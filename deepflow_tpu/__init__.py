@@ -1,0 +1,29 @@
+"""deepflow_tpu: a TPU-native streaming network-analytics framework.
+
+A from-scratch re-design of DeepFlow's server-side data plane
+(reference: server/ingester in dzy176/deepflow) for TPU hardware:
+
+- ``wire``     — the agent firehose protocol (BaseHeader/FlowHeader framing,
+                 flow_log/metric protobuf schemas, batched PB codec).
+- ``decode``   — columnar decoders turning framed record streams into
+                 structure-of-arrays host buffers (C++ fast path + Python).
+- ``batch``    — record->tensor batching with static shapes, padding masks and
+                 double buffering across the host->device boundary.
+- ``ops``      — JAX/Pallas sketch kernels: multiply-shift hashing, Count-Min,
+                 HyperLogLog, top-K heavy hitters, windowed entropy, Oja PCA.
+- ``models``   — end-to-end streaming analytics models composed from ops
+                 (heavy-hitter tracker, cardinality tracker, DDoS entropy
+                 detector, golden-signal anomaly detector).
+- ``parallel`` — device mesh construction, shard_map'd update steps, ICI
+                 collective merges (psum/pmax) of mergeable sketch state.
+- ``runtime``  — the ingester runtime: receiver, overwrite queues, reservoir
+                 throttler, exporter plugin registry, self-telemetry stats,
+                 config loading, debug introspection.
+- ``replay``   — synthetic agent: generates and sends wire-exact firehose
+                 traffic for tests and benchmarks.
+- ``store``    — sketch snapshot checkpoint/restore (mergeable state).
+- ``query``    — query surface over sketch outputs (top-K, cardinality,
+                 entropy series) analogous to the reference's querier.
+"""
+
+__version__ = "0.1.0"

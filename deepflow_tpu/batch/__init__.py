@@ -1,0 +1,4 @@
+from deepflow_tpu.batch.schema import L4_SCHEMA, METRIC_SCHEMA, Schema
+from deepflow_tpu.batch.batcher import Batcher, TensorBatch
+
+__all__ = ["L4_SCHEMA", "METRIC_SCHEMA", "Schema", "Batcher", "TensorBatch"]

@@ -1,0 +1,78 @@
+"""Record->tensor batching with static shapes.
+
+Accumulates decoded column chunks into fixed-capacity host buffers and emits
+`TensorBatch`es of exactly `capacity` rows — full ones as the stream runs,
+and padded ones (valid < capacity) at window flush. Static shapes mean XLA
+compiles the sketch update exactly once (SURVEY.md §7 "pad + mask, carry
+remainder between steps"). The role is the reference decoder's Gets(1024)
+batch loop (server/ingester/flow_log/decoder/decoder.go:132-169), reshaped
+for a device boundary instead of a ClickHouse writer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+from deepflow_tpu.batch.schema import Schema
+
+
+@dataclass
+class TensorBatch:
+    """A fixed-shape columnar batch; rows >= valid are padding."""
+
+    columns: Dict[str, np.ndarray]
+    valid: int
+
+    @property
+    def capacity(self) -> int:
+        return 0 if not self.columns else len(next(iter(self.columns.values())))
+
+    def mask(self) -> np.ndarray:
+        return np.arange(self.capacity) < self.valid
+
+
+class Batcher:
+    """Accumulates column chunks; yields full static-shape batches."""
+
+    def __init__(self, schema: Schema, capacity: int) -> None:
+        self.schema = schema
+        self.capacity = capacity
+        self._buf = schema.alloc(capacity)
+        self._fill = 0
+        self.total_rows = 0
+        self.emitted_batches = 0
+
+    def put(self, cols: Dict[str, np.ndarray]) -> Iterator[TensorBatch]:
+        """Append a chunk; yield zero or more exactly-full batches."""
+        n = len(cols[self.schema.names[0]])
+        self.total_rows += n
+        off = 0
+        while n - off > 0:
+            take = min(self.capacity - self._fill, n - off)
+            for name in self.schema.names:
+                self._buf[name][self._fill:self._fill + take] = cols[name][off:off + take]
+            self._fill += take
+            off += take
+            if self._fill == self.capacity:
+                yield self._emit(self.capacity)
+
+    def flush(self) -> Iterator[TensorBatch]:
+        """Emit the partial remainder (padded), e.g. at a window boundary."""
+        if self._fill > 0:
+            yield self._emit(self._fill)
+
+    def _emit(self, valid: int) -> TensorBatch:
+        # Hand the filled buffer to the batch and allocate a replacement —
+        # one allocation per batch, no copy (the reference's pool discipline,
+        # server/libs/pool, minus the free-list).
+        out = self._buf
+        if valid < self.capacity:
+            for n in self.schema.names:
+                out[n][valid:] = 0
+        self._buf = self.schema.alloc(self.capacity)
+        self._fill = 0
+        self.emitted_batches += 1
+        return TensorBatch(columns=out, valid=valid)

@@ -1,0 +1,102 @@
+"""Columnar feature schemas: the tensor mirror of the reference row schemas.
+
+The L4 schema covers the subset of l4_flow_log columns the sketch kernels
+consume (reference: server/ingester/flow_log/log_data/l4_flow_log.go —
+5-tuple :79-170, metrics :456-486, KnowledgeGraph ints :226-266). Every
+column is a fixed-dtype numpy array; a batch is a dict of equal-length
+columns plus a validity count (pad+mask discipline for XLA static shapes).
+
+64-bit wire counters (byte/packet counts) are carried as uint32 on device —
+they are per-record deltas, far below 2^32; window totals live in sketch
+cells whose dtype the caller picks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Schema:
+    name: str
+    columns: Tuple[Tuple[str, np.dtype], ...]
+
+    def alloc(self, capacity: int) -> Dict[str, np.ndarray]:
+        return {n: np.zeros(capacity, dtype=d) for n, d in self.columns}
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.columns)
+
+    def row_bytes(self) -> int:
+        return sum(np.dtype(d).itemsize for _, d in self.columns)
+
+
+L4_SCHEMA = Schema(
+    name="l4_flow_log",
+    columns=(
+        ("ip_src", np.dtype(np.uint32)),
+        ("ip_dst", np.dtype(np.uint32)),
+        ("port_src", np.dtype(np.uint32)),
+        ("port_dst", np.dtype(np.uint32)),
+        ("proto", np.dtype(np.uint32)),
+        ("vtap_id", np.dtype(np.uint32)),
+        ("tap_side", np.dtype(np.uint32)),
+        ("l3_epc_id", np.dtype(np.int32)),
+        ("byte_tx", np.dtype(np.uint32)),
+        ("byte_rx", np.dtype(np.uint32)),
+        ("packet_tx", np.dtype(np.uint32)),
+        ("packet_rx", np.dtype(np.uint32)),
+        ("rtt", np.dtype(np.uint32)),
+        ("retrans", np.dtype(np.uint32)),
+        ("close_type", np.dtype(np.uint32)),
+        ("timestamp", np.dtype(np.uint32)),   # start_time ns -> s
+        ("duration_us", np.dtype(np.uint32)),
+    ),
+)
+
+L7_SCHEMA = Schema(
+    name="l7_flow_log",
+    columns=(
+        ("ip_src", np.dtype(np.uint32)),
+        ("ip_dst", np.dtype(np.uint32)),
+        ("port_src", np.dtype(np.uint32)),
+        ("port_dst", np.dtype(np.uint32)),
+        ("protocol", np.dtype(np.uint32)),     # transport proto
+        ("l7_protocol", np.dtype(np.uint32)),  # AppProtoHead.proto
+        ("msg_type", np.dtype(np.uint32)),
+        ("vtap_id", np.dtype(np.uint32)),
+        ("endpoint_hash", np.dtype(np.uint32)),  # hashed req endpoint string
+        ("status", np.dtype(np.uint32)),
+        ("rrt_us", np.dtype(np.uint32)),
+        ("req_len", np.dtype(np.int32)),
+        ("resp_len", np.dtype(np.int32)),
+        ("timestamp", np.dtype(np.uint32)),
+    ),
+)
+
+METRIC_SCHEMA = Schema(
+    name="flow_metrics",
+    columns=(
+        ("timestamp", np.dtype(np.uint32)),
+        ("ip", np.dtype(np.uint32)),
+        ("server_port", np.dtype(np.uint32)),
+        ("vtap_id", np.dtype(np.uint32)),
+        ("protocol", np.dtype(np.uint32)),
+        ("packet_tx", np.dtype(np.uint32)),
+        ("packet_rx", np.dtype(np.uint32)),
+        ("byte_tx", np.dtype(np.uint32)),
+        ("byte_rx", np.dtype(np.uint32)),
+        ("new_flow", np.dtype(np.uint32)),
+        ("closed_flow", np.dtype(np.uint32)),
+        ("syn", np.dtype(np.uint32)),
+        ("synack", np.dtype(np.uint32)),
+        ("retrans_tx", np.dtype(np.uint32)),
+        ("retrans_rx", np.dtype(np.uint32)),
+        ("rtt_sum", np.dtype(np.uint32)),
+        ("rtt_count", np.dtype(np.uint32)),
+    ),
+)

@@ -1,0 +1,7 @@
+from deepflow_tpu.decode.columnar import (
+    decode_l4_records,
+    decode_l7_records,
+    decode_metric_records,
+)
+
+__all__ = ["decode_l4_records", "decode_l7_records", "decode_metric_records"]

@@ -1,0 +1,120 @@
+"""Reference Python columnar decoders: pb records -> schema columns.
+
+This is the correctness oracle and fallback; the line-rate path is the C++
+decoder (deepflow_tpu.decode.native), which walks the protobuf wire format
+directly into the same column layout. Mirrors the reference decode stage
+(server/ingester/flow_log/decoder/decoder.go:176-192 TaggedFlow ->
+L4FlowLog), but emits structure-of-arrays instead of row structs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+from deepflow_tpu.batch.schema import L4_SCHEMA, L7_SCHEMA, METRIC_SCHEMA
+from deepflow_tpu.wire.gen import flow_log_pb2, metric_pb2
+
+_NS_PER_S = 1_000_000_000
+
+
+def _fnv1a32(data: bytes) -> int:
+    h = 0x811C9DC5
+    for b in data:
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def _u32(v: int) -> int:
+    return v & 0xFFFFFFFF
+
+
+def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
+    """Parse TaggedFlow records into L4_SCHEMA columns."""
+    rows: List[tuple] = []
+    for raw in records:
+        m = flow_log_pb2.TaggedFlow()
+        m.ParseFromString(raw)
+        f = m.flow
+        k = f.flow_key
+        tcp = f.perf_stats.tcp
+        rows.append((
+            k.ip_src, k.ip_dst, k.port_src, k.port_dst, k.proto,
+            k.vtap_id, f.tap_side, _u32(f.metrics_peer_src.l3_epc_id),
+            _u32(f.metrics_peer_src.byte_count),
+            _u32(f.metrics_peer_dst.byte_count),
+            _u32(f.metrics_peer_src.packet_count),
+            _u32(f.metrics_peer_dst.packet_count),
+            tcp.rtt, tcp.total_retrans_count, f.close_type,
+            _u32(f.start_time // _NS_PER_S),
+            _u32(min(f.duration // 1000, 0xFFFFFFFF)),
+        ))
+    cols = L4_SCHEMA.alloc(len(rows))
+    if rows:
+        arr = np.array(rows, dtype=np.uint64)
+        for i, (name, dt) in enumerate(L4_SCHEMA.columns):
+            if dt == np.dtype(np.int32):
+                cols[name][:] = arr[:, i].astype(np.uint32).view(np.int32)
+            else:
+                cols[name][:] = arr[:, i].astype(dt)
+    return cols
+
+
+def decode_l7_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
+    """Parse AppProtoLogsData records into L7_SCHEMA columns.
+
+    String endpoints are hashed to uint32 on the host (FNV-1a), matching the
+    SmartEncoding philosophy: strings become integers before they reach the
+    columnar/device domain (reference: the tagrecorder dictionary approach,
+    SURVEY.md §2.3).
+    """
+    rows: List[tuple] = []
+    for raw in records:
+        m = flow_log_pb2.AppProtoLogsData()
+        m.ParseFromString(raw)
+        b = m.base
+        endpoint = (m.req.endpoint or m.req.resource or m.req.domain).encode()
+        rows.append((
+            b.ip_src, b.ip_dst, b.port_src, b.port_dst, b.protocol,
+            b.head.proto, b.head.msg_type, b.vtap_id,
+            _fnv1a32(endpoint), m.resp.status,
+            _u32(b.head.rrt // 1000), _u32(m.req_len), _u32(m.resp_len),
+            _u32(b.start_time // _NS_PER_S),
+        ))
+    cols = L7_SCHEMA.alloc(len(rows))
+    if rows:
+        arr = np.array(rows, dtype=np.uint64)
+        for i, (name, dt) in enumerate(L7_SCHEMA.columns):
+            if dt == np.dtype(np.int32):
+                cols[name][:] = arr[:, i].astype(np.uint32).view(np.int32)
+            else:
+                cols[name][:] = arr[:, i].astype(dt)
+    return cols
+
+
+def decode_metric_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
+    """Parse metric Document records into METRIC_SCHEMA columns."""
+    rows: List[tuple] = []
+    for raw in records:
+        d = metric_pb2.Document()
+        d.ParseFromString(raw)
+        fld = d.tag.field
+        ip = int.from_bytes(fld.ip, "big") if fld.ip else 0
+        t = d.meter.flow.traffic
+        p = d.meter.flow.performance
+        lat = d.meter.flow.latency
+        rows.append((
+            d.timestamp, _u32(ip), fld.server_port, fld.vtap_id, fld.protocol,
+            _u32(t.packet_tx), _u32(t.packet_rx),
+            _u32(t.byte_tx), _u32(t.byte_rx),
+            _u32(t.new_flow), _u32(t.closed_flow), t.syn, t.synack,
+            _u32(p.retrans_tx), _u32(p.retrans_rx),
+            _u32(lat.rtt_sum), lat.rtt_count,
+        ))
+    cols = METRIC_SCHEMA.alloc(len(rows))
+    if rows:
+        arr = np.array(rows, dtype=np.uint64)
+        for i, (name, dt) in enumerate(METRIC_SCHEMA.columns):
+            cols[name][:] = arr[:, i].astype(dt)
+    return cols

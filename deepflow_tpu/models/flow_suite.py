@@ -1,0 +1,151 @@
+"""The flagship streaming model: all l4_flow_log sketches in one jitted step.
+
+One `update` consumes a static-shape L4 TensorBatch (as device arrays) and
+advances, in a single XLA program:
+
+- Count-Min (conservative) over the flow 5-tuple  -> heavy-hitter counts
+- candidate ring                                  -> top-K flows
+- per-service HyperLogLog                         -> distinct client IPs
+- 4-feature entropy histograms                    -> DDoS signals
+- per-service byte/packet accumulators            -> service meters
+
+`flush` closes a 1s-style window: reads top-K / cardinalities / entropies,
+then resets window state. This is the TPU re-design of the reference's
+decode->enrich->aggregate ingester stage (SURVEY.md §3.2 hot path): where
+the reference fans records across threads into per-thread stashes, we fan
+lanes across a batch axis into device-resident sketch state; where it merges
+stashes over queues, we merge sketch pytrees with ICI collectives
+(deepflow_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import cms, entropy, hll, topk
+from deepflow_tpu.utils.u32 import fold_columns
+
+ENTROPY_FEATURES = ("ip_src", "ip_dst", "port_src", "port_dst")
+
+
+@dataclass(frozen=True)
+class FlowSuiteConfig:
+    cms_depth: int = 4
+    cms_log2_width: int = 16
+    ring_size: int = 2048
+    top_k: int = 100
+    hll_groups: int = 1024       # service hash space
+    hll_precision: int = 10
+    entropy_log2_buckets: int = 12
+    conservative: bool = True
+    seed: int = 0xDEC0DE
+
+
+class FlowSuiteState(NamedTuple):
+    sketch: cms.CMSState
+    ring: topk.TopKState
+    services: hll.HLLState
+    ent: entropy.EntropyState
+    rows_seen: jnp.ndarray       # [] int32 valid rows this window
+    batches_seen: jnp.ndarray    # [] int32
+
+
+class FlowWindowOutput(NamedTuple):
+    topk_keys: jnp.ndarray       # [K] uint32 flow-key hashes
+    topk_counts: jnp.ndarray     # [K] int32
+    service_cardinality: jnp.ndarray  # [hll_groups] float32 distinct clients
+    entropies: jnp.ndarray       # [4] normalized src/dst ip/port entropy
+    rows: jnp.ndarray            # [] int32
+
+
+def init(cfg: FlowSuiteConfig) -> FlowSuiteState:
+    return FlowSuiteState(
+        sketch=cms.init(cfg.cms_depth, cfg.cms_log2_width, cfg.seed),
+        ring=topk.init(cfg.ring_size),
+        services=hll.init(cfg.hll_groups, cfg.hll_precision),
+        ent=entropy.init(len(ENTROPY_FEATURES), cfg.entropy_log2_buckets,
+                         cfg.seed ^ 0xE27),
+        rows_seen=jnp.zeros((), jnp.int32),
+        batches_seen=jnp.zeros((), jnp.int32),
+    )
+
+
+def flow_key(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """uint32 flow key from the 5-tuple (the heavy-hitter key space)."""
+    return fold_columns([cols["ip_src"], cols["ip_dst"], cols["port_src"],
+                         cols["port_dst"], cols["proto"]])
+
+
+def service_key(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """uint32 service key: (server ip, server port, proto)."""
+    return fold_columns([cols["ip_dst"], cols["port_dst"], cols["proto"]])
+
+
+def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
+           mask: jnp.ndarray, cfg: FlowSuiteConfig) -> FlowSuiteState:
+    """Advance all sketches by one static-shape batch. Fully jittable."""
+    fkey = flow_key(cols)
+    skey = service_key(cols)
+    upd = cms.update_conservative if cfg.conservative else cms.update
+    sketch = upd(state.sketch, fkey, mask=mask)
+    ring = topk.offer(state.ring, fkey, sketch, mask=mask)
+    group = (skey % np.uint32(cfg.hll_groups)).astype(jnp.int32)
+    services = hll.update(state.services, group, cols["ip_src"], mask=mask)
+    feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
+    packets = cols["packet_tx"] + cols["packet_rx"]
+    ent = entropy.update(state.ent, feats, packets.astype(jnp.int32), mask)
+    return FlowSuiteState(
+        sketch=sketch,
+        ring=ring,
+        services=services,
+        ent=ent,
+        rows_seen=state.rows_seen + jnp.sum(mask.astype(jnp.int32)),
+        batches_seen=state.batches_seen + 1,
+    )
+
+
+def flush(state: FlowSuiteState, cfg: FlowSuiteConfig
+          ) -> Tuple[FlowSuiteState, FlowWindowOutput]:
+    """Read window outputs, then reset window-scoped state."""
+    keys, counts = topk.result(state.ring, cfg.top_k)
+    out = FlowWindowOutput(
+        topk_keys=keys,
+        topk_counts=counts,
+        service_cardinality=hll.estimate(state.services),
+        entropies=entropy.entropies(state.ent),
+        rows=state.rows_seen,
+    )
+    fresh = FlowSuiteState(
+        sketch=cms.reset(state.sketch),
+        ring=topk.reset(state.ring),
+        services=hll.reset(state.services),
+        ent=entropy.reset(state.ent),
+        rows_seen=jnp.zeros((), jnp.int32),
+        batches_seen=jnp.zeros((), jnp.int32),
+    )
+    return fresh, out
+
+
+def merge(a: FlowSuiteState, b: FlowSuiteState, cfg: FlowSuiteConfig) -> FlowSuiteState:
+    """Merge two window states (e.g. per-chip partials). All components are
+    mergeable: CMS add, HLL max, histogram add, ring re-top-k."""
+    sketch = cms.merge(a.sketch, b.sketch)
+    all_keys = jnp.concatenate([a.ring.keys, b.ring.keys])
+    all_counts = jnp.concatenate([a.ring.counts, b.ring.counts])
+    k, c = topk._dedup_keep_max(all_keys, all_counts)
+    top_c, top_i = jax.lax.top_k(c, a.ring.keys.shape[0])
+    ring = topk.TopKState(keys=k[top_i], counts=top_c)
+    return FlowSuiteState(
+        sketch=sketch,
+        ring=ring,
+        services=hll.merge(a.services, b.services),
+        ent=entropy.merge(a.ent, b.ent),
+        rows_seen=a.rows_seen + b.rows_seen,
+        batches_seen=a.batches_seen + b.batches_seen,
+    )

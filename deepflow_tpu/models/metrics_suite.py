@@ -1,0 +1,103 @@
+"""Streaming anomaly models over the flow_metrics Document stream.
+
+Two detectors driven by METRIC_SCHEMA batches (the decoded form of the
+agent's 1s Documents — reference: server/ingester/flow_metrics/unmarshaller):
+
+- **DDoS entropy detector** (BASELINE.md config 4): per-window traffic
+  entropy over (ip, server_port) weighted by packets, EWMA-tracked; a z-score
+  spike on src dispersion + dst concentration raises the alarm flag.
+- **Golden-signal PCA** (config 5): Oja streaming PCA over the log1p'd meter
+  vector; reconstruction residual is the anomaly score.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import entropy, pca
+
+GOLDEN_SIGNALS = (
+    "packet_tx", "packet_rx", "byte_tx", "byte_rx",
+    "new_flow", "closed_flow", "syn", "synack",
+    "retrans_tx", "retrans_rx", "rtt_sum", "rtt_count",
+)
+
+ENTROPY_FEATURES = ("ip", "server_port")
+
+
+@dataclass(frozen=True)
+class MetricsSuiteConfig:
+    pca_k: int = 3
+    entropy_log2_buckets: int = 10
+    ewma_alpha: float = 0.05
+    z_threshold: float = 4.0
+    pca_lr: float = 0.05
+    seed: int = 0x3E7
+
+
+class MetricsSuiteState(NamedTuple):
+    ent: entropy.EntropyState
+    ent_mean: jnp.ndarray   # [2] EWMA of per-window entropies
+    ent_var: jnp.ndarray    # [2]
+    windows: jnp.ndarray    # [] int32
+    pca: pca.PCAState
+
+
+class MetricsWindowOutput(NamedTuple):
+    entropies: jnp.ndarray      # [2]
+    z_scores: jnp.ndarray       # [2]
+    ddos_alarm: jnp.ndarray     # [] bool
+    anomaly_scores: jnp.ndarray  # [n] PCA residual per record of last batch
+
+
+def init(cfg: MetricsSuiteConfig) -> MetricsSuiteState:
+    return MetricsSuiteState(
+        ent=entropy.init(len(ENTROPY_FEATURES), cfg.entropy_log2_buckets, cfg.seed),
+        ent_mean=jnp.full((len(ENTROPY_FEATURES),), 0.5, jnp.float32),
+        ent_var=jnp.full((len(ENTROPY_FEATURES),), 0.25, jnp.float32),
+        windows=jnp.zeros((), jnp.int32),
+        pca=pca.init(len(GOLDEN_SIGNALS), cfg.pca_k),
+    )
+
+
+def signal_matrix(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """[n, signals] float32 log1p-compressed golden-signal matrix."""
+    x = jnp.stack([cols[s].astype(jnp.float32) for s in GOLDEN_SIGNALS], axis=1)
+    return jnp.log1p(x)
+
+
+def update(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
+           mask: jnp.ndarray, cfg: MetricsSuiteConfig) -> MetricsSuiteState:
+    feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
+    packets = (cols["packet_tx"] + cols["packet_rx"]).astype(jnp.int32)
+    ent = entropy.update(state.ent, feats, packets, mask)
+    p = pca.update(state.pca, signal_matrix(cols), mask, lr=cfg.pca_lr)
+    return state._replace(ent=ent, pca=p)
+
+
+def flush(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
+          mask: jnp.ndarray, cfg: MetricsSuiteConfig
+          ) -> Tuple[MetricsSuiteState, MetricsWindowOutput]:
+    """Close the entropy window; score the (last) batch against the PCA."""
+    ents = entropy.entropies(state.ent)
+    std = jnp.sqrt(state.ent_var + 1e-6)
+    z = (ents - state.ent_mean) / std
+    # Volumetric DDoS: victim (dst ip) entropy collapses while the window is
+    # busy — alarm on a large |z| swing once the EWMA is warmed up.
+    alarm = (state.windows > 10) & (jnp.max(jnp.abs(z)) > cfg.z_threshold)
+    a = cfg.ewma_alpha
+    mean = (1 - a) * state.ent_mean + a * ents
+    var = (1 - a) * state.ent_var + a * (ents - mean) ** 2
+    scores = pca.score(state.pca, signal_matrix(cols)) * mask.astype(jnp.float32)
+    out = MetricsWindowOutput(entropies=ents, z_scores=z, ddos_alarm=alarm,
+                              anomaly_scores=scores)
+    fresh = state._replace(
+        ent=entropy.reset(state.ent),
+        ent_mean=mean,
+        ent_var=var,
+        windows=state.windows + 1,
+    )
+    return fresh, out

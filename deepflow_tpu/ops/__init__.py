@@ -1,0 +1,3 @@
+from deepflow_tpu.ops import cms, entropy, hashing, hll, pca, topk
+
+__all__ = ["cms", "entropy", "hashing", "hll", "pca", "topk"]

@@ -1,0 +1,125 @@
+"""Count-Min Sketch as a fixed-shape device-resident JAX kernel.
+
+State is `[depth, width]` integer counts (width a power of two). Updates are
+one flattened scatter-add per batch; queries are gathers + a row-min. The
+sketch is linearly mergeable (elementwise add), which is what lets multi-chip
+state merge ride ICI `psum` — the TPU-physical version of the reference
+merging per-thread metric stashes (agent/src/collector/quadruple_generator.rs
+SubQuadGen 1s/1m stashes).
+
+A conservative-update variant (`update_conservative`) cuts overestimation
+~2-4x for the same width, which is what keeps top-K recall loss <1% at
+realistic widths (BASELINE.md north star).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import hashing
+
+
+class CMSState(NamedTuple):
+    counts: jnp.ndarray  # [depth, width] int32 (or caller-chosen int dtype)
+    seeds: jnp.ndarray   # [depth, 2] uint32
+
+
+def init(depth: int, log2_width: int, seed: int = 0xDEC0DE, dtype=jnp.int32) -> CMSState:
+    if not (1 <= log2_width <= 26):
+        raise ValueError(f"log2_width {log2_width} out of range")
+    counts = jnp.zeros((depth, 1 << log2_width), dtype=dtype)
+    return CMSState(counts=counts, seeds=hashing.make_seeds(depth, seed))
+
+
+def log2_width(state: CMSState) -> int:
+    return int(np.log2(state.counts.shape[1]))
+
+
+def update(state: CMSState, keys: jnp.ndarray, weights: jnp.ndarray | None = None,
+           mask: jnp.ndarray | None = None) -> CMSState:
+    """Scatter-add a batch of (key, weight) into all rows. O(d·n) lanes.
+
+    `mask` zeroes padded lanes so static-shape batches (pad+mask streaming)
+    never pollute counts.
+    """
+    d, w = state.counts.shape
+    lw = int(np.log2(w))
+    n = keys.shape[0]
+    if weights is None:
+        weights = jnp.ones((n,), dtype=state.counts.dtype)
+    else:
+        weights = weights.astype(state.counts.dtype)
+    if mask is not None:
+        weights = weights * mask.astype(state.counts.dtype)
+    idx = hashing.multi_bucket(keys, state.seeds, lw)          # [d, n]
+    flat = (idx + (jnp.arange(d, dtype=jnp.int32) * w)[:, None]).reshape(-1)
+    vals = jnp.broadcast_to(weights[None, :], (d, n)).reshape(-1)
+    counts = state.counts.reshape(-1).at[flat].add(vals, mode="drop").reshape(d, w)
+    return state._replace(counts=counts)
+
+
+def query(state: CMSState, keys: jnp.ndarray) -> jnp.ndarray:
+    """Point estimate: min over rows of the hashed buckets. Overestimate."""
+    d, w = state.counts.shape
+    lw = int(np.log2(w))
+    idx = hashing.multi_bucket(keys, state.seeds, lw)          # [d, n]
+    flat = (idx + (jnp.arange(d, dtype=jnp.int32) * w)[:, None]).reshape(-1)
+    est = state.counts.reshape(-1)[flat].reshape(d, -1)
+    return jnp.min(est, axis=0)
+
+
+def update_conservative(state: CMSState, keys: jnp.ndarray,
+                        weights: jnp.ndarray | None = None,
+                        mask: jnp.ndarray | None = None) -> CMSState:
+    """Conservative update: bucket_i <- max(bucket_i, est + w_total(key)).
+
+    Batch-vectorized: sort keys, segment-sum duplicate weights onto the first
+    occurrence, then a single scatter-max per row. The max-merge preserves the
+    CMS overestimate invariant for every key in the batch (each colliding
+    candidate needs bucket >= its own est+w; max satisfies all).
+    """
+    d, w = state.counts.shape
+    lw = int(np.log2(w))
+    n = keys.shape[0]
+    if weights is None:
+        weights = jnp.ones((n,), dtype=state.counts.dtype)
+    else:
+        weights = weights.astype(state.counts.dtype)
+    if mask is not None:
+        weights = weights * mask.astype(state.counts.dtype)
+
+    order = jnp.argsort(keys)
+    sk = keys[order]
+    sw = weights[order]
+    first = jnp.concatenate([jnp.ones((1,), jnp.bool_), sk[1:] != sk[:-1]])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1                # segment ids
+    totals = jax.ops.segment_sum(sw, seg, num_segments=n)        # [n] padded
+    w_total = totals[seg] * first.astype(state.counts.dtype)     # only firsts
+
+    est = query(state, sk)                                       # [n]
+    target = est + w_total
+    idx = hashing.multi_bucket(sk, state.seeds, lw)
+    flat = (idx + (jnp.arange(d, dtype=jnp.int32) * w)[:, None]).reshape(-1)
+    tgt = jnp.broadcast_to(target[None, :], (d, n)).reshape(-1)
+    # padded/duplicate lanes carry target == est (w_total 0), a no-op for max
+    counts = state.counts.reshape(-1).at[flat].max(tgt, mode="drop").reshape(d, w)
+    return state._replace(counts=counts)
+
+
+def merge(a: CMSState, b: CMSState) -> CMSState:
+    """CMS merge = elementwise add (seeds must match)."""
+    return a._replace(counts=a.counts + b.counts)
+
+
+def reset(state: CMSState) -> CMSState:
+    return state._replace(counts=jnp.zeros_like(state.counts))
+
+
+def decay(state: CMSState, shift: int = 1) -> CMSState:
+    """Halve (or >>shift) all counts: cheap sliding-window forgetting."""
+    return state._replace(counts=state.counts >> shift)

@@ -1,0 +1,46 @@
+"""Multiply-shift hash families on uint32 lanes.
+
+All sketch kernels share this family. Widths are powers of two so bucket
+selection is a top-bits shift (multiply-shift universal hashing), never a
+modulo — TPU-friendly and avalanche-tested in tests/test_hashing.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepflow_tpu.utils.u32 import as_u32, mix32, splitmix32_seeds
+
+_U32 = np.uint32
+
+
+def make_seeds(depth: int, seed: int = 0xDEC0DE) -> jnp.ndarray:
+    """[depth, 2] odd uint32 (multiplier, xor-salt) pairs."""
+    raw = splitmix32_seeds(2 * depth, seed)
+    return jnp.asarray(raw.reshape(depth, 2))
+
+
+def bucket(keys: jnp.ndarray, mult: jnp.ndarray, salt: jnp.ndarray, log2_width: int) -> jnp.ndarray:
+    """h(x) = top log2_width bits of (mult * mix32(x ^ salt)); shape of keys."""
+    x = mix32(as_u32(keys) ^ salt)
+    return ((mult * x) >> _U32(32 - log2_width)).astype(jnp.int32)
+
+
+def multi_bucket(keys: jnp.ndarray, seeds: jnp.ndarray, log2_width: int) -> jnp.ndarray:
+    """[depth, n] bucket indices for each of the `depth` hash rows.
+
+    Plays the role of the d independent hash rows of a Count-Min sketch; the
+    reference's exact GROUP BY has no analogue — this is where the TPU design
+    trades exactness for a fixed-shape, device-resident state.
+    """
+    mult = seeds[:, 0][:, None]  # [d, 1]
+    salt = seeds[:, 1][:, None]
+    x = mix32(as_u32(keys)[None, :] ^ salt)
+    return ((mult * x) >> _U32(32 - log2_width)).astype(jnp.int32)
+
+
+def fingerprint(keys: jnp.ndarray, salt: int = 0xF1A9E12) -> jnp.ndarray:
+    """Secondary 32-bit fingerprint, independent of bucket hashes."""
+    return mix32(as_u32(keys) ^ _U32(salt))

@@ -1,0 +1,111 @@
+"""Grouped HyperLogLog on device: `[groups, m]` register arrays.
+
+Tracks distinct-cardinality (e.g. distinct client IPs per service_id — the
+l7_flow_log HLL config in BASELINE.md) for many groups at once. Registers are
+int32 for VPU friendliness (values fit in 6 bits). Updates are one flattened
+scatter-max; merge across chips is elementwise max, so multi-device merge is
+a single `lax.pmax`/psum-style ICI collective.
+
+Estimator: Ertl's improved estimator ("New cardinality estimation algorithms
+for HyperLogLog sketches", 2017) — bias-free across the full range without
+HLL++ empirical tables, built from fixed-iteration σ/τ series that jit
+cleanly (no data-dependent loops).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.utils.u32 import as_u32, mix32
+
+_U32 = np.uint32
+
+
+class HLLState(NamedTuple):
+    registers: jnp.ndarray  # [groups, m] int32
+
+
+def init(groups: int, precision: int = 12) -> HLLState:
+    """precision p: m = 2^p registers per group (p=12 -> ~1.6% rel. error)."""
+    if not (4 <= precision <= 16):
+        raise ValueError(f"precision {precision} out of range")
+    return HLLState(registers=jnp.zeros((groups, 1 << precision), dtype=jnp.int32))
+
+
+def _precision(state: HLLState) -> int:
+    return int(np.log2(state.registers.shape[1]))
+
+
+def update(state: HLLState, group_ids: jnp.ndarray, keys: jnp.ndarray,
+           mask: jnp.ndarray | None = None) -> HLLState:
+    g, m = state.registers.shape
+    p = int(np.log2(m))
+    h = mix32(as_u32(keys))
+    reg_idx = (h >> _U32(32 - p)).astype(jnp.int32)             # top p bits
+    rest = h << _U32(p)                                          # low 32-p bits up top
+    rho = jnp.minimum(jax.lax.clz(rest.astype(jnp.int32)), 32 - p) + 1
+    gid = jnp.clip(group_ids.astype(jnp.int32), 0, g - 1)
+    if mask is not None:
+        # masked lanes write rho=0: a no-op for scatter-max (registers >= 0)
+        rho = jnp.where(mask, rho, 0)
+    flat = gid * m + reg_idx
+    regs = state.registers.reshape(-1).at[flat].max(rho, mode="drop").reshape(g, m)
+    return HLLState(registers=regs)
+
+
+def _sigma(x: jnp.ndarray, iters: int = 32) -> jnp.ndarray:
+    """Ertl σ(x) = x + Σ x^(2^k) 2^(k-1); diverges at x=1 (guarded by caller)."""
+    y = jnp.ones_like(x)
+    z = x
+    for _ in range(iters):
+        x = x * x
+        z = z + x * y
+        y = y + y
+    return z
+
+
+def _tau(x: jnp.ndarray, iters: int = 32) -> jnp.ndarray:
+    """Ertl τ(x); τ(0) = τ(1) = 0."""
+    y = jnp.ones_like(x)
+    z = 1.0 - x
+    for _ in range(iters):
+        x = jnp.sqrt(x)
+        y = 0.5 * y
+        z = z - jnp.square(1.0 - x) * y
+    return z / 3.0
+
+
+def estimate(state: HLLState) -> jnp.ndarray:
+    """[groups] float32 cardinality estimates (Ertl improved estimator)."""
+    g, m = state.registers.shape
+    p = int(np.log2(m))
+    q = 32 - p
+    # Per-group histogram C[k] of register values, k in [0, q+1], via a
+    # flattened scatter-add: O(g*m) work, no [g, m, q+2] broadcast blow-up.
+    ks = jnp.arange(q + 2, dtype=jnp.int32)
+    rows = jnp.repeat(jnp.arange(g, dtype=jnp.int32), m)
+    flat = rows * (q + 2) + jnp.clip(state.registers.reshape(-1), 0, q + 1)
+    c = jnp.zeros((g * (q + 2),), jnp.int32).at[flat].add(1).reshape(g, q + 2)
+    c = c.astype(jnp.float32)                                     # [g, q+2]
+    mf = jnp.float32(m)
+    z = mf * _tau(1.0 - c[:, q + 1] / mf) * jnp.float32(2.0 ** (-q))
+    pow2 = jnp.exp2(-ks[1:q + 1].astype(jnp.float32))             # [q]
+    mid = jnp.sum(c[:, 1:q + 1] * pow2[None, :], axis=1)
+    denom = z + mid + mf * _sigma(c[:, 0] / mf)
+    alpha_inf = jnp.float32(1.0 / (2.0 * np.log(2.0)))
+    est = alpha_inf * mf * mf / denom
+    # All-zero sketch (σ(1) series saturates at iteration cap) -> exactly 0.
+    return jnp.where(c[:, 0] >= mf, 0.0, est)
+
+
+def merge(a: HLLState, b: HLLState) -> HLLState:
+    return HLLState(registers=jnp.maximum(a.registers, b.registers))
+
+
+def reset(state: HLLState) -> HLLState:
+    return HLLState(registers=jnp.zeros_like(state.registers))

@@ -1,0 +1,96 @@
+"""Streaming PCA (Oja subspace tracking) for golden-signal anomaly scores.
+
+Tracks the top-k principal subspace of the flow_metrics golden signals
+(throughput, new/closed flows, retrans, RTT/SRT/ART sums...) with
+EMA-standardized inputs and batched Oja updates; anomaly score is the
+reconstruction residual outside the tracked subspace (BASELINE.md config 5).
+
+The Oja gradient Zᵀ(ZW) is a per-batch matmul — MXU work — and is exactly
+data-parallel: local grads from each chip's batch shard merge with one ICI
+`psum` before the replicated W update.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class PCAState(NamedTuple):
+    mean: jnp.ndarray   # [f] EMA mean
+    var: jnp.ndarray    # [f] EMA variance
+    w: jnp.ndarray      # [f, k] orthonormal basis
+    step: jnp.ndarray   # [] int32
+
+
+def init(features: int, k: int, seed: int = 7) -> PCAState:
+    # Deterministic full-rank init: identity-ish slab, orthonormal by QR.
+    a = jnp.eye(features, k, dtype=jnp.float32)
+    noise = jnp.sin(jnp.arange(features * k, dtype=jnp.float32)).reshape(features, k)
+    q, _ = jnp.linalg.qr(a + 0.01 * noise)
+    return PCAState(
+        mean=jnp.zeros((features,), jnp.float32),
+        var=jnp.ones((features,), jnp.float32),
+        w=q.astype(jnp.float32),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def _standardize(state: PCAState, x: jnp.ndarray) -> jnp.ndarray:
+    return (x - state.mean[None, :]) / jnp.sqrt(state.var[None, :] + 1e-6)
+
+
+def update(state: PCAState, x: jnp.ndarray, mask: jnp.ndarray | None = None,
+           lr: float = 0.05, ema: float = 0.01) -> PCAState:
+    """One batched Oja step on x: [n, features] float32."""
+    n = x.shape[0]
+    if mask is None:
+        m = jnp.ones((n,), jnp.float32)
+    else:
+        m = mask.astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(m), 1.0)
+    xm = x * m[:, None]
+    bmean = jnp.sum(xm, axis=0) / cnt
+    bvar = jnp.sum(((x - bmean[None, :]) ** 2) * m[:, None], axis=0) / cnt
+    mean = (1 - ema) * state.mean + ema * bmean
+    var = (1 - ema) * state.var + ema * bvar
+
+    z = _standardize(state._replace(mean=mean, var=var), x) * m[:, None]
+    g = z.T @ (z @ state.w) / cnt            # [f, k] — MXU matmuls
+    w, _ = jnp.linalg.qr(state.w + lr * g)
+    return PCAState(mean=mean, var=var, w=w.astype(jnp.float32),
+                    step=state.step + 1)
+
+
+def score(state: PCAState, x: jnp.ndarray) -> jnp.ndarray:
+    """[n] reconstruction-residual anomaly scores (L2 outside subspace)."""
+    z = _standardize(state, x)
+    proj = (z @ state.w) @ state.w.T
+    return jnp.sqrt(jnp.sum((z - proj) ** 2, axis=1))
+
+
+def grad(state: PCAState, x: jnp.ndarray, mask: jnp.ndarray | None = None):
+    """Expose (batch stats, Oja gradient) for cross-chip psum before update."""
+    n = x.shape[0]
+    m = jnp.ones((n,), jnp.float32) if mask is None else mask.astype(jnp.float32)
+    cnt = jnp.sum(m)
+    xm = x * m[:, None]
+    s1 = jnp.sum(xm, axis=0)
+    s2 = jnp.sum((x ** 2) * m[:, None], axis=0)
+    z = _standardize(state, x) * m[:, None]
+    g = z.T @ (z @ state.w)
+    return cnt, s1, s2, g
+
+
+def apply_grad(state: PCAState, cnt, s1, s2, g, lr: float = 0.05,
+               ema: float = 0.01) -> PCAState:
+    """Apply globally-reduced stats/gradient (after psum over chips)."""
+    c = jnp.maximum(cnt, 1.0)
+    bmean = s1 / c
+    bvar = jnp.maximum(s2 / c - bmean ** 2, 0.0)
+    mean = (1 - ema) * state.mean + ema * bmean
+    var = (1 - ema) * state.var + ema * bvar
+    w, _ = jnp.linalg.qr(state.w + lr * g / c)
+    return PCAState(mean=mean, var=var, w=w.astype(jnp.float32),
+                    step=state.step + 1)

@@ -1,0 +1,79 @@
+"""Device-side heavy-hitter top-K over a CMS-estimated candidate ring.
+
+Exact top-K needs the full key universe (the reference gets it for free from
+ClickHouse GROUP BY at query time). On device we instead keep a fixed-size
+candidate ring: every batch, the batch's (deduped) keys are scored against
+the Count-Min sketch, merged with the standing candidates, and compacted back
+to ring size with `lax.top_k` — all static shapes, fully jittable.
+
+Recall loss vs exact comes from (a) CMS overestimation (mitigated by
+conservative update) and (b) ring evictions (mitigated by ring_size >> K).
+tests/test_topk.py scores recall against an exact numpy GROUP BY, the
+in-repo stand-in for the reference exactness harness (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import cms
+
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
+class TopKState(NamedTuple):
+    keys: jnp.ndarray    # [ring] uint32, SENTINEL = empty
+    counts: jnp.ndarray  # [ring] int32 CMS estimates
+
+
+def init(ring_size: int) -> TopKState:
+    return TopKState(
+        keys=jnp.full((ring_size,), SENTINEL, dtype=jnp.uint32),
+        counts=jnp.full((ring_size,), -1, dtype=jnp.int32),
+    )
+
+
+def _dedup_keep_max(keys: jnp.ndarray, counts: jnp.ndarray):
+    """Sort by key; on equal runs keep the max count on one lane, -1 on rest."""
+    order = jnp.argsort(keys)
+    k = keys[order]
+    c = counts[order]
+    # Segment-max over equal-key runs, written back to the run's first lane.
+    first = jnp.concatenate([jnp.ones((1,), jnp.bool_), k[1:] != k[:-1]])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    seg_max = jax.ops.segment_max(c, seg, num_segments=k.shape[0])
+    c = jnp.where(first, seg_max[seg], -1)
+    k = jnp.where(first, k, SENTINEL)       # blank duplicate lanes entirely
+    c = jnp.where(k == SENTINEL, -1, c)
+    return k, c
+
+
+def offer(state: TopKState, batch_keys: jnp.ndarray, sketch: cms.CMSState,
+          mask: jnp.ndarray | None = None) -> TopKState:
+    """Merge a batch of keys (scored via `sketch`) into the candidate ring."""
+    bk = batch_keys.astype(jnp.uint32)
+    if mask is not None:
+        bk = jnp.where(mask, bk, SENTINEL)
+    est = cms.query(sketch, bk).astype(jnp.int32)
+    est = jnp.where(bk == SENTINEL, -1, est)
+    # Standing candidates get re-scored too: their CMS estimates only grow.
+    standing = jnp.where(state.keys == SENTINEL, -1,
+                         cms.query(sketch, state.keys).astype(jnp.int32))
+    all_keys = jnp.concatenate([state.keys, bk])
+    all_counts = jnp.concatenate([standing, est])
+    k, c = _dedup_keep_max(all_keys, all_counts)
+    top_c, top_i = jax.lax.top_k(c, state.keys.shape[0])
+    return TopKState(keys=k[top_i], counts=top_c)
+
+
+def result(state: TopKState, k: int):
+    """(keys, counts) of the current top-k, count-descending."""
+    top_c, top_i = jax.lax.top_k(state.counts, k)
+    return state.keys[top_i], top_c
+
+
+def reset(state: TopKState) -> TopKState:
+    return init(state.keys.shape[0])

@@ -1,0 +1,4 @@
+from deepflow_tpu.parallel.mesh import make_mesh
+from deepflow_tpu.parallel.sharded import ShardedFlowSuite
+
+__all__ = ["make_mesh", "ShardedFlowSuite"]

@@ -1,0 +1,40 @@
+"""Device mesh construction.
+
+The scale-out axis is the record stream (SURVEY.md §5 "long-context"): the
+batch axis shards across chips over `data`, sketch state lives per-chip, and
+window merges ride ICI collectives. This replaces the reference's two
+parallelism layers — per-CPU hashed multi-queues (agent trident.rs:1706) and
+agent↔ingester horizontal sharding (controller/monitor/) — with one SPMD
+mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axes: Sequence[str] = ("data",)) -> Mesh:
+    """1-D (default) mesh over the first n_devices; multi-axis if requested."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    devs = devs[:n]
+    if len(axes) == 1:
+        return Mesh(np.array(devs), axes)
+    # Factor n across the requested axes: peel the smallest prime factor for
+    # each leading axis, leaving the remainder (largest factor) on the last.
+    shape = []
+    rem = n
+    for _ in range(len(axes) - 1):
+        f = next((p for p in range(2, rem + 1) if rem % p == 0), 1)
+        shape.append(f)
+        rem //= f
+    shape.append(rem)
+    return Mesh(np.array(devs).reshape(shape), axes)

@@ -1,0 +1,131 @@
+"""Multi-chip FlowSuite: batch-sharded updates, collective window merges.
+
+State carries a leading device axis sharded over the mesh's `data` axis; each
+chip updates its own sketch shard from its batch shard inside `shard_map`
+(zero cross-chip traffic on the hot path). At window flush the partial
+sketches merge — CMS/histograms by add, HLL by max, rings by re-top-k — in
+one jitted program whose collectives XLA lays onto ICI. This is the
+TPU-physical form of the reference's per-thread stash merge
+(agent/src/collector/quadruple_generator.rs SubQuadGen) and the design
+SURVEY.md §7 Phase 4 calls for.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepflow_tpu.models import flow_suite
+from deepflow_tpu.models.flow_suite import (
+    FlowSuiteConfig,
+    FlowSuiteState,
+    FlowWindowOutput,
+)
+from deepflow_tpu.ops import cms, entropy, hll, topk
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _merge_axis0(state: FlowSuiteState) -> FlowSuiteState:
+    """Merge per-device partial states stacked on axis 0 into one."""
+    ring_keys = state.ring.keys.reshape(-1)
+    ring_counts = state.ring.counts.reshape(-1)
+    k, c = topk._dedup_keep_max(ring_keys, ring_counts)
+    ring_size = state.ring.keys.shape[1]
+    top_c, top_i = jax.lax.top_k(c, ring_size)
+    return FlowSuiteState(
+        sketch=cms.CMSState(counts=jnp.sum(state.sketch.counts, axis=0),
+                            seeds=state.sketch.seeds[0]),
+        ring=topk.TopKState(keys=k[top_i], counts=top_c),
+        services=hll.HLLState(registers=jnp.max(state.services.registers, axis=0)),
+        ent=entropy.EntropyState(hist=jnp.sum(state.ent.hist, axis=0),
+                                 seeds=state.ent.seeds[0]),
+        rows_seen=jnp.sum(state.rows_seen, axis=0),
+        batches_seen=jnp.sum(state.batches_seen, axis=0),
+    )
+
+
+class ShardedFlowSuite:
+    """FlowSuite sharded over a mesh's `data` axis.
+
+    update(state, cols, mask): cols/mask are [B] arrays, B % n_devices == 0;
+    each device consumes its shard. flush(state): merged window output +
+    fresh state.
+    """
+
+    def __init__(self, cfg: FlowSuiteConfig, mesh: Mesh,
+                 axis: str = "data") -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = mesh.shape[axis]
+        self._dev_spec = P(axis)
+        self._state_sharding = NamedSharding(mesh, self._dev_spec)
+        self._batch_sharding = NamedSharding(mesh, P(axis))
+
+        state_specs = jax.tree.map(lambda _: self._dev_spec, self._template())
+        cfg_ = cfg
+
+        def local_update(state, cols, mask):
+            local = jax.tree.map(lambda x: x[0], state)
+            local = flow_suite.update(local, cols, mask, cfg_)
+            return jax.tree.map(lambda x: x[None], local)
+
+        self._update = jax.jit(shard_map(
+            local_update,
+            mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis)),
+            out_specs=state_specs,
+            check_vma=False,
+        ))
+
+        def flush_fn(state):
+            merged = _merge_axis0(state)
+            # Re-score ring candidates against the globally-merged sketch:
+            # per-shard estimates only saw 1/n_devices of the stream.
+            rescored = jnp.where(
+                merged.ring.keys == topk.SENTINEL, -1,
+                cms.query(merged.sketch, merged.ring.keys).astype(jnp.int32))
+            merged = merged._replace(
+                ring=merged.ring._replace(counts=rescored))
+            fresh, out = flow_suite.flush(merged, cfg_)
+            fresh_d = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (self.n_devices,) + x.shape),
+                fresh)
+            return fresh_d, out
+
+        self._flush = jax.jit(flush_fn, out_shardings=(
+            jax.tree.map(lambda _: self._state_sharding, state_specs), None))
+
+    def _template(self) -> FlowSuiteState:
+        return flow_suite.init(self.cfg)
+
+    def init(self) -> FlowSuiteState:
+        single = flow_suite.init(self.cfg)
+        return jax.device_put(
+            jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (self.n_devices,) + x.shape),
+                single),
+            self._state_sharding)
+
+    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
+        """Host->device transfer of a batch, sharded along the data axis."""
+        cols_d = {k: jax.device_put(v, self._batch_sharding)
+                  for k, v in cols.items()}
+        mask_d = jax.device_put(mask, self._batch_sharding)
+        return cols_d, mask_d
+
+    def update(self, state: FlowSuiteState, cols: Dict,
+               mask) -> FlowSuiteState:
+        return self._update(state, cols, mask)
+
+    def flush(self, state: FlowSuiteState
+              ) -> Tuple[FlowSuiteState, FlowWindowOutput]:
+        return self._flush(state)

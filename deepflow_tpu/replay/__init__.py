@@ -1,0 +1,3 @@
+from deepflow_tpu.replay.generator import SyntheticAgent
+
+__all__ = ["SyntheticAgent"]

@@ -1,0 +1,156 @@
+"""Synthetic agent: generates wire-exact firehose traffic.
+
+Stands in for the Rust agent in tests and benchmarks, the way the reference
+uses synthetic senders (reference: server/ingester/droplet/adapter/tools/
+send.go) and pcap fixtures (SURVEY.md §4). Produces TaggedFlow / Document
+protobuf records with a Zipf-heavy key distribution plus the matching
+ground-truth numpy columns, so decoder and sketch outputs can be scored
+against exact aggregation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from deepflow_tpu.wire import (
+    FlowHeader,
+    MessageType,
+    encode_frame,
+    pack_pb_records,
+)
+from deepflow_tpu.wire.gen import flow_log_pb2, metric_pb2
+
+
+@dataclass
+class SyntheticAgent:
+    """Generates l4 TaggedFlow and flow_metrics Document streams."""
+
+    seed: int = 0xA9E27
+    vtap_id: int = 7
+    n_hosts: int = 4096          # distinct client IPs
+    n_services: int = 64         # distinct (server ip, port) pairs
+    zipf_a: float = 1.25
+    _seq: int = field(default=0, init=False)
+
+    def __post_init__(self) -> None:
+        self.rng = np.random.default_rng(self.seed)
+        base = int.from_bytes(b"\x0a\x00\x00\x00", "big")
+        self.client_ips = (base + self.rng.choice(1 << 20, self.n_hosts, replace=False)).astype(np.uint32)
+        sbase = int.from_bytes(b"\xac\x10\x00\x00", "big")
+        self.server_ips = (sbase + self.rng.choice(1 << 16, self.n_services, replace=False)).astype(np.uint32)
+        self.server_ports = self.rng.choice(
+            np.array([80, 443, 3306, 6379, 8080, 9092, 5432, 53], np.uint32),
+            self.n_services,
+        )
+
+    def l4_columns(self, n: int) -> dict:
+        """Ground-truth columns for n flow records (Zipf-heavy services)."""
+        r = self.rng
+        svc = (r.zipf(self.zipf_a, n) - 1).clip(max=self.n_services - 1)
+        cli = r.integers(0, self.n_hosts, n)
+        cols = {
+            "ip_src": self.client_ips[cli],
+            "ip_dst": self.server_ips[svc],
+            "port_src": r.integers(1024, 65536, n).astype(np.uint32),
+            "port_dst": self.server_ports[svc].astype(np.uint32),
+            "proto": np.where(r.random(n) < 0.9, 6, 17).astype(np.uint32),
+            "vtap_id": np.full(n, self.vtap_id, np.uint32),
+            "tap_side": r.integers(0, 3, n).astype(np.uint32),
+            "byte_tx": r.lognormal(6.0, 1.5, n).astype(np.uint64),
+            "byte_rx": r.lognormal(7.0, 1.5, n).astype(np.uint64),
+            "packet_tx": r.integers(1, 64, n).astype(np.uint64),
+            "packet_rx": r.integers(1, 64, n).astype(np.uint64),
+            "l3_epc_id": r.integers(-2, 100, n).astype(np.int32),
+            "start_time": (np.uint64(1_700_000_000_000_000_000)
+                           + np.arange(n, dtype=np.uint64) * np.uint64(1000)),
+            "duration": r.integers(10_000, 10_000_000_000, n).astype(np.uint64),
+            "close_type": r.integers(0, 8, n).astype(np.uint32),
+            "flow_id": np.arange(n, dtype=np.uint64) + np.uint64(1),
+            "rtt": r.integers(100, 200_000, n).astype(np.uint32),
+            "retrans": (r.random(n) < 0.02).astype(np.uint32) * r.integers(1, 5, n).astype(np.uint32),
+        }
+        return cols
+
+    def l4_columns_pooled(self, n: int, pool: int = 2048) -> dict:
+        """Columns where rows sample a fixed pool of `pool` distinct flow
+        5-tuples with Zipf weights — heavy flows genuinely repeat, so exact
+        GROUP BY top-K is well-defined (the recall-harness feed)."""
+        r = self.rng
+        base = self.l4_columns(pool)
+        pick = (r.zipf(self.zipf_a, n) - 1).clip(max=pool - 1)
+        cols = {k: v[pick] for k, v in base.items()}
+        cols["flow_id"] = np.arange(n, dtype=np.uint64) + np.uint64(1)
+        cols["start_time"] = (np.uint64(1_700_000_000_000_000_000)
+                              + np.arange(n, dtype=np.uint64) * np.uint64(1000))
+        return cols
+
+    @staticmethod
+    def l4_record(cols: dict, i: int) -> bytes:
+        """Serialize row i of the column dict as one TaggedFlow record."""
+        m = flow_log_pb2.TaggedFlow()
+        f = m.flow
+        k = f.flow_key
+        k.vtap_id = int(cols["vtap_id"][i])
+        k.tap_type = 3
+        k.ip_src = int(cols["ip_src"][i])
+        k.ip_dst = int(cols["ip_dst"][i])
+        k.port_src = int(cols["port_src"][i])
+        k.port_dst = int(cols["port_dst"][i])
+        k.proto = int(cols["proto"][i])
+        src = f.metrics_peer_src
+        src.byte_count = int(cols["byte_tx"][i])
+        src.packet_count = int(cols["packet_tx"][i])
+        src.total_byte_count = int(cols["byte_tx"][i])
+        src.l3_epc_id = int(cols["l3_epc_id"][i])
+        dst = f.metrics_peer_dst
+        dst.byte_count = int(cols["byte_rx"][i])
+        dst.packet_count = int(cols["packet_rx"][i])
+        dst.total_byte_count = int(cols["byte_rx"][i])
+        f.flow_id = int(cols["flow_id"][i])
+        f.start_time = int(cols["start_time"][i])
+        f.end_time = int(cols["start_time"][i] + cols["duration"][i])
+        f.duration = int(cols["duration"][i])
+        f.eth_type = 0x0800
+        f.close_type = int(cols["close_type"][i])
+        f.tap_side = int(cols["tap_side"][i])
+        f.is_new_flow = 1
+        if cols["rtt"][i] or cols["retrans"][i]:
+            f.has_perf_stats = 1
+            f.perf_stats.l4_protocol = 1
+            f.perf_stats.tcp.rtt = int(cols["rtt"][i])
+            f.perf_stats.tcp.total_retrans_count = int(cols["retrans"][i])
+        return m.SerializeToString()
+
+    def l4_batch(self, n: int) -> Tuple[dict, List[bytes]]:
+        cols = self.l4_columns(n)
+        return cols, [self.l4_record(cols, i) for i in range(n)]
+
+    def metric_record(self, ts: int, svc: int, traffic: dict) -> bytes:
+        d = metric_pb2.Document()
+        d.timestamp = ts
+        d.flags = 0
+        d.tag.code = 0x1
+        fld = d.tag.field
+        fld.ip = int(self.server_ips[svc % self.n_services]).to_bytes(4, "big")
+        fld.server_port = int(self.server_ports[svc % self.n_services])
+        fld.vtap_id = self.vtap_id
+        fld.protocol = 6
+        d.meter.meter_id = 0
+        t = d.meter.flow.traffic
+        for name, val in traffic.items():
+            setattr(t, name, int(val))
+        return d.SerializeToString()
+
+    def frames(self, records: List[bytes], msg_type: MessageType,
+               per_frame: int = 64) -> Iterator[bytes]:
+        """Pack records into wire frames with sequenced FlowHeaders."""
+        for i in range(0, len(records), per_frame):
+            payload = pack_pb_records(records[i:i + per_frame])
+            self._seq += 1
+            yield encode_frame(
+                msg_type, payload,
+                FlowHeader(sequence=self._seq, vtap_id=self.vtap_id),
+            )

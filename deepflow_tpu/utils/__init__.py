@@ -1,0 +1,8 @@
+from deepflow_tpu.utils.u32 import (
+    as_u32,
+    fold_columns,
+    mix32,
+    splitmix32_seeds,
+)
+
+__all__ = ["as_u32", "fold_columns", "mix32", "splitmix32_seeds"]

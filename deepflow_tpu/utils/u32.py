@@ -1,0 +1,75 @@
+"""32-bit lane arithmetic helpers.
+
+TPUs have no 64-bit multiply-high, so every hash in this framework is built
+from uint32 wrap-around arithmetic that XLA lowers to single VPU ops. This is
+the TPU-native answer to the reference's 64-bit FNV/xxhash-style hashing used
+to spread work across queues (e.g. hashing by vtap_id in
+server/libs/receiver/receiver.go and agent/crates/public queue fan-out).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_U32 = np.uint32
+
+
+def as_u32(x) -> jnp.ndarray:
+    """View/cast any integer array as uint32 (wrap-around semantics)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.uint32:
+        return x
+    if x.dtype in (jnp.int32,):
+        # bit-preserving view keeps entropy of negative ids (e.g. l3_epc_id)
+        return jnp.asarray(x).view(jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 fmix32 finalizer: a full-avalanche 32-bit mixer.
+
+    Five VPU ops per lane; every bit of the input affects every bit of the
+    output, which is what Count-Min row hashing needs for near-universal
+    behavior at 32-bit width.
+    """
+    x = as_u32(x)
+    x = x ^ (x >> 16)
+    x = x * _U32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * _U32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def fold_columns(cols) -> jnp.ndarray:
+    """Fold N uint32 feature columns into one well-mixed uint32 key.
+
+    hash_combine-style: h = mix32(h ^ (c + GOLDEN + h<<6 + h>>2)). Used to
+    build flow keys from the 5-tuple columns of l4_flow_log (reference schema:
+    server/ingester/flow_log/log_data/l4_flow_log.go:79-170).
+    """
+    cols = [as_u32(c) for c in cols]
+    h = jnp.full_like(cols[0], _U32(0x9E3779B9))
+    for c in cols:
+        h = mix32(h ^ (c + _U32(0x9E3779B9) + (h << 6) + (h >> 2)))
+    return h
+
+
+def splitmix32_seeds(n: int, seed: int = 0x5DEECE66) -> np.ndarray:
+    """Host-side deterministic seed schedule (splitmix32), for hash-row salts.
+
+    Returns odd constants so multiply-shift hashing stays 2-universal-ish.
+    """
+    out = np.empty(n, dtype=np.uint32)
+    x = np.uint32(seed)
+    with np.errstate(over="ignore"):
+        for i in range(n):
+            x = _U32(x + _U32(0x9E3779B9))
+            z = x
+            z = _U32((z ^ (z >> 16)) * _U32(0x21F0AAAD))
+            z = _U32((z ^ (z >> 15)) * _U32(0x735A2D97))
+            z = z ^ (z >> 15)
+            out[i] = z | _U32(1)  # force odd
+    return out

@@ -1,0 +1,18 @@
+from deepflow_tpu.wire.framing import (
+    BaseHeader,
+    FlowHeader,
+    MessageType,
+    FrameReader,
+    encode_frame,
+)
+from deepflow_tpu.wire.codec import iter_pb_records, pack_pb_records
+
+__all__ = [
+    "BaseHeader",
+    "FlowHeader",
+    "MessageType",
+    "FrameReader",
+    "encode_frame",
+    "iter_pb_records",
+    "pack_pb_records",
+]

@@ -1,0 +1,104 @@
+"""Minimum end-to-end slice (SURVEY.md §7): wire bytes -> top-K report.
+
+Replays a synthetic agent firehose through the full stack — framing decode,
+columnar decode, static-shape batching, sharded sketch updates — and prints
+the window's top-K heavy hitters scored against an exact numpy GROUP BY.
+
+Run:  python examples/e2e_l4_topk.py [--records N] [--devices N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.batch import Batcher, L4_SCHEMA
+from deepflow_tpu.decode import decode_l4_records
+from deepflow_tpu.models import FlowSuiteConfig, flow_suite
+from deepflow_tpu.parallel import ShardedFlowSuite, make_mesh
+from deepflow_tpu.replay import SyntheticAgent
+from deepflow_tpu.wire import FrameReader, MessageType, iter_pb_records
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=50_000)
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--top-k", type=int, default=20)
+    args = ap.parse_args()
+
+    print(f"jax devices: {jax.devices()}")
+    mesh = make_mesh(args.devices)
+    n_dev = mesh.shape["data"]
+    cfg = FlowSuiteConfig(top_k=args.top_k)
+    suite = ShardedFlowSuite(cfg, mesh)
+    state = suite.init()
+
+    # --- synthetic agent side: encode a wire-exact byte stream ------------
+    agent = SyntheticAgent()
+    cols_true = agent.l4_columns_pooled(args.records)
+    records = [agent.l4_record(cols_true, i) for i in range(args.records)]
+    wire_stream = b"".join(agent.frames(records, MessageType.TAGGEDFLOW))
+    print(f"encoded {args.records} TaggedFlow records -> "
+          f"{len(wire_stream)/1e6:.1f} MB wire stream")
+
+    # --- ingester side: frames -> records -> columns -> batches ----------
+    t0 = time.perf_counter()
+    reader = FrameReader()
+    batcher = Batcher(L4_SCHEMA, capacity=args.batch)
+    n_batches = 0
+    feature_names = ("ip_src", "ip_dst", "port_src", "port_dst", "proto",
+                     "packet_tx", "packet_rx")
+
+    def run_batch(tb, state):
+        cols = {k: jnp.asarray(tb.columns[k]) for k in feature_names}
+        mask = jnp.asarray(tb.mask())
+        cd, md = suite.put_batch(cols, mask)
+        return suite.update(state, cd, md)
+
+    for frame in reader.feed(wire_stream):
+        assert frame.msg_type == MessageType.TAGGEDFLOW
+        cols = decode_l4_records(iter_pb_records(frame.payload))
+        for tb in batcher.put(cols):
+            state = run_batch(tb, state)
+            n_batches += 1
+    for tb in batcher.flush():
+        state = run_batch(tb, state)
+        n_batches += 1
+    state, out = suite.flush(state)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    # --- score against exact GROUP BY ------------------------------------
+    true_keys = np.asarray(flow_suite.flow_key(
+        {k: jnp.asarray(cols_true[k].astype(np.uint32)) for k in feature_names}))
+    uniq, counts = np.unique(true_keys, return_counts=True)
+    order = np.argsort(counts)[::-1]
+    exact_top = set(uniq[order[: args.top_k]].tolist())
+    got_keys = np.asarray(out.topk_keys)
+    got_counts = np.asarray(out.topk_counts)
+    recall = len(set(got_keys.tolist()) & exact_top) / args.top_k
+
+    print(f"pipeline: {n_batches} batches x {args.batch} on {n_dev} device(s) "
+          f"in {dt:.2f}s ({args.records/dt/1e3:.0f}k rec/s end-to-end)")
+    print(f"rows counted on device: {int(np.asarray(out.rows))}")
+    print(f"entropies (src_ip dst_ip src_port dst_port): "
+          f"{np.round(np.asarray(out.entropies), 3)}")
+    card = np.asarray(out.service_cardinality)
+    print(f"service cardinality: {card[card > 0].sum():.0f} total distinct "
+          f"client-ip observations across {int((card > 0).sum())} service groups")
+    print(f"\ntop-{args.top_k} heavy hitters (CMS estimate vs exact):")
+    truth = dict(zip(uniq.tolist(), counts.tolist()))
+    for kk, cc in list(zip(got_keys.tolist(), got_counts.tolist()))[:10]:
+        print(f"  key={kk:>10}  est={cc:>7}  exact={truth.get(kk, 0):>7}")
+    print(f"\nrecall vs exact GROUP BY: {recall:.3f}  "
+          f"(target: >= 0.99 per BASELINE.md)")
+
+
+if __name__ == "__main__":
+    main()

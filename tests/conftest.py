@@ -1,0 +1,26 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors SURVEY.md's test strategy: multi-chip sharding is validated on a
+virtual host-platform mesh (the driver separately dry-runs the real
+multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+# Force-override: the session environment pins JAX_PLATFORMS to the real TPU
+# tunnel; tests must run on the virtual CPU mesh (and would otherwise
+# serialize/deadlock on the single chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xDF170)

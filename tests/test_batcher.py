@@ -1,0 +1,44 @@
+import numpy as np
+
+from deepflow_tpu.batch import Batcher, L4_SCHEMA
+
+
+def _chunk(n, base=0):
+    cols = L4_SCHEMA.alloc(n)
+    cols["ip_src"][:] = np.arange(base, base + n, dtype=np.uint32)
+    cols["byte_tx"][:] = 1
+    return cols
+
+
+def test_exact_fill_emits_full_batches():
+    b = Batcher(L4_SCHEMA, capacity=64)
+    out = list(b.put(_chunk(128)))
+    assert len(out) == 2
+    assert all(t.valid == 64 for t in out)
+    assert np.array_equal(out[0].columns["ip_src"], np.arange(64))
+    assert np.array_equal(out[1].columns["ip_src"], np.arange(64, 128))
+
+
+def test_partial_then_flush_pads_and_masks():
+    b = Batcher(L4_SCHEMA, capacity=64)
+    assert list(b.put(_chunk(10, base=100))) == []
+    out = list(b.flush())
+    assert len(out) == 1
+    t = out[0]
+    assert t.valid == 10 and t.capacity == 64
+    assert t.mask().sum() == 10
+    assert np.all(t.columns["ip_src"][10:] == 0)      # padding zeroed
+    assert np.array_equal(t.columns["ip_src"][:10], np.arange(100, 110))
+    assert list(b.flush()) == []                       # idempotent
+
+
+def test_spanning_chunks_preserve_order():
+    b = Batcher(L4_SCHEMA, capacity=32)
+    got = []
+    for i in range(7):
+        got.extend(b.put(_chunk(13, base=13 * i)))
+    got.extend(b.flush())
+    all_ips = np.concatenate([t.columns["ip_src"][:t.valid] for t in got])
+    assert np.array_equal(all_ips, np.arange(7 * 13))
+    assert b.total_rows == 91
+    assert b.emitted_batches == len(got)

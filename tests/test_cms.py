@@ -1,0 +1,77 @@
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import cms
+
+
+def _zipf_keys(rng, n, universe=5000, a=1.3):
+    return rng.zipf(a, size=n).clip(max=universe).astype(np.uint32)
+
+
+def test_update_query_overestimates_and_is_tight(rng):
+    keys = _zipf_keys(rng, 50_000)
+    state = cms.init(depth=4, log2_width=14)
+    state = jax.jit(cms.update)(state, jnp.asarray(keys))
+    uniq, true = np.unique(keys, return_counts=True)
+    est = np.asarray(cms.query(state, jnp.asarray(uniq)))
+    assert np.all(est >= true)            # CMS never underestimates
+    # error bound: overestimate small relative to stream size
+    assert np.mean(est - true) < 50_000 * 2.0 / (1 << 14) * 4
+
+
+def test_conservative_update_tighter_than_plain(rng):
+    keys = _zipf_keys(rng, 50_000)
+    plain = cms.init(depth=4, log2_width=12)
+    cons = cms.init(depth=4, log2_width=12)
+    jkeys = jnp.asarray(keys)
+    plain = jax.jit(cms.update)(plain, jkeys)
+    cons = jax.jit(cms.update_conservative)(cons, jkeys)
+    uniq, true = np.unique(keys, return_counts=True)
+    e_plain = np.asarray(cms.query(plain, jnp.asarray(uniq)))
+    e_cons = np.asarray(cms.query(cons, jnp.asarray(uniq)))
+    assert np.all(e_cons >= true)
+    assert e_cons.sum() <= e_plain.sum()
+    assert (e_cons - true).mean() < (e_plain - true).mean()
+
+
+def test_weights_and_mask(rng):
+    keys = np.array([1, 2, 1, 3, 1], dtype=np.uint32)
+    w = np.array([10, 5, 10, 7, 100], dtype=np.int32)
+    m = np.array([1, 1, 1, 1, 0], dtype=bool)   # last lane is padding
+    state = cms.init(depth=3, log2_width=10)
+    state = cms.update(state, jnp.asarray(keys), jnp.asarray(w), jnp.asarray(m))
+    est = np.asarray(cms.query(state, jnp.asarray(np.array([1, 2, 3], np.uint32))))
+    assert est[0] >= 20 and est[1] >= 5 and est[2] >= 7
+    assert est[0] < 120   # masked 100 not counted
+
+
+def test_conservative_mask_and_duplicates():
+    keys = jnp.asarray(np.array([7, 7, 7, 9, 9], np.uint32))
+    w = jnp.asarray(np.array([1, 2, 3, 4, 5], np.int32))
+    m = jnp.asarray(np.array([1, 1, 0, 1, 1], bool))
+    state = cms.init(depth=2, log2_width=8)
+    state = jax.jit(cms.update_conservative)(state, keys, w, m)
+    est = np.asarray(cms.query(state, jnp.asarray(np.array([7, 9], np.uint32))))
+    assert est[0] >= 3 and est[1] >= 9
+
+
+def test_merge_equals_single_stream(rng):
+    keys = _zipf_keys(rng, 20_000)
+    a = cms.init(depth=4, log2_width=12)
+    b = cms.init(depth=4, log2_width=12)
+    whole = cms.init(depth=4, log2_width=12)
+    a = cms.update(a, jnp.asarray(keys[:10_000]))
+    b = cms.update(b, jnp.asarray(keys[10_000:]))
+    whole = cms.update(whole, jnp.asarray(keys))
+    merged = cms.merge(a, b)
+    assert np.array_equal(np.asarray(merged.counts), np.asarray(whole.counts))
+
+
+def test_reset_and_decay():
+    state = cms.init(depth=2, log2_width=8)
+    state = cms.update(state, jnp.asarray(np.array([5, 5, 5, 5], np.uint32)))
+    dec = cms.decay(state)
+    assert np.asarray(dec.counts).sum() * 2 == np.asarray(state.counts).sum()
+    assert np.asarray(cms.reset(state).counts).sum() == 0

@@ -1,0 +1,43 @@
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import entropy
+
+
+def test_uniform_vs_concentrated(rng):
+    n = 50_000
+    uniform = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    concentrated = np.full(n, 42, dtype=np.uint32)
+    cols = jnp.asarray(np.stack([uniform, concentrated]))
+    state = entropy.init(features=2, log2_buckets=12)
+    state = jax.jit(entropy.update)(state, cols)
+    ents = np.asarray(entropy.entropies(state))
+    assert ents[0] > 0.9          # many distinct values -> near max entropy
+    assert ents[1] < 0.01         # single value -> near zero
+
+
+def test_entropy_matches_exact_histogram(rng):
+    # Few distinct values, no hash collisions expected at 2^14 buckets.
+    n = 20_000
+    vals = rng.integers(0, 16, size=n, dtype=np.uint32)
+    state = entropy.init(features=1, log2_buckets=14)
+    state = entropy.update(state, jnp.asarray(vals[None, :]))
+    got = float(entropy.entropies(state)[0])
+    counts = np.bincount(vals)
+    p = counts[counts > 0] / n
+    want = -(p * np.log(p)).sum() / np.log(1 << 14)
+    assert abs(got - want) < 1e-3
+
+
+def test_weights_mask_merge_reset(rng):
+    vals = np.array([1, 1, 2, 3], dtype=np.uint32)
+    w = np.array([2, 2, 4, 100], dtype=np.int32)
+    m = np.array([1, 1, 1, 0], dtype=bool)
+    s = entropy.init(1, 10)
+    s = entropy.update(s, jnp.asarray(vals[None, :]), jnp.asarray(w), jnp.asarray(m))
+    assert int(np.asarray(s.hist).sum()) == 8
+    merged = entropy.merge(s, s)
+    assert int(np.asarray(merged.hist).sum()) == 16
+    assert int(np.asarray(entropy.reset(s).hist).sum()) == 0

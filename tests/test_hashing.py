@@ -1,0 +1,61 @@
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import hashing
+from deepflow_tpu.utils import fold_columns, mix32, splitmix32_seeds
+
+
+def test_mix32_bijective_sample(rng):
+    xs = rng.integers(0, 2**32, size=100_000, dtype=np.uint32)
+    ys = np.asarray(mix32(jnp.asarray(xs)))
+    assert len(np.unique(ys)) == len(np.unique(xs))
+
+
+def test_mix32_avalanche(rng):
+    """Flipping one input bit flips ~half the output bits on average."""
+    xs = rng.integers(0, 2**32, size=4096, dtype=np.uint32)
+    base = np.asarray(mix32(jnp.asarray(xs)))
+    for bit in (0, 7, 16, 31):
+        flipped = np.asarray(mix32(jnp.asarray(xs ^ np.uint32(1 << bit))))
+        hamming = np.unpackbits((base ^ flipped).view(np.uint8)).mean() * 32
+        assert 13.0 < hamming < 19.0, f"bit {bit}: {hamming}"
+
+
+def test_seeds_deterministic_and_odd():
+    a = splitmix32_seeds(64)
+    b = splitmix32_seeds(64)
+    assert np.array_equal(a, b)
+    assert np.all(a % 2 == 1)
+    assert len(np.unique(a)) == 64
+
+
+def test_bucket_uniformity(rng):
+    keys = jnp.asarray(rng.integers(0, 2**32, size=200_000, dtype=np.uint32))
+    seeds = hashing.make_seeds(4)
+    idx = np.asarray(hashing.multi_bucket(keys, seeds, 10))
+    assert idx.shape == (4, 200_000)
+    assert idx.min() >= 0 and idx.max() < 1024
+    for row in idx:
+        counts = np.bincount(row, minlength=1024)
+        # chi2 ~ buckets for uniform; allow generous slack
+        chi2 = ((counts - counts.mean()) ** 2 / counts.mean()).sum()
+        assert chi2 < 1400, chi2
+
+
+def test_rows_independent(rng):
+    keys = jnp.asarray(rng.integers(0, 2**32, size=50_000, dtype=np.uint32))
+    seeds = hashing.make_seeds(4)
+    idx = np.asarray(hashing.multi_bucket(keys, seeds, 12))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            match = (idx[i] == idx[j]).mean()
+            assert match < 0.01, (i, j, match)
+
+
+def test_fold_columns_sensitivity(rng):
+    a = rng.integers(0, 2**32, size=10_000, dtype=np.uint32)
+    b = rng.integers(0, 2**16, size=10_000, dtype=np.uint32)
+    k1 = np.asarray(fold_columns([jnp.asarray(a), jnp.asarray(b)]))
+    k2 = np.asarray(fold_columns([jnp.asarray(a), jnp.asarray(b ^ np.uint32(1))]))
+    assert (k1 != k2).mean() > 0.999

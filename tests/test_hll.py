@@ -1,0 +1,60 @@
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import hll
+
+
+def test_single_group_accuracy(rng):
+    for true_n in (100, 10_000, 200_000):
+        keys = rng.integers(0, 2**32, size=true_n, dtype=np.uint32)
+        keys = np.unique(keys)
+        state = hll.init(groups=1, precision=12)
+        gid = jnp.zeros((len(keys),), jnp.int32)
+        state = jax.jit(hll.update)(state, gid, jnp.asarray(keys))
+        est = float(hll.estimate(state)[0])
+        rel = abs(est - len(keys)) / len(keys)
+        assert rel < 0.05, (true_n, est, rel)
+
+
+def test_duplicates_dont_inflate(rng):
+    keys = rng.integers(0, 1000, size=100_000, dtype=np.uint32)
+    state = hll.init(groups=1, precision=12)
+    state = hll.update(state, jnp.zeros((len(keys),), jnp.int32), jnp.asarray(keys))
+    est = float(hll.estimate(state)[0])
+    true = len(np.unique(keys))
+    assert abs(est - true) / true < 0.05
+
+
+def test_grouped_updates_isolated(rng):
+    n = 30_000
+    keys = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    gids = rng.integers(0, 4, size=n, dtype=np.int32)
+    state = hll.init(groups=4, precision=11)
+    state = jax.jit(hll.update)(state, jnp.asarray(gids), jnp.asarray(keys))
+    est = np.asarray(hll.estimate(state))
+    for g in range(4):
+        true = len(np.unique(keys[gids == g]))
+        assert abs(est[g] - true) / true < 0.07, (g, est[g], true)
+
+
+def test_mask_skips_lanes():
+    keys = jnp.asarray(np.arange(1000, dtype=np.uint32))
+    gid = jnp.zeros((1000,), jnp.int32)
+    mask = jnp.asarray(np.arange(1000) < 500)
+    state = hll.update(hll.init(1, 12), gid, keys, mask)
+    est = float(hll.estimate(state)[0])
+    assert abs(est - 500) / 500 < 0.1
+
+
+def test_merge_is_union(rng):
+    a_keys = rng.integers(0, 2**32, size=5000, dtype=np.uint32)
+    b_keys = rng.integers(0, 2**32, size=5000, dtype=np.uint32)
+    z = jnp.zeros((5000,), jnp.int32)
+    a = hll.update(hll.init(1, 12), z, jnp.asarray(a_keys))
+    b = hll.update(hll.init(1, 12), z, jnp.asarray(b_keys))
+    m = hll.merge(a, b)
+    true = len(np.unique(np.concatenate([a_keys, b_keys])))
+    est = float(hll.estimate(m)[0])
+    assert abs(est - true) / true < 0.05

@@ -1,0 +1,69 @@
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import pca
+
+
+def _low_rank_batch(rng, n, f=16, rank=2, noise=0.05):
+    basis, _ = np.linalg.qr(rng.standard_normal((f, rank)))
+    z = rng.standard_normal((n, rank)) * np.array([5.0, 2.0])[:rank]
+    return (z @ basis.T + noise * rng.standard_normal((n, f))).astype(np.float32), basis
+
+
+def test_oja_converges_to_principal_subspace(rng):
+    x, basis = _low_rank_batch(rng, 50_000, f=16, rank=2)
+    state = pca.init(features=16, k=2)
+    step = jax.jit(pca.update)
+    for i in range(0, 50_000, 1000):
+        state = step(state, jnp.asarray(x[i:i + 1000]))
+    w = np.asarray(state.w)
+    # principal angle between learned and true subspace ~ 0
+    overlap = np.linalg.svd(basis.T @ w, compute_uv=False)
+    assert overlap.min() > 0.95, overlap
+
+
+def test_w_stays_orthonormal(rng):
+    x, _ = _low_rank_batch(rng, 5000, f=8, rank=2)
+    state = pca.init(features=8, k=3)
+    for i in range(0, 5000, 500):
+        state = pca.update(state, jnp.asarray(x[i:i + 500]))
+    wtw = np.asarray(state.w).T @ np.asarray(state.w)
+    assert np.allclose(wtw, np.eye(3), atol=1e-4)
+
+
+def test_anomaly_scores_separate_outliers(rng):
+    x, basis = _low_rank_batch(rng, 20_000, f=16, rank=2)
+    state = pca.init(features=16, k=2)
+    for i in range(0, 20_000, 1000):
+        state = pca.update(state, jnp.asarray(x[i:i + 1000]))
+    normal = x[:200]
+    outliers = rng.standard_normal((200, 16)).astype(np.float32) * 5.0
+    s_norm = np.asarray(pca.score(state, jnp.asarray(normal)))
+    s_out = np.asarray(pca.score(state, jnp.asarray(outliers)))
+    assert np.median(s_out) > 3 * np.median(s_norm)
+
+
+def test_grad_apply_matches_update(rng):
+    """Split-path (grad + apply_grad, the cross-chip psum path) must equal the
+    fused single-chip update."""
+    x, _ = _low_rank_batch(rng, 1024, f=8, rank=2)
+    xb = jnp.asarray(x)
+    s0 = pca.init(features=8, k=2)
+    fused = pca.update(s0, xb)
+    cnt, s1, s2, g = pca.grad(s0, xb)
+    split = pca.apply_grad(s0, cnt, s1, s2, g)
+    # same mean/var EMA; W may differ only in numerical noise
+    assert np.allclose(np.asarray(fused.mean), np.asarray(split.mean), atol=1e-4)
+    assert np.allclose(np.abs(np.asarray(fused.w).T @ np.asarray(split.w)),
+                       np.eye(2), atol=0.05)
+
+
+def test_mask_ignores_padding(rng):
+    x, _ = _low_rank_batch(rng, 1000, f=8, rank=2)
+    pad = np.concatenate([x, 1000 * np.ones((24, 8), np.float32)])
+    mask = jnp.asarray(np.arange(1024) < 1000)
+    s_clean = pca.update(pca.init(8, 2), jnp.asarray(x))
+    s_mask = pca.update(pca.init(8, 2), jnp.asarray(pad), mask=mask)
+    assert np.allclose(np.asarray(s_clean.mean), np.asarray(s_mask.mean), atol=1e-3)

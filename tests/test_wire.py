@@ -1,0 +1,110 @@
+import numpy as np
+
+from deepflow_tpu.decode import decode_l4_records, decode_metric_records
+from deepflow_tpu.replay import SyntheticAgent
+from deepflow_tpu.wire import (
+    BaseHeader,
+    FlowHeader,
+    FrameReader,
+    MessageType,
+    encode_frame,
+    iter_pb_records,
+    pack_pb_records,
+)
+
+
+def test_base_header_roundtrip():
+    h = BaseHeader(frame_size=12345, msg_type=MessageType.TAGGEDFLOW)
+    enc = h.encode()
+    assert len(enc) == 5
+    assert enc[:4] == (12345).to_bytes(4, "big")      # big-endian frame size
+    d = BaseHeader.decode(enc)
+    assert d.frame_size == 12345 and d.msg_type == MessageType.TAGGEDFLOW
+
+
+def test_flow_header_roundtrip():
+    h = FlowHeader(version=20220117, sequence=99, vtap_id=42)
+    enc = h.encode()
+    assert len(enc) == 14
+    assert enc[:4] == (20220117).to_bytes(4, "little")  # little-endian
+    d = FlowHeader.decode(enc)
+    assert (d.version, d.sequence, d.vtap_id) == (20220117, 99, 42)
+
+
+def test_pb_record_packing():
+    recs = [b"aaa", b"", b"0123456789"]
+    packed = pack_pb_records(recs)
+    assert list(iter_pb_records(packed)) == recs
+
+
+def test_frame_reader_handles_arbitrary_chunking():
+    agent = SyntheticAgent()
+    _, recs = agent.l4_batch(100)
+    frames = list(agent.frames(recs, MessageType.TAGGEDFLOW, per_frame=16))
+    stream = b"".join(frames)
+    reader = FrameReader()
+    got = []
+    for i in range(0, len(stream), 7):                 # pathological chunking
+        got.extend(reader.feed(stream[i:i + 7]))
+    assert len(got) == len(frames)
+    out = []
+    for fr in got:
+        assert fr.msg_type == MessageType.TAGGEDFLOW
+        assert fr.flow_header.vtap_id == agent.vtap_id
+        out.extend(iter_pb_records(fr.payload))
+    assert len(out) == 100
+    seqs = [fr.flow_header.sequence for fr in got]
+    assert seqs == sorted(seqs)
+
+
+def test_l4_decode_matches_ground_truth():
+    agent = SyntheticAgent()
+    cols, recs = agent.l4_batch(500)
+    got = decode_l4_records(recs)
+    assert np.array_equal(got["ip_src"], cols["ip_src"])
+    assert np.array_equal(got["ip_dst"], cols["ip_dst"])
+    assert np.array_equal(got["port_dst"], cols["port_dst"])
+    assert np.array_equal(got["proto"], cols["proto"])
+    assert np.array_equal(got["byte_tx"], cols["byte_tx"].astype(np.uint32))
+    assert np.array_equal(got["rtt"], cols["rtt"])
+    assert np.array_equal(got["retrans"], cols["retrans"])
+    assert np.array_equal(got["l3_epc_id"], cols["l3_epc_id"])
+    assert np.array_equal(
+        got["timestamp"], (cols["start_time"] // 10**9).astype(np.uint32))
+
+
+def test_metric_decode_roundtrip():
+    agent = SyntheticAgent()
+    recs = [
+        agent.metric_record(1700000000 + i, svc=i % 4,
+                            traffic=dict(packet_tx=10 * i, byte_rx=100 * i,
+                                         new_flow=i))
+        for i in range(20)
+    ]
+    cols = decode_metric_records(recs)
+    assert cols["timestamp"][5] == 1700000005
+    assert cols["packet_tx"][3] == 30
+    assert cols["byte_rx"][7] == 700
+    assert cols["new_flow"][19] == 19
+    assert cols["server_port"][0] == agent.server_ports[0]
+
+
+def test_oversize_frame_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        encode_frame(MessageType.TAGGEDFLOW, b"x" * 600_000)
+
+
+def test_malformed_headers_rejected_not_looped():
+    """Corrupt frame sizes must raise, not spin or desync (DoS guard)."""
+    import pytest
+    r = FrameReader()
+    with pytest.raises(ValueError):                 # frame_size == 0
+        list(r.feed((0).to_bytes(4, "big") + bytes([1]) + b"xxxx"))
+    r = FrameReader()
+    with pytest.raises(ValueError):                 # below flow-header min
+        list(r.feed((10).to_bytes(4, "big")
+                    + bytes([int(MessageType.TAGGEDFLOW)]) + b"x" * 10))
+    r = FrameReader()
+    with pytest.raises(ValueError):                 # unknown message type
+        list(r.feed((20).to_bytes(4, "big") + bytes([99]) + b"x" * 15))
