@@ -1,0 +1,26 @@
+"""Ingester runtime: the host-side plumbing around the TPU compute path.
+
+Re-designs the reference server's runtime layer (SURVEY.md §2.2) for a
+Python/JAX process: fixed-size overwrite queues with drop accounting
+(reference: server/libs/queue), a TCP/UDP firehose receiver with per-vtap
+sequence tracking (server/libs/receiver), a reservoir-sampling throttler
+(server/ingester/flow_log/throttler), the exporter plugin surface
+(server/ingester/flow_log/exporters), and a Countable self-telemetry
+registry (server/libs/stats).
+"""
+
+from deepflow_tpu.runtime.queues import OverwriteQueue, MultiQueue
+from deepflow_tpu.runtime.stats import Countable, StatsRegistry, default_registry
+from deepflow_tpu.runtime.throttler import ThrottlingQueue
+from deepflow_tpu.runtime.exporters import Exporter, Exporters
+
+__all__ = [
+    "OverwriteQueue",
+    "MultiQueue",
+    "Countable",
+    "StatsRegistry",
+    "default_registry",
+    "ThrottlingQueue",
+    "Exporter",
+    "Exporters",
+]

@@ -1,0 +1,142 @@
+"""Exporter plugin surface: where analytics backends plug into the pipeline.
+
+Re-designs the reference's exporter registry (server/ingester/flow_log/
+exporters/exporters.go: `Exporter` interface {Start/Close/Put/IsExportData},
+`NewExporters` registry, per-decoder put caches) with the widening SURVEY.md
+§7 Phase 3 calls for: `Put` takes (stream, decoder_index, records) so L4, L7
+and metric streams all export — the reference's interface was typed to
+*L7FlowLog only (exporters.go:46), which its own L4 path couldn't use.
+
+Exporters receive *decoded columnar chunks* (schema column dicts), not row
+structs: by the time data leaves the decode stage it is already
+structure-of-arrays, the form both the TPU path and any file/OTLP-style
+writer want.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Protocol, Sequence
+
+from deepflow_tpu.runtime.queues import OverwriteQueue
+from deepflow_tpu.runtime.stats import StatsRegistry
+
+
+class Exporter(Protocol):
+    """The plugin contract (reference: exporters.go:35-48)."""
+
+    def start(self) -> None: ...
+
+    def close(self) -> None: ...
+
+    def is_export_data(self, stream: str, cols: Dict[str, Any]) -> bool:
+        """Cheap filter before enqueue (reference: IsExportData signal-source
+        bit filter, otlp_exporter/exporter.go:120)."""
+        ...
+
+    def put(self, stream: str, decoder_index: int,
+            cols: Dict[str, Any]) -> None:
+        """Hand one decoded columnar chunk to the exporter. Must not block."""
+        ...
+
+
+class Exporters:
+    """Registry + fan-out. One instance sits after the decode stage."""
+
+    def __init__(self, stats: Optional[StatsRegistry] = None) -> None:
+        self._exporters: List[Exporter] = []
+        self._started = False
+        self.put_count = 0
+        self.filtered_count = 0
+        if stats is not None:
+            stats.register("exporters", self.counters)
+
+    def register(self, exporter: Exporter) -> None:
+        if self._started:
+            raise RuntimeError("register before start()")
+        self._exporters.append(exporter)
+
+    def start(self) -> None:
+        self._started = True
+        for e in self._exporters:
+            e.start()
+
+    def close(self) -> None:
+        for e in self._exporters:
+            e.close()
+        self._started = False
+
+    def put(self, stream: str, decoder_index: int,
+            cols: Dict[str, Any]) -> None:
+        for e in self._exporters:
+            if e.is_export_data(stream, cols):
+                e.put(stream, decoder_index, cols)
+                self.put_count += 1
+            else:
+                self.filtered_count += 1
+
+    def counters(self) -> dict:
+        return {"put": self.put_count, "filtered": self.filtered_count,
+                "n_exporters": len(self._exporters)}
+
+
+class QueueWorkerExporter:
+    """Base for exporters that buffer chunks and drain on worker threads.
+
+    The reference OTLP exporter's shape (otlp_exporter/exporter.go:86):
+    own OverwriteQueue (drop-oldest back-pressure, observable loss) + N
+    workers + Countable stats. Subclasses implement `process(chunks)`.
+    """
+
+    def __init__(self, name: str, streams: Sequence[str],
+                 queue_size: int = 1 << 16, n_workers: int = 1,
+                 batch: int = 64,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        self.name = name
+        self.streams = frozenset(streams)
+        self.queue = OverwriteQueue(f"exporter.{name}", queue_size)
+        self.n_workers = n_workers
+        self.batch = batch
+        self._threads: List[threading.Thread] = []
+        self.processed = 0
+        if stats is not None:
+            stats.register(f"exporter.{name}", self.counters)
+
+    # -- Exporter contract -------------------------------------------------
+    def start(self) -> None:
+        for i in range(self.n_workers):
+            t = threading.Thread(target=self._run, name=f"{self.name}-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def is_export_data(self, stream: str, cols: Dict[str, Any]) -> bool:
+        return stream in self.streams
+
+    def put(self, stream: str, decoder_index: int,
+            cols: Dict[str, Any]) -> None:
+        self.queue.put((stream, decoder_index, cols))
+
+    # -- subclass surface --------------------------------------------------
+    def process(self, chunks: List[Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def _run(self) -> None:
+        while True:
+            chunks = self.queue.gets(self.batch, timeout=0.2)
+            if chunks:
+                self.process(chunks)
+                self.processed += len(chunks)
+            elif self.queue.closed:
+                return
+
+    def counters(self) -> dict:
+        c = self.queue.counters()
+        c["processed"] = self.processed
+        return c

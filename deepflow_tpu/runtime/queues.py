@@ -1,0 +1,137 @@
+"""Fixed-size overwrite queues with drop accounting.
+
+The reference moves every record between pipeline stages through bounded
+rings that overwrite the oldest entry instead of blocking the producer
+(server/libs/queue/queue.go OverwriteQueue; agent mirror:
+agent/crates/public/src/queue). Loss under overload is deliberate and
+*observable* — overwritten counts are exported as stats. This is the Python
+re-design: a lock + condvar ring (no lock-free tricks — the hot path here is
+batched, thousands of records per queue op, so lock cost amortizes away),
+with the same batch `gets` contract the reference decoders rely on
+(flow_log/decoder/decoder.go Gets(1024) loop).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class OverwriteQueue:
+    """Bounded ring; puts never block, overwriting oldest on overflow."""
+
+    def __init__(self, name: str, capacity: int) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.name = name
+        self.capacity = capacity
+        self._buf: List[Any] = [None] * capacity
+        self._head = 0          # next slot to read
+        self._size = 0
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self._closed = False
+        # Countable-style counters (scraped by runtime.stats)
+        self.in_count = 0
+        self.out_count = 0
+        self.overwritten = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._size
+
+    def put(self, item: Any) -> None:
+        self.puts((item,))
+
+    def puts(self, items: Sequence[Any]) -> None:
+        """Append a batch; overwrite the oldest entries if full."""
+        with self._ready:
+            if self._closed:
+                raise RuntimeError(f"queue {self.name} is closed")
+            for item in items:
+                tail = (self._head + self._size) % self.capacity
+                if self._size == self.capacity:
+                    # overwrite oldest: advance head, count the loss
+                    self._head = (self._head + 1) % self.capacity
+                    self.overwritten += 1
+                else:
+                    self._size += 1
+                self._buf[tail] = item
+            self.in_count += len(items)
+            self._ready.notify_all()
+
+    def gets(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
+        """Take up to max_items; block until >=1 available, timeout, or close.
+
+        Returns [] only on timeout or closed-and-drained.
+        """
+        with self._ready:
+            if self._size == 0 and not self._closed:
+                self._ready.wait(timeout)
+            n = min(self._size, max_items)
+            out = []
+            for _ in range(n):
+                out.append(self._buf[self._head])
+                self._buf[self._head] = None
+                self._head = (self._head + 1) % self.capacity
+            self._size -= n
+            self.out_count += n
+            return out
+
+    def close(self) -> None:
+        """Wake all readers; subsequent puts raise, gets drain then return []."""
+        with self._ready:
+            self._closed = True
+            self._ready.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "in": self.in_count,
+                "out": self.out_count,
+                "overwritten": self.overwritten,
+                "pending": self._size,
+            }
+
+
+class MultiQueue:
+    """N OverwriteQueues addressed by a hash key (reference: FixedMultiQueue).
+
+    The receiver hashes by vtap_id so one agent's stream stays ordered within
+    a single consumer (server/libs/receiver/receiver.go hash dispatch).
+    """
+
+    def __init__(self, name: str, n_queues: int, capacity: int,
+                 key_fn: Callable[[Any], int] = hash) -> None:
+        self.name = name
+        self.queues = [OverwriteQueue(f"{name}.{i}", capacity)
+                       for i in range(n_queues)]
+        self._key_fn = key_fn
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.queues)
+
+    def put(self, key: int, item: Any) -> None:
+        self.queues[key % len(self.queues)].put(item)
+
+    def puts(self, key: int, items: Sequence[Any]) -> None:
+        self.queues[key % len(self.queues)].puts(items)
+
+    def gets(self, queue_index: int, max_items: int,
+             timeout: Optional[float] = None) -> List[Any]:
+        return self.queues[queue_index].gets(max_items, timeout)
+
+    def close(self) -> None:
+        for q in self.queues:
+            q.close()
+
+    def counters(self) -> dict:
+        agg = {"in": 0, "out": 0, "overwritten": 0, "pending": 0}
+        for q in self.queues:
+            for k, v in q.counters().items():
+                agg[k] += v
+        return agg

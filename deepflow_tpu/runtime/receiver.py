@@ -1,0 +1,208 @@
+"""Firehose receiver: TCP/UDP listener -> per-type hashed queues.
+
+The framework's network front door, speaking the agent sender's exact wire
+format (reference: server/libs/receiver/receiver.go — one port, TCP framing
+by BaseHeader.FrameSize, UDP one-frame-per-datagram, demux of MESSAGE_TYPE_*
+to registered multi-queues hashed by vtap_id, per-vtap sequence/status
+tracking :215-296). Threaded rather than asyncio: the work unit is a whole
+frame (up to 512 kB), so per-connection reader threads feeding overwrite
+queues carry line rate without an event loop in the hot path.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from deepflow_tpu.runtime.queues import MultiQueue
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.wire.framing import (
+    MESSAGE_HEADER_LEN,
+    MESSAGE_FRAME_SIZE_MAX,
+    Frame,
+    FrameReader,
+    MessageType,
+)
+
+DEFAULT_PORT = 30033  # reference default ingester data port
+
+
+@dataclass
+class VtapStatus:
+    """Per-(vtap, message type) liveness + sequence-gap accounting
+    (reference: receiver.go:215-296)."""
+
+    vtap_id: int
+    msg_type: int
+    last_seq: int = 0
+    last_ts: float = 0.0
+    rx_frames: int = 0
+    rx_dropped: int = 0   # frames lost upstream, inferred from seq gaps
+    rx_invalid: int = 0
+
+    def observe(self, seq: int, now: float) -> None:
+        if self.rx_frames > 0 and seq > self.last_seq + 1:
+            self.rx_dropped += seq - self.last_seq - 1
+        # seq <= last_seq: agent restarted; reset without counting drops
+        self.last_seq = seq
+        self.last_ts = now
+        self.rx_frames += 1
+
+
+class Receiver:
+    """Listens on one port (TCP + UDP), demuxes frames to handler queues."""
+
+    def __init__(self, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
+                 stats: Optional[StatsRegistry] = None) -> None:
+        self.host = host
+        self.port = port
+        self._handlers: Dict[MessageType, MultiQueue] = {}
+        self._status: Dict[Tuple[int, int], VtapStatus] = {}
+        self._status_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._tcp_sock: Optional[socket.socket] = None
+        self._udp_sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.rx_frames = 0
+        self.rx_bytes = 0
+        self.rx_errors = 0
+        self.no_handler = 0
+        if stats is not None:
+            stats.register("receiver", self.counters)
+
+    def register_handler(self, msg_type: MessageType,
+                         queues: MultiQueue) -> None:
+        """Route frames of msg_type into `queues`, hashed by vtap_id
+        (reference: receiver.go RegistHandler)."""
+        self._handlers[msg_type] = queues
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._tcp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._tcp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._tcp_sock.bind((self.host, self.port))
+        self._tcp_sock.listen(64)
+        self._tcp_sock.settimeout(0.2)
+        # With port=0 the kernel picks the TCP port; UDP must follow it so
+        # both speak on the same number (the reference listens on one port).
+        actual_port = self._tcp_sock.getsockname()[1]
+
+        self._udp_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._udp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._udp_sock.bind((self.host, actual_port))
+        self._udp_sock.settimeout(0.2)
+        # UDP datagrams up to the max frame need a big kernel buffer
+        self._udp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                  8 * MESSAGE_FRAME_SIZE_MAX)
+
+        for target, name in ((self._accept_loop, "recv-tcp-accept"),
+                             (self._udp_loop, "recv-udp")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        for s in (self._tcp_sock, self._udp_sock):
+            if s is not None:
+                s.close()
+        self._threads.clear()
+
+    @property
+    def bound_port(self) -> int:
+        """Actual port (useful when constructed with port=0 in tests)."""
+        assert self._tcp_sock is not None
+        return self._tcp_sock.getsockname()[1]
+
+    # -- data path ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._tcp_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._tcp_conn_loop,
+                                 args=(conn, addr),
+                                 name=f"recv-tcp-{addr[0]}:{addr[1]}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _tcp_conn_loop(self, conn: socket.socket, addr) -> None:
+        reader = FrameReader()
+        conn.settimeout(0.2)
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                try:
+                    for frame in reader.feed(chunk):
+                        self._dispatch(frame, len(frame.payload))
+                except ValueError:
+                    self.rx_errors += 1
+                    return  # framing lost; drop the connection
+
+    def _udp_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                datagram, _ = self._udp_sock.recvfrom(MESSAGE_FRAME_SIZE_MAX)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            reader = FrameReader()  # one datagram = one frame
+            try:
+                for frame in reader.feed(datagram):
+                    self._dispatch(frame, len(frame.payload))
+            except ValueError:
+                self.rx_errors += 1
+
+    def _dispatch(self, frame: Frame, nbytes: int) -> None:
+        self.rx_frames += 1
+        self.rx_bytes += nbytes
+        vtap = 0
+        if frame.flow_header is not None:
+            vtap = frame.flow_header.vtap_id
+            self._track(frame, vtap)
+        handler = self._handlers.get(frame.msg_type)
+        if handler is None:
+            self.no_handler += 1
+            return
+        handler.put(vtap, frame)
+
+    def _track(self, frame: Frame, vtap: int) -> None:
+        key = (vtap, int(frame.msg_type))
+        with self._status_lock:
+            st = self._status.get(key)
+            if st is None:
+                st = self._status[key] = VtapStatus(vtap, int(frame.msg_type))
+            st.observe(frame.flow_header.sequence, time.time())
+
+    # -- introspection -----------------------------------------------------
+    def status(self) -> Dict[Tuple[int, int], VtapStatus]:
+        with self._status_lock:
+            return dict(self._status)
+
+    def counters(self) -> dict:
+        dropped = sum(s.rx_dropped for s in self._status.values())
+        return {
+            "rx_frames": self.rx_frames,
+            "rx_bytes": self.rx_bytes,
+            "rx_errors": self.rx_errors,
+            "no_handler": self.no_handler,
+            "seq_dropped": dropped,
+            "vtaps": len(self._status),
+        }

@@ -1,0 +1,118 @@
+"""Countable self-telemetry registry.
+
+Every pipeline stage registers a counter source; a collector thread scrapes
+them on a cadence and hands the samples to sinks (log line, in-memory series,
+or the DFSTATS wire message back into the firehose — the reference monitors
+itself with its own pipeline, server/libs/stats/stats.go:91-92, landing in
+the deepflow_system DB; agent mirror agent/src/utils/stats.rs).
+
+A "Countable" is any zero-arg callable returning {name: number}. Closed-over
+state (queue counters, decoder totals) keeps registration free of base
+classes — stages register `queue.counters` directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+Countable = Callable[[], Dict[str, float]]
+
+
+@dataclass
+class StatSample:
+    ts: float
+    module: str
+    tags: Dict[str, str]
+    values: Dict[str, float]
+
+
+@dataclass
+class _Source:
+    module: str
+    countable: Countable
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+class StatsRegistry:
+    """Register Countables; scrape on demand or on a background cadence."""
+
+    def __init__(self, history: int = 1024) -> None:
+        self._sources: List[_Source] = []
+        self._lock = threading.Lock()
+        self._history: List[StatSample] = []
+        self._history_cap = history
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._sinks: List[Callable[[StatSample], None]] = []
+
+    def register(self, module: str, countable: Countable,
+                 tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._sources.append(_Source(module, countable, dict(tags or {})))
+
+    def deregister(self, module: str) -> None:
+        with self._lock:
+            self._sources = [s for s in self._sources if s.module != module]
+
+    def add_sink(self, sink: Callable[[StatSample], None]) -> None:
+        self._sinks.append(sink)
+
+    def collect(self) -> List[StatSample]:
+        """Scrape every source once; append to history and fan to sinks."""
+        now = time.time()
+        with self._lock:
+            sources = list(self._sources)
+        samples = []
+        for s in sources:
+            try:
+                values = s.countable()
+            except Exception:  # a broken source must not kill the collector
+                continue
+            samples.append(StatSample(now, s.module, s.tags, dict(values)))
+        with self._lock:
+            self._history.extend(samples)
+            if len(self._history) > self._history_cap:
+                del self._history[:len(self._history) - self._history_cap]
+        for sample in samples:
+            for sink in self._sinks:
+                sink(sample)
+        return samples
+
+    def history(self, module: Optional[str] = None) -> List[StatSample]:
+        with self._lock:
+            return [s for s in self._history
+                    if module is None or s.module == module]
+
+    def start(self, interval_s: float = 10.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                self.collect()
+
+        self._thread = threading.Thread(target=loop, name="stats-collector",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_default: Optional[StatsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> StatsRegistry:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = StatsRegistry()
+        return _default

@@ -1,0 +1,77 @@
+"""Reservoir-sampling write throttler.
+
+Caps downstream record rate the way the reference caps ClickHouse writes
+(server/ingester/flow_log/throttler/throttling_queue.go SendWithThrottling:
+a throttle*bucket-second reservoir; records past the cap replace a random
+reservoir slot, so the surviving sample is uniform over the bucket). Rate
+defaults mirror flow_log/config/config.go:33-34 (50 000/s, 8 s buckets).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, List, Optional
+
+
+class ThrottlingQueue:
+    """Uniform reservoir over fixed time buckets; flushes on bucket roll."""
+
+    def __init__(self, emit: Callable[[List[Any]], None],
+                 throttle_per_s: int = 50_000, bucket_s: int = 8,
+                 seed: Optional[int] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        if throttle_per_s <= 0 or bucket_s <= 0:
+            raise ValueError("throttle and bucket must be positive")
+        self._emit = emit
+        self.capacity = throttle_per_s * bucket_s
+        self.bucket_s = bucket_s
+        self._clock = clock
+        self._rng = random.Random(seed)
+        self._reservoir: List[Any] = []
+        self._seen = 0           # records offered this bucket
+        self._bucket = self._bucket_of(clock())
+        # Countable counters
+        self.in_count = 0
+        self.sampled_out = 0     # records dropped by sampling
+        self.emitted = 0
+
+    def _bucket_of(self, ts: float) -> int:
+        return int(ts) // self.bucket_s
+
+    def send(self, item: Any) -> bool:
+        """Offer one record. Returns False iff it was sampled away."""
+        now = self._clock()
+        if self._bucket_of(now) != self._bucket:
+            self.flush()
+            self._bucket = self._bucket_of(now)
+        self.in_count += 1
+        self._seen += 1
+        if len(self._reservoir) < self.capacity:
+            self._reservoir.append(item)
+            return True
+        # classic Algorithm R: keep with prob capacity/seen
+        j = self._rng.randrange(self._seen)
+        if j < self.capacity:
+            self._reservoir[j] = item
+            self.sampled_out += 1   # displaced one previously-kept record
+            return True
+        self.sampled_out += 1
+        return False
+
+    def flush(self) -> None:
+        """Emit the current bucket's survivors downstream."""
+        if self._reservoir:
+            batch = self._reservoir
+            self._reservoir = []
+            self.emitted += len(batch)
+            self._emit(batch)
+        self._seen = 0
+
+    def counters(self) -> dict:
+        return {
+            "in": self.in_count,
+            "sampled_out": self.sampled_out,
+            "emitted": self.emitted,
+            "pending": len(self._reservoir),
+        }

@@ -3,19 +3,24 @@
 Mirrors SURVEY.md's test strategy: multi-chip sharding is validated on a
 virtual host-platform mesh (the driver separately dry-runs the real
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+The session's sitecustomize hook (PYTHONPATH=/root/.axon_site) claims the
+TPU tunnel and overrides JAX_PLATFORMS at interpreter start; setting
+PALLAS_AXON_POOL_IPS="" disables the hook (see .claude/skills/verify).
+In-process we additionally force the platform through jax.config before
+first backend use, which wins regardless of the hook.
 """
 
 import os
 
-# Force-override: the session environment pins JAX_PLATFORMS to the real TPU
-# tunnel; tests must run on the virtual CPU mesh (and would otherwise
-# serialize/deadlock on the single chip).
+# For any subprocess a test spawns: disable the TPU-claiming hook and pick cpu
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest
