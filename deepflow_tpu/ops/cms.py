@@ -21,7 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepflow_tpu.ops import hashing
+from deepflow_tpu.ops import hashing, mxu_hist
 
 
 class CMSState(NamedTuple):
@@ -41,22 +41,32 @@ def log2_width(state: CMSState) -> int:
 
 
 def update(state: CMSState, keys: jnp.ndarray, weights: jnp.ndarray | None = None,
-           mask: jnp.ndarray | None = None) -> CMSState:
-    """Scatter-add a batch of (key, weight) into all rows. O(d·n) lanes.
+           mask: jnp.ndarray | None = None, method: str = "auto",
+           weight_planes: int = 2) -> CMSState:
+    """Add a batch of (key, weight) into all rows. O(d·n) lanes.
 
     `mask` zeroes padded lanes so static-shape batches (pad+mask streaming)
-    never pollute counts.
+    never pollute counts. Large batches ride the MXU histogram path
+    (ops/mxu_hist.py — ~6x faster than XLA scatter on TPU); small ones use a
+    scatter-add. For unweighted/masked batches the two paths agree exactly;
+    with weights, the MXU path saturates per-lane weights at
+    256**weight_planes - 1 and rounds per-bucket per-batch sums above 2^24
+    (see mxu_hist.hist), where the scatter path is full-int32 exact.
     """
     d, w = state.counts.shape
     lw = int(np.log2(w))
     n = keys.shape[0]
+    use_mxu = method == "mxu" or (method == "auto" and n >= mxu_hist.MIN_LANES)
+    idx = hashing.multi_bucket(keys, state.seeds, lw)          # [d, n]
+    if use_mxu:
+        h = mxu_hist.hist_masked(idx, w, weights, mask, weight_planes)
+        return state._replace(counts=state.counts + h.astype(state.counts.dtype))
     if weights is None:
         weights = jnp.ones((n,), dtype=state.counts.dtype)
     else:
         weights = weights.astype(state.counts.dtype)
     if mask is not None:
         weights = weights * mask.astype(state.counts.dtype)
-    idx = hashing.multi_bucket(keys, state.seeds, lw)          # [d, n]
     flat = (idx + (jnp.arange(d, dtype=jnp.int32) * w)[:, None]).reshape(-1)
     vals = jnp.broadcast_to(weights[None, :], (d, n)).reshape(-1)
     counts = state.counts.reshape(-1).at[flat].add(vals, mode="drop").reshape(d, w)

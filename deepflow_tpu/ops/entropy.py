@@ -18,7 +18,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from deepflow_tpu.ops import hashing
+from deepflow_tpu.ops import hashing, mxu_hist
 
 
 class EntropyState(NamedTuple):
@@ -35,20 +35,29 @@ def init(features: int, log2_buckets: int = 12, seed: int = 0xE27B0) -> EntropyS
 
 def update(state: EntropyState, feature_cols: jnp.ndarray,
            weights: jnp.ndarray | None = None,
-           mask: jnp.ndarray | None = None) -> EntropyState:
-    """feature_cols: [features, n] uint32 columns (one row per feature)."""
+           mask: jnp.ndarray | None = None, method: str = "auto",
+           weight_planes: int = 2) -> EntropyState:
+    """feature_cols: [features, n] uint32 columns (one row per feature).
+
+    Large batches use the MXU histogram (ops/mxu_hist.py), which saturates
+    per-lane weights at 256**weight_planes - 1; small ones a full-exact
+    scatter-add.
+    """
     f, b = state.hist.shape
     lb = int(np.log2(b))
     n = feature_cols.shape[1]
+    mult = state.seeds[:, 0][:, None]
+    salt = state.seeds[:, 1][:, None]
+    idx = hashing.bucket(feature_cols, mult, salt, lb)           # [f, n]
+    if method == "mxu" or (method == "auto" and n >= mxu_hist.MIN_LANES):
+        h = mxu_hist.hist_masked(idx, b, weights, mask, weight_planes)
+        return state._replace(hist=state.hist + h.astype(state.hist.dtype))
     if weights is None:
         weights = jnp.ones((n,), dtype=state.hist.dtype)
     else:
         weights = weights.astype(state.hist.dtype)
     if mask is not None:
         weights = weights * mask.astype(state.hist.dtype)
-    mult = state.seeds[:, 0][:, None]
-    salt = state.seeds[:, 1][:, None]
-    idx = hashing.bucket(feature_cols, mult, salt, lb)           # [f, n]
     flat = (idx + (jnp.arange(f, dtype=jnp.int32) * b)[:, None]).reshape(-1)
     vals = jnp.broadcast_to(weights[None, :], (f, n)).reshape(-1)
     hist = state.hist.reshape(-1).at[flat].add(vals, mode="drop").reshape(f, b)

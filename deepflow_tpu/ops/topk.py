@@ -52,11 +52,28 @@ def _dedup_keep_max(keys: jnp.ndarray, counts: jnp.ndarray):
 
 
 def offer(state: TopKState, batch_keys: jnp.ndarray, sketch: cms.CMSState,
-          mask: jnp.ndarray | None = None) -> TopKState:
-    """Merge a batch of keys (scored via `sketch`) into the candidate ring."""
+          mask: jnp.ndarray | None = None, sample_log2: int = 0,
+          phase: jnp.ndarray | int = 0) -> TopKState:
+    """Merge a batch of keys (scored via `sketch`) into the candidate ring.
+
+    `sample_log2 > 0` admits only a 1/2^s stride-sample of lanes. Admission
+    is sampled; *scores* always come from the full Count-Min sketch, and
+    standing candidates are rescored every batch, so a hot key only has to be
+    sampled once per window to be ranked with its true (full-stream) estimate.
+    This cuts the per-batch gather + sort from O(n) to O(n/2^s), bounding
+    per-batch work the way the reference's throttler bounds per-second writes
+    (server/ingester/flow_log/throttler/throttling_queue.go:98).
+
+    `phase` rotates which residue class (mod 2^s) gets sampled — pass a
+    per-batch counter so lane positions correlated with the stride (e.g.
+    round-robin packers upstream) still get admitted over a window.
+    """
     bk = batch_keys.astype(jnp.uint32)
     if mask is not None:
         bk = jnp.where(mask, bk, SENTINEL)
+    if sample_log2 > 0:
+        bk = jnp.roll(bk, -(jnp.asarray(phase) % (1 << sample_log2)))
+        bk = bk[:: 1 << sample_log2]
     est = cms.query(sketch, bk).astype(jnp.int32)
     est = jnp.where(bk == SENTINEL, -1, est)
     # Standing candidates get re-scored too: their CMS estimates only grow.

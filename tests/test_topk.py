@@ -56,3 +56,29 @@ def test_mask_excludes_padding():
     keys, counts = topk.result(ring, 8)
     keys = np.asarray(keys)[np.asarray(counts) > 0]
     assert 999 not in keys.tolist()
+
+
+def test_sampled_admission_recall_production_path(rng):
+    """Recall harness for the production-style path: plain MXU-histogram CMS
+    + 1/16 stride-sampled, phase-rotated ring admission (the flow_suite
+    mechanism, at test-scale width). Admission is sampled but scores are
+    full-sketch and standing candidates are rescored each batch, so hot keys
+    rank correctly once admitted."""
+    n, k, batch = 400_000, 100, 40_000
+    keys = rng.zipf(1.2, size=n).clip(max=200_000).astype(np.uint32)
+    sketch = cms.init(depth=4, log2_width=16)
+    ring = topk.init(ring_size=1024)
+
+    step = jax.jit(lambda s, r, b, ph: (
+        lambda s2: (s2, topk.offer(r, b, s2, sample_log2=4, phase=ph))
+    )(cms.update(s, b)))
+    for j, i in enumerate(range(0, n, batch)):
+        sketch, ring = step(sketch, ring, jnp.asarray(keys[i:i + batch]),
+                            jnp.int32(j))
+
+    got_keys, _ = topk.result(ring, k)
+    got = set(np.asarray(got_keys).tolist())
+    uniq, counts = np.unique(keys, return_counts=True)
+    want = set(uniq[np.argsort(counts)[::-1][:k]].tolist())
+    recall = len(got & want) / k
+    assert recall >= 0.98, recall
