@@ -3,7 +3,7 @@
 One `update` consumes a static-shape L4 TensorBatch (as device arrays) and
 advances, in a single XLA program:
 
-- Count-Min (conservative) over the flow 5-tuple  -> heavy-hitter counts
+- Count-Min (MXU-histogram update) over the 5-tuple -> heavy-hitter counts
 - candidate ring                                  -> top-K flows
 - per-service HyperLogLog                         -> distinct client IPs
 - 4-feature entropy histograms                    -> DDoS signals

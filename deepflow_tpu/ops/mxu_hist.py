@@ -74,6 +74,7 @@ def hist(idx: jnp.ndarray, width: int, weights: jnp.ndarray | None = None,
         if weights is None:
             weights = jnp.concatenate(
                 [jnp.ones((n,), jnp.int32), jnp.zeros((pad,), jnp.int32)])
+            weight_planes = 1  # synthesized 0/1 weights fit one plane
         else:
             weights = jnp.pad(weights.astype(jnp.int32), (0, pad))
     n_pad = n + pad

@@ -1,0 +1,23 @@
+"""Columnar storage engine: the framework's ClickHouse-role subsystem.
+
+The reference writes telemetry to an external ClickHouse cluster through
+batched inserts (server/ingester/pkg/ckwriter/ckwriter.go) with
+schema-as-code DDL (server/libs/ckdb/ckdb.go), in-service schema upgrade
+(server/ingester/ckissu/ckissu.go), rollup materialized views
+(server/ingester/datasource/handle.go) and disk-watermark GC
+(server/ingester/ckmonitor/monitor.go). The TPU-native re-design keeps the
+same roles but stores time-partitioned columnar segments (one numpy array
+per column) directly — the layout a TPU feed wants — and runs rollup
+aggregation as JAX segment reductions instead of SQL materialized views.
+"""
+
+from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
+from deepflow_tpu.store.db import Store, Table
+from deepflow_tpu.store.writer import StoreWriter
+from deepflow_tpu.store.rollup import RollupManager
+from deepflow_tpu.store.monitor import DiskMonitor
+
+__all__ = [
+    "AggKind", "ColumnSpec", "TableSchema", "Store", "Table",
+    "StoreWriter", "RollupManager", "DiskMonitor",
+]

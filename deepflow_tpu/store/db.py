@@ -1,0 +1,263 @@
+"""Store root + Table: time-partitioned columnar segments on disk.
+
+Layout (one directory per partition, one .npz per flushed segment):
+
+    <root>/<db>/<table>/manifest.json
+    <root>/<db>/<table>/p<partition_start>/seg-<seq>.npz
+
+A segment is written once and never mutated (the ClickHouse part model,
+server/libs/ckdb; merges are unnecessary because readers concatenate).
+TTL expiry and watermark GC drop whole partition directories, exactly the
+granularity the reference uses (ckmonitor/monitor.go force-drops oldest
+partitions).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepflow_tpu.store.table import ColumnSpec, TableSchema
+
+MANIFEST = "manifest.json"
+
+
+def _partition_dir(start: int) -> str:
+    return f"p{start:012d}"
+
+
+def _partition_start_of(name: str) -> int:
+    return int(name[1:])
+
+
+class Table:
+    """One columnar table: append segments, scan partitions, expire TTL."""
+
+    def __init__(self, root: str, schema: TableSchema) -> None:
+        self.root = root
+        self.schema = schema
+        self._lock = threading.Lock()
+        self._seq = 0
+        os.makedirs(root, exist_ok=True)
+        self._save_manifest()
+        # resume segment sequence after restart; clear half-written tmp
+        # segments left by a crash mid-append
+        for p in self.partitions():
+            pdir = os.path.join(self.root, _partition_dir(p))
+            for f in os.listdir(pdir):
+                if f.endswith(".tmp"):
+                    os.unlink(os.path.join(pdir, f))
+                elif f.startswith("seg-") and f.endswith(".npz"):
+                    self._seq = max(self._seq, int(f[4:-4]) + 1)
+        self.rows_written = 0
+        self.segments_written = 0
+
+    # -- manifest ----------------------------------------------------------
+    def _save_manifest(self) -> None:
+        tmp = os.path.join(self.root, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(self.schema.to_json(), f, indent=1)
+        os.replace(tmp, os.path.join(self.root, MANIFEST))
+
+    # -- write path --------------------------------------------------------
+    def append(self, cols: Dict[str, np.ndarray]) -> int:
+        """Write one columnar chunk as >=1 segments, split by partition.
+        Returns rows written. Thread-safe."""
+        n = self.schema.validate_chunk(cols)
+        if n == 0:
+            return 0
+        ts = np.asarray(cols[self.schema.time_column], dtype=np.int64)
+        part = (ts // self.schema.partition_seconds) * self.schema.partition_seconds
+        with self._lock:
+            for p in np.unique(part):
+                sel = part == p
+                seg = {c.name: np.ascontiguousarray(
+                           np.asarray(cols[c.name])[sel].astype(c.dtype,
+                                                                copy=False))
+                       for c in self.schema.columns}
+                pdir = os.path.join(self.root, _partition_dir(int(p)))
+                os.makedirs(pdir, exist_ok=True)
+                path = os.path.join(pdir, f"seg-{self._seq:08d}.npz")
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.savez(f, **seg)
+                os.replace(tmp, path)
+                self._seq += 1
+                self.segments_written += 1
+            self.rows_written += n
+        return n
+
+    # -- read path ---------------------------------------------------------
+    def partitions(self) -> List[int]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(_partition_start_of(d) for d in os.listdir(self.root)
+                      if d.startswith("p") and d[1:].isdigit())
+
+    def _segment_files(self, partitions: Iterable[int]) -> List[str]:
+        files: List[str] = []
+        for p in partitions:
+            pdir = os.path.join(self.root, _partition_dir(p))
+            if os.path.isdir(pdir):
+                files.extend(os.path.join(pdir, f)
+                             for f in sorted(os.listdir(pdir))
+                             if f.startswith("seg-") and f.endswith(".npz"))
+        return files
+
+    def scan(self, columns: Optional[Sequence[str]] = None,
+             time_range: Optional[Tuple[int, int]] = None
+             ) -> Dict[str, np.ndarray]:
+        """Concatenate requested columns across partitions.
+
+        `time_range` is [lo, hi) on the time column; partition pruning first,
+        then row filtering — the two-level pruning ClickHouse does with
+        partition keys + primary index.
+        """
+        names = list(columns) if columns is not None else \
+            list(self.schema.column_names)
+        for nm in names:
+            self.schema.spec(nm)  # raises on unknown column
+        parts = self.partitions()
+        if time_range is not None:
+            lo, hi = time_range
+            psec = self.schema.partition_seconds
+            parts = [p for p in parts if p + psec > lo and p < hi]
+        need_time = (time_range is not None and
+                     self.schema.time_column not in names)
+        load_names = names + [self.schema.time_column] if need_time else names
+        out: Dict[str, List[np.ndarray]] = {nm: [] for nm in names}
+        for path in self._segment_files(parts):
+            try:
+                z = np.load(path)
+            except (FileNotFoundError, OSError):
+                continue  # partition force-dropped by GC mid-scan
+            with z:
+                chunk = {}
+                for nm in load_names:
+                    stored = next((s for s in self.schema.stored_names(nm)
+                                   if s in z.files), None)
+                    if stored is not None:
+                        chunk[nm] = z[stored]
+                    else:
+                        # column added by migration after this segment: default
+                        spec = self.schema.spec(nm)
+                        length = z[z.files[0]].shape[0]
+                        chunk[nm] = np.full(length, spec.default,
+                                            dtype=spec.dtype)
+                if time_range is not None:
+                    t = chunk[self.schema.time_column].astype(np.int64)
+                    sel = (t >= time_range[0]) & (t < time_range[1])
+                    for nm in names:
+                        out[nm].append(chunk[nm][sel])
+                else:
+                    for nm in names:
+                        out[nm].append(chunk[nm])
+        return {nm: (np.concatenate(v) if v else
+                     np.empty(0, dtype=self.schema.spec(nm).dtype))
+                for nm, v in out.items()}
+
+    def row_count(self) -> int:
+        total = 0
+        for path in self._segment_files(self.partitions()):
+            try:
+                z = np.load(path)
+            except (FileNotFoundError, OSError):
+                continue
+            with z:
+                total += z[z.files[0]].shape[0]
+        return total
+
+    # -- retention ---------------------------------------------------------
+    def expire(self, now: Optional[float] = None) -> int:
+        """Drop partitions past TTL; returns partitions dropped."""
+        if self.schema.ttl_seconds is None:
+            return 0
+        now = time.time() if now is None else now
+        cutoff = now - self.schema.ttl_seconds
+        dropped = 0
+        for p in self.partitions():
+            if p + self.schema.partition_seconds <= cutoff:
+                self.drop_partition(p)
+                dropped += 1
+        return dropped
+
+    def drop_partition(self, start: int) -> None:
+        shutil.rmtree(os.path.join(self.root, _partition_dir(start)),
+                      ignore_errors=True)
+
+    def disk_bytes(self) -> int:
+        total = 0
+        for path in self._segment_files(self.partitions()):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                continue
+        return total
+
+    def partition_bytes(self, start: int) -> int:
+        total = 0
+        for path in self._segment_files([start]):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                continue
+        return total
+
+    def counters(self) -> dict:
+        return {"rows_written": self.rows_written,
+                "segments_written": self.segments_written,
+                "partitions": len(self.partitions())}
+
+
+class Store:
+    """Root handle: databases of tables under one directory tree."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._tables: Dict[Tuple[str, str], Table] = {}
+        self._lock = threading.Lock()
+        self._load_existing()
+
+    def _load_existing(self) -> None:
+        for db in sorted(os.listdir(self.root)):
+            dbdir = os.path.join(self.root, db)
+            if not os.path.isdir(dbdir):
+                continue
+            for tname in sorted(os.listdir(dbdir)):
+                man = os.path.join(dbdir, tname, MANIFEST)
+                if os.path.isfile(man):
+                    with open(man) as f:
+                        schema = TableSchema.from_json(json.load(f))
+                    self._tables[(db, tname)] = Table(
+                        os.path.join(dbdir, tname), schema)
+
+    def create_table(self, db: str, schema: TableSchema) -> Table:
+        with self._lock:
+            key = (db, schema.name)
+            if key in self._tables:
+                return self._tables[key]
+            t = Table(os.path.join(self.root, db, schema.name), schema)
+            self._tables[key] = t
+            return t
+
+    def table(self, db: str, name: str) -> Table:
+        return self._tables[(db, name)]
+
+    def has_table(self, db: str, name: str) -> bool:
+        return (db, name) in self._tables
+
+    def tables(self) -> List[Tuple[str, str]]:
+        return sorted(self._tables.keys())
+
+    def expire_all(self, now: Optional[float] = None) -> int:
+        return sum(t.expire(now) for t in self._tables.values())
+
+    def disk_bytes(self) -> int:
+        return sum(t.disk_bytes() for t in self._tables.values())

@@ -1,0 +1,77 @@
+"""Disk watermark GC (reference: server/ingester/ckmonitor/monitor.go).
+
+The reference watches system.disks and force-drops the oldest partitions
+when free space crosses a threshold. Here the store owns its directory, so
+the monitor bounds total store bytes: above the high watermark it drops the
+globally-oldest partitions (across every table) until under the low one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.runtime.stats import StatsRegistry
+
+
+class DiskMonitor:
+    def __init__(self, store: Store, max_bytes: int,
+                 low_fraction: float = 0.8, interval: float = 60.0,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        self.store = store
+        self.max_bytes = max_bytes
+        self.low_bytes = int(max_bytes * low_fraction)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.partitions_dropped = 0
+        self.ttl_dropped = 0
+        if stats is not None:
+            stats.register("ckmonitor", self.counters)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="ckmonitor",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def check_once(self, now: Optional[float] = None) -> int:
+        """TTL expiry + watermark GC; returns partitions dropped."""
+        now = time.time() if now is None else now
+        self.ttl_dropped += self.store.expire_all(now)
+        dropped = 0
+        used = self.store.disk_bytes()
+        if used <= self.max_bytes:
+            return dropped
+        # oldest partitions first, across all tables; decrement the running
+        # total per drop instead of re-walking every segment each iteration
+        candidates: List[Tuple[int, Tuple[str, str]]] = []
+        for db, tname in self.store.tables():
+            t = self.store.table(db, tname)
+            candidates.extend((p, (db, tname)) for p in t.partitions())
+        candidates.sort()
+        for part, (db, tname) in candidates:
+            if used <= self.low_bytes:
+                break
+            t = self.store.table(db, tname)
+            used -= t.partition_bytes(part)
+            t.drop_partition(part)
+            dropped += 1
+        self.partitions_dropped += dropped
+        return dropped
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check_once()
+
+    def counters(self) -> dict:
+        return {"partitions_dropped": self.partitions_dropped,
+                "ttl_dropped": self.ttl_dropped,
+                "disk_bytes": self.store.disk_bytes()}

@@ -1,0 +1,102 @@
+"""Batched async table writer (reference: ingester/pkg/ckwriter/ckwriter.go).
+
+The reference's CKWriter buffers rows per table and flushes 512k-row batches
+every 10s on dedicated goroutines. Here the unit of buffering is a columnar
+chunk (already structure-of-arrays when it leaves the decode stage), and a
+flush concatenates pending chunks into one segment append — so segment size
+tracks the configured batch, not the arrival pattern.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepflow_tpu.store.db import Table
+from deepflow_tpu.runtime.stats import StatsRegistry
+
+
+class StoreWriter:
+    """Buffers columnar chunks for one table; background flush thread."""
+
+    def __init__(self, table: Table, batch_rows: int = 512_000,
+                 flush_interval: float = 10.0,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        self.table = table
+        self.batch_rows = batch_rows
+        self.flush_interval = flush_interval
+        self._pending: List[Dict[str, np.ndarray]] = []
+        self._pending_rows = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._kick = threading.Event()  # threshold crossed: flush off-thread
+        self._thread: Optional[threading.Thread] = None
+        self.flushes = 0
+        if stats is not None:
+            stats.register(f"store.{table.schema.name}", self.counters)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"ckwriter-{self.table.schema.name}",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.flush()
+
+    def put(self, cols: Dict[str, np.ndarray]) -> None:
+        """Queue one columnar chunk; never blocks on IO. Crossing the batch
+        threshold wakes the flush thread instead of writing inline — if no
+        flush thread is running (start() not called), flushes inline."""
+        n = self.table.schema.validate_chunk(cols)
+        if n == 0:
+            return
+        with self._lock:
+            self._pending.append(cols)
+            self._pending_rows += n
+            do_flush = self._pending_rows >= self.batch_rows
+        if do_flush:
+            if self._thread is not None:
+                self._kick.set()
+            else:
+                self.flush()
+
+    def flush(self) -> int:
+        with self._lock:
+            chunks, self._pending = self._pending, []
+            self._pending_rows = 0
+        if not chunks:
+            return 0
+        merged = {
+            name: np.concatenate([np.asarray(c[name]) for c in chunks])
+            for name in self.table.schema.column_names
+        }
+        rows = self.table.append(merged)
+        self.flushes += 1
+        return rows
+
+    def _run(self) -> None:
+        deadline = time.monotonic() + self.flush_interval
+        while not self._stop.is_set():
+            timeout = max(0.0, deadline - time.monotonic())
+            kicked = self._kick.wait(min(timeout, 0.5))
+            if kicked:
+                self._kick.clear()
+                self.flush()
+            elif time.monotonic() >= deadline:
+                self.flush()
+                deadline = time.monotonic() + self.flush_interval
+
+    def counters(self) -> dict:
+        with self._lock:
+            pending = self._pending_rows
+        c = self.table.counters()
+        c.update({"flushes": self.flushes, "pending_rows": pending})
+        return c
