@@ -35,7 +35,10 @@ def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
     rows: List[tuple] = []
     for raw in records:
         m = flow_log_pb2.TaggedFlow()
-        m.ParseFromString(raw)
+        try:
+            m.ParseFromString(raw)
+        except Exception:
+            continue  # skip the one bad record, keep the batch
         f = m.flow
         k = f.flow_key
         tcp = f.perf_stats.tcp
@@ -72,7 +75,10 @@ def decode_l7_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
     rows: List[tuple] = []
     for raw in records:
         m = flow_log_pb2.AppProtoLogsData()
-        m.ParseFromString(raw)
+        try:
+            m.ParseFromString(raw)
+        except Exception:
+            continue
         b = m.base
         endpoint = (m.req.endpoint or m.req.resource or m.req.domain).encode()
         rows.append((
@@ -98,7 +104,10 @@ def decode_metric_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
     rows: List[tuple] = []
     for raw in records:
         d = metric_pb2.Document()
-        d.ParseFromString(raw)
+        try:
+            d.ParseFromString(raw)
+        except Exception:
+            continue
         fld = d.tag.field
         ip = int.from_bytes(fld.ip, "big") if fld.ip else 0
         t = d.meter.flow.traffic

@@ -1,0 +1,19 @@
+"""Metadata enrichment: stamping KnowledgeGraph tags onto decoded columns.
+
+Reference: server/libs/grpc/grpc_platformdata.go — the ingester-side cache
+of controller metadata (PlatformInfoTable, ServiceTable) that every decoded
+record is enriched with before storage. The TPU-native re-design replaces
+per-record hash-map hits with vectorized columnar lookups (sorted-key
+searchsorted joins over whole batches), the same batch-at-a-time discipline
+the device kernels run on.
+"""
+
+from deepflow_tpu.enrich.platform_data import (
+    CidrInfo, InterfaceInfo, PlatformDataManager, PlatformInfoTable,
+    ServiceEntry, ServiceTable,
+)
+
+__all__ = [
+    "CidrInfo", "InterfaceInfo", "PlatformDataManager", "PlatformInfoTable",
+    "ServiceEntry", "ServiceTable",
+]

@@ -1,0 +1,12 @@
+"""Ingester pipelines: receiver queues -> decode -> enrich -> store/export.
+
+Python mirrors of the reference's per-message-type ingester pipelines
+(server/ingester/{flow_log,flow_metrics,...}), re-shaped columnar: the unit
+of work everywhere is a structure-of-arrays chunk, so the decode stage's
+output feeds the store writer, the exporter fan-out, and the TPU sketch
+path without further transformation.
+"""
+
+from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+
+__all__ = ["Ingester", "IngesterConfig"]

@@ -1,0 +1,112 @@
+"""flow_metrics pipeline: METRICS Documents -> vtap_flow_port rows.
+
+Reference: server/ingester/flow_metrics/flow_metrics.go (N unmarshallers
+from MESSAGE_TYPE_METRICS) + unmarshaller/unmarshaller.go (DecodePB ->
+app.Document, KnowledgeGraph fill, dbwriter table-per-meter). Here one
+unmarshaller fleet decodes Documents columnar, and the RollupManager
+(store/rollup.py) stands in for the CH materialized-view 1m tables.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from deepflow_tpu.decode import columnar
+from deepflow_tpu.pipelines.schemas import METRICS_TABLE
+from deepflow_tpu.runtime.exporters import Exporters
+from deepflow_tpu.runtime.queues import MultiQueue
+from deepflow_tpu.runtime.receiver import Receiver
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.rollup import RollupManager
+from deepflow_tpu.store.writer import StoreWriter
+from deepflow_tpu.wire.codec import iter_pb_records
+from deepflow_tpu.wire.framing import MessageType
+
+FLOW_METRICS_DB = "flow_metrics"
+
+
+class FlowMetricsPipeline:
+    def __init__(self, receiver: Receiver, store: Optional[Store],
+                 exporters: Optional[Exporters] = None,
+                 n_unmarshallers: int = 2, queue_size: int = 16384,
+                 rollup_intervals=(60,), rollup_period: float = 10.0,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        self.queues = MultiQueue("ingest.flow_metrics", n_unmarshallers,
+                                 queue_size)
+        receiver.register_handler(MessageType.METRICS, self.queues)
+        self.exporters = exporters
+        self.writer = None
+        self.rollups: Optional[RollupManager] = None
+        self.rollup_period = rollup_period
+        if store is not None:
+            self.rollups = RollupManager(store, FLOW_METRICS_DB,
+                                         METRICS_TABLE,
+                                         intervals=rollup_intervals)
+            self.writer = StoreWriter(self.rollups.base, stats=stats)
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self.n = n_unmarshallers
+        self.records = 0
+        self.decode_errors = 0
+        if stats is not None:
+            stats.register("flow_metrics", self.counters)
+
+    def start(self) -> None:
+        if self.writer is not None:
+            self.writer.start()
+        for i in range(self.n):
+            t = threading.Thread(target=self._run, args=(i,),
+                                 name=f"unmarshall-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.rollups is not None:
+            t = threading.Thread(target=self._rollup_loop, name="rollup",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self.queues.close()
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        if self.writer is not None:
+            self.writer.close()  # flush pending rows first
+        if self.rollups is not None:
+            self.rollups.advance(time.time() + 120)  # final drain, no wait
+
+    def _run(self, index: int) -> None:
+        while not self._stop.is_set():
+            frames = self.queues.gets(index, 64, timeout=0.2)
+            if not frames:
+                if self.queues.queues[index].closed:
+                    return
+                continue
+            records: List[bytes] = []
+            for f in frames:
+                try:
+                    records.extend(iter_pb_records(f.payload))
+                except ValueError:
+                    self.decode_errors += 1
+            if not records:
+                continue
+            try:
+                cols = columnar.decode_metric_records(records)
+            except Exception:
+                self.decode_errors += 1
+                continue
+            self.records += len(records)
+            if self.exporters is not None:
+                self.exporters.put("flow_metrics", index, cols)
+            if self.writer is not None:
+                self.writer.put(cols)
+
+    def _rollup_loop(self) -> None:
+        while not self._stop.wait(self.rollup_period):
+            self.rollups.advance(time.time())
+
+    def counters(self) -> dict:
+        return {"records": self.records, "decode_errors": self.decode_errors}

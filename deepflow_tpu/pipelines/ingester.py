@@ -1,0 +1,87 @@
+"""Ingester assembly: build receiver + every pipeline from one config.
+
+Reference: server/ingester/ingester/ingester.go:67-224 — loads per-module
+configs, builds Receiver + PlatformDataManager, starts all pipelines,
+returns closers. Storage can be disabled (the reference's StorageDisabled
+mode, ingester.go:132) which leaves decode + export live — the mode the
+pure-TPU sketch deployment runs in.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines.flow_log import FlowLogPipeline
+from deepflow_tpu.pipelines.flow_metrics import FlowMetricsPipeline
+from deepflow_tpu.runtime.exporters import Exporters
+from deepflow_tpu.runtime.receiver import Receiver
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.monitor import DiskMonitor
+
+
+@dataclass
+class IngesterConfig:
+    """Mirrors the reference's per-module config blocks
+    (flow_log/config/config.go defaults)."""
+
+    listen_port: int = 30033
+    listen_host: str = "127.0.0.1"
+    store_path: Optional[str] = None     # None = StorageDisabled mode
+    n_decoders: int = 2
+    queue_size: int = 16384
+    throttle_per_s: int = 50_000
+    store_max_bytes: int = 100 << 30
+    rollup_intervals: tuple = (60,)
+
+
+class Ingester:
+    """One-call construction of the full receive->store data plane."""
+
+    def __init__(self, cfg: IngesterConfig,
+                 platform: Optional[PlatformDataManager] = None,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        self.cfg = cfg
+        self.stats = stats or StatsRegistry()
+        self.platform = platform or PlatformDataManager(stats=self.stats)
+        self.exporters = Exporters(stats=self.stats)
+        self.store: Optional[Store] = None
+        self.monitor: Optional[DiskMonitor] = None
+        if cfg.store_path is not None:
+            os.makedirs(cfg.store_path, exist_ok=True)
+            self.store = Store(cfg.store_path)
+            self.monitor = DiskMonitor(self.store, cfg.store_max_bytes,
+                                       stats=self.stats)
+        self.receiver = Receiver(port=cfg.listen_port, host=cfg.listen_host,
+                                 stats=self.stats)
+        self.flow_log = FlowLogPipeline(
+            self.receiver, self.store, self.platform, self.exporters,
+            n_decoders=cfg.n_decoders, queue_size=cfg.queue_size,
+            throttle_per_s=cfg.throttle_per_s, stats=self.stats)
+        self.flow_metrics = FlowMetricsPipeline(
+            self.receiver, self.store, self.exporters,
+            n_unmarshallers=cfg.n_decoders, queue_size=cfg.queue_size,
+            rollup_intervals=cfg.rollup_intervals, stats=self.stats)
+
+    def start(self) -> None:
+        self.exporters.start()
+        self.flow_log.start()
+        self.flow_metrics.start()
+        if self.monitor is not None:
+            self.monitor.start()
+        self.receiver.start()  # last, like the reference (ingester.go:220)
+
+    def close(self) -> None:
+        self.receiver.close()
+        self.flow_log.close()
+        self.flow_metrics.close()
+        if self.monitor is not None:
+            self.monitor.close()
+        self.exporters.close()
+
+    @property
+    def port(self) -> int:
+        return self.receiver.bound_port

@@ -1,0 +1,83 @@
+"""Store table schemas for every ingester pipeline.
+
+The enriched l4/l7 schemas are the decode schemas (batch/schema.py) plus
+the KnowledgeGraph tag columns stamped by enrich/platform_data.py —
+mirroring how the reference's row structs carry a KnowledgeGraph block
+(log_data/l4_flow_log.go:226-266). Agg kinds drive the rollup manager.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepflow_tpu.batch.schema import L4_SCHEMA, L7_SCHEMA, METRIC_SCHEMA
+from deepflow_tpu.enrich.platform_data import KG_FIELDS
+from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
+
+_U32 = np.dtype(np.uint32)
+
+# which decode columns form the rollup group-by identity
+_L4_KEYS = {"ip_src", "ip_dst", "port_dst", "proto", "vtap_id",
+            "l3_epc_id", "tap_side", "timestamp"}
+_L4_AGG = {"byte_tx": AggKind.SUM, "byte_rx": AggKind.SUM,
+           "packet_tx": AggKind.SUM, "packet_rx": AggKind.SUM,
+           "rtt": AggKind.MAX, "retrans": AggKind.SUM,
+           "duration_us": AggKind.MAX}
+
+
+def _lift(batch_schema, keys, aggs) -> tuple:
+    cols = []
+    for name, dt in batch_schema.columns:
+        if name in keys:
+            agg = AggKind.KEY
+        else:
+            agg = aggs.get(name, AggKind.LAST)
+        cols.append(ColumnSpec(name, np.dtype(dt), agg))
+    return tuple(cols)
+
+
+def _kg_columns() -> tuple:
+    cols = []
+    for side in ("0", "1"):
+        for f in KG_FIELDS:
+            cols.append(ColumnSpec(f"{f}_{side}", _U32, AggKind.KEY))
+    cols.append(ColumnSpec("service_id_1", _U32, AggKind.KEY))
+    return tuple(cols)
+
+
+L4_TABLE = TableSchema(
+    name="l4_flow_log",
+    columns=_lift(L4_SCHEMA, _L4_KEYS, _L4_AGG) + _kg_columns(),
+    time_column="timestamp",
+    ttl_seconds=3 * 24 * 3600,
+)
+
+_L7_KEYS = {"ip_src", "ip_dst", "port_dst", "protocol", "l7_protocol",
+            "msg_type", "vtap_id", "endpoint_hash", "timestamp"}
+_L7_AGG = {"rrt_us": AggKind.MAX, "req_len": AggKind.SUM,
+           "resp_len": AggKind.SUM, "status": AggKind.MAX}
+
+L7_TABLE = TableSchema(
+    name="l7_flow_log",
+    columns=_lift(L7_SCHEMA, _L7_KEYS, _L7_AGG),
+    time_column="timestamp",
+    ttl_seconds=3 * 24 * 3600,
+)
+
+_METRIC_KEYS = {"timestamp", "ip", "server_port", "vtap_id", "protocol"}
+_METRIC_AGG = {
+    "packet_tx": AggKind.SUM, "packet_rx": AggKind.SUM,
+    "byte_tx": AggKind.SUM, "byte_rx": AggKind.SUM,
+    "new_flow": AggKind.SUM, "closed_flow": AggKind.SUM,
+    "syn": AggKind.SUM, "synack": AggKind.SUM,
+    "retrans_tx": AggKind.SUM, "retrans_rx": AggKind.SUM,
+    "rtt_sum": AggKind.SUM, "rtt_count": AggKind.SUM,
+}
+
+# reference table name: flow_metrics."vtap_flow_port.1s"
+METRICS_TABLE = TableSchema(
+    name="vtap_flow_port",
+    columns=_lift(METRIC_SCHEMA, _METRIC_KEYS, _METRIC_AGG),
+    time_column="timestamp",
+    ttl_seconds=3 * 24 * 3600,
+)

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
 
 
 class ThrottlingQueue:
@@ -75,3 +77,86 @@ class ThrottlingQueue:
             "emitted": self.emitted,
             "pending": len(self._reservoir),
         }
+
+
+class ColumnarThrottler:
+    """Reservoir rate cap for structure-of-arrays pipelines.
+
+    The exact ThrottlingQueue contract — a uniform survivor sample per time
+    bucket, emitted downstream on bucket roll, observable drops — but run
+    vectorized: the reservoir is a set of preallocated column arrays, and
+    each chunk's rows are admitted with Algorithm R's keep probability
+    capacity/seen in one vectorized draw, displacing random slots.
+    """
+
+    def __init__(self, emit: Callable[[Dict[str, np.ndarray]], None],
+                 throttle_per_s: int = 50_000, bucket_s: int = 8,
+                 seed: Optional[int] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.capacity = throttle_per_s * bucket_s
+        self.bucket_s = bucket_s
+        self._emit = emit
+        self._clock = clock
+        self._rng = np.random.default_rng(seed)
+        self._bucket = int(clock()) // bucket_s
+        self._res: Optional[Dict[str, np.ndarray]] = None
+        self._fill = 0
+        self._seen = 0
+        self.in_count = 0
+        self.sampled_out = 0
+        self.emitted = 0
+
+    def offer(self, cols: Dict[str, np.ndarray]) -> None:
+        """Feed one chunk; survivors are emitted on the next bucket roll."""
+        n = len(next(iter(cols.values()))) if cols else 0
+        if n == 0:
+            return
+        now = self._clock()
+        bucket = int(now) // self.bucket_s
+        if bucket != self._bucket:
+            self.flush()
+            self._bucket = bucket
+        self.in_count += n
+        if self._res is None:
+            self._res = {k: np.empty((self.capacity,) + np.asarray(v).shape[1:],
+                                     dtype=np.asarray(v).dtype)
+                         for k, v in cols.items()}
+        take = min(n, self.capacity - self._fill)
+        if take:
+            for k, v in cols.items():
+                self._res[k][self._fill:self._fill + take] = \
+                    np.asarray(v)[:take]
+            self._fill += take
+            self._seen += take
+        if take == n:
+            return
+        # reservoir full: row at global index g survives w.p. capacity/(g+1)
+        rest = n - take
+        g = self._seen + np.arange(rest)
+        keep = self._rng.random(rest) < self.capacity / (g + 1)
+        self._seen += rest
+        kept = int(keep.sum())
+        self.sampled_out += rest - kept
+        if kept:
+            slots = self._rng.integers(0, self.capacity, size=kept)
+            for k, v in cols.items():
+                self._res[k][slots] = np.asarray(v)[take:][keep]
+            self.sampled_out += 0  # displaced rows counted at flush
+        return
+
+    def flush(self) -> None:
+        """Emit the current bucket's survivors downstream."""
+        if self._res is not None and self._fill:
+            out = {k: v[:self._fill].copy() for k, v in self._res.items()}
+            self.emitted += self._fill
+            # rows offered but not in the final reservoir were sampled away
+            self.sampled_out = self.in_count - self.emitted
+            self._fill = 0
+            self._seen = 0
+            self._emit(out)
+        else:
+            self._seen = 0
+
+    def counters(self) -> dict:
+        return {"in": self.in_count, "sampled_out": self.sampled_out,
+                "emitted": self.emitted}

@@ -1,0 +1,102 @@
+"""Platform-data enrichment: vectorized interface/CIDR/service lookups."""
+
+import numpy as np
+
+from deepflow_tpu.enrich import (CidrInfo, InterfaceInfo, PlatformDataManager,
+                                 PlatformInfoTable, ServiceEntry, ServiceTable)
+
+
+def _ip(a, b, c, d):
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def test_interface_exact_lookup():
+    table = PlatformInfoTable(
+        interfaces=[
+            InterfaceInfo(epc_id=1, ip=_ip(10, 0, 0, 5), region_id=7,
+                          az_id=3, pod_id=77, subnet_id=12),
+            InterfaceInfo(epc_id=1, ip=_ip(10, 0, 0, 6), region_id=7,
+                          az_id=4, pod_id=78, subnet_id=12),
+            InterfaceInfo(epc_id=2, ip=_ip(10, 0, 0, 5), region_id=9),
+        ],
+        version=1)
+    epc = np.array([1, 1, 2, 1], np.uint32)
+    ip = np.array([_ip(10, 0, 0, 5), _ip(10, 0, 0, 6),
+                   _ip(10, 0, 0, 5), _ip(1, 2, 3, 4)], np.uint32)
+    out = table.query(epc, ip)
+    assert out["pod_id"].tolist() == [77, 78, 0, 0]
+    assert out["region_id"].tolist() == [7, 7, 9, 0]
+    # same ip in another epc resolved independently
+    assert out["az_id"].tolist() == [3, 4, 0, 0]
+    assert table.misses == 1
+
+
+def test_cidr_lpm_fallback():
+    table = PlatformInfoTable(
+        interfaces=[InterfaceInfo(epc_id=1, ip=_ip(10, 1, 0, 9), pod_id=5,
+                                  region_id=1)],
+        cidrs=[
+            CidrInfo(epc_id=1, prefix=_ip(10, 0, 0, 0), mask_len=8,
+                     region_id=100, subnet_id=200),
+            CidrInfo(epc_id=1, prefix=_ip(10, 1, 0, 0), mask_len=16,
+                     region_id=101, subnet_id=201),
+        ],
+        version=1)
+    epc = np.array([1, 1, 1], np.uint32)
+    ip = np.array([_ip(10, 1, 0, 9),     # exact interface wins
+                   _ip(10, 1, 2, 3),     # /16 (longest prefix) wins
+                   _ip(10, 9, 9, 9)],    # /8 fallback
+                  np.uint32)
+    out = table.query(epc, ip)
+    assert out["pod_id"].tolist() == [5, 0, 0]
+    assert out["region_id"].tolist() == [1, 101, 100]
+    assert out["subnet_id"].tolist() == [12, 201, 200] or \
+        out["subnet_id"].tolist()[1:] == [201, 200]
+
+
+def test_reload_version_gate():
+    table = PlatformInfoTable(version=3)
+    assert not table.reload([], [], version=3)   # same version: no-op
+    assert table.reload([InterfaceInfo(1, 42, region_id=9)], [], version=4)
+    out = table.query(np.array([1], np.uint32), np.array([42], np.uint32))
+    assert out["region_id"].tolist() == [9]
+
+
+def test_service_table_wildcards():
+    t = ServiceTable([
+        ServiceEntry(epc_id=1, ip=_ip(10, 0, 0, 1), port=80, protocol=6,
+                     service_id=11),
+        ServiceEntry(epc_id=1, ip=_ip(10, 0, 0, 1), port=0, protocol=6,
+                     service_id=22),           # any-port
+        ServiceEntry(epc_id=1, ip=0, port=53, protocol=17, service_id=33),
+    ])
+    epc = np.array([1, 1, 1, 1], np.uint32)
+    ip = np.array([_ip(10, 0, 0, 1), _ip(10, 0, 0, 1),
+                   _ip(99, 9, 9, 9), _ip(99, 9, 9, 9)], np.uint32)
+    port = np.array([80, 443, 53, 53], np.uint32)
+    proto = np.array([6, 6, 17, 6], np.uint32)
+    got = t.query(epc, ip, port, proto).tolist()
+    # exact; any-port fallback; any-ip UDP hit; TCP:53 does NOT match UDP rule
+    assert got == [11, 22, 33, 0]
+
+
+def test_stamp_l4_both_sides():
+    mgr = PlatformDataManager()
+    mgr.update(
+        interfaces=[InterfaceInfo(epc_id=5, ip=100, pod_id=1, region_id=2),
+                    InterfaceInfo(epc_id=5, ip=200, pod_id=9, region_id=2)],
+        cidrs=[],
+        services=[ServiceEntry(epc_id=5, ip=200, port=8080, protocol=6,
+                               service_id=444)],
+        version=1)
+    cols = {
+        "l3_epc_id": np.array([5, 5], np.int32),
+        "ip_src": np.array([100, 100], np.uint32),
+        "ip_dst": np.array([200, 300], np.uint32),
+        "port_dst": np.array([8080, 8080], np.uint32),
+        "proto": np.array([6, 6], np.uint32),
+    }
+    out = mgr.stamp_l4(cols)
+    assert out["pod_id_0"].tolist() == [1, 1]
+    assert out["pod_id_1"].tolist() == [9, 0]
+    assert out["service_id_1"].tolist() == [444, 0]
