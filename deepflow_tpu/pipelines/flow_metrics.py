@@ -98,11 +98,19 @@ class FlowMetricsPipeline:
             except Exception:
                 self.decode_errors += 1
                 continue
-            self.records += len(records)
+            decoded = len(cols["timestamp"])
+            self.decode_errors += len(records) - decoded  # bad ones skipped
+            self.records += decoded
+            if decoded == 0:
+                continue
             if self.exporters is not None:
                 self.exporters.put("flow_metrics", index, cols)
             if self.writer is not None:
                 self.writer.put(cols)
+
+    def flush(self) -> None:
+        if self.writer is not None:
+            self.writer.flush()
 
     def _rollup_loop(self) -> None:
         while not self._stop.wait(self.rollup_period):

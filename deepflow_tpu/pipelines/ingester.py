@@ -14,12 +14,17 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines.droplet import DropletPipeline
+from deepflow_tpu.pipelines.event import EventPipeline
+from deepflow_tpu.pipelines.ext_metrics import ExtMetricsPipeline
 from deepflow_tpu.pipelines.flow_log import FlowLogPipeline
 from deepflow_tpu.pipelines.flow_metrics import FlowMetricsPipeline
+from deepflow_tpu.pipelines.profile import ProfilePipeline
 from deepflow_tpu.runtime.exporters import Exporters
 from deepflow_tpu.runtime.receiver import Receiver
 from deepflow_tpu.runtime.stats import StatsRegistry
 from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.dict_store import TagDictRegistry
 from deepflow_tpu.store.monitor import DiskMonitor
 
 
@@ -55,6 +60,7 @@ class Ingester:
             self.store = Store(cfg.store_path)
             self.monitor = DiskMonitor(self.store, cfg.store_max_bytes,
                                        stats=self.stats)
+        self.tag_dicts = TagDictRegistry(cfg.store_path)
         self.receiver = Receiver(port=cfg.listen_port, host=cfg.listen_host,
                                  stats=self.stats)
         self.flow_log = FlowLogPipeline(
@@ -65,22 +71,42 @@ class Ingester:
             self.receiver, self.store, self.exporters,
             n_unmarshallers=cfg.n_decoders, queue_size=cfg.queue_size,
             rollup_intervals=cfg.rollup_intervals, stats=self.stats)
+        self.ext_metrics = ExtMetricsPipeline(
+            self.receiver, self.store, self.tag_dicts, stats=self.stats)
+        self.event = EventPipeline(
+            self.receiver, self.store, self.tag_dicts, stats=self.stats)
+        self.profile = ProfilePipeline(
+            self.receiver, self.store, self.tag_dicts, stats=self.stats)
+        droplet_dir = None if cfg.store_path is None else \
+            os.path.join(cfg.store_path, "droplet")
+        self.droplet = DropletPipeline(
+            self.receiver, self.store, self.tag_dicts, droplet_dir,
+            stats=self.stats)
+        self._pipelines = (self.flow_log, self.flow_metrics, self.ext_metrics,
+                           self.event, self.profile, self.droplet)
 
     def start(self) -> None:
         self.exporters.start()
-        self.flow_log.start()
-        self.flow_metrics.start()
+        for p in self._pipelines:
+            p.start()
         if self.monitor is not None:
             self.monitor.start()
         self.receiver.start()  # last, like the reference (ingester.go:220)
 
+    def flush(self) -> None:
+        """Drain throttlers/writers to disk (tests and shutdown)."""
+        for p in self._pipelines:
+            p.flush()
+        self.tag_dicts.flush()
+
     def close(self) -> None:
         self.receiver.close()
-        self.flow_log.close()
-        self.flow_metrics.close()
+        for p in self._pipelines:
+            p.close()
         if self.monitor is not None:
             self.monitor.close()
         self.exporters.close()
+        self.tag_dicts.close()
 
     @property
     def port(self) -> int:

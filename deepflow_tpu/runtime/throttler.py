@@ -10,6 +10,7 @@ defaults mirror flow_log/config/config.go:33-34 (50 000/s, 8 s buckets).
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -102,19 +103,26 @@ class ColumnarThrottler:
         self._res: Optional[Dict[str, np.ndarray]] = None
         self._fill = 0
         self._seen = 0
+        # offer() runs on the decoder thread; flush() is also called from
+        # pipeline flush/stop on other threads — serialize reservoir state
+        self._lock = threading.Lock()
         self.in_count = 0
         self.sampled_out = 0
         self.emitted = 0
 
     def offer(self, cols: Dict[str, np.ndarray]) -> None:
         """Feed one chunk; survivors are emitted on the next bucket roll."""
+        with self._lock:
+            self._offer_locked(cols)
+
+    def _offer_locked(self, cols: Dict[str, np.ndarray]) -> None:
         n = len(next(iter(cols.values()))) if cols else 0
         if n == 0:
             return
         now = self._clock()
         bucket = int(now) // self.bucket_s
         if bucket != self._bucket:
-            self.flush()
+            self._flush_locked()
             self._bucket = bucket
         self.in_count += n
         if self._res is None:
@@ -146,6 +154,10 @@ class ColumnarThrottler:
 
     def flush(self) -> None:
         """Emit the current bucket's survivors downstream."""
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         if self._res is not None and self._fill:
             out = {k: v[:self._fill].copy() for k, v in self._res.items()}
             self.emitted += self._fill

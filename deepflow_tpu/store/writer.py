@@ -24,7 +24,8 @@ class StoreWriter:
 
     def __init__(self, table: Table, batch_rows: int = 512_000,
                  flush_interval: float = 10.0,
-                 stats: Optional[StatsRegistry] = None) -> None:
+                 stats: Optional[StatsRegistry] = None,
+                 stats_name: Optional[str] = None) -> None:
         self.table = table
         self.batch_rows = batch_rows
         self.flush_interval = flush_interval
@@ -36,7 +37,8 @@ class StoreWriter:
         self._thread: Optional[threading.Thread] = None
         self.flushes = 0
         if stats is not None:
-            stats.register(f"store.{table.schema.name}", self.counters)
+            stats.register(stats_name or f"store.{table.schema.name}",
+                           self.counters)
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -1,0 +1,178 @@
+"""Aux ingester pipelines: ext_metrics, events, profiles, droplet streams."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.pipelines.ext_metrics import parse_influx_line
+from deepflow_tpu.pipelines.droplet import parse_statsd_line
+from deepflow_tpu.wire.codec import pack_pb_records
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+from deepflow_tpu.wire.gen import stats_pb2, telemetry_pb2
+
+
+def _send(port, frames):
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        for fr in frames:
+            s.sendall(fr)
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def ing(tmp_path):
+    i = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path)))
+    i.start()
+    yield i
+    i.close()
+
+
+def test_influx_line_parser():
+    m, tags, fields, ts = parse_influx_line(
+        'cpu,host=web1,region=us usage_idle=90.5,count=3i 1700000000000000000')
+    assert m == "cpu" and tags == {"host": "web1", "region": "us"}
+    assert fields == {"usage_idle": 90.5, "count": 3.0}
+    assert ts == 1_700_000_000_000_000_000
+    assert parse_influx_line("# comment") is None
+    assert parse_influx_line("garbage") is None
+
+
+def test_statsd_line_parser():
+    assert parse_statsd_line("api.rps:42|c|#env:prod") == \
+        ("api.rps", 42.0, {"env": "prod"})
+    assert parse_statsd_line("bad line") is None
+
+
+def test_prometheus_remote_write(ing):
+    wr = telemetry_pb2.WriteRequest()
+    ts = wr.timeseries.add()
+    ts.labels.add(name="__name__", value="http_requests_total")
+    ts.labels.add(name="job", value="api")
+    ts.samples.add(value=5.0, timestamp=1_700_000_000_000)
+    ts.samples.add(value=7.0, timestamp=1_700_000_001_000)
+    pm = telemetry_pb2.PrometheusMetric(metrics=wr.SerializeToString())
+    frame = encode_frame(MessageType.PROMETHEUS, pm.SerializeToString(),
+                         FlowHeader(sequence=1, vtap_id=3))
+    _send(ing.port, [frame])
+    assert _wait(lambda: ing.ext_metrics.samples >= 2)
+    ing.flush()
+    t = ing.store.table("ext_metrics", "ext_samples")
+    out = t.scan()
+    assert sorted(out["value"].tolist()) == [5.0, 7.0]
+    name = ing.tag_dicts.get("metric_name").decode(out["metric"][0])
+    assert name == "http_requests_total"
+    labels = ing.tag_dicts.get("label_set").decode(out["labels"][0])
+    assert labels == "job=api"
+
+
+def test_prometheus_bare_write_request(ing):
+    wr = telemetry_pb2.WriteRequest()
+    ts = wr.timeseries.add()
+    ts.labels.add(name="__name__", value="up")
+    ts.samples.add(value=1.0, timestamp=1_700_000_000_000)
+    frame = encode_frame(MessageType.PROMETHEUS, wr.SerializeToString(),
+                         FlowHeader(sequence=1, vtap_id=3))
+    _send(ing.port, [frame])
+    assert _wait(lambda: ing.ext_metrics.samples >= 1)
+    ing.flush()
+    out = ing.store.table("ext_metrics", "ext_samples").scan()
+    assert out["value"].tolist() == [1.0]
+
+
+def test_telegraf_and_dfstats(ing):
+    tele = b"mem,host=db used_percent=31.5 1700000000000000000\n"
+    f1 = encode_frame(MessageType.TELEGRAF, tele,
+                      FlowHeader(sequence=1, vtap_id=3))
+    st = stats_pb2.Stats(timestamp=1_700_000_000, name="queue",
+                         tag_names=["module"], tag_values=["recv"],
+                         metrics_float_names=["pending"],
+                         metrics_float_values=[12.0])
+    f2 = encode_frame(MessageType.DFSTATS,
+                      pack_pb_records([st.SerializeToString()]))
+    _send(ing.port, [f1, f2])
+    assert _wait(lambda: ing.ext_metrics.samples >= 2)
+    ing.flush()
+    assert ing.store.table("ext_metrics", "ext_samples").row_count() == 1
+    sys_rows = ing.store.table("deepflow_system", "ext_samples").scan()
+    assert sys_rows["value"].tolist() == [12.0]
+
+
+def test_proc_and_alarm_events(ing):
+    ev = telemetry_pb2.ProcEvent(
+        pid=42, thread_id=43, pod_id=7,
+        start_time=1_700_000_000_000_000_000,
+        end_time=1_700_000_000_500_000_000,
+        event_type=telemetry_pb2.IoEvent)
+    ev.io_event_data.bytes_count = 4096
+    ev.io_event_data.operation = telemetry_pb2.Read
+    ev.io_event_data.filename = b"/var/log/app.log\x00"
+    f1 = encode_frame(MessageType.PROC_EVENT,
+                      pack_pb_records([ev.SerializeToString()]),
+                      FlowHeader(sequence=1, vtap_id=3))
+    al = telemetry_pb2.AlarmEvent(timestamp=1_700_000_000, policy_id=5,
+                                  policy_name="high-rtt", event_level=2,
+                                  alarm_target="svc-a", trigger_value=99.5)
+    f2 = encode_frame(MessageType.ALARM_EVENT,
+                      pack_pb_records([al.SerializeToString()]),
+                      FlowHeader(sequence=2, vtap_id=3))
+    _send(ing.port, [f1, f2])
+    assert _wait(lambda: ing.event.events >= 2)
+    ing.flush()
+    perf = ing.store.table("event", "perf_event").scan()
+    assert perf["bytes_count"].tolist() == [4096]
+    fname = ing.tag_dicts.get("event_strings").decode(perf["filename"][0])
+    assert fname == "/var/log/app.log"
+    alarm = ing.store.table("event", "alarm_event").scan()
+    assert alarm["policy_id"].tolist() == [5]
+    # resource events through the in-process API
+    ing.event.put_resource_event(3, 101, "create", "pod created", ts=1000)
+    ing.flush()
+    res = ing.store.table("event", "resource_event").scan()
+    assert res["resource_id"].tolist() == [101]
+
+
+def test_profiles_and_dict_persistence(ing, tmp_path):
+    p = telemetry_pb2.Profile(
+        timestamp=1_700_000_000_000_000_000, app_service="checkout",
+        pid=9, vtap_id=3, event_type="on-cpu",
+        stack="main;handler;db_query", value=17)
+    f = encode_frame(MessageType.PROFILE,
+                     pack_pb_records([p.SerializeToString()]),
+                     FlowHeader(sequence=1, vtap_id=3))
+    _send(ing.port, [f])
+    assert _wait(lambda: ing.profile.profiles >= 1)
+    ing.flush()
+    rows = ing.store.table("profile", "in_process_profile").scan()
+    assert rows["value"].tolist() == [17]
+    stack = ing.tag_dicts.get("profile_stack").decode(rows["stack"][0])
+    assert stack == "main;handler;db_query"
+    # dictionary survives reopen
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    reg = TagDictRegistry(str(tmp_path))
+    assert reg.get("profile_stack").decode(rows["stack"][0]) == \
+        "main;handler;db_query"
+
+
+def test_syslog_statsd_pcap(ing, tmp_path):
+    f1 = encode_frame(MessageType.SYSLOG, b"<14>Jul 29 host app: hello\n")
+    f2 = encode_frame(MessageType.STATSD, b"api.rps:42|c|#env:prod\n")
+    f3 = encode_frame(MessageType.RAW_PCAP, b"\xaa" * 128,
+                      FlowHeader(sequence=1, vtap_id=3))
+    _send(ing.port, [f1, f2, f3])
+    assert _wait(lambda: ing.droplet.syslog_lines >= 1
+                 and ing.droplet.statsd_samples >= 1
+                 and ing.droplet.pcap_bytes >= 128)
+    ing.flush()
+    logf = tmp_path / "droplet" / "syslog-vtap0.log"
+    assert logf.exists() and "hello" in logf.read_text()
+    assert (tmp_path / "droplet" / "pcap-vtap3.bin").stat().st_size == 128
