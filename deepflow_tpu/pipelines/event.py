@@ -154,8 +154,7 @@ class EventPipeline:
                     self.decode_errors += 1
 
     def _handle_proc(self, payload: bytes) -> None:
-        rows = {n: [] for n, _ in
-                ((c.name, c) for c in PERF_EVENT_TABLE.columns)}
+        rows = {c.name: [] for c in PERF_EVENT_TABLE.columns}
         for raw in iter_pb_records(payload):
             ev = telemetry_pb2.ProcEvent()
             try:

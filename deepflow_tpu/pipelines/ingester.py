@@ -35,6 +35,7 @@ class IngesterConfig:
 
     listen_port: int = 30033
     listen_host: str = "127.0.0.1"
+    debug_port: Optional[int] = None     # None disables the UDP debug server
     store_path: Optional[str] = None     # None = StorageDisabled mode
     n_decoders: int = 2
     queue_size: int = 16384
@@ -84,6 +85,14 @@ class Ingester:
             stats=self.stats)
         self._pipelines = (self.flow_log, self.flow_metrics, self.ext_metrics,
                            self.event, self.profile, self.droplet)
+        self.debug = None
+        if cfg.debug_port is not None:
+            from deepflow_tpu.runtime.debug import DebugServer
+            self.debug = DebugServer(self.stats, port=cfg.debug_port)
+            self.debug.register(
+                "vtap-status",
+                lambda req: {f"{v}:{t}": vars(st) for (v, t), st
+                             in self.receiver.status().items()})
 
     def start(self) -> None:
         self.exporters.start()
@@ -91,6 +100,8 @@ class Ingester:
             p.start()
         if self.monitor is not None:
             self.monitor.start()
+        if self.debug is not None:
+            self.debug.start()
         self.receiver.start()  # last, like the reference (ingester.go:220)
 
     def flush(self) -> None:
@@ -105,6 +116,8 @@ class Ingester:
             p.close()
         if self.monitor is not None:
             self.monitor.close()
+        if self.debug is not None:
+            self.debug.close()
         self.exporters.close()
         self.tag_dicts.close()
 

@@ -1,0 +1,15 @@
+"""Querier: SQL + PromQL query surface over the columnar store.
+
+Reference: server/querier/ — HTTP /v1/query executes DeepFlow-SQL
+(`show tags/metrics`, auto tag translation, derived metrics) by
+translating to ClickHouse SQL (engine/clickhouse/clickhouse.go). Here the
+translation target is the framework's own store: filters are vectorized
+numpy masks, GROUP BY aggregation runs as a device segment-reduction
+(store/rollup.group_reduce), and SmartEncoded hash columns translate back
+to strings through the TagDict registry at result time.
+"""
+
+from deepflow_tpu.querier.engine import QueryEngine, QueryResult
+from deepflow_tpu.querier.sql import parse_sql
+
+__all__ = ["QueryEngine", "QueryResult", "parse_sql"]

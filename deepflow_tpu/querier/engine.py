@@ -1,0 +1,373 @@
+"""Query execution over the columnar store.
+
+The reference engine turns DeepFlow-SQL into ClickHouse SQL and lets CH
+aggregate (engine/clickhouse/clickhouse.go). Here the store is ours, so
+execution is direct: partition-pruned scans, vectorized numpy filters,
+and GROUP BY as the same device segment-reduction the rollup manager
+uses — an aggregation query literally runs on the TPU. SmartEncoded hash
+columns translate to/from strings through TagDicts (the reference joins
+flow_tag dict tables, engine/clickhouse/tag/translation.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from deepflow_tpu.querier import sql as Q
+from deepflow_tpu.store.db import Store, Table
+from deepflow_tpu.store.dict_store import TagDictRegistry
+from deepflow_tpu.store.rollup import group_reduce
+from deepflow_tpu.store.table import AggKind
+
+# hash-typed columns -> candidate dictionaries that can reverse them (a
+# column name may be written by more than one pipeline with different
+# dicts, e.g. event_type in resource_event vs in_process_profile)
+DICT_COLUMNS = {
+    "endpoint_hash": ("l7_endpoint",),
+    "metric": ("metric_name",),
+    "labels": ("label_set",),
+    "stack": ("profile_stack",),
+    "app_service": ("profile_name",),
+    "event_type": ("event_strings", "profile_name"),
+    "filename": ("event_strings",),
+    "policy_name": ("event_strings",),
+    "alarm_target": ("event_strings",),
+    "description": ("event_strings",),
+}
+
+
+@dataclass
+class QueryResult:
+    columns: List[str]
+    values: List[List]         # row-major, JSON-friendly
+
+    def as_dict(self) -> dict:
+        return {"columns": self.columns, "values": self.values}
+
+
+class QueryEngine:
+    def __init__(self, store: Store,
+                 tag_dicts: Optional[TagDictRegistry] = None) -> None:
+        self.store = store
+        self.tag_dicts = tag_dicts
+
+    # -- public ------------------------------------------------------------
+    def execute(self, sql_text: str, db: Optional[str] = None) -> QueryResult:
+        stmt = Q.parse_sql(sql_text)
+        if isinstance(stmt, Q.Show):
+            return self._show(stmt, db)
+        return self._select(stmt, db)
+
+    # -- SHOW --------------------------------------------------------------
+    def _show(self, stmt: Q.Show, db: Optional[str]) -> QueryResult:
+        if stmt.what == "databases":
+            names = sorted({d for d, _ in self.store.tables()})
+            return QueryResult(["name"], [[n] for n in names])
+        if stmt.what == "tables":
+            rows = [[d, t] for d, t in self.store.tables()
+                    if stmt.table in (None, d)]
+            return QueryResult(["database", "table"], rows)
+        table = self._resolve_table(stmt.table, db)
+        if stmt.what == "tags":
+            rows = [[c.name, np.dtype(c.dtype).name]
+                    for c in table.schema.columns if c.agg is AggKind.KEY]
+            return QueryResult(["name", "type"], rows)
+        rows = [[c.name, c.agg.value] for c in table.schema.columns
+                if c.agg is not AggKind.KEY]
+        return QueryResult(["name", "operator"], rows)
+
+    # -- SELECT ------------------------------------------------------------
+    def _resolve_table(self, name: str, db: Optional[str]) -> Table:
+        if "." in name:
+            d, _, t = name.partition(".")
+            return self.store.table(d, t)
+        if db is not None:
+            return self.store.table(db, name)
+        for d, t in self.store.tables():
+            if t == name:
+                return self.store.table(d, t)
+        raise KeyError(f"unknown table {name}")
+
+    def _select(self, stmt: Q.Select, db: Optional[str]) -> QueryResult:
+        table = self._resolve_table(stmt.table, db)
+        schema = table.schema
+
+        # columns referenced anywhere
+        needed = set(stmt.group_by)
+        for it in stmt.items:
+            needed |= _expr_columns(it.expr)
+        for c in stmt.where:
+            needed.add(c.column)
+        if not needed:
+            needed = {schema.time_column}  # Count(*) still needs row counts
+        for nm in needed:
+            schema.spec(nm)  # raises on unknown
+
+        time_range, residual = self._time_bounds(stmt.where,
+                                                 schema.time_column)
+        cols = table.scan(columns=sorted(needed), time_range=time_range)
+        mask = self._filter_mask(cols, residual)
+        if mask is not None:
+            cols = {k: v[mask] for k, v in cols.items()}
+
+        if stmt.group_by:
+            out_cols, out_rows = self._grouped(stmt, cols)
+        else:
+            out_cols, out_rows = self._flat(stmt, cols)
+
+        out_rows = self._order_limit(stmt, out_cols, out_rows)
+        out_rows = self._humanize(out_cols, out_rows)
+        return QueryResult(out_cols, out_rows)
+
+    # -- where -------------------------------------------------------------
+    def _time_bounds(self, conds: List[Q.Cond], tcol: str):
+        """Split WHERE into a [lo,hi) range on the time column (for
+        partition pruning) + residual vectorized conditions."""
+        lo, hi = None, None
+        residual = []
+        for c in conds:
+            if c.column == tcol and c.op in (">", ">=", "<", "<="):
+                v = int(c.value)
+                if c.op == ">":
+                    lo = max(lo or 0, v + 1)
+                elif c.op == ">=":
+                    lo = max(lo or 0, v)
+                elif c.op == "<":
+                    hi = min(hi if hi is not None else 1 << 62, v)
+                else:
+                    hi = min(hi if hi is not None else 1 << 62, v + 1)
+            else:
+                residual.append(c)
+        if lo is None and hi is None:
+            return None, residual
+        return (lo or 0, hi if hi is not None else 1 << 62), residual
+
+    def _cond_value(self, column: str, value):
+        """Translate string literals on hash columns through the dicts.
+        Lookup-only (never grows a dictionary); an unknown string returns
+        None, meaning the condition matches nothing."""
+        if isinstance(value, str):
+            dict_names = DICT_COLUMNS.get(column)
+            if dict_names is None or self.tag_dicts is None:
+                raise ValueError(
+                    f"string literal on non-dictionary column {column}")
+            for dn in dict_names:
+                h = self.tag_dicts.get(dn).lookup(value)
+                if h is not None:
+                    return h
+            return None
+        return value
+
+    def _filter_mask(self, cols: Dict[str, np.ndarray],
+                     conds: List[Q.Cond]) -> Optional[np.ndarray]:
+        if not conds:
+            return None
+        mask = None
+        for c in conds:
+            col = cols[c.column]
+            if c.op == "in":
+                vals = [v for v in (self._cond_value(c.column, x)
+                                    for x in c.value) if v is not None]
+                m = np.isin(col, np.asarray(vals, dtype=col.dtype)) if vals \
+                    else np.zeros(len(col), np.bool_)
+            else:
+                raw = self._cond_value(c.column, c.value)
+                if raw is None:  # unknown dictionary string
+                    m = np.full(len(col), c.op == "!=")
+                else:
+                    v = np.asarray(raw).astype(col.dtype)
+                    m = {"=": col == v, "!=": col != v, "<": col < v,
+                         "<=": col <= v, ">": col > v, ">=": col >= v}[c.op]
+            mask = m if mask is None else (mask & m)
+        return mask
+
+    # -- aggregation -------------------------------------------------------
+    def _grouped(self, stmt: Q.Select, cols: Dict[str, np.ndarray]):
+        aggs: Dict[str, str] = {}     # internal value name -> reduce kind
+        value_src: Dict[str, np.ndarray] = {}
+        n = len(next(iter(cols.values()))) if cols else 0
+
+        def register(agg: Q.Agg) -> str:
+            kind = agg.func
+            if agg.arg is None:            # Count(*)
+                key = "__count"
+                value_src[key] = np.ones(n, np.int64)
+                aggs[key] = "sum"
+                return key
+            src = _eval_cols(agg.arg, cols, n)
+            key = f"__{kind}_{len(value_src)}"
+            value_src[key] = src
+            aggs[key] = "count" if kind == "count" else \
+                "sum" if kind in ("sum", "avg") else kind
+            if kind == "avg":
+                value_src[key + "_n"] = np.ones(n, np.int64)
+                aggs[key + "_n"] = "sum"
+            if kind == "count":
+                aggs[key] = "sum"
+                value_src[key] = np.ones(n, np.int64)
+            return key
+
+        # map every aggregate in every select item to a reduced column
+        plans = [_plan_aggs(it.expr, register) for it in stmt.items]
+        work = {k: cols[k] for k in stmt.group_by}
+        work.update(value_src)
+        reduced = group_reduce(work, list(stmt.group_by), aggs) if n else \
+            {k: np.empty(0, np.int64) for k in list(stmt.group_by) + list(aggs)}
+
+        out_cols, series = [], []
+        for it, plan in zip(stmt.items, plans):
+            name = it.alias or _expr_name(it.expr)
+            out_cols.append(name)
+            series.append(_eval_reduced(plan, reduced))
+        rows = [list(r) for r in zip(*[np.asarray(s).tolist()
+                                       for s in series])] if series else []
+        return out_cols, rows
+
+    def _flat(self, stmt: Q.Select, cols: Dict[str, np.ndarray]):
+        n = len(next(iter(cols.values()))) if cols else 0
+        has_agg = any(_has_agg(it.expr) for it in stmt.items)
+        out_cols, series = [], []
+        for it in stmt.items:
+            name = it.alias or _expr_name(it.expr)
+            out_cols.append(name)
+            if has_agg:
+                series.append([_eval_scalar(it.expr, cols, n)])
+            else:
+                series.append(np.asarray(
+                    _eval_cols(it.expr, cols, n)).tolist())
+        rows = [list(r) for r in zip(*series)]
+        return out_cols, rows
+
+    # -- post --------------------------------------------------------------
+    def _order_limit(self, stmt: Q.Select, out_cols: List[str], rows):
+        if stmt.order_by is not None:
+            key, desc = stmt.order_by
+            if key not in out_cols:
+                raise ValueError(f"ORDER BY {key} not in select list")
+            idx = out_cols.index(key)
+            rows = sorted(rows, key=lambda r: r[idx], reverse=desc)
+        if stmt.limit is not None:
+            rows = rows[:stmt.limit]
+        return rows
+
+    def _humanize(self, out_cols: List[str], rows):
+        """Reverse-translate dictionary hash columns to strings."""
+        if self.tag_dicts is None:
+            return rows
+        for j, name in enumerate(out_cols):
+            dict_names = DICT_COLUMNS.get(name)
+            if dict_names is None:
+                continue
+            dicts = [self.tag_dicts.get(dn) for dn in dict_names]
+            for r in rows:
+                for d in dicts:
+                    s = d.decode(int(r[j]))
+                    if s is not None:
+                        r[j] = s
+                        break
+        return rows
+
+
+# -- expression helpers ----------------------------------------------------
+def _expr_columns(e: Q.Expr) -> set:
+    if isinstance(e, Q.Column):
+        return {e.name}
+    if isinstance(e, Q.Agg):
+        return _expr_columns(e.arg) if e.arg is not None else set()
+    if isinstance(e, Q.BinOp):
+        return _expr_columns(e.left) | _expr_columns(e.right)
+    return set()
+
+
+def _has_agg(e: Q.Expr) -> bool:
+    if isinstance(e, Q.Agg):
+        return True
+    if isinstance(e, Q.BinOp):
+        return _has_agg(e.left) or _has_agg(e.right)
+    return False
+
+
+def _expr_name(e: Q.Expr) -> str:
+    if isinstance(e, Q.Column):
+        return e.name
+    if isinstance(e, Q.Literal):
+        return str(e.value)
+    if isinstance(e, Q.Agg):
+        return f"{e.func}({_expr_name(e.arg) if e.arg else '*'})"
+    return f"{_expr_name(e.left)}{e.op}{_expr_name(e.right)}"
+
+
+def _eval_cols(e: Q.Expr, cols: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """Row-wise evaluation (no aggregates)."""
+    if isinstance(e, Q.Column):
+        c = cols[e.name]
+        # floats stay float row-wise; grouped reduction is integer-domain
+        # (group_reduce casts to int64 — fractional metric sums truncate)
+        return c.astype(np.float64 if c.dtype.kind == "f" else np.int64)
+    if isinstance(e, Q.Literal):
+        return np.full(n, e.value)
+    if isinstance(e, Q.BinOp):
+        a = _eval_cols(e.left, cols, n)
+        b = _eval_cols(e.right, cols, n)
+        return _apply_op(e.op, a, b)
+    raise ValueError("aggregate in row-wise context")
+
+
+def _apply_op(op: str, a, b):
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = np.asarray(a, np.float64) / np.asarray(b, np.float64)
+    return np.nan_to_num(r)
+
+
+def _plan_aggs(e: Q.Expr, register) -> Q.Expr:
+    """Rewrite Agg nodes into Column refs over reduced names."""
+    if isinstance(e, Q.Agg):
+        return Q.Column(register(e) + ("|avg" if e.func == "avg" else ""))
+    if isinstance(e, Q.BinOp):
+        return Q.BinOp(e.op, _plan_aggs(e.left, register),
+                       _plan_aggs(e.right, register))
+    return e
+
+
+def _eval_reduced(e: Q.Expr, reduced: Dict[str, np.ndarray]) -> np.ndarray:
+    if isinstance(e, Q.Column):
+        if e.name.endswith("|avg"):
+            base = e.name[:-4]
+            return _apply_op("/", reduced[base], reduced[base + "_n"])
+        return reduced[e.name]
+    if isinstance(e, Q.Literal):
+        some = next(iter(reduced.values()))
+        return np.full(len(some), e.value)
+    return _apply_op(e.op, _eval_reduced(e.left, reduced),
+                     _eval_reduced(e.right, reduced))
+
+
+def _eval_scalar(e: Q.Expr, cols: Dict[str, np.ndarray], n: int):
+    if isinstance(e, Q.Agg):
+        if e.arg is None or e.func == "count":
+            return n
+        src = _eval_cols(e.arg, cols, n)
+        if len(src) == 0:
+            return 0
+        if e.func == "sum":
+            return int(src.sum())
+        if e.func == "max":
+            return int(src.max())
+        if e.func == "min":
+            return int(src.min())
+        return float(src.mean())
+    if isinstance(e, Q.BinOp):
+        return _apply_op(e.op, _eval_scalar(e.left, cols, n),
+                         _eval_scalar(e.right, cols, n))
+    if isinstance(e, Q.Literal):
+        return e.value
+    raise ValueError(f"bare column {e} in aggregate context")

@@ -1,0 +1,146 @@
+"""PromQL subset over the ext_metrics sample tables.
+
+Reference: server/querier/app/prometheus/ — a PromQL-to-querier-SQL
+adapter serving Grafana and remote_read. The subset here covers the
+selector algebra that adapter sees most: instant/range vector selectors
+with label matchers, `rate(m[d])`, and `sum/avg/max/min by (...)` over
+them. Series come back keyed by their label-set string (the reverse of
+the SmartEncoded labels hash).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.dict_store import TagDictRegistry
+
+_SELECTOR = re.compile(
+    r"""^\s*(?:(?P<agg>sum|avg|max|min)(?:\s+by\s*\((?P<by>[^)]*)\))?\s*\()?
+        \s*(?:(?P<rate>rate)\s*\()?
+        \s*(?P<metric>[A-Za-z_:][A-Za-z0-9_:.]*)
+        (?:\{(?P<matchers>[^}]*)\})?
+        (?:\[(?P<range>\d+)(?P<range_unit>[smh])\])?
+        \s*\)?\s*\)?\s*$""", re.VERBOSE)
+
+_UNIT_S = {"s": 1, "m": 60, "h": 3600}
+
+
+@dataclass
+class PromQuery:
+    metric: str
+    matchers: List[Tuple[str, str, str]]   # (label, op, value); op =|!=|=~
+    range_s: Optional[int] = None
+    rate: bool = False
+    agg: Optional[str] = None
+    by: List[str] = field(default_factory=list)
+
+
+def parse_promql(q: str) -> PromQuery:
+    m = _SELECTOR.match(q)
+    if not m:
+        raise ValueError(f"unsupported PromQL: {q!r}")
+    matchers = []
+    if m.group("matchers"):
+        for part in m.group("matchers").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            mm = re.match(r'([A-Za-z_][A-Za-z0-9_]*)\s*(=~|!=|=)\s*"([^"]*)"',
+                          part)
+            if not mm:
+                raise ValueError(f"bad matcher {part!r}")
+            matchers.append((mm.group(1), mm.group(2), mm.group(3)))
+    rng = None
+    if m.group("range"):
+        rng = int(m.group("range")) * _UNIT_S[m.group("range_unit")]
+    return PromQuery(
+        metric=m.group("metric"), matchers=matchers, range_s=rng,
+        rate=bool(m.group("rate")), agg=m.group("agg"),
+        by=[b.strip() for b in (m.group("by") or "").split(",") if b.strip()])
+
+
+def _parse_labels(s: str) -> Dict[str, str]:
+    out = {}
+    for part in s.split(","):
+        k, _, v = part.partition("=")
+        if k:
+            out[k] = v
+    return out
+
+
+class PromEngine:
+    def __init__(self, store: Store, tag_dicts: TagDictRegistry,
+                 db: str = "ext_metrics", table: str = "ext_samples") -> None:
+        self.store = store
+        self.tag_dicts = tag_dicts
+        self.db = db
+        self.table = table
+
+    def query(self, promql: str, at: Optional[int] = None) -> List[dict]:
+        """Instant query: returns [{metric: {labels}, value: [ts, v]}] in
+        the Prometheus HTTP API result shape."""
+        pq = parse_promql(promql)
+        metric_dict = self.tag_dicts.get("metric_name")
+        label_dict = self.tag_dicts.get("label_set")
+        mh = metric_dict.encode_one(pq.metric)
+        t = self.store.table(self.db, self.table)
+        at = at if at is not None else int(time.time())
+        hi = at + 1  # instant query at t includes samples stamped exactly t
+        lo = hi - (pq.range_s if pq.range_s else 300)
+        cols = t.scan(time_range=(lo, hi))
+        sel = cols["metric"] == np.uint32(mh)
+        # decode label hashes once, filter by matchers
+        series: Dict[int, Dict[str, str]] = {}
+        for lh in np.unique(cols["labels"][sel]):
+            labels = _parse_labels(label_dict.decode(int(lh)) or "")
+            if self._match(labels, pq.matchers):
+                series[int(lh)] = labels
+        out = []
+        groups: Dict[Tuple, List[Tuple[Dict[str, str], float]]] = {}
+        for lh, labels in series.items():
+            m = sel & (cols["labels"] == np.uint32(lh))
+            ts = cols["timestamp"][m].astype(np.int64)
+            vs = cols["value"][m].astype(np.float64)
+            if len(ts) == 0:
+                continue
+            order = np.argsort(ts)
+            ts, vs = ts[order], vs[order]
+            if pq.rate:
+                if len(ts) < 2 or ts[-1] == ts[0]:
+                    continue
+                val = float((vs[-1] - vs[0]) / (ts[-1] - ts[0]))
+            else:
+                val = float(vs[-1])
+            stamp = int(ts[-1])
+            if pq.agg:
+                key = tuple(labels.get(b, "") for b in pq.by)
+                groups.setdefault(key, []).append((labels, val))
+            else:
+                out.append({"metric": {"__name__": pq.metric, **labels},
+                            "value": [stamp, str(val)]})
+        for key, members in groups.items():
+            vals = [v for _, v in members]
+            v = {"sum": sum(vals), "max": max(vals), "min": min(vals),
+                 "avg": sum(vals) / len(vals)}[pq.agg]
+            labels = dict(zip(pq.by, key))
+            out.append({"metric": labels, "value": [at, str(v)]})
+        return sorted(out, key=lambda r: str(r["metric"]))
+
+    @staticmethod
+    def _match(labels: Dict[str, str],
+               matchers: List[Tuple[str, str, str]]) -> bool:
+        for name, op, value in matchers:
+            have = labels.get(name, "")
+            if op == "=" and have != value:
+                return False
+            if op == "!=" and have == value:
+                return False
+            if op == "=~" and not re.fullmatch(value, have):
+                return False
+        return True

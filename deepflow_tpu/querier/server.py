@@ -1,0 +1,101 @@
+"""Querier HTTP API (reference: server/querier/router/query.go).
+
+POST /v1/query           body: db=<db>&sql=<sql>   (form or JSON)
+GET  /api/v1/query?query=<promql>[&time=<epoch>]   (Prometheus shape)
+GET  /health
+
+Stdlib ThreadingHTTPServer: the query path is read-only over immutable
+segments, so handlers are safely concurrent with ingest.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepflow_tpu.querier.engine import QueryEngine
+from deepflow_tpu.querier.promql import PromEngine
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.dict_store import TagDictRegistry
+
+DEFAULT_PORT = 20416   # reference querier listens on 20416
+
+
+class QuerierServer:
+    def __init__(self, store: Store, tag_dicts: TagDictRegistry,
+                 port: int = DEFAULT_PORT, host: str = "127.0.0.1") -> None:
+        self.engine = QueryEngine(store, tag_dicts)
+        self.prom = PromEngine(store, tag_dicts)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                url = urllib.parse.urlparse(self.path)
+                if url.path == "/health":
+                    self._send(200, {"status": "ok"})
+                    return
+                if url.path == "/api/v1/query":
+                    qs = urllib.parse.parse_qs(url.query)
+                    try:
+                        result = outer.prom.query(
+                            qs["query"][0],
+                            at=int(qs["time"][0]) if "time" in qs else None)
+                        self._send(200, {"status": "success",
+                                         "data": {"resultType": "vector",
+                                                  "result": result}})
+                    except Exception as e:
+                        self._send(400, {"status": "error", "error": str(e)})
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_POST(self) -> None:
+                url = urllib.parse.urlparse(self.path)
+                if url.path != "/v1/query":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length).decode()
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        params = json.loads(raw or "{}")
+                    else:
+                        params = {k: v[0] for k, v in
+                                  urllib.parse.parse_qs(raw).items()}
+                    res = outer.engine.execute(params.get("sql", ""),
+                                               db=params.get("db") or None)
+                    self._send(200, {"result": res.as_dict()})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="querier-http", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

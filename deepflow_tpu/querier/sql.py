@@ -1,0 +1,259 @@
+"""DeepFlow-SQL parser: a small recursive-descent front end.
+
+Supports the query shapes the reference querier serves from Grafana
+(engine/clickhouse/clickhouse.go TransSelect/TransWhere/TransGroupBy):
+
+    SELECT <expr> [AS alias], ... FROM <table>
+      [WHERE <cond> [AND <cond>]...]
+      [GROUP BY col, ...] [ORDER BY <expr> [ASC|DESC]] [LIMIT n]
+    SHOW DATABASES | SHOW TABLES [FROM db] |
+    SHOW TAGS FROM <table> | SHOW METRICS FROM <table>
+
+Expressions: columns, integer/float/string literals, aggregate calls
+(Sum/Min/Max/Avg/Count), and +,-,*,/ arithmetic over them (derived
+metrics like Sum(retrans)/Sum(packet_tx)). Conditions: =, !=, <, <=, >,
+>=, IN (...), and AND conjunction. The reference's sqlparser fork
+(querier/parse/parse.go) plays this role; a hand-rolled parser keeps the
+dependency surface zero.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+AGG_FUNCS = {"sum", "min", "max", "avg", "count"}
+
+_TOKEN = re.compile(r"""
+    \s*(
+        '(?:[^'\\]|\\.)*'        # string literal
+      | [A-Za-z_][A-Za-z0-9_.]*  # ident (may be db.table)
+      | \d+\.\d+ | \d+           # number
+      | != | <= | >= | [(),=<>*+/-]
+    )""", re.VERBOSE)
+
+
+def tokenize(s: str) -> List[str]:
+    out, pos = [], 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if not m:
+            if s[pos:].strip() == "":
+                break
+            raise ValueError(f"bad token at: {s[pos:pos+20]!r}")
+        out.append(m.group(1))
+        pos = m.end()
+    return out
+
+
+# -- AST -------------------------------------------------------------------
+@dataclass(frozen=True)
+class Column:
+    name: str
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Union[int, float, str]
+
+
+@dataclass(frozen=True)
+class Agg:
+    func: str                 # sum|min|max|avg|count
+    arg: Optional["Expr"]     # None for Count(*)
+
+
+@dataclass(frozen=True)
+class BinOp:
+    op: str                   # + - * /
+    left: "Expr"
+    right: "Expr"
+
+
+Expr = Union[Column, Literal, Agg, BinOp]
+
+
+@dataclass(frozen=True)
+class Cond:
+    column: str
+    op: str                   # = != < <= > >= in
+    value: Union[int, float, str, Tuple]
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str]
+
+
+@dataclass(frozen=True)
+class Select:
+    items: List[SelectItem]
+    table: str
+    where: List[Cond] = field(default_factory=list)
+    group_by: List[str] = field(default_factory=list)
+    order_by: Optional[Tuple[str, bool]] = None   # (alias/col, desc)
+    limit: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Show:
+    what: str                 # databases|tables|tags|metrics
+    table: Optional[str] = None
+
+
+Statement = Union[Select, Show]
+
+
+class _Parser:
+    def __init__(self, tokens: List[str]) -> None:
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of query")
+        self.i += 1
+        return t
+
+    def expect(self, word: str) -> None:
+        t = self.next()
+        if t.lower() != word.lower():
+            raise ValueError(f"expected {word!r}, got {t!r}")
+
+    def accept(self, word: str) -> bool:
+        if (self.peek() or "").lower() == word.lower():
+            self.i += 1
+            return True
+        return False
+
+    # -- expressions -------------------------------------------------------
+    def parse_expr(self) -> Expr:
+        left = self.parse_term()
+        while self.peek() in ("+", "-"):
+            op = self.next()
+            left = BinOp(op, left, self.parse_term())
+        return left
+
+    def parse_term(self) -> Expr:
+        left = self.parse_atom()
+        while self.peek() in ("*", "/"):
+            op = self.next()
+            left = BinOp(op, left, self.parse_atom())
+        return left
+
+    def parse_atom(self) -> Expr:
+        t = self.next()
+        if t == "(":
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if t.startswith("'"):
+            return Literal(t[1:-1])
+        if re.fullmatch(r"\d+", t):
+            return Literal(int(t))
+        if re.fullmatch(r"\d+\.\d+", t):
+            return Literal(float(t))
+        if t.lower() in AGG_FUNCS and self.peek() == "(":
+            self.next()
+            if self.accept("*"):
+                self.expect(")")
+                return Agg(t.lower(), None)
+            arg = self.parse_expr()
+            self.expect(")")
+            return Agg(t.lower(), arg)
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_.]*", t):
+            raise ValueError(f"unexpected token {t!r}")
+        return Column(t)
+
+    # -- clauses -----------------------------------------------------------
+    def parse_select(self) -> Select:
+        items = []
+        while True:
+            e = self.parse_expr()
+            alias = None
+            if self.accept("as"):
+                alias = self.next()
+            items.append(SelectItem(e, alias))
+            if not self.accept(","):
+                break
+        self.expect("from")
+        table = self.next()
+        where: List[Cond] = []
+        group_by: List[str] = []
+        order_by = None
+        limit = None
+        if self.accept("where"):
+            where.append(self.parse_cond())
+            while self.accept("and"):
+                where.append(self.parse_cond())
+        if self.accept("group"):
+            self.expect("by")
+            group_by.append(self.next())
+            while self.accept(","):
+                group_by.append(self.next())
+        if self.accept("order"):
+            self.expect("by")
+            key = self.next()
+            desc = False
+            if self.accept("desc"):
+                desc = True
+            elif self.accept("asc"):
+                pass
+            order_by = (key, desc)
+        if self.accept("limit"):
+            limit = int(self.next())
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens at {self.peek()!r}")
+        return Select(items, table, where, group_by, order_by, limit)
+
+    def parse_cond(self) -> Cond:
+        col = self.next()
+        op = self.next().lower()
+        if op == "in":
+            self.expect("(")
+            vals = [self._value(self.next())]
+            while self.accept(","):
+                vals.append(self._value(self.next()))
+            self.expect(")")
+            return Cond(col, "in", tuple(vals))
+        if op not in ("=", "!=", "<", "<=", ">", ">="):
+            raise ValueError(f"bad operator {op!r}")
+        return Cond(col, op, self._value(self.next()))
+
+    @staticmethod
+    def _value(t: str) -> Union[int, float, str]:
+        if t.startswith("'"):
+            return t[1:-1]
+        if re.fullmatch(r"\d+", t):
+            return int(t)
+        if re.fullmatch(r"\d+\.\d+", t):
+            return float(t)
+        raise ValueError(f"bad literal {t!r}")
+
+
+def parse_sql(sql: str) -> Statement:
+    toks = tokenize(sql)
+    p = _Parser(toks)
+    head = p.next().lower()
+    if head == "select":
+        return p.parse_select()
+    if head == "show":
+        what = p.next().lower()
+        if what == "databases":
+            return Show("databases")
+        if what == "tables":
+            table = None
+            if p.accept("from"):
+                table = p.next()
+            return Show("tables", table)
+        if what in ("tags", "metrics"):
+            p.expect("from")
+            return Show(what, p.next())
+        raise ValueError(f"SHOW {what} not supported")
+    raise ValueError(f"unsupported statement {head!r}")

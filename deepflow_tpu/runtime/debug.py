@@ -1,0 +1,96 @@
+"""UDP debug protocol: runtime introspection for the CLI.
+
+Reference: server/libs/debug — a UDP request/response protocol every
+ingester module registers into, driven by `deepflow-ctl ingester ...`.
+Here requests/responses are single-datagram JSON: {"cmd": ...} in,
+{"ok": ..., "data": ...} out. Commands: counters (scrape the Countable
+registry), vtap-status (receiver per-agent sequence tracking), ping.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Callable, Dict, Optional
+
+from deepflow_tpu.runtime.stats import StatsRegistry
+
+DEFAULT_DEBUG_PORT = 30035
+
+
+class DebugServer:
+    def __init__(self, stats: StatsRegistry, port: int = DEFAULT_DEBUG_PORT,
+                 host: str = "127.0.0.1") -> None:
+        self.stats = stats
+        self._handlers: Dict[str, Callable[[dict], object]] = {
+            "ping": lambda req: "pong",
+            "counters": self._counters,
+        }
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, port))
+        self._sock.settimeout(0.2)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def register(self, cmd: str, handler: Callable[[dict], object]) -> None:
+        self._handlers[cmd] = handler
+
+    def _counters(self, req: dict) -> dict:
+        module = req.get("module")
+        out = {}
+        for s in self.stats.collect():
+            if module is None or s.module.startswith(module):
+                out[s.module] = s.values
+        return out
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="debug-udp",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        self._sock.close()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                req = json.loads(data.decode())
+                handler = self._handlers.get(req.get("cmd", ""))
+                if handler is None:
+                    resp = {"ok": False, "error": "unknown command"}
+                else:
+                    resp = {"ok": True, "data": handler(req)}
+            except Exception as e:
+                resp = {"ok": False, "error": str(e)}
+            try:
+                self._sock.sendto(json.dumps(resp).encode(), addr)
+            except OSError:
+                pass
+
+
+def debug_request(cmd: str, port: int = DEFAULT_DEBUG_PORT,
+                  host: str = "127.0.0.1", timeout: float = 2.0,
+                  **kw) -> dict:
+    """One-shot client (the deepflow-ctl side)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(json.dumps({"cmd": cmd, **kw}).encode(), (host, port))
+        data, _ = sock.recvfrom(1 << 20)
+        return json.loads(data.decode())
+    finally:
+        sock.close()

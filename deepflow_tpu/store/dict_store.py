@@ -70,6 +70,12 @@ class TagDict:
         return np.fromiter((self.encode_one(s) for s in strings),
                            dtype=np.uint32)
 
+    def lookup(self, s: str) -> Optional[int]:
+        """Read-only encode: the query path must not grow the dictionary
+        (unbounded journal growth from probing WHERE literals)."""
+        with self._lock:
+            return self._fwd.get(s)
+
     def decode(self, h: int) -> Optional[str]:
         return self._rev.get(int(h))
 
@@ -107,9 +113,13 @@ class TagDictRegistry:
             return d
 
     def flush(self) -> None:
-        for d in self._dicts.values():
+        with self._lock:
+            dicts = list(self._dicts.values())
+        for d in dicts:
             d.flush()
 
     def close(self) -> None:
-        for d in self._dicts.values():
+        with self._lock:
+            dicts = list(self._dicts.values())
+        for d in dicts:
             d.close()

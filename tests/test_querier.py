@@ -1,0 +1,204 @@
+"""Querier: SQL parse goldens, execution vs numpy, PromQL, HTTP API."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.querier import QueryEngine, parse_sql
+from deepflow_tpu.querier.promql import PromEngine, parse_promql
+from deepflow_tpu.querier.server import QuerierServer
+from deepflow_tpu.querier.sql import Agg, BinOp, Column, Select, Show
+from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+from deepflow_tpu.store.dict_store import TagDictRegistry
+
+
+# -- parser goldens --------------------------------------------------------
+def test_parse_select_golden():
+    s = parse_sql(
+        "SELECT ip_dst, Sum(byte_tx) AS bytes, Sum(retrans)/Sum(packet_tx) "
+        "FROM l4_flow_log WHERE timestamp >= 100 AND timestamp < 200 "
+        "AND proto = 6 GROUP BY ip_dst ORDER BY bytes DESC LIMIT 10")
+    assert isinstance(s, Select)
+    assert s.table == "l4_flow_log"
+    assert [c.op for c in s.where] == [">=", "<", "="]
+    assert s.group_by == ["ip_dst"]
+    assert s.order_by == ("bytes", True)
+    assert s.limit == 10
+    assert isinstance(s.items[2].expr, BinOp)
+    assert isinstance(s.items[2].expr.left, Agg)
+
+
+def test_parse_show():
+    assert parse_sql("show databases") == Show("databases")
+    assert parse_sql("SHOW TAGS FROM l4_flow_log") == \
+        Show("tags", "l4_flow_log")
+    with pytest.raises(ValueError):
+        parse_sql("DROP TABLE x")
+
+
+# -- execution -------------------------------------------------------------
+@pytest.fixture
+def engine(tmp_path):
+    store = Store(str(tmp_path))
+    schema = TableSchema(
+        name="flows",
+        columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("proto", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM),
+            ColumnSpec("rtt", np.dtype(np.uint32), AggKind.MAX),
+        ))
+    t = store.create_table("flow_log", schema)
+    rng = np.random.default_rng(3)
+    n = 2000
+    cols = {
+        "timestamp": rng.integers(0, 100, n).astype(np.uint32),
+        "ip": rng.integers(1, 5, n).astype(np.uint32),
+        "proto": np.where(rng.random(n) < 0.5, 6, 17).astype(np.uint32),
+        "bytes": rng.integers(0, 1000, n).astype(np.uint32),
+        "rtt": rng.integers(0, 9999, n).astype(np.uint32),
+    }
+    t.append(cols)
+    eng = QueryEngine(store, TagDictRegistry(None))
+    return eng, cols
+
+
+def test_group_by_matches_numpy(engine):
+    eng, cols = engine
+    res = eng.execute("SELECT ip, Sum(bytes) AS b, Max(rtt) AS r, Count(*) "
+                      "AS n FROM flows WHERE proto = 6 GROUP BY ip "
+                      "ORDER BY ip")
+    sel = cols["proto"] == 6
+    for row in res.values:
+        ip, b, r, n = row
+        m = sel & (cols["ip"] == ip)
+        assert b == int(cols["bytes"][m].sum())
+        assert r == int(cols["rtt"][m].max())
+        assert n == int(m.sum())
+
+
+def test_derived_metric_and_avg(engine):
+    eng, cols = engine
+    res = eng.execute("SELECT Avg(bytes) AS a, Sum(bytes)/Count(*) AS d "
+                      "FROM flows")
+    a, d = res.values[0]
+    assert a == pytest.approx(cols["bytes"].mean(), rel=1e-9)
+    assert d == pytest.approx(cols["bytes"].mean(), rel=1e-9)
+
+
+def test_time_pruning_and_in(engine):
+    eng, cols = engine
+    res = eng.execute("SELECT Count(*) AS n FROM flows WHERE "
+                      "timestamp >= 10 AND timestamp < 20 AND ip IN (1, 2)")
+    m = (cols["timestamp"] >= 10) & (cols["timestamp"] < 20) & \
+        np.isin(cols["ip"], [1, 2])
+    assert res.values[0][0] == int(m.sum())
+
+
+def test_raw_rows_limit(engine):
+    eng, _ = engine
+    res = eng.execute("SELECT ip, bytes FROM flows LIMIT 5")
+    assert res.columns == ["ip", "bytes"]
+    assert len(res.values) == 5
+
+
+def test_show_tags_metrics(engine):
+    eng, _ = engine
+    tags = eng.execute("SHOW TAGS FROM flows")
+    assert ["timestamp", "ip", "proto"] == [r[0] for r in tags.values]
+    mets = eng.execute("SHOW METRICS FROM flows")
+    assert [r[0] for r in mets.values] == ["bytes", "rtt"]
+
+
+# -- promql ----------------------------------------------------------------
+def test_parse_promql():
+    pq = parse_promql('sum by (job) (rate(http_requests_total'
+                      '{job=~"api.*", env!="dev"}[5m]))')
+    assert pq.metric == "http_requests_total"
+    assert pq.agg == "sum" and pq.by == ["job"]
+    assert pq.rate and pq.range_s == 300
+    assert ("env", "!=", "dev") in pq.matchers
+
+
+@pytest.fixture
+def prom(tmp_path):
+    from deepflow_tpu.pipelines.ext_metrics import SAMPLE_TABLE
+    store = Store(str(tmp_path / "store"))
+    dicts = TagDictRegistry(str(tmp_path / "store"))
+    t = store.create_table("ext_metrics", SAMPLE_TABLE)
+    md, ld = dicts.get("metric_name"), dicts.get("label_set")
+    mh = md.encode_one("rps")
+    rows = []
+    for job, start in (("api", 10.0), ("web", 100.0)):
+        lh = ld.encode_one(f"job={job}")
+        for i in range(10):
+            rows.append((1000 + i * 10, mh, lh, start + i))
+    arr = np.array(rows)
+    t.append({"timestamp": arr[:, 0].astype(np.uint32),
+              "metric": arr[:, 1].astype(np.uint32),
+              "labels": arr[:, 2].astype(np.uint32),
+              "value": arr[:, 3].astype(np.float32)})
+    return PromEngine(store, dicts), store, dicts
+
+
+def test_promql_instant_and_rate(prom):
+    eng, _, _ = prom
+    out = eng.query('rps{job="api"}', at=1100)
+    assert len(out) == 1
+    assert float(out[0]["value"][1]) == 19.0   # last sample
+    out = eng.query('rate(rps[2m])', at=1100)
+    assert len(out) == 2
+    # both series rise 1 per 10s
+    for r in out:
+        assert float(r["value"][1]) == pytest.approx(0.1)
+    out = eng.query('sum by (job) (rps)', at=1100)
+    assert {r["metric"]["job"]: float(r["value"][1]) for r in out} == \
+        {"api": 19.0, "web": 109.0}
+
+
+# -- http ------------------------------------------------------------------
+def test_http_api(engine, prom):
+    eng, cols = engine
+    peng, store, dicts = prom
+    srv = QuerierServer(eng.store, eng.tag_dicts
+                        if eng.tag_dicts is not None else TagDictRegistry(None),
+                        port=0)
+    srv.start()
+    try:
+        body = "db=flow_log&sql=" + urllib.parse.quote(
+            "SELECT Count(*) AS n FROM flows")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/query", data=body.encode(),
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            payload = json.load(resp)
+        assert payload["result"]["columns"] == ["n"]
+        assert payload["result"]["values"][0][0] == 2000
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=5) as resp:
+            assert json.load(resp)["status"] == "ok"
+    finally:
+        srv.close()
+
+
+import urllib.parse  # noqa: E402  (used in test_http_api)
+
+
+def test_debug_server():
+    from deepflow_tpu.runtime.debug import DebugServer, debug_request
+    from deepflow_tpu.runtime.stats import StatsRegistry
+
+    stats = StatsRegistry()
+    stats.register("decoder.l4", lambda: {"records": 42})
+    srv = DebugServer(stats, port=0)
+    srv.start()
+    try:
+        assert debug_request("ping", port=srv.port)["data"] == "pong"
+        out = debug_request("counters", port=srv.port, module="decoder")
+        assert out["ok"] and out["data"]["decoder.l4"]["records"] == 42
+        assert not debug_request("nope", port=srv.port)["ok"]
+    finally:
+        srv.close()
