@@ -1,0 +1,193 @@
+"""df-ctl: the deepflow-ctl equivalent ops CLI.
+
+Reference: cli/ctl/ (cobra `deepflow-ctl`): agent listing, agent-group
+config CRUD, domain resource management, queries, and the ingester UDP
+debug client. Run as `python -m deepflow_tpu.cli <cmd> ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from deepflow_tpu.runtime.debug import DEFAULT_DEBUG_PORT, debug_request
+
+CONTROLLER = "http://127.0.0.1:20417"
+QUERIER = "http://127.0.0.1:20416"
+
+
+def _http(url: str, body=None, form: str = None):
+    data = None
+    headers = {}
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    elif form is not None:
+        data = form.encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=data, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.load(resp)
+    except urllib.error.HTTPError as e:
+        # both servers put the real message in a JSON error body
+        try:
+            return json.loads(e.read().decode())
+        except ValueError:
+            raise e from None
+
+
+def _table(rows, columns):
+    if not rows:
+        print("(empty)")
+        return
+    widths = [max(len(str(c)), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(columns)]
+    print("  ".join(str(c).ljust(w) for c, w in zip(columns, widths)))
+    for r in rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+
+
+def cmd_agent(args) -> int:
+    if args.action == "list":
+        vtaps = _http(f"{args.controller}/v1/vtaps")
+        _table([[v["vtap_id"], v["ctrl_ip"], v["host"], v["group"],
+                 "ALIVE" if v["alive"] else "OFFLINE", v["revision"]]
+                for v in vtaps],
+               ["ID", "CTRL_IP", "HOST", "GROUP", "STATE", "REVISION"])
+    return 0
+
+
+def cmd_group_config(args) -> int:
+    url = f"{args.controller}/v1/vtap-group-config?group={args.group}"
+    if args.set:
+        body = {}
+        for kv in args.set:
+            k, _, v = kv.partition("=")
+            try:
+                body[k] = json.loads(v)
+            except ValueError:
+                body[k] = v
+        out = _http(url, body=body)
+        print(json.dumps(out))
+    else:
+        print(json.dumps(_http(url), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_domain(args) -> int:
+    with open(args.file) as f:
+        resources = json.load(f)
+    if isinstance(resources, dict):
+        resources = resources.get("resources", [])
+    out = _http(f"{args.controller}/v1/domains/{args.name}/resources",
+                body={"resources": resources})
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_resource(args) -> int:
+    qs = f"?type={args.type}" if args.type else ""
+    rows = _http(f"{args.controller}/v1/resources{qs}")
+    _table([[r["type"], r["id"], r["name"], r["domain"]] for r in rows],
+           ["TYPE", "ID", "NAME", "DOMAIN"])
+    return 0
+
+
+def cmd_ingester(args) -> int:
+    if args.action == "set":   # full membership replace (rebalances fleet)
+        out = _http(f"{args.controller}/v1/ingesters",
+                    body={"addrs": args.addrs})
+        print(json.dumps(out))
+    elif args.action == "assignments":
+        print(json.dumps(_http(f"{args.controller}/v1/assignments"),
+                         indent=2))
+    elif args.action in ("counters", "vtap-status", "ping"):
+        out = debug_request(args.action, port=args.debug_port,
+                            **({"module": args.module} if args.module
+                               else {}))
+        print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_query(args) -> int:
+    form = urllib.parse.urlencode(
+        {"sql": args.sql, **({"db": args.db} if args.db else {})})
+    out = _http(f"{args.querier}/v1/query", form=form)
+    if "error" in out:
+        print(out["error"], file=sys.stderr)
+        return 1
+    res = out["result"]
+    _table(res["values"], res["columns"])
+    return 0
+
+
+def cmd_promql(args) -> int:
+    qs = urllib.parse.urlencode(
+        {"query": args.expr, **({"time": args.time} if args.time else {})})
+    out = _http(f"{args.querier}/api/v1/query?{qs}")
+    print(json.dumps(out, indent=2))
+    return 0 if out.get("status") == "success" else 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="df-ctl", description="deepflow-tpu ops CLI")
+    p.add_argument("--controller", default=CONTROLLER)
+    p.add_argument("--querier", default=QUERIER)
+    p.add_argument("--debug-port", type=int, default=DEFAULT_DEBUG_PORT)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("agent", help="agent fleet")
+    a.add_argument("action", choices=["list"])
+    a.set_defaults(fn=cmd_agent)
+
+    g = sub.add_parser("agent-group-config", help="group config CRUD")
+    g.add_argument("--group", default="default")
+    g.add_argument("--set", nargs="*", metavar="KEY=VALUE")
+    g.set_defaults(fn=cmd_group_config)
+
+    d = sub.add_parser("domain", help="push a domain resource snapshot")
+    d.add_argument("name")
+    d.add_argument("-f", "--file", required=True)
+    d.set_defaults(fn=cmd_domain)
+
+    r = sub.add_parser("resource", help="list resources")
+    r.add_argument("--type")
+    r.set_defaults(fn=cmd_resource)
+
+    i = sub.add_parser("ingester", help="ingester membership + debug")
+    i.add_argument("action", choices=["set", "assignments", "counters",
+                                      "vtap-status", "ping"])
+    i.add_argument("addrs", nargs="*")
+    i.add_argument("--module")
+    i.set_defaults(fn=cmd_ingester)
+
+    q = sub.add_parser("query", help="run DeepFlow-SQL")
+    q.add_argument("sql")
+    q.add_argument("-d", "--db")
+    q.set_defaults(fn=cmd_query)
+
+    pq = sub.add_parser("promql", help="run a PromQL instant query")
+    pq.add_argument("expr")
+    pq.add_argument("--time", type=int)
+    pq.set_defaults(fn=cmd_promql)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

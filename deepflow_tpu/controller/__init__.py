@@ -1,0 +1,17 @@
+"""Controller: agent management, resource model, tag dictionaries.
+
+Reference: server/controller/ — trisolaris (agent registration/config
+push), genesis (agent-reported resources), recorder (cloud+genesis ->
+MySQL resource model), tagrecorder (SmartEncoding dimension tables),
+election (single master), monitor (agent liveness + ingester
+rebalancing). The re-design keeps the same responsibilities with an
+in-memory + JSON-persisted resource model, a file-lock election, and
+HTTP (stdlib) in place of gRPC for the sync surface — the data-plane
+wire stays the firehose.
+"""
+
+from deepflow_tpu.controller.model import ResourceModel
+from deepflow_tpu.controller.registry import VTapRegistry
+from deepflow_tpu.controller.server import ControllerServer
+
+__all__ = ["ResourceModel", "VTapRegistry", "ControllerServer"]

@@ -1,0 +1,92 @@
+"""Leader election via a heartbeat lease file.
+
+Reference: server/controller/election/election.go uses a k8s
+leaderelection Lease so exactly one controller runs cloud sync and
+tagrecorder. The single-host analogue is a lease file with an owner id +
+heartbeat timestamp: a candidate acquires the lease if it is free or
+stale, renews it on a cadence, and loses leadership when another owner's
+fresher heartbeat appears (e.g. after this process stalls past the lease
+duration).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, List, Optional
+
+
+class Election:
+    def __init__(self, lease_path: str, lease_seconds: float = 15.0,
+                 renew_seconds: float = 5.0) -> None:
+        self.lease_path = lease_path
+        self.lease_seconds = lease_seconds
+        self.renew_seconds = renew_seconds
+        self.identity = uuid.uuid4().hex[:12]
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.on_started_leading: List[Callable[[], None]] = []
+        self.on_stopped_leading: List[Callable[[], None]] = []
+        os.makedirs(os.path.dirname(lease_path) or ".", exist_ok=True)
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.lease_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """One election round; returns current leadership."""
+        now = time.time() if now is None else now
+        lease = self._read()
+        free = (lease is None
+                or lease["holder"] == self.identity
+                or now - lease["renewed"] > self.lease_seconds)
+        if free:
+            tmp = f"{self.lease_path}.{self.identity}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"holder": self.identity, "renewed": now}, f)
+            os.replace(tmp, self.lease_path)
+            # re-read: another candidate may have replaced concurrently;
+            # last writer wins and the loser sees it here
+            lease = self._read()
+        held = bool(lease and lease["holder"] == self.identity)
+        if held and not self._leader:
+            self._leader = True
+            for fn in self.on_started_leading:
+                fn()
+        elif not held and self._leader:
+            self._leader = False
+            for fn in self.on_stopped_leading:
+                fn()
+        return self._leader
+
+    def start(self) -> None:
+        self.try_acquire()
+        self._thread = threading.Thread(target=self._run, name="election",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.renew_seconds):
+            self.try_acquire()
+
+    def close(self, release: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if release and self._leader:
+            try:
+                os.unlink(self.lease_path)
+            except OSError:
+                pass
+            self._leader = False

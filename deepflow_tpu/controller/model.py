@@ -1,0 +1,144 @@
+"""Resource model: the controller's source-of-truth tables.
+
+Reference: server/controller/recorder/ reconciles cloud-API and
+genesis-reported snapshots into MySQL resource tables (region/az/host/
+vpc/subnet/pod_node/pod_ns/pod_group/pod/service), and emits change
+events. Here the model is in-memory dataclass tables persisted as one
+JSON document, with the same diff-on-update discipline: update_domain()
+reconciles a full snapshot per domain and reports created/deleted ids so
+resource events and dictionary syncs stay incremental.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+RESOURCE_TYPES = (
+    "region", "az", "host", "vpc", "subnet",
+    "pod_cluster", "pod_node", "pod_ns", "pod_group", "pod", "service",
+)
+
+
+@dataclass(frozen=True)
+class Resource:
+    """One row of any resource table."""
+
+    type: str
+    id: int
+    name: str
+    domain: str = "default"
+    # type-specific links (epc_id for subnets/pods, ip/port for services...)
+    attrs: Tuple[Tuple[str, object], ...] = ()
+
+    def attr(self, key: str, default=None):
+        for k, v in self.attrs:
+            if k == key:
+                return v
+        return default
+
+
+def make_resource(type: str, id: int, name: str, domain: str = "default",
+                  **attrs) -> Resource:
+    return Resource(type, id, name, domain,
+                    tuple(sorted(attrs.items())))
+
+
+@dataclass
+class DomainDiff:
+    created: List[Resource] = field(default_factory=list)
+    deleted: List[Resource] = field(default_factory=list)
+    updated: List[Resource] = field(default_factory=list)
+
+    @property
+    def changed(self) -> bool:
+        return bool(self.created or self.deleted or self.updated)
+
+
+class ResourceModel:
+    """All resource tables + version counter + change subscribers."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self._rows: Dict[Tuple[str, int], Resource] = {}
+        self._lock = threading.Lock()
+        self.version = 1
+        self._subscribers: List[Callable[[DomainDiff], None]] = []
+        if path is not None and os.path.exists(path):
+            self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        with open(self.path) as f:
+            doc = json.load(f)
+        self.version = doc.get("version", 1)
+        for r in doc.get("resources", []):
+            res = Resource(r["type"], r["id"], r["name"], r["domain"],
+                           tuple((k, v) for k, v in r["attrs"]))
+            self._rows[(res.type, res.id)] = res
+
+    def _save(self) -> None:
+        if self.path is None:
+            return
+        doc = {
+            "version": self.version,
+            "resources": [
+                {"type": r.type, "id": r.id, "name": r.name,
+                 "domain": r.domain, "attrs": [list(a) for a in r.attrs]}
+                for r in self._rows.values()
+            ],
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+
+    # -- queries -----------------------------------------------------------
+    def list(self, type: Optional[str] = None,
+             domain: Optional[str] = None) -> List[Resource]:
+        with self._lock:
+            return [r for r in self._rows.values()
+                    if (type is None or r.type == type)
+                    and (domain is None or r.domain == domain)]
+
+    def get(self, type: str, id: int) -> Optional[Resource]:
+        with self._lock:
+            return self._rows.get((type, id))
+
+    # -- updates -----------------------------------------------------------
+    def subscribe(self, fn: Callable[[DomainDiff], None]) -> None:
+        """Called after each update_domain with the diff (reference:
+        recorder/pubsub feeding tagrecorder + resource-event emit)."""
+        self._subscribers.append(fn)
+
+    def update_domain(self, domain: str,
+                      snapshot: List[Resource]) -> DomainDiff:
+        """Reconcile the full snapshot for one domain (reference:
+        recorder.Refresh diff engines, recorder/updater/)."""
+        for r in snapshot:   # validate before any mutation
+            if r.domain != domain:
+                raise ValueError(f"resource {r} not in domain {domain}")
+        diff = DomainDiff()
+        with self._lock:
+            new_keys = {(r.type, r.id) for r in snapshot}
+            for key, old in list(self._rows.items()):
+                if old.domain == domain and key not in new_keys:
+                    del self._rows[key]
+                    diff.deleted.append(old)
+            for r in snapshot:
+                old = self._rows.get((r.type, r.id))
+                if old is None:
+                    diff.created.append(r)
+                elif old != r:
+                    diff.updated.append(r)
+                self._rows[(r.type, r.id)] = r
+            if diff.changed:
+                self.version += 1
+                self._save()
+        if diff.changed:
+            for fn in self._subscribers:
+                fn(diff)
+        return diff

@@ -1,0 +1,150 @@
+"""VTap (agent) registry + group config distribution.
+
+Reference: server/controller/trisolaris/ — agents call Synchronizer.Sync
+with (ctrl_ip, ctrl_mac, host); the controller matches/creates a vtap row,
+assigns vtap_id, and returns the group's RuntimeConfig plus the platform
+data version so the agent knows when to re-pull. Group configs are the
+yaml documents deepflow-ctl agent-group-config CRUDs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+DEFAULT_CONFIG = {
+    # trimmed mirror of the reference RuntimeConfig defaults
+    # (agent/src/config/handler.rs; trident.proto Config)
+    "max_cpus": 1,
+    "max_memory_mb": 768,
+    "sync_interval_s": 60,
+    "stats_interval_s": 10,
+    "log_threshold": 300,
+    "l4_log_tap_types": [0],
+    "l7_log_enabled": True,
+    "capture_bpf": "",
+    "max_collect_pps": 200_000,
+    "throttle_per_s": 50_000,
+}
+
+
+@dataclass
+class VTap:
+    vtap_id: int
+    ctrl_ip: str
+    host: str
+    group: str = "default"
+    created_at: float = field(default_factory=time.time)
+    last_seen: float = field(default_factory=time.time)
+    revision: str = ""
+    boot_count: int = 0
+
+    @property
+    def alive(self) -> bool:
+        return time.time() - self.last_seen < 120
+
+
+class VTapRegistry:
+    """Assigns vtap ids, tracks liveness, versions group configs."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self._vtaps: Dict[str, VTap] = {}      # key = ctrl_ip|host
+        self._configs: Dict[str, dict] = {"default": dict(DEFAULT_CONFIG)}
+        self.config_version = 1
+        self._next_id = 1
+        self._lock = threading.Lock()
+        if path is not None and os.path.exists(path):
+            self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        with open(self.path) as f:
+            doc = json.load(f)
+        self._next_id = doc["next_id"]
+        self.config_version = doc.get("config_version", 1)
+        self._configs = doc.get("configs", self._configs)
+        for v in doc.get("vtaps", []):
+            vt = VTap(**v)
+            self._vtaps[f"{vt.ctrl_ip}|{vt.host}"] = vt
+
+    def _save_locked(self) -> None:
+        if self.path is None:
+            return
+        doc = {
+            "next_id": self._next_id,
+            "config_version": self.config_version,
+            "configs": self._configs,
+            "vtaps": [vars(v) for v in self._vtaps.values()],
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+
+    # -- sync (the agent-facing RPC) ---------------------------------------
+    def sync(self, ctrl_ip: str, host: str, revision: str = "",
+             boot: bool = False) -> dict:
+        """Register-or-refresh; returns the Sync response body
+        (reference: trisolaris synchronize service Sync)."""
+        key = f"{ctrl_ip}|{host}"
+        with self._lock:
+            vt = self._vtaps.get(key)
+            registered = vt is None
+            if vt is None:
+                vt = VTap(vtap_id=self._next_id, ctrl_ip=ctrl_ip, host=host)
+                self._next_id += 1
+                self._vtaps[key] = vt
+            vt.last_seen = time.time()
+            vt.revision = revision
+            if boot:
+                vt.boot_count += 1
+            cfg = self._configs.get(vt.group,
+                                    self._configs["default"])
+            # persist only on membership changes — a heartbeat-only sync
+            # must not rewrite the whole registry file every 60s per agent
+            if registered or boot:
+                self._save_locked()
+            return {
+                "vtap_id": vt.vtap_id,
+                "group": vt.group,
+                "config": cfg,
+                "config_version": self.config_version,
+            }
+
+    # -- fleet management --------------------------------------------------
+    def list(self) -> List[VTap]:
+        with self._lock:
+            return list(self._vtaps.values())
+
+    def set_group(self, ctrl_ip: str, host: str, group: str) -> None:
+        with self._lock:
+            vt = self._vtaps[f"{ctrl_ip}|{host}"]
+            vt.group = group
+            self._save_locked()
+
+    def get_config(self, group: str = "default") -> dict:
+        with self._lock:
+            return dict(self._configs.get(group, self._configs["default"]))
+
+    def set_config(self, group: str, config: dict) -> int:
+        """CRUD for group configs (reference: cli agent-group-config).
+        Unknown keys are rejected so typos don't silently no-op."""
+        bad = set(config) - set(DEFAULT_CONFIG)
+        if bad:
+            raise ValueError(f"unknown config keys: {sorted(bad)}")
+        with self._lock:
+            base = dict(self._configs.get(group, DEFAULT_CONFIG))
+            base.update(config)
+            self._configs[group] = base
+            self.config_version += 1
+            self._save_locked()
+            return self.config_version
+
+    def groups(self) -> List[str]:
+        with self._lock:
+            return sorted(self._configs)

@@ -1,0 +1,200 @@
+"""Controller HTTP API (reference: server/controller/http/ routers).
+
+Agent-facing (the trisolaris sync surface, JSON over HTTP instead of
+gRPC — the reference's message/trident.proto Synchronizer service):
+  POST /v1/sync             {ctrl_ip, host, revision?, boot?}
+                            -> vtap_id, config, config_version,
+                               platform_version, ingester
+  POST /v1/genesis          {ctrl_ip, host, interfaces: [...]}
+
+Ops-facing (driven by the CLI):
+  GET  /v1/vtaps            fleet listing with liveness
+  GET  /v1/vtap-groups      group names
+  GET/POST /v1/vtap-group-config?group=g     config CRUD
+  POST /v1/domains/<name>/resources          full domain snapshot
+  GET  /v1/resources[?type=pod]
+  GET  /v1/platform-data    compiled enrichment tables + version
+  GET  /v1/election         leader status
+  POST /v1/ingesters        {addrs: [...]} membership for rebalancing
+  GET  /v1/assignments
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepflow_tpu.controller.election import Election
+from deepflow_tpu.controller.model import ResourceModel, make_resource
+from deepflow_tpu.controller.monitor import FleetMonitor
+from deepflow_tpu.controller.platform_compiler import compile_platform_data
+from deepflow_tpu.controller.registry import VTapRegistry
+from deepflow_tpu.controller.tagrecorder import TagRecorder
+
+DEFAULT_PORT = 20417   # reference controller HTTP is 20417 in-cluster
+
+
+class ControllerServer:
+    def __init__(self, model: ResourceModel, registry: VTapRegistry,
+                 monitor: Optional[FleetMonitor] = None,
+                 election: Optional[Election] = None,
+                 tagrecorder: Optional[TagRecorder] = None,
+                 genesis_domain: str = "genesis",
+                 port: int = DEFAULT_PORT, host: str = "127.0.0.1") -> None:
+        self.model = model
+        self.registry = registry
+        self.monitor = monitor or FleetMonitor(registry)
+        self.election = election
+        self.tagrecorder = tagrecorder
+        self.genesis_domain = genesis_domain
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length).decode() if length else "{}"
+                return json.loads(raw or "{}")
+
+            def do_GET(self) -> None:
+                try:
+                    url = urllib.parse.urlparse(self.path)
+                    qs = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(url.query).items()}
+                    self._send(200, outer._get(url.path, qs))
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+            def do_POST(self) -> None:
+                try:
+                    url = urllib.parse.urlparse(self.path)
+                    qs = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(url.query).items()}
+                    self._send(200, outer._post(url.path, qs, self._body()))
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing -----------------------------------------------------------
+    def _get(self, path: str, qs: dict):
+        if path == "/v1/vtaps":
+            status = self.monitor.check()
+            return [{**vars(v), "alive": f"{v.ctrl_ip}|{v.host}"
+                     in status["alive"]} for v in self.registry.list()]
+        if path == "/v1/vtap-groups":
+            return self.registry.groups()
+        if path == "/v1/vtap-group-config":
+            return self.registry.get_config(qs.get("group", "default"))
+        if path == "/v1/resources":
+            return [{"type": r.type, "id": r.id, "name": r.name,
+                     "domain": r.domain, **dict(r.attrs)}
+                    for r in self.model.list(type=qs.get("type"))]
+        if path == "/v1/platform-data":
+            ifaces, cidrs, services, version = compile_platform_data(
+                self.model)
+            return {
+                "version": version,
+                "interfaces": [vars(i) for i in ifaces],
+                "cidrs": [vars(c) for c in cidrs],
+                "services": [vars(s) for s in services],
+            }
+        if path == "/v1/election":
+            if self.election is None:
+                return {"leader": True, "identity": "standalone"}
+            return {"leader": self.election.is_leader,
+                    "identity": self.election.identity}
+        if path == "/v1/assignments":
+            return self.monitor.assignments()
+        if path == "/health":
+            return {"status": "ok"}
+        raise KeyError(path)
+
+    def _post(self, path: str, qs: dict, body: dict):
+        if path == "/v1/sync":
+            resp = self.registry.sync(body["ctrl_ip"], body["host"],
+                                      body.get("revision", ""),
+                                      bool(body.get("boot")))
+            resp["platform_version"] = self.model.version
+            resp["ingester"] = self.monitor.assign(body["ctrl_ip"],
+                                                   body["host"])
+            return resp
+        if path == "/v1/genesis":
+            # agent-reported interfaces become host resources in the
+            # genesis domain (reference: controller/genesis sinks); ids
+            # must be restart-stable, so use a content hash, and only
+            # well-formed IPv4 addresses may enter the model (a bad row
+            # would poison every later platform-data compile)
+            import ipaddress
+
+            from deepflow_tpu.store.dict_store import fnv1a32
+            snapshot = []
+            for i, itf in enumerate(body.get("interfaces", [])):
+                try:
+                    ipaddress.IPv4Address(itf["ip"])
+                except (KeyError, ValueError):
+                    continue
+                snapshot.append(make_resource(
+                    "host",
+                    1_000_000 + (fnv1a32(
+                        f"{body['host']}|{itf['ip']}".encode()) & 0xFFFFF),
+                    f"{body['host']}:{itf.get('name', i)}",
+                    domain=self.genesis_domain,
+                    ip=itf["ip"], epc_id=itf.get("epc_id", 0)))
+            diff = self.model.update_domain(self.genesis_domain, snapshot)
+            return {"created": len(diff.created),
+                    "deleted": len(diff.deleted)}
+        if path == "/v1/vtap-group-config":
+            version = self.registry.set_config(qs.get("group", "default"),
+                                               body)
+            return {"config_version": version}
+        if path.startswith("/v1/domains/") and path.endswith("/resources"):
+            domain = path[len("/v1/domains/"):-len("/resources")]
+            snapshot = [make_resource(
+                r["type"], r["id"], r["name"], domain,
+                **{k: v for k, v in r.items()
+                   if k not in ("type", "id", "name", "domain")})
+                for r in body.get("resources", [])]
+            diff = self.model.update_domain(domain, snapshot)
+            return {"created": len(diff.created),
+                    "deleted": len(diff.deleted),
+                    "updated": len(diff.updated),
+                    "version": self.model.version}
+        if path == "/v1/ingesters":
+            self.monitor.set_ingesters(list(body.get("addrs", [])))
+            return {"ingesters": self.monitor.ingesters()}
+        raise KeyError(path)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="controller-http", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -50,9 +50,14 @@ class QueryResult:
 
 class QueryEngine:
     def __init__(self, store: Store,
-                 tag_dicts: Optional[TagDictRegistry] = None) -> None:
+                 tag_dicts: Optional[TagDictRegistry] = None,
+                 tagrecorder=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
+        # controller.tagrecorder.TagRecorder: id->name dimension dicts for
+        # KnowledgeGraph columns (pod_id_0 -> pod name); duck-typed so the
+        # querier runs without a controller
+        self.tagrecorder = tagrecorder
 
     # -- public ------------------------------------------------------------
     def execute(self, sql_text: str, db: Optional[str] = None) -> QueryResult:
@@ -254,7 +259,17 @@ class QueryEngine:
         return rows
 
     def _humanize(self, out_cols: List[str], rows):
-        """Reverse-translate dictionary hash columns to strings."""
+        """Reverse-translate dictionary hash columns to strings, and
+        KnowledgeGraph id columns to resource names (tagrecorder)."""
+        if self.tagrecorder is not None:
+            for j, name in enumerate(out_cols):
+                d = self.tagrecorder.dict_for_column(name)
+                if d is None:
+                    continue
+                id_names = d.snapshot()  # one locked copy per column
+                for r in rows:
+                    if isinstance(r[j], (int, np.integer)):
+                        r[j] = id_names.get(int(r[j]), r[j])
         if self.tag_dicts is None:
             return rows
         for j, name in enumerate(out_cols):

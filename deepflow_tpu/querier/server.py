@@ -26,8 +26,9 @@ DEFAULT_PORT = 20416   # reference querier listens on 20416
 
 class QuerierServer:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
-                 port: int = DEFAULT_PORT, host: str = "127.0.0.1") -> None:
-        self.engine = QueryEngine(store, tag_dicts)
+                 port: int = DEFAULT_PORT, host: str = "127.0.0.1",
+                 tagrecorder=None) -> None:
+        self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder)
         self.prom = PromEngine(store, tag_dicts)
         outer = self
 

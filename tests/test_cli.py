@@ -1,0 +1,88 @@
+"""CLI end-to-end against live controller + querier servers."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.cli import main
+from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                     VTapRegistry)
+from deepflow_tpu.controller.monitor import FleetMonitor
+from deepflow_tpu.querier.server import QuerierServer
+from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+from deepflow_tpu.store.dict_store import TagDictRegistry
+
+
+@pytest.fixture
+def stack(tmp_path):
+    model = ResourceModel()
+    reg = VTapRegistry()
+    reg.sync("10.0.0.1", "node-1", revision="v1.0")
+    srv = ControllerServer(model, reg, FleetMonitor(reg), port=0)
+    srv.start()
+
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_log", TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(10, dtype=np.uint32),
+              "bytes": np.full(10, 7, np.uint32)})
+    qsrv = QuerierServer(store, TagDictRegistry(None), port=0)
+    qsrv.start()
+    yield srv, qsrv
+    qsrv.close()
+    srv.close()
+
+
+def _run(capsys, *argv):
+    rc = main(list(argv))
+    return rc, capsys.readouterr().out
+
+
+def test_cli_agent_list(stack, capsys):
+    srv, _ = stack
+    rc, out = _run(capsys, "--controller",
+                   f"http://127.0.0.1:{srv.port}", "agent", "list")
+    assert rc == 0
+    assert "node-1" in out and "ALIVE" in out
+
+
+def test_cli_group_config_roundtrip(stack, capsys):
+    srv, _ = stack
+    base = f"http://127.0.0.1:{srv.port}"
+    rc, _ = _run(capsys, "--controller", base, "agent-group-config",
+                 "--set", "max_cpus=8")
+    assert rc == 0
+    rc, out = _run(capsys, "--controller", base, "agent-group-config")
+    assert json.loads(out)["max_cpus"] == 8
+
+
+def test_cli_query(stack, capsys):
+    _, qsrv = stack
+    rc, out = _run(capsys, "--querier", f"http://127.0.0.1:{qsrv.port}",
+                   "query", "SELECT Sum(bytes) AS total FROM flows",
+                   "-d", "flow_log")
+    assert rc == 0
+    assert "70" in out
+
+
+def test_cli_query_error(stack, capsys):
+    _, qsrv = stack
+    rc = main(["--querier", f"http://127.0.0.1:{qsrv.port}",
+               "query", "SELECT nope FROM missing"])
+    assert rc == 1
+
+
+def test_cli_domain_and_resources(stack, capsys, tmp_path):
+    srv, _ = stack
+    base = f"http://127.0.0.1:{srv.port}"
+    snap = tmp_path / "resources.json"
+    snap.write_text(json.dumps([
+        {"type": "pod", "id": 1, "name": "p1", "ip": "10.0.0.9"}]))
+    rc, out = _run(capsys, "--controller", base, "domain", "k8s",
+                   "-f", str(snap))
+    assert rc == 0 and json.loads(out)["created"] == 1
+    rc, out = _run(capsys, "--controller", base, "resource", "--type", "pod")
+    assert rc == 0 and "p1" in out

@@ -1,0 +1,202 @@
+"""Controller: model diffs, registry, tagrecorder, platform push, election,
+rebalancing, HTTP API."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                     VTapRegistry)
+from deepflow_tpu.controller.election import Election
+from deepflow_tpu.controller.model import make_resource
+from deepflow_tpu.controller.monitor import FleetMonitor
+from deepflow_tpu.controller.platform_compiler import PlatformPusher
+from deepflow_tpu.controller.tagrecorder import TagRecorder
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+
+
+def _pods(domain="k8s"):
+    return [
+        make_resource("region", 1, "us-east", domain),
+        make_resource("pod", 10, "web-0", domain, ip="10.0.0.5", epc_id=3,
+                      region_id=1, pod_ns_id=30),
+        make_resource("pod", 11, "web-1", domain, ip="10.0.0.6", epc_id=3,
+                      region_id=1, pod_ns_id=30),
+        make_resource("service", 40, "web-svc", domain, ip="10.0.0.100",
+                      port=80, protocol=6, epc_id=3),
+        make_resource("subnet", 50, "pods-net", domain, cidr="10.0.0.0/16",
+                      epc_id=3, region_id=1),
+    ]
+
+
+def test_model_diff_and_persistence(tmp_path):
+    path = str(tmp_path / "model.json")
+    model = ResourceModel(path)
+    d1 = model.update_domain("k8s", _pods())
+    assert len(d1.created) == 5 and model.version == 2
+    # idempotent re-apply
+    d2 = model.update_domain("k8s", _pods())
+    assert not d2.changed and model.version == 2
+    # delete one, rename another
+    snap = _pods()[:-1]
+    snap[1] = make_resource("pod", 10, "web-0-renamed", "k8s", ip="10.0.0.5",
+                            epc_id=3, region_id=1, pod_ns_id=30)
+    d3 = model.update_domain("k8s", snap)
+    assert [r.id for r in d3.deleted] == [50]
+    assert [r.name for r in d3.updated] == ["web-0-renamed"]
+    # reload from disk
+    model2 = ResourceModel(path)
+    assert model2.version == model.version
+    assert model2.get("pod", 10).name == "web-0-renamed"
+
+
+def test_registry_sync_and_config(tmp_path):
+    reg = VTapRegistry(str(tmp_path / "vtaps.json"))
+    r1 = reg.sync("10.1.1.1", "node-a", boot=True)
+    r2 = reg.sync("10.1.1.2", "node-b")
+    assert r1["vtap_id"] == 1 and r2["vtap_id"] == 2
+    assert reg.sync("10.1.1.1", "node-a")["vtap_id"] == 1  # stable
+    v = reg.set_config("default", {"max_cpus": 4})
+    assert reg.sync("10.1.1.1", "node-a")["config"]["max_cpus"] == 4
+    assert reg.sync("10.1.1.1", "node-a")["config_version"] == v
+    with pytest.raises(ValueError):
+        reg.set_config("default", {"not_a_key": 1})
+    # persistence
+    reg2 = VTapRegistry(str(tmp_path / "vtaps.json"))
+    assert reg2.sync("10.1.1.1", "node-a")["vtap_id"] == 1
+    assert reg2.get_config()["max_cpus"] == 4
+
+
+def test_tagrecorder_and_humanize(tmp_path):
+    model = ResourceModel()
+    tr = TagRecorder(model, root=str(tmp_path))
+    model.update_domain("k8s", _pods())
+    assert tr.name("pod", 10) == "web-0"
+    assert tr.column_name("pod_id_0", 11) == "web-1"
+    assert tr.column_name("region_id_1", 1) == "us-east"
+    # deletions drop dictionary entries
+    model.update_domain("k8s", _pods()[:2])
+    assert tr.name("pod", 11) is None
+    # persistence across restart
+    tr2 = TagRecorder(ResourceModel(), root=str(tmp_path))
+    assert tr2.name("pod", 10) == "web-0"
+
+
+def test_platform_push_stamps_ingest():
+    model = ResourceModel()
+    mgr = PlatformDataManager()
+    PlatformPusher(model, mgr)
+    model.update_domain("k8s", _pods())
+    cols = {
+        "l3_epc_id": np.array([3, 3], np.int32),
+        "ip_src": np.array([int(np.uint32(0x0A000005)),  # 10.0.0.5 pod
+                            int(np.uint32(0x0A00FF01))], np.uint32),
+        "ip_dst": np.array([int(np.uint32(0x0A000064))] * 2, np.uint32),
+        "port_dst": np.array([80, 80], np.uint32),
+        "proto": np.array([6, 6], np.uint32),
+    }
+    out = mgr.stamp_l4(cols)
+    assert out["pod_id_0"].tolist() == [10, 0]
+    assert out["region_id_0"].tolist() == [1, 1]   # second via subnet CIDR
+    assert out["service_id_1"].tolist() == [40, 40]
+
+
+def test_election_takeover(tmp_path):
+    lease = str(tmp_path / "lease.json")
+    a = Election(lease)
+    b = Election(lease)
+    assert a.try_acquire(now=100.0)
+    assert not b.try_acquire(now=101.0)   # lease held and fresh
+    assert b.try_acquire(now=100.0 + 16)  # stale -> takeover
+    assert not a.try_acquire(now=100.0 + 17)  # a sees it lost
+    assert not a.is_leader and b.is_leader
+
+
+def test_rendezvous_rebalance():
+    reg = VTapRegistry()
+    for i in range(50):
+        reg.sync(f"10.0.0.{i}", f"node-{i}")
+    mon = FleetMonitor(reg)
+    mon.set_ingesters(["ing-a:30033", "ing-b:30033", "ing-c:30033"])
+    before = {f"10.0.0.{i}|node-{i}": mon.assign(f"10.0.0.{i}", f"node-{i}")
+              for i in range(50)}
+    counts = {a: list(before.values()).count(a) for a in mon.ingesters()}
+    assert all(c > 5 for c in counts.values())  # roughly spread
+    # removing one ingester moves ONLY its agents
+    mon.set_ingesters(["ing-a:30033", "ing-c:30033"])
+    for key, old in before.items():
+        ip, host = key.split("|")
+        new = mon.assign(ip, host)
+        if old != "ing-b:30033":
+            assert new == old
+
+
+def test_querier_humanizes_kg_columns(tmp_path):
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+
+    model = ResourceModel()
+    tr = TagRecorder(model)
+    model.update_domain("k8s", _pods())
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_log", TableSchema(
+        name="l4", columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("pod_id_0", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.array([1, 2], np.uint32),
+              "pod_id_0": np.array([10, 11], np.uint32),
+              "bytes": np.array([5, 6], np.uint32)})
+    eng = QueryEngine(store, tagrecorder=tr)
+    res = eng.execute("SELECT pod_id_0, Sum(bytes) AS b FROM l4 "
+                      "GROUP BY pod_id_0 ORDER BY b")
+    assert res.values == [["web-0", 5], ["web-1", 6]]
+
+
+def _req(port, path, body=None, qs=""):
+    url = f"http://127.0.0.1:{port}{path}{qs}"
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.load(resp)
+
+
+def test_controller_http_api(tmp_path):
+    model = ResourceModel()
+    reg = VTapRegistry()
+    mon = FleetMonitor(reg)
+    srv = ControllerServer(model, reg, mon, port=0)
+    srv.start()
+    try:
+        p = srv.port
+        _req(p, "/v1/ingesters", {"addrs": ["127.0.0.1:30033"]})
+        r = _req(p, "/v1/sync", {"ctrl_ip": "10.9.9.9", "host": "n1",
+                                 "boot": True})
+        assert r["vtap_id"] == 1
+        assert r["ingester"] == "127.0.0.1:30033"
+        assert r["config"]["max_cpus"] == 1
+        # group config CRUD
+        _req(p, "/v1/vtap-group-config", {"max_cpus": 8},
+             qs="?group=default")
+        assert _req(p, "/v1/vtap-group-config",
+                    qs="?group=default")["max_cpus"] == 8
+        # domain snapshot + platform data
+        _req(p, "/v1/domains/k8s/resources", {"resources": [
+            {"type": "pod", "id": 10, "name": "web-0", "ip": "10.0.0.5",
+             "epc_id": 3}]})
+        pd = _req(p, "/v1/platform-data")
+        assert pd["version"] == model.version
+        assert pd["interfaces"][0]["pod_id"] == 10
+        # genesis interface report
+        g = _req(p, "/v1/genesis", {
+            "ctrl_ip": "10.9.9.9", "host": "n1",
+            "interfaces": [{"name": "eth0", "ip": "10.9.9.9"}]})
+        assert g["created"] == 1
+        vtaps = _req(p, "/v1/vtaps")
+        assert vtaps[0]["alive"] is True
+    finally:
+        srv.close()
