@@ -1,0 +1,18 @@
+"""Agent: the capture-side pipeline, re-designed batch-columnar.
+
+Reference: agent/ (Rust) — dispatcher pulls packets, FlowMap turns them
+into TaggedFlows with TCP perf stats, protocol parsers extract L7
+request logs, the quadruple generator folds flows into 1s metric
+Documents, and UniformSender ships everything to the ingester
+(SURVEY.md §2.1, §3.2). The re-design replaces the per-packet hash-table
+hot loop with batch columnar processing: packets decode into
+structure-of-arrays, flows aggregate by segment reduction (the same
+device-friendly GROUP BY the server uses), and cross-batch flow state
+lives in mergeable per-flow accumulators.
+"""
+
+from deepflow_tpu.agent.packet import decode_packets
+from deepflow_tpu.agent.flow_map import FlowMap
+from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+__all__ = ["decode_packets", "FlowMap", "Agent", "AgentConfig"]

@@ -1,0 +1,227 @@
+"""L7 protocol parsers: payload bytes -> request/response log records.
+
+Reference: agent/src/flow_generator/protocol_logs/ — per-protocol
+check_payload/parse_payload trait objects dispatched over an enum
+(agent/src/common/l7_protocol_log.rs:162-219), feeding a session
+aggregator that merges request+response by stream. The re-design keeps
+the same two-phase contract (cheap check, then parse) as plain Python
+classes in a registry; parsers run host-side on the payload slices the
+batched packet decoder exposes, and their output is already the columnar
+L7 record shape.
+
+Protocol ids follow the reference's L7Protocol enum: HTTP1=20, DNS=120,
+MySQL=60, Redis=80.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+import threading
+from dataclasses import dataclass
+from typing import ClassVar, List, Optional
+
+L7_HTTP1 = 20
+L7_MYSQL = 60
+L7_REDIS = 80
+L7_DNS = 120
+
+MSG_REQUEST = 0
+MSG_RESPONSE = 1
+
+
+@dataclass
+class L7Record:
+    proto: int
+    msg_type: int           # MSG_REQUEST / MSG_RESPONSE
+    endpoint: str = ""      # method+path / query name / statement verb
+    status: int = 0         # protocol status code
+    req_len: int = 0
+    resp_len: int = 0
+
+
+class HttpParser:
+    """HTTP/1.x (reference: protocol_logs/http.rs)."""
+
+    proto: ClassVar[int] = L7_HTTP1
+    _METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ",
+                b"OPTIONS ", b"PATCH ")
+
+    def check(self, payload: bytes) -> bool:
+        return payload.startswith(self._METHODS) or \
+            payload.startswith(b"HTTP/1.")
+
+    def parse(self, payload: bytes) -> Optional[L7Record]:
+        try:
+            line, _, _ = payload.partition(b"\r\n")
+            parts = line.decode("latin-1").split(" ", 2)
+        except Exception:
+            return None
+        if payload.startswith(b"HTTP/1."):
+            if len(parts) < 2 or not parts[1][:3].isdigit():
+                return None
+            return L7Record(self.proto, MSG_RESPONSE,
+                            status=int(parts[1][:3]),
+                            resp_len=len(payload))
+        if len(parts) < 3 or not parts[2].startswith("HTTP/"):
+            return None
+        path = parts[1].split("?", 1)[0]
+        return L7Record(self.proto, MSG_REQUEST,
+                        endpoint=f"{parts[0]} {path}", req_len=len(payload))
+
+
+class DnsParser:
+    """DNS over UDP (reference: protocol_logs/dns.rs)."""
+
+    proto: ClassVar[int] = L7_DNS
+
+    def check(self, payload: bytes) -> bool:
+        if len(payload) < 12:
+            return False
+        qd = struct.unpack_from(">H", payload, 4)[0]
+        return 1 <= qd <= 4
+
+    def parse(self, payload: bytes) -> Optional[L7Record]:
+        if len(payload) < 12:
+            return None
+        flags = struct.unpack_from(">H", payload, 2)[0]
+        is_resp = bool(flags & 0x8000)
+        rcode = flags & 0x000F
+        # parse the first question name
+        labels = []
+        off = 12
+        try:
+            while off < len(payload):
+                ln = payload[off]
+                if ln == 0 or ln >= 0xC0:
+                    break
+                labels.append(payload[off + 1:off + 1 + ln]
+                              .decode("latin-1"))
+                off += 1 + ln
+        except IndexError:
+            return None
+        name = ".".join(labels)
+        if is_resp:
+            return L7Record(self.proto, MSG_RESPONSE, endpoint=name,
+                            status=rcode, resp_len=len(payload))
+        return L7Record(self.proto, MSG_REQUEST, endpoint=name,
+                        req_len=len(payload))
+
+
+class RedisParser:
+    """RESP protocol (reference: protocol_logs/sql/redis.rs)."""
+
+    proto: ClassVar[int] = L7_REDIS
+
+    def check(self, payload: bytes) -> bool:
+        return len(payload) > 2 and payload[:1] in b"*+-:$"
+
+    def parse(self, payload: bytes) -> Optional[L7Record]:
+        head = payload[:1]
+        if head == b"*":
+            # array of bulk strings: first element is the command
+            m = re.match(rb"\*\d+\r\n\$\d+\r\n([A-Za-z]+)", payload)
+            cmd = m.group(1).decode().upper() if m else ""
+            return L7Record(self.proto, MSG_REQUEST, endpoint=cmd,
+                            req_len=len(payload))
+        if head == b"-":
+            return L7Record(self.proto, MSG_RESPONSE, status=1,
+                            resp_len=len(payload))
+        if head in (b"+", b":", b"$"):
+            return L7Record(self.proto, MSG_RESPONSE, status=0,
+                            resp_len=len(payload))
+        return None
+
+
+class MysqlParser:
+    """MySQL client/server packets (reference: protocol_logs/sql/mysql.rs).
+    Command packets: 3-byte length + seq + command byte; COM_QUERY=3."""
+
+    proto: ClassVar[int] = L7_MYSQL
+    _VERBS = re.compile(rb"^\s*(SELECT|INSERT|UPDATE|DELETE|CREATE|DROP|"
+                        rb"ALTER|BEGIN|COMMIT|SET|SHOW)", re.IGNORECASE)
+
+    def check(self, payload: bytes) -> bool:
+        if len(payload) < 5:
+            return False
+        ln = int.from_bytes(payload[:3], "little")
+        return ln + 4 == len(payload) and payload[3] in (0, 1)
+
+    def parse(self, payload: bytes) -> Optional[L7Record]:
+        if len(payload) < 5:
+            return None
+        cmd = payload[4]
+        if payload[3] == 0 and cmd == 3:        # COM_QUERY request
+            m = self._VERBS.match(payload[5:])
+            verb = m.group(1).decode().upper() if m else "QUERY"
+            return L7Record(self.proto, MSG_REQUEST, endpoint=verb,
+                            req_len=len(payload))
+        if payload[3] == 1:                      # first response packet
+            status = 1 if cmd == 0xFF else 0     # ERR header
+            return L7Record(self.proto, MSG_RESPONSE, status=status,
+                            resp_len=len(payload))
+        return None
+
+
+PARSERS = (HttpParser(), DnsParser(), MysqlParser(), RedisParser())
+
+
+def parse_payload(payload: bytes) -> Optional[L7Record]:
+    """Two-phase dispatch: first parser whose cheap check passes wins
+    (reference: check_payload ordering in l7_protocol_log.rs)."""
+    for p in PARSERS:
+        if p.check(payload):
+            rec = p.parse(payload)
+            if rec is not None:
+                return rec
+    return None
+
+
+class SessionAggregator:
+    """Merge request+response halves per (flow, stream) within a time
+    window (reference: protocol_logs/parser.rs SessionAggregator :737).
+    Emits merged L7Records with round-trip time filled in."""
+
+    def __init__(self, window_ns: int = 60 * 1_000_000_000) -> None:
+        self.window_ns = window_ns
+        self._pending: dict = {}
+        # offer() runs on the capture thread, expire() on the tick loop
+        self._lock = threading.Lock()
+        self.merged = 0
+        self.unpaired = 0
+
+    def offer(self, flow_key: tuple, rec: L7Record,
+              ts_ns: int) -> Optional[dict]:
+        """Returns a merged session dict when a pair completes."""
+        key = (flow_key, rec.proto)
+        if rec.msg_type == MSG_REQUEST:
+            with self._lock:
+                self._pending[key] = (rec, ts_ns)
+            return None
+        with self._lock:
+            req = self._pending.pop(key, None)
+        if req is None:
+            self.unpaired += 1
+            return {"proto": rec.proto, "endpoint": rec.endpoint,
+                    "status": rec.status, "rrt_us": 0,
+                    "req_len": 0, "resp_len": rec.resp_len}
+        req_rec, req_ts = req
+        self.merged += 1
+        return {
+            "proto": rec.proto,
+            "endpoint": req_rec.endpoint or rec.endpoint,
+            "status": rec.status,
+            "rrt_us": max(ts_ns - req_ts, 0) // 1000,
+            "req_len": req_rec.req_len,
+            "resp_len": rec.resp_len,
+        }
+
+    def expire(self, now_ns: int) -> int:
+        """Drop requests that never saw a response within the window."""
+        with self._lock:
+            stale = [k for k, (_, ts) in self._pending.items()
+                     if now_ns - ts > self.window_ns]
+            for k in stale:
+                del self._pending[k]
+        self.unpaired += len(stale)
+        return len(stale)

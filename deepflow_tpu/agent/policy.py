@@ -1,0 +1,75 @@
+"""Policy labeler: vectorized ACL matching over packet batches.
+
+Reference: agent/src/policy/ — first_path (full ACL walk) + fast_path
+(LRU cache) label every packet with matched policy ids. Batched columns
+make the cache unnecessary: each rule is one vectorized predicate over
+the whole batch, and the match matrix reduces to a first-match rule id
+per packet. Rules express (ip prefix, port range, protocol) on either
+side, the subset the reference's NPB/PCAP ACLs use on the hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class AclRule:
+    rule_id: int
+    # 0 in any field = wildcard
+    ip_prefix: int = 0
+    ip_mask_len: int = 0        # applies to either src or dst
+    port_min: int = 0
+    port_max: int = 0           # either src or dst port in range
+    protocol: int = 0
+    action: int = 1             # 1 = capture/export (NPB), 2 = drop
+
+
+class PolicyLabeler:
+    def __init__(self, rules: Optional[List[AclRule]] = None) -> None:
+        self.rules: List[AclRule] = list(rules or [])
+        self.version = 0
+        self.lookups = 0
+        self.hits = 0
+
+    def update(self, rules: List[AclRule], version: int) -> bool:
+        if version == self.version:
+            return False
+        self.rules = list(rules)
+        self.version = version
+        return True
+
+    def lookup(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+        """[n] int32 first-matching rule id (0 = no policy)."""
+        n = len(cols["ip_src"])
+        self.lookups += n
+        out = np.zeros(n, np.int32)
+        unmatched = np.ones(n, np.bool_)
+        for r in self.rules:
+            if not unmatched.any():
+                break
+            m = unmatched.copy()
+            if r.ip_mask_len:
+                mask = np.uint32((0xFFFFFFFF << (32 - r.ip_mask_len))
+                                 & 0xFFFFFFFF)
+                prefix = np.uint32(r.ip_prefix) & mask
+                m &= ((cols["ip_src"] & mask) == prefix) | \
+                     ((cols["ip_dst"] & mask) == prefix)
+            if r.port_max:
+                m &= ((cols["port_src"] >= r.port_min)
+                      & (cols["port_src"] <= r.port_max)) | \
+                     ((cols["port_dst"] >= r.port_min)
+                      & (cols["port_dst"] <= r.port_max))
+            if r.protocol:
+                m &= cols["proto"] == r.protocol
+            out[m] = r.rule_id
+            unmatched &= ~m
+        self.hits += int((out != 0).sum())
+        return out
+
+    def counters(self) -> dict:
+        return {"rules": len(self.rules), "version": self.version,
+                "lookups": self.lookups, "hits": self.hits}

@@ -1,0 +1,117 @@
+"""UniformSender: framed record batches -> ingester TCP firehose.
+
+Reference: agent/src/sender/uniform_sender.rs — one sender per message
+type, batching pb records under BaseHeader+FlowHeader frames with a
+per-type sequence counter, reconnecting TCP. The framing/codec modules
+are shared with the server side, so this is the thin socket half.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from deepflow_tpu.wire.codec import pack_pb_records
+from deepflow_tpu.wire.framing import (MESSAGE_FRAME_SIZE_MAX, FlowHeader,
+                                       MessageType, encode_frame)
+
+# keep payloads comfortably under the wire max
+_BATCH_BYTES = MESSAGE_FRAME_SIZE_MAX - 4096
+
+
+class UniformSender:
+    """One message type, one connection, sequenced frames."""
+
+    def __init__(self, msg_type: MessageType, addr: str, vtap_id: int = 0,
+                 reconnect_interval: float = 2.0) -> None:
+        self.msg_type = msg_type
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.vtap_id = vtap_id
+        self.reconnect_interval = reconnect_interval
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._last_attempt = 0.0
+        self.sent_frames = 0
+        self.sent_records = 0
+        self.dropped_records = 0
+
+    def set_target(self, addr: str) -> None:
+        """Re-point at a different ingester (controller rebalancing)."""
+        host, _, port = addr.rpartition(":")
+        with self._lock:
+            if (host or "127.0.0.1", int(port)) == (self.host, self.port):
+                return
+            self.host, self.port = host or "127.0.0.1", int(port)
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _connect_locked(self) -> bool:
+        if self._sock is not None:
+            return True
+        now = time.time()
+        if now - self._last_attempt < self.reconnect_interval:
+            return False
+        self._last_attempt = now
+        try:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=5)
+            return True
+        except OSError:
+            self._sock = None
+            return False
+
+    def send(self, records: List[bytes]) -> int:
+        """Frame + send; returns records sent (drops on no connection —
+        the reference's queues also shed under backpressure, observably)."""
+        if not records:
+            return 0
+        sent = 0
+        with self._lock:
+            if not self._connect_locked():
+                self.dropped_records += len(records)
+                return 0
+            batch: List[bytes] = []
+            size = 0
+            for rec in records + [None]:
+                if rec is not None and size + len(rec) + 4 < _BATCH_BYTES:
+                    batch.append(rec)
+                    size += len(rec) + 4
+                    continue
+                if batch:
+                    self._seq += 1
+                    frame = encode_frame(
+                        self.msg_type, pack_pb_records(batch),
+                        FlowHeader(sequence=self._seq,
+                                   vtap_id=self.vtap_id))
+                    try:
+                        self._sock.sendall(frame)
+                        sent += len(batch)
+                        self.sent_frames += 1
+                    except OSError:
+                        self._close_locked()
+                        self.dropped_records += len(records) - sent
+                        break
+                batch, size = ([rec], len(rec) + 4) if rec is not None \
+                    else ([], 0)
+        self.sent_records += sent
+        return sent
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def counters(self) -> dict:
+        return {"sent_frames": self.sent_frames,
+                "sent_records": self.sent_records,
+                "dropped_records": self.dropped_records}

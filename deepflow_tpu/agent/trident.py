@@ -1,0 +1,276 @@
+"""Agent orchestrator (reference: agent/src/trident.rs + rpc/synchronizer).
+
+Builds the capture-side pipeline — packet decode, policy labeler, flow
+map, L7 session parsing, quadruple generator, uniform senders — and runs
+the control loops: a controller sync heartbeat that registers the agent,
+hot-applies pushed config (reference: ConfigHandler diff/apply), follows
+ingester reassignment, and escapes to safe defaults when the controller
+goes silent; plus the 1s tick that flushes flows and metric documents
+onto the firehose.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepflow_tpu.agent.flow_map import FlowMap, flows_to_columns
+from deepflow_tpu.agent.guard import EscapeTimer, Guard
+from deepflow_tpu.agent.l7 import (MSG_REQUEST, SessionAggregator,
+                                   parse_payload)
+from deepflow_tpu.agent.packet import PROTO_TCP, PROTO_UDP, decode_packets
+from deepflow_tpu.agent.policy import PolicyLabeler
+from deepflow_tpu.agent.quadruple import (documents_to_records,
+                                          flows_to_documents)
+from deepflow_tpu.agent.sender import UniformSender
+from deepflow_tpu.wire.framing import MessageType
+from deepflow_tpu.wire.gen import flow_log_pb2
+
+
+@dataclass
+class AgentConfig:
+    ctrl_ip: str = "127.0.0.1"
+    host: str = "agent-host"
+    controller_url: Optional[str] = None      # None = standalone mode
+    ingester_addr: str = "127.0.0.1:30033"
+    sync_interval_s: float = 60.0
+    escape_after_s: float = 300.0
+    revision: str = "deepflow-tpu-agent"
+    l7_enabled: bool = True
+
+
+def columns_to_l4_records(cols: Dict[str, np.ndarray]) -> List[bytes]:
+    """Serialize tick flow columns as TaggedFlow wire records."""
+    out: List[bytes] = []
+    for i in range(len(cols["ip_src"])):
+        m = flow_log_pb2.TaggedFlow()
+        f = m.flow
+        k = f.flow_key
+        k.vtap_id = int(cols["vtap_id"][i])
+        k.ip_src = int(cols["ip_src"][i])
+        k.ip_dst = int(cols["ip_dst"][i])
+        k.port_src = int(cols["port_src"][i])
+        k.port_dst = int(cols["port_dst"][i])
+        k.proto = int(cols["proto"][i])
+        src = f.metrics_peer_src
+        src.byte_count = int(cols["byte_tx"][i])
+        src.packet_count = int(cols["packet_tx"][i])
+        src.l3_epc_id = int(cols["l3_epc_id"][i])
+        dst = f.metrics_peer_dst
+        dst.byte_count = int(cols["byte_rx"][i])
+        dst.packet_count = int(cols["packet_rx"][i])
+        f.flow_id = int(cols["flow_id"][i])
+        f.start_time = int(cols["start_time"][i])
+        f.duration = int(cols["duration"][i])
+        f.end_time = f.start_time + f.duration
+        f.close_type = int(cols["close_type"][i])
+        f.tap_side = int(cols["tap_side"][i])
+        f.is_new_flow = int(cols["is_new_flow"][i])
+        f.eth_type = 0x0800
+        if cols["rtt"][i] or cols["retrans"][i]:
+            f.has_perf_stats = 1
+            f.perf_stats.l4_protocol = 1
+            f.perf_stats.tcp.rtt = int(cols["rtt"][i])
+            f.perf_stats.tcp.total_retrans_count = int(cols["retrans"][i])
+        out.append(m.SerializeToString())
+    return out
+
+
+def _l7_record_bytes(flow, rec_dict: dict, ts_ns: int,
+                     vtap_id: int) -> bytes:
+    m = flow_log_pb2.AppProtoLogsData()
+    b = m.base
+    b.start_time = ts_ns
+    b.vtap_id = vtap_id
+    b.ip_src, b.ip_dst = int(flow[0]), int(flow[1])
+    b.port_src, b.port_dst = int(flow[2]), int(flow[3])
+    b.protocol = int(flow[4])
+    b.head.proto = rec_dict["proto"]
+    b.head.msg_type = 1
+    b.head.rrt = rec_dict["rrt_us"] * 1000
+    m.req.endpoint = rec_dict["endpoint"]
+    m.resp.status = rec_dict["status"]
+    m.req_len = rec_dict["req_len"]
+    m.resp_len = rec_dict["resp_len"]
+    return m.SerializeToString()
+
+
+class Agent:
+    """Standalone or managed capture agent."""
+
+    def __init__(self, cfg: AgentConfig) -> None:
+        self.cfg = cfg
+        self.vtap_id = 0
+        self.flow_map = FlowMap()
+        self.policy = PolicyLabeler()
+        self.sessions = SessionAggregator()
+        self.guard = Guard()
+        self.escape = EscapeTimer(cfg.escape_after_s, self._on_escape)
+        self.senders: Dict[MessageType, UniformSender] = {
+            mt: UniformSender(mt, cfg.ingester_addr)
+            for mt in (MessageType.TAGGEDFLOW, MessageType.METRICS,
+                       MessageType.PROTOCOLLOG)
+        }
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._l7_out: List[bytes] = []
+        self.escaped = False
+        self.config_version = 0
+
+    # -- control plane -----------------------------------------------------
+    def sync_once(self) -> bool:
+        """One controller round trip (reference: Synchronizer.Sync)."""
+        if self.cfg.controller_url is None:
+            return True
+        body = json.dumps({"ctrl_ip": self.cfg.ctrl_ip,
+                           "host": self.cfg.host,
+                           "revision": self.cfg.revision,
+                           "boot": self.vtap_id == 0}).encode()
+        req = urllib.request.Request(
+            f"{self.cfg.controller_url}/v1/sync", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                r = json.load(resp)
+        except Exception:
+            return False
+        self.vtap_id = r["vtap_id"]
+        self.flow_map.vtap_id = r["vtap_id"]
+        for s in self.senders.values():
+            s.vtap_id = r["vtap_id"]
+        if r.get("ingester"):
+            for s in self.senders.values():
+                s.set_target(r["ingester"])
+        if r["config_version"] != self.config_version:
+            self._apply_config(r["config"])
+            self.config_version = r["config_version"]
+        self.escape.on_sync_ok()
+        self.escaped = False
+        return True
+
+    def _apply_config(self, cfg: dict) -> None:
+        """Hot-apply pushed RuntimeConfig (reference: ConfigHandler)."""
+        self.guard.set_limits(cfg.get("max_memory_mb", 768),
+                              cfg.get("max_cpus", 1))
+        self.cfg.l7_enabled = bool(cfg.get("l7_log_enabled", True))
+        self.cfg.sync_interval_s = cfg.get("sync_interval_s", 60)
+
+    def _on_escape(self) -> None:
+        """Controller silent too long: fall back to conservative defaults
+        (reference: escape timer -> safe RuntimeConfig)."""
+        self.escaped = True
+        self.cfg.l7_enabled = False
+
+    # -- data plane --------------------------------------------------------
+    def feed(self, frames: List[bytes],
+             timestamps_ns: Optional[np.ndarray] = None) -> int:
+        """Ingest one capture batch; returns valid packets."""
+        pkt = decode_packets(frames, timestamps_ns)
+        with self._lock:
+            self.flow_map.inject(pkt)
+        if self.cfg.l7_enabled:
+            self._parse_l7(frames, pkt)
+        return int(pkt["valid"].sum())
+
+    def _parse_l7(self, frames: List[bytes],
+                  pkt: Dict[str, np.ndarray]) -> None:
+        candidates = np.nonzero(
+            pkt["valid"] & (pkt["payload_len"] > 0)
+            & ((pkt["proto"] == PROTO_TCP) | (pkt["proto"] == PROTO_UDP))
+        )[0]
+        for i in candidates:
+            payload = frames[i][int(pkt["payload_off"][i]):]
+            rec = parse_payload(payload)
+            if rec is None:
+                continue
+            # session key is direction-agnostic
+            key = tuple(sorted([(int(pkt["ip_src"][i]),
+                                 int(pkt["port_src"][i])),
+                                (int(pkt["ip_dst"][i]),
+                                 int(pkt["port_dst"][i]))]))
+            # the merged record is emitted on the RESPONSE packet, whose
+            # src is the server — orient the log client->server
+            if rec.msg_type == MSG_REQUEST:
+                flow = (pkt["ip_src"][i], pkt["ip_dst"][i],
+                        pkt["port_src"][i], pkt["port_dst"][i],
+                        pkt["proto"][i])
+            else:
+                flow = (pkt["ip_dst"][i], pkt["ip_src"][i],
+                        pkt["port_dst"][i], pkt["port_src"][i],
+                        pkt["proto"][i])
+            merged = self.sessions.offer((key, int(pkt["proto"][i])), rec,
+                                         int(pkt["timestamp_ns"][i]))
+            if merged is not None:
+                with self._lock:
+                    self._l7_out.append(_l7_record_bytes(
+                        flow, merged, int(pkt["timestamp_ns"][i]),
+                        self.vtap_id))
+
+    def tick(self, now_ns: Optional[int] = None) -> dict:
+        """1s flush: flows -> TAGGEDFLOW, documents -> METRICS,
+        sessions -> PROTOCOLLOG."""
+        now_ns = int(time.time() * 1e9) if now_ns is None else now_ns
+        with self._lock:
+            flows = self.flow_map.tick(now_ns)
+            l7_records, self._l7_out = self._l7_out, []
+        sent = {"flows": 0, "documents": 0, "l7": 0}
+        if flows:
+            cols = flows_to_columns(flows, self.vtap_id, now_ns)
+            records = columns_to_l4_records(cols)
+            sent["flows"] = self.senders[MessageType.TAGGEDFLOW].send(records)
+            docs = flows_to_documents(cols, now_ns // 1_000_000_000)
+            doc_records = documents_to_records(docs)
+            sent["documents"] = self.senders[MessageType.METRICS].send(
+                doc_records)
+        if l7_records:
+            sent["l7"] = self.senders[MessageType.PROTOCOLLOG].send(
+                l7_records)
+        self.sessions.expire(now_ns)
+        return sent
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.guard.start()
+        if self.cfg.controller_url is not None:
+            t = threading.Thread(target=self._sync_loop, name="synchronizer",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._tick_loop, name="flow-tick",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self.tick()  # final flush
+        self.guard.close()
+        for s in self.senders.values():
+            s.close()
+
+    def _sync_loop(self) -> None:
+        self.sync_once()
+        while not self._stop.wait(self.cfg.sync_interval_s):
+            self.sync_once()
+            self.escape.check()
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            self.tick()
+
+    def counters(self) -> dict:
+        c = self.flow_map.counters()
+        c["escaped"] = int(self.escaped)
+        c["sessions_merged"] = self.sessions.merged
+        for mt, s in self.senders.items():
+            c[f"sent_{mt.name.lower()}"] = s.sent_records
+        return c

@@ -34,11 +34,14 @@ def _http(url: str, body=None, form: str = None):
         with urllib.request.urlopen(req, timeout=10) as resp:
             return json.load(resp)
     except urllib.error.HTTPError as e:
-        # both servers put the real message in a JSON error body
+        # both servers put the real message in a JSON error body; surface
+        # it as a failure so commands exit non-zero instead of printing
+        # the error dict as a result
         try:
-            return json.loads(e.read().decode())
+            body = json.loads(e.read().decode())
         except ValueError:
             raise e from None
+        raise RuntimeError(body.get("error", body)) from None
 
 
 def _table(rows, columns):

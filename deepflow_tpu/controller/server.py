@@ -138,14 +138,17 @@ class ControllerServer:
                                                    body["host"])
             return resp
         if path == "/v1/genesis":
-            # agent-reported interfaces become host resources in the
-            # genesis domain (reference: controller/genesis sinks); ids
-            # must be restart-stable, so use a content hash, and only
-            # well-formed IPv4 addresses may enter the model (a bad row
-            # would poison every later platform-data compile)
+            # agent-reported interfaces become host resources in a
+            # PER-AGENT genesis domain (reference: controller/genesis
+            # sinks keyed by vtap) — one shared domain would let each
+            # agent's snapshot delete every other agent's rows. Ids must
+            # be restart-stable (content hash), and only well-formed
+            # IPv4 addresses may enter the model (a bad row would poison
+            # every later platform-data compile).
             import ipaddress
 
             from deepflow_tpu.store.dict_store import fnv1a32
+            domain = f"{self.genesis_domain}/{body['host']}"
             snapshot = []
             for i, itf in enumerate(body.get("interfaces", [])):
                 try:
@@ -157,9 +160,9 @@ class ControllerServer:
                     1_000_000 + (fnv1a32(
                         f"{body['host']}|{itf['ip']}".encode()) & 0xFFFFF),
                     f"{body['host']}:{itf.get('name', i)}",
-                    domain=self.genesis_domain,
+                    domain=domain,
                     ip=itf["ip"], epc_id=itf.get("epc_id", 0)))
-            diff = self.model.update_domain(self.genesis_domain, snapshot)
+            diff = self.model.update_domain(domain, snapshot)
             return {"created": len(diff.created),
                     "deleted": len(diff.deleted)}
         if path == "/v1/vtap-group-config":

@@ -1,0 +1,279 @@
+"""Agent pipeline: packet decode, flow map, L7 parsers, policy, e2e."""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.flow_map import (CLOSE_FIN, CLOSE_RST, FlowMap,
+                                         flows_to_columns)
+from deepflow_tpu.agent.l7 import (L7_DNS, L7_HTTP1, L7_MYSQL, L7_REDIS,
+                                   MSG_REQUEST, SessionAggregator,
+                                   parse_payload)
+from deepflow_tpu.agent.packet import ACK, FIN, SYN, decode_packets
+from deepflow_tpu.agent.policy import AclRule, PolicyLabeler
+from deepflow_tpu.agent.quadruple import flows_to_documents
+from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+
+def _ip(a, b, c, d):
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def eth_ipv4_tcp(src, dst, sport, dport, flags=ACK, payload=b"", seq=0,
+                 vlan=False):
+    eth = b"\x02" * 6 + b"\x04" * 6
+    eth += (b"\x81\x00\x00\x01\x08\x00" if vlan else b"\x08\x00")
+    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, 0, 0x50, flags,
+                      8192, 0, 0) + payload
+    total = 20 + len(tcp)
+    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 6, 0,
+                     src, dst)
+    return eth + ip + tcp
+
+
+def eth_ipv4_udp(src, dst, sport, dport, payload=b""):
+    eth = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x00"
+    udp = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0) + payload
+    total = 20 + len(udp)
+    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 17, 0,
+                     src, dst)
+    return eth + ip + udp
+
+
+CLIENT = _ip(10, 0, 0, 1)
+SERVER = _ip(10, 0, 0, 2)
+
+
+def test_decode_tcp_and_vlan():
+    frames = [
+        eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, SYN, seq=100),
+        eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, ACK, b"hello", seq=101,
+                     vlan=True),
+        b"\x00" * 20,  # garbage
+    ]
+    cols = decode_packets(frames)
+    assert cols["valid"].tolist() == [True, True, False]
+    assert cols["ip_src"][0] == CLIENT and cols["port_dst"][0] == 80
+    assert cols["tcp_flags"][0] == SYN
+    assert cols["tcp_seq"][0] == 100
+    # vlan packet: payload length correct despite shifted offsets
+    assert cols["payload_len"][1] == 5
+    assert frames[1][cols["payload_off"][1]:] == b"hello"
+
+
+def test_decode_vxlan():
+    inner = eth_ipv4_tcp(CLIENT, SERVER, 1234, 443, SYN)
+    vxlan = b"\x08\x00\x00\x00\x00\x00\x7b\x00" + inner
+    outer = eth_ipv4_udp(_ip(1, 1, 1, 1), _ip(2, 2, 2, 2), 5555, 4789,
+                         vxlan)
+    cols = decode_packets([outer])
+    assert cols["valid"][0] and cols["tunneled"][0]
+    assert cols["ip_src"][0] == CLIENT
+    assert cols["port_dst"][0] == 443
+
+
+def test_flow_map_full_session():
+    fm = FlowMap()
+    us = 1_000  # ns per us
+    t0 = 1_700_000_000_000_000_000
+    frames = [
+        eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, SYN, seq=1),
+        eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, SYN | ACK, seq=1),
+        eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, ACK, b"x" * 100, seq=2),
+        eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, ACK, b"y" * 500, seq=2),
+        eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, FIN | ACK, seq=102),
+        eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, FIN | ACK, seq=502),
+    ]
+    ts = np.array([t0, t0 + 200 * us, t0 + 400 * us, t0 + 500 * us,
+                   t0 + 600 * us, t0 + 700 * us], np.uint64)
+    # split across two batches to exercise cross-batch merge
+    for lo, hi in ((0, 3), (3, 6)):
+        pkt = decode_packets(frames[lo:hi], ts[lo:hi])
+        fm.inject(pkt)
+    assert len(fm) == 1
+    flows = fm.tick(now_ns=t0 + 10**9)
+    assert len(flows) == 1 and len(fm) == 0   # FIN both ways -> closed
+    cols = flows_to_columns(flows, vtap_id=7, now_ns=t0 + 10**9)
+    assert cols["ip_src"][0] == CLIENT        # initiator = client
+    assert cols["ip_dst"][0] == SERVER
+    assert cols["packet_tx"][0] == 3 and cols["packet_rx"][0] == 3
+    assert cols["byte_rx"][0] > cols["byte_tx"][0]
+    assert cols["rtt"][0] == 200              # syn->synack in us
+    assert cols["close_type"][0] == CLOSE_FIN
+    assert cols["duration"][0] == 700 * us
+
+
+def test_flow_map_rst_and_active_report():
+    fm = FlowMap()
+    t0 = 1_700_000_000_000_000_000
+    pkt = decode_packets(
+        [eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, ACK, b"z", seq=5)],
+        np.array([t0], np.uint64))
+    fm.inject(pkt)
+    active = fm.tick(now_ns=t0 + 10**9)
+    assert len(active) == 1 and len(fm) == 1  # forced report, kept
+    pkt = decode_packets(
+        [eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, 0x04, seq=6)],  # RST
+        np.array([t0 + 2 * 10**9], np.uint64))
+    fm.inject(pkt)
+    closed = fm.tick(now_ns=t0 + 3 * 10**9)
+    assert len(closed) == 1 and len(fm) == 0
+    assert closed[0].close_type(t0 + 3 * 10**9) == CLOSE_RST
+
+
+def test_flow_map_reports_interval_deltas():
+    fm = FlowMap()
+    t0 = 1_700_000_000_000_000_000
+    mk = lambda n, t: decode_packets(
+        [eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, ACK, b"d" * 10, seq=s)
+         for s in range(n)], np.full(n, t, np.uint64))
+    fm.inject(mk(4, t0))
+    first = fm.tick(now_ns=t0 + 10**9)
+    assert first[0].packets[0] + first[0].packets[1] == 4
+    assert first[0].reported is False         # first-ever report
+    fm.inject(mk(2, t0 + 15 * 10**8))
+    second = fm.tick(now_ns=t0 + 2 * 10**9)
+    # only the interval's 2 packets, not cumulative 6
+    assert second[0].packets[0] + second[0].packets[1] == 2
+    assert second[0].reported is True
+    # idle interval -> no re-report
+    assert fm.tick(now_ns=t0 + 3 * 10**9) == []
+
+
+def test_l7_parsers():
+    http_req = parse_payload(b"GET /api/users?id=7 HTTP/1.1\r\nHost: x\r\n")
+    assert http_req.proto == L7_HTTP1 and http_req.msg_type == MSG_REQUEST
+    assert http_req.endpoint == "GET /api/users"
+    http_resp = parse_payload(b"HTTP/1.1 404 Not Found\r\n\r\n")
+    assert http_resp.status == 404
+
+    dns_q = struct.pack(">HHHHHH", 7, 0x0100, 1, 0, 0, 0) + \
+        b"\x03www\x07example\x03com\x00" + struct.pack(">HH", 1, 1)
+    rec = parse_payload(dns_q)
+    assert rec.proto == L7_DNS and rec.endpoint == "www.example.com"
+
+    redis = parse_payload(b"*2\r\n$3\r\nGET\r\n$3\r\nfoo\r\n")
+    assert redis.proto == L7_REDIS and redis.endpoint == "GET"
+
+    q = b"\x03SELECT * FROM users"
+    mysql = parse_payload(bytes([len(q), 0, 0, 0]) + q)
+    assert mysql.proto == L7_MYSQL and mysql.endpoint == "SELECT"
+
+
+def test_session_aggregator_rrt():
+    agg = SessionAggregator()
+    req = parse_payload(b"GET /x HTTP/1.1\r\n")
+    resp = parse_payload(b"HTTP/1.1 200 OK\r\n")
+    key = (("f",), L7_HTTP1)
+    assert agg.offer(key, req, 1000_000) is None
+    merged = agg.offer(key, resp, 4000_000)
+    assert merged["endpoint"] == "GET /x" and merged["status"] == 200
+    assert merged["rrt_us"] == 3000
+    assert agg.merged == 1
+
+
+def test_policy_labeler():
+    rules = [
+        AclRule(rule_id=5, ip_prefix=_ip(10, 0, 0, 0), ip_mask_len=8,
+                protocol=6),
+        AclRule(rule_id=9, port_min=53, port_max=53, protocol=17),
+    ]
+    pl = PolicyLabeler(rules)
+    cols = {
+        "ip_src": np.array([CLIENT, _ip(8, 8, 8, 8), _ip(8, 8, 4, 4)],
+                           np.uint32),
+        "ip_dst": np.array([SERVER, _ip(8, 8, 8, 9), _ip(8, 8, 4, 5)],
+                           np.uint32),
+        "port_src": np.array([40000, 53, 9999], np.uint32),
+        "port_dst": np.array([80, 5555, 9999], np.uint32),
+        "proto": np.array([6, 17, 6], np.uint32),
+    }
+    assert pl.lookup(cols).tolist() == [5, 9, 0]
+
+
+def test_quadruple_documents():
+    fm = FlowMap()
+    t0 = 1_700_000_000_000_000_000
+    frames = [eth_ipv4_tcp(CLIENT, SERVER, 40000 + i, 80, SYN, seq=1)
+              for i in range(3)]
+    fm.inject(decode_packets(frames, np.full(3, t0, np.uint64)))
+    cols = flows_to_columns(fm.tick(now_ns=t0 + 10**9), 7, t0 + 10**9)
+    docs = flows_to_documents(cols, second=1_700_000_000)
+    assert len(docs["ip"]) == 1               # one (server, port) group
+    assert docs["ip"][0] == SERVER
+    assert docs["new_flow"][0] == 3
+    assert docs["packet_tx"][0] == 3
+
+
+def test_agent_to_ingester_e2e(tmp_path):
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path)))
+    ing.start()
+    try:
+        cfg = AgentConfig(ingester_addr=f"127.0.0.1:{ing.port}",
+                          l7_enabled=True)
+        agent = Agent(cfg)
+        agent.vtap_id = 42
+        t0 = int(time.time() * 1e9)
+        frames = [
+            eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, SYN, seq=1),
+            eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, SYN | ACK, seq=1),
+            eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, ACK,
+                         b"GET /hello HTTP/1.1\r\n\r\n", seq=2),
+            eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, ACK,
+                         b"HTTP/1.1 200 OK\r\n\r\n", seq=2),
+            eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, FIN | ACK, seq=30),
+            eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, FIN | ACK, seq=20),
+        ]
+        ts = np.array([t0 + i * 1000 for i in range(6)], np.uint64)
+        assert agent.feed(frames, ts) == 6
+        sent = agent.tick(now_ns=t0 + 10**9)
+        assert sent["flows"] == 1 and sent["documents"] == 1
+        assert sent["l7"] == 1
+        deadline = time.time() + 10
+        table = ing.store.table("flow_log", "l4_flow_log")
+        while time.time() < deadline:
+            ing.flush()
+            if table.row_count() >= 1 and \
+                    ing.store.table("flow_log", "l7_flow_log").row_count():
+                break
+            time.sleep(0.1)
+        out = table.scan()
+        assert out["ip_src"].tolist() == [CLIENT]
+        assert out["vtap_id"].tolist() == [42]
+        l7 = ing.store.table("flow_log", "l7_flow_log").scan()
+        assert l7["status"].tolist() == [200]
+        metrics = ing.store.table("flow_metrics", "vtap_flow_port")
+        assert metrics.row_count() >= 1
+        agent.close()
+    finally:
+        ing.close()
+
+
+def test_agent_managed_by_controller(tmp_path):
+    from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                         VTapRegistry)
+    from deepflow_tpu.controller.monitor import FleetMonitor
+
+    reg = VTapRegistry()
+    mon = FleetMonitor(reg)
+    mon.set_ingesters(["127.0.0.1:39999"])
+    srv = ControllerServer(ResourceModel(), reg, mon, port=0)
+    srv.start()
+    try:
+        cfg = AgentConfig(controller_url=f"http://127.0.0.1:{srv.port}",
+                          ctrl_ip="10.5.5.5", host="it-host")
+        agent = Agent(cfg)
+        assert agent.sync_once()
+        assert agent.vtap_id == 1
+        assert agent.senders[list(agent.senders)[0]].port == 39999
+        # config push round trip
+        reg.set_config("default", {"l7_log_enabled": False})
+        assert agent.sync_once()
+        assert agent.cfg.l7_enabled is False
+    finally:
+        srv.close()
