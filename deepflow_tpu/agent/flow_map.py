@@ -133,17 +133,14 @@ class FlowMap:
             # against the accumulator's max_seq at merge time
             "payload_pkts": has_payload.astype(np.int64),
         }
-        red = group_reduce(
+        red, inv = group_reduce(
             work, ["ip0", "ip1", "p0", "p1", "proto", "dir"],
             {"bytes": "sum", "pkts": "sum", "flags": "max",
              "ts_min": "min", "ts_max": "max", "syn_ts": "min",
-             "synack_ts": "min", "seq_max": "max", "payload_pkts": "sum"})
-        # flags need OR, not max: OR-reduce per group on host (group count
-        # << packet count). np.unique here sees the same key columns in
-        # the same order as group_reduce's, so row order lines up.
-        gk = np.stack([a.astype(np.int64) for a in
-                       (ip0, ip1, p0, p1, cols["proto"], direction)], axis=1)
-        _, inv = np.unique(gk, axis=0, return_inverse=True)
+             "synack_ts": "min", "seq_max": "max", "payload_pkts": "sum"},
+            return_inverse=True)
+        # flags need OR, not max: OR-reduce per group on host, reusing the
+        # group ids from the reduction (group count << packet count)
         red_flags = np.zeros(len(red["ip0"]), np.int64)
         np.bitwise_or.at(red_flags, inv, flags)
 

@@ -166,10 +166,21 @@ class MysqlParser:
 PARSERS = (HttpParser(), DnsParser(), MysqlParser(), RedisParser())
 
 
-def parse_payload(payload: bytes) -> Optional[L7Record]:
+def parse_payload(payload: bytes, proto: Optional[int] = None,
+                  port_src: Optional[int] = None,
+                  port_dst: Optional[int] = None) -> Optional[L7Record]:
     """Two-phase dispatch: first parser whose cheap check passes wins
-    (reference: check_payload ordering in l7_protocol_log.rs)."""
+    (reference: check_payload ordering in l7_protocol_log.rs). Transport
+    context, when provided, gates ambiguous parsers: DNS only on UDP or
+    port 53 (byte patterns alone misfire on e.g. TLS records), and the
+    byte-oriented TCP protocols never match UDP payloads."""
     for p in PARSERS:
+        if proto is not None:
+            if p.proto == L7_DNS:
+                if proto != 17 and 53 not in (port_src, port_dst):
+                    continue
+            elif proto != 6:
+                continue
         if p.check(payload):
             rec = p.parse(payload)
             if rec is not None:
@@ -192,14 +203,19 @@ class SessionAggregator:
 
     def offer(self, flow_key: tuple, rec: L7Record,
               ts_ns: int) -> Optional[dict]:
-        """Returns a merged session dict when a pair completes."""
+        """Returns a merged session dict when a pair completes. Pipelined
+        requests on one connection queue FIFO, so response k pairs with
+        request k (HTTP/1.1 pipelining order)."""
         key = (flow_key, rec.proto)
         if rec.msg_type == MSG_REQUEST:
             with self._lock:
-                self._pending[key] = (rec, ts_ns)
+                self._pending.setdefault(key, []).append((rec, ts_ns))
             return None
         with self._lock:
-            req = self._pending.pop(key, None)
+            queue = self._pending.get(key)
+            req = queue.pop(0) if queue else None
+            if queue is not None and not queue:
+                del self._pending[key]
         if req is None:
             self.unpaired += 1
             return {"proto": rec.proto, "endpoint": rec.endpoint,
@@ -218,10 +234,16 @@ class SessionAggregator:
 
     def expire(self, now_ns: int) -> int:
         """Drop requests that never saw a response within the window."""
+        dropped = 0
         with self._lock:
-            stale = [k for k, (_, ts) in self._pending.items()
-                     if now_ns - ts > self.window_ns]
-            for k in stale:
-                del self._pending[k]
-        self.unpaired += len(stale)
-        return len(stale)
+            for k in list(self._pending):
+                queue = self._pending[k]
+                keep = [(r, ts) for r, ts in queue
+                        if now_ns - ts <= self.window_ns]
+                dropped += len(queue) - len(keep)
+                if keep:
+                    self._pending[k] = keep
+                else:
+                    del self._pending[k]
+        self.unpaired += dropped
+        return dropped

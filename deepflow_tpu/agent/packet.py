@@ -80,6 +80,7 @@ def decode_packets(frames: List[bytes],
 
     valid = (eth_type == ETH_IPV4) & (lens >= l3_off + 20)
     ihl = (mat[rows, l3_off] & 0x0F).astype(np.int32) * 4
+    valid &= ihl >= 20  # IHL < 5 is malformed; l4 reads would hit IP bytes
     proto = mat[rows, l3_off + 9].astype(np.uint32)
     ip_src = _be32(mat, l3_off + 12)
     ip_dst = _be32(mat, l3_off + 16)

@@ -187,7 +187,9 @@ class Agent:
         )[0]
         for i in candidates:
             payload = frames[i][int(pkt["payload_off"][i]):]
-            rec = parse_payload(payload)
+            rec = parse_payload(payload, proto=int(pkt["proto"][i]),
+                                port_src=int(pkt["port_src"][i]),
+                                port_dst=int(pkt["port_dst"][i]))
             if rec is None:
                 continue
             # session key is direction-agnostic

@@ -38,12 +38,13 @@ class _Decoder(threading.Thread):
     def __init__(self, stream: str, index: int, queues: MultiQueue,
                  decode_fn, enrich_fn, throttler: ColumnarThrottler,
                  writer: Optional[StoreWriter], exporters: Optional[Exporters],
-                 batch: int = 64) -> None:
+                 batch: int = 64, payload_decode_fn=None) -> None:
         super().__init__(name=f"decode-{stream}-{index}", daemon=True)
         self.stream = stream
         self.index = index
         self.queues = queues
         self.decode_fn = decode_fn
+        self.payload_decode_fn = payload_decode_fn
         self.enrich_fn = enrich_fn
         self.throttler = throttler
         self.writer = writer
@@ -65,22 +66,45 @@ class _Decoder(threading.Thread):
             self.handle(frames)
 
     def handle(self, frames: List[Frame]) -> None:
-        records: List[bytes] = []
-        for f in frames:
-            try:
-                records.extend(iter_pb_records(f.payload))
-            except ValueError:
-                self.decode_errors += 1
         self.frames += len(frames)
-        if not records:
-            return
-        try:
-            cols = self.decode_fn(records)
-        except Exception:
-            self.decode_errors += 1
-            return
+        cols = None
+        if self.payload_decode_fn is not None:
+            # native fast path: each frame payload IS a packed record
+            # stream. Decode per frame (not one joined buffer) so a
+            # corrupt frame only loses its own tail, like the Python path.
+            try:
+                import numpy as np
+                parts = []
+                for f in frames:
+                    c, bad = self.payload_decode_fn(f.payload)
+                    self.decode_errors += bad
+                    if len(next(iter(c.values()))):
+                        parts.append(c)
+                if parts:
+                    cols = {k: np.concatenate([p[k] for p in parts])
+                            for k in parts[0]}
+                else:
+                    cols = {k: v for k, v in
+                            self.payload_decode_fn(b"")[0].items()}
+            except Exception:
+                cols = None  # fall through to the Python oracle
+        if cols is None:
+            records: List[bytes] = []
+            for f in frames:
+                try:
+                    records.extend(iter_pb_records(f.payload))
+                except ValueError:
+                    self.decode_errors += 1
+            if not records:
+                return
+            try:
+                cols = self.decode_fn(records)
+            except Exception:
+                self.decode_errors += 1
+                return
+            self.decode_errors += len(records) - \
+                len(next(iter(cols.values())))  # bad records skipped
         decoded = len(next(iter(cols.values()))) if cols else 0
-        self.decode_errors += len(records) - decoded  # bad records skipped
         self.records += decoded
         if decoded == 0:
             return
@@ -126,6 +150,11 @@ class FlowLogPipeline:
                 table = store.create_table(FLOW_LOG_DB, table_schema)
                 writer = StoreWriter(table, stats=stats)
                 self.writers.append(writer)
+            payload_fn = None
+            if stream == "l4_flow_log":
+                from deepflow_tpu.decode import native
+                if native.available():
+                    payload_fn = native.decode_l4_payload
             for i in range(n_decoders):
                 # budget split across decoders so the aggregate cap matches
                 # the config (reference: flow_log.go throttle/queueCount)
@@ -133,7 +162,8 @@ class FlowLogPipeline:
                     (writer.put if writer is not None else lambda c: None),
                     max(1, throttle_per_s // n_decoders), seed=i)
                 d = _Decoder(stream, i, queues, decode_fn, enrich_fn,
-                             throttler, writer, exporters)
+                             throttler, writer, exporters,
+                             payload_decode_fn=payload_fn)
                 self.decoders.append(d)
                 if stats is not None:
                     stats.register(f"decoder.{stream}.{i}", d.counters)

@@ -69,15 +69,20 @@ def _segment_reduce(seg: jnp.ndarray, mask: jnp.ndarray, data: jnp.ndarray,
 
 
 def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
-                 aggs: Dict[str, str]) -> Dict[str, np.ndarray]:
+                 aggs: Dict[str, str],
+                 return_inverse: bool = False):
     """Exact GROUP BY: host group-ids + device segment reduction.
 
     `aggs` maps value column -> sum|max|min|count. Key columns come back
-    deduplicated; value columns reduced. Shared by rollups and the querier.
+    deduplicated; value columns reduced. Shared by rollups, the querier,
+    and the agent flow map. With return_inverse, also returns the [n]
+    row->group index (callers needing extra reductions, e.g. bitwise OR,
+    reuse it instead of re-grouping).
     """
     n = len(next(iter(cols.values())))
     if n == 0:
-        return {nm: cols[nm][:0] for nm in list(key_names) + list(aggs)}
+        empty = {nm: cols[nm][:0] for nm in list(key_names) + list(aggs)}
+        return (empty, np.empty(0, np.int64)) if return_inverse else empty
     packed = np.stack([np.ascontiguousarray(cols[nm]).astype(np.int64)
                        for nm in key_names], axis=1)
     uniq, inverse = np.unique(packed, axis=0, return_inverse=True)
@@ -108,7 +113,7 @@ def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
         out[nm] = uniq[:, j].astype(cols[nm].dtype)
     for i, nm in enumerate(value_names):
         out[nm] = reduced[:, i]
-    return out
+    return (out, inverse) if return_inverse else out
 
 
 class RollupManager:
