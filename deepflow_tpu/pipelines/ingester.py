@@ -42,6 +42,9 @@ class IngesterConfig:
     throttle_per_s: int = 50_000
     store_max_bytes: int = 100 << 30
     rollup_intervals: tuple = (60,)
+    # enable the TPU sketch analytics exporter (BASELINE.json's
+    # tpu_sketch plugin); None disables, a float sets window seconds
+    tpu_sketch_window_s: Optional[float] = None
 
 
 class Ingester:
@@ -62,6 +65,15 @@ class Ingester:
             self.monitor = DiskMonitor(self.store, cfg.store_max_bytes,
                                        stats=self.stats)
         self.tag_dicts = TagDictRegistry(cfg.store_path)
+        self.tpu_sketch = None
+        if cfg.tpu_sketch_window_s is not None:
+            from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+            ckpt_dir = None if cfg.store_path is None else \
+                os.path.join(cfg.store_path, "sketch_ckpt")
+            self.tpu_sketch = TpuSketchExporter(
+                store=self.store, window_seconds=cfg.tpu_sketch_window_s,
+                checkpoint_dir=ckpt_dir, stats=self.stats)
+            self.exporters.register(self.tpu_sketch)
         self.receiver = Receiver(port=cfg.listen_port, host=cfg.listen_host,
                                  stats=self.stats)
         self.flow_log = FlowLogPipeline(
@@ -108,6 +120,8 @@ class Ingester:
         """Drain throttlers/writers to disk (tests and shutdown)."""
         for p in self._pipelines:
             p.flush()
+        if self.tpu_sketch is not None:
+            self.tpu_sketch.flush()
         self.tag_dicts.flush()
 
     def close(self) -> None:

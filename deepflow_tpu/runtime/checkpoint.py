@@ -1,0 +1,98 @@
+"""Sketch-state checkpointing: mergeable snapshots, restart loses <=1 window.
+
+Reference: the reference has no ML-style checkpointing — durable state is
+MySQL + ClickHouse and agents are stateless across restarts (SURVEY.md §5).
+The TPU analogue this framework needs: sketch states (CMS counts, HLL
+registers, rings, EWMAs) are device pytrees, so a checkpoint is one
+device_get + atomic npz write per cadence, and restore validates leaf
+shapes/dtypes against a freshly-initialized state of the current config
+— incompatible checkpoints (config changed) are refused, not misloaded.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+
+
+class SketchCheckpointer:
+    """Atomic rolling snapshots of one pytree state."""
+
+    def __init__(self, directory: str, name: str = "sketch",
+                 keep: int = 3) -> None:
+        self.directory = directory
+        self.name = name
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+        self.saves = 0
+        self.restores = 0
+
+    # -- save --------------------------------------------------------------
+    def save(self, state: Any, step: int) -> str:
+        leaves = jax.tree_util.tree_leaves(state)
+        host = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+        path = os.path.join(self.directory,
+                            f"{self.name}-{step:012d}.npz")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **{f"leaf_{i}": a for i, a in enumerate(host)},
+                     __step=np.asarray(step, np.int64))
+        os.replace(tmp, path)
+        self.saves += 1
+        self._gc()
+        return path
+
+    def _snapshots(self) -> list:
+        if not os.path.isdir(self.directory):
+            return []
+        return sorted(
+            f for f in os.listdir(self.directory)
+            if f.startswith(self.name + "-") and f.endswith(".npz"))
+
+    def _gc(self) -> None:
+        snaps = self._snapshots()
+        for f in snaps[:-self.keep]:
+            try:
+                os.unlink(os.path.join(self.directory, f))
+            except OSError:
+                pass
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, like: Any) -> Optional[Any]:
+        """Load the newest compatible snapshot shaped like `like` (a
+        freshly-initialized state). Returns None when no snapshot exists
+        or the stored leaves don't match the current config's shapes."""
+        like_leaves, treedef = jax.tree_util.tree_flatten(like)
+        for fname in reversed(self._snapshots()):
+            path = os.path.join(self.directory, fname)
+            try:
+                with np.load(path) as z:
+                    loaded = [z[f"leaf_{i}"]
+                              for i in range(len(like_leaves))]
+            except (OSError, KeyError, ValueError):
+                continue  # torn or incompatible file: try the previous one
+            ok = all(
+                a.shape == np.shape(b) and a.dtype == np.asarray(b).dtype
+                for a, b in zip(loaded, like_leaves))
+            if not ok:
+                continue
+            self.restores += 1
+            device_leaves = [jax.numpy.asarray(a) for a in loaded]
+            return jax.tree_util.tree_unflatten(treedef, device_leaves)
+        return None
+
+    def latest_step(self) -> Optional[int]:
+        snaps = self._snapshots()
+        if not snaps:
+            return None
+        return int(snaps[-1][len(self.name) + 1:-4])
+
+    def counters(self) -> dict:
+        return {"saves": self.saves, "restores": self.restores,
+                "snapshots": len(self._snapshots())}

@@ -1,0 +1,209 @@
+"""The tpu_sketch exporter: the framework's flagship analytics backend.
+
+This is the component BASELINE.json names: an exporter registered behind
+the ingester's plugin interface (beside the store/OTLP-style writers)
+that batches decoded l4_flow_log chunks into static-shape device tensors
+and advances the FlowSuite sketches (Count-Min top-K, per-service HLL,
+traffic entropy) in one jitted program per batch. Window flushes write
+heavy-hitter/cardinality/entropy rows into the store for the querier,
+and checkpoint the mergeable sketch state so a restart loses at most one
+window (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepflow_tpu.batch.batcher import Batcher, TensorBatch
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.models import flow_suite
+from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
+from deepflow_tpu.runtime.exporters import QueueWorkerExporter
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
+from deepflow_tpu.store.writer import StoreWriter
+
+SKETCH_DB = "tpu_sketch"
+
+TOPK_TABLE = TableSchema(
+    name="topk_flows",
+    columns=(
+        ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("rank", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("flow_key", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("count", np.dtype(np.uint32), AggKind.MAX),
+    ),
+)
+
+WINDOW_TABLE = TableSchema(
+    name="window_signals",
+    columns=(
+        ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("rows", np.dtype(np.uint32), AggKind.SUM),
+        ColumnSpec("entropy_ip_src", np.dtype(np.float32), AggKind.MAX),
+        ColumnSpec("entropy_ip_dst", np.dtype(np.float32), AggKind.MAX),
+        ColumnSpec("entropy_port_src", np.dtype(np.float32), AggKind.MAX),
+        ColumnSpec("entropy_port_dst", np.dtype(np.float32), AggKind.MAX),
+        ColumnSpec("distinct_clients", np.dtype(np.uint32), AggKind.MAX),
+    ),
+)
+
+
+class TpuSketchExporter(QueueWorkerExporter):
+    """Exporter contract (start/close/is_export_data/put) over FlowSuite."""
+
+    def __init__(self, store: Optional[Store] = None,
+                 cfg: Optional[flow_suite.FlowSuiteConfig] = None,
+                 batch_rows: int = 1 << 15,
+                 window_seconds: float = 1.0,
+                 checkpoint_dir: Optional[str] = None,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
+                         batch=64, stats=stats)
+        import jax.numpy as jnp  # deferred: exporter import stays light
+
+        self._jnp = jnp
+        self.cfg = cfg or flow_suite.FlowSuiteConfig()
+        self.window_seconds = window_seconds
+        self.batcher = Batcher(L4_SCHEMA, capacity=batch_rows)
+        self.state = flow_suite.init(self.cfg)
+        self.checkpointer = None
+        self.windows = 0
+        if checkpoint_dir is not None:
+            self.checkpointer = SketchCheckpointer(checkpoint_dir)
+            restored = self.checkpointer.restore(self.state)
+            if restored is not None:
+                self.state = restored
+                # resume the step counter past existing snapshots, else
+                # new saves sort below stale ones and GC eats them
+                self.windows = self.checkpointer.latest_step() or 0
+        self.topk_writer = self.window_writer = None
+        if store is not None:
+            self.topk_writer = StoreWriter(
+                store.create_table(SKETCH_DB, TOPK_TABLE),
+                batch_rows=4096, flush_interval=5.0)
+            self.window_writer = StoreWriter(
+                store.create_table(SKETCH_DB, WINDOW_TABLE),
+                batch_rows=1024, flush_interval=5.0)
+        import jax
+
+        self._update = jax.jit(
+            lambda s, c, m: flow_suite.update(s, c, m, self.cfg),
+            donate_argnums=0)
+        # NOT donated: the pre-flush state is also the checkpoint payload
+        self._flush_fn = jax.jit(lambda s: flow_suite.flush(s, self.cfg))
+        self.rows_in = 0
+        self.last_output: Optional[flow_suite.FlowWindowOutput] = None
+        self._window_thread: Optional[threading.Thread] = None
+        self._window_stop = threading.Event()
+        self._state_lock = threading.Lock()
+
+    # -- exporter lifecycle ------------------------------------------------
+    def start(self) -> None:
+        if self.topk_writer is not None:
+            self.topk_writer.start()
+            self.window_writer.start()
+        super().start()
+        self._window_thread = threading.Thread(
+            target=self._window_loop, name="tpu-sketch-window", daemon=True)
+        self._window_thread.start()
+
+    def close(self) -> None:
+        self._window_stop.set()
+        if self._window_thread is not None:
+            self._window_thread.join(timeout=5)
+        super().close()
+        self.flush_window()  # final window
+        for w in (self.topk_writer, self.window_writer):
+            if w is not None:
+                w.close()
+
+    # -- data path ---------------------------------------------------------
+    def process(self, chunks: List[Any]) -> None:
+        """Queue worker: decoded chunks -> static batches -> device.
+        Holds _state_lock across batcher + state mutation: the window
+        thread's flush_window() touches both under the same lock."""
+        for stream, _idx, cols in chunks:
+            schema_cols = {
+                name: np.ascontiguousarray(cols[name]).astype(dt, copy=False)
+                if name in cols else
+                np.zeros(len(next(iter(cols.values()))), dt)
+                for name, dt in L4_SCHEMA.columns
+            }
+            with self._state_lock:
+                for tb in self.batcher.put(schema_cols):
+                    self._run_batch_locked(tb)
+                # counted only once the chunk is fully on device, so
+                # rows_in is a processed-watermark, not an arrival count
+                self.rows_in += len(next(iter(schema_cols.values())))
+
+    def _run_batch_locked(self, tb: TensorBatch) -> None:
+        jnp = self._jnp
+        cols_d = {k: jnp.asarray(v) for k, v in tb.columns.items()}
+        mask_d = jnp.asarray(tb.mask())
+        self.state = self._update(self.state, cols_d, mask_d)
+
+    # -- windows -----------------------------------------------------------
+    def flush_window(self, now: Optional[float] = None) -> Optional[
+            flow_suite.FlowWindowOutput]:
+        now = time.time() if now is None else now
+        with self._state_lock:
+            for tb in self.batcher.flush():
+                self._run_batch_locked(tb)
+            self.windows += 1
+            # checkpoint the PRE-flush state (the window's accumulation):
+            # restore replays the window at-least-once; saving post-flush
+            # would snapshot a reset state and recover nothing
+            if self.checkpointer is not None:
+                self.checkpointer.save(self.state, self.windows)
+            self.state, out = self._flush_fn(self.state)
+        self.last_output = out
+        self._write_output(out, int(now))
+        return out
+
+    def _write_output(self, out: flow_suite.FlowWindowOutput,
+                      second: int) -> None:
+        if self.topk_writer is None:
+            return
+        keys = np.asarray(out.topk_keys)
+        counts = np.asarray(out.topk_counts)
+        live = counts > 0
+        k = int(live.sum())
+        if k:
+            self.topk_writer.put({
+                "timestamp": np.full(k, second, np.uint32),
+                "rank": np.arange(k, dtype=np.uint32),
+                "flow_key": keys[live].astype(np.uint32),
+                "count": np.maximum(counts[live], 0).astype(np.uint32),
+            })
+        ent = np.asarray(out.entropies, np.float32)
+        card = np.asarray(out.service_cardinality)
+        self.window_writer.put({
+            "timestamp": np.asarray([second], np.uint32),
+            "rows": np.asarray([int(np.asarray(out.rows))], np.uint32),
+            "entropy_ip_src": ent[0:1], "entropy_ip_dst": ent[1:2],
+            "entropy_port_src": ent[2:3], "entropy_port_dst": ent[3:4],
+            "distinct_clients": np.asarray([card.sum()], np.uint32),
+        })
+
+    def flush(self) -> None:
+        """Drain pending sketch-output rows to disk (Ingester.flush)."""
+        for w in (self.topk_writer, self.window_writer):
+            if w is not None:
+                w.flush()
+
+    def _window_loop(self) -> None:
+        while not self._window_stop.wait(self.window_seconds):
+            self.flush_window()
+
+    def counters(self) -> dict:
+        c = super().counters()
+        c.update({"rows_in": self.rows_in, "windows": self.windows})
+        if self.checkpointer is not None:
+            c.update(self.checkpointer.counters())
+        return c
