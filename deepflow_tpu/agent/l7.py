@@ -163,7 +163,22 @@ class MysqlParser:
         return None
 
 
-PARSERS = (HttpParser(), DnsParser(), MysqlParser(), RedisParser())
+PARSERS: List = [HttpParser(), DnsParser(), MysqlParser(), RedisParser()]
+
+
+def register_parser(parser, prepend: bool = False) -> None:
+    """Plug in a custom protocol parser (the role of the reference's
+    Wasm/so plugin hooks, agent/src/plugin/wasm/ — here a plain object
+    with .proto, .check(payload) and .parse(payload)->L7Record, plus an
+    optional .transports tuple of ip protocols it applies to).
+    `prepend` lets a plugin shadow a built-in whose check() is greedy."""
+    for attr in ("proto", "check", "parse"):
+        if not hasattr(parser, attr):
+            raise TypeError(f"parser lacks .{attr}")
+    if prepend:
+        PARSERS.insert(0, parser)
+    else:
+        PARSERS.append(parser)
 
 
 def parse_payload(payload: bytes, proto: Optional[int] = None,
@@ -179,7 +194,7 @@ def parse_payload(payload: bytes, proto: Optional[int] = None,
             if p.proto == L7_DNS:
                 if proto != 17 and 53 not in (port_src, port_dst):
                     continue
-            elif proto != 6:
+            elif proto not in getattr(p, "transports", (6,)):
                 continue
         if p.check(payload):
             rec = p.parse(payload)

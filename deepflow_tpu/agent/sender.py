@@ -107,6 +107,31 @@ class UniformSender:
         self.sent_records += sent
         return sent
 
+    def send_raw(self, payload: bytes) -> bool:
+        """Frame one raw payload as-is (streams whose frame body is a
+        single message — OTel exports, influx text — rather than a
+        length-prefixed record batch)."""
+        if len(payload) >= _BATCH_BYTES:
+            self.dropped_records += 1
+            return False
+        with self._lock:
+            if not self._connect_locked():
+                self.dropped_records += 1
+                return False
+            self._seq += 1
+            frame = encode_frame(self.msg_type, payload,
+                                 FlowHeader(sequence=self._seq,
+                                            vtap_id=self.vtap_id))
+            try:
+                self._sock.sendall(frame)
+                self.sent_frames += 1
+                self.sent_records += 1
+                return True
+            except OSError:
+                self._close_locked()
+                self.dropped_records += 1
+                return False
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()

@@ -9,12 +9,18 @@ L4FlowLog), but emits structure-of-arrays instead of row structs.
 
 from __future__ import annotations
 
+import zlib
 from typing import Dict, Iterable, List
 
 import numpy as np
 
 from deepflow_tpu.batch.schema import L4_SCHEMA, L7_SCHEMA, METRIC_SCHEMA
-from deepflow_tpu.wire.gen import flow_log_pb2, metric_pb2
+from deepflow_tpu.wire.gen import flow_log_pb2, metric_pb2, otel_pb2
+
+# L7Protocol ids (reference: agent l7_protocol enum)
+L7_PROTO_HTTP1 = 20
+L7_PROTO_GRPC = 41
+L7_PROTO_UNKNOWN = 0
 
 _NS_PER_S = 1_000_000_000
 
@@ -97,6 +103,68 @@ def decode_l7_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
             else:
                 cols[name][:] = arr[:, i].astype(dt)
     return cols
+
+
+def decode_otel_frames(payloads: Iterable[bytes],
+                       compressed: bool = False):
+    """OTLP trace exports -> (L7_SCHEMA columns, bad_payload_count)
+    (reference: flow_log decoder.go:219 zlib+pb decode ->
+    log_data/otel.go span mapping).
+
+    Each payload is one ExportTraceServiceRequest. Spans map like the
+    reference's: name -> endpoint, duration -> rrt, OTLP status code ->
+    response status (0 ok, 1 error), rpc.system/http.* attributes pick
+    the l7 protocol; network peers come from net.* attributes when
+    present, else 0.
+    """
+    rows: List[tuple] = []
+    bad = 0
+    for payload in payloads:
+        if compressed:
+            try:
+                payload = zlib.decompress(payload)
+            except zlib.error:
+                bad += 1
+                continue
+        req = otel_pb2.ExportTraceServiceRequest()
+        try:
+            req.ParseFromString(payload)
+        except Exception:
+            bad += 1
+            continue
+        for rs in req.resource_spans:
+            for ss in rs.scope_spans:
+                for span in ss.spans:
+                    attrs = {kv.key: kv.value for kv in span.attributes}
+                    l7 = L7_PROTO_UNKNOWN
+                    if "rpc.system" in attrs and \
+                            attrs["rpc.system"].string_value == "grpc":
+                        l7 = L7_PROTO_GRPC
+                    elif any(k.startswith("http.") for k in attrs):
+                        l7 = L7_PROTO_HTTP1
+                    port = (int(attrs["net.peer.port"].int_value)
+                            & 0xFFFF) if "net.peer.port" in attrs else 0
+                    dur_us = max(span.end_time_unix_nano
+                                 - span.start_time_unix_nano, 0) // 1000
+                    rows.append((
+                        0, 0, 0, port, 6, l7,
+                        3,                       # msg_type: session
+                        0,                       # vtap: from flow header
+                        _fnv1a32(span.name.encode()),
+                        1 if span.status.code == 2 else 0,
+                        _u32(dur_us),
+                        0, 0,
+                        _u32(span.start_time_unix_nano // _NS_PER_S),
+                    ))
+    cols = L7_SCHEMA.alloc(len(rows))
+    if rows:
+        arr = np.array(rows, dtype=np.uint64)
+        for i, (name, dt) in enumerate(L7_SCHEMA.columns):
+            if dt == np.dtype(np.int32):
+                cols[name][:] = arr[:, i].astype(np.uint32).view(np.int32)
+            else:
+                cols[name][:] = arr[:, i].astype(dt)
+    return cols, bad
 
 
 def decode_metric_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:

@@ -38,13 +38,18 @@ class _Decoder(threading.Thread):
     def __init__(self, stream: str, index: int, queues: MultiQueue,
                  decode_fn, enrich_fn, throttler: ColumnarThrottler,
                  writer: Optional[StoreWriter], exporters: Optional[Exporters],
-                 batch: int = 64, payload_decode_fn=None) -> None:
+                 batch: int = 64, payload_decode_fn=None,
+                 frame_mode: bool = False) -> None:
         super().__init__(name=f"decode-{stream}-{index}", daemon=True)
         self.stream = stream
         self.index = index
         self.queues = queues
         self.decode_fn = decode_fn
         self.payload_decode_fn = payload_decode_fn
+        # frame_mode: decode_fn consumes whole frames (msg_type, payload)
+        # instead of length-prefixed record lists (the OTel case —
+        # one frame = one ExportTraceServiceRequest)
+        self.frame_mode = frame_mode
         self.enrich_fn = enrich_fn
         self.throttler = throttler
         self.writer = writer
@@ -67,13 +72,21 @@ class _Decoder(threading.Thread):
 
     def handle(self, frames: List[Frame]) -> None:
         self.frames += len(frames)
-        cols = None
+        if self.frame_mode:
+            try:
+                cols, bad = self.decode_fn(frames)
+                self.decode_errors += bad
+            except Exception:
+                self.decode_errors += len(frames)
+                return
+            # falls through to the shared enrich/export/throttle tail
+        else:
+            cols = None
         if self.payload_decode_fn is not None:
             # native fast path: each frame payload IS a packed record
             # stream. Decode per frame (not one joined buffer) so a
             # corrupt frame only loses its own tail, like the Python path.
             try:
-                import numpy as np
                 parts = []
                 for f in frames:
                     c, bad = self.payload_decode_fn(f.payload)
@@ -155,12 +168,15 @@ class FlowLogPipeline:
                 from deepflow_tpu.decode import native
                 if native.available():
                     payload_fn = native.decode_l4_payload
+            # budget split across every consumer of the stream's writer so
+            # the aggregate cap matches the config (reference: flow_log.go
+            # throttle/queueCount); the l7 table is also fed by the OTel
+            # decoder, so its budget splits one way further
+            n_consumers = n_decoders + (1 if stream == "l7_flow_log" else 0)
             for i in range(n_decoders):
-                # budget split across decoders so the aggregate cap matches
-                # the config (reference: flow_log.go throttle/queueCount)
                 throttler = ColumnarThrottler(
                     (writer.put if writer is not None else lambda c: None),
-                    max(1, throttle_per_s // n_decoders), seed=i)
+                    max(1, throttle_per_s // n_consumers), seed=i)
                 d = _Decoder(stream, i, queues, decode_fn, enrich_fn,
                              throttler, writer, exporters,
                              payload_decode_fn=payload_fn)
@@ -168,6 +184,52 @@ class FlowLogPipeline:
                 if stats is not None:
                     stats.register(f"decoder.{stream}.{i}", d.counters)
             self._streams.append((stream, queues))
+
+        # OTel spans: raw + zlib-compressed frames land in l7_flow_log too
+        # (reference: flow_log.go OTel+compressed Loggers :99-106)
+        def _decode_otel(frames: List[Frame]):
+            raw = [f.payload for f in frames
+                   if f.msg_type == MessageType.OPENTELEMETRY]
+            comp = [f.payload for f in frames
+                    if f.msg_type == MessageType.OPENTELEMETRY_COMPRESSED]
+            parts, bad = [], 0
+            for payloads, z in ((raw, False), (comp, True)):
+                if payloads:
+                    c, b = columnar.decode_otel_frames(payloads,
+                                                       compressed=z)
+                    bad += b
+                    if len(next(iter(c.values()))):
+                        parts.append(c)
+            if not parts:
+                return columnar.decode_otel_frames([])[0], bad
+            return ({k: np.concatenate([p[k] for p in parts])
+                     for k in parts[0]}, bad)
+
+        otel_queues = MultiQueue("ingest.otel", 1, queue_size)
+        receiver.register_handler(MessageType.OPENTELEMETRY, otel_queues)
+        receiver.register_handler(MessageType.OPENTELEMETRY_COMPRESSED,
+                                  otel_queues)
+        l7_writer = next(
+            (w for w in self.writers
+             if w.table.schema.name == "l7_flow_log"), None)
+        # stream name distinguishes signal source: exporters that match
+        # "l7_flow_log" (e.g. the OTLP exporter) must NOT re-export spans
+        # that arrived via OTLP — the reference filters by SignalSource
+        # bits for the same reason (otlp_exporter IsExportData)
+        otel_decoder = _Decoder(
+            "l7_flow_log.otel", 0, otel_queues, _decode_otel, lambda c: c,
+            # the l7 write budget is shared with the PROTOCOLLOG decoders
+            # (all feed the same table), so every consumer gets an equal
+            # slice of the configured cap
+            ColumnarThrottler(
+                (l7_writer.put if l7_writer is not None else lambda c: None),
+                max(1, throttle_per_s // (n_decoders + 1)),
+                seed=n_decoders),
+            l7_writer, exporters, frame_mode=True)
+        self.decoders.append(otel_decoder)
+        self._streams.append(("otel", otel_queues))
+        if stats is not None:
+            stats.register("decoder.otel.0", otel_decoder.counters)
 
     def start(self) -> None:
         for w in self.writers:

@@ -106,6 +106,54 @@ class StatsRegistry:
             self._thread = None
 
 
+class StatsShipper:
+    """Ships the registry's samples onto the firehose as DFSTATS records
+    — the framework monitors itself with its own pipeline, landing in
+    the deepflow_system DB (reference: server/libs/stats/stats.go:91-92
+    REMOTE_TYPE_DFSTATSD -> ext_metrics/decoder.go:130)."""
+
+    def __init__(self, registry: StatsRegistry, ingester_addr: str,
+                 vtap_id: int = 0) -> None:
+        from deepflow_tpu.agent.sender import UniformSender
+        from deepflow_tpu.wire.framing import MessageType
+
+        self.registry = registry
+        self.sender = UniformSender(MessageType.DFSTATS, ingester_addr,
+                                    vtap_id=vtap_id)
+        registry.add_sink(self._on_sample)
+        self._batch: List = []
+        self._lock = threading.Lock()
+
+    def _on_sample(self, sample: StatSample) -> None:
+        from deepflow_tpu.wire.gen import stats_pb2
+
+        st = stats_pb2.Stats(
+            timestamp=int(sample.ts), name=sample.module,
+            tag_names=list(sample.tags.keys()),
+            tag_values=[str(v) for v in sample.tags.values()],
+            metrics_float_names=list(sample.values.keys()),
+            metrics_float_values=[float(v) for v in
+                                  sample.values.values()])
+        with self._lock:
+            self._batch.append(st.SerializeToString())
+            if len(self._batch) >= 64:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._batch:
+            # send() packs, size-splits, and accounts per record
+            self.sender.send(self._batch)
+            self._batch = []
+
+    def close(self) -> None:
+        self.flush()
+        self.sender.close()
+
+
 _default: Optional[StatsRegistry] = None
 _default_lock = threading.Lock()
 

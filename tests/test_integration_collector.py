@@ -1,0 +1,114 @@
+"""Integration collector + stats shipper + custom parser plugins."""
+
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.integration import IntegrationCollector
+from deepflow_tpu.agent.l7 import (L7Record, MSG_REQUEST, PARSERS,
+                                   parse_payload, register_parser)
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.wire.gen import telemetry_pb2
+
+
+def _post(port, path, body, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=body, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status
+
+
+@pytest.fixture
+def stack(tmp_path):
+    ing = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path)))
+    ing.start()
+    coll = IntegrationCollector(f"127.0.0.1:{ing.port}", vtap_id=5, port=0)
+    coll.start()
+    yield ing, coll
+    coll.close()
+    ing.close()
+
+
+def test_prometheus_and_telegraf_ingest(stack):
+    ing, coll = stack
+    wr = telemetry_pb2.WriteRequest()
+    ts = wr.timeseries.add()
+    ts.labels.add(name="__name__", value="up")
+    ts.samples.add(value=1.0, timestamp=1_700_000_000_000)
+    assert _post(coll.port, "/api/v1/prometheus",
+                 wr.SerializeToString()) == 204
+    assert _post(coll.port, "/api/v1/telegraf",
+                 b"cpu,host=x usage=5.5 1700000000000000000\n") == 204
+    deadline = time.time() + 10
+    while ing.ext_metrics.samples < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert ing.ext_metrics.samples == 2
+    ing.flush()
+    rows = ing.store.table("ext_metrics", "ext_samples").scan()
+    assert sorted(rows["value"].tolist()) == [1.0, 5.5]
+
+
+def test_profile_ingest(stack):
+    ing, coll = stack
+    p = telemetry_pb2.Profile(timestamp=1_700_000_000_000_000_000,
+                              app_service="svc", event_type="on-cpu",
+                              stack="a;b", value=3)
+    assert _post(coll.port, "/api/v1/profile/ingest",
+                 p.SerializeToString()) == 204
+    deadline = time.time() + 10
+    while ing.profile.profiles < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert ing.profile.profiles == 1
+
+
+def test_unknown_path_is_400(stack):
+    _, coll = stack
+    with pytest.raises(urllib.error.HTTPError):
+        _post(coll.port, "/nope", b"x")
+
+
+def test_stats_shipper_self_telemetry(stack):
+    from deepflow_tpu.runtime.stats import StatsRegistry, StatsShipper
+
+    ing, _ = stack
+    reg = StatsRegistry()
+    reg.register("unit.test", lambda: {"value": 42.0})
+    shipper = StatsShipper(reg, f"127.0.0.1:{ing.port}")
+    reg.collect()
+    shipper.flush()
+    deadline = time.time() + 10
+    while ing.ext_metrics.samples < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    ing.flush()
+    rows = ing.store.table("deepflow_system", "ext_samples").scan()
+    assert 42.0 in rows["value"].tolist()
+    name = ing.tag_dicts.get("metric_name").decode(rows["metric"][0])
+    assert name.startswith("unit.test")
+    shipper.close()
+
+
+def test_custom_parser_plugin():
+    class MemcacheParser:
+        proto = 900
+        transports = (6,)
+
+        def check(self, payload):
+            return payload.startswith((b"get ", b"set "))
+
+        def parse(self, payload):
+            verb = payload.split(b" ", 1)[0].decode()
+            return L7Record(self.proto, MSG_REQUEST, endpoint=verb)
+
+    before = len(PARSERS)
+    register_parser(MemcacheParser())
+    try:
+        rec = parse_payload(b"get somekey\r\n", proto=6)
+        assert rec.proto == 900 and rec.endpoint == "get"
+        # UDP payload doesn't match a TCP-only plugin
+        assert parse_payload(b"get somekey\r\n", proto=17) is None
+        with pytest.raises(TypeError):
+            register_parser(object())
+    finally:
+        del PARSERS[before:]

@@ -1,0 +1,144 @@
+"""OTel span ingest + OTLP exporter."""
+
+import json
+import socket
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.decode.columnar import (L7_PROTO_GRPC, L7_PROTO_HTTP1,
+                                          decode_otel_frames)
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.otlp_exporter import OtlpExporter, l7_chunk_to_otlp
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+from deepflow_tpu.wire.gen import otel_pb2
+
+
+def _trace_request():
+    req = otel_pb2.ExportTraceServiceRequest()
+    rs = req.resource_spans.add()
+    ss = rs.scope_spans.add()
+    s1 = ss.spans.add()
+    s1.name = "GET /api/users"
+    s1.start_time_unix_nano = 1_700_000_000_000_000_000
+    s1.end_time_unix_nano = 1_700_000_000_005_000_000
+    kv = s1.attributes.add()
+    kv.key = "http.method"
+    kv.value.string_value = "GET"
+    s2 = ss.spans.add()
+    s2.name = "UserService/Get"
+    s2.start_time_unix_nano = 1_700_000_000_000_000_000
+    s2.end_time_unix_nano = 1_700_000_000_001_000_000
+    s2.status.code = 2
+    kv = s2.attributes.add()
+    kv.key = "rpc.system"
+    kv.value.string_value = "grpc"
+    kv = s2.attributes.add()
+    kv.key = "net.peer.port"
+    kv.value.int_value = 9090
+    return req
+
+
+def test_decode_otel_frames():
+    payload = _trace_request().SerializeToString()
+    cols, bad = decode_otel_frames([payload])
+    assert bad == 0
+    assert len(cols["timestamp"]) == 2
+    assert cols["l7_protocol"].tolist() == [L7_PROTO_HTTP1, L7_PROTO_GRPC]
+    assert cols["rrt_us"].tolist() == [5000, 1000]
+    assert cols["status"].tolist() == [0, 1]
+    assert cols["port_dst"].tolist() == [0, 9090]
+    # compressed flavor
+    cc, bad = decode_otel_frames([zlib.compress(payload)], compressed=True)
+    assert bad == 0 and cc["rrt_us"].tolist() == [5000, 1000]
+    # garbage is skipped and counted, not fatal
+    gc, bad = decode_otel_frames([b"junk" * 10])
+    assert bad == 1 and len(gc["timestamp"]) == 0
+
+
+def test_otel_through_ingester(tmp_path):
+    ing = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path)))
+    ing.start()
+    try:
+        payload = _trace_request().SerializeToString()
+        frames = [
+            encode_frame(MessageType.OPENTELEMETRY, payload,
+                         FlowHeader(sequence=1, vtap_id=3)),
+            encode_frame(MessageType.OPENTELEMETRY_COMPRESSED,
+                         zlib.compress(payload),
+                         FlowHeader(sequence=2, vtap_id=3)),
+        ]
+        with socket.create_connection(("127.0.0.1", ing.port),
+                                      timeout=5) as s:
+            for fr in frames:
+                s.sendall(fr)
+        otel_dec = [d for d in ing.flow_log.decoders if d.frame_mode][0]
+        deadline = time.time() + 10
+        while otel_dec.records < 4 and time.time() < deadline:
+            time.sleep(0.05)
+        assert otel_dec.records == 4
+        ing.flush()
+        rows = ing.store.table("flow_log", "l7_flow_log").scan()
+        assert len(rows["timestamp"]) == 4
+        assert sorted(rows["l7_protocol"].tolist()) == \
+            sorted([L7_PROTO_HTTP1, L7_PROTO_GRPC] * 2)
+    finally:
+        ing.close()
+
+
+class _Collector(BaseHTTPRequestHandler):
+    received = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers["Content-Length"])
+        _Collector.received.append((self.path, self.rfile.read(length)))
+        self.send_response(200)
+        self.end_headers()
+
+
+def test_otlp_exporter_roundtrip():
+    _Collector.received = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Collector)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        exp = OtlpExporter(f"http://127.0.0.1:{httpd.server_address[1]}")
+        exp.start()
+        cols = {
+            "endpoint_hash": np.array([0xAB, 0xCD], np.uint32),
+            "timestamp": np.array([1_700_000_000] * 2, np.uint32),
+            "rrt_us": np.array([1500, 900], np.uint32),
+            "status": np.array([0, 1], np.uint32),
+            "l7_protocol": np.array([20, 41], np.uint32),
+            "port_dst": np.array([80, 9090], np.uint32),
+        }
+        assert exp.is_export_data("l7_flow_log", cols)
+        exp.put("l7_flow_log", 0, cols)
+        deadline = time.time() + 10
+        while not _Collector.received and time.time() < deadline:
+            time.sleep(0.05)
+        exp.close()
+        assert exp.spans_sent == 2
+        path, body = _Collector.received[0]
+        assert path == "/v1/traces"
+        back = otel_pb2.ExportTraceServiceRequest()
+        back.ParseFromString(body)
+        spans = back.resource_spans[0].scope_spans[0].spans
+        assert len(spans) == 2
+        assert spans[0].name == "endpoint-000000ab"
+        assert spans[1].status.code == 2
+        # ingest our own export: full circle
+        cols2, _ = decode_otel_frames([body])
+        assert cols2["rrt_us"].tolist() == [1500, 900]
+        # OTel-ingested spans use a distinct stream name, so the OTLP
+        # exporter never re-exports them (no feedback loop)
+        assert not exp.is_export_data("l7_flow_log.otel", cols)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
