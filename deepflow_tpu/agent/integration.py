@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from deepflow_tpu.agent.sender import UniformSender
+from deepflow_tpu.utils import snappy
 from deepflow_tpu.wire.codec import pack_pb_records
 from deepflow_tpu.wire.framing import MessageType
 from deepflow_tpu.wire.gen import telemetry_pb2
@@ -45,8 +46,12 @@ class IntegrationCollector:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length)
-                    if self.headers.get("Content-Encoding") == "gzip":
+                    enc = self.headers.get("Content-Encoding", "")
+                    if enc == "gzip":
                         body = gzip.decompress(body)
+                    elif enc == "snappy":
+                        # Prometheus remote-write mandates snappy
+                        body = snappy.decompress(body)
                     path = urllib.parse.urlparse(self.path).path
                     ok = outer.handle(path, body)
                 except Exception:

@@ -70,13 +70,15 @@ def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
     return cols
 
 
-def decode_l7_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
+def decode_l7_records(records: Iterable[bytes],
+                      endpoint_dict=None) -> Dict[str, np.ndarray]:
     """Parse AppProtoLogsData records into L7_SCHEMA columns.
 
-    String endpoints are hashed to uint32 on the host (FNV-1a), matching the
+    String endpoints are hashed to uint32 on the host, matching the
     SmartEncoding philosophy: strings become integers before they reach the
     columnar/device domain (reference: the tagrecorder dictionary approach,
-    SURVEY.md §2.3).
+    SURVEY.md §2.3). With `endpoint_dict` (a TagDict) the hash is recorded
+    reversibly; without, a raw FNV-1a is used.
     """
     rows: List[tuple] = []
     for raw in records:
@@ -86,11 +88,13 @@ def decode_l7_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
         except Exception:
             continue
         b = m.base
-        endpoint = (m.req.endpoint or m.req.resource or m.req.domain).encode()
+        endpoint = m.req.endpoint or m.req.resource or m.req.domain
+        eh = endpoint_dict.encode_one(endpoint) if endpoint_dict is not None \
+            else _fnv1a32(endpoint.encode())
         rows.append((
             b.ip_src, b.ip_dst, b.port_src, b.port_dst, b.protocol,
             b.head.proto, b.head.msg_type, b.vtap_id,
-            _fnv1a32(endpoint), m.resp.status,
+            eh, m.resp.status,
             _u32(b.head.rrt // 1000), _u32(m.req_len), _u32(m.resp_len),
             _u32(b.start_time // _NS_PER_S),
         ))
@@ -106,7 +110,8 @@ def decode_l7_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
 
 
 def decode_otel_frames(payloads: Iterable[bytes],
-                       compressed: bool = False):
+                       compressed: bool = False, vtap_id: int = 0,
+                       endpoint_dict=None):
     """OTLP trace exports -> (L7_SCHEMA columns, bad_payload_count)
     (reference: flow_log decoder.go:219 zlib+pb decode ->
     log_data/otel.go span mapping).
@@ -146,11 +151,17 @@ def decode_otel_frames(payloads: Iterable[bytes],
                             & 0xFFFF) if "net.peer.port" in attrs else 0
                     dur_us = max(span.end_time_unix_nano
                                  - span.start_time_unix_nano, 0) // 1000
+                    # record the name in the endpoint dictionary so the
+                    # hash is reversible at query/export time (its probing
+                    # also resolves collisions, unlike a raw fnv)
+                    eh = endpoint_dict.encode_one(span.name) \
+                        if endpoint_dict is not None \
+                        else _fnv1a32(span.name.encode())
                     rows.append((
                         0, 0, 0, port, 6, l7,
                         3,                       # msg_type: session
-                        0,                       # vtap: from flow header
-                        _fnv1a32(span.name.encode()),
+                        vtap_id,
+                        eh,
                         1 if span.status.code == 2 else 0,
                         _u32(dur_us),
                         0, 0,

@@ -146,7 +146,12 @@ class ExtMetricsPipeline:
         except Exception:
             pm = telemetry_pb2.PrometheusMetric()
             wr = telemetry_pb2.WriteRequest()
-            wr.ParseFromString(payload)
+            try:
+                wr.ParseFromString(payload)
+            except Exception:
+                # a direct remote-write sender ships snappy-compressed
+                from deepflow_tpu.utils import snappy
+                wr.ParseFromString(snappy.decompress(payload))
         extra = list(zip(pm.extra_label_names, pm.extra_label_values))
         ts_l, m_l, l_l, v_l = [], [], [], []
         for series in wr.timeseries:

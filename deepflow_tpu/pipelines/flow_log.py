@@ -146,15 +146,23 @@ class FlowLogPipeline:
                  exporters: Optional[Exporters] = None,
                  n_decoders: int = 2, queue_size: int = 16384,
                  throttle_per_s: int = 50_000,
-                 stats: Optional[StatsRegistry] = None) -> None:
+                 stats: Optional[StatsRegistry] = None,
+                 tag_dicts=None) -> None:
         self.decoders: List[_Decoder] = []
         self.writers: List[StoreWriter] = []
         self._streams = []
+        endpoint_dict = None if tag_dicts is None \
+            else tag_dicts.get("l7_endpoint")
+
+        def decode_l7(records):
+            return columnar.decode_l7_records(records,
+                                              endpoint_dict=endpoint_dict)
+
         for stream, msg_type, table_schema, decode_fn, enrich_fn in (
             ("l4_flow_log", MessageType.TAGGEDFLOW, L4_TABLE,
              columnar.decode_l4_records, platform.stamp_l4),
             ("l7_flow_log", MessageType.PROTOCOLLOG, L7_TABLE,
-             columnar.decode_l7_records, lambda c: c),
+             decode_l7, lambda c: c),
         ):
             queues = MultiQueue(f"ingest.{stream}", n_decoders, queue_size)
             receiver.register_handler(msg_type, queues)
@@ -188,18 +196,21 @@ class FlowLogPipeline:
         # OTel spans: raw + zlib-compressed frames land in l7_flow_log too
         # (reference: flow_log.go OTel+compressed Loggers :99-106)
         def _decode_otel(frames: List[Frame]):
-            raw = [f.payload for f in frames
-                   if f.msg_type == MessageType.OPENTELEMETRY]
-            comp = [f.payload for f in frames
-                    if f.msg_type == MessageType.OPENTELEMETRY_COMPRESSED]
+            # per-frame decode so each span batch carries its sender's
+            # vtap_id from the flow header (reference stamps VtapID the
+            # same way)
             parts, bad = [], 0
-            for payloads, z in ((raw, False), (comp, True)):
-                if payloads:
-                    c, b = columnar.decode_otel_frames(payloads,
-                                                       compressed=z)
-                    bad += b
-                    if len(next(iter(c.values()))):
-                        parts.append(c)
+            for f in frames:
+                c, b = columnar.decode_otel_frames(
+                    [f.payload],
+                    compressed=(f.msg_type
+                                == MessageType.OPENTELEMETRY_COMPRESSED),
+                    vtap_id=(f.flow_header.vtap_id if f.flow_header
+                             else 0),
+                    endpoint_dict=endpoint_dict)
+                bad += b
+                if len(next(iter(c.values()))):
+                    parts.append(c)
             if not parts:
                 return columnar.decode_otel_frames([])[0], bad
             return ({k: np.concatenate([p[k] for p in parts])

@@ -79,7 +79,8 @@ class Ingester:
         self.flow_log = FlowLogPipeline(
             self.receiver, self.store, self.platform, self.exporters,
             n_decoders=cfg.n_decoders, queue_size=cfg.queue_size,
-            throttle_per_s=cfg.throttle_per_s, stats=self.stats)
+            throttle_per_s=cfg.throttle_per_s, stats=self.stats,
+            tag_dicts=self.tag_dicts)
         self.flow_metrics = FlowMetricsPipeline(
             self.receiver, self.store, self.exporters,
             n_unmarshallers=cfg.n_decoders, queue_size=cfg.queue_size,

@@ -60,6 +60,9 @@ class StatsRegistry:
     def add_sink(self, sink: Callable[[StatSample], None]) -> None:
         self._sinks.append(sink)
 
+    def remove_sink(self, sink: Callable[[StatSample], None]) -> None:
+        self._sinks = [s for s in self._sinks if s is not sink]
+
     def collect(self) -> List[StatSample]:
         """Scrape every source once; append to history and fan to sinks."""
         now = time.time()
@@ -123,10 +126,13 @@ class StatsShipper:
         registry.add_sink(self._on_sample)
         self._batch: List = []
         self._lock = threading.Lock()
+        self._closed = False
 
     def _on_sample(self, sample: StatSample) -> None:
         from deepflow_tpu.wire.gen import stats_pb2
 
+        if self._closed:
+            return
         st = stats_pb2.Stats(
             timestamp=int(sample.ts), name=sample.module,
             tag_names=list(sample.tags.keys()),
@@ -150,6 +156,8 @@ class StatsShipper:
             self._batch = []
 
     def close(self) -> None:
+        self._closed = True
+        self.registry.remove_sink(self._on_sample)
         self.flush()
         self.sender.close()
 

@@ -1,0 +1,219 @@
+"""All-in-one server: controller + ingester + querier in one process.
+
+Reference: server/cmd/server/main.go — one binary starts the controller
+(election -> resource model -> trisolaris), the ingester (receiver +
+pipelines), and the querier behind a single /etc/server.yaml, plus a
+config watcher that restarts on change (server/ingester/config/
+watcher.go). Same shape here: `Server(config_path).start()`, or
+`python -m deepflow_tpu.server -f server.yaml`.
+
+Config (all keys optional):
+
+    controller:
+      enabled: true
+      port: 20417
+      lease_path: /tmp/df-lease.json
+    ingester:
+      port: 30033
+      store_path: /var/lib/deepflow-tpu
+      debug_port: 30035
+      throttle_per_s: 50000
+      tpu_sketch_window_s: 1.0
+    querier:
+      enabled: true
+      port: 20416
+    self_telemetry: true
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+import yaml
+
+
+def load_config(path: Optional[str]) -> dict:
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+class Server:
+    def __init__(self, config_path: Optional[str] = None) -> None:
+        self.config_path = config_path
+        self.cfg = load_config(config_path)
+        self._watch_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._build()
+
+    # -- construction ------------------------------------------------------
+    def _build(self) -> None:
+        from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                             VTapRegistry)
+        from deepflow_tpu.controller.election import Election
+        from deepflow_tpu.controller.monitor import FleetMonitor
+        from deepflow_tpu.controller.platform_compiler import PlatformPusher
+        from deepflow_tpu.controller.tagrecorder import TagRecorder
+        from deepflow_tpu.pipelines import Ingester, IngesterConfig
+        from deepflow_tpu.querier.server import QuerierServer
+        from deepflow_tpu.runtime.stats import StatsShipper
+
+        c = self.cfg
+        ing_cfg = c.get("ingester", {})
+        store_path = ing_cfg.get("store_path")
+
+        ctl_cfg = c.get("controller", {})
+        self.controller = None
+        self.election = None
+        self.tagrecorder = None
+        if ctl_cfg.get("enabled", True):
+            state_dir = store_path or "/tmp/deepflow-tpu"
+            os.makedirs(state_dir, exist_ok=True)
+            self.model = ResourceModel(os.path.join(state_dir, "model.json"))
+            self.registry = VTapRegistry(
+                os.path.join(state_dir, "vtaps.json"))
+            self.monitor = FleetMonitor(self.registry)
+            self.election = Election(
+                ctl_cfg.get("lease_path",
+                            os.path.join(state_dir, "lease.json")))
+            self.tagrecorder = TagRecorder(self.model, root=state_dir)
+            self.controller = ControllerServer(
+                self.model, self.registry, self.monitor,
+                election=self.election, tagrecorder=self.tagrecorder,
+                port=ctl_cfg.get("port", 20417))
+
+        self.ingester = Ingester(IngesterConfig(
+            listen_port=ing_cfg.get("port", 30033),
+            listen_host=ing_cfg.get("host", "127.0.0.1"),
+            store_path=store_path,
+            debug_port=ing_cfg.get("debug_port"),
+            n_decoders=ing_cfg.get("n_decoders", 2),
+            throttle_per_s=ing_cfg.get("throttle_per_s", 50_000),
+            store_max_bytes=ing_cfg.get("store_max_bytes", 100 << 30),
+            tpu_sketch_window_s=ing_cfg.get("tpu_sketch_window_s"),
+        ))
+        if self.controller is not None:
+            # in-process ingester enriches from this controller's model
+            PlatformPusher(self.model, self.ingester.platform)
+
+        q_cfg = c.get("querier", {})
+        self.querier = None
+        if q_cfg.get("enabled", True) and self.ingester.store is not None:
+            self.querier = QuerierServer(
+                self.ingester.store, self.ingester.tag_dicts,
+                port=q_cfg.get("port", 20416),
+                tagrecorder=self.tagrecorder)
+
+        self.stats_shipper = None
+        if c.get("self_telemetry", True):
+            # the server monitors itself through its own firehose
+            addr = f"127.0.0.1:{ing_cfg.get('port', 30033)}"
+            self.stats_shipper = StatsShipper(self.ingester.stats, addr)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self.election is not None:
+            self.election.start()
+        if self.controller is not None:
+            self.controller.start()
+        self.ingester.start()
+        if self.stats_shipper is not None:
+            # shipper targets the real bound port (port may have been 0)
+            self.stats_shipper.sender.set_target(
+                f"127.0.0.1:{self.ingester.port}")
+            self.ingester.stats.start(interval_s=10.0)
+        if self.querier is not None:
+            self.querier.start()
+        if self.config_path is not None:
+            self._watch_thread = threading.Thread(
+                target=self._watch_config, name="config-watcher",
+                daemon=True)
+            self._watch_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+        with self._lock:
+            self._close_components()
+
+    def _close_components(self) -> None:
+        if self.querier is not None:
+            self.querier.close()
+        if self.stats_shipper is not None:
+            self.ingester.stats.stop()
+            self.stats_shipper.close()
+        self.ingester.close()
+        if self.controller is not None:
+            self.controller.close()
+        if self.election is not None:
+            self.election.close()
+
+    # -- config watcher ----------------------------------------------------
+    def _watch_config(self) -> None:
+        """Restart components when the config file changes (reference:
+        ingester/config/watcher.go exits for the supervisor to restart;
+        in-process we rebuild)."""
+        try:
+            last = os.path.getmtime(self.config_path)
+        except OSError:
+            last = 0.0
+        while not self._stop.wait(5.0):
+            try:
+                cur = os.path.getmtime(self.config_path)
+            except OSError:
+                continue
+            if cur != last:
+                last = cur
+                self.reload()
+
+    def reload(self) -> None:
+        with self._lock:
+            new_cfg = load_config(self.config_path)
+            if new_cfg == self.cfg:
+                return
+            self._close_components()
+            self.cfg = new_cfg
+            self._build()
+            # restart everything except the watcher (already running)
+            if self.election is not None:
+                self.election.start()
+            if self.controller is not None:
+                self.controller.start()
+            self.ingester.start()
+            if self.stats_shipper is not None:
+                self.stats_shipper.sender.set_target(
+                    f"127.0.0.1:{self.ingester.port}")
+                self.ingester.stats.start(interval_s=10.0)
+            if self.querier is not None:
+                self.querier.start()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="deepflow-tpu-server")
+    ap.add_argument("-f", "--config", default=None)
+    args = ap.parse_args(argv)
+    server = Server(args.config)
+    server.start()
+    print(f"deepflow-tpu server up: ingester :{server.ingester.port}"
+          + (f", controller :{server.controller.port}"
+             if server.controller else "")
+          + (f", querier :{server.querier.port}" if server.querier else ""))
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

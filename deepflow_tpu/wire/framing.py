@@ -48,6 +48,8 @@ class MessageType(enum.IntEnum):
 
     @property
     def has_flow_header(self) -> bool:
+        # HEADER_TYPE_LT_VTAP set (reference: droplet-message.go:97-115 —
+        # COMPRESS/SYSLOG/STATSD are the only header-less types)
         return self in (
             MessageType.METRICS,
             MessageType.TAGGEDFLOW,
@@ -56,6 +58,8 @@ class MessageType(enum.IntEnum):
             MessageType.PROMETHEUS,
             MessageType.TELEGRAF,
             MessageType.PACKETSEQUENCE,
+            MessageType.DFSTATS,
+            MessageType.OPENTELEMETRY_COMPRESSED,
             MessageType.RAW_PCAP,
             MessageType.PROFILE,
             MessageType.PROC_EVENT,

@@ -86,6 +86,11 @@ def test_otel_through_ingester(tmp_path):
         assert len(rows["timestamp"]) == 4
         assert sorted(rows["l7_protocol"].tolist()) == \
             sorted([L7_PROTO_HTTP1, L7_PROTO_GRPC] * 2)
+        # vtap stamped from the flow header, names recoverable
+        assert rows["vtap_id"].tolist() == [3] * 4
+        names = {ing.tag_dicts.get("l7_endpoint").decode(h)
+                 for h in rows["endpoint_hash"]}
+        assert names == {"GET /api/users", "UserService/Get"}
     finally:
         ing.close()
 
