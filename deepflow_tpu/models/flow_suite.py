@@ -107,9 +107,10 @@ def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
     services = hll.update(state.services, group, cols["ip_src"], mask=mask)
     feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
     packets = cols["packet_tx"] + cols["packet_rx"]
-    # 3 weight planes: per-record packet counts are exact up to 2^24
+    # 2 weight planes: per-record packet counts saturate at 65535
+    # (ample for 1s flow ticks); the third plane cost a full matmul pass
     ent = entropy.update(state.ent, feats, packets.astype(jnp.int32), mask,
-                         weight_planes=3)
+                         weight_planes=2)
     return FlowSuiteState(
         sketch=sketch,
         ring=ring,

@@ -73,8 +73,10 @@ def update(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
            mask: jnp.ndarray, cfg: MetricsSuiteConfig) -> MetricsSuiteState:
     feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
     packets = (cols["packet_tx"] + cols["packet_rx"]).astype(jnp.int32)
-    # 3 weight planes keep per-record packet weights exact up to 2^24
-    ent = entropy.update(state.ent, feats, packets, mask, weight_planes=3)
+    # 2 weight planes: per-record packet counts saturate at 65535
+    # (ample for 1s flow ticks) and each plane costs a full matmul
+    # pass, so the third plane was pure overhead
+    ent = entropy.update(state.ent, feats, packets, mask, weight_planes=2)
     p = pca.update(state.pca, signal_matrix(cols), mask, lr=cfg.pca_lr)
     return state._replace(ent=ent, pca=p)
 

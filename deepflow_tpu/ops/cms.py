@@ -59,7 +59,10 @@ def update(state: CMSState, keys: jnp.ndarray, weights: jnp.ndarray | None = Non
     use_mxu = method == "mxu" or (method == "auto" and n >= mxu_hist.MIN_LANES)
     idx = hashing.multi_bucket(keys, state.seeds, lw)          # [d, n]
     if use_mxu:
-        h = mxu_hist.hist_masked(idx, w, weights, mask, weight_planes)
+        # chunk 32768: at CMS widths (2^17) larger chunks amortize the
+        # scan step overhead (measured ~6%% faster than 16384 on v5e)
+        h = mxu_hist.hist_masked(idx, w, weights, mask, weight_planes,
+                                 chunk=32768)
         return state._replace(counts=state.counts + h.astype(state.counts.dtype))
     if weights is None:
         weights = jnp.ones((n,), dtype=state.counts.dtype)

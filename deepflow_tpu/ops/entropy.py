@@ -50,7 +50,10 @@ def update(state: EntropyState, feature_cols: jnp.ndarray,
     salt = state.seeds[:, 1][:, None]
     idx = hashing.bucket(feature_cols, mult, salt, lb)           # [f, n]
     if method == "mxu" or (method == "auto" and n >= mxu_hist.MIN_LANES):
-        h = mxu_hist.hist_masked(idx, b, weights, mask, weight_planes)
+        # chunk 8192: at entropy widths (2^12) smaller chunks fit VMEM
+        # better (measured ~10%% faster than 16384 on v5e)
+        h = mxu_hist.hist_masked(idx, b, weights, mask, weight_planes,
+                                 chunk=8192)
         return state._replace(hist=state.hist + h.astype(state.hist.dtype))
     if weights is None:
         weights = jnp.ones((n,), dtype=state.hist.dtype)

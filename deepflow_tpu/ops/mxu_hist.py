@@ -44,14 +44,15 @@ MIN_LANES = 8192
 
 def hist_masked(idx: jnp.ndarray, width: int,
                 weights: jnp.ndarray | None, mask: jnp.ndarray | None,
-                weight_planes: int = 2) -> jnp.ndarray:
+                weight_planes: int = 2, chunk: int = 16384) -> jnp.ndarray:
     """`hist` with the mask folded into the weights (shared dispatch helper
     for cms.update / entropy.update: mask-only batches need just one plane)."""
     if weights is None and mask is not None:
         weights, weight_planes = mask.astype(jnp.int32), 1
     elif weights is not None and mask is not None:
         weights = weights.astype(jnp.int32) * mask.astype(jnp.int32)
-    return hist(idx, width, weights, weight_planes=weight_planes)
+    return hist(idx, width, weights, chunk=chunk,
+                weight_planes=weight_planes)
 
 
 def hist(idx: jnp.ndarray, width: int, weights: jnp.ndarray | None = None,
