@@ -165,6 +165,17 @@ class MysqlParser:
 
 PARSERS: List = [HttpParser(), DnsParser(), MysqlParser(), RedisParser()]
 
+# the extended set (TLS, HTTP/2+gRPC, Kafka, PostgreSQL, MongoDB, Dubbo,
+# MQTT, AMQP, NATS, OpenWire, FastCGI, SofaRPC) registers behind the four
+# core parsers; deferred import because l7_ext imports this module's types
+def _register_extended() -> None:
+    from deepflow_tpu.agent import l7_ext
+
+    l7_ext.register_extended(PARSERS)
+
+
+_register_extended()
+
 
 def register_parser(parser, prepend: bool = False) -> None:
     """Plug in a custom protocol parser (the role of the reference's

@@ -107,6 +107,27 @@ class UniformSender:
         self.sent_records += sent
         return sent
 
+    def send_columns(self, cols, schema) -> int:
+        """Send column arrays as planar COLUMNAR_FLOW payloads (the
+        TPU-native wire mode: no per-row protobuf serialization on the
+        agent, no varint walk on the server — wire/columnar_wire.py).
+        Chunks rows so each frame stays under the wire max. Returns rows
+        sent."""
+        from deepflow_tpu.wire import columnar_wire
+
+        n = len(next(iter(cols.values())))
+        if n == 0:
+            return 0
+        rows_per_frame = max(1, (_BATCH_BYTES - columnar_wire.HEADER_LEN)
+                             // (4 * len(schema.columns)))
+        sent = 0
+        for lo in range(0, n, rows_per_frame):
+            hi = min(lo + rows_per_frame, n)
+            chunk = {k: v[lo:hi] for k, v in cols.items()}
+            if self.send_raw(columnar_wire.encode_columnar(chunk, schema)):
+                sent += hi - lo
+        return sent
+
     def send_raw(self, payload: bytes) -> bool:
         """Frame one raw payload as-is (streams whose frame body is a
         single message — OTel exports, influx text — rather than a

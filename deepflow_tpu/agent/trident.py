@@ -43,6 +43,31 @@ class AgentConfig:
     escape_after_s: float = 300.0
     revision: str = "deepflow-tpu-agent"
     l7_enabled: bool = True
+    # "columnar" ships tick flows as planar COLUMNAR_FLOW frames (the
+    # TPU-native wire: vectorized encode, memcpy decode); "protobuf"
+    # emits per-row TaggedFlow records for reference-compatible servers
+    wire_mode: str = "columnar"
+
+
+def columns_to_l4_schema(cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Vectorized tick-columns -> L4_SCHEMA planar columns, the payload of
+    the columnar wire mode. Matches the server decoders' unit contract
+    (timestamp s, duration us, 4-byte planes) without any per-row work."""
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+
+    out: Dict[str, np.ndarray] = {}
+    for name, dt in L4_SCHEMA.columns:
+        if name == "timestamp":
+            out[name] = (cols["start_time"]
+                         // np.uint64(1_000_000_000)).astype(dt)
+        elif name == "duration_us":
+            out[name] = np.minimum(cols["duration"] // np.uint64(1000),
+                                   np.uint64(0xFFFFFFFF)).astype(dt)
+        elif name in cols:
+            out[name] = cols[name].astype(dt, copy=False)
+        else:
+            out[name] = np.zeros(len(cols["ip_src"]), dt)
+    return out
 
 
 def columns_to_l4_records(cols: Dict[str, np.ndarray]) -> List[bytes]:
@@ -115,7 +140,7 @@ class Agent:
         self.senders: Dict[MessageType, UniformSender] = {
             mt: UniformSender(mt, cfg.ingester_addr)
             for mt in (MessageType.TAGGEDFLOW, MessageType.METRICS,
-                       MessageType.PROTOCOLLOG)
+                       MessageType.PROTOCOLLOG, MessageType.COLUMNAR_FLOW)
         }
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -225,8 +250,15 @@ class Agent:
         sent = {"flows": 0, "documents": 0, "l7": 0}
         if flows:
             cols = flows_to_columns(flows, self.vtap_id, now_ns)
-            records = columns_to_l4_records(cols)
-            sent["flows"] = self.senders[MessageType.TAGGEDFLOW].send(records)
+            if self.cfg.wire_mode == "columnar":
+                from deepflow_tpu.batch.schema import L4_SCHEMA
+                sent["flows"] = self.senders[
+                    MessageType.COLUMNAR_FLOW].send_columns(
+                        columns_to_l4_schema(cols), L4_SCHEMA)
+            else:
+                records = columns_to_l4_records(cols)
+                sent["flows"] = self.senders[
+                    MessageType.TAGGEDFLOW].send(records)
             docs = flows_to_documents(cols, now_ns // 1_000_000_000)
             doc_records = documents_to_records(docs)
             sent["documents"] = self.senders[MessageType.METRICS].send(

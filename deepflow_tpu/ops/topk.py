@@ -74,13 +74,15 @@ def offer(state: TopKState, batch_keys: jnp.ndarray, sketch: cms.CMSState,
     if sample_log2 > 0:
         bk = jnp.roll(bk, -(jnp.asarray(phase) % (1 << sample_log2)))
         bk = bk[:: 1 << sample_log2]
-    est = cms.query(sketch, bk).astype(jnp.int32)
-    est = jnp.where(bk == SENTINEL, -1, est)
-    # Standing candidates get re-scored too: their CMS estimates only grow.
-    standing = jnp.where(state.keys == SENTINEL, -1,
-                         cms.query(sketch, state.keys).astype(jnp.int32))
+    # Standing candidates get re-scored too (their CMS estimates only
+    # grow), in the SAME query as the batch keys: one concat + one gather
+    # instead of a separate ring-sized pass. Besides saving a gather,
+    # keeping ring-shaped work off its own tiny fusion matters on the
+    # remote-TPU runtime: standalone [ring]-sized select kernels trip a
+    # pathological slow mode in the transfer layer (see bench.py notes).
     all_keys = jnp.concatenate([state.keys, bk])
-    all_counts = jnp.concatenate([standing, est])
+    est = cms.query(sketch, all_keys).astype(jnp.int32)
+    all_counts = jnp.where(all_keys == SENTINEL, -1, est)
     k, c = _dedup_keep_max(all_keys, all_counts)
     top_c, top_i = jax.lax.top_k(c, state.keys.shape[0])
     return TopKState(keys=k[top_i], counts=top_c)

@@ -38,14 +38,18 @@ class _Decoder(threading.Thread):
     def __init__(self, stream: str, index: int, queues: MultiQueue,
                  decode_fn, enrich_fn, throttler: ColumnarThrottler,
                  writer: Optional[StoreWriter], exporters: Optional[Exporters],
-                 batch: int = 64, payload_decode_fn=None,
+                 batch: int = 64, payload_decode_fns=None,
                  frame_mode: bool = False) -> None:
         super().__init__(name=f"decode-{stream}-{index}", daemon=True)
         self.stream = stream
         self.index = index
         self.queues = queues
         self.decode_fn = decode_fn
-        self.payload_decode_fn = payload_decode_fn
+        # per-message-type payload fast paths ({MessageType: payload->cols}):
+        # the native protobuf walker for TAGGEDFLOW, the planar memcpy
+        # decode for COLUMNAR_FLOW; frames without an entry fall back to
+        # the Python record-list decoder
+        self.payload_decode_fns = payload_decode_fns or {}
         # frame_mode: decode_fn consumes whole frames (msg_type, payload)
         # instead of length-prefixed record lists (the OTel case —
         # one frame = one ExportTraceServiceRequest)
@@ -81,42 +85,39 @@ class _Decoder(threading.Thread):
                 return
             # falls through to the shared enrich/export/throttle tail
         else:
-            cols = None
-        if self.payload_decode_fn is not None:
-            # native fast path: each frame payload IS a packed record
-            # stream. Decode per frame (not one joined buffer) so a
-            # corrupt frame only loses its own tail, like the Python path.
-            try:
-                parts = []
-                for f in frames:
-                    c, bad = self.payload_decode_fn(f.payload)
-                    self.decode_errors += bad
-                    if len(next(iter(c.values()))):
-                        parts.append(c)
-                if parts:
-                    cols = {k: np.concatenate([p[k] for p in parts])
-                            for k in parts[0]}
-                else:
-                    cols = {k: v for k, v in
-                            self.payload_decode_fn(b"")[0].items()}
-            except Exception:
-                cols = None  # fall through to the Python oracle
-        if cols is None:
+            # fast paths decode per frame (not one joined buffer) so a
+            # corrupt frame only loses its own tail, like the Python path;
+            # frames without a fast path pool into one record-list decode
+            parts: List[Dict[str, np.ndarray]] = []
             records: List[bytes] = []
             for f in frames:
+                fast = self.payload_decode_fns.get(f.msg_type)
+                if fast is not None:
+                    try:
+                        c, bad = fast(f.payload)
+                        self.decode_errors += bad
+                        if len(next(iter(c.values()))):
+                            parts.append(c)
+                        continue
+                    except Exception:
+                        pass  # fall through to the Python oracle
                 try:
                     records.extend(iter_pb_records(f.payload))
                 except ValueError:
                     self.decode_errors += 1
-            if not records:
+            if records:
+                try:
+                    c = self.decode_fn(records)
+                    self.decode_errors += len(records) - \
+                        len(next(iter(c.values())))  # bad records skipped
+                    if len(next(iter(c.values()))):
+                        parts.append(c)
+                except Exception:
+                    self.decode_errors += 1
+            if not parts:
                 return
-            try:
-                cols = self.decode_fn(records)
-            except Exception:
-                self.decode_errors += 1
-                return
-            self.decode_errors += len(records) - \
-                len(next(iter(cols.values())))  # bad records skipped
+            cols = parts[0] if len(parts) == 1 else \
+                {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
         decoded = len(next(iter(cols.values()))) if cols else 0
         self.records += decoded
         if decoded == 0:
@@ -171,11 +172,19 @@ class FlowLogPipeline:
                 table = store.create_table(FLOW_LOG_DB, table_schema)
                 writer = StoreWriter(table, stats=stats)
                 self.writers.append(writer)
-            payload_fn = None
+            payload_fns = {}
             if stream == "l4_flow_log":
+                # planar frames from deepflow_tpu agents ride the same
+                # queues/decoders as protobuf TAGGEDFLOW from reference
+                # agents; the decode fast path is picked per frame
+                from deepflow_tpu.wire import columnar_wire
+                receiver.register_handler(MessageType.COLUMNAR_FLOW, queues)
+                payload_fns[MessageType.COLUMNAR_FLOW] = \
+                    columnar_wire.decode_columnar
                 from deepflow_tpu.decode import native
                 if native.available():
-                    payload_fn = native.decode_l4_payload
+                    payload_fns[MessageType.TAGGEDFLOW] = \
+                        native.decode_l4_payload
             # budget split across every consumer of the stream's writer so
             # the aggregate cap matches the config (reference: flow_log.go
             # throttle/queueCount); the l7 table is also fed by the OTel
@@ -187,7 +196,7 @@ class FlowLogPipeline:
                     max(1, throttle_per_s // n_consumers), seed=i)
                 d = _Decoder(stream, i, queues, decode_fn, enrich_fn,
                              throttler, writer, exporters,
-                             payload_decode_fn=payload_fn)
+                             payload_decode_fns=payload_fns)
                 self.decoders.append(d)
                 if stats is not None:
                     stats.register(f"decoder.{stream}.{i}", d.counters)

