@@ -133,6 +133,9 @@ class Receiver:
                                  name=f"recv-tcp-{addr[0]}:{addr[1]}",
                                  daemon=True)
             t.start()
+            # Prune threads of closed connections so a churning agent fleet
+            # doesn't grow the list unboundedly.
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _tcp_conn_loop(self, conn: socket.socket, addr) -> None:

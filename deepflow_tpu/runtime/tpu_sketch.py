@@ -6,8 +6,9 @@ that batches decoded l4_flow_log chunks into static-shape device tensors
 and advances the FlowSuite sketches (Count-Min top-K, per-service HLL,
 traffic entropy) in one jitted program per batch. Window flushes write
 heavy-hitter/cardinality/entropy rows into the store for the querier,
-and checkpoint the mergeable sketch state so a restart loses at most one
-window (SURVEY.md §5 checkpoint/resume).
+and checkpoint the mergeable sketch state so a restart loses at most
+`checkpoint_every` windows (default 1; idle windows are skipped)
+(SURVEY.md §5 checkpoint/resume).
 """
 
 from __future__ import annotations
@@ -62,6 +63,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                  batch_rows: int = 1 << 15,
                  window_seconds: float = 1.0,
                  checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
                          batch=64, stats=stats)
@@ -73,7 +75,9 @@ class TpuSketchExporter(QueueWorkerExporter):
         self.batcher = Batcher(L4_SCHEMA, capacity=batch_rows)
         self.state = flow_suite.init(self.cfg)
         self.checkpointer = None
+        self.checkpoint_every = max(1, checkpoint_every)
         self.windows = 0
+        self._rows_at_ckpt = 0
         if checkpoint_dir is not None:
             self.checkpointer = SketchCheckpointer(checkpoint_dir)
             restored = self.checkpointer.restore(self.state)
@@ -82,6 +86,9 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # resume the step counter past existing snapshots, else
                 # new saves sort below stale ones and GC eats them
                 self.windows = self.checkpointer.latest_step() or 0
+                # restored accumulation is live data this process hasn't
+                # counted; mark dirty so its replayed window checkpoints
+                self._rows_at_ckpt = -1
         self.topk_writer = self.window_writer = None
         if store is not None:
             self.topk_writer = StoreWriter(
@@ -158,9 +165,16 @@ class TpuSketchExporter(QueueWorkerExporter):
             self.windows += 1
             # checkpoint the PRE-flush state (the window's accumulation):
             # restore replays the window at-least-once; saving post-flush
-            # would snapshot a reset state and recover nothing
-            if self.checkpointer is not None:
+            # would snapshot a reset state and recover nothing. Cadence:
+            # every checkpoint_every-th window, and only if rows arrived
+            # since the last save (a full npz per 1s window is not
+            # "low-overhead"); restart then loses at most checkpoint_every
+            # windows instead of one — a documented, configurable trade.
+            dirty = self.rows_in != self._rows_at_ckpt
+            if (self.checkpointer is not None and dirty
+                    and self.windows % self.checkpoint_every == 0):
                 self.checkpointer.save(self.state, self.windows)
+                self._rows_at_ckpt = self.rows_in
             self.state, out = self._flush_fn(self.state)
         self.last_output = out
         self._write_output(out, int(now))

@@ -73,6 +73,28 @@ def test_checkpoint_gc_keeps_latest(tmp_path):
     assert ck.latest_step() == 4
 
 
+def test_checkpoint_cadence_skips_idle_and_off_cycle(tmp_path):
+    """Idle windows never checkpoint; checkpoint_every>1 saves only on
+    cycle boundaries, bounding restart loss to checkpoint_every windows."""
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+
+    exp = TpuSketchExporter(cfg=CFG, batch_rows=256, window_seconds=3600,
+                            checkpoint_dir=str(tmp_path / "ckpt"),
+                            checkpoint_every=2)
+    rng = np.random.default_rng(3)
+    cols = {name: rng.integers(0, 1 << 20, 100).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    exp.process([("l4_flow_log", 0, cols)])
+    exp.flush_window(now=100)          # window 1: dirty but off-cycle
+    assert exp.checkpointer.counters()["saves"] == 0
+    exp.process([("l4_flow_log", 0, cols)])
+    exp.flush_window(now=101)          # window 2: dirty + on-cycle -> save
+    assert exp.checkpointer.counters()["saves"] == 1
+    exp.flush_window(now=102)          # window 3: idle, off-cycle
+    exp.flush_window(now=103)          # window 4: idle -> skipped
+    assert exp.checkpointer.counters()["saves"] == 1
+
+
 def test_exporter_restart_replays_window(tmp_path):
     """Crash after a window: the restored state re-derives that window
     (at-least-once), so restart loses no accumulated data."""
