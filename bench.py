@@ -47,7 +47,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from deepflow_tpu.batch.schema import L4_SCHEMA
+    from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
     from deepflow_tpu.decode import native
     from deepflow_tpu.models import flow_suite
     from deepflow_tpu.replay.generator import SyntheticAgent
@@ -65,14 +65,14 @@ def main() -> None:
     # -- stage: one pool of distinct flows, Zipf-picked record streams ----
     agent = SyntheticAgent()
     base = agent.l4_columns(pool_n)
-    pool_schema = _to_schema(base, pool_n, L4_SCHEMA)
+    pool_schema = _to_schema(base, pool_n, SKETCH_L4_SCHEMA)
     pool_records = [agent.l4_record(base, i) for i in range(pool_n)]
 
     picks = [(rng.zipf(1.25, batch) - 1).clip(max=pool_n - 1)
              for _ in range(n_batches)]
     schema_batches = [{k: v[p] for k, v in pool_schema.items()}
                       for p in picks]
-    columnar_payloads = [columnar_wire.encode_columnar(c, L4_SCHEMA)
+    columnar_payloads = [columnar_wire.encode_columnar(c, SKETCH_L4_SCHEMA)
                          for c in schema_batches]
     pb_payloads = [pack_pb_records([pool_records[i] for i in p])
                    for p in picks]
@@ -97,7 +97,7 @@ def main() -> None:
 
     state = flow_suite.init(cfg)
     for payload in columnar_payloads:
-        cols, bad = columnar_wire.decode_columnar(payload, L4_SCHEMA)
+        cols, bad = columnar_wire.decode_columnar(payload, SKETCH_L4_SCHEMA)
         assert bad == 0
         state = step(state, {k: jnp.asarray(v) for k, v in cols.items()},
                      mask_d)
@@ -109,14 +109,14 @@ def main() -> None:
     state = flow_suite.init(cfg)
     for i in range(warmup):
         cols, _ = columnar_wire.decode_columnar(
-            columnar_payloads[i % n_batches], L4_SCHEMA)
+            columnar_payloads[i % n_batches], SKETCH_L4_SCHEMA)
         state = step(state, {k: jnp.asarray(v) for k, v in cols.items()},
                      mask_d)
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     for i in range(iters):
         cols, _ = columnar_wire.decode_columnar(
-            columnar_payloads[i % n_batches], L4_SCHEMA)
+            columnar_payloads[i % n_batches], SKETCH_L4_SCHEMA)
         state = step(state, {k: jnp.asarray(v) for k, v in cols.items()},
                      mask_d)
     jax.block_until_ready(state)
@@ -125,14 +125,22 @@ def main() -> None:
     # -- timed: e2e protobuf wire (native decoder, ping-pong buffers) ------
     pb_rate = None
     if native.available():
-        ncols = len(L4_SCHEMA.columns)
-        bufs = [np.empty((ncols, batch), np.uint32) for _ in range(2)]
+        # full wide decode (the honest cost), but only the kernel-consumed
+        # sketch columns cross to the device. The sketch subset is the
+        # head block of the u32 plane (schema core comes first).
+        n32, n64 = len(native.L4_COLS32), len(native.L4_COLS64)
+        sketch_names = set(SKETCH_L4_SCHEMA.names)
+        sketch_idx = [(j, name, dt) for j, (name, dt)
+                      in enumerate(native.L4_COLS32) if name in sketch_names]
+        bufs = [(np.empty((n32, batch), np.uint32),
+                 np.empty((n64, batch), np.uint64)) for _ in range(2)]
 
         def pb_step(state, payload, buf):
-            rows, bad, _ = native.decode_l4_into(payload, buf)
+            buf32, buf64 = buf
+            rows, bad, _ = native.decode_l4_into(payload, buf32, buf64)
             cols = {}
-            for j, (name, dt) in enumerate(L4_SCHEMA.columns):
-                col = buf[j, :rows]
+            for j, name, dt in sketch_idx:
+                col = buf32[j, :rows]
                 cols[name] = col.view(np.int32) \
                     if np.dtype(dt) == np.int32 else col
             return step(state, {k: jnp.asarray(v) for k, v in cols.items()},

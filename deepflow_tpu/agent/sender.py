@@ -119,7 +119,7 @@ class UniformSender:
         if n == 0:
             return 0
         rows_per_frame = max(1, (_BATCH_BYTES - columnar_wire.HEADER_LEN)
-                             // (4 * len(schema.columns)))
+                             // schema.row_bytes())
         sent = 0
         for lo in range(0, n, rows_per_frame):
             hi = min(lo + rows_per_frame, n)

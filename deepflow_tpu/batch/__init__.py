@@ -1,4 +1,6 @@
-from deepflow_tpu.batch.schema import L4_SCHEMA, METRIC_SCHEMA, Schema
+from deepflow_tpu.batch.schema import (L4_SCHEMA, L7_SCHEMA, METRIC_SCHEMA,
+                                        SKETCH_L4_SCHEMA, Schema)
 from deepflow_tpu.batch.batcher import Batcher, TensorBatch
 
-__all__ = ["L4_SCHEMA", "METRIC_SCHEMA", "Schema", "Batcher", "TensorBatch"]
+__all__ = ["L4_SCHEMA", "L7_SCHEMA", "METRIC_SCHEMA", "SKETCH_L4_SCHEMA",
+           "Schema", "Batcher", "TensorBatch"]

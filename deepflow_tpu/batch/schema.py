@@ -1,14 +1,33 @@
 """Columnar feature schemas: the tensor mirror of the reference row schemas.
 
-The L4 schema covers the subset of l4_flow_log columns the sketch kernels
-consume (reference: server/ingester/flow_log/log_data/l4_flow_log.go —
-5-tuple :79-170, metrics :456-486, KnowledgeGraph ints :226-266). Every
-column is a fixed-dtype numpy array; a batch is a dict of equal-length
-columns plus a validity count (pad+mask discipline for XLA static shapes).
+The L4 schema mirrors the reference's l4_flow_log column families
+(reference: server/ingester/flow_log/log_data/l4_flow_log.go —
+DataLinkLayer :57, NetworkLayer :79, TransportLayer :166, ApplicationLayer
+:199, FlowInfo :363, Metrics :466) as fixed-dtype numpy columns; a batch is
+a dict of equal-length columns plus a validity count (pad+mask discipline
+for XLA static shapes). KnowledgeGraph columns are NOT decode columns —
+they are stamped by enrich/platform_data.py, as in the reference's decoder
+enrichment stage.
+
+Two deliberate departures from the reference's 147-column table:
+
+- Strings travel as u32 content hashes (SmartEncoding discipline:
+  strings/wide values become dictionary integers before the columnar
+  domain; store/dict_store.py holds the reverse maps). So `tap_side` is
+  an enum int, `endpoint` is `endpoint_hash`, etc.
+- IPv6 columns don't exist: v6 addresses fold to u32 hashes at decode
+  time, `is_ipv6` marks the rows (the reference carries parallel IPv4 and
+  IPv6 columns and an is_ipv4 discriminator).
+
+The device/sketch path does NOT consume the wide schema: SKETCH_L4_SCHEMA
+below is the subset the FlowSuite kernels read, and it is all that gets
+transferred host->device (HBM bandwidth is the scarce resource — shipping
+76 columns the kernels never read would be pure waste).
 
 64-bit wire counters (byte/packet counts) are carried as uint32 on device —
 they are per-record deltas, far below 2^32; window totals live in sketch
-cells whose dtype the caller picks.
+cells whose dtype the caller picks. True 64-bit identities (MACs, flow_id,
+microsecond clocks) keep u64 columns at the schema tail.
 """
 
 from __future__ import annotations
@@ -17,6 +36,10 @@ from dataclasses import dataclass
 from typing import Dict, Tuple
 
 import numpy as np
+
+_U32 = np.dtype(np.uint32)
+_I32 = np.dtype(np.int32)
+_U64 = np.dtype(np.uint64)
 
 
 @dataclass(frozen=True)
@@ -34,69 +57,246 @@ class Schema:
     def row_bytes(self) -> int:
         return sum(np.dtype(d).itemsize for _, d in self.columns)
 
+    def subset(self, names: Tuple[str, ...], new_name: str) -> "Schema":
+        """Project onto `names` (kept in this schema's column order)."""
+        want = set(names)
+        cols = tuple((n, d) for n, d in self.columns if n in want)
+        missing = want - {n for n, _ in cols}
+        if missing:
+            raise KeyError(f"not in {self.name}: {sorted(missing)}")
+        return Schema(name=new_name, columns=cols)
+
+
+# -- L4 flow log -----------------------------------------------------------
+# The first 17 columns are the original core set (and the sketch-kernel
+# input contract); families follow in reference order. u64 columns sit at
+# the tail so the native decoder can emit one u32 plane block + one u64
+# plane block.
+
+_L4_CORE = (
+    ("ip_src", _U32),
+    ("ip_dst", _U32),
+    ("port_src", _U32),
+    ("port_dst", _U32),
+    ("proto", _U32),
+    ("vtap_id", _U32),
+    ("tap_side", _U32),
+    ("l3_epc_id", _I32),          # src-side epc (reference l3_epc_id_0)
+    ("byte_tx", _U32),
+    ("byte_rx", _U32),
+    ("packet_tx", _U32),
+    ("packet_rx", _U32),
+    ("rtt", _U32),
+    ("retrans", _U32),
+    ("close_type", _U32),
+    ("timestamp", _U32),          # start_time ns -> s
+    ("duration_us", _U32),
+)
+
+_L4_DATALINK = (                  # l4_flow_log.go DataLinkLayer :57
+    ("eth_type", _U32),
+    ("vlan", _U32),
+)
+
+_L4_NETWORK = (                   # NetworkLayer tunnel block :79
+    ("is_ipv6", _U32),
+    ("tunnel_tier", _U32),
+    ("tunnel_type", _U32),
+    ("tunnel_tx_id", _U32),
+    ("tunnel_rx_id", _U32),
+    ("tunnel_tx_ip_0", _U32),
+    ("tunnel_tx_ip_1", _U32),
+    ("tunnel_rx_ip_0", _U32),
+    ("tunnel_rx_ip_1", _U32),
+)
+
+_L4_TRANSPORT = (                 # TransportLayer :166
+    ("tcp_flags_bit_0", _U32),
+    ("tcp_flags_bit_1", _U32),
+    ("syn_seq", _U32),
+    ("synack_seq", _U32),
+    ("last_keepalive_seq", _U32),
+    ("last_keepalive_ack", _U32),
+)
+
+_L4_APP = (                       # ApplicationLayer :199
+    ("l7_protocol", _U32),
+)
+
+_L4_FLOWINFO = (                  # FlowInfo :363
+    ("l3_epc_id_1", _I32),        # dst-side epc
+    ("signal_source", _U32),
+    ("tap_type", _U32),
+    ("tap_port", _U32),
+    ("tap_port_type", _U32),
+    ("is_new_flow", _U32),
+    ("is_active_service", _U32),
+    ("l2_end_0", _U32),
+    ("l2_end_1", _U32),
+    ("l3_end_0", _U32),
+    ("l3_end_1", _U32),
+    ("direction_score", _U32),
+    ("gprocess_id_0", _U32),
+    ("gprocess_id_1", _U32),
+    ("nat_real_ip_0", _U32),
+    ("nat_real_ip_1", _U32),
+    ("nat_real_port_0", _U32),
+    ("nat_real_port_1", _U32),
+)
+
+_L4_METRICS = (                   # Metrics :466
+    ("l3_byte_tx", _U32),
+    ("l3_byte_rx", _U32),
+    ("l4_byte_tx", _U32),
+    ("l4_byte_rx", _U32),
+    ("total_byte_tx", _U32),
+    ("total_byte_rx", _U32),
+    ("total_packet_tx", _U32),
+    ("total_packet_rx", _U32),
+    ("l7_request", _U32),
+    ("l7_response", _U32),
+    ("l7_parse_failed", _U32),
+    ("l7_client_error", _U32),
+    ("l7_server_error", _U32),
+    ("l7_server_timeout", _U32),
+    ("rtt_client", _U32),         # us (max over window)
+    ("rtt_server", _U32),
+    ("tls_rtt", _U32),
+    ("srt_sum", _U32),
+    ("srt_count", _U32),
+    ("srt_max", _U32),
+    ("art_sum", _U32),
+    ("art_count", _U32),
+    ("art_max", _U32),
+    ("rrt_sum", _U32),
+    ("rrt_count", _U32),
+    ("rrt_max", _U32),
+    ("cit_sum", _U32),
+    ("cit_count", _U32),
+    ("cit_max", _U32),
+    ("retrans_tx", _U32),
+    ("retrans_rx", _U32),
+    ("zero_win_tx", _U32),
+    ("zero_win_rx", _U32),
+    ("syn_count", _U32),
+    ("synack_count", _U32),
+)
+
+_L4_WIDE64 = (                    # true 64-bit identities, tail block
+    ("mac_src", _U64),
+    ("mac_dst", _U64),
+    ("flow_id", _U64),
+    ("start_time_us", _U64),
+    ("end_time_us", _U64),
+)
 
 L4_SCHEMA = Schema(
     name="l4_flow_log",
-    columns=(
-        ("ip_src", np.dtype(np.uint32)),
-        ("ip_dst", np.dtype(np.uint32)),
-        ("port_src", np.dtype(np.uint32)),
-        ("port_dst", np.dtype(np.uint32)),
-        ("proto", np.dtype(np.uint32)),
-        ("vtap_id", np.dtype(np.uint32)),
-        ("tap_side", np.dtype(np.uint32)),
-        ("l3_epc_id", np.dtype(np.int32)),
-        ("byte_tx", np.dtype(np.uint32)),
-        ("byte_rx", np.dtype(np.uint32)),
-        ("packet_tx", np.dtype(np.uint32)),
-        ("packet_rx", np.dtype(np.uint32)),
-        ("rtt", np.dtype(np.uint32)),
-        ("retrans", np.dtype(np.uint32)),
-        ("close_type", np.dtype(np.uint32)),
-        ("timestamp", np.dtype(np.uint32)),   # start_time ns -> s
-        ("duration_us", np.dtype(np.uint32)),
-    ),
+    columns=(_L4_CORE + _L4_DATALINK + _L4_NETWORK + _L4_TRANSPORT
+             + _L4_APP + _L4_FLOWINFO + _L4_METRICS + _L4_WIDE64),
+)
+
+# The FlowSuite kernel input contract: exactly the columns the sketch
+# update reads (models/flow_suite.py) plus the batcher's bookkeeping keys.
+# Host->device transfer and the columnar sketch-feed wire use this.
+SKETCH_L4_SCHEMA = Schema(name="l4_sketch",
+                          columns=_L4_CORE)
+
+# -- L7 flow log -----------------------------------------------------------
+# Reference: log_data/l7_flow_log.go L7Base + L7FlowLog :187-286. String
+# fields are *_hash u32 dictionary codes; nullable wire fields use 0 as
+# the null image (the store has no null concept, same as SmartEncoding
+# dropping Nullable for dictionary codes).
+
+_L7_CORE = (
+    ("ip_src", _U32),
+    ("ip_dst", _U32),
+    ("port_src", _U32),
+    ("port_dst", _U32),
+    ("protocol", _U32),           # transport proto
+    ("l7_protocol", _U32),        # AppProtoHead.proto
+    ("msg_type", _U32),           # 0 request / 1 response / 2+ session
+    ("vtap_id", _U32),
+    ("endpoint_hash", _U32),      # hashed req endpoint string
+    ("status", _U32),
+    ("rrt_us", _U32),
+    ("req_len", _I32),
+    ("resp_len", _I32),
+    ("timestamp", _U32),
+)
+
+_L7_WIDE = (
+    ("l3_epc_id_0", _I32),
+    ("l3_epc_id_1", _I32),
+    ("tap_side", _U32),
+    ("tap_type", _U32),
+    ("tap_port", _U32),
+    ("tap_port_type", _U32),
+    ("is_ipv6", _U32),
+    ("is_tls", _U32),
+    ("version_hash", _U32),
+    ("request_type_hash", _U32),
+    ("request_domain_hash", _U32),
+    ("request_resource_hash", _U32),
+    ("request_id", _U32),
+    ("response_code", _I32),
+    ("response_exception_hash", _U32),
+    ("response_result_hash", _U32),
+    ("trace_id_hash", _U32),
+    ("span_id_hash", _U32),
+    ("parent_span_id_hash", _U32),
+    ("x_request_id_0_hash", _U32),
+    ("x_request_id_1_hash", _U32),
+    ("http_proxy_client_hash", _U32),
+    ("app_service_hash", _U32),
+    ("app_instance_hash", _U32),
+    ("user_agent_hash", _U32),
+    ("referer_hash", _U32),
+    ("process_id_0", _U32),
+    ("process_id_1", _U32),
+    ("gprocess_id_0", _U32),
+    ("gprocess_id_1", _U32),
+    ("pod_id_0", _U32),
+    ("pod_id_1", _U32),
+    ("req_tcp_seq", _U32),
+    ("resp_tcp_seq", _U32),
+    ("sql_affected_rows", _U32),
+    ("direction_score", _U32),
+    ("signal_source", _U32),
+)
+
+_L7_WIDE64 = (
+    ("syscall_trace_id_request", _U64),
+    ("syscall_trace_id_response", _U64),
+    ("flow_id", _U64),
+    ("start_time_us", _U64),
+    ("end_time_us", _U64),
 )
 
 L7_SCHEMA = Schema(
     name="l7_flow_log",
-    columns=(
-        ("ip_src", np.dtype(np.uint32)),
-        ("ip_dst", np.dtype(np.uint32)),
-        ("port_src", np.dtype(np.uint32)),
-        ("port_dst", np.dtype(np.uint32)),
-        ("protocol", np.dtype(np.uint32)),     # transport proto
-        ("l7_protocol", np.dtype(np.uint32)),  # AppProtoHead.proto
-        ("msg_type", np.dtype(np.uint32)),
-        ("vtap_id", np.dtype(np.uint32)),
-        ("endpoint_hash", np.dtype(np.uint32)),  # hashed req endpoint string
-        ("status", np.dtype(np.uint32)),
-        ("rrt_us", np.dtype(np.uint32)),
-        ("req_len", np.dtype(np.int32)),
-        ("resp_len", np.dtype(np.int32)),
-        ("timestamp", np.dtype(np.uint32)),
-    ),
+    columns=_L7_CORE + _L7_WIDE + _L7_WIDE64,
 )
 
 METRIC_SCHEMA = Schema(
     name="flow_metrics",
     columns=(
-        ("timestamp", np.dtype(np.uint32)),
-        ("ip", np.dtype(np.uint32)),
-        ("server_port", np.dtype(np.uint32)),
-        ("vtap_id", np.dtype(np.uint32)),
-        ("protocol", np.dtype(np.uint32)),
-        ("packet_tx", np.dtype(np.uint32)),
-        ("packet_rx", np.dtype(np.uint32)),
-        ("byte_tx", np.dtype(np.uint32)),
-        ("byte_rx", np.dtype(np.uint32)),
-        ("new_flow", np.dtype(np.uint32)),
-        ("closed_flow", np.dtype(np.uint32)),
-        ("syn", np.dtype(np.uint32)),
-        ("synack", np.dtype(np.uint32)),
-        ("retrans_tx", np.dtype(np.uint32)),
-        ("retrans_rx", np.dtype(np.uint32)),
-        ("rtt_sum", np.dtype(np.uint32)),
-        ("rtt_count", np.dtype(np.uint32)),
+        ("timestamp", _U32),
+        ("ip", _U32),
+        ("server_port", _U32),
+        ("vtap_id", _U32),
+        ("protocol", _U32),
+        ("packet_tx", _U32),
+        ("packet_rx", _U32),
+        ("byte_tx", _U32),
+        ("byte_rx", _U32),
+        ("new_flow", _U32),
+        ("closed_flow", _U32),
+        ("syn", _U32),
+        ("synack", _U32),
+        ("retrans_tx", _U32),
+        ("retrans_rx", _U32),
+        ("rtt_sum", _U32),
+        ("rtt_count", _U32),
     ),
 )

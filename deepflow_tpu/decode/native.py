@@ -5,6 +5,11 @@ the .so is cached beside the source keyed by source mtime) and exposes
 `decode_l4_payloads`, a drop-in fast path for the flow_log decode stage.
 Falls back cleanly: `available()` is False when no compiler exists, and
 callers keep using the pure-Python decoder.
+
+The native ABI emits two plane blocks per batch — a [N32, capacity] u32
+block for every u32/i32 schema column and a [N64, capacity] u64 block for
+the 64-bit tail (macs, flow_id, microsecond clocks) — matching
+batch/schema.py L4_SCHEMA order exactly.
 """
 
 from __future__ import annotations
@@ -23,6 +28,12 @@ _SRC = os.path.join(os.path.dirname(__file__), "native_src", "decoder.cc")
 _SO = os.path.join(os.path.dirname(__file__), "native_src",
                    "_native_decoder.so")
 
+# schema columns partitioned by plane width (order preserved per plane)
+L4_COLS32 = tuple((n, d) for n, d in L4_SCHEMA.columns
+                  if np.dtype(d).itemsize == 4)
+L4_COLS64 = tuple((n, d) for n, d in L4_SCHEMA.columns
+                  if np.dtype(d).itemsize == 8)
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
@@ -34,7 +45,7 @@ def _build() -> Optional[str]:
             os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return None
     # -O3 -march=native -funroll-loops is load-bearing: the varint walk
-    # runs ~3x faster than at generic -O2 (9.5M vs 3.2M rec/s single-core)
+    # runs ~3x faster than at generic -O2
     cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
            "-fPIC", "-std=c++17", _SRC, "-o", _SO + ".tmp", "-lpthread"]
     try:
@@ -60,20 +71,24 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.df_decode_l4.restype = ctypes.c_long
         lib.df_decode_l4.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_uint32), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_long,
             ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.df_decode_l4_mt.restype = ctypes.c_long
         lib.df_decode_l4_mt.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_uint32), ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_long, ctypes.c_int,
             ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.df_n_l4_cols.restype = ctypes.c_int
-        n = lib.df_n_l4_cols()
-        if n != len(L4_SCHEMA.columns):
-            _build_error = (f"column count mismatch: native {n} vs "
-                            f"schema {len(L4_SCHEMA.columns)}")
+        lib.df_n_l4_cols64.restype = ctypes.c_int
+        n32, n64 = lib.df_n_l4_cols(), lib.df_n_l4_cols64()
+        if n32 != len(L4_COLS32) or n64 != len(L4_COLS64):
+            _build_error = (
+                f"column count mismatch: native {n32}+{n64} vs "
+                f"schema {len(L4_COLS32)}+{len(L4_COLS64)}")
             return None
         _lib = lib
         return _lib
@@ -88,31 +103,46 @@ def build_error() -> Optional[str]:
     return _build_error
 
 
-def decode_l4_into(payload: bytes, out: np.ndarray,
+def decode_l4_into(payload: bytes, out32: np.ndarray, out64: np.ndarray,
                    n_threads: int = 1) -> Tuple[int, int, int]:
-    """Zero-alloc decode into a caller-owned [N_COLS, capacity] uint32
-    buffer. Returns (rows, bad_records, consumed_bytes). The buffer can be
-    reused across calls — the bench's double-buffer feed path (reference:
-    server/libs/receiver/receiver.go tiered buffer pools play this role).
-    """
+    """Zero-alloc decode into caller-owned [N32, capacity] uint32 and
+    [N64, capacity] uint64 buffers. Returns (rows, bad_records,
+    consumed_bytes). The buffers can be reused across calls — the bench's
+    double-buffer feed path (reference: server/libs/receiver/receiver.go
+    tiered buffer pools play this role)."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native decoder unavailable: {_build_error}")
-    ncols = len(L4_SCHEMA.columns)
-    assert out.ndim == 2 and out.shape[0] == ncols and \
-        out.dtype == np.uint32 and out.flags.c_contiguous
-    capacity = out.shape[1]
+    assert out32.ndim == 2 and out32.shape[0] == len(L4_COLS32) and \
+        out32.dtype == np.uint32 and out32.flags.c_contiguous
+    assert out64.ndim == 2 and out64.shape[0] == len(L4_COLS64) and \
+        out64.dtype == np.uint64 and out64.flags.c_contiguous
+    assert out32.shape[1] == out64.shape[1]
+    capacity = out32.shape[1]
     bad = ctypes.c_long()
     consumed = ctypes.c_size_t()
-    ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+    p32 = out32.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+    p64 = out64.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
     if n_threads == 1:
-        rows = lib.df_decode_l4(payload, len(payload), ptr, capacity,
+        rows = lib.df_decode_l4(payload, len(payload), p32, p64, capacity,
                                 ctypes.byref(bad), ctypes.byref(consumed))
     else:
-        rows = lib.df_decode_l4_mt(payload, len(payload), ptr, capacity,
-                                   n_threads, ctypes.byref(bad),
+        rows = lib.df_decode_l4_mt(payload, len(payload), p32, p64,
+                                   capacity, n_threads, ctypes.byref(bad),
                                    ctypes.byref(consumed))
     return rows, bad.value, consumed.value
+
+
+def _mats_to_cols(mat32: np.ndarray,
+                  mat64: np.ndarray) -> Dict[str, np.ndarray]:
+    cols: Dict[str, np.ndarray] = {}
+    for i, (name, dt) in enumerate(L4_COLS32):
+        col = mat32[i]
+        cols[name] = col.view(np.int32) if dt == np.dtype(np.int32) \
+            else col
+    for i, (name, _) in enumerate(L4_COLS64):
+        cols[name] = mat64[i]
+    return cols
 
 
 def decode_l4_payload(payload: bytes, capacity: int = 65536,
@@ -124,29 +154,28 @@ def decode_l4_payload(payload: bytes, capacity: int = 65536,
     in further passes internally, so the result always covers the whole
     payload.
     """
-    ncols = len(L4_SCHEMA.columns)
+    n32, n64 = len(L4_COLS32), len(L4_COLS64)
     chunks = []
     bad_total = 0
     view = payload
     while True:
-        out = np.empty((ncols, capacity), np.uint32)
-        rows, bad, consumed = decode_l4_into(view, out, n_threads=n_threads)
+        out32 = np.empty((n32, capacity), np.uint32)
+        out64 = np.empty((n64, capacity), np.uint64)
+        rows, bad, consumed = decode_l4_into(view, out32, out64,
+                                             n_threads=n_threads)
         bad_total += bad
         if rows > 0:
-            chunks.append(out[:, :rows].copy())
+            chunks.append((out32[:, :rows].copy(), out64[:, :rows].copy()))
         if consumed >= len(view) or rows == 0:
             break
         view = view[consumed:]
     if chunks:
-        mat = np.concatenate(chunks, axis=1)
+        mat32 = np.concatenate([c[0] for c in chunks], axis=1)
+        mat64 = np.concatenate([c[1] for c in chunks], axis=1)
     else:
-        mat = np.empty((ncols, 0), np.uint32)
-    cols: Dict[str, np.ndarray] = {}
-    for i, (name, dt) in enumerate(L4_SCHEMA.columns):
-        col = mat[i]
-        cols[name] = col.view(np.int32) if dt == np.dtype(np.int32) \
-            else col.astype(dt, copy=False)
-    return cols, bad_total
+        mat32 = np.empty((n32, 0), np.uint32)
+        mat64 = np.empty((n64, 0), np.uint64)
+    return _mats_to_cols(mat32, mat64), bad_total
 
 
 def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:

@@ -10,16 +10,16 @@
 // messages (wire/protos/flow_log.proto — field numbers mirror the
 // reference message/flow_log.proto so agent streams decode unchanged).
 //
-// Output: a single uint32 buffer of shape [N_COLS, capacity], row-major
-// per column (out[col * capacity + row]); column order must match
-// batch/schema.py L4_SCHEMA. The int32 l3_epc_id column is stored as its
-// two's-complement uint32 image, exactly like the Python decoder.
+// Output: a uint32 buffer of shape [N_COLS32, capacity] plus a uint64
+// buffer of shape [N_COLS64, capacity], row-major per column
+// (out[col * capacity + row]). Column order must match batch/schema.py
+// L4_SCHEMA: the u32/i32 columns first (int32 stored as its
+// two's-complement uint32 image, exactly like the Python decoder), then
+// the u64 tail block (mac_src, mac_dst, flow_id, start/end_time_us).
 //
-// Performance: on this host's single core the walk runs ~9.5M rec/s when
-// built -O3 -march=native -funroll-loops (vs ~3.2M at generic -O2) — past
-// the reference's per-thread Go decoder rate. Hand-"optimized" variants
-// (unrolled varint fast paths, single-byte tag dispatch) measured SLOWER
-// than this simple structure under those flags; keep the loops naive and
+// Performance: the walk stays a naive tag-dispatch loop — hand-"optimized"
+// variants (unrolled varint fast paths, single-byte tag dispatch) measured
+// SLOWER under -O3 -march=native -funroll-loops; keep the loops simple and
 // let the compiler schedule them. df_decode_l4_mt adds a std::thread
 // fan-out for hosts with more than one core.
 //
@@ -34,12 +34,50 @@
 
 namespace {
 
-// L4_SCHEMA column indices
+// L4_SCHEMA u32 column indices (batch/schema.py order)
 enum {
+  // core
   COL_IP_SRC = 0, COL_IP_DST, COL_PORT_SRC, COL_PORT_DST, COL_PROTO,
   COL_VTAP_ID, COL_TAP_SIDE, COL_L3_EPC_ID, COL_BYTE_TX, COL_BYTE_RX,
   COL_PACKET_TX, COL_PACKET_RX, COL_RTT, COL_RETRANS, COL_CLOSE_TYPE,
-  COL_TIMESTAMP, COL_DURATION_US, N_COLS
+  COL_TIMESTAMP, COL_DURATION_US,
+  // datalink
+  COL_ETH_TYPE, COL_VLAN,
+  // network / tunnel
+  COL_IS_IPV6, COL_TUNNEL_TIER, COL_TUNNEL_TYPE, COL_TUNNEL_TX_ID,
+  COL_TUNNEL_RX_ID, COL_TUNNEL_TX_IP_0, COL_TUNNEL_TX_IP_1,
+  COL_TUNNEL_RX_IP_0, COL_TUNNEL_RX_IP_1,
+  // transport
+  COL_TCP_FLAGS_BIT_0, COL_TCP_FLAGS_BIT_1, COL_SYN_SEQ, COL_SYNACK_SEQ,
+  COL_LAST_KEEPALIVE_SEQ, COL_LAST_KEEPALIVE_ACK,
+  // application
+  COL_L7_PROTOCOL,
+  // flow info
+  COL_L3_EPC_ID_1, COL_SIGNAL_SOURCE, COL_TAP_TYPE, COL_TAP_PORT,
+  COL_TAP_PORT_TYPE, COL_IS_NEW_FLOW, COL_IS_ACTIVE_SERVICE,
+  COL_L2_END_0, COL_L2_END_1, COL_L3_END_0, COL_L3_END_1,
+  COL_DIRECTION_SCORE, COL_GPROCESS_ID_0, COL_GPROCESS_ID_1,
+  COL_NAT_REAL_IP_0, COL_NAT_REAL_IP_1, COL_NAT_REAL_PORT_0,
+  COL_NAT_REAL_PORT_1,
+  // metrics
+  COL_L3_BYTE_TX, COL_L3_BYTE_RX, COL_L4_BYTE_TX, COL_L4_BYTE_RX,
+  COL_TOTAL_BYTE_TX, COL_TOTAL_BYTE_RX, COL_TOTAL_PACKET_TX,
+  COL_TOTAL_PACKET_RX, COL_L7_REQUEST, COL_L7_RESPONSE,
+  COL_L7_PARSE_FAILED, COL_L7_CLIENT_ERROR, COL_L7_SERVER_ERROR,
+  COL_L7_SERVER_TIMEOUT, COL_RTT_CLIENT, COL_RTT_SERVER, COL_TLS_RTT,
+  COL_SRT_SUM, COL_SRT_COUNT, COL_SRT_MAX,
+  COL_ART_SUM, COL_ART_COUNT, COL_ART_MAX,
+  COL_RRT_SUM, COL_RRT_COUNT, COL_RRT_MAX,
+  COL_CIT_SUM, COL_CIT_COUNT, COL_CIT_MAX,
+  COL_RETRANS_TX, COL_RETRANS_RX, COL_ZERO_WIN_TX, COL_ZERO_WIN_RX,
+  COL_SYN_COUNT, COL_SYNACK_COUNT,
+  N_COLS32
+};
+
+// u64 tail block indices
+enum {
+  COL64_MAC_SRC = 0, COL64_MAC_DST, COL64_FLOW_ID, COL64_START_TIME_US,
+  COL64_END_TIME_US, N_COLS64
 };
 
 struct Cursor {
@@ -95,21 +133,60 @@ inline bool open_sub(Cursor& c, Cursor* sub) {
   return true;
 }
 
+// length-delimited bytes field -> FNV-1a 32 fold (the Python decoder's
+// _fnv1a32, used to fold IPv6 addresses into the u32 ip columns)
+inline bool read_bytes_fnv(Cursor& c, uint32_t* out, bool* nonempty) {
+  uint64_t len;
+  if (!read_varint(c, &len) ||
+      static_cast<uint64_t>(c.end - c.p) < len) return false;
+  uint32_t h = 0x811C9DC5u;
+  for (uint64_t i = 0; i < len; ++i)
+    h = (h ^ c.p[i]) * 0x01000193u;
+  c.p += len;
+  *out = h;
+  *nonempty = len > 0;
+  return true;
+}
+
 struct Row {
-  uint32_t v[N_COLS];
+  uint32_t v[N_COLS32];
+  uint64_t v64[N_COLS64];
 };
 
 bool parse_flow_key(Cursor c, Row* r) {
   uint32_t wt;
   for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
     uint64_t v;
+    uint32_t h;
+    bool nonempty;
     switch (tag) {
       case 1:  if (!read_varint(c, &v)) return false;
                r->v[COL_VTAP_ID] = static_cast<uint32_t>(v); break;
+      case 2:  if (!read_varint(c, &v)) return false;
+               r->v[COL_TAP_TYPE] = static_cast<uint32_t>(v); break;
+      case 3:  if (!read_varint(c, &v)) return false;   // tap_port u64
+               r->v[COL_TAP_PORT] = static_cast<uint32_t>(v);
+               r->v[COL_TAP_PORT_TYPE] =
+                   static_cast<uint32_t>((v >> 32) & 0xFF);
+               break;
+      case 4:  if (!read_varint(c, &v)) return false;
+               r->v64[COL64_MAC_SRC] = v; break;
+      case 5:  if (!read_varint(c, &v)) return false;
+               r->v64[COL64_MAC_DST] = v; break;
       case 6:  if (!read_varint(c, &v)) return false;
                r->v[COL_IP_SRC] = static_cast<uint32_t>(v); break;
       case 7:  if (!read_varint(c, &v)) return false;
                r->v[COL_IP_DST] = static_cast<uint32_t>(v); break;
+      case 8:  if (wt != 2 || !read_bytes_fnv(c, &h, &nonempty))
+                 return false;
+               if (nonempty) { r->v[COL_IP_SRC] = h;
+                               r->v[COL_IS_IPV6] = 1; }
+               break;
+      case 9:  if (wt != 2 || !read_bytes_fnv(c, &h, &nonempty))
+                 return false;
+               if (nonempty) { r->v[COL_IP_DST] = h;
+                               r->v[COL_IS_IPV6] = 1; }
+               break;
       case 10: if (!read_varint(c, &v)) return false;
                r->v[COL_PORT_SRC] = static_cast<uint32_t>(v); break;
       case 11: if (!read_varint(c, &v)) return false;
@@ -122,18 +199,98 @@ bool parse_flow_key(Cursor c, Row* r) {
   return true;
 }
 
-bool parse_peer(Cursor c, Row* r, int byte_col, int pkt_col, bool src) {
+// per-side column targets for FlowMetricsPeer
+struct PeerCols {
+  int byte_col, pkt_col, epc_col, l3b_col, l4b_col, totb_col, totp_col,
+      flags_col, l2end_col, l3end_col, realip_col, realport_col, gpid_col;
+};
+
+bool parse_peer(Cursor c, Row* r, const PeerCols& pc) {
   uint32_t wt;
   for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
     uint64_t v;
     switch (tag) {
       case 1:  if (!read_varint(c, &v)) return false;
-               r->v[byte_col] = static_cast<uint32_t>(v); break;
+               r->v[pc.byte_col] = static_cast<uint32_t>(v); break;
+      case 2:  if (!read_varint(c, &v)) return false;
+               r->v[pc.l3b_col] = static_cast<uint32_t>(v); break;
+      case 3:  if (!read_varint(c, &v)) return false;
+               r->v[pc.l4b_col] = static_cast<uint32_t>(v); break;
       case 4:  if (!read_varint(c, &v)) return false;
-               r->v[pkt_col] = static_cast<uint32_t>(v); break;
+               r->v[pc.pkt_col] = static_cast<uint32_t>(v); break;
+      case 5:  if (!read_varint(c, &v)) return false;
+               r->v[pc.totb_col] = static_cast<uint32_t>(v); break;
+      case 6:  if (!read_varint(c, &v)) return false;
+               r->v[pc.totp_col] = static_cast<uint32_t>(v); break;
+      case 9:  if (!read_varint(c, &v)) return false;
+               r->v[pc.flags_col] = static_cast<uint32_t>(v); break;
       case 10: if (!read_varint(c, &v)) return false;   // int32 l3_epc_id
-               if (src) r->v[COL_L3_EPC_ID] = static_cast<uint32_t>(v);
-               break;
+               r->v[pc.epc_col] = static_cast<uint32_t>(v); break;
+      case 11: if (!read_varint(c, &v)) return false;
+               r->v[pc.l2end_col] = static_cast<uint32_t>(v); break;
+      case 12: if (!read_varint(c, &v)) return false;
+               r->v[pc.l3end_col] = static_cast<uint32_t>(v); break;
+      case 20: if (!read_varint(c, &v)) return false;
+               r->v[pc.realip_col] = static_cast<uint32_t>(v); break;
+      case 21: if (!read_varint(c, &v)) return false;
+               r->v[pc.realport_col] = static_cast<uint32_t>(v); break;
+      case 22: if (!read_varint(c, &v)) return false;
+               r->v[pc.gpid_col] = static_cast<uint32_t>(v); break;
+      default: if (!skip_field(c, wt)) return false;
+    }
+  }
+  return true;
+}
+
+const PeerCols kPeerSrc = {
+  COL_BYTE_TX, COL_PACKET_TX, COL_L3_EPC_ID, COL_L3_BYTE_TX, COL_L4_BYTE_TX,
+  COL_TOTAL_BYTE_TX, COL_TOTAL_PACKET_TX, COL_TCP_FLAGS_BIT_0,
+  COL_L2_END_0, COL_L3_END_0, COL_NAT_REAL_IP_0, COL_NAT_REAL_PORT_0,
+  COL_GPROCESS_ID_0
+};
+const PeerCols kPeerDst = {
+  COL_BYTE_RX, COL_PACKET_RX, COL_L3_EPC_ID_1, COL_L3_BYTE_RX,
+  COL_L4_BYTE_RX, COL_TOTAL_BYTE_RX, COL_TOTAL_PACKET_RX,
+  COL_TCP_FLAGS_BIT_1, COL_L2_END_1, COL_L3_END_1, COL_NAT_REAL_IP_1,
+  COL_NAT_REAL_PORT_1, COL_GPROCESS_ID_1
+};
+
+bool parse_tunnel(Cursor c, Row* r) {
+  uint32_t wt;
+  for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
+    uint64_t v;
+    switch (tag) {
+      case 1:  if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_TX_IP_0] = static_cast<uint32_t>(v); break;
+      case 2:  if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_TX_IP_1] = static_cast<uint32_t>(v); break;
+      case 3:  if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_RX_IP_0] = static_cast<uint32_t>(v); break;
+      case 4:  if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_RX_IP_1] = static_cast<uint32_t>(v); break;
+      case 9:  if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_TX_ID] = static_cast<uint32_t>(v); break;
+      case 10: if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_RX_ID] = static_cast<uint32_t>(v); break;
+      case 11: if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_TYPE] = static_cast<uint32_t>(v); break;
+      case 12: if (!read_varint(c, &v)) return false;
+               r->v[COL_TUNNEL_TIER] = static_cast<uint32_t>(v); break;
+      default: if (!skip_field(c, wt)) return false;
+    }
+  }
+  return true;
+}
+
+bool parse_tcp_counts_peer(Cursor c, Row* r, int retrans_col, int zwin_col) {
+  uint32_t wt;
+  for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
+    uint64_t v;
+    switch (tag) {
+      case 1: if (!read_varint(c, &v)) return false;
+              r->v[retrans_col] = static_cast<uint32_t>(v); break;
+      case 2: if (!read_varint(c, &v)) return false;
+              r->v[zwin_col] = static_cast<uint32_t>(v); break;
       default: if (!skip_field(c, wt)) return false;
     }
   }
@@ -144,11 +301,75 @@ bool parse_tcp_perf(Cursor c, Row* r) {
   uint32_t wt;
   for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
     uint64_t v;
+    Cursor sub;
     switch (tag) {
+      case 1:  if (!read_varint(c, &v)) return false;
+               r->v[COL_RTT_CLIENT] = static_cast<uint32_t>(v); break;
+      case 2:  if (!read_varint(c, &v)) return false;
+               r->v[COL_RTT_SERVER] = static_cast<uint32_t>(v); break;
+      case 3:  if (!read_varint(c, &v)) return false;
+               r->v[COL_SRT_MAX] = static_cast<uint32_t>(v); break;
+      case 4:  if (!read_varint(c, &v)) return false;
+               r->v[COL_ART_MAX] = static_cast<uint32_t>(v); break;
       case 5:  if (!read_varint(c, &v)) return false;   // rtt
                r->v[COL_RTT] = static_cast<uint32_t>(v); break;
+      case 8:  if (!read_varint(c, &v)) return false;
+               r->v[COL_SRT_SUM] = static_cast<uint32_t>(v); break;
+      case 9:  if (!read_varint(c, &v)) return false;
+               r->v[COL_ART_SUM] = static_cast<uint32_t>(v); break;
+      case 12: if (!read_varint(c, &v)) return false;
+               r->v[COL_SRT_COUNT] = static_cast<uint32_t>(v); break;
+      case 13: if (!read_varint(c, &v)) return false;
+               r->v[COL_ART_COUNT] = static_cast<uint32_t>(v); break;
+      case 14: if (wt != 2 || !open_sub(c, &sub) ||
+                   !parse_tcp_counts_peer(sub, r, COL_RETRANS_TX,
+                                          COL_ZERO_WIN_TX)) return false;
+               break;
+      case 15: if (wt != 2 || !open_sub(c, &sub) ||
+                   !parse_tcp_counts_peer(sub, r, COL_RETRANS_RX,
+                                          COL_ZERO_WIN_RX)) return false;
+               break;
       case 16: if (!read_varint(c, &v)) return false;   // total_retrans
                r->v[COL_RETRANS] = static_cast<uint32_t>(v); break;
+      case 17: if (!read_varint(c, &v)) return false;
+               r->v[COL_SYN_COUNT] = static_cast<uint32_t>(v); break;
+      case 18: if (!read_varint(c, &v)) return false;
+               r->v[COL_SYNACK_COUNT] = static_cast<uint32_t>(v); break;
+      case 19: if (!read_varint(c, &v)) return false;
+               r->v[COL_CIT_MAX] = static_cast<uint32_t>(v); break;
+      case 20: if (!read_varint(c, &v)) return false;
+               r->v[COL_CIT_SUM] = static_cast<uint32_t>(v); break;
+      case 21: if (!read_varint(c, &v)) return false;
+               r->v[COL_CIT_COUNT] = static_cast<uint32_t>(v); break;
+      default: if (!skip_field(c, wt)) return false;
+    }
+  }
+  return true;
+}
+
+bool parse_l7_perf(Cursor c, Row* r) {
+  uint32_t wt;
+  for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
+    uint64_t v;
+    switch (tag) {
+      case 1: if (!read_varint(c, &v)) return false;
+              r->v[COL_L7_REQUEST] = static_cast<uint32_t>(v); break;
+      case 2: if (!read_varint(c, &v)) return false;
+              r->v[COL_L7_RESPONSE] = static_cast<uint32_t>(v); break;
+      case 3: if (!read_varint(c, &v)) return false;
+              r->v[COL_L7_CLIENT_ERROR] = static_cast<uint32_t>(v); break;
+      case 4: if (!read_varint(c, &v)) return false;
+              r->v[COL_L7_SERVER_ERROR] = static_cast<uint32_t>(v); break;
+      case 5: if (!read_varint(c, &v)) return false;
+              r->v[COL_L7_SERVER_TIMEOUT] = static_cast<uint32_t>(v); break;
+      case 6: if (!read_varint(c, &v)) return false;
+              r->v[COL_RRT_COUNT] = static_cast<uint32_t>(v); break;
+      case 7: if (!read_varint(c, &v)) return false;   // rrt_sum u64
+              r->v[COL_RRT_SUM] = static_cast<uint32_t>(v); break;
+      case 8: if (!read_varint(c, &v)) return false;
+              r->v[COL_RRT_MAX] = static_cast<uint32_t>(v); break;
+      case 9: if (!read_varint(c, &v)) return false;
+              r->v[COL_TLS_RTT] = static_cast<uint32_t>(v); break;
       default: if (!skip_field(c, wt)) return false;
     }
   }
@@ -158,11 +379,27 @@ bool parse_tcp_perf(Cursor c, Row* r) {
 bool parse_perf_stats(Cursor c, Row* r) {
   uint32_t wt;
   for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
-    if (tag == 1 && wt == 2) {                          // tcp
-      Cursor sub;
-      if (!open_sub(c, &sub) || !parse_tcp_perf(sub, r)) return false;
-    } else if (!skip_field(c, wt)) {
-      return false;
+    uint64_t v;
+    Cursor sub;
+    switch (tag) {
+      case 1:                                           // tcp
+        if (wt != 2 || !open_sub(c, &sub) || !parse_tcp_perf(sub, r))
+          return false;
+        break;
+      case 2:                                           // l7
+        if (wt != 2 || !open_sub(c, &sub) || !parse_l7_perf(sub, r))
+          return false;
+        break;
+      case 4:                                           // l7_protocol
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_L7_PROTOCOL] = static_cast<uint32_t>(v);
+        break;
+      case 5:                                           // l7_failed_count
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_L7_PARSE_FAILED] = static_cast<uint32_t>(v);
+        break;
+      default:
+        if (!skip_field(c, wt)) return false;
     }
   }
   return true;
@@ -178,19 +415,28 @@ bool parse_flow(Cursor c, Row* r) {
         if (!open_sub(c, &sub) || !parse_flow_key(sub, r)) return false;
         break;
       case 2:                                            // peer_src
-        if (!open_sub(c, &sub) ||
-            !parse_peer(sub, r, COL_BYTE_TX, COL_PACKET_TX, true))
+        if (!open_sub(c, &sub) || !parse_peer(sub, r, kPeerSrc))
           return false;
         break;
       case 3:                                            // peer_dst
-        if (!open_sub(c, &sub) ||
-            !parse_peer(sub, r, COL_BYTE_RX, COL_PACKET_RX, false))
+        if (!open_sub(c, &sub) || !parse_peer(sub, r, kPeerDst))
           return false;
+        break;
+      case 4:                                            // tunnel
+        if (!open_sub(c, &sub) || !parse_tunnel(sub, r)) return false;
+        break;
+      case 5:                                            // flow_id
+        if (!read_varint(c, &v)) return false;
+        r->v64[COL64_FLOW_ID] = v;
         break;
       case 6:                                            // start_time ns
         if (!read_varint(c, &v)) return false;
-        r->v[COL_TIMESTAMP] =
-            static_cast<uint32_t>(v / 1000000000ULL);
+        r->v[COL_TIMESTAMP] = static_cast<uint32_t>(v / 1000000000ULL);
+        r->v64[COL64_START_TIME_US] = v / 1000ULL;
+        break;
+      case 7:                                            // end_time ns
+        if (!read_varint(c, &v)) return false;
+        r->v64[COL64_END_TIME_US] = v / 1000ULL;
         break;
       case 8: {                                          // duration ns
         if (!read_varint(c, &v)) return false;
@@ -200,6 +446,14 @@ bool parse_flow(Cursor c, Row* r) {
                                : static_cast<uint32_t>(us);
         break;
       }
+      case 10:                                           // vlan
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_VLAN] = static_cast<uint32_t>(v);
+        break;
+      case 11:                                           // eth_type
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_ETH_TYPE] = static_cast<uint32_t>(v);
+        break;
       case 13:                                           // perf_stats
         if (!open_sub(c, &sub) || !parse_perf_stats(sub, r)) return false;
         break;
@@ -207,9 +461,41 @@ bool parse_flow(Cursor c, Row* r) {
         if (!read_varint(c, &v)) return false;
         r->v[COL_CLOSE_TYPE] = static_cast<uint32_t>(v);
         break;
+      case 15:                                           // signal_source
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_SIGNAL_SOURCE] = static_cast<uint32_t>(v);
+        break;
+      case 16:                                           // is_active_service
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_IS_ACTIVE_SERVICE] = static_cast<uint32_t>(v);
+        break;
+      case 18:                                           // is_new_flow
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_IS_NEW_FLOW] = static_cast<uint32_t>(v);
+        break;
       case 19:                                           // tap_side
         if (!read_varint(c, &v)) return false;
         r->v[COL_TAP_SIDE] = static_cast<uint32_t>(v);
+        break;
+      case 20:                                           // syn_seq
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_SYN_SEQ] = static_cast<uint32_t>(v);
+        break;
+      case 21:                                           // synack_seq
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_SYNACK_SEQ] = static_cast<uint32_t>(v);
+        break;
+      case 22:                                           // last_keepalive_seq
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_LAST_KEEPALIVE_SEQ] = static_cast<uint32_t>(v);
+        break;
+      case 23:                                           // last_keepalive_ack
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_LAST_KEEPALIVE_ACK] = static_cast<uint32_t>(v);
+        break;
+      case 25:                                           // direction_score
+        if (!read_varint(c, &v)) return false;
+        r->v[COL_DIRECTION_SCORE] = static_cast<uint32_t>(v);
         break;
       default:
         if (!skip_field(c, wt)) return false;
@@ -218,19 +504,48 @@ bool parse_flow(Cursor c, Row* r) {
   return true;
 }
 
+inline void store_row(uint32_t* out32, uint64_t* out64, long capacity,
+                      long row, const Row& r) {
+  for (int col = 0; col < N_COLS32; ++col)
+    out32[static_cast<size_t>(col) * capacity + row] = r.v[col];
+  for (int col = 0; col < N_COLS64; ++col)
+    out64[static_cast<size_t>(col) * capacity + row] = r.v64[col];
+}
+
+inline bool decode_record(const uint8_t* rec, uint32_t rec_len, Row* r) {
+  Cursor c{rec, rec + rec_len};
+  std::memset(r, 0, sizeof(*r));
+  // TaggedFlow: field 1 = Flow
+  bool ok = false;
+  uint32_t wt;
+  for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
+    if (tag == 1 && wt == 2) {
+      Cursor sub;
+      if (open_sub(c, &sub) && parse_flow(sub, r)) ok = true;
+      else return false;
+    } else if (!skip_field(c, wt)) {
+      return false;
+    }
+  }
+  return ok;
+}
+
 }  // namespace
 
 extern "C" {
 
-// Decode a packed record stream into [N_COLS, capacity] uint32 columns.
+// Decode a packed record stream into [N_COLS32, capacity] uint32 planes +
+// [N_COLS64, capacity] uint64 planes.
 // Returns rows decoded (>= 0); *bad_records counts skipped records.
 // Stops early (without error) when capacity is reached; *consumed reports
 // how many payload bytes were processed so the caller can continue.
-long df_decode_l4(const uint8_t* payload, size_t len, uint32_t* out,
-                  long capacity, long* bad_records, size_t* consumed) {
+long df_decode_l4(const uint8_t* payload, size_t len, uint32_t* out32,
+                  uint64_t* out64, long capacity, long* bad_records,
+                  size_t* consumed) {
   long rows = 0;
   *bad_records = 0;
   size_t off = 0;
+  Row r;
   while (off + 4 <= len && rows < capacity) {
     uint32_t rec_len;
     std::memcpy(&rec_len, payload + off, 4);   // little-endian hosts
@@ -241,27 +556,10 @@ long df_decode_l4(const uint8_t* payload, size_t len, uint32_t* out,
       off = len;
       break;
     }
-    Cursor c{payload + off, payload + off + rec_len};
+    const uint8_t* rec = payload + off;
     off += rec_len;
-
-    Row r;
-    std::memset(&r, 0, sizeof(r));
-    // TaggedFlow: field 1 = Flow
-    bool ok = false;
-    uint32_t wt;
-    for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
-      if (tag == 1 && wt == 2) {
-        Cursor sub;
-        if (open_sub(c, &sub) && parse_flow(sub, &r)) ok = true;
-        else { ok = false; break; }
-      } else if (!skip_field(c, wt)) {
-        ok = false;
-        break;
-      }
-    }
-    if (!ok) { *bad_records += 1; continue; }
-    for (int col = 0; col < N_COLS; ++col)
-      out[static_cast<size_t>(col) * capacity + rows] = r.v[col];
+    if (!decode_record(rec, rec_len, &r)) { *bad_records += 1; continue; }
+    store_row(out32, out64, capacity, rows, r);
     ++rows;
   }
   *consumed = off;
@@ -270,11 +568,11 @@ long df_decode_l4(const uint8_t* payload, size_t len, uint32_t* out,
 
 // Multi-threaded variant: scans the record length prefixes once (cheap),
 // splits the record list across n_threads, each decoding into its own
-// disjoint row range of `out`, then compacts the per-thread gaps left by
-// bad records. n_threads <= 0 means hardware_concurrency. Semantics match
-// df_decode_l4 (capacity bound, *consumed resume point).
-long df_decode_l4_mt(const uint8_t* payload, size_t len, uint32_t* out,
-                     long capacity, int n_threads,
+// disjoint row range of the planes, then compacts the per-thread gaps left
+// by bad records. n_threads <= 0 means hardware_concurrency. Semantics
+// match df_decode_l4 (capacity bound, *consumed resume point).
+long df_decode_l4_mt(const uint8_t* payload, size_t len, uint32_t* out32,
+                     uint64_t* out64, long capacity, int n_threads,
                      long* bad_records, size_t* consumed) {
   struct Range { size_t off; uint32_t len; };
   *bad_records = 0;
@@ -303,24 +601,11 @@ long df_decode_l4_mt(const uint8_t* payload, size_t len, uint32_t* out,
     long rows = first;
     Row r;
     for (long i = first; i < last; ++i) {
-      const uint8_t* rec = payload + ranges[i].off;
-      Cursor c{rec, rec + ranges[i].len};
-      std::memset(&r, 0, sizeof(r));
-      bool ok = false;
-      uint32_t wt;
-      for (uint32_t tag; (tag = next_tag(c, &wt)) != 0; ) {
-        if (tag == 1 && wt == 2) {
-          Cursor sub;
-          if (open_sub(c, &sub) && parse_flow(sub, &r)) ok = true;
-          else { ok = false; break; }
-        } else if (!skip_field(c, wt)) {
-          ok = false;
-          break;
-        }
+      if (!decode_record(payload + ranges[i].off, ranges[i].len, &r)) {
+        ++*bad_out;
+        continue;
       }
-      if (!ok) { ++*bad_out; continue; }
-      for (int col = 0; col < N_COLS; ++col)
-        out[static_cast<size_t>(col) * capacity + rows] = r.v[col];
+      store_row(out32, out64, capacity, rows, r);
       ++rows;
     }
     *rows_out = rows - first;
@@ -346,10 +631,15 @@ long df_decode_l4_mt(const uint8_t* payload, size_t len, uint32_t* out,
   for (int t = 1; t < n_threads; ++t) {
     if (t_rows[t] == 0) continue;
     if (rows != t_first[t]) {
-      for (int col = 0; col < N_COLS; ++col) {
-        uint32_t* base = out + static_cast<size_t>(col) * capacity;
+      for (int col = 0; col < N_COLS32; ++col) {
+        uint32_t* base = out32 + static_cast<size_t>(col) * capacity;
         std::memmove(base + rows, base + t_first[t],
                      static_cast<size_t>(t_rows[t]) * sizeof(uint32_t));
+      }
+      for (int col = 0; col < N_COLS64; ++col) {
+        uint64_t* base = out64 + static_cast<size_t>(col) * capacity;
+        std::memmove(base + rows, base + t_first[t],
+                     static_cast<size_t>(t_rows[t]) * sizeof(uint64_t));
       }
     }
     rows += t_rows[t];
@@ -359,6 +649,7 @@ long df_decode_l4_mt(const uint8_t* payload, size_t len, uint32_t* out,
   return rows;
 }
 
-int df_n_l4_cols(void) { return N_COLS; }
+int df_n_l4_cols(void) { return N_COLS32; }
+int df_n_l4_cols64(void) { return N_COLS64; }
 
 }  // extern "C"

@@ -30,6 +30,21 @@ KG_FIELDS = (
     "pod_node_id", "pod_ns_id", "pod_group_id", "pod_id", "pod_cluster_id",
 )
 
+# derived per side at stamp time (reference KnowledgeGraph :283-293):
+# epc_id, service_id, auto_instance/auto_service — the most-specific
+# resource owning the IP, pod > pod_node > l3_device (framework-local
+# type enum below; the reference uses tagrecorder device-type codes)
+KG_DERIVED_FIELDS = (
+    "epc_id", "service_id",
+    "auto_instance_id", "auto_instance_type",
+    "auto_service_id", "auto_service_type",
+)
+AUTO_TYPE_NONE = 0
+AUTO_TYPE_POD = 1
+AUTO_TYPE_POD_NODE = 2
+AUTO_TYPE_L3_DEVICE = 3
+AUTO_TYPE_SERVICE = 4
+
 
 @dataclass(frozen=True)
 class InterfaceInfo:
@@ -75,6 +90,23 @@ class ServiceEntry:
 
 def _pack(epc: np.ndarray, ip: np.ndarray) -> np.ndarray:
     return (epc.astype(np.uint64) << np.uint64(32)) | ip.astype(np.uint64)
+
+
+def _epc_pair(cols: Dict[str, np.ndarray], n: int, src_name: str,
+              dst_name: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-side epc columns as u32 images; rows where the dst side is
+    unset fall back to the src epc (single-VPC flows, and agents that
+    only fill the src peer)."""
+    def as_u32(name: str) -> np.ndarray:
+        c = cols.get(name)
+        if c is None:
+            return np.zeros(n, np.uint32)
+        return c.view(np.uint32) if c.dtype == np.int32 \
+            else c.astype(np.uint32)
+
+    epc0 = as_u32(src_name)
+    epc1 = as_u32(dst_name)
+    return epc0, np.where(epc1 != 0, epc1, epc0)
 
 
 class PlatformInfoTable:
@@ -253,17 +285,68 @@ class PlatformDataManager:
             self.services = ServiceTable(services)
         return changed
 
+    def _stamp_side(self, out: Dict[str, np.ndarray], side: str,
+                    epc: np.ndarray, ip: np.ndarray, port: np.ndarray,
+                    proto: np.ndarray) -> None:
+        """KG lookup + derived columns for one side. Existing nonzero
+        values in `out` win (eBPF-sourced pod ids etc. are ground truth;
+        reference: grpc_platformdata QueryEpcIDPodInfo precedence)."""
+        kg = self.info.query(epc, ip)
+        for f in KG_FIELDS:
+            name = f"{f}_{side}"
+            if name in out:
+                have = out[name].astype(np.uint32, copy=False)
+                out[name] = np.where(have != 0, have, kg[f])
+            else:
+                out[name] = kg[f]
+        svc = self.services.query(epc, ip, port, proto)
+        out[f"service_id_{side}"] = svc
+        # epc_id: the interface's epc when known, else the flow's
+        out[f"epc_id_{side}"] = np.ascontiguousarray(epc).view(np.int32)
+        # auto_instance: most-specific owner — pod > pod_node > l3_device
+        pod = out[f"pod_id_{side}"]
+        node = out[f"pod_node_id_{side}"]
+        dev = out[f"l3_device_id_{side}"]
+        inst_id = np.where(pod != 0, pod, np.where(node != 0, node, dev))
+        inst_ty = np.where(
+            pod != 0, AUTO_TYPE_POD,
+            np.where(node != 0, AUTO_TYPE_POD_NODE,
+                     np.where(dev != 0, AUTO_TYPE_L3_DEVICE,
+                              AUTO_TYPE_NONE)))
+        out[f"auto_instance_id_{side}"] = inst_id.astype(np.uint32)
+        out[f"auto_instance_type_{side}"] = inst_ty.astype(np.uint32)
+        # auto_service: the service when registered, else the instance
+        out[f"auto_service_id_{side}"] = np.where(
+            svc != 0, svc, inst_id).astype(np.uint32)
+        out[f"auto_service_type_{side}"] = np.where(
+            svc != 0, AUTO_TYPE_SERVICE, inst_ty).astype(np.uint32)
+
     def stamp_l4(self, cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Add KnowledgeGraph columns for both sides of an L4 batch, plus
-        server-side service_id (reference: decoder.go handleTaggedFlow ->
-        fillL4FlowLog KnowledgeGraph stamping)."""
-        epc = cols["l3_epc_id"].view(np.uint32) if cols["l3_epc_id"].dtype \
-            == np.int32 else cols["l3_epc_id"].astype(np.uint32)
+        per-side service/epc/auto_* (reference: decoder.go handleTaggedFlow
+        -> fillL4FlowLog KnowledgeGraph stamping)."""
+        n = len(cols["ip_src"])
         out = dict(cols)
-        for side, ipcol in (("0", "ip_src"), ("1", "ip_dst")):
-            kg = self.info.query(epc, cols[ipcol])
-            for f in KG_FIELDS:
-                out[f"{f}_{side}"] = kg[f]
-        out["service_id_1"] = self.services.query(
-            epc, cols["ip_dst"], cols["port_dst"], cols["proto"])
+        epc0, epc1 = _epc_pair(cols, n, "l3_epc_id", "l3_epc_id_1")
+        # client side matches any-port service entries (reference queries
+        # the ServiceTable with port 0 for side 0)
+        self._stamp_side(out, "0", epc0, cols["ip_src"],
+                         np.zeros(n, np.uint32), cols["proto"])
+        self._stamp_side(out, "1", epc1, cols["ip_dst"],
+                         cols["port_dst"], cols["proto"])
+        return out
+
+    def stamp_l7(self, cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """KnowledgeGraph + service enrichment for l7_flow_log / OTel
+        columns (reference: decoder.go:310 ProtoLogToL7FlowLog stamps the
+        same PlatformInfoTable tags on L7 rows). Wire-carried pod ids
+        (eBPF ground truth) take precedence over the IP-table lookup."""
+        n = len(cols["ip_src"])
+        out = dict(cols)
+        proto = cols.get("protocol", np.full(n, 6, np.uint32))
+        epc0, epc1 = _epc_pair(cols, n, "l3_epc_id_0", "l3_epc_id_1")
+        self._stamp_side(out, "0", epc0, cols["ip_src"],
+                         np.zeros(n, np.uint32), proto)
+        self._stamp_side(out, "1", epc1, cols["ip_dst"],
+                         cols["port_dst"], proto)
         return out

@@ -163,7 +163,7 @@ class FlowLogPipeline:
             ("l4_flow_log", MessageType.TAGGEDFLOW, L4_TABLE,
              columnar.decode_l4_records, platform.stamp_l4),
             ("l7_flow_log", MessageType.PROTOCOLLOG, L7_TABLE,
-             decode_l7, lambda c: c),
+             decode_l7, platform.stamp_l7),
         ):
             queues = MultiQueue(f"ingest.{stream}", n_decoders, queue_size)
             receiver.register_handler(msg_type, queues)
@@ -236,8 +236,11 @@ class FlowLogPipeline:
         # "l7_flow_log" (e.g. the OTLP exporter) must NOT re-export spans
         # that arrived via OTLP — the reference filters by SignalSource
         # bits for the same reason (otlp_exporter IsExportData)
+        # OTel rows get the same KnowledgeGraph stamping as PROTOCOLLOG l7
+        # rows (reference: decoder.go ProtoLogToL7FlowLog for both sources)
         otel_decoder = _Decoder(
-            "l7_flow_log.otel", 0, otel_queues, _decode_otel, lambda c: c,
+            "l7_flow_log.otel", 0, otel_queues, _decode_otel,
+            platform.stamp_l7,
             # the l7 write budget is shared with the PROTOCOLLOG decoders
             # (all feed the same table), so every consumer gets an equal
             # slice of the configured cap

@@ -3,7 +3,9 @@
 The enriched l4/l7 schemas are the decode schemas (batch/schema.py) plus
 the KnowledgeGraph tag columns stamped by enrich/platform_data.py —
 mirroring how the reference's row structs carry a KnowledgeGraph block
-(log_data/l4_flow_log.go:226-266). Agg kinds drive the rollup manager.
+(log_data/l4_flow_log.go:226-266). Agg kinds drive the rollup manager:
+KEY columns form rollup group identity, SUM/MAX columns aggregate, LAST
+columns pass through.
 """
 
 from __future__ import annotations
@@ -11,18 +13,44 @@ from __future__ import annotations
 import numpy as np
 
 from deepflow_tpu.batch.schema import L4_SCHEMA, L7_SCHEMA, METRIC_SCHEMA
-from deepflow_tpu.enrich.platform_data import KG_FIELDS
+from deepflow_tpu.enrich.platform_data import KG_DERIVED_FIELDS, KG_FIELDS
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
 
 _U32 = np.dtype(np.uint32)
+_I32 = np.dtype(np.int32)
 
 # which decode columns form the rollup group-by identity
 _L4_KEYS = {"ip_src", "ip_dst", "port_dst", "proto", "vtap_id",
             "l3_epc_id", "tap_side", "timestamp"}
-_L4_AGG = {"byte_tx": AggKind.SUM, "byte_rx": AggKind.SUM,
-           "packet_tx": AggKind.SUM, "packet_rx": AggKind.SUM,
-           "rtt": AggKind.MAX, "retrans": AggKind.SUM,
-           "duration_us": AggKind.MAX}
+_L4_AGG = {
+    # core
+    "byte_tx": AggKind.SUM, "byte_rx": AggKind.SUM,
+    "packet_tx": AggKind.SUM, "packet_rx": AggKind.SUM,
+    "rtt": AggKind.MAX, "retrans": AggKind.SUM,
+    "duration_us": AggKind.MAX,
+    # metrics family (l4_flow_log.go Metrics :466)
+    "l3_byte_tx": AggKind.SUM, "l3_byte_rx": AggKind.SUM,
+    "l4_byte_tx": AggKind.SUM, "l4_byte_rx": AggKind.SUM,
+    "total_byte_tx": AggKind.SUM, "total_byte_rx": AggKind.SUM,
+    "total_packet_tx": AggKind.SUM, "total_packet_rx": AggKind.SUM,
+    "l7_request": AggKind.SUM, "l7_response": AggKind.SUM,
+    "l7_parse_failed": AggKind.SUM,
+    "l7_client_error": AggKind.SUM, "l7_server_error": AggKind.SUM,
+    "l7_server_timeout": AggKind.SUM,
+    "rtt_client": AggKind.MAX, "rtt_server": AggKind.MAX,
+    "tls_rtt": AggKind.MAX,
+    "srt_sum": AggKind.SUM, "srt_count": AggKind.SUM,
+    "srt_max": AggKind.MAX,
+    "art_sum": AggKind.SUM, "art_count": AggKind.SUM,
+    "art_max": AggKind.MAX,
+    "rrt_sum": AggKind.SUM, "rrt_count": AggKind.SUM,
+    "rrt_max": AggKind.MAX,
+    "cit_sum": AggKind.SUM, "cit_count": AggKind.SUM,
+    "cit_max": AggKind.MAX,
+    "retrans_tx": AggKind.SUM, "retrans_rx": AggKind.SUM,
+    "zero_win_tx": AggKind.SUM, "zero_win_rx": AggKind.SUM,
+    "syn_count": AggKind.SUM, "synack_count": AggKind.SUM,
+}
 
 
 def _lift(batch_schema, keys, aggs) -> tuple:
@@ -36,12 +64,17 @@ def _lift(batch_schema, keys, aggs) -> tuple:
     return tuple(cols)
 
 
-def _kg_columns() -> tuple:
+def _kg_columns(skip=()) -> tuple:
+    """Columns stamped by PlatformDataManager per side: KG_FIELDS from the
+    interface table plus the derived epc/service/auto_* set."""
     cols = []
     for side in ("0", "1"):
-        for f in KG_FIELDS:
-            cols.append(ColumnSpec(f"{f}_{side}", _U32, AggKind.KEY))
-    cols.append(ColumnSpec("service_id_1", _U32, AggKind.KEY))
+        for f in KG_FIELDS + KG_DERIVED_FIELDS:
+            name = f"{f}_{side}"
+            if name in skip:
+                continue
+            dt = _I32 if f == "epc_id" else _U32
+            cols.append(ColumnSpec(name, dt, AggKind.KEY))
     return tuple(cols)
 
 
@@ -57,9 +90,14 @@ _L7_KEYS = {"ip_src", "ip_dst", "port_dst", "protocol", "l7_protocol",
 _L7_AGG = {"rrt_us": AggKind.MAX, "req_len": AggKind.SUM,
            "resp_len": AggKind.SUM, "status": AggKind.MAX}
 
+# pod_id_0/1 are decode columns on L7 (eBPF-sourced); the stamp merges
+# into them rather than adding a second pair
+_L7_DECODED_KG = {"pod_id_0", "pod_id_1"}
+
 L7_TABLE = TableSchema(
     name="l7_flow_log",
-    columns=_lift(L7_SCHEMA, _L7_KEYS, _L7_AGG),
+    columns=_lift(L7_SCHEMA, _L7_KEYS, _L7_AGG)
+    + _kg_columns(skip=_L7_DECODED_KG),
     time_column="timestamp",
     ttl_seconds=3 * 24 * 3600,
 )

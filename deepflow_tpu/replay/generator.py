@@ -71,6 +71,27 @@ class SyntheticAgent:
             "flow_id": np.arange(n, dtype=np.uint64) + np.uint64(1),
             "rtt": r.integers(100, 200_000, n).astype(np.uint32),
             "retrans": (r.random(n) < 0.02).astype(np.uint32) * r.integers(1, 5, n).astype(np.uint32),
+            # wide-schema families
+            "mac_src": r.integers(0, 1 << 48, n).astype(np.uint64),
+            "mac_dst": r.integers(0, 1 << 48, n).astype(np.uint64),
+            "vlan": r.integers(0, 4096, n).astype(np.uint32),
+            "tcp_flags_bit_0": r.integers(0, 256, n).astype(np.uint32),
+            "tcp_flags_bit_1": r.integers(0, 256, n).astype(np.uint32),
+            "syn_seq": r.integers(0, 1 << 32, n).astype(np.uint32),
+            "synack_seq": r.integers(0, 1 << 32, n).astype(np.uint32),
+            "l3_byte_tx": r.integers(0, 1 << 20, n).astype(np.uint32),
+            "l3_byte_rx": r.integers(0, 1 << 20, n).astype(np.uint32),
+            "total_packet_tx": r.integers(1, 128, n).astype(np.uint32),
+            "total_packet_rx": r.integers(1, 128, n).astype(np.uint32),
+            "rtt_client": r.integers(50, 100_000, n).astype(np.uint32),
+            "rtt_server": r.integers(50, 100_000, n).astype(np.uint32),
+            "retrans_tx": (r.random(n) < 0.02).astype(np.uint32),
+            "retrans_rx": (r.random(n) < 0.02).astype(np.uint32),
+            "l7_request": r.integers(0, 16, n).astype(np.uint32),
+            "l7_response": r.integers(0, 16, n).astype(np.uint32),
+            "direction_score": r.integers(0, 256, n).astype(np.uint32),
+            "gprocess_id_0": r.integers(0, 1 << 16, n).astype(np.uint32),
+            "gprocess_id_1": r.integers(0, 1 << 16, n).astype(np.uint32),
         }
         return cols
 
@@ -90,6 +111,9 @@ class SyntheticAgent:
     @staticmethod
     def l4_record(cols: dict, i: int) -> bytes:
         """Serialize row i of the column dict as one TaggedFlow record."""
+        def g(name: str, default: int = 0) -> int:
+            return int(cols[name][i]) if name in cols else default
+
         m = flow_log_pb2.TaggedFlow()
         f = m.flow
         k = f.flow_key
@@ -100,28 +124,51 @@ class SyntheticAgent:
         k.port_src = int(cols["port_src"][i])
         k.port_dst = int(cols["port_dst"][i])
         k.proto = int(cols["proto"][i])
+        k.mac_src = g("mac_src")
+        k.mac_dst = g("mac_dst")
         src = f.metrics_peer_src
         src.byte_count = int(cols["byte_tx"][i])
         src.packet_count = int(cols["packet_tx"][i])
         src.total_byte_count = int(cols["byte_tx"][i])
+        src.total_packet_count = g("total_packet_tx")
+        src.l3_byte_count = g("l3_byte_tx")
         src.l3_epc_id = int(cols["l3_epc_id"][i])
+        src.tcp_flags = g("tcp_flags_bit_0")
+        src.gpid = g("gprocess_id_0")
         dst = f.metrics_peer_dst
+        dst.l3_epc_id = g("l3_epc_id_1", int(cols["l3_epc_id"][i]))
         dst.byte_count = int(cols["byte_rx"][i])
         dst.packet_count = int(cols["packet_rx"][i])
         dst.total_byte_count = int(cols["byte_rx"][i])
+        dst.total_packet_count = g("total_packet_rx")
+        dst.l3_byte_count = g("l3_byte_rx")
+        dst.tcp_flags = g("tcp_flags_bit_1")
+        dst.gpid = g("gprocess_id_1")
         f.flow_id = int(cols["flow_id"][i])
         f.start_time = int(cols["start_time"][i])
         f.end_time = int(cols["start_time"][i] + cols["duration"][i])
         f.duration = int(cols["duration"][i])
         f.eth_type = 0x0800
+        f.vlan = g("vlan")
         f.close_type = int(cols["close_type"][i])
         f.tap_side = int(cols["tap_side"][i])
         f.is_new_flow = 1
+        f.syn_seq = g("syn_seq")
+        f.synack_seq = g("synack_seq")
+        f.direction_score = g("direction_score")
         if cols["rtt"][i] or cols["retrans"][i]:
             f.has_perf_stats = 1
             f.perf_stats.l4_protocol = 1
-            f.perf_stats.tcp.rtt = int(cols["rtt"][i])
-            f.perf_stats.tcp.total_retrans_count = int(cols["retrans"][i])
+            tcp = f.perf_stats.tcp
+            tcp.rtt = int(cols["rtt"][i])
+            tcp.total_retrans_count = int(cols["retrans"][i])
+            tcp.rtt_client_max = g("rtt_client")
+            tcp.rtt_server_max = g("rtt_server")
+            tcp.counts_peer_tx.retrans_count = g("retrans_tx")
+            tcp.counts_peer_rx.retrans_count = g("retrans_rx")
+            l7 = f.perf_stats.l7
+            l7.request_count = g("l7_request")
+            l7.response_count = g("l7_response")
         return m.SerializeToString()
 
     def l4_batch(self, n: int) -> Tuple[dict, List[bytes]]:

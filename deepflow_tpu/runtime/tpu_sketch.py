@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from deepflow_tpu.batch.batcher import Batcher, TensorBatch
-from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
 from deepflow_tpu.models import flow_suite
 from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
 from deepflow_tpu.runtime.exporters import QueueWorkerExporter
@@ -72,12 +72,14 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._jnp = jnp
         self.cfg = cfg or flow_suite.FlowSuiteConfig()
         self.window_seconds = window_seconds
-        self.batcher = Batcher(L4_SCHEMA, capacity=batch_rows)
+        # only the kernel-consumed subset is batched and transferred to
+        # device — the wide store schema never crosses the PCIe/ICI
+        self.batcher = Batcher(SKETCH_L4_SCHEMA, capacity=batch_rows)
         self.state = flow_suite.init(self.cfg)
         self.checkpointer = None
         self.checkpoint_every = max(1, checkpoint_every)
         self.windows = 0
-        self._rows_at_ckpt = 0
+        self._rows_at_flush = 0
         if checkpoint_dir is not None:
             self.checkpointer = SketchCheckpointer(checkpoint_dir)
             restored = self.checkpointer.restore(self.state)
@@ -88,7 +90,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                 self.windows = self.checkpointer.latest_step() or 0
                 # restored accumulation is live data this process hasn't
                 # counted; mark dirty so its replayed window checkpoints
-                self._rows_at_ckpt = -1
+                self._rows_at_flush = -1
         self.topk_writer = self.window_writer = None
         if store is not None:
             self.topk_writer = StoreWriter(
@@ -140,7 +142,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                 name: np.ascontiguousarray(cols[name]).astype(dt, copy=False)
                 if name in cols else
                 np.zeros(len(next(iter(cols.values()))), dt)
-                for name, dt in L4_SCHEMA.columns
+                for name, dt in SKETCH_L4_SCHEMA.columns
             }
             with self._state_lock:
                 for tb in self.batcher.put(schema_cols):
@@ -166,15 +168,17 @@ class TpuSketchExporter(QueueWorkerExporter):
             # checkpoint the PRE-flush state (the window's accumulation):
             # restore replays the window at-least-once; saving post-flush
             # would snapshot a reset state and recover nothing. Cadence:
-            # every checkpoint_every-th window, and only if rows arrived
-            # since the last save (a full npz per 1s window is not
-            # "low-overhead"); restart then loses at most checkpoint_every
-            # windows instead of one — a documented, configurable trade.
-            dirty = self.rows_in != self._rows_at_ckpt
+            # every checkpoint_every-th window, and only if THIS window's
+            # accumulation is non-empty (a full npz per idle 1s window is
+            # not "low-overhead"). Rows in already-flushed windows need no
+            # snapshot — their output reached the store; restart loses at
+            # most the current accumulation, bounded by checkpoint_every
+            # windows of data.
+            dirty = self.rows_in != self._rows_at_flush
             if (self.checkpointer is not None and dirty
                     and self.windows % self.checkpoint_every == 0):
                 self.checkpointer.save(self.state, self.windows)
-                self._rows_at_ckpt = self.rows_in
+            self._rows_at_flush = self.rows_in
             self.state, out = self._flush_fn(self.state)
         self.last_output = out
         self._write_output(out, int(now))

@@ -15,11 +15,13 @@ path sustain the TPU kernel's >10M records/s.
 Frame payload layout (all little-endian, inside a COLUMNAR_FLOW frame):
 
     u32 magic 'DFCL'  | u16 version | u16 n_cols | u32 schema_hash
-    u32 n_rows        | n_cols * n_rows * u32 column planes
+    u32 n_rows        | per-column planes, schema order
 
-Columns appear in schema order; every device schema column is 4 bytes
-(int32 columns travel as their two's-complement uint32 image, exactly
-like the native protobuf decoder's output contract).
+Each plane is n_rows * itemsize bytes at the column's schema dtype width
+(4 for u32/i32 — int32 travels as its two's-complement uint32 image,
+exactly like the native protobuf decoder's output contract — 8 for the
+u64 identity columns). The schema_hash covers dtypes, so both ends agree
+on every plane's width and offset.
 """
 
 from __future__ import annotations
@@ -33,7 +35,7 @@ import numpy as np
 from deepflow_tpu.batch.schema import L4_SCHEMA, Schema
 
 MAGIC = 0x4C434644  # b"DFCL" little-endian
-VERSION = 1
+VERSION = 2         # v2: mixed 4/8-byte planes (v1 was u32-only)
 
 _HEADER = struct.Struct("<IHHII")
 HEADER_LEN = _HEADER.size
@@ -51,19 +53,15 @@ def encode_columnar(cols: Dict[str, np.ndarray],
                     schema: Schema = L4_SCHEMA) -> bytes:
     """Pack equal-length column arrays into one planar payload."""
     n = len(next(iter(cols.values())))
-    mat = np.empty((len(schema.columns), n), np.uint32)
-    for i, (name, dt) in enumerate(schema.columns):
-        assert np.dtype(dt).itemsize == 4, f"{name}: wire planes are 4-byte"
-        col = cols[name]
+    parts = [_HEADER.pack(MAGIC, VERSION, len(schema.columns),
+                          schema_hash(schema), n)]
+    for name, dt in schema.columns:
+        col = np.asarray(cols[name])
         if len(col) != n:
             raise ValueError(f"ragged column {name}: {len(col)} != {n}")
-        if col.dtype == np.int32:
-            mat[i] = np.asarray(col).view(np.uint32)
-        else:
-            mat[i] = np.asarray(col).astype(np.uint32, copy=False)
-    header = _HEADER.pack(MAGIC, VERSION, len(schema.columns),
-                          schema_hash(schema), n)
-    return header + mat.tobytes()
+        parts.append(np.ascontiguousarray(
+            col.astype(dt, copy=False)).tobytes())
+    return b"".join(parts)
 
 
 def decode_columnar(payload: bytes, schema: Schema = L4_SCHEMA
@@ -78,16 +76,15 @@ def decode_columnar(payload: bytes, schema: Schema = L4_SCHEMA
         if (magic != MAGIC or version != VERSION or n_cols != ncols
                 or shash != schema_hash(schema)):
             raise ValueError("columnar header mismatch")
-        need = HEADER_LEN + 4 * ncols * n_rows
+        need = HEADER_LEN + schema.row_bytes() * n_rows
         if len(payload) < need:
             raise ValueError(f"short columnar payload: {len(payload)}/{need}")
     except (struct.error, ValueError):
         return {n: np.empty(0, d) for n, d in schema.columns}, 1
-    mat = np.frombuffer(payload, np.uint32, count=ncols * n_rows,
-                        offset=HEADER_LEN).reshape(ncols, n_rows)
     cols: Dict[str, np.ndarray] = {}
-    for i, (name, dt) in enumerate(schema.columns):
-        col = mat[i]
-        cols[name] = col.view(np.int32) if np.dtype(dt) == np.int32 \
-            else col
+    off = HEADER_LEN
+    for name, dt in schema.columns:
+        dt = np.dtype(dt)
+        cols[name] = np.frombuffer(payload, dt, count=n_rows, offset=off)
+        off += dt.itemsize * n_rows
     return cols, 0

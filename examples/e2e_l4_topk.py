@@ -16,7 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepflow_tpu.batch import Batcher, L4_SCHEMA
+from deepflow_tpu.batch import Batcher, SKETCH_L4_SCHEMA
 from deepflow_tpu.decode import decode_l4_records
 from deepflow_tpu.models import FlowSuiteConfig, flow_suite
 from deepflow_tpu.parallel import ShardedFlowSuite, make_mesh
@@ -50,7 +50,7 @@ def main() -> None:
     # --- ingester side: frames -> records -> columns -> batches ----------
     t0 = time.perf_counter()
     reader = FrameReader()
-    batcher = Batcher(L4_SCHEMA, capacity=args.batch)
+    batcher = Batcher(SKETCH_L4_SCHEMA, capacity=args.batch)
     n_batches = 0
     feature_names = ("ip_src", "ip_dst", "port_src", "port_dst", "proto",
                      "packet_tx", "packet_rx")

@@ -99,3 +99,65 @@ def test_stamp_l4_both_sides():
     assert out["pod_id_0"].tolist() == [1, 1]
     assert out["pod_id_1"].tolist() == [9, 0]
     assert out["service_id_1"].tolist() == [444, 0]
+
+
+def test_stamp_l7_and_auto_tags():
+    """L7 rows get KnowledgeGraph + service ids (reference: decoder.go:310
+    ProtoLogToL7FlowLog); wire-carried (eBPF) pod ids take precedence; the
+    auto_instance/auto_service hierarchy picks pod > pod_node > device."""
+    mgr = PlatformDataManager()
+    mgr.update(
+        interfaces=[
+            InterfaceInfo(epc_id=5, ip=100, pod_id=11, pod_node_id=3,
+                          region_id=2),
+            InterfaceInfo(epc_id=5, ip=200, pod_node_id=4, region_id=2,
+                          l3_device_id=70),
+        ],
+        cidrs=[],
+        services=[ServiceEntry(epc_id=5, ip=200, port=8080, protocol=6,
+                               service_id=444)],
+        version=1)
+    cols = {
+        "l3_epc_id_0": np.array([5, 5], np.int32),
+        "l3_epc_id_1": np.array([5, 0], np.int32),  # row 1: epc falls back
+        "ip_src": np.array([100, 100], np.uint32),
+        "ip_dst": np.array([200, 200], np.uint32),
+        "port_dst": np.array([8080, 8080], np.uint32),
+        "protocol": np.array([6, 6], np.uint32),
+        # row 1 carries an eBPF-sourced pod id: must win over the lookup
+        "pod_id_0": np.array([0, 999], np.uint32),
+        "pod_id_1": np.array([0, 0], np.uint32),
+    }
+    out = mgr.stamp_l7(cols)
+    assert out["pod_id_0"].tolist() == [11, 999]
+    assert out["region_id_0"].tolist() == [2, 2]
+    assert out["region_id_1"].tolist() == [2, 2]   # row 1 via epc fallback
+    assert out["service_id_1"].tolist() == [444, 444]
+    # auto hierarchy: side 0 is a pod; side 1 has no pod -> pod_node
+    assert out["auto_instance_id_0"].tolist() == [11, 999]
+    assert out["auto_instance_type_0"].tolist() == [1, 1]        # POD
+    assert out["auto_instance_id_1"].tolist() == [4, 4]
+    assert out["auto_instance_type_1"].tolist() == [2, 2]        # POD_NODE
+    # auto_service prefers the registered service
+    assert out["auto_service_id_1"].tolist() == [444, 444]
+    assert out["auto_service_type_1"].tolist() == [4, 4]         # SERVICE
+    assert out["epc_id_1"].tolist() == [5, 5]
+
+
+def test_stamp_l4_auto_service_falls_back_to_instance():
+    mgr = PlatformDataManager()
+    mgr.update(
+        interfaces=[InterfaceInfo(epc_id=7, ip=50, l3_device_id=31)],
+        cidrs=[], services=[], version=1)
+    cols = {
+        "l3_epc_id": np.array([7], np.int32),
+        "ip_src": np.array([50], np.uint32),
+        "ip_dst": np.array([60], np.uint32),
+        "port_dst": np.array([80], np.uint32),
+        "proto": np.array([6], np.uint32),
+    }
+    out = mgr.stamp_l4(cols)
+    assert out["auto_instance_id_0"].tolist() == [31]
+    assert out["auto_instance_type_0"].tolist() == [3]           # L3_DEVICE
+    assert out["auto_service_id_0"].tolist() == [31]             # no service
+    assert out["auto_service_type_0"].tolist() == [3]

@@ -147,3 +147,27 @@ def test_otlp_exporter_roundtrip():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_decode_otel_hostile_attributes():
+    """A negative int64 http.status_code (AnyValue.int_value is full
+    int64) must not crash the columnar staging or drop the batch."""
+    req = otel_pb2.ExportTraceServiceRequest()
+    rs = req.resource_spans.add()
+    kv = rs.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = "hostile-svc"
+    ss = rs.scope_spans.add()
+    s = ss.spans.add()
+    s.name = "GET /x"
+    s.start_time_unix_nano = 1_700_000_000_000_000_000
+    s.end_time_unix_nano = 1_700_000_000_001_000_000
+    a = s.attributes.add()
+    a.key = "http.status_code"
+    a.value.int_value = -1
+    cols, bad = decode_otel_frames([req.SerializeToString()])
+    assert bad == 0
+    assert len(cols["timestamp"]) == 1
+    assert cols["response_code"].tolist() == [-1]  # i32 image preserved
+    assert cols["app_service_hash"][0] != 0
+    assert cols["trace_id_hash"].tolist() == [0]   # empty id -> null image

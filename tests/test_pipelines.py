@@ -146,3 +146,63 @@ def test_storage_disabled_mode_exports():
         assert ing.store is None
     finally:
         ing.close()
+
+
+def test_l7_firehose_rows_are_enriched(ingester):
+    """l7_flow_log rows carry pod/service attribution after the firehose
+    (VERDICT r1 weak #2: the reference stamps KnowledgeGraph on L7 too —
+    decoder.go:310 ProtoLogToL7FlowLog + PlatformInfoTable)."""
+    from deepflow_tpu.wire.codec import pack_pb_records
+    from deepflow_tpu.wire.framing import FlowHeader, encode_frame
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    server_ip, server_port = 0xAC100001, 8080
+    ingester.platform.update(
+        [InterfaceInfo(epc_id=5, ip=server_ip, region_id=9, pod_id=42)],
+        [],
+        [ServiceEntry(epc_id=5, ip=server_ip, port=server_port, protocol=6,
+                      service_id=777)],
+        version=1)
+
+    n = 40
+    records = []
+    for i in range(n):
+        m = flow_log_pb2.AppProtoLogsData()
+        b = m.base
+        b.ip_src, b.ip_dst = 0x0A000001 + i, server_ip
+        b.port_src, b.port_dst = 40000 + i, server_port
+        b.protocol = 6
+        b.vtap_id = 7
+        b.l3_epc_id_src = 5
+        b.l3_epc_id_dst = 5
+        b.start_time = 1_700_000_000_000_000_000 + i
+        b.head.proto = 20      # HTTP1
+        b.head.msg_type = 2
+        b.head.rrt = 1_500_000
+        m.req.req_type = "GET"
+        m.req.domain = "svc.example"
+        m.req.resource = "/api/x"
+        m.resp.status = 0
+        m.resp.code = 200
+        m.trace_info.trace_id = f"trace-{i}"
+        records.append(m.SerializeToString())
+    frame = encode_frame(MessageType.PROTOCOLLOG, pack_pb_records(records),
+                         FlowHeader(sequence=1, vtap_id=7))
+    _send_all(ingester.port, [frame])
+
+    table = ingester.store.table("flow_log", "l7_flow_log")
+    # the records counter ticks before the throttler offer, so flush+poll
+    # the table itself rather than racing the decoder thread
+    assert _wait(lambda: (ingester.flow_log.flush() or True)
+                 and table.row_count() >= n)
+    out = table.scan()
+    assert len(out["ip_dst"]) == n
+    # KnowledgeGraph + service stamped on the server side
+    assert (out["pod_id_1"] == 42).all()
+    assert (out["region_id_1"] == 9).all()
+    assert (out["service_id_1"] == 777).all()
+    # wide decode columns made it through the store
+    assert (out["response_code"] == 200).all()
+    assert (out["request_type_hash"] != 0).all()
+    assert (out["trace_id_hash"] != 0).all()
+    assert (out["rrt_us"] == 1500).all()
