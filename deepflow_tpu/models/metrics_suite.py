@@ -69,14 +69,21 @@ def signal_matrix(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     return jnp.log1p(x)
 
 
-def update(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
-           mask: jnp.ndarray, cfg: MetricsSuiteConfig) -> MetricsSuiteState:
+def entropy_update(ent: entropy.EntropyState, cols: Dict[str, jnp.ndarray],
+                   mask: jnp.ndarray) -> entropy.EntropyState:
+    """The entropy half of the update — shared with the sharded suite so
+    feature/weighting choices can never drift between the two paths."""
     feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
     packets = (cols["packet_tx"] + cols["packet_rx"]).astype(jnp.int32)
     # 2 weight planes: per-record packet counts saturate at 65535
     # (ample for 1s flow ticks) and each plane costs a full matmul
     # pass, so the third plane was pure overhead
-    ent = entropy.update(state.ent, feats, packets, mask, weight_planes=2)
+    return entropy.update(ent, feats, packets, mask, weight_planes=2)
+
+
+def update(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
+           mask: jnp.ndarray, cfg: MetricsSuiteConfig) -> MetricsSuiteState:
+    ent = entropy_update(state.ent, cols, mask)
     p = pca.update(state.pca, signal_matrix(cols), mask, lr=cfg.pca_lr)
     return state._replace(ent=ent, pca=p)
 

@@ -43,24 +43,13 @@ def _standardize(state: PCAState, x: jnp.ndarray) -> jnp.ndarray:
 
 def update(state: PCAState, x: jnp.ndarray, mask: jnp.ndarray | None = None,
            lr: float = 0.05, ema: float = 0.01) -> PCAState:
-    """One batched Oja step on x: [n, features] float32."""
-    n = x.shape[0]
-    if mask is None:
-        m = jnp.ones((n,), jnp.float32)
-    else:
-        m = mask.astype(jnp.float32)
-    cnt = jnp.maximum(jnp.sum(m), 1.0)
-    xm = x * m[:, None]
-    bmean = jnp.sum(xm, axis=0) / cnt
-    bvar = jnp.sum(((x - bmean[None, :]) ** 2) * m[:, None], axis=0) / cnt
-    mean = (1 - ema) * state.mean + ema * bmean
-    var = (1 - ema) * state.var + ema * bvar
+    """One batched Oja step on x: [n, features] float32.
 
-    z = _standardize(state._replace(mean=mean, var=var), x) * m[:, None]
-    g = z.T @ (z @ state.w) / cnt            # [f, k] — MXU matmuls
-    w, _ = jnp.linalg.qr(state.w + lr * g)
-    return PCAState(mean=mean, var=var, w=w.astype(jnp.float32),
-                    step=state.step + 1)
+    Defined as grad + apply_grad so the single-device step IS the
+    distributed algorithm with a world size of one — the sharded suite
+    psums the grad() tuple between the two calls, and both paths
+    standardize with the same (pre-update) statistics."""
+    return apply_grad(state, *grad(state, x, mask), lr=lr, ema=ema)
 
 
 def score(state: PCAState, x: jnp.ndarray) -> jnp.ndarray:

@@ -1,4 +1,4 @@
 from deepflow_tpu.parallel.mesh import make_mesh
-from deepflow_tpu.parallel.sharded import ShardedFlowSuite
+from deepflow_tpu.parallel.sharded import ShardedFlowSuite, ShardedMetricsSuite
 
-__all__ = ["make_mesh", "ShardedFlowSuite"]
+__all__ = ["make_mesh", "ShardedFlowSuite", "ShardedMetricsSuite"]

@@ -1,4 +1,4 @@
-"""Multi-chip FlowSuite: batch-sharded updates, collective window merges.
+"""Multi-chip suites: batch-sharded updates, collective window merges.
 
 State carries a leading device axis sharded over the mesh's `data` axis; each
 chip updates its own sketch shard from its batch shard inside `shard_map`
@@ -8,6 +8,16 @@ one jitted program whose collectives XLA lays onto ICI. This is the
 TPU-physical form of the reference's per-thread stash merge
 (agent/src/collector/quadruple_generator.rs SubQuadGen) and the design
 SURVEY.md §7 Phase 4 calls for.
+
+Two suites share the pattern:
+
+- ShardedFlowSuite — the l4 sketch suite (CMS top-K / HLL / entropy),
+  comm-free updates, merge-at-flush.
+- ShardedMetricsSuite — the flow_metrics anomaly suite (BASELINE.md
+  config 5): entropy histograms shard like the sketches, while the
+  streaming-PCA basis stays REPLICATED — each chip computes the Oja
+  gradient of its batch shard and one ICI `psum` merges (count, sums,
+  gradient) before the identical basis update runs everywhere.
 """
 
 from __future__ import annotations
@@ -19,18 +29,38 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepflow_tpu.models import flow_suite
+from deepflow_tpu.models import flow_suite, metrics_suite
 from deepflow_tpu.models.flow_suite import (
     FlowSuiteConfig,
     FlowSuiteState,
     FlowWindowOutput,
 )
-from deepflow_tpu.ops import cms, entropy, hll, topk
+from deepflow_tpu.models.metrics_suite import (
+    MetricsSuiteConfig,
+    MetricsSuiteState,
+    MetricsWindowOutput,
+)
+from deepflow_tpu.ops import cms, entropy, hll, pca, topk
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _replicate_init(single, n_devices: int, sharding: NamedSharding):
+    """Broadcast a single-device state pytree onto the device axis."""
+    return jax.device_put(
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n_devices,) + x.shape),
+            single),
+        sharding)
+
+
+def _put_sharded(cols: Dict, mask, sharding: NamedSharding):
+    """Host->device transfer of a batch, sharded along the data axis."""
+    cols_d = {k: jax.device_put(v, sharding) for k, v in cols.items()}
+    return cols_d, jax.device_put(mask, sharding)
 
 
 def _merge_axis0(state: FlowSuiteState) -> FlowSuiteState:
@@ -108,19 +138,11 @@ class ShardedFlowSuite:
         return flow_suite.init(self.cfg)
 
     def init(self) -> FlowSuiteState:
-        single = flow_suite.init(self.cfg)
-        return jax.device_put(
-            jax.tree.map(
-                lambda x: jnp.broadcast_to(x[None], (self.n_devices,) + x.shape),
-                single),
-            self._state_sharding)
+        return _replicate_init(flow_suite.init(self.cfg), self.n_devices,
+                               self._state_sharding)
 
     def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
-        """Host->device transfer of a batch, sharded along the data axis."""
-        cols_d = {k: jax.device_put(v, self._batch_sharding)
-                  for k, v in cols.items()}
-        mask_d = jax.device_put(mask, self._batch_sharding)
-        return cols_d, mask_d
+        return _put_sharded(cols, mask, self._batch_sharding)
 
     def update(self, state: FlowSuiteState, cols: Dict,
                mask) -> FlowSuiteState:
@@ -129,3 +151,92 @@ class ShardedFlowSuite:
     def flush(self, state: FlowSuiteState
               ) -> Tuple[FlowSuiteState, FlowWindowOutput]:
         return self._flush(state)
+
+
+class ShardedMetricsSuite:
+    """MetricsSuite (DDoS entropy + golden-signal PCA) over a mesh.
+
+    Entropy histograms shard per device and merge by `psum` at flush (they
+    are integer adds, so sharded == single-device exactly). The PCA basis
+    is replicated: `update` computes each chip's Oja gradient locally
+    (pca.grad — the Zᵀ(ZW) matmul, MXU work), `psum`s the
+    (count, Σx, Σx², gradient) tuple over ICI, and applies the identical
+    globally-reduced step on every chip (pca.apply_grad) — the classic
+    data-parallel optimizer shape, so the basis never diverges across
+    devices (BASELINE.md config 5 "streaming PCA with ICI psum merge").
+    """
+
+    def __init__(self, cfg: MetricsSuiteConfig, mesh: Mesh,
+                 axis: str = "data") -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = mesh.shape[axis]
+        self._dev_spec = P(axis)
+        self._state_sharding = NamedSharding(mesh, self._dev_spec)
+        self._batch_sharding = NamedSharding(mesh, P(axis))
+        state_specs = jax.tree.map(lambda _: self._dev_spec,
+                                   metrics_suite.init(cfg))
+        cfg_ = cfg
+
+        def local_update(state, cols, mask):
+            local = jax.tree.map(lambda x: x[0], state)
+            # entropy: comm-free per-shard histogram adds (shared helper —
+            # identical feature/weighting choices as the single-dev suite)
+            ent = metrics_suite.entropy_update(local.ent, cols, mask)
+            # PCA: local grad -> ICI psum -> replicated apply. With world
+            # size 1 this IS pca.update, which is defined as the same
+            # grad+apply composition.
+            x = metrics_suite.signal_matrix(cols)
+            cnt, s1, s2, g = pca.grad(local.pca, x, mask)
+            cnt, s1, s2, g = jax.lax.psum((cnt, s1, s2, g), axis)
+            p = pca.apply_grad(local.pca, cnt, s1, s2, g, lr=cfg_.pca_lr)
+            new = local._replace(ent=ent, pca=p)
+            return jax.tree.map(lambda x_: x_[None], new)
+
+        self._update = jax.jit(shard_map(
+            local_update,
+            mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis)),
+            out_specs=state_specs,
+            check_vma=False,
+        ))
+
+        def local_flush(state, cols, mask):
+            local = jax.tree.map(lambda x: x[0], state)
+            # merge the entropy window across chips, then run the identical
+            # window close everywhere (EWMA/z/alarm are scalar math on the
+            # merged entropies, so every chip computes the same values)
+            hist = jax.lax.psum(local.ent.hist, axis)
+            merged = local._replace(ent=local.ent._replace(hist=hist))
+            fresh, out = metrics_suite.flush(merged, cols, mask, cfg_)
+            return jax.tree.map(lambda x_: x_[None], fresh), out
+
+        # anomaly scores stay sharded like the batch; the window scalars
+        # are replicated (identical on every chip after the psum)
+        out_specs = (state_specs,
+                     MetricsWindowOutput(entropies=P(), z_scores=P(),
+                                         ddos_alarm=P(),
+                                         anomaly_scores=P(axis)))
+        self._flush = jax.jit(shard_map(
+            local_flush,
+            mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis)),
+            out_specs=out_specs,
+            check_vma=False,
+        ))
+
+    def init(self) -> MetricsSuiteState:
+        return _replicate_init(metrics_suite.init(self.cfg), self.n_devices,
+                               self._state_sharding)
+
+    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
+        return _put_sharded(cols, mask, self._batch_sharding)
+
+    def update(self, state: MetricsSuiteState, cols: Dict,
+               mask) -> MetricsSuiteState:
+        return self._update(state, cols, mask)
+
+    def flush(self, state: MetricsSuiteState, cols: Dict, mask
+              ) -> Tuple[MetricsSuiteState, MetricsWindowOutput]:
+        return self._flush(state, cols, mask)

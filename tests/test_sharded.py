@@ -99,3 +99,81 @@ def test_sharded_topk_recall_vs_exact(rng):
     # after flush, state is clean
     state2, out2 = sharded.flush(state)
     assert int(np.asarray(out2.rows)) == 0
+
+
+def _metric_batch(rng, n):
+    from deepflow_tpu.models.metrics_suite import (ENTROPY_FEATURES,
+                                                   GOLDEN_SIGNALS)
+    cols = {}
+    for f in ENTROPY_FEATURES:
+        cols[f] = jnp.asarray(
+            rng.integers(0, 500, n).astype(np.uint32))
+    for s in GOLDEN_SIGNALS:
+        cols[s] = jnp.asarray(
+            rng.integers(0, 10_000, n).astype(np.uint32))
+    return cols
+
+
+def test_sharded_metrics_suite_equals_one_device(rng):
+    """BASELINE.md config 5 invariant: the 8-device ShardedMetricsSuite
+    (entropy psum merge + PCA grad psum) produces the same window outputs
+    and the same replicated PCA basis as the 1-device run of the SAME
+    distributed algorithm on the full batch."""
+    from deepflow_tpu.models.metrics_suite import MetricsSuiteConfig
+    from deepflow_tpu.parallel import ShardedMetricsSuite
+
+    from deepflow_tpu.models import metrics_suite
+
+    cfg = MetricsSuiteConfig(entropy_log2_buckets=8)
+    wide = ShardedMetricsSuite(cfg, make_mesh(8))
+    one = ShardedMetricsSuite(cfg, make_mesh(1))
+    s8, s1 = wide.init(), one.init()
+    plain = metrics_suite.init(cfg)   # the single-device suite itself
+
+    n = 2048
+    for _ in range(3):
+        cols = _metric_batch(rng, n)
+        mask = jnp.ones((n,), jnp.bool_)
+        c8, m8 = wide.put_batch(cols, mask)
+        c1, m1 = one.put_batch(cols, mask)
+        s8 = wide.update(s8, c8, m8)
+        s1 = one.update(s1, c1, m1)
+        plain = jax.jit(lambda s, c, m: metrics_suite.update(s, c, m, cfg))(
+            plain, cols, mask)
+
+    last = _metric_batch(rng, n)
+    mask = jnp.ones((n,), jnp.bool_)
+    s8, out8 = wide.flush(s8, *wide.put_batch(last, mask))
+    s1, out1 = one.flush(s1, *one.put_batch(last, mask))
+    plain, outp = jax.jit(
+        lambda s, c, m: metrics_suite.flush(s, c, m, cfg))(plain, last, mask)
+
+    # the sharded suite IS MetricsSuite-over-a-mesh: plain single-device
+    # update/flush match the 1-device mesh run
+    np.testing.assert_array_equal(np.asarray(outp.entropies),
+                                  np.asarray(out1.entropies))
+    np.testing.assert_allclose(np.asarray(plain.pca.w),
+                               np.asarray(s1.pca.w)[0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(outp.anomaly_scores),
+                               np.asarray(out1.anomaly_scores),
+                               rtol=1e-5, atol=1e-6)
+
+    # entropy histograms are integer adds: merged == single exactly
+    np.testing.assert_array_equal(np.asarray(out8.entropies),
+                                  np.asarray(out1.entropies))
+    np.testing.assert_allclose(np.asarray(out8.z_scores),
+                               np.asarray(out1.z_scores), rtol=1e-5)
+    assert bool(np.asarray(out8.ddos_alarm)) == \
+        bool(np.asarray(out1.ddos_alarm))
+    # the psum'd Oja step keeps the basis replicated and equal to the
+    # full-batch step (float tolerance: reduction order differs)
+    w8 = np.asarray(jax.tree.map(lambda x: x, s8.pca.w))
+    assert w8.shape[0] == 8
+    for d in range(1, 8):
+        np.testing.assert_allclose(w8[d], w8[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w8[0], np.asarray(s1.pca.w)[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out8.anomaly_scores),
+                               np.asarray(out1.anomaly_scores),
+                               rtol=1e-4, atol=1e-5)
