@@ -149,6 +149,14 @@ class Agent:
         self.escaped = False
         self.config_version = 0
 
+    def set_vtap_id(self, vtap_id: int) -> None:
+        """Fan the assigned id out to every component that stamps it:
+        flow rows, and each sender's wire FlowHeader."""
+        self.vtap_id = vtap_id
+        self.flow_map.vtap_id = vtap_id
+        for s in self.senders.values():
+            s.vtap_id = vtap_id
+
     # -- control plane -----------------------------------------------------
     def sync_once(self) -> bool:
         """One controller round trip (reference: Synchronizer.Sync)."""
@@ -166,10 +174,7 @@ class Agent:
                 r = json.load(resp)
         except Exception:
             return False
-        self.vtap_id = r["vtap_id"]
-        self.flow_map.vtap_id = r["vtap_id"]
-        for s in self.senders.values():
-            s.vtap_id = r["vtap_id"]
+        self.set_vtap_id(r["vtap_id"])
         if r.get("ingester"):
             for s in self.senders.values():
                 s.set_target(r["ingester"])

@@ -129,6 +129,24 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_replay_pcap(args) -> int:
+    """Replay a pcap fixture through a capture agent into an ingester
+    (reference role: agent/resources/test replays + droplet send tools)."""
+    from deepflow_tpu.agent.pcap import PcapFrameSource
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    agent = Agent(AgentConfig(ingester_addr=args.ingester,
+                              l7_enabled=not args.no_l7))
+    agent.set_vtap_id(args.vtap_id)
+    src = PcapFrameSource(args.path)
+    valid = src.feed_agent(agent, batch_size=args.batch)
+    sent = agent.tick()
+    agent.close()
+    print(json.dumps({"frames": src.frames_read, "valid_packets": valid,
+                      **sent}))
+    return 0
+
+
 def cmd_promql(args) -> int:
     qs = urllib.parse.urlencode(
         {"query": args.expr, **({"time": args.time} if args.time else {})})
@@ -179,6 +197,15 @@ def build_parser() -> argparse.ArgumentParser:
     pq.add_argument("expr")
     pq.add_argument("--time", type=int)
     pq.set_defaults(fn=cmd_promql)
+
+    rp = sub.add_parser("replay-pcap",
+                        help="replay a pcap through an agent -> ingester")
+    rp.add_argument("path")
+    rp.add_argument("--ingester", default="127.0.0.1:30033")
+    rp.add_argument("--vtap-id", type=int, default=1)
+    rp.add_argument("--batch", type=int, default=4096)
+    rp.add_argument("--no-l7", action="store_true")
+    rp.set_defaults(fn=cmd_replay_pcap)
 
     return p
 
