@@ -148,9 +148,19 @@ def cmd_replay_pcap(args) -> int:
 
 
 def cmd_promql(args) -> int:
-    qs = urllib.parse.urlencode(
-        {"query": args.expr, **({"time": args.time} if args.time else {})})
-    out = _http(f"{args.querier}/api/v1/query?{qs}")
+    if (args.start is None) != (args.end is None):
+        print("error: --start and --end must be given together",
+              file=sys.stderr)
+        return 1
+    if args.start is not None and args.end is not None:
+        qs = urllib.parse.urlencode({"query": args.expr, "start": args.start,
+                                     "end": args.end, "step": args.step})
+        out = _http(f"{args.querier}/api/v1/query_range?{qs}")
+    else:
+        qs = urllib.parse.urlencode(
+            {"query": args.expr,
+             **({"time": args.time} if args.time else {})})
+        out = _http(f"{args.querier}/api/v1/query?{qs}")
     print(json.dumps(out, indent=2))
     return 0 if out.get("status") == "success" else 1
 
@@ -193,9 +203,12 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("-d", "--db")
     q.set_defaults(fn=cmd_query)
 
-    pq = sub.add_parser("promql", help="run a PromQL instant query")
+    pq = sub.add_parser("promql", help="run a PromQL instant/range query")
     pq.add_argument("expr")
     pq.add_argument("--time", type=int)
+    pq.add_argument("--start", type=int)
+    pq.add_argument("--end", type=int)
+    pq.add_argument("--step", type=int, default=60)
     pq.set_defaults(fn=cmd_promql)
 
     rp = sub.add_parser("replay-pcap",

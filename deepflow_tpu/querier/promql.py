@@ -88,7 +88,11 @@ class PromEngine:
         pq = parse_promql(promql)
         metric_dict = self.tag_dicts.get("metric_name")
         label_dict = self.tag_dicts.get("label_set")
-        mh = metric_dict.encode_one(pq.metric)
+        # read-only lookup: the query path must not grow the dictionary
+        # (a typo'd Grafana panel would journal a new entry per refresh)
+        mh = metric_dict.lookup(pq.metric)
+        if mh is None:
+            return []
         t = self.store.table(self.db, self.table)
         at = at if at is not None else int(time.time())
         hi = at + 1  # instant query at t includes samples stamped exactly t
@@ -131,6 +135,90 @@ class PromEngine:
             labels = dict(zip(pq.by, key))
             out.append({"metric": labels, "value": [at, str(v)]})
         return sorted(out, key=lambda r: str(r["metric"]))
+
+    def query_range(self, promql: str, start: int, end: int,
+                    step: int) -> List[dict]:
+        """Range query: evaluate the expression on the [start, end] step
+        grid, returning Prometheus matrix results
+        [{metric: {...}, values: [[ts, "v"], ...]}] — what Grafana panels
+        POST (reference: server/querier/app/prometheus/router/prometheus.go
+        promQueryRange). Instant-selector semantics per grid point: latest
+        sample within the lookback window; rate() over its range window."""
+        if step <= 0:
+            raise ValueError("step must be positive")
+        if end < start:
+            raise ValueError("end < start")
+        pq = parse_promql(promql)
+        lookback = pq.range_s if pq.range_s else 300
+        metric_dict = self.tag_dicts.get("metric_name")
+        label_dict = self.tag_dicts.get("label_set")
+        mh = metric_dict.lookup(pq.metric)   # read-only: see query()
+        if mh is None:
+            return []
+        t = self.store.table(self.db, self.table)
+        cols = t.scan(time_range=(start - lookback, end + 1))
+        sel = cols["metric"] == np.uint32(mh)
+        grid = np.arange(start, end + 1, step, dtype=np.int64)
+
+        series_vals: List[Tuple[Dict[str, str], np.ndarray]] = []
+        for lh in np.unique(cols["labels"][sel]):
+            labels = _parse_labels(label_dict.decode(int(lh)) or "")
+            if not self._match(labels, pq.matchers):
+                continue
+            m = sel & (cols["labels"] == np.uint32(lh))
+            ts = cols["timestamp"][m].astype(np.int64)
+            vs = cols["value"][m].astype(np.float64)
+            order = np.argsort(ts)
+            ts, vs = ts[order], vs[order]
+            # per grid point: index of the last sample with ts <= point
+            hi = np.searchsorted(ts, grid, side="right") - 1
+            valid = hi >= 0
+            # staleness: sample must fall inside the lookback window
+            valid &= np.where(hi >= 0, grid - ts[np.maximum(hi, 0)],
+                              np.int64(1 << 40)) <= lookback
+            if pq.rate:
+                # first sample index inside each point's range window
+                lo = np.searchsorted(ts, grid - lookback, side="left")
+                valid &= (hi > lo)
+                dt = ts[np.maximum(hi, 0)] - ts[np.minimum(lo, len(ts) - 1)]
+                dv = vs[np.maximum(hi, 0)] - vs[np.minimum(lo, len(ts) - 1)]
+                vals = np.where(valid & (dt > 0), dv / np.maximum(dt, 1),
+                                np.nan)
+            else:
+                vals = np.where(valid, vs[np.maximum(hi, 0)], np.nan)
+            if np.isnan(vals).all():
+                continue
+            series_vals.append((labels, vals))
+
+        out = []
+        if pq.agg:
+            groups: Dict[Tuple, List[np.ndarray]] = {}
+            for labels, vals in series_vals:
+                key = tuple(labels.get(b, "") for b in pq.by)
+                groups.setdefault(key, []).append(vals)
+            for key, arrs in groups.items():
+                stack = np.vstack(arrs)
+                # mask all-NaN grid points BEFORE aggregating: nanmax/min/
+                # mean warn (warnings module, not errstate) on all-NaN
+                # slices, which would fire per Grafana poll
+                dead = np.isnan(stack).all(axis=0)
+                safe = np.where(dead[None, :], 0.0, stack)
+                agg = {"sum": np.nansum, "max": np.nanmax,
+                       "min": np.nanmin, "avg": np.nanmean}[pq.agg](
+                           safe, axis=0)
+                agg = np.where(dead, np.nan, agg)
+                out.append((dict(zip(pq.by, key)), agg))
+        else:
+            out = [({"__name__": pq.metric, **labels}, vals)
+                   for labels, vals in series_vals]
+
+        result = []
+        for labels, vals in sorted(out, key=lambda r: str(r[0])):
+            values = [[int(g), str(float(v))]
+                      for g, v in zip(grid, vals) if not np.isnan(v)]
+            if values:
+                result.append({"metric": labels, "values": values})
+        return result
 
     @staticmethod
     def _match(labels: Dict[str, str],

@@ -2,6 +2,9 @@
 
 POST /v1/query           body: db=<db>&sql=<sql>   (form or JSON)
 GET  /api/v1/query?query=<promql>[&time=<epoch>]   (Prometheus shape)
+GET  /api/v1/query_range?query=&start=&end=&step=  (Prometheus matrix)
+GET  /v1/profile/flame[?app_service=&event_type=&start=&end=]
+GET  /v1/profile/top[?...same...&limit=]
 GET  /health
 
 Stdlib ThreadingHTTPServer: the query path is read-only over immutable
@@ -17,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from deepflow_tpu.querier.engine import QueryEngine
+from deepflow_tpu.querier.profile import ProfileQuery
 from deepflow_tpu.querier.promql import PromEngine
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.dict_store import TagDictRegistry
@@ -30,6 +34,7 @@ class QuerierServer:
                  tagrecorder=None) -> None:
         self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder)
         self.prom = PromEngine(store, tag_dicts)
+        self.profile = ProfileQuery(store, tag_dicts)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,6 +65,42 @@ class QuerierServer:
                                                   "result": result}})
                     except Exception as e:
                         self._send(400, {"status": "error", "error": str(e)})
+                    return
+                if url.path == "/api/v1/query_range":
+                    qs = urllib.parse.parse_qs(url.query)
+                    try:
+                        result = outer.prom.query_range(
+                            qs["query"][0], start=int(float(qs["start"][0])),
+                            end=int(float(qs["end"][0])),
+                            step=int(float(qs["step"][0])))
+                        self._send(200, {"status": "success",
+                                         "data": {"resultType": "matrix",
+                                                  "result": result}})
+                    except Exception as e:
+                        self._send(400, {"status": "error", "error": str(e)})
+                    return
+                if url.path in ("/v1/profile/flame", "/v1/profile/top"):
+                    qs = urllib.parse.parse_qs(url.query)
+
+                    def one(key):
+                        return qs[key][0] if key in qs else None
+
+                    try:
+                        tr = None
+                        if "start" in qs and "end" in qs:
+                            tr = (int(qs["start"][0]), int(qs["end"][0]))
+                        if url.path.endswith("flame"):
+                            res = outer.profile.flame(
+                                app_service=one("app_service"),
+                                event_type=one("event_type"), time_range=tr)
+                        else:
+                            res = outer.profile.top_functions(
+                                app_service=one("app_service"),
+                                event_type=one("event_type"), time_range=tr,
+                                limit=int(one("limit") or 50))
+                        self._send(200, {"result": res})
+                    except Exception as e:
+                        self._send(400, {"error": str(e)})
                     return
                 self._send(404, {"error": "not found"})
 

@@ -202,3 +202,187 @@ def test_debug_server():
         assert not debug_request("nope", port=srv.port)["ok"]
     finally:
         srv.close()
+
+
+def test_promql_query_range(prom):
+    eng, _, _ = prom
+    # matrix over the sample window: both series step up 1 per 10s
+    out = eng.query_range('rps{job="api"}', start=1000, end=1090, step=30)
+    assert len(out) == 1
+    vals = out[0]["values"]
+    assert vals == [[1000, "10.0"], [1030, "13.0"], [1060, "16.0"],
+                    [1090, "19.0"]]
+    # rate over the grid
+    out = eng.query_range('rate(rps[1m])', start=1060, end=1090, step=30)
+    assert len(out) == 2
+    for series in out:
+        for _, v in series["values"]:
+            assert float(v) == pytest.approx(0.1)
+    # aggregated matrix
+    out = eng.query_range('sum by (job) (rps)', start=1090, end=1090, step=10)
+    assert {r["metric"]["job"]: r["values"][0][1] for r in out} == \
+        {"api": "19.0", "web": "109.0"}
+    # grid points before the first sample are absent, not zero
+    out = eng.query_range('rps{job="api"}', start=400, end=1000, step=300)
+    assert out[0]["values"] == [[1000, "10.0"]]
+
+
+def test_promql_query_range_validates(prom):
+    eng, _, _ = prom
+    with pytest.raises(ValueError):
+        eng.query_range("rps", start=100, end=50, step=10)
+    with pytest.raises(ValueError):
+        eng.query_range("rps", start=0, end=50, step=0)
+
+
+def _profile_fixture(tmp_path):
+    from deepflow_tpu.pipelines.profile import PROFILE_DB, PROFILE_TABLE
+    from deepflow_tpu.querier.profile import ProfileQuery
+
+    store = Store(str(tmp_path / "pstore"))
+    dicts = TagDictRegistry(str(tmp_path / "pstore"))
+    t = store.create_table(PROFILE_DB, PROFILE_TABLE)
+    stacks = dicts.get("profile_stack")
+    names = dicts.get("profile_name")
+    svc = names.encode_one("checkout")
+    cpu = names.encode_one("on-cpu")
+    rows = [
+        ("main;handler;db_query", 10),
+        ("main;handler;db_query", 5),
+        ("main;handler;render", 7),
+        ("main;gc", 3),
+    ]
+    n = len(rows)
+    t.append({
+        "timestamp": np.full(n, 1000, np.uint32),
+        "app_service": np.full(n, svc, np.uint32),
+        "event_type": np.full(n, cpu, np.uint32),
+        "stack": np.array([stacks.encode_one(s) for s, _ in rows],
+                          np.uint32),
+        "pid": np.full(n, 1, np.uint32),
+        "vtap_id": np.full(n, 1, np.uint32),
+        "pod_id": np.zeros(n, np.uint32),
+        "value": np.array([v for _, v in rows], np.uint32),
+    })
+    return ProfileQuery(store, dicts)
+
+
+def test_profile_flame_graph(tmp_path):
+    pq = _profile_fixture(tmp_path)
+    tree = pq.flame(app_service="checkout")
+    assert tree["total_value"] == 25
+    main = tree["children"][0]
+    assert main["name"] == "main" and main["total_value"] == 25
+    handler = main["children"][0]
+    assert handler["name"] == "handler" and handler["total_value"] == 22
+    # children sorted by total, leaf self-values correct
+    assert [c["name"] for c in handler["children"]] == ["db_query", "render"]
+    assert handler["children"][0]["self_value"] == 15
+    assert main["children"][1]["name"] == "gc"
+    assert main["children"][1]["self_value"] == 3
+    # filter that matches nothing
+    assert pq.flame(app_service="nope")["total_value"] == 0
+
+
+def test_profile_top_functions(tmp_path):
+    pq = _profile_fixture(tmp_path)
+    top = pq.top_functions(event_type="on-cpu")
+    by_name = {r["name"]: r for r in top}
+    assert by_name["db_query"]["self_value"] == 15
+    assert by_name["handler"]["total_value"] == 22
+    assert by_name["handler"]["self_value"] == 0
+    assert by_name["main"]["total_value"] == 25
+
+
+def test_http_query_range_and_profile_endpoints(tmp_path, prom):
+    import urllib.request as _rq
+
+    peng, store, dicts = prom
+    # profile rows live in the same store/dicts for this server instance
+    from deepflow_tpu.pipelines.profile import PROFILE_DB, PROFILE_TABLE
+    t = store.create_table(PROFILE_DB, PROFILE_TABLE)
+    stacks, names = dicts.get("profile_stack"), dicts.get("profile_name")
+    t.append({
+        "timestamp": np.array([1000], np.uint32),
+        "app_service": np.array([names.encode_one("checkout")], np.uint32),
+        "event_type": np.array([names.encode_one("on-cpu")], np.uint32),
+        "stack": np.array([stacks.encode_one("main;work")], np.uint32),
+        "pid": np.array([1], np.uint32),
+        "vtap_id": np.array([1], np.uint32),
+        "pod_id": np.array([0], np.uint32),
+        "value": np.array([9], np.uint32),
+    })
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    try:
+        url = (f"http://127.0.0.1:{srv.port}/api/v1/query_range?"
+               + urllib.parse.urlencode(
+                   {"query": "rps", "start": 1090, "end": 1090, "step": 10}))
+        with _rq.urlopen(url, timeout=5) as resp:
+            payload = json.load(resp)
+        assert payload["status"] == "success"
+        assert payload["data"]["resultType"] == "matrix"
+        assert len(payload["data"]["result"]) == 2
+        # malformed: missing step
+        try:
+            _rq.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/v1/query_range?query=rps",
+                timeout=5)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        with _rq.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/profile/flame"
+                "?app_service=checkout", timeout=5) as resp:
+            tree = json.load(resp)["result"]
+        assert tree["total_value"] == 9
+        assert tree["children"][0]["name"] == "main"
+        with _rq.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/profile/top", timeout=5) \
+                as resp:
+            top = json.load(resp)["result"]
+        assert {r["name"] for r in top} == {"main", "work"}
+    finally:
+        srv.close()
+        dicts.close()
+
+
+import urllib.error  # noqa: E402  (used above)
+
+
+def test_query_paths_never_grow_dicts(prom, tmp_path):
+    """Unknown metric / service names on the read path must not journal
+    new dictionary entries (a typo'd dashboard would grow them forever)."""
+    eng, store, dicts = prom
+    md = dicts.get("metric_name")
+    before = len(md._s2h) if hasattr(md, "_s2h") else None
+    assert eng.query("totally_unknown_metric") == []
+    assert eng.query_range("totally_unknown_metric", 0, 10, 5) == []
+    assert md.lookup("totally_unknown_metric") is None
+    pq = _profile_fixture(tmp_path)
+    assert pq.flame(app_service="ghost-service")["total_value"] == 0
+    assert pq.names.lookup("ghost-service") is None
+
+
+def test_query_range_disjoint_series_no_warning(prom):
+    """max() over series alive at disjoint grid points must not emit
+    All-NaN warnings (or crash under -W error)."""
+    import warnings
+
+    eng, store, dicts = prom
+    from deepflow_tpu.pipelines.ext_metrics import SAMPLE_TABLE
+    md, ld = dicts.get("metric_name"), dicts.get("label_set")
+    t = store.table("ext_metrics", "ext_samples")
+    mh = md.encode_one("spiky")
+    t.append({"timestamp": np.array([1000, 3000], np.uint32),
+              "metric": np.full(2, mh, np.uint32),
+              "labels": np.array([ld.encode_one("job=a"),
+                                  ld.encode_one("job=b")], np.uint32),
+              "value": np.array([1.0, 2.0], np.float32)})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = eng.query_range("max(spiky)", start=1000, end=3000, step=500)
+    pts = dict(out[0]["values"])
+    # only the sample instants are within the 300s lookback of a grid
+    # point; the dead middle of the grid is absent, not zero or NaN
+    assert pts == {1000: "1.0", 3000: "2.0"}
