@@ -1,0 +1,158 @@
+"""Per-kernel microbenches (reference role: agent/benches/ criterion suite).
+
+Times each sketch/analytics kernel at fixed shapes on whatever backend JAX
+resolves (the driver's real chip, or CPU under JAX_PLATFORMS=cpu) plus the
+native C++ decoder, and prints one JSON line per kernel:
+
+    {"bench": "cms_update", "rows_per_sec": ..., "ms_per_iter": ...,
+     "shape": "...", "backend": "cpu"}
+
+Run:  python benches/kernel_bench.py [--batch 1048576] [--iters 20]
+      [--only cms_update,hll_update]
+
+Each timed fn is jitted with donated state where the real pipelines donate,
+warmed twice, then timed over `iters` calls with a final block_until_ready —
+the same discipline as bench.py, so per-kernel numbers decompose the
+headline number honestly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--only", type=str, default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepflow_tpu.ops import cms, entropy, hll, mxu_hist, pca, topk
+
+    backend = jax.default_backend()
+    n = args.batch
+    rng = np.random.default_rng(0xBE7C)
+    keys = jnp.asarray(rng.integers(0, 1 << 20, n, dtype=np.uint32))
+    groups = jnp.asarray(rng.integers(0, 64, n, dtype=np.uint32))
+    mask = jnp.ones(n, jnp.bool_)
+
+    results = []
+
+    def bench(name, shape, fn, state_factory, *xs, rows=None):
+        """Time state = fn(state, *xs) over iters (donated state, fresh
+        per bench so donation can't free a buffer another bench holds)."""
+        if args.only and name not in args.only.split(","):
+            return
+        step = jax.jit(fn, donate_argnums=0)
+        s = state_factory()
+        for _ in range(2):
+            s = step(s, *xs)
+        jax.block_until_ready(s)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            s = step(s, *xs)
+        jax.block_until_ready(s)
+        dt = time.perf_counter() - t0
+        r = {"bench": name, "shape": shape, "backend": backend,
+             "ms_per_iter": round(1e3 * dt / args.iters, 3)}
+        if rows is not None:
+            r["rows_per_sec"] = round(rows * args.iters / dt)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    # -- cms ---------------------------------------------------------------
+    def cms_init():
+        return cms.init(depth=4, log2_width=16)
+
+    bench("cms_update", f"[{n}] keys, 4x2^16",
+          lambda s, k: cms.update(s, k), cms_init, keys, rows=n)
+    bench("cms_update_conservative", f"[{n}] keys, 4x2^16",
+          lambda s, k: cms.update_conservative(s, k), cms_init, keys,
+          rows=n)
+    bench("cms_query", f"[{n}] keys, 4x2^16",
+          lambda s, k: s._replace(
+              seeds=s.seeds + (cms.query(s, k) > (1 << 30)).astype(
+                  s.seeds.dtype).sum()),   # keep state-shaped for donate
+          cms_init, keys, rows=n)
+
+    # -- hll ---------------------------------------------------------------
+    bench("hll_update", f"[{n}] keys, 64 groups, p=12",
+          lambda s, g, k: hll.update(s, g, k),
+          lambda: hll.init(groups=64, precision=12), groups, keys, rows=n)
+
+    # -- entropy / mxu hist -----------------------------------------------
+    feats = jnp.stack([keys, keys ^ 0x5A5A, keys >> 3, keys << 1])
+    bench("entropy_update_mxu", f"[4,{n}] -> 2^12 buckets",
+          lambda s, f, m: entropy.update(s, f, None, m),
+          lambda: entropy.init(features=4, log2_buckets=12), feats,
+          mask, rows=n)
+
+    idx = jnp.asarray(rng.integers(0, 1 << 12, (4, n), dtype=np.uint32))
+
+    def hist_step(acc, ix):
+        return acc + mxu_hist.hist(ix, 1 << 12).astype(acc.dtype)
+
+    bench("mxu_hist", f"[4,{n}] -> 2^12", hist_step,
+          lambda: jnp.zeros((4, 1 << 12), jnp.int32), idx, rows=n)
+
+    # -- topk admission ----------------------------------------------------
+    # populated, NON-donated sketch shared by the ring benches
+    query_sketch = jax.jit(cms.update)(cms_init(), keys)
+    jax.block_until_ready(query_sketch)
+    bench("topk_offer_sampled", f"[{n}] keys, ring 512, 1/16 sample",
+          lambda s, k, sk: topk.offer(s, k, sk, sample_log2=4),
+          lambda: topk.init(ring_size=512), keys, query_sketch, rows=n)
+    bench("topk_offer_full", f"[{n}] keys, ring 512",
+          lambda s, k, sk: topk.offer(s, k, sk),
+          lambda: topk.init(ring_size=512), keys, query_sketch, rows=n)
+
+    # -- pca ---------------------------------------------------------------
+    x = jnp.asarray(rng.normal(size=(min(n, 1 << 17), 12)), jnp.float32)
+    bench("pca_update", f"[{x.shape[0]},12] k=3",
+          lambda s, xx: pca.update(s, xx), lambda: pca.init(12, 3), x,
+          rows=x.shape[0])
+
+    # -- native decoder (host C++, no jit) --------------------------------
+    if not args.only or "native_decode" in args.only.split(","):
+        from deepflow_tpu.decode import native
+        from deepflow_tpu.replay.generator import SyntheticAgent
+        from deepflow_tpu.wire.codec import pack_pb_records
+
+        if native.available():
+            agent = SyntheticAgent()
+            nrec = 1 << 16
+            cols, records = agent.l4_batch(nrec)
+            payload = pack_pb_records(records)
+            out32 = np.empty((len(native.L4_COLS32), nrec), np.uint32)
+            out64 = np.empty((len(native.L4_COLS64), nrec), np.uint64)
+            for threads in (1, 0):   # 0 = all cores
+                native.decode_l4_into(payload, out32, out64,
+                                      n_threads=threads)
+                t0 = time.perf_counter()
+                iters = max(4, args.iters // 2)
+                for _ in range(iters):
+                    rows, bad, _ = native.decode_l4_into(
+                        payload, out32, out64, n_threads=threads)
+                dt = time.perf_counter() - t0
+                r = {"bench": "native_decode_mt" if threads == 0
+                     else "native_decode",
+                     "shape": f"[{nrec}] TaggedFlow, 93 cols",
+                     "backend": "host",
+                     "ms_per_iter": round(1e3 * dt / iters, 3),
+                     "rows_per_sec": round(nrec * iters / dt)}
+                results.append(r)
+                print(json.dumps(r), flush=True)
+
+    print(json.dumps({"bench": "summary", "backend": backend,
+                      "kernels": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# CI entry point: tests + entry-point checks + per-kernel microbenches.
+#
+# Everything runs on the virtual 8-device CPU mesh (no TPU needed), the
+# same environment tests/conftest.py pins, so this script is safe on any
+# box with the baked-in Python env. SURVEY.md §4: the new framework's CI
+# bar is "do better than the reference" — the reference gates on
+# unit+integration; this also compile-checks the driver entry points and
+# keeps kernel microbenches runnable in one command.
+#
+# Usage: ./ci.sh [quick]   ("quick" skips the microbenches)
+
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export PALLAS_AXON_POOL_IPS=   # never claim the TPU tunnel from CI
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "== pytest =="
+python -m pytest tests/ -q
+
+echo "== driver entry points =="
+python - <<'EOF'
+import jax
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print("entry + 8-device dryrun ok")
+EOF
+
+if [ "${1:-}" != "quick" ]; then
+  echo "== kernel microbenches (CPU shapes) =="
+  python benches/kernel_bench.py --batch 262144 --iters 6
+fi
+
+echo "CI OK"

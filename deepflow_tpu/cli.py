@@ -152,6 +152,10 @@ def cmd_promql(args) -> int:
         print("error: --start and --end must be given together",
               file=sys.stderr)
         return 1
+    if args.time is not None and args.start is not None:
+        print("error: --time conflicts with --start/--end",
+              file=sys.stderr)
+        return 1
     if args.start is not None and args.end is not None:
         qs = urllib.parse.urlencode({"query": args.expr, "start": args.start,
                                      "end": args.end, "step": args.step})

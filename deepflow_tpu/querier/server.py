@@ -49,66 +49,72 @@ class QuerierServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            # -- shared param-dict handlers (GET query string and POST
+            # form body route here: Grafana's Prometheus datasource
+            # defaults to POST for /api/v1/query*) -----------------------
+            def _prom_query(self, p) -> None:
+                try:
+                    result = outer.prom.query(
+                        p["query"], at=int(float(p["time"]))
+                        if "time" in p else None)
+                    self._send(200, {"status": "success",
+                                     "data": {"resultType": "vector",
+                                              "result": result}})
+                except Exception as e:
+                    self._send(400, {"status": "error", "error": str(e)})
+
+            def _prom_query_range(self, p) -> None:
+                try:
+                    result = outer.prom.query_range(
+                        p["query"], start=int(float(p["start"])),
+                        end=int(float(p["end"])),
+                        step=int(float(p["step"])))
+                    self._send(200, {"status": "success",
+                                     "data": {"resultType": "matrix",
+                                              "result": result}})
+                except Exception as e:
+                    self._send(400, {"status": "error", "error": str(e)})
+
+            def _profile(self, path: str, p) -> None:
+                try:
+                    tr = None
+                    if "start" in p and "end" in p:
+                        # inclusive end: scan() filters ts < hi
+                        tr = (int(p["start"]), int(p["end"]) + 1)
+                    if path.endswith("flame"):
+                        res = outer.profile.flame(
+                            app_service=p.get("app_service"),
+                            event_type=p.get("event_type"), time_range=tr)
+                    else:
+                        res = outer.profile.top_functions(
+                            app_service=p.get("app_service"),
+                            event_type=p.get("event_type"), time_range=tr,
+                            limit=int(p.get("limit") or 50))
+                    self._send(200, {"result": res})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+            def _route(self, path: str, params) -> None:
+                if path == "/api/v1/query":
+                    self._prom_query(params)
+                elif path == "/api/v1/query_range":
+                    self._prom_query_range(params)
+                elif path in ("/v1/profile/flame", "/v1/profile/top"):
+                    self._profile(path, params)
+                else:
+                    self._send(404, {"error": "not found"})
+
             def do_GET(self) -> None:
                 url = urllib.parse.urlparse(self.path)
                 if url.path == "/health":
                     self._send(200, {"status": "ok"})
                     return
-                if url.path == "/api/v1/query":
-                    qs = urllib.parse.parse_qs(url.query)
-                    try:
-                        result = outer.prom.query(
-                            qs["query"][0],
-                            at=int(qs["time"][0]) if "time" in qs else None)
-                        self._send(200, {"status": "success",
-                                         "data": {"resultType": "vector",
-                                                  "result": result}})
-                    except Exception as e:
-                        self._send(400, {"status": "error", "error": str(e)})
-                    return
-                if url.path == "/api/v1/query_range":
-                    qs = urllib.parse.parse_qs(url.query)
-                    try:
-                        result = outer.prom.query_range(
-                            qs["query"][0], start=int(float(qs["start"][0])),
-                            end=int(float(qs["end"][0])),
-                            step=int(float(qs["step"][0])))
-                        self._send(200, {"status": "success",
-                                         "data": {"resultType": "matrix",
-                                                  "result": result}})
-                    except Exception as e:
-                        self._send(400, {"status": "error", "error": str(e)})
-                    return
-                if url.path in ("/v1/profile/flame", "/v1/profile/top"):
-                    qs = urllib.parse.parse_qs(url.query)
-
-                    def one(key):
-                        return qs[key][0] if key in qs else None
-
-                    try:
-                        tr = None
-                        if "start" in qs and "end" in qs:
-                            tr = (int(qs["start"][0]), int(qs["end"][0]))
-                        if url.path.endswith("flame"):
-                            res = outer.profile.flame(
-                                app_service=one("app_service"),
-                                event_type=one("event_type"), time_range=tr)
-                        else:
-                            res = outer.profile.top_functions(
-                                app_service=one("app_service"),
-                                event_type=one("event_type"), time_range=tr,
-                                limit=int(one("limit") or 50))
-                        self._send(200, {"result": res})
-                    except Exception as e:
-                        self._send(400, {"error": str(e)})
-                    return
-                self._send(404, {"error": "not found"})
+                params = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(url.query).items()}
+                self._route(url.path, params)
 
             def do_POST(self) -> None:
                 url = urllib.parse.urlparse(self.path)
-                if url.path != "/v1/query":
-                    self._send(404, {"error": "not found"})
-                    return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(length).decode()
@@ -118,11 +124,23 @@ class QuerierServer:
                     else:
                         params = {k: v[0] for k, v in
                                   urllib.parse.parse_qs(raw).items()}
-                    res = outer.engine.execute(params.get("sql", ""),
-                                               db=params.get("db") or None)
-                    self._send(200, {"result": res.as_dict()})
                 except Exception as e:
                     self._send(400, {"error": str(e)})
+                    return
+                if url.path == "/v1/query":
+                    try:
+                        res = outer.engine.execute(params.get("sql", ""),
+                                                   db=params.get("db")
+                                                   or None)
+                        self._send(200, {"result": res.as_dict()})
+                    except Exception as e:
+                        self._send(400, {"error": str(e)})
+                    return
+                # Prometheus-style endpoints accept POST form bodies too;
+                # query-string params fill anything the body omitted
+                qs = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(url.query).items()}
+                self._route(url.path, {**qs, **params})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None

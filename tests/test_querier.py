@@ -386,3 +386,55 @@ def test_query_range_disjoint_series_no_warning(prom):
     # only the sample instants are within the 300s lookback of a grid
     # point; the dead middle of the grid is absent, not zero or NaN
     assert pts == {1000: "1.0", 3000: "2.0"}
+
+
+def test_http_post_query_range_and_inclusive_profile_end(tmp_path, prom):
+    """Grafana POSTs /api/v1/query_range with a form body; profile
+    endpoints treat end as inclusive."""
+    import urllib.request as _rq
+
+    peng, store, dicts = prom
+    from deepflow_tpu.pipelines.profile import PROFILE_DB, PROFILE_TABLE
+    t = store.create_table(PROFILE_DB, PROFILE_TABLE)
+    stacks, names = dicts.get("profile_stack"), dicts.get("profile_name")
+    t.append({
+        "timestamp": np.array([1000], np.uint32),
+        "app_service": np.array([names.encode_one("svc")], np.uint32),
+        "event_type": np.array([names.encode_one("on-cpu")], np.uint32),
+        "stack": np.array([stacks.encode_one("main;work")], np.uint32),
+        "pid": np.array([1], np.uint32),
+        "vtap_id": np.array([1], np.uint32),
+        "pod_id": np.array([0], np.uint32),
+        "value": np.array([9], np.uint32),
+    })
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    try:
+        body = urllib.parse.urlencode(
+            {"query": "rps", "start": 1090, "end": 1090, "step": 10}
+        ).encode()
+        req = _rq.Request(
+            f"http://127.0.0.1:{srv.port}/api/v1/query_range", data=body,
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with _rq.urlopen(req, timeout=5) as resp:
+            payload = json.load(resp)
+        assert payload["status"] == "success"
+        assert len(payload["data"]["result"]) == 2
+        # sample at exactly end=1000 is included
+        with _rq.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/profile/flame"
+                "?start=900&end=1000", timeout=5) as resp:
+            assert json.load(resp)["result"]["total_value"] == 9
+    finally:
+        srv.close()
+        dicts.close()
+
+
+def test_cli_promql_flag_conflicts(capsys):
+    from deepflow_tpu.cli import main as cli_main
+
+    assert cli_main(["promql", "rps", "--start", "1"]) == 1
+    assert "together" in capsys.readouterr().err
+    assert cli_main(["promql", "rps", "--time", "5",
+                     "--start", "1", "--end", "2"]) == 1
+    assert "conflicts" in capsys.readouterr().err
