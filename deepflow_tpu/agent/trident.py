@@ -47,6 +47,11 @@ class AgentConfig:
     # TPU-native wire: vectorized encode, memcpy decode); "protobuf"
     # emits per-row TaggedFlow records for reference-compatible servers
     wire_mode: str = "columnar"
+    # platform sync (agent/platform.py): interface report cadence, and an
+    # optional k8s resource file to watch (api_watcher analogue)
+    platform_sync_interval_s: float = 60.0
+    k8s_resource_file: Optional[str] = None
+    k8s_cluster_domain: str = "k8s-cluster"
 
 
 def columns_to_l4_schema(cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -148,6 +153,8 @@ class Agent:
         self._l7_out: List[bytes] = []
         self.escaped = False
         self.config_version = 0
+        self.platform_watcher = None
+        self.k8s_watcher = None
 
     def set_vtap_id(self, vtap_id: int) -> None:
         """Fan the assigned id out to every component that stamps it:
@@ -282,6 +289,22 @@ class Agent:
                                  daemon=True)
             t.start()
             self._threads.append(t)
+            # platform sync: interface report on change + optional k8s
+            # cluster watch (agent/platform.py — api_watcher analogue)
+            from deepflow_tpu.agent.platform import (file_lister,
+                                                     interface_reporter,
+                                                     k8s_watcher)
+            self.platform_watcher = interface_reporter(
+                self.cfg.controller_url, self.cfg.host, self.cfg.ctrl_ip,
+                interval_s=self.cfg.platform_sync_interval_s)
+            self.platform_watcher.start()
+            if self.cfg.k8s_resource_file:
+                self.k8s_watcher = k8s_watcher(
+                    self.cfg.controller_url,
+                    self.cfg.k8s_cluster_domain,
+                    file_lister(self.cfg.k8s_resource_file),
+                    interval_s=self.cfg.platform_sync_interval_s)
+                self.k8s_watcher.start()
         t = threading.Thread(target=self._tick_loop, name="flow-tick",
                              daemon=True)
         t.start()
@@ -289,6 +312,9 @@ class Agent:
 
     def close(self) -> None:
         self._stop.set()
+        for w in (self.platform_watcher, self.k8s_watcher):
+            if w is not None:
+                w.close()
         for t in self._threads:
             t.join(timeout=2)
         self.tick()  # final flush

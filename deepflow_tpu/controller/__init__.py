@@ -11,7 +11,9 @@ wire stays the firehose.
 """
 
 from deepflow_tpu.controller.model import ResourceModel
+from deepflow_tpu.controller.recorder import Recorder
 from deepflow_tpu.controller.registry import VTapRegistry
 from deepflow_tpu.controller.server import ControllerServer
 
-__all__ = ["ResourceModel", "VTapRegistry", "ControllerServer"]
+__all__ = ["ResourceModel", "Recorder", "VTapRegistry",
+           "ControllerServer"]

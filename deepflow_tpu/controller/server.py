@@ -6,6 +6,7 @@ gRPC — the reference's message/trident.proto Synchronizer service):
                             -> vtap_id, config, config_version,
                                platform_version, ingester
   POST /v1/genesis          {ctrl_ip, host, interfaces: [...]}
+  GET  /v1/genesis/export   locally-owned genesis domains (peer pull)
 
 Ops-facing (driven by the CLI):
   GET  /v1/vtaps            fleet listing with liveness
@@ -43,8 +44,13 @@ class ControllerServer:
                  election: Optional[Election] = None,
                  tagrecorder: Optional[TagRecorder] = None,
                  genesis_domain: str = "genesis",
+                 genesis_peers=None,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1") -> None:
         self.model = model
+        from deepflow_tpu.controller.genesis_sync import GenesisSync
+        from deepflow_tpu.controller.recorder import Recorder
+        self.recorder = Recorder(model)
+        self.genesis_sync = GenesisSync(model, peers=genesis_peers or ())
         self.registry = registry
         self.monitor = monitor or FleetMonitor(registry)
         self.election = election
@@ -117,6 +123,8 @@ class ControllerServer:
                 "cidrs": [vars(c) for c in cidrs],
                 "services": [vars(s) for s in services],
             }
+        if path == "/v1/genesis/export":
+            return {"domains": self.genesis_sync.export()}
         if path == "/v1/election":
             if self.election is None:
                 return {"leader": True, "identity": "standalone"}
@@ -163,6 +171,7 @@ class ControllerServer:
                     domain=domain,
                     ip=itf["ip"], epc_id=itf.get("epc_id", 0)))
             diff = self.model.update_domain(domain, snapshot)
+            self.genesis_sync.mark_local(domain)
             return {"created": len(diff.created),
                     "deleted": len(diff.deleted)}
         if path == "/v1/vtap-group-config":
@@ -176,10 +185,15 @@ class ControllerServer:
                 **{k: v for k, v in r.items()
                    if k not in ("type", "id", "name", "domain")})
                 for r in body.get("resources", [])]
-            diff = self.model.update_domain(domain, snapshot)
+            diff = self.recorder.reconcile(domain, snapshot)
             return {"created": len(diff.created),
                     "deleted": len(diff.deleted),
                     "updated": len(diff.updated),
+                    "orphaned": len(diff.orphaned),
+                    "field_changes": [
+                        {"type": c.type, "id": c.id, "field": c.field,
+                         "old": c.old, "new": c.new}
+                        for c in diff.field_changes],
                     "version": self.model.version}
         if path == "/v1/ingesters":
             self.monitor.set_ingesters(list(body.get("addrs", [])))
@@ -195,8 +209,10 @@ class ControllerServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="controller-http", daemon=True)
         self._thread.start()
+        self.genesis_sync.start()
 
     def close(self) -> None:
+        self.genesis_sync.close()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -5,6 +5,8 @@ GET  /api/v1/query?query=<promql>[&time=<epoch>]   (Prometheus shape)
 GET  /api/v1/query_range?query=&start=&end=&step=  (Prometheus matrix)
 GET  /v1/profile/flame[?app_service=&event_type=&start=&end=]
 GET  /v1/profile/top[?...same...&limit=]
+GET  /api/echo | /api/traces/{id} | /api/search[?service=&minDuration=]
+     /api/search/tags | /api/search/tag/{name}/values   (Tempo datasource)
 GET  /health
 
 Stdlib ThreadingHTTPServer: the query path is read-only over immutable
@@ -22,6 +24,7 @@ from typing import Optional
 from deepflow_tpu.querier.engine import QueryEngine
 from deepflow_tpu.querier.profile import ProfileQuery
 from deepflow_tpu.querier.promql import PromEngine
+from deepflow_tpu.querier.tempo import TempoQuery
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.dict_store import TagDictRegistry
 
@@ -35,6 +38,7 @@ class QuerierServer:
         self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder)
         self.prom = PromEngine(store, tag_dicts)
         self.profile = ProfileQuery(store, tag_dicts)
+        self.tempo = TempoQuery(store, tag_dicts)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -94,6 +98,48 @@ class QuerierServer:
                 except Exception as e:
                     self._send(400, {"error": str(e)})
 
+            def _tempo(self, path: str, p) -> None:
+                """Tempo datasource routes (reference:
+                server/querier/tempo/tempo.go + router/query.go:33-37)."""
+                try:
+                    tr = None
+                    if "start" in p and "end" in p:
+                        tr = (int(p["start"]), int(p["end"]) + 1)
+                    if path == "/api/echo":
+                        # plain text, not JSON: Tempo's health check
+                        # compares the literal body
+                        body = b"echo"
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    elif path.startswith("/api/traces/"):
+                        trace = outer.tempo.trace(path.split("/")[-1],
+                                                  time_range=tr)
+                        if trace is None:
+                            self._send(404, {"error": "trace not found"})
+                        else:
+                            self._send(200, trace)
+                    elif path == "/api/search/tags":
+                        self._send(200, {"tagNames": outer.tempo.tags()})
+                    elif path.startswith("/api/search/tag/"):
+                        tag = path.split("/")[-2]
+                        self._send(200, {"tagValues":
+                                         outer.tempo.tag_values(tag,
+                                                                time_range=tr)})
+                    else:  # /api/search
+                        from deepflow_tpu.querier.tempo import \
+                            parse_duration_us
+                        res = outer.tempo.search(
+                            service=p.get("service"),
+                            min_duration_us=parse_duration_us(
+                                p.get("minDuration", "0")),
+                            limit=int(p.get("limit", 20)), time_range=tr)
+                        self._send(200, {"traces": res})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
             def _route(self, path: str, params) -> None:
                 if path == "/api/v1/query":
                     self._prom_query(params)
@@ -101,6 +147,9 @@ class QuerierServer:
                     self._prom_query_range(params)
                 elif path in ("/v1/profile/flame", "/v1/profile/top"):
                     self._profile(path, params)
+                elif path == "/api/echo" or path.startswith("/api/traces/") \
+                        or path.startswith("/api/search"):
+                    self._tempo(path, params)
                 else:
                     self._send(404, {"error": "not found"})
 

@@ -200,3 +200,182 @@ def test_controller_http_api(tmp_path):
         assert vtaps[0]["alive"] is True
     finally:
         srv.close()
+
+
+def test_recorder_field_diffs_and_ordering(tmp_path):
+    """Per-resource reconciliation engines (reference: recorder/updater/):
+    field-level update info, parent-first ordering, orphan quarantine."""
+    from deepflow_tpu.controller.model import make_resource
+    from deepflow_tpu.controller.recorder import Recorder
+    from deepflow_tpu.controller import ResourceModel
+
+    model = ResourceModel()
+    rec = Recorder(model, retention_s=100)
+    snap = [
+        make_resource("pod", 30, "pod-a", "d", pod_ns_id=20),
+        make_resource("pod_ns", 20, "ns", "d", pod_cluster_id=10),
+        make_resource("pod_cluster", 10, "cluster", "d"),
+        # orphan: names a vpc that exists nowhere
+        make_resource("subnet", 40, "lost", "d", vpc_id=999),
+    ]
+    out = rec.reconcile("d", snap, now=1000.0)
+    # creation order: parents first
+    assert [r.type for r in out.created] == ["pod_cluster", "pod_ns", "pod"]
+    assert [r.id for r in out.orphaned] == [40]
+    assert model.get("subnet", 40) is None      # quarantined, not written
+    assert rec.counters()["orphans_total"] == 1
+
+    # rename the ns + move the pod: exact field changes reported
+    snap2 = [
+        make_resource("pod_cluster", 10, "cluster", "d"),
+        make_resource("pod_ns", 20, "ns-renamed", "d", pod_cluster_id=10),
+        make_resource("pod", 30, "pod-a", "d", pod_ns_id=20, pod_node_id=0),
+    ]
+    out2 = rec.reconcile("d", snap2, now=1001.0)
+    changes = {(c.type, c.field): (c.old, c.new) for c in out2.field_changes}
+    assert changes[("pod_ns", "name")] == ("ns", "ns-renamed")
+    assert ("pod", "pod_ns_id") not in changes  # unchanged attr not reported
+
+    # delete the pod: deletion order children-first + tombstone kept
+    out3 = rec.reconcile("d", snap2[:2], now=1002.0)
+    assert [r.type for r in out3.deleted] == ["pod"]
+    assert [r.id for r in rec.deleted_resources()] == [30]
+    # past retention the tombstone purges
+    rec.cleanup(now=1200.0)
+    assert rec.deleted_resources() == []
+
+
+def test_recorder_rejects_malformed_snapshots():
+    from deepflow_tpu.controller.model import make_resource
+    from deepflow_tpu.controller.recorder import Recorder
+    from deepflow_tpu.controller import ResourceModel
+
+    rec = Recorder(ResourceModel())
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        rec.reconcile("d", [make_resource("pod", 1, "a", "d"),
+                            make_resource("pod", 1, "b", "d")])
+    with _pytest.raises(ValueError):
+        rec.reconcile("d", [make_resource("blimp", 1, "a", "d")])
+
+
+def test_recorder_parent_in_model_other_domain():
+    """Parent links may resolve against rows already in the model (e.g.
+    cloud domain provides the vpc, k8s domain provides the pods)."""
+    from deepflow_tpu.controller.model import make_resource
+    from deepflow_tpu.controller.recorder import Recorder
+    from deepflow_tpu.controller import ResourceModel
+
+    model = ResourceModel()
+    rec = Recorder(model)
+    rec.reconcile("cloud", [make_resource("vpc", 7, "vpc", "cloud")])
+    out = rec.reconcile("k8s", [make_resource(
+        "subnet", 71, "sub", "k8s", vpc_id=7)])
+    assert len(out.created) == 1 and not out.orphaned
+
+
+def test_genesis_cross_controller_merge(tmp_path):
+    """Agent reports to controller A; controller B pulls A's genesis
+    export and compiles the same hosts; ownership prevents echo loops."""
+    import urllib.request
+
+    from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                         VTapRegistry)
+
+    a_model = ResourceModel()
+    a = ControllerServer(a_model, VTapRegistry(), port=0)
+    a.start()
+    try:
+        body = json.dumps({
+            "ctrl_ip": "10.0.0.9", "host": "node-1",
+            "interfaces": [{"ip": "10.0.0.9", "name": "eth0", "epc_id": 3},
+                           {"ip": "bogus", "name": "bad"}],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{a.port}/v1/genesis", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.load(resp)["created"] == 1
+
+        b_model = ResourceModel()
+        b = ControllerServer(
+            b_model, VTapRegistry(), port=0,
+            genesis_peers=[f"http://127.0.0.1:{a.port}"])
+        b.start()
+        try:
+            assert b.genesis_sync.pull_once() == 1
+            hosts = b_model.list(type="host")
+            assert len(hosts) == 1
+            assert hosts[0].attr("ip") == "10.0.0.9"
+            assert hosts[0].domain == "genesis/node-1"
+            # B does not export what it merged; A ignores its own domain
+            assert b.genesis_sync.export() == {}
+            a.genesis_sync.merge(
+                {"genesis/node-1": []})   # would wipe A's rows if applied
+            assert len(a_model.list(type="host")) == 1
+            assert b.genesis_sync.counters()["merged_domains"] == 1
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_recorder_cross_domain_id_rejected_before_mutation():
+    """A snapshot claiming an id owned by another domain fails whole —
+    no half-applied model state."""
+    from deepflow_tpu.controller.model import make_resource
+    from deepflow_tpu.controller.recorder import Recorder
+    from deepflow_tpu.controller import ResourceModel
+
+    model = ResourceModel()
+    rec = Recorder(model)
+    rec.reconcile("cloud", [make_resource("vpc", 7, "vpc", "cloud")])
+    v = model.version
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        rec.reconcile("k8s", [make_resource("vpc", 7, "stolen", "k8s")])
+    assert model.get("vpc", 7).domain == "cloud"
+    assert model.version == v                 # untouched
+
+
+def test_recorder_orphan_cascades_and_holds_last_good():
+    from deepflow_tpu.controller.model import make_resource
+    from deepflow_tpu.controller.recorder import Recorder
+    from deepflow_tpu.controller import ResourceModel
+
+    model = ResourceModel()
+    rec = Recorder(model)
+    # cascade: ns's cluster is unknown -> ns quarantined -> pod too
+    out = rec.reconcile("d", [
+        make_resource("pod_ns", 20, "ns", "d", pod_cluster_id=999),
+        make_resource("pod", 30, "p", "d", pod_ns_id=20),
+    ])
+    assert not out.created
+    assert {r.id for r in out.orphaned} == {20, 30}
+    assert model.get("pod", 30) is None
+
+    # hold-last-good: existing subnet survives a transiently bad vpc link
+    rec.reconcile("d", [make_resource("vpc", 1, "v", "d"),
+                        make_resource("subnet", 2, "s", "d", vpc_id=1)])
+    out = rec.reconcile("d", [make_resource("vpc", 1, "v", "d"),
+                              make_resource("subnet", 2, "s", "d",
+                                            vpc_id=555)])
+    assert [r.id for r in out.orphaned] == [2]
+    assert not out.deleted
+    kept = model.get("subnet", 2)
+    assert kept is not None and kept.attr("vpc_id") == 1  # last-good
+
+
+def test_genesis_stale_peer_domains_cleared():
+    from deepflow_tpu.controller.genesis_sync import GenesisSync
+    from deepflow_tpu.controller import ResourceModel
+
+    model = ResourceModel()
+    gs = GenesisSync(model)
+    rows = [{"type": "host", "id": 1, "name": "n1", "ip": "10.0.0.1"}]
+    gs.merge({"genesis/node-1": rows}, peer="http://a")
+    assert len(model.list(type="host")) == 1
+    # next pull from the same peer no longer carries the domain
+    gs.merge({}, peer="http://a")
+    assert model.list(type="host") == []
+    assert gs.counters()["merged_domains"] == 0

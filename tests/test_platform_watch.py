@@ -1,0 +1,85 @@
+"""Agent platform sync: snapshot watchers + k8s watch analogue e2e."""
+
+import json
+
+import pytest
+
+from deepflow_tpu.agent.platform import (SnapshotWatcher, file_lister,
+                                         k8s_watcher)
+
+
+def test_snapshot_watcher_pushes_only_on_change():
+    snapshots = [[{"name": "eth0", "ip": "10.0.0.1"}]]
+    sent = []
+
+    def report(s):
+        sent.append(s)
+        return True
+
+    w = SnapshotWatcher(lambda: snapshots[-1], report, interval_s=999)
+    assert w.poll_once() is True
+    assert w.poll_once() is False          # unchanged: no push
+    snapshots.append([{"name": "eth0", "ip": "10.0.0.2"}])
+    assert w.poll_once() is True
+    assert len(sent) == 2 and w.reports == 2
+
+
+def test_snapshot_watcher_retries_failed_report():
+    ok = [False]
+    sent = []
+
+    def report(s):
+        sent.append(s)
+        return ok[0]
+
+    w = SnapshotWatcher(lambda: [{"a": 1}], report, interval_s=999)
+    assert w.poll_once() is False          # report failed
+    assert w.report_errors == 1
+    ok[0] = True
+    assert w.poll_once() is True           # same snapshot retried
+    assert len(sent) == 2
+
+
+def test_file_lister_missing_and_invalid(tmp_path):
+    lister = file_lister(str(tmp_path / "nope.json"))
+    assert lister() == []
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    assert file_lister(str(p))() == []
+    p.write_text(json.dumps({"resources": [{"type": "pod"}]}))
+    assert file_lister(str(p))() == [{"type": "pod"}]
+
+
+def test_k8s_watch_to_controller_e2e(tmp_path):
+    """File-watch analogue of api_watcher: cluster state lands in the
+    controller model, updates flow through on change only."""
+    from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                         VTapRegistry)
+
+    model = ResourceModel()
+    ctl = ControllerServer(model, VTapRegistry(), port=0)
+    ctl.start()
+    try:
+        f = tmp_path / "cluster.json"
+        f.write_text(json.dumps({"resources": [
+            {"type": "pod_cluster", "id": 1, "name": "c"},
+            {"type": "pod_ns", "id": 2, "name": "default",
+             "pod_cluster_id": 1},
+            {"type": "pod", "id": 3, "name": "web-1", "pod_ns_id": 2},
+        ]}))
+        w = k8s_watcher(f"http://127.0.0.1:{ctl.port}", "k8s-c1",
+                        file_lister(str(f)), interval_s=999)
+        assert w.poll_once() is True
+        assert {r.name for r in model.list(domain="k8s-c1")} == \
+            {"c", "default", "web-1"}
+        assert w.poll_once() is False      # no change, no POST
+        # pod deleted from the cluster
+        f.write_text(json.dumps({"resources": [
+            {"type": "pod_cluster", "id": 1, "name": "c"},
+            {"type": "pod_ns", "id": 2, "name": "default",
+             "pod_cluster_id": 1},
+        ]}))
+        assert w.poll_once() is True
+        assert model.get("pod", 3) is None
+    finally:
+        ctl.close()

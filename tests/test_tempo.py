@@ -1,0 +1,154 @@
+"""Tempo trace-query API over l7_flow_log (reference: querier/tempo/)."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.pipelines.schemas import L7_TABLE
+from deepflow_tpu.querier.server import QuerierServer
+from deepflow_tpu.querier.tempo import TempoQuery
+from deepflow_tpu.store import Store
+from deepflow_tpu.store.dict_store import TagDictRegistry
+
+
+@pytest.fixture
+def tempo(tmp_path):
+    store = Store(str(tmp_path / "store"))
+    dicts = TagDictRegistry(str(tmp_path / "store"))
+    t = store.create_table("flow_log", L7_TABLE)
+    s = dicts.get("l7_endpoint")
+
+    def h(x):
+        return s.encode_one(x)
+
+    # trace A: two spans (parent gateway -> child backend); trace B: one
+    rows = [
+        # (trace, span, parent, endpoint, service, start_us, end_us, st)
+        ("trace-a", "a1", "", "GET /api", "gateway", 1_000_000,
+         1_050_000, 0),
+        ("trace-a", "a2", "a1", "SELECT users", "backend", 1_010_000,
+         1_030_000, 0),
+        ("trace-b", "b1", "", "GET /slow", "gateway", 2_000_000,
+         2_500_000, 1),
+    ]
+    n = len(rows)
+    cols = {spec.name: np.zeros(n, spec.dtype) for spec in L7_TABLE.columns}
+    for i, (tr, sp, par, ep, svc, st, en, status) in enumerate(rows):
+        cols["trace_id_hash"][i] = h(tr)
+        cols["span_id_hash"][i] = h(sp)
+        cols["parent_span_id_hash"][i] = h(par) if par else 0
+        cols["endpoint_hash"][i] = h(ep)
+        cols["app_service_hash"][i] = h(svc)
+        cols["start_time_us"][i] = st
+        cols["end_time_us"][i] = en
+        cols["status"][i] = status
+        cols["timestamp"][i] = st // 1_000_000
+        cols["l7_protocol"][i] = 20
+        cols["ip_src"][i] = 0x0A000001
+        cols["ip_dst"][i] = 0x0A000002
+        cols["port_dst"][i] = 80
+        cols["rrt_us"][i] = en - st
+    t.append(cols)
+    yield TempoQuery(store, dicts), store, dicts
+    dicts.close()
+
+
+def test_trace_by_id(tempo):
+    tq, _, _ = tempo
+    tr = tq.trace("trace-a")
+    assert tr is not None and len(tr["spans"]) == 2
+    root, child = tr["spans"]          # start-time ordered
+    assert root["spanID"] == "a1" and root["parentSpanID"] == ""
+    assert child["parentSpanID"] == "a1"
+    assert child["operationName"] == "SELECT users"
+    assert child["serviceName"] == "backend"
+    assert child["durationNanos"] == 20_000_000
+    assert root["attributes"]["l7.protocol"] == "HTTP"
+    assert root["attributes"]["ip.dst"] == "10.0.0.2"
+    # unknown trace does not grow the dictionary
+    assert tq.trace("trace-nope") is None
+    assert tq.strings.lookup("trace-nope") is None
+
+
+def test_search(tempo):
+    tq, _, _ = tempo
+    out = tq.search()
+    assert [t["traceID"] for t in out] == ["trace-b", "trace-a"]  # newest 1st
+    a = next(t for t in out if t["traceID"] == "trace-a")
+    assert a["rootServiceName"] == "gateway"
+    assert a["spanSets"][0]["matched"] == 2
+    assert a["durationMs"] == 50
+    # duration filter keeps only the slow trace
+    out = tq.search(min_duration_us=100_000)
+    assert [t["traceID"] for t in out] == ["trace-b"]
+    # service filter
+    out = tq.search(service="gateway")
+    assert len(out) == 2
+    assert tq.search(service="ghost") == []
+
+
+def test_tags_and_values(tempo):
+    tq, _, _ = tempo
+    assert "service.name" in tq.tags()
+    assert tq.tag_values("service.name") == ["backend", "gateway"]
+    assert tq.tag_values("l7.protocol") == ["HTTP"]
+    assert tq.tag_values("nope") == []
+
+
+def test_tempo_http_routes(tempo):
+    tq, store, dicts = tempo
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/api/echo", timeout=5) as r:
+            assert r.read() == b"echo"
+        with urllib.request.urlopen(f"{base}/api/traces/trace-a",
+                                    timeout=5) as r:
+            assert len(json.load(r)["spans"]) == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/api/traces/none", timeout=5)
+        assert ei.value.code == 404
+        with urllib.request.urlopen(f"{base}/api/search?minDuration=100000",
+                                    timeout=5) as r:
+            assert [t["traceID"] for t in json.load(r)["traces"]] == \
+                ["trace-b"]
+        with urllib.request.urlopen(f"{base}/api/search/tags", timeout=5) \
+                as r:
+            assert "service.name" in json.load(r)["tagNames"]
+        with urllib.request.urlopen(
+                f"{base}/api/search/tag/service.name/values", timeout=5) \
+                as r:
+            assert json.load(r)["tagValues"] == ["backend", "gateway"]
+    finally:
+        srv.close()
+
+
+def test_parse_duration_and_echo_plain(tempo):
+    from deepflow_tpu.querier.tempo import parse_duration_us
+
+    assert parse_duration_us("5ms") == 5000
+    assert parse_duration_us("1.5s") == 1_500_000
+    assert parse_duration_us("300us") == 300
+    assert parse_duration_us("250ns") == 0
+    assert parse_duration_us("2m") == 120_000_000
+    assert parse_duration_us("42") == 42
+    assert parse_duration_us("") == 0
+
+    tq, store, dicts = tempo
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/api/echo", timeout=5) as r:
+            assert r.read() == b"echo"         # literal body, not JSON
+        with urllib.request.urlopen(
+                f"{base}/api/search?minDuration=100ms", timeout=5) as r:
+            assert [t["traceID"] for t in json.load(r)["traces"]] == \
+                ["trace-b"]
+    finally:
+        srv.close()
