@@ -8,11 +8,17 @@ batch-columnar re-design splits that into:
 1. per-batch: canonicalize 5-tuples (so both directions share a flow),
    segment-reduce per-direction byte/packet/flag/timestamp aggregates —
    one vectorized pass over the whole batch, device-friendly;
-2. cross-batch: merge the per-flow partials into a dict of mergeable
-   accumulators (the only O(flows) state);
-3. tick(now): emit 1s updates for active flows and close flows on
-   FIN/RST or timeout, deriving close_type and RTT (SYN->SYN/ACK) the
-   way the reference's state machine does.
+2. cross-batch: merge the per-flow partials into a COLUMNAR flow table —
+   the accumulators are numpy arrays indexed by slot, so the merge is a
+   handful of vectorized scatters (np.add.at / np.maximum.at). The only
+   per-group Python is one dict lookup resolving the 5-tuple to its
+   slot (plus allocation for first-seen flows);
+3. tick(now): one vectorized pass over the table emits 1s interval
+   deltas for active flows and closes flows on FIN/RST or timeout,
+   deriving close_type and RTT (SYN->SYN/ACK) the way the reference's
+   state machine does. `tick_columns` returns oriented wire-ready
+   columns with zero per-flow Python; `tick` wraps them in FlowAcc
+   objects for callers that want row views.
 
 Retransmissions are estimated per direction by counting payload-carrying
 packets whose sequence did not advance (reference counts true
@@ -24,7 +30,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -39,11 +45,13 @@ CLOSE_TIMEOUT = 3
 
 FLOW_TIMEOUT_NS = 120 * 1_000_000_000
 _U64 = np.uint64
+_BIG = np.int64(1 << 62)
 
 
 @dataclass
 class FlowAcc:
-    """Mergeable per-flow accumulator (one per active canonical flow)."""
+    """Row view of one emitted flow (compat shell over the columnar
+    table; tick_columns is the zero-copy path)."""
 
     ip0: int
     ip1: int
@@ -82,15 +90,74 @@ class FlowAcc:
 
 
 class FlowMap:
-    """Cross-batch flow table with batched ingest + 1s tick output."""
+    """Cross-batch columnar flow table: batched ingest + 1s tick output."""
 
-    def __init__(self, vtap_id: int = 0) -> None:
+    def __init__(self, vtap_id: int = 0, capacity: int = 1024) -> None:
         self.vtap_id = vtap_id
-        self._flows: Dict[Tuple[int, int, int, int, int], FlowAcc] = {}
+        self._slot: Dict[Tuple[int, int, int, int, int], int] = {}
+        self._free: List[int] = []
         self._next_flow_id = 1
         self.packets_in = 0
         self.invalid_packets = 0
         self.flows_created = 0
+        self._alloc_cols(max(capacity, 16))
+
+    def _alloc_cols(self, cap: int) -> None:
+        self._cap = cap
+        z64 = lambda shape: np.zeros(shape, np.int64)  # noqa: E731
+        self.c_key = z64((cap, 5))       # ip0 ip1 p0 p1 proto
+        self.c_flow_id = np.zeros(cap, np.uint64)
+        self.c_start = z64(cap)
+        self.c_last = z64(cap)
+        self.c_bytes = z64((cap, 2))
+        self.c_pkts = z64((cap, 2))
+        self.c_flags = z64((cap, 2))
+        self.c_retrans = z64((cap, 2))
+        self.c_max_seq = z64((cap, 2))
+        self.c_syn = z64(cap)            # 0 = unset
+        self.c_synack = z64(cap)
+        self.c_initiator = np.full(cap, -1, np.int8)
+        self.c_reported = np.zeros(cap, np.bool_)
+        self.c_live = np.zeros(cap, np.bool_)
+
+    def _grow(self) -> None:
+        old = {k: getattr(self, k) for k in (
+            "c_key", "c_flow_id", "c_start", "c_last", "c_bytes", "c_pkts",
+            "c_flags", "c_retrans", "c_max_seq", "c_syn", "c_synack",
+            "c_initiator", "c_reported", "c_live")}
+        n = self._cap
+        self._alloc_cols(self._cap * 2)
+        for k, v in old.items():
+            getattr(self, k)[:n] = v
+
+    def _allocate(self, key: Tuple[int, int, int, int, int]) -> int:
+        if self._free:
+            s = self._free.pop()
+        else:
+            s = len(self._slot)
+            while s >= self._cap or self.c_live[s]:
+                if s >= self._cap:
+                    self._grow()
+                    continue
+                s += 1
+        self._slot[key] = s
+        self.c_key[s] = key
+        self.c_flow_id[s] = self._next_flow_id
+        self._next_flow_id += 1
+        self.c_start[s] = _BIG
+        self.c_last[s] = 0
+        self.c_bytes[s] = 0
+        self.c_pkts[s] = 0
+        self.c_flags[s] = 0
+        self.c_retrans[s] = 0
+        self.c_max_seq[s] = 0
+        self.c_syn[s] = 0
+        self.c_synack[s] = 0
+        self.c_initiator[s] = -1
+        self.c_reported[s] = False
+        self.c_live[s] = True
+        self.flows_created += 1
+        return s
 
     # -- ingest ------------------------------------------------------------
     def inject(self, pkt: Dict[str, np.ndarray]) -> None:
@@ -119,14 +186,21 @@ class FlowMap:
         is_synack = (flags & (SYN | ACK)) == (SYN | ACK)
         has_payload = cols["payload_len"] > 0
 
-        # per-(flow, direction) segment reduction — one device pass
+        # per-(flow, direction) segment reduction — one device pass.
+        # The 6-part key packs into 2 u64 words (ips | ports+proto+dir):
+        # grouping cost is 2 radix-friendly i64 sorts, not a 48-byte
+        # memcmp sort.
+        k_ips = (ip0.astype(_U64) << _U64(32)) | ip1.astype(_U64)
+        k_rest = ((p0.astype(_U64) << _U64(25))
+                  | (p1.astype(_U64) << _U64(9))
+                  | (cols["proto"].astype(_U64) << _U64(1))
+                  | direction.astype(_U64))
         work = {
-            "ip0": ip0, "ip1": ip1, "p0": p0, "p1": p1,
-            "proto": cols["proto"], "dir": direction,
+            "k_ips": k_ips, "k_rest": k_rest,
             "bytes": cols["pkt_len"], "pkts": np.ones(n, np.int64),
             "flags": flags, "ts_min": ts, "ts_max": ts,
-            "syn_ts": np.where(is_syn, ts, np.int64(1 << 62)),
-            "synack_ts": np.where(is_synack, ts, np.int64(1 << 62)),
+            "syn_ts": np.where(is_syn, ts, _BIG),
+            "synack_ts": np.where(is_synack, ts, _BIG),
             "seq_max": cols["tcp_seq"].astype(np.int64),
             # payload packets whose seq never advances past the running max
             # are the batch-local retrans candidates; cross-batch handled
@@ -134,102 +208,183 @@ class FlowMap:
             "payload_pkts": has_payload.astype(np.int64),
         }
         red, inv = group_reduce(
-            work, ["ip0", "ip1", "p0", "p1", "proto", "dir"],
+            work, ["k_ips", "k_rest"],
             {"bytes": "sum", "pkts": "sum", "flags": "max",
              "ts_min": "min", "ts_max": "max", "syn_ts": "min",
              "synack_ts": "min", "seq_max": "max", "payload_pkts": "sum"},
             return_inverse=True)
         # flags need OR, not max: OR-reduce per group on host, reusing the
         # group ids from the reduction (group count << packet count)
-        red_flags = np.zeros(len(red["ip0"]), np.int64)
+        red_flags = np.zeros(len(red["k_ips"]), np.int64)
         np.bitwise_or.at(red_flags, inv, flags)
 
-        m = len(red["ip0"])
+        m = len(red["k_ips"])
+        # unpack the key words back to tuple form for slot resolution
+        rk_ips = red["k_ips"].astype(_U64)
+        rk_rest = red["k_rest"].astype(_U64)
+        r_ip0 = (rk_ips >> _U64(32)).astype(np.int64)
+        r_ip1 = (rk_ips & _U64(0xFFFFFFFF)).astype(np.int64)
+        r_p0 = (rk_rest >> _U64(25)).astype(np.int64)
+        r_p1 = ((rk_rest >> _U64(9)) & _U64(0xFFFF)).astype(np.int64)
+        r_proto = ((rk_rest >> _U64(1)) & _U64(0xFF)).astype(np.int64)
+        # slot resolution: the ONLY per-group Python — one dict op each
+        keys = list(zip(r_ip0.tolist(), r_ip1.tolist(), r_p0.tolist(),
+                        r_p1.tolist(), r_proto.tolist()))
+        get = self._slot.get
+        slots = np.fromiter(
+            (s if (s := get(k)) is not None else self._allocate(k)
+             for k in keys), dtype=np.int64, count=m)
+        d = (rk_rest & _U64(1)).astype(np.int64)
 
-        for i in range(m):
-            key = (int(red["ip0"][i]), int(red["ip1"][i]),
-                   int(red["p0"][i]), int(red["p1"][i]),
-                   int(red["proto"][i]))
-            d = int(red["dir"][i])
-            acc = self._flows.get(key)
-            if acc is None:
-                acc = FlowAcc(*key, flow_id=self._next_flow_id,
-                              start_ns=int(red["ts_min"][i]),
-                              last_ns=int(red["ts_max"][i]))
-                self._next_flow_id += 1
-                self._flows[key] = acc
-                self.flows_created += 1
-            acc.start_ns = min(acc.start_ns, int(red["ts_min"][i]))
-            acc.last_ns = max(acc.last_ns, int(red["ts_max"][i]))
-            acc.bytes_[d] += int(red["bytes"][i])
-            acc.packets[d] += int(red["pkts"][i])
-            new_flags = int(red_flags[i])
-            # retrans estimate: payload packets that failed to move seq_max
-            seq = int(red["seq_max"][i])
-            if acc.packets[d] > int(red["pkts"][i]) and acc.max_seq[d] and \
-                    seq <= acc.max_seq[d] and int(red["payload_pkts"][i]):
-                acc.retrans[d] += int(red["payload_pkts"][i])
-            acc.max_seq[d] = max(acc.max_seq[d], seq)
-            acc.flags[d] |= new_flags
-            syn_ts = int(red["syn_ts"][i])
-            if syn_ts < (1 << 62):
-                if acc.initiator < 0:
-                    acc.initiator = d
-                if acc.syn_ns == 0 or syn_ts < acc.syn_ns:
-                    acc.syn_ns = syn_ts
-            sa = int(red["synack_ts"][i])
-            if sa < (1 << 62) and (acc.synack_ns == 0 or sa < acc.synack_ns):
-                acc.synack_ns = sa
+        # everything below is vectorized scatter over (slot, dir). A slot
+        # can appear for both directions in one batch, so per-slot columns
+        # use .at reductions; per-(slot, dir) targets are unique and can
+        # assign directly.
+        prev_pkts = self.c_pkts[slots, d]
+        prev_max = self.c_max_seq[slots, d]
+        seq = red["seq_max"]
+        self.c_bytes[slots, d] += red["bytes"]
+        self.c_pkts[slots, d] = prev_pkts + red["pkts"]
+        self.c_flags[slots, d] |= red_flags
+        # retrans estimate: payload packets that failed to move seq_max
+        self.c_retrans[slots, d] += np.where(
+            (prev_pkts > 0) & (prev_max > 0) & (seq <= prev_max),
+            red["payload_pkts"], 0)
+        self.c_max_seq[slots, d] = np.maximum(prev_max, seq)
+        np.minimum.at(self.c_start, slots, red["ts_min"])
+        np.maximum.at(self.c_last, slots, red["ts_max"])
+        # handshake stamps: 0 means unset — lift touched slots to +inf
+        # BEFORE the min-scatter (min against a 0 target would stick), and
+        # lower the never-set ones back after
+        touched = np.unique(slots)
+        for col, cand in ((self.c_syn, red["syn_ts"]),
+                          (self.c_synack, red["synack_ts"])):
+            cur = col[touched]
+            col[touched] = np.where(cur == 0, _BIG, cur)
+            np.minimum.at(col, slots, cand)
+            cur = col[touched]
+            col[touched] = np.where(cur >= _BIG, 0, cur)
+        # initiator: direction of the earliest SYN. Write candidates in
+        # DESCENDING syn_ts order so the earliest lands last (last write
+        # wins on duplicate fancy indices); only unset slots take it.
+        cand = np.nonzero((red["syn_ts"] < _BIG)
+                          & (self.c_initiator[slots] < 0))[0]
+        if len(cand):
+            order = cand[np.argsort(-red["syn_ts"][cand],
+                                    kind="stable")]
+            self.c_initiator[slots[order]] = d[order].astype(np.int8)
 
     # -- tick output -------------------------------------------------------
-    def tick(self, now_ns: Optional[int] = None,
-             emit_active: bool = True) -> List[FlowAcc]:
-        """Emit flows: closed ones are removed; active ones are reported
-        as *interval deltas* and kept with their counters reset (the
-        reference's 1s forced report reports per-interval traffic too —
-        re-emitting cumulative totals would double-count downstream sums)."""
+    def tick_columns(self, now_ns: Optional[int] = None,
+                     emit_active: bool = True) -> Dict[str, np.ndarray]:
+        """One vectorized pass: closed flows are removed; active ones are
+        reported as *interval deltas* and kept with their counters reset
+        (the reference's 1s forced report reports per-interval traffic
+        too — re-emitting cumulative totals would double-count downstream
+        sums). Output columns are oriented client->server: the initiator
+        (first SYN sender) is the client."""
         now_ns = int(time.time() * 1e9) if now_ns is None else now_ns
-        out: List[FlowAcc] = []
-        for key, acc in list(self._flows.items()):
-            ct = acc.close_type(now_ns)
-            if ct != CLOSE_FORCED_REPORT:
-                out.append(acc)
-                del self._flows[key]
-            elif emit_active and acc.packets != [0, 0]:
-                out.append(self._snapshot_and_reset(acc))
+        live = self.c_live
+        flags0, flags1 = self.c_flags[:, 0], self.c_flags[:, 1]
+        ct = np.zeros(self._cap, np.uint32)
+        ct[now_ns - self.c_last > FLOW_TIMEOUT_NS] = CLOSE_TIMEOUT
+        ct[((flags0 & FIN) > 0) & ((flags1 & FIN) > 0)] = CLOSE_FIN
+        ct[((flags0 | flags1) & RST) > 0] = CLOSE_RST
+        closed = live & (ct != CLOSE_FORCED_REPORT)
+        active = live & (ct == CLOSE_FORCED_REPORT) & \
+            (self.c_pkts.sum(axis=1) > 0)
+        emit = closed | (active if emit_active else False)
+        idx = np.nonzero(emit)[0]
+
+        cli = np.maximum(self.c_initiator[idx], 0).astype(np.int64)
+        srv = 1 - cli
+        ips = self.c_key[idx, 0:2]
+        ports = self.c_key[idx, 2:4]
+        r = np.arange(len(idx))
+        syn, synack = self.c_syn[idx], self.c_synack[idx]
+        out = {
+            "ip_src": ips[r, cli].astype(np.uint32),
+            "ip_dst": ips[r, srv].astype(np.uint32),
+            "port_src": ports[r, cli].astype(np.uint32),
+            "port_dst": ports[r, srv].astype(np.uint32),
+            "proto": self.c_key[idx, 4].astype(np.uint32),
+            "vtap_id": np.full(len(idx), self.vtap_id, np.uint32),
+            "byte_tx": self.c_bytes[idx][r, cli].astype(np.uint64),
+            "byte_rx": self.c_bytes[idx][r, srv].astype(np.uint64),
+            "packet_tx": self.c_pkts[idx][r, cli].astype(np.uint64),
+            "packet_rx": self.c_pkts[idx][r, srv].astype(np.uint64),
+            "retrans": self.c_retrans[idx].sum(axis=1).astype(np.uint32),
+            "rtt": np.where((syn > 0) & (synack > syn),
+                            (synack - syn) // 1000, 0).astype(np.uint32),
+            "close_type": ct[idx],
+            "flow_id": self.c_flow_id[idx],
+            "start_time": self.c_start[idx].astype(np.uint64),
+            "duration": np.maximum(self.c_last[idx] - self.c_start[idx],
+                                   0).astype(np.uint64),
+            "tap_side": np.zeros(len(idx), np.uint32),
+            "l3_epc_id": np.zeros(len(idx), np.int32),
+            "is_new_flow": (~self.c_reported[idx]).astype(np.uint32),
+        }
+        # reset interval counters on kept-active flows; free closed slots
+        act_idx = np.nonzero(active)[0] if emit_active else \
+            np.empty(0, np.int64)
+        self.c_bytes[act_idx] = 0
+        self.c_pkts[act_idx] = 0
+        self.c_retrans[act_idx] = 0
+        self.c_reported[act_idx] = True
+        for s in np.nonzero(closed)[0]:
+            self.c_live[s] = False
+            del self._slot[tuple(self.c_key[s].tolist())]
+            self._free.append(int(s))
         return out
 
-    @staticmethod
-    def _snapshot_and_reset(acc: FlowAcc) -> FlowAcc:
-        snap = FlowAcc(
-            acc.ip0, acc.ip1, acc.port0, acc.port1, acc.proto,
-            flow_id=acc.flow_id, start_ns=acc.start_ns, last_ns=acc.last_ns,
-            bytes_=list(acc.bytes_), packets=list(acc.packets),
-            flags=list(acc.flags), retrans=list(acc.retrans),
-            max_seq=list(acc.max_seq), syn_ns=acc.syn_ns,
-            synack_ns=acc.synack_ns, initiator=acc.initiator,
-            reported=acc.reported)
-        acc.bytes_ = [0, 0]
-        acc.packets = [0, 0]
-        acc.retrans = [0, 0]
-        acc.reported = True
-        return snap
+    def tick(self, now_ns: Optional[int] = None,
+             emit_active: bool = True) -> List[FlowAcc]:
+        """Row-view tick for callers that want per-flow objects (tests,
+        ad-hoc inspection). Same semantics as tick_columns; the column
+        path is the hot one."""
+        now_ns = int(time.time() * 1e9) if now_ns is None else now_ns
+        snap = self._row_views(now_ns)
+        self.tick_columns(now_ns, emit_active=emit_active)
+        out = []
+        for f in snap:
+            closed = f.close_type(now_ns) != CLOSE_FORCED_REPORT
+            if closed or (emit_active and f.packets != [0, 0]):
+                out.append(f)
+        return out
+
+    def _row_views(self, now_ns: int) -> List[FlowAcc]:
+        out = []
+        for s in np.nonzero(self.c_live)[0]:
+            k = self.c_key[s]
+            out.append(FlowAcc(
+                int(k[0]), int(k[1]), int(k[2]), int(k[3]), int(k[4]),
+                flow_id=int(self.c_flow_id[s]),
+                start_ns=int(self.c_start[s]), last_ns=int(self.c_last[s]),
+                bytes_=self.c_bytes[s].tolist(),
+                packets=self.c_pkts[s].tolist(),
+                flags=self.c_flags[s].tolist(),
+                retrans=self.c_retrans[s].tolist(),
+                max_seq=self.c_max_seq[s].tolist(),
+                syn_ns=int(self.c_syn[s]), synack_ns=int(self.c_synack[s]),
+                initiator=int(self.c_initiator[s]),
+                reported=bool(self.c_reported[s])))
+        return out
 
     def __len__(self) -> int:
-        return len(self._flows)
+        return len(self._slot)
 
     def counters(self) -> dict:
         return {"packets_in": self.packets_in,
                 "invalid_packets": self.invalid_packets,
                 "flows_created": self.flows_created,
-                "active_flows": len(self._flows)}
+                "active_flows": len(self._slot)}
 
 
 def flows_to_columns(flows: List[FlowAcc], vtap_id: int,
                      now_ns: int) -> Dict[str, np.ndarray]:
-    """TaggedFlow-equivalent columns, oriented client->server: the
-    initiator (first SYN sender) is the client; src carries direction-0
-    accumulators of whichever side initiated."""
+    """TaggedFlow-equivalent columns from FlowAcc row views (compat for
+    the tick() path; tick_columns emits these directly)."""
     n = len(flows)
     cols = {k: np.zeros(n, dt) for k, dt in (
         ("ip_src", np.uint32), ("ip_dst", np.uint32),

@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from deepflow_tpu.agent.flow_map import FlowMap, flows_to_columns
+from deepflow_tpu.agent.flow_map import FlowMap
 from deepflow_tpu.agent.guard import EscapeTimer, Guard
 from deepflow_tpu.agent.l7 import (MSG_REQUEST, SessionAggregator,
                                    parse_payload)
@@ -257,11 +257,13 @@ class Agent:
         sessions -> PROTOCOLLOG."""
         now_ns = int(time.time() * 1e9) if now_ns is None else now_ns
         with self._lock:
-            flows = self.flow_map.tick(now_ns)
+            # vectorized tick: oriented wire-ready columns, no per-flow
+            # Python (flow_map.tick_columns)
+            cols = self.flow_map.tick_columns(now_ns)
+            cols["vtap_id"][:] = self.vtap_id
             l7_records, self._l7_out = self._l7_out, []
         sent = {"flows": 0, "documents": 0, "l7": 0}
-        if flows:
-            cols = flows_to_columns(flows, self.vtap_id, now_ns)
+        if len(cols["ip_src"]):
             if self.cfg.wire_mode == "columnar":
                 from deepflow_tpu.batch.schema import L4_SCHEMA
                 sent["flows"] = self.senders[

@@ -84,7 +84,11 @@ class GenesisSync:
             merged += 1
         if peer is not None:
             with self._lock:
-                stale = self._peer_domains.get(peer, set()) - applied
+                # a domain that has since failed over to THIS controller
+                # (mark_local) is first-hand data now — never clear it
+                # just because the old owner stopped exporting it
+                stale = (self._peer_domains.get(peer, set()) - applied
+                         - self._local_domains)
                 self._peer_domains[peer] = applied
                 for d in stale:
                     self._merged_domains.discard(d)

@@ -24,6 +24,7 @@ updater fleet adds on top:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -80,6 +81,10 @@ class Recorder:
                  retention_s: float = 24 * 3600.0) -> None:
         self.model = model
         self.retention_s = retention_s
+        # reconcile is validate-then-apply over shared state; the HTTP
+        # server is threaded, so the pair must be atomic or two racing
+        # snapshots can bypass the cross-domain ownership check
+        self._lock = threading.RLock()
         # (type, id) -> (resource, deleted_at)
         self._tombstones: Dict[Tuple[str, int], Tuple[Resource, float]] = {}
         self.orphans_total = 0
@@ -134,6 +139,11 @@ class Recorder:
     # -- reconciliation ----------------------------------------------------
     def reconcile(self, domain: str, snapshot: List[Resource],
                   now: Optional[float] = None) -> RecorderDiff:
+        with self._lock:
+            return self._reconcile_locked(domain, snapshot, now)
+
+    def _reconcile_locked(self, domain: str, snapshot: List[Resource],
+                          now: Optional[float]) -> RecorderDiff:
         now = time.time() if now is None else now
         accepted, orphaned = self._validate(domain, snapshot)
         self.orphans_total += len(orphaned)
@@ -168,15 +178,17 @@ class Recorder:
     def deleted_resources(self) -> List[Resource]:
         """Soft-deleted rows still within retention (reference: the
         deleted_at-marked rows the cleaner hasn't purged)."""
-        return [r for r, _ in self._tombstones.values()]
+        with self._lock:
+            return [r for r, _ in self._tombstones.values()]
 
     def cleanup(self, now: Optional[float] = None) -> int:
         """Purge tombstones past retention; returns purged count."""
         now = time.time() if now is None else now
-        dead = [k for k, (_, t) in self._tombstones.items()
-                if now - t >= self.retention_s]
-        for k in dead:
-            del self._tombstones[k]
+        with self._lock:
+            dead = [k for k, (_, t) in self._tombstones.items()
+                    if now - t >= self.retention_s]
+            for k in dead:
+                del self._tombstones[k]
         return len(dead)
 
     def counters(self) -> dict:

@@ -59,12 +59,23 @@ class TempoQuery:
         self.db = db
         self.table = table
 
-    def _scan(self, time_range: Optional[Tuple[int, int]] = None):
+    # column sets per endpoint: the l7 table is ~90 columns wide and a
+    # Grafana poll must not pay a full-width scan for the handful it reads
+    _SPAN_COLS = ("trace_id_hash", "span_id_hash", "parent_span_id_hash",
+                  "endpoint_hash", "app_service_hash", "start_time_us",
+                  "end_time_us", "rrt_us", "l7_protocol", "status",
+                  "response_code", "ip_src", "ip_dst", "port_dst",
+                  "vtap_id")
+    _SEARCH_COLS = ("trace_id_hash", "app_service_hash", "endpoint_hash",
+                    "start_time_us", "end_time_us")
+
+    def _scan(self, time_range: Optional[Tuple[int, int]] = None,
+              columns=None):
         try:
             t = self.store.table(self.db, self.table)
         except KeyError:
             return None
-        return t.scan(time_range=time_range)
+        return t.scan(columns=columns, time_range=time_range)
 
     def _span(self, cols: Dict[str, np.ndarray], i: int) -> dict:
         dec = self.strings.decode
@@ -97,7 +108,7 @@ class TempoQuery:
         h = self.strings.lookup(trace_id)   # read-only: never grows dict
         if h is None:
             return None
-        cols = self._scan(time_range)
+        cols = self._scan(time_range, columns=self._SPAN_COLS)
         if cols is None:
             return None
         idx = np.nonzero(cols["trace_id_hash"] == np.uint32(h))[0]
@@ -112,7 +123,7 @@ class TempoQuery:
                time_range: Optional[Tuple[int, int]] = None) -> List[dict]:
         """Recent trace summaries (GET /api/search): one row per trace with
         root service, span count, duration."""
-        cols = self._scan(time_range)
+        cols = self._scan(time_range, columns=self._SEARCH_COLS)
         if cols is None:
             return []
         sel = cols["trace_id_hash"] != 0
@@ -162,7 +173,9 @@ class TempoQuery:
     def tag_values(self, tag: str,
                    time_range: Optional[Tuple[int, int]] = None
                    ) -> List[str]:
-        cols = self._scan(time_range)
+        cols = self._scan(
+            time_range,
+            columns=("app_service_hash", "l7_protocol", "status"))
         if cols is None or not len(cols["l7_protocol"]):
             return []
         if tag == "service.name":

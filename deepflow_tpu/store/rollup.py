@@ -68,6 +68,27 @@ def _segment_reduce(seg: jnp.ndarray, mask: jnp.ndarray, data: jnp.ndarray,
     return jnp.stack(outs, axis=1)
 
 
+def _unique_rows(packed: np.ndarray):
+    """np.unique(axis=0) built from per-column argsorts: numpy's axis=0
+    unique argsorts a void view (memcmp per compare), which profiles 5-10x
+    slower than k stable i64 sorts at flow-map batch sizes. Returns
+    (unique_rows, inverse) with rows in lexicographic order, matching
+    np.unique's contract."""
+    n, k = packed.shape
+    if k == 1:
+        u, inv = np.unique(packed[:, 0], return_inverse=True)
+        return u[:, None], inv
+    order = np.lexsort(tuple(packed[:, j] for j in reversed(range(k))))
+    skeys = packed[order]
+    boundary = np.empty(n, np.bool_)
+    boundary[0] = True
+    np.any(skeys[1:] != skeys[:-1], axis=1, out=boundary[1:])
+    group_of_sorted = np.cumsum(boundary) - 1
+    inverse = np.empty(n, np.int64)
+    inverse[order] = group_of_sorted
+    return skeys[boundary], inverse
+
+
 def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
                  aggs: Dict[str, str],
                  return_inverse: bool = False):
@@ -85,7 +106,7 @@ def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
         return (empty, np.empty(0, np.int64)) if return_inverse else empty
     packed = np.stack([np.ascontiguousarray(cols[nm]).astype(np.int64)
                        for nm in key_names], axis=1)
-    uniq, inverse = np.unique(packed, axis=0, return_inverse=True)
+    uniq, inverse = _unique_rows(packed)
     n_groups = uniq.shape[0]
     value_names = list(aggs.keys())
     data = np.stack([np.asarray(cols[nm]).astype(np.int64)

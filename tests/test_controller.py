@@ -379,3 +379,23 @@ def test_genesis_stale_peer_domains_cleared():
     gs.merge({}, peer="http://a")
     assert model.list(type="host") == []
     assert gs.counters()["merged_domains"] == 0
+
+
+def test_genesis_failover_domain_not_cleared():
+    """A domain that failed over to this controller (now local) must not
+    be cleared when the old owner stops exporting it."""
+    from deepflow_tpu.controller.genesis_sync import GenesisSync
+    from deepflow_tpu.controller import ResourceModel
+    from deepflow_tpu.controller.model import make_resource
+
+    model = ResourceModel()
+    gs = GenesisSync(model)
+    rows = [{"type": "host", "id": 1, "name": "n1", "ip": "10.0.0.1"}]
+    gs.merge({"genesis/node-1": rows}, peer="http://a")
+    # agent fails over: this controller now hears node-1 first-hand
+    gs.mark_local("genesis/node-1")
+    model.update_domain("genesis/node-1", [
+        make_resource("host", 1, "n1", "genesis/node-1", ip="10.0.0.1")])
+    # old owner no longer exports the domain
+    gs.merge({}, peer="http://a")
+    assert len(model.list(type="host")) == 1   # first-hand data survives
