@@ -72,11 +72,20 @@ def decode_packets(frames: List[bytes],
     eth_type = _be16(mat, np.full(n, 12))
     l3_off = np.full(n, 14)
     vlan = eth_type == ETH_VLAN
+    vlan_id = np.zeros(n, np.uint32)
     if vlan.any():
         # 802.1Q: real ethertype 4 bytes later
         et2 = _be16(mat, np.full(n, 16))
+        vlan_id = np.where(vlan, _be16(mat, np.full(n, 14)) & 0x0FFF, 0)
         eth_type = np.where(vlan, et2, eth_type)
         l3_off = np.where(vlan, 18, l3_off)
+
+    # MACs: 6 bytes each, vectorized horner over the header matrix
+    mac_dst = np.zeros(n, np.uint64)
+    mac_src = np.zeros(n, np.uint64)
+    for k in range(6):
+        mac_dst = (mac_dst << np.uint64(8)) | mat[rows, k]
+        mac_src = (mac_src << np.uint64(8)) | mat[rows, 6 + k]
 
     valid = (eth_type == ETH_IPV4) & (lens >= l3_off + 20)
     ihl = (mat[rows, l3_off] & 0x0F).astype(np.int32) * 4
@@ -121,6 +130,8 @@ def decode_packets(frames: List[bytes],
         "payload_len": payload_len.astype(np.int32),
         "timestamp_ns": np.asarray(timestamps_ns, np.uint64),
         "tunneled": np.zeros(n, np.bool_),
+        "mac_src": mac_src, "mac_dst": mac_dst,
+        "vlan_id": vlan_id,
     }
 
     if decap_vxlan:
@@ -136,8 +147,13 @@ def decode_packets(frames: List[bytes],
                 inner_frames.append(frames[i][off:])
             inner = decode_packets(inner_frames,
                                    timestamps_ns[idxs], decap_vxlan=False)
+            # inner MACs replace the outer VTEP MACs: the flow the ip
+            # columns now describe belongs to the overlay VMs, and
+            # mirror-mode MAC filtering / tap_side orientation must see
+            # the same layer
             for name in ("valid", "ip_src", "ip_dst", "port_src",
-                         "port_dst", "proto", "tcp_flags", "tcp_seq"):
+                         "port_dst", "proto", "tcp_flags", "tcp_seq",
+                         "mac_src", "mac_dst"):
                 cols[name][idxs] = inner[name]
             # payload offsets are relative to the inner frame start
             cols["payload_off"][idxs] = inner["payload_off"] + \

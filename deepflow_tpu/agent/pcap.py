@@ -81,19 +81,44 @@ def write_pcap(path: str, frames: Sequence[bytes],
                nanosecond: bool = True) -> int:
     """Write Ethernet frames as a classic pcap file; returns frames
     written. Default nanosecond flavor keeps agent timestamps exact."""
-    magic = MAGIC_NS if nanosecond else MAGIC_US
-    div = 1 if nanosecond else 1000
-    with open(path, "wb") as f:
-        f.write(_FILE_HDR.pack(magic, 2, 4, 0, 0, 1 << 18,
-                               LINKTYPE_ETHERNET))
-        for i, frame in enumerate(frames):
-            ts = int(timestamps_ns[i]) if timestamps_ns is not None \
-                else i * 1_000_000
-            f.write(struct.pack("<IIII", ts // 1_000_000_000,
-                                (ts % 1_000_000_000) // div,
-                                len(frame), len(frame)))
-            f.write(frame)
-    return len(frames)
+    if timestamps_ns is None:
+        timestamps_ns = [i * 1_000_000 for i in range(len(frames))]
+    w = PcapWriter(path, nanosecond=nanosecond)
+    try:
+        return w.write(frames, timestamps_ns)
+    finally:
+        w.close()
+
+
+class PcapWriter:
+    """Streaming pcap writer (the PCAP policy-action sink and write_pcap's
+    engine): header once, records appended as they arrive."""
+
+    def __init__(self, path: str, nanosecond: bool = True) -> None:
+        self.path = path
+        self._div = 1 if nanosecond else 1000
+        self._f = open(path, "wb")
+        self._f.write(_FILE_HDR.pack(MAGIC_NS if nanosecond else MAGIC_US,
+                                     2, 4, 0, 0, 1 << 18,
+                                     LINKTYPE_ETHERNET))
+        self.frames_written = 0
+
+    def write(self, frames: Sequence[bytes],
+              timestamps_ns: Sequence[int]) -> int:
+        for frame, ts in zip(frames, timestamps_ns):
+            ts = int(ts)
+            self._f.write(struct.pack("<IIII", ts // 1_000_000_000,
+                                      (ts % 1_000_000_000) // self._div,
+                                      len(frame), len(frame)))
+            self._f.write(frame)
+        self.frames_written += len(frames)
+        return len(frames)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
 
 
 class PcapFrameSource:

@@ -24,8 +24,9 @@ from deepflow_tpu.agent.flow_map import FlowMap
 from deepflow_tpu.agent.guard import EscapeTimer, Guard
 from deepflow_tpu.agent.l7 import (MSG_REQUEST, SessionAggregator,
                                    parse_payload)
-from deepflow_tpu.agent.packet import PROTO_TCP, PROTO_UDP, decode_packets
-from deepflow_tpu.agent.policy import PolicyLabeler
+from deepflow_tpu.agent.packet import PROTO_TCP, PROTO_UDP
+from deepflow_tpu.agent.policy import (PolicyEnforcer,
+                                       PolicyLabeler)
 from deepflow_tpu.agent.quadruple import (documents_to_records,
                                           flows_to_documents)
 from deepflow_tpu.agent.sender import UniformSender
@@ -52,6 +53,11 @@ class AgentConfig:
     platform_sync_interval_s: float = 60.0
     k8s_resource_file: Optional[str] = None
     k8s_cluster_domain: str = "k8s-cluster"
+    # dispatcher (agent/dispatcher.py): capture mode + policy actions
+    dispatcher_mode: str = "local"
+    local_macs: tuple = ()
+    npb_addr: Optional[str] = None            # NPB action target
+    pcap_policy_dir: Optional[str] = None     # PCAP action sink
 
 
 def columns_to_l4_schema(cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -139,6 +145,14 @@ class Agent:
         self.vtap_id = 0
         self.flow_map = FlowMap()
         self.policy = PolicyLabeler()
+        from deepflow_tpu.agent.dispatcher import (Dispatcher,
+                                                   DispatcherConfig)
+        self.enforcer = PolicyEnforcer(self.policy, npb_addr=cfg.npb_addr,
+                                       pcap_dir=cfg.pcap_policy_dir)
+        self.dispatcher = Dispatcher(
+            DispatcherConfig(mode=cfg.dispatcher_mode,
+                             local_macs=set(cfg.local_macs)),
+            policy=self.policy, enforcer=self.enforcer)
         self.sessions = SessionAggregator()
         self.guard = Guard()
         self.escape = EscapeTimer(cfg.escape_after_s, self._on_escape)
@@ -209,7 +223,7 @@ class Agent:
     def feed(self, frames: List[bytes],
              timestamps_ns: Optional[np.ndarray] = None) -> int:
         """Ingest one capture batch; returns valid packets."""
-        pkt = decode_packets(frames, timestamps_ns)
+        pkt = self.dispatcher.dispatch(frames, timestamps_ns)
         with self._lock:
             self.flow_map.inject(pkt)
         if self.cfg.l7_enabled:
@@ -320,6 +334,7 @@ class Agent:
         for t in self._threads:
             t.join(timeout=2)
         self.tick()  # final flush
+        self.enforcer.close()
         self.guard.close()
         for s in self.senders.values():
             s.close()

@@ -117,6 +117,22 @@ class Server:
             # the server monitors itself through its own firehose
             addr = f"127.0.0.1:{ing_cfg.get('port', 30033)}"
             self.stats_shipper = StatsShipper(self.ingester.stats, addr)
+            if self.controller is not None:
+                # controller self-report rides the same DFSTATS loop
+                # (reference: controller statsd -> deepflow_system)
+                stats = self.ingester.stats
+                stats.register("controller.recorder",
+                               self.controller.recorder.counters)
+                stats.register("controller.genesis",
+                               self.controller.genesis_sync.counters)
+                stats.register(
+                    "controller.fleet",
+                    lambda: {"vtaps": len(self.registry.list()),
+                             "ingesters": len(self.monitor.ingesters()),
+                             "resources": len(self.model.list()),
+                             "model_version": self.model.version,
+                             "is_leader": int(self.election.is_leader)
+                             if self.election else 1})
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

@@ -1,0 +1,101 @@
+"""Dispatcher capture modes + policy NPB/PCAP/DROP actions."""
+
+import socket
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.dispatcher import (Dispatcher, DispatcherConfig,
+                                           MODE_ANALYZER, MODE_MIRROR)
+from deepflow_tpu.agent.packet import ACK, SYN
+from deepflow_tpu.agent.pcap import read_pcap
+from deepflow_tpu.agent.policy import (ACTION_DROP, ACTION_NPB, ACTION_PCAP,
+                                       AclRule, PolicyEnforcer,
+                                       PolicyLabeler)
+from tests.test_agent import CLIENT, SERVER, eth_ipv4_tcp, eth_ipv4_udp
+
+def _frames():
+    return [
+        eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, SYN, seq=1),
+        eth_ipv4_tcp(SERVER, CLIENT, 80, 40000, ACK, b"x", seq=2),
+        eth_ipv4_udp(CLIENT, SERVER, 5353, 53, b"q"),
+    ]
+
+
+def test_macs_and_vlan_decoded():
+    from deepflow_tpu.agent.packet import decode_packets
+
+    pkt = decode_packets([eth_ipv4_tcp(CLIENT, SERVER, 1, 2, ACK,
+                                       vlan=True)])
+    assert pkt["mac_dst"][0] == 0x020202020202
+    assert pkt["mac_src"][0] == 0x040404040404
+    assert pkt["vlan_id"][0] == 1
+
+
+def test_local_mode_orients_by_mac():
+    from deepflow_tpu.agent.packet import decode_packets
+
+    pkt = decode_packets(_frames())
+    src_mac = int(pkt["mac_src"][0])
+    d = Dispatcher(DispatcherConfig(local_macs={src_mac}))
+    out = d.dispatch(_frames())
+    # all helper frames share the same src mac -> all client-side
+    assert out["tap_side"].tolist() == [0, 0, 0]
+    assert out["l2_end_0"].all()
+
+
+def test_mirror_mode_filters_unmonitored():
+    from deepflow_tpu.agent.packet import decode_packets
+
+    pkt = decode_packets(_frames())
+    d = Dispatcher(DispatcherConfig(mode=MODE_MIRROR,
+                                    local_macs={0xDEADBEEF}))
+    out = d.dispatch(_frames())
+    assert not out["valid"].any()          # nothing touches monitored macs
+    d2 = Dispatcher(DispatcherConfig(mode=MODE_MIRROR,
+                                     local_macs={int(pkt["mac_src"][0])}))
+    assert d2.dispatch(_frames())["valid"].sum() == 3
+
+
+def test_analyzer_mode_tap_from_vlan():
+    d = Dispatcher(DispatcherConfig(mode=MODE_ANALYZER))
+    out = d.dispatch([eth_ipv4_tcp(CLIENT, SERVER, 1, 2, ACK, vlan=True)])
+    assert out["tap_type"].tolist() == [1]
+
+
+def test_policy_actions(tmp_path):
+    policy = PolicyLabeler([
+        AclRule(rule_id=1, port_min=53, port_max=53, action=ACTION_DROP),
+        AclRule(rule_id=2, port_min=80, port_max=80, action=ACTION_PCAP),
+    ])
+    enf = PolicyEnforcer(policy, pcap_dir=str(tmp_path / "caps"))
+    d = Dispatcher(DispatcherConfig(), policy=policy, enforcer=enf)
+    frames = _frames()
+    out = d.dispatch(frames, np.arange(3, dtype=np.uint64) * 10**6)
+    # DNS dropped, HTTP captured, all labeled
+    assert out["valid"].tolist() == [True, True, False]
+    assert out["policy_id"].tolist() == [2, 2, 1]
+    assert enf.dropped == 1 and enf.pcap_dumped == 2
+    enf.flush()
+    got = list(read_pcap(str(tmp_path / "caps" / "rule_2.pcap")))
+    assert [g[1] for g in got] == frames[:2]
+    enf.close()
+
+
+def test_npb_forwarding():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    port = rx.getsockname()[1]
+    policy = PolicyLabeler([AclRule(rule_id=9, protocol=6,
+                                    action=ACTION_NPB)])
+    enf = PolicyEnforcer(policy, npb_addr=f"127.0.0.1:{port}")
+    d = Dispatcher(DispatcherConfig(), policy=policy, enforcer=enf)
+    frames = _frames()
+    out = d.dispatch(frames)
+    assert out["valid"].all()              # NPB copies, never drops
+    got = {rx.recv(65535) for _ in range(2)}
+    assert got == set(frames[:2])
+    assert enf.npb_sent == 2
+    enf.close()
+    rx.close()

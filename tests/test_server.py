@@ -105,3 +105,40 @@ def test_config_reload(tmp_path):
         assert srv.querier is not None
     finally:
         srv.close()
+
+
+def test_controller_self_report(tmp_path):
+    """Controller counters ride the DFSTATS self-telemetry loop into
+    deepflow_system (reference: controller statsd report)."""
+    cfg = {
+        "controller": {"enabled": True, "port": 0,
+                       "lease_path": str(tmp_path / "lease.json")},
+        "ingester": {"port": 0, "store_path": str(tmp_path / "store")},
+        "querier": {"enabled": False},
+        "self_telemetry": True,
+    }
+    path = tmp_path / "server.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    srv = Server(str(path))
+    srv.start()
+    try:
+        _req(f"http://127.0.0.1:{srv.controller.port}/v1/sync",
+             body={"ctrl_ip": "10.1.1.1", "host": "h1"})
+        srv.ingester.stats.collect()   # one scrape -> shipper -> firehose
+        srv.stats_shipper.flush()      # push the buffered DFSTATS batch
+        table = srv.ingester.store.table("deepflow_system", "ext_samples")
+        deadline = time.time() + 10
+        found = set()
+        md = srv.ingester.tag_dicts.get("metric_name")
+        while time.time() < deadline:
+            srv.ingester.flush()
+            rows = table.scan()
+            found = {md.decode(int(h)) for h in set(rows["metric"].tolist())}
+            if any(f and f.startswith("controller.fleet") for f in found):
+                break
+            time.sleep(0.2)
+        assert any(f and f.startswith("controller.fleet.vtaps")
+                   for f in found), found
+        assert any(f and f.startswith("controller.recorder") for f in found)
+    finally:
+        srv.close()
