@@ -1,22 +1,38 @@
 """Headline benchmark: wire-bytes-in -> sketch-state-advanced, one chip.
 
-Three numbers, one JSON line:
+Numbers, one JSON line:
 
 - headline (`value`): END-TO-END records/s over the TPU-native columnar
   wire (wire/columnar_wire.py): planar frame payload -> host decode ->
   host->device transfer -> FlowSuite sketch update (plain CMS + sampled
-  top-K admission + HLL + entropy, one fused XLA program, donated state).
-  Decode+transfer are INSIDE the timed loop.
+  top-K admission + HLL + entropy, donated state). Decode+transfer are
+  INSIDE the timed loop. The update runs as the staged four-program
+  pipeline (flow_suite.make_staged_update) — see below.
 - `e2e_protobuf_records_per_sec`: the same loop fed by protobuf
   TaggedFlow payloads (the reference-agent compat wire) through the C++
   native decoder (decode/native_src/decoder.cc) into a reused buffer.
 - `kernel_records_per_sec`: device-resident batches only (the round-1
   number, kept for regression tracking).
+- `topk_recall_vs_exact`: top-100 heavy-hitter recall on the PRODUCTION
+  FlowSuiteConfig against an exact host GROUP BY over the stream.
+  vs_baseline is against BASELINE.json's 10M records/s.
 
-Plus the second north-star metric: `topk_recall_vs_exact` — top-100
-heavy-hitter recall on the PRODUCTION FlowSuiteConfig (plain CMS,
-1/16-sampled ring admission) against an exact host GROUP BY over the
-generated stream. vs_baseline is against BASELINE.json's 10M records/s.
+Remote-TPU (axon tunnel) caveat, measured and reported, not hidden:
+on the tunneled runtime, COMPILING certain executables — elementwise
+compares/selects consuming values produced by gather/sort/slice in the
+same program, and sometimes plain compare+blend kernels depending on
+backend state — trips a persistent process-wide slow mode in the
+transfer layer: every later host->device copy runs ~15-30x slower
+(~45 MB/s vs ~1 GB/s; latency 3.5ms -> 135ms). The sketch programs are
+written compare-free on moved data (ops/topk.py _not_sentinel) and the
+update is split into four programs to dodge the fusion trigger, but the
+pathology is backend-state-dependent, so the bench measures transfer
+health BEFORE any compile (`h2d_mb_s_fresh`) and AFTER
+(`h2d_mb_s_after_compile`) and flags `transfer_degraded`. When the flag
+is true, the e2e numbers are bounded by the degraded tunnel, not by this
+framework — kernel_records_per_sec remains the hardware-limited number,
+and the device-resident batches for it are staged while the link is
+still healthy.
 """
 
 from __future__ import annotations
@@ -62,6 +78,15 @@ def main() -> None:
     iters = 16
     rng = np.random.default_rng(0xBE7C)
 
+    def h2d_mb_s() -> float:
+        """Transfer-health probe: one 68MB host->device copy."""
+        probe = np.empty((17, batch), np.uint32)
+        t0 = time.perf_counter()
+        jax.block_until_ready(jnp.asarray(probe))
+        return probe.nbytes / 1e6 / (time.perf_counter() - t0)
+
+    h2d_fresh = h2d_mb_s()
+
     # -- stage: one pool of distinct flows, Zipf-picked record streams ----
     agent = SyntheticAgent()
     base = agent.l4_columns(pool_n)
@@ -71,15 +96,20 @@ def main() -> None:
     picks = [(rng.zipf(1.25, batch) - 1).clip(max=pool_n - 1)
              for _ in range(n_batches)]
     schema_batches = [{k: v[p] for k, v in pool_schema.items()}
-                      for p in picks]
+                     for p in picks]
     columnar_payloads = [columnar_wire.encode_columnar(c, SKETCH_L4_SCHEMA)
                          for c in schema_batches]
     pb_payloads = [pack_pb_records([pool_records[i] for i in p])
                    for p in picks]
     mask_d = jnp.asarray(np.ones(batch, dtype=np.bool_))
 
-    step = jax.jit(
-        lambda s, c, m: flow_suite.update(s, c, m, cfg), donate_argnums=0)
+    # device-resident batches for the kernel number are staged NOW, while
+    # the link is healthy (before any sketch-program compile)
+    dev_batches = [{k: jnp.asarray(v) for k, v in c.items()}
+                   for c in schema_batches]
+    jax.block_until_ready(dev_batches)
+
+    staged = flow_suite.make_staged_update(cfg)
 
     # -- recall: production config vs exact GROUP BY ----------------------
     # exact side: the device flow_key of every pool row (so both sides use
@@ -96,29 +126,30 @@ def main() -> None:
     exact_top = set(uniq_keys[order].tolist())
 
     state = flow_suite.init(cfg)
-    for payload in columnar_payloads:
-        cols, bad = columnar_wire.decode_columnar(payload, SKETCH_L4_SCHEMA)
-        assert bad == 0
-        state = step(state, {k: jnp.asarray(v) for k, v in cols.items()},
-                     mask_d)
+    for i in range(n_batches):
+        state = staged(state, dev_batches[i], mask_d)
     state, out = jax.jit(lambda s: flow_suite.flush(s, cfg))(state)
     got = set(np.asarray(out.topk_keys).tolist())
     recall = len(got & exact_top) / cfg.top_k
 
+    h2d_after_staged = h2d_mb_s()
+
     # -- timed: e2e columnar wire -> sketch --------------------------------
+    # (runs BEFORE the fused kernel program compiles: the staged programs
+    # are the transfer-friendly set, and compiling the big fused update
+    # can by itself trip the tunnel slow mode on some backends)
+    def col_step(state, payload):
+        cols, _ = columnar_wire.decode_columnar(payload, SKETCH_L4_SCHEMA)
+        return staged(state,
+                      {k: jnp.asarray(v) for k, v in cols.items()}, mask_d)
+
     state = flow_suite.init(cfg)
     for i in range(warmup):
-        cols, _ = columnar_wire.decode_columnar(
-            columnar_payloads[i % n_batches], SKETCH_L4_SCHEMA)
-        state = step(state, {k: jnp.asarray(v) for k, v in cols.items()},
-                     mask_d)
+        state = col_step(state, columnar_payloads[i % n_batches])
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     for i in range(iters):
-        cols, _ = columnar_wire.decode_columnar(
-            columnar_payloads[i % n_batches], SKETCH_L4_SCHEMA)
-        state = step(state, {k: jnp.asarray(v) for k, v in cols.items()},
-                     mask_d)
+        state = col_step(state, columnar_payloads[i % n_batches])
     jax.block_until_ready(state)
     e2e_rate = batch * iters / (time.perf_counter() - t0)
 
@@ -143,8 +174,9 @@ def main() -> None:
                 col = buf32[j, :rows]
                 cols[name] = col.view(np.int32) \
                     if np.dtype(dt) == np.int32 else col
-            return step(state, {k: jnp.asarray(v) for k, v in cols.items()},
-                        mask_d)
+            return staged(state,
+                          {k: jnp.asarray(v) for k, v in cols.items()},
+                          mask_d)
 
         state = flow_suite.init(cfg)
         for i in range(warmup):
@@ -156,9 +188,9 @@ def main() -> None:
         jax.block_until_ready(state)
         pb_rate = batch * iters / (time.perf_counter() - t0)
 
-    # -- timed: kernel only (device-resident batches) ----------------------
-    dev_batches = [{k: jnp.asarray(v) for k, v in c.items()}
-                   for c in schema_batches]
+    # -- timed: kernel only (device-resident batches, fused program) -------
+    step = jax.jit(
+        lambda s, c, m: flow_suite.update(s, c, m, cfg), donate_argnums=0)
     state = flow_suite.init(cfg)
     for i in range(warmup):
         state = step(state, dev_batches[i % n_batches], mask_d)
@@ -168,6 +200,7 @@ def main() -> None:
         state = step(state, dev_batches[i % n_batches], mask_d)
     jax.block_until_ready(state)
     kernel_rate = batch * iters / (time.perf_counter() - t0)
+    h2d_after = h2d_mb_s()
 
     print(json.dumps({
         "metric": "l4_e2e_wire_to_sketch_records_per_sec_per_chip",
@@ -178,6 +211,10 @@ def main() -> None:
         "kernel_records_per_sec": round(kernel_rate),
         "topk_recall_vs_exact": round(recall, 4),
         "recall_target": 0.99,
+        "h2d_mb_s_fresh": round(h2d_fresh),
+        "h2d_mb_s_after_staged_compile": round(h2d_after_staged),
+        "h2d_mb_s_after_fused_compile": round(h2d_after),
+        "transfer_degraded": bool(h2d_after_staged < h2d_fresh / 3),
     }))
 
 

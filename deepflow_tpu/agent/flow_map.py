@@ -116,6 +116,7 @@ class FlowMap:
         self.c_max_seq = z64((cap, 2))
         self.c_syn = z64(cap)            # 0 = unset
         self.c_synack = z64(cap)
+        self.c_tap_side = z64(cap)
         self.c_initiator = np.full(cap, -1, np.int8)
         self.c_reported = np.zeros(cap, np.bool_)
         self.c_live = np.zeros(cap, np.bool_)
@@ -124,7 +125,7 @@ class FlowMap:
         old = {k: getattr(self, k) for k in (
             "c_key", "c_flow_id", "c_start", "c_last", "c_bytes", "c_pkts",
             "c_flags", "c_retrans", "c_max_seq", "c_syn", "c_synack",
-            "c_initiator", "c_reported", "c_live")}
+            "c_tap_side", "c_initiator", "c_reported", "c_live")}
         n = self._cap
         self._alloc_cols(self._cap * 2)
         for k, v in old.items():
@@ -153,6 +154,7 @@ class FlowMap:
         self.c_max_seq[s] = 0
         self.c_syn[s] = 0
         self.c_synack[s] = 0
+        self.c_tap_side[s] = 0
         self.c_initiator[s] = -1
         self.c_reported[s] = False
         self.c_live[s] = True
@@ -181,6 +183,9 @@ class FlowMap:
         direction = rev.astype(np.uint32)
 
         ts = cols["timestamp_ns"].astype(np.int64)
+        tap_side = cols.get("tap_side")
+        if tap_side is None:
+            tap_side = np.zeros(n, np.int64)
         flags = cols["tcp_flags"].astype(np.int64)
         is_syn = (flags & (SYN | ACK)) == SYN
         is_synack = (flags & (SYN | ACK)) == (SYN | ACK)
@@ -202,6 +207,7 @@ class FlowMap:
             "syn_ts": np.where(is_syn, ts, _BIG),
             "synack_ts": np.where(is_synack, ts, _BIG),
             "seq_max": cols["tcp_seq"].astype(np.int64),
+            "tap_side": tap_side.astype(np.int64),
             # payload packets whose seq never advances past the running max
             # are the batch-local retrans candidates; cross-batch handled
             # against the accumulator's max_seq at merge time
@@ -211,7 +217,8 @@ class FlowMap:
             work, ["k_ips", "k_rest"],
             {"bytes": "sum", "pkts": "sum", "flags": "max",
              "ts_min": "min", "ts_max": "max", "syn_ts": "min",
-             "synack_ts": "min", "seq_max": "max", "payload_pkts": "sum"},
+             "synack_ts": "min", "seq_max": "max", "payload_pkts": "sum",
+             "tap_side": "max"},
             return_inverse=True)
         # flags need OR, not max: OR-reduce per group on host, reusing the
         # group ids from the reduction (group count << packet count)
@@ -253,6 +260,9 @@ class FlowMap:
         self.c_max_seq[slots, d] = np.maximum(prev_max, seq)
         np.minimum.at(self.c_start, slots, red["ts_min"])
         np.maximum.at(self.c_last, slots, red["ts_max"])
+        # capture-point side (dispatcher MAC orientation) — constant per
+        # observation point, so max-merge is exact
+        np.maximum.at(self.c_tap_side, slots, red["tap_side"])
         # handshake stamps: 0 means unset — lift touched slots to +inf
         # BEFORE the min-scatter (min against a 0 target would stick), and
         # lower the never-set ones back after
@@ -321,7 +331,7 @@ class FlowMap:
             "start_time": self.c_start[idx].astype(np.uint64),
             "duration": np.maximum(self.c_last[idx] - self.c_start[idx],
                                    0).astype(np.uint64),
-            "tap_side": np.zeros(len(idx), np.uint32),
+            "tap_side": self.c_tap_side[idx].astype(np.uint32),
             "l3_epc_id": np.zeros(len(idx), np.int32),
             "is_new_flow": (~self.c_reported[idx]).astype(np.uint32),
         }

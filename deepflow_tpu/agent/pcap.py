@@ -105,6 +105,9 @@ class PcapWriter:
 
     def write(self, frames: Sequence[bytes],
               timestamps_ns: Sequence[int]) -> int:
+        if len(frames) != len(timestamps_ns):
+            raise ValueError(f"{len(frames)} frames vs "
+                             f"{len(timestamps_ns)} timestamps")
         for frame, ts in zip(frames, timestamps_ns):
             ts = int(ts)
             self._f.write(struct.pack("<IIII", ts // 1_000_000_000,

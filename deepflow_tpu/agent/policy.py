@@ -138,7 +138,9 @@ class PolicyEnforcer:
         for r in self.policy.rules:
             act_of[r.rule_id] = r.action
         acts = act_of[np.minimum(rule_ids, max_id)]
-        acts[rule_ids == 0] = 0
+        # unknown/stale ids (hot rule reload between lookup and apply)
+        # get NO action, not the highest rule's
+        acts[(rule_ids == 0) | (rule_ids > max_id)] = 0
         drop = acts == ACTION_DROP
         keep &= ~drop
         self.dropped += int(drop.sum())

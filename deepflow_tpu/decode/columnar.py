@@ -340,8 +340,14 @@ def decode_otel_frames(payloads: Iterable[bytes],
     return _fill(L7_SCHEMA, rows), bad
 
 
-def decode_metric_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
-    """Parse metric Document records into METRIC_SCHEMA columns."""
+_METRIC_NAMES = METRIC_SCHEMA.names
+
+
+def decode_metric_records(records: Iterable[bytes],
+                          endpoint_dict=None) -> Dict[str, np.ndarray]:
+    """Parse metric Document records into METRIC_SCHEMA columns — the full
+    zerodoc tag+meter model (MiniTag dimensions, Traffic/Latency/
+    Performance/Anomaly meters, AppMeter l7 counters)."""
     rows: List[tuple] = []
     for raw in records:
         d = metric_pb2.Document()
@@ -350,16 +356,67 @@ def decode_metric_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
         except Exception:
             continue
         fld = d.tag.field
-        ip = int.from_bytes(fld.ip, "big") if fld.ip else 0
+        ip = _fnv1a32(fld.ip) if len(fld.ip) == 16 else (
+            int.from_bytes(fld.ip, "big") if fld.ip else 0)
         t = d.meter.flow.traffic
         p = d.meter.flow.performance
         lat = d.meter.flow.latency
-        rows.append((
-            d.timestamp, _u32(ip), fld.server_port, fld.vtap_id, fld.protocol,
-            _u32(t.packet_tx), _u32(t.packet_rx),
-            _u32(t.byte_tx), _u32(t.byte_rx),
-            _u32(t.new_flow), _u32(t.closed_flow), t.syn, t.synack,
-            _u32(p.retrans_tx), _u32(p.retrans_rx),
-            _u32(lat.rtt_sum), lat.rtt_count,
-        ))
+        an = d.meter.flow.anomaly
+        app = d.meter.app
+        v = {
+            "timestamp": d.timestamp,
+            "ip": _u32(ip), "server_port": fld.server_port,
+            "vtap_id": fld.vtap_id, "protocol": fld.protocol,
+            "l3_epc_id": _u32(fld.l3_epc_id),
+            "direction": fld.direction, "tap_side": fld.tap_side,
+            "tap_type": fld.tap_type, "tap_port": _u32(fld.tap_port),
+            "l7_protocol": fld.l7_protocol,
+            "gprocess_id": fld.gpid,
+            "signal_source": fld.signal_source,
+            "pod_id": fld.pod_id,
+            "app_service_hash": _hash_str(fld.app_service, endpoint_dict),
+            "endpoint_hash": _hash_str(fld.endpoint, endpoint_dict),
+            "packet_tx": _u32(t.packet_tx), "packet_rx": _u32(t.packet_rx),
+            "byte_tx": _u32(t.byte_tx), "byte_rx": _u32(t.byte_rx),
+            "l3_byte_tx": _u32(t.l3_byte_tx),
+            "l3_byte_rx": _u32(t.l3_byte_rx),
+            "l4_byte_tx": _u32(t.l4_byte_tx),
+            "l4_byte_rx": _u32(t.l4_byte_rx),
+            "new_flow": _u32(t.new_flow),
+            "closed_flow": _u32(t.closed_flow),
+            "l7_request": t.l7_request or app.traffic.request,
+            "l7_response": t.l7_response or app.traffic.response,
+            "syn": t.syn, "synack": t.synack,
+            "rtt_sum": _u32(lat.rtt_sum), "rtt_count": lat.rtt_count,
+            "rtt_max": lat.rtt_max,
+            "rtt_client_sum": _u32(lat.rtt_client_sum),
+            "rtt_client_count": lat.rtt_client_count,
+            "rtt_server_sum": _u32(lat.rtt_server_sum),
+            "rtt_server_count": lat.rtt_server_count,
+            "srt_sum": _u32(lat.srt_sum), "srt_count": lat.srt_count,
+            "srt_max": lat.srt_max,
+            "art_sum": _u32(lat.art_sum), "art_count": lat.art_count,
+            "art_max": lat.art_max,
+            "rrt_sum": _u32(lat.rrt_sum), "rrt_count": lat.rrt_count,
+            "rrt_max": lat.rrt_max,
+            "cit_sum": _u32(lat.cit_sum), "cit_count": lat.cit_count,
+            "cit_max": lat.cit_max,
+            "retrans_tx": _u32(p.retrans_tx),
+            "retrans_rx": _u32(p.retrans_rx),
+            "zero_win_tx": _u32(p.zero_win_tx),
+            "zero_win_rx": _u32(p.zero_win_rx),
+            "retrans_syn": p.retrans_syn,
+            "retrans_synack": p.retrans_synack,
+            "client_rst_flow": _u32(an.client_rst_flow),
+            "server_rst_flow": _u32(an.server_rst_flow),
+            "client_syn_repeat": _u32(an.client_syn_repeat),
+            "server_synack_repeat": _u32(an.server_synack_repeat),
+            "client_half_close_flow": _u32(an.client_half_close_flow),
+            "server_half_close_flow": _u32(an.server_half_close_flow),
+            "tcp_timeout": _u32(an.tcp_timeout),
+            "l7_client_error": an.l7_client_error,
+            "l7_server_error": an.l7_server_error,
+            "l7_timeout": an.l7_timeout,
+        }
+        rows.append(tuple(v[n] for n in _METRIC_NAMES))
     return _fill(METRIC_SCHEMA, rows)

@@ -121,6 +121,69 @@ def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
     )
 
 
+def make_staged_update(cfg: FlowSuiteConfig):
+    """`update` as a chain of four small jitted programs — the remote-TPU
+    (tunnel) form of the hot loop.
+
+    Why: on the tunneled runtime, merely COMPILING an executable whose
+    elementwise compares (==, minimum, where) consume values produced by
+    data-movement ops (gather/sort/strided-slice) in the SAME executable
+    trips a persistent process-wide slow mode in the transfer layer —
+    every later host->device copy runs ~15-30x slower (verified by
+    bisection; compile alone suffices, and compares on program INPUTS are
+    harmless). The fused `update` contains exactly that pattern in the
+    ring-admission path, so here each compare-bearing stage is its own
+    program whose moved operands arrive as fresh inputs:
+
+      S1 movement: sketches advance + candidate concat + CMS gather
+      S2 compare : sentinel blend (inputs only)
+      S3 movement: two-key sort
+      S4 compare+movement: run-boundary blend (on S3's output as input),
+                   top_k, gather
+
+    Intermediate values stay on device between stages; the extra cost is
+    three dispatch round-trips per batch. Single-chip local runtimes can
+    keep using the fused `update`.
+    """
+    sl = cfg.topk_sample_log2
+
+    def s1_core(state, cols, mask):
+        fkey = flow_key(cols)
+        skey = service_key(cols)
+        upd = cms.update_conservative if cfg.conservative else cms.update
+        sketch = upd(state.sketch, fkey, mask=mask)
+        all_keys = topk.candidate_keys(state.ring.keys, fkey, mask=mask,
+                                       sample_log2=sl,
+                                       phase=state.batches_seen)
+        est = cms.query(sketch, all_keys)
+        group = (skey % np.uint32(cfg.hll_groups)).astype(jnp.int32)
+        services = hll.update(state.services, group, cols["ip_src"],
+                              mask=mask)
+        feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
+        packets = cols["packet_tx"] + cols["packet_rx"]
+        ent = entropy.update(state.ent, feats, packets.astype(jnp.int32),
+                             mask, weight_planes=2)
+        mid = FlowSuiteState(
+            sketch=sketch, ring=state.ring, services=services, ent=ent,
+            rows_seen=state.rows_seen + jnp.sum(mask.astype(jnp.int32)),
+            batches_seen=state.batches_seen + 1)
+        return mid, all_keys, est
+
+    j1 = jax.jit(s1_core, donate_argnums=0)
+    j2 = jax.jit(topk.blend_counts)
+    j3 = jax.jit(topk.sort_pairs)
+    j4 = jax.jit(lambda k, c: topk.select_ring(k, c, cfg.ring_size))
+
+    def staged_update(state: FlowSuiteState, cols, mask) -> FlowSuiteState:
+        mid, ak, est = j1(state, cols, mask)
+        ac = j2(ak, est)
+        k, c = j3(ak, ac)
+        ring = j4(k, c)
+        return mid._replace(ring=ring)
+
+    return staged_update
+
+
 def flush(state: FlowSuiteState, cfg: FlowSuiteConfig
           ) -> Tuple[FlowSuiteState, FlowWindowOutput]:
     """Read window outputs, then reset window-scoped state."""

@@ -36,19 +36,75 @@ def init(ring_size: int) -> TopKState:
     )
 
 
-def _dedup_keep_max(keys: jnp.ndarray, counts: jnp.ndarray):
-    """Sort by key; on equal runs keep the max count on one lane, -1 on rest."""
-    order = jnp.argsort(keys)
-    k = keys[order]
-    c = counts[order]
-    # Segment-max over equal-key runs, written back to the run's first lane.
-    first = jnp.concatenate([jnp.ones((1,), jnp.bool_), k[1:] != k[:-1]])
-    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
-    seg_max = jax.ops.segment_max(c, seg, num_segments=k.shape[0])
-    c = jnp.where(first, seg_max[seg], -1)
-    k = jnp.where(first, k, SENTINEL)       # blank duplicate lanes entirely
-    c = jnp.where(k == SENTINEL, -1, c)
+def _not_sentinel(keys: jnp.ndarray) -> jnp.ndarray:
+    """[n] int32 1 where key != SENTINEL, else 0 — WITHOUT a compare op.
+
+    Load-bearing on the remote-TPU runtime: merely COMPILING a program
+    whose elementwise compares consume gather/sort/strided-slice outputs
+    trips a persistent slow mode in the tunnel's transfer layer (every
+    later host->device copy runs ~15-30x slow for the process; verified
+    by bisection — compile alone suffices, compares on plain inputs are
+    fine). The ring path is exactly such a program, so every predicate on
+    moved data here is arithmetic: SENTINEL is u32 max, so
+    min(SENTINEL - k, 1) is 0 iff k == SENTINEL."""
+    return jnp.minimum(SENTINEL - keys, jnp.uint32(1)).astype(jnp.int32)
+
+
+def _dedup_sorted(k: jnp.ndarray, c: jnp.ndarray):
+    """Dedup ALREADY-SORTED (key, count) pairs: within an equal-key run
+    counts sort ascending, so the run's LAST lane already holds the max —
+    no segment-max scatter, no cumsum. Run boundaries are detected
+    arithmetically (sorted ascending => k[i+1] - k[i] is 0 iff equal),
+    never with a compare: see _not_sentinel."""
+    diff = jnp.minimum(k[1:] - k[:-1], jnp.uint32(1))
+    last_u = jnp.concatenate([diff, jnp.ones((1,), jnp.uint32)])
+    last_i = last_u.astype(jnp.int32) * _not_sentinel(k)
+    # k where last-of-run, SENTINEL elsewhere; c where kept, -1 elsewhere
+    k = k * last_u + SENTINEL * (jnp.uint32(1) - last_u)
+    c = last_i * (c + 1) - 1
     return k, c
+
+
+def _dedup_keep_max(keys: jnp.ndarray, counts: jnp.ndarray):
+    """Sort by key; on equal runs keep the max count on one lane, -1 on
+    rest (one two-key sort + arithmetic boundary detect)."""
+    k, c = jax.lax.sort((keys, counts), num_keys=2)
+    return _dedup_sorted(k, c)
+
+
+def candidate_keys(state_keys: jnp.ndarray, batch_keys: jnp.ndarray,
+                   mask: jnp.ndarray | None = None, sample_log2: int = 0,
+                   phase: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """Standing ring keys + (sampled) batch keys — the movement half of
+    admission, shared by offer() and the staged pipeline."""
+    bk = batch_keys.astype(jnp.uint32)
+    if mask is not None:
+        bk = jnp.where(mask, bk, SENTINEL)
+    if sample_log2 > 0:
+        bk = jnp.roll(bk, -(jnp.asarray(phase) % (1 << sample_log2)))
+        bk = bk[:: 1 << sample_log2]
+    return jnp.concatenate([state_keys, bk])
+
+
+def blend_counts(all_keys: jnp.ndarray, est: jnp.ndarray) -> jnp.ndarray:
+    """est where the key is live, -1 at sentinels — compare-free."""
+    live = _not_sentinel(all_keys)
+    return live * (est.astype(jnp.int32) + 1) - 1
+
+
+def sort_pairs(all_keys: jnp.ndarray, all_counts: jnp.ndarray):
+    """Two-key lexicographic sort (movement only, no compares)."""
+    return jax.lax.sort((all_keys, all_counts), num_keys=2)
+
+
+def select_ring(k: jnp.ndarray, c: jnp.ndarray,
+                ring_size: int) -> TopKState:
+    """Dedup (last-of-run on the sorted pairs) + top_k compaction.
+    Compares here touch only this function's inputs — the staged pipeline
+    relies on that (see flow_suite.make_staged_update)."""
+    k2, c2 = _dedup_sorted(k, c)
+    top_c, top_i = jax.lax.top_k(c2, ring_size)
+    return TopKState(keys=k2[top_i], counts=top_c)
 
 
 def offer(state: TopKState, batch_keys: jnp.ndarray, sketch: cms.CMSState,
@@ -68,24 +124,14 @@ def offer(state: TopKState, batch_keys: jnp.ndarray, sketch: cms.CMSState,
     per-batch counter so lane positions correlated with the stride (e.g.
     round-robin packers upstream) still get admitted over a window.
     """
-    bk = batch_keys.astype(jnp.uint32)
-    if mask is not None:
-        bk = jnp.where(mask, bk, SENTINEL)
-    if sample_log2 > 0:
-        bk = jnp.roll(bk, -(jnp.asarray(phase) % (1 << sample_log2)))
-        bk = bk[:: 1 << sample_log2]
     # Standing candidates get re-scored too (their CMS estimates only
     # grow), in the SAME query as the batch keys: one concat + one gather
-    # instead of a separate ring-sized pass. Besides saving a gather,
-    # keeping ring-shaped work off its own tiny fusion matters on the
-    # remote-TPU runtime: standalone [ring]-sized select kernels trip a
-    # pathological slow mode in the transfer layer (see bench.py notes).
-    all_keys = jnp.concatenate([state.keys, bk])
-    est = cms.query(sketch, all_keys).astype(jnp.int32)
-    all_counts = jnp.where(all_keys == SENTINEL, -1, est)
-    k, c = _dedup_keep_max(all_keys, all_counts)
-    top_c, top_i = jax.lax.top_k(c, state.keys.shape[0])
-    return TopKState(keys=k[top_i], counts=top_c)
+    # instead of a separate ring-sized pass.
+    all_keys = candidate_keys(state.keys, batch_keys, mask, sample_log2,
+                              phase)
+    est = cms.query(sketch, all_keys)
+    k, c = sort_pairs(all_keys, blend_counts(all_keys, est))
+    return select_ring(k, c, state.keys.shape[0])
 
 
 def result(state: TopKState, k: int):

@@ -120,9 +120,11 @@ class ShardedFlowSuite:
             merged = _merge_axis0(state)
             # Re-score ring candidates against the globally-merged sketch:
             # per-shard estimates only saw 1/n_devices of the stream.
-            rescored = jnp.where(
-                merged.ring.keys == topk.SENTINEL, -1,
-                cms.query(merged.sketch, merged.ring.keys).astype(jnp.int32))
+            # (compare-free sentinel mask: see topk._not_sentinel)
+            est = cms.query(merged.sketch,
+                            merged.ring.keys).astype(jnp.int32)
+            live = topk._not_sentinel(merged.ring.keys)
+            rescored = live * (est + 1) - 1
             merged = merged._replace(
                 ring=merged.ring._replace(counts=rescored))
             fresh, out = flow_suite.flush(merged, cfg_)

@@ -102,14 +102,33 @@ L7_TABLE = TableSchema(
     ttl_seconds=3 * 24 * 3600,
 )
 
-_METRIC_KEYS = {"timestamp", "ip", "server_port", "vtap_id", "protocol"}
+_METRIC_KEYS = {"timestamp", "ip", "server_port", "vtap_id", "protocol",
+                "l3_epc_id", "direction", "tap_side", "tap_type",
+                "tap_port", "l7_protocol", "gprocess_id", "signal_source",
+                "pod_id", "app_service_hash", "endpoint_hash"}
 _METRIC_AGG = {
-    "packet_tx": AggKind.SUM, "packet_rx": AggKind.SUM,
-    "byte_tx": AggKind.SUM, "byte_rx": AggKind.SUM,
-    "new_flow": AggKind.SUM, "closed_flow": AggKind.SUM,
-    "syn": AggKind.SUM, "synack": AggKind.SUM,
-    "retrans_tx": AggKind.SUM, "retrans_rx": AggKind.SUM,
-    "rtt_sum": AggKind.SUM, "rtt_count": AggKind.SUM,
+    # every meter counter sums across rollup windows except the *_max
+    # latency quantiles (zerodoc ConcurrentMerge: sums + maxes)
+    name: (AggKind.MAX if name.endswith("_max") else AggKind.SUM)
+    for name in (
+        "packet_tx", "packet_rx", "byte_tx", "byte_rx",
+        "l3_byte_tx", "l3_byte_rx", "l4_byte_tx", "l4_byte_rx",
+        "new_flow", "closed_flow", "l7_request", "l7_response",
+        "syn", "synack",
+        "rtt_sum", "rtt_count", "rtt_max",
+        "rtt_client_sum", "rtt_client_count",
+        "rtt_server_sum", "rtt_server_count",
+        "srt_sum", "srt_count", "srt_max",
+        "art_sum", "art_count", "art_max",
+        "rrt_sum", "rrt_count", "rrt_max",
+        "cit_sum", "cit_count", "cit_max",
+        "retrans_tx", "retrans_rx", "zero_win_tx", "zero_win_rx",
+        "retrans_syn", "retrans_synack",
+        "client_rst_flow", "server_rst_flow",
+        "client_syn_repeat", "server_synack_repeat",
+        "client_half_close_flow", "server_half_close_flow",
+        "tcp_timeout", "l7_client_error", "l7_server_error", "l7_timeout",
+    )
 }
 
 # reference table name: flow_metrics."vtap_flow_port.1s"

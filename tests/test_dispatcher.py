@@ -99,3 +99,20 @@ def test_npb_forwarding():
     assert enf.npb_sent == 2
     enf.close()
     rx.close()
+
+
+def test_tap_side_threads_through_flow_output():
+    """Dispatcher MAC orientation reaches the flow tick output
+    (dispatch -> flow map -> tap_side column)."""
+    from deepflow_tpu.agent.flow_map import FlowMap
+    from deepflow_tpu.agent.packet import decode_packets
+
+    frames = [eth_ipv4_tcp(CLIENT, SERVER, 40000, 80, SYN, seq=1)]
+    mac = int(decode_packets(frames)["mac_src"][0])
+    d = Dispatcher(DispatcherConfig(local_macs={0x999999}))  # not ours
+    fm = FlowMap()
+    pkt = d.dispatch(frames, np.array([10**18], np.uint64))
+    assert pkt["tap_side"].tolist() == [1]     # src mac unknown -> server
+    fm.inject(pkt)
+    cols = fm.tick_columns(now_ns=10**18 + 10**9)
+    assert cols["tap_side"].tolist() == [1]
