@@ -20,6 +20,7 @@ stashes over queues, we merge sketch pytrees with ICI collectives
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Dict, NamedTuple, Tuple
 
@@ -93,16 +94,15 @@ def service_key(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     return fold_columns([cols["ip_dst"], cols["port_dst"], cols["proto"]])
 
 
-def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
-           mask: jnp.ndarray, cfg: FlowSuiteConfig) -> FlowSuiteState:
-    """Advance all sketches by one static-shape batch. Fully jittable."""
+def _advance_sketches(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
+                      mask: jnp.ndarray, cfg: FlowSuiteConfig):
+    """Everything except ring admission — shared by the fused `update`
+    and the staged pipeline so the two paths cannot drift. Returns the
+    advanced state (ring untouched) plus the batch flow keys."""
     fkey = flow_key(cols)
     skey = service_key(cols)
     upd = cms.update_conservative if cfg.conservative else cms.update
     sketch = upd(state.sketch, fkey, mask=mask)
-    ring = topk.offer(state.ring, fkey, sketch, mask=mask,
-                      sample_log2=cfg.topk_sample_log2,
-                      phase=state.batches_seen)
     group = (skey % np.uint32(cfg.hll_groups)).astype(jnp.int32)
     services = hll.update(state.services, group, cols["ip_src"], mask=mask)
     feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
@@ -111,14 +111,25 @@ def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
     # (ample for 1s flow ticks); the third plane cost a full matmul pass
     ent = entropy.update(state.ent, feats, packets.astype(jnp.int32), mask,
                          weight_planes=2)
-    return FlowSuiteState(
+    mid = FlowSuiteState(
         sketch=sketch,
-        ring=ring,
+        ring=state.ring,
         services=services,
         ent=ent,
         rows_seen=state.rows_seen + jnp.sum(mask.astype(jnp.int32)),
         batches_seen=state.batches_seen + 1,
     )
+    return mid, fkey
+
+
+def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
+           mask: jnp.ndarray, cfg: FlowSuiteConfig) -> FlowSuiteState:
+    """Advance all sketches by one static-shape batch. Fully jittable."""
+    mid, fkey = _advance_sketches(state, cols, mask, cfg)
+    ring = topk.offer(state.ring, fkey, mid.sketch, mask=mask,
+                      sample_log2=cfg.topk_sample_log2,
+                      phase=state.batches_seen)
+    return mid._replace(ring=ring)
 
 
 def make_staged_update(cfg: FlowSuiteConfig):
@@ -148,25 +159,11 @@ def make_staged_update(cfg: FlowSuiteConfig):
     sl = cfg.topk_sample_log2
 
     def s1_core(state, cols, mask):
-        fkey = flow_key(cols)
-        skey = service_key(cols)
-        upd = cms.update_conservative if cfg.conservative else cms.update
-        sketch = upd(state.sketch, fkey, mask=mask)
+        mid, fkey = _advance_sketches(state, cols, mask, cfg)
         all_keys = topk.candidate_keys(state.ring.keys, fkey, mask=mask,
                                        sample_log2=sl,
                                        phase=state.batches_seen)
-        est = cms.query(sketch, all_keys)
-        group = (skey % np.uint32(cfg.hll_groups)).astype(jnp.int32)
-        services = hll.update(state.services, group, cols["ip_src"],
-                              mask=mask)
-        feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
-        packets = cols["packet_tx"] + cols["packet_rx"]
-        ent = entropy.update(state.ent, feats, packets.astype(jnp.int32),
-                             mask, weight_planes=2)
-        mid = FlowSuiteState(
-            sketch=sketch, ring=state.ring, services=services, ent=ent,
-            rows_seen=state.rows_seen + jnp.sum(mask.astype(jnp.int32)),
-            batches_seen=state.batches_seen + 1)
+        est = cms.query(mid.sketch, all_keys)
         return mid, all_keys, est
 
     j1 = jax.jit(s1_core, donate_argnums=0)
@@ -176,9 +173,17 @@ def make_staged_update(cfg: FlowSuiteConfig):
 
     def staged_update(state: FlowSuiteState, cols, mask) -> FlowSuiteState:
         mid, ak, est = j1(state, cols, mask)
-        ac = j2(ak, est)
-        k, c = j3(ak, ac)
-        ring = j4(k, c)
+        try:
+            k, c = j3(ak, j2(ak, est))
+            ring = j4(k, c)
+        except Exception:
+            # j1 already donated the old state; mid is the only valid
+            # state left. Skip this batch's ring admission (standing
+            # candidates rescore from the full sketch next batch) rather
+            # than leaving the caller holding deleted buffers.
+            logging.getLogger(__name__).exception(
+                "staged ring admission failed; batch skipped")
+            return mid
         return mid._replace(ring=ring)
 
     return staged_update

@@ -64,6 +64,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                  window_seconds: float = 1.0,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1,
+                 staged: Optional[bool] = None,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
                          batch=64, stats=stats)
@@ -101,9 +102,18 @@ class TpuSketchExporter(QueueWorkerExporter):
                 batch_rows=1024, flush_interval=5.0)
         import jax
 
-        self._update = jax.jit(
-            lambda s, c, m: flow_suite.update(s, c, m, self.cfg),
-            donate_argnums=0)
+        # staged four-program update on tunneled remote-TPU backends
+        # (transfer-safe; see flow_suite.make_staged_update), fused
+        # single-program update elsewhere (cheaper dispatch, full fusion)
+        if staged is None:
+            staged = jax.default_backend() == "axon"
+        self.staged = staged
+        if staged:
+            self._update = flow_suite.make_staged_update(self.cfg)
+        else:
+            self._update = jax.jit(
+                lambda s, c, m: flow_suite.update(s, c, m, self.cfg),
+                donate_argnums=0)
         # NOT donated: the pre-flush state is also the checkpoint payload
         self._flush_fn = jax.jit(lambda s: flow_suite.flush(s, self.cfg))
         self.rows_in = 0

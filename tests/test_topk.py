@@ -82,3 +82,31 @@ def test_sampled_admission_recall_production_path(rng):
     want = set(uniq[np.argsort(counts)[::-1][:k]].tolist())
     recall = len(got & want) / k
     assert recall >= 0.98, recall
+
+
+def test_staged_update_equals_fused():
+    """flow_suite.make_staged_update (the transfer-safe four-program
+    pipeline the tpu_sketch exporter uses on tunneled backends) produces
+    bit-identical state to the fused update."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
+    from deepflow_tpu.models import flow_suite
+
+    cfg = flow_suite.FlowSuiteConfig(cms_log2_width=12, ring_size=256,
+                                     hll_groups=32, hll_precision=6,
+                                     entropy_log2_buckets=6)
+    rng = np.random.default_rng(11)
+    staged = flow_suite.make_staged_update(cfg)
+    fused = jax.jit(lambda s, c, m: flow_suite.update(s, c, m, cfg))
+    s_f, s_s = flow_suite.init(cfg), flow_suite.init(cfg)
+    n = 4096
+    for i in range(4):
+        cols = {nm: jnp.asarray(rng.integers(0, 1 << 16, n).astype(d))
+                for nm, d in SKETCH_L4_SCHEMA.columns}
+        mask = jnp.asarray(rng.random(n) < 0.9)
+        s_f = fused(s_f, cols, mask)
+        s_s = staged(s_s, cols, mask)
+    for a, b in zip(jax.tree.leaves(s_f), jax.tree.leaves(s_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
