@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from deepflow_tpu.querier import metrics as M
 from deepflow_tpu.querier import sql as Q
 from deepflow_tpu.store.db import Store, Table
 from deepflow_tpu.store.dict_store import TagDictRegistry
@@ -80,9 +81,18 @@ class QueryEngine:
             rows = [[c.name, np.dtype(c.dtype).name]
                     for c in table.schema.columns if c.agg is AggKind.KEY]
             return QueryResult(["name", "type"], rows)
-        rows = [[c.name, c.agg.value] for c in table.schema.columns
-                if c.agg is not AggKind.KEY]
-        return QueryResult(["name", "operator"], rows)
+        rows = [[c.name, c.agg.value, "", ""]
+                for c in table.schema.columns if c.agg is not AggKind.KEY]
+        # derived metrics the table can satisfy (reference:
+        # engine/clickhouse/metrics/ registry); a real column of the same
+        # name shadows the library entry, matching SELECT precedence
+        col_names = set(table.schema.column_names)
+        for name, (expr, unit, desc) in sorted(
+                M.available_for(col_names).items()):
+            if name not in col_names:
+                rows.append([name, "derived", unit, desc])
+        return QueryResult(["name", "operator", "unit", "description"],
+                          rows)
 
     # -- SELECT ------------------------------------------------------------
     def _resolve_table(self, name: str, db: Optional[str]) -> Table:
@@ -100,10 +110,27 @@ class QueryEngine:
         table = self._resolve_table(stmt.table, db)
         schema = table.schema
 
+        # expand derived metrics: a bare identifier that names a library
+        # metric (and not a real column) substitutes its expression, so
+        # `SELECT ip_dst, rtt_avg FROM l4 GROUP BY ip_dst` just works
+        col_names = set(schema.column_names)
+        items = []
+        for it in stmt.items:
+            if isinstance(it.expr, Q.Column) \
+                    and it.expr.name not in col_names:
+                d = M.expression(it.expr.name)
+                if d is not None:
+                    items.append(Q.SelectItem(d, it.alias or it.expr.name))
+                    continue
+            items.append(it)
+        if items != stmt.items:
+            stmt = Q.Select(items, stmt.table, stmt.where, stmt.group_by,
+                            stmt.order_by, stmt.limit)
+
         # columns referenced anywhere
         needed = set(stmt.group_by)
         for it in stmt.items:
-            needed |= _expr_columns(it.expr)
+            needed |= Q.expr_columns(it.expr)
         for c in stmt.where:
             needed.add(c.column)
         if not needed:
@@ -287,16 +314,6 @@ class QueryEngine:
 
 
 # -- expression helpers ----------------------------------------------------
-def _expr_columns(e: Q.Expr) -> set:
-    if isinstance(e, Q.Column):
-        return {e.name}
-    if isinstance(e, Q.Agg):
-        return _expr_columns(e.arg) if e.arg is not None else set()
-    if isinstance(e, Q.BinOp):
-        return _expr_columns(e.left) | _expr_columns(e.right)
-    return set()
-
-
 def _has_agg(e: Q.Expr) -> bool:
     if isinstance(e, Q.Agg):
         return True

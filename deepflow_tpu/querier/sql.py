@@ -106,6 +106,17 @@ class Show:
 Statement = Union[Select, Show]
 
 
+def expr_columns(expr: Expr) -> set:
+    """Column names referenced anywhere in an expression tree."""
+    if isinstance(expr, Column):
+        return {expr.name}
+    if isinstance(expr, Agg):
+        return expr_columns(expr.arg) if expr.arg is not None else set()
+    if isinstance(expr, BinOp):
+        return expr_columns(expr.left) | expr_columns(expr.right)
+    return set()
+
+
 class _Parser:
     def __init__(self, tokens: List[str]) -> None:
         self.toks = tokens

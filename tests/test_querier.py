@@ -438,3 +438,56 @@ def test_cli_promql_flag_conflicts(capsys):
     assert cli_main(["promql", "rps", "--time", "5",
                      "--start", "1", "--end", "2"]) == 1
     assert "conflicts" in capsys.readouterr().err
+
+
+def test_derived_metric_library(engine):
+    """Named derived metrics expand to expressions (reference:
+    engine/clickhouse/metrics registry)."""
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+
+    eng, cols = engine
+    # a metrics-shaped table in the same store
+    t = eng.store.create_table("flow_metrics", TableSchema(
+        name="m",
+        columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("rtt_sum", np.dtype(np.uint32), AggKind.SUM),
+            ColumnSpec("rtt_count", np.dtype(np.uint32), AggKind.SUM),
+            ColumnSpec("byte_tx", np.dtype(np.uint32), AggKind.SUM),
+            ColumnSpec("byte_rx", np.dtype(np.uint32), AggKind.SUM),
+        )))
+    t.append({"timestamp": np.array([1, 1, 2], np.uint32),
+              "ip": np.array([10, 10, 11], np.uint32),
+              "rtt_sum": np.array([100, 300, 40], np.uint32),
+              "rtt_count": np.array([1, 3, 2], np.uint32),
+              "byte_tx": np.array([5, 5, 7], np.uint32),
+              "byte_rx": np.array([1, 1, 3], np.uint32)})
+    res = eng.execute("SELECT ip, rtt_avg, byte FROM m GROUP BY ip "
+                      "ORDER BY ip")
+    assert res.columns == ["ip", "rtt_avg", "byte"]
+    assert res.values[0] == [10, 100.0, 12]     # (100+300)/(1+3), 5+5+1+1
+    assert res.values[1] == [11, 20.0, 10]
+    # SHOW METRICS lists the satisfiable derived metrics with units
+    show = eng.execute("SHOW METRICS FROM m")
+    by_name = {r[0]: r for r in show.values}
+    assert by_name["rtt_avg"][1] == "derived"
+    assert by_name["rtt_avg"][2] == "us"
+    assert "retrans_ratio" not in by_name       # columns absent
+    # real columns always win over library names: a table column named
+    # like a library metric is listed once, as the real column
+    t2 = eng.store.create_table("flow_metrics", TableSchema(
+        name="m2",
+        columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("new_flow", np.dtype(np.uint32), AggKind.SUM),
+        )))
+    t2.append({"timestamp": np.array([1, 1], np.uint32),
+               "new_flow": np.array([2, 3], np.uint32)})
+    show2 = eng.execute("SHOW METRICS FROM m2")
+    names = [r[0] for r in show2.values]
+    assert names.count("new_flow") == 1
+    assert [r for r in show2.values if r[0] == "new_flow"][0][1] == "sum"
+    # SELECT of the shadowed name aggregates the REAL column
+    res2 = eng.execute("SELECT Sum(new_flow) AS n FROM m2")
+    assert res2.values[0][0] == 5
