@@ -147,6 +147,41 @@ def cmd_replay_pcap(args) -> int:
     return 0
 
 
+def cmd_capture(args) -> int:
+    """Live AF_PACKET capture -> agent -> ingester (reference role: the
+    dispatcher recv_engine; requires CAP_NET_RAW)."""
+    import time as _time
+
+    from deepflow_tpu.agent.afpacket import AfPacketSource, CaptureLoop
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    try:
+        # open the capture socket FIRST: the common failure (missing
+        # CAP_NET_RAW) must not leave a started agent behind
+        source = AfPacketSource(iface=args.iface)
+    except PermissionError:
+        print("error: live capture requires CAP_NET_RAW (run as root)",
+              file=sys.stderr)
+        return 1
+    agent = Agent(AgentConfig(ingester_addr=args.ingester,
+                              l7_enabled=not args.no_l7))
+    agent.set_vtap_id(args.vtap_id)
+    agent.start()
+    loop = CaptureLoop(source, agent)
+    loop.start()
+    try:
+        deadline = _time.time() + args.seconds if args.seconds else None
+        while deadline is None or _time.time() < deadline:
+            _time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        loop.close()
+        agent.close()
+    print(json.dumps({**loop.counters(), **agent.counters()}))
+    return 0
+
+
 def cmd_promql(args) -> int:
     if (args.start is None) != (args.end is None):
         print("error: --start and --end must be given together",
@@ -214,6 +249,17 @@ def build_parser() -> argparse.ArgumentParser:
     pq.add_argument("--end", type=int)
     pq.add_argument("--step", type=int, default=60)
     pq.set_defaults(fn=cmd_promql)
+
+    cp = sub.add_parser("capture",
+                        help="live AF_PACKET capture -> agent -> ingester")
+    cp.add_argument("--iface", default=None,
+                    help="interface (default: all)")
+    cp.add_argument("--ingester", default="127.0.0.1:30033")
+    cp.add_argument("--vtap-id", type=int, default=1)
+    cp.add_argument("--seconds", type=float, default=0,
+                    help="capture duration (0 = until interrupt)")
+    cp.add_argument("--no-l7", action="store_true")
+    cp.set_defaults(fn=cmd_capture)
 
     rp = sub.add_parser("replay-pcap",
                         help="replay a pcap through an agent -> ingester")
