@@ -220,6 +220,72 @@ class PromEngine:
                 result.append({"metric": labels, "values": values})
         return result
 
+    def remote_read(self, body: bytes) -> bytes:
+        """Prometheus remote-read: snappy(ReadRequest) -> snappy(
+        ReadResponse) (reference: server/querier/app/prometheus remote
+        read service). Serves raw matrix data so a federated Prometheus
+        can pull this store's samples."""
+        from deepflow_tpu.utils import snappy
+        from deepflow_tpu.wire.gen import telemetry_pb2 as pb
+
+        _PB_OPS = {0: "=", 1: "!=", 2: "=~", 3: "!~"}
+        req = pb.ReadRequest()
+        req.ParseFromString(snappy.decompress(body))
+        label_dict = self.tag_dicts.get("label_set")
+        metric_dict = self.tag_dicts.get("metric_name")
+        resp = pb.ReadResponse()
+        t = self.store.table(self.db, self.table)
+        for q in req.queries:
+            result = resp.results.add()
+            matchers = [(m.name, _PB_OPS[m.type], m.value)
+                        for m in q.matchers]
+            # the common shape names one metric exactly: prefilter by its
+            # hash (read-only lookup) before any scan/decode work
+            eq_name = next((v for n, op, v in matchers
+                            if n == "__name__" and op == "="), None)
+            want_mh = None
+            if eq_name is not None:
+                want_mh = metric_dict.lookup(eq_name)
+                if want_mh is None:
+                    continue
+            lo = int(q.start_timestamp_ms // 1000)
+            hi = int(-(-q.end_timestamp_ms // 1000)) + 1
+            cols = t.scan(time_range=(lo, hi))
+            if not len(cols["timestamp"]):
+                continue
+            if want_mh is not None:
+                sel = cols["metric"] == np.uint32(want_mh)
+                cols = {k: v[sel] for k, v in cols.items()}
+                if not len(cols["timestamp"]):
+                    continue
+            # group rows by (metric, labels) hash pair
+            pair = (cols["metric"].astype(np.uint64) << np.uint64(32)) \
+                | cols["labels"].astype(np.uint64)
+            for ph in np.unique(pair):
+                mh, lh = int(ph >> np.uint64(32)), int(ph & np.uint64(0xFFFFFFFF))
+                name = metric_dict.decode(mh) or ""
+                labels = _parse_labels(label_dict.decode(lh) or "")
+                full = {"__name__": name, **labels}
+                if not self._match(full, matchers):
+                    continue
+                sel = pair == ph
+                ts = cols["timestamp"][sel].astype(np.int64) * 1000
+                vs = cols["value"][sel].astype(np.float64)
+                keep = (ts >= q.start_timestamp_ms) & \
+                    (ts <= q.end_timestamp_ms)
+                if not keep.any():
+                    continue
+                order = np.argsort(ts[keep])
+                series = result.timeseries.add()
+                for k, v in sorted(full.items()):
+                    lbl = series.labels.add()
+                    lbl.name, lbl.value = k, v
+                for tms, val in zip(ts[keep][order].tolist(),
+                                    vs[keep][order].tolist()):
+                    s = series.samples.add()
+                    s.timestamp, s.value = int(tms), float(val)
+        return snappy.compress(resp.SerializeToString())
+
     @staticmethod
     def _match(labels: Dict[str, str],
                matchers: List[Tuple[str, str, str]]) -> bool:
@@ -230,5 +296,7 @@ class PromEngine:
             if op == "!=" and have == value:
                 return False
             if op == "=~" and not re.fullmatch(value, have):
+                return False
+            if op == "!~" and re.fullmatch(value, have):
                 return False
         return True

@@ -3,6 +3,7 @@
 POST /v1/query           body: db=<db>&sql=<sql>   (form or JSON)
 GET  /api/v1/query?query=<promql>[&time=<epoch>]   (Prometheus shape)
 GET  /api/v1/query_range?query=&start=&end=&step=  (Prometheus matrix)
+POST /api/v1/read         snappy prompb ReadRequest (remote-read)
 GET  /v1/profile/flame[?app_service=&event_type=&start=&end=]
 GET  /v1/profile/top[?...same...&limit=]
 GET  /api/echo | /api/traces/{id} | /api/search[?service=&minDuration=]
@@ -164,9 +165,25 @@ class QuerierServer:
 
             def do_POST(self) -> None:
                 url = urllib.parse.urlparse(self.path)
+                length = int(self.headers.get("Content-Length", 0))
+                raw_bytes = self.rfile.read(length)
+                if url.path == "/api/v1/read":
+                    # prometheus remote-read: snappy protobuf in/out,
+                    # handled whole before any text-body parsing
+                    try:
+                        out = outer.prom.remote_read(raw_bytes)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-protobuf")
+                        self.send_header("Content-Encoding", "snappy")
+                        self.send_header("Content-Length", str(len(out)))
+                        self.end_headers()
+                        self.wfile.write(out)
+                    except Exception as e:
+                        self._send(400, {"error": str(e)})
+                    return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(length).decode()
+                    raw = raw_bytes.decode()
                     ctype = self.headers.get("Content-Type", "")
                     if "json" in ctype:
                         params = json.loads(raw or "{}")

@@ -3,8 +3,9 @@
 Prometheus remote-write mandates snappy compression; no snappy binding
 is vendored in this environment, and the block format is small enough
 to implement directly (varint uncompressed length, then a stream of
-literal/copy tags). Decompress-only: the framework never needs to
-produce snappy.
+literal/copy tags). Decompress handles the full tag set; compress emits
+a valid all-literal stream (remote-read responses must be snappy-framed,
+ratio is irrelevant at those sizes).
 """
 
 from __future__ import annotations
@@ -79,4 +80,34 @@ def decompress(data: bytes) -> bytes:
                 out.append(out[start + i])
     if len(out) != ulen:
         raise SnappyError(f"length mismatch: {len(out)} != {ulen}")
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """Minimal VALID snappy block stream: the uncompressed-length varint
+    followed by all-literal tags (ratio 1.0, but every decoder accepts
+    it). Needed by remote-read responses; remote-write ingest only ever
+    decompresses."""
+    out = bytearray()
+    n = len(data)
+    while True:            # length varint
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            break
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos:pos + 65536]
+        L = len(chunk) - 1
+        if L < 60:
+            out.append(L << 2)
+        elif L < 1 << 8:
+            out.append(60 << 2)
+            out.append(L)
+        else:
+            out.append(61 << 2)
+            out += L.to_bytes(2, "little")
+        out += chunk
+        pos += len(chunk)
     return bytes(out)

@@ -1,0 +1,95 @@
+"""Extended L7 protocols through the LIVE agent path: pcap-style frames
+-> Agent.feed -> session aggregation -> PROTOCOLLOG wire records ->
+ingester store rows with the right l7_protocol ids."""
+
+import time
+
+import numpy as np
+
+from deepflow_tpu.agent.l7_ext import L7_HTTP2, L7_KAFKA, L7_TLS
+from deepflow_tpu.agent.trident import Agent, AgentConfig
+from tests.test_agent import CLIENT, SERVER, eth_ipv4_tcp
+from tests.test_l7_ext import (_client_hello, _h2_headers_frame,
+                               _kafka_request)
+import struct
+
+from deepflow_tpu.agent import l7_ext
+
+ACK = 0x10
+T0 = 1_700_000_000_000_000_000
+
+
+def _server_hello():
+    body = b"\x03\x03" + b"\x00" * 32 + b"\x00" + b"\x13\x01" + b"\x00"
+    hs = b"\x02" + len(body).to_bytes(3, "big") + body
+    return b"\x16\x03\x03" + struct.pack(">H", len(hs)) + hs
+
+
+def test_extended_l7_through_agent(tmp_path):
+    agent = Agent(AgentConfig(ingester_addr="127.0.0.1:1",
+                              l7_enabled=True))
+    agent.set_vtap_id(9)
+    frames, stamps = [], []
+
+    def conv(sport, dport, req, resp):
+        frames.append(eth_ipv4_tcp(CLIENT, SERVER, sport, dport, ACK,
+                                   req, seq=1))
+        stamps.append(T0 + len(stamps) * 1_000_000)
+        frames.append(eth_ipv4_tcp(SERVER, CLIENT, dport, sport, ACK,
+                                   resp, seq=1))
+        stamps.append(T0 + len(stamps) * 1_000_000 + 2_000_000)
+
+    conv(40000, 443, _client_hello(), _server_hello())
+    conv(40001, 8080,
+         l7_ext._H2_PREFACE + _h2_headers_frame(
+             bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")),
+         _h2_headers_frame(bytes.fromhex("88")))
+    resp_body = struct.pack(">i", 42) + b"\x00" * 6
+    conv(40002, 9092, _kafka_request(0),
+         struct.pack(">i", len(resp_body)) + resp_body)
+
+    assert agent.feed(frames, np.asarray(stamps, np.uint64)) == 6
+    with agent._lock:
+        records = list(agent._l7_out)
+    assert len(records) == 3       # one merged session per conversation
+
+    from deepflow_tpu.decode.columnar import decode_l7_records
+    cols = decode_l7_records(records)
+    protos = sorted(cols["l7_protocol"].tolist())
+    assert protos == sorted([L7_TLS, L7_HTTP2, L7_KAFKA])
+    # sessions carry request->response round-trip times (2ms apart)
+    assert (cols["rrt_us"] > 0).all()
+    agent.close()
+
+
+def test_extended_l7_lands_in_store(tmp_path):
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path / "st")))
+    ing.start()
+    try:
+        agent = Agent(AgentConfig(ingester_addr=f"127.0.0.1:{ing.port}",
+                                  l7_enabled=True))
+        agent.set_vtap_id(9)
+        frames = [
+            eth_ipv4_tcp(CLIENT, SERVER, 40000, 443, ACK,
+                         _client_hello(), seq=1),
+            eth_ipv4_tcp(SERVER, CLIENT, 443, 40000, ACK,
+                         _server_hello(), seq=1),
+        ]
+        agent.feed(frames, np.asarray([T0, T0 + 5_000_000], np.uint64))
+        agent.tick(now_ns=T0 + 10**9)
+        table = ing.store.table("flow_log", "l7_flow_log")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ing.flush()
+            if table.row_count():
+                break
+            time.sleep(0.1)
+        out = table.scan()
+        assert out["l7_protocol"].tolist() == [L7_TLS]
+        assert out["port_dst"].tolist() == [443]
+        agent.close()
+    finally:
+        ing.close()

@@ -491,3 +491,49 @@ def test_derived_metric_library(engine):
     # SELECT of the shadowed name aggregates the REAL column
     res2 = eng.execute("SELECT Sum(new_flow) AS n FROM m2")
     assert res2.values[0][0] == 5
+
+
+def test_prometheus_remote_read(prom):
+    """Remote-read serves snappy prompb matrices a federated Prometheus
+    can pull (reference: querier/app/prometheus remote read)."""
+    import urllib.request as _rq
+
+    from deepflow_tpu.utils import snappy
+    from deepflow_tpu.wire.gen import telemetry_pb2 as pb
+
+    peng, store, dicts = prom
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    try:
+        req = pb.ReadRequest()
+        q = req.queries.add()
+        q.start_timestamp_ms = 1000_000
+        q.end_timestamp_ms = 1090_000
+        m = q.matchers.add()
+        m.type = pb.LabelMatcher.EQ
+        m.name = "__name__"
+        m.value = "rps"
+        m2 = q.matchers.add()
+        m2.type = pb.LabelMatcher.RE
+        m2.name = "job"
+        m2.value = "a.*"
+        body = snappy.compress(req.SerializeToString())
+        hr = _rq.Request(f"http://127.0.0.1:{srv.port}/api/v1/read",
+                         data=body,
+                         headers={"Content-Type": "application/x-protobuf",
+                                  "Content-Encoding": "snappy"})
+        with _rq.urlopen(hr, timeout=5) as resp:
+            out = pb.ReadResponse()
+            out.ParseFromString(snappy.decompress(resp.read()))
+        assert len(out.results) == 1
+        series = out.results[0].timeseries
+        assert len(series) == 1                      # only job=api matches
+        labels = {l.name: l.value for l in series[0].labels}
+        assert labels == {"__name__": "rps", "job": "api"}
+        assert len(series[0].samples) == 10
+        assert series[0].samples[0].timestamp == 1000_000
+        assert series[0].samples[0].value == 10.0
+        assert series[0].samples[-1].value == 19.0
+    finally:
+        srv.close()
+        dicts.close()
