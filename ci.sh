@@ -32,6 +32,27 @@ print("entry + 8-device dryrun ok")
 EOF
 
 if [ "${1:-}" != "quick" ]; then
+  echo "== TSAN: native decoder MT path =="
+  # the one native component with real concurrency; any data race aborts
+  # with ThreadSanitizer's report (SURVEY.md §4: beat the reference's
+  # go -race bar on the ported hot path)
+  if ! command -v g++ >/dev/null; then
+    echo "(g++ unavailable; TSAN step skipped)"
+  else
+    # a real compile failure must FAIL CI, not silently skip the gate
+    g++ -O1 -g -fsanitize=thread -std=c++17 \
+      deepflow_tpu/decode/native_src/tsan_harness.cc \
+      -o /tmp/tsan_decoder -lpthread
+    python - <<'PYEOF'
+from deepflow_tpu.replay.generator import SyntheticAgent
+from deepflow_tpu.wire.codec import pack_pb_records
+agent = SyntheticAgent()
+cols, records = agent.l4_batch(50000)
+open("/tmp/tsan_payload.bin", "wb").write(pack_pb_records(records))
+PYEOF
+    /tmp/tsan_decoder /tmp/tsan_payload.bin
+  fi
+
   echo "== kernel microbenches (CPU shapes) =="
   python benches/kernel_bench.py --batch 262144 --iters 6
 fi

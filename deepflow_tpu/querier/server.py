@@ -165,8 +165,12 @@ class QuerierServer:
 
             def do_POST(self) -> None:
                 url = urllib.parse.urlparse(self.path)
-                length = int(self.headers.get("Content-Length", 0))
-                raw_bytes = self.rfile.read(length)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw_bytes = self.rfile.read(length)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
                 if url.path == "/api/v1/read":
                     # prometheus remote-read: snappy protobuf in/out,
                     # handled whole before any text-body parsing
