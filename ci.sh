@@ -48,9 +48,19 @@ from deepflow_tpu.replay.generator import SyntheticAgent
 from deepflow_tpu.wire.codec import pack_pb_records
 agent = SyntheticAgent()
 cols, records = agent.l4_batch(50000)
+records = list(records)
+# corrupt a scattered subset so every worker's region has gaps: the MT
+# decoder's memmove compaction (decoder.cc df_decode_l4_mt) only runs
+# when bad records leave regions sparse — a clean payload would let a
+# compaction race pass TSAN vacuously. Two failure shapes: garbage wire
+# bytes, and a well-formed record with no Flow field.
+for i in range(0, len(records), 97):
+    records[i] = b"\xff" * len(records[i])
+for i in range(31, len(records), 193):
+    records[i] = b"\x08\x01"
 open("/tmp/tsan_payload.bin", "wb").write(pack_pb_records(records))
 PYEOF
-    /tmp/tsan_decoder /tmp/tsan_payload.bin
+    /tmp/tsan_decoder /tmp/tsan_payload.bin 500
   fi
 
   echo "== kernel microbenches (CPU shapes) =="

@@ -20,7 +20,7 @@ CONTROLLER = "http://127.0.0.1:20417"
 QUERIER = "http://127.0.0.1:20416"
 
 
-def _http(url: str, body=None, form: str = None):
+def _http(url: str, body=None, form: str = None, method: str = None):
     data = None
     headers = {}
     if body is not None:
@@ -29,7 +29,8 @@ def _http(url: str, body=None, form: str = None):
     elif form is not None:
         data = form.encode()
         headers["Content-Type"] = "application/x-www-form-urlencoded"
-    req = urllib.request.Request(url, data=data, headers=headers)
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
     try:
         with urllib.request.urlopen(req, timeout=10) as resp:
             return json.load(resp)
@@ -87,9 +88,47 @@ def cmd_domain(args) -> int:
         resources = json.load(f)
     if isinstance(resources, dict):
         resources = resources.get("resources", [])
-    out = _http(f"{args.controller}/v1/domains/{args.name}/resources",
+    out = _http(f"{args.controller}/v1/domains/"
+                f"{urllib.parse.quote(args.name, safe='')}/resources",
                 body={"resources": resources})
     print(json.dumps(out))
+    return 0
+
+
+def cmd_cloud(args) -> int:
+    base = f"{args.controller}/v1/cloud"
+    if args.action != "list" and not args.name:
+        raise RuntimeError(f"cloud {args.action} requires a domain name")
+    if args.action == "add":
+        need = {"filereader": args.path, "http": args.url}
+        if args.platform in need and not need[args.platform]:
+            raise RuntimeError(
+                f"--{'path' if args.platform == 'filereader' else 'url'} "
+                f"is required for platform {args.platform}")
+        body = {"domain": args.name, "platform": args.platform,
+                "interval_s": args.interval}
+        if args.platform == "filereader":
+            body["path"] = args.path
+        elif args.platform == "http":
+            body["url"] = args.url
+        elif args.platform == "kubernetes_gather":
+            body["cluster"] = args.cluster or args.name
+        print(json.dumps(_http(f"{base}/domains", body=body)))
+    elif args.action == "list":
+        rows = _http(f"{base}/tasks")
+        _table([[t["domain"], t["platform"], t["gathers_ok"],
+                 t["gathers_failed"], t["resource_count"],
+                 round(t["last_cost_s"], 3), t["last_error"] or "-"]
+                for t in rows],
+               ["DOMAIN", "PLATFORM", "OK", "FAILED", "RESOURCES",
+                "COST_S", "LAST_ERROR"])
+    elif args.action == "refresh":
+        q = urllib.parse.quote(args.name, safe="")
+        print(json.dumps(_http(
+            f"{args.controller}/v1/domains/{q}/refresh", body={})))
+    elif args.action == "delete":
+        q = urllib.parse.quote(args.name, safe="")
+        print(json.dumps(_http(f"{base}/domains/{q}", method="DELETE")))
     return 0
 
 
@@ -225,6 +264,18 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("name")
     d.add_argument("-f", "--file", required=True)
     d.set_defaults(fn=cmd_domain)
+
+    c = sub.add_parser("cloud", help="cloud domain pollers")
+    c.add_argument("action",
+                   choices=["add", "list", "refresh", "delete"])
+    c.add_argument("name", nargs="?", help="domain name")
+    c.add_argument("--platform", default="filereader",
+                   choices=["filereader", "http", "kubernetes_gather"])
+    c.add_argument("--path", help="resource document (filereader)")
+    c.add_argument("--url", help="snapshot URL (http)")
+    c.add_argument("--cluster", help="cluster name (kubernetes_gather)")
+    c.add_argument("--interval", type=float, default=60.0)
+    c.set_defaults(fn=cmd_cloud)
 
     r = sub.add_parser("resource", help="list resources")
     r.add_argument("--type")

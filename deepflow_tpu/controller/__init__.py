@@ -10,10 +10,15 @@ HTTP (stdlib) in place of gRPC for the sync surface — the data-plane
 wire stays the firehose.
 """
 
+from deepflow_tpu.controller.cloud import (CloudManager, CloudTask,
+                                           FileReaderPlatform, HttpPlatform,
+                                           KubernetesGatherPlatform)
 from deepflow_tpu.controller.model import ResourceModel
 from deepflow_tpu.controller.recorder import Recorder
 from deepflow_tpu.controller.registry import VTapRegistry
 from deepflow_tpu.controller.server import ControllerServer
 
 __all__ = ["ResourceModel", "Recorder", "VTapRegistry",
-           "ControllerServer"]
+           "ControllerServer", "CloudManager", "CloudTask",
+           "FileReaderPlatform", "HttpPlatform",
+           "KubernetesGatherPlatform"]

@@ -14,6 +14,10 @@ Ops-facing (driven by the CLI):
   GET/POST /v1/vtap-group-config?group=g     config CRUD
   POST /v1/domains/<name>/resources          full domain snapshot
   GET  /v1/resources[?type=pod]
+  GET  /v1/cloud/tasks      per-domain poller info + cost
+  POST /v1/cloud/domains    {domain, platform: filereader|http|kubernetes_gather, ...}
+  DELETE /v1/cloud/domains/<name>
+  POST /v1/domains/<name>/refresh            trigger an immediate gather
   GET  /v1/platform-data    compiled enrichment tables + version
   GET  /v1/election         leader status
   POST /v1/ingesters        {addrs: [...]} membership for rebalancing
@@ -28,6 +32,10 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from deepflow_tpu.controller.cloud import (CloudManager, FileReaderPlatform,
+                                           HttpPlatform,
+                                           KubernetesGatherPlatform,
+                                           rows_to_resources)
 from deepflow_tpu.controller.election import Election
 from deepflow_tpu.controller.model import ResourceModel, make_resource
 from deepflow_tpu.controller.monitor import FleetMonitor
@@ -50,6 +58,7 @@ class ControllerServer:
         from deepflow_tpu.controller.genesis_sync import GenesisSync
         from deepflow_tpu.controller.recorder import Recorder
         self.recorder = Recorder(model)
+        self.cloud = CloudManager(self.recorder)
         self.genesis_sync = GenesisSync(model, peers=genesis_peers or ())
         self.registry = registry
         self.monitor = monitor or FleetMonitor(registry)
@@ -97,6 +106,15 @@ class ControllerServer:
                 except Exception as e:
                     self._send(400, {"error": str(e)})
 
+            def do_DELETE(self) -> None:
+                try:
+                    url = urllib.parse.urlparse(self.path)
+                    self._send(200, outer._delete(url.path))
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -132,6 +150,8 @@ class ControllerServer:
                     "identity": self.election.identity}
         if path == "/v1/assignments":
             return self.monitor.assignments()
+        if path == "/v1/cloud/tasks":
+            return [vars(i) for i in self.cloud.tasks()]
         if path == "/health":
             return {"status": "ok"}
         raise KeyError(path)
@@ -179,12 +199,9 @@ class ControllerServer:
                                                body)
             return {"config_version": version}
         if path.startswith("/v1/domains/") and path.endswith("/resources"):
-            domain = path[len("/v1/domains/"):-len("/resources")]
-            snapshot = [make_resource(
-                r["type"], r["id"], r["name"], domain,
-                **{k: v for k, v in r.items()
-                   if k not in ("type", "id", "name", "domain")})
-                for r in body.get("resources", [])]
+            domain = urllib.parse.unquote(
+                path[len("/v1/domains/"):-len("/resources")])
+            snapshot = rows_to_resources(body.get("resources", []), domain)
             diff = self.recorder.reconcile(domain, snapshot)
             return {"created": len(diff.created),
                     "deleted": len(diff.deleted),
@@ -198,7 +215,51 @@ class ControllerServer:
         if path == "/v1/ingesters":
             self.monitor.set_ingesters(list(body.get("addrs", [])))
             return {"ingesters": self.monitor.ingesters()}
+        if path == "/v1/cloud/domains":
+            if not isinstance(body.get("domain"), str) or not body["domain"]:
+                raise ValueError("domain must be a non-empty string")
+            task = self.cloud.add(
+                body["domain"], self._make_platform(body),
+                interval_s=float(body.get("interval_s", 60.0)))
+            return {"domain": task.domain, "platform": task.info.platform,
+                    "auth_failed": task.info.auth_failed}
+        if path.startswith("/v1/domains/") and path.endswith("/refresh"):
+            domain = urllib.parse.unquote(
+                path[len("/v1/domains/"):-len("/refresh")])
+            task = self.cloud.get(domain)
+            if task is None:
+                raise KeyError(domain)
+            ok = task.gather_once()   # synchronous: the CLI wants the diff
+            return {"domain": domain, "ok": ok,
+                    "error": task.info.last_error,
+                    "resource_count": task.info.resource_count,
+                    "version": self.model.version}
         raise KeyError(path)
+
+    def _delete(self, path: str):
+        if path.startswith("/v1/cloud/domains/"):
+            domain = urllib.parse.unquote(path[len("/v1/cloud/domains/"):])
+            if not self.cloud.remove(domain):
+                raise KeyError(domain)
+            return {"deleted": domain, "version": self.model.version}
+        raise KeyError(path)
+
+    def _make_platform(self, body: dict):
+        kind = body.get("platform", "filereader")
+        if kind == "filereader":
+            if not body.get("path"):
+                raise ValueError("filereader platform requires path")
+            return FileReaderPlatform(body["path"], body["domain"])
+        if kind == "http":
+            if not body.get("url"):
+                raise ValueError("http platform requires url")
+            return HttpPlatform(body["url"], body["domain"],
+                                headers=body.get("headers"))
+        if kind == "kubernetes_gather":
+            return KubernetesGatherPlatform(
+                self.model, body.get("cluster", body["domain"]),
+                body["domain"])
+        raise ValueError(f"unknown platform kind {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -210,8 +271,10 @@ class ControllerServer:
                                         name="controller-http", daemon=True)
         self._thread.start()
         self.genesis_sync.start()
+        self.cloud.start()
 
     def close(self) -> None:
+        self.cloud.close()
         self.genesis_sync.close()
         self._httpd.shutdown()
         self._httpd.server_close()

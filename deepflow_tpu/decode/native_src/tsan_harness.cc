@@ -20,9 +20,15 @@
 
 int main(int argc, char** argv) {
   if (argc < 2) {
-    std::fprintf(stderr, "usage: %s <packed-payload-file>\n", argv[0]);
+    std::fprintf(stderr, "usage: %s <packed-payload-file> [min-bad]\n",
+                 argv[0]);
     return 2;
   }
+  // min-bad guards the payload: the gap-compaction path in
+  // df_decode_l4_mt only runs when worker regions are sparse (bad
+  // records present), so a clean payload would leave the riskiest code
+  // unexercised and this harness would pass vacuously.
+  long min_bad = argc > 2 ? std::atol(argv[2]) : 0;
   FILE* f = std::fopen(argv[1], "rb");
   if (!f) { std::perror("open"); return 2; }
   std::fseek(f, 0, SEEK_END);
@@ -43,6 +49,12 @@ int main(int argc, char** argv) {
   long rows = df_decode_l4(payload.data(), len, ref32.data(), ref64.data(),
                            cap, &bad, &consumed);
   std::printf("single-threaded: %ld rows (%ld bad)\n", rows, bad);
+  if (bad < min_bad) {
+    std::fprintf(stderr,
+                 "payload has %ld bad records, expected >= %ld: the MT "
+                 "gap-compaction path would go untested\n", bad, min_bad);
+    return 1;
+  }
 
   for (int threads = 1; threads <= 8; ++threads) {
     std::vector<uint32_t> out32(static_cast<size_t>(N_COLS32) * cap, 0xAA);

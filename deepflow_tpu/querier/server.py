@@ -167,6 +167,8 @@ class QuerierServer:
                 url = urllib.parse.urlparse(self.path)
                 try:
                     length = int(self.headers.get("Content-Length", 0))
+                    if length < 0:   # read(-1) would block until EOF
+                        raise ValueError("negative Content-Length")
                     raw_bytes = self.rfile.read(length)
                 except ValueError as e:
                     self._send(400, {"error": str(e)})

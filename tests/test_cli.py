@@ -86,3 +86,22 @@ def test_cli_domain_and_resources(stack, capsys, tmp_path):
     assert rc == 0 and json.loads(out)["created"] == 1
     rc, out = _run(capsys, "--controller", base, "resource", "--type", "pod")
     assert rc == 0 and "p1" in out
+
+
+def test_cli_cloud_lifecycle(stack, capsys, tmp_path):
+    srv, _ = stack
+    base = f"http://127.0.0.1:{srv.port}"
+    doc = tmp_path / "cloud.json"
+    doc.write_text(json.dumps({"vpcs": [{"name": "vpc1"}]}))
+    rc, out = _run(capsys, "--controller", base, "cloud", "add", "file-d",
+                   "--platform", "filereader", "--path", str(doc),
+                   "--interval", "3600")
+    assert rc == 0 and not json.loads(out)["auth_failed"]
+    rc, out = _run(capsys, "--controller", base, "cloud", "refresh",
+                   "file-d")
+    assert rc == 0 and json.loads(out)["resource_count"] == 1
+    rc, out = _run(capsys, "--controller", base, "cloud", "list")
+    assert rc == 0 and "file-d" in out and "FileReaderPlatform" in out
+    rc, out = _run(capsys, "--controller", base, "cloud", "delete",
+                   "file-d")
+    assert rc == 0 and json.loads(out)["deleted"] == "file-d"

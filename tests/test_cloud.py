@@ -1,0 +1,279 @@
+"""Cloud poller framework: platform clients, task loop, manager, HTTP API.
+
+Reference: server/controller/cloud/cloud.go (task loop, hold-last-good,
+task cost), cloud/filereader/ (manual resource document),
+cloud/kubernetes_gather/ (genesis-derived k8s view).
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                     Recorder, VTapRegistry)
+from deepflow_tpu.controller.cloud import (CloudManager, CloudTask,
+                                           FileReaderPlatform, HttpPlatform,
+                                           KubernetesGatherPlatform,
+                                           parse_resource_doc)
+from deepflow_tpu.controller.model import make_resource
+
+DOC = {
+    "regions": [{"name": "r1"}],
+    "azs": [{"name": "az1", "region": "r1"}],
+    "vpcs": [{"name": "vpc1"}],
+    "subnets": [{"name": "s1", "vpc": "vpc1", "cidr": "10.0.0.0/24",
+                 "epc_id": 3}],
+    "hosts": [{"name": "h1", "az": "az1", "ip": "10.0.0.7"}],
+    "services": [{"name": "svc1", "vpc": "vpc1", "ip": "10.0.0.100",
+                  "port": 443}],
+}
+
+
+def test_parse_resource_doc_links_and_stable_ids():
+    rows = parse_resource_doc(DOC, "d1")
+    by = {(r.type, r.name): r for r in rows}
+    assert by[("az", "az1")].attr("region_id") == by[("region", "r1")].id
+    assert by[("subnet", "s1")].attr("vpc_id") == by[("vpc", "vpc1")].id
+    assert by[("subnet", "s1")].attr("cidr") == "10.0.0.0/24"
+    # ids are content-stable across parses
+    again = {(r.type, r.name): r for r in parse_resource_doc(DOC, "d1")}
+    assert all(again[k].id == r.id for k, r in by.items())
+    # ...but differ across domains (no cross-domain id collisions by luck)
+    other = {(r.type, r.name): r for r in parse_resource_doc(DOC, "d2")}
+    assert other[("region", "r1")].id != by[("region", "r1")].id
+
+
+def test_parse_resource_doc_rejects_dangling_ref():
+    bad = {"azs": [{"name": "az1", "region": "nope"}]}
+    try:
+        parse_resource_doc(bad, "d")
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+
+
+def test_filereader_gather_and_regather(tmp_path):
+    path = tmp_path / "cloud.json"
+    path.write_text(json.dumps(DOC))
+    model = ResourceModel()
+    rec = Recorder(model)
+    task = CloudTask(FileReaderPlatform(str(path), "file-d"), rec, "file-d")
+    assert task.gather_once()
+    assert task.info.gathers_ok == 1
+    assert task.info.resource_count == len(model.list(domain="file-d")) == 6
+    # edit the document: one resource renamed-in-place, one gone
+    doc2 = dict(DOC)
+    doc2["hosts"] = [{"name": "h1", "az": "az1", "ip": "10.0.0.8"}]
+    doc2.pop("services")
+    path.write_text(json.dumps(doc2))
+    assert task.gather_once()
+    assert model.list(type="service", domain="file-d") == []
+    h1 = [r for r in model.list(type="host") if r.name == "h1"][0]
+    assert h1.attr("ip") == "10.0.0.8"
+
+
+def test_gather_failure_holds_last_good(tmp_path):
+    path = tmp_path / "cloud.json"
+    path.write_text(json.dumps(DOC))
+    model = ResourceModel()
+    task = CloudTask(FileReaderPlatform(str(path), "d"), Recorder(model),
+                     "d")
+    assert task.gather_once()
+    before = model.version
+    path.write_text("{not json or yaml: [")
+    assert not task.gather_once()
+    assert task.info.gathers_failed == 1
+    assert task.info.last_error
+    # the model still holds the last good snapshot, untouched
+    assert model.version == before
+    assert len(model.list(domain="d")) == 6
+
+
+def test_kubernetes_gather_from_genesis():
+    model = ResourceModel()
+    # two agents reported via genesis: n1 with eth0+veth, n2 with eth0
+    model.update_domain("genesis/n1", [
+        make_resource("host", 1, "n1:eth0", "genesis/n1", ip="10.1.1.1"),
+        make_resource("host", 2, "n1:veth3", "genesis/n1", ip="10.244.0.9"),
+    ])
+    model.update_domain("genesis/n2", [
+        make_resource("host", 3, "n2:eth0", "genesis/n2", ip="10.1.1.2"),
+    ])
+    task = CloudTask(KubernetesGatherPlatform(model, "prod", "k8s-d"),
+                     Recorder(model), "k8s-d")
+    assert task.gather_once()
+    nodes = model.list(type="pod_node", domain="k8s-d")
+    assert sorted(n.name for n in nodes) == ["n1", "n2"]
+    pods = model.list(type="pod", domain="k8s-d")
+    assert [p.name for p in pods] == ["n1:veth3"]
+    node1 = [n for n in nodes if n.name == "n1"][0]
+    assert pods[0].attr("pod_node_id") == node1.id
+    # agent decommissioned -> its pod_node disappears on the next gather
+    model.update_domain("genesis/n2", [])
+    assert task.gather_once()
+    assert sorted(n.name for n in
+                  model.list(type="pod_node", domain="k8s-d")) == ["n1"]
+
+
+class _SnapshotHandler(BaseHTTPRequestHandler):
+    doc = {"resources": [
+        {"type": "vpc", "name": "vpc-a"},
+        {"type": "pod_cluster", "name": "c1"},
+    ]}
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps(self.doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_http_platform_poll():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _SnapshotHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/snap"
+        model = ResourceModel()
+        task = CloudTask(HttpPlatform(url, "http-d"), Recorder(model),
+                         "http-d")
+        task.platform.check_auth()
+        assert task.gather_once()
+        assert {r.type for r in model.list(domain="http-d")} == \
+            {"vpc", "pod_cluster"}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_manager_add_remove_cascades():
+    model = ResourceModel()
+    mgr = CloudManager(Recorder(model))
+    task = mgr.add("k8s-d", KubernetesGatherPlatform(model, "c", "k8s-d"),
+                   interval_s=3600)
+    task.gather_once()
+    assert model.list(domain="k8s-d")
+    assert mgr.counters()["tasks"] == 1
+    assert mgr.remove("k8s-d")
+    assert not mgr.remove("k8s-d")
+    # removing the domain cascades resource deletion
+    assert model.list(domain="k8s-d") == []
+
+
+def _req(port, path, body=None, method=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    if body is None and method is None:
+        with urllib.request.urlopen(url) as r:
+            return json.load(r)
+    req = urllib.request.Request(
+        url, data=json.dumps(body or {}).encode(),
+        method=method or "POST")
+    with urllib.request.urlopen(req) as r:
+        return json.load(r)
+
+
+def test_cloud_http_api(tmp_path):
+    path = tmp_path / "cloud.json"
+    path.write_text(json.dumps(DOC))
+    srv = ControllerServer(ResourceModel(), VTapRegistry(), port=0)
+    srv.start()
+    try:
+        p = srv.port
+        r = _req(p, "/v1/cloud/domains",
+                 {"domain": "file-d", "platform": "filereader",
+                  "path": str(path), "interval_s": 3600})
+        assert r["platform"] == "FileReaderPlatform"
+        assert not r["auth_failed"]
+        ref = _req(p, "/v1/domains/file-d/refresh", {})
+        assert ref["ok"] and ref["resource_count"] == 6
+        tasks = _req(p, "/v1/cloud/tasks")
+        assert tasks[0]["domain"] == "file-d"
+        assert tasks[0]["gathers_ok"] >= 1
+        assert len(_req(p, "/v1/resources")) == 6
+        d = _req(p, "/v1/cloud/domains/file-d", method="DELETE")
+        assert d["deleted"] == "file-d"
+        assert _req(p, "/v1/resources") == []
+    finally:
+        srv.close()
+
+
+def test_task_rejects_bad_interval():
+    model = ResourceModel()
+    for bad in (0, -5, float("nan")):
+        try:
+            CloudTask(KubernetesGatherPlatform(model, "c", "d"),
+                      Recorder(model), "d", interval_s=bad)
+            assert False, f"interval {bad} accepted"
+        except ValueError:
+            pass
+
+
+def test_on_diff_exception_does_not_kill_gather():
+    model = ResourceModel()
+
+    def boom(domain, diff):
+        raise RuntimeError("subscriber broke")
+
+    mgr = CloudManager(Recorder(model), on_diff=boom)
+    task = mgr.add("d", KubernetesGatherPlatform(model, "c", "d"),
+                   interval_s=3600)
+    assert task.gather_once()          # gather succeeds, model updated
+    assert model.list(domain="d")
+    assert "on_diff" in task.info.last_error
+
+
+def test_auth_failed_clears_on_successful_gather(tmp_path):
+    path = tmp_path / "late.json"      # does not exist yet
+    model = ResourceModel()
+    task = CloudTask(FileReaderPlatform(str(path), "d"), Recorder(model),
+                     "d", interval_s=3600)
+    try:
+        task.platform.check_auth()
+    except OSError:
+        task.info.auth_failed = True
+    assert task.info.auth_failed
+    path.write_text(json.dumps({"vpcs": [{"name": "v"}]}))
+    assert task.gather_once()
+    assert not task.info.auth_failed
+
+
+def test_domain_names_with_url_unsafe_chars(tmp_path):
+    from deepflow_tpu.cli import main as cli_main
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps({"vpcs": [{"name": "v"}]}))
+    srv = ControllerServer(ResourceModel(), VTapRegistry(), port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        name = "aws us-east?1#prod"
+        assert cli_main(["--controller", base, "cloud", "add", name,
+                         "--path", str(path), "--interval", "3600"]) == 0
+        assert cli_main(["--controller", base, "cloud", "refresh",
+                         name]) == 0
+        assert srv.model.list(domain=name)
+        assert cli_main(["--controller", base, "cloud", "delete",
+                         name]) == 0
+        assert srv.cloud.get(name) is None
+        assert srv.model.list(domain=name) == []
+    finally:
+        srv.close()
+
+
+def test_add_with_bad_interval_keeps_old_task():
+    model = ResourceModel()
+    mgr = CloudManager(Recorder(model))
+    task = mgr.add("d", KubernetesGatherPlatform(model, "c", "d"),
+                   interval_s=3600)
+    try:
+        mgr.add("d", KubernetesGatherPlatform(model, "c2", "d"),
+                interval_s=0)
+        assert False, "interval 0 accepted"
+    except ValueError:
+        pass
+    # the original task survives, still registered and removable
+    assert mgr.get("d") is task
+    assert mgr.remove("d")
