@@ -194,12 +194,18 @@ def register_parser(parser, prepend: bool = False) -> None:
 
 def parse_payload(payload: bytes, proto: Optional[int] = None,
                   port_src: Optional[int] = None,
-                  port_dst: Optional[int] = None) -> Optional[L7Record]:
+                  port_dst: Optional[int] = None,
+                  ts_ns: int = 0,
+                  ip_src: int = 0, ip_dst: int = 0) -> Optional[L7Record]:
     """Two-phase dispatch: first parser whose cheap check passes wins
     (reference: check_payload ordering in l7_protocol_log.rs). Transport
     context, when provided, gates ambiguous parsers: DNS only on UDP or
     port 53 (byte patterns alone misfire on e.g. TLS records), and the
-    byte-oriented TCP protocols never match UDP payloads."""
+    byte-oriented TCP protocols never match UDP payloads.
+
+    A parser with `wants_ctx = True` (the .so plugin adapter) receives
+    the full dispatch context — the reference's parse_ctx carries
+    ips/ports/time and plugins legitimately gate on them."""
     for p in PARSERS:
         if proto is not None:
             if p.proto == L7_DNS:
@@ -207,7 +213,14 @@ def parse_payload(payload: bytes, proto: Optional[int] = None,
                     continue
             elif proto not in getattr(p, "transports", (6,)):
                 continue
-        if p.check(payload):
+        if getattr(p, "wants_ctx", False):
+            ctx = (proto, port_src or 0, port_dst or 0, ts_ns,
+                   ip_src, ip_dst)
+            if p.check(payload, *ctx):
+                rec = p.parse(payload, *ctx)
+                if rec is not None:
+                    return rec
+        elif p.check(payload):
             rec = p.parse(payload)
             if rec is not None:
                 return rec

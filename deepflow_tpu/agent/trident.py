@@ -53,6 +53,9 @@ class AgentConfig:
     platform_sync_interval_s: float = 60.0
     k8s_resource_file: Optional[str] = None
     k8s_cluster_domain: str = "k8s-cluster"
+    # shared-object L7 plugins (agent/plugin.py): .so paths loaded at
+    # startup and hot-loadable via pushed config (reference: rpc Plugin)
+    so_plugins: tuple = ()
     # dispatcher (agent/dispatcher.py): capture mode + policy actions
     dispatcher_mode: str = "local"
     local_macs: tuple = ()
@@ -169,6 +172,21 @@ class Agent:
         self.config_version = 0
         self.platform_watcher = None
         self.k8s_watcher = None
+        self.so_plugins: Dict[str, object] = {}
+        for path in cfg.so_plugins:
+            self._load_plugin(path)
+
+    def _load_plugin(self, path: str) -> bool:
+        """dlopen + register one L7 plugin; a broken .so must not take
+        the agent down (reference: load_plugin error path just logs)."""
+        from deepflow_tpu.agent.plugin import load_so_plugin
+        if path in self.so_plugins:
+            return True
+        try:
+            self.so_plugins[path] = load_so_plugin(path)
+            return True
+        except (OSError, ValueError):
+            return False
 
     def set_vtap_id(self, vtap_id: int) -> None:
         """Fan the assigned id out to every component that stamps it:
@@ -212,6 +230,20 @@ class Agent:
                               cfg.get("max_cpus", 1))
         self.cfg.l7_enabled = bool(cfg.get("l7_log_enabled", True))
         self.cfg.sync_interval_s = cfg.get("sync_interval_s", 60)
+        if "so_plugins" in cfg:   # absent key = leave plugins alone
+            self._sync_plugins(cfg["so_plugins"])
+
+    def _sync_plugins(self, paths) -> None:
+        """Converge loaded plugins to the pushed set: load new paths,
+        unload removed ones (pushing so_plugins=[] must actually stop a
+        plugin from matching traffic)."""
+        from deepflow_tpu.agent.plugin import unload_so_plugin
+        want = set(paths)
+        for path in list(self.so_plugins):
+            if path not in want:
+                unload_so_plugin(self.so_plugins.pop(path))
+        for path in paths:
+            self._load_plugin(path)
 
     def _on_escape(self) -> None:
         """Controller silent too long: fall back to conservative defaults
@@ -240,7 +272,10 @@ class Agent:
             payload = frames[i][int(pkt["payload_off"][i]):]
             rec = parse_payload(payload, proto=int(pkt["proto"][i]),
                                 port_src=int(pkt["port_src"][i]),
-                                port_dst=int(pkt["port_dst"][i]))
+                                port_dst=int(pkt["port_dst"][i]),
+                                ts_ns=int(pkt["timestamp_ns"][i]),
+                                ip_src=int(pkt["ip_src"][i]),
+                                ip_dst=int(pkt["ip_dst"][i]))
             if rec is None:
                 continue
             # session key is direction-agnostic
@@ -338,6 +373,9 @@ class Agent:
         self.guard.close()
         for s in self.senders.values():
             s.close()
+        # unregister our plugins from the process-global parser set: a
+        # successor Agent in this process would otherwise double-register
+        self._sync_plugins(())
 
     def _sync_loop(self) -> None:
         self.sync_once()

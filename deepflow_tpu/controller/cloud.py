@@ -158,6 +158,20 @@ class HttpPlatform:
         return parse_resource_doc(doc, self.domain)
 
 
+# virtual-device name prefixes that must never be picked as a node's
+# primary interface (genesis rows are named "<host>:<ifname>")
+_VIRTUAL_IFACES = ("veth", "br", "docker", "cni", "flannel", "cali",
+                   "lo", "tun", "vxlan", "kube")
+
+
+def _iface_rank(r: Resource):
+    """Primary NIC first: physical-looking names (eth0, ens3, ...) rank
+    ahead of virtual devices, then lexicographic for stability. Plain
+    name-sorting would crown 'br0' over 'eth0'."""
+    ifname = r.name.rsplit(":", 1)[-1]
+    return (ifname.startswith(_VIRTUAL_IFACES), r.name)
+
+
 class KubernetesGatherPlatform:
     """Compiles genesis-reported agent interfaces into a k8s cluster view.
 
@@ -197,7 +211,7 @@ class KubernetesGatherPlatform:
                 r.domain[len(self.genesis_prefix):], []).append(r)
         for host, ifaces in sorted(by_host.items()):
             node_id = _stable_id(self.domain, "pod_node", host)
-            ifaces = sorted(ifaces, key=lambda r: r.name)
+            ifaces = sorted(ifaces, key=_iface_rank)
             out.append(make_resource(
                 "pod_node", node_id, host, domain=self.domain,
                 pod_cluster_id=cluster_id,
@@ -347,18 +361,22 @@ class CloudManager:
         return task
 
     def remove(self, domain: str) -> bool:
+        # the whole pop+close+cascade runs under the manager lock so a
+        # concurrent add() of the same domain is ordered strictly after:
+        # otherwise the new task's first gather could land between the
+        # pop and the cascade and have its fresh resources wiped
         with self._lock:
             task = self._tasks.pop(domain, None)
-        if task is None:
-            return False
-        task.close()
-        # domain deleted -> its resources go too (reference: deleting a
-        # mysql.Domain cascades through recorder cleanup). Under the
-        # task's reconcile lock: close() set _stop, so any gather still
-        # blocked in its platform fetch will discard its snapshot rather
-        # than resurrect the domain after this delete.
-        with task._reconcile_lock:
-            self.recorder.reconcile(domain, [])
+            if task is None:
+                return False
+            task.close()
+            # domain deleted -> its resources go too (reference: deleting
+            # a mysql.Domain cascades through recorder cleanup). Under the
+            # task's reconcile lock: close() set _stop, so any gather
+            # still blocked in its platform fetch will discard its
+            # snapshot rather than resurrect the domain after this delete.
+            with task._reconcile_lock:
+                self.recorder.reconcile(domain, [])
         return True
 
     def get(self, domain: str) -> Optional[CloudTask]:

@@ -277,3 +277,18 @@ def test_add_with_bad_interval_keeps_old_task():
     # the original task survives, still registered and removable
     assert mgr.get("d") is task
     assert mgr.remove("d")
+
+
+def test_k8s_gather_prefers_physical_primary_iface():
+    model = ResourceModel()
+    # bridge sorts before eth0 lexicographically; the rank must still
+    # pick eth0 as the node address
+    model.update_domain("genesis/n1", [
+        make_resource("host", 1, "n1:br0", "genesis/n1", ip="172.17.0.1"),
+        make_resource("host", 2, "n1:eth0", "genesis/n1", ip="10.1.1.1"),
+    ])
+    task = CloudTask(KubernetesGatherPlatform(model, "c", "kd"),
+                     Recorder(model), "kd")
+    assert task.gather_once()
+    node = model.list(type="pod_node", domain="kd")[0]
+    assert node.attr("ip") == "10.1.1.1"

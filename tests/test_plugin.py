@@ -1,0 +1,235 @@
+"""Shared-object L7 plugin runtime: ABI, loader, registry dispatch.
+
+Reference: agent/src/plugin/shared_obj/ (dlopen + fixed symbols +
+SoPluginCounter). The sample plugin is the memcached text protocol
+(native_src/memcached_plugin.cc), built here with g++ -shared.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from deepflow_tpu.agent import l7
+from deepflow_tpu.agent.plugin import (SoPlugin, load_so_plugin,
+                                       loaded_plugins, unload_so_plugin)
+
+SRC = Path(__file__).resolve().parent.parent / "deepflow_tpu" / "agent" / \
+    "native_src"
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="g++ unavailable")
+
+
+@pytest.fixture(scope="module")
+def so_path(tmp_path_factory):
+    out = tmp_path_factory.mktemp("plugins") / "memcached_plugin.so"
+    subprocess.run(
+        ["g++", "-shared", "-fPIC", "-O2", "-std=c++17",
+         str(SRC / "memcached_plugin.cc"), "-o", str(out)],
+        check=True, cwd=str(SRC))
+    return str(out)
+
+
+@pytest.fixture
+def plugin(so_path):
+    p = load_so_plugin(so_path)
+    yield p
+    unload_so_plugin(p)   # keep the global registry clean across tests
+
+
+def test_load_exposes_identity(plugin):
+    assert plugin.proto == 201
+    assert plugin.name == "Memcached"
+    assert plugin in l7.PARSERS
+    assert loaded_plugins() == [plugin]
+
+
+def test_check_and_parse_request(plugin):
+    req = b"get user:42\r\n"
+    assert plugin.check(req)
+    rec = plugin.parse(req)
+    assert rec.proto == 201
+    assert rec.msg_type == l7.MSG_REQUEST
+    assert rec.endpoint == "get user:42"
+    assert rec.req_len == len(req)
+
+
+def test_parse_response_and_errors(plugin):
+    ok = plugin.parse(b"STORED\r\n")
+    assert ok.msg_type == l7.MSG_RESPONSE and ok.status == 0
+    err = plugin.parse(b"SERVER_ERROR out of memory\r\n")
+    assert err.msg_type == l7.MSG_RESPONSE and err.status == 1
+    assert plugin.parse(b"\x16\x03\x01\x00\n\n") is None
+    assert plugin.failures == 1
+
+
+def test_registry_dispatch_and_transport_gate(plugin):
+    rec = l7.parse_payload(b"set session:9 0 60 5\r\nhello\r\n",
+                           proto=6, port_src=5000, port_dst=11211)
+    assert rec is not None and rec.proto == 201
+    assert rec.endpoint == "set session:9"
+    # a TCP-only plugin must not match UDP payloads
+    assert l7.parse_payload(b"get x\r\n", proto=17,
+                            port_src=5000, port_dst=11211) is None
+    # builtins still win their own traffic
+    http = l7.parse_payload(b"GET /api HTTP/1.1\r\n\r\n", proto=6,
+                            port_src=5000, port_dst=80)
+    assert http.proto == l7.L7_HTTP1
+
+
+def test_counters(plugin):
+    plugin.check(b"get k\r\n")
+    plugin.parse(b"get k\r\n")
+    c = plugin.counters()
+    assert c["plugin"] == "Memcached"
+    assert c["calls"] >= 2
+    assert c["exe_us"] >= 0
+
+
+def test_session_aggregation(plugin):
+    agg = l7.SessionAggregator()
+    key = (("10.0.0.1", "10.0.0.2", 5000, 11211), )
+    req = l7.parse_payload(b"get user:42\r\n", proto=6)
+    assert agg.offer(key, req, 1_000_000_000) is None
+    resp = l7.parse_payload(b"VALUE user:42 0 3\r\nabc\r\nEND\r\n", proto=6)
+    merged = agg.offer(key, resp, 1_002_000_000)
+    assert merged["proto"] == 201
+    assert merged["endpoint"] == "get user:42"
+    assert merged["rrt_us"] == 2000
+
+
+def test_bad_so_rejected(tmp_path):
+    bad = tmp_path / "not_a_plugin.so"
+    bad.write_bytes(b"\x7fELF garbage")
+    with pytest.raises(OSError):
+        SoPlugin(str(bad))
+    # a real .so missing the required exports is rejected with ValueError
+    src = tmp_path / "empty.cc"
+    src.write_text("extern \"C\" int unrelated(void) { return 0; }\n")
+    out = tmp_path / "empty.so"
+    subprocess.run(["g++", "-shared", "-fPIC", str(src), "-o", str(out)],
+                   check=True)
+    with pytest.raises(ValueError, match="missing required export"):
+        SoPlugin(str(out))
+
+
+def test_agent_loads_plugins_from_config(so_path):
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    agent = Agent(AgentConfig(so_plugins=(so_path,)))
+    try:
+        assert so_path in agent.so_plugins
+        # a broken path is skipped without taking the agent down
+        assert not agent._load_plugin("/nonexistent/plugin.so")
+        # hot-apply dedupes already-loaded paths
+        agent._apply_config({"so_plugins": [so_path]})
+        assert len(agent.so_plugins) == 1
+    finally:
+        for p in agent.so_plugins.values():
+            unload_so_plugin(p)
+
+
+def test_plugin_through_live_agent(so_path):
+    """Memcached frames through Agent.feed: plugin traffic and builtin
+    traffic interleave, sessions merge, wire records carry the plugin's
+    protocol id (the reference's so-plugin -> l7_flow_log path)."""
+    import numpy as np
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.decode.columnar import decode_l7_records
+    from tests.test_agent import CLIENT, SERVER, eth_ipv4_tcp
+
+    ACK = 0x10
+    T0 = 1_700_000_000_000_000_000
+    agent = Agent(AgentConfig(ingester_addr="127.0.0.1:1",
+                              l7_enabled=True, so_plugins=(so_path,)))
+    agent.set_vtap_id(9)
+    try:
+        frames = [
+            eth_ipv4_tcp(CLIENT, SERVER, 40000, 11211, ACK,
+                         b"get user:42\r\n", seq=1),
+            eth_ipv4_tcp(SERVER, CLIENT, 11211, 40000, ACK,
+                         b"VALUE user:42 0 3\r\nabc\r\nEND\r\n", seq=1),
+            eth_ipv4_tcp(CLIENT, SERVER, 40001, 80, ACK,
+                         b"GET /x HTTP/1.1\r\n\r\n", seq=1),
+            eth_ipv4_tcp(SERVER, CLIENT, 80, 40001, ACK,
+                         b"HTTP/1.1 200 OK\r\n\r\n", seq=1),
+        ]
+        stamps = np.asarray([T0, T0 + 2_000_000,
+                             T0 + 3_000_000, T0 + 4_000_000], np.uint64)
+        assert agent.feed(frames, stamps) == 4
+        with agent._lock:
+            records = list(agent._l7_out)
+        cols = decode_l7_records(records)
+        protos = sorted(cols["l7_protocol"].tolist())
+        assert protos == sorted([201, l7.L7_HTTP1])
+        assert (cols["rrt_us"] > 0).all()
+    finally:
+        for p in agent.so_plugins.values():
+            unload_so_plugin(p)
+        agent.close()
+
+
+def test_plugin_receives_dispatch_context(so_path, tmp_path):
+    """The .so sees real ports/time, not zeros: a plugin that gates on
+    ctx->port_dst must match its port and reject others."""
+    src = tmp_path / "portgate.cc"
+    src.write_text(r'''
+#include "df_plugin.h"
+#include <cstring>
+extern "C" {
+uint8_t df_plugin_proto(void) { return 202; }
+const char* df_plugin_name(void) { return "PortGate"; }
+int df_check_payload(const struct df_parse_ctx* c) {
+  return c->port_dst == 7777 && c->time_ns > 0;
+}
+int df_parse_payload(const struct df_parse_ctx* c,
+                     struct df_l7_record* out) {
+  std::memset(out, 0, sizeof(*out));
+  out->msg_type = DF_MSG_REQUEST;
+  out->req_len = c->payload_size;
+  return DF_ACTION_OK;
+}
+}
+''')
+    out = tmp_path / "portgate.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-std=c++17",
+                    f"-I{SRC}", str(src), "-o", str(out)], check=True)
+    p = load_so_plugin(str(out))
+    try:
+        assert l7.parse_payload(b"xx", proto=6, port_src=1, port_dst=7777,
+                                ts_ns=123).proto == 202
+        assert l7.parse_payload(b"xx", proto=6, port_src=1,
+                                port_dst=7778, ts_ns=123) is None
+        assert l7.parse_payload(b"xx", proto=6, port_src=1, port_dst=7777,
+                                ts_ns=0) is None
+    finally:
+        unload_so_plugin(p)
+
+
+def test_config_push_unloads_plugins(so_path):
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    agent = Agent(AgentConfig(so_plugins=(so_path,)))
+    try:
+        plugin = agent.so_plugins[so_path]
+        assert plugin in l7.PARSERS
+        agent._apply_config({"so_plugins": []})
+        assert agent.so_plugins == {}
+        assert plugin not in l7.PARSERS
+        # a push WITHOUT the key leaves plugins alone
+        agent._apply_config({"so_plugins": [so_path]})
+        agent._apply_config({})
+        assert len(agent.so_plugins) == 1
+    finally:
+        agent.close()
+    # close() unregisters: a successor agent doesn't double-register
+    assert loaded_plugins() == []
+    agent2 = Agent(AgentConfig(so_plugins=(so_path,)))
+    try:
+        assert len(loaded_plugins()) == 1
+    finally:
+        agent2.close()
+    assert loaded_plugins() == []
