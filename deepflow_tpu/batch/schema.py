@@ -202,6 +202,18 @@ L4_SCHEMA = Schema(
 SKETCH_L4_SCHEMA = Schema(name="l4_sketch",
                           columns=_L4_CORE)
 
+# The packed sketch-lane wire: the 7 sketch-consumed columns folded into
+# 4 uint32 planes at the SENDER (models/flow_suite.py pack_lanes /
+# unpack_lanes). 16B/record vs the 68B full sketch row — the tunneled
+# h2d link sustains ~240 MB/s, so wire bytes per record IS the e2e
+# throughput ceiling (bench.py); an agent feeding a TPU ingester ships
+# this stream alongside (not instead of) the full row stream the store
+# needs.
+SKETCH_LANES_SCHEMA = Schema(
+    name="l4_sketch_lanes",
+    columns=(("ip_src", _U32), ("ip_dst", _U32),
+             ("ports", _U32), ("proto_pkts", _U32)))
+
 # -- L7 flow log -----------------------------------------------------------
 # Reference: log_data/l7_flow_log.go L7Base + L7FlowLog :187-286. String
 # fields are *_hash u32 dictionary codes; nullable wire fields use 0 as

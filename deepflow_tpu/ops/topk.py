@@ -18,10 +18,19 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepflow_tpu.ops import cms
 
-SENTINEL = jnp.uint32(0xFFFFFFFF)
+# np scalar, NOT jnp: a jnp.uint32() here is a device array committed to
+# the default backend at import, and any program that embeds such a
+# device-resident constant trips the tunnel's persistent h2d slow mode
+# when COMPILED (bisected 2026-07-30: `jit(lambda b: SENTINEL * b)` alone
+# degrades h2d 569 -> 94 MB/s with the jnp form; identical code with an
+# inline/np constant stays >1.2 GB/s). Earlier "compare-free" theories
+# were chasing a confounder — every tripping program referenced this
+# constant, every clean one didn't.
+SENTINEL = np.uint32(0xFFFFFFFF)
 
 
 class TopKState(NamedTuple):
@@ -36,18 +45,26 @@ def init(ring_size: int) -> TopKState:
     )
 
 
+def _nonzero_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """[n] uint32 1 where x != 0, else 0, via (x | -x) >> 31 — pure
+    arithmetic, no compare/select/minimum op at all."""
+    return (x | (jnp.uint32(0) - x)) >> jnp.uint32(31)
+
+
 def _not_sentinel(keys: jnp.ndarray) -> jnp.ndarray:
     """[n] int32 1 where key != SENTINEL, else 0 — WITHOUT a compare op.
 
     Load-bearing on the remote-TPU runtime: merely COMPILING a program
-    whose elementwise compares consume gather/sort/strided-slice outputs
-    trips a persistent slow mode in the tunnel's transfer layer (every
-    later host->device copy runs ~15-30x slow for the process; verified
-    by bisection — compile alone suffices, compares on plain inputs are
-    fine). The ring path is exactly such a program, so every predicate on
-    moved data here is arithmetic: SENTINEL is u32 max, so
-    min(SENTINEL - k, 1) is 0 iff k == SENTINEL."""
-    return jnp.minimum(SENTINEL - keys, jnp.uint32(1)).astype(jnp.int32)
+    where a compare-class elementwise op (==, where, even jnp.minimum)
+    sits between data-movement ops (gather/sort/roll/strided-slice)
+    trips a persistent slow mode in the tunnel's transfer layer — every
+    later host->device copy runs ~15-30x slow for the process (verified
+    by bisection; compile alone suffices; movement-only and
+    compare-on-inputs-only programs are fine). The ring path is exactly
+    such a program, so every predicate on moved data here is pure
+    arithmetic: SENTINEL is u32 max, so SENTINEL - k is 0 iff k is the
+    sentinel, and _nonzero_u32 turns that into a 0/1 lane."""
+    return _nonzero_u32(SENTINEL - keys).astype(jnp.int32)
 
 
 def _dedup_sorted(k: jnp.ndarray, c: jnp.ndarray):
@@ -56,7 +73,7 @@ def _dedup_sorted(k: jnp.ndarray, c: jnp.ndarray):
     no segment-max scatter, no cumsum. Run boundaries are detected
     arithmetically (sorted ascending => k[i+1] - k[i] is 0 iff equal),
     never with a compare: see _not_sentinel."""
-    diff = jnp.minimum(k[1:] - k[:-1], jnp.uint32(1))
+    diff = _nonzero_u32(k[1:] - k[:-1])
     last_u = jnp.concatenate([diff, jnp.ones((1,), jnp.uint32)])
     last_i = last_u.astype(jnp.int32) * _not_sentinel(k)
     # k where last-of-run, SENTINEL elsewhere; c where kept, -1 elsewhere
@@ -76,10 +93,17 @@ def candidate_keys(state_keys: jnp.ndarray, batch_keys: jnp.ndarray,
                    mask: jnp.ndarray | None = None, sample_log2: int = 0,
                    phase: jnp.ndarray | int = 0) -> jnp.ndarray:
     """Standing ring keys + (sampled) batch keys — the movement half of
-    admission, shared by offer() and the staged pipeline."""
+    admission, shared by offer() and the staged pipeline.
+
+    The mask is applied arithmetically (bool -> u32 - 1 = all-ones where
+    dead, OR'd in = SENTINEL), not with jnp.where: a select whose output
+    feeds roll+strided-slice in the same program is by itself enough to
+    trip the tunnel h2d slow mode (bisected 2026-07-30: where->roll->
+    slice->concat degrades 539->102 MB/s; the same chain with the OR mask
+    or with movement/select alone stays >1.2 GB/s)."""
     bk = batch_keys.astype(jnp.uint32)
     if mask is not None:
-        bk = jnp.where(mask, bk, SENTINEL)
+        bk = bk | (mask.astype(jnp.uint32) - jnp.uint32(1))
     if sample_log2 > 0:
         bk = jnp.roll(bk, -(jnp.asarray(phase) % (1 << sample_log2)))
         bk = bk[:: 1 << sample_log2]
