@@ -39,9 +39,17 @@ eating the e2e number.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def _phase(msg: str) -> None:
+    """Progress marker on stderr (the JSON contract owns stdout): a
+    wedged tunnel shows as a stuck phase instead of a silent hang."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def _to_schema(cols, batch, schema):
@@ -94,8 +102,10 @@ def main() -> None:
                        / (time.perf_counter() - t0))
         return best
 
+    _phase("probe fresh h2d")
     h2d_fresh = h2d_mb_s()
 
+    _phase("staging synthetic pool + payloads")
     # -- stage: one pool of distinct flows, Zipf-picked record streams ----
     agent = SyntheticAgent()
     base = agent.l4_columns(pool_n)
@@ -170,6 +180,7 @@ def main() -> None:
                            {k: jnp.asarray(v) for k, v in lanes.items()},
                            mask_d)
 
+    _phase("timed: packed-lane e2e")
     lane_rate = timed_loop(lane_step, lane_payloads)
 
     # -- timed: e2e full-column wire -> sketch -----------------------------
@@ -178,6 +189,7 @@ def main() -> None:
         return step(state,
                     {k: jnp.asarray(v) for k, v in cols.items()}, mask_d)
 
+    _phase("timed: full-row e2e")
     e2e_rate = timed_loop(col_step, columnar_payloads)
 
     # -- timed: e2e protobuf wire (native decoder, ping-pong buffers) ------
@@ -193,26 +205,39 @@ def main() -> None:
         bufs = [(np.empty((n32, batch), np.uint32),
                  np.empty((n64, batch), np.uint64)) for _ in range(2)]
 
+        import os
+        try:   # affinity-aware: cpu_count() overcounts in pinned cgroups
+            n_threads = len(os.sched_getaffinity(0))
+        except AttributeError:
+            n_threads = os.cpu_count() or 1
+
         def pb_step(state, payload, i):
             buf32, buf64 = bufs[i % 2]
-            rows, bad, _ = native.decode_l4_into(payload, buf32, buf64)
+            rows, bad, _ = native.decode_l4_into(payload, buf32, buf64,
+                                                 n_threads=n_threads)
             cols = {}
             for j, name, dt in sketch_idx:
                 col = buf32[j, :rows]
                 cols[name] = col.view(np.int32) \
                     if np.dtype(dt) == np.int32 else col
-            return step(state,
-                        {k: jnp.asarray(v) for k, v in cols.items()},
-                        mask_d)
+            # pack on host: 16B/record over the link instead of 68B
+            lanes = flow_suite.pack_lanes(cols)
+            return step_packed(
+                state, {k: jnp.asarray(v) for k, v in lanes.items()},
+                mask_d)
 
+        _phase("timed: protobuf e2e")
         pb_rate = timed_loop(pb_step, pb_payloads)
 
     # -- timed: kernel only (device-resident batches, fused program) -------
+    _phase("probe h2d after e2e loops")
     h2d_after = h2d_mb_s()
+    _phase("timed: kernel")
     kernel_rate = timed_loop(
         lambda s, b, i: step(s, b, mask_d), dev_batches,
         close_with_fetch=True)
 
+    _phase("recall pass")
     # -- recall: production config vs exact GROUP BY ----------------------
     # runs LAST: np.asarray fetches below trip the tunnel slow mode.
     # exact side: the device flow_key of every pool row (so both sides use

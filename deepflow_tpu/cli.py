@@ -132,6 +132,23 @@ def cmd_cloud(args) -> int:
     return 0
 
 
+def cmd_genesis(args) -> int:
+    doc = _http(f"{args.controller}/v1/genesis/export")
+    rows = [[d, r["type"], r["id"], r["name"], r.get("ip", "-")]
+            for d, rs in sorted(doc.get("domains", {}).items())
+            for r in rs]
+    _table(rows, ["DOMAIN", "TYPE", "ID", "NAME", "IP"])
+    return 0
+
+
+def cmd_recorder(args) -> int:
+    # one JSON document on stdout (pipe-safe, like the other
+    # JSON-emitting subcommands)
+    print(json.dumps(_http(f"{args.controller}/v1/recorder"),
+                     indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_resource(args) -> int:
     qs = f"?type={args.type}" if args.type else ""
     rows = _http(f"{args.controller}/v1/resources{qs}")
@@ -280,6 +297,13 @@ def build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("resource", help="list resources")
     r.add_argument("--type")
     r.set_defaults(fn=cmd_resource)
+
+    ge = sub.add_parser("genesis", help="agent-reported genesis resources")
+    ge.set_defaults(fn=cmd_genesis)
+
+    rec = sub.add_parser("recorder",
+                         help="recorder counters + tombstones")
+    rec.set_defaults(fn=cmd_recorder)
 
     i = sub.add_parser("ingester", help="ingester membership + debug")
     i.add_argument("action", choices=["set", "assignments", "counters",

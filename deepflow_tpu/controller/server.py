@@ -152,6 +152,15 @@ class ControllerServer:
             return self.monitor.assignments()
         if path == "/v1/cloud/tasks":
             return [vars(i) for i in self.cloud.tasks()]
+        if path == "/v1/recorder":
+            # recorder debug surface (reference: deepflow-ctl recorder):
+            # counters + soft-deleted rows still inside retention
+            return {**self.recorder.counters(),
+                    "genesis": self.genesis_sync.counters(),
+                    "tombstones_rows": [
+                        {"type": r.type, "id": r.id, "name": r.name,
+                         "domain": r.domain}
+                        for r in self.recorder.deleted_resources()]}
         if path == "/health":
             return {"status": "ok"}
         raise KeyError(path)

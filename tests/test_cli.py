@@ -105,3 +105,20 @@ def test_cli_cloud_lifecycle(stack, capsys, tmp_path):
     rc, out = _run(capsys, "--controller", base, "cloud", "delete",
                    "file-d")
     assert rc == 0 and json.loads(out)["deleted"] == "file-d"
+
+
+def test_cli_genesis_and_recorder(stack, capsys):
+    srv, _ = stack
+    base = f"http://127.0.0.1:{srv.port}"
+    import urllib.request
+    req = urllib.request.Request(
+        f"{base}/v1/genesis",
+        data=json.dumps({"ctrl_ip": "10.0.0.1", "host": "n1",
+                         "interfaces": [{"name": "eth0",
+                                         "ip": "10.0.0.1"}]}).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req).read()
+    rc, out = _run(capsys, "--controller", base, "genesis")
+    assert rc == 0 and "n1:eth0" in out and "10.0.0.1" in out
+    rc, out = _run(capsys, "--controller", base, "recorder")
+    assert rc == 0 and "tombstones" in out and "model_version" in out
