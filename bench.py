@@ -39,6 +39,7 @@ eating the e2e number.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -205,7 +206,6 @@ def main() -> None:
         bufs = [(np.empty((n32, batch), np.uint32),
                  np.empty((n64, batch), np.uint64)) for _ in range(2)]
 
-        import os
         try:   # affinity-aware: cpu_count() overcounts in pinned cgroups
             n_threads = len(os.sched_getaffinity(0))
         except AttributeError:

@@ -1,0 +1,100 @@
+"""Multi-host scale-out: DCN x ICI meshes, process-local batch feeding.
+
+Reference: the deployment splits capture across many agents and shards
+agents across ingester replicas (server/controller/monitor/ rebalancing,
+agent/src/sender/uniform_sender.rs one-TCP-stream-per-type); scaling
+past one ingester node is horizontal sharding with no cross-node merge.
+The TPU re-design instead forms ONE logical device mesh across hosts:
+every host runs this same program, `jax.distributed` wires the
+coordination service (the role the reference's controller plays for its
+fleet), each host's receiver feeds only its local batch shard, and
+window merges ride ICI within a host and DCN across hosts — the
+collective backend the task needs where the reference would reach for
+NCCL/MPI.
+
+Axis layout follows the scaling-book recipe: the outer (`dcn_data`)
+axis maps to host boundaries so the only cross-host traffic is the
+window-flush psum/max of sketch state (KBs per second), while the hot
+batch axis (`data`) stays inside each host's ICI domain. A
+batch-sharded suite over the flattened ("data",) mesh of a multi-host
+run therefore still places each record's work on the host that
+received it: `process_local_batch` builds the global array from purely
+local shards with zero data movement.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Join (or stand alone in) a multi-host run; returns process count.
+
+    With no arguments this is a no-op for single-host runs (the common
+    dev path) — callers can use the same code for 1..N hosts. With a
+    coordinator address every host calls this once before touching any
+    jax device API (reference analogue: the agent's sync-first startup,
+    trident.rs boot ordering).
+    """
+    if coordinator is None:
+        return jax.process_count()
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_count()
+
+
+def make_global_mesh(axes: Sequence[str] = ("data",)) -> Mesh:
+    """Mesh over every device of every process.
+
+    1-D (default): one flat `data` axis across all hosts — right for the
+    batch-sharded suites (cross-chip traffic happens only at flush).
+    2-D ("dcn_data", "data"): outer axis = hosts (DCN), inner = each
+    host's chips (ICI), for programs that want explicit host-local
+    collectives before a cross-host reduce.
+    """
+    if len(axes) == 1:
+        from deepflow_tpu.parallel.mesh import make_mesh
+        return make_mesh(axes=axes)   # one construction path for 1-D
+    if len(axes) == 2:
+        # jax.devices() orders by process index, so rows = hosts
+        arr = np.array(jax.devices()).reshape(jax.process_count(),
+                                              jax.local_device_count())
+        return Mesh(arr, axes)
+    raise ValueError(f"axes must be 1-D or 2-D, got {axes!r}")
+
+
+def process_local_batch(cols: Dict[str, np.ndarray], mask: np.ndarray,
+                        mesh: Mesh, axis: str = "data"
+                        ) -> Tuple[Dict, jax.Array]:
+    """Assemble the global sharded batch from THIS host's rows only.
+
+    Each host passes the rows its own receiver decoded (local_rows =
+    global_rows / process_count, the static-shape contract the Batcher
+    already enforces); `make_array_from_process_local_data` places each
+    host's shard on its own devices with no cross-host transfer. The
+    returned arrays are valid inputs to ShardedFlowSuite/
+    ShardedMetricsSuite built over the same mesh.
+    """
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(x: np.ndarray) -> jax.Array:
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return {k: put(np.asarray(v)) for k, v in cols.items()}, \
+        put(np.asarray(mask))
+
+
+def local_shard(arr: jax.Array) -> np.ndarray:
+    """This host's rows of a `data`-sharded global output (e.g. the
+    per-record anomaly scores): fetch only addressable shards."""
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])

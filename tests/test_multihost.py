@@ -1,0 +1,128 @@
+"""Multi-host backend: 2 real processes, one global mesh, merged windows.
+
+The worker script below runs IDENTICALLY in two coordinated processes
+(jax.distributed over localhost, 4 virtual CPU devices each -> one
+8-device global mesh). Each process feeds only its own half of the
+record stream through ShardedFlowSuite via process_local_batch; the
+flush output must match the single-process 8-device run over the full
+stream bit-for-bit — the invariant that makes horizontal ingester
+scale-out (SURVEY §5 distributed backend) safe.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import json, sys
+import numpy as np
+
+coordinator, n_proc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from deepflow_tpu.parallel import (ShardedFlowSuite, init_distributed,
+                                   make_global_mesh, process_local_batch)
+from deepflow_tpu.models import flow_suite
+
+if n_proc > 1:
+    init_distributed(coordinator, n_proc, pid)
+
+import jax
+assert jax.device_count() == 8, jax.device_count()
+
+cfg = flow_suite.FlowSuiteConfig(cms_log2_width=12, ring_size=256,
+                                 hll_groups=64, hll_precision=8,
+                                 entropy_log2_buckets=8)
+mesh = make_global_mesh()
+suite = ShardedFlowSuite(cfg, mesh)
+
+# deterministic global stream, same on every process
+rng = np.random.default_rng(0xD15C0)
+n = 4096
+cols = {name: rng.integers(0, 2**31, n, dtype=np.uint64).astype(dt)
+        for name, dt in
+        __import__("deepflow_tpu.batch.schema",
+                   fromlist=["SKETCH_L4_SCHEMA"]).SKETCH_L4_SCHEMA.columns}
+# a planted heavy hitter in rows [0, 512): every process must see it in
+# the merged top-K even though those rows all land on process 0's shard
+for k in cols:
+    cols[k][:512] = cols[k][0]
+mask = np.ones(n, np.bool_)
+
+local = n // n_proc
+sl = slice(pid * local, (pid + 1) * local)
+local_cols = {k: v[sl] for k, v in cols.items()}
+cols_d, mask_d = process_local_batch(local_cols, mask[sl], mesh)
+
+state = suite.init()
+state = suite.update(state, cols_d, mask_d)
+state, out = suite.flush(state)
+
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "rows": int(out.rows),
+    "top_key": int(np.asarray(out.topk_keys)[0]),
+    "top_count": int(np.asarray(out.topk_counts)[0]),
+    "ent0": float(np.asarray(out.entropies)[0]),
+}))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_worker(coordinator, n_proc, pid, n_devices):
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_devices}",
+        "PYTHONPATH": str(REPO),
+    })
+    return subprocess.Popen(
+        [sys.executable, "-c", WORKER, coordinator, str(n_proc), str(pid)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _result(out: str) -> dict:
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in: {out!r}")
+
+
+def test_two_process_mesh_matches_single_process():
+    # single-process baseline: 8 devices, full stream
+    p = _run_worker("unused", 1, 0, 8)
+    out, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err
+    base = _result(out)
+    assert base["rows"] == 4096
+    assert base["top_count"] >= 512   # the planted heavy hitter
+
+    # the same program, two coordinated processes with 4 devices each
+    coord = f"127.0.0.1:{_free_port()}"
+    workers = [_run_worker(coord, 2, pid, 4) for pid in range(2)]
+    outs = []
+    for w in workers:
+        out, err = w.communicate(timeout=300)
+        assert w.returncode == 0, err
+        outs.append(_result(out))
+
+    for r in outs:
+        assert r["rows"] == base["rows"]
+        assert r["top_key"] == base["top_key"]
+        assert r["top_count"] == base["top_count"]
+        assert r["ent0"] == pytest.approx(base["ent0"], abs=1e-6)
