@@ -94,7 +94,15 @@ def process_local_batch(cols: Dict[str, np.ndarray], mask: np.ndarray,
 
 def local_shard(arr: jax.Array) -> np.ndarray:
     """This host's rows of a `data`-sharded global output (e.g. the
-    per-record anomaly scores): fetch only addressable shards."""
-    shards = sorted(arr.addressable_shards,
-                    key=lambda s: s.index[0].start or 0)
-    return np.concatenate([np.asarray(s.data) for s in shards])
+    per-record anomaly scores): fetch only addressable shards.
+
+    Replicated arrays (flush window scalars, out_spec P()) come back
+    whole, once — every addressable shard covers the full array, so
+    concatenating them would silently duplicate rows."""
+    if arr.is_fully_replicated:
+        return np.asarray(arr)
+    seen = {}
+    for s in arr.addressable_shards:
+        seen.setdefault(s.index[0].start or 0, s.data)
+    return np.concatenate(
+        [np.asarray(seen[k]) for k in sorted(seen)])

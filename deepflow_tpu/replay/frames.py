@@ -1,0 +1,55 @@
+"""Synthetic raw-frame builders: eth/ipv4 tcp+udp, vlan, simple tunnels.
+
+The replay analogue of the reference's packet-crafting test helpers
+(agent/resources/test/ fixture style): hand-built frames that exercise
+the batch packet decoder (agent/packet.py) without a capture device.
+Used by examples, fixture tests, and the replay CLI.
+"""
+
+from __future__ import annotations
+
+import struct
+
+SYN = 0x02
+ACK = 0x10
+FIN = 0x01
+RST = 0x04
+
+
+def ip4(a: int, b: int, c: int, d: int) -> int:
+    """Dotted quad -> the u32 the decoder and schemas carry."""
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def eth_ipv4_tcp(src: int, dst: int, sport: int, dport: int,
+                 flags: int = ACK, payload: bytes = b"", seq: int = 0,
+                 vlan: bool = False) -> bytes:
+    """One eth(+optional 802.1Q)/ipv4/tcp frame."""
+    eth = b"\x02" * 6 + b"\x04" * 6
+    eth += (b"\x81\x00\x00\x01\x08\x00" if vlan else b"\x08\x00")
+    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, 0, 0x50, flags,
+                      8192, 0, 0) + payload
+    total = 20 + len(tcp)
+    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 6, 0,
+                     src, dst)
+    return eth + ip + tcp
+
+
+def eth_ipv4_udp(src: int, dst: int, sport: int, dport: int,
+                 payload: bytes = b"") -> bytes:
+    """One eth/ipv4/udp frame."""
+    eth = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x00"
+    udp = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0) + payload
+    total = 20 + len(udp)
+    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 17, 0,
+                     src, dst)
+    return eth + ip + udp
+
+
+def vxlan(outer_src: int, outer_dst: int, inner_frame: bytes,
+          vni: int = 123) -> bytes:
+    """Wrap an inner frame in vxlan/udp/ipv4 (decap tested in
+    agent/packet.py)."""
+    head = struct.pack(">BBHI", 0x08, 0, 0, vni << 8)
+    return eth_ipv4_udp(outer_src, outer_dst, 5555, 4789,
+                        head + inner_frame)

@@ -1,0 +1,165 @@
+"""The whole product in one process: controller + ingester + querier +
+a live agent, driven end to end.
+
+What a reference (dzy176/deepflow) user gets after switching:
+
+1. all-in-one server boots (election -> resource model -> receiver ->
+   pipelines -> querier), as `server/cmd/server/main.go` does;
+2. a cloud domain is registered (filereader poller) and agent-reported
+   genesis interfaces land beside it;
+3. a real Agent syncs against the controller, captures packet frames
+   (synthetic eth/ipv4/tcp here), runs flow generation + L7 parsing,
+   and ships flows/metrics/l7 logs over the firehose wire;
+4. the ingester decodes, enriches with platform data, stores, and the
+   TPU sketch exporter keeps heavy-hitter/cardinality/entropy windows;
+5. DeepFlow-SQL and PromQL answer over the stored data.
+
+Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python examples/all_in_one_demo.py
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+import time
+import urllib.parse
+import urllib.request
+
+
+def _req(url: str, body=None, form: dict | None = None):
+    data = None
+    headers = {}
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    elif form is not None:
+        data = urllib.parse.urlencode(form).encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.load(resp)
+
+
+def main() -> None:
+    import numpy as np
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.server import Server
+
+    tmp = tempfile.mkdtemp(prefix="df-demo-")
+
+    # -- 1. all-in-one server ---------------------------------------------
+    cfg_path = f"{tmp}/server.yaml"
+    with open(cfg_path, "w") as f:
+        f.write(f"""
+controller:
+  port: 0
+  lease_path: {tmp}/lease.json
+ingester:
+  port: 0
+  store_path: {tmp}/store
+  debug_port: 0
+querier:
+  port: 0
+""")
+    server = Server(cfg_path)
+    server.start()
+    ctl = f"http://127.0.0.1:{server.controller.port}"
+    q = f"http://127.0.0.1:{server.querier.port}"
+    print(f"server up: controller={server.controller.port} "
+          f"ingester={server.ingester.port} querier={server.querier.port}")
+
+    # -- 2. cloud domain + resources --------------------------------------
+    with open(f"{tmp}/cloud.json", "w") as f:
+        json.dump({
+            "vpcs": [{"name": "prod-vpc"}],
+            "subnets": [{"name": "web-subnet", "vpc": "prod-vpc",
+                         "cidr": "10.0.0.0/16", "epc_id": 1}],
+            "pod_clusters": [{"name": "prod"}],
+            "pod_namespaces": [{"name": "default",
+                                "pod_cluster": "prod"}],
+            "services": [{"name": "api", "vpc": "prod-vpc",
+                          "ip": "10.0.0.5", "port": 80}],
+        }, f)
+    _req(f"{ctl}/v1/cloud/domains",
+         {"domain": "aws-prod", "platform": "filereader",
+          "path": f"{tmp}/cloud.json", "interval_s": 3600})
+    r = _req(f"{ctl}/v1/domains/aws-prod/refresh", {})
+    print(f"cloud domain gathered: {r['resource_count']} resources")
+
+    # -- 3. live agent ----------------------------------------------------
+    agent = Agent(AgentConfig(
+        ctrl_ip="10.1.2.3", host="demo-node", controller_url=ctl,
+        ingester_addr=f"127.0.0.1:{server.ingester.port}"))
+    assert agent.sync_once()
+    print(f"agent registered: vtap_id={agent.vtap_id}")
+
+    # synthetic capture: an HTTP conversation between two pods
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+    CLIENT, SERVER = ip4(10, 0, 0, 1), ip4(10, 0, 0, 2)
+    T0 = int(time.time() * 1e9)
+    frames = [
+        eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, 0x02, b"", seq=0),   # SYN
+        eth_ipv4_tcp(SERVER, CLIENT, 80, 41000, 0x12, b"", seq=0),   # SYNACK
+        eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, 0x10,
+                     b"GET /api/users HTTP/1.1\r\nHost: api\r\n\r\n",
+                     seq=1),
+        eth_ipv4_tcp(SERVER, CLIENT, 80, 41000, 0x10,
+                     b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok",
+                     seq=1),
+    ]
+    stamps = np.asarray([T0, T0 + 200_000, T0 + 1_000_000,
+                         T0 + 3_500_000], np.uint64)
+    fed = agent.feed(frames, stamps)
+    sent = agent.tick(T0 + 1_000_000_000)
+    print(f"agent: {fed} packets -> sent {sent}")
+
+    # -- 4. ingester + sketches -------------------------------------------
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        server.ingester.flush()
+        try:
+            counts = [_req(f"{q}/v1/query", form={
+                "db": "flow_log",
+                "sql": f"SELECT Count(*) AS n FROM {t}",
+            })["result"]["values"][0][0] for t in ("l4_flow_log",
+                                                   "l7_flow_log")]
+            if all(counts):
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+
+    # -- 5. queries --------------------------------------------------------
+    flows = _req(f"{q}/v1/query", form={
+        "db": "flow_log",
+        "sql": "SELECT ip_src, ip_dst, port_dst, l7_protocol, "
+               "Sum(byte_tx) AS bytes FROM l4_flow_log "
+               "GROUP BY ip_src, ip_dst, port_dst, l7_protocol",
+    })["result"]
+    print("\nl4 flows:")
+    print("  " + " | ".join(flows["columns"]))
+    for row in flows["values"]:
+        print("  " + " | ".join(str(v) for v in row))
+
+    l7 = _req(f"{q}/v1/query", form={
+        "db": "flow_log",
+        "sql": "SELECT l7_protocol, endpoint_hash, status, rrt_us "
+               "FROM l7_flow_log",
+    })["result"]
+    print("\nl7 requests:")
+    for row in l7["values"]:
+        print("  " + " | ".join(str(v) for v in row))
+
+    tags = _req(f"{q}/v1/query", form={
+        "db": "flow_log", "sql": "SHOW TAGS FROM l4_flow_log"})["result"]
+    print(f"\nSHOW TAGS: {len(tags['values'])} tags available")
+
+    agent.close()
+    server.close()
+    print("\ndemo OK")
+
+
+if __name__ == "__main__":
+    main()

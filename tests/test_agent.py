@@ -18,29 +18,10 @@ from deepflow_tpu.agent.quadruple import flows_to_documents
 from deepflow_tpu.agent.trident import Agent, AgentConfig
 
 
-def _ip(a, b, c, d):
-    return (a << 24) | (b << 16) | (c << 8) | d
-
-
-def eth_ipv4_tcp(src, dst, sport, dport, flags=ACK, payload=b"", seq=0,
-                 vlan=False):
-    eth = b"\x02" * 6 + b"\x04" * 6
-    eth += (b"\x81\x00\x00\x01\x08\x00" if vlan else b"\x08\x00")
-    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, 0, 0x50, flags,
-                      8192, 0, 0) + payload
-    total = 20 + len(tcp)
-    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 6, 0,
-                     src, dst)
-    return eth + ip + tcp
-
-
-def eth_ipv4_udp(src, dst, sport, dport, payload=b""):
-    eth = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x00"
-    udp = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0) + payload
-    total = 20 + len(udp)
-    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 17, 0,
-                     src, dst)
-    return eth + ip + udp
+# the frame builders are product API now (deepflow_tpu.replay.frames);
+# re-exported here because many test modules import them from this module
+from deepflow_tpu.replay.frames import (eth_ipv4_tcp, eth_ipv4_udp,  # noqa: F401
+                                        ip4 as _ip)
 
 
 CLIENT = _ip(10, 0, 0, 1)

@@ -46,10 +46,9 @@ suite = ShardedFlowSuite(cfg, mesh)
 # deterministic global stream, same on every process
 rng = np.random.default_rng(0xD15C0)
 n = 4096
+from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
 cols = {name: rng.integers(0, 2**31, n, dtype=np.uint64).astype(dt)
-        for name, dt in
-        __import__("deepflow_tpu.batch.schema",
-                   fromlist=["SKETCH_L4_SCHEMA"]).SKETCH_L4_SCHEMA.columns}
+        for name, dt in SKETCH_L4_SCHEMA.columns}
 # a planted heavy hitter in rows [0, 512): every process must see it in
 # the merged top-K even though those rows all land on process 0's shard
 for k in cols:
@@ -116,13 +115,37 @@ def test_two_process_mesh_matches_single_process():
     coord = f"127.0.0.1:{_free_port()}"
     workers = [_run_worker(coord, 2, pid, 4) for pid in range(2)]
     outs = []
-    for w in workers:
-        out, err = w.communicate(timeout=300)
-        assert w.returncode == 0, err
-        outs.append(_result(out))
+    try:
+        for w in workers:
+            out, err = w.communicate(timeout=300)
+            assert w.returncode == 0, err
+            outs.append(_result(out))
+    finally:
+        # a failed/hung worker must not linger holding the coordinator
+        # port while its peer blocks in distributed init
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
 
     for r in outs:
         assert r["rows"] == base["rows"]
         assert r["top_key"] == base["top_key"]
         assert r["top_count"] == base["top_count"]
         assert r["ent0"] == pytest.approx(base["ent0"], abs=1e-6)
+
+
+def test_local_shard_single_process():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepflow_tpu.parallel import local_shard, make_global_mesh
+
+    mesh = make_global_mesh()
+    n_dev = len(jax.devices())
+    x = jnp.arange(8 * n_dev, dtype=jnp.int32)
+    sharded = jax.device_put(x, NamedSharding(mesh, P("data")))
+    np.testing.assert_array_equal(local_shard(sharded), np.asarray(x))
+    # replicated arrays come back once, not duplicated per device
+    rep = jax.device_put(x, NamedSharding(mesh, P()))
+    np.testing.assert_array_equal(local_shard(rep), np.asarray(x))
