@@ -96,15 +96,30 @@ class QueryEngine:
 
     # -- SELECT ------------------------------------------------------------
     def _resolve_table(self, name: str, db: Optional[str]) -> Table:
+        # rollup tables are themselves dotted (`flows.1m`), so with a db
+        # in hand the whole name is tried as a table FIRST — otherwise
+        # the first dot would be misread as a db separator and every
+        # rollup table would be unqueryable relative to its db
+        if db is not None:
+            try:
+                return self.store.table(db, name)
+            except KeyError:
+                pass
         if "." in name:
             d, _, t = name.partition(".")
-            return self.store.table(d, t)
-        if db is not None:
-            return self.store.table(db, name)
-        for d, t in self.store.tables():
-            if t == name:
+            try:
                 return self.store.table(d, t)
-        raise KeyError(f"unknown table {name}")
+            except KeyError:
+                pass
+        if db is None:
+            # no db scoping requested: search every database
+            for d, t in self.store.tables():
+                if t == name:
+                    return self.store.table(d, t)
+        # an explicit db must NOT fall through to other databases — a
+        # typo'd db would silently answer from the wrong data
+        raise KeyError(f"unknown table {name}"
+                       + (f" in db {db}" if db is not None else ""))
 
     def _select(self, stmt: Q.Select, db: Optional[str]) -> QueryResult:
         table = self._resolve_table(stmt.table, db)

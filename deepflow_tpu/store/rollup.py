@@ -6,7 +6,11 @@ aggregate functions. The TPU-native re-design runs the same collapse as a
 JAX program: rows are bucketed by (key columns, floor(time/interval)) with
 exact group ids computed on the host (np.unique over packed keys — cheap,
 and collision-free unlike a folded hash), then every metric column is
-segment-reduced in one jitted XLA program at padded static shapes.
+segment-reduced in one jitted XLA program at padded static shapes. At
+hot-table batch sizes on a real accelerator, group_reduce auto-switches
+to the all-device path (_device_group_reduce: one sort + arithmetic
+boundary detect + cumsum ids + segment reductions in one program) so no
+host lexsort sits in front of the reduction.
 """
 
 from __future__ import annotations
@@ -89,18 +93,136 @@ def _unique_rows(packed: np.ndarray):
     return skeys[boundary], inverse
 
 
+@functools.partial(jax.jit, static_argnames=("aggs", "num_segments"))
+def _device_group_reduce(keys: Tuple[jnp.ndarray, ...],
+                         data: jnp.ndarray, mask: jnp.ndarray,
+                         aggs: Tuple[str, ...], num_segments: int):
+    """GROUP BY entirely on device: one sort + arithmetic boundary
+    detection + cumsum group ids + segment reductions, one program.
+
+    keys: n_keys u32 arrays [n]; data [n, m] i64; mask [n]. Invalid rows
+    sort to the end (leading 1-bit key), contribute no boundary, and
+    reduce into the trash segment. Returns (keys_out [n_keys, S],
+    vals [S, m], n_groups scalar) with groups in lexicographic key
+    order in slots [0, n_groups). Boundary predicates are pure
+    arithmetic on the sorted lanes — no compare ops on moved data (the
+    tunnel-safe discipline of ops/topk.py)."""
+    n_keys = len(keys)
+    invalid = jnp.logical_not(mask).astype(jnp.uint32)
+    ops = ((invalid,) + tuple(keys)
+           + tuple(data[:, i] for i in range(data.shape[1])))
+    sorted_ops = jax.lax.sort(ops, num_keys=1 + n_keys)
+    svalid = jnp.uint32(1) - sorted_ops[0]
+    skeys = sorted_ops[1:1 + n_keys]
+    sdata = sorted_ops[1 + n_keys:]
+
+    def _nz(x):   # u32 1 where x != 0, arithmetic only
+        return (x | (jnp.uint32(0) - x)) >> jnp.uint32(31)
+
+    diff = jnp.zeros_like(skeys[0][1:])
+    for k in skeys:
+        diff = diff | _nz(k[1:] - k[:-1])
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.uint32), diff]) * svalid
+    # gid <= valid_rows - 1 < num_segments - 1 == the trash segment, so
+    # a fully-distinct full batch cannot collide with trash
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg = jnp.where(svalid.astype(bool), gid, num_segments - 1)
+    n_groups = jnp.sum(boundary.astype(jnp.int32))
+
+    m = svalid.astype(bool)
+    # reuse the shared per-agg dispatch (it inlines into this program);
+    # seg already routes invalid rows to trash, and _segment_reduce's own
+    # mask handling re-applies the identical mapping
+    vals = _segment_reduce(seg, m, jnp.stack(sdata, axis=1), aggs,
+                           num_segments)
+    # group keys: constant within a group, so segment_max recovers them
+    keys_out = [jax.ops.segment_max(
+        jnp.where(m, k, jnp.uint32(0)).astype(jnp.int64),
+        seg, num_segments=num_segments).astype(jnp.uint32) for k in skeys]
+    return (jnp.stack(keys_out), vals, n_groups)
+
+
+def group_reduce_device(cols: Dict[str, np.ndarray], key_names: List[str],
+                        aggs: Dict[str, str]) -> Dict[str, np.ndarray]:
+    """`group_reduce` with the group-id stage on device too (the full
+    "GROUP BY runs on TPU" path). Key columns must fit uint32 (every
+    schema key column does; the rollup time bucket is epoch seconds).
+    Exactly equal to the host path — asserted in tests. Costs one
+    scalar fetch (n_groups), so on the tunneled dev runtime prefer the
+    host path for latency-sensitive callers (bench.py docstring)."""
+    for nm in key_names:
+        dt = np.asarray(cols[nm]).dtype
+        if dt.kind not in "uib" or dt.itemsize > 4:
+            raise ValueError(
+                f"device GROUP BY key {nm!r} is {dt} — keys must be "
+                "<=32-bit integers to ride the u32 sort lanes (floats "
+                "would truncate-merge, 64-bit ints would collide); use "
+                "the host path")
+    n = len(next(iter(cols.values())))
+    if n == 0:
+        return {nm: cols[nm][:0] for nm in list(key_names) + list(aggs)}
+    rows_pad = _next_pow2(n)
+    value_names = list(aggs.keys())
+
+    def pad_u32(a):
+        out = np.zeros(rows_pad, np.uint32)
+        out[:n] = a.astype(np.uint32)
+        return jnp.asarray(out)
+
+    with jax.enable_x64(True):
+        keys = tuple(pad_u32(np.asarray(cols[nm])) for nm in key_names)
+        data = np.zeros((rows_pad, len(value_names)), np.int64)
+        for i, nm in enumerate(value_names):
+            data[:n, i] = np.asarray(cols[nm]).astype(np.int64)
+        mask = np.zeros(rows_pad, np.bool_)
+        mask[:n] = True
+        keys_out, vals, n_groups = _device_group_reduce(
+            keys, jnp.asarray(data), jnp.asarray(mask),
+            tuple(aggs[nm] for nm in value_names), rows_pad + 1)
+        g = int(n_groups)
+        keys_np = np.asarray(keys_out)[:, :g]
+        vals_np = np.asarray(vals)[:g]
+    out: Dict[str, np.ndarray] = {}
+    for j, nm in enumerate(key_names):
+        out[nm] = keys_np[j].astype(cols[nm].dtype)
+    for i, nm in enumerate(value_names):
+        out[nm] = vals_np[:, i]
+    return out
+
+
 def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
                  aggs: Dict[str, str],
-                 return_inverse: bool = False):
-    """Exact GROUP BY: host group-ids + device segment reduction.
+                 return_inverse: bool = False, method: str = "auto"):
+    """Exact GROUP BY: group ids + segment reduction.
 
     `aggs` maps value column -> sum|max|min|count. Key columns come back
     deduplicated; value columns reduced. Shared by rollups, the querier,
     and the agent flow map. With return_inverse, also returns the [n]
     row->group index (callers needing extra reductions, e.g. bitwise OR,
     reuse it instead of re-grouping).
+
+    method: "host" computes group ids with a host lexsort and reduces on
+    device; "device" runs the whole thing in one device program
+    (group_reduce_device); "auto" picks device on a real accelerator at
+    batch sizes where the host lexsort would dominate (the
+    query-over-hot-table regime). return_inverse always takes the host
+    path — the device path never materializes the row->group map.
     """
     n = len(next(iter(cols.values())))
+    if method == "device" and return_inverse:
+        raise ValueError("the device GROUP BY never materializes the "
+                         "row->group map; use method='host' with "
+                         "return_inverse")
+    # device keys ride u32 lanes: a 64-bit key (mac_src, flow_id) would
+    # collide and a float key would truncate-merge — those group on host
+    keys_fit_u32 = all(np.asarray(cols[k]).dtype.kind in "uib"
+                       and np.asarray(cols[k]).dtype.itemsize <= 4
+                       for k in key_names)
+    if method == "device" or (
+            method == "auto" and not return_inverse and n >= (1 << 18)
+            and keys_fit_u32 and jax.default_backend() != "cpu"):
+        return group_reduce_device(cols, key_names, aggs)
     if n == 0:
         empty = {nm: cols[nm][:0] for nm in list(key_names) + list(aggs)}
         return (empty, np.empty(0, np.int64)) if return_inverse else empty
@@ -203,9 +325,11 @@ class RollupManager:
         n = len(cols[tcol])
         if n == 0:
             return 0
-        bucket = cols[tcol].astype(np.int64) // interval * interval
+        # keep the bucket in the schema's (u32) dtype: an int64 bucket
+        # would disqualify every rollup from the device GROUP BY path
+        bucket = cols[tcol] // np.uint32(interval) * np.uint32(interval)
         work = dict(cols)
-        work[tcol] = bucket
+        work[tcol] = bucket.astype(cols[tcol].dtype)
         key_names = [c.name for c in schema.columns if c.agg is AggKind.KEY]
         if tcol not in key_names:
             key_names.append(tcol)

@@ -537,3 +537,58 @@ def test_prometheus_remote_read(prom):
     finally:
         srv.close()
         dicts.close()
+
+
+def test_query_rollup_table_relative_name(tmp_path):
+    """`FROM flows.1m` with db set must hit the rollup table, not be
+    misread as db `flows` table `1m`."""
+    import numpy as np
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    from deepflow_tpu.store.rollup import RollupManager
+
+    store = Store(str(tmp_path))
+    schema = TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM)))
+    mgr = RollupManager(store, "flow_log", schema, intervals=(60,))
+    t0 = 1_700_000_040
+    mgr.base.append({
+        "timestamp": np.arange(t0, t0 + 120, dtype=np.uint32),
+        "ip": np.tile(np.arange(2, dtype=np.uint32), 60),
+        "bytes": np.full(120, 10, np.uint32)})
+    assert mgr.advance(now=t0 + 600)[60] == 4
+    eng = QueryEngine(store, TagDictRegistry(None))
+    for sql in ("SELECT ip, Sum(bytes) AS b FROM flows.1m GROUP BY ip",
+                "SELECT ip, Sum(bytes) AS b FROM flow_log.flows.1m "
+                "GROUP BY ip"):
+        res = eng.execute(sql, db="flow_log")
+        assert sorted(r[1] for r in res.values) == [600, 600], sql
+
+
+def test_explicit_db_stays_scoped(tmp_path):
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    t = store.create_table("prom", TableSchema(
+        name="samples",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("v", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(3, dtype=np.uint32),
+              "v": np.ones(3, np.uint32)})
+    eng = QueryEngine(store, TagDictRegistry(None))
+    # unscoped: global search finds it
+    assert eng.execute("SELECT Count(*) AS n FROM samples"
+                       ).values[0][0] == 3
+    # a typo'd db must error, not answer from another database
+    with pytest.raises(KeyError, match="flow_log"):
+        eng.execute("SELECT Count(*) AS n FROM samples", db="flow_log")

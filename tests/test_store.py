@@ -174,3 +174,110 @@ def test_disk_monitor_gc(tmp_path):
     assert store.disk_bytes() <= total // 2
     # oldest partitions went first
     assert min(t.partitions()) > 0
+
+
+def test_device_group_reduce_matches_host():
+    """The all-device GROUP BY (sort + boundary + segment reduce in one
+    program) must agree exactly with the host-group-id path, group for
+    group, on every agg kind."""
+    import numpy as np
+
+    from deepflow_tpu.store.rollup import group_reduce, group_reduce_device
+
+    rng = np.random.default_rng(42)
+    for n in (1, 7, 1024, 5000):
+        cols = {
+            "k1": rng.integers(0, 8, n).astype(np.uint32),
+            "k2": rng.integers(0, 5, n).astype(np.uint32),
+            "s": rng.integers(0, 1000, n).astype(np.uint32),
+            "mx": rng.integers(0, 2**31, n).astype(np.uint32),
+            "mn": rng.integers(0, 2**31, n).astype(np.uint32),
+            "c": np.ones(n, np.uint32),
+        }
+        aggs = {"s": "sum", "mx": "max", "mn": "min", "c": "count"}
+        host = group_reduce(cols, ["k1", "k2"], aggs)
+        dev = group_reduce_device(cols, ["k1", "k2"], aggs)
+        # compare group-for-group after a canonical sort on the keys
+        def canon(d):
+            order = np.lexsort((d["k2"], d["k1"]))
+            return {k: np.asarray(v)[order] for k, v in d.items()}
+        h, g = canon(host), canon(dev)
+        assert len(g["k1"]) == len(h["k1"])
+        for k in h:
+            np.testing.assert_array_equal(
+                np.asarray(g[k]).astype(np.int64),
+                np.asarray(h[k]).astype(np.int64), err_msg=f"{k} n={n}")
+
+
+def test_device_group_reduce_empty():
+    import numpy as np
+
+    from deepflow_tpu.store.rollup import group_reduce_device
+
+    out = group_reduce_device(
+        {"k": np.empty(0, np.uint32), "v": np.empty(0, np.uint32)},
+        ["k"], {"v": "sum"})
+    assert len(out["k"]) == 0 and len(out["v"]) == 0
+
+
+def test_device_group_reduce_rejects_wide_keys():
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu.store.rollup import group_reduce_device
+
+    with pytest.raises(ValueError, match="64-bit"):
+        group_reduce_device(
+            {"mac": np.zeros(4, np.uint64), "v": np.ones(4, np.uint32)},
+            ["mac"], {"v": "sum"})
+
+
+def test_group_reduce_device_return_inverse_rejected():
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu.store.rollup import group_reduce
+
+    with pytest.raises(ValueError, match="row->group"):
+        group_reduce({"k": np.ones(4, np.uint32),
+                      "v": np.ones(4, np.uint32)},
+                     ["k"], {"v": "sum"}, return_inverse=True,
+                     method="device")
+
+
+def test_device_group_reduce_rejects_float_keys():
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu.store.rollup import group_reduce_device
+
+    with pytest.raises(ValueError, match="32-bit integers"):
+        group_reduce_device(
+            {"f": np.zeros(4, np.float32), "v": np.ones(4, np.uint32)},
+            ["f"], {"v": "sum"})
+
+
+def test_rollup_keys_stay_device_eligible(tmp_path):
+    """The rollup bucket keeps its u32 dtype so rollups qualify for the
+    device GROUP BY (an i64 bucket made the auto-switch dead code)."""
+    import numpy as np
+
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.rollup import RollupManager
+
+    store = Store(str(tmp_path))
+    schema = TableSchema(
+        name="t",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM)))
+    mgr = RollupManager(store, "db", schema, intervals=(60,))
+    t0 = 1_700_000_040   # minute-aligned: exactly 2 buckets in 120 rows
+    mgr.base.append({
+        "timestamp": np.arange(t0, t0 + 120, dtype=np.uint32),
+        "ip": np.tile(np.arange(2, dtype=np.uint32), 60),
+        "bytes": np.ones(120, np.uint32)})
+    emitted = mgr.advance(now=t0 + 300)
+    assert emitted[60] == 4   # 2 minutes x 2 ips
+    out = store.table("db", "t.1m").scan()
+    assert sorted(out["bytes"].tolist()) == [30, 30, 30, 30]
