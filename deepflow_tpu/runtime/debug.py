@@ -4,7 +4,9 @@ Reference: server/libs/debug — a UDP request/response protocol every
 ingester module registers into, driven by `deepflow-ctl ingester ...`.
 Here requests/responses are single-datagram JSON: {"cmd": ...} in,
 {"ok": ..., "data": ...} out. Commands: counters (scrape the Countable
-registry), vtap-status (receiver per-agent sequence tracking), ping.
+registry), vtap-status (receiver per-agent sequence tracking), ping,
+stacks (every thread's current Python stack — the self-profiling role
+the reference's pprof server on :9526 plays, server/cmd/server/main.go).
 """
 
 from __future__ import annotations
@@ -26,6 +28,7 @@ class DebugServer:
         self._handlers: Dict[str, Callable[[dict], object]] = {
             "ping": lambda req: "pong",
             "counters": self._counters,
+            "stacks": self._stacks,
         }
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
@@ -46,6 +49,22 @@ class DebugServer:
         for s in self.stats.collect():
             if module is None or s.module.startswith(module):
                 out[s.module] = s.values
+        return out
+
+    @staticmethod
+    def _stacks(req: dict) -> dict:
+        """Live stack of every thread, keyed "name (tid)". The one-shot
+        on-demand form of the reference's always-on pprof endpoint —
+        enough to see where a wedged decoder/sender/window thread sits
+        without attaching a debugger to the process."""
+        import sys
+        import traceback
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            key = f"{names.get(tid, '?')} ({tid})"
+            out[key] = [f"{f.filename}:{f.lineno} {f.name}"
+                        for f in traceback.extract_stack(frame)][-8:]
         return out
 
     def start(self) -> None:
@@ -76,8 +95,15 @@ class DebugServer:
                     resp = {"ok": True, "data": handler(req)}
             except Exception as e:
                 resp = {"ok": False, "error": str(e)}
+            payload = json.dumps(resp).encode()
+            if len(payload) > 65000:   # single-datagram protocol
+                payload = json.dumps({
+                    "ok": False,
+                    "error": f"response too large ({len(payload)} bytes) "
+                             "for one datagram; narrow with --module"}
+                ).encode()
             try:
-                self._sock.sendto(json.dumps(resp).encode(), addr)
+                self._sock.sendto(payload, addr)
             except OSError:
                 pass
 

@@ -148,9 +148,11 @@ def group_reduce_device(cols: Dict[str, np.ndarray], key_names: List[str],
     """`group_reduce` with the group-id stage on device too (the full
     "GROUP BY runs on TPU" path). Key columns must fit uint32 (every
     schema key column does; the rollup time bucket is epoch seconds).
-    Exactly equal to the host path — asserted in tests. Costs one
-    scalar fetch (n_groups), so on the tunneled dev runtime prefer the
-    host path for latency-sensitive callers (bench.py docstring)."""
+    Exactly equal to the host path, including group order (signed keys
+    ride the lanes sign-bit-flipped so they sort like int64) — asserted
+    in tests. Costs one scalar fetch (n_groups), so on the tunneled dev
+    runtime prefer the host path for latency-sensitive callers
+    (bench.py docstring)."""
     for nm in key_names:
         dt = np.asarray(cols[nm]).dtype
         if dt.kind not in "uib" or dt.itemsize > 4:
@@ -167,7 +169,15 @@ def group_reduce_device(cols: Dict[str, np.ndarray], key_names: List[str],
 
     def pad_u32(a):
         out = np.zeros(rows_pad, np.uint32)
-        out[:n] = a.astype(np.uint32)
+        a = np.asarray(a)
+        if a.dtype.kind == "i":
+            # sign-bit flip: order-preserving signed -> u32 mapping, so
+            # groups come back in the SAME lexicographic order as the
+            # host path even with negative keys (e.g. l3_epc_id = -1)
+            out[:n] = a.astype(np.int64).astype(np.uint32) ^ np.uint32(
+                0x80000000)
+        else:
+            out[:n] = a.astype(np.uint32)
         return jnp.asarray(out)
 
     with jax.enable_x64(True):
@@ -185,7 +195,10 @@ def group_reduce_device(cols: Dict[str, np.ndarray], key_names: List[str],
         vals_np = np.asarray(vals)[:g]
     out: Dict[str, np.ndarray] = {}
     for j, nm in enumerate(key_names):
-        out[nm] = keys_np[j].astype(cols[nm].dtype)
+        k = keys_np[j]
+        if np.asarray(cols[nm]).dtype.kind == "i":
+            k = k ^ np.uint32(0x80000000)   # undo the sign-bit flip
+        out[nm] = k.astype(cols[nm].dtype)
     for i, nm in enumerate(value_names):
         out[nm] = vals_np[:, i]
     return out

@@ -231,3 +231,23 @@ def test_receiver_garbage_tcp_counted(receiver):
     with socket.create_connection(("127.0.0.1", r.bound_port)) as s:
         s.sendall(b"\xff" * 64)   # frame_size way over max
     assert _wait(lambda: r.rx_errors >= 1)
+
+
+def test_debug_stacks():
+    """The stacks debug command returns every live thread's frames (the
+    pprof-analogue one-shot profiler)."""
+    from deepflow_tpu.runtime.debug import DebugServer, debug_request
+    from deepflow_tpu.runtime.stats import StatsRegistry
+
+    srv = DebugServer(StatsRegistry(), port=0)
+    srv.start()
+    try:
+        out = debug_request("stacks", port=srv.port)
+        assert out["ok"]
+        names = list(out["data"])
+        assert any("MainThread" in k for k in names)
+        assert any("debug-udp" in k for k in names)
+        frames = next(iter(out["data"].values()))
+        assert all(":" in f for f in frames)
+    finally:
+        srv.close()

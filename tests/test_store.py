@@ -281,3 +281,19 @@ def test_rollup_keys_stay_device_eligible(tmp_path):
     assert emitted[60] == 4   # 2 minutes x 2 ips
     out = store.table("db", "t.1m").scan()
     assert sorted(out["bytes"].tolist()) == [30, 30, 30, 30]
+
+
+def test_device_group_reduce_signed_keys_order():
+    """Signed keys (l3_epc_id = -1) must come back in the SAME order as
+    the host path: the u32 lanes carry them sign-bit-flipped."""
+    import numpy as np
+
+    from deepflow_tpu.store.rollup import group_reduce, group_reduce_device
+
+    cols = {"epc": np.array([5, -1, 0, -1, 5, 0, -7], np.int32),
+            "v": np.arange(7, dtype=np.uint32)}
+    host = group_reduce(cols, ["epc"], {"v": "sum"}, method="host")
+    dev = group_reduce_device(cols, ["epc"], {"v": "sum"})
+    np.testing.assert_array_equal(np.asarray(dev["epc"]), host["epc"])
+    np.testing.assert_array_equal(np.asarray(dev["v"]), host["v"])
+    assert host["epc"].tolist() == [-7, -1, 0, 5]
