@@ -121,6 +121,22 @@ def main() -> None:
 
     only = args.only.split(",") if args.only else None
 
+    def host_bench(name, shape, fn, rows, iters, bench_backend="host"):
+        """Plain-callable timing (warmup once, time `iters`) with the
+        same JSON emit as the jitted benches."""
+        if only is not None and name not in only:
+            return
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        dt = time.perf_counter() - t0
+        r = {"bench": name, "shape": shape, "backend": bench_backend,
+             "ms_per_iter": round(1e3 * dt / iters, 3),
+             "rows_per_sec": round(rows * iters / dt)}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
     # -- device GROUP BY vs host group-ids --------------------------------
     from deepflow_tpu.store.rollup import group_reduce
 
@@ -128,47 +144,24 @@ def main() -> None:
              "port": rng.integers(0, 64, n).astype(np.uint32),
              "bytes": rng.integers(0, 1500, n).astype(np.uint32)}
     for method in ("host", "device"):
-        if only is not None and f"group_reduce_{method}" not in only:
-            continue
-        for _ in range(2):
-            group_reduce(gcols, ["ip", "port"], {"bytes": "sum"},
-                         method=method)
-        t0 = time.perf_counter()
-        it = max(4, args.iters // 4)
-        for _ in range(it):
-            group_reduce(gcols, ["ip", "port"], {"bytes": "sum"},
-                         method=method)
-        dt = time.perf_counter() - t0
-        r = {"bench": f"group_reduce_{method}",
-             "shape": f"[{n}] rows, 2 keys",
-             "backend": backend,
-             "ms_per_iter": round(1e3 * dt / it, 3),
-             "rows_per_sec": round(n * it / dt)}
-        results.append(r)
-        print(json.dumps(r), flush=True)
+        host_bench(
+            f"group_reduce_{method}", f"[{n}] rows, 2 keys",
+            lambda m=method: group_reduce(gcols, ["ip", "port"],
+                                          {"bytes": "sum"}, method=m),
+            rows=n, iters=max(4, args.iters // 4), bench_backend=backend)
 
     # -- sketch-lane pack (host) ------------------------------------------
-    if only is None or "pack_lanes" in only:
-        from deepflow_tpu.models import flow_suite
+    from deepflow_tpu.models import flow_suite
 
-        pcols = {k: rng.integers(0, 2**31, n, dtype=np.uint64)
-                 .astype(np.uint32)
-                 for k in ("ip_src", "ip_dst", "port_src", "port_dst",
-                           "proto", "packet_tx", "packet_rx")}
-        flow_suite.pack_lanes(pcols)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            flow_suite.pack_lanes(pcols)
-        dt = time.perf_counter() - t0
-        r = {"bench": "pack_lanes", "shape": f"[{n}] rows -> 4 planes",
-             "backend": "host",
-             "ms_per_iter": round(1e3 * dt / args.iters, 3),
-             "rows_per_sec": round(n * args.iters / dt)}
-        results.append(r)
-        print(json.dumps(r), flush=True)
+    pcols = {k: rng.integers(0, 2**31, n, dtype=np.uint64).astype(np.uint32)
+             for k in ("ip_src", "ip_dst", "port_src", "port_dst",
+                       "proto", "packet_tx", "packet_rx")}
+    host_bench("pack_lanes", f"[{n}] rows -> 4 planes",
+               lambda: flow_suite.pack_lanes(pcols), rows=n,
+               iters=args.iters)
 
     # -- native decoder (host C++, no jit) --------------------------------
-    if not args.only or "native_decode" in args.only.split(","):
+    if only is None or "native_decode" in only:
         from deepflow_tpu.decode import native
         from deepflow_tpu.replay.generator import SyntheticAgent
         from deepflow_tpu.wire.codec import pack_pb_records

@@ -59,6 +59,13 @@ class IdNameDict:
         with self._lock:
             return self._map.get(int(id))
 
+    def ids_for_name(self, name: str) -> List[int]:
+        """Reverse lookup for WHERE-by-name (reference: dictGet-joined
+        name conditions). Names are not unique across domains, so all
+        matching ids come back."""
+        with self._lock:
+            return [i for i, n in self._map.items() if n == name]
+
     def snapshot(self) -> Dict[int, str]:
         """One locked copy for bulk lookups (querier humanization)."""
         with self._lock:

@@ -193,19 +193,29 @@ class QueryEngine:
         return (lo or 0, hi if hi is not None else 1 << 62), residual
 
     def _cond_value(self, column: str, value):
-        """Translate string literals on hash columns through the dicts.
-        Lookup-only (never grows a dictionary); an unknown string returns
-        None, meaning the condition matches nothing."""
+        """Translate string literals on hash columns through the dicts,
+        and on KnowledgeGraph id columns through the tagrecorder (the
+        reference's auto-tag: WHERE pod_id = 'api-0' filters by resource
+        NAME). Lookup-only (never grows a dictionary); an unknown string
+        returns None, meaning the condition matches nothing. Duplicate
+        resource names return a list — the caller widens = to IN."""
         if isinstance(value, str):
             dict_names = DICT_COLUMNS.get(column)
-            if dict_names is None or self.tag_dicts is None:
-                raise ValueError(
-                    f"string literal on non-dictionary column {column}")
-            for dn in dict_names:
-                h = self.tag_dicts.get(dn).lookup(value)
-                if h is not None:
-                    return h
-            return None
+            if dict_names is not None and self.tag_dicts is not None:
+                for dn in dict_names:
+                    h = self.tag_dicts.get(dn).lookup(value)
+                    if h is not None:
+                        return h
+                return None
+            if self.tagrecorder is not None:
+                d = self.tagrecorder.dict_for_column(column)
+                if d is not None:
+                    ids = d.ids_for_name(value)
+                    if not ids:
+                        return None
+                    return ids[0] if len(ids) == 1 else ids
+            raise ValueError(
+                f"string literal on non-dictionary column {column}")
         return value
 
     def _filter_mask(self, cols: Dict[str, np.ndarray],
@@ -216,14 +226,28 @@ class QueryEngine:
         for c in conds:
             col = cols[c.column]
             if c.op == "in":
-                vals = [v for v in (self._cond_value(c.column, x)
-                                    for x in c.value) if v is not None]
+                vals = []
+                for x in c.value:
+                    v = self._cond_value(c.column, x)
+                    if v is None:
+                        continue
+                    # a duplicate resource name maps to several ids
+                    vals.extend(v if isinstance(v, list) else [v])
                 m = np.isin(col, np.asarray(vals, dtype=col.dtype)) if vals \
                     else np.zeros(len(col), np.bool_)
             else:
                 raw = self._cond_value(c.column, c.value)
                 if raw is None:  # unknown dictionary string
                     m = np.full(len(col), c.op == "!=")
+                elif isinstance(raw, list):
+                    # a resource name shared by several ids: = widens to
+                    # membership, != to non-membership
+                    if c.op not in ("=", "!="):
+                        raise ValueError(
+                            f"ordering comparison with name "
+                            f"{c.value!r} matching {len(raw)} resources")
+                    member = np.isin(col, np.asarray(raw, dtype=col.dtype))
+                    m = member if c.op == "=" else ~member
                 else:
                     v = np.asarray(raw).astype(col.dtype)
                     m = {"=": col == v, "!=": col != v, "<": col < v,

@@ -592,3 +592,52 @@ def test_explicit_db_stays_scoped(tmp_path):
     # a typo'd db must error, not answer from another database
     with pytest.raises(KeyError, match="flow_log"):
         eng.execute("SELECT Count(*) AS n FROM samples", db="flow_log")
+
+
+def test_where_by_resource_name(tmp_path):
+    """WHERE pod_id = 'name' filters through the tagrecorder (the
+    reference's auto-tag name conditions), including duplicate names."""
+    import numpy as np
+
+    from deepflow_tpu.controller import ResourceModel
+    from deepflow_tpu.controller.model import make_resource
+    from deepflow_tpu.controller.tagrecorder import TagRecorder
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    model = ResourceModel()
+    model.update_domain("d", [
+        make_resource("pod", 7, "api-0", "d"),
+        make_resource("pod", 8, "web-0", "d"),
+        make_resource("pod", 9, "api-0", "d"),   # same name, other ns
+    ])
+    tr = TagRecorder(model)
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_log", TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("pod_id_0", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(4, dtype=np.uint32),
+              "pod_id_0": np.array([7, 8, 9, 7], np.uint32),
+              "bytes": np.array([10, 20, 30, 40], np.uint32)})
+    eng = QueryEngine(store, TagDictRegistry(None), tagrecorder=tr)
+    res = eng.execute("SELECT Sum(bytes) AS b FROM flows "
+                      "WHERE pod_id_0 = 'api-0'", db="flow_log")
+    assert res.values[0][0] == 80   # ids 7 and 9
+    res = eng.execute("SELECT Sum(bytes) AS b FROM flows "
+                      "WHERE pod_id_0 = 'web-0'", db="flow_log")
+    assert res.values[0][0] == 20
+    res = eng.execute("SELECT Sum(bytes) AS b FROM flows "
+                      "WHERE pod_id_0 != 'api-0'", db="flow_log")
+    assert res.values[0][0] == 20
+    # unknown name matches nothing
+    res = eng.execute("SELECT Count(*) AS n FROM flows "
+                      "WHERE pod_id_0 = 'nope'", db="flow_log")
+    assert res.values[0][0] == 0
+    # IN with a duplicate name flattens to all matching ids
+    res = eng.execute("SELECT Sum(bytes) AS b FROM flows "
+                      "WHERE pod_id_0 IN ('api-0', 'web-0')",
+                      db="flow_log")
+    assert res.values[0][0] == 100
