@@ -113,6 +113,17 @@ def main() -> None:
           lambda s, k, sk: topk.offer(s, k, sk),
           lambda: topk.init(ring_size=512), keys, query_sketch, rows=n)
 
+    # -- ddsketch ----------------------------------------------------------
+    from deepflow_tpu.ops import ddsketch
+
+    dd_cfg = ddsketch.DDSketchConfig()
+    rrt = jnp.asarray(rng.integers(1, 1_000_000, n).astype(np.uint32))
+    bench("ddsketch_update",
+          f"[{n}] values, {dd_cfg.groups}x{dd_cfg.buckets}",
+          lambda s, g, v: ddsketch.update(s, g, v, cfg=dd_cfg),
+          lambda: ddsketch.init(dd_cfg),
+          (groups % np.uint32(1024)).astype(jnp.int32), rrt, rows=n)
+
     # -- pca ---------------------------------------------------------------
     x = jnp.asarray(rng.normal(size=(min(n, 1 << 17), 12)), jnp.float32)
     bench("pca_update", f"[{x.shape[0]},12] k=3",

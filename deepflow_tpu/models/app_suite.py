@@ -1,0 +1,120 @@
+"""AppSuite: per-service RED metrics (Rate/Errors/Duration) on device.
+
+Role: the reference's application observability reads request rates,
+error ratios, and latency quantiles per service out of ClickHouse —
+vtap_app_* meter tables for rate/error sums (server/ingester/
+flow_metrics/dbwriter) and `quantile()` over l7_flow_log.rrt at query
+time (querier derived metrics). A streaming TPU backend keeps the same
+answers as device sketches instead: one batched update per l7 window
+advances, for every hashed service group at once,
+
+- request counts            (histogram over the service space, MXU)
+- error counts              (same histogram, error-masked lanes)
+- latency DDSketch          (ops/ddsketch: mergeable log buckets)
+
+`flush` returns per-group request/error counts and p50/p95/p99 with
+bounded relative error. Everything merges by add, so multi-chip runs
+psum the state exactly like the other suites (parallel/sharded.py
+pattern); windows replay-merge for checkpoints the same way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import ddsketch, mxu_hist
+from deepflow_tpu.utils.u32 import fold_columns
+
+
+@dataclass(frozen=True)
+class AppSuiteConfig:
+    groups: int = 1024            # hashed service space
+    dd_buckets: int = 512         # see DDSketchConfig: range = g^buckets
+    dd_alpha: float = 0.02
+    quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+    @property
+    def dd(self) -> ddsketch.DDSketchConfig:
+        return ddsketch.DDSketchConfig(groups=self.groups,
+                                       buckets=self.dd_buckets,
+                                       alpha=self.dd_alpha)
+
+
+class AppSuiteState(NamedTuple):
+    requests: jnp.ndarray         # [groups] f32
+    errors: jnp.ndarray           # [groups] f32
+    rrt: ddsketch.DDSketchState
+
+
+class AppWindowOutput(NamedTuple):
+    requests: jnp.ndarray         # [groups] f32
+    errors: jnp.ndarray           # [groups] f32 (count — ratios don't
+    #                               aggregate across windows)
+    error_ratio: jnp.ndarray      # [groups] f32 in [0, 1]
+    rrt_quantiles: jnp.ndarray    # [len(quantiles), groups] f32 (us)
+
+
+def init(cfg: AppSuiteConfig) -> AppSuiteState:
+    return AppSuiteState(
+        requests=jnp.zeros((cfg.groups,), jnp.float32),
+        errors=jnp.zeros((cfg.groups,), jnp.float32),
+        rrt=ddsketch.init(cfg.dd),
+    )
+
+
+def service_group(cols: Dict[str, jnp.ndarray], groups: int) -> jnp.ndarray:
+    """[n] int32 hashed service id from the l7 row's server side —
+    the same (ip, port, proto) key space as flow_suite.service_key."""
+    key = fold_columns([cols["ip_dst"], cols["port_dst"],
+                        cols.get("protocol", cols.get("proto"))])
+    return (key % np.uint32(groups)).astype(jnp.int32)
+
+
+def update(state: AppSuiteState, cols: Dict[str, jnp.ndarray],
+           mask: jnp.ndarray, cfg: AppSuiteConfig) -> AppSuiteState:
+    """One static-shape l7 batch: needs ip_dst/port_dst/protocol (the
+    service key), status (0 ok), and rrt_us columns."""
+    group = service_group(cols, cfg.groups)
+    status = cols["status"].astype(jnp.uint32)
+    # the status column carries protocol-native codes: HTTP parsers
+    # store the raw response code (200/404/500, agent/l7.py HttpParser),
+    # the enum-style parsers store 0 ok / small nonzero error codes
+    # (MySQL/Redis/DNS rcode). Error = HTTP 4xx/5xx, or a nonzero
+    # sub-100 enum code; HTTP 1xx-3xx are NOT errors.
+    is_err = (status >= 400) | ((status > 0) & (status < 100))
+    err_mask = jnp.logical_and(mask, is_err)
+    req = mxu_hist.hist_masked(group[None, :], cfg.groups, None,
+                               mask).reshape(-1)
+    err = mxu_hist.hist_masked(group[None, :], cfg.groups, None,
+                               err_mask).reshape(-1)
+    rrt = ddsketch.update(state.rrt, group, cols["rrt_us"], mask=mask,
+                          cfg=cfg.dd)
+    return AppSuiteState(requests=state.requests + req,
+                         errors=state.errors + err, rrt=rrt)
+
+
+def merge(a: AppSuiteState, b: AppSuiteState) -> AppSuiteState:
+    """Exact union: the psum/window-merge form (every field adds)."""
+    return AppSuiteState(requests=a.requests + b.requests,
+                         errors=a.errors + b.errors,
+                         rrt=ddsketch.merge(a.rrt, b.rrt))
+
+
+def flush(state: AppSuiteState, cfg: AppSuiteConfig
+          ) -> Tuple[AppSuiteState, AppWindowOutput]:
+    qs = jnp.stack([ddsketch.quantile(state.rrt, q, cfg.dd)
+                    for q in cfg.quantiles])
+    safe = jnp.maximum(state.requests, 1.0)
+    out = AppWindowOutput(
+        requests=state.requests,
+        errors=state.errors,
+        error_ratio=state.errors / safe,
+        rrt_quantiles=qs,
+    )
+    return init(cfg), out

@@ -45,6 +45,9 @@ class IngesterConfig:
     # enable the TPU sketch analytics exporter (BASELINE.json's
     # tpu_sketch plugin); None disables, a float sets window seconds
     tpu_sketch_window_s: Optional[float] = None
+    # per-service RED windows from the l7 stream (runtime/app_red.py);
+    # None disables, a float sets window seconds
+    app_red_window_s: Optional[float] = None
 
 
 class Ingester:
@@ -74,6 +77,13 @@ class Ingester:
                 store=self.store, window_seconds=cfg.tpu_sketch_window_s,
                 checkpoint_dir=ckpt_dir, stats=self.stats)
             self.exporters.register(self.tpu_sketch)
+        self.app_red = None
+        if cfg.app_red_window_s is not None:
+            from deepflow_tpu.runtime.app_red import AppRedExporter
+            self.app_red = AppRedExporter(
+                store=self.store, window_seconds=cfg.app_red_window_s,
+                stats=self.stats)
+            self.exporters.register(self.app_red)
         self.receiver = Receiver(port=cfg.listen_port, host=cfg.listen_host,
                                  stats=self.stats)
         self.flow_log = FlowLogPipeline(
@@ -123,6 +133,8 @@ class Ingester:
             p.flush()
         if self.tpu_sketch is not None:
             self.tpu_sketch.flush()
+        if self.app_red is not None:
+            self.app_red.flush()
         self.tag_dicts.flush()
 
     def close(self) -> None:

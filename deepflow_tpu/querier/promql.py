@@ -34,7 +34,7 @@ _UNIT_S = {"s": 1, "m": 60, "h": 3600}
 @dataclass
 class PromQuery:
     metric: str
-    matchers: List[Tuple[str, str, str]]   # (label, op, value); op =|!=|=~
+    matchers: List[Tuple[str, str, str]]  # (label, op, value); =|!=|=~|!~
     range_s: Optional[int] = None
     rate: bool = False
     agg: Optional[str] = None
@@ -51,8 +51,9 @@ def parse_promql(q: str) -> PromQuery:
             part = part.strip()
             if not part:
                 continue
-            mm = re.match(r'([A-Za-z_][A-Za-z0-9_]*)\s*(=~|!=|=)\s*"([^"]*)"',
-                          part)
+            mm = re.match(
+                r'([A-Za-z_][A-Za-z0-9_]*)\s*(=~|!~|!=|=)\s*"([^"]*)"',
+                part)
             if not mm:
                 raise ValueError(f"bad matcher {part!r}")
             matchers.append((mm.group(1), mm.group(2), mm.group(3)))

@@ -1,0 +1,169 @@
+"""AppRedExporter: per-service RED windows from the l7 stream.
+
+Role: the reference answers service rate/error/latency from ClickHouse
+(vtap_app_* meter sums + `quantile()` over l7_flow_log.rrt at query
+time). Here the l7 firehose drives models/app_suite on device — request
+and error histograms plus a DDSketch per hashed service — and each
+window writes one row per active service group into
+`tpu_sketch.app_red` (requests, error_ratio, p50/p95/p99 rrt), which
+the querier reads like any other table. Same exporter shape as
+tpu_sketch.TpuSketchExporter: QueueWorkerExporter subscription,
+host-side batching to static shapes, windowed flush, donated state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from deepflow_tpu.batch.batcher import Batcher
+from deepflow_tpu.batch.schema import Schema
+from deepflow_tpu.models import app_suite
+from deepflow_tpu.runtime.exporters import QueueWorkerExporter
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.store.db import Store
+from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
+from deepflow_tpu.store.writer import StoreWriter
+
+APP_RED_DB = "tpu_sketch"
+
+APP_RED_TABLE = TableSchema(
+    name="app_red",
+    columns=(
+        ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("service_group", np.dtype(np.uint32), AggKind.KEY),
+        # counts, not ratios: ratios cannot aggregate across windows
+        # (the repo convention — querier derived metrics divide SUMs at
+        # query time, querier/metrics.py l7_error_ratio)
+        ColumnSpec("requests", np.dtype(np.uint32), AggKind.SUM),
+        ColumnSpec("errors", np.dtype(np.uint32), AggKind.SUM),
+        ColumnSpec("rrt_p50_us", np.dtype(np.float32), AggKind.MAX),
+        ColumnSpec("rrt_p95_us", np.dtype(np.float32), AggKind.MAX),
+        ColumnSpec("rrt_p99_us", np.dtype(np.float32), AggKind.MAX),
+    ),
+)
+
+# the l7 columns the suite consumes, batched to static shapes
+_RED_SCHEMA = Schema(name="l7_red", columns=(
+    ("ip_dst", np.dtype(np.uint32)),
+    ("port_dst", np.dtype(np.uint32)),
+    ("protocol", np.dtype(np.uint32)),
+    ("status", np.dtype(np.uint32)),
+    ("rrt_us", np.dtype(np.uint32)),
+))
+
+
+class AppRedExporter(QueueWorkerExporter):
+    """l7_flow_log -> AppSuite windows -> app_red rows."""
+
+    def __init__(self, store: Optional[Store] = None,
+                 cfg: Optional[app_suite.AppSuiteConfig] = None,
+                 batch_rows: int = 1 << 14,
+                 window_seconds: float = 1.0,
+                 stats: Optional[StatsRegistry] = None) -> None:
+        super().__init__("app_red", ["l7_flow_log"], n_workers=1,
+                         batch=64, stats=stats)
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.cfg = cfg or app_suite.AppSuiteConfig()
+        self.window_seconds = window_seconds
+        self.batcher = Batcher(_RED_SCHEMA, capacity=batch_rows)
+        self.state = app_suite.init(self.cfg)
+        self.rows_in = 0
+        self.windows = 0
+        self.last_output: Optional[app_suite.AppWindowOutput] = None
+        self._update = jax.jit(
+            lambda s, c, m: app_suite.update(s, c, m, self.cfg),
+            donate_argnums=0)
+        self._flush_fn = jax.jit(
+            lambda s: app_suite.flush(s, self.cfg), donate_argnums=0)
+        self.writer = None
+        if store is not None:
+            self.writer = StoreWriter(
+                store.create_table(APP_RED_DB, APP_RED_TABLE),
+                batch_rows=4096, flush_interval=5.0)
+        self._state_lock = threading.Lock()
+        self._window_stop = threading.Event()
+        self._window_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self.writer is not None:
+            self.writer.start()
+        super().start()
+        self._window_thread = threading.Thread(
+            target=self._window_loop, name="app-red-window", daemon=True)
+        self._window_thread.start()
+
+    def close(self) -> None:
+        self._window_stop.set()
+        if self._window_thread is not None:
+            self._window_thread.join(timeout=5)
+        super().close()
+        self.flush_window()
+        if self.writer is not None:
+            self.writer.close()
+
+    def _window_loop(self) -> None:
+        while not self._window_stop.wait(self.window_seconds):
+            self.flush_window()
+
+    # -- data path ---------------------------------------------------------
+    def process(self, chunks: List[Any]) -> None:
+        for stream, _idx, cols in chunks:
+            schema_cols = self.coerce_to_schema(cols, _RED_SCHEMA)
+            n = len(next(iter(schema_cols.values())))
+            with self._state_lock:
+                for tb in self.batcher.put(schema_cols):
+                    self._run_batch_locked(tb)
+                self.rows_in += n
+
+    def _run_batch_locked(self, tb) -> None:
+        jnp = self._jnp
+        cols_d = {k: jnp.asarray(v) for k, v in tb.columns.items()}
+        self.state = self._update(self.state, cols_d,
+                                  jnp.asarray(tb.mask()))
+
+    def flush_window(self, now: Optional[float] = None
+                     ) -> Optional[app_suite.AppWindowOutput]:
+        now = time.time() if now is None else now
+        with self._state_lock:
+            for tb in self.batcher.flush():
+                self._run_batch_locked(tb)
+            self.windows += 1
+            self.state, out = self._flush_fn(self.state)
+        self.last_output = out
+        self._write_output(out, int(now))
+        return out
+
+    def _write_output(self, out: app_suite.AppWindowOutput,
+                      second: int) -> None:
+        if self.writer is None:
+            return
+        reqs = np.asarray(out.requests)
+        active = np.nonzero(reqs > 0)[0]
+        if len(active) == 0:
+            return
+        qs = np.asarray(out.rrt_quantiles)[:, active]
+        self.writer.put({
+            "timestamp": np.full(len(active), second, np.uint32),
+            "service_group": active.astype(np.uint32),
+            "requests": reqs[active].astype(np.uint32),
+            "errors": np.asarray(out.errors)[active].astype(np.uint32),
+            "rrt_p50_us": qs[0].astype(np.float32),
+            "rrt_p95_us": qs[1].astype(np.float32),
+            "rrt_p99_us": qs[2].astype(np.float32),
+        })
+
+    def flush(self) -> None:
+        """Drain pending RED rows to disk (Ingester.flush)."""
+        if self.writer is not None:
+            self.writer.flush()
+
+    def counters(self) -> dict:
+        return {"rows_in": self.rows_in, "windows": self.windows}

@@ -127,6 +127,20 @@ class QueueWorkerExporter:
     def process(self, chunks: List[Any]) -> None:  # pragma: no cover
         raise NotImplementedError
 
+    @staticmethod
+    def coerce_to_schema(cols: Dict[str, Any], schema) -> Dict[str, Any]:
+        """Project a decoded chunk onto a batching Schema: contiguous
+        casts for present columns, zero-fill for absent ones, empty
+        chunks come back empty (shared by the tpu_sketch and app_red
+        sketch exporters, which would otherwise drift)."""
+        import numpy as np
+        n = len(next(iter(cols.values()))) if cols else 0
+        return {
+            name: np.ascontiguousarray(cols[name]).astype(dt, copy=False)
+            if name in cols else np.zeros(n, dt)
+            for name, dt in schema.columns
+        }
+
     def _run(self) -> None:
         while True:
             chunks = self.queue.gets(self.batch, timeout=0.2)

@@ -154,12 +154,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         Holds _state_lock across batcher + state mutation: the window
         thread's flush_window() touches both under the same lock."""
         for stream, _idx, cols in chunks:
-            schema_cols = {
-                name: np.ascontiguousarray(cols[name]).astype(dt, copy=False)
-                if name in cols else
-                np.zeros(len(next(iter(cols.values()))), dt)
-                for name, dt in SKETCH_L4_SCHEMA.columns
-            }
+            schema_cols = self.coerce_to_schema(cols, SKETCH_L4_SCHEMA)
             with self._state_lock:
                 for tb in self.batcher.put(schema_cols):
                     self._run_batch_locked(tb)

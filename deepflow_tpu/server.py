@@ -19,6 +19,7 @@ Config (all keys optional):
       debug_port: 30035
       throttle_per_s: 50000
       tpu_sketch_window_s: 1.0
+      app_red_window_s: 1.0
     querier:
       enabled: true
       port: 20416
@@ -99,6 +100,7 @@ class Server:
             throttle_per_s=ing_cfg.get("throttle_per_s", 50_000),
             store_max_bytes=ing_cfg.get("store_max_bytes", 100 << 30),
             tpu_sketch_window_s=ing_cfg.get("tpu_sketch_window_s"),
+            app_red_window_s=ing_cfg.get("app_red_window_s"),
         ))
         if self.controller is not None:
             # in-process ingester enriches from this controller's model

@@ -1,0 +1,231 @@
+"""DDSketch quantiles + AppSuite RED metrics.
+
+Reference role: ClickHouse `quantile()` over l7_flow_log.rrt and the
+vtap_app_* meter sums — here as mergeable device sketches.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.models import app_suite
+from deepflow_tpu.ops import ddsketch
+
+
+def test_quantile_relative_error():
+    cfg = ddsketch.DDSketchConfig(groups=4, buckets=1024, alpha=0.01)
+    rng = np.random.default_rng(5)
+    state = ddsketch.init(cfg)
+    # group 0: lognormal latencies; group 2: uniform
+    vals0 = rng.lognormal(mean=8.0, sigma=1.0, size=20000)   # ~3ms median
+    vals2 = rng.uniform(10, 10_000, size=20000)
+    group = np.concatenate([np.zeros(20000, np.int32),
+                            np.full(20000, 2, np.int32)])
+    values = np.concatenate([vals0, vals2]).astype(np.float32)
+    state = jax.jit(lambda s, g, v: ddsketch.update(s, g, v, cfg=cfg))(
+        state, jnp.asarray(group), jnp.asarray(values))
+    for q in (0.5, 0.95, 0.99):
+        est = np.asarray(ddsketch.quantile(state, q, cfg))
+        for g, vals in ((0, vals0), (2, vals2)):
+            exact = np.quantile(vals, q)
+            assert abs(est[g] - exact) / exact < 3 * cfg.alpha, (q, g)
+    # untouched groups stay empty
+    est = np.asarray(ddsketch.quantile(state, 0.5, cfg))
+    assert est[1] == 0.0 and est[3] == 0.0
+    cnt = np.asarray(ddsketch.counts(state))
+    assert cnt[0] == 20000 and cnt[2] == 20000
+
+
+def test_merge_is_exact_union():
+    cfg = ddsketch.DDSketchConfig(groups=2, buckets=512, alpha=0.02)
+    rng = np.random.default_rng(6)
+    a_vals = rng.uniform(1, 5000, 5000).astype(np.float32)
+    b_vals = rng.uniform(1, 5000, 5000).astype(np.float32)
+    g = np.zeros(5000, np.int32)
+    a = ddsketch.update(ddsketch.init(cfg), jnp.asarray(g),
+                        jnp.asarray(a_vals), cfg=cfg)
+    b = ddsketch.update(ddsketch.init(cfg), jnp.asarray(g),
+                        jnp.asarray(b_vals), cfg=cfg)
+    merged = ddsketch.merge(a, b)
+    both = ddsketch.update(a, jnp.asarray(g), jnp.asarray(b_vals), cfg=cfg)
+    np.testing.assert_allclose(np.asarray(merged.hist),
+                               np.asarray(both.hist))
+    np.testing.assert_allclose(
+        np.asarray(ddsketch.quantile(merged, 0.95, cfg)),
+        np.asarray(ddsketch.quantile(both, 0.95, cfg)))
+
+
+def test_zero_and_masked_values():
+    cfg = ddsketch.DDSketchConfig(groups=1, buckets=64, alpha=0.05)
+    vals = jnp.asarray(np.array([0, 0, 100, 200], np.float32))
+    g = jnp.zeros(4, jnp.int32)
+    mask = jnp.asarray(np.array([True, True, True, False]))
+    s = ddsketch.update(ddsketch.init(cfg), g, vals, mask=mask, cfg=cfg)
+    assert float(ddsketch.counts(s)[0]) == 3          # masked row dropped
+    assert float(s.zeros[0]) == 2                     # sub-min values
+    # the 0.9 quantile sits at the one real value
+    est = float(ddsketch.quantile(s, 0.9, cfg)[0])
+    assert abs(est - 100) / 100 < 3 * cfg.alpha
+
+
+def test_app_suite_red():
+    cfg = app_suite.AppSuiteConfig(groups=64, dd_buckets=1024,
+                                   dd_alpha=0.01)
+    rng = np.random.default_rng(9)
+    n = 8192
+    # two services; service B errors 25% of the time and is 10x slower
+    svc = rng.integers(0, 2, n)
+    cols = {
+        "ip_dst": jnp.asarray(np.where(svc, 0x0A000002, 0x0A000001)
+                              .astype(np.uint32)),
+        "port_dst": jnp.asarray(np.where(svc, 443, 80).astype(np.uint32)),
+        "protocol": jnp.asarray(np.full(n, 6, np.uint32)),
+        # raw HTTP codes: 200 must NOT count as an error, 500 must
+        "status": jnp.asarray(np.where(svc & (rng.random(n) < 0.25),
+                                       500, 200).astype(np.uint32)),
+        "rrt_us": jnp.asarray(np.where(svc, 10_000, 1_000)
+                              .astype(np.uint32)),
+    }
+    mask = jnp.ones(n, jnp.bool_)
+    state = jax.jit(
+        lambda s, c, m: app_suite.update(s, c, m, cfg))(
+        app_suite.init(cfg), cols, mask)
+    state, out = jax.jit(lambda s: app_suite.flush(s, cfg))(state)
+    ga = int(app_suite.service_group(
+        {k: v[:1] for k, v in cols.items()}, cfg.groups)[0])
+    reqs = np.asarray(out.requests)
+    err = np.asarray(out.error_ratio)
+    p95 = np.asarray(out.rrt_quantiles)[1]
+    a_count = int((svc == 0).sum())
+    assert reqs[ga] in (a_count, n - a_count)
+    a_is_a = reqs[ga] == a_count
+    gb = [g for g in np.nonzero(reqs)[0] if g != ga][0]
+    g_a, g_b = (ga, gb) if a_is_a else (gb, ga)
+    assert err[g_a] == 0.0
+    assert 0.15 < err[g_b] < 0.35
+    assert abs(p95[g_a] - 1_000) / 1_000 < 0.05
+    assert abs(p95[g_b] - 10_000) / 10_000 < 0.05
+    # flush reset the state
+    assert float(jnp.sum(state.requests)) == 0.0
+
+
+def test_app_suite_psum_merge_matches_single():
+    """Sharded-equals-single: splitting the batch and merging states is
+    the multi-chip psum form."""
+    cfg = app_suite.AppSuiteConfig(groups=16, dd_buckets=512)
+    rng = np.random.default_rng(10)
+    n = 4096
+    cols = {
+        "ip_dst": jnp.asarray(rng.integers(0, 2**31, n).astype(np.uint32)),
+        "port_dst": jnp.asarray(rng.integers(0, 1024, n)
+                                .astype(np.uint32)),
+        "protocol": jnp.asarray(np.full(n, 6, np.uint32)),
+        "status": jnp.asarray(rng.integers(0, 2, n).astype(np.uint32)),
+        "rrt_us": jnp.asarray(rng.integers(1, 100_000, n)
+                              .astype(np.uint32)),
+    }
+    mask = jnp.ones(n, jnp.bool_)
+    single = app_suite.update(app_suite.init(cfg), cols, mask, cfg)
+    h = n // 2
+    lo = app_suite.update(app_suite.init(cfg),
+                          {k: v[:h] for k, v in cols.items()},
+                          mask[:h], cfg)
+    hi = app_suite.update(app_suite.init(cfg),
+                          {k: v[h:] for k, v in cols.items()},
+                          mask[h:], cfg)
+    merged = app_suite.merge(lo, hi)
+    for a, b in zip(jax.tree_util.tree_leaves(single),
+                    jax.tree_util.tree_leaves(merged)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_app_red_exporter(tmp_path):
+    """l7 chunks -> AppRedExporter -> windowed RED rows in the store."""
+    import time
+
+    from deepflow_tpu.runtime.app_red import (APP_RED_DB, APP_RED_TABLE,
+                                              AppRedExporter)
+    from deepflow_tpu.store import Store
+
+    store = Store(str(tmp_path))
+    exp = AppRedExporter(store=store, window_seconds=3600,
+                         cfg=app_suite.AppSuiteConfig(groups=64,
+                                                      dd_buckets=512))
+    exp.start()
+    try:
+        n = 4000
+        rng = np.random.default_rng(2)
+        cols = {
+            "ip_dst": np.full(n, 0x0A000001, np.uint32),
+            "port_dst": np.full(n, 80, np.uint32),
+            "protocol": np.full(n, 6, np.uint32),
+            "status": (rng.random(n) < 0.1).astype(np.uint32),
+            "rrt_us": np.full(n, 2_000, np.uint32),
+        }
+        exp.put("l7_flow_log", 0, cols)
+        deadline = time.time() + 15
+        while exp.rows_in < n and time.time() < deadline:
+            time.sleep(0.1)
+        out = exp.flush_window()
+        exp.close()
+        reqs = np.asarray(out.requests)
+        g = int(np.nonzero(reqs)[0][0])
+        assert reqs[g] == n
+        assert 0.05 < float(np.asarray(out.error_ratio)[g]) < 0.15
+        rows = store.table(APP_RED_DB, APP_RED_TABLE.name).scan()
+        assert rows["requests"].tolist() == [n]
+        assert abs(rows["rrt_p95_us"][0] - 2000) / 2000 < 0.05
+    finally:
+        if exp._window_thread is not None and exp._window_thread.is_alive():
+            exp.close()
+
+
+def test_app_red_through_live_ingester(tmp_path):
+    """Agent l7 traffic -> firehose -> ingester with app_red enabled ->
+    RED rows appear in the store."""
+    import socket
+    import time
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+    from deepflow_tpu.runtime.app_red import APP_RED_DB, APP_RED_TABLE
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path / "st"),
+                                  app_red_window_s=3600))
+    ing.start()
+    try:
+        agent = Agent(AgentConfig(
+            ingester_addr=f"127.0.0.1:{ing.port}", l7_enabled=True))
+        agent.set_vtap_id(4)
+        C, S = ip4(10, 0, 0, 1), ip4(10, 0, 0, 2)
+        T0 = 1_700_000_000_000_000_000
+        frames, stamps = [], []
+        for i in range(5):
+            frames.append(eth_ipv4_tcp(C, S, 41000 + i, 80, 0x10,
+                                       b"GET /x HTTP/1.1\r\n\r\n", seq=1))
+            stamps.append(T0 + i * 10_000_000)
+            frames.append(eth_ipv4_tcp(S, C, 80, 41000 + i, 0x10,
+                                       b"HTTP/1.1 500 Oops\r\n\r\n",
+                                       seq=1))
+            stamps.append(T0 + i * 10_000_000 + 2_000_000)
+        agent.feed(frames, np.asarray(stamps, np.uint64))
+        agent.tick(T0 + int(1e9))
+        deadline = time.time() + 15
+        while ing.app_red.rows_in < 5 and time.time() < deadline:
+            time.sleep(0.1)
+        out = ing.app_red.flush_window()
+        agent.close()
+        reqs = np.asarray(out.requests)
+        g = int(np.nonzero(reqs)[0][0])
+        assert reqs[g] == 5
+        assert float(np.asarray(out.error_ratio)[g]) == 1.0   # all 500s
+        ing.flush()
+        rows = ing.store.table(APP_RED_DB, APP_RED_TABLE.name).scan()
+        assert rows["requests"].tolist() == [5]
+        assert (rows["rrt_p95_us"][0] - 2000) / 2000 < 0.05
+    finally:
+        ing.close()

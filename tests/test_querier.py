@@ -641,3 +641,13 @@ def test_where_by_resource_name(tmp_path):
                       "WHERE pod_id_0 IN ('api-0', 'web-0')",
                       db="flow_log")
     assert res.values[0][0] == 100
+
+
+def test_promql_regex_matchers(prom):
+    eng, _, _ = prom
+    out = eng.query('rps{job=~"a.*"}', at=1100)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "api"
+    out = eng.query('rps{job!~"a.*"}', at=1100)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "web"
+    out = eng.query('rps{job=~".*"}', at=1100)
+    assert len(out) == 2
