@@ -122,7 +122,8 @@ def main() -> None:
           f"[{n}] values, {dd_cfg.groups}x{dd_cfg.buckets}",
           lambda s, g, v: ddsketch.update(s, g, v, cfg=dd_cfg),
           lambda: ddsketch.init(dd_cfg),
-          (groups % np.uint32(1024)).astype(jnp.int32), rrt, rows=n)
+          (groups % np.uint32(dd_cfg.groups)).astype(jnp.int32), rrt,
+          rows=n)
 
     # -- pca ---------------------------------------------------------------
     x = jnp.asarray(rng.normal(size=(min(n, 1 << 17), 12)), jnp.float32)

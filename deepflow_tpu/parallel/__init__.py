@@ -2,8 +2,9 @@ from deepflow_tpu.parallel.mesh import make_mesh
 from deepflow_tpu.parallel.multihost import (init_distributed, local_shard,
                                              make_global_mesh,
                                              process_local_batch)
-from deepflow_tpu.parallel.sharded import ShardedFlowSuite, ShardedMetricsSuite
+from deepflow_tpu.parallel.sharded import (ShardedAppSuite, ShardedFlowSuite,
+                                           ShardedMetricsSuite)
 
 __all__ = ["make_mesh", "ShardedFlowSuite", "ShardedMetricsSuite",
-           "init_distributed", "make_global_mesh", "process_local_batch",
-           "local_shard"]
+           "ShardedAppSuite", "init_distributed", "make_global_mesh",
+           "process_local_batch", "local_shard"]

@@ -155,6 +155,67 @@ class ShardedFlowSuite:
         return self._flush(state)
 
 
+class ShardedAppSuite:
+    """AppSuite (per-service RED + DDSketch quantiles) over a mesh.
+
+    Every state field merges by ADD (request/error histograms, DDSketch
+    buckets — ddsketch.merge is exact union), so the comm pattern is the
+    simplest of the three suites: comm-free per-shard updates, one psum
+    of the whole state at flush, identical window close everywhere."""
+
+    def __init__(self, cfg, mesh: Mesh, axis: str = "data") -> None:
+        from deepflow_tpu.models import app_suite
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = mesh.shape[axis]
+        self._dev_spec = P(axis)
+        self._state_sharding = NamedSharding(mesh, self._dev_spec)
+        self._batch_sharding = NamedSharding(mesh, P(axis))
+        state_specs = jax.tree.map(lambda _: self._dev_spec,
+                                   app_suite.init(cfg))
+        cfg_ = cfg
+
+        def local_update(state, cols, mask):
+            local = jax.tree.map(lambda x: x[0], state)
+            new = app_suite.update(local, cols, mask, cfg_)
+            return jax.tree.map(lambda x: x[None], new)
+
+        self._update = jax.jit(shard_map(
+            local_update, mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis)),
+            out_specs=state_specs, check_vma=False))
+
+        def local_flush(state):
+            local = jax.tree.map(lambda x: x[0], state)
+            merged = jax.tree.map(lambda x: jax.lax.psum(x, axis), local)
+            fresh, out = app_suite.flush(merged, cfg_)
+            return jax.tree.map(lambda x: x[None], fresh), out
+
+        out_specs = (state_specs,
+                     app_suite.AppWindowOutput(
+                         requests=P(), errors=P(), error_ratio=P(),
+                         rrt_quantiles=P()))
+        self._flush = jax.jit(shard_map(
+            local_flush, mesh=mesh, in_specs=(state_specs,),
+            out_specs=out_specs, check_vma=False))
+        self._app_suite = app_suite
+
+    def init(self):
+        return _replicate_init(self._app_suite.init(self.cfg),
+                               self.n_devices, self._state_sharding)
+
+    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
+        return _put_sharded(cols, mask, self._batch_sharding)
+
+    def update(self, state, cols: Dict, mask):
+        return self._update(state, cols, mask)
+
+    def flush(self, state):
+        return self._flush(state)
+
+
 class ShardedMetricsSuite:
     """MetricsSuite (DDoS entropy + golden-signal PCA) over a mesh.
 

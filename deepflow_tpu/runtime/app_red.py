@@ -30,21 +30,29 @@ from deepflow_tpu.store.writer import StoreWriter
 
 APP_RED_DB = "tpu_sketch"
 
-APP_RED_TABLE = TableSchema(
-    name="app_red",
-    columns=(
-        ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
-        ColumnSpec("service_group", np.dtype(np.uint32), AggKind.KEY),
-        # counts, not ratios: ratios cannot aggregate across windows
-        # (the repo convention — querier derived metrics divide SUMs at
-        # query time, querier/metrics.py l7_error_ratio)
-        ColumnSpec("requests", np.dtype(np.uint32), AggKind.SUM),
-        ColumnSpec("errors", np.dtype(np.uint32), AggKind.SUM),
-        ColumnSpec("rrt_p50_us", np.dtype(np.float32), AggKind.MAX),
-        ColumnSpec("rrt_p95_us", np.dtype(np.float32), AggKind.MAX),
-        ColumnSpec("rrt_p99_us", np.dtype(np.float32), AggKind.MAX),
-    ),
-)
+
+def app_red_table(quantiles=(0.5, 0.95, 0.99)) -> TableSchema:
+    """Schema follows the configured quantile set (one rrt_pXX_us
+    column per quantile) — a non-default AppSuiteConfig.quantiles must
+    not silently land in wrong columns."""
+    qcols = tuple(
+        ColumnSpec(f"rrt_p{round(q * 100)}_us", np.dtype(np.float32),
+                   AggKind.MAX) for q in quantiles)
+    return TableSchema(
+        name="app_red",
+        columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("service_group", np.dtype(np.uint32), AggKind.KEY),
+            # counts, not ratios: ratios cannot aggregate across windows
+            # (the repo convention — querier derived metrics divide SUMs
+            # at query time, querier/metrics.py l7_error_ratio)
+            ColumnSpec("requests", np.dtype(np.uint32), AggKind.SUM),
+            ColumnSpec("errors", np.dtype(np.uint32), AggKind.SUM),
+        ) + qcols,
+    )
+
+
+APP_RED_TABLE = app_red_table()
 
 # the l7 columns the suite consumes, batched to static shapes
 _RED_SCHEMA = Schema(name="l7_red", columns=(
@@ -85,7 +93,8 @@ class AppRedExporter(QueueWorkerExporter):
         self.writer = None
         if store is not None:
             self.writer = StoreWriter(
-                store.create_table(APP_RED_DB, APP_RED_TABLE),
+                store.create_table(APP_RED_DB,
+                                   app_red_table(self.cfg.quantiles)),
                 batch_rows=4096, flush_interval=5.0)
         self._state_lock = threading.Lock()
         self._window_stop = threading.Event()
@@ -150,15 +159,15 @@ class AppRedExporter(QueueWorkerExporter):
         if len(active) == 0:
             return
         qs = np.asarray(out.rrt_quantiles)[:, active]
-        self.writer.put({
+        row = {
             "timestamp": np.full(len(active), second, np.uint32),
             "service_group": active.astype(np.uint32),
             "requests": reqs[active].astype(np.uint32),
             "errors": np.asarray(out.errors)[active].astype(np.uint32),
-            "rrt_p50_us": qs[0].astype(np.float32),
-            "rrt_p95_us": qs[1].astype(np.float32),
-            "rrt_p99_us": qs[2].astype(np.float32),
-        })
+        }
+        for i, q in enumerate(self.cfg.quantiles):
+            row[f"rrt_p{round(q * 100)}_us"] = qs[i].astype(np.float32)
+        self.writer.put(row)
 
     def flush(self) -> None:
         """Drain pending RED rows to disk (Ingester.flush)."""
@@ -166,4 +175,6 @@ class AppRedExporter(QueueWorkerExporter):
             self.writer.flush()
 
     def counters(self) -> dict:
-        return {"rows_in": self.rows_in, "windows": self.windows}
+        c = super().counters()   # keep the queue's observable-loss stats
+        c.update({"rows_in": self.rows_in, "windows": self.windows})
+        return c

@@ -226,6 +226,40 @@ def test_app_red_through_live_ingester(tmp_path):
         ing.flush()
         rows = ing.store.table(APP_RED_DB, APP_RED_TABLE.name).scan()
         assert rows["requests"].tolist() == [5]
-        assert (rows["rrt_p95_us"][0] - 2000) / 2000 < 0.05
+        assert abs(rows["rrt_p95_us"][0] - 2000) / 2000 < 0.05
     finally:
         ing.close()
+
+
+def test_app_red_custom_quantiles(tmp_path):
+    """A non-default quantile set gets its own columns, not mislabeled
+    p50/p95/p99 slots."""
+    from deepflow_tpu.runtime.app_red import APP_RED_DB, AppRedExporter
+    from deepflow_tpu.store import Store
+
+    store = Store(str(tmp_path))
+    exp = AppRedExporter(
+        store=store, window_seconds=3600,
+        cfg=app_suite.AppSuiteConfig(groups=8, dd_buckets=256,
+                                     quantiles=(0.9, 0.99)))
+    exp.start()
+    try:
+        n = 512
+        cols = {"ip_dst": np.full(n, 1, np.uint32),
+                "port_dst": np.full(n, 80, np.uint32),
+                "protocol": np.full(n, 6, np.uint32),
+                "status": np.zeros(n, np.uint32),
+                "rrt_us": np.full(n, 5_000, np.uint32)}
+        exp.put("l7_flow_log", 0, cols)
+        import time
+        deadline = time.time() + 10
+        while exp.rows_in < n and time.time() < deadline:
+            time.sleep(0.05)
+        exp.flush_window()
+        exp.flush()
+        rows = store.table(APP_RED_DB, "app_red").scan()
+        assert "rrt_p90_us" in rows and "rrt_p99_us" in rows
+        assert "rrt_p50_us" not in rows
+        assert abs(rows["rrt_p90_us"][0] - 5000) / 5000 < 0.1
+    finally:
+        exp.close()

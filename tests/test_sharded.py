@@ -177,3 +177,47 @@ def test_sharded_metrics_suite_equals_one_device(rng):
     np.testing.assert_allclose(np.asarray(out8.anomaly_scores),
                                np.asarray(out1.anomaly_scores),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_app_suite_matches_single():
+    """Sharded AppSuite == single-device AppSuite: the whole-state psum
+    merge must reproduce the unsharded answer exactly."""
+    import numpy as np
+
+    import jax
+
+    from deepflow_tpu.models import app_suite
+    from deepflow_tpu.parallel.sharded import ShardedAppSuite
+
+    mesh8 = make_mesh()
+    cfg = app_suite.AppSuiteConfig(groups=16, dd_buckets=128,
+                                   dd_alpha=0.05)
+    rng = np.random.default_rng(21)
+    n = 512
+    cols = {
+        "ip_dst": rng.integers(0, 1 << 16, n).astype(np.uint32),
+        "port_dst": rng.integers(0, 1024, n).astype(np.uint32),
+        "protocol": np.full(n, 6, np.uint32),
+        "status": np.where(rng.random(n) < 0.2, 500, 200)
+        .astype(np.uint32),
+        "rrt_us": rng.integers(1, 100_000, n).astype(np.uint32),
+    }
+    mask = np.ones(n, np.bool_)
+
+    import jax.numpy as jnp
+    single = app_suite.update(
+        app_suite.init(cfg), {k: jnp.asarray(v) for k, v in cols.items()},
+        jnp.asarray(mask), cfg)
+    _, single_out = app_suite.flush(single, cfg)
+
+    suite = ShardedAppSuite(cfg, mesh8)
+    state = suite.init()
+    cols_d, mask_d = suite.put_batch(cols, mask)
+    state = suite.update(state, cols_d, mask_d)
+    state, out = suite.flush(state)
+    np.testing.assert_allclose(np.asarray(out.requests),
+                               np.asarray(single_out.requests))
+    np.testing.assert_allclose(np.asarray(out.errors),
+                               np.asarray(single_out.errors))
+    np.testing.assert_allclose(np.asarray(out.rrt_quantiles),
+                               np.asarray(single_out.rrt_quantiles))
