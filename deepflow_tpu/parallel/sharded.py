@@ -9,10 +9,12 @@ TPU-physical form of the reference's per-thread stash merge
 (agent/src/collector/quadruple_generator.rs SubQuadGen) and the design
 SURVEY.md §7 Phase 4 calls for.
 
-Two suites share the pattern:
+Three suites share the pattern (scaffolding in _ShardedSuiteBase):
 
 - ShardedFlowSuite — the l4 sketch suite (CMS top-K / HLL / entropy),
   comm-free updates, merge-at-flush.
+- ShardedAppSuite — per-service RED + DDSketch quantiles; every state
+  field merges by add, so flush is one whole-state psum.
 - ShardedMetricsSuite — the flow_metrics anomaly suite (BASELINE.md
   config 5): entropy histograms shard like the sketches, while the
   streaming-PCA basis stays REPLICATED — each chip computes the Oja
@@ -23,7 +25,7 @@ Two suites share the pattern:
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,7 +84,45 @@ def _merge_axis0(state: FlowSuiteState) -> FlowSuiteState:
     )
 
 
-class ShardedFlowSuite:
+class _ShardedSuiteBase:
+    """Mesh/spec/plumbing shared by the three sharded suites: state
+    carries a leading device axis over `axis`, batches shard over the
+    same axis, updates run comm-free per shard inside shard_map.
+    Subclasses build self._update / self._flush in __init__ (their
+    merge topologies differ) via self._shard()."""
+
+    def __init__(self, cfg, mesh: Mesh, axis: str,
+                 init_single: Callable) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = mesh.shape[axis]
+        self._dev_spec = P(axis)
+        self._state_sharding = NamedSharding(mesh, self._dev_spec)
+        self._batch_sharding = NamedSharding(mesh, P(axis))
+        self._init_single = init_single
+        self._state_specs = jax.tree.map(lambda _: self._dev_spec,
+                                         init_single())
+
+    def _shard(self, fn, in_specs, out_specs):
+        return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+    def init(self):
+        return _replicate_init(self._init_single(), self.n_devices,
+                               self._state_sharding)
+
+    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
+        return _put_sharded(cols, mask, self._batch_sharding)
+
+    def update(self, state, cols: Dict, mask):
+        return self._update(state, cols, mask)
+
+    def flush(self, state):
+        return self._flush(state)
+
+
+class ShardedFlowSuite(_ShardedSuiteBase):
     """FlowSuite sharded over a mesh's `data` axis.
 
     update(state, cols, mask): cols/mask are [B] arrays, B % n_devices == 0;
@@ -92,15 +132,8 @@ class ShardedFlowSuite:
 
     def __init__(self, cfg: FlowSuiteConfig, mesh: Mesh,
                  axis: str = "data") -> None:
-        self.cfg = cfg
-        self.mesh = mesh
-        self.axis = axis
-        self.n_devices = mesh.shape[axis]
-        self._dev_spec = P(axis)
-        self._state_sharding = NamedSharding(mesh, self._dev_spec)
-        self._batch_sharding = NamedSharding(mesh, P(axis))
-
-        state_specs = jax.tree.map(lambda _: self._dev_spec, self._template())
+        super().__init__(cfg, mesh, axis, lambda: flow_suite.init(cfg))
+        state_specs = self._state_specs
         cfg_ = cfg
 
         def local_update(state, cols, mask):
@@ -108,13 +141,9 @@ class ShardedFlowSuite:
             local = flow_suite.update(local, cols, mask, cfg_)
             return jax.tree.map(lambda x: x[None], local)
 
-        self._update = jax.jit(shard_map(
-            local_update,
-            mesh=mesh,
-            in_specs=(state_specs, P(axis), P(axis)),
-            out_specs=state_specs,
-            check_vma=False,
-        ))
+        self._update = self._shard(local_update,
+                                   (state_specs, P(axis), P(axis)),
+                                   state_specs)
 
         def flush_fn(state):
             merged = _merge_axis0(state)
@@ -136,26 +165,8 @@ class ShardedFlowSuite:
         self._flush = jax.jit(flush_fn, out_shardings=(
             jax.tree.map(lambda _: self._state_sharding, state_specs), None))
 
-    def _template(self) -> FlowSuiteState:
-        return flow_suite.init(self.cfg)
 
-    def init(self) -> FlowSuiteState:
-        return _replicate_init(flow_suite.init(self.cfg), self.n_devices,
-                               self._state_sharding)
-
-    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
-        return _put_sharded(cols, mask, self._batch_sharding)
-
-    def update(self, state: FlowSuiteState, cols: Dict,
-               mask) -> FlowSuiteState:
-        return self._update(state, cols, mask)
-
-    def flush(self, state: FlowSuiteState
-              ) -> Tuple[FlowSuiteState, FlowWindowOutput]:
-        return self._flush(state)
-
-
-class ShardedAppSuite:
+class ShardedAppSuite(_ShardedSuiteBase):
     """AppSuite (per-service RED + DDSketch quantiles) over a mesh.
 
     Every state field merges by ADD (request/error histograms, DDSketch
@@ -166,15 +177,8 @@ class ShardedAppSuite:
     def __init__(self, cfg, mesh: Mesh, axis: str = "data") -> None:
         from deepflow_tpu.models import app_suite
 
-        self.cfg = cfg
-        self.mesh = mesh
-        self.axis = axis
-        self.n_devices = mesh.shape[axis]
-        self._dev_spec = P(axis)
-        self._state_sharding = NamedSharding(mesh, self._dev_spec)
-        self._batch_sharding = NamedSharding(mesh, P(axis))
-        state_specs = jax.tree.map(lambda _: self._dev_spec,
-                                   app_suite.init(cfg))
+        super().__init__(cfg, mesh, axis, lambda: app_suite.init(cfg))
+        state_specs = self._state_specs
         cfg_ = cfg
 
         def local_update(state, cols, mask):
@@ -182,10 +186,9 @@ class ShardedAppSuite:
             new = app_suite.update(local, cols, mask, cfg_)
             return jax.tree.map(lambda x: x[None], new)
 
-        self._update = jax.jit(shard_map(
-            local_update, mesh=mesh,
-            in_specs=(state_specs, P(axis), P(axis)),
-            out_specs=state_specs, check_vma=False))
+        self._update = self._shard(local_update,
+                                   (state_specs, P(axis), P(axis)),
+                                   state_specs)
 
         def local_flush(state):
             local = jax.tree.map(lambda x: x[0], state)
@@ -197,26 +200,10 @@ class ShardedAppSuite:
                      app_suite.AppWindowOutput(
                          requests=P(), errors=P(), error_ratio=P(),
                          rrt_quantiles=P()))
-        self._flush = jax.jit(shard_map(
-            local_flush, mesh=mesh, in_specs=(state_specs,),
-            out_specs=out_specs, check_vma=False))
-        self._app_suite = app_suite
-
-    def init(self):
-        return _replicate_init(self._app_suite.init(self.cfg),
-                               self.n_devices, self._state_sharding)
-
-    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
-        return _put_sharded(cols, mask, self._batch_sharding)
-
-    def update(self, state, cols: Dict, mask):
-        return self._update(state, cols, mask)
-
-    def flush(self, state):
-        return self._flush(state)
+        self._flush = self._shard(local_flush, (state_specs,), out_specs)
 
 
-class ShardedMetricsSuite:
+class ShardedMetricsSuite(_ShardedSuiteBase):
     """MetricsSuite (DDoS entropy + golden-signal PCA) over a mesh.
 
     Entropy histograms shard per device and merge by `psum` at flush (they
@@ -231,15 +218,8 @@ class ShardedMetricsSuite:
 
     def __init__(self, cfg: MetricsSuiteConfig, mesh: Mesh,
                  axis: str = "data") -> None:
-        self.cfg = cfg
-        self.mesh = mesh
-        self.axis = axis
-        self.n_devices = mesh.shape[axis]
-        self._dev_spec = P(axis)
-        self._state_sharding = NamedSharding(mesh, self._dev_spec)
-        self._batch_sharding = NamedSharding(mesh, P(axis))
-        state_specs = jax.tree.map(lambda _: self._dev_spec,
-                                   metrics_suite.init(cfg))
+        super().__init__(cfg, mesh, axis, lambda: metrics_suite.init(cfg))
+        state_specs = self._state_specs
         cfg_ = cfg
 
         def local_update(state, cols, mask):
@@ -257,13 +237,9 @@ class ShardedMetricsSuite:
             new = local._replace(ent=ent, pca=p)
             return jax.tree.map(lambda x_: x_[None], new)
 
-        self._update = jax.jit(shard_map(
-            local_update,
-            mesh=mesh,
-            in_specs=(state_specs, P(axis), P(axis)),
-            out_specs=state_specs,
-            check_vma=False,
-        ))
+        self._update = self._shard(local_update,
+                                   (state_specs, P(axis), P(axis)),
+                                   state_specs)
 
         def local_flush(state, cols, mask):
             local = jax.tree.map(lambda x: x[0], state)
@@ -281,20 +257,9 @@ class ShardedMetricsSuite:
                      MetricsWindowOutput(entropies=P(), z_scores=P(),
                                          ddos_alarm=P(),
                                          anomaly_scores=P(axis)))
-        self._flush = jax.jit(shard_map(
-            local_flush,
-            mesh=mesh,
-            in_specs=(state_specs, P(axis), P(axis)),
-            out_specs=out_specs,
-            check_vma=False,
-        ))
-
-    def init(self) -> MetricsSuiteState:
-        return _replicate_init(metrics_suite.init(self.cfg), self.n_devices,
-                               self._state_sharding)
-
-    def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
-        return _put_sharded(cols, mask, self._batch_sharding)
+        self._flush = self._shard(local_flush,
+                                  (state_specs, P(axis), P(axis)),
+                                  out_specs)
 
     def update(self, state: MetricsSuiteState, cols: Dict,
                mask) -> MetricsSuiteState:

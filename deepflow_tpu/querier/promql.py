@@ -83,15 +83,26 @@ class PromEngine:
         self.db = db
         self.table = table
 
+    def _matching_series(self, pq: PromQuery, cols: Dict[str, np.ndarray],
+                         sel: np.ndarray) -> Dict[int, Dict[str, str]]:
+        """label_hash -> decoded labels for series in `cols[sel]` passing
+        the selector's matchers — the one series-discovery loop shared by
+        query / query_range / series."""
+        label_dict = self.tag_dicts.get("label_set")
+        out: Dict[int, Dict[str, str]] = {}
+        for lh in np.unique(cols["labels"][sel]):
+            labels = _parse_labels(label_dict.decode(int(lh)) or "")
+            if self._match(labels, pq.matchers):
+                out[int(lh)] = labels
+        return out
+
     def query(self, promql: str, at: Optional[int] = None) -> List[dict]:
         """Instant query: returns [{metric: {labels}, value: [ts, v]}] in
         the Prometheus HTTP API result shape."""
         pq = parse_promql(promql)
-        metric_dict = self.tag_dicts.get("metric_name")
-        label_dict = self.tag_dicts.get("label_set")
         # read-only lookup: the query path must not grow the dictionary
         # (a typo'd Grafana panel would journal a new entry per refresh)
-        mh = metric_dict.lookup(pq.metric)
+        mh = self.tag_dicts.get("metric_name").lookup(pq.metric)
         if mh is None:
             return []
         t = self.store.table(self.db, self.table)
@@ -100,12 +111,7 @@ class PromEngine:
         lo = hi - (pq.range_s if pq.range_s else 300)
         cols = t.scan(time_range=(lo, hi))
         sel = cols["metric"] == np.uint32(mh)
-        # decode label hashes once, filter by matchers
-        series: Dict[int, Dict[str, str]] = {}
-        for lh in np.unique(cols["labels"][sel]):
-            labels = _parse_labels(label_dict.decode(int(lh)) or "")
-            if self._match(labels, pq.matchers):
-                series[int(lh)] = labels
+        series = self._matching_series(pq, cols, sel)
         out = []
         groups: Dict[Tuple, List[Tuple[Dict[str, str], float]]] = {}
         for lh, labels in series.items():
@@ -151,9 +157,8 @@ class PromEngine:
             raise ValueError("end < start")
         pq = parse_promql(promql)
         lookback = pq.range_s if pq.range_s else 300
-        metric_dict = self.tag_dicts.get("metric_name")
-        label_dict = self.tag_dicts.get("label_set")
-        mh = metric_dict.lookup(pq.metric)   # read-only: see query()
+        mh = self.tag_dicts.get("metric_name").lookup(
+            pq.metric)   # read-only: see query()
         if mh is None:
             return []
         t = self.store.table(self.db, self.table)
@@ -162,10 +167,7 @@ class PromEngine:
         grid = np.arange(start, end + 1, step, dtype=np.int64)
 
         series_vals: List[Tuple[Dict[str, str], np.ndarray]] = []
-        for lh in np.unique(cols["labels"][sel]):
-            labels = _parse_labels(label_dict.decode(int(lh)) or "")
-            if not self._match(labels, pq.matchers):
-                continue
+        for lh, labels in self._matching_series(pq, cols, sel).items():
             m = sel & (cols["labels"] == np.uint32(lh))
             ts = cols["timestamp"][m].astype(np.int64)
             vs = cols["value"][m].astype(np.float64)
@@ -220,6 +222,53 @@ class PromEngine:
             if values:
                 result.append({"metric": labels, "values": values})
         return result
+
+    # -- discovery (Grafana datasource surface) ---------------------------
+    def label_names(self) -> List[str]:
+        """GET /api/v1/labels: every label name across stored series,
+        plus __name__ (reference: app/prometheus router label APIs)."""
+        names = set()
+        for s in self.tag_dicts.get("label_set").values():
+            names.update(_parse_labels(s))
+        names.discard("")
+        names.add("__name__")
+        return sorted(names)
+
+    def label_values(self, name: str) -> List[str]:
+        """GET /api/v1/label/<name>/values."""
+        if name == "__name__":
+            return sorted(self.tag_dicts.get("metric_name").values())
+        vals = set()
+        for s in self.tag_dicts.get("label_set").values():
+            v = _parse_labels(s).get(name)
+            if v is not None:
+                vals.add(v)
+        return sorted(vals)
+
+    def series(self, matches, start: Optional[int] = None,
+               end: Optional[int] = None) -> List[Dict[str, str]]:
+        """GET /api/v1/series?match[]=...: label sets of series with
+        samples in [start, end] matching ANY selector (the Prometheus
+        API unions repeated match[] params)."""
+        if isinstance(matches, str):
+            matches = [matches]
+        end = end if end is not None else int(time.time())
+        start = start if start is not None else end - 3600
+        t = self.store.table(self.db, self.table)
+        cols = t.scan(columns=["metric", "labels"],
+                      time_range=(start, end + 1))
+        out, seen = [], set()
+        for match in matches:
+            pq = parse_promql(match)
+            mh = self.tag_dicts.get("metric_name").lookup(pq.metric)
+            if mh is None:
+                continue
+            sel = cols["metric"] == np.uint32(mh)
+            for lh, labels in self._matching_series(pq, cols, sel).items():
+                if (pq.metric, lh) not in seen:
+                    seen.add((pq.metric, lh))
+                    out.append({"__name__": pq.metric, **labels})
+        return out
 
     def remote_read(self, body: bytes) -> bytes:
         """Prometheus remote-read: snappy(ReadRequest) -> snappy(

@@ -3,6 +3,8 @@
 POST /v1/query           body: db=<db>&sql=<sql>   (form or JSON)
 GET  /api/v1/query?query=<promql>[&time=<epoch>]   (Prometheus shape)
 GET  /api/v1/query_range?query=&start=&end=&step=  (Prometheus matrix)
+GET  /api/v1/labels | /api/v1/label/<n>/values | /api/v1/series?match[]=
+                          (Grafana datasource discovery)
 POST /api/v1/read         snappy prompb ReadRequest (remote-read)
 GET  /v1/profile/flame[?app_service=&event_type=&start=&end=]
 GET  /v1/profile/top[?...same...&limit=]
@@ -146,6 +148,36 @@ class QuerierServer:
                     self._prom_query(params)
                 elif path == "/api/v1/query_range":
                     self._prom_query_range(params)
+                elif path == "/api/v1/labels":
+                    self._send(200, {"status": "success",
+                                     "data": outer.prom.label_names()})
+                elif path.startswith("/api/v1/label/") and \
+                        path.endswith("/values"):
+                    name = urllib.parse.unquote(
+                        path[len("/api/v1/label/"):-len("/values")])
+                    self._send(200, {"status": "success",
+                                     "data": outer.prom.label_values(name)})
+                elif path == "/api/v1/series":
+                    try:
+                        # repeated match[] params union (the Prometheus
+                        # API shape); params was collapsed to first-value
+                        multi = urllib.parse.parse_qs(
+                            urllib.parse.urlparse(self.path).query)
+                        matches = (multi.get("match[]")
+                                   or multi.get("match"))
+                        if not matches:
+                            raise ValueError("missing match[] selector")
+                        data = outer.prom.series(
+                            matches,
+                            start=int(float(params["start"]))
+                            if "start" in params else None,
+                            end=int(float(params["end"]))
+                            if "end" in params else None)
+                        self._send(200, {"status": "success",
+                                         "data": data})
+                    except Exception as e:
+                        self._send(400, {"status": "error",
+                                         "error": str(e)})
                 elif path in ("/v1/profile/flame", "/v1/profile/top"):
                     self._profile(path, params)
                 elif path == "/api/echo" or path.startswith("/api/traces/") \

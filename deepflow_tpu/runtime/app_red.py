@@ -31,13 +31,21 @@ from deepflow_tpu.store.writer import StoreWriter
 APP_RED_DB = "tpu_sketch"
 
 
+def quantile_column(q: float) -> str:
+    """0.95 -> rrt_p95_us, 0.995 -> rrt_p99_5_us, 0.999 -> rrt_p99_9_us
+    — exact, so no two distinct quantiles can share a column name."""
+    return "rrt_p" + f"{q * 100:g}".replace(".", "_") + "_us"
+
+
 def app_red_table(quantiles=(0.5, 0.95, 0.99)) -> TableSchema:
-    """Schema follows the configured quantile set (one rrt_pXX_us
-    column per quantile) — a non-default AppSuiteConfig.quantiles must
-    not silently land in wrong columns."""
-    qcols = tuple(
-        ColumnSpec(f"rrt_p{round(q * 100)}_us", np.dtype(np.float32),
-                   AggKind.MAX) for q in quantiles)
+    """Schema follows the configured quantile set (one column per
+    quantile) — a non-default AppSuiteConfig.quantiles must not
+    silently land in wrong columns."""
+    names = [quantile_column(q) for q in quantiles]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate quantile columns: {names}")
+    qcols = tuple(ColumnSpec(nm, np.dtype(np.float32), AggKind.MAX)
+                  for nm in names)
     return TableSchema(
         name="app_red",
         columns=(
@@ -166,7 +174,7 @@ class AppRedExporter(QueueWorkerExporter):
             "errors": np.asarray(out.errors)[active].astype(np.uint32),
         }
         for i, q in enumerate(self.cfg.quantiles):
-            row[f"rrt_p{round(q * 100)}_us"] = qs[i].astype(np.float32)
+            row[quantile_column(q)] = qs[i].astype(np.float32)
         self.writer.put(row)
 
     def flush(self) -> None:

@@ -76,6 +76,12 @@ class TagDict:
         with self._lock:
             return self._fwd.get(s)
 
+    def values(self) -> List[str]:
+        """All known strings (one locked copy) — series/label discovery
+        (the Prometheus /api/v1/labels surface)."""
+        with self._lock:
+            return list(self._fwd)
+
     def decode(self, h: int) -> Optional[str]:
         return self._rev.get(int(h))
 

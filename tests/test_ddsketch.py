@@ -263,3 +263,15 @@ def test_app_red_custom_quantiles(tmp_path):
         assert abs(rows["rrt_p90_us"][0] - 5000) / 5000 < 0.1
     finally:
         exp.close()
+
+
+def test_quantile_column_names_exact():
+    from deepflow_tpu.runtime.app_red import app_red_table, quantile_column
+
+    assert quantile_column(0.5) == "rrt_p50_us"
+    assert quantile_column(0.995) == "rrt_p99_5_us"
+    assert quantile_column(0.999) == "rrt_p99_9_us"
+    t = app_red_table((0.99, 0.995, 0.999))
+    names = [c.name for c in t.columns]
+    assert "rrt_p99_us" in names and "rrt_p99_5_us" in names \
+        and "rrt_p99_9_us" in names

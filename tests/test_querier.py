@@ -651,3 +651,42 @@ def test_promql_regex_matchers(prom):
     assert len(out) == 1 and out[0]["metric"]["job"] == "web"
     out = eng.query('rps{job=~".*"}', at=1100)
     assert len(out) == 2
+
+
+def test_promql_discovery_endpoints(prom, tmp_path):
+    """Grafana datasource discovery: labels, label values, series."""
+    import json
+    import urllib.parse
+    import urllib.request
+
+    from deepflow_tpu.querier.server import QuerierServer
+
+    eng, store, dicts = prom
+    assert eng.label_names() == ["__name__", "job"]
+    assert eng.label_values("job") == ["api", "web"]
+    assert eng.label_values("__name__") == ["rps"]
+    series = eng.series('rps{job="api"}', start=900, end=1200)
+    assert series == [{"__name__": "rps", "job": "api"}]
+
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/api/v1/labels") as r:
+            assert json.load(r)["data"] == ["__name__", "job"]
+        with urllib.request.urlopen(f"{base}/api/v1/label/job/values") as r:
+            assert json.load(r)["data"] == ["api", "web"]
+        q = urllib.parse.urlencode(
+            {"match[]": "rps", "start": 900, "end": 1200})
+        with urllib.request.urlopen(f"{base}/api/v1/series?{q}") as r:
+            data = json.load(r)["data"]
+        assert {d["job"] for d in data} == {"api", "web"}
+        # repeated match[] params union (and dedupe)
+        q2 = ("match%5B%5D=rps%7Bjob%3D%22api%22%7D"
+              "&match%5B%5D=rps&start=900&end=1200")
+        with urllib.request.urlopen(f"{base}/api/v1/series?{q2}") as r:
+            data = json.load(r)["data"]
+        assert len(data) == 2
+        assert {d["job"] for d in data} == {"api", "web"}
+    finally:
+        srv.close()
