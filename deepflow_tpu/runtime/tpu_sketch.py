@@ -38,6 +38,16 @@ TOPK_TABLE = TableSchema(
         ColumnSpec("rank", np.dtype(np.uint32), AggKind.KEY),
         ColumnSpec("flow_key", np.dtype(np.uint32), AggKind.KEY),
         ColumnSpec("count", np.dtype(np.uint32), AggKind.MAX),
+        # the 5-tuple behind the key, resolved host-side via the
+        # sampled reverse map (0 when the key was never sampled) — the
+        # universal-tag role: top-K output a human can read
+        # (SURVEY §7 Phase 5 (5); reference:
+        # exporters/universal_tag/universal_tag.go QueryUniversalTags)
+        ColumnSpec("ip_src", np.dtype(np.uint32), AggKind.MAX),
+        ColumnSpec("ip_dst", np.dtype(np.uint32), AggKind.MAX),
+        ColumnSpec("port_src", np.dtype(np.uint32), AggKind.MAX),
+        ColumnSpec("port_dst", np.dtype(np.uint32), AggKind.MAX),
+        ColumnSpec("proto", np.dtype(np.uint32), AggKind.MAX),
     ),
 )
 
@@ -123,6 +133,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         # NOT donated: the pre-flush state is also the checkpoint payload
         self._flush_fn = jax.jit(lambda s: flow_suite.flush(s, self.cfg))
         self.rows_in = 0
+        self._key_tuples: Dict[int, np.ndarray] = {}
         self.last_output: Optional[flow_suite.FlowWindowOutput] = None
         self._window_thread: Optional[threading.Thread] = None
         self._window_stop = threading.Event()
@@ -164,6 +175,7 @@ class TpuSketchExporter(QueueWorkerExporter):
 
     def _run_batch_locked(self, tb: TensorBatch) -> None:
         jnp = self._jnp
+        self._record_key_tuples(tb)
         mask_d = jnp.asarray(tb.mask())
         if self.staged:   # staged update consumes the full column dict
             cols_d = {k: jnp.asarray(v) for k, v in tb.columns.items()}
@@ -172,6 +184,38 @@ class TpuSketchExporter(QueueWorkerExporter):
         lanes = flow_suite.pack_lanes(tb.columns)
         lanes_d = {k: jnp.asarray(v) for k, v in lanes.items()}
         self.state = self._update(self.state, lanes_d, mask_d)
+
+    # one entry per distinct sampled flow key: (ip_src, ip_dst,
+    # port_src, port_dst, proto). Sized well above ring_size so standing
+    # heavy hitters stay resolvable across windows.
+    _KEY_TUPLES_CAP = 1 << 18
+
+    def _record_key_tuples(self, tb: TensorBatch) -> None:
+        """Sampled host-side key -> 5-tuple reverse map (the
+        universal-tag role): top-K heavy hitters recur, so a stride
+        sample resolves them with near-certainty while costing one
+        numpy hash over 1/16 of the batch. Drop-oldest at the cap, so
+        churn can't grow the map unboundedly."""
+        from deepflow_tpu.utils.u32 import fold_columns_np
+
+        stride = 16
+        cols = tb.columns
+        sl = slice(None, None, stride)
+        sample = [cols["ip_src"][sl], cols["ip_dst"][sl],
+                  cols["port_src"][sl], cols["port_dst"][sl],
+                  cols["proto"][sl]]
+        keys = fold_columns_np(sample)
+        tup = np.stack([c.astype(np.uint32) for c in sample], axis=1)
+        for i, key in enumerate(keys):
+            k = int(key)
+            # pop-then-insert refreshes recency: dict re-assignment
+            # keeps position, which would make the drop-oldest loop
+            # below evict STANDING heavy hitters first. copy(): a row
+            # view would pin the whole per-batch tup array per entry.
+            self._key_tuples.pop(k, None)
+            self._key_tuples[k] = tup[i].copy()
+        while len(self._key_tuples) > self._KEY_TUPLES_CAP:
+            self._key_tuples.pop(next(iter(self._key_tuples)))
 
     # -- windows -----------------------------------------------------------
     def flush_window(self, now: Optional[float] = None) -> Optional[
@@ -209,12 +253,21 @@ class TpuSketchExporter(QueueWorkerExporter):
         live = counts > 0
         k = int(live.sum())
         if k:
-            self.topk_writer.put({
+            rows = {
                 "timestamp": np.full(k, second, np.uint32),
                 "rank": np.arange(k, dtype=np.uint32),
                 "flow_key": keys[live].astype(np.uint32),
                 "count": np.maximum(counts[live], 0).astype(np.uint32),
-            })
+            }
+            tuples = np.zeros((k, 5), np.uint32)
+            for i, key in enumerate(keys[live].astype(np.uint32)):
+                t = self._key_tuples.get(int(key))
+                if t is not None:
+                    tuples[i] = t
+            for j, name in enumerate(("ip_src", "ip_dst", "port_src",
+                                      "port_dst", "proto")):
+                rows[name] = tuples[:, j]
+            self.topk_writer.put(rows)
         ent = np.asarray(out.entropies, np.float32)
         card = np.asarray(out.service_cardinality)
         self.window_writer.put({

@@ -57,6 +57,38 @@ def fold_columns(cols) -> jnp.ndarray:
     return h
 
 
+def _as_u32_np(x) -> np.ndarray:
+    x = np.asarray(x)
+    if x.dtype == np.uint32:
+        return x
+    if x.dtype == np.int32:
+        return x.view(np.uint32)
+    return x.astype(np.uint32)
+
+
+def _mix32_np(x: np.ndarray) -> np.ndarray:
+    """Host twin of mix32, op for op — keep the two in lockstep."""
+    x = x ^ (x >> _U32(16))
+    x = x * _U32(0x85EBCA6B)
+    x = x ^ (x >> _U32(13))
+    x = x * _U32(0xC2B2AE35)
+    return x ^ (x >> _U32(16))
+
+
+def fold_columns_np(cols) -> np.ndarray:
+    """Host twin of fold_columns — BIT-IDENTICAL to the device fold
+    (asserted in tests), so host code can resolve device flow keys back
+    to the tuples that produced them (e.g. the tpu_sketch exporter's
+    top-K reverse map) without a device round trip."""
+    cols = [_as_u32_np(c) for c in cols]
+    with np.errstate(over="ignore"):
+        h = np.full_like(cols[0], _U32(0x9E3779B9))
+        for c in cols:
+            h = _mix32_np(h ^ (c + _U32(0x9E3779B9) + (h << _U32(6))
+                               + (h >> _U32(2))))
+    return h
+
+
 def splitmix32_seeds(n: int, seed: int = 0x5DEECE66) -> np.ndarray:
     """Host-side deterministic seed schedule (splitmix32), for hash-row salts.
 

@@ -181,3 +181,57 @@ def test_tpu_sketch_exporter(tmp_path):
         assert exp.checkpointer.counters()["saves"] == 1
     finally:
         exp.close()
+
+
+def test_fold_columns_np_matches_device():
+    import numpy as np
+
+    import jax
+
+    from deepflow_tpu.utils.u32 import fold_columns, fold_columns_np
+
+    rng = np.random.default_rng(3)
+    cols = [rng.integers(0, 2**32, 4096, dtype=np.uint64)
+            .astype(np.uint32) for _ in range(5)]
+    dev = np.asarray(jax.jit(fold_columns)(cols))
+    host = fold_columns_np(cols)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_topk_rows_carry_resolved_tuples(tmp_path):
+    """The universal-tag role: topk_flows rows resolve the flow key back
+    to the 5-tuple a human can read (SURVEY Phase 5 (5))."""
+    import numpy as np
+
+    from deepflow_tpu.replay.generator import SyntheticAgent
+    from deepflow_tpu.runtime.tpu_sketch import (SKETCH_DB, TOPK_TABLE,
+                                                 TpuSketchExporter)
+    from deepflow_tpu.store import Store
+
+    store = Store(str(tmp_path))
+    exp = TpuSketchExporter(store=store, batch_rows=4096,
+                            window_seconds=3600)
+    exp.start()
+    try:
+        agent = SyntheticAgent()
+        cols = agent.l4_columns(8192)
+        # heavy hitter: repeat row 0 four thousand times (stride-16
+        # sampling certainly catches it)
+        for k in cols:
+            cols[k] = np.concatenate([cols[k],
+                                      np.repeat(cols[k][:1], 4000)])
+        exp.put("l4_flow_log", 0, cols)
+        import time
+        deadline = time.time() + 20
+        while exp.rows_in < 12192 and time.time() < deadline:
+            time.sleep(0.1)
+        exp.flush_window()
+        exp.flush()
+        rows = store.table(SKETCH_DB, TOPK_TABLE.name).scan()
+        top = int(np.argmax(rows["count"]))
+        assert rows["count"][top] >= 4000
+        assert rows["ip_src"][top] == np.uint32(cols["ip_src"][0])
+        assert rows["ip_dst"][top] == np.uint32(cols["ip_dst"][0])
+        assert rows["proto"][top] == np.uint32(cols["proto"][0])
+    finally:
+        exp.close()
