@@ -11,6 +11,8 @@ flow_tag dict tables, engine/clickhouse/tag/translation.go).
 
 from __future__ import annotations
 
+import dataclasses
+
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -139,8 +141,9 @@ class QueryEngine:
                     continue
             items.append(it)
         if items != stmt.items:
-            stmt = Q.Select(items, stmt.table, stmt.where, stmt.group_by,
-                            stmt.order_by, stmt.limit)
+            # replace(), never positional reconstruction: a new Select
+            # field must not be silently droppable at this call site
+            stmt = dataclasses.replace(stmt, items=items)
 
         # columns referenced anywhere
         needed = set(stmt.group_by)
@@ -165,9 +168,55 @@ class QueryEngine:
         else:
             out_cols, out_rows = self._flat(stmt, cols)
 
+        out_rows = self._having(stmt, out_cols, out_rows)
         out_rows = self._order_limit(stmt, out_cols, out_rows)
         out_rows = self._humanize(out_cols, out_rows)
         return QueryResult(out_cols, out_rows)
+
+    def _having(self, stmt: Q.Select, out_cols: List[str], rows):
+        """Post-aggregation row filter on output columns/aliases
+        (reference: TransHaving in engine/clickhouse)."""
+        if not stmt.having:
+            return rows
+        idx = {}
+        for c in stmt.having:
+            if c.column not in out_cols:
+                raise ValueError(
+                    f"HAVING references {c.column!r}, which is not an "
+                    f"output column of this query ({out_cols})")
+            idx[c.column] = out_cols.index(c.column)
+
+        import operator
+        ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
+               "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+
+        def translated(column: str, value):
+            """String literals translate through the same dictionaries
+            as WHERE; ints pass through. Returns None (match nothing) or
+            a list (duplicate-name membership) like _cond_value."""
+            return self._cond_value(column, value)
+
+        def test(c: Q.Cond, v) -> bool:
+            if c.op == "in":
+                hits = [translated(c.column, x) for x in c.value]
+                flat = [y for x in hits if x is not None
+                        for y in (x if isinstance(x, list) else [x])]
+                return v in flat
+            raw = translated(c.column, c.value)
+            if raw is None:          # unknown dictionary string
+                return c.op == "!="
+            if isinstance(raw, list):
+                if c.op == "=":
+                    return v in raw
+                if c.op == "!=":
+                    return v not in raw
+                raise ValueError(
+                    f"ordering comparison with name {c.value!r} matching "
+                    f"{len(raw)} resources")
+            return ops[c.op](v, raw)
+
+        return [row for row in rows
+                if all(test(c, row[idx[c.column]]) for c in stmt.having)]
 
     # -- where -------------------------------------------------------------
     def _time_bounds(self, conds: List[Q.Cond], tcol: str):

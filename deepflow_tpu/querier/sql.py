@@ -5,7 +5,8 @@ Supports the query shapes the reference querier serves from Grafana
 
     SELECT <expr> [AS alias], ... FROM <table>
       [WHERE <cond> [AND <cond>]...]
-      [GROUP BY col, ...] [ORDER BY <expr> [ASC|DESC]] [LIMIT n]
+      [GROUP BY col, ...] [HAVING <cond> [AND ...]]
+      [ORDER BY <expr> [ASC|DESC]] [LIMIT n]
     SHOW DATABASES | SHOW TABLES [FROM db] |
     SHOW TAGS FROM <table> | SHOW METRICS FROM <table>
 
@@ -95,6 +96,8 @@ class Select:
     group_by: List[str] = field(default_factory=list)
     order_by: Optional[Tuple[str, bool]] = None   # (alias/col, desc)
     limit: Optional[int] = None
+    # post-aggregation conditions on output column names/aliases
+    having: List[Cond] = field(default_factory=list)
 
 
 @dataclass(frozen=True)
@@ -208,6 +211,11 @@ class _Parser:
             group_by.append(self.next())
             while self.accept(","):
                 group_by.append(self.next())
+        having: List[Cond] = []
+        if self.accept("having"):
+            having.append(self.parse_cond())
+            while self.accept("and"):
+                having.append(self.parse_cond())
         if self.accept("order"):
             self.expect("by")
             key = self.next()
@@ -221,7 +229,8 @@ class _Parser:
             limit = int(self.next())
         if self.peek() is not None:
             raise ValueError(f"trailing tokens at {self.peek()!r}")
-        return Select(items, table, where, group_by, order_by, limit)
+        return Select(items, table, where, group_by, order_by, limit,
+                      having)
 
     def parse_cond(self) -> Cond:
         col = self.next()

@@ -227,6 +227,8 @@ def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
         raise ValueError("the device GROUP BY never materializes the "
                          "row->group map; use method='host' with "
                          "return_inverse")
+    if not aggs:
+        method = "host"   # pure dedup: the host path short-circuits it
     # device keys ride u32 lanes: a 64-bit key (mac_src, flow_id) would
     # collide and a float key would truncate-merge — those group on host
     keys_fit_u32 = all(np.asarray(cols[k]).dtype.kind in "uib"
@@ -244,6 +246,10 @@ def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
     uniq, inverse = _unique_rows(packed)
     n_groups = uniq.shape[0]
     value_names = list(aggs.keys())
+    if not value_names:   # pure dedup: SELECT k FROM t GROUP BY k
+        out = {nm: uniq[:, j].astype(cols[nm].dtype)
+               for j, nm in enumerate(key_names)}
+        return (out, inverse) if return_inverse else out
     data = np.stack([np.asarray(cols[nm]).astype(np.int64)
                      for nm in value_names], axis=1)
 

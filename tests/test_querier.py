@@ -690,3 +690,70 @@ def test_promql_discovery_endpoints(prom, tmp_path):
         assert {d["job"] for d in data} == {"api", "web"}
     finally:
         srv.close()
+
+
+def test_having_clause(tmp_path):
+    import numpy as np
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_log", TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(6, dtype=np.uint32),
+              "ip": np.array([1, 1, 1, 2, 2, 3], np.uint32),
+              "bytes": np.array([10, 10, 10, 10, 10, 10], np.uint32)})
+    eng = QueryEngine(store, TagDictRegistry(None))
+    res = eng.execute(
+        "SELECT ip, Sum(bytes) AS b FROM flows GROUP BY ip "
+        "HAVING b > 15 ORDER BY b DESC", db="flow_log")
+    assert res.values == [[1, 30], [2, 20]]
+    res = eng.execute(
+        "SELECT ip, Count(*) AS n FROM flows GROUP BY ip "
+        "HAVING n >= 2 AND n < 3", db="flow_log")
+    assert res.values == [[2, 2]]
+    # referencing a non-output column errors loudly
+    import pytest
+    with pytest.raises(ValueError, match="HAVING"):
+        eng.execute("SELECT ip FROM flows GROUP BY ip HAVING nope > 1",
+                    db="flow_log")
+
+
+def test_having_with_dictionary_string(tmp_path):
+    """HAVING on a hash column with a string literal translates through
+    the dictionaries like WHERE does (and never raises TypeError)."""
+    import numpy as np
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    dicts = TagDictRegistry(None)
+    ep = dicts.get("l7_endpoint")
+    h1, h2 = ep.encode_one("GET /a"), ep.encode_one("GET /b")
+    t = store.create_table("flow_log", TableSchema(
+        name="l7",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("endpoint_hash", np.dtype(np.uint32),
+                            AggKind.KEY),
+                 ColumnSpec("rrt_us", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(4, dtype=np.uint32),
+              "endpoint_hash": np.array([h1, h1, h2, h2], np.uint32),
+              "rrt_us": np.array([10, 20, 30, 40], np.uint32)})
+    eng = QueryEngine(store, dicts)
+    res = eng.execute(
+        "SELECT endpoint_hash, Sum(rrt_us) AS r FROM l7 "
+        "GROUP BY endpoint_hash HAVING endpoint_hash = 'GET /a'",
+        db="flow_log")
+    assert res.values == [["GET /a", 30]]
+    # unknown string matches nothing, != matches everything
+    res = eng.execute(
+        "SELECT endpoint_hash FROM l7 GROUP BY endpoint_hash "
+        "HAVING endpoint_hash != 'nope'", db="flow_log")
+    assert len(res.values) == 2

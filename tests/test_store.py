@@ -297,3 +297,15 @@ def test_device_group_reduce_signed_keys_order():
     np.testing.assert_array_equal(np.asarray(dev["epc"]), host["epc"])
     np.testing.assert_array_equal(np.asarray(dev["v"]), host["v"])
     assert host["epc"].tolist() == [-7, -1, 0, 5]
+
+
+def test_group_reduce_no_aggregates_is_dedup():
+    import numpy as np
+
+    from deepflow_tpu.store.rollup import group_reduce
+
+    cols = {"k": np.array([3, 1, 3, 2, 1], np.uint32)}
+    out = group_reduce(cols, ["k"], {})
+    assert out["k"].tolist() == [1, 2, 3]
+    out = group_reduce(cols, ["k"], {}, method="device")  # host fallback
+    assert out["k"].tolist() == [1, 2, 3]
