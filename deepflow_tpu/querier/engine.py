@@ -127,6 +127,14 @@ class QueryEngine:
         table = self._resolve_table(stmt.table, db)
         schema = table.schema
 
+        # SELECT *: every schema column, in schema order
+        if len(stmt.items) == 1 \
+                and isinstance(stmt.items[0].expr, Q.Column) \
+                and stmt.items[0].expr.name == "*":
+            stmt = dataclasses.replace(stmt, items=[
+                Q.SelectItem(Q.Column(c.name), None)
+                for c in schema.columns])
+
         # expand derived metrics: a bare identifier that names a library
         # metric (and not a real column) substitutes its expression, so
         # `SELECT ip_dst, rtt_avg FROM l4 GROUP BY ip_dst` just works
@@ -186,37 +194,38 @@ class QueryEngine:
                     f"output column of this query ({out_cols})")
             idx[c.column] = out_cols.index(c.column)
 
+        preds = [(idx[c.column], self._scalar_pred(c))
+                 for c in stmt.having]
+        return [row for row in rows
+                if all(p(row[j]) for j, p in preds)]
+
+    def _scalar_pred(self, c: Q.Cond):
+        """One condition -> a value predicate, with the literal
+        translated through the dictionaries ONCE (the scalar form of
+        _filter_mask's semantics: unknown strings match nothing,
+        duplicate resource names widen =/!= to membership — keep the
+        two in agreement)."""
         import operator
         ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
                "<=": operator.le, ">": operator.gt, ">=": operator.ge}
-
-        def translated(column: str, value):
-            """String literals translate through the same dictionaries
-            as WHERE; ints pass through. Returns None (match nothing) or
-            a list (duplicate-name membership) like _cond_value."""
-            return self._cond_value(column, value)
-
-        def test(c: Q.Cond, v) -> bool:
-            if c.op == "in":
-                hits = [translated(c.column, x) for x in c.value]
-                flat = [y for x in hits if x is not None
-                        for y in (x if isinstance(x, list) else [x])]
-                return v in flat
-            raw = translated(c.column, c.value)
-            if raw is None:          # unknown dictionary string
-                return c.op == "!="
-            if isinstance(raw, list):
-                if c.op == "=":
-                    return v in raw
-                if c.op == "!=":
-                    return v not in raw
+        if c.op == "in":
+            hits = [self._cond_value(c.column, x) for x in c.value]
+            flat = {y for x in hits if x is not None
+                    for y in (x if isinstance(x, list) else [x])}
+            return lambda v: v in flat
+        raw = self._cond_value(c.column, c.value)
+        if raw is None:              # unknown dictionary string
+            return lambda v, ok=(c.op == "!="): ok
+        if isinstance(raw, list):
+            if c.op not in ("=", "!="):
                 raise ValueError(
                     f"ordering comparison with name {c.value!r} matching "
                     f"{len(raw)} resources")
-            return ops[c.op](v, raw)
-
-        return [row for row in rows
-                if all(test(c, row[idx[c.column]]) for c in stmt.having)]
+            members = set(raw)
+            if c.op == "=":
+                return lambda v: v in members
+            return lambda v: v not in members
+        return lambda v, op=ops[c.op], t=raw: op(v, t)
 
     # -- where -------------------------------------------------------------
     def _time_bounds(self, conds: List[Q.Cond], tcol: str):
@@ -306,6 +315,15 @@ class QueryEngine:
 
     # -- aggregation -------------------------------------------------------
     def _grouped(self, stmt: Q.Select, cols: Dict[str, np.ndarray]):
+        # a plain column in the select list must be grouped (SELECT *
+        # with GROUP BY reaches here for every schema column) — catch it
+        # here with a real message, not a KeyError from _eval_reduced
+        grouped = set(stmt.group_by)
+        for it in stmt.items:
+            if isinstance(it.expr, Q.Column) and it.expr.name not in grouped:
+                raise ValueError(
+                    f"column {it.expr.name!r} must appear in GROUP BY "
+                    "or inside an aggregate function")
         aggs: Dict[str, str] = {}     # internal value name -> reduce kind
         value_src: Dict[str, np.ndarray] = {}
         n = len(next(iter(cols.values()))) if cols else 0
@@ -363,8 +381,9 @@ class QueryEngine:
 
     # -- post --------------------------------------------------------------
     def _order_limit(self, stmt: Q.Select, out_cols: List[str], rows):
-        if stmt.order_by is not None:
-            key, desc = stmt.order_by
+        # multi-key sort: apply keys in reverse so the stable sort makes
+        # the first ORDER BY key primary
+        for key, desc in reversed(stmt.order_by):
             if key not in out_cols:
                 raise ValueError(f"ORDER BY {key} not in select list")
             idx = out_cols.index(key)

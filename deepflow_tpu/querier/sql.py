@@ -3,10 +3,10 @@
 Supports the query shapes the reference querier serves from Grafana
 (engine/clickhouse/clickhouse.go TransSelect/TransWhere/TransGroupBy):
 
-    SELECT <expr> [AS alias], ... FROM <table>
+    SELECT * | <expr> [AS alias], ... FROM <table>
       [WHERE <cond> [AND <cond>]...]
       [GROUP BY col, ...] [HAVING <cond> [AND ...]]
-      [ORDER BY <expr> [ASC|DESC]] [LIMIT n]
+      [ORDER BY key [ASC|DESC], ...] [LIMIT n]
     SHOW DATABASES | SHOW TABLES [FROM db] |
     SHOW TAGS FROM <table> | SHOW METRICS FROM <table>
 
@@ -94,7 +94,8 @@ class Select:
     table: str
     where: List[Cond] = field(default_factory=list)
     group_by: List[str] = field(default_factory=list)
-    order_by: Optional[Tuple[str, bool]] = None   # (alias/col, desc)
+    # [(alias/col, desc), ...] — primary key first
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
     limit: Optional[int] = None
     # post-aggregation conditions on output column names/aliases
     having: List[Cond] = field(default_factory=list)
@@ -188,19 +189,24 @@ class _Parser:
     # -- clauses -----------------------------------------------------------
     def parse_select(self) -> Select:
         items = []
-        while True:
-            e = self.parse_expr()
-            alias = None
-            if self.accept("as"):
-                alias = self.next()
-            items.append(SelectItem(e, alias))
-            if not self.accept(","):
-                break
+        if self.accept("*"):
+            # SELECT *: expanded to the table's columns by the engine
+            # (which knows the schema); must be the only select item
+            items.append(SelectItem(Column("*"), None))
+        else:
+            while True:
+                e = self.parse_expr()
+                alias = None
+                if self.accept("as"):
+                    alias = self.next()
+                items.append(SelectItem(e, alias))
+                if not self.accept(","):
+                    break
         self.expect("from")
         table = self.next()
         where: List[Cond] = []
         group_by: List[str] = []
-        order_by = None
+        order_by: List[Tuple[str, bool]] = []
         limit = None
         if self.accept("where"):
             where.append(self.parse_cond())
@@ -218,13 +224,16 @@ class _Parser:
                 having.append(self.parse_cond())
         if self.accept("order"):
             self.expect("by")
-            key = self.next()
-            desc = False
-            if self.accept("desc"):
-                desc = True
-            elif self.accept("asc"):
-                pass
-            order_by = (key, desc)
+            while True:
+                key = self.next()
+                desc = False
+                if self.accept("desc"):
+                    desc = True
+                elif self.accept("asc"):
+                    pass
+                order_by.append((key, desc))
+                if not self.accept(","):
+                    break
         if self.accept("limit"):
             limit = int(self.next())
         if self.peek() is not None:

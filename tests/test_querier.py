@@ -24,7 +24,7 @@ def test_parse_select_golden():
     assert s.table == "l4_flow_log"
     assert [c.op for c in s.where] == [">=", "<", "="]
     assert s.group_by == ["ip_dst"]
-    assert s.order_by == ("bytes", True)
+    assert s.order_by == [("bytes", True)]
     assert s.limit == 10
     assert isinstance(s.items[2].expr, BinOp)
     assert isinstance(s.items[2].expr.left, Agg)
@@ -757,3 +757,71 @@ def test_having_with_dictionary_string(tmp_path):
         "SELECT endpoint_hash FROM l7 GROUP BY endpoint_hash "
         "HAVING endpoint_hash != 'nope'", db="flow_log")
     assert len(res.values) == 2
+
+
+def test_select_star(tmp_path):
+    import numpy as np
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_log", TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(3, dtype=np.uint32),
+              "ip": np.array([7, 8, 9], np.uint32),
+              "bytes": np.array([1, 2, 3], np.uint32)})
+    eng = QueryEngine(store, TagDictRegistry(None))
+    res = eng.execute("SELECT * FROM flows ORDER BY timestamp LIMIT 2",
+                      db="flow_log")
+    assert res.columns == ["timestamp", "ip", "bytes"]
+    assert res.values == [[0, 7, 1], [1, 8, 2]]
+    # WHERE composes with *
+    res = eng.execute("SELECT * FROM flows WHERE ip = 9", db="flow_log")
+    assert res.values == [[2, 9, 3]]
+
+
+def test_order_by_multiple_keys(tmp_path):
+    import numpy as np
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_log", TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    t.append({"timestamp": np.arange(4, dtype=np.uint32),
+              "ip": np.array([2, 1, 2, 1], np.uint32),
+              "bytes": np.array([5, 9, 3, 9], np.uint32)})
+    eng = QueryEngine(store, TagDictRegistry(None))
+    res = eng.execute(
+        "SELECT ip, bytes, timestamp FROM flows "
+        "ORDER BY ip ASC, bytes DESC, timestamp ASC", db="flow_log")
+    assert res.values == [[1, 9, 1], [1, 9, 3], [2, 5, 0], [2, 3, 2]]
+
+
+def test_select_star_with_group_by_errors_cleanly(tmp_path):
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store import AggKind, ColumnSpec, Store, TableSchema
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    store.create_table("flow_log", TableSchema(
+        name="flows",
+        columns=(ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("ip", np.dtype(np.uint32), AggKind.KEY),
+                 ColumnSpec("bytes", np.dtype(np.uint32), AggKind.SUM))))
+    eng = QueryEngine(store, TagDictRegistry(None))
+    with pytest.raises(ValueError, match="GROUP BY"):
+        eng.execute("SELECT * FROM flows GROUP BY ip", db="flow_log")
