@@ -16,13 +16,16 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 ETH_IPV4 = 0x0800
+ETH_IPV6 = 0x86DD
 ETH_VLAN = 0x8100
 PROTO_TCP = 6
 PROTO_UDP = 17
 PROTO_ICMP = 1
 VXLAN_PORT = 4789
 
-HDR_BYTES = 64   # enough for eth+vlan+ip(20)+tcp(20) with options slack
+# enough for eth+vlan+ipv6(40)+tcp(20)+options slack; v4 with options
+# still fits with more slack than the old 64
+HDR_BYTES = 96
 
 # tcp flag bits (reference: flow_state.rs)
 FIN, SYN, RST, PSH, ACK = 0x01, 0x02, 0x04, 0x08, 0x10
@@ -53,6 +56,18 @@ def _be32(mat: np.ndarray, off: np.ndarray) -> np.ndarray:
     return out
 
 
+def _fnv_fold16(mat: np.ndarray, off) -> np.ndarray:
+    """Vectorized FNV-1a over 16 bytes per row (IPv6 address -> u32),
+    byte-for-byte identical to store.dict_store.fnv1a32 so every folded
+    v6 key in the system (capture, enrich joins, dictionaries) agrees."""
+    rows = np.arange(mat.shape[0])
+    h = np.full(mat.shape[0], 0x811C9DC5, np.uint32)
+    with np.errstate(over="ignore"):
+        for k in range(16):
+            h = (h ^ mat[rows, off + k]) * np.uint32(0x01000193)
+    return h
+
+
 def decode_packets(frames: List[bytes],
                    timestamps_ns: Optional[np.ndarray] = None,
                    decap_vxlan: bool = True) -> Dict[str, np.ndarray]:
@@ -60,8 +75,10 @@ def decode_packets(frames: List[bytes],
 
     Returns columns: valid(bool), ip_src, ip_dst, port_src, port_dst,
     proto, tcp_flags, pkt_len, payload_off, payload_len, timestamp_ns,
-    tunneled(bool). Non-IPv4 packets come back valid=False (counted, not
-    dropped silently — the caller keeps the mask).
+    tunneled(bool). IPv4 and IPv6 parse (v6 addresses fold to u32 via
+    the system-wide FNV-1a, matching the enrich key space); anything
+    else comes back valid=False (counted, not dropped silently — the
+    caller keeps the mask).
     """
     n = len(frames)
     if timestamps_ns is None:
@@ -87,13 +104,31 @@ def decode_packets(frames: List[bytes],
         mac_dst = (mac_dst << np.uint64(8)) | mat[rows, k]
         mac_src = (mac_src << np.uint64(8)) | mat[rows, 6 + k]
 
-    valid = (eth_type == ETH_IPV4) & (lens >= l3_off + 20)
+    is4 = (eth_type == ETH_IPV4) & (lens >= l3_off + 20)
+    is6 = (eth_type == ETH_IPV6) & (lens >= l3_off + 40)
+    valid = is4 | is6
     ihl = (mat[rows, l3_off] & 0x0F).astype(np.int32) * 4
-    valid &= ihl >= 20  # IHL < 5 is malformed; l4 reads would hit IP bytes
-    proto = mat[rows, l3_off + 9].astype(np.uint32)
+    valid &= ~is4 | (ihl >= 20)  # v4 IHL < 5 is malformed
+    # v6: fixed 40-byte header. A next-header value naming an EXTENSION
+    # header (hop-by-hop/routing/fragment/ESP/AH/dest-opts) would need a
+    # chain walk to find the real l4; those packets come back
+    # valid=False (counted, not mis-parsed — proto 0 must never alias
+    # the hop-by-hop header). Final protocols (TCP/UDP/ICMPv6/...)
+    # parse with the l4 header at the fixed 40-byte offset.
+    proto = np.where(is6, mat[rows, l3_off + 6],
+                     mat[rows, l3_off + 9]).astype(np.uint32)
+    _V6_EXT = (0, 43, 44, 50, 51, 60)
+    ext6 = is6 & np.isin(proto, _V6_EXT)
+    valid &= ~ext6
+    # v6 addresses fold to u32 exactly like the enrich layer's FNV-1a
+    # fold (enrich/platform_data.py key packing), so platform joins on
+    # folded v6 keys agree with capture
     ip_src = _be32(mat, l3_off + 12)
     ip_dst = _be32(mat, l3_off + 16)
-    l4_off = l3_off + ihl
+    if is6.any():
+        ip_src = np.where(is6, _fnv_fold16(mat, l3_off + 8), ip_src)
+        ip_dst = np.where(is6, _fnv_fold16(mat, l3_off + 24), ip_dst)
+    l4_off = np.where(is6, l3_off + 40, l3_off + ihl)
     # l4 header must sit inside the sliced header matrix — clamped reads
     # past it would fabricate ports/flags from IP option bytes
     valid &= l4_off + 14 <= HDR_BYTES
@@ -132,6 +167,11 @@ def decode_packets(frames: List[bytes],
         "tunneled": np.zeros(n, np.bool_),
         "mac_src": mac_src, "mac_dst": mac_dst,
         "vlan_id": vlan_id,
+        # 4 or 6 (0 when invalid): v6 ip columns are FNV folds, so any
+        # consumer doing v4-prefix math (policy CIDR rules, CIDR joins)
+        # must gate on this
+        "ip_version": np.where(is6, 6,
+                               np.where(is4, 4, 0)).astype(np.uint8),
     }
 
     if decap_vxlan:
@@ -153,7 +193,7 @@ def decode_packets(frames: List[bytes],
             # the same layer
             for name in ("valid", "ip_src", "ip_dst", "port_src",
                          "port_dst", "proto", "tcp_flags", "tcp_seq",
-                         "mac_src", "mac_dst"):
+                         "mac_src", "mac_dst", "ip_version"):
                 cols[name][idxs] = inner[name]
             # payload offsets are relative to the inner frame start
             cols["payload_off"][idxs] = inner["payload_off"] + \

@@ -71,6 +71,11 @@ class PolicyLabeler:
                 prefix = np.uint32(r.ip_prefix) & mask
                 m &= ((cols["ip_src"] & mask) == prefix) | \
                      ((cols["ip_dst"] & mask) == prefix)
+                # v4 CIDR rules never match v6 rows: their ip columns
+                # are FNV folds, and prefix math on a hash would match
+                # ~1/2^mask_len of all v6 traffic at random
+                if "ip_version" in cols:
+                    m &= cols["ip_version"] != 6
             if r.port_max:
                 m &= ((cols["port_src"] >= r.port_min)
                       & (cols["port_src"] <= r.port_max)) | \

@@ -258,3 +258,47 @@ def test_agent_managed_by_controller(tmp_path):
         assert agent.cfg.l7_enabled is False
     finally:
         srv.close()
+
+
+def test_decode_ipv6():
+    import struct as _struct
+
+    from deepflow_tpu.store.dict_store import fnv1a32
+
+    src16 = bytes(range(16))
+    dst16 = bytes(range(16, 32))
+    tcp = _struct.pack(">HHIIBBHHH", 443, 55000, 7, 0, 0x50, ACK,
+                       8192, 0, 0) + b"hello6"
+    ip6 = _struct.pack(">IHBB", 0x60000000, len(tcp), 6, 64) \
+        + src16 + dst16
+    frame = b"\x02" * 6 + b"\x04" * 6 + b"\x86\xdd" + ip6 + tcp
+    cols = decode_packets([frame])
+    assert cols["valid"][0]
+    assert cols["proto"][0] == 6
+    assert cols["port_src"][0] == 443 and cols["port_dst"][0] == 55000
+    # v6 addresses fold exactly like the system-wide FNV-1a fold
+    assert cols["ip_src"][0] == fnv1a32(src16)
+    assert cols["ip_dst"][0] == fnv1a32(dst16)
+    assert frame[cols["payload_off"][0]:] == b"hello6"
+    assert cols["ip_version"][0] == 6
+    # a v6 packet with an extension-header chain is counted invalid
+    # (proto 0 must never alias hop-by-hop), never mis-parsed
+    for nh in (0, 43):
+        ip6_ext = _struct.pack(">IHBB", 0x60000000, len(tcp), nh, 64) \
+            + src16 + dst16
+        cols = decode_packets([b"\x02" * 6 + b"\x04" * 6 + b"\x86\xdd"
+                               + ip6_ext + tcp])
+        assert not cols["valid"][0]
+    # v4 CIDR policy rules must not match folded v6 addresses
+    from deepflow_tpu.agent.policy import AclRule, PolicyLabeler
+    import numpy as np
+    pl = PolicyLabeler([AclRule(rule_id=3, ip_prefix=0x0A000000,
+                                ip_mask_len=8)])
+    fold = fnv1a32(src16)
+    pcols = {"ip_src": np.array([fold, 0x0A000001], np.uint32),
+             "ip_dst": np.array([fold, 0x0A000002], np.uint32),
+             "port_src": np.zeros(2, np.uint32),
+             "port_dst": np.zeros(2, np.uint32),
+             "proto": np.full(2, 6, np.uint32),
+             "ip_version": np.array([6, 4], np.uint8)}
+    assert pl.lookup(pcols).tolist() == [0, 3]
