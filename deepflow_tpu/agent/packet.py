@@ -20,6 +20,7 @@ ETH_IPV6 = 0x86DD
 ETH_VLAN = 0x8100
 PROTO_TCP = 6
 PROTO_UDP = 17
+PROTO_GRE = 47
 PROTO_ICMP = 1
 VXLAN_PORT = 4789
 
@@ -56,16 +57,19 @@ def _be32(mat: np.ndarray, off: np.ndarray) -> np.ndarray:
     return out
 
 
-def _fnv_fold16(mat: np.ndarray, off) -> np.ndarray:
-    """Vectorized FNV-1a over 16 bytes per row (IPv6 address -> u32),
-    byte-for-byte identical to store.dict_store.fnv1a32 so every folded
-    v6 key in the system (capture, enrich joins, dictionaries) agrees."""
-    rows = np.arange(mat.shape[0])
-    h = np.full(mat.shape[0], 0x811C9DC5, np.uint32)
+def _fold16_rows(sub: np.ndarray, off: int) -> np.ndarray:
+    """Vectorized store.dict_store.fold_ipv6 over the rows of `sub`
+    (byte-for-byte identical, asserted in tests): FNV-1a over 16 bytes,
+    confined to class E so folded v6 keys never collide with real v4
+    ranges. Callers pass only the v6 rows — cost scales with v6 count,
+    not batch size."""
+    n = sub.shape[0]
+    rows = np.arange(n)
+    h = np.full(n, 0x811C9DC5, np.uint32)
     with np.errstate(over="ignore"):
         for k in range(16):
-            h = (h ^ mat[rows, off + k]) * np.uint32(0x01000193)
-    return h
+            h = (h ^ sub[rows, off + k]) * np.uint32(0x01000193)
+    return h | np.uint32(0xF0000000)
 
 
 def decode_packets(frames: List[bytes],
@@ -126,8 +130,11 @@ def decode_packets(frames: List[bytes],
     ip_src = _be32(mat, l3_off + 12)
     ip_dst = _be32(mat, l3_off + 16)
     if is6.any():
-        ip_src = np.where(is6, _fnv_fold16(mat, l3_off + 8), ip_src)
-        ip_dst = np.where(is6, _fnv_fold16(mat, l3_off + 24), ip_dst)
+        i6 = np.nonzero(is6)[0]
+        # l3_off can differ per row (vlan); slice each v6 row's l3 start
+        sub = np.stack([mat[i, l3_off[i]:l3_off[i] + 40] for i in i6])
+        ip_src[i6] = _fold16_rows(sub, 8)
+        ip_dst[i6] = _fold16_rows(sub, 24)
     l4_off = np.where(is6, l3_off + 40, l3_off + ihl)
     # l4 header must sit inside the sliced header matrix — clamped reads
     # past it would fabricate ports/flags from IP option bytes
@@ -200,4 +207,60 @@ def decode_packets(frames: List[bytes],
                 payload_off[idxs].astype(np.int32) + 8
             cols["payload_len"][idxs] = inner["payload_len"]
             cols["tunneled"][idxs] = True
+
+        # GRE (proto 47) and ERSPAN-over-GRE (reference:
+        # common/decapsulate.rs TunnelType::{Gre, ErspanOrTeb}). The GRE
+        # header is 4 bytes + 4 per C/K/S flag; protocol 0x6558
+        # (transparent ethernet) and 0x88BE/0x22EB (ERSPAN I-II/III,
+        # which add an 8/12-byte ERSPAN header before the inner eth)
+        # carry a full inner frame we can re-decode.
+        # ~tunneled: a row the VXLAN pass already rewrote carries INNER
+        # columns with OUTER offsets — re-examining it here would read
+        # GRE fields out of the vxlan header
+        gre = cols["valid"] & (cols["proto"] == PROTO_GRE) \
+            & ~cols["tunneled"]
+        if gre.any():
+            idxs, inner_frames, kept = np.nonzero(gre)[0], [], []
+            for i in idxs:
+                off = int(payload_off[i])
+                f = frames[i]
+                if off + 4 > len(f):
+                    continue
+                s_flag = (f[off] >> 4) & 1
+                gproto = (f[off + 2] << 8) | f[off + 3]
+                hdr = 4 + 4 * ((f[off] >> 7) & 1) \
+                    + 4 * ((f[off] >> 5) & 1) + 4 * s_flag
+                if gproto == 0x6558:              # TEB: inner eth
+                    inner_off = off + hdr
+                elif gproto == 0x88BE:
+                    # ERSPAN I has NO header and no S flag; II has the S
+                    # flag and an 8-byte header (type I vs II is exactly
+                    # this bit, decapsulate.rs erspan handling)
+                    inner_off = off + hdr + (8 if s_flag else 0)
+                elif gproto == 0x22EB:            # ERSPAN III: 12B header
+                    if off + hdr + 12 > len(f):
+                        continue
+                    inner_off = off + hdr + 12
+                    if f[off + hdr + 11] & 0x01:  # O bit: 8B subheader
+                        inner_off += 8
+                else:
+                    continue                      # routed GRE: no inner eth
+                if inner_off + 14 > len(f):
+                    continue
+                kept.append(i)
+                inner_frames.append(f[inner_off:])
+            if kept:
+                idxs = np.asarray(kept)
+                inner = decode_packets(inner_frames, timestamps_ns[idxs],
+                                       decap_vxlan=False)
+                for name in ("valid", "ip_src", "ip_dst", "port_src",
+                             "port_dst", "proto", "tcp_flags", "tcp_seq",
+                             "mac_src", "mac_dst", "ip_version"):
+                    cols[name][idxs] = inner[name]
+                offs = np.asarray([len(frames[i]) - len(nf)
+                                   for i, nf in zip(idxs, inner_frames)],
+                                  np.int32)
+                cols["payload_off"][idxs] = inner["payload_off"] + offs
+                cols["payload_len"][idxs] = inner["payload_len"]
+                cols["tunneled"][idxs] = True
     return cols

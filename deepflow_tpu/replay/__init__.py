@@ -1,6 +1,6 @@
-from deepflow_tpu.replay.frames import (eth_ipv4_tcp, eth_ipv4_udp, ip4,
-                                        vxlan)
+from deepflow_tpu.replay.frames import (erspan_i, erspan_ii, eth_ipv4_tcp,
+                                        eth_ipv4_udp, gre_teb, ip4, vxlan)
 from deepflow_tpu.replay.generator import SyntheticAgent
 
 __all__ = ["SyntheticAgent", "eth_ipv4_tcp", "eth_ipv4_udp", "ip4",
-           "vxlan"]
+           "vxlan", "gre_teb", "erspan_i", "erspan_ii"]

@@ -29,6 +29,16 @@ def fnv1a32(data: bytes) -> int:
     return h
 
 
+def fold_ipv6(addr16: bytes) -> int:
+    """THE system-wide IPv6 -> u32 fold: FNV-1a confined to the class-E
+    range (240.0.0.0/4, reserved and unrouted), so a folded v6 address
+    can never collide with a real v4 interface/CIDR in platform joins or
+    policy prefixes while keeping 28 bits of key entropy. Capture
+    (agent/packet.py), platform compilation, and enrichment all use this
+    one function."""
+    return fnv1a32(addr16) | 0xF0000000
+
+
 class TagDict:
     """One named dictionary (e.g. 'metric_name', 'app_stack')."""
 

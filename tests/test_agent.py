@@ -277,8 +277,10 @@ def test_decode_ipv6():
     assert cols["proto"][0] == 6
     assert cols["port_src"][0] == 443 and cols["port_dst"][0] == 55000
     # v6 addresses fold exactly like the system-wide FNV-1a fold
-    assert cols["ip_src"][0] == fnv1a32(src16)
-    assert cols["ip_dst"][0] == fnv1a32(dst16)
+    from deepflow_tpu.store.dict_store import fold_ipv6
+    assert cols["ip_src"][0] == fold_ipv6(src16)
+    assert cols["ip_dst"][0] == fold_ipv6(dst16)
+    assert cols["ip_src"][0] >> 28 == 0xF      # class-E confinement
     assert frame[cols["payload_off"][0]:] == b"hello6"
     assert cols["ip_version"][0] == 6
     # a v6 packet with an extension-header chain is counted invalid
@@ -294,7 +296,7 @@ def test_decode_ipv6():
     import numpy as np
     pl = PolicyLabeler([AclRule(rule_id=3, ip_prefix=0x0A000000,
                                 ip_mask_len=8)])
-    fold = fnv1a32(src16)
+    fold = fold_ipv6(src16)
     pcols = {"ip_src": np.array([fold, 0x0A000001], np.uint32),
              "ip_dst": np.array([fold, 0x0A000002], np.uint32),
              "port_src": np.zeros(2, np.uint32),
@@ -302,3 +304,31 @@ def test_decode_ipv6():
              "proto": np.full(2, 6, np.uint32),
              "ip_version": np.array([6, 4], np.uint8)}
     assert pl.lookup(pcols).tolist() == [0, 3]
+
+
+def test_decode_gre_and_erspan():
+    from deepflow_tpu.replay.frames import erspan_i, erspan_ii, gre_teb
+
+    inner = eth_ipv4_tcp(CLIENT, SERVER, 1234, 443, SYN, b"tls?", seq=9)
+    for outer in (gre_teb(_ip(1, 1, 1, 1), _ip(2, 2, 2, 2), inner),
+                  gre_teb(_ip(1, 1, 1, 1), _ip(2, 2, 2, 2), inner,
+                          key=0xBEEF),
+                  erspan_i(_ip(1, 1, 1, 1), _ip(2, 2, 2, 2), inner),
+                  erspan_ii(_ip(1, 1, 1, 1), _ip(2, 2, 2, 2), inner)):
+        cols = decode_packets([outer])
+        assert cols["valid"][0] and cols["tunneled"][0]
+        assert cols["ip_src"][0] == CLIENT
+        assert cols["port_dst"][0] == 443
+        assert cols["tcp_seq"][0] == 9
+        assert outer[cols["payload_off"][0]:] == b"tls?"
+    # routed GRE (inner is bare IP, proto 0x0800): no inner ETH to
+    # re-decode — stays an outer-flow packet, not mis-parsed
+    import struct as _s
+    bare = _s.pack(">HH", 0, 0x0800) + inner[14:]
+    total = 20 + len(bare)
+    ip = _s.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 47, 0,
+                 _ip(1, 1, 1, 1), _ip(2, 2, 2, 2))
+    frame = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x00" + ip + bare
+    cols = decode_packets([frame])
+    assert cols["valid"][0] and not cols["tunneled"][0]
+    assert cols["proto"][0] == 47
