@@ -131,8 +131,9 @@ def decode_packets(frames: List[bytes],
     ip_dst = _be32(mat, l3_off + 16)
     if is6.any():
         i6 = np.nonzero(is6)[0]
-        # l3_off can differ per row (vlan); slice each v6 row's l3 start
-        sub = np.stack([mat[i, l3_off[i]:l3_off[i] + 40] for i in i6])
+        # one fancy-index gather of each v6 row's 40 l3 header bytes
+        # (l3_off varies per row with vlan) — no per-packet Python
+        sub = mat[i6[:, None], l3_off[i6][:, None] + np.arange(40)]
         ip_src[i6] = _fold16_rows(sub, 8)
         ip_dst[i6] = _fold16_rows(sub, 24)
     l4_off = np.where(is6, l3_off + 40, l3_off + ihl)
@@ -247,20 +248,27 @@ def decode_packets(frames: List[bytes],
                     continue                      # routed GRE: no inner eth
                 if inner_off + 14 > len(f):
                     continue
-                kept.append(i)
+                kept.append((i, inner_off))
                 inner_frames.append(f[inner_off:])
             if kept:
-                idxs = np.asarray(kept)
+                idxs = np.asarray([i for i, _ in kept])
                 inner = decode_packets(inner_frames, timestamps_ns[idxs],
                                        decap_vxlan=False)
-                for name in ("valid", "ip_src", "ip_dst", "port_src",
-                             "port_dst", "proto", "tcp_flags", "tcp_seq",
-                             "mac_src", "mac_dst", "ip_version"):
-                    cols[name][idxs] = inner[name]
-                offs = np.asarray([len(frames[i]) - len(nf)
-                                   for i, nf in zip(idxs, inner_frames)],
-                                  np.int32)
-                cols["payload_off"][idxs] = inner["payload_off"] + offs
-                cols["payload_len"][idxs] = inner["payload_len"]
-                cols["tunneled"][idxs] = True
+                # a bridged inner frame can legitimately be non-IP
+                # (ARP/LLDP ride TEB): those keep the valid OUTER flow
+                # row instead of being overwritten with invalid columns
+                ok = inner["valid"]
+                if ok.any():
+                    sub = idxs[ok]
+                    for name in ("valid", "ip_src", "ip_dst", "port_src",
+                                 "port_dst", "proto", "tcp_flags",
+                                 "tcp_seq", "mac_src", "mac_dst",
+                                 "ip_version"):
+                        cols[name][sub] = inner[name][ok]
+                    offs = np.asarray([o for _, o in kept],
+                                      np.int32)[ok]
+                    cols["payload_off"][sub] = \
+                        inner["payload_off"][ok] + offs
+                    cols["payload_len"][sub] = inner["payload_len"][ok]
+                    cols["tunneled"][sub] = True
     return cols

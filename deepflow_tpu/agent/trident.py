@@ -172,6 +172,7 @@ class Agent:
         self.config_version = 0
         self.platform_watcher = None
         self.k8s_watcher = None
+        self.ntp_offset_ns = 0
         self.so_plugins: Dict[str, object] = {}
         for path in cfg.so_plugins:
             self._load_plugin(path)
@@ -208,11 +209,21 @@ class Agent:
         req = urllib.request.Request(
             f"{self.cfg.controller_url}/v1/sync", data=body,
             headers={"Content-Type": "application/json"})
+        t0 = time.time_ns()
         try:
             with urllib.request.urlopen(req, timeout=5) as resp:
                 r = json.load(resp)
         except Exception:
             return False
+        t1 = time.time_ns()
+        if "server_time_ns" in r:
+            # classic NTP midpoint estimate: offset = server - local at
+            # the round-trip middle (reference: rpc/ntp.rs). Tracked and
+            # surfaced, NOT silently applied to packet timestamps — a
+            # step-change mid-window would corrupt flow durations; the
+            # operator sees the drift in counters/df-ctl and fixes the
+            # clock (the reference's agent likewise reports and gates).
+            self.ntp_offset_ns = int(r["server_time_ns"]) - (t0 + t1) // 2
         self.set_vtap_id(r["vtap_id"])
         if r.get("ingester"):
             for s in self.senders.values():
@@ -390,6 +401,7 @@ class Agent:
     def counters(self) -> dict:
         c = self.flow_map.counters()
         c["escaped"] = int(self.escaped)
+        c["ntp_offset_ns"] = self.ntp_offset_ns
         c["sessions_merged"] = self.sessions.merged
         for mt, s in self.senders.items():
             c[f"sent_{mt.name.lower()}"] = s.sent_records

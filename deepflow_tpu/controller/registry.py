@@ -114,6 +114,11 @@ class VTapRegistry:
                 "group": vt.group,
                 "config": cfg,
                 "config_version": self.config_version,
+                # controller wall clock (ns): the agent derives its NTP
+                # offset from this (reference: Synchronizer.NTP — a
+                # dedicated rpc there; piggybacked on Sync here since
+                # the round trip is the same)
+                "server_time_ns": time.time_ns(),
             }
 
     # -- fleet management --------------------------------------------------

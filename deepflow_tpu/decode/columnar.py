@@ -77,8 +77,10 @@ def _fill(schema, rows: List[tuple]) -> Dict[str, np.ndarray]:
 
 
 def _ip_u32(ip4: int, ip6: bytes) -> int:
-    """v4 address, or the FNV fold of a v6 address (is_ipv6 marks which)."""
-    return _fnv1a32(ip6) if ip6 else _u32(ip4)
+    """v4 address, or the system-wide class-E-confined fold of a v6
+    address (store.dict_store.fold_ipv6; is_ipv6 marks which) — the
+    same u32 the capture path produces for the same address."""
+    return (_fnv1a32(ip6) | 0xF0000000) if ip6 else _u32(ip4)
 
 
 def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
@@ -356,7 +358,7 @@ def decode_metric_records(records: Iterable[bytes],
         except Exception:
             continue
         fld = d.tag.field
-        ip = _fnv1a32(fld.ip) if len(fld.ip) == 16 else (
+        ip = (_fnv1a32(fld.ip) | 0xF0000000) if len(fld.ip) == 16 else (
             int.from_bytes(fld.ip, "big") if fld.ip else 0)
         t = d.meter.flow.traffic
         p = d.meter.flow.performance

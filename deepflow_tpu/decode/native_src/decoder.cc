@@ -135,8 +135,12 @@ inline bool open_sub(Cursor& c, Cursor* sub) {
   return true;
 }
 
-// length-delimited bytes field -> FNV-1a 32 fold (the Python decoder's
-// _fnv1a32, used to fold IPv6 addresses into the u32 ip columns)
+// length-delimited IPv6 bytes field -> the system-wide u32 fold:
+// FNV-1a confined to class E (dict_store.fold_ipv6 / packet.py
+// _fold16_rows), so every path that keys on a folded v6 address —
+// capture, this decoder, enrichment — produces the SAME u32 and never
+// aliases a real v4 range. Only the two ip fields use this; string
+// hashes stay full-range FNV.
 inline bool read_bytes_fnv(Cursor& c, uint32_t* out, bool* nonempty) {
   uint64_t len;
   if (!read_varint(c, &len) ||
@@ -145,7 +149,7 @@ inline bool read_bytes_fnv(Cursor& c, uint32_t* out, bool* nonempty) {
   for (uint64_t i = 0; i < len; ++i)
     h = (h ^ c.p[i]) * 0x01000193u;
   c.p += len;
-  *out = h;
+  *out = h | 0xF0000000u;
   *nonempty = len > 0;
   return true;
 }

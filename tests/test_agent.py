@@ -332,3 +332,34 @@ def test_decode_gre_and_erspan():
     cols = decode_packets([frame])
     assert cols["valid"][0] and not cols["tunneled"][0]
     assert cols["proto"][0] == 47
+
+
+def test_agent_ntp_offset(tmp_path):
+    from deepflow_tpu.controller import (ControllerServer, ResourceModel,
+                                         VTapRegistry)
+
+    srv = ControllerServer(ResourceModel(), VTapRegistry(), port=0)
+    srv.start()
+    try:
+        agent = Agent(AgentConfig(
+            ctrl_ip="10.0.0.9", host="ntp-node",
+            controller_url=f"http://127.0.0.1:{srv.port}"))
+        assert agent.sync_once()
+        # same host, same clock: offset is bounded by the round trip
+        assert abs(agent.ntp_offset_ns) < 5_000_000_000
+        assert "ntp_offset_ns" in agent.counters()
+        agent.close()
+    finally:
+        srv.close()
+
+
+def test_gre_teb_arp_keeps_outer_flow():
+    from deepflow_tpu.replay.frames import gre_teb
+
+    arp = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x06" + b"\x00" * 28
+    outer = gre_teb(_ip(9, 9, 9, 1), _ip(9, 9, 9, 2), arp)
+    cols = decode_packets([outer])
+    # non-IP inner: the valid OUTER gre flow row survives
+    assert cols["valid"][0] and not cols["tunneled"][0]
+    assert cols["proto"][0] == 47
+    assert cols["ip_src"][0] == _ip(9, 9, 9, 1)

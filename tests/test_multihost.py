@@ -149,3 +149,14 @@ def test_local_shard_single_process():
     # replicated arrays come back once, not duplicated per device
     rep = jax.device_put(x, NamedSharding(mesh, P()))
     np.testing.assert_array_equal(local_shard(rep), np.asarray(x))
+
+
+def test_two_axis_global_mesh():
+    import jax
+
+    from deepflow_tpu.parallel import make_global_mesh
+
+    mesh = make_global_mesh(("dcn_data", "data"))
+    # single process: one host row spanning all local devices
+    assert mesh.shape["dcn_data"] == jax.process_count() == 1
+    assert mesh.shape["data"] == jax.local_device_count()

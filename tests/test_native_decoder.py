@@ -55,3 +55,50 @@ def test_truncated_payload():
 def test_empty_payload():
     got, bad = native.decode_l4_payload(b"")
     assert bad == 0 and len(got["ip_src"]) == 0
+
+
+def test_v6_fold_agrees_across_paths():
+    """Capture, the Python wire decoder, and the C++ decoder must all
+    produce the SAME class-E-confined u32 for one v6 address."""
+    import struct
+
+    import numpy as np
+
+    from deepflow_tpu.agent.packet import decode_packets
+    from deepflow_tpu.store.dict_store import fold_ipv6
+
+    src16 = bytes(range(100, 116))
+    dst16 = bytes(range(116, 132))
+    tcp = struct.pack(">HHIIBBHHH", 443, 55000, 7, 0, 0x50, 0x10,
+                      8192, 0, 0)
+    ip6 = struct.pack(">IHBB", 0x60000000, len(tcp), 6, 64) \
+        + src16 + dst16
+    frame = b"\x02" * 6 + b"\x04" * 6 + b"\x86\xdd" + ip6 + tcp
+    cap = decode_packets([frame])
+    assert cap["ip_src"][0] == fold_ipv6(src16)
+
+    from deepflow_tpu.decode import native
+    from deepflow_tpu.decode.columnar import decode_l4_records
+    from deepflow_tpu.wire.codec import pack_pb_records
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    d = flow_log_pb2.TaggedFlow()
+    d.flow.flow_key.ip6_src = src16
+    d.flow.flow_key.ip6_dst = dst16
+    d.flow.flow_key.port_src = 443
+    d.flow.flow_key.port_dst = 55000
+    rec = d.SerializeToString()
+    py = decode_l4_records([rec])
+    assert py["ip_src"][0] == fold_ipv6(src16)
+    assert py["ip_dst"][0] == fold_ipv6(dst16)
+    if native.available():
+        payload = pack_pb_records([rec])
+        n32 = len(native.L4_COLS32)
+        n64 = len(native.L4_COLS64)
+        buf32 = np.empty((n32, 8), np.uint32)
+        buf64 = np.empty((n64, 8), np.uint64)
+        rows, bad, _ = native.decode_l4_into(payload, buf32, buf64)
+        assert rows == 1
+        names32 = [n for n, _ in native.L4_COLS32]
+        assert buf32[names32.index("ip_src"), 0] == fold_ipv6(src16)
+        assert buf32[names32.index("ip_dst"), 0] == fold_ipv6(dst16)
