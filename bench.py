@@ -70,6 +70,21 @@ def _to_schema(cols, batch, schema):
 
 
 def main() -> None:
+    import threading
+
+    # backend-init watchdog: a down tunnel makes the first jax call hang
+    # forever; fail crisply instead so the driver records an error
+    # rather than a silent multi-hour stall. 300s >> the ~40s worst-case
+    # healthy cold init.
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(300):
+            _phase("FATAL: backend init exceeded 300s (tunnel down?)")
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
     import jax.numpy as jnp
 
@@ -105,6 +120,7 @@ def main() -> None:
 
     _phase("probe fresh h2d")
     h2d_fresh = h2d_mb_s()
+    init_done.set()   # backend is up; the watchdog stands down
 
     _phase("staging synthetic pool + payloads")
     # -- stage: one pool of distinct flows, Zipf-picked record streams ----
