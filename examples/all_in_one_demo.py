@@ -11,8 +11,11 @@ What a reference (dzy176/deepflow) user gets after switching:
    (synthetic eth/ipv4/tcp here), runs flow generation + L7 parsing,
    and ships flows/metrics/l7 logs over the firehose wire;
 4. the ingester decodes, enriches with platform data, stores, and the
-   TPU sketch exporter keeps heavy-hitter/cardinality/entropy windows;
-5. DeepFlow-SQL and PromQL answer over the stored data.
+   device analytics exporters keep heavy-hitter/cardinality/entropy and
+   per-service RED windows;
+5. DeepFlow-SQL answers over the stored data, including the sketch
+   outputs (top-K rows resolve to human-readable 5-tuples; RED rows
+   carry DDSketch latency quantiles).
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
         python examples/all_in_one_demo.py
@@ -60,6 +63,8 @@ ingester:
   port: 0
   store_path: {tmp}/store
   debug_port: 0
+  tpu_sketch_window_s: 3600
+  app_red_window_s: 3600
 querier:
   port: 0
 """)
@@ -155,6 +160,33 @@ querier:
     tags = _req(f"{q}/v1/query", form={
         "db": "flow_log", "sql": "SHOW TAGS FROM l4_flow_log"})["result"]
     print(f"\nSHOW TAGS: {len(tags['values'])} tags available")
+
+    # -- 6. device analytics: top-K heavy hitters + per-service RED --------
+    # the exporters consume their queues asynchronously: wait for the
+    # processed-rows watermark before closing the window, or it flushes
+    # empty (same discipline as the exporter tests)
+    deadline = time.time() + 15
+    while time.time() < deadline and not (
+            server.ingester.tpu_sketch.rows_in
+            and server.ingester.app_red.rows_in):
+        time.sleep(0.1)
+    server.ingester.tpu_sketch.flush_window()
+    server.ingester.app_red.flush_window()
+    server.ingester.flush()
+    topk = _req(f"{q}/v1/query", form={
+        "db": "tpu_sketch",
+        "sql": "SELECT rank, ip_src, ip_dst, port_dst, count "
+               "FROM topk_flows ORDER BY count DESC LIMIT 3"})["result"]
+    print("\ntop flows (device sketches, resolved 5-tuples):")
+    for row in topk["values"]:
+        print("  " + " | ".join(str(v) for v in row))
+    red = _req(f"{q}/v1/query", form={
+        "db": "tpu_sketch",
+        "sql": "SELECT service_group, requests, errors, rrt_p95_us "
+               "FROM app_red"})["result"]
+    print("\nper-service RED (DDSketch quantiles):")
+    for row in red["values"]:
+        print("  " + " | ".join(str(v) for v in row))
 
     agent.close()
     server.close()
