@@ -21,7 +21,7 @@ import os
 import socket
 import threading
 import urllib.request
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from deepflow_tpu.store.dict_store import fnv1a32
 

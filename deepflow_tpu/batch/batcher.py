@@ -12,7 +12,7 @@ for a device boundary instead of a ClickHouse writer.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator
 
 import numpy as np
 

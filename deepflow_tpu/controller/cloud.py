@@ -30,7 +30,7 @@ import json
 import threading
 import time
 import urllib.request
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from deepflow_tpu.controller.model import (RESOURCE_TYPES, Resource,

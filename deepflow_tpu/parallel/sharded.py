@@ -24,7 +24,6 @@ Three suites share the pattern (scaffolding in _ShardedSuiteBase):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Dict, Tuple
 
 import jax

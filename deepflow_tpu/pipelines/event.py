@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 

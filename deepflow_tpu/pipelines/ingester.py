@@ -10,7 +10,7 @@ pure-TPU sketch deployment runs in.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from deepflow_tpu.enrich.platform_data import PlatformDataManager

@@ -11,7 +11,7 @@ dictionary lookup at query time.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 

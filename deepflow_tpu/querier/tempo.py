@@ -13,7 +13,6 @@ hashes back out. No string columns ever hit the store.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np

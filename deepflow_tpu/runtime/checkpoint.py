@@ -12,8 +12,6 @@ shapes/dtypes against a freshly-initialized state of the current config
 from __future__ import annotations
 
 import os
-import threading
-import time
 from typing import Any, Optional
 
 import numpy as np

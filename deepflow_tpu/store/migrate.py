@@ -15,9 +15,8 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Tuple
+from typing import Dict, List, Tuple
 
-import numpy as np
 
 from deepflow_tpu.store.db import Store, Table
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
