@@ -1,6 +1,7 @@
 from deepflow_tpu.replay.frames import (erspan_i, erspan_ii, eth_ipv4_tcp,
-                                        eth_ipv4_udp, gre_teb, ip4, vxlan)
+                                        eth_ipv4_udp, eth_ipv6_tcp,
+                                        gre_teb, ip4, vxlan)
 from deepflow_tpu.replay.generator import SyntheticAgent
 
 __all__ = ["SyntheticAgent", "eth_ipv4_tcp", "eth_ipv4_udp", "ip4",
-           "vxlan", "gre_teb", "erspan_i", "erspan_ii"]
+           "vxlan", "gre_teb", "erspan_i", "erspan_ii", "eth_ipv6_tcp"]

@@ -48,6 +48,18 @@ def eth_ipv4_udp(src: int, dst: int, sport: int, dport: int,
     return _eth_ipv4(src, dst, 17, udp)
 
 
+def eth_ipv6_tcp(src16: bytes, dst16: bytes, sport: int, dport: int,
+                 flags: int = ACK, payload: bytes = b"",
+                 seq: int = 0) -> bytes:
+    """One eth/ipv6/tcp frame (fixed 40-byte v6 header, 16-byte
+    addresses)."""
+    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, 0, 0x50, flags,
+                      8192, 0, 0) + payload
+    ip6 = struct.pack(">IHBB", 0x60000000, len(tcp), 6, 64) \
+        + src16 + dst16
+    return b"\x02" * 6 + b"\x04" * 6 + b"\x86\xdd" + ip6 + tcp
+
+
 def vxlan(outer_src: int, outer_dst: int, inner_frame: bytes,
           vni: int = 123) -> bytes:
     """Wrap an inner frame in vxlan/udp/ipv4 (decap tested in

@@ -265,13 +265,12 @@ def test_decode_ipv6():
 
     from deepflow_tpu.store.dict_store import fnv1a32
 
+    from deepflow_tpu.replay import eth_ipv6_tcp
+
     src16 = bytes(range(16))
     dst16 = bytes(range(16, 32))
-    tcp = _struct.pack(">HHIIBBHHH", 443, 55000, 7, 0, 0x50, ACK,
-                       8192, 0, 0) + b"hello6"
-    ip6 = _struct.pack(">IHBB", 0x60000000, len(tcp), 6, 64) \
-        + src16 + dst16
-    frame = b"\x02" * 6 + b"\x04" * 6 + b"\x86\xdd" + ip6 + tcp
+    frame = eth_ipv6_tcp(src16, dst16, 443, 55000, ACK, b"hello6", seq=7)
+    tcp = frame[54:]   # the ext-header variants below reuse the l4 bytes
     cols = decode_packets([frame])
     assert cols["valid"][0]
     assert cols["proto"][0] == 6

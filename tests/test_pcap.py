@@ -174,3 +174,45 @@ def test_pcap_rejects_huge_record_length(tmp_path):
     open(path, "wb").write(bytes(data))
     with pytest.raises(PcapFormatError):
         list(read_pcap(path))
+
+
+def test_pcap_replay_v6_and_erspan(tmp_path):
+    """Fixture replay with the round's new protocols: an IPv6 handshake
+    and an ERSPAN-mirrored v4 conversation in one capture file."""
+    import struct
+
+    import numpy as np
+
+    from deepflow_tpu.agent.pcap import PcapFrameSource, write_pcap
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.replay import (erspan_ii, eth_ipv4_tcp,
+                                     eth_ipv6_tcp, ip4)
+    from deepflow_tpu.store.dict_store import fold_ipv6
+
+    C16 = bytes([0xFD] + [0] * 14 + [1])
+    S16 = bytes([0xFD] + [0] * 14 + [2])
+    inner = eth_ipv4_tcp(ip4(10, 1, 0, 1), ip4(10, 1, 0, 2), 45000, 443,
+                         0x02, seq=3)
+    T0 = 1_700_000_000_000_000_000
+    frames = [eth_ipv6_tcp(C16, S16, 52000, 80, 0x02, seq=1),
+              eth_ipv6_tcp(S16, C16, 80, 52000, 0x12, seq=1),
+              erspan_ii(ip4(9, 9, 9, 1), ip4(9, 9, 9, 2), inner)]
+    path = tmp_path / "mixed.pcap"
+    write_pcap(str(path), frames,
+               [T0, T0 + 1_000_000, T0 + 2_000_000])
+
+    agent = Agent(AgentConfig(ingester_addr="127.0.0.1:1"))
+    agent.set_vtap_id(8)
+    try:
+        src = PcapFrameSource(str(path))
+        src.feed_agent(agent, batch_size=16)
+        with agent._lock:
+            flows = agent.flow_map.tick_columns(T0 + int(1e9))
+        pairs = set(zip(flows["ip_src"].tolist(),
+                        flows["port_dst"].tolist()))
+        # v6 handshake oriented client->server on the folded keys
+        assert (int(np.uint32(fold_ipv6(C16))), 80) in pairs
+        # ERSPAN-decapped inner SYN
+        assert (ip4(10, 1, 0, 1), 443) in pairs
+    finally:
+        agent.close()
