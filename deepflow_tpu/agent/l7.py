@@ -196,7 +196,8 @@ def parse_payload(payload: bytes, proto: Optional[int] = None,
                   port_src: Optional[int] = None,
                   port_dst: Optional[int] = None,
                   ts_ns: int = 0,
-                  ip_src: int = 0, ip_dst: int = 0) -> Optional[L7Record]:
+                  ip_src: int = 0, ip_dst: int = 0,
+                  ip_version: int = 4) -> Optional[L7Record]:
     """Two-phase dispatch: first parser whose cheap check passes wins
     (reference: check_payload ordering in l7_protocol_log.rs). Transport
     context, when provided, gates ambiguous parsers: DNS only on UDP or
@@ -215,7 +216,7 @@ def parse_payload(payload: bytes, proto: Optional[int] = None,
                 continue
         if getattr(p, "wants_ctx", False):
             ctx = (proto, port_src or 0, port_dst or 0, ts_ns,
-                   ip_src, ip_dst)
+                   ip_src, ip_dst, ip_version)
             if p.check(payload, *ctx):
                 rec = p.parse(payload, *ctx)
                 if rec is not None:

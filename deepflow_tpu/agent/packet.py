@@ -121,7 +121,7 @@ def decode_packets(frames: List[bytes],
     # parse with the l4 header at the fixed 40-byte offset.
     proto = np.where(is6, mat[rows, l3_off + 6],
                      mat[rows, l3_off + 9]).astype(np.uint32)
-    _V6_EXT = (0, 43, 44, 50, 51, 60)
+    _V6_EXT = (0, 43, 44, 50, 51, 60, 135, 139, 140)  # incl. Mobility/HIP/Shim6
     ext6 = is6 & np.isin(proto, _V6_EXT)
     valid &= ~ext6
     # v6 addresses fold to u32 exactly like the enrich layer's FNV-1a

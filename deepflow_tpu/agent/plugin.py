@@ -101,9 +101,15 @@ class SoPlugin:
         return (self.l4_protocol,)
 
     def _ctx(self, payload: bytes, proto, port_src: int, port_dst: int,
-             ts_ns: int, ip_src: int, ip_dst: int) -> ParseCtx:
+             ts_ns: int, ip_src: int, ip_dst: int,
+             ip_version: int = 4) -> ParseCtx:
         ctx = ParseCtx()
-        ctx.ip_type = 4
+        # ip_type follows the packet's IP version. For v6 the capture
+        # layer only carries the FNV-folded u32 (packet.py _fold16_rows),
+        # so the fold lands in the first 4 bytes of the 16-byte field and
+        # the rest stays zero — plugins branching on ip_type==6 see the
+        # right type but a folded address (documented ABI limitation).
+        ctx.ip_type = 6 if ip_version == 6 else 4
         ctx.ip_src[:4] = int(ip_src).to_bytes(4, "big")
         ctx.ip_dst[:4] = int(ip_dst).to_bytes(4, "big")
         ctx.port_src = port_src
@@ -118,11 +124,11 @@ class SoPlugin:
 
     def check(self, payload: bytes, proto=None, port_src: int = 0,
               port_dst: int = 0, ts_ns: int = 0,
-              ip_src: int = 0, ip_dst: int = 0) -> bool:
+              ip_src: int = 0, ip_dst: int = 0, ip_version: int = 4) -> bool:
         t0 = time.perf_counter_ns()
         try:
             ctx = self._ctx(payload, proto, port_src, port_dst, ts_ns,
-                            ip_src, ip_dst)
+                            ip_src, ip_dst, ip_version)
             return bool(self._check(ctypes.byref(ctx)))
         finally:
             self.calls += 1
@@ -130,12 +136,13 @@ class SoPlugin:
 
     def parse(self, payload: bytes, proto=None, port_src: int = 0,
               port_dst: int = 0, ts_ns: int = 0,
-              ip_src: int = 0, ip_dst: int = 0) -> Optional[l7.L7Record]:
+              ip_src: int = 0, ip_dst: int = 0,
+              ip_version: int = 4) -> Optional[l7.L7Record]:
         out = L7RecordC()
         t0 = time.perf_counter_ns()
         rc = self._parse(ctypes.byref(self._ctx(payload, proto, port_src,
                                                 port_dst, ts_ns,
-                                                ip_src, ip_dst)),
+                                                ip_src, ip_dst, ip_version)),
                          ctypes.byref(out))
         self.exe_ns += time.perf_counter_ns() - t0
         self.calls += 1

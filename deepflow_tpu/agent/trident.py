@@ -286,7 +286,8 @@ class Agent:
                                 port_dst=int(pkt["port_dst"][i]),
                                 ts_ns=int(pkt["timestamp_ns"][i]),
                                 ip_src=int(pkt["ip_src"][i]),
-                                ip_dst=int(pkt["ip_dst"][i]))
+                                ip_dst=int(pkt["ip_dst"][i]),
+                                ip_version=int(pkt["ip_version"][i]))
             if rec is None:
                 continue
             # session key is direction-agnostic

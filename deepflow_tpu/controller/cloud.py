@@ -27,6 +27,7 @@ field-diffed reconciliation), exactly like hand-POSTed domain snapshots.
 from __future__ import annotations
 
 import json
+import contextlib
 import threading
 import time
 import urllib.request
@@ -342,7 +343,32 @@ class CloudManager:
         self.on_diff = on_diff
         self._tasks: Dict[str, CloudTask] = {}
         self._lock = threading.Lock()
+        # per-domain locks order same-domain add()/remove() without
+        # holding the manager lock across task.close() (a slow-to-stop
+        # poller's 2s join must not stall get()/tasks()/other domains).
+        # Entries are refcounted [lock, holders] and pruned at zero —
+        # domain names come from the unauthenticated ops API, so an
+        # unpruned dict would grow without bound.
+        self._domain_locks: Dict[str, list] = {}
         self._started = False
+
+    @contextlib.contextmanager
+    def _domain_lock(self, domain: str):
+        with self._lock:
+            ent = self._domain_locks.setdefault(
+                domain, [threading.Lock(), 0])
+            ent[1] += 1
+        try:
+            with ent[0]:
+                yield
+        finally:
+            with self._lock:
+                ent[1] -= 1
+                # prune only OUR entry at refcount zero: deleting while a
+                # waiter holds a reference would hand the next caller a
+                # fresh lock and break same-domain mutual exclusion
+                if ent[1] == 0 and self._domain_locks.get(domain) is ent:
+                    del self._domain_locks[domain]
 
     def add(self, domain: str, platform, interval_s: float = 60.0
             ) -> CloudTask:
@@ -350,23 +376,27 @@ class CloudManager:
         # constructor must not orphan a still-running poller
         task = CloudTask(platform, self.recorder, domain,
                          interval_s=interval_s, on_diff=self.on_diff)
-        with self._lock:
-            old = self._tasks.pop(domain, None)
-            self._tasks[domain] = task
-            started = self._started
-        if old is not None:
-            old.close()
+        with self._domain_lock(domain):
+            with self._lock:
+                old = self._tasks.pop(domain, None)
+                self._tasks[domain] = task
+                started = self._started
+            if old is not None:
+                old.close()
         if started:
             task.start()
         return task
 
     def remove(self, domain: str) -> bool:
-        # the whole pop+close+cascade runs under the manager lock so a
-        # concurrent add() of the same domain is ordered strictly after:
-        # otherwise the new task's first gather could land between the
-        # pop and the cascade and have its fresh resources wiped
-        with self._lock:
-            task = self._tasks.pop(domain, None)
+        # pop+close+cascade run under the DOMAIN lock so a concurrent
+        # add() of the same domain is ordered strictly after (otherwise
+        # the new task's first gather could land between the pop and the
+        # cascade and have its fresh resources wiped); the manager lock
+        # is held only for the pop, so other domains never block on a
+        # slow close()
+        with self._domain_lock(domain):
+            with self._lock:
+                task = self._tasks.pop(domain, None)
             if task is None:
                 return False
             task.close()

@@ -27,6 +27,7 @@ Ops-facing (driven by the CLI):
 from __future__ import annotations
 
 import json
+import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,8 +54,16 @@ class ControllerServer:
                  tagrecorder: Optional[TagRecorder] = None,
                  genesis_domain: str = "genesis",
                  genesis_peers=None,
+                 cloud_resource_dir: Optional[str] = None,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1") -> None:
         self.model = model
+        # filereader domains may only read documents under this directory
+        # (None = anywhere, for single-user dev). Without the fence, the
+        # unauthenticated ops API would be a file-probing primitive: any
+        # controller-readable path could be fed to the gather loop and
+        # its parse errors read back from /v1/cloud/tasks.
+        self.cloud_resource_dir = (os.path.realpath(cloud_resource_dir)
+                                   if cloud_resource_dir else None)
         from deepflow_tpu.controller.genesis_sync import GenesisSync
         from deepflow_tpu.controller.recorder import Recorder
         self.recorder = Recorder(model)
@@ -258,10 +267,26 @@ class ControllerServer:
         if kind == "filereader":
             if not body.get("path"):
                 raise ValueError("filereader platform requires path")
-            return FileReaderPlatform(body["path"], body["domain"])
+            # validate the RESOLVED path and construct the platform with
+            # it: passing the raw path would let a symlink inside the
+            # fence be re-pointed outside it after creation, and every
+            # later poll would follow it
+            real = os.path.realpath(body["path"])
+            if self.cloud_resource_dir is not None:
+                if not (real == self.cloud_resource_dir
+                        or real.startswith(self.cloud_resource_dir + os.sep)):
+                    raise ValueError(
+                        "filereader path outside cloud_resource_dir")
+            return FileReaderPlatform(real, body["domain"])
         if kind == "http":
             if not body.get("url"):
                 raise ValueError("http platform requires url")
+            # urllib's default opener happily serves file:// — without
+            # this check the 'http' platform would be a fence bypass
+            scheme = urllib.parse.urlparse(body["url"]).scheme
+            if scheme not in ("http", "https"):
+                raise ValueError(f"http platform requires an http(s) url, "
+                                 f"got scheme {scheme!r}")
             return HttpPlatform(body["url"], body["domain"],
                                 headers=body.get("headers"))
         if kind == "kubernetes_gather":

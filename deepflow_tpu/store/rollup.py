@@ -16,6 +16,7 @@ host lexsort sits in front of the reduction.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -234,9 +235,18 @@ def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
     keys_fit_u32 = all(np.asarray(cols[k]).dtype.kind in "uib"
                        and np.asarray(cols[k]).dtype.itemsize <= 4
                        for k in key_names)
+    # 'auto' never picks the device path on the tunneled axon backend
+    # unless explicitly opted in: group_reduce_device ends in a scalar
+    # D2H fetch (int(n_groups)), and on that backend ANY fetch degrades
+    # h2d ~20x for ~15s (verify skill, pathology section) — a hot-table
+    # query would silently throttle ingest sharing the process.
+    backend = jax.default_backend()
+    auto_device_ok = backend != "cpu" and (
+        backend != "axon"
+        or os.environ.get("DEEPFLOW_DEVICE_GROUPBY", "") == "1")
     if method == "device" or (
             method == "auto" and not return_inverse and n >= (1 << 18)
-            and keys_fit_u32 and jax.default_backend() != "cpu"):
+            and keys_fit_u32 and auto_device_ok):
         return group_reduce_device(cols, key_names, aggs)
     if n == 0:
         empty = {nm: cols[nm][:0] for nm in list(key_names) + list(aggs)}

@@ -7,6 +7,7 @@ cloud/kubernetes_gather/ (genesis-derived k8s view).
 
 import json
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -292,3 +293,42 @@ def test_k8s_gather_prefers_physical_primary_iface():
     assert task.gather_once()
     node = model.list(type="pod_node", domain="kd")[0]
     assert node.attr("ip") == "10.1.1.1"
+
+def test_filereader_path_fenced_to_resource_dir(tmp_path):
+    """With cloud_resource_dir set, filereader domains outside the fence
+    are rejected at creation (the ops API must not become a file-probing
+    primitive); paths inside the fence work end-to-end."""
+    fence = tmp_path / "resources"
+    fence.mkdir()
+    inside = fence / "cloud.json"
+    inside.write_text(json.dumps(DOC))
+    outside = tmp_path / "secrets.json"
+    outside.write_text("{}")
+    srv = ControllerServer(ResourceModel(), VTapRegistry(), port=0,
+                           cloud_resource_dir=str(fence))
+    srv.start()
+    try:
+        p = srv.port
+        try:
+            _req(p, "/v1/cloud/domains",
+                 {"domain": "bad", "platform": "filereader",
+                  "path": str(outside), "interval_s": 3600})
+            assert False, "path outside the fence accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # traversal through the fence dir must not escape it either
+        try:
+            _req(p, "/v1/cloud/domains",
+                 {"domain": "bad2", "platform": "filereader",
+                  "path": str(fence / ".." / "secrets.json"),
+                  "interval_s": 3600})
+            assert False, "dot-dot traversal accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        r = _req(p, "/v1/cloud/domains",
+                 {"domain": "ok", "platform": "filereader",
+                  "path": str(inside), "interval_s": 3600})
+        assert not r["auth_failed"]
+        assert _req(p, "/v1/domains/ok/refresh", {})["ok"]
+    finally:
+        srv.close()
