@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from deepflow_tpu.agent.packet import ACK, FIN, PROTO_TCP, RST, SYN
+from deepflow_tpu.agent.tcp_perf import TcpPerf
 from deepflow_tpu.store.rollup import group_reduce
 
 # close types (reference: agent/src/common/enums.rs CloseType)
@@ -101,6 +102,7 @@ class FlowMap:
         self.invalid_packets = 0
         self.flows_created = 0
         self._alloc_cols(max(capacity, 16))
+        self.perf = TcpPerf(self._cap)
 
     def _alloc_cols(self, cap: int) -> None:
         self._cap = cap
@@ -130,6 +132,7 @@ class FlowMap:
         self._alloc_cols(self._cap * 2)
         for k, v in old.items():
             getattr(self, k)[:n] = v
+        self.perf.grow(self._cap)
 
     def _allocate(self, key: Tuple[int, int, int, int, int]) -> int:
         if self._free:
@@ -158,6 +161,7 @@ class FlowMap:
         self.c_initiator[s] = -1
         self.c_reported[s] = False
         self.c_live[s] = True
+        self.perf.reset_slot(s)
         self.flows_created += 1
         return s
 
@@ -284,6 +288,22 @@ class FlowMap:
                                     kind="stable")]
             self.c_initiator[slots[order]] = d[order].astype(np.int8)
 
+        # TCP perf engine: per-PACKET pass (SRT/ART/CIT need packet
+        # ordering the per-(flow,dir) reduction above deliberately
+        # discards). Runs after the handshake-stamp merge so in-batch
+        # SYN/SYN_ACK timestamps are already resolved in c_syn/c_synack.
+        tcp = np.nonzero(cols["proto"] == PROTO_TCP)[0]
+        if len(tcp):
+            pkt_slots = slots[inv][tcp]
+            zeros = np.zeros(n, np.int64)
+            self.perf.inject(
+                pkt_slots, direction[tcp], ts[tcp], flags[tcp],
+                cols["tcp_seq"][tcp].astype(np.int64),
+                cols.get("tcp_ack", zeros)[tcp].astype(np.int64),
+                cols["payload_len"][tcp].astype(np.int64),
+                cols.get("tcp_win", zeros)[tcp].astype(np.int64),
+                self.c_syn[pkt_slots], self.c_synack[pkt_slots])
+
     # -- tick output -------------------------------------------------------
     def tick_columns(self, now_ns: Optional[int] = None,
                      emit_active: bool = True) -> Dict[str, np.ndarray]:
@@ -324,8 +344,8 @@ class FlowMap:
             "packet_tx": self.c_pkts[idx][r, cli].astype(np.uint64),
             "packet_rx": self.c_pkts[idx][r, srv].astype(np.uint64),
             "retrans": self.c_retrans[idx].sum(axis=1).astype(np.uint32),
-            "rtt": np.where((syn > 0) & (synack > syn),
-                            (synack - syn) // 1000, 0).astype(np.uint32),
+            "retrans_tx": self.c_retrans[idx][r, cli].astype(np.uint32),
+            "retrans_rx": self.c_retrans[idx][r, srv].astype(np.uint32),
             "close_type": ct[idx],
             "flow_id": self.c_flow_id[idx],
             "start_time": self.c_start[idx].astype(np.uint64),
@@ -335,6 +355,14 @@ class FlowMap:
             "l3_epc_id": np.zeros(len(idx), np.int32),
             "is_new_flow": (~self.c_reported[idx]).astype(np.uint32),
         }
+        # perf-engine window columns (rtt/srt/art/cit/zero-win/...);
+        # the full-handshake rtt falls back to the SYN->SYN_ACK estimate
+        # when the engine saw no handshake ACK (e.g. ack-less captures)
+        perf = self.perf.report(idx, cli)
+        est = np.where((syn > 0) & (synack > syn),
+                       (synack - syn) // 1000, 0).astype(np.uint32)
+        perf["rtt"] = np.where(perf["rtt"] > 0, perf["rtt"], est)
+        out.update(perf)
         # reset interval counters on kept-active flows; free closed slots
         act_idx = np.nonzero(active)[0] if emit_active else \
             np.empty(0, np.int64)
@@ -342,6 +370,7 @@ class FlowMap:
         self.c_pkts[act_idx] = 0
         self.c_retrans[act_idx] = 0
         self.c_reported[act_idx] = True
+        self.perf.window_reset(act_idx)
         for s in np.nonzero(closed)[0]:
             self.c_live[s] = False
             del self._slot[tuple(self.c_key[s].tolist())]

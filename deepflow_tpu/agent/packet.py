@@ -157,6 +157,12 @@ def decode_packets(frames: List[bytes],
     tcp_seq = np.where(is_tcp,
                        _be32(mat, np.minimum(l4_off + 4, HDR_BYTES - 4)),
                        0).astype(np.uint32)
+    tcp_ack = np.where(is_tcp,
+                       _be32(mat, np.minimum(l4_off + 8, HDR_BYTES - 4)),
+                       0).astype(np.uint32)
+    tcp_win = np.where(is_tcp,
+                       _be16(mat, np.minimum(l4_off + 14, HDR_BYTES - 2)),
+                       0).astype(np.uint32)
     payload_off = np.where(is_tcp, l4_off + doff,
                            np.where(proto == PROTO_UDP, l4_off + 8, l4_off))
     payload_len = np.maximum(lens - payload_off, 0)
@@ -168,6 +174,8 @@ def decode_packets(frames: List[bytes],
         "proto": np.where(valid, proto, 0).astype(np.uint32),
         "tcp_flags": tcp_flags,
         "tcp_seq": tcp_seq,
+        "tcp_ack": tcp_ack,
+        "tcp_win": tcp_win,
         "pkt_len": lens.astype(np.uint32),
         "payload_off": payload_off.astype(np.int32),
         "payload_len": payload_len.astype(np.int32),
@@ -201,6 +209,7 @@ def decode_packets(frames: List[bytes],
             # the same layer
             for name in ("valid", "ip_src", "ip_dst", "port_src",
                          "port_dst", "proto", "tcp_flags", "tcp_seq",
+                         "tcp_ack", "tcp_win",
                          "mac_src", "mac_dst", "ip_version"):
                 cols[name][idxs] = inner[name]
             # payload offsets are relative to the inner frame start
@@ -262,8 +271,8 @@ def decode_packets(frames: List[bytes],
                     sub = idxs[ok]
                     for name in ("valid", "ip_src", "ip_dst", "port_src",
                                  "port_dst", "proto", "tcp_flags",
-                                 "tcp_seq", "mac_src", "mac_dst",
-                                 "ip_version"):
+                                 "tcp_seq", "tcp_ack", "tcp_win",
+                                 "mac_src", "mac_dst", "ip_version"):
                         cols[name][sub] = inner[name][ok]
                     offs = np.asarray([o for _, o in kept],
                                       np.int32)[ok]

@@ -44,11 +44,32 @@ def flows_to_documents(cols: Dict[str, np.ndarray],
         "rtt_sum": cols["rtt"].astype(np.int64),
         "rtt_count": (cols["rtt"] > 0).astype(np.int64),
     }
+    # TCP perf engine columns (tcp_perf.py) fold straight into the
+    # Document meter: per-flow window sums are sum-mergeable, maxes are
+    # max-mergeable (zerodoc FlowMeter merge discipline)
+    sums = ["packet_tx", "packet_rx", "byte_tx", "byte_rx", "new_flow",
+            "closed_flow", "retrans", "rtt_sum", "rtt_count"]
+    maxes: list = []
+    for name in ("srt_sum", "srt_count", "art_sum", "art_count",
+                 "cit_sum", "cit_count", "rtt_client_sum",
+                 "rtt_client_count", "rtt_server_sum", "rtt_server_count",
+                 "zero_win_tx", "zero_win_rx", "retrans_tx", "retrans_rx",
+                 "retrans_syn", "retrans_synack", "syn", "synack"):
+        src = {"syn": "syn_count", "synack": "synack_count"}.get(name, name)
+        if src in cols:
+            work[name] = cols[src].astype(np.int64)
+            sums.append(name)
+    for name in ("srt_max", "art_max", "cit_max", "rtt_client_max",
+                 "rtt_server_max"):
+        src = {"rtt_client_max": "rtt_client",
+               "rtt_server_max": "rtt_server"}.get(name, name)
+        if src in cols:
+            work[name] = cols[src].astype(np.int64)
+            maxes.append(name)
+    aggs = {k: "sum" for k in sums}
+    aggs.update({k: "max" for k in maxes})
     red = group_reduce(
-        work, ["ip", "server_port", "protocol", "vtap_id"],
-        {k: "sum" for k in ("packet_tx", "packet_rx", "byte_tx", "byte_rx",
-                            "new_flow", "closed_flow", "retrans",
-                            "rtt_sum", "rtt_count")})
+        work, ["ip", "server_port", "protocol", "vtap_id"], aggs)
     red["timestamp"] = np.full(len(red["ip"]), second, np.int64)
     return red
 
@@ -76,9 +97,24 @@ def documents_to_records(doc_cols: Dict[str, np.ndarray]) -> List[bytes]:
         t.new_flow = int(doc_cols["new_flow"][i])
         t.closed_flow = int(doc_cols["closed_flow"][i])
         p = d.meter.flow.performance
-        p.retrans_tx = int(doc_cols["retrans"][i])
+        if "retrans_tx" in doc_cols:
+            p.retrans_tx = int(doc_cols["retrans_tx"][i])
+            p.retrans_rx = int(doc_cols["retrans_rx"][i])
+        else:
+            p.retrans_tx = int(doc_cols["retrans"][i])
+        for name in ("zero_win_tx", "zero_win_rx", "retrans_syn",
+                     "retrans_synack"):
+            if name in doc_cols:
+                setattr(p, name, int(doc_cols[name][i]))
         lat = d.meter.flow.latency
         lat.rtt_sum = int(doc_cols["rtt_sum"][i])
         lat.rtt_count = int(doc_cols["rtt_count"][i])
+        for name in ("srt_sum", "srt_count", "srt_max", "art_sum",
+                     "art_count", "art_max", "cit_sum", "cit_count",
+                     "cit_max", "rtt_client_sum", "rtt_client_count",
+                     "rtt_client_max", "rtt_server_sum",
+                     "rtt_server_count", "rtt_server_max"):
+            if name in doc_cols:
+                setattr(lat, name, int(doc_cols[name][i]))
         out.append(d.SerializeToString())
     return out

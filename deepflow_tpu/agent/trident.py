@@ -112,11 +112,37 @@ def columns_to_l4_records(cols: Dict[str, np.ndarray]) -> List[bytes]:
         f.tap_side = int(cols["tap_side"][i])
         f.is_new_flow = int(cols["is_new_flow"][i])
         f.eth_type = 0x0800
-        if cols["rtt"][i] or cols["retrans"][i]:
+        has_perf = cols["rtt"][i] or cols["retrans"][i]
+        if not has_perf:
+            # any engine signal warrants the stats block: a mid-stream
+            # capture can have zero-window/CIT/continuous-RTT data with
+            # no handshake rtt and no retransmissions
+            for name in ("srt_count", "art_count", "cit_count",
+                         "zero_win_tx", "zero_win_rx", "syn_count",
+                         "synack_count", "rtt_client", "rtt_server"):
+                if name in cols and cols[name][i]:
+                    has_perf = True
+                    break
+        if has_perf:
             f.has_perf_stats = 1
             f.perf_stats.l4_protocol = 1
-            f.perf_stats.tcp.rtt = int(cols["rtt"][i])
-            f.perf_stats.tcp.total_retrans_count = int(cols["retrans"][i])
+            t = f.perf_stats.tcp
+            t.rtt = int(cols["rtt"][i])
+            t.total_retrans_count = int(cols["retrans"][i])
+            for name in ("srt_sum", "srt_count", "srt_max", "art_sum",
+                         "art_count", "art_max", "cit_sum", "cit_count",
+                         "cit_max", "syn_count", "synack_count"):
+                if name in cols:
+                    setattr(t, name, int(cols[name][i]))
+            if "rtt_client" in cols:
+                t.rtt_client_max = int(cols["rtt_client"][i])
+                t.rtt_server_max = int(cols["rtt_server"][i])
+                t.counts_peer_tx.retrans_count = int(cols["retrans_tx"][i])
+                t.counts_peer_rx.retrans_count = int(cols["retrans_rx"][i])
+                t.counts_peer_tx.zero_win_count = \
+                    int(cols["zero_win_tx"][i])
+                t.counts_peer_rx.zero_win_count = \
+                    int(cols["zero_win_rx"][i])
         out.append(m.SerializeToString())
     return out
 

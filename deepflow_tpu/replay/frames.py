@@ -14,6 +14,7 @@ SYN = 0x02
 ACK = 0x10
 FIN = 0x01
 RST = 0x04
+PSH = 0x08
 
 
 def ip4(a: int, b: int, c: int, d: int) -> int:
@@ -34,10 +35,11 @@ def _eth_ipv4(src: int, dst: int, proto: int, l4: bytes,
 
 def eth_ipv4_tcp(src: int, dst: int, sport: int, dport: int,
                  flags: int = ACK, payload: bytes = b"", seq: int = 0,
+                 ack: int = 0, win: int = 8192,
                  vlan: bool = False) -> bytes:
     """One eth(+optional 802.1Q)/ipv4/tcp frame."""
-    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, 0, 0x50, flags,
-                      8192, 0, 0) + payload
+    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, ack, 0x50, flags,
+                      win, 0, 0) + payload
     return _eth_ipv4(src, dst, 6, tcp, vlan=vlan)
 
 

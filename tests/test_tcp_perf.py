@@ -1,0 +1,247 @@
+"""TCP perf engine goldens: RTT / SRT / ART / CIT from crafted captures.
+
+Reference semantics: agent/src/flow_generator/perf/tcp.rs (rtt split at
+:741-762, srt :826-837, art :839-850, cit :892-912). The scenarios are
+fixture-style conversations (reference test style:
+agent/resources/test/flow_generator/) driven through the real decode
+path so the tcp_ack/tcp_win columns come off the wire bytes.
+"""
+
+import numpy as np
+
+from deepflow_tpu.agent.flow_map import FlowMap
+from deepflow_tpu.agent.packet import decode_packets
+from deepflow_tpu.replay.frames import ACK, PSH, SYN, eth_ipv4_tcp, ip4
+
+CLI = ip4(10, 0, 0, 1)
+SRV = ip4(10, 0, 0, 2)
+
+MS = 1_000_000  # ns
+T0 = 1_700_000_000 * 1_000_000_000  # epoch base: 0 means "unset" stamps
+
+
+def _conversation():
+    """Canonical handshake + request/ack/response + second request.
+
+    t(ms) dir  pkt
+      0   c->s SYN        seq=100
+     10   s->c SYN/ACK    seq=500 ack=101
+     20   c->s ACK        seq=101 ack=501          rtt_cli=10ms rtt=20ms
+     30   c->s PSH 50B    seq=101 ack=501          cit=10ms (post-hs)
+     40   s->c ACK        seq=501 ack=151          srt(s)=10ms
+     55   s->c PSH 200B   seq=501 ack=151          art(s)=25ms
+     70   c->s ACK        seq=151 ack=701          srt(c)=15ms
+    100   c->s PSH 60B    seq=151 ack=701          cit=45ms, art(c)=45ms
+    """
+    frames = [
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, SYN, seq=100),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, SYN | ACK, seq=500, ack=101),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, ACK, seq=101, ack=501),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, PSH | ACK, b"q" * 50,
+                     seq=101, ack=501),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, ACK, seq=501, ack=151),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, PSH | ACK, b"r" * 200,
+                     seq=501, ack=151),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, ACK, seq=151, ack=701),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, PSH | ACK, b"q" * 60,
+                     seq=151, ack=701),
+    ]
+    ts = T0 + np.array([0, 10, 20, 30, 40, 55, 70, 100],
+                       np.uint64) * MS
+    return frames, ts
+
+
+def _run(frames, ts, splits=(len,)):
+    fm = FlowMap()
+    pkt = decode_packets(frames, ts)
+    fm.inject(pkt)
+    return fm.tick_columns(now_ns=int(ts[-1]) + MS)
+
+
+def test_decoder_carries_ack_and_win():
+    pkt = decode_packets([eth_ipv4_tcp(CLI, SRV, 1, 2, ACK, seq=7,
+                                       ack=99, win=0)])
+    assert pkt["tcp_seq"][0] == 7
+    assert pkt["tcp_ack"][0] == 99
+    assert pkt["tcp_win"][0] == 0
+
+
+def test_handshake_rtt_split():
+    frames, ts = _conversation()
+    out = _run(frames, ts)
+    assert len(out["rtt"]) == 1
+    assert out["rtt_server"][0] == 10_000        # SYN -> SYN/ACK, us
+    assert out["rtt_client"][0] == 10_000        # SYN/ACK -> ACK
+    assert out["rtt"][0] == 20_000               # full handshake
+    assert out["syn_count"][0] == 1
+    assert out["synack_count"][0] == 1
+    assert out["retrans_syn"][0] == 0
+
+
+def test_srt_prefers_server_side():
+    frames, ts = _conversation()
+    out = _run(frames, ts)
+    # server's ACK of the request: 40 - 30 = 10ms. The client-side
+    # sample (70 - 55) lands in the non-preferred direction.
+    assert out["srt_count"][0] == 1
+    assert out["srt_sum"][0] == 10_000
+    assert out["srt_max"][0] == 10_000
+
+
+def test_art_first_response_segment():
+    frames, ts = _conversation()
+    out = _run(frames, ts)
+    # response data at 55 vs last client packet at 30 = 25ms
+    assert out["art_count"][0] == 1
+    assert out["art_sum"][0] == 25_000
+    assert out["art_max"][0] == 25_000
+
+
+def test_cit_post_handshake_and_idle():
+    frames, ts = _conversation()
+    out = _run(frames, ts)
+    # 30 - max(20, 10) = 10ms, then 100 - 55 = 45ms
+    assert out["cit_count"][0] == 2
+    assert out["cit_sum"][0] == 55_000
+    assert out["cit_max"][0] == 45_000
+
+
+def test_batch_split_invariance():
+    """Feeding the conversation packet-by-packet must equal one batch:
+    the chain carry makes batch boundaries invisible."""
+    frames, ts = _conversation()
+    whole = _run(frames, ts)
+    fm = FlowMap()
+    for i in range(len(frames)):
+        fm.inject(decode_packets([frames[i]], ts[i:i + 1]))
+    split = fm.tick_columns(now_ns=int(ts[-1]) + MS)
+    for k in ("rtt", "rtt_client", "rtt_server", "srt_sum", "srt_count",
+              "srt_max", "art_sum", "art_count", "art_max", "cit_sum",
+              "cit_count", "zero_win_tx", "zero_win_rx", "syn_count",
+              "synack_count"):
+        assert split[k][0] == whole[k][0], k
+
+
+def test_zero_window_counted_per_side():
+    frames = [
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, SYN, seq=1),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, SYN | ACK, seq=9, ack=2),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, ACK, seq=2, ack=10),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, ACK, seq=10, ack=2, win=0),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, ACK, seq=10, ack=2, win=0),
+    ]
+    ts = T0 + np.arange(5, dtype=np.uint64) * 10 * MS
+    out = _run(frames, ts)
+    assert out["zero_win_rx"][0] == 2       # server side (rx of client)
+    assert out["zero_win_tx"][0] == 0
+
+
+def test_syn_retransmission_counted():
+    frames = [
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, SYN, seq=1),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, SYN, seq=1),
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, SYN, seq=1),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, SYN | ACK, seq=9, ack=2),
+    ]
+    ts = T0 + np.arange(4, dtype=np.uint64) * 1000 * MS
+    out = _run(frames, ts)
+    assert out["syn_count"][0] == 3
+    assert out["retrans_syn"][0] == 2
+    assert out["retrans_synack"][0] == 0
+    # rtt_server measured from the FIRST syn (tcp.rs keeps the first
+    # handshake timestamp through retransmissions)
+    assert out["rtt_server"][0] == 3_000_000
+
+
+def test_srt_requires_reply_ack_number():
+    """An ACK that does not acknowledge the data (wrong ack number)
+    must not produce an SRT sample (tcp.rs is_reply_packet)."""
+    frames = [
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, PSH | ACK, b"q" * 50,
+                     seq=100, ack=1),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, ACK, seq=1, ack=999),
+    ]
+    ts = T0 + np.arange(2, dtype=np.uint64) * 10 * MS
+    out = _run(frames, ts)
+    assert out["srt_count"][0] == 0
+
+
+def test_caps_drop_oversized_samples():
+    """SRT samples above 10s are dropped (tcp.rs SRT_MAX)."""
+    frames = [
+        eth_ipv4_tcp(CLI, SRV, 1234, 80, PSH | ACK, b"q" * 50,
+                     seq=100, ack=1),
+        eth_ipv4_tcp(SRV, CLI, 80, 1234, ACK, seq=1, ack=150),
+    ]
+    ts = T0 + np.array([0, 11_000], np.uint64) * MS   # 11s later
+    out = _run(frames, ts)
+    assert out["srt_count"][0] == 0
+
+
+def test_window_reset_keeps_chain_state():
+    """A tick between request and response must not lose the ART arming:
+    window accumulators reset, chain carry persists."""
+    frames, ts = _conversation()
+    fm = FlowMap()
+    fm.inject(decode_packets(frames[:5], ts[:5]))
+    first = fm.tick_columns(now_ns=int(ts[4]) + MS)
+    assert first["srt_count"][0] == 1            # request ack sampled
+    fm.inject(decode_packets(frames[5:], ts[5:]))
+    second = fm.tick_columns(now_ns=int(ts[-1]) + MS)
+    # the first window's server-side sample is gone (window reset); the
+    # second window only has the client-side ACK-of-response sample,
+    # which the reporting falls back to (tcp.rs: srt_0 when srt_1 has
+    # no samples)
+    assert second["srt_count"][0] == 1
+    assert second["srt_sum"][0] == 15_000
+    assert second["art_count"][0] == 1           # armed across the tick
+    assert second["art_sum"][0] == 25_000
+
+
+def test_perf_survives_the_wire_roundtrip():
+    """Agent tick -> TaggedFlow wire records -> ingester decode: the
+    perf columns the in-repo agent now computes must land in the same
+    l4 columns an external agent's stats do (closing round 2's 'agent
+    emits zeroed perf columns' gap)."""
+    from deepflow_tpu.agent.trident import columns_to_l4_records
+    from deepflow_tpu.decode.columnar import decode_l4_records
+
+    frames, ts = _conversation()
+    fm = FlowMap(vtap_id=7)
+    fm.inject(decode_packets(frames, ts))
+    cols = fm.tick_columns(now_ns=int(ts[-1]) + MS)
+    l4 = decode_l4_records(columns_to_l4_records(cols))
+    assert l4["rtt"][0] == 20_000
+    assert l4["rtt_client"][0] == 10_000
+    assert l4["rtt_server"][0] == 10_000
+    assert l4["srt_sum"][0] == 10_000 and l4["srt_count"][0] == 1
+    assert l4["art_sum"][0] == 25_000 and l4["art_count"][0] == 1
+    assert l4["cit_count"][0] == 2
+    assert l4["syn_count"][0] == 1 and l4["synack_count"][0] == 1
+
+
+def test_multi_flow_interleaved_batch():
+    """Two flows' handshakes interleaved in ONE batch: the segmented
+    first-SYN/SYN_ACK scans must resolve each flow's own handshake (a
+    global scan would hand flow B flow A's positions and zero its rtt)."""
+    CLI2 = ip4(10, 0, 0, 9)
+    frames, stamps = [], []
+
+    def add(t_ms, f):
+        frames.append(f)
+        stamps.append(T0 + t_ms * MS)
+
+    add(0, eth_ipv4_tcp(CLI, SRV, 1111, 80, SYN, seq=100))
+    add(2, eth_ipv4_tcp(CLI2, SRV, 2222, 80, SYN, seq=900))
+    add(10, eth_ipv4_tcp(SRV, CLI, 80, 1111, SYN | ACK, seq=500, ack=101))
+    add(32, eth_ipv4_tcp(SRV, CLI2, 80, 2222, SYN | ACK, seq=700,
+                         ack=901))
+    add(20, eth_ipv4_tcp(CLI, SRV, 1111, 80, ACK, seq=101, ack=501))
+    add(47, eth_ipv4_tcp(CLI2, SRV, 2222, 80, ACK, seq=901, ack=701))
+    out = _run(frames, np.asarray(stamps, np.uint64))
+    by_port = {int(p): i for i, p in enumerate(out["port_src"])}
+    a, b = by_port[1111], by_port[2222]
+    assert out["rtt_server"][a] == 10_000 and out["rtt_client"][a] == 10_000
+    assert out["rtt"][a] == 20_000
+    assert out["rtt_server"][b] == 30_000 and out["rtt_client"][b] == 15_000
+    assert out["rtt"][b] == 45_000
