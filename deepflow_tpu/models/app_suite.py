@@ -58,6 +58,12 @@ class AppWindowOutput(NamedTuple):
     #                               aggregate across windows)
     error_ratio: jnp.ndarray      # [groups] f32 in [0, 1]
     rrt_quantiles: jnp.ndarray    # [len(quantiles), groups] f32 (us)
+    # the window's raw sketch (device references, zero copy until
+    # fetched): consumers that surface the sketch as Prometheus `le`
+    # buckets (runtime/app_red.py prom_bucket_stride) read these; others
+    # never materialize them
+    rrt_hist: jnp.ndarray         # [groups, buckets] f32
+    rrt_zeros: jnp.ndarray        # [groups] f32 (values < min_value)
 
 
 def init(cfg: AppSuiteConfig) -> AppSuiteState:
@@ -116,5 +122,7 @@ def flush(state: AppSuiteState, cfg: AppSuiteConfig
         errors=state.errors,
         error_ratio=state.errors / safe,
         rrt_quantiles=qs,
+        rrt_hist=state.rrt.hist,
+        rrt_zeros=state.rrt.zeros,
     )
     return init(cfg), out

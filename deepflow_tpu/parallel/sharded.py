@@ -198,7 +198,8 @@ class ShardedAppSuite(_ShardedSuiteBase):
         out_specs = (state_specs,
                      app_suite.AppWindowOutput(
                          requests=P(), errors=P(), error_ratio=P(),
-                         rrt_quantiles=P()))
+                         rrt_quantiles=P(), rrt_hist=P(),
+                         rrt_zeros=P()))
         self._flush = self._shard(local_flush, (state_specs,), out_specs)
 
 

@@ -48,6 +48,9 @@ class IngesterConfig:
     # per-service RED windows from the l7 stream (runtime/app_red.py);
     # None disables, a float sets window seconds
     app_red_window_s: Optional[float] = None
+    # > 0: surface app_red's DDSketch windows as Prometheus `le` bucket
+    # counters (every Nth gamma boundary) so histogram_quantile works
+    app_red_prom_buckets: int = 0
 
 
 class Ingester:
@@ -82,7 +85,8 @@ class Ingester:
             from deepflow_tpu.runtime.app_red import AppRedExporter
             self.app_red = AppRedExporter(
                 store=self.store, window_seconds=cfg.app_red_window_s,
-                stats=self.stats)
+                stats=self.stats, tag_dicts=self.tag_dicts,
+                prom_bucket_stride=cfg.app_red_prom_buckets)
             self.exporters.register(self.app_red)
         self.receiver = Receiver(port=cfg.listen_port, host=cfg.listen_host,
                                  stats=self.stats)

@@ -154,11 +154,22 @@ class QueryEngine:
             stmt = dataclasses.replace(stmt, items=items)
 
         # columns referenced anywhere
-        needed = set(stmt.group_by)
+        bucket = next((g for g in stmt.group_by
+                       if isinstance(g, Q.TimeBucket)), None)
+        for it in stmt.items:
+            # walk the whole tree: time(30)+0 must not dodge the check
+            for tb in _time_buckets(it.expr):
+                if tb != bucket:
+                    raise ValueError(
+                        "time()/interval() in the select list requires "
+                        "the SAME bucket in GROUP BY")
+        needed = {g for g in stmt.group_by if isinstance(g, str)}
         for it in stmt.items:
             needed |= Q.expr_columns(it.expr)
         for c in stmt.where:
             needed.add(c.column)
+        if bucket is not None:
+            needed.add(schema.time_column)
         if not needed:
             needed = {schema.time_column}  # Count(*) still needs row counts
         for nm in needed:
@@ -170,6 +181,13 @@ class QueryEngine:
         mask = self._filter_mask(cols, residual)
         if mask is not None:
             cols = {k: v[mask] for k, v in cols.items()}
+        if bucket is not None:
+            # interval lowering: floor the time column once, then group
+            # on the bucket like any other key (the reduction itself is
+            # the same device segment-reduce — reference TransGroupBy
+            # lowers to toStartOfInterval the same way)
+            t = cols[schema.time_column].astype(np.int64)
+            cols["__time_bucket"] = (t // bucket.seconds) * bucket.seconds
 
         if stmt.group_by:
             out_cols, out_rows = self._grouped(stmt, cols)
@@ -318,12 +336,14 @@ class QueryEngine:
         # a plain column in the select list must be grouped (SELECT *
         # with GROUP BY reaches here for every schema column) — catch it
         # here with a real message, not a KeyError from _eval_reduced
-        grouped = set(stmt.group_by)
+        grouped = {g for g in stmt.group_by if isinstance(g, str)}
         for it in stmt.items:
             if isinstance(it.expr, Q.Column) and it.expr.name not in grouped:
                 raise ValueError(
                     f"column {it.expr.name!r} must appear in GROUP BY "
                     "or inside an aggregate function")
+        group_names = ["__time_bucket" if isinstance(g, Q.TimeBucket)
+                       else g for g in stmt.group_by]
         aggs: Dict[str, str] = {}     # internal value name -> reduce kind
         value_src: Dict[str, np.ndarray] = {}
         n = len(next(iter(cols.values()))) if cols else 0
@@ -350,10 +370,10 @@ class QueryEngine:
 
         # map every aggregate in every select item to a reduced column
         plans = [_plan_aggs(it.expr, register) for it in stmt.items]
-        work = {k: cols[k] for k in stmt.group_by}
+        work = {k: cols[k] for k in group_names}
         work.update(value_src)
-        reduced = group_reduce(work, list(stmt.group_by), aggs) if n else \
-            {k: np.empty(0, np.int64) for k in list(stmt.group_by) + list(aggs)}
+        reduced = group_reduce(work, group_names, aggs) if n else \
+            {k: np.empty(0, np.int64) for k in group_names + list(aggs)}
 
         out_cols, series = [], []
         for it, plan in zip(stmt.items, plans):
@@ -421,6 +441,16 @@ class QueryEngine:
 
 
 # -- expression helpers ----------------------------------------------------
+def _time_buckets(e: Q.Expr) -> List[Q.TimeBucket]:
+    if isinstance(e, Q.TimeBucket):
+        return [e]
+    if isinstance(e, Q.BinOp):
+        return _time_buckets(e.left) + _time_buckets(e.right)
+    if isinstance(e, Q.Agg) and e.arg is not None:
+        return _time_buckets(e.arg)
+    return []
+
+
 def _has_agg(e: Q.Expr) -> bool:
     if isinstance(e, Q.Agg):
         return True
@@ -436,6 +466,8 @@ def _expr_name(e: Q.Expr) -> str:
         return str(e.value)
     if isinstance(e, Q.Agg):
         return f"{e.func}({_expr_name(e.arg) if e.arg else '*'})"
+    if isinstance(e, Q.TimeBucket):
+        return "time"            # Grafana timeseries column convention
     return f"{_expr_name(e.left)}{e.op}{_expr_name(e.right)}"
 
 
@@ -471,6 +503,8 @@ def _plan_aggs(e: Q.Expr, register) -> Q.Expr:
     """Rewrite Agg nodes into Column refs over reduced names."""
     if isinstance(e, Q.Agg):
         return Q.Column(register(e) + ("|avg" if e.func == "avg" else ""))
+    if isinstance(e, Q.TimeBucket):
+        return Q.Column("__time_bucket")
     if isinstance(e, Q.BinOp):
         return Q.BinOp(e.op, _plan_aggs(e.left, register),
                        _plan_aggs(e.right, register))

@@ -1,69 +1,255 @@
-"""PromQL subset over the ext_metrics sample tables.
+"""PromQL engine over the ext_metrics sample tables.
 
-Reference: server/querier/app/prometheus/ — a PromQL-to-querier-SQL
-adapter serving Grafana and remote_read. The subset here covers the
-selector algebra that adapter sees most: instant/range vector selectors
-with label matchers, `rate(m[d])`, and `sum/avg/max/min by (...)` over
-them. Series come back keyed by their label-set string (the reverse of
-the SmartEncoded labels hash).
+Reference: server/querier/app/prometheus/ — a PromQL adapter serving
+Grafana and remote_read (service/promql.go embeds the upstream engine;
+functions.go maps its function library onto querier SQL). This engine
+parses a real expression grammar and evaluates it on a time grid:
+
+- instant & range vector selectors with label matchers and `offset`
+- rate() / irate() / increase() with upstream counter-reset correction
+  and window-edge extrapolation (promql/functions.go extrapolatedRate)
+- histogram_quantile() over `le`-bucketed series — which is how DDSketch
+  windows surface (runtime/app_red.py emits cumulative gamma-bucket
+  samples; the sketch IS a histogram, so the upstream bucket
+  interpolation applies unchanged)
+- sum/avg/max/min/count by (...) aggregation
+- vector○scalar and vector○vector arithmetic (+ - * /) with one-to-one
+  label matching
+
+Evaluation is columnar: every expression evaluates to a list of
+(labels, values-aligned-to-grid) pairs in one vectorized pass — an
+instant query is just a one-point grid. Series come back keyed by their
+label-set string (the reverse of the SmartEncoded labels hash).
 """
 
 from __future__ import annotations
 
+import math
 import re
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.dict_store import TagDictRegistry
 
-_SELECTOR = re.compile(
-    r"""^\s*(?:(?P<agg>sum|avg|max|min)(?:\s+by\s*\((?P<by>[^)]*)\))?\s*\()?
-        \s*(?:(?P<rate>rate)\s*\()?
-        \s*(?P<metric>[A-Za-z_:][A-Za-z0-9_:.]*)
-        (?:\{(?P<matchers>[^}]*)\})?
-        (?:\[(?P<range>\d+)(?P<range_unit>[smh])\])?
-        \s*\)?\s*\)?\s*$""", re.VERBOSE)
+DEFAULT_LOOKBACK_S = 300
+_UNIT_S = {"s": 1, "m": 60, "h": 3600, "d": 86400}
 
-_UNIT_S = {"s": 1, "m": 60, "h": 3600}
+AGG_OPS = ("sum", "avg", "max", "min", "count")
+RANGE_FUNCS = ("rate", "irate", "increase", "delta")
 
 
-@dataclass
-class PromQuery:
+# -- AST -------------------------------------------------------------------
+@dataclass(frozen=True)
+class Selector:
     metric: str
-    matchers: List[Tuple[str, str, str]]  # (label, op, value); =|!=|=~|!~
+    matchers: Tuple[Tuple[str, str, str], ...]  # (label, op, value)
     range_s: Optional[int] = None
-    rate: bool = False
-    agg: Optional[str] = None
-    by: List[str] = field(default_factory=list)
+    offset_s: int = 0
 
 
-def parse_promql(q: str) -> PromQuery:
-    m = _SELECTOR.match(q)
+@dataclass(frozen=True)
+class Func:
+    name: str                  # rate|irate|increase|delta|histogram_quantile
+    args: Tuple["Expr", ...]
+
+
+@dataclass(frozen=True)
+class AggExpr:
+    op: str                    # sum|avg|max|min|count
+    by: Tuple[str, ...]
+    arg: "Expr"
+
+
+@dataclass(frozen=True)
+class Bin:
+    op: str                    # + - * /
+    left: "Expr"
+    right: "Expr"
+
+
+@dataclass(frozen=True)
+class Num:
+    value: float
+
+
+Expr = Union[Selector, Func, AggExpr, Bin, Num]
+
+
+def _selectors(e: Expr) -> List[Selector]:
+    if isinstance(e, Selector):
+        return [e]
+    if isinstance(e, Func):
+        return [s for a in e.args for s in _selectors(a)]
+    if isinstance(e, AggExpr):
+        return _selectors(e.arg)
+    if isinstance(e, Bin):
+        return _selectors(e.left) + _selectors(e.right)
+    return []
+
+
+# -- parser ----------------------------------------------------------------
+_TOKEN = re.compile(r"""
+    \s*(
+        "(?:[^"\\]|\\.)*"                 # string
+      | \d+(?:\.\d+)?[smhd]               # duration
+      | \d+\.\d+ | \.\d+ | \d+            # number
+      | [A-Za-z_:][A-Za-z0-9_:.]*         # ident
+      | =~ | !~ | != | [()\[\]{},=+*/-]
+    )""", re.VERBOSE)
+
+
+def _tokenize(s: str) -> List[str]:
+    out, pos = [], 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if not m:
+            if s[pos:].strip() == "":
+                break
+            raise ValueError(f"bad PromQL token at: {s[pos:pos + 20]!r}")
+        out.append(m.group(1))
+        pos = m.end()
+    return out
+
+
+def _duration_s(tok: str) -> int:
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([smhd])", tok)
     if not m:
-        raise ValueError(f"unsupported PromQL: {q!r}")
-    matchers = []
-    if m.group("matchers"):
-        for part in m.group("matchers").split(","):
-            part = part.strip()
-            if not part:
-                continue
-            mm = re.match(
-                r'([A-Za-z_][A-Za-z0-9_]*)\s*(=~|!~|!=|=)\s*"([^"]*)"',
-                part)
-            if not mm:
-                raise ValueError(f"bad matcher {part!r}")
-            matchers.append((mm.group(1), mm.group(2), mm.group(3)))
-    rng = None
-    if m.group("range"):
-        rng = int(m.group("range")) * _UNIT_S[m.group("range_unit")]
-    return PromQuery(
-        metric=m.group("metric"), matchers=matchers, range_s=rng,
-        rate=bool(m.group("rate")), agg=m.group("agg"),
-        by=[b.strip() for b in (m.group("by") or "").split(",") if b.strip()])
+        raise ValueError(f"bad duration {tok!r}")
+    return int(float(m.group(1)) * _UNIT_S[m.group(2)])
+
+
+class _Parser:
+    def __init__(self, toks: List[str]) -> None:
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of PromQL")
+        self.i += 1
+        return t
+
+    def expect(self, tok: str) -> None:
+        t = self.next()
+        if t != tok:
+            raise ValueError(f"expected {tok!r}, got {t!r}")
+
+    def accept(self, tok: str) -> bool:
+        if self.peek() == tok:
+            self.i += 1
+            return True
+        return False
+
+    # precedence: (+,-) < (*,/) < atom
+    def expr(self) -> Expr:
+        left = self.term()
+        while self.peek() in ("+", "-"):
+            op = self.next()
+            left = Bin(op, left, self.term())
+        return left
+
+    def term(self) -> Expr:
+        left = self.atom()
+        while self.peek() in ("*", "/"):
+            op = self.next()
+            left = Bin(op, left, self.atom())
+        return left
+
+    def atom(self) -> Expr:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of PromQL")
+        if t == "(":
+            self.next()
+            e = self.expr()
+            self.expect(")")
+            return e
+        if re.fullmatch(r"\d+\.\d+|\.\d+|\d+", t):
+            self.next()
+            return Num(float(t))
+        ident = self.next()
+        low = ident.lower()
+        if low in AGG_OPS and self.peek() in ("(", "by"):
+            by: Tuple[str, ...] = ()
+            if self.accept("by"):
+                self.expect("(")
+                names = []
+                while not self.accept(")"):
+                    names.append(self.next())
+                    self.accept(",")
+                by = tuple(names)
+            self.expect("(")
+            arg = self.expr()
+            self.expect(")")
+            # trailing `by (...)` form: sum(x) by (a)
+            if not by and self.accept("by"):
+                self.expect("(")
+                names = []
+                while not self.accept(")"):
+                    names.append(self.next())
+                    self.accept(",")
+                by = tuple(names)
+            return AggExpr(low, by, arg)
+        if low in RANGE_FUNCS and self.peek() == "(":
+            self.next()
+            arg = self.expr()
+            self.expect(")")
+            if not isinstance(arg, Selector) or arg.range_s is None:
+                raise ValueError(f"{low}() needs a range vector "
+                                 f"(metric[5m])")
+            return Func(low, (arg,))
+        if low == "histogram_quantile" and self.peek() == "(":
+            self.next()
+            phi = self.expr()
+            self.expect(",")
+            arg = self.expr()
+            self.expect(")")
+            if not isinstance(phi, Num):
+                raise ValueError("histogram_quantile needs a scalar "
+                                 "quantile as its first argument")
+            return Func("histogram_quantile", (phi, arg))
+        # plain selector
+        return self.selector(ident)
+
+    def selector(self, metric: str) -> Selector:
+        matchers: List[Tuple[str, str, str]] = []
+        if self.accept("{"):
+            while not self.accept("}"):
+                name = self.next()
+                op = self.next()
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise ValueError(f"bad matcher op {op!r}")
+                val = self.next()
+                if not (val.startswith('"') and val.endswith('"')):
+                    raise ValueError(f"matcher value must be quoted: "
+                                     f"{val!r}")
+                matchers.append((name, op, val[1:-1]))
+                self.accept(",")
+        range_s = None
+        if self.accept("["):
+            range_s = _duration_s(self.next())
+            self.expect("]")
+        offset_s = 0
+        if (self.peek() or "").lower() == "offset":
+            self.next()
+            offset_s = _duration_s(self.next())
+        return Selector(metric, tuple(matchers), range_s, offset_s)
+
+
+def parse_promql(q: str) -> Expr:
+    p = _Parser(_tokenize(q))
+    e = p.expr()
+    if p.peek() is not None:
+        raise ValueError(f"trailing PromQL at {p.peek()!r}")
+    return e
 
 
 def _parse_labels(s: str) -> Dict[str, str]:
@@ -75,6 +261,271 @@ def _parse_labels(s: str) -> Dict[str, str]:
     return out
 
 
+# -- evaluation ------------------------------------------------------------
+SeriesList = List[Tuple[Dict[str, str], np.ndarray]]
+
+
+def _counter_corrected(vs: np.ndarray) -> np.ndarray:
+    """Counter-reset correction: every drop adds the pre-drop value back
+    (upstream promql: resets are treated as counter restarts from 0)."""
+    drops = np.where(np.diff(vs) < 0, vs[:-1], 0.0)
+    out = vs.astype(np.float64).copy()
+    out[1:] += np.cumsum(drops)
+    return out
+
+
+def _extrapolated(ts, vs, grid, range_s, is_counter, is_rate):
+    """Upstream extrapolatedRate (promql/functions.go): per grid point,
+    the window's sample delta extrapolated toward the window edges, with
+    counter-reset correction and zero-crossing clamping. Vectorized over
+    all grid points at once."""
+    start = grid - range_s
+    lo = np.searchsorted(ts, start, side="left")
+    hi = np.searchsorted(ts, grid, side="right") - 1
+    count = hi - lo + 1
+    ok = count >= 2
+    loc = np.minimum(np.maximum(lo, 0), len(ts) - 1)
+    hic = np.maximum(hi, 0)
+    cv = _counter_corrected(vs) if is_counter else vs.astype(np.float64)
+    delta = cv[hic] - cv[loc]
+    first_v = vs[loc]
+    sampled = (ts[hic] - ts[loc]).astype(np.float64)
+    ok &= sampled > 0
+    sampled = np.maximum(sampled, 1e-9)
+    avg_int = sampled / np.maximum(count - 1, 1)
+    to_start = (ts[loc] - start).astype(np.float64)
+    to_end = (grid - ts[hic]).astype(np.float64)
+    threshold = avg_int * 1.1
+    to_start = np.where(to_start >= threshold, avg_int / 2, to_start)
+    to_end = np.where(to_end >= threshold, avg_int / 2, to_end)
+    if is_counter:
+        # don't extrapolate a counter below zero
+        with np.errstate(divide="ignore", invalid="ignore"):
+            to_zero = sampled * (first_v / np.where(delta > 0, delta, 1.0))
+        clamp = (delta > 0) & (first_v >= 0) & (to_zero < to_start)
+        to_start = np.where(clamp, to_zero, to_start)
+    factor = (sampled + to_start + to_end) / sampled
+    out = delta * factor
+    if is_rate:
+        out = out / range_s
+    return np.where(ok, out, np.nan)
+
+
+class _Evaluator:
+    def __init__(self, engine: "PromEngine", grid: np.ndarray) -> None:
+        self.engine = engine
+        self.grid = grid
+        # one table scan per distinct (lo, hi) window per evaluation:
+        # `rps / rps` must not rescan identical data per selector
+        self._scan_cache: Dict[Tuple[int, int], dict] = {}
+
+    def eval(self, e: Expr) -> SeriesList:
+        if isinstance(e, Num):
+            raise ValueError("scalar-only expression has no series")
+        if isinstance(e, Selector):
+            return self._instant(e)
+        if isinstance(e, Func):
+            if e.name in RANGE_FUNCS:
+                return self._range_fn(e.name, e.args[0])
+            if e.name == "histogram_quantile":
+                phi = e.args[0].value
+                return self._histogram_quantile(phi, self.eval(e.args[1]))
+            raise ValueError(f"unknown function {e.name}")
+        if isinstance(e, AggExpr):
+            return self._agg(e)
+        if isinstance(e, Bin):
+            return self._bin(e)
+        raise ValueError(f"cannot evaluate {e!r}")
+
+    # -- selectors ---------------------------------------------------------
+    def _fetch(self, sel: Selector, lo: int, hi: int):
+        """[(labels, ts, vs)] for series matching the selector with any
+        samples in [lo, hi)."""
+        key = (lo, hi)
+        cols = self._scan_cache.get(key)
+        if cols is None:
+            t = self.engine.store.table(self.engine.db, self.engine.table)
+            cols = t.scan(time_range=(lo, hi))
+            self._scan_cache[key] = cols
+        return self.engine._fetch(sel.metric, list(sel.matchers), lo, hi,
+                                  cols=cols)
+
+    def _instant(self, sel: Selector) -> SeriesList:
+        if sel.range_s is not None:
+            raise ValueError("range vector needs rate()/increase()/... "
+                             "around it")
+        g = self.grid - sel.offset_s
+        lo = int(g.min()) - DEFAULT_LOOKBACK_S
+        hi = int(g.max()) + 1
+        out: SeriesList = []
+        for labels, ts, vs in self._fetch(sel, lo, hi):
+            idx = np.searchsorted(ts, g, side="right") - 1
+            valid = idx >= 0
+            age = np.where(valid, g - ts[np.maximum(idx, 0)],
+                           np.int64(1 << 40))
+            valid &= age <= DEFAULT_LOOKBACK_S
+            vals = np.where(valid, vs[np.maximum(idx, 0)].astype(np.float64),
+                            np.nan)
+            if not np.isnan(vals).all():
+                out.append((labels, vals))
+        return out
+
+    def _range_fn(self, name: str, sel: Selector) -> SeriesList:
+        g = self.grid - sel.offset_s
+        lo = int(g.min()) - sel.range_s
+        hi = int(g.max()) + 1
+        out: SeriesList = []
+        for labels, ts, vs in self._fetch(sel, lo, hi):
+            if name == "irate":
+                vals = self._irate(ts, vs, g, sel.range_s)
+            else:
+                vals = _extrapolated(
+                    ts, vs, g, sel.range_s,
+                    is_counter=name in ("rate", "increase"),
+                    is_rate=name == "rate")
+            if not np.isnan(vals).all():
+                # rate() drops the metric name upstream; matchers keep
+                # label identity
+                out.append((labels, vals))
+        return out
+
+    @staticmethod
+    def _irate(ts, vs, grid, range_s):
+        hi = np.searchsorted(ts, grid, side="right") - 1
+        lo = np.searchsorted(ts, grid - range_s, side="left")
+        ok = (hi >= 1) & (hi > lo)
+        h = np.maximum(hi, 1)
+        dv = vs[h].astype(np.float64) - vs[h - 1]
+        # counter reset between the two samples: restart from v[last]
+        dv = np.where(dv < 0, vs[h].astype(np.float64), dv)
+        dt = (ts[h] - ts[h - 1]).astype(np.float64)
+        return np.where(ok & (dt > 0), dv / np.maximum(dt, 1e-9), np.nan)
+
+    # -- histogram_quantile ------------------------------------------------
+    @staticmethod
+    def _histogram_quantile(phi: float, series: SeriesList) -> SeriesList:
+        groups: Dict[Tuple, Dict] = {}
+        for labels, vals in series:
+            le = labels.get("le")
+            if le is None:
+                continue
+            rest = tuple(sorted((k, v) for k, v in labels.items()
+                                if k not in ("le", "__name__")))
+            g = groups.setdefault(rest, {"les": [], "vals": []})
+            g["les"].append(math.inf if le in ("+Inf", "Inf", "inf")
+                            else float(le))
+            g["vals"].append(vals)
+        out: SeriesList = []
+        for rest, g in groups.items():
+            les = np.asarray(g["les"])
+            order = np.argsort(les)
+            les = les[order]
+            counts = np.vstack([g["vals"][i] for i in order])  # [B, G]
+            if len(les) < 2 or not math.isinf(les[-1]):
+                # upstream: quantile needs at least 2 buckets and +Inf
+                continue
+            counts = np.where(np.isnan(counts), 0.0, counts)
+            # cumulative `le` buckets can regress slightly across series
+            # merges — monotonize like upstream ensureMonotonic
+            counts = np.maximum.accumulate(counts, axis=0)
+            total = counts[-1]
+            if phi < 0:
+                q = np.full(counts.shape[1], -math.inf)
+            elif phi > 1:
+                q = np.full(counts.shape[1], math.inf)
+            else:
+                rank = phi * total
+                b = np.argmax(counts >= rank[None, :], axis=0)
+                b = np.minimum(b, len(les) - 1)
+                upper = les[b]
+                lower = np.where(b > 0, les[np.maximum(b - 1, 0)], 0.0)
+                c_hi = counts[b, np.arange(counts.shape[1])]
+                c_lo = np.where(
+                    b > 0,
+                    counts[np.maximum(b - 1, 0), np.arange(counts.shape[1])],
+                    0.0)
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    frac = (rank - c_lo) / np.maximum(c_hi - c_lo, 1e-12)
+                q = lower + (upper - lower) * np.clip(frac, 0.0, 1.0)
+                # +Inf bucket hit: report the highest finite bound
+                q = np.where(np.isinf(upper), les[-2], q)
+                q = np.where(total > 0, q, np.nan)
+            if not np.isnan(q).all():
+                out.append((dict(rest), q))
+        return out
+
+    # -- aggregation -------------------------------------------------------
+    def _agg(self, e: AggExpr) -> SeriesList:
+        series = self.eval(e.arg)
+        groups: Dict[Tuple, List[np.ndarray]] = {}
+        for labels, vals in series:
+            key = tuple(labels.get(b, "") for b in e.by)
+            groups.setdefault(key, []).append(vals)
+        out: SeriesList = []
+        for key, arrs in groups.items():
+            stack = np.vstack(arrs)
+            dead = np.isnan(stack).all(axis=0)
+            if e.op == "count":
+                agg = (~np.isnan(stack)).sum(axis=0).astype(np.float64)
+            else:
+                safe = np.where(dead[None, :], 0.0, stack)
+                agg = {"sum": np.nansum, "max": np.nanmax,
+                       "min": np.nanmin, "avg": np.nanmean}[e.op](
+                           safe, axis=0)
+            agg = np.where(dead, np.nan, agg)
+            out.append((dict(zip(e.by, key)), agg))
+        return out
+
+    # -- binary ops --------------------------------------------------------
+    def _bin(self, e: Bin) -> SeriesList:
+        lnum = isinstance(e.left, Num)
+        rnum = isinstance(e.right, Num)
+        if lnum and rnum:
+            raise ValueError("scalar-only expression has no series")
+        if lnum or rnum:
+            series = self.eval(e.right if lnum else e.left)
+            c = (e.left if lnum else e.right).value
+            out = []
+            for labels, vals in series:
+                a, b = (c, vals) if lnum else (vals, c)
+                out.append((_drop_name(labels), _arith(e.op, a, b)))
+            return out
+        left = self.eval(e.left)
+        right = self.eval(e.right)
+        # one-to-one vector match on the full label set minus __name__
+        rmap: Dict[Tuple, np.ndarray] = {}
+        for labels, vals in right:
+            key = tuple(sorted(_drop_name(labels).items()))
+            if key in rmap:
+                raise ValueError("many-to-many vector match")
+            rmap[key] = vals
+        out: SeriesList = []
+        for labels, vals in left:
+            key = tuple(sorted(_drop_name(labels).items()))
+            other = rmap.get(key)
+            if other is None:
+                continue
+            out.append((dict(key), _arith(e.op, vals, other)))
+        return out
+
+
+def _drop_name(labels: Dict[str, str]) -> Dict[str, str]:
+    return {k: v for k, v in labels.items() if k != "__name__"}
+
+
+def _arith(op: str, a, b):
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = np.asarray(a, np.float64) / np.asarray(b, np.float64)
+    return r
+
+
+# -- engine ----------------------------------------------------------------
 class PromEngine:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  db: str = "ext_metrics", table: str = "ext_samples") -> None:
@@ -83,144 +534,83 @@ class PromEngine:
         self.db = db
         self.table = table
 
-    def _matching_series(self, pq: PromQuery, cols: Dict[str, np.ndarray],
-                         sel: np.ndarray) -> Dict[int, Dict[str, str]]:
-        """label_hash -> decoded labels for series in `cols[sel]` passing
-        the selector's matchers — the one series-discovery loop shared by
-        query / query_range / series."""
+    # -- series access -----------------------------------------------------
+    def _fetch(self, metric: str, matchers, lo: int, hi: int,
+               cols: Optional[dict] = None):
+        """[(labels, sorted ts, vs)] for the metric's series passing the
+        matchers, with samples in [lo, hi). Read-only dictionary lookups
+        — the query path must never grow a dict (a typo'd Grafana panel
+        would journal a new entry per refresh)."""
+        mh = self.tag_dicts.get("metric_name").lookup(metric)
+        if mh is None:
+            return []
+        if cols is None:
+            t = self.store.table(self.db, self.table)
+            cols = t.scan(time_range=(lo, hi))
+        sel = cols["metric"] == np.uint32(mh)
+        label_dict = self.tag_dicts.get("label_set")
+        out = []
+        for lh in np.unique(cols["labels"][sel]):
+            labels = _parse_labels(label_dict.decode(int(lh)) or "")
+            if not self._match(labels, matchers):
+                continue
+            m = sel & (cols["labels"] == np.uint32(lh))
+            ts = cols["timestamp"][m].astype(np.int64)
+            vs = cols["value"][m].astype(np.float64)
+            order = np.argsort(ts)
+            labels = {"__name__": metric, **labels}
+            out.append((labels, ts[order], vs[order]))
+        return out
+
+    def _matching_series(self, metric, matchers, cols, sel):
+        """label_hash -> decoded labels for series in cols[sel] passing
+        the matchers (used by series() discovery)."""
         label_dict = self.tag_dicts.get("label_set")
         out: Dict[int, Dict[str, str]] = {}
         for lh in np.unique(cols["labels"][sel]):
             labels = _parse_labels(label_dict.decode(int(lh)) or "")
-            if self._match(labels, pq.matchers):
+            if self._match(labels, matchers):
                 out[int(lh)] = labels
         return out
 
+    # -- queries -----------------------------------------------------------
     def query(self, promql: str, at: Optional[int] = None) -> List[dict]:
-        """Instant query: returns [{metric: {labels}, value: [ts, v]}] in
-        the Prometheus HTTP API result shape."""
-        pq = parse_promql(promql)
-        # read-only lookup: the query path must not grow the dictionary
-        # (a typo'd Grafana panel would journal a new entry per refresh)
-        mh = self.tag_dicts.get("metric_name").lookup(pq.metric)
-        if mh is None:
-            return []
-        t = self.store.table(self.db, self.table)
+        """Instant query: [{metric: {...}, value: [ts, "v"]}] in the
+        Prometheus HTTP API result shape."""
         at = at if at is not None else int(time.time())
-        hi = at + 1  # instant query at t includes samples stamped exactly t
-        lo = hi - (pq.range_s if pq.range_s else 300)
-        cols = t.scan(time_range=(lo, hi))
-        sel = cols["metric"] == np.uint32(mh)
-        series = self._matching_series(pq, cols, sel)
+        expr = parse_promql(promql)
+        grid = np.asarray([at], np.int64)
+        series = _Evaluator(self, grid).eval(expr)
         out = []
-        groups: Dict[Tuple, List[Tuple[Dict[str, str], float]]] = {}
-        for lh, labels in series.items():
-            m = sel & (cols["labels"] == np.uint32(lh))
-            ts = cols["timestamp"][m].astype(np.int64)
-            vs = cols["value"][m].astype(np.float64)
-            if len(ts) == 0:
+        keep_name = isinstance(expr, Selector)
+        for labels, vals in series:
+            if np.isnan(vals[0]):
                 continue
-            order = np.argsort(ts)
-            ts, vs = ts[order], vs[order]
-            if pq.rate:
-                if len(ts) < 2 or ts[-1] == ts[0]:
-                    continue
-                val = float((vs[-1] - vs[0]) / (ts[-1] - ts[0]))
-            else:
-                val = float(vs[-1])
-            stamp = int(ts[-1])
-            if pq.agg:
-                key = tuple(labels.get(b, "") for b in pq.by)
-                groups.setdefault(key, []).append((labels, val))
-            else:
-                out.append({"metric": {"__name__": pq.metric, **labels},
-                            "value": [stamp, str(val)]})
-        for key, members in groups.items():
-            vals = [v for _, v in members]
-            v = {"sum": sum(vals), "max": max(vals), "min": min(vals),
-                 "avg": sum(vals) / len(vals)}[pq.agg]
-            labels = dict(zip(pq.by, key))
-            out.append({"metric": labels, "value": [at, str(v)]})
+            shown = labels if keep_name else _drop_name(labels)
+            out.append({"metric": shown,
+                        "value": [at, str(float(vals[0]))]})
         return sorted(out, key=lambda r: str(r["metric"]))
 
     def query_range(self, promql: str, start: int, end: int,
                     step: int) -> List[dict]:
-        """Range query: evaluate the expression on the [start, end] step
-        grid, returning Prometheus matrix results
-        [{metric: {...}, values: [[ts, "v"], ...]}] — what Grafana panels
-        POST (reference: server/querier/app/prometheus/router/prometheus.go
-        promQueryRange). Instant-selector semantics per grid point: latest
-        sample within the lookback window; rate() over its range window."""
+        """Range query on the [start, end] step grid — Prometheus matrix
+        results [{metric, values: [[ts, "v"], ...]}] (what Grafana
+        panels POST)."""
         if step <= 0:
             raise ValueError("step must be positive")
         if end < start:
             raise ValueError("end < start")
-        pq = parse_promql(promql)
-        lookback = pq.range_s if pq.range_s else 300
-        mh = self.tag_dicts.get("metric_name").lookup(
-            pq.metric)   # read-only: see query()
-        if mh is None:
-            return []
-        t = self.store.table(self.db, self.table)
-        cols = t.scan(time_range=(start - lookback, end + 1))
-        sel = cols["metric"] == np.uint32(mh)
+        expr = parse_promql(promql)
         grid = np.arange(start, end + 1, step, dtype=np.int64)
-
-        series_vals: List[Tuple[Dict[str, str], np.ndarray]] = []
-        for lh, labels in self._matching_series(pq, cols, sel).items():
-            m = sel & (cols["labels"] == np.uint32(lh))
-            ts = cols["timestamp"][m].astype(np.int64)
-            vs = cols["value"][m].astype(np.float64)
-            order = np.argsort(ts)
-            ts, vs = ts[order], vs[order]
-            # per grid point: index of the last sample with ts <= point
-            hi = np.searchsorted(ts, grid, side="right") - 1
-            valid = hi >= 0
-            # staleness: sample must fall inside the lookback window
-            valid &= np.where(hi >= 0, grid - ts[np.maximum(hi, 0)],
-                              np.int64(1 << 40)) <= lookback
-            if pq.rate:
-                # first sample index inside each point's range window
-                lo = np.searchsorted(ts, grid - lookback, side="left")
-                valid &= (hi > lo)
-                dt = ts[np.maximum(hi, 0)] - ts[np.minimum(lo, len(ts) - 1)]
-                dv = vs[np.maximum(hi, 0)] - vs[np.minimum(lo, len(ts) - 1)]
-                vals = np.where(valid & (dt > 0), dv / np.maximum(dt, 1),
-                                np.nan)
-            else:
-                vals = np.where(valid, vs[np.maximum(hi, 0)], np.nan)
-            if np.isnan(vals).all():
-                continue
-            series_vals.append((labels, vals))
-
-        out = []
-        if pq.agg:
-            groups: Dict[Tuple, List[np.ndarray]] = {}
-            for labels, vals in series_vals:
-                key = tuple(labels.get(b, "") for b in pq.by)
-                groups.setdefault(key, []).append(vals)
-            for key, arrs in groups.items():
-                stack = np.vstack(arrs)
-                # mask all-NaN grid points BEFORE aggregating: nanmax/min/
-                # mean warn (warnings module, not errstate) on all-NaN
-                # slices, which would fire per Grafana poll
-                dead = np.isnan(stack).all(axis=0)
-                safe = np.where(dead[None, :], 0.0, stack)
-                agg = {"sum": np.nansum, "max": np.nanmax,
-                       "min": np.nanmin, "avg": np.nanmean}[pq.agg](
-                           safe, axis=0)
-                agg = np.where(dead, np.nan, agg)
-                out.append((dict(zip(pq.by, key)), agg))
-        else:
-            out = [({"__name__": pq.metric, **labels}, vals)
-                   for labels, vals in series_vals]
-
+        series = _Evaluator(self, grid).eval(expr)
+        keep_name = isinstance(expr, Selector)
         result = []
-        for labels, vals in sorted(out, key=lambda r: str(r[0])):
+        for labels, vals in sorted(series, key=lambda r: str(r[0])):
+            shown = labels if keep_name else _drop_name(labels)
             values = [[int(g), str(float(v))]
                       for g, v in zip(grid, vals) if not np.isnan(v)]
             if values:
-                result.append({"metric": labels, "values": values})
+                result.append({"metric": shown, "values": values})
         return result
 
     # -- discovery (Grafana datasource surface) ---------------------------
@@ -259,15 +649,18 @@ class PromEngine:
                       time_range=(start, end + 1))
         out, seen = [], set()
         for match in matches:
-            pq = parse_promql(match)
-            mh = self.tag_dicts.get("metric_name").lookup(pq.metric)
-            if mh is None:
-                continue
-            sel = cols["metric"] == np.uint32(mh)
-            for lh, labels in self._matching_series(pq, cols, sel).items():
-                if (pq.metric, lh) not in seen:
-                    seen.add((pq.metric, lh))
-                    out.append({"__name__": pq.metric, **labels})
+            expr = parse_promql(match)
+            sels = _selectors(expr)
+            for sq in sels:
+                mh = self.tag_dicts.get("metric_name").lookup(sq.metric)
+                if mh is None:
+                    continue
+                sel = cols["metric"] == np.uint32(mh)
+                for lh, labels in self._matching_series(
+                        sq.metric, list(sq.matchers), cols, sel).items():
+                    if (sq.metric, lh) not in seen:
+                        seen.add((sq.metric, lh))
+                        out.append({"__name__": sq.metric, **labels})
         return out
 
     def remote_read(self, body: bytes) -> bytes:
@@ -312,7 +705,8 @@ class PromEngine:
             pair = (cols["metric"].astype(np.uint64) << np.uint64(32)) \
                 | cols["labels"].astype(np.uint64)
             for ph in np.unique(pair):
-                mh, lh = int(ph >> np.uint64(32)), int(ph & np.uint64(0xFFFFFFFF))
+                mh, lh = int(ph >> np.uint64(32)), \
+                    int(ph & np.uint64(0xFFFFFFFF))
                 name = metric_dict.decode(mh) or ""
                 labels = _parse_labels(label_dict.decode(lh) or "")
                 full = {"__name__": name, **labels}
@@ -338,7 +732,7 @@ class PromEngine:
 
     @staticmethod
     def _match(labels: Dict[str, str],
-               matchers: List[Tuple[str, str, str]]) -> bool:
+               matchers) -> bool:
         for name, op, value in matchers:
             have = labels.get(name, "")
             if op == "=" and have != value:

@@ -16,6 +16,12 @@ metrics like Sum(retrans)/Sum(packet_tx)). Conditions: =, !=, <, <=, >,
 >=, IN (...), and AND conjunction. The reference's sqlparser fork
 (querier/parse/parse.go) plays this role; a hand-rolled parser keeps the
 dependency surface zero.
+
+Time bucketing: `time(N)` (alias `interval(N)`) may appear in GROUP BY
+and in the select list — the reference's TransGroupBy interval grouping
+(engine/clickhouse/clickhouse.go:816-1088 lowers it to
+toStartOfInterval); here it floors the table's time column to N-second
+buckets so timeseries panels can be driven straight from SQL.
 """
 
 from __future__ import annotations
@@ -72,7 +78,14 @@ class BinOp:
     right: "Expr"
 
 
-Expr = Union[Column, Literal, Agg, BinOp]
+@dataclass(frozen=True)
+class TimeBucket:
+    """time(N) / interval(N): the table's time column floored to
+    N-second buckets. Output column name defaults to `time`."""
+    seconds: int
+
+
+Expr = Union[Column, Literal, Agg, BinOp, TimeBucket]
 
 
 @dataclass(frozen=True)
@@ -93,7 +106,8 @@ class Select:
     items: List[SelectItem]
     table: str
     where: List[Cond] = field(default_factory=list)
-    group_by: List[str] = field(default_factory=list)
+    # column names, plus at most one TimeBucket for interval grouping
+    group_by: List[Union[str, TimeBucket]] = field(default_factory=list)
     # [(alias/col, desc), ...] — primary key first
     order_by: List[Tuple[str, bool]] = field(default_factory=list)
     limit: Optional[int] = None
@@ -174,6 +188,8 @@ class _Parser:
             return Literal(int(t))
         if re.fullmatch(r"\d+\.\d+", t):
             return Literal(float(t))
+        if t.lower() in ("time", "interval") and self.peek() == "(":
+            return self._time_bucket()
         if t.lower() in AGG_FUNCS and self.peek() == "(":
             self.next()
             if self.accept("*"):
@@ -214,9 +230,12 @@ class _Parser:
                 where.append(self.parse_cond())
         if self.accept("group"):
             self.expect("by")
-            group_by.append(self.next())
+            group_by.append(self._group_item())
             while self.accept(","):
-                group_by.append(self.next())
+                group_by.append(self._group_item())
+            if sum(isinstance(g, TimeBucket) for g in group_by) > 1:
+                raise ValueError("at most one time()/interval() bucket "
+                                 "per GROUP BY")
         having: List[Cond] = []
         if self.accept("having"):
             having.append(self.parse_cond())
@@ -240,6 +259,21 @@ class _Parser:
             raise ValueError(f"trailing tokens at {self.peek()!r}")
         return Select(items, table, where, group_by, order_by, limit,
                       having)
+
+    def _time_bucket(self) -> TimeBucket:
+        self.expect("(")
+        t = self.next()
+        if not re.fullmatch(r"\d+", t) or int(t) <= 0:
+            raise ValueError(f"time() needs a positive interval in "
+                             f"seconds, got {t!r}")
+        self.expect(")")
+        return TimeBucket(int(t))
+
+    def _group_item(self) -> Union[str, TimeBucket]:
+        t = self.next()
+        if t.lower() in ("time", "interval") and self.peek() == "(":
+            return self._time_bucket()
+        return t
 
     def parse_cond(self) -> Cond:
         col = self.next()

@@ -79,7 +79,19 @@ class AppRedExporter(QueueWorkerExporter):
                  cfg: Optional[app_suite.AppSuiteConfig] = None,
                  batch_rows: int = 1 << 14,
                  window_seconds: float = 1.0,
-                 stats: Optional[StatsRegistry] = None) -> None:
+                 stats: Optional[StatsRegistry] = None,
+                 tag_dicts=None,
+                 prom_bucket_stride: int = 0,
+                 prom_bucket_metric: str = "app_rrt_bucket") -> None:
+        """prom_bucket_stride > 0 additionally surfaces each window's
+        DDSketch as cumulative Prometheus `le` buckets in the
+        ext_metrics.ext_samples table (one sample per active service per
+        retained gamma-bucket boundary, every stride-th boundary plus
+        +Inf), as RUNNING counters — so Grafana's canonical
+        `histogram_quantile(0.95, rate(app_rrt_bucket[5m]))` works over
+        real sketch windows (the DDSketch IS a histogram; its gamma
+        boundaries are just log-spaced `le` bounds). Needs tag_dicts for
+        the metric/label-set dictionaries."""
         super().__init__("app_red", ["l7_flow_log"], n_workers=1,
                          batch=64, stats=stats)
         import jax
@@ -104,6 +116,42 @@ class AppRedExporter(QueueWorkerExporter):
                 store.create_table(APP_RED_DB,
                                    app_red_table(self.cfg.quantiles)),
                 batch_rows=4096, flush_interval=5.0)
+        self.bucket_writer = None
+        if prom_bucket_stride > 0:
+            if store is None or tag_dicts is None:
+                raise ValueError("prom_bucket_stride needs store and "
+                                 "tag_dicts")
+            from deepflow_tpu.ops import ddsketch as _dd
+            from deepflow_tpu.pipelines.ext_metrics import (EXT_METRICS_DB,
+                                                            SAMPLE_TABLE)
+            self.bucket_writer = StoreWriter(
+                store.create_table(EXT_METRICS_DB, SAMPLE_TABLE),
+                batch_rows=4096, flush_interval=5.0)
+            g = _dd.gamma(self.cfg.dd)
+            # retained boundaries: every stride-th bucket upper edge,
+            # always ending in +Inf (Prometheus requires the Inf bucket)
+            idx = np.arange(prom_bucket_stride - 1, self.cfg.dd.buckets,
+                            prom_bucket_stride)
+            if len(idx) == 0 or idx[-1] != self.cfg.dd.buckets - 1:
+                idx = np.append(idx, self.cfg.dd.buckets - 1)
+            self._bucket_idx = idx
+            # sketch bucket i covers (min*g^(i-1), min*g^i] (ddsketch
+            # bucket_index is ceil-based), so cumsum through bucket i
+            # counts values <= min*g^i — that IS the le bound
+            les = self.cfg.dd.min_value * g ** idx.astype(np.float64)
+            self._bucket_les = [f"{v:.6g}" for v in les[:-1]] + ["+Inf"]
+            self._bucket_metric_h = tag_dicts.get("metric_name").encode_one(
+                prom_bucket_metric)
+            self._label_dict = tag_dicts.get("label_set")
+            self._label_rows: dict = {}   # group -> uint32 label hashes
+            # running cumulative counters per (group, retained bucket):
+            # Prometheus histograms are counters, rate() recovers
+            # windows. float64 here; the f32 value column caps exact
+            # integer counts at 2^24, so counters RESET to the window's
+            # own counts past 2^23 — a legal Prometheus counter reset
+            # that rate()'s reset correction absorbs.
+            self._bucket_cum = np.zeros(
+                (self.cfg.groups, len(idx)), np.float64)
         self._state_lock = threading.Lock()
         self._window_stop = threading.Event()
         self._window_thread: Optional[threading.Thread] = None
@@ -112,6 +160,8 @@ class AppRedExporter(QueueWorkerExporter):
     def start(self) -> None:
         if self.writer is not None:
             self.writer.start()
+        if self.bucket_writer is not None:
+            self.bucket_writer.start()
         super().start()
         self._window_thread = threading.Thread(
             target=self._window_loop, name="app-red-window", daemon=True)
@@ -125,6 +175,8 @@ class AppRedExporter(QueueWorkerExporter):
         self.flush_window()
         if self.writer is not None:
             self.writer.close()
+        if self.bucket_writer is not None:
+            self.bucket_writer.close()
 
     def _window_loop(self) -> None:
         while not self._window_stop.wait(self.window_seconds):
@@ -176,11 +228,55 @@ class AppRedExporter(QueueWorkerExporter):
         for i, q in enumerate(self.cfg.quantiles):
             row[quantile_column(q)] = qs[i].astype(np.float32)
         self.writer.put(row)
+        self._write_buckets(out, active, second)
+
+    def _write_buckets(self, out, active: np.ndarray, second: int) -> None:
+        if self.bucket_writer is None:
+            return
+        # fetch only the active groups' sketch rows (device gather first
+        # — the full [groups, buckets] plane would be a 2MB D2H per
+        # window)
+        hist = np.asarray(out.rrt_hist[self._jnp.asarray(active)])
+        zeros = np.asarray(out.rrt_zeros[self._jnp.asarray(active)])
+        # cumulative over buckets (le semantics: count of samples <=
+        # bound; the below-min zeros count is <= every retained bound),
+        # then accumulated over windows (counter semantics)
+        cum = np.cumsum(hist, axis=1)[:, self._bucket_idx] \
+            + zeros[:, None]
+        # f32-precision guard: reset a group's counter to this window's
+        # counts before its total exceeds the f32 exact-integer range
+        # (rate() absorbs the reset like any counter restart)
+        over = self._bucket_cum[active, -1] > float(1 << 23)
+        self._bucket_cum[active] = np.where(
+            over[:, None], cum, self._bucket_cum[active] + cum)
+        # one label-hash row per group, dictionary-encoded once; the
+        # emit itself is pure array ops (this runs on the 1s window
+        # thread — a per-(group, bucket) Python loop would stall it)
+        n_le = len(self._bucket_les)
+        lh_rows = []
+        for g in active.tolist():
+            row = self._label_rows.get(g)
+            if row is None:
+                row = np.asarray(
+                    [self._label_dict.encode_one(
+                        f"le={le},service_group={g}")
+                     for le in self._bucket_les], np.uint32)
+                self._label_rows[g] = row
+            lh_rows.append(row)
+        k = len(active) * n_le
+        self.bucket_writer.put({
+            "timestamp": np.full(k, second, np.uint32),
+            "metric": np.full(k, self._bucket_metric_h, np.uint32),
+            "labels": np.concatenate(lh_rows),
+            "value": self._bucket_cum[active].ravel().astype(np.float32),
+        })
 
     def flush(self) -> None:
         """Drain pending RED rows to disk (Ingester.flush)."""
         if self.writer is not None:
             self.writer.flush()
+        if self.bucket_writer is not None:
+            self.bucket_writer.flush()
 
     def counters(self) -> dict:
         c = super().counters()   # keep the queue's observable-loss stats

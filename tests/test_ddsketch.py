@@ -275,3 +275,59 @@ def test_quantile_column_names_exact():
     names = [c.name for c in t.columns]
     assert "rrt_p99_us" in names and "rrt_p99_5_us" in names \
         and "rrt_p99_9_us" in names
+
+
+def test_histogram_quantile_over_sketch_buckets(tmp_path):
+    """DDSketch windows -> cumulative `le` bucket counters in
+    ext_samples -> PromQL histogram_quantile(rate(...)) recovers the
+    sketch's own quantile within gamma resolution (the VERDICT-r2
+    'PromQL functions over the existing sketches' path, end to end)."""
+    import time
+
+    from deepflow_tpu.querier.promql import PromEngine
+    from deepflow_tpu.runtime.app_red import AppRedExporter
+    from deepflow_tpu.store import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    store = Store(str(tmp_path))
+    dicts = TagDictRegistry(str(tmp_path))
+    cfg = app_suite.AppSuiteConfig(groups=64, dd_buckets=512)
+    exp = AppRedExporter(store=store, window_seconds=3600, cfg=cfg,
+                         tag_dicts=dicts, prom_bucket_stride=1)
+    exp.start()
+    try:
+        n = 5000
+        rng = np.random.default_rng(7)
+        rrt = rng.lognormal(mean=7.0, sigma=0.8, size=n).astype(np.uint32)
+        cols = {
+            "ip_dst": np.full(n, 0x0A000001, np.uint32),
+            "port_dst": np.full(n, 80, np.uint32),
+            "protocol": np.full(n, 6, np.uint32),
+            "status": np.zeros(n, np.uint32),
+            "rrt_us": rrt,
+        }
+        exp.put("l7_flow_log", 0, cols)
+        deadline = time.time() + 15
+        while exp.rows_in < n and time.time() < deadline:
+            time.sleep(0.1)
+        now = 2000
+        out = exp.flush_window(now=now)
+        exp.flush()
+        exp.close()
+
+        reqs = np.asarray(out.requests)
+        g = int(np.nonzero(reqs)[0][0])
+        eng = PromEngine(store, dicts)
+        # one window: instant histogram_quantile over the raw counters
+        res = eng.query(
+            f'histogram_quantile(0.95, app_rrt_bucket'
+            f'{{service_group="{g}"}})', at=now)
+        assert len(res) == 1
+        got = float(res[0]["value"][1])
+        want = float(np.quantile(rrt, 0.95))
+        # gamma bucket resolution (alpha=0.02 -> ~4%) plus prom's linear
+        # interpolation inside the bucket
+        assert abs(got - want) / want < 0.08
+    finally:
+        if exp._window_thread is not None and exp._window_thread.is_alive():
+            exp.close()

@@ -115,12 +115,23 @@ def test_show_tags_metrics(engine):
 
 # -- promql ----------------------------------------------------------------
 def test_parse_promql():
-    pq = parse_promql('sum by (job) (rate(http_requests_total'
-                      '{job=~"api.*", env!="dev"}[5m]))')
-    assert pq.metric == "http_requests_total"
-    assert pq.agg == "sum" and pq.by == ["job"]
-    assert pq.rate and pq.range_s == 300
-    assert ("env", "!=", "dev") in pq.matchers
+    from deepflow_tpu.querier.promql import AggExpr, Func, Selector
+    e = parse_promql('sum by (job) (rate(http_requests_total'
+                     '{job=~"api.*", env!="dev"}[5m]))')
+    assert isinstance(e, AggExpr)
+    assert e.op == "sum" and e.by == ("job",)
+    assert isinstance(e.arg, Func) and e.arg.name == "rate"
+    sel = e.arg.args[0]
+    assert isinstance(sel, Selector)
+    assert sel.metric == "http_requests_total"
+    assert sel.range_s == 300
+    assert ("env", "!=", "dev") in sel.matchers
+    off = parse_promql('rps offset 5m')
+    assert off == Selector("rps", (), None, 300)
+    q = parse_promql('histogram_quantile(0.9, '
+                     'rate(rrt_bucket[1m])) * 2')
+    from deepflow_tpu.querier.promql import Bin, Num
+    assert isinstance(q, Bin) and q.op == "*" and q.right == Num(2.0)
 
 
 @pytest.fixture
@@ -151,9 +162,13 @@ def test_promql_instant_and_rate(prom):
     assert float(out[0]["value"][1]) == 19.0   # last sample
     out = eng.query('rate(rps[2m])', at=1100)
     assert len(out) == 2
-    # both series rise 1 per 10s
+    # both series rise 1 per 10s. Upstream extrapolatedRate semantics:
+    # window [980, 1100], samples 1000..1090 -> delta 9 over 90s
+    # sampled, extrapolated by (90 + 5 + 10)/90 (start is beyond the
+    # 1.1x-interval threshold -> half interval; end is within), over
+    # the 120s range: 9 * (105/90) / 120 = 0.0875
     for r in out:
-        assert float(r["value"][1]) == pytest.approx(0.1)
+        assert float(r["value"][1]) == pytest.approx(0.0875)
     out = eng.query('sum by (job) (rps)', at=1100)
     assert {r["metric"]["job"]: float(r["value"][1]) for r in out} == \
         {"api": 19.0, "web": 109.0}
@@ -825,3 +840,144 @@ def test_select_star_with_group_by_errors_cleanly(tmp_path):
     eng = QueryEngine(store, TagDictRegistry(None))
     with pytest.raises(ValueError, match="GROUP BY"):
         eng.execute("SELECT * FROM flows GROUP BY ip", db="flow_log")
+
+
+def test_parse_time_bucket():
+    from deepflow_tpu.querier.sql import TimeBucket
+    s = parse_sql("SELECT time(60), Sum(bytes) FROM flows "
+                  "GROUP BY time(60), ip ORDER BY time")
+    assert s.group_by == [TimeBucket(60), "ip"]
+    assert s.items[0].expr == TimeBucket(60)
+    # interval() is an alias
+    s2 = parse_sql("SELECT Sum(bytes) FROM flows GROUP BY interval(30)")
+    assert s2.group_by == [TimeBucket(30)]
+    with pytest.raises(ValueError):
+        parse_sql("SELECT 1 FROM t GROUP BY time(60), time(30)")
+    with pytest.raises(ValueError):
+        parse_sql("SELECT 1 FROM t GROUP BY time(0)")
+
+
+def test_time_bucket_matches_numpy(engine):
+    """GROUP BY time(N) goldens vs a direct numpy computation."""
+    eng, cols = engine
+    r = eng.execute(
+        "SELECT time(10), Sum(bytes) AS b FROM flows "
+        "GROUP BY time(10) ORDER BY time")
+    assert r.columns == ["time", "b"]
+    want = {}
+    for ts, by in zip((cols["timestamp"] // 10) * 10, cols["bytes"]):
+        want[int(ts)] = want.get(int(ts), 0) + int(by)
+    got = {int(row[0]): int(row[1]) for row in r.values}
+    assert got == want
+    # buckets come back sorted by the ORDER BY
+    assert [row[0] for row in r.values] == sorted(got)
+
+
+def test_time_bucket_with_key_and_where(engine):
+    eng, cols = engine
+    r = eng.execute(
+        "SELECT time(20), ip, Sum(bytes) AS b FROM flows "
+        "WHERE proto = 6 GROUP BY time(20), ip "
+        "ORDER BY time, ip")
+    m = cols["proto"] == 6
+    want = {}
+    for ts, ip, by in zip((cols["timestamp"][m] // 20) * 20,
+                          cols["ip"][m], cols["bytes"][m]):
+        want[(int(ts), int(ip))] = want.get((int(ts), int(ip)), 0) + int(by)
+    got = {(int(a), int(b)): int(c) for a, b, c in r.values}
+    assert got == want
+
+
+def test_time_bucket_requires_group(engine):
+    eng, _ = engine
+    with pytest.raises(ValueError):
+        eng.execute("SELECT time(60), Sum(bytes) FROM flows GROUP BY ip")
+    with pytest.raises(ValueError):
+        eng.execute("SELECT time(60), Sum(bytes) FROM flows "
+                    "GROUP BY time(30)")
+
+
+def test_promql_increase_irate_offset(prom):
+    eng, _, _ = prom
+    # increase = rate * range: 0.0875 * 120 = 10.5
+    out = eng.query('increase(rps{job="api"}[2m])', at=1100)
+    assert float(out[0]["value"][1]) == pytest.approx(10.5)
+    # irate: last two samples (1080->1090), 1 per 10s
+    out = eng.query('irate(rps{job="api"}[2m])', at=1100)
+    assert float(out[0]["value"][1]) == pytest.approx(0.1)
+    # offset 50s: instant value at 1050 is start + 5
+    out = eng.query('rps{job="api"} offset 50s', at=1100)
+    assert float(out[0]["value"][1]) == 15.0
+
+
+def test_promql_binary_ops(prom):
+    eng, _, _ = prom
+    out = eng.query('rps{job="api"} * 2', at=1100)
+    assert float(out[0]["value"][1]) == 38.0
+    out = eng.query('rps / rps', at=1100)          # vector/vector
+    assert len(out) == 2
+    for r in out:
+        assert float(r["value"][1]) == 1.0
+    out = eng.query('rps - rps{job="api"}', at=1100)
+    # one-to-one match: only the api series joins
+    assert len(out) == 1 and float(out[0]["value"][1]) == 0.0
+
+
+def test_promql_counter_reset_correction(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("ctr")
+    lh = dicts.get("label_set").encode_one("job=r")
+    # counter climbs to 50, resets to 3, climbs again: true increase
+    # within the sampled span = (50 - 10) + 3 + (13 - 3) ... corrected
+    ts = np.array([1000, 1010, 1020, 1030, 1040], np.uint32)
+    vs = np.array([10.0, 30.0, 50.0, 3.0, 13.0], np.float32)
+    t.append({"timestamp": ts, "metric": np.full(5, mh, np.uint32),
+              "labels": np.full(5, lh, np.uint32),
+              "value": vs})
+    out = eng.query('increase(ctr[40s])', at=1040)
+    # corrected delta over [1000,1040] = (63+50) - 10 = wait:
+    # corrected series = 10,30,50,53,63 -> delta 53; window == sampled
+    # span exactly, no extrapolation slack beyond edges (to_start=0,
+    # to_end=0), counter clamp no-op -> 53
+    assert float(out[0]["value"][1]) == pytest.approx(53.0)
+
+
+def test_promql_histogram_quantile(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("lat_bucket")
+    rows_le = [("0.1", 10.0), ("0.5", 70.0), ("1", 90.0), ("+Inf", 100.0)]
+    for le, c in rows_le:
+        lh = dicts.get("label_set").encode_one(f"job=h,le={le}")
+        t.append({"timestamp": np.array([1100], np.uint32),
+                  "metric": np.array([mh], np.uint32),
+                  "labels": np.array([lh], np.uint32),
+                  "value": np.array([c], np.float32)})
+    out = eng.query('histogram_quantile(0.5, lat_bucket)', at=1100)
+    assert len(out) == 1
+    assert out[0]["metric"] == {"job": "h"}
+    # rank = 50 -> bucket (0.1, 0.5]: 0.1 + 0.4*(50-10)/(70-10) = 0.3667
+    assert float(out[0]["value"][1]) == pytest.approx(0.1 + 0.4 * 40 / 60)
+    # phi=0.95 -> rank 95 -> bucket (1, +Inf] -> highest finite bound
+    out = eng.query('histogram_quantile(0.95, lat_bucket)', at=1100)
+    assert float(out[0]["value"][1]) == pytest.approx(1.0)
+
+
+def test_promql_range_histogram_quantile(prom):
+    """histogram_quantile over a range grid: per-point interpolation."""
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("h2_bucket")
+    for le, c0 in (("1", 50.0), ("+Inf", 100.0)):
+        lh = dicts.get("label_set").encode_one(f"le={le}")
+        t.append({"timestamp": np.array([1000, 1060], np.uint32),
+                  "metric": np.full(2, mh, np.uint32),
+                  "labels": np.full(2, lh, np.uint32),
+                  "value": np.array([c0, c0 * 2], np.float32)})
+    res = eng.query_range('histogram_quantile(0.25, h2_bucket)',
+                          start=1000, end=1060, step=60)
+    assert len(res) == 1
+    # rank 25 of 100 (then 50 of 200) -> within (0,1]: 0.5 both points
+    assert [float(v) for _, v in res[0]["values"]] == \
+        pytest.approx([0.5, 0.5])
