@@ -355,6 +355,15 @@ class FlowMap:
             "l3_epc_id": np.zeros(len(idx), np.int32),
             "is_new_flow": (~self.c_reported[idx]).astype(np.uint32),
         }
+        # LogMessageStatus (l4_flow_log.go getStatus :857) computed HERE
+        # so the planar columnar wire carries the same value the server
+        # derives for protobuf streams (wire-mode must not change data)
+        proto_tcp = out["proto"] == PROTO_TCP
+        ctv = ct[idx]
+        out["status"] = np.where(
+            (ctv == CLOSE_FORCED_REPORT) | (ctv == CLOSE_FIN), 0,
+            np.where(ctv == CLOSE_TIMEOUT, np.where(proto_tcp, 3, 0),
+                     np.where(ctv == CLOSE_RST, 3, 2))).astype(np.uint32)
         # perf-engine window columns (rtt/srt/art/cit/zero-win/...);
         # the full-handshake rtt falls back to the SYN->SYN_ACK estimate
         # when the engine saw no handshake ACK (e.g. ack-less captures)

@@ -123,6 +123,11 @@ _L4_APP = (                       # ApplicationLayer :199
     ("l7_protocol", _U32),
 )
 
+_L4_INTERNET = (                  # Internet :~330 (geo, dict-hashed)
+    ("province_0", _U32),
+    ("province_1", _U32),
+)
+
 _L4_FLOWINFO = (                  # FlowInfo :363
     ("l3_epc_id_1", _I32),        # dst-side epc
     ("signal_source", _U32),
@@ -142,6 +147,15 @@ _L4_FLOWINFO = (                  # FlowInfo :363
     ("nat_real_ip_1", _U32),
     ("nat_real_port_0", _U32),
     ("nat_real_port_1", _U32),
+    ("nat_source", _U32),
+    # LogMessageStatus derived from close_type (l4_flow_log.go getStatus
+    # :857): 0 ok / 2 not-exist / 3 server-error (this framework's
+    # 4-value close enum has no client/server RST split, so RSTs land
+    # server-side — the common mid-session attribution)
+    ("status", _U32),
+    # reference: Array(UInt16) of PCAP policy ACL gids; columnar image
+    # is the FIRST gid (0 = none) — multi-policy hits keep the earliest
+    ("acl_gids", _U32),
 )
 
 _L4_METRICS = (                   # Metrics :466
@@ -180,6 +194,11 @@ _L4_METRICS = (                   # Metrics :466
     ("zero_win_rx", _U32),
     ("syn_count", _U32),
     ("synack_count", _U32),
+    # derived at ingest exactly like the reference (l4_flow_log.go:960):
+    # handshake repeats counted as retransmissions
+    ("retrans_syn", _U32),
+    ("retrans_synack", _U32),
+    ("l7_error", _U32),           # client + server errors (:926)
 )
 
 _L4_WIDE64 = (                    # true 64-bit identities, tail block
@@ -188,12 +207,20 @@ _L4_WIDE64 = (                    # true 64-bit identities, tail block
     ("flow_id", _U64),
     ("start_time_us", _U64),
     ("end_time_us", _U64),
+    # outer tunnel endpoint MACs (reference tunnel_tx_mac_0/1 + rx pairs
+    # carry each MAC as two u32 halves; one u64 column each here)
+    ("tunnel_tx_mac", _U64),
+    ("tunnel_rx_mac", _U64),
+    # row id stamped at ingest: time<<32 | analyzer<<22 | counter
+    # (l4_flow_log.go genID :1040)
+    ("_id", _U64),
 )
 
 L4_SCHEMA = Schema(
     name="l4_flow_log",
     columns=(_L4_CORE + _L4_DATALINK + _L4_NETWORK + _L4_TRANSPORT
-             + _L4_APP + _L4_FLOWINFO + _L4_METRICS + _L4_WIDE64),
+             + _L4_APP + _L4_INTERNET + _L4_FLOWINFO + _L4_METRICS
+             + _L4_WIDE64),
 )
 
 # The FlowSuite kernel input contract: exactly the columns the sketch
@@ -275,14 +302,34 @@ _L7_WIDE = (
     ("sql_affected_rows", _U32),
     ("direction_score", _U32),
     ("signal_source", _U32),
+    # l7_flow_log.go L7Base/L7FlowLog tail parity
+    ("nat_source", _U32),
+    ("tunnel_type", _U32),
+    ("span_kind", _U32),
+    ("trace_id_index", _U32),     # low bits of trace_id for joins
+    ("process_kname_0_hash", _U32),
+    ("process_kname_1_hash", _U32),
+    ("syscall_thread_0", _U32),
+    ("syscall_thread_1", _U32),
+    # dynamic attribute/metric arrays fold to one content hash per list
+    # (SmartEncoding: the dict holds the joined names/values strings)
+    ("attribute_names_hash", _U32),
+    ("attribute_values_hash", _U32),
+    ("metrics_names_hash", _U32),
+    ("metrics_values_hash", _U32),
 )
 
 _L7_WIDE64 = (
     ("syscall_trace_id_request", _U64),
     ("syscall_trace_id_response", _U64),
+    ("syscall_coroutine_0", _U64),
+    ("syscall_coroutine_1", _U64),
+    ("syscall_cap_seq_0", _U64),
+    ("syscall_cap_seq_1", _U64),
     ("flow_id", _U64),
     ("start_time_us", _U64),
     ("end_time_us", _U64),
+    ("_id", _U64),
 )
 
 L7_SCHEMA = Schema(

@@ -83,6 +83,20 @@ def _ip_u32(ip4: int, ip6: bytes) -> int:
     return (_fnv1a32(ip6) | 0xF0000000) if ip6 else _u32(ip4)
 
 
+def _l4_status(close_type: int, proto: int) -> int:
+    """LogMessageStatus from close type (l4_flow_log.go getStatus :857;
+    enum protocol_logs.go:58 — 0 OK, 2 not-exist, 3 server-error).
+    This framework's 4-value close enum has no client/server RST split,
+    so RSTs land server-side."""
+    if close_type in (0, 1):                  # forced report / FIN
+        return 0
+    if close_type == 3:                       # timeout
+        return 3 if proto == 6 else 0
+    if close_type == 2:                       # RST
+        return 3
+    return 2
+
+
 def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
     """Parse TaggedFlow records into L4_SCHEMA columns (all families)."""
     rows: List[tuple] = []
@@ -127,6 +141,8 @@ def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
             "last_keepalive_ack": f.last_keepalive_ack,
             # application
             "l7_protocol": f.perf_stats.l7_protocol,
+            # internet (geo enrichment, never on the wire)
+            "province_0": 0, "province_1": 0,
             # flow info
             "l3_epc_id_1": _u32(dst.l3_epc_id),
             "signal_source": f.signal_source,
@@ -141,6 +157,9 @@ def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
             "gprocess_id_0": src.gpid, "gprocess_id_1": dst.gpid,
             "nat_real_ip_0": src.real_ip, "nat_real_ip_1": dst.real_ip,
             "nat_real_port_0": src.real_port, "nat_real_port_1": dst.real_port,
+            "nat_source": 0,
+            "status": _l4_status(f.close_type, k.proto),
+            "acl_gids": f.acl_gids[0] if f.acl_gids else 0,
             # metrics
             "l3_byte_tx": _u32(src.l3_byte_count),
             "l3_byte_rx": _u32(dst.l3_byte_count),
@@ -170,11 +189,19 @@ def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
             "zero_win_tx": tcp.counts_peer_tx.zero_win_count,
             "zero_win_rx": tcp.counts_peer_rx.zero_win_count,
             "syn_count": tcp.syn_count, "synack_count": tcp.synack_count,
+            # handshake repeats count as retransmissions at ingest
+            # (reference l4_flow_log.go:960)
+            "retrans_syn": max(int(tcp.syn_count) - 1, 0),
+            "retrans_synack": max(int(tcp.synack_count) - 1, 0),
+            "l7_error": l7.err_client_count + l7.err_server_count,
             # u64 tail
             "mac_src": k.mac_src, "mac_dst": k.mac_dst,
             "flow_id": f.flow_id,
             "start_time_us": f.start_time // 1000,
             "end_time_us": f.end_time // 1000,
+            "tunnel_tx_mac": (tun.tx_mac0 << 32) | tun.tx_mac1,
+            "tunnel_rx_mac": (tun.rx_mac0 << 32) | tun.rx_mac1,
+            "_id": 0,   # stamped by the ingest pipeline (genID role)
         }
         rows.append(tuple(v[n] for n in _L4_NAMES))
     return _fill(L4_SCHEMA, rows)
@@ -250,12 +277,32 @@ def decode_l7_records(records: Iterable[bytes],
             "sql_affected_rows": m.row_effect,
             "direction_score": m.direction_score,
             "signal_source": SIGNAL_SOURCE_PACKET,
+            "nat_source": 0,
+            "tunnel_type": 0,
+            "span_kind": 0,      # OTel-sourced rows set this (span path)
+            # join key for trace fan-out queries: the trace id's content
+            # hash doubles as the reference's trace_id_index role
+            "trace_id_index": h(t.trace_id),
+            "process_kname_0_hash": h(b.process_kname_0),
+            "process_kname_1_hash": h(b.process_kname_1),
+            "syscall_thread_0": b.syscall_trace_id_thread_0,
+            "syscall_thread_1": b.syscall_trace_id_thread_1,
+            "attribute_names_hash": h(",".join(e.attribute_names)),
+            "attribute_values_hash": h(",".join(e.attribute_values)),
+            "metrics_names_hash": h(",".join(e.metrics_names)),
+            "metrics_values_hash": h(",".join(
+                f"{x:g}" for x in e.metrics_values)),
             # u64 tail
             "syscall_trace_id_request": b.syscall_trace_id_request,
             "syscall_trace_id_response": b.syscall_trace_id_response,
+            "syscall_coroutine_0": b.syscall_coroutine_0,
+            "syscall_coroutine_1": b.syscall_coroutine_1,
+            "syscall_cap_seq_0": b.syscall_cap_seq_0,
+            "syscall_cap_seq_1": b.syscall_cap_seq_1,
             "flow_id": b.flow_id,
             "start_time_us": b.start_time // 1000,
             "end_time_us": b.end_time // 1000,
+            "_id": 0,
         }
         rows.append(tuple(v[n] for n in _L7_NAMES))
     return _fill(L7_SCHEMA, rows)
@@ -331,9 +378,11 @@ def decode_otel_frames(payloads: Iterable[bytes],
                             _u32(span.start_time_unix_nano // _NS_PER_S),
                         "response_code": code,
                         "trace_id_hash": h(span.trace_id.hex()),
+                        "trace_id_index": h(span.trace_id.hex()),
                         "span_id_hash": h(span.span_id.hex()),
                         "parent_span_id_hash": h(span.parent_span_id.hex()),
                         "app_service_hash": h(service),
+                        "span_kind": span.kind,
                         "signal_source": SIGNAL_SOURCE_OTEL,
                         "start_time_us": span.start_time_unix_nano // 1000,
                         "end_time_us": span.end_time_unix_nano // 1000,

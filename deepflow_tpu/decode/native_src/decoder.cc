@@ -163,13 +163,15 @@ enum {
   COL_LAST_KEEPALIVE_SEQ, COL_LAST_KEEPALIVE_ACK,
   // application
   COL_L7_PROTOCOL,
+  // internet (geo enrichment; zero at decode)
+  COL_PROVINCE_0, COL_PROVINCE_1,
   // flow info
   COL_L3_EPC_ID_1, COL_SIGNAL_SOURCE, COL_TAP_TYPE, COL_TAP_PORT,
   COL_TAP_PORT_TYPE, COL_IS_NEW_FLOW, COL_IS_ACTIVE_SERVICE,
   COL_L2_END_0, COL_L2_END_1, COL_L3_END_0, COL_L3_END_1,
   COL_DIRECTION_SCORE, COL_GPROCESS_ID_0, COL_GPROCESS_ID_1,
   COL_NAT_REAL_IP_0, COL_NAT_REAL_IP_1, COL_NAT_REAL_PORT_0,
-  COL_NAT_REAL_PORT_1,
+  COL_NAT_REAL_PORT_1, COL_NAT_SOURCE, COL_STATUS, COL_ACL_GIDS,
   // metrics
   COL_L3_BYTE_TX, COL_L3_BYTE_RX, COL_L4_BYTE_TX, COL_L4_BYTE_RX,
   COL_TOTAL_BYTE_TX, COL_TOTAL_BYTE_RX, COL_TOTAL_PACKET_TX,
@@ -182,13 +184,15 @@ enum {
   COL_CIT_SUM, COL_CIT_COUNT, COL_CIT_MAX,
   COL_RETRANS_TX, COL_RETRANS_RX, COL_ZERO_WIN_TX, COL_ZERO_WIN_RX,
   COL_SYN_COUNT, COL_SYNACK_COUNT,
+  COL_RETRANS_SYN, COL_RETRANS_SYNACK, COL_L7_ERROR,
   N_COLS32
 };
 
 // u64 tail block indices
 enum {
   COL64_MAC_SRC = 0, COL64_MAC_DST, COL64_FLOW_ID, COL64_START_TIME_US,
-  COL64_END_TIME_US, N_COLS64
+  COL64_END_TIME_US, COL64_TUNNEL_TX_MAC, COL64_TUNNEL_RX_MAC,
+  COL64_ID, N_COLS64
 };
 
 struct Cursor {
@@ -385,6 +389,24 @@ bool parse_tunnel(Cursor c, Row* r) {
                r->v[COL_TUNNEL_RX_IP_0] = static_cast<uint32_t>(v); break;
       case 4:  if (!read_varint(c, &v)) return false;
                r->v[COL_TUNNEL_RX_IP_1] = static_cast<uint32_t>(v); break;
+      case 5:  if (!read_varint(c, &v)) return false;   // tx_mac0 (hi)
+               r->v64[COL64_TUNNEL_TX_MAC] =
+                   (r->v64[COL64_TUNNEL_TX_MAC] & 0xFFFFFFFFULL)
+                   | (v << 32); break;
+      case 6:  if (!read_varint(c, &v)) return false;   // tx_mac1 (lo)
+               r->v64[COL64_TUNNEL_TX_MAC] =
+                   (r->v64[COL64_TUNNEL_TX_MAC]
+                    & 0xFFFFFFFF00000000ULL) | (v & 0xFFFFFFFFULL);
+               break;
+      case 7:  if (!read_varint(c, &v)) return false;   // rx_mac0
+               r->v64[COL64_TUNNEL_RX_MAC] =
+                   (r->v64[COL64_TUNNEL_RX_MAC] & 0xFFFFFFFFULL)
+                   | (v << 32); break;
+      case 8:  if (!read_varint(c, &v)) return false;   // rx_mac1
+               r->v64[COL64_TUNNEL_RX_MAC] =
+                   (r->v64[COL64_TUNNEL_RX_MAC]
+                    & 0xFFFFFFFF00000000ULL) | (v & 0xFFFFFFFFULL);
+               break;
       case 9:  if (!read_varint(c, &v)) return false;
                r->v[COL_TUNNEL_TX_ID] = static_cast<uint32_t>(v); break;
       case 10: if (!read_varint(c, &v)) return false;
@@ -610,6 +632,23 @@ bool parse_flow(Cursor c, Row* r) {
         if (!read_varint(c, &v)) return false;
         r->v[COL_LAST_KEEPALIVE_ACK] = static_cast<uint32_t>(v);
         break;
+      case 24:                                           // acl_gids
+        // repeated uint32 (packed or not): columnar image keeps the
+        // FIRST gid (batch/schema.py acl_gids contract)
+        if (wt == 2) {
+          Cursor sub2;
+          if (!open_sub(c, &sub2)) return false;
+          if (sub2.p < sub2.end) {
+            if (!read_varint(sub2, &v)) return false;
+            if (r->v[COL_ACL_GIDS] == 0)
+              r->v[COL_ACL_GIDS] = static_cast<uint32_t>(v);
+          }
+        } else {
+          if (!read_varint(c, &v)) return false;
+          if (r->v[COL_ACL_GIDS] == 0)
+            r->v[COL_ACL_GIDS] = static_cast<uint32_t>(v);
+        }
+        break;
       case 25:                                           // direction_score
         if (!read_varint(c, &v)) return false;
         r->v[COL_DIRECTION_SCORE] = static_cast<uint32_t>(v);
@@ -619,6 +658,26 @@ bool parse_flow(Cursor c, Row* r) {
     }
   }
   return true;
+}
+
+// ingest-derived columns (reference fills these in TaggedFlowToL4FlowLog,
+// l4_flow_log.go:857-960): LogMessageStatus from close_type+proto,
+// handshake repeats as retransmissions, and the combined l7 error count
+inline void derive_l4(Row* r) {
+  uint32_t ct = r->v[COL_CLOSE_TYPE];
+  uint32_t proto = r->v[COL_PROTO];
+  uint32_t status;
+  if (ct == 0 || ct == 1) status = 0;                   // forced / FIN
+  else if (ct == 3) status = proto == 6 ? 3 : 0;        // timeout
+  else if (ct == 2) status = 3;                         // RST
+  else status = 2;
+  r->v[COL_STATUS] = status;
+  if (r->v[COL_SYN_COUNT] > 0)
+    r->v[COL_RETRANS_SYN] = r->v[COL_SYN_COUNT] - 1;
+  if (r->v[COL_SYNACK_COUNT] > 0)
+    r->v[COL_RETRANS_SYNACK] = r->v[COL_SYNACK_COUNT] - 1;
+  r->v[COL_L7_ERROR] =
+      r->v[COL_L7_CLIENT_ERROR] + r->v[COL_L7_SERVER_ERROR];
 }
 
 // Block-buffered column store. Writing one row straight into 93+5 planes
@@ -681,6 +740,7 @@ inline bool decode_record(const uint8_t* rec, uint32_t rec_len, Row* r) {
       return false;
     }
   }
+  if (ok) derive_l4(r);
   return ok;
 }
 

@@ -38,7 +38,15 @@ KG_DERIVED_FIELDS = (
     "epc_id", "service_id",
     "auto_instance_id", "auto_instance_type",
     "auto_service_id", "auto_service_type",
+    "tag_source",   # where the side's tags came from (TAG_SOURCE_*)
 )
+
+# tag_source values (reference: flow_tag TagSource bits — interface
+# table vs CIDR fallback vs nothing)
+TAG_SOURCE_NONE = 0
+TAG_SOURCE_INTERFACE = 1
+TAG_SOURCE_CIDR = 2
+TAG_SOURCE_WIRE = 3   # wire-carried values (eBPF ground truth) won
 AUTO_TYPE_NONE = 0
 AUTO_TYPE_POD = 1
 AUTO_TYPE_POD_NODE = 2
@@ -201,6 +209,11 @@ class PlatformInfoTable:
             miss &= ~hit
         self.hits += int(n - miss.sum())
         self.misses += int(miss.sum())
+        # provenance per row: interface hit > cidr hit > none
+        out["tag_source"] = np.where(
+            found, TAG_SOURCE_INTERFACE,
+            np.where(~miss, TAG_SOURCE_CIDR,
+                     TAG_SOURCE_NONE)).astype(np.uint32)
         return out
 
     def counters(self) -> dict:
@@ -292,11 +305,14 @@ class PlatformDataManager:
         values in `out` win (eBPF-sourced pod ids etc. are ground truth;
         reference: grpc_platformdata QueryEpcIDPodInfo precedence)."""
         kg = self.info.query(epc, ip)
+        wire_won = None
         for f in KG_FIELDS:
             name = f"{f}_{side}"
             if name in out:
                 have = out[name].astype(np.uint32, copy=False)
-                out[name] = np.where(have != 0, have, kg[f])
+                won = have != 0
+                wire_won = won if wire_won is None else (wire_won | won)
+                out[name] = np.where(won, have, kg[f])
             else:
                 out[name] = kg[f]
         svc = self.services.query(epc, ip, port, proto)
@@ -320,6 +336,13 @@ class PlatformDataManager:
             svc != 0, svc, inst_id).astype(np.uint32)
         out[f"auto_service_type_{side}"] = np.where(
             svc != 0, AUTO_TYPE_SERVICE, inst_ty).astype(np.uint32)
+        # provenance: wire-carried (eBPF) values that won precedence
+        # outrank the table lookups they overrode
+        src = kg["tag_source"]
+        if wire_won is not None:
+            src = np.where(wire_won, TAG_SOURCE_WIRE, src).astype(
+                np.uint32)
+        out[f"tag_source_{side}"] = src
 
     def stamp_l4(self, cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Add KnowledgeGraph columns for both sides of an L4 batch, plus

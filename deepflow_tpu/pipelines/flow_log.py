@@ -29,6 +29,30 @@ from deepflow_tpu.store.writer import StoreWriter
 from deepflow_tpu.wire.codec import iter_pb_records
 from deepflow_tpu.wire.framing import Frame, MessageType
 
+# row-id generator (reference: l4_flow_log.go genID :1040 —
+# time<<32 | analyzer<<22 | counter, the counter a process-wide atomic).
+# The GIL makes the locked window tiny; ids are unique per process.
+_ID_LOCK = threading.Lock()
+_ID_NEXT = [1]
+
+
+def stamp_row_ids(cols: Dict[str, np.ndarray],
+                  analyzer_id: int = 0) -> Dict[str, np.ndarray]:
+    """Fill the `_id` column in-place for rows that lack one."""
+    ids = cols.get("_id")
+    n = 0 if ids is None else len(ids)
+    if n == 0:
+        return cols
+    with _ID_LOCK:
+        start = _ID_NEXT[0]
+        _ID_NEXT[0] += n
+    count = (np.arange(start, start + n, dtype=np.uint64)
+             & np.uint64(0x3FFFFF))
+    ts = cols["timestamp"].astype(np.uint64)
+    cols["_id"] = (ts << np.uint64(32)) \
+        | np.uint64((analyzer_id & 0x3FF) << 22) | count
+    return cols
+
 FLOW_LOG_DB = "flow_log"
 
 
@@ -148,7 +172,7 @@ class FlowLogPipeline:
                  n_decoders: int = 2, queue_size: int = 16384,
                  throttle_per_s: int = 50_000,
                  stats: Optional[StatsRegistry] = None,
-                 tag_dicts=None) -> None:
+                 tag_dicts=None, analyzer_id: int = 0) -> None:
         self.decoders: List[_Decoder] = []
         self.writers: List[StoreWriter] = []
         self._streams = []
@@ -159,11 +183,15 @@ class FlowLogPipeline:
             return columnar.decode_l7_records(records,
                                               endpoint_dict=endpoint_dict)
 
+        def _with_ids(enrich):
+            return lambda cols: stamp_row_ids(enrich(cols),
+                                              analyzer_id=analyzer_id)
+
         for stream, msg_type, table_schema, decode_fn, enrich_fn in (
             ("l4_flow_log", MessageType.TAGGEDFLOW, L4_TABLE,
-             columnar.decode_l4_records, platform.stamp_l4),
+             columnar.decode_l4_records, _with_ids(platform.stamp_l4)),
             ("l7_flow_log", MessageType.PROTOCOLLOG, L7_TABLE,
-             decode_l7, platform.stamp_l7),
+             decode_l7, _with_ids(platform.stamp_l7)),
         ):
             queues = MultiQueue(f"ingest.{stream}", n_decoders, queue_size)
             receiver.register_handler(msg_type, queues)
@@ -240,7 +268,7 @@ class FlowLogPipeline:
         # rows (reference: decoder.go ProtoLogToL7FlowLog for both sources)
         otel_decoder = _Decoder(
             "l7_flow_log.otel", 0, otel_queues, _decode_otel,
-            platform.stamp_l7,
+            _with_ids(platform.stamp_l7),
             # the l7 write budget is shared with the PROTOCOLLOG decoders
             # (all feed the same table), so every consumer gets an equal
             # slice of the configured cap

@@ -51,6 +51,10 @@ class IngesterConfig:
     # > 0: surface app_red's DDSketch windows as Prometheus `le` bucket
     # counters (every Nth gamma boundary) so histogram_quantile works
     app_red_prom_buckets: int = 0
+    # this ingester's id inside a multi-analyzer deployment: the 10
+    # analyzer bits of every row _id (l4_flow_log.go genID) — distinct
+    # per process or ids collide across ingesters
+    analyzer_id: int = 0
 
 
 class Ingester:
@@ -94,7 +98,7 @@ class Ingester:
             self.receiver, self.store, self.platform, self.exporters,
             n_decoders=cfg.n_decoders, queue_size=cfg.queue_size,
             throttle_per_s=cfg.throttle_per_s, stats=self.stats,
-            tag_dicts=self.tag_dicts)
+            tag_dicts=self.tag_dicts, analyzer_id=cfg.analyzer_id)
         self.flow_metrics = FlowMetricsPipeline(
             self.receiver, self.store, self.exporters,
             n_unmarshallers=cfg.n_decoders, queue_size=cfg.queue_size,

@@ -102,3 +102,52 @@ def test_v6_fold_agrees_across_paths():
         names32 = [n for n, _ in native.L4_COLS32]
         assert buf32[names32.index("ip_src"), 0] == fold_ipv6(src16)
         assert buf32[names32.index("ip_dst"), 0] == fold_ipv6(dst16)
+
+
+def test_round3_column_goldens():
+    """New round-3 columns: tunnel MACs, acl_gids, derived status /
+    retrans_syn[ack] / l7_error — exact values through BOTH decoders
+    (the reference derivations: l4_flow_log.go :857 getStatus, :960
+    handshake retrans, :926 l7_error)."""
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    def rec(close_type, proto, syn=0, synack=0, gids=(),
+            cli_err=0, srv_err=0):
+        m = flow_log_pb2.TaggedFlow()
+        f = m.flow
+        f.flow_key.proto = proto
+        f.flow_key.ip_src = 1
+        f.flow_key.ip_dst = 2
+        f.close_type = close_type
+        f.start_time = 1_000_000_000
+        f.end_time = 2_000_000_000
+        t = f.tunnel
+        t.tx_mac0, t.tx_mac1 = 0x0000AABB, 0xCCDDEEFF
+        t.rx_mac0, t.rx_mac1 = 0x00001122, 0x33445566
+        f.acl_gids.extend(gids)
+        if syn or synack or cli_err or srv_err:
+            f.has_perf_stats = 1
+            f.perf_stats.tcp.syn_count = syn
+            f.perf_stats.tcp.synack_count = synack
+            f.perf_stats.l7.err_client_count = cli_err
+            f.perf_stats.l7.err_server_count = srv_err
+        return m.SerializeToString()
+
+    records = [
+        rec(1, 6, syn=3, synack=2, gids=(7, 9)),   # FIN -> status 0
+        rec(3, 6),                                 # TCP timeout -> 3
+        rec(3, 17),                                # UDP timeout -> 0
+        rec(2, 6, cli_err=2, srv_err=5),           # RST -> 3
+    ]
+    want = columnar.decode_l4_records(records)
+    got, bad = native.decode_l4_payload(pack_pb_records(records))
+    assert bad == 0
+    for name in want:
+        np.testing.assert_array_equal(got[name], want[name], err_msg=name)
+    assert got["status"].tolist() == [0, 3, 0, 3]
+    assert got["retrans_syn"].tolist() == [2, 0, 0, 0]
+    assert got["retrans_synack"].tolist() == [1, 0, 0, 0]
+    assert got["acl_gids"].tolist() == [7, 0, 0, 0]
+    assert got["l7_error"].tolist() == [0, 0, 0, 7]
+    assert got["tunnel_tx_mac"].tolist() == [0x0000AABBCCDDEEFF] * 4
+    assert got["tunnel_rx_mac"].tolist() == [0x0000112233445566] * 4
