@@ -15,6 +15,7 @@ protocols (TLS, Dubbo, AMQP, OpenWire) check before the heuristic ones.
 
 from __future__ import annotations
 
+from collections import OrderedDict, deque
 import re
 import struct
 from typing import ClassVar, Dict, List, Optional, Tuple
@@ -106,47 +107,88 @@ class TlsParser:
 # HTTP/2 + gRPC (reference: protocol_logs/http.rs:503 + plugins/http2)
 # ---------------------------------------------------------------------------
 
-# RFC 7541 Appendix B Huffman codes for the symbols that appear in header
-# values (subset: ASCII printable + the common controls). Unknown longer
-# codes abort the decode — the caller falls back to a hex placeholder
-# rather than mis-decoding.
-_HUFF_CODES: Tuple[Tuple[int, int, int], ...] = (
-    (48, 0x0, 5), (49, 0x1, 5), (50, 0x2, 5), (97, 0x3, 5), (99, 0x4, 5),
-    (101, 0x5, 5), (105, 0x6, 5), (111, 0x7, 5), (115, 0x8, 5),
-    (116, 0x9, 5),
-    (32, 0x14, 6), (37, 0x15, 6), (45, 0x16, 6), (46, 0x17, 6),
-    (47, 0x18, 6), (51, 0x19, 6), (52, 0x1a, 6), (53, 0x1b, 6),
-    (54, 0x1c, 6), (55, 0x1d, 6), (56, 0x1e, 6), (57, 0x1f, 6),
-    (61, 0x20, 6), (65, 0x21, 6), (95, 0x22, 6), (98, 0x23, 6),
-    (100, 0x24, 6), (102, 0x25, 6), (103, 0x26, 6), (104, 0x27, 6),
-    (108, 0x28, 6), (109, 0x29, 6), (110, 0x2a, 6), (112, 0x2b, 6),
-    (114, 0x2c, 6), (117, 0x2d, 6),
-    (58, 0x5c, 7), (66, 0x5d, 7), (67, 0x5e, 7), (68, 0x5f, 7),
-    (69, 0x60, 7), (70, 0x61, 7), (71, 0x62, 7), (72, 0x63, 7),
-    (73, 0x64, 7), (74, 0x65, 7), (75, 0x66, 7), (76, 0x67, 7),
-    (77, 0x68, 7), (78, 0x69, 7), (79, 0x6a, 7), (80, 0x6b, 7),
-    (81, 0x6c, 7), (82, 0x6d, 7), (83, 0x6e, 7), (84, 0x6f, 7),
-    (85, 0x70, 7), (86, 0x71, 7), (87, 0x72, 7), (89, 0x73, 7),
-    (106, 0x74, 7), (107, 0x75, 7), (113, 0x76, 7), (118, 0x77, 7),
-    (119, 0x78, 7), (120, 0x79, 7), (121, 0x7a, 7), (122, 0x7b, 7),
-    (38, 0xf8, 8), (42, 0xf9, 8), (44, 0xfa, 8), (59, 0xfb, 8),
-    (88, 0xfc, 8), (90, 0xfd, 8),
-    (33, 0x3f8, 10), (34, 0x3f9, 10), (40, 0x3fa, 10), (41, 0x3fb, 10),
-    (63, 0x3fc, 10),
-    (39, 0x7fa, 11), (43, 0x7fb, 11), (124, 0x7fc, 11),
-    (35, 0xffa, 12), (62, 0xffb, 12),
-    (0, 0x1ff8, 13), (36, 0x1ff9, 13), (64, 0x1ffa, 13), (91, 0x1ffb, 13),
-    (93, 0x1ffc, 13), (126, 0x1ffd, 13),
-    (94, 0x3ffc, 14), (125, 0x3ffd, 14),
-    (60, 0x7ffc, 15), (96, 0x7ffd, 15), (123, 0x7ffe, 15),
+# RFC 7541 Appendix B: the COMPLETE Huffman code table — (code, bits)
+# for every byte 0..255 plus EOS(256). Spec constants, verified against
+# the RFC Appendix C.4 test vectors (tests/test_l7_ext.py); with the
+# full table no header value ever falls back to a hex placeholder.
+_HUFF_TABLE = (
+    (0x1ff8, 13), (0x7fffd8, 23), (0xfffffe2, 28), (0xfffffe3, 28),
+    (0xfffffe4, 28), (0xfffffe5, 28), (0xfffffe6, 28), (0xfffffe7, 28),
+    (0xfffffe8, 28), (0xffffea, 24), (0x3ffffffc, 30), (0xfffffe9, 28),
+    (0xfffffea, 28), (0x3ffffffd, 30), (0xfffffeb, 28), (0xfffffec, 28),
+    (0xfffffed, 28), (0xfffffee, 28), (0xfffffef, 28), (0xffffff0, 28),
+    (0xffffff1, 28), (0xffffff2, 28), (0x3ffffffe, 30), (0xffffff3, 28),
+    (0xffffff4, 28), (0xffffff5, 28), (0xffffff6, 28), (0xffffff7, 28),
+    (0xffffff8, 28), (0xffffff9, 28), (0xffffffa, 28), (0xffffffb, 28),
+    (0x14, 6), (0x3f8, 10), (0x3f9, 10), (0xffa, 12),
+    (0x1ff9, 13), (0x15, 6), (0xf8, 8), (0x7fa, 11),
+    (0x3fa, 10), (0x3fb, 10), (0xf9, 8), (0x7fb, 11),
+    (0xfa, 8), (0x16, 6), (0x17, 6), (0x18, 6),
+    (0x0, 5), (0x1, 5), (0x2, 5), (0x19, 6),
+    (0x1a, 6), (0x1b, 6), (0x1c, 6), (0x1d, 6),
+    (0x1e, 6), (0x1f, 6), (0x5c, 7), (0xfb, 8),
+    (0x7ffc, 15), (0x20, 6), (0xffb, 12), (0x3fc, 10),
+    (0x1ffa, 13), (0x21, 6), (0x5d, 7), (0x5e, 7),
+    (0x5f, 7), (0x60, 7), (0x61, 7), (0x62, 7),
+    (0x63, 7), (0x64, 7), (0x65, 7), (0x66, 7),
+    (0x67, 7), (0x68, 7), (0x69, 7), (0x6a, 7),
+    (0x6b, 7), (0x6c, 7), (0x6d, 7), (0x6e, 7),
+    (0x6f, 7), (0x70, 7), (0x71, 7), (0x72, 7),
+    (0xfc, 8), (0x73, 7), (0xfd, 8), (0x1ffb, 13),
+    (0x7fff0, 19), (0x1ffc, 13), (0x3ffc, 14), (0x22, 6),
+    (0x7ffd, 15), (0x3, 5), (0x23, 6), (0x4, 5),
+    (0x24, 6), (0x5, 5), (0x25, 6), (0x26, 6),
+    (0x27, 6), (0x6, 5), (0x74, 7), (0x75, 7),
+    (0x28, 6), (0x29, 6), (0x2a, 6), (0x7, 5),
+    (0x2b, 6), (0x76, 7), (0x2c, 6), (0x8, 5),
+    (0x9, 5), (0x2d, 6), (0x77, 7), (0x78, 7),
+    (0x79, 7), (0x7a, 7), (0x7b, 7), (0x7ffe, 15),
+    (0x7fc, 11), (0x3ffd, 14), (0x1ffd, 13), (0xffffffc, 28),
+    (0xfffe6, 20), (0x3fffd2, 22), (0xfffe7, 20), (0xfffe8, 20),
+    (0x3fffd3, 22), (0x3fffd4, 22), (0x3fffd5, 22), (0x7fffd9, 23),
+    (0x3fffd6, 22), (0x7fffda, 23), (0x7fffdb, 23), (0x7fffdc, 23),
+    (0x7fffdd, 23), (0x7fffde, 23), (0xffffeb, 24), (0x7fffdf, 23),
+    (0xffffec, 24), (0xffffed, 24), (0x3fffd7, 22), (0x7fffe0, 23),
+    (0xffffee, 24), (0x7fffe1, 23), (0x7fffe2, 23), (0x7fffe3, 23),
+    (0x7fffe4, 23), (0x1fffdc, 21), (0x3fffd8, 22), (0x7fffe5, 23),
+    (0x3fffd9, 22), (0x7fffe6, 23), (0x7fffe7, 23), (0xffffef, 24),
+    (0x3fffda, 22), (0x1fffdd, 21), (0xfffe9, 20), (0x3fffdb, 22),
+    (0x3fffdc, 22), (0x7fffe8, 23), (0x7fffe9, 23), (0x1fffde, 21),
+    (0x7fffea, 23), (0x3fffdd, 22), (0x3fffde, 22), (0xfffff0, 24),
+    (0x1fffdf, 21), (0x3fffdf, 22), (0x7fffeb, 23), (0x7fffec, 23),
+    (0x1fffe0, 21), (0x1fffe1, 21), (0x3fffe0, 22), (0x1fffe2, 21),
+    (0x7fffed, 23), (0x3fffe1, 22), (0x7fffee, 23), (0x7fffef, 23),
+    (0xfffea, 20), (0x3fffe2, 22), (0x3fffe3, 22), (0x3fffe4, 22),
+    (0x7ffff0, 23), (0x3fffe5, 22), (0x3fffe6, 22), (0x7ffff1, 23),
+    (0x3ffffe0, 26), (0x3ffffe1, 26), (0xfffeb, 20), (0x7fff1, 19),
+    (0x3fffe7, 22), (0x7ffff2, 23), (0x3fffe8, 22), (0x1ffffec, 25),
+    (0x3ffffe2, 26), (0x3ffffe3, 26), (0x3ffffe4, 26), (0x7ffffde, 27),
+    (0x7ffffdf, 27), (0x3ffffe5, 26), (0xfffff1, 24), (0x1ffffed, 25),
+    (0x7fff2, 19), (0x1fffe3, 21), (0x3ffffe6, 26), (0x7ffffe0, 27),
+    (0x7ffffe1, 27), (0x3ffffe7, 26), (0x7ffffe2, 27), (0xfffff2, 24),
+    (0x1fffe4, 21), (0x1fffe5, 21), (0x3ffffe8, 26), (0x3ffffe9, 26),
+    (0xffffffd, 28), (0x7ffffe3, 27), (0x7ffffe4, 27), (0x7ffffe5, 27),
+    (0xfffec, 20), (0xfffff3, 24), (0xfffed, 20), (0x1fffe6, 21),
+    (0x3fffe9, 22), (0x1fffe7, 21), (0x1fffe8, 21), (0x7ffff3, 23),
+    (0x3fffea, 22), (0x3fffeb, 22), (0x1ffffee, 25), (0x1ffffef, 25),
+    (0xfffff4, 24), (0xfffff5, 24), (0x3ffffea, 26), (0x7ffff4, 23),
+    (0x3ffffeb, 26), (0x7ffffe6, 27), (0x3ffffec, 26), (0x3ffffed, 26),
+    (0x7ffffe7, 27), (0x7ffffe8, 27), (0x7ffffe9, 27), (0x7ffffea, 27),
+    (0x7ffffeb, 27), (0xffffffe, 28), (0x7ffffec, 27), (0x7ffffed, 27),
+    (0x7ffffee, 27), (0x7ffffef, 27), (0x7fffff0, 27), (0x3ffffee, 26),
+    (0x3fffffff, 30),
 )
+
 _HUFF_BY_LEN: Dict[int, Dict[int, int]] = {}
-for _sym, _code, _bits in _HUFF_CODES:
+for _sym, (_code, _bits) in enumerate(_HUFF_TABLE):
     _HUFF_BY_LEN.setdefault(_bits, {})[_code] = _sym
+_HUFF_LENS = tuple(sorted(_HUFF_BY_LEN))
+_EOS = 256
 
 
 def huffman_decode(data: bytes) -> Optional[str]:
-    """HPACK Huffman string decode; None when an unknown code appears."""
+    """HPACK Huffman string decode (RFC 7541 §5.2); None on EOS in the
+    stream or non-ones padding — both are coding errors."""
     out = []
     acc = 0
     nbits = 0
@@ -155,10 +197,14 @@ def huffman_decode(data: bytes) -> Optional[str]:
         nbits += 8
         while nbits >= 5:
             matched = False
-            for ln in range(5, min(nbits, 15) + 1):
+            for ln in _HUFF_LENS:
+                if ln > nbits:
+                    break
                 code = (acc >> (nbits - ln)) & ((1 << ln) - 1)
-                sym = _HUFF_BY_LEN.get(ln, {}).get(code)
+                sym = _HUFF_BY_LEN[ln].get(code)
                 if sym is not None:
+                    if sym == _EOS:       # explicit EOS is an error
+                        return None
                     out.append(chr(sym))
                     nbits -= ln
                     acc &= (1 << nbits) - 1
@@ -166,7 +212,7 @@ def huffman_decode(data: bytes) -> Optional[str]:
                     break
             if not matched:
                 break
-    # trailing bits must be all-ones padding (EOS prefix)
+    # trailing bits must be all-ones padding (EOS prefix), < 8 of them
     if nbits > 7 or (nbits and (acc & ((1 << nbits) - 1))
                      != (1 << nbits) - 1):
         return None
@@ -235,54 +281,124 @@ def _hpack_str(data: bytes, off: int) -> Tuple[str, int]:
     return raw.decode("latin-1", "replace"), off
 
 
-def hpack_headers(block: bytes, max_headers: int = 64) -> List[Tuple[str, str]]:
-    """Decode an HPACK header block using the static table only.
+class HpackDecoder:
+    """RFC 7541-complete HPACK decoder: static table + a real dynamic
+    table with size-based eviction (§4.2; entry cost name+value+32).
+    One instance per connection DIRECTION — HPACK state is per sender.
+    The reference's http2 plugin carries equivalent per-session table
+    state (agent/plugins/http2)."""
 
-    Dynamic-table references decode as ("", "") placeholders — a
-    stateless per-frame parser can't track peer table state, and the
-    pseudo-headers this parser needs (:method/:path/:status) are almost
-    always emitted as static refs or literals on stream open (the
-    reference's HPACK plugin makes the same simplification for
-    uni-directional captures)."""
-    out: List[Tuple[str, str]] = []
-    off = 0
-    try:
-        while off < len(block) and len(out) < max_headers:
-            b = block[off]
-            if b & 0x80:                          # indexed field
-                idx, off = _hpack_int(block, off, 7)
-                out.append(_HPACK_STATIC.get(idx, ("", "")))
-            elif b & 0x40:                        # literal, incremental idx
-                idx, off = _hpack_int(block, off, 6)
-                name = _HPACK_STATIC.get(idx, ("", ""))[0] if idx else ""
-                if not idx or not name:
-                    name, off = _hpack_str(block, off)
-                val, off = _hpack_str(block, off)
-                out.append((name, val))
-            elif b & 0x20:                        # dynamic table size upd
-                _, off = _hpack_int(block, off, 5)
-            else:                                 # literal, no indexing
-                idx, off = _hpack_int(block, off, 4)
-                name = _HPACK_STATIC.get(idx, ("", ""))[0] if idx else ""
-                if not idx or not name:
-                    name, off = _hpack_str(block, off)
-                val, off = _hpack_str(block, off)
-                out.append((name, val))
-    except (IndexError, struct.error):
-        pass
-    return out
+    _HARD_MAX = 1 << 16
+
+    def __init__(self, max_size: int = 4096) -> None:
+        self._dyn: "deque[Tuple[str, str]]" = deque()
+        self._size = 0
+        self._max = max_size
+
+    def _entry(self, idx: int) -> Tuple[str, str]:
+        if idx in _HPACK_STATIC:
+            return _HPACK_STATIC[idx]
+        d = idx - 62
+        if 0 <= d < len(self._dyn):
+            return self._dyn[d]          # newest-first (§2.3.2)
+        return ("", "")
+
+    def _add(self, name: str, val: str) -> None:
+        self._dyn.appendleft((name, val))
+        self._size += len(name) + len(val) + 32
+        self._evict()
+
+    def _evict(self) -> None:
+        while self._size > self._max and self._dyn:
+            n, v = self._dyn.pop()
+            self._size -= len(n) + len(v) + 32
+
+    def decode(self, block: bytes,
+               max_headers: int = 64) -> List[Tuple[str, str]]:
+        """Decode one header block. The WHOLE block is always consumed —
+        a stateful decoder that stopped early (header cap) would let its
+        dynamic table silently diverge from the sender's; past the cap,
+        fields still process for their table side effects and are just
+        not reported. A name index pointing at a missing dynamic entry
+        (evicted here / lost packet) keeps WIRE SYNC: only the value
+        string follows on the wire, so only the value is read and the
+        name stays empty — never re-interpret the value as a name."""
+        out: List[Tuple[str, str]] = []
+        off = 0
+        try:
+            while off < len(block):
+                b = block[off]
+                if b & 0x80:                      # indexed field
+                    idx, off = _hpack_int(block, off, 7)
+                    if len(out) < max_headers:
+                        out.append(self._entry(idx))
+                elif b & 0x40:                    # literal, incremental idx
+                    idx, off = _hpack_int(block, off, 6)
+                    if idx:
+                        name = self._entry(idx)[0]
+                    else:
+                        name, off = _hpack_str(block, off)
+                    val, off = _hpack_str(block, off)
+                    self._add(name, val)
+                    if len(out) < max_headers:
+                        out.append((name, val))
+                elif b & 0x20:                    # dynamic table size upd
+                    sz, off = _hpack_int(block, off, 5)
+                    self._max = min(sz, self._HARD_MAX)
+                    self._evict()
+                else:                             # literal, no indexing
+                    idx, off = _hpack_int(block, off, 4)
+                    if idx:
+                        name = self._entry(idx)[0]
+                    else:
+                        name, off = _hpack_str(block, off)
+                    val, off = _hpack_str(block, off)
+                    if len(out) < max_headers:
+                        out.append((name, val))
+        except (IndexError, struct.error):
+            pass
+        return out
+
+
+def hpack_headers(block: bytes, max_headers: int = 64) -> List[Tuple[str, str]]:
+    """Stateless HPACK decode: a fresh table per block. Incremental
+    entries still resolve WITHIN the block; cross-frame references need
+    the per-connection decoder (Http2Parser keeps one per direction)."""
+    return HpackDecoder().decode(block, max_headers)
 
 
 class Http2Parser:
-    """HTTP/2 frames; HEADERS blocks decode via HPACK. gRPC calls
-    (content-type application/grpc*) report as L7Protocol.Grpc like the
-    reference."""
+    """HTTP/2 frames; HEADERS blocks decode via HPACK with a REAL
+    per-connection-direction dynamic table (LRU of HpackDecoders keyed
+    by the dispatch 4-tuple — cross-packet indexed references resolve).
+    gRPC calls (content-type application/grpc*) report as
+    L7Protocol.Grpc like the reference."""
 
     proto: ClassVar[int] = L7_HTTP2
+    wants_ctx: ClassVar[bool] = True
 
     _FRAME_HEADERS = 0x1
+    _MAX_CONNS = 512
 
-    def check(self, payload: bytes) -> bool:
+    def __init__(self) -> None:
+        self._conns: "OrderedDict[tuple, HpackDecoder]" = OrderedDict()
+
+    def _decoder(self, key) -> HpackDecoder:
+        if key is None:
+            return HpackDecoder()        # ctx-less callers: stateless
+        d = self._conns.get(key)
+        if d is None:
+            d = HpackDecoder()
+            self._conns[key] = d
+            while len(self._conns) > self._MAX_CONNS:
+                self._conns.popitem(last=False)
+        else:
+            self._conns.move_to_end(key)
+        return d
+
+    def check(self, payload: bytes, proto=None, port_src: int = 0,
+              port_dst: int = 0, ts_ns: int = 0, ip_src: int = 0,
+              ip_dst: int = 0, ip_version: int = 4) -> bool:
         if payload.startswith(_H2_PREFACE):
             return True
         if len(payload) < 9:
@@ -293,10 +409,22 @@ class Http2Parser:
         return ftype in (0x1, 0x4, 0x8) and ln <= 1 << 14 and \
             9 + ln <= len(payload) + (1 << 14)
 
-    def parse(self, payload: bytes) -> Optional[L7Record]:
+    def parse(self, payload: bytes, proto=None, port_src: int = 0,
+              port_dst: int = 0, ts_ns: int = 0, ip_src: int = 0,
+              ip_dst: int = 0,
+              ip_version: int = 4) -> Optional[L7Record]:
+        # direction-scoped HPACK state: the sender's table
+        key = ((ip_src, ip_dst, port_src, port_dst)
+               if (ip_src or ip_dst or port_src or port_dst) else None)
+        dec = self._decoder(key)
         off = 0
         if payload.startswith(_H2_PREFACE):
             off = len(_H2_PREFACE)
+        # EVERY headers frame in the payload must be decoded — returning
+        # at the first record would skip later frames' incremental-index
+        # entries and silently desync the connection's dynamic table
+        # from the sender's; the first record found is reported.
+        rec: Optional[L7Record] = None
         while off + 9 <= len(payload):
             ln = int.from_bytes(payload[off:off + 3], "big")
             ftype = payload[off + 3]
@@ -309,23 +437,26 @@ class Http2Parser:
                 body = body[1:len(body) - body[0]] if body else body
             if flags & 0x20:                       # PRIORITY
                 body = body[5:]
-            hdrs = dict(hpack_headers(body))
+            hdrs = dict(dec.decode(body))
+            if rec is not None:
+                continue                           # state only
             status = hdrs.get(":status")
             if status is not None:
                 code = int(status) if status.isdigit() else 0
-                return L7Record(self.proto, MSG_RESPONSE, status=code,
-                                resp_len=len(payload))
+                rec = L7Record(self.proto, MSG_RESPONSE, status=code,
+                               resp_len=len(payload))
+                continue
             method = hdrs.get(":method")
             if method is not None:
                 path = hdrs.get(":path", "").split("?", 1)[0]
-                proto = self.proto
+                proto_ = self.proto
                 if hdrs.get("content-type", "").startswith(
                         "application/grpc"):
-                    proto = L7_GRPC
-                return L7Record(proto, MSG_REQUEST,
-                                endpoint=f"{method} {path}",
-                                req_len=len(payload))
-        return None
+                    proto_ = L7_GRPC
+                rec = L7Record(proto_, MSG_REQUEST,
+                               endpoint=f"{method} {path}",
+                               req_len=len(payload))
+        return rec
 
 
 # ---------------------------------------------------------------------------

@@ -419,3 +419,72 @@ def test_random_bytes_do_not_crash():
         blob = rng.integers(0, 256, rng.integers(1, 300)).astype(
             np.uint8).tobytes()
         parse_payload(blob, proto=6, port_src=1234, port_dst=5678)
+
+
+def test_huffman_full_table_rare_symbols():
+    """Round-3: the COMPLETE RFC 7541 table — header values with rare
+    symbols (uppercase URLs, base64 ids with + / =) decode instead of
+    falling back to hex placeholders."""
+    from deepflow_tpu.agent.l7_ext import _HUFF_TABLE
+
+    def encode(s: str) -> bytes:
+        acc, nbits = 0, 0
+        for ch in s.encode("latin-1"):
+            code, ln = _HUFF_TABLE[ch]
+            acc = (acc << ln) | code
+            nbits += ln
+        pad = (8 - nbits % 8) % 8
+        acc = (acc << pad) | ((1 << pad) - 1)
+        return int.to_bytes(acc, (nbits + pad) // 8, "big")
+
+    for s in ("/API/V2/Users?id=AbC+9/zZ==",
+              "Mozilla/5.0 (X11; Linux x86_64) \"quoted\"",
+              "\x00\x7f\xff high+low bytes \xe4\xb8\xad"):
+        # latin-1 round trip: the table covers all 256 byte values
+        raw = s.encode("latin-1", "replace").decode("latin-1")
+        assert huffman_decode(encode(raw)) == raw, s
+
+
+def test_hpack_dynamic_table_cross_frame():
+    """Incremental-indexing entries persist across HEADERS frames on the
+    same connection direction (RFC 7541 §2.3.2): frame 1 adds a literal,
+    frame 2 references it by dynamic index 62."""
+    from deepflow_tpu.agent.l7_ext import Http2Parser
+
+    def h2_frame(block: bytes) -> bytes:
+        return len(block).to_bytes(3, "big") + b"\x01\x04" + \
+            b"\x00\x00\x00\x01" + block
+
+    p = Http2Parser()
+    ctx = dict(proto=6, port_src=5000, port_dst=80, ts_ns=0,
+               ip_src=0x0A000001, ip_dst=0x0A000002)
+    # frame 1: :method GET (static 2) + literal-with-indexing
+    # :path /svc/a (name from static 4, value literal)
+    blk1 = bytes([0x82]) + bytes([0x44]) + bytes([0x06]) + b"/svc/a"
+    rec1 = p.parse(h2_frame(blk1), **ctx)
+    assert rec1 is not None and rec1.endpoint == "GET /svc/a"
+    # frame 2 (same direction): :method GET + dynamic index 62
+    blk2 = bytes([0x82]) + bytes([0x80 | 62])
+    rec2 = p.parse(h2_frame(blk2), **ctx)
+    assert rec2 is not None and rec2.endpoint == "GET /svc/a"
+    # a DIFFERENT connection must NOT see that table entry
+    other = dict(ctx, port_src=6000)
+    rec3 = p.parse(h2_frame(blk2), **other)
+    assert rec3 is None or rec3.endpoint != "GET /svc/a"
+
+
+def test_hpack_dynamic_table_eviction():
+    """Entries evict at the size bound (name+value+32 each) and a
+    dynamic table size update shrinks the bound."""
+    from deepflow_tpu.agent.l7_ext import HpackDecoder
+    d = HpackDecoder(max_size=100)
+    d.decode(bytes([0x40, 0x03]) + b"aaa" + bytes([0x03]) + b"AAA")
+    d.decode(bytes([0x40, 0x03]) + b"bbb" + bytes([0x03]) + b"BBB")
+    d.decode(bytes([0x40, 0x03]) + b"ccc" + bytes([0x03]) + b"CCC")
+    # 3 * (3+3+32) = 114 > 100 -> the oldest ('aaa') is gone
+    assert d._entry(62) == ("ccc", "CCC")
+    assert d._entry(63) == ("bbb", "BBB")
+    assert d._entry(64) == ("", "")
+    # size update to 0 flushes everything
+    d.decode(bytes([0x20]))
+    assert d._entry(62) == ("", "")
