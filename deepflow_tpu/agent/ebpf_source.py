@@ -1,0 +1,206 @@
+"""eBPF-output front end: syscall-record stream -> l7 wire records.
+
+The reference's defining datapath is a kernel eBPF program
+(agent/src/ebpf/kernel/socket_trace.c) whose output records — socket
+read/write syscalls with thread identity, TCP seq at capture, per-socket
+capture sequence, and a thread-session trace id — make syscall-level L7
+logs joinable with packet captures and with EACH OTHER across services.
+The kernel side cannot run in this container; this module implements the
+USERSPACE semantics that make that data usable, fixture/replay-driven:
+
+- the thread-session trace-id state machine (socket_trace.c:960-1060):
+  * INGRESS data on a thread assigns a fresh trace id (or continues the
+    same-direction socket's previous one) and parks it in the trace map;
+  * the next EGRESS on that thread CONSUMES the parked id — that is the
+    implicit context propagation: service A's inbound request and its
+    outbound call to service B share one syscall_trace_id;
+  * a client-only egress request parks a zero marker so the later
+    ingress response doesn't fabricate a new trace (the "traceID: 0"
+    scenes in the kernel comments);
+  * goroutine/coroutine ids substitute for the thread id when present
+    (the ebpf_dispatcher's pseudo-thread treatment).
+- TCP-seq <-> flow association: req_tcp_seq / resp_tcp_seq land in the
+  l7 row from the syscall records, so an l7 log row joins the packet
+  pipeline's flow rows on (5-tuple, seq).
+- capture-sequence propagation (syscall_cap_seq_0/1) for loss detection.
+
+Records parse through the SAME L7 parser registry as packet payloads
+(agent/l7.py) and pair through the same SessionAggregator; merged
+sessions serialize as standard PROTOCOLLOG wire records, so a real eBPF
+agent can ship into this backend losslessly (the e2e test drives
+syscall records through the wire into l7_flow_log rows and joins them
+on the trace id).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from deepflow_tpu.agent.l7 import (MSG_REQUEST, MSG_RESPONSE,
+                                   SessionAggregator, parse_payload)
+
+T_INGRESS = 0
+T_EGRESS = 1
+
+
+@dataclass
+class SyscallRecord:
+    """One SK_BPF_DATA-like record (the socket_trace.c output contract,
+    userspace image)."""
+
+    pid: int
+    tid: int
+    direction: int                 # T_INGRESS (read) / T_EGRESS (write)
+    timestamp_ns: int
+    ip_src: int
+    ip_dst: int
+    port_src: int
+    port_dst: int
+    proto: int = 6
+    tcp_seq: int = 0               # TCP seq at the syscall boundary
+    cap_seq: int = 0               # per-socket capture sequence
+    coroutine_id: int = 0          # goroutine id when nonzero
+    process_kname: str = ""
+    payload: bytes = b""
+
+
+@dataclass
+class _SideMeta:
+    """Per-side syscall metadata captured when a record parses."""
+
+    tcp_seq: int = 0
+    trace_id: int = 0
+    thread: int = 0
+    coroutine: int = 0
+    cap_seq: int = 0
+    kname: str = ""
+
+
+class EbpfTracer:
+    """Syscall records in, merged l7 wire records out."""
+
+    def __init__(self, vtap_id: int = 0) -> None:
+        self.vtap_id = vtap_id
+        self.sessions = SessionAggregator()
+        # trace map: (pid, coroutine|tid) -> (parked trace id, socket
+        # key, direction); id 0 = the client-only zero marker
+        self._trace_map: Dict[Tuple[int, int], tuple] = {}
+        self._next_trace_id = 0
+        self._meta: Dict[tuple, Dict[int, _SideMeta]] = {}
+        self._meta_ts: Dict[tuple, int] = {}
+        self._last_expire_ns = 0
+        self.records_in = 0
+        self.parse_failed = 0
+
+    def expire(self, now_ns: int,
+               timeout_ns: int = 30 * 1_000_000_000) -> None:
+        """Drop unpaired per-session metadata older than the timeout —
+        one-sided captures and aborted connections must not grow _meta
+        without bound. Called opportunistically from feed()."""
+        dead = [k for k, t in self._meta_ts.items()
+                if now_ns - t > timeout_ns]
+        for k in dead:
+            self._meta.pop(k, None)
+            self._meta_ts.pop(k, None)
+
+    # -- trace-id state machine -------------------------------------------
+    def _trace_id_for(self, rec: SyscallRecord, msg_type: int,
+                      skey: tuple) -> int:
+        key = (rec.pid, rec.coroutine_id or rec.tid)
+        if rec.direction == T_INGRESS:
+            parked = self._trace_map.get(key)
+            if parked is not None and parked[0] == 0 \
+                    and msg_type == MSG_RESPONSE:
+                # client thread reading its own response: no tracking
+                del self._trace_map[key]
+                return 0
+            # continuation: more ingress data on the SAME socket keeps
+            # the session's id (socket_trace.c pre_trace_id); a new
+            # socket/direction means a new inbound request
+            if parked is not None and parked[0] \
+                    and parked[1:] == (skey, T_INGRESS):
+                return parked[0]
+            self._next_trace_id += 1
+            tid = self._next_trace_id
+            self._trace_map[key] = (tid, skey, T_INGRESS)
+            return tid
+        parked = self._trace_map.pop(key, None)
+        if parked is not None and parked[0]:
+            return parked[0]             # egress consumes the parked id
+        if msg_type == MSG_REQUEST:
+            # client-only request: (re-)park the zero marker — a client
+            # pipelining several requests must keep it parked, or its
+            # eventual response would fabricate a fresh trace id
+            self._trace_map[key] = (0, skey, T_EGRESS)
+        return 0
+
+    # -- data path ---------------------------------------------------------
+    def feed(self, rec: SyscallRecord) -> Optional[bytes]:
+        """Process one record; returns a serialized AppProtoLogsData when
+        a request/response session merges."""
+        self.records_in += 1
+        parsed = parse_payload(
+            rec.payload, proto=rec.proto, port_src=rec.port_src,
+            port_dst=rec.port_dst, ts_ns=rec.timestamp_ns,
+            ip_src=rec.ip_src, ip_dst=rec.ip_dst)
+        if parsed is None:
+            self.parse_failed += 1
+            return None
+        skey = tuple(sorted([(rec.ip_src, rec.port_src),
+                             (rec.ip_dst, rec.port_dst)])) + (rec.proto,)
+        trace_id = self._trace_id_for(rec, parsed.msg_type, skey)
+        if rec.timestamp_ns - self._last_expire_ns > 1_000_000_000:
+            self._last_expire_ns = rec.timestamp_ns
+            self.expire(rec.timestamp_ns)
+        side = 0 if parsed.msg_type == MSG_REQUEST else 1
+        self._meta_ts[skey] = rec.timestamp_ns
+        meta = self._meta.setdefault(skey, {})
+        meta[side] = _SideMeta(
+            tcp_seq=rec.tcp_seq, trace_id=trace_id,
+            thread=rec.coroutine_id or rec.tid,
+            coroutine=rec.coroutine_id, cap_seq=rec.cap_seq,
+            kname=rec.process_kname)
+        if parsed.msg_type == MSG_REQUEST:
+            flow = (rec.ip_src, rec.ip_dst, rec.port_src, rec.port_dst,
+                    rec.proto)
+        else:
+            flow = (rec.ip_dst, rec.ip_src, rec.port_dst, rec.port_src,
+                    rec.proto)
+        merged = self.sessions.offer(skey, parsed, rec.timestamp_ns)
+        if merged is None:
+            return None
+        sides = self._meta.pop(skey, {})
+        self._meta_ts.pop(skey, None)
+        return self._wire_record(flow, merged, rec, sides)
+
+    def _wire_record(self, flow, merged: dict, rec: SyscallRecord,
+                     sides: Dict[int, _SideMeta]) -> bytes:
+        from deepflow_tpu.agent.trident import l7_session_message
+        req = sides.get(0, _SideMeta())
+        resp = sides.get(1, _SideMeta())
+        # the shared builder owns orientation + common fields; only the
+        # syscall identities are eBPF-specific
+        m = l7_session_message(flow, merged, rec.timestamp_ns,
+                               self.vtap_id)
+        b = m.base
+        b.req_tcp_seq = req.tcp_seq
+        b.resp_tcp_seq = resp.tcp_seq
+        b.syscall_trace_id_request = req.trace_id
+        b.syscall_trace_id_response = resp.trace_id
+        b.syscall_trace_id_thread_0 = req.thread
+        b.syscall_trace_id_thread_1 = resp.thread
+        b.syscall_coroutine_0 = req.coroutine
+        b.syscall_coroutine_1 = resp.coroutine
+        b.syscall_cap_seq_0 = req.cap_seq
+        b.syscall_cap_seq_1 = resp.cap_seq
+        b.process_kname_0 = req.kname
+        b.process_kname_1 = resp.kname
+        b.process_id_0 = rec.pid
+        return m.SerializeToString()
+
+    def counters(self) -> dict:
+        return {"records_in": self.records_in,
+                "parse_failed": self.parse_failed,
+                "trace_map_entries": len(self._trace_map),
+                "next_trace_id": self._next_trace_id}

@@ -147,23 +147,35 @@ def columns_to_l4_records(cols: Dict[str, np.ndarray]) -> List[bytes]:
     return out
 
 
-def _l7_record_bytes(flow, rec_dict: dict, ts_ns: int,
-                     vtap_id: int) -> bytes:
+def l7_session_message(flow, rec_dict: dict, ts_ns: int,
+                       vtap_id: int) -> "flow_log_pb2.AppProtoLogsData":
+    """Merged l7 session -> AppProtoLogsData message. ONE builder for
+    every front end (packet capture here, syscall records in
+    agent/ebpf_source.py) so session orientation and wire fields cannot
+    drift between sources. ts_ns is the merge (response) time; start
+    backs off by the measured round trip."""
     m = flow_log_pb2.AppProtoLogsData()
     b = m.base
-    b.start_time = ts_ns
+    b.start_time = max(ts_ns - rec_dict["rrt_us"] * 1000, 0)
+    b.end_time = ts_ns
     b.vtap_id = vtap_id
     b.ip_src, b.ip_dst = int(flow[0]), int(flow[1])
     b.port_src, b.port_dst = int(flow[2]), int(flow[3])
     b.protocol = int(flow[4])
     b.head.proto = rec_dict["proto"]
-    b.head.msg_type = 1
+    b.head.msg_type = 2                # merged session (LogMessageType)
     b.head.rrt = rec_dict["rrt_us"] * 1000
     m.req.endpoint = rec_dict["endpoint"]
     m.resp.status = rec_dict["status"]
     m.req_len = rec_dict["req_len"]
     m.resp_len = rec_dict["resp_len"]
-    return m.SerializeToString()
+    return m
+
+
+def _l7_record_bytes(flow, rec_dict: dict, ts_ns: int,
+                     vtap_id: int) -> bytes:
+    return l7_session_message(flow, rec_dict, ts_ns,
+                              vtap_id).SerializeToString()
 
 
 class Agent:

@@ -276,7 +276,17 @@ def decode_l7_records(records: Iterable[bytes],
             "req_tcp_seq": b.req_tcp_seq, "resp_tcp_seq": b.resp_tcp_seq,
             "sql_affected_rows": m.row_effect,
             "direction_score": m.direction_score,
-            "signal_source": SIGNAL_SOURCE_PACKET,
+            # syscall identities only exist on eBPF-sourced records — the
+            # wire has no signal_source field, so provenance is inferred
+            # exactly like the reference's separate queue routing would
+            "signal_source": (SIGNAL_SOURCE_EBPF
+                              if (b.syscall_trace_id_request
+                                  or b.syscall_trace_id_response
+                                  or b.syscall_trace_id_thread_0
+                                  or b.syscall_trace_id_thread_1
+                                  or b.syscall_cap_seq_0
+                                  or b.syscall_cap_seq_1)
+                              else SIGNAL_SOURCE_PACKET),
             "nat_source": 0,
             "tunnel_type": 0,
             "span_kind": 0,      # OTel-sourced rows set this (span path)

@@ -1,0 +1,132 @@
+"""eBPF-output front end: syscall records -> l7 rows with trace ids.
+
+Reference semantics under test: socket_trace.c's thread-session trace
+map (:960-1060 — ingress parks an id, the next egress on the thread
+consumes it; client-only requests park a zero marker) and the TCP-seq
+association that joins syscall-level l7 logs with packet flows.
+"""
+
+import numpy as np
+
+from deepflow_tpu.agent.ebpf_source import (EbpfTracer, SyscallRecord,
+                                            T_EGRESS, T_INGRESS)
+from deepflow_tpu.decode.columnar import (SIGNAL_SOURCE_EBPF,
+                                          decode_l7_records)
+
+CLIENT, SVC_A, SVC_B = 0x0A000001, 0x0A000002, 0x0A000003
+MS = 1_000_000
+T0 = 1_700_000_000 * 1_000_000_000
+
+REQ_A = b"GET /api/users HTTP/1.1\r\nHost: a\r\n\r\n"
+REQ_B = b"GET /internal/roles HTTP/1.1\r\nHost: b\r\n\r\n"
+RESP = b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+
+
+def _svc_a_conversation(tracer):
+    """Service A (pid 10, thread 7): reads a request from the client,
+    calls service B on the same thread, reads B's response, answers the
+    client. The inbound request and the outbound call must share one
+    syscall trace id — the implicit context propagation."""
+    out = []
+    recs = [
+        # inbound request (ingress on A's server socket)
+        SyscallRecord(10, 7, T_INGRESS, T0, CLIENT, SVC_A, 5000, 80,
+                      tcp_seq=1001, cap_seq=1, process_kname="svc-a",
+                      payload=REQ_A),
+        # outbound call to B (egress, same thread) -> consumes the id
+        SyscallRecord(10, 7, T_EGRESS, T0 + 2 * MS, SVC_A, SVC_B,
+                      42000, 80, tcp_seq=2001, cap_seq=2,
+                      process_kname="svc-a", payload=REQ_B),
+        # B's response (ingress on the client socket)
+        SyscallRecord(10, 7, T_INGRESS, T0 + 8 * MS, SVC_B, SVC_A,
+                      80, 42000, tcp_seq=2002, cap_seq=3,
+                      process_kname="svc-a", payload=RESP),
+        # answer to the client (egress on the server socket)
+        SyscallRecord(10, 7, T_EGRESS, T0 + 9 * MS, SVC_A, CLIENT,
+                      80, 5000, tcp_seq=1002, cap_seq=4,
+                      process_kname="svc-a", payload=RESP),
+    ]
+    for r in recs:
+        w = tracer.feed(r)
+        if w is not None:
+            out.append(w)
+    return out
+
+
+def test_trace_id_propagates_across_sockets():
+    tracer = EbpfTracer(vtap_id=3)
+    wires = _svc_a_conversation(tracer)
+    assert len(wires) == 2                  # two merged sessions
+    cols = decode_l7_records(wires)
+    assert len(cols["ip_src"]) == 2
+    # identify rows by server ip
+    rows = {int(cols["ip_dst"][i]): i for i in range(2)}
+    inbound, outbound = rows[SVC_A], rows[SVC_B]
+    # the propagation: A's inbound request id == A's outbound request id
+    t_in = int(cols["syscall_trace_id_request"][inbound])
+    t_out = int(cols["syscall_trace_id_request"][outbound])
+    assert t_in != 0 and t_in == t_out
+    # the response side of the OUTBOUND call parked a fresh id consumed
+    # by the final answer: outbound's response id == inbound's response id
+    r_out = int(cols["syscall_trace_id_response"][outbound])
+    r_in = int(cols["syscall_trace_id_response"][inbound])
+    assert r_out != 0 and r_out == r_in
+    assert t_in != r_in
+
+
+def test_tcp_seq_and_identity_columns_land():
+    tracer = EbpfTracer()
+    wires = _svc_a_conversation(tracer)
+    cols = decode_l7_records(wires)
+    rows = {int(cols["ip_dst"][i]): i for i in range(2)}
+    inbound = rows[SVC_A]
+    assert cols["req_tcp_seq"][inbound] == 1001
+    assert cols["resp_tcp_seq"][inbound] == 1002
+    assert cols["syscall_cap_seq_0"][inbound] == 1
+    assert cols["syscall_cap_seq_1"][inbound] == 4
+    assert cols["signal_source"][inbound] == SIGNAL_SOURCE_EBPF
+    assert cols["process_kname_0_hash"][inbound] != 0
+    assert (cols["endpoint_hash"] != 0).all()
+
+
+def test_client_only_zero_marker():
+    """A pure client (egress request with no prior ingress) must not
+    fabricate a trace id for its own response (the 'traceID: 0' scenes
+    in socket_trace.c)."""
+    tracer = EbpfTracer()
+    w1 = tracer.feed(SyscallRecord(
+        20, 9, T_EGRESS, T0, CLIENT, SVC_A, 6000, 80,
+        tcp_seq=1, payload=REQ_A))
+    assert w1 is None
+    w2 = tracer.feed(SyscallRecord(
+        20, 9, T_INGRESS, T0 + MS, SVC_A, CLIENT, 80, 6000,
+        tcp_seq=2, payload=RESP))
+    assert w2 is not None
+    cols = decode_l7_records([w2])
+    assert cols["syscall_trace_id_request"][0] == 0
+    assert cols["syscall_trace_id_response"][0] == 0
+    assert tracer.counters()["trace_map_entries"] == 0
+
+
+def test_ingress_continuation_keeps_id():
+    """More ingress data on the same socket continues the session's id
+    (pre_trace_id) instead of burning a new one."""
+    tracer = EbpfTracer()
+    r = SyscallRecord(30, 1, T_INGRESS, T0, CLIENT, SVC_A, 7000, 80,
+                      payload=REQ_A)
+    tracer.feed(r)
+    first = tracer.counters()["next_trace_id"]
+    tracer.feed(SyscallRecord(30, 1, T_INGRESS, T0 + MS, CLIENT, SVC_A,
+                              7000, 80, payload=REQ_A))
+    assert tracer.counters()["next_trace_id"] == first
+
+
+def test_coroutine_substitutes_thread():
+    """Two coroutines on one OS thread keep separate trace sessions
+    (the ebpf_dispatcher pseudo-thread treatment)."""
+    tracer = EbpfTracer()
+    tracer.feed(SyscallRecord(40, 5, T_INGRESS, T0, CLIENT, SVC_A,
+                              8000, 80, coroutine_id=111, payload=REQ_A))
+    tracer.feed(SyscallRecord(40, 5, T_INGRESS, T0, CLIENT, SVC_A,
+                              8001, 80, coroutine_id=222, payload=REQ_A))
+    assert tracer.counters()["trace_map_entries"] == 2
