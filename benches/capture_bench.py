@@ -1,0 +1,82 @@
+"""Capture-source microbench: TPACKET_V3 mmap ring vs recv-per-frame.
+
+Floods loopback with UDP from a sender thread and measures how many
+packets each source harvests per second (reference role: the
+recv_engine mode comparison behind
+agent/src/dispatcher/recv_engine/af_packet/tpacket.rs). Requires
+CAP_NET_RAW; prints one JSON line per source:
+
+    {"bench": "capture_tpacket_v3", "pkts_per_sec": ..., "drops": ...}
+
+Run: python benches/capture_bench.py [--seconds 3] [--payload 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import threading
+import time
+
+
+def _flood(stop: threading.Event, payload: int, port: int,
+           counter: list) -> None:
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    data = b"\xab" * payload
+    while not stop.is_set():
+        for _ in range(64):
+            tx.sendto(data, ("127.0.0.1", port))
+        counter[0] += 64
+    tx.close()
+
+
+def bench_source(name: str, make_source, seconds: float,
+                 payload: int) -> dict:
+    src = make_source()
+    stop = threading.Event()
+    sent = [0]
+    t = threading.Thread(target=_flood, args=(stop, payload, 19997, sent),
+                         daemon=True)
+    t.start()
+    got = 0
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        frames, stamps = src.read_batch()
+        got += len(frames)
+    dt = time.perf_counter() - t0
+    stop.set()
+    t.join(timeout=2)
+    drops = 0
+    if hasattr(src, "statistics"):
+        _, drops = src.statistics()
+    src.close()
+    r = {"bench": name, "pkts_per_sec": round(got / dt),
+         "sent_per_sec": round(sent[0] / dt), "drops": drops,
+         "seconds": round(dt, 2)}
+    print(json.dumps(r), flush=True)
+    return r
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--payload", type=int, default=256)
+    args = ap.parse_args()
+
+    from deepflow_tpu.agent.afpacket import AfPacketSource, TpacketV3Source
+
+    bench_source(
+        "capture_recv", lambda: AfPacketSource(
+            iface="lo", batch_size=8192, poll_ms=20),
+        args.seconds, args.payload)
+    bench_source(
+        "capture_tpacket_v3", lambda: TpacketV3Source(
+            iface="lo", block_size=1 << 20, block_count=8,
+            retire_ms=10, poll_ms=20),
+        args.seconds, args.payload)
+
+
+if __name__ == "__main__":
+    main()
