@@ -103,6 +103,26 @@ def main() -> None:
     bench("mxu_hist", f"[4,{n}] -> 2^12", hist_step,
           lambda: jnp.zeros((4, 1 << 12), jnp.int32), idx, rows=n)
 
+    # Pallas VMEM-resident accumulator vs the XLA scan carry, at the
+    # CMS shape (the BENCH kernel hot path). Real TPUs only: the Mosaic
+    # interpreter would measure nothing real, and the kernel's TPU
+    # compiler params don't lower on GPU.
+    if backend in ("tpu", "axon"):
+        from deepflow_tpu.ops.pallas_hist import hist_pallas
+
+        idx16 = jnp.asarray(rng.integers(0, 1 << 16, (4, n),
+                                         dtype=np.int32))
+
+        for name, fn in (
+                ("hist_xla_2e16",
+                 lambda ix, w: mxu_hist.hist(ix, w, method="xla")),
+                ("hist_pallas_2e16",
+                 lambda ix, w: hist_pallas(ix, w))):
+            bench(name, f"[4,{n}] -> 2^16",
+                  lambda acc, ix, f=fn: acc + f(ix, 1 << 16),
+                  lambda: jnp.zeros((4, 1 << 16), jnp.float32), idx16,
+                  rows=n)
+
     # -- topk admission ----------------------------------------------------
     # populated, NON-donated sketch shared by the ring benches
     query_sketch = jax.jit(cms.update)(cms_init(), keys)

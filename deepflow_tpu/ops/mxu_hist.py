@@ -21,8 +21,11 @@ matmul operand stays exactly representable in bf16; planes are recombined as
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -44,7 +47,8 @@ MIN_LANES = 8192
 
 def hist_masked(idx: jnp.ndarray, width: int,
                 weights: jnp.ndarray | None, mask: jnp.ndarray | None,
-                weight_planes: int = 2, chunk: int = 16384) -> jnp.ndarray:
+                weight_planes: int = 2, chunk: int = 16384,
+                method: str = "auto") -> jnp.ndarray:
     """`hist` with the mask folded into the weights (shared dispatch helper
     for cms.update / entropy.update: mask-only batches need just one plane)."""
     if weights is None and mask is not None:
@@ -52,11 +56,32 @@ def hist_masked(idx: jnp.ndarray, width: int,
     elif weights is not None and mask is not None:
         weights = weights.astype(jnp.int32) * mask.astype(jnp.int32)
     return hist(idx, width, weights, chunk=chunk,
-                weight_planes=weight_planes)
+                weight_planes=weight_planes, method=method)
+
+
+def _use_pallas(method: str, width: int, d: int) -> bool:
+    """method dispatch: "pallas" forces the VMEM-resident kernel
+    (interpreted off-TPU, so tests run anywhere); "auto" takes it on a
+    TPU backend when the env opt-in is set — the tunneled dev chip
+    can't currently validate kernel perf, so auto stays conservative.
+    Auto also refuses shapes whose resident accumulator would crowd
+    VMEM (d * width * 4B; the one-hot chunk adapts on its own)."""
+    if method == "pallas":
+        return True
+    if method == "xla":
+        return False
+    if method != "auto":
+        raise ValueError(f"hist method {method!r}: "
+                         "expected auto | xla | pallas")
+    if width < MIN_LANES or d * width * 4 > (8 << 20):
+        return False
+    return (jax.default_backend() in ("tpu", "axon")
+            and os.environ.get("DEEPFLOW_HIST_PALLAS", "") == "1")
 
 
 def hist(idx: jnp.ndarray, width: int, weights: jnp.ndarray | None = None,
-         chunk: int = 16384, weight_planes: int = 2) -> jnp.ndarray:
+         chunk: int = 16384, weight_planes: int = 2,
+         method: str = "auto") -> jnp.ndarray:
     """Batched histogram: idx [d, n] int32 in [0, width) -> [d, width] f32.
 
     `weights` is [n] (shared across the d rows — the Count-Min case),
@@ -66,6 +91,15 @@ def hist(idx: jnp.ndarray, width: int, weights: jnp.ndarray | None = None,
     Out-of-range indices must be pre-masked by the caller (zero weight);
     indices are clamped defensively.
     """
+    if _use_pallas(method, width, idx.shape[0]):
+        from deepflow_tpu.ops.pallas_hist import hist_pallas
+        return hist_pallas(
+            idx, width, weights, chunk=min(chunk, 4096),
+            weight_planes=weight_planes,
+            # the kernel carries TPU Mosaic params: interpret anywhere
+            # that is not a real TPU (incl. GPU backends)
+            interpret=jax.default_backend() not in ("tpu", "axon"))
+
     d, n = idx.shape
     hi_n, lo_n = _split_hi_lo(width)
 
