@@ -8,6 +8,11 @@ agent's 1s Documents — reference: server/ingester/flow_metrics/unmarshaller):
   spike on src dispersion + dst concentration raises the alarm flag.
 - **Golden-signal PCA** (config 5): Oja streaming PCA over the log1p'd meter
   vector; reconstruction residual is the anomaly score.
+- **Matrix-profile discords** (config 5's second half): per-signal rings
+  of psum-merged window aggregates; the newest subsequence's
+  nearest-neighbor distance (ops/matrix_profile.py — all-pairs
+  subsequence matmuls on the MXU, not the CPU STOMP recurrence) flags
+  window-shape anomalies the instantaneous detectors can't see.
 """
 
 from __future__ import annotations
@@ -17,7 +22,7 @@ from typing import Dict, NamedTuple, Tuple
 
 import jax.numpy as jnp
 
-from deepflow_tpu.ops import entropy, pca
+from deepflow_tpu.ops import entropy, matrix_profile, pca
 
 GOLDEN_SIGNALS = (
     "packet_tx", "packet_rx", "byte_tx", "byte_rx",
@@ -35,6 +40,8 @@ class MetricsSuiteConfig:
     ewma_alpha: float = 0.05
     z_threshold: float = 4.0
     pca_lr: float = 0.05
+    mp_length: int = 512      # windows of history per signal ring
+    mp_m: int = 16            # subsequence length (windows)
     seed: int = 0x3E7
 
 
@@ -44,6 +51,8 @@ class MetricsSuiteState(NamedTuple):
     ent_var: jnp.ndarray    # [2]
     windows: jnp.ndarray    # [] int32
     pca: pca.PCAState
+    win_sum: jnp.ndarray    # [signals] raw window sums (pre-log)
+    mp: matrix_profile.MPState
 
 
 class MetricsWindowOutput(NamedTuple):
@@ -51,6 +60,7 @@ class MetricsWindowOutput(NamedTuple):
     z_scores: jnp.ndarray       # [2]
     ddos_alarm: jnp.ndarray     # [] bool
     anomaly_scores: jnp.ndarray  # [n] PCA residual per record of last batch
+    mp_scores: jnp.ndarray      # [signals] newest-window discord distances
 
 
 def init(cfg: MetricsSuiteConfig) -> MetricsSuiteState:
@@ -60,13 +70,21 @@ def init(cfg: MetricsSuiteConfig) -> MetricsSuiteState:
         ent_var=jnp.full((len(ENTROPY_FEATURES),), 0.25, jnp.float32),
         windows=jnp.zeros((), jnp.int32),
         pca=pca.init(len(GOLDEN_SIGNALS), cfg.pca_k),
+        win_sum=jnp.zeros((len(GOLDEN_SIGNALS),), jnp.float32),
+        mp=matrix_profile.init(len(GOLDEN_SIGNALS), cfg.mp_length),
     )
 
 
+def raw_signals(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """[n, signals] float32 raw golden-signal matrix — THE one stack
+    both the PCA and matrix-profile paths derive from."""
+    return jnp.stack([cols[s].astype(jnp.float32)
+                      for s in GOLDEN_SIGNALS], axis=1)
+
+
 def signal_matrix(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-    """[n, signals] float32 log1p-compressed golden-signal matrix."""
-    x = jnp.stack([cols[s].astype(jnp.float32) for s in GOLDEN_SIGNALS], axis=1)
-    return jnp.log1p(x)
+    """[n, signals] log1p-compressed golden-signal matrix."""
+    return jnp.log1p(raw_signals(cols))
 
 
 def entropy_update(ent: entropy.EntropyState, cols: Dict[str, jnp.ndarray],
@@ -81,11 +99,21 @@ def entropy_update(ent: entropy.EntropyState, cols: Dict[str, jnp.ndarray],
     return entropy.update(ent, feats, packets, mask, weight_planes=2)
 
 
+def window_sum(cols: Dict[str, jnp.ndarray],
+               mask: jnp.ndarray) -> jnp.ndarray:
+    """[signals] masked raw sums for the matrix-profile ring (summed
+    pre-log so shards psum exactly; log1p at push time)."""
+    return (raw_signals(cols)
+            * mask.astype(jnp.float32)[:, None]).sum(axis=0)
+
+
 def update(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
            mask: jnp.ndarray, cfg: MetricsSuiteConfig) -> MetricsSuiteState:
     ent = entropy_update(state.ent, cols, mask)
-    p = pca.update(state.pca, signal_matrix(cols), mask, lr=cfg.pca_lr)
-    return state._replace(ent=ent, pca=p)
+    raw = raw_signals(cols)                  # one stack for both paths
+    p = pca.update(state.pca, jnp.log1p(raw), mask, lr=cfg.pca_lr)
+    ws = (raw * mask.astype(jnp.float32)[:, None]).sum(axis=0)
+    return state._replace(ent=ent, pca=p, win_sum=state.win_sum + ws)
 
 
 def flush(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
@@ -102,12 +130,18 @@ def flush(state: MetricsSuiteState, cols: Dict[str, jnp.ndarray],
     mean = (1 - a) * state.ent_mean + a * ents
     var = (1 - a) * state.ent_var + a * (ents - mean) ** 2
     scores = pca.score(state.pca, signal_matrix(cols)) * mask.astype(jnp.float32)
+    # matrix profile: push the window's (merged) aggregate vector, then
+    # price the newest subsequence against history — one matvec
+    mp = matrix_profile.push(state.mp, jnp.log1p(state.win_sum))
+    mp_scores = matrix_profile.latest_score(mp, cfg.mp_m)
     out = MetricsWindowOutput(entropies=ents, z_scores=z, ddos_alarm=alarm,
-                              anomaly_scores=scores)
+                              anomaly_scores=scores, mp_scores=mp_scores)
     fresh = state._replace(
         ent=entropy.reset(state.ent),
         ent_mean=mean,
         ent_var=var,
         windows=state.windows + 1,
+        win_sum=jnp.zeros_like(state.win_sum),
+        mp=mp,
     )
     return fresh, out

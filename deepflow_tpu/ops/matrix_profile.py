@@ -1,0 +1,139 @@
+"""Streaming matrix-profile anomaly: nearest-neighbor subsequence
+distances as batched MXU matmuls.
+
+BASELINE.md milestone 5 names "streaming PCA / matrix-profile anomaly";
+PCA (ops/pca.py) covers the per-record residual, this op covers the
+TIME-SHAPE anomaly: for every length-m subsequence of a windowed metric
+series, the z-normalized Euclidean distance to its nearest non-trivial
+neighbor. A high profile value is a discord — a window pattern unlike
+anything seen before (latency plateau, retrans burst, silence).
+
+CPU matrix-profile libraries (STOMP/SCRIMP) stream a sequential QT
+recurrence — the classic cache-friendly CPU shape and exactly what a
+TPU hates. Here the all-pairs dot-product matrix of subsequences is ONE
+batched matmul (A @ A^T per series, [n_sub, m] x [m, n_sub] on the
+MXU); means/stds come from cumulative sums; z-normalized distances,
+trivial-match exclusion, and the row-min are elementwise/reduce work on
+the VPU. For the ring sizes this tracks (hundreds of 1s windows), the
+O(n^2) matrix is megabytes — the MXU eats it whole and there is no
+sequential dependency to schedule around.
+
+The streaming state is a right-aligned ring per series: push() appends
+the newest window value, latest_score() prices only the newest
+subsequence against history (one matvec), profile() computes the full
+profile. Distributed use: the ring holds post-merge (psum'd) window
+aggregates, so every chip carries the identical replicated ring —
+models/metrics_suite.py pushes after the flush-time ICI merge.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+
+class MPState(NamedTuple):
+    ring: jnp.ndarray    # [series, length] f32, right-aligned
+    count: jnp.ndarray   # [] int32: total windows ever pushed
+
+
+def init(series: int, length: int = 512) -> MPState:
+    return MPState(ring=jnp.zeros((series, length), jnp.float32),
+                   count=jnp.zeros((), jnp.int32))
+
+
+def push(state: MPState, values: jnp.ndarray) -> MPState:
+    """Append one window's [series] values (oldest falls off)."""
+    ring = jnp.concatenate(
+        [state.ring[:, 1:], values.astype(jnp.float32)[:, None]], axis=1)
+    return MPState(ring=ring, count=state.count + 1)
+
+
+_SD_FLOOR = 1e-5
+
+
+def _sub_stats(ring: jnp.ndarray, m: int):
+    """Sliding [series, n_sub, m] subsequences + their mean/std."""
+    length = ring.shape[1]
+    n_sub = length - m + 1
+    idx = jnp.arange(n_sub)[:, None] + jnp.arange(m)[None, :]
+    subs = ring[:, idx]                                # [s, n_sub, m]
+    mu = subs.mean(axis=2)
+    sd = jnp.sqrt(jnp.maximum(subs.var(axis=2), _SD_FLOOR ** 2))
+    return subs, mu, sd
+
+
+def _znorm_dist2(qt, mu_a, sd_a, mu_b, sd_b, m: int):
+    """z-normalized squared distance from dot products:
+    2m (1 - (qt - m mu_a mu_b) / (m sd_a sd_b)), clipped to [0, 4m].
+
+    Constant (zero-variance) subsequences need explicit handling — the
+    clamped sd would otherwise price two IDENTICAL flat windows at
+    corr 0 (d ~= sqrt(2m)), making quiet signals permanent false
+    discords. Convention (STOMP implementations): flat-vs-flat = 0,
+    flat-vs-varying = m (halfway)."""
+    corr = (qt - m * mu_a * mu_b) / (m * sd_a * sd_b)
+    corr = jnp.clip(corr, -1.0, 1.0)
+    d2 = 2.0 * m * (1.0 - corr)
+    const_a = sd_a <= _SD_FLOOR
+    const_b = sd_b <= _SD_FLOOR
+    return jnp.where(const_a & const_b, 0.0,
+                     jnp.where(const_a | const_b, float(m), d2))
+
+
+def _valid_sub_mask(count, length: int, m: int, n_sub: int):
+    """Subsequence j is real data iff it lies inside the ring's seen
+    region (right-aligned: the last min(count, length) entries)."""
+    first = length - jnp.minimum(count, length)
+    return jnp.arange(n_sub) >= first
+
+
+def profile(state: MPState, m: int = 16) -> jnp.ndarray:
+    """[series, n_sub] z-normalized NN distance per subsequence; +inf
+    where the subsequence (or every possible neighbor) is invalid.
+    Trivial matches within m//2 are excluded, as is self-match."""
+    length = state.ring.shape[1]
+    n_sub = length - m + 1
+    subs, mu, sd = _sub_stats(state.ring, m)
+    # the whole pairwise dot matrix in one batched MXU contraction
+    qt = jnp.einsum("sim,sjm->sij", subs, subs)
+    d2 = _znorm_dist2(qt, mu[:, :, None], sd[:, :, None],
+                      mu[:, None, :], sd[:, None, :], m)
+    i = jnp.arange(n_sub)
+    trivial = jnp.abs(i[:, None] - i[None, :]) < max(m // 2, 1)
+    valid = _valid_sub_mask(state.count, length, m, n_sub)
+    bad = trivial[None, :, :] | ~valid[None, None, :]
+    d2 = jnp.where(bad, jnp.inf, d2)
+    prof = jnp.sqrt(jnp.min(d2, axis=2))
+    return jnp.where(valid[None, :], prof, jnp.inf)
+
+
+def latest_score(state: MPState, m: int = 16) -> jnp.ndarray:
+    """[series] discord score of the NEWEST subsequence: its distance to
+    the nearest older neighbor (one matvec per series — the streaming
+    fast path). 0 until enough history exists (2m windows)."""
+    length = state.ring.shape[1]
+    n_sub = length - m + 1
+    subs, mu, sd = _sub_stats(state.ring, m)
+    q = subs[:, -1]                                    # [s, m]
+    qt = jnp.einsum("sm,sjm->sj", q, subs)
+    d2 = _znorm_dist2(qt, mu[:, -1:], sd[:, -1:], mu, sd, m)
+    i = jnp.arange(n_sub)
+    trivial = i > (n_sub - 1 - max(m // 2, 1))
+    valid = _valid_sub_mask(state.count, length, m, n_sub)
+    d2 = jnp.where(trivial[None, :] | ~valid[None, :], jnp.inf, d2)
+    score = jnp.sqrt(jnp.min(d2, axis=1))
+    warm = state.count >= 2 * m
+    return jnp.where(warm & jnp.isfinite(score), score, 0.0)
+
+
+def discords(state: MPState, m: int = 16,
+             k: int = 3) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k discord (score, subsequence index) per series from the full
+    profile; invalid slots carry -inf scores."""
+    from jax import lax
+    prof = profile(state, m)
+    finite = jnp.where(jnp.isfinite(prof), prof, -jnp.inf)
+    scores, idx = lax.top_k(finite, k)
+    return scores, idx

@@ -234,7 +234,10 @@ class ShardedMetricsSuite(_ShardedSuiteBase):
             cnt, s1, s2, g = pca.grad(local.pca, x, mask)
             cnt, s1, s2, g = jax.lax.psum((cnt, s1, s2, g), axis)
             p = pca.apply_grad(local.pca, cnt, s1, s2, g, lr=cfg_.pca_lr)
-            new = local._replace(ent=ent, pca=p)
+            # matrix-profile window sums accumulate per shard; the
+            # flush-time psum merges them before the ring push
+            ws = local.win_sum + metrics_suite.window_sum(cols, mask)
+            new = local._replace(ent=ent, pca=p, win_sum=ws)
             return jax.tree.map(lambda x_: x_[None], new)
 
         self._update = self._shard(local_update,
@@ -247,7 +250,11 @@ class ShardedMetricsSuite(_ShardedSuiteBase):
             # window close everywhere (EWMA/z/alarm are scalar math on the
             # merged entropies, so every chip computes the same values)
             hist = jax.lax.psum(local.ent.hist, axis)
-            merged = local._replace(ent=local.ent._replace(hist=hist))
+            ws = jax.lax.psum(local.win_sum, axis)
+            merged = local._replace(ent=local.ent._replace(hist=hist),
+                                    win_sum=ws)
+            # flush pushes the MERGED window vector into the ring, so
+            # the replicated rings stay identical on every chip
             fresh, out = metrics_suite.flush(merged, cols, mask, cfg_)
             return jax.tree.map(lambda x_: x_[None], fresh), out
 
@@ -256,7 +263,8 @@ class ShardedMetricsSuite(_ShardedSuiteBase):
         out_specs = (state_specs,
                      MetricsWindowOutput(entropies=P(), z_scores=P(),
                                          ddos_alarm=P(),
-                                         anomaly_scores=P(axis)))
+                                         anomaly_scores=P(axis),
+                                         mp_scores=P()))
         self._flush = self._shard(local_flush,
                                   (state_specs, P(axis), P(axis)),
                                   out_specs)

@@ -1,0 +1,107 @@
+"""Matrix-profile discord detection (BASELINE.md milestone 5): batched
+MXU all-pairs formulation vs a direct numpy oracle, streaming
+semantics, and the MetricsSuite integration incl. the sharded merge."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepflow_tpu.ops import matrix_profile as mp
+
+
+def _np_profile(series: np.ndarray, m: int) -> np.ndarray:
+    """Direct O(n^2 m) oracle: z-normalized NN distance per subsequence."""
+    n_sub = len(series) - m + 1
+    subs = np.stack([series[i:i + m] for i in range(n_sub)])
+    mu = subs.mean(axis=1)
+    sd = np.sqrt(np.maximum(subs.var(axis=1), 1e-12))
+    z = (subs - mu[:, None]) / sd[:, None]
+    out = np.full(n_sub, np.inf)
+    excl = max(m // 2, 1)
+    for i in range(n_sub):
+        d = np.sqrt(np.maximum(((z[i] - z) ** 2).sum(axis=1), 0))
+        d[max(0, i - excl + 1):i + excl] = np.inf
+        out[i] = d.min()
+    return out
+
+
+def test_profile_matches_numpy_oracle():
+    rng = np.random.default_rng(5)
+    L, m = 128, 8
+    series = np.sin(np.arange(L) / 5) + rng.normal(0, 0.05, L)
+    st = mp.init(1, L)
+    for v in series:
+        st = mp.push(st, jnp.asarray([v]))
+    got = np.asarray(mp.profile(st, m))[0]
+    want = _np_profile(series.astype(np.float32), m)
+    finite = np.isfinite(want)
+    np.testing.assert_allclose(got[finite], want[finite],
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_discord_found_at_anomaly():
+    """A sine series with one injected plateau: the top discord must
+    cover the plateau; latest_score spikes when it is newest."""
+    L, m = 256, 16
+    t = np.arange(L, dtype=np.float32)
+    series = np.sin(t / 6)
+    series[180:196] = 2.5                    # the anomaly
+    st = mp.init(1, L)
+    scores_over_time = []
+    for i, v in enumerate(series):
+        st = mp.push(st, jnp.asarray([v]))
+        scores_over_time.append(float(mp.latest_score(st, m)[0]))
+    scores, idx = mp.discords(st, m, k=1)
+    top = int(idx[0, 0])
+    assert 180 - m < top < 196, top
+    # the streaming score peaked while the plateau was the newest window
+    # (ignore the first ~6m windows: with almost no history, everything
+    # is legitimately "unlike anything seen" and scores run hot)
+    warm = 100
+    peak_at = warm + int(np.argmax(scores_over_time[warm:]))
+    assert 180 <= peak_at <= 200
+    # warmup: no score before 2m windows
+    assert all(s == 0.0 for s in scores_over_time[:2 * m - 1])
+
+
+def test_partial_ring_masks_unseen():
+    st = mp.init(2, 64)
+    for i in range(20):                      # fewer than the ring length
+        st = mp.push(st, jnp.asarray([float(i % 5), 1.0]))
+    prof = np.asarray(mp.profile(st, 8))
+    n_sub = 64 - 8 + 1
+    # subsequences before the seen region are inf
+    assert np.isinf(prof[:, : 64 - 20]).all()
+    assert np.isfinite(prof[:, n_sub - 5:]).any()
+
+
+def test_metrics_suite_emits_mp_scores():
+    from deepflow_tpu.models import metrics_suite as ms
+
+    cfg = ms.MetricsSuiteConfig(mp_length=64, mp_m=4)
+    state = ms.init(cfg)
+    rng = np.random.default_rng(0)
+    n = 256
+    for w in range(40):
+        cols = {k: jnp.asarray(rng.integers(0, 50, n, dtype=np.int64)
+                               .astype(np.uint32))
+                for k in ms.GOLDEN_SIGNALS + ms.ENTROPY_FEATURES}
+        mask = jnp.ones(n, jnp.bool_)
+        state = ms.update(state, cols, mask, cfg)
+        state, out = ms.flush(state, cols, mask, cfg)
+    assert out.mp_scores.shape == (len(ms.GOLDEN_SIGNALS),)
+    assert bool(jnp.isfinite(out.mp_scores).all())
+    # win_sum resets every window
+    assert float(state.win_sum.sum()) == 0.0
+
+
+def test_flat_signal_is_not_a_discord():
+    """Identical flat windows must score 0 against flat history (the
+    quiet-signal case: win_sum 0 for hours must not alarm)."""
+    st = mp.init(1, 64)
+    for _ in range(64):
+        st = mp.push(st, jnp.asarray([3.0]))
+    assert float(mp.latest_score(st, 8)[0]) == 0.0
+    prof = np.asarray(mp.profile(st, 8))[0]
+    assert (prof[np.isfinite(prof)] == 0.0).all()

@@ -177,6 +177,18 @@ def test_sharded_metrics_suite_equals_one_device(rng):
     np.testing.assert_allclose(np.asarray(out8.anomaly_scores),
                                np.asarray(out1.anomaly_scores),
                                rtol=1e-4, atol=1e-5)
+    # matrix-profile rings hold POST-psum window vectors: the merged
+    # 8-way scores must equal the 1-device and plain-suite scores (the
+    # psum-before-push invariant — a pre-merge push would diverge here)
+    np.testing.assert_allclose(np.asarray(outp.mp_scores),
+                               np.asarray(out1.mp_scores),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out8.mp_scores),
+                               np.asarray(out1.mp_scores),
+                               rtol=1e-4, atol=1e-5)
+    r8 = np.asarray(s8.mp.ring)
+    for d in range(1, 8):
+        np.testing.assert_allclose(r8[d], r8[0], rtol=1e-5, atol=1e-6)
 
 
 def test_sharded_app_suite_matches_single():
