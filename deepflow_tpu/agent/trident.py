@@ -53,6 +53,10 @@ class AgentConfig:
     platform_sync_interval_s: float = 60.0
     k8s_resource_file: Optional[str] = None
     k8s_cluster_domain: str = "k8s-cluster"
+    # live apiserver list/watch (agent/k8s_watch.py); takes precedence
+    # over the file lister when set
+    k8s_apiserver_url: Optional[str] = None
+    k8s_apiserver_token: Optional[str] = None
     # shared-object L7 plugins (agent/plugin.py): .so paths loaded at
     # startup and hot-loadable via pushed config (reference: rpc Plugin)
     so_plugins: tuple = ()
@@ -210,6 +214,7 @@ class Agent:
         self.config_version = 0
         self.platform_watcher = None
         self.k8s_watcher = None
+        self.api_watcher = None
         self.ntp_offset_ns = 0
         self.so_plugins: Dict[str, object] = {}
         for path in cfg.so_plugins:
@@ -399,7 +404,21 @@ class Agent:
                 self.cfg.controller_url, self.cfg.host, self.cfg.ctrl_ip,
                 interval_s=self.cfg.platform_sync_interval_s)
             self.platform_watcher.start()
-            if self.cfg.k8s_resource_file:
+            if self.cfg.k8s_apiserver_url:
+                # the real list/watch protocol: the live cache is the
+                # lister, SnapshotWatcher pushes it on change
+                from deepflow_tpu.agent.k8s_watch import ApiWatcher
+                self.api_watcher = ApiWatcher(
+                    self.cfg.k8s_apiserver_url,
+                    token=self.cfg.k8s_apiserver_token)
+                self.api_watcher.start()
+                self.k8s_watcher = k8s_watcher(
+                    self.cfg.controller_url,
+                    self.cfg.k8s_cluster_domain,
+                    self.api_watcher.snapshot,
+                    interval_s=self.cfg.platform_sync_interval_s)
+                self.k8s_watcher.start()
+            elif self.cfg.k8s_resource_file:
                 self.k8s_watcher = k8s_watcher(
                     self.cfg.controller_url,
                     self.cfg.k8s_cluster_domain,
@@ -413,7 +432,8 @@ class Agent:
 
     def close(self) -> None:
         self._stop.set()
-        for w in (self.platform_watcher, self.k8s_watcher):
+        for w in (self.platform_watcher, self.k8s_watcher,
+                  self.api_watcher):
             if w is not None:
                 w.close()
         for t in self._threads:
