@@ -1035,3 +1035,121 @@ def register_extended(parsers_list: List) -> None:
     for p in EXTENDED_PARSERS:
         if type(p) not in known:
             parsers_list.append(p)
+
+
+# ---------------------------------------------------------------------------
+# Oracle TNS (reference: protocol_logs/sql/oracle.rs — whose OSS build
+# stubs the parse out to an enterprise crate; this is a clean-room
+# parser of the PUBLIC TNS wire format, so the open build here covers
+# more than the reference's open build does)
+# ---------------------------------------------------------------------------
+
+L7_ORACLE = 62
+
+# TNS packet types (public protocol)
+_TNS_CONNECT = 1
+_TNS_ACCEPT = 2
+_TNS_REFUSE = 4
+_TNS_REDIRECT = 5
+_TNS_DATA = 6
+_TNS_MARKER = 12
+
+# TTI data ids seen at the start of DATA payloads (oracle.rs:72 names
+# 0x03 user-OCI-function); call ids for the common statement path
+_OCI_CALLS = {
+    0x02: "OPEN", 0x03: "QUERY", 0x04: "EXECUTE", 0x05: "FETCH",
+    0x08: "CLOSE", 0x09: "DISCONNECT", 0x0c: "AUTOCOMMIT",
+    0x3b: "VERSION", 0x5e: "QUERY", 0x60: "LOB_OP", 0x76: "AUTH",
+    0x73: "AUTH_SESSION",
+}
+
+
+class OracleParser:
+    """TNS framing + the session-visible verbs.
+
+    CONNECT extracts SERVICE_NAME from the connect descriptor as the
+    endpoint; ACCEPT/REFUSE close the handshake (REFUSE carries the
+    refusal reason string); DATA packets report the OCI function when
+    the payload opens with the user-OCI data id, with embedded SQL text
+    obfuscated through the shared sql_obfuscate pass."""
+
+    proto: ClassVar[int] = L7_ORACLE
+    _MAX_LEN = 1 << 16
+
+    def check(self, payload: bytes) -> bool:
+        if len(payload) < 8:
+            return False
+        ln = struct.unpack_from(">H", payload)[0]
+        ptype = payload[4]
+        if ptype not in (_TNS_CONNECT, _TNS_ACCEPT, _TNS_REFUSE,
+                         _TNS_REDIRECT, _TNS_DATA, _TNS_MARKER):
+            return False
+        if not (8 <= ln <= self._MAX_LEN):
+            return False
+        # CONNECT must carry a descriptor; DATA needs the 2-byte flags
+        if ptype == _TNS_CONNECT:
+            return len(payload) >= 34 and b"(" in payload[8:]
+        # other types: the frame length must be plausible vs the capture
+        return ln <= len(payload) + self._MAX_LEN // 2
+
+    @staticmethod
+    def _descriptor_field(text: bytes, key: bytes) -> str:
+        i = text.find(key + b"=")
+        if i < 0:
+            return ""
+        j = i + len(key) + 1
+        end = j
+        while end < len(text) and text[end:end + 1] not in (b")", b"("):
+            end += 1
+        return text[j:end].decode("latin-1", "replace").strip()
+
+    def parse(self, payload: bytes) -> Optional[L7Record]:
+        ptype = payload[4]
+        if ptype == _TNS_CONNECT:
+            svc = self._descriptor_field(payload[8:], b"SERVICE_NAME") \
+                or self._descriptor_field(payload[8:], b"SID")
+            return L7Record(self.proto, MSG_REQUEST,
+                            endpoint=f"CONNECT {svc}".strip(),
+                            req_len=len(payload))
+        if ptype == _TNS_ACCEPT:
+            return L7Record(self.proto, MSG_RESPONSE, status=0,
+                            resp_len=len(payload))
+        if ptype == _TNS_REFUSE:
+            reason = self._descriptor_field(payload[8:], b"ERR")
+            code = int(reason) if reason.isdigit() else 1
+            return L7Record(self.proto, MSG_RESPONSE, status=code,
+                            endpoint="REFUSED", resp_len=len(payload))
+        if ptype != _TNS_DATA or len(payload) < 11:
+            return None                    # markers/redirects: not log events
+        data = payload[10:]                # skip 2-byte data flags
+        if not data:
+            return None
+        data_id = data[0]
+        if data_id == 0x03 and len(data) >= 2:     # user OCI function
+            call = _OCI_CALLS.get(data[1], f"CALL_{data[1]:02x}")
+            # statement text rides in the TTI payload surrounded by
+            # binary TTC fields and bind data: bound the slice at the
+            # first non-printable byte BEFORE obfuscating, so control
+            # bytes and out-of-band bind values (PII) can never leak
+            # into the endpoint
+            tail = data[2:]
+            end = 0
+            while end < len(tail) and 0x20 <= tail[end] < 0x7F:
+                end += 1
+            text = tail[:end]
+            verb = sql_verb(text)
+            sql = obfuscate_sql(text) if verb else ""
+            endpoint = (f"{call} {sql}".strip() if sql else call)[:128]
+            return L7Record(self.proto, MSG_REQUEST, endpoint=endpoint,
+                            req_len=len(payload))
+        if data_id == 0x04 and len(data) >= 5:     # return status
+            # sequence# then a u16 return code in the common layout
+            code = struct.unpack_from(">H", data, 3)[0]
+            return L7Record(self.proto, MSG_RESPONSE, status=code,
+                            resp_len=len(payload))
+        return None
+
+
+# registered last: the TNS check is structural (type byte + frame
+# length) rather than magic-byte, so every stronger check goes first
+EXTENDED_PARSERS.append(OracleParser())

@@ -236,12 +236,16 @@ def make_staged_update(cfg: FlowSuiteConfig):
             # j1 already donated the old state; mid is the only valid
             # state left. Skip this batch's ring admission (standing
             # candidates rescore from the full sketch next batch) rather
-            # than leaving the caller holding deleted buffers.
+            # than leaving the caller holding deleted buffers. The
+            # counter makes the skip observable in deepflow_system (the
+            # tpu_sketch exporter surfaces it), not just in logs.
+            staged_update.admission_failures += 1
             logging.getLogger(__name__).exception(
                 "staged ring admission failed; batch skipped")
             return mid
         return mid._replace(ring=ring)
 
+    staged_update.admission_failures = 0
     return staged_update
 
 

@@ -291,6 +291,11 @@ class TpuSketchExporter(QueueWorkerExporter):
     def counters(self) -> dict:
         c = super().counters()
         c.update({"rows_in": self.rows_in, "windows": self.windows})
+        # staged-update admission skips (flow_suite.make_staged_update):
+        # bounded data loss that must show in deepflow_system, not logs
+        failures = getattr(self._update, "admission_failures", None)
+        if failures is not None:
+            c["ring_admission_failures"] = failures
         if self.checkpointer is not None:
             c.update(self.checkpointer.counters())
         return c

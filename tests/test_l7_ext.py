@@ -488,3 +488,57 @@ def test_hpack_dynamic_table_eviction():
     # size update to 0 flushes everything
     d.decode(bytes([0x20]))
     assert d._entry(62) == ("", "")
+
+
+# ------------------------------------------------------------- Oracle --
+
+def _tns(ptype, body):
+    ln = 8 + len(body)
+    return struct.pack(">HHBBH", ln, 0, ptype, 0, 0) + body
+
+
+def test_oracle_tns_connect_and_accept():
+    from deepflow_tpu.agent.l7_ext import L7_ORACLE
+
+    desc = (b"(DESCRIPTION=(CONNECT_DATA=(SERVICE_NAME=orcl.prod)"
+            b"(CID=(PROGRAM=sqlplus)))(ADDRESS=(HOST=db1)(PORT=1521)))")
+    conn = _tns(1, b"\x01\x36\x01\x2c" + b"\x00" * 22 + desc)
+    rec = _dispatch(conn, pd=1521)
+    assert rec is not None and rec.proto == L7_ORACLE
+    assert rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "CONNECT orcl.prod"
+    acc = _tns(2, b"\x01\x36\x00\x00" + b"\x00" * 16)
+    rec = _dispatch(acc, pd=1521)
+    assert rec.proto == L7_ORACLE and rec.msg_type == MSG_RESPONSE
+    assert rec.status == 0
+
+
+def test_oracle_tns_refuse_and_oci_call():
+    from deepflow_tpu.agent.l7_ext import L7_ORACLE
+
+    ref = _tns(4, b"\x01\x01\x00\x10(ERR=12514)(DESCRIPTION=x)")
+    rec = _dispatch(ref, pd=1521)
+    assert rec.msg_type == MSG_RESPONSE and rec.status == 12514
+    # DATA + user OCI function 0x5e with embedded SQL
+    sql = b"SELECT name FROM users WHERE id = 7"
+    data = _tns(6, b"\x00\x00" + b"\x03\x5e" + sql)
+    rec = _dispatch(data, pd=1521)
+    assert rec.proto == L7_ORACLE and rec.msg_type == MSG_REQUEST
+    assert rec.endpoint.startswith("QUERY SELECT")
+    assert "7" not in rec.endpoint          # literals obfuscated
+
+
+def test_oracle_binds_and_binary_never_leak():
+    """The TTI payload carries binary fields + bind values after the
+    statement: nothing past the first non-printable byte may reach the
+    endpoint (the sql_obfuscate PII contract)."""
+    from deepflow_tpu.agent.l7_ext import L7_ORACLE
+
+    sql = b"SELECT a FROM t WHERE e = :1"
+    binds = b"\x00\x17\x02user@example.com\x01\x7f"
+    data = _tns(6, b"\x00\x00" + b"\x03\x5e" + sql + binds)
+    rec = _dispatch(data, pd=1521)
+    assert rec.proto == L7_ORACLE
+    assert "user@example.com" not in rec.endpoint
+    assert all(0x20 <= ord(c) < 0x7F for c in rec.endpoint)
+    assert len(rec.endpoint) <= 128

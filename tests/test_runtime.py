@@ -251,3 +251,21 @@ def test_debug_stacks():
         assert all(":" in f for f in frames)
     finally:
         srv.close()
+
+
+def test_staged_update_failure_counter_surfaces():
+    """A staged ring-admission failure is observable through the
+    exporter's counters (deepflow_system), not only in logs."""
+    from deepflow_tpu.models import flow_suite
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+
+    exp = TpuSketchExporter(store=None, window_seconds=3600, staged=True)
+    try:
+        assert exp.counters().get("ring_admission_failures") == 0
+        exp._update.admission_failures += 1   # simulate a skipped batch
+        assert exp.counters()["ring_admission_failures"] == 1
+        # the attribute is part of make_staged_update's contract
+        fn = flow_suite.make_staged_update(exp.cfg)
+        assert fn.admission_failures == 0
+    finally:
+        exp.close()
