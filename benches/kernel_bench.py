@@ -223,7 +223,8 @@ def main() -> None:
                 dt = time.perf_counter() - t0
                 r = {"bench": "native_decode_mt" if threads == 0
                      else "native_decode",
-                     "shape": f"[{nrec}] TaggedFlow, 93 cols",
+                     "shape": f"[{nrec}] TaggedFlow, "
+                     f"{len(native.L4_COLS32) + len(native.L4_COLS64)} cols",
                      "backend": "host",
                      "ms_per_iter": round(1e3 * dt / iters, 3),
                      "rows_per_sec": round(nrec * iters / dt)}

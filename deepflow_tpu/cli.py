@@ -208,13 +208,17 @@ def cmd_capture(args) -> int:
     dispatcher recv_engine; requires CAP_NET_RAW)."""
     import time as _time
 
-    from deepflow_tpu.agent.afpacket import AfPacketSource, CaptureLoop
+    from deepflow_tpu.agent.afpacket import (AfPacketSource, CaptureLoop,
+                                             TpacketV3Source)
     from deepflow_tpu.agent.trident import Agent, AgentConfig
 
     try:
         # open the capture socket FIRST: the common failure (missing
         # CAP_NET_RAW) must not leave a started agent behind
-        source = AfPacketSource(iface=args.iface)
+        if args.ring:
+            source = TpacketV3Source(iface=args.iface)
+        else:
+            source = AfPacketSource(iface=args.iface)
     except PermissionError:
         print("error: live capture requires CAP_NET_RAW (run as root)",
               file=sys.stderr)
@@ -232,9 +236,15 @@ def cmd_capture(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # kernel drop stats come off the live socket: read BEFORE close
+        stats = source.statistics() if hasattr(source, "statistics") \
+            else None
         loop.close()
         agent.close()
-    print(json.dumps({**loop.counters(), **agent.counters()}))
+    counters = {**loop.counters(), **agent.counters()}
+    if stats is not None:
+        counters["kernel_packets"], counters["kernel_drops"] = stats
+    print(json.dumps(counters))
     return 0
 
 
@@ -334,6 +344,9 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("--seconds", type=float, default=0,
                     help="capture duration (0 = until interrupt)")
     cp.add_argument("--no-l7", action="store_true")
+    cp.add_argument("--ring", action="store_true",
+                    help="TPACKET_V3 mmap ring (zero per-packet "
+                         "syscalls, kernel timestamps + drop counters)")
     cp.set_defaults(fn=cmd_capture)
 
     rp = sub.add_parser("replay-pcap",
