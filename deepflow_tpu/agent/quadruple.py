@@ -81,9 +81,18 @@ def documents_to_records(doc_cols: Dict[str, np.ndarray]) -> List[bytes]:
     out: List[bytes] = []
     if not doc_cols:
         return out
+    # zerodoc Code bitmask for the dimension set this generator tags
+    # over: IP | Protocol | ServerPort | VTAPID (tag.go:36-95 bit
+    # layout) — receivers group per code, so documents with different
+    # dimension sets never merge
+    code = (0x1            # IP
+            | (1 << 42)    # Protocol
+            | (1 << 43)    # ServerPort
+            | (1 << 47))   # VTAPID
     for i in range(len(doc_cols["ip"])):
         d = metric_pb2.Document()
         d.timestamp = int(doc_cols["timestamp"][i])
+        d.tag.code = code
         fld = d.tag.field
         fld.ip = int(doc_cols["ip"][i]).to_bytes(4, "big")
         fld.server_port = int(doc_cols["server_port"][i])

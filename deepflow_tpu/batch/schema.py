@@ -345,7 +345,11 @@ METRIC_SCHEMA = Schema(
     name="flow_metrics",
     columns=(
         ("timestamp", _U32),
-        # tag dimensions
+        # tag dimensions. tag_code is the zerodoc Code bitmask (tag.go
+        # :36-95): WHICH dimensions this Document's tag carries — part
+        # of grouping identity, so Documents tagged over different
+        # dimension sets never merge (the reference's per-Code tables)
+        ("tag_code", _U64),
         ("ip", _U32),
         ("server_port", _U32),
         ("vtap_id", _U32),

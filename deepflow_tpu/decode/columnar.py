@@ -426,6 +426,7 @@ def decode_metric_records(records: Iterable[bytes],
         app = d.meter.app
         v = {
             "timestamp": d.timestamp,
+            "tag_code": int(d.tag.code),
             "ip": _u32(ip), "server_port": fld.server_port,
             "vtap_id": fld.vtap_id, "protocol": fld.protocol,
             "l3_epc_id": _u32(fld.l3_epc_id),

@@ -42,6 +42,15 @@ class FlowMetricsPipeline:
         self.rollups: Optional[RollupManager] = None
         self.rollup_period = rollup_period
         if store is not None:
+            # replay schema-evolution history first: a data root written
+            # by an older build must gain new columns (tag_code, ...)
+            # before the rollup manager snapshots the schema
+            from deepflow_tpu.pipelines.schemas import \
+                register_standard_migrations
+            from deepflow_tpu.store.migrate import Issu
+            issu = Issu(store, FLOW_METRICS_DB)
+            register_standard_migrations(issu)
+            issu.run()
             self.rollups = RollupManager(store, FLOW_METRICS_DB,
                                          METRICS_TABLE,
                                          intervals=rollup_intervals)

@@ -102,7 +102,7 @@ L7_TABLE = TableSchema(
     ttl_seconds=3 * 24 * 3600,
 )
 
-_METRIC_KEYS = {"timestamp", "ip", "server_port", "vtap_id", "protocol",
+_METRIC_KEYS = {"timestamp", "tag_code", "ip", "server_port", "vtap_id", "protocol",
                 "l3_epc_id", "direction", "tap_side", "tap_type",
                 "tap_port", "l7_protocol", "gprocess_id", "signal_source",
                 "pod_id", "app_service_hash", "endpoint_hash"}
@@ -132,9 +132,24 @@ _METRIC_AGG = {
 }
 
 # reference table name: flow_metrics."vtap_flow_port.1s"
+# version 2: + tag_code (zerodoc Code bitmask as grouping identity)
 METRICS_TABLE = TableSchema(
     name="vtap_flow_port",
     columns=_lift(METRIC_SCHEMA, _METRIC_KEYS, _METRIC_AGG),
     time_column="timestamp",
     ttl_seconds=3 * 24 * 3600,
+    version=2,
 )
+
+
+def register_standard_migrations(issu) -> None:
+    """Schema-evolution history for stores created by OLDER builds
+    (reference ckissu role): every schema change lands here alongside
+    its version bump, and the ingester replays them at startup so a
+    pre-change data root picks up new columns instead of silently
+    keeping the old manifest."""
+    from deepflow_tpu.store.migrate import AddColumn
+
+    issu.register(2, AddColumn(
+        "vtap_flow_port",
+        ColumnSpec("tag_code", np.dtype(np.uint64), AggKind.KEY)))

@@ -179,7 +179,11 @@ class SyntheticAgent:
         d = metric_pb2.Document()
         d.timestamp = ts
         d.flags = 0
-        d.tag.code = 0x1
+        # zerodoc Code for the dimensions actually populated below:
+        # IP | Protocol | ServerPort | VTAPID (tag.go bit layout) — must
+        # match agent/quadruple.py so replay and live documents sharing
+        # a dimension set group together
+        d.tag.code = 0x1 | (1 << 42) | (1 << 43) | (1 << 47)
         fld = d.tag.field
         fld.ip = int(self.server_ips[svc % self.n_services]).to_bytes(4, "big")
         fld.server_port = int(self.server_ports[svc % self.n_services])

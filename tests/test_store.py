@@ -309,3 +309,35 @@ def test_group_reduce_no_aggregates_is_dedup():
     assert out["k"].tolist() == [1, 2, 3]
     out = group_reduce(cols, ["k"], {}, method="device")  # host fallback
     assert out["k"].tolist() == [1, 2, 3]
+
+
+def test_standard_migrations_upgrade_old_metrics_store(tmp_path):
+    """A data root written before tag_code existed gains the column on
+    ingester startup (register_standard_migrations replay), so the
+    never-merge-across-codes grouping invariant holds after upgrade."""
+    import dataclasses
+
+    from deepflow_tpu.pipelines.schemas import (METRICS_TABLE,
+                                                register_standard_migrations)
+
+    # simulate the OLD build: same table, no tag_code, version 1
+    old = dataclasses.replace(
+        METRICS_TABLE,
+        columns=tuple(c for c in METRICS_TABLE.columns
+                      if c.name != "tag_code"),
+        version=1)
+    store = Store(str(tmp_path))
+    t = store.create_table("flow_metrics", old)
+    assert "tag_code" not in t.schema.column_names
+
+    issu = Issu(store, "flow_metrics")
+    register_standard_migrations(issu)
+    touched = issu.run()
+    assert touched == {"vtap_flow_port": 2}
+    t2 = store.table("flow_metrics", "vtap_flow_port")
+    assert "tag_code" in t2.schema.column_names
+    assert t2.schema.version == 2
+    # re-run is a no-op (idempotent)
+    issu2 = Issu(store, "flow_metrics")
+    register_standard_migrations(issu2)
+    assert issu2.run() == {}

@@ -108,3 +108,23 @@ def test_malformed_headers_rejected_not_looped():
     r = FrameReader()
     with pytest.raises(ValueError):                 # unknown message type
         list(r.feed((20).to_bytes(4, "big") + bytes([99]) + b"x" * 15))
+
+
+def test_metric_tag_code_roundtrip():
+    """The zerodoc Code bitmask travels the Document wire and lands as a
+    grouping dimension: documents tagged over different dimension sets
+    must never merge (tag.go:36-95)."""
+    from deepflow_tpu.agent.quadruple import documents_to_records
+
+    doc_cols = {k: np.asarray(v) for k, v in {
+        "timestamp": [1700000000], "ip": [0x0A000001],
+        "server_port": [80], "vtap_id": [1], "protocol": [6],
+        "packet_tx": [5], "packet_rx": [5], "byte_tx": [500],
+        "byte_rx": [900], "new_flow": [1], "closed_flow": [0],
+        "retrans": [0], "rtt_sum": [100], "rtt_count": [1],
+    }.items()}
+    recs = documents_to_records(doc_cols)
+    cols = decode_metric_records(recs)
+    want = 0x1 | (1 << 42) | (1 << 43) | (1 << 47)
+    assert cols["tag_code"].dtype == np.uint64
+    assert int(cols["tag_code"][0]) == want
