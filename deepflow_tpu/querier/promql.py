@@ -40,6 +40,8 @@ _UNIT_S = {"s": 1, "m": 60, "h": 3600, "d": 86400}
 
 AGG_OPS = ("sum", "avg", "max", "min", "count")
 RANGE_FUNCS = ("rate", "irate", "increase", "delta")
+OVER_TIME_FUNCS = ("avg_over_time", "max_over_time", "min_over_time",
+                   "sum_over_time", "count_over_time", "last_over_time")
 
 
 # -- AST -------------------------------------------------------------------
@@ -76,7 +78,17 @@ class Num:
     value: float
 
 
-Expr = Union[Selector, Func, AggExpr, Bin, Num]
+@dataclass(frozen=True)
+class Subquery:
+    """expr[range:step] — the inner expression evaluated on its own
+    step grid inside each outer window (promql subquery semantics)."""
+    expr: "Expr"
+    range_s: int
+    step_s: int
+    offset_s: int = 0
+
+
+Expr = Union[Selector, Func, AggExpr, Bin, Num, Subquery]
 
 
 def _selectors(e: Expr) -> List[Selector]:
@@ -88,6 +100,8 @@ def _selectors(e: Expr) -> List[Selector]:
         return _selectors(e.arg)
     if isinstance(e, Bin):
         return _selectors(e.left) + _selectors(e.right)
+    if isinstance(e, Subquery):
+        return _selectors(e.expr)
     return []
 
 
@@ -98,7 +112,7 @@ _TOKEN = re.compile(r"""
       | \d+(?:\.\d+)?[smhd]               # duration
       | \d+\.\d+ | \.\d+ | \d+            # number
       | [A-Za-z_:][A-Za-z0-9_:.]*         # ident
-      | =~ | !~ | != | [()\[\]{},=+*/-]
+      | =~ | !~ | != | [()\[\]{},=+*/:-]
     )""", re.VERBOSE)
 
 
@@ -171,7 +185,7 @@ class _Parser:
             self.next()
             e = self.expr()
             self.expect(")")
-            return e
+            return self._maybe_subquery(e)
         if re.fullmatch(r"\d+\.\d+|\.\d+|\d+", t):
             self.next()
             return Num(float(t))
@@ -197,15 +211,18 @@ class _Parser:
                     names.append(self.next())
                     self.accept(",")
                 by = tuple(names)
-            return AggExpr(low, by, arg)
-        if low in RANGE_FUNCS and self.peek() == "(":
+            return self._maybe_subquery(AggExpr(low, by, arg))
+        if low in RANGE_FUNCS + OVER_TIME_FUNCS and self.peek() == "(":
             self.next()
             arg = self.expr()
             self.expect(")")
-            if not isinstance(arg, Selector) or arg.range_s is None:
+            ranged = (isinstance(arg, Subquery)
+                      or (isinstance(arg, Selector)
+                          and arg.range_s is not None))
+            if not ranged:
                 raise ValueError(f"{low}() needs a range vector "
-                                 f"(metric[5m])")
-            return Func(low, (arg,))
+                                 f"(metric[5m] or a subquery)")
+            return self._maybe_subquery(Func(low, (arg,)))
         if low == "histogram_quantile" and self.peek() == "(":
             self.next()
             phi = self.expr()
@@ -215,9 +232,48 @@ class _Parser:
             if not isinstance(phi, Num):
                 raise ValueError("histogram_quantile needs a scalar "
                                  "quantile as its first argument")
-            return Func("histogram_quantile", (phi, arg))
+            return self._maybe_subquery(
+                Func("histogram_quantile", (phi, arg)))
         # plain selector
         return self.selector(ident)
+
+    def _accept_colon_duration(self) -> Optional[int]:
+        """The subquery ':step' — ':' fuses into the next token because
+        the ident class allows recording-rule colons; accept either
+        ':<dur>' as one token or ':' followed by a duration."""
+        t = self.peek()
+        if t is None:
+            return None
+        if t == ":":
+            self.next()
+            if self.peek() == "]":
+                return 0                    # expr[1h:] — default step
+            return _duration_s(self.next())
+        if t.startswith(":") and len(t) > 1:
+            self.next()
+            return _duration_s(t[1:])
+        return None
+
+    def _maybe_subquery(self, e: Expr) -> Expr:
+        """[range:step] suffix after a non-selector expression."""
+        if self.peek() != "[":
+            return e
+        # lookahead: a ':' inside the brackets makes it a subquery; a
+        # plain [dur] after a non-selector is an error promql rejects
+        save = self.i
+        self.next()
+        rng = _duration_s(self.next())
+        step = self._accept_colon_duration()
+        if step is None:
+            self.i = save
+            return e
+        self.expect("]")
+        # step 0 = "default resolution": resolved at evaluation time
+        offset_s = 0
+        if (self.peek() or "").lower() == "offset":
+            self.next()
+            offset_s = _duration_s(self.next())
+        return Subquery(e, rng, step, offset_s)
 
     def selector(self, metric: str) -> Selector:
         matchers: List[Tuple[str, str, str]] = []
@@ -234,13 +290,21 @@ class _Parser:
                 matchers.append((name, op, val[1:-1]))
                 self.accept(",")
         range_s = None
+        sub = None
         if self.accept("["):
             range_s = _duration_s(self.next())
+            step = self._accept_colon_duration()
+            if step is not None:            # metric[30m:1m] subquery
+                sub = (range_s, step)
+                range_s = None
             self.expect("]")
         offset_s = 0
         if (self.peek() or "").lower() == "offset":
             self.next()
             offset_s = _duration_s(self.next())
+        if sub is not None:
+            return Subquery(Selector(metric, tuple(matchers), None, 0),
+                            sub[0], sub[1], offset_s)
         return Selector(metric, tuple(matchers), range_s, offset_s)
 
 
@@ -315,6 +379,10 @@ class _Evaluator:
     def __init__(self, engine: "PromEngine", grid: np.ndarray) -> None:
         self.engine = engine
         self.grid = grid
+        # default subquery resolution (expr[1h:]): the outer grid's own
+        # step, or the conventional 15s scrape interval for instants
+        self.default_step = int(grid[1] - grid[0]) if len(grid) > 1 \
+            else 15
         # one table scan per distinct (lo, hi) window per evaluation:
         # `rps / rps` must not rescan identical data per selector
         self._scan_cache: Dict[Tuple[int, int], dict] = {}
@@ -327,6 +395,8 @@ class _Evaluator:
         if isinstance(e, Func):
             if e.name in RANGE_FUNCS:
                 return self._range_fn(e.name, e.args[0])
+            if e.name in OVER_TIME_FUNCS:
+                return self._over_time(e.name, e.args[0])
             if e.name == "histogram_quantile":
                 phi = e.args[0].value
                 return self._histogram_quantile(phi, self.eval(e.args[1]))
@@ -370,23 +440,96 @@ class _Evaluator:
                 out.append((labels, vals))
         return out
 
-    def _range_fn(self, name: str, sel: Selector) -> SeriesList:
-        g = self.grid - sel.offset_s
-        lo = int(g.min()) - sel.range_s
-        hi = int(g.max()) + 1
+    def _range_samples(self, node, g: np.ndarray):
+        """Per-series raw samples for a range argument: a Selector with
+        a range reads the store; a Subquery EVALUATES its inner
+        expression on the subquery's own step grid (promql subquery
+        semantics) and treats the finite points as samples."""
+        if isinstance(node, Selector):
+            lo = int(g.min()) - node.range_s
+            hi = int(g.max()) + 1
+            return self._fetch(node, lo, hi), node.range_s
+        assert isinstance(node, Subquery)
+        sg = node
+        step = sg.step_s or self.default_step
+        start = int(g.min()) - sg.range_s - sg.offset_s
+        end = int(g.max()) - sg.offset_s
+        # promql anchors subquery evaluation times at ABSOLUTE multiples
+        # of the step — otherwise the same historical window returns
+        # different values depending on when it is asked for
+        first = (start // step + 1) * step
+        sub_grid = np.arange(first, end + 1, step, dtype=np.int64)
+        inner = _Evaluator(self.engine, sub_grid).eval(sg.expr)
+        out = []
+        for labels, vals in inner:
+            keep = ~np.isnan(vals)
+            if keep.any():
+                out.append((labels, sub_grid[keep] + sg.offset_s,
+                            vals[keep]))
+        return out, sg.range_s
+
+    def _range_fn(self, name: str, node) -> SeriesList:
+        offset = node.offset_s if isinstance(node, Selector) else 0
+        g = self.grid - offset
+        series, range_s = self._range_samples(node, g)
         out: SeriesList = []
-        for labels, ts, vs in self._fetch(sel, lo, hi):
+        for labels, ts, vs in series:
             if name == "irate":
-                vals = self._irate(ts, vs, g, sel.range_s)
+                vals = self._irate(ts, vs, g, range_s)
             else:
                 vals = _extrapolated(
-                    ts, vs, g, sel.range_s,
+                    ts, vs, g, range_s,
                     is_counter=name in ("rate", "increase"),
                     is_rate=name == "rate")
             if not np.isnan(vals).all():
                 # rate() drops the metric name upstream; matchers keep
                 # label identity
                 out.append((labels, vals))
+        return out
+
+    def _over_time(self, name: str, node) -> SeriesList:
+        """avg/max/min/sum/count/last _over_time: aggregate the raw
+        samples inside each grid point's (t - range, t] window."""
+        offset = node.offset_s if isinstance(node, Selector) else 0
+        g = self.grid - offset
+        series, range_s = self._range_samples(node, g)
+        out: SeriesList = []
+        for labels, ts, vs in series:
+            lo = np.searchsorted(ts, g - range_s, side="right")
+            hi = np.searchsorted(ts, g, side="right")
+            valid = hi > lo
+            vals = np.full(len(g), np.nan)
+            if not valid.any():
+                continue
+            # one vectorized pass per window shape (the module's
+            # columnar discipline): cumsum differences for sum/count/
+            # avg/last, paired reduceat for max/min (a sentinel pad
+            # keeps the trailing hi == len(vs) index legal)
+            if name in ("sum_over_time", "count_over_time",
+                        "avg_over_time"):
+                cs = np.concatenate([[0.0], np.cumsum(vs)])
+                sums = cs[hi] - cs[lo]
+                cnt = (hi - lo).astype(np.float64)
+                if name == "sum_over_time":
+                    res = sums
+                elif name == "count_over_time":
+                    res = cnt
+                else:
+                    with np.errstate(invalid="ignore"):
+                        res = sums / np.maximum(cnt, 1)
+            elif name == "last_over_time":
+                res = vs[np.maximum(hi - 1, 0)]
+            else:
+                sentinel = -np.inf if name == "max_over_time" else np.inf
+                ufn = np.maximum if name == "max_over_time" \
+                    else np.minimum
+                vs_p = np.append(vs, sentinel)
+                pairs = np.column_stack(
+                    [lo, np.maximum(hi, lo + 1)]).ravel()
+                res = ufn.reduceat(vs_p, pairs)[::2]
+            vals = np.where(valid, res, np.nan)
+            if not np.isnan(vals).all():
+                out.append((_drop_name(labels), vals))
         return out
 
     @staticmethod

@@ -981,3 +981,54 @@ def test_promql_range_histogram_quantile(prom):
     # rank 25 of 100 (then 50 of 200) -> within (0,1]: 0.5 both points
     assert [float(v) for _, v in res[0]["values"]] == \
         pytest.approx([0.5, 0.5])
+
+
+def test_promql_over_time_functions(prom):
+    eng, _, _ = prom
+    # samples: api = 10..19 at t = 1000,1010,...,1090
+    out = eng.query('max_over_time(rps{job="api"}[1m])', at=1090)
+    # window (1030, 1090]: samples 14..19 -> max 19
+    assert float(out[0]["value"][1]) == 19.0
+    out = eng.query('avg_over_time(rps{job="api"}[1m])', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(np.mean(
+        [14, 15, 16, 17, 18, 19]))
+    out = eng.query('sum_over_time(rps{job="api"}[1m])', at=1090)
+    assert float(out[0]["value"][1]) == sum([14, 15, 16, 17, 18, 19])
+    out = eng.query('count_over_time(rps{job="api"}[1m])', at=1090)
+    assert float(out[0]["value"][1]) == 6.0
+    out = eng.query('last_over_time(rps{job="api"}[1m])', at=1090)
+    assert float(out[0]["value"][1]) == 19.0
+
+
+def test_promql_subquery(prom):
+    eng, _, _ = prom
+    # instant vector evaluated on a 10s sub-grid inside a 60s window:
+    # the series is sampled every 10s so every sub-grid point resolves
+    out = eng.query('max_over_time(rps{job="api"}[1m:10s])', at=1090)
+    assert float(out[0]["value"][1]) == 19.0
+    # rate over a subquery of the raw series behaves like rate over the
+    # raw samples when the sub-grid lands on the sample times
+    out = eng.query('max_over_time(rate(rps{job="api"}[40s])[1m:10s])',
+                    at=1090)
+    assert len(out) == 1
+    assert float(out[0]["value"][1]) > 0
+
+
+def test_promql_subquery_edge_forms(prom):
+    eng, _, _ = prom
+    from deepflow_tpu.querier.promql import parse_promql, Subquery
+    # subquery suffix on aggregations and histogram_quantile
+    e = parse_promql('max_over_time(sum(rate(rps[5m]))[30m:1m])')
+    assert isinstance(e.args[0], Subquery)
+    e2 = parse_promql('max_over_time(histogram_quantile(0.9, x)[30m:1m])')
+    assert isinstance(e2.args[0], Subquery)
+    # default-resolution form: step picked at evaluation time
+    e3 = parse_promql('avg_over_time(rps[1m:])')
+    assert isinstance(e3.args[0], Subquery) and e3.args[0].step_s == 0
+    out = eng.query('avg_over_time(rps{job="api"}[1m:])', at=1090)
+    assert len(out) == 1 and float(out[0]["value"][1]) > 0
+    # absolute step anchoring: asking at t and t+1 for the same window
+    # must sample the same inner timestamps (no refresh jitter)
+    a = eng.query('max_over_time(rps{job="api"}[1m:10s])', at=1090)
+    b = eng.query('max_over_time(rps{job="api"}[1m:10s])', at=1091)
+    assert float(a[0]["value"][1]) == float(b[0]["value"][1])
