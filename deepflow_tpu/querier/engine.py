@@ -67,6 +67,8 @@ class QueryEngine:
         stmt = Q.parse_sql(sql_text)
         if isinstance(stmt, Q.Show):
             return self._show(stmt, db)
+        if isinstance(stmt, Q.With):
+            return self._with(stmt, db)
         return self._select(stmt, db)
 
     # -- SHOW --------------------------------------------------------------
@@ -198,6 +200,69 @@ class QueryEngine:
         out_rows = self._order_limit(stmt, out_cols, out_rows)
         out_rows = self._humanize(out_cols, out_rows)
         return QueryResult(out_cols, out_rows)
+
+    def _with(self, stmt: Q.With, db: Optional[str]) -> QueryResult:
+        """WITH q1 AS (...), q2 AS (...) SELECT ... FROM q1 [LEFT] JOIN
+        q2 ON ... — the reference's Grafana multi-metric panel shape
+        (two aggregated subqueries hash-joined on their shared tags,
+        clickhouse_test.go:452). Each CTE runs through the normal select
+        path (device GROUP BY and all); the join is a host hash join
+        over the (small) aggregated results."""
+        results: Dict[str, QueryResult] = {}
+        for name, sel in stmt.ctes:
+            results[name] = self._select(sel, db)
+        js = stmt.select
+        left, right = results[js.left], results[js.right]
+        lpos = {c: i for i, c in enumerate(left.columns)}
+        rpos = {c: i for i, c in enumerate(right.columns)}
+        for lc, rc in js.on:
+            if lc not in lpos:
+                raise ValueError(f"ON column {lc!r} not produced by "
+                                 f"{js.left} ({left.columns})")
+            if rc not in rpos:
+                raise ValueError(f"ON column {rc!r} not produced by "
+                                 f"{js.right} ({right.columns})")
+        # hash the right side on its key tuple. Duplicate keys would make
+        # the join silently pick one arbitrary row per key — nothing
+        # forces a CTE to aggregate, so enforce it instead of guessing
+        index: Dict[tuple, list] = {}
+        for row in right.values:
+            key = tuple(row[rpos[rc]] for _, rc in js.on)
+            if key in index:
+                raise ValueError(
+                    f"JOIN right side {js.right!r} has duplicate key "
+                    f"{key!r}; GROUP BY the CTE so join keys are unique")
+            index[key] = row
+
+        def resolve(item: Q.SelectItem):
+            qname = item.expr.name
+            qn, _, col = qname.partition(".")
+            if qn == js.left:
+                if col not in lpos:
+                    raise ValueError(f"{qname}: no column {col!r} in "
+                                     f"{js.left}")
+                return ("L", lpos[col])
+            if qn == js.right:
+                if col not in rpos:
+                    raise ValueError(f"{qname}: no column {col!r} in "
+                                     f"{js.right}")
+                return ("R", rpos[col])
+            raise ValueError(f"{qname}: unknown query name {qn!r}")
+
+        plan = [resolve(it) for it in js.items]
+        out_cols = [it.alias or it.expr.name for it in js.items]
+        rows = []
+        for lrow in left.values:
+            key = tuple(lrow[lpos[lc]] for lc, _ in js.on)
+            rrow = index.get(key)
+            if rrow is None and js.join_type != "left":
+                continue
+            rows.append([
+                lrow[i] if side == "L"
+                else (rrow[i] if rrow is not None else None)
+                for side, i in plan])
+        rows = self._order_limit(js, out_cols, rows)
+        return QueryResult(out_cols, rows)
 
     def _having(self, stmt: Q.Select, out_cols: List[str], rows):
         """Post-aggregation row filter on output columns/aliases
@@ -400,14 +465,18 @@ class QueryEngine:
         return out_cols, rows
 
     # -- post --------------------------------------------------------------
-    def _order_limit(self, stmt: Q.Select, out_cols: List[str], rows):
+    def _order_limit(self, stmt, out_cols: List[str], rows):
         # multi-key sort: apply keys in reverse so the stable sort makes
-        # the first ORDER BY key primary
+        # the first ORDER BY key primary. None values (left-join misses)
+        # sort last in either direction.
         for key, desc in reversed(stmt.order_by):
             if key not in out_cols:
                 raise ValueError(f"ORDER BY {key} not in select list")
             idx = out_cols.index(key)
-            rows = sorted(rows, key=lambda r: r[idx], reverse=desc)
+            rows = sorted(rows,
+                          key=lambda r: ((r[idx] is None) ^ desc,
+                                         0 if r[idx] is None else r[idx]),
+                          reverse=desc)
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
         return rows

@@ -121,7 +121,27 @@ class Show:
     table: Optional[str] = None
 
 
-Statement = Union[Select, Show]
+@dataclass(frozen=True)
+class JoinSelect:
+    """The final SELECT of a WITH query: two CTE results joined on an
+    equality conjunction (the reference's Grafana multi-metric panel
+    shape, clickhouse_test.go:452)."""
+    items: List[SelectItem]          # qualified Column("q1.x") refs
+    left: str
+    right: str
+    join_type: str                   # left | inner
+    on: List[Tuple[str, str]]        # (left col, right col) pairs
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class With:
+    ctes: List[Tuple[str, Select]]
+    select: JoinSelect
+
+
+Statement = Union[Select, Show, With]
 
 
 def expr_columns(expr: Expr) -> set:
@@ -203,7 +223,7 @@ class _Parser:
         return Column(t)
 
     # -- clauses -----------------------------------------------------------
-    def parse_select(self) -> Select:
+    def parse_select(self, stop_at_paren: bool = False) -> Select:
         items = []
         if self.accept("*"):
             # SELECT *: expanded to the table's columns by the engine
@@ -241,21 +261,8 @@ class _Parser:
             having.append(self.parse_cond())
             while self.accept("and"):
                 having.append(self.parse_cond())
-        if self.accept("order"):
-            self.expect("by")
-            while True:
-                key = self.next()
-                desc = False
-                if self.accept("desc"):
-                    desc = True
-                elif self.accept("asc"):
-                    pass
-                order_by.append((key, desc))
-                if not self.accept(","):
-                    break
-        if self.accept("limit"):
-            limit = int(self.next())
-        if self.peek() is not None:
+        order_by, limit = self._order_limit_tail()
+        if not stop_at_paren and self.peek() is not None:
             raise ValueError(f"trailing tokens at {self.peek()!r}")
         return Select(items, table, where, group_by, order_by, limit,
                       having)
@@ -274,6 +281,94 @@ class _Parser:
         if t.lower() in ("time", "interval") and self.peek() == "(":
             return self._time_bucket()
         return t
+
+    def parse_with(self) -> "With":
+        ctes: List[Tuple[str, Select]] = []
+        seen = set()
+        while True:
+            name = self.next()
+            if name in seen:
+                raise ValueError(f"duplicate CTE name {name!r}")
+            seen.add(name)
+            self.expect("as")
+            self.expect("(")
+            self.expect("select")
+            ctes.append((name, self.parse_select(stop_at_paren=True)))
+            self.expect(")")
+            if not self.accept(","):
+                break
+        self.expect("select")
+        items = []
+        while True:
+            e = self.parse_expr()
+            if not isinstance(e, Column) or "." not in e.name:
+                raise ValueError("the joined SELECT takes qualified "
+                                 "columns (query1.col [AS alias])")
+            alias = self.next() if self.accept("as") else None
+            items.append(SelectItem(e, alias))
+            if not self.accept(","):
+                break
+        self.expect("from")
+        left = self.next()
+        join_type = "inner"
+        if self.accept("left"):
+            join_type = "left"
+        elif self.accept("inner"):
+            pass
+        self.expect("join")
+        right = self.next()
+        self.expect("on")
+        on: List[Tuple[str, str]] = []
+        while True:
+            a = self.next()
+            self.expect("=")
+            b = self.next()
+            for side in (a, b):
+                if "." not in side:
+                    raise ValueError(f"ON needs qualified columns, "
+                                     f"got {side!r}")
+            # normalize so the left CTE's column comes first
+            la, ca = a.split(".", 1)
+            lb, cb = b.split(".", 1)
+            if la == left and lb == right:
+                on.append((ca, cb))
+            elif la == right and lb == left:
+                on.append((cb, ca))
+            else:
+                raise ValueError(f"ON references unknown query "
+                                 f"names: {a} = {b}")
+            if not self.accept("and"):
+                break
+        order_by, limit = self._order_limit_tail()
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens at {self.peek()!r}")
+        names = {n for n, _ in ctes}
+        if left not in names or right not in names:
+            raise ValueError(f"JOIN references undefined query "
+                             f"({left}, {right})")
+        return With(ctes, JoinSelect(items, left, right, join_type, on,
+                                     order_by, limit))
+
+    def _order_limit_tail(self):
+        """The shared `ORDER BY k [ASC|DESC], ... LIMIT n` clause tail
+        (plain selects and joined WITH-selects parse it identically)."""
+        order_by: List[Tuple[str, bool]] = []
+        if self.accept("order"):
+            self.expect("by")
+            while True:
+                key = self.next()
+                desc = False
+                if self.accept("desc"):
+                    desc = True
+                elif self.accept("asc"):
+                    pass
+                order_by.append((key, desc))
+                if not self.accept(","):
+                    break
+        limit = None
+        if self.accept("limit"):
+            limit = int(self.next())
+        return order_by, limit
 
     def parse_cond(self) -> Cond:
         col = self.next()
@@ -306,6 +401,8 @@ def parse_sql(sql: str) -> Statement:
     head = p.next().lower()
     if head == "select":
         return p.parse_select()
+    if head == "with":
+        return p.parse_with()
     if head == "show":
         what = p.next().lower()
         if what == "databases":

@@ -1032,3 +1032,65 @@ def test_promql_subquery_edge_forms(prom):
     a = eng.query('max_over_time(rps{job="api"}[1m:10s])', at=1090)
     b = eng.query('max_over_time(rps{job="api"}[1m:10s])', at=1091)
     assert float(a[0]["value"][1]) == float(b[0]["value"][1])
+
+
+def test_with_join_two_queries(engine):
+    """The reference's Grafana panel shape: two aggregated CTEs joined
+    on their shared tag (clickhouse_test.go:452)."""
+    eng, cols = engine
+    r = eng.execute(
+        "WITH q1 AS (SELECT ip, Sum(bytes) AS b FROM flows "
+        "WHERE proto = 6 GROUP BY ip), "
+        "q2 AS (SELECT ip, Count(*) AS n FROM flows "
+        "WHERE proto = 17 GROUP BY ip) "
+        "SELECT q1.ip, q1.b AS b, q2.n FROM q1 LEFT JOIN q2 "
+        "ON q1.ip = q2.ip ORDER BY b DESC")
+    assert r.columns == ["q1.ip", "b", "q2.n"]
+    m6 = cols["proto"] == 6
+    m17 = cols["proto"] == 17
+    for ip, b, n in r.values:
+        assert b == int(cols["bytes"][m6 & (cols["ip"] == ip)].sum())
+        assert n == int((m17 & (cols["ip"] == ip)).sum())
+    # descending by b
+    bs = [row[1] for row in r.values]
+    assert bs == sorted(bs, reverse=True)
+
+
+def test_with_inner_join_drops_unmatched(engine):
+    eng, cols = engine
+    r = eng.execute(
+        "WITH a AS (SELECT ip, Count(*) AS n FROM flows "
+        "WHERE ip IN (1, 2) GROUP BY ip), "
+        "b AS (SELECT ip, Count(*) AS m FROM flows "
+        "WHERE ip IN (2, 3) GROUP BY ip) "
+        "SELECT a.ip, a.n AS left_n, b.m FROM a JOIN b ON a.ip = b.ip")
+    assert r.columns == ["a.ip", "left_n", "b.m"]
+    assert [row[0] for row in r.values] == [2]     # only the overlap
+    assert all(v is not None for row in r.values for v in row)
+
+
+def test_left_join_none_fill_and_guards(engine):
+    """LEFT JOIN misses fill None and sort last; duplicate right keys
+    and duplicate CTE names are rejected, not silently mis-joined."""
+    eng, cols = engine
+    r = eng.execute(
+        "WITH a AS (SELECT ip, Count(*) AS n FROM flows GROUP BY ip), "
+        "b AS (SELECT ip, Count(*) AS m FROM flows WHERE ip = 2 "
+        "GROUP BY ip) "
+        "SELECT a.ip, b.m AS m FROM a LEFT JOIN b ON a.ip = b.ip "
+        "ORDER BY m DESC")
+    by_ip = {row[0]: row[1] for row in r.values}
+    assert by_ip[2] is not None
+    assert all(v is None for ip, v in by_ip.items() if ip != 2)
+    # None rows sort LAST even descending
+    assert r.values[0][0] == 2 and r.values[-1][1] is None
+    with pytest.raises(ValueError, match="duplicate key"):
+        eng.execute(
+            "WITH a AS (SELECT ip, Count(*) AS n FROM flows GROUP BY ip),"
+            " b AS (SELECT ip, bytes FROM flows) "
+            "SELECT a.ip, b.bytes FROM a JOIN b ON a.ip = b.ip")
+    with pytest.raises(ValueError, match="duplicate CTE"):
+        eng.execute(
+            "WITH q AS (SELECT ip FROM flows GROUP BY ip), "
+            "q AS (SELECT ip FROM flows GROUP BY ip) "
+            "SELECT q.ip FROM q JOIN q ON q.ip = q.ip")
