@@ -477,6 +477,9 @@ class QueryEngine:
                           key=lambda r: ((r[idx] is None) ^ desc,
                                          0 if r[idx] is None else r[idx]),
                           reverse=desc)
+        off = getattr(stmt, "offset", 0)
+        if off:
+            rows = rows[off:]
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
         return rows

@@ -223,17 +223,16 @@ class _Parser:
                 raise ValueError(f"{low}() needs a range vector "
                                  f"(metric[5m] or a subquery)")
             return self._maybe_subquery(Func(low, (arg,)))
-        if low == "histogram_quantile" and self.peek() == "(":
+        if low in ("histogram_quantile", "topk", "bottomk",
+                   "quantile") and self.peek() == "(":
             self.next()
             phi = self.expr()
             self.expect(",")
             arg = self.expr()
             self.expect(")")
             if not isinstance(phi, Num):
-                raise ValueError("histogram_quantile needs a scalar "
-                                 "quantile as its first argument")
-            return self._maybe_subquery(
-                Func("histogram_quantile", (phi, arg)))
+                raise ValueError(f"{low} needs a scalar first argument")
+            return self._maybe_subquery(Func(low, (phi, arg)))
         # plain selector
         return self.selector(ident)
 
@@ -400,6 +399,13 @@ class _Evaluator:
             if e.name == "histogram_quantile":
                 phi = e.args[0].value
                 return self._histogram_quantile(phi, self.eval(e.args[1]))
+            if e.name in ("topk", "bottomk"):
+                return self._topk(int(e.args[0].value),
+                                  self.eval(e.args[1]),
+                                  largest=e.name == "topk")
+            if e.name == "quantile":
+                return self._quantile_agg(e.args[0].value,
+                                          self.eval(e.args[1]))
             raise ValueError(f"unknown function {e.name}")
         if isinstance(e, AggExpr):
             return self._agg(e)
@@ -596,6 +602,51 @@ class _Evaluator:
             if not np.isnan(q).all():
                 out.append((dict(rest), q))
         return out
+
+    @staticmethod
+    def _topk(k: int, series: SeriesList, largest: bool) -> SeriesList:
+        """Per grid point, keep the k highest (lowest) series values;
+        the rest become stale (NaN) — upstream topk/bottomk."""
+        if not series or k <= 0:
+            return []
+        stack = np.vstack([vals for _, vals in series])
+        key = np.where(np.isnan(stack), -np.inf if largest else np.inf,
+                       stack)
+        k_eff = min(k, stack.shape[0])
+        top = np.argpartition(-key if largest else key, k_eff - 1,
+                              axis=0)[:k_eff]
+        keep = np.zeros_like(stack, dtype=bool)
+        keep[top, np.arange(stack.shape[1])] = True
+        keep &= ~np.isnan(stack)
+        out: SeriesList = []
+        for i, (labels, vals) in enumerate(series):
+            v = np.where(keep[i], vals, np.nan)
+            if not np.isnan(v).all():
+                out.append((_drop_name(labels), v))
+        return out
+
+    @staticmethod
+    def _quantile_agg(phi: float, series: SeriesList) -> SeriesList:
+        """quantile(phi, expr): the phi-quantile ACROSS series per grid
+        point (linear interpolation, upstream semantics)."""
+        if not series:
+            return []
+        stack = np.vstack([vals for _, vals in series])
+        dead = np.isnan(stack).all(axis=0)
+        if phi < 0 or phi > 1:
+            # upstream: an out-of-range phi yields -Inf/+Inf, a loud
+            # signal of a bad query — never a plausible-looking value
+            q = np.where(dead, np.nan,
+                         -np.inf if phi < 0 else np.inf)
+            return [({}, q)]
+        # zero-fill all-NaN columns BEFORE nanquantile (it warns on
+        # all-NaN slices), then mask them back to stale
+        q = np.nanquantile(np.where(dead[None, :], 0.0, stack),
+                           phi, axis=0)
+        q = np.where(dead, np.nan, q)
+        if np.isnan(q).all():
+            return []
+        return [({}, q)]
 
     # -- aggregation -------------------------------------------------------
     def _agg(self, e: AggExpr) -> SeriesList:

@@ -113,6 +113,7 @@ class Select:
     limit: Optional[int] = None
     # post-aggregation conditions on output column names/aliases
     having: List[Cond] = field(default_factory=list)
+    offset: int = 0
 
 
 @dataclass(frozen=True)
@@ -133,6 +134,7 @@ class JoinSelect:
     on: List[Tuple[str, str]]        # (left col, right col) pairs
     order_by: List[Tuple[str, bool]] = field(default_factory=list)
     limit: Optional[int] = None
+    offset: int = 0
 
 
 @dataclass(frozen=True)
@@ -261,11 +263,11 @@ class _Parser:
             having.append(self.parse_cond())
             while self.accept("and"):
                 having.append(self.parse_cond())
-        order_by, limit = self._order_limit_tail()
+        order_by, limit, offset = self._order_limit_tail()
         if not stop_at_paren and self.peek() is not None:
             raise ValueError(f"trailing tokens at {self.peek()!r}")
         return Select(items, table, where, group_by, order_by, limit,
-                      having)
+                      having, offset)
 
     def _time_bucket(self) -> TimeBucket:
         self.expect("(")
@@ -339,7 +341,7 @@ class _Parser:
                                  f"names: {a} = {b}")
             if not self.accept("and"):
                 break
-        order_by, limit = self._order_limit_tail()
+        order_by, limit, offset = self._order_limit_tail()
         if self.peek() is not None:
             raise ValueError(f"trailing tokens at {self.peek()!r}")
         names = {n for n, _ in ctes}
@@ -347,7 +349,7 @@ class _Parser:
             raise ValueError(f"JOIN references undefined query "
                              f"({left}, {right})")
         return With(ctes, JoinSelect(items, left, right, join_type, on,
-                                     order_by, limit))
+                                     order_by, limit, offset))
 
     def _order_limit_tail(self):
         """The shared `ORDER BY k [ASC|DESC], ... LIMIT n` clause tail
@@ -366,9 +368,12 @@ class _Parser:
                 if not self.accept(","):
                     break
         limit = None
+        offset = 0
         if self.accept("limit"):
             limit = int(self.next())
-        return order_by, limit
+            if self.accept("offset"):
+                offset = int(self.next())
+        return order_by, limit, offset
 
     def parse_cond(self) -> Cond:
         col = self.next()

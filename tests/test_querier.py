@@ -1094,3 +1094,25 @@ def test_left_join_none_fill_and_guards(engine):
             "WITH q AS (SELECT ip FROM flows GROUP BY ip), "
             "q AS (SELECT ip FROM flows GROUP BY ip) "
             "SELECT q.ip FROM q JOIN q ON q.ip = q.ip")
+
+
+def test_promql_topk_bottomk_quantile(prom):
+    eng, _, _ = prom
+    # api=19, web=109 at t=1090
+    out = eng.query('topk(1, rps)', at=1090)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "web"
+    assert float(out[0]["value"][1]) == 109.0
+    out = eng.query('bottomk(1, rps)', at=1090)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "api"
+    out = eng.query('quantile(0.5, rps)', at=1090)
+    assert len(out) == 1
+    assert float(out[0]["value"][1]) == pytest.approx((19 + 109) / 2)
+
+
+def test_sql_limit_offset(engine):
+    eng, _ = engine
+    full = eng.execute("SELECT ip, Count(*) AS n FROM flows "
+                       "GROUP BY ip ORDER BY ip")
+    page = eng.execute("SELECT ip, Count(*) AS n FROM flows "
+                       "GROUP BY ip ORDER BY ip LIMIT 2 OFFSET 1")
+    assert page.values == full.values[1:3]
