@@ -124,6 +124,15 @@ class QuerierServer:
                             self._send(404, {"error": "trace not found"})
                         else:
                             self._send(200, trace)
+                    elif path == "/v1/l7_tracing":
+                        # the L7FlowTracing role: expand a trace from one
+                        # l7 row over app/syscall/x-request correlations
+                        trace = outer.tempo.l7_tracing(int(p["_id"]),
+                                                       time_range=tr)
+                        if trace is None:
+                            self._send(404, {"error": "row not found"})
+                        else:
+                            self._send(200, trace)
                     elif path == "/api/search/tags":
                         self._send(200, {"tagNames": outer.tempo.tags()})
                     elif path.startswith("/api/search/tag/"):
@@ -180,7 +189,8 @@ class QuerierServer:
                                          "error": str(e)})
                 elif path in ("/v1/profile/flame", "/v1/profile/top"):
                     self._profile(path, params)
-                elif path == "/api/echo" or path.startswith("/api/traces/") \
+                elif path == "/api/echo" or path == "/v1/l7_tracing" \
+                        or path.startswith("/api/traces/") \
                         or path.startswith("/api/search"):
                     self._tempo(path, params)
                 else:

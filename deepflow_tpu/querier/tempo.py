@@ -117,6 +117,74 @@ class TempoQuery:
         spans = [self._span(cols, int(i)) for i in order]
         return {"traceID": trace_id, "spans": spans}
 
+    _TRACING_COLS = _SPAN_COLS + ("syscall_trace_id_request",
+                                  "syscall_trace_id_response",
+                                  "x_request_id_0_hash",
+                                  "x_request_id_1_hash", "_id")
+
+    def l7_tracing(self, row_id: int,
+                   time_range: Optional[Tuple[int, int]] = None,
+                   max_hops: int = 8) -> Optional[dict]:
+        """Distributed tracing WITHOUT instrumentation: starting from one
+        l7 row (_id), expand the span set to a fixpoint over every
+        correlation the row family carries — app trace ids where present,
+        syscall_trace_id_request/response (the eBPF thread-session ids:
+        a service's inbound request and its outbound downstream call
+        share one, agent/ebpf_source.py), and x_request_id pairs. The
+        reference serves this as /v1/stats/querier/L7FlowTracing by
+        delegating to the external deepflow-app service; here the walk
+        is native, vectorized per hop."""
+        cols = self._scan(time_range, columns=self._TRACING_COLS)
+        if cols is None or len(cols["_id"]) == 0:
+            return None
+        in_trace = cols["_id"] == np.uint64(row_id)
+        if not in_trace.any():
+            return None
+
+        def _link_keys(name, mask):
+            vals = cols[name][mask]
+            return vals[vals != 0]
+
+        # frontier expansion: each hop extracts link keys only from the
+        # rows ADDED last hop (earlier rows' keys were already applied)
+        # and tests membership only on rows not yet in the trace
+        frontier = in_trace
+        for _ in range(max_hops):
+            tr = _link_keys("trace_id_hash", frontier)
+            sys_ids = np.concatenate([
+                _link_keys("syscall_trace_id_request", frontier),
+                _link_keys("syscall_trace_id_response", frontier)])
+            xreq = np.concatenate([
+                _link_keys("x_request_id_0_hash", frontier),
+                _link_keys("x_request_id_1_hash", frontier)])
+            new = ~in_trace & (
+                np.isin(cols["trace_id_hash"], tr)
+                | np.isin(cols["syscall_trace_id_request"], sys_ids)
+                | np.isin(cols["syscall_trace_id_response"], sys_ids)
+                | np.isin(cols["x_request_id_0_hash"], xreq)
+                | np.isin(cols["x_request_id_1_hash"], xreq))
+            if not new.any():
+                break
+            in_trace |= new
+            frontier = new
+        idx = np.nonzero(in_trace)[0]
+        order = idx[np.argsort(cols["start_time_us"][idx])]
+        spans = []
+        for i in order:
+            s = self._span(cols, int(i))
+            for attr, col in (("syscall_trace_id.request",
+                               "syscall_trace_id_request"),
+                              ("syscall_trace_id.response",
+                               "syscall_trace_id_response")):
+                v = int(cols[col][i])
+                if v:
+                    s["attributes"][attr] = v
+            s["attributes"]["_id"] = int(cols["_id"][i])
+            spans.append(s)
+        trace_id = next((s["traceID"] for s in spans if s["traceID"]),
+                        f"l7-tracing-{row_id}")
+        return {"traceID": trace_id, "spans": spans}
+
     def search(self, service: Optional[str] = None,
                min_duration_us: int = 0, limit: int = 20,
                time_range: Optional[Tuple[int, int]] = None) -> List[dict]:

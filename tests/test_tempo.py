@@ -152,3 +152,55 @@ def test_parse_duration_and_echo_plain(tempo):
                 ["trace-b"]
     finally:
         srv.close()
+
+
+def test_l7_tracing_chains_syscall_ids(tmp_path):
+    """The reference's signature capability, end to end WITHOUT app
+    instrumentation: eBPF syscall records -> wire -> l7 rows -> one
+    trace. Service A's inbound request and its outbound downstream call
+    share a syscall trace id; its answer to the client shares another —
+    starting from ANY row, l7_tracing reassembles the whole call path."""
+    import urllib.request as _rq
+
+    from deepflow_tpu.decode.columnar import decode_l7_records
+    from deepflow_tpu.pipelines.flow_log import stamp_row_ids
+    from deepflow_tpu.pipelines.schemas import L7_TABLE
+    from deepflow_tpu.querier.server import QuerierServer
+    from tests.test_ebpf_source import _svc_a_conversation, EbpfTracer
+
+    store = Store(str(tmp_path))
+    dicts = TagDictRegistry(str(tmp_path))
+    t = store.create_table("flow_log", L7_TABLE)
+    tracer = EbpfTracer(vtap_id=3)
+    wires = _svc_a_conversation(tracer)
+    cols = decode_l7_records(wires,
+                             endpoint_dict=dicts.get("l7_endpoint"))
+    # KG columns the store schema carries but decode doesn't produce
+    full = {spec.name: cols.get(
+        spec.name, np.zeros(len(cols["ip_src"]), spec.dtype))
+        for spec in L7_TABLE.columns}
+    stamp_row_ids(full)
+    t.append(full)
+
+    tq = TempoQuery(store, dicts)
+    for seed in full["_id"]:
+        trace = tq.l7_tracing(int(seed))
+        assert trace is not None
+        ids = {s["attributes"]["_id"] for s in trace["spans"]}
+        assert ids == {int(x) for x in full["_id"]}, \
+            "both sessions must chain into one trace"
+    # spans carry the syscall ids they linked on
+    spans = tq.l7_tracing(int(full["_id"][0]))["spans"]
+    assert any("syscall_trace_id.request" in s["attributes"]
+               for s in spans)
+
+    # the HTTP surface (the reference's L7FlowTracing route)
+    srv = QuerierServer(store, dicts, port=0)
+    srv.start()
+    try:
+        with _rq.urlopen(f"http://127.0.0.1:{srv.port}/v1/l7_tracing"
+                         f"?_id={int(full['_id'][0])}", timeout=5) as r:
+            doc = json.load(r)
+        assert len(doc["spans"]) == len(full["_id"])
+    finally:
+        srv.close()
