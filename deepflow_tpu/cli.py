@@ -185,6 +185,24 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Expand an L7 trace from one row id (the L7FlowTracing role):
+    app trace ids + eBPF syscall ids + x-request ids, no
+    instrumentation required."""
+    out = _http(f"{args.querier}/v1/l7_tracing?_id={args.id}")
+    rows = [[s["attributes"].get("_id", "-"),
+             s["operationName"] or "-",
+             s["attributes"].get("ip.src", "-"),
+             s["attributes"].get("ip.dst", "-"),
+             s["attributes"].get("syscall_trace_id.request", "-"),
+             s["attributes"].get("syscall_trace_id.response", "-"),
+             s["durationNanos"] // 1000]
+            for s in out["spans"]]
+    _table(rows, ["_ID", "OPERATION", "SRC", "DST", "SYSCALL_REQ",
+                  "SYSCALL_RESP", "DUR_US"])
+    return 0
+
+
 def cmd_replay_pcap(args) -> int:
     """Replay a pcap fixture through a capture agent into an ingester
     (reference role: agent/resources/test replays + droplet send tools)."""
@@ -348,6 +366,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="TPACKET_V3 mmap ring (zero per-packet "
                          "syscalls, kernel timestamps + drop counters)")
     cp.set_defaults(fn=cmd_capture)
+
+    tr = sub.add_parser("trace",
+                        help="assemble an l7 trace from one row "
+                             "(syscall/app/x-request correlation)")
+    tr.add_argument("--id", type=int, required=True,
+                    help="seed l7_flow_log row _id")
+    tr.set_defaults(fn=cmd_trace)
 
     rp = sub.add_parser("replay-pcap",
                         help="replay a pcap through an agent -> ingester")
