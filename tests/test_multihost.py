@@ -64,12 +64,56 @@ state = suite.init()
 state = suite.update(state, cols_d, mask_d)
 state, out = suite.flush(state)
 
+# second + third sharded pipelines across the same global mesh: the
+# metrics suite (entropy psum + replicated PCA + matrix-profile ring of
+# post-psum window sums) and the app suite (whole-state psum RED)
+from deepflow_tpu.models import metrics_suite
+from deepflow_tpu.models.app_suite import AppSuiteConfig
+from deepflow_tpu.parallel import ShardedAppSuite, ShardedMetricsSuite
+
+mcfg = metrics_suite.MetricsSuiteConfig(entropy_log2_buckets=6,
+                                        mp_length=32, mp_m=4)
+msuite = ShardedMetricsSuite(mcfg, mesh)
+mnames = (metrics_suite.ENTROPY_FEATURES + metrics_suite.GOLDEN_SIGNALS)
+ms = msuite.init()
+# enough VARYING windows to warm the matrix profile (2*mp_m pushes) so
+# mp_scores are nonzero and actually witness the win_sum psum merge —
+# identical draws from the shared rng stream on every process
+for _ in range(2 * mcfg.mp_m + 2):
+    mcols_g = {f: rng.integers(0, 1 << 12, n, dtype=np.int64)
+               .astype(np.uint32) for f in mnames}
+    mlocal = {k: v[sl] for k, v in mcols_g.items()}
+    mcols_d, mmask_d = process_local_batch(mlocal, mask[sl], mesh)
+    ms = msuite.update(ms, mcols_d, mmask_d)
+    ms, mout = msuite.flush(ms, mcols_d, mmask_d)
+
+# 128 gamma-buckets at alpha=0.05 cover the [1, 10000) rrt range — a
+# saturated sketch would make the quantile a data-independent constant
+acfg = AppSuiteConfig(groups=16, dd_buckets=128, dd_alpha=0.05)
+asuite = ShardedAppSuite(acfg, mesh)
+acols_g = {
+    "ip_dst": rng.integers(0, 1 << 16, n, dtype=np.int64).astype(np.uint32),
+    "port_dst": rng.integers(0, 1024, n, dtype=np.int64).astype(np.uint32),
+    "protocol": np.full(n, 6, np.uint32),
+    "status": rng.integers(0, 2, n, dtype=np.int64).astype(np.uint32),
+    "rrt_us": rng.integers(1, 10_000, n, dtype=np.int64).astype(np.uint32),
+}
+alocal = {k: v[sl] for k, v in acols_g.items()}
+acols_d, amask_d = process_local_batch(alocal, mask[sl], mesh)
+astate = asuite.init()
+astate = asuite.update(astate, acols_d, amask_d)
+astate, aout = asuite.flush(astate)
+
 print("RESULT " + json.dumps({
     "pid": pid,
     "rows": int(out.rows),
     "top_key": int(np.asarray(out.topk_keys)[0]),
     "top_count": int(np.asarray(out.topk_counts)[0]),
     "ent0": float(np.asarray(out.entropies)[0]),
+    "m_ent": [float(x) for x in np.asarray(mout.entropies)],
+    "mp_sum": float(np.asarray(mout.mp_scores).sum()),
+    "app_requests": float(np.asarray(aout.requests).sum()),
+    "app_p95_sum": float(np.asarray(aout.rrt_quantiles)[1].sum()),
 }))
 """
 
@@ -132,6 +176,15 @@ def test_two_process_mesh_matches_single_process():
         assert r["top_key"] == base["top_key"]
         assert r["top_count"] == base["top_count"]
         assert r["ent0"] == pytest.approx(base["ent0"], abs=1e-6)
+        # metrics suite: entropy + mp ring of MERGED window sums match
+        # the single-process run on every process
+        assert r["m_ent"] == pytest.approx(base["m_ent"], abs=1e-5)
+        assert base["mp_sum"] > 0, "profile must be warm, else vacuous"
+        assert r["mp_sum"] == pytest.approx(base["mp_sum"], rel=1e-4)
+        # app suite: psum-merged RED equals the full-stream run
+        assert r["app_requests"] == base["app_requests"] == 4096
+        assert r["app_p95_sum"] == pytest.approx(base["app_p95_sum"],
+                                                 rel=1e-5)
 
 
 def test_local_shard_single_process():
