@@ -81,6 +81,27 @@ class QueryEngine:
                     if stmt.table in (None, d)]
             return QueryResult(["database", "table"], rows)
         table = self._resolve_table(stmt.table, db)
+        if stmt.what == "tag_values":
+            # distinct stored values of one TAG column, humanized (the
+            # Grafana variable-dropdown surface). The dedup is the same
+            # group_reduce as any GROUP BY with no aggregates. Only KEY
+            # columns qualify — a float metric would truncate-merge in
+            # the int64 key packing and fabricate "distinct" values.
+            tags = {c.name for c in table.schema.columns
+                    if c.agg is AggKind.KEY}
+            if stmt.tag not in tags:
+                raise ValueError(f"{stmt.tag!r} is not a tag of "
+                                 f"{stmt.table} (SHOW TAGS lists them)")
+            cols = table.scan(columns=[stmt.tag])
+            uniq = group_reduce(cols, [stmt.tag], {})
+            rows = [[v] for v in uniq[stmt.tag].tolist()]
+            # humanize BEFORE sort/limit: a dict-hash column must page
+            # through alphabetical names, not arbitrary hash order
+            rows = self._humanize([stmt.tag], rows)
+            rows.sort(key=lambda r: (isinstance(r[0], str), r[0]))
+            if stmt.limit is not None:
+                rows = rows[:stmt.limit]
+            return QueryResult([stmt.tag], rows)
         if stmt.what == "tags":
             rows = [[c.name, np.dtype(c.dtype).name]
                     for c in table.schema.columns if c.agg is AggKind.KEY]

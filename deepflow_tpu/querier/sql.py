@@ -8,7 +8,8 @@ Supports the query shapes the reference querier serves from Grafana
       [GROUP BY col, ...] [HAVING <cond> [AND ...]]
       [ORDER BY key [ASC|DESC], ...] [LIMIT n]
     SHOW DATABASES | SHOW TABLES [FROM db] |
-    SHOW TAGS FROM <table> | SHOW METRICS FROM <table>
+    SHOW TAGS FROM <table> | SHOW METRICS FROM <table> |
+    SHOW TAG <tag> VALUES FROM <table> [LIMIT n]
 
 Expressions: columns, integer/float/string literals, aggregate calls
 (Sum/Min/Max/Avg/Count), and +,-,*,/ arithmetic over them (derived
@@ -118,8 +119,10 @@ class Select:
 
 @dataclass(frozen=True)
 class Show:
-    what: str                 # databases|tables|tags|metrics
+    what: str                 # databases|tables|tags|metrics|tag_values
     table: Optional[str] = None
+    tag: Optional[str] = None            # SHOW TAG <tag> VALUES FROM t
+    limit: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -420,5 +423,18 @@ def parse_sql(sql: str) -> Statement:
         if what in ("tags", "metrics"):
             p.expect("from")
             return Show(what, p.next())
+        if what == "tag":
+            # show tag <name> values from <table> [limit n] — the
+            # Grafana variable-dropdown query (clickhouse.go:53)
+            tag = p.next()
+            p.expect("values")
+            p.expect("from")
+            table = p.next()
+            limit = None
+            if p.accept("limit"):
+                limit = int(p.next())
+            if p.peek() is not None:
+                raise ValueError(f"trailing tokens at {p.peek()!r}")
+            return Show("tag_values", table, tag=tag, limit=limit)
         raise ValueError(f"SHOW {what} not supported")
     raise ValueError(f"unsupported statement {head!r}")

@@ -1116,3 +1116,18 @@ def test_sql_limit_offset(engine):
     page = eng.execute("SELECT ip, Count(*) AS n FROM flows "
                        "GROUP BY ip ORDER BY ip LIMIT 2 OFFSET 1")
     assert page.values == full.values[1:3]
+
+
+def test_show_tag_values(engine):
+    """The Grafana variable-dropdown query (clickhouse.go:53)."""
+    eng, cols = engine
+    r = eng.execute("SHOW TAG ip VALUES FROM flows")
+    assert r.columns == ["ip"]
+    assert [v[0] for v in r.values] == sorted(set(cols["ip"].tolist()))
+    r2 = eng.execute("SHOW TAG ip VALUES FROM flows LIMIT 2")
+    assert len(r2.values) == 2
+    with pytest.raises(ValueError, match="not a tag"):
+        eng.execute("SHOW TAG nope VALUES FROM flows")
+    # metric columns are NOT tags: float values would truncate-merge
+    with pytest.raises(ValueError, match="not a tag"):
+        eng.execute("SHOW TAG bytes VALUES FROM flows")
