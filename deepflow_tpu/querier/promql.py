@@ -64,6 +64,7 @@ class AggExpr:
     op: str                    # sum|avg|max|min|count
     by: Tuple[str, ...]
     arg: "Expr"
+    without: bool = False      # by-list is an EXCLUSION set
 
 
 @dataclass(frozen=True)
@@ -191,27 +192,36 @@ class _Parser:
             return Num(float(t))
         ident = self.next()
         low = ident.lower()
-        if low in AGG_OPS and self.peek() in ("(", "by"):
+        if low in AGG_OPS and self.peek() in ("(", "by", "without"):
             by: Tuple[str, ...] = ()
-            if self.accept("by"):
+            without = False
+            has_modifier = False
+
+            def _label_list():
                 self.expect("(")
                 names = []
                 while not self.accept(")"):
                     names.append(self.next())
                     self.accept(",")
-                by = tuple(names)
+                return tuple(names)
+
+            if self.accept("by"):
+                by, has_modifier = _label_list(), True
+            elif self.accept("without"):
+                by, without, has_modifier = _label_list(), True, True
             self.expect("(")
             arg = self.expr()
             self.expect(")")
-            # trailing `by (...)` form: sum(x) by (a)
-            if not by and self.accept("by"):
-                self.expect("(")
-                names = []
-                while not self.accept(")"):
-                    names.append(self.next())
-                    self.accept(",")
-                by = tuple(names)
-            return self._maybe_subquery(AggExpr(low, by, arg))
+            # trailing modifier form: sum(x) by (a) / sum(x) without (a)
+            # — a SECOND modifier is a syntax error upstream too (an
+            # empty leading list like `by ()` legitimately means
+            # "aggregate everything away", so track seen-ness, not
+            # list emptiness)
+            if not has_modifier and self.accept("by"):
+                by = _label_list()
+            elif not has_modifier and self.accept("without"):
+                by, without = _label_list(), True
+            return self._maybe_subquery(AggExpr(low, by, arg, without))
         if low in RANGE_FUNCS + OVER_TIME_FUNCS and self.peek() == "(":
             self.next()
             arg = self.expr()
@@ -653,7 +663,12 @@ class _Evaluator:
         series = self.eval(e.arg)
         groups: Dict[Tuple, List[np.ndarray]] = {}
         for labels, vals in series:
-            key = tuple(labels.get(b, "") for b in e.by)
+            if e.without:
+                key = tuple(sorted(
+                    (k, v) for k, v in labels.items()
+                    if k not in e.by and k != "__name__"))
+            else:
+                key = tuple(labels.get(b, "") for b in e.by)
             groups.setdefault(key, []).append(vals)
         out: SeriesList = []
         for key, arrs in groups.items():
@@ -667,7 +682,10 @@ class _Evaluator:
                        "min": np.nanmin, "avg": np.nanmean}[e.op](
                            safe, axis=0)
             agg = np.where(dead, np.nan, agg)
-            out.append((dict(zip(e.by, key)), agg))
+            # output labels derive from the key itself: (k, v) pairs in
+            # without-mode, the by-list zip otherwise
+            out.append((dict(key) if e.without
+                        else dict(zip(e.by, key)), agg))
         return out
 
     # -- binary ops --------------------------------------------------------

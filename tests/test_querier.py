@@ -1131,3 +1131,14 @@ def test_show_tag_values(engine):
     # metric columns are NOT tags: float values would truncate-merge
     with pytest.raises(ValueError, match="not a tag"):
         eng.execute("SHOW TAG bytes VALUES FROM flows")
+
+
+def test_promql_without_modifier(prom):
+    eng, _, _ = prom
+    # dropping the only label collapses both series into one sum
+    out = eng.query('sum without (job) (rps)', at=1090)
+    assert len(out) == 1 and out[0]["metric"] == {}
+    assert float(out[0]["value"][1]) == 19.0 + 109.0
+    # dropping a non-existent label keeps per-series identity
+    out = eng.query('sum without (zone) (rps)', at=1090)
+    assert {r["metric"]["job"] for r in out} == {"api", "web"}
