@@ -42,6 +42,16 @@ AGG_OPS = ("sum", "avg", "max", "min", "count")
 RANGE_FUNCS = ("rate", "irate", "increase", "delta")
 OVER_TIME_FUNCS = ("avg_over_time", "max_over_time", "min_over_time",
                    "sum_over_time", "count_over_time", "last_over_time")
+# elementwise math over an instant vector (upstream functions.go set)
+MATH_FUNCS = {
+    "abs": np.abs, "ceil": np.ceil, "floor": np.floor,
+    # upstream round() rounds ties UP (floor(v + 0.5)); np.round is
+    # banker's half-to-even and would silently differ on *.5 samples
+    "round": lambda v: np.floor(v + 0.5),
+    "sqrt": np.sqrt, "exp": np.exp,
+    "ln": np.log, "log2": np.log2, "log10": np.log10,
+}
+CLAMP_FUNCS = ("clamp_min", "clamp_max")
 
 
 # -- AST -------------------------------------------------------------------
@@ -187,6 +197,13 @@ class _Parser:
             e = self.expr()
             self.expect(")")
             return self._maybe_subquery(e)
+        if t == "-":
+            # unary minus: negative scalar literals (clamp bounds etc.)
+            self.next()
+            inner = self.atom()
+            if isinstance(inner, Num):
+                return Num(-inner.value)
+            return Bin("-", Num(0.0), inner)
         if re.fullmatch(r"\d+\.\d+|\.\d+|\d+", t):
             self.next()
             return Num(float(t))
@@ -233,6 +250,20 @@ class _Parser:
                 raise ValueError(f"{low}() needs a range vector "
                                  f"(metric[5m] or a subquery)")
             return self._maybe_subquery(Func(low, (arg,)))
+        if low in MATH_FUNCS and self.peek() == "(":
+            self.next()
+            arg = self.expr()
+            self.expect(")")
+            return self._maybe_subquery(Func(low, (arg,)))
+        if low in CLAMP_FUNCS and self.peek() == "(":
+            self.next()
+            arg = self.expr()
+            self.expect(",")
+            bound = self.expr()
+            self.expect(")")
+            if not isinstance(bound, Num):
+                raise ValueError(f"{low} needs a scalar bound")
+            return self._maybe_subquery(Func(low, (arg, bound)))
         if low in ("histogram_quantile", "topk", "bottomk",
                    "quantile") and self.peek() == "(":
             self.next()
@@ -416,6 +447,16 @@ class _Evaluator:
             if e.name == "quantile":
                 return self._quantile_agg(e.args[0].value,
                                           self.eval(e.args[1]))
+            if e.name in MATH_FUNCS:
+                fn = MATH_FUNCS[e.name]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    return [(_drop_name(lbl), fn(vals))
+                            for lbl, vals in self.eval(e.args[0])]
+            if e.name in CLAMP_FUNCS:
+                bound = e.args[1].value
+                fn = np.maximum if e.name == "clamp_min" else np.minimum
+                return [(_drop_name(lbl), fn(vals, bound))
+                        for lbl, vals in self.eval(e.args[0])]
             raise ValueError(f"unknown function {e.name}")
         if isinstance(e, AggExpr):
             return self._agg(e)

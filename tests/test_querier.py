@@ -1142,3 +1142,31 @@ def test_promql_without_modifier(prom):
     # dropping a non-existent label keeps per-series identity
     out = eng.query('sum without (zone) (rps)', at=1090)
     assert {r["metric"]["job"] for r in out} == {"api", "web"}
+
+
+def test_promql_math_functions(prom):
+    eng, _, _ = prom
+    out = eng.query('sqrt(rps{job="api"})', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(np.sqrt(19.0))
+    out = eng.query('clamp_max(rps, 50)', at=1090)
+    vals = {r["metric"]["job"]: float(r["value"][1]) for r in out}
+    assert vals == {"api": 19.0, "web": 50.0}
+    out = eng.query('ln(rps{job="api"}) + ln(rps{job="api"})', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(2 * np.log(19.0))
+
+
+def test_promql_round_and_negative_bounds(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("halfs")
+    lh = dicts.get("label_set").encode_one("job=h")
+    t.append({"timestamp": np.array([1100], np.uint32),
+              "metric": np.array([mh], np.uint32),
+              "labels": np.array([lh], np.uint32),
+              "value": np.array([2.5], np.float32)})
+    # upstream round(): ties round UP, not half-to-even
+    out = eng.query('round(halfs)', at=1100)
+    assert float(out[0]["value"][1]) == 3.0
+    # negative clamp bounds parse (unary minus)
+    out = eng.query('clamp_min(halfs - 10, -5)', at=1100)
+    assert float(out[0]["value"][1]) == -5.0
