@@ -165,7 +165,8 @@ def cmd_ingester(args) -> int:
     elif args.action == "assignments":
         print(json.dumps(_http(f"{args.controller}/v1/assignments"),
                          indent=2))
-    elif args.action in ("counters", "vtap-status", "ping", "stacks"):
+    elif args.action in ("counters", "vtap-status", "ping", "stacks",
+                         "artifacts"):
         out = debug_request(args.action, port=args.debug_port,
                             **({"module": args.module} if args.module
                                else {}))
@@ -335,7 +336,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     i = sub.add_parser("ingester", help="ingester membership + debug")
     i.add_argument("action", choices=["set", "assignments", "counters",
-                                      "vtap-status", "ping", "stacks"])
+                                      "vtap-status", "ping", "stacks",
+                                      "artifacts"])
     i.add_argument("addrs", nargs="*")
     i.add_argument("--module")
     i.set_defaults(fn=cmd_ingester)

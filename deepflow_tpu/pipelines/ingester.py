@@ -124,6 +124,30 @@ class Ingester:
                 "vtap-status",
                 lambda req: {f"{v}:{t}": vars(st) for (v, t), st
                              in self.receiver.status().items()})
+            self.debug.register("artifacts", self._artifact_listing)
+
+    def _artifact_listing(self, req: dict) -> dict:
+        """Stored droplet artifacts (per-vtap pcaps, syslog files) —
+        the deepflow-ctl pcap listing role. Names + sizes only; the
+        files live beside the store for direct retrieval. `module`
+        substring-filters names, and the listing truncates to the
+        debug protocol's single-datagram budget (truncated count
+        reported) so a busy ingester still answers."""
+        out_dir = self.droplet.out_dir
+        if out_dir is None or not os.path.isdir(out_dir):
+            return {"dir": out_dir, "files": []}
+        want = req.get("module") or ""
+        names = [n for n in sorted(os.listdir(out_dir)) if want in n]
+        files = []
+        for name in names[:500]:      # ~70B/entry << 65000B datagram
+            p = os.path.join(out_dir, name)
+            if os.path.isfile(p):
+                files.append({"name": name,
+                              "bytes": os.path.getsize(p)})
+        out = {"dir": out_dir, "files": files}
+        if len(names) > 500:
+            out["truncated"] = len(names) - 500
+        return out
 
     def start(self) -> None:
         self.exporters.start()

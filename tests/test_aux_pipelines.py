@@ -176,3 +176,30 @@ def test_syslog_statsd_pcap(ing, tmp_path):
     logf = tmp_path / "droplet" / "syslog-vtap0.log"
     assert logf.exists() and "hello" in logf.read_text()
     assert (tmp_path / "droplet" / "pcap-vtap3.bin").stat().st_size == 128
+
+
+def test_debug_artifacts_listing(tmp_path):
+    """df-ctl ingester artifacts: stored droplet pcap/syslog files show
+    with sizes over the UDP debug protocol (the pcap-listing role)."""
+    from deepflow_tpu.runtime.debug import debug_request
+
+    ing = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path),
+                                  debug_port=0))
+    ing.start()
+    try:
+        tx = socket.create_connection(("127.0.0.1", ing.port), timeout=5)
+        tx.sendall(encode_frame(MessageType.RAW_PCAP, b"\xca\xfe" * 64,
+                                FlowHeader(vtap_id=5)))
+        tx.close()
+        deadline = time.time() + 5
+        out = None
+        while time.time() < deadline:
+            ing.flush()
+            out = debug_request("artifacts", port=ing.debug.port)
+            if out["data"]["files"]:
+                break
+            time.sleep(0.1)
+        files = {f["name"]: f["bytes"] for f in out["data"]["files"]}
+        assert "pcap-vtap5.bin" in files and files["pcap-vtap5.bin"] > 0
+    finally:
+        ing.close()
