@@ -38,10 +38,11 @@ from deepflow_tpu.store.dict_store import TagDictRegistry
 DEFAULT_LOOKBACK_S = 300
 _UNIT_S = {"s": 1, "m": 60, "h": 3600, "d": 86400}
 
-AGG_OPS = ("sum", "avg", "max", "min", "count")
+AGG_OPS = ("sum", "avg", "max", "min", "count", "stddev", "stdvar")
 RANGE_FUNCS = ("rate", "irate", "increase", "delta")
 OVER_TIME_FUNCS = ("avg_over_time", "max_over_time", "min_over_time",
-                   "sum_over_time", "count_over_time", "last_over_time")
+                   "sum_over_time", "count_over_time", "last_over_time",
+                   "stddev_over_time", "stdvar_over_time")
 # elementwise math over an instant vector (upstream functions.go set)
 MATH_FUNCS = {
     "abs": np.abs, "ceil": np.ceil, "floor": np.floor,
@@ -52,6 +53,7 @@ MATH_FUNCS = {
     "ln": np.log, "log2": np.log2, "log10": np.log10,
 }
 CLAMP_FUNCS = ("clamp_min", "clamp_max")
+QUANTILE_OT = "quantile_over_time"
 
 
 # -- AST -------------------------------------------------------------------
@@ -243,12 +245,7 @@ class _Parser:
             self.next()
             arg = self.expr()
             self.expect(")")
-            ranged = (isinstance(arg, Subquery)
-                      or (isinstance(arg, Selector)
-                          and arg.range_s is not None))
-            if not ranged:
-                raise ValueError(f"{low}() needs a range vector "
-                                 f"(metric[5m] or a subquery)")
+            self._require_ranged(arg, low)
             return self._maybe_subquery(Func(low, (arg,)))
         if low in MATH_FUNCS and self.peek() == "(":
             self.next()
@@ -265,7 +262,7 @@ class _Parser:
                 raise ValueError(f"{low} needs a scalar bound")
             return self._maybe_subquery(Func(low, (arg, bound)))
         if low in ("histogram_quantile", "topk", "bottomk",
-                   "quantile") and self.peek() == "(":
+                   "quantile", QUANTILE_OT) and self.peek() == "(":
             self.next()
             phi = self.expr()
             self.expect(",")
@@ -273,6 +270,8 @@ class _Parser:
             self.expect(")")
             if not isinstance(phi, Num):
                 raise ValueError(f"{low} needs a scalar first argument")
+            if low == QUANTILE_OT:
+                self._require_ranged(arg, low)
             return self._maybe_subquery(Func(low, (phi, arg)))
         # plain selector
         return self.selector(ident)
@@ -293,6 +292,16 @@ class _Parser:
             self.next()
             return _duration_s(t[1:])
         return None
+
+    @staticmethod
+    def _require_ranged(arg: Expr, fn: str) -> None:
+        """Range-vector argument check, shared by every windowing fn."""
+        ranged = (isinstance(arg, Subquery)
+                  or (isinstance(arg, Selector)
+                      and arg.range_s is not None))
+        if not ranged:
+            raise ValueError(f"{fn}() needs a range vector "
+                             f"(metric[5m] or a subquery)")
 
     def _maybe_subquery(self, e: Expr) -> Expr:
         """[range:step] suffix after a non-selector expression."""
@@ -437,6 +446,9 @@ class _Evaluator:
                 return self._range_fn(e.name, e.args[0])
             if e.name in OVER_TIME_FUNCS:
                 return self._over_time(e.name, e.args[0])
+            if e.name == QUANTILE_OT:
+                return self._quantile_over_time(e.args[0].value,
+                                                e.args[1])
             if e.name == "histogram_quantile":
                 phi = e.args[0].value
                 return self._histogram_quantile(phi, self.eval(e.args[1]))
@@ -574,6 +586,18 @@ class _Evaluator:
                 else:
                     with np.errstate(invalid="ignore"):
                         res = sums / np.maximum(cnt, 1)
+            elif name in ("stddev_over_time", "stdvar_over_time"):
+                # per-window two-pass variance: the cumsum-of-squares
+                # form cancels catastrophically for large-valued gauges
+                # with tiny variance (E[x^2]-E[x]^2 at x ~ 1e9 loses
+                # every significant bit), so this slices per point like
+                # quantile_over_time — correctness over vectorization
+                res = np.full(len(g), np.nan)
+                for i in range(len(g)):
+                    if hi[i] > lo[i]:
+                        w = vs[lo[i]:hi[i]]
+                        res[i] = np.var(w) if name == "stdvar_over_time" \
+                            else np.std(w)
             elif name == "last_over_time":
                 res = vs[np.maximum(hi - 1, 0)]
             else:
@@ -600,6 +624,31 @@ class _Evaluator:
         dv = np.where(dv < 0, vs[h].astype(np.float64), dv)
         dt = (ts[h] - ts[h - 1]).astype(np.float64)
         return np.where(ok & (dt > 0), dv / np.maximum(dt, 1e-9), np.nan)
+
+    def _quantile_over_time(self, phi: float, node) -> SeriesList:
+        """phi-quantile of the raw samples in each window. No reduceat
+        analogue exists for quantiles, so this is the one over-time
+        aggregation that slices per grid point — bounded by the grid
+        size, and windows are typically small."""
+        offset = node.offset_s if isinstance(node, Selector) else 0
+        g = self.grid - offset
+        series, range_s = self._range_samples(node, g)
+        out: SeriesList = []
+        if phi < 0 or phi > 1:
+            fill = -np.inf if phi < 0 else np.inf
+        else:
+            fill = None
+        for labels, ts, vs in series:
+            lo = np.searchsorted(ts, g - range_s, side="right")
+            hi = np.searchsorted(ts, g, side="right")
+            vals = np.full(len(g), np.nan)
+            for i in range(len(g)):
+                if hi[i] > lo[i]:
+                    vals[i] = fill if fill is not None else \
+                        float(np.quantile(vs[lo[i]:hi[i]], phi))
+            if not np.isnan(vals).all():
+                out.append((_drop_name(labels), vals))
+        return out
 
     # -- histogram_quantile ------------------------------------------------
     @staticmethod
@@ -720,8 +769,10 @@ class _Evaluator:
             else:
                 safe = np.where(dead[None, :], 0.0, stack)
                 agg = {"sum": np.nansum, "max": np.nanmax,
-                       "min": np.nanmin, "avg": np.nanmean}[e.op](
-                           safe, axis=0)
+                       "min": np.nanmin, "avg": np.nanmean,
+                       # population variance, upstream semantics
+                       "stdvar": np.nanvar, "stddev": np.nanstd,
+                       }[e.op](safe, axis=0)
             agg = np.where(dead, np.nan, agg)
             # output labels derive from the key itself: (k, v) pairs in
             # without-mode, the by-list zip otherwise

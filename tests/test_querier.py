@@ -1170,3 +1170,42 @@ def test_promql_round_and_negative_bounds(prom):
     # negative clamp bounds parse (unary minus)
     out = eng.query('clamp_min(halfs - 10, -5)', at=1100)
     assert float(out[0]["value"][1]) == -5.0
+
+
+def test_promql_stddev_and_quantile_over_time(prom):
+    eng, _, _ = prom
+    # across-series stddev at t=1090: values {19, 109}
+    out = eng.query('stddev(rps)', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(np.std([19, 109]))
+    out = eng.query('stdvar without (job) (rps)', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(np.var([19, 109]))
+    # over-time: window (1030, 1090] holds samples 14..19
+    win = np.array([14, 15, 16, 17, 18, 19], float)
+    out = eng.query('stddev_over_time(rps{job="api"}[1m])', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(win.std())
+    out = eng.query('quantile_over_time(0.5, rps{job="api"}[1m])',
+                    at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(
+        np.quantile(win, 0.5))
+
+
+def test_stddev_over_time_large_values(prom):
+    """Catastrophic-cancellation guard: a huge-valued gauge with tiny
+    variance must report the true stddev, not 0."""
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("big_gauge")
+    lh = dicts.get("label_set").encode_one("job=g")
+    # the largest magnitude whose +-1 structure survives the f32 value
+    # column (ints <= 2^24 are exact); the old cumsum-of-squares form
+    # loses most of the variance here, the two-pass form is exact
+    base = 16_000_000.0
+    vals = np.array([base - 1, base + 1, base - 1, base + 1], np.float64)
+    t.append({"timestamp": np.array([1060, 1070, 1080, 1090], np.uint32),
+              "metric": np.full(4, mh, np.uint32),
+              "labels": np.full(4, lh, np.uint32),
+              "value": vals.astype(np.float32)})
+    out = eng.query('stddev_over_time(big_gauge[1m])', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(1.0, rel=1e-9)
+    out = eng.query('stdvar_over_time(big_gauge[1m])', at=1090)
+    assert float(out[0]["value"][1]) == pytest.approx(1.0, rel=1e-9)
