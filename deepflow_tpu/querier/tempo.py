@@ -23,9 +23,10 @@ from deepflow_tpu.store.dict_store import TagDictRegistry
 # l7_protocol enum -> display name (reference: datatype L7Protocol)
 L7_PROTOCOL_NAMES = {
     0: "unknown", 1: "other", 20: "HTTP", 21: "HTTP2", 40: "Dubbo",
-    41: "gRPC", 43: "SofaRPC", 60: "MySQL", 61: "PostgreSQL", 80: "Redis",
-    81: "MongoDB", 100: "Kafka", 101: "MQTT", 102: "AMQP", 103: "OpenWire",
-    104: "NATS", 120: "DNS", 121: "TLS", 124: "FastCGI",
+    41: "gRPC", 43: "SofaRPC", 44: "FastCGI", 60: "MySQL",
+    61: "PostgreSQL", 62: "Oracle", 80: "Redis", 81: "MongoDB",
+    100: "Kafka", 101: "MQTT", 102: "AMQP", 103: "OpenWire",
+    104: "NATS", 120: "DNS", 121: "TLS",
 }
 
 

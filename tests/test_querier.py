@@ -1209,3 +1209,19 @@ def test_stddev_over_time_large_values(prom):
     assert float(out[0]["value"][1]) == pytest.approx(1.0, rel=1e-9)
     out = eng.query('stdvar_over_time(big_gauge[1m])', at=1090)
     assert float(out[0]["value"][1]) == pytest.approx(1.0, rel=1e-9)
+
+
+def test_promql_delta(prom):
+    """delta(): non-counter difference over the window, extrapolated —
+    no counter-reset correction (a drop stays negative)."""
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("gauge_drop")
+    lh = dicts.get("label_set").encode_one("job=d")
+    t.append({"timestamp": np.array([1000, 1030, 1060], np.uint32),
+              "metric": np.full(3, mh, np.uint32),
+              "labels": np.full(3, lh, np.uint32),
+              "value": np.array([100.0, 60.0, 20.0], np.float32)})
+    out = eng.query('delta(gauge_drop[1m])', at=1060)
+    # window == sampled span exactly: delta = 20 - 100 = -80
+    assert float(out[0]["value"][1]) == pytest.approx(-80.0)
