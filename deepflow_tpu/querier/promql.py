@@ -84,6 +84,12 @@ class Bin:
     op: str                    # + - * /
     left: "Expr"
     right: "Expr"
+    # vector-matching modifiers (one-to-one only): None = no modifier
+    # (full-label match); `on` restricts the join key to these labels
+    # (an EMPTY on() legally joins everything on the empty key),
+    # `ignoring` removes them from the key
+    match_on: Optional[Tuple[str, ...]] = None
+    ignoring: bool = False
 
 
 @dataclass(frozen=True)
@@ -176,18 +182,40 @@ class _Parser:
         return False
 
     # precedence: (+,-) < (*,/) < atom
+    def _label_list(self) -> Tuple[str, ...]:
+        """Parenthesized label-name list, shared by by/without/on/
+        ignoring."""
+        self.expect("(")
+        names = []
+        while not self.accept(")"):
+            names.append(self.next())
+            self.accept(",")
+        return tuple(names)
+
+    def _match_modifier(self):
+        """Optional on(...)/ignoring(...) after a binary operator.
+        None = no modifier; an empty on() is meaningful (empty-key
+        join), so the two must stay distinguishable."""
+        word = (self.peek() or "").lower()
+        if word not in ("on", "ignoring"):
+            return None, False
+        self.next()
+        return self._label_list(), word == "ignoring"
+
     def expr(self) -> Expr:
         left = self.term()
         while self.peek() in ("+", "-"):
             op = self.next()
-            left = Bin(op, left, self.term())
+            on, ign = self._match_modifier()
+            left = Bin(op, left, self.term(), on, ign)
         return left
 
     def term(self) -> Expr:
         left = self.atom()
         while self.peek() in ("*", "/"):
             op = self.next()
-            left = Bin(op, left, self.atom())
+            on, ign = self._match_modifier()
+            left = Bin(op, left, self.atom(), on, ign)
         return left
 
     def atom(self) -> Expr:
@@ -215,19 +243,10 @@ class _Parser:
             by: Tuple[str, ...] = ()
             without = False
             has_modifier = False
-
-            def _label_list():
-                self.expect("(")
-                names = []
-                while not self.accept(")"):
-                    names.append(self.next())
-                    self.accept(",")
-                return tuple(names)
-
             if self.accept("by"):
-                by, has_modifier = _label_list(), True
+                by, has_modifier = self._label_list(), True
             elif self.accept("without"):
-                by, without, has_modifier = _label_list(), True, True
+                by, without, has_modifier = self._label_list(), True, True
             self.expect("(")
             arg = self.expr()
             self.expect(")")
@@ -237,9 +256,9 @@ class _Parser:
             # "aggregate everything away", so track seen-ness, not
             # list emptiness)
             if not has_modifier and self.accept("by"):
-                by = _label_list()
+                by = self._label_list()
             elif not has_modifier and self.accept("without"):
-                by, without = _label_list(), True
+                by, without = self._label_list(), True
             return self._maybe_subquery(AggExpr(low, by, arg, without))
         if low in RANGE_FUNCS + OVER_TIME_FUNCS and self.peek() == "(":
             self.next()
@@ -787,6 +806,9 @@ class _Evaluator:
         if lnum and rnum:
             raise ValueError("scalar-only expression has no series")
         if lnum or rnum:
+            if e.match_on is not None:
+                raise ValueError("vector matching (on/ignoring) only "
+                                 "applies between instant vectors")
             series = self.eval(e.right if lnum else e.left)
             c = (e.left if lnum else e.right).value
             out = []
@@ -796,19 +818,41 @@ class _Evaluator:
             return out
         left = self.eval(e.left)
         right = self.eval(e.right)
-        # one-to-one vector match on the full label set minus __name__
+
+        def match_key(labels: Dict[str, str]) -> Tuple:
+            kept = _drop_name(labels)
+            if e.match_on is not None and not e.ignoring:
+                # upstream keeps only the on-labels PRESENT on the
+                # series — never fabricates empty-valued entries (they
+                # would leak into legends and outer groupings)
+                kept = {k: kept[k] for k in e.match_on if k in kept}
+            elif e.match_on is not None:
+                kept = {k: v for k, v in kept.items()
+                        if k not in e.match_on}
+            return tuple(sorted(kept.items()))
+
+        # one-to-one vector match (full label set minus __name__ by
+        # default; on()/ignoring() restrict the key)
         rmap: Dict[Tuple, np.ndarray] = {}
         for labels, vals in right:
-            key = tuple(sorted(_drop_name(labels).items()))
+            key = match_key(labels)
             if key in rmap:
-                raise ValueError("many-to-many vector match")
+                raise ValueError("many-to-many vector match (use a "
+                                 "narrower on()/ignoring() set)")
             rmap[key] = vals
         out: SeriesList = []
+        matched_left = set()
         for labels, vals in left:
-            key = tuple(sorted(_drop_name(labels).items()))
+            key = match_key(labels)
             other = rmap.get(key)
             if other is None:
-                continue
+                continue          # unmatched series just drop (upstream)
+            if key in matched_left:
+                # only ACTUAL duplicate matches are errors, like
+                # upstream's matchedSigs tracking
+                raise ValueError("many-to-one vector match on the left "
+                                 "side (group_left is unsupported)")
+            matched_left.add(key)
             out.append((dict(key), _arith(e.op, vals, other)))
         return out
 

@@ -1225,3 +1225,56 @@ def test_promql_delta(prom):
     out = eng.query('delta(gauge_drop[1m])', at=1060)
     # window == sampled span exactly: delta = 20 - 100 = -80
     assert float(out[0]["value"][1]) == pytest.approx(-80.0)
+
+
+def test_promql_on_ignoring_matching(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("capacity")
+    # capacity carries an extra 'tier' label the rps series lacks
+    for job, cap in (("api", 100.0), ("web", 200.0)):
+        lh = dicts.get("label_set").encode_one(f"job={job},tier=gold")
+        t.append({"timestamp": np.array([1090], np.uint32),
+                  "metric": np.array([mh], np.uint32),
+                  "labels": np.array([lh], np.uint32),
+                  "value": np.array([cap], np.float32)})
+    # default 1:1 match fails to join (label sets differ) -> empty
+    assert eng.query('rps / capacity', at=1090) == []
+    # on(job) joins them
+    out = eng.query('rps / on (job) capacity', at=1090)
+    vals = {r["metric"]["job"]: float(r["value"][1]) for r in out}
+    assert vals == {"api": 19.0 / 100.0, "web": 109.0 / 200.0}
+    # ignoring(tier) is the equivalent exclusion form
+    out2 = eng.query('rps / ignoring (tier) capacity', at=1090)
+    vals2 = {r["metric"]["job"]: float(r["value"][1]) for r in out2}
+    assert vals2 == vals
+    # ambiguous match is loud, not arbitrary
+    with pytest.raises(ValueError, match="many-to-many"):
+        eng.query('rps / on (tier) capacity', at=1090)
+
+
+def test_promql_matching_edge_semantics(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("one_cap")
+    lh = dicts.get("label_set").encode_one("tier=gold")
+    t.append({"timestamp": np.array([1090], np.uint32),
+              "metric": np.array([mh], np.uint32),
+              "labels": np.array([lh], np.uint32),
+              "value": np.array([50.0], np.float32)})
+    # empty on(): joins single series on the empty key
+    out = eng.query('rps{job="api"} / on () one_cap', at=1090)
+    assert len(out) == 1 and float(out[0]["value"][1]) == 19.0 / 50.0
+    # on-labels absent from both sides never fabricate empty labels
+    assert out[0]["metric"] == {}
+    # duplicate left keys that MATCH one right sample: genuine
+    # many-to-one, loud error (group_left unsupported)
+    with pytest.raises(ValueError, match="many-to-one"):
+        eng.query('rps / on (nope) one_cap', at=1090)
+    # duplicate left keys that match NOTHING just drop (upstream
+    # semantics): on (tier) folds both rps series to the empty key but
+    # one_cap's key carries tier=gold, so nothing joins and no error
+    assert eng.query('rps / on (tier) one_cap', at=1090) == []
+    # scalar operands reject matching modifiers loudly
+    with pytest.raises(ValueError, match="instant vectors"):
+        eng.query('1 + on (job) rps', at=1090)
