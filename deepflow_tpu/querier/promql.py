@@ -12,9 +12,12 @@ parses a real expression grammar and evaluates it on a time grid:
   windows surface (runtime/app_red.py emits cumulative gamma-bucket
   samples; the sketch IS a histogram, so the upstream bucket
   interpolation applies unchanged)
-- sum/avg/max/min/count by (...) aggregation
+- sum/avg/max/min/count/stddev/stdvar with by (...) / without (...)
+- topk/bottomk/quantile, the *_over_time family (incl. quantile and
+  stddev/stdvar), subqueries (expr[range:step]) with absolute step
+  anchoring, and elementwise math/clamp functions
 - vector○scalar and vector○vector arithmetic (+ - * /) with one-to-one
-  label matching
+  label matching incl. on (...) / ignoring (...)
 
 Evaluation is columnar: every expression evaluates to a list of
 (labels, values-aligned-to-grid) pairs in one vectorized pass — an
