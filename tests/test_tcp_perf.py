@@ -245,3 +245,112 @@ def test_multi_flow_interleaved_batch():
     assert out["rtt"][a] == 20_000
     assert out["rtt_server"][b] == 30_000 and out["rtt_client"][b] == 15_000
     assert out["rtt"][b] == 45_000
+
+
+def test_randomized_differential_vs_per_packet_oracle():
+    """Property test: random interleaved conversations, random batch
+    splits — the vectorized segmented-scan engine must agree with a
+    straightforward per-packet state machine implementing the SRT/ART
+    chain rules (the most intricate part of the tcp.rs semantics; the
+    handshake RTT / CIT / zero-window paths are covered by the fixed
+    goldens above). Catches accumulation/ordering bugs none of the
+    fixed goldens would."""
+    rng = np.random.default_rng(0xF00D)
+
+    class Oracle:
+        """Per-packet reimplementation of the chain rules."""
+
+        def __init__(self):
+            self.flows = {}
+
+        def _st(self, key):
+            return self.flows.setdefault(key, {
+                "last": None,              # (kind, dir, ts, seq_end)
+                "last_dir": {0: None, 1: None},  # dir -> (ts, seq_end, plen)
+                "art_armed": [False, False],
+                "srt": [[0, 0, 0], [0, 0, 0]],   # sum,count,max per dir
+                "art": [[0, 0, 0], [0, 0, 0]],
+            })
+
+        def feed(self, key, d, ts, kind, seq, ack, payload):
+            st = self._st(key)
+            seq_end = (seq + payload) & 0xFFFFFFFF
+            ackish = kind in ("ACK", "DATA_PLAIN")
+            # SRT: prev is oppo-dir PSH data, cur ackish replying to it
+            if ackish and st["last"] is not None:
+                pk, pd, pts, pse = st["last"]
+                if pk == "DATA_PSH" and pd != d and ack == pse:
+                    delta = ts - pts
+                    if 0 < delta <= 10 * 10**9:
+                        s = st["srt"][d]
+                        s[0] += delta; s[1] += 1; s[2] = max(s[2], delta)
+            # ART: armed[d] and payload and seq continues own side
+            if payload > 0 and st["art_armed"][d]:
+                mine = st["last_dir"][d]
+                oppo = st["last_dir"][1 - d]
+                if mine is not None and oppo is not None \
+                        and seq == mine[1]:
+                    delta = ts - oppo[0]
+                    if 0 < delta <= 30 * 10**9:
+                        a = st["art"][d]
+                        a[0] += delta; a[1] += 1; a[2] = max(a[2], delta)
+            # chain transitions
+            if kind == "DATA_PSH":
+                st["art_armed"][d] = False
+                st["art_armed"][1 - d] = True
+            elif ackish:
+                st["art_armed"][1 - d] = False
+            else:
+                st["art_armed"] = [False, False]
+            st["last"] = (kind, d, ts, seq_end)
+            st["last_dir"][d] = (ts, seq_end, payload)
+
+    from deepflow_tpu.agent.tcp_perf import TcpPerf
+
+    KINDS = [("ACK", 0x10, 0), ("DATA_PLAIN", 0x10, 1),
+             ("DATA_PSH", 0x18, 1)]
+    n_flows, n_pkts = 6, 400
+    seqs = [[1000, 5000] for _ in range(n_flows)]
+    pkts = []
+    t = T0
+    for i in range(n_pkts):
+        f = int(rng.integers(0, n_flows))
+        d = int(rng.integers(0, 2))
+        kname, flags, has_pl = KINDS[int(rng.integers(0, 3))]
+        pl = int(rng.integers(1, 200)) if has_pl else 0
+        seq = seqs[f][d]
+        seqs[f][d] = (seq + pl) & 0xFFFFFFFF
+        ack = seqs[f][1 - d]          # cumulative ack of the other side
+        t += int(rng.integers(1, 5)) * MS
+        pkts.append((f, d, t, kname, flags, seq, ack, pl))
+
+    oracle = Oracle()
+    for f, d, ts, kname, flags, seq, ack, pl in pkts:
+        oracle.feed(f, d, ts, kname, seq, ack, pl)
+
+    perf = TcpPerf(16)
+    # feed in random batch splits, packets in order
+    i = 0
+    while i < len(pkts):
+        j = min(len(pkts), i + int(rng.integers(1, 40)))
+        chunk = pkts[i:j]
+        arr = lambda k: np.asarray([p[k] for p in chunk], np.int64)
+        perf.inject(arr(0), arr(1), arr(2),
+                    np.asarray([p[4] for p in chunk], np.int64),
+                    arr(5), arr(6), arr(7),
+                    np.full(len(chunk), 8192, np.int64),
+                    np.zeros(len(chunk), np.int64),
+                    np.zeros(len(chunk), np.int64))
+        i = j
+
+    for f in range(n_flows):
+        o = oracle.flows.get(f)
+        if o is None:
+            continue
+        for d in range(2):
+            assert perf.srt[f, d, 0] == o["srt"][d][0], (f, d, "srt sum")
+            assert perf.srt[f, d, 1] == o["srt"][d][1], (f, d, "srt cnt")
+            assert perf.srt[f, d, 2] == o["srt"][d][2], (f, d, "srt max")
+            assert perf.art[f, d, 0] == o["art"][d][0], (f, d, "art sum")
+            assert perf.art[f, d, 1] == o["art"][d][1], (f, d, "art cnt")
+            assert perf.art[f, d, 2] == o["art"][d][2], (f, d, "art max")
