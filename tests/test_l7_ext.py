@@ -421,21 +421,28 @@ def test_random_bytes_do_not_crash():
         parse_payload(blob, proto=6, port_src=1234, port_dst=5678)
 
 
+def _huff_encode(raw: bytes) -> bytes:
+    """RFC 7541 Huffman bit-packing over the spec table — the single
+    test-side encoder both huffman tests share."""
+    from deepflow_tpu.agent.l7_ext import _HUFF_TABLE
+    acc, nbits = 0, 0
+    for ch in raw:
+        code, ln = _HUFF_TABLE[ch]
+        acc = (acc << ln) | code
+        nbits += ln
+    if not nbits:
+        return b""
+    pad = (8 - nbits % 8) % 8
+    acc = (acc << pad) | ((1 << pad) - 1)
+    return int.to_bytes(acc, (nbits + pad) // 8, "big")
+
+
 def test_huffman_full_table_rare_symbols():
     """Round-3: the COMPLETE RFC 7541 table — header values with rare
     symbols (uppercase URLs, base64 ids with + / =) decode instead of
     falling back to hex placeholders."""
-    from deepflow_tpu.agent.l7_ext import _HUFF_TABLE
-
     def encode(s: str) -> bytes:
-        acc, nbits = 0, 0
-        for ch in s.encode("latin-1"):
-            code, ln = _HUFF_TABLE[ch]
-            acc = (acc << ln) | code
-            nbits += ln
-        pad = (8 - nbits % 8) % 8
-        acc = (acc << pad) | ((1 << pad) - 1)
-        return int.to_bytes(acc, (nbits + pad) // 8, "big")
+        return _huff_encode(s.encode("latin-1"))
 
     for s in ("/API/V2/Users?id=AbC+9/zZ==",
               "Mozilla/5.0 (X11; Linux x86_64) \"quoted\"",
@@ -542,3 +549,93 @@ def test_oracle_binds_and_binary_never_leak():
     assert "user@example.com" not in rec.endpoint
     assert all(0x20 <= ord(c) < 0x7F for c in rec.endpoint)
     assert len(rec.endpoint) <= 128
+
+
+def test_hpack_roundtrip_property():
+    """Property test: random header lists encoded with an in-test HPACK
+    encoder (dynamic-table refs, incremental indexing, Huffman) decode
+    back exactly through a stateful HpackDecoder pair — deep coverage of
+    index arithmetic and eviction none of the fixed blocks reach."""
+    import random
+
+    from deepflow_tpu.agent.l7_ext import _HPACK_STATIC, HpackDecoder
+
+    rnd = random.Random(0xBEEF)
+
+    def hint(value, prefix, first_byte):
+        if value < (1 << prefix) - 1:
+            return bytes([first_byte | value])
+        out = [first_byte | ((1 << prefix) - 1)]
+        value -= (1 << prefix) - 1
+        while value >= 0x80:
+            out.append((value & 0x7F) | 0x80)
+            value >>= 7
+        out.append(value)
+        return bytes(out)
+
+    def hstr(s, huff):
+        raw = s.encode("latin-1")
+        if huff:
+            raw = _huff_encode(raw)
+            return hint(len(raw), 7, 0x80) + raw
+        return hint(len(raw), 7, 0x00) + raw
+
+    class Encoder:
+        """Minimal spec-following encoder with its own dynamic table."""
+
+        def __init__(self, max_size=256):   # small: forces eviction
+            self.dyn = []
+            self.size = 0
+            self.max = max_size
+
+        def _evict(self):
+            while self.size > self.max and self.dyn:
+                n, v = self.dyn.pop()
+                self.size -= len(n) + len(v) + 32
+
+        def encode(self, headers):
+            out = b""
+            for name, value in headers:
+                # full match in static?
+                static_full = next((i for i, (n, v)
+                                    in _HPACK_STATIC.items()
+                                    if n == name and v == value), None)
+                dyn_full = next((i for i, (n, v)
+                                 in enumerate(self.dyn)
+                                 if n == name and v == value), None)
+                if static_full is not None and rnd.random() < 0.5:
+                    out += hint(static_full, 7, 0x80)
+                    continue
+                if dyn_full is not None and rnd.random() < 0.7:
+                    out += hint(62 + dyn_full, 7, 0x80)
+                    continue
+                # literal with incremental indexing; name may be indexed
+                name_idx = next((i for i, (n, _)
+                                 in _HPACK_STATIC.items() if n == name),
+                                None)
+                if name_idx is None:
+                    name_idx = next((62 + i for i, (n, _)
+                                     in enumerate(self.dyn)
+                                     if n == name), None)
+                if name_idx is not None and rnd.random() < 0.7:
+                    out += hint(name_idx, 6, 0x40)
+                else:
+                    out += hint(0, 6, 0x40)
+                    out += hstr(name, rnd.random() < 0.5)
+                out += hstr(value, rnd.random() < 0.5)
+                self.dyn.insert(0, (name, value))
+                self.size += len(name) + len(value) + 32
+                self._evict()
+            return out
+
+    names = [":method", ":path", "x-trace", "content-type", "cookie"]
+    values = ["GET", "/a", "/b/c?q=1", "abc123==", "Zm9vYmFy",
+              "text/html; charset=UTF-8", "k=v; k2=\"v2\""]
+    enc = Encoder()
+    dec = HpackDecoder(max_size=256)
+    for frame in range(40):
+        headers = [(rnd.choice(names), rnd.choice(values))
+                   for _ in range(rnd.randint(1, 6))]
+        block = enc.encode(headers)
+        got = dec.decode(block)
+        assert got == headers, (frame, got, headers)
