@@ -341,3 +341,35 @@ def test_standard_migrations_upgrade_old_metrics_store(tmp_path):
     issu2 = Issu(store, "flow_metrics")
     register_standard_migrations(issu2)
     assert issu2.run() == {}
+
+
+def test_group_reduce_device_matches_host_property():
+    """Property: the device GROUP BY program and the host-lexsort path
+    are the same function, across random key cardinalities, agg kinds,
+    and sizes (incl. non-power-of-two and singleton groups)."""
+    import numpy as np
+
+    from deepflow_tpu.store.rollup import group_reduce
+
+    rng = np.random.default_rng(0xD0D0)
+    for trial in range(6):
+        n = int(rng.integers(1, 5000))
+        k_card = int(rng.integers(1, 50))
+        cols = {
+            "a": rng.integers(0, k_card, n).astype(np.uint32),
+            "b": rng.integers(0, 7, n).astype(np.uint32),
+            "v": rng.integers(0, 100000, n).astype(np.uint32),
+            "w": rng.integers(0, 1000, n).astype(np.uint32),
+        }
+        aggs = {"v": "sum", "w": "max"}
+        host = group_reduce(dict(cols), ["a", "b"], dict(aggs),
+                            method="host")
+        dev = group_reduce(dict(cols), ["a", "b"], dict(aggs),
+                           method="device")
+        hmap = {(int(a), int(b)): (int(v), int(w))
+                for a, b, v, w in zip(host["a"], host["b"],
+                                      host["v"], host["w"])}
+        dmap = {(int(a), int(b)): (int(v), int(w))
+                for a, b, v, w in zip(dev["a"], dev["b"],
+                                      dev["v"], dev["w"])}
+        assert hmap == dmap, f"trial {trial}, n={n}, card={k_card}"
