@@ -46,9 +46,22 @@ import time
 import numpy as np
 
 
-def _phase(msg: str) -> None:
+# No single DEVICE phase legitimately takes this long; the CPU backend
+# is never "wedged" (and legitimately runs 100x slower), so main()
+# widens the default there. Host-bound phases pass their own budget.
+# State is one immutable tuple swapped in a single store so the
+# watchdog thread never pairs one phase's start time with another's
+# budget.
+_PHASE_STATE = [("start", time.monotonic(), None)]
+_PHASE_BUDGET_S = [240.0]
+
+
+def _phase(msg: str, budget: float | None = None) -> None:
     """Progress marker on stderr (the JSON contract owns stdout): a
-    wedged tunnel shows as a stuck phase instead of a silent hang."""
+    wedged tunnel shows as a stuck phase instead of a silent hang.
+    `budget` overrides the device-phase default for phases that are
+    host CPU work (whose duration says nothing about the tunnel)."""
+    _PHASE_STATE[0] = (msg, time.monotonic(), budget)
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
           file=sys.stderr, flush=True)
 
@@ -85,6 +98,24 @@ def main() -> None:
 
     threading.Thread(target=_watchdog, daemon=True).start()
 
+    # phase watchdog: back-to-back TPU processes occasionally inherit a
+    # backend state where one device op (typically the kernel-loop close
+    # fetch) never completes. SIGALRM can't interrupt the C runtime, so a
+    # thread polls phase age and hard-exits rc=4 — a crisp artifact for
+    # the driver instead of an external SIGTERM mid-claim.
+    def _phase_watchdog():
+        while True:
+            time.sleep(10)
+            msg, t0, budget = _PHASE_STATE[0]   # one atomic snapshot
+            age = time.monotonic() - t0
+            limit = budget if budget is not None else _PHASE_BUDGET_S[0]
+            if init_done.is_set() and age > limit:
+                _phase("FATAL: phase %r exceeded %.0fs (tunnel wedged?)"
+                       % (msg, limit))
+                os._exit(4)
+
+    threading.Thread(target=_phase_watchdog, daemon=True).start()
+
     import jax
     import jax.numpy as jnp
 
@@ -118,11 +149,16 @@ def main() -> None:
                        / (time.perf_counter() - t0))
         return best
 
+    if jax.default_backend() == "cpu":
+        _PHASE_BUDGET_S[0] = 3600.0
+
     _phase("probe fresh h2d")
     h2d_fresh = h2d_mb_s()
     init_done.set()   # backend is up; the watchdog stands down
 
-    _phase("staging synthetic pool + payloads")
+    # host CPU work (65k pb serializations + 4x 17-column encodes):
+    # its duration says nothing about the tunnel, so its own budget
+    _phase("staging synthetic pool + payloads", budget=3600.0)
     # -- stage: one pool of distinct flows, Zipf-picked record streams ----
     agent = SyntheticAgent()
     base = agent.l4_columns(pool_n)
@@ -140,6 +176,10 @@ def main() -> None:
         for c in schema_batches]
     pb_payloads = [pack_pb_records([pool_records[i] for i in p])
                    for p in picks]
+
+    # back on the device-phase budget: these transfers are exactly the
+    # hang class the watchdog exists for
+    _phase("staging device-resident batches")
     mask_d = jnp.asarray(np.ones(batch, dtype=np.bool_))
 
     # device-resident batches for the kernel number are staged NOW, while

@@ -49,11 +49,13 @@ def _kernel(idx_ref, w_ref, out_ref, *, d, width, hi_n, lo_n, planes):
     lo_iota = lax.broadcasted_iota(jnp.int32, (chunk, lo_n), 1)
     hi_iota = lax.broadcasted_iota(jnp.int32, (chunk, hi_n), 1)
     for plane in range(planes):
-        wp = ((w_ref[:] >> (8 * plane)) & 0xFF).astype(jnp.bfloat16)
+        # minor-dim insert while still int32 (Mosaic rejects it on bf16),
+        # then cast the [chunk, 1] column
+        wp = ((w_ref[:] >> (8 * plane)) & 0xFF)[:, None].astype(jnp.bfloat16)
         scale = np.float32(256.0 ** plane)
         for j in range(d):                           # d is tiny (<= 8)
             a = (hi[j][:, None] == hi_iota).astype(jnp.bfloat16) \
-                * wp[:, None]                        # [chunk, hi]
+                * wp                                 # [chunk, hi]
             b = (lo[j][:, None] == lo_iota).astype(jnp.bfloat16)
             # contract the chunk dim on the MXU: [hi, lo]
             out = lax.dot_general(

@@ -366,6 +366,10 @@ def test_group_reduce_device_matches_host_property():
                             method="host")
         dev = group_reduce(dict(cols), ["a", "b"], dict(aggs),
                            method="device")
+        # same row COUNT first: a dict comparison alone would collapse a
+        # duplicated group (same key emitted twice with equal aggs)
+        assert len(dev["a"]) == len(host["a"]), \
+            f"trial {trial}: dev {len(dev['a'])} rows vs host {len(host['a'])}"
         hmap = {(int(a), int(b)): (int(v), int(w))
                 for a, b, v, w in zip(host["a"], host["b"],
                                       host["v"], host["w"])}
