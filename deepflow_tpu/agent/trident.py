@@ -60,6 +60,9 @@ class AgentConfig:
     # shared-object L7 plugins (agent/plugin.py): .so paths loaded at
     # startup and hot-loadable via pushed config (reference: rpc Plugin)
     so_plugins: tuple = ()
+    # sandboxed wasm L7 plugins (agent/wasm_plugin.py): .wasm paths,
+    # same lifecycle as so_plugins but fuel/memory-confined
+    wasm_plugins: tuple = ()
     # dispatcher (agent/dispatcher.py): capture mode + policy actions
     dispatcher_mode: str = "local"
     local_macs: tuple = ()
@@ -219,6 +222,9 @@ class Agent:
         self.so_plugins: Dict[str, object] = {}
         for path in cfg.so_plugins:
             self._load_plugin(path)
+        self.wasm_plugins: Dict[str, object] = {}
+        for path in cfg.wasm_plugins:
+            self._load_wasm(path)
 
     def _load_plugin(self, path: str) -> bool:
         """dlopen + register one L7 plugin; a broken .so must not take
@@ -230,6 +236,21 @@ class Agent:
             self.so_plugins[path] = load_so_plugin(path)
             return True
         except (OSError, ValueError):
+            return False
+
+    def _load_wasm(self, path: str) -> bool:
+        """Instantiate + register one sandboxed wasm parser; a broken
+        module must not take the agent down."""
+        from deepflow_tpu.agent.wasm_plugin import load_wasm_plugin
+        if path in self.wasm_plugins:
+            return True
+        try:
+            self.wasm_plugins[path] = load_wasm_plugin(path)
+            return True
+        except Exception:
+            # hostile bytes can fail in arbitrary ways before the
+            # sandbox's own trap conversion is armed; none of them may
+            # take the agent down
             return False
 
     def set_vtap_id(self, vtap_id: int) -> None:
@@ -286,6 +307,8 @@ class Agent:
         self.cfg.sync_interval_s = cfg.get("sync_interval_s", 60)
         if "so_plugins" in cfg:   # absent key = leave plugins alone
             self._sync_plugins(cfg["so_plugins"])
+        if "wasm_plugins" in cfg:
+            self._sync_wasm_plugins(cfg["wasm_plugins"])
 
     def _sync_plugins(self, paths) -> None:
         """Converge loaded plugins to the pushed set: load new paths,
@@ -298,6 +321,15 @@ class Agent:
                 unload_so_plugin(self.so_plugins.pop(path))
         for path in paths:
             self._load_plugin(path)
+
+    def _sync_wasm_plugins(self, paths) -> None:
+        from deepflow_tpu.agent.wasm_plugin import unload_wasm_plugin
+        want = set(paths)
+        for path in list(self.wasm_plugins):
+            if path not in want:
+                unload_wasm_plugin(self.wasm_plugins.pop(path))
+        for path in paths:
+            self._load_wasm(path)
 
     def _on_escape(self) -> None:
         """Controller silent too long: fall back to conservative defaults
@@ -446,6 +478,7 @@ class Agent:
         # unregister our plugins from the process-global parser set: a
         # successor Agent in this process would otherwise double-register
         self._sync_plugins(())
+        self._sync_wasm_plugins(())
 
     def _sync_loop(self) -> None:
         self.sync_once()

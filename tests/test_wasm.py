@@ -1,0 +1,476 @@
+"""Wasm plugin runtime: interpreter semantics, sandbox limits, the
+host ABI, and registry dispatch parity with the .so path.
+
+The modules under test are built with the in-tree assembler
+(agent/wasm_asm.py) — the image has no wasm toolchain, which is the
+reason the interpreter exists at all.
+"""
+
+import pytest
+
+from deepflow_tpu.agent import l7
+from deepflow_tpu.agent.wasm_asm import (I32, I32_ADD, I32_EQ, I32_EQZ,
+                                         I32_GE_U, I32_MUL, I32_SUB,
+                                         I64_ADD, RETURN, UNREACHABLE,
+                                         MEMORY_GROW, ModuleBuilder, block,
+                                         br, br_if, call, global_get,
+                                         global_set, i32_const, i32_load,
+                                         i32_load8_u, i32_store8, i64_const,
+                                         if_else, local_get, local_set, loop)
+from deepflow_tpu.agent.wasm_plugin import (WasmPlugin, load_wasm_plugin,
+                                            loaded_wasm_plugins,
+                                            unload_wasm_plugin)
+from deepflow_tpu.agent.wasm_samples import build_memcached_wasm
+from deepflow_tpu.agent.wasm_vm import (FuncType, HostFunc, I64,
+                                        WasmInstance, WasmModule, WasmTrap)
+
+
+def _inst(m: ModuleBuilder, **kw) -> WasmInstance:
+    return WasmInstance(WasmModule(m.build()), **kw)
+
+
+# -- interpreter core --------------------------------------------------------
+
+def test_arith_and_locals():
+    m = ModuleBuilder()
+    t = m.functype([I32, I32], [I32])
+    # (a + b) * (a - b)
+    m.func(t, body=(local_get(0) + local_get(1) + I32_ADD
+                    + local_get(0) + local_get(1) + I32_SUB + I32_MUL),
+           export="f")
+    inst = _inst(m)
+    assert inst.invoke("f", 7, 3) == 40
+    # wrap-around: (2^31 + 1) * 1 stays u32
+    assert inst.invoke("f", 1 << 31, 0) == ((1 << 31) * (1 << 31)) % (1 << 32)
+
+
+def test_loop_factorial_and_branches():
+    m = ModuleBuilder()
+    t = m.functype([I32], [I32])
+    # acc=1; i=n; while i>1 { acc*=i; i-- }  (br_if exits, br restarts)
+    m.func(t, locals_=[I32], body=(
+        i32_const(1) + local_set(1)
+        + block(loop(
+            local_get(0) + i32_const(2) + I32_GE_U + I32_EQZ + br_if(1)
+            + local_get(1) + local_get(0) + I32_MUL + local_set(1)
+            + local_get(0) + i32_const(1) + I32_SUB + local_set(0)
+            + br(0)))
+        + local_get(1)), export="fact")
+    inst = _inst(m)
+    assert inst.invoke("fact", 5) == 120
+    assert inst.invoke("fact", 0) == 1
+    assert inst.invoke("fact", 12) == 479001600
+
+
+def test_if_else_and_nested_if_before_else():
+    m = ModuleBuilder()
+    t = m.functype([I32], [I32])
+    # if (x) { if (x == 2) { return 20 } ; return 10 } else { return 30 }
+    # the inner if (no else) ends right where the outer else begins —
+    # the end/else adjacency an interpreter can misparse
+    m.func(t, body=(
+        local_get(0)
+        + if_else(
+            local_get(0) + i32_const(2) + I32_EQ
+            + if_else(i32_const(20) + RETURN)
+            + i32_const(10) + RETURN,
+            i32_const(30) + RETURN)
+        + i32_const(99)), export="f")
+    inst = _inst(m)
+    assert inst.invoke("f", 2) == 20
+    assert inst.invoke("f", 1) == 10
+    assert inst.invoke("f", 0) == 30
+
+
+def test_memory_data_segments_and_loads():
+    m = ModuleBuilder()
+    m.memory(1, 1)
+    m.data(100, b"\x01\x02\x03\x04")
+    t = m.functype([], [I32])
+    m.func(t, body=i32_const(0) + i32_load(100), export="ld")
+    t2 = m.functype([I32, I32], [I32])
+    m.func(t2, body=(local_get(0) + local_get(1) + i32_store8(0)
+                     + local_get(0) + i32_load8_u(0)), export="st8")
+    inst = _inst(m)
+    assert inst.invoke("ld") == 0x04030201     # little-endian
+    assert inst.invoke("st8", 200, 0x1FF) == 0xFF   # store8 wraps
+
+
+def test_globals_and_i64():
+    m = ModuleBuilder()
+    g = m.global_i32(41)
+    t = m.functype([], [I32])
+    m.func(t, body=(global_get(g) + i32_const(1) + I32_ADD
+                    + global_set(g) + global_get(g)), export="bump")
+    inst = _inst(m)
+    assert inst.invoke("bump") == 42
+    assert inst.invoke("bump") == 43
+
+
+def test_i64_arith():
+    m = ModuleBuilder()
+    t = m.functype([], [I64])
+    m.func(t, body=(i64_const((1 << 62) + 5) + i64_const(1 << 62)
+                    + I64_ADD), export="f")
+    inst = _inst(m)
+    assert inst.invoke("f") == ((1 << 63) + 5)
+
+
+def test_host_import_call_and_signature_check():
+    m = ModuleBuilder()
+    t = m.functype([I32], [I32])
+    h = m.import_func("env", "double", t)
+    m.func(t, body=local_get(0) + call(h) + i32_const(1) + I32_ADD,
+           export="f")
+    blob = m.build()
+    inst = WasmInstance(WasmModule(blob), {"env": {
+        "double": HostFunc(lambda x: (x * 2) & 0xFFFFFFFF,
+                           FuncType((I32,), (I32,)))}})
+    assert inst.invoke("f", 21) == 43
+    with pytest.raises(Exception):   # signature mismatch refused at link
+        WasmInstance(WasmModule(blob), {"env": {
+            "double": HostFunc(lambda: 0, FuncType((), (I32,)))}})
+
+
+# -- sandbox limits ----------------------------------------------------------
+
+def test_fuel_exhaustion_traps():
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    m.func(t, body=loop(br(0)) + i32_const(0), export="spin")
+    inst = _inst(m, fuel=10_000)
+    with pytest.raises(WasmTrap, match="fuel"):
+        inst.invoke("spin")
+
+
+def test_oob_memory_access_traps():
+    m = ModuleBuilder()
+    m.memory(1, 1)
+    t = m.functype([I32], [I32])
+    m.func(t, body=local_get(0) + i32_load(0), export="peek")
+    inst = _inst(m)
+    assert inst.invoke("peek", 0) == 0
+    with pytest.raises(WasmTrap, match="out of bounds"):
+        inst.invoke("peek", 65533)           # 4-byte read past the page
+    with pytest.raises(WasmTrap, match="out of bounds"):
+        inst.invoke("peek", (1 << 32) - 4)
+
+
+def test_memory_grow_respects_sandbox_cap():
+    m = ModuleBuilder()
+    m.memory(1)                               # no module max
+    t = m.functype([I32], [I32])
+    m.func(t, body=local_get(0) + MEMORY_GROW, export="grow")
+    inst = _inst(m, max_pages=4)
+    assert inst.invoke("grow", 3) == 1        # 1 -> 4 pages: old size
+    assert inst.invoke("grow", 1) == 0xFFFFFFFF   # refused: -1
+    assert len(inst.mem) == 4 * 65536
+
+
+def test_div_by_zero_and_unreachable_trap():
+    m = ModuleBuilder()
+    t = m.functype([I32, I32], [I32])
+    m.func(t, body=local_get(0) + local_get(1) + b"\x6e", export="div")
+    m.func(m.functype([], [I32]), body=UNREACHABLE + i32_const(0),
+           export="boom")
+    inst = _inst(m)
+    assert inst.invoke("div", 7, 2) == 3
+    with pytest.raises(WasmTrap, match="divide by zero"):
+        inst.invoke("div", 1, 0)
+    with pytest.raises(WasmTrap, match="unreachable"):
+        inst.invoke("boom")
+
+
+def test_call_stack_depth_capped():
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    # f() calls itself unconditionally
+    m.func(t, body=call(0), export="rec")
+    blob = m.build()   # func index 0 IS rec (no imports)
+    inst = WasmInstance(WasmModule(blob))
+    with pytest.raises(WasmTrap, match="call stack"):
+        inst.invoke("rec")
+
+
+# -- the sample plugin through the host ABI ---------------------------------
+
+@pytest.fixture
+def plugin():
+    p = load_wasm_plugin(build_memcached_wasm())
+    yield p
+    unload_wasm_plugin(p)
+
+
+def test_plugin_identity(plugin):
+    assert plugin.proto == 202
+    assert plugin.name == "Memcached-wasm"
+    assert loaded_wasm_plugins() == [plugin]
+
+
+def test_plugin_check_and_parse_request(plugin):
+    req = b"get user:42\r\n"
+    assert plugin.check(req)
+    rec = plugin.parse(req)
+    assert rec.proto == 202
+    assert rec.msg_type == l7.MSG_REQUEST
+    assert rec.endpoint == "get user:42"
+    assert rec.req_len == len(req)
+    assert rec.resp_len == 0
+
+
+def test_plugin_parse_responses(plugin):
+    ok = plugin.parse(b"STORED\r\n")
+    assert ok.msg_type == l7.MSG_RESPONSE
+    assert ok.status == 0
+    assert ok.resp_len == len(b"STORED\r\n")
+    err = plugin.parse(b"SERVER_ERROR out of memory\r\n")
+    assert err.status == 1
+    assert err.endpoint == "SERVER_ERROR"
+
+
+def test_plugin_rejects_foreign_payloads(plugin):
+    assert not plugin.check(b"GET / HTTP/1.1\r\n")      # http verb, not mc
+    assert not plugin.check(b"get without newline")
+    assert not plugin.check(b"\x00\x01\x02\x03")
+    assert plugin.parse(b"\x00\x01\x02\x03") is None
+    assert plugin.failures >= 1
+
+
+def test_plugin_registry_dispatch(plugin):
+    rec = l7.parse_payload(b"delete session:9\r\n", proto=6,
+                           port_src=51000, port_dst=11211)
+    assert rec is not None and rec.proto == 202
+    assert rec.endpoint == "delete session:9"
+
+
+def test_branch_unwinds_operand_stack():
+    """A br out of an empty-typed block discards operands pushed inside
+    it (spec 4.4.8.6); a result-typed block keeps exactly its arity."""
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    # 100; block {} with a stranded 5 inside; +1 => 101, not 6
+    m.func(t, body=(i32_const(100)
+                    + block(i32_const(5) + br(0))
+                    + i32_const(1) + I32_ADD), export="discard")
+    # 100 is left below; block(result i32) carries the 5 => 5+1=6
+    m.func(t, body=(i32_const(100) + b"\x1a"
+                    + block(i32_const(5) + br(0), result=I32)
+                    + i32_const(1) + I32_ADD), export="carry")
+    inst = _inst(m)
+    assert inst.invoke("discard") == 101
+    assert inst.invoke("carry") == 6
+
+
+def test_loop_restart_does_not_grow_stack():
+    """`loop { i32.const 5; br 0 }` must keep the operand stack bounded
+    across iterations (label arity 0 truncates on restart)."""
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    m.func(t, body=loop(i32_const(5) + br(0)) + i32_const(0),
+           export="spin")
+    inst = _inst(m, fuel=120_000)
+    with pytest.raises(WasmTrap, match="fuel"):
+        inst.invoke("spin")
+    # ~40k iterations ran; a leak would have left tens of thousands of
+    # stranded operands in the (discarded) frame — instead the trap
+    # arrives promptly and memory stays flat, which the wall-clock of
+    # this test already demonstrates
+
+
+def test_runtime_decode_fault_is_a_trap():
+    """Unsupported opcodes reached at run time must trap, not leak
+    WasmDecodeError through the plugin's WasmTrap-only handlers."""
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    # block with a type-index signature (s33 >= 0): the ctrl-map
+    # pre-scan skips it, but _block_type rejects it at execution
+    m.func(t, body=b"\x02\x01\x0b" + i32_const(0), export="f")
+    inst = _inst(m)
+    with pytest.raises(WasmTrap, match="decode fault"):
+        inst.invoke("f")
+
+
+def test_float_min_max_nan_propagates():
+    import math
+    import struct as _struct
+
+    from deepflow_tpu.agent.wasm_vm import F64
+
+    m = ModuleBuilder()
+    t = m.functype([], [F64])
+    nan = b"\x44" + _struct.pack("<d", math.nan)
+    one = b"\x44" + _struct.pack("<d", 1.0)
+    m.func(t, body=nan + one + b"\xa4", export="fmin")     # f64.min
+    m.func(t, body=one + nan + b"\xa5", export="fmax")     # f64.max
+    inst = _inst(m)
+    assert math.isnan(inst.invoke("fmin"))
+    assert math.isnan(inst.invoke("fmax"))
+
+
+def test_stack_underflow_traps_not_crashes():
+    """Unvalidated guest code whose faults surface as Python exceptions
+    (stack underflow, bad indices) must convert to WasmTrap — the
+    capture thread never sees a raw IndexError."""
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    m.func(t, body=b"\x1a\x1a" + i32_const(0), export="f")   # drop, drop
+    inst = _inst(m)
+    with pytest.raises(WasmTrap, match="interpreter fault"):
+        inst.invoke("f")
+
+
+def test_untaken_if_arms_cost_no_rescan():
+    """A hostile `loop { if(0) { huge body } br 0 }` must be bounded by
+    fuel in wall-clock terms: untaken arms are jumped via the ctrl map,
+    not rescanned, so the loop burns its fuel in well under a second."""
+    import time as _time
+
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    huge = b"\x01" * 100_000                    # 100KB of nops
+    m.func(t, body=loop(
+        i32_const(0) + if_else(huge) + br(0)) + i32_const(0),
+        export="spin")
+    inst = _inst(m, fuel=100_000)
+    t0 = _time.perf_counter()
+    with pytest.raises(WasmTrap, match="fuel"):
+        inst.invoke("spin")
+    assert _time.perf_counter() - t0 < 2.0
+
+
+def test_malformed_code_section_is_decode_error():
+    """A code section with more bodies than declared functions must be
+    a WasmDecodeError, not an IndexError escaping to the embedder."""
+    from deepflow_tpu.agent.wasm_vm import WasmDecodeError, WasmModule
+
+    # module with ONLY a code section: 1 body, zero declared funcs
+    body = b"\x00" + b"\x0b"                    # no locals, end
+    code_sec = bytes([10]) + bytes([len(body) + 2]) + b"\x01" \
+        + bytes([len(body)]) + body
+    blob = b"\x00asm\x01\x00\x00\x00" + code_sec
+    with pytest.raises(WasmDecodeError, match="more code bodies"):
+        WasmModule(blob)
+
+
+def test_local_declaration_bomb_is_decode_error():
+    """Many small declarations must not expand to gigabytes of locals."""
+    from deepflow_tpu.agent.wasm_vm import WasmDecodeError, WasmModule
+    from deepflow_tpu.agent.wasm_asm import uleb
+
+    m = ModuleBuilder()
+    t = m.functype([], [I32])
+    m.func(t, body=i32_const(0), export="f")
+    blob = bytearray(m.build())
+    # splice a hand-built code section: 1000 declarations of 2^20 i32s
+    decl = uleb(1000) + (uleb(1 << 20) + bytes([I32])) * 1000
+    body = decl + i32_const(0) + b"\x0b"
+    code_payload = b"\x01" + uleb(len(body)) + body
+    # rebuild the module with the hostile code section
+    mb = ModuleBuilder()
+    t2 = mb.functype([], [I32])
+    mb.func(t2, body=i32_const(0), export="f")
+    clean = mb.build()
+    # locate the code section (id 10) and replace it
+    i = 8
+    out = bytearray(clean[:8])
+    while i < len(clean):
+        sid = clean[i]
+        # parse the uleb size
+        j = i + 1
+        size = 0
+        shift = 0
+        while True:
+            b = clean[j]
+            size |= (b & 0x7F) << shift
+            j += 1
+            if not b & 0x80:
+                break
+            shift += 7
+        if sid == 10:
+            out += bytes([10]) + uleb(len(code_payload)) + code_payload
+        else:
+            out += clean[i:j + size]
+        i = j + size
+    with pytest.raises(WasmDecodeError, match="local count"):
+        WasmModule(bytes(out))
+
+
+def test_agent_close_unregisters_wasm_plugins(tmp_path):
+    """close() must drop wasm parsers from the global registry so a
+    successor Agent doesn't double-register (parity with so_plugins)."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    wasm_path = tmp_path / "mc.wasm"
+    wasm_path.write_bytes(build_memcached_wasm())
+    a1 = Agent(AgentConfig(wasm_plugins=(str(wasm_path),)))
+    a1.close()
+    assert loaded_wasm_plugins() == []
+    a2 = Agent(AgentConfig(wasm_plugins=(str(wasm_path),)))
+    try:
+        assert len(loaded_wasm_plugins()) == 1
+    finally:
+        a2.close()
+
+
+def test_agent_survives_broken_wasm_bytes(tmp_path):
+    """Arbitrary hostile bytes pushed as a wasm_plugins path load-fail
+    cleanly (reference contract: a broken plugin only logs)."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    bad = tmp_path / "bad.wasm"
+    bad.write_bytes(b"\x00asm\x01\x00\x00\x00" + b"\x0a\x04\x01\x02\x00\x0b")
+    agent = Agent(AgentConfig())
+    assert agent._load_wasm(str(bad)) is False
+    assert agent.wasm_plugins == {}
+
+
+def test_hostile_plugin_traps_not_hangs():
+    """A plugin whose check() spins forever burns its fuel and traps;
+    the adapter reports check=False and counts the trap."""
+    m = ModuleBuilder()
+    t_v_i = m.functype([], [I32])
+    m.memory(1, 1)
+    m.func(t_v_i, body=i32_const(203), export="df_proto")
+    m.func(t_v_i, body=loop(br(0)) + i32_const(0), export="df_check")
+    m.func(t_v_i, body=i32_const(0), export="df_parse")
+    p = WasmPlugin(m.build(), fuel=50_000)
+    try:
+        assert p.check(b"anything") is False
+        assert p.traps == 1
+        assert p.counters()["traps"] == 1
+    finally:
+        pass
+
+
+def test_agent_hot_loads_wasm_plugins(tmp_path):
+    """Pushed-config lifecycle parity with so_plugins: load on
+    construction, converge on push, unload on removal."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    wasm_path = tmp_path / "memcached.wasm"
+    wasm_path.write_bytes(build_memcached_wasm())
+    agent = Agent(AgentConfig(wasm_plugins=(str(wasm_path),)))
+    try:
+        assert str(wasm_path) in agent.wasm_plugins
+        assert loaded_wasm_plugins() != []
+        rec = l7.parse_payload(b"incr hits 1\r\n", proto=6,
+                               port_src=51000, port_dst=11211)
+        assert rec is not None and rec.proto == 202
+        # pushing an empty set must actually stop the plugin
+        agent._apply_config({"wasm_plugins": []})
+        assert agent.wasm_plugins == {}
+        assert loaded_wasm_plugins() == []
+        # and a broken path must not take the agent down
+        assert agent._load_wasm(str(tmp_path / "missing.wasm")) is False
+    finally:
+        agent._sync_wasm_plugins([])
+
+
+def test_plugin_counters(plugin):
+    before = plugin.calls
+    plugin.check(b"get k\r\n")
+    plugin.parse(b"get k\r\n")
+    c = plugin.counters()
+    assert c["calls"] == before + 2
+    assert c["plugin"] == "Memcached-wasm"
+    assert c["mem_pages"] == 1
