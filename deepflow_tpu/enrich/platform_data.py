@@ -286,9 +286,14 @@ class PlatformDataManager:
     """Owns the shared tables; pipelines grab handles, the controller client
     pushes versioned snapshots (reference: PlatformDataManager :325)."""
 
-    def __init__(self, stats: Optional[StatsRegistry] = None) -> None:
+    def __init__(self, stats: Optional[StatsRegistry] = None,
+                 geo=None) -> None:
         self.info = PlatformInfoTable(stats=stats)
         self.services = ServiceTable()
+        # optional enrich.geo.GeoTable: province_0/1 stamping (reference
+        # stamps geo.QueryProvince right beside KnowledgeGraph fill,
+        # l4_flow_log.go:686); None leaves the columns zero
+        self.geo = geo
 
     def update(self, interfaces: Sequence[InterfaceInfo],
                cidrs: Sequence[CidrInfo],
@@ -357,6 +362,9 @@ class PlatformDataManager:
                          np.zeros(n, np.uint32), cols["proto"])
         self._stamp_side(out, "1", epc1, cols["ip_dst"],
                          cols["port_dst"], cols["proto"])
+        if self.geo is not None:
+            out["province_0"] = self.geo.query(cols["ip_src"])
+            out["province_1"] = self.geo.query(cols["ip_dst"])
         return out
 
     def stamp_l7(self, cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

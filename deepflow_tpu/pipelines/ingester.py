@@ -55,6 +55,11 @@ class IngesterConfig:
     # analyzer bits of every row _id (l4_flow_log.go genID) — distinct
     # per process or ids collide across ingesters
     analyzer_id: int = 0
+    # geo-IP province stamping (enrich/geo.py): a JSON data file path,
+    # or None for the built-in synthetic sample ranges; geo_enabled
+    # False leaves the province columns zero
+    geo_db_path: Optional[str] = None
+    geo_enabled: bool = True
 
 
 class Ingester:
@@ -75,6 +80,12 @@ class Ingester:
             self.monitor = DiskMonitor(self.store, cfg.store_max_bytes,
                                        stats=self.stats)
         self.tag_dicts = TagDictRegistry(cfg.store_path)
+        # a caller-supplied PlatformDataManager keeps its own geo choice
+        # (incl. geo=None meaning "leave the columns zero")
+        if platform is None and cfg.geo_enabled:
+            from deepflow_tpu.enrich.geo import load_geo_table
+            self.platform.geo = load_geo_table(cfg.geo_db_path,
+                                               self.tag_dicts)
         self.tpu_sketch = None
         if cfg.tpu_sketch_window_s is not None:
             from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter

@@ -30,6 +30,8 @@ from deepflow_tpu.store.table import AggKind
 # dicts, e.g. event_type in resource_event vs in_process_profile)
 DICT_COLUMNS = {
     "endpoint_hash": ("l7_endpoint",),
+    "province_0": ("province",),
+    "province_1": ("province",),
     "metric": ("metric_name",),
     "labels": ("label_set",),
     "stack": ("profile_stack",),

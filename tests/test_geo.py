@@ -1,0 +1,142 @@
+"""Geo-IP province enrichment (enrich/geo.py): range-join semantics,
+data loading, pipeline stamping, querier humanization.
+
+Reference behavior being matched: server/libs/geo netmask_tree Query +
+l4_flow_log.go:686 QueryProvince into province_0/1 — here one
+vectorized searchsorted join at enrich time and a SmartEncoded u32
+dictionary column instead of a per-row tree walk + string column.
+"""
+
+import ipaddress
+import json
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.enrich.geo import GeoTable, load_geo_table
+from deepflow_tpu.store.dict_store import TagDictRegistry
+
+
+def _ip(s: str) -> int:
+    return int(ipaddress.IPv4Address(s))
+
+
+def test_query_range_edges_and_misses():
+    t = GeoTable.sample()
+    ips = np.array([_ip("192.0.2.0"), _ip("192.0.2.255"),   # edges
+                    _ip("192.0.1.255"), _ip("192.0.3.0"),   # neighbors
+                    _ip("10.0.0.1"), 0, 0xFFFFFFFF],        # private/ends
+                   np.uint32)
+    codes = t.query(ips)
+    assert codes[0] == codes[1] != 0
+    assert codes[2] == codes[3] == 0
+    assert codes[4] == codes[5] == codes[6] == 0
+
+
+def test_query_distinguishes_ranges():
+    t = GeoTable.sample()
+    a = t.query(np.array([_ip("198.51.100.7")], np.uint32))[0]
+    b = t.query(np.array([_ip("203.0.113.7")], np.uint32))[0]
+    assert a != 0 and b != 0 and a != b
+    # the /15 benchmark net spans two /16s
+    c = t.query(np.array([_ip("198.18.0.1"), _ip("198.19.255.254")],
+                         np.uint32))
+    assert c[0] == c[1] != 0
+
+
+def test_empty_table_and_overlap_rejection():
+    assert GeoTable([]).query(np.arange(4, dtype=np.uint32)).sum() == 0
+    with pytest.raises(ValueError, match="overlap"):
+        GeoTable([(100, 200, "a"), (150, 300, "b")])
+
+
+def test_from_json_and_v6_skip(tmp_path):
+    p = tmp_path / "geo.json"
+    p.write_text(json.dumps([
+        {"cidr": "192.0.2.0/25", "province": "west"},
+        {"start": "192.0.2.128", "end": "192.0.2.255", "province": "east"},
+        {"cidr": "2001:db8::/32", "province": "ignored-v6"},
+        # v6 start/end rows must be SKIPPED like v6 cidrs, not crash
+        {"start": "2001:db8::1", "end": "2001:db8::ff",
+         "province": "ignored-v6-range"},
+    ]))
+    t = GeoTable.from_json(str(p))
+    codes = t.query(np.array([_ip("192.0.2.1"), _ip("192.0.2.200")],
+                             np.uint32))
+    assert codes[0] != codes[1] and 0 not in codes.tolist()
+    assert "ignored-v6" not in t.names
+    assert "ignored-v6-range" not in t.names
+
+
+def test_ingester_respects_caller_platform_and_disable(tmp_path):
+    """A caller-supplied PlatformDataManager keeps geo=None (columns
+    stay zero); geo_enabled=False disables stamping without a platform."""
+    from deepflow_tpu.enrich.platform_data import PlatformDataManager
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+
+    pm = PlatformDataManager()
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path / "a")),
+                   platform=pm)
+    assert pm.geo is None
+    ing2 = Ingester(IngesterConfig(listen_port=0, geo_enabled=False,
+                                   store_path=str(tmp_path / "b")))
+    assert ing2.platform.geo is None
+
+
+def test_stamp_l4_fills_province_columns():
+    from deepflow_tpu.enrich.platform_data import PlatformDataManager
+
+    pm = PlatformDataManager(geo=GeoTable.sample())
+    n = 3
+    cols = {
+        "ip_src": np.array([_ip("192.0.2.9"), _ip("10.1.1.1"),
+                            _ip("198.51.100.2")], np.uint32),
+        "ip_dst": np.array([_ip("203.0.113.9"), _ip("192.0.2.1"),
+                            _ip("10.2.2.2")], np.uint32),
+        "port_dst": np.zeros(n, np.uint32),
+        "proto": np.full(n, 6, np.uint32),
+        "l3_epc_id": np.zeros(n, np.uint32),
+        "l3_epc_id_1": np.zeros(n, np.uint32),
+    }
+    out = pm.stamp_l4(cols)
+    assert out["province_0"][0] != 0 and out["province_0"][1] == 0
+    assert out["province_1"][1] != 0 and out["province_1"][2] == 0
+    # codes resolve through the table's own name list
+    code = out["province_0"][0]
+    assert code in set(GeoTable.sample().codes.tolist())
+
+
+def test_names_land_in_shared_tag_dict(tmp_path):
+    dicts = TagDictRegistry(str(tmp_path))
+    t = load_geo_table(None, dicts)
+    code = t.query(np.array([_ip("192.0.2.1")], np.uint32))[0]
+    assert dicts.get("province").decode(int(code)) == "TEST-NET-1"
+
+
+def test_querier_humanizes_province(tmp_path):
+    """SELECT province_0 returns the region name, and WHERE
+    province_0 = '<name>' encodes through the same dictionary."""
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+    from deepflow_tpu.querier.engine import QueryEngine
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    try:
+        table = ing.store.table("flow_log", "l4_flow_log")
+        n = 2
+        cols = {c.name: np.zeros(n, c.dtype)
+                for c in table.schema.columns}
+        cols["timestamp"] = np.array([100, 101], np.uint32)
+        cols["ip_src"] = np.array([_ip("192.0.2.5"), _ip("10.0.0.5")],
+                                  np.uint32)
+        cols["province_0"] = ing.platform.geo.query(cols["ip_src"])
+        table.append(cols)
+        eng = QueryEngine(ing.store, tag_dicts=ing.tag_dicts)
+        res = eng.execute("SELECT province_0 FROM l4_flow_log "
+                          "ORDER BY province_0 LIMIT 10")
+        vals = [r[0] for r in res.values]
+        assert "TEST-NET-1" in vals
+    finally:
+        ing.close()
