@@ -165,6 +165,16 @@ def cmd_ingester(args) -> int:
     elif args.action == "assignments":
         print(json.dumps(_http(f"{args.controller}/v1/assignments"),
                          indent=2))
+    elif args.action == "datasource":
+        req = {"op": args.op}
+        if args.interval is not None:
+            req["interval"] = args.interval
+        if args.ttl is not None:
+            req["ttl"] = args.ttl
+        if args.keep_data:
+            req["drop"] = False
+        out = debug_request("datasource", port=args.debug_port, **req)
+        print(json.dumps(out, indent=2, sort_keys=True))
     elif args.action in ("counters", "vtap-status", "ping", "stacks",
                          "artifacts"):
         out = debug_request(args.action, port=args.debug_port,
@@ -337,9 +347,19 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("ingester", help="ingester membership + debug")
     i.add_argument("action", choices=["set", "assignments", "counters",
                                       "vtap-status", "ping", "stacks",
-                                      "artifacts"])
+                                      "artifacts", "datasource"])
     i.add_argument("addrs", nargs="*")
     i.add_argument("--module")
+    i.add_argument("--op", default="list",
+                   choices=["list", "add", "del", "retention"],
+                   help="datasource: rollup-tier CRUD "
+                        "(deepflow-ctl domain datasource role)")
+    i.add_argument("--interval", type=int,
+                   help="datasource tier in seconds (whole minutes)")
+    i.add_argument("--ttl", type=int,
+                   help="retention seconds (0 = keep forever)")
+    i.add_argument("--keep-data", action="store_true",
+                   help="datasource del: detach the tier but keep rows")
     i.set_defaults(fn=cmd_ingester)
 
     q = sub.add_parser("query", help="run DeepFlow-SQL")

@@ -363,8 +363,17 @@ class PlatformDataManager:
         self._stamp_side(out, "1", epc1, cols["ip_dst"],
                          cols["port_dst"], cols["proto"])
         if self.geo is not None:
-            out["province_0"] = self.geo.query(cols["ip_src"])
-            out["province_1"] = self.geo.query(cols["ip_dst"])
+            p0 = self.geo.query(cols["ip_src"])
+            p1 = self.geo.query(cols["ip_dst"])
+            if "is_ipv6" in cols:
+                # folded-u32 v6 addresses are not order-preserving: a
+                # range join on them is meaningless (the reference guards
+                # QueryProvince with !isIPv6, l4_flow_log.go:686)
+                v6 = np.asarray(cols["is_ipv6"]) != 0
+                p0 = np.where(v6, np.uint32(0), p0)
+                p1 = np.where(v6, np.uint32(0), p1)
+            out["province_0"] = p0
+            out["province_1"] = p1
         return out
 
     def stamp_l7(self, cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

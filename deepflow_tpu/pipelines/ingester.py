@@ -136,6 +136,41 @@ class Ingester:
                 lambda req: {f"{v}:{t}": vars(st) for (v, t), st
                              in self.receiver.status().items()})
             self.debug.register("artifacts", self._artifact_listing)
+            self.debug.register("datasource", self._datasource_cmd)
+
+    def _datasource_cmd(self, req: dict) -> dict:
+        """Runtime rollup-tier CRUD over the debug socket (the
+        reference's `deepflow-ctl domain datasource` ->
+        datasource/handle.go Handle). op: list | add | del | retention;
+        add/del/retention take interval (seconds, whole minutes), add
+        and retention take ttl (seconds, 0 = keep forever)."""
+        rollups = self.flow_metrics.rollups
+        if rollups is None:
+            return {"error": "storage disabled: no rollup tiers"}
+        op = req.get("op", "list")
+        try:
+            if op == "list":
+                return {"datasources": rollups.list_datasources()}
+            interval = int(req["interval"])
+            if op == "add":
+                ttl = req.get("ttl")
+                # absent ttl = derive the tier default; 0 = keep forever
+                from deepflow_tpu.store.rollup import TTL_DERIVE
+                return rollups.add_interval(
+                    interval, TTL_DERIVE if ttl is None else int(ttl))
+            if op == "del":
+                ok = rollups.remove_interval(interval,
+                                             drop_data=bool(
+                                                 req.get("drop", True)))
+                return {"deleted": ok, "interval": interval}
+            if op == "retention":
+                ttl = req.get("ttl")
+                ok = rollups.set_retention(interval,
+                                           None if not ttl else int(ttl))
+                return {"updated": ok, "interval": interval}
+            return {"error": f"unknown op {op!r}"}
+        except (KeyError, ValueError) as e:
+            return {"error": str(e)}
 
     def _artifact_listing(self, req: dict) -> dict:
         """Stored droplet artifacts (per-vtap pcaps, syslog files) —

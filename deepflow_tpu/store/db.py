@@ -174,6 +174,16 @@ class Table:
         return total
 
     # -- retention ---------------------------------------------------------
+    def set_ttl(self, ttl_seconds: Optional[int]) -> None:
+        """Change this table's retention and persist it (the reference's
+        datasource retention-time update, datasource/handle.go TTL
+        ALTERs). Takes effect at the next expire() sweep."""
+        import dataclasses
+        with self._lock:
+            self.schema = dataclasses.replace(self.schema,
+                                              ttl_seconds=ttl_seconds)
+            self._save_manifest()
+
     def expire(self, now: Optional[float] = None) -> int:
         """Drop partitions past TTL; returns partitions dropped."""
         if self.schema.ttl_seconds is None:
@@ -255,6 +265,17 @@ class Store:
 
     def tables(self) -> List[Tuple[str, str]]:
         return sorted(self._tables.keys())
+
+    def drop_table(self, db: str, name: str) -> bool:
+        """Delete a table and its data (the reference's datasource del
+        DROP TABLE). Only callers that own the table's write path should
+        drop it — a concurrent writer would recreate stray segment files."""
+        with self._lock:
+            t = self._tables.pop((db, name), None)
+        if t is None:
+            return False
+        shutil.rmtree(t.root, ignore_errors=True)
+        return True
 
     def expire_all(self, now: Optional[float] = None) -> int:
         return sum(t.expire(now) for t in self._tables.values())

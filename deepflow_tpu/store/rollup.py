@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import functools
 import os
+import shutil
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -31,17 +33,42 @@ _I64_MIN = np.int64(np.iinfo(np.int64).min)
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 
 
+TTL_DERIVE = -1   # rollup_schema ttl sentinel: 30x the base retention
+
+
+def _interval_suffix(interval: int) -> str:
+    return {60: "1m", 3600: "1h", 86400: "1d"}.get(interval, f"{interval}s")
+
+
+def interval_from_table_name(base_name: str, table_name: str
+                             ) -> Optional[int]:
+    """Inverse of rollup_schema's naming: `vtap_flow_port.1h` -> 3600
+    for base `vtap_flow_port`; None if not a rollup of this base."""
+    if not table_name.startswith(base_name + "."):
+        return None
+    suffix = table_name[len(base_name) + 1:]
+    named = {"1m": 60, "1h": 3600, "1d": 86400}.get(suffix)
+    if named is not None:
+        return named
+    if suffix.endswith("s") and suffix[:-1].isdigit():
+        return int(suffix[:-1])
+    return None
+
+
 def rollup_schema(base: TableSchema, interval: int,
-                  ttl_seconds: Optional[int] = None) -> TableSchema:
-    """Derive the coarser table's schema (name suffixed `.1m`-style)."""
-    suffix = {60: "1m", 3600: "1h", 86400: "1d"}.get(interval, f"{interval}s")
+                  ttl_seconds: Optional[int] = TTL_DERIVE) -> TableSchema:
+    """Derive the coarser table's schema (name suffixed `.1m`-style).
+    ttl_seconds: TTL_DERIVE = 30x base retention, None = keep forever,
+    >=0 = explicit seconds."""
+    if ttl_seconds == TTL_DERIVE:
+        ttl_seconds = None if base.ttl_seconds is None \
+            else base.ttl_seconds * 30
     return TableSchema(
-        name=f"{base.name}.{suffix}",
+        name=f"{base.name}.{_interval_suffix(interval)}",
         columns=base.columns,
         time_column=base.time_column,
         partition_seconds=max(base.partition_seconds, interval * 60),
-        ttl_seconds=ttl_seconds if ttl_seconds is not None
-        else (None if base.ttl_seconds is None else base.ttl_seconds * 30),
+        ttl_seconds=ttl_seconds,
         version=base.version,
     )
 
@@ -302,7 +329,19 @@ class RollupManager:
         self.base = store.create_table(db, base)
         self.allowance = allowance_seconds
         self.targets: List[Tuple[int, Table]] = []
-        for iv in intervals:
+        # configured tiers UNION tiers found on disk: a runtime
+        # `datasource add` persists as its table (the manifest IS the
+        # registration, like everything else in this store), so a
+        # restarted ingester keeps building tiers an operator added
+        # (reference: datasource defs live in the controller DB)
+        want = set(intervals)
+        for tdb, tname in store.tables():
+            if tdb != db:
+                continue
+            iv = interval_from_table_name(base.name, tname)
+            if iv is not None:
+                want.add(iv)
+        for iv in sorted(want):
             self.targets.append(
                 (iv, store.create_table(db, rollup_schema(base, iv))))
         # per-interval high-water mark: everything < mark already built.
@@ -311,6 +350,81 @@ class RollupManager:
         # double-count) by reading the newest built bucket's timestamp.
         self._built_until: Dict[int, int] = {
             iv: self._recover_watermark(iv, t) for iv, t in self.targets}
+        # guards targets/_built_until against runtime datasource CRUD
+        # (debug-socket thread) racing advance() (pipeline thread).
+        # Builds run OUTSIDE the lock (a backfill can scan days of base
+        # data — holding the lock would time out the debug socket);
+        # _building marks in-flight tiers, _drop_pending records a del
+        # that arrived mid-build so its table is re-dropped afterwards.
+        self._lock = threading.Lock()
+        self._building: set = set()
+        self._drop_pending: Dict[int, str] = {}   # interval -> table root
+
+    # -- runtime datasource CRUD (reference: datasource/handle.go Handle
+    # add/mod/del driven by deepflow-ctl; CH materialized views there,
+    # derived tables + watermarks here) -----------------------------------
+    def list_datasources(self) -> List[dict]:
+        with self._lock:
+            return [{"interval": iv, "table": t.schema.name,
+                     "ttl_seconds": t.schema.ttl_seconds,
+                     "built_until": self._built_until[iv]}
+                    for iv, t in self.targets]
+
+    def add_interval(self, interval: int,
+                     ttl_seconds: Optional[int] = TTL_DERIVE) -> dict:
+        """Create a new rollup tier at runtime. Unlike the reference's
+        materialized views (which only see new inserts), the next
+        advance() backfills every complete bucket still in the base
+        table's retention. ttl_seconds: TTL_DERIVE = 30x base retention,
+        None/0 = keep forever, >0 = explicit seconds."""
+        if interval <= 0 or interval % 60:
+            # the reference constrains custom tiers to whole minutes
+            # (handle.go: 1m/1h composition); sub-minute tiers belong to
+            # the base table
+            raise ValueError("interval must be a positive multiple of 60")
+        if ttl_seconds == 0:
+            ttl_seconds = None                       # keep forever
+        with self._lock:
+            if any(iv == interval for iv, _ in self.targets):
+                raise ValueError(f"datasource {interval}s already exists")
+            t = self.store.create_table(
+                self.db, rollup_schema(self.base.schema, interval,
+                                       ttl_seconds))
+            if ttl_seconds != TTL_DERIVE and \
+                    t.schema.ttl_seconds != ttl_seconds:
+                # re-attach of a tier removed with keep-data:
+                # create_table returned the EXISTING table — the
+                # requested retention must still win
+                t.set_ttl(ttl_seconds)
+            self.targets.append((interval, t))
+            self.targets.sort()
+            self._built_until[interval] = self._recover_watermark(interval, t)
+            return {"interval": interval, "table": t.schema.name,
+                    "ttl_seconds": t.schema.ttl_seconds}
+
+    def remove_interval(self, interval: int, drop_data: bool = True) -> bool:
+        with self._lock:
+            for i, (iv, t) in enumerate(self.targets):
+                if iv == interval:
+                    del self.targets[i]
+                    del self._built_until[iv]
+                    if drop_data:
+                        self.store.drop_table(self.db, t.schema.name)
+                        if iv in self._building:
+                            # an in-flight build may recreate the table
+                            # dir with its append; advance() re-drops it
+                            # when the build drains
+                            self._drop_pending[iv] = t.root
+                    return True
+        return False
+
+    def set_retention(self, interval: int, ttl_seconds: Optional[int]) -> bool:
+        with self._lock:
+            for iv, t in self.targets:
+                if iv == interval:
+                    t.set_ttl(ttl_seconds)
+                    return True
+        return False
 
     @staticmethod
     def _recover_watermark(interval: int, target: Table) -> int:
@@ -329,21 +443,43 @@ class RollupManager:
         """Build all complete buckets older than now-allowance.
         Returns {interval: rows_emitted}."""
         emitted: Dict[int, int] = {}
-        for iv, target in self.targets:
-            safe = int(now - self.allowance) // iv * iv
-            lo = self._built_until[iv]
-            if lo == 0:
-                parts = self.base.partitions()
-                if not parts:
+        with self._lock:
+            targets = list(self.targets)
+        for iv, target in targets:
+            # bookkeeping under the lock, the build itself outside it
+            # (a backfill can scan days of base data; the debug socket's
+            # datasource commands must stay responsive meanwhile). The
+            # _building marker keeps a concurrent del honest: its table
+            # drop is re-applied after the build drains.
+            with self._lock:
+                if iv not in self._built_until or iv in self._building:
+                    continue   # removed by datasource del / double run
+                safe = int(now - self.allowance) // iv * iv
+                lo = self._built_until[iv]
+                if lo == 0:
+                    parts = self.base.partitions()
+                    if not parts:
+                        emitted[iv] = 0
+                        continue
+                    lo = parts[0] // iv * iv
+                if safe <= lo:
                     emitted[iv] = 0
                     continue
-                lo = parts[0] // iv * iv
-            if safe <= lo:
-                emitted[iv] = 0
-                continue
-            rows = self._build_range(iv, target, lo, safe)
-            self._built_until[iv] = safe
-            emitted[iv] = rows
+                self._building.add(iv)
+            rows = None
+            try:
+                rows = self._build_range(iv, target, lo, safe)
+            finally:
+                with self._lock:
+                    self._building.discard(iv)
+                    if iv in self._built_until:
+                        if rows is not None:   # failed build: retry later
+                            self._built_until[iv] = safe
+                            emitted[iv] = rows
+                    else:
+                        pend = self._drop_pending.pop(iv, None)
+                        if pend is not None:
+                            shutil.rmtree(pend, ignore_errors=True)
         return emitted
 
     def _build_range(self, interval: int, target: Table,

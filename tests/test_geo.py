@@ -107,6 +107,30 @@ def test_stamp_l4_fills_province_columns():
     assert code in set(GeoTable.sample().codes.tolist())
 
 
+def test_v6_rows_never_geo_stamped():
+    """Folded v6 addresses land in 240.0.0.0/4; a sloppy operator range
+    reaching there must not stamp provinces on v6 flows (reference
+    guards QueryProvince with !isIPv6)."""
+    from deepflow_tpu.enrich.platform_data import PlatformDataManager
+
+    t = GeoTable([(0xF0000000, 0xFFFFFFFF, "sloppy-class-e")])
+    pm = PlatformDataManager(geo=t)
+    n = 2
+    folded_v6 = 0xF1234567
+    cols = {
+        "ip_src": np.array([folded_v6, folded_v6], np.uint32),
+        "ip_dst": np.array([folded_v6, folded_v6], np.uint32),
+        "is_ipv6": np.array([1, 0], np.uint32),
+        "port_dst": np.zeros(n, np.uint32),
+        "proto": np.full(n, 6, np.uint32),
+        "l3_epc_id": np.zeros(n, np.uint32),
+        "l3_epc_id_1": np.zeros(n, np.uint32),
+    }
+    out = pm.stamp_l4(cols)
+    assert out["province_0"][0] == 0          # v6: masked
+    assert out["province_0"][1] != 0          # v4 row in range: stamped
+
+
 def test_names_land_in_shared_tag_dict(tmp_path):
     dicts = TagDictRegistry(str(tmp_path))
     t = load_geo_table(None, dicts)

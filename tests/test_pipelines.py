@@ -206,3 +206,32 @@ def test_l7_firehose_rows_are_enriched(ingester):
     assert (out["request_type_hash"] != 0).all()
     assert (out["trace_id_hash"] != 0).all()
     assert (out["rrt_us"] == 1500).all()
+
+
+def test_datasource_debug_command(tmp_path):
+    """df-ctl ingester datasource --op ... round trip over the debug
+    socket: list, add (new tier appears), retention, del."""
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+    from deepflow_tpu.runtime.debug import debug_request
+
+    ing = Ingester(IngesterConfig(listen_port=0, debug_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    try:
+        port = ing.debug.port
+
+        def ds(**kw):
+            return debug_request("datasource", port=port, **kw)["data"]
+
+        out = ds(op="list")
+        assert {d["interval"] for d in out["datasources"]} == {60}
+        out = ds(op="add", interval=3600, ttl=999)
+        assert out["table"].endswith(".1h") and out["ttl_seconds"] == 999
+        out = ds(op="retention", interval=3600, ttl=555)
+        assert out["updated"] is True
+        out = ds(op="del", interval=3600)
+        assert out["deleted"] is True
+        out = ds(op="add", interval=90)
+        assert "multiple of 60" in out["error"]
+    finally:
+        ing.close()

@@ -343,6 +343,84 @@ def test_standard_migrations_upgrade_old_metrics_store(tmp_path):
     assert issu2.run() == {}
 
 
+def test_datasource_runtime_crud(tmp_path):
+    """Runtime rollup-tier CRUD (the reference's deepflow-ctl domain
+    datasource -> datasource/handle.go): add backfills history, del
+    drops the table, retention persists across a store reload."""
+    store = Store(str(tmp_path))
+    mgr = RollupManager(store, "db", _schema(), intervals=(60,),
+                        allowance_seconds=5)
+    mgr.base.append(_chunk([1, 2, 61, 3601], [9, 9, 9, 9],
+                           [10, 20, 40, 5], [3, 9, 4, 2]))
+    mgr.advance(now=7300.0)
+
+    # validation: sub-minute and duplicate tiers refused
+    import pytest
+    with pytest.raises(ValueError, match="multiple of 60"):
+        mgr.add_interval(90)
+    with pytest.raises(ValueError, match="already exists"):
+        mgr.add_interval(60)
+
+    # add a 1h tier at runtime: next advance BACKFILLS old buckets
+    info = mgr.add_interval(3600, ttl_seconds=1234)
+    assert info["table"] == "t.1h"
+    emitted = mgr.advance(now=7300.0)
+    assert emitted[3600] == 2           # hour-0 (3 rows) + hour-1 (1 row)
+    r = store.table("db", "t.1h").scan()
+    rows = {int(t): int(b) for t, b in zip(r["timestamp"], r["bytes"])}
+    assert rows == {0: 70, 3600: 5}
+    ds = {d["interval"]: d for d in mgr.list_datasources()}
+    assert ds[3600]["ttl_seconds"] == 1234
+
+    # retention: persists through the manifest to a fresh Store
+    assert mgr.set_retention(3600, 777) is True
+    assert Store(str(tmp_path)).table("db", "t.1h").schema.ttl_seconds == 777
+
+    # del: table gone from store and disk, advance survives
+    assert mgr.remove_interval(3600) is True
+    assert not store.has_table("db", "t.1h")
+    assert not (tmp_path / "db" / "t.1h").exists()
+    assert 3600 not in mgr.advance(now=7400.0)
+    assert mgr.remove_interval(3600) is False
+
+
+def test_datasource_ttl_semantics_and_restart_persistence(tmp_path):
+    """--ttl 0 means keep forever (not the derived default); absent ttl
+    derives 30x base; a runtime-added tier survives a restart because
+    its on-disk table IS the registration; re-adding a kept-data tier
+    with an explicit ttl applies that ttl."""
+    import dataclasses
+
+    from deepflow_tpu.store.rollup import TTL_DERIVE
+
+    base_schema = dataclasses.replace(_schema(), ttl_seconds=1000)
+    store = Store(str(tmp_path))
+    mgr = RollupManager(store, "db", base_schema, intervals=(60,),
+                        allowance_seconds=5)
+    mgr.base.append(_chunk([1, 3601], [9, 9], [10, 5], [3, 2]))
+
+    # ttl 0 -> forever; absent -> derived 30x base
+    info = mgr.add_interval(3600, ttl_seconds=0)
+    assert info["ttl_seconds"] is None
+    info2 = mgr.add_interval(7200, ttl_seconds=TTL_DERIVE)
+    assert info2["ttl_seconds"] == 1000 * 30
+
+    # restart: a fresh manager configured with only (60,) re-discovers
+    # both runtime tiers from disk and keeps building them
+    mgr2 = RollupManager(store, "db", base_schema, intervals=(60,),
+                         allowance_seconds=5)
+    assert {iv for iv, _ in mgr2.targets} == {60, 3600, 7200}
+    emitted = mgr2.advance(now=7300.0 + 3600)
+    assert emitted[3600] == 2
+
+    # keep-data del + re-add with explicit ttl: the ttl must win over
+    # the existing table's manifest
+    assert mgr2.remove_interval(3600, drop_data=False) is True
+    info3 = mgr2.add_interval(3600, ttl_seconds=42)
+    assert info3["ttl_seconds"] == 42
+    assert store.table("db", "t.1h").schema.ttl_seconds == 42
+
+
 def test_group_reduce_device_matches_host_property():
     """Property: the device GROUP BY program and the host-lexsort path
     are the same function, across random key cardinalities, agg kinds,
