@@ -148,6 +148,8 @@ class Ingester:
         if rollups is None:
             return {"error": "storage disabled: no rollup tiers"}
         op = req.get("op", "list")
+        if op not in ("list", "add", "del", "retention"):
+            return {"error": f"unknown op {op!r}"}
         try:
             if op == "list":
                 return {"datasources": rollups.list_datasources()}
@@ -163,13 +165,18 @@ class Ingester:
                                              drop_data=bool(
                                                  req.get("drop", True)))
                 return {"deleted": ok, "interval": interval}
-            if op == "retention":
-                ttl = req.get("ttl")
-                ok = rollups.set_retention(interval,
-                                           None if not ttl else int(ttl))
-                return {"updated": ok, "interval": interval}
-            return {"error": f"unknown op {op!r}"}
-        except (KeyError, ValueError) as e:
+            # retention: an explicit ttl is REQUIRED (a forgotten --ttl
+            # must not silently mean keep-forever); 0 = keep forever
+            ttl = req.get("ttl")
+            if ttl is None:
+                return {"error": "retention requires ttl "
+                                 "(seconds; 0 = keep forever)"}
+            ok = rollups.set_retention(interval,
+                                       None if int(ttl) == 0 else int(ttl))
+            return {"updated": ok, "interval": interval}
+        except KeyError as e:
+            return {"error": f"missing field {e}"}
+        except ValueError as e:
             return {"error": str(e)}
 
     def _artifact_listing(self, req: dict) -> dict:

@@ -142,7 +142,10 @@ class QueryEngine:
             # no db scoping requested: search every database
             for d, t in self.store.tables():
                 if t == name:
-                    return self.store.table(d, t)
+                    try:
+                        return self.store.table(d, t)
+                    except KeyError:
+                        break   # dropped between listing and lookup
         # an explicit db must NOT fall through to other databases — a
         # typo'd db would silently answer from the wrong data
         raise KeyError(f"unknown table {name}"

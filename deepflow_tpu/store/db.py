@@ -258,13 +258,22 @@ class Store:
             return t
 
     def table(self, db: str, name: str) -> Table:
-        return self._tables[(db, name)]
+        with self._lock:
+            return self._tables[(db, name)]
 
     def has_table(self, db: str, name: str) -> bool:
-        return (db, name) in self._tables
+        with self._lock:
+            return (db, name) in self._tables
 
     def tables(self) -> List[Tuple[str, str]]:
-        return sorted(self._tables.keys())
+        with self._lock:
+            return sorted(self._tables.keys())
+
+    def _snapshot(self) -> List[Table]:
+        # runtime datasource CRUD mutates _tables from the debug-socket
+        # thread; sweepers iterate a snapshot, never the live dict
+        with self._lock:
+            return list(self._tables.values())
 
     def drop_table(self, db: str, name: str) -> bool:
         """Delete a table and its data (the reference's datasource del
@@ -278,7 +287,7 @@ class Store:
         return True
 
     def expire_all(self, now: Optional[float] = None) -> int:
-        return sum(t.expire(now) for t in self._tables.values())
+        return sum(t.expire(now) for t in self._snapshot())
 
     def disk_bytes(self) -> int:
-        return sum(t.disk_bytes() for t in self._tables.values())
+        return sum(t.disk_bytes() for t in self._snapshot())

@@ -54,13 +54,19 @@ class DiskMonitor:
         # total per drop instead of re-walking every segment each iteration
         candidates: List[Tuple[int, Tuple[str, str]]] = []
         for db, tname in self.store.tables():
-            t = self.store.table(db, tname)
+            try:
+                t = self.store.table(db, tname)
+            except KeyError:
+                continue   # dropped by runtime datasource del mid-sweep
             candidates.extend((p, (db, tname)) for p in t.partitions())
         candidates.sort()
         for part, (db, tname) in candidates:
             if used <= self.low_bytes:
                 break
-            t = self.store.table(db, tname)
+            try:
+                t = self.store.table(db, tname)
+            except KeyError:
+                continue
             used -= t.partition_bytes(part)
             t.drop_partition(part)
             dropped += 1

@@ -33,11 +33,17 @@ _I64_MIN = np.int64(np.iinfo(np.int64).min)
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 
 
-TTL_DERIVE = -1   # rollup_schema ttl sentinel: 30x the base retention
+# rollup_schema ttl sentinel (identity object: no integer the debug
+# socket could pass collides with it): derive 30x the base retention
+TTL_DERIVE = object()
+
+# one shared table for both naming directions; inverse derived
+_NAMED_SUFFIXES = {60: "1m", 3600: "1h", 86400: "1d"}
+_SUFFIX_INTERVALS = {v: k for k, v in _NAMED_SUFFIXES.items()}
 
 
 def _interval_suffix(interval: int) -> str:
-    return {60: "1m", 3600: "1h", 86400: "1d"}.get(interval, f"{interval}s")
+    return _NAMED_SUFFIXES.get(interval, f"{interval}s")
 
 
 def interval_from_table_name(base_name: str, table_name: str
@@ -47,7 +53,7 @@ def interval_from_table_name(base_name: str, table_name: str
     if not table_name.startswith(base_name + "."):
         return None
     suffix = table_name[len(base_name) + 1:]
-    named = {"1m": 60, "1h": 3600, "1d": 86400}.get(suffix)
+    named = _SUFFIX_INTERVALS.get(suffix)
     if named is not None:
         return named
     if suffix.endswith("s") and suffix[:-1].isdigit():
@@ -56,11 +62,11 @@ def interval_from_table_name(base_name: str, table_name: str
 
 
 def rollup_schema(base: TableSchema, interval: int,
-                  ttl_seconds: Optional[int] = TTL_DERIVE) -> TableSchema:
+                  ttl_seconds=TTL_DERIVE) -> TableSchema:
     """Derive the coarser table's schema (name suffixed `.1m`-style).
     ttl_seconds: TTL_DERIVE = 30x base retention, None = keep forever,
     >=0 = explicit seconds."""
-    if ttl_seconds == TTL_DERIVE:
+    if ttl_seconds is TTL_DERIVE:
         ttl_seconds = None if base.ttl_seconds is None \
             else base.ttl_seconds * 30
     return TableSchema(
@@ -339,7 +345,16 @@ class RollupManager:
             if tdb != db:
                 continue
             iv = interval_from_table_name(base.name, tname)
-            if iv is not None:
+            if iv is None or iv in want:
+                continue
+            # a tier removed with keep-data left a DETACHED marker:
+            # its rows stay queryable but it must not resume building
+            try:
+                detached = os.path.exists(
+                    os.path.join(store.table(db, tname).root, "DETACHED"))
+            except KeyError:
+                continue
+            if not detached:
                 want.add(iv)
         for iv in sorted(want):
             self.targets.append(
@@ -382,18 +397,29 @@ class RollupManager:
             # (handle.go: 1m/1h composition); sub-minute tiers belong to
             # the base table
             raise ValueError("interval must be a positive multiple of 60")
-        if ttl_seconds == 0:
-            ttl_seconds = None                       # keep forever
+        if ttl_seconds is not TTL_DERIVE and ttl_seconds is not None:
+            if int(ttl_seconds) < 0:
+                raise ValueError("ttl_seconds must be >= 0")
+            if int(ttl_seconds) == 0:
+                ttl_seconds = None                   # keep forever
         with self._lock:
             if any(iv == interval for iv, _ in self.targets):
                 raise ValueError(f"datasource {interval}s already exists")
+            if interval in self._building or interval in self._drop_pending:
+                # a del'd tier's backfill is still draining: attaching a
+                # fresh table now would let the old build overwrite the
+                # new tier's watermark when it lands
+                raise ValueError(
+                    f"datasource {interval}s busy (build draining); retry")
             t = self.store.create_table(
                 self.db, rollup_schema(self.base.schema, interval,
                                        ttl_seconds))
-            if ttl_seconds != TTL_DERIVE and \
+            marker = os.path.join(t.root, "DETACHED")
+            if os.path.exists(marker):   # re-attach of a kept-data tier
+                os.remove(marker)
+            if ttl_seconds is not TTL_DERIVE and \
                     t.schema.ttl_seconds != ttl_seconds:
-                # re-attach of a tier removed with keep-data:
-                # create_table returned the EXISTING table — the
+                # create_table returned an EXISTING table — the
                 # requested retention must still win
                 t.set_ttl(ttl_seconds)
             self.targets.append((interval, t))
@@ -415,10 +441,21 @@ class RollupManager:
                             # dir with its append; advance() re-drops it
                             # when the build drains
                             self._drop_pending[iv] = t.root
+                    else:
+                        # kept data must not resurrect the tier on
+                        # restart: mark it detached on disk
+                        try:
+                            with open(os.path.join(t.root, "DETACHED"),
+                                      "w"):
+                                pass
+                        except OSError:
+                            pass
                     return True
         return False
 
     def set_retention(self, interval: int, ttl_seconds: Optional[int]) -> bool:
+        if ttl_seconds is not None and int(ttl_seconds) < 0:
+            raise ValueError("ttl_seconds must be >= 0")
         with self._lock:
             for iv, t in self.targets:
                 if iv == interval:

@@ -93,16 +93,25 @@ querier:
     r = _req(f"{ctl}/v1/domains/aws-prod/refresh", {})
     print(f"cloud domain gathered: {r['resource_count']} resources")
 
-    # -- 3. live agent ----------------------------------------------------
+    # -- 3. live agent (with a sandboxed wasm parser plugin) ---------------
+    from deepflow_tpu.agent.wasm_samples import build_memcached_wasm
+    wasm_path = f"{tmp}/memcached.wasm"
+    with open(wasm_path, "wb") as f:
+        f.write(build_memcached_wasm())
     agent = Agent(AgentConfig(
         ctrl_ip="10.1.2.3", host="demo-node", controller_url=ctl,
-        ingester_addr=f"127.0.0.1:{server.ingester.port}"))
+        ingester_addr=f"127.0.0.1:{server.ingester.port}",
+        wasm_plugins=(wasm_path,)))
     assert agent.sync_once()
-    print(f"agent registered: vtap_id={agent.vtap_id}")
+    print(f"agent registered: vtap_id={agent.vtap_id}  "
+          f"wasm plugins: {[p.name for p in agent.wasm_plugins.values()]}")
 
-    # synthetic capture: an HTTP conversation between two pods
+    # synthetic capture: an HTTP conversation between two pods, a
+    # memcached lookup (parsed by the wasm plugin), and an internet
+    # client whose address the geo table maps to a region
     from deepflow_tpu.replay import eth_ipv4_tcp, ip4
     CLIENT, SERVER = ip4(10, 0, 0, 1), ip4(10, 0, 0, 2)
+    INET = ip4(192, 0, 2, 55)            # TEST-NET-1: in the geo sample
     T0 = int(time.time() * 1e9)
     frames = [
         eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, 0x02, b"", seq=0),   # SYN
@@ -113,9 +122,19 @@ querier:
         eth_ipv4_tcp(SERVER, CLIENT, 80, 41000, 0x10,
                      b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok",
                      seq=1),
+        eth_ipv4_tcp(CLIENT, SERVER, 41002, 11211, 0x10,
+                     b"get session:42\r\n", seq=1),
+        eth_ipv4_tcp(SERVER, CLIENT, 11211, 41002, 0x10,
+                     b"END\r\n", seq=1),
+        eth_ipv4_tcp(INET, SERVER, 52000, 80, 0x10,
+                     b"GET /api/health HTTP/1.1\r\nHost: api\r\n\r\n",
+                     seq=1),
+        eth_ipv4_tcp(SERVER, INET, 80, 52000, 0x10,
+                     b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok",
+                     seq=1),
     ]
-    stamps = np.asarray([T0, T0 + 200_000, T0 + 1_000_000,
-                         T0 + 3_500_000], np.uint64)
+    stamps = np.asarray([T0 + i * 400_000 for i in range(len(frames))],
+                        np.uint64)
     fed = agent.feed(frames, stamps)
     sent = agent.tick(T0 + 1_000_000_000)
     print(f"agent: {fed} packets -> sent {sent}")
@@ -160,6 +179,23 @@ querier:
     tags = _req(f"{q}/v1/query", form={
         "db": "flow_log", "sql": "SHOW TAGS FROM l4_flow_log"})["result"]
     print(f"\nSHOW TAGS: {len(tags['values'])} tags available")
+
+    # the internet client's flow oriented server-side (port 80 is the
+    # service), so the client region is the _1 side
+    geo = _req(f"{q}/v1/query", form={
+        "db": "flow_log",
+        "sql": "SELECT province_1, ip_dst, port_dst FROM l4_flow_log "
+               "WHERE province_1 = 'TEST-NET-1'"})["result"]
+    print("\ninternet-client flows by region (geo enrichment):")
+    for row in geo["values"]:
+        print("  " + " | ".join(str(v) for v in row))
+    assert geo["values"], "geo-stamped flow missing"
+
+    # runtime datasource CRUD: add a 1h rollup tier over the debug socket
+    from deepflow_tpu.runtime.debug import debug_request
+    ds = debug_request("datasource", port=server.ingester.debug.port,
+                       op="add", interval=3600)["data"]
+    print(f"\ndatasource add: {ds['table']} (ttl {ds['ttl_seconds']}s)")
 
     # -- 6. device analytics: top-K heavy hitters + per-service RED --------
     # the exporters consume their queues asynchronously: wait for the

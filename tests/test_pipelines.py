@@ -233,5 +233,12 @@ def test_datasource_debug_command(tmp_path):
         assert out["deleted"] is True
         out = ds(op="add", interval=90)
         assert "multiple of 60" in out["error"]
+        # validation: negative ttl, retention without ttl, unknown op
+        out = ds(op="add", interval=7200, ttl=-5)
+        assert ">= 0" in out["error"]
+        out = ds(op="retention", interval=60)
+        assert "requires ttl" in out["error"]
+        out = ds(op="bogus")
+        assert "unknown op" in out["error"]
     finally:
         ing.close()

@@ -413,12 +413,33 @@ def test_datasource_ttl_semantics_and_restart_persistence(tmp_path):
     emitted = mgr2.advance(now=7300.0 + 3600)
     assert emitted[3600] == 2
 
-    # keep-data del + re-add with explicit ttl: the ttl must win over
-    # the existing table's manifest
+    # keep-data del: rows stay queryable, but a DETACHED marker keeps a
+    # restart from resurrecting the tier
     assert mgr2.remove_interval(3600, drop_data=False) is True
+    mgr3 = RollupManager(store, "db", base_schema, intervals=(60,),
+                         allowance_seconds=5)
+    assert {iv for iv, _ in mgr3.targets} == {60, 7200}
+    assert store.has_table("db", "t.1h")   # data kept
+
+    # re-add with explicit ttl: the marker clears, the ttl wins over
+    # the existing table's manifest, and building resumes
     info3 = mgr2.add_interval(3600, ttl_seconds=42)
     assert info3["ttl_seconds"] == 42
     assert store.table("db", "t.1h").schema.ttl_seconds == 42
+    mgr4 = RollupManager(store, "db", base_schema, intervals=(60,),
+                         allowance_seconds=5)
+    assert {iv for iv, _ in mgr4.targets} == {60, 3600, 7200}
+
+    # validation: negative ttl refused; re-add refused while a removed
+    # tier's build is still draining
+    with pytest.raises(ValueError, match=">= 0"):
+        mgr2.add_interval(10800, ttl_seconds=-5)
+    with pytest.raises(ValueError, match=">= 0"):
+        mgr2.set_retention(3600, -1)
+    mgr2._building.add(10800)
+    mgr2._drop_pending[10800] = "/nonexistent"
+    with pytest.raises(ValueError, match="busy"):
+        mgr2.add_interval(10800)
 
 
 def test_group_reduce_device_matches_host_property():
