@@ -145,7 +145,7 @@ class QueryEngine:
                     try:
                         return self.store.table(d, t)
                     except KeyError:
-                        break   # dropped between listing and lookup
+                        continue   # dropped between listing and lookup
         # an explicit db must NOT fall through to other databases — a
         # typo'd db would silently answer from the wrong data
         raise KeyError(f"unknown table {name}"

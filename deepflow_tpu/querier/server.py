@@ -37,11 +37,15 @@ DEFAULT_PORT = 20416   # reference querier listens on 20416
 class QuerierServer:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1",
-                 tagrecorder=None) -> None:
+                 tagrecorder=None, external_apm=None) -> None:
+        from deepflow_tpu.querier.tracing_adapter import \
+            TracingAdapterService
         self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder)
         self.prom = PromEngine(store, tag_dicts)
         self.profile = ProfileQuery(store, tag_dicts)
         self.tempo = TempoQuery(store, tag_dicts)
+        self.tracing_adapter = TracingAdapterService.from_config(
+            external_apm or [])
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -189,6 +193,19 @@ class QuerierServer:
                                          "error": str(e)})
                 elif path in ("/v1/profile/flame", "/v1/profile/top"):
                     self._profile(path, params)
+                elif path == "/api/v1/adapter/tracing":
+                    # external-APM trace pull (reference
+                    # tracing-adapter/router GET ?traceid=)
+                    tid = params.get("traceid")
+                    if not tid:
+                        self._send(400, {"status": "error",
+                                         "error": "traceid required"})
+                    else:
+                        spans = outer.tracing_adapter.get_trace(tid)
+                        self._send(200, {
+                            "status": "ok",
+                            "data": {"spans": [s.to_json()
+                                               for s in spans]}})
                 elif path == "/api/echo" or path == "/v1/l7_tracing" \
                         or path.startswith("/api/traces/") \
                         or path.startswith("/api/search"):

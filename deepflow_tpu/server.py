@@ -112,7 +112,8 @@ class Server:
             self.querier = QuerierServer(
                 self.ingester.store, self.ingester.tag_dicts,
                 port=q_cfg.get("port", 20416),
-                tagrecorder=self.tagrecorder)
+                tagrecorder=self.tagrecorder,
+                external_apm=q_cfg.get("external_apm", []))
 
         self.stats_shipper = None
         if c.get("self_telemetry", True):

@@ -345,18 +345,20 @@ class RollupManager:
             if tdb != db:
                 continue
             iv = interval_from_table_name(base.name, tname)
-            if iv is None or iv in want:
-                continue
-            # a tier removed with keep-data left a DETACHED marker:
-            # its rows stay queryable but it must not resume building
-            try:
-                detached = os.path.exists(
-                    os.path.join(store.table(db, tname).root, "DETACHED"))
-            except KeyError:
-                continue
-            if not detached:
+            if iv is not None:
                 want.add(iv)
         for iv in sorted(want):
+            # a tier removed with keep-data left a DETACHED marker: its
+            # rows stay queryable but it must not resume building — and
+            # the operator's detach outranks the static config list too
+            # (only a datasource add clears the marker)
+            name = f"{base.name}.{_interval_suffix(iv)}"
+            try:
+                root = store.table(db, name).root
+                if os.path.exists(os.path.join(root, "DETACHED")):
+                    continue
+            except KeyError:
+                pass   # table doesn't exist yet: nothing to detach
             self.targets.append(
                 (iv, store.create_table(db, rollup_schema(base, iv))))
         # per-interval high-water mark: everything < mark already built.

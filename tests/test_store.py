@@ -430,6 +430,17 @@ def test_datasource_ttl_semantics_and_restart_persistence(tmp_path):
                          allowance_seconds=5)
     assert {iv for iv, _ in mgr4.targets} == {60, 3600, 7200}
 
+    # a detach of a CONFIG-declared tier also sticks across restarts:
+    # the operator's del outranks the static interval list
+    assert mgr4.remove_interval(60, drop_data=False) is True
+    mgr5 = RollupManager(store, "db", base_schema, intervals=(60,),
+                         allowance_seconds=5)
+    assert 60 not in {iv for iv, _ in mgr5.targets}
+    mgr5.add_interval(60)          # datasource add clears the marker
+    mgr6 = RollupManager(store, "db", base_schema, intervals=(60,),
+                         allowance_seconds=5)
+    assert 60 in {iv for iv, _ in mgr6.targets}
+
     # validation: negative ttl refused; re-add refused while a removed
     # tier's build is still draining
     with pytest.raises(ValueError, match=">= 0"):

@@ -1,0 +1,176 @@
+"""External-APM tracing adapter (querier/tracing_adapter.py): the
+SkyWalking query-protocol client, span normalization, registry fan-out,
+and the /api/v1/adapter/tracing route.
+
+Reference behavior: server/querier/app/tracing-adapter/ — skywalking.go
+GetTrace over GraphQL, model/tracing.go ExSpan, router GET
+/api/v1/adapter/tracing?traceid=. The fake server below speaks the
+public skywalking-query-protocol response shape.
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepflow_tpu.querier.tracing_adapter import (ADAPTERS, ExternalAPM,
+                                                  KIND_CLIENT, KIND_SERVER,
+                                                  SkyWalkingAdapter,
+                                                  TracingAdapterService,
+                                                  register_adapter)
+
+_SW_TRACE = {
+    "data": {"trace": {"spans": [
+        {"traceId": "T1", "segmentId": "seg-a", "spanId": 0,
+         "parentSpanId": -1, "refs": [],
+         "serviceCode": "gateway", "serviceInstanceName": "gw-0",
+         "startTime": 1700000000000, "endTime": 1700000000120,
+         "endpointName": "GET /checkout", "type": "Entry",
+         "peer": "", "component": "tomcat", "isError": False,
+         "layer": "Http",
+         "tags": [{"key": "http.method", "value": "GET"},
+                  {"key": "http.status_code", "value": "200"}]},
+        {"traceId": "T1", "segmentId": "seg-a", "spanId": 1,
+         "parentSpanId": 0, "refs": [],
+         "serviceCode": "gateway", "serviceInstanceName": "gw-0",
+         "startTime": 1700000000010, "endTime": 1700000000100,
+         "endpointName": "orders.create", "type": "Exit",
+         "peer": "orders:8080", "component": "httpClient",
+         "isError": False, "layer": "Http",
+         "tags": [{"key": "http.method", "value": "POST"}]},
+        {"traceId": "T1", "segmentId": "seg-b", "spanId": 0,
+         "parentSpanId": -1,
+         "refs": [{"traceId": "T1", "parentSegmentId": "seg-a",
+                   "parentSpanId": 1, "type": "CROSS_PROCESS"}],
+         "serviceCode": "orders", "serviceInstanceName": "ord-2",
+         "startTime": 1700000000020, "endTime": 1700000000090,
+         "endpointName": "POST /orders", "type": "Entry",
+         "peer": "", "component": "spring", "isError": True,
+         "layer": "Http", "tags": []},
+    ]}}
+}
+
+
+class _FakeSkyWalking(BaseHTTPRequestHandler):
+    seen = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n))
+        type(self).seen.append(
+            (req, self.headers.get("Authorization")))
+        body = json.dumps(_SW_TRACE).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def sw_server():
+    _FakeSkyWalking.seen = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeSkyWalking)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_skywalking_normalization(sw_server):
+    apm = ExternalAPM(name="skywalking", addr=sw_server,
+                      extra_config={"auth": "user:pw"})
+    spans = SkyWalkingAdapter().get_trace("T1", apm)
+    assert len(spans) == 3
+
+    # the GraphQL document + basic auth actually went over the wire
+    req, auth = _FakeSkyWalking.seen[0]
+    assert req["variables"] == {"traceId": "T1"}
+    assert "queryTrace" in req["query"]
+    assert auth == "Basic " + base64.b64encode(b"user:pw").decode()
+
+    entry, exit_, remote = spans
+    assert entry.span_kind == KIND_SERVER and entry.tap_side == "s-app"
+    assert entry.request_type == "GET" and entry.response_status == 200
+    assert entry.l7_protocol_str == "HTTP"
+    assert entry.start_time_us == 1700000000000000
+    assert entry.app_service == "gateway"
+
+    assert exit_.span_kind == KIND_CLIENT and exit_.tap_side == "c-app"
+    assert exit_.parent_span_id == "seg-a-0"     # same-segment parent
+
+    # cross-segment ref resolves to the exit span's uid; isError with no
+    # status tag reports 500
+    assert remote.parent_span_id == "seg-a-1"
+    assert remote.span_id == "seg-b-0"
+    assert remote.response_status == 500
+
+    # ids are deterministic across processes
+    spans2 = SkyWalkingAdapter().get_trace("T1", apm)
+    assert [s._id for s in spans] == [s2._id for s2 in spans2]
+
+
+def test_service_fans_out_and_tolerates_down_apm(sw_server):
+    svc = TracingAdapterService.from_config([
+        {"name": "skywalking", "addr": sw_server},
+        # unreachable APM: logged, skipped, must not fail the query
+        {"name": "skywalking", "addr": "http://127.0.0.1:9",
+         "timeout_s": 0.2},
+        # unregistered adapter name: dropped at config time
+        {"name": "nonexistent-apm", "addr": "http://x"},
+        # malformed row (no addr): warned + skipped, never a crash
+        {"name": "skywalking"},
+    ])
+    assert len(svc.apms) == 2
+    spans = svc.get_trace("T1")
+    assert len(spans) == 3
+
+
+def test_custom_adapter_registration():
+    class Fake:
+        def get_trace(self, trace_id, apm):
+            return []
+
+    register_adapter("my-apm", Fake())
+    try:
+        assert "my-apm" in ADAPTERS
+        svc = TracingAdapterService.from_config(
+            [{"name": "my-apm", "addr": "http://x"}])
+        assert svc.get_trace("T9") == []
+    finally:
+        del ADAPTERS["my-apm"]
+    with pytest.raises(TypeError):
+        register_adapter("bad", object())
+
+
+def test_querier_route(tmp_path, sw_server):
+    from deepflow_tpu.querier.server import QuerierServer
+    from deepflow_tpu.store.db import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    import urllib.request
+
+    q = QuerierServer(Store(str(tmp_path)), TagDictRegistry(None), port=0,
+                      external_apm=[{"name": "skywalking",
+                                     "addr": sw_server}])
+    q.start()
+    try:
+        base = f"http://127.0.0.1:{q.port}"
+        with urllib.request.urlopen(
+                f"{base}/api/v1/adapter/tracing?traceid=T1") as r:
+            doc = json.load(r)
+        assert doc["status"] == "ok"
+        assert len(doc["data"]["spans"]) == 3
+        assert doc["data"]["spans"][0]["endpoint"] == "GET /checkout"
+        # missing traceid is a 400
+        try:
+            urllib.request.urlopen(f"{base}/api/v1/adapter/tracing")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        q.close()
