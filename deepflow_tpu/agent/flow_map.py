@@ -98,6 +98,9 @@ class FlowMap:
         self._slot: Dict[Tuple[int, int, int, int, int], int] = {}
         self._free: List[int] = []
         self._next_flow_id = 1
+        # opt-in per-packet context from inject() (flow_id/direction
+        # gathers) — only the packet-sequence collector pays for it
+        self.want_packet_context = False
         self.packets_in = 0
         self.invalid_packets = 0
         self.flows_created = 0
@@ -166,14 +169,21 @@ class FlowMap:
         return s
 
     # -- ingest ------------------------------------------------------------
-    def inject(self, pkt: Dict[str, np.ndarray]) -> None:
-        """Fold one decoded packet batch into the flow table."""
+    def inject(self, pkt: Dict[str, np.ndarray]) -> Optional[dict]:
+        """Fold one decoded packet batch into the flow table. Returns
+        per-packet context for the VALID packets so per-packet
+        consumers (the packet-sequence collector) reuse this pass's
+        masking/orientation instead of recomputing it:
+        {"cols": valid-filtered columns, "flow_id": [n] u64,
+        "direction": [n] u32 — 0 = the flow INITIATOR's side when a
+        SYN fixed the initiator, canonical (lower ip,port first)
+        orientation otherwise}."""
         valid = pkt["valid"]
         n = int(valid.sum())
         self.packets_in += len(valid)
         self.invalid_packets += len(valid) - n
         if n == 0:
-            return
+            return None
         cols = {k: v[valid] for k, v in pkt.items()}
 
         # canonical orientation: lower (ip, port) first; dir=1 if reversed
@@ -292,9 +302,10 @@ class FlowMap:
         # ordering the per-(flow,dir) reduction above deliberately
         # discards). Runs after the handshake-stamp merge so in-batch
         # SYN/SYN_ACK timestamps are already resolved in c_syn/c_synack.
+        all_slots = slots[inv]
         tcp = np.nonzero(cols["proto"] == PROTO_TCP)[0]
         if len(tcp):
-            pkt_slots = slots[inv][tcp]
+            pkt_slots = all_slots[tcp]
             zeros = np.zeros(n, np.int64)
             self.perf.inject(
                 pkt_slots, direction[tcp], ts[tcp], flags[tcp],
@@ -303,6 +314,14 @@ class FlowMap:
                 cols["payload_len"][tcp].astype(np.int64),
                 cols.get("tcp_win", zeros)[tcp].astype(np.int64),
                 self.c_syn[pkt_slots], self.c_synack[pkt_slots])
+        if not self.want_packet_context:
+            return None          # default path: no per-packet gathers
+        init = self.c_initiator[all_slots]
+        rel_dir = np.where(init >= 0,
+                           direction ^ (init == 1),
+                           direction).astype(np.uint32)
+        return {"cols": cols, "flow_id": self.c_flow_id[all_slots],
+                "direction": rel_dir}
 
     # -- tick output -------------------------------------------------------
     def tick_columns(self, now_ns: Optional[int] = None,

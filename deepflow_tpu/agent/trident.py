@@ -63,6 +63,10 @@ class AgentConfig:
     # sandboxed wasm L7 plugins (agent/wasm_plugin.py): .wasm paths,
     # same lifecycle as so_plugins but fuel/memory-confined
     wasm_plugins: tuple = ()
+    # packet-sequence collection (agent/packet_sequence.py): per-packet
+    # TCP headers -> l4_packet rows. Off by default like the reference's
+    # packet_sequence_flag=0 (config.rs:519)
+    packet_sequence: bool = False
     # dispatcher (agent/dispatcher.py): capture mode + policy actions
     dispatcher_mode: str = "local"
     local_macs: tuple = ()
@@ -204,10 +208,19 @@ class Agent:
         self.sessions = SessionAggregator()
         self.guard = Guard()
         self.escape = EscapeTimer(cfg.escape_after_s, self._on_escape)
+        sender_types = [MessageType.TAGGEDFLOW, MessageType.METRICS,
+                        MessageType.PROTOCOLLOG, MessageType.COLUMNAR_FLOW]
+        self.pseq = None
+        self._pseq_pending: List[bytes] = []
+        if cfg.packet_sequence:
+            from deepflow_tpu.agent.packet_sequence import \
+                PacketSequenceCollector
+            self.pseq = PacketSequenceCollector()
+            self.flow_map.want_packet_context = True
+            sender_types.append(MessageType.PACKETSEQUENCE)
         self.senders: Dict[MessageType, UniformSender] = {
             mt: UniformSender(mt, cfg.ingester_addr)
-            for mt in (MessageType.TAGGEDFLOW, MessageType.METRICS,
-                       MessageType.PROTOCOLLOG, MessageType.COLUMNAR_FLOW)
+            for mt in sender_types
         }
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -343,10 +356,32 @@ class Agent:
         """Ingest one capture batch; returns valid packets."""
         pkt = self.dispatcher.dispatch(frames, timestamps_ns)
         with self._lock:
-            self.flow_map.inject(pkt)
+            # collector state is shared with the tick thread's flush:
+            # both run under the same lock (the _l7_out pattern)
+            ctx = self.flow_map.inject(pkt)
+            if self.pseq is not None and ctx is not None:
+                self._collect_pseq(ctx)
         if self.cfg.l7_enabled:
             self._parse_l7(frames, pkt)
         return int(pkt["valid"].sum())
+
+    def _collect_pseq(self, ctx: dict) -> None:
+        """Per-packet TCP headers into the sequence collector; `ctx` is
+        flow_map.inject's per-valid-packet context (cols/flow_id/
+        initiator-relative direction — one masking+orientation pass,
+        owned by the flow map). Caller holds self._lock."""
+        cols = ctx["cols"]
+        tcp = np.nonzero(cols["proto"] == PROTO_TCP)[0]
+        if not len(tcp):
+            return
+        zeros = np.zeros(len(cols["proto"]), np.uint32)
+        blocks = self.pseq.observe(
+            ctx["flow_id"][tcp], cols["timestamp_ns"][tcp],
+            cols["tcp_seq"][tcp], cols.get("tcp_ack", zeros)[tcp],
+            cols["tcp_flags"][tcp], cols.get("tcp_win", zeros)[tcp],
+            cols["payload_len"][tcp], ctx["direction"][tcp])
+        if blocks:
+            self._pseq_pending.extend(blocks)
 
     def _parse_l7(self, frames: List[bytes],
                   pkt: Dict[str, np.ndarray]) -> None:
@@ -388,16 +423,24 @@ class Agent:
                         flow, merged, int(pkt["timestamp_ns"][i]),
                         self.vtap_id))
 
-    def tick(self, now_ns: Optional[int] = None) -> dict:
+    def tick(self, now_ns: Optional[int] = None,
+             final: bool = False) -> dict:
         """1s flush: flows -> TAGGEDFLOW, documents -> METRICS,
-        sessions -> PROTOCOLLOG."""
+        sessions -> PROTOCOLLOG. `final` force-flushes the
+        packet-sequence collector (shutdown: blocks younger than the
+        5s budget must not be dropped)."""
         now_ns = int(time.time() * 1e9) if now_ns is None else now_ns
+        pseq_blocks: List[bytes] = []
         with self._lock:
             # vectorized tick: oriented wire-ready columns, no per-flow
             # Python (flow_map.tick_columns)
             cols = self.flow_map.tick_columns(now_ns)
             cols["vtap_id"][:] = self.vtap_id
             l7_records, self._l7_out = self._l7_out, []
+            if self.pseq is not None:
+                pseq_blocks = self._pseq_pending \
+                    + self.pseq.flush(now_ns, force=final)
+                self._pseq_pending = []
         sent = {"flows": 0, "documents": 0, "l7": 0}
         if len(cols["ip_src"]):
             if self.cfg.wire_mode == "columnar":
@@ -416,6 +459,25 @@ class Agent:
         if l7_records:
             sent["l7"] = self.senders[MessageType.PROTOCOLLOG].send(
                 l7_records)
+        if pseq_blocks:
+            # packet-sequence blocks are self-delimited by their
+            # leading u32 block_size (l4_packet.go's decoder reads
+            # exactly that), so the frame body is blocks concatenated
+            # RAW — no per-record varint prefixes
+            sender = self.senders[MessageType.PACKETSEQUENCE]
+            n_sent = 0
+            batch: List[bytes] = []
+            size = 0
+            for blk in pseq_blocks + [None]:
+                if blk is not None and size + len(blk) < 400_000:
+                    batch.append(blk)
+                    size += len(blk)
+                    continue
+                if batch and sender.send_raw(b"".join(batch)):
+                    n_sent += len(batch)
+                batch, size = (([blk], len(blk)) if blk is not None
+                               else ([], 0))
+            sent["packet_blocks"] = n_sent
         self.sessions.expire(now_ns)
         return sent
 
@@ -470,7 +532,7 @@ class Agent:
                 w.close()
         for t in self._threads:
             t.join(timeout=2)
-        self.tick()  # final flush
+        self.tick(final=True)  # final flush incl. young pseq blocks
         self.enforcer.close()
         self.guard.close()
         for s in self.senders.values():

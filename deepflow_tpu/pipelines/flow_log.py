@@ -11,6 +11,7 @@ chunk to the store writer and every exporter.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -18,7 +19,8 @@ import numpy as np
 
 from deepflow_tpu.decode import columnar
 from deepflow_tpu.enrich.platform_data import PlatformDataManager
-from deepflow_tpu.pipelines.schemas import L4_TABLE, L7_TABLE
+from deepflow_tpu.pipelines.schemas import (L4_PACKET_TABLE, L4_TABLE,
+                                            L7_TABLE)
 from deepflow_tpu.runtime.exporters import Exporters
 from deepflow_tpu.runtime.queues import MultiQueue
 from deepflow_tpu.runtime.receiver import Receiver
@@ -60,7 +62,8 @@ class _Decoder(threading.Thread):
     """One decoder worker for one stream type (reference: decoder.go Run)."""
 
     def __init__(self, stream: str, index: int, queues: MultiQueue,
-                 decode_fn, enrich_fn, throttler: ColumnarThrottler,
+                 decode_fn, enrich_fn,
+                 throttler: Optional[ColumnarThrottler],
                  writer: Optional[StoreWriter], exporters: Optional[Exporters],
                  batch: int = 64, payload_decode_fns=None,
                  frame_mode: bool = False) -> None:
@@ -152,11 +155,18 @@ class _Decoder(threading.Thread):
         if self.exporters is not None:
             self.exporters.put(self.stream, self.index, cols)
         if self.writer is not None:
-            self.throttler.offer(cols)
+            if self.throttler is not None:
+                self.throttler.offer(cols)
+            else:
+                # unthrottled stream (diagnosis data): straight to the
+                # writer — a reservoir sized "never drop" would have to
+                # preallocate its whole capacity
+                self.writer.put(cols)
 
     def stop(self) -> None:
         self._halt.set()
-        self.throttler.flush()  # drain the open throttle bucket
+        if self.throttler is not None:
+            self.throttler.flush()  # drain the open throttle bucket
 
     def counters(self) -> dict:
         return {"frames": self.frames, "records": self.records,
@@ -282,6 +292,91 @@ class FlowLogPipeline:
         if stats is not None:
             stats.register("decoder.otel.0", otel_decoder.counters)
 
+        # -- l4_packet logger (PACKETSEQUENCE): per-packet TCP headers
+        # batched per flow (reference flow_log.go L4Packet logger :107,
+        # l4_packet.go DecodePacketSequence). Metadata rows land in the
+        # l4_packet table; the opaque batch bytes append to a sidecar
+        # blob addressed by (batch_off, batch_len).
+        from deepflow_tpu.agent.packet_sequence import decode_blocks
+
+        pseq_writer = None
+        self._pseq_table = None
+        self._pseq_blob = None          # (partition_start, open file)
+        if store is not None:
+            pseq_table = store.create_table(FLOW_LOG_DB, L4_PACKET_TABLE)
+            pseq_writer = StoreWriter(pseq_table, stats=stats)
+            self.writers.append(pseq_writer)
+            os.makedirs(pseq_table.root, exist_ok=True)
+            self._pseq_table = pseq_table
+
+        def _pseq_blob_for(part: int):
+            """Blob files segment per table partition (batches-p<start>)
+            so TTL/GC expiry of a partition's rows prunes its batch
+            bytes too; the reader derives the file from the row's
+            timestamp. One handle stays open (frames are time-ordered)."""
+            if self._pseq_blob is not None and self._pseq_blob[0] == part:
+                return self._pseq_blob[1]
+            if self._pseq_blob is not None:
+                self._pseq_blob[1].close()
+            f = open(os.path.join(self._pseq_table.root,
+                                  f"batches-p{part}.bin"), "ab")
+            self._pseq_blob = (part, f)
+            return f
+
+        def _decode_pseq(frames: List[Frame]):
+            rows, bad = [], 0
+            for f in frames:
+                r, b = decode_blocks(
+                    f.payload,
+                    vtap_id=(f.flow_header.vtap_id if f.flow_header
+                             else 0))
+                rows.extend(r)
+                bad += b
+            n = len(rows)
+            cols = {
+                "timestamp": np.fromiter(
+                    (r["end_time_us"] // 1_000_000 for r in rows),
+                    np.uint32, n),
+                "start_time_us": np.fromiter(
+                    (r["start_time_us"] for r in rows), np.uint64, n),
+                "end_time_us": np.fromiter(
+                    (r["end_time_us"] for r in rows), np.uint64, n),
+                "flow_id": np.fromiter(
+                    (r["flow_id"] for r in rows), np.uint64, n),
+                "vtap_id": np.fromiter(
+                    (r["vtap_id"] for r in rows), np.uint32, n),
+                "packet_count": np.fromiter(
+                    (r["packet_count"] for r in rows), np.uint32, n),
+                "batch_off": np.zeros(n, np.uint64),
+                "batch_len": np.fromiter(
+                    (len(r["batch"]) for r in rows), np.uint32, n),
+            }
+            if self._pseq_table is not None and n:
+                psec = self._pseq_table.schema.partition_seconds
+                offs = []
+                for i, r in enumerate(rows):
+                    part = int(cols["timestamp"][i]) // psec * psec
+                    fh = _pseq_blob_for(part)
+                    offs.append(fh.tell())
+                    fh.write(r["batch"])
+                self._pseq_blob[1].flush()
+                cols["batch_off"] = np.asarray(offs, np.uint64)
+            return cols, bad
+
+        pseq_queues = MultiQueue("ingest.l4_packet", 1, queue_size)
+        receiver.register_handler(MessageType.PACKETSEQUENCE, pseq_queues)
+        pseq_decoder = _Decoder(
+            "l4_packet", 0, pseq_queues, _decode_pseq,
+            lambda cols: cols,   # bare rows: no KnowledgeGraph
+            # diagnosis data is never throttled (reference: the L4Packet
+            # logger writes straight through); None = direct writer.put
+            None,
+            pseq_writer, exporters, frame_mode=True)
+        self.decoders.append(pseq_decoder)
+        self._streams.append(("l4_packet", pseq_queues))
+        if stats is not None:
+            stats.register("decoder.l4_packet.0", pseq_decoder.counters)
+
     def start(self) -> None:
         for w in self.writers:
             w.start()
@@ -291,9 +386,37 @@ class FlowLogPipeline:
     def flush(self) -> None:
         """Drain open throttle buckets and pending writer rows to disk."""
         for d in self.decoders:
-            d.throttler.flush()
+            if d.throttler is not None:
+                d.throttler.flush()
         for w in self.writers:
             w.flush()
+        self._prune_pseq_blobs()
+
+    def _prune_pseq_blobs(self) -> None:
+        """Remove batch blob files whose table partition has expired
+        (TTL/GC drop the rows; the bytes must follow)."""
+        t = self._pseq_table
+        if t is None:
+            return
+        live = set(t.partitions())
+        cur = self._pseq_blob[0] if self._pseq_blob is not None else None
+        try:
+            names = os.listdir(t.root)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("batches-p")
+                    and name.endswith(".bin")):
+                continue
+            try:
+                part = int(name[len("batches-p"):-len(".bin")])
+            except ValueError:
+                continue
+            if part not in live and part != cur:
+                try:
+                    os.remove(os.path.join(t.root, name))
+                except OSError:
+                    pass
 
     def close(self) -> None:
         for _, queues in self._streams:
@@ -304,3 +427,6 @@ class FlowLogPipeline:
             d.join(timeout=2)
         for w in self.writers:
             w.close()
+        if self._pseq_blob is not None:
+            self._pseq_blob[1].close()
+            self._pseq_blob = None

@@ -102,6 +102,28 @@ L7_TABLE = TableSchema(
     ttl_seconds=3 * 24 * 3600,
 )
 
+# packet-sequence rows (reference: flow_log/log_data/l4_packet.go
+# L4PacketColumns — time/start_time/end_time/flow_id/vtap_id/
+# packet_count/packet_batch). The opaque packet_batch string column
+# becomes (batch_off, batch_len) into an append-only sidecar blob file
+# beside the table (this store is numeric-columnar by design); the
+# batch content format is documented in agent/packet_sequence.py.
+L4_PACKET_TABLE = TableSchema(
+    name="l4_packet",
+    columns=(
+        ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("start_time_us", np.dtype(np.uint64)),
+        ColumnSpec("end_time_us", np.dtype(np.uint64)),
+        ColumnSpec("flow_id", np.dtype(np.uint64), AggKind.KEY),
+        ColumnSpec("vtap_id", np.dtype(np.uint32), AggKind.KEY),
+        ColumnSpec("packet_count", np.dtype(np.uint32), AggKind.SUM),
+        ColumnSpec("batch_off", np.dtype(np.uint64)),
+        ColumnSpec("batch_len", np.dtype(np.uint32)),
+    ),
+    time_column="timestamp",
+    ttl_seconds=3 * 24 * 3600,
+)
+
 _METRIC_KEYS = {"timestamp", "tag_code", "ip", "server_port", "vtap_id", "protocol",
                 "l3_epc_id", "direction", "tap_side", "tap_type",
                 "tap_port", "l7_protocol", "gprocess_id", "signal_source",

@@ -1,0 +1,298 @@
+"""Packet-sequence (l4_packet) path: block wire format, collector
+chunking/flush, ingester decode+store, agent e2e.
+
+Reference: flow_log/log_data/l4_packet.go DecodePacketSequence (the
+envelope this must match byte-for-byte) + the flow_log.go L4Packet
+logger; the agent side is an enterprise stub there, so the batch
+CONTENT format is this repo's own documented spec
+(agent/packet_sequence.py).
+"""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.packet_sequence import (BLOCK_HEAD_SIZE,
+                                                ENTRY_SIZE,
+                                                MAX_PACKETS_PER_BLOCK,
+                                                PacketSequenceCollector,
+                                                decode_blocks,
+                                                decode_entries)
+
+
+def _observe(c, fids, ts, seqs=None, **kw):
+    n = len(fids)
+    z = np.zeros(n, np.uint32)
+    return c.observe(
+        np.asarray(fids, np.uint64), np.asarray(ts, np.uint64),
+        np.asarray(seqs if seqs is not None else z, np.uint32),
+        kw.get("ack", z), kw.get("flags", z), kw.get("win", z),
+        kw.get("plen", z), kw.get("direction", z))
+
+
+def test_block_roundtrip_envelope_and_entries():
+    c = PacketSequenceCollector()
+    t0 = 1_700_000_000_000_000_000
+    out = _observe(c, [7, 7, 9], [t0, t0 + 1_000_000, t0 + 2_000_000],
+                   seqs=[100, 200, 300],
+                   flags=np.array([2, 16, 24], np.uint32),
+                   win=np.array([512, 513, 514], np.uint32),
+                   plen=np.array([0, 0, 99], np.uint32),
+                   direction=np.array([0, 1, 0], np.uint32))
+    assert out == []                       # below the per-block cap
+    blocks = c.flush(force=True)
+    assert len(blocks) == 2
+
+    payload = b"".join(blocks)
+    rows, bad = decode_blocks(payload, vtap_id=42)
+    assert bad == 0 and len(rows) == 2
+    rows.sort(key=lambda r: r["flow_id"])
+    f7, f9 = rows
+    assert f7["flow_id"] == 7 and f7["packet_count"] == 2
+    assert f7["vtap_id"] == 42
+    assert f7["end_time_us"] == (t0 + 1_000_000) // 1000
+    assert f7["start_time_us"] == f7["end_time_us"] - 5_000_000
+    assert len(f7["batch"]) == 2 * ENTRY_SIZE
+
+    e = decode_entries(f7["batch"])
+    assert e["delta_us"].tolist() == [0, 1000]
+    assert e["tcp_seq"].tolist() == [100, 200]
+    assert e["tcp_flags"].tolist() == [2, 16]
+    assert e["tcp_window"].tolist() == [512, 513]
+    assert e["direction"].tolist() == [0, 1]
+    e9 = decode_entries(f9["batch"])
+    assert e9["payload_len"].tolist() == [99]
+
+    # the envelope matches the reference decoder's arithmetic exactly
+    (size,) = struct.unpack_from("<I", blocks[0], 0)
+    assert size == BLOCK_HEAD_SIZE + len(rows[0]["batch"]) \
+        or size == BLOCK_HEAD_SIZE + len(rows[1]["batch"])
+
+
+def test_collector_block_cap_chunks_honestly():
+    """A burst bigger than the 8-bit count field splits into blocks
+    whose count fields match their actual entry counts."""
+    c = PacketSequenceCollector()
+    n = 700
+    t0 = 1_700_000_000_000_000_000
+    out = _observe(c, [5] * n, [t0 + i * 1000 for i in range(n)])
+    out += c.flush(force=True)
+    rows, bad = decode_blocks(b"".join(out), vtap_id=1)
+    assert bad == 0
+    counts = [r["packet_count"] for r in rows]
+    assert sum(counts) == n
+    assert all(cnt <= MAX_PACKETS_PER_BLOCK for cnt in counts)
+    for r in rows:
+        assert len(r["batch"]) == r["packet_count"] * ENTRY_SIZE
+
+
+def test_flush_age_budget():
+    c = PacketSequenceCollector()
+    t0 = 1_700_000_000_000_000_000
+    _observe(c, [1], [t0])
+    _observe(c, [2], [t0 + 4_000_000_000])
+    # only flow 1 is past the 5s budget at t0+5.5s
+    blocks = c.flush(now_ns=t0 + 5_500_000_000)
+    rows, _ = decode_blocks(b"".join(blocks), vtap_id=1)
+    assert [r["flow_id"] for r in rows] == [1]
+    assert c.counters()["open_flows"] == 1
+
+
+def test_reordered_timestamps_clamp_not_wrap():
+    """Out-of-order captures (packet earlier than the flow's first
+    recorded one) clamp delta_us to 0 instead of wrapping to ~71 min,
+    and end_time_us tracks the true max."""
+    c = PacketSequenceCollector()
+    t0 = 1_700_000_000_000_000_000
+    _observe(c, [3], [t0])
+    # second batch: one packet 2ms EARLIER, one 3ms later
+    _observe(c, [3, 3], [t0 - 2_000_000, t0 + 3_000_000])
+    rows, _ = decode_blocks(b"".join(c.flush(force=True)), vtap_id=1)
+    e = decode_entries(rows[0]["batch"])
+    assert e["delta_us"].tolist() == [0, 0, 3000]
+    assert rows[0]["end_time_us"] == (t0 + 3_000_000) // 1000
+
+
+def test_blob_files_pruned_with_expired_partitions(tmp_path):
+    """Blob segments follow their table partition out: TTL expiry of
+    l4_packet rows prunes the matching batches-p<part>.bin."""
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    try:
+        tab = ing.store.table("flow_log", "l4_packet")
+        psec = tab.schema.partition_seconds
+        old_part = 3600
+        # fabricate an expired-partition blob + a live row's blob
+        open(tab.root + f"/batches-p{old_part}.bin", "wb").write(b"x")
+        now = int(time.time())
+        live_part = now // psec * psec
+        open(tab.root + f"/batches-p{live_part}.bin", "wb").write(b"y")
+        tab.append({
+            "timestamp": np.array([now], np.uint32),
+            "start_time_us": np.zeros(1, np.uint64),
+            "end_time_us": np.zeros(1, np.uint64),
+            "flow_id": np.ones(1, np.uint64),
+            "vtap_id": np.ones(1, np.uint32),
+            "packet_count": np.ones(1, np.uint32),
+            "batch_off": np.zeros(1, np.uint64),
+            "batch_len": np.ones(1, np.uint32),
+        })
+        ing.flow_log.flush()
+        import os
+        assert not os.path.exists(tab.root + f"/batches-p{old_part}.bin")
+        assert os.path.exists(tab.root + f"/batches-p{live_part}.bin")
+    finally:
+        ing.close()
+
+
+def test_decode_blocks_rejects_malformed():
+    rows, bad = decode_blocks(struct.pack("<I", 4) + b"xxxx", vtap_id=1)
+    assert rows == [] and bad == 1
+    # truncated: declared size exceeds payload
+    rows, bad = decode_blocks(struct.pack("<I", 400) + b"\x00" * 20,
+                              vtap_id=1)
+    assert rows == [] and bad == 1
+
+
+def test_direction_is_initiator_relative():
+    """The direction bit follows the SYN initiator, not the canonical
+    (lower ip,port) orientation — even when the initiator is the HIGHER
+    tuple."""
+    from deepflow_tpu.agent.flow_map import FlowMap
+    from deepflow_tpu.agent.packet import PROTO_TCP, SYN, ACK
+
+    n = 2
+    t0 = 1_700_000_000_000_000_000
+    # initiator = (ip 9, port 50000) -> responder (ip 5, port 80):
+    # canonical ordering puts ip 5 first, so canonical dir(initiator)=1
+    pkt = {
+        "valid": np.array([True, True]),
+        "ip_src": np.array([9, 5], np.uint32),
+        "ip_dst": np.array([5, 9], np.uint32),
+        "port_src": np.array([50000, 80], np.uint32),
+        "port_dst": np.array([80, 50000], np.uint32),
+        "proto": np.full(n, PROTO_TCP, np.uint32),
+        "timestamp_ns": np.array([t0, t0 + 1000], np.uint64),
+        "tcp_flags": np.array([SYN, SYN | ACK], np.uint32),
+        "tcp_seq": np.zeros(n, np.uint32),
+        "tcp_ack": np.zeros(n, np.uint32),
+        "tcp_win": np.zeros(n, np.uint32),
+        "payload_len": np.zeros(n, np.uint32),
+        "pkt_len": np.full(n, 60, np.uint32),
+    }
+    fm = FlowMap()
+    fm.want_packet_context = True
+    ctx = fm.inject(pkt)
+    # SYN packet = initiator side -> 0; SYN|ACK = responder -> 1
+    assert ctx["direction"].tolist() == [0, 1]
+    assert ctx["flow_id"][0] == ctx["flow_id"][1]
+
+
+def test_close_force_flushes_young_blocks(tmp_path):
+    """Blocks younger than the 5s budget must survive a clean
+    shutdown (close -> tick(final=True))."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_agent import CLIENT, SERVER, SYN, eth_ipv4_tcp
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    try:
+        agent = Agent(AgentConfig(
+            ingester_addr=f"127.0.0.1:{ing.port}",
+            packet_sequence=True))
+        agent.set_vtap_id(3)
+        t0 = int(time.time() * 1e9)
+        agent.feed([eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, SYN, seq=1)],
+                   np.array([t0], np.uint64))
+        agent.close()   # within the 5s budget: only final=True flushes
+        tab = ing.store.table("flow_log", "l4_packet")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ing.flush()
+            if tab.row_count():
+                break
+            time.sleep(0.1)
+        assert tab.row_count() == 1
+    finally:
+        ing.close()
+
+
+def test_agent_to_ingester_l4_packet_e2e(tmp_path):
+    """packet_sequence=True agent -> PACKETSEQUENCE wire -> l4_packet
+    rows whose flow_id matches the l4_flow_log rows, batch bytes
+    recoverable from the sidecar blob."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_agent import ACK, CLIENT, FIN, SERVER, SYN, eth_ipv4_tcp
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    try:
+        agent = Agent(AgentConfig(
+            ingester_addr=f"127.0.0.1:{ing.port}",
+            packet_sequence=True))
+        agent.set_vtap_id(5)   # flow-header stamping needs the senders
+        t0 = int(time.time() * 1e9)
+        frames = [
+            eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, SYN, seq=1),
+            eth_ipv4_tcp(SERVER, CLIENT, 80, 41000, SYN | ACK, seq=1),
+            eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, ACK, b"ping", seq=2),
+            eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, FIN | ACK, seq=6),
+            eth_ipv4_tcp(SERVER, CLIENT, 80, 41000, FIN | ACK, seq=2),
+        ]
+        ts = np.array([t0 + i * 1000 for i in range(5)], np.uint64)
+        assert agent.feed(frames, ts) == 5
+        sent = agent.tick(now_ns=t0 + 10_000_000_000)
+        assert sent.get("packet_blocks", 0) >= 1
+
+        tab = ing.store.table("flow_log", "l4_packet")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ing.flush()
+            if tab.row_count():
+                break
+            time.sleep(0.1)
+        rows = tab.scan()
+        assert rows["packet_count"].sum() == 5
+        assert set(rows["vtap_id"].tolist()) == {5}
+
+        # flow identity is shared with the l4 rows
+        l4 = ing.store.table("flow_log", "l4_flow_log")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ing.flush()
+            if l4.row_count():
+                break
+            time.sleep(0.1)
+        assert set(rows["flow_id"].tolist()) == \
+            set(l4.scan()["flow_id"].tolist())
+
+        # batch bytes recoverable through (batch_off, batch_len); the
+        # blob file segments by the row's table partition
+        i = int(np.argmax(rows["packet_count"]))
+        psec = tab.schema.partition_seconds
+        part = int(rows["timestamp"][i]) // psec * psec
+        with open(tab.root + f"/batches-p{part}.bin", "rb") as f:
+            blob = f.read()
+        off, ln = int(rows["batch_off"][i]), int(rows["batch_len"][i])
+        e = decode_entries(blob[off:off + ln])
+        assert len(e["tcp_seq"]) == int(rows["packet_count"][i])
+        assert 2 in e["tcp_flags"].tolist()       # the SYN
+
+        agent.close()
+    finally:
+        ing.close()
