@@ -175,9 +175,9 @@ class FlowMap:
         consumers (the packet-sequence collector) reuse this pass's
         masking/orientation instead of recomputing it:
         {"cols": valid-filtered columns, "flow_id": [n] u64,
-        "direction": [n] u32 — 0 = the flow INITIATOR's side when a
-        SYN fixed the initiator, canonical (lower ip,port first)
-        orientation otherwise}."""
+        "direction": [n] u32 — the flow's CANONICAL orientation bit
+        (0 = packet travels lower-(ip,port)-first), stable for the
+        flow's lifetime}."""
         valid = pkt["valid"]
         n = int(valid.sum())
         self.packets_in += len(valid)
@@ -316,12 +316,14 @@ class FlowMap:
                 self.c_syn[pkt_slots], self.c_synack[pkt_slots])
         if not self.want_packet_context:
             return None          # default path: no per-packet gathers
-        init = self.c_initiator[all_slots]
-        rel_dir = np.where(init >= 0,
-                           direction ^ (init == 1),
-                           direction).astype(np.uint32)
+        # the direction bit uses CANONICAL orientation (lower (ip,port)
+        # first) — the only basis that is stable for a flow's whole
+        # lifetime. An initiator-relative bit would flip mid-flow when
+        # the SYN arrives after mid-stream capture started, leaving one
+        # block with contradictory bits. The l4_flow_log row for the
+        # same flow_id records which canonical side initiated.
         return {"cols": cols, "flow_id": self.c_flow_id[all_slots],
-                "direction": rel_dir}
+                "direction": direction.astype(np.uint32)}
 
     # -- tick output -------------------------------------------------------
     def tick_columns(self, now_ns: Optional[int] = None,

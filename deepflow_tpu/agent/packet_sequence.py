@@ -23,9 +23,11 @@ Batch content, little-endian, 20 bytes per packet:
     u16 tcp_window
     u16 payload_len
     u8  tcp_flags
-    u8  direction    0 = the flow INITIATOR's side once a SYN fixed the
-                     initiator; before that (no handshake observed) the
-                     canonical lower-(ip,port)-first orientation
+    u8  direction    the flow's CANONICAL orientation bit (0 = packet
+                     travels lower-(ip,port)-first) — stable for the
+                     flow's lifetime even under mid-stream capture; the
+                     l4_flow_log row with the same flow_id records
+                     which canonical side initiated
     u16 reserved     0
 
 Vectorized collection: one numpy pass per capture batch packs all TCP

@@ -103,7 +103,8 @@ class PolicyEnforcer:
 
     def __init__(self, policy: PolicyLabeler,
                  npb_addr: Optional[str] = None,
-                 pcap_dir: Optional[str] = None) -> None:
+                 pcap_dir: Optional[str] = None,
+                 npb_tunnel: str = "raw") -> None:
         self.policy = policy
         self.pcap_dir = pcap_dir
         self._writers: Dict[int, object] = {}
@@ -114,10 +115,33 @@ class PolicyEnforcer:
             self._npb_target = (host, int(port or 4789))
             self._npb_sock = socket.socket(socket.AF_INET,
                                            socket.SOCK_DGRAM)
+        # "vxlan": RFC 7348 encap of each mirrored frame, VNI = the
+        # matching rule id, 24-bit per-enforcer sequence riding the
+        # header's first reserved bytes (the reference's npb_sender
+        # stamps a sequence at vxlan::SEQUENCE_OFFSET the same way for
+        # broker-side loss detection). A broker — or an analyzer-mode
+        # agent, whose dispatcher decaps VXLAN — sees standard tunnel
+        # datagrams on the 4789 target port. "raw" sends bare frames.
+        if npb_tunnel not in ("raw", "vxlan"):
+            raise ValueError(f"unknown npb_tunnel {npb_tunnel!r}")
+        self.npb_tunnel = npb_tunnel
+        self._npb_seq = 0
         self.npb_sent = 0
         self.npb_errors = 0
         self.pcap_dumped = 0
         self.dropped = 0
+
+    def _encap(self, frame: bytes, rule_id: int) -> bytes:
+        if self.npb_tunnel != "vxlan":
+            return frame
+        self._npb_seq = (self._npb_seq + 1) & 0xFFFFFF
+        head = bytes([0x08,                          # flags: VNI valid
+                      (self._npb_seq >> 16) & 0xFF,  # 24-bit sequence in
+                      (self._npb_seq >> 8) & 0xFF,   # the reserved bytes
+                      self._npb_seq & 0xFF])
+        vni = rule_id & 0xFFFFFF
+        return head + bytes([(vni >> 16) & 0xFF, (vni >> 8) & 0xFF,
+                             vni & 0xFF, 0]) + frame
 
     def _writer(self, rule_id: int):
         w = self._writers.get(rule_id)
@@ -153,7 +177,9 @@ class PolicyEnforcer:
             if self._npb_sock is None:
                 break
             try:
-                self._npb_sock.sendto(frames[i], self._npb_target)
+                self._npb_sock.sendto(
+                    self._encap(frames[i], int(rule_ids[i])),
+                    self._npb_target)
                 self.npb_sent += 1
             except OSError:
                 # unreachable broker / oversized datagram: count it — a

@@ -128,6 +128,23 @@ class UniformSender:
                 sent += hi - lo
         return sent
 
+    def send_raw_batch(self, payloads: List[bytes]) -> int:
+        """Concatenate self-delimited payloads (packet-sequence blocks:
+        each leads with its own u32 size) into as few raw frames as fit
+        under the frame budget; returns payloads sent."""
+        sent = 0
+        batch: List[bytes] = []
+        size = 0
+        for p in payloads + [None]:
+            if p is not None and size + len(p) < _BATCH_BYTES:
+                batch.append(p)
+                size += len(p)
+                continue
+            if batch and self.send_raw(b"".join(batch)):
+                sent += len(batch)
+            batch, size = (([p], len(p)) if p is not None else ([], 0))
+        return sent
+
     def send_raw(self, payload: bytes) -> bool:
         """Frame one raw payload as-is (streams whose frame body is a
         single message — OTel exports, influx text — rather than a

@@ -71,6 +71,7 @@ class AgentConfig:
     dispatcher_mode: str = "local"
     local_macs: tuple = ()
     npb_addr: Optional[str] = None            # NPB action target
+    npb_tunnel: str = "raw"                   # "raw" | "vxlan" encap
     pcap_policy_dir: Optional[str] = None     # PCAP action sink
 
 
@@ -200,7 +201,8 @@ class Agent:
         from deepflow_tpu.agent.dispatcher import (Dispatcher,
                                                    DispatcherConfig)
         self.enforcer = PolicyEnforcer(self.policy, npb_addr=cfg.npb_addr,
-                                       pcap_dir=cfg.pcap_policy_dir)
+                                       pcap_dir=cfg.pcap_policy_dir,
+                                       npb_tunnel=cfg.npb_tunnel)
         self.dispatcher = Dispatcher(
             DispatcherConfig(mode=cfg.dispatcher_mode,
                              local_macs=set(cfg.local_macs)),
@@ -462,22 +464,10 @@ class Agent:
         if pseq_blocks:
             # packet-sequence blocks are self-delimited by their
             # leading u32 block_size (l4_packet.go's decoder reads
-            # exactly that), so the frame body is blocks concatenated
-            # RAW — no per-record varint prefixes
-            sender = self.senders[MessageType.PACKETSEQUENCE]
-            n_sent = 0
-            batch: List[bytes] = []
-            size = 0
-            for blk in pseq_blocks + [None]:
-                if blk is not None and size + len(blk) < 400_000:
-                    batch.append(blk)
-                    size += len(blk)
-                    continue
-                if batch and sender.send_raw(b"".join(batch)):
-                    n_sent += len(batch)
-                batch, size = (([blk], len(blk)) if blk is not None
-                               else ([], 0))
-            sent["packet_blocks"] = n_sent
+            # exactly that), so frames carry blocks concatenated RAW —
+            # no per-record varint prefixes
+            sent["packet_blocks"] = self.senders[
+                MessageType.PACKETSEQUENCE].send_raw_batch(pseq_blocks)
         self.sessions.expire(now_ns)
         return sent
 

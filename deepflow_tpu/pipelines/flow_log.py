@@ -394,12 +394,20 @@ class FlowLogPipeline:
 
     def _prune_pseq_blobs(self) -> None:
         """Remove batch blob files whose table partition has expired
-        (TTL/GC drop the rows; the bytes must follow)."""
+        (TTL/GC drop the rows; the bytes must follow). Only partitions
+        comfortably in the past are candidates: a blob for a BRAND-NEW
+        partition exists momentarily before its rows flush to the table
+        (decoder writes bytes first), and deleting it in that window
+        would strand the rows' offsets."""
+        import time as _time
+
         t = self._pseq_table
         if t is None:
             return
         live = set(t.partitions())
         cur = self._pseq_blob[0] if self._pseq_blob is not None else None
+        psec = t.schema.partition_seconds
+        horizon = _time.time() - 2 * psec   # grace >> writer flush lag
         try:
             names = os.listdir(t.root)
         except OSError:
@@ -412,7 +420,7 @@ class FlowLogPipeline:
                 part = int(name[len("batches-p"):-len(".bin")])
             except ValueError:
                 continue
-            if part not in live and part != cur:
+            if part not in live and part != cur and part + psec < horizon:
                 try:
                     os.remove(os.path.join(t.root, name))
                 except OSError:

@@ -101,6 +101,49 @@ def test_npb_forwarding():
     rx.close()
 
 
+def test_npb_vxlan_encap_roundtrip():
+    """npb_tunnel="vxlan": mirrored frames arrive at the broker as RFC
+    7348 datagrams (VNI = rule id, 24-bit sequence in the reserved
+    bytes, the reference npb_sender's loss-detection trick) — and an
+    analyzer-mode agent re-ingests them through its own VXLAN decap,
+    closing the mirror loop."""
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    port = rx.getsockname()[1]
+    policy = PolicyLabeler([AclRule(rule_id=7, protocol=6,
+                                    action=ACTION_NPB)])
+    enf = PolicyEnforcer(policy, npb_addr=f"127.0.0.1:{port}",
+                         npb_tunnel="vxlan")
+    d = Dispatcher(DispatcherConfig(), policy=policy, enforcer=enf)
+    frames = _frames()
+    d.dispatch(frames)
+    got = [rx.recv(65535) for _ in range(2)]
+    inner = set()
+    seqs = []
+    for dgram in got:
+        assert dgram[0] == 0x08                      # flags: VNI valid
+        seqs.append(int.from_bytes(dgram[1:4], "big"))
+        vni = int.from_bytes(dgram[4:7], "big")
+        assert vni == 7 and dgram[7] == 0
+        inner.add(dgram[8:])
+    assert inner == set(frames[:2])
+    assert sorted(seqs) == [1, 2]                    # per-frame sequence
+
+    # the mirror loop: wrap one broker datagram in outer eth/ip/udp:4789
+    # and feed it to a plain dispatcher — its VXLAN decap must surface
+    # the INNER 5-tuple
+    from deepflow_tpu.replay.frames import ip4
+    outer = eth_ipv4_udp(ip4(10, 9, 9, 1), ip4(10, 9, 9, 2),
+                         55000, 4789, got[0])
+    analyzer = Dispatcher(DispatcherConfig())
+    pkt = analyzer.dispatch([outer])
+    assert pkt["valid"].all()
+    assert int(pkt["port_dst"][0]) == 80             # inner flow, not 4789
+    enf.close()
+    rx.close()
+
+
 def test_tap_side_threads_through_flow_output():
     """Dispatcher MAC orientation reaches the flow tick output
     (dispatch -> flow map -> tap_side column)."""

@@ -159,10 +159,11 @@ def test_decode_blocks_rejects_malformed():
     assert rows == [] and bad == 1
 
 
-def test_direction_is_initiator_relative():
-    """The direction bit follows the SYN initiator, not the canonical
-    (lower ip,port) orientation — even when the initiator is the HIGHER
-    tuple."""
+def test_direction_is_canonical_and_stable():
+    """The direction bit is the flow's canonical orientation (lower
+    (ip,port) first) — chosen over initiator-relative because it cannot
+    flip mid-flow when a SYN shows up after mid-stream capture; the l4
+    row records the initiator side separately."""
     from deepflow_tpu.agent.flow_map import FlowMap
     from deepflow_tpu.agent.packet import PROTO_TCP, SYN, ACK
 
@@ -188,9 +189,13 @@ def test_direction_is_initiator_relative():
     fm = FlowMap()
     fm.want_packet_context = True
     ctx = fm.inject(pkt)
-    # SYN packet = initiator side -> 0; SYN|ACK = responder -> 1
-    assert ctx["direction"].tolist() == [0, 1]
+    # initiator (9,50000) sorts AFTER (5,80): its packets are the
+    # reversed canonical direction (1); the responder's are 0 — and the
+    # bits would be identical had capture started mid-flow
+    assert ctx["direction"].tolist() == [1, 0]
     assert ctx["flow_id"][0] == ctx["flow_id"][1]
+    # default agents don't pay for the context
+    assert FlowMap().inject(dict(pkt)) is None
 
 
 def test_close_force_flushes_young_blocks(tmp_path):
