@@ -67,6 +67,9 @@ class AgentConfig:
     # TCP headers -> l4_packet rows. Off by default like the reference's
     # packet_sequence_flag=0 (config.rs:519)
     packet_sequence: bool = False
+    # agent-side UDP debug server (reference: agent/src/debug/ serving
+    # per-subsystem dumps to deepflow-ctl). None disables; 0 = ephemeral
+    debug_port: Optional[int] = None
     # dispatcher (agent/dispatcher.py): capture mode + policy actions
     dispatcher_mode: str = "local"
     local_macs: tuple = ()
@@ -240,6 +243,42 @@ class Agent:
         self.wasm_plugins: Dict[str, object] = {}
         for path in cfg.wasm_plugins:
             self._load_wasm(path)
+        self.debug = None
+        if cfg.debug_port is not None:
+            self._build_debug(cfg.debug_port)
+
+    def _build_debug(self, port: int) -> None:
+        """Agent-side debug protocol (reference: agent/src/debug/ —
+        per-subsystem dumps over UDP for deepflow-ctl). Shares the
+        server-side protocol/CLI plumbing (runtime/debug.py)."""
+        from deepflow_tpu.runtime.debug import DebugServer
+        from deepflow_tpu.runtime.stats import StatsRegistry
+
+        stats = StatsRegistry()
+        stats.register("agent.flow_map", self.flow_map.counters)
+        stats.register("agent.dispatcher", self.dispatcher.counters)
+        stats.register("agent.enforcer", self.enforcer.counters)
+        stats.register("agent.guard", self.guard.counters)
+        if self.pseq is not None:
+            stats.register("agent.packet_sequence", self.pseq.counters)
+        self.debug = DebugServer(stats, port=port)
+        self.debug.register("policy", lambda req: {
+            "rules": [vars(r) for r in self.policy.rules],
+            "enforcer": self.enforcer.counters()})
+        self.debug.register("rpc", lambda req: {
+            "vtap_id": self.vtap_id,
+            "config_version": self.config_version,
+            "escaped": self.escaped,
+            "ntp_offset_ns": self.ntp_offset_ns,
+            "controller_url": self.cfg.controller_url})
+        from deepflow_tpu.agent.platform import local_interfaces
+        self.debug.register("platform", lambda req: {
+            "interfaces": local_interfaces(),
+            "k8s_watcher": (self.k8s_watcher.counters()
+                            if self.k8s_watcher is not None else None)})
+        self.debug.register("plugins", lambda req: {
+            "so": [p.counters() for p in self.so_plugins.values()],
+            "wasm": [p.counters() for p in self.wasm_plugins.values()]})
 
     def _load_plugin(self, path: str) -> bool:
         """dlopen + register one L7 plugin; a broken .so must not take
@@ -474,6 +513,8 @@ class Agent:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.guard.start()
+        if self.debug is not None:
+            self.debug.start()
         if self.cfg.controller_url is not None:
             t = threading.Thread(target=self._sync_loop, name="synchronizer",
                                  daemon=True)
@@ -523,6 +564,8 @@ class Agent:
         for t in self._threads:
             t.join(timeout=2)
         self.tick(final=True)  # final flush incl. young pseq blocks
+        if self.debug is not None:
+            self.debug.close()
         self.enforcer.close()
         self.guard.close()
         for s in self.senders.values():

@@ -63,6 +63,15 @@ def cmd_agent(args) -> int:
                  "ALIVE" if v["alive"] else "OFFLINE", v["revision"]]
                 for v in vtaps],
                ["ID", "CTRL_IP", "HOST", "GROUP", "STATE", "REVISION"])
+    else:
+        # live-agent debug protocol (reference: deepflow-ctl agent ...
+        # against agent/src/debug/'s UDP server)
+        if args.debug_port is None:
+            print("agent debug commands require --debug-port "
+                  "(agents have no default debug port)")
+            return 2
+        out = debug_request(args.action, port=args.debug_port)
+        print(json.dumps(out, indent=2, sort_keys=True))
     return 0
 
 
@@ -173,11 +182,14 @@ def cmd_ingester(args) -> int:
             req["ttl"] = args.ttl
         if args.keep_data:
             req["drop"] = False
-        out = debug_request("datasource", port=args.debug_port, **req)
+        out = debug_request("datasource",
+                            port=args.debug_port or DEFAULT_DEBUG_PORT,
+                            **req)
         print(json.dumps(out, indent=2, sort_keys=True))
     elif args.action in ("counters", "vtap-status", "ping", "stacks",
                          "artifacts"):
-        out = debug_request(args.action, port=args.debug_port,
+        out = debug_request(args.action,
+                            port=args.debug_port or DEFAULT_DEBUG_PORT,
                             **({"module": args.module} if args.module
                                else {}))
         print(json.dumps(out, indent=2, sort_keys=True))
@@ -304,11 +316,19 @@ def build_parser() -> argparse.ArgumentParser:
         prog="df-ctl", description="deepflow-tpu ops CLI")
     p.add_argument("--controller", default=CONTROLLER)
     p.add_argument("--querier", default=QUERIER)
-    p.add_argument("--debug-port", type=int, default=DEFAULT_DEBUG_PORT)
+    # None = "not given": ingester commands fall back to the ingester's
+    # well-known debug port; agent debug commands REQUIRE it (agents
+    # have no default port — a colocated ingester would answer the same
+    # protocol and its counters would masquerade as the agent's)
+    p.add_argument("--debug-port", type=int, default=None)
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    a = sub.add_parser("agent", help="agent fleet")
-    a.add_argument("action", choices=["list"])
+    a = sub.add_parser("agent", help="agent fleet + live-agent debug")
+    a.add_argument("action",
+                   choices=["list", "ping", "counters", "stacks",
+                            "policy", "rpc", "platform", "plugins"],
+                   help="list = fleet via controller; the rest query a "
+                        "live agent's UDP debug server (--debug-port)")
     a.set_defaults(fn=cmd_agent)
 
     g = sub.add_parser("agent-group-config", help="group config CRUD")

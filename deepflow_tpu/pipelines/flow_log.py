@@ -406,8 +406,11 @@ class FlowLogPipeline:
             return
         live = set(t.partitions())
         cur = self._pseq_blob[0] if self._pseq_blob is not None else None
-        psec = t.schema.partition_seconds
-        horizon = _time.time() - 2 * psec   # grace >> writer flush lag
+        # grace on the blob file's WALL-CLOCK mtime: the write→row-flush
+        # lag is wall-clock, while partition stamps are DATA time — a
+        # replayed historical pcap writes "old" partitions whose rows
+        # are still in flight (a data-time grace would delete them)
+        mtime_horizon = _time.time() - 120.0
         try:
             names = os.listdir(t.root)
         except OSError:
@@ -420,9 +423,14 @@ class FlowLogPipeline:
                 part = int(name[len("batches-p"):-len(".bin")])
             except ValueError:
                 continue
-            if part not in live and part != cur and part + psec < horizon:
+            path = os.path.join(t.root, name)
+            try:
+                recent = os.path.getmtime(path) > mtime_horizon
+            except OSError:
+                continue
+            if part not in live and part != cur and not recent:
                 try:
-                    os.remove(os.path.join(t.root, name))
+                    os.remove(path)
                 except OSError:
                     pass
 

@@ -235,6 +235,39 @@ def test_agent_to_ingester_e2e(tmp_path):
         ing.close()
 
 
+def test_agent_debug_server(tmp_path):
+    """The agent's own UDP debug surface (reference agent/src/debug/):
+    per-subsystem dumps served live, driven by the shared protocol the
+    df-ctl agent subcommand speaks."""
+    from deepflow_tpu.agent.policy import ACTION_DROP, AclRule
+    from deepflow_tpu.agent.wasm_samples import build_memcached_wasm
+    from deepflow_tpu.runtime.debug import debug_request
+
+    wasm_path = tmp_path / "mc.wasm"
+    wasm_path.write_bytes(build_memcached_wasm())
+    agent = Agent(AgentConfig(debug_port=0,
+                              wasm_plugins=(str(wasm_path),)))
+    agent.policy.rules.append(AclRule(rule_id=4, protocol=17,
+                                      action=ACTION_DROP))
+    agent.start()
+    try:
+        port = agent.debug.port
+        assert debug_request("ping", port=port)["data"] == "pong"
+        pol = debug_request("policy", port=port)["data"]
+        assert pol["rules"][0]["rule_id"] == 4
+        assert "dropped" in pol["enforcer"]
+        rpc = debug_request("rpc", port=port)["data"]
+        assert rpc["vtap_id"] == 0 and rpc["escaped"] is False
+        plat = debug_request("platform", port=port)["data"]
+        assert isinstance(plat["interfaces"], list)
+        plug = debug_request("plugins", port=port)["data"]
+        assert plug["wasm"][0]["plugin"] == "Memcached-wasm"
+        counters = debug_request("counters", port=port)["data"]
+        assert "agent.flow_map" in counters
+    finally:
+        agent.close()
+
+
 def test_agent_managed_by_controller(tmp_path):
     from deepflow_tpu.controller import (ControllerServer, ResourceModel,
                                          VTapRegistry)

@@ -126,12 +126,18 @@ def test_blob_files_pruned_with_expired_partitions(tmp_path):
     try:
         tab = ing.store.table("flow_log", "l4_packet")
         psec = tab.schema.partition_seconds
+        import os
         old_part = 3600
-        # fabricate an expired-partition blob + a live row's blob
+        # fabricate an expired-partition blob + a live row's blob; age
+        # the mtimes past the wall-clock grace (freshly written blobs
+        # are never pruned even for old DATA partitions — replay safety)
         open(tab.root + f"/batches-p{old_part}.bin", "wb").write(b"x")
         now = int(time.time())
         live_part = now // psec * psec
         open(tab.root + f"/batches-p{live_part}.bin", "wb").write(b"y")
+        for p in (old_part, live_part):
+            os.utime(tab.root + f"/batches-p{p}.bin",
+                     (now - 600, now - 600))
         tab.append({
             "timestamp": np.array([now], np.uint32),
             "start_time_us": np.zeros(1, np.uint64),
