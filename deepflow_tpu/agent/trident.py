@@ -70,6 +70,9 @@ class AgentConfig:
     # agent-side UDP debug server (reference: agent/src/debug/ serving
     # per-subsystem dumps to deepflow-ctl). None disables; 0 = ephemeral
     debug_port: Optional[int] = None
+    # ship the agent's own counters as DFSTATS onto the firehose
+    # (reference: utils/stats.rs -> ingester deepflow_system DB)
+    self_telemetry: bool = True
     # dispatcher (agent/dispatcher.py): capture mode + policy actions
     dispatcher_mode: str = "local"
     local_macs: tuple = ()
@@ -243,6 +246,20 @@ class Agent:
         self.wasm_plugins: Dict[str, object] = {}
         for path in cfg.wasm_plugins:
             self._load_wasm(path)
+        # one Countable registry for BOTH the debug surface and the
+        # DFSTATS self-telemetry loop (reference: utils/stats.rs — the
+        # agent monitors itself with the same pipeline it feeds)
+        from deepflow_tpu.runtime.stats import StatsRegistry
+
+        self.stats = StatsRegistry()
+        self.stats.register("agent.flow_map", self.flow_map.counters)
+        self.stats.register("agent.dispatcher", self.dispatcher.counters)
+        self.stats.register("agent.enforcer", self.enforcer.counters)
+        self.stats.register("agent.guard", self.guard.counters)
+        if self.pseq is not None:
+            self.stats.register("agent.packet_sequence",
+                                self.pseq.counters)
+        self.stats_shipper = None
         self.debug = None
         if cfg.debug_port is not None:
             self._build_debug(cfg.debug_port)
@@ -252,16 +269,8 @@ class Agent:
         per-subsystem dumps over UDP for deepflow-ctl). Shares the
         server-side protocol/CLI plumbing (runtime/debug.py)."""
         from deepflow_tpu.runtime.debug import DebugServer
-        from deepflow_tpu.runtime.stats import StatsRegistry
 
-        stats = StatsRegistry()
-        stats.register("agent.flow_map", self.flow_map.counters)
-        stats.register("agent.dispatcher", self.dispatcher.counters)
-        stats.register("agent.enforcer", self.enforcer.counters)
-        stats.register("agent.guard", self.guard.counters)
-        if self.pseq is not None:
-            stats.register("agent.packet_sequence", self.pseq.counters)
-        self.debug = DebugServer(stats, port=port)
+        self.debug = DebugServer(self.stats, port=port)
         self.debug.register("policy", lambda req: {
             "rules": [vars(r) for r in self.policy.rules],
             "enforcer": self.enforcer.counters()})
@@ -314,6 +323,8 @@ class Agent:
         self.flow_map.vtap_id = vtap_id
         for s in self.senders.values():
             s.vtap_id = vtap_id
+        if self.stats_shipper is not None:
+            self.stats_shipper.sender.vtap_id = vtap_id
 
     # -- control plane -----------------------------------------------------
     def sync_once(self) -> bool:
@@ -346,6 +357,9 @@ class Agent:
         if r.get("ingester"):
             for s in self.senders.values():
                 s.set_target(r["ingester"])
+            if self.stats_shipper is not None:
+                # self-telemetry follows the reassignment too
+                self.stats_shipper.sender.set_target(r["ingester"])
         if r["config_version"] != self.config_version:
             self._apply_config(r["config"])
             self.config_version = r["config_version"]
@@ -515,6 +529,11 @@ class Agent:
         self.guard.start()
         if self.debug is not None:
             self.debug.start()
+        if self.cfg.self_telemetry and self.cfg.ingester_addr:
+            from deepflow_tpu.runtime.stats import StatsShipper
+            self.stats_shipper = StatsShipper(
+                self.stats, self.cfg.ingester_addr, vtap_id=self.vtap_id)
+            self.stats.start(interval_s=10.0)
         if self.cfg.controller_url is not None:
             t = threading.Thread(target=self._sync_loop, name="synchronizer",
                                  daemon=True)
@@ -566,6 +585,12 @@ class Agent:
         self.tick(final=True)  # final flush incl. young pseq blocks
         if self.debug is not None:
             self.debug.close()
+        if self.stats_shipper is not None:
+            # final scrape: an agent shorter-lived than the 10s cadence
+            # (or counters updated since the last tick) must still land
+            self.stats.collect()
+            self.stats_shipper.close()   # removes sink, flushes, closes
+        self.stats.stop()
         self.enforcer.close()
         self.guard.close()
         for s in self.senders.values():

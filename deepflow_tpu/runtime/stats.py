@@ -133,13 +133,22 @@ class StatsShipper:
 
         if self._closed:
             return
+        # Countables may carry descriptive strings ("mode": "local")
+        # alongside numbers: strings ride as tags (what the pb's tag
+        # fields are for), numerics as float metrics
+        metrics = {}
+        tags = dict(sample.tags)
+        for k, v in sample.values.items():
+            if isinstance(v, (int, float)):   # incl. bool -> 0.0/1.0
+                metrics[k] = float(v)
+            else:
+                tags[k] = str(v)
         st = stats_pb2.Stats(
             timestamp=int(sample.ts), name=sample.module,
-            tag_names=list(sample.tags.keys()),
-            tag_values=[str(v) for v in sample.tags.values()],
-            metrics_float_names=list(sample.values.keys()),
-            metrics_float_values=[float(v) for v in
-                                  sample.values.values()])
+            tag_names=list(tags.keys()),
+            tag_values=[str(v) for v in tags.values()],
+            metrics_float_names=list(metrics.keys()),
+            metrics_float_values=list(metrics.values()))
         with self._lock:
             self._batch.append(st.SerializeToString())
             if len(self._batch) >= 64:

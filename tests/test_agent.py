@@ -268,6 +268,35 @@ def test_agent_debug_server(tmp_path):
         agent.close()
 
 
+def test_agent_self_telemetry_lands_in_deepflow_system(tmp_path):
+    """The agent ships its own Countables as DFSTATS over the firehose
+    into the ingester's deepflow_system DB (reference utils/stats.rs)."""
+    from deepflow_tpu.pipelines.ingester import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    try:
+        agent = Agent(AgentConfig(ingester_addr=f"127.0.0.1:{ing.port}"))
+        agent.start()
+        try:
+            # close() performs the final scrape+flush: an agent shorter-
+            # lived than the 10s cadence must still report
+            pass
+        finally:
+            agent.close()
+        deadline = time.time() + 10
+        while ing.ext_metrics.samples < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        ing.flush()
+        rows = ing.store.table("deepflow_system", "ext_samples").scan()
+        names = {ing.tag_dicts.get("metric_name").decode(h)
+                 for h in rows["metric"]}
+        assert any(n and n.startswith("agent.flow_map") for n in names)
+    finally:
+        ing.close()
+
+
 def test_agent_managed_by_controller(tmp_path):
     from deepflow_tpu.controller import (ControllerServer, ResourceModel,
                                          VTapRegistry)
