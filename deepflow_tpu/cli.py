@@ -186,8 +186,14 @@ def cmd_ingester(args) -> int:
                             port=args.debug_port or DEFAULT_DEBUG_PORT,
                             **req)
         print(json.dumps(out, indent=2, sort_keys=True))
+    elif args.action == "queue-tap":
+        out = debug_request("queue-tap",
+                            port=args.debug_port or DEFAULT_DEBUG_PORT,
+                            module=args.module or "",
+                            count=args.count)
+        print(json.dumps(out, indent=2, sort_keys=True))
     elif args.action in ("counters", "vtap-status", "ping", "stacks",
-                         "artifacts"):
+                         "artifacts", "queues"):
         out = debug_request(args.action,
                             port=args.debug_port or DEFAULT_DEBUG_PORT,
                             **({"module": args.module} if args.module
@@ -367,7 +373,8 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("ingester", help="ingester membership + debug")
     i.add_argument("action", choices=["set", "assignments", "counters",
                                       "vtap-status", "ping", "stacks",
-                                      "artifacts", "datasource"])
+                                      "artifacts", "datasource",
+                                      "queues", "queue-tap"])
     i.add_argument("addrs", nargs="*")
     i.add_argument("--module")
     i.add_argument("--op", default="list",
@@ -378,6 +385,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="datasource tier in seconds (whole minutes)")
     i.add_argument("--ttl", type=int,
                    help="retention seconds (0 = keep forever)")
+    i.add_argument("--count", type=int, default=3,
+                   help="queue-tap: items to sample")
     i.add_argument("--keep-data", action="store_true",
                    help="datasource del: detach the tier but keep rows")
     i.set_defaults(fn=cmd_ingester)

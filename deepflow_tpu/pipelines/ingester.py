@@ -137,6 +137,59 @@ class Ingester:
                              in self.receiver.status().items()})
             self.debug.register("artifacts", self._artifact_listing)
             self.debug.register("datasource", self._datasource_cmd)
+            self.debug.register("queues", self._queues_cmd)
+            self.debug.register("queue-tap", self._queue_tap_cmd)
+
+    def _own_queues(self) -> dict:
+        """THIS ingester's inter-stage MultiQueues by name. Scoped to
+        the instance — a process can host several ingesters, and a
+        debug command must never reach into another's pipelines."""
+        out = {}
+        for _, q in self.flow_log._streams:
+            out[q.name] = q
+        for p in (self.flow_metrics, self.ext_metrics, self.event,
+                  self.profile, self.droplet):
+            q = getattr(p, "queues", None)
+            if q is not None:
+                out[q.name] = q
+        return out
+
+    def _queues_cmd(self, req: dict) -> dict:
+        """Every inter-stage queue with in/out/overwritten/pending
+        (reference: queue-tap listing in deepflow-ctl)."""
+        want = req.get("module") or ""
+        return {name: q.counters()
+                for name, q in sorted(self._own_queues().items())
+                if want in name}
+
+    def _queue_tap_cmd(self, req: dict) -> dict:
+        """Sample up to `count` in-flight items from a named queue
+        (reference: queue::bounded_with_debug taps). Arms the tap, lets
+        traffic flow briefly, returns item summaries. The wait is
+        clamped: the debug loop is single-threaded, so a handler must
+        return well inside the client's 2s datagram timeout."""
+        import time as _time
+
+        name = req.get("module") or ""
+        q = self._own_queues().get(name)
+        if q is None:
+            return {"error": f"unknown queue {name!r} "
+                             "(list with the queues command)"}
+        count = min(int(req.get("count", 3)), 20)
+        wait_s = min(max(float(req.get("wait_s", 1.0)), 0.0), 1.5)
+        q.tap(count)
+        try:
+            deadline = _time.time() + wait_s
+            items: list = []
+            while _time.time() < deadline:
+                items.extend(q.tap_take())
+                if len(items) >= count:
+                    break
+                _time.sleep(0.05)
+            items.extend(q.tap_take())
+        finally:
+            q.untap()
+        return {"queue": name, "sampled": items[:count]}
 
     def _datasource_cmd(self, req: dict) -> dict:
         """Runtime rollup-tier CRUD over the debug socket (the

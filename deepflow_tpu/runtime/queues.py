@@ -35,6 +35,9 @@ class OverwriteQueue:
         self.in_count = 0
         self.out_count = 0
         self.overwritten = 0
+        # debug tap: when armed, the next N puts record item summaries
+        self._tap_left = 0
+        self._tap_out: List[str] = []
 
     def __len__(self) -> int:
         with self._lock:
@@ -57,6 +60,9 @@ class OverwriteQueue:
                 else:
                     self._size += 1
                 self._buf[tail] = item
+                if self._tap_left > 0:
+                    self._tap_left -= 1
+                    self._tap_out.append(repr(item)[:240])
             self.in_count += len(items)
             self._ready.notify_all()
 
@@ -87,6 +93,18 @@ class OverwriteQueue:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    def tap(self, count: int) -> None:
+        """Arm sampling of the next `count` items flowing through."""
+        with self._lock:
+            self._tap_left = max(0, count)
+            self._tap_out = []
+
+    def tap_take(self) -> List[str]:
+        """Collect (and clear) sampled item summaries."""
+        with self._lock:
+            out, self._tap_out = self._tap_out, []
+            return out
 
     def counters(self) -> dict:
         with self._lock:
@@ -128,6 +146,23 @@ class MultiQueue:
     def close(self) -> None:
         for q in self.queues:
             q.close()
+
+    def tap(self, count: int) -> None:
+        """Arm each sub-queue to sample up to `count` items."""
+        for q in self.queues:
+            q.tap(count)
+
+    def untap(self) -> None:
+        """Disarm all sub-queues and discard buffered samples (a tap
+        left armed keeps paying repr cost on the put hot path)."""
+        for q in self.queues:
+            q.tap(0)
+
+    def tap_take(self) -> List[str]:
+        out: List[str] = []
+        for q in self.queues:
+            out.extend(q.tap_take())
+        return out
 
     def counters(self) -> dict:
         agg = {"in": 0, "out": 0, "overwritten": 0, "pending": 0}
