@@ -373,9 +373,11 @@ class Agent:
                               cfg.get("max_cpus", 1))
         self.cfg.l7_enabled = bool(cfg.get("l7_log_enabled", True))
         self.cfg.sync_interval_s = cfg.get("sync_interval_s", 60)
-        if "so_plugins" in cfg:   # absent key = leave plugins alone
+        # absent or None = plugins not managed by this push; a LIST is
+        # authoritative (pushing [] must actually stop a plugin)
+        if cfg.get("so_plugins") is not None:
             self._sync_plugins(cfg["so_plugins"])
-        if "wasm_plugins" in cfg:
+        if cfg.get("wasm_plugins") is not None:
             self._sync_wasm_plugins(cfg["wasm_plugins"])
 
     def _sync_plugins(self, paths) -> None:

@@ -75,19 +75,63 @@ def cmd_agent(args) -> int:
     return 0
 
 
+# every key the agent's pushed-RuntimeConfig hot-apply honors
+# (Agent._apply_config), with the defaults it assumes when absent —
+# the reference's `deepflow-ctl agent-group-config example` role
+GROUP_CONFIG_EXAMPLE = """\
+# deepflow-tpu agent-group config (pushed RuntimeConfig).
+# CRUD as yaml: df-ctl agent-group-config set --group g --file cfg.yaml
+# Keys absent from a push keep their current value on the agent.
+
+# self-protection limits enforced by the guard thread
+max_memory_mb: 768        # RSS ceiling; breach -> callbacks fire
+max_cpus: 1               # CPU-fraction ceiling
+
+# L7 protocol log collection on/off (payload parsing cost)
+l7_log_enabled: true
+
+# controller sync cadence, seconds
+sync_interval_s: 60
+
+# L7 parser plugins. Omitted (or null) = not managed by this group:
+# agents keep whatever they loaded statically. A LIST is authoritative
+# and hot-converges agents to exactly it — so an explicit [] unloads
+# every plugin. Uncomment deliberately:
+# so_plugins: ["/opt/plugins/custom.so"]   # .so over df_plugin.h
+# wasm_plugins: ["/opt/plugins/custom.wasm"]  # sandboxed wasm
+"""
+
+
 def cmd_group_config(args) -> int:
+    if args.action == "example":
+        print(GROUP_CONFIG_EXAMPLE, end="")
+        return 0
     url = f"{args.controller}/v1/vtap-group-config?group={args.group}"
-    if args.set:
+    if args.action == "set":
         body = {}
-        for kv in args.set:
+        if args.file:
+            import yaml
+            with open(args.file) as f:
+                doc = yaml.safe_load(f) or {}
+            if not isinstance(doc, dict):
+                raise RuntimeError(f"{args.file}: expected a yaml mapping")
+            body.update(doc)
+        for kv in args.set or []:
             k, _, v = kv.partition("=")
             try:
                 body[k] = json.loads(v)
             except ValueError:
                 body[k] = v
+        if not body:
+            raise RuntimeError("set requires --file and/or --set KEY=VALUE")
         out = _http(url, body=body)
         print(json.dumps(out))
     else:
+        if args.set or args.file:
+            # the pre-round-3 form was `agent-group-config --set k=v`
+            # (no action); silently doing a GET would drop the change
+            print("did you mean: agent-group-config set --set/--file ...")
+            return 2
         print(json.dumps(_http(url), indent=2, sort_keys=True))
     return 0
 
@@ -337,8 +381,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "live agent's UDP debug server (--debug-port)")
     a.set_defaults(fn=cmd_agent)
 
-    g = sub.add_parser("agent-group-config", help="group config CRUD")
+    g = sub.add_parser("agent-group-config",
+                       help="group config CRUD (yaml or KEY=VALUE)")
+    g.add_argument("action", nargs="?", default="get",
+                   choices=["get", "set", "example"])
     g.add_argument("--group", default="default")
+    g.add_argument("--file", help="yaml config document for set")
     g.add_argument("--set", nargs="*", metavar="KEY=VALUE")
     g.set_defaults(fn=cmd_group_config)
 

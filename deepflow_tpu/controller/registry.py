@@ -29,6 +29,11 @@ DEFAULT_CONFIG = {
     "capture_bpf": "",
     "max_collect_pps": 200_000,
     "throttle_per_s": 50_000,
+    # L7 parser plugins: None = "not managed by this group" (agents
+    # keep their static sets); a LIST is authoritative and the agent
+    # hot-converges to exactly it (Agent._sync_*_plugins)
+    "so_plugins": None,
+    "wasm_plugins": None,
 }
 
 
@@ -142,6 +147,16 @@ class VTapRegistry:
         bad = set(config) - set(DEFAULT_CONFIG)
         if bad:
             raise ValueError(f"unknown config keys: {sorted(bad)}")
+        for key in ("so_plugins", "wasm_plugins"):
+            v = config.get(key)
+            if v is None:
+                continue
+            # a bare string would be iterated character-by-character by
+            # the agent's converge loop, unloading every plugin
+            if not (isinstance(v, list)
+                    and all(isinstance(p, str) for p in v)):
+                raise ValueError(
+                    f"{key} must be a list of paths (or null)")
         with self._lock:
             base = dict(self._configs.get(group, DEFAULT_CONFIG))
             base.update(config)

@@ -50,14 +50,45 @@ def test_cli_agent_list(stack, capsys):
     assert "node-1" in out and "ALIVE" in out
 
 
-def test_cli_group_config_roundtrip(stack, capsys):
+def test_cli_group_config_roundtrip(stack, capsys, tmp_path):
     srv, _ = stack
     base = f"http://127.0.0.1:{srv.port}"
     rc, _ = _run(capsys, "--controller", base, "agent-group-config",
-                 "--set", "max_cpus=8")
+                 "set", "--set", "max_cpus=8")
     assert rc == 0
     rc, out = _run(capsys, "--controller", base, "agent-group-config")
     assert json.loads(out)["max_cpus"] == 8
+    # yaml document push (the reference's yaml CRUD shape)
+    cfg = tmp_path / "group.yaml"
+    cfg.write_text("l7_log_enabled: false\nmax_memory_mb: 512\n")
+    rc, _ = _run(capsys, "--controller", base, "agent-group-config",
+                 "set", "--file", str(cfg))
+    assert rc == 0
+    rc, out = _run(capsys, "--controller", base, "agent-group-config")
+    doc = json.loads(out)
+    assert doc["l7_log_enabled"] is False and doc["max_memory_mb"] == 512
+    assert doc["max_cpus"] == 8            # earlier key preserved
+    # the example covers the always-on keys as valid yaml; the plugin
+    # keys stay COMMENTED (pushing the raw example must not unload
+    # anyone's plugins)
+    import yaml
+
+    rc, out = _run(capsys, "agent-group-config", "example")
+    assert rc == 0
+    ex = yaml.safe_load(out)
+    assert {"max_memory_mb", "max_cpus", "l7_log_enabled",
+            "sync_interval_s"} <= set(ex)
+    assert "so_plugins" not in ex and "# so_plugins" in out
+    # legacy form (--set without the action) errors instead of silently
+    # doing a get
+    rc, _ = _run(capsys, "--controller", base, "agent-group-config",
+                 "--set", "max_cpus=2")
+    assert rc == 2
+    # a bare-string plugin value is rejected server-side (main() turns
+    # the RuntimeError into exit code 1)
+    rc, _ = _run(capsys, "--controller", base, "agent-group-config",
+                 "set", "--set", "so_plugins=/x.so")
+    assert rc == 1
 
 
 def test_cli_query(stack, capsys):
