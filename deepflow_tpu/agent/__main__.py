@@ -1,0 +1,156 @@
+"""Agent process entrypoint: `python -m deepflow_tpu.agent -f agent.yaml`.
+
+Reference: agent/src/main.rs:102 — the binary reads a tiny bootstrap
+yaml (controller address and little else; the full RuntimeConfig is
+PUSHED by the controller after registration) and runs until signalled.
+Same shape here: the yaml's keys are AgentConfig fields plus a
+`capture:` block choosing the packet source; everything else arrives
+through the sync loop (trident.py Agent.sync_once -> _apply_config).
+
+Capture sources (agent/afpacket.py, agent/pcap.py):
+  capture: {engine: ring,  iface: eth0}     TPACKET_V3 mmap ring
+  capture: {engine: raw,   iface: eth0}     batched raw socket
+  capture: {engine: pcap,  path: x.pcap}    replay a capture file
+  capture: {engine: none}                   control-plane only (eBPF or
+                                            integration push feeds data)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+import yaml
+
+_CAPTURE_KEYS = ("engine", "iface", "path", "batch_size", "block_size",
+                 "block_count", "poll_ms", "snaplen")
+
+
+def load_bootstrap(path: str) -> tuple:
+    """Parse the bootstrap yaml into (AgentConfig, capture dict).
+
+    Unknown keys are an error, not a warning: a typo'd yaml silently
+    running on defaults is how a fleet ends up capturing nothing
+    (the reference validates pushed config the same way —
+    config.rs RuntimeConfig::validate).
+    """
+    # deferred: importing trident pulls jax (seconds); main() registers
+    # signal handlers before paying that, so TERM-during-startup exits
+    # cleanly instead of through the default handler
+    from deepflow_tpu.agent.trident import AgentConfig
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    capture = raw.pop("capture", {"engine": "none"}) or {"engine": "none"}
+    unknown = set(capture) - set(_CAPTURE_KEYS)
+    if unknown:
+        raise ValueError(f"unknown capture keys: {sorted(unknown)}")
+    engine = capture.get("engine", "none")
+    if engine not in ("none", "raw", "ring", "pcap"):
+        raise ValueError(f"unknown capture engine {engine!r} "
+                         "(none|raw|ring|pcap)")
+    if engine == "pcap" and not capture.get("path"):
+        raise ValueError("capture engine pcap requires path")
+    # per-engine knobs: reject mismatches here so --dry-run catches them
+    if engine != "raw" and "snaplen" in capture:
+        raise ValueError("snaplen applies to engine raw only; "
+                         "the ring sizes frames via block_size")
+    if engine != "ring" and ("block_size" in capture
+                             or "block_count" in capture):
+        raise ValueError("block_size/block_count apply to engine ring only")
+    fields = AgentConfig.__dataclass_fields__
+    unknown = set(raw) - set(fields)
+    if unknown:
+        raise ValueError(f"unknown agent config keys: {sorted(unknown)}")
+    for k in ("so_plugins", "wasm_plugins", "local_macs"):
+        if k in raw and isinstance(raw[k], list):
+            raw[k] = tuple(raw[k])
+    return AgentConfig(**raw), capture
+
+
+def build_source(capture: dict):
+    engine = capture.get("engine", "none")
+    if engine == "none":
+        return None
+    if engine == "pcap":
+        from deepflow_tpu.agent.pcap import PcapFrameSource
+        if not os.path.exists(capture["path"]):
+            # PcapFrameSource opens lazily (in the capture thread, where
+            # the error would only be swallowed) — fail at startup
+            raise OSError(f"pcap not found: {capture['path']}")
+        return PcapFrameSource(capture["path"])
+    kw = {}
+    for k in ("batch_size", "poll_ms"):
+        if k in capture:
+            kw[k] = capture[k]
+    if engine == "ring":
+        from deepflow_tpu.agent.afpacket import TpacketV3Source
+        for k in ("block_size", "block_count"):
+            if k in capture:
+                kw[k] = capture[k]
+        return TpacketV3Source(capture.get("iface"), **kw)
+    if engine == "raw":
+        from deepflow_tpu.agent.afpacket import AfPacketSource
+        if "snaplen" in capture:
+            kw["snaplen"] = capture["snaplen"]
+        return AfPacketSource(capture.get("iface"), **kw)
+    raise ValueError(f"unknown capture engine {engine!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deepflow-tpu-agent",
+        description="capture agent (managed when controller_url is set, "
+                    "standalone otherwise)")
+    ap.add_argument("-f", "--config", required=True,
+                    help="bootstrap yaml (AgentConfig keys + capture:)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="validate the bootstrap config and exit")
+    args = ap.parse_args(argv)
+
+    # handlers FIRST: everything below pays the multi-second jax import
+    # (load_bootstrap's AgentConfig pull included), and a TERM during
+    # startup must reach the clean-close path, not the default handler —
+    # k8s sends TERM whenever it feels like it
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    try:
+        cfg, capture = load_bootstrap(args.config)
+    except (OSError, ValueError, TypeError, yaml.YAMLError) as e:
+        print(f"bad bootstrap config: {e}", file=sys.stderr)
+        return 2
+    if args.dry_run:
+        print(f"config ok: controller={cfg.controller_url or 'standalone'} "
+              f"ingester={cfg.ingester_addr} "
+              f"capture={capture.get('engine', 'none')}")
+        return 0
+
+    # source BEFORE agent: a bad iface/pcap must fail through the clean
+    # config-error path, not leave a half-started agent behind
+    try:
+        source = build_source(capture)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"bad capture config: {e}", file=sys.stderr)
+        return 2
+
+    from deepflow_tpu.agent.trident import Agent
+    agent = Agent(cfg)
+    loop = None
+    agent.start()
+    if source is not None and not stop.is_set():
+        from deepflow_tpu.agent.afpacket import CaptureLoop
+        loop = CaptureLoop(source, agent, stats=agent.stats)
+        loop.start()
+    stop.wait()
+    if loop is not None:
+        loop.close()
+    agent.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,6 +236,7 @@ class CaptureLoop:
         self._thread: Optional[threading.Thread] = None
         self.batches = 0
         self.packets = 0
+        self.failed: Optional[str] = None
         if stats is not None:
             stats.register("capture", self.counters)
 
@@ -248,7 +249,18 @@ class CaptureLoop:
         import numpy as np
         errors_seen = 0
         while not self._stop.is_set():
-            frames, stamps = self.source.read_batch()
+            try:
+                frames, stamps = self.source.read_batch()
+            except Exception as e:
+                # a capture source that throws (malformed pcap, iface
+                # torn down) must not leave a zombie agent that LOOKS
+                # alive but captures nothing: record the failure where
+                # counters/DFSTATS surface it, then stop this loop
+                import logging
+                logging.getLogger(__name__).exception(
+                    "capture source failed; capture stopped")
+                self.failed = f"{type(e).__name__}: {e}"
+                return
             if not frames:
                 # if the empty batch came from a socket error (not a
                 # quiet interface), back off instead of busy-spinning
@@ -268,7 +280,8 @@ class CaptureLoop:
         self.source.close()
 
     def counters(self) -> dict:
-        c = {"batches": self.batches, "packets": self.packets}
+        c = {"batches": self.batches, "packets": self.packets,
+             "failed": self.failed or ""}
         for attr in ("frames_captured", "errors"):
             if hasattr(self.source, attr):
                 c[f"capture_{attr}" if attr == "errors" else attr] = \

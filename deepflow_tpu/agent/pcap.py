@@ -16,6 +16,7 @@ agent-side captures a stock wireshark/tcpdump can open.
 from __future__ import annotations
 
 import struct
+import time
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -135,6 +136,7 @@ class PcapFrameSource:
     def __init__(self, path: str) -> None:
         self.path = path
         self.frames_read = 0
+        self._batch_iter: Optional[Iterator] = None
 
     def batches(self, batch_size: int = 4096
                 ) -> Iterator[Tuple[List[bytes], np.ndarray]]:
@@ -156,3 +158,18 @@ class PcapFrameSource:
         for frames, stamps in self.batches(batch_size):
             valid += agent.feed(frames, stamps)
         return valid
+
+    # live capture-source contract (afpacket.CaptureLoop drives replay
+    # files exactly like an interface; empty batch = EOF, loop idles)
+    def read_batch(self) -> Tuple[List[bytes], List[int]]:
+        if self._batch_iter is None:
+            self._batch_iter = self.batches()
+        try:
+            frames, stamps = next(self._batch_iter)
+            return frames, list(stamps)
+        except StopIteration:
+            time.sleep(0.05)   # EOF: don't let CaptureLoop busy-spin
+            return [], []
+
+    def close(self) -> None:
+        self._batch_iter = None

@@ -129,6 +129,12 @@ class ControllerServer:
 
     # -- routing -----------------------------------------------------------
     def _get(self, path: str, qs: dict):
+        if path == "/v1/health":
+            # liveness/readiness for deploy probes (manifests/k8s): cheap,
+            # no model access beyond version reads
+            return {"ok": True, "is_leader": self.election.is_leader
+                    if self.election is not None else True,
+                    "model_version": self.model.version}
         if path == "/v1/vtaps":
             status = self.monitor.check()
             return [{**vars(v), "alive": f"{v.ctrl_ip}|{v.host}"

@@ -25,8 +25,22 @@ import numpy as np
 from deepflow_tpu.batch.schema import L4_SCHEMA
 
 _SRC = os.path.join(os.path.dirname(__file__), "native_src", "decoder.cc")
-_SO = os.path.join(os.path.dirname(__file__), "native_src",
-                   "_native_decoder.so")
+
+
+def _so_path() -> str:
+    """Build cache location for the compiled decoder. Default: beside
+    the source. `DEEPFLOW_TPU_NATIVE_DIR` overrides for read-only
+    installs (the docker-compose manifest bind-mounts the repo :ro and
+    points this at a writable volume — without it the compile fails
+    silently into the pure-Python fallback)."""
+    d = os.environ.get("DEEPFLOW_TPU_NATIVE_DIR")
+    if d:
+        return os.path.join(d, "_native_decoder.so")
+    return os.path.join(os.path.dirname(__file__), "native_src",
+                        "_native_decoder.so")
+
+
+_SO = _so_path()
 
 # schema columns partitioned by plane width (order preserved per plane)
 L4_COLS32 = tuple((n, d) for n, d in L4_SCHEMA.columns
@@ -44,6 +58,12 @@ def _build() -> Optional[str]:
     if os.path.exists(_SO) and \
             os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return None
+    # cache-dir creation failures degrade like every other build failure
+    # (pure-Python fallback + build_error()), never a startup crash
+    try:
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    except OSError as e:
+        return f"native cache dir: {e}"
     # -O3 -march=native -funroll-loops is load-bearing: the varint walk
     # runs ~3x faster than at generic -O2
     cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",

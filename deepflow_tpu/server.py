@@ -89,7 +89,8 @@ class Server:
             self.controller = ControllerServer(
                 self.model, self.registry, self.monitor,
                 election=self.election, tagrecorder=self.tagrecorder,
-                port=ctl_cfg.get("port", 20417))
+                port=ctl_cfg.get("port", 20417),
+                host=ctl_cfg.get("host", "127.0.0.1"))
 
         self.ingester = Ingester(IngesterConfig(
             listen_port=ing_cfg.get("port", 30033),
@@ -112,6 +113,7 @@ class Server:
             self.querier = QuerierServer(
                 self.ingester.store, self.ingester.tag_dicts,
                 port=q_cfg.get("port", 20416),
+                host=q_cfg.get("host", "127.0.0.1"),
                 tagrecorder=self.tagrecorder,
                 external_apm=q_cfg.get("external_apm", []))
 
