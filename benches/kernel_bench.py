@@ -11,9 +11,14 @@ Run:  python benches/kernel_bench.py [--batch 1048576] [--iters 20]
       [--only cms_update,hll_update]
 
 Each timed fn is jitted with donated state where the real pipelines donate,
-warmed twice, then timed over `iters` calls with a final block_until_ready —
-the same discipline as bench.py, so per-kernel numbers decompose the
-headline number honestly.
+warmed twice, then timed over `iters` calls. How the window CLOSES matters
+on the tunneled runtime: block_until_ready can ack before device execution
+drains there, inflating dispatch-bound numbers ~200x (measured 2026-07-31,
+docs/BENCH_NOTES_r3.md). Default close is block_until_ready (fine on CPU
+and local chips); pass --fetch-close on the tunneled chip to close with a
+4-byte result fetch — bench.py's kernel-phase discipline — minus a
+separately-measured fetch round-trip so the tunnel RTT doesn't ride on
+ms_per_iter.
 """
 
 from __future__ import annotations
@@ -29,6 +34,13 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=1 << 20)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--only", type=str, default="")
+    ap.add_argument("--fetch-close", action="store_true",
+                    help="close every timed window with a 4-byte result "
+                    "fetch: on the tunneled runtime block_until_ready "
+                    "can ack before execution drains, overcounting "
+                    "dispatch-bound kernels. The fetch trips the "
+                    "~15s h2d slow mode (verify skill), so use with "
+                    "--only when comparing kernels back to back.")
     args = ap.parse_args()
 
     import jax
@@ -52,17 +64,39 @@ def main() -> None:
         if args.only and name not in args.only.split(","):
             return
         step = jax.jit(fn, donate_argnums=0)
+
+        def drain(state):
+            """Wait for the device to really finish `state`."""
+            if args.fetch_close:
+                # 4-byte fetch of the first leaf: the only wait this
+                # runtime cannot ack early (bench.py close_with_fetch)
+                leaf = jax.tree_util.tree_leaves(state)[0]
+                np.asarray(jnp.ravel(leaf)[0])
+            else:
+                jax.block_until_ready(state)
+
         s = state_factory()
         for _ in range(2):
             s = step(s, *xs)
-        jax.block_until_ready(s)
+        drain(s)
+        # the closing fetch's own round-trip rides INSIDE the timed
+        # window; measure it on the already-drained state and subtract
+        # (tunnel RTT can be several ms — same order as a kernel call)
+        fetch_ms = 0.0
+        if args.fetch_close:
+            t0 = time.perf_counter()
+            drain(s)
+            fetch_ms = time.perf_counter() - t0
         t0 = time.perf_counter()
         for _ in range(args.iters):
             s = step(s, *xs)
-        jax.block_until_ready(s)
-        dt = time.perf_counter() - t0
+        drain(s)
+        dt = max(time.perf_counter() - t0 - fetch_ms, 1e-9)
         r = {"bench": name, "shape": shape, "backend": backend,
-             "ms_per_iter": round(1e3 * dt / args.iters, 3)}
+             "ms_per_iter": round(1e3 * dt / args.iters, 3),
+             "fetch_closed": bool(args.fetch_close)}
+        if args.fetch_close:
+            r["fetch_rtt_ms"] = round(1e3 * fetch_ms, 3)
         if rows is not None:
             r["rows_per_sec"] = round(rows * args.iters / dt)
         results.append(r)

@@ -16,10 +16,22 @@ VMEM budget at the default chunk=4096, width 2^16 (hi=lo=256, d=4):
 one-hots 2 x [4096, 256] bf16 = 4 MB, accumulator 1 MB, idx block
 64 KB — comfortably inside ~16 MB.
 
-Used by mxu_hist.hist(method=...) — "auto" stays on the XLA path until
-the env opt-in (DEEPFLOW_HIST_PALLAS=1) because the tunneled dev chip
-cannot currently validate kernel perf; tests pin correctness against
-the XLA path in interpret mode on CPU.
+MEASURED (real v5e chip, 2026-07-31, fetch-closed timing — see
+kernel_bench --fetch-close): the XLA scan wins. At [4, 2^20] -> 2^16:
+xla 9.9-10.2 ms vs this kernel 12.8-13.8 ms, stable across chunk
+1024-4096, bf16 vs int8 operands, per-row vs d-batched dot_general
+(chunk >= 8192 exceeds Mosaic's 16 MB scoped-vmem stack). The
+motivating premise is also dead on the numbers: the scan's HBM
+accumulator carry is ~2 MB x 64 steps ~ 0.16 ms of a ~10 ms step
+(<2%) — VMEM residency buys nothing at this shape, and Mosaic's
+lowering of the eq/broadcast one-hot construction costs ~30% over
+XLA's fused schedule at 16k-lane chunks.
+
+Kept for: (a) correctness-pinned reference of the Pallas pattern
+(tests exercise interpret mode on CPU), (b) shapes where XLA's carry
+DOES dominate (very wide histograms at small batch), via the
+DEEPFLOW_HIST_PALLAS=1 opt-in. mxu_hist.hist "auto" stays on the XLA
+path.
 """
 
 from __future__ import annotations
