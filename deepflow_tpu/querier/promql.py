@@ -13,11 +13,17 @@ parses a real expression grammar and evaluates it on a time grid:
   samples; the sketch IS a histogram, so the upstream bucket
   interpolation applies unchanged)
 - sum/avg/max/min/count/stddev/stdvar with by (...) / without (...)
-- topk/bottomk/quantile, the *_over_time family (incl. quantile and
-  stddev/stdvar), subqueries (expr[range:step]) with absolute step
-  anchoring, and elementwise math/clamp functions
-- vector○scalar and vector○vector arithmetic (+ - * /) with one-to-one
-  label matching incl. on (...) / ignoring (...)
+- topk/bottomk/quantile, the *_over_time family (incl. quantile,
+  stddev/stdvar and present), subqueries (expr[range:step]) with
+  absolute step anchoring, and elementwise math/clamp/sgn functions
+- changes/resets/deriv/predict_linear over range vectors (vectorized
+  per-window cumsum regressions)
+- vector○scalar and vector○vector arithmetic (+ - * / % ^), filter and
+  `bool` comparisons (== != > < >= <=), set ops and/or/unless — all
+  with on (...) / ignoring (...), plus group_left/group_right
+  many-to-one matching with label copy
+- label_replace/label_join, absent, sort/sort_desc, timestamp,
+  time()/scalar()/vector() scalar bridges
 
 Evaluation is columnar: every expression evaluates to a list of
 (labels, values-aligned-to-grid) pairs in one vectorized pass — an
@@ -42,10 +48,12 @@ DEFAULT_LOOKBACK_S = 300
 _UNIT_S = {"s": 1, "m": 60, "h": 3600, "d": 86400}
 
 AGG_OPS = ("sum", "avg", "max", "min", "count", "stddev", "stdvar")
-RANGE_FUNCS = ("rate", "irate", "increase", "delta")
+RANGE_FUNCS = ("rate", "irate", "increase", "delta",
+               "changes", "resets", "deriv")
 OVER_TIME_FUNCS = ("avg_over_time", "max_over_time", "min_over_time",
                    "sum_over_time", "count_over_time", "last_over_time",
-                   "stddev_over_time", "stdvar_over_time")
+                   "stddev_over_time", "stdvar_over_time",
+                   "present_over_time")
 # elementwise math over an instant vector (upstream functions.go set)
 MATH_FUNCS = {
     "abs": np.abs, "ceil": np.ceil, "floor": np.floor,
@@ -54,6 +62,7 @@ MATH_FUNCS = {
     "round": lambda v: np.floor(v + 0.5),
     "sqrt": np.sqrt, "exp": np.exp,
     "ln": np.log, "log2": np.log2, "log10": np.log10,
+    "sgn": np.sign,
 }
 CLAMP_FUNCS = ("clamp_min", "clamp_max")
 QUANTILE_OT = "quantile_over_time"
@@ -84,15 +93,25 @@ class AggExpr:
 
 @dataclass(frozen=True)
 class Bin:
-    op: str                    # + - * /
+    op: str                    # + - * / % ^, comparisons, and/or/unless
     left: "Expr"
     right: "Expr"
-    # vector-matching modifiers (one-to-one only): None = no modifier
-    # (full-label match); `on` restricts the join key to these labels
-    # (an EMPTY on() legally joins everything on the empty key),
-    # `ignoring` removes them from the key
+    # vector-matching modifiers: None = no modifier (full-label match);
+    # `on` restricts the join key to these labels (an EMPTY on() legally
+    # joins everything on the empty key), `ignoring` removes them
     match_on: Optional[Tuple[str, ...]] = None
     ignoring: bool = False
+    # comparisons: True = return 0/1 instead of filtering
+    bool_mode: bool = False
+    # many-to-one matching: "left"/"right" = group_left/group_right with
+    # the extra labels to copy from the one-side; None = one-to-one
+    group_side: Optional[str] = None
+    group_labels: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Str:
+    value: str                 # string literal (label_replace/join args)
 
 
 @dataclass(frozen=True)
@@ -110,7 +129,12 @@ class Subquery:
     offset_s: int = 0
 
 
-Expr = Union[Selector, Func, AggExpr, Bin, Num, Subquery]
+Expr = Union[Selector, Func, AggExpr, Bin, Num, Str, Subquery]
+
+COMPARE_OPS = ("==", "!=", ">", "<", ">=", "<=")
+SET_OPS = ("and", "or", "unless")
+# funcs that evaluate to a per-grid-point SCALAR (usable where Num is)
+SCALAR_FUNCS = ("time", "scalar")
 
 
 def _selectors(e: Expr) -> List[Selector]:
@@ -134,7 +158,8 @@ _TOKEN = re.compile(r"""
       | \d+(?:\.\d+)?[smhd]               # duration
       | \d+\.\d+ | \.\d+ | \d+            # number
       | [A-Za-z_:][A-Za-z0-9_:.]*         # ident
-      | =~ | !~ | != | [()\[\]{},=+*/:-]
+      | =~ | !~ | != | == | >= | <=
+      | [()\[\]{},=+*/:%^<>-]
     )""", re.VERBOSE)
 
 
@@ -205,20 +230,73 @@ class _Parser:
         self.next()
         return self._label_list(), word == "ignoring"
 
+    def _group_modifier(self):
+        """Optional group_left(...)/group_right(...) after on/ignoring —
+        many-to-one matching with labels copied from the one-side."""
+        word = (self.peek() or "").lower()
+        if word not in ("group_left", "group_right"):
+            return None, ()
+        self.next()
+        labels: Tuple[str, ...] = ()
+        if self.peek() == "(":
+            labels = self._label_list()
+        return ("left" if word == "group_left" else "right"), labels
+
+    # precedence, loosest to tightest (upstream promql):
+    #   or < and/unless < comparisons < +,- < *,/,% < ^ < atom
     def expr(self) -> Expr:
+        left = self.and_expr()
+        while (self.peek() or "").lower() == "or":
+            self.next()
+            on, ign = self._match_modifier()
+            left = Bin("or", left, self.and_expr(), on, ign)
+        return left
+
+    def and_expr(self) -> Expr:
+        left = self.cmp_expr()
+        while (self.peek() or "").lower() in ("and", "unless"):
+            op = self.next().lower()
+            on, ign = self._match_modifier()
+            left = Bin(op, left, self.cmp_expr(), on, ign)
+        return left
+
+    def cmp_expr(self) -> Expr:
+        left = self.addsub()
+        while self.peek() in COMPARE_OPS:
+            op = self.next()
+            bool_mode = False
+            if (self.peek() or "").lower() == "bool":
+                self.next()
+                bool_mode = True
+            on, ign = self._match_modifier()
+            gs, gl = self._group_modifier()
+            left = Bin(op, left, self.addsub(), on, ign, bool_mode, gs, gl)
+        return left
+
+    def addsub(self) -> Expr:
         left = self.term()
         while self.peek() in ("+", "-"):
             op = self.next()
             on, ign = self._match_modifier()
-            left = Bin(op, left, self.term(), on, ign)
+            gs, gl = self._group_modifier()
+            left = Bin(op, left, self.term(), on, ign, False, gs, gl)
         return left
 
     def term(self) -> Expr:
-        left = self.atom()
-        while self.peek() in ("*", "/"):
+        left = self.power()
+        while self.peek() in ("*", "/", "%"):
             op = self.next()
             on, ign = self._match_modifier()
-            left = Bin(op, left, self.atom(), on, ign)
+            gs, gl = self._group_modifier()
+            left = Bin(op, left, self.power(), on, ign, False, gs, gl)
+        return left
+
+    def power(self) -> Expr:
+        left = self.atom()
+        if self.peek() == "^":                 # right-associative
+            self.next()
+            on, ign = self._match_modifier()
+            return Bin("^", left, self.power(), on, ign)
         return left
 
     def atom(self) -> Expr:
@@ -240,6 +318,9 @@ class _Parser:
         if re.fullmatch(r"\d+\.\d+|\.\d+|\d+", t):
             self.next()
             return Num(float(t))
+        if t.startswith('"'):
+            self.next()
+            return Str(t[1:-1])
         ident = self.next()
         low = ident.lower()
         if low in AGG_OPS and self.peek() in ("(", "by", "without"):
@@ -295,6 +376,53 @@ class _Parser:
             if low == QUANTILE_OT:
                 self._require_ranged(arg, low)
             return self._maybe_subquery(Func(low, (phi, arg)))
+        if low == "clamp" and self.peek() == "(":
+            self.next()
+            arg = self.expr()
+            self.expect(",")
+            lo_b = self.expr()
+            self.expect(",")
+            hi_b = self.expr()
+            self.expect(")")
+            if not (isinstance(lo_b, Num) and isinstance(hi_b, Num)):
+                raise ValueError("clamp needs scalar bounds")
+            return self._maybe_subquery(Func(low, (arg, lo_b, hi_b)))
+        if low == "predict_linear" and self.peek() == "(":
+            self.next()
+            arg = self.expr()
+            self.expect(",")
+            horizon = self.expr()
+            self.expect(")")
+            if not isinstance(horizon, Num):
+                raise ValueError("predict_linear needs a scalar horizon")
+            self._require_ranged(arg, low)
+            return self._maybe_subquery(Func(low, (arg, horizon)))
+        if low in ("label_replace", "label_join") and self.peek() == "(":
+            self.next()
+            args = [self.expr()]
+            while self.accept(","):
+                args.append(self.expr())
+            self.expect(")")
+            n_str = len(args) - 1
+            if not all(isinstance(a, Str) for a in args[1:]):
+                raise ValueError(f"{low} takes string arguments after "
+                                 "the vector")
+            if low == "label_replace" and n_str != 4:
+                raise ValueError("label_replace(v, dst, replacement, "
+                                 "src, regex)")
+            if low == "label_join" and n_str < 2:
+                raise ValueError("label_join(v, dst, sep, src...)")
+            return self._maybe_subquery(Func(low, tuple(args)))
+        if low == "time" and self.peek() == "(":
+            self.next()
+            self.expect(")")
+            return Func("time", ())
+        if low in ("absent", "sort", "sort_desc", "timestamp", "scalar",
+                   "vector") and self.peek() == "(":
+            self.next()
+            arg = self.expr()
+            self.expect(")")
+            return self._maybe_subquery(Func(low, (arg,)))
         # plain selector
         return self.selector(ident)
 
@@ -461,6 +589,8 @@ class _Evaluator:
     def eval(self, e: Expr) -> SeriesList:
         if isinstance(e, Num):
             raise ValueError("scalar-only expression has no series")
+        if isinstance(e, Str):
+            raise ValueError("string literal is not a query")
         if isinstance(e, Selector):
             return self._instant(e)
         if isinstance(e, Func):
@@ -491,6 +621,38 @@ class _Evaluator:
                 fn = np.maximum if e.name == "clamp_min" else np.minimum
                 return [(_drop_name(lbl), fn(vals, bound))
                         for lbl, vals in self.eval(e.args[0])]
+            if e.name == "clamp":
+                lo_b, hi_b = e.args[1].value, e.args[2].value
+                if lo_b > hi_b:     # upstream: empty result, not a swap
+                    return []
+                return [(_drop_name(lbl), np.clip(vals, lo_b, hi_b))
+                        for lbl, vals in self.eval(e.args[0])]
+            if e.name == "predict_linear":
+                return self._linear(e.args[0],
+                                    horizon=e.args[1].value)
+            if e.name == "label_replace":
+                return self._label_replace(e)
+            if e.name == "label_join":
+                return self._label_join(e)
+            if e.name == "absent":
+                return self._absent(e.args[0])
+            if e.name in ("sort", "sort_desc"):
+                series = self.eval(e.args[0])
+                sign = -1.0 if e.name == "sort_desc" else 1.0
+                # order by the last grid point's value (upstream sorts
+                # instant vectors; NaN sinks to the end either way)
+                def sort_key(item):
+                    v = item[1][-1]
+                    return (np.isnan(v), sign * v)
+                return sorted(series, key=sort_key)
+            if e.name == "timestamp":
+                return self._timestamp(e.args[0])
+            if e.name == "vector":
+                return [({}, self._scalar(e.args[0]))]
+            if e.name in SCALAR_FUNCS:
+                raise ValueError(f"{e.name}() is scalar-valued; use it "
+                                 "inside an arithmetic expression or "
+                                 "wrap it in vector()")
             raise ValueError(f"unknown function {e.name}")
         if isinstance(e, AggExpr):
             return self._agg(e)
@@ -567,6 +729,11 @@ class _Evaluator:
         for labels, ts, vs in series:
             if name == "irate":
                 vals = self._irate(ts, vs, g, range_s)
+            elif name in ("changes", "resets"):
+                vals = self._changes(ts, vs, g, range_s,
+                                     resets=name == "resets")
+            elif name == "deriv":
+                vals = self._deriv(ts, vs, g, range_s)
             else:
                 vals = _extrapolated(
                     ts, vs, g, range_s,
@@ -577,6 +744,75 @@ class _Evaluator:
                 # label identity
                 out.append((labels, vals))
         return out
+
+    @staticmethod
+    def _changes(ts, vs, grid, range_s, resets: bool):
+        """changes()/resets(): count of value changes (or drops) between
+        consecutive samples inside each window, via one cumsum over the
+        pairwise indicators."""
+        d = np.diff(vs.astype(np.float64))
+        ind = (d < 0) if resets else (d != 0)
+        # C[i] = number of flagged pairs among samples [0..i]
+        c = np.concatenate([[0], np.cumsum(ind)])
+        lo = np.searchsorted(ts, grid - range_s, side="right")
+        hi = np.searchsorted(ts, grid, side="right")
+        ok = hi > lo
+        # pairs fully inside the window: both endpoints in [lo, hi) —
+        # clamp hi-1 up to lo so an empty/single-sample window counts 0,
+        # and everything into c's index range
+        n_c = len(c)
+        lo_c = np.minimum(lo, n_c - 1)
+        hi_c = np.minimum(np.maximum(hi - 1, lo_c), n_c - 1)
+        cnt = c[hi_c] - c[lo_c]
+        return np.where(ok, cnt.astype(np.float64), np.nan)
+
+    def _deriv(self, ts, vs, grid, range_s):
+        slope, _ = self._regress(ts, vs, grid, range_s)
+        return slope
+
+    def _linear(self, node, horizon: float) -> SeriesList:
+        """predict_linear(v[r], t): least-squares value t seconds past
+        each grid point."""
+        offset = node.offset_s if isinstance(node, Selector) else 0
+        g = self.grid - offset
+        series, range_s = self._range_samples(node, g)
+        out: SeriesList = []
+        for labels, ts, vs in series:
+            slope, at_grid = self._regress(ts, vs, g, range_s)
+            vals = at_grid + slope * horizon
+            if not np.isnan(vals).all():
+                out.append((_drop_name(labels), vals))
+        return out
+
+    @staticmethod
+    def _regress(ts, vs, grid, range_s):
+        """Per-window least squares, vectorized with window cumsums.
+        Returns (slope per grid point, regression value AT the grid
+        point — upstream's intercept perspective). Timestamps are
+        rebased to the series start so the t^2 sums keep precision."""
+        t0 = ts[0] if len(ts) else 0
+        t = (ts - t0).astype(np.float64)
+        v = vs.astype(np.float64)
+        cs = lambda x: np.concatenate([[0.0], np.cumsum(x)])  # noqa: E731
+        St, Sv, Stt, Stv = cs(t), cs(v), cs(t * t), cs(t * v)
+        lo = np.searchsorted(ts, grid - range_s, side="right")
+        hi = np.searchsorted(ts, grid, side="right")
+        n = (hi - lo).astype(np.float64)
+        ok = n >= 2
+        sum_t = St[hi] - St[lo]
+        sum_v = Sv[hi] - Sv[lo]
+        sum_tt = Stt[hi] - Stt[lo]
+        sum_tv = Stv[hi] - Stv[lo]
+        denom = n * sum_tt - sum_t * sum_t
+        with np.errstate(divide="ignore", invalid="ignore"):
+            slope = (n * sum_tv - sum_t * sum_v) / denom
+            mean_t = sum_t / np.maximum(n, 1)
+            mean_v = sum_v / np.maximum(n, 1)
+            g_rel = (grid - t0).astype(np.float64)
+            at_grid = mean_v + slope * (g_rel - mean_t)
+        ok &= np.abs(denom) > 1e-9
+        return (np.where(ok, slope, np.nan),
+                np.where(ok, at_grid, np.nan))
 
     def _over_time(self, name: str, node) -> SeriesList:
         """avg/max/min/sum/count/last _over_time: aggregate the raw
@@ -620,6 +856,8 @@ class _Evaluator:
                         w = vs[lo[i]:hi[i]]
                         res[i] = np.var(w) if name == "stdvar_over_time" \
                             else np.std(w)
+            elif name == "present_over_time":
+                res = np.ones(len(g))     # any sample in window -> 1
             elif name == "last_over_time":
                 res = vs[np.maximum(hi - 1, 0)]
             else:
@@ -671,6 +909,109 @@ class _Evaluator:
             if not np.isnan(vals).all():
                 out.append((_drop_name(labels), vals))
         return out
+
+    # -- label rewriting / presence / scalar bridges -----------------------
+    def _label_replace(self, e: Func) -> SeriesList:
+        dst, repl, src, regex = (a.value for a in e.args[1:])
+        if not re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", dst):
+            raise ValueError(f"label_replace: bad destination {dst!r}")
+        pat = re.compile(regex)
+        out: SeriesList = []
+        for labels, vals in self.eval(e.args[0]):
+            m = pat.fullmatch(labels.get(src, ""))   # upstream anchors
+            if m:
+                # $1 group refs -> python backrefs
+                new = m.expand(re.sub(r"\$(\d+)", r"\\\1", repl))
+                labels = dict(labels)
+                if new:
+                    labels[dst] = new
+                else:
+                    labels.pop(dst, None)     # empty value drops label
+            out.append((labels, vals))
+        return out
+
+    def _label_join(self, e: Func) -> SeriesList:
+        dst, sep = e.args[1].value, e.args[2].value
+        srcs = [a.value for a in e.args[3:]]
+        out: SeriesList = []
+        for labels, vals in self.eval(e.args[0]):
+            labels = dict(labels)
+            new = sep.join(labels.get(s, "") for s in srcs)
+            if new:
+                labels[dst] = new
+            else:
+                labels.pop(dst, None)
+            out.append((labels, vals))
+        return out
+
+    def _absent(self, arg) -> SeriesList:
+        """absent(v): 1 at grid points where v has NO series value.
+        Labels derive from the selector's equality matchers (upstream),
+        so `absent(up{job="api"})` alerts carry job="api"."""
+        series = self.eval(arg)
+        if series:
+            stack = np.vstack([v for _, v in series])
+            present = (~np.isnan(stack)).any(axis=0)
+        else:
+            present = np.zeros(len(self.grid), bool)
+        vals = np.where(present, np.nan, 1.0)
+        if np.isnan(vals).all():
+            return []
+        labels = {}
+        if isinstance(arg, Selector):
+            labels = {n: v for n, op, v in arg.matchers if op == "="}
+        return [(labels, vals)]
+
+    def _timestamp(self, arg) -> SeriesList:
+        """timestamp(v): the evaluation-window sample's own timestamp
+        per grid point (selector args only — the one function that
+        needs raw sample times after instant lookup)."""
+        if not isinstance(arg, Selector) or arg.range_s is not None:
+            raise ValueError("timestamp() takes an instant selector")
+        g = self.grid - arg.offset_s
+        lo = int(g.min()) - DEFAULT_LOOKBACK_S
+        hi = int(g.max()) + 1
+        out: SeriesList = []
+        for labels, ts, vs in self._fetch(arg, lo, hi):
+            idx = np.searchsorted(ts, g, side="right") - 1
+            valid = idx >= 0
+            stamp = ts[np.maximum(idx, 0)]
+            valid &= (g - stamp) <= DEFAULT_LOOKBACK_S
+            vals = np.where(valid, stamp.astype(np.float64), np.nan)
+            if not np.isnan(vals).all():
+                out.append((_drop_name(labels), vals))
+        return out
+
+    def _scalar(self, e: Expr) -> np.ndarray:
+        """Per-grid-point scalar value of a scalar-valued expression."""
+        if isinstance(e, Num):
+            return np.full(len(self.grid), e.value)
+        if isinstance(e, Func) and e.name == "time":
+            return self.grid.astype(np.float64)
+        if isinstance(e, Func) and e.name == "scalar":
+            series = self.eval(e.args[0])
+            if len(series) == 1:
+                return series[0][1].astype(np.float64)
+            return np.full(len(self.grid), np.nan)  # upstream semantics
+        if isinstance(e, Bin):
+            a, b = self._scalar(e.left), self._scalar(e.right)
+            if e.op in COMPARE_OPS:
+                # scalar comparisons are always bool-valued upstream
+                return _compare(e.op, a, b).astype(np.float64)
+            return _arith(e.op, a, b)
+        raise ValueError(f"not a scalar expression: {e!r}")
+
+    @staticmethod
+    def _is_scalar(e: Expr) -> bool:
+        if isinstance(e, Num):
+            return True
+        if isinstance(e, Func) and e.name in SCALAR_FUNCS:
+            return True
+        if isinstance(e, Bin) and e.op not in SET_OPS:
+            # scalar○scalar arithmetic/comparison is scalar (1^2, etc.)
+            return (_Evaluator._is_scalar(e.left)
+                    and _Evaluator._is_scalar(e.right))
+        return False
 
     # -- histogram_quantile ------------------------------------------------
     @staticmethod
@@ -804,35 +1145,45 @@ class _Evaluator:
 
     # -- binary ops --------------------------------------------------------
     def _bin(self, e: Bin) -> SeriesList:
-        lnum = isinstance(e.left, Num)
-        rnum = isinstance(e.right, Num)
-        if lnum and rnum:
+        if e.op in SET_OPS:
+            return self._set_op(e)
+        lsc = self._is_scalar(e.left)
+        rsc = self._is_scalar(e.right)
+        if lsc and rsc:
             raise ValueError("scalar-only expression has no series")
-        if lnum or rnum:
+        is_cmp = e.op in COMPARE_OPS
+        if lsc or rsc:
             if e.match_on is not None:
                 raise ValueError("vector matching (on/ignoring) only "
                                  "applies between instant vectors")
-            series = self.eval(e.right if lnum else e.left)
-            c = (e.left if lnum else e.right).value
+            series = self.eval(e.right if lsc else e.left)
+            c = self._scalar(e.left if lsc else e.right)
             out = []
             for labels, vals in series:
-                a, b = (c, vals) if lnum else (vals, c)
-                out.append((_drop_name(labels), _arith(e.op, a, b)))
+                a, b = (c, vals) if lsc else (vals, c)
+                if is_cmp:
+                    hit = _compare(e.op, a, b)
+                    if e.bool_mode:
+                        v = np.where(np.isnan(vals), np.nan,
+                                     hit.astype(np.float64))
+                        out.append((_drop_name(labels), v))
+                    else:
+                        # filter: keep the VECTOR side's value (upstream
+                        # keeps labels incl. the metric name)
+                        v = np.where(hit, vals, np.nan)
+                        if not np.isnan(v).all():
+                            out.append((labels, v))
+                else:
+                    out.append((_drop_name(labels), _arith(e.op, a, b)))
             return out
         left = self.eval(e.left)
         right = self.eval(e.right)
 
         def match_key(labels: Dict[str, str]) -> Tuple:
-            kept = _drop_name(labels)
-            if e.match_on is not None and not e.ignoring:
-                # upstream keeps only the on-labels PRESENT on the
-                # series — never fabricates empty-valued entries (they
-                # would leak into legends and outer groupings)
-                kept = {k: kept[k] for k in e.match_on if k in kept}
-            elif e.match_on is not None:
-                kept = {k: v for k, v in kept.items()
-                        if k not in e.match_on}
-            return tuple(sorted(kept.items()))
+            return _match_key(labels, e.match_on, e.ignoring)
+
+        if e.group_side is not None:
+            return self._bin_grouped(e, left, right, match_key)
 
         # one-to-one vector match (full label set minus __name__ by
         # default; on()/ignoring() restrict the key)
@@ -841,7 +1192,8 @@ class _Evaluator:
             key = match_key(labels)
             if key in rmap:
                 raise ValueError("many-to-many vector match (use a "
-                                 "narrower on()/ignoring() set)")
+                                 "narrower on()/ignoring() set or "
+                                 "group_left/group_right)")
             rmap[key] = vals
         out: SeriesList = []
         matched_left = set()
@@ -854,14 +1206,130 @@ class _Evaluator:
                 # only ACTUAL duplicate matches are errors, like
                 # upstream's matchedSigs tracking
                 raise ValueError("many-to-one vector match on the left "
-                                 "side (group_left is unsupported)")
+                                 "side (add group_left)")
             matched_left.add(key)
-            out.append((dict(key), _arith(e.op, vals, other)))
+            if is_cmp:
+                hit = _compare(e.op, vals, other)
+                if e.bool_mode:
+                    out.append((dict(key),
+                                np.where(np.isnan(vals) | np.isnan(other),
+                                         np.nan, hit.astype(np.float64))))
+                else:
+                    v = np.where(hit, vals, np.nan)
+                    if not np.isnan(v).all():
+                        out.append((dict(labels), v))
+            else:
+                out.append((dict(key), _arith(e.op, vals, other)))
+        return out
+
+    def _bin_grouped(self, e: Bin, left, right, match_key) -> SeriesList:
+        """group_left/group_right many-to-one: the one-side must be
+        unique per key; many-side labels survive, plus any
+        group-modifier labels copied from the one-side."""
+        many, one = (left, right) if e.group_side == "left" \
+            else (right, left)
+        one_map: Dict[Tuple, Tuple[Dict[str, str], np.ndarray]] = {}
+        for labels, vals in one:
+            key = match_key(labels)
+            if key in one_map:
+                raise ValueError("group_left/group_right: the one-side "
+                                 "has duplicate match keys")
+            one_map[key] = (labels, vals)
+        is_cmp = e.op in COMPARE_OPS
+        out: SeriesList = []
+        for labels, vals in many:
+            got = one_map.get(match_key(labels))
+            if got is None:
+                continue
+            o_labels, o_vals = got
+            a, b = (vals, o_vals) if e.group_side == "left" \
+                else (o_vals, vals)
+            shown = _drop_name(labels)
+            for gl in e.group_labels:
+                if gl in o_labels:
+                    shown[gl] = o_labels[gl]
+            if is_cmp:
+                hit = _compare(e.op, a, b)
+                if e.bool_mode:
+                    out.append((shown,
+                                np.where(np.isnan(a) | np.isnan(b),
+                                         np.nan, hit.astype(np.float64))))
+                else:
+                    v = np.where(hit, vals, np.nan)
+                    if not np.isnan(v).all():
+                        # filter mode keeps the many-side labels (incl.
+                        # __name__) PLUS the copied group labels
+                        full = dict(labels)
+                        for gl in e.group_labels:
+                            if gl in o_labels:
+                                full[gl] = o_labels[gl]
+                        out.append((full, v))
+            else:
+                out.append((shown, _arith(e.op, a, b)))
+        return out
+
+    def _set_op(self, e: Bin) -> SeriesList:
+        left = self.eval(e.left)
+        right = self.eval(e.right)
+
+        def key_of(labels: Dict[str, str]) -> Tuple:
+            return _match_key(labels, e.match_on, e.ignoring)
+
+        # per-grid-point presence on the right, unioned by key
+        rpresent: Dict[Tuple, np.ndarray] = {}
+        for labels, vals in right:
+            k = key_of(labels)
+            p = ~np.isnan(vals)
+            rpresent[k] = rpresent[k] | p if k in rpresent else p
+        out: SeriesList = []
+        if e.op in ("and", "unless"):
+            for labels, vals in left:
+                p = rpresent.get(key_of(labels))
+                if e.op == "and":
+                    keep = p if p is not None else \
+                        np.zeros(len(vals), bool)
+                else:
+                    keep = ~p if p is not None else \
+                        np.ones(len(vals), bool)
+                v = np.where(keep, vals, np.nan)
+                if not np.isnan(v).all():
+                    out.append((labels, v))
+            return out
+        # or: all left series, plus right series at points where no
+        # left series with the same key is present
+        lpresent: Dict[Tuple, np.ndarray] = {}
+        for labels, vals in left:
+            k = key_of(labels)
+            p = ~np.isnan(vals)
+            lpresent[k] = lpresent[k] | p if k in lpresent else p
+            out.append((labels, vals))
+        for labels, vals in right:
+            p = lpresent.get(key_of(labels))
+            v = vals if p is None else np.where(p, np.nan, vals)
+            if not np.isnan(v).all():
+                out.append((labels, v))
         return out
 
 
 def _drop_name(labels: Dict[str, str]) -> Dict[str, str]:
     return {k: v for k, v in labels.items() if k != "__name__"}
+
+
+def _keeps_name(expr: Expr) -> bool:
+    """Does the top-level expression preserve the metric name? Plain
+    selectors do; so do filter-mode comparisons, set ops, and the
+    label/ordering functions that pass series through unchanged
+    (upstream: only value-transforming expressions drop __name__)."""
+    if isinstance(expr, Selector):
+        return True
+    if isinstance(expr, Bin):
+        if expr.op in SET_OPS:
+            return _keeps_name(expr.left)
+        return expr.op in COMPARE_OPS and not expr.bool_mode
+    if isinstance(expr, Func) and expr.name in (
+            "sort", "sort_desc", "label_replace", "label_join"):
+        return _keeps_name(expr.args[0])
+    return False
 
 
 def _arith(op: str, a, b):
@@ -872,8 +1340,46 @@ def _arith(op: str, a, b):
     if op == "*":
         return a * b
     with np.errstate(divide="ignore", invalid="ignore"):
-        r = np.asarray(a, np.float64) / np.asarray(b, np.float64)
-    return r
+        if op == "%":
+            # upstream uses Go math.Mod: result takes the DIVIDEND's
+            # sign; np.mod takes the divisor's
+            return np.fmod(np.asarray(a, np.float64),
+                           np.asarray(b, np.float64))
+        if op == "^":
+            return np.power(np.asarray(a, np.float64),
+                            np.asarray(b, np.float64))
+        if op == "/":
+            return np.asarray(a, np.float64) / np.asarray(b, np.float64)
+    # never fall through (a set op reaching here would silently divide)
+    raise ValueError(f"not an arithmetic operator: {op!r}")
+
+
+def _match_key(labels: Dict[str, str], match_on, ignoring: bool) -> Tuple:
+    """Vector-matching key: full label set minus __name__ by default;
+    on() keeps only the on-labels PRESENT on the series (never
+    fabricates empty-valued entries — they would leak into legends and
+    outer groupings); ignoring() strips its labels."""
+    kept = _drop_name(labels)
+    if match_on is not None and not ignoring:
+        kept = {k: kept[k] for k in match_on if k in kept}
+    elif match_on is not None:
+        kept = {k: v for k, v in kept.items() if k not in match_on}
+    return tuple(sorted(kept.items()))
+
+
+def _compare(op: str, a, b) -> np.ndarray:
+    with np.errstate(invalid="ignore"):
+        if op == "==":
+            return np.asarray(a) == np.asarray(b)
+        if op == "!=":
+            return np.asarray(a) != np.asarray(b)
+        if op == ">":
+            return np.asarray(a) > np.asarray(b)
+        if op == "<":
+            return np.asarray(a) < np.asarray(b)
+        if op == ">=":
+            return np.asarray(a) >= np.asarray(b)
+        return np.asarray(a) <= np.asarray(b)
 
 
 # -- engine ----------------------------------------------------------------
@@ -933,13 +1439,14 @@ class PromEngine:
         grid = np.asarray([at], np.int64)
         series = _Evaluator(self, grid).eval(expr)
         out = []
-        keep_name = isinstance(expr, Selector)
         for labels, vals in series:
             if np.isnan(vals[0]):
                 continue
-            shown = labels if keep_name else _drop_name(labels)
+            shown = labels if _keeps_name(expr) else _drop_name(labels)
             out.append({"metric": shown,
                         "value": [at, str(float(vals[0]))]})
+        if isinstance(expr, Func) and expr.name in ("sort", "sort_desc"):
+            return out      # the function's ordering IS the result
         return sorted(out, key=lambda r: str(r["metric"]))
 
     def query_range(self, promql: str, start: int, end: int,
@@ -954,10 +1461,9 @@ class PromEngine:
         expr = parse_promql(promql)
         grid = np.arange(start, end + 1, step, dtype=np.int64)
         series = _Evaluator(self, grid).eval(expr)
-        keep_name = isinstance(expr, Selector)
         result = []
         for labels, vals in sorted(series, key=lambda r: str(r[0])):
-            shown = labels if keep_name else _drop_name(labels)
+            shown = labels if _keeps_name(expr) else _drop_name(labels)
             values = [[int(g), str(float(v))]
                       for g, v in zip(grid, vals) if not np.isnan(v)]
             if values:

@@ -1278,3 +1278,183 @@ def test_promql_matching_edge_semantics(prom):
     # scalar operands reject matching modifiers loudly
     with pytest.raises(ValueError, match="instant vectors"):
         eng.query('1 + on (job) rps', at=1090)
+
+
+# -- round-3b PromQL surface: comparisons, set ops, function library ------
+def test_promql_comparison_filter_and_bool(prom):
+    eng, _, _ = prom
+    # filter: only series whose value passes survive, value unchanged
+    out = eng.query('rps > 50', at=1100)
+    assert len(out) == 1
+    assert out[0]["metric"]["job"] == "web"
+    assert float(out[0]["value"][1]) == 109.0
+    # filter keeps the metric name upstream
+    assert out[0]["metric"].get("__name__") == "rps"
+    # bool: every series yields 0/1 and drops the name
+    out = eng.query('rps > bool 50', at=1100)
+    got = {r["metric"]["job"]: float(r["value"][1]) for r in out}
+    assert got == {"api": 0.0, "web": 1.0}
+    # vector-vector comparison with bool
+    out = eng.query('rps == bool rps', at=1100)
+    assert sorted(float(r["value"][1]) for r in out) == [1.0, 1.0]
+    # <= and != round out the operator set
+    out = eng.query('rps <= 19', at=1100)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "api"
+    out = eng.query('rps != bool 19', at=1100)
+    got = {r["metric"]["job"]: float(r["value"][1]) for r in out}
+    assert got == {"api": 0.0, "web": 1.0}
+
+
+def test_promql_set_ops(prom):
+    eng, _, _ = prom
+    out = eng.query('rps and rps{job="api"}', at=1100)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "api"
+    out = eng.query('rps unless rps{job="api"}', at=1100)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "web"
+    out = eng.query('rps{job="api"} or rps', at=1100)
+    got = {r["metric"]["job"]: float(r["value"][1]) for r in out}
+    assert got == {"api": 19.0, "web": 109.0}
+    # on() restricting the set-op key
+    out = eng.query('rps and on (job) rps{job="web"}', at=1100)
+    assert len(out) == 1 and out[0]["metric"]["job"] == "web"
+
+
+def test_promql_mod_pow_arith(prom):
+    eng, _, _ = prom
+    out = eng.query('rps{job="api"} % 4', at=1100)
+    assert float(out[0]["value"][1]) == 3.0              # 19 % 4
+    out = eng.query('rps{job="api"} ^ 2', at=1100)
+    assert float(out[0]["value"][1]) == 361.0
+    # ^ is right-associative: 2^(3^2) would be 512 on scalars; probe
+    # via a vector: v ^ 1 ^ 2 = v ^ (1^2) = v
+    out = eng.query('rps{job="api"} ^ 1 ^ 2', at=1100)
+    assert float(out[0]["value"][1]) == 19.0
+    # fmod semantics: dividend sign (Go math.Mod), not python %
+    out = eng.query('(0 - rps{job="api"}) % 4', at=1100)
+    assert float(out[0]["value"][1]) == -3.0
+
+
+def test_promql_scalar_bridges(prom):
+    eng, _, _ = prom
+    out = eng.query('rps{job="api"} - time()', at=1100)
+    assert float(out[0]["value"][1]) == 19.0 - 1100.0
+    out = eng.query('rps{job="web"} - scalar(rps{job="api"})', at=1100)
+    assert float(out[0]["value"][1]) == 90.0
+    # scalar() of a 2-series vector is NaN -> empty result
+    assert eng.query('rps{job="web"} - scalar(rps)', at=1100) == []
+    # vector(): scalar into an empty-labeled series
+    out = eng.query('vector(7)', at=1100)
+    assert out[0]["metric"] == {} and float(out[0]["value"][1]) == 7.0
+
+
+def test_promql_absent_and_present(prom):
+    eng, _, _ = prom
+    out = eng.query('absent(rps{job="nope"})', at=1100)
+    assert len(out) == 1
+    assert float(out[0]["value"][1]) == 1.0
+    # labels derive from the equality matchers
+    assert out[0]["metric"] == {"job": "nope"}
+    assert eng.query('absent(rps{job="api"})', at=1100) == []
+    out = eng.query('present_over_time(rps{job="api"}[1m])', at=1100)
+    assert float(out[0]["value"][1]) == 1.0
+
+
+def test_promql_changes_resets_deriv_predict(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("wig")
+    lh = dicts.get("label_set").encode_one("job=w")
+    ts = np.array([1000, 1010, 1020, 1030, 1040], np.uint32)
+    vs = np.array([10.0, 30.0, 30.0, 3.0, 13.0], np.float32)
+    t.append({"timestamp": ts, "metric": np.full(5, mh, np.uint32),
+              "labels": np.full(5, lh, np.uint32),
+              "value": vs})
+    # the (t-range, t] window is LEFT-OPEN (modern upstream): the
+    # sample AT 1000 is excluded, so in-window values are 30,30,3,13
+    out = eng.query('changes(wig[40s])', at=1040)
+    assert float(out[0]["value"][1]) == 2.0     # 30->3, 3->13
+    out = eng.query('resets(wig[40s])', at=1040)
+    assert float(out[0]["value"][1]) == 1.0     # only 30->3
+    # rps{job=api} climbs exactly 0.1/s
+    out = eng.query('deriv(rps{job="api"}[1m])', at=1100)
+    assert float(out[0]["value"][1]) == pytest.approx(0.1)
+    out = eng.query('predict_linear(rps{job="api"}[1m], 60)', at=1100)
+    # the fitted line v(t) = 0.1*(t-1000) + 10 evaluates to 20 AT the
+    # grid point 1100 (upstream's intercept perspective), +60s*0.1 = 26
+    assert float(out[0]["value"][1]) == pytest.approx(26.0)
+
+
+def test_promql_label_functions(prom):
+    eng, _, _ = prom
+    out = eng.query(
+        'label_replace(rps, "env", "x-$1", "job", "(a.*)")', at=1100)
+    envs = {r["metric"]["job"]: r["metric"].get("env") for r in out}
+    assert envs == {"api": "x-api", "web": None}   # web: regex no match
+    out = eng.query(
+        'label_join(rps, "combo", "-", "job", "job")', at=1100)
+    combos = sorted(r["metric"]["combo"] for r in out)
+    assert combos == ["api-api", "web-web"]
+
+
+def test_promql_sort_and_timestamp(prom):
+    eng, _, _ = prom
+    out = eng.query('sort(rps)', at=1100)
+    assert [r["metric"]["job"] for r in out] == ["api", "web"]
+    out = eng.query('sort_desc(rps)', at=1100)
+    assert [r["metric"]["job"] for r in out] == ["web", "api"]
+    out = eng.query('timestamp(rps{job="api"})', at=1100)
+    assert float(out[0]["value"][1]) == 1090.0  # last sample's own ts
+    out = eng.query('sgn(rps{job="api"} - 100)', at=1100)
+    assert float(out[0]["value"][1]) == -1.0
+    out = eng.query('clamp(rps, 20, 105)', at=1100)
+    got = sorted(float(r["value"][1]) for r in out)
+    assert got == [20.0, 105.0]
+    # upstream: min > max yields empty, not a swap
+    assert eng.query('clamp(rps, 105, 20)', at=1100) == []
+
+
+def test_promql_group_left(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("build_info")
+    lh = dicts.get("label_set").encode_one("job=api,ver=2.1")
+    t.append({"timestamp": np.array([1090], np.uint32),
+              "metric": np.array([mh], np.uint32),
+              "labels": np.array([lh], np.uint32),
+              "value": np.array([1.0], np.float32)})
+    # many-to-one: both rps series could match a shared key; with
+    # on(job) only api joins, and group_left(ver) copies the version
+    out = eng.query('rps * on (job) group_left (ver) build_info',
+                    at=1100)
+    assert len(out) == 1
+    assert out[0]["metric"]["job"] == "api"
+    assert out[0]["metric"]["ver"] == "2.1"
+    assert float(out[0]["value"][1]) == 19.0
+    # group_right mirrors: one-side on the left
+    out = eng.query('build_info * on (job) group_right (ver) rps',
+                    at=1100)
+    assert len(out) == 1 and float(out[0]["value"][1]) == 19.0
+
+
+def test_promql_group_left_filter_keeps_group_labels(prom):
+    eng, store, dicts = prom
+    t = store.table("ext_metrics", "ext_samples")
+    mh = dicts.get("metric_name").encode_one("gi")
+    lh = dicts.get("label_set").encode_one("job=api,ver=9")
+    t.append({"timestamp": np.array([1090], np.uint32),
+              "metric": np.array([mh], np.uint32),
+              "labels": np.array([lh], np.uint32),
+              "value": np.array([1.0], np.float32)})
+    # filter-mode comparison with group_left still copies the group
+    # labels (upstream resultMetric semantics)
+    out = eng.query('rps > on (job) group_left (ver) gi', at=1100)
+    assert len(out) == 1
+    assert out[0]["metric"]["ver"] == "9"
+    assert out[0]["metric"].get("__name__") == "rps"
+
+
+def test_promql_set_op_on_scalars_is_loud(prom):
+    eng, _, _ = prom
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        eng.query('vector(1 and 2)', at=1100)
