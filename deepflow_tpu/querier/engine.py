@@ -12,9 +12,10 @@ flow_tag dict tables, engine/clickhouse/tag/translation.go).
 from __future__ import annotations
 
 import dataclasses
+import re
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -195,7 +196,7 @@ class QueryEngine:
         for it in stmt.items:
             needed |= Q.expr_columns(it.expr)
         for c in stmt.where:
-            needed.add(c.column)
+            needed |= _where_columns(c)
         if bucket is not None:
             needed.add(schema.time_column)
         if not needed:
@@ -205,6 +206,29 @@ class QueryEngine:
 
         time_range, residual = self._time_bounds(stmt.where,
                                                  schema.time_column)
+        # PerSecond(): resolve IntervalRef to concrete seconds — the
+        # bucket width under interval grouping, else the WHERE span
+        if any(_has_interval_ref(it.expr) for it in stmt.items):
+            # BOTH bounds must be explicit: _time_bounds fills a missing
+            # lower bound with 0, and dividing by an epoch-sized span
+            # would silently collapse every rate to ~0
+            has_lo = any(isinstance(c, Q.Cond) and c.column ==
+                         schema.time_column and c.op in (">", ">=")
+                         for c in stmt.where)
+            if bucket is not None:
+                iv = bucket.seconds
+            elif time_range is not None and has_lo \
+                    and time_range[1] < (1 << 62):
+                iv = max(time_range[1] - time_range[0], 1)
+            else:
+                raise ValueError(
+                    "PerSecond() needs GROUP BY time(N) or a WHERE "
+                    "time range bounded on both sides to define the "
+                    "interval")
+            stmt = dataclasses.replace(stmt, items=[
+                Q.SelectItem(_resolve_interval(it.expr, iv),
+                             it.alias or _expr_name(it.expr))
+                for it in stmt.items])
         cols = table.scan(columns=sorted(needed), time_range=time_range)
         mask = self._filter_mask(cols, residual)
         if mask is not None:
@@ -317,11 +341,16 @@ class QueryEngine:
         import operator
         ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
                "<=": operator.le, ">": operator.gt, ">=": operator.ge}
-        if c.op == "in":
+        if c.op in ("in", "not_in"):
             hits = [self._cond_value(c.column, x) for x in c.value]
             flat = {y for x in hits if x is not None
                     for y in (x if isinstance(x, list) else [x])}
+            if c.op == "not_in":
+                return lambda v: v not in flat
             return lambda v: v in flat
+        if c.op in ("like", "not_like", "regexp"):
+            raise ValueError(f"{c.op} is a WHERE operator; HAVING "
+                             "compares aggregated values")
         raw = self._cond_value(c.column, c.value)
         if raw is None:              # unknown dictionary string
             return lambda v, ok=(c.op == "!="): ok
@@ -337,13 +366,17 @@ class QueryEngine:
         return lambda v, op=ops[c.op], t=raw: op(v, t)
 
     # -- where -------------------------------------------------------------
-    def _time_bounds(self, conds: List[Q.Cond], tcol: str):
+    def _time_bounds(self, conds, tcol: str):
         """Split WHERE into a [lo,hi) range on the time column (for
-        partition pruning) + residual vectorized conditions."""
+        partition pruning) + residual vectorized conditions. Only
+        TOP-LEVEL conjuncts prune; OR/NOT subtrees stay residual (a
+        time bound inside `a OR b` does not bound the whole scan)."""
         lo, hi = None, None
         residual = []
         for c in conds:
-            if c.column == tcol and c.op in (">", ">=", "<", "<="):
+            if not isinstance(c, Q.Cond):
+                residual.append(c)
+            elif c.column == tcol and c.op in (">", ">=", "<", "<="):
                 v = int(c.value)
                 if c.op == ">":
                     lo = max(lo or 0, v + 1)
@@ -386,41 +419,97 @@ class QueryEngine:
         return value
 
     def _filter_mask(self, cols: Dict[str, np.ndarray],
-                     conds: List[Q.Cond]) -> Optional[np.ndarray]:
+                     conds) -> Optional[np.ndarray]:
         if not conds:
             return None
         mask = None
         for c in conds:
-            col = cols[c.column]
-            if c.op == "in":
-                vals = []
-                for x in c.value:
-                    v = self._cond_value(c.column, x)
-                    if v is None:
-                        continue
-                    # a duplicate resource name maps to several ids
-                    vals.extend(v if isinstance(v, list) else [v])
-                m = np.isin(col, np.asarray(vals, dtype=col.dtype)) if vals \
-                    else np.zeros(len(col), np.bool_)
-            else:
-                raw = self._cond_value(c.column, c.value)
-                if raw is None:  # unknown dictionary string
-                    m = np.full(len(col), c.op == "!=")
-                elif isinstance(raw, list):
-                    # a resource name shared by several ids: = widens to
-                    # membership, != to non-membership
-                    if c.op not in ("=", "!="):
-                        raise ValueError(
-                            f"ordering comparison with name "
-                            f"{c.value!r} matching {len(raw)} resources")
-                    member = np.isin(col, np.asarray(raw, dtype=col.dtype))
-                    m = member if c.op == "=" else ~member
-                else:
-                    v = np.asarray(raw).astype(col.dtype)
-                    m = {"=": col == v, "!=": col != v, "<": col < v,
-                         "<=": col <= v, ">": col > v, ">=": col >= v}[c.op]
+            m = self._node_mask(cols, c)
             mask = m if mask is None else (mask & m)
         return mask
+
+    def _node_mask(self, cols, node) -> np.ndarray:
+        """One WHERE tree node -> boolean row mask."""
+        if isinstance(node, Q.BoolOp):
+            if node.op == "not":
+                return ~self._node_mask(cols, node.children[0])
+            parts = [self._node_mask(cols, ch) for ch in node.children]
+            out = parts[0]
+            for p in parts[1:]:
+                out = (out & p) if node.op == "and" else (out | p)
+            return out
+        c = node
+        col = cols[c.column]
+        if c.op in ("in", "not_in"):
+            vals = []
+            for x in c.value:
+                v = self._cond_value(c.column, x)
+                if v is None:
+                    continue
+                # a duplicate resource name maps to several ids
+                vals.extend(v if isinstance(v, list) else [v])
+            m = np.isin(col, np.asarray(vals, dtype=col.dtype)) if vals \
+                else np.zeros(len(col), np.bool_)
+            return ~m if c.op == "not_in" else m
+        if c.op in ("like", "not_like", "regexp"):
+            ids = self._pattern_ids(c.column, c.op, c.value)
+            m = np.isin(col, np.asarray(sorted(ids),
+                                        dtype=col.dtype)) if ids \
+                else np.zeros(len(col), np.bool_)
+            return ~m if c.op == "not_like" else m
+        raw = self._cond_value(c.column, c.value)
+        if raw is None:  # unknown dictionary string
+            return np.full(len(col), c.op == "!=")
+        if isinstance(raw, list):
+            # a resource name shared by several ids: = widens to
+            # membership, != to non-membership
+            if c.op not in ("=", "!="):
+                raise ValueError(
+                    f"ordering comparison with name "
+                    f"{c.value!r} matching {len(raw)} resources")
+            member = np.isin(col, np.asarray(raw, dtype=col.dtype))
+            return member if c.op == "=" else ~member
+        v = np.asarray(raw).astype(col.dtype)
+        return {"=": col == v, "!=": col != v, "<": col < v,
+                "<=": col <= v, ">": col > v, ">=": col >= v}[c.op]
+
+    def _pattern_ids(self, column: str, op: str, pattern: str):
+        """LIKE/REGEXP on a dictionary-backed column: enumerate the
+        column's dictionary (tag dicts or tagrecorder names), match the
+        pattern against the STRINGS, return the matching ids — the
+        reference lowers LIKE on auto-tags to dictGet the same way."""
+        if op in ("like", "not_like"):
+            # SQL wildcards -> anchored regex (% = any run, _ = one)
+            rx = re.compile("".join(
+                ".*" if ch == "%" else "." if ch == "_"
+                else re.escape(ch) for ch in pattern))
+            match = rx.fullmatch
+        else:
+            # REGEXP is an unanchored SEARCH (ClickHouse match(), the
+            # reference's lowering) — fullmatch would make 'api' match
+            # nothing
+            match = re.compile(pattern).search
+        ids = set()
+        dict_names = DICT_COLUMNS.get(column)
+        if dict_names is not None and self.tag_dicts is not None:
+            for dn in dict_names:
+                d = self.tag_dicts.get(dn)
+                for s in d.values():
+                    if match(s):
+                        h = d.lookup(s)
+                        if h is not None:
+                            ids.add(h)
+            return ids
+        if self.tagrecorder is not None:
+            d = self.tagrecorder.dict_for_column(column)
+            if d is not None:
+                for i, name in d.snapshot().items():
+                    if match(str(name)):
+                        ids.add(i)
+                return ids
+        raise ValueError(
+            f"{op.upper().replace('_', ' ')} needs a dictionary-backed "
+            f"column, got {column}")
 
     # -- aggregation -------------------------------------------------------
     def _grouped(self, stmt: Q.Select, cols: Dict[str, np.ndarray]):
@@ -437,6 +526,10 @@ class QueryEngine:
                        else g for g in stmt.group_by]
         aggs: Dict[str, str] = {}     # internal value name -> reduce kind
         value_src: Dict[str, np.ndarray] = {}
+        # Percentile cannot ride the segment reduction (no sum/max/min
+        # form); its sources reduce per group AFTER, via the row->group
+        # inverse the same grouping pass produces
+        pct_jobs: Dict[str, Tuple[np.ndarray, float]] = {}
         n = len(next(iter(cols.values()))) if cols else 0
 
         def register(agg: Q.Agg) -> str:
@@ -447,7 +540,10 @@ class QueryEngine:
                 aggs[key] = "sum"
                 return key
             src = _eval_cols(agg.arg, cols, n)
-            key = f"__{kind}_{len(value_src)}"
+            key = f"__{kind}_{len(value_src) + len(pct_jobs)}"
+            if kind == "percentile":
+                pct_jobs[key] = (src, agg.param)
+                return key
             value_src[key] = src
             aggs[key] = "count" if kind == "count" else \
                 "sum" if kind in ("sum", "avg") else kind
@@ -462,9 +558,31 @@ class QueryEngine:
         # map every aggregate in every select item to a reduced column
         plans = [_plan_aggs(it.expr, register) for it in stmt.items]
         work = {k: cols[k] for k in group_names}
+        if not aggs and pct_jobs:
+            # the reduction needs at least one value column to carry
+            work["__ones"] = np.ones(n, np.int64)
+            aggs["__ones"] = "sum"
         work.update(value_src)
-        reduced = group_reduce(work, group_names, aggs) if n else \
-            {k: np.empty(0, np.int64) for k in group_names + list(aggs)}
+        if n == 0:
+            reduced = {k: np.empty(0, np.int64)
+                       for k in group_names + list(aggs)}
+            for key in pct_jobs:
+                reduced[key] = np.empty(0, np.float64)
+        elif pct_jobs:
+            reduced, inv = group_reduce(work, group_names, aggs,
+                                        return_inverse=True)
+            order = np.argsort(inv, kind="stable")
+            n_groups = len(next(iter(reduced.values())))
+            bounds = np.searchsorted(inv[order], np.arange(n_groups + 1))
+            for key, (src, p) in pct_jobs.items():
+                vals = src[order].astype(np.float64)
+                out = np.empty(n_groups, np.float64)
+                for g in range(n_groups):
+                    seg = vals[bounds[g]:bounds[g + 1]]
+                    out[g] = np.percentile(seg, p) if len(seg) else np.nan
+                reduced[key] = out
+        else:
+            reduced = group_reduce(work, group_names, aggs)
 
         out_cols, series = [], []
         for it, plan in zip(stmt.items, plans):
@@ -563,10 +681,46 @@ def _expr_name(e: Q.Expr) -> str:
     if isinstance(e, Q.Literal):
         return str(e.value)
     if isinstance(e, Q.Agg):
+        if e.func == "percentile":
+            return f"percentile({_expr_name(e.arg)},{e.param:g})"
         return f"{e.func}({_expr_name(e.arg) if e.arg else '*'})"
     if isinstance(e, Q.TimeBucket):
         return "time"            # Grafana timeseries column convention
+    if isinstance(e, Q.IntervalRef):
+        return "interval"
     return f"{_expr_name(e.left)}{e.op}{_expr_name(e.right)}"
+
+
+def _where_columns(node) -> set:
+    """Column names referenced anywhere in a WHERE tree node."""
+    if isinstance(node, Q.BoolOp):
+        out = set()
+        for ch in node.children:
+            out |= _where_columns(ch)
+        return out
+    return {node.column}
+
+
+def _has_interval_ref(e: Q.Expr) -> bool:
+    if isinstance(e, Q.IntervalRef):
+        return True
+    if isinstance(e, Q.BinOp):
+        return _has_interval_ref(e.left) or _has_interval_ref(e.right)
+    if isinstance(e, Q.Agg) and e.arg is not None:
+        return _has_interval_ref(e.arg)
+    return False
+
+
+def _resolve_interval(e: Q.Expr, seconds: int) -> Q.Expr:
+    """Substitute IntervalRef with the resolved interval literal."""
+    if isinstance(e, Q.IntervalRef):
+        return Q.Literal(seconds)
+    if isinstance(e, Q.BinOp):
+        return Q.BinOp(e.op, _resolve_interval(e.left, seconds),
+                       _resolve_interval(e.right, seconds))
+    if isinstance(e, Q.Agg) and e.arg is not None:
+        return Q.Agg(e.func, _resolve_interval(e.arg, seconds), e.param)
+    return e
 
 
 def _eval_cols(e: Q.Expr, cols: Dict[str, np.ndarray], n: int) -> np.ndarray:
@@ -635,6 +789,8 @@ def _eval_scalar(e: Q.Expr, cols: Dict[str, np.ndarray], n: int):
             return int(src.max())
         if e.func == "min":
             return int(src.min())
+        if e.func == "percentile":
+            return float(np.percentile(src, e.param))
         return float(src.mean())
     if isinstance(e, Q.BinOp):
         return _apply_op(e.op, _eval_scalar(e.left, cols, n),

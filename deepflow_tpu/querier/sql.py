@@ -12,11 +12,15 @@ Supports the query shapes the reference querier serves from Grafana
     SHOW TAG <tag> VALUES FROM <table> [LIMIT n]
 
 Expressions: columns, integer/float/string literals, aggregate calls
-(Sum/Min/Max/Avg/Count), and +,-,*,/ arithmetic over them (derived
-metrics like Sum(retrans)/Sum(packet_tx)). Conditions: =, !=, <, <=, >,
->=, IN (...), and AND conjunction. The reference's sqlparser fork
-(querier/parse/parse.go) plays this role; a hand-rolled parser keeps the
-dependency surface zero.
+(Sum/Min/Max/Avg/Count, Percentile(col, p), PerSecond(expr) — the
+reference's TransMetricFunc function set), and +,-,*,/ arithmetic over
+them (derived metrics like Sum(retrans)/Sum(packet_tx)). Conditions:
+=, !=, <, <=, >, >=, IN/NOT IN (...), LIKE/NOT LIKE ('%' and '_'
+wildcards on dictionary-backed columns), REGEXP, combined with
+AND/OR/NOT and parentheses (full boolean trees; time-range pruning
+reads the top-level conjuncts). The reference's sqlparser fork
+(querier/parse/parse.go) plays this role; a hand-rolled parser keeps
+the dependency surface zero.
 
 Time bucketing: `time(N)` (alias `interval(N)`) may appear in GROUP BY
 and in the select list — the reference's TransGroupBy interval grouping
@@ -68,8 +72,16 @@ class Literal:
 
 @dataclass(frozen=True)
 class Agg:
-    func: str                 # sum|min|max|avg|count
+    func: str                 # sum|min|max|avg|count|percentile
     arg: Optional["Expr"]     # None for Count(*)
+    param: Optional[float] = None   # Percentile(col, p)'s p
+
+
+@dataclass(frozen=True)
+class IntervalRef:
+    """PerSecond()'s divisor: the GROUP BY time-bucket width, or the
+    query's WHERE time span (reference: engine/clickhouse metrics
+    TransMetricFunc lowers PerSecond to value/interval)."""
 
 
 @dataclass(frozen=True)
@@ -86,14 +98,26 @@ class TimeBucket:
     seconds: int
 
 
-Expr = Union[Column, Literal, Agg, BinOp, TimeBucket]
+Expr = Union[Column, Literal, Agg, BinOp, TimeBucket, IntervalRef]
 
 
 @dataclass(frozen=True)
 class Cond:
     column: str
-    op: str                   # = != < <= > >= in
+    op: str         # = != < <= > >= in not_in like not_like regexp
     value: Union[int, float, str, Tuple]
+
+
+@dataclass(frozen=True)
+class BoolOp:
+    """WHERE boolean tree node. Select.where is a top-level AND list;
+    OR/NOT subtrees appear as BoolOp entries (so time-range pruning
+    keeps working off the top-level conjuncts)."""
+    op: str                   # "and" | "or" | "not"
+    children: Tuple           # Cond | BoolOp
+
+
+WhereNode = Union[Cond, BoolOp]
 
 
 @dataclass(frozen=True)
@@ -215,6 +239,22 @@ class _Parser:
             return Literal(float(t))
         if t.lower() in ("time", "interval") and self.peek() == "(":
             return self._time_bucket()
+        if t.lower() == "percentile" and self.peek() == "(":
+            self.next()
+            arg = self.parse_expr()
+            self.expect(",")
+            p = self._value(self.next())
+            self.expect(")")
+            if not isinstance(p, (int, float)) or not 0 <= p <= 100:
+                raise ValueError(f"Percentile needs 0..100, got {p!r}")
+            return Agg("percentile", arg, float(p))
+        if t.lower() == "persecond" and self.peek() == "(":
+            # PerSecond(expr) = expr / the query interval (time-bucket
+            # width under interval grouping, else the WHERE time span)
+            self.next()
+            arg = self.parse_expr()
+            self.expect(")")
+            return BinOp("/", arg, IntervalRef())
         if t.lower() in AGG_FUNCS and self.peek() == "(":
             self.next()
             if self.accept("*"):
@@ -250,9 +290,7 @@ class _Parser:
         order_by: List[Tuple[str, bool]] = []
         limit = None
         if self.accept("where"):
-            where.append(self.parse_cond())
-            while self.accept("and"):
-                where.append(self.parse_cond())
+            where = self.parse_bool()
         if self.accept("group"):
             self.expect("by")
             group_by.append(self._group_item())
@@ -378,16 +416,77 @@ class _Parser:
                 offset = int(self.next())
         return order_by, limit, offset
 
+    def parse_bool(self) -> List[WhereNode]:
+        """WHERE tree, precedence OR < AND < NOT < atom; returns the
+        top-level AND conjunct list (time pruning reads it directly)."""
+        node = self._bool_or()
+        if isinstance(node, BoolOp) and node.op == "and":
+            return list(node.children)
+        return [node]
+
+    def _bool_or(self) -> WhereNode:
+        left = self._bool_and()
+        branches = [left]
+        while self.accept("or"):
+            branches.append(self._bool_and())
+        if len(branches) == 1:
+            return left
+        return BoolOp("or", tuple(branches))
+
+    def _bool_and(self) -> WhereNode:
+        left = self._bool_not()
+        parts = [left]
+        while self.accept("and"):
+            parts.append(self._bool_not())
+        if len(parts) == 1:
+            return left
+        # flatten nested ANDs so parse_bool's top-level list is maximal
+        flat: List[WhereNode] = []
+        for p in parts:
+            if isinstance(p, BoolOp) and p.op == "and":
+                flat.extend(p.children)
+            else:
+                flat.append(p)
+        return BoolOp("and", tuple(flat))
+
+    def _bool_not(self) -> WhereNode:
+        if self.accept("not"):
+            return BoolOp("not", (self._bool_not(),))
+        if self.peek() == "(":
+            # lookahead: '(' here is a boolean group, because a
+            # condition atom always starts with a column name
+            self.next()
+            inner = self._bool_or()
+            self.expect(")")
+            return inner
+        return self.parse_cond()
+
     def parse_cond(self) -> Cond:
         col = self.next()
         op = self.next().lower()
+        negate = False
+        if op == "not":
+            negate = True
+            op = self.next().lower()
+            if op not in ("in", "like"):
+                raise ValueError(f"bad operator NOT {op!r}")
         if op == "in":
             self.expect("(")
             vals = [self._value(self.next())]
             while self.accept(","):
                 vals.append(self._value(self.next()))
             self.expect(")")
-            return Cond(col, "in", tuple(vals))
+            return Cond(col, "not_in" if negate else "in", tuple(vals))
+        if op == "like":
+            v = self._value(self.next())
+            if not isinstance(v, str):
+                raise ValueError("LIKE needs a string pattern")
+            return Cond(col, "not_like" if negate else "like", v)
+        if op == "regexp":
+            v = self._value(self.next())
+            if not isinstance(v, str):
+                raise ValueError("REGEXP needs a string pattern")
+            return Cond(col, "regexp", v)
         if op not in ("=", "!=", "<", "<=", ">", ">="):
             raise ValueError(f"bad operator {op!r}")
         return Cond(col, op, self._value(self.next()))

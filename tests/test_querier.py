@@ -1458,3 +1458,137 @@ def test_promql_set_op_on_scalars_is_loud(prom):
     import pytest as _pt
     with _pt.raises(ValueError):
         eng.query('vector(1 and 2)', at=1100)
+
+
+# -- round-3b SQL: boolean WHERE trees, LIKE, Percentile, PerSecond -------
+def test_sql_or_not_parens(engine):
+    eng, cols = engine
+    res = eng.execute("SELECT Count(*) AS n FROM flows WHERE "
+                      "ip = 1 OR ip = 2")
+    m = (cols["ip"] == 1) | (cols["ip"] == 2)
+    assert res.values[0][0] == int(m.sum())
+    res = eng.execute("SELECT Count(*) AS n FROM flows WHERE "
+                      "NOT (ip = 1 OR ip = 2)")
+    assert res.values[0][0] == int((~m).sum())
+    # mixed precedence: AND binds tighter than OR
+    res = eng.execute("SELECT Count(*) AS n FROM flows WHERE "
+                      "ip = 1 AND proto = 6 OR ip = 2 AND proto = 17")
+    m = ((cols["ip"] == 1) & (cols["proto"] == 6)) | \
+        ((cols["ip"] == 2) & (cols["proto"] == 17))
+    assert res.values[0][0] == int(m.sum())
+    # time pruning still applies with an OR residual alongside
+    res = eng.execute("SELECT Count(*) AS n FROM flows WHERE "
+                      "timestamp >= 10 AND timestamp < 20 AND "
+                      "(ip = 1 OR proto = 6)")
+    m = (cols["timestamp"] >= 10) & (cols["timestamp"] < 20) & \
+        ((cols["ip"] == 1) | (cols["proto"] == 6))
+    assert res.values[0][0] == int(m.sum())
+
+
+def test_sql_not_in(engine):
+    eng, cols = engine
+    res = eng.execute("SELECT Count(*) AS n FROM flows WHERE "
+                      "ip NOT IN (1, 2)")
+    assert res.values[0][0] == int((~np.isin(cols["ip"], [1, 2])).sum())
+
+
+def test_sql_percentile(engine):
+    eng, cols = engine
+    res = eng.execute("SELECT Percentile(rtt, 95) AS p FROM flows")
+    assert res.values[0][0] == pytest.approx(
+        float(np.percentile(cols["rtt"], 95)))
+    res = eng.execute("SELECT ip, Percentile(rtt, 50) AS p FROM flows "
+                      "GROUP BY ip ORDER BY ip")
+    for ip, p in res.values:
+        assert p == pytest.approx(
+            float(np.percentile(cols["rtt"][cols["ip"] == ip], 50)))
+
+
+def test_sql_persecond(engine):
+    eng, cols = engine
+    # bounded WHERE span: 40s
+    res = eng.execute("SELECT PerSecond(Sum(bytes)) AS r FROM flows "
+                      "WHERE timestamp >= 10 AND timestamp < 50")
+    m = (cols["timestamp"] >= 10) & (cols["timestamp"] < 50)
+    assert res.values[0][0] == pytest.approx(
+        cols["bytes"][m].sum() / 40.0)
+    # under interval grouping the bucket width is the divisor
+    res = eng.execute("SELECT time(20), PerSecond(Sum(bytes)) AS r "
+                      "FROM flows GROUP BY time(20) ORDER BY time")
+    for tb, r in res.values:
+        m = (cols["timestamp"] // 20) * 20 == tb
+        assert r == pytest.approx(cols["bytes"][m].sum() / 20.0)
+    # unbounded + unbucketed is a loud error
+    with pytest.raises(ValueError, match="PerSecond"):
+        eng.execute("SELECT PerSecond(Sum(bytes)) AS r FROM flows")
+
+
+def test_sql_like_regexp(tmp_path):
+    """LIKE/REGEXP widen to dictionary-id membership (the reference's
+    dictGet lowering for auto-tags)."""
+    from deepflow_tpu.querier.engine import DICT_COLUMNS
+    store = Store(str(tmp_path / "s"))
+    dicts = TagDictRegistry(str(tmp_path / "s"))
+    schema = TableSchema(
+        name="l7",
+        columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("endpoint_hash", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("n", np.dtype(np.uint32), AggKind.SUM),
+        ))
+    t = store.create_table("flow_log", schema)
+    d_name = DICT_COLUMNS.get("endpoint_hash")
+    assert d_name, "endpoint_hash should be dictionary-backed"
+    d = dicts.get(d_name[0])
+    eps = ["GET /api/users", "GET /api/orders", "POST /login"]
+    hs = [d.encode_one(s) for s in eps]
+    t.append({"timestamp": np.array([1, 2, 3], np.uint32),
+              "endpoint_hash": np.array(hs, np.uint32),
+              "n": np.ones(3, np.uint32)})
+    eng = QueryEngine(store, dicts)
+    res = eng.execute("SELECT Count(*) AS c FROM l7 WHERE "
+                      "endpoint_hash LIKE 'GET /api/%'")
+    assert res.values[0][0] == 2
+    res = eng.execute("SELECT Count(*) AS c FROM l7 WHERE "
+                      "endpoint_hash NOT LIKE 'GET %'")
+    assert res.values[0][0] == 1
+    res = eng.execute("SELECT Count(*) AS c FROM l7 WHERE "
+                      "endpoint_hash REGEXP '(GET|POST) /(api/)?[a-z]+'")
+    assert res.values[0][0] == 3
+
+
+def test_sql_regexp_is_unanchored(tmp_path):
+    """REGEXP searches (ClickHouse match()); LIKE stays anchored."""
+    from deepflow_tpu.querier.engine import DICT_COLUMNS
+    store = Store(str(tmp_path / "s"))
+    dicts = TagDictRegistry(str(tmp_path / "s"))
+    schema = TableSchema(
+        name="l7",
+        columns=(
+            ColumnSpec("timestamp", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("endpoint_hash", np.dtype(np.uint32), AggKind.KEY),
+            ColumnSpec("n", np.dtype(np.uint32), AggKind.SUM),
+        ))
+    t = store.create_table("flow_log", schema)
+    d = dicts.get(DICT_COLUMNS["endpoint_hash"][0])
+    hs = [d.encode_one(s) for s in
+          ["GET /api/users", "GET /api/orders", "POST /login"]]
+    t.append({"timestamp": np.array([1, 2, 3], np.uint32),
+              "endpoint_hash": np.array(hs, np.uint32),
+              "n": np.ones(3, np.uint32)})
+    eng = QueryEngine(store, dicts)
+    res = eng.execute("SELECT Count(*) AS c FROM l7 WHERE "
+                      "endpoint_hash REGEXP 'api'")     # substring
+    assert res.values[0][0] == 2
+    res = eng.execute("SELECT Count(*) AS c FROM l7 WHERE "
+                      "endpoint_hash LIKE 'api'")       # anchored: none
+    assert res.values[0][0] == 0
+
+
+def test_sql_persecond_needs_both_bounds(engine):
+    eng, _ = engine
+    # only an upper bound: the implicit lo=0 would make an epoch-sized
+    # divisor; must be loud instead
+    with pytest.raises(ValueError, match="both sides"):
+        eng.execute("SELECT PerSecond(Sum(bytes)) AS r FROM flows "
+                    "WHERE timestamp < 50")
