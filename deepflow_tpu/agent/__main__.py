@@ -26,7 +26,8 @@ import threading
 import yaml
 
 _CAPTURE_KEYS = ("engine", "iface", "path", "batch_size", "block_size",
-                 "block_count", "poll_ms", "snaplen")
+                 "block_count", "poll_ms", "snaplen", "bpf")
+_BPF_KEYS = ("proto", "port", "sample_shift")
 
 
 def load_bootstrap(path: str) -> tuple:
@@ -60,6 +61,21 @@ def load_bootstrap(path: str) -> tuple:
     if engine != "ring" and ("block_size" in capture
                              or "block_count" in capture):
         raise ValueError("block_size/block_count apply to engine ring only")
+    if "bpf" in capture:
+        if engine not in ("raw", "ring"):
+            raise ValueError("bpf filters attach to live sockets "
+                             "(engine raw or ring)")
+        b = capture["bpf"] or {}
+        unknown = set(b) - set(_BPF_KEYS)
+        if unknown:
+            raise ValueError(f"unknown bpf keys: {sorted(unknown)}")
+        for k, hi in (("proto", 255), ("port", 65535),
+                      ("sample_shift", 31)):
+            v = b.get(k)
+            if v is not None and (not isinstance(v, int)
+                                  or not 0 <= v <= hi):
+                raise ValueError(f"bpf {k} must be an int in "
+                                 f"0..{hi}, got {v!r}")
     fields = AgentConfig.__dataclass_fields__
     unknown = set(raw) - set(fields)
     if unknown:
@@ -85,18 +101,35 @@ def build_source(capture: dict):
     for k in ("batch_size", "poll_ms"):
         if k in capture:
             kw[k] = capture[k]
-    if engine == "ring":
-        from deepflow_tpu.agent.afpacket import TpacketV3Source
-        for k in ("block_size", "block_count"):
-            if k in capture:
-                kw[k] = capture[k]
-        return TpacketV3Source(capture.get("iface"), **kw)
-    if engine == "raw":
-        from deepflow_tpu.agent.afpacket import AfPacketSource
-        if "snaplen" in capture:
-            kw["snaplen"] = capture["snaplen"]
-        return AfPacketSource(capture.get("iface"), **kw)
-    raise ValueError(f"unknown capture engine {engine!r}")
+    filt = None
+    if "bpf" in capture:
+        # kernel-side filter on the capture socket (recv_engine BPF
+        # injection): attached BEFORE the socket binds (prepare hook)
+        # so no packet ever reaches userspace unfiltered
+        from deepflow_tpu.agent.bpf import BpfFilter
+        filt = BpfFilter(**(capture["bpf"] or {}))
+        kw["prepare"] = filt.attach_socket
+    try:
+        if engine == "ring":
+            from deepflow_tpu.agent.afpacket import TpacketV3Source
+            for k in ("block_size", "block_count"):
+                if k in capture:
+                    kw[k] = capture[k]
+            src = TpacketV3Source(capture.get("iface"), **kw)
+        elif engine == "raw":
+            from deepflow_tpu.agent.afpacket import AfPacketSource
+            if "snaplen" in capture:
+                kw["snaplen"] = capture["snaplen"]
+            src = AfPacketSource(capture.get("iface"), **kw)
+        else:
+            raise ValueError(f"unknown capture engine {engine!r}")
+    except BaseException:
+        if filt is not None:
+            filt.close()
+        raise
+    if filt is not None:
+        src.bpf = filt          # counters + lifecycle ride the source
+    return src
 
 
 def main(argv=None) -> int:

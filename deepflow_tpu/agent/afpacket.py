@@ -42,7 +42,7 @@ class AfPacketSource:
 
     def __init__(self, iface: Optional[str] = None,
                  batch_size: int = 4096, poll_ms: float = 50.0,
-                 snaplen: int = 65535) -> None:
+                 snaplen: int = 65535, prepare=None) -> None:
         if not hasattr(socket, "AF_PACKET"):
             raise OSError("AF_PACKET requires Linux")
         self.iface = iface
@@ -52,6 +52,11 @@ class AfPacketSource:
         self._sock = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
                                    socket.htons(ETH_P_ALL))
         try:
+            if prepare is not None:
+                # e.g. bpf.BpfFilter.attach_socket: the filter must be
+                # on the socket BEFORE bind, or pre-attach packets
+                # reach userspace unfiltered
+                prepare(self._sock)
             if iface:
                 self._sock.bind((iface, 0))
             self._sock.settimeout(poll_ms / 1e3)
@@ -120,7 +125,8 @@ class TpacketV3Source:
     def __init__(self, iface: Optional[str] = None,
                  block_size: int = 1 << 20, block_count: int = 8,
                  frame_size: int = 1 << 11, retire_ms: int = 60,
-                 batch_size: int = 8192, poll_ms: float = 50.0) -> None:
+                 batch_size: int = 8192, poll_ms: float = 50.0,
+                 prepare=None) -> None:
         if not hasattr(socket, "AF_PACKET"):
             raise OSError("AF_PACKET requires Linux")
         if block_size % mmap.PAGESIZE or block_size % frame_size:
@@ -134,6 +140,8 @@ class TpacketV3Source:
         self._sock = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
                                    socket.htons(ETH_P_ALL))
         try:
+            if prepare is not None:
+                prepare(self._sock)   # filter before bind (see raw src)
             self._sock.setsockopt(SOL_PACKET, PACKET_VERSION, TPACKET_V3)
             req = struct.pack(
                 "IIIIIII", block_size, block_count, frame_size,
@@ -278,10 +286,17 @@ class CaptureLoop:
         if self._thread is not None:
             self._thread.join(timeout=2)
         self.source.close()
+        bpf = getattr(self.source, "bpf", None)
+        if bpf is not None:
+            bpf.close()      # program + map fds owned per attachment
 
     def counters(self) -> dict:
         c = {"batches": self.batches, "packets": self.packets,
              "failed": self.failed or ""}
+        bpf = getattr(self.source, "bpf", None)
+        if bpf is not None:
+            # kernel-side filter verdicts (agent/bpf.py BpfFilter)
+            c.update(bpf.counters())
         for attr in ("frames_captured", "errors"):
             if hasattr(self.source, attr):
                 c[f"capture_{attr}" if attr == "errors" else attr] = \

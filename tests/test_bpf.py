@@ -1,0 +1,231 @@
+"""Pure-Python eBPF toolkit: kernel-verified load, attach, filter.
+
+These tests run REAL kernel eBPF (bpf(2) + SO_ATTACH_BPF on loopback
+traffic) — the capture-filter class of the reference's eBPF machinery
+(recv_engine BPF injection; load.c's loader role). Skipped wholesale
+where the kernel/container forbids bpf()."""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from deepflow_tpu.agent import bpf
+
+pytestmark = pytest.mark.skipif(not bpf.available(),
+                                reason="bpf(2) unavailable")
+
+
+def test_insn_encoding_golden():
+    # mov r0, 7; exit — the canonical 2-insn accept-all body
+    insns = bpf.Asm().exit_imm(7).assemble()
+    assert insns == (struct.pack("<BBhi", 0xb7, 0, 0, 7)
+                     + struct.pack("<BBhi", 0x95, 0, 0, 0))
+
+
+def test_verifier_rejects_bad_program_with_log():
+    # fall off the end without exit: the VERIFIER must reject it and
+    # the error must carry its reasoning
+    prog = bpf.Asm().mov_imm(bpf.R0, 0).assemble()
+    with pytest.raises(OSError, match="verifier"):
+        bpf.load(prog)
+
+
+def test_map_roundtrip():
+    m = bpf.Map(4)
+    try:
+        m.update(2, 0xDEADBEEF)
+        assert m.lookup(2) == 0xDEADBEEF
+        assert m.lookup(0) == 0
+        with pytest.raises(OSError):
+            m.lookup(99)          # out of range
+    finally:
+        m.close()
+
+
+def _flood(port_hit: int, port_miss: int, n: int = 40) -> None:
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for i in range(n):
+        tx.sendto(b"hit-%d" % i, ("127.0.0.1", port_hit))
+        tx.sendto(b"miss-%d" % i, ("127.0.0.1", port_miss))
+    tx.close()
+
+
+def test_kernel_filter_on_raw_socket():
+    from deepflow_tpu.agent.afpacket import AfPacketSource
+    filt = bpf.BpfFilter(proto=17, port=55997)
+    src = AfPacketSource("lo", batch_size=512, poll_ms=100)
+    filt.attach(src)
+    try:
+        _flood(55997, 44444)
+        time.sleep(0.2)
+        frames, _ = src.read_batch()
+        assert sum(1 for f in frames if b"miss-" in f) == 0
+        assert sum(1 for f in frames if b"hit-" in f) >= 40
+        c = filt.counters()
+        # every packet traverses lo twice (rx+tx hooks)
+        assert c["bpf_seen"] >= 160
+        assert 80 <= c["bpf_accepted"] < c["bpf_seen"]
+    finally:
+        src.close()
+        filt.close()
+
+
+def test_kernel_filter_on_ring():
+    from deepflow_tpu.agent.afpacket import TpacketV3Source
+    filt = bpf.BpfFilter(proto=17, port=55996)
+    src = TpacketV3Source("lo", batch_size=512, poll_ms=100)
+    filt.attach(src)
+    try:
+        _flood(55996, 44444)
+        deadline = time.time() + 3
+        hit, miss = 0, 0
+        while time.time() < deadline and hit < 40:
+            frames, _ = src.read_batch()
+            hit += sum(1 for f in frames if b"hit-" in f)
+            miss += sum(1 for f in frames if b"miss-" in f)
+        assert miss == 0
+        assert hit >= 40
+    finally:
+        src.close()
+        filt.close()
+
+
+def test_kernel_sampling_deterministic():
+    """sample_shift=1 keeps every second ACCEPTED packet, counted in
+    kernel: accepted ~= seen/2 for an all-UDP matched stream."""
+    from deepflow_tpu.agent.afpacket import AfPacketSource
+    filt = bpf.BpfFilter(proto=17, port=55995, sample_shift=1)
+    src = AfPacketSource("lo", batch_size=512, poll_ms=100)
+    filt.attach(src)
+    try:
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(60):
+            tx.sendto(b"s-%d" % i, ("127.0.0.1", 55995))
+        tx.close()
+        time.sleep(0.2)
+        frames, _ = src.read_batch()
+        got = sum(1 for f in frames if b"s-" in f)
+        # 60 sends x 2 hooks = 120 matched; 1/2 sampling -> 60 delivered
+        assert 50 <= got <= 70
+        c = filt.counters()
+        assert c["bpf_accepted"] == pytest.approx(got, abs=4)
+    finally:
+        src.close()
+        filt.close()
+
+
+def test_capture_loop_surfaces_bpf_counters():
+    from deepflow_tpu.agent.afpacket import AfPacketSource, CaptureLoop
+
+    class NullAgent:
+        def feed(self, frames, stamps):
+            return len(frames)
+
+    filt = bpf.BpfFilter(proto=17, port=55994)
+    src = AfPacketSource("lo", batch_size=256, poll_ms=50)
+    filt.attach(src)
+    loop = CaptureLoop(src, NullAgent())
+    loop.start()
+    try:
+        _flood(55994, 44444, n=20)
+        time.sleep(0.5)
+        c = loop.counters()
+        assert c["bpf_seen"] > 0
+        assert c["bpf_accepted"] >= 20
+    finally:
+        loop.close()      # closes source AND the attached filter
+    assert filt.map.fd == -1          # ownership followed the loop
+
+
+def test_unconstrained_filter_loads_and_accepts():
+    """bpf: {} (count-only) must pass the verifier — the drop block is
+    only assembled when referenced (unreachable insns are rejected)."""
+    m = bpf.Map(4)
+    try:
+        p = bpf.build_capture_filter(m)
+        p.close()
+    finally:
+        m.close()
+
+
+def test_imm_encoding_folds_unsigned():
+    # 0xFFFFFFFF must encode as s32 -1, not raise struct.error
+    raw = bpf._insn(0xb7, 0, 0, 0, 0xFFFFFFFF)
+    assert raw[4:] == b"\xff\xff\xff\xff"
+
+
+def test_portless_proto_with_port_rejected():
+    m = bpf.Map(4)
+    try:
+        with pytest.raises(ValueError, match="no L4 ports"):
+            bpf.build_capture_filter(m, proto=1, port=80)   # ICMP
+    finally:
+        m.close()
+
+
+def test_non_first_fragment_dropped():
+    """A non-first IPv4 fragment whose payload bytes mimic the target
+    port must NOT match (tcpdump frag semantics)."""
+    import struct as st
+    from deepflow_tpu.agent.afpacket import AfPacketSource
+    filt = bpf.BpfFilter(port=55993)
+    src = AfPacketSource("lo", batch_size=256, poll_ms=100)
+    filt.attach(src)
+    tx = socket.socket(socket.AF_PACKET, socket.SOCK_RAW)
+    tx.bind(("lo", 0))
+    try:
+        # eth + ipv4 (frag_off=0x00B9 -> non-first) + payload that
+        # looks like src/dst port 55993
+        eth = b"\x00" * 12 + b"\x08\x00"
+        payload = st.pack(">HH", 55993, 55993) + b"frag-payload"
+        total = 20 + len(payload)
+        ip = st.pack(">BBHHHBBH4s4s", 0x45, 0, total, 1, 0x00B9,
+                     64, 17, 0, bytes([127, 0, 0, 1]),
+                     bytes([127, 0, 0, 1]))
+        tx.send(eth + ip + payload)
+        # control: a FIRST fragment (frag_off 0, MF set) with real
+        # UDP ports DOES match
+        udp = st.pack(">HHHH", 55993, 55993, 8 + 4, 0) + b"ok"
+        total = 20 + len(udp)
+        ip1 = st.pack(">BBHHHBBH4s4s", 0x45, 0, total, 2, 0x2000,
+                      64, 17, 0, bytes([127, 0, 0, 1]),
+                      bytes([127, 0, 0, 1]))
+        tx.send(eth + ip1 + udp)
+        time.sleep(0.2)
+        frames, _ = src.read_batch()
+        assert sum(1 for f in frames if b"frag-payload" in f) == 0
+        assert sum(1 for f in frames if b"ok" in f) >= 1
+    finally:
+        tx.close()
+        src.close()
+        filt.close()
+
+
+def test_bootstrap_bpf_value_types(tmp_path):
+    from deepflow_tpu.agent.__main__ import load_bootstrap
+    p = tmp_path / "a.yaml"
+    p.write_text("capture: {engine: raw, bpf: {port: '80'}}\n")
+    with pytest.raises(ValueError, match="port"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: raw, bpf: {sample_shift: 32}}\n")
+    with pytest.raises(ValueError, match="sample_shift"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: raw, bpf: {proto: 300}}\n")
+    with pytest.raises(ValueError, match="proto"):
+        load_bootstrap(str(p))
+
+
+def test_bootstrap_bpf_validation(tmp_path):
+    from deepflow_tpu.agent.__main__ import load_bootstrap
+    p = tmp_path / "a.yaml"
+    p.write_text("capture: {engine: pcap, path: x, bpf: {proto: 6}}\n")
+    with pytest.raises(ValueError, match="live sockets"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: raw, bpf: {prot: 6}}\n")
+    with pytest.raises(ValueError, match="prot"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: raw, bpf: {proto: 6, port: 80}}\n")
+    cfg, capture = load_bootstrap(str(p))
+    assert capture["bpf"] == {"proto": 6, "port": 80}
