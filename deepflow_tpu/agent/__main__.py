@@ -7,8 +7,12 @@ Same shape here: the yaml's keys are AgentConfig fields plus a
 `capture:` block choosing the packet source; everything else arrives
 through the sync loop (trident.py Agent.sync_once -> _apply_config).
 
-Capture sources (agent/afpacket.py, agent/pcap.py):
+Capture sources (agent/afpacket.py, agent/xdp.py, agent/pcap.py):
   capture: {engine: ring,  iface: eth0}     TPACKET_V3 mmap ring
+  capture: {engine: xdp,   iface: eth0}     AF_XDP (XDP redirect into
+                                            XSK rings; CONSUMES the
+                                            queue's ingress — analyzer
+                                            deployments)
   capture: {engine: raw,   iface: eth0}     batched raw socket
   capture: {engine: pcap,  path: x.pcap}    replay a capture file
   capture: {engine: none}                   control-plane only (eBPF or
@@ -26,7 +30,8 @@ import threading
 import yaml
 
 _CAPTURE_KEYS = ("engine", "iface", "path", "batch_size", "block_size",
-                 "block_count", "poll_ms", "snaplen", "bpf")
+                 "block_count", "poll_ms", "snaplen", "bpf", "queue",
+                 "frame_count")
 _BPF_KEYS = ("proto", "port", "sample_shift")
 
 
@@ -49,11 +54,13 @@ def load_bootstrap(path: str) -> tuple:
     if unknown:
         raise ValueError(f"unknown capture keys: {sorted(unknown)}")
     engine = capture.get("engine", "none")
-    if engine not in ("none", "raw", "ring", "pcap"):
+    if engine not in ("none", "raw", "ring", "xdp", "pcap"):
         raise ValueError(f"unknown capture engine {engine!r} "
-                         "(none|raw|ring|pcap)")
+                         "(none|raw|ring|xdp|pcap)")
     if engine == "pcap" and not capture.get("path"):
         raise ValueError("capture engine pcap requires path")
+    if engine == "xdp" and not capture.get("iface"):
+        raise ValueError("capture engine xdp requires iface")
     # per-engine knobs: reject mismatches here so --dry-run catches them
     if engine != "raw" and "snaplen" in capture:
         raise ValueError("snaplen applies to engine raw only; "
@@ -61,8 +68,13 @@ def load_bootstrap(path: str) -> tuple:
     if engine != "ring" and ("block_size" in capture
                              or "block_count" in capture):
         raise ValueError("block_size/block_count apply to engine ring only")
+    if engine != "xdp" and ("queue" in capture
+                            or "frame_count" in capture):
+        raise ValueError("queue/frame_count apply to engine xdp only")
     if "bpf" in capture:
         if engine not in ("raw", "ring"):
+            # xdp has its own in-kernel program; socket filters don't
+            # apply to XSK rings
             raise ValueError("bpf filters attach to live sockets "
                              "(engine raw or ring)")
         b = capture["bpf"] or {}
@@ -121,6 +133,12 @@ def build_source(capture: dict):
             if "snaplen" in capture:
                 kw["snaplen"] = capture["snaplen"]
             src = AfPacketSource(capture.get("iface"), **kw)
+        elif engine == "xdp":
+            from deepflow_tpu.agent.xdp import XdpSource
+            for k in ("queue", "frame_count"):
+                if k in capture:
+                    kw[k] = capture[k]
+            src = XdpSource(capture["iface"], **kw)
         else:
             raise ValueError(f"unknown capture engine {engine!r}")
     except BaseException:

@@ -63,6 +63,7 @@ BPF_ALU, BPF_JMP, BPF_ALU64 = 0x04, 0x05, 0x07
 BPF_W, BPF_H, BPF_B, BPF_DW = 0x00, 0x08, 0x10, 0x18
 BPF_IMM, BPF_ABS, BPF_MEM = 0x00, 0x20, 0x60
 BPF_ATOMIC = 0xc0
+BPF_FETCH = 0x01
 BPF_ADD, BPF_SUB, BPF_AND, BPF_OR = 0x00, 0x10, 0x50, 0x40
 BPF_LSH, BPF_RSH = 0x60, 0x70
 BPF_MOV = 0xb0
@@ -194,6 +195,15 @@ class Asm:
         """*(dst + off) += src, atomically (BPF_ATOMIC | BPF_ADD)."""
         self._insns.append(("raw", _insn(BPF_STX | BPF_ATOMIC | size,
                                          dst, src, off, BPF_ADD)))
+        return self
+
+    def atomic_fetch_add(self, size: int, dst: int, src: int,
+                         off: int) -> "Asm":
+        """src = fetch_add(*(dst + off), src) — the OLD value lands in
+        src, making read-modify-write one atomic op (BPF_FETCH)."""
+        self._insns.append(("raw", _insn(BPF_STX | BPF_ATOMIC | size,
+                                         dst, src, off,
+                                         BPF_ADD | BPF_FETCH)))
         return self
 
     def ld_map_fd(self, dst: int, map_: Map) -> "Asm":
@@ -388,9 +398,10 @@ def build_capture_filter(counters: Map,
         a.st_imm(BPF_W, R10, -4, 2)       # cell 2: sample counter
         a.call(FN_map_lookup_elem)
         a.jmp_imm(BPF_JEQ, R0, 0, "deliver")
-        a.ldx_mem(BPF_DW, R8, R0, 0)
-        a.mov_imm(R1, 1)
-        a.atomic_add(BPF_DW, R0, R1, 0)
+        # one atomic fetch-add: separate load+add would let two CPUs
+        # observe the same count and both deliver, skewing the ratio
+        a.mov_imm(R8, 1)
+        a.atomic_fetch_add(BPF_DW, R0, R8, 0)
         a.alu_imm(BPF_AND, R8, (1 << sample_shift) - 1)
         a.jmp_imm(BPF_JNE, R8, 0, "drop")
     a.label("deliver")

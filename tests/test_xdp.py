@@ -1,0 +1,108 @@
+"""AF_XDP capture source: real XDP redirect on loopback.
+
+These tests attach a REAL XDP program (generic mode) to lo and read
+frames out of the XSK rings. While attached, the redirect CONSUMES
+lo's ingress — each test keeps the window short and detaches in a
+finally so the rest of the suite (and any loopback tunnel) is
+untouched. Skipped wholesale where the container forbids the path."""
+
+import socket
+import time
+
+import pytest
+
+from deepflow_tpu.agent import xdp
+
+pytestmark = pytest.mark.skipif(not xdp.available(),
+                                reason="AF_XDP unavailable")
+
+
+def _flood(port: int, n: int, tag: bytes = b"x") -> None:
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for i in range(n):
+        tx.sendto(tag + b"-%d" % i, ("127.0.0.1", port))
+    tx.close()
+
+
+def test_xdp_capture_roundtrip():
+    src = xdp.XdpSource("lo", frame_count=256)
+    try:
+        _flood(55988, 50, b"xdpA")
+        time.sleep(0.2)
+        frames, stamps = src.read_batch()
+        hits = sum(1 for f in frames if b"xdpA-" in f)
+        assert hits == 50
+        assert len(stamps) == len(frames)
+        # frames recycle through the fill ring: a second burst larger
+        # than half the UMEM must still arrive intact
+        _flood(55988, 200, b"xdpB")
+        time.sleep(0.2)
+        frames, _ = src.read_batch()
+        assert sum(1 for f in frames if b"xdpB-" in f) == 200
+        dropped, ring_full = src.statistics()
+        assert dropped == 0
+    finally:
+        src.close()
+
+
+def test_xdp_capture_loop_and_flow_map():
+    """CaptureLoop + a real FlowMap over XSK frames: the decode path
+    accepts XDP-delivered frames like any other source's."""
+    from deepflow_tpu.agent.afpacket import CaptureLoop
+    from deepflow_tpu.agent.packet import decode_packets
+    import numpy as np
+
+    class DecodeAgent:
+        def __init__(self):
+            self.rows = 0
+
+        def feed(self, frames, stamps):
+            pkt = decode_packets(frames, np.asarray(stamps, np.uint64))
+            self.rows += int(pkt["valid"].sum())
+            return len(frames)
+
+    agent = DecodeAgent()
+    src = xdp.XdpSource("lo", frame_count=256)
+    loop = CaptureLoop(src, agent)
+    loop.start()
+    try:
+        _flood(55987, 80, b"flow")
+        deadline = time.time() + 3
+        while time.time() < deadline and agent.rows < 80:
+            time.sleep(0.05)
+        assert agent.rows >= 80
+    finally:
+        loop.close()
+
+
+def test_xdp_detach_restores_loopback():
+    """After close(), lo ingress must flow normally again (the XDP
+    program is removed via netlink, not leaked)."""
+    src = xdp.XdpSource("lo", frame_count=64)
+    src.close()
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(2)
+    port = rx.getsockname()[1]
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.sendto(b"after-detach", ("127.0.0.1", port))
+    tx.close()
+    assert rx.recv(64) == b"after-detach"
+    rx.close()
+
+
+def test_xdp_bootstrap_validation(tmp_path):
+    from deepflow_tpu.agent.__main__ import load_bootstrap
+    p = tmp_path / "a.yaml"
+    p.write_text("capture: {engine: xdp}\n")
+    with pytest.raises(ValueError, match="iface"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: raw, queue: 1}\n")
+    with pytest.raises(ValueError, match="queue"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: xdp, iface: lo, bpf: {proto: 6}}\n")
+    with pytest.raises(ValueError, match="raw or ring"):
+        load_bootstrap(str(p))
+    p.write_text("capture: {engine: xdp, iface: lo, frame_count: 128}\n")
+    _, capture = load_bootstrap(str(p))
+    assert capture["frame_count"] == 128
