@@ -76,6 +76,20 @@ def main() -> None:
             iface="lo", block_size=1 << 20, block_count=8,
             retire_ms=10, poll_ms=20),
         args.seconds, args.payload)
+    from deepflow_tpu.agent import xdp
+    if xdp.available():
+        # NOTE: while attached, the redirect consumes lo ingress — the
+        # flood's own socket never sees replies anyway, so the bench is
+        # unaffected, but anything else using loopback concurrently
+        # (debug sockets, local tunnels) loses its traffic for the
+        # bench window. Run this bench alone.
+        bench_source(
+            "capture_af_xdp", lambda: xdp.XdpSource(
+                "lo", frame_count=2048, batch_size=8192, poll_ms=20),
+            args.seconds, args.payload)
+    else:
+        print(json.dumps({"bench": "capture_af_xdp",
+                          "skipped": "AF_XDP unavailable"}), flush=True)
 
 
 if __name__ == "__main__":

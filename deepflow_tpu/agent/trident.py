@@ -67,6 +67,11 @@ class AgentConfig:
     # TCP headers -> l4_packet rows. Off by default like the reference's
     # packet_sequence_flag=0 (config.rs:519)
     packet_sequence: bool = False
+    # l4 flow-log aggregation interval (agent/flow_aggr.py, the
+    # collector/flow_aggr.rs role): 0 ships every 1s tick row; 60
+    # matches the reference's 1m l4_flow_log granularity. The metrics
+    # fork (quadruple documents) always stays at 1s either way.
+    l4_log_aggr_s: int = 0
     # agent-side UDP debug server (reference: agent/src/debug/ serving
     # per-subsystem dumps to deepflow-ctl). None disables; 0 = ephemeral
     debug_port: Optional[int] = None
@@ -214,6 +219,11 @@ class Agent:
                              local_macs=set(cfg.local_macs)),
             policy=self.policy, enforcer=self.enforcer)
         self.sessions = SessionAggregator()
+        self.flow_aggr = None
+        self._pending_aggr = None     # stash drained on interval change
+        if cfg.l4_log_aggr_s:
+            from deepflow_tpu.agent.flow_aggr import FlowAggr
+            self.flow_aggr = FlowAggr(cfg.l4_log_aggr_s)
         self.guard = Guard()
         self.escape = EscapeTimer(cfg.escape_after_s, self._on_escape)
         sender_types = [MessageType.TAGGEDFLOW, MessageType.METRICS,
@@ -253,6 +263,13 @@ class Agent:
 
         self.stats = StatsRegistry()
         self.stats.register("agent.flow_map", self.flow_map.counters)
+        # closure, not a bound method: the aggregator hot-swaps when a
+        # pushed config changes l4_log_aggr_s
+        self.stats.register(
+            "agent.flow_aggr",
+            lambda: (self.flow_aggr.counters() if self.flow_aggr
+                     is not None else {"rows_in": 0, "rows_out": 0,
+                                       "stashed": 0, "enabled": 0}))
         self.stats.register("agent.dispatcher", self.dispatcher.counters)
         self.stats.register("agent.enforcer", self.enforcer.counters)
         self.stats.register("agent.guard", self.guard.counters)
@@ -373,6 +390,34 @@ class Agent:
                               cfg.get("max_cpus", 1))
         self.cfg.l7_enabled = bool(cfg.get("l7_log_enabled", True))
         self.cfg.sync_interval_s = cfg.get("sync_interval_s", 60)
+        # flow-log aggregation interval is hot-switchable; turning it
+        # OFF flushes the stash so no merged rows strand. Under the
+        # agent lock: tick() (flow-tick thread) reads/advances the
+        # same aggregator.
+        if "l4_log_aggr_s" in cfg:
+            want = int(cfg["l4_log_aggr_s"] or 0)
+            with self._lock:
+                have = (self.flow_aggr.interval_s
+                        if self.flow_aggr is not None else 0)
+                if want != have:
+                    if self.flow_aggr is not None:
+                        out = self.flow_aggr.flush()
+                        if out is not None:
+                            # stash drains through the NEXT tick; a
+                            # second switch before that tick must
+                            # APPEND, not clobber
+                            if self._pending_aggr is not None:
+                                out = {k: np.concatenate(
+                                    [self._pending_aggr[k], out[k]])
+                                    for k in out
+                                    if k in self._pending_aggr}
+                            self._pending_aggr = out
+                    if want:
+                        from deepflow_tpu.agent.flow_aggr import FlowAggr
+                        self.flow_aggr = FlowAggr(want)
+                    else:
+                        self.flow_aggr = None
+                    self.cfg.l4_log_aggr_s = want
         # absent or None = plugins not managed by this push; a LIST is
         # authoritative (pushing [] must actually stop a plugin)
         if cfg.get("so_plugins") is not None:
@@ -499,16 +544,40 @@ class Agent:
                     + self.pseq.flush(now_ns, force=final)
                 self._pseq_pending = []
         sent = {"flows": 0, "documents": 0, "l7": 0}
-        if len(cols["ip_src"]):
+        # flow-log fork: optionally aggregated to l4_log_aggr_s buckets
+        # (flow_aggr.rs); the metrics fork below always sees the 1s
+        # cols. Under the agent lock: _apply_config (synchronizer
+        # thread) flushes/swaps the aggregator on hot-switch, and the
+        # stash's slot bookkeeping is not safe against that interleave.
+        flow_cols = cols
+        with self._lock:
+            if self.flow_aggr is not None:
+                agg = self.flow_aggr.add(cols, now_ns)
+                if final:
+                    fin = self.flow_aggr.flush()
+                    if fin is not None:
+                        agg = fin if agg is None else {
+                            k: np.concatenate([agg[k], fin[k]])
+                            for k in agg}
+                flow_cols = agg
+            if self._pending_aggr is not None:
+                # rows flushed by an interval hot-switch ride this tick
+                pend, self._pending_aggr = self._pending_aggr, None
+                flow_cols = pend if flow_cols is None or not len(
+                    flow_cols.get("ip_src", ())) else {
+                        k: np.concatenate([flow_cols[k], pend[k]])
+                        for k in pend if k in flow_cols}
+        if flow_cols is not None and len(flow_cols["ip_src"]):
             if self.cfg.wire_mode == "columnar":
                 from deepflow_tpu.batch.schema import L4_SCHEMA
                 sent["flows"] = self.senders[
                     MessageType.COLUMNAR_FLOW].send_columns(
-                        columns_to_l4_schema(cols), L4_SCHEMA)
+                        columns_to_l4_schema(flow_cols), L4_SCHEMA)
             else:
-                records = columns_to_l4_records(cols)
+                records = columns_to_l4_records(flow_cols)
                 sent["flows"] = self.senders[
                     MessageType.TAGGEDFLOW].send(records)
+        if len(cols["ip_src"]):
             docs = flows_to_documents(cols, now_ns // 1_000_000_000)
             doc_records = documents_to_records(docs)
             sent["documents"] = self.senders[MessageType.METRICS].send(

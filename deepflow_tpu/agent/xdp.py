@@ -273,10 +273,13 @@ class XdpSource:
         return frames, stamps
 
     def statistics(self) -> Tuple[int, int]:
-        """(rx_dropped, rx_ring_full) from XDP_STATISTICS."""
+        """(rx_dropped, rx_ring_full) from XDP_STATISTICS.
+        struct xdp_statistics: {rx_dropped, rx_invalid_descs,
+        tx_invalid_descs, rx_ring_full, rx_fill_ring_empty_descs,
+        tx_ring_empty_descs} — ring_full is field 3, not 2."""
         raw = self._sock.getsockopt(SOL_XDP, XDP_STATISTICS, 48)
-        dropped, invalid, ring_full = struct.unpack_from("<3Q", raw)
-        return dropped, ring_full
+        vals = struct.unpack_from("<6Q", raw.ljust(48, b"\x00"))
+        return vals[0], vals[3]
 
     def close(self) -> None:
         if self._closed:

@@ -93,6 +93,12 @@ l7_log_enabled: true
 # controller sync cadence, seconds
 sync_interval_s: 60
 
+# l4 flow-log aggregation interval (collector/flow_aggr role):
+# 0 ships every 1s tick row; 60 = one merged row per flow per minute
+# (the metrics fork always stays at 1s). Hot-switchable; switching
+# drains the stash through the next tick.
+l4_log_aggr_s: 0
+
 # L7 parser plugins. Omitted (or null) = not managed by this group:
 # agents keep whatever they loaded statically. A LIST is authoritative
 # and hot-converges agents to exactly it — so an explicit [] unloads

@@ -1,0 +1,183 @@
+"""FlowAggr: 1m flow-log aggregation (collector/flow_aggr.rs role)."""
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.flow_aggr import FlowAggr
+
+
+def _tick(flow_id, byte_tx=100, close_type=0, start=1_000_000_000_000,
+          duration=1_000_000_000, rtt=0, srt_sum=0, srt_count=0,
+          srt_max=0, is_new=0):
+    n = len(flow_id)
+    mk = lambda v, dt: np.full(n, v, dt) if np.isscalar(v) \
+        else np.asarray(v, dt)                              # noqa: E731
+    return {
+        "flow_id": np.asarray(flow_id, np.uint64),
+        "ip_src": mk(0x0A000001, np.uint32),
+        "ip_dst": mk(0x0A000002, np.uint32),
+        "port_src": mk(40000, np.uint32),
+        "port_dst": mk(80, np.uint32),
+        "proto": mk(6, np.uint32),
+        "byte_tx": mk(byte_tx, np.uint64),
+        "packet_tx": mk(1, np.uint64),
+        "retrans": mk(0, np.uint32),
+        "close_type": mk(close_type, np.uint32),
+        "start_time": mk(start, np.uint64),
+        "duration": mk(duration, np.uint64),
+        "rtt": mk(rtt, np.uint32),
+        "srt_sum": mk(srt_sum, np.uint32),
+        "srt_count": mk(srt_count, np.uint32),
+        "srt_max": mk(srt_max, np.uint32),
+        "is_new_flow": mk(is_new, np.uint32),
+        "status": mk(0, np.uint32),
+    }
+
+
+NS = 1_000_000_000
+
+
+def test_active_flow_merges_until_bucket_boundary():
+    fa = FlowAggr(interval_s=60)
+    t0 = 1_700_000_000 * NS
+    # 5 ticks of the same flow inside one minute: nothing emits
+    for i in range(5):
+        out = fa.add(_tick([7], byte_tx=100, start=t0 + i * NS,
+                           duration=NS, srt_sum=10, srt_count=1,
+                           srt_max=5 + i, is_new=1 if i == 0 else 0),
+                     now_ns=t0 + i * NS)
+        assert out is None
+    assert fa.counters()["stashed"] == 1
+    # minute boundary: the merged row flushes as a forced report
+    out = fa.add({"flow_id": np.empty(0, np.uint64)}, now_ns=t0 + 60 * NS)
+    assert out is not None and len(out["flow_id"]) == 1
+    assert out["byte_tx"][0] == 500          # summed
+    assert out["srt_sum"][0] == 50
+    assert out["srt_count"][0] == 5
+    assert out["srt_max"][0] == 9            # max
+    assert out["is_new_flow"][0] == 1        # OR across reports
+    assert out["start_time"][0] == t0
+    # duration spans first start -> last end: 5 ticks of 1s each
+    assert out["duration"][0] == 5 * NS
+    assert fa.counters()["stashed"] == 0
+
+
+def test_closed_flow_emits_immediately_merged():
+    fa = FlowAggr(interval_s=60)
+    t0 = 1_700_000_100 * NS
+    assert fa.add(_tick([9], byte_tx=100, start=t0, duration=NS),
+                  now_ns=t0) is None
+    out = fa.add(_tick([9], byte_tx=40, close_type=1, start=t0 + NS,
+                       duration=NS), now_ns=t0 + NS)
+    assert out is not None and len(out["flow_id"]) == 1
+    assert out["byte_tx"][0] == 140
+    assert out["close_type"][0] == 1
+    assert fa.counters()["stashed"] == 0
+    # the slot is reusable afterwards
+    assert fa.add(_tick([10]), now_ns=t0 + 2 * NS) is None
+    assert fa.counters()["stashed"] == 1
+
+
+def test_boundary_flush_and_new_rows_in_same_add():
+    fa = FlowAggr(interval_s=60)
+    t0 = (1_700_000_220 // 60) * 60 * NS     # aligned minute start
+    fa.add(_tick([1]), now_ns=t0)
+    # next add crosses the boundary AND closes a new flow: both emit
+    out = fa.add(_tick([2], close_type=2), now_ns=t0 + 61 * NS)
+    assert out is not None
+    got = sorted(out["flow_id"].tolist())
+    assert got == [1, 2]
+    # flow 1 was a forced report (close 0), flow 2 closed with RST
+    by = dict(zip(out["flow_id"].tolist(), out["close_type"].tolist()))
+    assert by[1] == 0 and by[2] == 2
+
+
+def test_identity_columns_first_value_wins():
+    fa = FlowAggr(interval_s=60)
+    t0 = 1_700_000_300 * NS
+    fa.add(_tick([5]), now_ns=t0)
+    second = _tick([5], close_type=3)
+    second["ip_src"][:] = 0xDEAD             # must NOT overwrite
+    out = fa.add(second, now_ns=t0 + NS)
+    assert out["ip_src"][0] == 0x0A000001
+
+
+def test_flush_on_shutdown():
+    fa = FlowAggr(interval_s=60)
+    t0 = 1_700_000_400 * NS
+    fa.add(_tick([3, 4]), now_ns=t0)
+    out = fa.flush()
+    assert sorted(out["flow_id"].tolist()) == [3, 4]
+    assert fa.flush() is None
+
+
+def test_growth_past_initial_capacity():
+    fa = FlowAggr(interval_s=3600)
+    t0 = 1_700_003_600 * NS
+    ids = list(range(1, 200))
+    fa.add(_tick(ids), now_ns=t0)
+    assert fa.counters()["stashed"] == 199
+    out = fa.flush()
+    assert len(out["flow_id"]) == 199
+
+
+def test_agent_level_aggregation():
+    """Through the real Agent: with l4_log_aggr_s, mid-life ticks ship
+    no flow rows; the final close ships ONE merged row; metrics keep
+    flowing every tick."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+    import time as _t
+
+    agent = Agent(AgentConfig(self_telemetry=False, l4_log_aggr_s=3600))
+    try:
+        C, S = ip4(10, 0, 0, 1), ip4(10, 0, 0, 2)
+        t0 = int(_t.time() * 1e9)
+        for i in range(3):
+            frames = [eth_ipv4_tcp(C, S, 40001, 80, 0x10,
+                                   b"x" * 10, seq=i + 1)]
+            agent.feed(frames, np.asarray([t0 + i * NS], np.uint64))
+            agent.tick(t0 + (i + 1) * NS)
+            # stashed, not shipped (no ingester here, so assert on the
+            # aggregator's own books, not sender delivery counts)
+            assert agent.flow_aggr.counters()["rows_out"] == 0
+        assert agent.flow_aggr.counters()["stashed"] == 1
+        assert agent.flow_aggr.counters()["rows_in"] == 3
+        # FIN both ways closes the flow -> one merged row ships
+        fin = [eth_ipv4_tcp(C, S, 40001, 80, 0x11, b"", seq=10),
+               eth_ipv4_tcp(S, C, 80, 40001, 0x11, b"", seq=10)]
+        agent.feed(fin, np.asarray([t0 + 4 * NS, t0 + 4 * NS + 1000],
+                                   np.uint64))
+        agent.tick(t0 + 5 * NS)
+        c = agent.flow_aggr.counters()
+        assert c["rows_out"] == 1 and c["stashed"] == 0
+    finally:
+        agent.close()
+
+
+def test_hot_switch_drains_stash():
+    """Pushed-config interval change flushes stashed rows through the
+    next tick instead of stranding them."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+    import time as _t
+
+    agent = Agent(AgentConfig(self_telemetry=False, l4_log_aggr_s=3600))
+    try:
+        C, S = ip4(10, 0, 0, 3), ip4(10, 0, 0, 4)
+        t0 = int(_t.time() * 1e9)
+        agent.feed([eth_ipv4_tcp(C, S, 40002, 80, 0x10, b"y", seq=1)],
+                   np.asarray([t0], np.uint64))
+        agent.tick(t0 + NS)
+        assert agent.flow_aggr.counters()["stashed"] == 1
+        agent._apply_config({"l4_log_aggr_s": 0})
+        assert agent.flow_aggr is None
+        assert agent._pending_aggr is not None
+        agent.tick(t0 + 2 * NS)
+        assert agent._pending_aggr is None
+        # and switching back on builds a fresh aggregator
+        agent._apply_config({"l4_log_aggr_s": 60})
+        assert agent.flow_aggr is not None
+        assert agent.flow_aggr.interval_s == 60
+    finally:
+        agent.close()
