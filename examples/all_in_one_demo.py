@@ -139,6 +139,36 @@ querier:
     sent = agent.tick(T0 + 1_000_000_000)
     print(f"agent: {fed} packets -> sent {sent}")
 
+    # -- 3b. kernel eBPF capture filter on live loopback -------------------
+    # the recv_engine's BPF injection, end to end: an in-tree-assembled
+    # filter runs IN KERNEL on a real socket; non-matching packets never
+    # reach userspace, and the verdict counters live in a BPF map
+    from deepflow_tpu.agent import bpf as bpf_mod
+    if bpf_mod.available():
+        import socket as _socket
+        from deepflow_tpu.agent.afpacket import AfPacketSource
+        filt = bpf_mod.BpfFilter(proto=17, port=53530)
+        # prepare hook: the filter lands on the socket BEFORE bind, so
+        # the server's own loopback chatter can't slip in pre-attach
+        csrc = AfPacketSource("lo", batch_size=512, poll_ms=150,
+                              prepare=filt.attach_socket)
+        csrc.bpf = filt
+        tx = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        for i in range(20):
+            tx.sendto(b"demo-match", ("127.0.0.1", 53530))
+            tx.sendto(b"demo-noise", ("127.0.0.1", 49999))
+        tx.close()
+        time.sleep(0.2)
+        live_frames, _ = csrc.read_batch()
+        noise = sum(1 for f in live_frames if b"demo-noise" in f)
+        c = filt.counters()
+        csrc.close()
+        filt.close()
+        assert noise == 0, "kernel filter leaked non-matching packets"
+        print(f"kernel eBPF filter: {c['bpf_seen']} pkts seen in kernel, "
+              f"{c['bpf_accepted']} accepted, {len(live_frames)} "
+              f"delivered, 0 noise")
+
     # -- 4. ingester + sketches -------------------------------------------
     deadline = time.time() + 15
     while time.time() < deadline:
