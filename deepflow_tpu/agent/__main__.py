@@ -194,6 +194,7 @@ def main(argv=None) -> int:
     agent.start()
     if source is not None and not stop.is_set():
         from deepflow_tpu.agent.afpacket import CaptureLoop
+        agent.attach_source(source)       # ebpf debug dump reads it
         loop = CaptureLoop(source, agent, stats=agent.stats)
         loop.start()
     stop.wait()

@@ -250,6 +250,7 @@ class Agent:
         self.k8s_watcher = None
         self.api_watcher = None
         self.ntp_offset_ns = 0
+        self._capture_source = None   # set via attach_source()
         self.so_plugins: Dict[str, object] = {}
         for path in cfg.so_plugins:
             self._load_plugin(path)
@@ -306,6 +307,23 @@ class Agent:
             "so": [p.counters() for p in self.so_plugins.values()],
             "wasm": [p.counters() for p in self.wasm_plugins.values()]})
 
+        def _ebpf_dump(req: dict) -> dict:
+            # the reference's `deepflow-ctl agent ebpf` dump: what the
+            # kernel side is doing — loader availability, attached
+            # capture filters (kernel verdict counters), and the
+            # syscall-tracer state machine if one is wired
+            from deepflow_tpu.agent import bpf as bpf_mod
+            out: dict = {"bpf_available": bpf_mod.available()}
+            tracer = getattr(self, "ebpf_tracer", None)
+            if tracer is not None:
+                out["tracer"] = tracer.counters()
+            src = self._capture_source
+            filt = getattr(src, "bpf", None) if src is not None else None
+            if filt is not None:
+                out["capture_filter"] = {**filt.counters(), **filt.spec}
+            return out
+        self.debug.register("ebpf", _ebpf_dump)
+
     def _load_plugin(self, path: str) -> bool:
         """dlopen + register one L7 plugin; a broken .so must not take
         the agent down (reference: load_plugin error path just logs)."""
@@ -332,6 +350,12 @@ class Agent:
             # sandbox's own trap conversion is armed; none of them may
             # take the agent down
             return False
+
+    def attach_source(self, source) -> None:
+        """Declare the live capture source feeding this agent (the
+        CaptureLoop's source) so the debug surface can introspect it
+        (ebpf dump: attached filter spec + kernel verdict counters)."""
+        self._capture_source = source
 
     def set_vtap_id(self, vtap_id: int) -> None:
         """Fan the assigned id out to every component that stamps it:

@@ -392,6 +392,15 @@ class FlowLogPipeline:
             w.flush()
         self._prune_pseq_blobs()
 
+    def tick(self) -> None:
+        """Wall-clock throttle-bucket roll: without it, a stream that
+        goes quiet strands its last bucket in the reservoir until the
+        NEXT record arrives (possibly never) — the writer's 10s flush
+        timer can't see rows the throttler hasn't released."""
+        for d in self.decoders:
+            if d.throttler is not None:
+                d.throttler.tick()
+
     def _prune_pseq_blobs(self) -> None:
         """Remove batch blob files whose table partition has expired
         (TTL/GC drop the rows; the bytes must follow). Only partitions

@@ -10,6 +10,7 @@ pure-TPU sketch deployment runs in.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -263,6 +264,21 @@ class Ingester:
             self.monitor.start()
         if self.debug is not None:
             self.debug.start()
+        # throttle-bucket janitor: rolls idle reservoir buckets on wall
+        # clock so a quiet stream's rows reach the writer within one
+        # bucket width instead of waiting for the next record
+        self._janitor_stop = threading.Event()
+
+        def _janitor():
+            while not self._janitor_stop.wait(1.0):
+                for p in self._pipelines:
+                    tick = getattr(p, "tick", None)
+                    if tick is not None:
+                        tick()
+        self._janitor = threading.Thread(target=_janitor,
+                                         name="throttle-janitor",
+                                         daemon=True)
+        self._janitor.start()
         self.receiver.start()  # last, like the reference (ingester.go:220)
 
     def flush(self) -> None:
@@ -276,6 +292,10 @@ class Ingester:
         self.tag_dicts.flush()
 
     def close(self) -> None:
+        janitor_stop = getattr(self, "_janitor_stop", None)
+        if janitor_stop is not None:
+            janitor_stop.set()
+            self._janitor.join(timeout=2)
         self.receiver.close()
         for p in self._pipelines:
             p.close()

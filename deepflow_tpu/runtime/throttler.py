@@ -34,6 +34,9 @@ class ThrottlingQueue:
         self._reservoir: List[Any] = []
         self._seen = 0           # records offered this bucket
         self._bucket = self._bucket_of(clock())
+        # same lock discipline as ColumnarThrottler: tick() runs on a
+        # janitor thread while send() runs on a decoder thread
+        self._lock = threading.Lock()
         # Countable counters
         self.in_count = 0
         self.sampled_out = 0     # records dropped by sampling
@@ -44,32 +47,46 @@ class ThrottlingQueue:
 
     def send(self, item: Any) -> bool:
         """Offer one record. Returns False iff it was sampled away."""
-        now = self._clock()
-        if self._bucket_of(now) != self._bucket:
-            self.flush()
-            self._bucket = self._bucket_of(now)
-        self.in_count += 1
-        self._seen += 1
-        if len(self._reservoir) < self.capacity:
-            self._reservoir.append(item)
-            return True
-        # classic Algorithm R: keep with prob capacity/seen
-        j = self._rng.randrange(self._seen)
-        if j < self.capacity:
-            self._reservoir[j] = item
-            self.sampled_out += 1   # displaced one previously-kept record
-            return True
-        self.sampled_out += 1
-        return False
+        with self._lock:
+            now = self._clock()
+            if self._bucket_of(now) != self._bucket:
+                self._flush_locked()
+                self._bucket = self._bucket_of(now)
+            self.in_count += 1
+            self._seen += 1
+            if len(self._reservoir) < self.capacity:
+                self._reservoir.append(item)
+                return True
+            # classic Algorithm R: keep with prob capacity/seen
+            j = self._rng.randrange(self._seen)
+            if j < self.capacity:
+                self._reservoir[j] = item
+                self.sampled_out += 1   # displaced a kept record
+                return True
+            self.sampled_out += 1
+            return False
 
     def flush(self) -> None:
         """Emit the current bucket's survivors downstream."""
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         if self._reservoir:
             batch = self._reservoir
             self._reservoir = []
             self.emitted += len(batch)
             self._emit(batch)
         self._seen = 0
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Wall-clock bucket roll: a quiet stream's last bucket must
+        not strand in the reservoir (see ColumnarThrottler.tick)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self._bucket_of(now) != self._bucket:
+                self._flush_locked()
+                self._bucket = self._bucket_of(now)
 
     def counters(self) -> dict:
         return {
@@ -156,6 +173,18 @@ class ColumnarThrottler:
         """Emit the current bucket's survivors downstream."""
         with self._lock:
             self._flush_locked()
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Roll the bucket on WALL CLOCK: without this, a quiet stream
+        strands its last bucket in the reservoir forever (rolls
+        otherwise only happen when the NEXT record arrives). Called
+        periodically by the ingester's janitor; mid-bucket it's a
+        no-op, so reservoir uniformity is untouched."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if int(now) // self.bucket_s != self._bucket:
+                self._flush_locked()
+                self._bucket = int(now) // self.bucket_s
 
     def _flush_locked(self) -> None:
         if self._res is not None and self._fill:

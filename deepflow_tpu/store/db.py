@@ -57,6 +57,7 @@ class Table:
                     self._seq = max(self._seq, int(f[4:-4]) + 1)
         self.rows_written = 0
         self.segments_written = 0
+        self.segments_compacted = 0
 
     # -- manifest ----------------------------------------------------------
     def _save_manifest(self) -> None:
@@ -104,10 +105,19 @@ class Table:
         files: List[str] = []
         for p in partitions:
             pdir = os.path.join(self.root, _partition_dir(p))
-            if os.path.isdir(pdir):
-                files.extend(os.path.join(pdir, f)
-                             for f in sorted(os.listdir(pdir))
+            if not os.path.isdir(pdir):
+                continue
+            listing = sorted(f for f in os.listdir(pdir)
                              if f.startswith("seg-") and f.endswith(".npz"))
+            # compaction superseded-set: skip sources whose merged
+            # segment is present in THIS listing (sources linger one
+            # sweep for in-flight readers; counting both would double)
+            manifest = self._merged_manifest(pdir)
+            have = set(listing)
+            superseded = {s for merged, srcs in manifest.items()
+                          if merged in have for s in srcs}
+            files.extend(os.path.join(pdir, f) for f in listing
+                         if f not in superseded)
         return files
 
     def scan(self, columns: Optional[Sequence[str]] = None,
@@ -162,6 +172,128 @@ class Table:
                      np.empty(0, dtype=self.schema.spec(nm).dtype))
                 for nm, v in out.items()}
 
+    # -- compaction --------------------------------------------------------
+    # The reference leans on ClickHouse background merges to keep part
+    # counts bounded; this store's analogue merges a partition's small
+    # segments into one. Swap protocol (scan() stays lockless): the
+    # merged segment lands atomically, merged.json records which source
+    # segments it supersedes, and the sources are DELETED ONE SWEEP
+    # LATER — a reader that listed before the manifest update still
+    # loads the sources (no merged file in its listing: correct), one
+    # that listed after skips them via the manifest (correct), and by
+    # the deferred delete every in-flight scan is long done.
+    def _merged_manifest(self, pdir: str) -> Dict[str, List[str]]:
+        path = os.path.join(pdir, "merged.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            return {}
+
+    def compact(self, max_segment_bytes: int = 64 << 20,
+                min_segments: int = 8, max_sources: int = 64) -> int:
+        """Merge each partition's small segments (one pass); returns
+        segments removed from circulation. Call periodically (the disk
+        monitor does). At most max_sources (and max_segment_bytes of
+        input) merge per partition per sweep — an unbounded concat of a
+        large backlog would balloon the monitor thread's memory the way
+        ClickHouse bounds merge input sizes to avoid."""
+        removed = 0
+        for p in self.partitions():
+            pdir = os.path.join(self.root, _partition_dir(p))
+            manifest = self._merged_manifest(pdir)
+            # phase 1: delete sources superseded by a PREVIOUS sweep
+            done = []
+            for merged, sources in manifest.items():
+                if os.path.exists(os.path.join(pdir, merged)):
+                    for s in sources:
+                        try:
+                            os.unlink(os.path.join(pdir, s))
+                        except FileNotFoundError:
+                            pass
+                done.append(merged)
+            if done:
+                manifest = {}
+                self._write_merged_manifest(pdir, manifest)
+            # phase 2: merge this sweep's small segments (bounded input)
+            small = []
+            small_bytes = 0
+            for f in sorted(os.listdir(pdir)):
+                if not (f.startswith("seg-") and f.endswith(".npz")):
+                    continue
+                fp = os.path.join(pdir, f)
+                try:
+                    sz = os.path.getsize(fp)
+                except OSError:
+                    continue
+                if sz < max_segment_bytes:
+                    if (len(small) >= max_sources
+                            or small_bytes + sz > max_segment_bytes):
+                        break       # rest merges on later sweeps
+                    small.append(f)
+                    small_bytes += sz
+            if len(small) < min_segments:
+                continue
+            cols: Dict[str, List[np.ndarray]] = {
+                c.name: [] for c in self.schema.columns}
+            ok: List[str] = []
+            for f in small:
+                try:
+                    z = np.load(os.path.join(pdir, f))
+                except (FileNotFoundError, OSError):
+                    continue
+                with z:
+                    length = z[z.files[0]].shape[0]
+                    for c in self.schema.columns:
+                        stored = next(
+                            (s for s in self.schema.stored_names(c.name)
+                             if s in z.files), None)
+                        cols[c.name].append(
+                            z[stored] if stored is not None
+                            else np.full(length, c.default, c.dtype))
+                ok.append(f)
+            if len(ok) < min_segments:
+                continue
+            seg = {nm: np.ascontiguousarray(
+                       np.concatenate(v).astype(
+                           self.schema.spec(nm).dtype, copy=False))
+                   for nm, v in cols.items()}
+            with self._lock:
+                name = f"seg-{self._seq:08d}.npz"
+                self._seq += 1
+            path = os.path.join(pdir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **seg)
+            # ORDER IS THE PROTOCOL: manifest first, merged segment
+            # second. A reader between the two steps sees the manifest
+            # entry but no merged file in its listing ('merged in have'
+            # fails) and correctly loads the sources; the reverse order
+            # would double-count — and a crash between the steps would
+            # double-count PERMANENTLY. A crash after the manifest but
+            # before the replace leaves a dangling entry phase 1 later
+            # discards harmlessly.
+            manifest[name] = ok
+            self._write_merged_manifest(pdir, manifest)
+            os.replace(tmp, path)
+            removed += len(ok)
+            self.segments_compacted += len(ok)
+        return removed
+
+    def _write_merged_manifest(self, pdir: str,
+                               manifest: Dict[str, List[str]]) -> None:
+        path = os.path.join(pdir, "merged.json")
+        if not manifest:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+
     def row_count(self) -> int:
         total = 0
         for path in self._segment_files(self.partitions()):
@@ -201,27 +333,34 @@ class Table:
         shutil.rmtree(os.path.join(self.root, _partition_dir(start)),
                       ignore_errors=True)
 
-    def disk_bytes(self) -> int:
+    def _physical_bytes(self, partitions: Iterable[int]) -> int:
+        """PHYSICAL on-disk bytes — includes superseded compaction
+        sources that linger one sweep. Watermark GC must see real disk
+        usage or a tightly sized volume hits ENOSPC while GC reports
+        headroom."""
         total = 0
-        for path in self._segment_files(self.partitions()):
-            try:
-                total += os.path.getsize(path)
-            except OSError:
+        for p in partitions:
+            pdir = os.path.join(self.root, _partition_dir(p))
+            if not os.path.isdir(pdir):
                 continue
+            for f in os.listdir(pdir):
+                if f.endswith(".npz"):
+                    try:
+                        total += os.path.getsize(os.path.join(pdir, f))
+                    except OSError:
+                        continue
         return total
 
+    def disk_bytes(self) -> int:
+        return self._physical_bytes(self.partitions())
+
     def partition_bytes(self, start: int) -> int:
-        total = 0
-        for path in self._segment_files([start]):
-            try:
-                total += os.path.getsize(path)
-            except OSError:
-                continue
-        return total
+        return self._physical_bytes([start])
 
     def counters(self) -> dict:
         return {"rows_written": self.rows_written,
                 "segments_written": self.segments_written,
+                "segments_compacted": self.segments_compacted,
                 "partitions": len(self.partitions())}
 
 

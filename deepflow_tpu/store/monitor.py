@@ -27,6 +27,7 @@ class DiskMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.partitions_dropped = 0
+        self.segments_compacted = 0
         self.ttl_dropped = 0
         if stats is not None:
             stats.register("ckmonitor", self.counters)
@@ -43,9 +44,22 @@ class DiskMonitor:
             self._thread = None
 
     def check_once(self, now: Optional[float] = None) -> int:
-        """TTL expiry + watermark GC; returns partitions dropped."""
+        """TTL expiry + segment compaction + watermark GC; returns
+        partitions dropped."""
         now = time.time() if now is None else now
         self.ttl_dropped += self.store.expire_all(now)
+        # bound per-partition segment counts (ClickHouse background
+        # merges' role): each sweep merges small segments and deletes
+        # the previous sweep's superseded sources
+        for db, tname in self.store.tables():
+            try:
+                self.segments_compacted += \
+                    self.store.table(db, tname).compact()
+            except (KeyError, OSError):
+                # table dropped (runtime datasource del) or its
+                # directory removed mid-compaction — the sweep thread
+                # must survive either, or TTL/watermark GC dies with it
+                continue
         dropped = 0
         used = self.store.disk_bytes()
         if used <= self.max_bytes:
@@ -80,4 +94,5 @@ class DiskMonitor:
     def counters(self) -> dict:
         return {"partitions_dropped": self.partitions_dropped,
                 "ttl_dropped": self.ttl_dropped,
+                "segments_compacted": self.segments_compacted,
                 "disk_bytes": self.store.disk_bytes()}

@@ -229,3 +229,29 @@ def test_bootstrap_bpf_validation(tmp_path):
     p.write_text("capture: {engine: raw, bpf: {proto: 6, port: 80}}\n")
     cfg, capture = load_bootstrap(str(p))
     assert capture["bpf"] == {"proto": 6, "port": 80}
+
+
+def test_agent_ebpf_debug_dump():
+    """`df-ctl agent ebpf` surface: loader availability + attached
+    capture-filter verdicts over the real debug protocol."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.agent.afpacket import AfPacketSource
+    from deepflow_tpu.runtime.debug import debug_request
+
+    agent = Agent(AgentConfig(self_telemetry=False, debug_port=0))
+    filt = bpf.BpfFilter(proto=17, port=55992)
+    src = AfPacketSource("lo", prepare=filt.attach_socket)
+    src.bpf = filt
+    agent.attach_source(src)
+    agent.start()
+    try:
+        out = debug_request("ebpf", port=agent.debug.port)
+        assert out["ok"]
+        d = out["data"]
+        assert d["bpf_available"] is True
+        assert d["capture_filter"]["proto"] == 17
+        assert "bpf_seen" in d["capture_filter"]
+    finally:
+        src.close()
+        filt.close()
+        agent.close()

@@ -295,3 +295,52 @@ def test_queue_listing_and_tap(tmp_path):
         assert "unknown queue" in bad["error"]
     finally:
         ing.close()
+
+
+def test_quiet_stream_rows_land_within_bucket(tmp_path):
+    """A stream that goes quiet must still reach the store within one
+    throttle bucket + writer flush: the janitor rolls idle reservoir
+    buckets on wall clock (before this, rows strand until the NEXT
+    record arrives — possibly never)."""
+    import time as _t
+    from deepflow_tpu.runtime.throttler import ColumnarThrottler
+
+    got = []
+    clock = [1000.0]
+    t = ColumnarThrottler(got.append, throttle_per_s=100, bucket_s=8,
+                          clock=lambda: clock[0])
+    t.offer({"v": np.arange(5, dtype=np.uint32)})
+    t.tick()                    # same bucket: must NOT emit early
+    assert got == []
+    clock[0] = 1009.0           # wall clock leaves the bucket, no data
+    t.tick()
+    assert len(got) == 1 and len(got[0]["v"]) == 5
+    # ingester-level: closed flow rows land without any further traffic
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+    ing = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path)))
+    ing.start()
+    try:
+        agent = Agent(AgentConfig(host="q", self_telemetry=False,
+                                  ingester_addr=f"127.0.0.1:{ing.port}"))
+        NS = 1_000_000_000
+        t0 = int(_t.time() * 1e9)
+        C, S = ip4(10, 12, 0, 1), ip4(10, 12, 0, 2)
+        agent.feed([eth_ipv4_tcp(C, S, 43000, 80, 0x11, b"", seq=1),
+                    eth_ipv4_tcp(S, C, 80, 43000, 0x11, b"", seq=1)],
+                   np.asarray([t0, t0 + 1000], np.uint64))
+        agent.tick(t0 + NS)
+        agent.close()
+        # NO flush() call and NO further traffic: the janitor (1s
+        # cadence) must roll the bucket once wall clock leaves it
+        # (bucket_s=8), then the writer's timer flushes. Bounded wait:
+        deadline = _t.time() + 25
+        table = ing.store.table("flow_log", "l4_flow_log")
+        while _t.time() < deadline:
+            if table.row_count() > 0:
+                break
+            _t.sleep(0.5)
+        assert table.row_count() >= 1
+    finally:
+        ing.close()
