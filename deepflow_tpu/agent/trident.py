@@ -72,6 +72,9 @@ class AgentConfig:
     # matches the reference's 1m l4_flow_log granularity. The metrics
     # fork (quadruple documents) always stays at 1s either way.
     l4_log_aggr_s: int = 0
+    # agent-side L7 session rate cap per second (reference:
+    # l7_log_collect_nps_threshold, default 10000); 0 = uncapped
+    l7_log_rate: int = 10_000
     # agent-side UDP debug server (reference: agent/src/debug/ serving
     # per-subsystem dumps to deepflow-ctl). None disables; 0 = ephemeral
     debug_port: Optional[int] = None
@@ -251,6 +254,9 @@ class Agent:
         self.api_watcher = None
         self.ntp_offset_ns = 0
         self._capture_source = None   # set via attach_source()
+        self._l7_rate_sec = -1        # L7 rate-cap window (epoch second)
+        self._l7_rate_used = 0
+        self.l7_throttled = 0
         self.so_plugins: Dict[str, object] = {}
         for path in cfg.so_plugins:
             self._load_plugin(path)
@@ -414,6 +420,8 @@ class Agent:
                               cfg.get("max_cpus", 1))
         self.cfg.l7_enabled = bool(cfg.get("l7_log_enabled", True))
         self.cfg.sync_interval_s = cfg.get("sync_interval_s", 60)
+        if "l7_log_rate" in cfg:
+            self.cfg.l7_log_rate = int(cfg["l7_log_rate"] or 0)
         # flow-log aggregation interval is hot-switchable; turning it
         # OFF flushes the stash so no merged rows strand. Under the
         # agent lock: tick() (flow-tick thread) reads/advances the
@@ -545,6 +553,24 @@ class Agent:
                                          int(pkt["timestamp_ns"][i]))
             if merged is not None:
                 with self._lock:
+                    # agent-side L7 rate cap (reference: the LeakyBucket
+                    # throttle on PROTOCOLLOG sends,
+                    # l7_log_collect_nps_threshold): sessions past this
+                    # second's budget drop HERE, before serialization,
+                    # and the drop is a Countable
+                    sec = int(pkt["timestamp_ns"][i]) // 1_000_000_000
+                    # monotonic window roll: an out-of-order EARLIER
+                    # stamp must count against the current budget, not
+                    # reset it (a != test would refill on every
+                    # boundary-straddling interleave)
+                    if sec > self._l7_rate_sec:
+                        self._l7_rate_sec = sec
+                        self._l7_rate_used = 0
+                    if self.cfg.l7_log_rate and \
+                            self._l7_rate_used >= self.cfg.l7_log_rate:
+                        self.l7_throttled += 1
+                        continue
+                    self._l7_rate_used += 1
                     self._l7_out.append(_l7_record_bytes(
                         flow, merged, int(pkt["timestamp_ns"][i]),
                         self.vtap_id))
@@ -710,6 +736,7 @@ class Agent:
         c["escaped"] = int(self.escaped)
         c["ntp_offset_ns"] = self.ntp_offset_ns
         c["sessions_merged"] = self.sessions.merged
+        c["l7_throttled"] = self.l7_throttled
         for mt, s in self.senders.items():
             c[f"sent_{mt.name.lower()}"] = s.sent_records
         return c

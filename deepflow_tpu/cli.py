@@ -93,6 +93,11 @@ l7_log_enabled: true
 # controller sync cadence, seconds
 sync_interval_s: 60
 
+# agent-side L7 session rate cap per second (reference:
+# l7_log_collect_nps_threshold); 0 = uncapped. Sessions past the
+# budget drop at the agent, counted in l7_throttled.
+l7_log_rate: 10000
+
 # l4 flow-log aggregation interval (collector/flow_aggr role):
 # 0 ships every 1s tick row; 60 = one merged row per flow per minute
 # (the metrics fork always stays at 1s). Hot-switchable; switching

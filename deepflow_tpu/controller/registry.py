@@ -29,6 +29,10 @@ DEFAULT_CONFIG = {
     "capture_bpf": "",
     "max_collect_pps": 200_000,
     "throttle_per_s": 50_000,
+    # agent-side L7 session cap/s (l7_log_collect_nps_threshold role)
+    "l7_log_rate": 10_000,
+    # l4 flow-log aggregation interval (flow_aggr role); 0 = every tick
+    "l4_log_aggr_s": 0,
     # L7 parser plugins: None = "not managed by this group" (agents
     # keep their static sets); a LIST is authoritative and the agent
     # hot-converges to exactly it (Agent._sync_*_plugins)

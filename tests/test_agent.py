@@ -424,3 +424,90 @@ def test_gre_teb_arp_keeps_outer_flow():
     assert cols["valid"][0] and not cols["tunneled"][0]
     assert cols["proto"][0] == 47
     assert cols["ip_src"][0] == _ip(9, 9, 9, 1)
+
+
+def test_l7_rate_cap():
+    """Agent-side L7 session rate cap (l7_log_collect_nps_threshold
+    role): sessions past the per-second budget drop at the agent with
+    an observable counter; the cap is hot-switchable."""
+    import numpy as np
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+
+    agent = Agent(AgentConfig(self_telemetry=False, l7_log_rate=5))
+    try:
+        C, S = ip4(10, 13, 0, 1), ip4(10, 13, 0, 2)
+        t0 = 1_700_000_000_000_000_000
+        frames, stamps = [], []
+        for i in range(12):     # 12 sessions in ONE second
+            sp = 44000 + i
+            frames += [
+                eth_ipv4_tcp(C, S, sp, 80, 0x10,
+                             b"GET /x HTTP/1.1\r\nHost: h\r\n\r\n", seq=1),
+                eth_ipv4_tcp(S, C, 80, sp, 0x10,
+                             b"HTTP/1.1 200 OK\r\n\r\n", seq=1),
+            ]
+            stamps += [t0 + i * 1000, t0 + i * 1000 + 10]
+        agent.feed(frames, np.asarray(stamps, np.uint64))
+        assert len(agent._l7_out) == 5
+        assert agent.counters()["l7_throttled"] == 7
+        # next second: the budget refills
+        frames2 = [
+            eth_ipv4_tcp(C, S, 44900, 80, 0x10,
+                         b"GET /y HTTP/1.1\r\nHost: h\r\n\r\n", seq=1),
+            eth_ipv4_tcp(S, C, 80, 44900, 0x10,
+                         b"HTTP/1.1 200 OK\r\n\r\n", seq=1)]
+        agent.feed(frames2, np.asarray([t0 + 10**9, t0 + 10**9 + 10],
+                                       np.uint64))
+        assert len(agent._l7_out) == 6
+        # hot-switch: uncapped
+        agent._apply_config({"l7_log_rate": 0})
+        assert agent.cfg.l7_log_rate == 0
+    finally:
+        agent.close()
+
+
+def test_l7_rate_cap_pushable_and_monotonic():
+    """The cap must be configurable through the CONTROLLER push path
+    (registry accepts the key) and the window must roll monotonically
+    (out-of-order earlier stamps can't refill the budget)."""
+    import numpy as np
+    from deepflow_tpu.controller.registry import DEFAULT_CONFIG, \
+        VTapRegistry
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.replay import eth_ipv4_tcp, ip4
+
+    assert "l7_log_rate" in DEFAULT_CONFIG
+    assert "l4_log_aggr_s" in DEFAULT_CONFIG
+    reg = VTapRegistry(None)
+    reg.set_config("default", {"l7_log_rate": 3})   # must not raise
+
+    agent = Agent(AgentConfig(self_telemetry=False, l7_log_rate=3))
+    try:
+        C, S = ip4(10, 15, 0, 1), ip4(10, 15, 0, 2)
+        t0 = 1_700_000_000_000_000_000
+        NS = 1_000_000_000
+
+        def session(sp, ts):
+            return ([eth_ipv4_tcp(C, S, sp, 80, 0x10,
+                                  b"GET /m HTTP/1.1\r\nHost: h\r\n\r\n",
+                                  seq=1),
+                     eth_ipv4_tcp(S, C, 80, sp, 0x10,
+                                  b"HTTP/1.1 200 OK\r\n\r\n", seq=1)],
+                    [ts, ts + 10])
+        # interleave stamps straddling a second boundary: N+1, N, N+1, N
+        frames, stamps = [], []
+        order = [t0 + NS, t0, t0 + NS + 1000, t0 + 2000,
+                 t0 + NS + 2000, t0 + 3000]
+        for i, ts in enumerate(order):
+            f, s = session(46000 + i, ts)
+            frames += f
+            stamps += s
+        agent.feed(frames, np.asarray(stamps, np.uint64))
+        # with a != reset every interleave would refill: all 6 emit.
+        # monotonic: the first N+1 stamp opens the N+1 window; the
+        # out-of-order N stamps count against it -> exactly 3 emit
+        assert len(agent._l7_out) == 3
+        assert agent.counters()["l7_throttled"] == 3
+    finally:
+        agent.close()
