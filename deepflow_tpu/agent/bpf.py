@@ -293,8 +293,15 @@ def load(insns: bytes, prog_type: int = BPF_PROG_TYPE_SOCKET_FILTER,
             return Program(_bpf(BPF_PROG_LOAD, attr))
         except OSError:
             text = log.value.decode("utf-8", "replace").strip()
+            if text:
+                raise OSError(e.errno,
+                              f"BPF verifier rejected program: "
+                              f"{text[-2000:]}") from None
+            # empty verifier log => not a verifier verdict: EPERM
+            # (missing CAP_BPF/CAP_SYS_ADMIN), ENOSYS, E2BIG... —
+            # surface the real errno so operators chase the right cause
             raise OSError(e.errno,
-                          f"BPF verifier rejected program: {text[-2000:]}"
+                          f"bpf(BPF_PROG_LOAD): {os.strerror(e.errno)}"
                           ) from None
 
 

@@ -224,6 +224,8 @@ class Agent:
         self.sessions = SessionAggregator()
         self.flow_aggr = None
         self._pending_aggr = None     # stash drained on interval change
+        self.aggr_schema_errors = 0   # divergent hot-switch column sets
+        self.last_aggr_schema_error = ""
         if cfg.l4_log_aggr_s:
             from deepflow_tpu.agent.flow_aggr import FlowAggr
             self.flow_aggr = FlowAggr(cfg.l4_log_aggr_s)
@@ -439,10 +441,14 @@ class Agent:
                             # second switch before that tick must
                             # APPEND, not clobber
                             if self._pending_aggr is not None:
-                                out = {k: np.concatenate(
-                                    [self._pending_aggr[k], out[k]])
-                                    for k in out
-                                    if k in self._pending_aggr}
+                                if self._aggr_sets_match(
+                                        self._pending_aggr, out):
+                                    out = {k: np.concatenate(
+                                        [self._pending_aggr[k], out[k]])
+                                        for k in out}
+                                # diverged: keep only the fresh flush —
+                                # counted in aggr_schema_errors, never
+                                # silently intersected
                             self._pending_aggr = out
                     if want:
                         from deepflow_tpu.agent.flow_aggr import FlowAggr
@@ -613,10 +619,19 @@ class Agent:
             if self._pending_aggr is not None:
                 # rows flushed by an interval hot-switch ride this tick
                 pend, self._pending_aggr = self._pending_aggr, None
-                flow_cols = pend if flow_cols is None or not len(
-                    flow_cols.get("ip_src", ())) else {
+                if flow_cols is None or not len(
+                        flow_cols.get("ip_src", ())):
+                    flow_cols = pend
+                elif self._aggr_sets_match(pend, flow_cols):
+                    flow_cols = {
                         k: np.concatenate([flow_cols[k], pend[k]])
-                        for k in pend if k in flow_cols}
+                        for k in pend}
+                # else: column sets diverged (schema change between the
+                # hot-switch flush and this tick). The stale pending rows
+                # are DROPPED — visibly, via aggr_schema_errors — rather
+                # than intersect-merged into a malformed batch or raised
+                # into the unsupervised flow-tick thread (which would
+                # stop all exports for the rest of the process).
         if flow_cols is not None and len(flow_cols["ip_src"]):
             if self.cfg.wire_mode == "columnar":
                 from deepflow_tpu.batch.schema import L4_SCHEMA
@@ -731,9 +746,21 @@ class Agent:
         while not self._stop.wait(1.0):
             self.tick()
 
+    def _aggr_sets_match(self, a: dict, b: dict) -> bool:
+        """True when two aggregated-column dicts share an identical key
+        set; on divergence, records it (visible in counters + debug)."""
+        if set(a) == set(b):
+            return True
+        self.aggr_schema_errors += 1
+        self.last_aggr_schema_error = (
+            f"only_a={sorted(set(a) - set(b))} "
+            f"only_b={sorted(set(b) - set(a))}")
+        return False
+
     def counters(self) -> dict:
         c = self.flow_map.counters()
         c["escaped"] = int(self.escaped)
+        c["aggr_schema_errors"] = self.aggr_schema_errors
         c["ntp_offset_ns"] = self.ntp_offset_ns
         c["sessions_merged"] = self.sessions.merged
         c["l7_throttled"] = self.l7_throttled

@@ -19,6 +19,7 @@ import os
 import shutil
 import threading
 import time
+import zipfile
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -26,6 +27,11 @@ import numpy as np
 from deepflow_tpu.store.table import ColumnSpec, TableSchema
 
 MANIFEST = "manifest.json"
+
+# what a torn/corrupt .npz raises: BadZipFile on open or CRC check,
+# ValueError/EOFError from a truncated member. Distinct from OSError
+# (transient IO / GC race), which must be retried, never quarantined.
+CORRUPT_SEGMENT_ERRORS = (zipfile.BadZipFile, ValueError, EOFError)
 
 
 def _partition_dir(start: int) -> str:
@@ -43,6 +49,12 @@ class Table:
         self.root = root
         self.schema = schema
         self._lock = threading.Lock()
+        # held across a whole compaction sweep: two overlapping sweeps
+        # could merge overlapping source sets and the last-writer-wins
+        # merged.json would leave one merged segment untracked (rows
+        # double-counted forever). Non-blocking acquire: a second caller
+        # skips the sweep instead of queueing behind it.
+        self._compact_lock = threading.Lock()
         self._seq = 0
         os.makedirs(root, exist_ok=True)
         self._save_manifest()
@@ -58,6 +70,8 @@ class Table:
         self.rows_written = 0
         self.segments_written = 0
         self.segments_compacted = 0
+        self.segments_quarantined = 0
+        self.segments_skipped_corrupt = 0
 
     # -- manifest ----------------------------------------------------------
     def _save_manifest(self) -> None:
@@ -95,6 +109,27 @@ class Table:
         return n
 
     # -- read path ---------------------------------------------------------
+    def _read_segment(self, path: str,
+                      names: Sequence[str]) -> Dict[str, np.ndarray]:
+        """Load logical columns `names` from one segment, filling
+        migration defaults for columns newer than the segment. The
+        chunk is fully staged before return, so a mid-read failure
+        never leaks a partial result. Raises what np.load raises —
+        callers classify via CORRUPT_SEGMENT_ERRORS vs OSError."""
+        chunk: Dict[str, np.ndarray] = {}
+        with np.load(path) as z:
+            length = z[z.files[0]].shape[0]
+            for nm in names:
+                stored = next((s for s in self.schema.stored_names(nm)
+                               if s in z.files), None)
+                if stored is not None:
+                    chunk[nm] = z[stored]
+                else:
+                    spec = self.schema.spec(nm)
+                    chunk[nm] = np.full(length, spec.default,
+                                        dtype=spec.dtype)
+        return chunk
+
     def partitions(self) -> List[int]:
         if not os.path.isdir(self.root):
             return []
@@ -143,31 +178,27 @@ class Table:
         load_names = names + [self.schema.time_column] if need_time else names
         out: Dict[str, List[np.ndarray]] = {nm: [] for nm in names}
         for path in self._segment_files(parts):
+            # OSError: partition force-dropped by GC mid-scan or
+            # transient IO — skip. CORRUPT_SEGMENT_ERRORS: a torn
+            # segment — served around (the way ClickHouse serves around
+            # a broken part; compact() quarantines it next sweep) and
+            # counted so empty results are diagnosable. Anything else
+            # (a schema/code bug) propagates loudly.
             try:
-                z = np.load(path)
-            except (FileNotFoundError, OSError):
-                continue  # partition force-dropped by GC mid-scan
-            with z:
-                chunk = {}
-                for nm in load_names:
-                    stored = next((s for s in self.schema.stored_names(nm)
-                                   if s in z.files), None)
-                    if stored is not None:
-                        chunk[nm] = z[stored]
-                    else:
-                        # column added by migration after this segment: default
-                        spec = self.schema.spec(nm)
-                        length = z[z.files[0]].shape[0]
-                        chunk[nm] = np.full(length, spec.default,
-                                            dtype=spec.dtype)
-                if time_range is not None:
-                    t = chunk[self.schema.time_column].astype(np.int64)
-                    sel = (t >= time_range[0]) & (t < time_range[1])
-                    for nm in names:
-                        out[nm].append(chunk[nm][sel])
-                else:
-                    for nm in names:
-                        out[nm].append(chunk[nm])
+                chunk = self._read_segment(path, load_names)
+            except OSError:
+                continue
+            except CORRUPT_SEGMENT_ERRORS:
+                self.segments_skipped_corrupt += 1
+                continue
+            if time_range is not None:
+                t = chunk[self.schema.time_column].astype(np.int64)
+                sel = (t >= time_range[0]) & (t < time_range[1])
+                for nm in names:
+                    out[nm].append(chunk[nm][sel])
+            else:
+                for nm in names:
+                    out[nm].append(chunk[nm])
         return {nm: (np.concatenate(v) if v else
                      np.empty(0, dtype=self.schema.spec(nm).dtype))
                 for nm, v in out.items()}
@@ -198,6 +229,16 @@ class Table:
         input) merge per partition per sweep — an unbounded concat of a
         large backlog would balloon the monitor thread's memory the way
         ClickHouse bounds merge input sizes to avoid."""
+        if not self._compact_lock.acquire(blocking=False):
+            return 0    # another sweep in flight; overlap would corrupt
+        try:
+            return self._compact_locked(max_segment_bytes, min_segments,
+                                        max_sources)
+        finally:
+            self._compact_lock.release()
+
+    def _compact_locked(self, max_segment_bytes: int, min_segments: int,
+                        max_sources: int) -> int:
         removed = 0
         for p in self.partitions():
             pdir = os.path.join(self.root, _partition_dir(p))
@@ -238,19 +279,29 @@ class Table:
                 c.name: [] for c in self.schema.columns}
             ok: List[str] = []
             for f in small:
+                fp = os.path.join(pdir, f)
                 try:
-                    z = np.load(os.path.join(pdir, f))
-                except (FileNotFoundError, OSError):
+                    chunk = self._read_segment(
+                        fp, [c.name for c in self.schema.columns])
+                except OSError:
+                    # gone (GC race) or transient IO (EIO/ESTALE on a
+                    # flaky mount): skip and retry next sweep — a
+                    # healthy segment must never be quarantined for a
+                    # one-off read error
                     continue
-                with z:
-                    length = z[z.files[0]].shape[0]
-                    for c in self.schema.columns:
-                        stored = next(
-                            (s for s in self.schema.stored_names(c.name)
-                             if s in z.files), None)
-                        cols[c.name].append(
-                            z[stored] if stored is not None
-                            else np.full(length, c.default, c.dtype))
+                except CORRUPT_SEGMENT_ERRORS:
+                    # quarantine (ClickHouse detaches broken parts): a
+                    # corrupt segment left in place would occupy this
+                    # sweep's bounded merge budget on EVERY sweep and
+                    # could block the partition's compaction forever
+                    try:
+                        os.replace(fp, fp + ".bad")
+                        self.segments_quarantined += 1
+                    except OSError:
+                        pass
+                    continue
+                for nm, arr in chunk.items():
+                    cols[nm].append(arr)
                 ok.append(f)
             if len(ok) < min_segments:
                 continue
@@ -298,11 +349,15 @@ class Table:
         total = 0
         for path in self._segment_files(self.partitions()):
             try:
-                z = np.load(path)
-            except (FileNotFoundError, OSError):
+                with np.load(path) as z:
+                    total += z[z.files[0]].shape[0]
+            except OSError:
                 continue
-            with z:
-                total += z[z.files[0]].shape[0]
+            except CORRUPT_SEGMENT_ERRORS:
+                # same contract as scan(): serve around a torn segment
+                # until compact() quarantines it
+                self.segments_skipped_corrupt += 1
+                continue
         return total
 
     # -- retention ---------------------------------------------------------
@@ -344,7 +399,9 @@ class Table:
             if not os.path.isdir(pdir):
                 continue
             for f in os.listdir(pdir):
-                if f.endswith(".npz"):
+                # .bad = quarantined corrupt segments — still on disk,
+                # still counted, or watermark GC under-reports usage
+                if f.endswith(".npz") or f.endswith(".bad"):
                     try:
                         total += os.path.getsize(os.path.join(pdir, f))
                     except OSError:
@@ -361,6 +418,8 @@ class Table:
         return {"rows_written": self.rows_written,
                 "segments_written": self.segments_written,
                 "segments_compacted": self.segments_compacted,
+                "segments_quarantined": self.segments_quarantined,
+                "segments_skipped_corrupt": self.segments_skipped_corrupt,
                 "partitions": len(self.partitions())}
 
 

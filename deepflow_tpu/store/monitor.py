@@ -29,6 +29,8 @@ class DiskMonitor:
         self.partitions_dropped = 0
         self.segments_compacted = 0
         self.ttl_dropped = 0
+        self.sweep_errors = 0
+        self.last_sweep_error = ""
         if stats is not None:
             stats.register("ckmonitor", self.counters)
 
@@ -89,10 +91,20 @@ class DiskMonitor:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
-            self.check_once()
+            try:
+                self.check_once()
+            except Exception as e:
+                # retention GC must survive any single sweep error
+                # (corrupt segment, racing table drop, transient IO) —
+                # a dead monitor thread silently fills the disk. The
+                # repr makes a climbing counter diagnosable over the
+                # debug socket.
+                self.sweep_errors += 1
+                self.last_sweep_error = repr(e)
 
     def counters(self) -> dict:
         return {"partitions_dropped": self.partitions_dropped,
                 "ttl_dropped": self.ttl_dropped,
                 "segments_compacted": self.segments_compacted,
+                "sweep_errors": self.sweep_errors,
                 "disk_bytes": self.store.disk_bytes()}

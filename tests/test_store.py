@@ -565,3 +565,71 @@ def test_monitor_sweep_compacts(tmp_path):
     mon.check_once()
     assert mon.counters()["segments_compacted"] == 10
     assert len(t._segment_files(t.partitions())) == 1
+
+
+def test_compaction_quarantines_corrupt_segment(tmp_path):
+    """A torn/corrupt .npz (raises zipfile.BadZipFile, not OSError) is
+    quarantined to .bad by compact() instead of killing the sweep or
+    re-consuming the merge budget every sweep; scan() serves around it
+    (ADVICE r3 + review r4)."""
+    import os as _os
+    import time as _t
+    from deepflow_tpu.store.db import _partition_dir
+    store, t = _mini_table(tmp_path)
+    now = int(_t.time())
+    for i in range(10):
+        t.append({"timestamp": np.full(4, now, np.uint32),
+                  "v": np.full(4, i, np.uint32)})
+    pdir = _os.path.join(t.root, _partition_dir(t.partitions()[0]))
+    segs = sorted(f for f in _os.listdir(pdir) if f.endswith(".npz"))
+    with open(_os.path.join(pdir, segs[3]), "wb") as f:
+        f.write(b"not a zip file at all")           # torn write
+    assert len(t.scan()["v"]) == 36                 # scan serves around it
+    removed = t.compact(min_segments=4)             # must not raise
+    assert removed == 9                             # all but the bad one
+    assert t.counters()["segments_quarantined"] == 1
+    assert any(f.endswith(".bad") for f in _os.listdir(pdir))
+    assert not any(f == segs[3] for f in _os.listdir(pdir))
+    assert len(t.scan()["v"]) == 36
+    # quarantined bytes still count toward watermark accounting
+    assert t.disk_bytes() > 0
+
+
+def test_monitor_thread_survives_sweep_exception(tmp_path):
+    """The _run loop survives an exception thrown by a sweep and keeps
+    sweeping (a dead monitor thread silently fills the disk)."""
+    import threading as _th
+    from deepflow_tpu.store.monitor import DiskMonitor
+    store, _ = _mini_table(tmp_path)
+    mon = DiskMonitor(store, max_bytes=1 << 40, interval=0.01)
+    calls = {"n": 0}
+    ok = _th.Event()
+
+    def boom(now=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("sweep exploded")
+        ok.set()
+        return 0
+
+    mon.check_once = boom
+    mon.start()
+    assert ok.wait(5.0)            # a second sweep ran after the raise
+    mon.close()
+    assert mon.sweep_errors == 1
+    assert "sweep exploded" in mon.last_sweep_error
+
+
+def test_compaction_skips_when_sweep_in_flight(tmp_path):
+    """Overlapping compact() calls: the second returns 0 instead of
+    racing the first's merged.json (ADVICE r3)."""
+    _, t = _mini_table(tmp_path)
+    for i in range(10):
+        t.append({"timestamp": np.full(4, 100, np.uint32),
+                  "v": np.full(4, i, np.uint32)})
+    assert t._compact_lock.acquire(blocking=False)
+    try:
+        assert t.compact(min_segments=4) == 0       # sweep "in flight"
+    finally:
+        t._compact_lock.release()
+    assert t.compact(min_segments=4) == 10
