@@ -118,13 +118,16 @@ class Table:
         callers classify via CORRUPT_SEGMENT_ERRORS vs OSError."""
         chunk: Dict[str, np.ndarray] = {}
         with np.load(path) as z:
-            length = z[z.files[0]].shape[0]
-            for nm in names:
+            length = None     # lazily: NpzFile reads decompress every
+            for nm in names:  # time — don't pay one just for a shape
                 stored = next((s for s in self.schema.stored_names(nm)
                                if s in z.files), None)
                 if stored is not None:
                     chunk[nm] = z[stored]
                 else:
+                    if length is None:
+                        length = (next(iter(chunk.values())).shape[0]
+                                  if chunk else z[z.files[0]].shape[0])
                     spec = self.schema.spec(nm)
                     chunk[nm] = np.full(length, spec.default,
                                         dtype=spec.dtype)
