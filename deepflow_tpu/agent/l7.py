@@ -38,10 +38,82 @@ class L7Record:
     status: int = 0         # protocol status code
     req_len: int = 0
     resp_len: int = 0
+    # instrumented-app trace context (reference: http.rs decode_id) —
+    # what links this packet/syscall span to OTel spans in one trace
+    trace_id: str = ""
+    span_id: str = ""
+    # request detail (reference: HttpInfo host/user-agent/referer/
+    # x-request-id/proxy-real-ip extraction, http.rs:990-1080)
+    req_type: str = ""      # method
+    domain: str = ""        # Host / :authority
+    resource: str = ""      # full path incl. query
+    version: str = ""       # "1.1" / "2"
+    user_agent: str = ""
+    referer: str = ""
+    x_request_id: str = ""
+    client_ip: str = ""     # X-Forwarded-For / X-Real-IP first hop
+
+
+def parse_http_headers(payload: bytes,
+                       max_headers: int = 64) -> dict:
+    """Header block after the first CRLF -> {lowercase-name: value}.
+    Duplicate names keep the first occurrence (proxy-chain semantics:
+    the outermost hop's value). Bounded: header floods can't balloon."""
+    headers: dict = {}
+    head_end = payload.find(b"\r\n\r\n")
+    block = payload[:head_end if head_end >= 0 else len(payload)]
+    for line in block.split(b"\r\n")[1:max_headers + 1]:
+        name, sep, value = line.partition(b":")
+        if not sep:
+            continue
+        key = name.strip().decode("latin-1").lower()
+        if key and key not in headers:
+            headers[key] = value.strip().decode("latin-1")
+    return headers
+
+
+def http_body_len(payload: bytes, headers: dict) -> int:
+    """Body bytes per the message's own framing (reference: http.rs
+    content-length tracking): Content-Length when present; for
+    Transfer-Encoding: chunked, the sum of the chunk sizes visible in
+    this capture slice (each capped to what's actually present — a
+    lying chunk header must not inflate the accounting); else the bytes
+    past the header block."""
+    head_end = payload.find(b"\r\n\r\n")
+    body_off = head_end + 4 if head_end >= 0 else len(payload)
+    cl = headers.get("content-length", "")
+    if cl.isdigit():
+        return int(cl)
+    if "chunked" in headers.get("transfer-encoding", "").lower():
+        total = 0
+        off = body_off
+        while off < len(payload):
+            line_end = payload.find(b"\r\n", off)
+            if line_end < 0:
+                break
+            size_tok = payload[off:line_end].split(b";")[0].strip()
+            # strict hex only: int(x, 16) also accepts signs and
+            # underscores, and a hostile b"-2" chunk header would drive
+            # the accumulated length negative (u32-wrapping downstream)
+            if not size_tok or not all(c in b"0123456789abcdefABCDEF"
+                                       for c in size_tok):
+                break
+            size = int(size_tok, 16)
+            if size == 0:
+                break
+            avail = max(len(payload) - (line_end + 2), 0)
+            total += min(size, avail)
+            off = line_end + 2 + size + 2      # data + trailing CRLF
+        return total
+    return max(len(payload) - body_off, 0)
 
 
 class HttpParser:
-    """HTTP/1.x (reference: protocol_logs/http.rs)."""
+    """HTTP/1.x (reference: protocol_logs/http.rs): request line +
+    full header extraction (host, content-type, user-agent, referer,
+    x-request-id, proxy client ip), trace-context decode
+    (trace_context.extract), and content-length/chunked body
+    accounting."""
 
     proto: ClassVar[int] = L7_HTTP1
     _METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ",
@@ -52,22 +124,41 @@ class HttpParser:
             payload.startswith(b"HTTP/1.")
 
     def parse(self, payload: bytes) -> Optional[L7Record]:
+        from deepflow_tpu.agent import trace_context
+
         try:
             line, _, _ = payload.partition(b"\r\n")
             parts = line.decode("latin-1").split(" ", 2)
         except Exception:
             return None
+        headers = parse_http_headers(payload)
+        ids = trace_context.extract(headers)
         if payload.startswith(b"HTTP/1."):
             if len(parts) < 2 or not parts[1][:3].isdigit():
                 return None
-            return L7Record(self.proto, MSG_RESPONSE,
-                            status=int(parts[1][:3]),
-                            resp_len=len(payload))
+            return L7Record(
+                self.proto, MSG_RESPONSE,
+                status=int(parts[1][:3]),
+                resp_len=http_body_len(payload, headers),
+                version=parts[0][5:],
+                trace_id=ids["trace_id"], span_id=ids["span_id"],
+                x_request_id=ids["x_request_id"])
         if len(parts) < 3 or not parts[2].startswith("HTTP/"):
             return None
         path = parts[1].split("?", 1)[0]
-        return L7Record(self.proto, MSG_REQUEST,
-                        endpoint=f"{parts[0]} {path}", req_len=len(payload))
+        return L7Record(
+            self.proto, MSG_REQUEST,
+            endpoint=f"{parts[0]} {path}",
+            req_len=http_body_len(payload, headers),
+            req_type=parts[0],
+            domain=headers.get("host", ""),
+            resource=parts[1],
+            version=parts[2][5:].strip(),
+            user_agent=headers.get("user-agent", ""),
+            referer=headers.get("referer", ""),
+            trace_id=ids["trace_id"], span_id=ids["span_id"],
+            x_request_id=ids["x_request_id"],
+            client_ip=ids["client_ip"])
 
 
 class DnsParser:
@@ -228,6 +319,25 @@ def parse_payload(payload: bytes, proto: Optional[int] = None,
     return None
 
 
+_DETAIL_FIELDS = ("trace_id", "span_id", "req_type", "domain",
+                  "resource", "version", "user_agent", "referer",
+                  "client_ip")
+
+
+def _session_detail(req: Optional[L7Record],
+                    resp: Optional[L7Record]) -> dict:
+    """Merged string detail: the request's value wins (trace context
+    and request headers live on the request); the response fills gaps
+    (server-stamped trace ids). x_request_id keeps both directions —
+    the reference's x_request_id_0/_1 pair is how proxy-injected ids
+    correlate across hops."""
+    out = {f: getattr(req, f, "") or getattr(resp, f, "")
+           for f in _DETAIL_FIELDS}
+    out["x_request_id_0"] = getattr(req, "x_request_id", "")
+    out["x_request_id_1"] = getattr(resp, "x_request_id", "")
+    return out
+
+
 class SessionAggregator:
     """Merge request+response halves per (flow, stream) within a time
     window (reference: protocol_logs/parser.rs SessionAggregator :737).
@@ -260,7 +370,8 @@ class SessionAggregator:
             self.unpaired += 1
             return {"proto": rec.proto, "endpoint": rec.endpoint,
                     "status": rec.status, "rrt_us": 0,
-                    "req_len": 0, "resp_len": rec.resp_len}
+                    "req_len": 0, "resp_len": rec.resp_len,
+                    **_session_detail(None, rec)}
         req_rec, req_ts = req
         self.merged += 1
         return {
@@ -270,6 +381,7 @@ class SessionAggregator:
             "rrt_us": max(ts_ns - req_ts, 0) // 1000,
             "req_len": req_rec.req_len,
             "resp_len": rec.resp_len,
+            **_session_detail(req_rec, rec),
         }
 
     def expire(self, now_ns: int) -> int:

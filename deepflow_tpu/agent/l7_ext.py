@@ -437,25 +437,45 @@ class Http2Parser:
                 body = body[1:len(body) - body[0]] if body else body
             if flags & 0x20:                       # PRIORITY
                 body = body[5:]
-            hdrs = dict(dec.decode(body))
+            # first occurrence wins on duplicates — the same proxy-chain
+            # semantics parse_http_headers documents for HTTP/1, so one
+            # request yields the same client_ip/trace id on either version
+            hdrs: dict = {}
+            for hk, hv in dec.decode(body):
+                hdrs.setdefault(hk, hv)
             if rec is not None:
                 continue                           # state only
+            from deepflow_tpu.agent import trace_context
+            ids = trace_context.extract(hdrs)
             status = hdrs.get(":status")
             if status is not None:
                 code = int(status) if status.isdigit() else 0
                 rec = L7Record(self.proto, MSG_RESPONSE, status=code,
-                               resp_len=len(payload))
+                               resp_len=len(payload), version="2",
+                               trace_id=ids["trace_id"],
+                               span_id=ids["span_id"],
+                               x_request_id=ids["x_request_id"])
                 continue
             method = hdrs.get(":method")
             if method is not None:
-                path = hdrs.get(":path", "").split("?", 1)[0]
+                full_path = hdrs.get(":path", "")
+                path = full_path.split("?", 1)[0]
                 proto_ = self.proto
                 if hdrs.get("content-type", "").startswith(
                         "application/grpc"):
                     proto_ = L7_GRPC
                 rec = L7Record(proto_, MSG_REQUEST,
                                endpoint=f"{method} {path}",
-                               req_len=len(payload))
+                               req_len=len(payload),
+                               req_type=method,
+                               domain=hdrs.get(":authority", ""),
+                               resource=full_path, version="2",
+                               user_agent=hdrs.get("user-agent", ""),
+                               referer=hdrs.get("referer", ""),
+                               trace_id=ids["trace_id"],
+                               span_id=ids["span_id"],
+                               x_request_id=ids["x_request_id"],
+                               client_ip=ids["client_ip"])
         return rec
 
 

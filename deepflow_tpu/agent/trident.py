@@ -195,6 +195,19 @@ def l7_session_message(flow, rec_dict: dict, ts_ns: int,
     m.resp.status = rec_dict["status"]
     m.req_len = rec_dict["req_len"]
     m.resp_len = rec_dict["resp_len"]
+    # instrumented-app trace context + request detail (parsers stamp
+    # these when present; empty strings hash to 0 = reference NULL)
+    m.version = rec_dict.get("version", "")
+    m.req.req_type = rec_dict.get("req_type", "")
+    m.req.domain = rec_dict.get("domain", "")
+    m.req.resource = rec_dict.get("resource", "")
+    m.trace_info.trace_id = rec_dict.get("trace_id", "")
+    m.trace_info.span_id = rec_dict.get("span_id", "")
+    m.ext_info.x_request_id_0 = rec_dict.get("x_request_id_0", "")
+    m.ext_info.x_request_id_1 = rec_dict.get("x_request_id_1", "")
+    m.ext_info.client_ip = rec_dict.get("client_ip", "")
+    m.ext_info.http_user_agent = rec_dict.get("user_agent", "")
+    m.ext_info.http_referer = rec_dict.get("referer", "")
     return m
 
 
@@ -456,6 +469,20 @@ class Agent:
                     else:
                         self.flow_aggr = None
                     self.cfg.l4_log_aggr_s = want
+        # trace-context header extraction config (reference proxy config
+        # http_log_trace_id / http_log_span_id / ...): hot-swapped into
+        # the process-global parser registry's extraction config.
+        # configure() accepts a list or the reference's comma-joined
+        # string form for every field.
+        if any(k in cfg for k in ("http_log_trace_id", "http_log_span_id",
+                                  "http_log_x_request_id",
+                                  "http_log_proxy_client")):
+            from deepflow_tpu.agent import trace_context
+            trace_context.configure(
+                trace_types=cfg.get("http_log_trace_id"),
+                span_types=cfg.get("http_log_span_id"),
+                x_request_id=cfg.get("http_log_x_request_id"),
+                proxy_client=cfg.get("http_log_proxy_client"))
         # absent or None = plugins not managed by this push; a LIST is
         # authoritative (pushing [] must actually stop a plugin)
         if cfg.get("so_plugins") is not None:

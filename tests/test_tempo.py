@@ -204,3 +204,88 @@ def test_l7_tracing_chains_syscall_ids(tmp_path):
         assert len(doc["spans"]) == len(full["_id"])
     finally:
         srv.close()
+
+
+def test_instrumented_capture_stitches_ebpf_and_otel_spans(tmp_path):
+    """Round-4 verdict #4 end-to-end: an instrumented app stamps
+    `traceparent` on its requests. The eBPF-captured sessions extract
+    the trace id from the header (agent/trace_context.py) AND carry
+    syscall trace ids; an OTel span exported by the app's own SDK
+    shares the same trace id. Starting from the eBPF row, l7_tracing
+    must assemble ONE trace holding both signal sources — header trace
+    ids preferred, syscall ids still chaining the uninstrumented hop."""
+    from deepflow_tpu.decode.columnar import (decode_l7_records,
+                                              decode_otel_frames)
+    from deepflow_tpu.pipelines.flow_log import stamp_row_ids
+    from deepflow_tpu.pipelines.schemas import L7_TABLE
+    from deepflow_tpu.wire.gen import otel_pb2
+    from tests.test_ebpf_source import (CLIENT, MS, SVC_A, SVC_B, T0,
+                                        T_EGRESS, T_INGRESS,
+                                        EbpfTracer, SyscallRecord)
+
+    tid_hex = "4bf92f3577b34da6a3ce929d0e0e4736"
+    req_a = (b"GET /api/users HTTP/1.1\r\nHost: a\r\n"
+             b"traceparent: 00-" + tid_hex.encode() +
+             b"-00f067aa0ba902b7-01\r\n\r\n")
+    req_b = b"GET /internal/roles HTTP/1.1\r\nHost: b\r\n\r\n"
+    resp = b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+
+    tracer = EbpfTracer(vtap_id=3)
+    wires = []
+    for r in [
+        SyscallRecord(10, 7, T_INGRESS, T0, CLIENT, SVC_A, 5000, 80,
+                      payload=req_a),
+        SyscallRecord(10, 7, T_EGRESS, T0 + 2 * MS, SVC_A, SVC_B,
+                      42000, 80, payload=req_b),
+        SyscallRecord(10, 7, T_INGRESS, T0 + 8 * MS, SVC_B, SVC_A,
+                      80, 42000, payload=resp),
+        SyscallRecord(10, 7, T_EGRESS, T0 + 9 * MS, SVC_A, CLIENT,
+                      80, 5000, payload=resp),
+    ]:
+        w = tracer.feed(r)
+        if w is not None:
+            wires.append(w)
+    assert len(wires) == 2
+
+    store = Store(str(tmp_path / "s"))
+    dicts = TagDictRegistry(str(tmp_path / "s"))
+    d = dicts.get("l7_endpoint")
+    t = store.create_table("flow_log", L7_TABLE)
+
+    ecols = decode_l7_records(wires, endpoint_dict=d)
+    # the eBPF inbound session carries the app's header trace id
+    assert np.uint32(d.encode_one(tid_hex)) in ecols["trace_id_hash"]
+
+    # the app's own OTel span, same trace id (SDK-exported)
+    req = otel_pb2.ExportTraceServiceRequest()
+    ss = req.resource_spans.add().scope_spans.add()
+    span = ss.spans.add()
+    span.name = "GET /api/users"
+    span.trace_id = bytes.fromhex(tid_hex)
+    span.span_id = bytes.fromhex("00f067aa0ba902b7")
+    span.start_time_unix_nano = T0
+    span.end_time_unix_nano = T0 + 9 * MS
+    ocols, bad = decode_otel_frames([req.SerializeToString()],
+                                    endpoint_dict=d)
+    assert bad == 0
+
+    for cols in (ecols, ocols):
+        full = {spec.name: cols.get(
+            spec.name, np.zeros(len(cols["ip_src"]), spec.dtype))
+            for spec in L7_TABLE.columns}
+        stamp_row_ids(full)
+        t.append(full)
+
+    tq = TempoQuery(store, dicts)
+    all_ids = t.scan(columns=["_id"])["_id"]
+    assert len(all_ids) == 3            # 2 eBPF sessions + 1 OTel span
+    seed = int(all_ids[0])
+    trace = tq.l7_tracing(seed)
+    assert trace is not None
+    assert len(trace["spans"]) == 3, (
+        "header trace id must stitch the OTel span to the eBPF "
+        "sessions, syscall ids the uninstrumented hop")
+    # the assembled trace is named by the app's trace id, not a
+    # synthetic l7-tracing fallback id
+    assert trace["traceID"] == tid_hex
+    dicts.close()

@@ -1,0 +1,257 @@
+"""Trace-context header extraction + deep HTTP/1 parsing.
+
+Reference behavior: agent/src/flow_generator/protocol_logs/http.rs
+decode_id (TraceType dispatch) and the HttpInfo header extraction —
+trace ids from instrumented-app headers are what link packet/eBPF spans
+to OTel spans in one distributed trace.
+"""
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent import trace_context
+from deepflow_tpu.agent.l7 import (MSG_REQUEST, MSG_RESPONSE, HttpParser,
+                                   SessionAggregator, http_body_len,
+                                   parse_http_headers)
+from deepflow_tpu.agent.trace_context import SPAN_ID, TRACE_ID, decode_id
+
+
+@pytest.fixture(autouse=True)
+def _default_config():
+    """Each test starts from the default extraction config."""
+    trace_context.configure(trace_types=("traceparent", "sw8"),
+                            span_types=("traceparent", "sw8"),
+                            x_request_id="x-request-id",
+                            proxy_client=("x-forwarded-for", "x-real-ip"))
+    yield
+
+
+# -- decoder formats -------------------------------------------------------
+def test_traceparent_decode():
+    v = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+    assert decode_id("traceparent", v, TRACE_ID) == \
+        "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert decode_id("traceparent", v, SPAN_ID) == "00f067aa0ba902b7"
+
+
+def test_sw8_decode_base64_segments():
+    # sample-TRACEID(b64)-SEGMENTID(b64)-SPANID-...
+    import base64
+    tid = base64.b64encode(b"trace-123").decode()
+    seg = base64.b64encode(b"seg-9").decode()
+    v = f"1-{tid}-{seg}-3-c2Vydmlj-aW5zdA==-L2FwaQ==-MTAuMC4wLjE6ODA="
+    assert decode_id("sw8", v, TRACE_ID) == "trace-123"
+    assert decode_id("sw8", v, SPAN_ID) == "seg-9-3"
+
+
+def test_sw3_decode():
+    v = "seg1|4|100|100|#10.0.0.1:80|#/parent|#/api|TRACE9|1"
+    assert decode_id("sw3", v, TRACE_ID) == "TRACE9"
+    assert decode_id("sw3", v, SPAN_ID) == "seg1-4"
+
+
+def test_uber_decode():
+    v = "abcdef123:span77:parent0:1"
+    assert decode_id("uber-trace-id", v, TRACE_ID) == "abcdef123"
+    assert decode_id("uber-trace-id", v, SPAN_ID) == "parent0"
+
+
+def test_custom_key_decodes_raw():
+    assert decode_id("x-company-trace", " raw-id ", TRACE_ID) == "raw-id"
+
+
+def test_extract_priority_order_and_custom_config():
+    hdrs = {"sw8": "1-" + "dHJhY2U=" + "-c2Vn-1-a-b-c-d",
+            "x-mytrace": "custom-id"}
+    # default order: traceparent absent -> sw8 wins
+    assert trace_context.extract(hdrs)["trace_id"] == "trace"
+    # pushed config: a customize key takes priority
+    trace_context.configure(trace_types=("x-mytrace", "sw8"))
+    assert trace_context.extract(hdrs)["trace_id"] == "custom-id"
+
+
+def test_extract_proxy_client_first_hop():
+    hdrs = {"x-forwarded-for": "203.0.113.9, 10.0.0.1, 10.0.0.2"}
+    assert trace_context.extract(hdrs)["client_ip"] == "203.0.113.9"
+    hdrs = {"x-real-ip": "198.51.100.7"}
+    assert trace_context.extract(hdrs)["client_ip"] == "198.51.100.7"
+
+
+# -- deep HTTP/1 -----------------------------------------------------------
+REQ = (b"GET /api/users?id=7 HTTP/1.1\r\n"
+       b"Host: api.example.com\r\n"
+       b"User-Agent: curl/8.0\r\n"
+       b"Referer: https://example.com/home\r\n"
+       b"X-Request-Id: req-42\r\n"
+       b"X-Forwarded-For: 203.0.113.9, 10.0.0.1\r\n"
+       b"traceparent: 00-4bf92f3577b34da6a3ce929d0e0e4736-"
+       b"00f067aa0ba902b7-01\r\n"
+       b"\r\n")
+
+
+def test_http1_request_full_headers():
+    rec = HttpParser().parse(REQ)
+    assert rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "GET /api/users"
+    assert rec.resource == "/api/users?id=7"
+    assert rec.req_type == "GET"
+    assert rec.domain == "api.example.com"
+    assert rec.version == "1.1"
+    assert rec.user_agent == "curl/8.0"
+    assert rec.referer == "https://example.com/home"
+    assert rec.x_request_id == "req-42"
+    assert rec.client_ip == "203.0.113.9"
+    assert rec.trace_id == "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert rec.span_id == "00f067aa0ba902b7"
+
+
+def test_http1_response_content_length():
+    resp = (b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 512\r\n\r\n" + b"x" * 16)
+    rec = HttpParser().parse(resp)
+    assert rec.msg_type == MSG_RESPONSE and rec.status == 200
+    assert rec.resp_len == 512          # framing truth, not capture size
+
+
+def test_http1_chunked_body_accounting():
+    body = (b"4\r\nWiki\r\n"
+            b"5\r\npedia\r\n"
+            b"0\r\n\r\n")
+    resp = (b"HTTP/1.1 200 OK\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n" + body)
+    rec = HttpParser().parse(resp)
+    assert rec.resp_len == 9            # 4 + 5, terminator excluded
+    # a lying chunk size is capped at the bytes actually present
+    liar = (b"HTTP/1.1 200 OK\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"FFFF\r\nonly-14-bytes!\r\n")
+    assert HttpParser().parse(liar).resp_len == 16  # 14 + CRLF present
+
+
+def test_parse_http_headers_first_value_wins_and_bounded():
+    payload = (b"GET / HTTP/1.1\r\n"
+               b"X-Dup: first\r\nX-Dup: second\r\n\r\n")
+    h = parse_http_headers(payload)
+    assert h["x-dup"] == "first"
+    flood = b"GET / HTTP/1.1\r\n" + b"".join(
+        b"H%d: v\r\n" % i for i in range(500)) + b"\r\n"
+    assert len(parse_http_headers(flood)) <= 64
+
+
+def test_http_body_len_no_framing_headers():
+    assert http_body_len(b"POST /x HTTP/1.1\r\nHost: a\r\n\r\nhello",
+                         {"host": "a"}) == 5
+
+
+# -- session merge carries the detail -------------------------------------
+def test_session_merge_carries_trace_context():
+    agg = SessionAggregator()
+    req = HttpParser().parse(REQ)
+    resp = HttpParser().parse(b"HTTP/1.1 200 OK\r\n"
+                              b"Content-Length: 2\r\n"
+                              b"X-Request-Id: resp-43\r\n\r\nok")
+    assert agg.offer(("f",), req, 1_000) is None
+    merged = agg.offer(("f",), resp, 2_000)
+    assert merged["trace_id"] == "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert merged["span_id"] == "00f067aa0ba902b7"
+    assert merged["domain"] == "api.example.com"
+    assert merged["user_agent"] == "curl/8.0"
+    assert merged["client_ip"] == "203.0.113.9"
+    assert merged["x_request_id_0"] == "req-42"
+    assert merged["x_request_id_1"] == "resp-43"
+
+
+# -- HTTP/2: same extraction through HPACK --------------------------------
+def test_http2_request_trace_headers():
+    import struct
+
+    from deepflow_tpu.agent import l7_ext
+
+    def lit(name: bytes, value: bytes) -> bytes:
+        return (b"\x00" + bytes([len(name)]) + name
+                + bytes([len(value)]) + value)
+
+    tp = b"00-aaaabbbbccccddddeeeeffff00001111-2222333344445555-01"
+    block = (b"\x82"                                    # :method GET
+             + lit(b":path", b"/v2/users?x=1")
+             + lit(b":authority", b"svc.example.com")
+             + lit(b"traceparent", tp)
+             + lit(b"x-request-id", b"h2-req-1")
+             + lit(b"user-agent", b"grpc-go/1.50"))
+    payload = l7_ext._H2_PREFACE + len(block).to_bytes(3, "big") + \
+        bytes([0x1, 0x4]) + struct.pack(">I", 1) + block
+    rec = l7_ext.Http2Parser().parse(payload)
+    assert rec.msg_type == MSG_REQUEST
+    assert rec.endpoint == "GET /v2/users"
+    assert rec.resource == "/v2/users?x=1"
+    assert rec.domain == "svc.example.com"
+    assert rec.version == "2"
+    assert rec.trace_id == "aaaabbbbccccddddeeeeffff00001111"
+    assert rec.span_id == "2222333344445555"
+    assert rec.x_request_id == "h2-req-1"
+    assert rec.user_agent == "grpc-go/1.50"
+
+
+# -- the wire carries it: session dict -> protobuf -> columns -------------
+def test_l7_wire_roundtrip_stamps_trace_columns(tmp_path):
+    from deepflow_tpu.agent.trident import _l7_record_bytes
+    from deepflow_tpu.decode.columnar import decode_l7_records
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    agg = SessionAggregator()
+    agg.offer(("f",), HttpParser().parse(REQ), 1_000)
+    merged = agg.offer(("f",), HttpParser().parse(
+        b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"), 2_000)
+    raw = _l7_record_bytes((0x0A000001, 0x0A000002, 555, 80, 6),
+                           merged, 2_000, vtap_id=3)
+    dicts = TagDictRegistry(str(tmp_path))
+    d = dicts.get("l7_endpoint")
+    cols = decode_l7_records([raw], endpoint_dict=d)
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert cols["trace_id_hash"][0] == np.uint32(d.encode_one(tid))
+    assert cols["span_id_hash"][0] != 0
+    assert cols["request_domain_hash"][0] == np.uint32(
+        d.encode_one("api.example.com"))
+    assert cols["user_agent_hash"][0] != 0
+    assert cols["x_request_id_0_hash"][0] == np.uint32(
+        d.encode_one("req-42"))
+    # the dict reverses the hash back to the trace id (tempo lookup path)
+    assert d.decode(int(cols["trace_id_hash"][0])) == tid
+    dicts.close()
+
+
+def test_configure_accepts_comma_strings_and_lists():
+    trace_context.configure(trace_types="X-MyTrace, sw8",
+                            x_request_id=["X-Req-A", "x-req-b"])
+    cfg = trace_context.config()
+    assert cfg.trace_types == ("x-mytrace", "sw8")
+    assert cfg.x_request_id == ("x-req-a", "x-req-b")
+    got = trace_context.extract({"x-req-b": "id-9"})
+    assert got["x_request_id"] == "id-9"
+
+
+def test_chunked_rejects_hostile_size_tokens():
+    for tok in (b"-2", b"+3", b"1_0", b"0x10", b""):
+        payload = (b"HTTP/1.1 200 OK\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n"
+                   + tok + b"\r\nAAAA\r\n")
+        assert http_body_len(payload, {"transfer-encoding": "chunked"}) == 0
+
+
+def test_http2_duplicate_header_first_wins():
+    import struct
+
+    from deepflow_tpu.agent import l7_ext
+
+    def lit(name: bytes, value: bytes) -> bytes:
+        return (b"\x00" + bytes([len(name)]) + name
+                + bytes([len(value)]) + value)
+
+    block = (b"\x82" + lit(b":path", b"/")
+             + lit(b"x-forwarded-for", b"1.1.1.1")
+             + lit(b"x-forwarded-for", b"2.2.2.2"))
+    payload = l7_ext._H2_PREFACE + len(block).to_bytes(3, "big") + \
+        bytes([0x1, 0x4]) + struct.pack(">I", 1) + block
+    rec = l7_ext.Http2Parser().parse(payload)
+    assert rec.client_ip == "1.1.1.1"       # same as HTTP/1 semantics
