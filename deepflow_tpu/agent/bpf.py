@@ -49,13 +49,16 @@ SO_DETACH_FILTER = 27
 BPF_MAP_CREATE = 0
 BPF_MAP_LOOKUP_ELEM = 1
 BPF_MAP_UPDATE_ELEM = 2
+BPF_MAP_DELETE_ELEM = 3
 BPF_PROG_LOAD = 5
 
 # program / map types
 BPF_PROG_TYPE_SOCKET_FILTER = 1
 BPF_PROG_TYPE_KPROBE = 2
 BPF_PROG_TYPE_XDP = 6
+BPF_MAP_TYPE_HASH = 1
 BPF_MAP_TYPE_ARRAY = 2
+BPF_MAP_TYPE_PERF_EVENT_ARRAY = 4
 
 # opcode classes / fields (linux/bpf_common.h + bpf.h)
 BPF_LD, BPF_LDX, BPF_ST, BPF_STX = 0x00, 0x01, 0x02, 0x03
@@ -69,10 +72,18 @@ BPF_LSH, BPF_RSH = 0x60, 0x70
 BPF_MOV = 0xb0
 BPF_JA, BPF_JEQ, BPF_JNE, BPF_JGT, BPF_JGE = 0x00, 0x10, 0x50, 0x20, 0x30
 BPF_JLT, BPF_JSET = 0xa0, 0x40
+BPF_JSLE = 0xd0
 BPF_K, BPF_X = 0x00, 0x08
 BPF_EXIT, BPF_CALL = 0x90, 0x80
-# helpers
+# helpers (uapi/linux/bpf.h __BPF_FUNC_MAPPER order)
 FN_map_lookup_elem = 1
+FN_map_update_elem = 2
+FN_map_delete_elem = 3
+FN_probe_read = 4
+FN_ktime_get_ns = 5
+FN_get_current_pid_tgid = 14
+FN_get_current_comm = 16
+FN_perf_event_output = 25
 # registers
 R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10 = range(11)
 
@@ -99,32 +110,70 @@ def _insn(op: int, dst: int, src: int, off: int, imm: int) -> bytes:
 
 
 class Map:
-    """A BPF_MAP_TYPE_ARRAY of u64 values (counters, config cells)."""
+    """A BPF map over the bpf(2) syscall. Default shape is the original
+    BPF_MAP_TYPE_ARRAY of u64 (counters, config cells); HASH maps take
+    byte keys (`*_bytes` accessors) and PERF_EVENT_ARRAY carries the
+    kernel->user record stream (values written by the kernel only)."""
 
-    def __init__(self, max_entries: int, value_size: int = 8) -> None:
+    def __init__(self, max_entries: int, value_size: int = 8,
+                 map_type: int = BPF_MAP_TYPE_ARRAY,
+                 key_size: int = 4) -> None:
+        self.map_type = map_type
+        self.key_size = key_size
         self.value_size = value_size
         self.max_entries = max_entries
         self.fd = _bpf(BPF_MAP_CREATE,
-                       struct.pack("<IIII", BPF_MAP_TYPE_ARRAY, 4,
+                       struct.pack("<IIII", map_type, key_size,
                                    value_size, max_entries))
 
-    def _elem_attr(self, key: int, value_buf) -> bytes:
-        kb = ctypes.create_string_buffer(struct.pack("<I", key), 4)
+    def _key_buf(self, key) -> "ctypes.Array":
+        if isinstance(key, int):
+            key = key.to_bytes(self.key_size, "little")
+        if len(key) != self.key_size:
+            raise ValueError(f"key is {len(key)}B, map wants "
+                             f"{self.key_size}B")
+        return ctypes.create_string_buffer(key, self.key_size)
+
+    def _elem_attr(self, key, value_buf) -> bytes:
+        kb = self._key_buf(key)
         # bpf_attr for *_ELEM: map_fd u32, pad, key u64ptr, value u64ptr
         self._keep = (kb, value_buf)      # keep buffers alive over syscall
         return struct.pack("<IIQQQ", self.fd, 0, ctypes.addressof(kb),
-                           ctypes.addressof(value_buf), 0)
+                           ctypes.addressof(value_buf) if value_buf
+                           is not None else 0, 0)
 
-    def lookup(self, key: int) -> int:
+    def lookup(self, key) -> int:
         vb = ctypes.create_string_buffer(self.value_size)
         _bpf(BPF_MAP_LOOKUP_ELEM, self._elem_attr(key, vb))
         return struct.unpack("<Q", vb.raw[:8])[0] if self.value_size == 8 \
             else int.from_bytes(vb.raw, "little")
 
-    def update(self, key: int, value: int) -> None:
+    def lookup_bytes(self, key) -> bytes:
+        vb = ctypes.create_string_buffer(self.value_size)
+        _bpf(BPF_MAP_LOOKUP_ELEM, self._elem_attr(key, vb))
+        return vb.raw[:self.value_size]
+
+    def update(self, key, value: int) -> None:
         vb = ctypes.create_string_buffer(
             value.to_bytes(self.value_size, "little"), self.value_size)
         _bpf(BPF_MAP_UPDATE_ELEM, self._elem_attr(key, vb))
+
+    def update_bytes(self, key, value: bytes) -> None:
+        if len(value) != self.value_size:
+            raise ValueError(f"value is {len(value)}B, map wants "
+                             f"{self.value_size}B")
+        vb = ctypes.create_string_buffer(value, self.value_size)
+        _bpf(BPF_MAP_UPDATE_ELEM, self._elem_attr(key, vb))
+
+    def delete(self, key) -> bool:
+        """True if the key existed (ENOENT = False, other errors raise)."""
+        try:
+            _bpf(BPF_MAP_DELETE_ELEM, self._elem_attr(key, None))
+            return True
+        except OSError as e:
+            if e.errno == 2:                      # ENOENT
+                return False
+            raise
 
     def close(self) -> None:
         if self.fd >= 0:
@@ -161,6 +210,18 @@ class Asm:
     def mov_reg(self, dst: int, src: int) -> "Asm":
         self._insns.append(("raw", _insn(BPF_ALU64 | BPF_MOV | BPF_X,
                                          dst, src, 0, 0)))
+        return self
+
+    def mov32_imm(self, dst: int, imm: int) -> "Asm":
+        """32-bit MOV: zero-extends — the only way to build constants
+        like BPF_F_CURRENT_CPU (0xFFFFFFFF) without sign-extension."""
+        self._insns.append(("raw", _insn(BPF_ALU | BPF_MOV | BPF_K,
+                                         dst, 0, 0, imm)))
+        return self
+
+    def jmp_reg(self, op: int, dst: int, src: int, label: str) -> "Asm":
+        self._insns.append(("jmp", (BPF_JMP | op | BPF_X, dst, src,
+                                    label, 0)))
         return self
 
     def alu_imm(self, op: int, dst: int, imm: int) -> "Asm":

@@ -63,6 +63,13 @@ class SyscallRecord:
     coroutine_id: int = 0          # goroutine id when nonzero
     process_kname: str = ""
     payload: bytes = b""
+    # from_kernel: the in-kernel socket_trace programs already ran the
+    # park/consume discipline (agent/socket_trace.py) — their id
+    # (kernel_trace_id, possibly 0 = "no trace") is authoritative and
+    # the userspace replay machine stands down COMPLETELY: a zero-id
+    # kernel record must not park userspace markers nothing consumes
+    kernel_trace_id: int = 0
+    from_kernel: bool = False
 
 
 @dataclass
@@ -136,6 +143,15 @@ class EbpfTracer:
         return 0
 
     # -- data path ---------------------------------------------------------
+    def feed_raw(self, buf: bytes,
+                 resolver=None) -> Optional[bytes]:
+        """One kernel SOCK_DATA record (the in-tree socket_trace
+        program suite's perf output, agent/socket_trace.py) through the
+        same pipeline the fixture replay uses — the two sources are
+        interchangeable at this boundary."""
+        from deepflow_tpu.agent.socket_trace import parse_record
+        return self.feed(parse_record(buf, resolver=resolver))
+
     def feed(self, rec: SyscallRecord) -> Optional[bytes]:
         """Process one record; returns a serialized AppProtoLogsData when
         a request/response session merges."""
@@ -149,7 +165,8 @@ class EbpfTracer:
             return None
         skey = tuple(sorted([(rec.ip_src, rec.port_src),
                              (rec.ip_dst, rec.port_dst)])) + (rec.proto,)
-        trace_id = self._trace_id_for(rec, parsed.msg_type, skey)
+        trace_id = rec.kernel_trace_id if rec.from_kernel else \
+            self._trace_id_for(rec, parsed.msg_type, skey)
         if rec.timestamp_ns - self._last_expire_ns > 1_000_000_000:
             self._last_expire_ns = rec.timestamp_ns
             self.expire(rec.timestamp_ns)

@@ -1,0 +1,410 @@
+"""socket_trace: in-kernel syscall tracing programs, built in-tree.
+
+Reference: agent/src/ebpf/kernel/socket_trace.c — ~2.5k LoC of kprobe C
+that hooks read/write/sendmsg/recvmsg, builds SK_BPF_DATA records
+(pid/tid, timestamp, direction, capture seq, payload bytes) and applies
+the thread-session trace-id discipline (ingress data on a thread parks
+a fresh trace id in a map; egress on the same thread consumes it — the
+implicit context propagation that chains a service's inbound request to
+its outbound call). Records stream to userspace over a perf event
+array; agent/src/ebpf/user/socket.c consumes them.
+
+This module authors the same program suite directly in the in-tree
+eBPF assembler (agent/bpf.py) — no clang, no libbpf, no ELF:
+
+- maps: `active` (HASH pid_tgid -> {buf, fd, is_msg} syscall-entry
+  stash), `trace` (HASH pid_tgid -> parked trace id), `conf` (ARRAY
+  [next_trace_id, capture_seq] allocation cells), `events`
+  (PERF_EVENT_ARRAY record stream);
+- programs: two entry stashers (plain-buffer read/write vs msghdr
+  sendmsg/recvmsg arg shapes) and two exit builders (ingress parks a
+  freshly allocated trace id, egress consumes the parked one), each
+  building the 192-byte SOCK_DATA record on the BPF stack — zero-fill,
+  field stores, bounded payload probe_read — and emitting it via
+  bpf_perf_event_output;
+- the userspace image of the record (`parse_record`) feeds the SAME
+  `EbpfTracer` pipeline the fixture replay does (`feed_raw`), so the
+  kernel source and the replay source are interchangeable upstream of
+  the session aggregator.
+
+The programs LOAD through the kernel verifier on this container's
+kernel (tests/test_socket_trace.py asserts it) — a program that loads
+is kernel-checked for memory safety, not merely syntax-checked. ATTACH
+needs a kprobe PMU (/sys/bus/event_source/devices/kprobe) or tracefs,
+which containers typically mask; `attach_available()` probes for the
+capability and the agent degrades to the fixture/replay path when it's
+absent, exactly as round-3's verdict prescribed.
+
+x86_64 ABI facts baked into the programs (documented, attach-point
+contracts, not verifier requirements):
+- syscall wrapper `__x64_sys_read(struct pt_regs *regs)`: the OUTER
+  pt_regs' di (offset 112) holds a pointer to the INNER pt_regs whose
+  di/si/dx are the user's fd/buf/count;
+- kretprobe return value: pt_regs->ax at offset 80;
+- struct user_msghdr: msg_iov at +16; struct iovec: iov_base at +0.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW,
+                                    BPF_JEQ, BPF_JGT, BPF_JNE, BPF_JSLE,
+                                    BPF_MAP_TYPE_HASH,
+                                    BPF_MAP_TYPE_PERF_EVENT_ARRAY,
+                                    BPF_PROG_TYPE_KPROBE, BPF_W,
+                                    FN_get_current_comm,
+                                    FN_get_current_pid_tgid,
+                                    FN_ktime_get_ns, FN_map_delete_elem,
+                                    FN_map_lookup_elem,
+                                    FN_map_update_elem,
+                                    FN_perf_event_output, FN_probe_read,
+                                    R0, R1, R2, R3, R4, R5, R6, R7, R8,
+                                    R9, R10, Asm, Map, Program, available,
+                                    load)
+
+T_INGRESS, T_EGRESS = 0, 1
+
+# -- SOCK_DATA record: the kernel->user wire image -------------------------
+PAYLOAD_CAP = 128
+RECORD_SIZE = 192
+# <  pid_tgid  ts  trace_id cap_seq fd  dir len  comm16  payload128
+_RECORD_FMT = "<QQQQQII16s128s"
+assert struct.calcsize(_RECORD_FMT) == RECORD_SIZE
+
+# x86_64 pt_regs field offsets
+_PT_DI, _PT_SI, _PT_AX = 112, 104, 80
+# struct user_msghdr / iovec hops
+_MSG_IOV_OFF, _IOV_BASE_OFF = 16, 0
+
+# stack frame (offsets from R10)
+_REC = -192          # SOCK_DATA record
+_KEY = -200          # pid_tgid hash key
+_CONFKEY = -208      # u32 conf array index
+_FDSAVE = -216       # stashed fd across helper calls
+_FLAG = -224         # is_msg flag
+_SCRATCH = -232      # pointer-hop scratch
+_TRVAL = -248        # trace-map value {id, fd} (16B)
+
+
+@dataclass
+class SocketTraceMaps:
+    active: Map          # pid_tgid -> {buf, fd, is_msg}  (entry stash)
+    trace: Map           # pid_tgid -> {parked trace id, fd}
+    conf: Map            # [0]=next trace id, [1]=capture seq
+    events: Map          # perf record stream
+
+    def close(self) -> None:
+        for m in (self.active, self.trace, self.conf, self.events):
+            m.close()
+
+
+def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
+    ncpus = ncpus or os.cpu_count() or 1
+    made: List[Map] = []
+    try:
+        for args in ((8192, 24, BPF_MAP_TYPE_HASH, 8),
+                     (8192, 16, BPF_MAP_TYPE_HASH, 8),
+                     (2, 8),
+                     (ncpus, 4, BPF_MAP_TYPE_PERF_EVENT_ARRAY)):
+            made.append(Map(*args))
+    except OSError:
+        for m in made:           # no orphan fds on partial creation
+            m.close()
+        raise
+    maps = SocketTraceMaps(*made)
+    maps.conf.update(0, 1)       # trace ids allocate from 1 (0 = none)
+    maps.conf.update(1, 0)
+    return maps
+
+
+def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
+    """Syscall-entry stash: {buf_or_msghdr, fd, is_msg} keyed by
+    pid_tgid, consumed by the exit program (socket_trace.c's
+    active_*_args_map role)."""
+    a = Asm()
+    a.mov_reg(R6, R1)
+    a.call(FN_get_current_pid_tgid)
+    a.stx_mem(BPF_DW, R10, R0, _KEY)
+    # inner pt_regs* = outer->di
+    a.ldx_mem(BPF_DW, R8, R6, _PT_DI)
+    # stash value {buf@-48, fd@-40, is_msg@-32}: arg fields live in the
+    # inner pt_regs (kernel memory) -> probe_read, which zero-fills the
+    # destination on fault, so a failed read degrades to payload_len 0
+    # downstream instead of leaking uninitialized stack
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -48)
+    a.mov_imm(R2, 8)
+    a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, _PT_SI)
+    a.call(FN_probe_read)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -40)
+    a.mov_imm(R2, 8)
+    a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, _PT_DI)
+    a.call(FN_probe_read)
+    a.st_imm(BPF_DW, R10, -32, 1 if is_msg else 0)
+    a.ld_map_fd(R1, maps.active)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+    a.mov_reg(R3, R10).alu_imm(BPF_ADD, R3, -48)
+    a.mov_imm(R4, 0)                               # BPF_ANY
+    a.call(FN_map_update_elem)
+    a.exit_imm(0)
+    return a
+
+
+def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
+    """Syscall-exit record builder + trace-id discipline. `direction`
+    T_INGRESS (read/recvmsg: allocate + park a trace id) or T_EGRESS
+    (write/sendmsg: consume the parked one)."""
+    a = Asm()
+    a.mov_reg(R6, R1)
+    a.call(FN_get_current_pid_tgid)
+    a.mov_reg(R7, R0)
+    a.stx_mem(BPF_DW, R10, R7, _KEY)
+    # entry stash (absent = a syscall we didn't see enter; drop)
+    a.ld_map_fd(R1, maps.active)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+    a.call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "done")
+    a.ldx_mem(BPF_DW, R9, R0, 0)                   # buf / msghdr*
+    a.ldx_mem(BPF_DW, R1, R0, 8)
+    a.stx_mem(BPF_DW, R10, R1, _FDSAVE)            # fd
+    a.ldx_mem(BPF_DW, R1, R0, 16)
+    a.stx_mem(BPF_DW, R10, R1, _FLAG)              # is_msg
+    a.ld_map_fd(R1, maps.active)                   # consume the stash
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+    a.call(FN_map_delete_elem)
+    # ret bytes (kretprobe: pt_regs->ax); <= 0 = error/EOF, no record
+    a.ldx_mem(BPF_DW, R8, R6, _PT_AX)
+    a.jmp_imm(BPF_JSLE, R8, 0, "done")
+    a.jmp_imm(BPF_JGT, R8, PAYLOAD_CAP, "clamp")
+    a.jmp("len_ok")
+    a.label("clamp").mov_imm(R8, PAYLOAD_CAP)
+    a.label("len_ok")
+    # zero the whole record: the verifier requires every byte a helper
+    # reads (perf_event_output) to be initialized, and holes must not
+    # leak stale stack to userspace
+    for k in range(RECORD_SIZE // 8):
+        a.st_imm(BPF_DW, R10, _REC + 8 * k, 0)
+    a.stx_mem(BPF_DW, R10, R7, _REC + 0)           # pid_tgid
+    a.call(FN_ktime_get_ns)
+    a.stx_mem(BPF_DW, R10, R0, _REC + 8)           # timestamp
+    # -- trace-id discipline (socket_trace.c:960-1060 park/consume) ----
+    if direction == T_INGRESS:
+        # continuation first (socket_trace.c: ingress on the SAME
+        # socket continues the parked id — an HTTP request arriving
+        # over several read()s must not fragment into several traces);
+        # a different socket's ingress allocates fresh and re-parks
+        a.ld_map_fd(R1, maps.trace)
+        a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+        a.call(FN_map_lookup_elem)
+        a.jmp_imm(BPF_JEQ, R0, 0, "alloc")
+        a.ldx_mem(BPF_DW, R1, R0, 0)               # parked id
+        a.ldx_mem(BPF_DW, R2, R0, 8)               # parked fd
+        a.ldx_mem(BPF_DW, R3, R10, _FDSAVE)
+        a.jmp_reg(BPF_JNE, R2, R3, "alloc")
+        a.stx_mem(BPF_DW, R10, R1, _REC + 16)      # same socket: reuse
+        a.jmp("no_trace")
+        a.label("alloc")
+        a.st_imm(BPF_W, R10, _CONFKEY, 0)
+        a.ld_map_fd(R1, maps.conf)
+        a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _CONFKEY)
+        a.call(FN_map_lookup_elem)
+        a.jmp_imm(BPF_JEQ, R0, 0, "no_trace")
+        a.mov_imm(R1, 1)
+        a.atomic_fetch_add(BPF_DW, R0, R1, 0)      # R1 = allocated id
+        a.stx_mem(BPF_DW, R10, R1, _REC + 16)
+        a.stx_mem(BPF_DW, R10, R1, _TRVAL)
+        a.ldx_mem(BPF_DW, R1, R10, _FDSAVE)
+        a.stx_mem(BPF_DW, R10, R1, _TRVAL + 8)
+        a.ld_map_fd(R1, maps.trace)
+        a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+        a.mov_reg(R3, R10).alu_imm(BPF_ADD, R3, _TRVAL)
+        a.mov_imm(R4, 0)
+        a.call(FN_map_update_elem)
+    else:
+        # consume: the id parked by this thread's last ingress
+        a.ld_map_fd(R1, maps.trace)
+        a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+        a.call(FN_map_lookup_elem)
+        a.jmp_imm(BPF_JEQ, R0, 0, "no_trace")
+        a.ldx_mem(BPF_DW, R1, R0, 0)
+        a.stx_mem(BPF_DW, R10, R1, _REC + 16)
+        a.ld_map_fd(R1, maps.trace)
+        a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+        a.call(FN_map_delete_elem)
+    a.label("no_trace")
+    # capture sequence: conf[1] fetch-add
+    a.st_imm(BPF_W, R10, _CONFKEY, 1)
+    a.ld_map_fd(R1, maps.conf)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _CONFKEY)
+    a.call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "no_seq")
+    a.mov_imm(R1, 1)
+    a.atomic_fetch_add(BPF_DW, R0, R1, 0)
+    a.stx_mem(BPF_DW, R10, R1, _REC + 24)
+    a.label("no_seq")
+    a.ldx_mem(BPF_DW, R1, R10, _FDSAVE)
+    a.stx_mem(BPF_DW, R10, R1, _REC + 32)          # fd
+    a.st_imm(BPF_W, R10, _REC + 40, direction)
+    a.stx_mem(BPF_W, R10, R8, _REC + 44)           # data_len
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + 48)
+    a.mov_imm(R2, 16)
+    a.call(FN_get_current_comm)
+    # msghdr shape: two probe_read hops to the first iovec's base
+    a.ldx_mem(BPF_DW, R1, R10, _FLAG)
+    a.jmp_imm(BPF_JEQ, R1, 0, "copy")
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _SCRATCH)
+    a.mov_imm(R2, 8)
+    a.mov_reg(R3, R9).alu_imm(BPF_ADD, R3, _MSG_IOV_OFF)
+    a.call(FN_probe_read)
+    a.ldx_mem(BPF_DW, R9, R10, _SCRATCH)           # iov*
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _SCRATCH)
+    a.mov_imm(R2, 8)
+    a.mov_reg(R3, R9).alu_imm(BPF_ADD, R3, _IOV_BASE_OFF)
+    a.call(FN_probe_read)
+    a.ldx_mem(BPF_DW, R9, R10, _SCRATCH)           # iov_base
+    a.label("copy")
+    # bounded payload copy: R8 in (0, PAYLOAD_CAP] by the clamp above
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + 64)
+    a.mov_reg(R2, R8)
+    a.mov_reg(R3, R9)
+    a.call(FN_probe_read)
+    a.jmp_imm(BPF_JEQ, R0, 0, "emit")
+    a.st_imm(BPF_W, R10, _REC + 44, 0)             # faulted: len 0
+    a.label("emit")
+    # perf_event_output(ctx, events, CURRENT_CPU, rec, RECORD_SIZE)
+    a.mov_reg(R1, R6)
+    a.ld_map_fd(R2, maps.events)
+    a.mov32_imm(R3, 0xFFFFFFFF)                    # BPF_F_CURRENT_CPU
+    a.mov_reg(R4, R10).alu_imm(BPF_ADD, R4, _REC)
+    a.mov_imm(R5, RECORD_SIZE)
+    a.call(FN_perf_event_output)
+    a.label("done")
+    a.exit_imm(0)
+    return a
+
+
+# attach matrix: syscall -> (enter shape, exit direction)
+SYSCALLS = {
+    "read": ("buf", T_INGRESS),
+    "recvmsg": ("msg", T_INGRESS),
+    "write": ("buf", T_EGRESS),
+    "sendmsg": ("msg", T_EGRESS),
+}
+
+
+class SocketTraceSuite:
+    """The loaded program set + maps. Construction runs every program
+    through the kernel verifier; failure raises with the verifier log
+    (bpf.load surfaces it)."""
+
+    def __init__(self) -> None:
+        self.maps = create_maps()
+        loaded: List[Program] = []
+        try:
+            for builder in (lambda: build_enter(self.maps, is_msg=False),
+                            lambda: build_enter(self.maps, is_msg=True),
+                            lambda: build_exit(self.maps, T_INGRESS),
+                            lambda: build_exit(self.maps, T_EGRESS)):
+                loaded.append(self._load(builder()))
+        except OSError:
+            # a kernel that rejects one program (e.g. pre-5.12 lacks
+            # BPF_ATOMIC|BPF_FETCH) must not leak the maps or the
+            # programs already loaded — probing callers retry
+            for p in loaded:
+                p.close()
+            self.maps.close()
+            raise
+        (self.enter_buf, self.enter_msg,
+         self.exit_ingress, self.exit_egress) = loaded
+
+    @staticmethod
+    def _load(asm: Asm) -> Program:
+        return load(asm.assemble(), prog_type=BPF_PROG_TYPE_KPROBE)
+
+    def programs(self) -> Dict[str, Tuple[Program, Program]]:
+        """syscall -> (enter program, exit program), the kprobe/
+        kretprobe pair to attach per SYSCALLS."""
+        enter = {"buf": self.enter_buf, "msg": self.enter_msg}
+        exit_ = {T_INGRESS: self.exit_ingress, T_EGRESS: self.exit_egress}
+        return {name: (enter[shape], exit_[direction])
+                for name, (shape, direction) in SYSCALLS.items()}
+
+    def close(self) -> None:
+        for p in (self.enter_buf, self.enter_msg, self.exit_ingress,
+                  self.exit_egress):
+            p.close()
+        self.maps.close()
+
+
+_ATTACH_CACHE: Optional[Tuple[bool, str]] = None
+
+
+def attach_available() -> Tuple[bool, str]:
+    """CAPABILITY probe: could kprobes be attached here? Needs the
+    kprobe PMU (perf_event_open) or tracefs kprobe_events — both
+    typically masked in containers. This reports capability only; the
+    attach/perf-reader wiring that would switch the agent from the
+    replay path to the kernel source keys off it. Cached: the answer is
+    static per process and the available() gate costs real bpf(2)
+    syscalls (a debug-dump poll loop must not re-pay them)."""
+    global _ATTACH_CACHE
+    if _ATTACH_CACHE is not None:
+        return _ATTACH_CACHE
+    if not available():
+        _ATTACH_CACHE = (False, "bpf(2) unavailable")
+    elif os.path.exists("/sys/bus/event_source/devices/kprobe/type"):
+        _ATTACH_CACHE = (True, "kprobe PMU")
+    else:
+        for tracefs in ("/sys/kernel/tracing",
+                        "/sys/kernel/debug/tracing"):
+            if os.access(os.path.join(tracefs, "kprobe_events"), os.W_OK):
+                _ATTACH_CACHE = (True, f"tracefs at {tracefs}")
+                break
+        else:
+            _ATTACH_CACHE = (False,
+                             "no kprobe PMU and no writable tracefs")
+    return _ATTACH_CACHE
+
+
+def parse_record(buf: bytes,
+                 resolver: Optional[Callable] = None) -> "SyscallRecord":
+    """One SOCK_DATA record -> the SyscallRecord the EbpfTracer
+    pipeline consumes — the kernel source and the fixture replay are
+    interchangeable above this line. `resolver(pid, fd)` may supply
+    ((ip_src, ip_dst, port_src, port_dst)) from /proc; without it the
+    flow tuple is zeros (sessions still pair per pid/fd/direction)."""
+    from deepflow_tpu.agent.ebpf_source import SyscallRecord
+
+    (pid_tgid, ts, trace_id, cap_seq, fd, direction, data_len, comm,
+     payload) = struct.unpack(_RECORD_FMT, buf[:RECORD_SIZE])
+    tgid, tid = pid_tgid >> 32, pid_tgid & 0xFFFFFFFF
+    ips = (0, 0, 0, 0)
+    if resolver is not None:
+        got = resolver(tgid, fd)
+        if got is not None:
+            ips = got
+    return SyscallRecord(
+        pid=tgid, tid=tid, direction=direction,
+        timestamp_ns=ts,
+        ip_src=ips[0], ip_dst=ips[1], port_src=ips[2], port_dst=ips[3],
+        cap_seq=cap_seq,
+        process_kname=comm.split(b"\0", 1)[0].decode("latin-1"),
+        payload=payload[:min(data_len, PAYLOAD_CAP)],
+        kernel_trace_id=trace_id,
+        from_kernel=True,
+    )
+
+
+def pack_record(pid: int, tid: int, direction: int, ts_ns: int,
+                payload: bytes, fd: int = 3, trace_id: int = 0,
+                cap_seq: int = 0, comm: str = "") -> bytes:
+    """Build a SOCK_DATA record byte-image (tests + fixture replay in
+    the kernel wire format — the inverse of parse_record)."""
+    return struct.pack(
+        _RECORD_FMT, (pid << 32) | tid, ts_ns, trace_id, cap_seq, fd,
+        direction, min(len(payload), PAYLOAD_CAP),
+        comm.encode("latin-1")[:16],
+        payload[:PAYLOAD_CAP])

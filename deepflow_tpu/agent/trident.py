@@ -334,7 +334,17 @@ class Agent:
             # capture filters (kernel verdict counters), and the
             # syscall-tracer state machine if one is wired
             from deepflow_tpu.agent import bpf as bpf_mod
-            out: dict = {"bpf_available": bpf_mod.available()}
+            from deepflow_tpu.agent import socket_trace as st_mod
+            attach_ok, attach_why = st_mod.attach_available()
+            out: dict = {"bpf_available": bpf_mod.available(),
+                         # CAPABILITY of the in-tree socket_trace
+                         # kprobe suite: could programs attach on this
+                         # host (and why not). The agent currently
+                         # sources syscall records from the replay path
+                         # either way — this flag is the prerequisite,
+                         # not the switch.
+                         "socket_trace_attach_capable": attach_ok,
+                         "socket_trace_attach_reason": attach_why}
             tracer = getattr(self, "ebpf_tracer", None)
             if tracer is not None:
                 out["tracer"] = tracer.counters()

@@ -1,0 +1,154 @@
+"""In-tree socket_trace eBPF suite: verifier-loaded kprobe programs,
+kernel-exercised map layouts, and the SOCK_DATA record contract shared
+with the EbpfTracer pipeline (reference:
+agent/src/ebpf/kernel/socket_trace.c)."""
+
+import struct
+
+import pytest
+
+from deepflow_tpu.agent import bpf, socket_trace
+from deepflow_tpu.agent.bpf import (BPF_MAP_TYPE_HASH, Map)
+from deepflow_tpu.agent.ebpf_source import EbpfTracer
+from deepflow_tpu.agent.socket_trace import (PAYLOAD_CAP, RECORD_SIZE,
+                                             SYSCALLS, T_EGRESS,
+                                             T_INGRESS, SocketTraceSuite,
+                                             attach_available,
+                                             pack_record, parse_record)
+
+pytestmark = pytest.mark.skipif(not bpf.available(),
+                                reason="bpf(2) unavailable")
+
+
+def test_hash_map_kernel_ops():
+    """HASH map create/update/lookup/delete against the real kernel —
+    the trace map's layout (u64 pid_tgid -> {u64 trace id, u64 fd},
+    the fd enabling same-socket ingress continuation)."""
+    m = Map(64, value_size=16, map_type=BPF_MAP_TYPE_HASH, key_size=8)
+    try:
+        key = struct.pack("<Q", (1234 << 32) | 77)
+        with pytest.raises(OSError):        # ENOENT before insert
+            m.lookup_bytes(key)
+        m.update_bytes(key, struct.pack("<QQ", 42, 9))
+        assert struct.unpack("<QQ", m.lookup_bytes(key)) == (42, 9)
+        assert m.delete(key) is True
+        assert m.delete(key) is False       # already gone
+    finally:
+        m.close()
+
+
+def test_active_stash_map_layout():
+    """The entry-stash value layout {buf, fd, is_msg} (24B) the exit
+    program reads at fixed offsets."""
+    m = Map(64, value_size=24, map_type=BPF_MAP_TYPE_HASH, key_size=8)
+    try:
+        key = struct.pack("<Q", 9)
+        m.update_bytes(key, struct.pack("<QQQ", 0xDEAD, 5, 1))
+        buf, fd, is_msg = struct.unpack("<QQQ", m.lookup_bytes(key))
+        assert (buf, fd, is_msg) == (0xDEAD, 5, 1)
+    finally:
+        m.close()
+
+
+def test_all_four_programs_pass_the_verifier():
+    """The deliverable: kprobe-type socket_trace programs LOAD through
+    the kernel verifier on this kernel — memory-safety-checked, not
+    merely assembled."""
+    suite = SocketTraceSuite()
+    try:
+        progs = suite.programs()
+        assert set(progs) == set(SYSCALLS)
+        for name, (enter, exit_) in progs.items():
+            assert enter.fd >= 0 and exit_.fd >= 0, name
+        # shapes share programs: read/write stash via the plain-buffer
+        # enter, sendmsg/recvmsg via the msghdr one
+        assert progs["read"][0] is progs["write"][0]
+        assert progs["recvmsg"][0] is progs["sendmsg"][0]
+        # directions share exits: read/recvmsg park, write/sendmsg consume
+        assert progs["read"][1] is progs["recvmsg"][1]
+        assert progs["write"][1] is progs["sendmsg"][1]
+        # trace-id allocation starts at 1 (0 = "no trace")
+        assert suite.maps.conf.lookup(0) == 1
+    finally:
+        suite.close()
+
+
+def test_attach_probe_reports_capability():
+    ok, reason = attach_available()
+    assert isinstance(ok, bool) and isinstance(reason, str)
+    # in this container attach is expected to be masked; the probe must
+    # say why rather than guessing
+    if not ok:
+        assert reason
+
+
+def test_record_roundtrip():
+    raw = pack_record(pid=1234, tid=77, direction=T_INGRESS,
+                      ts_ns=5_000_000, payload=b"GET / HTTP/1.1\r\n\r\n",
+                      fd=9, trace_id=6, cap_seq=3, comm="svc-a")
+    assert len(raw) == RECORD_SIZE
+    rec = parse_record(raw)
+    assert (rec.pid, rec.tid) == (1234, 77)
+    assert rec.direction == T_INGRESS
+    assert rec.timestamp_ns == 5_000_000
+    assert rec.kernel_trace_id == 6
+    assert rec.cap_seq == 3
+    assert rec.process_kname == "svc-a"
+    assert rec.payload == b"GET / HTTP/1.1\r\n\r\n"
+
+
+def test_payload_cap_enforced():
+    rec = parse_record(pack_record(1, 1, T_EGRESS, 0,
+                                   payload=b"A" * 500))
+    assert len(rec.payload) == PAYLOAD_CAP
+    # a lying data_len beyond the cap must not over-read
+    raw = bytearray(pack_record(1, 1, T_EGRESS, 0, payload=b"B" * 8))
+    struct.pack_into("<I", raw, 44, 4096)
+    assert len(parse_record(bytes(raw)).payload) == PAYLOAD_CAP
+
+
+def test_feed_raw_kernel_records_merge_a_session():
+    """Kernel-format SOCK_DATA records through the SAME EbpfTracer
+    pipeline the fixture replay uses: request+response pair into one
+    wire l7 record, with the KERNEL's trace id authoritative."""
+    from deepflow_tpu.decode.columnar import decode_l7_records
+
+    def resolver(pid, fd):
+        return (0x0A000001, 0x0A000002, 5000, 80)
+
+    tracer = EbpfTracer(vtap_id=7)
+    w1 = tracer.feed_raw(
+        pack_record(10, 7, T_INGRESS, 1_000_000_000,
+                    payload=b"GET /api HTTP/1.1\r\nHost: a\r\n\r\n",
+                    trace_id=55, comm="svc-a"),
+        resolver=resolver)
+    assert w1 is None                       # request parked
+    w2 = tracer.feed_raw(
+        pack_record(10, 7, T_EGRESS, 1_002_000_000,
+                    payload=b"HTTP/1.1 200 OK\r\nContent-Length: 2"
+                            b"\r\n\r\nok",
+                    trace_id=55, comm="svc-a"),
+        resolver=resolver)
+    assert w2 is not None
+    cols = decode_l7_records([w2])
+    assert cols["syscall_trace_id_request"][0] == 55
+    assert cols["rrt_us"][0] == 2000
+    assert cols["process_kname_0_hash"][0] != 0
+    # the kernel already ran the park/consume discipline: the userspace
+    # replay machine must stand down entirely — zero-id kernel records
+    # must not park markers nothing will ever consume
+    assert tracer._trace_map == {}
+
+
+def test_zero_id_kernel_records_do_not_grow_userspace_trace_map():
+    def resolver(pid, fd):
+        return (0x0A000001, 0x0A000002, 5000, 80)
+
+    tracer = EbpfTracer()
+    for i in range(20):
+        tracer.feed_raw(
+            pack_record(50 + i, 1, T_EGRESS, 1_000_000_000 + i,
+                        payload=b"GET /x HTTP/1.1\r\n\r\n",
+                        trace_id=0),
+            resolver=resolver)
+    assert tracer._trace_map == {}
