@@ -12,6 +12,7 @@ onto the firehose.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -75,6 +76,14 @@ class AgentConfig:
     # agent-side L7 session rate cap per second (reference:
     # l7_log_collect_nps_threshold, default 10000); 0 = uncapped
     l7_log_rate: int = 10_000
+    # continuous OnCPU profiling (agent/profiler.py, the perf_profiler.c
+    # role): pids to sample (0 = the agent's own process). Each cycle
+    # samples `profile_duration_s` at `profile_freq_hz` and ships the
+    # folded stacks as Profile records on the firehose. Empty = off.
+    profile_pids: tuple = ()
+    profile_interval_s: float = 10.0
+    profile_duration_s: float = 1.0
+    profile_freq_hz: int = 99
     # agent-side UDP debug server (reference: agent/src/debug/ serving
     # per-subsystem dumps to deepflow-ctl). None disables; 0 = ephemeral
     debug_port: Optional[int] = None
@@ -254,6 +263,10 @@ class Agent:
             self.pseq = PacketSequenceCollector()
             self.flow_map.want_packet_context = True
             sender_types.append(MessageType.PACKETSEQUENCE)
+        self.profiles_sent = 0
+        self.profile_errors = 0
+        if cfg.profile_pids:
+            sender_types.append(MessageType.PROFILE)
         self.senders: Dict[MessageType, UniformSender] = {
             mt: UniformSender(mt, cfg.ingester_addr)
             for mt in sender_types
@@ -746,6 +759,13 @@ class Agent:
                              daemon=True)
         t.start()
         self._threads.append(t)
+        if self.cfg.profile_pids:
+            from deepflow_tpu.agent import profiler as prof_mod
+            if prof_mod.available():
+                tp = threading.Thread(target=self._profile_loop,
+                                      name="oncpu-profiler", daemon=True)
+                tp.start()
+                self._threads.append(tp)
 
     def close(self) -> None:
         self._stop.set()
@@ -783,6 +803,46 @@ class Agent:
         while not self._stop.wait(1.0):
             self.tick()
 
+    def _profile_loop(self) -> None:
+        """Continuous OnCPU profiling cycle: sample each configured pid
+        for profile_duration_s, ship folded stacks on the firehose. A
+        target that exits or refuses perf is counted, never fatal."""
+        from deepflow_tpu.agent.profiler import (OnCpuProfiler, Symbolizer,
+                                                 folded_to_profile_records)
+        # symbolizers cached per pid across cycles, invalidated when the
+        # process's mappings change — re-parsing every mapped ELF's
+        # symtab each 10s cycle would burn steady multi-MB IO for maps
+        # that almost never change
+        sym_cache: Dict[int, tuple] = {}
+        while not self._stop.wait(self.cfg.profile_interval_s):
+            for pid in self.cfg.profile_pids:
+                target = int(pid) or os.getpid()
+                try:
+                    with open(f"/proc/{target}/maps") as f:
+                        maps_txt = f.read()
+                    cached = sym_cache.get(target)
+                    if cached is None or cached[0] != maps_txt:
+                        cached = (maps_txt, Symbolizer(target))
+                        sym_cache[target] = cached
+                    prof = OnCpuProfiler(target,
+                                         freq_hz=self.cfg.profile_freq_hz)
+                    try:
+                        folded = prof.run(self.cfg.profile_duration_s,
+                                          symbolizer=cached[1])
+                    finally:
+                        prof.close()
+                except OSError:
+                    self.profile_errors += 1
+                    sym_cache.pop(target, None)   # e.g. target exited
+                    continue
+                if not folded:
+                    continue
+                recs = folded_to_profile_records(
+                    folded, app_service=self.cfg.host, pid=target,
+                    vtap_id=self.vtap_id)
+                self.profiles_sent += self.senders[
+                    MessageType.PROFILE].send(recs)
+
     def _aggr_sets_match(self, a: dict, b: dict) -> bool:
         """True when two aggregated-column dicts share an identical key
         set; on divergence, records it (visible in counters + debug)."""
@@ -798,6 +858,8 @@ class Agent:
         c = self.flow_map.counters()
         c["escaped"] = int(self.escaped)
         c["aggr_schema_errors"] = self.aggr_schema_errors
+        c["profiles_sent"] = self.profiles_sent
+        c["profile_errors"] = self.profile_errors
         c["ntp_offset_ns"] = self.ntp_offset_ns
         c["sessions_merged"] = self.sessions.merged
         c["l7_throttled"] = self.l7_throttled
