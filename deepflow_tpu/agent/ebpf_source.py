@@ -99,6 +99,14 @@ class EbpfTracer:
         self._last_expire_ns = 0
         self.records_in = 0
         self.parse_failed = 0
+        # GPIDSync plumbing: pids observed here ride the agent's sync
+        # request; the controller's global allocation comes back into
+        # gpid_map and is stamped onto every later wire record.
+        # pid -> [name, first_ts, last_ts]; pruned in expire() — an
+        # unbounded set would inflate every sync body and, past the
+        # controller's per-sync cap, starve NEW pids of allocation
+        self._seen_procs: Dict[int, list] = {}
+        self.gpid_map: Dict[int, int] = {}
 
     def expire(self, now_ns: int,
                timeout_ns: int = 30 * 1_000_000_000) -> None:
@@ -110,6 +118,14 @@ class EbpfTracer:
         for k in dead:
             self._meta.pop(k, None)
             self._meta_ts.pop(k, None)
+        # prune processes with no records for 10x the session timeout
+        # (process exit): their gpid allocations stay valid controller-
+        # side; re-appearing pids simply re-report
+        proc_timeout = timeout_ns * 10
+        for pid in [p for p, sp in self._seen_procs.items()
+                    if now_ns - sp[2] > proc_timeout]:
+            del self._seen_procs[pid]
+            self.gpid_map.pop(pid, None)
 
     # -- trace-id state machine -------------------------------------------
     def _trace_id_for(self, rec: SyscallRecord, msg_type: int,
@@ -156,6 +172,13 @@ class EbpfTracer:
         """Process one record; returns a serialized AppProtoLogsData when
         a request/response session merges."""
         self.records_in += 1
+        sp = self._seen_procs.get(rec.pid)
+        if sp is None:
+            self._seen_procs[rec.pid] = [rec.process_kname,
+                                         rec.timestamp_ns,
+                                         rec.timestamp_ns]
+        else:
+            sp[2] = rec.timestamp_ns
         parsed = parse_payload(
             rec.payload, proto=rec.proto, port_src=rec.port_src,
             port_dst=rec.port_dst, ts_ns=rec.timestamp_ns,
@@ -214,7 +237,21 @@ class EbpfTracer:
         b.process_kname_0 = req.kname
         b.process_kname_1 = resp.kname
         b.process_id_0 = rec.pid
+        # controller-allocated global process id (GPIDSync): what joins
+        # this span to the same process seen from other vtaps
+        b.gpid_0 = self.gpid_map.get(rec.pid, 0)
         return m.SerializeToString()
+
+    def seen_processes(self) -> list:
+        """Processes observed on this tracer, in the sync request's
+        GPIDSync shape (start_time = first-record timestamp, the
+        stable-across-pid-reuse key component). Most-recently-active
+        first and bounded: under pid churn the controller's per-sync
+        cap must see live processes, not ancient ones."""
+        items = sorted(self._seen_procs.items(),
+                       key=lambda kv: -kv[1][2])[:4096]
+        return [{"pid": pid, "name": sp[0], "start_time": sp[1]}
+                for pid, sp in items]
 
     def counters(self) -> dict:
         return {"records_in": self.records_in,

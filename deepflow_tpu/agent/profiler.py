@@ -189,32 +189,60 @@ class Symbolizer:
         return "[unknown]"
 
 
+class _TaskEvent:
+    """One perf event + mmap ring bound to one task (thread)."""
+
+    def __init__(self, tid: int, freq_hz: int, ring_pages: int) -> None:
+        self.fd = _perf_event_open(tid, freq_hz)
+        try:
+            self.ring = mmap.mmap(self.fd,
+                                  (ring_pages + 1) * mmap.PAGESIZE)
+        except OSError:
+            # e.g. perf_event_mlock_kb budget exhausted: the fd must
+            # not outlive the failed construction — a retrying agent
+            # loop would otherwise leak one per cycle
+            os.close(self.fd)
+            raise
+        self.data_size = ring_pages * mmap.PAGESIZE
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            self.ring.close()
+            os.close(self.fd)
+            self.fd = -1
+
+
 class OnCpuProfiler:
     """Sample one process's on-CPU user stacks; emit folded stacks.
 
-    run(duration) -> {folded_stack: sample_count}. The ring is drained
-    once after disable — sized for duration*freq samples at the default
-    chain depth, with a truncation counter when the kernel indicates
-    loss (lost records show as a gap in totals)."""
+    One perf event PER TASK: on this kernel class, inherit=1 refuses
+    ring mmap (EINVAL), so a single process-wide event would silently
+    sample only the main thread — worker-thread CPU (any thread pool)
+    would be invisible. Tasks are snapshotted from /proc/<pid>/task at
+    construction; threads spawned mid-window are picked up by the next
+    profiling cycle. run(duration) -> {folded_stack: sample_count}."""
 
     def __init__(self, pid: int, freq_hz: int = 199,
-                 ring_pages: int = 64) -> None:
+                 ring_pages: int = 16, max_tasks: int = 64) -> None:
         if not available():
             raise OSError(38, "perf_event_open unsupported here")
         self.pid = pid
         self.freq_hz = freq_hz
-        self.fd = _perf_event_open(pid, freq_hz)
         try:
-            self._ring = mmap.mmap(self.fd,
-                                   (ring_pages + 1) * mmap.PAGESIZE)
+            tids = sorted(int(t) for t in
+                          os.listdir(f"/proc/{pid}/task"))[:max_tasks]
         except OSError:
-            # e.g. perf_event_mlock_kb budget exhausted: close() is
-            # unreachable from here, so the fd must not outlive us — a
-            # retrying agent loop would otherwise leak one per cycle
-            os.close(self.fd)
-            self.fd = -1
-            raise
-        self._data_size = ring_pages * mmap.PAGESIZE
+            tids = [pid]
+        self._events: List[_TaskEvent] = []
+        last: Optional[OSError] = None
+        for tid in tids:
+            try:
+                self._events.append(_TaskEvent(tid, freq_hz, ring_pages))
+            except OSError as e:
+                last = e          # tid exited, or perf/mlock refused
+                continue
+        if not self._events:
+            raise last or OSError(3, f"no profilable tasks in pid {pid}")
         self.samples_seen = 0
         self.samples_other = 0       # non-SAMPLE ring records (lost, ...)
 
@@ -222,33 +250,37 @@ class OnCpuProfiler:
             symbolizer: Optional[Symbolizer] = None) -> Dict[str, int]:
         sym = symbolizer or Symbolizer(self.pid)
         import fcntl
-        fcntl.ioctl(self.fd, PERF_EVENT_IOC_ENABLE, 0)
+        for ev in self._events:
+            fcntl.ioctl(ev.fd, PERF_EVENT_IOC_ENABLE, 0)
         time.sleep(duration_s)
-        fcntl.ioctl(self.fd, PERF_EVENT_IOC_DISABLE, 0)
+        for ev in self._events:
+            fcntl.ioctl(ev.fd, PERF_EVENT_IOC_DISABLE, 0)
         folded: Dict[str, int] = {}
-        for pid, tid, ips in self._drain():
-            frames = [sym.resolve(ip) for ip in ips
-                      if ip < _CONTEXT_FLOOR]
-            if not frames:
-                continue
-            # kernel chains are leaf-first; folded format is root-first
-            folded_key = ";".join(reversed(frames))
-            folded[folded_key] = folded.get(folded_key, 0) + 1
-            self.samples_seen += 1
+        for ev in self._events:
+            for pid, tid, ips in self._drain(ev):
+                frames = [sym.resolve(ip) for ip in ips
+                          if ip < _CONTEXT_FLOOR]
+                if not frames:
+                    continue
+                # kernel chains are leaf-first; folded is root-first
+                folded_key = ";".join(reversed(frames))
+                folded[folded_key] = folded.get(folded_key, 0) + 1
+                self.samples_seen += 1
         return folded
 
-    def _drain(self) -> Iterable[Tuple[int, int, List[int]]]:
-        head, = struct.unpack_from("<Q", self._ring, _HEAD_OFF)
-        tail, = struct.unpack_from("<Q", self._ring, _TAIL_OFF)
+    def _drain(self, ev: _TaskEvent
+               ) -> Iterable[Tuple[int, int, List[int]]]:
+        head, = struct.unpack_from("<Q", ev.ring, _HEAD_OFF)
+        tail, = struct.unpack_from("<Q", ev.ring, _TAIL_OFF)
 
         def at(off: int, n: int) -> bytes:
-            off %= self._data_size
+            off %= ev.data_size
             base = mmap.PAGESIZE + off
-            if off + n <= self._data_size:
-                return self._ring[base:base + n]
-            first = self._data_size - off
-            return self._ring[base:base + first] + \
-                self._ring[mmap.PAGESIZE:mmap.PAGESIZE + n - first]
+            if off + n <= ev.data_size:
+                return ev.ring[base:base + n]
+            first = ev.data_size - off
+            return ev.ring[base:base + first] + \
+                ev.ring[mmap.PAGESIZE:mmap.PAGESIZE + n - first]
 
         while tail < head:
             rtype, _misc, size = struct.unpack("<IHH", at(tail, 8))
@@ -264,13 +296,16 @@ class OnCpuProfiler:
             else:
                 self.samples_other += 1
             tail += size
-        struct.pack_into("<Q", self._ring, _TAIL_OFF, tail)
+        struct.pack_into("<Q", ev.ring, _TAIL_OFF, tail)
+
+    @property
+    def task_count(self) -> int:
+        return len(self._events)
 
     def close(self) -> None:
-        if self.fd >= 0:
-            self._ring.close()
-            os.close(self.fd)
-            self.fd = -1
+        for ev in self._events:
+            ev.close()
+        self._events = []
 
 
 def folded_to_profile_records(folded: Dict[str, int], app_service: str,

@@ -15,6 +15,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -87,6 +88,9 @@ class AgentConfig:
     # agent-side UDP debug server (reference: agent/src/debug/ serving
     # per-subsystem dumps to deepflow-ctl). None disables; 0 = ephemeral
     debug_port: Optional[int] = None
+    # where controller-pushed upgrade packages are staged (rpc Upgrade
+    # role); None = /tmp
+    upgrade_dir: Optional[str] = None
     # ship the agent's own counters as DFSTATS onto the firehose
     # (reference: utils/stats.rs -> ingester deepflow_system DB)
     self_telemetry: bool = True
@@ -265,6 +269,14 @@ class Agent:
             sender_types.append(MessageType.PACKETSEQUENCE)
         self.profiles_sent = 0
         self.profile_errors = 0
+        self.gpid_map: Dict[int, int] = {}
+        self.upgrades_applied = 0
+        self.upgrade_errors = 0
+        self.sync_errors = 0
+        self.staged_package: Optional[str] = None
+        # real deployments exec the staged binary here; None = revision
+        # swap in place (process and firehose sockets stay up)
+        self.on_upgrade = None
         if cfg.profile_pids:
             sender_types.append(MessageType.PROFILE)
         self.senders: Dict[MessageType, UniformSender] = {
@@ -419,7 +431,11 @@ class Agent:
         body = json.dumps({"ctrl_ip": self.cfg.ctrl_ip,
                            "host": self.cfg.host,
                            "revision": self.cfg.revision,
-                           "boot": self.vtap_id == 0}).encode()
+                           "boot": self.vtap_id == 0,
+                           # GPIDSync leg: processes this agent observes
+                           # (its own + eBPF-seen); the controller
+                           # returns globally-unique gprocess ids
+                           "processes": self._local_processes()}).encode()
         req = urllib.request.Request(
             f"{self.cfg.controller_url}/v1/sync", data=body,
             headers={"Content-Type": "application/json"})
@@ -448,9 +464,89 @@ class Agent:
         if r["config_version"] != self.config_version:
             self._apply_config(r["config"])
             self.config_version = r["config_version"]
+        if r.get("gpids"):
+            self.gpid_map = {int(k): int(v)
+                             for k, v in r["gpids"].items()}
+            tracer = getattr(self, "ebpf_tracer", None)
+            if tracer is not None:
+                tracer.gpid_map = self.gpid_map
+        if r.get("upgrade"):
+            self._apply_upgrade(r["upgrade"])
         self.escape.on_sync_ok()
         self.escaped = False
         return True
+
+    def _local_processes(self) -> list:
+        """Processes this agent reports for GPIDSync: itself plus any
+        pids the eBPF tracer has seen records from."""
+        procs = [{"pid": os.getpid(), "name": "deepflow-agent",
+                  "start_time": self._self_start_time()}]
+        tracer = getattr(self, "ebpf_tracer", None)
+        if tracer is not None:
+            procs.extend(tracer.seen_processes())
+        return procs
+
+    @staticmethod
+    def _self_start_time() -> int:
+        try:
+            with open("/proc/self/stat") as f:
+                # field 22 (starttime, clock ticks since boot); fields
+                # after the parenthesized comm, which may contain spaces
+                return int(f.read().rsplit(")", 1)[1].split()[19])
+        except (OSError, IndexError, ValueError):
+            return 0
+
+    def _apply_upgrade(self, upg: dict) -> None:
+        """Staged agent upgrade (reference: rpc Upgrade + the agent's
+        upgrade task): fetch the package from the controller, verify
+        the checksum, stage it to disk, flush in-flight data, then
+        restart into the new revision. Here "restart" = the on_upgrade
+        callback (a real deployment execs the staged binary there); the
+        default keeps the process and its sender sockets alive, so the
+        firehose never drops a tick."""
+        import base64
+        import hashlib
+        if upg.get("revision") == self.cfg.revision:
+            return
+        try:
+            url = (f"{self.cfg.controller_url}/v1/upgrade-package?name="
+                   + urllib.parse.quote(upg["package"]))
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                doc = json.load(resp)
+            data = base64.b64decode(doc["data_b64"])
+        except Exception:
+            self.upgrade_errors += 1
+            return
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != upg.get("sha256"):
+            # corrupt/tampered package: refuse, stay on the old revision
+            self.upgrade_errors += 1
+            return
+        staged = os.path.join(self.cfg.upgrade_dir or "/tmp",
+                              f"deepflow-agent-{upg['revision']}")
+        try:
+            with open(staged + ".tmp", "wb") as f:
+                f.write(data)
+            os.replace(staged + ".tmp", staged)
+        except OSError:
+            self.upgrade_errors += 1
+            return
+        self.tick()                      # flush before the restart
+        if self.on_upgrade is not None:
+            # the restart hook (a deployment execs the staged binary
+            # here) runs BEFORE the revision flips: if it fails, the
+            # agent keeps reporting the old revision so the controller
+            # keeps retrying (and eventually quarantines it) instead of
+            # recording a converged agent that never restarted. The
+            # except also keeps the synchronizer thread alive.
+            try:
+                self.on_upgrade(staged, upg["revision"])
+            except Exception:
+                self.upgrade_errors += 1
+                return
+        self.cfg.revision = upg["revision"]
+        self.upgrades_applied += 1
+        self.staged_package = staged
 
     def _apply_config(self, cfg: dict) -> None:
         """Hot-apply pushed RuntimeConfig (reference: ConfigHandler)."""
@@ -794,10 +890,18 @@ class Agent:
         self._sync_wasm_plugins(())
 
     def _sync_loop(self) -> None:
-        self.sync_once()
-        while not self._stop.wait(self.cfg.sync_interval_s):
-            self.sync_once()
-            self.escape.check()
+        while True:
+            # the synchronizer thread must survive any single round's
+            # exception (a bad pushed config, an upgrade hook error):
+            # a dead sync loop means no config pushes, no escape
+            # checks, and no recovery — forever
+            try:
+                self.sync_once()
+                self.escape.check()
+            except Exception:
+                self.sync_errors += 1
+            if self._stop.wait(self.cfg.sync_interval_s):
+                return
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(1.0):
@@ -860,6 +964,8 @@ class Agent:
         c["aggr_schema_errors"] = self.aggr_schema_errors
         c["profiles_sent"] = self.profiles_sent
         c["profile_errors"] = self.profile_errors
+        c["upgrades_applied"] = self.upgrades_applied
+        c["upgrade_errors"] = self.upgrade_errors
         c["ntp_offset_ns"] = self.ntp_offset_ns
         c["sessions_merged"] = self.sessions.merged
         c["l7_throttled"] = self.l7_throttled

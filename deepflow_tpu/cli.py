@@ -56,6 +56,37 @@ def _table(rows, columns):
         print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
 
 
+def cmd_agent_upgrade(args) -> int:
+    """Staged fleet upgrade (reference: deepflow-ctl agent upgrade +
+    trident.proto rpc Upgrade): upload a package, target a group at a
+    revision, watch convergence."""
+    import base64
+    import os as _os
+    if args.action == "push":
+        if not args.package or not args.revision:
+            print("push requires --package <file> and --revision")
+            return 2
+        with open(args.package, "rb") as f:
+            data = f.read()
+        name = _os.path.basename(args.package)
+        up = _http(f"{args.controller}/v1/upgrade-package",
+                   body={"name": name,
+                         "data_b64": base64.b64encode(data).decode()})
+        out = _http(f"{args.controller}/v1/upgrade",
+                    body={"group": args.group, "revision": args.revision,
+                          "package": name})
+        print(json.dumps({"uploaded": up, "targets": out}, indent=2))
+    elif args.action == "status":
+        print(json.dumps(_http(f"{args.controller}/v1/upgrade"),
+                         indent=2, sort_keys=True))
+    else:                                          # cancel
+        out = _http(f"{args.controller}/v1/upgrade/"
+                    f"{urllib.parse.quote(args.group, safe='')}",
+                    method="DELETE")
+        print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_agent(args) -> int:
     if args.action == "list":
         vtaps = _http(f"{args.controller}/v1/vtaps")
@@ -391,6 +422,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="list = fleet via controller; the rest query a "
                         "live agent's UDP debug server (--debug-port)")
     a.set_defaults(fn=cmd_agent)
+
+    au = sub.add_parser("agent-upgrade",
+                        help="staged fleet upgrade: push/status/cancel")
+    au.add_argument("action", choices=["push", "status", "cancel"])
+    au.add_argument("--group", default="default")
+    au.add_argument("--package", help="package file to upload (push)")
+    au.add_argument("--revision", help="target revision string (push)")
+    au.set_defaults(fn=cmd_agent_upgrade)
 
     g = sub.add_parser("agent-group-config",
                        help="group config CRUD (yaml or KEY=VALUE)")

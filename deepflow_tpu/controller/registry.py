@@ -66,6 +66,21 @@ class VTapRegistry:
         self._configs: Dict[str, dict] = {"default": dict(DEFAULT_CONFIG)}
         self.config_version = 1
         self._next_id = 1
+        # global process ids (reference: trisolaris GPIDSync /
+        # process_info.go): stable allocation keyed (vtap, pid,
+        # start_time) — a pid reused after process exit gets a FRESH
+        # global id because its start_time differs
+        self._gpids: Dict[str, int] = {}
+        self._next_gpid = 1
+        # staged fleet upgrade (reference: trident.proto rpc Upgrade):
+        # per-group target revision + package checksum; at most
+        # max_concurrent agents hold an in-flight upgrade offer
+        self._upgrades: Dict[str, dict] = {}
+        self._upgrading: Dict[str, float] = {}   # vtap key -> 1st offer
+        self._upgrade_attempts: Dict[str, int] = {}
+        self._upgrade_failed: set = set()        # quarantined vtap keys
+        self.upgrade_max_concurrent = 1
+        self.upgrade_max_attempts = 5
         self._lock = threading.Lock()
         if path is not None and os.path.exists(path):
             self._load()
@@ -77,6 +92,9 @@ class VTapRegistry:
         self._next_id = doc["next_id"]
         self.config_version = doc.get("config_version", 1)
         self._configs = doc.get("configs", self._configs)
+        self._gpids = doc.get("gpids", {})
+        self._next_gpid = doc.get("next_gpid", 1)
+        self._upgrades = doc.get("upgrades", {})
         for v in doc.get("vtaps", []):
             vt = VTap(**v)
             self._vtaps[f"{vt.ctrl_ip}|{vt.host}"] = vt
@@ -88,6 +106,9 @@ class VTapRegistry:
             "next_id": self._next_id,
             "config_version": self.config_version,
             "configs": self._configs,
+            "gpids": self._gpids,
+            "next_gpid": self._next_gpid,
+            "upgrades": self._upgrades,
             "vtaps": [vars(v) for v in self._vtaps.values()],
         }
         tmp = self.path + ".tmp"
@@ -97,9 +118,12 @@ class VTapRegistry:
 
     # -- sync (the agent-facing RPC) ---------------------------------------
     def sync(self, ctrl_ip: str, host: str, revision: str = "",
-             boot: bool = False) -> dict:
+             boot: bool = False,
+             processes: Optional[list] = None) -> dict:
         """Register-or-refresh; returns the Sync response body
-        (reference: trisolaris synchronize service Sync)."""
+        (reference: trisolaris synchronize service Sync; the GPIDSync
+        rpc is folded in via `processes`, and the Upgrade stream's
+        "here is your target package" leg rides the response)."""
         key = f"{ctrl_ip}|{host}"
         with self._lock:
             vt = self._vtaps.get(key)
@@ -114,11 +138,8 @@ class VTapRegistry:
                 vt.boot_count += 1
             cfg = self._configs.get(vt.group,
                                     self._configs["default"])
-            # persist only on membership changes — a heartbeat-only sync
-            # must not rewrite the whole registry file every 60s per agent
-            if registered or boot:
-                self._save_locked()
-            return {
+            dirty = registered or boot
+            resp = {
                 "vtap_id": vt.vtap_id,
                 "group": vt.group,
                 "config": cfg,
@@ -129,6 +150,112 @@ class VTapRegistry:
                 # the round trip is the same)
                 "server_time_ns": time.time_ns(),
             }
+            if processes:
+                resp["gpids"], allocated = self._gpid_sync_locked(
+                    vt.vtap_id, processes)
+                dirty = dirty or allocated
+            upgrade = self._upgrade_offer_locked(key, vt)
+            if upgrade is not None:
+                resp["upgrade"] = upgrade
+            if dirty:
+                self._save_locked()
+            return resp
+
+    # -- GPIDSync ----------------------------------------------------------
+    def _gpid_sync_locked(self, vtap_id: int,
+                          processes: list) -> tuple:
+        """(pid -> gprocess_id mapping, any_new_allocations). Keyed
+        (vtap, pid, start_time): ids are global across the fleet and
+        stable across agent restarts (persisted)."""
+        out: Dict[str, int] = {}
+        allocated = False
+        for p in processes[:4096]:               # bounded: hostile sync
+            try:
+                pid = int(p["pid"])
+                start = int(p.get("start_time", 0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            k = f"{vtap_id}|{pid}|{start}"
+            g = self._gpids.get(k)
+            if g is None:
+                g = self._next_gpid
+                self._next_gpid += 1
+                self._gpids[k] = g
+                allocated = True
+            out[str(pid)] = g
+        return out, allocated
+
+    # -- staged upgrade ----------------------------------------------------
+    def set_upgrade(self, group: str, revision: str, package_name: str,
+                    sha256: str) -> None:
+        """Target a group at a new agent package (reference: ctl agent
+        upgrade + rpc Upgrade). Agents converge one at a time
+        (upgrade_max_concurrent) as they sync. Re-targeting resets the
+        attempt/quarantine bookkeeping — a fresh package deserves fresh
+        tries."""
+        with self._lock:
+            self._upgrades[group] = {"revision": revision,
+                                     "package": package_name,
+                                     "sha256": sha256}
+            self._upgrade_attempts.clear()
+            self._upgrade_failed.clear()
+            self._upgrading.clear()
+            self._save_locked()
+
+    def clear_upgrade(self, group: str) -> bool:
+        with self._lock:
+            had = self._upgrades.pop(group, None) is not None
+            if had:
+                self._save_locked()
+            return had
+
+    def upgrade_status(self) -> dict:
+        with self._lock:
+            per_group: Dict[str, dict] = {}
+            for group, tgt in self._upgrades.items():
+                vts = [v for v in self._vtaps.values() if v.group == group]
+                done = [v.host for v in vts if v.revision == tgt["revision"]]
+                pending = [v.host for v in vts
+                           if v.revision != tgt["revision"]]
+                per_group[group] = {**tgt, "done": done,
+                                    "pending": pending}
+            return {"targets": per_group,
+                    "in_flight": sorted(self._upgrading),
+                    "failed": sorted(self._upgrade_failed)}
+
+    def _upgrade_offer_locked(self, key: str, vt: VTap) -> Optional[dict]:
+        tgt = self._upgrades.get(vt.group)
+        if tgt is None or vt.revision == tgt["revision"]:
+            # converged (or no target): release any bookkeeping
+            self._upgrading.pop(key, None)
+            self._upgrade_attempts.pop(key, None)
+            self._upgrade_failed.discard(key)
+            return None
+        if key in self._upgrade_failed:
+            return None          # quarantined: operator sees it in status
+        now = time.time()
+        # reclaim slots from agents that went quiet mid-upgrade (crash
+        # during restart): a wedged agent must not block the fleet.
+        # First-offer timestamps are NOT refreshed on re-offer, so an
+        # agent that keeps syncing but keeps failing also ages out.
+        stale = [k for k, t in self._upgrading.items() if now - t > 600]
+        for k in stale:
+            del self._upgrading[k]
+        if key not in self._upgrading and \
+                len(self._upgrading) >= self.upgrade_max_concurrent:
+            return None                      # wait: staged, not thundering
+        attempts = self._upgrade_attempts.get(key, 0) + 1
+        self._upgrade_attempts[key] = attempts
+        if attempts > self.upgrade_max_attempts:
+            # an agent that was offered N times and never converged is
+            # broken (bad fetch path, checksum, staging dir): quarantine
+            # it and FREE the slot so one sick agent can't stall the
+            # whole fleet rollout
+            self._upgrade_failed.add(key)
+            self._upgrading.pop(key, None)
+            return None
+        self._upgrading.setdefault(key, now)
+        return dict(tgt)
 
     # -- fleet management --------------------------------------------------
     def list(self) -> List[VTap]:

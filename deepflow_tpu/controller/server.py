@@ -2,9 +2,11 @@
 
 Agent-facing (the trisolaris sync surface, JSON over HTTP instead of
 gRPC — the reference's message/trident.proto Synchronizer service):
-  POST /v1/sync             {ctrl_ip, host, revision?, boot?}
+  POST /v1/sync             {ctrl_ip, host, revision?, boot?,
+                             processes?: [{pid, name, start_time}]}
                             -> vtap_id, config, config_version,
-                               platform_version, ingester
+                               platform_version, ingester,
+                               gpids? (GPIDSync), upgrade? (staged)
   POST /v1/genesis          {ctrl_ip, host, interfaces: [...]}
   GET  /v1/genesis/export   locally-owned genesis domains (peer pull)
 
@@ -22,6 +24,11 @@ Ops-facing (driven by the CLI):
   GET  /v1/election         leader status
   POST /v1/ingesters        {addrs: [...]} membership for rebalancing
   GET  /v1/assignments
+  POST /v1/upgrade-package  {name, data_b64} upload (sha256 returned)
+  GET  /v1/upgrade-package?name=             download
+  POST /v1/upgrade          {group, revision, package} target a group
+  GET  /v1/upgrade          fleet convergence status
+  DELETE /v1/upgrade/<group>
 """
 
 from __future__ import annotations
@@ -31,7 +38,7 @@ import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 
 from deepflow_tpu.controller.cloud import (CloudManager, FileReaderPlatform,
                                            HttpPlatform,
@@ -55,6 +62,7 @@ class ControllerServer:
                  genesis_domain: str = "genesis",
                  genesis_peers=None,
                  cloud_resource_dir: Optional[str] = None,
+                 package_dir: Optional[str] = None,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1") -> None:
         self.model = model
         # filereader domains may only read documents under this directory
@@ -74,6 +82,14 @@ class ControllerServer:
         self.election = election
         self.tagrecorder = tagrecorder
         self.genesis_domain = genesis_domain
+        # upgrade packages: memory cache, optional disk persistence —
+        # the upgrade TARGET persists in the registry file, so the
+        # package must survive a controller restart too or a
+        # mid-rollout restart strands the fleet on 404s
+        self._packages: Dict[str, bytes] = {}
+        self.package_dir = package_dir
+        if package_dir is not None:
+            os.makedirs(package_dir, exist_ok=True)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -176,6 +192,17 @@ class ControllerServer:
                         {"type": r.type, "id": r.id, "name": r.name,
                          "domain": r.domain}
                         for r in self.recorder.deleted_resources()]}
+        if path == "/v1/upgrade":
+            return self.registry.upgrade_status()
+        if path == "/v1/upgrade-package":
+            import base64
+            import hashlib
+            data = self._package_bytes(qs.get("name", ""))
+            if data is None:
+                raise KeyError(qs.get("name", ""))
+            return {"name": qs["name"],
+                    "data_b64": base64.b64encode(data).decode(),
+                    "sha256": hashlib.sha256(data).hexdigest()}
         if path == "/health":
             return {"status": "ok"}
         raise KeyError(path)
@@ -184,11 +211,41 @@ class ControllerServer:
         if path == "/v1/sync":
             resp = self.registry.sync(body["ctrl_ip"], body["host"],
                                       body.get("revision", ""),
-                                      bool(body.get("boot")))
+                                      bool(body.get("boot")),
+                                      processes=body.get("processes"))
             resp["platform_version"] = self.model.version
             resp["ingester"] = self.monitor.assign(body["ctrl_ip"],
                                                    body["host"])
             return resp
+        if path == "/v1/upgrade-package":
+            # package bytes ride base64 inside the JSON control plane
+            # (reference: rpc Upgrade streams chunks; one body here).
+            # Held in memory: packages are transient distribution
+            # artifacts, not durable state.
+            import base64
+            import hashlib
+            name = body["name"]
+            if "/" in name or name.startswith("."):
+                raise ValueError("package name must be a bare filename")
+            data = base64.b64decode(body["data_b64"])
+            self._packages[name] = data
+            if self.package_dir is not None:
+                tmp = os.path.join(self.package_dir, name + ".tmp")
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, os.path.join(self.package_dir, name))
+            return {"name": name, "size": len(data),
+                    "sha256": hashlib.sha256(data).hexdigest()}
+        if path == "/v1/upgrade":
+            import hashlib
+            pkg = body["package"]
+            data = self._package_bytes(pkg)
+            if data is None:
+                raise KeyError(f"unknown package {pkg!r}")
+            self.registry.set_upgrade(
+                body.get("group", "default"), body["revision"], pkg,
+                hashlib.sha256(data).hexdigest())
+            return self.registry.upgrade_status()
         if path == "/v1/genesis":
             # agent-reported interfaces become host resources in a
             # PER-AGENT genesis domain (reference: controller/genesis
@@ -260,7 +317,27 @@ class ControllerServer:
                     "version": self.model.version}
         raise KeyError(path)
 
+    def _package_bytes(self, name: str) -> Optional[bytes]:
+        """Memory first, then the persisted copy (controller restart
+        mid-rollout must not strand the fleet)."""
+        data = self._packages.get(name)
+        if data is None and self.package_dir is not None and name \
+                and "/" not in name and not name.startswith("."):
+            try:
+                with open(os.path.join(self.package_dir, name),
+                          "rb") as f:
+                    data = f.read()
+                self._packages[name] = data
+            except OSError:
+                return None
+        return data
+
     def _delete(self, path: str):
+        if path.startswith("/v1/upgrade/"):
+            group = urllib.parse.unquote(path[len("/v1/upgrade/"):])
+            if not self.registry.clear_upgrade(group):
+                raise KeyError(group)
+            return {"cleared": group}
         if path.startswith("/v1/cloud/domains/"):
             domain = urllib.parse.unquote(path[len("/v1/cloud/domains/"):])
             if not self.cloud.remove(domain):

@@ -1,5 +1,6 @@
 """Agent pipeline: packet decode, flow map, L7 parsers, policy, e2e."""
 
+import os
 import socket
 import struct
 import time
@@ -511,3 +512,111 @@ def test_l7_rate_cap_pushable_and_monotonic():
         assert agent.counters()["l7_throttled"] == 3
     finally:
         agent.close()
+
+
+def test_fleet_upgrade_without_firehose_gap(tmp_path):
+    """Round-4 verdict #6 e2e: push a package for a group of two
+    agents; they converge ONE AT A TIME (staged restart), checksums
+    verified, and the flow firehose never goes dark — rows keep landing
+    across both upgrades."""
+    import hashlib
+
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0, store_path=str(tmp_path)))
+    ing.start()
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    agents = []
+    try:
+        ctl = f"http://127.0.0.1:{srv.port}"
+        import base64
+        import json as _json
+        import urllib.request as _rq
+
+        def post(path, body):
+            req = _rq.Request(f"{ctl}{path}",
+                              data=_json.dumps(body).encode(),
+                              headers={"Content-Type": "application/json"})
+            with _rq.urlopen(req, timeout=5) as r:
+                return _json.load(r)
+
+        for i in range(2):
+            cfg = AgentConfig(ctrl_ip=f"10.0.0.{i+1}", host=f"n{i+1}",
+                              controller_url=ctl,
+                              ingester_addr=f"127.0.0.1:{ing.port}",
+                              revision="v1",
+                              upgrade_dir=str(tmp_path / f"up{i}"))
+            os.makedirs(cfg.upgrade_dir, exist_ok=True)
+            a = Agent(cfg)
+            assert a.sync_once()
+            agents.append(a)
+
+        def feed_and_count():
+            """One tick of traffic from each agent; returns rows sent."""
+            t0 = int(time.time() * 1e9)
+            n = 0
+            for a in agents:
+                frames = [eth_ipv4_tcp(CLIENT, SERVER, 41000, 80, SYN,
+                                       seq=1)]
+                a.feed(frames, np.array([t0], np.uint64))
+                n += a.tick(now_ns=t0 + 10**9)["flows"]
+            return n
+
+        sent_before = feed_and_count()
+        assert sent_before > 0
+
+        pkg = b"new-agent-binary-v2" * 100
+        post("/v1/upgrade-package",
+             {"name": "agent-v2.bin",
+              "data_b64": base64.b64encode(pkg).decode()})
+        post("/v1/upgrade", {"group": "default", "revision": "v2",
+                             "package": "agent-v2.bin"})
+
+        # sync rounds: staged convergence — after ONE round at most one
+        # agent may have upgraded; after a few rounds, both have
+        for a in agents:
+            a.sync_once()
+        upgraded = [a for a in agents if a.cfg.revision == "v2"]
+        assert len(upgraded) <= 1
+        sent_mid = feed_and_count()          # firehose alive mid-fleet
+        assert sent_mid > 0
+        for _ in range(4):
+            for a in agents:
+                a.sync_once()
+        assert all(a.cfg.revision == "v2" for a in agents)
+        assert all(a.upgrades_applied == 1 for a in agents)
+        assert all(a.upgrade_errors == 0 for a in agents)
+        # the staged package landed intact
+        for a in agents:
+            with open(a.staged_package, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == \
+                    hashlib.sha256(pkg).hexdigest()
+        sent_after = feed_and_count()
+        assert sent_after > 0
+        # controller agrees the fleet converged
+        with _rq.urlopen(f"{ctl}/v1/upgrade", timeout=5) as r:
+            status = _json.load(r)
+        assert sorted(status["targets"]["default"]["done"]) == \
+            ["n1", "n2"]
+        # every row sent across the upgrade actually landed (no gap)
+        want = sent_before + sent_mid + sent_after
+        deadline = time.time() + 10
+        table = ing.store.table("flow_log", "l4_flow_log")
+        while time.time() < deadline:
+            ing.flush()
+            if table.row_count() >= want:
+                break
+            time.sleep(0.1)
+        assert table.row_count() >= want
+    finally:
+        for a in agents:
+            a.close()
+        srv.close()
+        ing.close()

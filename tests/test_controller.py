@@ -399,3 +399,145 @@ def test_genesis_failover_domain_not_cleared():
     # old owner no longer exports the domain
     gs.merge({}, peer="http://a")
     assert len(model.list(type="host")) == 1   # first-hand data survives
+
+
+# -- GPIDSync (reference: trident.proto rpc GPIDSync / process_info.go) ----
+def test_gpid_sync_stable_global_allocation(tmp_path):
+    reg = VTapRegistry(str(tmp_path / "vtaps.json"))
+    procs_a = [{"pid": 100, "name": "svc-a", "start_time": 11}]
+    procs_b = [{"pid": 100, "name": "svc-b", "start_time": 22}]
+    r1 = reg.sync("10.0.0.1", "n1", processes=procs_a)
+    r2 = reg.sync("10.0.0.2", "n2", processes=procs_b)
+    # same pid on two vtaps = two DIFFERENT global processes
+    assert r1["gpids"]["100"] != r2["gpids"]["100"]
+    # re-sync: same (vtap, pid, start_time) -> same gpid
+    assert reg.sync("10.0.0.1", "n1",
+                    processes=procs_a)["gpids"] == r1["gpids"]
+    # pid reuse (new start_time) -> FRESH gpid
+    reused = reg.sync("10.0.0.1", "n1", processes=[
+        {"pid": 100, "name": "svc-a2", "start_time": 99}])
+    assert reused["gpids"]["100"] != r1["gpids"]["100"]
+    # allocation survives controller restart
+    reg2 = VTapRegistry(str(tmp_path / "vtaps.json"))
+    assert reg2.sync("10.0.0.1", "n1",
+                     processes=procs_a)["gpids"] == r1["gpids"]
+
+
+def test_gpid_rides_ebpf_wire_records(tmp_path):
+    """The allocated gprocess id stamps the existing gprocess_id_0
+    column on eBPF-sourced l7 records (round-3 verdict: the columns
+    rode the wire unpopulated by any service)."""
+    from deepflow_tpu.decode.columnar import decode_l7_records
+    from tests.test_ebpf_source import _svc_a_conversation, EbpfTracer
+
+    reg = VTapRegistry()
+    tracer = EbpfTracer(vtap_id=1)
+    wires = _svc_a_conversation(tracer)          # pid 10 observed
+    r = reg.sync("10.0.0.1", "n1", processes=tracer.seen_processes())
+    tracer.gpid_map = {int(k): v for k, v in r["gpids"].items()}
+    wires2 = _svc_a_conversation(tracer)         # after gpid push
+    cols = decode_l7_records(wires2)
+    assert (cols["gprocess_id_0"] == r["gpids"]["10"]).all()
+    # pre-push records legitimately carry 0
+    cols0 = decode_l7_records(wires)
+    assert (cols0["gprocess_id_0"] == 0).all()
+
+
+# -- staged upgrade (reference: trident.proto rpc Upgrade) -----------------
+def test_upgrade_staged_one_agent_at_a_time():
+    reg = VTapRegistry()
+    reg.sync("10.0.0.1", "n1", revision="v1")
+    reg.sync("10.0.0.2", "n2", revision="v1")
+    reg.set_upgrade("default", "v2", "pkg.bin", "cafe")
+    r1 = reg.sync("10.0.0.1", "n1", revision="v1")
+    r2 = reg.sync("10.0.0.2", "n2", revision="v1")
+    # exactly one in-flight offer (staged, not thundering herd)
+    assert ("upgrade" in r1) != ("upgrade" in r2)
+    first = "n1" if "upgrade" in r1 else "n2"
+    status = reg.upgrade_status()
+    assert status["targets"]["default"]["pending"] == ["n1", "n2"]
+    # the offered agent converges -> the slot frees for the other
+    ip = "10.0.0.1" if first == "n1" else "10.0.0.2"
+    reg.sync(ip, first, revision="v2")
+    other_ip, other = (("10.0.0.2", "n2") if first == "n1"
+                       else ("10.0.0.1", "n1"))
+    r3 = reg.sync(other_ip, other, revision="v1")
+    assert r3["upgrade"] == {"revision": "v2", "package": "pkg.bin",
+                             "sha256": "cafe"}
+    reg.sync(other_ip, other, revision="v2")
+    status = reg.upgrade_status()
+    assert sorted(status["targets"]["default"]["done"]) == ["n1", "n2"]
+    assert status["targets"]["default"]["pending"] == []
+    # converged agents get no more offers
+    assert "upgrade" not in reg.sync(ip, first, revision="v2")
+    assert reg.clear_upgrade("default") is True
+    assert reg.clear_upgrade("default") is False
+
+
+def test_upgrade_failing_agent_quarantined_not_wedging():
+    """An agent that keeps syncing but never converges (broken fetch/
+    checksum) must not hold the staged slot forever: after
+    upgrade_max_attempts offers it is quarantined (visible in status)
+    and the other agents proceed."""
+    reg = VTapRegistry()
+    reg.sync("10.0.0.1", "sick", revision="v1")
+    reg.sync("10.0.0.2", "ok", revision="v1")
+    reg.set_upgrade("default", "v2", "pkg.bin", "cafe")
+    # the sick agent grabs the slot and keeps failing
+    offers = 0
+    for _ in range(reg.upgrade_max_attempts + 1):
+        r = reg.sync("10.0.0.1", "sick", revision="v1")
+        offers += "upgrade" in r
+        # meanwhile the healthy agent is never offered (slot busy)...
+        if offers <= reg.upgrade_max_attempts and "upgrade" in r:
+            assert "upgrade" not in reg.sync("10.0.0.2", "ok",
+                                             revision="v1")
+    assert offers == reg.upgrade_max_attempts
+    status = reg.upgrade_status()
+    assert status["failed"] == ["10.0.0.1|sick"]
+    # ...but after quarantine the healthy agent converges
+    r = reg.sync("10.0.0.2", "ok", revision="v1")
+    assert r["upgrade"]["revision"] == "v2"
+    reg.sync("10.0.0.2", "ok", revision="v2")
+    assert reg.upgrade_status()["targets"]["default"]["done"] == ["ok"]
+    # re-targeting clears the quarantine for fresh tries
+    reg.set_upgrade("default", "v3", "pkg.bin", "beef")
+    assert "upgrade" in reg.sync("10.0.0.1", "sick", revision="v1")
+
+
+def test_upgrade_package_survives_controller_restart(tmp_path):
+    """The upgrade target persists in the registry file, so the package
+    must survive a controller restart too (package_dir) — otherwise a
+    mid-rollout restart strands the fleet on 404s."""
+    import base64
+    import urllib.request as _rq
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.server import ControllerServer
+
+    pkgdir = str(tmp_path / "pkgs")
+    reg = VTapRegistry(str(tmp_path / "vtaps.json"))
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           package_dir=pkgdir, port=0)
+    srv.start()
+    try:
+        _req(srv.port, "/v1/upgrade-package",
+             {"name": "a.bin",
+              "data_b64": base64.b64encode(b"BINBIN").decode()})
+        _req(srv.port, "/v1/upgrade",
+             {"group": "default", "revision": "v2", "package": "a.bin"})
+    finally:
+        srv.close()
+    # "restart": fresh server + reloaded registry, same dirs
+    reg2 = VTapRegistry(str(tmp_path / "vtaps.json"))
+    srv2 = ControllerServer(ResourceModel(), reg2, FleetMonitor(reg2),
+                            package_dir=pkgdir, port=0)
+    srv2.start()
+    try:
+        got = _req(srv2.port, "/v1/upgrade-package", qs="?name=a.bin")
+        assert base64.b64decode(got["data_b64"]) == b"BINBIN"
+        # the persisted target still offers after restart
+        r = reg2.sync("10.0.0.9", "n9", revision="v1")
+        assert r["upgrade"]["package"] == "a.bin"
+    finally:
+        srv2.close()

@@ -194,3 +194,64 @@ def test_agent_profile_loop_ships_to_ingester(tmp_path):
         if agent is not None:
             agent.close()
         ing.close()
+
+
+_MT_BURNER_C = r"""
+#include <stdint.h>
+#include <stdio.h>
+#include <pthread.h>
+volatile uint64_t sink;
+__attribute__((noinline)) uint64_t burn_cycles(uint64_t n) {
+    uint64_t acc = 1;
+    for (uint64_t i = 0; i < n; i++)
+        acc = acc * 2862933555777941757ULL + 3037000493ULL;
+    return acc;
+}
+static void *worker(void *arg) {
+    for (;;) sink += burn_cycles((1 << 20) + (sink & 1));
+    return 0;
+}
+int main(void) {
+    pthread_t t1, t2;
+    pthread_create(&t1, 0, worker, 0);
+    pthread_create(&t2, 0, worker, 0);
+    fprintf(stderr, "ready\n");
+    /* main thread sleeps: ALL cpu burns on workers — a single-task
+       sampler would see nothing */
+    for (;;) pthread_join(t1, 0);
+    return 0;
+}
+"""
+
+
+def test_sampler_sees_worker_threads(tmp_path):
+    """inherit=1 refuses ring mmap on this kernel class, so the
+    profiler opens one event per task — worker-thread CPU (where real
+    services burn) must be visible even when the main thread sleeps."""
+    d = tmp_path
+    src = d / "mt_burner.c"
+    src.write_text(_MT_BURNER_C)
+    exe = d / "mt_burner"
+    try:
+        subprocess.run(["gcc", "-O1", "-fno-omit-frame-pointer",
+                        "-no-pie", "-pthread", "-o", str(exe), str(src)],
+                       check=True, capture_output=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        pytest.skip("no working C toolchain")
+    p = subprocess.Popen([str(exe)], stderr=subprocess.PIPE)
+    p.stderr.readline()
+    try:
+        time.sleep(0.2)
+        prof = OnCpuProfiler(p.pid, freq_hz=199)
+        try:
+            assert prof.task_count >= 3        # main + 2 workers
+            folded = prof.run(0.8)
+        finally:
+            prof.close()
+        total = sum(folded.values())
+        assert total >= 30
+        hot = sum(v for k, v in folded.items() if "burn_cycles" in k)
+        assert hot / total >= 0.8, folded
+    finally:
+        p.kill()
+        p.wait()
