@@ -376,6 +376,33 @@ class ControllerServer:
             return KubernetesGatherPlatform(
                 self.model, body.get("cluster", body["domain"]),
                 body["domain"])
+        if kind == "aws":
+            # reference domain-config keys (aws.go NewAws): secret_id /
+            # secret_key / region filters; endpoint override for
+            # gov/china partitions or the test recorder
+            from deepflow_tpu.controller.cloud_aws import AwsPlatform
+            if not body.get("secret_id") or not body.get("secret_key"):
+                raise ValueError("aws platform requires secret_id and "
+                                 "secret_key")
+            kw = {}
+            if body.get("endpoint_template"):
+                import re
+                tmpl = body["endpoint_template"]
+                scheme = urllib.parse.urlparse(tmpl).scheme
+                if scheme not in ("http", "https"):
+                    raise ValueError("endpoint_template must be http(s)")
+                # only the literal {region} placeholder: a typo'd or
+                # attribute-access template ({regoin}, {region.__x__})
+                # must 400 here, not fail on every later gather
+                if not re.fullmatch(r"[^{}]*(\{region\}[^{}]*)+", tmpl):
+                    raise ValueError("endpoint_template must contain "
+                                     "{region} and no other braces")
+                kw["endpoint_template"] = tmpl
+            return AwsPlatform(
+                body["domain"], body["secret_id"], body["secret_key"],
+                regions=tuple(body.get("regions", ())),
+                api_default_region=body.get("api_default_region",
+                                            "us-east-1"), **kw)
         raise ValueError(f"unknown platform kind {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------

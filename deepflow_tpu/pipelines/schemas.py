@@ -14,6 +14,9 @@ import numpy as np
 
 from deepflow_tpu.batch.schema import L4_SCHEMA, L7_SCHEMA, METRIC_SCHEMA
 from deepflow_tpu.enrich.platform_data import KG_DERIVED_FIELDS, KG_FIELDS
+from deepflow_tpu.pipelines.tag_code import (VTAP_FLOW_EDGE_PORT,
+                                             VTAP_FLOW_PORT,
+                                             make_metrics_table)
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
 
 _U32 = np.dtype(np.uint32)
@@ -124,44 +127,34 @@ L4_PACKET_TABLE = TableSchema(
     ttl_seconds=3 * 24 * 3600,
 )
 
-_METRIC_KEYS = {"timestamp", "tag_code", "ip", "server_port", "vtap_id", "protocol",
-                "l3_epc_id", "direction", "tap_side", "tap_type",
-                "tap_port", "l7_protocol", "gprocess_id", "signal_source",
-                "pod_id", "app_service_hash", "endpoint_hash"}
-_METRIC_AGG = {
-    # every meter counter sums across rollup windows except the *_max
-    # latency quantiles (zerodoc ConcurrentMerge: sums + maxes)
-    name: (AggKind.MAX if name.endswith("_max") else AggKind.SUM)
-    for name in (
-        "packet_tx", "packet_rx", "byte_tx", "byte_rx",
-        "l3_byte_tx", "l3_byte_rx", "l4_byte_tx", "l4_byte_rx",
-        "new_flow", "closed_flow", "l7_request", "l7_response",
-        "syn", "synack",
-        "rtt_sum", "rtt_count", "rtt_max",
-        "rtt_client_sum", "rtt_client_count",
-        "rtt_server_sum", "rtt_server_count",
-        "srt_sum", "srt_count", "srt_max",
-        "art_sum", "art_count", "art_max",
-        "rrt_sum", "rrt_count", "rrt_max",
-        "cit_sum", "cit_count", "cit_max",
-        "retrans_tx", "retrans_rx", "zero_win_tx", "zero_win_rx",
-        "retrans_syn", "retrans_synack",
-        "client_rst_flow", "server_rst_flow",
-        "client_syn_repeat", "server_synack_repeat",
-        "client_half_close_flow", "server_half_close_flow",
-        "tcp_timeout", "l7_client_error", "l7_server_error", "l7_timeout",
-    )
-}
-
 # reference table name: flow_metrics."vtap_flow_port.1s"
 # version 2: + tag_code (zerodoc Code bitmask as grouping identity)
-METRICS_TABLE = TableSchema(
-    name="vtap_flow_port",
-    columns=_lift(METRIC_SCHEMA, _METRIC_KEYS, _METRIC_AGG),
-    time_column="timestamp",
-    ttl_seconds=3 * 24 * 3600,
-    version=2,
-)
+#
+# GENERATED from the tag-Code bitmask model (pipelines/tag_code.py —
+# the reference's zerodoc Code -> table generation): the code names the
+# dimensions, make_metrics_table expands them + the shared FlowMeter.
+# tests/test_tag_code.py pins this to the pre-generator hand-listed
+# column set exactly (names, dtypes, agg kinds).
+METRICS_TABLE = make_metrics_table("vtap_flow_port", VTAP_FLOW_PORT,
+                                   version=2)
+
+# dtype lockstep with the decode side: the wire schema (METRIC_SCHEMA,
+# what decode_metric_records produces) and the generated store table
+# must agree per column, or Table.append's astype would silently
+# truncate a widened counter on write. Checked at import: a divergence
+# fails every test and every server start, loudly.
+for _c in METRICS_TABLE.columns:
+    _wire_dt = dict(METRIC_SCHEMA.columns).get(_c.name)
+    assert _wire_dt is None or np.dtype(_wire_dt) == _c.dtype, (
+        f"vtap_flow_port.{_c.name}: store dtype {_c.dtype} != wire "
+        f"dtype {np.dtype(_wire_dt)} (METRIC_SCHEMA)")
+
+# the edge-tag (client->server path) table schema: one line, as the
+# tag-code model promises. A generator demonstration for now — the
+# decode/routing that would feed it edge-coded Documents is not wired;
+# tests/test_tag_code.py drives it through store+rollup directly.
+EDGE_METRICS_TABLE = make_metrics_table("vtap_flow_edge_port",
+                                        VTAP_FLOW_EDGE_PORT)
 
 
 def register_standard_migrations(issu) -> None:

@@ -1,0 +1,295 @@
+"""AWS cloud client: SigV4 against the official AWS test vector, and a
+fixture recorder that VERIFIES signatures, serves real-shaped EC2 XML
+with nextToken pagination, and fans out per region (reference:
+server/controller/cloud/aws/)."""
+
+import datetime
+import threading
+import urllib.error
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepflow_tpu.controller.cloud_aws import (AwsPlatform,
+                                               sigv4_headers,
+                                               sigv4_signature)
+
+ACCESS, SECRET = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+def test_sigv4_official_aws_test_vector():
+    """The 'get-vanilla' case from AWS's published SigV4 test suite:
+    known keys + fixed date must reproduce AWS's expected signature
+    exactly — the signing math is checked against the vendor, not
+    against itself."""
+    now = datetime.datetime(2015, 8, 30, 12, 36, 0,
+                            tzinfo=datetime.timezone.utc)
+    h = sigv4_headers("GET", "https://example.amazonaws.com/", b"",
+                      ACCESS, SECRET, "us-east-1", service="service",
+                      now=now)
+    assert h["x-amz-date"] == "20150830T123600Z"
+    assert h["Authorization"] == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/service/aws4_request, "
+        "SignedHeaders=host;x-amz-date, "
+        "Signature=5fa00fa31553b73ebf1942676e86291e8372ff2a2260"
+        "956d9b8aae1d763fbf31")
+
+
+# -- fixture recorder ------------------------------------------------------
+_REGIONS_XML = """<DescribeRegionsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <regionInfo>
+    <item><regionName>us-east-1</regionName></item>
+    <item><regionName>eu-west-1</regionName></item>
+    <item><regionName>ap-south-1</regionName></item>
+  </regionInfo>
+</DescribeRegionsResponse>"""
+
+_AZS_XML = """<DescribeAvailabilityZonesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <availabilityZoneInfo>
+    <item><zoneName>{r}a</zoneName><regionName>{r}</regionName></item>
+    <item><zoneName>{r}b</zoneName><regionName>{r}</regionName></item>
+  </availabilityZoneInfo>
+</DescribeAvailabilityZonesResponse>"""
+
+_VPCS_XML = """<DescribeVpcsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <vpcSet>
+    <item><vpcId>vpc-{r}1</vpcId><cidrBlock>10.1.0.0/16</cidrBlock>
+      <tagSet><item><key>Name</key><value>prod-{r}</value></item></tagSet>
+    </item>
+  </vpcSet>
+</DescribeVpcsResponse>"""
+
+_SUBNETS_XML = """<DescribeSubnetsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <subnetSet>
+    <item><subnetId>subnet-{r}1</subnetId><vpcId>vpc-{r}1</vpcId>
+      <cidrBlock>10.1.1.0/24</cidrBlock>
+      <availabilityZone>{r}a</availabilityZone></item>
+  </subnetSet>
+</DescribeSubnetsResponse>"""
+
+_INSTANCES_PAGE1 = """<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <reservationSet>
+    <item><instancesSet>
+      <item><instanceId>i-{r}a</instanceId>
+        <privateIpAddress>10.1.1.10</privateIpAddress>
+        <vpcId>vpc-{r}1</vpcId><subnetId>subnet-{r}1</subnetId>
+        <placement><availabilityZone>{r}a</availabilityZone></placement>
+        <tagSet><item><key>Name</key><value>web-{r}</value></item></tagSet>
+      </item>
+    </instancesSet></item>
+  </reservationSet>
+  <nextToken>PAGE2TOKEN</nextToken>
+</DescribeInstancesResponse>"""
+
+_INSTANCES_PAGE2 = """<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <reservationSet>
+    <item><instancesSet>
+      <item><instanceId>i-{r}b</instanceId>
+        <privateIpAddress>10.1.1.11</privateIpAddress>
+        <vpcId>vpc-{r}1</vpcId><subnetId>subnet-{r}1</subnetId>
+        <placement><availabilityZone>{r}b</availabilityZone></placement>
+      </item>
+    </instancesSet></item>
+  </reservationSet>
+</DescribeInstancesResponse>"""
+
+
+class _Recorder(ThreadingHTTPServer):
+    """Replays EC2 fixtures; 403s any request whose SigV4 signature
+    does not verify against the known secret — proving the client's
+    signing end to end, not just its own self-consistency."""
+
+    def __init__(self):
+        self.calls = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                if not outer._verify(self, body):
+                    self.send_response(403)
+                    self.end_headers()
+                    return
+                region = self.path.strip("/")
+                form = dict(urllib.parse.parse_qsl(body.decode()))
+                outer.calls.append((region, form.get("Action"),
+                                    form.get("NextToken")))
+                xml = outer._respond(region, form)
+                data = xml.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/xml")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        super().__init__(("127.0.0.1", 0), H)
+
+    def _verify(self, handler, body: bytes) -> bool:
+        auth = handler.headers.get("Authorization", "")
+        amz_date = handler.headers.get("x-amz-date", "")
+        if not auth or not amz_date:
+            return False
+        now = datetime.datetime.strptime(
+            amz_date, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=datetime.timezone.utc)
+        region = handler.path.strip("/") or "us-east-1"
+        url = (f"http://{handler.headers['Host']}{handler.path}")
+        want = sigv4_headers(
+            "POST", url, body, ACCESS, SECRET, region, now=now,
+            extra_headers={"content-type":
+                           "application/x-www-form-urlencoded"})
+        return want["Authorization"] == auth
+
+    def _respond(self, region: str, form: dict) -> str:
+        a = form["Action"]
+        if a == "DescribeRegions":
+            return _REGIONS_XML
+        if a == "DescribeAvailabilityZones":
+            return _AZS_XML.format(r=region)
+        if a == "DescribeVpcs":
+            return _VPCS_XML.format(r=region)
+        if a == "DescribeSubnets":
+            return _SUBNETS_XML.format(r=region)
+        if a == "DescribeInstances":
+            if form.get("NextToken") == "PAGE2TOKEN":
+                return _INSTANCES_PAGE2.format(r=region)
+            return _INSTANCES_PAGE1.format(r=region)
+        raise AssertionError(f"unexpected action {a}")
+
+
+@pytest.fixture
+def recorder():
+    srv = _Recorder()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _platform(srv, **kw):
+    return AwsPlatform(
+        "aws-dom", ACCESS, SECRET,
+        endpoint_template=(
+            f"http://127.0.0.1:{srv.server_address[1]}/{{region}}"),
+        **kw)
+
+
+def test_gather_normalizes_regions_vpcs_subnets_hosts(recorder):
+    p = _platform(recorder, regions=("us-east-1", "eu-west-1"))
+    p.check_auth()
+    rows = p.get_cloud_data()
+    by = {}
+    for r in rows:
+        by.setdefault(r.type, []).append(r)
+    assert [r.name for r in by["region"]] == ["us-east-1", "eu-west-1"]
+    assert len(by["az"]) == 4
+    assert sorted(r.name for r in by["vpc"]) == ["prod-eu-west-1",
+                                                 "prod-us-east-1"]
+    # pagination: BOTH instance pages landed, per region
+    assert sorted(r.name for r in by["host"]) == [
+        "i-eu-west-1b", "i-us-east-1b", "web-eu-west-1", "web-us-east-1"]
+    # epc (vpc) links resolve to the allocated vpc row ids
+    vpc_ids = {r.name: r.id for r in by["vpc"]}
+    host_attrs = {r.name: dict(r.attrs) for r in by["host"]}
+    assert host_attrs["web-us-east-1"]["epc_id"] == \
+        vpc_ids["prod-us-east-1"]
+    assert host_attrs["web-us-east-1"]["ip"] == "10.1.1.10"
+    subnet_attrs = {r.name: dict(r.attrs) for r in by["subnet"]}
+    assert subnet_attrs["subnet-us-east-11"]["epc_id"] == \
+        vpc_ids["prod-us-east-1"]
+    # region fan-out actually happened (distinct endpoints by path)
+    regions_hit = {c[0] for c in recorder.calls}
+    assert regions_hit == {"us-east-1", "eu-west-1"}
+    # DescribeInstances paged exactly once per region
+    tokens = [c for c in recorder.calls
+              if c[1] == "DescribeInstances" and c[2] == "PAGE2TOKEN"]
+    assert len(tokens) == 2
+
+
+def test_bad_secret_fails_auth(recorder):
+    p = AwsPlatform(
+        "aws-dom", ACCESS, "WRONG-SECRET",
+        endpoint_template=(
+            f"http://127.0.0.1:{recorder.server_address[1]}/{{region}}"))
+    with pytest.raises(urllib.error.HTTPError):
+        p.check_auth()
+
+
+def test_controller_drives_aws_domain(recorder, tmp_path):
+    """The ops API wires an aws domain end to end: platform construct,
+    gather, recorder reconcile, rows visible in /v1/resources."""
+    import json
+    import urllib.request
+
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.load(r)
+
+        post("/v1/cloud/domains", {
+            "domain": "aws-prod", "platform": "aws",
+            "secret_id": ACCESS, "secret_key": SECRET,
+            "regions": ["us-east-1"],
+            "endpoint_template":
+                f"http://127.0.0.1:{recorder.server_address[1]}"
+                "/{region}"})
+        out = post("/v1/domains/aws-prod/refresh", {})
+        assert out["ok"] is True
+        assert out["resource_count"] >= 6
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/resources?type=host",
+                timeout=5) as r:
+            hosts = json.load(r)
+        names = {h["name"] for h in hosts}
+        assert {"web-us-east-1", "i-us-east-1b"} <= names
+    finally:
+        srv.close()
+
+
+def test_bad_endpoint_template_rejected_at_config_time():
+    import json
+    import urllib.request
+
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    try:
+        for bad in ("https://x/{regoin}/", "https://x{", "file:///e/{region}"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/cloud/domains",
+                data=json.dumps({
+                    "domain": "d", "platform": "aws",
+                    "secret_id": "a", "secret_key": "b",
+                    "endpoint_template": bad}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert e.value.code == 400
+    finally:
+        srv.close()

@@ -1,0 +1,105 @@
+"""tag-Code bitmask -> generated metric schemas (reference:
+server/libs/zerodoc/tag.go:36-104)."""
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.pipelines.schemas import (EDGE_METRICS_TABLE,
+                                            METRICS_TABLE)
+from deepflow_tpu.pipelines.tag_code import (EDGE_MASK, FLOW_METER,
+                                             VTAP_FLOW_EDGE_PORT,
+                                             VTAP_FLOW_PORT, Code,
+                                             has_edge_tag,
+                                             make_metrics_table,
+                                             tag_columns)
+from deepflow_tpu.store.table import AggKind
+
+
+def test_bit_positions_mirror_tag_go():
+    """The modeled subset sits at tag.go's exact bit positions: edge
+    variants are the single-ended bit << 20, globals in the 1<<40
+    block."""
+    assert Code.IP == 1 and Code.L3_EPC_ID == 2
+    assert Code.GPID == 1 << 15
+    assert Code.IP_PATH == Code.IP << 20
+    assert Code.GPID_PATH == Code.GPID << 20
+    assert Code.DIRECTION == 1 << 40
+    assert Code.VTAP_ID == 1 << 47
+    assert has_edge_tag(Code.IP_PATH)
+    assert not has_edge_tag(Code.IP | Code.VTAP_ID)
+    assert EDGE_MASK == 0xFFFFF00000        # tag.go HasEdgeTagField
+
+
+def test_generated_vtap_flow_port_matches_handwritten_set():
+    """Pin: the generator reproduces the pre-generator hand-listed
+    column set of vtap_flow_port exactly (names, dtypes, agg kinds) —
+    swapping the definition changed nothing for stored data."""
+    want = {
+        ("timestamp", "uint32", AggKind.KEY),
+        ("tag_code", "uint64", AggKind.KEY),
+        ("ip", "uint32", AggKind.KEY),
+        ("l3_epc_id", "int32", AggKind.KEY),
+        ("pod_id", "uint32", AggKind.KEY),
+        ("gprocess_id", "uint32", AggKind.KEY),
+        ("direction", "uint32", AggKind.KEY),
+        ("protocol", "uint32", AggKind.KEY),
+        ("server_port", "uint32", AggKind.KEY),
+        ("tap_type", "uint32", AggKind.KEY),
+        ("vtap_id", "uint32", AggKind.KEY),
+        ("tap_side", "uint32", AggKind.KEY),
+        ("tap_port", "uint32", AggKind.KEY),
+        ("l7_protocol", "uint32", AggKind.KEY),
+        ("signal_source", "uint32", AggKind.KEY),
+        ("app_service_hash", "uint32", AggKind.KEY),
+        ("endpoint_hash", "uint32", AggKind.KEY),
+    } | {(name, "uint32",
+          AggKind.MAX if name.endswith("_max") else AggKind.SUM)
+         for name in FLOW_METER}
+    got = {(c.name, str(c.dtype), c.agg) for c in METRICS_TABLE.columns}
+    assert got == want
+    assert METRICS_TABLE.version == 2
+
+
+def test_edge_table_expands_path_bits_to_side_pairs():
+    cols = {c.name for c in EDGE_METRICS_TABLE.columns}
+    assert {"ip_0", "ip_1", "l3_epc_id_0", "l3_epc_id_1",
+            "pod_id_0", "pod_id_1", "gprocess_id_0",
+            "gprocess_id_1"} <= cols
+    assert "ip" not in cols                # edge code: no single-ended ip
+    assert {"server_port", "protocol", "vtap_id"} <= cols
+    assert has_edge_tag(VTAP_FLOW_EDGE_PORT)
+    assert not has_edge_tag(VTAP_FLOW_PORT)
+
+
+def test_unmodeled_bit_is_loud():
+    with pytest.raises(ValueError):
+        tag_columns(Code(1 << 2))          # L3Device: not modeled
+
+
+def test_one_line_table_drives_store_and_rollup(tmp_path):
+    """The acceptance bar: a NEW edge-tag table is one make_metrics_table
+    call, and the whole store machinery (append, scan, 1m rollup with
+    sum/max merge over the generated keys) runs on it unchanged."""
+    from deepflow_tpu.store import Store
+    from deepflow_tpu.store.rollup import RollupManager
+
+    table = make_metrics_table(
+        "edge_demo", Code.IP_PATH | Code.SERVER_PORT | Code.VTAP_ID)
+    store = Store(str(tmp_path))
+    rollups = RollupManager(store, "flow_metrics", table,
+                            intervals=(60,))
+    n = 120
+    cols = {c.name: np.zeros(n, c.dtype) for c in table.columns}
+    cols["timestamp"][:] = np.arange(n) + 60      # two 1m buckets
+    cols["ip_0"][:] = 0x0A000001
+    cols["ip_1"][:] = 0x0A000002
+    cols["server_port"][:] = 443
+    cols["byte_tx"][:] = 10
+    cols["rtt_max"][:] = np.arange(n)
+    rollups.base.append(cols)
+    rollups.advance(now=10_000)
+    out = store.table("flow_metrics", "edge_demo.1m").scan()
+    assert len(out["timestamp"]) == 2              # one row per bucket
+    assert out["byte_tx"].sum() == 10 * n          # SUM merged
+    assert set(out["ip_0"]) == {0x0A000001}        # keys preserved
+    assert out["rtt_max"].max() == n - 1           # MAX merged
