@@ -233,7 +233,7 @@ def main() -> None:
         if tunneled:
             time.sleep(16)
 
-    def timed_loop(step_fn, payloads):
+    def timed_run(run_fn):
         """EVERY window closes on a 4-byte result fetch: on this
         runtime block_until_ready can ack before device execution
         drains — run 3 on 2026-07-31 recorded a 95.9M rec/s lane rate
@@ -241,10 +241,12 @@ def main() -> None:
         this, so 'the e2e loops are gated by their synchronous H2D' is
         NOT a safe assumption. The fetch's own round trip is measured
         on the drained warmup state and subtracted; the slow mode it
-        triggers is slept out before the timed iterations start."""
+        triggers is slept out before the timed iterations start.
+        `run_fn(state, n_iters) -> state` supplies the loop body — ONE
+        timing harness for the per-payload loops and the pipelined
+        protobuf feed, so a harness fix can never miss a copy."""
         state = flow_suite.init(cfg)
-        for i in range(warmup):
-            state = step_fn(state, payloads[i % n_batches], i)
+        state = run_fn(state, warmup)
         int(state.batches_seen)       # drain warmup + earlier backlog
         # fetch RTT on a FRESH (uncached) tiny result: re-reading
         # batches_seen would hit jax.Array's materialized host cache
@@ -254,12 +256,18 @@ def main() -> None:
         fetch_s = time.perf_counter() - t0
         _recover()                    # the drain fetches degraded h2d
         t0 = time.perf_counter()
-        for i in range(iters):
-            state = step_fn(state, payloads[i % n_batches], i)
+        state = run_fn(state, iters)
         int(state.batches_seen)
         dt = max(time.perf_counter() - t0 - fetch_s, 1e-9)
         _recover()                    # don't poison the NEXT loop
         return batch * iters / dt
+
+    def timed_loop(step_fn, payloads):
+        def run(state, n_iters):
+            for i in range(n_iters):
+                state = step_fn(state, payloads[i % n_batches], i)
+            return state
+        return timed_run(run)
 
     # -- timed: e2e packed-lane wire -> sketch (the headline) --------------
     step_packed = jax.jit(
@@ -325,8 +333,10 @@ def main() -> None:
         sketch_names = set(SKETCH_L4_SCHEMA.names)
         sketch_idx = [(j, name, dt) for j, (name, dt)
                       in enumerate(native.L4_COLS32) if name in sketch_names]
-        bufs = [(np.empty((n32, batch), np.uint32),
-                 np.empty((n64, batch), np.uint64)) for _ in range(2)]
+        # scratch pair for the thread-scaling sweep (the e2e loop's
+        # buffers live inside PipelinedDecoder's ring)
+        buf32 = np.empty((n32, batch), np.uint32)
+        buf64 = np.empty((n64, batch), np.uint64)
 
         try:   # affinity-aware: cpu_count() overcounts in pinned cgroups
             n_aff = len(os.sched_getaffinity(0))
@@ -340,7 +350,6 @@ def main() -> None:
         # sensitivity, its own budget.
         _phase("pb decode thread-scaling sweep", budget=3600.0)
         cands = sorted({min(1 << i, n_aff) for i in range(6)})
-        buf32, buf64 = bufs[0]
         for t in cands:
             native.decode_l4_into(pb_payloads[0], buf32, buf64,
                                   n_threads=t)          # warm/compile-free
@@ -355,23 +364,36 @@ def main() -> None:
         decode_threads = int(max(pb_decode_scaling,
                                  key=lambda k: pb_decode_scaling[k]))
 
-        def pb_step(state, payload, i):
-            buf32, buf64 = bufs[i % 2]
-            rows, bad, _ = native.decode_l4_into(payload, buf32, buf64,
-                                                 n_threads=decode_threads)
+        def _consume(state, rows, buf32):
             cols = {}
             for j, name, dt in sketch_idx:
                 col = buf32[j, :rows]
-                cols[name] = col.view(np.int32) \
-                    if np.dtype(dt) == np.int32 else col
+                # the yielded ring buffer is valid for exactly ONE
+                # iteration (the feeder may overwrite it the moment the
+                # next item is fetched) and pack_lanes views its ip
+                # columns (copy=False) — these copies are what makes
+                # consuming it safe
+                cols[name] = (col.view(np.int32).copy()
+                              if np.dtype(dt) == np.int32 else col.copy())
             # pack on host: 16B/record over the link instead of 68B
             lanes = flow_suite.pack_lanes(cols)
             return step_packed(
                 state, {k: jnp.asarray(v) for k, v in lanes.items()},
                 mask_d)
 
-        _phase("timed: protobuf e2e")
-        pb_rate = timed_loop(pb_step, pb_payloads)
+        def pb_run(state, n_iters, dec):
+            seq = (pb_payloads[i % n_batches] for i in range(n_iters))
+            for rows, b32, b64 in dec.stream(seq):
+                state = _consume(state, rows, b32)
+            return state
+
+        # decode OVERLAPS transfer+dispatch (native.PipelinedDecoder):
+        # the serial loop paid them back-to-back and round 3 measured
+        # 1.46M rec/s against a 2.8M single-core decode ceiling
+        _phase("timed: protobuf e2e (pipelined decode)")
+        dec = native.PipelinedDecoder(capacity=batch,
+                                      n_threads=decode_threads)
+        pb_rate = timed_run(lambda state, n: pb_run(state, n, dec))
 
     lane_window()                             # window 1: mid-bench link
 

@@ -204,3 +204,90 @@ def decode_l4_records(records: Iterable[bytes]) -> Dict[str, np.ndarray]:
 
     cols, _ = decode_l4_payload(pack_pb_records(records))
     return cols
+
+
+class PipelinedDecoder:
+    """Overlap protobuf decode with the consumer's device work.
+
+    The serial compat-path loop pays decode + transfer + dispatch
+    back-to-back; since decode_l4_into releases the GIL inside the C++
+    walker and the transfer is mostly socket/DMA wait, running decode
+    on a feeder thread overlaps the two and lifts the protobuf e2e
+    toward the pure-decode ceiling (the reference's decoder goroutine
+    pool in front of ckwriter plays the same role).
+
+    Buffer discipline: a ring of >=3 (buf32, buf64) pairs cycles
+    free -> decoded -> consumed; the consumer RETURNS each slot via
+    done() (or just lets `for` advance: the previous slot auto-returns)
+    so a decoded buffer is never overwritten while the device still
+    reads from it.
+    """
+
+    def __init__(self, capacity: int, n_bufs: int = 3,
+                 n_threads: int = 1) -> None:
+        import queue as _q
+        import threading as _t
+        if n_bufs < 2:
+            raise ValueError("need >=2 buffers to overlap")
+        n32, n64 = len(L4_COLS32), len(L4_COLS64)
+        self._bufs = [(np.empty((n32, capacity), np.uint32),
+                       np.empty((n64, capacity), np.uint64))
+                      for _ in range(n_bufs)]
+        self.n_threads = n_threads
+        self._q = _q
+        self._threading = _t
+
+    def stream(self, payloads):
+        """Yield (rows, buf32, buf64) per payload, decode running one
+        (or more) payloads ahead on the feeder thread. A yielded buffer
+        is valid for EXACTLY ONE iteration step — fetching the next
+        item frees it for the feeder to overwrite. One stream at a
+        time per decoder (the buffer ring is shared); the queues are
+        per-call and an early consumer break stops the feeder, so an
+        aborted or failed stream never poisons the next one."""
+        free: "self._q.Queue[int]" = self._q.Queue()
+        for i in range(len(self._bufs)):
+            free.put(i)
+        ready: "self._q.Queue" = self._q.Queue()
+        stop = self._threading.Event()
+
+        def feeder():
+            try:
+                for p in payloads:
+                    while True:              # stoppable slot wait
+                        if stop.is_set():
+                            return
+                        try:
+                            i = free.get(timeout=0.1)
+                            break
+                        except self._q.Empty:
+                            continue
+                    b32, b64 = self._bufs[i]
+                    rows, _bad, _ = decode_l4_into(
+                        p, b32, b64, n_threads=self.n_threads)
+                    ready.put((i, rows))
+            except BaseException as e:      # surfaced on the consumer
+                ready.put(e)
+            finally:
+                ready.put(None)
+
+        t = self._threading.Thread(target=feeder, name="pb-decode",
+                                   daemon=True)
+        t.start()
+        held = None
+        try:
+            while True:
+                got = ready.get()
+                if got is None:
+                    break
+                if isinstance(got, BaseException):
+                    raise got
+                i, rows = got
+                if held is not None:
+                    free.put(held)          # previous slot now reusable
+                held = i
+                b32, b64 = self._bufs[i]
+                yield rows, b32, b64
+        finally:
+            stop.set()                      # unblock an early-break feeder
+            t.join(timeout=5)

@@ -194,3 +194,70 @@ def test_fuzz_hostile_payloads_never_crash():
             # except byte-corrupted ones that remain valid protobuf
             # with unknown fields — so only assert bounds
             assert rows <= 64
+
+
+def test_pipelined_decoder_matches_serial():
+    """PipelinedDecoder (feeder-thread overlap) yields byte-identical
+    column data to serial decode_l4_into across a payload stream long
+    enough to cycle every ring slot several times, and consuming slowly
+    never lets the feeder overwrite a buffer still held."""
+    agent = SyntheticAgent()
+    base = agent.l4_columns(512)
+    recs = [agent.l4_record(base, i) for i in range(512)]
+    payloads = [pack_pb_records(recs[i::8]) for i in range(8)] * 3
+    n32, n64 = len(native.L4_COLS32), len(native.L4_COLS64)
+    want = []
+    b32 = np.empty((n32, 64), np.uint32)
+    b64 = np.empty((n64, 64), np.uint64)
+    for p in payloads:
+        rows, bad, _ = native.decode_l4_into(p, b32, b64)
+        assert bad == 0
+        want.append((rows, b32[:, :rows].copy(), b64[:, :rows].copy()))
+
+    dec = native.PipelinedDecoder(capacity=64, n_bufs=3)
+    got_n = 0
+    import time as _t
+    for (rows, g32, g64), (wr, w32, w64) in zip(
+            dec.stream(iter(payloads)), want):
+        _t.sleep(0.002)      # slow consumer: feeder runs ahead, must
+        assert rows == wr    # still respect the ring discipline
+        np.testing.assert_array_equal(g32[:, :rows], w32)
+        np.testing.assert_array_equal(g64[:, :rows], w64)
+        got_n += 1
+    assert got_n == len(payloads)
+
+
+def test_pipelined_decoder_propagates_feeder_errors():
+    dec = native.PipelinedDecoder(capacity=64)
+
+    def gen():
+        yield b"\x00\x01ok-this-will-decode-to-nothing"
+        raise RuntimeError("payload source exploded")
+
+    with pytest.raises(RuntimeError, match="payload source exploded"):
+        for _ in dec.stream(gen()):
+            pass
+
+
+def test_pipelined_decoder_reusable_after_abort_and_error():
+    """An early consumer break or a feeder error must not poison the
+    NEXT stream on the same decoder (per-call queues + stop flag)."""
+    agent = SyntheticAgent()
+    base = agent.l4_columns(128)
+    recs = [agent.l4_record(base, i) for i in range(128)]
+    payloads = [pack_pb_records(recs[i::4]) for i in range(4)]
+    dec = native.PipelinedDecoder(capacity=128, n_bufs=2)
+    # 1) abort mid-stream
+    for n, _ in enumerate(dec.stream(iter(payloads))):
+        if n == 1:
+            break
+    # 2) feeder error mid-stream
+    def gen():
+        yield payloads[0]
+        raise RuntimeError("boom")
+    with pytest.raises(RuntimeError):
+        for _ in dec.stream(gen()):
+            pass
+    # 3) a fresh stream still yields every payload with correct counts
+    got = [rows for rows, _, _ in dec.stream(iter(payloads))]
+    assert got == [32, 32, 32, 32]
