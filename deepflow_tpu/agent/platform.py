@@ -63,6 +63,58 @@ def local_interfaces() -> List[dict]:
     return out
 
 
+def libvirt_lister(xml_dir: str = "/etc/libvirt/qemu"
+                   ) -> Callable[[], List[dict]]:
+    """Follow a libvirt qemu domain-XML directory and report each VM's
+    virtual interfaces (reference:
+    agent/src/platform/libvirt_xml_extractor.rs — on KVM hosts the
+    agent learns guest NICs from the domain definitions, no guest agent
+    needed). Per interface: the target dev name, mac, and the owning
+    domain's name/uuid. Files that fail to parse are skipped (a
+    half-written definition mid-virsh-edit must not drop the report);
+    interfaces without a mac are skipped like the reference's."""
+    import xml.etree.ElementTree as ET
+
+    def lister() -> List[dict]:
+        out: List[dict] = []
+        try:
+            names = sorted(os.listdir(xml_dir))
+        except OSError:
+            return out
+        for fn in names:
+            if not fn.endswith(".xml"):
+                continue
+            try:
+                root = ET.parse(os.path.join(xml_dir, fn)).getroot()
+            except (ET.ParseError, OSError):
+                continue
+            domain_name = root.findtext("name") or ""
+            domain_uuid = root.findtext("uuid") or ""
+            if not domain_name or not domain_uuid:
+                continue
+            for itf in root.findall("devices/interface"):
+                mac_el = itf.find("mac")
+                tgt_el = itf.find("target")
+                mac = (mac_el.get("address", "")
+                       if mac_el is not None else "")
+                dev = (tgt_el.get("dev", "")
+                       if tgt_el is not None else "")
+                if not mac:
+                    continue
+                # PERSISTENT domain XML strips auto-generated vnetX
+                # <target dev> names on save — only runtime XML keeps
+                # them. The mac is the durable key (the reference keys
+                # on it too); a mac-derived name keeps the row usable
+                # when dev is absent.
+                if not dev:
+                    dev = "tap-" + mac.replace(":", "")[-6:]
+                out.append({"name": dev, "mac": mac,
+                            "domain_name": domain_name,
+                            "domain_uuid": domain_uuid})
+        return out
+    return lister
+
+
 def file_lister(path: str) -> Callable[[], List[dict]]:
     """Follow a JSON file holding a resource list (kubectl-export style);
     missing/invalid file reads as empty, not fatal."""

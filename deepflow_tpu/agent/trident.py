@@ -59,6 +59,9 @@ class AgentConfig:
     # over the file lister when set
     k8s_apiserver_url: Optional[str] = None
     k8s_apiserver_token: Optional[str] = None
+    # KVM host: libvirt qemu domain-XML directory to extract guest
+    # NICs from (reference: libvirt_xml_extractor.rs); None = off
+    libvirt_xml_dir: Optional[str] = None
     # shared-object L7 plugins (agent/plugin.py): .so paths loaded at
     # startup and hot-loadable via pushed config (reference: rpc Plugin)
     so_plugins: tuple = ()
@@ -825,9 +828,18 @@ class Agent:
             # cluster watch (agent/platform.py — api_watcher analogue)
             from deepflow_tpu.agent.platform import (file_lister,
                                                      interface_reporter,
-                                                     k8s_watcher)
+                                                     k8s_watcher,
+                                                     libvirt_lister,
+                                                     local_interfaces)
+            lister = None
+            if self.cfg.libvirt_xml_dir:
+                # KVM host: guest NICs from the domain XML definitions
+                # ride the same genesis report as the host's own NICs
+                lv = libvirt_lister(self.cfg.libvirt_xml_dir)
+                lister = (lambda: local_interfaces() + lv())
             self.platform_watcher = interface_reporter(
                 self.cfg.controller_url, self.cfg.host, self.cfg.ctrl_ip,
+                lister=lister,
                 interval_s=self.cfg.platform_sync_interval_s)
             self.platform_watcher.start()
             if self.cfg.k8s_apiserver_url:

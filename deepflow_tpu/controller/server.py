@@ -263,6 +263,21 @@ class ControllerServer:
                 try:
                     ipaddress.IPv4Address(itf["ip"])
                 except (KeyError, ValueError):
+                    # no (valid) ip: a libvirt guest NIC report is
+                    # mac-keyed (agent libvirt_xml_extractor role) —
+                    # model it as a vinterface row under the owning VM
+                    if itf.get("mac") and itf.get("domain_name"):
+                        key = f"{body['host']}|{itf['mac']}"
+                        snapshot.append(make_resource(
+                            "vinterface",
+                            2_000_000 + (fnv1a32(key.encode())
+                                         & 0xFFFFF),
+                            f"{itf['domain_name']}:{itf.get('name', i)}",
+                            domain=domain,
+                            mac=itf["mac"],
+                            vm_name=itf["domain_name"],
+                            vm_uuid=itf.get("domain_uuid", ""),
+                            host=body["host"]))
                     continue
                 snapshot.append(make_resource(
                     "host",

@@ -83,3 +83,84 @@ def test_k8s_watch_to_controller_e2e(tmp_path):
         assert model.get("pod", 3) is None
     finally:
         ctl.close()
+
+
+def test_libvirt_lister_extracts_guest_nics(tmp_path):
+    """Domain XML -> guest interface entries (reference:
+    agent/src/platform/libvirt_xml_extractor.rs): target dev + mac +
+    owning domain; torn files and mac-less interfaces skipped."""
+    from deepflow_tpu.agent.platform import libvirt_lister
+
+    (tmp_path / "web1.xml").write_text("""
+<domain type='kvm'>
+  <name>web1</name>
+  <uuid>aaaa-bbbb</uuid>
+  <devices>
+    <interface type='bridge'>
+      <mac address='52:54:00:11:22:33'/>
+      <target dev='vnet0'/>
+    </interface>
+    <interface type='bridge'>
+      <target dev='vnet9'/>
+    </interface>
+    <interface type='network'>
+      <mac address='52:54:00:aa:bb:cc'/>
+    </interface>
+  </devices>
+</domain>""")
+    (tmp_path / "broken.xml").write_text("<domain><name>x</na")
+    (tmp_path / "notes.txt").write_text("not xml")
+    got = libvirt_lister(str(tmp_path))()
+    # mac-less vnet9 skipped; the persistent-XML case (mac, no target
+    # dev — libvirt strips auto vnetX names on save) gets a mac-derived
+    # name instead of being dropped
+    assert got == [
+        {"name": "vnet0", "mac": "52:54:00:11:22:33",
+         "domain_name": "web1", "domain_uuid": "aaaa-bbbb"},
+        {"name": "tap-aabbcc", "mac": "52:54:00:aa:bb:cc",
+         "domain_name": "web1", "domain_uuid": "aaaa-bbbb"},
+    ]
+
+
+def test_genesis_accepts_libvirt_vinterfaces(tmp_path):
+    """Mac-keyed (ip-less) interface reports land as vinterface rows
+    under the per-agent genesis domain."""
+    import json
+    import urllib.request
+
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/genesis",
+            data=json.dumps({
+                "ctrl_ip": "10.0.0.5", "host": "kvm-node",
+                "interfaces": [
+                    {"name": "eth0", "ip": "10.0.0.5"},
+                    {"name": "vnet0", "mac": "52:54:00:11:22:33",
+                     "domain_name": "web1", "domain_uuid": "u1"},
+                ]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            out = json.load(r)
+        assert out["created"] == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/resources"
+                "?type=vinterface", timeout=5) as r:
+            vifs = json.load(r)
+        assert len(vifs) == 1
+        assert vifs[0]["name"] == "web1:vnet0"
+        attrs = dict(vifs[0].get("attrs") or [])
+        if not attrs and "mac" in vifs[0]:
+            attrs = vifs[0]
+        assert attrs["mac"] == "52:54:00:11:22:33"
+        assert attrs["vm_name"] == "web1"
+    finally:
+        srv.close()
