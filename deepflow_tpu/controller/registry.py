@@ -185,6 +185,21 @@ class VTapRegistry:
             out[str(pid)] = g
         return out, allocated
 
+    def gpid_batch(self, vtap_id: int, pids) -> Dict[int, int]:
+        """pid -> gprocess id for a whole request at once (the gRPC
+        GPIDSync path): ONE lock hold and at most ONE registry save per
+        request, not per pid — a first sync carrying N processes must
+        not serialize the registry 2N times. pid 0 maps to 0."""
+        want = sorted({int(p) for p in pids if p})
+        with self._lock:
+            out, allocated = self._gpid_sync_locked(
+                vtap_id, [{"pid": p, "start_time": 0} for p in want])
+            if allocated:
+                self._save_locked()
+        got = {int(k): v for k, v in out.items()}
+        got[0] = 0
+        return got
+
     # -- staged upgrade ----------------------------------------------------
     def set_upgrade(self, group: str, revision: str, package_name: str,
                     sha256: str) -> None:

@@ -197,7 +197,7 @@ class ControllerServer:
         if path == "/v1/upgrade-package":
             import base64
             import hashlib
-            data = self._package_bytes(qs.get("name", ""))
+            data = self.package_bytes(qs.get("name", ""))
             if data is None:
                 raise KeyError(qs.get("name", ""))
             return {"name": qs["name"],
@@ -239,7 +239,7 @@ class ControllerServer:
         if path == "/v1/upgrade":
             import hashlib
             pkg = body["package"]
-            data = self._package_bytes(pkg)
+            data = self.package_bytes(pkg)
             if data is None:
                 raise KeyError(f"unknown package {pkg!r}")
             self.registry.set_upgrade(
@@ -332,7 +332,7 @@ class ControllerServer:
                     "version": self.model.version}
         raise KeyError(path)
 
-    def _package_bytes(self, name: str) -> Optional[bytes]:
+    def package_bytes(self, name: str) -> Optional[bytes]:
         """Memory first, then the persisted copy (controller restart
         mid-rollout must not strand the fleet)."""
         data = self._packages.get(name)

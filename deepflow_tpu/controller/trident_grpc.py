@@ -1,0 +1,184 @@
+"""trident.Synchronizer gRPC service: the reference-agent control plane.
+
+Reference: message/trident.proto Synchronizer service +
+server/controller/trisolaris/services/grpc/synchronize/ — the gRPC
+surface an UNMODIFIED reference agent speaks. The JSON/HTTP control
+plane (controller/server.py) remains the native surface; this bridge
+serves the same VTapRegistry state over the reference's wire so a
+reference agent can register, receive pushed config, learn upgrade
+targets, stream upgrade packages, resolve gpids, and NTP-sync:
+
+- Sync: register-or-refresh via registry.sync (same allocation/groups/
+  staged-upgrade bookkeeping as /v1/sync); RuntimeConfig mapped onto
+  the Config fields the subset proto carries; an upgrade offer rides
+  `revision` + `self_update_url` exactly like the reference triggers
+  its Upgrade rpc.
+- Upgrade: streams the targeted package in chunks with md5/total_len/
+  pkt_count (trident.proto UpgradeResponse contract).
+- GPIDSync: entry pids are replaced with controller-global gprocess
+  ids (process_info.go role) keyed by the registry's persisted
+  (vtap, pid) allocation.
+- Query: a real 48-byte NTPv3 server answer (rpc/ntp.rs client side):
+  originate := client transmit, receive/transmit := server clock.
+
+grpcio carries HTTP/2; the service handlers are plain functions over
+generated-from-our-subset-proto messages (wire/protos/trident.proto,
+field-number compatible with the reference)."""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import time
+from concurrent import futures
+from typing import Callable, Optional
+
+from deepflow_tpu.controller.registry import VTapRegistry
+from deepflow_tpu.wire.gen import trident_pb2 as pb
+
+UPGRADE_CHUNK = 1 << 20
+
+# seconds between the NTP epoch (1900) and the unix epoch (1970)
+_NTP_UNIX_DELTA = 2208988800
+
+
+def _ntp_ts(unix: float) -> int:
+    sec = int(unix) + _NTP_UNIX_DELTA
+    frac = int((unix % 1.0) * (1 << 32))
+    return (sec << 32) | frac
+
+
+def ntp_answer(request: bytes, now: Optional[float] = None) -> bytes:
+    """48-byte NTPv3 mode-4 (server) answer to a client packet: LI=0,
+    stratum 1, originate := client's transmit, recv/trans := now."""
+    now = time.time() if now is None else now
+    client_transmit = request[40:48] if len(request) >= 48 \
+        else b"\0" * 8
+    vn = (request[0] >> 3) & 0x7 if request else 3
+    head = bytes([((vn & 0x7) << 3) | 4, 1, 0, 0])      # mode 4, stratum 1
+    ts = _ntp_ts(now)
+    return (head + b"\0" * 8                             # delay/dispersion
+            + b"DFTP"                                    # reference id
+            + struct.pack(">Q", ts)                      # reference ts
+            + client_transmit                            # originate
+            + struct.pack(">Q", ts)                      # receive
+            + struct.pack(">Q", ts))                     # transmit
+
+
+class SynchronizerService:
+    """Handler set behind grpc.method_handlers_generic_handler."""
+
+    def __init__(self, registry: VTapRegistry,
+                 package_bytes: Callable[[str], Optional[bytes]],
+                 platform_version: Callable[[], int] = lambda: 0) -> None:
+        self.registry = registry
+        self.package_bytes = package_bytes
+        self.platform_version = platform_version
+        self.syncs = 0
+        self.upgrades_streamed = 0
+
+    # -- rpc Sync ----------------------------------------------------------
+    def Sync(self, req: "pb.SyncRequest", ctx) -> "pb.SyncResponse":
+        self.syncs += 1
+        r = self.registry.sync(req.ctrl_ip, req.host or req.ctrl_ip,
+                               revision=req.revision,
+                               boot=bool(req.boot_time))
+        cfg = r["config"]
+        resp = pb.SyncResponse(
+            status=pb.SUCCESS,
+            version_platform_data=self.platform_version())
+        c = resp.config
+        c.vtap_id = r["vtap_id"]
+        c.enabled = True
+        c.max_cpus = int(cfg.get("max_cpus", 1))
+        c.max_memory = int(cfg.get("max_memory_mb", 768))
+        c.sync_interval = int(cfg.get("sync_interval_s", 60))
+        c.stats_interval = int(cfg.get("stats_interval_s", 10))
+        c.global_pps_threshold = int(cfg.get("max_collect_pps", 200000))
+        c.max_escape_seconds = 3600
+        c.capture_bpf = str(cfg.get("capture_bpf", ""))
+        c.l4_log_tap_types.extend(
+            int(t) for t in cfg.get("l4_log_tap_types", ()))
+        upg = r.get("upgrade")
+        if upg:
+            resp.revision = upg["revision"]
+            resp.self_update_url = "grpc"      # fetch via rpc Upgrade
+        return resp
+
+    # -- rpc Query (NTP) ---------------------------------------------------
+    def Query(self, req: "pb.NtpRequest", ctx) -> "pb.NtpResponse":
+        return pb.NtpResponse(response=ntp_answer(req.request))
+
+    # -- rpc Upgrade (server-stream) ---------------------------------------
+    def Upgrade(self, req: "pb.UpgradeRequest", ctx):
+        key_host = None
+        for vt in self.registry.list():
+            if vt.ctrl_ip == req.ctrl_ip:
+                key_host = vt
+                break
+        tgt = None
+        if key_host is not None:
+            with self.registry._lock:
+                tgt = self.registry._upgrades.get(key_host.group)
+        data = self.package_bytes(tgt["package"]) if tgt else None
+        if data is None:
+            yield pb.UpgradeResponse(status=pb.FAILED)
+            return
+        self.upgrades_streamed += 1
+        md5 = hashlib.md5(data).hexdigest()
+        total = len(data)
+        count = (total + UPGRADE_CHUNK - 1) // UPGRADE_CHUNK or 1
+        for off in range(0, total or 1, UPGRADE_CHUNK):
+            yield pb.UpgradeResponse(
+                status=pb.SUCCESS, content=data[off:off + UPGRADE_CHUNK],
+                md5=md5, total_len=total, pkt_count=count)
+
+    # -- rpc GPIDSync ------------------------------------------------------
+    def GPIDSync(self, req: "pb.GPIDSyncRequest",
+                 ctx) -> "pb.GPIDSyncResponse":
+        gpids = self.registry.gpid_batch(
+            req.vtap_id,
+            [p for e in req.entries for p in (e.pid_0, e.pid_1)])
+        resp = pb.GPIDSyncResponse()
+        for e in req.entries:
+            out = resp.entries.add()
+            out.CopyFrom(e)
+            out.pid_0 = gpids[e.pid_0]
+            out.pid_1 = gpids[e.pid_1]
+        return resp
+
+
+def serve(registry: VTapRegistry,
+          package_bytes: Callable[[str], Optional[bytes]],
+          platform_version: Callable[[], int] = lambda: 0,
+          host: str = "127.0.0.1", port: int = 30035):
+    """Start the gRPC server; returns (server, bound_port, service).
+    Port 30035 is the reference's proxy_controller_port default."""
+    import grpc
+
+    svc = SynchronizerService(registry, package_bytes, platform_version)
+    handlers = {
+        "Sync": grpc.unary_unary_rpc_method_handler(
+            svc.Sync,
+            request_deserializer=pb.SyncRequest.FromString,
+            response_serializer=pb.SyncResponse.SerializeToString),
+        "Query": grpc.unary_unary_rpc_method_handler(
+            svc.Query,
+            request_deserializer=pb.NtpRequest.FromString,
+            response_serializer=pb.NtpResponse.SerializeToString),
+        "Upgrade": grpc.unary_stream_rpc_method_handler(
+            svc.Upgrade,
+            request_deserializer=pb.UpgradeRequest.FromString,
+            response_serializer=pb.UpgradeResponse.SerializeToString),
+        "GPIDSync": grpc.unary_unary_rpc_method_handler(
+            svc.GPIDSync,
+            request_deserializer=pb.GPIDSyncRequest.FromString,
+            response_serializer=pb.GPIDSyncResponse.SerializeToString),
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("trident.Synchronizer",
+                                             handlers),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, bound, svc

@@ -106,6 +106,20 @@ class Server:
         if self.controller is not None:
             # in-process ingester enriches from this controller's model
             PlatformPusher(self.model, self.ingester.platform)
+        # trident gRPC bridge: the reference-agent control plane
+        # (message/trident.proto Synchronizer) over the same registry.
+        # grpc_port 0 = ephemeral; None/absent with no grpcio = skip.
+        self.trident_grpc = None
+        self._grpc_parts = None
+        if self.controller is not None and \
+                ctl_cfg.get("grpc_enabled", True):
+            try:
+                from deepflow_tpu.controller import trident_grpc
+                self._grpc_parts = (trident_grpc,
+                                    ctl_cfg.get("grpc_port", 30035),
+                                    ctl_cfg.get("host", "127.0.0.1"))
+            except ImportError:
+                pass          # grpcio not in this image: JSON-only
 
         q_cfg = c.get("querier", {})
         self.querier = None
@@ -140,11 +154,27 @@ class Server:
                              if self.election else 1})
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self) -> None:
+    def _start_components(self) -> None:
+        """ONE start sequence shared by start() and reload() — a
+        duplicated copy silently diverged once (reload forgot the gRPC
+        bridge) and must not exist again."""
         if self.election is not None:
             self.election.start()
         if self.controller is not None:
             self.controller.start()
+        if self._grpc_parts is not None:
+            mod, port, host = self._grpc_parts
+            server, bound, svc = mod.serve(
+                self.registry, self.controller.package_bytes,
+                platform_version=lambda: self.model.version,
+                host=host, port=port)
+            if bound == 0:
+                # grpc's add_insecure_port reports bind failure as 0
+                # and start() would otherwise proceed silently deaf
+                server.stop(grace=0)
+                raise OSError(
+                    f"trident gRPC bridge failed to bind {host}:{port}")
+            self.trident_grpc = (server, bound, svc)
         self.ingester.start()
         if self.stats_shipper is not None:
             # shipper targets the real bound port (port may have been 0)
@@ -153,6 +183,9 @@ class Server:
             self.ingester.stats.start(interval_s=10.0)
         if self.querier is not None:
             self.querier.start()
+
+    def start(self) -> None:
+        self._start_components()
         if self.config_path is not None:
             self._watch_thread = threading.Thread(
                 target=self._watch_config, name="config-watcher",
@@ -167,6 +200,9 @@ class Server:
             self._close_components()
 
     def _close_components(self) -> None:
+        if self.trident_grpc is not None:
+            self.trident_grpc[0].stop(grace=1).wait()
+            self.trident_grpc = None
         if self.querier is not None:
             self.querier.close()
         if self.stats_shipper is not None:
@@ -205,17 +241,7 @@ class Server:
             self.cfg = new_cfg
             self._build()
             # restart everything except the watcher (already running)
-            if self.election is not None:
-                self.election.start()
-            if self.controller is not None:
-                self.controller.start()
-            self.ingester.start()
-            if self.stats_shipper is not None:
-                self.stats_shipper.sender.set_target(
-                    f"127.0.0.1:{self.ingester.port}")
-                self.ingester.stats.start(interval_s=10.0)
-            if self.querier is not None:
-                self.querier.start()
+            self._start_components()
 
 
 def main(argv=None) -> int:
