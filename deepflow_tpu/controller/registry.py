@@ -189,15 +189,22 @@ class VTapRegistry:
         """pid -> gprocess id for a whole request at once (the gRPC
         GPIDSync path): ONE lock hold and at most ONE registry save per
         request, not per pid — a first sync carrying N processes must
-        not serialize the registry 2N times. pid 0 maps to 0."""
+        not serialize the registry 2N times. pid 0 maps to 0. Requests
+        beyond _gpid_sync_locked's per-call bound are chunked, so a
+        host with >4096 processes maps every pid instead of KeyErroring
+        the rpc."""
         want = sorted({int(p) for p in pids if p})
+        got: Dict[int, int] = {0: 0}
         with self._lock:
-            out, allocated = self._gpid_sync_locked(
-                vtap_id, [{"pid": p, "start_time": 0} for p in want])
-            if allocated:
+            any_alloc = False
+            for i in range(0, len(want), 4096):
+                out, allocated = self._gpid_sync_locked(
+                    vtap_id, [{"pid": p, "start_time": 0}
+                              for p in want[i:i + 4096]])
+                any_alloc = any_alloc or allocated
+                got.update((int(k), v) for k, v in out.items())
+            if any_alloc:
                 self._save_locked()
-        got = {int(k): v for k, v in out.items()}
-        got[0] = 0
         return got
 
     # -- staged upgrade ----------------------------------------------------
@@ -216,6 +223,13 @@ class VTapRegistry:
             self._upgrade_failed.clear()
             self._upgrading.clear()
             self._save_locked()
+
+    def upgrade_target(self, group: str) -> Optional[dict]:
+        """The group's current upgrade target (revision/package/sha256)
+        or None — the public read the gRPC Upgrade stream keys off."""
+        with self._lock:
+            tgt = self._upgrades.get(group)
+            return dict(tgt) if tgt else None
 
     def clear_upgrade(self, group: str) -> bool:
         with self._lock:

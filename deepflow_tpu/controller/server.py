@@ -247,49 +247,8 @@ class ControllerServer:
                 hashlib.sha256(data).hexdigest())
             return self.registry.upgrade_status()
         if path == "/v1/genesis":
-            # agent-reported interfaces become host resources in a
-            # PER-AGENT genesis domain (reference: controller/genesis
-            # sinks keyed by vtap) — one shared domain would let each
-            # agent's snapshot delete every other agent's rows. Ids must
-            # be restart-stable (content hash), and only well-formed
-            # IPv4 addresses may enter the model (a bad row would poison
-            # every later platform-data compile).
-            import ipaddress
-
-            from deepflow_tpu.store.dict_store import fnv1a32
-            domain = f"{self.genesis_domain}/{body['host']}"
-            snapshot = []
-            for i, itf in enumerate(body.get("interfaces", [])):
-                try:
-                    ipaddress.IPv4Address(itf["ip"])
-                except (KeyError, ValueError):
-                    # no (valid) ip: a libvirt guest NIC report is
-                    # mac-keyed (agent libvirt_xml_extractor role) —
-                    # model it as a vinterface row under the owning VM
-                    if itf.get("mac") and itf.get("domain_name"):
-                        key = f"{body['host']}|{itf['mac']}"
-                        snapshot.append(make_resource(
-                            "vinterface",
-                            2_000_000 + (fnv1a32(key.encode())
-                                         & 0xFFFFF),
-                            f"{itf['domain_name']}:{itf.get('name', i)}",
-                            domain=domain,
-                            mac=itf["mac"],
-                            vm_name=itf["domain_name"],
-                            vm_uuid=itf.get("domain_uuid", ""),
-                            host=body["host"]))
-                    continue
-                snapshot.append(make_resource(
-                    "host",
-                    1_000_000 + (fnv1a32(
-                        f"{body['host']}|{itf['ip']}".encode()) & 0xFFFFF),
-                    f"{body['host']}:{itf.get('name', i)}",
-                    domain=domain,
-                    ip=itf["ip"], epc_id=itf.get("epc_id", 0)))
-            diff = self.model.update_domain(domain, snapshot)
-            self.genesis_sync.mark_local(domain)
-            return {"created": len(diff.created),
-                    "deleted": len(diff.deleted)}
+            return self.genesis_report(body["host"],
+                                       body.get("interfaces", []))
         if path == "/v1/vtap-group-config":
             version = self.registry.set_config(qs.get("group", "default"),
                                                body)
@@ -331,6 +290,52 @@ class ControllerServer:
                     "resource_count": task.info.resource_count,
                     "version": self.model.version}
         raise KeyError(path)
+
+    def genesis_report(self, host: str, interfaces: list) -> dict:
+        """Agent-reported interfaces become resources in a PER-AGENT
+        genesis domain (reference: controller/genesis sinks keyed by
+        vtap) — one shared domain would let each agent's snapshot
+        delete every other agent's rows. Ids must be restart-stable
+        (content hash); only well-formed IPv4 addresses enter as host
+        rows (a bad row would poison every later platform-data
+        compile); mac-keyed ip-less entries (libvirt guest NICs) become
+        vinterface rows. Shared by the JSON route and the trident gRPC
+        GenesisSync rpc so the two ingest paths cannot diverge."""
+        import ipaddress
+
+        from deepflow_tpu.store.dict_store import fnv1a32
+        domain = f"{self.genesis_domain}/{host}"
+        snapshot = []
+        for i, itf in enumerate(interfaces):
+            try:
+                ipaddress.IPv4Address(itf["ip"])
+            except (KeyError, ValueError):
+                # no (valid) ip: a libvirt guest NIC report is
+                # mac-keyed (agent libvirt_xml_extractor role) —
+                # model it as a vinterface row under the owning VM
+                if itf.get("mac") and itf.get("domain_name"):
+                    key = f"{host}|{itf['mac']}"
+                    snapshot.append(make_resource(
+                        "vinterface",
+                        2_000_000 + (fnv1a32(key.encode()) & 0xFFFFF),
+                        f"{itf['domain_name']}:{itf.get('name', i)}",
+                        domain=domain,
+                        mac=itf["mac"],
+                        vm_name=itf["domain_name"],
+                        vm_uuid=itf.get("domain_uuid", ""),
+                        host=host))
+                continue
+            snapshot.append(make_resource(
+                "host",
+                1_000_000 + (fnv1a32(
+                    f"{host}|{itf['ip']}".encode()) & 0xFFFFF),
+                f"{host}:{itf.get('name', i)}",
+                domain=domain,
+                ip=itf["ip"], epc_id=itf.get("epc_id", 0)))
+        diff = self.model.update_domain(domain, snapshot)
+        self.genesis_sync.mark_local(domain)
+        return {"created": len(diff.created),
+                "deleted": len(diff.deleted)}
 
     def package_bytes(self, name: str) -> Optional[bytes]:
         """Memory first, then the persisted copy (controller restart

@@ -70,19 +70,29 @@ class SynchronizerService:
 
     def __init__(self, registry: VTapRegistry,
                  package_bytes: Callable[[str], Optional[bytes]],
-                 platform_version: Callable[[], int] = lambda: 0) -> None:
+                 platform_version: Callable[[], int] = lambda: 0,
+                 genesis_report: Optional[Callable] = None,
+                 assign: Optional[Callable] = None) -> None:
         self.registry = registry
         self.package_bytes = package_bytes
         self.platform_version = platform_version
+        self.genesis_report = genesis_report
+        self.assign = assign          # (ctrl_ip, host) -> "ip:port"
         self.syncs = 0
         self.upgrades_streamed = 0
+        self.genesis_syncs = 0
+        # reference agents stamp boot_time on EVERY periodic Sync; a
+        # boot is when it CHANGES (process restarted), not when present
+        self._boot_times: dict = {}
 
     # -- rpc Sync ----------------------------------------------------------
     def Sync(self, req: "pb.SyncRequest", ctx) -> "pb.SyncResponse":
         self.syncs += 1
+        key = (req.ctrl_ip, req.host or req.ctrl_ip)
+        boot = self._boot_times.get(key) != req.boot_time
+        self._boot_times[key] = req.boot_time
         r = self.registry.sync(req.ctrl_ip, req.host or req.ctrl_ip,
-                               revision=req.revision,
-                               boot=bool(req.boot_time))
+                               revision=req.revision, boot=boot)
         cfg = r["config"]
         resp = pb.SyncResponse(
             status=pb.SUCCESS,
@@ -99,6 +109,15 @@ class SynchronizerService:
         c.capture_bpf = str(cfg.get("capture_bpf", ""))
         c.l4_log_tap_types.extend(
             int(t) for t in cfg.get("l4_log_tap_types", ()))
+        # the data-plane destination (JSON route's resp["ingester"]):
+        # without analyzer_ip a managed agent has nowhere to ship
+        if self.assign is not None:
+            target = self.assign(req.ctrl_ip, req.host or req.ctrl_ip)
+            if target:
+                ip, _, port = str(target).rpartition(":")
+                c.analyzer_ip = ip or str(target)
+                if port.isdigit():
+                    c.analyzer_port = int(port)
         upg = r.get("upgrade")
         if upg:
             resp.revision = upg["revision"]
@@ -111,15 +130,9 @@ class SynchronizerService:
 
     # -- rpc Upgrade (server-stream) ---------------------------------------
     def Upgrade(self, req: "pb.UpgradeRequest", ctx):
-        key_host = None
-        for vt in self.registry.list():
-            if vt.ctrl_ip == req.ctrl_ip:
-                key_host = vt
-                break
-        tgt = None
-        if key_host is not None:
-            with self.registry._lock:
-                tgt = self.registry._upgrades.get(key_host.group)
+        vt = next((v for v in self.registry.list()
+                   if v.ctrl_ip == req.ctrl_ip), None)
+        tgt = self.registry.upgrade_target(vt.group) if vt else None
         data = self.package_bytes(tgt["package"]) if tgt else None
         if data is None:
             yield pb.UpgradeResponse(status=pb.FAILED)
@@ -132,6 +145,35 @@ class SynchronizerService:
             yield pb.UpgradeResponse(
                 status=pb.SUCCESS, content=data[off:off + UPGRADE_CHUNK],
                 md5=md5, total_len=total, pkt_count=count)
+
+    # -- rpc GenesisSync ---------------------------------------------------
+    def GenesisSync(self, req: "pb.GenesisSyncRequest",
+                    ctx) -> "pb.GenesisSyncResponse":
+        """Platform report leg: InterfaceInfo entries map onto the same
+        genesis ingestion the JSON route uses — "ip/masklen" strings
+        become host rows, mac-only entries vinterface rows (device_name
+        as the owning domain)."""
+        if self.genesis_report is None:
+            return pb.GenesisSyncResponse(version=0)
+        self.genesis_syncs += 1
+        host = req.platform_data.raw_hostname or req.source_ip
+        rows = []
+        for itf in req.platform_data.interfaces:
+            mac = itf.mac
+            mac_str = ":".join(f"{(mac >> s) & 0xFF:02x}"
+                               for s in range(40, -8, -8)) if mac else ""
+            # EVERY address gets a row (genesis_report keys host rows
+            # by host|ip, so one interface may emit several); invalid
+            # entries are dropped by genesis_report's own validation
+            for addr in itf.ip:
+                rows.append({"name": itf.name,
+                             "ip": addr.split("/")[0]})
+            if mac_str and itf.device_name:
+                rows.append({"name": itf.name, "mac": mac_str,
+                             "domain_name": itf.device_name,
+                             "domain_uuid": itf.device_id})
+        self.genesis_report(host, rows)
+        return pb.GenesisSyncResponse(version=self.platform_version())
 
     # -- rpc GPIDSync ------------------------------------------------------
     def GPIDSync(self, req: "pb.GPIDSyncRequest",
@@ -151,12 +193,16 @@ class SynchronizerService:
 def serve(registry: VTapRegistry,
           package_bytes: Callable[[str], Optional[bytes]],
           platform_version: Callable[[], int] = lambda: 0,
+          genesis_report: Optional[Callable] = None,
+          assign: Optional[Callable] = None,
           host: str = "127.0.0.1", port: int = 30035):
     """Start the gRPC server; returns (server, bound_port, service).
     Port 30035 is the reference's proxy_controller_port default."""
     import grpc
 
-    svc = SynchronizerService(registry, package_bytes, platform_version)
+    svc = SynchronizerService(registry, package_bytes, platform_version,
+                              genesis_report=genesis_report,
+                              assign=assign)
     handlers = {
         "Sync": grpc.unary_unary_rpc_method_handler(
             svc.Sync,
@@ -174,6 +220,10 @@ def serve(registry: VTapRegistry,
             svc.GPIDSync,
             request_deserializer=pb.GPIDSyncRequest.FromString,
             response_serializer=pb.GPIDSyncResponse.SerializeToString),
+        "GenesisSync": grpc.unary_unary_rpc_method_handler(
+            svc.GenesisSync,
+            request_deserializer=pb.GenesisSyncRequest.FromString,
+            response_serializer=pb.GenesisSyncResponse.SerializeToString),
     }
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
     server.add_generic_rpc_handlers((

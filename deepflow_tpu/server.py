@@ -51,6 +51,7 @@ class Server:
         self.config_path = config_path
         self.cfg = load_config(config_path)
         self._watch_thread: Optional[threading.Thread] = None
+        self.reload_error: Optional[str] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._build()
@@ -167,6 +168,8 @@ class Server:
             server, bound, svc = mod.serve(
                 self.registry, self.controller.package_bytes,
                 platform_version=lambda: self.model.version,
+                genesis_report=self.controller.genesis_report,
+                assign=self.monitor.assign,
                 host=host, port=port)
             if bound == 0:
                 # grpc's add_insecure_port reports bind failure as 0
@@ -240,8 +243,16 @@ class Server:
             self._close_components()
             self.cfg = new_cfg
             self._build()
-            # restart everything except the watcher (already running)
-            self._start_components()
+            # restart everything except the watcher (already running).
+            # A start failure here (e.g. a port the new config picked is
+            # taken) must NOT propagate: it would kill the watcher
+            # thread with components half-stopped and no way back —
+            # record it and keep watching so the next edit can recover.
+            try:
+                self._start_components()
+                self.reload_error = None
+            except Exception as e:
+                self.reload_error = repr(e)
 
 
 def main(argv=None) -> int:

@@ -255,3 +255,49 @@ def test_http2_duplicate_header_first_wins():
         bytes([0x1, 0x4]) + struct.pack(">I", 1) + block
     rec = l7_ext.Http2Parser().parse(payload)
     assert rec.client_ip == "1.1.1.1"       # same as HTTP/1 semantics
+
+
+def test_parser_surface_never_raises_on_fuzz():
+    """The new header/trace parsing surface is attacker-facing payload
+    handling: random and structured-corrupt inputs must never raise
+    (the reference fuzzes its protocol_logs the same way)."""
+    import random
+
+    from deepflow_tpu.agent.l7 import HttpParser
+
+    rng = random.Random(0xFEED)
+    p = HttpParser()
+    seeds = [
+        REQ,
+        b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n5\r\nhi",
+        b"GET / HTTP/1.1\r\n" + b"traceparent: " + b"-" * 300 + b"\r\n\r\n",
+        b"GET / HTTP/1.1\r\nHost: " + bytes(range(256)) + b"\r\n\r\n",
+    ]
+    for _ in range(300):
+        base = bytearray(rng.choice(seeds))
+        for _ in range(rng.randrange(1, 8)):
+            base[rng.randrange(len(base))] = rng.randrange(256)
+        payload = bytes(base)
+        if p.check(payload):
+            p.parse(payload)                    # must not raise
+        parse_http_headers(payload)
+        http_body_len(payload, parse_http_headers(payload))
+    for _ in range(200):
+        blob = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(0, 400)))
+        if p.check(blob):
+            p.parse(blob)
+
+
+def test_decoders_never_raise_on_fuzz():
+    import random
+
+    rng = random.Random(0xD00D)
+    keys = ["traceparent", "sw8", "sw6", "sw3", "uber-trace-id", "x-any"]
+    for _ in range(500):
+        key = rng.choice(keys)
+        value = "".join(rng.choice("-|:.abc0123\x00 ￿")
+                        for _ in range(rng.randrange(0, 60)))
+        decode_id(key, value, TRACE_ID)
+        decode_id(key, value, SPAN_ID)
+        trace_context.extract({key: value})

@@ -174,3 +174,80 @@ def test_all_in_one_server_serves_grpc(tmp_path):
         assert any(v.host == "n2" for v in srv.registry.list())
     finally:
         srv.close()
+
+
+def test_genesis_sync_lands_platform_rows(tmp_path):
+    """The GenesisSync rpc feeds the SAME genesis ingestion as the
+    JSON route: ip interfaces -> host rows, mac-only -> vinterface."""
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    ctl = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    server, port, svc = serve(reg, lambda n: None,
+                              platform_version=lambda: ctl.model.version,
+                              genesis_report=ctl.genesis_report, port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        req = pb.GenesisSyncRequest(source_ip="10.3.3.3", vtap_id=1)
+        req.platform_data.raw_hostname = "kvm-host-1"
+        req.platform_data.interfaces.add(
+            mac=0x5254001122EE, ip=["10.3.3.3/24"], name="eth0")
+        req.platform_data.interfaces.add(
+            mac=0x5254001122FF, name="vnet0", device_name="guest-vm",
+            device_id="uuid-9")
+        resp = chan.unary_unary(
+            "/trident.Synchronizer/GenesisSync",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GenesisSyncResponse.FromString)(
+                req, timeout=5)
+        assert resp.version == ctl.model.version >= 1
+        rows = {(r.type, r.name) for r in ctl.model.list()}
+        assert ("host", "kvm-host-1:eth0") in rows
+        assert ("vinterface", "guest-vm:vnet0") in rows
+        vif = [r for r in ctl.model.list() if r.type == "vinterface"][0]
+        assert dict(vif.attrs)["mac"] == "52:54:00:11:22:ff"
+        assert svc.genesis_syncs == 1
+    finally:
+        chan.close()
+        server.stop(grace=0)
+
+
+def test_sync_boot_semantics_and_analyzer_assignment(tmp_path):
+    """boot_time rides EVERY reference sync; only a CHANGE is a boot.
+    The response carries the assigned ingester as analyzer_ip/port."""
+    reg = VTapRegistry(str(tmp_path / "v.json"))
+    server, port, svc = serve(
+        reg, lambda n: None,
+        assign=lambda ip, host: "10.77.0.9:30033", port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        def sync(bt):
+            return chan.unary_unary(
+                "/trident.Synchronizer/Sync",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.SyncResponse.FromString)(
+                    pb.SyncRequest(ctrl_ip="10.5.5.5", host="n5",
+                                   boot_time=bt), timeout=5)
+
+        r = sync(1000)
+        assert r.config.analyzer_ip == "10.77.0.9"
+        assert r.config.analyzer_port == 30033
+        sync(1000)
+        sync(1000)                     # same boot_time: periodic syncs
+        assert reg.list()[0].boot_count == 1
+        sync(2000)                     # restarted process
+        assert reg.list()[0].boot_count == 2
+    finally:
+        chan.close()
+        server.stop(grace=0)
+
+
+def test_gpid_batch_chunks_past_per_call_bound(tmp_path):
+    reg = VTapRegistry()
+    got = reg.gpid_batch(1, range(1, 5002))      # > 4096 distinct pids
+    assert len(got) == 5002                      # all pids + the 0 map
+    assert len(set(got.values())) == 5002        # distinct, incl. 0
+    assert got[0] == 0
