@@ -141,6 +141,13 @@ l4_log_aggr_s: 0
 # every plugin. Uncomment deliberately:
 # so_plugins: ["/opt/plugins/custom.so"]   # .so over df_plugin.h
 # wasm_plugins: ["/opt/plugins/custom.wasm"]  # sandboxed wasm
+
+# trace-context header extraction (ordered: first present header wins;
+# custom keys decode raw). Omitted/null = agents keep their defaults.
+# http_log_trace_id: [traceparent, sw8]
+# http_log_span_id: [traceparent, sw8]
+# http_log_x_request_id: [x-request-id]
+# http_log_proxy_client: [x-forwarded-for, x-real-ip]
 """
 
 

@@ -38,6 +38,13 @@ DEFAULT_CONFIG = {
     # hot-converges to exactly it (Agent._sync_*_plugins)
     "so_plugins": None,
     "wasm_plugins": None,
+    # trace-context header extraction (agent/trace_context.py): ordered
+    # key lists (or the reference's comma-joined string form); None =
+    # not managed by this group
+    "http_log_trace_id": None,
+    "http_log_span_id": None,
+    "http_log_x_request_id": None,
+    "http_log_proxy_client": None,
 }
 
 

@@ -301,3 +301,24 @@ def test_decoders_never_raise_on_fuzz():
         decode_id(key, value, TRACE_ID)
         decode_id(key, value, SPAN_ID)
         trace_context.extract({key: value})
+
+
+def test_http_log_keys_push_through_group_config():
+    """The controller accepts the http_log_* keys and a managed agent
+    hot-applies them (the ops-documented flow end to end)."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.controller.registry import VTapRegistry
+
+    reg = VTapRegistry()
+    reg.set_config("default",
+                   {"http_log_trace_id": "x-corp-trace, traceparent"})
+    agent = Agent(AgentConfig())
+    try:
+        agent._apply_config(reg.get_config("default"))
+        assert trace_context.config().trace_types == \
+            ("x-corp-trace", "traceparent")
+        # unmanaged keys keep their values
+        assert trace_context.config().proxy_client == \
+            ("x-forwarded-for", "x-real-ip")
+    finally:
+        agent.close()
