@@ -324,6 +324,19 @@ class VTapRegistry:
                     and all(isinstance(p, str) for p in v)):
                 raise ValueError(
                     f"{key} must be a list of paths (or null)")
+        for key in ("http_log_trace_id", "http_log_span_id",
+                    "http_log_x_request_id", "http_log_proxy_client"):
+            v = config.get(key)
+            if v is None:
+                continue
+            # an int/bool here would raise inside the agent's hot-apply
+            # EVERY sync round, wedging the whole config push — reject
+            # at the API boundary like the plugin lists
+            if not (isinstance(v, str)
+                    or (isinstance(v, list)
+                        and all(isinstance(s, str) for s in v))):
+                raise ValueError(f"{key} must be a string, a list of "
+                                 f"strings, or null")
         with self._lock:
             base = dict(self._configs.get(group, DEFAULT_CONFIG))
             base.update(config)

@@ -322,3 +322,16 @@ def test_http_log_keys_push_through_group_config():
             ("x-forwarded-for", "x-real-ip")
     finally:
         agent.close()
+
+
+def test_bad_http_log_values_rejected_at_the_controller():
+    """A non-string value must 400 at set_config, not raise inside
+    every managed agent's hot-apply forever."""
+    from deepflow_tpu.controller.registry import VTapRegistry
+
+    reg = VTapRegistry()
+    for bad in (5, True, [1, 2], {"a": 1}):
+        with pytest.raises(ValueError):
+            reg.set_config("default", {"http_log_trace_id": bad})
+    reg.set_config("default", {"http_log_trace_id": "a, b"})     # ok
+    reg.set_config("default", {"http_log_trace_id": ["a", "b"]})  # ok
