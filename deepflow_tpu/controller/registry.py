@@ -79,6 +79,7 @@ class VTapRegistry:
         # global id because its start_time differs
         self._gpids: Dict[str, int] = {}
         self._next_gpid = 1
+        self._cluster_ids: Dict[str, str] = {}   # ca_md5 -> cluster id
         # staged fleet upgrade (reference: trident.proto rpc Upgrade):
         # per-group target revision + package checksum; at most
         # max_concurrent agents hold an in-flight upgrade offer
@@ -101,6 +102,7 @@ class VTapRegistry:
         self._configs = doc.get("configs", self._configs)
         self._gpids = doc.get("gpids", {})
         self._next_gpid = doc.get("next_gpid", 1)
+        self._cluster_ids = doc.get("cluster_ids", {})
         self._upgrades = doc.get("upgrades", {})
         for v in doc.get("vtaps", []):
             vt = VTap(**v)
@@ -115,6 +117,7 @@ class VTapRegistry:
             "configs": self._configs,
             "gpids": self._gpids,
             "next_gpid": self._next_gpid,
+            "cluster_ids": self._cluster_ids,
             "upgrades": self._upgrades,
             "vtaps": [vars(v) for v in self._vtaps.values()],
         }
@@ -126,7 +129,8 @@ class VTapRegistry:
     # -- sync (the agent-facing RPC) ---------------------------------------
     def sync(self, ctrl_ip: str, host: str, revision: str = "",
              boot: bool = False,
-             processes: Optional[list] = None) -> dict:
+             processes: Optional[list] = None,
+             count_upgrade_attempt: bool = True) -> dict:
         """Register-or-refresh; returns the Sync response body
         (reference: trisolaris synchronize service Sync; the GPIDSync
         rpc is folded in via `processes`, and the Upgrade stream's
@@ -161,7 +165,8 @@ class VTapRegistry:
                 resp["gpids"], allocated = self._gpid_sync_locked(
                     vt.vtap_id, processes)
                 dirty = dirty or allocated
-            upgrade = self._upgrade_offer_locked(key, vt)
+            upgrade = self._upgrade_offer_locked(key, vt,
+                                                 count_upgrade_attempt)
             if upgrade is not None:
                 resp["upgrade"] = upgrade
             if dirty:
@@ -231,6 +236,27 @@ class VTapRegistry:
             self._upgrading.clear()
             self._save_locked()
 
+    def cluster_id_for(self, ca_md5: str,
+                       name: str = "") -> str:
+        """Stable kubernetes cluster id keyed by the cluster CA's md5
+        (reference: trisolaris kubernetes_cluster allocation) —
+        persisted so every agent of one cluster converges on one id
+        across controller restarts. The reported cluster name is
+        recorded alongside (ops listing; latest report wins)."""
+        from deepflow_tpu.store.dict_store import fnv1a32
+        with self._lock:
+            rec = self._cluster_ids.get(ca_md5)
+            if rec is None:
+                rec = {"id": (f"d-{fnv1a32(ca_md5.encode()):08x}"
+                              f"{len(self._cluster_ids):04x}"),
+                       "name": name}
+                self._cluster_ids[ca_md5] = rec
+                self._save_locked()
+            elif name and rec.get("name") != name:
+                rec["name"] = name
+                self._save_locked()
+            return rec["id"]
+
     def upgrade_target(self, group: str) -> Optional[dict]:
         """The group's current upgrade target (revision/package/sha256)
         or None — the public read the gRPC Upgrade stream keys off."""
@@ -259,7 +285,9 @@ class VTapRegistry:
                     "in_flight": sorted(self._upgrading),
                     "failed": sorted(self._upgrade_failed)}
 
-    def _upgrade_offer_locked(self, key: str, vt: VTap) -> Optional[dict]:
+    def _upgrade_offer_locked(self, key: str, vt: VTap,
+                              count_attempt: bool = True
+                              ) -> Optional[dict]:
         tgt = self._upgrades.get(vt.group)
         if tgt is None or vt.revision == tgt["revision"]:
             # converged (or no target): release any bookkeeping
@@ -280,7 +308,11 @@ class VTapRegistry:
         if key not in self._upgrading and \
                 len(self._upgrading) >= self.upgrade_max_concurrent:
             return None                      # wait: staged, not thundering
-        attempts = self._upgrade_attempts.get(key, 0) + 1
+        # a high-frequency poller (the gRPC Push stream, 5s cadence)
+        # re-reads the standing offer without burning the attempt
+        # budget — attempts were calibrated for the 60s sync cadence
+        attempts = self._upgrade_attempts.get(key, 0) + (
+            1 if count_attempt else 0)
         self._upgrade_attempts[key] = attempts
         if attempts > self.upgrade_max_attempts:
             # an agent that was offered N times and never converged is

@@ -84,6 +84,8 @@ class SynchronizerService:
         # reference agents stamp boot_time on EVERY periodic Sync; a
         # boot is when it CHANGES (process restarted), not when present
         self._boot_times: dict = {}
+        import threading
+        self._push_slots = threading.Semaphore(self.max_push_streams)
 
     # -- rpc Sync ----------------------------------------------------------
     def Sync(self, req: "pb.SyncRequest", ctx) -> "pb.SyncResponse":
@@ -93,6 +95,10 @@ class SynchronizerService:
         self._boot_times[key] = req.boot_time
         r = self.registry.sync(req.ctrl_ip, req.host or req.ctrl_ip,
                                revision=req.revision, boot=boot)
+        return self._sync_response(req, r)
+
+    def _sync_response(self, req: "pb.SyncRequest",
+                       r: dict) -> "pb.SyncResponse":
         cfg = r["config"]
         resp = pb.SyncResponse(
             status=pb.SUCCESS,
@@ -123,6 +129,65 @@ class SynchronizerService:
             resp.revision = upg["revision"]
             resp.self_update_url = "grpc"      # fetch via rpc Upgrade
         return resp
+
+    # -- rpc Push (server-stream Sync) -------------------------------------
+    push_poll_s = 5.0
+    # a Push generator parks one executor thread for the connection's
+    # lifetime; the cap keeps unary rpcs (Sync/Upgrade/NTP) schedulable
+    # when many agents hold push channels — an over-cap agent gets one
+    # snapshot and falls back to Sync polling
+    max_push_streams = 24
+
+    def Push(self, req: "pb.SyncRequest", ctx):
+        """The reference's push channel: one response immediately, then
+        a new one whenever the group config / platform version / an
+        upgrade offer moves, until the agent disconnects. Each round
+        refreshes the vtap's liveness; restarts are detected from
+        boot_time changes exactly like Sync. The standing upgrade offer
+        is re-read WITHOUT burning attempt budget (5s cadence vs the
+        60s the budget assumes)."""
+        key = (req.ctrl_ip, req.host or req.ctrl_ip)
+        boot = self._boot_times.get(key) != req.boot_time
+        self._boot_times[key] = req.boot_time
+        over_cap = not self._push_slots.acquire(blocking=False)
+        last = None
+        try:
+            while ctx.is_active():
+                self.syncs += 1
+                r = self.registry.sync(req.ctrl_ip,
+                                       req.host or req.ctrl_ip,
+                                       revision=req.revision, boot=boot,
+                                       count_upgrade_attempt=False)
+                boot = False
+                state = (r["config_version"], self.platform_version(),
+                         bool(r.get("upgrade")))
+                if state != last:
+                    last = state
+                    yield self._sync_response(req, r)
+                if over_cap:
+                    return                    # snapshot-only fallback
+                # responsive to cancellation: short sleeps, not one long
+                waited = 0.0
+                while waited < self.push_poll_s and ctx.is_active():
+                    step = min(0.25, self.push_poll_s - waited)
+                    time.sleep(step)
+                    waited += step
+        finally:
+            if not over_cap:
+                self._push_slots.release()
+
+    # -- rpc GetKubernetesClusterID ----------------------------------------
+    def GetKubernetesClusterID(self, req: "pb.KubernetesClusterIDRequest",
+                               ctx) -> "pb.KubernetesClusterIDResponse":
+        """Stable cluster-id allocation keyed by the cluster CA's md5
+        (trisolaris kubernetes_cluster service role): every agent in
+        one cluster gets the same id."""
+        if not req.ca_md5:
+            return pb.KubernetesClusterIDResponse(
+                error_msg="ca_md5 required")
+        cid = self.registry.cluster_id_for(
+            req.ca_md5, req.kubernetes_cluster_name)
+        return pb.KubernetesClusterIDResponse(cluster_id=cid)
 
     # -- rpc Query (NTP) ---------------------------------------------------
     def Query(self, req: "pb.NtpRequest", ctx) -> "pb.NtpResponse":
@@ -203,6 +268,9 @@ def serve(registry: VTapRegistry,
     svc = SynchronizerService(registry, package_bytes, platform_version,
                               genesis_report=genesis_report,
                               assign=assign)
+    # worker pool sized above the push-stream cap so unary rpcs always
+    # find a schedulable thread even at full push occupancy
+    max_workers = svc.max_push_streams + 8
     handlers = {
         "Sync": grpc.unary_unary_rpc_method_handler(
             svc.Sync,
@@ -224,8 +292,18 @@ def serve(registry: VTapRegistry,
             svc.GenesisSync,
             request_deserializer=pb.GenesisSyncRequest.FromString,
             response_serializer=pb.GenesisSyncResponse.SerializeToString),
+        "Push": grpc.unary_stream_rpc_method_handler(
+            svc.Push,
+            request_deserializer=pb.SyncRequest.FromString,
+            response_serializer=pb.SyncResponse.SerializeToString),
+        "GetKubernetesClusterID": grpc.unary_unary_rpc_method_handler(
+            svc.GetKubernetesClusterID,
+            request_deserializer=pb.KubernetesClusterIDRequest.FromString,
+            response_serializer=(
+                pb.KubernetesClusterIDResponse.SerializeToString)),
     }
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server = grpc.server(futures.ThreadPoolExecutor(
+        max_workers=max_workers))
     server.add_generic_rpc_handlers((
         grpc.method_handlers_generic_handler("trident.Synchronizer",
                                              handlers),))

@@ -251,3 +251,78 @@ def test_gpid_batch_chunks_past_per_call_bound(tmp_path):
     assert len(got) == 5002                      # all pids + the 0 map
     assert len(set(got.values())) == 5002        # distinct, incl. 0
     assert got[0] == 0
+
+
+def test_push_streams_on_config_change(tmp_path):
+    """rpc Push: one response immediately, a new one when the group
+    config version moves, nothing in between."""
+    import threading
+
+    reg = VTapRegistry()
+    server, port, svc = serve(reg, lambda n: None, port=0)
+    svc.push_poll_s = 0.05
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    got = []
+    done = threading.Event()
+
+    def consume():
+        stream = chan.unary_stream(
+            "/trident.Synchronizer/Push",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.SyncResponse.FromString)(
+                pb.SyncRequest(ctrl_ip="10.6.6.6", host="n6"),
+                timeout=10)
+        try:
+            for resp in stream:
+                got.append(resp)
+                if len(got) >= 2:
+                    stream.cancel()
+                    return
+        except grpc.RpcError:
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(got) == 1                      # immediate snapshot only
+    time.sleep(0.3)
+    assert len(got) == 1                      # no change: no push
+    reg.set_config("default", {"max_cpus": 4})
+    assert done.wait(5)
+    assert len(got) == 2
+    assert got[1].config.max_cpus == 4
+    chan.close()
+    server.stop(grace=0)
+
+
+def test_kubernetes_cluster_id_stable_per_ca(tmp_path):
+    reg = VTapRegistry(str(tmp_path / "v.json"))
+    server, port, svc = serve(reg, lambda n: None, port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        def ask(md5):
+            return chan.unary_unary(
+                "/trident.Synchronizer/GetKubernetesClusterID",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=(
+                    pb.KubernetesClusterIDResponse.FromString))(
+                    pb.KubernetesClusterIDRequest(
+                        ca_md5=md5, kubernetes_cluster_name="c"),
+                    timeout=5)
+
+        a = ask("aaaa").cluster_id
+        b = ask("bbbb").cluster_id
+        assert a and b and a != b
+        assert ask("aaaa").cluster_id == a       # stable
+        bad = ask("")
+        assert bad.error_msg and not bad.cluster_id
+    finally:
+        chan.close()
+        server.stop(grace=0)
+    # persisted across controller restart
+    reg2 = VTapRegistry(str(tmp_path / "v.json"))
+    assert reg2.cluster_id_for("aaaa") == a
