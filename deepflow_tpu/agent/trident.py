@@ -276,6 +276,7 @@ class Agent:
         self.upgrades_applied = 0
         self.upgrade_errors = 0
         self.sync_errors = 0
+        self.plugin_fetch_errors = 0
         self.staged_package: Optional[str] = None
         # real deployments exec the staged binary here; None = revision
         # swap in place (process and firehose sockets stay up)
@@ -612,26 +613,85 @@ class Agent:
         if cfg.get("wasm_plugins") is not None:
             self._sync_wasm_plugins(cfg["wasm_plugins"])
 
-    def _sync_plugins(self, paths) -> None:
-        """Converge loaded plugins to the pushed set: load new paths,
-        unload removed ones (pushing so_plugins=[] must actually stop a
-        plugin from matching traffic)."""
-        from deepflow_tpu.agent.plugin import unload_so_plugin
-        want = set(paths)
-        for path in list(self.so_plugins):
+    def _resolve_plugin_path(self, entry: str) -> Optional[str]:
+        """A pushed plugin entry is a local path, or `pkg://<name>` —
+        a controller-DISTRIBUTED binary (the reference's rpc Plugin
+        stream role): fetched from the upgrade-package store, sha256-
+        verified, cached under upgrade_dir/plugins. A cache hit is
+        validated against the store's metadata (a re-uploaded package
+        under the same name must reach every agent, not just fresh
+        ones); when the controller is unreachable the cache is trusted
+        (offline tolerance). Returns the local path to load, or None
+        on failure (counted)."""
+        if not entry.startswith("pkg://"):
+            return entry
+        import base64
+        import hashlib
+        name = entry[len("pkg://"):]
+        if not name or "/" in name or name.startswith("."):
+            self.plugin_fetch_errors += 1
+            return None
+        cache_dir = os.path.join(self.cfg.upgrade_dir or "/tmp",
+                                 "plugins")
+        cached = os.path.join(cache_dir, name)
+        base = (f"{self.cfg.controller_url}/v1/upgrade-package?name="
+                + urllib.parse.quote(name)
+                ) if self.cfg.controller_url else None
+        if os.path.exists(cached):
+            if base is None:
+                return cached
+            try:
+                with urllib.request.urlopen(base + "&meta=1",
+                                            timeout=10) as resp:
+                    meta = json.load(resp)
+                with open(cached, "rb") as f:
+                    local = hashlib.sha256(f.read()).hexdigest()
+                if local == meta.get("sha256"):
+                    return cached
+                # stale: fall through to refetch
+            except Exception:
+                return cached           # controller unreachable: trust
+        if base is None:
+            self.plugin_fetch_errors += 1
+            return None
+        try:
+            with urllib.request.urlopen(base, timeout=30) as resp:
+                doc = json.load(resp)
+            data = base64.b64decode(doc["data_b64"])
+            if hashlib.sha256(data).hexdigest() != doc.get("sha256"):
+                raise ValueError("package sha256 mismatch")
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(cached + ".tmp", "wb") as f:
+                f.write(data)
+            os.replace(cached + ".tmp", cached)
+            return cached
+        except Exception:
+            self.plugin_fetch_errors += 1
+            return None
+
+    def _converge_plugins(self, paths, loaded: dict, load_fn,
+                          unload_fn) -> None:
+        """ONE converge discipline for .so and wasm plugin sets: resolve
+        (local or pkg://), unload what's no longer wanted (pushing []
+        must actually stop a plugin), load the rest."""
+        resolved = [p for p in (self._resolve_plugin_path(e)
+                                for e in paths) if p is not None]
+        want = set(resolved)
+        for path in list(loaded):
             if path not in want:
-                unload_so_plugin(self.so_plugins.pop(path))
-        for path in paths:
-            self._load_plugin(path)
+                unload_fn(loaded.pop(path))
+        for path in resolved:
+            load_fn(path)
+
+    def _sync_plugins(self, paths) -> None:
+        from deepflow_tpu.agent.plugin import unload_so_plugin
+        self._converge_plugins(paths, self.so_plugins,
+                               self._load_plugin, unload_so_plugin)
 
     def _sync_wasm_plugins(self, paths) -> None:
         from deepflow_tpu.agent.wasm_plugin import unload_wasm_plugin
-        want = set(paths)
-        for path in list(self.wasm_plugins):
-            if path not in want:
-                unload_wasm_plugin(self.wasm_plugins.pop(path))
-        for path in paths:
-            self._load_wasm(path)
+        self._converge_plugins(paths, self.wasm_plugins,
+                               self._load_wasm, unload_wasm_plugin)
 
     def _on_escape(self) -> None:
         """Controller silent too long: fall back to conservative defaults

@@ -85,10 +85,15 @@ class VTapRegistry:
         # max_concurrent agents hold an in-flight upgrade offer
         self._upgrades: Dict[str, dict] = {}
         self._upgrading: Dict[str, float] = {}   # vtap key -> 1st offer
-        self._upgrade_attempts: Dict[str, int] = {}
+        # vtap key -> [attempt count, last bump ts]: attempts accrue at
+        # most once per upgrade_attempt_interval_s, so a 5s Push poll
+        # and a 60s Sync cadence burn budget at the SAME rate — and a
+        # wedged push-mode agent still reaches quarantine
+        self._upgrade_attempts: Dict[str, list] = {}
         self._upgrade_failed: set = set()        # quarantined vtap keys
         self.upgrade_max_concurrent = 1
         self.upgrade_max_attempts = 5
+        self.upgrade_attempt_interval_s = 60.0
         self._lock = threading.Lock()
         if path is not None and os.path.exists(path):
             self._load()
@@ -129,8 +134,7 @@ class VTapRegistry:
     # -- sync (the agent-facing RPC) ---------------------------------------
     def sync(self, ctrl_ip: str, host: str, revision: str = "",
              boot: bool = False,
-             processes: Optional[list] = None,
-             count_upgrade_attempt: bool = True) -> dict:
+             processes: Optional[list] = None) -> dict:
         """Register-or-refresh; returns the Sync response body
         (reference: trisolaris synchronize service Sync; the GPIDSync
         rpc is folded in via `processes`, and the Upgrade stream's
@@ -165,8 +169,7 @@ class VTapRegistry:
                 resp["gpids"], allocated = self._gpid_sync_locked(
                     vt.vtap_id, processes)
                 dirty = dirty or allocated
-            upgrade = self._upgrade_offer_locked(key, vt,
-                                                 count_upgrade_attempt)
+            upgrade = self._upgrade_offer_locked(key, vt)
             if upgrade is not None:
                 resp["upgrade"] = upgrade
             if dirty:
@@ -285,9 +288,8 @@ class VTapRegistry:
                     "in_flight": sorted(self._upgrading),
                     "failed": sorted(self._upgrade_failed)}
 
-    def _upgrade_offer_locked(self, key: str, vt: VTap,
-                              count_attempt: bool = True
-                              ) -> Optional[dict]:
+    def _upgrade_offer_locked(self, key: str,
+                              vt: VTap) -> Optional[dict]:
         tgt = self._upgrades.get(vt.group)
         if tgt is None or vt.revision == tgt["revision"]:
             # converged (or no target): release any bookkeeping
@@ -308,13 +310,11 @@ class VTapRegistry:
         if key not in self._upgrading and \
                 len(self._upgrading) >= self.upgrade_max_concurrent:
             return None                      # wait: staged, not thundering
-        # a high-frequency poller (the gRPC Push stream, 5s cadence)
-        # re-reads the standing offer without burning the attempt
-        # budget — attempts were calibrated for the 60s sync cadence
-        attempts = self._upgrade_attempts.get(key, 0) + (
-            1 if count_attempt else 0)
-        self._upgrade_attempts[key] = attempts
-        if attempts > self.upgrade_max_attempts:
+        rec = self._upgrade_attempts.setdefault(key, [0, 0.0])
+        if now - rec[1] >= self.upgrade_attempt_interval_s:
+            rec[0] += 1
+            rec[1] = now
+        if rec[0] > self.upgrade_max_attempts:
             # an agent that was offered N times and never converged is
             # broken (bad fetch path, checksum, staging dir): quarantine
             # it and FREE the slot so one sick agent can't stall the

@@ -200,9 +200,15 @@ class ControllerServer:
             data = self.package_bytes(qs.get("name", ""))
             if data is None:
                 raise KeyError(qs.get("name", ""))
+            sha = hashlib.sha256(data).hexdigest()
+            if qs.get("meta"):
+                # metadata-only probe: agents validate their plugin
+                # cache against this without re-downloading the bytes
+                return {"name": qs["name"], "size": len(data),
+                        "sha256": sha}
             return {"name": qs["name"],
                     "data_b64": base64.b64encode(data).decode(),
-                    "sha256": hashlib.sha256(data).hexdigest()}
+                    "sha256": sha}
         if path == "/health":
             return {"status": "ok"}
         raise KeyError(path)

@@ -143,9 +143,10 @@ class SynchronizerService:
         a new one whenever the group config / platform version / an
         upgrade offer moves, until the agent disconnects. Each round
         refreshes the vtap's liveness; restarts are detected from
-        boot_time changes exactly like Sync. The standing upgrade offer
-        is re-read WITHOUT burning attempt budget (5s cadence vs the
-        60s the budget assumes)."""
+        boot_time changes exactly like Sync. Upgrade attempt budget
+        accrues per TIME (registry.upgrade_attempt_interval_s), so the
+        5s poll burns it no faster than the 60s Sync cadence — and a
+        wedged push-mode agent still quarantines."""
         key = (req.ctrl_ip, req.host or req.ctrl_ip)
         boot = self._boot_times.get(key) != req.boot_time
         self._boot_times[key] = req.boot_time
@@ -156,11 +157,13 @@ class SynchronizerService:
                 self.syncs += 1
                 r = self.registry.sync(req.ctrl_ip,
                                        req.host or req.ctrl_ip,
-                                       revision=req.revision, boot=boot,
-                                       count_upgrade_attempt=False)
+                                       revision=req.revision, boot=boot)
                 boot = False
+                upg = r.get("upgrade")
+                # the offered REVISION is part of the change state: a
+                # re-target while an offer stands must push anew
                 state = (r["config_version"], self.platform_version(),
-                         bool(r.get("upgrade")))
+                         upg["revision"] if upg else None)
                 if state != last:
                     last = state
                     yield self._sync_response(req, r)

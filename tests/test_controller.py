@@ -483,6 +483,7 @@ def test_upgrade_failing_agent_quarantined_not_wedging():
     reg.sync("10.0.0.1", "sick", revision="v1")
     reg.sync("10.0.0.2", "ok", revision="v1")
     reg.set_upgrade("default", "v2", "pkg.bin", "cafe")
+    reg.upgrade_attempt_interval_s = 0   # per-call accrual for the test
     # the sick agent grabs the slot and keeps failing
     offers = 0
     for _ in range(reg.upgrade_max_attempts + 1):

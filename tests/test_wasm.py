@@ -474,3 +474,115 @@ def test_plugin_counters(plugin):
     assert c["calls"] == before + 2
     assert c["plugin"] == "Memcached-wasm"
     assert c["mem_pages"] == 1
+
+
+def test_controller_distributed_wasm_plugin(tmp_path):
+    """A pushed `pkg://<name>` plugin entry is FETCHED from the
+    controller's package store, cached, and hot-loaded (the reference's
+    rpc Plugin distribution stream role) — plugins no longer need to
+    pre-exist on the agent host."""
+    import base64
+    import json as _json
+    import urllib.request as _rq
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    agent = None
+    try:
+        ctl = f"http://127.0.0.1:{srv.port}"
+        wasm = build_memcached_wasm()
+        req = _rq.Request(
+            f"{ctl}/v1/upgrade-package",
+            data=_json.dumps({
+                "name": "memcached.wasm",
+                "data_b64": base64.b64encode(wasm).decode()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with _rq.urlopen(req, timeout=5) as r:
+            _json.load(r)
+        reg.set_config("default",
+                       {"wasm_plugins": ["pkg://memcached.wasm"]})
+        agent = Agent(AgentConfig(controller_url=ctl,
+                                  upgrade_dir=str(tmp_path)))
+        assert agent.sync_once()
+        assert len(loaded_wasm_plugins()) == 1
+        assert agent.plugin_fetch_errors == 0
+        cached = tmp_path / "plugins" / "memcached.wasm"
+        assert cached.read_bytes() == wasm
+        # pushing [] unloads the distributed plugin like any other
+        reg.set_config("default", {"wasm_plugins": []})
+        assert agent.sync_once()
+        assert loaded_wasm_plugins() == []
+        # a missing package is counted, never fatal
+        reg.set_config("default", {"wasm_plugins": ["pkg://nope.wasm"]})
+        assert agent.sync_once()
+        assert agent.plugin_fetch_errors == 1
+    finally:
+        if agent is not None:
+            agent.close()
+        srv.close()
+
+
+def test_redistributed_plugin_invalidates_agent_cache(tmp_path):
+    """Re-uploading a package under the same name must reach agents
+    that already cached the old copy (cache validated against the
+    store's sha256 metadata each converge)."""
+    import base64
+    import json as _json
+    import urllib.request as _rq
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    agent = None
+    try:
+        ctl = f"http://127.0.0.1:{srv.port}"
+
+        def upload(data):
+            req = _rq.Request(
+                f"{ctl}/v1/upgrade-package",
+                data=_json.dumps({
+                    "name": "p.wasm",
+                    "data_b64": base64.b64encode(data).decode()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with _rq.urlopen(req, timeout=5) as r:
+                _json.load(r)
+
+        v1 = build_memcached_wasm()
+        upload(v1)
+        reg.set_config("default", {"wasm_plugins": ["pkg://p.wasm"]})
+        agent = Agent(AgentConfig(controller_url=ctl,
+                                  upgrade_dir=str(tmp_path)))
+        assert agent.sync_once()
+        cached = tmp_path / "plugins" / "p.wasm"
+        assert cached.read_bytes() == v1
+        # re-upload a DIFFERENT build under the same name; force a new
+        # config version so the agent re-converges
+        v2 = v1 + b"\x00\x0b\x01\x00"        # padded custom section
+        upload(v2)
+        reg.set_config("default", {"wasm_plugins": ["pkg://p.wasm"],
+                                   "l7_log_rate": 999})
+        assert agent.sync_once()
+        assert cached.read_bytes() == v2      # cache refreshed
+        # empty pkg name is counted, not silently resolved to the dir
+        before = agent.plugin_fetch_errors
+        assert agent._resolve_plugin_path("pkg://") is None
+        assert agent.plugin_fetch_errors == before + 1
+    finally:
+        if agent is not None:
+            agent.close()
+        srv.close()
