@@ -65,6 +65,19 @@ PYEOF
 
   echo "== kernel microbenches (CPU shapes) =="
   python benches/kernel_bench.py --batch 262144 --iters 6
+
+  echo "== headline bench smoke (small shapes, CPU) =="
+  # the scoreboard harness itself is product surface: a regression in
+  # the window/selection/pipelined-decode machinery must fail CI, not
+  # the end-of-round driver run
+  DEEPFLOW_BENCH_SMALL=1 python bench.py > /tmp/bench_smoke.json
+  python - <<'PYEOF'
+import json
+d = json.load(open("/tmp/bench_smoke.json"))
+assert d["value"] > 0 and d["topk_recall_vs_exact"] >= 0.99, d
+assert d["lane_windows"] and d["headline_window"] is not None
+print("bench smoke OK:", d["value"], "rec/s (CPU small)")
+PYEOF
 fi
 
 echo "CI OK"
