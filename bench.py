@@ -101,6 +101,16 @@ def main() -> None:
     def _watchdog():
         if not init_done.wait(300):
             _phase("FATAL: backend init exceeded 300s (tunnel down?)")
+            # an explicit artifact beats an empty file: the driver
+            # records stdout, and a flagged zero is diagnosable where
+            # a bare rc=3 is not (the tunnel was hard-down for 4h+ on
+            # 2026-07-31 — docs/BENCH_NOTES_r4.md has the run log)
+            print(json.dumps({
+                "metric": "l4_e2e_wire_to_sketch_records_per_sec_per_chip",
+                "value": 0, "unit": "records/s", "vs_baseline": 0,
+                "error": "backend init exceeded 300s: TPU tunnel down",
+                "see": "docs/BENCH_NOTES_r4.md",
+            }), flush=True)
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
