@@ -586,3 +586,25 @@ def test_redistributed_plugin_invalidates_agent_cache(tmp_path):
         if agent is not None:
             agent.close()
         srv.close()
+
+
+def test_cached_plugin_trusted_when_controller_unreachable(tmp_path):
+    """Offline tolerance: a cache hit with the controller down loads
+    the cached copy instead of failing the converge."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+
+    wasm = build_memcached_wasm()
+    cache = tmp_path / "plugins"
+    cache.mkdir()
+    (cache / "p.wasm").write_bytes(wasm)
+    agent = Agent(AgentConfig(controller_url="http://127.0.0.1:1",
+                              upgrade_dir=str(tmp_path)))
+    try:
+        got = agent._resolve_plugin_path("pkg://p.wasm")
+        assert got == str(cache / "p.wasm")
+        assert agent.plugin_fetch_errors == 0
+        # no cache + no controller = counted failure, not a raise
+        assert agent._resolve_plugin_path("pkg://absent.wasm") is None
+        assert agent.plugin_fetch_errors == 1
+    finally:
+        agent.close()
