@@ -52,7 +52,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW,
-                                    BPF_JEQ, BPF_JGT, BPF_JNE, BPF_JSLE,
+                                    BPF_JEQ, BPF_JGE, BPF_JGT, BPF_JNE,
+                                    BPF_JSLE,
                                     BPF_MAP_TYPE_HASH,
                                     BPF_MAP_TYPE_PERF_EVENT_ARRAY,
                                     BPF_PROG_TYPE_KPROBE, BPF_W,
@@ -78,7 +79,7 @@ assert struct.calcsize(_RECORD_FMT) == RECORD_SIZE
 # x86_64 pt_regs field offsets
 _PT_DI, _PT_SI, _PT_AX = 112, 104, 80
 # struct user_msghdr / iovec hops
-_MSG_IOV_OFF, _IOV_BASE_OFF = 16, 0
+_MSG_IOV_OFF, _IOV_BASE_OFF, _IOV_LEN_OFF = 16, 0, 8
 
 # stack frame (offsets from R10)
 _REC = -192          # SOCK_DATA record
@@ -87,6 +88,8 @@ _CONFKEY = -208      # u32 conf array index
 _FDSAVE = -216       # stashed fd across helper calls
 _FLAG = -224         # is_msg flag
 _SCRATCH = -232      # pointer-hop scratch
+_IOVPAIR = -264      # first iovec {iov_base, iov_len} read as ONE 16B
+                     # probe_read (-264..-249; -248.. is _TRVAL's 16B)
 _TRVAL = -248        # trace-map value {id, fd} (16B)
 
 
@@ -260,11 +263,26 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.mov_reg(R3, R9).alu_imm(BPF_ADD, R3, _MSG_IOV_OFF)
     a.call(FN_probe_read)
     a.ldx_mem(BPF_DW, R9, R10, _SCRATCH)           # iov*
-    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _SCRATCH)
-    a.mov_imm(R2, 8)
-    a.mov_reg(R3, R9).alu_imm(BPF_ADD, R3, _IOV_BASE_OFF)
+    # whole first iovec {iov_base, iov_len} in ONE 16B probe_read
+    # (advisor r4): a scattered sendmsg whose FIRST iovec is shorter
+    # than the ret-clamped length must not capture adjacent process
+    # memory — clamp the copy to min(ret, iov_len, CAP) like the
+    # reference does
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _IOVPAIR)
+    a.mov_imm(R2, 16)
+    a.mov_reg(R3, R9)
     a.call(FN_probe_read)
-    a.ldx_mem(BPF_DW, R9, R10, _SCRATCH)           # iov_base
+    a.ldx_mem(BPF_DW, R9, R10, _IOVPAIR + _IOV_BASE_OFF)   # iov_base
+    a.ldx_mem(BPF_DW, R1, R10, _IOVPAIR + _IOV_LEN_OFF)    # iov_len
+    # verifier-friendly clamp: the JGT pins R1 <= CAP on fallthrough
+    # (an imm bound the verifier tracks precisely), so the mov leaves
+    # R8 bounded for the copy's size argument
+    a.jmp_imm(BPF_JGT, R1, PAYLOAD_CAP, "iov_ok")
+    a.jmp_reg(BPF_JGE, R1, R8, "iov_ok")
+    a.mov_reg(R8, R1)
+    a.stx_mem(BPF_W, R10, R8, _REC + 44)           # data_len reflects it
+    a.jmp_imm(BPF_JEQ, R8, 0, "emit")              # empty iovec: no copy
+    a.label("iov_ok")
     a.label("copy")
     # bounded payload copy: R8 in (0, PAYLOAD_CAP] by the clamp above
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + 64)

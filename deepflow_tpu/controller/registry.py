@@ -53,6 +53,7 @@ class VTap:
     vtap_id: int
     ctrl_ip: str
     host: str
+    ctrl_mac: str = ""
     group: str = "default"
     created_at: float = field(default_factory=time.time)
     last_seen: float = field(default_factory=time.time)
@@ -134,7 +135,8 @@ class VTapRegistry:
     # -- sync (the agent-facing RPC) ---------------------------------------
     def sync(self, ctrl_ip: str, host: str, revision: str = "",
              boot: bool = False,
-             processes: Optional[list] = None) -> dict:
+             processes: Optional[list] = None,
+             ctrl_mac: str = "") -> dict:
         """Register-or-refresh; returns the Sync response body
         (reference: trisolaris synchronize service Sync; the GPIDSync
         rpc is folded in via `processes`, and the Upgrade stream's
@@ -149,11 +151,19 @@ class VTapRegistry:
                 self._vtaps[key] = vt
             vt.last_seen = time.time()
             vt.revision = revision
+            mac_changed = bool(ctrl_mac) and vt.ctrl_mac != ctrl_mac
+            if mac_changed:
+                # recorded so mac-keyed rpcs (Upgrade carries only
+                # ctrl_ip+ctrl_mac) can disambiguate two hosts that
+                # share a ctrl_ip (NAT / host-network pods); persisted
+                # NOW — a restart before the next dirty event must not
+                # forget it (the mac match would silently fall back)
+                vt.ctrl_mac = ctrl_mac
             if boot:
                 vt.boot_count += 1
             cfg = self._configs.get(vt.group,
                                     self._configs["default"])
-            dirty = registered or boot
+            dirty = registered or boot or mac_changed
             resp = {
                 "vtap_id": vt.vtap_id,
                 "group": vt.group,
@@ -181,9 +191,39 @@ class VTapRegistry:
                           processes: list) -> tuple:
         """(pid -> gprocess_id mapping, any_new_allocations). Keyed
         (vtap, pid, start_time): ids are global across the fleet and
-        stable across agent restarts (persisted)."""
+        stable across agent restarts (persisted).
+
+        start_time == 0 means UNKNOWN (the gRPC GPIDSyncEntry carries
+        no start_time): an unknown-start entry reuses any existing
+        allocation for the same (vtap, pid), and a later concrete
+        start_time ADOPTS a pending 0-key rather than allocating a
+        second id — so the JSON and gRPC control-plane paths can never
+        hand the same live process two different global ids. The cost,
+        documented: a pid reused after process exit keeps its old gpid
+        when only the gRPC path ever sees it."""
         out: Dict[str, int] = {}
         allocated = False
+        # per-(vtap,pid) index for the unknown-start reuse branch —
+        # built LAZILY on the first start==0 entry (the common JSON
+        # path, all-concrete start_times, must not pay an O(fleet
+        # gpids) scan under the registry lock per sync), and kept in
+        # lockstep with _gpids mutations below so a processes list
+        # mixing concrete and unknown entries for one pid can't read
+        # a stale view
+        by_pid: Optional[Dict[int, list]] = None
+
+        def _index() -> Dict[int, list]:
+            nonlocal by_pid
+            if by_pid is None:
+                by_pid = {}
+                prefix = f"{vtap_id}|"
+                for key in self._gpids:
+                    if key.startswith(prefix):
+                        _, pid_s, start_s = key.split("|")
+                        by_pid.setdefault(int(pid_s),
+                                          []).append(int(start_s))
+            return by_pid
+
         for p in processes[:4096]:               # bounded: hostile sync
             try:
                 pid = int(p["pid"])
@@ -192,10 +232,30 @@ class VTapRegistry:
                 continue
             k = f"{vtap_id}|{pid}|{start}"
             g = self._gpids.get(k)
+            if g is None and start == 0:
+                starts = _index().get(pid)
+                if starts:
+                    # unknown start: reuse the newest concrete
+                    # allocation (0 can't be in the index here — the
+                    # direct get(k) above would have found it, and
+                    # adoption removes popped 0-keys from the index)
+                    g = self._gpids[f"{vtap_id}|{pid}|{max(starts)}"]
+            elif g is None and start != 0:
+                k0 = f"{vtap_id}|{pid}|0"
+                g0 = self._gpids.pop(k0, None)
+                if g0 is not None:       # adopt the pending unknown-key
+                    self._gpids[k] = g0
+                    g = g0
+                    allocated = True     # map changed: persist it
+                    if by_pid is not None and pid in by_pid:
+                        by_pid[pid] = [s for s in by_pid[pid] if s != 0]
+                        by_pid[pid].append(start)
             if g is None:
                 g = self._next_gpid
                 self._next_gpid += 1
                 self._gpids[k] = g
+                if by_pid is not None:
+                    by_pid.setdefault(pid, []).append(start)
                 allocated = True
             out[str(pid)] = g
         return out, allocated

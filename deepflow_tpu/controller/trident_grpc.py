@@ -94,7 +94,8 @@ class SynchronizerService:
         boot = self._boot_times.get(key) != req.boot_time
         self._boot_times[key] = req.boot_time
         r = self.registry.sync(req.ctrl_ip, req.host or req.ctrl_ip,
-                               revision=req.revision, boot=boot)
+                               revision=req.revision, boot=boot,
+                               ctrl_mac=req.ctrl_mac)
         return self._sync_response(req, r)
 
     def _sync_response(self, req: "pb.SyncRequest",
@@ -157,7 +158,8 @@ class SynchronizerService:
                 self.syncs += 1
                 r = self.registry.sync(req.ctrl_ip,
                                        req.host or req.ctrl_ip,
-                                       revision=req.revision, boot=boot)
+                                       revision=req.revision, boot=boot,
+                                       ctrl_mac=req.ctrl_mac)
                 boot = False
                 upg = r.get("upgrade")
                 # the offered REVISION is part of the change state: a
@@ -198,8 +200,24 @@ class SynchronizerService:
 
     # -- rpc Upgrade (server-stream) ---------------------------------------
     def Upgrade(self, req: "pb.UpgradeRequest", ctx):
-        vt = next((v for v in self.registry.list()
-                   if v.ctrl_ip == req.ctrl_ip), None)
+        # UpgradeRequest carries only ctrl_ip+ctrl_mac (reference
+        # trident.proto:579) while the registry keys vtaps by
+        # (ctrl_ip, host): disambiguate shared ctrl_ips by the mac the
+        # vtap reported at Sync, falling back to ctrl_ip-only for
+        # agents that never sent one
+        cands = [v for v in self.registry.list()
+                 if v.ctrl_ip == req.ctrl_ip]
+        if req.ctrl_mac:
+            # exact mac match first; else a candidate that never
+            # reported a mac (pre-mac registration) may be it. A
+            # mac-bearing request matching NO candidate while all
+            # candidates carry different recorded macs must FAIL, not
+            # serve an arbitrary host's package
+            vt = (next((v for v in cands
+                        if v.ctrl_mac == req.ctrl_mac), None)
+                  or next((v for v in cands if not v.ctrl_mac), None))
+        else:
+            vt = cands[0] if cands else None
         tgt = self.registry.upgrade_target(vt.group) if vt else None
         data = self.package_bytes(tgt["package"]) if tgt else None
         if data is None:

@@ -145,9 +145,12 @@ METRICS_TABLE = make_metrics_table("vtap_flow_port", VTAP_FLOW_PORT,
 # fails every test and every server start, loudly.
 for _c in METRICS_TABLE.columns:
     _wire_dt = dict(METRIC_SCHEMA.columns).get(_c.name)
-    assert _wire_dt is None or np.dtype(_wire_dt) == _c.dtype, (
-        f"vtap_flow_port.{_c.name}: store dtype {_c.dtype} != wire "
-        f"dtype {np.dtype(_wire_dt)} (METRIC_SCHEMA)")
+    # a real raise, not `assert`: python -O compiles asserts out and
+    # this guard must survive optimized runs (advisor r4)
+    if _wire_dt is not None and np.dtype(_wire_dt) != _c.dtype:
+        raise AssertionError(
+            f"vtap_flow_port.{_c.name}: store dtype {_c.dtype} != wire "
+            f"dtype {np.dtype(_wire_dt)} (METRIC_SCHEMA)")
 
 # the edge-tag (client->server path) table schema: one line, as the
 # tag-code model promises. A generator demonstration for now — the

@@ -626,3 +626,34 @@ def test_election_renewal_cannot_clobber_successor(tmp_path):
     with open(path) as f:
         assert _json.load(f)["holder"] == b.identity
     assert b.try_acquire(now=1011.0)          # B renews fine
+
+
+def test_gpid_grpc_and_json_paths_cannot_diverge(tmp_path):
+    """advisor r4: gpid_batch (gRPC, no start_time on the wire) and the
+    JSON sync path (concrete start_time) must hand one live process ONE
+    global id regardless of which path allocated first."""
+    reg = VTapRegistry(str(tmp_path / "vtaps.json"))
+    vt = reg.sync("10.0.0.1", "n1")["vtap_id"]
+    # gRPC first (unknown start), JSON second (concrete start): adopted
+    g0 = reg.gpid_batch(vt, [4242])[4242]
+    r = reg.sync("10.0.0.1", "n1",
+                 processes=[{"pid": 4242, "start_time": 777}])
+    assert r["gpids"]["4242"] == g0
+    # and the adoption is durable under the concrete key
+    assert reg.gpid_batch(vt, [4242])[4242] == g0
+    # JSON first, gRPC second: reused, not re-allocated
+    r2 = reg.sync("10.0.0.1", "n1",
+                  processes=[{"pid": 5555, "start_time": 888}])
+    assert reg.gpid_batch(vt, [5555])[5555] == r2["gpids"]["5555"]
+
+
+def test_gpid_mixed_concrete_and_unknown_same_pid_one_list(tmp_path):
+    """One processes list carrying BOTH a concrete and an unknown
+    start_time for the same pid (post-adoption index staleness repro)."""
+    reg = VTapRegistry(str(tmp_path / "vtaps.json"))
+    vt = reg.sync("10.0.0.1", "n1")["vtap_id"]
+    g0 = reg.gpid_batch(vt, [4242])[4242]
+    r = reg.sync("10.0.0.1", "n1", processes=[
+        {"pid": 4242, "start_time": 777},
+        {"pid": 4242, "start_time": 0}])
+    assert r["gpids"]["4242"] == g0

@@ -326,3 +326,51 @@ def test_kubernetes_cluster_id_stable_per_ca(tmp_path):
     # persisted across controller restart
     reg2 = VTapRegistry(str(tmp_path / "v.json"))
     assert reg2.cluster_id_for("aaaa") == a
+
+
+def test_upgrade_disambiguates_shared_ctrl_ip_by_mac(bridge):
+    """advisor r4: two hosts behind one ctrl_ip (NAT / host-network
+    pods) must each receive THEIR group's package when the Upgrade rpc
+    (which carries only ctrl_ip+ctrl_mac) resolves the vtap."""
+    reg, packages, call, chan, _ = bridge
+    pkg_a, pkg_b = b"A" * 4096, b"B" * 4096
+    packages["a.bin"], packages["b.bin"] = pkg_a, pkg_b
+    call("Sync", pb.SyncRequest(ctrl_ip="10.7.7.7", host="h-a",
+                                ctrl_mac="aa:aa:aa:aa:aa:aa"),
+         pb.SyncResponse)
+    call("Sync", pb.SyncRequest(ctrl_ip="10.7.7.7", host="h-b",
+                                ctrl_mac="bb:bb:bb:bb:bb:bb"),
+         pb.SyncResponse)
+    reg.set_group("10.7.7.7", "h-b", "grp-b")
+    reg.set_upgrade("grp-b", "v9", "b.bin",
+                    hashlib.sha256(pkg_b).hexdigest())
+    stream = chan.unary_stream(
+        "/trident.Synchronizer/Upgrade",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.UpgradeResponse.FromString)(
+            pb.UpgradeRequest(ctrl_ip="10.7.7.7",
+                              ctrl_mac="bb:bb:bb:bb:bb:bb"), timeout=10)
+    chunks = list(stream)
+    assert all(c.status == pb.SUCCESS for c in chunks)
+    assert b"".join(c.content for c in chunks) == pkg_b
+
+
+def test_upgrade_unmatched_mac_fails_rather_than_wrong_package(bridge):
+    """A mac-bearing Upgrade that matches no candidate — while every
+    candidate carries a DIFFERENT recorded mac — must fail, not serve
+    an arbitrary host's package."""
+    reg, packages, call, chan, _ = bridge
+    packages["a.bin"] = b"A" * 1024
+    call("Sync", pb.SyncRequest(ctrl_ip="10.8.8.8", host="h-a",
+                                ctrl_mac="aa:aa:aa:aa:aa:aa"),
+         pb.SyncResponse)
+    reg.set_upgrade("default", "v9", "a.bin",
+                    hashlib.sha256(packages["a.bin"]).hexdigest())
+    stream = chan.unary_stream(
+        "/trident.Synchronizer/Upgrade",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.UpgradeResponse.FromString)(
+            pb.UpgradeRequest(ctrl_ip="10.8.8.8",
+                              ctrl_mac="cc:cc:cc:cc:cc:cc"), timeout=5)
+    chunks = list(stream)
+    assert len(chunks) == 1 and chunks[0].status == pb.FAILED
