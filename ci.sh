@@ -18,6 +18,16 @@ export PALLAS_AXON_POOL_IPS=   # never claim the TPU tunnel from CI
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
+echo "== kernel capability probes =="
+# verdict r4 #8: every CI log states which datapath mode ran — live
+# kernel attach (PMU visible) or verifier-load + replay (masked)
+python - <<'EOF'
+from deepflow_tpu.agent import bpf, socket_trace, uprobe_trace
+print("bpf(2):", bpf.available())
+print("kprobe attach:", socket_trace.attach_available())
+print("uprobe attach:", uprobe_trace.attach_available())
+EOF
+
 echo "== pytest =="
 python -m pytest tests/ -q
 

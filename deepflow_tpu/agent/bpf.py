@@ -18,11 +18,13 @@ This module is that machinery's clean-room, container-runnable core:
   packets never cross into userspace; per-verdict counters live in a
   BPF array map both kernel and userspace touch.
 
-Kprobe/XDP program types LOAD on this kernel too, but kprobe ATTACH
-needs a kprobe PMU / tracefs (absent in this container) — the
-socket-trace kernel datapath therefore stays fixture-driven
-(agent/ebpf_source.py); this module covers the capture-filter class
-end to end with real kernel execution.
+Kprobe/XDP program types LOAD on this kernel too. Attach capability is
+probed per PMU: the kprobe PMU is masked in this container (the
+socket-trace KERNEL datapath stays fixture-driven there,
+agent/ebpf_source.py), but the UPROBE PMU is exposed — the TLS uprobe
+suite (agent/uprobe_trace.py + agent/perf_ring.py) attaches for real
+and tests/test_attach_live.py exercises program execution in the
+kernel end to end.
 
 Layout note (linux/bpf.h): one insn = u8 opcode, u8 dst:4|src:4,
 s16 off, s32 imm, little-endian; dual-insn LD_IMM64 for map fds.
@@ -68,11 +70,11 @@ BPF_IMM, BPF_ABS, BPF_MEM = 0x00, 0x20, 0x60
 BPF_ATOMIC = 0xc0
 BPF_FETCH = 0x01
 BPF_ADD, BPF_SUB, BPF_AND, BPF_OR = 0x00, 0x10, 0x50, 0x40
-BPF_LSH, BPF_RSH = 0x60, 0x70
+BPF_LSH, BPF_RSH, BPF_ARSH = 0x60, 0x70, 0xc0
 BPF_MOV = 0xb0
 BPF_JA, BPF_JEQ, BPF_JNE, BPF_JGT, BPF_JGE = 0x00, 0x10, 0x50, 0x20, 0x30
 BPF_JLT, BPF_JSET = 0xa0, 0x40
-BPF_JSLE = 0xd0
+BPF_JSGT, BPF_JSLE = 0x60, 0xd0
 BPF_K, BPF_X = 0x00, 0x08
 BPF_EXIT, BPF_CALL = 0x90, 0x80
 # helpers (uapi/linux/bpf.h __BPF_FUNC_MAPPER order)
@@ -227,6 +229,11 @@ class Asm:
     def alu_imm(self, op: int, dst: int, imm: int) -> "Asm":
         self._insns.append(("raw", _insn(BPF_ALU64 | op | BPF_K,
                                          dst, 0, 0, imm)))
+        return self
+
+    def alu_reg(self, op: int, dst: int, src: int) -> "Asm":
+        self._insns.append(("raw", _insn(BPF_ALU64 | op | BPF_X,
+                                         dst, src, 0, 0)))
         return self
 
     def ld_abs(self, size: int, off: int) -> "Asm":

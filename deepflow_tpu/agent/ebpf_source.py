@@ -44,6 +44,61 @@ T_INGRESS = 0
 T_EGRESS = 1
 
 
+class ProcFdResolver:
+    """(pid, fd) -> (ip_src, ip_dst, port_src, port_dst) from /proc:
+    the fd symlink names the socket inode, /proc/<pid>/net/{tcp,udp}
+    maps inodes to 4-tuples (the reference resolves the same via its
+    socket info cache, user/socket.c). IPv4 addresses in those tables
+    are little-endian hex. Lookups are cached per (pid, fd) for
+    `ttl_s` — one kernel record burst must not rescan the proc tables
+    per record."""
+
+    def __init__(self, ttl_s: float = 3.0) -> None:
+        self.ttl_s = ttl_s
+        self._cache: Dict[Tuple[int, int], Tuple[float, object]] = {}
+
+    def __call__(self, pid: int, fd: int):
+        import time as _time
+        now = _time.monotonic()
+        hit = self._cache.get((pid, fd))
+        if hit is not None and now - hit[0] < self.ttl_s:
+            return hit[1]
+        got = self._resolve(pid, fd)
+        if len(self._cache) > 4096:           # bounded under fd churn
+            self._cache.clear()
+        self._cache[(pid, fd)] = (now, got)
+        return got
+
+    @staticmethod
+    def _resolve(pid: int, fd: int):
+        import os
+        try:
+            tgt = os.readlink(f"/proc/{pid}/fd/{fd}")
+        except OSError:
+            return None
+        if not tgt.startswith("socket:["):
+            return None
+        inode = tgt[8:-1]
+        for tbl in ("tcp", "udp"):
+            try:
+                with open(f"/proc/{pid}/net/{tbl}") as f:
+                    lines = f.readlines()[1:]
+            except OSError:
+                continue
+            for ln in lines:
+                parts = ln.split()
+                if len(parts) < 10 or parts[9] != inode:
+                    continue
+                l_ip, _, l_port = parts[1].partition(":")
+                r_ip, _, r_port = parts[2].partition(":")
+                if len(l_ip) != 8:            # IPv6 rows: not handled
+                    continue
+                return (int.from_bytes(bytes.fromhex(l_ip), "little"),
+                        int.from_bytes(bytes.fromhex(r_ip), "little"),
+                        int(l_port, 16), int(r_port, 16))
+        return None
+
+
 @dataclass
 class SyscallRecord:
     """One SK_BPF_DATA-like record (the socket_trace.c output contract,
@@ -70,6 +125,11 @@ class SyscallRecord:
     # kernel record must not park userspace markers nothing consumes
     kernel_trace_id: int = 0
     from_kernel: bool = False
+    # provenance (reference process_data_extra_source): SOURCE_SYSCALL
+    # for plaintext syscalls; the OpenSSL / Go-TLS uprobe sources mean
+    # the payload is DECRYPTED application data captured above the TLS
+    # layer — the l7 row is flagged is_tls downstream
+    source: int = 0
 
 
 @dataclass
@@ -240,6 +300,13 @@ class EbpfTracer:
         # controller-allocated global process id (GPIDSync): what joins
         # this span to the same process seen from other vtaps
         b.gpid_0 = self.gpid_map.get(rec.pid, 0)
+        from deepflow_tpu.agent.socket_trace import TLS_SOURCES
+        if rec.source in TLS_SOURCES:
+            # uprobe-captured plaintext of encrypted traffic: the l7
+            # row carries the TLS bit (flow_log.proto AppProtoLogsData
+            # .flags bit 0 -> columnar is_tls) so queries can tell
+            # decrypted-uprobe spans from plaintext-syscall ones
+            m.flags = m.flags | 1
         return m.SerializeToString()
 
     def seen_processes(self) -> list:

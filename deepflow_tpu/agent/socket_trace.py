@@ -69,6 +69,16 @@ from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW,
 
 T_INGRESS, T_EGRESS = 0, 1
 
+# data provenance, packed into the record direction word's high half
+# (reference: process_data_extra_source, ebpf/kernel/include/common.h:79)
+SOURCE_SYSCALL = 0
+SOURCE_GO_TLS_UPROBE = 1
+SOURCE_GO_HTTP2_UPROBE = 2
+SOURCE_OPENSSL_UPROBE = 3
+SOURCE_IO_EVENT = 4
+TLS_SOURCES = (SOURCE_GO_TLS_UPROBE, SOURCE_GO_HTTP2_UPROBE,
+               SOURCE_OPENSSL_UPROBE)
+
 # -- SOCK_DATA record: the kernel->user wire image -------------------------
 PAYLOAD_CAP = 128
 RECORD_SIZE = 192
@@ -185,6 +195,29 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.jmp("len_ok")
     a.label("clamp").mov_imm(R8, PAYLOAD_CAP)
     a.label("len_ok")
+    emit_record_tail(a, maps, direction, msghdr_check=True)
+    a.label("done")
+    a.exit_imm(0)
+    return a
+
+
+def emit_record_tail(a: Asm, maps, direction: int, source: int = 0,
+                     msghdr_check: bool = False) -> Asm:
+    """The shared SOCK_DATA record build + trace-id discipline + perf
+    emit — the tail every record-producing exit program ends with
+    (syscall kretprobes here; SSL/Go-TLS uprobe exits in
+    agent/uprobe_trace.py, which is why `maps` is duck-typed: anything
+    with .trace/.conf/.events Map attributes).
+
+    Register/stack CONTRACT on entry (the callers' prologues establish
+    it): R6=ctx, R7=pid_tgid, R8=payload length already clamped to
+    (0, PAYLOAD_CAP], R9=user buffer pointer (or user_msghdr* when
+    `msghdr_check` and the _FLAG slot is nonzero), _KEY holds pid_tgid
+    and _FDSAVE the fd. Jumps target the "done" label the CALLER must
+    place before its exit. `source` is the reference's
+    process_data_extra_source (common.h:79): packed into the record's
+    direction word's high half — SOURCE_SYSCALL (0) keeps the word
+    byte-identical to pre-uprobe records."""
     # zero the whole record: the verifier requires every byte a helper
     # reads (perf_event_output) to be initialized, and holes must not
     # leak stale stack to userspace
@@ -250,39 +283,41 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.label("no_seq")
     a.ldx_mem(BPF_DW, R1, R10, _FDSAVE)
     a.stx_mem(BPF_DW, R10, R1, _REC + 32)          # fd
-    a.st_imm(BPF_W, R10, _REC + 40, direction)
+    a.st_imm(BPF_W, R10, _REC + 40,
+             direction | (source << 16))           # dir | source<<16
     a.stx_mem(BPF_W, R10, R8, _REC + 44)           # data_len
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + 48)
     a.mov_imm(R2, 16)
     a.call(FN_get_current_comm)
-    # msghdr shape: two probe_read hops to the first iovec's base
-    a.ldx_mem(BPF_DW, R1, R10, _FLAG)
-    a.jmp_imm(BPF_JEQ, R1, 0, "copy")
-    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _SCRATCH)
-    a.mov_imm(R2, 8)
-    a.mov_reg(R3, R9).alu_imm(BPF_ADD, R3, _MSG_IOV_OFF)
-    a.call(FN_probe_read)
-    a.ldx_mem(BPF_DW, R9, R10, _SCRATCH)           # iov*
-    # whole first iovec {iov_base, iov_len} in ONE 16B probe_read
-    # (advisor r4): a scattered sendmsg whose FIRST iovec is shorter
-    # than the ret-clamped length must not capture adjacent process
-    # memory — clamp the copy to min(ret, iov_len, CAP) like the
-    # reference does
-    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _IOVPAIR)
-    a.mov_imm(R2, 16)
-    a.mov_reg(R3, R9)
-    a.call(FN_probe_read)
-    a.ldx_mem(BPF_DW, R9, R10, _IOVPAIR + _IOV_BASE_OFF)   # iov_base
-    a.ldx_mem(BPF_DW, R1, R10, _IOVPAIR + _IOV_LEN_OFF)    # iov_len
-    # verifier-friendly clamp: the JGT pins R1 <= CAP on fallthrough
-    # (an imm bound the verifier tracks precisely), so the mov leaves
-    # R8 bounded for the copy's size argument
-    a.jmp_imm(BPF_JGT, R1, PAYLOAD_CAP, "iov_ok")
-    a.jmp_reg(BPF_JGE, R1, R8, "iov_ok")
-    a.mov_reg(R8, R1)
-    a.stx_mem(BPF_W, R10, R8, _REC + 44)           # data_len reflects it
-    a.jmp_imm(BPF_JEQ, R8, 0, "emit")              # empty iovec: no copy
-    a.label("iov_ok")
+    if msghdr_check:
+        # msghdr shape: two probe_read hops to the first iovec's base
+        a.ldx_mem(BPF_DW, R1, R10, _FLAG)
+        a.jmp_imm(BPF_JEQ, R1, 0, "copy")
+        a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _SCRATCH)
+        a.mov_imm(R2, 8)
+        a.mov_reg(R3, R9).alu_imm(BPF_ADD, R3, _MSG_IOV_OFF)
+        a.call(FN_probe_read)
+        a.ldx_mem(BPF_DW, R9, R10, _SCRATCH)       # iov*
+        # whole first iovec {iov_base, iov_len} in ONE 16B probe_read
+        # (advisor r4): a scattered sendmsg whose FIRST iovec is
+        # shorter than the ret-clamped length must not capture
+        # adjacent process memory — clamp the copy to
+        # min(ret, iov_len, CAP) like the reference does
+        a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _IOVPAIR)
+        a.mov_imm(R2, 16)
+        a.mov_reg(R3, R9)
+        a.call(FN_probe_read)
+        a.ldx_mem(BPF_DW, R9, R10, _IOVPAIR + _IOV_BASE_OFF)
+        a.ldx_mem(BPF_DW, R1, R10, _IOVPAIR + _IOV_LEN_OFF)
+        # verifier-friendly clamp: the JGT pins R1 <= CAP on
+        # fallthrough (an imm bound the verifier tracks precisely),
+        # so the mov leaves R8 bounded for the copy's size argument
+        a.jmp_imm(BPF_JGT, R1, PAYLOAD_CAP, "iov_ok")
+        a.jmp_reg(BPF_JGE, R1, R8, "iov_ok")
+        a.mov_reg(R8, R1)
+        a.stx_mem(BPF_W, R10, R8, _REC + 44)       # data_len reflects
+        a.jmp_imm(BPF_JEQ, R8, 0, "emit")          # empty iovec
+        a.label("iov_ok")
     a.label("copy")
     # bounded payload copy: R8 in (0, PAYLOAD_CAP] by the clamp above
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + 64)
@@ -299,8 +334,6 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.mov_reg(R4, R10).alu_imm(BPF_ADD, R4, _REC)
     a.mov_imm(R5, RECORD_SIZE)
     a.call(FN_perf_event_output)
-    a.label("done")
-    a.exit_imm(0)
     return a
 
 
@@ -396,16 +429,25 @@ def parse_record(buf: bytes,
     flow tuple is zeros (sessions still pair per pid/fd/direction)."""
     from deepflow_tpu.agent.ebpf_source import SyscallRecord
 
-    (pid_tgid, ts, trace_id, cap_seq, fd, direction, data_len, comm,
+    (pid_tgid, ts, trace_id, cap_seq, fd, dirword, data_len, comm,
      payload) = struct.unpack(_RECORD_FMT, buf[:RECORD_SIZE])
+    direction, source = dirword & 0xFFFF, dirword >> 16
     tgid, tid = pid_tgid >> 32, pid_tgid & 0xFFFFFFFF
     ips = (0, 0, 0, 0)
     if resolver is not None:
         got = resolver(tgid, fd)
         if got is not None:
-            ips = got
+            # resolver convention: (local, remote, lport, rport). The
+            # record convention is ip_src = SENDER of the data, so an
+            # ingress record (remote peer sent it) swaps the tuple —
+            # otherwise every live inbound request exports client and
+            # server reversed
+            if direction == T_INGRESS:
+                ips = (got[1], got[0], got[3], got[2])
+            else:
+                ips = got
     return SyscallRecord(
-        pid=tgid, tid=tid, direction=direction,
+        pid=tgid, tid=tid, direction=direction, source=source,
         timestamp_ns=ts,
         ip_src=ips[0], ip_dst=ips[1], port_src=ips[2], port_dst=ips[3],
         cap_seq=cap_seq,
@@ -418,11 +460,12 @@ def parse_record(buf: bytes,
 
 def pack_record(pid: int, tid: int, direction: int, ts_ns: int,
                 payload: bytes, fd: int = 3, trace_id: int = 0,
-                cap_seq: int = 0, comm: str = "") -> bytes:
+                cap_seq: int = 0, comm: str = "",
+                source: int = SOURCE_SYSCALL) -> bytes:
     """Build a SOCK_DATA record byte-image (tests + fixture replay in
     the kernel wire format — the inverse of parse_record)."""
     return struct.pack(
         _RECORD_FMT, (pid << 32) | tid, ts_ns, trace_id, cap_seq, fd,
-        direction, min(len(payload), PAYLOAD_CAP),
+        direction | (source << 16), min(len(payload), PAYLOAD_CAP),
         comm.encode("latin-1")[:16],
         payload[:PAYLOAD_CAP])

@@ -364,7 +364,9 @@ class Agent:
             # syscall-tracer state machine if one is wired
             from deepflow_tpu.agent import bpf as bpf_mod
             from deepflow_tpu.agent import socket_trace as st_mod
+            from deepflow_tpu.agent import uprobe_trace as up_mod
             attach_ok, attach_why = st_mod.attach_available()
+            up_ok, up_why = up_mod.attach_available()
             out: dict = {"bpf_available": bpf_mod.available(),
                          # CAPABILITY of the in-tree socket_trace
                          # kprobe suite: could programs attach on this
@@ -373,7 +375,14 @@ class Agent:
                          # either way — this flag is the prerequisite,
                          # not the switch.
                          "socket_trace_attach_capable": attach_ok,
-                         "socket_trace_attach_reason": attach_why}
+                         "socket_trace_attach_reason": attach_why,
+                         # TLS uprobe suite: live when the uprobe PMU
+                         # is visible AND enable_tls_uprobes ran
+                         "tls_uprobe_attach_capable": up_ok,
+                         "tls_uprobe_attach_reason": up_why}
+            tls = getattr(self, "tls_uprobes", None)
+            if tls is not None:
+                out["tls_uprobes"] = tls.counters()
             tracer = getattr(self, "ebpf_tracer", None)
             if tracer is not None:
                 out["tracer"] = tracer.counters()
@@ -790,6 +799,51 @@ class Agent:
                         flow, merged, int(pkt["timestamp_ns"][i]),
                         self.vtap_id))
 
+    def enable_tls_uprobes(self, paths: Optional[List[str]] = None,
+                           pids: Optional[List[int]] = None) -> dict:
+        """Live encrypted-traffic capture (reference: the ssl/go
+        tracer lifecycles): load the uprobe suite, attach the given
+        libssl/Go-binary images and/or discover per-pid, and pump
+        captured plaintext records through the EbpfTracer into the
+        normal l7 export every tick. Raises OSError where the uprobe
+        PMU is masked (callers gate on
+        uprobe_trace.attach_available)."""
+        from deepflow_tpu.agent.ebpf_source import (EbpfTracer,
+                                                    ProcFdResolver)
+        from deepflow_tpu.agent.uprobe_trace import (TlsUprobeSource,
+                                                     go_version)
+        if getattr(self, "ebpf_tracer", None) is None:
+            self.ebpf_tracer = EbpfTracer(vtap_id=self.vtap_id)
+            self.ebpf_tracer.gpid_map = self.gpid_map
+        if getattr(self, "tls_uprobes", None) is None:
+            self.tls_uprobes = TlsUprobeSource()
+            self._fd_resolver = ProcFdResolver()
+        src = self.tls_uprobes
+        for p in paths or []:
+            if go_version(p):
+                src.attach_go(p)
+            else:
+                src.attach_ssl(p)
+        for pid in pids or []:
+            src.attach_pid(pid)
+        return src.counters()
+
+    def _pump_tls_uprobes(self) -> int:
+        """Kernel ring -> EbpfTracer -> _l7_out (ships with the next
+        tick's PROTOCOLLOG batch like every other l7 record)."""
+        src = getattr(self, "tls_uprobes", None)
+        if src is None:
+            return 0
+        tracer = self.ebpf_tracer
+
+        def _feed(raw: bytes) -> None:
+            rec = tracer.feed_raw(raw, resolver=self._fd_resolver)
+            if rec:
+                with self._lock:
+                    self._l7_out.append(rec)
+
+        return src.pump(_feed)
+
     def tick(self, now_ns: Optional[int] = None,
              final: bool = False) -> dict:
         """1s flush: flows -> TAGGEDFLOW, documents -> METRICS,
@@ -797,6 +851,7 @@ class Agent:
         packet-sequence collector (shutdown: blocks younger than the
         5s budget must not be dropped)."""
         now_ns = int(time.time() * 1e9) if now_ns is None else now_ns
+        self._pump_tls_uprobes()
         pseq_blocks: List[bytes] = []
         with self._lock:
             # vectorized tick: oriented wire-ready columns, no per-flow
@@ -944,6 +999,10 @@ class Agent:
         for t in self._threads:
             t.join(timeout=2)
         self.tick(final=True)  # final flush incl. young pseq blocks
+        tls = getattr(self, "tls_uprobes", None)
+        if tls is not None:    # detach probes + perf rings + maps
+            tls.close()
+            self.tls_uprobes = None
         if self.debug is not None:
             self.debug.close()
         if self.stats_shipper is not None:

@@ -1,0 +1,221 @@
+"""LIVE kernel datapath: uprobe attach -> in-kernel program execution
+-> perf ring -> EbpfTracer. These tests attach REAL uprobes (via the
+uprobe PMU) to a compiled stand-in libssl and assert the in-tree
+programs capture a real process's plaintext — the full
+openssl_bpf.c-equivalent path with zero fixtures (reference:
+agent/src/ebpf/user/tracer.c attach + socket reader).
+
+Where the PMU or perf paranoia masks the path, tests SKIP LOUDLY with
+the probe's reason (the round-4 verdict's degradation contract);
+ci.sh prints the capability probe so every CI log shows which mode
+ran."""
+
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from deepflow_tpu.agent import bpf, perf_ring, uprobe_trace
+from deepflow_tpu.agent.ebpf_source import EbpfTracer
+from deepflow_tpu.agent.socket_trace import (SOURCE_OPENSSL_UPROBE,
+                                             T_EGRESS, T_INGRESS,
+                                             parse_record)
+
+_cc = shutil.which("gcc") or shutil.which("cc")
+_attach_ok, _attach_why = uprobe_trace.attach_available()
+
+pytestmark = [
+    pytest.mark.skipif(not bpf.available(), reason="bpf(2) unavailable"),
+    pytest.mark.skipif(not _attach_ok,
+                       reason=f"uprobe attach masked: {_attach_why}"),
+    pytest.mark.skipif(_cc is None, reason="no C toolchain"),
+]
+
+
+@pytest.fixture(scope="module")
+def ssl_binaries(tmp_path_factory):
+    d = tmp_path_factory.mktemp("live_ssl")
+    (d / "fakessl.c").write_text(
+        "int SSL_read(void *s, void *b, int n)"
+        "{ return n > 0 ? n : -1; }\n"
+        "int SSL_write(void *s, const void *b, int n){ return n; }\n")
+    (d / "driver.c").write_text(
+        '#include <string.h>\n'
+        '#include <unistd.h>\n'
+        'extern int SSL_write(void*, const void*, int);\n'
+        'extern int SSL_read(void*, void*, int);\n'
+        'int main(void) {\n'
+        '  char req[] = "GET /api/pay HTTP/1.1\\r\\nHost: svc\\r\\n'
+        'Content-Length: 0\\r\\n\\r\\n";\n'
+        '  char resp[] = "HTTP/1.1 200 OK\\r\\n'
+        'Content-Length: 2\\r\\n\\r\\nok";\n'
+        '  for (int i = 0; i < 4; i++) {\n'
+        '    SSL_write((void*)0, req, (int)strlen(req));\n'
+        '    SSL_read((void*)0, resp, (int)strlen(resp));\n'
+        '    usleep(5000);\n'
+        '  }\n'
+        '  return 0;\n'
+        '}\n')
+    so = d / "libfakessl.so"
+    drv = d / "driver"
+    subprocess.run([_cc, "-O2", "-shared", "-fPIC",
+                    str(d / "fakessl.c"), "-o", str(so)], check=True)
+    subprocess.run([_cc, "-O2", str(d / "driver.c"), f"-L{d}",
+                    "-lfakessl", "-o", str(drv),
+                    f"-Wl,-rpath,{d}"], check=True)
+    return str(so), str(drv)
+
+
+@pytest.fixture
+def live(ssl_binaries):
+    so, drv = ssl_binaries
+    suite = uprobe_trace.UprobeSuite()
+    probes = []
+    reader = None
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(suite.maps.events,
+                                               cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        progs = suite.programs()
+        for s in uprobe_trace.plan_ssl(so):
+            probes.append(perf_ring.attach_uprobe(
+                progs[s.role], s.path, s.offset, s.retprobe))
+        yield so, drv, reader
+    finally:
+        for p in probes:
+            p.close()
+        if reader is not None:
+            reader.close()
+        suite.close()
+
+
+def _run_driver(drv: str) -> None:
+    # pin to cpu 0: the reader's ring is on cpu 0 and the kernel
+    # program writes to the CURRENT cpu's ring slot
+    tset = shutil.which("taskset")
+    cmd = [tset, "-c", "0", drv] if tset else [drv]
+    subprocess.run(cmd, check=True, timeout=30)
+    time.sleep(0.2)
+
+
+def test_live_uprobe_captures_plaintext_and_chains_traces(live):
+    """The in-tree SSL programs, attached for real: payloads captured
+    from the traced process's memory, direction/source stamped, and
+    the trace-id discipline run IN KERNEL — each read parks an id the
+    next write consumes."""
+    so, drv, reader = live
+    _run_driver(drv)
+    recs = [parse_record(r) for r in reader.drain()]
+    assert len(recs) >= 8, "expected 4 write+read pairs"
+    writes = [r for r in recs if r.direction == T_EGRESS]
+    reads = [r for r in recs if r.direction == T_INGRESS]
+    assert writes and reads
+    assert all(r.source == SOURCE_OPENSSL_UPROBE for r in recs)
+    assert all(r.payload.startswith(b"GET /api/pay") for r in writes)
+    assert all(r.payload.startswith(b"HTTP/1.1 200") for r in reads)
+    assert all(r.process_kname == "driver" for r in recs)
+    assert all(r.from_kernel for r in recs)
+    # kernel trace chaining: every parked ingress id is consumed by
+    # the FOLLOWING egress (driver loop: write; read; write; read...)
+    read_ids = [r.kernel_trace_id for r in sorted(
+        reads, key=lambda r: r.timestamp_ns)]
+    late_write_ids = [r.kernel_trace_id for r in sorted(
+        writes, key=lambda r: r.timestamp_ns)[1:]]  # first write: none
+    assert read_ids and read_ids == sorted(read_ids)
+    assert late_write_ids == read_ids[:len(late_write_ids)]
+
+
+def test_live_records_merge_into_tls_flagged_l7_rows(live):
+    """Kernel records -> EbpfTracer -> merged l7 wire records with the
+    TLS flag: the whole decrypted-visibility story with no fixture
+    anywhere."""
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    so, drv, reader = live
+    _run_driver(drv)
+    tracer = EbpfTracer(vtap_id=3)
+    resolver = lambda pid, fd: (0x0A00000A, 0x0A000014, 52000, 443)  # noqa
+    merged = []
+    for raw in reader.drain():
+        got = tracer.feed_raw(raw, resolver=resolver)
+        if got:
+            merged.append(got)
+    assert merged, "no sessions merged from live kernel records"
+    for blob in merged:
+        m = flow_log_pb2.AppProtoLogsData.FromString(blob)
+        assert m.flags & 1                      # is_tls
+        assert m.req.req_type == "GET"
+        assert m.resp.status == 200
+        assert m.base.process_kname_0 in ("driver", "")
+
+
+def test_live_probe_detach_stops_the_stream(ssl_binaries):
+    so, drv = ssl_binaries
+    suite = uprobe_trace.UprobeSuite()
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(suite.maps.events,
+                                               cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        progs = suite.programs()
+        probes = [perf_ring.attach_uprobe(
+            progs[s.role], s.path, s.offset, s.retprobe)
+            for s in uprobe_trace.plan_ssl(so)]
+        _run_driver(drv)
+        assert list(reader.drain())
+        for p in probes:
+            p.close()
+        _run_driver(drv)
+        assert list(reader.drain()) == []       # detached = silent
+        reader.close()
+    finally:
+        suite.close()
+
+
+def test_agent_ships_live_tls_rows_to_ingester(ssl_binaries, tmp_path):
+    """The whole product path with a LIVE kernel source: agent
+    enables TLS uprobes -> driver's SSL calls captured in kernel ->
+    tick ships PROTOCOLLOG -> ingester lands l7_flow_log rows with
+    is_tls=1 (reference: the ssl tracer feeding the normal l7
+    export)."""
+    import time as _time
+
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    so, drv = ssl_binaries
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path)))
+    ing.start()
+    agent = None
+    try:
+        agent = Agent(AgentConfig(
+            ingester_addr=f"127.0.0.1:{ing.port}", l7_enabled=True))
+        agent.vtap_id = 77
+        try:
+            got = agent.enable_tls_uprobes(paths=[so])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        assert got["probes_attached"] == 4      # 2 syms x enter+exit
+        _run_driver(drv)
+        sent = agent.tick()
+        assert sent["l7"] >= 1, agent.tls_uprobes.counters()
+        table = ing.store.table("flow_log", "l7_flow_log")
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            ing.flush()
+            if table.row_count():
+                break
+            _time.sleep(0.1)
+        rows = table.scan()
+        assert rows["is_tls"].min() == 1
+        assert rows["vtap_id"].tolist()[0] == 77
+    finally:
+        if agent is not None:
+            agent.close()
+        ing.close()

@@ -1,0 +1,359 @@
+"""TLS-uprobe suite: verifier-loaded OpenSSL/Go-TLS programs, ELF
+offset/RET resolution, Go buildinfo detection, and the tls-flagged
+record path through EbpfTracer (reference:
+agent/src/ebpf/kernel/{openssl_bpf.c,go_tls_bpf.c},
+user/{ssl_tracer.c,go_tracer.c,symbol.c})."""
+
+import os
+import re
+import shutil
+import struct
+import subprocess
+
+import pytest
+
+from deepflow_tpu.agent import bpf, uprobe_trace
+from deepflow_tpu.agent.ebpf_source import EbpfTracer
+from deepflow_tpu.agent.socket_trace import (SOURCE_GO_TLS_UPROBE,
+                                             SOURCE_OPENSSL_UPROBE,
+                                             SOURCE_SYSCALL, T_EGRESS,
+                                             T_INGRESS,
+                                             SocketTraceSuite,
+                                             pack_record, parse_record)
+from deepflow_tpu.agent.x86_decode import (DecodeError, find_ret_offsets,
+                                           insn_len)
+
+_bpf_required = pytest.mark.skipif(not bpf.available(),
+                                   reason="bpf(2) unavailable")
+_cc = shutil.which("gcc") or shutil.which("cc")
+
+
+# -- kernel programs --------------------------------------------------------
+
+@_bpf_required
+def test_all_six_programs_pass_the_verifier():
+    """SSL enter + 2 exits, Go enter + 2 exits — each is kernel-
+    verifier-checked for memory safety at load, not merely
+    assembled."""
+    suite = uprobe_trace.UprobeSuite()
+    try:
+        progs = suite.programs()
+        assert sorted(progs) == ["go_enter", "go_exit_read",
+                                 "go_exit_write", "ssl_enter",
+                                 "ssl_exit_read", "ssl_exit_write"]
+        assert all(p.fd >= 0 for p in progs.values())
+    finally:
+        suite.close()
+
+
+@_bpf_required
+def test_suite_shares_trace_map_with_socket_trace():
+    """Passing the socket_trace maps gives ONE trace-id space: a TLS
+    read must park the id a later plaintext sendmsg consumes."""
+    st = SocketTraceSuite()
+    try:
+        up = uprobe_trace.UprobeSuite(shared=st.maps)
+        try:
+            assert up.maps.trace.fd == st.maps.trace.fd
+            assert up.maps.events.fd == st.maps.events.fd
+            assert up.maps.owns_shared is False
+        finally:
+            up.close()
+        # shared maps survive the uprobe suite's close
+        st.maps.conf.update(0, 7)
+        assert st.maps.conf.lookup(0) == 7
+    finally:
+        st.close()
+
+
+@_bpf_required
+def test_proc_info_map_layout():
+    """The {reg_abi, conn_off, fd_off, sysfd_off} cell the Go programs
+    read at fixed offsets, written through the userspace setter."""
+    maps = uprobe_trace.create_uprobe_maps()
+    try:
+        maps.set_proc_info(4242, reg_abi=True, conn_off=0, fd_off=0,
+                           sysfd_off=16)
+        got = struct.unpack(
+            "<IIII", maps.proc_info.lookup_bytes(struct.pack("<I", 4242)))
+        assert got == (1, 0, 0, 16)
+    finally:
+        maps.close()
+
+
+def test_attach_probe_reports_capability():
+    ok, why = uprobe_trace.attach_available()
+    assert isinstance(ok, bool) and why
+
+
+# -- x86 decoder ------------------------------------------------------------
+
+def test_decoder_simple_sequences():
+    # xor eax,eax ; ret
+    assert find_ret_offsets(bytes.fromhex("31c0c3")) == [2]
+    # mov rax, imm64 (REX.W B8 + 8 bytes) hiding a C3 inside the imm
+    code = bytes.fromhex("48b8c3c3c3c3c3c3c3c3c3")
+    assert find_ret_offsets(code) == [10]
+    # ret imm16 (C2 10 00)
+    assert find_ret_offsets(bytes.fromhex("c21000")) == [0]
+    # rep ret (F3 C3 — the AMD-friendly form compilers emit)
+    assert find_ret_offsets(bytes.fromhex("f3c3")) == [0]
+
+
+def test_decoder_refuses_unknown_rather_than_guessing():
+    with pytest.raises(DecodeError):
+        insn_len(bytes.fromhex("67488b04"), 0)   # 0x67 override
+
+
+@pytest.mark.skipif(_cc is None or shutil.which("objdump") is None,
+                    reason="no C toolchain / objdump")
+def test_decoder_boundaries_match_objdump(tmp_path):
+    """Ground truth: every instruction boundary and RET offset in
+    gcc -O2 output (incl. SSE) must match objdump's disassembly."""
+    src = tmp_path / "t.c"
+    src.write_text(
+        '#include <string.h>\n'
+        '#include <stdint.h>\n'
+        'double f1(double x, int n){ double s=0;'
+        ' for(int i=0;i<n;i++){ s += x*i; if (s>1e9) return s; }'
+        ' return s; }\n'
+        'int f2(const char*a, const char*b){ if(!a) return -1;'
+        ' int r = strcmp(a,b); return r ? r : (int)strlen(a); }\n'
+        'uint64_t f3(uint64_t x){ x ^= x>>33;'
+        ' x *= 0xff51afd7ed558ccdULL; x ^= x>>33; return x; }\n'
+        'void f4(float*d, const float*s, int n){'
+        ' for(int i=0;i<n;i++) d[i] = s[i]*2.0f + 1.0f; }\n')
+    obj = tmp_path / "t.o"
+    subprocess.run([_cc, "-O2", "-c", str(src), "-o", str(obj)],
+                   check=True)
+    out = subprocess.run(["objdump", "-d", str(obj)],
+                         capture_output=True, text=True,
+                         check=True).stdout
+    funcs, cur = {}, None
+    for line in out.splitlines():
+        m = re.match(r"^[0-9a-f]+ <(\w+)>:", line)
+        if m:
+            cur = m.group(1)
+            funcs[cur] = []
+            continue
+        m = re.match(r"^\s+([0-9a-f]+):\t([0-9a-f ]+)\t?(.*)", line)
+        if m and cur:
+            off = int(m.group(1), 16)
+            bs = bytes.fromhex(m.group(2).replace(" ", ""))
+            mn = m.group(3).strip()
+            if not mn and funcs[cur]:      # objdump line-wrapped insn
+                o, b, pm = funcs[cur][-1]
+                funcs[cur][-1] = (o, b + bs, pm)
+            else:
+                funcs[cur].append((off, bs, mn))
+    assert len(funcs) >= 4
+    for name, insns in funcs.items():
+        code = b"".join(b for _, b, _ in insns)
+        base = insns[0][0]
+        i, bounds = 0, []
+        while i < len(code):
+            bounds.append(base + i)
+            i += insn_len(code, i)
+        assert bounds == [off for off, _, _ in insns], name
+        assert [base + o for o in find_ret_offsets(code)] == \
+            [off for off, _, mn in insns if mn.startswith("ret")], name
+
+
+# -- ELF resolution ---------------------------------------------------------
+
+@pytest.mark.skipif(_cc is None, reason="no C toolchain")
+def test_ssl_plan_resolves_symbols_in_a_real_so(tmp_path):
+    """A compiled stand-in libssl: SSL_read/SSL_write resolve to file
+    offsets whose bytes really are those functions (the uprobe attach
+    contract — a wrong offset probes garbage)."""
+    src = tmp_path / "fakessl.c"
+    src.write_text(
+        "int SSL_read(void*s, void*b, int n){ return n > 0 ? n : -1; }\n"
+        "int SSL_write(void*s, const void*b, int n){ return n; }\n"
+        "int SSL_do_handshake(void*s){ return 1; }\n")
+    so = tmp_path / "libssl.so.3"
+    subprocess.run([_cc, "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(so)], check=True)
+    specs = uprobe_trace.plan_ssl(str(so))
+    roles = {(s.symbol, s.role, s.retprobe) for s in specs}
+    assert ("SSL_read", "ssl_enter", False) in roles
+    assert ("SSL_read", "ssl_exit_read", True) in roles
+    assert ("SSL_write", "ssl_enter", False) in roles
+    assert ("SSL_write", "ssl_exit_write", True) in roles
+    data = so.read_bytes()
+    funcs = uprobe_trace.elf_func_table(str(so))
+    for s in specs:
+        _, size = funcs[s.symbol]
+        body = data[s.offset:s.offset + size]
+        # the resolved offset must hold decodable code ending in RET
+        assert find_ret_offsets(body), s.symbol
+
+
+def _synthetic_go_elf(tmp_path, version=b"go1.20.4", func_code=None):
+    """A minimal ET_DYN ELF64 with .text, .go.buildinfo (1.18+ inline
+    layout), .symtab/.strtab carrying the crypto/tls symbols — enough
+    for the Go inspection path without a Go toolchain in the image."""
+    if func_code is None:
+        # xor eax,eax ; jne +2 ; ret ; xor eax,eax ; ret  (two RETs)
+        func_code = bytes.fromhex("31c07502c331c0c3")
+    text = func_code + func_code            # Read then Write
+    bi = (b"\xff Go buildinf:" + bytes([0, 8, 2])  # magic,pad,ptr,flags
+          + b"\0" * 16 + bytes([len(version)]) + version)
+    bi += b"\0" * ((16 - len(bi) % 16) % 16)
+    names = [b"", b"crypto/tls.(*Conn).Read", b"crypto/tls.(*Conn).Write"]
+    strtab = b"\0".join(names) + b"\0"
+    offs, o = [], 0
+    for n in names:
+        offs.append(o)
+        o += len(n) + 1
+    shstr = (b"\0.text\0.go.buildinfo\0.symtab\0.strtab\0.shstrtab\0")
+    # layout: ehdr(64) phdr(56) text buildinfo symtab strtab shstrtab shdrs
+    text_off = 64 + 56
+    bi_off = text_off + len(text)
+    vbase = 0x1000
+    sym_off = bi_off + len(bi)
+    syms = struct.pack("<IBBHQQ", 0, 0, 0, 0, 0, 0)
+    half = len(func_code)
+    for i, (name_off, addr, size) in enumerate(
+            ((offs[1], vbase + text_off, half),
+             (offs[2], vbase + text_off + half, half))):
+        syms += struct.pack("<IBBHQQ", name_off, 0x12, 0, 1, addr, size)
+    str_off = sym_off + len(syms)
+    shstr_off = str_off + len(strtab)
+    shoff = shstr_off + len(shstr)
+    ehdr = struct.pack(
+        "<4sBBBBB7xHHIQQQIHHHHHH", b"\x7fELF", 2, 1, 1, 0, 0,
+        3, 0x3E, 1, 0, 64, shoff, 0, 64, 56, 1, 64, 6, 5)
+    phdr = struct.pack("<IIQQQQQQ", 1, 5, 0, vbase, vbase,
+                       shoff, shoff, 0x1000)
+    def shdr(name, typ, off, size, addr=0, link=0, entsize=0):
+        return struct.pack("<IIQQQQIIQQ", shstr.index(name), typ, 0,
+                           addr, off, size, link, 0, 1, entsize)
+    shdrs = (struct.pack("<IIQQQQIIQQ", 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)
+             + shdr(b".text", 1, text_off, len(text), vbase + text_off)
+             + shdr(b".go.buildinfo", 1, bi_off, len(bi),
+                    vbase + bi_off)
+             + shdr(b".symtab", 2, sym_off, len(syms), link=4,
+                    entsize=24)
+             + shdr(b".strtab", 3, str_off, len(strtab))
+             + shdr(b".shstrtab", 3, shstr_off, len(shstr)))
+    blob = (ehdr + phdr + text + bi + syms + strtab + shstr + shdrs)
+    path = tmp_path / "gosrv"
+    path.write_bytes(blob)
+    return str(path), text_off, half
+
+
+def test_go_plan_on_synthetic_binary(tmp_path):
+    path, text_off, half = _synthetic_go_elf(tmp_path)
+    assert uprobe_trace.go_version(path) == "go1.20.4"
+    plan = uprobe_trace.plan_go(path)
+    assert plan is not None and plan.reg_abi is True
+    by_role: dict = {}
+    for s in plan.specs:
+        by_role.setdefault(s.role, []).append(s.offset)
+    assert by_role["go_enter"] == [text_off, text_off + half]
+    # each body has RETs at +4 and +7
+    assert sorted(by_role["go_exit_read"]) == [text_off + 4,
+                                               text_off + 7]
+    assert sorted(by_role["go_exit_write"]) == [text_off + half + 4,
+                                                text_off + half + 7]
+    assert not plan.undecodable
+
+
+def test_go_plan_undecodable_function_skips_exits(tmp_path):
+    # 0x67-prefixed junk: the decoder must refuse, the plan must keep
+    # the entry probe and record the skip — loss, never a guessed probe
+    path, _, _ = _synthetic_go_elf(
+        tmp_path, func_code=bytes.fromhex("67488b04c3c3c3c3"))
+    plan = uprobe_trace.plan_go(path)
+    assert plan is not None
+    assert sorted(plan.undecodable) == ["crypto/tls.(*Conn).Read",
+                                        "crypto/tls.(*Conn).Write"]
+    assert all(s.role == "go_enter" for s in plan.specs)
+
+
+def test_go_register_abi_thresholds():
+    assert uprobe_trace.go_register_abi("go1.17") is True
+    assert uprobe_trace.go_register_abi("go1.20.4") is True
+    assert uprobe_trace.go_register_abi("go1.16.9") is False
+    assert uprobe_trace.go_register_abi("go1.8") is False
+    assert uprobe_trace.go_register_abi(None) is True
+
+
+# -- record flow: tls source -> is_tls ------------------------------------
+
+def _http(payload_req=True):
+    if payload_req:
+        return (b"GET /api/pay HTTP/1.1\r\nHost: svc\r\n"
+                b"Content-Length: 0\r\n\r\n")
+    return b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+
+
+def test_tls_source_rides_the_record_wire():
+    raw = pack_record(100, 101, T_INGRESS, 1_000, b"x",
+                      source=SOURCE_OPENSSL_UPROBE)
+    rec = parse_record(raw)
+    assert rec.direction == T_INGRESS
+    assert rec.source == SOURCE_OPENSSL_UPROBE
+    # a pre-uprobe record (source 0) is byte-identical to the old wire
+    legacy = pack_record(100, 101, T_INGRESS, 1_000, b"x")
+    assert parse_record(legacy).source == SOURCE_SYSCALL
+
+
+def test_openssl_records_produce_is_tls_l7_rows():
+    """SSL-uprobe records through EbpfTracer merge into l7 records
+    carrying the TLS flag (flow_log.proto AppProtoLogsData.flags bit
+    0) — the decrypted-visibility contract end to end."""
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    tracer = EbpfTracer(vtap_id=7)
+    resolver = lambda pid, fd: (0x0A000001, 0x0A000002, 51000, 443)  # noqa
+    out = []
+    for direction, body, src in (
+            (T_EGRESS, _http(True), SOURCE_OPENSSL_UPROBE),
+            (T_INGRESS, _http(False), SOURCE_OPENSSL_UPROBE)):
+        raw = pack_record(300, 301, direction, 5_000_000, body,
+                          fd=9, source=src)
+        got = tracer.feed_raw(raw, resolver=resolver)
+        if got:
+            out.append(got)
+    assert len(out) == 1
+    m = flow_log_pb2.AppProtoLogsData.FromString(out[0])
+    assert m.flags & 1, "TLS flag missing on the merged l7 record"
+    assert m.req.req_type == "GET"
+    assert m.resp.status == 200
+
+
+def test_plaintext_records_stay_unflagged():
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    tracer = EbpfTracer(vtap_id=7)
+    resolver = lambda pid, fd: (0x0A000001, 0x0A000002, 51000, 80)  # noqa
+    out = []
+    for direction, body in ((T_EGRESS, _http(True)),
+                            (T_INGRESS, _http(False))):
+        raw = pack_record(300, 301, direction, 5_000_000, body, fd=9)
+        got = tracer.feed_raw(raw, resolver=resolver)
+        if got:
+            out.append(got)
+    m = flow_log_pb2.AppProtoLogsData.FromString(out[0])
+    assert m.flags & 1 == 0
+
+
+def test_find_libssl_returns_mapped_library_or_none():
+    # this python process may or may not map libssl; both answers are
+    # valid — the contract is "a mapped path or None", never a raise
+    got = uprobe_trace.find_libssl(os.getpid())
+    assert got is None or ("libssl" in got and os.path.exists(got))
+
+
+def test_decoder_vex_maps():
+    # vzeroupper (VEX2, map 1, NO ModRM): C5 F8 77
+    assert insn_len(bytes.fromhex("c5f877")) == 3
+    # vinsertf128 ymm0,ymm1,xmm0,1 (VEX3 map 3: imm8 ALWAYS):
+    # C4 E3 75 18 C0 01
+    assert insn_len(bytes.fromhex("c4e37518c001")) == 6
+    # vpshufb ymm (VEX3 map 2, no imm): C4 E2 75 00 C0
+    assert insn_len(bytes.fromhex("c4e27500c0")) == 5
+    # a 0F3A-map RET byte inside the imm8 must NOT be a boundary
+    assert find_ret_offsets(bytes.fromhex("c4e37518c0c3c3")) == [6]
