@@ -234,8 +234,13 @@ def build_ssl_exit(maps: UprobeMaps, direction: int) -> Asm:
     a.ld_map_fd(R1, maps.ssl_ctx)                  # consume the stash
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
     a.call(FN_map_delete_elem)
-    # uretprobe fires with the USER pt_regs at return: ax = SSL ret
+    # uretprobe fires with the USER pt_regs at return: ax = SSL ret.
+    # SSL_read/SSL_write return a C int — the 32-bit register write
+    # zero-extends, so -1 arrives as 0x00000000FFFFFFFF; sign-extend
+    # before the signed drop check or every failed/WANT_READ call
+    # would emit a bogus 128-byte garbage record
     a.ldx_mem(BPF_DW, R8, R6, _PT_AX)
+    a.alu_imm(BPF_LSH, R8, 32).alu_imm(BPF_ARSH, R8, 32)
     a.jmp_imm(BPF_JSLE, R8, 0, "done")             # error/WANT_READ
     _clamp_len(a)
     emit_record_tail(a, maps, direction, source=SOURCE_OPENSSL_UPROBE)
@@ -550,8 +555,14 @@ def go_version(path: str) -> Optional[str]:
             v = blob[33:33 + n].decode("utf-8", "replace")
             if v.startswith("go"):
                 return v
-    # pointer-layout buildinfo (go < 1.18) or stripped section: the
-    # runtime always embeds "go1.X.Y" — take the first match
+    # pointer-layout buildinfo (go < 1.18): the runtime always embeds
+    # "go1.X.Y" — but ONLY trust the scan when the binary carries Go
+    # structure (.go.buildinfo / .gopclntab / runtime symbols). A bare
+    # byte match anywhere ('logo1.2' in libssl's docs) would misroute
+    # a C library away from SSL attach with no error anywhere
+    if not ({".go.buildinfo", ".gopclntab"} & set(secs)
+            or ".note.go.buildid" in secs):
+        return None
     import re
     m = re.search(rb"go1\.\d+(\.\d+)?", data)
     return m.group(0).decode() if m else None
@@ -699,18 +710,28 @@ class TlsUprobeSource:
             raise
         self._probes: List[object] = []
         self.targets: List[dict] = []
+        # (kind, realpath) of images already probed: uprobes attach to
+        # the INODE, so two pids mapping one libssl (nginx workers) or
+        # a repeated enable call must not install duplicate probes —
+        # every TLS call would fire both and emit doubled records that
+        # corrupt session pairing downstream
+        self._attached: set = set()
         self.records_pumped = 0
 
     def attach_ssl(self, path: str) -> int:
         """Attach the OpenSSL pair set to a libssl image; returns the
-        probe count (0 = symbols not found)."""
+        probe count (0 = symbols not found or already attached)."""
         from deepflow_tpu.agent import perf_ring
+        key = ("openssl", os.path.realpath(path))
+        if key in self._attached:
+            return 0
         progs = self.suite.programs()
         specs = plan_ssl(path)
         for s in specs:
             self._probes.append(perf_ring.attach_uprobe(
                 progs[s.role], s.path, s.offset, s.retprobe))
         if specs:
+            self._attached.add(key)
             self.targets.append({"kind": "openssl", "path": path,
                                  "probes": len(specs)})
         return len(specs)
@@ -718,11 +739,22 @@ class TlsUprobeSource:
     def attach_go(self, path: str, tgid: Optional[int] = None) -> int:
         """Attach the Go-TLS set to a Go binary and push its ABI/offset
         proc_info (for `tgid`, or every current process running that
-        binary when omitted)."""
+        binary when omitted). An already-probed binary only refreshes
+        proc_info for the new tgid (no duplicate probes)."""
         from deepflow_tpu.agent import perf_ring
+        key = ("go_tls", os.path.realpath(path))
+        if key in self._attached:
+            plan = plan_go(path)
+            if plan is not None and tgid is not None:
+                self.suite.maps.set_proc_info(
+                    tgid, reg_abi=plan.reg_abi, **{
+                        k: GO_DEFAULT_INFO[k]
+                        for k in ("conn_off", "fd_off", "sysfd_off")})
+            return 0
         plan = plan_go(path)
         if plan is None:
             return 0
+        self._attached.add(key)
         progs = self.suite.programs()
         for s in plan.specs:
             self._probes.append(perf_ring.attach_uprobe(

@@ -68,7 +68,7 @@ for _op in (0xE0, 0xE1, 0xE2, 0xE3):
 _NO_MODRM_2B = (set(range(0x80, 0x90))          # Jcc rel32
                 | {0x05, 0x06, 0x07, 0x08, 0x09, 0x0B, 0x0E,
                    0x30, 0x31, 0x32, 0x33, 0x34, 0x35, 0x37,
-                   0x77, 0xA0, 0xA1, 0xA8, 0xA9, 0xAA}
+                   0x77, 0xA0, 0xA1, 0xA2, 0xA8, 0xA9, 0xAA}
                 | set(range(0xC8, 0xD0)))       # BSWAP
 # two-byte opcodes with an imm8 after ModRM
 _IMM8_2B = {0x70, 0x71, 0x72, 0x73, 0xA4, 0xAC, 0xBA, 0xC2, 0xC4,

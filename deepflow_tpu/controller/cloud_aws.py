@@ -221,8 +221,11 @@ class AwsPlatform:
                     iid = _text(inst, "instanceId")
                     epc = ids.get(("vpc", _text(inst, "vpcId")), 0)
                     ip = _text(inst, "privateIpAddress")
-                    add("host", iid, _tag_name(inst, iid),
-                        epc_id=epc, ip=ip,
+                    # EC2 instances are VMs (reference aws.go GetVMs ->
+                    # chost rows, VIF_DEVICE_TYPE_VM), not hypervisor
+                    # hosts — the round-5 model carries both types
+                    add("vm", iid, _tag_name(inst, iid),
+                        epc_id=epc, vpc_id=epc, ip=ip,
                         az=_text(inst, "placement/availabilityZone"),
                         subnet=_text(inst, "subnetId"))
         return out

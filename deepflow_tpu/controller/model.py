@@ -17,9 +17,30 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+# Ordered PARENTS-FIRST: the recorder sorts created lists by this index
+# so a subscriber never sees a child before its parent. The set mirrors
+# the reference's updater fleet (server/controller/recorder/updater/ —
+# region.go, az.go, sub_domain.go, host.go, vm.go, vpc.go, network.go,
+# vrouter.go, routing_table.go, vinterface.go, wan_ip.go, lan_ip.go,
+# floating_ip.go, security_group(+_rule).go, nat_gateway.go,
+# nat_rule.go, nat_vm_connection.go, lb.go, lb_listener.go,
+# lb_target_server.go, lb_vm_connection.go, peer_connection.go, cen.go,
+# rds_instance.go, redis_instance.go, pod_cluster.go, pod_node.go,
+# vm_pod_node_connection.go, pod_namespace.go, pod_ingress(+rule,
+# +rule_backend).go, pod_service(+port).go, pod_group(+port).go,
+# pod_replica_set.go, pod.go, process.go).
 RESOURCE_TYPES = (
-    "region", "az", "host", "vpc", "subnet",
-    "pod_cluster", "pod_node", "pod_ns", "pod_group", "pod", "service",
+    "region", "az", "sub_domain", "host", "vpc", "vm", "subnet",
+    "vrouter", "routing_table", "vinterface", "wan_ip", "lan_ip",
+    "floating_ip", "security_group", "security_group_rule",
+    "nat_gateway", "nat_rule", "nat_vm_connection",
+    "lb", "lb_listener", "lb_target_server", "lb_vm_connection",
+    "peer_connection", "cen", "rds_instance", "redis_instance",
+    "pod_cluster", "pod_node", "vm_pod_node_connection",
+    "pod_ns", "pod_ingress", "pod_ingress_rule",
+    "pod_ingress_rule_backend", "service", "pod_service_port",
+    "pod_group", "pod_group_port", "pod_replica_set", "pod",
+    "process",
 )
 
 
@@ -114,20 +135,41 @@ class ResourceModel:
         recorder/pubsub feeding tagrecorder + resource-event emit)."""
         self._subscribers.append(fn)
 
-    def update_domain(self, domain: str,
-                      snapshot: List[Resource]) -> DomainDiff:
+    def update_domain(self, domain: str, snapshot: List[Resource],
+                      sub_domain_id: Optional[int] = None) -> DomainDiff:
         """Reconcile the full snapshot for one domain (reference:
-        recorder.Refresh diff engines, recorder/updater/)."""
+        recorder.Refresh diff engines, recorder/updater/).
+
+        `sub_domain_id` narrows the reconciliation scope to ONE
+        sub-domain's rows (reference: cloud/sub_domain.go — an attached
+        k8s cluster refreshes independently of its owning cloud
+        domain): only rows carrying that sub_domain_id attr are
+        eligible for deletion, and every snapshot row must carry it —
+        a sub-domain refresh can never delete the parent domain's own
+        resources, and a full-domain refresh (None) owns only the
+        un-scoped rows."""
         for r in snapshot:   # validate before any mutation
             if r.domain != domain:
                 raise ValueError(f"resource {r} not in domain {domain}")
+            # scope symmetry: a sub-domain refresh must carry ITS id on
+            # every row, and a full-domain refresh must carry none — a
+            # scoped row upserted by the full-domain path would be
+            # deletable by NO refresh (each side's deletion scope would
+            # skip it), i.e. an immortal stale resource
+            if r.attr("sub_domain_id", 0) != (sub_domain_id or 0):
+                raise ValueError(
+                    f"resource {(r.type, r.id)} sub_domain scope "
+                    f"mismatch (refresh scope: {sub_domain_id})")
         diff = DomainDiff()
         with self._lock:
             new_keys = {(r.type, r.id) for r in snapshot}
             for key, old in list(self._rows.items()):
-                if old.domain == domain and key not in new_keys:
-                    del self._rows[key]
-                    diff.deleted.append(old)
+                if old.domain != domain or key in new_keys:
+                    continue
+                if old.attr("sub_domain_id", 0) != (sub_domain_id or 0):
+                    continue         # outside this refresh's scope
+                del self._rows[key]
+                diff.deleted.append(old)
             for r in snapshot:
                 old = self._rows.get((r.type, r.id))
                 if old is None:

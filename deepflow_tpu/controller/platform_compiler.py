@@ -69,6 +69,20 @@ def compile_platform_data(model: ResourceModel
             az_id=host.attr("az_id", 0), host_id=host.id,
             l3_device_type=6, l3_device_id=host.id))  # 6 = host
 
+    for vm in model.list(type="vm"):
+        # cloud instances (reference chost: VIF_DEVICE_TYPE_VM = 1,
+        # controller/common/const.go:384) — distinct from hypervisor
+        # hosts; round-5 cloud clients emit EC2/ECS instances as vm
+        ip = _ip_u32(vm.attr("ip"))
+        if ip is None:
+            continue
+        interfaces.append(InterfaceInfo(
+            epc_id=vm.attr("epc_id", vm.attr("vpc_id", 0)), ip=ip,
+            region_id=vm.attr("region_id", 0),
+            az_id=vm.attr("az_id", 0),
+            host_id=vm.attr("host_id", 0),
+            l3_device_type=1, l3_device_id=vm.id))
+
     for sn in model.list(type="subnet"):
         cidr = sn.attr("cidr")
         try:

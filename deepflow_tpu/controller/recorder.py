@@ -33,18 +33,56 @@ from deepflow_tpu.controller.model import (RESOURCE_TYPES, DomainDiff,
                                            Resource, ResourceModel)
 
 # child attr -> parent type links (reference: recorder/updater per-type
-# lcuuid-to-id lookups). 0 / missing attr = no link claimed.
+# lcuuid-to-id lookups — lb.go resolves vpc, lb_listener.go resolves
+# lb, pod_ingress_rule_backend.go resolves rule + ingress, ...).
+# 0 / missing attr = no link claimed (many links are optional in the
+# reference too: a floating ip may not be bound to a vm yet).
 PARENT_LINKS: Dict[str, Tuple[Tuple[str, str], ...]] = {
     "az": (("region_id", "region"),),
     "host": (("az_id", "az"),),
+    "vm": (("host_id", "host"), ("vpc_id", "vpc")),
     "subnet": (("vpc_id", "vpc"),),
+    "vrouter": (("vpc_id", "vpc"),),
+    "routing_table": (("vrouter_id", "vrouter"),),
+    "vinterface": (("subnet_id", "subnet"),),
+    "wan_ip": (("vinterface_id", "vinterface"),),
+    "lan_ip": (("vinterface_id", "vinterface"),),
+    "floating_ip": (("vpc_id", "vpc"), ("vm_id", "vm")),
+    "security_group_rule": (("security_group_id", "security_group"),),
+    "nat_gateway": (("vpc_id", "vpc"),),
+    "nat_rule": (("nat_gateway_id", "nat_gateway"),),
+    "nat_vm_connection": (("nat_gateway_id", "nat_gateway"),
+                          ("vm_id", "vm")),
+    "lb": (("vpc_id", "vpc"),),
+    "lb_listener": (("lb_id", "lb"),),
+    "lb_target_server": (("lb_id", "lb"),
+                         ("lb_listener_id", "lb_listener")),
+    "lb_vm_connection": (("lb_id", "lb"), ("vm_id", "vm")),
+    "rds_instance": (("vpc_id", "vpc"),),
+    "redis_instance": (("vpc_id", "vpc"),),
     "pod_node": (("pod_cluster_id", "pod_cluster"),),
+    "vm_pod_node_connection": (("vm_id", "vm"),
+                               ("pod_node_id", "pod_node")),
     "pod_ns": (("pod_cluster_id", "pod_cluster"),),
+    "pod_ingress": (("pod_ns_id", "pod_ns"),),
+    "pod_ingress_rule": (("pod_ingress_id", "pod_ingress"),),
+    "pod_ingress_rule_backend": (
+        ("pod_ingress_rule_id", "pod_ingress_rule"),),
+    "service": (("vpc_id", "vpc"),),
+    "pod_service_port": (("service_id", "service"),),
     "pod_group": (("pod_ns_id", "pod_ns"),),
+    "pod_group_port": (("pod_group_id", "pod_group"),
+                       ("service_id", "service")),
+    "pod_replica_set": (("pod_group_id", "pod_group"),),
     "pod": (("pod_ns_id", "pod_ns"), ("pod_node_id", "pod_node"),
             ("pod_group_id", "pod_group")),
-    "service": (("vpc_id", "vpc"),),
+    "process": (("pod_id", "pod"), ("vm_id", "vm")),
 }
+
+# every type may additionally claim sub-domain membership (reference:
+# each mysql model carries sub_domain lcuuid; cloud/sub_domain.go owns
+# those rows' lifecycle) — validated like any other parent link
+_SUB_DOMAIN_LINK = ("sub_domain_id", "sub_domain")
 
 _TYPE_ORDER = {t: i for i, t in enumerate(RESOURCE_TYPES)}
 
@@ -118,7 +156,10 @@ class Recorder:
             still, newly = [], []
             for r in accepted:
                 ok = True
-                for attr, parent_type in PARENT_LINKS.get(r.type, ()):
+                links = PARENT_LINKS.get(r.type, ())
+                if r.type != "sub_domain":
+                    links = links + (_SUB_DOMAIN_LINK,)
+                for attr, parent_type in links:
                     pid = r.attr(attr, 0)
                     if pid and (parent_type, pid) not in known:
                         ok = False
@@ -140,15 +181,35 @@ class Recorder:
     def reconcile(self, domain: str, snapshot: List[Resource],
                   now: Optional[float] = None) -> RecorderDiff:
         with self._lock:
-            return self._reconcile_locked(domain, snapshot, now)
+            return self._reconcile_locked(domain, snapshot, now, None)
+
+    def reconcile_sub_domain(self, domain: str, sub_domain_id: int,
+                             snapshot: List[Resource],
+                             now: Optional[float] = None
+                             ) -> RecorderDiff:
+        """Refresh ONE attached k8s cluster inside a cloud domain
+        (reference: cloud/sub_domain.go + the recorder's sub_domain-
+        scoped updaters): deletions are bounded to rows carrying this
+        sub_domain_id, so a sub-domain poller can never erase the
+        owning domain's resources — and vice versa."""
+        for r in snapshot:
+            if r.attr("sub_domain_id", 0) != sub_domain_id:
+                raise ValueError(
+                    f"resource {(r.type, r.id)} does not carry "
+                    f"sub_domain_id={sub_domain_id}")
+        with self._lock:
+            return self._reconcile_locked(domain, snapshot, now,
+                                          sub_domain_id)
 
     def _reconcile_locked(self, domain: str, snapshot: List[Resource],
-                          now: Optional[float]) -> RecorderDiff:
+                          now: Optional[float],
+                          sub_domain_id: Optional[int]) -> RecorderDiff:
         now = time.time() if now is None else now
         accepted, orphaned = self._validate(domain, snapshot)
         self.orphans_total += len(orphaned)
         olds = {(r.type, r.id): r for r in self.model.list(domain=domain)}
-        diff = self.model.update_domain(domain, accepted)
+        diff = self.model.update_domain(domain, accepted,
+                                        sub_domain_id=sub_domain_id)
         out = RecorderDiff(
             created=sorted(diff.created,
                            key=lambda r: (_TYPE_ORDER[r.type], r.id)),

@@ -429,6 +429,30 @@ class ControllerServer:
                 regions=tuple(body.get("regions", ())),
                 api_default_region=body.get("api_default_region",
                                             "us-east-1"), **kw)
+        if kind == "aliyun":
+            # reference domain-config keys (aliyun.go NewAliyun):
+            # secret_id/secret_key + region include list
+            from deepflow_tpu.controller.cloud_aliyun import \
+                AliyunPlatform
+            if not body.get("secret_id") or not body.get("secret_key"):
+                raise ValueError("aliyun platform requires secret_id "
+                                 "and secret_key")
+            kw = {}
+            if body.get("endpoint_template"):
+                import re
+                tmpl = body["endpoint_template"]
+                scheme = urllib.parse.urlparse(tmpl).scheme
+                if scheme not in ("http", "https"):
+                    raise ValueError("endpoint_template must be http(s)")
+                if not re.fullmatch(r"[^{}]*(\{region\}[^{}]*)+", tmpl):
+                    raise ValueError("endpoint_template must contain "
+                                     "{region} and no other braces")
+                kw["endpoint_template"] = tmpl
+            return AliyunPlatform(
+                body["domain"], body["secret_id"], body["secret_key"],
+                regions=tuple(body.get("regions", ())),
+                api_default_region=body.get("api_default_region",
+                                            "cn-hangzhou"), **kw)
         raise ValueError(f"unknown platform kind {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------

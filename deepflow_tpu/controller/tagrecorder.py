@@ -115,6 +115,13 @@ class TagRecorder:
         "pod_node_id": "pod_node", "pod_ns_id": "pod_ns",
         "pod_group_id": "pod_group", "pod_id": "pod",
         "service_id": "service", "l3_epc_id": "vpc",
+        # round-5 model widening (reference: tagrecorder's ch_lb /
+        # ch_chost / ch_gprocess / ch_pod_ingress dimension tables)
+        "gprocess_id": "process", "chost_id": "vm", "vm_id": "vm",
+        "lb_id": "lb", "lb_listener_id": "lb_listener",
+        "natgw_id": "nat_gateway", "nat_gateway_id": "nat_gateway",
+        "pod_ingress_id": "pod_ingress",
+        "pod_service_id": "service",
     }
 
     def dict_for_column(self, column: str) -> Optional[IdNameDict]:

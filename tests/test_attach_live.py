@@ -51,9 +51,14 @@ def ssl_binaries(tmp_path_factory):
         'Content-Length: 0\\r\\n\\r\\n";\n'
         '  char resp[] = "HTTP/1.1 200 OK\\r\\n'
         'Content-Length: 2\\r\\n\\r\\nok";\n'
+        '  char junk[] = "JUNKJUNKJUNK";\n'
         '  for (int i = 0; i < 4; i++) {\n'
         '    SSL_write((void*)0, req, (int)strlen(req));\n'
         '    SSL_read((void*)0, resp, (int)strlen(resp));\n'
+        '    /* failing calls (ret < 0, arrives zero-extended in RAX):'
+        ' must emit NO record */\n'
+        '    SSL_write((void*)0, junk, -3);\n'
+        '    SSL_read((void*)0, junk, 0);\n'
         '    usleep(5000);\n'
         '  }\n'
         '  return 0;\n'
@@ -115,8 +120,12 @@ def test_live_uprobe_captures_plaintext_and_chains_traces(live):
     reads = [r for r in recs if r.direction == T_INGRESS]
     assert writes and reads
     assert all(r.source == SOURCE_OPENSSL_UPROBE for r in recs)
+    # the driver's FAILING calls (ret -1 / 0, i.e. zero-extended
+    # negatives in RAX) must have produced no record: any 'JUNK'
+    # payload here means the sign-extension drop check regressed
     assert all(r.payload.startswith(b"GET /api/pay") for r in writes)
     assert all(r.payload.startswith(b"HTTP/1.1 200") for r in reads)
+    assert not any(b"JUNK" in r.payload for r in recs)
     assert all(r.process_kname == "driver" for r in recs)
     assert all(r.from_kernel for r in recs)
     # kernel trace chaining: every parked ingress id is consumed by
@@ -202,6 +211,10 @@ def test_agent_ships_live_tls_rows_to_ingester(ssl_binaries, tmp_path):
         except OSError as e:
             pytest.skip(f"perf ring refused: {e}")
         assert got["probes_attached"] == 4      # 2 syms x enter+exit
+        # idempotent: re-enabling the same image must not double-probe
+        # (doubled records would corrupt session pairing)
+        assert agent.enable_tls_uprobes(
+            paths=[so])["probes_attached"] == 4
         _run_driver(drv)
         sent = agent.tick()
         assert sent["l7"] >= 1, agent.tls_uprobes.counters()

@@ -181,7 +181,7 @@ def _platform(srv, **kw):
         **kw)
 
 
-def test_gather_normalizes_regions_vpcs_subnets_hosts(recorder):
+def test_gather_normalizes_regions_vpcs_subnets_vms(recorder):
     p = _platform(recorder, regions=("us-east-1", "eu-west-1"))
     p.check_auth()
     rows = p.get_cloud_data()
@@ -193,14 +193,14 @@ def test_gather_normalizes_regions_vpcs_subnets_hosts(recorder):
     assert sorted(r.name for r in by["vpc"]) == ["prod-eu-west-1",
                                                  "prod-us-east-1"]
     # pagination: BOTH instance pages landed, per region
-    assert sorted(r.name for r in by["host"]) == [
+    assert sorted(r.name for r in by["vm"]) == [
         "i-eu-west-1b", "i-us-east-1b", "web-eu-west-1", "web-us-east-1"]
     # epc (vpc) links resolve to the allocated vpc row ids
     vpc_ids = {r.name: r.id for r in by["vpc"]}
-    host_attrs = {r.name: dict(r.attrs) for r in by["host"]}
-    assert host_attrs["web-us-east-1"]["epc_id"] == \
+    vm_attrs = {r.name: dict(r.attrs) for r in by["vm"]}
+    assert vm_attrs["web-us-east-1"]["epc_id"] == \
         vpc_ids["prod-us-east-1"]
-    assert host_attrs["web-us-east-1"]["ip"] == "10.1.1.10"
+    assert vm_attrs["web-us-east-1"]["ip"] == "10.1.1.10"
     subnet_attrs = {r.name: dict(r.attrs) for r in by["subnet"]}
     assert subnet_attrs["subnet-us-east-11"]["epc_id"] == \
         vpc_ids["prod-us-east-1"]
@@ -257,10 +257,10 @@ def test_controller_drives_aws_domain(recorder, tmp_path):
         assert out["ok"] is True
         assert out["resource_count"] >= 6
         with urllib.request.urlopen(
-                f"http://127.0.0.1:{srv.port}/v1/resources?type=host",
+                f"http://127.0.0.1:{srv.port}/v1/resources?type=vm",
                 timeout=5) as r:
-            hosts = json.load(r)
-        names = {h["name"] for h in hosts}
+            vms = json.load(r)
+        names = {h["name"] for h in vms}
         assert {"web-us-east-1", "i-us-east-1b"} <= names
     finally:
         srv.close()

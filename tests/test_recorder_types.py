@@ -1,0 +1,256 @@
+"""Per-type recorder diff tests for the round-5 model widening: every
+new resource family gets create/update/delete/orphan coverage, plus
+sub_domain-scoped reconciliation (reference: the 46-file
+server/controller/recorder/db test suite + cloud/sub_domain.go)."""
+
+import pytest
+
+from deepflow_tpu.controller.model import (RESOURCE_TYPES, ResourceModel,
+                                           make_resource)
+from deepflow_tpu.controller.recorder import PARENT_LINKS, Recorder
+
+D = "cloud-1"
+
+
+def _mk(model=None):
+    return Recorder(model or ResourceModel())
+
+
+# (child type, parent chain bottom-up as (type, id, extra attrs))
+# exercising every NEW family's full link path
+FAMILIES = {
+    "lb_target_server": [
+        ("vpc", 10, {}),
+        ("lb", 20, {"vpc_id": 10}),
+        ("lb_listener", 30, {"lb_id": 20}),
+        ("lb_target_server", 40, {"lb_id": 20, "lb_listener_id": 30}),
+    ],
+    "lb_vm_connection": [
+        ("vpc", 10, {}),
+        ("vm", 11, {"vpc_id": 10}),
+        ("lb", 20, {"vpc_id": 10}),
+        ("lb_vm_connection", 41, {"lb_id": 20, "vm_id": 11}),
+    ],
+    "nat_rule": [
+        ("vpc", 10, {}),
+        ("nat_gateway", 50, {"vpc_id": 10}),
+        ("nat_rule", 51, {"nat_gateway_id": 50}),
+    ],
+    "nat_vm_connection": [
+        ("vpc", 10, {}),
+        ("vm", 11, {"vpc_id": 10}),
+        ("nat_gateway", 50, {"vpc_id": 10}),
+        ("nat_vm_connection", 52, {"nat_gateway_id": 50, "vm_id": 11}),
+    ],
+    "floating_ip": [
+        ("vpc", 10, {}),
+        ("vm", 11, {"vpc_id": 10}),
+        ("floating_ip", 60, {"vpc_id": 10, "vm_id": 11,
+                             "ip": "1.2.3.4"}),
+    ],
+    "pod_ingress_rule_backend": [
+        ("pod_cluster", 70, {}),
+        ("pod_ns", 71, {"pod_cluster_id": 70}),
+        ("pod_ingress", 72, {"pod_ns_id": 71}),
+        ("pod_ingress_rule", 73, {"pod_ingress_id": 72}),
+        ("pod_ingress_rule_backend", 74, {"pod_ingress_rule_id": 73,
+                                          "port": 8080}),
+    ],
+    "pod_service_port": [
+        ("vpc", 10, {}),
+        ("service", 80, {"vpc_id": 10}),
+        ("pod_service_port", 81, {"service_id": 80, "port": 443,
+                                  "protocol": "TCP"}),
+    ],
+    "pod_group_port": [
+        ("vpc", 10, {}),
+        ("pod_cluster", 70, {}),
+        ("pod_ns", 71, {"pod_cluster_id": 70}),
+        ("pod_group", 75, {"pod_ns_id": 71}),
+        ("service", 80, {"vpc_id": 10}),
+        ("pod_group_port", 82, {"pod_group_id": 75, "service_id": 80,
+                                "port": 8443}),
+    ],
+    "pod_replica_set": [
+        ("pod_cluster", 70, {}),
+        ("pod_ns", 71, {"pod_cluster_id": 70}),
+        ("pod_group", 75, {"pod_ns_id": 71}),
+        ("pod_replica_set", 76, {"pod_group_id": 75}),
+    ],
+    "vm_pod_node_connection": [
+        ("vpc", 10, {}),
+        ("vm", 11, {"vpc_id": 10}),
+        ("pod_cluster", 70, {}),
+        ("pod_node", 77, {"pod_cluster_id": 70}),
+        ("vm_pod_node_connection", 78, {"vm_id": 11,
+                                        "pod_node_id": 77}),
+    ],
+    "process": [
+        ("pod_cluster", 70, {}),
+        ("pod_ns", 71, {"pod_cluster_id": 70}),
+        ("pod", 79, {"pod_ns_id": 71}),
+        ("process", 90, {"pod_id": 79, "pid": 1234,
+                         "process_name": "nginx"}),
+    ],
+    "routing_table": [
+        ("vpc", 10, {}),
+        ("vrouter", 91, {"vpc_id": 10}),
+        ("routing_table", 92, {"vrouter_id": 91}),
+    ],
+    "security_group_rule": [
+        ("security_group", 93, {}),
+        ("security_group_rule", 94, {"security_group_id": 93}),
+    ],
+    "wan_ip": [
+        ("vpc", 10, {}),
+        ("subnet", 95, {"vpc_id": 10}),
+        ("vinterface", 96, {"subnet_id": 95}),
+        ("wan_ip", 97, {"vinterface_id": 96, "ip": "5.6.7.8"}),
+    ],
+    "rds_instance": [
+        ("vpc", 10, {}),
+        ("rds_instance", 98, {"vpc_id": 10, "engine": "mysql"}),
+    ],
+    "redis_instance": [
+        ("vpc", 10, {}),
+        ("redis_instance", 99, {"vpc_id": 10}),
+    ],
+}
+
+
+def _rows(chain):
+    return [make_resource(t, i, f"{t}-{i}", domain=D, **extra)
+            for t, i, extra in chain]
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_family_create_update_delete(family):
+    rec = _mk()
+    chain = FAMILIES[family]
+    rows = _rows(chain)
+    # create: full chain lands, parents first in the created order
+    d = rec.reconcile(D, rows)
+    assert not d.orphaned
+    created_types = [r.type for r in d.created]
+    assert created_types[-1] == family
+    order = {t: i for i, t in enumerate(RESOURCE_TYPES)}
+    assert created_types == sorted(created_types, key=lambda t: order[t])
+    # update: rename the leaf -> exactly one field change
+    leaf_t, leaf_id, extra = chain[-1]
+    renamed = rows[:-1] + [make_resource(leaf_t, leaf_id, "renamed",
+                                         domain=D, **extra)]
+    d = rec.reconcile(D, renamed)
+    changes = [(c.field, c.new) for c in d.field_changes]
+    assert ("name", "renamed") in changes and len(changes) == 1
+    # delete: drop the leaf -> deleted + tombstoned
+    d = rec.reconcile(D, rows[:-1])
+    assert [r.id for r in d.deleted] == [leaf_id]
+    assert any(r.id == leaf_id for r in rec.deleted_resources())
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_family_orphan_quarantine(family):
+    """A NEW leaf whose direct parent is absent quarantines (never
+    half-lands); pre-existing rows hold last-good instead."""
+    rec = _mk()
+    chain = FAMILIES[family]
+    rows = _rows(chain)
+    parent_ids = {i for t, i, _ in chain[:-1]}
+    if not parent_ids:
+        pytest.skip("family has no parent")
+    d = rec.reconcile(D, [rows[-1]])          # leaf without its chain
+    assert [r.type for r in d.orphaned] == [family]
+    assert rec.model.get(chain[-1][0], chain[-1][1]) is None
+
+
+def test_every_new_type_is_modeled_and_linked():
+    """The verdict's breadth bar: >= 25 types, and every non-root type
+    in PARENT_LINKS resolves to modeled parent types."""
+    assert len(RESOURCE_TYPES) >= 25
+    for child, links in PARENT_LINKS.items():
+        assert child in RESOURCE_TYPES
+        for _attr, parent in links:
+            assert parent in RESOURCE_TYPES
+
+
+def test_sub_domain_scoped_reconcile_cannot_touch_domain_rows():
+    """cloud/sub_domain.go discipline: the k8s sub-domain refresh owns
+    ONLY rows carrying its sub_domain_id; the domain refresh owns only
+    un-scoped rows."""
+    rec = _mk()
+    base = [make_resource("region", 1, "r", domain=D),
+            make_resource("sub_domain", 5, "k8s-a", domain=D),
+            make_resource("vpc", 10, "v", domain=D)]
+    rec.reconcile(D, base)
+    sd_rows = [make_resource("pod_cluster", 100, "c", domain=D,
+                             sub_domain_id=5),
+               make_resource("pod_ns", 101, "ns", domain=D,
+                             sub_domain_id=5, pod_cluster_id=100)]
+    d = rec.reconcile_sub_domain(D, 5, sd_rows)
+    assert len(d.created) == 2
+    # an empty sub-domain refresh deletes ITS rows only
+    d = rec.reconcile_sub_domain(D, 5, [])
+    assert sorted(r.id for r in d.deleted) == [100, 101]
+    assert rec.model.get("vpc", 10) is not None
+    assert rec.model.get("region", 1) is not None
+    # ...and a full-domain refresh never deletes sub-domain rows
+    rec.reconcile_sub_domain(D, 5, sd_rows)
+    d = rec.reconcile(D, base)
+    assert not d.deleted
+    assert rec.model.get("pod_cluster", 100) is not None
+
+
+def test_sub_domain_membership_is_validated_like_a_link():
+    """A row claiming a sub_domain_id that exists nowhere quarantines
+    — membership is a parent link, not a free-form tag."""
+    rec = _mk()
+    rec.reconcile(D, [make_resource("pod_cluster", 70, "c", domain=D)])
+    d = rec.reconcile(D, [
+        make_resource("pod_cluster", 70, "c", domain=D),
+        make_resource("pod_node", 71, "n", domain=D,
+                      pod_cluster_id=70, sub_domain_id=999)])
+    assert [r.id for r in d.orphaned] == [71]
+
+
+def test_sub_domain_refresh_rejects_foreign_rows():
+    rec = _mk()
+    rec.reconcile(D, [make_resource("sub_domain", 5, "k8s", domain=D)])
+    with pytest.raises(ValueError):
+        rec.reconcile_sub_domain(D, 5, [
+            make_resource("pod_cluster", 100, "c", domain=D)])  # no attr
+
+
+def test_tagrecorder_covers_new_dimensions(tmp_path):
+    from deepflow_tpu.controller.tagrecorder import TagRecorder
+
+    model = ResourceModel()
+    tr = TagRecorder(model, root=str(tmp_path))
+    rec = Recorder(model)
+    rec.reconcile(D, _rows(FAMILIES["lb_target_server"])
+                  + _rows(FAMILIES["process"]))
+    assert tr.name("lb", 20) == "lb-20"
+    assert tr.column_name("lb_id", 20) == "lb-20"
+    assert tr.column_name("gprocess_id_0", 90) == "process-90"
+    assert tr.column_name("vm_id_1", 11) is None   # not created here
+
+
+def test_full_domain_refresh_rejects_scoped_rows():
+    """Scope symmetry: a sub_domain-carrying row upserted by the
+    full-domain path would be deletable by NO refresh (an immortal
+    stale resource) — it must fail whole instead."""
+    rec = _mk()
+    rec.reconcile(D, [make_resource("sub_domain", 5, "k8s", domain=D)])
+    with pytest.raises(ValueError):
+        rec.model.update_domain(D, [
+            make_resource("sub_domain", 5, "k8s", domain=D),
+            make_resource("pod_cluster", 100, "c", domain=D,
+                          sub_domain_id=5)])
+
+
+def test_created_order_is_parents_first_for_vm():
+    """vm links vpc (and host); RESOURCE_TYPES must order both parents
+    before it, or subscribers see the child first."""
+    idx = {t: i for i, t in enumerate(RESOURCE_TYPES)}
+    for child, links in PARENT_LINKS.items():
+        for _attr, parent in links:
+            assert idx[parent] < idx[child], (parent, child)
