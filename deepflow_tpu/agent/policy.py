@@ -39,6 +39,64 @@ class AclRule:
     port_max: int = 0           # either src or dst port in range
     protocol: int = 0
     action: int = ACTION_NPB
+    # DIRECTIONAL port constraints (reference FlowAcl src_ports /
+    # dst_ports are independent predicates ANDed together); 0 max =
+    # that side unconstrained. Distinct from port_min/max, which
+    # matches either side (the pre-push rule shape).
+    src_port_min: int = 0
+    src_port_max: int = 0
+    dst_port_min: int = 0
+    dst_port_max: int = 0
+
+
+def rules_from_flow_acls(acls: Sequence[dict]) -> List[AclRule]:
+    """Controller-pushed FlowAcl dicts -> AclRules (reference:
+    trident.proto `message FlowAcl` + the agent's policy compile,
+    agent/src/policy/labeler.rs). Each acl carries port-range STRINGS
+    ("80-90,443") and npb_actions; every range expands to one AclRule
+    (the labeler matches ranges, not lists) and the first npb action's
+    tunnel type picks the enforcement action: PCAP -> capture,
+    NPB_DROP -> drop, VXLAN/GRE -> forward. Malformed entries are
+    skipped, not raised: one bad pushed acl must not reject the whole
+    policy set (the reference logs-and-continues too)."""
+    def _ranges(spec: object) -> List[tuple]:
+        out: List[tuple] = []
+        for part in str(spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            lo, _, hi = part.partition("-")
+            out.append((int(lo), int(hi or lo)))
+        return out or [(0, 0)]                       # wildcard side
+
+    out: List[AclRule] = []
+    for acl in acls or ():
+        try:
+            rule_id = int(acl.get("id", 0))
+            if not rule_id:
+                continue
+            protocol = int(acl.get("protocol", 256))
+            if protocol >= 256:                      # 256 = any
+                protocol = 0
+            actions = acl.get("npb_actions") or ()
+            tunnel = (actions[0].get("tunnel_type", 0)
+                      if actions else 0)
+            action = {2: ACTION_PCAP, 3: ACTION_DROP}.get(
+                int(tunnel), ACTION_NPB)
+            # src_ports and dst_ports are INDEPENDENT predicates ANDed
+            # together (the reference semantics) — the cross product
+            # of their range lists expands into rules, each carrying
+            # both directional constraints
+            for s_lo, s_hi in _ranges(acl.get("src_ports")):
+                for d_lo, d_hi in _ranges(acl.get("dst_ports")):
+                    out.append(AclRule(
+                        rule_id=rule_id, protocol=protocol,
+                        action=action,
+                        src_port_min=s_lo, src_port_max=s_hi,
+                        dst_port_min=d_lo, dst_port_max=d_hi))
+        except (TypeError, ValueError, KeyError, IndexError):
+            continue
+    return out
 
 
 class PolicyLabeler:
@@ -81,6 +139,12 @@ class PolicyLabeler:
                       & (cols["port_src"] <= r.port_max)) | \
                      ((cols["port_dst"] >= r.port_min)
                       & (cols["port_dst"] <= r.port_max))
+            if r.src_port_max:
+                m &= ((cols["port_src"] >= r.src_port_min)
+                      & (cols["port_src"] <= r.src_port_max))
+            if r.dst_port_max:
+                m &= ((cols["port_dst"] >= r.dst_port_min)
+                      & (cols["port_dst"] <= r.dst_port_max))
             if r.protocol:
                 m &= cols["proto"] == r.protocol
             out[m] = r.rule_id

@@ -615,6 +615,15 @@ class Agent:
                 span_types=cfg.get("http_log_span_id"),
                 x_request_id=cfg.get("http_log_x_request_id"),
                 proxy_client=cfg.get("http_log_proxy_client"))
+        # pushed policy (reference: FlowAcl push -> policy compile):
+        # absent/None = unmanaged; a LIST is authoritative (pushing []
+        # must clear the rule set). Versioned like the reference's
+        # version_acls so an unchanged push is a no-op.
+        if cfg.get("flow_acls") is not None:
+            from deepflow_tpu.agent.policy import rules_from_flow_acls
+            self.policy.update(rules_from_flow_acls(cfg["flow_acls"]),
+                               int(cfg.get("acl_version", 0) or 0)
+                               or self.policy.version + 1)
         # absent or None = plugins not managed by this push; a LIST is
         # authoritative (pushing [] must actually stop a plugin)
         if cfg.get("so_plugins") is not None:

@@ -45,6 +45,36 @@ DEFAULT_CONFIG = {
     "http_log_span_id": None,
     "http_log_x_request_id": None,
     "http_log_proxy_client": None,
+    # round-5 Config widening (reference trident.proto:185-289):
+    # capture surface + resource limits + l7 sizes. None = unmanaged
+    # (the agent keeps its own default; the gRPC bridge leaves the
+    # proto2 default in place)
+    "tap_interface_regex": None,
+    "extra_netns_regex": None,
+    "tap_mode": None,              # 0 LOCAL / 1 MIRROR / 2 ANALYZER
+    "mtu": None,
+    "output_vlan": None,
+    "max_npb_bps": None,
+    "capture_packet_size": None,
+    "l7_log_packet_size": None,
+    "log_level": None,
+    "thread_threshold": None,
+    "process_threshold": None,
+    "log_retention_days": None,
+    "ntp_enabled": None,
+    "platform_enabled": None,
+    "kubernetes_api_enabled": None,
+    "l4_performance_enabled": None,
+    "l7_metrics_enabled": None,
+    "region_id": None,
+    "epc_id": None,
+    "pod_cluster_id": None,
+    # pushed policy (reference FlowAcl push): list of FlowAcl dicts
+    # {id, tap_type, protocol, src_ports, dst_ports, npb_actions:
+    # [{tunnel_type, tunnel_id, tunnel_ip, payload_slice}]} + a
+    # monotonic version; None = policy not managed by this group
+    "flow_acls": None,
+    "acl_version": 0,
 }
 
 
@@ -429,9 +459,55 @@ class VTapRegistry:
                         and all(isinstance(s, str) for s in v))):
                 raise ValueError(f"{key} must be a string, a list of "
                                  f"strings, or null")
+        # round-5 knobs: same boundary discipline — a bad type/value
+        # would raise inside the gRPC bridge's proto mapping on EVERY
+        # Sync/Push for the group (agents then get an RPC error instead
+        # of any config at all)
+        for key in ("mtu", "output_vlan", "max_npb_bps",
+                    "capture_packet_size", "l7_log_packet_size",
+                    "log_threshold", "thread_threshold",
+                    "process_threshold", "log_retention_days",
+                    "region_id", "epc_id", "pod_cluster_id",
+                    "acl_version"):
+            v = config.get(key)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, int) or v < 0):
+                raise ValueError(f"{key} must be a non-negative "
+                                 f"integer or null")
+        for key in ("ntp_enabled", "platform_enabled",
+                    "kubernetes_api_enabled", "l4_performance_enabled",
+                    "l7_metrics_enabled"):
+            v = config.get(key)
+            if v is not None and not isinstance(v, bool):
+                raise ValueError(f"{key} must be a boolean or null")
+        for key in ("tap_interface_regex", "extra_netns_regex",
+                    "log_level"):
+            v = config.get(key)
+            if v is not None and not isinstance(v, str):
+                raise ValueError(f"{key} must be a string or null")
+        v = config.get("tap_mode")
+        if v is not None and v not in (0, 1, 2, 3):
+            raise ValueError("tap_mode must be 0..3 (LOCAL/MIRROR/"
+                             "ANALYZER/DECAP) or null")
+        v = config.get("flow_acls")
+        if v is not None and not (isinstance(v, list)
+                                  and all(isinstance(a, dict)
+                                          for a in v)):
+            raise ValueError("flow_acls must be a list of acl dicts "
+                             "or null")
         with self._lock:
             base = dict(self._configs.get(group, DEFAULT_CONFIG))
+            old_acls = base.get("flow_acls")
+            old_ver = int(base.get("acl_version") or 0)
             base.update(config)
+            # acl_version follows policy content automatically when the
+            # caller didn't bump it: an edited rule set with a stale
+            # version would be silently ignored by EVERY agent (the
+            # labeler and the reference agent both recompile only when
+            # the version moves) — fleet-wide stale policy, no error
+            if "flow_acls" in config and config["flow_acls"] != old_acls \
+                    and int(base.get("acl_version") or 0) <= old_ver:
+                base["acl_version"] = old_ver + 1
             self._configs[group] = base
             self.config_version += 1
             self._save_locked()

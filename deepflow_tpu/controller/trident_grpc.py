@@ -116,6 +116,50 @@ class SynchronizerService:
         c.capture_bpf = str(cfg.get("capture_bpf", ""))
         c.l4_log_tap_types.extend(
             int(t) for t in cfg.get("l4_log_tap_types", ()))
+        # capture / resource-limit / l7 surface (round-5 Config
+        # widening; reference trident.proto:185-289): only fields the
+        # group config actually carries are set — proto2 defaults
+        # cover the rest, so an unmodified reference agent sees its
+        # own defaults for unmanaged knobs rather than zeros
+        _scalar = (("tap_interface_regex", "tap_interface_regex", str),
+                   ("extra_netns_regex", "extra_netns_regex", str),
+                   ("mtu", "mtu", int),
+                   ("output_vlan", "output_vlan", int),
+                   ("npb_bps_threshold", "max_npb_bps", int),
+                   ("capture_packet_size", "capture_packet_size", int),
+                   ("l7_log_packet_size", "l7_log_packet_size", int),
+                   ("log_threshold", "log_threshold", int),
+                   ("log_level", "log_level", str),
+                   ("thread_threshold", "thread_threshold", int),
+                   ("process_threshold", "process_threshold", int),
+                   ("log_retention", "log_retention_days", int),
+                   ("ntp_enabled", "ntp_enabled", bool),
+                   ("platform_enabled", "platform_enabled", bool),
+                   ("kubernetes_api_enabled", "kubernetes_api_enabled",
+                    bool),
+                   ("l4_performance_enabled", "l4_performance_enabled",
+                    bool),
+                   ("l7_metrics_enabled", "l7_metrics_enabled", bool),
+                   ("tap_mode", "tap_mode", int),
+                   ("region_id", "region_id", int),
+                   ("epc_id", "epc_id", int),
+                   ("pod_cluster_id", "pod_cluster_id", int),
+                   ("http_log_trace_id", "http_log_trace_id", None),
+                   ("http_log_span_id", "http_log_span_id", None),
+                   ("http_log_x_request_id", "http_log_x_request_id",
+                    None),
+                   ("http_log_proxy_client", "http_log_proxy_client",
+                    None))
+        for pb_field, cfg_key, cast in _scalar:
+            v = cfg.get(cfg_key)
+            if v is None:
+                continue
+            if cast is None:       # header lists ride comma-joined
+                v = ", ".join(v) if isinstance(v, (list, tuple)) \
+                    else str(v)
+                setattr(c, pb_field, v)
+            else:
+                setattr(c, pb_field, cast(v))
         # the data-plane destination (JSON route's resp["ingester"]):
         # without analyzer_ip a managed agent has nowhere to ship
         if self.assign is not None:
@@ -125,6 +169,32 @@ class SynchronizerService:
                 c.analyzer_ip = ip or str(target)
                 if port.isdigit():
                     c.analyzer_port = int(port)
+        # policy push (round-5: reference SyncResponse.flow_acls — a
+        # serialized FlowAcls blob + version; the reference agent
+        # re-compiles its labeler only when version_acls moves).
+        # `is not None`: an EMPTY list is authoritative and must ship
+        # (as a present-but-empty blob with a bumped version) so
+        # agents actually CLEAR their rules — `if acls:` would leave a
+        # fleet dropping traffic forever after a policy disable
+        acls = cfg.get("flow_acls")
+        if acls is not None:
+            resp.version_acls = int(cfg.get("acl_version", 1) or 1)
+            fa = pb.FlowAcls()
+            for a in acls:
+                f = fa.flow_acl.add()
+                f.id = int(a.get("id", 0))
+                f.tap_type = int(a.get("tap_type", 0))
+                f.protocol = int(a.get("protocol", 256))
+                f.src_ports = str(a.get("src_ports", "") or "")
+                f.dst_ports = str(a.get("dst_ports", "") or "")
+                for act in a.get("npb_actions") or ():
+                    na = f.npb_actions.add()
+                    na.tunnel_type = int(act.get("tunnel_type", 0))
+                    na.tunnel_id = int(act.get("tunnel_id", 0))
+                    na.tunnel_ip = str(act.get("tunnel_ip", "") or "")
+                    na.payload_slice = int(
+                        act.get("payload_slice", 65535))
+            resp.flow_acls = fa.SerializeToString()
         upg = r.get("upgrade")
         if upg:
             resp.revision = upg["revision"]

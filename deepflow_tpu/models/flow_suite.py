@@ -178,6 +178,39 @@ def update_packed(state: FlowSuiteState, lanes: Dict[str, jnp.ndarray],
     return update(state, unpack_lanes(lanes), mask, cfg)
 
 
+def unpack_plane(plane: jnp.ndarray,
+                 schema=None) -> Dict[str, jnp.ndarray]:
+    """One (n_cols, n) uint32 device plane -> the cols dict, on device.
+
+    The full-row wire (SKETCH_L4_SCHEMA: 17 four-byte columns) is
+    ALREADY a contiguous u32 matrix on the host — frombuffer + reshape
+    is free — so the whole batch can cross the link as ONE transfer
+    instead of 17. On the tunneled runtime per-transfer overhead, not
+    bandwidth, is what holds the full-row path ~3x under the link's
+    byte rate (round-3: 77 MB/s achieved vs ~206 the lane path
+    sustains), so fusing the copies is the fix the round-4 verdict's
+    #7 asks for. Signed columns are bitcast back on device (free:
+    XLA folds it into the consumer)."""
+    from jax import lax
+
+    from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
+    schema = schema or SKETCH_L4_SCHEMA
+    cols: Dict[str, jnp.ndarray] = {}
+    for i, (name, dt) in enumerate(schema.columns):
+        row = plane[i]
+        if np.dtype(dt) == np.int32:
+            row = lax.bitcast_convert_type(row, jnp.int32)
+        cols[name] = row
+    return cols
+
+
+def update_plane(state: FlowSuiteState, plane: jnp.ndarray,
+                 mask: jnp.ndarray,
+                 cfg: FlowSuiteConfig) -> FlowSuiteState:
+    """`update` over the single-transfer full-row plane batch."""
+    return update(state, unpack_plane(plane), mask, cfg)
+
+
 def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
            mask: jnp.ndarray, cfg: FlowSuiteConfig) -> FlowSuiteState:
     """Advance all sketches by one static-shape batch. Fully jittable."""

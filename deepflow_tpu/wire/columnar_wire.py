@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import struct
 import zlib
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -64,22 +64,31 @@ def encode_columnar(cols: Dict[str, np.ndarray],
     return b"".join(parts)
 
 
+def _checked_n_rows(payload: bytes, schema: Schema) -> Optional[int]:
+    """Validate the frame header against the schema; None = reject
+    (the ONE place the frame-validity rules live — both decoders and
+    any future one must agree on what a valid frame is)."""
+    try:
+        magic, version, n_cols, shash, n_rows = _HEADER.unpack_from(payload)
+        if (magic != MAGIC or version != VERSION
+                or n_cols != len(schema.columns)
+                or shash != schema_hash(schema)):
+            return None
+        if len(payload) < HEADER_LEN + schema.row_bytes() * n_rows:
+            return None
+    except struct.error:
+        return None
+    return n_rows
+
+
 def decode_columnar(payload: bytes, schema: Schema = L4_SCHEMA
                     ) -> Tuple[Dict[str, np.ndarray], int]:
     """Planar payload -> columns dict. Returns (cols, bad_record_count)
     matching the native protobuf decoder's contract; a malformed payload
     loses the whole frame (there is no per-record resync in a planar
     layout), reported as one bad record."""
-    ncols = len(schema.columns)
-    try:
-        magic, version, n_cols, shash, n_rows = _HEADER.unpack_from(payload)
-        if (magic != MAGIC or version != VERSION or n_cols != ncols
-                or shash != schema_hash(schema)):
-            raise ValueError("columnar header mismatch")
-        need = HEADER_LEN + schema.row_bytes() * n_rows
-        if len(payload) < need:
-            raise ValueError(f"short columnar payload: {len(payload)}/{need}")
-    except (struct.error, ValueError):
+    n_rows = _checked_n_rows(payload, schema)
+    if n_rows is None:
         return {n: np.empty(0, d) for n, d in schema.columns}, 1
     cols: Dict[str, np.ndarray] = {}
     off = HEADER_LEN
@@ -88,3 +97,23 @@ def decode_columnar(payload: bytes, schema: Schema = L4_SCHEMA
         cols[name] = np.frombuffer(payload, dt, count=n_rows, offset=off)
         off += dt.itemsize * n_rows
     return cols, 0
+
+
+def decode_columnar_plane(payload: bytes, schema: Schema = L4_SCHEMA
+                          ) -> Tuple[np.ndarray, int]:
+    """Planar payload -> ONE (n_cols, n_rows) uint32 matrix VIEW (plus
+    bad_record_count, same contract as decode_columnar). Valid only
+    for schemas whose columns are all 4-byte (SKETCH_L4_SCHEMA is);
+    the body already IS that matrix, so this is a free reshape — and
+    the consumer can ship the whole batch device-ward as a single
+    transfer (models/flow_suite.py unpack_plane slices it back on
+    device). Signed columns ride bitcast in the u32 view."""
+    ncols = len(schema.columns)
+    if any(np.dtype(dt).itemsize != 4 for _, dt in schema.columns):
+        raise ValueError(f"schema {schema.name} is not all-4-byte")
+    n_rows = _checked_n_rows(payload, schema)
+    if n_rows is None:
+        return np.empty((ncols, 0), np.uint32), 1
+    plane = np.frombuffer(payload, np.uint32, count=ncols * n_rows,
+                          offset=HEADER_LEN).reshape(ncols, n_rows)
+    return plane, 0

@@ -167,3 +167,77 @@ def test_pipeline_ingests_columnar_frames(tmp_path):
             int(cols["byte_tx"].sum())
     finally:
         ing.close()
+
+
+def test_plane_decode_equals_column_decode():
+    """decode_columnar_plane's (n_cols, n) u32 view must hold exactly
+    the per-column data (signed columns bitcast), and the device-side
+    unpack (flow_suite.unpack_plane) must reproduce the cols dict —
+    the single-transfer full-row path's correctness contract."""
+    import jax.numpy as jnp
+
+    from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
+    from deepflow_tpu.models import flow_suite
+
+    rng = np.random.default_rng(7)
+    n = 257
+    cols = {}
+    for name, dt in SKETCH_L4_SCHEMA.columns:
+        if np.dtype(dt) == np.int32:
+            cols[name] = rng.integers(-2**31, 2**31, n, dtype=np.int64
+                                      ).astype(np.int32)
+        else:
+            cols[name] = rng.integers(0, 2**32, n, dtype=np.uint64
+                                      ).astype(dt)
+    payload = columnar_wire.encode_columnar(cols, SKETCH_L4_SCHEMA)
+    plane, bad = columnar_wire.decode_columnar_plane(
+        payload, SKETCH_L4_SCHEMA)
+    assert bad == 0 and plane.shape == (len(SKETCH_L4_SCHEMA.columns), n)
+    ref, _ = columnar_wire.decode_columnar(payload, SKETCH_L4_SCHEMA)
+    for i, (name, dt) in enumerate(SKETCH_L4_SCHEMA.columns):
+        np.testing.assert_array_equal(plane[i],
+                                      ref[name].view(np.uint32))
+    got = flow_suite.unpack_plane(jnp.asarray(plane))
+    for name, dt in SKETCH_L4_SCHEMA.columns:
+        assert got[name].dtype == np.dtype(dt), name
+        np.testing.assert_array_equal(np.asarray(got[name]), ref[name])
+
+
+def test_plane_decode_rejects_mixed_width_schema():
+    from deepflow_tpu.batch.schema import L4_SCHEMA as WIDE
+    if all(np.dtype(dt).itemsize == 4 for _, dt in WIDE.columns):
+        pytest.skip("wide schema became all-4-byte")
+    with pytest.raises(ValueError):
+        columnar_wire.decode_columnar_plane(b"", WIDE)
+
+
+def test_plane_update_equals_column_update():
+    """One production-config sketch step over the plane path must land
+    the IDENTICAL state as the dict path."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
+    from deepflow_tpu.models import flow_suite
+
+    rng = np.random.default_rng(11)
+    n = 1024
+    cols = {name: rng.integers(0, 2**20, n).astype(dt)
+            for name, dt in SKETCH_L4_SCHEMA.columns}
+    payload = columnar_wire.encode_columnar(cols, SKETCH_L4_SCHEMA)
+    cfg = flow_suite.FlowSuiteConfig()
+    mask = jnp.asarray(np.ones(n, np.bool_))
+    s_cols = flow_suite.init(cfg)
+    s_cols = jax.jit(lambda s, c, m: flow_suite.update(s, c, m, cfg))(
+        s_cols, {k: jnp.asarray(v) for k, v in
+                 columnar_wire.decode_columnar(
+                     payload, SKETCH_L4_SCHEMA)[0].items()}, mask)
+    plane, _ = columnar_wire.decode_columnar_plane(payload,
+                                                   SKETCH_L4_SCHEMA)
+    s_plane = flow_suite.init(cfg)
+    s_plane = jax.jit(
+        lambda s, p, m: flow_suite.update_plane(s, p, m, cfg))(
+        s_plane, jnp.asarray(plane), mask)
+    for a, b in zip(jax.tree_util.tree_leaves(s_cols),
+                    jax.tree_util.tree_leaves(s_plane)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
