@@ -34,7 +34,7 @@ on the trace id).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, Optional, Tuple
 
 from deepflow_tpu.agent.l7 import (MSG_REQUEST, MSG_RESPONSE,
@@ -113,6 +113,7 @@ class SyscallRecord:
     port_src: int
     port_dst: int
     proto: int = 6
+    fd: int = 0                    # socket fd in the traced process
     tcp_seq: int = 0               # TCP seq at the syscall boundary
     cap_seq: int = 0               # per-socket capture sequence
     coroutine_id: int = 0          # goroutine id when nonzero
@@ -167,6 +168,7 @@ class EbpfTracer:
         # controller's per-sync cap, starve NEW pids of allocation
         self._seen_procs: Dict[int, list] = {}
         self.gpid_map: Dict[int, int] = {}
+        self._http2 = None           # lazy Http2Assembler
 
     def expire(self, now_ns: int,
                timeout_ns: int = 30 * 1_000_000_000) -> None:
@@ -186,6 +188,10 @@ class EbpfTracer:
                     if now_ns - sp[2] > proc_timeout]:
             del self._seen_procs[pid]
             self.gpid_map.pop(pid, None)
+        if self._http2 is not None:
+            # orphaned h2 header groups (lost END markers) expire on
+            # the same cadence as the other per-session maps
+            self._http2.expire(now_ns)
 
     # -- trace-id state machine -------------------------------------------
     def _trace_id_for(self, rec: SyscallRecord, msg_type: int,
@@ -232,6 +238,21 @@ class EbpfTracer:
         """Process one record; returns a serialized AppProtoLogsData when
         a request/response session merges."""
         self.records_in += 1
+        from deepflow_tpu.agent.socket_trace import \
+            SOURCE_GO_HTTP2_UPROBE
+        if rec.source == SOURCE_GO_HTTP2_UPROBE:
+            # header-level events (agent/http2_trace.py): group per
+            # stream; only a COMPLETED block continues into parsing,
+            # as a synthesized HTTP-shaped payload — every consumer
+            # (live pump, replay) gets h2 handling for free here
+            if self._http2 is None:
+                from deepflow_tpu.agent.http2_trace import \
+                    Http2Assembler
+                self._http2 = Http2Assembler()
+            block = self._http2.feed(rec)
+            if block is None:
+                return None
+            rec = replace(rec, payload=block)
         sp = self._seen_procs.get(rec.pid)
         if sp is None:
             self._seen_procs[rec.pid] = [rec.process_kname,

@@ -1,0 +1,486 @@
+"""Go HTTP/2 uprobe suite: header-level capture above HPACK, in-tree.
+
+Reference: agent/src/ebpf/kernel/go_http2_bpf.c (1187 LoC) — uprobes
+on the Go http2 internals capture DECODED header fields where the
+byte stream is out of reach: `(*http2ClientConn).writeHeader(name,
+value string)` fires once per request header, `writeHeaders(streamID,
+...)` marks the header block's end, and the server-side mirrors them;
+events carry (fd, stream id, k/v) and stream to userspace tagged
+DATA_SOURCE_GO_HTTP2_UPROBE, where header groups reassemble into L7
+requests. The fd comes from walking the conn struct with per-binary
+offsets in proc_info_map, and unmanaged processes are skipped
+(skip_http2_uprobe).
+
+This module rebuilds that on the in-tree toolkit:
+
+- kernel programs (agent/bpf.py assembler, kernel-verifier-loaded):
+  `build_header_event` (one per-header event: clamped name/value
+  copied at FIXED payload offsets — constant offsets are what the
+  verifier can check) and `build_headers_end` (the end marker carrying
+  the stream id). Both gate on the `http2_info` map (per-process
+  offsets: tconn interface offset -> net.conn fd walk, stream-id
+  offset, regabi flag) so an unmanaged process pays two map misses.
+  Register-ABI Go (>= 1.17) only — the stack-ABI http2 internals
+  predate the versions that matter for h2 traffic; documented subset.
+- events ride the standard 192B SOCK_DATA wire (socket_trace.py)
+  with SOURCE_GO_HTTP2_UPROBE in the direction word, so the perf
+  reader and EbpfTracer plumbing need nothing new;
+- `Http2Assembler` groups events per (pid, fd, stream, side) and, at
+  the end marker, synthesizes an HTTP/1-shaped header block (pseudo-
+  headers :method/:path/:authority/:status become request/status
+  lines) — the existing deep HTTP parser then extracts method, path,
+  host, UA, and trace context exactly as it does for every other
+  source, and the l7 row comes out version="2", is_tls flagged
+  (GO_HTTP2 is a TLS source).
+- `plan_go_http2` resolves the probe sites (net/http and vendored
+  golang.org/x/net/http2 symbol spellings, like go_tracer.c's table).
+
+The reference's server-side processHeaders slice walk (a bounded
+in-probe loop over hpack fields) is NOT authored here — read-side
+visibility comes from the Go-TLS uprobes' plaintext byte stream
+through the ordinary HTTP/2/HPACK parser; this suite adds the
+write-side header events that have no byte-stream equivalent.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW, BPF_JEQ, BPF_JGT,
+                                    BPF_JLT, BPF_LSH,
+                                    BPF_MAP_TYPE_HASH, BPF_OR,
+                                    BPF_PROG_TYPE_KPROBE, BPF_RSH,
+                                    BPF_SUB, BPF_W,
+                                    FN_get_current_comm,
+                                    FN_get_current_pid_tgid,
+                                    FN_ktime_get_ns,
+                                    FN_map_lookup_elem,
+                                    FN_perf_event_output,
+                                    FN_probe_read,
+                                    R0, R1, R2, R3, R4, R5, R6, R7, R8,
+                                    R9, R10, Asm, Map, Program, load)
+from deepflow_tpu.agent.socket_trace import (RECORD_SIZE,
+                                             SOURCE_GO_HTTP2_UPROBE,
+                                             SocketTraceMaps, T_EGRESS,
+                                             T_INGRESS, create_maps)
+from deepflow_tpu.agent.socket_trace import (_FDSAVE, _KEY,  # noqa
+                                             _PT_AX, _REC, _SCRATCH)
+from deepflow_tpu.agent.uprobe_trace import (_GOSTASH, _PIKEY,  # noqa
+                                             _PT_BX, _PT_CX,
+                                             UprobeSpec, elf_func_table,
+                                             go_version,
+                                             vaddr_to_offset)
+
+_PT_SI, _PT_DI = 104, 112
+
+# per-binary walk defaults (go_tracer.c data_members:
+# net/http.http2ClientConn.tconn default 8, .nextStreamID default 176;
+# the interface's net.conn fd walk reuses the tls defaults)
+GO_HTTP2_DEFAULT_INFO = {"tconn_off": 8, "fd_off": 0, "sysfd_off": 16,
+                         "stream_off": 176}
+
+# event layout inside the SOCK_DATA payload (offsets from _REC+64):
+#   u32 stream | u8 flags | u8 name_len | u8 value_len | u8 pad
+#   name[64] at +8 | value[56] at +72       -> 128B = PAYLOAD_CAP
+EV_FLAG_END = 1          # end-of-header-block marker
+EV_FLAG_READ = 2         # read side (server-processed headers)
+NAME_CAP, VALUE_CAP = 64, 56
+_EV_FMT = "<IBBBx"
+_PAYLOAD_OFF = 64        # payload offset inside the record
+
+
+@dataclass
+class Http2Maps:
+    """http2_info: tgid -> {reg_abi, tconn_off, fd_off, sysfd_off,
+    stream_off, pad} (24B — go_http2_bpf.c's proc_info offsets for
+    the http2ClientConn walk); shared trace/conf/events as usual."""
+
+    http2_info: Map
+    shared: SocketTraceMaps
+    owns_shared: bool = False
+
+    @property
+    def events(self) -> Map:
+        return self.shared.events
+
+    def set_info(self, tgid: int, reg_abi: bool = True,
+                 tconn_off: int = 0, fd_off: int = 0,
+                 sysfd_off: int = 16, stream_off: int = 0) -> None:
+        self.http2_info.update_bytes(
+            struct.pack("<I", tgid),
+            struct.pack("<IIIIII", 1 if reg_abi else 0, tconn_off,
+                        fd_off, sysfd_off, stream_off, 0))
+
+    def close(self) -> None:
+        self.http2_info.close()
+        if self.owns_shared:
+            self.shared.close()
+
+
+def create_http2_maps(
+        shared: Optional[SocketTraceMaps] = None) -> Http2Maps:
+    owns = shared is None
+    if shared is None:
+        shared = create_maps()
+    try:
+        info = Map(1024, 24, BPF_MAP_TYPE_HASH, 4)
+    except OSError:
+        if owns:
+            shared.close()
+        raise
+    return Http2Maps(info, shared=shared, owns_shared=owns)
+
+
+def _prologue(a: Asm, maps: Http2Maps) -> None:
+    """ctx->R6, pid_tgid->R7/_KEY, http2_info lookup (absent ->
+    "done"), offsets copied to the stack: tconn_off -> _SCRATCH(W),
+    fd/sysfd/stream offs -> _GOSTASH+0/+4/+8 (W each)."""
+    a.mov_reg(R6, R1)
+    a.call(FN_get_current_pid_tgid)
+    a.mov_reg(R7, R0)
+    a.stx_mem(BPF_DW, R10, R7, _KEY)
+    a.mov_reg(R1, R7).alu_imm(BPF_RSH, R1, 32)
+    a.stx_mem(BPF_W, R10, R1, _PIKEY)
+    a.ld_map_fd(R1, maps.http2_info)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _PIKEY)
+    a.call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "done")
+    # the programs read the REGISTER ABI; a stack-ABI (go < 1.17)
+    # process must exit here, not emit garbage from AX/BX/CX reads
+    a.ldx_mem(BPF_W, R1, R0, 0)                    # reg_abi
+    a.jmp_imm(BPF_JEQ, R1, 0, "done")
+    a.ldx_mem(BPF_W, R1, R0, 4)                    # tconn_off
+    a.stx_mem(BPF_W, R10, R1, _SCRATCH)
+    a.ldx_mem(BPF_W, R1, R0, 8)                    # fd_off
+    a.stx_mem(BPF_W, R10, R1, _GOSTASH + 0)
+    a.ldx_mem(BPF_W, R1, R0, 12)                   # sysfd_off
+    a.stx_mem(BPF_W, R10, R1, _GOSTASH + 4)
+    a.ldx_mem(BPF_W, R1, R0, 16)                   # stream_off
+    a.stx_mem(BPF_W, R10, R1, _GOSTASH + 8)
+
+
+def _fd_walk(a: Asm) -> None:
+    """Receiver (AX) -> tconn iface data -> net.conn fd -> Sysfd, via
+    the stacked offsets; result (u32, zero-filled on fault) lands in
+    _FDSAVE. Mirrors get_fd_from_http2ClientConn
+    (go_http2_bpf.c:51-64)."""
+    a.ldx_mem(BPF_DW, R8, R6, _PT_AX)              # receiver
+    a.ldx_mem(BPF_W, R3, R10, _SCRATCH)
+    a.alu_reg(BPF_ADD, R3, R8).alu_imm(BPF_ADD, R3, 8)   # iface data
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOSTASH + 16)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.ldx_mem(BPF_DW, R8, R10, _GOSTASH + 16)
+    a.st_imm(BPF_DW, R10, _FDSAVE, 0)
+    a.jmp_imm(BPF_JEQ, R8, 0, "fd_done")
+    a.ldx_mem(BPF_W, R3, R10, _GOSTASH + 0)
+    a.alu_reg(BPF_ADD, R3, R8)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOSTASH + 16)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.ldx_mem(BPF_DW, R8, R10, _GOSTASH + 16)
+    a.jmp_imm(BPF_JEQ, R8, 0, "fd_done")
+    a.ldx_mem(BPF_W, R3, R10, _GOSTASH + 4)
+    a.alu_reg(BPF_ADD, R3, R8)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _FDSAVE)
+    a.mov_imm(R2, 4)
+    a.call(FN_probe_read)
+    a.label("fd_done")
+
+
+def _emit_event(a: Asm, maps: Http2Maps, direction: int) -> None:
+    """Zero + fill the SOCK_DATA framing (pid/ts/fd/dir|source/comm,
+    data_len = 128) and perf-output the record. The event body must
+    already sit in the payload area."""
+    a.stx_mem(BPF_DW, R10, R7, _REC + 0)
+    a.call(FN_ktime_get_ns)
+    a.stx_mem(BPF_DW, R10, R0, _REC + 8)
+    a.st_imm(BPF_DW, R10, _REC + 16, 0)            # trace id: none
+    a.st_imm(BPF_DW, R10, _REC + 24, 0)
+    a.ldx_mem(BPF_DW, R1, R10, _FDSAVE)
+    a.stx_mem(BPF_DW, R10, R1, _REC + 32)
+    a.st_imm(BPF_W, R10, _REC + 40,
+             direction | (SOURCE_GO_HTTP2_UPROBE << 16))
+    a.st_imm(BPF_W, R10, _REC + 44, 128)           # data_len = cap
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + 48)
+    a.mov_imm(R2, 16)
+    a.call(FN_get_current_comm)
+    a.mov_reg(R1, R6)
+    a.ld_map_fd(R2, maps.events)
+    a.mov32_imm(R3, 0xFFFFFFFF)
+    a.mov_reg(R4, R10).alu_imm(BPF_ADD, R4, _REC)
+    a.mov_imm(R5, RECORD_SIZE)
+    a.call(FN_perf_event_output)
+
+
+def _zero_record(a: Asm) -> None:
+    for k in range(RECORD_SIZE // 8):
+        a.st_imm(BPF_DW, R10, _REC + 8 * k, 0)
+
+
+def build_header_event(maps: Http2Maps, direction: int) -> Asm:
+    """uprobe on writeHeader(name, value string) (go_http2_bpf.c:540):
+    one per-header event. Register ABI: receiver AX, name {ptr BX,
+    len CX}, value {ptr DI, len SI}. Name/value copy to FIXED payload
+    offsets with immediate-bounded lengths."""
+    a = Asm()
+    _prologue(a, maps)
+    _fd_walk(a)
+    _zero_record(a)
+    # stream id: *(receiver + stream_off), best-effort (cc.nextID)
+    a.ldx_mem(BPF_DW, R8, R6, _PT_AX)
+    a.ldx_mem(BPF_W, R3, R10, _GOSTASH + 8)
+    a.jmp_imm(BPF_JEQ, R3, 0, "no_stream")
+    a.alu_reg(BPF_ADD, R3, R8)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _REC + _PAYLOAD_OFF)
+    a.mov_imm(R2, 4)
+    a.call(FN_probe_read)
+    # cc.nextStreamID is the NEXT (odd) client stream; the one being
+    # written is next-2 (go_http2_bpf.c:566-568's `data.stream -= 2`
+    # for go >= 1.16 — regabi gating already implies >= 1.17), so the
+    # header events key under the SAME id the end marker carries
+    a.ldx_mem(BPF_W, R1, R10, _REC + _PAYLOAD_OFF)
+    a.jmp_imm(BPF_JLT, R1, 2, "no_stream")
+    a.alu_imm(BPF_SUB, R1, 2)
+    a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF)
+    a.label("no_stream")
+    # clamped name length -> flags byte area
+    a.ldx_mem(BPF_DW, R8, R6, _PT_CX)              # name len
+    a.jmp_imm(BPF_JGT, R8, NAME_CAP, "nclamp")
+    a.jmp("nok")
+    a.label("nclamp").mov_imm(R8, NAME_CAP)
+    a.label("nok")
+    a.stx_mem(BPF_W, R10, R8, _SCRATCH)            # scratch: name_len
+    a.ldx_mem(BPF_DW, R9, R6, _PT_SI)              # value len
+    a.jmp_imm(BPF_JGT, R9, VALUE_CAP, "vclamp")
+    a.jmp("vok")
+    a.label("vclamp").mov_imm(R9, VALUE_CAP)
+    a.label("vok")
+    # event header: ONE packed little-endian W at payload+4 —
+    # flags(0) | name_len<<8 | value_len<<16 (byte-granular reg
+    # stores at these offsets would need three narrow stx's; the
+    # packed word is one store and parse_event's <IBBBx reads it back
+    # byte-exact)
+    a.mov_reg(R1, R9)                              # value_len
+    a.mov_reg(R2, R8)                              # name_len
+    a.alu_imm(BPF_LSH, R1, 16)
+    a.alu_imm(BPF_LSH, R2, 8)
+    a.alu_reg(BPF_OR, R1, R2)
+    a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF + 4)
+    # name copy (bounded by the clamp above)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1,
+                               _REC + _PAYLOAD_OFF + 8)
+    a.mov_reg(R2, R8)
+    a.ldx_mem(BPF_DW, R3, R6, _PT_BX)
+    a.call(FN_probe_read)
+    # value copy
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1,
+                               _REC + _PAYLOAD_OFF + 8 + NAME_CAP)
+    a.mov_reg(R2, R9)
+    a.ldx_mem(BPF_DW, R3, R6, _PT_DI)
+    a.call(FN_probe_read)
+    _emit_event(a, maps, direction)
+    a.label("done")
+    a.exit_imm(0)
+    return a
+
+
+def build_headers_end(maps: Http2Maps, direction: int) -> Asm:
+    """uprobe on writeHeaders(streamID uint32, ...): the end-of-block
+    marker (go_http2_bpf.c:600 — MSG_REQUEST_END role). Register ABI:
+    streamID in BX."""
+    a = Asm()
+    _prologue(a, maps)
+    _fd_walk(a)
+    _zero_record(a)
+    a.ldx_mem(BPF_DW, R1, R6, _PT_BX)              # streamID
+    a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF)
+    a.st_imm(BPF_W, R10, _REC + _PAYLOAD_OFF + 4, EV_FLAG_END)
+    _emit_event(a, maps, direction)
+    a.label("done")
+    a.exit_imm(0)
+    return a
+
+
+class Http2Suite:
+    """Loaded program set (all kernel-verifier-checked)."""
+
+    def __init__(self,
+                 shared: Optional[SocketTraceMaps] = None) -> None:
+        self.maps = create_http2_maps(shared)
+        loaded: List[Program] = []
+        try:
+            for builder in (
+                    lambda: build_header_event(self.maps, T_EGRESS),
+                    lambda: build_header_event(self.maps, T_INGRESS),
+                    lambda: build_headers_end(self.maps, T_EGRESS),
+                    lambda: build_headers_end(self.maps, T_INGRESS)):
+                loaded.append(load(builder().assemble(),
+                                   prog_type=BPF_PROG_TYPE_KPROBE))
+        except OSError:
+            for p in loaded:
+                p.close()
+            self.maps.close()
+            raise
+        (self.header_write, self.header_read,
+         self.end_write, self.end_read) = loaded
+
+    def programs(self) -> Dict[str, Program]:
+        return {"header_write": self.header_write,
+                "header_read": self.header_read,
+                "end_write": self.end_write,
+                "end_read": self.end_read}
+
+    def close(self) -> None:
+        for p in self.programs().values():
+            p.close()
+        self.maps.close()
+
+
+# -- userspace: event wire + attach plan -----------------------------------
+
+def pack_event(stream: int, flags: int, name: bytes,
+               value: bytes) -> bytes:
+    """Event body byte-image (tests/replay — the inverse of
+    parse_event, fixed-slot layout like the kernel programs write)."""
+    name, value = name[:NAME_CAP], value[:VALUE_CAP]
+    return (struct.pack(_EV_FMT, stream, flags, len(name), len(value))
+            + name.ljust(NAME_CAP, b"\0")
+            + value.ljust(VALUE_CAP, b"\0"))
+
+
+def parse_event(payload: bytes
+                ) -> Optional[Tuple[int, int, bytes, bytes]]:
+    """(stream, flags, name, value) from an event payload; None on a
+    short/garbled body."""
+    if len(payload) < 8 + NAME_CAP + VALUE_CAP:
+        return None
+    stream, flags, nlen, vlen = struct.unpack_from(_EV_FMT, payload)
+    nlen, vlen = min(nlen, NAME_CAP), min(vlen, VALUE_CAP)
+    name = payload[8:8 + nlen]
+    value = payload[8 + NAME_CAP:8 + NAME_CAP + vlen]
+    return stream, flags, name, value
+
+
+HTTP2_SYMBOLS = {
+    # (symbol spelling, role, direction): net/http's bundled copy and
+    # the vendored golang.org/x/net/http2 spelling (go_tracer.c:226+)
+    "net/http.(*http2ClientConn).writeHeader":
+        ("header_write", T_EGRESS),
+    "golang.org/x/net/http2.(*ClientConn).writeHeader":
+        ("header_write", T_EGRESS),
+    "net/http.(*http2ClientConn).writeHeaders":
+        ("end_write", T_EGRESS),
+    "golang.org/x/net/http2.(*ClientConn).writeHeaders":
+        ("end_write", T_EGRESS),
+}
+
+
+def plan_go_http2(path: str) -> List[UprobeSpec]:
+    """Entry-uprobe specs for whichever http2 spellings the binary
+    carries (no RET probes: header events fire at entry)."""
+    if go_version(path) is None:
+        return []
+    funcs = elf_func_table(path)
+    specs: List[UprobeSpec] = []
+    for sym, (role, _direction) in HTTP2_SYMBOLS.items():
+        if sym not in funcs:
+            continue
+        vaddr, _size = funcs[sym]
+        off = vaddr_to_offset(path, vaddr)
+        if off is not None:
+            specs.append(UprobeSpec(path, sym, off, role))
+    return specs
+
+
+# -- userspace: header-group assembly --------------------------------------
+
+class Http2Assembler:
+    """Per-(pid, fd, stream, side) header groups -> synthesized
+    HTTP/1-shaped payloads at the end marker, so the ordinary deep
+    HTTP parser (agent/l7.py) extracts method/path/host/trace context
+    from uprobe-captured h2 headers (the role go_http2_bpf.c's
+    userspace reassembly plays)."""
+
+    def __init__(self, max_groups: int = 4096,
+                 max_headers: int = 64,
+                 timeout_ns: int = 30 * 1_000_000_000) -> None:
+        # key -> [last_ts_ns, [(name, value), ...]]
+        self._groups: Dict[tuple, list] = {}
+        self.max_groups = max_groups
+        self.max_headers = max_headers
+        self.timeout_ns = timeout_ns
+        self.events_in = 0
+        self.blocks_out = 0
+        self.dropped = 0
+
+    def feed(self, rec) -> Optional[bytes]:
+        """One SOURCE_GO_HTTP2_UPROBE SyscallRecord in; a synthesized
+        header-block payload out when its group completes. Grouped by
+        (pid, FD, stream, side): stream ids are per-CONNECTION (two h2
+        conns both use 1,3,5...) and goroutines migrate OS threads, so
+        fd — walked in-kernel exactly for this — is the connection
+        identity, never the tid."""
+        ev = parse_event(rec.payload)
+        if ev is None:
+            self.dropped += 1
+            return None
+        stream, flags, name, value = ev
+        side = T_INGRESS if flags & EV_FLAG_READ else rec.direction
+        key = (rec.pid, getattr(rec, "fd", 0), stream, side)
+        self.events_in += 1
+        if not flags & EV_FLAG_END:
+            if len(self._groups) >= self.max_groups \
+                    and key not in self._groups:
+                self.dropped += 1          # bounded under stream floods
+                return None
+            if name:
+                g = self._groups.setdefault(key, [0, []])
+                g[0] = rec.timestamp_ns
+                if len(g[1]) < self.max_headers:   # header-flood bound
+                    g[1].append((name, value))
+                else:
+                    self.dropped += 1
+            return None
+        _, headers = self._groups.pop(key, (0, []))
+        self.blocks_out += 1
+        return synthesize_block(headers, side)
+
+    def expire(self, now_ns: int) -> int:
+        """Drop groups whose END marker never arrived (perf-ring loss
+        drops markers; an orphaned group must not pin a max_groups
+        slot for the agent's lifetime). EbpfTracer.expire drives
+        this."""
+        dead = [k for k, g in self._groups.items()
+                if now_ns - g[0] > self.timeout_ns]
+        for k in dead:
+            del self._groups[k]
+        self.dropped += len(dead)
+        return len(dead)
+
+    def counters(self) -> dict:
+        return {"events_in": self.events_in,
+                "blocks_out": self.blocks_out,
+                "groups_pending": len(self._groups),
+                "dropped": self.dropped}
+
+
+def synthesize_block(headers: List[Tuple[bytes, bytes]],
+                     side: int) -> bytes:
+    """Pseudo-headers -> request/status line; the rest -> an HTTP/1-
+    shaped header block the existing parser consumes (version is
+    rewritten to "2" downstream via the HTTP/2 marker line)."""
+    pseudo = {n: v for n, v in headers if n.startswith(b":")}
+    plain = [(n, v) for n, v in headers if not n.startswith(b":")]
+    if side == T_EGRESS or b":method" in pseudo:
+        line = (pseudo.get(b":method", b"GET") + b" "
+                + pseudo.get(b":path", b"/") + b" HTTP/2\r\n")
+        if b":authority" in pseudo and not any(
+                n == b"host" for n, _ in plain):
+            plain.insert(0, (b"host", pseudo[b":authority"]))
+    else:
+        line = b"HTTP/2 " + pseudo.get(b":status", b"200") + b" \r\n"
+    return line + b"".join(n + b": " + v + b"\r\n"
+                           for n, v in plain) + b"\r\n"

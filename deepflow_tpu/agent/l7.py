@@ -120,8 +120,12 @@ class HttpParser:
                 b"OPTIONS ", b"PATCH ")
 
     def check(self, payload: bytes) -> bool:
+        # "HTTP/2 " (ASCII status line): the http2-uprobe assembler's
+        # synthesized blocks (agent/http2_trace.py) — real h2 framing
+        # is binary and never hits this prefix
         return payload.startswith(self._METHODS) or \
-            payload.startswith(b"HTTP/1.")
+            payload.startswith(b"HTTP/1.") or \
+            payload.startswith(b"HTTP/2 ")
 
     def parse(self, payload: bytes) -> Optional[L7Record]:
         from deepflow_tpu.agent import trace_context
@@ -133,7 +137,8 @@ class HttpParser:
             return None
         headers = parse_http_headers(payload)
         ids = trace_context.extract(headers)
-        if payload.startswith(b"HTTP/1."):
+        if payload.startswith(b"HTTP/1.") or \
+                payload.startswith(b"HTTP/2 "):
             if len(parts) < 2 or not parts[1][:3].isdigit():
                 return None
             return L7Record(

@@ -447,7 +447,7 @@ def parse_record(buf: bytes,
             else:
                 ips = got
     return SyscallRecord(
-        pid=tgid, tid=tid, direction=direction, source=source,
+        pid=tgid, tid=tid, direction=direction, source=source, fd=fd,
         timestamp_ns=ts,
         ip_src=ips[0], ip_dst=ips[1], port_src=ips[2], port_dst=ips[3],
         cap_seq=cap_seq,

@@ -716,6 +716,7 @@ class TlsUprobeSource:
         # every TLS call would fire both and emit doubled records that
         # corrupt session pairing downstream
         self._attached: set = set()
+        self._http2_suite = None       # lazy, shares the events map
         self.records_pumped = 0
 
     def attach_ssl(self, path: str) -> int:
@@ -750,6 +751,16 @@ class TlsUprobeSource:
                     tgid, reg_abi=plan.reg_abi, **{
                         k: GO_DEFAULT_INFO[k]
                         for k in ("conn_off", "fd_off", "sysfd_off")})
+                if self._http2_suite is not None:
+                    # a NEW pid of an already-probed binary needs its
+                    # http2_info row too, or its writeHeader probes
+                    # fire into the prologue's map-miss exit and h2
+                    # capture silently never happens for it
+                    from deepflow_tpu.agent.http2_trace import \
+                        GO_HTTP2_DEFAULT_INFO
+                    self._http2_suite.maps.set_info(
+                        tgid, reg_abi=plan.reg_abi,
+                        **GO_HTTP2_DEFAULT_INFO)
             return 0
         plan = plan_go(path)
         if plan is None:
@@ -771,6 +782,30 @@ class TlsUprobeSource:
                              "probes": len(plan.specs),
                              "tgids": tgids,
                              "undecodable": plan.undecodable})
+        # http2 write-side header sites ride along when the binary has
+        # them (reference: go_tracer.c attaches the http2 probe table
+        # next to the tls one); events land in the SAME perf rings
+        from deepflow_tpu.agent.http2_trace import (
+            GO_HTTP2_DEFAULT_INFO, Http2Suite, plan_go_http2)
+        h2_specs = plan_go_http2(path)
+        if h2_specs:
+            if self._http2_suite is None:
+                self._http2_suite = Http2Suite(
+                    shared=self.suite.maps.shared)
+            progs2 = self._http2_suite.programs()
+            for s in h2_specs:
+                self._probes.append(perf_ring.attach_uprobe(
+                    progs2[s.role], s.path, s.offset, s.retprobe))
+            for t in tgids:
+                # the REAL walk/stream offsets (go_tracer.c defaults),
+                # not set_info's zero defaults — stream_off=0 would
+                # leave header events keyed stream 0 while end markers
+                # carry the real id, and no group would ever complete
+                self._http2_suite.maps.set_info(
+                    t, reg_abi=plan.reg_abi, **GO_HTTP2_DEFAULT_INFO)
+            self.targets.append({"kind": "go_http2", "path": path,
+                                 "probes": len(h2_specs),
+                                 "tgids": tgids})
         return len(plan.specs)
 
     def attach_pid(self, pid: int) -> int:
@@ -808,6 +843,9 @@ class TlsUprobeSource:
             p.close()
         self._probes = []
         self.reader.close()
+        if self._http2_suite is not None:
+            self._http2_suite.close()
+            self._http2_suite = None
         self.suite.close()
 
 

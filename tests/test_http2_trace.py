@@ -1,0 +1,231 @@
+"""Go HTTP/2 uprobe suite: verifier-loaded header-event programs, the
+fixed-slot event wire, header-group assembly into parser-consumable
+blocks, and the full path into tls-flagged l7 rows (reference:
+agent/src/ebpf/kernel/go_http2_bpf.c + its userspace reassembly)."""
+
+import struct
+
+import pytest
+
+from deepflow_tpu.agent import bpf, http2_trace as h2
+from deepflow_tpu.agent.ebpf_source import EbpfTracer
+from deepflow_tpu.agent.socket_trace import (SOURCE_GO_HTTP2_UPROBE,
+                                             T_EGRESS, T_INGRESS,
+                                             SocketTraceSuite,
+                                             pack_record, parse_record)
+
+_bpf_required = pytest.mark.skipif(not bpf.available(),
+                                   reason="bpf(2) unavailable")
+
+
+@_bpf_required
+def test_all_four_programs_pass_the_verifier():
+    suite = h2.Http2Suite()
+    try:
+        progs = suite.programs()
+        assert sorted(progs) == ["end_read", "end_write",
+                                 "header_read", "header_write"]
+        assert all(p.fd >= 0 for p in progs.values())
+    finally:
+        suite.close()
+
+
+@_bpf_required
+def test_http2_info_map_layout_and_sharing():
+    st = SocketTraceSuite()
+    try:
+        suite = h2.Http2Suite(shared=st.maps)
+        try:
+            assert suite.maps.events.fd == st.maps.events.fd
+            suite.maps.set_info(777, reg_abi=True, tconn_off=8,
+                                fd_off=0, sysfd_off=16, stream_off=232)
+            got = struct.unpack("<IIIIII",
+                                suite.maps.http2_info.lookup_bytes(
+                                    struct.pack("<I", 777)))
+            assert got == (1, 8, 0, 16, 232, 0)
+        finally:
+            suite.close()
+    finally:
+        st.close()
+
+
+def test_event_wire_roundtrip():
+    ev = h2.pack_event(7, 0, b":method", b"GET")
+    assert len(ev) == 8 + h2.NAME_CAP + h2.VALUE_CAP
+    assert h2.parse_event(ev) == (7, 0, b":method", b"GET")
+    # caps enforced, end marker flag survives
+    long = h2.pack_event(9, h2.EV_FLAG_END, b"n" * 100, b"v" * 100)
+    stream, flags, name, value = h2.parse_event(long)
+    assert (stream, flags) == (9, h2.EV_FLAG_END)
+    assert len(name) == h2.NAME_CAP and len(value) == h2.VALUE_CAP
+    assert h2.parse_event(b"short") is None
+
+
+def _event_record(pid, tid, direction, ts, stream, flags, name=b"",
+                  value=b""):
+    return pack_record(pid, tid, direction, ts,
+                       h2.pack_event(stream, flags, name, value),
+                       fd=12, source=SOURCE_GO_HTTP2_UPROBE)
+
+
+def test_assembler_groups_headers_until_end_marker():
+    asm = h2.Http2Assembler()
+    recs = [
+        _event_record(10, 11, T_EGRESS, 1000, 5, 0, b":method", b"POST"),
+        _event_record(10, 11, T_EGRESS, 1001, 5, 0, b":path",
+                      b"/api/charge?id=4"),
+        _event_record(10, 11, T_EGRESS, 1002, 5, 0, b":authority",
+                      b"pay.svc"),
+        _event_record(10, 11, T_EGRESS, 1003, 5, 0, b"traceparent",
+                      b"00-aabb-ccdd-01"),
+    ]
+    for raw in recs:
+        assert asm.feed(parse_record(raw)) is None      # no END yet
+    block = asm.feed(parse_record(
+        _event_record(10, 11, T_EGRESS, 1004, 5, h2.EV_FLAG_END)))
+    assert block is not None
+    assert block.startswith(b"POST /api/charge?id=4 HTTP/2\r\n")
+    assert b"host: pay.svc\r\n" in block
+    assert b"traceparent: 00-aabb-ccdd-01\r\n" in block
+    assert asm.counters()["groups_pending"] == 0
+
+
+def test_assembler_keeps_streams_separate():
+    asm = h2.Http2Assembler()
+    asm.feed(parse_record(_event_record(1, 2, T_EGRESS, 1, 5, 0,
+                                        b":path", b"/a")))
+    asm.feed(parse_record(_event_record(1, 2, T_EGRESS, 2, 7, 0,
+                                        b":path", b"/b")))
+    blk5 = asm.feed(parse_record(
+        _event_record(1, 2, T_EGRESS, 3, 5, h2.EV_FLAG_END)))
+    blk7 = asm.feed(parse_record(
+        _event_record(1, 2, T_EGRESS, 4, 7, h2.EV_FLAG_END)))
+    assert b"/a HTTP/2" in blk5 and b"/b HTTP/2" in blk7
+
+
+def test_response_side_synthesizes_status_line():
+    block = h2.synthesize_block([(b":status", b"503"),
+                                 (b"content-type", b"text/plain")],
+                                T_INGRESS)
+    assert block.startswith(b"HTTP/2 503 \r\n")
+    assert b"content-type: text/plain\r\n" in block
+
+
+def test_http2_events_merge_into_tls_flagged_l7_rows():
+    """Events -> (tracer-internal) assembly -> merged l7 record with
+    version 2, the h2 method/path/host, trace context, TLS flag."""
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    tracer = EbpfTracer(vtap_id=4)
+    resolver = lambda pid, fd: (0x0A000001, 0x0A000002, 50001, 443)  # noqa
+    merged = []
+
+    def pump(raw):
+        got = tracer.feed_raw(raw, resolver=resolver)
+        if got:
+            merged.append(got)
+
+    for raw in (
+            _event_record(10, 11, T_EGRESS, 1000, 5, 0, b":method",
+                          b"GET"),
+            _event_record(10, 11, T_EGRESS, 1001, 5, 0, b":path",
+                          b"/orders/7"),
+            _event_record(10, 11, T_EGRESS, 1002, 5, 0, b":authority",
+                          b"orders.svc"),
+            _event_record(10, 11, T_EGRESS, 1003, 5, h2.EV_FLAG_END),
+            _event_record(10, 11, T_INGRESS, 2000, 5,
+                          h2.EV_FLAG_READ, b":status", b"200"),
+            _event_record(10, 11, T_INGRESS, 2001, 5,
+                          h2.EV_FLAG_READ | h2.EV_FLAG_END)):
+        pump(raw)
+    assert len(merged) == 1
+    m = flow_log_pb2.AppProtoLogsData.FromString(merged[0])
+    assert m.flags & 1                             # TLS source
+    assert m.version == "2"
+    assert m.req.req_type == "GET"
+    assert m.req.domain == "orders.svc"
+    assert m.resp.status == 200
+
+
+def test_plan_resolves_http2_sites(tmp_path):
+    import tests.test_uprobe_trace as tu
+
+    # crypto/tls-only binary: no http2 sites
+    path, text_off, half = tu._synthetic_go_elf(tmp_path)
+    assert h2.plan_go_http2(path) == []
+    # the net/http bundled spelling resolves to entry offsets
+    d2 = tmp_path / "h2"
+    d2.mkdir()
+    path2, text_off2, half2 = tu._synthetic_go_elf(
+        d2, symbols=(b"net/http.(*http2ClientConn).writeHeader",
+                     b"net/http.(*http2ClientConn).writeHeaders"))
+    specs = h2.plan_go_http2(path2)
+    assert {(s.role, s.offset) for s in specs} == {
+        ("header_write", text_off2),
+        ("end_write", text_off2 + half2)}
+    assert all(not s.retprobe for s in specs)
+
+
+def test_plan_requires_go_binary(tmp_path):
+    p = tmp_path / "notgo"
+    p.write_bytes(b"\x7fELF" + b"\0" * 100)
+    assert h2.plan_go_http2(str(p)) == []
+
+
+def test_feed_raw_transparently_assembles_http2_events():
+    """EbpfTracer.feed_raw on raw GO_HTTP2 records: the tracer runs
+    the assembler internally, so the live pump and replay paths need
+    no h2-specific wiring anywhere."""
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    tracer = EbpfTracer(vtap_id=6)
+    resolver = lambda pid, fd: (0x0A000001, 0x0A000002, 50002, 443)  # noqa
+    merged = []
+    for raw in (
+            _event_record(20, 21, T_EGRESS, 1000, 9, 0, b":method",
+                          b"DELETE"),
+            _event_record(20, 21, T_EGRESS, 1001, 9, 0, b":path",
+                          b"/cart/3"),
+            _event_record(20, 21, T_EGRESS, 1002, 9, h2.EV_FLAG_END),
+            _event_record(20, 21, T_INGRESS, 2000, 9,
+                          h2.EV_FLAG_READ, b":status", b"204"),
+            _event_record(20, 21, T_INGRESS, 2001, 9,
+                          h2.EV_FLAG_READ | h2.EV_FLAG_END)):
+        got = tracer.feed_raw(raw, resolver=resolver)
+        if got:
+            merged.append(got)
+    assert len(merged) == 1
+    m = flow_log_pb2.AppProtoLogsData.FromString(merged[0])
+    assert m.version == "2" and m.resp.status == 204
+    assert m.req.req_type == "DELETE"
+    assert m.flags & 1
+
+
+def test_assembler_expires_orphaned_groups():
+    """A group whose END marker was lost (ring overflow) must expire,
+    not pin a max_groups slot forever."""
+    asm = h2.Http2Assembler(timeout_ns=1_000)
+    asm.feed(parse_record(_event_record(1, 2, T_EGRESS, 100, 5, 0,
+                                        b":path", b"/lost")))
+    assert asm.counters()["groups_pending"] == 1
+    assert asm.expire(now_ns=100 + 2_000) == 1
+    assert asm.counters()["groups_pending"] == 0
+
+
+def test_assembler_keys_by_fd_not_tid():
+    """Two connections (fds) reusing stream id 1 must not merge; the
+    same fd's events from different tids MUST merge (goroutine
+    migration)."""
+    asm = h2.Http2Assembler()
+
+    def rec(fd, tid, *a, **kw):
+        raw = pack_record(1, tid, T_EGRESS, kw.pop("ts", 1),
+                          h2.pack_event(*a), fd=fd,
+                          source=SOURCE_GO_HTTP2_UPROBE)
+        return parse_record(raw)
+
+    asm.feed(rec(3, 10, 1, 0, b":path", b"/conn-a"))
+    asm.feed(rec(4, 10, 1, 0, b":path", b"/conn-b"))
+    # END for fd 3 arrives on ANOTHER tid: still completes the group
+    blk = asm.feed(rec(3, 99, 1, h2.EV_FLAG_END, b"", b""))
+    assert b"/conn-a HTTP/2" in blk and b"/conn-b" not in blk

@@ -189,7 +189,9 @@ def test_ssl_plan_resolves_symbols_in_a_real_so(tmp_path):
         assert find_ret_offsets(body), s.symbol
 
 
-def _synthetic_go_elf(tmp_path, version=b"go1.20.4", func_code=None):
+def _synthetic_go_elf(tmp_path, version=b"go1.20.4", func_code=None,
+                      symbols=(b"crypto/tls.(*Conn).Read",
+                               b"crypto/tls.(*Conn).Write")):
     """A minimal ET_DYN ELF64 with .text, .go.buildinfo (1.18+ inline
     layout), .symtab/.strtab carrying the crypto/tls symbols — enough
     for the Go inspection path without a Go toolchain in the image."""
@@ -200,7 +202,7 @@ def _synthetic_go_elf(tmp_path, version=b"go1.20.4", func_code=None):
     bi = (b"\xff Go buildinf:" + bytes([0, 8, 2])  # magic,pad,ptr,flags
           + b"\0" * 16 + bytes([len(version)]) + version)
     bi += b"\0" * ((16 - len(bi) % 16) % 16)
-    names = [b"", b"crypto/tls.(*Conn).Read", b"crypto/tls.(*Conn).Write"]
+    names = [b""] + list(symbols)
     strtab = b"\0".join(names) + b"\0"
     offs, o = [], 0
     for n in names:
