@@ -130,6 +130,30 @@ class ResourceModel:
             return self._rows.get((type, id))
 
     # -- updates -----------------------------------------------------------
+    def upsert(self, resource: Resource) -> bool:
+        """Atomic single-row create/update (no deletion scope at all —
+        unlike update_domain this can never remove anything). Returns
+        True when the row changed; subscribers see a one-row diff.
+        Exists for hot-path upserts (per-sync sub_domain rows) where a
+        whole-domain reconcile would be an O(domain) read-modify-write
+        race against concurrent syncs."""
+        with self._lock:
+            old = self._rows.get((resource.type, resource.id))
+            if old == resource:
+                return False
+            if old is not None and old.domain != resource.domain:
+                raise ValueError(
+                    f"resource {(resource.type, resource.id)} is owned "
+                    f"by domain {old.domain!r}")
+            self._rows[(resource.type, resource.id)] = resource
+            self.version += 1
+            self._save()
+        diff = DomainDiff(created=[resource] if old is None else [],
+                          updated=[resource] if old is not None else [])
+        for fn in self._subscribers:
+            fn(diff)
+        return True
+
     def subscribe(self, fn: Callable[[DomainDiff], None]) -> None:
         """Called after each update_domain with the diff (reference:
         recorder/pubsub feeding tagrecorder + resource-event emit)."""

@@ -76,6 +76,8 @@ class ControllerServer:
         from deepflow_tpu.controller.recorder import Recorder
         self.recorder = Recorder(model)
         self.cloud = CloudManager(self.recorder)
+        self.process_record_errors = 0
+        self._proc_record_calls = 0
         self.genesis_sync = GenesisSync(model, peers=genesis_peers or ())
         self.registry = registry
         self.monitor = monitor or FleetMonitor(registry)
@@ -187,6 +189,7 @@ class ControllerServer:
             # recorder debug surface (reference: deepflow-ctl recorder):
             # counters + soft-deleted rows still inside retention
             return {**self.recorder.counters(),
+                    "process_record_errors": self.process_record_errors,
                     "genesis": self.genesis_sync.counters(),
                     "tombstones_rows": [
                         {"type": r.type, "id": r.id, "name": r.name,
@@ -219,6 +222,10 @@ class ControllerServer:
                                       body.get("revision", ""),
                                       bool(body.get("boot")),
                                       processes=body.get("processes"))
+            if body.get("processes") and resp.get("gpids"):
+                self._record_processes(resp["vtap_id"],
+                                       body["processes"],
+                                       resp["gpids"])
             resp["platform_version"] = self.model.version
             resp["ingester"] = self.monitor.assign(body["ctrl_ip"],
                                                    body["host"])
@@ -371,6 +378,96 @@ class ControllerServer:
             return {"deleted": domain, "version": self.model.version}
         raise KeyError(path)
 
+    # one model domain holds every agent's reported processes; each
+    # vtap owns a SUB-DOMAIN inside it so one agent's refresh can
+    # never delete another's rows (the scoped-reconcile machinery
+    # built for attached k8s clusters, reused)
+    PROC_DOMAIN = "genesis-processes"
+
+    def _record_processes(self, vtap_id: int, processes: list,
+                          gpids: dict) -> None:
+        """Agent-reported processes -> `process` resource rows keyed
+        by their GLOBAL id (reference: the recorder's process updater
+        + tagrecorder ch_gprocess — what makes gprocess_id columns
+        humanize to process names in the querier). Failures are
+        counted, never allowed to fail the sync RPC itself."""
+        try:
+            # O(1) idempotent upsert of THIS vtap's sub_domain row:
+            # a whole-domain reconcile here would race concurrent
+            # syncs (two first-syncs each reading the list before the
+            # other's write -> mutual sub_domain deletion) and pay an
+            # O(model) scan per sync
+            self.model.upsert(make_resource(
+                "sub_domain", vtap_id, f"vtap-{vtap_id}",
+                domain=self.PROC_DOMAIN))
+            proc_rows = []
+            for p in processes[:4096]:
+                gpid = gpids.get(str(p.get("pid")))
+                if not gpid:
+                    continue
+                proc_rows.append(make_resource(
+                    "process", int(gpid),
+                    str(p.get("name") or p.get("pid")),
+                    domain=self.PROC_DOMAIN,
+                    sub_domain_id=vtap_id, pid=int(p["pid"]),
+                    start_time=int(p.get("start_time", 0)),
+                    vtap_id=vtap_id))
+            self.recorder.reconcile_sub_domain(
+                self.PROC_DOMAIN, vtap_id, proc_rows)
+            # amortized dead-vtap sweep: a decommissioned host's
+            # process inventory must not accumulate forever (its own
+            # reconcile never comes again) — every 256th recording
+            # sync pays one pruning pass
+            self._proc_record_calls += 1
+            if self._proc_record_calls % 256 == 0:
+                self.prune_dead_vtap_processes()
+        except (ValueError, KeyError, TypeError):
+            self.process_record_errors += 1
+
+    def prune_dead_vtap_processes(self,
+                                  ttl_s: float = 3600.0) -> int:
+        """Drop the process sub-domains of vtaps that no longer exist
+        or haven't synced within `ttl_s`; returns pruned vtap count."""
+        import time as _time
+        now = _time.time()
+        alive = {v.vtap_id for v in self.registry.list()
+                 if now - v.last_seen < ttl_s}
+        pruned = 0
+        for sd in self.model.list(type="sub_domain",
+                                  domain=self.PROC_DOMAIN):
+            if sd.id in alive:
+                continue
+            self.recorder.reconcile_sub_domain(self.PROC_DOMAIN,
+                                               sd.id, [])
+            self.model.update_domain(
+                self.PROC_DOMAIN,
+                [r for r in self.model.list(domain=self.PROC_DOMAIN)
+                 if not (r.type == "sub_domain" and r.id == sd.id)
+                 and not r.attr("sub_domain_id", 0)])
+            pruned += 1
+        return pruned
+
+    @staticmethod
+    def _endpoint_template_kw(body: dict, placeholder: str) -> dict:
+        """Validated endpoint_template pass-through shared by every
+        vendor branch: http(s) scheme, and ONLY the literal
+        {placeholder} (a typo'd or attribute-access template —
+        {regoin}, {region.__x__} — must 400 here, not fail on every
+        later gather)."""
+        if not body.get("endpoint_template"):
+            return {}
+        import re
+        tmpl = body["endpoint_template"]
+        scheme = urllib.parse.urlparse(tmpl).scheme
+        if scheme not in ("http", "https"):
+            raise ValueError("endpoint_template must be http(s)")
+        if not re.fullmatch(
+                r"[^{}]*(\{%s\}[^{}]*)+" % re.escape(placeholder),
+                tmpl):
+            raise ValueError(f"endpoint_template must contain "
+                             f"{{{placeholder}}} and no other braces")
+        return {"endpoint_template": tmpl}
+
     def _make_platform(self, body: dict):
         kind = body.get("platform", "filereader")
         if kind == "filereader":
@@ -410,20 +507,7 @@ class ControllerServer:
             if not body.get("secret_id") or not body.get("secret_key"):
                 raise ValueError("aws platform requires secret_id and "
                                  "secret_key")
-            kw = {}
-            if body.get("endpoint_template"):
-                import re
-                tmpl = body["endpoint_template"]
-                scheme = urllib.parse.urlparse(tmpl).scheme
-                if scheme not in ("http", "https"):
-                    raise ValueError("endpoint_template must be http(s)")
-                # only the literal {region} placeholder: a typo'd or
-                # attribute-access template ({regoin}, {region.__x__})
-                # must 400 here, not fail on every later gather
-                if not re.fullmatch(r"[^{}]*(\{region\}[^{}]*)+", tmpl):
-                    raise ValueError("endpoint_template must contain "
-                                     "{region} and no other braces")
-                kw["endpoint_template"] = tmpl
+            kw = self._endpoint_template_kw(body, "region")
             return AwsPlatform(
                 body["domain"], body["secret_id"], body["secret_key"],
                 regions=tuple(body.get("regions", ())),
@@ -437,22 +521,25 @@ class ControllerServer:
             if not body.get("secret_id") or not body.get("secret_key"):
                 raise ValueError("aliyun platform requires secret_id "
                                  "and secret_key")
-            kw = {}
-            if body.get("endpoint_template"):
-                import re
-                tmpl = body["endpoint_template"]
-                scheme = urllib.parse.urlparse(tmpl).scheme
-                if scheme not in ("http", "https"):
-                    raise ValueError("endpoint_template must be http(s)")
-                if not re.fullmatch(r"[^{}]*(\{region\}[^{}]*)+", tmpl):
-                    raise ValueError("endpoint_template must contain "
-                                     "{region} and no other braces")
-                kw["endpoint_template"] = tmpl
+            kw = self._endpoint_template_kw(body, "region")
             return AliyunPlatform(
                 body["domain"], body["secret_id"], body["secret_key"],
                 regions=tuple(body.get("regions", ())),
                 api_default_region=body.get("api_default_region",
                                             "cn-hangzhou"), **kw)
+        if kind == "tencent":
+            # reference domain-config keys (tencent.go NewTencent);
+            # endpoints are service-global ({service} placeholder, the
+            # region rides the X-TC-Region header)
+            from deepflow_tpu.controller.cloud_tencent import \
+                TencentPlatform
+            if not body.get("secret_id") or not body.get("secret_key"):
+                raise ValueError("tencent platform requires secret_id "
+                                 "and secret_key")
+            kw = self._endpoint_template_kw(body, "service")
+            return TencentPlatform(
+                body["domain"], body["secret_id"], body["secret_key"],
+                regions=tuple(body.get("regions", ())), **kw)
         raise ValueError(f"unknown platform kind {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------

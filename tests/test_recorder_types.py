@@ -254,3 +254,98 @@ def test_created_order_is_parents_first_for_vm():
     for child, links in PARENT_LINKS.items():
         for _attr, parent in links:
             assert idx[parent] < idx[child], (parent, child)
+
+
+def test_agent_reported_processes_become_gprocess_rows(tmp_path):
+    """The JSON sync's GPIDSync leg lands `process` resource rows
+    keyed by GLOBAL id, per-vtap sub-domain scoped, humanizable via
+    tagrecorder (reference: recorder process updater + ch_gprocess)."""
+    import json
+    import urllib.request
+
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+    from deepflow_tpu.controller.tagrecorder import TagRecorder
+
+    model = ResourceModel()
+    tr = TagRecorder(model)
+    reg = VTapRegistry()
+    srv = ControllerServer(model, reg, FleetMonitor(reg), port=0,
+                           tagrecorder=tr)
+    srv.start()
+    try:
+        def sync(ctrl_ip, host, procs):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/sync",
+                data=json.dumps({"ctrl_ip": ctrl_ip, "host": host,
+                                 "processes": procs}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.load(r)
+
+        r1 = sync("10.0.0.1", "n1",
+                  [{"pid": 100, "name": "nginx", "start_time": 5},
+                   {"pid": 200, "name": "envoy", "start_time": 6}])
+        r2 = sync("10.0.0.2", "n2",
+                  [{"pid": 100, "name": "redis", "start_time": 7}])
+        rows = {r.id: r for r in model.list(type="process")}
+        g_nginx = int(r1["gpids"]["100"])
+        g_redis = int(r2["gpids"]["100"])
+        assert rows[g_nginx].name == "nginx"
+        assert rows[g_redis].name == "redis"
+        assert g_nginx != g_redis           # same pid, two vtaps
+        # querier humanization surface: gprocess_id -> name
+        assert tr.column_name("gprocess_id_0", g_nginx) == "nginx"
+        # vtap 1 re-syncs with nginx gone: ITS row dies, vtap 2's stays
+        sync("10.0.0.1", "n1",
+             [{"pid": 200, "name": "envoy", "start_time": 6}])
+        rows = {r.id: r for r in model.list(type="process")}
+        assert g_nginx not in rows and g_redis in rows
+        assert srv.process_record_errors == 0
+    finally:
+        srv.close()
+
+
+def test_dead_vtap_process_rows_pruned(tmp_path):
+    """A decommissioned vtap's process inventory must not live
+    forever: the sweep drops its sub-domain and rows while live
+    vtaps' rows survive."""
+    import json
+    import urllib.request
+
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    model = ResourceModel()
+    reg = VTapRegistry()
+    srv = ControllerServer(model, reg, FleetMonitor(reg), port=0)
+    srv.start()
+    try:
+        def sync(ctrl_ip, host, procs):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/sync",
+                data=json.dumps({"ctrl_ip": ctrl_ip, "host": host,
+                                 "processes": procs}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.load(r)
+
+        sync("10.0.0.1", "n1", [{"pid": 1, "name": "a",
+                                 "start_time": 1}])
+        sync("10.0.0.2", "n2", [{"pid": 2, "name": "b",
+                                 "start_time": 2}])
+        assert len(model.list(type="process")) == 2
+        # age out vtap 1 only
+        for v in reg.list():
+            if v.host == "n1":
+                v.last_seen = 0.0
+        assert srv.prune_dead_vtap_processes(ttl_s=3600) == 1
+        procs = model.list(type="process")
+        assert [p.name for p in procs] == ["b"]
+        assert [s.name for s in model.list(type="sub_domain")] \
+            == [f"vtap-{procs[0].attr('vtap_id')}"]
+        assert srv.process_record_errors == 0
+    finally:
+        srv.close()
