@@ -39,12 +39,19 @@ from deepflow_tpu.controller.model import Resource, make_resource
 
 CVM_VERSION = "2017-03-12"
 VPC_VERSION = "2017-03-12"
+CLB_VERSION = "2018-03-17"
 PAGE_LIMIT = 100
 
 # actions whose Offset/Limit are Integer-typed; every OTHER paged
-# action takes them as STRINGS (the vpc service's documented shape —
-# tencent.go:47-49 pagesIntControl + :209-213's strconv branch)
-_INT_PAGED_ACTIONS = {"DescribeInstances"}
+# action takes them as STRINGS (tencent.go:47-55 pagesIntControl —
+# the FULL reference set, mirrored exactly — + :209-213's strconv
+# branch for the rest)
+_INT_PAGED_ACTIONS = {
+    "DescribeInstances", "DescribeNatGateways",
+    "DescribeLoadBalancers", "DescribeNetworkInterfaces",
+    "DescribeVpcPeerConnections",
+    "DescribeNatGatewayDestinationIpPortTranslationNatRules",
+}
 
 
 def tc3_signature(secret_key: str, service: str, payload: bytes,
@@ -219,4 +226,47 @@ class TencentPlatform:
                     epc_id=epc, vpc_id=epc,
                     ip=ips[0] if ips else "",
                     az=inst.get("Placement", {}).get("Zone", ""))
+            # NAT gateways + their floating ips (nat_gateway.go:35-80:
+            # NatGatewaySet rows carry PublicIpAddressSet)
+            for nat in self._paged("vpc", VPC_VERSION,
+                                   "DescribeNatGateways", region,
+                                   "NatGatewaySet"):
+                nid = nat.get("NatGatewayId", "")
+                if not nid:
+                    continue
+                epc = ids.get(("vpc", nat.get("VpcId", "")), 0)
+                nat_rid = add("nat_gateway", nid,
+                              nat.get("NatGatewayName") or nid,
+                              vpc_id=epc, region_id=region_id)
+                for ip_e in nat.get("PublicIpAddressSet") or []:
+                    ip = ip_e.get("PublicIpAddress", "")
+                    if ip:
+                        add("floating_ip", f"{nid}/{ip}", ip,
+                            vpc_id=epc, ip=ip,
+                            nat_gateway_id=nat_rid)
+            # CLB load balancers + listeners (lb.go:42-108)
+            for lb in self._paged("clb", CLB_VERSION,
+                                  "DescribeLoadBalancers", region,
+                                  "LoadBalancerSet"):
+                lid = lb.get("LoadBalancerId", "")
+                if not lid:
+                    continue
+                epc = ids.get(("vpc", lb.get("VpcId", "")), 0)
+                vips = lb.get("LoadBalancerVips") or []
+                lb_rid = add("lb", lid,
+                             lb.get("LoadBalancerName") or lid,
+                             vpc_id=epc, region_id=region_id,
+                             ip=vips[0] if vips else "",
+                             lb_model=lb.get("LoadBalancerType", ""))
+                lst = self._call("clb", CLB_VERSION,
+                                 "DescribeListeners", region,
+                                 {"LoadBalancerId": lid})
+                for ln in lst.get("Listeners", []):
+                    lnid = ln.get("ListenerId", "")
+                    if lnid:
+                        add("lb_listener", lnid,
+                            ln.get("ListenerName") or lnid,
+                            lb_id=lb_rid,
+                            port=int(ln.get("Port", 0)),
+                            protocol=ln.get("Protocol", ""))
         return out

@@ -32,8 +32,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 RESOURCE_TYPES = (
     "region", "az", "sub_domain", "host", "vpc", "vm", "subnet",
     "vrouter", "routing_table", "vinterface", "wan_ip", "lan_ip",
-    "floating_ip", "security_group", "security_group_rule",
+    "security_group", "security_group_rule",
     "nat_gateway", "nat_rule", "nat_vm_connection",
+    "floating_ip",      # links vpc+vm+nat_gateway: after all three
     "lb", "lb_listener", "lb_target_server", "lb_vm_connection",
     "peer_connection", "cen", "rds_instance", "redis_instance",
     "pod_cluster", "pod_node", "vm_pod_node_connection",

@@ -47,7 +47,8 @@ PARENT_LINKS: Dict[str, Tuple[Tuple[str, str], ...]] = {
     "vinterface": (("subnet_id", "subnet"),),
     "wan_ip": (("vinterface_id", "vinterface"),),
     "lan_ip": (("vinterface_id", "vinterface"),),
-    "floating_ip": (("vpc_id", "vpc"), ("vm_id", "vm")),
+    "floating_ip": (("vpc_id", "vpc"), ("vm_id", "vm"),
+                    ("nat_gateway_id", "nat_gateway")),
     "security_group_rule": (("security_group_id", "security_group"),),
     "nat_gateway": (("vpc_id", "vpc"),),
     "nat_rule": (("nat_gateway_id", "nat_gateway"),),

@@ -105,10 +105,12 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.loads(payload or b"{}")
         offset = int(body.get("Offset", 0))
         if "Offset" in body:
-            # vendor type strictness: cvm DescribeInstances takes
-            # Integer Offset/Limit, the vpc service takes STRINGS
-            # (tencent.go pagesIntControl)
-            want_int = action == "DescribeInstances"
+            # vendor type strictness (tencent.go pagesIntControl's
+            # exact set): these actions take Integer Offset/Limit,
+            # everything else STRINGS
+            want_int = action in ("DescribeInstances",
+                                  "DescribeNatGateways",
+                                  "DescribeLoadBalancers")
             if (isinstance(body["Offset"], int) != want_int
                     or isinstance(body["Limit"], int) != want_int):
                 srv.type_errors += 1
@@ -136,6 +138,23 @@ class _Handler(BaseHTTPRequestHandler):
                 {"SubnetId": "sub-{r}-1", "SubnetName": "net-{r}-1",
                  "CidrBlock": "10.3.1.0/24", "VpcId": "vpc-{r}",
                  "Zone": "{r}-1"}])}
+        elif action == "DescribeNatGateways":
+            resp = {"TotalCount": 1, "NatGatewaySet": fill([
+                {"NatGatewayId": "nat-{r}", "NatGatewayName": "gw-{r}",
+                 "VpcId": "vpc-{r}",
+                 "PublicIpAddressSet": [
+                     {"PublicIpAddress": "1.2.3.4"}]}])}
+        elif action == "DescribeLoadBalancers":
+            resp = {"TotalCount": 1, "LoadBalancerSet": fill([
+                {"LoadBalancerId": "clb-{r}",
+                 "LoadBalancerName": "web-lb-{r}",
+                 "LoadBalancerType": "OPEN", "VpcId": "vpc-{r}",
+                 "LoadBalancerVips": ["9.9.9.9"]}])}
+        elif action == "DescribeListeners":
+            resp = {"Listeners": [
+                {"ListenerId": f"lbl-{r}",
+                 "ListenerName": f"https-{r}",
+                 "Port": 443, "Protocol": "HTTPS"}]}
         elif action == "DescribeInstances":
             # two pages of one instance each: Offset paging must walk
             page = 0 if offset == 0 else 1
@@ -198,8 +217,24 @@ def test_gather_normalizes_and_paginates(recorder):
                      ("cvm", "DescribeInstances", "ap-beijing", 1),
                      ("cvm", "DescribeInstances", "ap-guangzhou", 0),
                      ("cvm", "DescribeInstances", "ap-guangzhou", 1)]
-    # vpc-service calls hit the vpc host
+    # vpc-service calls hit the vpc host, clb its own
     assert any(c[0] == "vpc" for c in recorder.calls)
+    assert any(c[0] == "clb" for c in recorder.calls)
+    # nat/lb families land with resolved links (the widened model)
+    nat = {r.name: dict(r.attrs) for r in by["nat_gateway"]}
+    assert nat["gw-ap-guangzhou"]["vpc_id"] == \
+        vpc_ids["prod-ap-guangzhou"]
+    fips = {r.name for r in by["floating_ip"]}
+    assert "1.2.3.4" in fips
+    # every listener links to ITS OWN region's lb — a driver that
+    # mislinked all listeners to the first lb would fail per-row here
+    lbs_by_id = {r.id: r.name for r in by["lb"]}
+    assert len(by["lb_listener"]) == 2
+    for ln in by["lb_listener"]:
+        attrs = dict(ln.attrs)
+        assert attrs["port"] == 443
+        region = ln.name.removeprefix("https-")
+        assert lbs_by_id[attrs["lb_id"]] == f"web-lb-{region}"
 
 
 def test_bad_secret_fails_auth(recorder):
