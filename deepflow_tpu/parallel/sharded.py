@@ -144,6 +144,19 @@ class ShardedFlowSuite(_ShardedSuiteBase):
                                    (state_specs, P(axis), P(axis)),
                                    state_specs)
 
+        def local_update_plane(state, plane, mask):
+            # the single-transfer full-row form (wire/columnar_wire
+            # decode_columnar_plane): plane is (n_cols, B) sharded on
+            # its BATCH axis; unpack happens per-shard on device
+            local = jax.tree.map(lambda x: x[0], state)
+            local = flow_suite.update_plane(local, plane, mask, cfg_)
+            return jax.tree.map(lambda x: x[None], local)
+
+        self._update_plane = self._shard(
+            local_update_plane,
+            (state_specs, P(None, axis), P(axis)), state_specs)
+        self._plane_sharding = NamedSharding(mesh, P(None, axis))
+
         def flush_fn(state):
             merged = _merge_axis0(state)
             # Re-score ring candidates against the globally-merged sketch:
@@ -163,6 +176,16 @@ class ShardedFlowSuite(_ShardedSuiteBase):
 
         self._flush = jax.jit(flush_fn, out_shardings=(
             jax.tree.map(lambda _: self._state_sharding, state_specs), None))
+
+    def put_plane(self, plane, mask):
+        """Device-place one (n_cols, B) full-row plane + mask, batch
+        axis sharded — ONE transfer per device instead of n_cols."""
+        return (jax.device_put(plane, self._plane_sharding),
+                jax.device_put(jnp.asarray(mask),
+                               self._batch_sharding))
+
+    def update_plane(self, state, plane, mask):
+        return self._update_plane(state, plane, mask)
 
 
 class ShardedAppSuite(_ShardedSuiteBase):

@@ -233,3 +233,41 @@ def test_sharded_app_suite_matches_single():
                                np.asarray(single_out.errors))
     np.testing.assert_allclose(np.asarray(out.rrt_quantiles),
                                np.asarray(single_out.rrt_quantiles))
+
+
+def test_sharded_plane_update_equals_cols_update(rng):
+    """The single-transfer (n_cols, B) plane form of the sharded
+    update lands the IDENTICAL state as the cols-dict form — the
+    multi-chip face of the full-row fused-transfer path."""
+    from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
+    from deepflow_tpu.wire import columnar_wire
+
+    cfg = FlowSuiteConfig(cms_log2_width=12, ring_size=256,
+                          hll_groups=64, hll_precision=8,
+                          conservative=False)
+    mesh = make_mesh()
+    sharded = ShardedFlowSuite(cfg, mesh)
+    s_cols = sharded.init()
+    s_plane = sharded.init()
+    agent = SyntheticAgent()
+    for _ in range(2):
+        base = agent.l4_columns_pooled(4096)
+        full = {}
+        for name, dt in SKETCH_L4_SCHEMA.columns:
+            col = base.get(name)
+            full[name] = (np.asarray(col).astype(dt)
+                          if col is not None
+                          else np.zeros(4096, dt))
+        payload = columnar_wire.encode_columnar(full, SKETCH_L4_SCHEMA)
+        plane, bad = columnar_wire.decode_columnar_plane(
+            payload, SKETCH_L4_SCHEMA)
+        assert bad == 0
+        mask = np.ones(4096, np.bool_)
+        dc = {k: jnp.asarray(v) for k, v in full.items()}
+        cd, md = sharded.put_batch(dc, jnp.asarray(mask))
+        s_cols = sharded.update(s_cols, cd, md)
+        pd_, md2 = sharded.put_plane(jnp.asarray(plane), mask)
+        s_plane = sharded.update_plane(s_plane, pd_, md2)
+    for a, b in zip(jax.tree_util.tree_leaves(s_cols),
+                    jax.tree_util.tree_leaves(s_plane)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
