@@ -40,6 +40,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from deepflow_tpu.controller.model import Resource, make_resource
 
 ECS_VERSION = "2014-05-26"
+# the VPC and SLB products are separate RPC APIs with their own hosts
+# and versions (reference: aliyun.go constructs vpc.Client/slb.Client
+# beside ecs.Client; vpc.go/nat_gateway.go/lb.go route through them)
+VPC_VERSION = "2016-04-28"
+SLB_VERSION = "2014-05-15"
 PAGE_SIZE = 50
 
 
@@ -70,7 +75,7 @@ class AliyunPlatform:
     def __init__(self, domain: str, access_key_id: str,
                  access_key_secret: str,
                  endpoint_template: str =
-                 "https://ecs.{region}.aliyuncs.com",
+                 "https://{product}.{region}.aliyuncs.com",
                  regions: Optional[Sequence[str]] = None,
                  api_default_region: str = "cn-hangzhou") -> None:
         self.domain = domain
@@ -81,11 +86,12 @@ class AliyunPlatform:
         self.api_default_region = api_default_region
 
     # -- wire --------------------------------------------------------------
-    def _call(self, region: str, action: str, **extra) -> dict:
+    def _call(self, region: str, action: str, product: str = "ecs",
+              version: str = ECS_VERSION, **extra) -> dict:
         params: Dict[str, object] = {
             "Action": action,
             "Format": "JSON",
-            "Version": ECS_VERSION,
+            "Version": version,
             "AccessKeyId": self.access_key_id,
             "SignatureMethod": "HMAC-SHA1",
             "SignatureVersion": "1.0",
@@ -97,20 +103,26 @@ class AliyunPlatform:
         params.update(extra)
         params["Signature"] = rpc_signature("GET", params,
                                             self.access_key_secret)
-        url = (self.endpoint_template.format(region=region) + "/?"
+        # {product} is optional in the template (a test fixture may
+        # serve every product from one host); format ignores the
+        # kwarg when the placeholder is absent
+        url = (self.endpoint_template.format(region=region,
+                                             product=product) + "/?"
                + urllib.parse.urlencode(params))
         with urllib.request.urlopen(url, timeout=30) as r:
             return json.load(r)
 
     def _paged(self, region: str, action: str, container: str,
-               item: str, **extra) -> List[dict]:
+               item: str, product: str = "ecs",
+               version: str = ECS_VERSION, **extra) -> List[dict]:
         """PageNumber/PageSize until TotalCount rows collected (vm.go's
         getVMResponse loop; guards against a lying TotalCount with a
         hard page cap)."""
         out: List[dict] = []
         page = 1
         while page < 1000:
-            doc = self._call(region, action, PageNumber=page,
+            doc = self._call(region, action, product=product,
+                             version=version, PageNumber=page,
                              PageSize=PAGE_SIZE, **extra)
             rows = doc.get(container, {}).get(item, [])
             out.extend(rows)
@@ -158,7 +170,8 @@ class AliyunPlatform:
                 if zid:
                     add("az", zid, zid, region_id=region_id)
             for vpc in self._paged(region, "DescribeVpcs",
-                                   "Vpcs", "Vpc"):
+                                   "Vpcs", "Vpc", product="vpc",
+                                   version=VPC_VERSION):
                 vid = vpc.get("VpcId", "")
                 if not vid:
                     continue
@@ -166,7 +179,9 @@ class AliyunPlatform:
                     region_id=region_id,
                     cidr=vpc.get("CidrBlock", ""))
             for sw in self._paged(region, "DescribeVSwitches",
-                                  "VSwitches", "VSwitch"):
+                                  "VSwitches", "VSwitch",
+                                  product="vpc",
+                                  version=VPC_VERSION):
                 sid = sw.get("VSwitchId", "")
                 if not sid:
                     continue
@@ -189,4 +204,38 @@ class AliyunPlatform:
                     epc_id=epc, vpc_id=epc,
                     ip=ips[0] if ips else "",
                     az=inst.get("ZoneId", ""))
+            # NAT gateways + their EIP floating ips
+            # (nat_gateway.go:45-80: IpLists.IpList[].IpAddress)
+            for nat in self._paged(region, "DescribeNatGateways",
+                                   "NatGateways", "NatGateway",
+                                   product="vpc",
+                                   version=VPC_VERSION):
+                nid = nat.get("NatGatewayId", "")
+                if not nid:
+                    continue
+                epc = ids.get(("vpc", nat.get("VpcId", "")), 0)
+                nat_rid = add("nat_gateway", nid,
+                              nat.get("Name") or nid,
+                              vpc_id=epc, region_id=region_id)
+                ip_list = nat.get("IpLists", {}).get("IpList", [])
+                for ip_e in ip_list:
+                    ip = ip_e.get("IpAddress", "")
+                    if ip:
+                        add("floating_ip", f"{nid}/{ip}", ip,
+                            vpc_id=epc, ip=ip,
+                            nat_gateway_id=nat_rid)
+            # SLB load balancers (lb.go:49-85; internet-facing rows
+            # carry the vip as Address)
+            for lb in self._paged(region, "DescribeLoadBalancers",
+                                  "LoadBalancers", "LoadBalancer",
+                                  product="slb",
+                                  version=SLB_VERSION):
+                lid = lb.get("LoadBalancerId", "")
+                if not lid:
+                    continue
+                epc = ids.get(("vpc", lb.get("VpcId", "")), 0)
+                add("lb", lid, lb.get("LoadBalancerName") or lid,
+                    vpc_id=epc, region_id=region_id,
+                    ip=lb.get("Address", ""),
+                    lb_model=lb.get("AddressType", ""))
         return out

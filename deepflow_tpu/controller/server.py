@@ -448,10 +448,12 @@ class ControllerServer:
         return pruned
 
     @staticmethod
-    def _endpoint_template_kw(body: dict, placeholder: str) -> dict:
+    def _endpoint_template_kw(body: dict, required: str,
+                              optional: tuple = ()) -> dict:
         """Validated endpoint_template pass-through shared by every
-        vendor branch: http(s) scheme, and ONLY the literal
-        {placeholder} (a typo'd or attribute-access template —
+        vendor branch: http(s) scheme, the literal {required}
+        placeholder present, and NO braces besides the allowed
+        placeholders (a typo'd or attribute-access template —
         {regoin}, {region.__x__} — must 400 here, not fail on every
         later gather)."""
         if not body.get("endpoint_template"):
@@ -461,11 +463,14 @@ class ControllerServer:
         scheme = urllib.parse.urlparse(tmpl).scheme
         if scheme not in ("http", "https"):
             raise ValueError("endpoint_template must be http(s)")
-        if not re.fullmatch(
-                r"[^{}]*(\{%s\}[^{}]*)+" % re.escape(placeholder),
-                tmpl):
+        names = "|".join(re.escape(n) for n in (required, *optional))
+        if not re.fullmatch(r"[^{}]*(\{(%s)\}[^{}]*)+" % names, tmpl) \
+                or ("{%s}" % required) not in tmpl:
+            allowed = ", ".join(f"{{{n}}}" for n in (required,
+                                                     *optional))
             raise ValueError(f"endpoint_template must contain "
-                             f"{{{placeholder}}} and no other braces")
+                             f"{{{required}}} and no braces besides "
+                             f"{allowed}")
         return {"endpoint_template": tmpl}
 
     def _make_platform(self, body: dict):
@@ -521,7 +526,10 @@ class ControllerServer:
             if not body.get("secret_id") or not body.get("secret_key"):
                 raise ValueError("aliyun platform requires secret_id "
                                  "and secret_key")
-            kw = self._endpoint_template_kw(body, "region")
+            # {product} optional: the real vendor routes vpc/slb
+            # actions to their own hosts (cloud_aliyun.py routing)
+            kw = self._endpoint_template_kw(body, "region",
+                                            optional=("product",))
             return AliyunPlatform(
                 body["domain"], body["secret_id"], body["secret_key"],
                 regions=tuple(body.get("regions", ())),

@@ -88,6 +88,7 @@ class _Recorder(ThreadingHTTPServer):
     def __init__(self):
         self.calls = []
         self.bad_signatures = 0
+        self.bad_versions = 0
         self.nonces = set()
         super().__init__(("127.0.0.1", 0), _Handler)
 
@@ -120,6 +121,20 @@ class _Handler(BaseHTTPRequestHandler):
         def fill(rows):
             return json.loads(json.dumps(rows).replace("{r}", r))
 
+        # product-API version fidelity (reference routes vpc/slb
+        # actions through their own clients): wrong Version = miss
+        ver = q.get("Version", "")
+        want_ver = {"DescribeVpcs": "2016-04-28",
+                    "DescribeVSwitches": "2016-04-28",
+                    "DescribeNatGateways": "2016-04-28",
+                    "DescribeLoadBalancers": "2014-05-15"}.get(
+            action, "2014-05-26")
+        if ver != want_ver:
+            srv.bad_versions += 1
+            self.send_response(400)
+            self.end_headers()
+            self.wfile.write(b'{"Code": "InvalidVersion"}')
+            return
         if action == "DescribeRegions":
             doc = {"Regions": {"Region": [
                 {"RegionId": "cn-hangzhou"}, {"RegionId": "cn-beijing"},
@@ -139,6 +154,20 @@ class _Handler(BaseHTTPRequestHandler):
                         "VSwitchName": "sw-{r}-1",
                         "CidrBlock": "10.2.1.0/24", "VpcId": "vpc-{r}",
                         "ZoneId": "{r}-a"}])}}
+        elif action == "DescribeNatGateways":
+            doc = {"TotalCount": 1, "PageNumber": page,
+                   "NatGateways": {"NatGateway": fill([
+                       {"NatGatewayId": "ngw-{r}", "Name": "gw-{r}",
+                        "VpcId": "vpc-{r}",
+                        "IpLists": {"IpList": [
+                            {"IpAddress": "8.8.4.4"}]}}])}}
+        elif action == "DescribeLoadBalancers":
+            doc = {"TotalCount": 1, "PageNumber": page,
+                   "LoadBalancers": {"LoadBalancer": fill([
+                       {"LoadBalancerId": "slb-{r}",
+                        "LoadBalancerName": "lb-{r}",
+                        "VpcId": "vpc-{r}", "Address": "7.7.7.7",
+                        "AddressType": "internet"}])}}
         elif action == "DescribeInstances":
             # TWO pages of one instance each: the PageNumber loop must
             # fetch both (TotalCount=2 > PageSize-agnostic row count)
@@ -178,6 +207,7 @@ def test_gather_normalizes_and_paginates(recorder):
     p.check_auth()
     rows = p.get_cloud_data()
     assert recorder.bad_signatures == 0
+    assert recorder.bad_versions == 0
     by = {}
     for r in rows:
         by.setdefault(r.type, []).append(r)
@@ -199,6 +229,14 @@ def test_gather_normalizes_and_paginates(recorder):
     sw_attrs = {r.name: dict(r.attrs) for r in by["subnet"]}
     assert sw_attrs["sw-cn-hangzhou-1"]["epc_id"] == \
         vpc_ids["prod-cn-hangzhou"]
+    # nat/lb families land with resolved links
+    vpc_hz = vpc_ids["prod-cn-hangzhou"]
+    nat = {r.name: dict(r.attrs) for r in by["nat_gateway"]}
+    assert nat["gw-cn-hangzhou"]["vpc_id"] == vpc_hz
+    assert any(r.name == "8.8.4.4" for r in by["floating_ip"])
+    lbs = {r.name: dict(r.attrs) for r in by["lb"]}
+    assert lbs["lb-cn-hangzhou"]["vpc_id"] == vpc_hz
+    assert lbs["lb-cn-hangzhou"]["ip"] == "7.7.7.7"
     pages = [c for c in recorder.calls if c[1] == "DescribeInstances"]
     assert sorted(pages) == [("cn-beijing", "DescribeInstances", 1),
                              ("cn-beijing", "DescribeInstances", 2),
@@ -265,3 +303,27 @@ def test_controller_drives_aliyun_domain(recorder):
             {v["name"] for v in vms}
     finally:
         srv.close()
+
+
+def test_endpoint_template_accepts_optional_product_placeholder():
+    """The ops API must accept {product}+{region} templates (the real
+    vendor's per-product hosts) and still reject typo'd braces."""
+    from deepflow_tpu.controller.server import ControllerServer
+
+    good = ControllerServer._endpoint_template_kw(
+        {"endpoint_template":
+         "https://{product}.{region}.example-proxy.com"},
+        "region", optional=("product",))
+    assert good["endpoint_template"].startswith("https://{product}")
+    ControllerServer._endpoint_template_kw(
+        {"endpoint_template": "https://ecs.{region}.example.com"},
+        "region", optional=("product",))     # region-only still fine
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ControllerServer._endpoint_template_kw(
+            {"endpoint_template": "https://{product}.example.com"},
+            "region", optional=("product",))  # required missing
+    with _pytest.raises(ValueError):
+        ControllerServer._endpoint_template_kw(
+            {"endpoint_template": "https://{regoin}.example.com"},
+            "region", optional=("product",))
