@@ -228,4 +228,27 @@ class AwsPlatform:
                         epc_id=epc, vpc_id=epc, ip=ip,
                         az=_text(inst, "placement/availabilityZone"),
                         subnet=_text(inst, "subnetId"))
+            # NAT gateways ride the SAME EC2 Query API (reference
+            # nat_gateway.go DescribeNatGateways); their public
+            # addresses land as nat-linked floating_ips
+            for nat in self._paged(region, "DescribeNatGateways",
+                                   "natGatewaySet"):
+                nid = _text(nat, "natGatewayId")
+                if not nid:
+                    continue
+                # deleted gateways linger in DescribeNatGateways for
+                # ~1h (their public IPs may already be reassigned);
+                # the reference keeps only available ones
+                # (aws/nat_gateway.go:60)
+                if _text(nat, "state") != "available":
+                    continue
+                epc = ids.get(("vpc", _text(nat, "vpcId")), 0)
+                nat_rid = add("nat_gateway", nid, _tag_name(nat, nid),
+                              vpc_id=epc, region_id=region_id)
+                for addr in _items(nat, "natGatewayAddressSet"):
+                    ip = _text(addr, "publicIp")
+                    if ip:
+                        add("floating_ip", f"{nid}/{ip}", ip,
+                            vpc_id=epc, ip=ip,
+                            nat_gateway_id=nat_rid)
         return out

@@ -37,6 +37,25 @@ def test_sigv4_official_aws_test_vector():
         "956d9b8aae1d763fbf31")
 
 
+_NAT_XML = """<DescribeNatGatewaysResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <natGatewaySet>
+    <item><natGatewayId>nat-{r}</natGatewayId><vpcId>vpc-{r}1</vpcId>
+      <state>available</state>
+      <natGatewayAddressSet>
+        <item><publicIp>3.3.{o}.3</publicIp></item>
+      </natGatewayAddressSet>
+      <tagSet><item><key>Name</key><value>gw-{r}</value></item></tagSet>
+    </item>
+    <item><natGatewayId>nat-{r}-dead</natGatewayId><vpcId>vpc-{r}1</vpcId>
+      <state>deleted</state>
+      <natGatewayAddressSet>
+        <item><publicIp>9.9.{o}.9</publicIp></item>
+      </natGatewayAddressSet>
+    </item>
+  </natGatewaySet>
+</DescribeNatGatewaysResponse>"""
+
+
 # -- fixture recorder ------------------------------------------------------
 _REGIONS_XML = """<DescribeRegionsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
   <regionInfo>
@@ -160,6 +179,9 @@ class _Recorder(ThreadingHTTPServer):
             if form.get("NextToken") == "PAGE2TOKEN":
                 return _INSTANCES_PAGE2.format(r=region)
             return _INSTANCES_PAGE1.format(r=region)
+        if a == "DescribeNatGateways":
+            return _NAT_XML.format(r=region,
+                                   o=1 if region == "us-east-1" else 2)
         raise AssertionError(f"unexpected action {a}")
 
 
@@ -204,6 +226,19 @@ def test_gather_normalizes_regions_vpcs_subnets_vms(recorder):
     subnet_attrs = {r.name: dict(r.attrs) for r in by["subnet"]}
     assert subnet_attrs["subnet-us-east-11"]["epc_id"] == \
         vpc_ids["prod-us-east-1"]
+    # NAT gateways + nat-linked floating ips (same EC2 Query wire);
+    # deleted-state gateways and their (possibly reassigned) IPs are
+    # FILTERED like the reference does
+    nat_ids = {r.name: r.id for r in by["nat_gateway"]}
+    nat_attrs = {r.name: dict(r.attrs) for r in by["nat_gateway"]}
+    assert set(nat_ids) == {"gw-us-east-1", "gw-eu-west-1"}
+    assert nat_attrs["gw-us-east-1"]["vpc_id"] == \
+        vpc_ids["prod-us-east-1"]
+    fips = {r.name: dict(r.attrs) for r in by["floating_ip"]}
+    # per-region IPs link to THEIR OWN region's gateway, exactly
+    assert fips["3.3.1.3"]["nat_gateway_id"] == nat_ids["gw-us-east-1"]
+    assert fips["3.3.2.3"]["nat_gateway_id"] == nat_ids["gw-eu-west-1"]
+    assert not any(n.startswith("9.9.") for n in fips)
     # region fan-out actually happened (distinct endpoints by path)
     regions_hit = {c[0] for c in recorder.calls}
     assert regions_hit == {"us-east-1", "eu-west-1"}
