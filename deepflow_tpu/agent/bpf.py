@@ -73,7 +73,7 @@ BPF_ADD, BPF_SUB, BPF_AND, BPF_OR = 0x00, 0x10, 0x50, 0x40
 BPF_LSH, BPF_RSH, BPF_ARSH = 0x60, 0x70, 0xc0
 BPF_MOV = 0xb0
 BPF_JA, BPF_JEQ, BPF_JNE, BPF_JGT, BPF_JGE = 0x00, 0x10, 0x50, 0x20, 0x30
-BPF_JLT, BPF_JSET = 0xa0, 0x40
+BPF_JLT, BPF_JLE, BPF_JSET = 0xa0, 0xb0, 0x40
 BPF_JSGT, BPF_JSLE = 0x60, 0xd0
 BPF_K, BPF_X = 0x00, 0x08
 BPF_EXIT, BPF_CALL = 0x90, 0x80

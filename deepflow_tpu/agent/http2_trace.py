@@ -35,11 +35,12 @@ This module rebuilds that on the in-tree toolkit:
 - `plan_go_http2` resolves the probe sites (net/http and vendored
   golang.org/x/net/http2 symbol spellings, like go_tracer.c's table).
 
-The reference's server-side processHeaders slice walk (a bounded
-in-probe loop over hpack fields) is NOT authored here — read-side
-visibility comes from the Go-TLS uprobes' plaintext byte stream
-through the ordinary HTTP/2/HPACK parser; this suite adds the
-write-side header events that have no byte-stream equivalent.
+The server-side processHeaders slice walk IS authored too
+(`build_process_headers`): a bounded unrolled loop (the reference's
+`#pragma unroll` 9-field cap) copies already-HPACK-decoded
+hpack.HeaderField entries from the MetaHeadersFrame's Fields slice,
+one READ event each plus the READ|END marker with the frame's stream
+id — the read-side leg for traffic whose byte stream is unreachable.
 """
 
 from __future__ import annotations
@@ -49,7 +50,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW, BPF_JEQ, BPF_JGT,
-                                    BPF_JLT, BPF_LSH,
+                                    BPF_JLE, BPF_JLT, BPF_LSH,
                                     BPF_MAP_TYPE_HASH, BPF_OR,
                                     BPF_PROG_TYPE_KPROBE, BPF_RSH,
                                     BPF_SUB, BPF_W,
@@ -80,6 +81,24 @@ _PT_SI, _PT_DI = 104, 112
 # the interface's net.conn fd walk reuses the tls defaults)
 GO_HTTP2_DEFAULT_INFO = {"tconn_off": 8, "fd_off": 0, "sysfd_off": 16,
                          "stream_off": 176}
+
+# server-side (http2serverConn) walk constants — the go_tracer.c
+# data_members defaults: serverConn.conn at +16,
+# MetaHeadersFrame.Fields at +8, FrameHeader.StreamID at +8 after one
+# deref; hpack.HeaderField is {Name string, Value string, Sensitive
+# bool} = 40B stride
+_SRV_CONN_OFF = 16
+_FIELDS_OFF = 8
+_FRAME_STREAM_OFF = 8
+_FIELD_STRIDE = 40
+MAX_FIELDS = 9           # the reference's unrolled bound (#pragma
+                         # unroll for idx < 9, go_http2_bpf.c:476)
+
+# extra stack slots (below uprobe_trace's frame, which ends at -312)
+_FRAME = -328            # saved MetaHeadersFrame*
+_FIELDSV = -344          # fields slice {data ptr, len} (16B)
+_FIELD = -384            # one copied HeaderField (40B)
+_STREAMSV = -392         # stream id
 
 # event layout inside the SOCK_DATA payload (offsets from _REC+64):
 #   u32 stream | u8 flags | u8 name_len | u8 value_len | u8 pad
@@ -220,6 +239,30 @@ def _zero_record(a: Asm) -> None:
         a.st_imm(BPF_DW, R10, _REC + 8 * k, 0)
 
 
+def _clamp_reg(a: Asm, reg: int, cap: int, tag: str) -> None:
+    """Immediate-bound clamp (the verifier-trackable form) shared by
+    every name/value length in this module — ONE copy of the caps
+    contract."""
+    a.jmp_imm(BPF_JGT, reg, cap, f"clamp_{tag}")
+    a.jmp(f"ok_{tag}")
+    a.label(f"clamp_{tag}").mov_imm(reg, cap)
+    a.label(f"ok_{tag}")
+
+
+def _pack_flags_word(a: Asm, flags: int) -> None:
+    """R8=name_len, R9=value_len -> the packed little-endian event
+    word (flags | name_len<<8 | value_len<<16) at payload+4 — ONE
+    copy of the wire layout parse_event reads back."""
+    a.mov_reg(R1, R9)
+    a.mov_reg(R2, R8)
+    a.alu_imm(BPF_LSH, R1, 16)
+    a.alu_imm(BPF_LSH, R2, 8)
+    a.alu_reg(BPF_OR, R1, R2)
+    if flags:
+        a.alu_imm(BPF_OR, R1, flags)
+    a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF + 4)
+
+
 def build_header_event(maps: Http2Maps, direction: int) -> Asm:
     """uprobe on writeHeader(name, value string) (go_http2_bpf.c:540):
     one per-header event. Register ABI: receiver AX, name {ptr BX,
@@ -246,29 +289,11 @@ def build_header_event(maps: Http2Maps, direction: int) -> Asm:
     a.alu_imm(BPF_SUB, R1, 2)
     a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF)
     a.label("no_stream")
-    # clamped name length -> flags byte area
     a.ldx_mem(BPF_DW, R8, R6, _PT_CX)              # name len
-    a.jmp_imm(BPF_JGT, R8, NAME_CAP, "nclamp")
-    a.jmp("nok")
-    a.label("nclamp").mov_imm(R8, NAME_CAP)
-    a.label("nok")
-    a.stx_mem(BPF_W, R10, R8, _SCRATCH)            # scratch: name_len
+    _clamp_reg(a, R8, NAME_CAP, "n")
     a.ldx_mem(BPF_DW, R9, R6, _PT_SI)              # value len
-    a.jmp_imm(BPF_JGT, R9, VALUE_CAP, "vclamp")
-    a.jmp("vok")
-    a.label("vclamp").mov_imm(R9, VALUE_CAP)
-    a.label("vok")
-    # event header: ONE packed little-endian W at payload+4 —
-    # flags(0) | name_len<<8 | value_len<<16 (byte-granular reg
-    # stores at these offsets would need three narrow stx's; the
-    # packed word is one store and parse_event's <IBBBx reads it back
-    # byte-exact)
-    a.mov_reg(R1, R9)                              # value_len
-    a.mov_reg(R2, R8)                              # name_len
-    a.alu_imm(BPF_LSH, R1, 16)
-    a.alu_imm(BPF_LSH, R2, 8)
-    a.alu_reg(BPF_OR, R1, R2)
-    a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF + 4)
+    _clamp_reg(a, R9, VALUE_CAP, "v")
+    _pack_flags_word(a, 0)
     # name copy (bounded by the clamp above)
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1,
                                _REC + _PAYLOAD_OFF + 8)
@@ -304,6 +329,94 @@ def build_headers_end(maps: Http2Maps, direction: int) -> Asm:
     return a
 
 
+def build_process_headers(maps: Http2Maps) -> Asm:
+    """uprobe on (*http2serverConn).processHeaders(f
+    *http2MetaHeadersFrame) — the server-side READ leg
+    (go_http2_bpf.c:648-681 + submit_http2_headers:451-496): walk up
+    to MAX_FIELDS already-HPACK-decoded header fields from the
+    frame's Fields slice, one event each (EV_FLAG_READ), then the
+    END marker carrying the frame's stream id. The per-binary struct
+    offsets use the reference defaults baked above (a per-process
+    override would need a second map row; subset documented)."""
+    a = Asm()
+    _prologue(a, maps)
+    # frame* = arg 2 (BX, register ABI — the prologue gated on it)
+    a.ldx_mem(BPF_DW, R8, R6, _PT_BX)
+    a.stx_mem(BPF_DW, R10, R8, _FRAME)
+    # fd via the serverConn.conn walk: override the prologue's
+    # client-side tconn offset with the server struct's
+    a.st_imm(BPF_W, R10, _SCRATCH, _SRV_CONN_OFF)
+    _fd_walk(a)
+    # stream: p = *(frame); stream = *(u32)(p + _FRAME_STREAM_OFF)
+    a.ldx_mem(BPF_DW, R3, R10, _FRAME)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _FIELDSV)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.ldx_mem(BPF_DW, R3, R10, _FIELDSV)
+    a.alu_imm(BPF_ADD, R3, _FRAME_STREAM_OFF)
+    a.st_imm(BPF_DW, R10, _STREAMSV, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _STREAMSV)
+    a.mov_imm(R2, 4)
+    a.call(FN_probe_read)
+    # fields slice {data, len} at frame + _FIELDS_OFF, one 16B read
+    a.ldx_mem(BPF_DW, R3, R10, _FRAME)
+    a.alu_imm(BPF_ADD, R3, _FIELDS_OFF)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _FIELDSV)
+    a.mov_imm(R2, 16)
+    a.call(FN_probe_read)
+    # a faulted frame walk zero-fills: a NULL fields pointer means
+    # nothing was decoded — emit NOTHING (an unconditional END marker
+    # would fabricate an empty 200-status block downstream)
+    a.ldx_mem(BPF_DW, R1, R10, _FIELDSV)
+    a.jmp_imm(BPF_JEQ, R1, 0, "done")
+
+    def _one_record(end: bool) -> None:
+        """Zero + fill + emit one event record; for non-end records
+        the caller copied name/value into _FIELD first."""
+        _zero_record(a)
+        a.ldx_mem(BPF_DW, R1, R10, _STREAMSV)
+        a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF)
+        if end:
+            a.st_imm(BPF_W, R10, _REC + _PAYLOAD_OFF + 4,
+                     EV_FLAG_READ | EV_FLAG_END)
+        else:
+            # name/value lens were clamped into R8/R9 by the caller
+            _pack_flags_word(a, EV_FLAG_READ)
+            # bounded copies from the field's go-string pointers
+            a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1,
+                                       _REC + _PAYLOAD_OFF + 8)
+            a.mov_reg(R2, R8)
+            a.ldx_mem(BPF_DW, R3, R10, _FIELD + 0)     # name.ptr
+            a.call(FN_probe_read)
+            a.mov_reg(R1, R10).alu_imm(
+                BPF_ADD, R1, _REC + _PAYLOAD_OFF + 8 + NAME_CAP)
+            a.mov_reg(R2, R9)
+            a.ldx_mem(BPF_DW, R3, R10, _FIELD + 16)    # value.ptr
+            a.call(FN_probe_read)
+        _emit_event(a, maps, T_INGRESS)
+
+    for idx in range(MAX_FIELDS):
+        # if fields.len <= idx: done (the reference's unrolled bound)
+        a.ldx_mem(BPF_DW, R1, R10, _FIELDSV + 8)
+        a.jmp_imm(BPF_JLE, R1, idx, "fields_done")
+        # copy HeaderField idx: {name{ptr,len}, value{ptr,len}, ...}
+        a.ldx_mem(BPF_DW, R3, R10, _FIELDSV)
+        a.alu_imm(BPF_ADD, R3, idx * _FIELD_STRIDE)
+        a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _FIELD)
+        a.mov_imm(R2, 32)          # name ptr/len + value ptr/len
+        a.call(FN_probe_read)
+        a.ldx_mem(BPF_DW, R8, R10, _FIELD + 8)         # name.len
+        _clamp_reg(a, R8, NAME_CAP, f"n{idx}")
+        a.ldx_mem(BPF_DW, R9, R10, _FIELD + 24)        # value.len
+        _clamp_reg(a, R9, VALUE_CAP, f"v{idx}")
+        _one_record(end=False)
+    a.label("fields_done")
+    _one_record(end=True)
+    a.label("done")
+    a.exit_imm(0)
+    return a
+
+
 class Http2Suite:
     """Loaded program set (all kernel-verifier-checked)."""
 
@@ -316,7 +429,8 @@ class Http2Suite:
                     lambda: build_header_event(self.maps, T_EGRESS),
                     lambda: build_header_event(self.maps, T_INGRESS),
                     lambda: build_headers_end(self.maps, T_EGRESS),
-                    lambda: build_headers_end(self.maps, T_INGRESS)):
+                    lambda: build_headers_end(self.maps, T_INGRESS),
+                    lambda: build_process_headers(self.maps)):
                 loaded.append(load(builder().assemble(),
                                    prog_type=BPF_PROG_TYPE_KPROBE))
         except OSError:
@@ -325,13 +439,15 @@ class Http2Suite:
             self.maps.close()
             raise
         (self.header_write, self.header_read,
-         self.end_write, self.end_read) = loaded
+         self.end_write, self.end_read,
+         self.process_headers) = loaded
 
     def programs(self) -> Dict[str, Program]:
         return {"header_write": self.header_write,
                 "header_read": self.header_read,
                 "end_write": self.end_write,
-                "end_read": self.end_read}
+                "end_read": self.end_read,
+                "process_headers": self.process_headers}
 
     def close(self) -> None:
         for p in self.programs().values():
@@ -375,6 +491,10 @@ HTTP2_SYMBOLS = {
         ("end_write", T_EGRESS),
     "golang.org/x/net/http2.(*ClientConn).writeHeaders":
         ("end_write", T_EGRESS),
+    "net/http.(*http2serverConn).processHeaders":
+        ("process_headers", T_INGRESS),
+    "golang.org/x/net/http2.(*serverConn).processHeaders":
+        ("process_headers", T_INGRESS),
 }
 
 

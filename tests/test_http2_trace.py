@@ -19,12 +19,13 @@ _bpf_required = pytest.mark.skipif(not bpf.available(),
 
 
 @_bpf_required
-def test_all_four_programs_pass_the_verifier():
+def test_all_five_programs_pass_the_verifier():
     suite = h2.Http2Suite()
     try:
         progs = suite.programs()
         assert sorted(progs) == ["end_read", "end_write",
-                                 "header_read", "header_write"]
+                                 "header_read", "header_write",
+                                 "process_headers"]
         assert all(p.fd >= 0 for p in progs.values())
     finally:
         suite.close()
@@ -229,3 +230,77 @@ def test_assembler_keys_by_fd_not_tid():
     # END for fd 3 arrives on ANOTHER tid: still completes the group
     blk = asm.feed(rec(3, 99, 1, h2.EV_FLAG_END, b"", b""))
     assert b"/conn-a HTTP/2" in blk and b"/conn-b" not in blk
+
+
+def test_plan_includes_server_side_process_headers(tmp_path):
+    import tests.test_uprobe_trace as tu
+
+    d = tmp_path / "srv"
+    d.mkdir()
+    path, text_off, half = tu._synthetic_go_elf(
+        d, symbols=(b"net/http.(*http2serverConn).processHeaders",
+                    b"golang.org/x/net/http2.(*ClientConn).writeHeader"))
+    specs = h2.plan_go_http2(path)
+    assert {(s.role, s.offset) for s in specs} == {
+        ("process_headers", text_off),
+        ("header_write", text_off + half)}
+
+
+def test_server_read_events_merge_with_client_write_block():
+    """The server-side leg's record shape (per-field READ events +
+    READ|END marker, the processHeaders program's output contract)
+    pairs with a client write block into one merged l7 session."""
+    from deepflow_tpu.wire.gen import flow_log_pb2
+
+    tracer = EbpfTracer(vtap_id=8)
+    resolver = lambda pid, fd: (0x0A000001, 0x0A000002, 50003, 443)  # noqa
+    merged = []
+    for raw in (
+            # client write side
+            _event_record(40, 41, T_EGRESS, 1000, 7, 0, b":method",
+                          b"GET"),
+            _event_record(40, 41, T_EGRESS, 1001, 7, 0, b":path",
+                          b"/inventory"),
+            _event_record(40, 41, T_EGRESS, 1002, 7, h2.EV_FLAG_END),
+            # server processHeaders leg: direction INGRESS via flags
+            _event_record(40, 42, T_INGRESS, 2000, 7,
+                          h2.EV_FLAG_READ, b":status", b"200"),
+            _event_record(40, 42, T_INGRESS, 2001, 7,
+                          h2.EV_FLAG_READ, b"content-type",
+                          b"application/json"),
+            _event_record(40, 42, T_INGRESS, 2002, 7,
+                          h2.EV_FLAG_READ | h2.EV_FLAG_END)):
+        got = tracer.feed_raw(raw, resolver=resolver)
+        if got:
+            merged.append(got)
+    assert len(merged) == 1
+    m = flow_log_pb2.AppProtoLogsData.FromString(merged[0])
+    assert m.resp.status == 200 and m.version == "2"
+
+
+def test_server_read_request_leg_without_response_expires_cleanly():
+    """The REALISTIC processHeaders shape: the server's READ leg
+    carries the CLIENT'S request pseudo-headers (:method/:path), and
+    the server's own response (writeHeaders, unprobed server-side)
+    never arrives — the request must synthesize as an ingress REQUEST
+    block, park unpaired, and expire without leaking groups."""
+    tracer = EbpfTracer(vtap_id=9)
+    resolver = lambda pid, fd: (0x0A000002, 0x0A000001, 443, 50005)  # noqa
+    outs = []
+    for raw in (
+            _event_record(60, 61, T_INGRESS, 1_000_000_000, 13,
+                          h2.EV_FLAG_READ, b":method", b"GET"),
+            _event_record(60, 61, T_INGRESS, 1_000_000_001, 13,
+                          h2.EV_FLAG_READ, b":path", b"/healthz"),
+            _event_record(60, 61, T_INGRESS, 1_000_000_002, 13,
+                          h2.EV_FLAG_READ | h2.EV_FLAG_END)):
+        outs.append(tracer.feed_raw(raw, resolver=resolver))
+    assert outs == [None, None, None]       # request parked, unpaired
+    agg = tracer.sessions
+    assert agg.merged == 0
+    # the h2 assembler holds no pending groups (END consumed it) and
+    # the parked session expires on the window like any other
+    assert tracer._http2.counters()["groups_pending"] == 0
+    dropped_before = agg.unpaired
+    agg.expire(now_ns=1_000_000_002 + 61 * 1_000_000_000)
+    assert agg.unpaired > dropped_before
