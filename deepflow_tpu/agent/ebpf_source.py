@@ -342,7 +342,10 @@ class EbpfTracer:
                 for pid, sp in items]
 
     def counters(self) -> dict:
-        return {"records_in": self.records_in,
-                "parse_failed": self.parse_failed,
-                "trace_map_entries": len(self._trace_map),
-                "next_trace_id": self._next_trace_id}
+        out = {"records_in": self.records_in,
+               "parse_failed": self.parse_failed,
+               "trace_map_entries": len(self._trace_map),
+               "next_trace_id": self._next_trace_id}
+        if self._http2 is not None:
+            out["http2"] = self._http2.counters()
+        return out

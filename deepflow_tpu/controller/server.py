@@ -548,6 +548,28 @@ class ControllerServer:
             return TencentPlatform(
                 body["domain"], body["secret_id"], body["secret_key"],
                 regions=tuple(body.get("regions", ())), **kw)
+        if kind == "huawei":
+            # reference domain-config keys (huawei/config.go): IAM
+            # password identity + project scoping; token-lifecycle
+            # auth, so no secret_id/secret_key pair here
+            from deepflow_tpu.controller.cloud_huawei import \
+                HuaweiPlatform
+            for k in ("account_name", "iam_name", "password",
+                      "project_name", "project_id", "iam_endpoint"):
+                if not body.get(k):
+                    raise ValueError(f"huawei platform requires {k}")
+            scheme = urllib.parse.urlparse(body["iam_endpoint"]).scheme
+            if scheme not in ("http", "https"):
+                raise ValueError("iam_endpoint must be http(s)")
+            kw = self._endpoint_template_kw(body, "service")
+            if not kw:
+                raise ValueError(
+                    "huawei platform requires endpoint_template")
+            return HuaweiPlatform(
+                body["domain"], body["account_name"],
+                body["iam_name"], body["password"],
+                body["project_name"], body["project_id"],
+                body["iam_endpoint"], kw["endpoint_template"])
         raise ValueError(f"unknown platform kind {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------
