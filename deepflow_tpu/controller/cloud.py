@@ -56,6 +56,57 @@ def _stable_id(domain: str, rtype: str, name: str) -> int:
     return 1 + (fnv1a32(f"{domain}|{rtype}|{name}".encode()) & 0x3FFFFFF)
 
 
+class ResourceBuilder:
+    """Shared row builder for the vendor clients (aws/aliyun/tencent/
+    huawei/qingcloud/baidubce): ids are CONTENT-STABLE 26-bit hashes
+    of (domain, type, vendor key) — the role lcuuid plays in the
+    reference — so re-polls and row-order changes keep every id (a
+    local 1..N counter reshuffled on reorders and collided across
+    domains on the same controller).
+
+    Collision honesty (the id space is 26-bit because vendor ids flow
+    into i32/u32 KnowledgeGraph columns): a WITHIN-domain hash
+    collision (~1.5e-8 per pair) re-salts deterministically per key
+    (key#1, key#2, ...) and is counted in `collisions` — the colliding
+    key's id is then stable only while the winning key keeps first
+    insertion, so treat a nonzero counter as a prompt to rename.
+    CROSS-domain collisions are not resolvable here (the model keys
+    rows by (type, id) globally); the recorder rejects that domain's
+    snapshot LOUDLY ("owned by domain X") rather than silently
+    merging two vendors' resources."""
+
+    def __init__(self, domain: str) -> None:
+        self.domain = domain
+        self._ids: Dict[tuple, int] = {}
+        self._used: Dict[str, set] = {}     # rtype -> {id}
+        self._rows: List[Resource] = []
+        self.collisions = 0
+
+    def add(self, rtype: str, key: str, name: str, **attrs) -> int:
+        rid = self._ids.get((rtype, key))
+        if rid is None:
+            used = self._used.setdefault(rtype, set())
+            rid = _stable_id(self.domain, rtype, str(key))
+            salt = 0
+            while rid in used:
+                self.collisions += 1
+                salt += 1
+                rid = _stable_id(self.domain, rtype,
+                                 f"{key}#{salt}")
+            used.add(rid)
+            self._ids[(rtype, key)] = rid
+            self._rows.append(make_resource(rtype, rid, name,
+                                            domain=self.domain,
+                                            **attrs))
+        return rid
+
+    def get(self, rtype: str, key: str, default: int = 0) -> int:
+        return self._ids.get((rtype, key), default)
+
+    def rows(self) -> List[Resource]:
+        return self._rows
+
+
 def rows_to_resources(rows: Sequence[dict], domain: str) -> List[Resource]:
     """Normalized snapshot rows ({type, id?, name, ...attrs}) ->
     Resource list. Shared by HttpPlatform and the controller's

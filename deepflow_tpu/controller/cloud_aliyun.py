@@ -35,9 +35,10 @@ import time
 import urllib.parse
 import urllib.request
 import uuid
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
-from deepflow_tpu.controller.model import Resource, make_resource
+from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.model import Resource
 
 ECS_VERSION = "2014-05-26"
 # the VPC and SLB products are separate RPC APIs with their own hosts
@@ -148,19 +149,8 @@ class AliyunPlatform:
         return names
 
     def get_cloud_data(self) -> List[Resource]:
-        out: List[Resource] = []
-        ids: Dict[Tuple[str, str], int] = {}
-        next_id = [1]
-
-        def add(rtype: str, key: str, name: str, **attrs) -> int:
-            rid = ids.get((rtype, key))
-            if rid is None:
-                rid = next_id[0]
-                next_id[0] += 1
-                ids[(rtype, key)] = rid
-                out.append(make_resource(rtype, rid, name,
-                                         domain=self.domain, **attrs))
-            return rid
+        b = ResourceBuilder(self.domain)
+        add = b.add
 
         for region in self._regions():
             region_id = add("region", region, region)
@@ -185,7 +175,7 @@ class AliyunPlatform:
                 sid = sw.get("VSwitchId", "")
                 if not sid:
                     continue
-                epc = ids.get(("vpc", sw.get("VpcId", "")), 0)
+                epc = b.get("vpc", sw.get("VpcId", ""))
                 add("subnet", sid, sw.get("VSwitchName") or sid,
                     epc_id=epc, cidr=sw.get("CidrBlock", ""),
                     az=sw.get("ZoneId", ""))
@@ -195,7 +185,7 @@ class AliyunPlatform:
                 if not iid:
                     continue
                 vpc_attrs = inst.get("VpcAttributes", {})
-                epc = ids.get(("vpc", vpc_attrs.get("VpcId", "")), 0)
+                epc = b.get("vpc", vpc_attrs.get("VpcId", ""))
                 ips = vpc_attrs.get("PrivateIpAddress",
                                     {}).get("IpAddress", [])
                 # ECS instances are VMs (vm.go getVMs -> model.VM),
@@ -213,7 +203,7 @@ class AliyunPlatform:
                 nid = nat.get("NatGatewayId", "")
                 if not nid:
                     continue
-                epc = ids.get(("vpc", nat.get("VpcId", "")), 0)
+                epc = b.get("vpc", nat.get("VpcId", ""))
                 nat_rid = add("nat_gateway", nid,
                               nat.get("Name") or nid,
                               vpc_id=epc, region_id=region_id)
@@ -233,9 +223,9 @@ class AliyunPlatform:
                 lid = lb.get("LoadBalancerId", "")
                 if not lid:
                     continue
-                epc = ids.get(("vpc", lb.get("VpcId", "")), 0)
+                epc = b.get("vpc", lb.get("VpcId", ""))
                 add("lb", lid, lb.get("LoadBalancerName") or lid,
                     vpc_id=epc, region_id=region_id,
                     ip=lb.get("Address", ""),
                     lb_model=lb.get("AddressType", ""))
-        return out
+        return b.rows()

@@ -28,9 +28,10 @@ import hmac
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
-from deepflow_tpu.controller.model import Resource, make_resource
+from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.model import Resource
 
 EC2_API_VERSION = "2016-11-15"
 
@@ -185,19 +186,8 @@ class AwsPlatform:
         return names
 
     def get_cloud_data(self) -> List[Resource]:
-        out: List[Resource] = []
-        ids: Dict[Tuple[str, str], int] = {}
-        next_id = [1]
-
-        def add(rtype: str, key: str, name: str, **attrs) -> int:
-            rid = ids.get((rtype, key))
-            if rid is None:
-                rid = next_id[0]
-                next_id[0] += 1
-                ids[(rtype, key)] = rid
-                out.append(make_resource(rtype, rid, name,
-                                         domain=self.domain, **attrs))
-            return rid
+        b = ResourceBuilder(self.domain)
+        add = b.add
 
         for region in self._regions():
             region_id = add("region", region, region)
@@ -211,7 +201,7 @@ class AwsPlatform:
                     region_id=region_id, cidr=_text(vpc, "cidrBlock"))
             for sn in self._paged(region, "DescribeSubnets", "subnetSet"):
                 sn_id = _text(sn, "subnetId")
-                epc = ids.get(("vpc", _text(sn, "vpcId")), 0)
+                epc = b.get("vpc", _text(sn, "vpcId"))
                 add("subnet", sn_id, _tag_name(sn, sn_id),
                     epc_id=epc, cidr=_text(sn, "cidrBlock"),
                     az=_text(sn, "availabilityZone"))
@@ -219,7 +209,7 @@ class AwsPlatform:
                                    "reservationSet"):
                 for inst in _items(rsv, "instancesSet"):
                     iid = _text(inst, "instanceId")
-                    epc = ids.get(("vpc", _text(inst, "vpcId")), 0)
+                    epc = b.get("vpc", _text(inst, "vpcId"))
                     ip = _text(inst, "privateIpAddress")
                     # EC2 instances are VMs (reference aws.go GetVMs ->
                     # chost rows, VIF_DEVICE_TYPE_VM), not hypervisor
@@ -242,7 +232,7 @@ class AwsPlatform:
                 # (aws/nat_gateway.go:60)
                 if _text(nat, "state") != "available":
                     continue
-                epc = ids.get(("vpc", _text(nat, "vpcId")), 0)
+                epc = b.get("vpc", _text(nat, "vpcId"))
                 nat_rid = add("nat_gateway", nid, _tag_name(nat, nid),
                               vpc_id=epc, region_id=region_id)
                 for addr in _items(nat, "natGatewayAddressSet"):
@@ -251,4 +241,4 @@ class AwsPlatform:
                         add("floating_ip", f"{nid}/{ip}", ip,
                             vpc_id=epc, ip=ip,
                             nat_gateway_id=nat_rid)
-        return out
+        return b.rows()

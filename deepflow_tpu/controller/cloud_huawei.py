@@ -28,9 +28,10 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
-from deepflow_tpu.controller.model import Resource, make_resource
+from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.model import Resource
 
 PAGE_LIMIT = 50
 # refresh this long before the reported expiry: a token that dies
@@ -143,19 +144,8 @@ class HuaweiPlatform:
         self._create_token()
 
     def get_cloud_data(self) -> List[Resource]:
-        out: List[Resource] = []
-        ids: Dict[Tuple[str, str], int] = {}
-        next_id = [1]
-
-        def add(rtype: str, key: str, name: str, **attrs) -> int:
-            rid = ids.get((rtype, key))
-            if rid is None:
-                rid = next_id[0]
-                next_id[0] += 1
-                ids[(rtype, key)] = rid
-                out.append(make_resource(rtype, rid, name,
-                                         domain=self.domain, **attrs))
-            return rid
+        b = ResourceBuilder(self.domain)
+        add = b.add
 
         # one project == one region in the reference's layout
         # (projects are per-region; URLs embed the project name)
@@ -173,7 +163,7 @@ class HuaweiPlatform:
             sid = sn.get("id", "")
             if not sid:
                 continue
-            epc = ids.get(("vpc", sn.get("vpc_id", "")), 0)
+            epc = b.get("vpc", sn.get("vpc_id", ""))
             add("subnet", sid, sn.get("name") or sid, epc_id=epc,
                 cidr=sn.get("cidr", ""),
                 az=sn.get("availability_zone", ""))
@@ -188,8 +178,9 @@ class HuaweiPlatform:
             epc = 0
             ip = ""
             for vpc_key, addrs in addresses.items():
-                if ("vpc", vpc_key) in ids:
-                    epc = ids[("vpc", vpc_key)]
+                got = b.get("vpc", vpc_key)
+                if got:
+                    epc = got
                     if addrs:
                         ip = addrs[0].get("addr", "")
                     break
@@ -198,4 +189,4 @@ class HuaweiPlatform:
             add("vm", sid, srv.get("name") or sid,
                 epc_id=epc, vpc_id=epc, ip=ip,
                 az=srv.get("OS-EXT-AZ:availability_zone", ""))
-        return out
+        return b.rows()

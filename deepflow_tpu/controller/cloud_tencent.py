@@ -33,9 +33,10 @@ import json
 import time
 import urllib.parse
 import urllib.request
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
-from deepflow_tpu.controller.model import Resource, make_resource
+from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.model import Resource
 
 CVM_VERSION = "2017-03-12"
 VPC_VERSION = "2017-03-12"
@@ -171,19 +172,8 @@ class TencentPlatform:
         return names
 
     def get_cloud_data(self) -> List[Resource]:
-        out: List[Resource] = []
-        ids: Dict[Tuple[str, str], int] = {}
-        next_id = [1]
-
-        def add(rtype: str, key: str, name: str, **attrs) -> int:
-            rid = ids.get((rtype, key))
-            if rid is None:
-                rid = next_id[0]
-                next_id[0] += 1
-                ids[(rtype, key)] = rid
-                out.append(make_resource(rtype, rid, name,
-                                         domain=self.domain, **attrs))
-            return rid
+        b = ResourceBuilder(self.domain)
+        add = b.add
 
         for region in self._regions():
             region_id = add("region", region, region)
@@ -208,7 +198,7 @@ class TencentPlatform:
                 sid = sn.get("SubnetId", "")
                 if not sid:
                     continue
-                epc = ids.get(("vpc", sn.get("VpcId", "")), 0)
+                epc = b.get("vpc", sn.get("VpcId", ""))
                 add("subnet", sid, sn.get("SubnetName") or sid,
                     epc_id=epc, cidr=sn.get("CidrBlock", ""),
                     az=sn.get("Zone", ""))
@@ -220,7 +210,7 @@ class TencentPlatform:
                     continue
                 vpc_id = inst.get("VirtualPrivateCloud",
                                   {}).get("VpcId", "")
-                epc = ids.get(("vpc", vpc_id), 0)
+                epc = b.get("vpc", vpc_id)
                 ips = inst.get("PrivateIpAddresses") or []
                 add("vm", iid, inst.get("InstanceName") or iid,
                     epc_id=epc, vpc_id=epc,
@@ -234,7 +224,7 @@ class TencentPlatform:
                 nid = nat.get("NatGatewayId", "")
                 if not nid:
                     continue
-                epc = ids.get(("vpc", nat.get("VpcId", "")), 0)
+                epc = b.get("vpc", nat.get("VpcId", ""))
                 nat_rid = add("nat_gateway", nid,
                               nat.get("NatGatewayName") or nid,
                               vpc_id=epc, region_id=region_id)
@@ -251,7 +241,7 @@ class TencentPlatform:
                 lid = lb.get("LoadBalancerId", "")
                 if not lid:
                     continue
-                epc = ids.get(("vpc", lb.get("VpcId", "")), 0)
+                epc = b.get("vpc", lb.get("VpcId", ""))
                 vips = lb.get("LoadBalancerVips") or []
                 lb_rid = add("lb", lid,
                              lb.get("LoadBalancerName") or lid,
@@ -269,4 +259,4 @@ class TencentPlatform:
                             lb_id=lb_rid,
                             port=int(ln.get("Port", 0)),
                             protocol=ln.get("Protocol", ""))
-        return out
+        return b.rows()

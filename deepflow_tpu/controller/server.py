@@ -570,6 +570,35 @@ class ControllerServer:
                 body["iam_name"], body["password"],
                 body["project_name"], body["project_id"],
                 body["iam_endpoint"], kw["endpoint_template"])
+        if kind == "qingcloud":
+            from deepflow_tpu.controller.cloud_qingcloud import \
+                QingCloudPlatform
+            if not body.get("secret_id") or not body.get("secret_key"):
+                raise ValueError("qingcloud platform requires "
+                                 "secret_id and secret_key")
+            kw = {}
+            if body.get("url"):
+                scheme = urllib.parse.urlparse(body["url"]).scheme
+                if scheme not in ("http", "https"):
+                    raise ValueError("url must be http(s)")
+                kw["url"] = body["url"]
+            return QingCloudPlatform(
+                body["domain"], body["secret_id"], body["secret_key"],
+                zones=tuple(body.get("zones", ())), **kw)
+        if kind == "baidubce":
+            from deepflow_tpu.controller.cloud_baidubce import \
+                BaiduBcePlatform
+            for k in ("secret_id", "secret_key", "endpoint"):
+                if not body.get(k):
+                    raise ValueError(f"baidubce platform requires {k}")
+            scheme = body.get("scheme", "https")
+            if scheme not in ("http", "https"):
+                raise ValueError("scheme must be http or https")
+            return BaiduBcePlatform(
+                body["domain"], body["secret_id"], body["secret_key"],
+                body["endpoint"],
+                region_name=body.get("region_name", "baidu"),
+                scheme=scheme, bcc_host=body.get("bcc_host"))
         raise ValueError(f"unknown platform kind {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------

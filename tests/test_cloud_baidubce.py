@@ -1,0 +1,189 @@
+"""Baidu BCE client: bce-auth-v1 header signatures verified
+SERVER-side (derived signing key recomputed from the header's own
+timestamp), nextMarker/isTruncated pagination, and controller wiring
+(reference: server/controller/cloud/baidubce/). Sixth vendor — the
+full reference vendor set is now real."""
+
+import hashlib
+import hmac as hmac_mod
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepflow_tpu.controller.cloud_baidubce import (BaiduBcePlatform,
+                                                    bce_authorization)
+
+ACCESS, SECRET = "bce-ak-test", "bce-sk-test"
+
+
+def test_bce_authorization_hand_built_path():
+    """Independent construction of the documented scheme: derived
+    hex signing key over the auth prefix, hex HMAC over
+    METHOD\\nURI\\nQUERY\\nhost header."""
+    ts = "2026-01-02T03:04:05Z"
+    auth = bce_authorization(ACCESS, SECRET, "GET", "/v1/vpc",
+                             {"maxKeys": "1000"}, "bcc.bj.example",
+                             timestamp=ts)
+    prefix = f"bce-auth-v1/{ACCESS}/{ts}/1800"
+    skey = hmac_mod.new(SECRET.encode(), prefix.encode(),
+                        hashlib.sha256).hexdigest()
+    canonical = ("GET\n/v1/vpc\nmaxKeys=1000\n"
+                 "host:bcc.bj.example")
+    want = hmac_mod.new(skey.encode(), canonical.encode(),
+                        hashlib.sha256).hexdigest()
+    assert auth == f"{prefix}/host/{want}"
+
+
+class _Recorder(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self):
+        self.calls = []
+        self.bad_signatures = 0
+        super().__init__(("127.0.0.1", 0), _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        srv: _Recorder = self.server
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        auth = self.headers.get("Authorization", "")
+        host = self.headers.get("Host", "")
+        # recompute from the header's OWN timestamp (the vendor
+        # validates the signature against the claimed prefix)
+        parts = auth.split("/")
+        ts = parts[2] if len(parts) == 6 else ""
+        want = bce_authorization(ACCESS, SECRET, "GET", url.path, q,
+                                 host, timestamp=ts)
+        if auth != want:
+            srv.bad_signatures += 1
+            self.send_response(403)
+            self.end_headers()
+            self.wfile.write(b'{"code": "AccessDenied"}')
+            return
+        srv.calls.append((url.path, q.get("marker", "")))
+        doc = self._data(url.path, q.get("marker", ""))
+        out = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    @staticmethod
+    def _data(path, marker):
+        if path == "/v1/vpc":
+            return {"isTruncated": False, "vpcs": [
+                {"vpcId": "vpc-b1", "name": "prod",
+                 "cidr": "172.16.0.0/16"}]}
+        if path == "/v1/subnet":
+            return {"isTruncated": False, "subnets": [
+                {"subnetId": "sbn-b1", "name": "net-1",
+                 "cidr": "172.16.1.0/24", "vpcId": "vpc-b1",
+                 "zoneName": "cn-bj-a"}]}
+        if path == "/v2/instance":
+            # TWO truncated pages: nextMarker must be followed
+            if marker == "":
+                return {"isTruncated": True, "nextMarker": "i-1",
+                        "instances": [
+                            {"id": "i-1", "name": "web-1",
+                             "internalIp": "172.16.1.8",
+                             "zoneName": "cn-bj-a",
+                             "vpcId": "vpc-b1"}]}
+            return {"isTruncated": False, "instances": [
+                {"id": "i-2", "name": "",
+                 "internalIp": "172.16.1.9", "zoneName": "cn-bj-a",
+                 "vpcId": "vpc-b1"}]}
+        return {}
+
+
+@pytest.fixture
+def recorder():
+    srv = _Recorder()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _platform(recorder):
+    host, port = recorder.server_address
+    return BaiduBcePlatform("bce-dom", ACCESS, SECRET,
+                            endpoint="bj.example",
+                            region_name="bj", scheme="http",
+                            bcc_host=f"127.0.0.1:{port}")
+
+
+def test_gather_with_header_auth_and_next_marker(recorder):
+    p = _platform(recorder)
+    p.check_auth()
+    rows = p.get_cloud_data()
+    assert recorder.bad_signatures == 0
+    by = {}
+    for r in rows:
+        by.setdefault(r.type, []).append(r)
+    assert [r.name for r in by["vpc"]] == ["prod"]
+    assert [r.name for r in by["subnet"]] == ["net-1"]
+    assert [r.name for r in by["az"]] == ["cn-bj-a"]
+    # nextMarker page followed; nameless instance falls back to id
+    assert sorted(r.name for r in by["vm"]) == ["i-2", "web-1"]
+    vm = {r.name: dict(r.attrs) for r in by["vm"]}
+    assert vm["web-1"]["epc_id"] == by["vpc"][0].id
+    assert vm["web-1"]["ip"] == "172.16.1.8"
+    markers = [m for path, m in recorder.calls
+               if path == "/v2/instance"]
+    assert markers == ["", "i-1"]
+
+
+def test_bad_secret_fails_auth(recorder):
+    p = BaiduBcePlatform("bce-dom", ACCESS, "WRONG",
+                         endpoint="bj.example", scheme="http",
+                         bcc_host=f"127.0.0.1:"
+                                  f"{recorder.server_address[1]}")
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        p.check_auth()
+
+
+def test_controller_drives_baidubce_domain(recorder):
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.load(r)
+
+        post("/v1/cloud/domains", {
+            "domain": "bce-prod", "platform": "baidubce",
+            "secret_id": ACCESS, "secret_key": SECRET,
+            "endpoint": "bj.example", "scheme": "http",
+            "bcc_host":
+                f"127.0.0.1:{recorder.server_address[1]}"})
+        out = post("/v1/domains/bce-prod/refresh", {})
+        assert out["ok"] is True and out["resource_count"] >= 5
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/resources?type=vm",
+                timeout=5) as r:
+            vms = json.load(r)
+        assert {"web-1", "i-2"} <= {v["name"] for v in vms}
+    finally:
+        srv.close()

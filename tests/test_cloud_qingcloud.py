@@ -1,0 +1,245 @@
+"""QingCloud client: sorted-query HMAC-SHA256 signatures verified
+SERVER-side, offset/total_count pagination, and the vendor's
+routers-as-VPCs / vxnets-as-subnets model (reference:
+server/controller/cloud/qingcloud/). Fifth vendor, fifth signature
+dialect."""
+
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepflow_tpu.controller.cloud_qingcloud import (QingCloudPlatform,
+                                                     signed_query)
+
+ACCESS, SECRET = "QYACCESSKEY", "qy-secret-key"
+
+
+def test_signed_query_hand_built_path():
+    """Independent construction of the documented StringToSign
+    ("GET\\n/iaas/\\n" + sorted escaped query) must reproduce
+    signed_query's signature."""
+    params = {"access_key_id": ACCESS, "action": "DescribeZones",
+              "limit": 100, "offset": 0,
+              "signature_method": "HmacSHA256",
+              "signature_version": 1,
+              "time_stamp": "2026-01-02T03:04:05Z", "version": 1,
+              "zone": "pek3 a"}          # space: must escape as %20
+    qs = signed_query(params, SECRET)
+    base, _, sig = qs.rpartition("&signature=")
+    assert "zone=pek3%20a" in base       # not '+'
+    want = base64.b64encode(hmac_mod.new(
+        SECRET.encode(), ("GET\n/iaas/\n" + base).encode(),
+        hashlib.sha256).digest()).decode()
+    assert urllib.parse.unquote(sig) == want
+    # sorted order: access_key_id first, zone last
+    assert base.startswith("access_key_id=") and "zone=" in \
+        base.split("&")[-1]
+
+
+class _Recorder(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self):
+        self.calls = []
+        self.bad_signatures = 0
+        super().__init__(("127.0.0.1", 0), _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        srv: _Recorder = self.server
+        query = urllib.parse.urlparse(self.path).query
+        base, _, sig = query.rpartition("&signature=")
+        want = base64.b64encode(hmac_mod.new(
+            SECRET.encode(), ("GET\n/iaas/\n" + base).encode(),
+            hashlib.sha256).digest()).decode()
+        q = dict(urllib.parse.parse_qsl(base))
+        if q.get("access_key_id") != ACCESS or \
+                urllib.parse.unquote(sig) != want:
+            srv.bad_signatures += 1
+            doc = {"ret_code": 1100,
+                   "message": "signature not matched"}
+        else:
+            action = q.get("action", "")
+            zone = q.get("zone", "")
+            offset = int(q.get("offset", 0))
+            srv.calls.append((action, zone, offset))
+            doc = self._data(action, zone, offset)
+        out = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    @staticmethod
+    def _data(action, zone, offset):
+        if action == "DescribeZones":
+            return {"ret_code": 0, "total_count": 3, "zone_set": [
+                {"zone_id": "pek3a", "status": "active"},
+                {"zone_id": "gd2a", "status": "active"},
+                {"zone_id": "dead1", "status": "faulty"}]}
+        if action == "DescribeRouters":
+            return {"ret_code": 0, "total_count": 1, "router_set": [
+                {"router_id": f"rtr-{zone}",
+                 "router_name": f"vpc-{zone}",
+                 "vpc_network": "192.168.0.0/16"}]}
+        if action == "DescribeVxnets":
+            return {"ret_code": 0, "total_count": 2, "vxnet_set": [
+                {"vxnet_id": f"vxnet-{zone}-1",
+                 "vxnet_name": f"net-{zone}",
+                 "router": {"router_id": f"rtr-{zone}",
+                            "ip_network": "192.168.1.0/24"}},
+                {"vxnet_id": f"vxnet-{zone}-orphan"}]}  # no router
+        if action == "DescribeInstances":
+            # two pages of one instance each (offset pagination)
+            rows = {0: [{"instance_id": f"i-{zone}-web",
+                         "instance_name": f"web-{zone}",
+                         "status": "running",
+                         "vxnets": [{"vxnet_id": f"vxnet-{zone}-1",
+                                     "private_ip": "192.168.1.9"}]}],
+                    1: [{"instance_id": f"i-{zone}-db",
+                         "instance_name": "",
+                         "status": "running",
+                         "vxnets": [{"vxnet_id": f"vxnet-{zone}-1",
+                                     "private_ip": "192.168.1.10"}]}]}
+            return {"ret_code": 0, "total_count": 2,
+                    "instance_set": rows.get(offset, [])}
+        return {"ret_code": 0}
+
+
+@pytest.fixture
+def recorder():
+    srv = _Recorder()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _platform(recorder, **kw):
+    return QingCloudPlatform(
+        "qc-dom", ACCESS, SECRET,
+        url=f"http://127.0.0.1:{recorder.server_address[1]}", **kw)
+
+
+def test_gather_routers_as_vpcs_and_paging(recorder):
+    p = _platform(recorder, zones=("pek3a", "gd2a"))
+    p.check_auth()
+    rows = p.get_cloud_data()
+    assert recorder.bad_signatures == 0
+    by = {}
+    for r in rows:
+        by.setdefault(r.type, []).append(r)
+    assert sorted(r.name for r in by["az"]) == ["gd2a", "pek3a"]
+    # routers ARE the vpcs; orphan vxnets (no router) excluded
+    assert sorted(r.name for r in by["vpc"]) == ["vpc-gd2a",
+                                                 "vpc-pek3a"]
+    assert sorted(r.name for r in by["subnet"]) == ["net-gd2a",
+                                                    "net-pek3a"]
+    assert sorted(r.name for r in by["vm"]) == [
+        "i-gd2a-db", "i-pek3a-db", "web-gd2a", "web-pek3a"]
+    # instances resolve their vpc THROUGH the vxnet's router
+    vpc_ids = {r.name: r.id for r in by["vpc"]}
+    vm = {r.name: dict(r.attrs) for r in by["vm"]}
+    assert vm["web-pek3a"]["epc_id"] == vpc_ids["vpc-pek3a"]
+    assert vm["web-pek3a"]["ip"] == "192.168.1.9"
+    # offset paging walked both instance pages per zone
+    pages = sorted(c for c in recorder.calls
+                   if c[0] == "DescribeInstances")
+    assert pages == [("DescribeInstances", "gd2a", 0),
+                     ("DescribeInstances", "gd2a", 1),
+                     ("DescribeInstances", "pek3a", 0),
+                     ("DescribeInstances", "pek3a", 1)]
+
+
+def test_bad_secret_fails_in_band(recorder):
+    p = QingCloudPlatform(
+        "qc-dom", ACCESS, "WRONG",
+        url=f"http://127.0.0.1:{recorder.server_address[1]}")
+    with pytest.raises(RuntimeError):
+        p.check_auth()
+
+
+def test_controller_drives_qingcloud_domain(recorder):
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.controller.registry import VTapRegistry
+    from deepflow_tpu.controller.server import ControllerServer
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.load(r)
+
+        post("/v1/cloud/domains", {
+            "domain": "qc-prod", "platform": "qingcloud",
+            "secret_id": ACCESS, "secret_key": SECRET,
+            "zones": ["pek3a"],
+            "url": f"http://127.0.0.1:{recorder.server_address[1]}"})
+        out = post("/v1/domains/qc-prod/refresh", {})
+        assert out["ok"] is True and out["resource_count"] >= 5
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/resources?type=vm",
+                timeout=5) as r:
+            vms = json.load(r)
+        assert {"web-pek3a", "i-pek3a-db"} <= {v["name"] for v in vms}
+    finally:
+        srv.close()
+
+
+def test_two_vendor_domains_coexist_with_stable_ids(recorder):
+    """The bug the multi-domain drive caught: per-client 1..N counters
+    collided across domains ((type, id) is global) and reshuffled on
+    row-order changes. ResourceBuilder's content-stable hashed ids
+    must let two vendor domains land on one controller and re-polls
+    produce ZERO spurious diffs."""
+    import tests.test_cloud_baidubce as bc
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.recorder import Recorder
+
+    brec = bc._Recorder()
+    t = threading.Thread(target=brec.serve_forever, daemon=True)
+    t.start()
+    try:
+        model = ResourceModel()
+        rec_ = Recorder(model)
+        qp = _platform(recorder, zones=("pek3a",))
+        bp = bc.BaiduBcePlatform(
+            "bce-dom", bc.ACCESS, bc.SECRET, endpoint="bj.example",
+            scheme="http",
+            bcc_host=f"127.0.0.1:{brec.server_address[1]}")
+        rec_.reconcile("qc-dom", qp.get_cloud_data())
+        rec_.reconcile("bce-dom", bp.get_cloud_data())
+        assert sorted(r.name for r in model.list(type="vm",
+                                                 domain="qc-dom")) \
+            == ["i-pek3a-db", "web-pek3a"]
+        assert sorted(r.name for r in model.list(type="vm",
+                                                 domain="bce-dom")) \
+            == ["i-2", "web-1"]
+        # stability: identical re-polls change NOTHING
+        v = model.version
+        rec_.reconcile("qc-dom", qp.get_cloud_data())
+        rec_.reconcile("bce-dom", bp.get_cloud_data())
+        assert model.version == v
+    finally:
+        brec.shutdown()
+        brec.server_close()
