@@ -31,5 +31,5 @@ EOF
     echo "[$(date -u +%H:%M:%S)] target reached; loop done" >> "$LOG"
     break
   fi
-  sleep 2400
+  sleep 1200
 done
