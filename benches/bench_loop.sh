@@ -1,6 +1,6 @@
 #!/bin/bash
 # Round-5 bench retry loop (verdict r4 #1): probe the TPU tunnel on a
-# ~40-min cadence and run the full bench whenever it answers; bench.py
+# ~20-min cadence and run the full bench whenever it answers; bench.py
 # self-persists every run under docs/bench_runs/ and promotes the best
 # self-consistent one to BENCH_BEST_r5.json, which the end-of-round
 # bench emits if its own window is worse. Stops once a self-consistent

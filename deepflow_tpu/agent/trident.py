@@ -26,6 +26,10 @@ from deepflow_tpu.agent.flow_map import FlowMap
 from deepflow_tpu.agent.guard import EscapeTimer, Guard
 from deepflow_tpu.agent.l7 import (MSG_REQUEST, SessionAggregator,
                                    parse_payload)
+# AFTER l7: the l7 <-> l7_ext pair registers extended parsers at
+# import time, and l7 must win the import race (importing l7_ext
+# first leaves it partially initialized when l7 calls back into it)
+from deepflow_tpu.agent.l7_ext import L7_TLS
 from deepflow_tpu.agent.packet import PROTO_TCP, PROTO_UDP
 from deepflow_tpu.agent.policy import (PolicyEnforcer,
                                        PolicyLabeler)
@@ -224,6 +228,12 @@ def l7_session_message(flow, rec_dict: dict, ts_ns: int,
     m.ext_info.client_ip = rec_dict.get("client_ip", "")
     m.ext_info.http_user_agent = rec_dict.get("user_agent", "")
     m.ext_info.http_referer = rec_dict.get("referer", "")
+    # packet-path TLS detection: a session the TLS parser recognized
+    # (handshake metadata — SNI/version; the payload itself stays
+    # encrypted) carries the same is_tls bit the uprobe sources set,
+    # so "WHERE is_tls = 1" covers both observation modes
+    if rec_dict["proto"] == L7_TLS:
+        m.flags = m.flags | 1
     return m
 
 

@@ -639,3 +639,20 @@ def test_hpack_roundtrip_property():
         block = enc.encode(headers)
         got = dec.decode(block)
         assert got == headers, (frame, got, headers)
+
+
+def test_tls_sessions_carry_is_tls_on_the_wire():
+    """A packet-path session the TLS parser recognized must ship with
+    the same is_tls bit the uprobe sources set — one query predicate
+    covers both observation modes."""
+    from deepflow_tpu.agent.l7 import L7_HTTP1
+    from deepflow_tpu.agent.l7_ext import L7_TLS
+    from deepflow_tpu.agent.trident import l7_session_message
+
+    rec = {"proto": L7_TLS, "endpoint": "svc.example:443",
+           "status": 0, "rrt_us": 120, "req_len": 0, "resp_len": 0}
+    m = l7_session_message((1, 2, 40000, 443, 6), rec, 1_000_000, 7)
+    assert m.flags & 1
+    rec["proto"] = L7_HTTP1                # plaintext
+    m = l7_session_message((1, 2, 40000, 80, 6), rec, 1_000_000, 7)
+    assert m.flags & 1 == 0
