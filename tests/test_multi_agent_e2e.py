@@ -1,0 +1,154 @@
+"""Multi-node e2e (the reference automation_test/ws_client.py role,
+in-process): ONE controller manages TWO capture agents that register,
+receive their ingester assignment, capture independent traffic, and
+land distinguishable rows in ONE ingester — then the fleet surfaces
+(liveness, per-vtap rows, cross-vtap SQL GROUP BY, gpid allocation
+disjointness) are asserted across the node boundary."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.test_agent import ACK, CLIENT, SERVER, SYN, eth_ipv4_tcp
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.load(r)
+
+
+def test_two_agents_one_controller_one_ingester(tmp_path):
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.controller import (ControllerServer,
+                                         ResourceModel, VTapRegistry)
+    from deepflow_tpu.controller.monitor import FleetMonitor
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+    from deepflow_tpu.querier.engine import QueryEngine
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(tmp_path / "store")))
+    ing.start()
+    reg = VTapRegistry()
+    mon = FleetMonitor(reg)
+    mon.set_ingesters([f"127.0.0.1:{ing.port}"])
+    srv = ControllerServer(ResourceModel(), reg, mon, port=0)
+    srv.start()
+    agents = []
+    try:
+        for i, (ip, host) in enumerate(
+                (("10.6.0.1", "node-a"), ("10.6.0.2", "node-b"))):
+            a = Agent(AgentConfig(
+                controller_url=f"http://127.0.0.1:{srv.port}",
+                ctrl_ip=ip, host=host, l7_enabled=True))
+            assert a.sync_once()
+            agents.append(a)
+        # distinct vtap ids from ONE registry; both got the ingester
+        assert sorted(a.vtap_id for a in agents) == [1, 2]
+        for a in agents:
+            assert a.senders[list(a.senders)[0]].port == ing.port
+
+        # independent traffic per node: node-a talks to port 8080,
+        # node-b to port 9090 — the rows must stay attributable
+        t0 = int(time.time() * 1e9)
+        for a, port in zip(agents, (8080, 9090)):
+            frames = [
+                eth_ipv4_tcp(CLIENT, SERVER, 41000 + port, port, SYN,
+                             seq=1),
+                eth_ipv4_tcp(SERVER, CLIENT, port, 41000 + port,
+                             SYN | ACK, seq=1),
+                eth_ipv4_tcp(CLIENT, SERVER, 41000 + port, port, ACK,
+                             b"GET /svc HTTP/1.1\r\n\r\n", seq=2),
+                eth_ipv4_tcp(SERVER, CLIENT, port, 41000 + port, ACK,
+                             b"HTTP/1.1 200 OK\r\n\r\n", seq=2),
+            ]
+            ts = np.array([t0 + k * 1000 for k in range(4)], np.uint64)
+            assert a.feed(frames, ts) == 4
+            sent = a.tick(now_ns=t0 + 10**9)
+            assert sent["flows"] == 1
+
+        table = ing.store.table("flow_log", "l4_flow_log")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            ing.flush()
+            if table.row_count() >= 2:
+                break
+            time.sleep(0.1)
+        rows = table.scan()
+        # each row carries ITS agent's vtap id
+        by_port = dict(zip(rows["port_dst"].tolist(),
+                           rows["vtap_id"].tolist()))
+        assert by_port[8080] != by_port[9090]
+        assert sorted(by_port.values()) == [1, 2]
+
+        # cross-node SQL: one GROUP BY spans both agents' rows
+        r = QueryEngine(ing.store).execute(
+            "SELECT vtap_id, Count(*) AS n FROM l4_flow_log "
+            "GROUP BY vtap_id", db="flow_log")
+        assert sorted(v[0] for v in r.values) == [1, 2]
+        assert all(v[1] == 1 for v in r.values)
+
+        # fleet surface: both vtaps listed alive
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/vtaps",
+                timeout=5) as resp:
+            vtaps = json.load(resp)
+        assert sorted(v["host"] for v in vtaps) == ["node-a", "node-b"]
+        assert all(v["alive"] for v in vtaps)
+
+        # gpid allocations from the two nodes never collide
+        r1 = _post(srv.port, "/v1/sync",
+                   {"ctrl_ip": "10.6.0.1", "host": "node-a",
+                    "processes": [{"pid": 7, "name": "x",
+                                   "start_time": 1}]})
+        r2 = _post(srv.port, "/v1/sync",
+                   {"ctrl_ip": "10.6.0.2", "host": "node-b",
+                    "processes": [{"pid": 7, "name": "y",
+                                   "start_time": 1}]})
+        assert r1["gpids"]["7"] != r2["gpids"]["7"]
+    finally:
+        for a in agents:
+            a.close()
+        srv.close()
+        ing.close()
+
+
+def test_group_config_push_reaches_only_that_group(tmp_path):
+    """Two nodes in different vtap groups: a group-scoped policy push
+    must land on ITS member only — the fleet-management semantics a
+    single-agent test can't see."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.controller import (ControllerServer,
+                                         ResourceModel, VTapRegistry)
+    from deepflow_tpu.controller.monitor import FleetMonitor
+
+    reg = VTapRegistry()
+    srv = ControllerServer(ResourceModel(), reg, FleetMonitor(reg),
+                           port=0)
+    srv.start()
+    agents = []
+    try:
+        for ip, host in (("10.7.0.1", "ga"), ("10.7.0.2", "gb")):
+            a = Agent(AgentConfig(
+                controller_url=f"http://127.0.0.1:{srv.port}",
+                ctrl_ip=ip, host=host))
+            assert a.sync_once()
+            agents.append(a)
+        reg.set_group("10.7.0.2", "gb", "edge")
+        reg.set_config("edge", {"flow_acls": [
+            {"id": 3, "protocol": 6, "dst_ports": "443",
+             "npb_actions": [{"tunnel_type": 3}]}]})
+        for a in agents:
+            assert a.sync_once()
+        assert agents[0].policy.rules == []          # default group
+        assert [r.rule_id for r in agents[1].policy.rules] == [3]
+    finally:
+        for a in agents:
+            a.close()
+        srv.close()
