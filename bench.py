@@ -45,6 +45,7 @@ eating the e2e number.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -419,23 +420,27 @@ def main() -> None:
              "self_consistent": bool(implied <= sustained * 1.3)}
         lane_windows.append(w)
         print(f"[bench] window {idx}: {w}", file=sys.stderr, flush=True)
-        try:
-            # incremental evidence: a mid-run tunnel collapse (rc=4)
-            # must not erase the windows already measured — the
-            # partial file is diagnosis material, never the scoreboard
-            # (only _persist_run's COMPLETE runs feed the best-cache).
-            # TPU runs only: CPU CI smokes must not litter docs/
-            if jax.default_backend() == "cpu":
-                return w
-            os.makedirs(_RUNS_DIR, exist_ok=True)
-            with open(os.path.join(_RUNS_DIR,
-                                   "partial_current.json"), "w") as f:
-                json.dump({"git_rev": _git_rev(),
-                           "at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                               time.gmtime()),
-                           "lane_windows": lane_windows}, f, indent=1)
-        except OSError:
-            pass
+        # incremental evidence: a mid-run tunnel collapse (rc=4) must
+        # not erase the windows already measured — the partial file is
+        # diagnosis material, never the scoreboard (only _persist_run's
+        # COMPLETE runs feed the best-cache). TPU runs only; atomic
+        # replace because the phase watchdog os._exit()s at any
+        # instant and a torn overwrite would destroy the very evidence
+        # this exists to keep.
+        if jax.default_backend() != "cpu":
+            try:
+                os.makedirs(_RUNS_DIR, exist_ok=True)
+                tmp = os.path.join(_RUNS_DIR, "partial_current.tmp")
+                with open(tmp, "w") as f:
+                    json.dump({"git_rev": _git_rev(),
+                               "at": time.strftime(
+                                   "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                               "lane_windows": lane_windows}, f,
+                              indent=1)
+                os.replace(tmp, os.path.join(_RUNS_DIR,
+                                             "partial_current.json"))
+            except OSError:
+                pass
         return w
 
     lane_window()                             # window 0: freshest link
@@ -635,6 +640,10 @@ def main() -> None:
     })
     if jax.default_backend() != "cpu":
         _persist_run(result)
+        # the run COMPLETED: its windows live in run_*.json now — a
+        # stale partial must not pose as the NEXT run's evidence
+        with contextlib.suppress(OSError):
+            os.remove(os.path.join(_RUNS_DIR, "partial_current.json"))
         _emit(result)
     else:
         print(json.dumps(result), flush=True)
