@@ -218,6 +218,37 @@ class AwsPlatform:
                         epc_id=epc, vpc_id=epc, ip=ip,
                         az=_text(inst, "placement/availabilityZone"),
                         subnet=_text(inst, "subnetId"))
+            # ENIs -> vinterface + lan/wan ip rows (reference
+            # vinterface_and_ip.go: unattached ENIs skipped, private
+            # addresses as LAN ips, the association's public ip as
+            # the WAN ip)
+            for eni in self._paged(region, "DescribeNetworkInterfaces",
+                                   "networkInterfaceSet"):
+                eid = _text(eni, "networkInterfaceId")
+                inst = _text(eni, "attachment/instanceId")
+                if not eid or not inst:
+                    continue
+                vif = add("vinterface", eid, eid,
+                          mac=_text(eni, "macAddress"),
+                          subnet_id=b.get("subnet",
+                                          _text(eni, "subnetId")),
+                          device_vm_id=b.get("vm", inst))
+                for ip_e in _items(eni, "privateIpAddressesSet"):
+                    ip = _text(ip_e, "privateIpAddress")
+                    if ip:
+                        add("lan_ip", f"{eid}/{ip}", ip,
+                            vinterface_id=vif, ip=ip)
+                    # EIPs on SECONDARY private ips nest under each
+                    # address item (vinterface_and_ip.go walks them
+                    # all; the eni-level association is the primary)
+                    pub2 = _text(ip_e, "association/publicIp")
+                    if pub2:
+                        add("wan_ip", f"{eid}/{pub2}", pub2,
+                            vinterface_id=vif, ip=pub2)
+                pub = _text(eni, "association/publicIp")
+                if pub:
+                    add("wan_ip", f"{eid}/{pub}", pub,
+                        vinterface_id=vif, ip=pub)
             # NAT gateways ride the SAME EC2 Query API (reference
             # nat_gateway.go DescribeNatGateways); their public
             # addresses land as nat-linked floating_ips

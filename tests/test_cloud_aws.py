@@ -37,6 +37,25 @@ def test_sigv4_official_aws_test_vector():
         "956d9b8aae1d763fbf31")
 
 
+_ENI_XML = """<DescribeNetworkInterfacesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <networkInterfaceSet>
+    <item><networkInterfaceId>eni-{r}-1</networkInterfaceId>
+      <subnetId>subnet-{r}1</subnetId><macAddress>02:aa:bb:cc:dd:01</macAddress>
+      <attachment><instanceId>i-{r}a</instanceId></attachment>
+      <privateIpAddressesSet>
+        <item><privateIpAddress>10.1.1.10</privateIpAddress></item>
+        <item><privateIpAddress>10.1.1.11</privateIpAddress>
+          <association><publicIp>52.9.{o}.9</publicIp></association>
+        </item>
+      </privateIpAddressesSet>
+      <association><publicIp>52.0.{o}.7</publicIp></association>
+    </item>
+    <item><networkInterfaceId>eni-{r}-floating</networkInterfaceId>
+      <subnetId>subnet-{r}1</subnetId><macAddress>02:aa:bb:cc:dd:02</macAddress>
+    </item>
+  </networkInterfaceSet>
+</DescribeNetworkInterfacesResponse>"""
+
 _NAT_XML = """<DescribeNatGatewaysResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
   <natGatewaySet>
     <item><natGatewayId>nat-{r}</natGatewayId><vpcId>vpc-{r}1</vpcId>
@@ -179,6 +198,9 @@ class _Recorder(ThreadingHTTPServer):
             if form.get("NextToken") == "PAGE2TOKEN":
                 return _INSTANCES_PAGE2.format(r=region)
             return _INSTANCES_PAGE1.format(r=region)
+        if a == "DescribeNetworkInterfaces":
+            return _ENI_XML.format(r=region,
+                                   o=1 if region == "us-east-1" else 2)
         if a == "DescribeNatGateways":
             return _NAT_XML.format(r=region,
                                    o=1 if region == "us-east-1" else 2)
@@ -226,6 +248,20 @@ def test_gather_normalizes_regions_vpcs_subnets_vms(recorder):
     subnet_attrs = {r.name: dict(r.attrs) for r in by["subnet"]}
     assert subnet_attrs["subnet-us-east-11"]["epc_id"] == \
         vpc_ids["prod-us-east-1"]
+    # ENIs: attached ones land as vinterfaces with LAN + WAN ips;
+    # the unattached eni-*-floating is skipped like the reference
+    vifs = {r.name: dict(r.attrs) for r in by["vinterface"]}
+    assert set(vifs) == {"eni-us-east-1-1", "eni-eu-west-1-1"}
+    v1 = vifs["eni-us-east-1-1"]
+    assert v1["mac"] == "02:aa:bb:cc:dd:01"
+    # exact device link: THE attached instance, not just any vm
+    vm_by_key = {r.name: r.id for r in by["vm"]}
+    assert v1["device_vm_id"] == vm_by_key["web-us-east-1"]
+    lan = {r.name for r in by["lan_ip"]}
+    assert {"10.1.1.10", "10.1.1.11"} <= lan
+    wan = {r.name for r in by["wan_ip"]}
+    # primary (eni-level) AND secondary (per-address) EIPs
+    assert {"52.0.1.7", "52.0.2.7", "52.9.1.9", "52.9.2.9"} <= wan
     # NAT gateways + nat-linked floating ips (same EC2 Query wire);
     # deleted-state gateways and their (possibly reassigned) IPs are
     # FILTERED like the reference does
