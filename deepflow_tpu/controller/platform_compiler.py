@@ -69,6 +69,30 @@ def compile_platform_data(model: ResourceModel
             az_id=host.attr("az_id", 0), host_id=host.id,
             l3_device_type=6, l3_device_id=host.id))  # 6 = host
 
+    # ENI-sourced addresses (cloud vinterface + lan_ip/wan_ip rows):
+    # every address a vinterface carries enriches flows with the
+    # device VM's identity — secondary private IPs and EIPs included,
+    # which the vm row's single primary ip cannot cover
+    vifs = {v.id: v for v in model.list(type="vinterface")}
+    vms_by_id = {v.id: v for v in model.list(type="vm")}
+    for ip_row in (model.list(type="lan_ip")
+                   + model.list(type="wan_ip")):
+        ip = _ip_u32(ip_row.attr("ip") or ip_row.name)
+        vif = vifs.get(ip_row.attr("vinterface_id", 0))
+        if ip is None or vif is None:
+            continue
+        dev = vms_by_id.get(vif.attr("device_vm_id", 0))
+        interfaces.append(InterfaceInfo(
+            epc_id=(dev.attr("epc_id", dev.attr("vpc_id", 0))
+                    if dev else 0),
+            ip=ip,
+            region_id=dev.attr("region_id", 0) if dev else 0,
+            az_id=dev.attr("az_id", 0) if dev else 0,
+            host_id=dev.attr("host_id", 0) if dev else 0,
+            subnet_id=vif.attr("subnet_id", 0),
+            l3_device_type=1 if dev else 0,
+            l3_device_id=dev.id if dev else 0))
+
     for vm in model.list(type="vm"):
         # cloud instances (reference chost: VIF_DEVICE_TYPE_VM = 1,
         # controller/common/const.go:384) — distinct from hypervisor

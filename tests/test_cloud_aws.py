@@ -44,7 +44,7 @@ _ENI_XML = """<DescribeNetworkInterfacesResponse xmlns="http://ec2.amazonaws.com
       <attachment><instanceId>i-{r}a</instanceId></attachment>
       <privateIpAddressesSet>
         <item><privateIpAddress>10.1.1.10</privateIpAddress></item>
-        <item><privateIpAddress>10.1.1.11</privateIpAddress>
+        <item><privateIpAddress>10.1.1.21</privateIpAddress>
           <association><publicIp>52.9.{o}.9</publicIp></association>
         </item>
       </privateIpAddressesSet>
@@ -258,7 +258,7 @@ def test_gather_normalizes_regions_vpcs_subnets_vms(recorder):
     vm_by_key = {r.name: r.id for r in by["vm"]}
     assert v1["device_vm_id"] == vm_by_key["web-us-east-1"]
     lan = {r.name for r in by["lan_ip"]}
-    assert {"10.1.1.10", "10.1.1.11"} <= lan
+    assert {"10.1.1.10", "10.1.1.21"} <= lan
     wan = {r.name for r in by["wan_ip"]}
     # primary (eni-level) AND secondary (per-address) EIPs
     assert {"52.0.1.7", "52.0.2.7", "52.9.1.9", "52.9.2.9"} <= wan
@@ -364,3 +364,29 @@ def test_bad_endpoint_template_rejected_at_config_time():
             assert e.value.code == 400
     finally:
         srv.close()
+
+
+def test_eni_addresses_compile_into_platform_data(recorder):
+    """ENI lan/wan ips become InterfaceInfo rows carrying the device
+    VM's identity — the enrichment a vm row's single primary ip can't
+    provide for secondary addresses and EIPs."""
+    from deepflow_tpu.controller.model import ResourceModel
+    from deepflow_tpu.controller.platform_compiler import \
+        compile_platform_data
+    from deepflow_tpu.controller.recorder import Recorder
+
+    model = ResourceModel()
+    p = _platform(recorder, regions=("us-east-1",))
+    Recorder(model).reconcile("aws-dom", p.get_cloud_data())
+    ifaces, _cidrs, _svcs, _v = compile_platform_data(model)
+    by_ip = {}
+    import ipaddress
+    for i in ifaces:
+        by_ip[str(ipaddress.IPv4Address(i.ip))] = i
+    vm_id = next(r.id for r in model.list(type="vm")
+                 if r.name == "web-us-east-1")
+    # secondary private ip AND its EIP both map to the attached VM
+    for addr in ("10.1.1.21", "52.9.1.9", "52.0.1.7"):
+        assert addr in by_ip, addr
+        assert by_ip[addr].l3_device_type == 1
+        assert by_ip[addr].l3_device_id == vm_id
