@@ -190,10 +190,32 @@ class AliyunPlatform:
                                     {}).get("IpAddress", [])
                 # ECS instances are VMs (vm.go getVMs -> model.VM),
                 # like the AWS client's EC2 rows
-                add("vm", iid, inst.get("InstanceName") or iid,
-                    epc_id=epc, vpc_id=epc,
-                    ip=ips[0] if ips else "",
-                    az=inst.get("ZoneId", ""))
+                vm_rid = add("vm", iid, inst.get("InstanceName") or iid,
+                             epc_id=epc, vpc_id=epc,
+                             ip=ips[0] if ips else "",
+                             az=inst.get("ZoneId", ""))
+                # VM public addresses: ONE WAN vinterface per VM with
+                # a wan_ip + vm-bound floating_ip per address
+                # (vm.go:115-150 reads PublicIpAddress; EipAddress —
+                # how VPC instances usually carry a public address on
+                # the real API — is covered here beyond the reference)
+                pubs = list((inst.get("PublicIpAddress", {})
+                             or {}).get("IpAddress", []))
+                eip = (inst.get("EipAddress", {})
+                       or {}).get("IpAddress", "")
+                if eip:
+                    pubs.append(eip)
+                vif = None
+                for pub in pubs:
+                    if not pub:
+                        continue
+                    if vif is None:
+                        vif = add("vinterface", f"{iid}/wan",
+                                  f"{iid}-wan", device_vm_id=vm_rid)
+                    add("wan_ip", f"{iid}/{pub}", pub,
+                        vinterface_id=vif, ip=pub)
+                    add("floating_ip", f"{iid}/{pub}", pub,
+                        vpc_id=epc, vm_id=vm_rid, ip=pub)
             # NAT gateways + their EIP floating ips
             # (nat_gateway.go:45-80: IpLists.IpList[].IpAddress)
             for nat in self._paged(region, "DescribeNatGateways",

@@ -71,11 +71,13 @@ def test_percent_encode_vendor_rules():
 _INSTANCES = {
     1: [{"InstanceId": "i-{r}-web", "InstanceName": "web-{r}",
          "ZoneId": "{r}-a",
+         "PublicIpAddress": {"IpAddress": ["47.1.2.3"]},
          "VpcAttributes": {"VpcId": "vpc-{r}",
                            "PrivateIpAddress":
                                {"IpAddress": ["10.2.1.10"]}}}],
     2: [{"InstanceId": "i-{r}-db", "InstanceName": "",
          "ZoneId": "{r}-b",
+         "EipAddress": {"IpAddress": "47.8.8.8"},
          "VpcAttributes": {"VpcId": "vpc-{r}",
                            "PrivateIpAddress":
                                {"IpAddress": ["10.2.1.11"]}}}],
@@ -229,6 +231,20 @@ def test_gather_normalizes_and_paginates(recorder):
     sw_attrs = {r.name: dict(r.attrs) for r in by["subnet"]}
     assert sw_attrs["sw-cn-hangzhou-1"]["epc_id"] == \
         vpc_ids["prod-cn-hangzhou"]
+    # VM public addresses: wan vinterface + wan_ip + vm floating_ip
+    wan = {r.name: dict(r.attrs) for r in by["wan_ip"]}
+    assert "47.1.2.3" in wan and "47.8.8.8" in wan   # incl. EipAddress
+    vm_ids = {r.name: r.id for r in by["vm"]}
+    fips = {(r.name, r.attr("vm_id")) for r in by["floating_ip"]}
+    # BOTH regions' web VMs carry their public ip (an or would let a
+    # one-region regression pass), and the EIP binds the db VMs
+    assert ("47.1.2.3", vm_ids["web-cn-hangzhou"]) in fips
+    assert ("47.1.2.3", vm_ids["web-cn-beijing"]) in fips
+    assert ("47.8.8.8", vm_ids["i-cn-hangzhou-db"]) in fips
+    # one WAN vinterface per VM, not one per address
+    wan_vifs = [r for r in by["vinterface"]
+                if r.name.endswith("-wan")]
+    assert len(wan_vifs) == len({r.id for r in wan_vifs}) == 4
     # nat/lb families land with resolved links
     vpc_hz = vpc_ids["prod-cn-hangzhou"]
     nat = {r.name: dict(r.attrs) for r in by["nat_gateway"]}
