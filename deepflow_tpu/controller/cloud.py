@@ -107,6 +107,24 @@ class ResourceBuilder:
         return self._rows
 
 
+def add_vm_public_addresses(b: "ResourceBuilder", vm_key: str,
+                            vm_rid: int, epc: int,
+                            addrs: Sequence[tuple]) -> None:
+    """The one normalized public-address shape every vendor client
+    emits (one copy, not N drifting ones): WAN vinterface per
+    (vm, mac) — vendors without macs collapse to one per vm — plus a
+    wan_ip and a vm-bound floating_ip per address."""
+    for ip, mac in addrs:
+        if not ip:
+            continue
+        vif = b.add("vinterface", f"{vm_key}/wan/{mac}",
+                    f"{vm_key}-wan", device_vm_id=vm_rid, mac=mac)
+        b.add("wan_ip", f"{vm_key}/{ip}", ip,
+              vinterface_id=vif, ip=ip)
+        b.add("floating_ip", f"{vm_key}/{ip}", ip,
+              vpc_id=epc, vm_id=vm_rid, ip=ip)
+
+
 def rows_to_resources(rows: Sequence[dict], domain: str) -> List[Resource]:
     """Normalized snapshot rows ({type, id?, name, ...attrs}) ->
     Resource list. Shared by HttpPlatform and the controller's

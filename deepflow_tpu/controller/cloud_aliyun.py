@@ -37,7 +37,8 @@ import urllib.request
 import uuid
 from typing import Dict, List, Optional, Sequence
 
-from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.cloud import (ResourceBuilder,
+                                           add_vm_public_addresses)
 from deepflow_tpu.controller.model import Resource
 
 ECS_VERSION = "2014-05-26"
@@ -194,28 +195,19 @@ class AliyunPlatform:
                              epc_id=epc, vpc_id=epc,
                              ip=ips[0] if ips else "",
                              az=inst.get("ZoneId", ""))
-                # VM public addresses: ONE WAN vinterface per VM with
-                # a wan_ip + vm-bound floating_ip per address
-                # (vm.go:115-150 reads PublicIpAddress; EipAddress —
-                # how VPC instances usually carry a public address on
-                # the real API — is covered here beyond the reference)
+                # VM public addresses (vm.go:115-150 reads
+                # PublicIpAddress; EipAddress — how VPC instances
+                # usually carry a public address on the real API — is
+                # covered beyond the reference); shared normalized
+                # shape via cloud.add_vm_public_addresses
                 pubs = list((inst.get("PublicIpAddress", {})
                              or {}).get("IpAddress", []))
                 eip = (inst.get("EipAddress", {})
                        or {}).get("IpAddress", "")
                 if eip:
                     pubs.append(eip)
-                vif = None
-                for pub in pubs:
-                    if not pub:
-                        continue
-                    if vif is None:
-                        vif = add("vinterface", f"{iid}/wan",
-                                  f"{iid}-wan", device_vm_id=vm_rid)
-                    add("wan_ip", f"{iid}/{pub}", pub,
-                        vinterface_id=vif, ip=pub)
-                    add("floating_ip", f"{iid}/{pub}", pub,
-                        vpc_id=epc, vm_id=vm_rid, ip=pub)
+                add_vm_public_addresses(
+                    b, iid, vm_rid, epc, [(p_, "") for p_ in pubs])
             # NAT gateways + their EIP floating ips
             # (nat_gateway.go:45-80: IpLists.IpList[].IpAddress)
             for nat in self._paged(region, "DescribeNatGateways",

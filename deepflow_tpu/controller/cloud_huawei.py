@@ -30,7 +30,8 @@ import urllib.parse
 import urllib.request
 from typing import List, Optional
 
-from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.cloud import (ResourceBuilder,
+                                           add_vm_public_addresses)
 from deepflow_tpu.controller.model import Resource
 
 PAGE_LIMIT = 50
@@ -186,7 +187,16 @@ class HuaweiPlatform:
                     break
             if not epc:
                 continue
-            add("vm", sid, srv.get("name") or sid,
-                epc_id=epc, vpc_id=epc, ip=ip,
-                az=srv.get("OS-EXT-AZ:availability_zone", ""))
+            vm_rid = add("vm", sid, srv.get("name") or sid,
+                         epc_id=epc, vpc_id=epc, ip=ip,
+                         az=srv.get("OS-EXT-AZ:availability_zone", ""))
+            # "floating"-typed address entries are the VM's public
+            # side (vm.go:158-186: WAN vinterface PER MAC — two NICs
+            # with their own EIPs must not share one vif)
+            add_vm_public_addresses(
+                b, sid, vm_rid, epc,
+                [(a2.get("addr", ""),
+                  a2.get("OS-EXT-IPS-MAC:mac_addr", ""))
+                 for addrs2 in addresses.values() for a2 in addrs2
+                 if a2.get("OS-EXT-IPS:type") == "floating"])
         return b.rows()

@@ -35,7 +35,8 @@ import urllib.parse
 import urllib.request
 from typing import List, Optional, Sequence, Tuple
 
-from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.cloud import (ResourceBuilder,
+                                           add_vm_public_addresses)
 from deepflow_tpu.controller.model import Resource
 
 CVM_VERSION = "2017-03-12"
@@ -212,10 +213,16 @@ class TencentPlatform:
                                   {}).get("VpcId", "")
                 epc = b.get("vpc", vpc_id)
                 ips = inst.get("PrivateIpAddresses") or []
-                add("vm", iid, inst.get("InstanceName") or iid,
-                    epc_id=epc, vpc_id=epc,
-                    ip=ips[0] if ips else "",
-                    az=inst.get("Placement", {}).get("Zone", ""))
+                vm_rid = add("vm", iid,
+                             inst.get("InstanceName") or iid,
+                             epc_id=epc, vpc_id=epc,
+                             ip=ips[0] if ips else "",
+                             az=inst.get("Placement",
+                                         {}).get("Zone", ""))
+                add_vm_public_addresses(
+                    b, iid, vm_rid, epc,
+                    [(p_, "") for p_ in
+                     inst.get("PublicIpAddresses") or []])
             # NAT gateways + their floating ips (nat_gateway.go:35-80:
             # NatGatewaySet rows carry PublicIpAddressSet)
             for nat in self._paged("vpc", VPC_VERSION,

@@ -93,7 +93,15 @@ class _Handler(BaseHTTPRequestHandler):
             # empty page may terminate (huawei.go:238-241)
             if marker == "":
                 rows = [{"id": "srv-1", "name": "web-1",
-                         "addresses": {"vpc-a": [{"addr": "10.4.1.10"}]},
+                         "addresses": {"vpc-a": [
+                             {"addr": "10.4.1.10",
+                              "OS-EXT-IPS:type": "fixed",
+                              "OS-EXT-IPS-MAC:mac_addr":
+                                  "fa:16:3e:00:00:01"},
+                             {"addr": "122.9.9.9",
+                              "OS-EXT-IPS:type": "floating",
+                              "OS-EXT-IPS-MAC:mac_addr":
+                                  "fa:16:3e:00:00:01"}]},
                          "OS-EXT-AZ:availability_zone": "cn-north-1a"}]
             elif marker == "srv-1":
                 rows = [{"id": "srv-2", "name": "novpc",
@@ -148,6 +156,12 @@ def test_gather_with_token_auth_and_marker_paging(recorder):
     vpc_id = by["vpc"][0].id
     assert vm["web-1"]["epc_id"] == vpc_id
     assert vm["web-1"]["ip"] == "10.4.1.10"
+    # the floating-typed address is the WAN side; fixed stays LAN-only
+    assert [r.name for r in by.get("wan_ip", [])] == ["122.9.9.9"]
+    vm_ids = {r.name: r.id for r in by["vm"]}
+    assert {(r.name, r.attr("vm_id"))
+            for r in by.get("floating_ip", [])} == {
+        ("122.9.9.9", vm_ids["web-1"])}
     # ONE token reused across every data call
     assert recorder.token_posts == 1
     markers = [m for path, m in recorder.calls

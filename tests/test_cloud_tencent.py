@@ -58,7 +58,8 @@ _INSTANCE_PAGES = {
     0: [{"InstanceId": "ins-{r}-web", "InstanceName": "web-{r}",
          "Placement": {"Zone": "{r}-1"},
          "VirtualPrivateCloud": {"VpcId": "vpc-{r}"},
-         "PrivateIpAddresses": ["10.3.1.10"]}],
+         "PrivateIpAddresses": ["10.3.1.10"],
+         "PublicIpAddresses": ["119.1.2.3"]}],
     1: [{"InstanceId": "ins-{r}-db", "InstanceName": "",
          "Placement": {"Zone": "{r}-2"},
          "VirtualPrivateCloud": {"VpcId": "vpc-{r}"},
@@ -220,6 +221,13 @@ def test_gather_normalizes_and_paginates(recorder):
     # vpc-service calls hit the vpc host, clb its own
     assert any(c[0] == "vpc" for c in recorder.calls)
     assert any(c[0] == "clb" for c in recorder.calls)
+    # instance public addresses: wan + vm-bound floating rows
+    assert any(r.name == "119.1.2.3" for r in by["wan_ip"])
+    vm_ids = {r.name: r.id for r in by["vm"]}
+    fips = {(r.name, r.attr("vm_id")) for r in by["floating_ip"]}
+    # BOTH regions (an `or` would let a one-region regression pass)
+    assert ("119.1.2.3", vm_ids["web-ap-guangzhou"]) in fips
+    assert ("119.1.2.3", vm_ids["web-ap-beijing"]) in fips
     # nat/lb families land with resolved links (the widened model)
     nat = {r.name: dict(r.attrs) for r in by["nat_gateway"]}
     assert nat["gw-ap-guangzhou"]["vpc_id"] == \
