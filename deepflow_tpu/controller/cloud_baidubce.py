@@ -30,7 +30,8 @@ import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional
 
-from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.cloud import (ResourceBuilder,
+                                           add_vm_public_addresses)
 from deepflow_tpu.controller.model import Resource
 
 PAGE_KEYS = 1000
@@ -142,8 +143,15 @@ class BaiduBcePlatform:
             if not iid:
                 continue
             epc = b.get("vpc", inst.get("vpcId", ""))
-            add("vm", iid, inst.get("name") or iid,
-                epc_id=epc, vpc_id=epc,
-                ip=inst.get("internalIp", ""),
-                az=inst.get("zoneName", ""))
+            vm_rid = add("vm", iid, inst.get("name") or iid,
+                         epc_id=epc, vpc_id=epc,
+                         ip=inst.get("internalIp", ""),
+                         az=inst.get("zoneName", ""))
+            # instance public address (vm.go:256-260 walks each
+            # private ip's PublicIpAddress; the detail row also
+            # carries the flat publicIp)
+            pub = inst.get("publicIp", "")
+            if pub:
+                add_vm_public_addresses(b, iid, vm_rid, epc,
+                                        [(pub, "")])
         return b.rows()

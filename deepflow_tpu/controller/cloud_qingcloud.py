@@ -27,7 +27,8 @@ import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
-from deepflow_tpu.controller.cloud import ResourceBuilder
+from deepflow_tpu.controller.cloud import (ResourceBuilder,
+                                           add_vm_public_addresses)
 from deepflow_tpu.controller.model import Resource
 
 PAGE_LIMIT = 100
@@ -154,15 +155,21 @@ class QingCloudPlatform:
                 if not iid:
                     continue
                 epc, ip = 0, ""
+                pubs = []
                 for vx in vm.get("vxnets") or ():
                     sub = b.get("subnet", vx.get("vxnet_id", ""))
-                    if sub:
+                    if sub and not epc:
                         for row in b.rows():
                             if row.type == "subnet" and row.id == sub:
                                 epc = row.attr("epc_id", 0)
                                 break
                         ip = vx.get("private_ip", "")
-                        break
-                add("vm", iid, vm.get("instance_name") or iid,
-                    epc_id=epc, vpc_id=epc, ip=ip, az=zone)
+                    # per-nic eip (vm.go:297: nic.eip.eip_addr)
+                    eip = (vx.get("eip") or {}).get("eip_addr", "")
+                    if eip:
+                        pubs.append((eip, vx.get("nic_id", "")))
+                vm_rid = add("vm", iid,
+                             vm.get("instance_name") or iid,
+                             epc_id=epc, vpc_id=epc, ip=ip, az=zone)
+                add_vm_public_addresses(b, iid, vm_rid, epc, pubs)
         return b.rows()

@@ -95,6 +95,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "instances": [
                             {"id": "i-1", "name": "web-1",
                              "internalIp": "172.16.1.8",
+                             "publicIp": "106.1.2.3",
                              "zoneName": "cn-bj-a",
                              "vpcId": "vpc-b1"}]}
             return {"isTruncated": False, "instances": [
@@ -141,6 +142,11 @@ def test_gather_with_header_auth_and_next_marker(recorder):
     markers = [m for path, m in recorder.calls
                if path == "/v2/instance"]
     assert markers == ["", "i-1"]
+    # instance public ip -> wan + vm-bound floating rows
+    assert any(r.name == "106.1.2.3" for r in by["wan_ip"])
+    vm_ids = {r.name: r.id for r in by["vm"]}
+    assert ("106.1.2.3", vm_ids["web-1"]) in {
+        (r.name, r.attr("vm_id")) for r in by["floating_ip"]}
 
 
 def test_bad_secret_fails_auth(recorder):

@@ -106,7 +106,10 @@ class _Handler(BaseHTTPRequestHandler):
                          "instance_name": f"web-{zone}",
                          "status": "running",
                          "vxnets": [{"vxnet_id": f"vxnet-{zone}-1",
-                                     "private_ip": "192.168.1.9"}]}],
+                                     "nic_id": "52:54:00:00:00:01",
+                                     "private_ip": "192.168.1.9",
+                                     "eip": {"eip_addr":
+                                             "139.1.2.3"}}]}],
                     1: [{"instance_id": f"i-{zone}-db",
                          "instance_name": "",
                          "status": "running",
@@ -154,6 +157,12 @@ def test_gather_routers_as_vpcs_and_paging(recorder):
     vm = {r.name: dict(r.attrs) for r in by["vm"]}
     assert vm["web-pek3a"]["epc_id"] == vpc_ids["vpc-pek3a"]
     assert vm["web-pek3a"]["ip"] == "192.168.1.9"
+    # per-nic eips land as wan + vm-bound floating rows
+    assert any(r.name == "139.1.2.3" for r in by["wan_ip"])
+    vm_ids = {r.name: r.id for r in by["vm"]}
+    fips = {(r.name, r.attr("vm_id")) for r in by["floating_ip"]}
+    assert ("139.1.2.3", vm_ids["web-pek3a"]) in fips
+    assert ("139.1.2.3", vm_ids["web-gd2a"]) in fips
     # offset paging walked both instance pages per zone
     pages = sorted(c for c in recorder.calls
                    if c[0] == "DescribeInstances")
