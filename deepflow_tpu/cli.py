@@ -215,6 +215,28 @@ def cmd_cloud(args) -> int:
             body["url"] = args.url
         elif args.platform == "kubernetes_gather":
             body["cluster"] = args.cluster or args.name
+        if args.config:
+            # vendor platforms (aws/aliyun/tencent/huawei/qingcloud/
+            # baidubce) carry credentials + regions/endpoints in a
+            # JSON file merged into the create body — the positional
+            # name and --platform stay authoritative (a config copied
+            # from another setup must not silently redirect the
+            # create), and a non-object file fails crisply
+            with open(args.config) as f:
+                cfg = json.load(f)
+            if not isinstance(cfg, dict):
+                raise RuntimeError(
+                    f"{args.config}: expected a JSON object")
+            for reserved in ("domain", "platform"):
+                if cfg.pop(reserved, None) is not None:
+                    print(f"note: ignoring {reserved!r} from "
+                          f"{args.config} (command line wins)",
+                          file=sys.stderr)
+            body.update(cfg)
+        elif args.platform not in ("filereader", "http",
+                                   "kubernetes_gather"):
+            raise RuntimeError(
+                f"--config is required for platform {args.platform}")
         print(json.dumps(_http(f"{base}/domains", body=body)))
     elif args.action == "list":
         rows = _http(f"{base}/tasks")
@@ -457,10 +479,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["add", "list", "refresh", "delete"])
     c.add_argument("name", nargs="?", help="domain name")
     c.add_argument("--platform", default="filereader",
-                   choices=["filereader", "http", "kubernetes_gather"])
+                   choices=["filereader", "http", "kubernetes_gather",
+                            "aws", "aliyun", "tencent", "huawei",
+                            "qingcloud", "baidubce"])
     c.add_argument("--path", help="resource document (filereader)")
     c.add_argument("--url", help="snapshot URL (http)")
     c.add_argument("--cluster", help="cluster name (kubernetes_gather)")
+    c.add_argument("--config", help="JSON file merged into the domain "
+                   "body (vendor credentials/regions/endpoints — "
+                   "secrets stay off the command line)")
     c.add_argument("--interval", type=float, default=60.0)
     c.set_defaults(fn=cmd_cloud)
 

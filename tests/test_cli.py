@@ -189,3 +189,39 @@ def test_capture_ring_flag(capsys):
     assert out["frames_captured"] > 0          # the ring really harvested
     assert out["kernel_packets"] > 0           # PACKET_STATISTICS surfaced
     assert "kernel_drops" in out
+
+
+def test_cli_cloud_add_vendor_via_config(stack, capsys, tmp_path):
+    """Vendor domains create through --config (credentials stay in a
+    file, merged into the body) against the live ops API + a live
+    signature-verifying vendor fixture."""
+    import threading
+
+    from tests.test_cloud_aliyun import _Recorder, ACCESS, SECRET
+
+    rec = _Recorder()
+    threading.Thread(target=rec.serve_forever, daemon=True).start()
+    try:
+        srv, _ = stack
+        base = f"http://127.0.0.1:{srv.port}"
+        cfg = tmp_path / "ali.json"
+        cfg.write_text(json.dumps({
+            "secret_id": ACCESS, "secret_key": SECRET,
+            "regions": ["cn-hangzhou"],
+            "endpoint_template":
+                f"http://127.0.0.1:{rec.server_address[1]}"
+                "/{region}"}))
+        rc, out = _run(capsys, "--controller", base, "cloud", "add",
+                       "ali-cli", "--platform", "aliyun",
+                       "--config", str(cfg))
+        assert rc == 0 and not json.loads(out)["auth_failed"]
+        rc, out = _run(capsys, "--controller", base, "cloud",
+                       "refresh", "ali-cli")
+        assert rc == 0 and json.loads(out)["resource_count"] >= 6
+        # a vendor platform without --config fails crisply
+        rc, out = _run(capsys, "--controller", base, "cloud", "add",
+                       "bad", "--platform", "tencent")
+        assert rc != 0
+    finally:
+        rec.shutdown()
+        rec.server_close()
