@@ -61,6 +61,7 @@ BPF_PROG_TYPE_XDP = 6
 BPF_MAP_TYPE_HASH = 1
 BPF_MAP_TYPE_ARRAY = 2
 BPF_MAP_TYPE_PERF_EVENT_ARRAY = 4
+BPF_MAP_TYPE_LRU_HASH = 9
 
 # opcode classes / fields (linux/bpf_common.h + bpf.h)
 BPF_LD, BPF_LDX, BPF_ST, BPF_STX = 0x00, 0x01, 0x02, 0x03

@@ -54,7 +54,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW,
                                     BPF_JEQ, BPF_JGE, BPF_JGT, BPF_JNE,
                                     BPF_JSLE,
-                                    BPF_MAP_TYPE_HASH,
+                                    BPF_MAP_TYPE_LRU_HASH,
                                     BPF_MAP_TYPE_PERF_EVENT_ARRAY,
                                     BPF_PROG_TYPE_KPROBE, BPF_W,
                                     FN_get_current_comm,
@@ -119,8 +119,15 @@ def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
     ncpus = ncpus or os.cpu_count() or 1
     made: List[Map] = []
     try:
-        for args in ((8192, 24, BPF_MAP_TYPE_HASH, 8),
-                     (8192, 16, BPF_MAP_TYPE_HASH, 8),
+        # active + trace are LRU: entries whose consumer never runs (a
+        # kill between enter and exit; a goroutine that parks an
+        # ingress id and exits without an egress — goid keys are
+        # monotonic and never naturally overwritten) must age out
+        # instead of filling the map and silently stopping ALL
+        # stash/park updates process-wide (socket_trace.c's maps are
+        # LRU for the same reason)
+        for args in ((8192, 24, BPF_MAP_TYPE_LRU_HASH, 8),
+                     (8192, 16, BPF_MAP_TYPE_LRU_HASH, 8),
                      (2, 8),
                      (ncpus, 4, BPF_MAP_TYPE_PERF_EVENT_ARRAY)):
             made.append(Map(*args))
@@ -212,8 +219,12 @@ def emit_record_tail(a: Asm, maps, direction: int, source: int = 0,
     Register/stack CONTRACT on entry (the callers' prologues establish
     it): R6=ctx, R7=pid_tgid, R8=payload length already clamped to
     (0, PAYLOAD_CAP], R9=user buffer pointer (or user_msghdr* when
-    `msghdr_check` and the _FLAG slot is nonzero), _KEY holds pid_tgid
-    and _FDSAVE the fd. Jumps target the "done" label the CALLER must
+    `msghdr_check` and the _FLAG slot is nonzero), _KEY holds the
+    caller's park/consume key — pid_tgid here and for pid_tgid-keyed
+    uprobe callers, the bit63|tgid|goid key for goid-keyed Go-TLS
+    callers (uprobe_trace._goid_rekey) — and _FDSAVE the fd. The
+    record's own pid_tgid field always comes from R7, whatever the
+    key shape. Jumps target the "done" label the CALLER must
     place before its exit. `source` is the reference's
     process_data_extra_source (common.h:79): packed into the record's
     direction word's high half — SOURCE_SYSCALL (0) keeps the word

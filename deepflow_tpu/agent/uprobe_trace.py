@@ -47,25 +47,56 @@ tests/test_attach_live.py attaches to a compiled stand-in libssl and
 drives real in-kernel captures (plaintext + in-kernel trace chaining)
 through the perf ring into EbpfTracer, un-skipped.
 
-Deviation, documented: the reference keys in-flight Go TLS calls by
-(tgid, goroutine id) read from the runtime.g via per-version offsets
-(uprobe_base_bpf.c:1); this suite keys by pid_tgid. A goroutine
-migrating OS threads between a Read's entry and its RET loses that
-call's record (dropped stash), never corrupts another's: the fallback
-is bounded to loss, not confusion.
+Goroutine-id keying (uprobe_base_bpf.c:1's get_current_goroutine):
+register-ABI Go keeps the current g in R14, so the programs read
+runtime.g.goid at the per-version offset userspace pushes in
+proc_info (goid_off; 0 disables) and key the in-flight stash AND the
+trace park/consume by (bit63 | tgid << 32 | goid & 0xffffffff)
+instead of pid_tgid. A goroutine migrating OS threads between a
+Read's entry and its RET now keeps its record and its trace chain —
+the exact loss mode the pid_tgid fallback had. Bit 63 partitions goid
+keys from the syscall suite's pid_tgid keys in the SHARED trace map
+(a pid_tgid's high word is a tgid < 2^22, so its bit 63 is always
+clear; without the partition a syscall park could be consumed by the
+wrong source). Stack ABI keeps pid_tgid keying: pre-1.17 g lives in
+TLS, not a register (userspace pushes goid_off=0). With keying
+enabled, a failed in-kernel goid read DROPS that call rather than
+falling back — a fallback would be asymmetric across the enter/exit
+pair and could pair an exit with a different call's stash
+(_goid_rekey's docstring has the full argument). The stash/trace maps
+are LRU: goid keys are monotonic — never naturally overwritten — so
+entries abandoned between enter and exit (goroutine exits with a
+parked id; panic unwinds past the RET) age out instead of filling a
+plain hash map and stopping all parking process-wide.
+
+Known tradeoff (documented, matches neither mode of the reference
+exactly): bit63-partitioned goid keys mean a goid-keyed TLS record
+cannot consume a trace id parked by a plaintext SYSCALL record of the
+same goroutine (and vice versa) — cross-source chaining inside one Go
+process requires goid-keying the syscall suite too, which the
+reference does via its unified get_current_goroutine key (and which
+loses the partition's never-cross-source-confused property). Non-Go
+and stack-ABI processes chain across sources exactly as before; for
+TLS'd connections the syscall records carry ciphertext and produce no
+L7 sessions anyway, so the loss is the TLS-to-plaintext-egress chain,
+which the userspace tempo assembly can still recover via trace
+headers when the app propagates them.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_ARSH, BPF_DW,
-                                    BPF_JEQ, BPF_JGT, BPF_JSGT, BPF_JSLE,
-                                    BPF_LSH, BPF_MAP_TYPE_HASH,
-                                    BPF_PROG_TYPE_KPROBE, BPF_RSH, BPF_W,
+                                    BPF_JEQ, BPF_JGT, BPF_JNE, BPF_JSGT,
+                                    BPF_JSLE, BPF_LSH, BPF_MAP_TYPE_HASH,
+                                    BPF_MAP_TYPE_LRU_HASH, BPF_OR,
+                                    BPF_PROG_TYPE_KPROBE,
+                                    BPF_RSH, BPF_W,
                                     FN_get_current_pid_tgid,
                                     FN_map_delete_elem,
                                     FN_map_lookup_elem,
@@ -84,8 +115,9 @@ from deepflow_tpu.agent.socket_trace import (_FDSAVE, _IOVPAIR,  # noqa
                                              _PT_SI, _SCRATCH)
 
 # x86_64 pt_regs offsets beyond socket_trace's (uprobes see the USER
-# registers directly — no syscall-wrapper inner-pt_regs hop)
-_PT_BX, _PT_CX, _PT_SP = 40, 88, 152
+# registers directly — no syscall-wrapper inner-pt_regs hop); R14 is
+# where register-ABI Go keeps the current g
+_PT_BX, _PT_CX, _PT_SP, _PT_R14 = 40, 88, 152, 8
 
 # OpenSSL fd recovery: ssl->rbio, then BIO->num at the offset each
 # libssl generation uses (openssl_bpf.c:43-47 — constants because
@@ -99,23 +131,37 @@ RBIO_FD_OFFS = (0x38, 0x30, 0x28)      # 3.x, 1.1.1, 1.1.0
 GO_DEFAULT_INFO = {"reg_abi": 1, "conn_off": 0, "fd_off": 0,
                    "sysfd_off": 16}
 
+# runtime.g.goid file: 152 bytes of fields precede goid (stack 16,
+# stackguard0/1, _panic, _defer, m, sched gobuf 56, syscallsp/pc,
+# stktopsp, param, atomicstatus+stackLock) from go 1.5 through 1.22;
+# 1.23 inserted syscallbp after syscallpc, shifting goid to 160
+# (go_tracer.c's per-version data_members table role)
+GOID_OFF_DEFAULT, GOID_OFF_GO123 = 152, 160
+
 # fresh stack slots (below socket_trace's frame, which tops out at
 # _IOVPAIR = -264 .. -249)
 _GOSTASH = -288      # stash build area {buf, fd, sp} (24B, -288..-265)
 _PIKEY = -296        # u32 tgid key for proc_info lookups
 _PIOFFS = -312       # {conn_off, fd_off, sysfd_off, pad} copy (16B)
+_GOIDVAL = -328      # probe_read target for runtime.g.goid (8B)
+_GOIDOFF = -336      # u32 goid_off copy (0 = pid_tgid keying)
 
 
 @dataclass
 class UprobeMaps:
     """ssl_ctx / go_conn / proc_info plus the SHARED trace/conf/events
-    maps — sharing them with a SocketTraceSuite (pass its maps) is what
-    makes a TLS read park the same trace id a later plaintext write
-    consumes: one trace-id space across syscall and uprobe sources."""
+    maps. Sharing them with a SocketTraceSuite (pass its maps) gives
+    one trace-id ALLOCATOR and one event stream across syscall and
+    uprobe sources, and OpenSSL/stack-ABI records (pid_tgid-keyed)
+    park/consume against syscall records directly. Goid-keyed records
+    (register-ABI Go) park in the same map under bit63-partitioned
+    keys — chained among themselves per-goroutine, not with the
+    syscall suite's pid_tgid parks (see the module docstring's
+    tradeoff note)."""
 
     ssl_ctx: Map         # pid_tgid -> {buf, fd}            (16B)
-    go_conn: Map         # pid_tgid -> {buf, fd, entry sp}  (24B)
-    proc_info: Map       # tgid -> {reg_abi, conn/fd/sysfd offs} (16B)
+    go_conn: Map         # goid key -> {buf, fd, entry sp}  (24B)
+    proc_info: Map       # tgid -> {reg_abi, conn/fd/sysfd/goid offs} (24B)
     shared: SocketTraceMaps
     owns_shared: bool = False
 
@@ -132,11 +178,15 @@ class UprobeMaps:
         return self.shared.events
 
     def set_proc_info(self, tgid: int, reg_abi: bool, conn_off: int = 0,
-                      fd_off: int = 0, sysfd_off: int = 16) -> None:
+                      fd_off: int = 0, sysfd_off: int = 16,
+                      goid_off: int = 0) -> None:
+        """goid_off nonzero enables goroutine-id keying for this tgid;
+        the userspace contract is goid_off=0 whenever reg_abi is false
+        (stack-ABI Go has no g register for the program to read)."""
         self.proc_info.update_bytes(
             struct.pack("<I", tgid),
-            struct.pack("<IIII", 1 if reg_abi else 0, conn_off, fd_off,
-                        sysfd_off))
+            struct.pack("<IIIIII", 1 if reg_abi else 0, conn_off, fd_off,
+                        sysfd_off, goid_off if reg_abi else 0, 0))
 
     def close(self) -> None:
         for m in (self.ssl_ctx, self.go_conn, self.proc_info):
@@ -152,9 +202,16 @@ def create_uprobe_maps(
         shared = create_maps()
     made: List[Map] = []
     try:
-        for args in ((8192, 16, BPF_MAP_TYPE_HASH, 8),
-                     (8192, 24, BPF_MAP_TYPE_HASH, 8),
-                     (1024, 16, BPF_MAP_TYPE_HASH, 4)):
+        # ssl_ctx / go_conn are LRU: a stash whose exit never fires (a
+        # panic unwinding past the RET uprobe; an undecodable-exit
+        # function whose enters still run; goid keys that are never
+        # naturally overwritten) must age out, not brick the map.
+        # proc_info stays a plain HASH — LRU eviction there would
+        # silently disable keying for a managed process, and its
+        # population is bounded by managed tgids, not call traffic.
+        for args in ((8192, 16, BPF_MAP_TYPE_LRU_HASH, 8),
+                     (8192, 24, BPF_MAP_TYPE_LRU_HASH, 8),
+                     (1024, 24, BPF_MAP_TYPE_HASH, 4)):
             made.append(Map(*args))
     except OSError:
         for m in made:
@@ -173,6 +230,61 @@ def _clamp_len(a: Asm) -> None:
     a.jmp("len_ok")
     a.label("clamp").mov_imm(R8, PAYLOAD_CAP)
     a.label("len_ok")
+
+
+def _goid_rekey(a: Asm) -> None:
+    """Rewrite the _KEY slot from pid_tgid to (tgid<<32 | goid-slice).
+
+    Contract on entry: R6=ctx (user pt_regs), R7=pid_tgid, _GOIDOFF
+    holds the u32 goid offset (0 = keep pid_tgid), _KEY already holds
+    pid_tgid. Clobbers R0-R3 and _GOIDVAL.
+
+    Fault discipline (review r5): with keying ENABLED (goid_off != 0)
+    any failed goid read — no g in R14, probe_read fault, goid 0 —
+    jumps to the program's "done" label and DROPS the call, it does
+    not fall back to pid_tgid. A fallback here would be asymmetric
+    across the enter/exit pair: an enter that faulted would stash
+    under pid_tgid(thread) where a LATER call's faulting exit on the
+    same thread could find it and emit that other call's buffer as its
+    own — wrong-payload confusion. Dropping keeps the guarantee
+    loss-only. (goid reads fault only in exceptional states — the g
+    page is always resident for a running goroutine — so the loss rate
+    is negligible; the reference accepts the confusion instead by
+    falling back to tid, common.h get_current_goroutine returning 0.)
+    Only goid_off == 0 (keying disabled: stack ABI, unmanaged tgid)
+    keeps the pid_tgid key, where enter and exit are symmetric by
+    construction.
+
+    Key shape: bit63 | tgid<<32 | (goid & 0xffffffff). Bit 63 is the
+    source partition for the SHARED trace map: pid_tgid keys always
+    have it clear (the high word is a tgid < pid_max = 2^22), so a
+    goid key can never consume a syscall park or vice versa
+    (uprobe_base_bpf.c keys its own map by tgid+goid; here one map
+    serves both sources, so the partition carries the separation).
+    Residual ambiguity: two goroutines in one tgid whose goids are
+    congruent mod 2^32, BOTH with a call in flight — goids are
+    monotonic, so that needs ~4 billion goroutine spawns between two
+    concurrently-live calls; the LRU maps bound the damage to one
+    wrong pairing even then."""
+    a.ldx_mem(BPF_W, R1, R10, _GOIDOFF)
+    a.jmp_imm(BPF_JEQ, R1, 0, "gokey_done")        # keying disabled
+    a.ldx_mem(BPF_DW, R3, R6, _PT_R14)             # current g
+    a.jmp_imm(BPF_JEQ, R3, 0, "done")              # no g: drop call
+    a.alu_reg(BPF_ADD, R3, R1)                     # &g.goid
+    a.st_imm(BPF_DW, R10, _GOIDVAL, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOIDVAL)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.jmp_imm(BPF_JNE, R0, 0, "done")              # faulted: drop call
+    a.ldx_mem(BPF_DW, R1, R10, _GOIDVAL)
+    a.jmp_imm(BPF_JEQ, R1, 0, "done")              # goid 0: drop call
+    a.alu_imm(BPF_LSH, R1, 32).alu_imm(BPF_RSH, R1, 32)  # goid lo32
+    a.mov_reg(R2, R7).alu_imm(BPF_RSH, R2, 32).alu_imm(BPF_LSH, R2, 32)
+    a.alu_reg(BPF_OR, R1, R2)                      # | tgid<<32
+    a.mov_imm(R2, 1).alu_imm(BPF_LSH, R2, 63)
+    a.alu_reg(BPF_OR, R1, R2)                      # | bit63 partition
+    a.stx_mem(BPF_DW, R10, R1, _KEY)
+    a.label("gokey_done")
 
 
 def build_ssl_enter(maps: UprobeMaps) -> Asm:
@@ -276,6 +388,9 @@ def build_go_tls_enter(maps: UprobeMaps) -> Asm:
     a.stx_mem(BPF_W, R10, R1, _PIOFFS + 12)
     a.ldx_mem(BPF_W, R1, R0, 12)                   # sysfd_off
     a.stx_mem(BPF_W, R10, R1, _SCRATCH)
+    a.ldx_mem(BPF_W, R1, R0, 16)                   # goid_off
+    a.stx_mem(BPF_W, R10, R1, _GOIDOFF)
+    _goid_rekey(a)                                 # stash keyed by goid
     a.ldx_mem(BPF_DW, R1, R6, _PT_SP)              # entry sp (exit's
     a.stx_mem(BPF_DW, R10, R1, _GOSTASH + 16)      # stack-ABI ret read)
     a.ldx_mem(BPF_DW, R1, R10, _PIOFFS + 0)
@@ -339,6 +454,19 @@ def build_go_tls_exit(maps: UprobeMaps, direction: int) -> Asm:
     a.call(FN_get_current_pid_tgid)
     a.mov_reg(R7, R0)
     a.stx_mem(BPF_DW, R10, R7, _KEY)
+    # proc_info FIRST (the enter gated on it too): reg_abi for the ret
+    # read, goid_off so the stash lookup key matches the enter's
+    a.mov_reg(R1, R7).alu_imm(BPF_RSH, R1, 32)
+    a.stx_mem(BPF_W, R10, R1, _PIKEY)
+    a.ld_map_fd(R1, maps.proc_info)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _PIKEY)
+    a.call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "done")
+    a.ldx_mem(BPF_W, R1, R0, 0)                    # reg_abi
+    a.stx_mem(BPF_DW, R10, R1, _PIOFFS + 0)
+    a.ldx_mem(BPF_W, R1, R0, 16)                   # goid_off
+    a.stx_mem(BPF_W, R10, R1, _GOIDOFF)
+    _goid_rekey(a)                                 # same key the enter built
     a.ld_map_fd(R1, maps.go_conn)
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
     a.call(FN_map_lookup_elem)
@@ -351,13 +479,7 @@ def build_go_tls_exit(maps: UprobeMaps, direction: int) -> Asm:
     a.ld_map_fd(R1, maps.go_conn)                  # consume the stash
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
     a.call(FN_map_delete_elem)
-    a.mov_reg(R1, R7).alu_imm(BPF_RSH, R1, 32)
-    a.stx_mem(BPF_W, R10, R1, _PIKEY)
-    a.ld_map_fd(R1, maps.proc_info)
-    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _PIKEY)
-    a.call(FN_map_lookup_elem)
-    a.jmp_imm(BPF_JEQ, R0, 0, "done")
-    a.ldx_mem(BPF_W, R1, R0, 0)                    # reg_abi
+    a.ldx_mem(BPF_DW, R1, R10, _PIOFFS + 0)        # reg_abi
     a.jmp_imm(BPF_JEQ, R1, 0, "stack_ret")
     a.ldx_mem(BPF_DW, R8, R6, _PT_AX)              # n in AX
     a.jmp("have_ret")
@@ -563,21 +685,45 @@ def go_version(path: str) -> Optional[str]:
     if not ({".go.buildinfo", ".gopclntab"} & set(secs)
             or ".note.go.buildid" in secs):
         return None
-    import re
     m = re.search(rb"go1\.\d+(\.\d+)?", data)
     return m.group(0).decode() if m else None
+
+
+def _go_release(version: Optional[str]) -> Optional[Tuple[int, int]]:
+    """(major, minor) from a toolchain version string, tolerating
+    prerelease suffixes ("go1.23rc1" -> (1, 23), "go1.24beta2" ->
+    (1, 24)); None when unparseable. ONE parser for every
+    version-gated decision below — two hand-rolled copies disagreed on
+    the unparseable fallback once, which mis-keyed prerelease
+    toolchains (review r5)."""
+    if not version or not version.startswith("go"):
+        return None
+    m = re.match(r"go(\d+)\.(\d+)", version)
+    if not m:
+        return None
+    return int(m.group(1)), int(m.group(2))
 
 
 def go_register_abi(version: Optional[str]) -> bool:
     """regabi (args in AX/BX/...) landed on amd64 in go 1.17
     (go_tracer.c's is_register_based_call)."""
-    if not version or not version.startswith("go"):
-        return True          # modern default
-    try:
-        parts = version[2:].split(".")
-        return (int(parts[0]), int(parts[1])) >= (1, 17)
-    except (ValueError, IndexError):
-        return True
+    rel = _go_release(version)
+    return True if rel is None else rel >= (1, 17)   # modern default
+
+
+def go_goid_offset(version: Optional[str]) -> int:
+    """Offset of runtime.g.goid for this toolchain version, 0 when
+    keying must be disabled: stack ABI (no g register to read), or an
+    UNPARSEABLE version — a guessed offset on the wrong layout would
+    read atomicstatus/stackLock, collapsing every goroutine onto one
+    key and cross-wiring their stashes, strictly worse than the
+    pid_tgid fallback's bounded loss. The reference resolves this from
+    its per-version data_members table (go_tracer.c:71-175); the
+    layout history is in GOID_OFF_DEFAULT's comment."""
+    rel = _go_release(version)
+    if rel is None or rel < (1, 17):
+        return 0
+    return GOID_OFF_GO123 if rel >= (1, 23) else GOID_OFF_DEFAULT
 
 
 # -- attach planning --------------------------------------------------------
@@ -604,6 +750,7 @@ class UprobeSpec:
 class GoProcPlan:
     version: str
     reg_abi: bool
+    goid_off: int = 0    # runtime.g.goid offset (0 = pid_tgid keying)
     specs: List[UprobeSpec] = field(default_factory=list)
     undecodable: List[str] = field(default_factory=list)
 
@@ -655,7 +802,8 @@ def plan_go(path: str) -> Optional[GoProcPlan]:
         return None
     funcs = elf_func_table(path)
     plan = GoProcPlan(version=version,
-                      reg_abi=go_register_abi(version))
+                      reg_abi=go_register_abi(version),
+                      goid_off=go_goid_offset(version))
     data = _read_elf(path) or b""
     for sym, direction in GO_TLS_SYMBOLS.items():
         if sym not in funcs:
@@ -737,6 +885,15 @@ class TlsUprobeSource:
                                  "probes": len(specs)})
         return len(specs)
 
+    def _push_proc_info(self, plan: GoProcPlan, tgid: int) -> None:
+        """ONE place turning a plan into a proc_info row — every field
+        added to the row (reg_abi, walk offsets, goid_off, ...) must
+        reach both the fresh-attach and already-attached paths."""
+        self.suite.maps.set_proc_info(
+            tgid, reg_abi=plan.reg_abi, goid_off=plan.goid_off,
+            **{k: GO_DEFAULT_INFO[k]
+               for k in ("conn_off", "fd_off", "sysfd_off")})
+
     def attach_go(self, path: str, tgid: Optional[int] = None) -> int:
         """Attach the Go-TLS set to a Go binary and push its ABI/offset
         proc_info (for `tgid`, or every current process running that
@@ -747,10 +904,7 @@ class TlsUprobeSource:
         if key in self._attached:
             plan = plan_go(path)
             if plan is not None and tgid is not None:
-                self.suite.maps.set_proc_info(
-                    tgid, reg_abi=plan.reg_abi, **{
-                        k: GO_DEFAULT_INFO[k]
-                        for k in ("conn_off", "fd_off", "sysfd_off")})
+                self._push_proc_info(plan, tgid)
                 if self._http2_suite is not None:
                     # a NEW pid of an already-probed binary needs its
                     # http2_info row too, or its writeHeader probes
@@ -772,13 +926,11 @@ class TlsUprobeSource:
                 progs[s.role], s.path, s.offset, s.retprobe))
         tgids = [tgid] if tgid is not None else _pids_running(path)
         for t in tgids:
-            self.suite.maps.set_proc_info(
-                t, reg_abi=plan.reg_abi, **{
-                    k: GO_DEFAULT_INFO[k]
-                    for k in ("conn_off", "fd_off", "sysfd_off")})
+            self._push_proc_info(plan, t)
         self.targets.append({"kind": "go_tls", "path": path,
                              "version": plan.version,
                              "reg_abi": plan.reg_abi,
+                             "goid_off": plan.goid_off,
                              "probes": len(plan.specs),
                              "tgids": tgids,
                              "undecodable": plan.undecodable})

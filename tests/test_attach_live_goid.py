@@ -1,0 +1,214 @@
+"""LIVE goroutine-id keying: the Go-TLS uprobe pair chained across OS
+threads. Register-ABI Go keeps the current g in R14 and may move a
+goroutine between threads while a crypto/tls Read/Write is in flight —
+the exact case pid_tgid keying loses. These tests drive the REAL
+kernel programs with a compiled stand-in that reproduces the Go
+calling environment (receiver in AX, slice in BX, fake runtime.g in
+R14) and prove:
+
+- enter on thread A + exit on thread B with the SAME goid emits the
+  record (goid keying found the stash across the migration);
+- with goid keying disabled (goid_off=0, the stack-ABI contract) the
+  same migration drops the record, while a same-thread pair still
+  works — the documented pid_tgid fallback, loss-bounded.
+
+Reference: agent/src/ebpf/kernel/uprobe_base_bpf.c:1 (goroutine id
+from runtime.g via per-version offset), user/go_tracer.c proc_info
+push."""
+
+import shutil
+import struct
+import subprocess
+
+import pytest
+
+from deepflow_tpu.agent import bpf, perf_ring, uprobe_trace
+from deepflow_tpu.agent.socket_trace import (SOURCE_GO_TLS_UPROBE,
+                                             T_EGRESS, parse_record)
+
+_cc = shutil.which("gcc") or shutil.which("cc")
+_attach_ok, _attach_why = uprobe_trace.attach_available()
+
+pytestmark = [
+    pytest.mark.skipif(not bpf.available(), reason="bpf(2) unavailable"),
+    pytest.mark.skipif(not _attach_ok,
+                       reason=f"uprobe attach masked: {_attach_why}"),
+    pytest.mark.skipif(_cc is None, reason="no C toolchain"),
+]
+
+_GOID = 0x11223344AABBCCDD     # bit 31 set in the low-32 slice
+_SYSFD = 33
+
+# The stand-in: two bare probe-point functions (attach targets), and
+# callers that reproduce the register state the programs read — AX =
+# receiver, BX = slice data, R14 = g (what register-ABI Go guarantees
+# at function entry), AX = byte count at the RET site. Structs mimic
+# the tls.Conn -> net.conn -> netFD -> Sysfd walk at the
+# GO_DEFAULT_INFO offsets, and g carries goid at +152.
+_DRIVER_C = r"""
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+
+__attribute__((noinline)) void go_probe_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+__attribute__((noinline)) void go_ret_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+
+struct netfd  { long pad[2]; int sysfd; };          /* Sysfd at +16 */
+struct netconn{ struct netfd *fd; };                /* *netFD at +0 */
+struct conn   { void *itab; struct netconn *data; };/* iface data +8 */
+struct fakeg  { char pad[152]; unsigned long long goid; };
+
+static struct netfd  nfd  = { {0, 0}, 33 };
+static struct netconn ncn = { &nfd };
+static struct conn    cn  = { 0, &ncn };
+static struct fakeg   g   = { {0}, 0x11223344AABBCCDDULL };
+static char req[] = "GET /goid HTTP/1.1\r\nHost: svc\r\n\r\n";
+
+static void call_enter(void) {
+  __asm__ volatile(
+    "mov %0, %%rax\n\t"
+    "mov %1, %%rbx\n\t"
+    "mov %2, %%r14\n\t"
+    "call go_probe_point\n\t"
+    : : "r"(&cn), "r"(req), "r"(&g)
+    : "rax", "rbx", "r14", "memory");
+}
+
+static void call_exit(void) {
+  long n = (long)strlen(req);
+  __asm__ volatile(
+    "mov %0, %%rax\n\t"
+    "mov %1, %%r14\n\t"
+    "call go_ret_point\n\t"
+    : : "r"(n), "r"(&g)
+    : "rax", "r14", "memory");
+}
+
+static void call_enter_badg(void) {   /* g -> unmapped page */
+  __asm__ volatile(
+    "mov %0, %%rax\n\t"
+    "mov %1, %%rbx\n\t"
+    "mov %2, %%r14\n\t"
+    "call go_probe_point\n\t"
+    : : "r"(&cn), "r"(req), "r"((void *)8)
+    : "rax", "rbx", "r14", "memory");
+}
+
+static void call_exit_badg(void) {
+  long n = (long)strlen(req);
+  __asm__ volatile(
+    "mov %0, %%rax\n\t"
+    "mov %1, %%r14\n\t"
+    "call go_ret_point\n\t"
+    : : "r"(n), "r"((void *)8)
+    : "rax", "r14", "memory");
+}
+
+static void *run_enter(void *a) { (void)a; call_enter(); return 0; }
+static void *run_exit(void *a)  { (void)a; call_exit();  return 0; }
+
+int main(int argc, char **argv) {
+  getchar();   /* parent pushes proc_info for our tgid, then signals */
+  const char *mode = argc > 1 ? argv[1] : "same";
+  if (strcmp(mode, "cross") == 0) { /* DIFFERENT OS threads */
+    pthread_t t;
+    pthread_create(&t, 0, run_enter, 0); pthread_join(t, 0);
+    pthread_create(&t, 0, run_exit, 0);  pthread_join(t, 0);
+  } else if (strcmp(mode, "faultg") == 0) {
+    /* goid read faults on BOTH sides: with keying enabled the call
+       must be DROPPED, never pid_tgid-paired (review r5) */
+    call_enter_badg(); call_exit_badg();
+  } else {     /* same thread: the pid_tgid fallback's happy path */
+    call_enter(); call_exit();
+  }
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def driver(tmp_path_factory):
+    d = tmp_path_factory.mktemp("live_goid")
+    (d / "driver.c").write_text(_DRIVER_C)
+    exe = d / "driver"
+    subprocess.run([_cc, "-O1", "-pthread", str(d / "driver.c"),
+                    "-o", str(exe)], check=True)
+    return str(exe)
+
+
+def _probe_offsets(exe):
+    funcs = uprobe_trace.elf_func_table(exe)
+    offs = {}
+    for sym in ("go_probe_point", "go_ret_point"):
+        vaddr, _size = funcs[sym]
+        offs[sym] = uprobe_trace.vaddr_to_offset(exe, vaddr)
+    return offs
+
+
+def _run_pair(exe, mode, goid_off):
+    """Attach go_enter/go_exit_write at the stand-in's probe points,
+    run the driver in `mode`, return the drained records."""
+    suite = uprobe_trace.UprobeSuite()
+    probes = []
+    reader = None
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(suite.maps.events,
+                                               cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        offs = _probe_offsets(exe)
+        progs = suite.programs()
+        probes.append(perf_ring.attach_uprobe(
+            progs["go_enter"], exe, offs["go_probe_point"], False))
+        probes.append(perf_ring.attach_uprobe(
+            progs["go_exit_write"], exe, offs["go_ret_point"], False))
+        tset = shutil.which("taskset")
+        cmd = ([tset, "-c", "0"] if tset else []) + [exe, mode]
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
+        suite.maps.set_proc_info(p.pid, reg_abi=True,
+                                 goid_off=goid_off,
+                                 **{k: uprobe_trace.GO_DEFAULT_INFO[k]
+                                    for k in ("conn_off", "fd_off",
+                                              "sysfd_off")})
+        p.communicate(b"\n", timeout=30)
+        assert p.returncode == 0
+        return [parse_record(r) for r in reader.drain()]
+    finally:
+        for pr in probes:
+            pr.close()
+        if reader is not None:
+            reader.close()
+        suite.close()
+
+
+def test_cross_thread_exit_keeps_record_with_goid_keying(driver):
+    recs = _run_pair(driver, "cross", goid_off=152)
+    assert len(recs) == 1, recs
+    r = recs[0]
+    assert r.direction == T_EGRESS
+    assert r.payload.startswith(b"GET /goid")
+    assert r.fd == _SYSFD            # walked conn->netFD->Sysfd
+    assert r.from_kernel
+
+
+def test_cross_thread_exit_drops_without_goid_keying(driver):
+    """goid_off=0 (the stack-ABI contract): the migration loses the
+    record — and ONLY loses it (no wrong-payload confusion)."""
+    assert _run_pair(driver, "cross", goid_off=0) == []
+
+
+def test_same_thread_pair_works_without_goid_keying(driver):
+    recs = _run_pair(driver, "same", goid_off=0)
+    assert len(recs) == 1
+    assert recs[0].payload.startswith(b"GET /goid")
+
+
+def test_faulting_goid_read_drops_call_never_falls_back(driver):
+    """Keying enabled + unreadable g: the call is DROPPED. A pid_tgid
+    fallback here would let a later faulting exit on the same thread
+    consume a stale stash from a DIFFERENT call — wrong-payload
+    confusion (review r5); loss is the contract instead."""
+    assert _run_pair(driver, "faultg", goid_off=152) == []

@@ -68,17 +68,47 @@ def test_suite_shares_trace_map_with_socket_trace():
 
 @_bpf_required
 def test_proc_info_map_layout():
-    """The {reg_abi, conn_off, fd_off, sysfd_off} cell the Go programs
-    read at fixed offsets, written through the userspace setter."""
+    """The {reg_abi, conn_off, fd_off, sysfd_off, goid_off} cell the
+    Go programs read at fixed offsets, written through the userspace
+    setter; goid_off is forced 0 for stack-ABI rows (no g register for
+    the program to read — pushing a nonzero offset there would key the
+    stash by garbage probe_reads)."""
     maps = uprobe_trace.create_uprobe_maps()
     try:
         maps.set_proc_info(4242, reg_abi=True, conn_off=0, fd_off=0,
-                           sysfd_off=16)
+                           sysfd_off=16, goid_off=152)
         got = struct.unpack(
-            "<IIII", maps.proc_info.lookup_bytes(struct.pack("<I", 4242)))
-        assert got == (1, 0, 0, 16)
+            "<IIIIII",
+            maps.proc_info.lookup_bytes(struct.pack("<I", 4242)))
+        assert got == (1, 0, 0, 16, 152, 0)
+        maps.set_proc_info(4243, reg_abi=False, goid_off=152)
+        got = struct.unpack(
+            "<IIIIII",
+            maps.proc_info.lookup_bytes(struct.pack("<I", 4243)))
+        assert got[0] == 0 and got[4] == 0
     finally:
         maps.close()
+
+
+def test_goid_offset_version_table():
+    """go_tracer.c's data_members role: goid moved 152 -> 160 when
+    1.23 inserted syscallbp into runtime.g; stack-ABI versions get 0
+    (keying disabled)."""
+    assert uprobe_trace.go_goid_offset("go1.20.4") == 152
+    assert uprobe_trace.go_goid_offset("go1.22.0") == 152
+    assert uprobe_trace.go_goid_offset("go1.23.1") == 160
+    assert uprobe_trace.go_goid_offset("go1.24.0") == 160
+    assert uprobe_trace.go_goid_offset("go1.16.9") == 0
+    # prerelease suffixes must parse (go1.23rc1 on the 152 guess would
+    # read atomicstatus — every goroutine one key); unparseable
+    # versions must DISABLE keying, not guess a layout
+    assert uprobe_trace.go_goid_offset("go1.23rc1") == 160
+    assert uprobe_trace.go_goid_offset("go1.24beta2") == 160
+    assert uprobe_trace.go_goid_offset("go1.17rc2") == 152
+    assert uprobe_trace.go_goid_offset(None) == 0
+    assert uprobe_trace.go_goid_offset("devel +abc123") == 0
+    assert uprobe_trace.go_register_abi("go1.23rc1") is True
+    assert uprobe_trace.go_register_abi("go1.16rc1") is False
 
 
 def test_attach_probe_reports_capability():
