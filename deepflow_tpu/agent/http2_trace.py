@@ -94,11 +94,14 @@ _FIELD_STRIDE = 40
 MAX_FIELDS = 9           # the reference's unrolled bound (#pragma
                          # unroll for idx < 9, go_http2_bpf.c:476)
 
-# extra stack slots (below uprobe_trace's frame, which ends at -312)
-_FRAME = -328            # saved MetaHeadersFrame*
-_FIELDSV = -344          # fields slice {data ptr, len} (16B)
-_FIELD = -384            # one copied HeaderField (40B)
-_STREAMSV = -392         # stream id
+# extra stack slots (below uprobe_trace's frame, which ends at -336
+# since the goid slots _GOIDVAL/-328 and _GOIDOFF/-336 joined it —
+# keeping the modules' frames disjoint is what lets these programs
+# call shared uprobe_trace helpers safely)
+_FRAME = -344            # saved MetaHeadersFrame*
+_FIELDSV = -360          # fields slice {data ptr, len} (16B)
+_FIELD = -400            # one copied HeaderField (40B)
+_STREAMSV = -408         # stream id
 
 # event layout inside the SOCK_DATA payload (offsets from _REC+64):
 #   u32 stream | u8 flags | u8 name_len | u8 value_len | u8 pad

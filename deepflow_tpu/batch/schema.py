@@ -241,6 +241,22 @@ SKETCH_LANES_SCHEMA = Schema(
     columns=(("ip_src", _U32), ("ip_dst", _U32),
              ("ports", _U32), ("proto_pkts", _U32)))
 
+# Dictionary-lane wire (models/flow_dict.py): SmartEncoding applied to
+# the host->device boundary. A flow's 5-tuple crosses the link ONCE
+# (news: dictionary index + the four lane key words + first packet
+# count, 24B); every later record of that flow is 8B {index, packets}.
+# Flow-log traffic re-reports live flows every window, so steady-state
+# wire cost is the hits row — half the 16B packed-lane row, and bytes
+# per record IS the e2e ceiling on the tunneled link.
+SKETCH_HITS_SCHEMA = Schema(
+    name="l4_sketch_hits",
+    columns=(("idx", _U32), ("pkts", _U32)))
+
+SKETCH_NEWS_SCHEMA = Schema(
+    name="l4_sketch_news",
+    columns=(("idx", _U32), ("ip_src", _U32), ("ip_dst", _U32),
+             ("ports", _U32), ("proto", _U32), ("pkts", _U32)))
+
 # -- L7 flow log -----------------------------------------------------------
 # Reference: log_data/l7_flow_log.go L7Base + L7FlowLog :187-286. String
 # fields are *_hash u32 dictionary codes; nullable wire fields use 0 as

@@ -1,0 +1,303 @@
+"""Dictionary lane: SmartEncoding applied to the host->device wire.
+
+The reference's SmartEncoding insight (server/ingester flow_tag /
+`docs/deepflow_sigcomm2023.pdf` §5.2: strings become dictionary
+integers once, rows carry the small code) applied to THIS framework's
+actual bottleneck, the tunneled host->device link (SURVEY §7 "Hard
+parts"): flow-log traffic re-reports the same live flows every window
+(per-minute ticks of long-lived flows; Zipf-shaped record streams),
+so the 5-tuple most records carry is redundant on the wire.
+
+- A flow's first record crosses as a NEWS row: assigned dictionary
+  index + the four packed-lane key words + its packet count
+  (SKETCH_NEWS_SCHEMA, 24B).
+- Every later record of that flow crosses as a HITS row: index +
+  packet count (SKETCH_HITS_SCHEMA, 8B — half the 16B packed-lane
+  row, an eighth of the 68B full row).
+
+The device keeps the key table resident — (4, capacity) uint32, the
+TagDict role with the table living in HBM — scatters news rows into
+it, and gathers hit rows back into exactly the lane columns
+`flow_suite.unpack_lanes` consumes, so CMS / HLL / entropy / row
+counts are BIT-IDENTICAL to the packed-lane path (the top-K ring sees
+the same flows through a different batch partition, so its stride
+sample admits different candidates — same class of difference as
+`topk_sample_log2` itself; recall is pinned by test instead of state
+equality). Batches apply strictly in emission order, which is what
+makes index reuse after eviction safe (FlowDictPacker's docstrings
+carry the argument).
+
+Steady state ships pure hit batches: separate `update_news` /
+`update_hits` programs mean a quiet stream pays ZERO news bytes
+rather than a padded news plane per batch.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepflow_tpu.models import flow_suite
+from deepflow_tpu.models.flow_suite import (FlowSuiteConfig,
+                                            FlowSuiteState, unpack_lanes)
+
+PKTS_CAP = 0xFFFFFF          # lane proto_pkts packet-count field width
+
+
+class FlowDictState(NamedTuple):
+    """Device-resident flow-key dictionary: row i of `table` holds the
+    four packed-lane key words (ip_src, ip_dst, ports, proto<<24) of
+    the flow the host assigned index i."""
+
+    table: jnp.ndarray       # (4, capacity) uint32
+
+
+def init_dict(capacity: int = 1 << 20) -> FlowDictState:
+    return FlowDictState(table=jnp.zeros((4, capacity), jnp.uint32))
+
+
+def update_news(state: FlowSuiteState, dstate: FlowDictState,
+                plane: jnp.ndarray, n: jnp.ndarray,
+                cfg: FlowSuiteConfig
+                ) -> Tuple[FlowSuiteState, FlowDictState]:
+    """Apply one (6, C) news plane: scatter the C key rows into the
+    table AND count the records themselves (a news row IS that flow's
+    first record, packets included — it must not be counted again).
+    Rows >= n are padding: their scatter is routed out of bounds and
+    dropped, their count masked."""
+    cap = dstate.table.shape[1]
+    idx = plane[0].astype(jnp.int32)
+    mask = jnp.arange(plane.shape[1]) < n
+    safe = jnp.where(mask, idx, cap)             # OOB -> dropped
+    # plane row 4 is the raw proto byte; the table stores the lane
+    # word proto<<24 so hit gathers rebuild proto_pkts with one OR
+    proto_word = plane[4] << jnp.uint32(24)
+    key_rows = jnp.concatenate([plane[1:4], proto_word[None]], axis=0)
+    table = dstate.table.at[:, safe].set(key_rows, mode="drop")
+    lanes = {
+        "ip_src": plane[1],
+        "ip_dst": plane[2],
+        "ports": plane[3],
+        "proto_pkts": proto_word | plane[5],
+    }
+    state = flow_suite.update(state, unpack_lanes(lanes), mask, cfg)
+    return state, FlowDictState(table=table)
+
+
+def update_hits(state: FlowSuiteState, dstate: FlowDictState,
+                plane: jnp.ndarray, n: jnp.ndarray,
+                cfg: FlowSuiteConfig) -> FlowSuiteState:
+    """Apply one (2, B) hits plane: gather each row's key words from
+    the table and advance the sketches exactly as the packed-lane path
+    would for the same records."""
+    idx = plane[0].astype(jnp.int32)
+    pkts = plane[1]
+    mask = jnp.arange(plane.shape[1]) < n
+    rows = dstate.table[:, idx]                  # (4, B) gather
+    lanes = {
+        "ip_src": rows[0],
+        "ip_dst": rows[1],
+        "ports": rows[2],
+        "proto_pkts": rows[3] | pkts,
+    }
+    return flow_suite.update(state, unpack_lanes(lanes), mask, cfg)
+
+
+class FlowDictPacker:
+    """Host side: streaming records -> ordered news/hits wire batches.
+
+    Correctness rests on two ordering rules the consumer must follow
+    (and `apply_batches` encodes): batches apply in emission order,
+    and within one `pack()` call every news batch is emitted before
+    any hits batch — a hit may reference an index its own call's news
+    assigned.
+
+    Index reuse after eviction is made safe by the PRE-DRAIN in
+    pack(): eviction can only happen once the dictionary is full,
+    pack() flushes every buffered hit row before resolving keys
+    whenever this call could fill it, and the current call's hit rows
+    are appended only after every key has resolved — so at any
+    eviction, no emitted-or-buffered hit row references the freed
+    index, and the index's next tenant is scattered (its news batch)
+    before any hit row referencing the reused index can exist.
+    `_assign` enforces the invariant rather than trusting it.
+
+    The packer is windowless: it never needs flushing on window
+    boundaries because sketch windows close on the DEVICE (flush
+    reads+resets sketch state, the table persists across windows —
+    a flow's dictionary row outlives any one window, exactly like a
+    TagDict entry outliving one segment)."""
+
+    def __init__(self, capacity: int = 1 << 20,
+                 hits_batch: int = 1 << 17, news_batch: int = 1 << 13):
+        if capacity <= hits_batch:
+            # the eviction-safety argument (_assign) needs an LRU head
+            # that the current call has not touched; a dictionary
+            # smaller than one wire batch cannot guarantee it
+            raise ValueError("capacity must exceed hits_batch")
+        self.capacity = capacity
+        self.hits_batch = hits_batch
+        self.news_batch = news_batch
+        self._idx: "OrderedDict[bytes, int]" = OrderedDict()  # LRU
+        self._free = list(range(capacity - 1, -1, -1))        # pop() asc
+        self._hit_idx: List[np.ndarray] = []     # buffered hit rows
+        self._hit_pkts: List[np.ndarray] = []
+        self._hit_count = 0
+        self.evictions = 0
+        self.bytes_news = 0
+        self.bytes_hits = 0
+
+    # -- wire accounting ----------------------------------------------------
+
+    def _emit_news(self, out: List[Tuple[str, np.ndarray, int]],
+                   idx: np.ndarray, keys: np.ndarray,
+                   pkts: np.ndarray) -> None:
+        """Emit (6, C) planes, padded; partial batches flush eagerly —
+        news must never sit buffered past the call whose hits may
+        reference them."""
+        C = self.news_batch
+        for s in range(0, len(idx), C):
+            e = min(s + C, len(idx))
+            plane = np.zeros((6, C), np.uint32)
+            plane[0, :e - s] = idx[s:e]
+            plane[1:5, :e - s] = keys[s:e].T
+            plane[5, :e - s] = pkts[s:e]
+            out.append(("news", plane, e - s))
+            self.bytes_news += plane.nbytes
+        # note: keys arrive as the four lane words with row 4 the RAW
+        # proto byte (update_news shifts it into the table word)
+
+    def _flush_hits(self, out: List[Tuple[str, np.ndarray, int]],
+                    partial: bool = False) -> None:
+        B = self.hits_batch
+        if not self._hit_count:
+            return
+        idx = np.concatenate(self._hit_idx)
+        pkts = np.concatenate(self._hit_pkts)
+        end = len(idx) if partial else (len(idx) // B) * B
+        for s in range(0, end, B):
+            e = min(s + B, end)
+            plane = np.zeros((2, B), np.uint32)
+            plane[0, :e - s] = idx[s:e]
+            plane[1, :e - s] = pkts[s:e]
+            out.append(("hits", plane, e - s))
+            self.bytes_hits += plane.nbytes
+        rest_i, rest_p = idx[end:], pkts[end:]
+        self._hit_idx = [rest_i] if len(rest_i) else []
+        self._hit_pkts = [rest_p] if len(rest_p) else []
+        self._hit_count = len(rest_i)
+
+    # -- packing ------------------------------------------------------------
+
+    def _assign(self, key: bytes) -> int:
+        """Index for a NEW key, evicting LRU when full.
+
+        Eviction is only reached with the hit buffer empty (pack()'s
+        pre-drain — enforced here, since reusing an index a buffered
+        hit still references would gather the new tenant's key), and
+        pops the LRU head, which is always a key NOT touched by the
+        current call (touched keys re-order to the tail as they
+        resolve; the `len(uniq) < capacity` guard in pack() means an
+        untouched one exists)."""
+        if not self._free:
+            if self._hit_count:
+                raise RuntimeError(
+                    "flow dict eviction with hits buffered: pack() "
+                    "must pre-drain first (bug, not load)")
+            _, old_idx = self._idx.popitem(last=False)
+            self.evictions += 1
+            self._free.append(old_idx)
+        idx = self._free.pop()
+        self._idx[key] = idx
+        return idx
+
+    def pack(self, cols: Dict[str, np.ndarray]
+             ) -> List[Tuple[str, np.ndarray, int]]:
+        """One record batch -> ordered wire batches [(kind, plane, n)].
+        `cols` is the same column dict `flow_suite.pack_lanes` takes."""
+        out: List[Tuple[str, np.ndarray, int]] = []
+        u32 = np.uint32
+        n = len(cols["ip_src"])
+        if n == 0:
+            return out
+        pkts = np.minimum(cols["packet_tx"].astype(np.uint64)
+                          + cols["packet_rx"], PKTS_CAP).astype(u32)
+        keys = np.empty((n, 4), u32)
+        keys[:, 0] = cols["ip_src"]
+        keys[:, 1] = cols["ip_dst"]
+        keys[:, 2] = ((cols["port_src"].astype(u32) & u32(0xFFFF))
+                      << u32(16)) | (cols["port_dst"].astype(u32)
+                                     & u32(0xFFFF))
+        keys[:, 3] = cols["proto"].astype(u32) & u32(0xFF)   # raw byte
+        kbytes = np.ascontiguousarray(keys).view("V16").ravel()  # (n,)
+        uniq, first, inverse = np.unique(
+            kbytes, return_index=True, return_inverse=True)
+        if len(uniq) >= self.capacity:
+            # with fewer uniques than capacity, a full dict always
+            # holds >= 1 key untouched by this call, so the LRU head
+            # _assign evicts can never be a key whose index this
+            # call's already-computed hit rows reference
+            raise ValueError(
+                f"{len(uniq)} unique flows in one pack() call >= "
+                f"dictionary capacity {self.capacity}")
+        # resolve each UNIQUE key once (python cost scales with new
+        # flows, not records); LRU order refreshed per appearance
+        uidx = np.empty(len(uniq), u32)
+        is_new = np.zeros(len(uniq), bool)
+        if len(self._idx) + len(uniq) > self.capacity and self._hit_count:
+            # eviction is possible this call: drain buffered hits
+            # FIRST so an old reference can never gather a reused
+            # index's new tenant (conservative — len(uniq) bounds the
+            # truly-new count from above)
+            self._flush_hits(out, partial=True)
+        for i, kb in enumerate(uniq):
+            k = bytes(kb)
+            got = self._idx.get(k)
+            if got is None:
+                is_new[i] = True
+                uidx[i] = self._assign(k)
+            else:
+                self._idx.move_to_end(k)
+                uidx[i] = got
+        rec_idx = uidx[inverse]
+        # news rows = the FIRST occurrence of each new unique key; all
+        # other records are hits (including later same-batch records
+        # of a new key — their news is emitted first, below)
+        news_rows = first[is_new]
+        self._emit_news(out, rec_idx[news_rows], keys[news_rows],
+                        pkts[news_rows])
+        hit_mask = np.ones(n, bool)
+        hit_mask[news_rows] = False
+        self._hit_idx.append(rec_idx[hit_mask])
+        self._hit_pkts.append(pkts[hit_mask])
+        self._hit_count += int(hit_mask.sum())
+        self._flush_hits(out)                    # full batches only
+        return out
+
+    def flush(self) -> List[Tuple[str, np.ndarray, int]]:
+        """Drain the partial hit buffer (end of stream / forced tick)."""
+        out: List[Tuple[str, np.ndarray, int]] = []
+        self._flush_hits(out, partial=True)
+        return out
+
+
+def apply_batches(state: FlowSuiteState, dstate: FlowDictState,
+                  batches, cfg: FlowSuiteConfig, *,
+                  news_fn=None, hits_fn=None
+                  ) -> Tuple[FlowSuiteState, FlowDictState]:
+    """Reference consumer: apply packer output in emission order.
+    `news_fn`/`hits_fn` default to the unjitted updates; the bench and
+    runtime pass jitted (donated) versions."""
+    news_fn = news_fn or (lambda s, d, p, n: update_news(s, d, p, n, cfg))
+    hits_fn = hits_fn or (lambda s, d, p, n: update_hits(s, d, p, n, cfg))
+    for kind, plane, n in batches:
+        nn = np.uint32(n)
+        if kind == "news":
+            state, dstate = news_fn(state, dstate, jnp.asarray(plane), nn)
+        else:
+            state = hits_fn(state, dstate, jnp.asarray(plane), nn)
+    return state, dstate

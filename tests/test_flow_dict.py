@@ -1,0 +1,183 @@
+"""Dictionary-lane wire (models/flow_dict.py): the host->device
+SmartEncoding path must produce bit-identical additive sketch state to
+the packed-lane path on the same records, at roughly half the steady-
+state wire bytes, with index reuse provably confusion-free."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.models import flow_dict, flow_suite
+from deepflow_tpu.models.flow_dict import FlowDictPacker
+from deepflow_tpu.models.flow_suite import FlowSuiteConfig
+
+CFG = FlowSuiteConfig(cms_log2_width=10, ring_size=256, top_k=20,
+                      hll_groups=64, entropy_log2_buckets=8)
+
+
+def _pool(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return {
+        "ip_src": rng.integers(0, 1 << 32, n, dtype=np.uint32),
+        "ip_dst": rng.integers(0, 1 << 32, n, dtype=np.uint32),
+        "port_src": rng.integers(1024, 65536, n, dtype=np.uint32),
+        "port_dst": rng.integers(1, 1024, n, dtype=np.uint32),
+        "proto": rng.choice(np.array([6, 17], np.uint32), n),
+        "packet_tx": rng.integers(1, 1000, n, dtype=np.uint32),
+        "packet_rx": rng.integers(0, 1000, n, dtype=np.uint32),
+    }
+
+
+def _zipf_stream(pool, n_batches, batch, seed=11):
+    rng = np.random.default_rng(seed)
+    n = len(pool["ip_src"])
+    for _ in range(n_batches):
+        picks = (rng.zipf(1.3, batch) - 1).clip(max=n - 1)
+        yield {k: v[picks] for k, v in pool.items()}
+
+
+def _run_packed(batches):
+    state = flow_suite.init(CFG)
+    for cols in batches:
+        lanes = {k: jnp.asarray(v)
+                 for k, v in flow_suite.pack_lanes(cols).items()}
+        mask = jnp.ones(len(cols["ip_src"]), bool)
+        state = flow_suite.update_packed(state, lanes, mask, CFG)
+    return state
+
+
+def _run_dict(batches, packer):
+    state = flow_suite.init(CFG)
+    dstate = flow_dict.init_dict(packer.capacity)
+    wire = []
+    for cols in batches:
+        wire.extend(packer.pack(cols))
+    wire.extend(packer.flush())
+    state, dstate = flow_dict.apply_batches(state, dstate, wire, CFG)
+    return state, dstate, wire
+
+
+def _assert_additive_state_equal(a, b):
+    """Everything except the ring: top-K admission stride-samples per
+    batch, so a different batch partition of the same records admits
+    different candidates (same class of difference as topk_sample_log2
+    itself); the additive sketches must match EXACTLY."""
+    np.testing.assert_array_equal(np.asarray(a.sketch.counts),
+                                  np.asarray(b.sketch.counts))
+    np.testing.assert_array_equal(np.asarray(a.services.registers),
+                                  np.asarray(b.services.registers))
+    np.testing.assert_array_equal(np.asarray(a.ent.hist),
+                                  np.asarray(b.ent.hist))
+    assert int(a.rows_seen) == int(b.rows_seen)
+
+
+def test_dict_path_matches_packed_path_state():
+    pool = _pool(512)
+    batches = list(_zipf_stream(pool, 6, 2048))
+    packed = _run_packed(batches)
+    dicted, _, wire = _run_dict(
+        batches, FlowDictPacker(capacity=4096, hits_batch=2048,
+                                news_batch=256))
+    _assert_additive_state_equal(packed, dicted)
+    kinds = [k for k, _, _ in wire]
+    assert "news" in kinds and "hits" in kinds
+
+
+def test_steady_state_ships_half_the_bytes():
+    """After warmup the stream is hits-only: 8B/record vs the packed
+    lane's 16B. Bytes are counted on PADDED planes (what actually
+    crosses the link), so the ratio must still land under 0.6 here."""
+    pool = _pool(256)
+    batches = list(_zipf_stream(pool, 20, 4096))
+    packer = FlowDictPacker(capacity=8192, hits_batch=4096,
+                            news_batch=256)
+    _, _, wire = _run_dict(batches, packer)
+    records = 20 * 4096
+    lane_bytes = records * 16
+    dict_bytes = packer.bytes_news + packer.bytes_hits
+    assert dict_bytes < 0.6 * lane_bytes, (
+        packer.bytes_news, packer.bytes_hits, lane_bytes)
+    # the tail of the stream must be pure hits (dictionary warm)
+    assert all(k == "hits" for k, _, _ in wire[-5:])
+
+
+def test_news_only_once_per_flow():
+    pool = _pool(64)
+    batches = [dict(pool) for _ in range(3)]   # same 64 flows, 3 times
+    packer = FlowDictPacker(capacity=1024, hits_batch=64, news_batch=64)
+    news_rows = 0
+    for cols in batches:
+        for kind, _, n in packer.pack(cols):
+            if kind == "news":
+                news_rows += n
+    assert news_rows == 64
+
+
+def test_eviction_reuse_never_confuses_counts():
+    """Roll through 3x the dictionary capacity in distinct flows so
+    eviction and index reuse churn constantly; CMS counts must still
+    equal the packed path's exactly (a mispaired gather would shift
+    counts between flow keys)."""
+    pool = _pool(1536, seed=23)
+    # visit flows in overlapping windows so evicted flows return
+    rng = np.random.default_rng(29)
+    batches = []
+    for start in (0, 256, 512, 768, 1024, 0, 512, 1200):
+        picks = rng.integers(start, min(start + 400, 1536), 512)
+        batches.append({k: v[picks] for k, v in pool.items()})
+    packer = FlowDictPacker(capacity=500, hits_batch=256, news_batch=128)
+    packed = _run_packed(batches)
+    dicted, _, _ = _run_dict(batches, packer)
+    assert packer.evictions > 0
+    _assert_additive_state_equal(packed, dicted)
+
+
+def test_recall_through_dict_path():
+    """End-to-end heavy-hitter recall over the dictionary wire: the
+    flows the exact GROUP BY ranks top-K must surface through
+    news/hits -> table gather -> sketches -> ring."""
+    pool = _pool(512, seed=31)
+    batches = list(_zipf_stream(pool, 8, 4096, seed=37))
+    packer = FlowDictPacker(capacity=8192, hits_batch=4096,
+                            news_batch=512)
+    state, _, _ = _run_dict(batches, packer)
+    _, out = flow_suite.flush(state, CFG)
+    got = set(np.asarray(out.topk_keys)[np.asarray(out.topk_counts) > 0]
+              .tolist())
+    # exact side
+    keyfn = jax.jit(flow_suite.flow_key)
+    pool_keys = np.asarray(keyfn(
+        {k: jnp.asarray(v) for k, v in pool.items()}))
+    counts = np.zeros(512, np.int64)
+    rng = np.random.default_rng(37)
+    for _ in range(8):
+        picks = (rng.zipf(1.3, 4096) - 1).clip(max=511)
+        counts += np.bincount(picks, minlength=512)
+    top = np.argsort(-counts)[:CFG.top_k]
+    exact = [pool_keys[i] for i in top]
+    hit = sum(1 for k in exact if int(k) in got)
+    assert hit / len(exact) >= 0.9, f"recall {hit}/{len(exact)}"
+
+
+def test_capacity_guards():
+    with pytest.raises(ValueError):
+        FlowDictPacker(capacity=64, hits_batch=64)
+    packer = FlowDictPacker(capacity=100, hits_batch=64, news_batch=32)
+    pool = _pool(200)
+    with pytest.raises(ValueError, match="unique flows"):
+        packer.pack(pool)
+
+
+def test_padding_rows_do_not_count():
+    """A partial hits batch (padding beyond n) must contribute nothing:
+    padded rows gather table row 0 — without the mask they would
+    credit a real flow."""
+    pool = _pool(8)
+    packer = FlowDictPacker(capacity=256, hits_batch=128, news_batch=16)
+    wire = packer.pack(pool) + packer.flush()
+    state = flow_suite.init(CFG)
+    dstate = flow_dict.init_dict(packer.capacity)
+    state, _ = flow_dict.apply_batches(state, dstate, wire, CFG)
+    assert int(state.rows_seen) == 8

@@ -175,14 +175,20 @@ def test_agent_profile_loop_ships_to_ingester(tmp_path):
                           profile_freq_hz=199)
         agent = Agent(cfg)
         agent.start()
-        deadline = time.time() + 15
+        # generous deadline: one sample cycle is ~0.5s, but this box
+        # has ONE core and background load (the TPU bench retry loop's
+        # probes) can starve the agent's sampler thread for long
+        # stretches — 15s flaked twice under a concurrent probe
+        deadline = time.time() + 45
         while time.time() < deadline and ing.profile.profiles == 0:
             # keep the target's CPU busy so the sampler sees stacks
             sum(i * i for i in range(20000))
             time.sleep(0.01)
         if agent.profile_errors and ing.profile.profiles == 0:
             pytest.skip("perf refused inside agent loop")
-        assert ing.profile.profiles >= 1
+        assert ing.profile.profiles >= 1, (
+            f"no profiles in 45s: sent={agent.profiles_sent} "
+            f"errors={agent.profile_errors}")
         assert agent.profiles_sent >= 1
         ing.flush()
         rows = ing.store.table("profile", "in_process_profile").scan()
