@@ -458,25 +458,36 @@ def main() -> None:
         except OSError:
             pass
 
-    def lane_window() -> dict:
-        idx = len(lane_windows)
-        _phase(f"probe h2d (lane window {idx})")
+    def _measure_window(name, windows, runner, bytes_per_record) -> dict:
+        """ONE window harness for every wire lane (the timed_run rule —
+        'a harness fix can never miss a copy' — applies to the window
+        bookkeeping too): probe the link, time the lane's loop, stamp
+        the self-consistency verdict from the lane's OWN bytes/record."""
+        idx = len(windows)
+        _phase(f"probe h2d ({name} window {idx})")
         burst = h2d_mb_s()
         sustained = h2d_sustained_mb_s()
-        _phase(f"timed: packed-lane e2e (window {idx})")
-        rate = timed_loop(lane_step, lane_payloads)
-        implied = rate * 16 / 1e6
+        _phase(f"timed: {name} e2e (window {idx})")
+        rate = runner()
+        implied = rate * bytes_per_record / 1e6
         w = {"window": idx,
              "at": time.strftime("%H:%M:%S"),
              "records_per_sec": round(rate),
              "h2d_burst_mb_s": round(burst),
              "h2d_sustained_mb_s": round(sustained),
              "implied_h2d_mb_s": round(implied),
+             "bytes_per_record": round(bytes_per_record, 2),
              "self_consistent": bool(implied <= sustained * 1.3)}
-        lane_windows.append(w)
-        print(f"[bench] window {idx}: {w}", file=sys.stderr, flush=True)
+        windows.append(w)
+        print(f"[bench] {name} window {idx}: {w}", file=sys.stderr,
+              flush=True)
         _write_partial()
         return w
+
+    def lane_window() -> dict:
+        return _measure_window(
+            "packed-lane", lane_windows,
+            lambda: timed_loop(lane_step, lane_payloads), 16)
 
     # -- timed: e2e dictionary-lane wire -> sketch -------------------------
     # same records, SmartEncoded wire: ~8.4B/record measured (news
@@ -493,43 +504,29 @@ def main() -> None:
 
     dict_windows: list = []
 
+    def _dict_run(state, n_iters):
+        for _ in range(n_iters):
+            for kind, payload, n in dict_payloads:
+                nn = np.uint32(n)
+                if kind == "news":
+                    plane, _ = columnar_wire.decode_columnar_plane(
+                        payload, SKETCH_NEWS_SCHEMA)
+                    state, _dict_run.dstate = step_news(
+                        state, _dict_run.dstate, jnp.asarray(plane), nn)
+                else:
+                    plane, _ = columnar_wire.decode_columnar_plane(
+                        payload, SKETCH_HITS_SCHEMA)
+                    state = step_hits(
+                        state, _dict_run.dstate, jnp.asarray(plane), nn)
+        return state
+
     def dict_window() -> dict:
-        idx = len(dict_windows)
-        _phase(f"probe h2d (dict window {idx})")
-        sustained = h2d_sustained_mb_s()
-        _phase(f"timed: dict-lane e2e (window {idx})")
-        dcell = [flow_dict.init_dict(dict_packer.capacity)]
-
-        def run(state, n_iters):
-            for it in range(n_iters):
-                for kind, payload, n in dict_payloads:
-                    nn = np.uint32(n)
-                    if kind == "news":
-                        plane, _ = columnar_wire.decode_columnar_plane(
-                            payload, SKETCH_NEWS_SCHEMA)
-                        state, dcell[0] = step_news(
-                            state, dcell[0], jnp.asarray(plane), nn)
-                    else:
-                        plane, _ = columnar_wire.decode_columnar_plane(
-                            payload, SKETCH_HITS_SCHEMA)
-                        state = step_hits(
-                            state, dcell[0], jnp.asarray(plane), nn)
-            return state
-
-        rate = timed_run(run, records_per_iter=dict_records_per_iter)
-        implied = rate * dict_b_per_rec / 1e6
-        w = {"window": idx,
-             "at": time.strftime("%H:%M:%S"),
-             "records_per_sec": round(rate),
-             "h2d_sustained_mb_s": round(sustained),
-             "implied_h2d_mb_s": round(implied),
-             "bytes_per_record": round(dict_b_per_rec, 2),
-             "self_consistent": bool(implied <= sustained * 1.3)}
-        dict_windows.append(w)
-        print(f"[bench] dict window {idx}: {w}", file=sys.stderr,
-              flush=True)
-        _write_partial()
-        return w
+        _dict_run.dstate = flow_dict.init_dict(dict_packer.capacity)
+        return _measure_window(
+            "dict-lane", dict_windows,
+            lambda: timed_run(_dict_run,
+                              records_per_iter=dict_records_per_iter),
+            dict_b_per_rec)
 
     lane_window()                             # window 0: freshest link
     dict_window()                             # dict 0: fresh link too

@@ -53,8 +53,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW,
                                     BPF_JEQ, BPF_JGE, BPF_JGT, BPF_JNE,
-                                    BPF_JSLE,
-                                    BPF_MAP_TYPE_LRU_HASH,
+                                    BPF_JSLE, BPF_LSH,
+                                    BPF_MAP_TYPE_HASH,
+                                    BPF_MAP_TYPE_LRU_HASH, BPF_OR,
+                                    BPF_RSH,
                                     BPF_MAP_TYPE_PERF_EVENT_ARRAY,
                                     BPF_PROG_TYPE_KPROBE, BPF_W,
                                     FN_get_current_comm,
@@ -91,7 +93,9 @@ _PT_DI, _PT_SI, _PT_AX = 112, 104, 80
 # struct user_msghdr / iovec hops
 _MSG_IOV_OFF, _IOV_BASE_OFF, _IOV_LEN_OFF = 16, 0, 8
 
-# stack frame (offsets from R10)
+# stack frame (offsets from R10). The uprobe/http2 modules allocate
+# their extra slots BELOW this frame's end (-280): extending it means
+# renumbering theirs too (uprobe_trace.py's _GOSTASH starts at -288).
 _REC = -192          # SOCK_DATA record
 _KEY = -200          # pid_tgid hash key
 _CONFKEY = -208      # u32 conf array index
@@ -101,18 +105,38 @@ _SCRATCH = -232      # pointer-hop scratch
 _IOVPAIR = -264      # first iovec {iov_base, iov_len} read as ONE 16B
                      # probe_read (-264..-249; -248.. is _TRVAL's 16B)
 _TRVAL = -248        # trace-map value {id, fd} (16B)
+_PIKEY = -272        # u32 tgid key for proc_info lookups
+_GOIDVAL = -280      # goid scratch (8B)
+
+# proc_info value layout shared with the uprobe suite (ONE map, pushed
+# once per managed Go tgid): {reg_abi, conn_off, fd_off, sysfd_off,
+# goid_off, pad} — the syscall programs read only goid_off (+16)
+_PI_GOID_OFF = 16
 
 
 @dataclass
 class SocketTraceMaps:
-    active: Map          # pid_tgid -> {buf, fd, is_msg}  (entry stash)
-    trace: Map           # pid_tgid -> {parked trace id, fd}
+    active: Map          # pid_tgid -> {buf, fd, is_msg, gokey} (stash)
+    trace: Map           # pid_tgid | goid key -> {parked trace id, fd}
     conf: Map            # [0]=next trace id, [1]=capture seq
     events: Map          # perf record stream
+    proc_info: Map       # tgid -> {reg_abi, walk offs, goid_off} (24B)
 
     def close(self) -> None:
-        for m in (self.active, self.trace, self.conf, self.events):
+        for m in (self.active, self.trace, self.conf, self.events,
+                  self.proc_info):
             m.close()
+
+    def set_proc_info(self, tgid: int, reg_abi: bool, conn_off: int = 0,
+                      fd_off: int = 0, sysfd_off: int = 16,
+                      goid_off: int = 0) -> None:
+        """One row enables goroutine-id trace keying for a tgid in BOTH
+        suites (the uprobe maps alias this map when shared). goid_off
+        is forced 0 for stack-ABI rows — no g register to read."""
+        self.proc_info.update_bytes(
+            struct.pack("<I", tgid),
+            struct.pack("<IIIIII", 1 if reg_abi else 0, conn_off, fd_off,
+                        sysfd_off, goid_off if reg_abi else 0, 0))
 
 
 def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
@@ -125,11 +149,14 @@ def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
         # monotonic and never naturally overwritten) must age out
         # instead of filling the map and silently stopping ALL
         # stash/park updates process-wide (socket_trace.c's maps are
-        # LRU for the same reason)
-        for args in ((8192, 24, BPF_MAP_TYPE_LRU_HASH, 8),
+        # LRU for the same reason). proc_info stays a plain HASH:
+        # eviction there would silently disable goid keying for a
+        # managed process, and its population is bounded by tgids.
+        for args in ((8192, 32, BPF_MAP_TYPE_LRU_HASH, 8),
                      (8192, 16, BPF_MAP_TYPE_LRU_HASH, 8),
                      (2, 8),
-                     (ncpus, 4, BPF_MAP_TYPE_PERF_EVENT_ARRAY)):
+                     (ncpus, 4, BPF_MAP_TYPE_PERF_EVENT_ARRAY),
+                     (1024, 24, BPF_MAP_TYPE_HASH, 4)):
             made.append(Map(*args))
     except OSError:
         for m in made:           # no orphan fds on partial creation
@@ -141,20 +168,55 @@ def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
     return maps
 
 
+def emit_gokey_pack(a: Asm) -> None:
+    """bit63 | tgid<<32 | (goid & 0xffffffff) -> R1. Expects R1=goid,
+    R7=pid_tgid; clobbers R2. ONE emitter for both suites — the
+    syscall and uprobe programs chain trace ids across sources only
+    while their keys are bit-identical, so the packing must be
+    structural, not maintained-by-parallel-edit (review r5). Bit 63
+    partitions goid keys from pid_tgid keys (whose high word is a
+    tgid < 2^22)."""
+    a.alu_imm(BPF_LSH, R1, 32).alu_imm(BPF_RSH, R1, 32)  # goid lo32
+    a.mov_reg(R2, R7).alu_imm(BPF_RSH, R2, 32).alu_imm(BPF_LSH, R2, 32)
+    a.alu_reg(BPF_OR, R1, R2)                      # | tgid<<32
+    a.mov_imm(R2, 1).alu_imm(BPF_LSH, R2, 63)
+    a.alu_reg(BPF_OR, R1, R2)                      # | bit63 partition
+
+
 def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
-    """Syscall-entry stash: {buf_or_msghdr, fd, is_msg} keyed by
+    """Syscall-entry stash: {buf_or_msghdr, fd, is_msg, gokey} keyed by
     pid_tgid, consumed by the exit program (socket_trace.c's
-    active_*_args_map role)."""
+    active_*_args_map role).
+
+    gokey: for a proc_info-managed register-ABI Go tgid, the
+    bit63|tgid<<32|goid trace key, read HERE — at syscall entry the
+    inner pt_regs carry the user registers, so g is reachable
+    (inner->r14); at the kretprobe they don't. A goroutine cannot
+    migrate OS threads while blocked IN a syscall, so the pid_tgid
+    stash key stays correct — only the trace park/consume needs the
+    goid key, and the exit reads it from the stash. This is what lets
+    a TLS-uprobe park chain into a plaintext syscall consume (and
+    vice versa) for Go processes: both sources build the IDENTICAL
+    key (uprobe_trace._goid_rekey). Same fault discipline as the
+    uprobe side: keying enabled but goid unreadable -> drop the call
+    (no stash), never a mismatched-key record. A non-goroutine thread
+    in a managed process (cgo, runtime sysmon) carries garbage in
+    r14: its reads either fault (dropped — such threads are not app
+    traffic) or yield a key whose top half still carries the REAL
+    tgid with bit 63, so it cannot collide into another process or
+    the pid_tgid key space."""
     a = Asm()
     a.mov_reg(R6, R1)
     a.call(FN_get_current_pid_tgid)
-    a.stx_mem(BPF_DW, R10, R0, _KEY)
+    a.mov_reg(R7, R0)
+    a.stx_mem(BPF_DW, R10, R7, _KEY)
     # inner pt_regs* = outer->di
     a.ldx_mem(BPF_DW, R8, R6, _PT_DI)
-    # stash value {buf@-48, fd@-40, is_msg@-32}: arg fields live in the
-    # inner pt_regs (kernel memory) -> probe_read, which zero-fills the
-    # destination on fault, so a failed read degrades to payload_len 0
-    # downstream instead of leaking uninitialized stack
+    # stash value {buf@-48, fd@-40, is_msg@-32, gokey@-24}: arg fields
+    # live in the inner pt_regs (kernel memory) -> probe_read, which
+    # zero-fills the destination on fault, so a failed read degrades
+    # to payload_len 0 downstream instead of leaking uninitialized
+    # stack
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -48)
     a.mov_imm(R2, 8)
     a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, _PT_SI)
@@ -164,11 +226,41 @@ def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
     a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, _PT_DI)
     a.call(FN_probe_read)
     a.st_imm(BPF_DW, R10, -32, 1 if is_msg else 0)
+    a.st_imm(BPF_DW, R10, -24, 0)                  # gokey default: none
+    # -- goid trace key for managed Go tgids ------------------------------
+    a.mov_reg(R1, R7).alu_imm(BPF_RSH, R1, 32)
+    a.stx_mem(BPF_W, R10, R1, _PIKEY)
+    a.ld_map_fd(R1, maps.proc_info)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _PIKEY)
+    a.call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "stash")             # unmanaged: pid_tgid
+    a.ldx_mem(BPF_W, R9, R0, _PI_GOID_OFF)
+    a.jmp_imm(BPF_JEQ, R9, 0, "stash")             # keying disabled
+    a.st_imm(BPF_DW, R10, _GOIDVAL, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOIDVAL)
+    a.mov_imm(R2, 8)
+    a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, 8)      # inner->r14 = g
+    a.call(FN_probe_read)
+    a.jmp_imm(BPF_JNE, R0, 0, "drop")              # unreadable: drop
+    a.ldx_mem(BPF_DW, R3, R10, _GOIDVAL)
+    a.jmp_imm(BPF_JEQ, R3, 0, "drop")
+    a.alu_reg(BPF_ADD, R3, R9)                     # &g.goid
+    a.st_imm(BPF_DW, R10, _GOIDVAL, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOIDVAL)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.jmp_imm(BPF_JNE, R0, 0, "drop")
+    a.ldx_mem(BPF_DW, R1, R10, _GOIDVAL)
+    a.jmp_imm(BPF_JEQ, R1, 0, "drop")
+    emit_gokey_pack(a)
+    a.stx_mem(BPF_DW, R10, R1, -24)                # gokey into stash
+    a.label("stash")
     a.ld_map_fd(R1, maps.active)
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
     a.mov_reg(R3, R10).alu_imm(BPF_ADD, R3, -48)
     a.mov_imm(R4, 0)                               # BPF_ANY
     a.call(FN_map_update_elem)
+    a.label("drop")
     a.exit_imm(0)
     return a
 
@@ -192,9 +284,19 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.stx_mem(BPF_DW, R10, R1, _FDSAVE)            # fd
     a.ldx_mem(BPF_DW, R1, R0, 16)
     a.stx_mem(BPF_DW, R10, R1, _FLAG)              # is_msg
+    a.ldx_mem(BPF_DW, R1, R0, 24)                  # gokey (0 = none)
+    a.stx_mem(BPF_DW, R10, R1, _GOIDVAL)
     a.ld_map_fd(R1, maps.active)                   # consume the stash
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
     a.call(FN_map_delete_elem)
+    # a goid-keyed call (Go process, enter read the key from g) parks/
+    # consumes its trace id under the gokey — the SAME key the TLS
+    # uprobe programs build, which is what chains a decrypted read to
+    # this goroutine's plaintext egress across sources and threads
+    a.ldx_mem(BPF_DW, R1, R10, _GOIDVAL)
+    a.jmp_imm(BPF_JEQ, R1, 0, "pidkey")
+    a.stx_mem(BPF_DW, R10, R1, _KEY)
+    a.label("pidkey")
     # ret bytes (kretprobe: pt_regs->ax); <= 0 = error/EOF, no record
     a.ldx_mem(BPF_DW, R8, R6, _PT_AX)
     a.jmp_imm(BPF_JSLE, R8, 0, "done")

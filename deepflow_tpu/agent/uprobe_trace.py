@@ -69,18 +69,17 @@ entries abandoned between enter and exit (goroutine exits with a
 parked id; panic unwinds past the RET) age out instead of filling a
 plain hash map and stopping all parking process-wide.
 
-Known tradeoff (documented, matches neither mode of the reference
-exactly): bit63-partitioned goid keys mean a goid-keyed TLS record
-cannot consume a trace id parked by a plaintext SYSCALL record of the
-same goroutine (and vice versa) — cross-source chaining inside one Go
-process requires goid-keying the syscall suite too, which the
-reference does via its unified get_current_goroutine key (and which
-loses the partition's never-cross-source-confused property). Non-Go
-and stack-ABI processes chain across sources exactly as before; for
-TLS'd connections the syscall records carry ciphertext and produce no
-L7 sessions anyway, so the loss is the TLS-to-plaintext-egress chain,
-which the userspace tempo assembly can still recover via trace
-headers when the app propagates them.
+Cross-source chaining (the reference's unified get_current_goroutine
+key, uprobe_base_bpf.c:1): the SYSCALL suite builds the IDENTICAL
+goid key for proc_info-managed Go tgids — read at syscall entry where
+the inner pt_regs expose the user's R14, carried to the kretprobe in
+the entry stash (socket_trace.build_enter; a goroutine cannot migrate
+OS threads while blocked in a syscall, so the stash's pid_tgid key
+stays valid and only the trace park/consume needs the goid). A
+decrypted TLS read therefore chains into the same goroutine's
+plaintext syscall egress across sources AND threads
+(tests/test_attach_live_cross_source.py proves it live in-kernel).
+One proc_info row — the maps alias each other — enables both.
 """
 
 from __future__ import annotations
@@ -93,7 +92,7 @@ from typing import Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_ARSH, BPF_DW,
                                     BPF_JEQ, BPF_JGT, BPF_JNE, BPF_JSGT,
-                                    BPF_JSLE, BPF_LSH, BPF_MAP_TYPE_HASH,
+                                    BPF_JSLE, BPF_LSH,
                                     BPF_MAP_TYPE_LRU_HASH, BPF_OR,
                                     BPF_PROG_TYPE_KPROBE,
                                     BPF_RSH, BPF_W,
@@ -109,6 +108,7 @@ from deepflow_tpu.agent.socket_trace import (PAYLOAD_CAP,
                                              SOURCE_OPENSSL_UPROBE,
                                              SocketTraceMaps, T_EGRESS,
                                              T_INGRESS, create_maps,
+                                             emit_gokey_pack,
                                              emit_record_tail)
 from deepflow_tpu.agent.socket_trace import (_FDSAVE, _IOVPAIR,  # noqa
                                              _KEY, _PT_AX, _PT_DI,
@@ -161,7 +161,6 @@ class UprobeMaps:
 
     ssl_ctx: Map         # pid_tgid -> {buf, fd}            (16B)
     go_conn: Map         # goid key -> {buf, fd, entry sp}  (24B)
-    proc_info: Map       # tgid -> {reg_abi, conn/fd/sysfd/goid offs} (24B)
     shared: SocketTraceMaps
     owns_shared: bool = False
 
@@ -177,19 +176,23 @@ class UprobeMaps:
     def events(self) -> Map:
         return self.shared.events
 
+    @property
+    def proc_info(self) -> Map:
+        """ALIASES the socket-trace suite's map: one proc_info row
+        enables goid keying for a tgid in both the syscall programs
+        (trace key via the entry stash) and the TLS uprobe programs —
+        which is what makes the two sources build the same key and
+        chain."""
+        return self.shared.proc_info
+
     def set_proc_info(self, tgid: int, reg_abi: bool, conn_off: int = 0,
                       fd_off: int = 0, sysfd_off: int = 16,
                       goid_off: int = 0) -> None:
-        """goid_off nonzero enables goroutine-id keying for this tgid;
-        the userspace contract is goid_off=0 whenever reg_abi is false
-        (stack-ABI Go has no g register for the program to read)."""
-        self.proc_info.update_bytes(
-            struct.pack("<I", tgid),
-            struct.pack("<IIIIII", 1 if reg_abi else 0, conn_off, fd_off,
-                        sysfd_off, goid_off if reg_abi else 0, 0))
+        self.shared.set_proc_info(tgid, reg_abi, conn_off, fd_off,
+                                  sysfd_off, goid_off)
 
     def close(self) -> None:
-        for m in (self.ssl_ctx, self.go_conn, self.proc_info):
+        for m in (self.ssl_ctx, self.go_conn):
             m.close()
         if self.owns_shared:
             self.shared.close()
@@ -206,12 +209,11 @@ def create_uprobe_maps(
         # panic unwinding past the RET uprobe; an undecodable-exit
         # function whose enters still run; goid keys that are never
         # naturally overwritten) must age out, not brick the map.
-        # proc_info stays a plain HASH — LRU eviction there would
-        # silently disable keying for a managed process, and its
-        # population is bounded by managed tgids, not call traffic.
+        # proc_info lives in the SHARED maps (plain HASH there — LRU
+        # eviction would silently disable keying for a managed
+        # process).
         for args in ((8192, 16, BPF_MAP_TYPE_LRU_HASH, 8),
-                     (8192, 24, BPF_MAP_TYPE_LRU_HASH, 8),
-                     (1024, 24, BPF_MAP_TYPE_HASH, 4)):
+                     (8192, 24, BPF_MAP_TYPE_LRU_HASH, 8)):
             made.append(Map(*args))
     except OSError:
         for m in made:
@@ -278,13 +280,9 @@ def _goid_rekey(a: Asm) -> None:
     a.jmp_imm(BPF_JNE, R0, 0, "done")              # faulted: drop call
     a.ldx_mem(BPF_DW, R1, R10, _GOIDVAL)
     a.jmp_imm(BPF_JEQ, R1, 0, "done")              # goid 0: drop call
-    a.alu_imm(BPF_LSH, R1, 32).alu_imm(BPF_RSH, R1, 32)  # goid lo32
-    a.mov_reg(R2, R7).alu_imm(BPF_RSH, R2, 32).alu_imm(BPF_LSH, R2, 32)
-    a.alu_reg(BPF_OR, R1, R2)                      # | tgid<<32
-    a.mov_imm(R2, 1).alu_imm(BPF_LSH, R2, 63)
-    a.alu_reg(BPF_OR, R1, R2)                      # | bit63 partition
-    a.stx_mem(BPF_DW, R10, R1, _KEY)
-    a.label("gokey_done")
+    emit_gokey_pack(a)             # SHARED with the syscall suite —
+    a.stx_mem(BPF_DW, R10, R1, _KEY)  # identical keys = cross-source
+    a.label("gokey_done")             # chaining
 
 
 def build_ssl_enter(maps: UprobeMaps) -> Asm:

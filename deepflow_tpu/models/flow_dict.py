@@ -153,16 +153,31 @@ class FlowDictPacker:
 
     # -- wire accounting ----------------------------------------------------
 
+    @staticmethod
+    def _bucket(n: int, full: int) -> int:
+        """Plane width for n live rows: the smallest power-of-two
+        bucket >= n (floor 256), capped at the full batch width. A
+        partial batch padded all the way to `full` would make a
+        TRICKLE of new flows cost a full plane per pack() call on the
+        wire — a steady few news/batch must stay a few hundred bytes,
+        not erase the hit lane's savings (review r5). Buckets bound
+        the distinct plane shapes (and so the consumer's jit
+        specializations) to log2(full/256) + 1 per kind."""
+        b = 256
+        while b < n:
+            b <<= 1
+        return min(b, full)
+
     def _emit_news(self, out: List[Tuple[str, np.ndarray, int]],
                    idx: np.ndarray, keys: np.ndarray,
                    pkts: np.ndarray) -> None:
-        """Emit (6, C) planes, padded; partial batches flush eagerly —
+        """Emit (6, bucket) planes; partial batches flush eagerly —
         news must never sit buffered past the call whose hits may
         reference them."""
         C = self.news_batch
         for s in range(0, len(idx), C):
             e = min(s + C, len(idx))
-            plane = np.zeros((6, C), np.uint32)
+            plane = np.zeros((6, self._bucket(e - s, C)), np.uint32)
             plane[0, :e - s] = idx[s:e]
             plane[1:5, :e - s] = keys[s:e].T
             plane[5, :e - s] = pkts[s:e]
@@ -181,7 +196,7 @@ class FlowDictPacker:
         end = len(idx) if partial else (len(idx) // B) * B
         for s in range(0, end, B):
             e = min(s + B, end)
-            plane = np.zeros((2, B), np.uint32)
+            plane = np.zeros((2, self._bucket(e - s, B)), np.uint32)
             plane[0, :e - s] = idx[s:e]
             plane[1, :e - s] = pkts[s:e]
             out.append(("hits", plane, e - s))

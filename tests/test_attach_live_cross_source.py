@@ -1,0 +1,214 @@
+"""LIVE cross-SOURCE trace chaining: a syscall read's parked trace id
+consumed by a Go-TLS uprobe write — across OS threads — through the
+goroutine-id key both suites now build identically.
+
+This is the chain the reference gets from its unified
+get_current_goroutine key (uprobe_base_bpf.c:1): an inbound request
+read by one goroutine chains to the same goroutine's outbound egress
+even when the two observations come from DIFFERENT instrumentation
+sources (plaintext syscall vs in-TLS uprobe) and the goroutine
+migrated threads in between. The syscall programs cannot kprobe-attach
+in this container (kprobe PMU masked), but their ABI contract — outer
+pt_regs whose di points at an inner pt_regs carrying the USER
+registers — is reproducible exactly with a uprobe on a C function
+whose first argument is a pointer to a fake inner pt_regs, so the REAL
+verifier-loaded syscall programs run in-kernel here too."""
+
+import shutil
+import struct
+import subprocess
+
+import pytest
+
+from deepflow_tpu.agent import bpf, perf_ring, socket_trace, uprobe_trace
+from deepflow_tpu.agent.socket_trace import (SOURCE_GO_TLS_UPROBE,
+                                             SOURCE_SYSCALL, T_EGRESS,
+                                             T_INGRESS, parse_record)
+
+_cc = shutil.which("gcc") or shutil.which("cc")
+_attach_ok, _attach_why = uprobe_trace.attach_available()
+
+pytestmark = [
+    pytest.mark.skipif(not bpf.available(), reason="bpf(2) unavailable"),
+    pytest.mark.skipif(not _attach_ok,
+                       reason=f"uprobe attach masked: {_attach_why}"),
+    pytest.mark.skipif(_cc is None, reason="no C toolchain"),
+]
+
+_DRIVER_C = r"""
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+
+__attribute__((noinline)) void sys_enter_point(void *r)
+  { (void)r; __asm__ volatile("" ::: "memory"); }
+__attribute__((noinline)) void sys_exit_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+__attribute__((noinline)) void go_probe_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+__attribute__((noinline)) void go_ret_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+
+struct netfd  { long pad[2]; int sysfd; };
+struct netconn{ struct netfd *fd; };
+struct conn   { void *itab; struct netconn *data; };
+struct fakeg  { char pad[152]; unsigned long long goid; };
+
+static struct netfd  nfd  = { {0, 0}, 44 };
+static struct netconn ncn = { &nfd };
+static struct conn    cn  = { 0, &ncn };
+static struct fakeg   g   = { {0}, 777 };
+static char inbound[]  = "GET /api/pay HTTP/1.1\r\nHost: svc\r\n\r\n";
+static char outbound[] = "GET /upstream HTTP/1.1\r\nHost: b\r\n\r\n";
+static char fregs[256];          /* fake INNER pt_regs (user regs) */
+
+static void *sys_read_sim(void *a) {
+  (void)a;
+  /* inner regs the syscall enter program walks: r14@8 = g,
+     si@104 = buf, di@112 = fd (socket_trace.py pt_regs offsets) */
+  *(void **)(fregs + 8)   = (void *)&g;
+  *(void **)(fregs + 104) = (void *)inbound;
+  *(long *) (fregs + 112) = 7;
+  sys_enter_point(fregs);
+  long n = (long)strlen(inbound);
+  __asm__ volatile(
+    "mov %0, %%rax\n\t"
+    "call sys_exit_point\n\t"
+    : : "r"(n) : "rax", "memory");
+  return 0;
+}
+
+static void *go_write_sim(void *a) {
+  (void)a;
+  __asm__ volatile(            /* crypto/tls Write entry, register ABI */
+    "mov %0, %%rax\n\t"
+    "mov %1, %%rbx\n\t"
+    "mov %2, %%r14\n\t"
+    "call go_probe_point\n\t"
+    : : "r"(&cn), "r"(outbound), "r"(&g)
+    : "rax", "rbx", "r14", "memory");
+  long n = (long)strlen(outbound);
+  __asm__ volatile(            /* its RET site */
+    "mov %0, %%rax\n\t"
+    "mov %1, %%r14\n\t"
+    "call go_ret_point\n\t"
+    : : "r"(n), "r"(&g)
+    : "rax", "r14", "memory");
+  return 0;
+}
+
+int main(void) {
+  getchar();                   /* parent pushes proc_info, signals */
+  pthread_t t;                 /* read on thread A, write on thread B */
+  pthread_create(&t, 0, sys_read_sim, 0); pthread_join(t, 0);
+  pthread_create(&t, 0, go_write_sim, 0); pthread_join(t, 0);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def driver(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cross_source")
+    (d / "driver.c").write_text(_DRIVER_C)
+    exe = d / "driver"
+    subprocess.run([_cc, "-O1", "-pthread", str(d / "driver.c"),
+                    "-o", str(exe)], check=True)
+    return str(exe)
+
+
+def test_syscall_read_chains_into_tls_write_across_threads(driver):
+    st = socket_trace.SocketTraceSuite()
+    up = uprobe_trace.UprobeSuite(shared=st.maps)
+    probes = []
+    reader = None
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(st.maps.events, cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        funcs = uprobe_trace.elf_func_table(driver)
+
+        def off(sym):
+            return uprobe_trace.vaddr_to_offset(driver, funcs[sym][0])
+
+        for prog, sym in ((st.enter_buf, "sys_enter_point"),
+                          (st.exit_ingress, "sys_exit_point"),
+                          (up.go_enter, "go_probe_point"),
+                          (up.go_exit_write, "go_ret_point")):
+            probes.append(perf_ring.attach_uprobe(
+                prog, driver, off(sym), False))
+        tset = shutil.which("taskset")
+        cmd = ([tset, "-c", "0"] if tset else []) + [driver]
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
+        st.maps.set_proc_info(p.pid, reg_abi=True, goid_off=152)
+        p.communicate(b"\n", timeout=30)
+        assert p.returncode == 0
+        recs = [parse_record(r) for r in reader.drain()]
+        assert len(recs) == 2, recs
+        reads = [r for r in recs if r.direction == T_INGRESS]
+        writes = [r for r in recs if r.direction == T_EGRESS]
+        assert len(reads) == 1 and len(writes) == 1
+        rd, wr = reads[0], writes[0]
+        assert rd.source == SOURCE_SYSCALL
+        assert rd.payload.startswith(b"GET /api/pay")
+        assert rd.fd == 7
+        assert wr.source == SOURCE_GO_TLS_UPROBE
+        assert wr.payload.startswith(b"GET /upstream")
+        assert wr.fd == 44                    # walked Conn->netFD->Sysfd
+        # THE point: the id the syscall read parked under the goid key
+        # is the id the TLS write consumed — across sources, across
+        # OS threads, zero userspace stitching
+        assert rd.kernel_trace_id != 0
+        assert wr.kernel_trace_id == rd.kernel_trace_id
+        assert rd.tid != wr.tid               # genuinely cross-thread
+    finally:
+        for pr in probes:
+            pr.close()
+        if reader is not None:
+            reader.close()
+        up.close()
+        st.close()
+
+
+def test_unmanaged_process_keeps_pid_tgid_chaining(driver):
+    """No proc_info row: the same driver chains NOTHING across threads
+    (pid_tgid keys differ) — proving the goid key, not an accident of
+    the shared maps, carries the cross-source chain."""
+    st = socket_trace.SocketTraceSuite()
+    up = uprobe_trace.UprobeSuite(shared=st.maps)
+    probes = []
+    reader = None
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(st.maps.events, cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        funcs = uprobe_trace.elf_func_table(driver)
+
+        def off(sym):
+            return uprobe_trace.vaddr_to_offset(driver, funcs[sym][0])
+
+        for prog, sym in ((st.enter_buf, "sys_enter_point"),
+                          (st.exit_ingress, "sys_exit_point")):
+            probes.append(perf_ring.attach_uprobe(
+                prog, driver, off(sym), False))
+        tset = shutil.which("taskset")
+        cmd = ([tset, "-c", "0"] if tset else []) + [driver]
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
+        # NO set_proc_info: unmanaged
+        p.communicate(b"\n", timeout=30)
+        assert p.returncode == 0
+        recs = [parse_record(r) for r in reader.drain()]
+        # go probes not attached here; the read still records, keyed
+        # pid_tgid, with a parked id nobody consumes
+        assert len(recs) == 1
+        assert recs[0].source == SOURCE_SYSCALL
+        assert recs[0].kernel_trace_id != 0
+    finally:
+        for pr in probes:
+            pr.close()
+        if reader is not None:
+            reader.close()
+        up.close()
+        st.close()

@@ -161,6 +161,36 @@ def test_recall_through_dict_path():
     assert hit / len(exact) >= 0.9, f"recall {hit}/{len(exact)}"
 
 
+def test_news_trickle_ships_small_bucketed_planes():
+    """A few new flows per pack() call must cost a few hundred bytes,
+    not a full padded news plane (review r5): buckets are the smallest
+    power of two >= rows (floor 256), so the trickle case stays
+    proportional while jit specializations stay bounded."""
+    pool = _pool(2048, seed=41)
+    packer = FlowDictPacker(capacity=8192, hits_batch=2048,
+                            news_batch=1024)
+    # warm with 512 flows
+    warm = {k: v[:512] for k, v in pool.items()}
+    packer.pack(warm)
+    before = packer.bytes_news
+    # trickle: 3 new flows among 512 repeats
+    trick = {k: np.concatenate([v[:509], v[512:515]])
+             for k, v in pool.items()}
+    out = packer.pack(trick)
+    news = [(p, n) for kind, p, n in out if kind == "news"]
+    assert len(news) == 1 and news[0][1] == 3
+    assert news[0][0].shape == (6, 256)            # smallest bucket
+    assert packer.bytes_news - before == 6 * 256 * 4
+    # state equivalence must hold across mixed bucket shapes
+    batches = [warm, trick]
+    packed = _run_packed(batches)
+    dicted, _, _ = _run_dict(batches,
+                             FlowDictPacker(capacity=8192,
+                                            hits_batch=2048,
+                                            news_batch=1024))
+    _assert_additive_state_equal(packed, dicted)
+
+
 def test_capacity_guards():
     with pytest.raises(ValueError):
         FlowDictPacker(capacity=64, hits_batch=64)
