@@ -504,27 +504,29 @@ def main() -> None:
 
     dict_windows: list = []
 
-    def _dict_run(state, n_iters):
-        for _ in range(n_iters):
-            for kind, payload, n in dict_payloads:
-                nn = np.uint32(n)
-                if kind == "news":
-                    plane, _ = columnar_wire.decode_columnar_plane(
-                        payload, SKETCH_NEWS_SCHEMA)
-                    state, _dict_run.dstate = step_news(
-                        state, _dict_run.dstate, jnp.asarray(plane), nn)
-                else:
-                    plane, _ = columnar_wire.decode_columnar_plane(
-                        payload, SKETCH_HITS_SCHEMA)
-                    state = step_hits(
-                        state, _dict_run.dstate, jnp.asarray(plane), nn)
-        return state
+    def _make_dict_run(dcell):
+        def run(state, n_iters):
+            for _ in range(n_iters):
+                for kind, payload, n in dict_payloads:
+                    nn = np.uint32(n)
+                    if kind == "news":
+                        plane, _ = columnar_wire.decode_columnar_plane(
+                            payload, SKETCH_NEWS_SCHEMA)
+                        state, dcell[0] = step_news(
+                            state, dcell[0], jnp.asarray(plane), nn)
+                    else:
+                        plane, _ = columnar_wire.decode_columnar_plane(
+                            payload, SKETCH_HITS_SCHEMA)
+                        state = step_hits(
+                            state, dcell[0], jnp.asarray(plane), nn)
+            return state
+        return run
 
     def dict_window() -> dict:
-        _dict_run.dstate = flow_dict.init_dict(dict_packer.capacity)
+        dcell = [flow_dict.init_dict(dict_packer.capacity)]
         return _measure_window(
             "dict-lane", dict_windows,
-            lambda: timed_run(_dict_run,
+            lambda: timed_run(_make_dict_run(dcell),
                               records_per_iter=dict_records_per_iter),
             dict_b_per_rec)
 

@@ -94,8 +94,13 @@ _PT_DI, _PT_SI, _PT_AX = 112, 104, 80
 _MSG_IOV_OFF, _IOV_BASE_OFF, _IOV_LEN_OFF = 16, 0, 8
 
 # stack frame (offsets from R10). The uprobe/http2 modules allocate
-# their extra slots BELOW this frame's end (-280): extending it means
-# renumbering theirs too (uprobe_trace.py's _GOSTASH starts at -288).
+# their extra slots BELOW this frame's end (-264): extending the frame
+# DOWNWARD means renumbering theirs too (uprobe_trace.py's _GOSTASH
+# starts at -288) — which is why the goid slots live in the free space
+# ABOVE the stash build area instead (-16..-1; the stash value ends at
+# -17).
+_GOIDVAL = -16       # goid scratch (8B, -16..-9)
+_PIKEY = -8          # u32 tgid key for proc_info lookups (-8..-5)
 _REC = -192          # SOCK_DATA record
 _KEY = -200          # pid_tgid hash key
 _CONFKEY = -208      # u32 conf array index
@@ -105,8 +110,6 @@ _SCRATCH = -232      # pointer-hop scratch
 _IOVPAIR = -264      # first iovec {iov_base, iov_len} read as ONE 16B
                      # probe_read (-264..-249; -248.. is _TRVAL's 16B)
 _TRVAL = -248        # trace-map value {id, fd} (16B)
-_PIKEY = -272        # u32 tgid key for proc_info lookups
-_GOIDVAL = -280      # goid scratch (8B)
 
 # proc_info value layout shared with the uprobe suite (ONE map, pushed
 # once per managed Go tgid): {reg_abi, conn_off, fd_off, sysfd_off,

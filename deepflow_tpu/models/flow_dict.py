@@ -62,13 +62,19 @@ def init_dict(capacity: int = 1 << 20) -> FlowDictState:
 
 def update_news(state: FlowSuiteState, dstate: FlowDictState,
                 plane: jnp.ndarray, n: jnp.ndarray,
-                cfg: FlowSuiteConfig
+                cfg: FlowSuiteConfig,
+                count_mask: jnp.ndarray = None
                 ) -> Tuple[FlowSuiteState, FlowDictState]:
     """Apply one (6, C) news plane: scatter the C key rows into the
     table AND count the records themselves (a news row IS that flow's
     first record, packets included — it must not be counted again).
     Rows >= n are padding: their scatter is routed out of bounds and
-    dropped, their count masked."""
+    dropped, their count masked.
+
+    `count_mask` (sharded path) narrows which rows THIS caller counts
+    while every valid row is still scattered: news planes replicate
+    across a mesh so every table replica stays identical, but each
+    record must land in exactly one shard's sketches."""
     cap = dstate.table.shape[1]
     idx = plane[0].astype(jnp.int32)
     mask = jnp.arange(plane.shape[1]) < n
@@ -84,19 +90,25 @@ def update_news(state: FlowSuiteState, dstate: FlowDictState,
         "ports": plane[3],
         "proto_pkts": proto_word | plane[5],
     }
-    state = flow_suite.update(state, unpack_lanes(lanes), mask, cfg)
+    if count_mask is None:
+        count_mask = mask
+    state = flow_suite.update(state, unpack_lanes(lanes), count_mask, cfg)
     return state, FlowDictState(table=table)
 
 
 def update_hits(state: FlowSuiteState, dstate: FlowDictState,
                 plane: jnp.ndarray, n: jnp.ndarray,
-                cfg: FlowSuiteConfig) -> FlowSuiteState:
+                cfg: FlowSuiteConfig,
+                mask: jnp.ndarray = None) -> FlowSuiteState:
     """Apply one (2, B) hits plane: gather each row's key words from
     the table and advance the sketches exactly as the packed-lane path
-    would for the same records."""
+    would for the same records. `mask` (sharded path) overrides the
+    default arange<n validity when the plane is a shard of a larger
+    batch and n indexes the GLOBAL row space."""
     idx = plane[0].astype(jnp.int32)
     pkts = plane[1]
-    mask = jnp.arange(plane.shape[1]) < n
+    if mask is None:
+        mask = jnp.arange(plane.shape[1]) < n
     rows = dstate.table[:, idx]                  # (4, B) gather
     lanes = {
         "ip_src": rows[0],

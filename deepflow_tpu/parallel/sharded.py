@@ -157,6 +157,46 @@ class ShardedFlowSuite(_ShardedSuiteBase):
             (state_specs, P(None, axis), P(axis)), state_specs)
         self._plane_sharding = NamedSharding(mesh, P(None, axis))
 
+        # -- dictionary lane (models/flow_dict.py) on the mesh ------------
+        # Key table REPLICATED (leading device axis, identical content):
+        # news planes broadcast so every replica scatters the same rows,
+        # with each record COUNTED by exactly one shard (interleaved
+        # count_mask); hits planes shard on the batch axis and gather
+        # from the local replica — comm-free, like the column update.
+        from deepflow_tpu.models import flow_dict as _fd
+        self._flow_dict = _fd
+        nd = self.n_devices
+
+        def local_update_news(state, dtable, plane, n):
+            local = jax.tree.map(lambda x: x[0], state)
+            table = _fd.FlowDictState(table=dtable[0])
+            d = jax.lax.axis_index(axis)
+            rows = jnp.arange(plane.shape[1])
+            count = (rows < n) & (rows % nd == d)
+            local, table = _fd.update_news(local, table, plane, n, cfg_,
+                                           count_mask=count)
+            return (jax.tree.map(lambda x: x[None], local),
+                    table.table[None])
+
+        self._update_news = self._shard(
+            local_update_news,
+            (state_specs, P(axis), P(None, None), P()),
+            (state_specs, P(axis)))
+
+        def local_update_hits(state, dtable, plane, n):
+            local = jax.tree.map(lambda x: x[0], state)
+            table = _fd.FlowDictState(table=dtable[0])
+            d = jax.lax.axis_index(axis)
+            local_b = plane.shape[1]          # per-shard width
+            gmask = (jnp.arange(local_b) + d * local_b) < n
+            local = _fd.update_hits(local, table, plane, n, cfg_,
+                                    mask=gmask)
+            return jax.tree.map(lambda x: x[None], local)
+
+        self._update_hits = self._shard(
+            local_update_hits,
+            (state_specs, P(axis), P(None, axis), P()), state_specs)
+
         def flush_fn(state):
             merged = _merge_axis0(state)
             # Re-score ring candidates against the globally-merged sketch:
@@ -186,6 +226,24 @@ class ShardedFlowSuite(_ShardedSuiteBase):
 
     def update_plane(self, state, plane, mask):
         return self._update_plane(state, plane, mask)
+
+    # -- dictionary lane ---------------------------------------------------
+
+    def init_dict(self, capacity: int = 1 << 20):
+        """Replicated key table with the leading device axis (every
+        replica identical — news broadcasts keep them so)."""
+        return jax.device_put(
+            jnp.zeros((self.n_devices, 4, capacity), jnp.uint32),
+            self._state_sharding)
+
+    def update_news(self, state, dtable, plane, n):
+        """plane (6, C) REPLICATED; each record counted on one shard."""
+        return self._update_news(state, dtable, plane, jnp.uint32(n))
+
+    def update_hits(self, state, dtable, plane, n):
+        """plane (2, B) sharded on the batch axis; n is the GLOBAL
+        valid-row count."""
+        return self._update_hits(state, dtable, plane, jnp.uint32(n))
 
 
 class ShardedAppSuite(_ShardedSuiteBase):

@@ -75,6 +75,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1,
                  staged: bool = False,
+                 wire: str = "dict",
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
                          batch=64, stats=stats)
@@ -123,8 +124,43 @@ class TpuSketchExporter(QueueWorkerExporter):
         # 68B — on a tunneled backend (~240 MB/s sustained h2d) that is
         # the difference between ~3.5M and ~14M rec/s ceiling.
         self.staged = bool(staged)
+        # wire="dict" (default): the dictionary lane
+        # (models/flow_dict.py) — a flow's tuple crosses the link once,
+        # repeats cross as 8B {index, packets} hit rows against a
+        # device-resident key table (~halving steady-state transfer
+        # again vs the packed lane; the sketch state is bit-identical
+        # either way). wire="lanes" keeps the stateless 16B packed
+        # lane. The dictionary is NOT checkpointed: on restore a fresh
+        # packer re-announces flows as news, and stale device-table
+        # rows at unassigned indices are unreachable (hits only
+        # reference host-assigned indices), so correctness never
+        # depends on host/device dictionary agreement across restarts.
+        if wire not in ("dict", "lanes"):
+            raise ValueError(f"wire must be 'dict' or 'lanes', got {wire!r}")
+        if self.staged and wire == "dict":
+            import logging
+            logging.getLogger(__name__).warning(
+                "staged=True forces the packed lane; wire='dict' ignored")
+        self.wire = "lanes" if self.staged else wire
+        self._dict_packer = None
         if self.staged:
             self._update = flow_suite.make_staged_update(self.cfg)
+        elif self.wire == "dict":
+            from deepflow_tpu.models import flow_dict
+            self._flow_dict = flow_dict
+            self._dict_packer = flow_dict.FlowDictPacker(
+                capacity=max(2 * batch_rows, 1 << 17),
+                hits_batch=batch_rows)
+            self._dict_state = flow_dict.init_dict(
+                self._dict_packer.capacity)
+            self._update_hits = jax.jit(
+                lambda s, d, p, n: flow_dict.update_hits(s, d, p, n,
+                                                         self.cfg),
+                donate_argnums=0)
+            self._update_news = jax.jit(
+                lambda s, d, p, n: flow_dict.update_news(s, d, p, n,
+                                                         self.cfg),
+                donate_argnums=(0, 1))
         else:
             self._update = jax.jit(
                 lambda s, l, m: flow_suite.update_packed(s, l, m,
@@ -176,6 +212,24 @@ class TpuSketchExporter(QueueWorkerExporter):
     def _run_batch_locked(self, tb: TensorBatch) -> None:
         jnp = self._jnp
         self._record_key_tuples(tb)
+        if self._dict_packer is not None:
+            # dictionary lane: pack only the VALID rows (the packer's
+            # row stream has no padding concept; plane padding is
+            # masked on device by each batch's n)
+            mask = tb.mask()
+            cols = {k: v[mask] for k, v in tb.columns.items()}
+            wire = self._dict_packer.pack(cols) + self._dict_packer.flush()
+            for kind, plane, n in wire:
+                nn = np.uint32(n)
+                if kind == "news":
+                    self.state, self._dict_state = self._update_news(
+                        self.state, self._dict_state,
+                        jnp.asarray(plane), nn)
+                else:
+                    self.state = self._update_hits(
+                        self.state, self._dict_state,
+                        jnp.asarray(plane), nn)
+            return
         mask_d = jnp.asarray(tb.mask())
         if self.staged:   # staged update consumes the full column dict
             cols_d = {k: jnp.asarray(v) for k, v in tb.columns.items()}

@@ -253,6 +253,46 @@ def test_debug_stacks():
         srv.close()
 
 
+def test_sketch_exporter_dict_wire_matches_lanes_wire():
+    """The exporter's default dictionary wire must land the exact
+    additive sketch state the stateless packed lane lands for the same
+    chunks — the product-path version of test_flow_dict's equivalence
+    (the dict lane is the default precisely because state is provably
+    identical at half the transfer bytes)."""
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+
+    rng = np.random.default_rng(17)
+    pool = {name: rng.integers(0, 1 << 16, 512).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    chunks = []
+    for _ in range(4):
+        picks = rng.integers(0, 512, 2000)
+        chunks.append({k: v[picks] for k, v in pool.items()})
+
+    a = TpuSketchExporter(store=None, window_seconds=3600,
+                          batch_rows=1024, wire="dict")
+    b = TpuSketchExporter(store=None, window_seconds=3600,
+                          batch_rows=1024, wire="lanes")
+    try:
+        assert a.wire == "dict" and b.wire == "lanes"
+        for c in chunks:
+            a.process([("l4_flow_log", 0, c)])
+            b.process([("l4_flow_log", 0, c)])
+        assert int(a.state.rows_seen) > 0
+        np.testing.assert_array_equal(np.asarray(a.state.sketch.counts),
+                                      np.asarray(b.state.sketch.counts))
+        np.testing.assert_array_equal(
+            np.asarray(a.state.services.registers),
+            np.asarray(b.state.services.registers))
+        np.testing.assert_array_equal(np.asarray(a.state.ent.hist),
+                                      np.asarray(b.state.ent.hist))
+        assert int(a.state.rows_seen) == int(b.state.rows_seen)
+    finally:
+        a.close()
+        b.close()
+
+
 def test_staged_update_failure_counter_surfaces():
     """A staged ring-admission failure is observable through the
     exporter's counters (deepflow_system), not only in logs."""

@@ -271,3 +271,63 @@ def test_sharded_plane_update_equals_cols_update(rng):
     for a, b in zip(jax.tree_util.tree_leaves(s_cols),
                     jax.tree_util.tree_leaves(s_plane)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_dict_lane_matches_single_device(rng):
+    """Dictionary lane on the mesh: replicated table + broadcast news
+    (each record counted on exactly one shard) + batch-sharded hits
+    must land the same merged additive state as the single-device dict
+    path AND the packed path on the same records."""
+    from deepflow_tpu.models import flow_dict
+    from deepflow_tpu.models.flow_dict import FlowDictPacker
+
+    cfg = FlowSuiteConfig(cms_log2_width=12, ring_size=256, hll_groups=64,
+                          hll_precision=8)
+    mesh = make_mesh()
+    sharded = ShardedFlowSuite(cfg, mesh)
+    state_d = sharded.init()
+    dtable = sharded.init_dict(capacity=8192)
+
+    single = flow_suite.init(cfg)
+    sdict = flow_dict.init_dict(8192)
+
+    packer = FlowDictPacker(capacity=8192, hits_batch=4096,
+                            news_batch=512)
+    wire = []
+    batches = _batches(rng, n_batches=3, batch=4096)
+    for cols in batches:
+        wire.extend(packer.pack(
+            {k: cols[k].astype(np.uint32)
+             for k in ("ip_src", "ip_dst", "port_src", "port_dst",
+                       "proto", "packet_tx", "packet_rx")}))
+    wire.extend(packer.flush())
+
+    for kind, plane, n in wire:
+        nn = np.uint32(n)
+        if kind == "news":
+            state_d, dtable = sharded.update_news(
+                state_d, dtable, jnp.asarray(plane), nn)
+            single, sdict = flow_dict.update_news(
+                single, sdict, jnp.asarray(plane), nn, cfg)
+        else:
+            state_d = sharded.update_hits(
+                state_d, dtable, jnp.asarray(plane), nn)
+            single = flow_dict.update_hits(
+                single, sdict, jnp.asarray(plane), nn, cfg)
+
+    # every table replica must equal the single-device table
+    tables = np.asarray(dtable)
+    for d in range(tables.shape[0]):
+        np.testing.assert_array_equal(tables[d], np.asarray(sdict.table))
+    # merged additive state == single-device dict state
+    merged_counts = np.asarray(state_d.sketch.counts).sum(axis=0)
+    np.testing.assert_array_equal(merged_counts,
+                                  np.asarray(single.sketch.counts))
+    np.testing.assert_array_equal(
+        np.asarray(state_d.services.registers).max(axis=0),
+        np.asarray(single.services.registers))
+    np.testing.assert_array_equal(
+        np.asarray(state_d.ent.hist).sum(axis=0),
+        np.asarray(single.ent.hist))
+    assert (int(np.asarray(state_d.rows_seen).sum())
+            == int(single.rows_seen))
