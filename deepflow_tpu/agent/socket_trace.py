@@ -51,7 +51,7 @@ import struct
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW,
+from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW, BPF_SUB,
                                     BPF_JEQ, BPF_JGE, BPF_JGT, BPF_JNE,
                                     BPF_JSLE, BPF_LSH,
                                     BPF_MAP_TYPE_HASH,
@@ -119,7 +119,7 @@ _PI_GOID_OFF = 16
 
 @dataclass
 class SocketTraceMaps:
-    active: Map          # pid_tgid -> {buf, fd, is_msg, gokey} (stash)
+    active: Map          # pid_tgid -> {buf, fd, is_msg, gokey, enter_ts}
     trace: Map           # pid_tgid | goid key -> {parked trace id, fd}
     conf: Map            # [0]=next trace id, [1]=capture seq
     events: Map          # perf record stream
@@ -155,7 +155,7 @@ def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
         # LRU for the same reason). proc_info stays a plain HASH:
         # eviction there would silently disable goid keying for a
         # managed process, and its population is bounded by tgids.
-        for args in ((8192, 32, BPF_MAP_TYPE_LRU_HASH, 8),
+        for args in ((8192, 40, BPF_MAP_TYPE_LRU_HASH, 8),
                      (8192, 16, BPF_MAP_TYPE_LRU_HASH, 8),
                      (2, 8),
                      (ncpus, 4, BPF_MAP_TYPE_PERF_EVENT_ARRAY),
@@ -215,21 +215,25 @@ def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
     a.stx_mem(BPF_DW, R10, R7, _KEY)
     # inner pt_regs* = outer->di
     a.ldx_mem(BPF_DW, R8, R6, _PT_DI)
-    # stash value {buf@-48, fd@-40, is_msg@-32, gokey@-24}: arg fields
-    # live in the inner pt_regs (kernel memory) -> probe_read, which
-    # zero-fills the destination on fault, so a failed read degrades
-    # to payload_len 0 downstream instead of leaking uninitialized
-    # stack
-    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -48)
+    # stash value {buf@-56, fd@-48, is_msg@-40, gokey@-32,
+    # enter_ts@-24}: arg fields live in the inner pt_regs (kernel
+    # memory) -> probe_read, which zero-fills the destination on
+    # fault, so a failed read degrades to payload_len 0 downstream
+    # instead of leaking uninitialized stack. enter_ts is what lets
+    # the exit compute the syscall's latency (the reference's
+    # data_args->enter_ts, socket_trace.c:2433 — the io_event gate)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -56)
     a.mov_imm(R2, 8)
     a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, _PT_SI)
     a.call(FN_probe_read)
-    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -40)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, -48)
     a.mov_imm(R2, 8)
     a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, _PT_DI)
     a.call(FN_probe_read)
-    a.st_imm(BPF_DW, R10, -32, 1 if is_msg else 0)
-    a.st_imm(BPF_DW, R10, -24, 0)                  # gokey default: none
+    a.st_imm(BPF_DW, R10, -40, 1 if is_msg else 0)
+    a.st_imm(BPF_DW, R10, -32, 0)                  # gokey default: none
+    a.call(FN_ktime_get_ns)
+    a.stx_mem(BPF_DW, R10, R0, -24)                # enter_ts
     # -- goid trace key for managed Go tgids ------------------------------
     a.mov_reg(R1, R7).alu_imm(BPF_RSH, R1, 32)
     a.stx_mem(BPF_W, R10, R1, _PIKEY)
@@ -256,11 +260,11 @@ def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
     a.ldx_mem(BPF_DW, R1, R10, _GOIDVAL)
     a.jmp_imm(BPF_JEQ, R1, 0, "drop")
     emit_gokey_pack(a)
-    a.stx_mem(BPF_DW, R10, R1, -24)                # gokey into stash
+    a.stx_mem(BPF_DW, R10, R1, -32)                # gokey into stash
     a.label("stash")
     a.ld_map_fd(R1, maps.active)
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
-    a.mov_reg(R3, R10).alu_imm(BPF_ADD, R3, -48)
+    a.mov_reg(R3, R10).alu_imm(BPF_ADD, R3, -56)
     a.mov_imm(R4, 0)                               # BPF_ANY
     a.call(FN_map_update_elem)
     a.label("drop")
@@ -289,6 +293,8 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.stx_mem(BPF_DW, R10, R1, _FLAG)              # is_msg
     a.ldx_mem(BPF_DW, R1, R0, 24)                  # gokey (0 = none)
     a.stx_mem(BPF_DW, R10, R1, _GOIDVAL)
+    a.ldx_mem(BPF_DW, R1, R0, 32)                  # enter_ts
+    a.stx_mem(BPF_DW, R10, R1, _SCRATCH)
     a.ld_map_fd(R1, maps.active)                   # consume the stash
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
     a.call(FN_map_delete_elem)
@@ -300,6 +306,24 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.jmp_imm(BPF_JEQ, R1, 0, "pidkey")
     a.stx_mem(BPF_DW, R10, R1, _KEY)
     a.label("pidkey")
+    # syscall latency = now - enter_ts, clamped to u32 ns (~4.3s cap):
+    # rides the record fd word's high half (the fd itself is a small
+    # int) so the wire image stays 192B. The userspace io-event gate
+    # (reference: trace_io_event_common, socket_trace.c:2393) needs it
+    # to attach slow file-IO spans to in-flight traces.
+    a.call(FN_ktime_get_ns)
+    a.mov_reg(R1, R0)
+    a.ldx_mem(BPF_DW, R2, R10, _SCRATCH)           # enter_ts
+    a.jmp_imm(BPF_JEQ, R2, 0, "lat_zero")          # old/faulted stash
+    a.alu_reg(BPF_SUB, R1, R2)
+    a.mov32_imm(R2, 0xFFFFFFFF)
+    a.jmp_reg(BPF_JGT, R1, R2, "lat_cap")
+    a.jmp("lat_done")
+    a.label("lat_cap").mov_reg(R1, R2)
+    a.jmp("lat_done")
+    a.label("lat_zero").mov_imm(R1, 0)
+    a.label("lat_done")
+    a.stx_mem(BPF_DW, R10, R1, _SCRATCH)           # latency slot
     # ret bytes (kretprobe: pt_regs->ax); <= 0 = error/EOF, no record
     a.ldx_mem(BPF_DW, R8, R6, _PT_AX)
     a.jmp_imm(BPF_JSLE, R8, 0, "done")
@@ -307,14 +331,16 @@ def build_exit(maps: SocketTraceMaps, direction: int) -> Asm:
     a.jmp("len_ok")
     a.label("clamp").mov_imm(R8, PAYLOAD_CAP)
     a.label("len_ok")
-    emit_record_tail(a, maps, direction, msghdr_check=True)
+    emit_record_tail(a, maps, direction, msghdr_check=True,
+                     latency_slot=_SCRATCH)
     a.label("done")
     a.exit_imm(0)
     return a
 
 
 def emit_record_tail(a: Asm, maps, direction: int, source: int = 0,
-                     msghdr_check: bool = False) -> Asm:
+                     msghdr_check: bool = False,
+                     latency_slot: int = None) -> Asm:
     """The shared SOCK_DATA record build + trace-id discipline + perf
     emit — the tail every record-producing exit program ends with
     (syscall kretprobes here; SSL/Go-TLS uprobe exits in
@@ -398,6 +424,15 @@ def emit_record_tail(a: Asm, maps, direction: int, source: int = 0,
     a.stx_mem(BPF_DW, R10, R1, _REC + 24)
     a.label("no_seq")
     a.ldx_mem(BPF_DW, R1, R10, _FDSAVE)
+    if latency_slot is not None:
+        # fd word = fd | latency_ns << 32 — _FDSAVE itself stays the
+        # PURE fd (the trace park value and its continuation compare
+        # use it; a latency-tainted fd would break same-socket
+        # continuation). Only the emitted record carries the packing.
+        a.alu_imm(BPF_LSH, R1, 32).alu_imm(BPF_RSH, R1, 32)
+        a.ldx_mem(BPF_DW, R2, R10, latency_slot)
+        a.alu_imm(BPF_LSH, R2, 32)
+        a.alu_reg(BPF_OR, R1, R2)
     a.stx_mem(BPF_DW, R10, R1, _REC + 32)          # fd
     a.st_imm(BPF_W, R10, _REC + 40,
              direction | (source << 16))           # dir | source<<16
@@ -545,10 +580,14 @@ def parse_record(buf: bytes,
     flow tuple is zeros (sessions still pair per pid/fd/direction)."""
     from deepflow_tpu.agent.ebpf_source import SyscallRecord
 
-    (pid_tgid, ts, trace_id, cap_seq, fd, dirword, data_len, comm,
+    (pid_tgid, ts, trace_id, cap_seq, fd_word, dirword, data_len, comm,
      payload) = struct.unpack(_RECORD_FMT, buf[:RECORD_SIZE])
     direction, source = dirword & 0xFFFF, dirword >> 16
     tgid, tid = pid_tgid >> 32, pid_tgid & 0xFFFFFFFF
+    # fd word: fd in the low half, syscall latency (u32 ns, clamped in
+    # kernel) in the high half — records from pre-latency sources have
+    # 0 there, which reads as latency 0
+    fd, latency_ns = fd_word & 0xFFFFFFFF, fd_word >> 32
     ips = (0, 0, 0, 0)
     if resolver is not None:
         got = resolver(tgid, fd)
@@ -567,6 +606,7 @@ def parse_record(buf: bytes,
         timestamp_ns=ts,
         ip_src=ips[0], ip_dst=ips[1], port_src=ips[2], port_dst=ips[3],
         cap_seq=cap_seq,
+        latency_ns=latency_ns,
         process_kname=comm.split(b"\0", 1)[0].decode("latin-1"),
         payload=payload[:min(data_len, PAYLOAD_CAP)],
         kernel_trace_id=trace_id,
@@ -577,11 +617,15 @@ def parse_record(buf: bytes,
 def pack_record(pid: int, tid: int, direction: int, ts_ns: int,
                 payload: bytes, fd: int = 3, trace_id: int = 0,
                 cap_seq: int = 0, comm: str = "",
-                source: int = SOURCE_SYSCALL) -> bytes:
+                source: int = SOURCE_SYSCALL,
+                latency_ns: int = 0) -> bytes:
     """Build a SOCK_DATA record byte-image (tests + fixture replay in
-    the kernel wire format — the inverse of parse_record)."""
+    the kernel wire format — the inverse of parse_record). latency_ns
+    rides the fd word's high half exactly as the kernel packs it."""
+    fd_word = (fd & 0xFFFFFFFF) | (min(latency_ns, 0xFFFFFFFF) << 32)
     return struct.pack(
-        _RECORD_FMT, (pid << 32) | tid, ts_ns, trace_id, cap_seq, fd,
-        direction | (source << 16), min(len(payload), PAYLOAD_CAP),
+        _RECORD_FMT, (pid << 32) | tid, ts_ns, trace_id, cap_seq,
+        fd_word, direction | (source << 16),
+        min(len(payload), PAYLOAD_CAP),
         comm.encode("latin-1")[:16],
         payload[:PAYLOAD_CAP])

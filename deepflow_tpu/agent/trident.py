@@ -271,7 +271,8 @@ class Agent:
         self.guard = Guard()
         self.escape = EscapeTimer(cfg.escape_after_s, self._on_escape)
         sender_types = [MessageType.TAGGEDFLOW, MessageType.METRICS,
-                        MessageType.PROTOCOLLOG, MessageType.COLUMNAR_FLOW]
+                        MessageType.PROTOCOLLOG, MessageType.COLUMNAR_FLOW,
+                        MessageType.PROC_EVENT]
         self.pseq = None
         self._pseq_pending: List[bytes] = []
         if cfg.packet_sequence:
@@ -933,6 +934,13 @@ class Agent:
         if l7_records:
             sent["l7"] = self.senders[MessageType.PROTOCOLLOG].send(
                 l7_records)
+        tracer = getattr(self, "ebpf_tracer", None)
+        if tracer is not None and tracer.io_events:
+            # slow file-IO spans the tracer's IO gate extracted
+            # (reference: io_event -> PROC_EVENT -> perf_event table)
+            evs, tracer.io_events = tracer.io_events, []
+            sent["proc_events"] = self.senders[
+                MessageType.PROC_EVENT].send(evs)
         if pseq_blocks:
             # packet-sequence blocks are self-delimited by their
             # leading u32 block_size (l4_packet.go's decoder reads

@@ -153,6 +153,11 @@ def test_syscall_read_chains_into_tls_write_across_threads(driver):
         assert rd.source == SOURCE_SYSCALL
         assert rd.payload.startswith(b"GET /api/pay")
         assert rd.fd == 7
+        # the kernel measured enter->exit latency and packed it into
+        # the fd word's high half (the io-event gate's input); the
+        # stand-in's enter and exit run microseconds apart, so the
+        # value must be positive and sane, and must NOT corrupt fd
+        assert 0 < rd.latency_ns < 10_000_000_000
         assert wr.source == SOURCE_GO_TLS_UPROBE
         assert wr.payload.startswith(b"GET /upstream")
         assert wr.fd == 44                    # walked Conn->netFD->Sysfd

@@ -1,0 +1,191 @@
+"""IO events: slow file-IO syscalls attached to in-flight traces —
+the reference's io_event tracepoint (socket_trace.c:2393
+trace_io_event_common) rebuilt as kernel latency packing + a
+userspace gate at the fd-resolution boundary.
+
+Layers: the kernel packs enter->exit latency into every record's fd
+word (live test in test_attach_live_cross_source.py asserts it from a
+real in-kernel run); EbpfTracer's gate routes PROVEN regular-file
+records (readlink of /proc/<pid>/fd/<fd> yields a real path — the
+reference's in-kernel is_regular_file, done where the fd table is
+readable) into ProcEvent IO events under the reference's
+collect-mode/min-duration rules; sockets, pipes, dead pids and
+closed fds all fall through to session parsing unchanged; trident
+ships PROC_EVENT frames; the event pipeline lands perf_event rows."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from deepflow_tpu.agent.ebpf_source import EbpfTracer
+from deepflow_tpu.agent.socket_trace import (T_EGRESS, T_INGRESS,
+                                             pack_record, parse_record)
+from deepflow_tpu.wire.gen import telemetry_pb2
+
+MS = 1_000_000
+
+
+@pytest.fixture
+def held_file(tmp_path):
+    """A REAL open regular file in THIS process: the gate proves
+    file-class through /proc, so fixtures must be live fds."""
+    p = tmp_path / "hot.log"
+    p.write_text("x" * 64)
+    f = open(p, "rb")
+    try:
+        yield os.getpid(), f.fileno(), str(p)
+    finally:
+        f.close()
+
+
+def _rec(pid, fd, latency_ns=5 * MS, trace_id=77, direction=T_EGRESS,
+         payload=b"log line\n"):
+    return parse_record(pack_record(
+        pid=pid, tid=pid + 1, direction=direction,
+        ts_ns=int(time.time() * 1e9), payload=payload, fd=fd,
+        trace_id=trace_id, comm="logger", latency_ns=latency_ns))
+
+
+def test_latency_rides_the_fd_word(held_file):
+    pid, fd, _ = held_file
+    rec = _rec(pid, fd, latency_ns=3 * MS)
+    assert rec.latency_ns == 3 * MS
+    assert rec.fd == fd
+    rec = _rec(pid, fd, latency_ns=1 << 40)     # clamp at u32
+    assert rec.latency_ns == 0xFFFFFFFF
+
+
+def test_gate_emits_proc_event_for_slow_traced_file_io(held_file):
+    pid, fd, path = held_file
+    tr = EbpfTracer(vtap_id=5)
+    assert tr.feed(_rec(pid, fd)) is None
+    assert len(tr.io_events) == 1
+    ev = telemetry_pb2.ProcEvent()
+    ev.ParseFromString(tr.io_events[0])
+    assert ev.pid == pid and ev.thread_id == pid + 1
+    assert ev.event_type == telemetry_pb2.IoEvent
+    assert ev.io_event_data.latency == 5 * MS
+    assert ev.io_event_data.operation == telemetry_pb2.Write
+    assert ev.io_event_data.bytes_count == len(b"log line\n")
+    assert ev.io_event_data.filename.decode() == path
+    assert ev.end_time - ev.start_time == 5 * MS
+    assert ev.process_kname == b"logger"
+
+
+def test_gate_mode1_requires_in_flight_trace(held_file):
+    pid, fd, _ = held_file
+    tr = EbpfTracer()
+    tr.feed(_rec(pid, fd, trace_id=0))
+    assert tr.io_events == []                   # no trace: skip (mode 1)
+    tr2 = EbpfTracer(io_event_collect_mode=2)
+    tr2.feed(_rec(pid, fd, trace_id=0))
+    assert len(tr2.io_events) == 1              # mode 2: everything
+    tr3 = EbpfTracer(io_event_collect_mode=0)
+    tr3.feed(_rec(pid, fd))
+    assert tr3.io_events == []                  # off
+
+
+def test_gate_minimal_duration(held_file):
+    pid, fd, _ = held_file
+    tr = EbpfTracer()
+    tr.feed(_rec(pid, fd, latency_ns=MS // 2))
+    assert tr.io_events == []                   # under 1ms default
+    tr.feed(_rec(pid, fd, latency_ns=2 * MS))
+    assert len(tr.io_events) == 1
+
+
+def test_resolved_socket_records_never_become_io_events(held_file):
+    """A record with a resolved socket tuple goes to session parsing,
+    whatever its latency."""
+    pid, fd, _ = held_file
+    tr = EbpfTracer()
+    raw = pack_record(pid=pid, tid=1, direction=T_INGRESS,
+                      ts_ns=1, payload=b"GET / HTTP/1.1\r\n\r\n",
+                      fd=fd, trace_id=9, latency_ns=50 * MS)
+    rec = parse_record(raw, resolver=lambda p, f: (1, 2, 3, 4))
+    tr.feed(rec)
+    assert tr.io_events == []
+
+
+def test_unresolved_socket_fd_falls_through_not_swallowed():
+    """An IPv6/unix socket the tuple resolver could not resolve has a
+    zero tuple BUT readlink says 'socket:[N]': the record must
+    continue into session parsing (swallowing it as file IO would
+    lose the L7 session), and no IO event may be emitted."""
+    a, b = socket.socketpair()
+    try:
+        tr = EbpfTracer()
+        rec = _rec(os.getpid(), a.fileno(),
+                   payload=b"GET / HTTP/1.1\r\n\r\n")
+        tr.feed(rec)
+        assert tr.io_events == []
+        # the record reached the session layer (HTTP parse succeeded
+        # -> a request side is pending, not parse_failed)
+        assert tr.parse_failed == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_dead_pid_falls_through():
+    """Replay of records from an exited process: file-class is
+    unprovable, so the conservative route is session parsing (the
+    pre-gate behavior), never a fabricated IO event."""
+    tr = EbpfTracer()
+    tr.feed(_rec(pid=4242, fd=9))               # no such pid
+    assert tr.io_events == []
+
+
+def test_buffer_cap_drops_loudly(held_file):
+    pid, fd, _ = held_file
+    tr = EbpfTracer()
+    tr._IO_EVENTS_CAP = 3
+    for _ in range(5):
+        tr.feed(_rec(pid, fd))
+    assert len(tr.io_events) == 3
+    assert tr.io_events_dropped == 2
+
+
+def test_agent_ships_io_events_to_perf_event_table(held_file, tmp_path):
+    """End to end: tracer gate -> trident PROC_EVENT frames ->
+    ingester event pipeline -> perf_event rows with filename
+    SmartEncoded (the full reference path io_event ->
+    MESSAGE_TYPE_PROC_EVENT -> perf_event)."""
+    from deepflow_tpu.agent.trident import Agent, AgentConfig
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    pid, fd, path = held_file
+    store_dir = tmp_path / "store"
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  store_path=str(store_dir)))
+    ing.start()
+    agent = None
+    try:
+        agent = Agent(AgentConfig(
+            ingester_addr=f"127.0.0.1:{ing.port}"))
+        agent.vtap_id = 12
+        agent.ebpf_tracer = EbpfTracer(vtap_id=12)
+        agent.ebpf_tracer.feed(_rec(pid, fd, latency_ns=7 * MS))
+        sent = agent.tick()
+        assert sent.get("proc_events", 0) >= 1
+        deadline = time.time() + 10
+        table = ing.store.table("event", "perf_event")
+        while time.time() < deadline:
+            ing.flush()
+            if table.row_count():
+                break
+            time.sleep(0.1)
+        rows = table.scan()
+        assert rows["duration_ns"].tolist()[0] == 7 * MS
+        assert rows["pid"].tolist()[0] == pid
+        assert rows["event_type"].tolist()[0] == int(
+            telemetry_pb2.IoEvent)
+        fname = ing.tag_dicts.get("event_strings").decode(
+            int(rows["filename"][0]))
+        assert fname == path
+    finally:
+        if agent is not None:
+            agent.close()
+        ing.close()
