@@ -173,6 +173,7 @@ class EbpfTracer:
         self.io_events: List[bytes] = []      # serialized ProcEvents
         self.io_events_dropped = 0
         self._IO_EVENTS_CAP = 4096
+        self._fd_path_cache: Dict[Tuple[int, int], tuple] = {}
         self.sessions = SessionAggregator()
         # trace map: (pid, coroutine|tid) -> (parked trace id, socket
         # key, direction); id 0 = the client-only zero marker
@@ -338,8 +339,7 @@ class EbpfTracer:
         self._meta_ts.pop(skey, None)
         return self._wire_record(flow, merged, rec, sides)
 
-    @staticmethod
-    def _fd_path(pid: int, fd: int) -> Optional[str]:
+    def _fd_path(self, pid: int, fd: int) -> Optional[str]:
         """The fd's regular-file path, or None when it is anything
         else (socket/pipe/anon inode — readlink yields "type:[N]") or
         unknowable (dead pid, closed fd). Resolution happens at
@@ -350,13 +350,24 @@ class EbpfTracer:
         filename (the reference avoids this by capturing the name
         in-kernel at event time; a /proc-based design cannot).
         Probabilistic and bounded by the drain latency — documented,
-        not hidden."""
+        not hidden. A short-TTL cache keeps a sustained slow-IO
+        stream (fsync-heavy logger) from paying one /proc readlink
+        per record on the drain hot path."""
         import os as _os
+        import time as _time
+        now = _time.monotonic()
+        got = self._fd_path_cache.get((pid, fd))
+        if got is not None and now - got[1] < 3.0:
+            return got[0]
         try:
             path = _os.readlink(f"/proc/{pid}/fd/{fd}")
+            result = path if path.startswith("/") else None
         except OSError:
-            return None
-        return path if path.startswith("/") else None
+            result = None
+        if len(self._fd_path_cache) > 4096:
+            self._fd_path_cache.clear()
+        self._fd_path_cache[(pid, fd)] = (result, now)
+        return result
 
     def _emit_io_event(self, rec: SyscallRecord, path: str) -> None:
         """Build the ProcEvent the event pipeline ingests
@@ -444,7 +455,11 @@ class EbpfTracer:
         out = {"records_in": self.records_in,
                "parse_failed": self.parse_failed,
                "trace_map_entries": len(self._trace_map),
-               "next_trace_id": self._next_trace_id}
+               "next_trace_id": self._next_trace_id,
+               # the cap's drops must be visible in the ebpf debug
+               # dump, or an operator can never see the loss
+               "io_events_pending": len(self.io_events),
+               "io_events_dropped": self.io_events_dropped}
         if self._http2 is not None:
             out["http2"] = self._http2.counters()
         return out

@@ -20,8 +20,9 @@ This module rebuilds that on the in-tree toolkit:
   the stream id). Both gate on the `http2_info` map (per-process
   offsets: tconn interface offset -> net.conn fd walk, stream-id
   offset, regabi flag) so an unmanaged process pays two map misses.
-  Register-ABI Go (>= 1.17) only — the stack-ABI http2 internals
-  predate the versions that matter for h2 traffic; documented subset.
+  Both Go ABIs: register (>= 1.17) and stack (< 1.17, every argument
+  read becomes a probe_read of SP+8k — go_http2_bpf.c:26-29's branch,
+  here as separate per-flavor programs selected by the attach plan).
 - events ride the standard 192B SOCK_DATA wire (socket_trace.py)
   with SOURCE_GO_HTTP2_UPROBE in the direction word, so the perf
   reader and EbpfTracer plumbing need nothing new;
@@ -50,7 +51,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW, BPF_JEQ, BPF_JGT,
-                                    BPF_JLE, BPF_JLT, BPF_LSH,
+                                    BPF_JLE, BPF_JLT, BPF_JNE, BPF_LSH,
                                     BPF_MAP_TYPE_HASH, BPF_OR,
                                     BPF_PROG_TYPE_KPROBE, BPF_RSH,
                                     BPF_SUB, BPF_W,
@@ -69,7 +70,7 @@ from deepflow_tpu.agent.socket_trace import (RECORD_SIZE,
 from deepflow_tpu.agent.socket_trace import (_FDSAVE, _KEY,  # noqa
                                              _PT_AX, _REC, _SCRATCH)
 from deepflow_tpu.agent.uprobe_trace import (_GOSTASH, _PIKEY,  # noqa
-                                             _PT_BX, _PT_CX,
+                                             _PT_BX, _PT_CX, _PT_SP,
                                              UprobeSpec, elf_func_table,
                                              go_version,
                                              vaddr_to_offset)
@@ -102,6 +103,7 @@ _FRAME = -344            # saved MetaHeadersFrame*
 _FIELDSV = -360          # fields slice {data ptr, len} (16B)
 _FIELD = -400            # one copied HeaderField (40B)
 _STREAMSV = -408         # stream id
+_ARGSLOT = -416          # stack-ABI argument probe_read target
 
 # event layout inside the SOCK_DATA payload (offsets from _REC+64):
 #   u32 stream | u8 flags | u8 name_len | u8 value_len | u8 pad
@@ -155,7 +157,27 @@ def create_http2_maps(
     return Http2Maps(info, shared=shared, owns_shared=owns)
 
 
-def _prologue(a: Asm, maps: Http2Maps) -> None:
+def _load_arg(a: Asm, reg_abi: bool, idx: int, pt_off: int,
+              dst) -> None:
+    """Go argument `idx` (0 = receiver) -> dst register. Register ABI
+    reads the mapped pt_regs register directly; stack ABI (go < 1.17)
+    probe_reads the caller-pushed slot at SP + 8 + 8*idx (SP points at
+    the return address at a function-entry uprobe) — the exact branch
+    go_http2_bpf.c:26-29 takes per argument. Stack mode clobbers
+    R1-R3 and _ARGSLOT; callers set probe_read args AFTER the load."""
+    if reg_abi:
+        a.ldx_mem(BPF_DW, dst, R6, pt_off)
+        return
+    a.ldx_mem(BPF_DW, R3, R6, _PT_SP)
+    a.alu_imm(BPF_ADD, R3, 8 + 8 * idx)
+    a.st_imm(BPF_DW, R10, _ARGSLOT, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _ARGSLOT)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.ldx_mem(BPF_DW, dst, R10, _ARGSLOT)
+
+
+def _prologue(a: Asm, maps: Http2Maps, reg_abi: bool = True) -> None:
     """ctx->R6, pid_tgid->R7/_KEY, http2_info lookup (absent ->
     "done"), offsets copied to the stack: tconn_off -> _SCRATCH(W),
     fd/sysfd/stream offs -> _GOSTASH+0/+4/+8 (W each)."""
@@ -169,10 +191,10 @@ def _prologue(a: Asm, maps: Http2Maps) -> None:
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _PIKEY)
     a.call(FN_map_lookup_elem)
     a.jmp_imm(BPF_JEQ, R0, 0, "done")
-    # the programs read the REGISTER ABI; a stack-ABI (go < 1.17)
-    # process must exit here, not emit garbage from AX/BX/CX reads
+    # each program is built for ONE ABI; a process pushed with the
+    # other flavor must exit here, not read garbage arg sources
     a.ldx_mem(BPF_W, R1, R0, 0)                    # reg_abi
-    a.jmp_imm(BPF_JEQ, R1, 0, "done")
+    a.jmp_imm(BPF_JEQ if reg_abi else BPF_JNE, R1, 0, "done")
     a.ldx_mem(BPF_W, R1, R0, 4)                    # tconn_off
     a.stx_mem(BPF_W, R10, R1, _SCRATCH)
     a.ldx_mem(BPF_W, R1, R0, 8)                    # fd_off
@@ -183,12 +205,12 @@ def _prologue(a: Asm, maps: Http2Maps) -> None:
     a.stx_mem(BPF_W, R10, R1, _GOSTASH + 8)
 
 
-def _fd_walk(a: Asm) -> None:
-    """Receiver (AX) -> tconn iface data -> net.conn fd -> Sysfd, via
-    the stacked offsets; result (u32, zero-filled on fault) lands in
-    _FDSAVE. Mirrors get_fd_from_http2ClientConn
+def _fd_walk(a: Asm, reg_abi: bool = True) -> None:
+    """Receiver (arg 0) -> tconn iface data -> net.conn fd -> Sysfd,
+    via the stacked offsets; result (u32, zero-filled on fault) lands
+    in _FDSAVE. Mirrors get_fd_from_http2ClientConn
     (go_http2_bpf.c:51-64)."""
-    a.ldx_mem(BPF_DW, R8, R6, _PT_AX)              # receiver
+    _load_arg(a, reg_abi, 0, _PT_AX, R8)           # receiver
     a.ldx_mem(BPF_W, R3, R10, _SCRATCH)
     a.alu_reg(BPF_ADD, R3, R8).alu_imm(BPF_ADD, R3, 8)   # iface data
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOSTASH + 16)
@@ -266,17 +288,19 @@ def _pack_flags_word(a: Asm, flags: int) -> None:
     a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF + 4)
 
 
-def build_header_event(maps: Http2Maps, direction: int) -> Asm:
+def build_header_event(maps: Http2Maps, direction: int,
+                       reg_abi: bool = True) -> Asm:
     """uprobe on writeHeader(name, value string) (go_http2_bpf.c:540):
     one per-header event. Register ABI: receiver AX, name {ptr BX,
-    len CX}, value {ptr DI, len SI}. Name/value copy to FIXED payload
-    offsets with immediate-bounded lengths."""
+    len CX}, value {ptr DI, len SI}; stack ABI: the same five args at
+    SP+8..SP+40. Name/value copy to FIXED payload offsets with
+    immediate-bounded lengths."""
     a = Asm()
-    _prologue(a, maps)
-    _fd_walk(a)
+    _prologue(a, maps, reg_abi)
+    _fd_walk(a, reg_abi)
     _zero_record(a)
     # stream id: *(receiver + stream_off), best-effort (cc.nextID)
-    a.ldx_mem(BPF_DW, R8, R6, _PT_AX)
+    _load_arg(a, reg_abi, 0, _PT_AX, R8)
     a.ldx_mem(BPF_W, R3, R10, _GOSTASH + 8)
     a.jmp_imm(BPF_JEQ, R3, 0, "no_stream")
     a.alu_reg(BPF_ADD, R3, R8)
@@ -285,29 +309,31 @@ def build_header_event(maps: Http2Maps, direction: int) -> Asm:
     a.call(FN_probe_read)
     # cc.nextStreamID is the NEXT (odd) client stream; the one being
     # written is next-2 (go_http2_bpf.c:566-568's `data.stream -= 2`
-    # for go >= 1.16 — regabi gating already implies >= 1.17), so the
-    # header events key under the SAME id the end marker carries
+    # for go >= 1.16 — plan_go_http2 refuses older binaries, so both
+    # ABI flavors here are >= 1.16), so the header events key under
+    # the SAME id the end marker carries
     a.ldx_mem(BPF_W, R1, R10, _REC + _PAYLOAD_OFF)
     a.jmp_imm(BPF_JLT, R1, 2, "no_stream")
     a.alu_imm(BPF_SUB, R1, 2)
     a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF)
     a.label("no_stream")
-    a.ldx_mem(BPF_DW, R8, R6, _PT_CX)              # name len
+    _load_arg(a, reg_abi, 2, _PT_CX, R8)           # name len
     _clamp_reg(a, R8, NAME_CAP, "n")
-    a.ldx_mem(BPF_DW, R9, R6, _PT_SI)              # value len
+    _load_arg(a, reg_abi, 4, _PT_SI, R9)           # value len
     _clamp_reg(a, R9, VALUE_CAP, "v")
     _pack_flags_word(a, 0)
-    # name copy (bounded by the clamp above)
+    # name copy (bounded by the clamp above; the arg load must come
+    # FIRST — stack mode clobbers R1-R3)
+    _load_arg(a, reg_abi, 1, _PT_BX, R3)           # name ptr
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1,
                                _REC + _PAYLOAD_OFF + 8)
     a.mov_reg(R2, R8)
-    a.ldx_mem(BPF_DW, R3, R6, _PT_BX)
     a.call(FN_probe_read)
     # value copy
+    _load_arg(a, reg_abi, 3, _PT_DI, R3)           # value ptr
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1,
                                _REC + _PAYLOAD_OFF + 8 + NAME_CAP)
     a.mov_reg(R2, R9)
-    a.ldx_mem(BPF_DW, R3, R6, _PT_DI)
     a.call(FN_probe_read)
     _emit_event(a, maps, direction)
     a.label("done")
@@ -315,15 +341,16 @@ def build_header_event(maps: Http2Maps, direction: int) -> Asm:
     return a
 
 
-def build_headers_end(maps: Http2Maps, direction: int) -> Asm:
+def build_headers_end(maps: Http2Maps, direction: int,
+                      reg_abi: bool = True) -> Asm:
     """uprobe on writeHeaders(streamID uint32, ...): the end-of-block
     marker (go_http2_bpf.c:600 — MSG_REQUEST_END role). Register ABI:
-    streamID in BX."""
+    streamID in BX; stack ABI: SP+16."""
     a = Asm()
-    _prologue(a, maps)
-    _fd_walk(a)
+    _prologue(a, maps, reg_abi)
+    _fd_walk(a, reg_abi)
     _zero_record(a)
-    a.ldx_mem(BPF_DW, R1, R6, _PT_BX)              # streamID
+    _load_arg(a, reg_abi, 1, _PT_BX, R1)           # streamID
     a.stx_mem(BPF_W, R10, R1, _REC + _PAYLOAD_OFF)
     a.st_imm(BPF_W, R10, _REC + _PAYLOAD_OFF + 4, EV_FLAG_END)
     _emit_event(a, maps, direction)
@@ -332,7 +359,8 @@ def build_headers_end(maps: Http2Maps, direction: int) -> Asm:
     return a
 
 
-def build_process_headers(maps: Http2Maps) -> Asm:
+def build_process_headers(maps: Http2Maps,
+                          reg_abi: bool = True) -> Asm:
     """uprobe on (*http2serverConn).processHeaders(f
     *http2MetaHeadersFrame) — the server-side READ leg
     (go_http2_bpf.c:648-681 + submit_http2_headers:451-496): walk up
@@ -342,14 +370,15 @@ def build_process_headers(maps: Http2Maps) -> Asm:
     offsets use the reference defaults baked above (a per-process
     override would need a second map row; subset documented)."""
     a = Asm()
-    _prologue(a, maps)
-    # frame* = arg 2 (BX, register ABI — the prologue gated on it)
-    a.ldx_mem(BPF_DW, R8, R6, _PT_BX)
+    _prologue(a, maps, reg_abi)
+    # frame* = arg 1 (BX register ABI / SP+16 stack ABI — the
+    # prologue gated on the matching flavor)
+    _load_arg(a, reg_abi, 1, _PT_BX, R8)
     a.stx_mem(BPF_DW, R10, R8, _FRAME)
     # fd via the serverConn.conn walk: override the prologue's
     # client-side tconn offset with the server struct's
     a.st_imm(BPF_W, R10, _SCRATCH, _SRV_CONN_OFF)
-    _fd_walk(a)
+    _fd_walk(a, reg_abi)
     # stream: p = *(frame); stream = *(u32)(p + _FRAME_STREAM_OFF)
     a.ldx_mem(BPF_DW, R3, R10, _FRAME)
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _FIELDSV)
@@ -421,39 +450,53 @@ def build_process_headers(maps: Http2Maps) -> Asm:
 
 
 class Http2Suite:
-    """Loaded program set (all kernel-verifier-checked)."""
+    """Loaded program set (all kernel-verifier-checked): every role in
+    BOTH ABI flavors — register (go >= 1.17) and stack (go < 1.17,
+    args at SP+8k; `<role>_stack` names). The per-process http2_info
+    reg_abi flag gates in-program, so a mixed fleet can share one
+    suite: each probe only fires usefully on processes of its own
+    flavor."""
 
     def __init__(self,
                  shared: Optional[SocketTraceMaps] = None) -> None:
         self.maps = create_http2_maps(shared)
-        loaded: List[Program] = []
+        self._progs: Dict[str, Program] = {}
         try:
-            for builder in (
-                    lambda: build_header_event(self.maps, T_EGRESS),
-                    lambda: build_header_event(self.maps, T_INGRESS),
-                    lambda: build_headers_end(self.maps, T_EGRESS),
-                    lambda: build_headers_end(self.maps, T_INGRESS),
-                    lambda: build_process_headers(self.maps)):
-                loaded.append(load(builder().assemble(),
-                                   prog_type=BPF_PROG_TYPE_KPROBE))
+            for abi_name, reg in (("", True), ("_stack", False)):
+                for role, builder in (
+                        ("header_write",
+                         lambda r: build_header_event(
+                             self.maps, T_EGRESS, r)),
+                        ("header_read",
+                         lambda r: build_header_event(
+                             self.maps, T_INGRESS, r)),
+                        ("end_write",
+                         lambda r: build_headers_end(
+                             self.maps, T_EGRESS, r)),
+                        ("end_read",
+                         lambda r: build_headers_end(
+                             self.maps, T_INGRESS, r)),
+                        ("process_headers",
+                         lambda r: build_process_headers(self.maps, r))):
+                    self._progs[role + abi_name] = load(
+                        builder(reg).assemble(),
+                        prog_type=BPF_PROG_TYPE_KPROBE)
         except OSError:
-            for p in loaded:
+            for p in self._progs.values():
                 p.close()
             self.maps.close()
             raise
         (self.header_write, self.header_read,
          self.end_write, self.end_read,
-         self.process_headers) = loaded
+         self.process_headers) = (self._progs[r] for r in (
+             "header_write", "header_read", "end_write", "end_read",
+             "process_headers"))
 
     def programs(self) -> Dict[str, Program]:
-        return {"header_write": self.header_write,
-                "header_read": self.header_read,
-                "end_write": self.end_write,
-                "end_read": self.end_read,
-                "process_headers": self.process_headers}
+        return dict(self._progs)
 
     def close(self) -> None:
-        for p in self.programs().values():
+        for p in self._progs.values():
             p.close()
         self.maps.close()
 
@@ -503,9 +546,25 @@ HTTP2_SYMBOLS = {
 
 def plan_go_http2(path: str) -> List[UprobeSpec]:
     """Entry-uprobe specs for whichever http2 spellings the binary
-    carries (no RET probes: header events fire at entry)."""
-    if go_version(path) is None:
+    carries (no RET probes: header events fire at entry). Roles carry
+    the `_stack` suffix for stack-ABI (go < 1.17) binaries so the
+    attach loop picks the matching program flavor."""
+    from deepflow_tpu.agent.uprobe_trace import (_go_release,
+                                                 go_register_abi)
+    version = go_version(path)
+    if version is None:
         return []
+    rel = _go_release(version)
+    if rel is not None and rel < (1, 16):
+        # the header-event programs apply the reference's
+        # `nextStreamID - 2` correction, which go_http2_bpf.c:566-568
+        # only applies for go >= 1.16 — on older runtimes it would
+        # mis-key every header group against its end marker and
+        # silently lose all h2 capture; those runtimes predate
+        # mainstream h2 deployment, so they get no probes (loud here,
+        # not silent loss downstream)
+        return []
+    suffix = "" if go_register_abi(version) else "_stack"
     funcs = elf_func_table(path)
     specs: List[UprobeSpec] = []
     for sym, (role, _direction) in HTTP2_SYMBOLS.items():
@@ -514,7 +573,7 @@ def plan_go_http2(path: str) -> List[UprobeSpec]:
         vaddr, _size = funcs[sym]
         off = vaddr_to_offset(path, vaddr)
         if off is not None:
-            specs.append(UprobeSpec(path, sym, off, role))
+            specs.append(UprobeSpec(path, sym, off, role + suffix))
     return specs
 
 

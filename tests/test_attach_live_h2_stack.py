@@ -1,0 +1,177 @@
+"""LIVE stack-ABI (go < 1.17) HTTP/2 uprobe programs: every argument
+read becomes a probe_read of the caller-pushed stack slot at SP+8k.
+A C stand-in reproduces the Go stack calling convention exactly
+(args stored at the caller's rsp so the callee sees them above its
+return address), and the REAL verifier-loaded `*_stack` programs run
+in-kernel against it. The register-flavor programs attached to the
+same sites must stay silent (their in-program reg_abi gate), proving
+a mixed-fleet suite can share one probe set."""
+
+import shutil
+import subprocess
+
+import pytest
+
+from deepflow_tpu.agent import bpf, http2_trace as h2, perf_ring
+from deepflow_tpu.agent import uprobe_trace
+from deepflow_tpu.agent.socket_trace import (SOURCE_GO_HTTP2_UPROBE,
+                                             T_EGRESS, parse_record)
+
+_cc = shutil.which("gcc") or shutil.which("cc")
+_attach_ok, _attach_why = uprobe_trace.attach_available()
+
+pytestmark = [
+    pytest.mark.skipif(not bpf.available(), reason="bpf(2) unavailable"),
+    pytest.mark.skipif(not _attach_ok,
+                       reason=f"uprobe attach masked: {_attach_why}"),
+    pytest.mark.skipif(_cc is None, reason="no C toolchain"),
+]
+
+_DRIVER_C = r"""
+#include <stdio.h>
+#include <string.h>
+
+__attribute__((noinline)) void h2_end_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+__attribute__((noinline)) void h2_header_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+
+struct netfd  { long pad[2]; int sysfd; };
+struct netconn{ struct netfd *fd; };
+struct conn   { void *itab; struct netconn *data; };
+
+static struct netfd  nfd  = { {0, 0}, 33 };
+static struct netconn ncn = { &nfd };
+static struct conn    cn  = { 0, &ncn };
+static char hname[]  = ":path";
+static char hvalue[] = "/api/v2/items";
+
+/* Go stack ABI: the CALLER stores args starting at its rsp; after
+   call pushes the return address the callee sees arg k at SP+8+8k */
+static void call_end_stack(unsigned long stream) {
+  __asm__ volatile(
+    "sub $64, %%rsp\n\t"
+    "mov %0, 0(%%rsp)\n\t"          /* arg0: receiver */
+    "mov %1, 8(%%rsp)\n\t"          /* arg1: streamID */
+    "call h2_end_point\n\t"
+    "add $64, %%rsp\n\t"
+    : : "r"(&cn), "r"(stream) : "memory");
+}
+
+static void call_header_stack(void) {
+  unsigned long nlen = strlen(hname), vlen = strlen(hvalue);
+  __asm__ volatile(
+    "sub $64, %%rsp\n\t"
+    "mov %0, 0(%%rsp)\n\t"          /* receiver */
+    "mov %1, 8(%%rsp)\n\t"          /* name ptr */
+    "mov %2, 16(%%rsp)\n\t"         /* name len */
+    "mov %3, 24(%%rsp)\n\t"         /* value ptr */
+    "mov %4, 32(%%rsp)\n\t"         /* value len */
+    "call h2_header_point\n\t"
+    "add $64, %%rsp\n\t"
+    : : "r"(&cn), "r"(hname), "r"(nlen), "r"(hvalue), "r"(vlen)
+    : "memory");
+}
+
+int main(void) {
+  getchar();                        /* parent pushes http2_info */
+  call_header_stack();
+  call_end_stack(7);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def driver(tmp_path_factory):
+    d = tmp_path_factory.mktemp("h2_stack")
+    (d / "driver.c").write_text(_DRIVER_C)
+    exe = d / "driver"
+    subprocess.run([_cc, "-O1", str(d / "driver.c"), "-o", str(exe)],
+                   check=True)
+    return str(exe)
+
+
+def test_stack_abi_programs_capture_and_register_flavor_stays_silent(
+        driver):
+    suite = h2.Http2Suite()
+    probes = []
+    reader = None
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(suite.maps.events,
+                                               cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        funcs = uprobe_trace.elf_func_table(driver)
+
+        def off(sym):
+            return uprobe_trace.vaddr_to_offset(driver, funcs[sym][0])
+
+        progs = suite.programs()
+        # BOTH flavors on each site: only the stack one may fire for a
+        # reg_abi=False process
+        for role, sym in (("header_write_stack", "h2_header_point"),
+                          ("header_write", "h2_header_point"),
+                          ("end_write_stack", "h2_end_point"),
+                          ("end_write", "h2_end_point")):
+            probes.append(perf_ring.attach_uprobe(
+                progs[role], driver, off(sym), False))
+        tset = shutil.which("taskset")
+        cmd = ([tset, "-c", "0"] if tset else []) + [driver]
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
+        suite.maps.set_info(p.pid, reg_abi=False, tconn_off=0,
+                            fd_off=0, sysfd_off=16, stream_off=0)
+        p.communicate(b"\n", timeout=30)
+        assert p.returncode == 0
+        recs = [parse_record(r) for r in reader.drain()]
+        assert len(recs) == 2, recs          # reg flavor stayed silent
+        assert all(r.source == SOURCE_GO_HTTP2_UPROBE for r in recs)
+        assert all(r.direction == T_EGRESS for r in recs)
+        assert all(r.fd == 33 for r in recs)     # SP-arg receiver walk
+        events = [h2.parse_event(r.payload) for r in recs]
+        headers = [e for e in events if not e[1] & h2.EV_FLAG_END]
+        ends = [e for e in events if e[1] & h2.EV_FLAG_END]
+        assert len(headers) == 1 and len(ends) == 1
+        assert headers[0][2] == b":path"
+        assert headers[0][3] == b"/api/v2/items"
+        assert ends[0][0] == 7                   # streamID from SP+16
+    finally:
+        for pr in probes:
+            pr.close()
+        if reader is not None:
+            reader.close()
+        suite.close()
+
+
+def test_plan_selects_stack_roles_for_old_go(tmp_path):
+    """plan_go_http2 routes a go1.16 binary to the `_stack` programs
+    and a modern binary to the register ones — the role-name contract
+    the attach loop consumes."""
+    import tests.test_uprobe_trace as tu
+
+    path, text_off, half = tu._synthetic_go_elf(
+        tmp_path, version=b"go1.16.15",
+        symbols=(b"net/http.(*http2ClientConn).writeHeader",
+                 b"net/http.(*http2ClientConn).writeHeaders"))
+    specs = h2.plan_go_http2(path)
+    assert {(s.role, s.offset) for s in specs} == {
+        ("header_write_stack", text_off),
+        ("end_write_stack", text_off + half)}
+    d2 = tmp_path / "new"
+    d2.mkdir()
+    path2, _, _ = tu._synthetic_go_elf(
+        d2, version=b"go1.21.0",
+        symbols=(b"net/http.(*http2ClientConn).writeHeader",
+                 b"net/http.(*http2ClientConn).writeHeaders"))
+    assert sorted(s.role for s in h2.plan_go_http2(path2)) == [
+        "end_write", "header_write"]
+    # pre-1.16 runtimes get NO probes: the stream -2 correction the
+    # header programs bake in would mis-key every group there
+    d3 = tmp_path / "ancient"
+    d3.mkdir()
+    path3, _, _ = tu._synthetic_go_elf(
+        d3, version=b"go1.15.8",
+        symbols=(b"net/http.(*http2ClientConn).writeHeader",
+                 b"net/http.(*http2ClientConn).writeHeaders"))
+    assert h2.plan_go_http2(path3) == []
