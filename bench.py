@@ -317,8 +317,8 @@ def main() -> None:
 
     # dictionary-lane wire (models/flow_dict.py): the same record
     # stream SmartEncoded against a device-resident flow table — the
-    # pool's 64Ki tuples cross once as news, every other record is an
-    # 8B hit row, ~halving bytes/record vs the 16B packed lane. The
+    # pool's 64Ki tuples cross once as news, every other record rides
+    # a 6B pairs-packed hits plane vs the 16B packed lane. The
     # packer runs at staging (host-side, untimed, same as pack_lanes);
     # the timed loop replays the wire batches, news included, so the
     # measured bytes/record is what the link actually carries.
@@ -490,7 +490,7 @@ def main() -> None:
             lambda: timed_loop(lane_step, lane_payloads), 16)
 
     # -- timed: e2e dictionary-lane wire -> sketch -------------------------
-    # same records, SmartEncoded wire: ~8.4B/record measured (news
+    # same records, SmartEncoded wire: ~6.4B/record measured (news
     # replayed every iteration included) vs the packed lane's 16 — on a
     # link-bound path the byte ratio IS the expected speedup. Windows
     # carry the same self-consistency check, against the MEASURED
@@ -721,7 +721,7 @@ def main() -> None:
         "h2d_mb_s_fresh": round(h2d_fresh),
         "h2d_mb_s_after_timed_loops": round(h2d_after),
         # self-check carried by the chosen window: the loop's measured
-        # bytes/record (16 for the packed lane, ~8.4 for the dict lane)
+        # bytes/record (16 for the packed lane, ~6.4 for the dict lane)
         # implies a link rate that must sit at-or-below the sustained
         # h2d measured around it; above = the window closed before the
         # device drained and the number is not trustworthy

@@ -244,13 +244,18 @@ SKETCH_LANES_SCHEMA = Schema(
 # Dictionary-lane wire (models/flow_dict.py): SmartEncoding applied to
 # the host->device boundary. A flow's 5-tuple crosses the link ONCE
 # (news: dictionary index + the four lane key words + first packet
-# count, 24B); every later record of that flow is 8B {index, packets}.
-# Flow-log traffic re-reports live flows every window, so steady-state
-# wire cost is the hits row — half the 16B packed-lane row, and bytes
-# per record IS the e2e ceiling on the tunneled link.
+# count, 24B); every later record of that flow rides a PAIRS-PACKED
+# hits plane — two records per three u32 words {idx_a, idx_b,
+# pkts_a | pkts_b << 16} = 6B/record, one transfer per batch.
+# Packet counts saturate at 65535 on this wire; their only sketch
+# consumer (the entropy histogram's bf16 weight planes) saturates
+# there anyway on the MXU path, and CMS/HLL/top-K/row counts never
+# read pkts. Flow-log traffic re-reports live flows every window, so
+# steady-state wire cost is the hits row — 6B vs the 16B packed-lane
+# row, and bytes per record IS the e2e ceiling on the tunneled link.
 SKETCH_HITS_SCHEMA = Schema(
-    name="l4_sketch_hits",
-    columns=(("idx", _U32), ("pkts", _U32)))
+    name="l4_sketch_hits_pairs",
+    columns=(("idx_a", _U32), ("idx_b", _U32), ("pkts_ab", _U32)))
 
 SKETCH_NEWS_SCHEMA = Schema(
     name="l4_sketch_news",

@@ -11,9 +11,13 @@ so the 5-tuple most records carry is redundant on the wire.
 - A flow's first record crosses as a NEWS row: assigned dictionary
   index + the four packed-lane key words + its packet count
   (SKETCH_NEWS_SCHEMA, 24B).
-- Every later record of that flow crosses as a HITS row: index +
-  packet count (SKETCH_HITS_SCHEMA, 8B — half the 16B packed-lane
-  row, an eighth of the 68B full row).
+- Every later record of that flow rides a PAIRS-PACKED hits plane:
+  two records per three u32 words {idx_a, idx_b, pkts_a|pkts_b<<16}
+  (SKETCH_HITS_SCHEMA) — 6B/record, one transfer per batch, vs the
+  16B packed-lane row and the 68B full row. Packet counts saturate
+  at 65535 on this wire; entropy (the only sketch that reads them)
+  saturates per-record weights there on BOTH its update paths, so
+  sketch state stays bit-identical to the packed lane regardless.
 
 The device keeps the key table resident — (4, capacity) uint32, the
 TagDict role with the table living in HBM — scatters news rows into
@@ -45,7 +49,13 @@ from deepflow_tpu.models import flow_suite
 from deepflow_tpu.models.flow_suite import (FlowSuiteConfig,
                                             FlowSuiteState, unpack_lanes)
 
-PKTS_CAP = 0xFFFFFF          # lane proto_pkts packet-count field width
+# ONE saturation point for the whole dict wire (news and hits): u16,
+# the pairs-plane field width. The packed lane's 24-bit cap is wider,
+# but pkts' only sketch consumer (entropy's bf16 weight planes)
+# saturates at 65535 on the MXU path anyway — capping news the same
+# as hits keeps a flow's first record and its repeats on identical
+# semantics (SKETCH_HITS_SCHEMA's comment carries the full argument)
+PKTS_CAP = 0xFFFF
 
 
 class FlowDictState(NamedTuple):
@@ -96,20 +106,34 @@ def update_news(state: FlowSuiteState, dstate: FlowDictState,
     return state, FlowDictState(table=table)
 
 
+def unpack_hits(plane: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(3, H) pairs plane -> (idx, pkts) arrays of 2H records in the
+    packer's original record order: the packer fills the a-lanes
+    completely (records [0, min(n, H))) and spills into the b-lanes
+    ([H, n)), so concatenation restores the stream with its valid
+    records contiguous at [0, n) — the sketch state is bit-identical
+    to an unpacked one-record-per-slot wire, ring admission
+    included."""
+    idx = jnp.concatenate([plane[0], plane[1]]).astype(jnp.int32)
+    pkts = jnp.concatenate([plane[2] & jnp.uint32(0xFFFF),
+                            plane[2] >> jnp.uint32(16)])
+    return idx, pkts
+
+
 def update_hits(state: FlowSuiteState, dstate: FlowDictState,
                 plane: jnp.ndarray, n: jnp.ndarray,
                 cfg: FlowSuiteConfig,
                 mask: jnp.ndarray = None) -> FlowSuiteState:
-    """Apply one (2, B) hits plane: gather each row's key words from
-    the table and advance the sketches exactly as the packed-lane path
-    would for the same records. `mask` (sharded path) overrides the
-    default arange<n validity when the plane is a shard of a larger
-    batch and n indexes the GLOBAL row space."""
-    idx = plane[0].astype(jnp.int32)
-    pkts = plane[1]
+    """Apply one (3, H) pairs-packed hits plane (2H records): gather
+    each record's key words from the table and advance the sketches
+    exactly as the packed-lane path would for the same records.
+    `mask` (sharded path) overrides the default arange<n validity
+    when the plane is a shard of a larger batch and n indexes the
+    GLOBAL row space."""
+    idx, pkts = unpack_hits(plane)
     if mask is None:
-        mask = jnp.arange(plane.shape[1]) < n
-    rows = dstate.table[:, idx]                  # (4, B) gather
+        mask = jnp.arange(2 * plane.shape[1]) < n
+    rows = dstate.table[:, idx]                  # (4, 2H) gather
     lanes = {
         "ip_src": rows[0],
         "ip_dst": rows[1],
@@ -151,6 +175,8 @@ class FlowDictPacker:
             # that the current call has not touched; a dictionary
             # smaller than one wire batch cannot guarantee it
             raise ValueError("capacity must exceed hits_batch")
+        if hits_batch % 2:
+            raise ValueError("hits_batch must be even (pairs planes)")
         self.capacity = capacity
         self.hits_batch = hits_batch
         self.news_batch = news_batch
@@ -200,18 +226,31 @@ class FlowDictPacker:
 
     def _flush_hits(self, out: List[Tuple[str, np.ndarray, int]],
                     partial: bool = False) -> None:
+        """Emit (3, H) PAIRS planes: the a-lanes fill COMPLETELY (records
+        [0, min(count, H))), the b-lanes take the spill ([H, count)) —
+        the device concat then holds its valid records at positions
+        [0, count) exactly, so the standard arange<n mask covers
+        partial planes too. pkts were saturated at PKTS_CAP when
+        buffered (pack())."""
         B = self.hits_batch
         if not self._hit_count:
             return
         idx = np.concatenate(self._hit_idx)
-        pkts = np.concatenate(self._hit_pkts)
+        pkts = np.concatenate(self._hit_pkts)    # PKTS_CAP'd in pack()
         end = len(idx) if partial else (len(idx) // B) * B
         for s in range(0, end, B):
             e = min(s + B, end)
-            plane = np.zeros((2, self._bucket(e - s, B)), np.uint32)
-            plane[0, :e - s] = idx[s:e]
-            plane[1, :e - s] = pkts[s:e]
-            out.append(("hits", plane, e - s))
+            cnt = e - s
+            H = self._bucket((cnt + 1) // 2, B // 2)
+            k = min(cnt, H)
+            plane = np.zeros((3, H), np.uint32)
+            plane[0, :k] = idx[s:s + k]
+            plane[2, :k] = pkts[s:s + k]
+            if cnt > H:
+                m = cnt - H
+                plane[1, :m] = idx[s + H:e]
+                plane[2, :m] |= pkts[s + H:e] << np.uint32(16)
+            out.append(("hits", plane, cnt))
             self.bytes_hits += plane.nbytes
         rest_i, rest_p = idx[end:], pkts[end:]
         self._hit_idx = [rest_i] if len(rest_i) else []

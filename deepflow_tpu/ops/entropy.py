@@ -58,7 +58,14 @@ def update(state: EntropyState, feature_cols: jnp.ndarray,
     if weights is None:
         weights = jnp.ones((n,), dtype=state.hist.dtype)
     else:
-        weights = weights.astype(state.hist.dtype)
+        # saturate EXACTLY like the MXU path: without this, the same
+        # stream produced different histograms depending on batch size
+        # (mxu_hist clips per-record weights at 256**planes - 1, the
+        # scatter-add added them in full), and the dictionary wire's
+        # u16 packet field would diverge from the packed lane on
+        # small batches only. One saturation semantics, both paths.
+        weights = jnp.minimum(weights.astype(state.hist.dtype),
+                              256 ** weight_planes - 1)
     if mask is not None:
         weights = weights * mask.astype(state.hist.dtype)
     flat = (idx + (jnp.arange(f, dtype=jnp.int32) * b)[:, None]).reshape(-1)

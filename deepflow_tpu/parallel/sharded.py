@@ -184,11 +184,17 @@ class ShardedFlowSuite(_ShardedSuiteBase):
             (state_specs, P(axis)))
 
         def local_update_hits(state, dtable, plane, n):
+            # plane is the PAIRS layout (3, H) sharded on its pairs
+            # axis: this shard's a-lanes hold global record positions
+            # [d*hp, (d+1)*hp) and its b-lanes the same offsets past
+            # the global a-half (H_global = hp * n_devices) — validity
+            # is global-position < n
             local = jax.tree.map(lambda x: x[0], state)
             table = _fd.FlowDictState(table=dtable[0])
             d = jax.lax.axis_index(axis)
-            local_b = plane.shape[1]          # per-shard width
-            gmask = (jnp.arange(local_b) + d * local_b) < n
+            hp = plane.shape[1]               # per-shard pairs width
+            pos_a = jnp.arange(hp) + d * hp
+            gmask = jnp.concatenate([pos_a, pos_a + hp * nd]) < n
             local = _fd.update_hits(local, table, plane, n, cfg_,
                                     mask=gmask)
             return jax.tree.map(lambda x: x[None], local)
@@ -241,8 +247,9 @@ class ShardedFlowSuite(_ShardedSuiteBase):
         return self._update_news(state, dtable, plane, jnp.uint32(n))
 
     def update_hits(self, state, dtable, plane, n):
-        """plane (2, B) sharded on the batch axis; n is the GLOBAL
-        valid-row count."""
+        """plane: the (3, H) PAIRS layout (flow_dict.SKETCH_HITS_SCHEMA
+        — idx_a/idx_b/pkts_ab rows, 2H records) sharded on its pairs
+        axis; n is the GLOBAL valid-record count."""
         return self._update_hits(state, dtable, plane, jnp.uint32(n))
 
 

@@ -126,7 +126,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         self.staged = bool(staged)
         # wire="dict" (default): the dictionary lane
         # (models/flow_dict.py) — a flow's tuple crosses the link once,
-        # repeats cross as 8B {index, packets} hit rows against a
+        # repeats cross as 6B pairs-packed hit rows against a
         # device-resident key table (~halving steady-state transfer
         # again vs the packed lane; the sketch state is bit-identical
         # either way). wire="lanes" keeps the stateless 16B packed

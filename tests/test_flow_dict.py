@@ -191,6 +191,25 @@ def test_news_trickle_ships_small_bucketed_planes():
     _assert_additive_state_equal(packed, dicted)
 
 
+def test_pkts_above_u16_still_match_packed_lane():
+    """The pairs wire carries u16 packet counts; entropy — the only
+    sketch that reads pkts — saturates per-record weights at 65535 on
+    BOTH its update paths (ops/entropy.py unified the exact path with
+    the MXU clip), so the dict wire equals the packed lane even for
+    records far above the field width. No pre-capping of the
+    reference: this is the unconditional claim."""
+    pool = _pool(32)
+    pool["packet_tx"] = np.full(32, 200_000, np.uint32)   # > u16
+    pool["packet_rx"] = np.zeros(32, np.uint32)
+    batches = [dict(pool)]
+    packed = _run_packed(batches)
+    dicted, _, _ = _run_dict(batches,
+                             FlowDictPacker(capacity=1024,
+                                            hits_batch=64,
+                                            news_batch=64))
+    _assert_additive_state_equal(packed, dicted)
+
+
 def test_capacity_guards():
     with pytest.raises(ValueError):
         FlowDictPacker(capacity=64, hits_batch=64)

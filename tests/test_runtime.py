@@ -263,6 +263,9 @@ def test_sketch_exporter_dict_wire_matches_lanes_wire():
     from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
 
     rng = np.random.default_rng(17)
+    # packet sums intentionally exceed the dict wire's u16 field:
+    # entropy saturates per-record weights at 65535 on every path, so
+    # the equality must hold regardless
     pool = {name: rng.integers(0, 1 << 16, 512).astype(dt)
             for name, dt in L4_SCHEMA.columns}
     chunks = []
