@@ -9,7 +9,7 @@
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p docs/bench_runs
 LOG=docs/bench_runs/loop.log
-for i in $(seq 1 40); do
+for i in $(seq 1 60); do
   echo "[$(date -u +%H:%M:%S)] attempt $i: probing tunnel" >> "$LOG"
   if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[$(date -u +%H:%M:%S)] probe ok; running full bench" >> "$LOG"
@@ -31,5 +31,5 @@ EOF
     echo "[$(date -u +%H:%M:%S)] target reached; loop done" >> "$LOG"
     break
   fi
-  sleep 1200
+  sleep 480
 done
