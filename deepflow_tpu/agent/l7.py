@@ -82,7 +82,7 @@ def http_body_len(payload: bytes, headers: dict) -> int:
     head_end = payload.find(b"\r\n\r\n")
     body_off = head_end + 4 if head_end >= 0 else len(payload)
     cl = headers.get("content-length", "")
-    if cl.isdigit():
+    if cl.isascii() and cl.isdigit():   # utils.text.parse_int's form
         return int(cl)
     if "chunked" in headers.get("transfer-encoding", "").lower():
         total = 0
@@ -139,7 +139,12 @@ class HttpParser:
         ids = trace_context.extract(headers)
         if payload.startswith(b"HTTP/1.") or \
                 payload.startswith(b"HTTP/2 "):
-            if len(parts) < 2 or not parts[1][:3].isdigit():
+            # isascii() is load-bearing: str.isdigit() accepts Unicode
+            # digits int() rejects (b'\xb3' -> '³'.isdigit() is True),
+            # and a mutated status line must not raise out of parse()
+            # (found by the registry fuzz)
+            if len(parts) < 2 or not (parts[1][:3].isascii()
+                                      and parts[1][:3].isdigit()):
                 return None
             return L7Record(
                 self.proto, MSG_RESPONSE,

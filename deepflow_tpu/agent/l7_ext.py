@@ -22,6 +22,7 @@ from typing import ClassVar, Dict, List, Optional, Tuple
 
 from deepflow_tpu.agent.l7 import (MSG_REQUEST, MSG_RESPONSE, L7Record)
 from deepflow_tpu.agent.sql_obfuscate import obfuscate_sql, sql_verb
+from deepflow_tpu.utils.text import parse_int
 
 L7_HTTP2 = 21
 L7_DUBBO = 40
@@ -449,7 +450,7 @@ class Http2Parser:
             ids = trace_context.extract(hdrs)
             status = hdrs.get(":status")
             if status is not None:
-                code = int(status) if status.isdigit() else 0
+                code = parse_int(status)
                 rec = L7Record(self.proto, MSG_RESPONSE, status=code,
                                resp_len=len(payload), version="2",
                                trace_id=ids["trace_id"],
@@ -1136,7 +1137,7 @@ class OracleParser:
                             resp_len=len(payload))
         if ptype == _TNS_REFUSE:
             reason = self._descriptor_field(payload[8:], b"ERR")
-            code = int(reason) if reason.isdigit() else 1
+            code = parse_int(reason, default=1)
             return L7Record(self.proto, MSG_RESPONSE, status=code,
                             endpoint="REFUSED", resp_len=len(payload))
         if ptype != _TNS_DATA or len(payload) < 11:

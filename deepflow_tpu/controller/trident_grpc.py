@@ -167,8 +167,8 @@ class SynchronizerService:
             if target:
                 ip, _, port = str(target).rpartition(":")
                 c.analyzer_ip = ip or str(target)
-                if port.isdigit():
-                    c.analyzer_port = int(port)
+                if port.isascii() and port.isdigit():
+                    c.analyzer_port = int(port)  # parse_int's form
         # policy push (round-5: reference SyncResponse.flow_acls — a
         # serialized FlowAcls blob + version; the reference agent
         # re-compiles its labeler only when version_acls moves).

@@ -148,8 +148,9 @@ class SkyWalkingAdapter:
                                         refs[0].get("parentSpanId", 0))
         status = 0
         for k in ("http.status_code", "http.status.code"):
-            if tags.get(k, "").isdigit():
-                status = int(tags[k])
+            v = tags.get(k, "")
+            if v.isascii() and v.isdigit():
+                status = int(v)
                 break
         if not status and s.get("isError"):
             status = 500
