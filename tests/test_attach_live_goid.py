@@ -108,6 +108,8 @@ static void call_exit_badg(void) {
 
 static void *run_enter(void *a) { (void)a; call_enter(); return 0; }
 static void *run_exit(void *a)  { (void)a; call_exit();  return 0; }
+static void *run_pair(void *a)  { (void)a; call_enter(); call_exit();
+                                  return 0; }
 
 int main(int argc, char **argv) {
   getchar();   /* parent pushes proc_info for our tgid, then signals */
@@ -120,6 +122,14 @@ int main(int argc, char **argv) {
     /* goid read faults on BOTH sides: with keying enabled the call
        must be DROPPED, never pid_tgid-paired (review r5) */
     call_enter_badg(); call_exit_badg();
+  } else if (strcmp(mode, "chain") == 0) {
+    /* one full call on thread A, another on thread B, same goid:
+       the trace id the first parks must be consumed by the second
+       ACROSS THREADS (TLS-read -> TLS-write chaining's thread shape;
+       the attach layer decides read vs write roles) */
+    pthread_t t;
+    pthread_create(&t, 0, run_pair, 0); pthread_join(t, 0);
+    pthread_create(&t, 0, run_pair, 0); pthread_join(t, 0);
   } else {     /* same thread: the pid_tgid fallback's happy path */
     call_enter(); call_exit();
   }
@@ -147,8 +157,8 @@ def _probe_offsets(exe):
     return offs
 
 
-def _run_pair(exe, mode, goid_off):
-    """Attach go_enter/go_exit_write at the stand-in's probe points,
+def _run_pair(exe, mode, goid_off, exit_role="go_exit_write"):
+    """Attach go_enter/<exit_role> at the stand-in's probe points,
     run the driver in `mode`, return the drained records."""
     suite = uprobe_trace.UprobeSuite()
     probes = []
@@ -164,7 +174,7 @@ def _run_pair(exe, mode, goid_off):
         probes.append(perf_ring.attach_uprobe(
             progs["go_enter"], exe, offs["go_probe_point"], False))
         probes.append(perf_ring.attach_uprobe(
-            progs["go_exit_write"], exe, offs["go_ret_point"], False))
+            progs[exit_role], exe, offs["go_ret_point"], False))
         tset = shutil.which("taskset")
         cmd = ([tset, "-c", "0"] if tset else []) + [exe, mode]
         p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
@@ -212,3 +222,19 @@ def test_faulting_goid_read_drops_call_never_falls_back(driver):
     consume a stale stash from a DIFFERENT call — wrong-payload
     confusion (review r5); loss is the contract instead."""
     assert _run_pair(driver, "faultg", goid_off=152) == []
+
+
+def test_trace_id_chains_across_threads_via_goid_key(driver):
+    """The trace PARK/CONSUME discipline under the goid key, live:
+    two complete TLS-read-shaped calls of the same goroutine on
+    DIFFERENT OS threads, same fd — ingress continuation must hand
+    the second call the id the first parked (socket_trace.c's
+    same-socket continuation, which under pid_tgid keying broke the
+    moment the goroutine migrated)."""
+    recs = _run_pair(driver, "chain", goid_off=152,
+                     exit_role="go_exit_read")
+    assert len(recs) == 2, recs
+    a, b = sorted(recs, key=lambda r: r.timestamp_ns)
+    assert a.tid != b.tid                        # genuinely cross-thread
+    assert a.kernel_trace_id != 0
+    assert b.kernel_trace_id == a.kernel_trace_id
