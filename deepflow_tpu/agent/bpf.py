@@ -87,6 +87,7 @@ FN_ktime_get_ns = 5
 FN_get_current_pid_tgid = 14
 FN_get_current_comm = 16
 FN_perf_event_output = 25
+FN_get_current_task = 35
 # registers
 R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10 = range(11)
 

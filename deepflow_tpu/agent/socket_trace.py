@@ -64,6 +64,7 @@ from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_DW, BPF_SUB,
                                     FN_ktime_get_ns, FN_map_delete_elem,
                                     FN_map_lookup_elem,
                                     FN_map_update_elem,
+                                    FN_get_current_task,
                                     FN_perf_event_output, FN_probe_read,
                                     R0, R1, R2, R3, R4, R5, R6, R7, R8,
                                     R9, R10, Asm, Map, Program, available,
@@ -113,8 +114,13 @@ _TRVAL = -248        # trace-map value {id, fd} (16B)
 
 # proc_info value layout shared with the uprobe suite (ONE map, pushed
 # once per managed Go tgid): {reg_abi, conn_off, fd_off, sysfd_off,
-# goid_off, pad} — the syscall programs read only goid_off (+16)
+# goid_off, fsbase_off} — the syscall programs read reg_abi (+0),
+# goid_off (+16) and fsbase_off (+20: task_struct->thread.fsbase from
+# kernel BTF, the stack-ABI g location %fs:-8; 0 = fs path
+# unavailable)
+_PI_REG_ABI = 0
 _PI_GOID_OFF = 16
+_PI_FSBASE_OFF = 20
 
 
 @dataclass
@@ -132,14 +138,22 @@ class SocketTraceMaps:
 
     def set_proc_info(self, tgid: int, reg_abi: bool, conn_off: int = 0,
                       fd_off: int = 0, sysfd_off: int = 16,
-                      goid_off: int = 0) -> None:
+                      goid_off: int = 0,
+                      fsbase_off: Optional[int] = None) -> None:
         """One row enables goroutine-id trace keying for a tgid in BOTH
-        suites (the uprobe maps alias this map when shared). goid_off
-        is forced 0 for stack-ABI rows — no g register to read."""
+        suites (the uprobe maps alias this map when shared). For
+        stack-ABI rows the programs reach g through %fs:-8 via
+        task->thread.fsbase at `fsbase_off` (default: discovered from
+        kernel BTF; 0 = unavailable, keying falls back to
+        pid_tgid)."""
+        if fsbase_off is None:
+            from deepflow_tpu.agent.btf import fsbase_offset
+            fsbase_off = fsbase_offset() if not reg_abi else 0
         self.proc_info.update_bytes(
             struct.pack("<I", tgid),
             struct.pack("<IIIIII", 1 if reg_abi else 0, conn_off, fd_off,
-                        sysfd_off, goid_off if reg_abi else 0, 0))
+                        sysfd_off, goid_off,
+                        0 if reg_abi else fsbase_off))
 
 
 def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
@@ -169,6 +183,34 @@ def create_maps(ncpus: Optional[int] = None) -> SocketTraceMaps:
     maps.conf.update(0, 1)       # trace ids allocate from 1 (0 = none)
     maps.conf.update(1, 0)
     return maps
+
+
+def emit_fs_g_load(a: Asm, fsbase_slot: int, scratch_slot: int,
+                   fault_label: str) -> None:
+    """Stack-ABI g load: current task -> thread.fsbase (offset in the
+    u32 stack slot `fsbase_slot`, BTF-discovered) -> *(fsbase - 8),
+    i.e. %fs:-8 where pre-1.17 Go keeps g. Leaves g in R3; clobbers
+    R0-R3 and `scratch_slot` (8B). Jumps to `fault_label` on any
+    failed hop — ONE emitter for both suites, like emit_gokey_pack:
+    the syscall and uprobe programs chain only while their g
+    derivation is bit-identical."""
+    a.call(FN_get_current_task)
+    a.ldx_mem(BPF_W, R1, R10, fsbase_slot)
+    a.mov_reg(R3, R0).alu_reg(BPF_ADD, R3, R1)     # &task->thread.fsbase
+    a.st_imm(BPF_DW, R10, scratch_slot, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, scratch_slot)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.jmp_imm(BPF_JNE, R0, 0, fault_label)
+    a.ldx_mem(BPF_DW, R3, R10, scratch_slot)       # fsbase
+    a.jmp_imm(BPF_JEQ, R3, 0, fault_label)
+    a.alu_imm(BPF_SUB, R3, 8)                      # &(%fs:-8) = &g
+    a.st_imm(BPF_DW, R10, scratch_slot, 0)
+    a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, scratch_slot)
+    a.mov_imm(R2, 8)
+    a.call(FN_probe_read)
+    a.jmp_imm(BPF_JNE, R0, 0, fault_label)
+    a.ldx_mem(BPF_DW, R3, R10, scratch_slot)       # g
 
 
 def emit_gokey_pack(a: Asm) -> None:
@@ -243,14 +285,29 @@ def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
     a.jmp_imm(BPF_JEQ, R0, 0, "stash")             # unmanaged: pid_tgid
     a.ldx_mem(BPF_W, R9, R0, _PI_GOID_OFF)
     a.jmp_imm(BPF_JEQ, R9, 0, "stash")             # keying disabled
+    a.ldx_mem(BPF_W, R1, R0, _PI_REG_ABI)
+    a.jmp_imm(BPF_JNE, R1, 0, "g_reg")
+    # stack-ABI Go (< 1.17): g lives at %fs:-8, reached through
+    # task_struct->thread.fsbase at the BTF-discovered offset; 0 means
+    # no BTF on this kernel — keying UNAVAILABLE, pid_tgid fallback
+    # (not a fault: nothing was attempted)
+    a.ldx_mem(BPF_W, R1, R0, _PI_FSBASE_OFF)
+    a.jmp_imm(BPF_JEQ, R1, 0, "stash")
+    a.stx_mem(BPF_W, R10, R1, _PIKEY)              # lookup done: reuse
+    emit_fs_g_load(a, _PIKEY, _GOIDVAL, "drop")    # g -> R3
+    a.jmp_imm(BPF_JEQ, R3, 0, "drop")
+    a.jmp("g_have")
+    a.label("g_reg")
+    # register ABI: g value = inner pt_regs' saved user r14
     a.st_imm(BPF_DW, R10, _GOIDVAL, 0)
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOIDVAL)
     a.mov_imm(R2, 8)
-    a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, 8)      # inner->r14 = g
+    a.mov_reg(R3, R8).alu_imm(BPF_ADD, R3, 8)      # &inner->r14
     a.call(FN_probe_read)
     a.jmp_imm(BPF_JNE, R0, 0, "drop")              # unreadable: drop
     a.ldx_mem(BPF_DW, R3, R10, _GOIDVAL)
     a.jmp_imm(BPF_JEQ, R3, 0, "drop")
+    a.label("g_have")
     a.alu_reg(BPF_ADD, R3, R9)                     # &g.goid
     a.st_imm(BPF_DW, R10, _GOIDVAL, 0)
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOIDVAL)

@@ -58,8 +58,13 @@ the exact loss mode the pid_tgid fallback had. Bit 63 partitions goid
 keys from the syscall suite's pid_tgid keys in the SHARED trace map
 (a pid_tgid's high word is a tgid < 2^22, so its bit 63 is always
 clear; without the partition a syscall park could be consumed by the
-wrong source). Stack ABI keeps pid_tgid keying: pre-1.17 g lives in
-TLS, not a register (userspace pushes goid_off=0). With keying
+wrong source). Stack-ABI (pre-1.17) processes key too: g lives in
+thread-local storage at %fs:-8 there, and the programs reach it as
+*(task->thread.fsbase - 8) with the fsbase offset discovered from the
+kernel's own BTF (agent/btf.py — the reference's kernel-adaption
+offset tables, answered by the kernel itself); a kernel without BTF
+pushes fsbase_off 0 and those processes fall back to pid_tgid keying
+(unavailable, not faulted). With keying
 enabled, a failed in-kernel goid read DROPS that call rather than
 falling back — a fallback would be asymmetric across the enter/exit
 pair and could pair an exit with a different call's stash
@@ -90,7 +95,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_ARSH, BPF_DW,
+from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_ARSH, BPF_DW, BPF_SUB,
                                     BPF_JEQ, BPF_JGT, BPF_JNE, BPF_JSGT,
                                     BPF_JSLE, BPF_LSH,
                                     BPF_MAP_TYPE_LRU_HASH, BPF_OR,
@@ -99,6 +104,7 @@ from deepflow_tpu.agent.bpf import (BPF_ADD, BPF_ARSH, BPF_DW,
                                     FN_get_current_pid_tgid,
                                     FN_map_delete_elem,
                                     FN_map_lookup_elem,
+                                    FN_get_current_task,
                                     FN_map_update_elem, FN_probe_read,
                                     R0, R1, R2, R3, R4, R6, R7, R8, R9,
                                     R10, Asm, Map, Program, available,
@@ -108,6 +114,7 @@ from deepflow_tpu.agent.socket_trace import (PAYLOAD_CAP,
                                              SOURCE_OPENSSL_UPROBE,
                                              SocketTraceMaps, T_EGRESS,
                                              T_INGRESS, create_maps,
+                                             emit_fs_g_load,
                                              emit_gokey_pack,
                                              emit_record_tail)
 from deepflow_tpu.agent.socket_trace import (_FDSAVE, _IOVPAIR,  # noqa
@@ -133,7 +140,8 @@ GO_DEFAULT_INFO = {"reg_abi": 1, "conn_off": 0, "fd_off": 0,
 
 # runtime.g.goid file: 152 bytes of fields precede goid (stack 16,
 # stackguard0/1, _panic, _defer, m, sched gobuf 56, syscallsp/pc,
-# stktopsp, param, atomicstatus+stackLock) from go 1.5 through 1.22;
+# stktopsp, param, atomicstatus+stackLock) from go 1.9 through 1.22
+# (1.5-1.8 carried stkbar stack-barrier fields before goid — refused);
 # 1.23 inserted syscallbp after syscallpc, shifting goid to 160
 # (go_tracer.c's per-version data_members table role)
 GOID_OFF_DEFAULT, GOID_OFF_GO123 = 152, 160
@@ -144,6 +152,7 @@ _GOSTASH = -288      # stash build area {buf, fd, sp} (24B, -288..-265)
 _PIKEY = -296        # u32 tgid key for proc_info lookups
 _PIOFFS = -312       # {conn_off, fd_off, sysfd_off, pad} copy (16B)
 _GOIDVAL = -328      # probe_read target for runtime.g.goid (8B)
+_FSBOFF = -332       # u32 fsbase_off copy (stack-ABI g via %fs:-8)
 _GOIDOFF = -336      # u32 goid_off copy (0 = pid_tgid keying)
 
 
@@ -187,9 +196,10 @@ class UprobeMaps:
 
     def set_proc_info(self, tgid: int, reg_abi: bool, conn_off: int = 0,
                       fd_off: int = 0, sysfd_off: int = 16,
-                      goid_off: int = 0) -> None:
+                      goid_off: int = 0,
+                      fsbase_off: Optional[int] = None) -> None:
         self.shared.set_proc_info(tgid, reg_abi, conn_off, fd_off,
-                                  sysfd_off, goid_off)
+                                  sysfd_off, goid_off, fsbase_off)
 
     def close(self) -> None:
         for m in (self.ssl_ctx, self.go_conn):
@@ -267,11 +277,27 @@ def _goid_rekey(a: Asm) -> None:
     congruent mod 2^32, BOTH with a call in flight — goids are
     monotonic, so that needs ~4 billion goroutine spawns between two
     concurrently-live calls; the LRU maps bound the damage to one
-    wrong pairing even then."""
+    wrong pairing even then.
+
+    g location by ABI (reads _PIOFFS+0/reg_abi and _FSBOFF, which the
+    callers' prologues copy from proc_info): register ABI has g in
+    R14; stack ABI (go < 1.17) keeps it at %fs:-8, reached through
+    task_struct->thread.fsbase at the BTF-discovered offset —
+    fsbase_off 0 (no BTF) keeps the pid_tgid key for stack-ABI
+    processes (keying unavailable, nothing attempted, not a drop)."""
     a.ldx_mem(BPF_W, R1, R10, _GOIDOFF)
     a.jmp_imm(BPF_JEQ, R1, 0, "gokey_done")        # keying disabled
+    a.ldx_mem(BPF_DW, R1, R10, _PIOFFS + 0)        # reg_abi
+    a.jmp_imm(BPF_JNE, R1, 0, "gk_reg")
+    a.ldx_mem(BPF_W, R1, R10, _FSBOFF)
+    a.jmp_imm(BPF_JEQ, R1, 0, "gokey_done")        # no BTF: fallback
+    emit_fs_g_load(a, _FSBOFF, _GOIDVAL, "done")   # g -> R3
+    a.jmp("gk_have")
+    a.label("gk_reg")
     a.ldx_mem(BPF_DW, R3, R6, _PT_R14)             # current g
+    a.label("gk_have")
     a.jmp_imm(BPF_JEQ, R3, 0, "done")              # no g: drop call
+    a.ldx_mem(BPF_W, R1, R10, _GOIDOFF)
     a.alu_reg(BPF_ADD, R3, R1)                     # &g.goid
     a.st_imm(BPF_DW, R10, _GOIDVAL, 0)
     a.mov_reg(R1, R10).alu_imm(BPF_ADD, R1, _GOIDVAL)
@@ -388,6 +414,8 @@ def build_go_tls_enter(maps: UprobeMaps) -> Asm:
     a.stx_mem(BPF_W, R10, R1, _SCRATCH)
     a.ldx_mem(BPF_W, R1, R0, 16)                   # goid_off
     a.stx_mem(BPF_W, R10, R1, _GOIDOFF)
+    a.ldx_mem(BPF_W, R1, R0, 20)                   # fsbase_off
+    a.stx_mem(BPF_W, R10, R1, _FSBOFF)
     _goid_rekey(a)                                 # stash keyed by goid
     a.ldx_mem(BPF_DW, R1, R6, _PT_SP)              # entry sp (exit's
     a.stx_mem(BPF_DW, R10, R1, _GOSTASH + 16)      # stack-ABI ret read)
@@ -464,6 +492,8 @@ def build_go_tls_exit(maps: UprobeMaps, direction: int) -> Asm:
     a.stx_mem(BPF_DW, R10, R1, _PIOFFS + 0)
     a.ldx_mem(BPF_W, R1, R0, 16)                   # goid_off
     a.stx_mem(BPF_W, R10, R1, _GOIDOFF)
+    a.ldx_mem(BPF_W, R1, R0, 20)                   # fsbase_off
+    a.stx_mem(BPF_W, R10, R1, _FSBOFF)
     _goid_rekey(a)                                 # same key the enter built
     a.ld_map_fd(R1, maps.go_conn)
     a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
@@ -711,15 +741,20 @@ def go_register_abi(version: Optional[str]) -> bool:
 
 def go_goid_offset(version: Optional[str]) -> int:
     """Offset of runtime.g.goid for this toolchain version, 0 when
-    keying must be disabled: stack ABI (no g register to read), or an
-    UNPARSEABLE version — a guessed offset on the wrong layout would
-    read atomicstatus/stackLock, collapsing every goroutine onto one
-    key and cross-wiring their stashes, strictly worse than the
-    pid_tgid fallback's bounded loss. The reference resolves this from
-    its per-version data_members table (go_tracer.c:71-175); the
-    layout history is in GOID_OFF_DEFAULT's comment."""
+    keying must be disabled: an UNPARSEABLE version — a guessed offset
+    on the wrong layout would read atomicstatus/stackLock, collapsing
+    every goroutine onto one key and cross-wiring their stashes,
+    strictly worse than the pid_tgid fallback's bounded loss. The
+    152-byte prefix held from go 1.9 through 1.22 (both ABIs — the
+    regabi transition did not reorder runtime.g; 1.5-1.8 carried
+    stack-barrier fields (stkbar/stkbarPos) before goid, so those
+    versions are REFUSED rather than mis-probed), and stack-ABI
+    binaries key too, with g reached via %fs:-8 instead of R14
+    (fsbase_off). The reference resolves this from its per-version
+    data_members table (go_tracer.c:71-175); the layout history is in
+    GOID_OFF_DEFAULT's comment."""
     rel = _go_release(version)
-    if rel is None or rel < (1, 17):
+    if rel is None or rel < (1, 9):
         return 0
     return GOID_OFF_GO123 if rel >= (1, 23) else GOID_OFF_DEFAULT
 

@@ -1,0 +1,233 @@
+"""LIVE stack-ABI (pre-1.17 Go) goroutine keying: g at %fs:-8,
+reached in-kernel as *(task->thread.fsbase - 8) with the fsbase
+offset discovered from the kernel's own BTF (agent/btf.py).
+
+The stand-in reproduces the pre-1.17 Go execution environment
+exactly: a fake TCB installed with arch_prctl(ARCH_SET_FS) — which is
+precisely what updates task->thread.fsbase, the field the programs
+probe — with the fake g pointer planted at base-8, and Go stack-ABI
+call frames (args above the return address). Between SET_FS and the
+restore the code is pure asm: libc is unusable while fs points at the
+fake TCB.
+
+Proofs: (same) the full fs -> g -> goid chain works in-kernel — under
+the drop-on-fault discipline a record can only exist if every hop
+succeeded; (cross) the stash parks under the goid key and a DIFFERENT
+OS thread with the same fake g consumes it — pid_tgid keying cannot
+produce this record."""
+
+import shutil
+import subprocess
+
+import pytest
+
+from deepflow_tpu.agent import bpf, btf, perf_ring, uprobe_trace
+from deepflow_tpu.agent.socket_trace import (SOURCE_GO_TLS_UPROBE,
+                                             T_EGRESS, parse_record)
+
+_cc = shutil.which("gcc") or shutil.which("cc")
+_attach_ok, _attach_why = uprobe_trace.attach_available()
+_fsbase = btf.fsbase_offset()
+
+pytestmark = [
+    pytest.mark.skipif(not bpf.available(), reason="bpf(2) unavailable"),
+    pytest.mark.skipif(not _attach_ok,
+                       reason=f"uprobe attach masked: {_attach_why}"),
+    pytest.mark.skipif(_cc is None, reason="no C toolchain"),
+    pytest.mark.skipif(_fsbase == 0, reason="no kernel BTF"),
+]
+
+_DRIVER_C = r"""
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#define ARCH_SET_FS 0x1002
+#define ARCH_GET_FS 0x1003
+
+__attribute__((noinline)) void go_probe_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+__attribute__((noinline)) void go_ret_point(void)
+  { __asm__ volatile("" ::: "memory"); }
+
+struct netfd  { long pad[2]; int sysfd; };
+struct netconn{ struct netfd *fd; };
+struct conn   { void *itab; struct netconn *data; };
+struct fakeg  { char pad[152]; unsigned long long goid; };
+
+static struct netfd  nfd  = { {0, 0}, 55 };
+static struct netconn ncn = { &nfd };
+static struct conn    cn  = { 0, &ncn };
+static struct fakeg   g   = { {0}, 424242 };
+static char req[] = "GET /fsgoid HTTP/1.1\r\nHost: old-go\r\n\r\n";
+
+/* fake TCB: fs base points INTO this buffer; the g pointer lives at
+   base-8, exactly where pre-1.17 Go keeps it */
+static unsigned long fake_tls[64];
+#define FAKE_BASE ((unsigned long)&fake_tls[32])
+
+static int pa[2], pb[2];               /* A->main, main->B sync */
+
+/* enter with a Go STACK-ABI frame under a hijacked fs. Keeps the
+   frame alive (rsp stays displaced) until `teardown` runs, so a
+   cross-thread exit can still read the stashed entry-sp slots. Pure
+   asm between SET_FS and the restore — libc has no TLS there. */
+static unsigned long saved_fs;
+
+static void fs_enter_keep_frame(void) {
+  syscall(SYS_arch_prctl, ARCH_GET_FS, &saved_fs);
+  long n = (long)strlen(req);
+  __asm__ volatile(
+    "mov $158, %%eax\n\t"
+    "mov $0x1002, %%edi\n\t"
+    "mov %[base], %%rsi\n\t"
+    "syscall\n\t"                      /* fs -> fake TCB */
+    "sub $64, %%rsp\n\t"
+    "mov %[conn], 0(%%rsp)\n\t"        /* callee sp+8: receiver */
+    "mov %[buf],  8(%%rsp)\n\t"        /* callee sp+16: slice ptr */
+    "mov %[n],   32(%%rsp)\n\t"        /* callee sp+40: ret value */
+    "call go_probe_point\n\t"
+    "add $64, %%rsp\n\t"
+    "mov $158, %%eax\n\t"
+    "mov $0x1002, %%edi\n\t"
+    "mov %[old], %%rsi\n\t"
+    "syscall\n\t"                      /* fs restored: libc ok again */
+    : : [base] "r" (FAKE_BASE), [conn] "r" (&cn), [buf] "r" (req),
+        [n] "r" (n), [old] "r" (saved_fs)
+    : "rax", "rdi", "rsi", "rcx", "r11", "memory");
+}
+/* NOTE: the frame is popped before return — the stash captured the
+   entry SP and the values STAY in memory below our live rsp; nothing
+   on this thread writes there while it blocks in read(2), so a
+   cross-thread exit can still probe_read them. */
+
+static void fs_exit(void) {
+  unsigned long old;
+  syscall(SYS_arch_prctl, ARCH_GET_FS, &old);
+  __asm__ volatile(
+    "mov $158, %%eax\n\t"
+    "mov $0x1002, %%edi\n\t"
+    "mov %[base], %%rsi\n\t"
+    "syscall\n\t"
+    "call go_ret_point\n\t"
+    "mov $158, %%eax\n\t"
+    "mov $0x1002, %%edi\n\t"
+    "mov %[old], %%rsi\n\t"
+    "syscall\n\t"
+    : : [base] "r" (FAKE_BASE), [old] "r" (old)
+    : "rax", "rdi", "rsi", "rcx", "r11", "memory");
+}
+
+static void *thread_a(void *arg) {
+  char c;
+  fs_enter_keep_frame();
+  (void)!write(pa[1], "a", 1);         /* enter parked; signal */
+  (void)!read(pb[0], &c, 1);           /* block until B consumed */
+  return arg;
+}
+
+static void *thread_b(void *arg) { fs_exit(); return arg; }
+
+int main(int argc, char **argv) {
+  *(void **)(FAKE_BASE - 8) = (void *)&g;     /* g at %fs:-8 */
+  getchar();                           /* parent pushes proc_info */
+  if (argc > 1 && strcmp(argv[1], "cross") == 0) {
+    char c;
+    pthread_t a, b;
+    if (pipe(pa) || pipe(pb)) return 2;
+    pthread_create(&a, 0, thread_a, 0);
+    if (read(pa[0], &c, 1) != 1) return 3;
+    pthread_create(&b, 0, thread_b, 0);
+    pthread_join(b, 0);
+    (void)!write(pb[1], "b", 1);
+    pthread_join(a, 0);
+  } else {                             /* same thread */
+    fs_enter_keep_frame();
+    fs_exit();
+  }
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def driver(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fs_goid")
+    (d / "driver.c").write_text(_DRIVER_C)
+    exe = d / "driver"
+    subprocess.run([_cc, "-O1", "-pthread", str(d / "driver.c"),
+                    "-o", str(exe)], check=True)
+    return str(exe)
+
+
+def _run(exe, mode, fsbase_off):
+    suite = uprobe_trace.UprobeSuite()
+    probes = []
+    reader = None
+    try:
+        try:
+            reader = perf_ring.BpfOutputReader(suite.maps.events,
+                                               cpus=[0])
+        except OSError as e:
+            pytest.skip(f"perf ring refused: {e}")
+        funcs = uprobe_trace.elf_func_table(exe)
+
+        def off(sym):
+            return uprobe_trace.vaddr_to_offset(exe, funcs[sym][0])
+
+        progs = suite.programs()
+        probes.append(perf_ring.attach_uprobe(
+            progs["go_enter"], exe, off("go_probe_point"), False))
+        probes.append(perf_ring.attach_uprobe(
+            progs["go_exit_write"], exe, off("go_ret_point"), False))
+        tset = shutil.which("taskset")
+        cmd = ([tset, "-c", "0"] if tset else []) + [exe, mode]
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
+        suite.maps.set_proc_info(
+            p.pid, reg_abi=False, goid_off=152, fsbase_off=fsbase_off,
+            **{k: uprobe_trace.GO_DEFAULT_INFO[k]
+               for k in ("conn_off", "fd_off", "sysfd_off")})
+        p.communicate(b"\n", timeout=30)
+        assert p.returncode == 0
+        return [parse_record(r) for r in reader.drain()]
+    finally:
+        for pr in probes:
+            pr.close()
+        if reader is not None:
+            reader.close()
+        suite.close()
+
+
+def test_fs_goid_chain_works_same_thread(driver):
+    """Record exists => every hop succeeded in-kernel: task ->
+    thread.fsbase (BTF offset) -> %fs:-8 -> g -> goid, plus the
+    stack-ABI arg frame (receiver/slice from SP slots, ret from the
+    stashed entry SP + 40)."""
+    recs = _run(driver, "same", _fsbase)
+    assert len(recs) == 1, recs
+    r = recs[0]
+    assert r.source == SOURCE_GO_TLS_UPROBE
+    assert r.direction == T_EGRESS
+    assert r.payload.startswith(b"GET /fsgoid")
+    assert r.fd == 55                  # SP-frame receiver walked
+    assert r.from_kernel
+
+
+def test_fs_goid_keys_across_threads(driver):
+    """Enter on thread A, exit on thread B, same fake g through two
+    separately-hijacked fs bases: only the goid key (tgid | goid
+    424242) can pair them — pid_tgid differs per thread."""
+    recs = _run(driver, "cross", _fsbase)
+    assert len(recs) == 1, recs
+    assert recs[0].payload.startswith(b"GET /fsgoid")
+    assert recs[0].fd == 55
+
+
+def test_no_btf_offset_disables_fs_keying_loudly(driver):
+    """fsbase_off 0 (a kernel without BTF): keying is UNAVAILABLE and
+    the programs fall back to pid_tgid — same-thread still records,
+    cross-thread loses the pair (bounded loss, never confusion)."""
+    assert len(_run(driver, "same", 0)) == 1
+    assert _run(driver, "cross", 0) == []

@@ -1,0 +1,48 @@
+"""Kernel-BTF reader (agent/btf.py): the kernel's own type
+descriptions answer the task_struct layout question the reference
+solves with per-kernel offset tables (ebpf/user/offset.c)."""
+
+import os
+import struct
+
+import pytest
+
+from deepflow_tpu.agent import btf
+
+
+def test_live_kernel_fsbase_offset():
+    if not os.path.exists(btf.BTF_PATH):
+        pytest.skip("no kernel BTF")
+    off = btf.fsbase_offset()
+    # plausibility: nonzero, 8-aligned, inside task_struct (< 64KiB)
+    assert off > 0 and off % 8 == 0 and off < 1 << 16
+    b = btf.Btf(open(btf.BTF_PATH, "rb").read())
+    thread = b.member_offset("task_struct", "thread")
+    fsbase = b.member_offset("thread_struct", "fsbase")
+    assert off == thread + fsbase
+    # thread_struct is conventionally LAST in task_struct
+    assert thread > 1000
+    # a known-early member for sanity
+    pid = b.member_offset("task_struct", "pid")
+    assert pid is not None and 0 < pid < thread
+
+
+def test_reader_rejects_garbage_and_misses_cleanly(tmp_path):
+    with pytest.raises(ValueError):
+        btf.Btf(b"\x00" * 64)
+    # a syntactically-valid empty BTF: header only, no types
+    hdr = struct.pack("<HBBIIIII", 0xEB9F, 1, 0, 24, 0, 0, 0, 1)
+    empty = btf.Btf(hdr + b"\x00")
+    assert empty.member_offset("task_struct", "thread") is None
+    p = tmp_path / "missing"
+    assert btf.fsbase_offset(str(p)) == 0          # no file -> disabled
+    p.write_bytes(b"junk")
+    assert btf.fsbase_offset(str(p)) == 0          # garbage -> disabled
+
+
+def test_fsbase_offset_is_cached():
+    if not os.path.exists(btf.BTF_PATH):
+        pytest.skip("no kernel BTF")
+    a = btf.fsbase_offset()
+    assert btf.BTF_PATH in btf._CACHE
+    assert btf.fsbase_offset() == a

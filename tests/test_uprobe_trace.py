@@ -68,11 +68,14 @@ def test_suite_shares_trace_map_with_socket_trace():
 
 @_bpf_required
 def test_proc_info_map_layout():
-    """The {reg_abi, conn_off, fd_off, sysfd_off, goid_off} cell the
-    Go programs read at fixed offsets, written through the userspace
-    setter; goid_off is forced 0 for stack-ABI rows (no g register for
-    the program to read — pushing a nonzero offset there would key the
-    stash by garbage probe_reads)."""
+    """The {reg_abi, conn_off, fd_off, sysfd_off, goid_off,
+    fsbase_off} cell the Go programs read at fixed offsets, written
+    through the userspace setter. A register-ABI row carries no
+    fsbase (g is in R14); a stack-ABI row carries the BTF-discovered
+    task->thread.fsbase offset so the programs can reach g at %fs:-8
+    (0 when the kernel has no BTF — keying falls back to
+    pid_tgid)."""
+    from deepflow_tpu.agent import btf
     maps = uprobe_trace.create_uprobe_maps()
     try:
         maps.set_proc_info(4242, reg_abi=True, conn_off=0, fd_off=0,
@@ -85,7 +88,14 @@ def test_proc_info_map_layout():
         got = struct.unpack(
             "<IIIIII",
             maps.proc_info.lookup_bytes(struct.pack("<I", 4243)))
-        assert got[0] == 0 and got[4] == 0
+        assert got[0] == 0 and got[4] == 152
+        assert got[5] == btf.fsbase_offset()
+        maps.set_proc_info(4244, reg_abi=False, goid_off=152,
+                           fsbase_off=0)        # explicit: no BTF
+        got = struct.unpack(
+            "<IIIIII",
+            maps.proc_info.lookup_bytes(struct.pack("<I", 4244)))
+        assert got[5] == 0
     finally:
         maps.close()
 
@@ -98,7 +108,14 @@ def test_goid_offset_version_table():
     assert uprobe_trace.go_goid_offset("go1.22.0") == 152
     assert uprobe_trace.go_goid_offset("go1.23.1") == 160
     assert uprobe_trace.go_goid_offset("go1.24.0") == 160
-    assert uprobe_trace.go_goid_offset("go1.16.9") == 0
+    # stack-ABI versions key too (g via %fs:-8); the 152-byte prefix
+    # held from 1.9 through 1.22 across the regabi transition —
+    # 1.5-1.8 laid stkbar fields before goid and are REFUSED (a 152
+    # probe there reads a slice header as the key)
+    assert uprobe_trace.go_goid_offset("go1.16.9") == 152
+    assert uprobe_trace.go_goid_offset("go1.9.0") == 152
+    assert uprobe_trace.go_goid_offset("go1.8.7") == 0
+    assert uprobe_trace.go_goid_offset("go1.5.0") == 0
     # prerelease suffixes must parse (go1.23rc1 on the 152 guess would
     # read atomicstatus — every goroutine one key); unparseable
     # versions must DISABLE keying, not guess a layout
