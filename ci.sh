@@ -22,10 +22,12 @@ echo "== kernel capability probes =="
 # verdict r4 #8: every CI log states which datapath mode ran — live
 # kernel attach (PMU visible) or verifier-load + replay (masked)
 python - <<'EOF'
-from deepflow_tpu.agent import bpf, socket_trace, uprobe_trace
+from deepflow_tpu.agent import bpf, btf, socket_trace, uprobe_trace
 print("bpf(2):", bpf.available())
 print("kprobe attach:", socket_trace.attach_available())
 print("uprobe attach:", uprobe_trace.attach_available())
+print("kernel BTF (stack-ABI goid keying):",
+      btf.fsbase_offset() or "unavailable")
 EOF
 
 echo "== pytest =="
