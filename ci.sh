@@ -33,6 +33,56 @@ EOF
 echo "== pytest =="
 python -m pytest tests/ -q
 
+echo "== prometheus exposition smoke =="
+# flight recorder + /metrics listener against a live ingester: the
+# text exposition format is a contract with real scrapers, so the
+# strict checker failing ANY line fails CI (ISSUE 1 observability)
+python - <<'EOF'
+import socket, time, urllib.request
+import numpy as np
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.runtime.tracing import default_tracer
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+ing = Ingester(IngesterConfig(listen_port=0, prom_port=0,
+                              tpu_sketch_window_s=0.2),
+               platform=PlatformDataManager())
+ing.start()
+r = np.random.default_rng(0)
+cols = {name: (r.integers(-100, 100, 1000).astype(dt)
+               if np.dtype(dt) == np.int32
+               else r.integers(0, 1 << 20, 1000).astype(dt))
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    for _ in range(4):
+        s.sendall(frame)
+needed = {"receiver", "decode", "export", "kernel", "window"}
+deadline = time.time() + 60
+while time.time() < deadline:
+    if needed <= set(default_tracer().latency()):
+        break
+    time.sleep(0.2)
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing.prom_port}/metrics", timeout=10) as resp:
+    text = resp.read().decode()
+ing.close()
+problems = validate_exposition(text)
+assert not problems, problems[:10]
+missing = needed - set(default_tracer().latency())
+assert not missing, f"stages never recorded: {missing}"
+for stage in needed:
+    assert f'stage="{stage}"' in text, f"{stage} absent from exposition"
+print("exposition OK:", len(text.splitlines()), "lines,",
+      len(default_tracer().latency()), "stages")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax
@@ -88,7 +138,14 @@ import json
 d = json.load(open("/tmp/bench_smoke.json"))
 assert d["value"] > 0 and d["topk_recall_vs_exact"] >= 0.99, d
 assert d["lane_windows"] and d["headline_window"] is not None
-print("bench smoke OK:", d["value"], "rec/s (CPU small)")
+# per-lane transfer/kernel attribution must always be present and
+# non-zero for BOTH wire lanes (the dict-lane chip measurement)
+for lane in ("packed", "dict"):
+    sb = d["stage_breakdown"][lane]
+    assert sb["h2d_mb_s"] > 0 and sb["kernel_records_per_sec"] > 0, sb
+print("bench smoke OK:", d["value"], "rec/s (CPU small),",
+      "dict kernel", d["stage_breakdown"]["dict"]["kernel_records_per_sec"],
+      "rec/s")
 PYEOF
 fi
 

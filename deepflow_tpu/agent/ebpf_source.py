@@ -174,6 +174,15 @@ class EbpfTracer:
         self.io_events_dropped = 0
         self._IO_EVENTS_CAP = 4096
         self._fd_path_cache: Dict[Tuple[int, int], tuple] = {}
+        # /proc fd-class gate arming: zero ip tuples only mean "proven
+        # non-socket" when a resolver actually ran over the record's fd
+        # (the live perf-ring drain path, feed_raw(resolver=...)). A
+        # replay/fixture feed never resolves, so its zero tuples are
+        # AMBIGUOUS — classifying them against this machine's
+        # /proc/<pid>/fd would let a pid collision with a live local
+        # process swallow an L7 session as a spurious IO event
+        # (ADVICE r5). False until a resolver is seen.
+        self._fd_class_active = False
         self.sessions = SessionAggregator()
         # trace map: (pid, coroutine|tid) -> (parked trace id, socket
         # key, direction); id 0 = the client-only zero marker
@@ -254,8 +263,12 @@ class EbpfTracer:
         """One kernel SOCK_DATA record (the in-tree socket_trace
         program suite's perf output, agent/socket_trace.py) through the
         same pipeline the fixture replay uses — the two sources are
-        interchangeable at this boundary."""
+        interchangeable at this boundary. A non-None resolver arms the
+        IO-event fd-class gate: from here on, a zero ip tuple means the
+        resolver genuinely failed to find a socket."""
         from deepflow_tpu.agent.socket_trace import parse_record
+        if resolver is not None:
+            self._fd_class_active = True
         return self.feed(parse_record(buf, resolver=resolver))
 
     def feed(self, rec: SyscallRecord) -> Optional[bytes]:
@@ -267,6 +280,7 @@ class EbpfTracer:
         from deepflow_tpu.agent.socket_trace import (SOURCE_SYSCALL,
                                                      SOURCE_GO_HTTP2_UPROBE)
         if (self.io_event_collect_mode and rec.latency_ns
+                and self._fd_class_active
                 and rec.source == SOURCE_SYSCALL
                 and rec.ip_src == 0 and rec.ip_dst == 0
                 and rec.latency_ns >= self.io_event_minimal_duration_ns
@@ -352,21 +366,34 @@ class EbpfTracer:
         Probabilistic and bounded by the drain latency — documented,
         not hidden. A short-TTL cache keeps a sustained slow-IO
         stream (fsync-heavy logger) from paying one /proc readlink
-        per record on the drain hot path."""
+        per record on the drain hot path. Positive entries (a real
+        path) expire faster than negative ones: a cached PATH that
+        outlives an fd close/reopen mislabels the next event's
+        filename, so its staleness window stays near the drain
+        latency, while "not a file" verdicts (sockets held open for
+        whole sessions) can afford the longer TTL. At the cap the
+        OLDEST entries evict first — a wholesale clear would drop
+        every hot entry at once and pay a readlink burst to rebuild
+        (ADVICE r5)."""
         import os as _os
         import time as _time
         now = _time.monotonic()
-        got = self._fd_path_cache.get((pid, fd))
-        if got is not None and now - got[1] < 3.0:
+        cache = self._fd_path_cache
+        got = cache.get((pid, fd))
+        if got is not None and now - got[1] < \
+                (1.0 if got[0] is not None else 3.0):
             return got[0]
         try:
             path = _os.readlink(f"/proc/{pid}/fd/{fd}")
             result = path if path.startswith("/") else None
         except OSError:
             result = None
-        if len(self._fd_path_cache) > 4096:
-            self._fd_path_cache.clear()
-        self._fd_path_cache[(pid, fd)] = (result, now)
+        # pop-then-insert keeps dict order ≈ recency, so the eviction
+        # loop below prunes the stalest entries, not arbitrary ones
+        cache.pop((pid, fd), None)
+        cache[(pid, fd)] = (result, now)
+        while len(cache) > 4096:
+            cache.pop(next(iter(cache)))
         return result
 
     def _emit_io_event(self, rec: SyscallRecord, path: str) -> None:

@@ -324,7 +324,18 @@ def build_enter(maps: SocketTraceMaps, is_msg: bool) -> Asm:
     a.mov_reg(R3, R10).alu_imm(BPF_ADD, R3, -56)
     a.mov_imm(R4, 0)                               # BPF_ANY
     a.call(FN_map_update_elem)
+    a.exit_imm(0)
+    # goid-read fault: this call is DROPPED, and any stale stash under
+    # this pid_tgid must go with it — a missed kretprobe (maxactive
+    # exhaustion) leaves the previous call's entry behind, and without
+    # this delete THIS call's exit would pair with that stale stash
+    # (wrong buf pointer, wrong enter_ts latency) instead of being
+    # dropped (ADVICE r5). _KEY still holds pid_tgid: nothing on the
+    # goid path writes it, and map helpers clobber only R0-R5.
     a.label("drop")
+    a.ld_map_fd(R1, maps.active)
+    a.mov_reg(R2, R10).alu_imm(BPF_ADD, R2, _KEY)
+    a.call(FN_map_delete_elem)
     a.exit_imm(0)
     return a
 

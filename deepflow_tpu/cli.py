@@ -330,20 +330,76 @@ def cmd_query(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    """Expand an L7 trace from one row id (the L7FlowTracing role):
-    app trace ids + eBPF syscall ids + x-request ids, no
-    instrumentation required."""
-    out = _http(f"{args.querier}/v1/l7_tracing?_id={args.id}")
-    rows = [[s["attributes"].get("_id", "-"),
-             s["operationName"] or "-",
-             s["attributes"].get("ip.src", "-"),
-             s["attributes"].get("ip.dst", "-"),
-             s["attributes"].get("syscall_trace_id.request", "-"),
-             s["attributes"].get("syscall_trace_id.response", "-"),
-             s["durationNanos"] // 1000]
-            for s in out["spans"]]
-    _table(rows, ["_ID", "OPERATION", "SRC", "DST", "SYSCALL_REQ",
-                  "SYSCALL_RESP", "DUR_US"])
+    """The trace family. `expand` (default with --id) assembles an L7
+    trace from one row id (the L7FlowTracing role). `latency`, `spans`
+    and `rrt` read the ingester's flight recorder over the UDP debug
+    protocol: per-stage latency quantiles, recent slow-batch spans, and
+    TPU transfer/kernel attribution."""
+    if args.action == "expand":
+        if args.id is None:
+            print("trace expand requires --id <l7_flow_log row _id>",
+                  file=sys.stderr)
+            return 2
+        out = _http(f"{args.querier}/v1/l7_tracing?_id={args.id}")
+        rows = [[s["attributes"].get("_id", "-"),
+                 s["operationName"] or "-",
+                 s["attributes"].get("ip.src", "-"),
+                 s["attributes"].get("ip.dst", "-"),
+                 s["attributes"].get("syscall_trace_id.request", "-"),
+                 s["attributes"].get("syscall_trace_id.response", "-"),
+                 s["durationNanos"] // 1000]
+                for s in out["spans"]]
+        _table(rows, ["_ID", "OPERATION", "SRC", "DST", "SYSCALL_REQ",
+                      "SYSCALL_RESP", "DUR_US"])
+        return 0
+    port = args.debug_port or DEFAULT_DEBUG_PORT
+    if args.action == "latency":
+        out = debug_request("latency", port=port,
+                            **({"module": args.stage} if args.stage
+                               else {}))
+        if not out.get("ok"):
+            print(f"error: {out.get('error')}", file=sys.stderr)
+            return 1
+        data = out["data"]
+        if not data.get("enabled"):
+            print("tracing disabled on this ingester "
+                  "(IngesterConfig.trace_enabled)", file=sys.stderr)
+        _table([[st, v["count"], round(v["p50_ms"], 3),
+                 round(v["p95_ms"], 3), round(v["p99_ms"], 3),
+                 round(v["max_ms"], 3), round(v["mean_ms"], 3)]
+                for st, v in sorted(data["stages"].items())],
+               ["STAGE", "COUNT", "P50_MS", "P95_MS", "P99_MS",
+                "MAX_MS", "MEAN_MS"])
+        return 0
+    if args.action == "spans":
+        req = {"count": args.count}
+        if args.stage:
+            req["stage"] = args.stage
+        if args.slow_ms is not None:
+            req["slow_ms"] = args.slow_ms
+        out = debug_request("spans", port=port, **req)
+        if not out.get("ok"):
+            print(f"error: {out.get('error')}", file=sys.stderr)
+            return 1
+        import time as _time
+        _table([[_time.strftime("%H:%M:%S", _time.localtime(s["ts"])),
+                 s["stage"], s["stream"] or "-", s["batch_id"],
+                 round(s["dur_ms"], 3), s["rows"]]
+                for s in out["data"]["spans"]],
+               ["AT", "STAGE", "STREAM", "BATCH", "DUR_MS", "ROWS"])
+        return 0
+    # rrt: TPU transfer/kernel attribution
+    out = debug_request("rrt", port=port)
+    if not out.get("ok"):
+        print(f"error: {out.get('error')}", file=sys.stderr)
+        return 1
+    data = out["data"]
+    _table([[st, v["count"], round(v["p50_ms"], 3), round(v["p99_ms"], 3),
+             round(v["mean_ms"], 3)]
+            for st, v in sorted(data["kernel_stages"].items())],
+           ["KERNEL_STAGE", "COUNT", "P50_MS", "P99_MS", "MEAN_MS"])
+    for name, value in sorted(data["gauges"].items()):
+        print(f"{name} = {round(value, 3)}")
     return 0
 
 
@@ -551,10 +607,22 @@ def build_parser() -> argparse.ArgumentParser:
     cp.set_defaults(fn=cmd_capture)
 
     tr = sub.add_parser("trace",
-                        help="assemble an l7 trace from one row "
-                             "(syscall/app/x-request correlation)")
-    tr.add_argument("--id", type=int, required=True,
-                    help="seed l7_flow_log row _id")
+                        help="l7 trace expansion + the ingester flight "
+                             "recorder (latency/spans/rrt)")
+    tr.add_argument("action", nargs="?", default="expand",
+                    choices=["expand", "latency", "spans", "rrt"],
+                    help="expand = assemble an l7 trace from --id; "
+                         "latency = per-stage p50/p95/p99 tables; "
+                         "spans = recent (slow) batch spans; "
+                         "rrt = TPU transfer/kernel attribution")
+    tr.add_argument("--id", type=int, default=None,
+                    help="seed l7_flow_log row _id (expand)")
+    tr.add_argument("--stage", help="stage filter (latency prefix / "
+                                    "spans exact)")
+    tr.add_argument("--count", type=int, default=20,
+                    help="spans: max spans to list")
+    tr.add_argument("--slow-ms", type=float, default=None,
+                    help="spans: only spans slower than this")
     tr.set_defaults(fn=cmd_trace)
 
     rp = sub.add_parser("replay-pcap",

@@ -146,11 +146,14 @@ def update_hits(state: FlowSuiteState, dstate: FlowDictState,
 class FlowDictPacker:
     """Host side: streaming records -> ordered news/hits wire batches.
 
-    Correctness rests on two ordering rules the consumer must follow
-    (and `apply_batches` encodes): batches apply in emission order,
-    and within one `pack()` call every news batch is emitted before
-    any hits batch — a hit may reference an index its own call's news
-    assigned.
+    Correctness rests on ONE consumer rule (and `apply_batches`
+    encodes it): batches apply strictly in emission order. Within one
+    `pack()` call, the call's OWN hit rows are buffered/emitted only
+    after its news batches (a hit may reference an index its own
+    call's news assigned) — but hits PRE-DRAINED from earlier calls
+    (the eviction-safety flush below) may legitimately precede this
+    call's news in the emitted stream, so grouping batches by kind
+    instead of preserving emission order is incorrect.
 
     Index reuse after eviction is made safe by the PRE-DRAIN in
     pack(): eviction can only happen once the dictionary is full,

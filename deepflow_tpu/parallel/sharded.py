@@ -48,6 +48,14 @@ try:  # jax >= 0.4.35 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+# the replication-check opt-out was renamed check_rep -> check_vma;
+# detect which spelling this jax takes so both versions run
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma"
+             if "check_vma" in _inspect.signature(shard_map).parameters
+             else "check_rep")
+
 
 def _replicate_init(single, n_devices: int, sharding: NamedSharding):
     """Broadcast a single-device state pytree onto the device axis."""
@@ -92,6 +100,8 @@ class _ShardedSuiteBase:
 
     def __init__(self, cfg, mesh: Mesh, axis: str,
                  init_single: Callable) -> None:
+        from deepflow_tpu.runtime.tracing import default_tracer
+
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -102,23 +112,58 @@ class _ShardedSuiteBase:
         self._init_single = init_single
         self._state_specs = jax.tree.map(lambda _: self._dev_spec,
                                          init_single())
+        # flight recorder: sharded suites attribute mesh h2d and update
+        # dispatch like the single-chip exporter (runtime/tracing.py).
+        # h2d attribution blocks on the placed batch — the only way to
+        # separate transfer from compute — so it is SAMPLED (every
+        # _attrib_every-th traced put); dispatch spans never block, so
+        # the async pipeline shape is preserved on traced batches.
+        self._tracer = default_tracer()
+        self._suite = type(self).__name__
+        self._attrib_every = 16
+        self._puts_traced = 0
 
     def _shard(self, fn, in_specs, out_specs):
         return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False))
+                                 out_specs=out_specs,
+                                 **{_CHECK_KW: False}))
 
     def init(self):
         return _replicate_init(self._init_single(), self.n_devices,
                                self._state_sharding)
 
     def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
-        return _put_sharded(cols, mask, self._batch_sharding)
+        tr = self._tracer
+        if not tr.enabled:
+            return _put_sharded(cols, mask, self._batch_sharding)
+        detailed = self._puts_traced % self._attrib_every == 0
+        self._puts_traced += 1
+        if not detailed:
+            return _put_sharded(cols, mask, self._batch_sharding)
+        import time
+        nbytes = sum(getattr(v, "nbytes", 0) for v in cols.values())
+        t0 = time.perf_counter()
+        out = _put_sharded(cols, mask, self._batch_sharding)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        tr.observe("shard.h2d", dt, stream=self._suite)
+        if dt > 0 and nbytes:
+            tr.gauge("mesh_h2d_mb_s", nbytes / 1e6 / dt)
+        return out
 
     def update(self, state, cols: Dict, mask):
-        return self._update(state, cols, mask)
+        tr = self._tracer
+        if not tr.enabled:
+            return self._update(state, cols, mask)
+        with tr.span("shard.update", stream=self._suite):
+            return self._update(state, cols, mask)
 
     def flush(self, state):
-        return self._flush(state)
+        tr = self._tracer
+        if not tr.enabled:
+            return self._flush(state)
+        with tr.span("shard.flush", stream=self._suite):
+            return self._flush(state)
 
 
 class ShardedFlowSuite(_ShardedSuiteBase):
@@ -357,10 +402,12 @@ class ShardedMetricsSuite(_ShardedSuiteBase):
                                   (state_specs, P(axis), P(axis)),
                                   out_specs)
 
-    def update(self, state: MetricsSuiteState, cols: Dict,
-               mask) -> MetricsSuiteState:
-        return self._update(state, cols, mask)
-
+    # update() is the inherited traced wrapper; flush() differs in
+    # arity (window close consumes the last batch's cols/mask)
     def flush(self, state: MetricsSuiteState, cols: Dict, mask
               ) -> Tuple[MetricsSuiteState, MetricsWindowOutput]:
-        return self._flush(state, cols, mask)
+        tr = self._tracer
+        if not tr.enabled:
+            return self._flush(state, cols, mask)
+        with tr.span("shard.flush", stream=self._suite):
+            return self._flush(state, cols, mask)

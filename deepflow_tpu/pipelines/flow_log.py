@@ -26,6 +26,7 @@ from deepflow_tpu.runtime.queues import MultiQueue
 from deepflow_tpu.runtime.receiver import Receiver
 from deepflow_tpu.runtime.stats import StatsRegistry
 from deepflow_tpu.runtime.throttler import ColumnarThrottler
+from deepflow_tpu.runtime.tracing import default_tracer
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.writer import StoreWriter
 from deepflow_tpu.wire.codec import iter_pb_records
@@ -90,6 +91,7 @@ class _Decoder(threading.Thread):
         self.frames = 0
         self.records = 0
         self.decode_errors = 0
+        self._tracer = default_tracer()
 
     def run(self) -> None:
         while not self._halt.is_set():
@@ -102,6 +104,23 @@ class _Decoder(threading.Thread):
             self.handle(frames)
 
     def handle(self, frames: List[Frame]) -> None:
+        tracer = self._tracer
+        if tracer.enabled:
+            # the chunk anchors to its FIRST frame's receiver-stamped
+            # batch id (batch causality receiver -> decode -> export);
+            # frames received before tracing was enabled get a fresh id.
+            bid = getattr(frames[0], "trace_batch_id", 0) or \
+                tracer.next_batch()
+            tracer.set_batch(bid)
+            before = self.records
+            with tracer.span("decode", stream=self.stream,
+                             batch_id=bid) as sp:
+                self._handle_inner(frames)
+                sp.rows = self.records - before
+        else:
+            self._handle_inner(frames)
+
+    def _handle_inner(self, frames: List[Frame]) -> None:
         self.frames += len(frames)
         if self.frame_mode:
             try:
@@ -204,6 +223,7 @@ class FlowLogPipeline:
              decode_l7, _with_ids(platform.stamp_l7)),
         ):
             queues = MultiQueue(f"ingest.{stream}", n_decoders, queue_size)
+            queues.trace_dwell(default_tracer(), f"queue.ingest.{stream}")
             receiver.register_handler(msg_type, queues)
             writer = None
             if store is not None:

@@ -61,6 +61,19 @@ class IngesterConfig:
     # False leaves the province columns zero
     geo_db_path: Optional[str] = None
     geo_enabled: bool = True
+    # flight recorder (runtime/tracing.py): span timing through the hot
+    # path, queryable via the trace CLI / debug commands. True enables
+    # the process tracer; False leaves it as-is (another ingester or a
+    # test may own it). Tracing costs ~one histogram add per batch
+    # stage; the explicit transfer/kernel drains that detailed
+    # attribution needs are SAMPLED (every 16th batch + cold
+    # compiles), so the async device pipeline keeps its shape
+    trace_enabled: bool = True
+    # Prometheus text-exposition listener (runtime/promexpo.py) serving
+    # the Countable registry + flight-recorder histograms; None
+    # disables, 0 binds an ephemeral port (reference: the :9526
+    # stats/pprof listener)
+    prom_port: Optional[int] = None
 
 
 class Ingester:
@@ -71,6 +84,11 @@ class Ingester:
                  stats: Optional[StatsRegistry] = None) -> None:
         self.cfg = cfg
         self.stats = stats or StatsRegistry()
+        from deepflow_tpu.runtime.tracing import default_tracer
+        self.tracer = default_tracer()
+        if cfg.trace_enabled:
+            self.tracer.enable()
+        self.stats.register("tracer", self.tracer.counters)
         self.platform = platform or PlatformDataManager(stats=self.stats)
         self.exporters = Exporters(stats=self.stats)
         self.store: Optional[Store] = None
@@ -128,10 +146,17 @@ class Ingester:
             stats=self.stats)
         self._pipelines = (self.flow_log, self.flow_metrics, self.ext_metrics,
                            self.event, self.profile, self.droplet)
+        self.prom = None
+        if cfg.prom_port is not None:
+            from deepflow_tpu.runtime.promexpo import PrometheusExporter
+            self.prom = PrometheusExporter(stats=self.stats,
+                                           tracer=self.tracer,
+                                           port=cfg.prom_port)
         self.debug = None
         if cfg.debug_port is not None:
             from deepflow_tpu.runtime.debug import DebugServer
-            self.debug = DebugServer(self.stats, port=cfg.debug_port)
+            self.debug = DebugServer(self.stats, port=cfg.debug_port,
+                                     tracer=self.tracer)
             self.debug.register(
                 "vtap-status",
                 lambda req: {f"{v}:{t}": vars(st) for (v, t), st
@@ -264,6 +289,8 @@ class Ingester:
             self.monitor.start()
         if self.debug is not None:
             self.debug.start()
+        if self.prom is not None:
+            self.prom.start()
         # throttle-bucket janitor: rolls idle reservoir buckets on wall
         # clock so a quiet stream's rows reach the writer within one
         # bucket width instead of waiting for the next record
@@ -303,9 +330,18 @@ class Ingester:
             self.monitor.close()
         if self.debug is not None:
             self.debug.close()
+        if self.prom is not None:
+            self.prom.close()
         self.exporters.close()
         self.tag_dicts.close()
+        self.stats.deregister("tracer")
 
     @property
     def port(self) -> int:
         return self.receiver.bound_port
+
+    @property
+    def prom_port(self) -> Optional[int]:
+        """Bound metrics-endpoint port (ephemeral-port aware), or None
+        when exposition is disabled."""
+        return None if self.prom is None else self.prom.port

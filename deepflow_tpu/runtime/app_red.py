@@ -184,7 +184,7 @@ class AppRedExporter(QueueWorkerExporter):
 
     # -- data path ---------------------------------------------------------
     def process(self, chunks: List[Any]) -> None:
-        for stream, _idx, cols in chunks:
+        for stream, _idx, cols, *_ in chunks:
             schema_cols = self.coerce_to_schema(cols, _RED_SCHEMA)
             n = len(next(iter(schema_cols.values())))
             with self._state_lock:

@@ -17,18 +17,24 @@ import threading
 from typing import Callable, Dict, Optional
 
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.tracing import Tracer, default_tracer
 
 DEFAULT_DEBUG_PORT = 30035
 
 
 class DebugServer:
     def __init__(self, stats: StatsRegistry, port: int = DEFAULT_DEBUG_PORT,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 tracer: Optional[Tracer] = None) -> None:
         self.stats = stats
+        self.tracer = tracer if tracer is not None else default_tracer()
         self._handlers: Dict[str, Callable[[dict], object]] = {
             "ping": lambda req: "pong",
             "counters": self._counters,
             "stacks": self._stacks,
+            "latency": self._latency,
+            "spans": self._spans,
+            "rrt": self._rrt,
         }
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
@@ -50,6 +56,37 @@ class DebugServer:
             if module is None or s.module.startswith(module):
                 out[s.module] = s.values
         return out
+
+    def _latency(self, req: dict) -> dict:
+        """Flight-recorder per-stage latency quantiles (the `deepflow-ctl
+        ingester rrt`-family backing data). `module` prefix-filters
+        stage names."""
+        want = req.get("module") or ""
+        return {"enabled": self.tracer.enabled,
+                "stages": {k: v for k, v in self.tracer.latency().items()
+                           if k.startswith(want)}}
+
+    def _spans(self, req: dict) -> dict:
+        """Recent completed spans from the ring, newest first. Options:
+        stage (exact), slow_ms (only slower), count (<= 200 — the reply
+        must fit one datagram)."""
+        count = min(int(req.get("count", 20)), 200)
+        return {"enabled": self.tracer.enabled,
+                "spans": self.tracer.recent(
+                    n=count, stage=req.get("stage") or None,
+                    slow_ms=(float(req["slow_ms"])
+                             if req.get("slow_ms") is not None else None))}
+
+    def _rrt(self, req: dict) -> dict:
+        """Where-time-goes attribution: TPU transfer/kernel gauges
+        (h2d MB/s, compile seconds) beside the kernel stage summaries —
+        the round-trip view of one batch through the device."""
+        lat = self.tracer.latency()
+        return {"enabled": self.tracer.enabled,
+                "gauges": self.tracer.gauges(),
+                "kernel_stages": {k: v for k, v in lat.items()
+                                  if k.startswith(("kernel", "shard"))},
+                "spans_recorded": self.tracer.spans_recorded}
 
     @staticmethod
     def _stacks(req: dict) -> dict:

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Protocol, Sequence
 
 from deepflow_tpu.runtime.queues import OverwriteQueue
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.tracing import default_tracer
 
 
 class Exporter(Protocol):
@@ -36,7 +37,11 @@ class Exporter(Protocol):
 
     def put(self, stream: str, decoder_index: int,
             cols: Dict[str, Any]) -> None:
-        """Hand one decoded columnar chunk to the exporter. Must not block."""
+        """Hand one decoded columnar chunk to the exporter. Must not
+        block. Batch causality rides the flight recorder's thread-local
+        batch id (tracing.Tracer.set_batch), not the signature — the
+        contract predates the tracer and third-party exporters keep
+        working unchanged."""
         ...
 
 
@@ -99,6 +104,8 @@ class QueueWorkerExporter:
         self.batch = batch
         self._threads: List[threading.Thread] = []
         self.processed = 0
+        self._tracer = default_tracer()
+        self.queue.trace_dwell(self._tracer, f"queue.exporter.{name}")
         if stats is not None:
             stats.register(f"exporter.{name}", self.counters)
 
@@ -121,7 +128,14 @@ class QueueWorkerExporter:
 
     def put(self, stream: str, decoder_index: int,
             cols: Dict[str, Any]) -> None:
-        self.queue.put((stream, decoder_index, cols))
+        # the enqueuing thread's batch id crosses the queue inside the
+        # item: the worker re-pins it so kernel attribution downstream
+        # anchors to the decoder's chunk (batch causality across the
+        # thread hop). -1 when tracing is off — same tuple shape always,
+        # so process() implementations never see two layouts.
+        self.queue.put((stream, decoder_index, cols,
+                        self._tracer.current_batch()
+                        if self._tracer.enabled else -1))
 
     # -- subclass surface --------------------------------------------------
     def process(self, chunks: List[Any]) -> None:  # pragma: no cover
@@ -142,10 +156,20 @@ class QueueWorkerExporter:
         }
 
     def _run(self) -> None:
+        tracer = self._tracer
         while True:
             chunks = self.queue.gets(self.batch, timeout=0.2)
             if chunks:
-                self.process(chunks)
+                if tracer.enabled:
+                    rows = sum(
+                        len(next(iter(c[2].values()))) if c[2] else 0
+                        for c in chunks)
+                    tracer.set_batch(chunks[0][3])
+                    with tracer.span("export", stream=self.name,
+                                     batch_id=chunks[0][3], rows=rows):
+                        self.process(chunks)
+                else:
+                    self.process(chunks)
                 self.processed += len(chunks)
             elif self.queue.closed:
                 return

@@ -65,7 +65,7 @@ class OtlpExporter(QueueWorkerExporter):
         self.send_errors = 0
 
     def process(self, chunks: List[Any]) -> None:
-        for _stream, _idx, cols in chunks:
+        for _stream, _idx, cols, *_ in chunks:
             req = l7_chunk_to_otlp(cols, self.endpoint_dict)
             body = req.SerializeToString()
             http_req = urllib.request.Request(

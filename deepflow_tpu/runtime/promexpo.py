@@ -1,0 +1,292 @@
+"""Prometheus text-exposition endpoint over the self-telemetry surfaces.
+
+The reference server runs an always-on stats/pprof listener on :9526
+(server/cmd/server/main.go); this is its Prometheus-shaped equivalent:
+one HTTP endpoint serving
+
+- every Countable the StatsRegistry scrapes, as
+  `deepflow_<module>_<name>` untyped samples with the source's tags as
+  labels (plus non-numeric countable values riding as labels on a
+  constant-1 info sample — dropping them would hide mode flags);
+- the flight recorder's per-stage latency histograms
+  (`deepflow_stage_latency_seconds` with a `stage` label), in native
+  Prometheus histogram form — cumulative `le` buckets read straight off
+  the host DDSketch's geometric boundaries, so `histogram_quantile`
+  works against them with the sketch's own relative-error bound;
+- tracer gauges (h2d MB/s, compile seconds, ...) as
+  `deepflow_trace_<name>`.
+
+`validate_exposition` is the strict line-format checker the golden test
+and ci.sh both run against the live endpoint — the format is a contract
+with real scrapers, so "mostly parseable" is a failure.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.tracing import Tracer, default_tracer
+
+DEFAULT_PROM_PORT = 9526   # the reference's self-observation listener
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(*parts: str) -> str:
+    return _NAME_OK.sub("_", "_".join(p for p in parts if p))
+
+
+def _label_name(s: str) -> str:
+    s = _LABEL_OK.sub("_", s)
+    return ("_" + s) if (not s or s[0].isdigit()) else s
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels(d: Dict[str, str]) -> str:
+    if not d:
+        return ""
+    inner = ",".join(f'{_label_name(k)}="{_escape_label(str(v))}"'
+                     for k, v in sorted(d.items()))
+    return "{" + inner + "}"
+
+
+def render_metrics(stats: Optional[StatsRegistry],
+                   tracer: Optional[Tracer],
+                   bucket_stride: int = 64) -> str:
+    """One scrape: collect Countables + tracer state, render text
+    exposition format (version 0.0.4)."""
+    lines: List[str] = []
+    typed: set = set()
+
+    def _sample(name: str, labels: Dict[str, str], value: float,
+                mtype: str = "untyped", help_: str = "") -> None:
+        if name not in typed:
+            typed.add(name)
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name}{_labels(labels)} {_fmt(value)}")
+
+    if stats is not None:
+        for s in stats.collect():
+            tags = dict(s.tags)
+            info = {}
+            for k, v in s.values.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    info[k] = str(v)
+                else:
+                    _sample(_metric_name("deepflow", s.module, k), tags,
+                            float(v))
+            if info:
+                _sample(_metric_name("deepflow", s.module, "info"),
+                        {**tags, **info}, 1.0,
+                        help_="non-numeric countable values as labels")
+
+    if tracer is not None:
+        hname = "deepflow_stage_latency_seconds"
+        first = True
+        for stage, sk in sorted(tracer.stages().items()):
+            # ONE snapshot per stage: spans keep landing while we
+            # render, and +Inf must equal _count in the output
+            buckets, total, sum_ = sk.snapshot(bucket_stride)
+            if total == 0:
+                continue
+            if first:
+                lines.append(f"# HELP {hname} per-stage pipeline latency "
+                             "(host DDSketch, relative error "
+                             f"{sk.alpha})")
+                lines.append(f"# TYPE {hname} histogram")
+                typed.add(hname)
+                first = False
+            lbl = {"stage": stage}
+            for le, cum in buckets:
+                lines.append(
+                    f"{hname}_bucket{_labels({**lbl, 'le': repr(le)})} "
+                    f"{_fmt(cum)}")
+            lines.append(
+                f"{hname}_bucket{_labels({**lbl, 'le': '+Inf'})} "
+                f"{_fmt(total)}")
+            lines.append(f"{hname}_sum{_labels(lbl)} {repr(sum_)}")
+            lines.append(f"{hname}_count{_labels(lbl)} {_fmt(total)}")
+        for name, value in sorted(tracer.gauges().items()):
+            _sample(_metric_name("deepflow_trace", name), {}, value,
+                    mtype="gauge")
+        _sample("deepflow_trace_spans_total", {},
+                float(tracer.spans_recorded), mtype="counter",
+                help_="spans recorded by the flight recorder")
+
+    return "\n".join(lines) + "\n"
+
+
+# -- strict format checker -------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'                       # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*"'       # first label
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*")*\})?'  # more labels
+    r' (-?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|\+?Inf|NaN))'  # value
+    r'( [0-9]+)?$')                                      # optional ts
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+_LE_RE = re.compile(r'le="((?:\\.|[^"\\])*)"')
+_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _label_key(labels: str) -> tuple:
+    """Canonical (name, value) tuple of a label block, `le` dropped —
+    the grouping key that pairs a histogram's buckets with its
+    _sum/_count series regardless of label ordering."""
+    return tuple(sorted((k, v) for k, v in _PAIR_RE.findall(labels)
+                        if k != "le"))
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Strict text-format (0.0.4) checker. Returns a list of problems
+    (empty = valid). Enforced beyond the line grammar: body ends with a
+    newline, TYPE precedes its samples and appears once, histogram
+    series carry a +Inf bucket whose value equals their _count, and
+    bucket counts are non-decreasing in le order."""
+    problems: List[str] = []
+    if not text:
+        return ["empty exposition body"]
+    if not text.endswith("\n"):
+        problems.append("body must end with a newline")
+    types: Dict[str, str] = {}
+    seen_samples: set = set()
+    # histogram accounting: (base_name, labels-sans-le) -> state
+    hist: Dict[tuple, dict] = {}
+    for ln, line in enumerate(text.split("\n")[:-1], 1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            if _HELP_RE.match(line):
+                continue
+            m = _TYPE_RE.match(line)
+            if not m:
+                problems.append(f"line {ln}: malformed comment: {line!r}")
+                continue
+            name = m.group(1)
+            if name in types:
+                problems.append(f"line {ln}: duplicate TYPE for {name}")
+            if name in seen_samples:
+                problems.append(
+                    f"line {ln}: TYPE for {name} after its samples")
+            types[name] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"line {ln}: malformed sample: {line!r}")
+            continue
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types \
+                    and types[name[:-len(suffix)]] == "histogram":
+                base = name[:-len(suffix)]
+                break
+        seen_samples.add(base)
+        if base != name and types.get(base) == "histogram":
+            key_labels = _label_key(labels)
+            h = hist.setdefault((base, key_labels),
+                                {"inf": None, "count": None, "last": None})
+            if name.endswith("_bucket"):
+                le = _LE_RE.search(labels)
+                if le is None:
+                    problems.append(
+                        f"line {ln}: histogram bucket without le label")
+                    continue
+                if le.group(1) == "+Inf":
+                    h["inf"] = float(value)
+                else:
+                    v = float(value)
+                    if h["last"] is not None and v < h["last"]:
+                        problems.append(
+                            f"line {ln}: bucket counts decrease "
+                            f"for {base}")
+                    h["last"] = v
+            elif name.endswith("_count"):
+                h["count"] = float(value)
+    for (base, labels), h in hist.items():
+        if h["inf"] is None:
+            problems.append(f"histogram {base}{labels}: no +Inf bucket")
+        elif h["count"] is not None and h["inf"] != h["count"]:
+            problems.append(
+                f"histogram {base}{labels}: +Inf bucket {h['inf']} "
+                f"!= _count {h['count']}")
+    return problems
+
+
+class PrometheusExporter:
+    """The :9526-style HTTP listener serving GET /metrics."""
+
+    def __init__(self, stats: Optional[StatsRegistry] = None,
+                 tracer: Optional[Tracer] = None,
+                 port: int = DEFAULT_PROM_PORT,
+                 host: str = "127.0.0.1") -> None:
+        self.stats = stats
+        self.tracer = tracer if tracer is not None else default_tracer()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:   # noqa: N802 (stdlib contract)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_metrics(exporter.stats,
+                                          exporter.tracer).encode()
+                except Exception as e:   # a broken countable: 500, not die
+                    self.send_error(500, str(e)[:200])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a) -> None:   # quiet: scrape cadence
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="prom-exposition",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        # shutdown() blocks on the serve_forever loop acking — calling
+        # it with no loop running (start() never happened, or it
+        # raised) would hang forever
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=2)
+            self._thread = None
+        self._server.server_close()

@@ -14,6 +14,7 @@ with the same batch `gets` contract the reference decoders rely on
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 
@@ -38,6 +39,11 @@ class OverwriteQueue:
         # debug tap: when armed, the next N puts record item summaries
         self._tap_left = 0
         self._tap_out: List[str] = []
+        # flight-recorder dwell sampling (trace_dwell): per-slot put
+        # timestamps, observed as "queue wait" when a batch drains
+        self._tracer = None
+        self._dwell_stage = ""
+        self._put_ts: Optional[List[float]] = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -48,6 +54,10 @@ class OverwriteQueue:
 
     def puts(self, items: Sequence[Any]) -> None:
         """Append a batch; overwrite the oldest entries if full."""
+        tracer = self._tracer
+        tracing = tracer is not None and tracer.enabled
+        if tracing:
+            now = time.perf_counter()
         with self._ready:
             if self._closed:
                 raise RuntimeError(f"queue {self.name} is closed")
@@ -60,6 +70,8 @@ class OverwriteQueue:
                 else:
                     self._size += 1
                 self._buf[tail] = item
+                if tracing:
+                    self._put_ts[tail] = now
                 if self._tap_left > 0:
                     self._tap_left -= 1
                     self._tap_out.append(repr(item)[:240])
@@ -71,10 +83,19 @@ class OverwriteQueue:
 
         Returns [] only on timeout or closed-and-drained.
         """
+        tracer = self._tracer
         with self._ready:
             if self._size == 0 and not self._closed:
                 self._ready.wait(timeout)
             n = min(self._size, max_items)
+            if (n and tracer is not None and tracer.enabled
+                    and self._put_ts is not None):
+                # sample the OLDEST drained item's dwell (one observation
+                # per batch get keeps the cost off the per-item path)
+                ts = self._put_ts[self._head]
+                if ts > 0.0:
+                    tracer.observe(self._dwell_stage,
+                                   time.perf_counter() - ts)
             out = []
             for _ in range(n):
                 out.append(self._buf[self._head])
@@ -93,6 +114,16 @@ class OverwriteQueue:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    def trace_dwell(self, tracer, stage: str) -> None:
+        """Arm flight-recorder dwell sampling: time items spend parked
+        in this queue lands in `tracer` under `stage`. Costs one
+        perf_counter per put batch plus a float store per item, and
+        ONLY while the tracer is enabled."""
+        with self._lock:
+            self._tracer = tracer
+            self._dwell_stage = stage
+            self._put_ts = [0.0] * self.capacity
 
     def tap(self, count: int) -> None:
         """Arm sampling of the next `count` items flowing through."""
@@ -146,6 +177,11 @@ class MultiQueue:
     def close(self) -> None:
         for q in self.queues:
             q.close()
+
+    def trace_dwell(self, tracer, stage: str) -> None:
+        """Arm dwell sampling on every sub-queue under one stage."""
+        for q in self.queues:
+            q.trace_dwell(tracer, stage)
 
     def tap(self, count: int) -> None:
         """Arm each sub-queue to sample up to `count` items."""

@@ -19,6 +19,7 @@ from typing import Dict, Optional, Tuple
 
 from deepflow_tpu.runtime.queues import MultiQueue
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.tracing import default_tracer
 from deepflow_tpu.wire.framing import (
     MESSAGE_HEADER_LEN,
     MESSAGE_FRAME_SIZE_MAX,
@@ -70,6 +71,7 @@ class Receiver:
         self.rx_bytes = 0
         self.rx_errors = 0
         self.no_handler = 0
+        self._tracer = default_tracer()
         if stats is not None:
             stats.register("receiver", self.counters)
 
@@ -176,6 +178,15 @@ class Receiver:
     def _dispatch(self, frame: Frame, nbytes: int) -> None:
         self.rx_frames += 1
         self.rx_bytes += nbytes
+        # flight recorder: frame-level batch_id is where batch causality
+        # STARTS (decode spans anchor to the first frame's id). The
+        # whole block is guarded so the disabled path adds one attribute
+        # load + branch, no allocations.
+        tracer = self._tracer
+        tracing = tracer.enabled
+        if tracing:
+            t0 = time.perf_counter()
+            frame.trace_batch_id = tracer.next_batch()
         vtap = 0
         if frame.flow_header is not None:
             vtap = frame.flow_header.vtap_id
@@ -185,6 +196,13 @@ class Receiver:
             self.no_handler += 1
             return
         handler.put(vtap, frame)
+        if tracing:
+            # rows stays 0: a frame's record count is unknown until
+            # decode, and payload BYTES under a ROWS column would read
+            # as 65k records next to the other stages' record counts
+            tracer.observe("receiver", time.perf_counter() - t0,
+                           stream=frame.msg_type.name,
+                           batch_id=frame.trace_batch_id)
 
     def _track(self, frame: Frame, vtap: int) -> None:
         key = (vtap, int(frame.msg_type))

@@ -25,6 +25,7 @@ from deepflow_tpu.models import flow_suite
 from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
 from deepflow_tpu.runtime.exporters import QueueWorkerExporter
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.tracing import default_tracer
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
 from deepflow_tpu.store.writer import StoreWriter
@@ -148,9 +149,13 @@ class TpuSketchExporter(QueueWorkerExporter):
         elif self.wire == "dict":
             from deepflow_tpu.models import flow_dict
             self._flow_dict = flow_dict
+            # pairs-packed hits planes hold two records per slot, so the
+            # packer's hits_batch must be even: an odd batch_rows rounds
+            # DOWN (capacity floors at 2) instead of surfacing as the
+            # packer's opaque "hits_batch must be even" at construction
             self._dict_packer = flow_dict.FlowDictPacker(
                 capacity=max(2 * batch_rows, 1 << 17),
-                hits_batch=batch_rows)
+                hits_batch=max(2, batch_rows & ~1))
             self._dict_state = flow_dict.init_dict(
                 self._dict_packer.capacity)
             self._update_hits = jax.jit(
@@ -174,6 +179,24 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._window_thread: Optional[threading.Thread] = None
         self._window_stop = threading.Event()
         self._state_lock = threading.Lock()
+        # flight recorder: kernel attribution (h2d / dispatch / device,
+        # first-call compile split out). _warm tracks which update
+        # programs have already compiled; h2d byte totals feed the
+        # tpu_h2d_mb_s gauge VERDICT r5 asked for. Attribution needs
+        # explicit drains to separate transfer from compute, and a
+        # drain serializes the otherwise-async device pipeline — so
+        # detailed (blocking) attribution runs on every
+        # `trace_attrib_every`-th batch plus every cold compile, and
+        # all other traced batches keep the async shape (their "kernel"
+        # span measures host-side time only). Sampling keeps the
+        # enabled-tracer overhead within the <=3% budget instead of
+        # turning observability-on into measurement-mode-always.
+        self._tracer = default_tracer()
+        self._warm: set = set()
+        self.h2d_bytes = 0
+        self._attrib_every = 16
+        self._batches_traced = 0
+        self._detailed = False
 
     # -- exporter lifecycle ------------------------------------------------
     def start(self) -> None:
@@ -199,8 +222,14 @@ class TpuSketchExporter(QueueWorkerExporter):
     def process(self, chunks: List[Any]) -> None:
         """Queue worker: decoded chunks -> static batches -> device.
         Holds _state_lock across batcher + state mutation: the window
-        thread's flush_window() touches both under the same lock."""
-        for stream, _idx, cols in chunks:
+        thread's flush_window() touches both under the same lock.
+        Chunks arrive as (stream, idx, cols, batch_id); the batch id is
+        pinned per chunk so kernel spans anchor to the decoder chunk
+        that produced the rows."""
+        tracing = self._tracer.enabled
+        for stream, _idx, cols, *rest in chunks:
+            if tracing and rest:
+                self._tracer.set_batch(rest[0])
             schema_cols = self.coerce_to_schema(cols, SKETCH_L4_SCHEMA)
             with self._state_lock:
                 for tb in self.batcher.put(schema_cols):
@@ -209,8 +238,68 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # rows_in is a processed-watermark, not an arrival count
                 self.rows_in += len(next(iter(schema_cols.values())))
 
-    def _run_batch_locked(self, tb: TensorBatch) -> None:
+    def _to_device(self, host_array, rows: int):
+        """jnp.asarray with flight-recorder h2d attribution. A
+        DETAILED batch adds a block_until_ready after the put — the
+        only way to separate transfer time from compute — so it is
+        sampled (see __init__); everything else stays fully async."""
         jnp = self._jnp
+        tr = self._tracer
+        # the byte counter is a TRUE total (scraped beside rows_in):
+        # every transfer counts, only the blocking measurement samples
+        self.h2d_bytes += host_array.nbytes
+        if not (tr.enabled and self._detailed):
+            return jnp.asarray(host_array)
+        t0 = time.perf_counter()
+        dev = jnp.asarray(host_array)
+        dev.block_until_ready()
+        dt = time.perf_counter() - t0
+        tr.observe("kernel.h2d", dt, stream=self.wire, rows=rows)
+        if dt > 0:
+            tr.gauge("tpu_h2d_mb_s", host_array.nbytes / 1e6 / dt)
+        return dev
+
+    def _timed_update(self, key: str, fn, *args):
+        """Dispatch + drain attribution around one jitted update call.
+        The first call per program is COMPILE (recorded as its own
+        stage and gauge, never polluting the steady-state kernel
+        quantiles); later calls split into dispatch (host returns) and
+        device (block_until_ready drain). Runs the plain async call
+        unless this batch is a sampled detailed one or the program is
+        cold (a compile must always be attributed — missing it would
+        poison the first sampled batch's device quantile instead)."""
+        tr = self._tracer
+        first = key not in self._warm
+        if not tr.enabled or not (self._detailed or first):
+            return fn(*args)
+        import jax
+        t0 = time.perf_counter()
+        out = fn(*args)
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        if first:
+            self._warm.add(key)
+            tr.observe("kernel.compile", t2 - t0, stream=key)
+            tr.gauge(f"tpu_compile_s_{key}", t2 - t0)
+        else:
+            tr.observe("kernel.dispatch", t1 - t0, stream=key)
+            tr.observe("kernel.device", t2 - t1, stream=key)
+        return out
+
+    def _run_batch_locked(self, tb: TensorBatch) -> None:
+        tr = self._tracer
+        if not tr.enabled:
+            self._run_batch_inner(tb)
+            return
+        with tr.span("kernel", stream=self.wire, rows=tb.valid):
+            self._run_batch_inner(tb)
+
+    def _run_batch_inner(self, tb: TensorBatch) -> None:
+        if self._tracer.enabled:
+            self._detailed = \
+                self._batches_traced % self._attrib_every == 0
+            self._batches_traced += 1
         self._record_key_tuples(tb)
         if self._dict_packer is not None:
             # dictionary lane: pack only the VALID rows (the packer's
@@ -221,23 +310,28 @@ class TpuSketchExporter(QueueWorkerExporter):
             wire = self._dict_packer.pack(cols) + self._dict_packer.flush()
             for kind, plane, n in wire:
                 nn = np.uint32(n)
+                plane_d = self._to_device(plane, n)
                 if kind == "news":
-                    self.state, self._dict_state = self._update_news(
-                        self.state, self._dict_state,
-                        jnp.asarray(plane), nn)
+                    self.state, self._dict_state = self._timed_update(
+                        "news", self._update_news,
+                        self.state, self._dict_state, plane_d, nn)
                 else:
-                    self.state = self._update_hits(
-                        self.state, self._dict_state,
-                        jnp.asarray(plane), nn)
+                    self.state = self._timed_update(
+                        "hits", self._update_hits,
+                        self.state, self._dict_state, plane_d, nn)
             return
-        mask_d = jnp.asarray(tb.mask())
+        n = tb.valid
+        mask_d = self._to_device(tb.mask(), n)
         if self.staged:   # staged update consumes the full column dict
-            cols_d = {k: jnp.asarray(v) for k, v in tb.columns.items()}
-            self.state = self._update(self.state, cols_d, mask_d)
+            cols_d = {k: self._to_device(v, n)
+                      for k, v in tb.columns.items()}
+            self.state = self._timed_update(
+                "staged", self._update, self.state, cols_d, mask_d)
             return
         lanes = flow_suite.pack_lanes(tb.columns)
-        lanes_d = {k: jnp.asarray(v) for k, v in lanes.items()}
-        self.state = self._update(self.state, lanes_d, mask_d)
+        lanes_d = {k: self._to_device(v, n) for k, v in lanes.items()}
+        self.state = self._timed_update(
+            "packed", self._update, self.state, lanes_d, mask_d)
 
     # one entry per distinct sampled flow key: (ip_src, ip_dst,
     # port_src, port_dst, proto). Sized well above ring_size so standing
@@ -275,6 +369,14 @@ class TpuSketchExporter(QueueWorkerExporter):
     def flush_window(self, now: Optional[float] = None) -> Optional[
             flow_suite.FlowWindowOutput]:
         now = time.time() if now is None else now
+        tr = self._tracer
+        if not tr.enabled:
+            return self._flush_window_inner(now)
+        with tr.span("window", stream=self.wire):
+            return self._flush_window_inner(now)
+
+    def _flush_window_inner(self, now: float) -> Optional[
+            flow_suite.FlowWindowOutput]:
         with self._state_lock:
             for tb in self.batcher.flush():
                 self._run_batch_locked(tb)
@@ -344,7 +446,8 @@ class TpuSketchExporter(QueueWorkerExporter):
 
     def counters(self) -> dict:
         c = super().counters()
-        c.update({"rows_in": self.rows_in, "windows": self.windows})
+        c.update({"rows_in": self.rows_in, "windows": self.windows,
+                  "h2d_bytes": self.h2d_bytes})
         # staged-update admission skips (flow_suite.make_staged_update):
         # bounded data loss that must show in deepflow_system, not logs
         failures = getattr(self._update, "admission_failures", None)

@@ -26,6 +26,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+try:   # jax >= 0.4.38 re-exports it; older versions keep it experimental
+    _enable_x64 = jax.enable_x64
+except AttributeError:
+    from jax.experimental import enable_x64 as _enable_x64
+
 from deepflow_tpu.store.db import Store, Table
 from deepflow_tpu.store.table import AggKind, TableSchema
 
@@ -214,7 +219,7 @@ def group_reduce_device(cols: Dict[str, np.ndarray], key_names: List[str],
             out[:n] = a.astype(np.uint32)
         return jnp.asarray(out)
 
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         keys = tuple(pad_u32(np.asarray(cols[nm])) for nm in key_names)
         data = np.zeros((rows_pad, len(value_names)), np.int64)
         for i, nm in enumerate(value_names):
@@ -308,7 +313,7 @@ def group_reduce(cols: Dict[str, np.ndarray], key_names: List[str],
     # Window sums of uint32 counters need 64-bit accumulators (ClickHouse
     # sums into UInt64); scope x64 to this program so the rest of the
     # framework keeps the TPU-friendly 32-bit default.
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         reduced = np.asarray(_segment_reduce(
             jnp.asarray(seg), jnp.asarray(mask), jnp.asarray(data_pad),
             tuple(aggs[nm] for nm in value_names), seg_pad))[:n_groups]

@@ -17,10 +17,21 @@ import os
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The 8-device request must land before jax initializes its backend.
+# jax_num_cpu_devices only exists on newer jax; the XLA flag works on
+# every version this repo supports, so it is the primary mechanism.
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " " + _FLAG).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:   # jax < 0.5: the XLA_FLAGS path above covers it
+    pass
 
 import numpy as np
 import pytest

@@ -40,12 +40,23 @@ def held_file(tmp_path):
         f.close()
 
 
-def _rec(pid, fd, latency_ns=5 * MS, trace_id=77, direction=T_EGRESS,
+def _raw(pid, fd, latency_ns=5 * MS, trace_id=77, direction=T_EGRESS,
          payload=b"log line\n"):
-    return parse_record(pack_record(
+    return pack_record(
         pid=pid, tid=pid + 1, direction=direction,
         ts_ns=int(time.time() * 1e9), payload=payload, fd=fd,
-        trace_id=trace_id, comm="logger", latency_ns=latency_ns))
+        trace_id=trace_id, comm="logger", latency_ns=latency_ns)
+
+
+def _rec(pid, fd, **kw):
+    return parse_record(_raw(pid, fd, **kw))
+
+
+def _none_resolver(pid, fd):
+    """A live-path resolver that PROVES the fd is no socket — records
+    fed with it arm the fd-class gate the way the perf-ring drain
+    does (feed_raw with a ProcFdResolver)."""
+    return None
 
 
 def test_latency_rides_the_fd_word(held_file):
@@ -60,7 +71,7 @@ def test_latency_rides_the_fd_word(held_file):
 def test_gate_emits_proc_event_for_slow_traced_file_io(held_file):
     pid, fd, path = held_file
     tr = EbpfTracer(vtap_id=5)
-    assert tr.feed(_rec(pid, fd)) is None
+    assert tr.feed_raw(_raw(pid, fd), resolver=_none_resolver) is None
     assert len(tr.io_events) == 1
     ev = telemetry_pb2.ProcEvent()
     ev.ParseFromString(tr.io_events[0])
@@ -77,22 +88,24 @@ def test_gate_emits_proc_event_for_slow_traced_file_io(held_file):
 def test_gate_mode1_requires_in_flight_trace(held_file):
     pid, fd, _ = held_file
     tr = EbpfTracer()
-    tr.feed(_rec(pid, fd, trace_id=0))
+    tr.feed_raw(_raw(pid, fd, trace_id=0), resolver=_none_resolver)
     assert tr.io_events == []                   # no trace: skip (mode 1)
     tr2 = EbpfTracer(io_event_collect_mode=2)
-    tr2.feed(_rec(pid, fd, trace_id=0))
+    tr2.feed_raw(_raw(pid, fd, trace_id=0), resolver=_none_resolver)
     assert len(tr2.io_events) == 1              # mode 2: everything
     tr3 = EbpfTracer(io_event_collect_mode=0)
-    tr3.feed(_rec(pid, fd))
+    tr3.feed_raw(_raw(pid, fd), resolver=_none_resolver)
     assert tr3.io_events == []                  # off
 
 
 def test_gate_minimal_duration(held_file):
     pid, fd, _ = held_file
     tr = EbpfTracer()
-    tr.feed(_rec(pid, fd, latency_ns=MS // 2))
+    tr.feed_raw(_raw(pid, fd, latency_ns=MS // 2),
+                resolver=_none_resolver)
     assert tr.io_events == []                   # under 1ms default
-    tr.feed(_rec(pid, fd, latency_ns=2 * MS))
+    tr.feed_raw(_raw(pid, fd, latency_ns=2 * MS),
+                resolver=_none_resolver)
     assert len(tr.io_events) == 1
 
 
@@ -143,7 +156,7 @@ def test_buffer_cap_drops_loudly(held_file):
     tr = EbpfTracer()
     tr._IO_EVENTS_CAP = 3
     for _ in range(5):
-        tr.feed(_rec(pid, fd))
+        tr.feed_raw(_raw(pid, fd), resolver=_none_resolver)
     assert len(tr.io_events) == 3
     assert tr.io_events_dropped == 2
 
@@ -167,7 +180,8 @@ def test_agent_ships_io_events_to_perf_event_table(held_file, tmp_path):
             ingester_addr=f"127.0.0.1:{ing.port}"))
         agent.vtap_id = 12
         agent.ebpf_tracer = EbpfTracer(vtap_id=12)
-        agent.ebpf_tracer.feed(_rec(pid, fd, latency_ns=7 * MS))
+        agent.ebpf_tracer.feed_raw(_raw(pid, fd, latency_ns=7 * MS),
+                                   resolver=_none_resolver)
         sent = agent.tick()
         assert sent.get("proc_events", 0) >= 1
         deadline = time.time() + 10
@@ -189,3 +203,19 @@ def test_agent_ships_io_events_to_perf_event_table(held_file, tmp_path):
         if agent is not None:
             agent.close()
         ing.close()
+
+def test_fixture_feed_without_resolver_never_classifies(held_file):
+    """A replay/fixture feed (no resolver ever configured) must not
+    consult this machine's /proc: a replayed pid colliding with a live
+    local process would otherwise swallow the record as a spurious IO
+    event and lose its L7 session (ADVICE r5). Zero tuples are only
+    'proven non-socket' once a resolver has actually run."""
+    pid, fd, _ = held_file
+    tr = EbpfTracer(io_event_collect_mode=2)
+    tr.feed(_rec(pid, fd))                      # direct fixture feed
+    assert tr.io_events == []
+    tr.feed_raw(_raw(pid, fd))                  # still no resolver
+    assert tr.io_events == []
+    # the first resolver-armed record flips the gate on for good
+    tr.feed_raw(_raw(pid, fd), resolver=_none_resolver)
+    assert len(tr.io_events) == 1
