@@ -1,0 +1,354 @@
+"""Pipeline flight recorder: spans, latency histograms, TPU attribution.
+
+The framework's core claim is zero-instrumentation observability of OTHER
+people's systems; this module is the half of self-observation the
+Countable registry (runtime/stats.py) doesn't cover — *where a batch's
+wall time goes*. Every hot-path stage (receiver dispatch, decode, queue
+dwell, kernel h2d/dispatch/device, window flush, export) records spans
+into:
+
+- a fixed-size ring of completed spans (the "flight recorder": the last
+  N spans survive for post-hoc inspection through the `spans` debug
+  command even after the workload that produced them has moved on), and
+- per-stage host-side DDSketch histograms (the pure-Python mirror of
+  ops/ddsketch.py's quantile math: geometric buckets, bounded RELATIVE
+  error), so p50/p95/p99 per stage are queryable at any time without
+  keeping raw samples.
+
+Batch causality rides a monotonically increasing `batch_id`: the
+receiver stamps one on every frame, the decoder anchors its chunk to the
+first frame's id and hands it to the exporter fan-out, and the sketch
+exporters carry it into kernel attribution — so one slow batch can be
+followed receiver -> decode -> export -> kernel from the span ring.
+
+Cost discipline (the design constraint everything here bends around):
+
+- DISABLED (default): `span()` returns a shared no-op context manager —
+  zero allocations; hot call sites additionally guard on `tracer.enabled`
+  so not even an argument tuple is built.
+- ENABLED: one perf_counter pair + one histogram add + one ring store
+  per span, a few microseconds against millisecond-scale batch stages.
+  Spans are per *batch/frame*, never per record.
+
+The ring is lock-free-ish: writers do an unsynchronized
+reserve-and-store (`i = n; n = i + 1`), relying on the GIL for memory
+safety. Two racing writers may very occasionally overwrite one another's
+slot or skip one — an acceptable loss for a diagnostic buffer that must
+never serialize the hot path. Reads snapshot under a lock.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["HostDDSketch", "Tracer", "default_tracer"]
+
+
+class HostDDSketch:
+    """Host-side (pure Python + array-module-free) DDSketch mirror of
+    ops/ddsketch.py: values land in geometric buckets
+    (gamma = (1+alpha)/(1-alpha)); any quantile reads back with bounded
+    relative error alpha; sketches merge by elementwise add. Sized for
+    durations in SECONDS: with alpha=0.01 and 1024 buckets the range
+    spans min_value=1us to ~770s, wider than any sane pipeline stage."""
+
+    __slots__ = ("alpha", "min_value", "buckets", "gamma", "_inv_log_gamma",
+                 "counts", "zeros", "count", "sum", "max")
+
+    def __init__(self, alpha: float = 0.01, min_value: float = 1e-6,
+                 buckets: int = 1024) -> None:
+        self.alpha = alpha
+        self.min_value = min_value
+        self.buckets = buckets
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._inv_log_gamma = 1.0 / math.log(self.gamma)
+        self.counts = [0] * buckets
+        self.zeros = 0          # values below min_value
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if v > self.max:
+            self.max = v
+        if v < self.min_value:
+            self.zeros += 1
+            return
+        i = int(math.ceil(math.log(v / self.min_value)
+                          * self._inv_log_gamma))
+        if i < 0:
+            i = 0
+        elif i >= self.buckets:
+            i = self.buckets - 1
+        self.counts[i] += 1
+
+    def quantile(self, q: float) -> float:
+        """q-quantile estimate (same bucket-midpoint readback as
+        ops/ddsketch.quantile); 0.0 when empty or below min_value."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        if target <= self.zeros:
+            return 0.0
+        acc = self.zeros
+        idx = self.buckets - 1
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                idx = i
+                break
+        g = self.gamma
+        return self.min_value * (2.0 * g ** idx) / (g + 1.0)
+
+    def merge(self, other: "HostDDSketch") -> None:
+        """Exact union (DDSketch's defining property) — bucket layouts
+        must match (same alpha/min_value/buckets)."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.zeros += other.zeros
+        self.count += other.count
+        self.sum += other.sum
+        if other.max > self.max:
+            self.max = other.max
+
+    def cumulative_buckets(self, stride: int = 32) -> List[tuple]:
+        """[(upper_bound_seconds, cumulative_count)] at every stride-th
+        gamma boundary — the Prometheus `le` bucket series (the +Inf
+        bucket is `count` and is the caller's to append). Values below
+        min_value (zeros) sit under every boundary."""
+        return self.snapshot(stride)[0]
+
+    def snapshot(self, stride: int = 32) -> tuple:
+        """(cumulative_buckets, total, sum) derived from ONE copy of
+        the bucket array: writers add() concurrently without a lock,
+        so a renderer that read buckets and `count` separately could
+        emit a +Inf bucket that disagrees with _count and fail its own
+        strict validator — everything here is internally consistent by
+        construction (total == the last cumulative value)."""
+        counts = list(self.counts)
+        zeros = self.zeros
+        sum_ = self.sum
+        out = []
+        acc = zeros
+        g = self.gamma
+        for i in range(0, self.buckets, stride):
+            for j in range(i, min(i + stride, self.buckets)):
+                acc += counts[j]
+            out.append((self.min_value
+                        * g ** min(i + stride - 1, self.buckets - 1), acc))
+        return out, acc, sum_
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disabled-tracer fast path
+    allocates NOTHING (one module-level instance serves every call)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "stage", "stream", "batch_id", "rows", "t0")
+
+    def __init__(self, tracer: "Tracer", stage: str, stream: str,
+                 batch_id: int, rows: int) -> None:
+        self._tracer = tracer
+        self.stage = stage
+        self.stream = stream
+        self.batch_id = batch_id
+        self.rows = rows
+        self.t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer.observe(self.stage, time.perf_counter() - self.t0,
+                             stream=self.stream, batch_id=self.batch_id,
+                             rows=self.rows, t0=self.t0)
+        return False
+
+
+class Tracer:
+    """Span recorder + per-stage latency histograms + gauges.
+
+    Disabled by default; `Ingester` enables the process default when
+    cfg.trace_enabled (the CLI `trace` family and the Prometheus
+    endpoint read from it). One Tracer serves the whole process — the
+    flight-recorder role is process-scoped, like the `stacks` debug
+    command (a second in-process ingester's spans land in the same ring,
+    distinguishable by stream labels)."""
+
+    def __init__(self, ring: int = 4096, alpha: float = 0.01,
+                 min_value_s: float = 1e-6, buckets: int = 1024) -> None:
+        self.enabled = False
+        self._ring: List[Optional[tuple]] = [None] * ring
+        self._ring_cap = ring
+        self._n = 0                     # total spans recorded (ever)
+        self._alpha = alpha
+        self._min_value_s = min_value_s
+        self._buckets = buckets
+        self._stages: Dict[str, HostDDSketch] = {}
+        self._gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()   # reads + stage/gauge creation
+        self._batch_seq = 0
+        self._tls = threading.local()
+
+    # -- lifecycle ---------------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = [None] * self._ring_cap
+            self._n = 0
+            self._stages = {}
+            self._gauges = {}
+
+    # -- batch causality ---------------------------------------------------
+    def next_batch(self) -> int:
+        """Allocate a batch id (monotonic; GIL-atomic enough — a rare
+        duplicate id degrades causality, never correctness)."""
+        b = self._batch_seq + 1
+        self._batch_seq = b
+        return b
+
+    def set_batch(self, batch_id: int) -> None:
+        """Pin the calling thread's current batch id (consumed by spans
+        recorded with batch_id=-1 — the implicit propagation hop across
+        a queue boundary)."""
+        self._tls.batch = batch_id
+
+    def current_batch(self) -> int:
+        return getattr(self._tls, "batch", -1)
+
+    # -- recording ---------------------------------------------------------
+    def span(self, stage: str, stream: str = "", batch_id: int = -1,
+             rows: int = 0):
+        """Context manager timing one stage execution. Returns a shared
+        no-op when disabled (zero allocations)."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, stage, stream, batch_id, rows)
+
+    def observe(self, stage: str, dur_s: float, stream: str = "",
+                batch_id: int = -1, rows: int = 0,
+                t0: Optional[float] = None) -> None:
+        """Record one completed span (the non-context-manager form the
+        hot call sites use behind their own `enabled` guard)."""
+        if not self.enabled:
+            return
+        if batch_id < 0:
+            batch_id = self.current_batch()
+        sk = self._stages.get(stage)
+        if sk is None:
+            with self._lock:
+                sk = self._stages.setdefault(
+                    stage, HostDDSketch(self._alpha, self._min_value_s,
+                                        self._buckets))
+        sk.add(dur_s)
+        # lock-free-ish reserve-and-store (see module docstring)
+        i = self._n
+        self._n = i + 1
+        self._ring[i % self._ring_cap] = (
+            stage, stream, batch_id,
+            time.time() if t0 is None else time.time() - dur_s,
+            dur_s, rows)
+
+    def gauge(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        self._gauges[name] = float(value)
+
+    # -- readback ----------------------------------------------------------
+    @property
+    def spans_recorded(self) -> int:
+        return self._n
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def stages(self) -> Dict[str, HostDDSketch]:
+        """Snapshot of the stage map (sketches themselves are live)."""
+        with self._lock:
+            return dict(self._stages)
+
+    def latency(self) -> Dict[str, Dict[str, float]]:
+        """{stage: {count, p50_ms, p95_ms, p99_ms, max_ms, mean_ms}} —
+        the `trace latency` table."""
+        out = {}
+        for stage, sk in sorted(self.stages().items()):
+            if sk.count == 0:
+                continue
+            out[stage] = {
+                "count": sk.count,
+                "p50_ms": sk.quantile(0.50) * 1e3,
+                "p95_ms": sk.quantile(0.95) * 1e3,
+                "p99_ms": sk.quantile(0.99) * 1e3,
+                "max_ms": sk.max * 1e3,
+                "mean_ms": (sk.sum / sk.count) * 1e3,
+            }
+        return out
+
+    def recent(self, n: int = 32, stage: Optional[str] = None,
+               slow_ms: Optional[float] = None) -> List[dict]:
+        """Most recent completed spans, newest first; optionally only
+        one stage, optionally only spans slower than slow_ms."""
+        with self._lock:
+            total = self._n
+            ring = list(self._ring)
+        out: List[dict] = []
+        for k in range(total - 1, max(total - self._ring_cap, 0) - 1, -1):
+            s = ring[k % self._ring_cap]
+            if s is None:
+                continue
+            if stage is not None and s[0] != stage:
+                continue
+            if slow_ms is not None and s[4] * 1e3 < slow_ms:
+                continue
+            out.append({"stage": s[0], "stream": s[1], "batch_id": s[2],
+                        "ts": s[3], "dur_ms": s[4] * 1e3, "rows": s[5]})
+            if len(out) >= n:
+                break
+        return out
+
+    def counters(self) -> dict:
+        """Countable for the stats registry: scrape-friendly totals."""
+        c = {"spans": self._n, "batches": self._batch_seq,
+             "enabled": 1.0 if self.enabled else 0.0}
+        for stage, sk in self.stages().items():
+            key = stage.replace(".", "_")
+            c[f"{key}_count"] = sk.count
+            c[f"{key}_sum_s"] = sk.sum
+        return c
+
+
+_default: Optional[Tracer] = None
+_default_lock = threading.Lock()
+
+
+def default_tracer() -> Tracer:
+    """The process flight recorder (mirrors stats.default_registry)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Tracer()
+        return _default
