@@ -83,6 +83,95 @@ print("exposition OK:", len(text.splitlines()), "lines,",
       len(default_tracer().latency()), "stages")
 EOF
 
+echo "== chaos smoke: breaker + supervisor + degraded sketch =="
+# Deterministic fault injection (runtime/faults.py, fixed seed) against a
+# live ingester: one exporter raises 100% for 5s then heals, and the
+# tpu_sketch device path is killed once. The process must stay up, the
+# breaker must open and re-close via its half-open probe, zero exceptions
+# may reach the decode stage, the sketch lane must restore from its
+# checkpoint, and every loss must be visible as Countables on /metrics.
+python - <<'EOF'
+import socket, tempfile, time, urllib.request
+import numpy as np
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+class Flaky:
+    name = "flaky"
+    def start(self): pass
+    def close(self): pass
+    def is_export_data(self, stream, cols): return stream == "l4_flow_log"
+    def put(self, stream, idx, cols): pass
+
+store = tempfile.mkdtemp(prefix="chaos_store_")
+ing = Ingester(IngesterConfig(
+    listen_port=0, prom_port=0, tpu_sketch_window_s=0.5, store_path=store,
+    breaker_min_calls=2, breaker_open_s=1.5, breaker_half_open_probes=1,
+    fault_spec=("exporter.raise:p=1.0,for_s=5,match=flaky;"
+                "tpu.device_error:count=1,after=2;seed=7")),
+    platform=PlatformDataManager())
+ing.exporters.register(Flaky())
+ing.start()
+r = np.random.default_rng(0)
+cols = {name: r.integers(0, 1 << 8, 500).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+states_seen, sent = set(), 0
+deadline = time.time() + 9.0
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    while time.time() < deadline:
+        s.sendall(frame); sent += 500
+        states_seen.add(ing.exporters.breakers()["flaky"]["state"])
+        if ("open" in states_seen and "closed" in states_seen
+                and ing.tpu_sketch.device_errors >= 1
+                and ing.exporters.breakers()["flaky"]["closes"] >= 1):
+            break
+        time.sleep(0.1)
+
+br = ing.exporters.breakers()["flaky"]
+assert br["trips"] >= 1, f"breaker never opened: {br}"
+assert br["closes"] >= 1 and br["state"] == "closed", \
+    f"breaker never re-closed via half-open probe: {br}"
+assert ing.exporters.put_errors >= 2 and ing.exporters.shed_count >= 1, \
+    "loss must be counted (put_errors/shed)"
+# zero exceptions reached the decode stage: every decoder alive, zero crashes
+dec = [t for t in ing.supervisor.threads() if t["name"].startswith("decode-")]
+assert dec and all(t["alive"] and t["crashes"] == 0 for t in dec), dec
+deadline = time.time() + 10.0
+while time.time() < deadline:
+    decoded = sum(d.records for d in ing.flow_log.decoders)
+    if decoded >= sent:
+        break
+    time.sleep(0.1)
+assert decoded >= sent, f"decode stalled: {decoded} < {sent}"
+# the killed device path restored from checkpoint, <=1 window lost
+sk = ing.tpu_sketch
+assert sk.device_errors >= 1 and sk.lost_windows <= 1, sk.counters()
+assert sk.checkpointer.counters()["restores"] >= 1, sk.checkpointer.counters()
+assert not sk.degraded
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing.prom_port}/metrics", timeout=10) as resp:
+    text = resp.read().decode()
+assert not validate_exposition(text)
+for needle in ("deepflow_breaker_flaky_trips", "deepflow_breaker_flaky_closes",
+               "deepflow_exporters_put_errors", "deepflow_supervisor_crashes",
+               "deepflow_supervisor_restarts",
+               "deepflow_exporter_tpu_sketch_device_errors",
+               "deepflow_exporter_tpu_sketch_lost_windows",
+               "deepflow_faults_armed"):
+    assert needle in text, f"{needle} absent from /metrics"
+ing.close()
+print(f"chaos OK: {sent} records sent, {decoded} decoded, breaker {br['trips']}"
+      f" trip(s)/{br['closes']} close(s), sketch restored "
+      f"{sk.checkpointer.counters()['restores']}x, {sk.lost_windows} window lost")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax
@@ -143,6 +232,8 @@ assert d["lane_windows"] and d["headline_window"] is not None
 for lane in ("packed", "dict"):
     sb = d["stage_breakdown"][lane]
     assert sb["h2d_mb_s"] > 0 and sb["kernel_records_per_sec"] > 0, sb
+# the degraded-mode floor must be measured, not asserted by docstring
+assert d["stage_breakdown"]["host_fallback"]["records_per_sec"] > 0
 print("bench smoke OK:", d["value"], "rec/s (CPU small),",
       "dict kernel", d["stage_breakdown"]["dict"]["kernel_records_per_sec"],
       "rec/s")

@@ -308,7 +308,7 @@ def cmd_ingester(args) -> int:
                             count=args.count)
         print(json.dumps(out, indent=2, sort_keys=True))
     elif args.action in ("counters", "vtap-status", "ping", "stacks",
-                         "artifacts", "queues"):
+                         "artifacts", "queues", "supervisor", "breakers"):
         out = debug_request(args.action,
                             port=args.debug_port or DEFAULT_DEBUG_PORT,
                             **({"module": args.module} if args.module
@@ -562,7 +562,8 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("action", choices=["set", "assignments", "counters",
                                       "vtap-status", "ping", "stacks",
                                       "artifacts", "datasource",
-                                      "queues", "queue-tap"])
+                                      "queues", "queue-tap",
+                                      "supervisor", "breakers"])
     i.add_argument("addrs", nargs="*")
     i.add_argument("--module")
     i.add_argument("--op", default="list",

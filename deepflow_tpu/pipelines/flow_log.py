@@ -59,8 +59,14 @@ def stamp_row_ids(cols: Dict[str, np.ndarray],
 FLOW_LOG_DB = "flow_log"
 
 
-class _Decoder(threading.Thread):
-    """One decoder worker for one stream type (reference: decoder.go Run)."""
+class _Decoder:
+    """One decoder worker for one stream type (reference: decoder.go Run).
+
+    A plain run() loop, not a Thread: the pipeline spawns it through
+    the process Supervisor (runtime/supervisor.py), so an unexpected
+    crash (decode handles its own known failure shapes below) is
+    captured with its traceback and the worker restarts with backoff
+    instead of silently going dark."""
 
     def __init__(self, stream: str, index: int, queues: MultiQueue,
                  decode_fn, enrich_fn,
@@ -68,7 +74,7 @@ class _Decoder(threading.Thread):
                  writer: Optional[StoreWriter], exporters: Optional[Exporters],
                  batch: int = 64, payload_decode_fns=None,
                  frame_mode: bool = False) -> None:
-        super().__init__(name=f"decode-{stream}-{index}", daemon=True)
+        self.name = f"decode-{stream}-{index}"
         self.stream = stream
         self.index = index
         self.queues = queues
@@ -94,7 +100,11 @@ class _Decoder(threading.Thread):
         self._tracer = default_tracer()
 
     def run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+
+        sup = default_supervisor()
         while not self._halt.is_set():
+            sup.beat()
             frames: List[Frame] = self.queues.gets(self.index, self.batch,
                                                    timeout=0.2)
             if not frames:
@@ -398,10 +408,12 @@ class FlowLogPipeline:
             stats.register("decoder.l4_packet.0", pseq_decoder.counters)
 
     def start(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+
         for w in self.writers:
             w.start()
-        for d in self.decoders:
-            d.start()
+        sup = default_supervisor()
+        self._handles = [sup.spawn(d.name, d.run) for d in self.decoders]
 
     def flush(self) -> None:
         """Drain open throttle buckets and pending writer rows to disk."""
@@ -468,8 +480,9 @@ class FlowLogPipeline:
             queues.close()
         for d in self.decoders:
             d.stop()
-        for d in self.decoders:
-            d.join(timeout=2)
+        for h in getattr(self, "_handles", ()):
+            h.stop()
+            h.join(timeout=2)
         for w in self.writers:
             w.close()
         if self._pseq_blob is not None:

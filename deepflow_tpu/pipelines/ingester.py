@@ -74,6 +74,27 @@ class IngesterConfig:
     # disables, 0 binds an ephemeral port (reference: the :9526
     # stats/pprof listener)
     prom_port: Optional[int] = None
+    # -- resilience (runtime/supervisor.py, breaker.py, faults.py) ----
+    # deadman watchdog: a supervised worker whose last heartbeat is
+    # older than this is counted stale (detection only; the `stacks`
+    # debug command shows where it sits). 0 disables.
+    supervisor_deadman_s: float = 60.0
+    # crash-restart backoff base (doubles per consecutive crash, capped
+    # at 100x base, deterministic jitter)
+    supervisor_backoff_s: float = 0.05
+    # per-exporter circuit breakers around the decode->export fan-out;
+    # False runs unwrapped (errors still contained, never quarantined)
+    breaker_enabled: bool = True
+    breaker_failure_rate: float = 0.5   # window fraction that trips
+    breaker_min_calls: int = 4          # outcomes before a trip decision
+    breaker_open_s: float = 5.0         # quarantine before half-open
+    breaker_half_open_probes: int = 2   # probes that must all succeed
+    # a put() slower than this counts as a failure; None disables
+    breaker_latency_budget_s: Optional[float] = None
+    # deterministic fault injection (runtime/faults.py spec string,
+    # e.g. "exporter.raise:p=1,for_s=5;seed=7"); also read from the
+    # DEEPFLOW_FAULTS env var — config wins when both are set
+    fault_spec: Optional[str] = None
 
 
 class Ingester:
@@ -89,8 +110,39 @@ class Ingester:
         if cfg.trace_enabled:
             self.tracer.enable()
         self.stats.register("tracer", self.tracer.counters)
+        # supervision tree: every worker thread below (receiver loops,
+        # decoders, exporter workers) spawns through the process
+        # supervisor — crash capture, backoff restart, deadman watchdog
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self.supervisor = default_supervisor()
+        # 0/None disables the watchdog (workers spawn with deadman None)
+        self.supervisor.deadman_s = cfg.supervisor_deadman_s or None
+        self.supervisor.backoff_base_s = cfg.supervisor_backoff_s
+        self.supervisor.backoff_cap_s = 100 * cfg.supervisor_backoff_s
+        self.stats.register("supervisor", self.supervisor.counters)
+        # deterministic chaos: arm fault sites from config/env so a
+        # chaos smoke replays the same schedule every run
+        from deepflow_tpu.runtime.faults import default_faults
+        self.faults = default_faults()
+        self._armed_sites: list = []
+        spec = cfg.fault_spec or os.environ.get("DEEPFLOW_FAULTS")
+        if spec:
+            # remembered so close() disarms exactly what THIS instance
+            # armed — chaos must not leak into a successor ingester
+            self._armed_sites = self.faults.arm_spec(spec)
+            self.stats.register("faults", self.faults.counters)
+        from deepflow_tpu.runtime.breaker import BreakerConfig
+        breaker_cfg = None
+        if cfg.breaker_enabled:
+            breaker_cfg = BreakerConfig(
+                failure_rate=cfg.breaker_failure_rate,
+                min_calls=cfg.breaker_min_calls,
+                open_s=cfg.breaker_open_s,
+                half_open_probes=cfg.breaker_half_open_probes,
+                latency_budget_s=cfg.breaker_latency_budget_s)
         self.platform = platform or PlatformDataManager(stats=self.stats)
-        self.exporters = Exporters(stats=self.stats)
+        self.exporters = Exporters(stats=self.stats,
+                                   breaker_cfg=breaker_cfg)
         self.store: Optional[Store] = None
         self.monitor: Optional[DiskMonitor] = None
         if cfg.store_path is not None:
@@ -151,7 +203,8 @@ class Ingester:
             from deepflow_tpu.runtime.promexpo import PrometheusExporter
             self.prom = PrometheusExporter(stats=self.stats,
                                            tracer=self.tracer,
-                                           port=cfg.prom_port)
+                                           port=cfg.prom_port,
+                                           health=self.health)
         self.debug = None
         if cfg.debug_port is not None:
             from deepflow_tpu.runtime.debug import DebugServer
@@ -165,6 +218,32 @@ class Ingester:
             self.debug.register("datasource", self._datasource_cmd)
             self.debug.register("queues", self._queues_cmd)
             self.debug.register("queue-tap", self._queue_tap_cmd)
+            # `supervisor` rides DebugServer's built-in handler (the
+            # supervision tree is process-scoped, like the tracer)
+            self.debug.register("breakers",
+                                lambda req: self.exporters.breakers())
+
+    def health(self) -> dict:
+        """Liveness verdict for the /healthz endpoint: not-ok when any
+        supervised worker is deadman-stale, any exporter breaker is
+        open (quarantined), or the tpu_sketch lane is running degraded
+        on the host fallback. The supervision tree is process-scoped
+        (like the flight recorder), so in the rare several-ingesters-
+        per-process deployment the stale/crash numbers aggregate across
+        all of them — breakers and the degraded flag stay per-instance."""
+        sup = self.supervisor.counters()
+        open_breakers = [n for n, c in self.exporters.breakers().items()
+                         if c["state"] == "open"]
+        degraded = bool(self.tpu_sketch is not None
+                        and self.tpu_sketch.degraded)
+        return {
+            "ok": not (sup["stale"] or open_breakers or degraded),
+            "stale_threads": sup["stale"],
+            "crashes": sup["crashes"],
+            "restarts": sup["restarts"],
+            "open_breakers": open_breakers,
+            "degraded_tpu_sketch": degraded,
+        }
 
     def _own_queues(self) -> dict:
         """THIS ingester's inter-stage MultiQueues by name. Scoped to
@@ -335,6 +414,12 @@ class Ingester:
         self.exporters.close()
         self.tag_dicts.close()
         self.stats.deregister("tracer")
+        self.stats.deregister("supervisor")
+        for site in self._armed_sites:
+            self.faults.disarm(site)
+        if self._armed_sites:
+            self.stats.deregister("faults")
+            self._armed_sites = []
 
     @property
     def port(self) -> int:

@@ -18,6 +18,8 @@ import numpy as np
 
 import jax
 
+from deepflow_tpu.runtime.faults import FAULT_CHECKPOINT_TORN, default_faults
+
 
 class SketchCheckpointer:
     """Atomic rolling snapshots of one pytree state."""
@@ -41,6 +43,14 @@ class SketchCheckpointer:
         with open(tmp, "wb") as f:
             np.savez(f, **{f"leaf_{i}": a for i, a in enumerate(host)},
                      __step=np.asarray(step, np.int64))
+        faults = default_faults()
+        if faults.enabled and faults.should_fire(FAULT_CHECKPOINT_TORN,
+                                                 key=self.name):
+            # chaos: the worst torn-write shape — a truncated file that
+            # still made it to its final name; restore must skip it
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as f:
+                f.truncate(max(1, size // 2))
         os.replace(tmp, path)
         self.saves += 1
         self._gc()
@@ -71,10 +81,20 @@ class SketchCheckpointer:
             path = os.path.join(self.directory, fname)
             try:
                 with np.load(path) as z:
+                    # the stored leaf COUNT must match exactly: a stale
+                    # snapshot from a bigger config whose first N leaves
+                    # happen to match shapes must be refused, not
+                    # silently half-loaded
+                    stored = sum(1 for k in z.files if k.startswith("leaf_"))
+                    if stored != len(like_leaves):
+                        continue
                     loaded = [z[f"leaf_{i}"]
                               for i in range(len(like_leaves))]
-            except (OSError, KeyError, ValueError):
-                continue  # torn or incompatible file: try the previous one
+            except Exception:
+                # torn or incompatible file (np.load raises OSError,
+                # BadZipFile, EOFError, ... depending on where the tear
+                # landed): try the previous snapshot
+                continue
             ok = all(
                 a.shape == np.shape(b) and a.dtype == np.asarray(b).dtype
                 for a, b in zip(loaded, like_leaves))

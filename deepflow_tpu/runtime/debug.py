@@ -35,6 +35,9 @@ class DebugServer:
             "latency": self._latency,
             "spans": self._spans,
             "rrt": self._rrt,
+            # default supervision-tree view (the Ingester overrides this
+            # with its own registration — same shape, same command)
+            "supervisor": self._supervisor,
         }
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
@@ -87,6 +90,23 @@ class DebugServer:
                 "kernel_stages": {k: v for k, v in lat.items()
                                   if k.startswith(("kernel", "shard"))},
                 "spans_recorded": self.tracer.spans_recorded}
+
+    @staticmethod
+    def _supervisor(req: dict) -> dict:
+        """Process supervision tree: worker liveness/restart rows + the
+        retained crash ring (tracebacks truncated for the one-datagram
+        budget). Pairs with `stacks` — this says WHICH worker is
+        crash-looping or deadman-stale, stacks says WHERE it sits."""
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+
+        sup = default_supervisor()
+        want = req.get("module") or ""
+        return {
+            "counters": sup.counters(),
+            "threads": [t for t in sup.threads() if want in t["name"]],
+            "crashes": [{**c, "traceback": c["traceback"][-1200:]}
+                        for c in sup.crash_log()[-8:]],
+        }
 
     @staticmethod
     def _stacks(req: dict) -> dict:

@@ -15,11 +15,16 @@ writer want.
 
 from __future__ import annotations
 
-import threading
+import time
 from typing import Any, Dict, List, Optional, Protocol, Sequence
 
+from deepflow_tpu.runtime.breaker import BreakerConfig, CircuitBreaker
+from deepflow_tpu.runtime.faults import (FAULT_EXPORTER_PROCESS,
+                                         FAULT_EXPORTER_RAISE,
+                                         default_faults)
 from deepflow_tpu.runtime.queues import OverwriteQueue
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.runtime.tracing import default_tracer
 
 
@@ -46,13 +51,31 @@ class Exporter(Protocol):
 
 
 class Exporters:
-    """Registry + fan-out. One instance sits after the decode stage."""
+    """Registry + fan-out. One instance sits after the decode stage.
 
-    def __init__(self, stats: Optional[StatsRegistry] = None) -> None:
+    Fault domain: `put` runs on the DECODER thread, so a raising
+    exporter used to poison decode for every stream. Each registered
+    exporter now sits behind its own `CircuitBreaker`: a raise (or a
+    put slower than the latency budget) is recorded against that
+    exporter alone; a tripped breaker quarantines it — its puts are
+    shed and counted (`shed`) while siblings and the decode stage keep
+    flowing — and a half-open probe re-admits it once it recovers.
+    Pass ``breaker_cfg=None`` to run unwrapped (errors still contained,
+    never quarantined)."""
+
+    def __init__(self, stats: Optional[StatsRegistry] = None,
+                 breaker_cfg: Optional[BreakerConfig] = BreakerConfig()
+                 ) -> None:
         self._exporters: List[Exporter] = []
+        self._breakers: List[Optional[CircuitBreaker]] = []
+        self._breaker_cfg = breaker_cfg
+        self._stats = stats
+        self._faults = default_faults()
         self._started = False
         self.put_count = 0
         self.filtered_count = 0
+        self.put_errors = 0        # exporter raised out of put/filter
+        self.shed_count = 0        # puts dropped by an open breaker
         if stats is not None:
             stats.register("exporters", self.counters)
 
@@ -60,6 +83,14 @@ class Exporters:
         if self._started:
             raise RuntimeError("register before start()")
         self._exporters.append(exporter)
+        breaker = None
+        if self._breaker_cfg is not None:
+            name = getattr(exporter, "name",
+                           f"exporter{len(self._exporters) - 1}")
+            breaker = CircuitBreaker(name, self._breaker_cfg)
+            if self._stats is not None:
+                self._stats.register(f"breaker.{name}", breaker.counters)
+        self._breakers.append(breaker)
 
     def start(self) -> None:
         self._started = True
@@ -73,15 +104,50 @@ class Exporters:
 
     def put(self, stream: str, decoder_index: int,
             cols: Dict[str, Any]) -> None:
-        for e in self._exporters:
-            if e.is_export_data(stream, cols):
+        faults = self._faults
+        for e, breaker in zip(self._exporters, self._breakers):
+            # filter FIRST, outside breaker accounting: a stream the
+            # exporter doesn't want must neither dilute its failure
+            # window nor satisfy a half-open probe untested. A RAISING
+            # filter is counted loss but deliberately NOT a breaker
+            # outcome — the breaker quarantines the put path (where
+            # real backends fail); tripping it on a filter bug would
+            # read "open" while the broken filter keeps running, a
+            # quarantine in name only.
+            try:
+                if not e.is_export_data(stream, cols):
+                    self.filtered_count += 1
+                    continue
+            except Exception:
+                self.put_errors += 1
+                continue
+            if breaker is not None and not breaker.allow():
+                self.shed_count += 1   # breaker counts its own `dropped`
+                continue
+            t0 = time.perf_counter()
+            try:
+                if faults.enabled:
+                    faults.maybe_raise(FAULT_EXPORTER_RAISE,
+                                       key=getattr(e, "name", ""))
                 e.put(stream, decoder_index, cols)
                 self.put_count += 1
+            except Exception:
+                # counted loss, never an exception into the decode stage
+                self.put_errors += 1
+                if breaker is not None:
+                    breaker.record_failure()
             else:
-                self.filtered_count += 1
+                if breaker is not None:
+                    breaker.record_success(time.perf_counter() - t0)
+
+    def breakers(self) -> Dict[str, dict]:
+        """Per-exporter breaker states (the `breakers` debug command)."""
+        return {b.name: b.counters()
+                for b in self._breakers if b is not None}
 
     def counters(self) -> dict:
         return {"put": self.put_count, "filtered": self.filtered_count,
+                "put_errors": self.put_errors, "shed": self.shed_count,
                 "n_exporters": len(self._exporters)}
 
 
@@ -102,8 +168,9 @@ class QueueWorkerExporter:
         self.queue = OverwriteQueue(f"exporter.{name}", queue_size)
         self.n_workers = n_workers
         self.batch = batch
-        self._threads: List[threading.Thread] = []
+        self._handles: List = []       # supervisor ThreadHandles
         self.processed = 0
+        self.process_errors = 0        # process() raised; batch dropped
         self._tracer = default_tracer()
         self.queue.trace_dwell(self._tracer, f"queue.exporter.{name}")
         if stats is not None:
@@ -111,17 +178,17 @@ class QueueWorkerExporter:
 
     # -- Exporter contract -------------------------------------------------
     def start(self) -> None:
+        sup = default_supervisor()
         for i in range(self.n_workers):
-            t = threading.Thread(target=self._run, name=f"{self.name}-{i}",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._handles.append(
+                sup.spawn(f"{self.name}-{i}", self._run))
 
     def close(self) -> None:
         self.queue.close()
-        for t in self._threads:
-            t.join(timeout=5)
-        self._threads.clear()
+        for h in self._handles:
+            h.stop()
+            h.join(timeout=5)
+        self._handles.clear()
 
     def is_export_data(self, stream: str, cols: Dict[str, Any]) -> bool:
         return stream in self.streams
@@ -157,24 +224,40 @@ class QueueWorkerExporter:
 
     def _run(self) -> None:
         tracer = self._tracer
+        sup = default_supervisor()
+        faults = default_faults()
         while True:
+            sup.beat()
             chunks = self.queue.gets(self.batch, timeout=0.2)
             if chunks:
-                if tracer.enabled:
-                    rows = sum(
-                        len(next(iter(c[2].values()))) if c[2] else 0
-                        for c in chunks)
-                    tracer.set_batch(chunks[0][3])
-                    with tracer.span("export", stream=self.name,
-                                     batch_id=chunks[0][3], rows=rows):
+                # a raising process() must not kill the worker: the
+                # batch is counted loss and the drain continues. Errors
+                # that escape THIS loop (queue layer bugs) crash the
+                # thread into the supervisor, which restarts it with
+                # backoff — two containment layers, different scopes.
+                try:
+                    if faults.enabled:
+                        faults.maybe_raise(FAULT_EXPORTER_PROCESS,
+                                           key=self.name)
+                    if tracer.enabled:
+                        rows = sum(
+                            len(next(iter(c[2].values()))) if c[2] else 0
+                            for c in chunks)
+                        tracer.set_batch(chunks[0][3])
+                        with tracer.span("export", stream=self.name,
+                                         batch_id=chunks[0][3], rows=rows):
+                            self.process(chunks)
+                    else:
                         self.process(chunks)
+                except Exception:
+                    self.process_errors += 1
                 else:
-                    self.process(chunks)
-                self.processed += len(chunks)
+                    self.processed += len(chunks)
             elif self.queue.closed:
                 return
 
     def counters(self) -> dict:
         c = self.queue.counters()
         c["processed"] = self.processed
+        c["process_errors"] = self.process_errors
         return c

@@ -1,0 +1,232 @@
+"""Deterministic, seed-driven fault injection for the ingester data plane.
+
+The resilience layer (runtime/supervisor.py, runtime/breaker.py, the
+degraded-mode tpu_sketch path) is only trustworthy if its failure paths
+run in CI, not just in outages. This registry is the single switchboard:
+named sites in the data plane ask `should_fire(site)` at the exact spot
+a real fault would land, and tests / the ci.sh chaos smoke arm those
+sites with a fixed seed so every run replays the same fault schedule.
+PSketch (PAPERS.md) argues the same for sketch degradation: priority-
+aware loss must be *designed and exercised*, not discovered.
+
+Sites wired in this tree (grep for `FAULT_` constants at the call site):
+
+- ``receiver.truncate``   — truncate a TCP read mid-frame (framing loss)
+- ``queue.stall``         — sleep inside OverwriteQueue.gets (slow consumer)
+- ``exporter.raise``      — raise out of an exporter's put() fan-out call
+- ``exporter.process``    — raise inside QueueWorkerExporter.process()
+- ``tpu.device_error``    — raise an XlaRuntimeError-shaped error in the
+  tpu_sketch device path (device loss / preemption)
+- ``checkpoint.torn``     — tear a checkpoint file mid-write
+
+Cost discipline: the registry is OFF by default and every call site
+guards on the module-level ``default_faults().enabled`` flag (one
+attribute load + branch on the hot path, like tracing). Arming any site
+flips the flag; disarming the last one clears it.
+
+Arming is programmatic (`arm()`) or via a spec string — the form the
+ingester reads from ``IngesterConfig.fault_spec`` or the
+``DEEPFLOW_FAULTS`` env var::
+
+    exporter.raise:p=1.0,for_s=5;tpu.device_error:count=1;seed=7
+
+Each clause is ``site:key=value,...``; a bare ``seed=N`` clause seeds
+the registry RNG. Keys: ``count`` (fire the first N hits), ``p``
+(fire with probability p per hit, seeded RNG), ``for_s`` (fire only
+within the first S seconds after arming), ``after`` (skip the first N
+hits), ``delay_s`` (for stall sites: how long to sleep), ``match``
+(only hits whose key contains this substring fire).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["FaultSite", "FaultRegistry", "default_faults",
+           "FAULT_RECEIVER_TRUNCATE", "FAULT_QUEUE_STALL",
+           "FAULT_EXPORTER_RAISE", "FAULT_EXPORTER_PROCESS",
+           "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN"]
+
+FAULT_RECEIVER_TRUNCATE = "receiver.truncate"
+FAULT_QUEUE_STALL = "queue.stall"
+FAULT_EXPORTER_RAISE = "exporter.raise"
+FAULT_EXPORTER_PROCESS = "exporter.process"
+FAULT_DEVICE_ERROR = "tpu.device_error"
+FAULT_CHECKPOINT_TORN = "checkpoint.torn"
+
+
+class InjectedFault(RuntimeError):
+    """The default raised error: unmistakable in tracebacks and logs."""
+
+
+class FaultSite:
+    """One armed site's schedule. All decisions are local + seeded."""
+
+    __slots__ = ("name", "count", "p", "until", "after", "delay_s",
+                 "match", "hits", "fired", "_rng")
+
+    def __init__(self, name: str, count: Optional[int] = None,
+                 p: Optional[float] = None, for_s: Optional[float] = None,
+                 after: int = 0, delay_s: float = 0.05,
+                 match: Optional[str] = None,
+                 rng: Optional[random.Random] = None,
+                 clock=time.monotonic) -> None:
+        self.name = name
+        self.count = count
+        self.p = p
+        self.until = None if for_s is None else clock() + float(for_s)
+        self.after = int(after)
+        self.delay_s = float(delay_s)
+        self.match = match
+        self.hits = 0
+        self.fired = 0
+        self._rng = rng or random.Random(0)
+
+    def decide(self, key: str, now: float) -> bool:
+        # match filters BEFORE hit accounting: `after`/`count` budgets
+        # count MATCHED hits only, so the schedule at one site doesn't
+        # silently depend on how many non-matching callers share it
+        if self.match is not None and self.match not in key:
+            return False
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.until is not None and now > self.until:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.p is not None and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultRegistry:
+    """Named sites -> armed schedules; `enabled` is the hot-path gate."""
+
+    def __init__(self, seed: int = 0,
+                 clock=time.monotonic, sleep=time.sleep) -> None:
+        self.enabled = False
+        self._sites: Dict[str, FaultSite] = {}
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._clock = clock
+        self._sleep = sleep
+
+    # -- arming ------------------------------------------------------------
+    def arm(self, site: str, **kw) -> FaultSite:
+        """Arm one site. kw: count / p / for_s / after / delay_s / match.
+        The site RNG derives from (registry seed, site name) so two runs
+        with the same seed replay the same schedule regardless of the
+        order other sites were armed in."""
+        rng = random.Random(f"{self._seed}:{site}")
+        fs = FaultSite(site, rng=rng, clock=self._clock, **kw)
+        with self._lock:
+            self._sites[site] = fs
+            self.enabled = True
+        return fs
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        """Disarm one site (or all); clears `enabled` when none remain."""
+        with self._lock:
+            if site is None:
+                self._sites.clear()
+            else:
+                self._sites.pop(site, None)
+            self.enabled = bool(self._sites)
+
+    def arm_spec(self, spec: str) -> List[str]:
+        """Arm from a spec string (see module docstring). Returns the
+        armed site names. A malformed clause raises ValueError — a typo
+        in a chaos config must fail loudly, not silently not-inject."""
+        armed: List[str] = []
+        clauses = [c.strip() for c in spec.split(";") if c.strip()]
+        # the seed clause applies registry-wide, so read it first
+        for c in clauses:
+            if c.startswith("seed="):
+                self._seed = int(c[len("seed="):])
+        for c in clauses:
+            if c.startswith("seed="):
+                continue
+            if ":" not in c:
+                raise ValueError(f"fault clause {c!r}: expected site:k=v,...")
+            site, _, body = c.partition(":")
+            kw: dict = {}
+            for pair in filter(None, (p.strip() for p in body.split(","))):
+                if "=" not in pair:
+                    raise ValueError(f"fault clause {c!r}: bad pair {pair!r}")
+                k, _, v = pair.partition("=")
+                if k in ("count", "after"):
+                    kw[k] = int(v)
+                elif k in ("p", "for_s", "delay_s"):
+                    kw[k] = float(v)
+                elif k == "match":
+                    kw[k] = v
+                else:
+                    raise ValueError(f"fault clause {c!r}: unknown key {k!r}")
+            self.arm(site.strip(), **kw)
+            armed.append(site.strip())
+        return armed
+
+    # -- fire decisions (hot path: callers pre-check `.enabled`) -----------
+    def should_fire(self, site: str, key: str = "") -> bool:
+        with self._lock:
+            fs = self._sites.get(site)
+            if fs is None:
+                return False
+            return fs.decide(key, self._clock())
+
+    def maybe_raise(self, site: str, key: str = "",
+                    exc_factory=None) -> None:
+        """Raise at an armed site. exc_factory builds the error — the
+        tpu site passes an XlaRuntimeError-shaped factory so the
+        handler under test classifies it exactly like a real one."""
+        if self.should_fire(site, key):
+            if exc_factory is not None:
+                raise exc_factory(f"injected fault at {site} ({key})")
+            raise InjectedFault(f"injected fault at {site} ({key})")
+
+    def maybe_stall(self, site: str, key: str = "") -> None:
+        if self.should_fire(site, key):
+            with self._lock:
+                fs = self._sites.get(site)
+                delay = fs.delay_s if fs is not None else 0.05
+            self._sleep(delay)
+
+    def maybe_truncate(self, site: str, data: bytes, key: str = "") -> bytes:
+        """Return a prefix of `data` when the site fires (at least one
+        byte short so downstream framing actually sees a tear)."""
+        if data and self.should_fire(site, key):
+            with self._lock:
+                fs = self._sites.get(site)
+                rng = fs._rng if fs is not None else random.Random(0)
+            return data[:rng.randrange(0, len(data))]
+        return data
+
+    # -- observability -----------------------------------------------------
+    def counters(self) -> dict:
+        """Countable: per-site hit/fired totals (deepflow_faults_*)."""
+        out: dict = {"armed": 0}
+        with self._lock:
+            for name, fs in self._sites.items():
+                out["armed"] += 1
+                key = name.replace(".", "_")
+                out[f"{key}_hits"] = fs.hits
+                out[f"{key}_fired"] = fs.fired
+        return out
+
+
+_default: Optional[FaultRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_faults() -> FaultRegistry:
+    """The process fault switchboard (mirrors tracing.default_tracer)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = FaultRegistry()
+        return _default

@@ -233,19 +233,32 @@ def validate_exposition(text: str) -> List[str]:
 
 
 class PrometheusExporter:
-    """The :9526-style HTTP listener serving GET /metrics."""
+    """The :9526-style HTTP listener: GET /metrics + GET /healthz.
+
+    /healthz is the fault-domain liveness contract: `health` is a
+    zero-arg callable returning a dict with an "ok" bool (the ingester
+    wires Ingester.health — stale supervised threads, open exporter
+    breakers, a degraded tpu_sketch lane all fail it). ok -> 200, not
+    ok -> 503, body either way is the full JSON verdict, so a k8s
+    probe and a human curl read the same surface."""
 
     def __init__(self, stats: Optional[StatsRegistry] = None,
                  tracer: Optional[Tracer] = None,
                  port: int = DEFAULT_PROM_PORT,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 health=None) -> None:
         self.stats = stats
         self.tracer = tracer if tracer is not None else default_tracer()
+        self.health = health
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:   # noqa: N802 (stdlib contract)
-                if self.path.split("?")[0] not in ("/metrics", "/"):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    self._healthz()
+                    return
+                if path not in ("/metrics", "/"):
                     self.send_error(404)
                     return
                 try:
@@ -258,6 +271,20 @@ class PrometheusExporter:
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4; "
                                  "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _healthz(self) -> None:
+                import json
+                try:
+                    verdict = {"ok": True} if exporter.health is None \
+                        else dict(exporter.health())
+                except Exception as e:
+                    verdict = {"ok": False, "error": str(e)[:200]}
+                body = json.dumps(verdict).encode()
+                self.send_response(200 if verdict.get("ok") else 503)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

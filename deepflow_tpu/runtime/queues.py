@@ -17,6 +17,10 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from deepflow_tpu.runtime.faults import FAULT_QUEUE_STALL, default_faults
+
+_FAULTS = default_faults()
+
 
 class OverwriteQueue:
     """Bounded ring; puts never block, overwriting oldest on overflow."""
@@ -83,6 +87,8 @@ class OverwriteQueue:
 
         Returns [] only on timeout or closed-and-drained.
         """
+        if _FAULTS.enabled:   # chaos: simulate a stalled consumer
+            _FAULTS.maybe_stall(FAULT_QUEUE_STALL, key=self.name)
         tracer = self._tracer
         with self._ready:
             if self._size == 0 and not self._closed:

@@ -17,8 +17,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
+from deepflow_tpu.runtime.faults import (FAULT_RECEIVER_TRUNCATE,
+                                         default_faults)
 from deepflow_tpu.runtime.queues import MultiQueue
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.runtime.tracing import default_tracer
 from deepflow_tpu.wire.framing import (
     MESSAGE_HEADER_LEN,
@@ -63,7 +66,7 @@ class Receiver:
         self._handlers: Dict[MessageType, MultiQueue] = {}
         self._status: Dict[Tuple[int, int], VtapStatus] = {}
         self._status_lock = threading.Lock()
-        self._threads: list[threading.Thread] = []
+        self._threads: list = []   # supervisor ThreadHandles
         self._tcp_sock: Optional[socket.socket] = None
         self._udp_sock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -100,15 +103,20 @@ class Receiver:
         self._udp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
                                   8 * MESSAGE_FRAME_SIZE_MAX)
 
+        # supervised: an unexpected crash in a listener loop restarts it
+        # with backoff while the sockets stay bound (a raising handler
+        # must not silence the firehose); per-connection readers below
+        # are restart=False — a dead socket is normal churn, only the
+        # crash capture matters
+        sup = default_supervisor()
         for target, name in ((self._accept_loop, "recv-tcp-accept"),
                              (self._udp_loop, "recv-udp")):
-            t = threading.Thread(target=target, name=name, daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._threads.append(sup.spawn(name, target))
 
     def close(self) -> None:
         self._stop.set()
         for t in self._threads:
+            t.stop()
             t.join(timeout=2)
         for s in (self._tcp_sock, self._udp_sock):
             if s is not None:
@@ -123,18 +131,18 @@ class Receiver:
 
     # -- data path ---------------------------------------------------------
     def _accept_loop(self) -> None:
+        sup = default_supervisor()
         while not self._stop.is_set():
+            sup.beat()
             try:
                 conn, addr = self._tcp_sock.accept()
             except socket.timeout:
                 continue
             except OSError:
                 return
-            t = threading.Thread(target=self._tcp_conn_loop,
-                                 args=(conn, addr),
-                                 name=f"recv-tcp-{addr[0]}:{addr[1]}",
-                                 daemon=True)
-            t.start()
+            t = sup.spawn(f"recv-tcp-{addr[0]}:{addr[1]}",
+                          lambda c=conn, a=addr: self._tcp_conn_loop(c, a),
+                          restart=False)
             # Prune threads of closed connections so a churning agent fleet
             # doesn't grow the list unboundedly.
             self._threads = [x for x in self._threads if x.is_alive()]
@@ -143,8 +151,11 @@ class Receiver:
     def _tcp_conn_loop(self, conn: socket.socket, addr) -> None:
         reader = FrameReader()
         conn.settimeout(0.2)
+        sup = default_supervisor()
+        faults = default_faults()
         with conn:
             while not self._stop.is_set():
+                sup.beat()
                 try:
                     chunk = conn.recv(1 << 16)
                 except socket.timeout:
@@ -153,6 +164,10 @@ class Receiver:
                     return
                 if not chunk:
                     return
+                if faults.enabled:   # chaos: tear the stream mid-frame
+                    chunk = faults.maybe_truncate(
+                        FAULT_RECEIVER_TRUNCATE, chunk,
+                        key=f"{addr[0]}:{addr[1]}")
                 try:
                     for frame in reader.feed(chunk):
                         self._dispatch(frame, len(frame.payload))
@@ -161,7 +176,9 @@ class Receiver:
                     return  # framing lost; drop the connection
 
     def _udp_loop(self) -> None:
+        sup = default_supervisor()
         while not self._stop.is_set():
+            sup.beat()
             try:
                 datagram, _ = self._udp_sock.recvfrom(MESSAGE_FRAME_SIZE_MAX)
             except socket.timeout:

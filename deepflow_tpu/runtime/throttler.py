@@ -49,44 +49,60 @@ class ThrottlingQueue:
         """Offer one record. Returns False iff it was sampled away."""
         with self._lock:
             now = self._clock()
+            batch = None
             if self._bucket_of(now) != self._bucket:
-                self._flush_locked()
+                batch = self._swap_locked()
                 self._bucket = self._bucket_of(now)
             self.in_count += 1
             self._seen += 1
             if len(self._reservoir) < self.capacity:
                 self._reservoir.append(item)
-                return True
-            # classic Algorithm R: keep with prob capacity/seen
-            j = self._rng.randrange(self._seen)
-            if j < self.capacity:
-                self._reservoir[j] = item
-                self.sampled_out += 1   # displaced a kept record
-                return True
-            self.sampled_out += 1
-            return False
+                kept = True
+            else:
+                # classic Algorithm R: keep with prob capacity/seen
+                j = self._rng.randrange(self._seen)
+                if j < self.capacity:
+                    self._reservoir[j] = item
+                    kept = True
+                else:
+                    kept = False
+                self.sampled_out += 1   # either way one record displaced
+        # emit OUTSIDE the lock: the downstream emit (a store writer, a
+        # throttled sink) can be arbitrarily slow, and holding _lock
+        # across it would block every decoder thread in send()
+        if batch is not None:
+            self._emit(batch)
+        return kept
 
     def flush(self) -> None:
         """Emit the current bucket's survivors downstream."""
         with self._lock:
-            self._flush_locked()
+            batch = self._swap_locked()
+        if batch is not None:
+            self._emit(batch)
 
-    def _flush_locked(self) -> None:
+    def _swap_locked(self) -> Optional[List[Any]]:
+        """Detach the reservoir under the lock; the CALLER emits it
+        after release (a slow emit must not serialize send())."""
+        batch = None
         if self._reservoir:
             batch = self._reservoir
             self._reservoir = []
             self.emitted += len(batch)
-            self._emit(batch)
         self._seen = 0
+        return batch
 
     def tick(self, now: Optional[float] = None) -> None:
         """Wall-clock bucket roll: a quiet stream's last bucket must
         not strand in the reservoir (see ColumnarThrottler.tick)."""
         now = self._clock() if now is None else now
+        batch = None
         with self._lock:
             if self._bucket_of(now) != self._bucket:
-                self._flush_locked()
+                batch = self._swap_locked()
                 self._bucket = self._bucket_of(now)
+        if batch is not None:
+            self._emit(batch)
 
     def counters(self) -> dict:
         return {
@@ -130,16 +146,22 @@ class ColumnarThrottler:
     def offer(self, cols: Dict[str, np.ndarray]) -> None:
         """Feed one chunk; survivors are emitted on the next bucket roll."""
         with self._lock:
-            self._offer_locked(cols)
+            batch = self._offer_locked(cols)
+        # emit OUTSIDE the lock (same discipline as ThrottlingQueue.send):
+        # a slow downstream emit must not block every decoder in offer()
+        if batch is not None:
+            self._emit(batch)
 
-    def _offer_locked(self, cols: Dict[str, np.ndarray]) -> None:
+    def _offer_locked(self, cols: Dict[str, np.ndarray]
+                      ) -> Optional[Dict[str, np.ndarray]]:
         n = len(next(iter(cols.values()))) if cols else 0
         if n == 0:
-            return
+            return None
+        batch = None
         now = self._clock()
         bucket = int(now) // self.bucket_s
         if bucket != self._bucket:
-            self._flush_locked()
+            batch = self._swap_locked()
             self._bucket = bucket
         self.in_count += n
         if self._res is None:
@@ -154,7 +176,7 @@ class ColumnarThrottler:
             self._fill += take
             self._seen += take
         if take == n:
-            return
+            return batch
         # reservoir full: row at global index g survives w.p. capacity/(g+1)
         rest = n - take
         g = self._seen + np.arange(rest)
@@ -167,12 +189,14 @@ class ColumnarThrottler:
             for k, v in cols.items():
                 self._res[k][slots] = np.asarray(v)[take:][keep]
             self.sampled_out += 0  # displaced rows counted at flush
-        return
+        return batch
 
     def flush(self) -> None:
         """Emit the current bucket's survivors downstream."""
         with self._lock:
-            self._flush_locked()
+            batch = self._swap_locked()
+        if batch is not None:
+            self._emit(batch)
 
     def tick(self, now: Optional[float] = None) -> None:
         """Roll the bucket on WALL CLOCK: without this, a quiet stream
@@ -181,12 +205,16 @@ class ColumnarThrottler:
         periodically by the ingester's janitor; mid-bucket it's a
         no-op, so reservoir uniformity is untouched."""
         now = self._clock() if now is None else now
+        batch = None
         with self._lock:
             if int(now) // self.bucket_s != self._bucket:
-                self._flush_locked()
+                batch = self._swap_locked()
                 self._bucket = int(now) // self.bucket_s
+        if batch is not None:
+            self._emit(batch)
 
-    def _flush_locked(self) -> None:
+    def _swap_locked(self) -> Optional[Dict[str, np.ndarray]]:
+        """Detach the bucket's survivors under the lock; caller emits."""
         if self._res is not None and self._fill:
             out = {k: v[:self._fill].copy() for k, v in self._res.items()}
             self.emitted += self._fill
@@ -194,9 +222,9 @@ class ColumnarThrottler:
             self.sampled_out = self.in_count - self.emitted
             self._fill = 0
             self._seen = 0
-            self._emit(out)
-        else:
-            self._seen = 0
+            return out
+        self._seen = 0
+        return None
 
     def counters(self) -> dict:
         return {"in": self.in_count, "sampled_out": self.sampled_out,

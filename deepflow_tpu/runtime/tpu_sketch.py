@@ -24,7 +24,9 @@ from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
 from deepflow_tpu.models import flow_suite
 from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
 from deepflow_tpu.runtime.exporters import QueueWorkerExporter
+from deepflow_tpu.runtime.faults import FAULT_DEVICE_ERROR, default_faults
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.runtime.tracing import default_tracer
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
@@ -64,6 +66,95 @@ WINDOW_TABLE = TableSchema(
         ColumnSpec("distinct_clients", np.dtype(np.uint32), AggKind.MAX),
     ),
 )
+
+
+class _HostSketch:
+    """Host-numpy fallback sketch: the degraded-mode lane.
+
+    When the device is lost, the lane must degrade, not die (PSketch's
+    priority-aware-degradation argument applied to the TPU fault
+    domain). This is a reduced-rate approximation of FlowSuite on plain
+    numpy: rows are stride-subsampled (1/stride admitted, counts scaled
+    back up), heavy hitters accumulate in a bounded exact dict instead
+    of a CMS+ring, distinct clients in a capped exact set instead of
+    HLL, and entropies over modulo-bucketed histograms (the device path
+    hashes; estimates are approximate by design and labelled by the
+    exporter's `degraded` Countable). flush() emits a standard
+    FlowWindowOutput so the store/querier surface is unchanged."""
+
+    DICT_CAP = 1 << 16
+    CLIENTS_CAP = 1 << 16
+
+    def __init__(self, cfg: flow_suite.FlowSuiteConfig,
+                 stride: int = 4) -> None:
+        self.cfg = cfg
+        self.stride = max(1, stride)
+        self.rows = 0
+        self._counts: Dict[int, int] = {}
+        self._clients: set = set()
+        self._buckets = 1 << cfg.entropy_log2_buckets
+        self._ent = np.zeros((len(flow_suite.ENTROPY_FEATURES),
+                              self._buckets), np.int64)
+
+    def update(self, cols: Dict[str, np.ndarray]) -> int:
+        """Absorb one chunk at 1/stride rate; returns rows admitted."""
+        from deepflow_tpu.utils.u32 import fold_columns_np
+
+        n = len(next(iter(cols.values()))) if cols else 0
+        if n == 0:
+            return 0
+        self.rows += n
+        sl = slice(None, None, self.stride)
+        sub = {k: np.asarray(v)[sl] for k, v in cols.items()}
+        keys = fold_columns_np([sub["ip_src"], sub["ip_dst"],
+                                sub["port_src"], sub["port_dst"],
+                                sub["proto"]])
+        uniq, cnt = np.unique(keys, return_counts=True)
+        counts = self._counts
+        for k, c in zip(uniq.tolist(), cnt.tolist()):
+            counts[k] = counts.get(k, 0) + c * self.stride
+        if len(counts) > self.DICT_CAP:
+            # keep the heavy half: the top-K readout only needs heads
+            keep = sorted(counts.items(), key=lambda kv: -kv[1])
+            self._counts = dict(keep[:self.DICT_CAP // 2])
+        if len(self._clients) < self.CLIENTS_CAP:
+            self._clients.update(sub["ip_src"].tolist())
+        pkts = np.minimum(sub["packet_tx"].astype(np.int64)
+                          + sub["packet_rx"].astype(np.int64), 0xFFFF)
+        for i, f in enumerate(flow_suite.ENTROPY_FEATURES):
+            np.add.at(self._ent[i],
+                      np.asarray(sub[f]).astype(np.uint32)
+                      % np.uint32(self._buckets), pkts)
+        return len(keys)
+
+    def flush(self, cfg: flow_suite.FlowSuiteConfig
+              ) -> flow_suite.FlowWindowOutput:
+        """Window readout in FlowWindowOutput shape, then reset."""
+        k = cfg.top_k
+        top = sorted(self._counts.items(), key=lambda kv: -kv[1])[:k]
+        keys = np.zeros(k, np.uint32)
+        counts = np.zeros(k, np.int32)
+        for i, (key, c) in enumerate(top):
+            keys[i] = key & 0xFFFFFFFF
+            counts[i] = min(c, np.iinfo(np.int32).max)
+        h = self._ent.astype(np.float64)
+        total = h.sum(axis=1, keepdims=True)
+        p = h / np.maximum(total, 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xlogx = np.where(p > 0, p * np.log(p), 0.0)
+        ent = np.where(total[:, 0] > 0,
+                       -xlogx.sum(axis=1) / np.log(self._buckets), 0.0)
+        out = flow_suite.FlowWindowOutput(
+            topk_keys=keys, topk_counts=counts,
+            service_cardinality=np.asarray([len(self._clients)],
+                                           np.float32),
+            entropies=ent.astype(np.float32),
+            rows=np.asarray(self.rows, np.int32))
+        self.rows = 0
+        self._counts = {}
+        self._clients = set()
+        self._ent[:] = 0
+        return out
 
 
 class TpuSketchExporter(QueueWorkerExporter):
@@ -153,9 +244,13 @@ class TpuSketchExporter(QueueWorkerExporter):
             # packer's hits_batch must be even: an odd batch_rows rounds
             # DOWN (capacity floors at 2) instead of surfacing as the
             # packer's opaque "hits_batch must be even" at construction
+            # (ctor params retained: degraded-mode recovery rebuilds the
+            # packer + device dictionary from scratch)
+            self._packer_capacity = max(2 * batch_rows, 1 << 17)
+            self._packer_hits_batch = max(2, batch_rows & ~1)
             self._dict_packer = flow_dict.FlowDictPacker(
-                capacity=max(2 * batch_rows, 1 << 17),
-                hits_batch=max(2, batch_rows & ~1))
+                capacity=self._packer_capacity,
+                hits_batch=self._packer_hits_batch)
             self._dict_state = flow_dict.init_dict(
                 self._dict_packer.capacity)
             self._update_hits = jax.jit(
@@ -197,6 +292,26 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._attrib_every = 16
         self._batches_traced = 0
         self._detailed = False
+        # -- degraded mode (fault domain: the device) ----------------------
+        # On a device-classified error (XlaRuntimeError / device loss —
+        # RuntimeError subclasses on every jax we run) the lane restores
+        # sketch state from the latest checkpoint snapshot (<=1 window
+        # lost, checkpoint.py's promise) and, after `degrade_after`
+        # consecutive failures, falls back to a host-numpy sketch at
+        # reduced rate until a per-window probe finds the device healthy
+        # again. All loss is counted, never silent.
+        self._faults = default_faults()
+        self.degraded = False
+        self.device_errors = 0     # device-classified raises
+        self.recoveries = 0        # degraded -> device restorations
+        self.lost_windows = 0      # window accumulations rolled back
+        self.lost_rows = 0         # rows in batches that died on device
+        self.host_rows = 0         # rows absorbed by the host fallback
+        self._consecutive_errors = 0
+        self.degrade_after = 2
+        self.host_stride = 4       # host fallback subsample (reduced rate)
+        self._host: Optional[_HostSketch] = None
+        self._window_lost_counted = False
 
     # -- exporter lifecycle ------------------------------------------------
     def start(self) -> None:
@@ -204,13 +319,15 @@ class TpuSketchExporter(QueueWorkerExporter):
             self.topk_writer.start()
             self.window_writer.start()
         super().start()
-        self._window_thread = threading.Thread(
-            target=self._window_loop, name="tpu-sketch-window", daemon=True)
-        self._window_thread.start()
+        # supervised (crash capture + restart), deadman disabled: the
+        # loop legitimately blocks a full window_seconds between beats
+        self._window_thread = default_supervisor().spawn(
+            "tpu-sketch-window", self._window_loop, deadman_s=None)
 
     def close(self) -> None:
         self._window_stop.set()
         if self._window_thread is not None:
+            self._window_thread.stop()
             self._window_thread.join(timeout=5)
         super().close()
         self.flush_window()  # final window
@@ -288,14 +405,99 @@ class TpuSketchExporter(QueueWorkerExporter):
         return out
 
     def _run_batch_locked(self, tb: TensorBatch) -> None:
-        tr = self._tracer
-        if not tr.enabled:
-            self._run_batch_inner(tb)
+        if self.degraded:
+            self._host_batch_locked(tb)
             return
-        with tr.span("kernel", stream=self.wire, rows=tb.valid):
-            self._run_batch_inner(tb)
+        tr = self._tracer
+        try:
+            if not tr.enabled:
+                self._run_batch_inner(tb)
+                return
+            with tr.span("kernel", stream=self.wire, rows=tb.valid):
+                self._run_batch_inner(tb)
+        except RuntimeError:
+            # XlaRuntimeError (device loss, OOM, preemption) subclasses
+            # RuntimeError; anything else device-shaped lands here too.
+            # Non-Runtime errors (shape bugs -> TypeError/ValueError)
+            # propagate to the worker's process_errors containment.
+            self._on_device_error_locked(int(tb.valid))
+
+    def _on_device_error_locked(self, rows: int) -> None:
+        """One batch died on the device: roll sketch state back to the
+        latest checkpoint (<=1 window lost), and after repeated failures
+        hand the lane to the host-numpy fallback."""
+        import logging
+
+        self.device_errors += 1
+        self._consecutive_errors += 1
+        self.lost_rows += rows
+        if not self._window_lost_counted:
+            self.lost_windows += 1          # this window's accumulation
+            self._window_lost_counted = True
+        logging.getLogger(__name__).exception(
+            "tpu_sketch device error #%d (consecutive %d)",
+            self.device_errors, self._consecutive_errors)
+        try:
+            self._restore_device_state_locked()
+        except Exception:
+            # the device can't even hold a fresh state: go degraded now
+            self._consecutive_errors = self.degrade_after
+        if self._consecutive_errors >= self.degrade_after:
+            self.degraded = True
+            logging.getLogger(__name__).warning(
+                "tpu_sketch degraded: host-numpy fallback at 1/%d rate",
+                self.host_stride)
+
+    def _restore_device_state_locked(self) -> None:
+        """Rebuild device-resident state: latest compatible checkpoint
+        if one exists, else a fresh init. The dictionary lane's packer +
+        device table restart empty — flows re-announce as news, and
+        correctness never depends on host/device dictionary agreement
+        (see the wire='dict' note in __init__)."""
+        fresh = flow_suite.init(self.cfg)
+        restored = None
+        if self.checkpointer is not None:
+            restored = self.checkpointer.restore(fresh)
+        self.state = restored if restored is not None else fresh
+        if self._dict_packer is not None:
+            self._dict_packer = self._flow_dict.FlowDictPacker(
+                capacity=self._packer_capacity,
+                hits_batch=self._packer_hits_batch)
+            self._dict_state = self._flow_dict.init_dict(
+                self._packer_capacity)
+        self._warm = set()
+
+    def _host_batch_locked(self, tb: TensorBatch) -> None:
+        if self._host is None:
+            self._host = _HostSketch(self.cfg, stride=self.host_stride)
+        mask = tb.mask()
+        cols = {k: v[mask] for k, v in tb.columns.items()}
+        self.host_rows += self._host.update(cols)
+
+    def _probe_device_locked(self) -> bool:
+        """Degraded-mode recovery probe (once per window): a tiny
+        device round-trip; healthy -> restore from checkpoint and hand
+        the lane back to the device. Host-window tallies were already
+        flushed as (reduced-fidelity) window outputs, so they are
+        dropped, not merged."""
+        try:
+            if self._faults.enabled:
+                self._faults.maybe_raise(FAULT_DEVICE_ERROR, key="probe")
+            probe = self._jnp.asarray(np.ones(8, np.uint32))
+            if int(probe.sum()) != 8:
+                return False
+            self._restore_device_state_locked()
+        except Exception:
+            return False
+        self.degraded = False
+        self._consecutive_errors = 0
+        self.recoveries += 1
+        self._host = None
+        return True
 
     def _run_batch_inner(self, tb: TensorBatch) -> None:
+        if self._faults.enabled:   # chaos: simulated device loss
+            self._faults.maybe_raise(FAULT_DEVICE_ERROR, key=self.wire)
         if self._tracer.enabled:
             self._detailed = \
                 self._batches_traced % self._attrib_every == 0
@@ -381,21 +583,42 @@ class TpuSketchExporter(QueueWorkerExporter):
             for tb in self.batcher.flush():
                 self._run_batch_locked(tb)
             self.windows += 1
-            # checkpoint the PRE-flush state (the window's accumulation):
-            # restore replays the window at-least-once; saving post-flush
-            # would snapshot a reset state and recover nothing. Cadence:
-            # every checkpoint_every-th window, and only if THIS window's
-            # accumulation is non-empty (a full npz per idle 1s window is
-            # not "low-overhead"). Rows in already-flushed windows need no
-            # snapshot — their output reached the store; restart loses at
-            # most the current accumulation, bounded by checkpoint_every
-            # windows of data.
-            dirty = self.rows_in != self._rows_at_flush
-            if (self.checkpointer is not None and dirty
-                    and self.windows % self.checkpoint_every == 0):
-                self.checkpointer.save(self.state, self.windows)
-            self._rows_at_flush = self.rows_in
-            self.state, out = self._flush_fn(self.state)
+            if self.degraded:
+                # host fallback window: reduced-fidelity output, then
+                # probe the device for recovery
+                out = None if self._host is None \
+                    else self._host.flush(self.cfg)
+                self._rows_at_flush = self.rows_in
+                self._probe_device_locked()
+            else:
+                # checkpoint the PRE-flush state (the window's
+                # accumulation): restore replays the window
+                # at-least-once; saving post-flush would snapshot a
+                # reset state and recover nothing. Cadence: every
+                # checkpoint_every-th window, and only if THIS window's
+                # accumulation is non-empty (a full npz per idle 1s
+                # window is not "low-overhead"). Rows in already-flushed
+                # windows need no snapshot — their output reached the
+                # store; restart loses at most the current accumulation,
+                # bounded by checkpoint_every windows of data.
+                dirty = self.rows_in != self._rows_at_flush
+                if (self.checkpointer is not None and dirty
+                        and self.windows % self.checkpoint_every == 0):
+                    self.checkpointer.save(self.state, self.windows)
+                self._rows_at_flush = self.rows_in
+                try:
+                    self.state, out = self._flush_fn(self.state)
+                except RuntimeError:
+                    # the window readback itself died on device: same
+                    # classification + recovery as a batch failure
+                    self._on_device_error_locked(0)
+                    out = None
+            # the lost-window guard resets at the TRUE window boundary —
+            # after the flush attempt — so a window where both a
+            # replayed batch and the readback die counts ONCE
+            self._window_lost_counted = False
+        if out is None:
+            return None
         self.last_output = out
         self._write_output(out, int(now))
         return out
@@ -447,10 +670,22 @@ class TpuSketchExporter(QueueWorkerExporter):
     def counters(self) -> dict:
         c = super().counters()
         c.update({"rows_in": self.rows_in, "windows": self.windows,
-                  "h2d_bytes": self.h2d_bytes})
+                  "h2d_bytes": self.h2d_bytes,
+                  # degraded-mode fault domain: every loss is a number
+                  "degraded": 1 if self.degraded else 0,
+                  "device_errors": self.device_errors,
+                  "recoveries": self.recoveries,
+                  "lost_windows": self.lost_windows,
+                  "lost_rows": self.lost_rows,
+                  "host_rows": self.host_rows})
         # staged-update admission skips (flow_suite.make_staged_update):
-        # bounded data loss that must show in deepflow_system, not logs
-        failures = getattr(self._update, "admission_failures", None)
+        # bounded data loss that must show in deepflow_system, not logs.
+        # _update only exists on the staged/lanes wires — the dict wire
+        # has hits/news programs instead, and reading through it raised
+        # AttributeError here, which StatsRegistry.collect swallowed:
+        # the whole tpu_sketch Countable silently vanished from scrapes
+        failures = getattr(getattr(self, "_update", None),
+                           "admission_failures", None)
         if failures is not None:
             c["ring_admission_failures"] = failures
         if self.checkpointer is not None:

@@ -42,7 +42,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 __all__ = ["HostDDSketch", "Tracer", "default_tracer"]
 
@@ -207,6 +207,10 @@ class Tracer:
         self._lock = threading.Lock()   # reads + stage/gauge creation
         self._batch_seq = 0
         self._tls = threading.local()
+        # optional heartbeat hook (set by supervisor.default_supervisor):
+        # every recorded span is proof of life for the recording thread,
+        # feeding the deadman watchdog for free on traced hot paths
+        self.heartbeat: Optional[Callable[[], None]] = None
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self) -> None:
@@ -255,6 +259,8 @@ class Tracer:
         hot call sites use behind their own `enabled` guard)."""
         if not self.enabled:
             return
+        if self.heartbeat is not None:
+            self.heartbeat()
         if batch_id < 0:
             batch_id = self.current_batch()
         sk = self._stages.get(stage)

@@ -1,0 +1,674 @@
+"""Resilience-layer tests: supervision, breakers, fault injection,
+degraded-mode sketching, and the satellite robustness fixes.
+
+Every fault here is DETERMINISTIC: sites fire through the seeded
+runtime/faults.py registry (disarmed in fixtures/finally so the global
+switchboard never leaks into other tests), clocks are injected where a
+schedule matters, and loss is asserted through Countables — the same
+surface /metrics scrapes — because the whole point of the layer is that
+failure is counted, not printed.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.runtime.breaker import (STATE_CLOSED, STATE_HALF_OPEN,
+                                          STATE_OPEN, BreakerConfig,
+                                          CircuitBreaker)
+from deepflow_tpu.runtime.exporters import Exporters, QueueWorkerExporter
+from deepflow_tpu.runtime.faults import (FAULT_CHECKPOINT_TORN,
+                                         FAULT_DEVICE_ERROR,
+                                         FAULT_EXPORTER_PROCESS,
+                                         FAULT_EXPORTER_RAISE,
+                                         FaultRegistry, default_faults)
+from deepflow_tpu.runtime.receiver import VtapStatus
+from deepflow_tpu.runtime.supervisor import Supervisor
+from deepflow_tpu.runtime.throttler import ColumnarThrottler, ThrottlingQueue
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The fault switchboard is process-global: never leak armed sites."""
+    default_faults().disarm()
+    yield
+    default_faults().disarm()
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------------- supervisor
+
+def test_supervisor_restarts_with_crash_capture():
+    sup = Supervisor(backoff_base_s=0.005, backoff_cap_s=0.02)
+    runs = []
+
+    def target():
+        runs.append(1)
+        if len(runs) < 3:
+            raise ValueError("decoder exploded")
+
+    h = sup.spawn("worker", target)
+    h.join(5)
+    assert len(runs) == 3 and h.done
+    assert h.crashes == 2 and h.restarts == 2
+    log = sup.crash_log()
+    assert len(log) == 2
+    assert "decoder exploded" in log[-1]["error"]
+    assert "ValueError" in log[-1]["traceback"]   # full traceback retained
+    c = sup.counters()
+    assert c["crashes"] == 2 and c["restarts"] == 2
+    sup.close()
+
+
+def test_supervisor_no_restart_policy():
+    """restart=False workers (per-connection readers) crash once: the
+    capture matters, the restart would be meaningless."""
+    sup = Supervisor(backoff_base_s=0.005)
+    runs = []
+
+    def target():
+        runs.append(1)
+        raise OSError("socket gone")
+
+    h = sup.spawn("conn", target, restart=False)
+    h.join(2)
+    assert len(runs) == 1 and h.done and h.crashes == 1 and h.restarts == 0
+    sup.close()
+
+
+def test_supervisor_stop_cancels_backoff():
+    sup = Supervisor(backoff_base_s=30.0, backoff_cap_s=30.0)
+
+    def target():
+        raise ValueError("x")
+
+    h = sup.spawn("slow-backoff", target)
+    assert _wait(lambda: h.crashes >= 1)
+    h.stop()                      # cancel the 30s backoff wait
+    h.join(2)
+    assert h.done and not h.is_alive()
+    sup.close()
+
+
+def test_supervisor_deadman_detects_wedged_thread():
+    sup = Supervisor(deadman_s=0.05)
+    release = threading.Event()
+    h = sup.spawn("wedged", lambda: release.wait(10), deadman_s=0.05)
+    assert _wait(lambda: "wedged" in sup.check_deadman(), timeout=2)
+    assert sup.counters()["stale"] == 1
+    release.set()
+    h.join(2)
+    # a finished worker is never stale
+    assert "wedged" not in sup.check_deadman()
+    sup.close()
+
+
+def test_supervisor_beat_clears_deadman():
+    sup = Supervisor(deadman_s=10.0)
+    stop = threading.Event()
+
+    def beating():
+        while not stop.wait(0.01):
+            sup.beat()
+
+    h = sup.spawn("alive", beating, deadman_s=0.2)
+    time.sleep(0.5)               # well past deadman_s without beats -> stale
+    assert sup.check_deadman() == []
+    stop.set()
+    h.join(2)
+    sup.close()
+
+
+# ---------------------------------------------------------------- breaker
+
+def _tripped_breaker(cfg, clock):
+    b = CircuitBreaker("exp", cfg, clock=lambda: clock[0])
+    for _ in range(cfg.min_calls):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == STATE_OPEN
+    return b
+
+
+def test_breaker_trips_sheds_and_recovers_via_half_open():
+    clock = [0.0]
+    cfg = BreakerConfig(min_calls=4, failure_rate=0.5, open_s=5.0,
+                        half_open_probes=2)
+    b = _tripped_breaker(cfg, clock)
+    # quarantined: shed and counted
+    assert not b.allow() and not b.allow()
+    assert b.counters()["dropped"] == 2
+    # cooldown elapses -> half-open admits exactly the probe budget
+    clock[0] = 5.1
+    assert b.allow() and b.state == STATE_HALF_OPEN
+    assert b.allow()
+    assert not b.allow()          # third call shed during probing
+    b.record_success(0.001)
+    b.record_success(0.001)
+    assert b.state == STATE_CLOSED
+    assert b.counters()["trips"] == 1 and b.counters()["closes"] == 1
+
+
+def test_breaker_failed_probe_reopens():
+    clock = [0.0]
+    cfg = BreakerConfig(min_calls=2, failure_rate=0.5, open_s=1.0,
+                        half_open_probes=1)
+    b = _tripped_breaker(cfg, clock)
+    clock[0] = 1.5
+    assert b.allow() and b.state == STATE_HALF_OPEN
+    b.record_failure()
+    assert b.state == STATE_OPEN and b.counters()["trips"] == 2
+    assert not b.allow()          # a fresh open_s quarantine
+
+
+def test_breaker_latency_budget_counts_slow_as_failure():
+    cfg = BreakerConfig(min_calls=4, failure_rate=0.5, open_s=1.0,
+                        latency_budget_s=0.01)
+    b = CircuitBreaker("slow", cfg)
+    for _ in range(4):
+        assert b.allow()
+        b.record_success(latency_s=0.5)   # "fast exporter" lying slowly
+    assert b.state == STATE_OPEN
+    assert b.counters()["slow"] == 4
+
+
+def test_breaker_healthy_traffic_stays_closed():
+    b = CircuitBreaker("ok", BreakerConfig(min_calls=4))
+    for _ in range(100):
+        assert b.allow()
+        b.record_success(0.0001)
+    assert b.state == STATE_CLOSED and b.counters()["trips"] == 0
+
+
+# ----------------------------------------------------------------- faults
+
+def test_fault_registry_is_deterministic_per_seed():
+    a = FaultRegistry(seed=42)
+    b = FaultRegistry(seed=42)
+    for fr in (a, b):
+        fr.arm("x", p=0.5, count=100)
+    seq_a = [a.should_fire("x") for _ in range(50)]
+    seq_b = [b.should_fire("x") for _ in range(50)]
+    assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+
+
+def test_fault_spec_parsing_and_counts():
+    fr = FaultRegistry()
+    armed = fr.arm_spec("exporter.raise:count=2;seed=9;"
+                        "queue.stall:delay_s=0.01,p=1.0")
+    assert set(armed) == {"exporter.raise", "queue.stall"}
+    assert [fr.should_fire("exporter.raise") for _ in range(4)] == \
+        [True, True, False, False]
+    c = fr.counters()
+    assert c["exporter_raise_fired"] == 2 and c["exporter_raise_hits"] == 4
+    with pytest.raises(ValueError):
+        fr.arm_spec("exporter.raise:nonsense=1")
+    fr.disarm()
+    assert not fr.enabled
+
+
+def test_fault_match_filters_by_key():
+    fr = FaultRegistry()
+    fr.arm("exporter.raise", count=10, match="otlp")
+    assert not fr.should_fire("exporter.raise", key="tpu_sketch")
+    assert fr.should_fire("exporter.raise", key="otlp-main")
+
+
+# ----------------------------------------- exporter fan-out containment
+
+class _Sink(QueueWorkerExporter):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.seen = []
+
+    def process(self, chunks):
+        self.seen.extend(chunks)
+
+
+class _Raising:
+    name = "raising"
+
+    def __init__(self):
+        self.puts = 0
+        self.healthy = False
+
+    def start(self):
+        pass
+
+    def close(self):
+        pass
+
+    def is_export_data(self, stream, cols):
+        return True
+
+    def put(self, stream, idx, cols):
+        self.puts += 1
+        if not self.healthy:
+            raise RuntimeError("backend down")
+
+
+def test_raising_exporter_is_quarantined_siblings_flow():
+    """The acceptance shape: one plugin raising 100% degrades to counted
+    loss behind its breaker while the sibling and the caller (the decode
+    stage) never see an exception."""
+    ex = Exporters(breaker_cfg=BreakerConfig(min_calls=4, failure_rate=0.5,
+                                             open_s=60.0))
+    bad = _Raising()
+    good = _Sink(name="good", streams=["l4_flow_log"])
+    ex.register(bad)
+    ex.register(good)
+    ex.start()
+    cols = {"ip_src": np.arange(8, dtype=np.uint32)}
+    for _ in range(20):
+        ex.put("l4_flow_log", 0, cols)    # must never raise
+    ex.close()
+    assert bad.puts == 4                  # quarantined after min_calls
+    assert ex.put_errors == 4
+    assert ex.shed_count == 16
+    br = ex.breakers()["raising"]
+    assert br["state"] == STATE_OPEN and br["dropped"] == 16
+    assert len(good.seen) > 0             # sibling got every chunk
+    assert ex.counters()["put"] >= 20
+
+
+def test_breaker_recloses_after_exporter_heals():
+    ex = Exporters(breaker_cfg=BreakerConfig(min_calls=2, failure_rate=0.5,
+                                             open_s=0.05,
+                                             half_open_probes=1))
+    bad = _Raising()
+    ex.register(bad)
+    cols = {"x": np.zeros(1)}
+    for _ in range(4):
+        ex.put("s", 0, cols)
+    assert ex.breakers()["raising"]["state"] == STATE_OPEN
+    bad.healthy = True
+    time.sleep(0.1)                       # cooldown -> half-open probe
+    ex.put("s", 0, cols)
+    assert ex.breakers()["raising"]["state"] == STATE_CLOSED
+
+
+def test_injected_exporter_raise_site():
+    default_faults().arm("exporter.raise", count=3)
+    ex = Exporters(breaker_cfg=None)      # containment even unwrapped
+    sink = _Sink(name="sink", streams=["s"])
+    ex.register(sink)
+    for _ in range(5):
+        ex.put("s", 0, {"x": np.zeros(2)})
+    assert ex.put_errors == 3
+    assert ex.counters()["put"] == 2
+
+
+def test_worker_survives_process_raise():
+    """Satellite: a raising process() is a counted dropped batch, not a
+    permanently dead worker thread."""
+    sink = _Sink(name="fragile", streams=["s"])
+    default_faults().arm(FAULT_EXPORTER_PROCESS, count=1)
+    sink.start()
+    try:
+        sink.put("s", 0, {"x": np.zeros(2)})
+        assert _wait(lambda: sink.process_errors == 1)
+        sink.put("s", 0, {"x": np.ones(2)})   # worker still draining
+        assert _wait(lambda: len(sink.seen) == 1)
+        assert sink.counters()["process_errors"] == 1
+    finally:
+        sink.close()
+
+
+# -------------------------------------------------- throttler satellites
+
+def test_throttler_emits_outside_lock():
+    """Satellite: the bucket-roll emit must run after _lock release — a
+    downstream that re-enters send() (or just blocks) must not deadlock
+    every decoder. Pre-fix this deadlocks on the non-reentrant lock."""
+    clk = [100.0]
+    result = []
+    t = ThrottlingQueue(lambda batch: result.append(
+        (list(batch), t.send("reentrant"))),
+        throttle_per_s=10, bucket_s=1, clock=lambda: clk[0])
+    t.send("a")
+    clk[0] = 101.5
+    done = []
+    th = threading.Thread(target=lambda: done.append(t.send("b")))
+    th.start()
+    th.join(timeout=5)
+    assert done == [True], "bucket-roll emit deadlocked send()"
+    assert result and result[0][0] == ["a"]
+
+
+def test_columnar_throttler_emits_outside_lock():
+    clk = [100.0]
+    result = []
+
+    def emit(cols):
+        ct.offer({"x": np.asarray([99], np.int64)})   # re-entrant offer
+        result.append(cols["x"].tolist())
+
+    ct = ColumnarThrottler(emit, throttle_per_s=10, bucket_s=1,
+                           clock=lambda: clk[0])
+    ct.offer({"x": np.asarray([1, 2], np.int64)})
+    clk[0] = 101.5
+    done = []
+    th = threading.Thread(target=lambda: done.append(
+        ct.offer({"x": np.asarray([3], np.int64)}) or True))
+    th.start()
+    th.join(timeout=5)
+    assert done == [True], "bucket-roll emit deadlocked offer()"
+    assert result == [[1, 2]]
+
+
+def test_throttler_backwards_clock_rolls_cleanly():
+    """Satellite: a clock stepping backwards (NTP slew, test clocks)
+    rolls the bucket without corrupting counters or crashing."""
+    clk = [100.0]
+    out = []
+    t = ThrottlingQueue(out.extend, throttle_per_s=10, bucket_s=1,
+                        clock=lambda: clk[0])
+    assert t.send("a")
+    clk[0] = 92.0                 # backwards: different bucket -> roll
+    assert t.send("b")
+    assert out == ["a"]
+    t.tick()                      # same (old) bucket: no-op
+    assert out == ["a"]
+    clk[0] = 101.0
+    t.tick()
+    assert out == ["a", "b"]
+    c = t.counters()
+    assert c["in"] == 2 and c["emitted"] == 2 and c["sampled_out"] == 0
+
+
+def test_columnar_throttler_backwards_clock():
+    clk = [100.0]
+    out = []
+    ct = ColumnarThrottler(lambda cols: out.append(cols["x"].tolist()),
+                           throttle_per_s=10, bucket_s=1,
+                           clock=lambda: clk[0])
+    ct.offer({"x": np.asarray([1], np.int64)})
+    clk[0] = 92.0
+    ct.offer({"x": np.asarray([2], np.int64)})
+    assert out == [[1]]
+    clk[0] = 101.0
+    ct.tick()
+    assert out == [[1], [2]]
+    assert ct.counters()["emitted"] == 2
+
+
+# ------------------------------------------------- receiver containment
+
+def test_receiver_survives_injected_frame_truncation():
+    """The receiver.truncate site tears a TCP read mid-frame: the torn
+    connection loses data (counted as rx_errors or missing frames) but
+    the listener stays up and a fresh connection delivers cleanly."""
+    import socket
+
+    from deepflow_tpu.runtime.faults import FAULT_RECEIVER_TRUNCATE
+    from deepflow_tpu.runtime.queues import MultiQueue
+    from deepflow_tpu.runtime.receiver import Receiver
+    from deepflow_tpu.replay.generator import SyntheticAgent
+    from deepflow_tpu.wire.framing import MessageType
+
+    r = Receiver(port=0)
+    mq = MultiQueue("t", 1, 256)
+    r.register_handler(MessageType.TAGGEDFLOW, mq)
+    r.start()
+    try:
+        agent = SyntheticAgent(vtap_id=9)
+        _, records = agent.l4_batch(16)
+        frames = list(agent.frames(records, MessageType.TAGGEDFLOW,
+                                   per_frame=8))
+        default_faults().arm(FAULT_RECEIVER_TRUNCATE, count=1)
+        with socket.create_connection(("127.0.0.1", r.bound_port)) as s:
+            for f in frames:
+                s.sendall(f)
+        _wait(lambda: r.rx_errors >= 1 or r.rx_frames >= 1, timeout=2)
+        torn_frames = r.rx_frames
+        assert r.rx_errors >= 1 or torn_frames < len(frames)
+        # fresh connection after the tear: clean delivery
+        with socket.create_connection(("127.0.0.1", r.bound_port)) as s:
+            for f in frames:
+                s.sendall(f)
+            assert _wait(
+                lambda: r.rx_frames >= torn_frames + len(frames))
+    finally:
+        r.close()
+
+
+# ------------------------------------------------------- vtap seq reset
+
+def test_vtap_status_agent_restart_no_phantom_drops():
+    """Satellite: an agent restarting resets its sequence; the gap
+    tracker must NOT book the wrap as upstream loss."""
+    st = VtapStatus(vtap_id=7, msg_type=1)
+    st.observe(5, 1.0)
+    st.observe(6, 2.0)
+    assert st.rx_dropped == 0
+    st.observe(1, 3.0)            # restart: seq went backwards
+    assert st.rx_dropped == 0
+    st.observe(2, 4.0)
+    assert st.rx_dropped == 0
+    st.observe(5, 5.0)            # a real gap after the restart
+    assert st.rx_dropped == 2
+    assert st.rx_frames == 5
+
+
+# --------------------------------------------------- checkpoint hardening
+
+def _leafy(n, shape=(4,)):
+    return [np.arange(np.prod(shape), dtype=np.float32).reshape(shape) + i
+            for i in range(n)]
+
+
+def test_checkpoint_refuses_leaf_count_mismatch(tmp_path):
+    """Satellite: a stale snapshot from a BIGGER config whose first N
+    leaves match shapes must be refused, not silently half-loaded."""
+    from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
+
+    ck = SketchCheckpointer(str(tmp_path))
+    ck.save(_leafy(3), step=1)
+    assert ck.restore(_leafy(3)) is not None     # exact count loads
+    assert ck.restore(_leafy(2)) is None         # prefix-match refused
+    assert ck.restore(_leafy(4)) is None
+
+
+def test_checkpoint_torn_write_skipped_on_restore(tmp_path):
+    from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
+
+    ck = SketchCheckpointer(str(tmp_path))
+    state = _leafy(2)
+    ck.save(state, step=1)                       # good snapshot
+    default_faults().arm(FAULT_CHECKPOINT_TORN, count=1)
+    ck.save([a + 100 for a in state], step=2)    # torn on disk
+    restored = ck.restore(state)
+    assert restored is not None
+    np.testing.assert_array_equal(restored[0], state[0])  # step-1 content
+
+
+# ---------------------------------------------- degraded-mode tpu_sketch
+
+def _l4_chunk(rng, n=2000):
+    """Values in wire range (proto < 2^8 etc.) so a host flow_key over
+    the raw columns equals the exporter's device key (pack_lanes masks
+    out-of-range values, see flow_suite.pack_lanes)."""
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+
+    return {name: rng.integers(0, 1 << 8, n).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+
+
+@pytest.fixture
+def sketch_exporter(tmp_path):
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+
+    exp = TpuSketchExporter(store=None, window_seconds=3600,
+                            batch_rows=1024,
+                            checkpoint_dir=str(tmp_path / "ckpt"))
+    exp.degrade_after = 2
+    yield exp
+    default_faults().disarm()
+    exp.close()
+
+
+def test_device_error_restores_from_checkpoint(sketch_exporter):
+    """Acceptance: a killed device path restores from the snapshot with
+    <=1 window of sketch state lost — checked via CMS estimates for the
+    checkpointed window's keys vs the lost window's keys."""
+    from deepflow_tpu.models.flow_suite import flow_key
+    from deepflow_tpu.ops import cms
+
+    exp = sketch_exporter
+    rng = np.random.default_rng(11)
+    chunk_a = _l4_chunk(rng)
+    exp.process([("l4_flow_log", 0, chunk_a)])
+    exp.flush_window()            # checkpoints A's accumulation pre-flush
+    assert exp.checkpointer.counters()["saves"] == 1
+
+    chunk_b = _l4_chunk(rng)
+    default_faults().arm(FAULT_DEVICE_ERROR, count=1)
+    exp.process([("l4_flow_log", 0, chunk_b)])   # B's batches die
+    assert exp.device_errors >= 1 and exp.lost_windows == 1
+    assert not exp.degraded       # single error: restored, still device
+
+    # restored state is A's accumulation (at-least-once), not B's
+    import jax.numpy as jnp
+    keys_a = np.asarray(flow_key({k: jnp.asarray(v[:64].astype(np.uint32))
+                                  for k, v in chunk_a.items()}))
+    est_a = np.asarray(cms.query(exp.state.sketch, jnp.asarray(keys_a)))
+    assert est_a.sum() > 0, "checkpointed window lost on restore"
+
+
+def test_sustained_device_loss_degrades_to_host_then_recovers(
+        sketch_exporter):
+    exp = sketch_exporter
+    rng = np.random.default_rng(12)
+    faults = default_faults()
+    faults.arm(FAULT_DEVICE_ERROR, count=4)
+    exp.process([("l4_flow_log", 0, _l4_chunk(rng, n=4096))])
+    assert exp.degraded, "consecutive device errors must degrade the lane"
+
+    # host fallback absorbs rows at reduced rate, window output flows
+    exp.process([("l4_flow_log", 0, _l4_chunk(rng))])
+    assert exp.host_rows > 0
+    out = exp.flush_window()      # probe fails (fault still armed)
+    assert out is not None and int(np.asarray(out.rows)) > 0
+    assert int(np.asarray(out.topk_counts).max()) > 0
+    assert exp.degraded
+
+    while faults.should_fire(FAULT_DEVICE_ERROR):   # drain the schedule
+        pass
+    exp.flush_window()            # probe succeeds -> device restored
+    assert not exp.degraded and exp.recoveries == 1
+    exp.process([("l4_flow_log", 0, _l4_chunk(rng))])   # device path again
+    c = exp.counters()
+    assert c["degraded"] == 0 and c["device_errors"] >= 2
+    assert c["host_rows"] > 0 and c["lost_windows"] >= 1
+
+
+def test_host_sketch_estimates_are_sane():
+    from deepflow_tpu.models import flow_suite
+    from deepflow_tpu.runtime.tpu_sketch import _HostSketch
+
+    cfg = flow_suite.FlowSuiteConfig()
+    hs = _HostSketch(cfg, stride=1)   # full rate: exact heavy hitters
+    rng = np.random.default_rng(5)
+    cols = {k: rng.integers(0, 1 << 12, 4096).astype(np.uint32)
+            for k in ("ip_src", "ip_dst", "port_src", "port_dst", "proto",
+                      "packet_tx", "packet_rx")}
+    # plant one dominant flow
+    for k in cols:
+        cols[k][:1024] = 7
+    hs.update(cols)
+    out = hs.flush(cfg)
+    assert int(np.asarray(out.rows)) == 4096
+    assert int(np.asarray(out.topk_counts)[0]) >= 1024
+    assert 0.0 <= float(np.asarray(out.entropies).max()) <= 1.0
+    assert int(np.asarray(out.service_cardinality).sum()) > 0
+    # flush resets window state
+    assert int(np.asarray(hs.flush(cfg).rows)) == 0
+
+
+# ------------------------------------------------------ end-to-end chaos
+
+def test_ingester_survives_raising_exporter_and_counts_loss(tmp_path):
+    """Mini chaos: a live ingester with an always-raising exporter keeps
+    decoding; the breaker opens; loss shows on /metrics; /healthz flips
+    503 while quarantined."""
+    import json
+    import socket
+    import urllib.error
+    import urllib.request
+
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+    from deepflow_tpu.enrich.platform_data import PlatformDataManager
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+    from deepflow_tpu.wire import columnar_wire
+    from deepflow_tpu.wire.framing import (FlowHeader, MessageType,
+                                           encode_frame)
+
+    ing = Ingester(IngesterConfig(listen_port=0, prom_port=0,
+                                  breaker_min_calls=2,
+                                  breaker_open_s=60.0),
+                   platform=PlatformDataManager())
+    bad = _Raising()
+    ing.exporters.register(bad)
+    ing.start()
+    try:
+        rng = np.random.default_rng(0)
+        cols = {name: rng.integers(0, 1 << 16, 500).astype(dt)
+                for name, dt in L4_SCHEMA.columns}
+        frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                             columnar_wire.encode_columnar(cols),
+                             FlowHeader(sequence=1, vtap_id=3))
+        with socket.create_connection(("127.0.0.1", ing.port),
+                                      timeout=5) as s:
+            for _ in range(8):
+                s.sendall(frame)
+        assert _wait(lambda: ing.exporters.put_errors >= 2, timeout=10)
+        assert _wait(
+            lambda: ing.exporters.breakers()["raising"]["state"]
+            == STATE_OPEN, timeout=10)
+        # decode kept flowing despite the poisonous plugin
+        assert _wait(lambda: sum(d.records for d in ing.flow_log.decoders)
+                     >= 500, timeout=10)
+        # loss is visible on the Prometheus surface
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ing.prom_port}/metrics",
+                timeout=10) as resp:
+            text = resp.read().decode()
+        assert "deepflow_breaker_raising_trips" in text
+        assert "deepflow_exporters_put_errors" in text
+        assert "deepflow_supervisor_crashes" in text
+        # /healthz: open breaker -> 503 with the verdict body
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ing.prom_port}/healthz", timeout=10)
+            raise AssertionError("healthz must 503 while quarantined")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            verdict = json.loads(e.read().decode())
+            assert verdict["open_breakers"] == ["raising"]
+    finally:
+        ing.close()
+
+
+def test_ingester_fault_spec_arms_registry(tmp_path):
+    from deepflow_tpu.enrich.platform_data import PlatformDataManager
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(
+        listen_port=0, fault_spec="exporter.raise:count=1;seed=3"),
+        platform=PlatformDataManager())
+    try:
+        assert default_faults().enabled
+        assert default_faults().counters()["armed"] == 1
+    finally:
+        ing.close()
+        default_faults().disarm()
