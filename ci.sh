@@ -30,6 +30,13 @@ print("kernel BTF (stack-ABI goid keying):",
       btf.fsbase_offset() or "unavailable")
 EOF
 
+echo "== deepflow-lint: static invariants =="
+# ISSUE 3: the pipeline's concurrency / trace-safety / metrics
+# disciplines checked mechanically (deepflow_tpu/analysis/). The gate
+# is "no findings beyond the committed baseline" — paying down debt
+# shrinks .lint-baseline.json; any NEW violation fails CI here
+python -m deepflow_tpu.cli lint --baseline .lint-baseline.json
+
 echo "== pytest =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,17 @@
+"""deepflow-lint: AST invariant checks for the pipeline's disciplines.
+
+Entry points: `df-ctl lint` (deepflow_tpu/cli.py), the `lint` debug
+command (runtime/debug.py), and ci.sh's failing lint step against the
+committed `.lint-baseline.json`. See core.py for the framework and
+checkers.py for the six rules.
+"""
+
+from deepflow_tpu.analysis.core import (Finding, all_rules,
+                                        findings_to_json, format_findings,
+                                        load_baseline, new_findings,
+                                        run_lint, run_on_sources,
+                                        save_baseline, scan_package)
+
+__all__ = ["Finding", "all_rules", "findings_to_json", "format_findings",
+           "load_baseline", "new_findings", "run_lint", "run_on_sources",
+           "save_baseline", "scan_package"]

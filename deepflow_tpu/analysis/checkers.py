@@ -1,0 +1,476 @@
+"""The six deepflow-lint rules. Each guards an incident class PRs 1-2
+paid for once already; the docstrings name the original failure so the
+rule stays reviewable against its reason to exist.
+
+All checkers are lexical (stdlib `ast`): they prove properties of the
+program TEXT, not the runtime. Where a rule cannot decide statically
+(an external base class, an unresolvable receiver) it stays silent —
+a linter that cries wolf gets pragma'd into uselessness. Grandfathered
+true positives live in the committed baseline instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from deepflow_tpu.analysis.core import (Checker, FileContext, Finding,
+                                        ProjectIndex, dotted, register)
+
+__all__ = ["UnsupervisedThread", "EmitUnderLock", "HostSyncInDevicePath",
+           "TraceUnsafeJit", "CountableMissingCounters", "FaultSiteDrift"]
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _walk_scoped(node: ast.AST, cls: Optional[str] = None,
+                 funcs: Tuple[str, ...] = ()
+                 ) -> Iterator[Tuple[ast.AST, Optional[str],
+                                     Tuple[str, ...]]]:
+    """Yield (node, enclosing class, enclosing function stack)."""
+    for child in ast.iter_child_nodes(node):
+        yield child, cls, funcs
+        if isinstance(child, ast.ClassDef):
+            yield from _walk_scoped(child, child.name, funcs)
+        elif isinstance(child, _FUNC_DEFS):
+            yield from _walk_scoped(child, cls, funcs + (child.name,))
+        else:
+            yield from _walk_scoped(child, cls, funcs)
+
+
+def _scope_label(cls: Optional[str], funcs: Tuple[str, ...]) -> str:
+    if funcs:
+        return f"{cls}.{funcs[-1]}" if cls else funcs[-1]
+    return cls or "<module>"
+
+
+def _walk_same_frame(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk `root`'s subtree WITHOUT descending into nested function
+    definitions: code inside a nested def is not executed where it is
+    defined, so lexical held-a-lock reasoning must stop at the frame."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNC_DEFS + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class UnsupervisedThread(Checker):
+    """PR 2 built the supervision tree because raising workers died
+    silently and their lane went dark with no counter moving. A bare
+    `threading.Thread(...)` re-opens exactly that hole: no crash
+    capture, no backoff restart, no deadman heartbeat. Only
+    runtime/supervisor.py may construct threads."""
+
+    name = "unsupervised-thread"
+    description = ("bare threading.Thread() outside runtime/supervisor.py "
+                   "— spawn through Supervisor.spawn")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        if ctx.path.endswith("runtime/supervisor.py"):
+            return
+        aliases = set()        # names bound to threading.Thread itself
+        mod_aliases = set()    # names bound to the threading module
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.ImportFrom) and n.module == "threading":
+                aliases |= {a.asname or a.name for a in n.names
+                            if a.name == "Thread"}
+            elif isinstance(n, ast.Import):
+                mod_aliases |= {a.asname or a.name for a in n.names
+                                if a.name == "threading"}
+        for node, cls, funcs in _walk_scoped(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            if d in aliases \
+                    or any(d == f"{m}.Thread" for m in mod_aliases) \
+                    or d == "threading.Thread" \
+                    or d.endswith(".threading.Thread") \
+                    or d.endswith("._threading.Thread"):
+                yield self.finding(
+                    ctx, node,
+                    f"bare threading.Thread() in "
+                    f"{_scope_label(cls, funcs)}: spawn through "
+                    f"Supervisor.spawn for crash capture, restart and "
+                    f"deadman beats")
+
+
+_EMIT_METHODS = frozenset(["emit", "put", "puts", "send", "observe"])
+
+
+@register
+class EmitUnderLock(Checker):
+    """The PR 2 throttler deadlock: ThrottlingQueue emitted downstream
+    while holding its reservoir lock, and a re-entrant emit wedged every
+    decoder. The fix was swap-under-lock (detach state under the lock,
+    emit after release; see runtime/throttler.py `_swap_locked`). This
+    rule flags emit/put/send/observe calls lexically inside a
+    `with self.<lock>:` body — or anywhere in a function whose
+    `_locked` suffix promises the caller already holds one."""
+
+    name = "emit-under-lock"
+    description = ("metrics/queue/exporter emit while holding a lock — "
+                   "use the swap-under-lock pattern")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        seen: Set[Tuple[int, int]] = set()
+        for node, cls, funcs in _walk_scoped(ctx.tree):
+            if isinstance(node, ast.With):
+                lock = self._lock_name(node, cls, ctx.path, index)
+                if lock:
+                    yield from self._scan(
+                        ctx, node, f"while holding {lock}", seen)
+            elif isinstance(node, _FUNC_DEFS) \
+                    and node.name.endswith("_locked"):
+                yield from self._scan(
+                    ctx, node,
+                    f"inside {node.name}() (the _locked suffix means the "
+                    f"caller holds a lock)", seen)
+
+    @staticmethod
+    def _lock_name(node: ast.With, cls: Optional[str], path: str,
+                   index: ProjectIndex) -> Optional[str]:
+        for item in node.items:
+            d = dotted(item.context_expr)
+            if d is None:
+                continue
+            leaf = d.rsplit(".", 1)[-1]
+            if "lock" in leaf.lower() or "mutex" in leaf.lower():
+                return d
+            # `with self._ready:` where _ready = threading.Condition(...)
+            if cls and d.startswith("self.") \
+                    and leaf in index.lock_attrs_of(cls, path):
+                return d
+        return None
+
+    def _scan(self, ctx: FileContext, root: ast.AST, why: str,
+              seen: Set[Tuple[int, int]]) -> Iterable[Finding]:
+        for sub in _walk_same_frame(root):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)):
+                continue
+            if sub.func.attr.lstrip("_") not in _EMIT_METHODS:
+                continue
+            at = (sub.lineno, sub.col_offset)
+            if at in seen:        # a with-lock inside a _locked function
+                continue
+            seen.add(at)
+            d = dotted(sub.func) or sub.func.attr
+            yield self.finding(
+                ctx, sub,
+                f"{d}() {why}: a slow or re-entrant emit deadlocks every "
+                f"caller — detach under the lock, emit after release "
+                f"(swap-under-lock)")
+
+
+_DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py")
+# the sampled-drain helpers where a blocking sync is the point: explicit
+# attribution drains on every Nth batch / cold compile (PR 1) and the
+# degraded-mode device probe (PR 2)
+_SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
+                               "_probe_device_locked"])
+
+
+@register
+class HostSyncInDevicePath(Checker):
+    """PR 1's attribution work kept the device pipeline async on
+    purpose: a `block_until_ready` (or `.item()` / `device_get`
+    materialization) on the hot path serializes dispatch against the
+    device and caps throughput at one batch in flight. Blocking drains
+    are allowed only inside the sanctioned sampled-drain helpers."""
+
+    name = "host-sync-in-device-path"
+    description = ("blocking device sync (block_until_ready/device_get/"
+                   ".item(), or np.asarray/float/int materializing "
+                   "device state) in the async device path outside the "
+                   "sanctioned sampled-drain helpers")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        if not (ctx.path.endswith(_DEVICE_PATH_SUFFIXES)
+                or "/parallel/" in f"/{ctx.path}"):
+            return
+        for node, cls, funcs in _walk_scoped(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(f in _SANCTIONED_SYNCS for f in funcs):
+                continue
+            what = self._sync_kind(node)
+            if what:
+                yield self.finding(
+                    ctx, node,
+                    f"{what} in {_scope_label(cls, funcs)} blocks the "
+                    f"async device pipeline; host syncs belong in the "
+                    f"sampled-drain helpers "
+                    f"({', '.join(sorted(_SANCTIONED_SYNCS))})")
+
+    @staticmethod
+    def _sync_kind(node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "block_until_ready":
+                return "block_until_ready()"
+            if node.func.attr == "item" and not node.args:
+                return ".item()"
+        d = dotted(node.func)
+        if d and (d == "device_get" or d.endswith(".device_get")):
+            return "jax.device_get()"
+        # np.asarray/float/int materialize (D2H-fetch) their argument.
+        # Host arrays are everywhere in these files, so only flag when
+        # the argument mentions the device-resident sketch *state* —
+        # the one thing that is ALWAYS a device value here. Broader
+        # device locals are beyond lexical reach; the unconditional
+        # primitives above catch their sync points instead.
+        if d in ("np.asarray", "numpy.asarray", "float", "int") \
+                and node.args:
+            for sub in ast.walk(node.args[0]):
+                name = sub.attr if isinstance(sub, ast.Attribute) else (
+                    sub.id if isinstance(sub, ast.Name) else "")
+                if "state" in name:
+                    return f"{d}() on device state"
+        return None
+
+
+_JIT_LEAVES = frozenset(["jit", "pmap", "shard_map"])
+_TIME_CALLS = frozenset(["time.time", "time.perf_counter", "time.monotonic",
+                         "time.time_ns", "time.perf_counter_ns"])
+# numpy attributes that are compile-time-static by construction (dtype
+# objects and their queries) — everything else under np.* runs at TRACE
+# time and bakes its result into the compiled program as a constant
+_NP_STATIC = frozenset(["dtype", "iinfo", "finfo", "uint8", "uint16",
+                        "uint32", "uint64", "int8", "int16", "int32",
+                        "int64", "float16", "float32", "float64", "bool_",
+                        "intp", "ndim", "shape"])
+
+
+@register
+class TraceUnsafeJit(Checker):
+    """A jitted function's Python body runs ONCE, at trace time:
+    `time.time()` freezes the compile timestamp into the program,
+    `random.*` freezes one draw, `np.*` constant-folds host math,
+    `print` fires only on recompiles, and `.item()` forces a host sync
+    mid-trace. The repo hit this class in PR 1 (compile-time constants
+    poisoning kernel quantiles). Flags hazards inside functions/lambdas
+    reachable from jax.jit / pmap / shard_map call sites and
+    decorators, following module-local helper calls (bare names and
+    self.<method>) with a visited set; cross-module calls are not
+    traversed."""
+
+    name = "trace-unsafe-jit"
+    description = ("host-side effect (time/random/np/print/.item) inside "
+                   "a function passed to jax.jit/shard_map/pmap")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        defs: Dict[str, ast.AST] = {
+            n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, _FUNC_DEFS)}
+        targets: List[Tuple[ast.AST, str]] = []
+        seen: Set[int] = set()
+
+        def add(node: ast.AST, label: str) -> None:
+            if id(node) not in seen:
+                seen.add(id(node))
+                targets.append((node, label))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if self._is_wrapper(d) and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Lambda):
+                        add(arg, f"lambda passed to {d}")
+                    elif isinstance(arg, ast.Name) and arg.id in defs:
+                        add(defs[arg.id], f"{arg.id}() (wrapped by {d})")
+            elif isinstance(node, _FUNC_DEFS):
+                for dec in node.decorator_list:
+                    if self._decorator_jits(dec):
+                        add(node, f"{node.name}() (jitted by decorator)")
+        for target, label in targets:
+            yield from self._scan(ctx, target, label, defs, set())
+
+    @staticmethod
+    def _is_wrapper(d: Optional[str]) -> bool:
+        return d is not None and d.rsplit(".", 1)[-1] in _JIT_LEAVES
+
+    @classmethod
+    def _decorator_jits(cls, dec: ast.AST) -> bool:
+        if cls._is_wrapper(dotted(dec)):
+            return True                        # @jax.jit
+        if isinstance(dec, ast.Call):
+            d = dotted(dec.func)
+            if cls._is_wrapper(d):
+                return True                    # @jax.jit(static_argnames=..)
+            if d and d.rsplit(".", 1)[-1] == "partial" and dec.args:
+                return cls._is_wrapper(dotted(dec.args[0]))
+        return False
+
+    def _scan(self, ctx: FileContext, root: ast.AST, label: str,
+              defs: Dict[str, ast.AST],
+              visited: Set[int]) -> Iterable[Finding]:
+        if id(root) in visited:
+            return
+        visited.add(id(root))
+        for sub in ast.walk(root):
+            if not isinstance(sub, ast.Call):
+                continue
+            hazard = self._hazard(sub)
+            if hazard:
+                yield self.finding(
+                    ctx, sub,
+                    f"{hazard} inside jit-traced {label}: runs once at "
+                    f"trace time, not per batch — its result is baked "
+                    f"into the compiled program")
+                continue
+            # follow module-local helper calls: the jit trace descends
+            # into them, so the lint must too (bare names and
+            # self.<method>; cross-module helpers are out of reach)
+            d = dotted(sub.func)
+            helper = None
+            if d in defs:
+                helper = defs[d]
+            elif d and d.startswith("self.") and d.count(".") == 1 \
+                    and d[5:] in defs:
+                helper = defs[d[5:]]
+            if helper is not None:
+                yield from self._scan(ctx, helper,
+                                      f"{label} via {d}()", defs, visited)
+
+    @staticmethod
+    def _hazard(node: ast.Call) -> Optional[str]:
+        d = dotted(node.func)
+        if d in _TIME_CALLS:
+            return f"{d}()"
+        if d and (d.startswith("random.") or d == "random"):
+            return f"{d}()"
+        if d and d.startswith(("np.", "numpy.")) \
+                and d.split(".", 1)[1].split(".")[0] not in _NP_STATIC:
+            return f"{d}()"
+        if d == "print":
+            return "print()"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            return ".item()"
+        return None
+
+
+@register
+class CountableMissingCounters(Checker):
+    """PR 2's silent AttributeError: a Countable registration pointed at
+    a `counters` the class didn't actually provide, the stats collector
+    swallowed the raise (a broken source must not kill the scrape), and
+    the tpu_sketch lane vanished from stats without a trace. Where the
+    registered object's class resolves within the repo, prove
+    `counters` exists — through repo-local base classes — and report
+    only a PROVEN absence (external bases stay silent)."""
+
+    name = "countable-missing-counters"
+    description = ("object registered as a Countable whose class "
+                   "defines no counters()")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        local_ctors = self._module_ctor_names(ctx.tree)
+        for node, cls, funcs in _walk_scoped(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "register"):
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                if not (isinstance(arg, ast.Attribute)
+                        and arg.attr == "counters"):
+                    continue
+                owner = self._owner_class(arg.value, cls, ctx.path,
+                                          local_ctors, index)
+                if owner and index.resolves_method(
+                        owner, "counters", path=ctx.path) == "no":
+                    yield self.finding(
+                        ctx, node,
+                        f"'{owner}' is registered as a Countable in "
+                        f"{_scope_label(cls, funcs)} but defines no "
+                        f"counters() — the stats collector will silently "
+                        f"drop it on every scrape")
+
+    @staticmethod
+    def _module_ctor_names(tree: ast.Module) -> Dict[str, Set[str]]:
+        """name -> class leaf names ever constructor-assigned to it."""
+        out: Dict[str, Set[str]] = {}
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                ctor = dotted(node.value.func)
+                if ctor:
+                    out.setdefault(node.targets[0].id, set()).add(
+                        ctor.rsplit(".", 1)[-1])
+        return out
+
+    @staticmethod
+    def _owner_class(recv: ast.AST, cls: Optional[str], path: str,
+                     local_ctors: Dict[str, Set[str]],
+                     index: ProjectIndex) -> Optional[str]:
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                return cls
+            ctors = local_ctors.get(recv.id, set())
+            if len(ctors) == 1:            # unambiguous local `x = Cls(...)`
+                return next(iter(ctors))
+            return None
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and cls):
+            infos = index.classes.get(cls, [])
+            same = [i for i in infos if i.path == path]
+            for info in same or infos:
+                owner = info.attr_classes.get(recv.attr)
+                if owner:
+                    return owner
+        return None
+
+
+@register
+class FaultSiteDrift(Checker):
+    """runtime/faults.py is trustworthy only while its site registry
+    matches the injection points: a site with no caller silently stops
+    injecting (chaos coverage rots), and an injection point using an
+    unregistered constant never fires. Diffs `FAULT_*` definitions
+    against name references (and site-string literals) across the scan.
+    Needs a whole-package scan — linting faults.py alone reads every
+    site as orphaned."""
+
+    name = "fault-site-drift"
+    description = ("FAULT_* site with no injection point, or injection "
+                   "point with no registered site")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        if not index.fault_defs:
+            return                       # faults.py outside the scan scope
+        if ctx.path == index.fault_defs_path:
+            for name, (value, line) in sorted(index.fault_defs.items()):
+                if name in index.fault_refs:
+                    continue
+                if index.site_strings.get(value):
+                    continue             # armed/fired via its spec string
+                yield Finding(
+                    self.name, ctx.path, line, 0,
+                    f"fault site '{value}' ({name}) has no injection "
+                    f"point outside faults.py — the registry and the "
+                    f"data plane have drifted", self.severity)
+            return
+        for name, refs in sorted(index.fault_refs.items()):
+            if name in index.fault_defs:
+                continue
+            for path, line in refs:
+                if path == ctx.path:
+                    yield Finding(
+                        self.name, ctx.path, line, 0,
+                        f"{name} is referenced here but not defined in "
+                        f"runtime/faults.py — this injection point can "
+                        f"never fire", self.severity)

@@ -308,9 +308,13 @@ def cmd_ingester(args) -> int:
                             count=args.count)
         print(json.dumps(out, indent=2, sort_keys=True))
     elif args.action in ("counters", "vtap-status", "ping", "stacks",
-                         "artifacts", "queues", "supervisor", "breakers"):
+                         "artifacts", "queues", "supervisor", "breakers",
+                         "lint"):
+        # lint self-scans ~250 files inside the debug loop: seconds, not
+        # the protocol's usual milliseconds — give it a matching timeout
         out = debug_request(args.action,
                             port=args.debug_port or DEFAULT_DEBUG_PORT,
+                            timeout=30.0 if args.action == "lint" else 2.0,
                             **({"module": args.module} if args.module
                                else {}))
         print(json.dumps(out, indent=2, sort_keys=True))
@@ -466,6 +470,56 @@ def cmd_capture(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """deepflow-lint (deepflow_tpu/analysis/): AST invariant checks for
+    the pipeline's concurrency / trace-safety / metrics disciplines.
+    The zero-arg form self-scans the installed package; --baseline
+    gates on NEW findings only (the committed .lint-baseline.json
+    workflow ci.sh enforces)."""
+    from deepflow_tpu import analysis
+
+    if args.list_rules:
+        for name, cls in sorted(analysis.all_rules().items()):
+            print(f"{name} [{cls.severity}]: {cls.description}")
+        return 0
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        if args.rules else None
+    findings = analysis.run_lint(args.paths or None, rules=rules)
+    if args.update_baseline:
+        if not args.baseline:
+            print("--update-baseline requires --baseline FILE",
+                  file=sys.stderr)
+            return 2
+        if rules:
+            # a rule-subset scan rewriting the baseline would silently
+            # delete every OTHER rule's grandfathered entries — the next
+            # full gate (ci.sh) then fails on all of them as "new"
+            print("--update-baseline refuses --rules: a subset scan "
+                  "would drop the other rules' grandfathered findings",
+                  file=sys.stderr)
+            return 2
+        if args.paths:
+            print("note: baseline updated from an explicit path scope — "
+                  "gate with the same paths, or findings outside them "
+                  "will read as new", file=sys.stderr)
+        analysis.save_baseline(findings, args.baseline)
+        print(f"baseline updated: {len(findings)} grandfathered "
+              f"finding(s) -> {args.baseline}")
+        return 0
+    gated = findings
+    if args.baseline:
+        gated = analysis.new_findings(findings,
+                                      analysis.load_baseline(args.baseline))
+    if args.json:
+        print(analysis.findings_to_json(gated))
+    else:
+        print(analysis.format_findings(gated))
+        if args.baseline and len(findings) > len(gated):
+            print(f"({len(findings) - len(gated)} baselined finding(s) "
+                  f"suppressed)")
+    return 1 if gated else 0
+
+
 def cmd_promql(args) -> int:
     if (args.start is None) != (args.end is None):
         print("error: --start and --end must be given together",
@@ -563,7 +617,7 @@ def build_parser() -> argparse.ArgumentParser:
                                       "vtap-status", "ping", "stacks",
                                       "artifacts", "datasource",
                                       "queues", "queue-tap",
-                                      "supervisor", "breakers"])
+                                      "supervisor", "breakers", "lint"])
     i.add_argument("addrs", nargs="*")
     i.add_argument("--module")
     i.add_argument("--op", default="list",
@@ -625,6 +679,25 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--slow-ms", type=float, default=None,
                     help="spans: only spans slower than this")
     tr.set_defaults(fn=cmd_trace)
+
+    ln = sub.add_parser(
+        "lint", help="deepflow-lint: AST invariant checks (concurrency /"
+                     " trace-safety / metrics disciplines)")
+    ln.add_argument("paths", nargs="*",
+                    help="files or directories (default: the installed "
+                         "deepflow_tpu package)")
+    ln.add_argument("--baseline",
+                    help="grandfathered-findings JSON; exit status gates "
+                         "on NEW findings only")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline from the current findings "
+                         "(review the diff: it should only shrink)")
+    ln.add_argument("--rules", help="comma-separated rule subset")
+    ln.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ln.add_argument("--list-rules", action="store_true",
+                    help="list rules with their one-line descriptions")
+    ln.set_defaults(fn=cmd_lint)
 
     rp = sub.add_parser("replay-pcap",
                         help="replay a pcap through an agent -> ingester")

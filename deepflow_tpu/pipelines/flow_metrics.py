@@ -9,6 +9,7 @@ unmarshaller fleet decodes Documents columnar, and the RollupManager
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import List, Optional
@@ -19,6 +20,7 @@ from deepflow_tpu.runtime.exporters import Exporters
 from deepflow_tpu.runtime.queues import MultiQueue
 from deepflow_tpu.runtime.receiver import Receiver
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.rollup import RollupManager
 from deepflow_tpu.store.writer import StoreWriter
@@ -55,7 +57,7 @@ class FlowMetricsPipeline:
                                          METRICS_TABLE,
                                          intervals=rollup_intervals)
             self.writer = StoreWriter(self.rollups.base, stats=stats)
-        self._threads: List[threading.Thread] = []
+        self._handles: List = []       # supervisor ThreadHandles
         self._stop = threading.Event()
         self.n = n_unmarshallers
         self.records = 0
@@ -66,29 +68,34 @@ class FlowMetricsPipeline:
     def start(self) -> None:
         if self.writer is not None:
             self.writer.start()
+        # supervised (crash capture, backoff restart, deadman beats
+        # from each drain iteration) — the flow_log decoder discipline,
+        # applied to the unmarshaller fleet and the rollup ticker
+        sup = default_supervisor()
         for i in range(self.n):
-            t = threading.Thread(target=self._run, args=(i,),
-                                 name=f"unmarshall-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._handles.append(
+                sup.spawn(f"unmarshall-{i}",
+                          functools.partial(self._run, i)))
         if self.rollups is not None:
-            t = threading.Thread(target=self._rollup_loop, name="rollup",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._handles.append(sup.spawn(
+                "rollup", self._rollup_loop,
+                beat_period_s=self.rollup_period))
 
     def close(self) -> None:
         self.queues.close()
         self._stop.set()
-        for t in self._threads:
-            t.join(timeout=2)
+        for h in self._handles:
+            h.stop()
+            h.join(timeout=2)
         if self.writer is not None:
             self.writer.close()  # flush pending rows first
         if self.rollups is not None:
             self.rollups.advance(time.time() + 120)  # final drain, no wait
 
     def _run(self, index: int) -> None:
+        sup = default_supervisor()
         while not self._stop.is_set():
+            sup.beat()
             frames = self.queues.gets(index, 64, timeout=0.2)
             if not frames:
                 if self.queues.queues[index].closed:
@@ -122,7 +129,9 @@ class FlowMetricsPipeline:
             self.writer.flush()
 
     def _rollup_loop(self) -> None:
+        sup = default_supervisor()
         while not self._stop.wait(self.rollup_period):
+            sup.beat()
             self.rollups.advance(time.time())
 
     def counters(self) -> dict:

@@ -24,6 +24,7 @@ from deepflow_tpu.batch.schema import Schema
 from deepflow_tpu.models import app_suite
 from deepflow_tpu.runtime.exporters import QueueWorkerExporter
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
 from deepflow_tpu.store.writer import StoreWriter
@@ -154,7 +155,7 @@ class AppRedExporter(QueueWorkerExporter):
                 (self.cfg.groups, len(idx)), np.float64)
         self._state_lock = threading.Lock()
         self._window_stop = threading.Event()
-        self._window_thread: Optional[threading.Thread] = None
+        self._window_thread = None     # supervisor ThreadHandle
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -163,13 +164,16 @@ class AppRedExporter(QueueWorkerExporter):
         if self.bucket_writer is not None:
             self.bucket_writer.start()
         super().start()
-        self._window_thread = threading.Thread(
-            target=self._window_loop, name="app-red-window", daemon=True)
-        self._window_thread.start()
+        # supervised (crash capture + restart), deadman disabled: the
+        # loop legitimately blocks a full window_seconds between beats
+        # (same policy as the tpu_sketch window thread)
+        self._window_thread = default_supervisor().spawn(
+            "app-red-window", self._window_loop, deadman_s=None)
 
     def close(self) -> None:
         self._window_stop.set()
         if self._window_thread is not None:
+            self._window_thread.stop()
             self._window_thread.join(timeout=5)
         super().close()
         self.flush_window()
@@ -188,7 +192,10 @@ class AppRedExporter(QueueWorkerExporter):
             schema_cols = self.coerce_to_schema(cols, _RED_SCHEMA)
             n = len(next(iter(schema_cols.values())))
             with self._state_lock:
-                for tb in self.batcher.put(schema_cols):
+                # not an emission: the batcher is private state guarded
+                # BY this lock (the window thread flushes it under the
+                # same lock); no other thread can block on it
+                for tb in self.batcher.put(schema_cols):  # lint: disable=emit-under-lock
                     self._run_batch_locked(tb)
                 self.rows_in += n
 

@@ -38,6 +38,7 @@ class DebugServer:
             # default supervision-tree view (the Ingester overrides this
             # with its own registration — same shape, same command)
             "supervisor": self._supervisor,
+            "lint": self._lint,
         }
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
@@ -107,6 +108,28 @@ class DebugServer:
             "crashes": [{**c, "traceback": c["traceback"][-1200:]}
                         for c in sup.crash_log()[-8:]],
         }
+
+    @staticmethod
+    def _lint(req: dict) -> dict:
+        """deepflow-lint self-scan of the INSTALLED package (analysis/):
+        is the code this process is actually running clean? Per-rule
+        totals plus the first findings, truncated for the one-datagram
+        budget; `module` substring-filters finding paths. No baseline is
+        applied here — this is the raw discipline surface; ci.sh owns
+        the grandfathered-baseline gate. The ~250-file ast.parse pass
+        runs inside the debug loop's request slot and takes SECONDS in
+        a busy process (GIL contention) — the CLI client raises its
+        datagram timeout for this command, and other debug requests
+        queue behind it (ops surface, not hot path)."""
+        from collections import Counter
+
+        from deepflow_tpu.analysis import scan_package
+
+        want = req.get("module") or ""
+        fs = [f for f in scan_package() if want in f.path]
+        return {"total": len(fs),
+                "by_rule": dict(sorted(Counter(f.rule for f in fs).items())),
+                "findings": [f.to_dict() for f in fs[:25]]}
 
     @staticmethod
     def _stacks(req: dict) -> dict:

@@ -90,6 +90,7 @@ class OverwriteQueue:
         if _FAULTS.enabled:   # chaos: simulate a stalled consumer
             _FAULTS.maybe_stall(FAULT_QUEUE_STALL, key=self.name)
         tracer = self._tracer
+        dwell = None
         with self._ready:
             if self._size == 0 and not self._closed:
                 self._ready.wait(timeout)
@@ -97,11 +98,14 @@ class OverwriteQueue:
             if (n and tracer is not None and tracer.enabled
                     and self._put_ts is not None):
                 # sample the OLDEST drained item's dwell (one observation
-                # per batch get keeps the cost off the per-item path)
+                # per batch get keeps the cost off the per-item path);
+                # measured here, EMITTED after release — observe() takes
+                # the tracer's own locks, and nesting those under the
+                # ring's condvar is the PR 2 deadlock class
+                # (deepflow-lint emit-under-lock)
                 ts = self._put_ts[self._head]
                 if ts > 0.0:
-                    tracer.observe(self._dwell_stage,
-                                   time.perf_counter() - ts)
+                    dwell = time.perf_counter() - ts
             out = []
             for _ in range(n):
                 out.append(self._buf[self._head])
@@ -109,7 +113,9 @@ class OverwriteQueue:
                 self._head = (self._head + 1) % self.capacity
             self._size -= n
             self.out_count += n
-            return out
+        if dwell is not None:
+            tracer.observe(self._dwell_stage, dwell)
+        return out
 
     def close(self) -> None:
         """Wake all readers; subsequent puts raise, gets drain then return []."""

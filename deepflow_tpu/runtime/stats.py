@@ -44,7 +44,7 @@ class StatsRegistry:
         self._lock = threading.Lock()
         self._history: List[StatSample] = []
         self._history_cap = history
-        self._thread: Optional[threading.Thread] = None
+        self._handle = None            # supervisor ThreadHandle
         self._stop = threading.Event()
         self._sinks: List[Callable[[StatSample], None]] = []
 
@@ -90,23 +90,31 @@ class StatsRegistry:
                     if module is None or s.module == module]
 
     def start(self, interval_s: float = 10.0) -> None:
-        if self._thread is not None:
+        if self._handle is not None:
             return
         self._stop.clear()
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
 
         def loop() -> None:
             while not self._stop.wait(interval_s):
+                sup.beat()
                 self.collect()
 
-        self._thread = threading.Thread(target=loop, name="stats-collector",
-                                        daemon=True)
-        self._thread.start()
+        # supervised: a raising collect() restarts with backoff instead
+        # of silently ending every scrape; the beat above feeds the
+        # deadman once per cadence (spawn derives the watchdog policy
+        # from beat_period_s — disabled for cadences the window can't
+        # cover)
+        self._handle = sup.spawn("stats-collector", loop,
+                                 beat_period_s=interval_s)
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        if self._handle is not None:
+            self._handle.stop()
+            self._handle.join(timeout=5)
+            self._handle = None
 
 
 class StatsShipper:
@@ -149,20 +157,26 @@ class StatsShipper:
             tag_values=[str(v) for v in tags.values()],
             metrics_float_names=list(metrics.keys()),
             metrics_float_values=list(metrics.values()))
+        # swap-under-lock (throttler discipline, deepflow-lint
+        # emit-under-lock): detach the full batch while holding _lock,
+        # send after release — the wire send can block on a reconnect,
+        # and holding _lock across it would stall every sink caller.
+        # sender.send is internally serialized, so two detached batches
+        # racing here interleave at frame granularity, never corrupt.
+        batch = None
         with self._lock:
             self._batch.append(st.SerializeToString())
             if len(self._batch) >= 64:
-                self._flush_locked()
+                batch, self._batch = self._batch, []
+        if batch:
+            # send() packs, size-splits, and accounts per record
+            self.sender.send(batch)
 
     def flush(self) -> None:
         with self._lock:
-            self._flush_locked()
-
-    def _flush_locked(self) -> None:
-        if self._batch:
-            # send() packs, size-splits, and accounts per record
-            self.sender.send(self._batch)
-            self._batch = []
+            batch, self._batch = self._batch, []
+        if batch:
+            self.sender.send(batch)
 
     def close(self) -> None:
         self._closed = True

@@ -107,12 +107,22 @@ class Supervisor:
     # -- spawning ----------------------------------------------------------
     def spawn(self, name: str, target: Callable[[], None],
               restart: bool = True,
-              deadman_s: Optional[float] = -1.0) -> ThreadHandle:
+              deadman_s: Optional[float] = -1.0,
+              beat_period_s: Optional[float] = None) -> ThreadHandle:
         """Run `target` (a long-running loop) on a supervised thread.
         deadman_s: -1 inherits the supervisor default; None/0 disables
         the watchdog for this worker (threads that legitimately block a
-        long time, e.g. the sketch window timer at test-sized periods)."""
+        long time, e.g. the sketch window timer at test-sized periods).
+        beat_period_s: the worker's natural heartbeat cadence (it beats
+        once per loop iteration); when given, the deadman policy is
+        derived HERE, once — a cadence at or past half the watchdog
+        window disables the watchdog for this worker, because a loop
+        that legitimately blocks that long between beats would read
+        permanently stale and flip /healthz on a healthy process."""
         dm = self.deadman_s if deadman_s == -1.0 else (deadman_s or None)
+        if beat_period_s is not None and dm is not None \
+                and beat_period_s >= dm / 2:
+            dm = None
         h = ThreadHandle(name, restart, dm, self._clock)
         t = threading.Thread(target=self._run, args=(h, target),
                              name=name, daemon=True)

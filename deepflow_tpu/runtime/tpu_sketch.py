@@ -349,7 +349,10 @@ class TpuSketchExporter(QueueWorkerExporter):
                 self._tracer.set_batch(rest[0])
             schema_cols = self.coerce_to_schema(cols, SKETCH_L4_SCHEMA)
             with self._state_lock:
-                for tb in self.batcher.put(schema_cols):
+                # not an emission: the batcher is private state guarded
+                # BY this lock (flush_window drains it under the same
+                # lock); no other thread can block on it
+                for tb in self.batcher.put(schema_cols):  # lint: disable=emit-under-lock
                     self._run_batch_locked(tb)
                 # counted only once the chunk is fully on device, so
                 # rows_in is a processed-watermark, not an arrival count

@@ -1,0 +1,487 @@
+"""deepflow-lint (deepflow_tpu/analysis/): per-rule positive / negative /
+pragma fixtures, the baseline machinery, the CLI gate, and the repo
+self-scan that keeps the shipped tree at zero non-baselined findings."""
+
+import json
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from deepflow_tpu import analysis
+from deepflow_tpu.cli import main as cli_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------- unsupervised-thread
+
+THREAD_SRC = "import threading\nt = threading.Thread(target=print)\n"
+
+
+def test_unsupervised_thread_positive():
+    fs = analysis.run_on_sources({"pkg/mod.py": THREAD_SRC})
+    assert rules_of(fs) == ["unsupervised-thread"]
+    assert "Supervisor.spawn" in fs[0].message
+
+
+def test_unsupervised_thread_catches_import_aliases():
+    src = "from threading import Thread as T\nt = T(target=print)\n"
+    assert rules_of(analysis.run_on_sources({"m.py": src})) \
+        == ["unsupervised-thread"]
+    # module-alias spelling must not bypass the gate
+    src = "import threading as th\nt = th.Thread(target=print)\n"
+    assert rules_of(analysis.run_on_sources({"m.py": src})) \
+        == ["unsupervised-thread"]
+
+
+def test_unsupervised_thread_negative_in_supervisor_and_pragma():
+    assert analysis.run_on_sources({
+        # the one sanctioned construction site
+        "runtime/supervisor.py": THREAD_SRC,
+        "pkg/ok.py": ("import threading\nt = threading.Thread(target=print)"
+                      "  # lint: disable=unsupervised-thread\n"),
+    }) == []
+
+
+# ----------------------------------------------------- emit-under-lock
+
+LOCKED_EMIT = """\
+import threading
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def go(self, sink, x):
+        with self._lock:
+            sink.emit(x)
+"""
+
+CONDVAR_EMIT = """\
+import threading
+class Q:
+    def __init__(self):
+        self._ready = threading.Condition(threading.Lock())
+    def go(self, sink, x):
+        with self._ready:
+            sink.put(x)
+"""
+
+SWAP_UNDER_LOCK = """\
+import threading
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._batch = []
+    def go(self, sink, x):
+        with self._lock:
+            self._batch.append(x)
+            batch, self._batch = self._batch, []
+        sink.send(batch)
+"""
+
+
+def test_emit_under_lock_positive_lock_and_condition():
+    assert rules_of(analysis.run_on_sources({"a.py": LOCKED_EMIT})) \
+        == ["emit-under-lock"]
+    # `with self._ready:` where _ready = threading.Condition(...)
+    assert rules_of(analysis.run_on_sources({"b.py": CONDVAR_EMIT})) \
+        == ["emit-under-lock"]
+
+
+def test_emit_under_lock_positive_locked_suffix_function():
+    src = ("class S:\n"
+           "    def _flush_locked(self, sink):\n"
+           "        sink.send(self._batch)\n")
+    fs = analysis.run_on_sources({"s.py": src})
+    assert rules_of(fs) == ["emit-under-lock"]
+    assert "_flush_locked" in fs[0].message
+
+
+def test_emit_under_lock_negative_swap_pattern_and_pragma():
+    assert analysis.run_on_sources({"a.py": SWAP_UNDER_LOCK}) == []
+    suppressed = LOCKED_EMIT.replace(
+        "sink.emit(x)", "sink.emit(x)  # lint: disable=emit-under-lock")
+    assert analysis.run_on_sources({"a.py": suppressed}) == []
+
+
+def test_emit_under_lock_ignores_nested_defs_under_lock():
+    # defining a closure under the lock is not emitting under the lock
+    src = ("import threading\n"
+           "class Q:\n"
+           "    def go(self, sink):\n"
+           "        with self._lock:\n"
+           "            def later():\n"
+           "                sink.send(1)\n"
+           "            self._cb = later\n")
+    assert analysis.run_on_sources({"a.py": src}) == []
+
+
+# -------------------------------------------- host-sync-in-device-path
+
+DEVICE_SYNC = """\
+import jax
+class E:
+    def process(self, x):
+        x.block_until_ready()
+        return jax.device_get(x)
+"""
+
+
+def test_host_sync_positive_in_device_path_files():
+    for path in ("runtime/tpu_sketch.py", "runtime/app_red.py",
+                 "parallel/sharded.py"):
+        fs = analysis.run_on_sources({path: DEVICE_SYNC})
+        assert rules_of(fs) == ["host-sync-in-device-path"] * 2, path
+
+
+def test_host_sync_negative_outside_device_path_and_in_helpers():
+    # other modules may sync freely (checkpointing does, by design)
+    assert analysis.run_on_sources({"runtime/checkpoint.py": DEVICE_SYNC}) \
+        == []
+    sanctioned = DEVICE_SYNC.replace("def process", "def _to_device")
+    assert analysis.run_on_sources(
+        {"runtime/tpu_sketch.py": sanctioned}) == []
+
+
+def test_host_sync_device_state_materialization():
+    src = ("import numpy as np\n"
+           "class E:\n"
+           "    def process(self, tb):\n"
+           "        return np.asarray(self.state)\n"
+           "    def host_side(self, cols):\n"
+           "        return np.asarray(cols['ip_src'])\n")
+    fs = analysis.run_on_sources({"runtime/tpu_sketch.py": src})
+    # the state fetch is flagged; plain host-array asarray is not
+    assert rules_of(fs) == ["host-sync-in-device-path"]
+    assert "device state" in fs[0].message and fs[0].line == 4
+
+
+def test_host_sync_item_call():
+    src = ("class E:\n"
+           "    def process(self, x):\n"
+           "        return x.sum().item()\n")
+    fs = analysis.run_on_sources({"runtime/app_red.py": src})
+    assert rules_of(fs) == ["host-sync-in-device-path"]
+
+
+# -------------------------------------------------- trace-unsafe-jit
+
+def test_trace_unsafe_jit_positive_named_function():
+    src = ("import time, jax\n"
+           "def step(x):\n"
+           "    return x * time.time()\n"
+           "f = jax.jit(step)\n")
+    fs = analysis.run_on_sources({"ops/m.py": src})
+    assert rules_of(fs) == ["trace-unsafe-jit"]
+    assert "time.time" in fs[0].message
+
+
+def test_trace_unsafe_jit_positive_lambda_and_decorator():
+    lam = ("import jax, numpy as np\n"
+           "f = jax.jit(lambda x: np.asarray(x))\n")
+    assert rules_of(analysis.run_on_sources({"a.py": lam})) \
+        == ["trace-unsafe-jit"]
+    dec = ("import functools, jax, random\n"
+           "@functools.partial(jax.jit, static_argnames=())\n"
+           "def step(x):\n"
+           "    return x + random.random()\n")
+    assert rules_of(analysis.run_on_sources({"b.py": dec})) \
+        == ["trace-unsafe-jit"]
+
+
+def test_trace_unsafe_jit_negative_unjitted_static_np_and_pragma():
+    # host effects in NEVER-jitted code are someone else's business
+    src = "import time\ndef step(x):\n    return x * time.time()\n"
+    assert analysis.run_on_sources({"a.py": src}) == []
+    # dtype constructors are compile-time static, not hazards
+    ok = ("import jax, numpy as np\n"
+          "f = jax.jit(lambda x: x.astype(np.float32))\n")
+    assert analysis.run_on_sources({"b.py": ok}) == []
+    suppressed = ("import time, jax\n"
+                  "def step(x):\n"
+                  "    return x * time.time()  # lint: disable=trace-unsafe-jit\n"
+                  "f = jax.jit(step)\n")
+    assert analysis.run_on_sources({"c.py": suppressed}) == []
+
+
+def test_trace_unsafe_jit_follows_module_local_helpers():
+    src = ("import time, jax\n"
+           "def helper(x):\n"
+           "    return x * time.time()\n"
+           "@jax.jit\n"
+           "def step(x):\n"
+           "    return helper(x)\n")
+    fs = analysis.run_on_sources({"a.py": src})
+    assert rules_of(fs) == ["trace-unsafe-jit"]
+    assert "via helper()" in fs[0].message
+    # self.<method> helpers too, with cycle tolerance
+    src2 = ("import time, jax\n"
+            "class M:\n"
+            "    def _helper(self, x):\n"
+            "        return self._helper(x) + time.time()\n"
+            "    def build(self):\n"
+            "        return jax.jit(lambda x: self._helper(x))\n")
+    assert rules_of(analysis.run_on_sources({"b.py": src2})) \
+        == ["trace-unsafe-jit"]
+
+
+def test_trace_unsafe_jit_shard_map():
+    src = ("from jax.experimental.shard_map import shard_map\n"
+           "def body(x):\n"
+           "    print(x)\n"
+           "    return x\n"
+           "f = shard_map(body, mesh=None, in_specs=(), out_specs=())\n")
+    fs = analysis.run_on_sources({"parallel/m.py": src})
+    assert "trace-unsafe-jit" in rules_of(fs)
+
+
+# ------------------------------------- countable-missing-counters
+
+def test_countable_missing_counters_positive_self():
+    src = ("class P:\n"
+           "    def __init__(self, stats):\n"
+           "        stats.register('p', self.counters)\n")
+    fs = analysis.run_on_sources({"a.py": src})
+    assert rules_of(fs) == ["countable-missing-counters"]
+
+
+def test_countable_missing_counters_positive_member_object():
+    src = ("class Sink:\n"
+           "    pass\n"
+           "class P:\n"
+           "    def __init__(self, stats):\n"
+           "        self.sink = Sink()\n"
+           "        stats.register('p', self.sink.counters)\n")
+    fs = analysis.run_on_sources({"a.py": src})
+    assert rules_of(fs) == ["countable-missing-counters"]
+    assert "'Sink'" in fs[0].message
+
+
+def test_countable_missing_counters_negative_inherited_and_external():
+    inherited = ("class Base:\n"
+                 "    def counters(self):\n"
+                 "        return {}\n"
+                 "class P(Base):\n"
+                 "    def __init__(self, stats):\n"
+                 "        stats.register('p', self.counters)\n")
+    assert analysis.run_on_sources({"a.py": inherited}) == []
+    # an unresolvable (external) base: absence is NOT proven -> silent
+    external = ("from somewhere import Base\n"
+                "class P(Base):\n"
+                "    def __init__(self, stats):\n"
+                "        stats.register('p', self.counters)\n")
+    assert analysis.run_on_sources({"b.py": external}) == []
+
+
+def test_countable_missing_counters_cross_file_base():
+    files = {
+        "base.py": "class Base:\n    def counters(self):\n        return {}\n",
+        "sub.py": ("class Sub(Base):\n"
+                   "    def __init__(self, stats):\n"
+                   "        stats.register('s', self.counters)\n"),
+    }
+    assert analysis.run_on_sources(files) == []
+
+
+def test_countable_missing_counters_import_aware():
+    # an IMPORTED repo-local base resolves through the import's module
+    resolved = {
+        "pkg/base.py": ("class Base:\n"
+                        "    def counters(self):\n"
+                        "        return {}\n"),
+        "pkg/sub.py": ("from pkg.base import Base\n"
+                       "class Sub(Base):\n"
+                       "    def __init__(self, stats):\n"
+                       "        stats.register('s', self.counters)\n"),
+    }
+    assert analysis.run_on_sources(resolved) == []
+    # a homonym class elsewhere in the repo must NOT stand in for an
+    # EXTERNAL import of the same name (would be a false 'proven
+    # absence' — the external Base may well define counters)
+    homonym = {
+        "pkg/base.py": "class Base:\n    pass\n",
+        "pkg/sub.py": ("from external_lib import Base\n"
+                       "class Sub(Base):\n"
+                       "    def __init__(self, stats):\n"
+                       "        stats.register('s', self.counters)\n"),
+    }
+    assert analysis.run_on_sources(homonym) == []
+
+
+# ------------------------------------------------- fault-site-drift
+
+FAULTS_SRC = ('FAULT_USED = "queue.stall"\n'
+              'FAULT_ORPHAN = "ghost.site"\n')
+
+
+def test_fault_site_drift_orphan_and_unknown():
+    fs = analysis.run_on_sources({
+        "runtime/faults.py": FAULTS_SRC,
+        "runtime/queues.py": ("from deepflow_tpu.runtime.faults import "
+                              "FAULT_USED, FAULT_MISSING\n"
+                              "def f(r):\n"
+                              "    r.maybe_stall(FAULT_USED)\n"
+                              "    r.maybe_stall(FAULT_MISSING)\n"),
+    })
+    assert sorted(rules_of(fs)) == ["fault-site-drift", "fault-site-drift"]
+    msgs = " | ".join(f.message for f in fs)
+    assert "ghost.site" in msgs and "FAULT_MISSING" in msgs
+    assert "FAULT_USED" not in msgs
+
+
+def test_fault_site_drift_spec_string_counts_as_reference():
+    # arming via a spec/site string is a live injection point too
+    fs = analysis.run_on_sources({
+        "runtime/faults.py": 'FAULT_X = "exporter.raise"\n',
+        "chaos.py": 'SPEC = "exporter.raise"\n',
+    })
+    assert fs == []
+
+
+def test_fault_site_drift_silent_without_faults_file():
+    # partial scans (faults.py out of scope) must not cry drift
+    src = "from deepflow_tpu.runtime.faults import FAULT_USED\nx = FAULT_USED\n"
+    assert analysis.run_on_sources({"runtime/queues.py": src}) == []
+
+
+# --------------------------------------------------------- framework
+
+def test_parse_error_is_a_finding():
+    fs = analysis.run_on_sources({"bad.py": "def f(:\n"})
+    assert rules_of(fs) == ["parse-error"]
+
+
+def test_pragma_inside_string_literal_does_not_suppress():
+    src = ('import threading\n'
+           't = threading.Thread(target=print); '
+           's = "# lint: disable=all"\n')
+    assert rules_of(analysis.run_on_sources({"m.py": src})) \
+        == ["unsupervised-thread"]
+
+
+def test_unknown_rule_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        analysis.run_on_sources({"a.py": "x = 1\n"}, rules=["no-such-rule"])
+
+
+def test_baseline_round_trip_and_line_shift(tmp_path):
+    fs = analysis.run_on_sources({"a.py": THREAD_SRC})
+    bl = tmp_path / "bl.json"
+    analysis.save_baseline(fs, str(bl))
+    loaded = analysis.load_baseline(str(bl))
+    assert analysis.new_findings(fs, loaded) == []
+    # shifting the finding to another line must not resurface it
+    shifted = analysis.run_on_sources({"a.py": "\n\n# pad\n" + THREAD_SRC})
+    assert analysis.new_findings(shifted, loaded) == []
+    # a SECOND identical violation exceeds the baselined count -> new
+    doubled = analysis.run_on_sources(
+        {"a.py": THREAD_SRC + "u = threading.Thread(target=print)\n"})
+    assert len(analysis.new_findings(doubled, loaded)) == 1
+
+
+def test_baseline_file_is_sorted_and_versioned(tmp_path):
+    fs = analysis.run_on_sources(
+        {"b.py": THREAD_SRC, "a.py": THREAD_SRC})
+    bl = tmp_path / "bl.json"
+    analysis.save_baseline(fs, str(bl))
+    doc = json.loads(bl.read_text())
+    assert doc["version"] == 1
+    paths = [e["path"] for e in doc["findings"]]
+    assert paths == sorted(paths)
+    assert all("line" not in e for e in doc["findings"])
+
+
+# --------------------------------------------------------------- CLI
+
+_RULE_FIXTURES = {
+    "unsupervised-thread": ("mod.py", THREAD_SRC),
+    "emit-under-lock": ("mod.py", LOCKED_EMIT),
+    "host-sync-in-device-path": ("runtime/tpu_sketch.py", DEVICE_SYNC),
+    "trace-unsafe-jit": ("mod.py", ("import time, jax\n"
+                                    "f = jax.jit(lambda x: time.time())\n")),
+    "countable-missing-counters": ("mod.py", (
+        "class P:\n"
+        "    def __init__(self, stats):\n"
+        "        stats.register('p', self.counters)\n")),
+    "fault-site-drift": ("runtime/faults.py", 'FAULT_O = "ghost.site"\n'),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_RULE_FIXTURES))
+def test_cli_exits_nonzero_on_synthetic_violation(rule, tmp_path, capsys):
+    relpath, src = _RULE_FIXTURES[rule]
+    f = tmp_path / rule / relpath
+    f.parent.mkdir(parents=True)
+    f.write_text(src)
+    assert cli_main(["lint", str(tmp_path / rule)]) == 1
+    out = capsys.readouterr().out
+    assert rule in out
+
+
+def test_cli_baseline_gates_and_updates(tmp_path, capsys):
+    f = tmp_path / "mod.py"
+    f.write_text(THREAD_SRC)
+    bl = tmp_path / "bl.json"
+    assert cli_main(["lint", str(f), "--baseline", str(bl),
+                     "--update-baseline"]) == 0
+    # same tree + baseline: clean exit
+    assert cli_main(["lint", str(f), "--baseline", str(bl)]) == 0
+    # a new violation beyond the baseline: gate trips
+    f.write_text(THREAD_SRC + "u = threading.Thread(target=print)\n")
+    assert cli_main(["lint", str(f), "--baseline", str(bl)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_explicit_path_gate_is_cwd_independent(tmp_path, capsys,
+                                                   monkeypatch):
+    """Explicit package paths key findings like the committed baseline
+    (package-parent-relative) from ANY cwd — an operator gating from
+    /tmp must not see 24 grandfathered findings resurface as new."""
+    monkeypatch.chdir(tmp_path)
+    assert cli_main(["lint", str(REPO_ROOT / "deepflow_tpu"),
+                     "--baseline",
+                     str(REPO_ROOT / ".lint-baseline.json")]) == 0
+    capsys.readouterr()
+
+
+def test_cli_json_output(tmp_path, capsys):
+    f = tmp_path / "mod.py"
+    f.write_text(THREAD_SRC)
+    assert cli_main(["lint", str(f), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc[0]["rule"] == "unsupervised-thread"
+
+
+# ---------------------------------------------------- repo self-scan
+
+@pytest.fixture(scope="module")
+def repo_scan():
+    """One ~250-file scan shared by the self-scan tests (ci.sh already
+    pays for a full scan in its lint gate; no need for two more)."""
+    return analysis.scan_package()
+
+
+def test_repo_self_scan_zero_new_findings(repo_scan):
+    """The shipped tree + committed baseline must gate clean — exactly
+    what ci.sh enforces. If this fails you either introduced a new
+    violation (fix it) or fixed a baselined one (shrink
+    .lint-baseline.json with --update-baseline and commit the diff)."""
+    baseline = analysis.load_baseline(str(REPO_ROOT / ".lint-baseline.json"))
+    new = analysis.new_findings(repo_scan, baseline)
+    assert new == [], "\n" + analysis.format_findings(new)
+
+
+def test_repo_baseline_has_no_stale_entries(repo_scan):
+    """Every baselined finding still exists AT ITS COUNT: entries whose
+    violations were (even partially) fixed must be deleted, or the spare
+    credits would grandfather a later reintroduction of the identical
+    violation (the baseline only ever shrinks — ISSUE 3). Multiset
+    compare: three identical Agent.start spawns are three entries."""
+    baseline = analysis.load_baseline(str(REPO_ROOT / ".lint-baseline.json"))
+    current = Counter(f.key for f in repo_scan)
+    stale = sorted(k for k, n in baseline.items() if n > current[k])
+    assert stale == [], f"over-credited baseline entries (shrink): {stale}"
