@@ -179,6 +179,101 @@ print(f"chaos OK: {sent} records sent, {decoded} decoded, breaker {br['trips']}"
       f"{sk.checkpointer.counters()['restores']}x, {sk.lost_windows} window lost")
 EOF
 
+echo "== durability smoke: kill-and-restart spill replay + retransmit =="
+# ISSUE 4: the conservation invariant end-to-end. Ingester A's l4 decoder
+# is wedged by a seeded stall while a real UniformSender (retransmit ring,
+# seeded disconnects) blasts records: overflow spills to CRC segment
+# files, /metrics shows the spill + dedup counters, and close() runs the
+# drain ladder — deadline, then park the backlog on disk. Ingester B on
+# the same spill_dir replays the segments; every record must be decoded
+# exactly once or attributed to a named loss counter. Zero silent loss.
+python - <<'EOF'
+import tempfile, time, urllib.request
+import numpy as np
+from deepflow_tpu.agent.sender import UniformSender
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.wire.framing import MessageType
+
+spill_dir = tempfile.mkdtemp(prefix="durability_spill_")
+ROWS, FRAMES = 50, 60
+cfg = dict(listen_port=0, prom_port=0, n_decoders=1, queue_size=64,
+           spill_dir=spill_dir, drain_deadline_s=0.6)
+ing_a = Ingester(IngesterConfig(
+    fault_spec=("queue.stall:p=1.0,delay_s=5,match=ingest.l4_flow_log;"
+                "sender.disconnect:count=3,after=5;seed=11"), **cfg),
+    platform=PlatformDataManager())
+ing_a.start()
+r = np.random.default_rng(0)
+cols = {name: r.integers(0, 1 << 8, ROWS).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+sender = UniformSender(MessageType.COLUMNAR_FLOW,
+                       f"127.0.0.1:{ing_a.port}", vtap_id=3,
+                       reconnect_interval=0.01)
+sent = 0
+for _ in range(FRAMES):
+    sent += sender.send_columns(cols, L4_SCHEMA)
+assert sender.flush(5.0) == 0, "retransmit ring failed to drain"
+assert sender.disconnects >= 1 and sender.retransmitted_frames >= 1
+deadline = time.time() + 10
+while time.time() < deadline:
+    if ing_a.spill.counters()["spilled_records"] > 0:
+        break
+    time.sleep(0.1)
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing_a.prom_port}/metrics", timeout=10) as resp:
+    text = resp.read().decode()
+assert not validate_exposition(text)
+for needle in ("deepflow_spill_spilled_records",
+               "deepflow_spill_pending_segments",
+               "deepflow_receiver_rx_duplicate"):
+    assert needle in text, f"{needle} absent from /metrics"
+dup = ing_a.receiver.counters()["rx_duplicate"]
+assert dup >= 1, "retransmit dedup never engaged"
+t0 = time.time()
+ing_a.close()                      # the "kill": wedged decoder, short drain
+took = time.time() - t0
+assert took < 15, f"drain ladder hung: {took:.1f}s"
+assert ing_a.health()["drain"] == "drained"
+a_spill = ing_a.spill.counters()
+a_decoded = sum(d.records for d in ing_a.flow_log.decoders)
+assert a_spill["spilled_records"] > 0, a_spill
+
+ing_b = Ingester(IngesterConfig(**cfg), platform=PlatformDataManager())
+ing_b.start()                      # restart: replay the parked segments
+deadline = time.time() + 20
+while time.time() < deadline:
+    if (ing_b.spill.pending_segments() == 0
+            and all(len(q) == 0 for q in ing_b._own_queues().values())):
+        break
+    time.sleep(0.1)
+time.sleep(0.5)
+b_decoded = sum(d.records for d in ing_b.flow_log.decoders)
+assert ing_b.spill.counters()["replayed"] > 0
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing_b.prom_port}/metrics", timeout=10) as resp:
+    text_b = resp.read().decode()
+assert "deepflow_spill_replayed" in text_b
+q_a = ing_a.flow_log._streams[0][1].counters()
+q_b = ing_b.flow_log._streams[0][1].counters()
+lost_frames = (a_spill["spill_evicted"] + q_a["overwritten"]
+               + q_a["closed_dropped"] + q_b["overwritten"]
+               + q_b["closed_dropped"]
+               + ing_b.spill.counters()["spill_evicted"])
+delivered = a_decoded + b_decoded
+assert delivered + lost_frames * ROWS + \
+    sender.counters()["retransmit_shed"] == sent, (
+        f"silent loss: sent={sent} delivered={delivered} "
+        f"lost_frames={lost_frames} a={a_spill} qa={q_a} qb={q_b}")
+ing_b.close()
+print(f"durability OK: {sent} records, {a_decoded} decoded pre-kill, "
+      f"{a_spill['spilled_records']} frames spilled, {b_decoded} decoded "
+      f"after restart replay, {dup} duplicate(s) suppressed, "
+      f"{lost_frames} frame(s) counted lost")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax

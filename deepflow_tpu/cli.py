@@ -309,7 +309,7 @@ def cmd_ingester(args) -> int:
         print(json.dumps(out, indent=2, sort_keys=True))
     elif args.action in ("counters", "vtap-status", "ping", "stacks",
                          "artifacts", "queues", "supervisor", "breakers",
-                         "lint"):
+                         "spill", "lint"):
         # lint self-scans ~250 files inside the debug loop: seconds, not
         # the protocol's usual milliseconds — give it a matching timeout
         out = debug_request(args.action,
@@ -617,7 +617,8 @@ def build_parser() -> argparse.ArgumentParser:
                                       "vtap-status", "ping", "stacks",
                                       "artifacts", "datasource",
                                       "queues", "queue-tap",
-                                      "supervisor", "breakers", "lint"])
+                                      "supervisor", "breakers", "spill",
+                                      "lint"])
     i.add_argument("addrs", nargs="*")
     i.add_argument("--module")
     i.add_argument("--op", default="list",

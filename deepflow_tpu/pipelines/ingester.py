@@ -95,6 +95,18 @@ class IngesterConfig:
     # e.g. "exporter.raise:p=1,for_s=5;seed=7"); also read from the
     # DEEPFLOW_FAULTS env var — config wins when both are set
     fault_spec: Optional[str] = None
+    # -- durability (runtime/spill.py, ISSUE 4) -----------------------
+    # disk-spill for the ingest queues: overflow past the watermark is
+    # serialized to CRC-framed segment files and replayed when headroom
+    # (or the next process) returns. None disables — overload falls
+    # back to overwrite-oldest. Segments found at start() are replayed.
+    spill_dir: Optional[str] = None
+    spill_segment_bytes: int = 1 << 20    # roll (fsync) cadence
+    spill_budget_bytes: int = 64 << 20    # oldest-segment eviction past this
+    spill_watermark: float = 0.75         # ring fraction that starts spilling
+    # drain ladder (close()): how long to wait for queues + exporters
+    # to flush before spilling the remainder to disk
+    drain_deadline_s: float = 5.0
 
 
 class Ingester:
@@ -198,6 +210,18 @@ class Ingester:
             stats=self.stats)
         self._pipelines = (self.flow_log, self.flow_metrics, self.ext_metrics,
                            self.event, self.profile, self.droplet)
+        # durability: arm disk-spill on every ingest queue; segments a
+        # previous process left behind replay once start() runs
+        self.spill = None
+        self._drain_state = "running"
+        if cfg.spill_dir is not None:
+            from deepflow_tpu.runtime.spill import SpillGroup
+            self.spill = SpillGroup(
+                self._own_queues(), cfg.spill_dir,
+                segment_bytes=cfg.spill_segment_bytes,
+                budget_bytes=cfg.spill_budget_bytes,
+                watermark=cfg.spill_watermark)
+            self.stats.register("spill", self.spill.counters)
         self.prom = None
         if cfg.prom_port is not None:
             from deepflow_tpu.runtime.promexpo import PrometheusExporter
@@ -222,6 +246,7 @@ class Ingester:
             # supervision tree is process-scoped, like the tracer)
             self.debug.register("breakers",
                                 lambda req: self.exporters.breakers())
+            self.debug.register("spill", self._spill_cmd)
 
     def health(self) -> dict:
         """Liveness verdict for the /healthz endpoint: not-ok when any
@@ -230,20 +255,39 @@ class Ingester:
         on the host fallback. The supervision tree is process-scoped
         (like the flight recorder), so in the rare several-ingesters-
         per-process deployment the stale/crash numbers aggregate across
-        all of them — breakers and the degraded flag stay per-instance."""
+        all of them — breakers and the degraded flag stay per-instance.
+        `drain` is the shutdown-ladder verdict: "running" in steady
+        state, "draining" while close() flushes under its deadline,
+        "drained" once everything landed (store/segments) — a probe
+        sees the ladder instead of a silently-vanishing endpoint."""
         sup = self.supervisor.counters()
         open_breakers = [n for n, c in self.exporters.breakers().items()
                          if c["state"] == "open"]
         degraded = bool(self.tpu_sketch is not None
                         and self.tpu_sketch.degraded)
+        draining = self._drain_state != "running"
         return {
-            "ok": not (sup["stale"] or open_breakers or degraded),
+            "ok": not (sup["stale"] or open_breakers or degraded
+                       or draining),
+            "drain": self._drain_state,
             "stale_threads": sup["stale"],
             "crashes": sup["crashes"],
             "restarts": sup["restarts"],
             "open_breakers": open_breakers,
             "degraded_tpu_sketch": degraded,
         }
+
+    def _spill_cmd(self, req: dict) -> dict:
+        """Per-queue disk-spill accounting (the `spill` debug command):
+        segments/bytes pending plus the spilled/replayed/evicted flow."""
+        if self.spill is None:
+            return {"enabled": False}
+        want = req.get("module") or ""
+        return {"enabled": True, "drain": self._drain_state,
+                "queues": {name: c
+                           for name, c in sorted(
+                               self.spill.per_queue().items())
+                           if want in name}}
 
     def _own_queues(self) -> dict:
         """THIS ingester's inter-stage MultiQueues by name. Scoped to
@@ -385,6 +429,11 @@ class Ingester:
                                          name="throttle-janitor",
                                          daemon=True)
         self._janitor.start()
+        if self.spill is not None:
+            # replay-before-receive: drain threads start re-injecting
+            # any segments a previous process left behind while the
+            # listener below is still coming up
+            self.spill.start()
         self.receiver.start()  # last, like the reference (ingester.go:220)
 
     def flush(self) -> None:
@@ -397,24 +446,76 @@ class Ingester:
             self.app_red.flush()
         self.tag_dicts.flush()
 
+    def _drain_wait(self, deadline: float) -> bool:
+        """Wait (bounded) for ingest queues, then exporter queues, to
+        empty — decoders and exporter workers are still running at this
+        point, so 'wait' means 'let them finish'. True = fully drained."""
+        import time as _time
+
+        queues = list(self._own_queues().values())
+
+        def drained() -> bool:
+            return (all(len(q) == 0 for q in queues)
+                    and self.exporters.pending() == 0
+                    and (self.spill is None
+                         or self.spill.pending_segments() == 0))
+
+        while _time.monotonic() < deadline:
+            if drained():
+                return True
+            _time.sleep(0.05)
+        return drained()
+
     def close(self) -> None:
+        """The drain ladder (ISSUE 4): stop accepting -> let decoders/
+        exporters flush under `drain_deadline_s` -> final sketch
+        checkpoint -> spill whatever never drained to segment files for
+        the next start -> tear down. /healthz reports the rung via the
+        `drain` verdict for as long as the listener is up."""
+        import time as _time
+
+        self._drain_state = "draining"
         janitor_stop = getattr(self, "_janitor_stop", None)
         if janitor_stop is not None:
             janitor_stop.set()
             self._janitor.join(timeout=2)
+        # rung 1: stop accepting — close the listener, let established
+        # connections dispatch their in-flight kernel-buffered bytes
+        # (bounded), THEN stop the readers
+        started = getattr(self, "_janitor", None) is not None
+        if started:
+            self.receiver.quiesce(
+                deadline_s=max(0.5, self.cfg.drain_deadline_s / 4))
         self.receiver.close()
+        # rung 2: bounded flush — pipelines and exporters still live
+        drained = True
+        if started:
+            drained = self._drain_wait(
+                _time.monotonic() + self.cfg.drain_deadline_s)
+            self.flush()               # throttle buckets + writers to disk
+        # rung 3: final sketch checkpoint (the flush in exporter close
+        # can still fail; the snapshot bounds that loss to zero windows)
+        if self.tpu_sketch is not None:
+            self.tpu_sketch.checkpoint_now()
+        # rung 4: park the undrained remainder on disk, counted, for
+        # the next start's replay (spill_remaining drains the rings)
+        if self.spill is not None:
+            self.spill.close(spill_remaining=not drained)
         for p in self._pipelines:
             p.close()
         if self.monitor is not None:
             self.monitor.close()
+        self.exporters.close()
+        self._drain_state = "drained"
         if self.debug is not None:
             self.debug.close()
         if self.prom is not None:
             self.prom.close()
-        self.exporters.close()
         self.tag_dicts.close()
         self.stats.deregister("tracer")
         self.stats.deregister("supervisor")
+        if self.spill is not None:
+            self.stats.deregister("spill")
         for site in self._armed_sites:
             self.faults.disarm(site)
         if self._armed_sites:

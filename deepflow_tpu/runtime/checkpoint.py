@@ -59,9 +59,16 @@ class SketchCheckpointer:
     def _snapshots(self) -> list:
         if not os.path.isdir(self.directory):
             return []
-        return sorted(
-            f for f in os.listdir(self.directory)
-            if f.startswith(self.name + "-") and f.endswith(".npz"))
+        out = []
+        for f in sorted(os.listdir(self.directory)):
+            if not (f.startswith(self.name + "-") and f.endswith(".npz")):
+                continue
+            # skip foreign/malformed names: a stray `sketch-old.npz`
+            # in the directory must not crash latest_step()'s int()
+            if not f[len(self.name) + 1:-4].isdigit():
+                continue
+            out.append(f)
+        return out
 
     def _gc(self) -> None:
         snaps = self._snapshots()

@@ -140,6 +140,17 @@ class Exporters:
                 if breaker is not None:
                     breaker.record_success(time.perf_counter() - t0)
 
+    def pending(self) -> int:
+        """Chunks parked in exporter queues (QueueWorkerExporter-shaped
+        exporters expose `.queue`) — the drain ladder waits on this
+        before closing so buffered exports flush instead of vanishing."""
+        total = 0
+        for e in self._exporters:
+            q = getattr(e, "queue", None)
+            if q is not None:
+                total += len(q)
+        return total
+
     def breakers(self) -> Dict[str, dict]:
         """Per-exporter breaker states (the `breakers` debug command)."""
         return {b.name: b.counters()

@@ -18,6 +18,9 @@ Sites wired in this tree (grep for `FAULT_` constants at the call site):
 - ``tpu.device_error``    — raise an XlaRuntimeError-shaped error in the
   tpu_sketch device path (device loss / preemption)
 - ``checkpoint.torn``     — tear a checkpoint file mid-write
+- ``spill.write``         — fail a spill segment write (disk full / EIO)
+- ``sender.disconnect``   — drop the agent sender's TCP connection at a
+  frame boundary (ingester restart / network partition)
 
 Cost discipline: the registry is OFF by default and every call site
 guards on the module-level ``default_faults().enabled`` flag (one
@@ -48,7 +51,8 @@ from typing import Dict, List, Optional
 __all__ = ["FaultSite", "FaultRegistry", "default_faults",
            "FAULT_RECEIVER_TRUNCATE", "FAULT_QUEUE_STALL",
            "FAULT_EXPORTER_RAISE", "FAULT_EXPORTER_PROCESS",
-           "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN"]
+           "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN",
+           "FAULT_SPILL_WRITE", "FAULT_SENDER_DISCONNECT"]
 
 FAULT_RECEIVER_TRUNCATE = "receiver.truncate"
 FAULT_QUEUE_STALL = "queue.stall"
@@ -56,6 +60,8 @@ FAULT_EXPORTER_RAISE = "exporter.raise"
 FAULT_EXPORTER_PROCESS = "exporter.process"
 FAULT_DEVICE_ERROR = "tpu.device_error"
 FAULT_CHECKPOINT_TORN = "checkpoint.torn"
+FAULT_SPILL_WRITE = "spill.write"
+FAULT_SENDER_DISCONNECT = "sender.disconnect"
 
 
 class InjectedFault(RuntimeError):

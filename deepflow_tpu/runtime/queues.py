@@ -40,6 +40,14 @@ class OverwriteQueue:
         self.in_count = 0
         self.out_count = 0
         self.overwritten = 0
+        self.closed_dropped = 0   # puts after close(): counted, not raised
+        self.spilled = 0          # items diverted to the armed spill sink
+        # durability (runtime/spill.py): when armed, puts that would push
+        # the ring past `_spill_mark` divert the overflow to `_spill_sink`
+        # (called AFTER the condvar is released — swap-under-lock) instead
+        # of overwriting the oldest entries
+        self._spill_sink = None
+        self._spill_mark = 0
         # debug tap: when armed, the next N puts record item summaries
         self._tap_left = 0
         self._tap_out: List[str] = []
@@ -57,30 +65,86 @@ class OverwriteQueue:
         self.puts((item,))
 
     def puts(self, items: Sequence[Any]) -> None:
-        """Append a batch; overwrite the oldest entries if full."""
+        """Append a batch; overwrite the oldest entries if full.
+
+        A closed queue counts the batch as `closed_dropped` instead of
+        raising: during the shutdown drain ladder, producers race the
+        close and a raise here would turn each of them into a
+        supervisor crash-loop. With a spill sink armed, items past the
+        high-watermark divert to the sink (disk) instead of forcing
+        overwrites."""
         tracer = self._tracer
         tracing = tracer is not None and tracer.enabled
         if tracing:
             now = time.perf_counter()
+        overflow: Optional[Sequence[Any]] = None
         with self._ready:
             if self._closed:
-                raise RuntimeError(f"queue {self.name} is closed")
-            for item in items:
-                tail = (self._head + self._size) % self.capacity
-                if self._size == self.capacity:
-                    # overwrite oldest: advance head, count the loss
-                    self._head = (self._head + 1) % self.capacity
-                    self.overwritten += 1
-                else:
-                    self._size += 1
-                self._buf[tail] = item
-                if tracing:
-                    self._put_ts[tail] = now
-                if self._tap_left > 0:
-                    self._tap_left -= 1
-                    self._tap_out.append(repr(item)[:240])
-            self.in_count += len(items)
+                self.closed_dropped += len(items)
+                return
+            sink = self._spill_sink
+            if sink is not None and \
+                    self._size + len(items) > self._spill_mark:
+                headroom = max(0, self._spill_mark - self._size)
+                overflow = items[headroom:]
+                items = items[:headroom]
+                self.spilled += len(overflow)
+            self._append_locked(items, tracing,
+                                now if tracing else 0.0)
+            if items:
+                self._ready.notify_all()
+        if overflow:
+            # emitted after the condvar is released: the sink does disk
+            # I/O and takes its own locks (deepflow-lint emit-under-lock)
+            sink(overflow)
+
+    def reinject(self, items: Sequence[Any]) -> None:
+        """Re-insert spilled items WITHOUT consulting the spill sink —
+        the drain thread's path back into the ring (a sink-aware put
+        here would loop spill->drain->spill forever). Overflow falls
+        back to overwrite-oldest accounting; the drain thread checks
+        headroom first so that stays theoretical."""
+        tracer = self._tracer
+        tracing = tracer is not None and tracer.enabled
+        now = time.perf_counter() if tracing else 0.0
+        with self._ready:
+            if self._closed:
+                self.closed_dropped += len(items)
+                return
+            self._append_locked(items, tracing, now)
             self._ready.notify_all()
+
+    def _append_locked(self, items: Sequence[Any], tracing: bool,
+                       now: float) -> None:
+        """The shared ring-append body (puts + reinject): overwrite-
+        oldest accounting, dwell stamps, tap sampling, in_count."""
+        for item in items:
+            tail = (self._head + self._size) % self.capacity
+            if self._size == self.capacity:
+                # overwrite oldest: advance head, count the loss
+                self._head = (self._head + 1) % self.capacity
+                self.overwritten += 1
+            else:
+                self._size += 1
+            self._buf[tail] = item
+            if tracing:
+                self._put_ts[tail] = now
+            if self._tap_left > 0:
+                self._tap_left -= 1
+                self._tap_out.append(repr(item)[:240])
+        self.in_count += len(items)
+
+    def spill_arm(self, sink: Callable[[Sequence[Any]], None],
+                  watermark: int) -> None:
+        """Divert puts past `watermark` items to `sink` (runtime/spill.py
+        hands a SpillQueue segment writer). Disarm with spill_disarm."""
+        with self._lock:
+            self._spill_sink = sink
+            self._spill_mark = max(1, min(int(watermark), self.capacity))
+
+    def spill_disarm(self) -> None:
+        with self._lock:
+            self._spill_sink = None
 
     def gets(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
         """Take up to max_items; block until >=1 available, timeout, or close.
@@ -118,10 +182,24 @@ class OverwriteQueue:
         return out
 
     def close(self) -> None:
-        """Wake all readers; subsequent puts raise, gets drain then return []."""
+        """Wake all readers; subsequent puts are counted drops
+        (`closed_dropped`), gets drain then return []."""
         with self._ready:
             self._closed = True
             self._ready.notify_all()
+
+    def drain_remaining(self) -> List[Any]:
+        """Take everything parked in the ring in one swap (shutdown
+        spill path: the drain ladder hands the result to disk)."""
+        with self._ready:
+            out = []
+            for _ in range(self._size):
+                out.append(self._buf[self._head])
+                self._buf[self._head] = None
+                self._head = (self._head + 1) % self.capacity
+            self._size = 0
+            self.out_count += len(out)
+            return out
 
     @property
     def closed(self) -> bool:
@@ -155,6 +233,8 @@ class OverwriteQueue:
                 "in": self.in_count,
                 "out": self.out_count,
                 "overwritten": self.overwritten,
+                "closed_dropped": self.closed_dropped,
+                "spilled": self.spilled,
                 "pending": self._size,
             }
 
@@ -213,8 +293,8 @@ class MultiQueue:
         return out
 
     def counters(self) -> dict:
-        agg = {"in": 0, "out": 0, "overwritten": 0, "pending": 0}
+        agg: dict = {}
         for q in self.queues:
             for k, v in q.counters().items():
-                agg[k] += v
+                agg[k] = agg.get(k, 0) + v
         return agg

@@ -24,6 +24,7 @@ from deepflow_tpu.runtime.stats import StatsRegistry
 from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.runtime.tracing import default_tracer
 from deepflow_tpu.wire.framing import (
+    FLOW_HEADER_RETRANSMIT,
     MESSAGE_HEADER_LEN,
     MESSAGE_FRAME_SIZE_MAX,
     Frame,
@@ -34,10 +35,19 @@ from deepflow_tpu.wire.framing import (
 DEFAULT_PORT = 30033  # reference default ingester data port
 
 
+# dedup belt on top of the retransmit flag: a flagged frame further
+# than this below last_seq cannot be one of OUR ring's replays (the
+# sender ring holds <= 256 frames) — it is another sender sharing this
+# (vtap, type) status. Suppressing it would be silent loss; delivering
+# it merely miscounts gaps, which senders sharing a vtap id already do.
+SEQ_DEDUP_WINDOW = 4096
+
+
 @dataclass
 class VtapStatus:
-    """Per-(vtap, message type) liveness + sequence-gap accounting
-    (reference: receiver.go:215-296)."""
+    """Per-(vtap, message type) liveness + sequence-gap + duplicate
+    accounting (reference: receiver.go:215-296; dedup is ours — the
+    sender's at-least-once retransmit ring needs it)."""
 
     vtap_id: int
     msg_type: int
@@ -46,14 +56,39 @@ class VtapStatus:
     rx_frames: int = 0
     rx_dropped: int = 0   # frames lost upstream, inferred from seq gaps
     rx_invalid: int = 0
+    rx_duplicate: int = 0  # sender-ring retransmits, suppressed
 
-    def observe(self, seq: int, now: float) -> None:
-        if self.rx_frames > 0 and seq > self.last_seq + 1:
-            self.rx_dropped += seq - self.last_seq - 1
-        # seq <= last_seq: agent restarted; reset without counting drops
-        self.last_seq = seq
+    def observe(self, seq: int, now: float,
+                retransmit: bool = False) -> bool:
+        """Track one frame's sequence; False = duplicate (suppress
+        before dispatch so at-least-once never double-counts sketches).
+
+        `retransmit` is the frame's FLOW_HEADER_RETRANSMIT bit: the
+        sender's ring replay marks frames whose earlier delivery a dead
+        connection left unknown. A FLAGGED frame at seq <= last_seq was
+        already dispatched here — duplicate. An UNFLAGGED frame going
+        backwards keeps the PR 2 reading: the agent restarted and reset
+        its counter — reset tracking without booking phantom drops."""
         self.last_ts = now
+        if self.rx_frames > 0 and seq <= self.last_seq:
+            if retransmit:
+                if self.last_seq - seq < SEQ_DEDUP_WINDOW:
+                    self.rx_duplicate += 1
+                    return False
+                # flagged but outside the window: a DIFFERENT sender
+                # sharing this vtap id replaying its ring. Deliver
+                # (suppressing a frame we never dispatched is silent
+                # loss) WITHOUT regressing last_seq — resetting it to
+                # the foreign sequence would book the other sender's
+                # next in-order frame as a ~window-sized phantom gap
+                self.rx_frames += 1
+                return True
+            # unflagged: agent restarted — reset without counting drops
+        elif self.rx_frames > 0 and seq > self.last_seq + 1:
+            self.rx_dropped += seq - self.last_seq - 1
+        self.last_seq = seq
         self.rx_frames += 1
+        return True
 
 
 class Receiver:
@@ -67,6 +102,9 @@ class Receiver:
         self._status: Dict[Tuple[int, int], VtapStatus] = {}
         self._status_lock = threading.Lock()
         self._threads: list = []   # supervisor ThreadHandles
+        # guards _threads: the accept loop prunes/appends per connection
+        # while close() drains the list from another thread
+        self._threads_lock = threading.Lock()
         self._tcp_sock: Optional[socket.socket] = None
         self._udp_sock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -111,17 +149,46 @@ class Receiver:
         sup = default_supervisor()
         for target, name in ((self._accept_loop, "recv-tcp-accept"),
                              (self._udp_loop, "recv-udp")):
-            self._threads.append(sup.spawn(name, target))
+            t = sup.spawn(name, target)
+            with self._threads_lock:
+                self._threads.append(t)
+
+    def quiesce(self, idle_s: float = 0.2, deadline_s: float = 2.0) -> bool:
+        """Drain-ladder rung 1: stop NEW connections (close the TCP
+        listener; established readers and the UDP loop stay live) and
+        wait — bounded — until the firehose has been idle for `idle_s`.
+        Bytes an agent already wrote sit in kernel buffers; close()ing
+        the readers immediately would guillotine them into silent loss.
+        Returns True when idle was reached (False: still receiving at
+        the deadline — a live sender can't be drained forever)."""
+        if self._tcp_sock is not None:
+            try:
+                # accept() raises OSError -> the accept loop returns;
+                # per-connection sockets are separate and keep reading
+                self._tcp_sock.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + deadline_s
+        last, last_t = self.rx_frames, time.monotonic()
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            if self.rx_frames != last:
+                last, last_t = self.rx_frames, time.monotonic()
+            elif time.monotonic() - last_t >= idle_s:
+                return True
+        return False
 
     def close(self) -> None:
         self._stop.set()
-        for t in self._threads:
+        with self._threads_lock:
+            threads = list(self._threads)
+            self._threads.clear()
+        for t in threads:
             t.stop()
             t.join(timeout=2)
         for s in (self._tcp_sock, self._udp_sock):
             if s is not None:
                 s.close()
-        self._threads.clear()
 
     @property
     def bound_port(self) -> int:
@@ -144,9 +211,11 @@ class Receiver:
                           lambda c=conn, a=addr: self._tcp_conn_loop(c, a),
                           restart=False)
             # Prune threads of closed connections so a churning agent fleet
-            # doesn't grow the list unboundedly.
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            # doesn't grow the list unboundedly; under the lock so a racing
+            # close() never iterates a half-rebuilt list.
+            with self._threads_lock:
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
 
     def _tcp_conn_loop(self, conn: socket.socket, addr) -> None:
         reader = FrameReader()
@@ -207,7 +276,11 @@ class Receiver:
         vtap = 0
         if frame.flow_header is not None:
             vtap = frame.flow_header.vtap_id
-            self._track(frame, vtap)
+            if not self._track(frame, vtap):
+                # sender-ring retransmit of a frame already dispatched:
+                # suppressed here so at-least-once delivery never
+                # double-counts sketches (counted rx_duplicate)
+                return
         handler = self._handlers.get(frame.msg_type)
         if handler is None:
             self.no_handler += 1
@@ -221,7 +294,7 @@ class Receiver:
                            stream=frame.msg_type.name,
                            batch_id=frame.trace_batch_id)
 
-    def _track(self, frame: Frame, vtap: int) -> None:
+    def _track(self, frame: Frame, vtap: int) -> bool:
         key = (vtap, int(frame.msg_type))
         with self._status_lock:
             st = self._status.get(key)
@@ -229,7 +302,10 @@ class Receiver:
                 st = self._status[key] = VtapStatus(vtap, int(frame.msg_type))
             # not an emission: VtapStatus.observe is plain in-memory
             # sequence arithmetic on state guarded BY this lock
-            st.observe(frame.flow_header.sequence, time.time())  # lint: disable=emit-under-lock
+            return st.observe(  # lint: disable=emit-under-lock
+                frame.flow_header.sequence, time.time(),
+                retransmit=bool(frame.flow_header.version
+                                & FLOW_HEADER_RETRANSMIT))
 
     # -- introspection -----------------------------------------------------
     def status(self) -> Dict[Tuple[int, int], VtapStatus]:
@@ -237,12 +313,16 @@ class Receiver:
             return dict(self._status)
 
     def counters(self) -> dict:
-        dropped = sum(s.rx_dropped for s in self._status.values())
+        # snapshot under the lock (like status()): a scrape racing a
+        # new-vtap insert must not see the dict resize mid-iteration
+        with self._status_lock:
+            statuses = list(self._status.values())
         return {
             "rx_frames": self.rx_frames,
             "rx_bytes": self.rx_bytes,
             "rx_errors": self.rx_errors,
             "no_handler": self.no_handler,
-            "seq_dropped": dropped,
-            "vtaps": len(self._status),
+            "seq_dropped": sum(s.rx_dropped for s in statuses),
+            "rx_duplicate": sum(s.rx_duplicate for s in statuses),
+            "vtaps": len(statuses),
         }

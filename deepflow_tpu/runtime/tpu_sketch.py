@@ -570,6 +570,18 @@ class TpuSketchExporter(QueueWorkerExporter):
         while len(self._key_tuples) > self._KEY_TUPLES_CAP:
             self._key_tuples.pop(next(iter(self._key_tuples)))
 
+    def checkpoint_now(self) -> bool:
+        """Drain-ladder hook (Ingester.close): persist the CURRENT
+        accumulation unconditionally, cadence ignored — if the final
+        window flush below dies mid-shutdown, the next start restores
+        this snapshot instead of losing the accumulation. No-op while
+        degraded (the host-fallback state is not a device pytree)."""
+        with self._state_lock:
+            if self.checkpointer is None or self.degraded:
+                return False
+            self.checkpointer.save(self.state, self.windows)
+            return True
+
     # -- windows -----------------------------------------------------------
     def flush_window(self, now: Optional[float] = None) -> Optional[
             flow_suite.FlowWindowOutput]:

@@ -22,6 +22,29 @@ MESSAGE_FRAME_SIZE_MAX = 512_000           # droplet-message.go:127
 MESSAGE_HEADER_LEN = 5
 FLOW_HEADER_LEN = 14
 
+# Bit 30 of FlowHeader.version marks a sender-ring RETRANSMIT (the
+# uniform sender's reconnect replay, ISSUE 4): delivery of frames sent
+# just before a connection died is unknowable without acks, so the ring
+# re-sends them flagged and the receiver dedups flagged frames whose
+# sequence it has already dispatched. Reference agents never set the
+# bit (their version constant keeps it clear), so unflagged streams
+# keep the plain restart-reset sequence semantics.
+FLOW_HEADER_RETRANSMIT = 1 << 30
+
+_VERSION_U32 = struct.Struct("<I")
+
+
+def set_retransmit(frame: bytes) -> bytes:
+    """Set FLOW_HEADER_RETRANSMIT in an already-encoded frame's
+    FlowHeader version word. Lives HERE, beside `_FLOW`, because it
+    patches that struct's byte layout (u32 LE at the head of the flow
+    header) — idempotent, so a frame surviving several reconnects is
+    patched once."""
+    v, = _VERSION_U32.unpack_from(frame, MESSAGE_HEADER_LEN)
+    return (frame[:MESSAGE_HEADER_LEN]
+            + _VERSION_U32.pack(v | FLOW_HEADER_RETRANSMIT)
+            + frame[MESSAGE_HEADER_LEN + _VERSION_U32.size:])
+
 _BASE = struct.Struct(">IB")               # frame_size BE, type
 _FLOW = struct.Struct("<IQH")              # version, sequence, vtap_id LE
 

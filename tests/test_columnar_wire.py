@@ -119,7 +119,8 @@ def test_sender_chunks_large_batches():
 
     sender = UniformSender(MessageType.COLUMNAR_FLOW, "127.0.0.1:1")
     sent_payloads = []
-    sender.send_raw = lambda p: (sent_payloads.append(p), True)[1]
+    sender.send_raw = \
+        lambda p, records=1: (sent_payloads.append(p), True)[1]
     n = 20000
     cols = _sample_cols(n)
     assert sender.send_columns(cols, L4_SCHEMA) == n

@@ -1,0 +1,459 @@
+"""End-to-end durability tests (ISSUE 4): disk-spill queues with restart
+replay, the sender retransmit ring + receiver dedup, the shutdown drain
+ladder, and the conservation invariant under deterministic chaos —
+every record is delivered exactly once or attributed to a NAMED loss
+counter (`overwritten`, `spill_evicted`, `retransmit_shed`,
+`closed_dropped`); zero silent loss.
+
+Discipline matches test_robustness.py: the fault switchboard is
+process-global (disarmed around every test), fault schedules are
+seeded, and loss is asserted through the same Countables /metrics
+scrapes.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.sender import UniformSender
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.faults import (FAULT_QUEUE_STALL,
+                                         FAULT_SENDER_DISCONNECT,
+                                         FAULT_SPILL_WRITE, default_faults)
+from deepflow_tpu.runtime.queues import MultiQueue, OverwriteQueue
+from deepflow_tpu.runtime.receiver import Receiver, VtapStatus
+from deepflow_tpu.runtime.spill import (SegmentStore, SpillQueue,
+                                        SpillWriteError, decode_frame_blob,
+                                        encode_frame_blob, read_segment)
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import (Frame, FlowHeader, MessageType,
+                                       encode_frame)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The fault switchboard is process-global: never leak armed sites."""
+    default_faults().disarm()
+    yield
+    default_faults().disarm()
+
+
+def _wait(predicate, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _frame(seq=1, vtap=3, rows=50, seed=0):
+    r = np.random.default_rng(seed)
+    cols = {name: r.integers(0, 1 << 8, rows).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    return encode_frame(MessageType.COLUMNAR_FLOW,
+                        columnar_wire.encode_columnar(cols),
+                        FlowHeader(sequence=seq, vtap_id=vtap))
+
+
+# ------------------------------------------------------------ segments
+
+def test_segment_store_round_trip(tmp_path):
+    store = SegmentStore(str(tmp_path), segment_bytes=4096)
+    blobs = [bytes([i]) * (100 + i) for i in range(200)]
+    written, evicted = store.append(blobs)
+    assert written == 200 and evicted == 0
+    store.close()
+    got = []
+    while True:
+        item = store.take_oldest()
+        if item is None:
+            break
+        path, records, torn = item
+        assert not torn
+        got.extend(records)
+        store.delete(path)
+    assert got == blobs
+    assert store.pending() == (0, 0)
+
+
+def test_segment_torn_tail_detected(tmp_path):
+    """The SIGKILL shape: a segment truncated mid-record must yield
+    every intact record and report the tear — never mis-decode."""
+    store = SegmentStore(str(tmp_path), segment_bytes=1 << 20)
+    blobs = [os.urandom(256) for _ in range(20)]
+    store.append(blobs)
+    store.close()
+    seg = [n for n in os.listdir(tmp_path) if n.endswith(".seg")][0]
+    path = os.path.join(tmp_path, seg)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 100)            # tear the last record
+    records, torn = read_segment(path)
+    assert torn
+    assert records == blobs[:len(records)]
+    assert len(records) >= 18             # only the tail is lost
+
+
+def test_segment_budget_evicts_oldest_counted(tmp_path):
+    store = SegmentStore(str(tmp_path), segment_bytes=2048,
+                         budget_bytes=2048 * 3)
+    total_evicted = 0
+    for i in range(40):
+        _, evicted = store.append([os.urandom(512)])
+        total_evicted += evicted
+    assert total_evicted > 0              # loss happened and was counted
+    segs, nbytes = store.pending()
+    assert nbytes <= 2048 * 3 + 2048      # budget holds (+1 open segment)
+
+
+def test_spill_write_failure_books_only_durable_prefix(tmp_path):
+    """Writes are buffered: Python-level write() success is not
+    durability. A mid-batch failure must report exactly the records
+    the CRC rescan proves are on disk — optimism here books records as
+    replayable that replay can never recover (uncounted loss)."""
+    store = SegmentStore(str(tmp_path), segment_bytes=1 << 20)
+    store.append([b"a" * 100])                 # 1 intact record on disk
+
+    class Exploding:
+        def __init__(self, f):
+            self.f, self.calls = f, 0
+
+        def write(self, b):
+            self.calls += 1
+            if self.calls >= 3:                # record c's header: boom
+                raise OSError(28, "ENOSPC")
+            return self.f.write(b)
+
+        def __getattr__(self, name):           # tell/flush/close/fileno
+            return getattr(self.f, name)
+
+    store._open_for_append_locked()
+    store._open_f = Exploding(store._open_f)
+    with pytest.raises(SpillWriteError) as ei:
+        store.append([b"b" * 100, b"c" * 100])
+    assert ei.value.written == 1               # only b survived, verified
+    path, records, torn = store.take_oldest()
+    assert records == [b"a" * 100, b"b" * 100]
+
+
+# ---------------------------------------------------------- spill queue
+
+def test_spill_queue_overflow_spills_then_replays(tmp_path):
+    q = OverwriteQueue("t", 64)
+    sq = SpillQueue(q, str(tmp_path), encode=lambda b: b,
+                    decode=lambda b: b, watermark=0.5)
+    sq.start()
+    try:
+        blobs = [b"%04d" % i for i in range(500)]
+        q.puts(blobs)                     # far past the 32-item watermark
+        assert q.counters()["overwritten"] == 0    # spill, not overwrite
+        assert q.counters()["spilled"] > 0
+        got = []
+        assert _wait(lambda: (got.extend(q.gets(64, timeout=0.05))
+                              or len(got) >= 500))
+        assert sorted(got) == blobs       # replay is complete, late but whole
+        assert sq.counters()["replayed"] > 0
+        assert _wait(lambda: sq.counters()["pending_segments"] == 0)
+    finally:
+        sq.close()
+
+
+def test_spill_restart_replay(tmp_path):
+    """Segments a dead process left behind replay on the next start."""
+    q1 = OverwriteQueue("t", 32)
+    sq1 = SpillQueue(q1, str(tmp_path), encode=lambda b: b,
+                     decode=lambda b: b, watermark=0.5)
+    sq1.start()
+    q1.puts([b"%04d" % i for i in range(300)])
+    # "kill" the process: stop the drain without draining the disk
+    sq1._stop.set()
+    sq1.close()
+    assert SegmentStore(str(tmp_path)).pending()[0] > 0
+    # next process, same directory: replay must reach the ring
+    q2 = OverwriteQueue("t", 256)
+    sq2 = SpillQueue(q2, str(tmp_path), encode=lambda b: b,
+                     decode=lambda b: b)
+    sq2.start()
+    try:
+        got = []
+        assert _wait(lambda: (got.extend(q2.gets(64, timeout=0.05))
+                              or sq2.counters()["pending_segments"] == 0))
+        while True:                        # segments done; empty the ring
+            batch = q2.gets(64, timeout=0.2)
+            if not batch:
+                break
+            got.extend(batch)
+        assert sq2.counters()["replayed"] > 0
+        assert len(got) == sq2.counters()["replayed"]
+    finally:
+        sq2.close()
+
+
+def test_spill_write_fault_is_counted_loss(tmp_path):
+    default_faults().arm(FAULT_SPILL_WRITE, count=2)
+    q = OverwriteQueue("t", 8)
+    sq = SpillQueue(q, str(tmp_path), encode=lambda b: b,
+                    decode=lambda b: b, watermark=0.5)
+    q.spill_arm(sq._sink, 4)
+    q.puts([b"x"] * 10)                   # 6 overflow -> first append fails
+    assert sq.spill_write_errors == 1
+    assert sq.spill_evicted == 6          # the failed batch is counted loss
+    q.puts([b"y"] * 10)                   # second armed failure
+    assert sq.spill_write_errors == 2
+    q.puts([b"z"] * 10)                   # site exhausted: spills fine
+    assert sq.spilled_records == 10
+    sq.close()
+
+
+# ------------------------------------------------- retransmit + dedup
+
+def test_vtap_status_dedup_vs_restart():
+    st = VtapStatus(vtap_id=1, msg_type=4)
+    assert st.observe(1, 1.0) and st.observe(2, 1.0) and st.observe(3, 1.0)
+    # a FLAGGED sender-ring retransmit: already seen, suppress
+    assert st.observe(2, 2.0, retransmit=True) is False
+    assert st.observe(3, 2.0, retransmit=True) is False
+    assert st.rx_duplicate == 2
+    # a flagged frame the receiver never saw: deliver, don't suppress
+    assert st.observe(4, 3.0, retransmit=True) is True
+    # agent restart (UNFLAGGED seq going backwards): reset, no dedup,
+    # no phantom drops — the PR 2 semantics unflagged streams keep
+    assert st.observe(1, 4.0) is True
+    assert st.rx_dropped == 0
+    # a flagged frame far outside any ring window: a DIFFERENT sender
+    # sharing this vtap id replaying its own ring — suppressing a frame
+    # this status never dispatched would be silent loss; deliver it
+    st2 = VtapStatus(vtap_id=0, msg_type=4)
+    st2.observe(5000, 1.0)
+    assert st2.observe(8, 2.0, retransmit=True) is True
+    assert st2.rx_duplicate == 0
+
+
+def test_sender_retransmit_receiver_dedup_over_socket_pair():
+    """Kill the TCP connection mid-stream: buffered + uncertain frames
+    re-send on reconnect, the receiver suppresses the already-delivered
+    ones, and every unique frame reaches the handler exactly once."""
+    recv = Receiver(port=0)
+    mq = MultiQueue("t", 1, 4096)
+    recv.register_handler(MessageType.TAGGEDFLOW, mq)
+    recv.start()
+    sender = UniformSender(MessageType.TAGGEDFLOW,
+                           f"127.0.0.1:{recv.bound_port}", vtap_id=9,
+                           reconnect_interval=0.02)
+    try:
+        for _ in range(10):
+            assert sender.send([b"\x08\x01" * 10]) > 0
+        assert _wait(lambda: mq.counters()["in"] == 10)
+        # connection dies under the sender
+        sender._sock.close()
+        sent_now = sender.send([b"\x08\x01" * 10])   # write fails, rings
+        assert sender.pending_frames() >= 1
+        # reconnect: the WHOLE ring re-sends (delivery of the pre-death
+        # tail is unknowable) and new traffic follows
+        assert _wait(lambda: sender.flush(0.5) == 0)
+        for _ in range(5):
+            sender.send([b"\x08\x01" * 10])
+        assert _wait(lambda: mq.counters()["in"] == 16)
+        time.sleep(0.1)
+        assert mq.counters()["in"] == 16             # no double dispatch
+        assert recv.counters()["rx_duplicate"] >= 1  # retransmits seen
+        assert sender.retransmitted_frames >= 1
+        assert sender.counters()["retransmit_shed"] == 0
+    finally:
+        sender.close()
+        recv.close()
+
+
+def test_sender_disconnect_fault_buffers_and_backs_off():
+    """FAULT_SENDER_DISCONNECT drops the connection at a frame
+    boundary; nothing is lost — frames ring and drain on reconnect."""
+    recv = Receiver(port=0)
+    mq = MultiQueue("t", 1, 4096)
+    recv.register_handler(MessageType.TAGGEDFLOW, mq)
+    recv.start()
+    default_faults().arm(FAULT_SENDER_DISCONNECT, count=3)
+    sender = UniformSender(MessageType.TAGGEDFLOW,
+                           f"127.0.0.1:{recv.bound_port}", vtap_id=9,
+                           reconnect_interval=0.01)
+    try:
+        for _ in range(20):
+            sender.send([b"\x08\x01"])
+        assert sender.disconnects >= 1
+        assert _wait(lambda: sender.flush(0.5) == 0)
+        assert _wait(lambda: mq.counters()["in"] == 20)
+        assert sender.counters()["retransmit_shed"] == 0
+    finally:
+        sender.close()
+        recv.close()
+
+
+def test_sender_ring_overflow_is_counted_shed():
+    """With no ingester at all, the bounded ring sheds oldest-unsent —
+    counted, never silent."""
+    sender = UniformSender(MessageType.TAGGEDFLOW, "127.0.0.1:1",
+                           reconnect_interval=30.0, ring_frames=4)
+    try:
+        for _ in range(10):
+            sender.send([b"\x08\x01"])
+        c = sender.counters()
+        assert c["ring_pending_frames"] == 4
+        assert c["retransmit_shed"] == 6
+        assert c["sent_records"] == 10    # accounting closes: 4 held + 6 shed
+    finally:
+        sender.close()
+
+
+def test_sender_backoff_spaces_reconnect_attempts():
+    sender = UniformSender(MessageType.TAGGEDFLOW, "127.0.0.1:1",
+                           reconnect_interval=5.0)
+    try:
+        t0 = time.time()
+        sender.send([b"\x08\x01"])        # first attempt: fails fast
+        assert time.time() - t0 < 2.0
+        assert sender._next_attempt > time.monotonic()  # backoff armed
+        before = sender._next_attempt
+        sender.send([b"\x08\x01"])        # inside the window: no dial
+        assert sender._next_attempt == before
+    finally:
+        sender.close()
+
+
+# ------------------------------------------------------- drain ladder
+
+def _blast(port, frame, n):
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        for _ in range(n):
+            s.sendall(frame)
+
+
+def test_drain_ladder_deadline_spills_remainder(tmp_path):
+    """A wedged decoder can't block shutdown: close() returns around
+    the deadline and parks the backlog in segment files, counted."""
+    spill_dir = str(tmp_path / "spill")
+    default_faults().arm(FAULT_QUEUE_STALL, p=1.0, delay_s=0.4,
+                         match="ingest.l4_flow_log")
+    ing = Ingester(IngesterConfig(listen_port=0, n_decoders=1,
+                                  queue_size=128, spill_dir=spill_dir,
+                                  drain_deadline_s=0.6),
+                   platform=PlatformDataManager())
+    ing.start()
+    frame = _frame(rows=50)
+    _blast(ing.port, frame, 40)
+    assert _wait(lambda: ing.receiver.counters()["rx_frames"] >= 40)
+    t0 = time.time()
+    ing.close()
+    took = time.time() - t0
+    assert took < 6.0                      # deadline held, no hang
+    assert ing.health()["drain"] == "drained"
+    # whatever didn't decode is on disk for the next start, not lost
+    spilled = ing.spill.counters()
+    decoded = sum(d.records for d in ing.flow_log.decoders)
+    assert decoded + spilled["spilled_records"] >= 40 * 50 \
+        - spilled["spill_evicted"]
+    default_faults().disarm()
+    # --- restart: a new ingester on the same directory replays ---
+    ing2 = Ingester(IngesterConfig(listen_port=0, n_decoders=1,
+                                   queue_size=256, spill_dir=spill_dir),
+                    platform=PlatformDataManager())
+    ing2.start()
+    try:
+        target = spilled["spilled_records"] - spilled["spill_evicted"]
+        assert _wait(lambda: sum(d.records for d in ing2.flow_log.decoders)
+                     >= target)
+        assert ing2.spill.counters()["replayed"] >= target // 50
+    finally:
+        ing2.close()
+
+
+def test_receiver_quiesce_drains_inflight_bytes():
+    """Rung 1 of the ladder: a close() right after a burst must not
+    guillotine frames the kernel accepted but the reader hasn't
+    dispatched yet — quiesce closes the LISTENER, waits for idle."""
+    recv = Receiver(port=0)
+    mq = MultiQueue("t", 1, 4096)
+    recv.register_handler(MessageType.COLUMNAR_FLOW, mq)
+    recv.start()
+    frame = _frame(rows=50)
+    _blast(recv.bound_port, frame, 200)
+    assert recv.quiesce(deadline_s=5.0)
+    recv.close()
+    assert mq.counters()["in"] == 200     # nothing lost in kernel buffers
+
+
+def test_healthz_drain_verdict_running():
+    ing = Ingester(IngesterConfig(listen_port=0),
+                   platform=PlatformDataManager())
+    h = ing.health()
+    assert h["drain"] == "running" and "ok" in h
+    ing.close()
+    assert ing.health()["drain"] == "drained"
+
+
+# ----------------------------------------------- conservation invariant
+
+def test_conservation_under_chaos(tmp_path):
+    """The acceptance bar: with sender disconnects AND spill-write
+    failures firing at a fixed seed, every record offered to the sender
+    is either decoded exactly once or attributed to a named loss
+    counter. Zero silent loss."""
+    spill_dir = str(tmp_path / "spill")
+    ing = Ingester(IngesterConfig(
+        listen_port=0, n_decoders=1, queue_size=64,
+        spill_dir=spill_dir, spill_segment_bytes=1 << 16,
+        # disconnects are count-bounded: an ever-firing p= schedule
+        # would re-mark the ring for retransmit on every reconnect and
+        # (correctly) never converge — a dead network, not a test
+        fault_spec=("sender.disconnect:count=6,after=10;"
+                    "spill.write:p=0.3;"
+                    "queue.stall:p=0.5,delay_s=0.05,for_s=2,"
+                    "match=ingest.l4_flow_log;seed=11")),
+        platform=PlatformDataManager())
+    ing.start()
+    rows = 64
+    r = np.random.default_rng(0)
+    cols = {name: r.integers(0, 1 << 8, rows).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    sender = UniformSender(MessageType.COLUMNAR_FLOW,
+                           f"127.0.0.1:{ing.port}", vtap_id=7,
+                           reconnect_interval=0.01)
+    sent = 0
+    try:
+        for _ in range(120):
+            sent += sender.send_columns(cols, L4_SCHEMA)
+        assert sender.flush(5.0) == 0      # ring fully drained
+        assert sent == sender.counters()["sent_records"]
+        # quiesce: queues empty, segments replayed, decoders caught up
+        def quiet():
+            qs = ing._own_queues().values()
+            return (all(len(q) == 0 for q in qs)
+                    and ing.spill.pending_segments() == 0)
+        assert _wait(quiet, timeout=15.0)
+        time.sleep(0.3)
+        decoded = sum(d.records for d in ing.flow_log.decoders)
+        queues = ing.flow_log._streams[0][1].counters()
+        spill = ing.spill.counters()
+        shed = sender.counters()["retransmit_shed"]
+        # queue/spill counters are in QUEUE ITEMS (frames); every frame
+        # here carries exactly `rows` records, the sender's shed counter
+        # is already in records — scale to one unit before summing
+        loss = (spill["spill_evicted"] + queues["overwritten"]
+                + queues["closed_dropped"]) * rows + shed
+        # chaos actually fired (the seeded schedule guarantees it)
+        assert sender.disconnects >= 1
+        assert spill["spill_write_errors"] + spill["spilled_records"] > 0
+        # seq gaps would be upstream loss the sender didn't cause; the
+        # retransmit ring must have prevented all of them
+        assert ing.receiver.counters()["seq_dropped"] == 0
+        assert decoded + loss == sent, (
+            f"silent loss: sent={sent} decoded={decoded} loss={loss} "
+            f"(spill={spill} queues={queues} shed={shed})")
+    finally:
+        sender.close()
+        ing.close()

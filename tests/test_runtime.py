@@ -53,8 +53,11 @@ def test_overwrite_queue_close_wakes_reader():
     q.close()
     t.join(timeout=2)
     assert got == [[]]
-    with pytest.raises(RuntimeError):
-        q.put(1)
+    # ISSUE 4 satellite: a post-close put is a COUNTED drop, not a
+    # raise — during the shutdown drain ladder racing producers must
+    # not crash-loop their supervisors
+    q.put(1)
+    assert q.counters()["closed_dropped"] == 1
 
 
 def test_multi_queue_hashes_consistently():
