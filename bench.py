@@ -21,6 +21,14 @@ Numbers, one JSON line:
   native decoder (decode/native_src/decoder.cc) into a reused buffer.
 - `kernel_records_per_sec`: device-resident batches only (the round-1
   number, kept for regression tracking).
+- `stage_breakdown.feed_overlap`: the production exporter hot path with
+  the ISSUE 5 overlapped feed on (coalesced single-transfer batches,
+  double-buffered prefetch thread, 2-batch fused scan steps): e2e
+  records/s, the device-busy fraction (feed rate / device-resident
+  kernel rate — the overlap-efficiency number), and transfers/
+  dispatches per batch (<= 1 each on the coalesced path; a regression
+  back to per-plane device_puts reads > 1 here and on the
+  tpu_transfers_per_batch gauge).
 - `topk_recall_vs_exact`: top-100 heavy-hitter recall on the PRODUCTION
   FlowSuiteConfig against an exact host GROUP BY over the stream.
   vs_baseline is against BASELINE.json's 10M records/s.
@@ -745,7 +753,45 @@ def main() -> None:
             hs_rows += len(next(iter(c.values())))
     host_fallback_rate = hs_rows / (time.perf_counter() - t0)
 
+    # -- timed: overlapped device feed (ISSUE 5) ---------------------------
+    # The production exporter hot path with the coalesced feed on:
+    # TensorBatches cross as ONE staged transfer each, a supervised
+    # feed thread packs batch N+1 while batch N runs async on device,
+    # and coalesce_batches fuses pairs into single scan dispatches.
+    # overlap efficiency = feed e2e rate / device-resident kernel rate
+    # (the device-busy fraction: 1.0 means the chip never waits on the
+    # host). Fetch-free: the fences block, they never read device data.
+    _phase("timed: feed overlap e2e")
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+
+    feed_exp = TpuSketchExporter(
+        store=None, window_seconds=3600, batch_rows=1 << 16,
+        wire="lanes", prefetch_depth=2, coalesce_batches=2)
+    feed_exp.process([("l4_flow_log", 0, schema_batches[0])])  # warm/compile
+    feed_exp._feed.drain()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        feed_exp.process([("l4_flow_log", 0,
+                           schema_batches[i % n_batches])])
+    feed_exp._feed.drain()
+    feed_rate = batch * iters / (time.perf_counter() - t0)
+    feed_batches = max(feed_exp.batcher.emitted_batches, 1)
+    feed_stats = {
+        "records_per_sec": round(feed_rate),
+        "device_busy_fraction": round(
+            min(1.0, feed_rate / max(packed_kernel_rate, 1.0)), 4),
+        "transfers_per_batch": round(
+            feed_exp.h2d_transfers / feed_batches, 3),
+        "dispatches_per_batch": round(
+            feed_exp.dispatches / feed_batches, 3),
+        "prefetch_depth": feed_exp.prefetch_depth,
+        "coalesce_batches": feed_exp.coalesce_batches,
+    }
+    feed_exp.close()
+    _recover()
+
     stage_breakdown = {
+        "feed_overlap": feed_stats,
         "packed": {"h2d_mb_s": round(packed_h2d),
                    "kernel_records_per_sec": round(packed_kernel_rate),
                    "bytes_per_record": 16},

@@ -274,6 +274,63 @@ print(f"durability OK: {sent} records, {a_decoded} decoded pre-kill, "
       f"{lost_frames} frame(s) counted lost")
 EOF
 
+echo "== feed smoke: coalesced+prefetch bit-identical, fewer dispatches =="
+# ISSUE 5: the overlapped device feed on the CPU backend. Prefetch
+# on/off must land the exact same sketch state; the coalesced path must
+# provably ship fewer, bigger transfers (one device_put per group
+# instead of one per plane) — asserted through the exporter's transfer/
+# dispatch counters AND the tracer's kernel span counts (one span per
+# fused group vs one per batch). The feed thread rides the supervision
+# tree and the lint gate above already proved no host sync leaked into
+# the async device path.
+python - <<'EOF'
+import numpy as np
+import jax
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.runtime.supervisor import default_supervisor
+from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+from deepflow_tpu.runtime.tracing import default_tracer
+
+tr = default_tracer()
+tr.enable()
+rng = np.random.default_rng(5)
+pool = {name: rng.integers(0, 1 << 12, 512).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+chunks = [{k: v[rng.integers(0, 512, 3000)] for k, v in pool.items()}
+          for _ in range(6)]
+base = TpuSketchExporter(store=None, window_seconds=3600, batch_rows=1024,
+                         wire="lanes", prefetch_depth=0)
+feed = TpuSketchExporter(store=None, window_seconds=3600, batch_rows=1024,
+                         wire="lanes", prefetch_depth=2, coalesce_batches=2)
+for c in chunks:
+    base.process([("l4_flow_log", 0, c)])
+    feed.process([("l4_flow_log", 0, c)])
+assert feed._feed.drain(30), "feed never drained"
+for a, b in zip(jax.tree.leaves(base.state), jax.tree.leaves(feed.state)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+batches = base.batcher.emitted_batches
+assert batches and batches == feed.batcher.emitted_batches
+# dispatches-per-batch dropped: tracer kernel spans = inline batches +
+# fused feed groups, and the fused groups undercut the batch count
+kernel_spans = tr.counters()["kernel_count"]
+assert kernel_spans == batches + feed._feed.groups, \
+    (kernel_spans, batches, feed._feed.groups)
+assert feed._feed.groups < batches
+assert base.h2d_transfers == 5 * batches      # mask + 4 planes, per batch
+assert feed.h2d_transfers <= batches, "coalesced path must be <= 1/batch"
+assert feed.dispatches < base.dispatches
+assert feed.batcher.pool_hits > 0, "recycle pool never engaged"
+sup = [t for t in default_supervisor().threads()
+       if t["name"] == "tpu-sketch-feed"]
+assert sup and all(t["crashes"] == 0 for t in sup), sup
+base.close()
+feed.close()
+tr.disable()
+print(f"feed OK: {batches} batches, transfers {base.h2d_transfers} -> "
+      f"{feed.h2d_transfers}, dispatches {base.dispatches} -> "
+      f"{feed.dispatches}, state bit-identical")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax

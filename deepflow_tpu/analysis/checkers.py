@@ -169,12 +169,16 @@ class EmitUnderLock(Checker):
                 f"(swap-under-lock)")
 
 
-_DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py")
+_DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
+                         "runtime/feed.py")
 # the sampled-drain helpers where a blocking sync is the point: explicit
-# attribution drains on every Nth batch / cold compile (PR 1) and the
-# degraded-mode device probe (PR 2)
+# attribution drains on every Nth batch / cold compile (PR 1), the
+# degraded-mode device probe (PR 2), and the overlapped feed's
+# bounded-window fence — the ONE place the prefetch pipeline may block
+# on the device (ISSUE 5; feed.py _fence_one / the error-path discard)
 _SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
-                               "_probe_device_locked"])
+                               "_probe_device_locked", "_fence_one",
+                               "_discard_inflight"])
 
 
 @register

@@ -35,15 +35,29 @@ class TensorBatch:
 
 
 class Batcher:
-    """Accumulates column chunks; yields full static-shape batches."""
+    """Accumulates column chunks; yields full static-shape batches.
+
+    Emitted buffers can come back through `recycle()` (the reference's
+    server/libs/pool free-list, completed): the consumer returns a
+    TensorBatch once its columns are fully read (for the coalesced
+    device feed: after the host pack into the staging buffer), and
+    `_emit` reuses the arrays instead of paying one `schema.alloc`
+    per batch. Safe by construction: put() overwrites every row up to
+    the fill point and _emit zeroes the padding tail, so a recycled
+    buffer's stale contents can never leak into a batch."""
+
+    _POOL_CAP = 8        # returned buffers retained (beyond = GC'd)
 
     def __init__(self, schema: Schema, capacity: int) -> None:
         self.schema = schema
         self.capacity = capacity
         self._buf = schema.alloc(capacity)
         self._fill = 0
+        self._pool: list = []
         self.total_rows = 0
         self.emitted_batches = 0
+        self.recycled = 0          # buffers accepted back
+        self.pool_hits = 0         # allocs avoided
 
     def put(self, cols: Dict[str, np.ndarray]) -> Iterator[TensorBatch]:
         """Append a chunk; yield zero or more exactly-full batches."""
@@ -64,15 +78,33 @@ class Batcher:
         if self._fill > 0:
             yield self._emit(self._fill)
 
+    def recycle(self, batch: TensorBatch) -> None:
+        """Return an emitted batch's buffers for reuse. Called from the
+        consumer's thread (the device-feed thread) while the producer
+        allocates under the exporter's state lock — list append/pop are
+        GIL-atomic and _emit tolerates a losing race by allocating."""
+        cols = batch.columns
+        if (len(self._pool) >= self._POOL_CAP
+                or batch.capacity != self.capacity
+                or set(cols) != set(self.schema.names)):
+            return
+        self.recycled += 1
+        self._pool.append(cols)
+
     def _emit(self, valid: int) -> TensorBatch:
-        # Hand the filled buffer to the batch and allocate a replacement —
-        # one allocation per batch, no copy (the reference's pool discipline,
-        # server/libs/pool, minus the free-list).
+        # Hand the filled buffer to the batch and take a replacement from
+        # the recycle pool (falling back to one fresh allocation — the
+        # reference's pool discipline, server/libs/pool, free-list
+        # included since ISSUE 5). No copy either way.
         out = self._buf
         if valid < self.capacity:
             for n in self.schema.names:
                 out[n][valid:] = 0
-        self._buf = self.schema.alloc(self.capacity)
+        try:
+            self._buf = self._pool.pop()
+            self.pool_hits += 1
+        except IndexError:
+            self._buf = self.schema.alloc(self.capacity)
         self._fill = 0
         self.emitted_batches += 1
         return TensorBatch(columns=out, valid=valid)

@@ -143,6 +143,73 @@ def update_hits(state: FlowSuiteState, dstate: FlowDictState,
     return flow_suite.update(state, unpack_lanes(lanes), mask, cfg)
 
 
+# plane rows per wire kind (the only two shapes the wire carries)
+_KIND_ROWS = {"news": 6, "hits": 3}
+
+
+def wire_signature(wire) -> Tuple[Tuple[str, int], ...]:
+    """Static shape signature of one emitted wire sequence: a tuple of
+    (kind, plane_width). The signature fully determines the fused
+    program `make_wire_update` builds, so the runtime can cache one
+    jitted program per signature — the packer's power-of-two width
+    buckets (`_bucket`) keep the signature space small."""
+    return tuple((kind, plane.shape[1]) for kind, plane, _ in wire)
+
+
+def wire_words(sig: Tuple[Tuple[str, int], ...]) -> int:
+    """uint32 words one coalesced staging buffer needs for `sig`:
+    one n-header word per plane, then the planes raveled in order."""
+    return len(sig) + sum(_KIND_ROWS[kind] * w for kind, w in sig)
+
+
+def stage_wire(wire, flat: np.ndarray) -> None:
+    """Host-pack one emitted wire sequence into a flat uint32 staging
+    buffer (layout: [n_0..n_{P-1} | plane_0.ravel() | ...]) — the
+    single-transfer form `make_wire_update` consumes. Emission order is
+    preserved exactly (the consumer rule the packer's docstring
+    carries)."""
+    P = len(wire)
+    off = P
+    for i, (_, plane, n) in enumerate(wire):
+        flat[i] = n
+        flat[off:off + plane.size] = plane.ravel()
+        off += plane.size
+
+
+def make_wire_update(cfg: FlowSuiteConfig,
+                     sig: Tuple[Tuple[str, int], ...]):
+    """One jitted program applying a whole staged wire sequence — every
+    news/hits plane of one (possibly multi-batch) group — from a single
+    coalesced transfer, in emission order. The per-plane math is
+    exactly `update_news`/`update_hits`, so sketch state is
+    bit-identical to the per-plane dispatch path; what changes is the
+    boundary: one device_put and one dispatch per group instead of one
+    of each per plane. Returns fn(state, dstate, flat) ->
+    (state, dstate, fence); state and dstate are donated (a pure-hits
+    program returns dstate through input-output aliasing), `fence` is a
+    small fresh scalar safe to block on after the donation."""
+    sig = tuple(sig)
+
+    def prog(state: FlowSuiteState, dstate: FlowDictState,
+             flat: jnp.ndarray):
+        rows = jnp.uint32(0)
+        off = len(sig)
+        for i, (kind, w) in enumerate(sig):
+            n = flat[i]
+            nwords = _KIND_ROWS[kind] * w
+            plane = flat[off:off + nwords].reshape(_KIND_ROWS[kind], w)
+            off += nwords
+            if kind == "news":
+                state, dstate = update_news(state, dstate, plane, n, cfg)
+            else:
+                state = update_hits(state, dstate, plane, n, cfg)
+            rows = rows + n
+        return state, dstate, rows
+
+    import jax
+    return jax.jit(prog, donate_argnums=(0, 1))
+
+
 class FlowDictPacker:
     """Host side: streaming records -> ordered news/hits wire batches.
 

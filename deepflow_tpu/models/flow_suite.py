@@ -156,6 +156,67 @@ def pack_lanes(cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     }
 
 
+def pack_lanes_into(cols: Dict[str, np.ndarray], out: np.ndarray) -> None:
+    """`pack_lanes` writing into a preallocated (4, n) uint32 view of a
+    coalesced staging buffer (runtime/feed.py): same bit-exact lane
+    words, zero intermediate allocations — the staging buffer is the
+    ONLY host copy between the TensorBatch and the single device_put."""
+    u32 = np.uint32
+    np.copyto(out[0], cols["ip_src"], casting="unsafe")
+    np.copyto(out[1], cols["ip_dst"], casting="unsafe")
+    out[2][:] = ((cols["port_src"].astype(u32) & u32(0xFFFF)) << u32(16)) \
+        | (cols["port_dst"].astype(u32) & u32(0xFFFF))
+    out[3][:] = ((cols["proto"].astype(u32) & u32(0xFF)) << u32(24)) \
+        | np.minimum(cols["packet_tx"].astype(np.uint64)
+                     + cols["packet_rx"], 0xFFFFFF).astype(u32)
+
+
+# Coalesced staging layout for K packed-lane batches of capacity C
+# (flat uint32, ONE transfer): [n_0..n_{K-1} | plane_0 (4*C) | ... |
+# plane_{K-1}]. The program recovers each batch's mask on device from
+# its n word, so not even the bool mask crosses the link any more.
+def coalesced_lanes_words(k_batches: int, capacity: int) -> int:
+    return k_batches + 4 * capacity * k_batches
+
+
+def make_coalesced_update(cfg: FlowSuiteConfig, k_batches: int,
+                          capacity: int):
+    """One jitted program advancing the suite by K stacked packed-lane
+    batches read from a single coalesced staging transfer (the
+    multi-batch fused step: `lax.scan` amortizes per-dispatch overhead
+    that dominates at small batch_rows). Applies the K batches in
+    order with per-batch masks, so the final state is bit-identical to
+    K separate `update_packed` dispatches — including ring admission,
+    whose phase rides state.batches_seen exactly as before. Returns
+    fn(state, flat) -> (state, fence) with `state` donated and `fence`
+    a small fresh scalar the feed can block on without touching the
+    donated chain."""
+    K, C = int(k_batches), int(capacity)
+
+    def _one(state: FlowSuiteState, plane: jnp.ndarray,
+             n: jnp.ndarray) -> FlowSuiteState:
+        lanes = {"ip_src": plane[0], "ip_dst": plane[1],
+                 "ports": plane[2], "proto_pkts": plane[3]}
+        mask = jnp.arange(plane.shape[1]) < n
+        return update(state, unpack_lanes(lanes), mask, cfg)
+
+    def prog(state: FlowSuiteState, flat: jnp.ndarray):
+        ns = flat[:K]
+        if K == 1:                     # no scan machinery for the common case
+            out = _one(state, flat[K:].reshape(4, C), ns[0])
+            return out, ns[0] + jnp.uint32(0)
+        planes = flat[K:].reshape(K, 4, C)
+
+        def body(s, xs):
+            plane, n = xs
+            return _one(s, plane, n), None
+
+        out, _ = jax.lax.scan(body, state, (planes, ns))
+        return out, jnp.sum(ns)
+
+    return jax.jit(prog, donate_argnums=0)
+
+
 def unpack_lanes(lanes: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     """Device-side unpack back to the column dict `update` consumes —
     bit-exact with the unpacked path (tests/test_cms.py asserts state

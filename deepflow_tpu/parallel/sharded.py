@@ -202,6 +202,27 @@ class ShardedFlowSuite(_ShardedSuiteBase):
             (state_specs, P(None, axis), P(axis)), state_specs)
         self._plane_sharding = NamedSharding(mesh, P(None, axis))
 
+        def local_update_lanes(state, plane, n):
+            # the coalesced packed-lane form (ISSUE 5): plane is the
+            # (4, B) lane matrix sharded on its BATCH axis, n the
+            # GLOBAL valid-row count — ONE transfer per device and the
+            # mask recovered on device from each shard's global
+            # positions, mirroring the single-chip feed's staging
+            # discipline (runtime/feed.py)
+            local = jax.tree.map(lambda x: x[0], state)
+            d = jax.lax.axis_index(axis)
+            b = plane.shape[1]                 # per-shard width
+            mask = (jnp.arange(b) + d * b) < n
+            lanes = {"ip_src": plane[0], "ip_dst": plane[1],
+                     "ports": plane[2], "proto_pkts": plane[3]}
+            local = flow_suite.update(
+                local, flow_suite.unpack_lanes(lanes), mask, cfg_)
+            return jax.tree.map(lambda x: x[None], local)
+
+        self._update_lanes = self._shard(
+            local_update_lanes,
+            (state_specs, P(None, axis), P()), state_specs)
+
         # -- dictionary lane (models/flow_dict.py) on the mesh ------------
         # Key table REPLICATED (leading device axis, identical content):
         # news planes broadcast so every replica scatters the same rows,
@@ -277,6 +298,17 @@ class ShardedFlowSuite(_ShardedSuiteBase):
 
     def update_plane(self, state, plane, mask):
         return self._update_plane(state, plane, mask)
+
+    def put_lanes(self, plane):
+        """Device-place one (4, B) packed-lane plane, batch axis
+        sharded — the mesh form of the coalesced single-transfer feed
+        (no mask transfer: update_lanes rebuilds it on device from n)."""
+        return jax.device_put(plane, self._plane_sharding)
+
+    def update_lanes(self, state, plane, n):
+        """Advance from a coalesced lane plane; n is the GLOBAL valid
+        count (rows >= n are padding, masked per shard on device)."""
+        return self._update_lanes(state, plane, jnp.uint32(n))
 
     # -- dictionary lane ---------------------------------------------------
 

@@ -46,6 +46,17 @@ class IngesterConfig:
     # enable the TPU sketch analytics exporter (BASELINE.json's
     # tpu_sketch plugin); None disables, a float sets window seconds
     tpu_sketch_window_s: Optional[float] = None
+    # -- overlapped device feed (runtime/feed.py, ISSUE 5) ------------
+    # double-buffered host->device prefetch for the tpu_sketch lane: a
+    # supervised feed thread packs + transfers batch N+1 (one coalesced
+    # device_put per batch) while batch N's donated-state update runs
+    # async on device. 0 = the inline unoverlapped path (bit-identical
+    # sketch state either way — asserted in tests/test_feed.py).
+    prefetch_depth: int = 2
+    # stack K TensorBatches into one lax.scan-fused device step,
+    # amortizing per-dispatch overhead that dominates at small
+    # batch_rows; 1 = one dispatch per batch (still coalesced)
+    coalesce_batches: int = 1
     # per-service RED windows from the l7 stream (runtime/app_red.py);
     # None disables, a float sets window seconds
     app_red_window_s: Optional[float] = None
@@ -176,7 +187,9 @@ class Ingester:
                 os.path.join(cfg.store_path, "sketch_ckpt")
             self.tpu_sketch = TpuSketchExporter(
                 store=self.store, window_seconds=cfg.tpu_sketch_window_s,
-                checkpoint_dir=ckpt_dir, stats=self.stats)
+                checkpoint_dir=ckpt_dir, stats=self.stats,
+                prefetch_depth=cfg.prefetch_depth,
+                coalesce_batches=cfg.coalesce_batches)
             self.exporters.register(self.tpu_sketch)
         self.app_red = None
         if cfg.app_red_window_s is not None:

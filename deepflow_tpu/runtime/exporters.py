@@ -149,6 +149,16 @@ class Exporters:
             q = getattr(e, "queue", None)
             if q is not None:
                 total += len(q)
+            # overlapped device feeds (runtime/feed.py) hold batches
+            # PAST the exporter queue — in the prefetch window — and
+            # the drain ladder must not declare victory while they are
+            # in flight (ISSUE 5)
+            extra = getattr(e, "pending_extra", None)
+            if extra is not None:
+                try:
+                    total += int(extra())
+                except Exception:
+                    pass
         return total
 
     def breakers(self) -> Dict[str, dict]:

@@ -123,9 +123,10 @@ def render_metrics(stats: Optional[StatsRegistry],
                 f"{_fmt(total)}")
             lines.append(f"{hname}_sum{_labels(lbl)} {repr(sum_)}")
             lines.append(f"{hname}_count{_labels(lbl)} {_fmt(total)}")
+        from deepflow_tpu.runtime.tracing import GAUGE_HELP
         for name, value in sorted(tracer.gauges().items()):
             _sample(_metric_name("deepflow_trace", name), {}, value,
-                    mtype="gauge")
+                    mtype="gauge", help_=GAUGE_HELP.get(name, ""))
         _sample("deepflow_trace_spans_total", {},
                 float(tracer.spans_recorded), mtype="counter",
                 help_="spans recorded by the flight recorder")

@@ -114,24 +114,38 @@ class _HostSketch:
         for k, c in zip(uniq.tolist(), cnt.tolist()):
             counts[k] = counts.get(k, 0) + c * self.stride
         if len(counts) > self.DICT_CAP:
-            # keep the heavy half: the top-K readout only needs heads
-            keep = sorted(counts.items(), key=lambda kv: -kv[1])
-            self._counts = dict(keep[:self.DICT_CAP // 2])
+            # keep the heavy half: the top-K readout only needs heads.
+            # nlargest is O(n log cap) vs the full sort's O(n log n) —
+            # this trim runs on the hot degraded path (bench
+            # host_fallback), where the sort showed up
+            import heapq
+            keep = heapq.nlargest(self.DICT_CAP // 2, counts.items(),
+                                  key=lambda kv: kv[1])
+            self._counts = dict(keep)
         if len(self._clients) < self.CLIENTS_CAP:
             self._clients.update(sub["ip_src"].tolist())
         pkts = np.minimum(sub["packet_tx"].astype(np.int64)
                           + sub["packet_rx"].astype(np.int64), 0xFFFF)
         for i, f in enumerate(flow_suite.ENTROPY_FEATURES):
-            np.add.at(self._ent[i],
-                      np.asarray(sub[f]).astype(np.uint32)
-                      % np.uint32(self._buckets), pkts)
+            # bincount over the bucketed feature beats np.add.at's
+            # per-element scatter ~10x at these sizes; float64 weight
+            # sums are exact for these integer magnitudes (< 2^53)
+            self._ent[i] += np.bincount(
+                np.asarray(sub[f]).astype(np.uint32)
+                % np.uint32(self._buckets),
+                weights=pkts, minlength=self._buckets).astype(np.int64)
         return len(keys)
 
     def flush(self, cfg: flow_suite.FlowSuiteConfig
               ) -> flow_suite.FlowWindowOutput:
         """Window readout in FlowWindowOutput shape, then reset."""
+        import heapq
         k = cfg.top_k
-        top = sorted(self._counts.items(), key=lambda kv: -kv[1])[:k]
+        # heapq.nlargest == sorted(..., reverse=True)[:k] (stable on
+        # ties, per its docs) at O(n log k) instead of sorting the
+        # whole surviving dict every window
+        top = heapq.nlargest(k, self._counts.items(),
+                             key=lambda kv: kv[1])
         keys = np.zeros(k, np.uint32)
         counts = np.zeros(k, np.int32)
         for i, (key, c) in enumerate(top):
@@ -168,6 +182,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                  checkpoint_every: int = 1,
                  staged: bool = False,
                  wire: str = "dict",
+                 prefetch_depth: int = 0,
+                 coalesce_batches: int = 1,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
                          batch=64, stats=stats)
@@ -312,6 +328,39 @@ class TpuSketchExporter(QueueWorkerExporter):
         self.host_stride = 4       # host fallback subsample (reduced rate)
         self._host: Optional[_HostSketch] = None
         self._window_lost_counted = False
+        # -- overlapped device feed (runtime/feed.py, ISSUE 5) -------------
+        # prefetch_depth > 0 routes the hot path through a supervised
+        # feed thread: host pack of batch N+1 overlaps the device update
+        # of batch N, each group crosses the link as ONE coalesced
+        # transfer (vs one per plane/column), and coalesce_batches=K
+        # fuses K TensorBatches into a single dispatch. 0 keeps the
+        # inline unoverlapped path — the bit-identical reference the
+        # equivalence tests diff against. State ownership with the feed
+        # on: between feed.drain() barriers the FEED thread is the only
+        # writer of self.state/_dict_state/_host; _state_lock serializes
+        # producers against the window flush, and the flush touches
+        # state only after a drain barrier returned (see feed.py).
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        self.coalesce_batches = max(1, int(coalesce_batches))
+        self.h2d_transfers = 0     # device_put count (TRUE total)
+        self.dispatches = 0        # update-program call count
+        self._feed = None
+        self._programs: Dict[Any, Any] = {}   # shape signature -> jitted
+        self._staging_pool: Dict[int, list] = {}
+        self._staging_cap = self.prefetch_depth + 2
+        if self.staged and self.prefetch_depth:
+            import logging
+            logging.getLogger(__name__).warning(
+                "staged=True has no coalesced feed; prefetch disabled")
+            self.prefetch_depth = 0
+        if self.prefetch_depth:
+            from deepflow_tpu.runtime.feed import DeviceFeed
+            self._feed = DeviceFeed(
+                "tpu-sketch-feed", self._feed_process_group,
+                depth=self.prefetch_depth,
+                coalesce=self.coalesce_batches,
+                on_fence_error=self._feed_fence_error,
+                on_restart=self._feed_crash_restart)
 
     # -- exporter lifecycle ------------------------------------------------
     def start(self) -> None:
@@ -330,7 +379,9 @@ class TpuSketchExporter(QueueWorkerExporter):
             self._window_thread.stop()
             self._window_thread.join(timeout=5)
         super().close()
-        self.flush_window()  # final window
+        self.flush_window()  # final window (drains the feed first)
+        if self._feed is not None:
+            self._feed.close()
         for w in (self.topk_writer, self.window_writer):
             if w is not None:
                 w.close()
@@ -353,10 +404,25 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # BY this lock (flush_window drains it under the same
                 # lock); no other thread can block on it
                 for tb in self.batcher.put(schema_cols):  # lint: disable=emit-under-lock
-                    self._run_batch_locked(tb)
-                # counted only once the chunk is fully on device, so
-                # rows_in is a processed-watermark, not an arrival count
+                    self._submit_batch_locked(tb)
+                # counted once the chunk is fully handed to the device
+                # path (inline: on device; feed: in the bounded window,
+                # which every flush drains first), so rows_in is a
+                # processed-watermark, not an arrival count
                 self.rows_in += len(next(iter(schema_cols.values())))
+
+    def _submit_batch_locked(self, tb: TensorBatch) -> None:
+        """One emitted TensorBatch onto the device path: inline
+        dispatch, or the overlapped feed when prefetch is on. The feed
+        consumer never takes _state_lock (feed.py's ownership
+        protocol), so the blocking put is back-pressure, not a
+        deadlock."""
+        if self._feed is None:
+            self._run_batch_locked(tb)
+            return
+        self._feed.put(  # lint: disable=emit-under-lock
+            tb, self._tracer.current_batch()
+            if self._tracer.enabled else -1)
 
     def _to_device(self, host_array, rows: int):
         """jnp.asarray with flight-recorder h2d attribution. A
@@ -365,9 +431,13 @@ class TpuSketchExporter(QueueWorkerExporter):
         sampled (see __init__); everything else stays fully async."""
         jnp = self._jnp
         tr = self._tracer
-        # the byte counter is a TRUE total (scraped beside rows_in):
-        # every transfer counts, only the blocking measurement samples
+        # byte/transfer counters are TRUE totals (scraped beside
+        # rows_in): every transfer counts, only the blocking
+        # measurement samples. transfers-vs-batches is the coalescing
+        # regression signal ISSUE 5 asks for — a slide back toward
+        # per-plane puts shows up as h2d_transfers outgrowing batches
         self.h2d_bytes += host_array.nbytes
+        self.h2d_transfers += 1
         if not (tr.enabled and self._detailed):
             return jnp.asarray(host_array)
         t0 = time.perf_counter()
@@ -389,6 +459,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         cold (a compile must always be attributed — missing it would
         poison the first sampled batch's device quantile instead)."""
         tr = self._tracer
+        self.dispatches += 1
         first = key not in self._warm
         if not tr.enabled or not (self._detailed or first):
             return fn(*args)
@@ -416,8 +487,15 @@ class TpuSketchExporter(QueueWorkerExporter):
             if not tr.enabled:
                 self._run_batch_inner(tb)
                 return
+            before = self.h2d_transfers
             with tr.span("kernel", stream=self.wire, rows=tb.valid):
                 self._run_batch_inner(tb)
+            if self._detailed:
+                # the same coalescing-regression gauge the feed path
+                # records: the inline path honestly reads its
+                # per-plane/per-column transfer count (> 1)
+                tr.gauge("tpu_transfers_per_batch",
+                         float(self.h2d_transfers - before))
         except RuntimeError:
             # XlaRuntimeError (device loss, OOM, preemption) subclasses
             # RuntimeError; anything else device-shaped lands here too.
@@ -538,6 +616,191 @@ class TpuSketchExporter(QueueWorkerExporter):
         self.state = self._timed_update(
             "packed", self._update, self.state, lanes_d, mask_d)
 
+    # -- overlapped feed (runtime/feed.py) ---------------------------------
+    # Everything below runs on the FEED THREAD. It never takes
+    # _state_lock: between drain barriers the feed thread is the only
+    # writer of self.state/_dict_state/_host (the ownership protocol
+    # feed.py documents), and flush/checkpoint/probe touch state only
+    # after a barrier returned.
+
+    def _feed_process_group(self, group) -> Optional["InFlight"]:
+        """Apply one group of (TensorBatch, batch_id): host-pack into a
+        single staging buffer, ONE coalesced transfer, one fused async
+        dispatch with donated state. Degraded mode absorbs the group
+        host-side; a device-classified error rolls back exactly like
+        the inline path, with the whole group counted."""
+        if self.degraded:
+            for tb, _ in group:
+                self._host_batch_locked(tb)
+                self.batcher.recycle(tb)
+            return None
+        tr = self._tracer
+        rows = sum(int(tb.valid) for tb, _ in group)
+        if not tr.enabled:
+            try:
+                return self._dispatch_group(group, rows)
+            except RuntimeError:
+                self._on_device_error_locked(rows)
+                return None
+        tr.set_batch(group[0][1])
+        try:
+            with tr.span("kernel", stream=self.wire, rows=rows):
+                return self._dispatch_group(group, rows)
+        except RuntimeError:
+            self._on_device_error_locked(rows)
+            return None
+
+    def _dispatch_group(self, group, rows: int) -> Optional["InFlight"]:
+        from deepflow_tpu.runtime.feed import InFlight
+
+        if self._faults.enabled:   # chaos: simulated device loss
+            self._faults.maybe_raise(FAULT_DEVICE_ERROR, key=self.wire)
+        tr = self._tracer
+        if tr.enabled:
+            self._detailed = \
+                self._batches_traced % self._attrib_every == 0
+            self._batches_traced += 1
+        before = self.h2d_transfers
+        if self.wire == "dict":
+            staged = self._dispatch_dict_group(group)
+        else:
+            staged = self._dispatch_lanes_group(group)
+        if tr.enabled and self._detailed:
+            tr.gauge("tpu_transfers_per_batch",
+                     (self.h2d_transfers - before) / len(group))
+        if staged is None:
+            return None
+        fence, flat = staged
+        if tr.enabled and self._detailed:
+            tr.gauge("tpu_h2d_coalesced_bytes", float(flat.nbytes))
+        return InFlight(fence, rows,
+                        lambda: self._staging_release(flat))
+
+    def _dispatch_lanes_group(self, group):
+        """K packed-lane batches -> one flat staging buffer -> one
+        scan-fused update program (flow_suite.make_coalesced_update)."""
+        K = len(group)
+        C = self.batcher.capacity
+        flat = self._staging_get(flow_suite.coalesced_lanes_words(K, C))
+        for k, (tb, _) in enumerate(group):
+            self._record_key_tuples(tb)
+            flat[k] = tb.valid
+            flow_suite.pack_lanes_into(
+                tb.columns,
+                flat[K + 4 * C * k:K + 4 * C * (k + 1)].reshape(4, C))
+            self.batcher.recycle(tb)
+        prog = self._program(
+            ("lanes", K, C),
+            lambda: flow_suite.make_coalesced_update(self.cfg, K, C))
+        flat_d = self._to_device(flat, sum(int(tb.valid)
+                                          for tb, _ in group))
+        self.state, fence = self._timed_update(
+            f"lanes_x{K}", prog, self.state, flat_d)
+        return fence, flat
+
+    def _dispatch_dict_group(self, group):
+        """K batches through the dictionary packer -> the emitted wire
+        sequence staged flat -> one signature-keyed fused program
+        (flow_dict.make_wire_update). Emission order is preserved
+        per-batch (pack + flush per TensorBatch, exactly the inline
+        sequence), so sketch state stays bit-identical."""
+        fd = self._flow_dict
+        wire = []
+        for tb, _ in group:
+            self._record_key_tuples(tb)
+            mask = tb.mask()
+            cols = {k: v[mask] for k, v in tb.columns.items()}
+            wire += self._dict_packer.pack(cols)
+            wire += self._dict_packer.flush()
+            self.batcher.recycle(tb)
+        if not wire:
+            return None
+        sig = fd.wire_signature(wire)
+        flat = self._staging_get(fd.wire_words(sig))
+        fd.stage_wire(wire, flat)
+        prog = self._program(
+            ("dict", sig), lambda: fd.make_wire_update(self.cfg, sig))
+        flat_d = self._to_device(flat, sum(n for _, _, n in wire))
+        key = "dict:" + "+".join(f"{k[0]}{w}" for k, w in sig)
+        self.state, self._dict_state, fence = self._timed_update(
+            key, prog, self.state, self._dict_state, flat_d)
+        return fence, flat
+
+    _PROGRAM_CACHE_CAP = 128
+
+    def _program(self, key, build):
+        """Shape-signature -> jitted fused program cache. Bounded: the
+        packer's power-of-two width buckets keep real signature churn
+        tiny, but a pathological stream must degrade to recompiles,
+        not grow without limit."""
+        prog = self._programs.get(key)
+        if prog is None:
+            if len(self._programs) >= self._PROGRAM_CACHE_CAP:
+                self._programs.clear()
+            prog = build()
+            self._programs[key] = prog
+        return prog
+
+    def _staging_get(self, words: int):
+        pool = self._staging_pool.get(words)
+        if pool:
+            try:
+                return pool.pop()
+            except IndexError:
+                pass
+        return np.empty(words, np.uint32)
+
+    def _staging_release(self, flat) -> None:
+        """Return a staging buffer once its batch's fence retired (the
+        only point reuse is provably safe: the program that read the
+        buffer has completed). Bounded per shape and in shape count."""
+        if len(self._staging_pool) >= 16 \
+                and flat.size not in self._staging_pool:
+            return
+        pool = self._staging_pool.setdefault(flat.size, [])
+        if len(pool) < self._staging_cap:
+            pool.append(flat)
+
+    def _feed_fence_error(self, exc: BaseException, rows: int) -> None:
+        """Async device failure surfaced at a feed fence: the failed
+        batch plus every younger in-flight batch (their donated state
+        chain is poisoned) arrive as ONE loss — same rollback ladder
+        as a synchronous dispatch error."""
+        if isinstance(exc, RuntimeError):
+            self._on_device_error_locked(rows)
+            return
+        # not device-shaped: count the loss, restore to a known state
+        self.lost_rows += rows
+        try:
+            self._restore_device_state_locked()
+        except Exception:
+            self._consecutive_errors = self.degrade_after
+            self.degraded = True
+
+    def _feed_crash_restart(self, rows: int) -> None:
+        """Supervisor restarted the feed thread after a crash: the
+        window's rows are counted lost and device state restored from
+        the latest checkpoint (donation leaves the chain uncertain, so
+        trusting it would risk silent corruption — the one loss class
+        this lane never accepts)."""
+        self.lost_rows += rows
+        if not self._window_lost_counted:
+            self.lost_windows += 1
+            self._window_lost_counted = True
+        if self.degraded:
+            return
+        try:
+            self._restore_device_state_locked()
+        except Exception:
+            self._consecutive_errors = self.degrade_after
+            self.degraded = True
+
+    def pending_extra(self) -> int:
+        """Batches still owed to the device by the prefetch window —
+        Exporters.pending() adds this so the drain ladder (PR 4) keeps
+        waiting while rows are in flight."""
+        return 0 if self._feed is None else self._feed.pending()
+
     # one entry per distinct sampled flow key: (ip_src, ip_dst,
     # port_src, port_dst, proto). Sized well above ring_size so standing
     # heavy hitters stay resolvable across windows.
@@ -579,6 +842,18 @@ class TpuSketchExporter(QueueWorkerExporter):
         with self._state_lock:
             if self.checkpointer is None or self.degraded:
                 return False
+            if self._feed is not None \
+                    and not self._feed.drain(timeout=10.0):
+                # the window never settled (wedged device / backlogged
+                # feed): saving now would snapshot a state the feed is
+                # still advancing — possibly donated-dead buffers — and
+                # a raise here would abort the caller's drain ladder
+                # before the spill rung. Skip the snapshot; the previous
+                # one still bounds the loss.
+                import logging
+                logging.getLogger(__name__).error(
+                    "feed drain timed out; shutdown checkpoint skipped")
+                return False
             self.checkpointer.save(self.state, self.windows)
             return True
 
@@ -596,7 +871,17 @@ class TpuSketchExporter(QueueWorkerExporter):
             flow_suite.FlowWindowOutput]:
         with self._state_lock:
             for tb in self.batcher.flush():
-                self._run_batch_locked(tb)
+                self._submit_batch_locked(tb)
+            if self._feed is not None:
+                # barrier: every in-flight prefetched batch applies and
+                # fences before the window reads/resets state (feed.py
+                # ownership protocol). The feed thread never takes
+                # _state_lock, so holding it across the wait is safe.
+                if not self._feed.drain(timeout=60.0):
+                    import logging
+                    logging.getLogger(__name__).error(
+                        "feed drain timed out; window flushed against "
+                        "a possibly-advancing state")
             self.windows += 1
             if self.degraded:
                 # host fallback window: reduced-fidelity output, then
@@ -686,6 +971,12 @@ class TpuSketchExporter(QueueWorkerExporter):
         c = super().counters()
         c.update({"rows_in": self.rows_in, "windows": self.windows,
                   "h2d_bytes": self.h2d_bytes,
+                  # coalescing health: transfers vs dispatches vs
+                  # batches — a regression back to per-plane puts shows
+                  # here (and as the tpu_transfers_per_batch gauge)
+                  "h2d_transfers": self.h2d_transfers,
+                  "dispatches": self.dispatches,
+                  "batches": self.batcher.emitted_batches,
                   # degraded-mode fault domain: every loss is a number
                   "degraded": 1 if self.degraded else 0,
                   "device_errors": self.device_errors,
@@ -703,6 +994,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                            "admission_failures", None)
         if failures is not None:
             c["ring_admission_failures"] = failures
+        if self._feed is not None:
+            c.update(self._feed.counters())
         if self.checkpointer is not None:
             c.update(self.checkpointer.counters())
         return c

@@ -44,7 +44,27 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["HostDDSketch", "Tracer", "default_tracer"]
+__all__ = ["HostDDSketch", "Tracer", "default_tracer", "GAUGE_HELP"]
+
+# HELP strings for the well-known tracer gauges (rendered into the
+# Prometheus exposition by runtime/promexpo.py). The ISSUE 5 feed
+# gauges live here so a scrape explains itself: transfers_per_batch is
+# the coalescing-regression signal (a slide back to per-plane
+# device_puts reads > 1), overlap_efficiency the device-busy proxy.
+GAUGE_HELP: Dict[str, str] = {
+    "tpu_h2d_mb_s": "sampled host->device transfer rate of the sketch "
+                    "lane (blocking measurement every Nth batch)",
+    "tpu_transfers_per_batch": "device_put calls per TensorBatch on the "
+                               "sketch lane; the coalesced feed holds "
+                               "this at <= 1",
+    "tpu_h2d_coalesced_bytes": "bytes of the last sampled coalesced "
+                               "staging transfer",
+    "tpu_feed_overlap_efficiency": "fraction of feed-thread wall time "
+                                   "spent waiting on the device fence "
+                                   "(~1 = chip-bound, ~0 = host-bound)",
+    "tpu_feed_inflight": "dispatched-but-unfenced updates in the "
+                         "prefetch window",
+}
 
 
 class HostDDSketch:
