@@ -790,8 +790,38 @@ def main() -> None:
     feed_exp.close()
     _recover()
 
+    # -- timed: audit overhead (ISSUE 6) -----------------------------------
+    # The accuracy observatory's acceptance bar: <5% e2e rec/s cost at
+    # the default sample rate. Same loop as feed_overlap with the
+    # exact-shadow audit on; overhead_frac is the measured fraction of
+    # the feed rate the audit eats (the number, not an adjective).
+    _phase("timed: feed overlap e2e (audit on)")
+    AUDIT_RATE = 1.0 / 64
+    audit_exp = TpuSketchExporter(
+        store=None, window_seconds=3600, batch_rows=1 << 16,
+        wire="lanes", prefetch_depth=2, coalesce_batches=2,
+        audit_rate=AUDIT_RATE)
+    audit_exp.process([("l4_flow_log", 0, schema_batches[0])])
+    audit_exp._feed.drain()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        audit_exp.process([("l4_flow_log", 0,
+                            schema_batches[i % n_batches])])
+    audit_exp._feed.drain()
+    audit_rate_recs = batch * iters / (time.perf_counter() - t0)
+    audit_stats = {
+        "records_per_sec": round(audit_rate_recs),
+        "overhead_frac": round(
+            max(0.0, 1.0 - audit_rate_recs / max(feed_rate, 1.0)), 4),
+        "sample_rate": round(AUDIT_RATE, 6),
+        "sampled_rows": audit_exp._audit.sampled_rows_total,
+    }
+    audit_exp.close()
+    _recover()
+
     stage_breakdown = {
         "feed_overlap": feed_stats,
+        "audit": audit_stats,
         "packed": {"h2d_mb_s": round(packed_h2d),
                    "kernel_records_per_sec": round(packed_kernel_rate),
                    "bytes_per_record": 16},

@@ -86,6 +86,11 @@ missing = needed - set(default_tracer().latency())
 assert not missing, f"stages never recorded: {missing}"
 for stage in needed:
     assert f'stage="{stage}"' in text, f"{stage} absent from exposition"
+# ISSUE 6: the accuracy observatory's Countable family and the
+# continuous occupancy gauges ride every scrape of a live ingester
+for needle in ("deepflow_tpu_sketch_accuracy_windows",
+               "tpu_device_busy_fraction", "tpu_feed_stall_seconds"):
+    assert needle in text, f"{needle} absent from exposition"
 print("exposition OK:", len(text.splitlines()), "lines,",
       len(default_tracer().latency()), "stages")
 EOF
@@ -331,6 +336,73 @@ print(f"feed OK: {batches} batches, transfers {base.h2d_transfers} -> "
       f"{feed.dispatches}, state bit-identical")
 EOF
 
+echo "== audit smoke: exact-shadow recall + degraded conservation =="
+# ISSUE 6: the accuracy observatory against a fixed-seed heavy-hitter
+# replay. The full-rate exact shadow must score the live sketch's top-K
+# recall >= 0.9 and hold every error inside its theoretical bound; then
+# an injected tpu.device_error pushes the lane through a degraded
+# (host-fallback) window, which must still be audited — tagged, kept
+# out of the alarm — with the audit's row conservation intact
+# (rows observed by the shadow == rows_in, loss included). The
+# occupancy profiler must export a Perfetto-loadable timeline.
+python - <<'EOF'
+import json
+import numpy as np
+from deepflow_tpu.replay.generator import SyntheticAgent
+from deepflow_tpu.runtime.faults import default_faults
+from deepflow_tpu.runtime.profiler import default_profiler
+from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+from deepflow_tpu.runtime.tracing import default_tracer
+
+tr = default_tracer(); tr.enable()
+agent = SyntheticAgent(seed=0xC0FFEE)
+cols = agent.l4_columns_pooled(60000, pool=512)
+exp = TpuSketchExporter(store=None, window_seconds=3600, batch_rows=4096,
+                        wire="lanes", prefetch_depth=2,
+                        coalesce_batches=2, audit_rate=1.0)
+for i in range(0, 60000, 10000):
+    exp.process([("l4_flow_log", 0,
+                  {k: v[i:i+10000] for k, v in cols.items()})])
+exp.flush_window()
+a = exp._audit
+snap = a.last_window
+assert snap["topk_recall"] >= 0.9, snap
+assert tr.gauges()["tpu_audit_topk_recall"] >= 0.9
+assert not snap["violation"] and not a.alarm, snap
+assert snap["cms_rel_error"] <= a.cms_eps_theory, snap
+assert a.rows_seen_total == exp.rows_in == 60000
+
+# degraded window: inject device errors, lane falls to the host
+# fallback; the audit keeps counting every row and tags the window
+f = default_faults()
+sites = f.arm_spec("tpu.device_error:count=2;seed=3")
+exp.degrade_after = 1
+more = agent.l4_columns_pooled(30000, pool=512)
+for i in range(0, 30000, 10000):
+    exp.process([("l4_flow_log", 0,
+                  {k: v[i:i+10000] for k, v in more.items()})])
+assert exp._feed.drain(30)
+assert exp.device_errors >= 1 and exp.degraded, exp.counters()
+exp.flush_window()
+for s in sites:
+    f.disarm(s)
+assert a.degraded_windows >= 1 and a.last_window["degraded"]
+assert not a.alarm and a._violations == 0      # tagged, never alarmed
+assert a.rows_seen_total == exp.rows_in == 90000, (
+    f"audit conservation broken: {a.rows_seen_total} != {exp.rows_in}")
+trace = default_profiler().to_chrome_trace()
+xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert xs and all({"ts", "dur", "pid", "tid"} <= set(e) for e in xs)
+json.dumps(trace)
+busy = default_profiler().busy_fraction()
+exp.close(); tr.disable()
+print(f"audit OK: recall {snap['topk_recall']}, cms_err "
+      f"{snap['cms_rel_error']:.2e} (eps {a.cms_eps_theory:.2e}), "
+      f"hll_err {snap['hll_rel_error']:.4f}, {a.degraded_windows} "
+      f"degraded window(s) audited, conservation 90000/90000, "
+      f"{len(xs)} trace events, device busy {busy:.2f}")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax
@@ -393,6 +465,10 @@ for lane in ("packed", "dict"):
     assert sb["h2d_mb_s"] > 0 and sb["kernel_records_per_sec"] > 0, sb
 # the degraded-mode floor must be measured, not asserted by docstring
 assert d["stage_breakdown"]["host_fallback"]["records_per_sec"] > 0
+# the audit overhead must be measured too (ISSUE 6 acceptance: <5% on
+# TPU at the default rate; CPU smoke only asserts the measurement runs)
+audit = d["stage_breakdown"]["audit"]
+assert audit["records_per_sec"] > 0 and 0 <= audit["overhead_frac"] <= 1
 print("bench smoke OK:", d["value"], "rec/s (CPU small),",
       "dict kernel", d["stage_breakdown"]["dict"]["kernel_records_per_sec"],
       "rec/s")

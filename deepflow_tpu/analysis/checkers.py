@@ -170,15 +170,22 @@ class EmitUnderLock(Checker):
 
 
 _DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
-                         "runtime/feed.py")
+                         "runtime/feed.py", "runtime/audit.py",
+                         "runtime/profiler.py")
 # the sampled-drain helpers where a blocking sync is the point: explicit
 # attribution drains on every Nth batch / cold compile (PR 1), the
-# degraded-mode device probe (PR 2), and the overlapped feed's
+# degraded-mode device probe (PR 2), the overlapped feed's
 # bounded-window fence — the ONE place the prefetch pipeline may block
-# on the device (ISSUE 5; feed.py _fence_one / the error-path discard)
+# on the device (ISSUE 5; feed.py _fence_one / the error-path discard) —
+# and the accuracy observatory's window close (ISSUE 6; audit.py
+# close_window/_compare materialize window-output leaves at the same
+# boundary flush_window already fetches them; everything else in
+# audit.py/profiler.py must stay host-pure, which is why they are under
+# this rule at all)
 _SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
                                "_probe_device_locked", "_fence_one",
-                               "_discard_inflight"])
+                               "_discard_inflight", "close_window",
+                               "_compare"])
 
 
 @register

@@ -374,9 +374,38 @@ def cmd_trace(args) -> int:
                 for st, v in sorted(data["stages"].items())],
                ["STAGE", "COUNT", "P50_MS", "P95_MS", "P99_MS",
                 "MAX_MS", "MEAN_MS"])
+        occ = data.get("occupancy")
+        if occ:
+            # the continuous occupancy profiler's verdict (ISSUE 6):
+            # is the chip busy, and who is at fault when it isn't
+            print()
+            _table([[occ.get("device_busy_fraction", 0.0),
+                     occ.get("feed_overlap_efficiency", 0.0),
+                     occ.get("feed_stall_seconds", 0.0)]],
+                   ["DEVICE_BUSY_FRAC", "FEED_OVERLAP_EFF",
+                    "FEED_STALL_S"])
+        return 0
+    if args.action == "export":
+        # occupancy timeline -> Chrome-trace/Perfetto JSON (loads in
+        # ui.perfetto.dev / chrome://tracing)
+        out = debug_request("trace-export", port=port,
+                            limit=args.count or 350)
+        if not out.get("ok"):
+            print(f"error: {out.get('error')}", file=sys.stderr)
+            return 1
+        doc = out["data"]["trace"]
+        body = json.dumps(doc)
+        if args.out and args.out != "-":
+            with open(args.out, "w") as f:
+                f.write(body)
+            print(f"wrote {len(doc['traceEvents'])} events "
+                  f"({out['data']['spans_recorded']} spans recorded) "
+                  f"to {args.out}")
+        else:
+            print(body)
         return 0
     if args.action == "spans":
-        req = {"count": args.count}
+        req = {"count": args.count or 20}
         if args.stage:
             req["stage"] = args.stage
         if args.slow_ms is not None:
@@ -666,19 +695,27 @@ def build_parser() -> argparse.ArgumentParser:
                         help="l7 trace expansion + the ingester flight "
                              "recorder (latency/spans/rrt)")
     tr.add_argument("action", nargs="?", default="expand",
-                    choices=["expand", "latency", "spans", "rrt"],
+                    choices=["expand", "latency", "spans", "rrt",
+                             "export"],
                     help="expand = assemble an l7 trace from --id; "
-                         "latency = per-stage p50/p95/p99 tables; "
+                         "latency = per-stage p50/p95/p99 tables + "
+                         "occupancy row; "
                          "spans = recent (slow) batch spans; "
-                         "rrt = TPU transfer/kernel attribution")
+                         "rrt = TPU transfer/kernel attribution; "
+                         "export = occupancy timeline as Chrome-trace/"
+                         "Perfetto JSON")
     tr.add_argument("--id", type=int, default=None,
                     help="seed l7_flow_log row _id (expand)")
     tr.add_argument("--stage", help="stage filter (latency prefix / "
                                     "spans exact)")
-    tr.add_argument("--count", type=int, default=20,
-                    help="spans: max spans to list")
+    tr.add_argument("--count", type=int, default=None,
+                    help="spans: max spans to list (default 20); "
+                         "export: max events (default and cap 350 — "
+                         "the one-datagram budget)")
     tr.add_argument("--slow-ms", type=float, default=None,
                     help="spans: only spans slower than this")
+    tr.add_argument("--out", default="-",
+                    help="export: output file ('-' = stdout)")
     tr.set_defaults(fn=cmd_trace)
 
     ln = sub.add_parser(

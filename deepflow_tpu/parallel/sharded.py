@@ -122,6 +122,22 @@ class _ShardedSuiteBase:
         self._suite = type(self).__name__
         self._attrib_every = 16
         self._puts_traced = 0
+        # accuracy observatory hook (runtime/audit.py): an attached
+        # ShadowAuditor mirrors host batches before transfer and is
+        # closed against the MERGED window output at flush — so the
+        # future pod-merged sketch path (ROADMAP item 1) inherits the
+        # same exact-shadow audit the single-chip exporter runs, with
+        # per-shard sampled-row attribution (construct the auditor with
+        # shards=n_devices).
+        self._auditor = None
+        from deepflow_tpu.runtime.profiler import default_profiler
+        self._prof = default_profiler()
+
+    def attach_auditor(self, auditor) -> None:
+        """Attach a ShadowAuditor; host-side only (device-placed
+        batches are skipped, counted in audit_device_skipped)."""
+        self._auditor = auditor
+        self.audit_device_skipped = 0
 
     def _shard(self, fn, in_specs, out_specs):
         return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
@@ -133,6 +149,25 @@ class _ShardedSuiteBase:
                                self._state_sharding)
 
     def put_batch(self, cols: Dict, mask) -> Tuple[Dict, jnp.ndarray]:
+        if self._auditor is not None:
+            import numpy as _np
+            needed = ("ip_src", "ip_dst", "port_src", "port_dst",
+                      "proto", "packet_tx", "packet_rx")
+            # host-side only: a batch already living on device would
+            # cost a D2H fetch to mirror — skipped and counted instead
+            # of silently bending the host-only audit rule
+            if all(isinstance(cols.get(k), _np.ndarray) for k in needed) \
+                    and isinstance(mask, _np.ndarray):
+                # the device excludes masked (padding) rows; so must
+                # the shadow, or the exact counts drift per batch and
+                # the alarm fires on its own bookkeeping
+                m = mask.astype(bool, copy=False)
+                if m.all():
+                    self._auditor.absorb({k: cols[k] for k in needed})
+                else:
+                    self._auditor.absorb({k: cols[k][m] for k in needed})
+            else:
+                self.audit_device_skipped += 1
         tr = self._tracer
         if not tr.enabled:
             return _put_sharded(cols, mask, self._batch_sharding)
@@ -155,15 +190,28 @@ class _ShardedSuiteBase:
         tr = self._tracer
         if not tr.enabled:
             return self._update(state, cols, mask)
+        import time as _time
+        t0 = _time.perf_counter()
         with tr.span("shard.update", stream=self._suite):
-            return self._update(state, cols, mask)
+            out = self._update(state, cols, mask)
+        self._prof.record("dispatch", f"shard:{self._suite}",
+                          _time.perf_counter() - t0)
+        return out
 
     def flush(self, state):
         tr = self._tracer
         if not tr.enabled:
-            return self._flush(state)
-        with tr.span("shard.flush", stream=self._suite):
-            return self._flush(state)
+            res = self._flush(state)
+        else:
+            with tr.span("shard.flush", stream=self._suite):
+                res = self._flush(state)
+        if self._auditor is not None and isinstance(res, tuple) \
+                and len(res) == 2 and hasattr(res[1], "topk_keys"):
+            # merged window output vs the exact shadow — the audit the
+            # merged-sketch path inherits (close_window materializes
+            # the output leaves, its sanctioned sync)
+            self._auditor.close_window(res[1])
+        return res
 
 
 class ShardedFlowSuite(_ShardedSuiteBase):

@@ -57,6 +57,15 @@ class IngesterConfig:
     # amortizing per-dispatch overhead that dominates at small
     # batch_rows; 1 = one dispatch per batch (still coalesced)
     coalesce_batches: int = 1
+    # -- accuracy observatory (runtime/audit.py, ISSUE 6) -------------
+    # deterministic flow-hash sampled exact shadow of the tpu_sketch
+    # lane: exact per-key counts / distinct count / entropy for the
+    # sampled slice, compared against the device sketch at every window
+    # close — observed error, epsilon headroom and top-K recall land on
+    # /metrics as gauges plus the tpu_sketch_accuracy Countable family,
+    # and a sustained bound violation trips an alarm on /healthz.
+    # Host-side only, bit-invisible to the sketch path. 0 disables.
+    audit_sample_rate: float = 1.0 / 64
     # per-service RED windows from the l7 stream (runtime/app_red.py);
     # None disables, a float sets window seconds
     app_red_window_s: Optional[float] = None
@@ -189,7 +198,8 @@ class Ingester:
                 store=self.store, window_seconds=cfg.tpu_sketch_window_s,
                 checkpoint_dir=ckpt_dir, stats=self.stats,
                 prefetch_depth=cfg.prefetch_depth,
-                coalesce_batches=cfg.coalesce_batches)
+                coalesce_batches=cfg.coalesce_batches,
+                audit_rate=cfg.audit_sample_rate)
             self.exporters.register(self.tpu_sketch)
         self.app_red = None
         if cfg.app_red_window_s is not None:
@@ -278,16 +288,22 @@ class Ingester:
                          if c["state"] == "open"]
         degraded = bool(self.tpu_sketch is not None
                         and self.tpu_sketch.degraded)
+        # accuracy observatory (ISSUE 6): sustained observed-error-
+        # over-bound windows trip a breaker-style alarm — the lane is
+        # up but its ANSWERS are suspect, which a probe must see
+        accuracy_alarm = bool(self.tpu_sketch is not None
+                              and self.tpu_sketch.audit_alarm)
         draining = self._drain_state != "running"
         return {
             "ok": not (sup["stale"] or open_breakers or degraded
-                       or draining),
+                       or accuracy_alarm or draining),
             "drain": self._drain_state,
             "stale_threads": sup["stale"],
             "crashes": sup["crashes"],
             "restarts": sup["restarts"],
             "open_breakers": open_breakers,
             "degraded_tpu_sketch": degraded,
+            "accuracy_alarm": accuracy_alarm,
         }
 
     def _spill_cmd(self, req: dict) -> dict:

@@ -39,6 +39,7 @@ class DebugServer:
             # with its own registration — same shape, same command)
             "supervisor": self._supervisor,
             "lint": self._lint,
+            "trace-export": self._trace_export,
         }
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
@@ -64,11 +65,32 @@ class DebugServer:
     def _latency(self, req: dict) -> dict:
         """Flight-recorder per-stage latency quantiles (the `deepflow-ctl
         ingester rrt`-family backing data). `module` prefix-filters
-        stage names."""
+        stage names. `occupancy` carries the continuous profiler
+        reductions (device-busy fraction, feed-overlap efficiency,
+        cumulative feed stall) for the CLI's occupancy columns."""
+        from deepflow_tpu.runtime.profiler import default_profiler
+
         want = req.get("module") or ""
         return {"enabled": self.tracer.enabled,
                 "stages": {k: v for k, v in self.tracer.latency().items()
-                           if k.startswith(want)}}
+                           if k.startswith(want)},
+                "occupancy": default_profiler().occupancy()}
+
+    @staticmethod
+    def _trace_export(req: dict) -> dict:
+        """The occupancy profiler's span ring as a Chrome-trace /
+        Perfetto JSON timeline (`df-ctl trace export`). `limit` caps
+        the newest events so the reply fits the one-datagram budget:
+        a serialized X event runs ~130-145B (epoch-microsecond floats
+        are 18-19 chars), so 350 events + track metadata + the
+        occupancy wrapper stays comfortably under 65000B."""
+        from deepflow_tpu.runtime.profiler import default_profiler
+
+        limit = max(0, min(int(req.get("limit", 350)), 350))
+        prof = default_profiler()
+        return {"trace": prof.to_chrome_trace(limit=limit),
+                "spans_recorded": prof.counters()["spans"],
+                "occupancy": prof.occupancy()}
 
     def _spans(self, req: dict) -> dict:
         """Recent completed spans from the ring, newest first. Options:

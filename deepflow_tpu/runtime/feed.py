@@ -50,6 +50,7 @@ import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
+from deepflow_tpu.runtime.profiler import default_profiler
 from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.runtime.tracing import default_tracer
 
@@ -133,6 +134,11 @@ class DeviceFeed:
         self._queued_batches = 0
         self._active_batches = 0   # group inside process_group right now
         self._tracer = default_tracer()
+        # occupancy profiler (runtime/profiler.py): feed/fence/device
+        # spans at group granularity — the dispatch->fence interval is
+        # what tpu_device_busy_fraction unions, and idle q.get waits
+        # with an empty window are the feed-stall (starved device) time
+        self._prof = default_profiler()
         # counters (surfaced through the owner's Countable)
         self.groups = 0
         self.batches = 0
@@ -200,11 +206,19 @@ class DeviceFeed:
         if self._active is not None or self._inflight:
             self._recover_after_crash()
         while True:
+            t0 = time.perf_counter()
             try:
                 item = self._q.get(timeout=0.2)
             except _queue.Empty:
                 sup.beat()
                 continue
+            if not self._inflight:
+                # the device sat with an empty window until this work
+                # arrived: genuine host starvation — the gap PRECEDING
+                # real work. A pipeline that is simply idle (no traffic
+                # at all) accrues nothing: empty polls don't count, so
+                # the gauge stays a culprit signal, not an uptime clock.
+                self._prof.add_stall(time.perf_counter() - t0)
             sup.beat()
             if item[0] != "batch":
                 if self._handle_control(item):
@@ -247,19 +261,28 @@ class DeviceFeed:
         # owner's process_group contains everything it understands
         # (device errors, degraded fallback); what's left is a bug whose
         # group must be recovered on restart, not guessed at here
+        t0 = time.perf_counter()
         out = self._process_group(group)
+        t1 = time.perf_counter()
+        rows = sum(int(getattr(tb, "valid", 0)) for tb, _ in group)
+        self._prof.record("feed", f"group[{len(group)}]", t1 - t0,
+                          rows=rows)
         self._active = None
         self.groups += 1
         self.batches += len(group)
         if out is not None:
-            self._inflight.append(out)
+            # the dispatch timestamp rides beside the fence: when the
+            # fence retires, [dispatch, retire] is the device-execution
+            # interval the busy-fraction gauge unions
+            self._inflight.append((out, t1))
             while len(self._inflight) > self.depth:
-                self._fence_one(self._inflight.popleft())
+                self._fence_one(*self._inflight.popleft())
         with self._pending_lock:       # after the in-flight append: the
             self._active_batches = 0   # count may overlap, never gap
         self._maybe_gauges()
 
-    def _fence_one(self, f: InFlight) -> None:
+    def _fence_one(self, f: InFlight,
+                   t_dispatch: Optional[float] = None) -> None:
         """Wait for one dispatched update to retire (the sanctioned
         blocking sync of this module: the bounded-window fence). An
         error here is an ASYNC device failure — the donated state chain
@@ -279,14 +302,22 @@ class DeviceFeed:
             if self._on_fence_error is not None:
                 self._on_fence_error(e, f.rows + extra)
             return
-        self.fence_wait_s += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self.fence_wait_s += t1 - t0
         self.fences += 1
+        self._prof.record("fence", "wait", t1 - t0, rows=f.rows)
+        if t_dispatch is not None:
+            # dispatch -> retirement brackets the program's device
+            # execution: the fence can only ack after completion, and
+            # the bounded window keeps retirement close behind it
+            self._prof.record("device", "update", t1 - t_dispatch,
+                              rows=f.rows)
         if f.release is not None:
             f.release()
 
     def _fence_all(self) -> None:
         while self._inflight:
-            self._fence_one(self._inflight.popleft())
+            self._fence_one(*self._inflight.popleft())
 
     def _discard_inflight(self) -> int:
         """Drop every outstanding fence, swallowing their (expected)
@@ -294,7 +325,7 @@ class DeviceFeed:
         the loss in one place."""
         rows = 0
         while self._inflight:
-            f = self._inflight.popleft()
+            f, _t = self._inflight.popleft()
             rows += f.rows
             try:
                 if f.fence is not None:

@@ -67,9 +67,13 @@ def _labels(d: Dict[str, str]) -> str:
 
 def render_metrics(stats: Optional[StatsRegistry],
                    tracer: Optional[Tracer],
-                   bucket_stride: int = 64) -> str:
-    """One scrape: collect Countables + tracer state, render text
-    exposition format (version 0.0.4)."""
+                   bucket_stride: int = 64,
+                   profiler=None) -> str:
+    """One scrape: collect Countables + tracer state + the occupancy
+    profiler's continuous gauges, render text exposition format
+    (version 0.0.4). `profiler` defaults to the process profiler
+    (runtime/profiler.py) so ``tpu_device_busy_fraction`` /
+    ``tpu_feed_stall_seconds`` are freshly computed per scrape."""
     lines: List[str] = []
     typed: set = set()
 
@@ -123,13 +127,24 @@ def render_metrics(stats: Optional[StatsRegistry],
                 f"{_fmt(total)}")
             lines.append(f"{hname}_sum{_labels(lbl)} {repr(sum_)}")
             lines.append(f"{hname}_count{_labels(lbl)} {_fmt(total)}")
-        from deepflow_tpu.runtime.tracing import GAUGE_HELP
+        from deepflow_tpu.runtime.tracing import gauge_help
         for name, value in sorted(tracer.gauges().items()):
             _sample(_metric_name("deepflow_trace", name), {}, value,
-                    mtype="gauge", help_=GAUGE_HELP.get(name, ""))
+                    mtype="gauge", help_=gauge_help(name))
         _sample("deepflow_trace_spans_total", {},
                 float(tracer.spans_recorded), mtype="counter",
                 help_="spans recorded by the flight recorder")
+
+    if profiler is None:
+        from deepflow_tpu.runtime.profiler import default_profiler
+        profiler = default_profiler()
+    from deepflow_tpu.runtime.profiler import PROFILER_GAUGE_HELP
+    for name, value in sorted(profiler.gauges().items()):
+        _sample(_metric_name("deepflow_profiler", name), {}, value,
+                mtype="gauge", help_=PROFILER_GAUGE_HELP.get(name, ""))
+    _sample("deepflow_profiler_spans_total", {},
+            float(profiler.spans_recorded), mtype="counter",
+            help_="spans recorded into the occupancy ring")
 
     return "\n".join(lines) + "\n"
 
@@ -160,7 +175,9 @@ def _label_key(labels: str) -> tuple:
 def validate_exposition(text: str) -> List[str]:
     """Strict text-format (0.0.4) checker. Returns a list of problems
     (empty = valid). Enforced beyond the line grammar: body ends with a
-    newline, TYPE precedes its samples and appears once, histogram
+    newline, TYPE precedes its samples and appears once, every
+    gauge-typed metric carries HELP text (a gauge a scraper can't
+    explain is a gauge nobody will trust during an incident), histogram
     series carry a +Inf bucket whose value equals their _count, and
     bucket counts are non-decreasing in le order."""
     problems: List[str] = []
@@ -170,13 +187,18 @@ def validate_exposition(text: str) -> List[str]:
         problems.append("body must end with a newline")
     types: Dict[str, str] = {}
     seen_samples: set = set()
+    helped: set = set()
+    gauge_lines: Dict[str, int] = {}   # gauge-typed name -> TYPE line
     # histogram accounting: (base_name, labels-sans-le) -> state
     hist: Dict[tuple, dict] = {}
     for ln, line in enumerate(text.split("\n")[:-1], 1):
         if line == "":
             continue
         if line.startswith("#"):
-            if _HELP_RE.match(line):
+            h = _HELP_RE.match(line)
+            if h:
+                if h.group(2).strip():
+                    helped.add(h.group(1))
                 continue
             m = _TYPE_RE.match(line)
             if not m:
@@ -188,6 +210,8 @@ def validate_exposition(text: str) -> List[str]:
             if name in seen_samples:
                 problems.append(
                     f"line {ln}: TYPE for {name} after its samples")
+            if m.group(2) == "gauge":
+                gauge_lines[name] = ln
             types[name] = m.group(2)
             continue
         m = _SAMPLE_RE.match(line)
@@ -223,6 +247,11 @@ def validate_exposition(text: str) -> List[str]:
                     h["last"] = v
             elif name.endswith("_count"):
                 h["count"] = float(value)
+    # checked after the full pass: the format does not mandate
+    # HELP-before-TYPE order, so a HELP arriving later still counts
+    for name, ln in sorted(gauge_lines.items(), key=lambda kv: kv[1]):
+        if name not in helped:
+            problems.append(f"line {ln}: gauge {name} lacks HELP text")
     for (base, labels), h in hist.items():
         if h["inf"] is None:
             problems.append(f"histogram {base}{labels}: no +Inf bucket")

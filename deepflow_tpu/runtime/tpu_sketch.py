@@ -184,6 +184,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                  wire: str = "dict",
                  prefetch_depth: int = 0,
                  coalesce_batches: int = 1,
+                 audit_rate: float = 0.0,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
                          batch=64, stats=stats)
@@ -361,6 +362,21 @@ class TpuSketchExporter(QueueWorkerExporter):
                 coalesce=self.coalesce_batches,
                 on_fence_error=self._feed_fence_error,
                 on_restart=self._feed_crash_restart)
+        # -- accuracy observatory (runtime/audit.py, ISSUE 6) --------------
+        # deterministic flow-hash sampled exact shadow, compared against
+        # the sketch at every window close. Host-side only and
+        # bit-invisible to the device path (tests assert state equality
+        # with the audit on/off); degraded/lossy windows are audited too,
+        # tagged instead of alarmed on. 0 disables.
+        from deepflow_tpu.runtime.profiler import default_profiler
+        self._prof = default_profiler()
+        self._audit = None
+        self.audit_rate = max(0.0, float(audit_rate))
+        if self.audit_rate > 0:
+            from deepflow_tpu.runtime.audit import ShadowAuditor
+            self._audit = ShadowAuditor(self.cfg, rate=self.audit_rate)
+            if stats is not None:
+                stats.register("tpu_sketch_accuracy", self._audit.counters)
 
     # -- exporter lifecycle ------------------------------------------------
     def start(self) -> None:
@@ -410,6 +426,13 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # which every flush drains first), so rows_in is a
                 # processed-watermark, not an arrival count
                 self.rows_in += len(next(iter(schema_cols.values())))
+                if self._audit is not None:
+                    # exact-shadow mirror at the SAME boundary rows_in
+                    # moves: the audit window and the sketch window see
+                    # the identical row set (flush drains batcher+feed
+                    # under this lock before closing both). Host numpy
+                    # only — the device path never sees the audit.
+                    self._audit.absorb(schema_cols)
 
     def _submit_batch_locked(self, tb: TensorBatch) -> None:
         """One emitted TensorBatch onto the device path: inline
@@ -445,6 +468,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         dev.block_until_ready()
         dt = time.perf_counter() - t0
         tr.observe("kernel.h2d", dt, stream=self.wire, rows=rows)
+        self._prof.record("h2d", self.wire, dt, rows=rows)
         if dt > 0:
             tr.gauge("tpu_h2d_mb_s", host_array.nbytes / 1e6 / dt)
         return dev
@@ -473,9 +497,18 @@ class TpuSketchExporter(QueueWorkerExporter):
             self._warm.add(key)
             tr.observe("kernel.compile", t2 - t0, stream=key)
             tr.gauge(f"tpu_compile_s_{key}", t2 - t0)
+            self._prof.record("device", f"compile:{key}", t2 - t0)
         else:
             tr.observe("kernel.dispatch", t1 - t0, stream=key)
             tr.observe("kernel.device", t2 - t1, stream=key)
+            # sampled occupancy evidence for the inline path (the feed
+            # path's fence intervals are the continuous signal). The
+            # dispatch span ENDED a device-execution ago — anchor its
+            # wall-clock end back so the exported timeline shows
+            # dispatch preceding device, not stacked on top of it.
+            self._prof.record("dispatch", key, t1 - t0,
+                              t_end=time.time() - (t2 - t1))
+            self._prof.record("device", key, t2 - t1)
         return out
 
     def _run_batch_locked(self, tb: TensorBatch) -> None:
@@ -801,6 +834,13 @@ class TpuSketchExporter(QueueWorkerExporter):
         waiting while rows are in flight."""
         return 0 if self._feed is None else self._feed.pending()
 
+    @property
+    def audit_alarm(self) -> bool:
+        """Accuracy-observatory alarm: observed sketch error exceeded
+        its theoretical bound for N consecutive clean windows
+        (runtime/audit.py). Ingester.health surfaces it on /healthz."""
+        return self._audit is not None and self._audit.alarm
+
     # one entry per distinct sampled flow key: (ip_src, ip_dst,
     # port_src, port_dst, proto). Sized well above ring_size so standing
     # heavy hitters stay resolvable across windows.
@@ -869,6 +909,7 @@ class TpuSketchExporter(QueueWorkerExporter):
 
     def _flush_window_inner(self, now: float) -> Optional[
             flow_suite.FlowWindowOutput]:
+        t_flush = time.perf_counter()
         with self._state_lock:
             for tb in self.batcher.flush():
                 self._submit_batch_locked(tb)
@@ -883,6 +924,7 @@ class TpuSketchExporter(QueueWorkerExporter):
                         "feed drain timed out; window flushed against "
                         "a possibly-advancing state")
             self.windows += 1
+            was_degraded = self.degraded
             if self.degraded:
                 # host fallback window: reduced-fidelity output, then
                 # probe the device for recovery
@@ -913,10 +955,22 @@ class TpuSketchExporter(QueueWorkerExporter):
                     # classification + recovery as a batch failure
                     self._on_device_error_locked(0)
                     out = None
+            if self._audit is not None:
+                # accuracy observatory: compare the settled window
+                # against the exact shadow AT the window boundary (same
+                # lock, after the drain barrier — the shadow and the
+                # sketch saw the identical row set). A window with
+                # counted loss or on the degraded lane is audited too,
+                # tagged instead of alarmed on.
+                self._audit.close_window(
+                    out, degraded=was_degraded,
+                    lossy=self._window_lost_counted)
             # the lost-window guard resets at the TRUE window boundary —
             # after the flush attempt — so a window where both a
             # replayed batch and the readback die counts ONCE
             self._window_lost_counted = False
+        self._prof.record("window", "flush",
+                          time.perf_counter() - t_flush)
         if out is None:
             return None
         self.last_output = out
@@ -998,4 +1052,9 @@ class TpuSketchExporter(QueueWorkerExporter):
             c.update(self._feed.counters())
         if self.checkpointer is not None:
             c.update(self.checkpointer.counters())
+        if self._audit is not None:
+            # headline verdicts only — the full family is the separate
+            # `tpu_sketch_accuracy` Countable (runtime/audit.py)
+            c["audit_alarm"] = 1 if self._audit.alarm else 0
+            c["audit_windows"] = self._audit.windows
         return c

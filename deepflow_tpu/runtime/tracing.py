@@ -44,13 +44,16 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["HostDDSketch", "Tracer", "default_tracer", "GAUGE_HELP"]
+__all__ = ["HostDDSketch", "Tracer", "default_tracer", "GAUGE_HELP",
+           "gauge_help"]
 
 # HELP strings for the well-known tracer gauges (rendered into the
-# Prometheus exposition by runtime/promexpo.py). The ISSUE 5 feed
-# gauges live here so a scrape explains itself: transfers_per_batch is
-# the coalescing-regression signal (a slide back to per-plane
-# device_puts reads > 1), overlap_efficiency the device-busy proxy.
+# Prometheus exposition by runtime/promexpo.py, whose strict checker now
+# FAILS any gauge without one — a scrape must explain itself). The
+# ISSUE 5 feed gauges: transfers_per_batch is the coalescing-regression
+# signal (a slide back to per-plane device_puts reads > 1),
+# overlap_efficiency the device-busy proxy. The ISSUE 6 audit gauges
+# are the accuracy observatory's window verdicts (runtime/audit.py).
 GAUGE_HELP: Dict[str, str] = {
     "tpu_h2d_mb_s": "sampled host->device transfer rate of the sketch "
                     "lane (blocking measurement every Nth batch)",
@@ -64,7 +67,52 @@ GAUGE_HELP: Dict[str, str] = {
                                    "(~1 = chip-bound, ~0 = host-bound)",
     "tpu_feed_inflight": "dispatched-but-unfenced updates in the "
                          "prefetch window",
+    "mesh_h2d_mb_s": "sampled host->device transfer rate of the "
+                     "sharded mesh lane (blocking measurement every "
+                     "Nth put_batch)",
+    "tpu_audit_cms_rel_error": "observed CMS point-estimate error on "
+                               "audited heavy hitters, relative to the "
+                               "window's row count (exact shadow)",
+    "tpu_audit_cms_eps_headroom": "theoretical CMS epsilon (e/width) "
+                                  "minus the observed error; negative "
+                                  "= out of bound",
+    "tpu_audit_hll_rel_error": "observed HLL cardinality error vs the "
+                               "distinct-sampled exact shadow",
+    "tpu_audit_hll_eps_headroom": "HLL error bound (sketch epsilon + "
+                                  "shadow sampling noise) minus the "
+                                  "observed error; negative = out of "
+                                  "bound",
+    "tpu_audit_entropy_abs_error": "max abs difference between device "
+                                   "and exact-shadow normalized "
+                                   "entropy across the 4 features",
+    "tpu_audit_topk_recall": "fraction of the shadow's exact top "
+                             "ceil(rate*K) sampled keys present in the "
+                             "device top-K output",
+    "tpu_audit_sampled_keys": "distinct flow keys in the exact shadow "
+                              "at the last window close",
+    "tpu_audit_degraded_window": "1 when the last audited window ran "
+                                 "on the degraded host-fallback lane",
 }
+
+# dynamically-named gauges get HELP by prefix (one entry documents the
+# whole family; promexpo resolves through gauge_help below)
+GAUGE_HELP_PREFIXES: Dict[str, str] = {
+    "tpu_compile_s_": "first-call XLA compile seconds of the named "
+                      "update program (cold compiles attributed apart "
+                      "from steady-state kernel quantiles)",
+}
+
+
+def gauge_help(name: str) -> str:
+    """HELP text for a tracer gauge: exact entry, then prefix family,
+    else empty (which the strict exposition checker flags)."""
+    text = GAUGE_HELP.get(name)
+    if text is not None:
+        return text
+    for prefix, ptext in GAUGE_HELP_PREFIXES.items():
+        if name.startswith(prefix):
+            return ptext
+    return ""
 
 
 class HostDDSketch:

@@ -1,0 +1,518 @@
+"""ISSUE 6: the accuracy observatory + occupancy profiler.
+
+The contracts under test: the exact shadow samples DETERMINISTICALLY by
+flow-key hash (same keys after any restart or re-chunking), its exact
+answers agree with the device sketch within the theoretical bounds on a
+seeded stream, the audit lane is BIT-INVISIBLE to the sketch path
+(state identical with the audit on/off), the bound-violation alarm
+trips and clears breaker-style, and the profiler's bounded ring exports
+a schema-valid Chrome-trace/Perfetto timeline."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.models import flow_suite
+from deepflow_tpu.models.flow_suite import FlowSuiteConfig, FlowWindowOutput
+from deepflow_tpu.replay.generator import SyntheticAgent
+from deepflow_tpu.runtime.audit import ShadowAuditor
+from deepflow_tpu.runtime.faults import default_faults
+from deepflow_tpu.runtime.profiler import OccupancyProfiler, default_profiler
+from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+from deepflow_tpu.runtime.tracing import default_tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    default_faults().disarm()
+    yield
+    default_faults().disarm()
+
+
+def _stream(n=40000, pool=512, seed=0xC0FFEE):
+    """Pooled Zipf stream: heavy hitters genuinely repeat, so exact
+    top-K is well-defined (the recall-harness feed)."""
+    return SyntheticAgent(seed=seed).l4_columns_pooled(n, pool=pool)
+
+
+def _chunks(cols, rows=8000):
+    n = len(next(iter(cols.values())))
+    return [{k: v[i:i + rows] for k, v in cols.items()}
+            for i in range(0, n, rows)]
+
+
+def _exporter(audit_rate, **kw):
+    kw.setdefault("wire", "lanes")
+    return TpuSketchExporter(store=None, window_seconds=3600,
+                             batch_rows=4096, audit_rate=audit_rate, **kw)
+
+
+# ---------------------------------------------------- sampler determinism
+
+def test_sampler_deterministic_across_restarts():
+    """The flow-hash sample admits the SAME keys with the SAME exact
+    counts regardless of process lifetime or chunking — a restarted
+    auditor over a replayed stream rebuilds the identical shadow."""
+    cfg = FlowSuiteConfig()
+    cols = _stream(20000)
+    a = ShadowAuditor(cfg, rate=0.25)
+    b = ShadowAuditor(cfg, rate=0.25)       # the "restarted" process
+    for c in _chunks(cols, rows=5000):
+        a.absorb(c)
+    for c in _chunks(cols, rows=1777):      # different chunking
+        b.absorb(c)
+    assert a._counts and a._counts == b._counts
+    assert a._clients == b._clients
+    np.testing.assert_array_equal(a._ent, b._ent)
+    # and the sample is a sample, not everything
+    total_keys = len(np.unique(np.concatenate(
+        [np.atleast_1d(v) for v in [c["ip_src"] for c in [cols]]])))
+    assert 0 < len(a._counts)
+    assert a.sampled_rows_total < a.rows_seen_total
+
+
+def test_sample_rate_scales_admission():
+    cfg = FlowSuiteConfig()
+    cols = _stream(20000, pool=2048)
+    lo = ShadowAuditor(cfg, rate=1.0 / 16)
+    hi = ShadowAuditor(cfg, rate=1.0)
+    for c in _chunks(cols):
+        lo.absorb(c)
+        hi.absorb(c)
+    assert hi.sampled_rows_total == hi.rows_seen_total == 20000
+    # rate 1/16 admits roughly 1/16 of distinct keys (hash-uniform)
+    frac = len(lo._counts) / len(hi._counts)
+    assert 0.02 < frac < 0.2
+
+
+# ------------------------------------------- exact shadow vs live sketch
+
+def test_shadow_agrees_with_sketch_on_seeded_stream():
+    """Full-rate shadow vs the device sketch: CMS error within e/width,
+    HLL within its bound, entropy within the plug-in bound, top-K
+    recall >= 0.9, no violation — on a seeded Zipf stream."""
+    exp = _exporter(audit_rate=1.0)
+    for c in _chunks(_stream()):
+        exp.process([("l4_flow_log", 0, c)])
+    exp.flush_window()
+    snap = exp._audit.last_window
+    assert snap is not None and snap["rows_match"]
+    assert snap["cms_rel_error"] <= exp._audit.cms_eps_theory
+    assert snap["hll_rel_error"] <= snap["hll_eps_bound"]
+    assert snap["entropy_abs_error"] <= snap["entropy_bound"]
+    assert snap["topk_recall"] >= 0.9
+    assert not snap["violation"] and not exp._audit.alarm
+    exp.close()
+
+
+@pytest.mark.parametrize("wire,depth", [("lanes", 0), ("lanes", 2),
+                                        ("dict", 2)])
+def test_audit_is_bit_invisible_to_sketch_state(wire, depth):
+    """The acceptance bar: sketch state with the audit on is IDENTICAL
+    to the audit off, on both wires, with and without the feed."""
+    import jax
+
+    on = _exporter(1.0, wire=wire, prefetch_depth=depth)
+    off = _exporter(0.0, wire=wire, prefetch_depth=depth)
+    for c in _chunks(_stream(16000)):
+        on.process([("l4_flow_log", 0, c)])
+        off.process([("l4_flow_log", 0, c)])
+    for e in (on, off):
+        if e._feed is not None:
+            assert e._feed.drain(30)
+    for a, b in zip(jax.tree.leaves(on.state), jax.tree.leaves(off.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert on._audit.rows_seen_total == on.rows_in == off.rows_in
+    on.close()
+    off.close()
+
+
+def test_audit_conservation_through_degraded_mode():
+    """Every processed row is observed by the audit exactly once —
+    including rows that die on the device and rows absorbed by the
+    degraded host fallback — and the degraded window is audited,
+    tagged, and kept OUT of the alarm ladder."""
+    f = default_faults()
+    sites = f.arm_spec("tpu.device_error:count=2;seed=3")
+    try:
+        exp = _exporter(1.0)
+        exp.degrade_after = 1
+        sent = 0
+        for c in _chunks(_stream(24000)):
+            exp.process([("l4_flow_log", 0, c)])
+            sent += len(next(iter(c.values())))
+        assert exp.device_errors >= 1 and exp.degraded
+        exp.flush_window()
+        a = exp._audit
+        assert a.rows_seen_total == exp.rows_in == sent
+        assert a.degraded_windows >= 1
+        assert a.last_window["degraded"]
+        assert not a.alarm and a._violations == 0
+    finally:
+        for s in sites:
+            f.disarm(s)
+    exp.close()
+
+
+def test_lossy_window_tagged_not_alarmed():
+    """One device error inside a window: the loss is counted by the
+    exporter and the window's audit snapshot carries lossy=True (its
+    comparison is expected to disagree) without advancing the alarm."""
+    f = default_faults()
+    sites = f.arm_spec("tpu.device_error:count=1;seed=5")
+    try:
+        exp = _exporter(1.0)          # degrade_after=2: one error stays
+        for c in _chunks(_stream(24000)):   # on the device lane
+            exp.process([("l4_flow_log", 0, c)])
+        assert exp.device_errors == 1 and not exp.degraded
+        exp.flush_window()
+        snap = exp._audit.last_window
+        assert snap["lossy"] and exp._audit.lossy_windows == 1
+        assert exp._audit._violations == 0
+    finally:
+        for s in sites:
+            f.disarm(s)
+    exp.close()
+
+
+# --------------------------------------------------- alarm ladder (trip)
+
+def _window_out(cfg, keys, counts, card, ent, rows):
+    k = np.full(cfg.top_k, 0xFFFFFFFF, np.uint32)
+    c = np.full(cfg.top_k, -1, np.int32)
+    k[:len(keys)] = keys
+    c[:len(counts)] = counts
+    return FlowWindowOutput(
+        topk_keys=k, topk_counts=c,
+        service_cardinality=np.asarray([card], np.float32),
+        entropies=np.asarray(ent, np.float32),
+        rows=np.asarray(rows, np.int32))
+
+
+def test_alarm_trips_on_consecutive_violations_and_clears():
+    """Breaker-style: N consecutive bound violations trip the alarm
+    (surfaced on /healthz via the exporter property), M consecutive
+    in-bound windows clear it; a single bad window never trips."""
+    from deepflow_tpu.utils.u32 import fold_columns_np
+
+    cfg = FlowSuiteConfig()
+    a = ShadowAuditor(cfg, rate=1.0, trip_windows=3, clear_windows=2,
+                      min_sampled_rows=10)
+    cols = _stream(4000, pool=64)
+
+    def one_window(honest: bool):
+        for c in _chunks(cols, rows=4000):
+            a.absorb(c)
+        keys = np.array(sorted(a._counts, key=a._counts.get,
+                               reverse=True)[:cfg.top_k], np.uint64)
+        exact = np.array([a._counts[int(k)] for k in keys], np.int64)
+        dev = exact if honest else exact + 4000   # way past eps*N
+        # honest sibling numbers so only the CMS verdict varies
+        card = len(a._clients) / a.rate
+        h = a._ent.astype(np.float64)
+        tot = h.sum(axis=1, keepdims=True)
+        p = h / np.maximum(tot, 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xlogx = np.where(p > 0, p * np.log(p), 0.0)
+        ent = -xlogx.sum(axis=1) / np.log(a._buckets)
+        return a.close_window(_window_out(
+            cfg, keys.astype(np.uint32),
+            np.minimum(dev, 2**31 - 1).astype(np.int32),
+            card, ent, rows=4000))
+
+    assert not one_window(honest=True)["violation"]
+    assert one_window(honest=False)["violation"] and not a.alarm
+    one_window(honest=False)
+    assert not a.alarm                       # 2 consecutive: still armed
+    one_window(honest=False)
+    assert a.alarm and a.alarm_trips == 1    # 3rd consecutive: tripped
+    one_window(honest=True)
+    assert a.alarm                           # 1 healthy: not yet cleared
+    one_window(honest=True)
+    assert not a.alarm                       # 2 healthy: cleared
+
+
+def test_alarm_surfaces_on_healthz():
+    from deepflow_tpu.enrich.platform_data import PlatformDataManager
+    from deepflow_tpu.pipelines import Ingester, IngesterConfig
+
+    ing = Ingester(IngesterConfig(listen_port=0,
+                                  tpu_sketch_window_s=3600),
+                   platform=PlatformDataManager())
+    try:
+        assert ing.health()["accuracy_alarm"] is False
+        assert ing.tpu_sketch._audit is not None     # on by default
+        ing.tpu_sketch._audit.alarm = True
+        h = ing.health()
+        assert h["accuracy_alarm"] and not h["ok"]
+    finally:
+        ing.tpu_sketch._audit.alarm = False
+        ing.close()
+
+
+def test_shadow_key_cap_clips_and_tags():
+    cfg = FlowSuiteConfig()
+    a = ShadowAuditor(cfg, rate=1.0, max_keys=64)
+    rng = np.random.default_rng(9)
+    cols = {name: rng.integers(0, 1 << 20, 4000).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    a.absorb(cols)
+    assert a.evicted_keys > 0 and a._clipped
+    assert len(a._counts) <= 64
+    snap = a.close_window(None)
+    assert snap["clipped"] and a.clipped_windows == 1
+
+
+# -------------------------------------------------------------- profiler
+
+def test_profiler_ring_overflow_bounded():
+    p = OccupancyProfiler(ring=32)
+    for i in range(100):
+        p.record("device", f"s{i}", 0.001)
+    c = p.counters()
+    assert c["spans"] == 100 and c["dropped"] == 68
+    t = p.to_chrome_trace()
+    xs = [e for e in t["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 32                      # ring keeps the newest 32
+    assert xs[-1]["name"] == "s99"
+
+
+def test_profiler_busy_fraction_union_math():
+    import time as _time
+
+    p = OccupancyProfiler()
+    now = _time.time()
+    # two overlapping 1s intervals + one disjoint 1s interval over a
+    # 10s window anchored at the earliest span start -> 2s covered
+    p.record("device", "a", 1.0, t_end=now - 8.0)    # [-9, -8]
+    p.record("device", "b", 1.0, t_end=now - 8.5)    # [-9.5, -8.5] overlap
+    p.record("device", "c", 1.0, t_end=now - 2.0)    # [-3, -2]
+    frac = p.busy_fraction("device", horizon_s=30.0, now=now)
+    window = 9.5                                     # earliest start -> now
+    assert abs(frac - 2.5 / window) < 0.02
+    assert p.busy_fraction("feed", horizon_s=30.0, now=now) == 0.0
+    # stall accumulation
+    p.add_stall(0.5)
+    p.add_stall(0.25)
+    assert abs(p.gauges()["tpu_feed_stall_seconds"] - 0.75) < 1e-9
+
+
+def test_chrome_trace_schema_valid():
+    """The Perfetto/chrome://tracing JSON contract: a traceEvents array
+    of complete ('X') events with numeric microsecond ts/dur, pid/tid,
+    and per-track thread_name metadata — json-serializable as-is."""
+    p = OccupancyProfiler()
+    p.record("feed", "group[2]", 0.003, rows=2048)
+    p.record("device", "update", 0.002, rows=2048)
+    p.record("fence", "wait", 0.001)
+    doc = json.loads(json.dumps(p.to_chrome_trace()))
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["displayTimeUnit"] == "ms"
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in metas} == {"feed", "device",
+                                                  "fence"}
+    assert len(xs) == 3
+    for e in xs:
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0 and e["tid"] >= 1
+    tids = {m["tid"] for m in metas}
+    assert all(e["tid"] in tids for e in xs)
+
+
+def test_feed_populates_device_track_and_stall():
+    """The overlapped feed feeds the profiler: device intervals from
+    dispatch->fence, feed group spans, and starvation time while the
+    window sits empty."""
+    prof = default_profiler()
+    prof.reset()
+    exp = _exporter(0.0, prefetch_depth=2, coalesce_batches=2)
+    for c in _chunks(_stream(16000)):
+        exp.process([("l4_flow_log", 0, c)])
+    assert exp._feed.drain(30)
+    tracks = {s[0] for s in prof._snapshot()}
+    assert {"feed", "device", "fence"} <= tracks
+    assert prof.busy_fraction("device") > 0
+    exp.close()
+
+
+# ------------------------------------------------ exposition + CLI + debug
+
+def test_gauges_on_metrics_render():
+    """/metrics carries the audit error gauges (HELP-documented, strict
+    checker clean) and the profiler occupancy gauges every scrape."""
+    from deepflow_tpu.runtime.promexpo import (render_metrics,
+                                               validate_exposition)
+    from deepflow_tpu.runtime.stats import StatsRegistry
+
+    tr = default_tracer()
+    tr.reset()
+    tr.enable()
+    try:
+        reg = StatsRegistry()
+        exp = _exporter(1.0, stats=reg)
+        for c in _chunks(_stream(16000)):
+            exp.process([("l4_flow_log", 0, c)])
+        exp.flush_window()
+        text = render_metrics(reg, tr)
+        assert validate_exposition(text) == []
+        for needle in ("deepflow_tpu_sketch_accuracy_windows",
+                       "deepflow_trace_tpu_audit_cms_rel_error",
+                       "deepflow_trace_tpu_audit_topk_recall",
+                       "tpu_device_busy_fraction",
+                       "tpu_feed_stall_seconds"):
+            assert needle in text, f"{needle} absent"
+        exp.close()
+    finally:
+        tr.disable()
+
+
+def test_gauge_without_help_fails_strict_validation():
+    from deepflow_tpu.runtime.promexpo import validate_exposition
+
+    bad = "# TYPE mystery gauge\nmystery 1\n"
+    assert any("lacks HELP" in p for p in validate_exposition(bad))
+    ok = "# HELP mystery documented\n# TYPE mystery gauge\nmystery 1\n"
+    assert validate_exposition(ok) == []
+    # the format does not mandate HELP-before-TYPE: a third-party
+    # exposition with the comments swapped is still valid
+    swapped = "# TYPE mystery gauge\n# HELP mystery documented\nmystery 1\n"
+    assert validate_exposition(swapped) == []
+
+
+def test_entropy_gauge_advisory_at_sampled_rates():
+    """Per-key admission makes the sampled shadow's entropy a CLUSTER
+    sample: a heavy key hashed out of the sample is missing from every
+    window deterministically. At rate < 1 the entropy gauge must never
+    feed the alarm verdict (only CMS/HLL/recall can), or a healthy
+    ingester would flip /healthz 503 during exactly the heavy-hitter
+    event it exists to detect."""
+    cfg = FlowSuiteConfig()
+    a = ShadowAuditor(cfg, rate=0.5, min_sampled_rows=10)
+    cols = _stream(8000, pool=64)
+    for c in _chunks(cols, rows=8000):
+        a.absorb(c)
+    keys = np.array(sorted(a._counts, key=a._counts.get,
+                           reverse=True)[:cfg.top_k], np.uint64)
+    exact = np.array([a._counts[int(k)] for k in keys], np.int64)
+    card = len(a._clients) / a.rate
+    # device entropy wildly different from the shadow's: at full rate
+    # this is a violation, at a sampled rate it must be advisory
+    snap = a.close_window(_window_out(
+        cfg, keys.astype(np.uint32),
+        np.minimum(exact, 2**31 - 1).astype(np.int32),
+        card, [0.0, 0.0, 0.0, 0.0], rows=8000))
+    assert snap["entropy_abs_error"] > snap["entropy_bound"]
+    assert not snap["violation"]
+
+
+def test_trace_export_fits_one_datagram_at_cap():
+    """A full ring exported at the cap must come back through the UDP
+    debug protocol, not be replaced by the response-too-large error."""
+    from deepflow_tpu.runtime.debug import DebugServer, debug_request
+    from deepflow_tpu.runtime.stats import StatsRegistry
+
+    prof = default_profiler()
+    prof.reset()
+    for i in range(1000):
+        prof.record("device", f"update:lanes_x{i % 7}", 0.0123, rows=65536)
+    srv = DebugServer(StatsRegistry(), port=0)
+    srv.start()
+    try:
+        out = debug_request("trace-export", port=srv.port, limit=10_000,
+                            timeout=10.0)
+        assert out["ok"], out
+        xs = [e for e in out["data"]["trace"]["traceEvents"]
+              if e["ph"] == "X"]
+        assert len(xs) == 350                  # server-side cap
+    finally:
+        srv.close()
+        prof.reset()
+
+
+def test_trace_export_debug_route_and_cli(tmp_path, capsys):
+    """`df-ctl trace export` round-trip: debug route -> CLI -> a file
+    that parses as a Chrome-trace document; `trace latency` renders the
+    occupancy columns."""
+    from deepflow_tpu.cli import main
+    from deepflow_tpu.runtime.debug import DebugServer
+    from deepflow_tpu.runtime.stats import StatsRegistry
+
+    prof = default_profiler()
+    prof.record("device", "update", 0.002, rows=1024)
+    srv = DebugServer(StatsRegistry(), port=0)
+    srv.start()
+    try:
+        out_path = tmp_path / "trace.json"
+        rc = main(["--debug-port", str(srv.port), "trace", "export",
+                   "--out", str(out_path)])
+        assert rc == 0
+        doc = json.loads(out_path.read_text())
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        capsys.readouterr()
+        tr = default_tracer()
+        tr.enable()
+        tr.observe("kernel", 0.002)
+        try:
+            rc = main(["--debug-port", str(srv.port), "trace",
+                       "latency"])
+        finally:
+            tr.disable()
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "DEVICE_BUSY_FRAC" in text
+        assert "FEED_OVERLAP_EFF" in text
+    finally:
+        srv.close()
+
+
+# -------------------------------------------------- sharded (mesh) audit
+
+def test_sharded_suite_inherits_audit(rng):
+    """ShardedFlowSuite with an attached auditor: host batches are
+    mirrored with per-shard attribution, and flush closes the audit
+    window against the MERGED output — the path the future pod-merged
+    sketch inherits."""
+    from deepflow_tpu.parallel import ShardedFlowSuite, make_mesh
+
+    cfg = FlowSuiteConfig(cms_log2_width=14, ring_size=512,
+                          hll_groups=64, hll_precision=8)
+    mesh = make_mesh()
+    suite = ShardedFlowSuite(cfg, mesh)
+    auditor = ShadowAuditor(cfg, rate=1.0, shards=suite.n_devices)
+    suite.attach_auditor(auditor)
+    state = suite.init()
+    B, n_batches = 1 << 13, 6     # several batches: ring admission is
+    cols = _stream(B * n_batches, pool=256)   # sampled 1/16 per batch,
+    mask = np.ones(B, bool)       # a heavy key needs a few to land
+    for i in range(n_batches):
+        batch = {k: np.ascontiguousarray(
+                     v[i * B:(i + 1) * B]).astype(np.uint32)
+                 for k, v in cols.items()
+                 if k in ("ip_src", "ip_dst", "port_src", "port_dst",
+                          "proto", "packet_tx", "packet_rx")}
+        dc, md = suite.put_batch(batch, mask)
+        state = suite.update(state, dc, md)
+    assert auditor.rows_seen_total == B * n_batches
+    assert sum(auditor._shard_rows) == auditor.sampled_rows_total
+    assert all(r > 0 for r in auditor._shard_rows)
+    # masked (padding) rows are excluded from the shadow exactly like
+    # the device excludes them — the shadow must not audit rows the
+    # sketch never saw
+    part = np.zeros(B, bool)
+    part[:100] = True
+    batch = {k: np.ascontiguousarray(v[:B]).astype(np.uint32)
+             for k, v in cols.items()
+             if k in ("ip_src", "ip_dst", "port_src", "port_dst",
+                      "proto", "packet_tx", "packet_rx")}
+    dc, md = suite.put_batch(batch, part)
+    state = suite.update(state, dc, md)
+    assert auditor.rows_seen_total == B * n_batches + 100
+    state, out = suite.flush(state)
+    snap = auditor.last_window
+    assert snap is not None and snap["sampled_keys"] > 0
+    assert snap["topk_recall"] >= 0.9
+    assert snap["cms_rel_error"] <= auditor.cms_eps_theory
