@@ -819,7 +819,106 @@ def main() -> None:
     audit_exp.close()
     _recover()
 
+    # -- timed: sketch-serving read path (ISSUE 7) -------------------------
+    # The acceptance bar: sustained point-query QPS against a LIVE
+    # ingest, p99 on the gauge surface, and zero ingest-side impact —
+    # the sketch state after the read-hammered run must be BIT-IDENTICAL
+    # to a no-readers twin fed the same stream (reads come from the
+    # snapshot cache, never the device; FENXI's isolation discipline as
+    # a measured number). Snapshot publishes fetch state at window
+    # close, so this phase runs after the other fetch-free loops.
+    _phase("timed: serving read path vs live ingest", budget=600.0)
+    from deepflow_tpu.serving import SketchTables, SnapshotCache
+
+    def _serving_run(with_readers: bool):
+        exp = TpuSketchExporter(
+            store=None, window_seconds=3600, batch_rows=1 << 16,
+            wire="lanes", prefetch_depth=2, coalesce_batches=2)
+        cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=30.0)
+        tables = SketchTables(cache)
+        # window 1: seed + publish the first snapshot
+        for i in range(2):
+            exp.process([("l4_flow_log", 0,
+                          schema_batches[i % n_batches])])
+        exp._feed.drain()
+        # wall-clock now: the publish wall time IS the staleness base
+        # (state itself is now-independent, so bit-identity holds)
+        exp.flush_window(now=time.time())
+        reads = [0]
+        stop = threading.Event()
+        hot = [r["flow_key"] for r in tables.topk(64)] or [1]
+        hot_arr = np.asarray(hot, np.uint32)
+
+        def _reader():
+            # the dashboard mix: one 64-key multiget (vectorized, GIL
+            # released inside numpy) + single point reads + the heavier
+            # top-K/cardinality panels at a lower cadence. Every key
+            # answered counts as one point query.
+            i, n, n_hot = 0, 0, len(hot)
+            t_end = time.perf_counter() + 0.5
+            while not stop.is_set() or time.perf_counter() < t_end:
+                got = tables.cms_points(hot_arr)
+                n += len(hot_arr) if got is not None else 0
+                for _ in range(4):
+                    tables.cms_point(hot[i % n_hot])
+                    i += 1
+                    n += 1
+                if i % 256 == 0:
+                    tables.topk(10)
+                    tables.hll_card()
+                    n += 2
+            reads[0] = n
+
+        rt = None
+        read_t0 = time.perf_counter()
+        if with_readers:
+            rt = threading.Thread(target=_reader, name="serving-reader",
+                                  daemon=True)
+            rt.start()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            exp.process([("l4_flow_log", 0,
+                          schema_batches[i % n_batches])])
+            if i == iters // 2:
+                # mid-run window flush: the live-ingest shape publishes
+                # fresh snapshots while readers run, keeping staleness
+                # bounded by the window cadence (identical in both runs,
+                # so the bit-identity comparison stays fair)
+                exp._feed.drain()
+                exp.flush_window(now=time.time())
+        exp._feed.drain()
+        ing_rate = batch * iters / (time.perf_counter() - t0)
+        if rt is not None:
+            stop.set()
+            rt.join()
+        read_wall = time.perf_counter() - read_t0
+        leaves = [np.asarray(a) for a in jax.tree_util.tree_leaves(
+            exp.state)]
+        stats = {"ingest_records_per_sec": round(ing_rate),
+                 "point_query_qps": round(reads[0] / max(read_wall, 1e-9)),
+                 "read_p99_s": round(tables._lat.quantile(0.99), 6),
+                 "staleness_s": round(cache.staleness_s(), 3)
+                 if cache.staleness_s() != float("inf") else -1.0,
+                 "reads": reads[0]}
+        cache.close()
+        exp.close()
+        return stats, leaves
+
+    serve_stats, serve_leaves = _serving_run(with_readers=True)
+    quiet_stats, quiet_leaves = _serving_run(with_readers=False)
+    bit_identical = all(np.array_equal(a, b) for a, b
+                        in zip(serve_leaves, quiet_leaves))
+    serving_stats = dict(serve_stats)
+    serving_stats["bit_identical_vs_no_readers"] = bool(bit_identical)
+    serving_stats["ingest_regression_frac"] = round(max(
+        0.0, 1.0 - serve_stats["ingest_records_per_sec"]
+        / max(quiet_stats["ingest_records_per_sec"], 1)), 4)
+    serving_stats["no_readers_ingest_records_per_sec"] = \
+        quiet_stats["ingest_records_per_sec"]
+    _recover()
+
     stage_breakdown = {
+        "serving": serving_stats,
         "feed_overlap": feed_stats,
         "audit": audit_stats,
         "packed": {"h2d_mb_s": round(packed_h2d),

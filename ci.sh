@@ -403,6 +403,112 @@ print(f"audit OK: recall {snap['topk_recall']}, cms_err "
       f"{len(xs)} trace events, device busy {busy:.2f}")
 EOF
 
+echo "== serving smoke: sketch read path vs live ingest =="
+# ISSUE 7: the sketch-serving read plane against a live ingester at the
+# chaos-smoke rate. A QuerierServer (supervised accept thread) mounts
+# the SnapshotCache-backed sketch datasource; a concurrent query loop
+# hammers SQL + PromQL + direct point reads WHILE frames flow. Gates:
+# answers come back non-empty, the serving gauges land on /metrics with
+# staleness <= max_staleness_s, the datasource listing shows the sketch
+# tables, and the strict exposition checker stays green.
+python - <<'EOF'
+import json, socket, tempfile, threading, time, urllib.parse, urllib.request
+import numpy as np
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.querier.server import QuerierServer
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.runtime.supervisor import default_supervisor
+from deepflow_tpu.serving import SketchTables, SnapshotCache
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+MAX_STALE = 3.0
+store = tempfile.mkdtemp(prefix="serving_store_")
+ing = Ingester(IngesterConfig(listen_port=0, prom_port=0,
+                              tpu_sketch_window_s=0.3, store_path=store),
+               platform=PlatformDataManager())
+ing.start()
+cache = SnapshotCache(ing.tpu_sketch.snapshot_bus, max_staleness_s=MAX_STALE)
+tables = SketchTables(cache)
+tables.register_datasource()
+q = QuerierServer(ing.store, ing.tag_dicts, port=0, sketch=tables)
+q.start()
+sup = [t for t in default_supervisor().threads()
+       if t["name"] == "querier-http"]
+assert sup and sup[0]["alive"] and sup[0]["crashes"] == 0, sup
+
+r = np.random.default_rng(0)
+cols = {name: r.integers(0, 1 << 8, 500).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+
+results = {"sql": 0, "prom": 0, "direct": 0, "errors": []}
+stop = threading.Event()
+
+def _query_loop():
+    base = f"http://127.0.0.1:{q.port}"
+    while not stop.is_set():
+        try:
+            body = urllib.parse.urlencode(
+                {"sql": "SELECT sketch.topk(5) FROM sketch"}).encode()
+            req = urllib.request.Request(f"{base}/v1/query", data=body)
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.load(resp)
+            if out.get("result", {}).get("values"):
+                results["sql"] += 1
+            qs = urllib.parse.urlencode({"query": "sketch_hll_card()"})
+            with urllib.request.urlopen(f"{base}/api/v1/query?{qs}",
+                                        timeout=5) as resp:
+                out = json.load(resp)
+            if out.get("status") == "success" and out["data"]["result"]:
+                results["prom"] += 1
+            for _ in range(200):    # the dashboard-QPS shape: point reads
+                tables.cms_point(0xBEEF)
+                results["direct"] += 1
+        except Exception as e:      # noqa: BLE001 — smoke must report
+            results["errors"].append(repr(e))
+            time.sleep(0.05)
+
+qt = threading.Thread(target=_query_loop, daemon=True)
+qt.start()
+sent = 0
+deadline = time.time() + 5.0
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    while time.time() < deadline:
+        s.sendall(frame); sent += 500
+        time.sleep(0.02)
+# let the last window flush + the query loop observe it, then scrape
+time.sleep(0.7)
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing.prom_port}/metrics", timeout=10) as resp:
+    text = resp.read().decode()
+stop.set(); qt.join(timeout=5)
+problems = validate_exposition(text)
+assert not problems, problems[:10]
+assert results["sql"] > 0 and results["prom"] > 0, results
+assert not results["errors"], results["errors"][:3]
+for needle in ("deepflow_trace_querier_read_qps",
+               "deepflow_trace_querier_read_p99_s",
+               "deepflow_trace_sketch_snapshot_staleness_s"):
+    assert needle in text, f"{needle} absent from /metrics"
+stale = [float(line.split()[-1]) for line in text.splitlines()
+         if line.startswith("deepflow_trace_sketch_snapshot_staleness_s ")]
+assert stale and stale[0] <= MAX_STALE, \
+    f"staleness bound violated: {stale} > {MAX_STALE}"
+ds = ing.flow_metrics.rollups.list_datasources()
+assert any(row.get("table") == "sketch.topk" for row in ds), ds
+q.close()
+tables.unregister_datasource()
+ing.close()
+print(f"serving OK: {sent} records ingested, {results['sql']} SQL + "
+      f"{results['prom']} PromQL + {results['direct']} direct reads, "
+      f"staleness {stale[0]:.2f}s <= {MAX_STALE}s")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax
@@ -469,6 +575,13 @@ assert d["stage_breakdown"]["host_fallback"]["records_per_sec"] > 0
 # TPU at the default rate; CPU smoke only asserts the measurement runs)
 audit = d["stage_breakdown"]["audit"]
 assert audit["records_per_sec"] > 0 and 0 <= audit["overhead_frac"] <= 1
+# the serving read path (ISSUE 7 acceptance): >= 50k point-query QPS
+# against a live ingest, with the read-hammered run's sketch state
+# bit-identical to the no-readers twin
+srv = d["stage_breakdown"]["serving"]
+assert srv["point_query_qps"] >= 50_000, srv
+assert srv["bit_identical_vs_no_readers"] is True, srv
+assert srv["read_p99_s"] > 0 and srv["reads"] > 0, srv
 print("bench smoke OK:", d["value"], "rec/s (CPU small),",
       "dict kernel", d["stage_breakdown"]["dict"]["kernel_records_per_sec"],
       "rec/s")

@@ -24,6 +24,8 @@ A from-scratch re-design of DeepFlow's server-side data plane
 - ``store``    — sketch snapshot checkpoint/restore (mergeable state).
 - ``query``    — query surface over sketch outputs (top-K, cardinality,
                  entropy series) analogous to the reference's querier.
+- ``serving``  — sketch-serving read path: snapshot-bus cache +
+                 queryable sketch tables with staleness-bounded reads.
 """
 
 __version__ = "0.1.0"

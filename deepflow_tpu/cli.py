@@ -322,6 +322,28 @@ def cmd_ingester(args) -> int:
 
 
 def cmd_query(args) -> int:
+    if args.snapshots:
+        # one-shot sketch point query straight off a snapshot directory
+        # (ISSUE 7): no querier server needed — the SnapshotBus disk
+        # store IS the serving format, so `df-ctl query --snapshots
+        # <ckpt_dir> "SELECT sketch.topk(10) FROM sketch"` answers from
+        # the newest snapshot a live (or dead) ingester left behind.
+        from deepflow_tpu.querier.sql import Select, parse_sql
+        from deepflow_tpu.runtime.snapbus import SnapshotBus
+        from deepflow_tpu.serving import SketchTables, SnapshotCache
+        stmt = parse_sql(args.sql)
+        if not (isinstance(stmt, Select) and stmt.table == "sketch"):
+            print("--snapshots serves the sketch datasource only "
+                  "(SELECT sketch.* FROM sketch)", file=sys.stderr)
+            return 2
+        bus = SnapshotBus(args.snapshots)
+        # offline snapshots are stale by definition: serve the newest
+        # one regardless of age (its `time` column says how old it is)
+        tables = SketchTables(SnapshotCache(bus,
+                                            max_staleness_s=float("inf")))
+        res = tables.sql(stmt)
+        _table(res.values, res.columns)
+        return 0
     form = urllib.parse.urlencode(
         {"sql": args.sql, **({"db": args.db} if args.db else {})})
     out = _http(f"{args.querier}/v1/query", form=form)
@@ -667,6 +689,10 @@ def build_parser() -> argparse.ArgumentParser:
     q = sub.add_parser("query", help="run DeepFlow-SQL")
     q.add_argument("sql")
     q.add_argument("-d", "--db")
+    q.add_argument("--snapshots",
+                   help="one-shot sketch point query off a snapshot "
+                        "directory (the ingester's sketch_ckpt dir) — "
+                        "no querier server needed")
     q.set_defaults(fn=cmd_query)
 
     pq = sub.add_parser("promql", help="run a PromQL instant/range query")

@@ -57,13 +57,17 @@ class QueryResult:
 class QueryEngine:
     def __init__(self, store: Store,
                  tag_dicts: Optional[TagDictRegistry] = None,
-                 tagrecorder=None) -> None:
+                 tagrecorder=None, sketch=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
         # controller.tagrecorder.TagRecorder: id->name dimension dicts for
         # KnowledgeGraph columns (pod_id_0 -> pod name); duck-typed so the
         # querier runs without a controller
         self.tagrecorder = tagrecorder
+        # serving.SketchTables (ISSUE 7): the `sketch` virtual datasource
+        # — SELECT sketch.cms_point/hll_card/topk/entropy answers from
+        # the in-process snapshot cache, never the store or the device
+        self.sketch = sketch
 
     # -- public ------------------------------------------------------------
     def execute(self, sql_text: str, db: Optional[str] = None) -> QueryResult:
@@ -153,6 +157,9 @@ class QueryEngine:
                        + (f" in db {db}" if db is not None else ""))
 
     def _select(self, stmt: Q.Select, db: Optional[str]) -> QueryResult:
+        if self.sketch is not None and stmt.table == "sketch":
+            # the sketch datasource: snapshot-cache reads, no store scan
+            return self.sketch.sql(stmt)
         table = self._resolve_table(stmt.table, db)
         schema = table.schema
 

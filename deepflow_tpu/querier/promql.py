@@ -66,6 +66,12 @@ MATH_FUNCS = {
 }
 CLAMP_FUNCS = ("clamp_min", "clamp_max")
 QUANTILE_OT = "quantile_over_time"
+# the ISSUE 7 sketch datasource (serving/tables.py): leaf functions that
+# answer from the snapshot cache instead of the samples table —
+# sketch_topk(10), sketch_cms_point(key), sketch_hll_card([group]),
+# sketch_entropy(). Optional scalar-literal argument.
+SKETCH_FUNCS = ("sketch_cms_point", "sketch_hll_card",
+                "sketch_topk", "sketch_entropy")
 
 
 # -- AST -------------------------------------------------------------------
@@ -413,6 +419,16 @@ class _Parser:
             if low == "label_join" and n_str < 2:
                 raise ValueError("label_join(v, dst, sep, src...)")
             return self._maybe_subquery(Func(low, tuple(args)))
+        if low in SKETCH_FUNCS and self.peek() == "(":
+            self.next()
+            if self.accept(")"):
+                return self._maybe_subquery(Func(low, ()))
+            arg = self.expr()
+            self.expect(")")
+            if not isinstance(arg, Num):
+                raise ValueError(f"{low} takes one scalar literal "
+                                 "argument (a flow key / group / k)")
+            return self._maybe_subquery(Func(low, (arg,)))
         if low == "time" and self.peek() == "(":
             self.next()
             self.expect(")")
@@ -649,6 +665,8 @@ class _Evaluator:
                 return self._timestamp(e.args[0])
             if e.name == "vector":
                 return [({}, self._scalar(e.args[0]))]
+            if e.name in SKETCH_FUNCS:
+                return self._sketch_series(e)
             if e.name in SCALAR_FUNCS:
                 raise ValueError(f"{e.name}() is scalar-valued; use it "
                                  "inside an arithmetic expression or "
@@ -981,6 +999,21 @@ class _Evaluator:
             if not np.isnan(vals).all():
                 out.append((_drop_name(labels), vals))
         return out
+
+    def _sketch_series(self, e: Func) -> SeriesList:
+        """The sketch datasource's leaf functions (ISSUE 7): delegate
+        to serving.SketchTables.prom_series — values come from the
+        in-process snapshot cache (staleness-bounded host reads), never
+        from the samples table or the device."""
+        tables = getattr(self.engine, "sketch", None)
+        if tables is None:
+            raise ValueError(
+                f"{e.name}() needs the sketch datasource — no serving "
+                "tables are wired into this querier")
+        arg = e.args[0].value if e.args else None
+        return [(dict(labels), np.asarray(vals, np.float64))
+                for labels, vals in tables.prom_series(e.name, arg,
+                                                       self.grid)]
 
     def _scalar(self, e: Expr) -> np.ndarray:
         """Per-grid-point scalar value of a scalar-valued expression."""
@@ -1385,11 +1418,14 @@ def _compare(op: str, a, b) -> np.ndarray:
 # -- engine ----------------------------------------------------------------
 class PromEngine:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
-                 db: str = "ext_metrics", table: str = "ext_samples") -> None:
+                 db: str = "ext_metrics", table: str = "ext_samples",
+                 sketch=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
         self.db = db
         self.table = table
+        # serving.SketchTables (ISSUE 7): backs the sketch_* functions
+        self.sketch = sketch
 
     # -- series access -----------------------------------------------------
     def _fetch(self, metric: str, matchers, lo: int, hi: int,

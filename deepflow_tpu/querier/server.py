@@ -19,10 +19,8 @@ segments, so handlers are safely concurrent with ingest.
 from __future__ import annotations
 
 import json
-import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
 from deepflow_tpu.querier.engine import QueryEngine
 from deepflow_tpu.querier.profile import ProfileQuery
@@ -37,11 +35,21 @@ DEFAULT_PORT = 20416   # reference querier listens on 20416
 class QuerierServer:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1",
-                 tagrecorder=None, external_apm=None) -> None:
+                 tagrecorder=None, external_apm=None,
+                 sketch=None, supervisor=None) -> None:
         from deepflow_tpu.querier.tracing_adapter import \
             TracingAdapterService
-        self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder)
-        self.prom = PromEngine(store, tag_dicts)
+        # serving.SketchTables (ISSUE 7): both engines mount it as the
+        # `sketch` datasource (SQL SELECT sketch.* / PromQL sketch_*),
+        # served through the existing /v1/query and /api/v1/query routes
+        self.sketch = sketch
+        # supervision tree for the accept loop; None = the process
+        # default, resolved at start() (a start()-time supervisor
+        # argument overrides a constructor-time one)
+        self._supervisor = supervisor
+        self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder,
+                                  sketch=sketch)
+        self.prom = PromEngine(store, tag_dicts, sketch=sketch)
         self.profile = ProfileQuery(store, tag_dicts)
         self.tempo = TempoQuery(store, tag_dicts)
         self.tracing_adapter = TracingAdapterService.from_config(
@@ -273,20 +281,49 @@ class QuerierServer:
                       urllib.parse.parse_qs(url.query).items()}
                 self._route(url.path, {**qs, **params})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._thread: Optional[threading.Thread] = None
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def service_actions(inner) -> None:
+                # serve_forever calls this every poll_interval on the
+                # accept thread: a free deadman heartbeat for the
+                # supervised worker (PR 2 discipline — no beats, no
+                # watchdog; see start())
+                beat = self._beat
+                if beat is not None:
+                    beat()
+
+        self._beat = None
+        self._httpd = _Server((host, port), Handler)
+        self._handle = None
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
 
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="querier-http", daemon=True)
-        self._thread.start()
+    def start(self, supervisor=None) -> None:
+        """Spawn the accept loop through the supervision tree (PR 2/3
+        discipline: crash capture, backoff restart, deadman beats via
+        service_actions — the ISSUE 7 satellite that retired this
+        file's unsupervised-thread baseline entry). `supervisor` defaults
+        to the process tree; serve_forever returning after shutdown()
+        reads as normal completion, so close() doesn't trigger a
+        restart."""
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = supervisor if supervisor is not None else self._supervisor
+        if sup is None:
+            sup = default_supervisor()
+        self._beat = sup.beat
+        self._handle = sup.spawn(
+            "querier-http", lambda: self._httpd.serve_forever(
+                poll_interval=0.5),
+            beat_period_s=0.5)
 
     def close(self) -> None:
+        if self._handle is not None:
+            self._handle.stop()      # no restart on the way down
         self._httpd.shutdown()
         self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+        if self._handle is not None:
+            self._handle.join(timeout=2)
+            self._handle = None

@@ -98,7 +98,19 @@ class TimeBucket:
     seconds: int
 
 
-Expr = Union[Column, Literal, Agg, BinOp, TimeBucket, IntervalRef]
+@dataclass(frozen=True)
+class QualifiedFunc:
+    """A dotted function call — ``sketch.topk(10)``,
+    ``sketch.cms_point(key)`` — the virtual-datasource surface (ISSUE
+    7's sketch tables). The parser stays generic: it records the dotted
+    name plus LITERAL arguments; the owning datasource interprets them
+    (serving/tables.py for the ``sketch.*`` family)."""
+    name: str
+    args: Tuple[Union[int, float, str], ...] = ()
+
+
+Expr = Union[Column, Literal, Agg, BinOp, TimeBucket, IntervalRef,
+             QualifiedFunc]
 
 
 @dataclass(frozen=True)
@@ -265,6 +277,18 @@ class _Parser:
             return Agg(t.lower(), arg)
         if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_.]*", t):
             raise ValueError(f"unexpected token {t!r}")
+        if "." in t and self.peek() == "(":
+            # dotted function call (sketch.topk(10)-style): literal
+            # arguments only — the datasource that owns the namespace
+            # validates names/arity (engine._select routes by table)
+            self.next()
+            args = []
+            if not self.accept(")"):
+                args.append(self._value(self.next()))
+                while self.accept(","):
+                    args.append(self._value(self.next()))
+                self.expect(")")
+            return QualifiedFunc(t.lower(), tuple(args))
         return Column(t)
 
     # -- clauses -----------------------------------------------------------

@@ -1,123 +1,26 @@
-"""Sketch-state checkpointing: mergeable snapshots, restart loses <=1 window.
+"""Sketch-state checkpointing — now a thin alias over the SnapshotBus.
 
-Reference: the reference has no ML-style checkpointing — durable state is
-MySQL + ClickHouse and agents are stateless across restarts (SURVEY.md §5).
-The TPU analogue this framework needs: sketch states (CMS counts, HLL
-registers, rings, EWMAs) are device pytrees, so a checkpoint is one
-device_get + atomic npz write per cadence, and restore validates leaf
-shapes/dtypes against a freshly-initialized state of the current config
-— incompatible checkpoints (config changed) are refused, not misloaded.
+ISSUE 7 refactored this module's ``SketchCheckpointer`` into the
+pub/sub, versioned :class:`~deepflow_tpu.runtime.snapbus.SnapshotBus`:
+one snapshot format now serves three consumers — querier reads
+(``serving/``), degraded-mode restore, and restart replay. The name is
+kept because "checkpointer" is what the restore/replay consumers still
+see; new code (and anything that wants the pub/sub surface) should
+import :mod:`deepflow_tpu.runtime.snapbus` directly.
+
+The PR 4 promise is unchanged: atomic rolling npz snapshots of one
+pytree state, restart loses <= 1 window, incompatible snapshots (config
+changed) are refused, not misloaded — plus the ISSUE 7 durability fix
+(fsync file-then-directory around the rename) and restored-step
+attribution (``counters()["last_restored_step"]``).
 """
 
 from __future__ import annotations
 
-import os
-from typing import Any, Optional
+from deepflow_tpu.runtime.snapbus import SketchSnapshot, SnapshotBus
 
-import numpy as np
+__all__ = ["SketchCheckpointer", "SketchSnapshot", "SnapshotBus"]
 
-import jax
-
-from deepflow_tpu.runtime.faults import FAULT_CHECKPOINT_TORN, default_faults
-
-
-class SketchCheckpointer:
-    """Atomic rolling snapshots of one pytree state."""
-
-    def __init__(self, directory: str, name: str = "sketch",
-                 keep: int = 3) -> None:
-        self.directory = directory
-        self.name = name
-        self.keep = keep
-        os.makedirs(directory, exist_ok=True)
-        self.saves = 0
-        self.restores = 0
-
-    # -- save --------------------------------------------------------------
-    def save(self, state: Any, step: int) -> str:
-        leaves = jax.tree_util.tree_leaves(state)
-        host = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
-        path = os.path.join(self.directory,
-                            f"{self.name}-{step:012d}.npz")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **{f"leaf_{i}": a for i, a in enumerate(host)},
-                     __step=np.asarray(step, np.int64))
-        faults = default_faults()
-        if faults.enabled and faults.should_fire(FAULT_CHECKPOINT_TORN,
-                                                 key=self.name):
-            # chaos: the worst torn-write shape — a truncated file that
-            # still made it to its final name; restore must skip it
-            size = os.path.getsize(tmp)
-            with open(tmp, "r+b") as f:
-                f.truncate(max(1, size // 2))
-        os.replace(tmp, path)
-        self.saves += 1
-        self._gc()
-        return path
-
-    def _snapshots(self) -> list:
-        if not os.path.isdir(self.directory):
-            return []
-        out = []
-        for f in sorted(os.listdir(self.directory)):
-            if not (f.startswith(self.name + "-") and f.endswith(".npz")):
-                continue
-            # skip foreign/malformed names: a stray `sketch-old.npz`
-            # in the directory must not crash latest_step()'s int()
-            if not f[len(self.name) + 1:-4].isdigit():
-                continue
-            out.append(f)
-        return out
-
-    def _gc(self) -> None:
-        snaps = self._snapshots()
-        for f in snaps[:-self.keep]:
-            try:
-                os.unlink(os.path.join(self.directory, f))
-            except OSError:
-                pass
-
-    # -- restore -----------------------------------------------------------
-    def restore(self, like: Any) -> Optional[Any]:
-        """Load the newest compatible snapshot shaped like `like` (a
-        freshly-initialized state). Returns None when no snapshot exists
-        or the stored leaves don't match the current config's shapes."""
-        like_leaves, treedef = jax.tree_util.tree_flatten(like)
-        for fname in reversed(self._snapshots()):
-            path = os.path.join(self.directory, fname)
-            try:
-                with np.load(path) as z:
-                    # the stored leaf COUNT must match exactly: a stale
-                    # snapshot from a bigger config whose first N leaves
-                    # happen to match shapes must be refused, not
-                    # silently half-loaded
-                    stored = sum(1 for k in z.files if k.startswith("leaf_"))
-                    if stored != len(like_leaves):
-                        continue
-                    loaded = [z[f"leaf_{i}"]
-                              for i in range(len(like_leaves))]
-            except Exception:
-                # torn or incompatible file (np.load raises OSError,
-                # BadZipFile, EOFError, ... depending on where the tear
-                # landed): try the previous snapshot
-                continue
-            ok = all(
-                a.shape == np.shape(b) and a.dtype == np.asarray(b).dtype
-                for a, b in zip(loaded, like_leaves))
-            if not ok:
-                continue
-            self.restores += 1
-            device_leaves = [jax.numpy.asarray(a) for a in loaded]
-            return jax.tree_util.tree_unflatten(treedef, device_leaves)
-        return None
-
-    def latest_step(self) -> Optional[int]:
-        snaps = self._snapshots()
-        if not snaps:
-            return None
-        return int(snaps[-1][len(self.name) + 1:-4])
-
-    def counters(self) -> dict:
-        return {"saves": self.saves, "restores": self.restores,
-                "snapshots": len(self._snapshots())}
+# the historical name: identical object, not a subclass — isinstance
+# checks and counters stay interchangeable across the rename
+SketchCheckpointer = SnapshotBus

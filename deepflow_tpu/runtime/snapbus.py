@@ -1,0 +1,337 @@
+"""SnapshotBus: the versioned sketch-snapshot store, pub/sub + disk.
+
+PR 4's ``SketchCheckpointer`` wrote rolling npz snapshots for exactly one
+consumer (restart replay) and PR 2 quietly grew a second (degraded-mode
+restore). The serving read path (ROADMAP item 4) is the third — dashboard
+queries need the same window states the checkpointer already fetches at
+every window close, without ever touching the device or the feed/drain
+hot path. So the checkpointer is refactored into a *bus*: every
+``publish`` materializes the state's leaves host-side ONCE and fans the
+immutable :class:`SketchSnapshot` out to
+
+- in-process subscribers (``serving/cache.py``'s query cache — reads are
+  answered from these host arrays, the FENXI host<->accelerator isolation
+  discipline: query traffic never syncs the device),
+- the disk store (restart replay + degraded-mode restore read the SAME
+  npz format back through :meth:`restore`), and
+- the ``counters()`` surface (saves/restores/published/last_restored_step
+  so degraded-mode logs and the PR 6 audit can attribute which snapshot a
+  rollback landed on).
+
+Durability (ISSUE 7 satellite): ``save()`` previously wrote tmp +
+``os.replace`` with no fsync — a crash right after ``checkpoint_now()``
+could lose the just-renamed "latest" snapshot even though PR 4 fsyncs
+spill segments. The tmp file is now fsynced before the rename and the
+directory after it, so a rename that returned is a rename that persists.
+
+Reference: the reference has no ML-style checkpointing — durable state is
+MySQL + ClickHouse and agents are stateless across restarts (SURVEY.md
+§5). Sketch states (CMS counts, HLL registers, rings) are device
+pytrees, so a snapshot is one device_get + atomic npz write per cadence,
+and restore validates leaf shapes/dtypes against a freshly-initialized
+state of the current config — incompatible snapshots are refused, not
+misloaded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from deepflow_tpu.runtime.faults import FAULT_CHECKPOINT_TORN, default_faults
+
+__all__ = ["SketchSnapshot", "SnapshotBus"]
+
+
+@dataclass(frozen=True)
+class SketchSnapshot:
+    """One immutable published sketch state (host-side numpy leaves).
+
+    ``step`` is the producer's window counter, ``seq`` the bus's own
+    monotonically increasing version (distinct producers of the same
+    step still order), ``wall_time`` the publish wall clock — the
+    querier maps query time bounds onto snapshot windows through it.
+    ``tags`` carries the PR 6 audit verdicts for the window (``lossy``,
+    ``degraded``, ``final``) so a dashboard answer can say whether the
+    window it came from is trustworthy."""
+
+    step: int
+    seq: int
+    wall_time: float
+    leaves: Tuple[np.ndarray, ...]
+    tags: Dict[str, Any] = field(default_factory=dict)
+    path: Optional[str] = None
+
+    @property
+    def age_s(self) -> float:
+        return max(0.0, time.time() - self.wall_time)
+
+
+def _fsync_dir(directory: str) -> None:
+    """Persist a rename: fsync the directory so the new directory entry
+    survives a crash (same discipline as spill.py's segment roll)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class SnapshotBus:
+    """Versioned snapshot store: one publish feeds querier reads,
+    degraded-mode restore and restart replay from one format.
+
+    ``directory=None`` runs the bus in-process only (pub/sub without
+    durability — the StorageDisabled serving mode); otherwise every
+    disk-bound publish is an atomic fsynced npz under ``directory``.
+    """
+
+    def __init__(self, directory: Optional[str], name: str = "sketch",
+                 keep: int = 3) -> None:
+        self.directory = directory
+        self.name = name
+        self.keep = keep
+        if directory is not None:
+            os.makedirs(directory, exist_ok=True)
+        self.saves = 0            # disk-bound publishes
+        self.restores = 0
+        self.published = 0        # all publishes (incl. in-memory-only)
+        self.subscriber_errors = 0
+        self.last_restored_step: int = -1   # -1 = never restored
+        self._seq = 0
+        self._latest: Optional[SketchSnapshot] = None
+        # (path, mtime, snapshot): read_latest's one-deep disk cache —
+        # a polling reader (the serving cache refreshing on every stale
+        # read against a quiet companion-process store) must get the
+        # SAME snapshot object back, not a fresh npz load + fresh seq
+        # per query (which would also defeat the view cache downstream)
+        self._read_cache: Optional[Tuple[str, float, SketchSnapshot]] = None
+        self._subs: List[Callable[[SketchSnapshot], None]] = []
+        self._lock = threading.Lock()
+
+    # -- pub/sub -----------------------------------------------------------
+    def subscribe(self, fn: Callable[[SketchSnapshot], None]
+                  ) -> Callable[[], None]:
+        """Register an in-process subscriber; returns an unsubscribe
+        callable. The current latest snapshot (if any) is delivered
+        immediately so a late subscriber does not start blind."""
+        with self._lock:
+            self._subs.append(fn)
+            latest = self._latest
+        if latest is not None:
+            self._notify_one(fn, latest)
+
+        def _unsubscribe() -> None:
+            with self._lock:
+                try:
+                    self._subs.remove(fn)
+                except ValueError:
+                    pass
+        return _unsubscribe
+
+    def has_subscribers(self) -> bool:
+        return bool(self._subs)
+
+    def _notify_one(self, fn, snap: SketchSnapshot) -> None:
+        try:
+            fn(snap)
+        except Exception:
+            # a broken reader must never kill the window flush
+            self.subscriber_errors += 1
+            logging.getLogger(__name__).exception(
+                "snapshot subscriber raised; snapshot seq=%d dropped "
+                "for this subscriber", snap.seq)
+
+    def publish(self, state: Any, step: int,
+                wall_time: Optional[float] = None,
+                tags: Optional[Dict[str, Any]] = None,
+                to_disk: bool = True) -> SketchSnapshot:
+        """Materialize ``state``'s leaves host-side and fan the snapshot
+        out. ``to_disk=False`` skips the npz (subscriber-only publish —
+        the serving cache at cadences finer than checkpoint_every)."""
+        leaves = tuple(np.asarray(jax.device_get(leaf))
+                       for leaf in jax.tree_util.tree_leaves(state))
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        snap = SketchSnapshot(
+            step=int(step), seq=seq,
+            wall_time=time.time() if wall_time is None else float(wall_time),
+            leaves=leaves, tags=dict(tags or {}))
+        if to_disk and self.directory is not None:
+            snap = self._write(snap)
+            self.saves += 1
+        self.published += 1
+        with self._lock:
+            self._latest = snap
+            subs = list(self._subs)
+        for fn in subs:
+            self._notify_one(fn, snap)
+        return snap
+
+    # -- legacy checkpoint surface -----------------------------------------
+    def save(self, state: Any, step: int) -> str:
+        """The PR 4 checkpointer API: publish to disk, return the path."""
+        return self.publish(state, step).path or ""
+
+    def _write(self, snap: SketchSnapshot) -> SketchSnapshot:
+        path = os.path.join(self.directory,
+                            f"{self.name}-{snap.step:012d}.npz")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **{f"leaf_{i}": a
+                           for i, a in enumerate(snap.leaves)},
+                     __step=np.asarray(snap.step, np.int64),
+                     __wall=np.asarray(snap.wall_time, np.float64),
+                     __tags=np.asarray(json.dumps(snap.tags)))
+            # fsync BEFORE the rename: os.replace orders the directory
+            # entry, not the data — without this a crash can leave the
+            # final name pointing at unwritten blocks (the satellite fix)
+            f.flush()
+            os.fsync(f.fileno())
+        faults = default_faults()
+        if faults.enabled and faults.should_fire(FAULT_CHECKPOINT_TORN,
+                                                 key=self.name):
+            # chaos: the worst torn-write shape — a truncated file that
+            # still made it to its final name; restore must skip it
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as f:
+                f.truncate(max(1, size // 2))
+        os.replace(tmp, path)
+        _fsync_dir(self.directory)
+        self._gc()
+        return dataclasses.replace(snap, path=path)
+
+    def _snapshots(self) -> list:
+        if self.directory is None or not os.path.isdir(self.directory):
+            return []
+        out = []
+        for f in sorted(os.listdir(self.directory)):
+            if not (f.startswith(self.name + "-") and f.endswith(".npz")):
+                continue
+            # skip foreign/malformed names: a stray `sketch-old.npz`
+            # in the directory must not crash latest_step()'s int()
+            if not f[len(self.name) + 1:-4].isdigit():
+                continue
+            out.append(f)
+        return out
+
+    def _gc(self) -> None:
+        snaps = self._snapshots()
+        for f in snaps[:-self.keep]:
+            try:
+                os.unlink(os.path.join(self.directory, f))
+            except OSError:
+                pass
+
+    # -- reads -------------------------------------------------------------
+    def latest(self) -> Optional[SketchSnapshot]:
+        """Newest snapshot this process published; falls back to the
+        disk store (a restarted/companion process's snapshots) — the
+        cache-refresh path, never a device sync."""
+        with self._lock:
+            latest = self._latest
+        if latest is not None:
+            return latest
+        return self.read_latest()
+
+    def read_latest(self) -> Optional[SketchSnapshot]:
+        """Re-read the newest parseable snapshot from DISK into a
+        SketchSnapshot (no shape validation — the reader compares
+        against its own expected layout). Torn files are skipped, like
+        restore()."""
+        for fname in reversed(self._snapshots()):
+            path = os.path.join(self.directory, fname)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            cached = self._read_cache
+            if cached is not None and cached[0] == path \
+                    and cached[1] == mtime:
+                return cached[2]      # unchanged file: same snapshot
+            try:
+                with np.load(path) as z:
+                    n = sum(1 for k in z.files if k.startswith("leaf_"))
+                    leaves = tuple(z[f"leaf_{i}"] for i in range(n))
+                    step = int(z["__step"]) if "__step" in z.files else \
+                        int(fname[len(self.name) + 1:-4])
+                    wall = float(z["__wall"]) if "__wall" in z.files \
+                        else mtime
+                    tags = json.loads(str(z["__tags"])) \
+                        if "__tags" in z.files else {}
+            except Exception:
+                continue
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            snap = SketchSnapshot(step=step, seq=seq, wall_time=wall,
+                                  leaves=leaves, tags=tags, path=path)
+            self._read_cache = (path, mtime, snap)
+            return snap
+        return None
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, like: Any) -> Optional[Any]:
+        """Load the newest compatible snapshot shaped like `like` (a
+        freshly-initialized state). Returns None when no snapshot exists
+        or the stored leaves don't match the current config's shapes.
+        The restored snapshot's step lands in ``last_restored_step`` so
+        degraded-mode logs and the PR 6 audit can attribute the
+        rollback window (ISSUE 7 satellite)."""
+        like_leaves, treedef = jax.tree_util.tree_flatten(like)
+        for fname in reversed(self._snapshots()):
+            path = os.path.join(self.directory, fname)
+            try:
+                with np.load(path) as z:
+                    # the stored leaf COUNT must match exactly: a stale
+                    # snapshot from a bigger config whose first N leaves
+                    # happen to match shapes must be refused, not
+                    # silently half-loaded
+                    stored = sum(1 for k in z.files if k.startswith("leaf_"))
+                    if stored != len(like_leaves):
+                        continue
+                    loaded = [z[f"leaf_{i}"]
+                              for i in range(len(like_leaves))]
+            except Exception:
+                # torn or incompatible file (np.load raises OSError,
+                # BadZipFile, EOFError, ... depending on where the tear
+                # landed): try the previous snapshot
+                continue
+            ok = all(
+                a.shape == np.shape(b) and a.dtype == np.asarray(b).dtype
+                for a, b in zip(loaded, like_leaves))
+            if not ok:
+                continue
+            self.restores += 1
+            self.last_restored_step = int(fname[len(self.name) + 1:-4])
+            device_leaves = [jax.numpy.asarray(a) for a in loaded]
+            return jax.tree_util.tree_unflatten(treedef, device_leaves)
+        return None
+
+    def latest_step(self) -> Optional[int]:
+        snaps = self._snapshots()
+        if not snaps:
+            return None
+        return int(snaps[-1][len(self.name) + 1:-4])
+
+    def counters(self) -> dict:
+        return {"saves": self.saves, "restores": self.restores,
+                "snapshots": len(self._snapshots()),
+                "published": self.published,
+                "subscribers": len(self._subs),
+                "subscriber_errors": self.subscriber_errors,
+                "last_restored_step": self.last_restored_step}

@@ -22,7 +22,7 @@ import numpy as np
 from deepflow_tpu.batch.batcher import Batcher, TensorBatch
 from deepflow_tpu.batch.schema import SKETCH_L4_SCHEMA
 from deepflow_tpu.models import flow_suite
-from deepflow_tpu.runtime.checkpoint import SketchCheckpointer
+from deepflow_tpu.runtime.snapbus import SnapshotBus
 from deepflow_tpu.runtime.exporters import QueueWorkerExporter
 from deepflow_tpu.runtime.faults import FAULT_DEVICE_ERROR, default_faults
 from deepflow_tpu.runtime.stats import StatsRegistry
@@ -197,12 +197,20 @@ class TpuSketchExporter(QueueWorkerExporter):
         # device — the wide store schema never crosses the PCIe/ICI
         self.batcher = Batcher(SKETCH_L4_SCHEMA, capacity=batch_rows)
         self.state = flow_suite.init(self.cfg)
-        self.checkpointer = None
+        # snapshot bus (ISSUE 7): the checkpointer refactored into a
+        # pub/sub versioned snapshot store. With a checkpoint_dir the
+        # bus is disk-backed (restart replay + degraded restore read the
+        # same format back); without one it still exists in-process so
+        # the serving read path works in StorageDisabled mode.
+        # `checkpointer` stays None when undurable — every PR 2/4
+        # restore/cadence decision keys off that, unchanged.
+        self._snapbus = SnapshotBus(checkpoint_dir)
+        self.checkpointer = self._snapbus if checkpoint_dir is not None \
+            else None
         self.checkpoint_every = max(1, checkpoint_every)
         self.windows = 0
         self._rows_at_flush = 0
         if checkpoint_dir is not None:
-            self.checkpointer = SketchCheckpointer(checkpoint_dir)
             restored = self.checkpointer.restore(self.state)
             if restored is not None:
                 self.state = restored
@@ -572,6 +580,16 @@ class TpuSketchExporter(QueueWorkerExporter):
         restored = None
         if self.checkpointer is not None:
             restored = self.checkpointer.restore(fresh)
+        if restored is not None:
+            import logging
+            # which snapshot the rollback landed on (ISSUE 7 satellite:
+            # the audit/ops can attribute the replayed window instead of
+            # guessing; the same number rides counters() as
+            # last_restored_step)
+            logging.getLogger(__name__).warning(
+                "tpu_sketch state restored from snapshot step %d "
+                "(current window %d)",
+                self.checkpointer.last_restored_step, self.windows)
         self.state = restored if restored is not None else fresh
         if self._dict_packer is not None:
             self._dict_packer = self._flow_dict.FlowDictPacker(
@@ -835,6 +853,12 @@ class TpuSketchExporter(QueueWorkerExporter):
         return 0 if self._feed is None else self._feed.pending()
 
     @property
+    def snapshot_bus(self) -> SnapshotBus:
+        """The ISSUE 7 snapshot bus: serving caches subscribe here.
+        Always present (in-process-only when no checkpoint_dir)."""
+        return self._snapbus
+
+    @property
     def audit_alarm(self) -> bool:
         """Accuracy-observatory alarm: observed sketch error exceeded
         its theoretical bound for N consecutive clean windows
@@ -894,7 +918,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                 logging.getLogger(__name__).error(
                     "feed drain timed out; shutdown checkpoint skipped")
                 return False
-            self.checkpointer.save(self.state, self.windows)
+            self._snapbus.publish(self.state, self.windows,
+                                  tags={"final": True})
             return True
 
     # -- windows -----------------------------------------------------------
@@ -944,9 +969,19 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # store; restart loses at most the current accumulation,
                 # bounded by checkpoint_every windows of data.
                 dirty = self.rows_in != self._rows_at_flush
-                if (self.checkpointer is not None and dirty
-                        and self.windows % self.checkpoint_every == 0):
-                    self.checkpointer.save(self.state, self.windows)
+                # snapshot bus (ISSUE 7): a disk publish on the PR 4
+                # cadence, PLUS a subscriber-only (no npz) publish for
+                # every dirty window when the serving cache is listening
+                # — its staleness bound is one window, not
+                # checkpoint_every windows. No subscribers, no cadence
+                # hit => no device_get at all (the pre-ISSUE 7 shape).
+                want_disk = (self.checkpointer is not None and dirty
+                             and self.windows % self.checkpoint_every == 0)
+                if want_disk or (dirty and self._snapbus.has_subscribers()):
+                    self._snapbus.publish(
+                        self.state, self.windows, wall_time=now,
+                        tags={"lossy": self._window_lost_counted},
+                        to_disk=want_disk)
                 self._rows_at_flush = self.rows_in
                 try:
                     self.state, out = self._flush_fn(self.state)
@@ -1050,8 +1085,10 @@ class TpuSketchExporter(QueueWorkerExporter):
             c["ring_admission_failures"] = failures
         if self._feed is not None:
             c.update(self._feed.counters())
-        if self.checkpointer is not None:
-            c.update(self.checkpointer.counters())
+        # the snapshot bus is always live (in-process-only without a
+        # checkpoint_dir): saves/restores plus the ISSUE 7 pub/sub and
+        # restored-step attribution counters
+        c.update(self._snapbus.counters())
         if self._audit is not None:
             # headline verdicts only — the full family is the separate
             # `tpu_sketch_accuracy` Countable (runtime/audit.py)

@@ -92,6 +92,19 @@ GAUGE_HELP: Dict[str, str] = {
                               "at the last window close",
     "tpu_audit_degraded_window": "1 when the last audited window ran "
                                  "on the degraded host-fallback lane",
+    # the ISSUE 7 sketch-serving read path (serving/tables.py): read
+    # traffic answered from the in-process snapshot cache — these are
+    # the dashboard-QPS acceptance gauges
+    "querier_read_qps": "sketch point queries answered per second "
+                        "over the last gauge window (snapshot-cache "
+                        "reads; never a device sync)",
+    "querier_read_p99_s": "p99 latency of sketch point queries in "
+                          "seconds (host DDSketch over all reads)",
+    "sketch_snapshot_staleness_s": "age of the newest published sketch "
+                                   "snapshot at the last read; the "
+                                   "staleness-bounded-read contract is "
+                                   "staleness <= max_staleness_s "
+                                   "whenever ingest is flushing windows",
 }
 
 # dynamically-named gauges get HELP by prefix (one entry documents the

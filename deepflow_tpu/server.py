@@ -124,13 +124,31 @@ class Server:
 
         q_cfg = c.get("querier", {})
         self.querier = None
+        self.sketch_tables = None
         if q_cfg.get("enabled", True) and self.ingester.store is not None:
+            # ISSUE 7 serving read path: when the tpu_sketch lane runs,
+            # mount its snapshot bus as the `sketch` datasource — SQL
+            # SELECT sketch.* / PromQL sketch_*() answer from the
+            # in-process cache with staleness-bounded reads, never
+            # touching the device or the feed/drain hot path
+            if self.ingester.tpu_sketch is not None:
+                from deepflow_tpu.serving import (SketchTables,
+                                                  SnapshotCache)
+                cache = SnapshotCache(
+                    self.ingester.tpu_sketch.snapshot_bus,
+                    max_staleness_s=q_cfg.get("sketch_max_staleness_s",
+                                              5.0))
+                self.sketch_tables = SketchTables(cache)
+                self.sketch_tables.register_datasource()
+                self.ingester.stats.register("serving",
+                                             self.sketch_tables.counters)
             self.querier = QuerierServer(
                 self.ingester.store, self.ingester.tag_dicts,
                 port=q_cfg.get("port", 20416),
                 host=q_cfg.get("host", "127.0.0.1"),
                 tagrecorder=self.tagrecorder,
-                external_apm=q_cfg.get("external_apm", []))
+                external_apm=q_cfg.get("external_apm", []),
+                sketch=self.sketch_tables)
 
         self.stats_shipper = None
         if c.get("self_telemetry", True):
@@ -208,6 +226,11 @@ class Server:
             self.trident_grpc = None
         if self.querier is not None:
             self.querier.close()
+        if self.sketch_tables is not None:
+            self.sketch_tables.unregister_datasource()
+            self.sketch_tables.cache.close()
+            self.ingester.stats.deregister("serving")
+            self.sketch_tables = None
         if self.stats_shipper is not None:
             self.ingester.stats.stop()
             self.stats_shipper.close()

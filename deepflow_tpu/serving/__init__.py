@@ -1,0 +1,18 @@
+"""Sketch-serving read path (ISSUE 7, ROADMAP item 4).
+
+Ingest is half of production; this package is the other half — millions
+of users *reading* detections. A :class:`SnapshotCache` subscribes to the
+tpu_sketch exporter's :class:`~deepflow_tpu.runtime.snapbus.SnapshotBus`
+and keeps recent window snapshots as host numpy; :class:`SketchTables`
+answers point queries (CMS point estimate, HLL cardinality, top-K,
+entropy timeline) from that cache with staleness-bounded reads — query
+traffic never syncs the device and never touches the feed/drain hot path
+(the FENXI host<->accelerator isolation discipline, PAPERS.md
+2105.11738). Both query engines (``querier/engine.py`` SQL and
+``querier/promql.py``) wire the tables in as the ``sketch`` datasource.
+"""
+
+from deepflow_tpu.serving.cache import SnapshotCache
+from deepflow_tpu.serving.tables import SketchTables
+
+__all__ = ["SnapshotCache", "SketchTables"]

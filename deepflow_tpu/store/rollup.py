@@ -19,7 +19,7 @@ import functools
 import os
 import shutil
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -41,6 +41,41 @@ _I64_MAX = np.int64(np.iinfo(np.int64).max)
 # rollup_schema ttl sentinel (identity object: no integer the debug
 # socket could pass collides with it): derive 30x the base retention
 TTL_DERIVE = object()
+
+# -- external datasources (ISSUE 7) ----------------------------------------
+# Virtual datasources that live beside the rollup tiers in the
+# `datasource list` surface but are not derived tables — today the
+# serving sketch tables (serving/tables.py), which register a provider
+# callable returning their listing rows. Process-scoped like the
+# default tracer; providers must be cheap (called per debug command).
+_EXTERNAL_DATASOURCES: Dict[str, "Callable[[], List[dict]]"] = {}
+_EXTERNAL_LOCK = threading.Lock()
+
+
+def register_datasource(name: str, provider) -> None:
+    """Register a virtual datasource provider (rows for list)."""
+    with _EXTERNAL_LOCK:
+        _EXTERNAL_DATASOURCES[name] = provider
+
+
+def unregister_datasource(name: str) -> None:
+    with _EXTERNAL_LOCK:
+        _EXTERNAL_DATASOURCES.pop(name, None)
+
+
+def external_datasources() -> List[dict]:
+    """Rows from every registered virtual datasource; a broken provider
+    contributes an error row instead of killing the listing."""
+    with _EXTERNAL_LOCK:
+        providers = dict(_EXTERNAL_DATASOURCES)
+    rows: List[dict] = []
+    for name, provider in sorted(providers.items()):
+        try:
+            rows.extend(provider())
+        except Exception as e:   # the debug socket must still answer
+            rows.append({"table": name, "kind": "external",
+                         "error": str(e)[:200]})
+    return rows
 
 # one shared table for both naming directions; inverse derived
 _NAMED_SUFFIXES = {60: "1m", 3600: "1h", 86400: "1d"}
@@ -387,10 +422,14 @@ class RollupManager:
     # derived tables + watermarks here) -----------------------------------
     def list_datasources(self) -> List[dict]:
         with self._lock:
-            return [{"interval": iv, "table": t.schema.name,
+            rows = [{"interval": iv, "table": t.schema.name,
                      "ttl_seconds": t.schema.ttl_seconds,
                      "built_until": self._built_until[iv]}
                     for iv, t in self.targets]
+        # virtual datasources (ISSUE 7 sketch tables) ride the same
+        # listing — the operator sees every queryable surface in one
+        # `datasource list`
+        return rows + external_datasources()
 
     def add_interval(self, interval: int,
                      ttl_seconds: Optional[int] = TTL_DERIVE) -> dict:

@@ -1592,3 +1592,128 @@ def test_sql_persecond_needs_both_bounds(engine):
     with pytest.raises(ValueError, match="both sides"):
         eng.execute("SELECT PerSecond(Sum(bytes)) AS r FROM flows "
                     "WHERE timestamp < 50")
+
+
+# -- sketch datasource (ISSUE 7 serving read path) -------------------------
+def test_parse_qualified_func():
+    from deepflow_tpu.querier.sql import QualifiedFunc
+    s = parse_sql("SELECT sketch.topk(10) FROM sketch "
+                  "WHERE time >= 100 AND time < 200 LIMIT 5")
+    assert s.table == "sketch" and s.limit == 5
+    assert s.items[0].expr == QualifiedFunc("sketch.topk", (10,))
+    s = parse_sql("SELECT sketch.hll_card() FROM sketch")
+    assert s.items[0].expr == QualifiedFunc("sketch.hll_card", ())
+    # bare dotted idents stay plain columns (rollup tables etc.)
+    s = parse_sql("SELECT sketch.entropy FROM sketch")
+    from deepflow_tpu.querier.sql import Column
+    assert s.items[0].expr == Column("sketch.entropy")
+
+
+@pytest.fixture
+def sketch_served(tmp_path):
+    from deepflow_tpu.models import flow_suite
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+    from deepflow_tpu.serving import SketchTables, SnapshotCache
+
+    cfg = flow_suite.FlowSuiteConfig(cms_log2_width=12, ring_size=256,
+                                     hll_groups=32, hll_precision=8,
+                                     entropy_log2_buckets=8)
+    exp = TpuSketchExporter(cfg=cfg, store=None, batch_rows=2048,
+                            window_seconds=3600, wire="lanes")
+    tables = SketchTables(SnapshotCache(exp.snapshot_bus,
+                                        max_staleness_s=1e9))
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+    rng = np.random.default_rng(11)
+    base = {
+        "ip_src": rng.integers(0, 1 << 30, 64).astype(np.uint32),
+        "ip_dst": rng.integers(0, 1 << 30, 64).astype(np.uint32),
+        "port_src": rng.integers(0, 1 << 16, 64).astype(np.uint32),
+        "port_dst": rng.integers(0, 1 << 16, 64).astype(np.uint32),
+        "proto": rng.integers(0, 255, 64).astype(np.uint32),
+    }
+    for w, now in ((1, 1000.0), (2, 1001.0)):
+        picks = rng.integers(0, 64, 8000)
+        cols = {}
+        for name, dt in L4_SCHEMA.columns:
+            cols[name] = (base[name][picks].astype(dt) if name in base
+                          else rng.integers(0, 1 << 10, 8000).astype(dt))
+        exp.process([("l4_flow_log", 0, cols)])
+        exp.flush_window(now=now)
+    yield exp, tables
+    exp.close()
+
+
+def test_sketch_sql_roundtrip_through_engine(tmp_path, sketch_served):
+    exp, tables = sketch_served
+    eng = QueryEngine(Store(str(tmp_path / "qs")), TagDictRegistry(None),
+                      sketch=tables)
+    res = eng.execute("SELECT sketch.topk(5) FROM sketch")
+    assert res.columns == ["time", "window", "rank", "flow_key", "count"]
+    assert res.values and res.values[0][1] == 2      # latest window
+    assert res.values[0][4] >= res.values[-1][4]     # rank order
+    key = res.values[0][3]
+    res = eng.execute(f"SELECT sketch.cms_point({key}) FROM sketch")
+    assert res.values[0][3] > 0                      # estimate column
+    res = eng.execute("SELECT sketch.entropy FROM sketch "
+                      "WHERE time >= 999 AND time < 1002")
+    assert [r[1] for r in res.values] == [1, 2]      # both windows
+    res = eng.execute("SELECT sketch.hll_card() FROM sketch")
+    assert res.values[0][3] > 0
+    # without serving wired, the table is unknown like any other
+    bare = QueryEngine(Store(str(tmp_path / "qs2")), TagDictRegistry(None))
+    with pytest.raises(KeyError):
+        bare.execute("SELECT sketch.topk(5) FROM sketch")
+
+
+def test_sketch_promql_functions(tmp_path, sketch_served):
+    exp, tables = sketch_served
+    store = Store(str(tmp_path / "ps"))
+    dicts = TagDictRegistry(None)
+    eng = PromEngine(store, dicts, sketch=tables)
+    out = eng.query("sketch_topk(3)", at=1001)
+    assert 0 < len(out) <= 3
+    assert all("flow_key" in r["metric"] for r in out)
+    key = int(out[0]["metric"]["flow_key"])
+    out = eng.query(f"sketch_cms_point({key})", at=1001)
+    assert float(out[0]["value"][1]) > 0
+    out = eng.query("sketch_hll_card()", at=1001)
+    assert float(out[0]["value"][1]) > 0
+    # range query: the entropy timeline across both windows
+    out = eng.query_range("sketch_entropy()", start=1000, end=1001, step=1)
+    feats = {r["metric"]["feature"] for r in out}
+    assert feats == {"ip_src", "ip_dst", "port_src", "port_dst"}
+    assert all(len(r["values"]) == 2 for r in out)
+    # sketch functions compose with the normal evaluator
+    out = eng.query("sum(sketch_topk(3))", at=1001)
+    assert len(out) == 1
+    # unwired engine: crisp error, not a silent empty vector
+    with pytest.raises(ValueError, match="sketch datasource"):
+        PromEngine(store, dicts).query("sketch_topk(3)", at=1001)
+
+
+def test_sketch_http_routes(sketch_served, tmp_path):
+    exp, tables = sketch_served
+    store = Store(str(tmp_path / "hs"))
+    srv = QuerierServer(store, TagDictRegistry(None), port=0,
+                        sketch=tables)
+    srv.start()
+    try:
+        body = "sql=" + urllib.parse.quote(
+            "SELECT sketch.topk(3) FROM sketch")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/query", data=body.encode(),
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            payload = json.load(resp)
+        assert payload["result"]["columns"][2] == "rank"
+        assert payload["result"]["values"]
+        qs = urllib.parse.urlencode({"query": "sketch_entropy()",
+                                     "time": 1001})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/v1/query?{qs}",
+                timeout=5) as resp:
+            out = json.load(resp)
+        assert out["status"] == "success"
+        assert len(out["data"]["result"]) == 4
+    finally:
+        srv.close()

@@ -1,0 +1,452 @@
+"""The ISSUE 7 sketch-serving read path: snapshot bus pub/sub +
+versioning, staleness-bounded cache reads, point-query answers vs the
+device kernels and the exact shadow, and read-vs-ingest isolation
+(bit-identical sketch state with a reader hammering the cache)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.models import flow_suite
+from deepflow_tpu.runtime.snapbus import SnapshotBus
+from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+from deepflow_tpu.serving import SketchTables, SnapshotCache
+from deepflow_tpu.utils.u32 import fold_columns_np
+
+CFG = flow_suite.FlowSuiteConfig(cms_log2_width=12, ring_size=256,
+                                 hll_groups=32, hll_precision=8,
+                                 entropy_log2_buckets=8)
+
+
+def _l4_cols(n, seed=0, pool=64):
+    """Realistic sketch columns: ports < 2^16, proto < 2^8 (the packed
+    lane masks to range — out-of-range synthetic values would fork the
+    flow key between host fold and device wire)."""
+    rng = np.random.default_rng(seed)
+    base = {
+        "ip_src": rng.integers(0, 1 << 30, pool).astype(np.uint32),
+        "ip_dst": rng.integers(0, 1 << 30, pool).astype(np.uint32),
+        "port_src": rng.integers(0, 1 << 16, pool).astype(np.uint32),
+        "port_dst": rng.integers(0, 1 << 16, pool).astype(np.uint32),
+        "proto": rng.integers(0, 255, pool).astype(np.uint32),
+    }
+    picks = rng.integers(0, pool, n)
+    cols = {}
+    for name, dt in L4_SCHEMA.columns:
+        if name in base:
+            cols[name] = base[name][picks].astype(dt)
+        else:
+            cols[name] = rng.integers(0, 1 << 10, n).astype(dt)
+    return cols
+
+
+def _keys_of(cols):
+    return fold_columns_np([cols["ip_src"], cols["ip_dst"],
+                            cols["port_src"], cols["port_dst"],
+                            cols["proto"]])
+
+
+# -- snapshot bus ----------------------------------------------------------
+def test_bus_publish_subscribe_versioning(tmp_path):
+    bus = SnapshotBus(str(tmp_path))
+    state = flow_suite.init(CFG)
+    got = []
+    unsub = bus.subscribe(got.append)
+    s1 = bus.publish(state, 1, wall_time=100.0, tags={"lossy": False})
+    s2 = bus.publish(state, 2, wall_time=101.0)
+    assert [s.step for s in got] == [1, 2]
+    assert s2.seq > s1.seq                      # versioned
+    assert bus.latest().step == 2
+    assert s1.path and os.path.exists(s2.path)
+    # a LATE subscriber gets the current latest immediately
+    late = []
+    bus.subscribe(late.append)
+    assert [s.step for s in late] == [2]
+    # unsubscribe stops delivery
+    unsub()
+    bus.publish(state, 3, wall_time=102.0)
+    assert [s.step for s in got] == [1, 2]
+    assert [s.step for s in late] == [2, 3]
+    # tags + wall time survive the disk round trip (a fresh bus on the
+    # same directory = the restart/companion-process reader)
+    bus2 = SnapshotBus(str(tmp_path))
+    snap = bus2.read_latest()
+    assert snap.step == 3 and snap.wall_time == 102.0
+    lossy_snap = SnapshotBus(str(tmp_path), keep=10)
+    lossy_snap.publish(state, 4, wall_time=103.0, tags={"lossy": True})
+    assert SnapshotBus(str(tmp_path)).read_latest().tags == {"lossy": True}
+
+
+def test_bus_in_memory_only():
+    """directory=None: pub/sub without durability (StorageDisabled)."""
+    bus = SnapshotBus(None)
+    got = []
+    bus.subscribe(got.append)
+    snap = bus.publish(flow_suite.init(CFG), 7, wall_time=5.0,
+                       to_disk=False)
+    assert snap.path is None and got and got[0].step == 7
+    assert bus.latest() is snap
+    assert bus.counters()["saves"] == 0
+    assert bus.counters()["published"] == 1
+
+
+def test_bus_subscriber_error_contained(tmp_path):
+    bus = SnapshotBus(str(tmp_path))
+    good = []
+
+    def bad(_snap):
+        raise RuntimeError("broken reader")
+
+    bus.subscribe(bad)
+    bus.subscribe(good.append)
+    bus.publish(flow_suite.init(CFG), 1)
+    assert good and bus.counters()["subscriber_errors"] == 1
+
+
+def test_bus_fsyncs_file_and_directory(tmp_path, monkeypatch):
+    """The ISSUE 7 durability satellite: the tmp file is fsynced before
+    the rename and the directory after it."""
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (synced.append(fd), real_fsync(fd)))
+    SnapshotBus(str(tmp_path)).publish(flow_suite.init(CFG), 1)
+    assert len(synced) >= 2, "expected file + directory fsync"
+
+
+def test_restore_stashes_last_restored_step(tmp_path):
+    bus = SnapshotBus(str(tmp_path))
+    bus.publish(flow_suite.init(CFG), 5)
+    assert bus.counters()["last_restored_step"] == -1
+    assert bus.restore(flow_suite.init(CFG)) is not None
+    assert bus.counters()["last_restored_step"] == 5
+    # incompatible restore leaves the stash untouched
+    other = flow_suite.FlowSuiteConfig(cms_log2_width=10, ring_size=64,
+                                       hll_groups=8, hll_precision=6,
+                                       entropy_log2_buckets=6)
+    assert bus.restore(flow_suite.init(other)) is None
+    assert bus.counters()["last_restored_step"] == 5
+
+
+# -- staleness-bounded cache ----------------------------------------------
+def test_cache_staleness_miss_and_refresh(tmp_path):
+    clock = [1000.0]
+    writer = SnapshotBus(str(tmp_path))      # the "other process"
+    reader_bus = SnapshotBus(str(tmp_path))
+    cache = SnapshotCache(reader_bus, max_staleness_s=2.0,
+                          clock=lambda: clock[0])
+    state = flow_suite.init(CFG)
+    writer.publish(state, 1, wall_time=999.5)
+    # cold cache: first read is a miss that refreshes from the bus disk
+    snap = cache.latest()
+    assert snap is not None and snap.step == 1
+    assert cache.refreshes == 1 and cache.stale_served == 0
+    assert cache.staleness_s() == pytest.approx(0.5)
+    # fresh enough: no refresh
+    cache.latest()
+    assert cache.refreshes == 1
+    # the writer publishes a newer snapshot; the cache only notices
+    # once its copy goes stale (the re-subscribe/refresh contract)
+    writer.publish(state, 2, wall_time=1003.0)
+    clock[0] = 1004.0
+    snap = cache.latest()
+    assert snap.step == 2 and cache.refreshes == 2
+    assert cache.stale_served == 0
+    # nothing newer exists anywhere: the stale snapshot is served and
+    # counted, never a hang and never a device sync
+    clock[0] = 1010.0
+    snap = cache.latest()
+    assert snap.step == 2 and cache.stale_served == 1
+
+
+def test_cache_window_range_maps_time_bounds(tmp_path):
+    bus = SnapshotBus(str(tmp_path), keep=10)
+    cache = SnapshotCache(bus, max_staleness_s=1e9)
+    state = flow_suite.init(CFG)
+    for step, wall in ((1, 100.0), (2, 101.0), (3, 102.0)):
+        bus.publish(state, step, wall_time=wall)
+    assert [s.step for s in cache.window_range(100.5, 102.5)] == [2, 3]
+    assert [s.step for s in cache.window_range(None, None)] == [1, 2, 3]
+    # a re-publish of the same step (checkpoint_now) supersedes
+    bus.publish(state, 3, wall_time=102.6)
+    got = cache.window_range(None, None)
+    assert [s.step for s in got] == [1, 2, 3]
+    assert got[-1].wall_time == 102.6
+
+
+# -- point queries vs the device kernels + exact shadow --------------------
+@pytest.fixture
+def served(tmp_path):
+    exp = TpuSketchExporter(cfg=CFG, store=None, batch_rows=2048,
+                            window_seconds=3600, wire="lanes",
+                            checkpoint_dir=str(tmp_path / "ckpt"),
+                            audit_rate=1.0)
+    cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=1e9)
+    tables = SketchTables(cache)
+    cols = _l4_cols(20000, seed=3)
+    exp.process([("l4_flow_log", 0, cols)])
+    shadow_counts = dict(exp._audit._counts)     # exact, pre-close
+    out = exp.flush_window(now=1000.0)
+    yield exp, tables, cols, out, shadow_counts
+    exp.close()
+
+
+def test_point_queries_match_device(served):
+    """Every served estimator is the host twin of its device kernel:
+    identical top-K, bit-equal CMS point estimates, same HLL estimate,
+    same entropies — for the very snapshot the device flushed."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.ops import cms
+
+    exp, tables, cols, out, _shadow = served
+    snap = tables.cache.latest()
+    assert snap.step == 1 and snap.wall_time == 1000.0
+
+    # top-K: serving rows == the device flush readout
+    dev_keys = np.asarray(out.topk_keys)
+    dev_counts = np.asarray(out.topk_counts)
+    live = dev_counts > 0
+    rows = tables.topk(int(live.sum()))
+    assert [r["flow_key"] for r in rows] == dev_keys[live].tolist()
+    assert [r["count"] for r in rows] == dev_counts[live].tolist()
+
+    # CMS: rebuild the snapshot state on device, query the same keys
+    treedef = jax.tree_util.tree_structure(flow_suite.init(CFG))
+    st = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(a) for a in snap.leaves])
+    keys = np.unique(_keys_of(cols))[:256]
+    dev_est = np.asarray(cms.query(st.sketch, jnp.asarray(keys)))
+    view = tables._view(snap)
+    np.testing.assert_array_equal(view.cms_points(keys), dev_est)
+    assert all(view.cms_point(int(k)) == int(e)
+               for k, e in zip(keys[:64], dev_est[:64]))
+
+    # HLL cardinality: the flush's distinct_clients number
+    card = tables.hll_card()["cardinality"]
+    assert card == pytest.approx(
+        float(np.asarray(out.service_cardinality).sum()), rel=1e-5)
+
+    # entropy timeline row: the flush's 4 features
+    ent = tables.entropy()
+    dev_ent = np.asarray(out.entropies)
+    from deepflow_tpu.serving.tables import ENTROPY_COLS
+    for i, c in enumerate(ENTROPY_COLS):
+        assert ent[c] == pytest.approx(float(dev_ent[i]), abs=1e-5)
+
+
+def test_point_queries_vs_exact_shadow(served):
+    """CMS point estimates against the PR 6 exact shadow: never under
+    the true count (the CMS invariant) and inside the epsilon bound on
+    the window's heavy hitters."""
+    exp, tables, cols, out, shadow = served
+    snap = tables.cache.latest()
+    view = tables._view(snap)
+    n_total = int(np.asarray(out.rows))
+    assert n_total == 20000
+    eps = np.e / float(1 << CFG.cms_log2_width)
+    heavy = sorted(shadow.items(), key=lambda kv: -kv[1])[:50]
+    for key, exact in heavy:
+        est = view.cms_point(key)
+        assert est >= exact, (key, est, exact)
+        assert (est - exact) / n_total <= eps, (key, est, exact)
+
+
+def test_sql_time_bounds_and_summary(tmp_path):
+    exp = TpuSketchExporter(cfg=CFG, store=None, batch_rows=2048,
+                            window_seconds=3600, wire="lanes")
+    cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=1e9)
+    tables = SketchTables(cache)
+    from deepflow_tpu.querier.sql import parse_sql
+    try:
+        for w, now in ((1, 100.0), (2, 200.0), (3, 300.0)):
+            exp.process([("l4_flow_log", 0, _l4_cols(4000, seed=w))])
+            exp.flush_window(now=now)
+        res = tables.sql(parse_sql(
+            "SELECT sketch.entropy FROM sketch "
+            "WHERE time >= 150 AND time < 301"))
+        assert [r[1] for r in res.values] == [2, 3]   # window column
+        res = tables.sql(parse_sql("SELECT * FROM sketch"))
+        assert res.columns[:3] == ["time", "window", "rows"]
+        assert res.values[0][2] == 4000
+        res = tables.sql(parse_sql(
+            "SELECT sketch.topk(3) FROM sketch LIMIT 2"))
+        assert len(res.values) == 2
+        with pytest.raises(ValueError):
+            tables.sql(parse_sql("SELECT sketch.nope(1) FROM sketch"))
+        with pytest.raises(ValueError):
+            tables.sql(parse_sql(
+                "SELECT sketch.topk(3) FROM sketch WHERE proto = 6"))
+    finally:
+        exp.close()
+
+
+def test_reads_concurrent_with_ingest_bit_identical():
+    """A reader hammering the cache while ingest runs must leave the
+    sketch state bit-identical to a no-readers twin — the read plane
+    provably never touches the write plane."""
+    import jax
+
+    def run(with_reader: bool):
+        exp = TpuSketchExporter(cfg=CFG, store=None, batch_rows=2048,
+                                window_seconds=3600, wire="lanes",
+                                prefetch_depth=2)
+        cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=1e9)
+        tables = SketchTables(cache)
+        exp.process([("l4_flow_log", 0, _l4_cols(6000, seed=1))])
+        exp.flush_window(now=100.0)
+        stop = threading.Event()
+        reads = [0]
+
+        def reader():
+            hot = [r["flow_key"] for r in tables.topk(16)] or [1]
+            i = 0
+            while not stop.is_set():
+                tables.cms_point(hot[i % len(hot)])
+                tables.hll_card()
+                i += 1
+            reads[0] = i
+
+        t = None
+        if with_reader:
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+        for seed in range(2, 6):
+            exp.process([("l4_flow_log", 0, _l4_cols(6000, seed=seed))])
+        assert exp._feed.drain(30)
+        if t is not None:
+            stop.set()
+            t.join(timeout=10)
+            assert reads[0] > 0
+        leaves = [np.asarray(a)
+                  for a in jax.tree_util.tree_leaves(exp.state)]
+        exp.close()
+        return leaves
+
+    with_r = run(True)
+    without_r = run(False)
+    for a, b in zip(with_r, without_r):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- gauges + supervised querier server ------------------------------------
+def test_serving_gauges_emitted(tmp_path):
+    from deepflow_tpu.runtime.tracing import default_tracer
+    tr = default_tracer()
+    tr.enable()
+    try:
+        exp = TpuSketchExporter(cfg=CFG, store=None, batch_rows=2048,
+                                window_seconds=3600, wire="lanes")
+        cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=60.0)
+        tables = SketchTables(cache, tracer=tr)
+        exp.process([("l4_flow_log", 0, _l4_cols(4000, seed=2))])
+        exp.flush_window(now=time.time())
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            tables.cms_point(123)
+            if "querier_read_qps" in tr.gauges():
+                break
+        g = tr.gauges()
+        assert g["querier_read_qps"] > 0
+        assert g["querier_read_p99_s"] > 0
+        assert 0 <= g["sketch_snapshot_staleness_s"] <= 60.0
+        # every serving gauge carries HELP (the strict exposition rule)
+        from deepflow_tpu.runtime.tracing import gauge_help
+        for name in ("querier_read_qps", "querier_read_p99_s",
+                     "sketch_snapshot_staleness_s"):
+            assert gauge_help(name)
+        exp.close()
+    finally:
+        tr.disable()
+
+
+def test_querier_server_supervised(tmp_path):
+    import json
+    import urllib.request
+
+    from deepflow_tpu.querier.server import QuerierServer
+    from deepflow_tpu.runtime.supervisor import default_supervisor
+    from deepflow_tpu.store.db import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+
+    srv = QuerierServer(Store(str(tmp_path)), TagDictRegistry(None),
+                        port=0)
+    srv.start()
+    try:
+        mine = [t for t in default_supervisor().threads()
+                if t["name"] == "querier-http"]
+        assert mine and mine[-1]["alive"] and mine[-1]["crashes"] == 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=5) as r:
+            assert json.load(r)["status"] == "ok"
+    finally:
+        srv.close()
+    mine = [t for t in default_supervisor().threads()
+            if t["name"] == "querier-http"]
+    assert mine[-1]["done"]        # shutdown = normal completion
+
+
+def test_datasource_listing_includes_sketch(tmp_path):
+    from deepflow_tpu.store import rollup
+    exp = TpuSketchExporter(cfg=CFG, store=None, batch_rows=2048,
+                            window_seconds=3600)
+    tables = SketchTables(SnapshotCache(exp.snapshot_bus))
+    tables.register_datasource()
+    try:
+        rows = rollup.external_datasources()
+        names = {r["table"] for r in rows}
+        assert {"sketch.topk", "sketch.cms_point", "sketch.hll_card",
+                "sketch.entropy"} <= names
+    finally:
+        tables.unregister_datasource()
+        exp.close()
+    assert rollup.external_datasources() == []
+
+
+def test_cli_one_shot_snapshot_query(tmp_path, capsys):
+    from deepflow_tpu.cli import main as cli_main
+
+    ck = str(tmp_path / "ckpt")
+    exp = TpuSketchExporter(cfg=CFG, store=None, batch_rows=2048,
+                            window_seconds=3600, checkpoint_dir=ck)
+    exp.process([("l4_flow_log", 0, _l4_cols(8000, seed=9))])
+    exp.flush_window(now=1234.0)
+    exp.close()
+    assert cli_main(["query", "--snapshots", ck,
+                     "SELECT sketch.topk(3) FROM sketch"]) == 0
+    out = capsys.readouterr().out
+    assert "flow_key" in out and "1234" in out
+    # non-sketch SQL is refused crisply in snapshot mode
+    assert cli_main(["query", "--snapshots", ck,
+                     "SELECT * FROM flows"]) == 2
+
+
+def test_read_latest_caches_unchanged_disk_snapshot(tmp_path):
+    """A polling reader against a quiet companion-process store must get
+    the SAME snapshot object back (one seq, one npz load) — not a fresh
+    load per query; a re-published file IS re-read."""
+    writer = SnapshotBus(str(tmp_path))
+    reader = SnapshotBus(str(tmp_path))
+    state = flow_suite.init(CFG)
+    writer.publish(state, 1, wall_time=100.0)
+    a = reader.read_latest()
+    b = reader.read_latest()
+    assert a is b and a.seq == b.seq
+    # the cached object also serves the stale-cache refresh path
+    # without growing the deque or the view cache
+    cache = SnapshotCache(reader, max_staleness_s=0.0)
+    tables = SketchTables(cache)
+    for _ in range(32):
+        tables.topk(3)
+    assert cache.counters()["cached"] == 1
+    assert len(tables._views) == 1
+    # content change at the same path: must be re-read
+    time.sleep(0.02)
+    writer.publish(state, 1, wall_time=105.0)
+    c = reader.read_latest()
+    assert c is not a and c.wall_time == 105.0
