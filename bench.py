@@ -250,7 +250,7 @@ def main() -> None:
                                            SKETCH_L4_SCHEMA,
                                            SKETCH_LANES_SCHEMA,
                                            SKETCH_NEWS_SCHEMA)
-    from deepflow_tpu.decode import native
+    from deepflow_tpu.decode import columnar, native
     from deepflow_tpu.models import flow_dict, flow_suite
     from deepflow_tpu.replay.generator import SyntheticAgent
     from deepflow_tpu.wire import columnar_wire
@@ -753,6 +753,76 @@ def main() -> None:
             hs_rows += len(next(iter(c.values())))
     host_fallback_rate = hs_rows / (time.perf_counter() - t0)
 
+    # -- timed: host decode->staging floor (ISSUE 9) -----------------------
+    # Host-only rec/s of the chunk -> staged-device-bytes paths: the
+    # TensorBatch reference (chunk -> Batcher copy -> pack into the
+    # coalesced slot) vs the zero-copy stager (chunk -> staging buffer,
+    # ONE copy), plus the flow-hash-sharded pack pool. Pure host work,
+    # no device — this is the ceiling the feed can keep the chip fed
+    # at, tracked beside feed_overlap so a decode regression is visible
+    # even when the device number is tunnel-noisy.
+    _phase("timed: host decode->staging floor", budget=3600.0)
+    from deepflow_tpu.batch.batcher import Batcher
+    from deepflow_tpu.batch.staging import LaneStager, PackPool
+
+    stage_C = 1 << 16
+
+    def _stage_rate(run_chunk, seconds=0.5):
+        rows = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            for c in schema_batches:
+                run_chunk(c)
+                rows += batch
+        return rows / (time.perf_counter() - t0)
+
+    stage_flat = np.empty(flow_suite.coalesced_lanes_words(1, stage_C),
+                          np.uint32)
+    stage_batcher = Batcher(SKETCH_L4_SCHEMA, capacity=stage_C)
+
+    def _tb_stage(c):
+        for tb in stage_batcher.put(c):
+            stage_flat[0] = tb.valid
+            flow_suite.pack_lanes_into(
+                tb.columns, flow_suite.slot_plane(stage_flat, 0, stage_C))
+            stage_batcher.recycle(tb)
+
+    tb_stage_rate = _stage_rate(_tb_stage)
+
+    zc_stager = LaneStager(stage_C, group_batches=1, pool_cap=4)
+
+    def _zc_stage(c):
+        for sg in zc_stager.put(c):
+            sg.wait_ready(timeout=30.0)
+            zc_stager.recycle(sg)
+
+    zc_stage_rate = _stage_rate(_zc_stage)
+
+    try:
+        stage_workers = min(4, len(os.sched_getaffinity(0)))
+    except AttributeError:
+        stage_workers = min(4, os.cpu_count() or 1)
+    stage_pool = PackPool(stage_workers, name="bench-stage-pack")
+    pool_stager = LaneStager(stage_C, group_batches=1, pool=stage_pool,
+                             pool_cap=4)
+
+    def _pool_stage(c):
+        for sg in pool_stager.put(c):
+            sg.wait_ready(timeout=30.0)
+            pool_stager.recycle(sg)
+
+    pool_stage_rate = _stage_rate(_pool_stage)
+    stage_pool.close()
+    decode_stats = {
+        "tensorbatch_records_per_sec": round(tb_stage_rate),
+        "zero_copy_records_per_sec": round(zc_stage_rate),
+        "zero_copy_pooled_records_per_sec": round(pool_stage_rate),
+        "pack_workers": stage_workers,
+        "zero_copy_speedup": round(
+            zc_stage_rate / max(tb_stage_rate, 1.0), 3),
+        "hash_cache": columnar.hash_cache_counters(),
+    }
+
     # -- timed: overlapped device feed (ISSUE 5) ---------------------------
     # The production exporter hot path with the coalesced feed on:
     # TensorBatches cross as ONE staged transfer each, a supervised
@@ -764,18 +834,26 @@ def main() -> None:
     _phase("timed: feed overlap e2e")
     from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
 
-    feed_exp = TpuSketchExporter(
-        store=None, window_seconds=3600, batch_rows=1 << 16,
-        wire="lanes", prefetch_depth=2, coalesce_batches=2)
-    feed_exp.process([("l4_flow_log", 0, schema_batches[0])])  # warm/compile
-    feed_exp._feed.drain()
-    t0 = time.perf_counter()
-    for i in range(iters):
-        feed_exp.process([("l4_flow_log", 0,
-                           schema_batches[i % n_batches])])
-    feed_exp._feed.drain()
-    feed_rate = batch * iters / (time.perf_counter() - t0)
-    feed_batches = max(feed_exp.batcher.emitted_batches, 1)
+    def _feed_run(**kw):
+        exp = TpuSketchExporter(
+            store=None, window_seconds=3600, batch_rows=1 << 16,
+            wire="lanes", prefetch_depth=2, coalesce_batches=2, **kw)
+        exp.process([("l4_flow_log", 0, schema_batches[0])])  # warm/compile
+        exp._feed.drain()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            exp.process([("l4_flow_log", 0,
+                          schema_batches[i % n_batches])])
+        exp._feed.drain()
+        return exp, batch * iters / (time.perf_counter() - t0)
+
+    # zero-copy is the production default (ISSUE 9): decoded chunks
+    # stage straight into the recycled coalesced buffer; the TensorBatch
+    # reference run quantifies what deleting the middle copy bought
+    feed_exp, feed_rate = _feed_run()
+    # batches counted at the stager on the zero-copy path (the
+    # TensorBatch batcher never runs there)
+    feed_batches = max(feed_exp.counters()["batches"], 1)
     feed_stats = {
         "records_per_sec": round(feed_rate),
         "device_busy_fraction": round(
@@ -786,8 +864,16 @@ def main() -> None:
             feed_exp.dispatches / feed_batches, 3),
         "prefetch_depth": feed_exp.prefetch_depth,
         "coalesce_batches": feed_exp.coalesce_batches,
+        "zero_copy": 1 if feed_exp.zero_copy else 0,
     }
     feed_exp.close()
+    _recover()
+    _phase("timed: feed overlap e2e (TensorBatch reference)")
+    tb_exp, tb_feed_rate = _feed_run(zero_copy=False)
+    feed_stats["records_per_sec_tensorbatch"] = round(tb_feed_rate)
+    feed_stats["zero_copy_speedup"] = round(
+        feed_rate / max(tb_feed_rate, 1.0), 3)
+    tb_exp.close()
     _recover()
 
     # -- timed: audit overhead (ISSUE 6) -----------------------------------
@@ -929,6 +1015,7 @@ def main() -> None:
                  "bytes_per_record": round(dict_b_per_rec, 2)},
         "host_fallback": {"records_per_sec": round(host_fallback_rate),
                           "stride": 4},
+        "decode": decode_stats,
     }
     print(f"[bench] stage_breakdown: {stage_breakdown}", file=sys.stderr,
           flush=True)

@@ -157,6 +157,35 @@ def main() -> None:
                   lambda: jnp.zeros((4, 1 << 16), jnp.float32), idx16,
                   rows=n)
 
+        # ISSUE 9 whole-step A/B at the staged-lane production shape:
+        # update over one staged plane with the CMS+entropy histogram
+        # half unfused (XLA) vs fused into the single Pallas kernel
+        # (ops/pallas_sketch.py) — the on-silicon verdict its STATUS
+        # note calls for. Bit-identical outputs within the 2^24
+        # cell-sum bound (tests/test_staging.py, ops/pallas_sketch.py);
+        # this measures only the dispatch/residency difference.
+        from deepflow_tpu.models import flow_suite as fs
+
+        lane_plane = jnp.asarray(
+            rng.integers(0, 1 << 32, (4, n), dtype=np.uint32))
+        lane_n = jnp.uint32(n)
+        cfg_u = fs.FlowSuiteConfig(fused_hists=False)
+        cfg_f = fs.FlowSuiteConfig(fused_hists=True)
+
+        def lanes_step_unfused(s, p, m):
+            lanes = {"ip_src": p[0], "ip_dst": p[1],
+                     "ports": p[2], "proto_pkts": p[3]}
+            mask = jnp.arange(p.shape[1]) < m
+            return fs.update(s, fs.unpack_lanes(lanes), mask, cfg_u)
+
+        bench("lanes_step_unfused", f"[4,{n}] staged plane, prod cfg",
+              lanes_step_unfused, lambda: fs.init(cfg_u),
+              lane_plane, lane_n, rows=n)
+        bench("lanes_step_fused_pallas",
+              f"[4,{n}] staged plane, prod cfg",
+              lambda s, p, m: fs.update_lanes_fused(s, p, m, cfg_f),
+              lambda: fs.init(cfg_f), lane_plane, lane_n, rows=n)
+
     # -- topk admission ----------------------------------------------------
     # populated, NON-donated sketch shared by the ring benches
     query_sketch = jax.jit(cms.update)(cms_init(), keys)

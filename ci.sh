@@ -305,8 +305,12 @@ chunks = [{k: v[rng.integers(0, 512, 3000)] for k, v in pool.items()}
           for _ in range(6)]
 base = TpuSketchExporter(store=None, window_seconds=3600, batch_rows=1024,
                          wire="lanes", prefetch_depth=0)
+# zero_copy pinned OFF: this smoke proves the ISSUE 5 TensorBatch feed
+# (the bit-identity REFERENCE); the decode smoke below proves the
+# ISSUE 9 zero-copy stager against it
 feed = TpuSketchExporter(store=None, window_seconds=3600, batch_rows=1024,
-                         wire="lanes", prefetch_depth=2, coalesce_batches=2)
+                         wire="lanes", prefetch_depth=2, coalesce_batches=2,
+                         zero_copy=False)
 for c in chunks:
     base.process([("l4_flow_log", 0, c)])
     feed.process([("l4_flow_log", 0, c)])
@@ -334,6 +338,125 @@ tr.disable()
 print(f"feed OK: {batches} batches, transfers {base.h2d_transfers} -> "
       f"{feed.h2d_transfers}, dispatches {base.dispatches} -> "
       f"{feed.dispatches}, state bit-identical")
+EOF
+
+echo "== decode smoke: zero-copy staging bit-identical, host floor, busy gauge =="
+# ISSUE 9: the zero-copy decode->staging path. Zero-copy on/off (and the
+# flow-hash sharded pack pool) must land the exact same sketch state;
+# the host staging floor must be measured and the zero-copy path must
+# not regress the TensorBatch reference; and a live lanes-wire ingester
+# must serve tpu_device_busy_fraction and the decode hash-cache
+# counters off /metrics.
+python - <<'EOF'
+import socket, time, urllib.request
+import numpy as np
+import jax
+from deepflow_tpu.batch.schema import L4_SCHEMA, SKETCH_L4_SCHEMA
+from deepflow_tpu.batch.staging import LaneStager, PackPool
+from deepflow_tpu.batch.batcher import Batcher
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.models import flow_suite
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+# -- zero-copy on/off (and sharded pack) state equality ------------------
+rng = np.random.default_rng(9)
+pool = {name: rng.integers(0, 1 << 12, 512).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+chunks = [{k: v[rng.integers(0, 512, 3000)] for k, v in pool.items()}
+          for _ in range(6)]
+mk = lambda **kw: TpuSketchExporter(
+    store=None, window_seconds=3600, batch_rows=1024, wire="lanes",
+    prefetch_depth=2, coalesce_batches=2, **kw)
+ref, zc, zcp = mk(zero_copy=False), mk(), mk(pack_workers=2)
+assert zc.zero_copy and zcp.zero_copy and not ref.zero_copy
+# compare at the WINDOW boundary (the consistency contract): the stager
+# may park complete slots in its open group buffer mid-stream, but every
+# flush ships the prefix — identical batch partition, identical output
+for c in chunks:
+    for e in (ref, zc, zcp):
+        e.process([("l4_flow_log", 0, c)])
+outs = [e.flush_window() for e in (ref, zc, zcp)]
+for o in outs[1:]:
+    for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(o)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+zc_counters = zcp.counters()
+assert zc_counters["staged_rows"] == 6 * 3000, zc_counters
+assert zc_counters["pack_tasks"] > 0 and zc_counters["pack_task_errors"] == 0
+for e in (ref, zc, zcp):
+    e.close()
+
+# -- host decode->staging floor: zero-copy must not regress --------------
+C = 4096
+sk_chunks = [{name: rng.integers(0, 1 << 12, 10_000).astype(dt)
+              for name, dt in SKETCH_L4_SCHEMA.columns} for _ in range(4)]
+
+def rate(fn):
+    rows = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.5:
+        for c in sk_chunks:
+            fn(c)
+            rows += 10_000
+    return rows / (time.perf_counter() - t0)
+
+flat = np.empty(flow_suite.coalesced_lanes_words(1, C), np.uint32)
+b = Batcher(SKETCH_L4_SCHEMA, capacity=C)
+def tb_path(c):
+    for tb in b.put(c):
+        flat[0] = tb.valid
+        flow_suite.pack_lanes_into(tb.columns, flow_suite.slot_plane(flat, 0, C))
+        b.recycle(tb)
+st = LaneStager(C, group_batches=1, pool_cap=4)
+def zc_path(c):
+    for sg in st.put(c):
+        sg.wait_ready(timeout=30.0)
+        st.recycle(sg)
+tb_rate, zc_rate = rate(tb_path), rate(zc_path)
+assert zc_rate > 1_000_000, f"zero-copy staging floor: {zc_rate:.0f} rec/s"
+assert zc_rate > 0.8 * tb_rate, \
+    f"zero-copy regressed the TensorBatch pack: {zc_rate:.0f} vs {tb_rate:.0f}"
+
+# -- live lanes-wire ingester: busy gauge + hash-cache on /metrics -------
+ing = Ingester(IngesterConfig(
+    listen_port=0, prom_port=0, tpu_sketch_window_s=0.5,
+    tpu_sketch_wire="lanes", pack_workers=2),
+    platform=PlatformDataManager())
+assert ing.tpu_sketch.zero_copy, "lanes-wire ingester must stage zero-copy"
+ing.start()
+cols = {name: rng.integers(0, 1 << 8, 500).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+sent = 0
+deadline = time.time() + 6.0
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    while time.time() < deadline and sent < 50_000:
+        s.sendall(frame); sent += 500
+deadline = time.time() + 10.0
+while time.time() < deadline:
+    if ing.tpu_sketch.rows_in >= sent:
+        break
+    time.sleep(0.1)
+assert ing.tpu_sketch.rows_in >= sent, \
+    f"sketch lane stalled: {ing.tpu_sketch.rows_in} < {sent}"
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing.prom_port}/metrics", timeout=10) as resp:
+    text = resp.read().decode()
+assert not validate_exposition(text)
+for needle in ("tpu_device_busy_fraction",
+               "deepflow_decode_hash_cache_hash_cache_hits",
+               "deepflow_exporter_tpu_sketch_staged_rows",
+               "deepflow_exporter_tpu_sketch_pack_tasks"):
+    assert needle in text, f"{needle} absent from /metrics"
+ing.close()
+print(f"decode OK: state bit-identical (zero-copy, sharded pack), host floor "
+      f"TensorBatch {tb_rate/1e6:.1f}M -> zero-copy {zc_rate/1e6:.1f}M rec/s, "
+      f"{sent} records through the live lanes ingester, busy gauge served")
 EOF
 
 echo "== audit smoke: exact-shadow recall + degraded conservation =="
@@ -575,6 +698,14 @@ assert d["stage_breakdown"]["host_fallback"]["records_per_sec"] > 0
 # TPU at the default rate; CPU smoke only asserts the measurement runs)
 audit = d["stage_breakdown"]["audit"]
 assert audit["records_per_sec"] > 0 and 0 <= audit["overhead_frac"] <= 1
+# the host decode->staging floor (ISSUE 9): both paths measured, the
+# feed phase runs zero-copy with the TensorBatch reference beside it
+dec = d["stage_breakdown"]["decode"]
+assert dec["tensorbatch_records_per_sec"] > 0, dec
+assert dec["zero_copy_records_per_sec"] > 0, dec
+assert dec["zero_copy_pooled_records_per_sec"] > 0, dec
+fo = d["stage_breakdown"]["feed_overlap"]
+assert fo["zero_copy"] == 1 and fo["records_per_sec_tensorbatch"] > 0, fo
 # the serving read path (ISSUE 7 acceptance): >= 50k point-query QPS
 # against a live ingest, with the read-hammered run's sketch state
 # bit-identical to the no-readers twin

@@ -172,7 +172,7 @@ class EmitUnderLock(Checker):
 _DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
                          "runtime/feed.py", "runtime/audit.py",
                          "runtime/profiler.py", "serving/cache.py",
-                         "serving/tables.py")
+                         "serving/tables.py", "batch/staging.py")
 # the sampled-drain helpers where a blocking sync is the point: explicit
 # attribution drains on every Nth batch / cold compile (PR 1), the
 # degraded-mode device probe (PR 2), the overlapped feed's
@@ -190,9 +190,13 @@ _SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
 # per-FILE sanctions: the ISSUE 7 serving read path is under the rule
 # with the stale-cache `refresh` (a bus/disk re-read, never the device)
 # its only sanctioned sync — scoped to cache.py because "refresh" is
-# far too common a method name to exempt across every device-path file
+# far too common a method name to exempt across every device-path file.
+# The ISSUE 9 zero-copy stager is under the rule to stay host-pure
+# (its buffers feed the device transfer; a device sync here would
+# serialize the pack against the chip) — no sanctioned syncs at all.
 _SANCTIONED_SYNCS_BY_FILE = {
     "serving/cache.py": frozenset(["refresh"]),
+    "batch/staging.py": frozenset(),
 }
 
 

@@ -14,6 +14,7 @@ l7_flow_log.go L7Base/L7FlowLog); strings become u32 dictionary hashes
 
 from __future__ import annotations
 
+import functools
 import zlib
 from typing import Dict, Iterable, List
 
@@ -47,6 +48,27 @@ def _fnv1a32(data: bytes) -> int:
     return h
 
 
+# The same endpoint/domain/service strings (and v6 addresses) recur on
+# every batch for the lifetime of a service, but every occurrence
+# re-ran byte-at-a-time FNV-1a in Python — pure host decode time for
+# zero new information (ISSUE 9). Bounded LRU over the PURE hash only:
+# TagDict codes stay on the dict's own map (encode_one records the
+# reversible mapping; caching its result here would pin codes across a
+# dict reset). lru_cache is thread-safe for the parallel decoder fleet
+# and its cache_info() feeds the hash_cache Countable.
+_HASH_CACHE_CAP = 1 << 16
+_fnv1a32_cached = functools.lru_cache(maxsize=_HASH_CACHE_CAP)(_fnv1a32)
+
+
+def hash_cache_counters() -> Dict[str, int]:
+    """Countable for the string-hash LRU (registered once per process
+    by FlowLogPipeline as `decode.hash_cache`)."""
+    info = _fnv1a32_cached.cache_info()
+    return {"hash_cache_hits": info.hits,
+            "hash_cache_misses": info.misses,
+            "hash_cache_size": info.currsize}
+
+
 def _hash_str(s: str, endpoint_dict=None) -> int:
     """String -> u32 dictionary code. Empty maps to 0 (the null image of
     the reference's Nullable string columns); with a TagDict the code is
@@ -55,7 +77,7 @@ def _hash_str(s: str, endpoint_dict=None) -> int:
     if not s:
         return 0
     return endpoint_dict.encode_one(s) if endpoint_dict is not None \
-        else _fnv1a32(s.encode())
+        else _fnv1a32_cached(s.encode())
 
 
 def _u32(v: int) -> int:
@@ -80,7 +102,7 @@ def _ip_u32(ip4: int, ip6: bytes) -> int:
     """v4 address, or the system-wide class-E-confined fold of a v6
     address (store.dict_store.fold_ipv6; is_ipv6 marks which) — the
     same u32 the capture path produces for the same address."""
-    return (_fnv1a32(ip6) | 0xF0000000) if ip6 else _u32(ip4)
+    return (_fnv1a32_cached(ip6) | 0xF0000000) if ip6 else _u32(ip4)
 
 
 def _l4_status(close_type: int, proto: int) -> int:
@@ -417,7 +439,7 @@ def decode_metric_records(records: Iterable[bytes],
         except Exception:
             continue
         fld = d.tag.field
-        ip = (_fnv1a32(fld.ip) | 0xF0000000) if len(fld.ip) == 16 else (
+        ip = (_fnv1a32_cached(fld.ip) | 0xF0000000) if len(fld.ip) == 16 else (
             int.from_bytes(fld.ip, "big") if fld.ip else 0)
         t = d.meter.flow.traffic
         p = d.meter.flow.performance

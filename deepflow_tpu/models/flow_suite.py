@@ -51,6 +51,13 @@ class FlowSuiteConfig:
     # Admit a 1/2^s stride-sample of lanes to the top-K ring per batch
     # (scores stay full-sketch; see ops/topk.py:offer).
     topk_sample_log2: int = 4
+    # Fused Pallas unpack+sketch kernel (ops/pallas_sketch.py): the CMS
+    # and entropy histogram passes of a staged lane batch run as ONE
+    # VMEM-resident kernel with the unpack prologue inlined. None =
+    # auto (TPU backend + DEEPFLOW_SKETCH_PALLAS=1 opt-in only — the
+    # ops/pallas_hist.py posture); True forces it (interpreted off-TPU,
+    # the correctness-test path); False never.
+    fused_hists: bool | None = None
     seed: int = 0xDEC0DE
 
 
@@ -95,22 +102,36 @@ def service_key(cols: Dict[str, jnp.ndarray]) -> jnp.ndarray:
 
 
 def _advance_sketches(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
-                      mask: jnp.ndarray, cfg: FlowSuiteConfig):
-    """Everything except ring admission — shared by the fused `update`
-    and the staged pipeline so the two paths cannot drift. Returns the
-    advanced state (ring untouched) plus the batch flow keys."""
+                      mask: jnp.ndarray, cfg: FlowSuiteConfig,
+                      hists=None):
+    """Everything except ring admission — shared by the fused `update`,
+    the staged pipeline and the Pallas-fused lane path so the paths
+    cannot drift. Returns the advanced state (ring untouched) plus the
+    batch flow keys. `hists` (the fused kernel's precomputed
+    (cms_hist, ent_hist) f32 deltas) replaces the CMS/entropy histogram
+    ops only; HLL, row/batch bookkeeping and key derivation stay the
+    one definition here."""
     fkey = flow_key(cols)
     skey = service_key(cols)
-    upd = cms.update_conservative if cfg.conservative else cms.update
-    sketch = upd(state.sketch, fkey, mask=mask)
+    if hists is None:
+        upd = cms.update_conservative if cfg.conservative else cms.update
+        sketch = upd(state.sketch, fkey, mask=mask)
+        feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
+        packets = cols["packet_tx"] + cols["packet_rx"]
+        # 2 weight planes: per-record packet counts saturate at 65535
+        # (ample for 1s flow ticks); the third plane cost a full matmul
+        # pass
+        ent = entropy.update(state.ent, feats, packets.astype(jnp.int32),
+                             mask, weight_planes=2)
+    else:
+        cms_h, ent_h = hists
+        sketch = state.sketch._replace(
+            counts=state.sketch.counts
+            + cms_h.astype(state.sketch.counts.dtype))
+        ent = state.ent._replace(
+            hist=state.ent.hist + ent_h.astype(state.ent.hist.dtype))
     group = (skey % np.uint32(cfg.hll_groups)).astype(jnp.int32)
     services = hll.update(state.services, group, cols["ip_src"], mask=mask)
-    feats = jnp.stack([cols[f] for f in ENTROPY_FEATURES])
-    packets = cols["packet_tx"] + cols["packet_rx"]
-    # 2 weight planes: per-record packet counts saturate at 65535
-    # (ample for 1s flow ticks); the third plane cost a full matmul pass
-    ent = entropy.update(state.ent, feats, packets.astype(jnp.int32), mask,
-                         weight_planes=2)
     mid = FlowSuiteState(
         sketch=sketch,
         ring=state.ring,
@@ -172,11 +193,32 @@ def pack_lanes_into(cols: Dict[str, np.ndarray], out: np.ndarray) -> None:
 
 
 # Coalesced staging layout for K packed-lane batches of capacity C
-# (flat uint32, ONE transfer): [n_0..n_{K-1} | plane_0 (4*C) | ... |
-# plane_{K-1}]. The program recovers each batch's mask on device from
-# its n word, so not even the bool mask crosses the link any more.
+# (flat uint32, ONE transfer): K slot-contiguous records, slot k at
+# [k*(1+4C), (k+1)*(1+4C)) holding [n_k | plane_k (4*C)]. The program
+# recovers each batch's mask on device from its n word, so not even
+# the bool mask crosses the link. Slot-contiguity (vs the ISSUE 5
+# header-block layout) is what makes PREFIX emission possible: a
+# partially-filled staging buffer of k < K complete slots is already a
+# valid k-batch coalesced transfer — the zero-copy stager
+# (batch/staging.py) fills slots in place and ships whatever is
+# complete at a window boundary without moving a byte.
+def slot_words(capacity: int) -> int:
+    return 1 + 4 * capacity
+
+
 def coalesced_lanes_words(k_batches: int, capacity: int) -> int:
-    return k_batches + 4 * capacity * k_batches
+    return k_batches * slot_words(capacity)
+
+
+def slot_plane(flat: np.ndarray, k: int, capacity: int) -> np.ndarray:
+    """(4, C) uint32 view of slot k's lane plane inside a coalesced
+    staging buffer — the destination `pack_lanes_into` (or a sharded
+    pack worker) writes without any intermediate copy. Callers stamp
+    the slot's n word at `flat[k * slot_words(capacity)]` themselves:
+    valid-row counts come from the batch (TensorBatch.valid, the
+    stager's fill cursor), never from a column length."""
+    s = slot_words(capacity)
+    return flat[k * s + 1:(k + 1) * s].reshape(4, capacity)
 
 
 def make_coalesced_update(cfg: FlowSuiteConfig, k_batches: int,
@@ -190,31 +232,86 @@ def make_coalesced_update(cfg: FlowSuiteConfig, k_batches: int,
     whose phase rides state.batches_seen exactly as before. Returns
     fn(state, flat) -> (state, fence) with `state` donated and `fence`
     a small fresh scalar the feed can block on without touching the
-    donated chain."""
+    donated chain.
+
+    When the fused Pallas unpack+sketch kernel is enabled (see
+    ops/pallas_sketch.py and `use_fused_hists`), the CMS + entropy
+    histogram work of each batch runs as ONE VMEM-resident kernel with
+    the lane unpack inlined; HLL/ring/counters stay XLA. Off by
+    default — the kernel is opt-in exactly like ops/pallas_hist.py."""
     K, C = int(k_batches), int(capacity)
+    fused = use_fused_hists(cfg)
 
     def _one(state: FlowSuiteState, plane: jnp.ndarray,
              n: jnp.ndarray) -> FlowSuiteState:
+        if fused:
+            return update_lanes_fused(state, plane, n, cfg)
         lanes = {"ip_src": plane[0], "ip_dst": plane[1],
                  "ports": plane[2], "proto_pkts": plane[3]}
         mask = jnp.arange(plane.shape[1]) < n
         return update(state, unpack_lanes(lanes), mask, cfg)
 
     def prog(state: FlowSuiteState, flat: jnp.ndarray):
-        ns = flat[:K]
+        slots = flat.reshape(K, slot_words(C))
         if K == 1:                     # no scan machinery for the common case
-            out = _one(state, flat[K:].reshape(4, C), ns[0])
-            return out, ns[0] + jnp.uint32(0)
-        planes = flat[K:].reshape(K, 4, C)
+            out = _one(state, slots[0, 1:].reshape(4, C), slots[0, 0])
+            return out, slots[0, 0] + jnp.uint32(0)
 
-        def body(s, xs):
-            plane, n = xs
-            return _one(s, plane, n), None
+        def body(s, slot):
+            return _one(s, slot[1:].reshape(4, C), slot[0]), None
 
-        out, _ = jax.lax.scan(body, state, (planes, ns))
-        return out, jnp.sum(ns)
+        out, _ = jax.lax.scan(body, state, slots)
+        return out, jnp.sum(slots[:, 0])
 
     return jax.jit(prog, donate_argnums=0)
+
+
+def use_fused_hists(cfg: FlowSuiteConfig) -> bool:
+    """Dispatch for the fused Pallas unpack+sketch kernel: forced by
+    `cfg.fused_hists` True/False; None (auto) takes it only on a real
+    TPU backend under the DEEPFLOW_SKETCH_PALLAS=1 opt-in — the same
+    conservative posture as ops/mxu_hist._use_pallas, and for the same
+    reason: off-TPU it would run interpreted (correct, slow), and the
+    tunneled dev chip can't validate kernel perf claims. Conservative
+    CMS update has no fused form (it needs a batch sort + scatter-max)."""
+    import os
+
+    if cfg.conservative:
+        return False
+    if cfg.fused_hists is not None:
+        return bool(cfg.fused_hists)
+    return (jax.default_backend() in ("tpu", "axon")
+            and os.environ.get("DEEPFLOW_SKETCH_PALLAS", "") == "1")
+
+
+def update_lanes_fused(state: FlowSuiteState, plane: jnp.ndarray,
+                       n: jnp.ndarray,
+                       cfg: FlowSuiteConfig) -> FlowSuiteState:
+    """`update` over one staged lane plane with the CMS + entropy
+    histogram passes fused into a single Pallas kernel (in-kernel
+    unpack + fold + bucket hashing, VMEM-resident accumulators —
+    ops/pallas_sketch.py). HLL's scatter-max, the top-K ring and the
+    window counters stay the one `_advance_sketches` definition, XLA
+    ops in the same jitted program. Bit-exact with the unfused path
+    while every histogram cell stays an integer sum below 2^24 — the
+    regime tests/test_staging.py asserts leaf equality in; past it the
+    two paths' f32 partial-sum orders differ and entropy cells may
+    round apart (see `fused_lane_hists` for the bound)."""
+    from deepflow_tpu.ops import pallas_sketch
+
+    cols = unpack_lanes({"ip_src": plane[0], "ip_dst": plane[1],
+                         "ports": plane[2], "proto_pkts": plane[3]})
+    mask = jnp.arange(plane.shape[1]) < n
+    hists = pallas_sketch.fused_lane_hists(
+        plane, n, state.sketch.seeds, state.ent.seeds,
+        cms_log2_width=cfg.cms_log2_width,
+        ent_log2_buckets=cfg.entropy_log2_buckets,
+        interpret=jax.default_backend() not in ("tpu", "axon"))
+    mid, fkey = _advance_sketches(state, cols, mask, cfg, hists=hists)
+    ring = topk.offer(state.ring, fkey, mid.sketch, mask=mask,
+                      sample_log2=cfg.topk_sample_log2,
+                      phase=state.batches_seen)
+    return mid._replace(ring=ring)
 
 
 def unpack_lanes(lanes: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
@@ -230,6 +327,26 @@ def unpack_lanes(lanes: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         "proto": lanes["proto_pkts"] >> u(24),
         "packet_tx": lanes["proto_pkts"] & u(0xFFFFFF),
         "packet_rx": jnp.zeros_like(lanes["ip_src"]),
+    }
+
+
+def unpack_lanes_np(plane: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+    """Host twin of `unpack_lanes` over one (4, C) staged plane,
+    trimmed to the n valid rows — what degraded mode consumes when a
+    staged group must be absorbed by the host-numpy fallback sketch
+    after the device is lost: the lanes ARE the batch by then (the
+    zero-copy path never materialized a TensorBatch). Same packet
+    split as the device unpack (tx carries the capped sum, rx zero),
+    so the fallback sees exactly what the device would have."""
+    u = np.uint32
+    return {
+        "ip_src": plane[0, :n],
+        "ip_dst": plane[1, :n],
+        "port_src": plane[2, :n] >> u(16),
+        "port_dst": plane[2, :n] & u(0xFFFF),
+        "proto": plane[3, :n] >> u(24),
+        "packet_tx": plane[3, :n] & u(0xFFFFFF),
+        "packet_rx": np.zeros(n, u),
     }
 
 

@@ -49,6 +49,15 @@ from jax.experimental.pallas import tpu as pltpu
 from deepflow_tpu.ops.mxu_hist import _split_hi_lo
 
 
+def tpu_compiler_params(**kw):
+    """Compat shim: pltpu.CompilerParams was TPUCompilerParams on the
+    jax 0.4.x line this repo pins (the PR 1 conftest shims' sibling).
+    One definition for every Pallas kernel in ops/."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _kernel(idx_ref, w_ref, out_ref, *, d, width, hi_n, lo_n, planes):
     @pl.when(pl.program_id(0) == 0)
     def _init():
@@ -121,7 +130,7 @@ def hist_pallas(idx: jnp.ndarray, width: int,
         out_specs=pl.BlockSpec((d, hi_n, lo_n), lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((d, hi_n, lo_n), jnp.float32),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
     )(idx, weights)
     return out.reshape(d, width)

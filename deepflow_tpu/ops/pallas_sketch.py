@@ -1,0 +1,207 @@
+"""Fused Pallas unpack+sketch kernel: one VMEM-resident pass per batch.
+
+The coalesced feed (ISSUE 5) got the batch across the link as one
+transfer; what is left of the per-batch device cost is XLA scheduling
+the step as SEPARATE histogram passes — the CMS rides mxu_hist's scan,
+the entropy histogram rides another, and each re-reads the unpacked
+lane columns from HBM. This kernel fuses the whole histogram half of
+`flow_suite.update` into a single Pallas program:
+
+- the unpack prologue (ports/proto/packet split out of the 4 staged
+  lane words) runs IN-KERNEL on each chunk, so the staged plane is
+  read from HBM exactly once and the derived columns never exist
+  outside VMEM;
+- the 5-tuple fold and the multiply-shift bucket hashes are the ACTUAL
+  utils/u32.fold_columns / ops/hashing.bucket helpers (plain jnp ops,
+  traced straight into the kernel body — they cannot drift from the
+  unfused path), run on the same chunk while it is resident;
+- the Count-Min rows AND the 4 entropy feature rows accumulate into
+  VMEM-resident accumulators via the same one-hot MXU contraction as
+  ops/mxu_hist, written back to HBM once at the end (the
+  ops/pallas_hist residency pattern, extended across both sketch
+  families).
+
+HLL's scatter-max and the top-K ring stay XLA ops in the surrounding
+jitted program (flow_suite.update_lanes_fused): a grouped scatter-max
+has no MXU form, and the ring path's sort must stay out of Mosaic.
+
+Bit-exactness: the CMS half is unconditional — mask weights are 0/1,
+so a cell's per-batch sum is bounded by batch_rows (< 2^24 at any
+sane capacity) and the f32 accumulation is exact regardless of
+partial-sum order. The entropy half is exact only while a cell's
+per-batch weighted sum stays below 2^24: weights saturate at
+256**planes - 1 per record exactly like mxu_hist, so a batch that
+concentrates many max-weight records on one bucket (a DDoS-shaped
+burst) can push a cell sum past 2^24, where f32 rounds — and this
+kernel's partial-sum order (chunk=1024, per-plane scaled adds)
+differs from mxu_hist's (chunk=8192, planes recombined per chunk),
+so the two paths may round apart by a few counts there. Within the
+bound they agree bit-for-bit no matter which unit ran them —
+asserted in tests/test_staging.py via interpret mode; the identity
+tests and the ci.sh equality gates stay inside it by construction.
+
+STATUS (2026-08-03): correctness-pinned (interpret-mode tests beside
+the unfused reference); NOT yet measured on a real chip — this
+environment has no TPU, and ops/pallas_hist.py's history says the
+residency premise must be proven on silicon, not assumed. Hence the
+same posture: auto dispatch takes this kernel only on a TPU backend
+under the DEEPFLOW_SKETCH_PALLAS=1 opt-in (flow_suite.use_fused_hists),
+and kernel_bench grows the A/B to read the verdict off a real v5e.
+
+VMEM budget at the defaults (chunk=1024, CMS [4, 2^17], entropy
+[4, 2^12]): CMS accumulator 2 MB + entropy accumulator 64 KB, one-hots
+(1024, 512) + (1024, 256) bf16 = 1.5 MB, lane chunk 16 KB — well
+inside ~16 MB.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepflow_tpu.ops import hashing
+from deepflow_tpu.ops.mxu_hist import _split_hi_lo
+from deepflow_tpu.ops.pallas_hist import tpu_compiler_params
+from deepflow_tpu.utils.u32 import fold_columns
+
+
+def _kernel(n_ref, lanes_ref, cms_seed_ref, ent_seed_ref,
+            cms_ref, ent_ref, *, chunk, cms_d, cms_width, ent_f,
+            ent_width, ent_weight_planes):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        cms_ref[:] = jnp.zeros_like(cms_ref)
+        ent_ref[:] = jnp.zeros_like(ent_ref)
+
+    u = jnp.uint32
+    lanes = lanes_ref[:]                      # [4, chunk] uint32
+    ip_src, ip_dst = lanes[0], lanes[1]
+    # unpack prologue, in-kernel (flow_suite.unpack_lanes, op for op)
+    port_src = lanes[2] >> u(16)
+    port_dst = lanes[2] & u(0xFFFF)
+    proto = lanes[3] >> u(24)
+    pkts = (lanes[3] & u(0xFFFFFF)).astype(jnp.int32)
+
+    # per-lane validity from the batch's n word: padded (or stale
+    # staging) lanes carry weight 0 everywhere, exactly like the
+    # unfused mask path
+    pos = lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)[:, 0]
+    mask = (pos + pl.program_id(0) * chunk) < n_ref[0]
+
+    # flow key: the REAL utils/u32.fold_columns — plain jnp ops, so the
+    # unfused path's hash helpers trace straight into the kernel body
+    # and can never drift from it
+    fkey = fold_columns((ip_src, ip_dst, port_src, port_dst, proto))
+
+    cms_hi, cms_lo = _split_hi_lo(cms_width)
+    ent_hi, ent_lo = _split_hi_lo(ent_width)
+    cms_lw = int(np.log2(cms_width))
+    ent_lw = int(np.log2(ent_width))
+
+    # Count-Min rows: mask-only weights (one 0/1 plane)
+    w_mask = mask[:, None].astype(jnp.bfloat16)            # [chunk, 1]
+    chi_iota = lax.broadcasted_iota(jnp.int32, (chunk, cms_hi), 1)
+    clo_iota = lax.broadcasted_iota(jnp.int32, (chunk, cms_lo), 1)
+    for j in range(cms_d):
+        mult = cms_seed_ref[j, 0].astype(u)   # i32 scalar, bits kept
+        salt = cms_seed_ref[j, 1].astype(u)
+        idx = hashing.bucket(fkey, mult, salt, cms_lw)
+        a = ((idx // cms_lo)[:, None] == chi_iota).astype(jnp.bfloat16) \
+            * w_mask
+        b = ((idx % cms_lo)[:, None] == clo_iota).astype(jnp.bfloat16)
+        cms_ref[j] += lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # entropy features: packet weights, saturated then masked exactly
+    # like mxu_hist.hist_masked (min first == mask first for 0/1 masks)
+    wm = jnp.minimum(pkts, np.int32(256 ** ent_weight_planes - 1)) \
+        * mask.astype(jnp.int32)                           # [chunk]
+    feats = (ip_src, ip_dst, port_src, port_dst)
+    ehi_iota = lax.broadcasted_iota(jnp.int32, (chunk, ent_hi), 1)
+    elo_iota = lax.broadcasted_iota(jnp.int32, (chunk, ent_lo), 1)
+    for f in range(ent_f):
+        mult = ent_seed_ref[f, 0].astype(u)
+        salt = ent_seed_ref[f, 1].astype(u)
+        idx = hashing.bucket(feats[f], mult, salt, ent_lw)
+        hi_oh = (idx // ent_lo)[:, None] == ehi_iota
+        b = ((idx % ent_lo)[:, None] == elo_iota).astype(jnp.bfloat16)
+        for plane in range(ent_weight_planes):
+            wp = (((wm >> (8 * plane)) & 0xFF)[:, None]
+                  ).astype(jnp.bfloat16)
+            a = hi_oh.astype(jnp.bfloat16) * wp
+            ent_ref[f] += lax.dot_general(
+                a, b, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) \
+                * np.float32(256.0 ** plane)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cms_log2_width", "ent_log2_buckets", "weight_planes", "chunk",
+    "interpret"))
+def fused_lane_hists(plane: jnp.ndarray, n: jnp.ndarray,
+                     cms_seeds: jnp.ndarray, ent_seeds: jnp.ndarray, *,
+                     cms_log2_width: int, ent_log2_buckets: int,
+                     weight_planes: int = 2, chunk: int = 1024,
+                     interpret: bool = False):
+    """One staged (4, C) lane plane + its n word -> (cms_hist, ent_hist)
+    f32 deltas, computed in a single fused kernel.
+
+    cms_hist is [d, 2^cms_log2_width] over the folded 5-tuple flow key
+    (== mxu_hist.hist_masked over hashing.multi_bucket, bit-exact);
+    ent_hist is [4, 2^ent_log2_buckets] over ip_src/ip_dst/port_src/
+    port_dst with capped packet weights (== entropy.update's histogram
+    delta). The caller adds the deltas into the int32 sketch state.
+    """
+    C = int(plane.shape[1])
+    d = int(cms_seeds.shape[0])
+    f = int(ent_seeds.shape[0])
+    cms_w, ent_w = 1 << cms_log2_width, 1 << ent_log2_buckets
+    cms_hi, cms_lo = _split_hi_lo(cms_w)
+    ent_hi, ent_lo = _split_hi_lo(ent_w)
+    chunk = min(chunk, C)
+    while C % chunk:                 # batch capacities are powers of two;
+        chunk //= 2                  # anything else degrades, still correct
+    nchunk = C // chunk
+
+    kern = functools.partial(
+        _kernel, chunk=chunk, cms_d=d, cms_width=cms_w, ent_f=f,
+        ent_width=ent_w, ent_weight_planes=weight_planes)
+    # scalars ride SMEM as int32 (bit-preserving: the kernel's
+    # astype(uint32) wraps the bits back); the lane plane streams
+    # through VMEM chunk blocks while both accumulators stay mapped to
+    # the SAME block every step — the pallas_hist residency pattern
+    cms_h, ent_h = pl.pallas_call(
+        kern,
+        grid=(nchunk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((4, chunk), lambda i: (0, i)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, cms_hi, cms_lo), lambda i: (0, 0, 0)),
+            pl.BlockSpec((f, ent_hi, ent_lo), lambda i: (0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, cms_hi, cms_lo), jnp.float32),
+            jax.ShapeDtypeStruct((f, ent_hi, ent_lo), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+    )(
+        jnp.asarray(n).astype(jnp.int32).reshape(1),
+        plane,
+        lax.bitcast_convert_type(cms_seeds, jnp.int32),
+        lax.bitcast_convert_type(ent_seeds, jnp.int32),
+    )
+    return cms_h.reshape(d, cms_w), ent_h.reshape(f, ent_w)

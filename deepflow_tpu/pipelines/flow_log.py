@@ -270,6 +270,13 @@ class FlowLogPipeline:
                     stats.register(f"decoder.{stream}.{i}", d.counters)
             self._streams.append((stream, queues))
 
+        if stats is not None:
+            # process-wide string-hash LRU shared by every decoder
+            # (decode/columnar.py, ISSUE 9) — one registration, not one
+            # per decoder thread
+            stats.register("decode.hash_cache",
+                           columnar.hash_cache_counters)
+
         # OTel spans: raw + zlib-compressed frames land in l7_flow_log too
         # (reference: flow_log.go OTel+compressed Loggers :99-106)
         def _decode_otel(frames: List[Frame]):

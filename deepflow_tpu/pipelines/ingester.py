@@ -46,6 +46,11 @@ class IngesterConfig:
     # enable the TPU sketch analytics exporter (BASELINE.json's
     # tpu_sketch plugin); None disables, a float sets window seconds
     tpu_sketch_window_s: Optional[float] = None
+    # which wire the sketch lane batches on: "dict" (SmartEncoded
+    # news/hits planes, the smallest bytes-per-record) or "lanes"
+    # (packed 4-plane batches — the wire the ISSUE 9 zero-copy stager
+    # and fused kernel ride)
+    tpu_sketch_wire: str = "dict"
     # -- overlapped device feed (runtime/feed.py, ISSUE 5) ------------
     # double-buffered host->device prefetch for the tpu_sketch lane: a
     # supervised feed thread packs + transfers batch N+1 (one coalesced
@@ -57,6 +62,17 @@ class IngesterConfig:
     # amortizing per-dispatch overhead that dominates at small
     # batch_rows; 1 = one dispatch per batch (still coalesced)
     coalesce_batches: int = 1
+    # -- zero-copy decode->staging (batch/staging.py, ISSUE 9) --------
+    # pack decoded chunk columns DIRECTLY into the recycled coalesced
+    # staging buffer — no intermediate TensorBatch copy on the lanes
+    # feed path. Bit-identical sketch state either way (the TensorBatch
+    # path stays as the reference; tests/test_staging.py). Only takes
+    # effect with wire="lanes" and prefetch_depth > 0.
+    zero_copy: bool = True
+    # > 0: shard the staging pack across this many supervised worker
+    # threads by flow hash, so host packing keeps prefetch_depth full
+    # on multi-core hosts; 0 packs on the exporter worker thread
+    pack_workers: int = 0
     # -- accuracy observatory (runtime/audit.py, ISSUE 6) -------------
     # deterministic flow-hash sampled exact shadow of the tpu_sketch
     # lane: exact per-key counts / distinct count / entropy for the
@@ -197,8 +213,11 @@ class Ingester:
             self.tpu_sketch = TpuSketchExporter(
                 store=self.store, window_seconds=cfg.tpu_sketch_window_s,
                 checkpoint_dir=ckpt_dir, stats=self.stats,
+                wire=cfg.tpu_sketch_wire,
                 prefetch_depth=cfg.prefetch_depth,
                 coalesce_batches=cfg.coalesce_batches,
+                zero_copy=cfg.zero_copy,
+                pack_workers=cfg.pack_workers,
                 audit_rate=cfg.audit_sample_rate)
             self.exporters.register(self.tpu_sketch)
         self.app_red = None

@@ -184,6 +184,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                  wire: str = "dict",
                  prefetch_depth: int = 0,
                  coalesce_batches: int = 1,
+                 zero_copy: bool = True,
+                 pack_workers: int = 0,
                  audit_rate: float = 0.0,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
@@ -193,9 +195,6 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._jnp = jnp
         self.cfg = cfg or flow_suite.FlowSuiteConfig()
         self.window_seconds = window_seconds
-        # only the kernel-consumed subset is batched and transferred to
-        # device — the wide store schema never crosses the PCIe/ICI
-        self.batcher = Batcher(SKETCH_L4_SCHEMA, capacity=batch_rows)
         self.state = flow_suite.init(self.cfg)
         # snapshot bus (ISSUE 7): the checkpointer refactored into a
         # pub/sub versioned snapshot store. With a checkpoint_dir the
@@ -362,12 +361,47 @@ class TpuSketchExporter(QueueWorkerExporter):
             logging.getLogger(__name__).warning(
                 "staged=True has no coalesced feed; prefetch disabled")
             self.prefetch_depth = 0
+        # -- zero-copy decode->staging (batch/staging.py, ISSUE 9) ---------
+        # The packed-lane feed path skips the TensorBatch entirely:
+        # decoded chunk columns (frombuffer views of the frame payload)
+        # pack DIRECTLY into recycled coalesced staging buffers, whole
+        # pre-staged groups ride the feed, and pack_workers > 0 shards
+        # the pack across supervised worker threads by flow hash. The
+        # TensorBatch path (zero_copy=False) remains the bit-identity
+        # reference the equivalence tests diff against; dict/staged
+        # wires and the inline path are unaffected.
+        self.zero_copy = (bool(zero_copy) and self.wire == "lanes"
+                          and not self.staged and self.prefetch_depth > 0)
+        self._stager = None
+        self._pack_pool = None
+        self.batcher = None
+        if self.zero_copy:
+            from deepflow_tpu.batch.staging import LaneStager, PackPool
+            if pack_workers > 0:
+                self._pack_pool = PackPool(pack_workers)
+            self._stager = LaneStager(
+                batch_rows, group_batches=self.coalesce_batches,
+                pool=self._pack_pool,
+                pool_cap=self.prefetch_depth + 2)
+        else:
+            # only the kernel-consumed subset is batched and transferred
+            # to device — the wide store schema never crosses the
+            # PCIe/ICI. Zero-copy stages decoded columns directly and
+            # never materializes a TensorBatch, so it skips the eager
+            # batch_rows x 68B alloc (and the dead always-zero batcher
+            # counters beside the stager's real ones).
+            self.batcher = Batcher(SKETCH_L4_SCHEMA, capacity=batch_rows)
         if self.prefetch_depth:
             from deepflow_tpu.runtime.feed import DeviceFeed
             self._feed = DeviceFeed(
-                "tpu-sketch-feed", self._feed_process_group,
+                "tpu-sketch-feed",
+                self._feed_process_staged if self.zero_copy
+                else self._feed_process_group,
                 depth=self.prefetch_depth,
-                coalesce=self.coalesce_batches,
+                # zero-copy groups are coalesced AT THE STAGER (K slots
+                # per buffer, deterministic); the feed moves one staged
+                # group per item
+                coalesce=1 if self.zero_copy else self.coalesce_batches,
                 on_fence_error=self._feed_fence_error,
                 on_restart=self._feed_crash_restart)
         # -- accuracy observatory (runtime/audit.py, ISSUE 6) --------------
@@ -406,6 +440,10 @@ class TpuSketchExporter(QueueWorkerExporter):
         self.flush_window()  # final window (drains the feed first)
         if self._feed is not None:
             self._feed.close()
+        if self._pack_pool is not None:
+            # after the feed: in-flight groups may still be waiting on
+            # pool packs, so the pool outlives the last fence
+            self._pack_pool.close()
         for w in (self.topk_writer, self.window_writer):
             if w is not None:
                 w.close()
@@ -423,12 +461,32 @@ class TpuSketchExporter(QueueWorkerExporter):
             if tracing and rest:
                 self._tracer.set_batch(rest[0])
             schema_cols = self.coerce_to_schema(cols, SKETCH_L4_SCHEMA)
+            if self._stager is not None:
+                # zero-copy: the sampled reverse map reads the chunk
+                # HERE, outside the lock (the staged lanes carry no
+                # tuple columns any more; the TensorBatch path hashes
+                # on the feed thread, equally unlocked) — the serialized
+                # section below keeps only the stager/rows_in mutations
+                self._record_key_tuples(schema_cols)
             with self._state_lock:
-                # not an emission: the batcher is private state guarded
-                # BY this lock (flush_window drains it under the same
-                # lock); no other thread can block on it
-                for tb in self.batcher.put(schema_cols):  # lint: disable=emit-under-lock
-                    self._submit_batch_locked(tb)
+                if self._stager is not None:
+                    # zero-copy: chunk columns pack straight into the
+                    # staging buffer — no TensorBatch, no batcher copy.
+                    # Not an emission: the stager is private state
+                    # guarded BY this lock (flush_window drains it under
+                    # the same lock), and its pack-pool queues drain on
+                    # workers that never take it — back-pressure, not
+                    # deadlock (the batcher.put argument).
+                    for sg in self._stager.put(schema_cols):  # lint: disable=emit-under-lock
+                        self._feed.put(  # lint: disable=emit-under-lock
+                            sg, self._tracer.current_batch()
+                            if self._tracer.enabled else -1)
+                else:
+                    # not an emission: the batcher is private state
+                    # guarded BY this lock (flush_window drains it under
+                    # the same lock); no other thread can block on it
+                    for tb in self.batcher.put(schema_cols):  # lint: disable=emit-under-lock
+                        self._submit_batch_locked(tb)
                 # counted once the chunk is fully handed to the device
                 # path (inline: on device; feed: in the bounded window,
                 # which every flush drains first), so rows_in is a
@@ -634,7 +692,7 @@ class TpuSketchExporter(QueueWorkerExporter):
             self._detailed = \
                 self._batches_traced % self._attrib_every == 0
             self._batches_traced += 1
-        self._record_key_tuples(tb)
+        self._record_key_tuples(tb.columns)
         if self._dict_packer is not None:
             # dictionary lane: pack only the VALID rows (the packer's
             # row stream has no padding concept; plane padding is
@@ -674,44 +732,64 @@ class TpuSketchExporter(QueueWorkerExporter):
     # feed.py documents), and flush/checkpoint/probe touch state only
     # after a barrier returned.
 
-    def _feed_process_group(self, group) -> Optional["InFlight"]:
-        """Apply one group of (TensorBatch, batch_id): host-pack into a
-        single staging buffer, ONE coalesced transfer, one fused async
-        dispatch with donated state. Degraded mode absorbs the group
-        host-side; a device-classified error rolls back exactly like
-        the inline path, with the whole group counted."""
+    def _feed_process(self, group, absorb, dispatch
+                      ) -> Optional["InFlight"]:
+        """Shared feed-thread shell for one group: degraded-mode host
+        absorption, tracer kernel span, and the device-error rollback
+        that counts the whole group. One definition so the TensorBatch
+        and zero-copy feeds cannot diverge in error accounting — only
+        the per-item absorb/dispatch callbacks differ (both item kinds
+        expose `.valid`)."""
         if self.degraded:
-            for tb, _ in group:
-                self._host_batch_locked(tb)
-                self.batcher.recycle(tb)
+            for item, _ in group:
+                absorb(item)
             return None
         tr = self._tracer
-        rows = sum(int(tb.valid) for tb, _ in group)
+        rows = sum(int(item.valid) for item, _ in group)
         if not tr.enabled:
             try:
-                return self._dispatch_group(group, rows)
+                return dispatch(group, rows)
             except RuntimeError:
                 self._on_device_error_locked(rows)
                 return None
         tr.set_batch(group[0][1])
         try:
             with tr.span("kernel", stream=self.wire, rows=rows):
-                return self._dispatch_group(group, rows)
+                return dispatch(group, rows)
         except RuntimeError:
             self._on_device_error_locked(rows)
             return None
 
-    def _dispatch_group(self, group, rows: int) -> Optional["InFlight"]:
-        from deepflow_tpu.runtime.feed import InFlight
+    def _feed_process_group(self, group) -> Optional["InFlight"]:
+        """Apply one group of (TensorBatch, batch_id): host-pack into a
+        single staging buffer, ONE coalesced transfer, one fused async
+        dispatch with donated state. Degraded mode absorbs the group
+        host-side; a device-classified error rolls back exactly like
+        the inline path, with the whole group counted."""
+        return self._feed_process(group, self._absorb_tensorbatch,
+                                  self._dispatch_group)
 
+    def _absorb_tensorbatch(self, tb) -> None:
+        self._host_batch_locked(tb)
+        self.batcher.recycle(tb)
+
+    def _dispatch_begin(self) -> int:
+        """Chaos fault injection + the every-Nth detailed-attribution
+        cadence shared by both dispatch twins; returns the h2d
+        transfer count before the dispatch for the per-batch gauge."""
         if self._faults.enabled:   # chaos: simulated device loss
             self._faults.maybe_raise(FAULT_DEVICE_ERROR, key=self.wire)
-        tr = self._tracer
-        if tr.enabled:
+        if self._tracer.enabled:
             self._detailed = \
                 self._batches_traced % self._attrib_every == 0
             self._batches_traced += 1
-        before = self.h2d_transfers
+        return self.h2d_transfers
+
+    def _dispatch_group(self, group, rows: int) -> Optional["InFlight"]:
+        from deepflow_tpu.runtime.feed import InFlight
+
+        before = self._dispatch_begin()
+        tr = self._tracer
         if self.wire == "dict":
             staged = self._dispatch_dict_group(group)
         else:
@@ -734,11 +812,10 @@ class TpuSketchExporter(QueueWorkerExporter):
         C = self.batcher.capacity
         flat = self._staging_get(flow_suite.coalesced_lanes_words(K, C))
         for k, (tb, _) in enumerate(group):
-            self._record_key_tuples(tb)
-            flat[k] = tb.valid
-            flow_suite.pack_lanes_into(
-                tb.columns,
-                flat[K + 4 * C * k:K + 4 * C * (k + 1)].reshape(4, C))
+            self._record_key_tuples(tb.columns)
+            flat[k * flow_suite.slot_words(C)] = tb.valid
+            flow_suite.pack_lanes_into(tb.columns,
+                                       flow_suite.slot_plane(flat, k, C))
             self.batcher.recycle(tb)
         prog = self._program(
             ("lanes", K, C),
@@ -758,7 +835,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         fd = self._flow_dict
         wire = []
         for tb, _ in group:
-            self._record_key_tuples(tb)
+            self._record_key_tuples(tb.columns)
             mask = tb.mask()
             cols = {k: v[mask] for k, v in tb.columns.items()}
             wire += self._dict_packer.pack(cols)
@@ -776,6 +853,64 @@ class TpuSketchExporter(QueueWorkerExporter):
         self.state, self._dict_state, fence = self._timed_update(
             key, prog, self.state, self._dict_state, flat_d)
         return fence, flat
+
+    def _feed_process_staged(self, group) -> Optional["InFlight"]:
+        """Zero-copy variant of _feed_process_group: items are
+        pre-staged groups (batch/staging.py StagedGroup) — the host
+        pack already happened (possibly on the sharded pack pool), so
+        this thread only waits for group readiness, transfers and
+        dispatches. Degraded mode absorbs the staged lanes host-side
+        via the unpack twin; device errors roll back exactly like the
+        TensorBatch path with the whole group counted."""
+        return self._feed_process(group, self._absorb_staged_host,
+                                  self._dispatch_staged)
+
+    def _dispatch_staged(self, group, rows: int) -> Optional["InFlight"]:
+        from deepflow_tpu.runtime.feed import InFlight
+
+        before = self._dispatch_begin()
+        tr = self._tracer
+        fence = None
+        for sg, _ in group:        # coalesce=1: normally exactly one
+            # host barrier for the sharded pack (NOT a device sync): a
+            # poisoned group raises StagingPackError, which escapes to
+            # the supervisor on purpose — restart + on_restart counts
+            # the window lost, the ISSUE 5 containment
+            sg.wait_ready(timeout=30.0)
+            prog = self._program(
+                ("lanes", sg.k, sg.capacity),
+                lambda k=sg.k, c=sg.capacity:
+                flow_suite.make_coalesced_update(self.cfg, k, c))
+            flat_d = self._to_device(sg.flat, sg.valid)
+            self.state, fence = self._timed_update(
+                f"lanes_x{sg.k}", prog, self.state, flat_d)
+        if tr.enabled and self._detailed:
+            tr.gauge("tpu_transfers_per_batch",
+                     (self.h2d_transfers - before)
+                     / max(1, sum(sg.k for sg, _ in group)))
+            tr.gauge("tpu_h2d_coalesced_bytes",
+                     float(sum(sg.flat.nbytes for sg, _ in group)))
+        groups = [sg for sg, _ in group]
+        return InFlight(
+            fence, rows,
+            lambda: [self._stager.recycle(sg) for sg in groups])
+
+    def _absorb_staged_host(self, sg) -> None:
+        """Degraded mode reached a pre-staged group: the lanes ARE the
+        batch now (no TensorBatch ever existed), so the host fallback
+        consumes the unpack twin of each slot at its reduced rate."""
+        sg.wait_ready(timeout=30.0)
+        if self._host is None:
+            self._host = _HostSketch(self.cfg, stride=self.host_stride)
+        s = flow_suite.slot_words(sg.capacity)
+        for k in range(sg.k):
+            n = int(sg.flat[k * s])
+            if n:
+                self.host_rows += self._host.update(
+                    flow_suite.unpack_lanes_np(
+                        flow_suite.slot_plane(sg.flat, k, sg.capacity),
+                        n))
+        self._stager.recycle(sg)
 
     _PROGRAM_CACHE_CAP = 128
 
@@ -870,16 +1005,17 @@ class TpuSketchExporter(QueueWorkerExporter):
     # heavy hitters stay resolvable across windows.
     _KEY_TUPLES_CAP = 1 << 18
 
-    def _record_key_tuples(self, tb: TensorBatch) -> None:
+    def _record_key_tuples(self, cols: Dict[str, np.ndarray]) -> None:
         """Sampled host-side key -> 5-tuple reverse map (the
         universal-tag role): top-K heavy hitters recur, so a stride
         sample resolves them with near-certainty while costing one
         numpy hash over 1/16 of the batch. Drop-oldest at the cap, so
-        churn can't grow the map unboundedly."""
+        churn can't grow the map unboundedly. Takes bare columns (not
+        a TensorBatch): the zero-copy path samples the decoded chunk
+        directly — staged lane words no longer carry the tuple."""
         from deepflow_tpu.utils.u32 import fold_columns_np
 
         stride = 16
-        cols = tb.columns
         sl = slice(None, None, stride)
         sample = [cols["ip_src"][sl], cols["ip_dst"][sl],
                   cols["port_src"][sl], cols["port_dst"][sl],
@@ -936,8 +1072,16 @@ class TpuSketchExporter(QueueWorkerExporter):
             flow_suite.FlowWindowOutput]:
         t_flush = time.perf_counter()
         with self._state_lock:
-            for tb in self.batcher.flush():
-                self._submit_batch_locked(tb)
+            if self._stager is not None:
+                # zero-copy: the open staging prefix ships as-is (slot
+                # contiguity — no repack); same put-under-lock shape as
+                # _submit_batch_locked, same back-pressure-not-deadlock
+                # argument
+                for sg in self._stager.flush():
+                    self._feed.put(sg, -1)  # lint: disable=emit-under-lock
+            else:
+                for tb in self.batcher.flush():
+                    self._submit_batch_locked(tb)
             if self._feed is not None:
                 # barrier: every in-flight prefetched batch applies and
                 # fences before the window reads/resets state (feed.py
@@ -1065,7 +1209,11 @@ class TpuSketchExporter(QueueWorkerExporter):
                   # here (and as the tpu_transfers_per_batch gauge)
                   "h2d_transfers": self.h2d_transfers,
                   "dispatches": self.dispatches,
-                  "batches": self.batcher.emitted_batches,
+                  # the zero-copy path batches at the stager, not the
+                  # (unused) TensorBatch batcher
+                  "batches": (self._stager.staged_batches
+                              if self._stager is not None
+                              else self.batcher.emitted_batches),
                   # degraded-mode fault domain: every loss is a number
                   "degraded": 1 if self.degraded else 0,
                   "device_errors": self.device_errors,
@@ -1085,6 +1233,11 @@ class TpuSketchExporter(QueueWorkerExporter):
             c["ring_admission_failures"] = failures
         if self._feed is not None:
             c.update(self._feed.counters())
+        if self._stager is not None:
+            # zero-copy staging health: groups/batches staged, buffer
+            # pool reuse, and the sharded pack pool's task counts
+            c["zero_copy"] = 1
+            c.update(self._stager.counters())
         # the snapshot bus is always live (in-process-only without a
         # checkpoint_dir): saves/restores plus the ISSUE 7 pub/sub and
         # restored-step attribution counters

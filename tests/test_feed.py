@@ -85,25 +85,32 @@ def test_transfers_and_dispatches_coalesce():
     """transfers-per-batch <= 1 on the coalesced path (one device_put
     per group), while the inline lanes path pays 5 (mask + 4 planes);
     coalesce_batches additionally amortizes dispatches below one per
-    batch."""
+    batch. Holds on both feed variants: the TensorBatch reference and
+    the zero-copy stager (ISSUE 9), which batches at the stager."""
     rng, pool = _pool(seed=5, hi=1 << 12)
     chunks = _chunks(rng, pool, n_chunks=6, rows=3000)
     inline = _exporter("lanes", 0, 1)
-    feed = _exporter("lanes", 2, 3)
+    feed = _exporter("lanes", 2, 3, zero_copy=False)
+    zc = _exporter("lanes", 2, 3)                 # zero-copy default
     try:
         for c in chunks:
             inline.process([("l4_flow_log", 0, c)])
             feed.process([("l4_flow_log", 0, c)])
+            zc.process([("l4_flow_log", 0, c)])
         assert feed._feed.drain(30)
+        assert zc._feed.drain(30)
         batches = inline.batcher.emitted_batches
         assert batches == feed.batcher.emitted_batches > 0
+        assert zc.counters()["batches"] == batches    # stager batches
         assert inline.h2d_transfers == 5 * batches
-        assert feed.h2d_transfers <= batches          # <= 1 per batch
-        assert feed.dispatches < batches              # K-fused steps
-        assert feed.dispatches == feed._feed.groups
+        for e in (feed, zc):
+            assert e.h2d_transfers <= batches         # <= 1 per batch
+            assert e.dispatches < batches             # K-fused steps
+            assert e.dispatches == e._feed.groups
     finally:
         inline.close()
         feed.close()
+        zc.close()
 
 
 def test_drain_ladder_flushes_prefetch_window():
@@ -347,11 +354,12 @@ def test_make_coalesced_update_matches_sequential(rng):
             for _ in range(K)]
     ns = [C, C - 100, C - 999]
 
+    # slot-contiguous layout (ISSUE 9): [n_k | plane_k] per slot
     flat = np.zeros(flow_suite.coalesced_lanes_words(K, C), np.uint32)
-    flat[:K] = ns
     for k in range(K):
-        flow_suite.pack_lanes_into(
-            cols[k], flat[K + 4 * C * k:K + 4 * C * (k + 1)].reshape(4, C))
+        flat[k * flow_suite.slot_words(C)] = ns[k]
+        flow_suite.pack_lanes_into(cols[k],
+                                   flow_suite.slot_plane(flat, k, C))
 
     fused = flow_suite.make_coalesced_update(cfg, K, C)
     got, fence = fused(flow_suite.init(cfg), jnp.asarray(flat))
