@@ -1003,8 +1003,90 @@ def main() -> None:
         quiet_stats["ingest_records_per_sec"]
     _recover()
 
+    # -- timed: pod merge epochs (ISSUE 10) --------------------------------
+    # The pod fault-domain layer: one single-device shard lane per
+    # device, deadline-bounded epoch merges of the mergeable sketches.
+    # Measured twice — clean, and with one injected merge.stall
+    # straggler — so the artifact shows both the merge-epoch latency
+    # and that the deadline actually bounds it (the epoch closes at
+    # ~deadline with 7/8 participation instead of waiting 30s).
+    _phase("timed: pod merge epochs", budget=900.0)
+    from deepflow_tpu.parallel.pod import PodFlowSuite
+    from deepflow_tpu.runtime.faults import default_faults
+    from deepflow_tpu.utils.u32 import fold_columns_np
+
+    pod_shards = min(8, len(jax.devices()))
+    pod_planes = []
+    pod_keys = []
+    for i in range(n_batches):
+        lanes = flow_suite.pack_lanes(schema_batches[i])
+        pod_planes.append(np.stack(
+            [lanes[k] for k in flow_suite.SKETCH_LANE_NAMES]))
+        pod_keys.append(fold_columns_np(
+            [schema_batches[i][k].astype(np.uint32)
+             for k in ("ip_src", "ip_dst", "port_src", "port_dst",
+                       "proto")]))
+
+    def _pod_run(straggler: bool):
+        faults = default_faults()
+        # the straggler deadline is generous enough for healthy shards
+        # to drain their device backlog and contribute (CPU smoke shapes
+        # included) while provably bounding the 60s-stalled one: the
+        # epoch must close at ~deadline, not at the stall
+        pod = PodFlowSuite(cfg, n_shards=pod_shards,
+                           merge_deadline_s=10.0 if straggler else 60.0)
+        pod.put_lanes(pod_planes[0], batch)     # warm/compile
+        pod.drain(120)
+        pod.close_epoch()
+        armed = faults.arm_spec(
+            "merge.stall:count=1,delay_s=60,match=shard1;seed=5") \
+            if straggler else []
+        t0 = time.perf_counter()
+        for i in range(iters):
+            pod.put_lanes(pod_planes[i % n_batches], batch)
+        pod.drain(300)
+        rate = batch * iters / (time.perf_counter() - t0)
+        res = pod.close_epoch()
+        c = pod.counters()
+        stats = {"records_per_sec": round(rate),
+                 "merge_epoch_s": c["pod_merge_epoch_s"],
+                 "shards_participated": len(res.participated),
+                 "merge_missed": c["pod_merge_missed"],
+                 "delivered_frac": round(
+                     c["pod_rows_delivered"]
+                     / max(c["pod_rows_sent"], 1), 4)}
+        out = res.out
+        pod.close(final_epoch=False)
+        for s in armed:
+            faults.disarm(s)
+        return stats, out
+
+    pod_clean, pod_out = _pod_run(straggler=False)
+    # recall vs exact GROUP BY over the measured stream only: the warm
+    # batch merged (and the shards reset) in the warm epoch, so pod_out
+    # covers exactly the iters timed batches
+    pod_exact: dict = {}
+    fed = [i % n_batches for i in range(iters)]
+    for i in fed:
+        uniq, cnt = np.unique(pod_keys[i], return_counts=True)
+        for k, c_ in zip(uniq.tolist(), cnt.tolist()):
+            pod_exact[k] = pod_exact.get(k, 0) + c_
+    pod_want = set(sorted(pod_exact, key=pod_exact.get,
+                          reverse=True)[:cfg.top_k])
+    pod_got = set(np.asarray(pod_out.topk_keys).tolist())
+    pod_straggler, _ = _pod_run(straggler=True)
+    pod_stats = {
+        "shards": pod_shards,
+        "topk_recall_vs_exact": round(
+            len(pod_got & pod_want) / max(len(pod_want), 1), 4),
+        "clean": pod_clean,
+        "one_straggler": pod_straggler,
+    }
+    _recover()
+
     stage_breakdown = {
         "serving": serving_stats,
+        "pod_merge": pod_stats,
         "feed_overlap": feed_stats,
         "audit": audit_stats,
         "packed": {"h2d_mb_s": round(packed_h2d),

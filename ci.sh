@@ -632,6 +632,134 @@ print(f"serving OK: {sent} records ingested, {results['sql']} SQL + "
       f"staleness {stale[0]:.2f}s <= {MAX_STALE}s")
 EOF
 
+echo "== pod chaos smoke: shard fault domains + epoch merges =="
+# ISSUE 10: the pod fault-domain layer against a LIVE 8-device simulated
+# mesh ingest. Seeded chaos kills one shard's device path until it
+# degrades and stalls another shard's epoch contribution past the merge
+# deadline. Gates: ingest on the surviving shards never blocks, /healthz
+# names the degraded shard, the straggler's epoch closes without it
+# (counted on /metrics), the shard pool recovers to 8/8, pod-wide
+# conservation `sent == delivered + host + lost` holds off /metrics, and
+# a serving sketch.topk answer carries the reduced shard participation.
+python - <<'EOF'
+import re, socket, time, urllib.request
+import numpy as np
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.serving import SketchTables, SnapshotCache
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        return resp.read().decode()
+
+def counter(text, name):
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+-]+)$", text, re.M)
+    return None if m is None else float(m.group(1))
+
+def healthz(port):
+    import json
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/healthz")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:           # 503 carries the body
+        import json as _j
+        return e.code, _j.load(e)
+
+ing = Ingester(IngesterConfig(
+    listen_port=0, prom_port=0, tpu_sketch_window_s=0.6,
+    tpu_sketch_pod_shards=8, pod_merge_deadline_s=1.0,
+    fault_spec=("shard.device_error:count=3,match=shard2;"
+                "merge.stall:count=1,delay_s=3.0,match=shard5;seed=13")),
+    platform=PlatformDataManager())
+assert ing.tpu_sketch.pod is not None
+ing.start()
+r = np.random.default_rng(0)
+cols = {name: r.integers(0, 1 << 8, 500).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+cache = SnapshotCache(ing.tpu_sketch.snapshot_bus, max_staleness_s=3600)
+tables = SketchTables(cache)
+sent = 0
+saw_degraded = saw_missed = False
+deadline = time.time() + 45.0
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    while time.time() < deadline:
+        s.sendall(frame); sent += 500
+        code, h = healthz(ing.prom_port)
+        if h.get("pod_shards_degraded") or h.get("pod_shards_lost"):
+            saw_degraded = True
+            assert code == 503 and not h["ok"], h   # probe sees it
+        c = ing.tpu_sketch.counters()
+        if c["pod_merge_missed"] >= 1:
+            saw_missed = True
+        if (saw_degraded and saw_missed
+                and c["pod_shards_active"] == 8
+                and c["pod_rows_delivered"] > 0
+                and c["pod_device_errors"] >= 2):
+            break
+        time.sleep(0.05)
+assert saw_degraded, "healthz never reported the degraded shard"
+assert saw_missed, "the straggler was never excluded at the deadline"
+# ingest on the surviving shards never blocked: everything sent was
+# decoded and accounted (delivered/host/lost/pending), nothing wedged
+deadline = time.time() + 15.0
+while time.time() < deadline and ing.tpu_sketch.rows_in < sent:
+    time.sleep(0.1)
+assert ing.tpu_sketch.rows_in >= sent, \
+    f"ingest stalled: {ing.tpu_sketch.rows_in} < {sent}"
+# recovery: the shard pool is back to 8/8 on /healthz
+deadline = time.time() + 20.0
+while time.time() < deadline:
+    code, h = healthz(ing.prom_port)
+    if h.get("pod_shards_active") == 8 and h["ok"]:
+        break
+    time.sleep(0.2)
+assert h["pod_shards_active"] == 8 and h["ok"], h
+# conservation + exclusion counters off /metrics (one scrape)
+text = scrape(ing.prom_port)
+assert not validate_exposition(text)
+P = "deepflow_exporter_tpu_sketch_"
+sent_c = counter(text, P + "pod_rows_sent")
+delivered = counter(text, P + "pod_rows_delivered")
+host = counter(text, P + "pod_rows_host")
+lost = counter(text, P + "pod_rows_lost")
+pending = counter(text, P + "pod_rows_pending")
+missed = counter(text, P + "pod_merge_missed")
+assert None not in (sent_c, delivered, host, lost, pending, missed), \
+    "pod counters absent from /metrics"
+assert sent_c == delivered + host + lost + pending, \
+    f"conservation broken: {sent_c} != {delivered}+{host}+{lost}+{pending}"
+assert missed >= 1 and counter(text, P + "pod_late_merges") >= 1
+for needle in ("deepflow_trace_pod_shards_active",
+               "deepflow_trace_pod_merge_epoch_s",
+               "deepflow_trace_pod_merge_missed"):
+    assert needle in text, f"{needle} absent from /metrics"
+# serving answers carry shard participation honestly
+rows = tables.topk(5)
+assert rows and "shards_active" in rows[0], rows[:1]
+assert any(s.tags.get("pod_shards_participated", 8) < 8
+           for s in cache.window_range(None, None)), \
+    "no reduced-participation snapshot was ever published"
+cache.close()
+ing.close()
+c = ing.tpu_sketch.counters()
+assert c["pod_rows_pending"] == 0
+assert c["pod_rows_sent"] == (c["pod_rows_delivered"] + c["pod_rows_host"]
+                              + c["pod_rows_lost"])
+print(f"pod OK: {sent} records, 8 shards, {int(c['pod_device_errors'])} "
+      f"device error(s), {int(c['pod_merge_missed'])} missed "
+      f"contribution(s), {int(c['pod_late_merges'])} late merge(s), "
+      f"{int(c['pod_rows_lost'])} rows counted lost, conservation exact")
+EOF
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax
@@ -706,6 +834,20 @@ assert dec["zero_copy_records_per_sec"] > 0, dec
 assert dec["zero_copy_pooled_records_per_sec"] > 0, dec
 fo = d["stage_breakdown"]["feed_overlap"]
 assert fo["zero_copy"] == 1 and fo["records_per_sec_tensorbatch"] > 0, fo
+# the pod merge-epoch phase (ISSUE 10): clean epochs merge with full
+# participation, and one injected straggler provably bounds the merge
+# at the deadline (excluded + counted) instead of stalling the pod
+pm = d["stage_breakdown"]["pod_merge"]
+assert pm["shards"] >= 2 and pm["clean"]["records_per_sec"] > 0, pm
+assert pm["clean"]["shards_participated"] == pm["shards"], pm
+assert pm["clean"]["merge_missed"] == 0, pm
+assert pm["clean"]["delivered_frac"] == 1.0, pm
+assert pm["one_straggler"]["merge_missed"] >= 1, pm
+# deadline-bounded: the epoch closed at ~the 10s deadline, nowhere
+# near the injected 60s stall
+assert pm["one_straggler"]["merge_epoch_s"] < 30.0, pm
+assert pm["one_straggler"]["delivered_frac"] < 1.0, pm
+assert pm["topk_recall_vs_exact"] >= 0.9, pm
 # the serving read path (ISSUE 7 acceptance): >= 50k point-query QPS
 # against a live ingest, with the read-hammered run's sketch state
 # bit-identical to the no-readers twin

@@ -194,9 +194,16 @@ _SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
 # The ISSUE 9 zero-copy stager is under the rule to stay host-pure
 # (its buffers feed the device transfer; a device sync here would
 # serialize the pack against the chip) — no sanctioned syncs at all.
+# The ISSUE 10 pod fault-domain layer (parallel/ is under the rule
+# path-wide) earns exactly two: `_contribute` is the epoch protocol's
+# one device_get per shard per epoch (the contribution copy — epoch
+# merges are DEFINED as a host-side merge of shard copies), and
+# `_probe_device` is the PR 2 degraded-recovery probe on the pod's
+# per-shard ladder. Shard batch updates stay async.
 _SANCTIONED_SYNCS_BY_FILE = {
     "serving/cache.py": frozenset(["refresh"]),
     "batch/staging.py": frozenset(),
+    "parallel/pod.py": frozenset(["_contribute", "_probe_device"]),
 }
 
 

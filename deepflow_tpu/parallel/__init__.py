@@ -2,9 +2,11 @@ from deepflow_tpu.parallel.mesh import make_mesh
 from deepflow_tpu.parallel.multihost import (init_distributed, local_shard,
                                              make_global_mesh,
                                              process_local_batch)
+from deepflow_tpu.parallel.pod import EpochResult, PodFlowSuite
 from deepflow_tpu.parallel.sharded import (ShardedAppSuite, ShardedFlowSuite,
                                            ShardedMetricsSuite)
 
 __all__ = ["make_mesh", "ShardedFlowSuite", "ShardedMetricsSuite",
            "ShardedAppSuite", "init_distributed", "make_global_mesh",
-           "process_local_batch", "local_shard"]
+           "process_local_batch", "local_shard", "PodFlowSuite",
+           "EpochResult"]

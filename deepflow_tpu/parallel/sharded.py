@@ -91,6 +91,18 @@ def _merge_axis0(state: FlowSuiteState) -> FlowSuiteState:
     )
 
 
+def rescore_ring(merged: FlowSuiteState) -> FlowSuiteState:
+    """Re-score merged ring candidates against the globally-merged
+    sketch (per-shard estimates only saw 1/n of the stream) — the
+    shared post-merge step of the mesh flush AND the pod epoch merge
+    (parallel/pod.py), factored out so the two lanes cannot drift.
+    (compare-free sentinel mask: see topk._not_sentinel)"""
+    est = cms.query(merged.sketch, merged.ring.keys).astype(jnp.int32)
+    live = topk._not_sentinel(merged.ring.keys)
+    return merged._replace(
+        ring=merged.ring._replace(counts=live * (est + 1) - 1))
+
+
 class _ShardedSuiteBase:
     """Mesh/spec/plumbing shared by the three sharded suites: state
     carries a leading device axis over `axis`, batches shard over the
@@ -318,16 +330,7 @@ class ShardedFlowSuite(_ShardedSuiteBase):
             (state_specs, P(axis), P(None, axis), P()), state_specs)
 
         def flush_fn(state):
-            merged = _merge_axis0(state)
-            # Re-score ring candidates against the globally-merged sketch:
-            # per-shard estimates only saw 1/n_devices of the stream.
-            # (compare-free sentinel mask: see topk._not_sentinel)
-            est = cms.query(merged.sketch,
-                            merged.ring.keys).astype(jnp.int32)
-            live = topk._not_sentinel(merged.ring.keys)
-            rescored = live * (est + 1) - 1
-            merged = merged._replace(
-                ring=merged.ring._replace(counts=rescored))
+            merged = rescore_ring(_merge_axis0(state))
             fresh, out = flow_suite.flush(merged, cfg_)
             fresh_d = jax.tree.map(
                 lambda x: jnp.broadcast_to(x[None], (self.n_devices,) + x.shape),

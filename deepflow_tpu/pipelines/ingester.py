@@ -73,6 +73,16 @@ class IngesterConfig:
     # threads by flow hash, so host packing keeps prefetch_depth full
     # on multi-core hosts; 0 packs on the exporter worker thread
     pack_workers: int = 0
+    # -- pod fault domains (parallel/pod.py, ISSUE 10) ----------------
+    # >= 2 runs the tpu_sketch lane as an epoch-merged pod of
+    # single-device shard fault domains (one per jax device): each
+    # window flush closes a deadline-bounded merge epoch, a straggler
+    # past pod_merge_deadline_s is excluded (counted) instead of
+    # awaited, a failing shard degrades/rejoins on its own, and the
+    # POD-MERGED state is published with shard-participation tags.
+    # 0 keeps the single-chip lane.
+    tpu_sketch_pod_shards: int = 0
+    pod_merge_deadline_s: float = 5.0
     # -- accuracy observatory (runtime/audit.py, ISSUE 6) -------------
     # deterministic flow-hash sampled exact shadow of the tpu_sketch
     # lane: exact per-key counts / distinct count / entropy for the
@@ -218,6 +228,8 @@ class Ingester:
                 coalesce_batches=cfg.coalesce_batches,
                 zero_copy=cfg.zero_copy,
                 pack_workers=cfg.pack_workers,
+                pod_shards=cfg.tpu_sketch_pod_shards,
+                pod_merge_deadline_s=cfg.pod_merge_deadline_s,
                 audit_rate=cfg.audit_sample_rate)
             self.exporters.register(self.tpu_sketch)
         self.app_red = None
@@ -313,7 +325,7 @@ class Ingester:
         accuracy_alarm = bool(self.tpu_sketch is not None
                               and self.tpu_sketch.audit_alarm)
         draining = self._drain_state != "running"
-        return {
+        out = {
             "ok": not (sup["stale"] or open_breakers or degraded
                        or accuracy_alarm or draining),
             "drain": self._drain_state,
@@ -324,6 +336,23 @@ class Ingester:
             "degraded_tpu_sketch": degraded,
             "accuracy_alarm": accuracy_alarm,
         }
+        # pod fault domains (ISSUE 10): per-shard states on the probe
+        # surface — a degraded or lost shard is a reduced-capacity pod
+        # (not-ok, like the single-chip degraded lane) and the probe
+        # names WHICH shard, not just "something is wrong"
+        pod = None if self.tpu_sketch is None else self.tpu_sketch.pod
+        if pod is not None:
+            status = pod.shard_status()
+            out["pod_shards"] = pod.n_shards
+            out["pod_shards_active"] = sum(
+                1 for s in status if s["status"] == "active")
+            out["pod_shards_degraded"] = [
+                s["shard"] for s in status if s["status"] == "degraded"]
+            out["pod_shards_lost"] = [
+                s["shard"] for s in status if s["status"] == "lost"]
+            if out["pod_shards_active"] < pod.n_shards:
+                out["ok"] = False
+        return out
 
     def _spill_cmd(self, req: dict) -> dict:
         """Per-queue disk-spill accounting (the `spill` debug command):

@@ -21,6 +21,19 @@ Sites wired in this tree (grep for `FAULT_` constants at the call site):
 - ``spill.write``         — fail a spill segment write (disk full / EIO)
 - ``sender.disconnect``   — drop the agent sender's TCP connection at a
   frame boundary (ingester restart / network partition)
+- ``shard.device_error``  — raise a device-classified error inside ONE
+  pod shard's update path (parallel/pod.py; keys are ``shardN:<site>``,
+  so ``match=shardN:`` targets a single fault domain exactly even on
+  >= 10-shard pods — matching is substring, so bare ``match=shardN``
+  also hits shard N0..N9 there — and the shard rolls back from its
+  snapshot while the rest of the pod keeps merging)
+- ``merge.stall``         — sleep between a pod shard's epoch
+  contribution copy and its post (a straggler host: past
+  ``merge_deadline_s`` the epoch closes without it, counted, and its
+  rows merge late)
+- ``shard.lost``          — kill a pod shard's worker mid-epoch
+  (simulated host loss: unsnapshotted rows counted lost, the shard
+  rejoins by bus snapshot at an epoch boundary)
 
 Cost discipline: the registry is OFF by default and every call site
 guards on the module-level ``default_faults().enabled`` flag (one
@@ -52,7 +65,9 @@ __all__ = ["FaultSite", "FaultRegistry", "default_faults",
            "FAULT_RECEIVER_TRUNCATE", "FAULT_QUEUE_STALL",
            "FAULT_EXPORTER_RAISE", "FAULT_EXPORTER_PROCESS",
            "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN",
-           "FAULT_SPILL_WRITE", "FAULT_SENDER_DISCONNECT"]
+           "FAULT_SPILL_WRITE", "FAULT_SENDER_DISCONNECT",
+           "FAULT_SHARD_DEVICE_ERROR", "FAULT_MERGE_STALL",
+           "FAULT_SHARD_LOST"]
 
 FAULT_RECEIVER_TRUNCATE = "receiver.truncate"
 FAULT_QUEUE_STALL = "queue.stall"
@@ -62,6 +77,9 @@ FAULT_DEVICE_ERROR = "tpu.device_error"
 FAULT_CHECKPOINT_TORN = "checkpoint.torn"
 FAULT_SPILL_WRITE = "spill.write"
 FAULT_SENDER_DISCONNECT = "sender.disconnect"
+FAULT_SHARD_DEVICE_ERROR = "shard.device_error"
+FAULT_MERGE_STALL = "merge.stall"
+FAULT_SHARD_LOST = "shard.lost"
 
 
 class InjectedFault(RuntimeError):

@@ -186,6 +186,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                  coalesce_batches: int = 1,
                  zero_copy: bool = True,
                  pack_workers: int = 0,
+                 pod_shards: int = 0,
+                 pod_merge_deadline_s: float = 5.0,
                  audit_rate: float = 0.0,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
@@ -195,21 +197,68 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._jnp = jnp
         self.cfg = cfg or flow_suite.FlowSuiteConfig()
         self.window_seconds = window_seconds
-        self.state = flow_suite.init(self.cfg)
+        # -- pod fault domains (parallel/pod.py, ISSUE 10) -----------------
+        # pod_shards >= 2 routes the lane through the epoch-merged pod:
+        # one single-device fault domain per shard, deadline-bounded
+        # merges, per-shard degraded mode and rejoin-by-snapshot. The
+        # pod runs the lanes wire with its own supervised shard workers
+        # (that is where the overlap lives), so the single-chip
+        # feed/staging knobs are forced off; each window flush closes
+        # one merge epoch.
+        self._pod = None
+        if int(pod_shards) >= 2:
+            import logging
+            if wire == "dict":
+                logging.getLogger(__name__).warning(
+                    "pod mode runs the lanes wire; wire='dict' ignored")
+            if staged or prefetch_depth or pack_workers:
+                logging.getLogger(__name__).info(
+                    "pod mode: staged/prefetch/zero_copy/pack_workers "
+                    "forced off (the pod's shard workers own overlap)")
+            wire, staged = "lanes", False
+            prefetch_depth = pack_workers = 0
+            zero_copy = False
+            from deepflow_tpu.parallel.pod import PodFlowSuite
+            import jax as _jax
+
+            # fail BEFORE the pod spawns its shard workers, not
+            # per-batch: put_lanes rejects a plane whose width the
+            # shard count does not divide (same clamp the pod applies)
+            eff_shards = min(int(pod_shards), len(_jax.devices()))
+            if batch_rows % max(1, eff_shards) != 0:
+                raise ValueError(
+                    f"batch_rows={batch_rows} not divisible by the "
+                    f"pod's {eff_shards} shard(s); every batch would "
+                    f"be rejected at put_lanes")
+            self._pod = PodFlowSuite(
+                self.cfg, n_shards=int(pod_shards), wire="lanes",
+                merge_deadline_s=pod_merge_deadline_s,
+                snapshot_dir=checkpoint_dir)
+        self.state = None if self._pod is not None \
+            else flow_suite.init(self.cfg)
         # snapshot bus (ISSUE 7): the checkpointer refactored into a
         # pub/sub versioned snapshot store. With a checkpoint_dir the
         # bus is disk-backed (restart replay + degraded restore read the
         # same format back); without one it still exists in-process so
         # the serving read path works in StorageDisabled mode.
         # `checkpointer` stays None when undurable — every PR 2/4
-        # restore/cadence decision keys off that, unchanged.
-        self._snapbus = SnapshotBus(checkpoint_dir)
-        self.checkpointer = self._snapbus if checkpoint_dir is not None \
-            else None
+        # restore/cadence decision keys off that, unchanged. In pod
+        # mode the POD-MERGED bus is the one serving subscribes to.
+        # Pod restart semantics differ from the single-chip restore:
+        # per-shard snapshots are run-scoped rollback scratch (never
+        # restored across a restart — the dead run's merge ledger is
+        # gone, so restoring could double-merge already-delivered
+        # rows); a restart loses at most the open epoch's per-shard
+        # accumulation, while the merged bus snapshots stay replayable
+        # and serveable (the pod resumes the epoch counter past them).
+        self._snapbus = self._pod.bus if self._pod is not None \
+            else SnapshotBus(checkpoint_dir)
+        self.checkpointer = self._snapbus \
+            if checkpoint_dir is not None and self._pod is None else None
         self.checkpoint_every = max(1, checkpoint_every)
         self.windows = 0
         self._rows_at_flush = 0
-        if checkpoint_dir is not None:
+        if self.checkpointer is not None:
             restored = self.checkpointer.restore(self.state)
             if restored is not None:
                 self.state = restored
@@ -438,6 +487,10 @@ class TpuSketchExporter(QueueWorkerExporter):
             self._window_thread.join(timeout=5)
         super().close()
         self.flush_window()  # final window (drains the feed first)
+        if self._pod is not None:
+            # one more (normally empty) epoch so late stragglers'
+            # contributions deliver before the workers stop
+            self._pod.close(final_epoch=True)
         if self._feed is not None:
             self._feed.close()
         if self._pack_pool is not None:
@@ -461,15 +514,25 @@ class TpuSketchExporter(QueueWorkerExporter):
             if tracing and rest:
                 self._tracer.set_batch(rest[0])
             schema_cols = self.coerce_to_schema(cols, SKETCH_L4_SCHEMA)
-            if self._stager is not None:
+            if self._stager is not None or self._pod is not None:
                 # zero-copy: the sampled reverse map reads the chunk
                 # HERE, outside the lock (the staged lanes carry no
                 # tuple columns any more; the TensorBatch path hashes
                 # on the feed thread, equally unlocked) — the serialized
-                # section below keeps only the stager/rows_in mutations
+                # section below keeps only the stager/rows_in mutations.
+                # The pod path samples here too: its shard workers only
+                # ever see packed lane planes.
                 self._record_key_tuples(schema_cols)
             with self._state_lock:
-                if self._stager is not None:
+                if self._pod is not None:
+                    # pod lane: pack into the (4, B) plane and fan the
+                    # shard slices onto the per-shard queues. put_lanes
+                    # never blocks (a slow/LOST shard drops counted on
+                    # its own queue), so this is not an emission that
+                    # can deadlock — same argument as the stager put.
+                    for tb in self.batcher.put(schema_cols):  # lint: disable=emit-under-lock
+                        self._pod_submit_locked(tb)
+                elif self._stager is not None:
                     # zero-copy: chunk columns pack straight into the
                     # staging buffer — no TensorBatch, no batcher copy.
                     # Not an emission: the stager is private state
@@ -499,6 +562,15 @@ class TpuSketchExporter(QueueWorkerExporter):
                     # under this lock before closing both). Host numpy
                     # only — the device path never sees the audit.
                     self._audit.absorb(schema_cols)
+
+    def _pod_submit_locked(self, tb: TensorBatch) -> None:
+        """One TensorBatch onto the pod lane: host-pack the 4-plane
+        lane matrix (a fresh buffer — the pod keeps views) and fan it
+        across the shard queues; the TensorBatch recycles immediately."""
+        lanes = flow_suite.pack_lanes(tb.columns)
+        plane = np.stack([lanes[k] for k in flow_suite.SKETCH_LANE_NAMES])
+        self._pod.put_lanes(plane, int(tb.valid))
+        self.batcher.recycle(tb)
 
     def _submit_batch_locked(self, tb: TensorBatch) -> None:
         """One emitted TensorBatch onto the device path: inline
@@ -990,8 +1062,17 @@ class TpuSketchExporter(QueueWorkerExporter):
     @property
     def snapshot_bus(self) -> SnapshotBus:
         """The ISSUE 7 snapshot bus: serving caches subscribe here.
-        Always present (in-process-only when no checkpoint_dir)."""
+        Always present (in-process-only when no checkpoint_dir). In pod
+        mode this is the POD-MERGED bus — every epoch's merged state
+        with shard-participation tags (ISSUE 10)."""
         return self._snapbus
+
+    @property
+    def pod(self):
+        """The pod fault-domain layer (parallel/pod.py), or None on
+        the single-chip lane — Ingester.health reads shard states
+        through this."""
+        return self._pod
 
     @property
     def audit_alarm(self) -> bool:
@@ -1040,6 +1121,11 @@ class TpuSketchExporter(QueueWorkerExporter):
         this snapshot instead of losing the accumulation. No-op while
         degraded (the host-fallback state is not a device pytree)."""
         with self._state_lock:
+            if self._pod is not None:
+                # the pod publishes the merged state every epoch and
+                # snapshots per shard; there is no single device state
+                # to park here
+                return False
             if self.checkpointer is None or self.degraded:
                 return False
             if self._feed is not None \
@@ -1071,6 +1157,15 @@ class TpuSketchExporter(QueueWorkerExporter):
     def _flush_window_inner(self, now: float) -> Optional[
             flow_suite.FlowWindowOutput]:
         t_flush = time.perf_counter()
+        if self._pod is not None:
+            out = self._flush_pod_window(now)
+            self._prof.record("window", "flush",
+                              time.perf_counter() - t_flush)
+            if out is None:
+                return None
+            self.last_output = out
+            self._write_output(out, int(now))
+            return out
         with self._state_lock:
             if self._stager is not None:
                 # zero-copy: the open staging prefix ships as-is (slot
@@ -1156,6 +1251,28 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._write_output(out, int(now))
         return out
 
+    def _flush_pod_window(self, now: float) -> Optional[
+            flow_suite.FlowWindowOutput]:
+        """Pod mode: a window flush IS a merge-epoch close. The state
+        lock is held through the deadline-bounded merge so the audit
+        shadow and the epoch see the identical row set (the single-chip
+        flush holds it through its drain barrier the same way);
+        producers back-pressure into the exporter queue's counted
+        drop-oldest, never into decode."""
+        with self._state_lock:
+            for tb in self.batcher.flush():  # lint: disable=emit-under-lock
+                self._pod_submit_locked(tb)
+            self.windows += 1
+            res = self._pod.close_epoch(now=now)
+            if self._audit is not None:
+                # epochs that excluded a shard (straggler/kill) or
+                # counted loss are tagged lossy/degraded — the accuracy
+                # alarm never fires on shard-loss variance (ISSUE 10)
+                self._audit.close_window(res.out,
+                                         degraded=bool(res.degraded),
+                                         lossy=res.lossy)
+        return res.out
+
     def _write_output(self, out: flow_suite.FlowWindowOutput,
                       second: int) -> None:
         if self.topk_writer is None:
@@ -1233,6 +1350,11 @@ class TpuSketchExporter(QueueWorkerExporter):
             c["ring_admission_failures"] = failures
         if self._feed is not None:
             c.update(self._feed.counters())
+        if self._pod is not None:
+            # pod fault-domain ledger: shard states, epoch merges and
+            # the pod-wide conservation terms (sent = delivered + host
+            # + lost + pending), all scrape-visible
+            c.update(self._pod.counters())
         if self._stager is not None:
             # zero-copy staging health: groups/batches staged, buffer
             # pool reuse, and the sharded pack pool's task counts

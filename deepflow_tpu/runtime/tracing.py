@@ -105,6 +105,17 @@ GAUGE_HELP: Dict[str, str] = {
                                    "staleness-bounded-read contract is "
                                    "staleness <= max_staleness_s "
                                    "whenever ingest is flushing windows",
+    # the ISSUE 10 pod fault-domain gauges (parallel/pod.py): epoch-
+    # merge health of the sharded sketch plane
+    "pod_shards_active": "shards on the device lane after the last "
+                         "merge epoch (out of pod_shards; lower = "
+                         "degraded/lost fault domains)",
+    "pod_merge_epoch_s": "wall seconds the last deadline-bounded epoch "
+                         "merge took (marker post -> merged publish)",
+    "pod_merge_missed": "cumulative shard contributions that missed "
+                        "their epoch's merge deadline (each counted "
+                        "row rides pod_rows_excluded until it merges "
+                        "late)",
 }
 
 # dynamically-named gauges get HELP by prefix (one entry documents the

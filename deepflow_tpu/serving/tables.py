@@ -369,8 +369,22 @@ class SketchTables:
             v = self._latest_view()
             if v is None:
                 return []
-            return [{"time": v.snap.wall_time, "window": v.snap.step,
-                     "rank": r, "flow_key": key, "count": cnt}
+            # pod-merged snapshots (parallel/pod.py) carry shard-
+            # participation tags: a reduced-participation answer SAYS
+            # so instead of silently serving a partial sketch. Single-
+            # chip snapshots have no shards, so no columns appear.
+            extra = {}
+            if "pod_shards_participated" in v.snap.tags:
+                extra = {"shards_active":
+                         int(v.snap.tags["pod_shards_participated"]),
+                         "shards": int(v.snap.tags.get(
+                             "pod_shards", 0)),
+                         "shards_missing": list(v.snap.tags.get(
+                             "pod_missing", []))}
+            return [dict({"time": v.snap.wall_time,
+                          "window": v.snap.step,
+                          "rank": r, "flow_key": key, "count": cnt},
+                         **extra)
                     for r, (key, cnt) in enumerate(v.topk(k))]
         finally:
             self._observe(t0)
@@ -468,9 +482,29 @@ class SketchTables:
         if name in ("sketch.topk", "topk"):
             k = int(self._arg(name, args, 1, 100))
             cols = ["time", "window", "rank", "flow_key", "count"]
-            rows = [[int(v.snap.wall_time), v.snap.step, r, key, cnt]
-                    for v in views
-                    for r, (key, cnt) in enumerate(v.topk(k))]
+            # pod-merged windows answer with their shard participation
+            # appended (honest reduced-participation answers, ISSUE 10);
+            # an all-single-chip range keeps the pinned 5-column shape
+            # (in a mixed range, single-chip rows carry None there)
+            podded = any("pod_shards_participated" in v.snap.tags
+                         for v in views)
+            if podded:
+                cols = cols + ["shards_active", "shards_missing"]
+            rows = []
+            for v in views:
+                # same type as the direct topk() path: the missing-shard
+                # ID LIST, not a count — one column name, one meaning.
+                # A single-chip window in a mixed range answers None,
+                # never a bogus -1 shard count.
+                pod_v = "pod_shards_participated" in v.snap.tags
+                tail = [] if not podded else [
+                    int(v.snap.tags["pod_shards_participated"])
+                    if pod_v else None,
+                    [int(i) for i in v.snap.tags.get("pod_missing", [])]
+                    if pod_v else None]
+                for r, (key, cnt) in enumerate(v.topk(k)):
+                    rows.append([int(v.snap.wall_time), v.snap.step,
+                                 r, key, cnt] + tail)
             return cols, rows
         if name in ("sketch.cms_point", "cms_point"):
             key = self._arg(name, args, 1)
@@ -506,11 +540,23 @@ class SketchTables:
 
     def _sql_summary(self, views):
         cols = ["time", "window", "rows", "lossy", "degraded", "final"]
-        rows = [[int(v.snap.wall_time), v.snap.step, v.rows,
-                 int(bool(v.snap.tags.get("lossy"))),
-                 int(bool(v.snap.tags.get("degraded"))),
-                 int(bool(v.snap.tags.get("final")))]
-                for v in views]
+        podded = any("pod_shards_participated" in v.snap.tags for v in views)
+        if podded:
+            cols = cols + ["shards_active", "shards_missing"]
+        rows = []
+        for v in views:
+            row = [int(v.snap.wall_time), v.snap.step, v.rows,
+                   int(bool(v.snap.tags.get("lossy"))),
+                   int(bool(v.snap.tags.get("degraded"))),
+                   int(bool(v.snap.tags.get("final")))]
+            if podded:
+                pod_v = "pod_shards_participated" in v.snap.tags
+                row += [int(v.snap.tags["pod_shards_participated"])
+                        if pod_v else None,
+                        [int(i) for i in
+                         v.snap.tags.get("pod_missing", [])]
+                        if pod_v else None]
+            rows.append(row)
         return cols, rows
 
     # -- PromQL (querier/promql.py leaf functions) -------------------------

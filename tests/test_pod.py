@@ -1,0 +1,361 @@
+"""Pod fault domains (parallel/pod.py, ISSUE 10): the chaos ladder.
+
+Everything runs on the simulated 8-device CPU mesh conftest pins
+(XLA_FLAGS=--xla_force_host_platform_device_count=8) — the same
+environment the green MULTICHIP runs use. The invariants under test:
+
+- bit-identity: with no faults, the epoch-merged pod output equals the
+  mesh lane's merged flush leaf-for-leaf on BOTH wires;
+- fault isolation: a device error / straggler / kill touches exactly one
+  shard's rows while the rest of the pod keeps merging, and ingest on
+  the surviving shards never blocks;
+- conservation, pod-wide: rows_sent == rows_delivered + rows_host +
+  rows_lost (+ pending, driven to zero), through every fault;
+- rejoin-by-snapshot: a killed shard's un-merged accumulation survives
+  on its bus snapshot and delivers late within two epochs;
+- audit honesty: epochs that excluded a shard close the shadow audit as
+  lossy — the accuracy alarm can never fire on shard-loss variance.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.models import FlowSuiteConfig, flow_suite
+from deepflow_tpu.parallel import PodFlowSuite, ShardedFlowSuite, make_mesh
+from deepflow_tpu.runtime.faults import default_faults
+from deepflow_tpu.replay import SyntheticAgent
+
+CFG = FlowSuiteConfig(cms_log2_width=10, ring_size=128, top_k=20,
+                      hll_groups=32, hll_precision=6,
+                      entropy_log2_buckets=8)
+B = 2048
+KEEP = ("ip_src", "ip_dst", "port_src", "port_dst", "proto",
+        "packet_tx", "packet_rx")
+
+
+def _plane(agent, n=B):
+    cols = agent.l4_columns_pooled(n)
+    lanes = flow_suite.pack_lanes(
+        {k: cols[k].astype(np.uint32) for k in KEEP})
+    return np.stack([lanes[k] for k in flow_suite.SKETCH_LANE_NAMES])
+
+
+def _feed(pod, agent, batches=4, valid=B):
+    for _ in range(batches):
+        pod.put_lanes(_plane(agent), valid)
+    return batches * valid
+
+
+def _conserve(pod):
+    c = pod.counters()
+    assert c["pod_rows_sent"] == (c["pod_rows_delivered"]
+                                  + c["pod_rows_host"]
+                                  + c["pod_rows_lost"]
+                                  + c["pod_rows_pending"]), c
+    return c
+
+
+@pytest.fixture
+def faults():
+    f = default_faults()
+    armed = []
+    yield lambda spec: armed.extend(f.arm_spec(spec))
+    for site in armed:
+        f.disarm(site)
+
+
+def test_pod_bit_identical_to_mesh_lanes(rng):
+    """No faults, all shards on time: the epoch merge must reproduce
+    the single-program mesh lane's merged flush exactly (lanes wire,
+    unaligned valid count so the per-shard masks are exercised)."""
+    mesh = make_mesh()
+    sharded = ShardedFlowSuite(CFG, mesh)
+    state_d = sharded.init()
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=30.0)
+    agent = SyntheticAgent(seed=3)
+    n = B - 37
+    for _ in range(3):
+        plane = _plane(agent)
+        state_d = sharded.update_lanes(
+            state_d, sharded.put_lanes(jnp.asarray(plane)), n)
+        pod.put_lanes(plane, n)
+    state_d, out_mesh = sharded.flush(state_d)
+    assert pod.drain(30)
+    res = pod.close_epoch()
+    assert res.participated == list(range(8)) and not res.missed
+    assert not res.tags["lossy"]
+    for a, b in zip(out_mesh, res.out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = _conserve(pod)
+    assert c["pod_rows_delivered"] == 3 * n
+    pod.close()
+    assert _conserve(pod)["pod_rows_pending"] == 0
+
+
+def test_pod_bit_identical_to_mesh_dict(rng):
+    """Dict wire: replicated news (interleaved count masks) + sharded
+    hits must merge to the mesh lane's exact output."""
+    from deepflow_tpu.models.flow_dict import FlowDictPacker
+
+    mesh = make_mesh()
+    sharded = ShardedFlowSuite(CFG, mesh)
+    state_d = sharded.init()
+    dtable = sharded.init_dict(capacity=8192)
+    pod = PodFlowSuite(CFG, n_shards=8, wire="dict", dict_capacity=8192,
+                       merge_deadline_s=30.0)
+    agent = SyntheticAgent(seed=5)
+    packer = FlowDictPacker(capacity=8192, hits_batch=4096,
+                            news_batch=512)
+    wire = []
+    for _ in range(3):
+        cols = agent.l4_columns_pooled(4096)
+        wire.extend(packer.pack(
+            {k: cols[k].astype(np.uint32) for k in KEEP}))
+    wire.extend(packer.flush())
+    for kind, plane, n in wire:
+        nn = np.uint32(n)
+        if kind == "news":
+            state_d, dtable = sharded.update_news(
+                state_d, dtable, jnp.asarray(plane), nn)
+        else:
+            state_d = sharded.update_hits(
+                state_d, dtable, jnp.asarray(plane), nn)
+    pod.put_wire(wire)
+    state_d, out_mesh = sharded.flush(state_d)
+    assert pod.drain(60)
+    res = pod.close_epoch()
+    assert res.participated == list(range(8))
+    for a, b in zip(out_mesh, res.out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    pod.close()
+    assert _conserve(pod)["pod_rows_pending"] == 0
+
+
+def test_shard_device_error_rollback(faults):
+    """A seeded device error on one shard rolls only that shard back
+    from its bus snapshot: bounded counted loss, every shard still
+    contributes, the pod never stops."""
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=30.0,
+                       snapshot_batches=2)
+    faults("shard.device_error:count=1,match=shard3;seed=7")
+    agent = SyntheticAgent(seed=7)
+    sent = _feed(pod, agent, batches=6)
+    assert pod.drain(30)
+    res = pod.close_epoch()
+    c = _conserve(pod)
+    assert c["pod_device_errors"] == 1
+    # loss is bounded by the snapshot cadence: at most snapshot_batches
+    # of shard 3's slice (B/8 rows each) plus the failed batch's slice
+    assert 0 < c["pod_rows_lost"] <= 3 * (B // 8)
+    assert len(res.participated) == 8        # restored shard contributes
+    assert res.tags["lossy"]                 # counted loss is tagged
+    assert c["pod_rows_delivered"] == sent - c["pod_rows_lost"]
+    st = {s["shard"]: s for s in pod.shard_status()}
+    assert st[3]["device_errors"] == 1 and st[3]["status"] == "active"
+    assert all(st[i]["rows_lost"] == 0 for i in range(8) if i != 3)
+    pod.close()
+    assert _conserve(pod)["pod_rows_pending"] == 0
+
+
+def test_straggler_excluded_at_deadline(faults):
+    """A merge.stall straggler past merge_deadline_s is excluded from
+    its epoch — counted, tagged — while the other 7 shards' merge
+    closes on time; the late contribution delivers next epoch."""
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=0.4)
+    faults("merge.stall:count=1,delay_s=3.0,match=shard5;seed=7")
+    agent = SyntheticAgent(seed=9)
+    sent = _feed(pod, agent, batches=4)
+    assert pod.drain(30)
+    t0 = time.monotonic()
+    res = pod.close_epoch()
+    took = time.monotonic() - t0
+    # the bound discriminates deadline-close (0.4s + one-time merge
+    # program compile, ~1s on a loaded CPU) from stall-close (>= 3s)
+    assert took < 2.0, f"deadline not enforced: {took:.2f}s"
+    assert res.missed == [5] and res.tags["pod_shards_participated"] == 7
+    assert 5 in res.tags["pod_missing"] and res.tags["lossy"]
+    c = _conserve(pod)
+    assert c["pod_merge_missed"] == 1
+    assert c["pod_rows_excluded"] == sent // 8    # shard 5's slice
+    # surviving shards' rows merged on time
+    assert c["pod_rows_delivered"] == sent - sent // 8
+    # ingest keeps flowing while the straggler sleeps
+    t0 = time.monotonic()
+    _feed(pod, agent, batches=2)
+    assert time.monotonic() - t0 < 0.5, "ingest blocked on a straggler"
+    time.sleep(3.0)                 # let the stalled contribution post
+    assert pod.drain(30)
+    res2 = pod.close_epoch()
+    c = _conserve(pod)
+    assert c["pod_late_merges"] >= 1 and c["pod_rows_pending"] == 0
+    assert c["pod_rows_delivered"] == c["pod_rows_sent"]  # nothing lost
+    assert not res2.missed
+    pod.close()
+    _conserve(pod)
+
+
+def test_shard_kill_and_snapshot_rejoin():
+    """Kill one shard mid-ingest: unsnapshotted rows counted lost,
+    snapshotted rows survive on its bus and deliver late at rejoin —
+    within two epochs the shard is contributing again."""
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=30.0,
+                       snapshot_batches=2)
+    agent = SyntheticAgent(seed=11)
+    _feed(pod, agent, batches=6)
+    assert pod.drain(30)
+    pod.kill(2)
+    _feed(pod, agent, batches=2)          # shard 2's slices drop counted
+    res = pod.close_epoch()               # epoch E: excluded + rejoined
+    assert 2 in res.lost and res.tags["pod_shards_participated"] == 7
+    assert 2 in res.tags["pod_missing"]
+    c = _conserve(pod)
+    assert c["pod_rejoins"] == 1 and c["pod_shards_lost"] == 0
+    assert c["pod_rows_lost"] == 2 * (B // 8)      # the post-kill drops
+    res2 = pod.close_epoch()              # epoch E+1: snapshot merges
+    c = _conserve(pod)
+    assert c["pod_late_merges"] >= 1
+    assert c["pod_rows_pending"] == 0
+    assert c["pod_rows_sent"] == c["pod_rows_delivered"] + c["pod_rows_lost"]
+    _feed(pod, agent, batches=2)
+    assert pod.drain(30)
+    res3 = pod.close_epoch()              # epoch E+2: full participation
+    assert len(res3.participated) == 8
+    pod.close()
+    assert _conserve(pod)["pod_rows_pending"] == 0
+
+
+def test_degraded_shard_host_fallback_and_probe_recovery(faults):
+    """Past degrade_after consecutive errors one shard drops to the
+    host fallback (its rows counted as reduced-fidelity host rows, the
+    epoch tagged degraded) while the pod keeps merging; the epoch-
+    boundary probe brings it back once the fault clears."""
+    f = default_faults()
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=30.0,
+                       degrade_after=1, snapshot_batches=100)
+    faults("shard.device_error:count=2,match=shard1;seed=3")
+    agent = SyntheticAgent(seed=13)
+    _feed(pod, agent, batches=6)
+    assert pod.drain(30)
+    st = {s["shard"]: s["status"] for s in pod.shard_status()}
+    assert st[1] == "degraded"
+    _feed(pod, agent, batches=2)          # these shard-1 slices go host
+    assert pod.drain(30)
+    res = pod.close_epoch()               # probe fires the 2nd injection
+    c = _conserve(pod)
+    assert res.degraded == [1] and res.tags["pod_degraded"] == [1]
+    # batch 1 of shard 1's slice died on device (counted lost); the 5
+    # remaining first-feed batches plus the 2 later ones absorbed host
+    assert c["pod_rows_lost"] == B // 8
+    assert c["pod_rows_host"] == 7 * (B // 8)
+    f.disarm("shard.device_error")
+    pod.close_epoch()                     # probe recovers at this boundary
+    _feed(pod, agent, batches=2)
+    assert pod.drain(30)
+    res2 = pod.close_epoch()
+    assert not res2.degraded and len(res2.participated) == 8
+    assert {s["shard"]: s["status"] for s in pod.shard_status()}[1] \
+        == "active"
+    pod.close()
+    assert _conserve(pod)["pod_rows_pending"] == 0
+
+
+def test_pod_audit_tags_shard_loss_lossy(faults):
+    """Per-shard audit accounting: the exact shadow absorbed EVERY row
+    (rows_in conservation intact), and an epoch that excluded a shard
+    closes the audit window as lossy — the accuracy alarm never fires
+    on shard-loss variance even at full audit rate."""
+    from deepflow_tpu.runtime.audit import ShadowAuditor
+
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=0.4)
+    auditor = ShadowAuditor(CFG, rate=1.0, trip_windows=1)
+    pod.attach_auditor(auditor)
+    faults("merge.stall:count=1,delay_s=1.5,match=shard4;seed=7")
+    agent = SyntheticAgent(seed=17)
+    sent = _feed(pod, agent, batches=4)
+    assert pod.drain(30)
+    pod.close_epoch()                     # shard 4 excluded
+    assert auditor.rows_seen_total == sent     # shadow saw excluded rows
+    assert auditor.lossy_windows == 1 and auditor.last_window["lossy"]
+    assert not auditor.alarm and auditor._violations == 0
+    time.sleep(1.3)
+    pod.close_epoch()    # late merge: lossy too (the output carries the
+    #                      prior epoch's rows this window's shadow lacks)
+    assert auditor.windows == 2 and auditor.lossy_windows == 2
+    assert not auditor.alarm
+    pod.close(final_epoch=False)
+    _conserve(pod)
+
+
+def test_pod_exporter_serving_participation_tags(faults, tmp_path):
+    """The exporter's pod mode end-to-end: chunks fan across the shard
+    queues, a window flush closes a merge epoch, the POD-MERGED
+    snapshot lands on the bus with participation tags, and a serving
+    topk answer carries the reduced participation honestly."""
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+    from deepflow_tpu.serving import SketchTables, SnapshotCache
+
+    exp = TpuSketchExporter(store=None, cfg=CFG, window_seconds=3600,
+                            batch_rows=B, pod_shards=8,
+                            pod_merge_deadline_s=0.4)
+    assert exp.pod is not None and exp.snapshot_bus is exp.pod.bus
+    cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=3600)
+    tables = SketchTables(cache)
+    faults("merge.stall:count=1,delay_s=1.5,match=shard6;seed=7")
+    rng_ = np.random.default_rng(0)
+    cols = {name: rng_.integers(0, 1 << 10, 3 * B).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    exp.process([("l4_flow_log", 0, cols)])
+    assert exp.pod.drain(30)
+    out = exp.flush_window()
+    assert out is not None
+    rows = tables.topk(5)
+    assert rows and rows[0]["shards_active"] == 7
+    assert rows[0]["shards_missing"] == [6]
+    snap = cache.latest()
+    assert snap.tags["pod_shards_participated"] == 7 and snap.tags["lossy"]
+    c = exp.counters()
+    assert c["pod_merge_missed"] == 1
+    assert c["pod_rows_sent"] == c["rows_in"] == 3 * B
+    time.sleep(1.3)
+    exp.close()          # final epochs deliver the straggler
+    c = exp.counters()
+    assert c["pod_rows_pending"] == 0
+    assert c["pod_rows_sent"] == (c["pod_rows_delivered"]
+                                  + c["pod_rows_host"]
+                                  + c["pod_rows_lost"])
+    cache.close()
+
+
+def test_pod_ingest_never_blocks_on_lost_shard():
+    """put_lanes against a pod with a LOST shard returns immediately:
+    the dead shard's slices drop counted on its own queue while every
+    other shard keeps absorbing."""
+    pod = PodFlowSuite(CFG, n_shards=8, merge_deadline_s=30.0,
+                       auto_rejoin=False)
+    agent = SyntheticAgent(seed=19)
+    _feed(pod, agent, batches=2)
+    assert pod.drain(30)
+    pod.kill(0)
+    t0 = time.monotonic()
+    sent = _feed(pod, agent, batches=8)
+    assert time.monotonic() - t0 < 1.0, "ingest blocked on a lost shard"
+    assert pod.drain(30)
+    res = pod.close_epoch()
+    assert 0 in res.lost and res.tags["pod_shards_participated"] == 7
+    c = _conserve(pod)
+    assert c["pod_rows_lost"] >= 8 * (B // 8)   # shard 0's dropped slices
+    st = {s["shard"]: s for s in pod.shard_status()}
+    assert st[0]["rows_dropped"] == sent // 8
+    # manual rejoin path (auto_rejoin off): the API form works too
+    assert pod.rejoin(0)
+    res2 = pod.close_epoch()
+    c = _conserve(pod)
+    assert c["pod_rejoins"] == 1
+    pod.close()
+    assert _conserve(pod)["pod_rows_pending"] == 0
