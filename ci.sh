@@ -31,11 +31,80 @@ print("kernel BTF (stack-ABI goid keying):",
 EOF
 
 echo "== deepflow-lint: static invariants =="
-# ISSUE 3: the pipeline's concurrency / trace-safety / metrics
-# disciplines checked mechanically (deepflow_tpu/analysis/). The gate
-# is "no findings beyond the committed baseline" — paying down debt
-# shrinks .lint-baseline.json; any NEW violation fails CI here
-python -m deepflow_tpu.cli lint --baseline .lint-baseline.json
+# ISSUE 3 + ISSUE 11: the pipeline's concurrency / trace-safety /
+# metrics / conservation / twin disciplines checked mechanically
+# (deepflow_tpu/analysis/). The gate is "no findings beyond the
+# committed baseline" — paying down debt shrinks .lint-baseline.json;
+# any NEW violation (including a twin fingerprint drifting from
+# .lint-twins.json without --ack-twin) fails CI here. SARIF rides to
+# artifacts/lint.sarif for annotation surfaces, and the wall-clock
+# budget (<30s, memoized ProjectIndex) keeps the gate honest as the
+# rule set grows.
+mkdir -p artifacts
+lint_t0=$(date +%s)
+python -m deepflow_tpu.cli lint --baseline .lint-baseline.json \
+    --sarif artifacts/lint.sarif
+lint_t1=$(date +%s)
+lint_dt=$((lint_t1 - lint_t0))
+echo "lint self-scan: ${lint_dt}s (budget 30s)"
+if [ "$lint_dt" -ge 30 ]; then
+    echo "FAIL: lint self-scan blew the 30s runtime budget" >&2
+    exit 1
+fi
+python - <<'EOF'
+import json
+doc = json.load(open("artifacts/lint.sarif"))
+assert doc["version"] == "2.1.0", doc.get("version")
+rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+for need in ("lock-order-cycle", "unlocked-shared-write",
+             "silent-drop", "twin-drift"):
+    assert need in rules, f"SARIF rule table missing {need}"
+print(f"lint.sarif: {len(rules)} rules, "
+      f"{len(doc['runs'][0]['results'])} gated result(s)")
+EOF
+
+echo "== twin-drift gate trips on an unacked edit =="
+# ISSUE 11 acceptance: prove IN CI that editing one side of a
+# registered twin pair without `--ack-twin` fails the gate — on a
+# throwaway copy of the fixture shape, never the real tree
+python - <<'EOF'
+import json, os, pathlib, subprocess, sys, tempfile
+
+with tempfile.TemporaryDirectory() as td:
+    td = pathlib.Path(td)
+    (td / "analysis").mkdir()
+    (td / "analysis" / "twins.py").write_text(
+        'TWIN_TABLE = [\n'
+        '    ("demo", "host.py:mix_np", "dev.py:mix"),\n'
+        ']\n')
+    (td / "host.py").write_text("def mix_np(x):\n    return x * 3\n")
+    (td / "dev.py").write_text("def mix(x):\n    return x * 3\n")
+    store = td / "twins.json"
+    run = lambda *a: subprocess.run(
+        [sys.executable, "-m", "deepflow_tpu.cli", "lint", str(td),
+         "--rules", "twin-drift", "--twins", str(store), *a],
+        capture_output=True, text=True)
+    ack = subprocess.run(
+        [sys.executable, "-m", "deepflow_tpu.cli", "lint", str(td),
+         "--twins", str(store), "--ack-twin"],
+        capture_output=True, text=True)
+    assert ack.returncode == 0, ack.stderr + ack.stdout
+    clean = run()
+    assert clean.returncode == 0, clean.stdout
+    # edit the device side WITHOUT re-acking: the gate must trip
+    (td / "dev.py").write_text("def mix(x):\n    return x * 5\n")
+    tripped = run()
+    assert tripped.returncode == 1 and "twin-drift" in tripped.stdout, \
+        tripped.stdout
+    # ack makes it green again
+    ack2 = subprocess.run(
+        [sys.executable, "-m", "deepflow_tpu.cli", "lint", str(td),
+         "--twins", str(store), "--ack-twin"],
+        capture_output=True, text=True)
+    assert ack2.returncode == 0, ack2.stderr
+    assert run().returncode == 0
+print("twin gate: ack -> clean, edit -> trip, re-ack -> clean")
+EOF
 
 echo "== pytest =="
 python -m pytest tests/ -q

@@ -2,16 +2,23 @@
 
 Entry points: `df-ctl lint` (deepflow_tpu/cli.py), the `lint` debug
 command (runtime/debug.py), and ci.sh's failing lint step against the
-committed `.lint-baseline.json`. See core.py for the framework and
-checkers.py for the six rules.
+committed `.lint-baseline.json` + `.lint-twins.json`. See core.py for
+the framework, checkers.py for the per-file rules, concurrency.py for
+the whole-program lock/race rules, and twins.py for the host/device
+twin registry behind the twin-drift gate.
 """
 
 from deepflow_tpu.analysis.core import (Finding, all_rules,
-                                        findings_to_json, format_findings,
-                                        load_baseline, new_findings,
-                                        run_lint, run_on_sources,
-                                        save_baseline, scan_package)
+                                        default_twin_store_path,
+                                        findings_to_json,
+                                        findings_to_sarif,
+                                        format_findings, load_baseline,
+                                        new_findings, run_lint,
+                                        run_on_sources, save_baseline,
+                                        scan_package)
+from deepflow_tpu.analysis.twins import host_twin_of
 
-__all__ = ["Finding", "all_rules", "findings_to_json", "format_findings",
-           "load_baseline", "new_findings", "run_lint", "run_on_sources",
-           "save_baseline", "scan_package"]
+__all__ = ["Finding", "all_rules", "default_twin_store_path",
+           "findings_to_json", "findings_to_sarif", "format_findings",
+           "host_twin_of", "load_baseline", "new_findings", "run_lint",
+           "run_on_sources", "save_baseline", "scan_package"]

@@ -1,6 +1,8 @@
-"""The six deepflow-lint rules. Each guards an incident class PRs 1-2
-paid for once already; the docstrings name the original failure so the
-rule stays reviewable against its reason to exist.
+"""The per-file deepflow-lint rules (the ISSUE 3 six plus ISSUE 11's
+silent-drop). Each guards an incident class a PR paid for once already;
+the docstrings name the original failure so the rule stays reviewable
+against its reason to exist. The whole-program concurrency and twin
+rules live in concurrency.py / twins.py.
 
 All checkers are lexical (stdlib `ast`): they prove properties of the
 program TEXT, not the runtime. Where a rule cannot decide statically
@@ -18,7 +20,8 @@ from deepflow_tpu.analysis.core import (Checker, FileContext, Finding,
                                         ProjectIndex, dotted, register)
 
 __all__ = ["UnsupervisedThread", "EmitUnderLock", "HostSyncInDevicePath",
-           "TraceUnsafeJit", "CountableMissingCounters", "FaultSiteDrift"]
+           "TraceUnsafeJit", "CountableMissingCounters", "FaultSiteDrift",
+           "SilentDrop"]
 
 _FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -466,6 +469,390 @@ class CountableMissingCounters(Checker):
                 if owner:
                     return owner
         return None
+
+
+# the conservation ledger's vocabulary: identifiers carrying these
+# words hold data-plane payload whose disappearance must move a counter
+# (README "Loss accounting" — every loss class has an owning Countable)
+_DATA_NOUNS = frozenset([
+    "frame", "frames", "row", "rows", "chunk", "chunks", "batch",
+    "batches", "record", "records", "blob", "blobs", "segment",
+    "segments", "seg", "datagram", "datagrams", "msg", "msgs",
+    "payload", "payloads"])
+# a drop path is "counted" when its block provably moves a ledger: any
+# augmented assignment (counter += n), or a call whose name owns a loss
+# verb (self._count_drop(), tracer.incr(...), shed(), ...)
+_COUNT_WORDS = frozenset([
+    "count", "counts", "counted", "counter", "counters", "drop",
+    "dropped", "drops", "evict", "evicted", "shed", "discard",
+    "discarded", "lost", "lose", "loss", "exclude", "excluded",
+    "reject", "rejected", "nack", "incr", "inc", "torn", "miss",
+    "missed", "skip", "skipped", "overwritten"])
+
+
+def _words(name: str) -> List[str]:
+    if name.isupper():
+        return []               # ALL_CAPS constant, not data-plane state
+    return name.lower().split("_")
+
+
+def _mentions_noun(node: ast.AST) -> Set[str]:
+    """Data nouns referenced anywhere under `node` (names, attributes,
+    function parameters are handled by callers)."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        name = sub.id if isinstance(sub, ast.Name) else (
+            sub.attr if isinstance(sub, ast.Attribute) else (
+                sub.arg if isinstance(sub, ast.arg) else ""))
+        if name:
+            out.update(w for w in _words(name) if w in _DATA_NOUNS)
+    return out
+
+
+def _counted(stmts: List[ast.stmt], defs: Dict[str, ast.AST],
+             _visited: Optional[Set[str]] = None) -> bool:
+    """Does this block provably account for what it abandons? Stops at
+    nested defs (their bodies do not run here). A value-bearing return
+    also counts: the caller receives the evidence and owns the ledger
+    (spill's `return evicted` pattern). Same-file helper calls are
+    followed (`self._on_device_error(sh, rows)` counts because the
+    helper's body moves the ledger), cycle-guarded — the trace-unsafe
+    rule's posture applied to conservation."""
+    visited = _visited if _visited is not None else set()
+    for stmt in stmts:
+        for sub in _walk_same_frame_stmts(stmt):
+            if isinstance(sub, ast.AugAssign):
+                return True
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Return) and sub.value is not None \
+                    and not (isinstance(sub.value, ast.Constant)
+                             and sub.value.value is None):
+                return True
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                leaf = (d or "").rsplit(".", 1)[-1] if d else (
+                    sub.func.attr if isinstance(sub.func, ast.Attribute)
+                    else "")
+                if leaf and set(_words(leaf)) & _COUNT_WORDS:
+                    return True
+                helper = None
+                if d and d in defs:
+                    helper = d
+                elif d and d.startswith("self.") and d.count(".") == 1 \
+                        and d[5:] in defs:
+                    helper = d[5:]
+                if helper is not None and helper not in visited:
+                    visited.add(helper)
+                    if _counted(defs[helper].body, defs, visited):
+                        return True
+    return False
+
+
+_WAIT_LEAVES = frozenset(["wait", "sleep", "beat", "is_set"])
+
+
+def _backpressure_only(stmts: List[ast.stmt]) -> bool:
+    """`self._stop.wait(0.05); continue` — the retry idiom: nothing is
+    consumed, the loop re-attempts the same work. Not a drop. A bare
+    `continue` with no wait is NOT this idiom — that one skips."""
+    saw_wait = False
+    for stmt in stmts:
+        if isinstance(stmt, (ast.Continue, ast.Pass)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            func = stmt.value.func
+            leaf = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else "")
+            if leaf in _WAIT_LEAVES:
+                saw_wait = True
+                continue
+        return False
+    return saw_wait
+
+
+def _walk_same_frame_stmts(root: ast.AST) -> Iterator[ast.AST]:
+    yield root
+    if isinstance(root, _FUNC_DEFS + (ast.Lambda,)):
+        return
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNC_DEFS + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _falsiness_guard(test: ast.AST) -> bool:
+    """True when the branch test is (or contains) an emptiness check of
+    a data noun — `if not frames:`, `if frame is None:`,
+    `if len(batch) == 0:` — i.e. the early return abandons NOTHING."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.UnaryOp) and isinstance(sub.op, ast.Not) \
+                and _mentions_noun(sub.operand):
+            return True
+        if isinstance(sub, ast.Compare):
+            ops = sub.ops
+            if any(isinstance(o, (ast.Is, ast.Eq)) for o in ops) \
+                    and _mentions_noun(sub):
+                comparators = [sub.left] + list(sub.comparators)
+                if any(isinstance(c, ast.Constant)
+                       and c.value in (None, 0) for c in comparators):
+                    return True
+    return False
+
+
+@register
+class SilentDrop(Checker):
+    """PR 10's pod ledger made `sent == delivered + host + lost +
+    pending` the product guarantee, and README's loss-accounting table
+    names the Countable that owns every loss class. This rule enforces
+    the table's CLOSURE statically: a data-plane `except`, `continue`,
+    or guarded early-`return` that abandons frames/rows/chunks/batches
+    without moving any counter is exactly how the ledger starts lying
+    — the next `spill_evicted`-shaped bug, caught as text. Scoped to
+    the conservation core (runtime/, parallel/, batch/, serving/);
+    emptiness guards (`if not frames: return`) and value-bearing
+    returns (the caller owns the ledger) stay silent."""
+
+    name = "silent-drop"
+    description = ("data-plane except/continue/early-return discards "
+                   "frames/rows/chunks/batches without incrementing a "
+                   "Countable — every loss class needs an owning "
+                   "counter (README loss-accounting table)")
+
+    # telemetry/control-plane modules inside the scoped dirs: dropping
+    # a trace span, a /metrics scrape or a debug reply is not row loss
+    # — the conservation ledger covers DATA, these carry evidence
+    _EXEMPT_SUFFIXES = ("runtime/tracing.py", "runtime/profiler.py",
+                        "runtime/debug.py", "runtime/promexpo.py",
+                        "runtime/stats.py")
+
+    def check(self, ctx: FileContext,
+              index: ProjectIndex) -> Iterable[Finding]:
+        from deepflow_tpu.analysis.concurrency import scoped
+        if not scoped(ctx.path) or ctx.path.endswith(self._EXEMPT_SUFFIXES):
+            return
+        seen: Set[Tuple[int, int]] = set()
+        # flat same-file helper map for counted-call following (homonym
+        # methods across classes over-approximate toward silence, which
+        # is the right direction for a proven-violations-only rule)
+        self._defs = {n.name: n for n in ast.walk(ctx.tree)
+                      if isinstance(n, _FUNC_DEFS)}
+        yield from self._scan_frame(ctx, ctx.tree, None, None, seen)
+
+    # -- traversal ---------------------------------------------------------
+    def _scan_frame(self, ctx: FileContext, frame: ast.AST,
+                    func: Optional[ast.AST],
+                    noun_params: Optional[Set[str]],
+                    seen: Set[Tuple[int, int]]) -> Iterator[Finding]:
+        """Walk one function frame; recurse into nested defs with their
+        own parameter context."""
+        body = frame.body if isinstance(frame.body, list) \
+            else [frame.body]
+        yield from self._scan_block(ctx, body, func, noun_params, None,
+                                    None, seen)
+
+    def _scan_block(self, ctx, stmts, func, noun_params, loop_nouns,
+                    branch, seen) -> Iterator[Finding]:
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.Try):
+                yield from self._scan_try(ctx, stmt, stmts[i + 1:],
+                                          func, noun_params, loop_nouns,
+                                          branch, seen)
+            else:
+                yield from self._scan_stmt(ctx, stmt, func, noun_params,
+                                           loop_nouns, branch, seen)
+
+    def _scan_stmt(self, ctx, node, func, noun_params, loop_nouns,
+                   branch, seen) -> Iterator[Finding]:
+        if isinstance(node, ast.ClassDef):
+            yield from self._scan_block(ctx, node.body, None, None,
+                                        None, None, seen)
+            return
+        if isinstance(node, _FUNC_DEFS):
+            params = {a.arg for a in
+                      (node.args.posonlyargs + node.args.args
+                       + node.args.kwonlyargs)
+                      if set(_words(a.arg)) & _DATA_NOUNS}
+            yield from self._scan_frame(ctx, node, node,
+                                        params or None, seen)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            nouns = _mentions_noun(node.target) or None
+            yield from self._scan_block(ctx, node.body, func,
+                                        noun_params, nouns, None, seen)
+            yield from self._scan_block(ctx, node.orelse, func,
+                                        noun_params, loop_nouns, branch,
+                                        seen)
+            return
+        if isinstance(node, ast.While):
+            # worker-loop shape: `while ...: msg = q.get(); ...` — the
+            # loop is noun-carrying when its body top level binds one
+            nouns: Set[str] = set()
+            for s in node.body:
+                if isinstance(s, ast.Assign):
+                    for t in s.targets:
+                        nouns |= _mentions_noun(t)
+            yield from self._scan_block(ctx, node.body, func,
+                                        noun_params, nouns or None,
+                                        None, seen)
+            yield from self._scan_block(ctx, node.orelse, func,
+                                        noun_params, loop_nouns, branch,
+                                        seen)
+            return
+        if isinstance(node, ast.If):
+            yield from self._scan_block(ctx, node.body, func,
+                                        noun_params, loop_nouns,
+                                        (node.test, node.body), seen)
+            yield from self._scan_block(ctx, node.orelse, func,
+                                        noun_params, loop_nouns,
+                                        (node.test, node.orelse), seen)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            yield from self._scan_block(ctx, node.body, func,
+                                        noun_params, loop_nouns, branch,
+                                        seen)
+            return
+        if isinstance(node, ast.Continue):
+            yield from self._continue_discard(ctx, node, loop_nouns,
+                                              branch, seen)
+            return
+        if isinstance(node, ast.Return):
+            yield from self._return_discard(ctx, node, func,
+                                            noun_params, branch, seen)
+            return
+
+    # -- the three shapes --------------------------------------------------
+    def _scan_try(self, ctx, node, rest, func, noun_params, loop_nouns,
+                  branch, seen) -> Iterator[Finding]:
+        try_nouns = self._live_try_nouns(node.body)
+        for handler in node.handlers:
+            flagged = list(self._except_swallow(
+                ctx, handler, try_nouns, rest, seen))
+            yield from flagged
+            # a flagged swallow already covers any continue/return
+            # inside it — don't double-report the same drop
+            yield from self._scan_block(
+                ctx, handler.body, func, noun_params,
+                None if flagged else loop_nouns,
+                None if flagged else (None, handler.body), seen)
+        for sub in (node.body, node.orelse, node.finalbody):
+            yield from self._scan_block(ctx, sub, func, noun_params,
+                                        loop_nouns, branch, seen)
+
+    @staticmethod
+    def _live_try_nouns(body: List[ast.stmt]) -> Set[str]:
+        """Nouns whose data EXISTS inside the try body — i.e. noun
+        identifiers that are LOADED there. A noun that only ever
+        appears as a plain assignment target (`chunk = conn.recv()`)
+        is a store, not a load: it never held data when the call
+        raised, so the recv-retry loops stay silent."""
+        loads: Set[str] = set()
+        for stmt in body:
+            for sub in _walk_same_frame_stmts(stmt):
+                if isinstance(sub, ast.Name) \
+                        and not isinstance(sub.ctx, ast.Store):
+                    loads |= {w for w in _words(sub.id)
+                              if w in _DATA_NOUNS}
+                elif isinstance(sub, ast.Attribute):
+                    loads |= {w for w in _words(sub.attr)
+                              if w in _DATA_NOUNS}
+        return loads
+
+    def _except_swallow(self, ctx, handler, try_nouns, rest,
+                        seen) -> Iterator[Finding]:
+        nouns = try_nouns & _DATA_NOUNS
+        if not nouns:
+            return
+        if _counted(handler.body, self._defs):
+            return
+        # no terminal jump: the handler falls through to the try's
+        # siblings — if THOSE move the ledger (pod's rollback counts
+        # after the except), the path is covered
+        falls_through = not any(
+            isinstance(s, (ast.Return, ast.Continue, ast.Break))
+            for s in handler.body)
+        if falls_through and _counted(rest, self._defs):
+            return
+        at = (handler.lineno, handler.col_offset)
+        if at in seen:
+            return
+        seen.add(at)
+        yield Finding(
+            self.name, ctx.path, handler.lineno, handler.col_offset,
+            f"except path swallows a failure while handling "
+            f"{'/'.join(sorted(nouns))} without moving any counter — "
+            f"count the loss (README loss-accounting) or re-raise",
+            self.severity)
+
+    def _continue_discard(self, ctx, node, loop_nouns, branch,
+                          seen) -> Iterator[Finding]:
+        if not loop_nouns or branch is None:
+            return                  # unconditional continue: no drop
+        test, block = branch
+        if test is not None and _falsiness_guard(test):
+            return                  # `if not frame: continue` skips nothing
+        if _backpressure_only(block):
+            return                  # wait-and-retry: nothing consumed
+        if _counted(block, self._defs):
+            return
+        at = (node.lineno, node.col_offset)
+        if at in seen:
+            return
+        seen.add(at)
+        yield Finding(
+            self.name, ctx.path, node.lineno, node.col_offset,
+            f"continue discards the current "
+            f"{'/'.join(sorted(loop_nouns))} without moving any "
+            f"counter — count the drop before skipping",
+            self.severity)
+
+    def _return_discard(self, ctx, node, func, noun_params, branch,
+                        seen) -> Iterator[Finding]:
+        if func is None or not noun_params or branch is None:
+            return
+        if node.value is not None \
+                and not (isinstance(node.value, ast.Constant)
+                         and node.value.value is None):
+            return                  # value-bearing: caller owns ledger
+        test, block = branch
+        if test is not None and _falsiness_guard(test):
+            return                  # `if not frames: return` drops nothing
+        if _counted(block, self._defs):
+            return
+        if self._counted_before(func, node.lineno):
+            return                  # `lost += rows; ...; if X: return`
+        at = (node.lineno, node.col_offset)
+        if at in seen:
+            return
+        seen.add(at)
+        yield Finding(
+            self.name, ctx.path, node.lineno, node.col_offset,
+            f"early return drops the "
+            f"{'/'.join(sorted(noun_params))} argument without moving "
+            f"any counter — count the drop (README loss-accounting) "
+            f"or make the guard an emptiness check",
+            self.severity)
+
+    def _counted_before(self, func: ast.AST, lineno: int) -> bool:
+        """The `self.lost_rows += rows; ...; if degraded: return` shape:
+        the function already moved a ledger for its argument before the
+        guard — the early return abandons nothing uncounted."""
+        for stmt in func.body:
+            for sub in _walk_same_frame_stmts(stmt):
+                if getattr(sub, "lineno", lineno) >= lineno:
+                    continue
+                if isinstance(sub, ast.AugAssign):
+                    return True
+                if isinstance(sub, ast.Call):
+                    d = dotted(sub.func)
+                    leaf = (d or "").rsplit(".", 1)[-1] if d else (
+                        sub.func.attr
+                        if isinstance(sub.func, ast.Attribute) else "")
+                    if leaf and set(_words(leaf)) & _COUNT_WORDS:
+                        return True
+        return False
 
 
 @register

@@ -40,7 +40,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 __all__ = ["Finding", "FileContext", "ProjectIndex", "Checker",
            "register", "all_rules", "run_lint", "run_on_sources",
            "scan_package", "save_baseline", "load_baseline",
-           "new_findings", "format_findings", "findings_to_json"]
+           "new_findings", "format_findings", "findings_to_json",
+           "findings_to_sarif"]
 
 
 @dataclass(frozen=True)
@@ -123,10 +124,27 @@ class ClassInfo:
     attr_classes: Dict[str, str] = field(default_factory=dict)
     # self.<attr> = threading.Lock()/RLock()/Condition(...)
     lock_attrs: set = field(default_factory=set)
+    # lock attr -> "Lock" | "RLock" | "Condition" (re-entrancy matters
+    # to the lock-order checker: with self._rlock nested in itself is
+    # legal, with self._lock is a self-deadlock)
+    lock_kinds: Dict[str, str] = field(default_factory=dict)
+    # method name -> its def node (the concurrency checkers walk real
+    # bodies; names alone cannot carry held-lock context)
+    method_asts: Dict[str, ast.AST] = field(default_factory=dict)
+    # methods whose bound reference was passed to a *.spawn(...) call
+    # (Supervisor.spawn targets and worker factories): thread roots
+    spawned: set = field(default_factory=set)
+    # methods handed out as bare `self.<m>` callback references in any
+    # call (ctor wiring like DeviceFeed(process=self._feed), scrape
+    # registration): they run on whoever holds the reference — another
+    # thread until proven otherwise
+    callbacks: set = field(default_factory=set)
 
 
 # a string literal that could plausibly name a fault site ("queue.stall")
 _SITE_STR_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+
+_DEF_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
 
 
 class ProjectIndex:
@@ -153,10 +171,26 @@ class ProjectIndex:
         self.fault_refs: Dict[str, List[Tuple[str, int]]] = {}
         # site-shaped string literals outside faults.py: value -> paths
         self.site_strings: Dict[str, set] = {}
+        # path -> qualname ("func" / "Class" / "Class.method") -> node,
+        # for the twin-drift fingerprint resolver
+        self.defs_by_path: Dict[str, Dict[str, ast.AST]] = {}
+        # path -> module tree (twin-table parsing needs module-level
+        # statements, which defs_by_path deliberately drops)
+        self.trees: Dict[str, ast.Module] = {}
+        # committed twin-fingerprint store (.lint-twins.json contents),
+        # or None when the scan was given none (fixture scans)
+        self.twin_store: Optional[dict] = None
+        # scratch memo space for whole-program analyses built lazily on
+        # first query (lock graph, twin registry): one build per scan
+        # no matter how many files ask — the memoized-ProjectIndex
+        # contract behind the ci.sh lint-runtime budget
+        self.memo: Dict[str, object] = {}
 
     # -- construction ------------------------------------------------------
     def add_file(self, ctx: FileContext) -> None:
         is_faults = ctx.path.endswith("faults.py")
+        self.trees[ctx.path] = ctx.tree
+        self._add_defs(ctx)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
                 self._add_class(node, ctx.path)
@@ -191,14 +225,28 @@ class ProjectIndex:
                                                    node.lineno)
             self.fault_defs_path = path
 
+    def _add_defs(self, ctx: FileContext) -> None:
+        """Top-level (and one-level class-nested) def/class nodes by
+        qualname — the twin-drift resolver's address space."""
+        defs = self.defs_by_path.setdefault(ctx.path, {})
+        for item in ctx.tree.body:
+            if isinstance(item, _DEF_NODES):
+                defs[item.name] = item
+                if isinstance(item, ast.ClassDef):
+                    for sub in item.body:
+                        if isinstance(sub, _DEF_NODES):
+                            defs[f"{item.name}.{sub.name}"] = sub
+
     def _add_class(self, node: ast.ClassDef, path: str) -> None:
         info = ClassInfo(node.name, path,
                          [d for d in (dotted(b) for b in node.bases) if d])
         for item in node.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 info.methods.add(item.name)
+                info.method_asts[item.name] = item
                 for sub in ast.walk(item):
                     self._maybe_self_attr(sub, info)
+                    self._maybe_spawn(sub, info)
             elif isinstance(item, ast.Assign):
                 for t in item.targets:
                     if isinstance(t, ast.Name):
@@ -220,8 +268,41 @@ class ProjectIndex:
         leaf = ctor.rsplit(".", 1)[-1]
         if leaf in ("Lock", "RLock", "Condition"):
             info.lock_attrs.add(t.attr)
+            info.lock_kinds[t.attr] = leaf
         else:
             info.attr_classes.setdefault(t.attr, leaf)
+
+    @staticmethod
+    def _maybe_spawn(node: ast.AST, info: ClassInfo) -> None:
+        """Record thread-root handoffs of this class's methods.
+
+        `sup.spawn(name, self._run)` marks `_run` a spawn target (the
+        Supervisor.spawn signature: target is the second positional or
+        the `target=` keyword); `sup.spawn(name, self._make_worker(i))`
+        marks the factory (its returned closure runs on the thread).
+        Separately, ANY bare `self.<m>` passed as a call argument is a
+        callback reference (`DeviceFeed(process=self._feed)`,
+        `stats.register("x", self.counters)`) — it runs on whichever
+        thread holds it."""
+        if not isinstance(node, ast.Call):
+            return
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            if (isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"):
+                info.callbacks.add(arg.attr)
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "spawn"):
+            return
+        targets = node.args[1:2] + [kw.value for kw in node.keywords
+                                    if kw.arg in ("target", "fn")]
+        for arg in targets:
+            target = arg.func if isinstance(arg, ast.Call) else arg
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                info.spawned.add(target.attr)
 
     # -- queries -----------------------------------------------------------
     _EXTERNAL_BASES = frozenset(["object", "Protocol", "ABC", "Generic",
@@ -387,8 +468,10 @@ def register(cls: type) -> type:
 
 
 def all_rules() -> Dict[str, type]:
-    """rule name -> Checker class (checkers module import-registers)."""
+    """rule name -> Checker class (checker modules import-register)."""
     from deepflow_tpu.analysis import checkers  # noqa: F401  (registers)
+    from deepflow_tpu.analysis import concurrency  # noqa: F401
+    from deepflow_tpu.analysis import twins  # noqa: F401
     return dict(_REGISTRY)
 
 
@@ -407,8 +490,43 @@ def _iter_py_files(root: str) -> List[str]:
     return out
 
 
+def build_index(files: Sequence[Tuple[str, str]]
+                ) -> Tuple[List[FileContext], ProjectIndex, List[Finding]]:
+    """Parse + index (relpath, source) pairs. Unparsable files become
+    parse-error findings instead of contexts — a silent parse skip
+    would read as "clean" (no-silent-caps)."""
+    contexts: List[FileContext] = []
+    errors: List[Finding] = []
+    index = ProjectIndex()
+    for path, source in files:
+        cached = _PARSE_CACHE.get(path)
+        if cached is not None and cached[0] == source:
+            ctx = FileContext(path, source, cached[1], cached[2])
+        else:
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                errors.append(Finding("parse-error", path, e.lineno or 1,
+                                      e.offset or 0,
+                                      f"syntax error: {e.msg}"))
+                continue
+            ctx = FileContext(path, source, tree, _pragmas(source))
+            _PARSE_CACHE[path] = (source, tree, ctx.pragma_lines)
+        contexts.append(ctx)
+        index.add_file(ctx)
+    return contexts, index, errors
+
+
+# path -> (source, tree, pragma lines): parsing ~250 files dominates a
+# self-scan, and the debug-loop `lint` command + the ci.sh budget both
+# re-scan an unchanged tree — trees are never mutated by checkers, so
+# an exact-source hit is safe to share across ProjectIndex builds
+_PARSE_CACHE: Dict[str, Tuple[str, ast.Module, Dict[int, set]]] = {}
+
+
 def _check_files(files: Sequence[Tuple[str, str]],
-                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+                 rules: Optional[Sequence[str]] = None,
+                 twin_store: Optional[dict] = None) -> List[Finding]:
     """Core pass over (relpath, source) pairs: parse, index, check."""
     registry = all_rules()
     if rules:
@@ -417,21 +535,8 @@ def _check_files(files: Sequence[Tuple[str, str]],
             raise ValueError(f"unknown rule(s): {', '.join(unknown)} "
                              f"(known: {', '.join(sorted(registry))})")
         registry = {k: v for k, v in registry.items() if k in rules}
-    contexts: List[FileContext] = []
-    findings: List[Finding] = []
-    index = ProjectIndex()
-    for path, source in files:
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as e:
-            # a file the checkers cannot see is itself a finding — a
-            # silent parse skip would read as "clean" (no-silent-caps)
-            findings.append(Finding("parse-error", path, e.lineno or 1,
-                                    e.offset or 0, f"syntax error: {e.msg}"))
-            continue
-        ctx = FileContext(path, source, tree, _pragmas(source))
-        contexts.append(ctx)
-        index.add_file(ctx)
+    contexts, index, findings = build_index(files)
+    index.twin_store = twin_store
     for ctx in contexts:
         for cls in registry.values():
             for f in cls().check(ctx, index):
@@ -445,18 +550,46 @@ def _norm(path: str, start: str) -> str:
     return os.path.relpath(os.path.abspath(path), start).replace(os.sep, "/")
 
 
+def package_parent() -> str:
+    """Directory the committed baseline/twin-store paths resolve
+    against (the installed package's parent — the repo root)."""
+    import deepflow_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(deepflow_tpu.__file__)))
+
+
+def default_twin_store_path() -> str:
+    return os.path.join(package_parent(), ".lint-twins.json")
+
+
+def _auto_twin_store(twin_store) -> Optional[dict]:
+    """"auto" -> the committed .lint-twins.json (None before the first
+    --ack-twin ever ran); a dict/None passes through (fixtures)."""
+    if twin_store != "auto":
+        return twin_store
+    from deepflow_tpu.analysis import twins
+    try:
+        return twins.load_store(default_twin_store_path())
+    except FileNotFoundError:
+        return None
+
+
 def run_lint(paths: Optional[Sequence[str]] = None,
-             rules: Optional[Sequence[str]] = None) -> List[Finding]:
+             rules: Optional[Sequence[str]] = None,
+             twin_store="auto") -> List[Finding]:
     """Lint `paths` (files or directories; default: the installed
     deepflow_tpu package). Files under the installed package normalize
     relative to the package PARENT ("deepflow_tpu/runtime/stats.py" —
     the same keys scan_package and the committed baseline use, from any
     cwd); files elsewhere fall back to cwd-relative."""
     if not paths:
-        return scan_package(rules=rules)
-    import deepflow_tpu
-    pkg_parent = os.path.dirname(os.path.dirname(
-        os.path.abspath(deepflow_tpu.__file__)))
+        return scan_package(rules=rules, twin_store=twin_store)
+    return _check_files(load_path_sources(paths), rules=rules,
+                        twin_store=_auto_twin_store(twin_store))
+
+
+def load_path_sources(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    pkg_parent = package_parent()
     cwd = os.getcwd()
     files: List[Tuple[str, str]] = []
     for p in paths:
@@ -467,27 +600,36 @@ def run_lint(paths: Optional[Sequence[str]] = None,
                 rel = _norm(t, cwd)
             with open(t, encoding="utf-8") as fh:
                 files.append((rel, fh.read()))
-    return _check_files(files, rules=rules)
+    return files
 
 
-def scan_package(rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Self-scan the installed deepflow_tpu tree (CI + the `lint` debug
-    command): paths come out relative to the package's parent, matching
-    the committed baseline regardless of the caller's cwd."""
-    import deepflow_tpu
-    pkg_dir = os.path.dirname(os.path.abspath(deepflow_tpu.__file__))
-    start = os.path.dirname(pkg_dir)
+def load_package_sources() -> List[Tuple[str, str]]:
+    pkg_parent = package_parent()
+    pkg_dir = os.path.join(pkg_parent, "deepflow_tpu")
     files = []
     for t in _iter_py_files(pkg_dir):
         with open(t, encoding="utf-8") as fh:
-            files.append((_norm(t, start), fh.read()))
-    return _check_files(files, rules=rules)
+            files.append((_norm(t, pkg_parent), fh.read()))
+    return files
+
+
+def scan_package(rules: Optional[Sequence[str]] = None,
+                 twin_store="auto") -> List[Finding]:
+    """Self-scan the installed deepflow_tpu tree (CI + the `lint` debug
+    command): paths come out relative to the package's parent, matching
+    the committed baseline regardless of the caller's cwd."""
+    return _check_files(load_package_sources(), rules=rules,
+                        twin_store=_auto_twin_store(twin_store))
 
 
 def run_on_sources(sources: Dict[str, str],
-                   rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Lint in-memory {path: source} — the test-fixture surface."""
-    return _check_files(sorted(sources.items()), rules=rules)
+                   rules: Optional[Sequence[str]] = None,
+                   twin_store: Optional[dict] = None) -> List[Finding]:
+    """Lint in-memory {path: source} — the test-fixture surface.
+    `twin_store` defaults to None (NOT the committed store): fixture
+    scans must never be judged against the real repo's fingerprints."""
+    return _check_files(sorted(sources.items()), rules=rules,
+                        twin_store=twin_store)
 
 
 # -- baseline --------------------------------------------------------------
@@ -548,3 +690,41 @@ def format_findings(findings: Sequence[Finding]) -> str:
 
 def findings_to_json(findings: Sequence[Finding]) -> str:
     return json.dumps([f.to_dict() for f in findings], indent=1)
+
+
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "note": "note"}
+
+
+def findings_to_sarif(findings: Sequence[Finding]) -> dict:
+    """SARIF 2.1.0 document for CI annotation surfaces (the ci.sh lint
+    gate writes artifacts/lint.sarif). Carries the full rule table so a
+    viewer can render descriptions for rules with zero results too."""
+    rules = [{"id": name,
+              "shortDescription": {"text": cls.description},
+              "defaultConfiguration": {
+                  "level": _SARIF_LEVELS.get(cls.severity, "error")}}
+             for name, cls in sorted(all_rules().items())]
+    rules.append({"id": "parse-error",
+                  "shortDescription": {"text": "file failed to parse — "
+                                               "checkers cannot see it"},
+                  "defaultConfiguration": {"level": "error"}})
+    results = [{
+        "ruleId": f.rule,
+        "level": _SARIF_LEVELS.get(f.severity, "error"),
+        "message": {"text": f.message},
+        "locations": [{"physicalLocation": {
+            "artifactLocation": {"uri": f.path},
+            "region": {"startLine": max(f.line, 1),
+                       "startColumn": f.col + 1},
+        }}],
+    } for f in findings]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "deepflow-lint",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }

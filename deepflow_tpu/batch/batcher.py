@@ -87,7 +87,10 @@ class Batcher:
         if (len(self._pool) >= self._POOL_CAP
                 or batch.capacity != self.capacity
                 or set(cols) != set(self.schema.names)):
-            return
+            # the batch's ROWS were already delivered downstream; this
+            # declines only the spent buffer's reuse (pool full/shape
+            # mismatch), so there is no loss to count
+            return  # lint: disable=silent-drop
         self.recycled += 1
         self._pool.append(cols)
 

@@ -526,8 +526,12 @@ def cmd_lint(args) -> int:
     the pipeline's concurrency / trace-safety / metrics disciplines.
     The zero-arg form self-scans the installed package; --baseline
     gates on NEW findings only (the committed .lint-baseline.json
-    workflow ci.sh enforces)."""
+    workflow ci.sh enforces); --twins/--ack-twin manage the host/device
+    twin fingerprints (.lint-twins.json) the twin-drift rule gates on;
+    --sarif writes the gated findings as SARIF 2.1.0 for CI annotation."""
     from deepflow_tpu import analysis
+    from deepflow_tpu.analysis import core as _ana_core
+    from deepflow_tpu.analysis import twins as _ana_twins
 
     if args.list_rules:
         for name, cls in sorted(analysis.all_rules().items()):
@@ -535,7 +539,52 @@ def cmd_lint(args) -> int:
         return 0
     rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
         if args.rules else None
-    findings = analysis.run_lint(args.paths or None, rules=rules)
+    twins_path = args.twins or _ana_core.default_twin_store_path()
+    if args.ack_twin:
+        # re-acknowledge every declared twin pair: recompute normalized
+        # fingerprints from the CURRENT tree and rewrite the store. The
+        # bit-identity tests in the same CI run are what make this an
+        # informed signature, not a rubber stamp.
+        files = _ana_core.load_path_sources(args.paths) if args.paths \
+            else _ana_core.load_package_sources()
+        _ctxs, index, errors = _ana_core.build_index(files)
+        if errors:
+            print(analysis.format_findings(errors), file=sys.stderr)
+            return 2
+        store, missing = _ana_twins.build_store(index)
+        if missing:
+            print("--ack-twin refuses unresolvable twin refs "
+                  "(fix the registry first):", file=sys.stderr)
+            for m in missing:
+                print(f"  {m}", file=sys.stderr)
+            return 2
+        if args.paths:
+            # partial scope: MERGE into the existing store — a scan
+            # that never saw a pair must not silently un-acknowledge
+            # it (only the full self-scan may drop pairs)
+            try:
+                prior = _ana_twins.load_store(twins_path)
+            except FileNotFoundError:
+                prior = None
+            if prior is not None:
+                merged = dict(prior.get("pairs", {}))
+                merged.update(store["pairs"])
+                store["pairs"] = merged
+                print(f"note: path-scoped ack merged into "
+                      f"{len(merged)} committed pair(s); only a full "
+                      f"self-scan ack drops pairs", file=sys.stderr)
+        _ana_twins.save_store(store, twins_path)
+        print(f"twin store updated: {len(store['pairs'])} pair(s) "
+              f"acknowledged -> {twins_path}")
+        return 0
+    twin_store = "auto"
+    if args.twins:
+        try:
+            twin_store = _ana_twins.load_store(args.twins)
+        except FileNotFoundError:
+            twin_store = None       # no store yet: pairs read as unacked
+    findings = analysis.run_lint(args.paths or None, rules=rules,
+                                 twin_store=twin_store)
     if args.update_baseline:
         if not args.baseline:
             print("--update-baseline requires --baseline FILE",
@@ -561,6 +610,11 @@ def cmd_lint(args) -> int:
     if args.baseline:
         gated = analysis.new_findings(findings,
                                       analysis.load_baseline(args.baseline))
+    if args.sarif:
+        doc = analysis.findings_to_sarif(gated)
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
     if args.json:
         print(analysis.findings_to_json(gated))
     else:
@@ -759,6 +813,18 @@ def build_parser() -> argparse.ArgumentParser:
     ln.add_argument("--rules", help="comma-separated rule subset")
     ln.add_argument("--json", action="store_true",
                     help="machine-readable findings on stdout")
+    ln.add_argument("--sarif", metavar="FILE",
+                    help="write gated findings as SARIF 2.1.0 (CI "
+                         "annotation surfaces; ci.sh writes "
+                         "artifacts/lint.sarif)")
+    ln.add_argument("--twins", metavar="FILE",
+                    help="twin-fingerprint store for the twin-drift "
+                         "rule (default: the committed "
+                         ".lint-twins.json next to the package)")
+    ln.add_argument("--ack-twin", action="store_true",
+                    help="re-acknowledge all declared host/device twin "
+                         "pairs: recompute fingerprints and rewrite the "
+                         "store (run the bit-identity tests first)")
     ln.add_argument("--list-rules", action="store_true",
                     help="list rules with their one-line descriptions")
     ln.set_defaults(fn=cmd_lint)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from deepflow_tpu.ops import cms, entropy, hll, topk
+from deepflow_tpu.utils.twinmark import host_twin_of
 from deepflow_tpu.utils.u32 import fold_columns
 
 ENTROPY_FEATURES = ("ip_src", "ip_dst", "port_src", "port_dst")
@@ -330,6 +331,7 @@ def unpack_lanes(lanes: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     }
 
 
+@host_twin_of("deepflow_tpu/models/flow_suite.py:unpack_lanes")
 def unpack_lanes_np(plane: np.ndarray, n: int) -> Dict[str, np.ndarray]:
     """Host twin of `unpack_lanes` over one (4, C) staged plane,
     trimmed to the n valid rows — what degraded mode consumes when a

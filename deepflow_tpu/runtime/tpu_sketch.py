@@ -656,11 +656,11 @@ class TpuSketchExporter(QueueWorkerExporter):
         tr = self._tracer
         try:
             if not tr.enabled:
-                self._run_batch_inner(tb)
+                self._run_batch_inner_locked(tb)
                 return
             before = self.h2d_transfers
             with tr.span("kernel", stream=self.wire, rows=tb.valid):
-                self._run_batch_inner(tb)
+                self._run_batch_inner_locked(tb)
             if self._detailed:
                 # the same coalescing-regression gauge the feed path
                 # records: the inline path honestly reads its
@@ -757,7 +757,7 @@ class TpuSketchExporter(QueueWorkerExporter):
         self._host = None
         return True
 
-    def _run_batch_inner(self, tb: TensorBatch) -> None:
+    def _run_batch_inner_locked(self, tb: TensorBatch) -> None:
         if self._faults.enabled:   # chaos: simulated device loss
             self._faults.maybe_raise(FAULT_DEVICE_ERROR, key=self.wire)
         if self._tracer.enabled:
@@ -870,7 +870,10 @@ class TpuSketchExporter(QueueWorkerExporter):
             tr.gauge("tpu_transfers_per_batch",
                      (self.h2d_transfers - before) / len(group))
         if staged is None:
-            return None
+            # None = the dict packer emitted no wire for this group
+            # (zero valid rows): there is no fence to wait on and no
+            # data was abandoned — nothing for the ledger to count
+            return None  # lint: disable=silent-drop
         fence, flat = staged
         if tr.enabled and self._detailed:
             tr.gauge("tpu_h2d_coalesced_bytes", float(flat.nbytes))

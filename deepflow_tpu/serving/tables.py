@@ -50,6 +50,7 @@ import numpy as np
 from deepflow_tpu.runtime.snapbus import SketchSnapshot
 from deepflow_tpu.runtime.tracing import HostDDSketch, default_tracer
 from deepflow_tpu.serving.cache import SnapshotCache
+from deepflow_tpu.utils.twinmark import host_twin_of
 from deepflow_tpu.utils.u32 import _mix32_np
 
 __all__ = ["SketchTables", "SKETCH_TABLE", "SKETCH_SQL_FUNCS",
@@ -76,6 +77,7 @@ ENTROPY_COLS = ("entropy_ip_src", "entropy_ip_dst",
 LOOKBACK_S = 300.0
 
 
+@host_twin_of("deepflow_tpu/utils/u32.py:mix32")
 def _mix32_int(x: int) -> int:
     """Scalar host twin of utils/u32.mix32 (murmur3 fmix32) — plain int
     arithmetic, the cms_point fast path (no array allocation per query,
@@ -89,6 +91,7 @@ def _mix32_int(x: int) -> int:
     return x
 
 
+@host_twin_of("deepflow_tpu/utils/u32.py:fold_columns")
 def fold_tuple(ip_src: int, ip_dst: int, port_src: int, port_dst: int,
                proto: int) -> int:
     """Scalar host twin of flow_suite.flow_key (fold_columns): the

@@ -1,0 +1,25 @@
+"""The host/device twin marker, dependency-free on purpose.
+
+Data-plane modules (utils/u32, models/flow_suite, serving/tables) tag
+their host twins with `@host_twin_of(...)`; the twin-drift lint rule
+(analysis/twins.py) reads the decorator LEXICALLY, so this module must
+cost nothing to import and can never create a cycle — it imports
+nothing. analysis/twins re-exports it for tooling-side callers.
+"""
+
+from __future__ import annotations
+
+__all__ = ["host_twin_of"]
+
+
+def host_twin_of(device_ref: str):
+    """Declare the decorated def/class the host twin of `device_ref`
+    ("path/to/mod.py:qualname" or "pkg.mod:qualname").
+
+    Runtime no-op beyond tagging (`__device_twin__`) — the lint reads
+    the decorator lexically. The tag keeps the link discoverable from
+    a REPL (`fold_columns_np.__device_twin__`)."""
+    def deco(obj):
+        obj.__device_twin__ = device_ref
+        return obj
+    return deco

@@ -13,6 +13,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from deepflow_tpu.utils.twinmark import host_twin_of
+
 _U32 = np.uint32
 
 
@@ -66,6 +68,7 @@ def _as_u32_np(x) -> np.ndarray:
     return x.astype(np.uint32)
 
 
+@host_twin_of("deepflow_tpu/utils/u32.py:mix32")
 def _mix32_np(x: np.ndarray) -> np.ndarray:
     """Host twin of mix32, op for op — keep the two in lockstep."""
     x = x ^ (x >> _U32(16))
@@ -75,6 +78,7 @@ def _mix32_np(x: np.ndarray) -> np.ndarray:
     return x ^ (x >> _U32(16))
 
 
+@host_twin_of("deepflow_tpu/utils/u32.py:fold_columns")
 def fold_columns_np(cols) -> np.ndarray:
     """Host twin of fold_columns — BIT-IDENTICAL to the device fold
     (asserted in tests), so host code can resolve device flow keys back

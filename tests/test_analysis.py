@@ -348,6 +348,478 @@ def test_fault_site_drift_silent_without_faults_file():
     assert analysis.run_on_sources({"runtime/queues.py": src}) == []
 
 
+# ---------------------------------------------------- lock-order-cycle
+
+TWO_LOCK_CYCLE = {
+    # the seeded deadlock: A.m1 holds _la then asks B for _lb, while
+    # B.m3 holds _lb then asks A for _la — classic inversion
+    "runtime/locks_a.py": (
+        "import threading\n"
+        "from runtime.locks_b import B\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self.b = B()\n"
+        "    def m1(self):\n"
+        "        with self._la:\n"
+        "            self.b.m2()\n"
+        "    def m4(self):\n"
+        "        with self._la:\n"
+        "            pass\n"),
+    "runtime/locks_b.py": (
+        "import threading\n"
+        "from runtime.locks_a import A\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lb = threading.Lock()\n"
+        "        self.a = A()\n"
+        "    def m2(self):\n"
+        "        with self._lb:\n"
+        "            pass\n"
+        "    def m3(self):\n"
+        "        with self._lb:\n"
+        "            self.a.m4()\n"),
+}
+
+
+def test_lock_order_cycle_two_lock_fixture():
+    fs = analysis.run_on_sources(TWO_LOCK_CYCLE)
+    assert rules_of(fs) == ["lock-order-cycle"]
+    # ONE finding per cycle, naming the full ring deterministically
+    assert "A._la -> B._lb -> A._la" in fs[0].message
+
+
+def test_lock_order_cycle_negative_consistent_order():
+    # same two locks, both paths acquire A-then-B: acyclic, silent
+    ok = {k: v.replace("self.a.m4()", "pass") for k, v in
+          TWO_LOCK_CYCLE.items()}
+    assert analysis.run_on_sources(ok) == []
+
+
+def test_lock_order_cycle_self_deadlock_through_helper():
+    src = ("import threading\n"
+           "class Q:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def put(self, x):\n"
+           "        with self._lock:\n"
+           "            self._flush()\n"
+           "    def _flush(self):\n"
+           "        with self._lock:\n"
+           "            pass\n")
+    fs = analysis.run_on_sources({"runtime/q.py": src})
+    assert rules_of(fs) == ["lock-order-cycle"]
+    assert "non-reentrant" in fs[0].message
+    # the same nesting through an RLock is legal — silent
+    assert analysis.run_on_sources(
+        {"runtime/q.py": src.replace("threading.Lock()",
+                                     "threading.RLock()")}) == []
+
+
+def test_lock_order_cycle_self_deadlock_through_member_chain():
+    # A.m1 holds _la -> b.m2 -> a.m4 re-acquires _la: deadlock with no
+    # second thread, reported even though it crosses two member calls
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self._la = threading.Lock()\n"
+           "        self.b = B()\n"
+           "    def m1(self):\n"
+           "        with self._la:\n"
+           "            self.b.m2()\n"
+           "    def m4(self):\n"
+           "        with self._la:\n"
+           "            pass\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self.a = A()\n"
+           "    def m2(self):\n"
+           "        self.a.m4()\n")
+    fs = analysis.run_on_sources({"runtime/chain.py": src})
+    assert rules_of(fs) == ["lock-order-cycle"]
+    assert "non-reentrant" in fs[0].message
+
+
+def test_lock_order_cycle_pragma_and_scope():
+    # pragma on the anchor line silences the cycle
+    pragmad = dict(TWO_LOCK_CYCLE)
+    pragmad["runtime/locks_a.py"] = pragmad["runtime/locks_a.py"].replace(
+        "            self.b.m2()",
+        "            self.b.m2()  # lint: disable=lock-order-cycle")
+    assert analysis.run_on_sources(pragmad) == []
+    # outside the concurrency core (agent/) the rule stays out
+    moved = {k.replace("runtime/", "agent/"):
+             v.replace("runtime.", "agent.")
+             for k, v in TWO_LOCK_CYCLE.items()}
+    assert analysis.run_on_sources(moved) == []
+
+
+# ------------------------------------------------ unlocked-shared-write
+
+SHARED_WRITE = (
+    "import threading\n"
+    "class W:\n"
+    "    def __init__(self, sup):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._buf = []\n"
+    "        sup.spawn('w', self._run)\n"
+    "    def put(self, frame):\n"
+    "        with self._lock:\n"
+    "            self._buf.append(frame)\n"
+    "    def _run(self):\n"
+    "        self._buf = []\n")
+
+
+def test_unlocked_shared_write_positive():
+    fs = analysis.run_on_sources({"runtime/w.py": SHARED_WRITE})
+    assert rules_of(fs) == ["unlocked-shared-write"]
+    assert "_buf" in fs[0].message and "_run" in fs[0].message
+
+
+def test_unlocked_shared_write_negatives():
+    # both writes under the lock: silent
+    locked = SHARED_WRITE.replace(
+        "    def _run(self):\n        self._buf = []\n",
+        "    def _run(self):\n        with self._lock:\n"
+        "            self._buf = []\n")
+    assert analysis.run_on_sources({"runtime/w.py": locked}) == []
+    # a *_locked helper carries the caller-holds-the-lock promise
+    suffixed = SHARED_WRITE.replace(
+        "    def _run(self):\n        self._buf = []\n",
+        "    def _run(self):\n        self._clear_locked()\n"
+        "    def _clear_locked(self):\n        self._buf = []\n")
+    assert analysis.run_on_sources({"runtime/w.py": suffixed}) == []
+    # a deliberately lock-free counter (no locked write anywhere) is
+    # not this rule's business
+    lockfree = SHARED_WRITE.replace("        with self._lock:\n"
+                                    "            self._buf.append(frame)\n",
+                                    "        self._buf.append(frame)\n")
+    assert analysis.run_on_sources({"runtime/w.py": lockfree}) == []
+    # __init__ writes are construction, not a race: the one finding in
+    # the positive fixture indicts _run, never the constructor
+    fs = analysis.run_on_sources({"runtime/w.py": SHARED_WRITE})
+    assert len(fs) == 1 and "W._run()" in fs[0].message
+
+
+def test_unlocked_shared_write_single_entry_and_pragma():
+    # only ONE thread root: nothing shared, silent
+    single = SHARED_WRITE.replace("sup.spawn('w', self._run)\n", "pass\n")
+    assert analysis.run_on_sources({"runtime/w.py": single}) == []
+    pragmad = SHARED_WRITE.replace(
+        "        self._buf = []\n",
+        "        self._buf = []  # lint: disable=unlocked-shared-write\n")
+    assert analysis.run_on_sources({"runtime/w.py": pragmad}) == []
+
+
+def test_unlocked_shared_write_callback_entry():
+    # a method handed out as a ctor callback is a thread root too
+    src = ("import threading\n"
+           "class W:\n"
+           "    def __init__(self, feed_cls):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._n = 0\n"
+           "        self._feed = feed_cls(on_error=self._on_error)\n"
+           "    def put(self, frame):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n"
+           "    def _on_error(self, exc):\n"
+           "        self._n = 0\n")
+    fs = analysis.run_on_sources({"runtime/cb.py": src})
+    assert rules_of(fs) == ["unlocked-shared-write"]
+
+
+# ------------------------------------------------------- silent-drop
+
+def test_silent_drop_except_swallow():
+    src = ("class D:\n"
+           "    def feed(self, frames):\n"
+           "        for frame in frames:\n"
+           "            try:\n"
+           "                frame.decode()\n"
+           "            except Exception:\n"
+           "                continue\n")
+    fs = analysis.run_on_sources({"runtime/d.py": src})
+    assert rules_of(fs) == ["silent-drop"]
+    assert "frame" in fs[0].message
+    # counting the loss in the handler satisfies the ledger
+    counted = src.replace("                continue\n",
+                          "                self.decode_errors += 1\n")
+    assert analysis.run_on_sources({"runtime/d.py": counted}) == []
+    # ... and so does following a same-file helper that counts
+    helper = src.replace(
+        "                continue\n",
+        "                self._on_error()\n") + (
+        "    def _on_error(self):\n"
+        "        self.decode_errors += 1\n")
+    assert analysis.run_on_sources({"runtime/d.py": helper}) == []
+
+
+def test_silent_drop_continue_and_guarded_return():
+    src = ("class D:\n"
+           "    def scan(self, batches):\n"
+           "        for batch in batches:\n"
+           "            if batch.stale:\n"
+           "                continue\n"
+           "            self._emit(batch)\n"
+           "    def put(self, batch):\n"
+           "        if self._closed:\n"
+           "            return\n"
+           "        self._emit(batch)\n")
+    fs = analysis.run_on_sources({"runtime/d.py": src})
+    assert rules_of(fs) == ["silent-drop", "silent-drop"]
+    # emptiness guards abandon nothing
+    ok = ("class D:\n"
+          "    def put(self, batch):\n"
+          "        if not batch:\n"
+          "            return\n"
+          "        self._emit(batch)\n")
+    assert analysis.run_on_sources({"runtime/d.py": ok}) == []
+    # counting before the guard covers the early return
+    pre = ("class D:\n"
+           "    def absorb(self, rows):\n"
+           "        self.lost_rows += rows\n"
+           "        if self.degraded:\n"
+           "            return\n"
+           "        self._restore()\n")
+    assert analysis.run_on_sources({"runtime/d.py": pre}) == []
+
+
+def test_silent_drop_empty_skip_continue_stays_silent():
+    # `if not frame: continue` skips NOTHING — same emptiness-guard
+    # exemption the return shape has
+    src = ("class D:\n"
+           "    def feed(self, frames):\n"
+           "        for frame in frames:\n"
+           "            if not frame:\n"
+           "                continue\n"
+           "            self._emit(frame)\n")
+    assert analysis.run_on_sources({"runtime/d.py": src}) == []
+    src2 = src.replace("if not frame:", "if frame is None:")
+    assert analysis.run_on_sources({"runtime/d.py": src2}) == []
+
+
+def test_silent_drop_retry_idioms_stay_silent():
+    # recv-retry: the noun was only ever an assignment target — no
+    # data existed when the call raised
+    recv = ("class R:\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            try:\n"
+            "                chunk = self.sock.recv(65536)\n"
+            "            except OSError:\n"
+            "                return\n"
+            "            self._dispatch(chunk)\n")
+    assert analysis.run_on_sources({"runtime/r.py": recv}) == []
+    # backpressure wait-and-continue consumes nothing
+    bp = ("class S:\n"
+          "    def _drain(self):\n"
+          "        while True:\n"
+          "            blobs = self.store.take()\n"
+          "            if self.queue.full():\n"
+          "                self._stop.wait(0.05)\n"
+          "                continue\n"
+          "            self.queue.reinject(blobs)\n")
+    assert analysis.run_on_sources({"runtime/s.py": bp}) == []
+
+
+def test_silent_drop_pragma_and_scope():
+    src = ("class D:\n"
+           "    def put(self, batch):\n"
+           "        if self._closed:\n"
+           "            return  # lint: disable=silent-drop\n"
+           "        self._emit(batch)\n")
+    assert analysis.run_on_sources({"runtime/d.py": src}) == []
+    # telemetry modules are exempt: dropping a span is not row loss
+    span = ("class T:\n"
+            "    def observe(self, rows):\n"
+            "        if self._off:\n"
+            "            return\n"
+            "        self._emit(rows)\n")
+    assert analysis.run_on_sources({"runtime/tracing.py": span}) == []
+    assert analysis.run_on_sources({"runtime/t.py": span}) != []
+
+
+# -------------------------------------------------------- twin-drift
+
+TWIN_SRCS = {
+    "pkg/analysis/twins.py": (
+        'TWIN_TABLE = [\n'
+        '    ("host-sketch", "pkg/host.py:HostSketch", "pkg/dev.py:mix"),\n'
+        ']\n'),
+    "pkg/host.py": ("class HostSketch:\n"
+                    "    def absorb(self, x):\n"
+                    "        return x * 3\n"),
+    "pkg/dev.py": ("def mix(x):\n"
+                   "    return x * 3\n"),
+    "pkg/marked.py": (
+        "from deepflow_tpu.analysis.twins import host_twin_of\n"
+        "@host_twin_of('pkg/dev.py:mix')\n"
+        "def mix_np(x):\n"
+        "    return x * 3\n"),
+}
+
+
+def _twin_store_for(srcs):
+    from deepflow_tpu.analysis import core as ana_core
+    from deepflow_tpu.analysis import twins as ana_twins
+    _ctxs, index, _errs = ana_core.build_index(sorted(srcs.items()))
+    store, missing = ana_twins.build_store(index)
+    assert missing == []
+    return store
+
+
+def test_twin_drift_unacked_edit_trips_both_decl_kinds():
+    store = _twin_store_for(TWIN_SRCS)
+    # acked store + unchanged tree: clean
+    assert analysis.run_on_sources(TWIN_SRCS, twin_store=store) == []
+    # editing the shared DEVICE side without re-ack trips BOTH the
+    # table pair and the decorator pair
+    edited = dict(TWIN_SRCS)
+    edited["pkg/dev.py"] = "def mix(x):\n    return x * 5\n"
+    fs = analysis.run_on_sources(edited, twin_store=store)
+    assert rules_of(fs) == ["twin-drift", "twin-drift"]
+    assert all("device side" in f.message for f in fs)
+    # editing the HOST class twin trips just its pair, at the class
+    edited2 = dict(TWIN_SRCS)
+    edited2["pkg/host.py"] = TWIN_SRCS["pkg/host.py"].replace("* 3", "* 4")
+    fs = analysis.run_on_sources(edited2, twin_store=store)
+    assert [f.path for f in fs] == ["pkg/host.py"]
+    assert "host side" in fs[0].message
+
+
+def test_twin_drift_comment_edits_do_not_trip():
+    store = _twin_store_for(TWIN_SRCS)
+    cosmetic = dict(TWIN_SRCS)
+    cosmetic["pkg/dev.py"] = ("def mix(x):\n"
+                              "    # a comment, not a drift\n"
+                              "    return x * 3\n")
+    assert analysis.run_on_sources(cosmetic, twin_store=store) == []
+
+
+def test_twin_drift_unregistered_missing_and_stale():
+    # declared pair with no committed fingerprints: unacked
+    fs = analysis.run_on_sources(TWIN_SRCS, twin_store=None)
+    assert rules_of(fs) == ["twin-drift"] * 2
+    assert all("no committed fingerprints" in f.message for f in fs)
+    # one side deleted: the registry itself has drifted
+    store = _twin_store_for(TWIN_SRCS)
+    gone = {k: v for k, v in TWIN_SRCS.items() if k != "pkg/dev.py"}
+    fs = analysis.run_on_sources(gone, twin_store=store)
+    assert fs and all("does not resolve" in f.message for f in fs)
+    # a committed pair no longer declared anywhere: deliberate drop
+    # required (--ack-twin)
+    undeclared = dict(TWIN_SRCS)
+    undeclared["pkg/analysis/twins.py"] = "TWIN_TABLE = []\n"
+    fs = analysis.run_on_sources(undeclared, twin_store=store)
+    assert any("no longer declared" in f.message for f in fs)
+    # ...including when EVERY registration is deleted at once — an
+    # emptied registry must not disarm its own gate
+    disarmed = dict(undeclared)
+    disarmed["pkg/marked.py"] = "def mix_np(x):\n    return x * 3\n"
+    fs = analysis.run_on_sources(disarmed, twin_store=store)
+    assert sorted(f.message.split("'")[1] for f in fs
+                  if "no longer declared" in f.message) == \
+        ["host-sketch", "pkg/marked.py:mix_np"]
+
+
+def test_twin_drift_pragma_and_partial_scan():
+    store = _twin_store_for(TWIN_SRCS)
+    edited = dict(TWIN_SRCS)
+    edited["pkg/dev.py"] = ("def mix(x):  # lint: disable=twin-drift\n"
+                            "    return x * 5\n")
+    assert analysis.run_on_sources(edited, twin_store=store) == []
+    # a scan that sees NEITHER side of a pair stays silent (partial
+    # scans must not cry drift)
+    partial = {"pkg/analysis/twins.py": TWIN_SRCS["pkg/analysis/twins.py"]}
+    assert analysis.run_on_sources(partial, twin_store=store) == []
+
+
+def test_twin_ack_cli_round_trip(tmp_path, capsys):
+    """The --ack-twin workflow end to end: ack -> clean gate, edit ->
+    gate trips, re-ack -> clean again (the CI acceptance shape)."""
+    for rel, src in TWIN_SRCS.items():
+        if rel == "pkg/marked.py":
+            continue            # keep the fixture import-free
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+    store = tmp_path / "twins.json"
+    assert cli_main(["lint", str(tmp_path), "--twins", str(store),
+                     "--ack-twin"]) == 0
+    assert cli_main(["lint", str(tmp_path), "--twins", str(store),
+                     "--rules", "twin-drift"]) == 0
+    (tmp_path / "pkg/dev.py").write_text("def mix(x):\n    return x * 9\n")
+    assert cli_main(["lint", str(tmp_path), "--twins", str(store),
+                     "--rules", "twin-drift"]) == 1
+    out = capsys.readouterr().out
+    assert "twin-drift" in out and "--ack-twin" in out
+    assert cli_main(["lint", str(tmp_path), "--twins", str(store),
+                     "--ack-twin"]) == 0
+    assert cli_main(["lint", str(tmp_path), "--twins", str(store),
+                     "--rules", "twin-drift"]) == 0
+    capsys.readouterr()
+
+
+def test_twin_ack_path_scope_merges_not_overwrites(tmp_path, capsys):
+    """A path-scoped --ack-twin must not drop acknowledged pairs it
+    never scanned — partial acks merge; only a full scan replaces."""
+    for rel, src in TWIN_SRCS.items():
+        if rel == "pkg/marked.py":
+            continue
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+    other = tmp_path / "other.py"
+    other.write_text(
+        "from deepflow_tpu.utils.twinmark import host_twin_of\n"
+        "@host_twin_of('other.py:dev')\n"
+        "def host(x):\n"
+        "    return x\n"
+        "def dev(x):\n"
+        "    return x\n")
+    store = tmp_path / "twins.json"
+    assert cli_main(["lint", str(tmp_path), "--twins", str(store),
+                     "--ack-twin"]) == 0
+    n_full = len(json.loads(store.read_text())["pairs"])
+    assert n_full == 2          # the table pair + the decorator pair
+    # re-ack ONLY the decorator file: the table pair must survive
+    assert cli_main(["lint", str(other), "--twins", str(store),
+                     "--ack-twin"]) == 0
+    assert len(json.loads(store.read_text())["pairs"]) == n_full
+    capsys.readouterr()
+
+
+def test_repo_twin_store_matches_tree(repo_scan):
+    """The committed .lint-twins.json is in lockstep with the shipped
+    tree: the self-scan (which loads it by default) reports no drift,
+    and every committed pair still resolves."""
+    assert [f for f in repo_scan if f.rule == "twin-drift"] == []
+    store = json.loads((REPO_ROOT / ".lint-twins.json").read_text())
+    assert store["version"] == 1
+    assert len(store["pairs"]) >= 10
+
+
+# --------------------------------------------------------------- sarif
+
+def test_cli_sarif_output(tmp_path, capsys):
+    f = tmp_path / "mod.py"
+    f.write_text(THREAD_SRC)
+    out = tmp_path / "lint.sarif"
+    assert cli_main(["lint", str(f), "--sarif", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "deepflow-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    for need in ("lock-order-cycle", "unlocked-shared-write",
+                 "silent-drop", "twin-drift", "unsupervised-thread"):
+        assert need in rule_ids
+    assert run["results"][0]["ruleId"] == "unsupervised-thread"
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 2
+    capsys.readouterr()
+
+
 # --------------------------------------------------------- framework
 
 def test_parse_error_is_a_finding():
@@ -408,6 +880,44 @@ _RULE_FIXTURES = {
         "    def __init__(self, stats):\n"
         "        stats.register('p', self.counters)\n")),
     "fault-site-drift": ("runtime/faults.py", 'FAULT_O = "ghost.site"\n'),
+    "lock-order-cycle": ("runtime/q.py", (
+        "import threading\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def put(self, x):\n"
+        "        with self._lock:\n"
+        "            self._flush()\n"
+        "    def _flush(self):\n"
+        "        with self._lock:\n"
+        "            pass\n")),
+    "unlocked-shared-write": ("runtime/w.py", (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self, sup):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._buf = []\n"
+        "        sup.spawn('w', self._run)\n"
+        "    def put(self, frame):\n"
+        "        with self._lock:\n"
+        "            self._buf.append(frame)\n"
+        "    def _run(self):\n"
+        "        self._buf = []\n")),
+    "silent-drop": ("runtime/d.py", (
+        "class D:\n"
+        "    def put(self, batch):\n"
+        "        if self._closed:\n"
+        "            return\n"
+        "        self._emit(batch)\n")),
+    # the table-declared pair is unacked against the committed store,
+    # so the gate trips on the fixture without touching the real tree
+    "twin-drift": ("analysis/twins.py", (
+        'TWIN_TABLE = [("p", "analysis/twins.py:f",'
+        ' "analysis/twins.py:g")]\n'
+        "def f(x):\n"
+        "    return x\n"
+        "def g(x):\n"
+        "    return x\n")),
 }
 
 
