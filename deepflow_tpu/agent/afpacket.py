@@ -249,14 +249,21 @@ class CaptureLoop:
             stats.register("capture", self.counters)
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run,
-                                        name="capture-loop", daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): crash capture +
+        # deadman beats; a source failure still STOPS the loop (normal
+        # return, no restart) with the failure recorded in counters
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "capture-loop", self._run)
 
     def _run(self) -> None:
         import numpy as np
+
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         errors_seen = 0
         while not self._stop.is_set():
+            sup.beat()
             try:
                 frames, stamps = self.source.read_batch()
             except Exception as e:
@@ -284,6 +291,7 @@ class CaptureLoop:
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
         self.source.close()
         bpf = getattr(self.source, "bpf", None)

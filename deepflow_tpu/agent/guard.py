@@ -68,17 +68,24 @@ class Guard:
         return None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name="guard",
-                                        daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): a raising breach
+        # callback used to kill the guard silently — no RSS ceiling, no
+        # CPU cap, forever; now it's crash-captured and restarted
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "guard", self._run, beat_period_s=self.check_interval)
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
 
     def _run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._stop.wait(self.check_interval):
+            sup.beat()
             breach = self.check_once()
             if breach is not None:
                 self.breaches += 1

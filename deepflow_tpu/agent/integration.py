@@ -97,11 +97,19 @@ class IntegrationCollector:
         return self._httpd.server_address[1]
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="integration-http", daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): crash capture for
+        # the accept loop. deadman off — serve_forever cannot beat
+        # without the querier's service_actions subclass, and a silent
+        # watchdog 503 on a healthy collector is worse than no watchdog
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "integration-http",
+            lambda: self._httpd.serve_forever(poll_interval=0.5),
+            deadman_s=None)
 
     def close(self) -> None:
+        if self._thread is not None:
+            self._thread.stop()     # no restart on the way down
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -98,7 +98,7 @@ class ApiWatcher:
         self._cache: Dict[str, Dict[str, dict]] = {r: {} for r in resources}
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
+        self._threads: list = []   # supervisor ThreadHandles
         self.lists = 0
         self.watch_events = 0
         self.relists_410 = 0
@@ -212,15 +212,20 @@ class ApiWatcher:
 
     # -- public ------------------------------------------------------------
     def start(self) -> None:
+        # supervised (ISSUE 14 baseline burn-down). deadman off: the
+        # watch stream legitimately blocks ~watch_timeout_s between
+        # events, which would read permanently stale to the watchdog
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         for r in self.resources:
-            t = threading.Thread(target=self._run, args=(r,),
-                                 name=f"k8s-watch-{r}", daemon=True)
-            t.start()
+            t = sup.spawn(f"k8s-watch-{r}",
+                          lambda r=r: self._run(r), deadman_s=None)
             self._threads.append(t)
 
     def close(self) -> None:
         self._stop.set()
         for t in self._threads:
+            t.stop()
             t.join(timeout=2)
 
     def snapshot(self) -> List[dict]:

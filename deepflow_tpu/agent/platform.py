@@ -161,18 +161,24 @@ class SnapshotWatcher:
         return False
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop,
-                                        name="platform-watch", daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): a raising lister /
+        # report hook is crash-captured and restarted with backoff
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "platform-watch", self._loop, beat_period_s=self.interval_s)
 
     def _loop(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         self.poll_once()
         while not self._stop.wait(self.interval_s):
+            sup.beat()
             self.poll_once()
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
 
     def counters(self) -> dict:

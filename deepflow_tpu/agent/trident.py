@@ -299,7 +299,7 @@ class Agent:
             for mt in sender_types
         }
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
+        self._threads: list = []   # supervisor ThreadHandles
         self._lock = threading.Lock()
         self._l7_out: List[bytes] = []
         self.escaped = False
@@ -961,11 +961,15 @@ class Agent:
             self.stats_shipper = StatsShipper(
                 self.stats, self.cfg.ingester_addr, vtap_id=self.vtap_id)
             self.stats.start(interval_s=10.0)
+        # worker threads ride the supervision tree (ISSUE 14 baseline
+        # burn-down): crash capture + backoff restart instead of a
+        # silently dead synchronizer/ticker
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         if self.cfg.controller_url is not None:
-            t = threading.Thread(target=self._sync_loop, name="synchronizer",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._threads.append(sup.spawn(
+                "synchronizer", self._sync_loop,
+                beat_period_s=self.cfg.sync_interval_s))
             # platform sync: interface report on change + optional k8s
             # cluster watch (agent/platform.py — api_watcher analogue)
             from deepflow_tpu.agent.platform import (file_lister,
@@ -1005,17 +1009,15 @@ class Agent:
                     file_lister(self.cfg.k8s_resource_file),
                     interval_s=self.cfg.platform_sync_interval_s)
                 self.k8s_watcher.start()
-        t = threading.Thread(target=self._tick_loop, name="flow-tick",
-                             daemon=True)
-        t.start()
-        self._threads.append(t)
+        self._threads.append(sup.spawn("flow-tick", self._tick_loop))
         if self.cfg.profile_pids:
             from deepflow_tpu.agent import profiler as prof_mod
             if prof_mod.available():
-                tp = threading.Thread(target=self._profile_loop,
-                                      name="oncpu-profiler", daemon=True)
-                tp.start()
-                self._threads.append(tp)
+                # deadman off: a sampling cycle legitimately blocks for
+                # profile_duration_s at a stretch
+                self._threads.append(sup.spawn(
+                    "oncpu-profiler", self._profile_loop,
+                    deadman_s=None))
 
     def close(self) -> None:
         self._stop.set()
@@ -1023,6 +1025,8 @@ class Agent:
                   self.api_watcher):
             if w is not None:
                 w.close()
+        for t in self._threads:
+            t.stop()           # cancel any in-progress restart backoff
         for t in self._threads:
             t.join(timeout=2)
         self.tick(final=True)  # final flush incl. young pseq blocks
@@ -1048,7 +1052,10 @@ class Agent:
         self._sync_wasm_plugins(())
 
     def _sync_loop(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while True:
+            sup.beat()
             # the synchronizer thread must survive any single round's
             # exception (a bad pushed config, an upgrade hook error):
             # a dead sync loop means no config pushes, no escape
@@ -1062,7 +1069,10 @@ class Agent:
                 return
 
     def _tick_loop(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._stop.wait(1.0):
+            sup.beat()
             self.tick()
 
     def _profile_loop(self) -> None:

@@ -2,13 +2,20 @@
 
 Entry points: `df-ctl lint` (deepflow_tpu/cli.py), the `lint` debug
 command (runtime/debug.py), and ci.sh's failing lint step against the
-committed `.lint-baseline.json` + `.lint-twins.json`. See core.py for
-the framework, checkers.py for the per-file rules, concurrency.py for
-the whole-program lock/race rules, and twins.py for the host/device
-twin registry behind the twin-drift gate.
+committed `.lint-baseline.json` + `.lint-twins.json` +
+`.model-conform.json`. See core.py for the framework, checkers.py for
+the per-file rules, concurrency.py for the whole-program lock/race
+rules, twins.py for the host/device twin registry behind the
+twin-drift gate, docdrift.py for the README knob/gauge coverage rule,
+and model/ for deepflow-model — the explicit-state protocol checker
+behind `df-ctl verify` and the model-conform gate (ISSUE 14). Rule
+modules are discovered dynamically (core.all_rules walks the package),
+so a new checker file registers itself.
 """
 
 from deepflow_tpu.analysis.core import (Finding, all_rules,
+                                        default_conform_store_path,
+                                        default_doc_path,
                                         default_twin_store_path,
                                         findings_to_json,
                                         findings_to_sarif,
@@ -18,7 +25,8 @@ from deepflow_tpu.analysis.core import (Finding, all_rules,
                                         scan_package)
 from deepflow_tpu.analysis.twins import host_twin_of
 
-__all__ = ["Finding", "all_rules", "default_twin_store_path",
+__all__ = ["Finding", "all_rules", "default_conform_store_path",
+           "default_doc_path", "default_twin_store_path",
            "findings_to_json", "findings_to_sarif", "format_findings",
            "host_twin_of", "load_baseline", "new_findings", "run_lint",
            "run_on_sources", "save_baseline", "scan_package"]

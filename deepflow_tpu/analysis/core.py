@@ -41,7 +41,8 @@ __all__ = ["Finding", "FileContext", "ProjectIndex", "Checker",
            "register", "all_rules", "run_lint", "run_on_sources",
            "scan_package", "save_baseline", "load_baseline",
            "new_findings", "format_findings", "findings_to_json",
-           "findings_to_sarif"]
+           "findings_to_sarif", "default_twin_store_path",
+           "default_conform_store_path", "default_doc_path"]
 
 
 @dataclass(frozen=True)
@@ -180,6 +181,12 @@ class ProjectIndex:
         # committed twin-fingerprint store (.lint-twins.json contents),
         # or None when the scan was given none (fixture scans)
         self.twin_store: Optional[dict] = None
+        # committed model-conformance store (.model-conform.json), same
+        # contract as twin_store (ISSUE 14: gated exactly alike)
+        self.conform_store: Optional[dict] = None
+        # project documentation text (README.md) for the doc-drift
+        # rule; None = no doc in scope (fixture scans stay silent)
+        self.doc_text: Optional[str] = None
         # scratch memo space for whole-program analyses built lazily on
         # first query (lock graph, twin registry): one build per scan
         # no matter how many files ask — the memoized-ProjectIndex
@@ -468,10 +475,19 @@ def register(cls: type) -> type:
 
 
 def all_rules() -> Dict[str, type]:
-    """rule name -> Checker class (checker modules import-register)."""
-    from deepflow_tpu.analysis import checkers  # noqa: F401  (registers)
-    from deepflow_tpu.analysis import concurrency  # noqa: F401
-    from deepflow_tpu.analysis import twins  # noqa: F401
+    """rule name -> Checker class. Checker modules register on import;
+    discovery walks the WHOLE analysis package (pkgutil), so a new
+    rule module lands in the registry — and therefore in --list-rules
+    and the SARIF rule table — the moment the file exists. No
+    hand-maintained import list to forget (ISSUE 14 satellite;
+    tests/test_model.py diffs the registry against both outputs)."""
+    import importlib
+    import pkgutil
+
+    import deepflow_tpu.analysis as _pkg
+    for info in pkgutil.walk_packages(_pkg.__path__,
+                                      prefix=_pkg.__name__ + "."):
+        importlib.import_module(info.name)
     return dict(_REGISTRY)
 
 
@@ -526,7 +542,9 @@ _PARSE_CACHE: Dict[str, Tuple[str, ast.Module, Dict[int, set]]] = {}
 
 def _check_files(files: Sequence[Tuple[str, str]],
                  rules: Optional[Sequence[str]] = None,
-                 twin_store: Optional[dict] = None) -> List[Finding]:
+                 twin_store: Optional[dict] = None,
+                 conform_store: Optional[dict] = None,
+                 doc_text: Optional[str] = None) -> List[Finding]:
     """Core pass over (relpath, source) pairs: parse, index, check."""
     registry = all_rules()
     if rules:
@@ -537,6 +555,8 @@ def _check_files(files: Sequence[Tuple[str, str]],
         registry = {k: v for k, v in registry.items() if k in rules}
     contexts, index, findings = build_index(files)
     index.twin_store = twin_store
+    index.conform_store = conform_store
+    index.doc_text = doc_text
     for ctx in contexts:
         for cls in registry.values():
             for f in cls().check(ctx, index):
@@ -562,6 +582,14 @@ def default_twin_store_path() -> str:
     return os.path.join(package_parent(), ".lint-twins.json")
 
 
+def default_conform_store_path() -> str:
+    return os.path.join(package_parent(), ".model-conform.json")
+
+
+def default_doc_path() -> str:
+    return os.path.join(package_parent(), "README.md")
+
+
 def _auto_twin_store(twin_store) -> Optional[dict]:
     """"auto" -> the committed .lint-twins.json (None before the first
     --ack-twin ever ran); a dict/None passes through (fixtures)."""
@@ -574,18 +602,47 @@ def _auto_twin_store(twin_store) -> Optional[dict]:
         return None
 
 
+def _auto_conform_store(conform_store) -> Optional[dict]:
+    """"auto" -> the committed .model-conform.json (None before the
+    first --ack-conform); a dict/None passes through (fixtures)."""
+    if conform_store != "auto":
+        return conform_store
+    from deepflow_tpu.analysis.model import conform
+    try:
+        return conform.load_store(default_conform_store_path())
+    except FileNotFoundError:
+        return None
+
+
+def _auto_doc_text(doc_text) -> Optional[str]:
+    """"auto" -> the repo README.md (the doc-drift rule's coverage
+    target); a str/None passes through (fixtures)."""
+    if doc_text != "auto":
+        return doc_text
+    try:
+        with open(default_doc_path(), encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
 def run_lint(paths: Optional[Sequence[str]] = None,
              rules: Optional[Sequence[str]] = None,
-             twin_store="auto") -> List[Finding]:
+             twin_store="auto", conform_store="auto",
+             doc_text="auto") -> List[Finding]:
     """Lint `paths` (files or directories; default: the installed
     deepflow_tpu package). Files under the installed package normalize
     relative to the package PARENT ("deepflow_tpu/runtime/stats.py" —
     the same keys scan_package and the committed baseline use, from any
     cwd); files elsewhere fall back to cwd-relative."""
     if not paths:
-        return scan_package(rules=rules, twin_store=twin_store)
+        return scan_package(rules=rules, twin_store=twin_store,
+                            conform_store=conform_store,
+                            doc_text=doc_text)
     return _check_files(load_path_sources(paths), rules=rules,
-                        twin_store=_auto_twin_store(twin_store))
+                        twin_store=_auto_twin_store(twin_store),
+                        conform_store=_auto_conform_store(conform_store),
+                        doc_text=_auto_doc_text(doc_text))
 
 
 def load_path_sources(paths: Sequence[str]) -> List[Tuple[str, str]]:
@@ -614,22 +671,29 @@ def load_package_sources() -> List[Tuple[str, str]]:
 
 
 def scan_package(rules: Optional[Sequence[str]] = None,
-                 twin_store="auto") -> List[Finding]:
+                 twin_store="auto", conform_store="auto",
+                 doc_text="auto") -> List[Finding]:
     """Self-scan the installed deepflow_tpu tree (CI + the `lint` debug
     command): paths come out relative to the package's parent, matching
     the committed baseline regardless of the caller's cwd."""
     return _check_files(load_package_sources(), rules=rules,
-                        twin_store=_auto_twin_store(twin_store))
+                        twin_store=_auto_twin_store(twin_store),
+                        conform_store=_auto_conform_store(conform_store),
+                        doc_text=_auto_doc_text(doc_text))
 
 
 def run_on_sources(sources: Dict[str, str],
                    rules: Optional[Sequence[str]] = None,
-                   twin_store: Optional[dict] = None) -> List[Finding]:
+                   twin_store: Optional[dict] = None,
+                   conform_store: Optional[dict] = None,
+                   doc_text: Optional[str] = None) -> List[Finding]:
     """Lint in-memory {path: source} — the test-fixture surface.
-    `twin_store` defaults to None (NOT the committed store): fixture
-    scans must never be judged against the real repo's fingerprints."""
+    `twin_store`/`conform_store`/`doc_text` default to None (NOT the
+    committed stores or the real README): fixture scans must never be
+    judged against the real repo's contracts."""
     return _check_files(sorted(sources.items()), rules=rules,
-                        twin_store=twin_store)
+                        twin_store=twin_store,
+                        conform_store=conform_store, doc_text=doc_text)
 
 
 # -- baseline --------------------------------------------------------------

@@ -1,0 +1,45 @@
+"""deepflow-model: exhaustive explicit-state checking of the repo's
+three hardest protocols (ISSUE 14).
+
+The pod epoch protocol (parallel/pod.py), the spill/drain durability
+ladder (runtime/spill.py) and the sender retransmit ring / receiver
+dedup pair (agent/sender.py + runtime/receiver.py) each promise an
+invariant in prose — conservation ledgers exact in every state, at most
+one unsynced segment lost to a SIGKILL, exactly-once delivery into
+`_dispatch`. The chaos tests exercise the interleavings their seeds
+happen to drive; this package proves the invariants over ALL
+interleavings of a small, faithful abstraction:
+
+- `spec.py` — the modeling vocabulary: guarded atomic actions over
+  dict states, a fault alphabet named by the REAL `runtime/faults.py`
+  site strings, invariants that return messages instead of booleans.
+- `explore.py` — the BFS explorer: invariant checking in every reached
+  state, deadlock detection, goal-reachability livelock detection
+  (weak fairness), counterexample traces rendered as readable
+  schedules, state hashing + symmetry reduction over shard ids.
+- `pod_epoch.py` / `spill_drain.py` / `sender_ring.py` — the three
+  committed models, each with seeded mutants the checker must kill.
+- `mutate.py` — the self-test harness: flip one model transition at a
+  time and assert every mutant dies with a counterexample.
+- `conform.py` — the conformance layer: the models' ledger alphabets
+  (counter names, fault sites, twin'd transition qualnames) are
+  extracted from the CODE through the lint ProjectIndex and gated on
+  the committed `.model-conform.json`, exactly like `.lint-twins.json`
+  — so the proof cannot rot silently when pod.py gains a counter.
+
+Entry points: `df-ctl verify` (deepflow_tpu/cli.py) and the ci.sh
+`verify` gate; the `model-conform` rule rides the normal lint gate.
+"""
+
+from deepflow_tpu.analysis.model.spec import (Action, Model,
+                                              freeze_state)
+from deepflow_tpu.analysis.model.explore import (CheckResult, Violation,
+                                                 check, render_trace)
+from deepflow_tpu.analysis.model.mutate import (all_mutants, kill_all,
+                                                model_for)
+
+__all__ = ["Action", "Model", "freeze_state", "CheckResult",
+           "Violation", "check", "render_trace", "all_mutants",
+           "kill_all", "model_for"]
+
+PROTOCOLS = ("pod", "spill", "sender")

@@ -625,6 +625,152 @@ def cmd_lint(args) -> int:
     return 1 if gated else 0
 
 
+def cmd_verify(args) -> int:
+    """deepflow-model (deepflow_tpu/analysis/model/): exhaustive
+    explicit-state checking of the pod epoch, spill/drain and sender
+    retransmit protocols. The zero-flag form sweeps all three models
+    plus the conformance gate; --mutants runs the seeded kill sweep
+    (every mutant must die with a counterexample); --mutant NAME runs
+    one mutant and prints its counterexample schedule; --ack-conform
+    rewrites the committed .model-conform.json from the current tree
+    (run AFTER a green `df-ctl verify` — the ack is the informed
+    signature tying the models to the code).
+
+    Exit codes: 0 = proven; 1 = violation / surviving mutant /
+    conformance drift; 2 = budget exhausted (INCOMPLETE — a partial
+    sweep is not a proof) or usage error."""
+    import time as _time
+
+    from deepflow_tpu import analysis
+    from deepflow_tpu.analysis import core as _ana_core
+    from deepflow_tpu.analysis.model import (PROTOCOLS, check, model_for,
+                                             render_trace)
+    from deepflow_tpu.analysis.model import conform as _conform
+    from deepflow_tpu.analysis.model.mutate import all_mutants, kill_all
+
+    if args.list_mutants:
+        for proto, name, why in all_mutants():
+            print(f"{proto}/{name}: {why}")
+        return 0
+
+    if args.ack_conform:
+        files = _ana_core.load_package_sources()
+        _ctxs, index, errors = _ana_core.build_index(files)
+        if errors:
+            print(analysis.format_findings(errors), file=sys.stderr)
+            return 2
+        store, missing = _conform.build_store(index)
+        if missing:
+            print("--ack-conform refuses unresolvable model refs "
+                  "(fix the CONFORMANCE contracts first):",
+                  file=sys.stderr)
+            for m in missing:
+                print(f"  {m}", file=sys.stderr)
+            return 2
+        path = args.conform or _ana_core.default_conform_store_path()
+        _conform.save_store(store, path)
+        print(f"conformance store updated: "
+              f"{len(store['protocols'])} protocol(s) acknowledged "
+              f"-> {path}")
+        return 0
+
+    deadline = None
+    if args.budget_s is not None:
+        deadline = _time.monotonic() + args.budget_s
+
+    def remaining():
+        if deadline is None:
+            return None
+        return max(0.0, deadline - _time.monotonic())
+
+    texts = []
+    rc = 0
+
+    def emit(text: str) -> None:
+        texts.append(text)
+        if not args.json:
+            print(text)
+
+    if args.mutant:
+        protos = [args.protocol] if args.protocol else \
+            sorted({p for p, n, _w in all_mutants() if n == args.mutant})
+        if len(protos) != 1:
+            print(f"--mutant {args.mutant}: unknown mutant (see "
+                  f"--list-mutants), or ambiguous without --protocol",
+                  file=sys.stderr)
+            return 2
+        try:
+            model = model_for(protos[0], args.mutant)
+        except ValueError as e:
+            # a typo'd protocol/mutant pair is a USAGE error (2) —
+            # exit 1 is reserved for "the checker found the bug", and
+            # ci.sh's demo asserts exactly that
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        res = check(model, max_faults=args.max_faults,
+                    budget_s=remaining())
+        emit(render_trace(res))
+        results = [res]
+        # for a mutant run, "found the bug" IS the expected outcome:
+        # exit 1 (a violation was found), so ci.sh can assert the
+        # checker kills a live injected bug
+        rc = 2 if not res.complete and res.violation is None \
+            else (1 if res.violation is not None else 0)
+    elif args.mutants:
+        report = kill_all(protocol=args.protocol,
+                          max_faults=args.max_faults,
+                          budget_s=args.budget_s)
+        results = []
+        for (proto, name), res in sorted(report.results.items()):
+            results.append(res)
+            v = res.violation
+            verdict = "KILLED" if v is not None else (
+                "INCOMPLETE" if not res.complete else "SURVIVED")
+            detail = f" ({v.kind}/{v.name}, {len(v.trace)}-step trace)" \
+                if v is not None else ""
+            emit(f"mutant {proto}/{name}: {verdict}{detail}  "
+                 f"[{res.states} states, {res.elapsed_s:.2f}s]")
+        if report.survivors:
+            emit(f"SURVIVING mutant(s): the checker has a blind spot: "
+                 f"{report.survivors}")
+            rc = 1
+        elif report.incomplete:
+            emit(f"INCOMPLETE mutant sweep(s) within the budget: "
+                 f"{report.incomplete}")
+            rc = 2
+        else:
+            emit(f"mutation self-test: all "
+                 f"{len(report.results)} seeded mutants killed")
+    else:
+        protos = [args.protocol] if args.protocol else list(PROTOCOLS)
+        results = []
+        for proto in protos:
+            res = check(model_for(proto), max_faults=args.max_faults,
+                        budget_s=remaining())
+            results.append(res)
+            emit(render_trace(res))
+            if not res.complete and res.violation is None:
+                rc = max(rc, 2)
+            elif res.violation is not None:
+                rc = max(rc, 1)
+        if args.protocol is None and rc == 0:
+            # whole-sweep runs also gate model<->code conformance (the
+            # same check the lint rule rides in CI)
+            findings = analysis.run_lint(rules=["model-conform"])
+            if findings:
+                emit(analysis.format_findings(findings))
+                rc = 1
+            else:
+                emit("conformance: models and code agree "
+                     "(.model-conform.json acknowledged)")
+    if args.trace_out:
+        with open(args.trace_out, "w", encoding="utf-8") as fh:
+            fh.write("\n\n".join(texts) + "\n")
+    if args.json:
+        print(json.dumps([r.to_dict() for r in results], indent=1))
+    return rc
+
+
 def cmd_promql(args) -> int:
     if (args.start is None) != (args.end is None):
         print("error: --start and --end must be given together",
@@ -828,6 +974,44 @@ def build_parser() -> argparse.ArgumentParser:
     ln.add_argument("--list-rules", action="store_true",
                     help="list rules with their one-line descriptions")
     ln.set_defaults(fn=cmd_lint)
+
+    vf = sub.add_parser(
+        "verify", help="deepflow-model: exhaustive explicit-state "
+                       "checking of the pod epoch / spill / sender "
+                       "protocols (+ the code-conformance gate)")
+    vf.add_argument("--protocol", choices=["pod", "spill", "sender"],
+                    help="check one protocol (default: all three + "
+                         "the conformance gate)")
+    vf.add_argument("--budget-s", type=float, default=None,
+                    help="total wall-clock budget; an unfinished sweep "
+                         "exits 2 (INCOMPLETE), never a silent pass")
+    vf.add_argument("--max-faults", type=int, default=2,
+                    help="fault-injection budget per execution "
+                         "(default 2 — the CI acceptance bound)")
+    vf.add_argument("--trace-out", metavar="FILE",
+                    help="write the verdicts + any counterexample "
+                         "schedule to FILE (ci.sh uploads it beside "
+                         "artifacts/lint.sarif)")
+    vf.add_argument("--mutants", action="store_true",
+                    help="mutation self-test: every seeded mutant must "
+                         "die with a counterexample")
+    vf.add_argument("--mutant", metavar="NAME",
+                    help="run ONE seeded mutant and print its "
+                         "counterexample (exit 1 = killed, the "
+                         "expected outcome)")
+    vf.add_argument("--list-mutants", action="store_true",
+                    help="list the seeded mutants per protocol")
+    vf.add_argument("--ack-conform", action="store_true",
+                    help="re-acknowledge the model<->code conformance "
+                         "fingerprints (.model-conform.json); run "
+                         "after a green `df-ctl verify`")
+    vf.add_argument("--conform", metavar="FILE",
+                    help="conformance store path (default: the "
+                         "committed .model-conform.json next to the "
+                         "package)")
+    vf.add_argument("--json", action="store_true",
+                    help="machine-readable results on stdout")
+    vf.set_defaults(fn=cmd_verify)
 
     rp = sub.add_parser("replay-pcap",
                         help="replay a pcap through an agent -> ingester")

@@ -382,15 +382,22 @@ class CloudTask:
             # reports unhealthy; the loop still runs and retries
             self.info.auth_failed = True
             self.info.last_error = f"{type(e).__name__}: {e}"
-        self._thread = threading.Thread(
-            target=self._loop, name=f"cloud-{self.domain}", daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): a raising platform
+        # poller is crash-captured and restarted instead of silently
+        # freezing the domain's resource model
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            f"cloud-{self.domain}", self._loop,
+            beat_period_s=self.interval_s)
 
     def _loop(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         self.gather_once()
         while not self._stop.is_set():
             self._wake.wait(self.interval_s)   # trigger() shortcuts the wait
             self._wake.clear()
+            sup.beat()
             if self._stop.is_set():
                 break
             self.gather_once()
@@ -399,6 +406,7 @@ class CloudTask:
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
 
 

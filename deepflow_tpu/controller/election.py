@@ -188,12 +188,17 @@ class Election:
 
     def start(self) -> None:
         self.try_acquire()
-        self._thread = threading.Thread(target=self._run, name="election",
-                                        daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): a dead election
+        # loop is unbounded dual leadership — crash capture + restart
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "election", self._run, beat_period_s=self.renew_seconds)
 
     def _run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._stop.wait(self.renew_seconds):
+            sup.beat()
             try:
                 self.try_acquire()
             except Exception:
@@ -206,6 +211,7 @@ class Election:
     def close(self, release: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
         if release and self._leader:
             # release only OUR lease: we may have lost it since the

@@ -114,17 +114,22 @@ class GenesisSync:
     def start(self) -> None:
         if not self.peers:
             return
-        self._thread = threading.Thread(target=self._loop,
-                                        name="genesis-sync", daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down)
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "genesis-sync", self._loop, beat_period_s=self.interval_s)
 
     def _loop(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._stop.wait(self.interval_s):
+            sup.beat()
             self.pull_once()
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
 
     def counters(self) -> dict:

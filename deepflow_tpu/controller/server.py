@@ -607,15 +607,22 @@ class ControllerServer:
         return self._httpd.server_address[1]
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="controller-http", daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): crash capture for
+        # the accept loop. deadman off — serve_forever cannot beat
+        # without the querier's service_actions subclass
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "controller-http",
+            lambda: self._httpd.serve_forever(poll_interval=0.5),
+            deadman_s=None)
         self.genesis_sync.start()
         self.cloud.start()
 
     def close(self) -> None:
         self.cloud.close()
         self.genesis_sync.close()
+        if self._thread is not None:
+            self._thread.stop()     # no restart on the way down
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -77,14 +77,17 @@ class DropletPipeline:
     def start(self) -> None:
         if self.writer is not None:
             self.writer.start()
-        self._thread = threading.Thread(target=self._run, name="droplet",
-                                        daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): crash capture,
+        # backoff restart and deadman beats for the decode worker
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "droplet", self._run)
 
     def close(self) -> None:
         self.queues.close()
         self._halt.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
         if self.writer is not None:
             self.writer.close()
@@ -97,7 +100,10 @@ class DropletPipeline:
             self.writer.flush()
 
     def _run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._halt.is_set():
+            sup.beat()
             frames: List[Frame] = self.queues.gets(0, 64, timeout=0.2)
             if not frames:
                 if self.queues.queues[0].closed:

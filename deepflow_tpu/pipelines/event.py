@@ -101,14 +101,17 @@ class EventPipeline:
         for w in (self.perf_writer, self.alarm_writer, self.resource_writer):
             if w is not None:
                 w.start()
-        self._thread = threading.Thread(target=self._run, name="event",
-                                        daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): crash capture,
+        # backoff restart and deadman beats for the decode worker
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "event", self._run)
 
     def close(self) -> None:
         self.queues.close()
         self._halt.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
         for w in (self.perf_writer, self.alarm_writer, self.resource_writer):
             if w is not None:
@@ -138,7 +141,10 @@ class EventPipeline:
 
     # -- wire decode -------------------------------------------------------
     def _run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._halt.is_set():
+            sup.beat()
             frames = self.queues.gets(0, 64, timeout=0.2)
             if not frames:
                 if self.queues.queues[0].closed:

@@ -68,14 +68,17 @@ class ProfilePipeline:
     def start(self) -> None:
         if self.writer is not None:
             self.writer.start()
-        self._thread = threading.Thread(target=self._run, name="profile",
-                                        daemon=True)
-        self._thread.start()
+        # supervised (ISSUE 14 baseline burn-down): crash capture,
+        # backoff restart and deadman beats for the decode worker
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "profile", self._run)
 
     def close(self) -> None:
         self.queues.close()
         self._halt.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
         if self.writer is not None:
             self.writer.close()
@@ -85,7 +88,10 @@ class ProfilePipeline:
             self.writer.flush()
 
     def _run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._halt.is_set():
+            sup.beat()
             frames = self.queues.gets(0, 64, timeout=0.2)
             if not frames:
                 if self.queues.queues[0].closed:

@@ -67,7 +67,7 @@ __all__ = ["FaultSite", "FaultRegistry", "default_faults",
            "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN",
            "FAULT_SPILL_WRITE", "FAULT_SENDER_DISCONNECT",
            "FAULT_SHARD_DEVICE_ERROR", "FAULT_MERGE_STALL",
-           "FAULT_SHARD_LOST"]
+           "FAULT_SHARD_LOST", "ALL_FAULT_SITES"]
 
 FAULT_RECEIVER_TRUNCATE = "receiver.truncate"
 FAULT_QUEUE_STALL = "queue.stall"
@@ -80,6 +80,18 @@ FAULT_SENDER_DISCONNECT = "sender.disconnect"
 FAULT_SHARD_DEVICE_ERROR = "shard.device_error"
 FAULT_MERGE_STALL = "merge.stall"
 FAULT_SHARD_LOST = "shard.lost"
+
+# every registered site string in one machine-readable tuple, derived
+# (never hand-listed) from the FAULT_* constants above. Two consumers
+# keep it honest: the deepflow-model protocol models (ISSUE 14) import
+# the constants for their fault alphabets and the conformance gate
+# (analysis/model/conform.py) diffs those alphabets against the
+# lexical FAULT_* definitions — a shard-scoped site added here without
+# a model transition fails `df-ctl lint` (model-conform), the same way
+# fault-site-drift fails a site with no injection point.
+ALL_FAULT_SITES = tuple(sorted(
+    v for k, v in list(globals().items())
+    if k.startswith("FAULT_") and isinstance(v, str)))
 
 
 class InjectedFault(RuntimeError):

@@ -37,6 +37,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 CI deselects these (`-m 'not slow'`); the deeper sweeps
+    # (e.g. the SENDS=3 pod model run) still run on demand
+    config.addinivalue_line(
+        "markers", "slow: deeper sweeps excluded from the tier-1 run")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0xDF170)

@@ -1,0 +1,403 @@
+"""deepflow-model (deepflow_tpu/analysis/model/): the explicit-state
+checker behind `df-ctl verify` (ISSUE 14).
+
+Covers: per-protocol exhaustive invariant sweeps, the mutation
+self-test (every seeded mutant must die with a counterexample),
+counterexample-schedule readability (fault steps carry the REAL
+runtime/faults.py site strings), the conformance trip/ack round-trip
+on fixtures, CLI exit codes + `--budget-s` enforcement, the
+symmetry-reduction state-count bound, and the dynamic rule registry
+(`--list-rules` and the SARIF rule table must both equal the
+registry — no hand-maintained list)."""
+
+import json
+import re
+
+import pytest
+
+from deepflow_tpu import analysis
+from deepflow_tpu.analysis import core as ana_core
+from deepflow_tpu.analysis.model import (check, explore, model_for,
+                                         render_trace)
+from deepflow_tpu.analysis.model import conform
+from deepflow_tpu.analysis.model import pod_epoch
+from deepflow_tpu.analysis.model.mutate import all_mutants, kill_all
+from deepflow_tpu.cli import main as cli_main
+
+
+# ------------------------------------------------ clean protocol sweeps
+
+@pytest.mark.parametrize("protocol", ["pod", "spill", "sender"])
+def test_protocol_invariants_hold_exhaustively(protocol):
+    res = check(model_for(protocol), max_faults=2)
+    assert res.ok and res.complete, render_trace(res)
+    assert res.states > 100          # an exhaustive sweep, not a stub
+    assert res.violation is None
+
+
+@pytest.mark.slow
+def test_pod_clean_at_three_rows():
+    # the CI default keeps SENDS=2 for wall-clock; the deeper row
+    # budget must hold too (more rows = more ledger arithmetic, same
+    # behaviors — this proves that claim instead of asserting it)
+    old = pod_epoch.SENDS
+    pod_epoch.SENDS = 3
+    try:
+        res = explore.check(pod_epoch.build(), max_faults=2)
+    finally:
+        pod_epoch.SENDS = old
+    assert res.ok and res.complete, render_trace(res)
+
+
+# ---------------------------------------------------- mutation harness
+
+def test_every_seeded_mutant_is_killed():
+    report = kill_all(max_faults=2)
+    assert len(report.results) == len(all_mutants()) >= 10
+    assert not report.incomplete, report.incomplete
+    assert not report.survivors, \
+        f"checker blind spot — surviving mutants: {report.survivors}"
+    for (proto, name), res in report.results.items():
+        v = res.violation
+        assert v is not None and v.trace, (proto, name)
+
+
+def test_mutant_verdict_matches_advertised_breakage():
+    # the MUTANTS tables promise WHAT each flip breaks; hold them to it
+    expect = {
+        ("pod", "double-merge-late"): ("invariant", "conservation"),
+        ("pod", "stalled-post-dropped"): ("livelock", "goal-unreachable"),
+        ("spill", "drop-fsync-on-roll"): ("invariant", "kill-bound"),
+        ("sender", "skip-dedup-seq-check"): ("invariant", "exactly-once"),
+        ("sender", "evict-unsent-silently"): ("livelock",
+                                             "goal-unreachable"),
+    }
+    for (proto, name), (kind, iname) in expect.items():
+        res = check(model_for(proto, name), max_faults=2)
+        v = res.violation
+        assert v is not None, (proto, name)
+        assert (v.kind, v.name) == (kind, iname), (proto, name, v.kind,
+                                                   v.name, v.message)
+
+
+# ------------------------------------------------- trace readability
+
+def test_counterexample_schedule_names_real_fault_sites():
+    res = check(model_for("pod", "kill-uncounted"), max_faults=2)
+    text = render_trace(res)
+    # the schedule must read like a chaos spec: the kill step carries
+    # the real runtime/faults.py site string
+    assert "!! fault shard.lost" in text
+    assert "schedule (shortest):" in text
+    assert "state at violation:" in text
+    # steps are numbered and name the owning process
+    assert re.search(r"^\s+\d+\. ", text, re.M)
+
+
+def test_clean_result_renders_ok_summary():
+    res = check(model_for("sender"), max_faults=1)
+    text = render_trace(res)
+    assert "result: OK" in text and "sender-ring" in text
+
+
+# ------------------------------------------------ budget + symmetry
+
+def test_budget_returns_incomplete_not_a_lie():
+    res = check(model_for("pod"), max_faults=2, budget_s=0.001)
+    assert not res.complete
+    assert res.violation is None     # no verdict, not a false pass
+
+
+def test_symmetry_reduction_bounds_the_state_count():
+    old_sends, old_qcap = pod_epoch.SENDS, pod_epoch.QCAP
+    pod_epoch.SENDS, pod_epoch.QCAP = 1, 1
+    try:
+        sym = explore.check(pod_epoch.build(), max_faults=1,
+                            symmetry=True)
+        raw = explore.check(pod_epoch.build(), max_faults=1,
+                            symmetry=False)
+    finally:
+        pod_epoch.SENDS, pod_epoch.QCAP = old_sends, old_qcap
+    assert sym.ok and raw.ok and sym.complete and raw.complete
+    # shard ids are a 3-element symmetry group: the canonical sweep
+    # must be strictly smaller, and comfortably under the raw count
+    assert sym.states < raw.states
+    assert sym.states * 2 < raw.states * 3   # > 1.5x reduction
+
+
+def test_ci_configuration_fits_the_budget():
+    # the acceptance bound: N=3 shards, <= 2 faults, exhaustive, and
+    # small enough that ci.sh's 60s verify budget holds with margin
+    res = check(model_for("pod"), max_faults=2)
+    assert res.complete and res.states < 120_000, res.states
+
+
+# ------------------------------------------------------- CLI contract
+
+def test_cli_verify_exit_codes(tmp_path):
+    # clean protocol -> 0
+    assert cli_main(["verify", "--protocol", "spill"]) == 0
+    # a mutant run FINDS the injected bug -> 1, with the trace artifact
+    out = tmp_path / "trace.txt"
+    rc = cli_main(["verify", "--protocol", "pod", "--mutant",
+                   "double-merge-late", "--trace-out", str(out)])
+    assert rc == 1
+    text = out.read_text()
+    assert "conservation" in text and "schedule (shortest):" in text
+    # an unknown mutant is a usage error -> 2, and so is a mutant
+    # named with the WRONG protocol (exit 1 must stay reserved for
+    # "the checker found the bug")
+    assert cli_main(["verify", "--mutant", "no-such-flip"]) == 2
+    assert cli_main(["verify", "--protocol", "pod", "--mutant",
+                     "drop-fsync-on-roll"]) == 2
+
+
+def test_cli_verify_budget_enforcement(capsys):
+    rc = cli_main(["verify", "--protocol", "pod", "--budget-s", "0.001"])
+    assert rc == 2
+    assert "NO — budget" in capsys.readouterr().out
+
+
+def test_cli_verify_list_mutants(capsys):
+    assert cli_main(["verify", "--list-mutants"]) == 0
+    out = capsys.readouterr().out
+    for needle in ("pod/double-merge-late", "spill/drop-fsync-on-roll",
+                   "sender/skip-dedup-seq-check"):
+        assert needle in out
+
+
+def test_cli_verify_json(capsys):
+    assert cli_main(["verify", "--protocol", "sender", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc and doc[0]["model"] == "sender-ring" and doc[0]["ok"]
+
+
+# ------------------------------------------------ conformance fixtures
+
+_FIX_CODE = """\
+class PodFlowSuite:
+    def put_lanes(self, plane, n):
+        return n
+    def counters(self):
+        c = {"pod_rows_sent": 1, "pod_rows_lost": 2}
+        c["pod_rows_pending"] = 3
+        return c
+"""
+
+_FIX_FAULTS = """\
+FAULT_SHARD_DEVICE_ERROR = "shard.device_error"
+FAULT_MERGE_STALL = "merge.stall"
+"""
+
+_FIX_MODEL = """\
+CONFORMANCE = {
+    "protocol": "pod",
+    "ledgers": [
+        {"src": "pkg/parallel/pod.py:PodFlowSuite.counters",
+         "counters": ["pod_rows_sent", "pod_rows_lost",
+                      "pod_rows_pending"]},
+    ],
+    "fault_sites": ["shard.device_error", "merge.stall"],
+    "site_prefixes": ["shard.", "merge."],
+    "twins": {"send": "pkg/parallel/pod.py:PodFlowSuite.put_lanes"},
+}
+"""
+
+
+def _fixture_sources(code=_FIX_CODE, faults=_FIX_FAULTS,
+                     model=_FIX_MODEL):
+    return {"pkg/parallel/pod.py": code,
+            "pkg/runtime/faults.py": faults,
+            "pkg/analysis/model/mini.py": model}
+
+
+def _store_for(sources):
+    _ctxs, index, errors = ana_core.build_index(sorted(sources.items()))
+    assert not errors
+    store, missing = conform.build_store(index)
+    assert not missing, missing
+    return store
+
+
+def test_conformance_unacked_then_acked_roundtrip():
+    sources = _fixture_sources()
+    # no committed store: the declared protocol reads as unacknowledged
+    fs = analysis.run_on_sources(sources, rules=["model-conform"])
+    assert [f.rule for f in fs] == ["model-conform"]
+    assert "no committed conformance fingerprint" in fs[0].message
+    # ack: build the store from the same tree -> clean
+    store = _store_for(sources)
+    assert analysis.run_on_sources(sources, rules=["model-conform"],
+                                   conform_store=store) == []
+
+
+def test_conformance_trips_on_counter_drift():
+    sources = _fixture_sources()
+    store = _store_for(sources)
+    # the code ledger loses a counter the model still models
+    drifted = dict(sources)
+    drifted["pkg/parallel/pod.py"] = _FIX_CODE.replace(
+        '"pod_rows_lost": 2', '"pod_rows_dropped": 2')
+    msgs = [f.message for f in analysis.run_on_sources(
+        drifted, rules=["model-conform"], conform_store=store)]
+    assert any("modeled counter 'pod_rows_lost'" in m for m in msgs)
+    assert any("changed since the last ack" in m for m in msgs)
+
+
+def test_conformance_trips_on_twin_transition_edit():
+    sources = _fixture_sources()
+    store = _store_for(sources)
+    drifted = dict(sources)
+    drifted["pkg/parallel/pod.py"] = _FIX_CODE.replace(
+        "return n", "return n + 1")
+    msgs = [f.message for f in analysis.run_on_sources(
+        drifted, rules=["model-conform"], conform_store=store)]
+    assert any("modeled as 'send'" in m and "changed since" in m
+               for m in msgs)
+    # re-ack against the edited tree -> clean again (the round-trip)
+    store2 = _store_for(drifted)
+    assert analysis.run_on_sources(drifted, rules=["model-conform"],
+                                   conform_store=store2) == []
+
+
+def test_conformance_superset_gate_on_new_fault_site():
+    sources = _fixture_sources(
+        faults=_FIX_FAULTS + 'FAULT_SHARD_LOST = "shard.lost"\n')
+    store = _store_for(sources)
+    msgs = [f.message for f in analysis.run_on_sources(
+        sources, rules=["model-conform"], conform_store=store)]
+    # faults.py grew a shard site the model's alphabet never explores
+    assert any("'shard.lost'" in m and "fault alphabet" in m
+               for m in msgs)
+
+
+def test_conformance_trips_on_renamed_transition():
+    sources = _fixture_sources()
+    store = _store_for(sources)
+    drifted = dict(sources)
+    drifted["pkg/parallel/pod.py"] = _FIX_CODE.replace(
+        "def put_lanes", "def put_planes")
+    msgs = [f.message for f in analysis.run_on_sources(
+        drifted, rules=["model-conform"], conform_store=store)]
+    assert any("twin'd transition 'send'" in m and "does not resolve"
+               in m for m in msgs)
+
+
+def test_conformance_trips_on_contract_narrowing():
+    # deleting an acked twin, ledger or modeled counter from the
+    # CONTRACT (not the code) must trip too: narrowing un-arms part of
+    # the proof as surely as code drift does
+    sources = _fixture_sources()
+    store = _store_for(sources)
+    narrowed = dict(sources)
+    narrowed["pkg/analysis/model/mini.py"] = _FIX_MODEL.replace(
+        '"twins": {"send": "pkg/parallel/pod.py:PodFlowSuite.put_lanes"},',
+        '"twins": {},').replace('"pod_rows_lost",\n', "")
+    msgs = [f.message for f in analysis.run_on_sources(
+        narrowed, rules=["model-conform"], conform_store=store)]
+    assert any("twin'd transition 'send' is no longer declared" in m
+               for m in msgs)
+    assert any("pod_rows_lost" in m and "narrowed" in m for m in msgs)
+    # re-ack against the narrowed contract -> clean (deliberate drop)
+    store2 = _store_for(narrowed)
+    assert analysis.run_on_sources(narrowed, rules=["model-conform"],
+                                   conform_store=store2) == []
+
+
+def test_conformance_silent_on_partial_scans():
+    # the model declaration alone (no code in scope) must not cry
+    sources = {"pkg/analysis/model/mini.py": _FIX_MODEL}
+    assert analysis.run_on_sources(sources, rules=["model-conform"]) == []
+
+
+def test_model_fault_alphabets_are_registered_sites():
+    # runtime agreement beside the lexical gate: every faults.py site
+    # a model injects exists in the live registry, and every
+    # shard-scoped site the registry knows is modeled (the superset
+    # contract ROADMAP item 1's DCN variant will lean on)
+    from deepflow_tpu.runtime.faults import ALL_FAULT_SITES
+    from deepflow_tpu.analysis.model import (pod_epoch, sender_ring,
+                                             spill_drain)
+    for mod in (pod_epoch, spill_drain, sender_ring):
+        declared = set(mod.CONFORMANCE["fault_sites"])
+        assert declared <= set(ALL_FAULT_SITES), mod.__name__
+    shard_sites = {s for s in ALL_FAULT_SITES
+                   if s.startswith(("shard.", "merge."))}
+    assert shard_sites <= set(pod_epoch.CONFORMANCE["fault_sites"])
+
+
+def test_real_tree_conformance_is_acknowledged():
+    # the committed .model-conform.json matches the shipped tree: the
+    # self-scan stays clean (the same gate ci.sh lint enforces)
+    assert analysis.scan_package(rules=["model-conform"]) == []
+
+
+# ------------------------------------------- dynamic rule registry
+
+def test_list_rules_and_sarif_match_registry(capsys):
+    registry = set(analysis.all_rules())
+    # the new rules are registered purely by existing on disk
+    for need in ("model-conform", "doc-drift"):
+        assert need in registry
+    assert cli_main(["lint", "--list-rules"]) == 0
+    listed = {line.split(" ", 1)[0]
+              for line in capsys.readouterr().out.splitlines() if line}
+    assert listed == registry
+    sarif_rules = {r["id"] for r in analysis.findings_to_sarif([])
+                   ["runs"][0]["tool"]["driver"]["rules"]}
+    # SARIF additionally documents the synthetic parse-error rule
+    assert sarif_rules == registry | {"parse-error"}
+
+
+# ------------------------------------------------------- doc-drift
+
+_FIX_INGESTER = """\
+from dataclasses import dataclass
+@dataclass
+class IngesterConfig:
+    listen_port: int = 30033
+    shiny_new_knob: int = 7
+"""
+
+_FIX_TRACING = """\
+GAUGE_HELP = {
+    "tpu_h2d_mb_s": "documented",
+    "tpu_mystery_gauge": "undocumented",
+}
+"""
+
+_FIX_DOC = ("| `listen_port` | the port |\n"
+            "| `tpu_h2d_mb_s` | transfer rate |\n")
+
+
+def test_doc_drift_flags_undocumented_knob_and_gauge():
+    fs = analysis.run_on_sources(
+        {"pkg/pipelines/ingester.py": _FIX_INGESTER,
+         "pkg/runtime/tracing.py": _FIX_TRACING},
+        rules=["doc-drift"], doc_text=_FIX_DOC)
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 2
+    assert "IngesterConfig.shiny_new_knob" in msgs[0]
+    assert "tpu_mystery_gauge" in msgs[1]
+
+
+def test_doc_drift_silent_without_doc_and_with_pragma():
+    sources = {"pkg/pipelines/ingester.py": _FIX_INGESTER,
+               "pkg/runtime/tracing.py": _FIX_TRACING}
+    # no doc in scope (fixture scans): silent
+    assert analysis.run_on_sources(sources, rules=["doc-drift"]) == []
+    # pragma-able like every other rule
+    pragmaed = dict(sources)
+    pragmaed["pkg/pipelines/ingester.py"] = _FIX_INGESTER.replace(
+        "shiny_new_knob: int = 7",
+        "shiny_new_knob: int = 7  # lint: disable=doc-drift")
+    fs = analysis.run_on_sources(pragmaed, rules=["doc-drift"],
+                                 doc_text=_FIX_DOC)
+    # the pragma silences the knob; the undocumented gauge still trips
+    assert all("shiny_new_knob" not in f.message for f in fs)
+    assert ["tpu_mystery_gauge" in f.message for f in fs] == [True]
+
+
+def test_doc_drift_clean_on_real_tree():
+    # every IngesterConfig knob and GAUGE_HELP gauge has its README row
+    assert analysis.scan_package(rules=["doc-drift"]) == []
