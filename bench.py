@@ -29,6 +29,12 @@ Numbers, one JSON line:
   dispatches per batch (<= 1 each on the coalesced path; a regression
   back to per-plane device_puts reads > 1 here and on the
   tpu_transfers_per_batch gauge).
+- `stage_breakdown.anomaly`: the ISSUE 15 detection lane measured
+  against a detectors-off twin over the same ddos_ramp windows:
+  settled window-close latency both ways, the overhead fraction
+  (acceptance: < 5% at the default config), detection latency in
+  windows from ramp onset, and the rows_seen == rows_in conservation
+  verdict.
 - `topk_recall_vs_exact`: top-100 heavy-hitter recall on the PRODUCTION
   FlowSuiteConfig against an exact host GROUP BY over the stream.
   vs_baseline is against BASELINE.json's 10M records/s.
@@ -1084,7 +1090,71 @@ def main() -> None:
     }
     _recover()
 
+    # -- timed: anomaly plane (ISSUE 15) -----------------------------------
+    # The detection lane beside the sketch lane: the same ddos_ramp
+    # windows flushed twice — detectors off (the reference) and on —
+    # so the artifact shows the per-window-close cost of the anomaly
+    # window step + active-flow feeds directly, plus whether the ramp
+    # was detected and at what latency. Acceptance: the lane adds < 5%
+    # to window-close latency at the default config.
+    _phase("timed: anomaly plane", budget=600.0)
+    from deepflow_tpu.anomaly import AnomalyConfig
+    from deepflow_tpu.replay.generator import ddos_ramp
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+
+    anomaly_rows = min(batch, 1 << 14)
+
+    def _anomaly_run(enabled: bool):
+        ramp = ddos_ramp(seed=7, rows_per_window=anomaly_rows)
+        exp = TpuSketchExporter(
+            cfg=cfg, store=None, window_seconds=3600,
+            batch_rows=anomaly_rows, wire="lanes",
+            anomaly=AnomalyConfig() if enabled else None)
+        flush_s = []
+        first_alert = None
+        try:
+            for w, _name, cols in ramp.windows():
+                exp.process([("l4_flow_log", 0, cols, -1)])
+                t0 = time.perf_counter()
+                out = exp.flush_window(now=1000.0 + w)
+                # settle the window in BOTH runs: the detectors-off
+                # flush is fully async (its cost would otherwise defer
+                # into the next batch) while the anomaly close
+                # materializes scores — the honest comparison blocks
+                # on the window output either way
+                jax.block_until_ready(
+                    (exp.state, out if out is not None else ()))
+                flush_s.append(time.perf_counter() - t0)
+                if enabled and first_alert is None \
+                        and sum(exp.anomaly.alerts_total):
+                    first_alert = w
+            rows_seen = None if not enabled else exp.anomaly.rows_seen
+            rows_in = exp.rows_in
+        finally:
+            exp.close()
+        # the first windows carry the window-step / feed compiles;
+        # median: a single GC/scheduler hiccup must not fake a
+        # detection-lane regression (or hide one)
+        steady = flush_s[4:]
+        return (float(np.median(steady)), first_alert,
+                ramp.onset_window, rows_seen, rows_in)
+
+    off_s, _, _, _, _ = _anomaly_run(False)
+    on_s, first_alert, onset, a_rows, a_rows_in = _anomaly_run(True)
+    anomaly_stats = {
+        "rows_per_window": anomaly_rows,
+        "window_close_ms_off": round(off_s * 1e3, 3),
+        "window_close_ms_on": round(on_s * 1e3, 3),
+        "overhead_frac": round(max(0.0, on_s - off_s) / max(off_s, 1e-9),
+                               4),
+        "detect_latency_windows": (None if first_alert is None
+                                   else first_alert - onset),
+        "rows_conserved": a_rows == a_rows_in,
+    }
+    _recover()
+
     stage_breakdown = {
+        "anomaly": anomaly_stats,
         "serving": serving_stats,
         "pod_merge": pod_stats,
         "feed_overlap": feed_stats,

@@ -175,7 +175,9 @@ class EmitUnderLock(Checker):
 _DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
                          "runtime/feed.py", "runtime/audit.py",
                          "runtime/profiler.py", "serving/cache.py",
-                         "serving/tables.py", "batch/staging.py")
+                         "serving/tables.py", "serving/anomaly.py",
+                         "batch/staging.py", "anomaly/detectors.py",
+                         "anomaly/alerts.py")
 # the sampled-drain helpers where a blocking sync is the point: explicit
 # attribution drains on every Nth batch / cold compile (PR 1), the
 # degraded-mode device probe (PR 2), the overlapped feed's
@@ -203,9 +205,22 @@ _SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
 # merges are DEFINED as a host-side merge of shard copies), and
 # `_probe_device` is the PR 2 degraded-recovery probe on the pod's
 # per-shard ladder. Shard batch updates stay async.
+# The ISSUE 15 anomaly plane is under the rule on all three files:
+# detectors.py must stay a pure device program library (zero sanctioned
+# syncs), alerts.py materializes the window's scores ONLY inside
+# close_window (already the globally-sanctioned window-close name the
+# audit uses, same boundary, same argument), and serving/anomaly.py is
+# a snapshot-cache reader like tables.py (host arrays only; the cache's
+# `refresh` is its one sanctioned sync, scoped via serving/cache.py).
 _SANCTIONED_SYNCS_BY_FILE = {
     "serving/cache.py": frozenset(["refresh"]),
     "batch/staging.py": frozenset(),
+    "anomaly/detectors.py": frozenset(),
+    # device_lost is the anomaly plane's error-path recovery: ONE
+    # device_get to salvage the detection baselines off a possibly-dead
+    # chain (the _restore_device_state_locked posture, not a hot-path
+    # sync — it runs at most once per device error)
+    "anomaly/alerts.py": frozenset(["device_lost"]),
     "parallel/pod.py": frozenset(["_contribute", "_probe_device"]),
 }
 
@@ -478,7 +493,10 @@ _DATA_NOUNS = frozenset([
     "frame", "frames", "row", "rows", "chunk", "chunks", "batch",
     "batches", "record", "records", "blob", "blobs", "segment",
     "segments", "seg", "datagram", "datagrams", "msg", "msgs",
-    "payload", "payloads"])
+    "payload", "payloads",
+    # ISSUE 15: alerts are data-plane product output — a dropped alert
+    # must move a Countable exactly like a dropped row
+    "alert", "alerts"])
 # a drop path is "counted" when its block provably moves a ledger: any
 # augmented assignment (counter += n), or a call whose name owns a loss
 # verb (self._count_drop(), tracer.incr(...), shed(), ...)

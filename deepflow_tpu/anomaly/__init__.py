@@ -1,0 +1,15 @@
+"""The anomaly plane (ISSUE 15): windowed entropy-DDoS + streaming-PCA
++ matrix-profile detection as a first-class, durable, queryable lane
+beside the sketch lane. ``detectors`` holds the device state + jitted
+window step; ``alerts`` the AlertRecord wire shape and the AnomalyPlane
+orchestrator; the serving read side lives in
+``deepflow_tpu/serving/anomaly.py``."""
+
+from deepflow_tpu.anomaly.detectors import (AnomalyConfig, AnomalyState,
+                                            DETECTORS, GOLDEN_FEATURES)
+from deepflow_tpu.anomaly.alerts import (AlertRecord, AnomalyPlane,
+                                         ANOMALY_STREAM)
+
+__all__ = ["AnomalyConfig", "AnomalyState", "DETECTORS",
+           "GOLDEN_FEATURES", "AlertRecord", "AnomalyPlane",
+           "ANOMALY_STREAM"]

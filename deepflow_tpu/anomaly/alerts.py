@@ -1,0 +1,480 @@
+"""Alert records + the AnomalyPlane: window closes -> durable alerts.
+
+``AlertRecord`` is the wire shape a detection crosses every boundary
+in: the breaker-wrapped ``Exporters`` fan-out (stream ``"anomaly"``,
+columnar like every other exporter put), the anomaly snapshot bus
+(``SnapshotBus(name="anomaly")`` — the same pub/sub + fsynced-npz
+machinery the sketch lane trusts, so alerts survive a crash and
+``serving/`` answers ``SELECT * FROM anomaly`` and
+``anomaly_score{detector=...}`` from snapshot caches without touching
+the hot path), and the /metrics gauges
+(``anomaly_score`` / ``anomaly_alerts_total`` /
+``anomaly_detect_latency_windows`` / ``anomaly_active_flows``).
+
+``AnomalyPlane`` is the host-side orchestrator the tpu_sketch exporter
+owns: per-batch active-flow feeds (device-array reuse, no extra h2d),
+the jitted window step at every flush, alert decision + excursion
+latency tracking, and the publish fan-out. Lock discipline mirrors the
+exporter: ``close_window`` runs under the exporter's ``_state_lock``
+(same boundary the sketch flush owns), while ``publish_pending`` runs
+AFTER the lock releases — bus subscribers and exporter puts are
+emissions and never run under a lock (the PR 3 swap-under-lock rule).
+
+Loss accounting (the silent-drop rule covers this package): a window
+the scorer could not price is ``windows_unscored``; an alert the
+fan-out could not place is ``alerts_shed``; a batch the active-flow
+feed could not apply is ``feed_errors`` (detection-quality loss only —
+the rows themselves are the sketch lane's ledger). ``rows_seen`` is
+the conservation mirror of the exporter's ``rows_in``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepflow_tpu.anomaly import detectors
+from deepflow_tpu.anomaly.detectors import AnomalyConfig, DETECTORS
+from deepflow_tpu.runtime.faults import FAULT_ANOMALY_SCORE, default_faults
+from deepflow_tpu.runtime.snapbus import SnapshotBus
+
+__all__ = ["AlertRecord", "AlertSnapshot", "AnomalyPlane",
+           "ALERT_COLUMNS", "ANOMALY_STREAM"]
+
+# the Exporters fan-out stream alerts ride (is_export_data key)
+ANOMALY_STREAM = "anomaly"
+
+# the columnar wire shape of one alert batch (Exporters.put cols)
+ALERT_COLUMNS = ("window", "wall_time", "detector", "score", "threshold",
+                 "latency_windows", "top_keys", "top_counts", "lossy",
+                 "degraded")
+
+
+@dataclass(frozen=True)
+class AlertRecord:
+    """One detection: which detector fired on which window, how hard,
+    and who contributed. ``top_keys`` are the ring top-K flow keys of
+    the window (the alert's named suspects — the same key space every
+    sketch query speaks); tags inherit the window's trust verdicts
+    (``lossy``/``degraded`` from the epoch/flush result, pod
+    participation when the lane is a pod)."""
+
+    window: int
+    wall_time: float
+    detector: str
+    score: float
+    threshold: float
+    latency_windows: int
+    top_keys: Tuple[int, ...] = ()
+    top_counts: Tuple[int, ...] = ()
+    lossy: bool = False
+    degraded: bool = False
+    participation: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe form (bus snapshot tags / SQL rows)."""
+        return {
+            "window": self.window, "wall_time": self.wall_time,
+            "detector": self.detector, "score": round(self.score, 4),
+            "threshold": self.threshold,
+            "latency_windows": self.latency_windows,
+            "top_keys": list(self.top_keys),
+            "top_counts": list(self.top_counts),
+            "lossy": self.lossy, "degraded": self.degraded,
+            "participation": dict(self.participation),
+        }
+
+
+class AlertSnapshot:
+    """The anomaly bus payload: a fixed-order leaf tuple (the snapbus
+    publishes any pytree by flattening — a plain list of arrays keeps
+    the serving view's positional contract explicit).
+
+    Leaf order (serving/anomaly.py pins it):
+      0 scores [3] f32        1 thresholds [3] f32
+      2 z [4] f32             3 feats [9] f32
+      4 active_flows [] i32   5 new_flows [] i32
+      6 rows [] i32           7 alerts_total [3] i64
+    """
+
+    N_LEAVES = 8
+
+    @staticmethod
+    def leaves(scores, thresholds, z, feats, active, new, rows,
+               alerts_total) -> List[np.ndarray]:
+        return [np.asarray(scores, np.float32),
+                np.asarray(thresholds, np.float32),
+                np.asarray(z, np.float32),
+                np.asarray(feats, np.float32),
+                np.asarray(active, np.int32),
+                np.asarray(new, np.int32),
+                np.asarray(rows, np.int32),
+                np.asarray(alerts_total, np.int64)]
+
+
+class AnomalyPlane:
+    """The detection lane beside one tpu_sketch exporter.
+
+    Ownership protocol: feed_* and close_window run wherever the
+    exporter's state advances (the worker thread under _state_lock, or
+    the feed thread between drain barriers) — the plane's device state
+    rides the same ownership the sketch state does. publish_pending is
+    the only method that emits, and the caller invokes it with no lock
+    held."""
+
+    def __init__(self, cfg: Optional[AnomalyConfig] = None,
+                 directory: Optional[str] = None,
+                 stats=None, keep_snapshots: int = 8) -> None:
+        self.cfg = cfg or AnomalyConfig()
+        self.state = detectors.init(self.cfg)
+        self._step = detectors.make_window_step(self.cfg)
+        import jax
+
+        self._advance = jax.jit(
+            lambda s: s._replace(window=s.window + 1), donate_argnums=0)
+        self._programs: Dict[Any, Any] = {}
+        self.bus = SnapshotBus(directory, name="anomaly",
+                               keep=keep_snapshots)
+        self._exporters = None
+        self._faults = default_faults()
+        from deepflow_tpu.runtime.tracing import default_tracer
+        self._tracer = default_tracer()
+        # -- ledgers (all host-side ints; scrape-visible) ---------------
+        self.rows_seen = 0           # conservation mirror of rows_in
+        self.windows = 0             # windows closed (scored or not)
+        self.windows_unscored = 0    # scoring failed/shed — counted loss
+        self.feed_errors = 0         # active-flow feed batches dropped
+        self.alerts_total = [0] * len(DETECTORS)
+        self.alerts_shed = 0         # alert failed to publish anywhere
+        self.score_errors = 0        # injected/real scoring raises
+        self.last_scores = [0.0] * len(DETECTORS)
+        self.last_latency_windows = 0
+        self.active_flows = 0
+        self.new_flows = 0
+        self.table_offers = 0
+        self.table_evictions = 0
+        # excursion tracking for detect latency (see faults.py ledger):
+        # _onset pins the excursion's first (possibly unscored) window,
+        # _onset_latency the latency of its FIRST alert — later alerts
+        # in the same excursion repeat it instead of growing
+        self._onset: List[Optional[int]] = [None] * len(DETECTORS)
+        self._onset_latency: List[int] = [0] * len(DETECTORS)
+        self._unscored_since: Optional[int] = None
+        self._pending: Optional[Tuple[list, List[np.ndarray], dict,
+                                      float, int]] = None
+        # the last window's entropy_ddos verdict, for the detection
+        # audit (runtime/audit.py compares it against the exact
+        # shadow's twin scorer): eligible = scored AND past warmup
+        self.last_entropy_verdict: Optional[Dict[str, Any]] = None
+        if stats is not None:
+            stats.register("anomaly", self.counters)
+
+    # -- wiring ------------------------------------------------------------
+    def attach_exporters(self, exporters) -> None:
+        """The breaker-wrapped fan-out alerts ride (Exporters.put on
+        stream 'anomaly'); None keeps bus-only publishing."""
+        self._exporters = exporters
+
+    # -- ingest-side accounting (under the exporter's state lock) ----------
+    def observe_rows(self, n: int) -> None:
+        self.rows_seen += int(n)
+
+    # -- per-batch active-flow feeds (device, exporter/feed thread) --------
+    def _feed(self, key, build, *args) -> None:
+        """Run one jitted feed program against the active-flow table.
+        A device-classified failure here costs detection fidelity, not
+        data: the batch's offers are dropped COUNTED (feed_errors) and
+        the sketch path never sees the error. The failed dispatch has
+        already consumed the DONATED state buffers, so the state must
+        be re-initialized (window counter preserved) — leaving it
+        pointing at dead buffers would fail every later feed AND the
+        window step."""
+        if self.cfg.active_log2 <= 0:
+            return
+        prog = self._programs.get(key)
+        if prog is None:
+            import jax
+
+            prog = jax.jit(build(), donate_argnums=0)
+            self._programs[key] = prog
+        try:
+            self.state = prog(self.state, *args)
+        except RuntimeError:
+            self.feed_errors += 1
+            self.state = detectors.init(self.cfg, window=self.windows)
+
+    def feed_lanes(self, lanes, mask) -> None:
+        self._feed(("lanes", lanes["ip_src"].shape[0]),
+                   lambda: lambda s, l, m: detectors.feed_lanes(
+                       s, l, m, self.cfg),
+                   lanes, mask)
+
+    def feed_cols(self, cols, mask) -> None:
+        self._feed(("cols", mask.shape[0]),
+                   lambda: lambda s, c, m: detectors.feed_cols(
+                       s, c, m, self.cfg),
+                   cols, mask)
+
+    def feed_flat(self, flat, k: int, capacity: int) -> None:
+        self._feed(("flat", k, capacity),
+                   lambda: lambda s, f, k=k, c=capacity:
+                   detectors.feed_flat(s, f, k, c, self.cfg),
+                   flat)
+
+    def feed_dict_flat(self, table, flat, sig) -> None:
+        self._feed(("dict", tuple(sig), table.shape[1]),
+                   lambda: lambda s, t, f, sg=tuple(sig):
+                   detectors.feed_dict_flat(s, t, f, sg, self.cfg),
+                   table, flat)
+
+    def feed_news(self, plane, n) -> None:
+        self._feed(("news", plane.shape[1]),
+                   lambda: lambda s, p, nn: detectors.feed_news(
+                       s, p, nn, self.cfg),
+                   plane, n)
+
+    def feed_hits(self, table, plane, n) -> None:
+        self._feed(("hits", plane.shape[1], table.shape[1]),
+                   lambda: lambda s, t, p, nn: detectors.feed_hits(
+                       s, t, p, nn, self.cfg),
+                   table, plane, n)
+
+    # -- window close (under the exporter's state lock) --------------------
+    def close_window(self, out, now: Optional[float] = None,
+                     lossy: bool = False, degraded: bool = False,
+                     participation: Optional[Dict[str, Any]] = None
+                     ) -> List[AlertRecord]:
+        """Score the settled window and decide alerts. The ONE
+        sanctioned host sync of the anomaly lane: the step's scores are
+        materialized here, at the same boundary flush_window already
+        fetches the window output. ``out`` is the window's
+        FlowWindowOutput (device arrays on the single-chip lane, host
+        arrays from the degraded/pod paths) or None (a window the
+        sketch itself could not read). Returns the alerts; the caller
+        must call publish_pending() after releasing its lock."""
+        now = time.time() if now is None else now
+        w = self.windows
+        self.windows += 1
+        scored = None
+        if out is None:
+            self.windows_unscored += 1
+            self._unscored_since = w if self._unscored_since is None \
+                else self._unscored_since
+            self._advance_unscored()
+        else:
+            try:
+                if self._faults.enabled:
+                    self._faults.maybe_raise(FAULT_ANOMALY_SCORE,
+                                             key=f"window{w}")
+                self.state, scored = self._step(
+                    self.state, out.entropies, out.topk_counts,
+                    out.service_cardinality, out.rows)
+            except Exception:
+                # injected (anomaly.score) or device-classified: the
+                # window closes UNSCORED — counted, excursion state
+                # kept so the next scored window carries the latency
+                self.score_errors += 1
+                self.windows_unscored += 1
+                self._unscored_since = w if self._unscored_since is None \
+                    else self._unscored_since
+                logging.getLogger(__name__).exception(
+                    "anomaly window %d unscored", w)
+                self._advance_unscored()
+        alerts: List[AlertRecord] = []
+        leaves = None
+        tags: Dict[str, Any] = {"window": w, "lossy": bool(lossy),
+                                "degraded": bool(degraded),
+                                "scored": scored is not None}
+        if participation:
+            tags.update(participation)
+        if scored is not None:
+            # the sanctioned materialization: small vectors, once per
+            # window
+            scores = np.asarray(scored.scores, np.float32)
+            z = np.asarray(scored.z, np.float32)
+            feats = np.asarray(scored.feats, np.float32)
+            self.active_flows = int(np.asarray(scored.active_flows))
+            self.new_flows = int(np.asarray(scored.new_flows))
+            self.table_offers = int(np.asarray(self.state.offers))
+            self.table_evictions = int(np.asarray(self.state.evictions))
+            rows = int(np.asarray(scored.rows))
+            self.last_scores = [float(s) for s in scores]
+            # lazily materialized on the FIRST alerting detector only:
+            # steady-state (alert-free) windows never pay the ring
+            # fetch under the exporter's state lock
+            contributors = None
+            thr = self.cfg.thresholds
+            for i, det in enumerate(DETECTORS):
+                if float(scores[i]) >= thr[i]:
+                    if contributors is None:
+                        contributors = self._top_contributors(out)
+                    if self._onset[i] is None:
+                        onset = self._unscored_since \
+                            if self._unscored_since is not None else w
+                        self._onset[i] = onset
+                        self._onset_latency[i] = w - onset
+                    latency = self._onset_latency[i]
+                    self.last_latency_windows = latency
+                    self.alerts_total[i] += 1
+                    alerts.append(AlertRecord(
+                        window=w, wall_time=now, detector=det,
+                        score=float(scores[i]), threshold=thr[i],
+                        latency_windows=latency,
+                        top_keys=contributors[0],
+                        top_counts=contributors[1],
+                        lossy=bool(lossy), degraded=bool(degraded),
+                        participation=dict(participation or {})))
+                else:
+                    self._onset[i] = None
+            self._unscored_since = None
+            leaves = AlertSnapshot.leaves(
+                scores, np.asarray(thr, np.float32), z, feats,
+                self.active_flows, self.new_flows, rows,
+                self.alerts_total)
+            tags["z"] = [round(float(v), 4) for v in z]
+        if alerts:
+            tags["alerts"] = [a.to_dict() for a in alerts]
+        self.last_entropy_verdict = {
+            "eligible": scored is not None
+            and w >= self.cfg.warmup_windows,
+            "alerted": any(a.detector == DETECTORS[0] for a in alerts),
+            "score": self.last_scores[0],
+            "threshold": self.cfg.entropy_z,
+            "warmup_windows": self.cfg.warmup_windows,
+            "ewma_alpha": self.cfg.ewma_alpha,
+        }
+        self._pending = (alerts, leaves, tags, now, w)
+        return alerts
+
+    def _advance_unscored(self) -> None:
+        """Bump the device window counter so the table's LRU epoch
+        stays aligned with the host window count even when scoring
+        failed; a second failure here resets the plane (detection
+        restarts from a cold baseline — counted via score_errors).
+        The reset seeds the window counter from the HOST count: a
+        zeroed device counter would re-gate warmup and black out
+        detection for warmup_windows without anything counting it."""
+        try:
+            self.state = self._advance(self.state)
+        except Exception:
+            self.state = detectors.init(self.cfg, window=self.windows)
+
+    def _top_contributors(self, out):
+        """The window's ring top-K heads — the alert's named suspects."""
+        k = self.cfg.top_contributors
+        keys = np.asarray(out.topk_keys)[:k]
+        counts = np.asarray(out.topk_counts)[:k]
+        live = counts > 0
+        return (tuple(int(x) for x in keys[live]),
+                tuple(int(x) for x in counts[live]))
+
+    # -- publish (NO lock held) --------------------------------------------
+    def publish_pending(self) -> None:
+        """Fan the last closed window out: anomaly bus (durable npz on
+        alert windows, subscriber-only otherwise), the breaker-wrapped
+        Exporters stream, and the /metrics gauges. Runs after the
+        exporter's state lock released — every failure is counted
+        (alerts_shed), never raised into the window thread."""
+        pending = self._pending
+        if pending is None:
+            return
+        self._pending = None
+        alerts, leaves, tags, now, w = pending
+        published = False
+        if leaves is not None:
+            try:
+                self.bus.publish(leaves, step=w, wall_time=now,
+                                 tags=tags, to_disk=bool(alerts))
+                published = True
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "anomaly bus publish failed (window %d)", w)
+        if alerts and self._exporters is not None:
+            # columnar alert batch through the breaker-wrapped fan-out;
+            # Exporters.put contains every exporter failure itself
+            self._exporters.put(ANOMALY_STREAM, 0, self._alert_cols(alerts))
+            published = True
+        if alerts and not published:
+            # nowhere to land: the alerts are shed — counted loss
+            self.alerts_shed += len(alerts)
+        self._emit_gauges()
+
+    @staticmethod
+    def _alert_cols(alerts: List[AlertRecord]) -> Dict[str, np.ndarray]:
+        n = len(alerts)
+        return {
+            "window": np.asarray([a.window for a in alerts], np.uint32),
+            "wall_time": np.asarray([a.wall_time for a in alerts],
+                                    np.float64),
+            "detector": np.asarray([a.detector for a in alerts]),
+            "score": np.asarray([a.score for a in alerts], np.float32),
+            "threshold": np.asarray([a.threshold for a in alerts],
+                                    np.float32),
+            "latency_windows": np.asarray(
+                [a.latency_windows for a in alerts], np.uint32),
+            "top_keys": np.asarray(
+                [",".join(str(k) for k in a.top_keys) for a in alerts]),
+            "top_counts": np.asarray(
+                [",".join(str(c) for c in a.top_counts)
+                 for a in alerts]),
+            "lossy": np.asarray([a.lossy for a in alerts], np.uint8),
+            "degraded": np.asarray([a.degraded for a in alerts],
+                                   np.uint8),
+        } if n else {}
+
+    def _emit_gauges(self) -> None:
+        tr = self._tracer
+        if not tr.enabled:
+            return
+        tr.gauge("anomaly_score", max(self.last_scores) if
+                 self.last_scores else 0.0)
+        tr.gauge("anomaly_alerts_total", float(sum(self.alerts_total)))
+        tr.gauge("anomaly_detect_latency_windows",
+                 float(self.last_latency_windows))
+        tr.gauge("anomaly_active_flows", float(self.active_flows))
+
+    # -- degraded-lane hooks -----------------------------------------------
+    def device_lost(self) -> None:
+        """The sketch lane classified a device error: the anomaly
+        state's buffers may ride the same dead chain. Salvage the
+        baselines by round-tripping the state through the host (fresh
+        device buffers, same EWMAs/PCA/ring — a transient error costs
+        nothing); only when even that fails does detection restart
+        from a cold baseline. Either way the event is counted
+        (feed_errors) and the window counter is preserved so the
+        table's LRU epoch stays aligned."""
+        import jax
+        import jax.numpy as jnp
+
+        self.feed_errors += 1
+        try:
+            host = jax.device_get(self.state)
+            self.state = jax.tree_util.tree_map(jnp.asarray, host)
+        except Exception:
+            self.state = detectors.init(self.cfg, window=self.windows)
+
+    # -- observability -----------------------------------------------------
+    def counters(self) -> dict:
+        c = {
+            "rows_seen": self.rows_seen,
+            "windows": self.windows,
+            "windows_unscored": self.windows_unscored,
+            "score_errors": self.score_errors,
+            "feed_errors": self.feed_errors,
+            "alerts_shed": self.alerts_shed,
+            "alerts_total": sum(self.alerts_total),
+            "active_flows": self.active_flows,
+            "new_flows": self.new_flows,
+            "table_offers": self.table_offers,
+            "table_evictions": self.table_evictions,
+            "detect_latency_windows": self.last_latency_windows,
+        }
+        for i, det in enumerate(DETECTORS):
+            c[f"alerts_{det}"] = self.alerts_total[i]
+            c[f"score_{det}"] = round(self.last_scores[i], 4)
+        c.update({f"bus_{k}": v for k, v in self.bus.counters().items()})
+        return c

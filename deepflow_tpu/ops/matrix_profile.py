@@ -60,7 +60,16 @@ def _sub_stats(ring: jnp.ndarray, m: int):
     idx = jnp.arange(n_sub)[:, None] + jnp.arange(m)[None, :]
     subs = ring[:, idx]                                # [s, n_sub, m]
     mu = subs.mean(axis=2)
-    sd = jnp.sqrt(jnp.maximum(subs.var(axis=2), _SD_FLOOR ** 2))
+    # var of an f32-overflowing (or inf/nan-poisoned) subsequence is
+    # NaN (inf - inf), and NaN survives jnp.maximum — the constant-
+    # subsequence guard in _znorm_dist2 then reads `NaN <= floor` as
+    # False and NaN distances leak into the profile (ISSUE 15
+    # hardening). Treat a non-finite variance as zero variance: the
+    # subsequence prices via the constant-series convention instead of
+    # poisoning every row it neighbors.
+    var = subs.var(axis=2)
+    var = jnp.where(jnp.isfinite(var), var, 0.0)
+    sd = jnp.sqrt(jnp.maximum(var, _SD_FLOOR ** 2))
     return subs, mu, sd
 
 
@@ -74,7 +83,11 @@ def _znorm_dist2(qt, mu_a, sd_a, mu_b, sd_b, m: int):
     discords. Convention (STOMP implementations): flat-vs-flat = 0,
     flat-vs-varying = m (halfway)."""
     corr = (qt - m * mu_a * mu_b) / (m * sd_a * sd_b)
-    corr = jnp.clip(corr, -1.0, 1.0)
+    # the zero-variance guard's second half: qt/mu of overflowing
+    # subsequences can be inf, making corr NaN through inf - inf even
+    # with a floored sd; clip() propagates NaN, so blank it to 0
+    # (neutral correlation) before the constant-flag selection below
+    corr = jnp.clip(jnp.where(jnp.isfinite(corr), corr, 0.0), -1.0, 1.0)
     d2 = 2.0 * m * (1.0 - corr)
     const_a = sd_a <= _SD_FLOOR
     const_b = sd_b <= _SD_FLOOR

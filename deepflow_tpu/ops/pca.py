@@ -37,8 +37,19 @@ def init(features: int, k: int, seed: int = 7) -> PCAState:
     )
 
 
+# EMA-variance floor for standardization. The EMA variance of a
+# (near-)constant feature decays toward 0, and the old additive 1e-6
+# epsilon then divides a feature's noise by ~1e-3 — a one-count jitter
+# on a dead-quiet signal became a z of hundreds and the reconstruction
+# residual spiked on nothing (ISSUE 15 hardening). A hard floor keeps
+# the standardized scale of quiet features bounded; genuinely varying
+# features sit far above it and are unaffected.
+_VAR_FLOOR = 1e-4
+
+
 def _standardize(state: PCAState, x: jnp.ndarray) -> jnp.ndarray:
-    return (x - state.mean[None, :]) / jnp.sqrt(state.var[None, :] + 1e-6)
+    return (x - state.mean[None, :]) \
+        / jnp.sqrt(jnp.maximum(state.var[None, :], _VAR_FLOOR))
 
 
 def update(state: PCAState, x: jnp.ndarray, mask: jnp.ndarray | None = None,

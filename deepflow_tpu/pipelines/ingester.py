@@ -92,6 +92,31 @@ class IngesterConfig:
     # and a sustained bound violation trips an alarm on /healthz.
     # Host-side only, bit-invisible to the sketch path. 0 disables.
     audit_sample_rate: float = 1.0 / 64
+    # -- anomaly plane (deepflow_tpu/anomaly/, ISSUE 15) --------------
+    # run the detection lane beside the tpu_sketch lane: per-window
+    # entropy-DDoS scoring over a device-resident active-flow working
+    # set, streaming-PCA residuals and matrix-profile discords over
+    # the golden-signal window series, alert records durable on the
+    # anomaly snapshot bus and queryable through serving/ (SQL
+    # `SELECT * FROM anomaly`, PromQL `anomaly_score{detector=...}`).
+    # Requires the tpu_sketch lane; False leaves detection off.
+    anomaly_enabled: bool = False
+    # entropy-DDoS alert threshold in z units (EWMA-standardized
+    # feature-entropy deviation; src dispersion up / dst collapse)
+    anomaly_entropy_z: float = 4.0
+    # streaming-PCA residual threshold in z units (residual deviation
+    # against its own EWMA history)
+    anomaly_pca_z: float = 4.0
+    # matrix-profile discord threshold (z-normalized subsequence
+    # distance of the newest window against all history)
+    anomaly_mp_threshold: float = 3.0
+    # active-flow working-set size as log2 slots (2^n-entry device
+    # table, LRU-by-window eviction); 0 disables the table (the
+    # entropy detector still runs off the suite entropies)
+    anomaly_active_log2: int = 14
+    # windows before any detector may alert (EWMA baselines warm up
+    # on a running average over these)
+    anomaly_warmup_windows: int = 8
     # per-service RED windows from the l7 stream (runtime/app_red.py);
     # None disables, a float sets window seconds
     app_red_window_s: Optional[float] = None
@@ -220,6 +245,18 @@ class Ingester:
             from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
             ckpt_dir = None if cfg.store_path is None else \
                 os.path.join(cfg.store_path, "sketch_ckpt")
+            anomaly = None
+            anomaly_dir = None
+            if cfg.anomaly_enabled:
+                from deepflow_tpu.anomaly import AnomalyConfig
+                anomaly = AnomalyConfig(
+                    active_log2=cfg.anomaly_active_log2,
+                    entropy_z=cfg.anomaly_entropy_z,
+                    pca_z=cfg.anomaly_pca_z,
+                    mp_threshold=cfg.anomaly_mp_threshold,
+                    warmup_windows=cfg.anomaly_warmup_windows)
+                anomaly_dir = None if cfg.store_path is None else \
+                    os.path.join(cfg.store_path, "anomaly_ckpt")
             self.tpu_sketch = TpuSketchExporter(
                 store=self.store, window_seconds=cfg.tpu_sketch_window_s,
                 checkpoint_dir=ckpt_dir, stats=self.stats,
@@ -230,8 +267,14 @@ class Ingester:
                 pack_workers=cfg.pack_workers,
                 pod_shards=cfg.tpu_sketch_pod_shards,
                 pod_merge_deadline_s=cfg.pod_merge_deadline_s,
-                audit_rate=cfg.audit_sample_rate)
+                audit_rate=cfg.audit_sample_rate,
+                anomaly=anomaly, anomaly_dir=anomaly_dir)
             self.exporters.register(self.tpu_sketch)
+            if self.tpu_sketch.anomaly is not None:
+                # alerts ride the breaker-wrapped fan-out on stream
+                # "anomaly" (third-party exporters can subscribe; the
+                # put itself is contained + counted like every other)
+                self.tpu_sketch.anomaly.attach_exporters(self.exporters)
         self.app_red = None
         if cfg.app_red_window_s is not None:
             from deepflow_tpu.runtime.app_red import AppRedExporter

@@ -57,7 +57,7 @@ class QueryResult:
 class QueryEngine:
     def __init__(self, store: Store,
                  tag_dicts: Optional[TagDictRegistry] = None,
-                 tagrecorder=None, sketch=None) -> None:
+                 tagrecorder=None, sketch=None, anomaly=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
         # controller.tagrecorder.TagRecorder: id->name dimension dicts for
@@ -68,6 +68,9 @@ class QueryEngine:
         # — SELECT sketch.cms_point/hll_card/topk/entropy answers from
         # the in-process snapshot cache, never the store or the device
         self.sketch = sketch
+        # serving.AnomalyTables (ISSUE 15): SELECT * FROM anomaly —
+        # the detection lane's durable alert records as a table
+        self.anomaly = anomaly
 
     # -- public ------------------------------------------------------------
     def execute(self, sql_text: str, db: Optional[str] = None) -> QueryResult:
@@ -160,6 +163,10 @@ class QueryEngine:
         if self.sketch is not None and stmt.table == "sketch":
             # the sketch datasource: snapshot-cache reads, no store scan
             return self.sketch.sql(stmt)
+        if self.anomaly is not None and stmt.table == "anomaly":
+            # the anomaly datasource: alert records off the plane's
+            # snapshot cache — same no-store, no-device posture
+            return self.anomaly.sql(stmt)
         table = self._resolve_table(stmt.table, db)
         schema = table.schema
 

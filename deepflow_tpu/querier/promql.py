@@ -74,6 +74,13 @@ SKETCH_FUNCS = ("sketch_cms_point", "sketch_hll_card",
                 "sketch_topk", "sketch_entropy")
 
 
+def _anomaly_metrics():
+    """The ISSUE 15 anomaly selectors (deferred import: the evaluator
+    must not pull the serving package unless a plane is mounted)."""
+    from deepflow_tpu.serving.anomaly import ANOMALY_PROM_METRICS
+    return ANOMALY_PROM_METRICS
+
+
 # -- AST -------------------------------------------------------------------
 @dataclass(frozen=True)
 class Selector:
@@ -695,6 +702,15 @@ class _Evaluator:
         if sel.range_s is not None:
             raise ValueError("range vector needs rate()/increase()/... "
                              "around it")
+        # the ISSUE 15 anomaly datasource: anomaly_score{detector=...}
+        # et al. are real instant-vector selectors answered from the
+        # plane's snapshot cache, never the samples table
+        anomaly = getattr(self.engine, "anomaly", None)
+        if anomaly is not None and sel.metric in _anomaly_metrics():
+            return [(dict(labels), np.asarray(vals, np.float64))
+                    for labels, vals in anomaly.prom_instant(
+                        sel.metric, sel.matchers,
+                        self.grid - sel.offset_s)]
         g = self.grid - sel.offset_s
         lo = int(g.min()) - DEFAULT_LOOKBACK_S
         hi = int(g.max()) + 1
@@ -1419,13 +1435,16 @@ def _compare(op: str, a, b) -> np.ndarray:
 class PromEngine:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  db: str = "ext_metrics", table: str = "ext_samples",
-                 sketch=None) -> None:
+                 sketch=None, anomaly=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
         self.db = db
         self.table = table
         # serving.SketchTables (ISSUE 7): backs the sketch_* functions
         self.sketch = sketch
+        # serving.AnomalyTables (ISSUE 15): backs the anomaly_*
+        # instant-vector selectors
+        self.anomaly = anomaly
 
     # -- series access -----------------------------------------------------
     def _fetch(self, metric: str, matchers, lo: int, hi: int,

@@ -36,20 +36,24 @@ class QuerierServer:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1",
                  tagrecorder=None, external_apm=None,
-                 sketch=None, supervisor=None) -> None:
+                 sketch=None, anomaly=None, supervisor=None) -> None:
         from deepflow_tpu.querier.tracing_adapter import \
             TracingAdapterService
         # serving.SketchTables (ISSUE 7): both engines mount it as the
         # `sketch` datasource (SQL SELECT sketch.* / PromQL sketch_*),
         # served through the existing /v1/query and /api/v1/query routes
         self.sketch = sketch
+        # serving.AnomalyTables (ISSUE 15): SELECT * FROM anomaly /
+        # anomaly_score{detector=...} through the same routes
+        self.anomaly = anomaly
         # supervision tree for the accept loop; None = the process
         # default, resolved at start() (a start()-time supervisor
         # argument overrides a constructor-time one)
         self._supervisor = supervisor
         self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder,
-                                  sketch=sketch)
-        self.prom = PromEngine(store, tag_dicts, sketch=sketch)
+                                  sketch=sketch, anomaly=anomaly)
+        self.prom = PromEngine(store, tag_dicts, sketch=sketch,
+                               anomaly=anomaly)
         self.profile = ProfileQuery(store, tag_dicts)
         self.tempo = TempoQuery(store, tag_dicts)
         self.tracing_adapter = TracingAdapterService.from_config(

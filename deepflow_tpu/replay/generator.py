@@ -11,7 +11,7 @@ against exact aggregation.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -205,3 +205,163 @@ class SyntheticAgent:
                 msg_type, payload,
                 FlowHeader(sequence=self._seq, vtap_id=self.vtap_id),
             )
+
+
+# -- DDoS ramp profile (ISSUE 15) -------------------------------------------
+
+@dataclass(frozen=True)
+class RampPhase:
+    """One phase of the DDoS ramp: ``attack_frac`` of each window's
+    rows are src-spoofed attack rows aimed at the victim ("ramp"
+    phases interpolate 0 -> attack_frac across their windows);
+    ``rate_mult`` scales the window's row count."""
+
+    name: str
+    windows: int
+    attack_frac: float
+    rate_mult: float = 1.0
+
+
+# the default profile: quiet baseline, a 3-window ramp onto the victim,
+# a sustained flood, traffic normalizing. Reused verbatim by
+# tests/test_anomaly.py, ci.sh's anomaly smoke and bench.py's anomaly
+# phase so "detection latency <= 2 windows of onset" means the same
+# thing everywhere.
+DDOS_RAMP_PHASES = (
+    RampPhase("baseline", 12, 0.0),
+    RampPhase("ramp", 3, 0.9, rate_mult=2.0),
+    RampPhase("sustained", 5, 0.9, rate_mult=3.0),
+    RampPhase("recovery", 8, 0.0),
+)
+
+
+class DDoSRamp:
+    """Deterministic windowed DDoS traffic: per-window l4 lane columns
+    (the full SyntheticAgent schema, so every wire/decoder eats them)
+    plus matching ``metric_record`` golden-signal traffic dicts.
+
+    Determinism is per-(seed, window): ``window_cols(w)`` derives its
+    RNG from the seed and the window index alone, so any consumer —
+    test, ci smoke, bench phase, two processes replaying against each
+    other — sees identical bytes for window w regardless of iteration
+    order or how many windows it materializes."""
+
+    def __init__(self, seed: int = 0xDD05,
+                 phases: Optional[Tuple[RampPhase, ...]] = None,
+                 rows_per_window: int = 4096,
+                 victim_ip: int = 0xAC10BEEF,
+                 victim_port: int = 80) -> None:
+        self.seed = int(seed)
+        self.phases = tuple(phases or DDOS_RAMP_PHASES)
+        self.rows_per_window = int(rows_per_window)
+        self.victim_ip = np.uint32(victim_ip)
+        self.victim_port = np.uint32(victim_port)
+        # a stable flow pool for the benign share: heavy hitters
+        # genuinely repeat across windows (the recall-harness feed)
+        self._agent = SyntheticAgent(seed=self.seed)
+        self._pool = self._agent.l4_columns_pooled(
+            max(2048, rows_per_window), pool=512)
+
+    @property
+    def n_windows(self) -> int:
+        return sum(p.windows for p in self.phases)
+
+    @property
+    def onset_window(self) -> int:
+        """First window carrying any attack rows — the latency anchor
+        every consumer measures detection against."""
+        w = 0
+        for p in self.phases:
+            if p.attack_frac > 0:
+                return w
+            w += p.windows
+        return w
+
+    def phase_of(self, w: int) -> Tuple[RampPhase, int]:
+        """(phase, index within the phase) for window w."""
+        off = w
+        for p in self.phases:
+            if off < p.windows:
+                return p, off
+            off -= p.windows
+        return self.phases[-1], self.phases[-1].windows - 1
+
+    def _attack_frac(self, w: int) -> float:
+        p, i = self.phase_of(w)
+        frac = p.attack_frac
+        if p.name == "ramp" and p.windows > 1:
+            frac = p.attack_frac * (i + 1) / p.windows
+        return frac
+
+    def window_cols(self, w: int) -> Tuple[str, dict]:
+        """(phase name, l4 columns) for window w. Benign rows resample
+        the stable pool; attack rows are src-spoofed (uniform /12
+        space), single-victim, single-port, 1-packet SYN-shaped."""
+        p, _ = self.phase_of(w)
+        rng = np.random.default_rng((self.seed, w))
+        n = max(1, int(self.rows_per_window * p.rate_mult))
+        pool_n = len(next(iter(self._pool.values())))
+        pick = rng.integers(0, pool_n, n)
+        cols = {k: v[pick].copy() for k, v in self._pool.items()}
+        n_attack = int(n * self._attack_frac(w))
+        if n_attack:
+            sl = slice(n - n_attack, n)      # attack rows at the tail
+            cols["ip_src"][sl] = rng.integers(
+                0, 1 << 20, n_attack).astype(np.uint32) \
+                + np.uint32(0x0B000000)
+            cols["ip_dst"][sl] = self.victim_ip
+            cols["port_src"][sl] = rng.integers(
+                1024, 65536, n_attack).astype(np.uint32)
+            cols["port_dst"][sl] = self.victim_port
+            cols["proto"][sl] = 6
+            # volumetric flood: big one-way packet trains per flow tick
+            # (the packet-weighted dst entropy must actually collapse
+            # onto the victim, not just the flow-count entropy)
+            cols["packet_tx"][sl] = 96
+            cols["packet_rx"][sl] = 0
+            cols["byte_tx"][sl] = 40 * 96
+            cols["byte_rx"][sl] = 0
+            cols["retrans"][sl] = 0
+        cols["flow_id"] = (np.uint64(w) << np.uint64(32)) \
+            + np.arange(n, dtype=np.uint64) + np.uint64(1)
+        return p.name, cols
+
+    def windows(self) -> Iterator[Tuple[int, str, dict]]:
+        for w in range(self.n_windows):
+            name, cols = self.window_cols(w)
+            yield w, name, cols
+
+    @staticmethod
+    def golden_traffic(cols: dict) -> dict:
+        """The window's flow_metrics golden signals (the traffic dict
+        ``SyntheticAgent.metric_record`` serializes) derived from the
+        SAME columns, so the l4 and metric wires describe one story."""
+        n = len(cols["ip_src"])
+        return {
+            "packet_tx": int(cols["packet_tx"].sum()),
+            "packet_rx": int(cols["packet_rx"].sum()),
+            "byte_tx": int(cols["byte_tx"].sum()),
+            "byte_rx": int(cols["byte_rx"].sum()),
+            "new_flow": n,
+            "closed_flow": int((cols["close_type"] > 0).sum()),
+            # a spoofed flood is one-way: no reply packets ever come
+            "syn": int((cols["packet_rx"] == 0).sum()),
+        }
+
+    def metric_documents(self, w: int, ts: Optional[int] = None
+                         ) -> List[bytes]:
+        """One golden-signal Document for window w (reuses the same
+        deterministic columns)."""
+        _, cols = self.window_cols(w)
+        return [self._agent.metric_record(
+            int(1_700_000_000 + w if ts is None else ts), 0,
+            self.golden_traffic(cols))]
+
+
+def ddos_ramp(seed: int = 0xDD05,
+              phases: Optional[Tuple[RampPhase, ...]] = None,
+              **kw) -> DDoSRamp:
+    """The deterministic DDoS ramp profile (baseline -> src-spoofed
+    ramp -> sustained -> recovery), shared by tests, ci.sh and the
+    bench anomaly phase."""
+    return DDoSRamp(seed=seed, phases=phases, **kw)

@@ -69,6 +69,8 @@ AUDIT_GAUGES = (
     "tpu_audit_topk_recall",
     "tpu_audit_sampled_keys",
     "tpu_audit_degraded_window",
+    "tpu_audit_detection_precision",
+    "tpu_audit_detection_recall",
 )
 
 
@@ -140,6 +142,24 @@ class ShadowAuditor:
         self._violations = 0                    # consecutive, toward trip
         self._healthy = 0                       # consecutive, toward clear
         self.last_window: Optional[dict] = None
+        # -- detection audit (ISSUE 15) --------------------------------
+        # the anomaly plane's entropy-DDoS verdict audited the way
+        # sketch error is: the shadow scores its EXACT entropies with
+        # the twin of the device's scorer (anomaly/detectors.py
+        # ddos_score_np) over its own EWMA baseline, and clean windows
+        # accumulate a confusion matrix (device verdict vs shadow
+        # verdict) -> live precision/recall. At rate < 1 the shadow's
+        # entropies are a cluster sample (see the entropy caveat
+        # above), so the numbers are advisory below full rate — same
+        # honesty contract as the entropy gauge.
+        self.det_tp = 0
+        self.det_fp = 0
+        self.det_fn = 0
+        self.det_tn = 0
+        self._det_mean = np.full(len(self._features), 0.5)
+        self._det_var = np.full(len(self._features), 0.25)
+        self._det_windows = 0                   # busy windows into the EWMA
+        self.last_detection: Optional[dict] = None
         from deepflow_tpu.runtime.tracing import default_tracer
         self._tracer = default_tracer()
 
@@ -225,20 +245,27 @@ class ShadowAuditor:
 
     # -- window close ------------------------------------------------------
     def close_window(self, out, degraded: bool = False,
-                     lossy: bool = False) -> Optional[dict]:
+                     lossy: bool = False,
+                     detection: Optional[dict] = None) -> Optional[dict]:
         """Compare the settled window output against the exact shadow,
         emit gauges, advance the alarm ladder, reset the shadow. The
         sanctioned device sync of this module: window-output leaves may
         still be device arrays and are materialized HERE, at the same
         boundary flush_window already fetches them. ``out`` may be None
         (error/empty window) — the shadow still resets and the window
-        is counted untrusted."""
+        is counted untrusted. ``detection`` is the anomaly plane's
+        entropy-DDoS verdict for the window
+        (AnomalyPlane.last_entropy_verdict) — when present, the shadow
+        audits detection precision/recall the way it audits sketch
+        error (ISSUE 15)."""
         with self._lock:
-            snap = self._close_window_locked(out, degraded, lossy)
+            snap = self._close_window_locked(out, degraded, lossy,
+                                             detection)
         return snap
 
-    def _close_window_locked(self, out, degraded: bool,
-                             lossy: bool) -> Optional[dict]:
+    def _close_window_locked(self, out, degraded: bool, lossy: bool,
+                             detection: Optional[dict] = None
+                             ) -> Optional[dict]:
         self.windows += 1
         clipped = self._clipped
         snap = {
@@ -259,6 +286,9 @@ class ShadowAuditor:
             self.clipped_windows += 1
         if out is not None and self._window_rows > 0:
             snap.update(self._compare(out))
+        if detection is not None:
+            snap.update(self._close_detection_locked(
+                detection, degraded=degraded, lossy=lossy))
         self._emit_gauges(snap)
         # alarm ladder: only clean windows (device lane, no counted
         # loss, unclipped shadow, enough sample) advance it — a degraded
@@ -289,6 +319,75 @@ class ShadowAuditor:
         self._shard_rows = [0] * self.shards
         self.last_window = snap
         return snap
+
+    def _shadow_entropies(self) -> Optional[np.ndarray]:
+        """Normalized Shannon entropies of the shadow's hashed-bucket
+        histograms (the same formula _compare reads) — None when the
+        window sampled nothing."""
+        h = self._ent.astype(np.float64)
+        total = h.sum(axis=1, keepdims=True)
+        if not (total > 0).any():
+            return None
+        p = h / np.maximum(total, 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xlogx = np.where(p > 0, p * np.log(p), 0.0)
+        return np.where(total[:, 0] > 0,
+                        -xlogx.sum(axis=1) / np.log(self._buckets), 0.0)
+
+    def _close_detection_locked(self, detection: dict, degraded: bool,
+                                lossy: bool) -> dict:
+        """One window of the detection audit: the shadow prices ITS
+        exact entropies with the twin scorer over its own EWMA baseline
+        (same running-average warmup as the device plane), and clean
+        windows advance the confusion matrix against the device
+        verdict."""
+        from deepflow_tpu.anomaly.detectors import ddos_score_np
+
+        res: dict = {}
+        ent = self._shadow_entropies()
+        if ent is None:
+            return res
+        w = self._det_windows
+        z = (ent - self._det_mean) / np.sqrt(
+            np.maximum(self._det_var, 1e-4))
+        score = ddos_score_np(z)
+        threshold = float(detection.get("threshold", 4.0))
+        warm = w >= int(detection.get("warmup_windows", 8))
+        truth = warm and score >= threshold
+        pred = bool(detection.get("alerted"))
+        res["detection_shadow_score"] = round(float(score), 4)
+        res["detection_truth"] = truth
+        res["detection_pred"] = pred
+        eligible = (bool(detection.get("eligible")) and warm
+                    and not degraded and not lossy and not self._clipped
+                    and self._window_sampled >= self.min_sampled_rows)
+        if eligible:
+            if truth and pred:
+                self.det_tp += 1
+            elif truth:
+                self.det_fn += 1
+            elif pred:
+                self.det_fp += 1
+            else:
+                self.det_tn += 1
+        # baseline advancement mirrors the device plane: running
+        # average while young, EWMA after, and an alerting (truth)
+        # window never updates its own baseline
+        if not truth:
+            a = max(float(detection.get("ewma_alpha", 0.05)),
+                    1.0 / (w + 1.0))
+            self._det_mean = (1 - a) * self._det_mean + a * ent
+            self._det_var = (1 - a) * self._det_var \
+                + a * (ent - self._det_mean) ** 2
+        self._det_windows += 1
+        if self.det_tp + self.det_fp:
+            res["detection_precision"] = round(
+                self.det_tp / (self.det_tp + self.det_fp), 4)
+        if self.det_tp + self.det_fn:
+            res["detection_recall"] = round(
+                self.det_tp / (self.det_tp + self.det_fn), 4)
+        self.last_detection = res
+        return res
 
     def _compare(self, out) -> dict:
         """Exact-vs-sketch comparison for one window. All inputs are
@@ -394,7 +493,11 @@ class ShadowAuditor:
                             "tpu_audit_hll_eps_headroom"),
                            ("entropy_abs_error",
                             "tpu_audit_entropy_abs_error"),
-                           ("topk_recall", "tpu_audit_topk_recall")):
+                           ("topk_recall", "tpu_audit_topk_recall"),
+                           ("detection_precision",
+                            "tpu_audit_detection_precision"),
+                           ("detection_recall",
+                            "tpu_audit_detection_recall")):
             if key in snap:
                 tr.gauge(gauge, float(snap[key]))
 
@@ -415,7 +518,17 @@ class ShadowAuditor:
                 "alarm_trips": self.alarm_trips,
                 "consecutive_violations": self._violations,
                 "shadow_keys": len(self._counts),
+                "detection_tp": self.det_tp,
+                "detection_fp": self.det_fp,
+                "detection_fn": self.det_fn,
+                "detection_tn": self.det_tn,
             }
+            if self.det_tp + self.det_fp:
+                c["detection_precision"] = round(
+                    self.det_tp / (self.det_tp + self.det_fp), 4)
+            if self.det_tp + self.det_fn:
+                c["detection_recall"] = round(
+                    self.det_tp / (self.det_tp + self.det_fn), 4)
             last = self.last_window
         if last is not None:
             for key in ("cms_rel_error", "hll_rel_error",

@@ -34,6 +34,12 @@ Sites wired in this tree (grep for `FAULT_` constants at the call site):
 - ``shard.lost``          — kill a pod shard's worker mid-epoch
   (simulated host loss: unsnapshotted rows counted lost, the shard
   rejoins by bus snapshot at an epoch boundary)
+- ``anomaly.score``       — raise inside the anomaly plane's window
+  scoring step (deepflow_tpu/anomaly/detectors.py): the window closes
+  UNSCORED — counted (``windows_unscored``), never silently skipped —
+  and a latent above-threshold excursion is detected at the next
+  scored window with its latency honestly > 0
+  (``anomaly_detect_latency_windows``)
 
 Cost discipline: the registry is OFF by default and every call site
 guards on the module-level ``default_faults().enabled`` flag (one
@@ -67,7 +73,7 @@ __all__ = ["FaultSite", "FaultRegistry", "default_faults",
            "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN",
            "FAULT_SPILL_WRITE", "FAULT_SENDER_DISCONNECT",
            "FAULT_SHARD_DEVICE_ERROR", "FAULT_MERGE_STALL",
-           "FAULT_SHARD_LOST", "ALL_FAULT_SITES"]
+           "FAULT_SHARD_LOST", "FAULT_ANOMALY_SCORE", "ALL_FAULT_SITES"]
 
 FAULT_RECEIVER_TRUNCATE = "receiver.truncate"
 FAULT_QUEUE_STALL = "queue.stall"
@@ -80,6 +86,7 @@ FAULT_SENDER_DISCONNECT = "sender.disconnect"
 FAULT_SHARD_DEVICE_ERROR = "shard.device_error"
 FAULT_MERGE_STALL = "merge.stall"
 FAULT_SHARD_LOST = "shard.lost"
+FAULT_ANOMALY_SCORE = "anomaly.score"
 
 # every registered site string in one machine-readable tuple, derived
 # (never hand-listed) from the FAULT_* constants above. Two consumers

@@ -189,6 +189,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                  pod_shards: int = 0,
                  pod_merge_deadline_s: float = 5.0,
                  audit_rate: float = 0.0,
+                 anomaly=None,
+                 anomaly_dir: Optional[str] = None,
                  stats: Optional[StatsRegistry] = None) -> None:
         super().__init__("tpu_sketch", ["l4_flow_log"], n_workers=1,
                          batch=64, stats=stats)
@@ -468,6 +470,22 @@ class TpuSketchExporter(QueueWorkerExporter):
             self._audit = ShadowAuditor(self.cfg, rate=self.audit_rate)
             if stats is not None:
                 stats.register("tpu_sketch_accuracy", self._audit.counters)
+        # -- anomaly plane (deepflow_tpu/anomaly/, ISSUE 15) ---------------
+        # The detection lane beside the sketch lane: a device-resident
+        # active-flow table fed per batch from the SAME device arrays
+        # the sketch update transfers (zero extra h2d), plus one jitted
+        # window step per flush (entropy-DDoS z-scores, streaming-PCA
+        # residual, matrix-profile discord). Its state is a separate
+        # pytree — sketch state is bit-identical with the plane on or
+        # off (tests/test_anomaly.py). `anomaly` is an AnomalyConfig,
+        # or True for defaults; None disables.
+        self._anomaly = None
+        if anomaly:
+            from deepflow_tpu.anomaly import AnomalyConfig, AnomalyPlane
+            acfg = anomaly if isinstance(anomaly, AnomalyConfig) \
+                else AnomalyConfig()
+            self._anomaly = AnomalyPlane(acfg, directory=anomaly_dir,
+                                         stats=stats)
 
     # -- exporter lifecycle ------------------------------------------------
     def start(self) -> None:
@@ -555,6 +573,14 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # which every flush drains first), so rows_in is a
                 # processed-watermark, not an arrival count
                 self.rows_in += len(next(iter(schema_cols.values())))
+                if self._anomaly is not None:
+                    # conservation mirror: the detection lane's
+                    # rows_seen moves at the SAME boundary rows_in
+                    # does, so `anomaly.rows_seen == rows_in` is an
+                    # exact scrape-time invariant (the ci.sh anomaly
+                    # smoke asserts it through a mid-attack fault)
+                    self._anomaly.observe_rows(
+                        len(next(iter(schema_cols.values()))))
                 if self._audit is not None:
                     # exact-shadow mirror at the SAME boundary rows_in
                     # moves: the audit window and the sketch window see
@@ -699,6 +725,10 @@ class TpuSketchExporter(QueueWorkerExporter):
             logging.getLogger(__name__).warning(
                 "tpu_sketch degraded: host-numpy fallback at 1/%d rate",
                 self.host_stride)
+        if self._anomaly is not None:
+            # the anomaly state may ride the same dead device chain:
+            # re-init the table (counted), window counter preserved
+            self._anomaly.device_lost()
 
     def _restore_device_state_locked(self) -> None:
         """Rebuild device-resident state: latest compatible checkpoint
@@ -779,10 +809,15 @@ class TpuSketchExporter(QueueWorkerExporter):
                     self.state, self._dict_state = self._timed_update(
                         "news", self._update_news,
                         self.state, self._dict_state, plane_d, nn)
+                    if self._anomaly is not None:
+                        self._anomaly.feed_news(plane_d, nn)
                 else:
                     self.state = self._timed_update(
                         "hits", self._update_hits,
                         self.state, self._dict_state, plane_d, nn)
+                    if self._anomaly is not None:
+                        self._anomaly.feed_hits(
+                            self._dict_state.table, plane_d, nn)
             return
         n = tb.valid
         mask_d = self._to_device(tb.mask(), n)
@@ -791,11 +826,17 @@ class TpuSketchExporter(QueueWorkerExporter):
                       for k, v in tb.columns.items()}
             self.state = self._timed_update(
                 "staged", self._update, self.state, cols_d, mask_d)
+            if self._anomaly is not None:
+                self._anomaly.feed_cols(cols_d, mask_d)
             return
         lanes = flow_suite.pack_lanes(tb.columns)
         lanes_d = {k: self._to_device(v, n) for k, v in lanes.items()}
         self.state = self._timed_update(
             "packed", self._update, self.state, lanes_d, mask_d)
+        if self._anomaly is not None:
+            # the active-flow working set eats the SAME device arrays
+            # the sketch update just consumed — no second transfer
+            self._anomaly.feed_lanes(lanes_d, mask_d)
 
     # -- overlapped feed (runtime/feed.py) ---------------------------------
     # Everything below runs on the FEED THREAD. It never takes
@@ -899,6 +940,8 @@ class TpuSketchExporter(QueueWorkerExporter):
                                           for tb, _ in group))
         self.state, fence = self._timed_update(
             f"lanes_x{K}", prog, self.state, flat_d)
+        if self._anomaly is not None:
+            self._anomaly.feed_flat(flat_d, K, C)
         return fence, flat
 
     def _dispatch_dict_group(self, group):
@@ -927,6 +970,9 @@ class TpuSketchExporter(QueueWorkerExporter):
         key = "dict:" + "+".join(f"{k[0]}{w}" for k, w in sig)
         self.state, self._dict_state, fence = self._timed_update(
             key, prog, self.state, self._dict_state, flat_d)
+        if self._anomaly is not None:
+            self._anomaly.feed_dict_flat(self._dict_state.table,
+                                         flat_d, sig)
         return fence, flat
 
     def _feed_process_staged(self, group) -> Optional["InFlight"]:
@@ -959,6 +1005,8 @@ class TpuSketchExporter(QueueWorkerExporter):
             flat_d = self._to_device(sg.flat, sg.valid)
             self.state, fence = self._timed_update(
                 f"lanes_x{sg.k}", prog, self.state, flat_d)
+            if self._anomaly is not None:
+                self._anomaly.feed_flat(flat_d, sg.k, sg.capacity)
         if tr.enabled and self._detailed:
             tr.gauge("tpu_transfers_per_batch",
                      (self.h2d_transfers - before)
@@ -1076,6 +1124,13 @@ class TpuSketchExporter(QueueWorkerExporter):
         the single-chip lane — Ingester.health reads shard states
         through this."""
         return self._pod
+
+    @property
+    def anomaly(self):
+        """The anomaly plane (deepflow_tpu/anomaly/), or None when the
+        detection lane is off — the Ingester wires the Exporters
+        fan-out and serving mounts the alert bus through this."""
+        return self._anomaly
 
     @property
     def audit_alarm(self) -> bool:
@@ -1232,6 +1287,16 @@ class TpuSketchExporter(QueueWorkerExporter):
                     # classification + recovery as a batch failure
                     self._on_device_error_locked(0)
                     out = None
+            if self._anomaly is not None:
+                # anomaly plane (ISSUE 15): score the settled window
+                # BEFORE the audit closes so the detection audit can
+                # compare the device verdict against the exact shadow's
+                # twin scorer. Publication happens after the lock
+                # releases (publish_pending below) — bus subscribers
+                # and the exporter fan-out are emissions.
+                self._anomaly.close_window(
+                    out, now=now, lossy=self._window_lost_counted,
+                    degraded=was_degraded)
             if self._audit is not None:
                 # accuracy observatory: compare the settled window
                 # against the exact shadow AT the window boundary (same
@@ -1241,11 +1306,16 @@ class TpuSketchExporter(QueueWorkerExporter):
                 # tagged instead of alarmed on.
                 self._audit.close_window(
                     out, degraded=was_degraded,
-                    lossy=self._window_lost_counted)
+                    lossy=self._window_lost_counted,
+                    detection=None if self._anomaly is None
+                    else self._anomaly.last_entropy_verdict)
             # the lost-window guard resets at the TRUE window boundary —
             # after the flush attempt — so a window where both a
             # replayed batch and the readback die counts ONCE
             self._window_lost_counted = False
+        if self._anomaly is not None:
+            # NO lock held: alert fan-out + bus publish + gauges
+            self._anomaly.publish_pending()
         self._prof.record("window", "flush",
                           time.perf_counter() - t_flush)
         if out is None:
@@ -1267,13 +1337,31 @@ class TpuSketchExporter(QueueWorkerExporter):
                 self._pod_submit_locked(tb)
             self.windows += 1
             res = self._pod.close_epoch(now=now)
+            if self._anomaly is not None:
+                # the pod lane scores the MERGED epoch output; the
+                # active-flow features read 0 there (shard batches
+                # never cross this process's device) and the alert
+                # inherits the epoch's participation tags so a
+                # reduced-participation detection says so
+                self._anomaly.close_window(
+                    res.out, now=now, lossy=res.lossy,
+                    degraded=bool(res.degraded),
+                    participation={
+                        k: res.tags[k]
+                        for k in ("pod_shards_participated",
+                                  "pod_shards", "pod_missing")
+                        if k in res.tags})
             if self._audit is not None:
                 # epochs that excluded a shard (straggler/kill) or
                 # counted loss are tagged lossy/degraded — the accuracy
                 # alarm never fires on shard-loss variance (ISSUE 10)
-                self._audit.close_window(res.out,
-                                         degraded=bool(res.degraded),
-                                         lossy=res.lossy)
+                self._audit.close_window(
+                    res.out, degraded=bool(res.degraded),
+                    lossy=res.lossy,
+                    detection=None if self._anomaly is None
+                    else self._anomaly.last_entropy_verdict)
+        if self._anomaly is not None:
+            self._anomaly.publish_pending()   # NO lock held
         return res.out
 
     def _write_output(self, out: flow_suite.FlowWindowOutput,
@@ -1372,4 +1460,13 @@ class TpuSketchExporter(QueueWorkerExporter):
             # `tpu_sketch_accuracy` Countable (runtime/audit.py)
             c["audit_alarm"] = 1 if self._audit.alarm else 0
             c["audit_windows"] = self._audit.windows
+        if self._anomaly is not None:
+            # headline conservation terms only — the full family is
+            # the separate `anomaly` Countable (anomaly/alerts.py);
+            # rows_seen here against rows_in above is the detection
+            # lane's conservation check in ONE scrape
+            c["anomaly_rows_seen"] = self._anomaly.rows_seen
+            c["anomaly_alerts"] = sum(self._anomaly.alerts_total)
+            c["anomaly_windows_unscored"] = \
+                self._anomaly.windows_unscored
         return c

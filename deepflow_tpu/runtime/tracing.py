@@ -92,6 +92,28 @@ GAUGE_HELP: Dict[str, str] = {
                               "at the last window close",
     "tpu_audit_degraded_window": "1 when the last audited window ran "
                                  "on the degraded host-fallback lane",
+    "tpu_audit_detection_precision": "clean-window precision of the "
+                                     "anomaly plane's entropy-DDoS "
+                                     "verdict vs the exact shadow's "
+                                     "twin scorer (advisory below "
+                                     "full audit rate)",
+    "tpu_audit_detection_recall": "clean-window recall of the anomaly "
+                                  "plane's entropy-DDoS verdict vs "
+                                  "the exact shadow's twin scorer",
+    # the ISSUE 15 anomaly plane (deepflow_tpu/anomaly/): detection
+    # lane health beside the sketch lane
+    "anomaly_score": "max detector score at the last window close "
+                     "(z units for entropy/PCA, z-normalized distance "
+                     "for the matrix profile)",
+    "anomaly_alerts_total": "cumulative alerts emitted across all "
+                            "detectors since start",
+    "anomaly_detect_latency_windows": "windows between the last "
+                                      "alert's excursion onset and its "
+                                      "first emission (> 0 only when "
+                                      "unscored windows intervened)",
+    "anomaly_active_flows": "active-flow working-set slots seen in the "
+                            "last closed window (device-resident "
+                            "table, LRU-by-window)",
     # the ISSUE 7 sketch-serving read path (serving/tables.py): read
     # traffic answered from the in-process snapshot cache — these are
     # the dashboard-QPS acceptance gauges

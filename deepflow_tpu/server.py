@@ -125,6 +125,7 @@ class Server:
         q_cfg = c.get("querier", {})
         self.querier = None
         self.sketch_tables = None
+        self.anomaly_tables = None
         if q_cfg.get("enabled", True) and self.ingester.store is not None:
             # ISSUE 7 serving read path: when the tpu_sketch lane runs,
             # mount its snapshot bus as the `sketch` datasource — SQL
@@ -142,13 +143,28 @@ class Server:
                 self.sketch_tables.register_datasource()
                 self.ingester.stats.register("serving",
                                              self.sketch_tables.counters)
+                # ISSUE 15 anomaly plane: when the detection lane runs,
+                # mount its alert bus as the `anomaly` datasource —
+                # SELECT * FROM anomaly / anomaly_score{detector=...}
+                # answer from the same snapshot-cache posture
+                if self.ingester.tpu_sketch.anomaly is not None:
+                    from deepflow_tpu.serving import AnomalyTables
+                    acache = SnapshotCache(
+                        self.ingester.tpu_sketch.anomaly.bus,
+                        max_staleness_s=q_cfg.get(
+                            "sketch_max_staleness_s", 5.0))
+                    self.anomaly_tables = AnomalyTables(acache)
+                    self.anomaly_tables.register_datasource()
+                    self.ingester.stats.register(
+                        "serving_anomaly", self.anomaly_tables.counters)
             self.querier = QuerierServer(
                 self.ingester.store, self.ingester.tag_dicts,
                 port=q_cfg.get("port", 20416),
                 host=q_cfg.get("host", "127.0.0.1"),
                 tagrecorder=self.tagrecorder,
                 external_apm=q_cfg.get("external_apm", []),
-                sketch=self.sketch_tables)
+                sketch=self.sketch_tables,
+                anomaly=self.anomaly_tables)
 
         self.stats_shipper = None
         if c.get("self_telemetry", True):
@@ -226,6 +242,11 @@ class Server:
             self.trident_grpc = None
         if self.querier is not None:
             self.querier.close()
+        if self.anomaly_tables is not None:
+            self.anomaly_tables.unregister_datasource()
+            self.anomaly_tables.cache.close()
+            self.ingester.stats.deregister("serving_anomaly")
+            self.anomaly_tables = None
         if self.sketch_tables is not None:
             self.sketch_tables.unregister_datasource()
             self.sketch_tables.cache.close()

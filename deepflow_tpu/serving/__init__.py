@@ -14,5 +14,6 @@ traffic never syncs the device and never touches the feed/drain hot path
 
 from deepflow_tpu.serving.cache import SnapshotCache
 from deepflow_tpu.serving.tables import SketchTables
+from deepflow_tpu.serving.anomaly import AnomalyTables
 
-__all__ = ["SnapshotCache", "SketchTables"]
+__all__ = ["SnapshotCache", "SketchTables", "AnomalyTables"]

@@ -1,0 +1,557 @@
+"""ISSUE 15: the anomaly plane — entropy-DDoS + streaming-PCA +
+matrix-profile detection as a first-class, durable, queryable lane.
+
+Contracts under test: the DDoS ramp profile is deterministic and the
+entropy detector catches it within <= 2 windows of onset (entropy
+collapse on dst / dispersion on src under spoofing); the PCA residual
+spikes on a golden-signal shift and the matrix profile flags a
+latency-plateau discord; the anomaly lane is BIT-INVISIBLE to sketch
+state (leaf-by-leaf vs a detectors-off twin on every wire); degraded /
+unscored windows are tagged and counted, never silent; and alerts
+round-trip through SQL, PromQL and the /metrics gauges."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.anomaly import (AnomalyConfig, AnomalyPlane, DETECTORS)
+from deepflow_tpu.anomaly import detectors
+from deepflow_tpu.models.flow_suite import FlowSuiteConfig, FlowWindowOutput
+from deepflow_tpu.replay.generator import DDOS_RAMP_PHASES, ddos_ramp
+from deepflow_tpu.runtime.faults import default_faults
+from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+from deepflow_tpu.runtime.tracing import default_tracer, gauge_help
+
+CFG = FlowSuiteConfig()
+ACFG = AnomalyConfig(warmup_windows=4, mp_length=64)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    default_faults().disarm()
+    yield
+    default_faults().disarm()
+
+
+def _exporter(anomaly=None, **kw):
+    kw.setdefault("wire", "lanes")
+    kw.setdefault("batch_rows", 4096)
+    return TpuSketchExporter(cfg=CFG, store=None, window_seconds=3600,
+                             anomaly=anomaly, **kw)
+
+
+# ------------------------------------------------------- ddos_ramp profile
+
+def test_ddos_ramp_deterministic_and_shaped():
+    ramp = ddos_ramp(seed=11)
+    assert ramp.n_windows == sum(p.windows for p in DDOS_RAMP_PHASES)
+    assert ramp.onset_window == 12
+    name, a = ramp.window_cols(14)
+    assert name == "ramp"
+    _, b = ddos_ramp(seed=11).window_cols(14)
+    assert all((a[k] == b[k]).all() for k in a)   # per-window determinism
+    # attack rows aim at the single victim
+    frac = (a["ip_dst"] == ramp.victim_ip).mean()
+    assert 0.5 < frac <= 0.95
+    # a different seed is a different stream
+    _, c = ddos_ramp(seed=12).window_cols(14)
+    assert (a["ip_src"] != c["ip_src"]).any()
+
+
+def test_ddos_ramp_metric_documents_parse():
+    from deepflow_tpu.wire.gen import metric_pb2
+    ramp = ddos_ramp(seed=5)
+    _, cols = ramp.window_cols(13)
+    traffic = ramp.golden_traffic(cols)
+    assert traffic["new_flow"] == len(cols["ip_src"])
+    assert traffic["packet_tx"] == int(cols["packet_tx"].sum())
+    (blob,) = ramp.metric_documents(13)
+    d = metric_pb2.Document()
+    d.ParseFromString(blob)
+    assert d.meter.flow.traffic.packet_tx == traffic["packet_tx"]
+
+
+# --------------------------------------------------------- ramp detection
+
+def test_entropy_ddos_detected_within_two_windows():
+    """Spoofed ramp: src-ip entropy rises, dst-ip entropy collapses on
+    the victim; the entropy detector alerts within <= 2 windows of
+    attack onset with the collapse visible in the z vector."""
+    ramp = ddos_ramp(seed=7)
+    exp = _exporter(anomaly=AnomalyConfig())
+    first_alert = None
+    z_at_alert = None
+    try:
+        for w, _phase, cols in ramp.windows():
+            exp.process([("l4_flow_log", 0, cols, -1)])
+            exp.flush_window(now=1000.0 + w)
+            plane = exp.anomaly
+            if first_alert is None and plane.alerts_total[0]:
+                first_alert = w
+                snap = plane.bus.latest()
+                z_at_alert = np.asarray(snap.leaves[2])
+            if w > ramp.onset_window + 3:
+                break
+    finally:
+        exp.close()
+    assert first_alert is not None, "entropy_ddos never fired"
+    assert first_alert - ramp.onset_window <= 2, \
+        (first_alert, ramp.onset_window)
+    # the classic signature: source dispersion UP, destination entropy
+    # DOWN (ip_dst and port_dst both collapse onto the victim)
+    assert z_at_alert[0] > 0, z_at_alert       # ip_src dispersion
+    assert z_at_alert[1] < 0, z_at_alert       # ip_dst collapse
+    # conservation through the detection lane
+    assert exp.anomaly.rows_seen == exp.rows_in
+    assert exp.anomaly.table_offers == exp.rows_in
+
+
+def _out(rows, ent, card=100.0, top1=50):
+    k = CFG.top_k
+    counts = np.zeros(k, np.int32)
+    counts[0] = top1
+    return FlowWindowOutput(
+        topk_keys=np.zeros(k, np.uint32),
+        topk_counts=counts,
+        service_cardinality=np.asarray([card], np.float32),
+        entropies=np.asarray(ent, np.float32),
+        rows=np.asarray(rows, np.int32))
+
+
+def test_pca_residual_spikes_on_golden_signal_shift():
+    """A correlated-structure break (rows surge 16x while distinct
+    clients COLLAPSE and the heavy head concentrates — entropies held
+    flat, so the DDoS detector stays quiet) must show up as a PCA
+    residual spike: the shift is orthogonal to the tracked subspace
+    the calm rows/cardinality/head correlation spans."""
+    plane = AnomalyPlane(ACFG)
+    rng = np.random.default_rng(3)
+    ent = np.asarray([0.82, 0.55, 0.9, 0.3])
+    for w in range(40):
+        rows = 4000 + int(rng.integers(-200, 200))
+        plane.close_window(
+            _out(rows, ent + rng.normal(0, 0.003, 4),
+                 card=rows / 40.0, top1=rows // 80),
+            now=100.0 + w)
+        plane.publish_pending()
+    assert abs(plane.last_scores[1]) < ACFG.pca_z   # calm baseline
+    assert plane.alerts_total[1] == 0
+    plane.close_window(
+        _out(64000, ent + rng.normal(0, 0.003, 4),
+             card=10.0, top1=8000), now=200.0)
+    plane.publish_pending()
+    assert plane.last_scores[1] >= ACFG.pca_z, plane.last_scores
+    assert plane.last_scores[0] < ACFG.entropy_z    # DDoS stayed quiet
+    assert plane.alerts_total[1] >= 1
+
+
+def test_mp_discord_on_latency_plateau():
+    """A periodic signal flattening into a plateau is a time-SHAPE
+    anomaly: the newest subsequence has no good neighbor in history
+    and the matrix-profile detector flags the discord."""
+    # m=16: a fully-flat subsequence prices at sqrt(m)=4 against a
+    # varying history (the zero-variance convention), clearing the
+    # default 3.0 threshold — the plateau-length vs responsiveness
+    # trade the mp_m knob owns
+    plane = AnomalyPlane(AnomalyConfig(warmup_windows=4, mp_length=64,
+                                       mp_m=16, entropy_z=1e9,
+                                       pca_z=1e9))
+    rng = np.random.default_rng(5)
+    PLATEAU = 80
+    settled_alerts = None
+    fired_at = None
+    for w in range(PLATEAU + 20):
+        if w < PLATEAU:
+            # periodic load: rows oscillate (the ring sees real shape;
+            # by w=64 the full ring holds ~4 periods, so every phase
+            # has a genuine neighbor and the profile settles)
+            rows = 4000 + int(2000 * np.sin(w / 3.0)) \
+                + int(rng.integers(-100, 100))
+        else:
+            rows = 6500                      # the plateau
+        plane.close_window(_out(rows, [0.8, 0.5, 0.9, 0.3],
+                                card=rows / 40.0, top1=rows // 80),
+                           now=100.0 + w)
+        plane.publish_pending()
+        if w == PLATEAU - 1:
+            settled_alerts = plane.alerts_total[2]
+        if w >= PLATEAU and fired_at is None \
+                and plane.alerts_total[2] > settled_alerts:
+            fired_at = w
+    # the settled periodic baseline is quiet over its last stretch and
+    # the plateau is the discord that fires
+    assert fired_at is not None and fired_at >= PLATEAU, \
+        (fired_at, settled_alerts)
+
+
+# -------------------------------------------------------- bit-invisibility
+
+@pytest.mark.parametrize("kw", [
+    dict(wire="lanes"),
+    dict(wire="dict"),
+    dict(wire="lanes", prefetch_depth=2, zero_copy=True),
+])
+def test_sketch_state_bit_identical_with_plane_on(kw):
+    ramp = ddos_ramp(seed=9, rows_per_window=2048)
+    ref = _exporter(anomaly=None, **kw)
+    dut = _exporter(anomaly=ACFG, **kw)
+    try:
+        for w, _phase, cols in ramp.windows():
+            if w >= 16:
+                break
+            for exp in (ref, dut):
+                exp.process([("l4_flow_log", 0, cols, -1)])
+            ref.flush_window(now=1000.0 + w)
+            dut.flush_window(now=1000.0 + w)
+        ra = jax.tree_util.tree_leaves(ref.state)
+        rb = jax.tree_util.tree_leaves(dut.state)
+        assert all((np.asarray(x) == np.asarray(y)).all()
+                   for x, y in zip(ra, rb))
+        assert dut.anomaly.rows_seen == dut.rows_in
+    finally:
+        ref.close()
+        dut.close()
+
+
+# ------------------------------------------------ active-flow working set
+
+def test_active_flow_table_lru_by_window():
+    cfg = AnomalyConfig(active_log2=8)
+    st = detectors.init(cfg)
+    keys = jnp.arange(1000, 1016, dtype=jnp.uint32)
+    mask = jnp.ones(16, bool)
+    st = detectors.offer(st, keys, mask, cfg)
+    active = int((np.asarray(st.last_window) == 0).sum())
+    assert active == 16                       # all admitted, window 0
+    assert int(st.offers) == 16 and int(st.evictions) == 0
+    # same keys again in the same window: no evictions, same slots
+    st = detectors.offer(st, keys, mask, cfg)
+    assert int(st.evictions) == 0
+    assert int((np.asarray(st.last_window) == 0).sum()) == 16
+    # next window: a colliding NEW key displaces only stale occupants
+    st = st._replace(window=st.window + 1)
+    nkeys = jnp.arange(5000, 5016, dtype=jnp.uint32)
+    st = detectors.offer(st, nkeys, mask, cfg)
+    seen_now = int((np.asarray(st.last_window) == 1).sum())
+    assert seen_now >= 1
+    born = np.asarray(st.born)[np.asarray(st.last_window) == 1]
+    assert (born == 1).all()                  # all newcomers this window
+
+
+def test_active_flow_occupant_wins_same_window():
+    cfg = AnomalyConfig(active_log2=2)        # 4 slots: forced collisions
+    st = detectors.init(cfg)
+    a = jnp.arange(0, 64, dtype=jnp.uint32)
+    st = detectors.offer(st, a, jnp.ones(64, bool), cfg)
+    keys_after = np.asarray(st.keys).copy()
+    # a second wave the SAME window cannot displace live occupants
+    b = jnp.arange(100, 164, dtype=jnp.uint32)
+    st = detectors.offer(st, b, jnp.ones(64, bool), cfg)
+    still = np.asarray(st.keys)
+    assert (still == keys_after).all()
+
+
+# ------------------------------------------------- faults + degraded mode
+
+def test_anomaly_score_fault_counted_and_latency_honest():
+    """anomaly.score sheds ONE window's scoring (counted); the latent
+    excursion is detected at the next scored window with latency > 0
+    — never silently skipped."""
+    ramp = ddos_ramp(seed=7)
+    # shed the scoring of the ONSET window itself: the excursion is
+    # latent through the shed window and the first alert carries it
+    default_faults().arm("anomaly.score", count=1,
+                         match=f"window{ramp.onset_window}")
+    exp = _exporter(anomaly=AnomalyConfig())
+    try:
+        first = None
+        for w, _phase, cols in ramp.windows():
+            exp.process([("l4_flow_log", 0, cols, -1)])
+            exp.flush_window(now=1000.0 + w)
+            if first is None and exp.anomaly.alerts_total[0]:
+                first = w
+                break
+        plane = exp.anomaly
+        assert plane.windows_unscored == 1
+        assert plane.score_errors == 1
+        assert first is not None
+        # the shed window sat inside the excursion: latency counts it
+        assert plane.last_latency_windows >= 1
+        assert plane.rows_seen == exp.rows_in   # conservation holds
+    finally:
+        exp.close()
+
+
+def test_device_error_mid_attack_tagged_never_lost():
+    """A device error mid-attack rolls the sketch back (lossy window);
+    the anomaly snapshot carries the tag, detection continues, and
+    nothing in the detection lane is silently dropped."""
+    ramp = ddos_ramp(seed=7)
+    onset = ramp.onset_window
+    # one batch crosses the site per baseline window and ramp windows
+    # emit 2: `after = onset + 4` lands the error at ~window 14 —
+    # MID-attack, after the first alert already fired at the onset
+    default_faults().arm("tpu.device_error", count=1, after=onset + 4)
+    exp = _exporter(anomaly=AnomalyConfig())
+    try:
+        lossy_seen = False
+        for w, _phase, cols in ramp.windows():
+            exp.process([("l4_flow_log", 0, cols, -1)])
+            exp.flush_window(now=1000.0 + w)
+            snap = exp.anomaly.bus.latest()
+            if snap is not None and snap.tags.get("lossy"):
+                lossy_seen = True
+            if w >= onset + 4:
+                break
+        plane = exp.anomaly
+        assert exp.lost_rows > 0                 # the fault really fired
+        assert lossy_seen                        # tagged, not hidden
+        assert plane.alerts_total[0] >= 1        # detection survived
+        assert plane.rows_seen == exp.rows_in
+        # every closed window is accounted: scored or counted unscored
+        assert plane.windows == exp.windows
+    finally:
+        exp.close()
+
+
+def test_feed_error_recovers_donated_state():
+    """A failed feed dispatch has already consumed the DONATED state
+    buffers: the plane must re-init (window preserved) so later feeds
+    and the window step keep working — one counted feed_error, not a
+    cascade."""
+    plane = AnomalyPlane(ACFG)
+    keys = jnp.arange(100, dtype=jnp.uint32)
+    mask = jnp.ones(100, bool)
+    lanes = {"ip_src": keys, "ip_dst": keys, "ports": keys,
+             "proto_pkts": keys}
+    plane.close_window(_out(100, [0.8, 0.5, 0.9, 0.3]), now=1.0)
+    plane.publish_pending()
+
+    def _boom(s, l, m):
+        raise RuntimeError("injected feed failure")
+
+    plane._programs[("lanes", 100)] = _boom
+    plane.feed_lanes(lanes, mask)
+    assert plane.feed_errors == 1
+    assert int(plane.state.window) == plane.windows   # epoch realigned
+    del plane._programs[("lanes", 100)]
+    plane.feed_lanes(lanes, mask)                     # feeds work again
+    assert plane.feed_errors == 1
+    plane.close_window(_out(100, [0.8, 0.5, 0.9, 0.3]), now=2.0)
+    plane.publish_pending()
+    assert plane.windows_unscored == 0                # scoring works too
+
+
+# ------------------------------------------------ alert fan-out + serving
+
+class _RecordingExporter:
+    name = "rec"
+
+    def __init__(self):
+        self.puts = []
+
+    def start(self):
+        pass
+
+    def close(self):
+        pass
+
+    def is_export_data(self, stream, cols):
+        return stream == "anomaly"
+
+    def put(self, stream, idx, cols):
+        self.puts.append((stream, cols))
+
+
+def test_alerts_ride_breaker_wrapped_fanout():
+    from deepflow_tpu.runtime.exporters import Exporters
+    ramp = ddos_ramp(seed=7)
+    exps = Exporters(breaker_cfg=None)
+    rec = _RecordingExporter()
+    exps.register(rec)
+    exp = _exporter(anomaly=AnomalyConfig())
+    exp.anomaly.attach_exporters(exps)
+    try:
+        for w, _phase, cols in ramp.windows():
+            exp.process([("l4_flow_log", 0, cols, -1)])
+            exp.flush_window(now=1000.0 + w)
+            if exp.anomaly.alerts_total[0]:
+                break
+        assert rec.puts, "no alert reached the fan-out"
+        stream, cols = rec.puts[0]
+        assert stream == "anomaly"
+        assert cols["detector"][0] == "entropy_ddos"
+        assert float(cols["score"][0]) >= float(cols["threshold"][0])
+        assert exp.anomaly.alerts_shed == 0
+    finally:
+        exp.close()
+
+
+def _ramp_with_serving(tmp_path, windows=18):
+    """Run the ramp far enough to alert; return (exporter, tables)."""
+    from deepflow_tpu.serving import AnomalyTables, SnapshotCache
+    ramp = ddos_ramp(seed=7)
+    exp = _exporter(anomaly=AnomalyConfig(),
+                    anomaly_dir=str(tmp_path / "anomaly_ckpt"))
+    cache = SnapshotCache(exp.anomaly.bus, max_staleness_s=1e9)
+    tables = AnomalyTables(cache)
+    for w, _phase, cols in ramp.windows():
+        if w >= windows:
+            break
+        exp.process([("l4_flow_log", 0, cols, -1)])
+        exp.flush_window(now=1000.0 + w)
+    return exp, tables
+
+
+def test_alert_roundtrip_sql(tmp_path):
+    from deepflow_tpu.querier.sql import parse_sql
+    exp, tables = _ramp_with_serving(tmp_path)
+    try:
+        res = tables.sql(parse_sql("SELECT * FROM anomaly"))
+        assert res.columns == ["time", "window", "detector", "score",
+                               "threshold", "alert", "latency_windows",
+                               "top_keys", "top_counts", "lossy",
+                               "degraded"]
+        # one row per detector for the latest window
+        assert [r[2] for r in res.values] == list(DETECTORS)
+        alerted = [r for r in res.values if r[5]]
+        assert alerted and alerted[0][3] >= alerted[0][4]
+        assert alerted[0][7], "alert carries top contributing keys"
+        with pytest.raises(ValueError):
+            tables.sql(parse_sql("SELECT score FROM anomaly"))
+    finally:
+        exp.close()
+
+
+def test_alert_roundtrip_promql(tmp_path):
+    from deepflow_tpu.querier.promql import PromEngine
+    from deepflow_tpu.store.db import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    exp, tables = _ramp_with_serving(tmp_path)
+    try:
+        prom = PromEngine(Store(str(tmp_path / "store")),
+                          TagDictRegistry(None), anomaly=tables)
+        out = prom.query('anomaly_score{detector="entropy_ddos"}',
+                         at=1017)
+        assert len(out) == 1
+        assert out[0]["metric"]["detector"] == "entropy_ddos"
+        assert float(out[0]["value"][1]) >= 4.0
+        # matchers filter; unknown detector -> empty
+        assert prom.query('anomaly_score{detector="nope"}', at=1017) == []
+        # composes with the evaluator
+        out = prom.query("max(anomaly_score) > 3", at=1017)
+        assert out
+        out = prom.query('anomaly_alerts_total{detector="entropy_ddos"}',
+                         at=1017)
+        assert float(out[0]["value"][1]) >= 1
+        out = prom.query("anomaly_active_flows", at=1017)
+        assert float(out[0]["value"][1]) > 0
+    finally:
+        exp.close()
+
+
+def test_alerts_durable_across_restart(tmp_path):
+    """Alert windows are fsynced npz on the anomaly bus: a fresh
+    process (fresh bus over the same directory) reads the alerts
+    back — detections survive a crash."""
+    from deepflow_tpu.runtime.snapbus import SnapshotBus
+    exp, _tables = _ramp_with_serving(tmp_path)
+    exp.close()
+    bus = SnapshotBus(str(tmp_path / "anomaly_ckpt"), name="anomaly")
+    snap = bus.read_latest()
+    assert snap is not None
+    assert snap.tags.get("alerts"), "restarted bus lost the alerts"
+    a = snap.tags["alerts"][0]
+    assert a["detector"] in DETECTORS and a["score"] >= a["threshold"]
+
+
+def test_gauges_emitted_and_helped(tmp_path):
+    tracer = default_tracer()
+    was = tracer.enabled
+    tracer.enable()
+    try:
+        exp, _tables = _ramp_with_serving(tmp_path)
+        exp.close()
+        gauges = tracer.gauges()
+        for name in ("anomaly_score", "anomaly_alerts_total",
+                     "anomaly_detect_latency_windows",
+                     "anomaly_active_flows"):
+            assert name in gauges, name
+            assert gauge_help(name), f"{name} missing GAUGE_HELP"
+        assert gauges["anomaly_alerts_total"] >= 1
+    finally:
+        if not was:
+            tracer.disable()
+
+
+def test_datasource_listing_includes_anomaly(tmp_path):
+    from deepflow_tpu.store import rollup
+    exp, tables = _ramp_with_serving(tmp_path, windows=2)
+    tables.register_datasource()
+    try:
+        rows = rollup.external_datasources()
+        mine = [r for r in rows if r.get("table") == "anomaly"]
+        assert mine and mine[0]["detectors"] == list(DETECTORS)
+    finally:
+        tables.unregister_datasource()
+        exp.close()
+
+
+# ------------------------------------------------------- detection audit
+
+def test_shadow_audits_detection_precision_recall():
+    """The auditor scores its EXACT entropies with the twin scorer and
+    accumulates a confusion matrix against the device verdict — the
+    detection analogue of the sketch-error audit."""
+    from deepflow_tpu.runtime.audit import ShadowAuditor
+    aud = ShadowAuditor(CFG, rate=1.0)
+    ramp = ddos_ramp(seed=7, rows_per_window=2048)
+
+    def verdict(alerted):
+        return {"eligible": True, "alerted": alerted, "score": 0.0,
+                "threshold": 4.0, "warmup_windows": 4, "ewma_alpha": 0.05}
+
+    ent = np.asarray([0.8, 0.5, 0.9, 0.3])
+    for w in range(12):                      # calm agreement -> TNs
+        _, cols = ramp.window_cols(w)
+        aud.absorb({k: cols[k] for k in ("ip_src", "ip_dst", "port_src",
+                                         "port_dst", "proto",
+                                         "packet_tx", "packet_rx")})
+        aud.close_window(_out(2048, ent), detection=verdict(False))
+    assert aud.det_tn >= 6 and aud.det_fp == 0
+    # attack windows where the device also alerted -> TPs
+    for w in range(15, 19):
+        _, cols = ramp.window_cols(w)        # sustained attack columns
+        aud.absorb({k: cols[k] for k in ("ip_src", "ip_dst", "port_src",
+                                         "port_dst", "proto",
+                                         "packet_tx", "packet_rx")})
+        aud.close_window(_out(2048, ent), detection=verdict(True))
+    c = aud.counters()
+    assert c["detection_tp"] >= 1, c
+    assert c["detection_precision"] == 1.0
+    assert c["detection_recall"] == 1.0
+
+
+# ---------------------------------------------------------- pod epoch lane
+
+def test_pod_lane_scores_merged_epochs():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    ramp = ddos_ramp(seed=7, rows_per_window=2048)
+    exp = _exporter(anomaly=AnomalyConfig(), pod_shards=2,
+                    batch_rows=2048)
+    try:
+        for w, _phase, cols in ramp.windows():
+            if w >= 6:
+                break
+            exp.process([("l4_flow_log", 0, cols, -1)])
+            exp.flush_window(now=1000.0 + w)
+        plane = exp.anomaly
+        assert plane.windows >= 6
+        snap = plane.bus.latest()
+        assert snap is not None
+        assert "pod_shards_participated" in snap.tags
+    finally:
+        exp.close()

@@ -105,3 +105,32 @@ def test_flat_signal_is_not_a_discord():
     assert float(mp.latest_score(st, 8)[0]) == 0.0
     prof = np.asarray(mp.profile(st, 8))[0]
     assert (prof[np.isfinite(prof)] == 0.0).all()
+
+
+def test_nonfinite_ring_values_never_yield_nan():
+    """ISSUE 15 hardening: an f32-overflowing (or inf-poisoned) ring
+    makes subsequence variance NaN through inf - inf; the zero-variance
+    guard must treat it as a constant subsequence, not poison every
+    neighbor's distance with NaN."""
+    st = mp.init(2, 64)
+    for i in range(40):
+        st = mp.push(st, jnp.asarray([1e20 if i % 2 else 1e19,
+                                      float(i)]))
+    st = mp.push(st, jnp.asarray([float("inf"), 40.0]))
+    for m in (4, 8, 16):
+        prof = np.asarray(mp.profile(st, m))
+        assert not np.isnan(prof).any(), m
+        latest = np.asarray(mp.latest_score(st, m))
+        assert np.isfinite(latest).all(), m
+
+
+def test_constant_series_profile_finite_at_every_fill_level():
+    """A constant series must price flat-vs-flat at 0 (never NaN) at
+    any warm-up level, including a ring still mostly unseen."""
+    for pushes in (1, 7, 16, 64):
+        st = mp.init(1, 64)
+        for _ in range(pushes):
+            st = mp.push(st, jnp.asarray([5.0]))
+        prof = np.asarray(mp.profile(st, 8))
+        assert not np.isnan(prof).any(), pushes
+        assert float(mp.latest_score(st, 8)[0]) == 0.0

@@ -67,3 +67,22 @@ def test_mask_ignores_padding(rng):
     s_clean = pca.update(pca.init(8, 2), jnp.asarray(x))
     s_mask = pca.update(pca.init(8, 2), jnp.asarray(pad), mask=mask)
     assert np.allclose(np.asarray(s_clean.mean), np.asarray(s_mask.mean), atol=1e-3)
+
+
+def test_standardize_var_floor_bounds_quiet_features(rng):
+    """ISSUE 15 hardening: the EMA variance of a (near-)constant
+    feature decays toward 0 — standardization must floor it so a
+    one-count jitter on a dead-quiet signal cannot become a huge z
+    and a phantom residual spike."""
+    s = pca.init(4, 2)
+    x = np.tile(np.asarray([3.0, 7.0, 0.0, 100.0], np.float32), (64, 1))
+    for _ in range(300):
+        s = pca.update(s, jnp.asarray(x))
+    # a tiny jitter on one dead feature
+    x2 = x.copy()
+    x2[:, 2] = 0.01
+    scores = np.asarray(pca.score(s, jnp.asarray(x2)))
+    assert np.isfinite(scores).all()
+    # |z| of the jitter is bounded by jitter/sqrt(floor) = 0.01/1e-2 = 1,
+    # so the residual cannot exceed ~the full z-norm bound
+    assert scores.max() < 2.0, scores.max()
