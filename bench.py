@@ -35,6 +35,11 @@ Numbers, one JSON line:
   (acceptance: < 5% at the default config), detection latency in
   windows from ramp onset, and the rows_seen == rows_in conservation
   verdict.
+- `stage_breakdown.timeline`: the ISSUE 16 self-telemetry sampler tick
+  (Countable scrape + ring appends + recording/SLO rules) measured
+  beside the window close it rides along: median tick cost, series
+  count, and the overhead fraction per window at the default 1 Hz
+  cadence (acceptance: < 1% of window-close time).
 - `topk_recall_vs_exact`: top-100 heavy-hitter recall on the PRODUCTION
   FlowSuiteConfig against an exact host GROUP BY over the stream.
   vs_baseline is against BASELINE.json's 10M records/s.
@@ -1141,6 +1146,7 @@ def main() -> None:
 
     off_s, _, _, _, _ = _anomaly_run(False)
     on_s, first_alert, onset, a_rows, a_rows_in = _anomaly_run(True)
+
     anomaly_stats = {
         "rows_per_window": anomaly_rows,
         "window_close_ms_off": round(off_s * 1e3, 3),
@@ -1153,8 +1159,65 @@ def main() -> None:
     }
     _recover()
 
+    # -- timed: self-telemetry timeline (ISSUE 16) -------------------------
+    # The sampler tick riding beside the window close: one tick per
+    # window at the default 1 Hz cadence, production-shaped rule set
+    # (a recording rule + a ratio SLO burn-rated over both windows).
+    # Acceptance: the tick costs < 1% of window-close time. Median of
+    # the settled ticks: a GC hiccup on one tick must not fake a
+    # sampler regression.
+    _phase("timed: timeline sampler", budget=300.0)
+    from deepflow_tpu.runtime.stats import StatsRegistry
+    from deepflow_tpu.runtime.timeline import (Timeline, RecordingRule,
+                                               SloRule)
+
+    def _timeline_run():
+        ramp = ddos_ramp(seed=7, rows_per_window=anomaly_rows)
+        exp = TpuSketchExporter(
+            cfg=cfg, store=None, window_seconds=3600,
+            batch_rows=anomaly_rows, wire="lanes")
+        t_stats = StatsRegistry()
+        t_stats.register("exporter.tpu_sketch", exp.counters)
+        tl = Timeline(sample_s=1.0, hot_samples=600, coarse_every=10,
+                      stats=t_stats)
+        tl.add_rule(RecordingRule(
+            "sketch_rows_per_s",
+            lambda t, now: t._window_delta("tpu_sketch_rows_in",
+                                           now - 10.0, now) / 10.0))
+        tl.add_slo(SloRule("ingest_availability", objective=0.999,
+                           bad=("tpu_sketch_rows_dropped",),
+                           total=("tpu_sketch_rows_in",)))
+        flush_s, tick_s = [], []
+        try:
+            for w, _name, cols in ramp.windows():
+                exp.process([("l4_flow_log", 0, cols, -1)])
+                t0 = time.perf_counter()
+                out = exp.flush_window(now=1000.0 + w)
+                jax.block_until_ready(
+                    (exp.state, out if out is not None else ()))
+                flush_s.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                tl.sample_once(now=1000.0 + w)
+                tick_s.append(time.perf_counter() - t0)
+        finally:
+            exp.close()
+        return (float(np.median(flush_s[4:])),
+                float(np.median(tick_s[4:])), tl)
+
+    tl_flush_s, tl_tick_s, tl_run = _timeline_run()
+    tl_counters = tl_run.counters()
+    timeline_stats = {
+        "window_close_ms": round(tl_flush_s * 1e3, 3),
+        "sampler_tick_ms": round(tl_tick_s * 1e3, 4),
+        "series": tl_counters["series"],
+        "samples": tl_counters["samples"],
+        "samples_overwritten": tl_counters["samples_overwritten"],
+        "overhead_frac": round(tl_tick_s / max(tl_flush_s, 1e-9), 4),
+    }
+    _recover()
     stage_breakdown = {
         "anomaly": anomaly_stats,
+        "timeline": timeline_stats,
         "serving": serving_stats,
         "pod_merge": pod_stats,
         "feed_overlap": feed_stats,

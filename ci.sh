@@ -1013,6 +1013,158 @@ import os as _os
 _os._exit(0)
 EOF
 
+echo "== blackbox smoke: timeline + SLO burn + incident flight recorder =="
+# ISSUE 16 end-to-end: a live ingester self-samples into the timeline
+# while a seeded exporter.raise fault trips the flaky breaker; the
+# trigger must capture EXACTLY ONE durable incident bundle whose
+# manifest is valid and whose timeline window covers the trigger
+# instant; PromQL (rate over a sketch counter, query_range over the
+# device-busy gauge) and SQL (FROM timeline / FROM incidents) must
+# answer over the live self-metrics through the QuerierServer HTTP
+# routes; and /metrics must carry the slo_burn_rate family with HELP,
+# strictly valid.
+python - <<'EOF'
+import json, os, socket, tempfile, time, urllib.parse, urllib.request
+import numpy as np
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.querier.server import QuerierServer
+from deepflow_tpu.runtime.faults import default_faults
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+class Flaky:
+    name = "flaky"
+    def start(self): pass
+    def close(self): pass
+    def is_export_data(self, stream, cols): return stream == "l4_flow_log"
+    def put(self, stream, idx, cols): pass
+
+store = tempfile.mkdtemp(prefix="blackbox_store_")
+ing = Ingester(IngesterConfig(
+    listen_port=0, prom_port=0, tpu_sketch_window_s=0.5, store_path=store,
+    timeline_sample_s=0.1, breaker_min_calls=2, breaker_open_s=60.0,
+    fault_spec="exporter.raise:p=1.0,for_s=5,match=flaky;seed=7"),
+    platform=PlatformDataManager())
+ing.exporters.register(Flaky())
+ing.start()
+q = QuerierServer(ing.store, ing.tag_dicts, port=0,
+                  timeline=ing.timeline, incidents=ing.incidents)
+q.start()
+
+r = np.random.default_rng(0)
+cols = {name: r.integers(0, 1 << 8, 500).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+sent = 0
+deadline = time.time() + 12.0
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    while time.time() < deadline:
+        s.sendall(frame); sent += 500
+        if (ing.exporters.breakers()["flaky"]["trips"] >= 1
+                and ing.incidents.counters()["captured"] >= 1
+                and ing.timeline.ticks >= 70):  # >= 7s of 0.1s samples
+                                                # for the range query
+            break
+        time.sleep(0.1)
+
+# the seeded fault tripped the breaker and the watcher captured
+# EXACTLY ONE durable bundle (the global rate limit collapses the
+# correlated edges of this one bad moment)
+br = ing.exporters.breakers()["flaky"]
+assert br["trips"] >= 1, f"breaker never opened: {br}"
+inc = ing.incidents.counters()
+assert inc["captured"] == 1, inc
+assert inc["capture_errors"] == 0 and inc["bundles"] == 1, inc
+listing = ing.incidents.list()
+assert len(listing) == 1, listing
+m = listing[0]
+assert m["version"] == 1 and m["kind"] == "breaker_open", m
+bundle = m["path"]
+for fname, size in m["files"].items():
+    p = os.path.join(bundle, fname)
+    assert os.path.getsize(p) == size, (fname, size)
+# the bundle's timeline window covers the trigger instant, and the
+# captured window actually carries self-metric series
+lo, hi = m["window"]
+assert lo <= m["wall_time"] <= hi, m
+tj = json.load(open(os.path.join(bundle, "timeline.json")))
+tl_metrics = {s["metric"] for s in tj["series"]}
+assert "receiver_rx_frames" in tl_metrics, sorted(tl_metrics)[:20]
+trg = json.load(open(os.path.join(bundle, "trigger.json")))
+assert trg["kind"] == "breaker_open" and \
+    trg["detail"]["breaker"] == "flaky", trg
+
+base = f"http://127.0.0.1:{q.port}"
+# PromQL over live self-metrics: rate() over the sketch-lane counter
+qs = urllib.parse.urlencode({"query": "rate(tpu_sketch_rows_in[1m])"})
+with urllib.request.urlopen(f"{base}/api/v1/query?{qs}", timeout=10) as resp:
+    out = json.load(resp)
+assert out["status"] == "success" and out["data"]["result"], out
+assert float(out["data"]["result"][0]["value"][1]) > 0, out
+# query_range over the profiler gauge: >= 5 grid points answered
+now = int(time.time())
+qs = urllib.parse.urlencode({"query": "tpu_device_busy_fraction",
+                             "start": now - 5, "end": now, "step": 1})
+with urllib.request.urlopen(f"{base}/api/v1/query_range?{qs}",
+                            timeout=10) as resp:
+    out = json.load(resp)
+assert out["status"] == "success" and out["data"]["result"], out
+vals = out["data"]["result"][0]["values"]
+assert len(vals) >= 5, vals
+# SQL over the rings and the bundle directory (POST /v1/query)
+def sql(stmt):
+    body = urllib.parse.urlencode({"sql": stmt}).encode()
+    with urllib.request.urlopen(
+            urllib.request.Request(f"{base}/v1/query", data=body),
+            timeout=10) as resp:
+        return json.load(resp)["result"]
+rows = sql("SELECT * FROM timeline LIMIT 50")
+assert rows["columns"] == ["time", "metric", "labels", "value", "tier"]
+assert len(rows["values"]) == 50, len(rows["values"])
+rows = sql("SELECT * FROM incidents")
+assert len(rows["values"]) == 1 and rows["values"][0][2] == "breaker_open"
+# /metrics: burn-rate family with HELP + staleness count, strictly valid
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{ing.prom_port}/metrics", timeout=10) as resp:
+    text = resp.read().decode()
+assert not validate_exposition(text)
+for needle in ("# HELP deepflow_slo_burn_rate",
+               'deepflow_slo_burn_rate{slo="ingest_availability",window="fast"}',
+               "deepflow_selfmetric_stale",
+               "deepflow_timeline_samples",
+               "deepflow_incidents_captured"):
+    assert needle in text, f"{needle} absent from /metrics"
+ticks = ing.timeline.ticks
+q.close()
+ing.close()
+default_faults().disarm()
+print(f"blackbox OK: {sent} records sent, {ticks} sampler ticks, "
+      f"breaker {br['trips']} trip(s), 1 incident bundle "
+      f"({len(m['files'])} files), query_range {len(vals)} samples",
+      flush=True)
+import os as _os
+_os._exit(0)
+EOF
+
+# the offline CLI over the same bundle directory (capture, then grep:
+# grep -q on a live pipe EPIPEs the CLI under pipefail)
+BB_STORE=$(ls -dt /tmp/blackbox_store_* | head -1)
+BB_LIST=$(python -m deepflow_tpu.cli incident list --dir "$BB_STORE/incidents")
+echo "$BB_LIST" | grep -q breaker_open
+BB_ID=$(echo "$BB_LIST" | grep -o 'inc-[a-z0-9_-]*' | head -1)
+python -m deepflow_tpu.cli incident show --dir "$BB_STORE/incidents" \
+  --id "$BB_ID" > /tmp/bb_show.json
+grep -q '"kind": "breaker_open"' /tmp/bb_show.json
+python -m deepflow_tpu.cli incident export --dir "$BB_STORE/incidents" \
+  --id "$BB_ID" --out /tmp/bb_incident.tar.gz
+tar -tzf /tmp/bb_incident.tar.gz | grep -q manifest.json
+echo "incident CLI OK: $BB_ID listed, shown, exported"
+
 echo "== driver entry points =="
 python - <<'EOF'
 import jax
@@ -1111,6 +1263,13 @@ assert an["overhead_frac"] < 0.05, an
 assert an["detect_latency_windows"] is not None \
     and an["detect_latency_windows"] <= 2, an
 assert an["rows_conserved"] is True, an
+# the self-telemetry sampler (ISSUE 16 acceptance): one tick of the
+# production-shaped rule set costs < 1% of the window close it rides
+# beside, with the series actually populated
+tl = d["stage_breakdown"]["timeline"]
+assert tl["window_close_ms"] > 0 and tl["sampler_tick_ms"] > 0, tl
+assert tl["overhead_frac"] < 0.01, tl
+assert tl["series"] >= 5 and tl["samples"] > 0, tl
 # the serving read path (ISSUE 7 acceptance): >= 50k point-query QPS
 # against a live ingest, with the read-hammered run's sketch state
 # bit-identical to the no-readers twin

@@ -177,7 +177,14 @@ _DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
                          "runtime/profiler.py", "serving/cache.py",
                          "serving/tables.py", "serving/anomaly.py",
                          "batch/staging.py", "anomaly/detectors.py",
-                         "anomaly/alerts.py")
+                         "anomaly/alerts.py",
+                         # ISSUE 16: the self-telemetry sampler and the
+                         # incident recorder run BESIDE the device
+                         # pipeline on every deployment — a device sync
+                         # on the sampler tick would serialize dispatch
+                         # once per second forever; both must stay
+                         # host-pure (zero sanctioned syncs)
+                         "runtime/timeline.py", "runtime/incident.py")
 # the sampled-drain helpers where a blocking sync is the point: explicit
 # attribution drains on every Nth batch / cold compile (PR 1), the
 # degraded-mode device probe (PR 2), the overlapped feed's
@@ -496,7 +503,11 @@ _DATA_NOUNS = frozenset([
     "payload", "payloads",
     # ISSUE 15: alerts are data-plane product output — a dropped alert
     # must move a Countable exactly like a dropped row
-    "alert", "alerts"])
+    "alert", "alerts",
+    # ISSUE 16: timeline samples and incident bundles are the
+    # observability plane's payload — an overwritten ring sample and an
+    # evicted bundle both move a Countable, never vanish
+    "sample", "samples", "bundle", "bundles", "incident", "incidents"])
 # a drop path is "counted" when its block provably moves a ledger: any
 # augmented assignment (counter += n), or a call whose name owns a loss
 # verb (self._count_drop(), tracer.incr(...), shed(), ...)

@@ -477,8 +477,13 @@ class LockOrderCycle(Checker):
 
 # the producer-facing mutation methods that count as thread entry
 # points beside spawn targets, callback handoffs and `run` workers:
-# the main put path
-_ENTRY_NAMES = frozenset(["run", "put", "puts", "put_batch"])
+# the main put path, plus the ISSUE 16 timeline's two cross-thread
+# faces — `sample_once` is the sampler thread's per-tick entry (the
+# spawn target is a closure, invisible to the self.<m> detector) and
+# `prom_fetch` is the querier server threads' read entry into the
+# same rings
+_ENTRY_NAMES = frozenset(["run", "put", "puts", "put_batch",
+                          "sample_once", "prom_fetch"])
 
 # Reviewed per-file sanction (the _SANCTIONED_SYNCS_BY_FILE pattern):
 # methods whose bare writes are governed by a documented ownership

@@ -355,6 +355,52 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_incident(args) -> int:
+    """Flight-recorder bundles (ISSUE 16): list/show/export straight
+    off the incident directory — like `query --snapshots`, no live
+    ingester needed (the bundles are fsynced precisely so they outlive
+    the process that captured them)."""
+    import os
+    import tarfile
+    import time
+
+    from deepflow_tpu.runtime.incident import IncidentRecorder
+
+    rec = IncidentRecorder(args.dir)
+    if args.action == "list":
+        rows = [[m["id"], m["kind"],
+                 time.strftime("%Y-%m-%d %H:%M:%S",
+                               time.localtime(m.get("wall_time", 0))),
+                 m.get("bytes", 0), len(m.get("files", {}))]
+                for m in rec.list()]
+        _table(rows, ["id", "kind", "time", "bytes", "files"])
+        return 0
+    if not args.id:
+        print("--id required for show/export "
+              "(list ids with `incident list`)", file=sys.stderr)
+        return 2
+    m = rec.manifest(args.id)
+    if m is None:
+        print(f"no bundle {args.id!r} under {args.dir}", file=sys.stderr)
+        return 1
+    if args.action == "show":
+        bundle = {"manifest": m}
+        for fname in ("trigger.json", "snapbus.json"):
+            p = os.path.join(m["path"], fname)
+            if os.path.isfile(p):
+                with open(p, "r", encoding="utf-8") as f:
+                    bundle[fname.split(".")[0]] = json.load(f)
+        print(json.dumps(bundle, indent=2, sort_keys=True))
+        return 0
+    # export: one portable .tar.gz of the bundle directory
+    out = args.out or f"{args.id}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        tar.add(m["path"], arcname=args.id)
+    print(f"wrote {out} ({os.path.getsize(out)} bytes, "
+          f"{len(m.get('files', {}))} files)")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """The trace family. `expand` (default with --id) assembles an L7
     trace from one row id (the L7FlowTracing role). `latency`, `spans`
@@ -1012,6 +1058,21 @@ def build_parser() -> argparse.ArgumentParser:
     vf.add_argument("--json", action="store_true",
                     help="machine-readable results on stdout")
     vf.set_defaults(fn=cmd_verify)
+
+    inc = sub.add_parser(
+        "incident", help="flight-recorder bundles: list/show/export "
+                         "off an incident directory (no live ingester "
+                         "needed)")
+    inc.add_argument("action", nargs="?", default="list",
+                     choices=["list", "show", "export"])
+    inc.add_argument("--dir", required=True,
+                     help="incident directory (the ingester's "
+                          "<store_path>/incidents, or incident_dir)")
+    inc.add_argument("--id", help="bundle id (show/export)")
+    inc.add_argument("--out",
+                     help="export: output .tar.gz path "
+                          "(default <id>.tar.gz)")
+    inc.set_defaults(fn=cmd_incident)
 
     rp = sub.add_parser("replay-pcap",
                         help="replay a pcap through an agent -> ingester")

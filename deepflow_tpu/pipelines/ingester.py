@@ -178,6 +178,40 @@ class IngesterConfig:
     # drain ladder (close()): how long to wait for queues + exporters
     # to flush before spilling the remainder to disk
     drain_deadline_s: float = 5.0
+    # -- self-telemetry timeline (runtime/timeline.py, ISSUE 16) ------
+    # sampler cadence of the bounded in-process TSDB over every
+    # registered Countable + gauge surface: a Supervisor-spawned
+    # thread snapshots at this cadence into fixed-size per-series
+    # rings, and PromQL/SQL answer over them through the querier.
+    # 0 disables the timeline (and with it the SLO burn-rate rules
+    # and the incident recorder, which both ride the sampler tick)
+    timeline_sample_s: float = 1.0
+    # hot per-series ring capacity (samples); the oldest sample past
+    # this either graduates to the coarse tier or is dropped counted
+    timeline_hot_samples: int = 600
+    # every Nth evicted hot sample joins the coarse tier (same
+    # capacity -> Nx the lookback at 1/N resolution); 0 disables it
+    timeline_coarse_every: int = 10
+    # -- SLO burn-rate rules (evaluated on the sampler tick) ----------
+    # shared objective for the declared SLOs (ingest availability off
+    # the conservation-ledger loss counters; serving p99; detection
+    # latency); burn rate = error fraction / (1 - objective)
+    slo_objective: float = 0.999
+    # serving p99 bound (seconds) the querier-read SLO holds against
+    slo_serving_p99_s: float = 0.05
+    # detection-latency bound (windows behind live) for the anomaly SLO
+    slo_detect_latency_windows: float = 2.0
+    # fast-window (5m) burn rate that counts as fast-burning — feeds
+    # health()["slo_burning"] and the incident trigger (14.4 burns a
+    # 0.999 objective's monthly budget in about two days)
+    slo_fast_burn: float = 14.4
+    # -- incident flight recorder (runtime/incident.py) ---------------
+    # bundle directory; None derives <store_path>/incidents, and with
+    # store_path also None the recorder is off (nowhere durable)
+    incident_dir: Optional[str] = None
+    incident_budget_bytes: int = 64 << 20  # oldest bundles evicted past
+    incident_min_interval_s: float = 30.0  # global capture rate limit
+    incident_window_s: float = 120.0       # timeline lookback per bundle
 
 
 class Ingester:
@@ -319,13 +353,95 @@ class Ingester:
                 budget_bytes=cfg.spill_budget_bytes,
                 watermark=cfg.spill_watermark)
             self.stats.register("spill", self.spill.counters)
+        # self-telemetry timeline + SLO burn rates + incident recorder
+        # (ISSUE 16): the sampler snapshots every Countable and gauge
+        # surface into per-series rings, SLO rules burn-rate on its
+        # tick, and the watcher captures one correlated fsynced bundle
+        # per trigger edge. Host-side only — bit-invisible to the
+        # sketch/anomaly device state (asserted in tests).
+        self.timeline = None
+        self.incidents = None
+        self._incident_watcher = None
+        if cfg.timeline_sample_s > 0:
+            from deepflow_tpu.runtime.profiler import default_profiler
+            from deepflow_tpu.runtime.timeline import (RecordingRule,
+                                                       SloRule, Timeline)
+            self.timeline = Timeline(
+                sample_s=cfg.timeline_sample_s,
+                hot_samples=cfg.timeline_hot_samples,
+                coarse_every=cfg.timeline_coarse_every,
+                stats=self.stats, tracer=self.tracer,
+                profiler=default_profiler(),
+                fast_burn_threshold=cfg.slo_fast_burn)
+            # recording rules: the derived lane rates item 2's feedback
+            # controller conditions on, materialized as first-class
+            # series (rate window = 10 ticks, the staleness horizon)
+            rate_win = 10.0 * cfg.timeline_sample_s
+
+            def _per_s(metric):
+                def fn(tl, now):
+                    d = tl._window_delta(metric, now - rate_win, now)
+                    return d / rate_win
+                return fn
+
+            self.timeline.add_rule(RecordingRule(
+                "ingest_frames_per_s", _per_s("receiver_rx_frames")))
+            self.timeline.add_rule(RecordingRule(
+                "sketch_rows_per_s", _per_s("tpu_sketch_rows_in")))
+            # declared SLOs: availability off the conservation-ledger
+            # loss counters, serving p99, detection latency
+            self.timeline.add_slo(SloRule(
+                "ingest_availability", objective=cfg.slo_objective,
+                kind="ratio",
+                bad=("receiver_rx_dropped", "exporters_put_errors",
+                     "exporters_shed"),
+                total=("receiver_rx_frames",)))
+            self.timeline.add_slo(SloRule(
+                "serving_p99", objective=cfg.slo_objective,
+                kind="threshold", series="querier_read_p99_s",
+                bound=cfg.slo_serving_p99_s))
+            self.timeline.add_slo(SloRule(
+                "detection_latency", objective=cfg.slo_objective,
+                kind="threshold",
+                series="anomaly_detect_latency_windows",
+                bound=cfg.slo_detect_latency_windows))
+            self.stats.register("timeline", self.timeline.counters)
+            incident_dir = cfg.incident_dir
+            if incident_dir is None and cfg.store_path is not None:
+                incident_dir = os.path.join(cfg.store_path, "incidents")
+            if incident_dir is not None:
+                from deepflow_tpu.runtime.incident import (
+                    IncidentRecorder, IncidentWatcher)
+                buses = {}
+                if self.tpu_sketch is not None:
+                    buses["sketch"] = self.tpu_sketch.snapshot_bus
+                    if self.tpu_sketch.anomaly is not None:
+                        buses["anomaly"] = self.tpu_sketch.anomaly.bus
+                self.incidents = IncidentRecorder(
+                    incident_dir, timeline=self.timeline,
+                    profiler=default_profiler(), stats=self.stats,
+                    snapbuses=buses,
+                    budget_bytes=cfg.incident_budget_bytes,
+                    min_interval_s=cfg.incident_min_interval_s,
+                    window_s=cfg.incident_window_s)
+                self.stats.register("incidents", self.incidents.counters)
+                anomaly = None if self.tpu_sketch is None \
+                    else self.tpu_sketch.anomaly
+                self._incident_watcher = IncidentWatcher(
+                    self.incidents, health_fn=self.health,
+                    breakers_fn=self.exporters.breakers,
+                    alerts_fn=None if anomaly is None else
+                    (lambda: float(sum(anomaly.alerts_total))),
+                    timeline=self.timeline)
+                self.timeline.add_tick_hook(self._incident_watcher.tick)
         self.prom = None
         if cfg.prom_port is not None:
             from deepflow_tpu.runtime.promexpo import PrometheusExporter
             self.prom = PrometheusExporter(stats=self.stats,
                                            tracer=self.tracer,
                                            port=cfg.prom_port,
-                                           health=self.health)
+                                           health=self.health,
+                                           timeline=self.timeline)
         self.debug = None
         if cfg.debug_port is not None:
             from deepflow_tpu.runtime.debug import DebugServer
@@ -379,6 +495,14 @@ class Ingester:
             "degraded_tpu_sketch": degraded,
             "accuracy_alarm": accuracy_alarm,
         }
+        # SLO fast-burn verdict (ISSUE 16): informational — which
+        # declared objectives are burning budget past the fast-window
+        # threshold. Deliberately NOT folded into `ok`: burn lags its
+        # cause (the loss that burned the budget already flipped a
+        # breaker or loss counter above), and a 5m-window burn keeping
+        # /healthz 503 long after recovery would fight the probes
+        if self.timeline is not None:
+            out["slo_burning"] = self.timeline.fast_burning()
         # pod fault domains (ISSUE 10): per-shard states on the probe
         # surface — a degraded or lost shard is a reduced-capacity pod
         # (not-ok, like the single-chip degraded lane) and the probe
@@ -554,6 +678,11 @@ class Ingester:
             # any segments a previous process left behind while the
             # listener below is still coming up
             self.spill.start()
+        if self.timeline is not None:
+            self.timeline.register_datasource()
+            if self.incidents is not None:
+                self.incidents.register_datasource()
+            self.timeline.start(self.supervisor)
         self.receiver.start()  # last, like the reference (ingester.go:220)
 
     def flush(self) -> None:
@@ -595,6 +724,13 @@ class Ingester:
         import time as _time
 
         self._drain_state = "draining"
+        # sampler first: its tick hooks read health()/breakers, and the
+        # surfaces below are about to be torn down under it
+        if self.timeline is not None:
+            self.timeline.stop()
+            self.timeline.unregister_datasource()
+            if self.incidents is not None:
+                self.incidents.unregister_datasource()
         janitor_stop = getattr(self, "_janitor_stop", None)
         if janitor_stop is not None:
             janitor_stop.set()
@@ -634,6 +770,10 @@ class Ingester:
         self.tag_dicts.close()
         self.stats.deregister("tracer")
         self.stats.deregister("supervisor")
+        if self.timeline is not None:
+            self.stats.deregister("timeline")
+        if self.incidents is not None:
+            self.stats.deregister("incidents")
         if self.spill is not None:
             self.stats.deregister("spill")
         for site in self._armed_sites:

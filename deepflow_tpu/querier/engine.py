@@ -57,7 +57,8 @@ class QueryResult:
 class QueryEngine:
     def __init__(self, store: Store,
                  tag_dicts: Optional[TagDictRegistry] = None,
-                 tagrecorder=None, sketch=None, anomaly=None) -> None:
+                 tagrecorder=None, sketch=None, anomaly=None,
+                 timeline=None, incidents=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
         # controller.tagrecorder.TagRecorder: id->name dimension dicts for
@@ -71,6 +72,11 @@ class QueryEngine:
         # serving.AnomalyTables (ISSUE 15): SELECT * FROM anomaly —
         # the detection lane's durable alert records as a table
         self.anomaly = anomaly
+        # runtime.Timeline / runtime.IncidentRecorder (ISSUE 16):
+        # SELECT * FROM timeline / FROM incidents — the self-telemetry
+        # rings and the flight recorder's bundles as tables
+        self.timeline = timeline
+        self.incidents = incidents
 
     # -- public ------------------------------------------------------------
     def execute(self, sql_text: str, db: Optional[str] = None) -> QueryResult:
@@ -167,6 +173,13 @@ class QueryEngine:
             # the anomaly datasource: alert records off the plane's
             # snapshot cache — same no-store, no-device posture
             return self.anomaly.sql(stmt)
+        if self.timeline is not None and stmt.table == "timeline":
+            # the self-telemetry datasource (ISSUE 16): one row per
+            # ring sample, straight off the in-process rings
+            return self.timeline.sql(stmt)
+        if self.incidents is not None and stmt.table == "incidents":
+            # the flight recorder's bundles: one row per manifest
+            return self.incidents.sql(stmt)
         table = self._resolve_table(stmt.table, db)
         schema = table.schema
 

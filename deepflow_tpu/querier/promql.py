@@ -689,6 +689,16 @@ class _Evaluator:
     def _fetch(self, sel: Selector, lo: int, hi: int):
         """[(labels, ts, vs)] for series matching the selector with any
         samples in [lo, hi)."""
+        # the ISSUE 16 self-telemetry timeline: selectors over metrics
+        # the in-process rings carry (tpu_sketch_rows_in, slo_burn_rate,
+        # tpu_device_busy_fraction, ...) are answered from the timeline
+        # instead of a store scan — every selector path funnels here, so
+        # rate()/increase()/*_over_time()/subqueries all work against
+        # self-metrics through the existing routes
+        timeline = getattr(self.engine, "timeline", None)
+        if timeline is not None and timeline.has_metric(sel.metric):
+            return timeline.prom_fetch(sel.metric, list(sel.matchers),
+                                       lo, hi)
         key = (lo, hi)
         cols = self._scan_cache.get(key)
         if cols is None:
@@ -1435,7 +1445,7 @@ def _compare(op: str, a, b) -> np.ndarray:
 class PromEngine:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  db: str = "ext_metrics", table: str = "ext_samples",
-                 sketch=None, anomaly=None) -> None:
+                 sketch=None, anomaly=None, timeline=None) -> None:
         self.store = store
         self.tag_dicts = tag_dicts
         self.db = db
@@ -1445,6 +1455,9 @@ class PromEngine:
         # serving.AnomalyTables (ISSUE 15): backs the anomaly_*
         # instant-vector selectors
         self.anomaly = anomaly
+        # runtime.Timeline (ISSUE 16): selectors over self-telemetry
+        # series answer from the in-process rings, not a store scan
+        self.timeline = timeline
 
     # -- series access -----------------------------------------------------
     def _fetch(self, metric: str, matchers, lo: int, hi: int,

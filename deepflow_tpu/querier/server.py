@@ -36,7 +36,8 @@ class QuerierServer:
     def __init__(self, store: Store, tag_dicts: TagDictRegistry,
                  port: int = DEFAULT_PORT, host: str = "127.0.0.1",
                  tagrecorder=None, external_apm=None,
-                 sketch=None, anomaly=None, supervisor=None) -> None:
+                 sketch=None, anomaly=None, supervisor=None,
+                 timeline=None, incidents=None) -> None:
         from deepflow_tpu.querier.tracing_adapter import \
             TracingAdapterService
         # serving.SketchTables (ISSUE 7): both engines mount it as the
@@ -46,14 +47,21 @@ class QuerierServer:
         # serving.AnomalyTables (ISSUE 15): SELECT * FROM anomaly /
         # anomaly_score{detector=...} through the same routes
         self.anomaly = anomaly
+        # runtime.Timeline + runtime.IncidentRecorder (ISSUE 16):
+        # self-telemetry series (SQL FROM timeline, PromQL over any
+        # timeline-carried metric incl. /api/v1/query_range) and the
+        # flight recorder's bundles (SQL FROM incidents), same routes
+        self.timeline = timeline
+        self.incidents = incidents
         # supervision tree for the accept loop; None = the process
         # default, resolved at start() (a start()-time supervisor
         # argument overrides a constructor-time one)
         self._supervisor = supervisor
         self.engine = QueryEngine(store, tag_dicts, tagrecorder=tagrecorder,
-                                  sketch=sketch, anomaly=anomaly)
+                                  sketch=sketch, anomaly=anomaly,
+                                  timeline=timeline, incidents=incidents)
         self.prom = PromEngine(store, tag_dicts, sketch=sketch,
-                               anomaly=anomaly)
+                               anomaly=anomaly, timeline=timeline)
         self.profile = ProfileQuery(store, tag_dicts)
         self.tempo = TempoQuery(store, tag_dicts)
         self.tracing_adapter = TracingAdapterService.from_config(

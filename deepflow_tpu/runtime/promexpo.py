@@ -68,12 +68,20 @@ def _labels(d: Dict[str, str]) -> str:
 def render_metrics(stats: Optional[StatsRegistry],
                    tracer: Optional[Tracer],
                    bucket_stride: int = 64,
-                   profiler=None) -> str:
+                   profiler=None,
+                   timeline=None) -> str:
     """One scrape: collect Countables + tracer state + the occupancy
     profiler's continuous gauges, render text exposition format
     (version 0.0.4). `profiler` defaults to the process profiler
     (runtime/profiler.py) so ``tpu_device_busy_fraction`` /
-    ``tpu_feed_stall_seconds`` are freshly computed per scrape."""
+    ``tpu_feed_stall_seconds`` are freshly computed per scrape.
+
+    With a `timeline` (runtime/timeline.py) attached, fossil gauges —
+    tracer gauges whose wall stamp is past the timeline's staleness
+    horizon (10x sample cadence) — are withheld COUNTED as
+    ``deepflow_selfmetric_stale`` instead of silently served, and the
+    timeline's ``slo_burn_rate`` family is exposed as
+    ``deepflow_slo_burn_rate{slo,window}``."""
     lines: List[str] = []
     typed: set = set()
 
@@ -128,9 +136,29 @@ def render_metrics(stats: Optional[StatsRegistry],
             lines.append(f"{hname}_sum{_labels(lbl)} {repr(sum_)}")
             lines.append(f"{hname}_count{_labels(lbl)} {_fmt(total)}")
         from deepflow_tpu.runtime.tracing import gauge_help
+        stale = timeline.stale_gauges() if timeline is not None else {}
         for name, value in sorted(tracer.gauges().items()):
+            if name in stale:
+                # a fossil: its writer has not refreshed it within the
+                # staleness horizon — withheld, counted below, never
+                # silently served as if current
+                continue
+            # gauges registered at runtime (a concurrently-registering
+            # thread, a plugin) may lack a GAUGE_HELP entry; the strict
+            # validator rejects gauge-typed series without HELP, so
+            # fall back to a generic line rather than emit an
+            # exposition a real scraper flags mid-incident
             _sample(_metric_name("deepflow_trace", name), {}, value,
-                    mtype="gauge", help_=gauge_help(name))
+                    mtype="gauge",
+                    help_=gauge_help(name) or
+                    "tracer gauge (no GAUGE_HELP entry; see "
+                    "runtime/tracing.py)")
+        if timeline is not None:
+            _sample("deepflow_selfmetric_stale", {}, float(len(stale)),
+                    mtype="gauge",
+                    help_="self-metric gauge series withheld from this "
+                    "scrape as stale (no write within 10x the timeline "
+                    "sample cadence)")
         _sample("deepflow_trace_spans_total", {},
                 float(tracer.spans_recorded), mtype="counter",
                 help_="spans recorded by the flight recorder")
@@ -145,6 +173,14 @@ def render_metrics(stats: Optional[StatsRegistry],
     _sample("deepflow_profiler_spans_total", {},
             float(profiler.spans_recorded), mtype="counter",
             help_="spans recorded into the occupancy ring")
+
+    if timeline is not None:
+        for lbl, burn in sorted(timeline.slo_gauges(),
+                                key=lambda p: sorted(p[0].items())):
+            _sample("deepflow_slo_burn_rate", lbl, burn, mtype="gauge",
+                    help_="error-budget burn rate per SLO and window "
+                    "(1.0 = budget burning exactly at its sustainable "
+                    "pace; see runtime/timeline.py SloRule)")
 
     return "\n".join(lines) + "\n"
 
@@ -276,10 +312,11 @@ class PrometheusExporter:
                  tracer: Optional[Tracer] = None,
                  port: int = DEFAULT_PROM_PORT,
                  host: str = "127.0.0.1",
-                 health=None) -> None:
+                 health=None, timeline=None) -> None:
         self.stats = stats
         self.tracer = tracer if tracer is not None else default_tracer()
         self.health = health
+        self.timeline = timeline
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -292,8 +329,9 @@ class PrometheusExporter:
                     self.send_error(404)
                     return
                 try:
-                    body = render_metrics(exporter.stats,
-                                          exporter.tracer).encode()
+                    body = render_metrics(
+                        exporter.stats, exporter.tracer,
+                        timeline=exporter.timeline).encode()
                 except Exception as e:   # a broken countable: 500, not die
                     self.send_error(500, str(e)[:200])
                     return

@@ -84,6 +84,25 @@ class StatsRegistry:
                 sink(sample)
         return samples
 
+    def peek(self) -> List[StatSample]:
+        """Scrape every source once WITHOUT touching history or sinks.
+
+        The timeline sampler reads the registry at its own (faster)
+        cadence; going through collect() would multiply the history
+        churn and re-ship every scrape over an attached StatsShipper.
+        """
+        now = time.time()
+        with self._lock:
+            sources = list(self._sources)
+        samples = []
+        for s in sources:
+            try:
+                values = s.countable()
+            except Exception:  # a broken source must not kill the sampler
+                continue
+            samples.append(StatSample(now, s.module, s.tags, dict(values)))
+        return samples
+
     def history(self, module: Optional[str] = None) -> List[StatSample]:
         with self._lock:
             return [s for s in self._history

@@ -318,6 +318,7 @@ class Tracer:
         self._buckets = buckets
         self._stages: Dict[str, HostDDSketch] = {}
         self._gauges: Dict[str, float] = {}
+        self._gauge_stamps: Dict[str, float] = {}  # name -> wall time
         self._lock = threading.Lock()   # reads + stage/gauge creation
         self._batch_seq = 0
         self._tls = threading.local()
@@ -339,6 +340,7 @@ class Tracer:
             self._n = 0
             self._stages = {}
             self._gauges = {}
+            self._gauge_stamps = {}
 
     # -- batch causality ---------------------------------------------------
     def next_batch(self) -> int:
@@ -395,7 +397,12 @@ class Tracer:
     def gauge(self, name: str, value: float) -> None:
         if not self.enabled:
             return
+        # wall-stamped so the timeline/exposition can tell a live gauge
+        # from a fossil: gauges refresh only on their own code path
+        # (e.g. snapshot staleness updates only when a read happens), so
+        # without the stamp the last value is served forever
         self._gauges[name] = float(value)
+        self._gauge_stamps[name] = time.time()
 
     # -- readback ----------------------------------------------------------
     @property
@@ -405,6 +412,14 @@ class Tracer:
     def gauges(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._gauges)
+
+    def gauges_stamped(self) -> Dict[str, tuple]:
+        """name -> (value, wall stamp of last write). Gauges written
+        before stamping landed (or via direct dict poke in tests) get
+        stamp 0.0 — maximally stale, which fails safe."""
+        with self._lock:
+            return {k: (v, self._gauge_stamps.get(k, 0.0))
+                    for k, v in self._gauges.items()}
 
     def stages(self) -> Dict[str, HostDDSketch]:
         """Snapshot of the stage map (sketches themselves are live)."""

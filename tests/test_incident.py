@@ -1,0 +1,340 @@
+"""ISSUE 16: the incident flight recorder — durable correlated bundles,
+rate limiting, budget eviction, SQL, and the edge-triggered watcher.
+
+Contracts under test: a trigger captures exactly one fsynced bundle
+(manifest + trigger + timeline window + trace + counters + snapbus
+heads) whose timeline window covers the trigger instant; capture is
+globally rate-limited with suppressions COUNTED; the directory is
+bounded by budget_bytes with oldest-first eviction COUNTED; unreadable
+manifests are skipped COUNTED; bundles answer SELECT * FROM incidents;
+and the watcher fires on edges only (closed->open, ok->not-ok, rising
+alert count, SLO entering fast-burn), never on levels."""
+
+import json
+import os
+
+import pytest
+
+from deepflow_tpu.runtime.incident import (IncidentRecorder,
+                                           IncidentWatcher,
+                                           BUNDLE_VERSION)
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.timeline import Timeline, SloRule
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeSnap:
+    step = 7
+    seq = 3
+    wall_time = 999.5
+    path = "/snap/sketch-7"
+    leaves = [1, 2, 3]
+    tags = {"window": 7}
+
+
+class _FakeBus:
+    def __init__(self, snap=_FakeSnap()):
+        self._snap = snap
+
+    def latest(self):
+        return self._snap
+
+
+def _recorder(tmp_path, clock, timeline=None, **kw):
+    kw.setdefault("min_interval_s", 30.0)
+    kw.setdefault("window_s", 60.0)
+    return IncidentRecorder(str(tmp_path / "incidents"),
+                            timeline=timeline, clock=clock, **kw)
+
+
+def _timeline_with_data(clock):
+    tl = Timeline(sample_s=1.0, hot_samples=64, coarse_every=4,
+                  clock=clock)
+    for i in range(30):
+        tl.record("receiver_rx_frames", float(i * 10), now=970.0 + i)
+    return tl
+
+
+# -------------------------------------------------------------- capture
+
+def test_capture_bundle_layout_and_durability(tmp_path):
+    clock = _Clock()
+    stats = StatsRegistry()
+    stats.register("receiver", lambda: {"rx_frames": 42})
+    tl = _timeline_with_data(clock)
+    rec = _recorder(tmp_path, clock, timeline=tl, stats=stats,
+                    snapbuses={"sketch": _FakeBus(),
+                               "anomaly": _FakeBus(None)})
+    path = rec.capture("breaker_open", {"breaker": "flaky"})
+    assert path is not None and os.path.isdir(path)
+    base = os.path.basename(path)
+    assert base.startswith("inc-1000-0001-breaker_open")
+    names = sorted(os.listdir(path))
+    # no profiler attached -> no trace.json; every other section present
+    assert names == ["counters.json", "manifest.json", "snapbus.json",
+                     "timeline.json", "trigger.json"]
+
+    m = json.load(open(os.path.join(path, "manifest.json")))
+    assert m["version"] == BUNDLE_VERSION
+    assert m["id"] == base and m["kind"] == "breaker_open"
+    assert sorted(m["files"]) == [n for n in names if n != "manifest.json"]
+    assert all(m["files"][f] == os.path.getsize(os.path.join(path, f))
+               for f in m["files"])
+    # the timeline window covers the trigger instant
+    t = json.load(open(os.path.join(path, "timeline.json")))
+    lo, hi = t["window"]
+    assert lo <= m["wall_time"] <= hi
+    series = {s["metric"]: s for s in t["series"]}
+    assert "receiver_rx_frames" in series
+    assert all(lo <= ts <= clock.t + 1.0
+               for ts in series["receiver_rx_frames"]["ts"])
+    trg = json.load(open(os.path.join(path, "trigger.json")))
+    assert trg == {"kind": "breaker_open", "wall_time": 1000.0,
+                   "detail": {"breaker": "flaky"}}
+    counters = json.load(open(os.path.join(path, "counters.json")))
+    assert any(c["module"] == "receiver" and
+               c["values"]["rx_frames"] == 42 for c in counters)
+    snap = json.load(open(os.path.join(path, "snapbus.json")))
+    assert snap["sketch"]["step"] == 7 and snap["sketch"]["leaves"] == 3
+    assert snap["anomaly"] is None
+    # no torn tmp directories left behind
+    assert all(not n.startswith(".")
+               for n in os.listdir(rec.directory))
+    # trace.json present even with no profiler attached? profiler=None
+    # means the recorder skips it — this recorder had none
+    assert rec.counters()["captured"] == 1
+
+
+def test_capture_without_optional_sources(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock)      # no timeline/profiler/stats
+    path = rec.capture("healthz", {})
+    names = sorted(os.listdir(path))
+    assert names == ["manifest.json", "snapbus.json", "trigger.json"]
+    assert json.load(open(os.path.join(path, "manifest.json")))["kind"] \
+        == "healthz"
+
+
+def test_rate_limit_is_global_and_counted(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=30.0)
+    assert rec.capture("breaker_open", {}) is not None
+    # a different KIND within the interval is still suppressed: one bad
+    # moment trips several detectors and must yield ONE bundle
+    clock.t += 5.0
+    assert rec.capture("healthz", {}) is None
+    assert rec.capture("slo_fast_burn", {}) is None
+    assert rec.counters()["suppressed"] == 2
+    clock.t += 30.0
+    assert rec.capture("healthz", {}) is not None
+    assert rec.counters()["captured"] == 2
+    assert len(rec.list()) == 2
+
+
+def test_budget_eviction_oldest_first_counted(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=0.0,
+                    budget_bytes=1)       # everything over budget
+    first = rec.capture("a", {})
+    clock.t += 60.0
+    second = rec.capture("b", {})
+    # first capture evicted the (empty) excess; second evicted first
+    assert not os.path.exists(first)
+    assert os.path.exists(second) or rec.counters()["bundles_evicted"] >= 1
+    c = rec.counters()
+    assert c["bundles_evicted"] >= 1
+    assert c["bytes_evicted"] > 0
+    # with a sane budget nothing is evicted
+    rec2 = IncidentRecorder(str(tmp_path / "inc2"), clock=clock,
+                            min_interval_s=0.0,
+                            budget_bytes=64 << 20)
+    rec2.capture("a", {})
+    clock.t += 1.0
+    rec2.capture("b", {})
+    assert rec2.counters()["bundles_evicted"] == 0
+    assert len(rec2.list()) == 2
+
+
+def test_unreadable_manifest_skipped_counted(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock)
+    rec.capture("a", {})
+    torn = os.path.join(rec.directory, "inc-999-0000-torn")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "manifest.json"), "w") as f:
+        f.write("{not json")
+    listing = rec.list()
+    assert len(listing) == 1              # the torn bundle is skipped
+    assert rec.counters()["manifest_errors"] == 1
+    assert rec.counters()["bundles"] == 2  # ...but still counted on disk
+
+
+def test_list_survives_restart(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock)
+    p = rec.capture("a", {"x": 1})
+    # a fresh recorder over the same directory sees the bundle: the
+    # directory is the source of truth
+    rec2 = IncidentRecorder(rec.directory, clock=clock)
+    listing = rec2.list()
+    assert len(listing) == 1
+    assert listing[0]["id"] == os.path.basename(p)
+    assert listing[0]["path"] == p
+    assert listing[0]["bytes"] > 0
+
+
+# ------------------------------------------------------------------ SQL
+
+def test_sql_select_from_incidents(tmp_path):
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store.db import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=0.0)
+    rec.capture("breaker_open", {"breaker": "flaky"})
+    clock.t += 100.0
+    rec.capture("healthz", {})
+    eng = QueryEngine(Store(str(tmp_path / "store")),
+                      TagDictRegistry(None), incidents=rec)
+    r = eng.execute("SELECT * FROM incidents")
+    assert r.columns == ["time", "id", "kind", "bytes", "files",
+                         "detail"]
+    assert [row[2] for row in r.values] == ["breaker_open", "healthz"]
+    assert r.values[0][0] == 1000 and r.values[1][0] == 1100
+    assert json.loads(r.values[0][5]) == {"breaker": "flaky"}
+    assert all(row[3] > 0 and row[4] >= 2 for row in r.values)
+    # time bounds + LIMIT
+    r = eng.execute("SELECT * FROM incidents WHERE time >= 1050")
+    assert [row[2] for row in r.values] == ["healthz"]
+    r = eng.execute("SELECT * FROM incidents LIMIT 1")
+    assert len(r.values) == 1
+    with pytest.raises(ValueError):
+        eng.execute("SELECT kind FROM incidents")
+
+
+# -------------------------------------------------------------- watcher
+
+def test_watcher_breaker_edge(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=0.0)
+    state = {"flaky": {"state": "closed", "opens": 0}}
+    w = IncidentWatcher(rec, breakers_fn=lambda: state)
+    w.tick(clock.t)
+    assert w.triggers == 0
+    state["flaky"]["state"] = "open"
+    clock.t += 1.0
+    w.tick(clock.t)
+    assert w.triggers == 1
+    # a breaker STAYING open is one incident, not one per tick
+    clock.t += 1.0
+    w.tick(clock.t)
+    assert w.triggers == 1
+    # half-open is recovery probing, not a new incident
+    state["flaky"]["state"] = "half-open"
+    w.tick(clock.t + 1)
+    assert w.triggers == 1
+    # closed -> open again: a NEW edge fires
+    state["flaky"]["state"] = "closed"
+    w.tick(clock.t + 2)
+    state["flaky"]["state"] = "open"
+    w.tick(clock.t + 3)
+    assert w.triggers == 2
+    kinds = [m["kind"] for m in rec.list()]
+    assert kinds.count("breaker_open") == 2
+
+
+def test_watcher_health_and_alarm_edges(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=0.0)
+    health = {"ok": True, "accuracy_alarm": False}
+    w = IncidentWatcher(rec, health_fn=lambda: dict(health))
+    w.tick(clock.t)
+    assert w.triggers == 0
+    health["ok"] = False
+    w.tick(clock.t + 1)
+    assert w.triggers == 1                # ok -> not-ok edge
+    w.tick(clock.t + 2)
+    assert w.triggers == 1                # staying not-ok: no re-fire
+    health["accuracy_alarm"] = True
+    w.tick(clock.t + 3)
+    assert w.triggers == 2                # alarm latching edge
+    w.tick(clock.t + 4)
+    assert w.triggers == 2
+    kinds = sorted(m["kind"] for m in rec.list())
+    assert kinds == ["accuracy_alarm", "healthz"]
+
+
+def test_watcher_alert_count_and_fast_burn(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=0.0)
+    alerts = {"n": 0.0}
+    tl = Timeline(sample_s=1.0, hot_samples=32, clock=clock,
+                  fast_burn_threshold=14.4)
+    tl.add_slo(SloRule("avail", objective=0.999, kind="threshold",
+                       series="bad_g", bound=0.5))
+    w = IncidentWatcher(rec, alerts_fn=lambda: alerts["n"],
+                        timeline=tl)
+    tl.add_tick_hook(w.tick)
+    tl.record("bad_g", 0.0, now=clock.t)
+    tl.sample_once()
+    assert w.triggers == 0                # baseline established
+    alerts["n"] = 3.0
+    clock.t += 1.0
+    tl.record("bad_g", 0.0, now=clock.t)
+    tl.sample_once()
+    assert w.triggers == 1                # rising alert count
+    # SLO entering fast-burn: the violated threshold series pushes the
+    # fast-window burn to 1000 >> 14.4
+    clock.t += 1.0
+    tl.record("bad_g", 1.0, now=clock.t)
+    tl.sample_once()
+    assert w.triggers == 2
+    kinds = sorted(m["kind"] for m in rec.list())
+    assert kinds == ["anomaly_alert", "slo_fast_burn"]
+    # still burning next tick: no re-fire (edge, not level)
+    clock.t += 1.0
+    tl.record("bad_g", 1.0, now=clock.t)
+    tl.sample_once()
+    assert w.triggers == 2
+
+
+def test_watcher_burst_collapses_to_one_bundle(tmp_path):
+    """One bad moment trips several detectors; the recorder's global
+    rate limit collapses the correlated edges into a single bundle."""
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=30.0)
+    state = {"flaky": {"state": "closed"}}
+    health = {"ok": True}
+    w = IncidentWatcher(rec, health_fn=lambda: dict(health),
+                        breakers_fn=lambda: state)
+    w.tick(clock.t)
+    state["flaky"]["state"] = "open"      # breaker opens AND health
+    health["ok"] = False                  # flips in the same tick
+    clock.t += 1.0
+    w.tick(clock.t)
+    assert w.triggers == 2                # both edges detected...
+    assert rec.counters()["captured"] == 1   # ...one durable bundle
+    assert rec.counters()["suppressed"] == 1
+
+
+def test_watcher_source_errors_do_not_kill_tick(tmp_path):
+    clock = _Clock()
+    rec = _recorder(tmp_path, clock, min_interval_s=0.0)
+
+    def bad_fn():
+        raise RuntimeError("probe down")
+
+    health = {"ok": True}
+    w = IncidentWatcher(rec, health_fn=lambda: dict(health),
+                        breakers_fn=bad_fn, alerts_fn=bad_fn)
+    w.tick(clock.t)                       # must not raise
+    health["ok"] = False
+    w.tick(clock.t + 1)
+    assert w.triggers == 1                # healthy sources still fire

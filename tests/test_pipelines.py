@@ -224,7 +224,12 @@ def test_datasource_debug_command(tmp_path):
             return debug_request("datasource", port=port, **kw)["data"]
 
         out = ds(op="list")
-        assert {d["interval"] for d in out["datasources"]} == {60}
+        # rollup tiers carry an interval; virtual datasources (timeline,
+        # incidents — ISSUE 16) ride the same listing without one
+        assert {d["interval"] for d in out["datasources"]
+                if "interval" in d} == {60}
+        kinds = {d.get("kind") for d in out["datasources"]}
+        assert {"timeline", "incidents"} <= kinds
         out = ds(op="add", interval=3600, ttl=999)
         assert out["table"].endswith(".1h") and out["ttl_seconds"] == 999
         out = ds(op="retention", interval=3600, ttl=555)

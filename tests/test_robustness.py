@@ -627,9 +627,19 @@ def test_ingester_survives_raising_exporter_and_counts_loss(tmp_path):
         frame = encode_frame(MessageType.COLUMNAR_FLOW,
                              columnar_wire.encode_columnar(cols),
                              FlowHeader(sequence=1, vtap_id=3))
+        # Two waves with a barrier between them: the decoder coalesces
+        # whatever is queued into ONE batch -> ONE exporters.put, so a
+        # loaded machine delivering all frames before the decoder wakes
+        # yields a single put_error and `put_errors >= 2` never holds
+        # (the under-load flake). Waiting for wave 1's error before
+        # sending wave 2 guarantees two distinct put calls.
         with socket.create_connection(("127.0.0.1", ing.port),
                                       timeout=5) as s:
-            for _ in range(8):
+            for _ in range(4):
+                s.sendall(frame)
+            assert _wait(lambda: ing.exporters.put_errors >= 1,
+                         timeout=10)
+            for _ in range(4):
                 s.sendall(frame)
         assert _wait(lambda: ing.exporters.put_errors >= 2, timeout=10)
         assert _wait(

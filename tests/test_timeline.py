@@ -1,0 +1,505 @@
+"""ISSUE 16: the self-telemetry timeline — bounded in-process TSDB,
+SLO burn-rate rules, gauge staleness, and the query-plane integration.
+
+Contracts under test: the per-series ring keeps a hot tier plus a
+coarse downsampled tier with every dropped sample COUNTED; the sampler
+tick snapshots Countables + tracer/profiler gauges and skips fossil
+gauges (stale past 10x the cadence) counted, with /metrics reporting
+the withheld count as deepflow_selfmetric_stale; recording rules
+materialize derived series and SLO rules burn-rate correctly for both
+the ratio and threshold kinds; PromQL (rate, *_over_time, matchers,
+query_range grids) and SQL (SELECT * FROM timeline) answer from the
+rings through the existing engines; /metrics stays strictly valid with
+the slo_burn_rate family attached AND while a racing thread registers
+new gauges mid-render; and the whole lane is bit-invisible to sketch
+device state."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.runtime.timeline import (
+    Timeline, SeriesRing, RecordingRule, SloRule,
+    SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S)
+from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.tracing import Tracer
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ----------------------------------------------------------- SeriesRing
+
+def test_ring_hot_tier_oldest_first():
+    r = SeriesRing("m", {}, cap=8, coarse_every=0)
+    for i in range(5):
+        r.append(100.0 + i, float(i))
+    ts, vs = r.samples()
+    assert ts.tolist() == [100.0, 101.0, 102.0, 103.0, 104.0]
+    assert vs.tolist() == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert r.last == (104.0, 4.0)
+    assert r.overwritten == 0
+
+
+def test_ring_eviction_counted_without_coarse():
+    r = SeriesRing("m", {}, cap=4, coarse_every=0)
+    for i in range(10):
+        r.append(100.0 + i, float(i))
+    ts, _ = r.samples()
+    assert ts.tolist() == [106.0, 107.0, 108.0, 109.0]
+    # 6 evicted, no coarse tier to graduate into: all counted dropped
+    assert r.overwritten == 6
+    assert r.cn == 0
+
+
+def test_ring_coarse_graduation_and_accounting():
+    r = SeriesRing("m", {}, cap=4, coarse_every=2)
+    for i in range(12):
+        r.append(100.0 + i, float(i))
+    # 8 evictions; every 2nd graduates (evicted idx 0,2,4,6), the other
+    # 4 are dropped counted
+    assert r.cn == 4
+    assert r.overwritten == 4
+    ts, vs = r.samples()
+    # coarse (100,102,104,106) strictly older than hot (108..111)
+    assert ts.tolist() == [100.0, 102.0, 104.0, 106.0,
+                           108.0, 109.0, 110.0, 111.0]
+    assert vs[0] == 0.0 and vs[-1] == 11.0
+    # window clipping via searchsorted: [103, 109)
+    ts, _ = r.samples(103.0, 109.0)
+    assert ts.tolist() == [104.0, 106.0, 108.0]
+
+
+def test_ring_coarse_tier_overwrite_counted():
+    r = SeriesRing("m", {}, cap=2, coarse_every=1)
+    for i in range(8):
+        r.append(100.0 + i, float(i))
+    # every eviction graduates; coarse cap == 2, so graduations past
+    # the first two overwrite counted
+    assert r.coarse_overwritten == 4
+    ts, _ = r.samples()
+    # overwritten coarse slots hold newer samples; stale-vs-hot clip
+    # keeps ordering sane
+    assert list(ts) == sorted(ts)
+
+
+def test_ring_empty():
+    r = SeriesRing("m", {}, cap=4, coarse_every=2)
+    ts, vs = r.samples()
+    assert len(ts) == 0 and len(vs) == 0
+    t, v = r.last
+    assert t == 0.0 and v != v
+
+
+# ------------------------------------------------------------- sampling
+
+def test_series_name_mapping():
+    assert Timeline.series_name("exporter.tpu_sketch", "rows_in") \
+        == "tpu_sketch_rows_in"
+    assert Timeline.series_name("receiver", "rx_frames") \
+        == "receiver_rx_frames"
+    assert Timeline.series_name("breaker.tpu_sketch", "opens") \
+        == "breaker_tpu_sketch_opens"
+    assert Timeline.series_name("decoder.flow.0", "batches") \
+        == "decoder_flow_0_batches"
+
+
+def _timeline(clock, **kw):
+    kw.setdefault("sample_s", 1.0)
+    kw.setdefault("hot_samples", 64)
+    kw.setdefault("coarse_every", 4)
+    return Timeline(clock=clock, **kw)
+
+
+def test_sample_once_counters_and_gauges():
+    clock = _Clock()
+    stats = StatsRegistry()
+    rx = {"rx_frames": 0}
+    stats.register("receiver", lambda: dict(rx))
+    tracer = Tracer()
+    tracer.enable()
+    tl = _timeline(clock, stats=stats, tracer=tracer)
+    for i in range(5):
+        clock.t = 1000.0 + i
+        rx["rx_frames"] = i * 10
+        tracer.gauge("querier_read_p99_s", 0.001 * i)
+        # keep the stamp on the fake clock so staleness math is exact
+        tracer._gauge_stamps["querier_read_p99_s"] = clock.t
+        tl.sample_once()
+    assert tl.ticks == 5
+    assert tl.has_metric("receiver_rx_frames")
+    assert tl.has_metric("querier_read_p99_s")
+    ts, vs = tl._rings_of("receiver_rx_frames")[0].samples()
+    assert vs.tolist() == [0.0, 10.0, 20.0, 30.0, 40.0]
+    assert ts.tolist() == [1000.0, 1001.0, 1002.0, 1003.0, 1004.0]
+    # bools and non-numerics never become series
+    assert not tl.has_metric("receiver_ok")
+
+
+def test_stale_gauge_skipped_counted():
+    clock = _Clock()
+    tracer = Tracer()
+    tracer.enable()
+    tracer.gauge("fresh_g", 1.0)
+    tracer.gauge("fossil_g", 2.0)
+    tl = _timeline(clock, tracer=tracer)    # stale_after_s = 10.0
+    tracer._gauge_stamps["fresh_g"] = 995.0     # age 5: live
+    tracer._gauge_stamps["fossil_g"] = 900.0    # age 100: fossil
+    tl.sample_once()
+    assert tl.has_metric("fresh_g")
+    assert not tl.has_metric("fossil_g")
+    assert tl.stale_skipped == 1
+    assert tl.stale_gauges() == {"fossil_g": pytest.approx(100.0)}
+    # the fossil coming back to life clears the stale set
+    tracer._gauge_stamps["fossil_g"] = clock.t
+    tl.sample_once()
+    assert tl.has_metric("fossil_g")
+    assert tl.stale_gauges() == {}
+
+
+def test_unstamped_gauge_is_maximally_stale():
+    clock = _Clock()
+    tracer = Tracer()
+    tracer.enable()
+    tracer._gauges["poked"] = 7.0   # direct poke: no stamp ever landed
+    tl = _timeline(clock, tracer=tracer)
+    tl.sample_once()
+    assert not tl.has_metric("poked")
+    assert "poked" in tl.stale_gauges()
+
+
+def test_recording_rule_and_error_isolation():
+    clock = _Clock()
+    tl = _timeline(clock)
+
+    def boom(_tl, _now):
+        raise RuntimeError("rule bug")
+
+    tl.add_rule(RecordingRule("derived_x", lambda t, now: 42.0))
+    tl.add_rule(RecordingRule("derived_skip", lambda t, now: None))
+    tl.add_rule(RecordingRule("derived_nan",
+                              lambda t, now: float("nan")))
+    tl.add_rule(RecordingRule("derived_boom", boom))
+    tl.sample_once()
+    assert tl.has_metric("derived_x")
+    assert not tl.has_metric("derived_skip")
+    assert not tl.has_metric("derived_nan")   # NaN = skip this tick
+    assert not tl.has_metric("derived_boom")
+    assert tl.rule_errors == 1
+    assert tl.ticks == 1                      # the tick survived
+
+
+# ------------------------------------------------------------ SLO burn
+
+def _fill_counter(tl, name, t0, n, step_s, per_tick):
+    for i in range(n):
+        tl.record(name, float(i * per_tick), now=t0 + i * step_s)
+
+
+def test_slo_ratio_burn_rate():
+    clock = _Clock(2000.0)
+    tl = _timeline(clock, hot_samples=512)
+    # 100 frames/s for 400s; 1 drop/s over the last 100s
+    t0 = 2000.0 - 400.0
+    _fill_counter(tl, "receiver_rx_frames", t0, 401, 1.0, 100.0)
+    for i in range(101):
+        tl.record("receiver_rx_dropped", float(i), now=1900.0 + i)
+    slo = SloRule("ingest_availability", objective=0.999,
+                  bad=("receiver_rx_dropped",),
+                  total=("receiver_rx_frames",))
+    # fast window (300s): 100 bad / 30000 total = 1/300 error frac
+    ef = slo.error_frac(tl, 2000.0, SLO_FAST_WINDOW_S)
+    assert ef == pytest.approx(100.0 / 30000.0, rel=1e-6)
+    assert slo.burn(tl, 2000.0, SLO_FAST_WINDOW_S) \
+        == pytest.approx(ef / 0.001, rel=1e-6)
+
+
+def test_slo_ratio_idle_and_pure_loss():
+    clock = _Clock(2000.0)
+    tl = _timeline(clock)
+    slo = SloRule("a", objective=0.999, bad=("b",), total=("t",))
+    # no samples at all: idle lane burns nothing
+    assert slo.error_frac(tl, 2000.0, 300.0) == 0.0
+    # counted loss with zero accounted total: full burn, not a free pass
+    tl.record("b", 0.0, now=1990.0)
+    tl.record("b", 5.0, now=2000.0)
+    assert slo.error_frac(tl, 2000.0, 300.0) == 1.0
+
+
+def test_slo_threshold_burn_rate():
+    clock = _Clock(3000.0)
+    tl = _timeline(clock, hot_samples=512)
+    # 10 samples, 3 above the bound
+    for i in range(10):
+        v = 0.2 if i in (2, 5, 7) else 0.01
+        tl.record("querier_read_p99_s", v, now=2990.0 + i)
+    slo = SloRule("serving_p99", objective=0.99, kind="threshold",
+                  series="querier_read_p99_s", bound=0.05)
+    assert slo.error_frac(tl, 3000.0, 300.0) == pytest.approx(0.3)
+    assert slo.burn(tl, 3000.0, 300.0) == pytest.approx(0.3 / 0.01)
+
+
+def test_slo_series_and_fast_burning():
+    clock = _Clock(4000.0)
+    tl = _timeline(clock, fast_burn_threshold=14.4)
+    # a threshold SLO permanently violated: error frac 1.0, objective
+    # 0.999 -> burn 1000 on both windows
+    tl.add_slo(SloRule("always_bad", objective=0.999, kind="threshold",
+                       series="bad_g", bound=0.5))
+    tl.add_slo(SloRule("always_good", objective=0.999, kind="threshold",
+                       series="good_g", bound=0.5))
+    for i in range(4):
+        clock.t = 4000.0 + i
+        tl.record("bad_g", 1.0, now=clock.t)
+        tl.record("good_g", 0.0, now=clock.t)
+        tl.sample_once()
+    gauges = {(dict(l)["slo"], dict(l)["window"]): v
+              for l, v in tl.slo_gauges()}
+    assert gauges[("always_bad", "fast")] == pytest.approx(1000.0)
+    assert gauges[("always_bad", "slow")] == pytest.approx(1000.0)
+    assert gauges[("always_good", "fast")] == 0.0
+    assert tl.fast_burning() == ["always_bad"]
+    assert tl.has_metric("slo_burn_rate")
+
+
+# --------------------------------------------------- PromQL datasource
+
+def _prom_engine(tmp_path, tl):
+    from deepflow_tpu.querier.promql import PromEngine
+    from deepflow_tpu.store.db import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    return PromEngine(Store(str(tmp_path / "store")),
+                      TagDictRegistry(None), timeline=tl)
+
+
+def test_promql_rate_over_timeline(tmp_path):
+    clock = _Clock(1060.0)
+    tl = _timeline(clock, hot_samples=256)
+    # counter rising 5/s for 60s
+    _fill_counter(tl, "tpu_sketch_rows_in", 1000.0, 61, 1.0, 5.0)
+    eng = _prom_engine(tmp_path, tl)
+    out = eng.query("rate(tpu_sketch_rows_in[1m])", at=1060)
+    assert len(out) == 1
+    assert float(out[0]["value"][1]) == pytest.approx(5.0, rel=0.05)
+    # instant selector sees the newest-at-or-before sample
+    out = eng.query("tpu_sketch_rows_in", at=1060)
+    assert float(out[0]["value"][1]) == pytest.approx(300.0)
+
+
+def test_promql_matchers_and_over_time(tmp_path):
+    clock = _Clock(1100.0)
+    tl = _timeline(clock, hot_samples=256)
+    for i in range(20):
+        tl.record("slo_burn_rate", float(i),
+                  labels={"slo": "a", "window": "fast"}, now=1080.0 + i)
+        tl.record("slo_burn_rate", 0.5,
+                  labels={"slo": "b", "window": "fast"}, now=1080.0 + i)
+    eng = _prom_engine(tmp_path, tl)
+    out = eng.query('max_over_time(slo_burn_rate{slo="a"}[30s])',
+                    at=1100)
+    assert len(out) == 1
+    assert float(out[0]["value"][1]) == pytest.approx(19.0)
+    # matcher filters series, unknown value -> empty
+    assert eng.query('slo_burn_rate{slo="nope"}', at=1100) == []
+    # both series without a matcher
+    assert len(eng.query("slo_burn_rate", at=1100)) == 2
+
+
+def test_promql_query_range_grid(tmp_path):
+    clock = _Clock(1200.0)
+    tl = _timeline(clock, hot_samples=256)
+    for i in range(60):
+        tl.record("tpu_device_busy_fraction", 0.5 + 0.001 * i,
+                  now=1140.0 + i)
+    eng = _prom_engine(tmp_path, tl)
+    out = eng.query_range("tpu_device_busy_fraction",
+                          start=1150, end=1200, step=10)
+    assert len(out) == 1
+    vals = out[0]["values"]
+    assert len(vals) == 6                  # 1150..1200 step 10
+    assert all(0.5 <= float(v) <= 0.56 for _t, v in vals)
+    # a grid point past the newest sample still answers with the
+    # staleness-window lookback, not a gap
+    out = eng.query_range("tpu_device_busy_fraction",
+                          start=1200, end=1210, step=10)
+    assert out and len(out[0]["values"]) >= 1
+
+
+# ------------------------------------------------------ SQL datasource
+
+def test_sql_select_from_timeline(tmp_path):
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.store.db import Store
+    from deepflow_tpu.store.dict_store import TagDictRegistry
+    clock = _Clock(1500.0)
+    tl = _timeline(clock, hot_samples=8, coarse_every=2)
+    for i in range(20):
+        tl.record("receiver_rx_frames", float(i), now=1400.0 + i)
+    tl.record("slo_burn_rate", 2.0,
+              labels={"slo": "a", "window": "fast"}, now=1419.0)
+    eng = QueryEngine(Store(str(tmp_path / "store")),
+                      TagDictRegistry(None), timeline=tl)
+    r = eng.execute("SELECT * FROM timeline")
+    assert r.columns == ["time", "metric", "labels", "value", "tier"]
+    metrics = {row[1] for row in r.values}
+    assert metrics == {"receiver_rx_frames", "slo_burn_rate"}
+    tiers = {row[4] for row in r.values if row[1] == "receiver_rx_frames"}
+    assert tiers == {"hot", "coarse"}       # both tiers visible, tagged
+    lbl = [row[2] for row in r.values if row[1] == "slo_burn_rate"]
+    assert lbl == ["slo=a,window=fast"]
+    # time bounds + LIMIT
+    r = eng.execute("SELECT * FROM timeline WHERE time >= 1412 "
+                    "AND time < 1415 LIMIT 2")
+    assert len(r.values) == 2
+    assert all(1412 <= row[0] < 1415 for row in r.values)
+    # the datasource answers SELECT * only
+    with pytest.raises(ValueError):
+        eng.execute("SELECT metric FROM timeline")
+
+
+# ------------------------------------------------- /metrics exposition
+
+def test_render_metrics_with_timeline_strict_valid():
+    from deepflow_tpu.runtime.promexpo import (render_metrics,
+                                               validate_exposition)
+    clock = _Clock()
+    stats = StatsRegistry()
+    stats.register("receiver", lambda: {"rx_frames": 3})
+    tracer = Tracer()
+    tracer.enable()
+    tracer.gauge("querier_read_p99_s", 0.01)
+    tracer.gauge("sketch_snapshot_staleness_s", 1.0)
+    tl = _timeline(clock, stats=stats, tracer=tracer)
+    tl.add_slo(SloRule("serving_p99", objective=0.99, kind="threshold",
+                       series="querier_read_p99_s", bound=0.05))
+    tracer._gauge_stamps["querier_read_p99_s"] = clock.t
+    tracer._gauge_stamps["sketch_snapshot_staleness_s"] = 1.0  # fossil
+    tl.sample_once()
+    text = render_metrics(stats, tracer, timeline=tl)
+    assert validate_exposition(text) == []
+    # the fossil gauge is withheld and the count says so
+    assert "deepflow_sketch_snapshot_staleness_s " not in text
+    assert "deepflow_selfmetric_stale 1" in text
+    # burn-rate family rendered with labels and HELP
+    assert "# HELP deepflow_slo_burn_rate" in text
+    assert 'deepflow_slo_burn_rate{slo="serving_p99",window="fast"}' \
+        in text
+
+
+def test_render_metrics_race_with_registering_thread():
+    """ISSUE 16 satellite: a thread registering NEW tracer gauges
+    (names outside GAUGE_HELP) while /metrics renders must never
+    produce an invalid exposition — the renderer synthesizes HELP for
+    unknown gauges instead of emitting a gauge TYPE with no HELP."""
+    from deepflow_tpu.runtime.promexpo import (render_metrics,
+                                               validate_exposition)
+    tracer = Tracer()
+    tracer.enable()
+    stats = StatsRegistry()
+    stop = threading.Event()
+    problems = []
+
+    def registrar():
+        i = 0
+        while not stop.is_set():
+            tracer.gauge(f"hotplug_gauge_{i % 64}", float(i))
+            i += 1
+
+    th = threading.Thread(target=registrar, daemon=True)
+    th.start()
+    try:
+        for _ in range(50):
+            text = render_metrics(stats, tracer)
+            problems.extend(validate_exposition(text))
+    finally:
+        stop.set()
+        th.join(timeout=5)
+    assert problems == []
+    # and the synthesized HELP is actually present for a hotplug gauge
+    text = render_metrics(stats, tracer)
+    assert "# HELP deepflow_trace_hotplug_gauge_0" in text
+
+
+# ------------------------------------------------------ bit-invisibility
+
+def test_sketch_state_bit_identical_with_timeline_on():
+    """Sampling an exporter's counters into a timeline (rules, SLOs and
+    all) must be bit-invisible to sketch device state."""
+    from deepflow_tpu.models.flow_suite import FlowSuiteConfig
+    from deepflow_tpu.replay.generator import ddos_ramp
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+    import jax
+
+    cfg = FlowSuiteConfig()
+    ramp = ddos_ramp(seed=9, rows_per_window=1024)
+    ref = TpuSketchExporter(cfg=cfg, store=None, window_seconds=3600,
+                            wire="lanes", batch_rows=4096)
+    dut = TpuSketchExporter(cfg=cfg, store=None, window_seconds=3600,
+                            wire="lanes", batch_rows=4096)
+    clock = _Clock()
+    stats = StatsRegistry()
+    stats.register("exporter.tpu_sketch", dut.counters)
+    tl = _timeline(clock, stats=stats)
+    tl.add_rule(RecordingRule(
+        "rows_per_s",
+        lambda t, now: t._window_delta("tpu_sketch_rows_in",
+                                       now - 10.0, now) / 10.0))
+    tl.add_slo(SloRule("avail", objective=0.999,
+                       bad=("tpu_sketch_rows_dropped",),
+                       total=("tpu_sketch_rows_in",)))
+    try:
+        for w, _phase, cols in ramp.windows():
+            if w >= 8:
+                break
+            for exp in (ref, dut):
+                exp.process([("l4_flow_log", 0, cols, -1)])
+            ref.flush_window(now=1000.0 + w)
+            dut.flush_window(now=1000.0 + w)
+            clock.t = 1000.0 + w
+            tl.sample_once()
+        assert tl.ticks == 8
+        assert tl.has_metric("tpu_sketch_rows_in")
+        ra = jax.tree_util.tree_leaves(ref.state)
+        rb = jax.tree_util.tree_leaves(dut.state)
+        assert all((np.asarray(x) == np.asarray(y)).all()
+                   for x, y in zip(ra, rb))
+    finally:
+        ref.close()
+        dut.close()
+
+
+# ------------------------------------------------------------ lifecycle
+
+def test_sampler_thread_lifecycle_and_counters():
+    from deepflow_tpu.runtime.supervisor import Supervisor
+    stats = StatsRegistry()
+    stats.register("receiver", lambda: {"rx_frames": 1})
+    tl = Timeline(sample_s=0.02, hot_samples=32, coarse_every=4,
+                  stats=stats)
+    sup = Supervisor()
+    tl.start(sup)
+    try:
+        import time as _t
+        deadline = _t.time() + 5.0
+        while tl.ticks < 3 and _t.time() < deadline:
+            _t.sleep(0.02)
+        assert tl.ticks >= 3
+    finally:
+        tl.stop()
+        sup.close()
+    ticks = tl.ticks
+    import time as _t
+    _t.sleep(0.08)
+    assert tl.ticks == ticks               # sampler actually stopped
+    c = tl.counters()
+    assert c["series"] >= 1
+    assert c["ticks"] == ticks
+    assert c["samples"] >= ticks
+    ds = tl.datasources()
+    assert ds[0]["table"] == "timeline" and ds[0]["series"] >= 1
