@@ -35,6 +35,11 @@ Numbers, one JSON line:
   (acceptance: < 5% at the default config), detection latency in
   windows from ramp onset, and the rows_seen == rows_in conservation
   verdict.
+- `stage_breakdown.multihost_merge`: the ISSUE 17 cross-host DCN epoch
+  at 2 simulated hosts, clean and with one injected marker loss: pod
+  records/s, the DCN epoch-close latency, and the deadline bound (the
+  lossy close excludes the host at ~the marker deadline, counted, with
+  delivered_frac < 1 until the next epoch recovers it).
 - `stage_breakdown.timeline`: the ISSUE 16 self-telemetry sampler tick
   (Countable scrape + ring appends + recording/SLO rules) measured
   beside the window close it rides along: median tick cost, series
@@ -1095,6 +1100,56 @@ def main() -> None:
     }
     _recover()
 
+    # -- timed: cross-host DCN merge (ISSUE 17) ----------------------------
+    # The host ladder above the pod: 2 simulated hosts, measured clean
+    # and with one injected dcn.marker_loss — the artifact shows the
+    # DCN epoch-close latency and that the marker deadline actually
+    # bounds it (the epoch closes at ~deadline with 1/2 hosts instead
+    # of waiting on the lost marker forever).
+    _phase("timed: multihost DCN merge", budget=600.0)
+    from deepflow_tpu.parallel.multihost import HostPodCoordinator
+
+    def _multihost_run(marker_losses: int):
+        faults = default_faults()
+        co = HostPodCoordinator(cfg, n_hosts=2,
+                                shards_per_host=max(1, pod_shards // 2),
+                                transport="sim",
+                                dcn_marker_deadline_s=8.0,
+                                merge_deadline_s=60.0)
+        co.put_lanes(pod_planes[0], batch)      # warm/compile
+        co.drain(120)
+        co.close_epoch()
+        armed = faults.arm_spec(
+            f"dcn.marker_loss:count={marker_losses},match=host1;seed=5") \
+            if marker_losses else []
+        t0 = time.perf_counter()
+        for i in range(iters):
+            co.put_lanes(pod_planes[i % n_batches], batch)
+        co.drain(300)
+        rate = batch * iters / (time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        res = co.close_epoch()
+        close_s = time.perf_counter() - t1
+        c = co.counters()
+        stats = {"records_per_sec": round(rate),
+                 "epoch_close_s": round(close_s, 4),
+                 "hosts_participated":
+                     res.tags["pod_hosts_participated"],
+                 "hosts_missed": c["pod_hosts_missed"],
+                 "markers_lost": c["dcn_markers_lost"],
+                 "delivered_frac": round(
+                     c["pod_rows_delivered"]
+                     / max(c["pod_rows_sent"], 1), 4)}
+        co.close(final_epoch=False)
+        for s in armed:
+            faults.disarm(s)
+        return stats
+
+    multihost_stats = {"hosts": 2,
+                       "clean": _multihost_run(0),
+                       "one_marker_loss": _multihost_run(1)}
+    _recover()
+
     # -- timed: anomaly plane (ISSUE 15) -----------------------------------
     # The detection lane beside the sketch lane: the same ddos_ramp
     # windows flushed twice — detectors off (the reference) and on —
@@ -1220,6 +1275,7 @@ def main() -> None:
         "timeline": timeline_stats,
         "serving": serving_stats,
         "pod_merge": pod_stats,
+        "multihost_merge": multihost_stats,
         "feed_overlap": feed_stats,
         "audit": audit_stats,
         "packed": {"h2d_mb_s": round(packed_h2d),

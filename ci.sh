@@ -867,6 +867,143 @@ print(f"pod OK: {sent} records, 8 shards, {int(c['pod_device_errors'])} "
       f"{int(c['pod_rows_lost'])} rows counted lost, conservation exact")
 EOF
 
+echo "== multihost chaos smoke: DCN partition + host kill + rejoin =="
+# ISSUE 17: the cross-host pod against a LIVE 2-host simulated-DCN
+# ingest. Seeded chaos severs host 1's DCN link at the first epoch
+# marker (held, auto-healed after 2s) and kills the host on the first
+# marker it DOES receive post-heal; the boundary rejoin brings it back.
+# Gates: /healthz names the missing host (503), ingest never blocks,
+# the partitioned epoch excludes the host counted, the kill rejoins to
+# 2/2 hosts, pod-wide conservation `sent == delivered + host + lost +
+# pending` holds off ONE /metrics scrape mid-chaos, and serving topk
+# answers carry the reduced host participation.
+python - <<'EOF'
+import re, socket, time, urllib.request
+import numpy as np
+from deepflow_tpu.batch.schema import L4_SCHEMA
+from deepflow_tpu.enrich.platform_data import PlatformDataManager
+from deepflow_tpu.pipelines import Ingester, IngesterConfig
+from deepflow_tpu.runtime.promexpo import validate_exposition
+from deepflow_tpu.serving import SketchTables, SnapshotCache
+from deepflow_tpu.wire import columnar_wire
+from deepflow_tpu.wire.framing import FlowHeader, MessageType, encode_frame
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        return resp.read().decode()
+
+def counter(text, name):
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+-]+)$", text, re.M)
+    return None if m is None else float(m.group(1))
+
+def healthz(port):
+    import json
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/healthz")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:           # 503 carries the body
+        import json as _j
+        return e.code, _j.load(e)
+
+ing = Ingester(IngesterConfig(
+    listen_port=0, prom_port=0, tpu_sketch_window_s=0.6,
+    tpu_sketch_pod_shards=2, pod_hosts=2, dcn_transport="sim",
+    dcn_marker_deadline_s=1.0, dcn_heal_after_s=2.0,
+    fault_spec=("dcn.partition:count=1,match=host1;"
+                "host.lost:count=1,match=host1;seed=13")),
+    platform=PlatformDataManager())
+pod = ing.tpu_sketch.pod
+assert pod is not None and hasattr(pod, "host_status")
+ing.start()
+r = np.random.default_rng(0)
+cols = {name: r.integers(0, 1 << 8, 500).astype(dt)
+        for name, dt in L4_SCHEMA.columns}
+frame = encode_frame(MessageType.COLUMNAR_FLOW,
+                     columnar_wire.encode_columnar(cols),
+                     FlowHeader(sequence=1, vtap_id=3))
+cache = SnapshotCache(ing.tpu_sketch.snapshot_bus, max_staleness_s=3600)
+tables = SketchTables(cache)
+sent = 0
+saw_missing = saw_link_down = mid_chaos_conserved = False
+deadline = time.time() + 60.0
+with socket.create_connection(("127.0.0.1", ing.port), timeout=5) as s:
+    while time.time() < deadline:
+        s.sendall(frame); sent += 500
+        code, h = healthz(ing.prom_port)
+        if h.get("pod_hosts_lost"):
+            saw_missing = True
+            assert code == 503 and not h["ok"], h   # probe names it
+            assert h["pod_hosts_lost"] == [1], h
+        if h.get("pod_links_down"):
+            saw_link_down = True
+        c = ing.tpu_sketch.counters()
+        if not mid_chaos_conserved and c["pod_hosts_missed"] >= 1:
+            # conservation off ONE scrape while the chaos is live
+            text = scrape(ing.prom_port)
+            P = "deepflow_exporter_tpu_sketch_"
+            terms = [counter(text, P + k) for k in
+                     ("pod_rows_sent", "pod_rows_delivered",
+                      "pod_rows_host", "pod_rows_lost",
+                      "pod_rows_pending")]
+            assert None not in terms, "pod host counters absent"
+            assert terms[0] == sum(terms[1:]), \
+                f"mid-chaos conservation broken: {terms}"
+            mid_chaos_conserved = True
+        if (saw_missing and mid_chaos_conserved
+                and c["pod_host_rejoins"] >= 1
+                and c["pod_hosts_active"] == 2
+                and c["pod_rows_delivered"] > 0):
+            break
+        time.sleep(0.05)
+assert saw_missing, "healthz never reported the lost host"
+assert saw_link_down, "healthz never reported the severed DCN link"
+assert mid_chaos_conserved, "the host was never excluded at the deadline"
+# ingest never blocked on the dead/partitioned host
+deadline = time.time() + 15.0
+while time.time() < deadline and ing.tpu_sketch.rows_in < sent:
+    time.sleep(0.1)
+assert ing.tpu_sketch.rows_in >= sent, \
+    f"ingest stalled: {ing.tpu_sketch.rows_in} < {sent}"
+# recovery: both hosts active on /healthz
+deadline = time.time() + 20.0
+while time.time() < deadline:
+    code, h = healthz(ing.prom_port)
+    if h.get("pod_hosts_active") == 2 and h["ok"]:
+        break
+    time.sleep(0.2)
+assert h["pod_hosts_active"] == 2 and h["ok"], h
+# the full host ledger off /metrics (one scrape)
+text = scrape(ing.prom_port)
+assert not validate_exposition(text)
+P = "deepflow_exporter_tpu_sketch_"
+assert counter(text, P + "pod_hosts_missed") >= 1
+assert counter(text, P + "dcn_partitions") >= 1
+assert counter(text, P + "dcn_heals") >= 1
+assert counter(text, P + "pod_hosts_killed") >= 1
+assert counter(text, P + "pod_host_rejoins") >= 1
+assert counter(text, P + "dcn_markers_sent") >= 1
+# serving answers carry host participation honestly
+rows = tables.topk(5)
+assert rows and "hosts_active" in rows[0], rows[:1]
+assert any(s.tags.get("pod_hosts_participated", 2) < 2
+           for s in cache.window_range(None, None)), \
+    "no reduced-host-participation snapshot was ever published"
+cache.close()
+ing.close()
+c = ing.tpu_sketch.counters()
+assert c["pod_rows_pending"] == 0
+assert c["pod_rows_sent"] == (c["pod_rows_delivered"] + c["pod_rows_host"]
+                              + c["pod_rows_lost"])
+print(f"multihost OK: {sent} records, 2 hosts, "
+      f"{int(c['dcn_partitions'])} partition(s), "
+      f"{int(c['pod_hosts_killed'])} host kill(s), "
+      f"{int(c['pod_host_rejoins'])} rejoin(s), "
+      f"{int(c['pod_hosts_missed'])} missed epoch(s), "
+      f"{int(c['pod_rows_lost'])} rows counted lost, conservation exact")
+EOF
+
 echo "== anomaly smoke: DDoS ramp detection + mid-attack device fault =="
 # ISSUE 15: the anomaly plane against a LIVE ingester. The ddos_ramp
 # profile streams over the socket window-by-window; a tpu.device_error
@@ -1253,6 +1390,20 @@ assert pm["one_straggler"]["merge_missed"] >= 1, pm
 assert pm["one_straggler"]["merge_epoch_s"] < 30.0, pm
 assert pm["one_straggler"]["delivered_frac"] < 1.0, pm
 assert pm["topk_recall_vs_exact"] >= 0.9, pm
+# the cross-host DCN merge (ISSUE 17 acceptance): 2 simulated hosts
+# merge clean at full participation, and one injected marker loss
+# excludes the host at ~the marker deadline (counted) instead of
+# stalling the pod — the close stays deadline-bounded
+mh = d["stage_breakdown"]["multihost_merge"]
+assert mh["hosts"] == 2 and mh["clean"]["records_per_sec"] > 0, mh
+assert mh["clean"]["hosts_participated"] == 2, mh
+assert mh["clean"]["hosts_missed"] == 0, mh
+assert mh["clean"]["delivered_frac"] == 1.0, mh
+assert mh["one_marker_loss"]["markers_lost"] >= 1, mh
+assert mh["one_marker_loss"]["hosts_missed"] >= 1, mh
+assert mh["one_marker_loss"]["hosts_participated"] == 1, mh
+assert mh["one_marker_loss"]["delivered_frac"] < 1.0, mh
+assert mh["one_marker_loss"]["epoch_close_s"] < 30.0, mh
 # the anomaly plane (ISSUE 15 acceptance): the detection lane adds
 # < 5% to window-close latency at the default config, the ramp is
 # detected within <= 2 windows of onset, and the detection lane's

@@ -229,6 +229,14 @@ _SANCTIONED_SYNCS_BY_FILE = {
     # sync — it runs at most once per device error)
     "anomaly/alerts.py": frozenset(["device_lost"]),
     "parallel/pod.py": frozenset(["_contribute", "_probe_device"]),
+    # The ISSUE 17 cross-host coordinator earns exactly one:
+    # `_merge_global` is the cross-host epoch merge — the one stacked
+    # device program of the DCN path, materializing only the merged
+    # window's row count (the same boundary pod.py's merge owns via
+    # _merge_epoch). Host-lane ingest, the DCN transports and the host
+    # agents stay host-pure/async.
+    "parallel/multihost.py": frozenset(["_merge_global",
+                                        "_close_epoch_collective"]),
 }
 
 
@@ -507,7 +515,12 @@ _DATA_NOUNS = frozenset([
     # ISSUE 16: timeline samples and incident bundles are the
     # observability plane's payload — an overwritten ring sample and an
     # evicted bundle both move a Countable, never vanish
-    "sample", "samples", "bundle", "bundles", "incident", "incidents"])
+    "sample", "samples", "bundle", "bundles", "incident", "incidents",
+    # ISSUE 17: DCN epoch markers and host contributions are protocol
+    # payload — a silently vanished marker is a host silently excluded
+    # (dcn_markers_lost must move), a dropped contribution is rows
+    # (pod_rows_lost must move)
+    "marker", "markers", "contribution", "contributions"])
 # a drop path is "counted" when its block provably moves a ledger: any
 # augmented assignment (counter += n), or a call whose name owns a loss
 # verb (self._count_drop(), tracer.incr(...), shed(), ...)

@@ -18,7 +18,10 @@ interleavings of a small, faithful abstraction:
   (weak fairness), counterexample traces rendered as readable
   schedules, state hashing + symmetry reduction over shard ids.
 - `pod_epoch.py` / `spill_drain.py` / `sender_ring.py` — the three
-  committed models, each with seeded mutants the checker must kill.
+  original committed models, each with seeded mutants the checker must
+  kill — joined by `host_pod.py` (ISSUE 17), the 2-host DCN-coordinated
+  epoch ladder over the single-host pod, proven BEFORE its runtime
+  (`parallel/multihost.py::HostPodCoordinator`) was written.
 - `mutate.py` — the self-test harness: flip one model transition at a
   time and assert every mutant dies with a counterexample.
 - `conform.py` — the conformance layer: the models' ledger alphabets
@@ -40,6 +43,14 @@ from deepflow_tpu.analysis.model.mutate import (all_mutants, kill_all,
 
 __all__ = ["Action", "Model", "freeze_state", "CheckResult",
            "Violation", "check", "render_trace", "all_mutants",
-           "kill_all", "model_for"]
+           "kill_all", "model_for", "expand_protocol"]
 
-PROTOCOLS = ("pod", "spill", "sender")
+PROTOCOLS = ("pod", "hostpod", "spill", "sender")
+
+
+def expand_protocol(name: str) -> tuple:
+    """CLI protocol names -> model names. 'pod' covers BOTH pod
+    granularities — the single-host shard ladder and the cross-host
+    host ladder stacked on it — so `df-ctl verify --protocol pod`
+    proves the whole pod story; every other name maps to itself."""
+    return ("pod", "hostpod") if name == "pod" else (name,)

@@ -21,10 +21,10 @@ __all__ = ["model_for", "all_mutants", "kill_all", "KillReport"]
 
 
 def _modules():
-    from deepflow_tpu.analysis.model import (pod_epoch, sender_ring,
-                                             spill_drain)
-    return {"pod": pod_epoch, "spill": spill_drain,
-            "sender": sender_ring}
+    from deepflow_tpu.analysis.model import (host_pod, pod_epoch,
+                                             sender_ring, spill_drain)
+    return {"pod": pod_epoch, "hostpod": host_pod,
+            "spill": spill_drain, "sender": sender_ring}
 
 
 def model_for(protocol: str, mutation: Optional[str] = None):
@@ -73,9 +73,10 @@ def kill_all(protocol: Optional[str] = None, max_faults: int = 2,
     budget by the mutant count."""
     import time
     deadline = None if budget_s is None else time.monotonic() + budget_s
+    from deepflow_tpu.analysis.model import expand_protocol
     report = KillReport()
     for proto, name, _why in all_mutants():
-        if protocol is not None and proto != protocol:
+        if protocol is not None and proto not in expand_protocol(protocol):
             continue
         remaining = None if deadline is None \
             else max(0.0, deadline - time.monotonic())

@@ -286,6 +286,12 @@ class AnomalyPlane:
                 self._advance_unscored()
         alerts: List[AlertRecord] = []
         leaves = None
+        # a window whose merge EXCLUDED a whole host is lossy for the
+        # detectors no matter what the caller's flag said: the scored
+        # output is missing that host's flows, and an untagged score
+        # over a partial pod reads as traffic collapse, not exclusion
+        if participation and participation.get("pod_hosts_missing"):
+            lossy = True
         tags: Dict[str, Any] = {"window": w, "lossy": bool(lossy),
                                 "degraded": bool(degraded),
                                 "scored": scored is not None}

@@ -673,9 +673,11 @@ def cmd_lint(args) -> int:
 
 def cmd_verify(args) -> int:
     """deepflow-model (deepflow_tpu/analysis/model/): exhaustive
-    explicit-state checking of the pod epoch, spill/drain and sender
-    retransmit protocols. The zero-flag form sweeps all three models
-    plus the conformance gate; --mutants runs the seeded kill sweep
+    explicit-state checking of the pod epoch (single-host shard ladder
+    AND the cross-host host ladder), spill/drain and sender retransmit
+    protocols. The zero-flag form sweeps every model plus the
+    conformance gate; --protocol pod covers both pod granularities
+    (pod + hostpod); --mutants runs the seeded kill sweep
     (every mutant must die with a counterexample); --mutant NAME runs
     one mutant and prints its counterexample schedule; --ack-conform
     rewrites the committed .model-conform.json from the current tree
@@ -689,7 +691,8 @@ def cmd_verify(args) -> int:
 
     from deepflow_tpu import analysis
     from deepflow_tpu.analysis import core as _ana_core
-    from deepflow_tpu.analysis.model import (PROTOCOLS, check, model_for,
+    from deepflow_tpu.analysis.model import (PROTOCOLS, check,
+                                             expand_protocol, model_for,
                                              render_trace)
     from deepflow_tpu.analysis.model import conform as _conform
     from deepflow_tpu.analysis.model.mutate import all_mutants, kill_all
@@ -738,8 +741,11 @@ def cmd_verify(args) -> int:
             print(text)
 
     if args.mutant:
-        protos = [args.protocol] if args.protocol else \
-            sorted({p for p, n, _w in all_mutants() if n == args.mutant})
+        cands = expand_protocol(args.protocol) if args.protocol else None
+        protos = sorted({p for p, n, _w in all_mutants()
+                         if n == args.mutant
+                         and (cands is None or p in cands)}) \
+            or list(cands or ())
         if len(protos) != 1:
             print(f"--mutant {args.mutant}: unknown mutant (see "
                   f"--list-mutants), or ambiguous without --protocol",
@@ -788,7 +794,8 @@ def cmd_verify(args) -> int:
             emit(f"mutation self-test: all "
                  f"{len(report.results)} seeded mutants killed")
     else:
-        protos = [args.protocol] if args.protocol else list(PROTOCOLS)
+        protos = list(expand_protocol(args.protocol)) if args.protocol \
+            else list(PROTOCOLS)
         results = []
         for proto in protos:
             res = check(model_for(proto), max_faults=args.max_faults,
@@ -1025,9 +1032,11 @@ def build_parser() -> argparse.ArgumentParser:
         "verify", help="deepflow-model: exhaustive explicit-state "
                        "checking of the pod epoch / spill / sender "
                        "protocols (+ the code-conformance gate)")
-    vf.add_argument("--protocol", choices=["pod", "spill", "sender"],
-                    help="check one protocol (default: all three + "
-                         "the conformance gate)")
+    vf.add_argument("--protocol",
+                    choices=["pod", "hostpod", "spill", "sender"],
+                    help="check one protocol ('pod' covers both the "
+                         "single-host and cross-host pod models; "
+                         "default: all models + the conformance gate)")
     vf.add_argument("--budget-s", type=float, default=None,
                     help="total wall-clock budget; an unfinished sweep "
                          "exits 2 (INCOMPLETE), never a silent pass")

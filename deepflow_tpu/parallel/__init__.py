@@ -1,7 +1,11 @@
 from deepflow_tpu.parallel.mesh import make_mesh
-from deepflow_tpu.parallel.multihost import (init_distributed, local_shard,
+from deepflow_tpu.parallel.multihost import (HostPodCoordinator,
+                                             JaxDcnTransport,
+                                             SimulatedDcnTransport,
+                                             init_distributed, local_shard,
                                              make_global_mesh,
-                                             process_local_batch)
+                                             process_local_batch,
+                                             select_transport)
 from deepflow_tpu.parallel.pod import EpochResult, PodFlowSuite
 from deepflow_tpu.parallel.sharded import (ShardedAppSuite, ShardedFlowSuite,
                                            ShardedMetricsSuite)
@@ -9,4 +13,5 @@ from deepflow_tpu.parallel.sharded import (ShardedAppSuite, ShardedFlowSuite,
 __all__ = ["make_mesh", "ShardedFlowSuite", "ShardedMetricsSuite",
            "ShardedAppSuite", "init_distributed", "make_global_mesh",
            "process_local_batch", "local_shard", "PodFlowSuite",
-           "EpochResult"]
+           "EpochResult", "HostPodCoordinator", "SimulatedDcnTransport",
+           "JaxDcnTransport", "select_transport"]

@@ -83,6 +83,24 @@ class IngesterConfig:
     # 0 keeps the single-chip lane.
     tpu_sketch_pod_shards: int = 0
     pod_merge_deadline_s: float = 5.0
+    # -- cross-host pod (parallel/multihost.py, ISSUE 17) -------------
+    # >= 2 stacks a HOST fault-domain ladder on top of the shard pod:
+    # each host runs its own PodFlowSuite, epoch markers and host
+    # contributions cross the DCN (real jax.distributed collectives in
+    # a multiprocess run, an in-process simulated DCN with seeded
+    # marker-loss/partition/host-kill injection otherwise), a host past
+    # dcn_marker_deadline_s is EXCLUDED (counted) instead of awaited,
+    # and a killed host rejoins at an epoch boundary from its snapbus
+    # snapshots. 0 keeps the single-host lane.
+    pod_hosts: int = 0
+    dcn_marker_deadline_s: float = 5.0
+    # DCN transport: "auto" picks real collectives when the process
+    # joined a jax.distributed run, the simulated DCN otherwise;
+    # "sim"/"jax" force one.
+    dcn_transport: str = "auto"
+    # > 0: a simulated-DCN partition self-heals after this many seconds
+    # (chaos runs drive partition + heal without an in-process hook)
+    dcn_heal_after_s: float = 0.0
     # -- accuracy observatory (runtime/audit.py, ISSUE 6) -------------
     # deterministic flow-hash sampled exact shadow of the tpu_sketch
     # lane: exact per-key counts / distinct count / entropy for the
@@ -301,6 +319,10 @@ class Ingester:
                 pack_workers=cfg.pack_workers,
                 pod_shards=cfg.tpu_sketch_pod_shards,
                 pod_merge_deadline_s=cfg.pod_merge_deadline_s,
+                pod_hosts=cfg.pod_hosts,
+                dcn_marker_deadline_s=cfg.dcn_marker_deadline_s,
+                dcn_transport=cfg.dcn_transport,
+                dcn_heal_after_s=cfg.dcn_heal_after_s,
                 audit_rate=cfg.audit_sample_rate,
                 anomaly=anomaly, anomaly_dir=anomaly_dir)
             self.exporters.register(self.tpu_sketch)
@@ -519,6 +541,19 @@ class Ingester:
                 s["shard"] for s in status if s["status"] == "lost"]
             if out["pod_shards_active"] < pod.n_shards:
                 out["ok"] = False
+            # cross-host pod (ISSUE 17): the probe names WHICH host is
+            # missing, same contract one fault-domain level up
+            if hasattr(pod, "host_status"):
+                hosts = pod.host_status()
+                out["pod_hosts"] = len(hosts)
+                out["pod_hosts_active"] = sum(
+                    1 for h in hosts if h["status"] == "active")
+                out["pod_hosts_lost"] = [
+                    h["host"] for h in hosts if h["status"] == "lost"]
+                out["pod_links_down"] = [
+                    h["host"] for h in hosts if not h["link_up"]]
+                if out["pod_hosts_active"] < len(hosts):
+                    out["ok"] = False
         return out
 
     def _spill_cmd(self, req: dict) -> dict:

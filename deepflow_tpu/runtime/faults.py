@@ -40,6 +40,17 @@ Sites wired in this tree (grep for `FAULT_` constants at the call site):
   and a latent above-threshold excursion is detected at the next
   scored window with its latency honestly > 0
   (``anomaly_detect_latency_windows``)
+- ``host.lost``           — kill one whole host lane of the cross-host
+  pod (parallel/multihost.py; keys are ``hostN:<site>``): its un-merged
+  local rows are counted lost at the epoch-boundary rejoin while its
+  snapbus snapshot re-enters as a LATE contribution (delivered, never
+  silently dropped)
+- ``dcn.partition``       — sever one host's simulated-DCN link: epoch
+  markers and contributions hold back in the transport and deliver on
+  heal (merged LATE, counted ``pod_host_late_merges``), never lost
+- ``dcn.marker_loss``     — drop one epoch marker in DCN transit: the
+  host misses the epoch (counted ``pod_hosts_missed`` /
+  ``pod_host_rows_excluded``) and its rows merge at the next marker
 
 Cost discipline: the registry is OFF by default and every call site
 guards on the module-level ``default_faults().enabled`` flag (one
@@ -73,7 +84,9 @@ __all__ = ["FaultSite", "FaultRegistry", "default_faults",
            "FAULT_DEVICE_ERROR", "FAULT_CHECKPOINT_TORN",
            "FAULT_SPILL_WRITE", "FAULT_SENDER_DISCONNECT",
            "FAULT_SHARD_DEVICE_ERROR", "FAULT_MERGE_STALL",
-           "FAULT_SHARD_LOST", "FAULT_ANOMALY_SCORE", "ALL_FAULT_SITES"]
+           "FAULT_SHARD_LOST", "FAULT_ANOMALY_SCORE", "FAULT_HOST_LOST",
+           "FAULT_DCN_PARTITION", "FAULT_DCN_MARKER_LOSS",
+           "ALL_FAULT_SITES"]
 
 FAULT_RECEIVER_TRUNCATE = "receiver.truncate"
 FAULT_QUEUE_STALL = "queue.stall"
@@ -87,6 +100,9 @@ FAULT_SHARD_DEVICE_ERROR = "shard.device_error"
 FAULT_MERGE_STALL = "merge.stall"
 FAULT_SHARD_LOST = "shard.lost"
 FAULT_ANOMALY_SCORE = "anomaly.score"
+FAULT_HOST_LOST = "host.lost"
+FAULT_DCN_PARTITION = "dcn.partition"
+FAULT_DCN_MARKER_LOSS = "dcn.marker_loss"
 
 # every registered site string in one machine-readable tuple, derived
 # (never hand-listed) from the FAULT_* constants above. Two consumers

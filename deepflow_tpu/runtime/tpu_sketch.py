@@ -188,6 +188,10 @@ class TpuSketchExporter(QueueWorkerExporter):
                  pack_workers: int = 0,
                  pod_shards: int = 0,
                  pod_merge_deadline_s: float = 5.0,
+                 pod_hosts: int = 0,
+                 dcn_marker_deadline_s: float = 5.0,
+                 dcn_transport: str = "auto",
+                 dcn_heal_after_s: float = 0.0,
                  audit_rate: float = 0.0,
                  anomaly=None,
                  anomaly_dir: Optional[str] = None,
@@ -207,8 +211,14 @@ class TpuSketchExporter(QueueWorkerExporter):
         # (that is where the overlap lives), so the single-chip
         # feed/staging knobs are forced off; each window flush closes
         # one merge epoch.
+        # pod_hosts >= 2 stacks the cross-host ladder on top: the lane
+        # routes through a HostPodCoordinator (parallel/multihost.py,
+        # ISSUE 17) — per-host PodFlowSuites, DCN epoch markers, host
+        # deadman exclusion, host kill/rejoin — same duck-typed surface
+        # as the single-host pod, so everything below (window flush =
+        # epoch close, merged bus, counters) is shared.
         self._pod = None
-        if int(pod_shards) >= 2:
+        if int(pod_shards) >= 2 or int(pod_hosts) >= 2:
             import logging
             if wire == "dict":
                 logging.getLogger(__name__).warning(
@@ -220,6 +230,23 @@ class TpuSketchExporter(QueueWorkerExporter):
             wire, staged = "lanes", False
             prefetch_depth = pack_workers = 0
             zero_copy = False
+        if int(pod_hosts) >= 2:
+            from deepflow_tpu.parallel.multihost import (
+                HostPodCoordinator, select_transport)
+
+            # no batch-width divisibility constraint here: the
+            # coordinator re-packs each host's flow-hash slice into a
+            # fresh plane padded to that lane's own shard width
+            self._pod = HostPodCoordinator(
+                self.cfg, n_hosts=int(pod_hosts),
+                shards_per_host=int(pod_shards) or None,
+                transport=select_transport(
+                    dcn_transport, int(pod_hosts),
+                    heal_after_s=(float(dcn_heal_after_s) or None)),
+                dcn_marker_deadline_s=dcn_marker_deadline_s,
+                merge_deadline_s=pod_merge_deadline_s,
+                snapshot_dir=checkpoint_dir)
+        elif int(pod_shards) >= 2:
             from deepflow_tpu.parallel.pod import PodFlowSuite
             import jax as _jax
 
@@ -1338,18 +1365,23 @@ class TpuSketchExporter(QueueWorkerExporter):
             self.windows += 1
             res = self._pod.close_epoch(now=now)
             if self._anomaly is not None:
-                # the pod lane scores the MERGED epoch output; the
+                # the pod lane scores the MERGED epoch output — in
+                # cross-host mode that is the CROSS-HOST merged window,
+                # scored once pod-wide, never once per host; the
                 # active-flow features read 0 there (shard batches
                 # never cross this process's device) and the alert
-                # inherits the epoch's participation tags so a
-                # reduced-participation detection says so
+                # inherits the epoch's participation tags (shard AND
+                # host ladders) so a reduced-participation detection
+                # says so
                 self._anomaly.close_window(
                     res.out, now=now, lossy=res.lossy,
                     degraded=bool(res.degraded),
                     participation={
                         k: res.tags[k]
                         for k in ("pod_shards_participated",
-                                  "pod_shards", "pod_missing")
+                                  "pod_shards", "pod_missing",
+                                  "pod_hosts_participated",
+                                  "pod_hosts", "pod_hosts_missing")
                         if k in res.tags})
             if self._audit is not None:
                 # epochs that excluded a shard (straggler/kill) or

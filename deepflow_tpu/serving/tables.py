@@ -384,6 +384,16 @@ class SketchTables:
                              "pod_shards", 0)),
                          "shards_missing": list(v.snap.tags.get(
                              "pod_missing", []))}
+            # cross-host pod windows (ISSUE 17) append the HOST ladder
+            # too: a top-K served off an epoch that excluded a whole
+            # host names the host, beside the shard columns
+            if "pod_hosts_participated" in v.snap.tags:
+                extra.update(
+                    {"hosts_active":
+                     int(v.snap.tags["pod_hosts_participated"]),
+                     "hosts": int(v.snap.tags.get("pod_hosts", 0)),
+                     "hosts_missing": list(v.snap.tags.get(
+                         "pod_hosts_missing", []))})
             return [dict({"time": v.snap.wall_time,
                           "window": v.snap.step,
                           "rank": r, "flow_key": key, "count": cnt},
@@ -493,6 +503,11 @@ class SketchTables:
                          for v in views)
             if podded:
                 cols = cols + ["shards_active", "shards_missing"]
+            # cross-host windows (ISSUE 17) add the host ladder columns
+            hosted = any("pod_hosts_participated" in v.snap.tags
+                         for v in views)
+            if hosted:
+                cols = cols + ["hosts_active", "hosts_missing"]
             rows = []
             for v in views:
                 # same type as the direct topk() path: the missing-shard
@@ -505,6 +520,14 @@ class SketchTables:
                     if pod_v else None,
                     [int(i) for i in v.snap.tags.get("pod_missing", [])]
                     if pod_v else None]
+                host_v = "pod_hosts_participated" in v.snap.tags
+                if hosted:
+                    tail = tail + [
+                        int(v.snap.tags["pod_hosts_participated"])
+                        if host_v else None,
+                        [int(i) for i in v.snap.tags.get(
+                            "pod_hosts_missing", [])]
+                        if host_v else None]
                 for r, (key, cnt) in enumerate(v.topk(k)):
                     rows.append([int(v.snap.wall_time), v.snap.step,
                                  r, key, cnt] + tail)

@@ -1,0 +1,456 @@
+"""Cross-host pod (parallel/multihost.py + analysis/model/host_pod.py,
+ISSUE 17): the DCN-coordinated host ladder, model-checked before built.
+
+Contracts under test:
+
+- the 2-host `hostpod` model sweeps clean and COMPLETE at <=2 faults
+  (and at a deeper row budget under `slow`), and every seeded protocol
+  mutant dies with a counterexample;
+- the conformance gate trips when the multihost runtime drifts from a
+  committed fingerprint (twin edit, counter drift) — the fixture-level
+  round-trip of the gate `df-ctl verify --ack-conform` commits;
+- merge equivalence: with no faults the 2-host merged epoch equals a
+  single-host pod over the same rows (the in-process stand-in for the
+  real-silicon run tests/test_multihost.py can only do on TPU);
+- the fault ladders: marker loss excludes-then-recovers, a partition
+  holds contributions for a late merge after heal, a killed host
+  rejoins by snapshot with its shipped rows DELIVERED, ingest to a
+  LOST host drops counted — pod-wide conservation
+  (`pod_rows_sent == pod_rows_delivered + pod_rows_host +
+  pod_rows_lost + pod_rows_pending`) exact at every probe;
+- honest degradation above the pod: the anomaly plane forces `lossy`
+  on a host-excluded window and AlertRecords carry the host keys, and
+  serving topk answers grow `hosts_active`/`hosts_missing` columns.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu import analysis
+from deepflow_tpu.analysis import core as ana_core
+from deepflow_tpu.analysis.model import (check, conform, host_pod,
+                                         model_for, render_trace)
+from deepflow_tpu.analysis.model.mutate import kill_all
+from deepflow_tpu.models import FlowSuiteConfig, flow_suite
+from deepflow_tpu.parallel import HostPodCoordinator, PodFlowSuite
+from deepflow_tpu.runtime.faults import default_faults
+from deepflow_tpu.replay import SyntheticAgent
+
+CFG = FlowSuiteConfig(cms_log2_width=10, ring_size=128, top_k=20,
+                      hll_groups=32, hll_precision=6,
+                      entropy_log2_buckets=8)
+B = 1024
+KEEP = ("ip_src", "ip_dst", "port_src", "port_dst", "proto",
+        "packet_tx", "packet_rx")
+
+
+@pytest.fixture
+def faults():
+    f = default_faults()
+    armed = []
+    yield lambda spec: armed.extend(f.arm_spec(spec))
+    for site in armed:
+        f.disarm(site)
+
+
+def _plane(agent, n=B):
+    cols = agent.l4_columns_pooled(n)
+    lanes = flow_suite.pack_lanes(
+        {k: cols[k].astype(np.uint32) for k in KEEP})
+    return np.stack([lanes[k] for k in flow_suite.SKETCH_LANE_NAMES])
+
+
+def _coordinator(**kw):
+    kw.setdefault("n_hosts", 2)
+    kw.setdefault("shards_per_host", 2)
+    kw.setdefault("transport", "sim")
+    kw.setdefault("dcn_marker_deadline_s", 5.0)
+    kw.setdefault("merge_deadline_s", 5.0)
+    return HostPodCoordinator(CFG, **kw)
+
+
+def _conserve(co):
+    c = co.counters()
+    assert c["pod_rows_sent"] == (c["pod_rows_delivered"]
+                                  + c["pod_rows_host"]
+                                  + c["pod_rows_lost"]
+                                  + c["pod_rows_pending"]), c
+    return c
+
+
+# ------------------------------------------------ the model, first
+
+def test_hostpod_model_sweeps_clean():
+    res = check(model_for("hostpod"), max_faults=2)
+    assert res.ok and res.complete, render_trace(res)
+    assert res.states > 1000         # an exhaustive sweep, not a stub
+    assert res.violation is None
+
+
+@pytest.mark.slow
+def test_hostpod_model_clean_at_three_rows():
+    old = host_pod.SENDS
+    host_pod.SENDS = 3
+    try:
+        res = check(host_pod.build(), max_faults=2)
+    finally:
+        host_pod.SENDS = old
+    assert res.ok and res.complete, render_trace(res)
+
+
+def test_hostpod_mutants_all_killed():
+    report = kill_all(protocol="hostpod", max_faults=2)
+    assert set(report.results) == {
+        ("hostpod", name) for name in host_pod.MUTANTS}
+    assert len(report.results) >= 4
+    assert not report.survivors, report.survivors
+    for key, res in report.results.items():
+        assert res.violation is not None and res.violation.trace, key
+
+
+def test_hostpod_fault_alphabet_is_registered():
+    from deepflow_tpu.runtime.faults import ALL_FAULT_SITES
+    declared = set(host_pod.CONFORMANCE["fault_sites"])
+    assert declared <= set(ALL_FAULT_SITES)
+    dcn_sites = {s for s in ALL_FAULT_SITES
+                 if s.startswith(("host.", "dcn."))}
+    assert dcn_sites <= declared
+
+
+# ------------------------------------- conformance gate (fixture-level)
+
+_FIX_CODE = """\
+class SimulatedDcnTransport:
+    def heal(self, host=None):
+        return host
+
+class HostPodCoordinator:
+    def put_lanes(self, plane, n):
+        return n
+    def close_epoch(self, now=None):
+        return None
+    def counters(self):
+        c = {"pod_rows_sent": 1, "pod_rows_lost": 2}
+        c["pod_hosts_missed"] = 3
+        return c
+"""
+
+_FIX_FAULTS = """\
+FAULT_HOST_LOST = "host.lost"
+FAULT_DCN_PARTITION = "dcn.partition"
+FAULT_DCN_MARKER_LOSS = "dcn.marker_loss"
+"""
+
+_FIX_MODEL = """\
+CONFORMANCE = {
+    "protocol": "hostpod",
+    "ledgers": [
+        {"src":
+            "pkg/parallel/multihost.py:HostPodCoordinator.counters",
+         "counters": ["pod_rows_sent", "pod_rows_lost",
+                      "pod_hosts_missed"]},
+    ],
+    "fault_sites": ["host.lost", "dcn.partition", "dcn.marker_loss"],
+    "site_prefixes": ["host.", "dcn."],
+    "twins": {
+        "send":
+            "pkg/parallel/multihost.py:HostPodCoordinator.put_lanes",
+        "close_epoch":
+            "pkg/parallel/multihost.py:HostPodCoordinator.close_epoch",
+        "heal":
+            "pkg/parallel/multihost.py:SimulatedDcnTransport.heal",
+    },
+}
+"""
+
+
+def _sources(code=_FIX_CODE):
+    return {"pkg/parallel/multihost.py": code,
+            "pkg/runtime/faults.py": _FIX_FAULTS,
+            "pkg/analysis/model/mini_hostpod.py": _FIX_MODEL}
+
+
+def _store_for(sources):
+    _ctxs, index, errors = ana_core.build_index(sorted(sources.items()))
+    assert not errors
+    store, missing = conform.build_store(index)
+    assert not missing, missing
+    return store
+
+
+def test_hostpod_conformance_trips_on_runtime_drift():
+    sources = _sources()
+    # unacked -> the finding df-ctl verify --ack-conform clears
+    fs = analysis.run_on_sources(sources, rules=["model-conform"])
+    assert any("no committed conformance fingerprint" in f.message
+               for f in fs)
+    store = _store_for(sources)
+    assert analysis.run_on_sources(sources, rules=["model-conform"],
+                                   conform_store=store) == []
+    # a twin edit (the model's `send`) trips against the same store
+    drifted = _sources(code=_FIX_CODE.replace("return n", "return n + 1"))
+    msgs = [f.message for f in analysis.run_on_sources(
+        drifted, rules=["model-conform"], conform_store=store)]
+    assert any("modeled as 'send'" in m and "changed since" in m
+               for m in msgs)
+    # counter drift: the host ledger loses a modeled counter
+    drifted = _sources(code=_FIX_CODE.replace(
+        '"pod_hosts_missed"', '"pod_hosts_misst"'))
+    msgs = [f.message for f in analysis.run_on_sources(
+        drifted, rules=["model-conform"], conform_store=store)]
+    assert any("pod_hosts_missed" in m for m in msgs)
+
+
+def test_real_multihost_twins_resolve():
+    # every qualname the shipped model twins must exist in the shipped
+    # runtime — the same resolution `df-ctl verify --ack-conform` does
+    import inspect
+
+    import deepflow_tpu.parallel.multihost as mh
+    for twin in host_pod.CONFORMANCE["twins"].values():
+        path, _, qual = twin.partition(":")
+        assert path.endswith("multihost.py"), twin
+        obj = mh
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        assert inspect.isfunction(obj) or inspect.ismethod(obj), twin
+
+
+# ------------------------------------------------ runtime: equivalence
+
+def test_hostpod_merge_matches_single_pod():
+    """No faults: the 2-host DCN-merged epoch must equal a single-host
+    4-shard pod over the same rows — host routing + hierarchical merge
+    change WHERE state accumulates, never the merged window."""
+    agent = SyntheticAgent(seed=11)
+    planes = [_plane(agent) for _ in range(3)]
+
+    ref = PodFlowSuite(CFG, n_shards=4, merge_deadline_s=5.0)
+    for p in planes:
+        ref.put_lanes(p, B)
+    assert ref.drain(30)
+    ref_res = ref.close_epoch()
+    ref.close(final_epoch=False)
+
+    co = _coordinator()
+    for p in planes:
+        co.put_lanes(p, B)
+    assert co.drain(30)
+    res = co.close_epoch()
+    c = _conserve(co)
+    co.close(final_epoch=False)
+
+    assert res.merged_rows == ref_res.merged_rows == 3 * B
+    assert c["pod_rows_delivered"] == 3 * B
+    assert res.tags["pod_hosts_participated"] == 2
+    assert res.tags["pod_hosts_missing"] == [] and not res.lossy
+    r_out, h_out = ref_res.out, res.out
+    # the additive/max sketch planes merge associatively, so the
+    # entropy features are exact; the ring's tail order may differ on
+    # count ties between flat and hierarchical candidate unions, so
+    # the top-K contract is: same head, and every surviving key priced
+    # at the same merged-CMS count the flat merge gives it
+    np.testing.assert_allclose(np.asarray(h_out.entropies),
+                               np.asarray(r_out.entropies), atol=1e-5)
+    ref_counts = dict(zip(np.asarray(r_out.topk_keys).tolist(),
+                          np.asarray(r_out.topk_counts).tolist()))
+    h_keys = np.asarray(h_out.topk_keys).tolist()
+    h_counts = np.asarray(h_out.topk_counts).tolist()
+    np.testing.assert_array_equal(h_keys[:8],
+                                  np.asarray(r_out.topk_keys)[:8])
+    np.testing.assert_array_equal(h_counts[:8],
+                                  np.asarray(r_out.topk_counts)[:8])
+    for k, n in zip(h_keys, h_counts):
+        if k in ref_counts:
+            assert n == ref_counts[k], (k, n, ref_counts[k])
+
+
+# ------------------------------------------------ runtime: fault ladders
+
+def test_marker_loss_excludes_host_then_recovers(faults):
+    """A lost epoch marker excludes the WHOLE host past the DCN
+    deadline (counted, tagged lossy) — and the next epoch's marker
+    recovers every excluded row: delivered catches up to sent."""
+    co = _coordinator()
+    agent = SyntheticAgent(seed=3)
+    co.put_lanes(_plane(agent), B)            # warm epoch: jit compile
+    assert co.drain(30)
+    assert co.close_epoch().missed == []
+    faults("dcn.marker_loss:count=1,match=host1;seed=7")
+    co.put_lanes(_plane(agent), B)
+    assert co.drain(30)
+    res = co.close_epoch(deadline_s=0.6)
+    assert res.missed == [1] and res.lossy
+    assert res.tags["pod_hosts_missing"] == [1]
+    c = _conserve(co)
+    assert c["pod_hosts_missed"] == 1
+    assert c["dcn_markers_lost"] == 1
+    assert c["pod_host_rows_excluded"] > 0
+    assert c["pod_rows_pending"] > 0          # excluded, not lost
+    res2 = co.close_epoch()                   # next marker arrives
+    assert res2.missed == [] and res2.tags["pod_hosts_participated"] == 2
+    co.close(final_epoch=False)
+    c = _conserve(co)
+    assert c["pod_rows_delivered"] == c["pod_rows_sent"] == 2 * B
+    assert c["pod_rows_pending"] == 0
+
+
+def test_partition_holds_contribution_until_heal(faults):
+    """A severed DCN link HOLDS messages (partition is not loss): the
+    epoch excludes the host, heal releases the held contribution and
+    it merges late — delivered == sent, nothing dropped."""
+    co = _coordinator()
+    agent = SyntheticAgent(seed=5)
+    co.put_lanes(_plane(agent), B)            # warm epoch: jit compile
+    assert co.drain(30)
+    assert co.close_epoch().missed == []
+    faults("dcn.partition:count=1,match=host1;seed=7")
+    co.put_lanes(_plane(agent), B)
+    assert co.drain(30)
+    res = co.close_epoch(deadline_s=0.6)
+    assert res.missed == [1] and res.lossy
+    c = _conserve(co)
+    assert c["dcn_partitions"] == 1 and c["dcn_links_down"] == 1
+    assert c["dcn_held_messages"] >= 1
+    co.transport.heal(1)
+    res2 = co.close_epoch()
+    assert res2.tags["pod_hosts_participated"] == 2
+    co.close(final_epoch=False)
+    c = _conserve(co)
+    assert c["dcn_heals"] == 1 and c["dcn_links_down"] == 0
+    assert c["pod_host_late_merges"] >= 1
+    assert c["pod_rows_delivered"] == c["pod_rows_sent"] == 2 * B
+    assert c["pod_rows_pending"] == 0
+
+
+def test_host_kill_rejoins_by_snapshot(faults):
+    """host.lost fires inside the host's DCN agent: the host dies
+    holding the marker, the epoch counts it lost, and the boundary
+    rejoin re-ships its snapbus contributions — closed rows DELIVER
+    (late), only the un-snapshotted tail counts lost."""
+    co = _coordinator()
+    agent = SyntheticAgent(seed=9)
+    co.put_lanes(_plane(agent), B)            # warm epoch: jit compile
+    assert co.drain(30)
+    assert co.close_epoch().missed == []
+    co.put_lanes(_plane(agent), B)
+    assert co.drain(30)
+    co.snapshot_host(1)            # local close -> outbox entry on bus
+    faults("host.lost:count=1,match=host1;seed=7")
+    res = co.close_epoch(deadline_s=0.6)   # marker delivery kills host 1
+    # the host was live at marker SEND and died holding the marker, so
+    # this epoch excludes it as missed (a kill before the marker went
+    # out would land it in res.lost instead)
+    assert res.lossy and (res.missed == [1] or res.lost == [1])
+    c = _conserve(co)
+    assert c["pod_hosts_killed"] == 1
+    res2 = co.close_epoch()        # boundary rejoin: outbox re-ships
+    assert res2.lost == [1]
+    c = _conserve(co)
+    assert c["pod_host_rejoins"] == 1
+    assert all(h["status"] == "active" for h in co.host_status())
+    co.close()                     # the re-shipped outbox merges LATE
+    c = _conserve(co)
+    assert c["pod_rows_pending"] == 0
+    assert c["pod_host_late_merges"] >= 1
+    # everything locally closed before the kill DELIVERED
+    assert c["pod_rows_delivered"] + c["pod_rows_lost"] == 2 * B
+    assert c["pod_rows_delivered"] > B        # host 0 + the snapshot
+
+
+def test_ingest_to_lost_host_drops_counted():
+    co = _coordinator(auto_rejoin=False)
+    agent = SyntheticAgent(seed=13)
+    co.kill_host(1)
+    co.put_lanes(_plane(agent), B)
+    assert co.drain(30)
+    co.close_epoch()
+    c = _conserve(co)
+    assert c["pod_rows_lost"] > 0             # host 1's routed slice
+    assert c["pod_rows_delivered"] > 0        # host 0 kept merging
+    assert c["pod_rows_lost"] + c["pod_rows_delivered"] == B
+    st = {h["host"]: h for h in co.host_status()}
+    assert st[1]["status"] == "lost" and st[1]["rows_dropped"] > 0
+    sh = {s["shard"]: s["status"] for s in co.shard_status()}
+    assert all(v == "lost" for k, v in sh.items() if k >= 2)
+    co.close(final_epoch=False)
+    _conserve(co)
+
+
+# ------------------------------------- honest degradation above the pod
+
+def test_anomaly_window_forced_lossy_on_missing_host():
+    """A window whose merge excluded a whole host scores lossy no
+    matter what the caller said, and the AlertRecord's participation
+    carries the host keys — regression for the ISSUE 17 alerts hook."""
+    from deepflow_tpu.anomaly import AnomalyConfig, AnomalyPlane
+    from deepflow_tpu.models.flow_suite import FlowWindowOutput
+
+    def out(rows, ent):
+        k = CFG.top_k
+        counts = np.zeros(k, np.int32)
+        counts[0] = rows // 8
+        return FlowWindowOutput(
+            topk_keys=np.zeros(k, np.uint32),
+            topk_counts=counts,
+            service_cardinality=np.asarray([100.0], np.float32),
+            entropies=np.asarray(ent, np.float32),
+            rows=np.asarray(rows, np.int32))
+
+    plane = AnomalyPlane(AnomalyConfig(warmup_windows=2, entropy_z=0.0,
+                                       pca_z=1e9, mp_threshold=1e9))
+    for w in range(4):
+        plane.close_window(out(4000, [0.8, 0.5, 0.9, 0.3]),
+                           now=100.0 + w)
+        plane.publish_pending()
+    part = {"pod_hosts": 2, "pod_hosts_participated": 1,
+            "pod_hosts_missing": [1]}
+    alerts = plane.close_window(out(4000, [0.8, 0.5, 0.9, 0.3]),
+                                now=200.0, lossy=False,
+                                participation=part)
+    plane.publish_pending()
+    assert alerts, "entropy_z=0 must fire past warmup"
+    rec = alerts[0]
+    assert rec.lossy                            # forced, caller said no
+    assert rec.participation["pod_hosts_missing"] == [1]
+    assert rec.participation["pod_hosts"] == 2
+
+
+def test_exporter_serving_host_columns(faults, tmp_path):
+    """pod_hosts=2 end-to-end through the exporter: the cross-host
+    MERGED snapshot lands on the bus with host participation tags and
+    serving topk rows carry hosts_active/hosts_missing."""
+    from deepflow_tpu.batch.schema import L4_SCHEMA
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+    from deepflow_tpu.serving import SketchTables, SnapshotCache
+
+    exp = TpuSketchExporter(store=None, cfg=CFG, window_seconds=3600,
+                            batch_rows=B, pod_shards=2, pod_hosts=2,
+                            dcn_transport="sim",
+                            pod_merge_deadline_s=5.0)
+    assert exp.pod is not None and hasattr(exp.pod, "host_status")
+    cache = SnapshotCache(exp.snapshot_bus, max_staleness_s=3600)
+    tables = SketchTables(cache)
+    rng_ = np.random.default_rng(0)
+    cols = {name: rng_.integers(0, 1 << 10, 2 * B).astype(dt)
+            for name, dt in L4_SCHEMA.columns}
+    exp.process([("l4_flow_log", 0, cols)])
+    assert exp.pod.drain(30)
+    out = exp.flush_window()
+    assert out is not None
+    snap = cache.latest()
+    assert snap.tags["pod_hosts"] == 2
+    assert snap.tags["pod_hosts_participated"] == 2
+    assert snap.tags["pod_hosts_missing"] == []
+    rows = tables.topk(5)
+    assert rows and rows[0]["hosts_active"] == 2
+    assert rows[0]["hosts_missing"] == []
+    exp.close()
+    c = exp.counters()
+    assert c["pod_rows_pending"] == 0
+    assert c["pod_rows_sent"] == (c["pod_rows_delivered"]
+                                  + c["pod_rows_host"]
+                                  + c["pod_rows_lost"])
+    cache.close()

@@ -159,17 +159,34 @@ def test_two_process_mesh_matches_single_process():
     coord = f"127.0.0.1:{_free_port()}"
     workers = [_run_worker(coord, 2, pid, 4) for pid in range(2)]
     outs = []
+    errs = []
     try:
         for w in workers:
             out, err = w.communicate(timeout=300)
-            assert w.returncode == 0, err
-            outs.append(_result(out))
+            if w.returncode != 0:
+                errs.append(err)
+            else:
+                outs.append(_result(out))
     finally:
         # a failed/hung worker must not linger holding the coordinator
         # port while its peer blocks in distributed init
         for w in workers:
             if w.poll() is None:
                 w.kill()
+    if errs and any("Multiprocess computations aren't implemented on "
+                    "the CPU backend" in e for e in errs):
+        # env-bound, not a code bug: XLA's CPU backend has no
+        # cross-process collective implementation, so the coordinated
+        # 2-process half of this test can only run on real multi-host
+        # silicon. The cross-host ladder itself IS covered on CPU —
+        # tests/test_hostpod.py drives the 2-host HostPodCoordinator
+        # over the in-process SimulatedDcnTransport end to end.
+        pytest.skip(
+            "jax CPU backend cannot run multiprocess collectives "
+            "(XLA: \"Multiprocess computations aren't implemented on "
+            "the CPU backend\"); cross-host merge equivalence runs "
+            "in-process in tests/test_hostpod.py instead")
+    assert not errs, errs[0]
 
     for r in outs:
         assert r["rows"] == base["rows"]
