@@ -58,10 +58,22 @@ assert doc["version"] == "2.1.0", doc.get("version")
 rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
 for need in ("lock-order-cycle", "unlocked-shared-write",
              "silent-drop", "twin-drift", "model-conform",
-             "doc-drift"):
+             "doc-drift",
+             # ISSUE 18: the device-plane rules must be registered
+             "donation-use-after-donate", "retrace-hazard",
+             "u32-overflow", "pytree-schema-drift"):
     assert need in rules, f"SARIF rule table missing {need}"
 print(f"lint.sarif: {len(rules)} rules, "
       f"{len(doc['runs'][0]['results'])} gated result(s)")
+# the device-plane gate only has teeth while both stores are
+# committed (deleting one disarms it silently — fail loudly here)
+for path, key, floor in ((".lint-programs.json", "programs", 20),
+                         (".lint-schemas.json", "schemas", 14)):
+    store = json.load(open(path))
+    assert store["version"] == 1, path
+    n = len(store[key])
+    assert n >= floor, f"{path}: {n} {key} < {floor}"
+    print(f"{path}: {n} acknowledged {key}")
 EOF
 
 echo "== deepflow-model: exhaustive protocol verification =="
@@ -142,6 +154,56 @@ with tempfile.TemporaryDirectory() as td:
     assert ack2.returncode == 0, ack2.stderr
     assert run().returncode == 0
 print("twin gate: ack -> clean, edit -> trip, re-ack -> clean")
+EOF
+
+echo "== device-plane gate: donated reuse trips live =="
+# ISSUE 18 acceptance: the PR-15 bug class — a donated state buffer
+# read after the donating dispatch — must fail the gate on a live
+# throwaway tree, cross-file through a jit-returning factory; and a
+# jit cache-key edit without --ack-programs must name the callable
+python - <<'EOF'
+import pathlib, subprocess, sys, tempfile
+
+with tempfile.TemporaryDirectory() as td:
+    td = pathlib.Path(td)
+    (td / "detectors.py").write_text(
+        "import jax\n"
+        "def make_window_step(cfg):\n"
+        "    return jax.jit(lambda s, rows: s, donate_argnums=0)\n")
+    (td / "alerts.py").write_text(
+        "import detectors\n"
+        "class Engine:\n"
+        "    def __init__(self, cfg):\n"
+        "        self._step = detectors.make_window_step(cfg)\n"
+        "    def feed(self, state, rows):\n"
+        "        out = self._step(state, rows)\n"
+        "        return state\n")     # <- read after donation
+    run = lambda *a: subprocess.run(
+        [sys.executable, "-m", "deepflow_tpu.cli", "lint", str(td), *a],
+        capture_output=True, text=True)
+    tripped = run("--rules", "donation-use-after-donate")
+    assert tripped.returncode == 1, tripped.stdout
+    assert "donated" in tripped.stdout and "alerts.py" in tripped.stdout
+    # the sanctioned shape — rebind the result over the donated name
+    (td / "alerts.py").write_text((td / "alerts.py").read_text().replace(
+        "        out = self._step(state, rows)\n",
+        "        state = self._step(state, rows)\n"))
+    assert run("--rules", "donation-use-after-donate").returncode == 0
+    # cache-key edits go through --ack-programs, like twin edits
+    store = td / "programs.json"
+    ack = run("--programs", str(store), "--ack-programs")
+    assert ack.returncode == 0, ack.stderr + ack.stdout
+    assert run("--programs", str(store),
+               "--rules", "retrace-hazard").returncode == 0
+    (td / "detectors.py").write_text(
+        (td / "detectors.py").read_text().replace(
+            "donate_argnums=0", "donate_argnums=0, static_argnums=1"))
+    drift = run("--programs", str(store), "--rules", "retrace-hazard")
+    assert drift.returncode == 1, drift.stdout
+    assert "make_window_step" in drift.stdout \
+        and "--ack-programs" in drift.stdout, drift.stdout
+print("device gate: donated reuse trips, rebind clean, "
+      "key edit needs --ack-programs")
 EOF
 
 echo "== pytest =="

@@ -195,49 +195,25 @@ _DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
 # boundary flush_window already fetches them; everything else in
 # audit.py/profiler.py must stay host-pure, which is why they are under
 # this rule at all)
+# ONE global set of sanctioned sync HELPER names (functions whose whole
+# point is the blocking device fetch), replacing the ISSUE 7-17 era
+# per-FILE allowlist (ISSUE 18): the finding is now a device VALUE
+# reaching a materializer, not a file — see the per-value pass below,
+# which covers every file via the devprog jit-site index. Beyond the
+# original sampled-drain helpers, the set carries: `device_lost` (the
+# anomaly plane's once-per-device-error baseline salvage),
+# `_contribute`/`_probe_device` (the pod epoch protocol's one
+# device_get per shard per epoch + the PR 2 degraded-recovery probe on
+# the shard ladder), and `_merge_global`/`_close_epoch_collective`
+# (the cross-host epoch merge — the one stacked device program of the
+# DCN path). serving/cache.py's `refresh` needed no sanction at all:
+# it re-reads the bus/disk, never the device.
 _SANCTIONED_SYNCS = frozenset(["_to_device", "_timed_update", "put_batch",
                                "_probe_device_locked", "_fence_one",
                                "_discard_inflight", "close_window",
-                               "_compare"])
-# per-FILE sanctions: the ISSUE 7 serving read path is under the rule
-# with the stale-cache `refresh` (a bus/disk re-read, never the device)
-# its only sanctioned sync — scoped to cache.py because "refresh" is
-# far too common a method name to exempt across every device-path file.
-# The ISSUE 9 zero-copy stager is under the rule to stay host-pure
-# (its buffers feed the device transfer; a device sync here would
-# serialize the pack against the chip) — no sanctioned syncs at all.
-# The ISSUE 10 pod fault-domain layer (parallel/ is under the rule
-# path-wide) earns exactly two: `_contribute` is the epoch protocol's
-# one device_get per shard per epoch (the contribution copy — epoch
-# merges are DEFINED as a host-side merge of shard copies), and
-# `_probe_device` is the PR 2 degraded-recovery probe on the pod's
-# per-shard ladder. Shard batch updates stay async.
-# The ISSUE 15 anomaly plane is under the rule on all three files:
-# detectors.py must stay a pure device program library (zero sanctioned
-# syncs), alerts.py materializes the window's scores ONLY inside
-# close_window (already the globally-sanctioned window-close name the
-# audit uses, same boundary, same argument), and serving/anomaly.py is
-# a snapshot-cache reader like tables.py (host arrays only; the cache's
-# `refresh` is its one sanctioned sync, scoped via serving/cache.py).
-_SANCTIONED_SYNCS_BY_FILE = {
-    "serving/cache.py": frozenset(["refresh"]),
-    "batch/staging.py": frozenset(),
-    "anomaly/detectors.py": frozenset(),
-    # device_lost is the anomaly plane's error-path recovery: ONE
-    # device_get to salvage the detection baselines off a possibly-dead
-    # chain (the _restore_device_state_locked posture, not a hot-path
-    # sync — it runs at most once per device error)
-    "anomaly/alerts.py": frozenset(["device_lost"]),
-    "parallel/pod.py": frozenset(["_contribute", "_probe_device"]),
-    # The ISSUE 17 cross-host coordinator earns exactly one:
-    # `_merge_global` is the cross-host epoch merge — the one stacked
-    # device program of the DCN path, materializing only the merged
-    # window's row count (the same boundary pod.py's merge owns via
-    # _merge_epoch). Host-lane ingest, the DCN transports and the host
-    # agents stay host-pure/async.
-    "parallel/multihost.py": frozenset(["_merge_global",
-                                        "_close_epoch_collective"]),
-}
+                               "_compare", "device_lost", "_contribute",
+                               "_probe_device", "_merge_global",
+                               "_close_epoch_collective"])
 
 
 @register
@@ -251,31 +227,48 @@ class HostSyncInDevicePath(Checker):
     name = "host-sync-in-device-path"
     description = ("blocking device sync (block_until_ready/device_get/"
                    ".item(), or np.asarray/float/int materializing "
-                   "device state) in the async device path outside the "
-                   "sanctioned sampled-drain helpers")
+                   "device state) in the async device path — or a "
+                   "jitted program's result value materialized in ANY "
+                   "file — outside the sanctioned sync helpers")
 
     def check(self, ctx: FileContext,
               index: ProjectIndex) -> Iterable[Finding]:
-        if not (ctx.path.endswith(_DEVICE_PATH_SUFFIXES)
-                or "/parallel/" in f"/{ctx.path}"):
-            return
-        sanctioned = _SANCTIONED_SYNCS
-        for sfx, extra in _SANCTIONED_SYNCS_BY_FILE.items():
-            if ctx.path.endswith(sfx):
-                sanctioned = sanctioned | extra
-        for node, cls, funcs in _walk_scoped(ctx.tree):
-            if not isinstance(node, ast.Call):
+        # the lazy import keeps the module graph acyclic: devprog is
+        # the whole-program jit index, this file is per-file rules
+        from deepflow_tpu.analysis import devprog
+        seen: Set[Tuple[int, int]] = set()
+        if ctx.path.endswith(_DEVICE_PATH_SUFFIXES) \
+                or "/parallel/" in f"/{ctx.path}":
+            for node, cls, funcs in _walk_scoped(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if any(f in _SANCTIONED_SYNCS for f in funcs):
+                    continue
+                what = self._sync_kind(node)
+                if what:
+                    seen.add((node.lineno, node.col_offset))
+                    yield self.finding(
+                        ctx, node,
+                        f"{what} in {_scope_label(cls, funcs)} blocks "
+                        f"the async device pipeline; host syncs belong "
+                        f"in the sampled-drain helpers "
+                        f"({', '.join(sorted(_SANCTIONED_SYNCS))})")
+        # per-VALUE pass, every file (ISSUE 18): a value provably
+        # produced by a jitted program reaching a materializer outside
+        # the sanctioned helpers is the finding — the device path is
+        # wherever device values flow, not a list of files
+        for node, what, var, producer, scope in devprog.device_value_syncs(
+                ctx, index, _SANCTIONED_SYNCS):
+            at = (node.lineno, node.col_offset)
+            if at in seen:
                 continue
-            if any(f in sanctioned for f in funcs):
-                continue
-            what = self._sync_kind(node)
-            if what:
-                yield self.finding(
-                    ctx, node,
-                    f"{what} in {_scope_label(cls, funcs)} blocks the "
-                    f"async device pipeline; host syncs belong in the "
-                    f"sampled-drain helpers "
-                    f"({', '.join(sorted(sanctioned))})")
+            seen.add(at)
+            yield self.finding(
+                ctx, node,
+                f"{what} on '{var}' — a device value produced by "
+                f"{producer}() — in {scope} forces a blocking device "
+                f"sync; materialize at the sanctioned sync boundaries "
+                f"({', '.join(sorted(_SANCTIONED_SYNCS))})")
 
     @staticmethod
     def _sync_kind(node: ast.Call) -> Optional[str]:

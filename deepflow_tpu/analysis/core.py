@@ -42,7 +42,8 @@ __all__ = ["Finding", "FileContext", "ProjectIndex", "Checker",
            "scan_package", "save_baseline", "load_baseline",
            "new_findings", "format_findings", "findings_to_json",
            "findings_to_sarif", "default_twin_store_path",
-           "default_conform_store_path", "default_doc_path"]
+           "default_conform_store_path", "default_doc_path",
+           "default_programs_store_path", "default_schemas_store_path"]
 
 
 @dataclass(frozen=True)
@@ -184,6 +185,11 @@ class ProjectIndex:
         # committed model-conformance store (.model-conform.json), same
         # contract as twin_store (ISSUE 14: gated exactly alike)
         self.conform_store: Optional[dict] = None
+        # committed jit cache-key store (.lint-programs.json) and
+        # durable-pytree schema store (.lint-schemas.json) for the
+        # ISSUE 18 device-plane rules; None for fixture scans
+        self.programs_store: Optional[dict] = None
+        self.schemas_store: Optional[dict] = None
         # project documentation text (README.md) for the doc-drift
         # rule; None = no doc in scope (fixture scans stay silent)
         self.doc_text: Optional[str] = None
@@ -544,7 +550,9 @@ def _check_files(files: Sequence[Tuple[str, str]],
                  rules: Optional[Sequence[str]] = None,
                  twin_store: Optional[dict] = None,
                  conform_store: Optional[dict] = None,
-                 doc_text: Optional[str] = None) -> List[Finding]:
+                 doc_text: Optional[str] = None,
+                 programs_store: Optional[dict] = None,
+                 schemas_store: Optional[dict] = None) -> List[Finding]:
     """Core pass over (relpath, source) pairs: parse, index, check."""
     registry = all_rules()
     if rules:
@@ -557,6 +565,8 @@ def _check_files(files: Sequence[Tuple[str, str]],
     index.twin_store = twin_store
     index.conform_store = conform_store
     index.doc_text = doc_text
+    index.programs_store = programs_store
+    index.schemas_store = schemas_store
     for ctx in contexts:
         for cls in registry.values():
             for f in cls().check(ctx, index):
@@ -590,6 +600,14 @@ def default_doc_path() -> str:
     return os.path.join(package_parent(), "README.md")
 
 
+def default_programs_store_path() -> str:
+    return os.path.join(package_parent(), ".lint-programs.json")
+
+
+def default_schemas_store_path() -> str:
+    return os.path.join(package_parent(), ".lint-schemas.json")
+
+
 def _auto_twin_store(twin_store) -> Optional[dict]:
     """"auto" -> the committed .lint-twins.json (None before the first
     --ack-twin ever ran); a dict/None passes through (fixtures)."""
@@ -614,6 +632,30 @@ def _auto_conform_store(conform_store) -> Optional[dict]:
         return None
 
 
+def _auto_programs_store(programs_store) -> Optional[dict]:
+    """"auto" -> the committed .lint-programs.json (None before the
+    first --ack-programs); a dict/None passes through (fixtures)."""
+    if programs_store != "auto":
+        return programs_store
+    from deepflow_tpu.analysis import devprog
+    try:
+        return devprog.load_programs_store(default_programs_store_path())
+    except FileNotFoundError:
+        return None
+
+
+def _auto_schemas_store(schemas_store) -> Optional[dict]:
+    """"auto" -> the committed .lint-schemas.json (None before the
+    first --ack-schemas); a dict/None passes through (fixtures)."""
+    if schemas_store != "auto":
+        return schemas_store
+    from deepflow_tpu.analysis import devprog
+    try:
+        return devprog.load_schemas_store(default_schemas_store_path())
+    except FileNotFoundError:
+        return None
+
+
 def _auto_doc_text(doc_text) -> Optional[str]:
     """"auto" -> the repo README.md (the doc-drift rule's coverage
     target); a str/None passes through (fixtures)."""
@@ -629,7 +671,8 @@ def _auto_doc_text(doc_text) -> Optional[str]:
 def run_lint(paths: Optional[Sequence[str]] = None,
              rules: Optional[Sequence[str]] = None,
              twin_store="auto", conform_store="auto",
-             doc_text="auto") -> List[Finding]:
+             doc_text="auto", programs_store="auto",
+             schemas_store="auto") -> List[Finding]:
     """Lint `paths` (files or directories; default: the installed
     deepflow_tpu package). Files under the installed package normalize
     relative to the package PARENT ("deepflow_tpu/runtime/stats.py" —
@@ -638,11 +681,15 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     if not paths:
         return scan_package(rules=rules, twin_store=twin_store,
                             conform_store=conform_store,
-                            doc_text=doc_text)
+                            doc_text=doc_text,
+                            programs_store=programs_store,
+                            schemas_store=schemas_store)
     return _check_files(load_path_sources(paths), rules=rules,
                         twin_store=_auto_twin_store(twin_store),
                         conform_store=_auto_conform_store(conform_store),
-                        doc_text=_auto_doc_text(doc_text))
+                        doc_text=_auto_doc_text(doc_text),
+                        programs_store=_auto_programs_store(programs_store),
+                        schemas_store=_auto_schemas_store(schemas_store))
 
 
 def load_path_sources(paths: Sequence[str]) -> List[Tuple[str, str]]:
@@ -672,28 +719,35 @@ def load_package_sources() -> List[Tuple[str, str]]:
 
 def scan_package(rules: Optional[Sequence[str]] = None,
                  twin_store="auto", conform_store="auto",
-                 doc_text="auto") -> List[Finding]:
+                 doc_text="auto", programs_store="auto",
+                 schemas_store="auto") -> List[Finding]:
     """Self-scan the installed deepflow_tpu tree (CI + the `lint` debug
     command): paths come out relative to the package's parent, matching
     the committed baseline regardless of the caller's cwd."""
     return _check_files(load_package_sources(), rules=rules,
                         twin_store=_auto_twin_store(twin_store),
                         conform_store=_auto_conform_store(conform_store),
-                        doc_text=_auto_doc_text(doc_text))
+                        doc_text=_auto_doc_text(doc_text),
+                        programs_store=_auto_programs_store(programs_store),
+                        schemas_store=_auto_schemas_store(schemas_store))
 
 
 def run_on_sources(sources: Dict[str, str],
                    rules: Optional[Sequence[str]] = None,
                    twin_store: Optional[dict] = None,
                    conform_store: Optional[dict] = None,
-                   doc_text: Optional[str] = None) -> List[Finding]:
+                   doc_text: Optional[str] = None,
+                   programs_store: Optional[dict] = None,
+                   schemas_store: Optional[dict] = None) -> List[Finding]:
     """Lint in-memory {path: source} — the test-fixture surface.
-    `twin_store`/`conform_store`/`doc_text` default to None (NOT the
-    committed stores or the real README): fixture scans must never be
-    judged against the real repo's contracts."""
+    All stores and `doc_text` default to None (NOT the committed
+    stores or the real README): fixture scans must never be judged
+    against the real repo's contracts."""
     return _check_files(sorted(sources.items()), rules=rules,
                         twin_store=twin_store,
-                        conform_store=conform_store, doc_text=doc_text)
+                        conform_store=conform_store, doc_text=doc_text,
+                        programs_store=programs_store,
+                        schemas_store=schemas_store)
 
 
 # -- baseline --------------------------------------------------------------

@@ -574,9 +574,14 @@ def cmd_lint(args) -> int:
     gates on NEW findings only (the committed .lint-baseline.json
     workflow ci.sh enforces); --twins/--ack-twin manage the host/device
     twin fingerprints (.lint-twins.json) the twin-drift rule gates on;
+    --programs/--ack-programs and --schemas/--ack-schemas manage the
+    ISSUE 18 jit cache-key store (.lint-programs.json, retrace-hazard)
+    and the durable-pytree schema store (.lint-schemas.json,
+    pytree-schema-drift) under exactly the twin-store contract;
     --sarif writes the gated findings as SARIF 2.1.0 for CI annotation."""
     from deepflow_tpu import analysis
     from deepflow_tpu.analysis import core as _ana_core
+    from deepflow_tpu.analysis import devprog as _ana_devprog
     from deepflow_tpu.analysis import twins as _ana_twins
 
     if args.list_rules:
@@ -623,14 +628,76 @@ def cmd_lint(args) -> int:
         print(f"twin store updated: {len(store['pairs'])} pair(s) "
               f"acknowledged -> {twins_path}")
         return 0
+    programs_path = args.programs or _ana_core.default_programs_store_path()
+    schemas_path = args.schemas or _ana_core.default_schemas_store_path()
+    if args.ack_programs or args.ack_schemas:
+        # the ISSUE 18 acks: recompute from the CURRENT tree and
+        # rewrite the store(s) — same contract as --ack-twin, including
+        # the partial-scope MERGE (a scan that never saw a site/schema
+        # must not silently un-acknowledge it)
+        files = _ana_core.load_path_sources(args.paths) if args.paths \
+            else _ana_core.load_package_sources()
+        _ctxs, index, errors = _ana_core.build_index(files)
+        if errors:
+            print(analysis.format_findings(errors), file=sys.stderr)
+            return 2
+        for enabled, build, load, save, key, path, what in (
+                (args.ack_programs, _ana_devprog.build_programs_store,
+                 _ana_devprog.load_programs_store,
+                 _ana_devprog.save_programs_store, "programs",
+                 programs_path, "jit program"),
+                (args.ack_schemas, _ana_devprog.build_schemas_store,
+                 _ana_devprog.load_schemas_store,
+                 _ana_devprog.save_schemas_store, "schemas",
+                 schemas_path, "schema")):
+            if not enabled:
+                continue
+            store, missing = build(index)
+            if missing:
+                print(f"--ack-{key} refuses unresolvable refs "
+                      f"(fix the registry first):", file=sys.stderr)
+                for m in missing:
+                    print(f"  {m}", file=sys.stderr)
+                return 2
+            if args.paths:
+                try:
+                    prior = load(path)
+                except FileNotFoundError:
+                    prior = None
+                if prior is not None:
+                    merged = dict(prior.get(key, {}))
+                    merged.update(store[key])
+                    store[key] = merged
+                    print(f"note: path-scoped ack merged into "
+                          f"{len(merged)} committed {what}(s); only a "
+                          f"full self-scan ack drops entries",
+                          file=sys.stderr)
+            save(store, path)
+            print(f"{key} store updated: {len(store[key])} {what}(s) "
+                  f"acknowledged -> {path}")
+        return 0
     twin_store = "auto"
     if args.twins:
         try:
             twin_store = _ana_twins.load_store(args.twins)
         except FileNotFoundError:
             twin_store = None       # no store yet: pairs read as unacked
+    programs_store = "auto"
+    if args.programs:
+        try:
+            programs_store = _ana_devprog.load_programs_store(args.programs)
+        except FileNotFoundError:
+            programs_store = None   # no store yet: sites read as unacked
+    schemas_store = "auto"
+    if args.schemas:
+        try:
+            schemas_store = _ana_devprog.load_schemas_store(args.schemas)
+        except FileNotFoundError:
+            schemas_store = None    # no store yet: schemas read as unacked
     findings = analysis.run_lint(args.paths or None, rules=rules,
-                                 twin_store=twin_store)
+                                 twin_store=twin_store,
+                                 programs_store=programs_store,
+                                 schemas_store=schemas_store)
     if args.update_baseline:
         if not args.baseline:
             print("--update-baseline requires --baseline FILE",
@@ -1024,6 +1091,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="re-acknowledge all declared host/device twin "
                          "pairs: recompute fingerprints and rewrite the "
                          "store (run the bit-identity tests first)")
+    ln.add_argument("--programs", metavar="FILE",
+                    help="jit cache-key store for the retrace-hazard "
+                         "rule (default: the committed "
+                         ".lint-programs.json next to the package)")
+    ln.add_argument("--ack-programs", action="store_true",
+                    help="re-acknowledge every jit site's cache-key "
+                         "fingerprint and compiled-program bound "
+                         "(review retrace risk first)")
+    ln.add_argument("--schemas", metavar="FILE",
+                    help="durable-pytree schema store for the "
+                         "pytree-schema-drift rule (default: the "
+                         "committed .lint-schemas.json next to the "
+                         "package)")
+    ln.add_argument("--ack-schemas", action="store_true",
+                    help="re-acknowledge every declared state pytree's "
+                         "leaf layout (run the snapshot round-trip "
+                         "tests first)")
     ln.add_argument("--list-rules", action="store_true",
                     help="list rules with their one-line descriptions")
     ln.set_defaults(fn=cmd_lint)

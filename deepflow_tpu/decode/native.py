@@ -251,12 +251,16 @@ class PipelinedDecoder:
         ready: "self._q.Queue" = self._q.Queue()
         stop = self._threading.Event()
 
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
+
         def feeder():
             try:
                 for p in payloads:
                     while True:              # stoppable slot wait
                         if stop.is_set():
                             return
+                        sup.beat()
                         try:
                             i = free.get(timeout=0.1)
                             break
@@ -271,9 +275,10 @@ class PipelinedDecoder:
             finally:
                 ready.put(None)
 
-        t = self._threading.Thread(target=feeder, name="pb-decode",
-                                   daemon=True)
-        t.start()
+        # supervised (crash capture + deadman beat from the slot wait);
+        # restart=False: a re-entered feeder would double-iterate
+        # `payloads` — errors already reach the consumer via `ready`
+        t = sup.spawn("pb-decode", feeder, restart=False)
         held = None
         try:
             while True:
@@ -290,4 +295,5 @@ class PipelinedDecoder:
                 yield rows, b32, b64
         finally:
             stop.set()                      # unblock an early-break feeder
+            t.stop()
             t.join(timeout=5)

@@ -48,7 +48,11 @@ def update(state: HLLState, group_ids: jnp.ndarray, keys: jnp.ndarray,
     h = mix32(as_u32(keys))
     reg_idx = (h >> _U32(32 - p)).astype(jnp.int32)             # top p bits
     rest = h << _U32(p)                                          # low 32-p bits up top
-    rho = jnp.minimum(jax.lax.clz(rest.astype(jnp.int32)), 32 - p) + 1
+    # the int32 cast is a deliberate bit REINTERPRETATION for clz (u32
+    # wrap to int32 preserves the bit pattern; clz counts bits, not
+    # values), not a range-losing narrowing
+    rho = jnp.minimum(jax.lax.clz(rest.astype(jnp.int32)),  # lint: disable=u32-overflow
+                      32 - p) + 1
     gid = jnp.clip(group_ids.astype(jnp.int32), 0, g - 1)
     if mask is not None:
         # masked lanes write rho=0: a no-op for scatter-max (registers >= 0)

@@ -11,6 +11,7 @@ halves of the hashes recorded in TagDicts for query-time display.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -20,6 +21,7 @@ import numpy as np
 from deepflow_tpu.runtime.queues import MultiQueue
 from deepflow_tpu.runtime.receiver import Receiver
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.store.dict_store import TagDictRegistry
 from deepflow_tpu.store.table import AggKind, ColumnSpec, TableSchema
@@ -107,7 +109,7 @@ class ExtMetricsPipeline:
                    MessageType.DFSTATS):
             receiver.register_handler(mt, self.queues)
         self.n = n_decoders
-        self._threads: List[threading.Thread] = []
+        self._threads: List = []       # supervisor ThreadHandles
         self._halt = threading.Event()
         self.samples = 0
         self.decode_errors = 0
@@ -213,16 +215,19 @@ class ExtMetricsPipeline:
         for w in self.writers.values():
             if w is not None:
                 w.start()
+        # supervised (crash capture, backoff restart, deadman beats
+        # from each drain iteration) — same discipline as flow_metrics
+        sup = default_supervisor()
         for i in range(self.n):
-            t = threading.Thread(target=self._run, args=(i,),
-                                 name=f"ext-metrics-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._threads.append(
+                sup.spawn(f"ext-metrics-{i}",
+                          functools.partial(self._run, i)))
 
     def close(self) -> None:
         self.queues.close()
         self._halt.set()
         for t in self._threads:
+            t.stop()
             t.join(timeout=2)
         for w in self.writers.values():
             if w is not None:
@@ -239,7 +244,9 @@ class ExtMetricsPipeline:
             MessageType.TELEGRAF: self.handle_telegraf,
             MessageType.DFSTATS: self.handle_dfstats,
         }
+        sup = default_supervisor()
         while not self._halt.is_set():
+            sup.beat()
             frames = self.queues.gets(index, 64, timeout=0.2)
             if not frames:
                 if self.queues.queues[index].closed:

@@ -700,14 +700,16 @@ class Ingester:
 
         def _janitor():
             while not self._janitor_stop.wait(1.0):
+                self.supervisor.beat()
                 for p in self._pipelines:
                     tick = getattr(p, "tick", None)
                     if tick is not None:
                         tick()
-        self._janitor = threading.Thread(target=_janitor,
-                                         name="throttle-janitor",
-                                         daemon=True)
-        self._janitor.start()
+        # supervised under the ingester's own tree: a crashed janitor
+        # (one pipeline's tick raising) restarts instead of leaving
+        # every quiet stream's rows stranded until the next record
+        self._janitor = self.supervisor.spawn(
+            "throttle-janitor", _janitor, beat_period_s=1.0)
         if self.spill is not None:
             # replay-before-receive: drain threads start re-injecting
             # any segments a previous process left behind while the
@@ -769,6 +771,7 @@ class Ingester:
         janitor_stop = getattr(self, "_janitor_stop", None)
         if janitor_stop is not None:
             janitor_stop.set()
+            self._janitor.stop()
             self._janitor.join(timeout=2)
         # rung 1: stop accepting — close the listener, let established
         # connections dispatch their in-flight kernel-buffered bytes

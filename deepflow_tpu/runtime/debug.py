@@ -45,7 +45,7 @@ class DebugServer:
         self._sock.bind((host, port))
         self._sock.settimeout(0.2)
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._thread = None            # supervisor ThreadHandle
 
     @property
     def port(self) -> int:
@@ -170,18 +170,23 @@ class DebugServer:
         return out
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name="debug-udp",
-                                        daemon=True)
-        self._thread.start()
+        # supervised: a crashed debug loop restarts on the same socket
+        # instead of going silently deaf (the socket survives the crash)
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn("debug-udp", self._run)
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=2)
         self._sock.close()
 
     def _run(self) -> None:
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        sup = default_supervisor()
         while not self._stop.is_set():
+            sup.beat()
             try:
                 data, addr = self._sock.recvfrom(65536)
             except socket.timeout:

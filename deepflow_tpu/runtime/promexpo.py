@@ -24,7 +24,6 @@ with real scrapers, so "mostly parseable" is a failure.
 from __future__ import annotations
 
 import re
-import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -362,7 +361,7 @@ class PrometheusExporter:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
-        self._thread: Optional[threading.Thread] = None
+        self._thread = None            # supervisor ThreadHandle
 
     @property
     def port(self) -> int:
@@ -371,16 +370,19 @@ class PrometheusExporter:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        name="prom-exposition",
-                                        daemon=True)
-        self._thread.start()
+        # supervised for crash capture + restart; deadman disabled:
+        # serve_forever blocks in select() with nowhere to beat from,
+        # and a quiet scrape target is healthy, not wedged
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+        self._thread = default_supervisor().spawn(
+            "prom-exposition", self._server.serve_forever, deadman_s=None)
 
     def close(self) -> None:
         # shutdown() blocks on the serve_forever loop acking — calling
         # it with no loop running (start() never happened, or it
         # raised) would hang forever
         if self._thread is not None:
+            self._thread.stop()
             self._server.shutdown()
             self._thread.join(timeout=2)
             self._thread = None

@@ -38,6 +38,8 @@ from typing import Optional
 
 import yaml
 
+from deepflow_tpu.runtime.supervisor import default_supervisor
+
 
 def load_config(path: Optional[str]) -> dict:
     if path is None or not os.path.exists(path):
@@ -50,7 +52,7 @@ class Server:
     def __init__(self, config_path: Optional[str] = None) -> None:
         self.config_path = config_path
         self.cfg = load_config(config_path)
-        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_thread = None      # supervisor ThreadHandle
         self.reload_error: Optional[str] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -224,14 +226,16 @@ class Server:
     def start(self) -> None:
         self._start_components()
         if self.config_path is not None:
-            self._watch_thread = threading.Thread(
-                target=self._watch_config, name="config-watcher",
-                daemon=True)
-            self._watch_thread.start()
+            # supervised: a reload that raises past the guard in
+            # reload() restarts the watcher instead of silently ending
+            # config reloads for the life of the process
+            self._watch_thread = default_supervisor().spawn(
+                "config-watcher", self._watch_config, beat_period_s=5.0)
 
     def close(self) -> None:
         self._stop.set()
         if self._watch_thread is not None:
+            self._watch_thread.stop()
             self._watch_thread.join(timeout=2)
         with self._lock:
             self._close_components()
@@ -271,6 +275,7 @@ class Server:
         except OSError:
             last = 0.0
         while not self._stop.wait(5.0):
+            default_supervisor().beat()
             try:
                 cur = os.path.getmtime(self.config_path)
             except OSError:

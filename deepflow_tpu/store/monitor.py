@@ -14,6 +14,7 @@ from typing import List, Optional, Tuple
 
 from deepflow_tpu.store.db import Store
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 
 
 class DiskMonitor:
@@ -25,7 +26,7 @@ class DiskMonitor:
         self.low_bytes = int(max_bytes * low_fraction)
         self.interval = interval
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._thread = None            # supervisor ThreadHandle
         self.partitions_dropped = 0
         self.segments_compacted = 0
         self.ttl_dropped = 0
@@ -35,13 +36,16 @@ class DiskMonitor:
             stats.register("ckmonitor", self.counters)
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name="ckmonitor",
-                                        daemon=True)
-        self._thread.start()
+        # supervised; beat_period_s lets the supervisor derive the
+        # deadman policy from the sweep cadence (a 60s interval
+        # legitimately outlives the default watchdog window)
+        self._thread = default_supervisor().spawn(
+            "ckmonitor", self._run, beat_period_s=self.interval)
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=5)
             self._thread = None
 
@@ -90,7 +94,9 @@ class DiskMonitor:
         return dropped
 
     def _run(self) -> None:
+        sup = default_supervisor()
         while not self._stop.wait(self.interval):
+            sup.beat()
             try:
                 self.check_once()
             except Exception as e:

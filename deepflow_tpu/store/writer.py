@@ -17,6 +17,7 @@ import numpy as np
 
 from deepflow_tpu.store.db import Table
 from deepflow_tpu.runtime.stats import StatsRegistry
+from deepflow_tpu.runtime.supervisor import default_supervisor
 
 
 class StoreWriter:
@@ -34,21 +35,23 @@ class StoreWriter:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._kick = threading.Event()  # threshold crossed: flush off-thread
-        self._thread: Optional[threading.Thread] = None
+        self._thread = None            # supervisor ThreadHandle
         self.flushes = 0
         if stats is not None:
             stats.register(stats_name or f"store.{table.schema.name}",
                            self.counters)
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, name=f"ckwriter-{self.table.schema.name}",
-            daemon=True)
-        self._thread.start()
+        # supervised: a crashed flush loop (bad chunk, disk error)
+        # restarts with pending chunks intact instead of buffering
+        # unboundedly with nothing draining
+        self._thread = default_supervisor().spawn(
+            f"ckwriter-{self.table.schema.name}", self._run)
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            self._thread.stop()
             self._thread.join(timeout=5)
             self._thread = None
         self.flush()
@@ -85,8 +88,10 @@ class StoreWriter:
         return rows
 
     def _run(self) -> None:
+        sup = default_supervisor()
         deadline = time.monotonic() + self.flush_interval
         while not self._stop.is_set():
+            sup.beat()
             timeout = max(0.0, deadline - time.monotonic())
             kicked = self._kick.wait(min(timeout, 0.5))
             if kicked:

@@ -1,0 +1,655 @@
+"""ISSUE 18: deepflow-devcheck — the device-plane static rules.
+
+Per-rule positive / negative / pragma fixtures for the four new rules
+(donation-use-after-donate, retrace-hazard, u32-overflow,
+pytree-schema-drift), the per-VALUE host-sync pass that rides the same
+jit index, the two committed stores' ack ladders (unacked -> ack ->
+edit -> re-ack, partial scans silent, path-scoped acks merge), and the
+repo-level lockstep checks for .lint-programs.json /
+.lint-schemas.json."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from deepflow_tpu import analysis
+from deepflow_tpu.analysis import core as ana_core
+from deepflow_tpu.analysis import devprog
+from deepflow_tpu.cli import main as cli_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def _index_for(srcs):
+    _ctxs, index, errs = ana_core.build_index(sorted(srcs.items()))
+    assert errs == []
+    return index
+
+
+# ------------------------------------------- the jit-site index itself
+
+SITE_SRC = (
+    "import functools\n"
+    "import jax\n"
+    "class Eng:\n"
+    "    def __init__(self, core):\n"
+    "        self._upd = jax.jit(core, donate_argnums=0)\n"
+    "@functools.partial(jax.jit, static_argnames='n')\n"
+    "def padded(x, n):\n"
+    "    return x\n"
+    "def make_step(core):\n"
+    "    return jax.jit(core, donate_argnums=(0,), static_argnums=2)\n")
+
+
+def test_site_index_covers_attr_decorator_and_factory_forms():
+    index = _index_for({"pkg/m.py": SITE_SRC})
+    sites = devprog.sites_for_path("pkg/m.py", index.trees["pkg/m.py"],
+                                   index)
+    by_qual = {s.qual: s for s in sites}
+    assert by_qual["Eng._upd"].donate_argnums == (0,)
+    assert by_qual["Eng._upd"].binding == "self._upd"
+    assert by_qual["padded"].static_argnames == ("n",)
+    ret = by_qual["make_step.return[core]"]
+    assert ret.donate_argnums == (0,) and ret.static_argnums == (2,)
+    # site ids are line-free: unrelated edits above must not move them
+    shifted = _index_for({"pkg/m.py": "# a new header comment\n"
+                          + SITE_SRC})
+    sites2 = devprog.sites_for_path(
+        "pkg/m.py", shifted.trees["pkg/m.py"], shifted)
+    assert sorted(s.site_id for s in sites2) \
+        == sorted(s.site_id for s in sites)
+    assert {s.site_id: devprog.site_fingerprint(s) for s in sites2} \
+        == {s.site_id: devprog.site_fingerprint(s) for s in sites}
+
+
+# ------------------------------------------- donation-use-after-donate
+
+def test_donation_read_after_donating_call():
+    src = ("import jax\n"
+           "def core(s, b):\n"
+           "    return s\n"
+           "upd = jax.jit(core, donate_argnums=0)\n"
+           "def feed(state, b):\n"
+           "    out = upd(state, b)\n"
+           "    return state\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["donation-use-after-donate"])
+    assert rules_of(fs) == ["donation-use-after-donate"]
+    assert "'state'" in fs[0].message and "upd()" in fs[0].message
+
+
+def test_donation_rebind_over_same_name_is_the_sanctioned_shape():
+    src = ("import jax\n"
+           "def core(s, b):\n"
+           "    return s\n"
+           "upd = jax.jit(core, donate_argnums=0)\n"
+           "def feed(state, batches):\n"
+           "    for b in batches:\n"
+           "        state = upd(state, b)\n"
+           "    return state\n")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": src}, rules=["donation-use-after-donate"]) == []
+
+
+def test_donation_repass_across_loop_iterations():
+    # donate at the bottom of the loop body, re-pass at the top of the
+    # next iteration: only a second flow over the body catches it
+    src = ("import jax\n"
+           "def core(s, b):\n"
+           "    return s\n"
+           "upd = jax.jit(core, donate_argnums=0)\n"
+           "def feed(state, batches):\n"
+           "    for b in batches:\n"
+           "        r = upd(state, b)\n"
+           "    return r\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["donation-use-after-donate"])
+    assert rules_of(fs) == ["donation-use-after-donate"]
+
+
+def test_donation_branch_arms_flow_independently():
+    src = ("import jax\n"
+           "def core(s, b):\n"
+           "    return s\n"
+           "upd = jax.jit(core, donate_argnums=0)\n"
+           "def feed(state, b, flag):\n"
+           "    if flag:\n"
+           "        out = upd(state, b)\n"
+           "    else:\n"
+           "        out = state.sum()\n"     # pre-branch value: alive
+           "    return out\n")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": src}, rules=["donation-use-after-donate"]) == []
+    # ...but after the merge the donated arm's death survives
+    joined = src.replace("    return out\n",
+                         "    return out + state\n")
+    fs = analysis.run_on_sources({"pkg/m.py": joined},
+                                 rules=["donation-use-after-donate"])
+    assert rules_of(fs) == ["donation-use-after-donate"]
+
+
+def test_donation_inline_jit_call_and_pragma():
+    src = ("import jax\n"
+           "def core(s, b):\n"
+           "    return s\n"
+           "def feed(state, b):\n"
+           "    out = jax.jit(core, donate_argnums=0)(state, b)\n"
+           "    return state\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["donation-use-after-donate"])
+    assert rules_of(fs) == ["donation-use-after-donate"]
+    quiet = src.replace(
+        "    return state\n",
+        "    return state  # lint: disable=donation-use-after-donate\n")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": quiet}, rules=["donation-use-after-donate"]) == []
+
+
+# The PR-15 shape: the jitted program comes out of a FACTORY in another
+# file (detectors.make_window_step), gets stashed on self, and the
+# donated state is read after the call — the bug class that shipped
+# live in PR 15's review round, now caught cross-file.
+FACTORY_SRCS = {
+    "pkg/detectors.py": (
+        "import jax\n"
+        "def make_window_step(cfg):\n"
+        "    return jax.jit(lambda s, rows: s, donate_argnums=0)\n"),
+    "pkg/alerts.py": (
+        "from pkg import detectors\n"
+        "class Engine:\n"
+        "    def __init__(self, cfg):\n"
+        "        self._step = detectors.make_window_step(cfg)\n"
+        "    def feed(self, state, rows):\n"
+        "        out = self._step(state, rows)\n"
+        "        return state.total\n"),
+}
+
+
+def test_donation_flows_through_cross_file_factory():
+    fs = analysis.run_on_sources(FACTORY_SRCS,
+                                 rules=["donation-use-after-donate"])
+    assert [(f.rule, f.path) for f in fs] \
+        == [("donation-use-after-donate", "pkg/alerts.py")]
+    assert "make_window_step" in fs[0].message
+    fixed = dict(FACTORY_SRCS)
+    fixed["pkg/alerts.py"] = FACTORY_SRCS["pkg/alerts.py"].replace(
+        "        out = self._step(state, rows)\n"
+        "        return state.total\n",
+        "        state = self._step(state, rows)\n"
+        "        return state.total\n")
+    assert analysis.run_on_sources(
+        fixed, rules=["donation-use-after-donate"]) == []
+
+
+# --------------------------------------------------- retrace-hazard
+
+LEN_KEYED = {
+    "pkg/m.py": ("import jax\n"
+                 "def core(x, n):\n"
+                 "    return x\n"
+                 "prog = jax.jit(core, static_argnums=1)\n"
+                 "def feed(batch):\n"
+                 "    return prog(batch, len(batch))\n"),
+}
+
+
+def test_retrace_len_fed_static_is_a_hazard_without_any_store():
+    fs = analysis.run_on_sources(LEN_KEYED, rules=["retrace-hazard"])
+    assert rules_of(fs) == ["retrace-hazard"]
+    assert "len(" in fs[0].message and "prog()" in fs[0].message
+
+
+def test_retrace_partial_jit_static_argnames_form():
+    src = ("import functools\n"
+           "import jax\n"
+           "@functools.partial(jax.jit, static_argnames='n')\n"
+           "def core(x, n):\n"
+           "    return x\n"
+           "def feed(b):\n"
+           "    return core(b, n=len(b))\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["retrace-hazard"])
+    assert rules_of(fs) == ["retrace-hazard"]
+    assert "'n'" in fs[0].message
+
+
+def test_retrace_container_display_static_and_pragma():
+    src = ("import jax\n"
+           "def core(x, dims):\n"
+           "    return x\n"
+           "prog = jax.jit(core, static_argnums=1)\n"
+           "def feed(batch):\n"
+           "    return prog(batch, [1, 2])\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["retrace-hazard"])
+    assert rules_of(fs) == ["retrace-hazard"]
+    assert "container" in fs[0].message
+    quiet = src.replace(
+        "    return prog(batch, [1, 2])\n",
+        "    return prog(batch, [1, 2])"
+        "  # lint: disable=retrace-hazard\n")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": quiet}, rules=["retrace-hazard"]) == []
+
+
+BOUNDED = {
+    "pkg/m.py": ("import jax\n"
+                 "def core(x, n):\n"
+                 "    return x\n"
+                 "prog = jax.jit(core, static_argnums=1)\n"
+                 "def feed(batch):\n"
+                 "    return prog(batch, 128)\n"),
+}
+
+
+def _programs_store_for(srcs):
+    store, missing = devprog.build_programs_store(_index_for(srcs))
+    assert missing == []
+    return store
+
+
+def test_retrace_store_ladder_ack_edit_bound_and_stale():
+    store = _programs_store_for(BOUNDED)
+    sid = "pkg/m.py:prog"
+    assert store["programs"][sid]["programs"] == 1
+    # acked store + unchanged tree: clean
+    assert analysis.run_on_sources(BOUNDED, rules=["retrace-hazard"],
+                                   programs_store=store) == []
+    # present-but-empty store: every site is unacknowledged
+    empty = {"version": 1, "tool": "deepflow-lint", "programs": {}}
+    fs = analysis.run_on_sources(BOUNDED, rules=["retrace-hazard"],
+                                 programs_store=empty)
+    assert rules_of(fs) == ["retrace-hazard"]
+    assert "no committed cache-key entry" in fs[0].message
+    # editing the cache key (donation config counts too) trips the fp
+    edited = {"pkg/m.py": BOUNDED["pkg/m.py"].replace(
+        "static_argnums=1", "static_argnums=1, donate_argnums=0")}
+    fs = analysis.run_on_sources(edited, rules=["retrace-hazard"],
+                                 programs_store=store)
+    assert any("cache key" in f.message
+               and "--ack-programs" in f.message for f in fs)
+    # a second distinct static signature exceeds the committed bound
+    grown = {"pkg/m.py": BOUNDED["pkg/m.py"]
+             + "def feed2(batch):\n    return prog(batch, 256)\n"}
+    fs = analysis.run_on_sources(grown, rules=["retrace-hazard"],
+                                 programs_store=store)
+    assert any("bound exceeded" in f.message for f in fs)
+    # a len() feeder makes a committed-bounded program unbounded
+    unbound = {"pkg/m.py": BOUNDED["pkg/m.py"].replace(
+        "prog(batch, 128)", "prog(batch, len(batch))")}
+    fs = analysis.run_on_sources(unbound, rules=["retrace-hazard"],
+                                 programs_store=store)
+    assert any("UNBOUNDED" in f.message for f in fs)
+    # site deleted while its file is in the scan: stale entry
+    gone = {"pkg/m.py": "import jax\ndef core(x, n):\n    return x\n"}
+    fs = analysis.run_on_sources(gone, rules=["retrace-hazard"],
+                                 programs_store=store)
+    assert any("no longer exists" in f.message for f in fs)
+    # the site's FILE out of the scan: partial scans stay silent
+    assert analysis.run_on_sources({"pkg/other.py": "x = 1\n"},
+                                   rules=["retrace-hazard"],
+                                   programs_store=store) == []
+
+
+def test_programs_ack_cli_round_trip(tmp_path, capsys):
+    f = tmp_path / "pkg" / "m.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(BOUNDED["pkg/m.py"])
+    store = tmp_path / "programs.json"
+    assert cli_main(["lint", str(tmp_path), "--programs", str(store),
+                     "--ack-programs"]) == 0
+    assert cli_main(["lint", str(tmp_path), "--programs", str(store),
+                     "--rules", "retrace-hazard"]) == 0
+    f.write_text(BOUNDED["pkg/m.py"].replace("static_argnums=1",
+                                             "static_argnums=(0, 1)"))
+    assert cli_main(["lint", str(tmp_path), "--programs", str(store),
+                     "--rules", "retrace-hazard"]) == 1
+    out = capsys.readouterr().out
+    assert "retrace-hazard" in out and "--ack-programs" in out
+    assert cli_main(["lint", str(tmp_path), "--programs", str(store),
+                     "--ack-programs"]) == 0
+    assert cli_main(["lint", str(tmp_path), "--programs", str(store),
+                     "--rules", "retrace-hazard"]) == 0
+    capsys.readouterr()
+
+
+def test_programs_ack_path_scope_merges_not_overwrites(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("import jax\ndef f(x):\n    return x\n"
+                 "pa = jax.jit(f)\n")
+    b.write_text("import jax\ndef g(x):\n    return x\n"
+                 "pb = jax.jit(g)\n")
+    store = tmp_path / "programs.json"
+    assert cli_main(["lint", str(tmp_path), "--programs", str(store),
+                     "--ack-programs"]) == 0
+    n_full = len(json.loads(store.read_text())["programs"])
+    assert n_full == 2
+    # re-ack ONLY a.py: b.py's entry must survive
+    assert cli_main(["lint", str(a), "--programs", str(store),
+                     "--ack-programs"]) == 0
+    assert len(json.loads(store.read_text())["programs"]) == n_full
+    capsys.readouterr()
+
+
+# ----------------------------------------------------- u32-overflow
+
+U32_IMPORT = "from deepflow_tpu.utils.u32 import mix32\n"
+
+
+def test_u32_bare_wide_constant_on_tracked_lane():
+    src = (U32_IMPORT
+           + "def key(x):\n"
+             "    h = mix32(x)\n"
+             "    return h * 0x9E3779B9\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["u32-overflow"])
+    assert rules_of(fs) == ["u32-overflow"]
+    assert "0x9e3779b9" in fs[0].message
+    # the wrapped (np.uint32) spelling is the discipline: clean
+    wrapped = src.replace("h * 0x9E3779B9",
+                          "h * np.uint32(0x9E3779B9)")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": wrapped}, rules=["u32-overflow"]) == []
+    # int32-range constants never flag
+    small = src.replace("0x9E3779B9", "0x7FFF")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": small}, rules=["u32-overflow"]) == []
+
+
+def test_u32_scope_is_u32_importers_only():
+    # identical code without the u32/hashing import: out of scope
+    src = ("def key(x):\n"
+           "    h = mix32(x)\n"
+           "    return h * 0x9E3779B9\n")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": src}, rules=["u32-overflow"]) == []
+
+
+def test_u32_fixpoint_follows_assignment_chains():
+    src = (U32_IMPORT
+           + "def key(x):\n"
+             "    h = mix32(x)\n"
+             "    y = h ^ 5\n"
+             "    z = y\n"
+             "    return z * 0xDEADBEEF\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["u32-overflow"])
+    assert rules_of(fs) == ["u32-overflow"]
+
+
+def test_u32_int32_cast_needs_range_clearing_shift():
+    src = (U32_IMPORT
+           + "import jax.numpy as jnp\n"
+             "def bucket(x):\n"
+             "    h = mix32(x)\n"
+             "    return h.astype(jnp.int32)\n")
+    fs = analysis.run_on_sources({"pkg/m.py": src},
+                                 rules=["u32-overflow"])
+    assert rules_of(fs) == ["u32-overflow"]
+    assert "shift or mask" in fs[0].message
+    # the ops/hashing `bucket` shape — shift-before-cast — is clean
+    safe = src.replace("h.astype(jnp.int32)",
+                       "(h >> 20).astype(jnp.int32)")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": safe}, rules=["u32-overflow"]) == []
+
+
+def test_u32_pragma():
+    src = (U32_IMPORT
+           + "def key(x):\n"
+             "    h = mix32(x)\n"
+             "    return h * 0x9E3779B9  # lint: disable=u32-overflow\n")
+    assert analysis.run_on_sources(
+        {"pkg/m.py": src}, rules=["u32-overflow"]) == []
+
+
+# ----------------------------------------------- pytree-schema-drift
+
+SCHEMA_SRCS = {
+    "pkg/analysis/devprog.py": (
+        'SCHEMA_TABLE = [\n'
+        '    ("cms-state", "pkg/state.py:CMSState"),\n'
+        '    ("alert-snapshot", "pkg/alerts.py:Snap"),\n'
+        ']\n'),
+    "pkg/state.py": ("from typing import NamedTuple\n"
+                     "class CMSState(NamedTuple):\n"
+                     "    table: int\n"
+                     "    salts: int\n"),
+    "pkg/alerts.py": (
+        "import numpy as np\n"
+        "class Snap:\n"
+        "    @staticmethod\n"
+        "    def leaves(ts, count):\n"
+        "        return [np.asarray(ts, np.float64),\n"
+        "                np.asarray(count, dtype=np.int32)]\n"),
+}
+
+
+def _schemas_store_for(srcs):
+    store, missing = devprog.build_schemas_store(_index_for(srcs))
+    assert missing == []
+    return store
+
+
+def test_schema_leaves_cover_namedtuple_and_leaves_method():
+    store = _schemas_store_for(SCHEMA_SRCS)
+    assert [l["name"] for l in store["schemas"]["cms-state"]["leaves"]] \
+        == ["table", "salts"]
+    snap = store["schemas"]["alert-snapshot"]["leaves"]
+    assert [(l["name"], l["type"]) for l in snap] \
+        == [("ts", "np.float64"), ("count", "np.int32")]
+
+
+def test_schema_unacked_then_acked_then_drift():
+    # no committed fingerprint: every declared schema is unacked
+    fs = analysis.run_on_sources(SCHEMA_SRCS,
+                                 rules=["pytree-schema-drift"])
+    assert rules_of(fs) == ["pytree-schema-drift"] * 2
+    assert all("no committed leaf fingerprint" in f.message for f in fs)
+    store = _schemas_store_for(SCHEMA_SRCS)
+    assert analysis.run_on_sources(SCHEMA_SRCS,
+                                   rules=["pytree-schema-drift"],
+                                   schemas_store=store) == []
+    # adding a leaf names the added leaf in the finding
+    edited = dict(SCHEMA_SRCS)
+    edited["pkg/state.py"] = SCHEMA_SRCS["pkg/state.py"] \
+        + "    depth: int\n"
+    fs = analysis.run_on_sources(edited, rules=["pytree-schema-drift"],
+                                 schemas_store=store)
+    assert rules_of(fs) == ["pytree-schema-drift"]
+    assert "added leaf 'depth'" in fs[0].message
+    assert "--ack-schemas" in fs[0].message
+
+
+def test_schema_reorder_and_retype_are_named():
+    store = _schemas_store_for(SCHEMA_SRCS)
+    swapped = dict(SCHEMA_SRCS)
+    swapped["pkg/state.py"] = ("from typing import NamedTuple\n"
+                               "class CMSState(NamedTuple):\n"
+                               "    salts: int\n"
+                               "    table: int\n")
+    fs = analysis.run_on_sources(swapped, rules=["pytree-schema-drift"],
+                                 schemas_store=store)
+    assert rules_of(fs) == ["pytree-schema-drift"]
+    assert "reordered" in fs[0].message and "'salts'" in fs[0].message
+    retyped = dict(SCHEMA_SRCS)
+    retyped["pkg/state.py"] = SCHEMA_SRCS["pkg/state.py"].replace(
+        "table: int", "table: float")
+    fs = analysis.run_on_sources(retyped, rules=["pytree-schema-drift"],
+                                 schemas_store=store)
+    assert "retyped 'table'" in fs[0].message
+
+
+def test_schema_partial_scan_stale_entry_and_dead_ref():
+    store = _schemas_store_for(SCHEMA_SRCS)
+    # a scan without the state files stays silent (partial scan)
+    partial = {"pkg/analysis/devprog.py":
+               SCHEMA_SRCS["pkg/analysis/devprog.py"]}
+    assert analysis.run_on_sources(partial,
+                                   rules=["pytree-schema-drift"],
+                                   schemas_store=store) == []
+    # schema dropped from the table while committed: deliberate drop
+    undeclared = dict(SCHEMA_SRCS)
+    undeclared["pkg/analysis/devprog.py"] = (
+        'SCHEMA_TABLE = [\n'
+        '    ("alert-snapshot", "pkg/alerts.py:Snap"),\n'
+        ']\n')
+    fs = analysis.run_on_sources(undeclared,
+                                 rules=["pytree-schema-drift"],
+                                 schemas_store=store)
+    assert any("no longer declared" in f.message
+               and "'cms-state'" in f.message for f in fs)
+    # the class deleted while its file is scanned: the ref is dead
+    dead = dict(SCHEMA_SRCS)
+    dead["pkg/state.py"] = "X = 1\n"
+    fs = analysis.run_on_sources(dead, rules=["pytree-schema-drift"],
+                                 schemas_store=store)
+    assert any("does not resolve" in f.message for f in fs)
+
+
+def test_schema_pragma_on_the_state_class():
+    store = _schemas_store_for(SCHEMA_SRCS)
+    edited = dict(SCHEMA_SRCS)
+    edited["pkg/state.py"] = (
+        "from typing import NamedTuple\n"
+        "class CMSState(NamedTuple):"
+        "  # lint: disable=pytree-schema-drift\n"
+        "    table: int\n"
+        "    salts: int\n"
+        "    depth: int\n")
+    assert analysis.run_on_sources(edited,
+                                   rules=["pytree-schema-drift"],
+                                   schemas_store=store) == []
+
+
+def test_schemas_ack_cli_round_trip(tmp_path, capsys):
+    for rel, src in SCHEMA_SRCS.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+    store = tmp_path / "schemas.json"
+    assert cli_main(["lint", str(tmp_path), "--schemas", str(store),
+                     "--ack-schemas"]) == 0
+    assert cli_main(["lint", str(tmp_path), "--schemas", str(store),
+                     "--rules", "pytree-schema-drift"]) == 0
+    (tmp_path / "pkg/state.py").write_text(
+        SCHEMA_SRCS["pkg/state.py"] + "    depth: int\n")
+    assert cli_main(["lint", str(tmp_path), "--schemas", str(store),
+                     "--rules", "pytree-schema-drift"]) == 1
+    out = capsys.readouterr().out
+    assert "added leaf 'depth'" in out and "--ack-schemas" in out
+    assert cli_main(["lint", str(tmp_path), "--schemas", str(store),
+                     "--ack-schemas"]) == 0
+    assert cli_main(["lint", str(tmp_path), "--schemas", str(store),
+                     "--rules", "pytree-schema-drift"]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------- the per-VALUE host-sync pass
+
+def test_host_sync_device_value_flagged_in_any_file():
+    # pkg/anyfile.py is NOT a device-path file: the lexical pass is
+    # silent there, but a value provably produced by a jitted program
+    # still must not be materialized outside a sanctioned helper
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "def core(x):\n"
+           "    return x\n"
+           "prog = jax.jit(core)\n"
+           "class C:\n"
+           "    def tick(self, x):\n"
+           "        y = prog(x)\n"
+           "        return float(y)\n")
+    fs = analysis.run_on_sources({"pkg/anyfile.py": src},
+                                 rules=["host-sync-in-device-path"])
+    assert rules_of(fs) == ["host-sync-in-device-path"]
+    assert "'y'" in fs[0].message and "prog" in fs[0].message
+
+
+def test_host_sync_self_stash_is_device_valued_class_wide():
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "def core(x):\n"
+           "    return x\n"
+           "prog = jax.jit(core)\n"
+           "class C:\n"
+           "    def absorb(self, x):\n"
+           "        self._acc = prog(x)\n"
+           "    def report(self):\n"
+           "        return np.asarray(self._acc)\n")
+    fs = analysis.run_on_sources({"pkg/anyfile.py": src},
+                                 rules=["host-sync-in-device-path"])
+    assert rules_of(fs) == ["host-sync-in-device-path"]
+    assert "'self._acc'" in fs[0].message
+
+
+def test_host_sync_sanctioned_helper_and_plain_values_stay_silent():
+    # materializing inside a sanctioned sync boundary is the contract
+    src = ("import jax\n"
+           "def core(x):\n"
+           "    return x\n"
+           "prog = jax.jit(core)\n"
+           "class C:\n"
+           "    def close_window(self, x):\n"
+           "        y = prog(x)\n"
+           "        return float(y)\n")
+    assert analysis.run_on_sources(
+        {"pkg/anyfile.py": src},
+        rules=["host-sync-in-device-path"]) == []
+    # a host value through the same materializers never flags
+    host = ("import numpy as np\n"
+            "def f(cols):\n"
+            "    return np.asarray(cols)\n")
+    assert analysis.run_on_sources(
+        {"pkg/anyfile.py": host},
+        rules=["host-sync-in-device-path"]) == []
+
+
+# ---------------------------------------------- repo-level lockstep
+
+@pytest.fixture(scope="module")
+def repo_scan():
+    return analysis.scan_package()
+
+
+def test_all_four_rules_are_registered():
+    assert {"donation-use-after-donate", "retrace-hazard",
+            "u32-overflow", "pytree-schema-drift"} \
+        <= set(analysis.all_rules())
+
+
+def test_repo_programs_store_matches_tree(repo_scan):
+    """The committed .lint-programs.json is in lockstep with the
+    shipped tree: the self-scan (which loads it by default) reports no
+    retrace findings, and the store covers the real jit surface."""
+    assert [f for f in repo_scan if f.rule == "retrace-hazard"] == []
+    store = json.loads((REPO_ROOT / ".lint-programs.json").read_text())
+    assert store["version"] == 1
+    assert len(store["programs"]) >= 20
+    # no committed program may be silently unbounded
+    assert all(e["programs"] != "unbounded"
+               for e in store["programs"].values())
+
+
+def test_repo_schemas_store_matches_tree(repo_scan):
+    assert [f for f in repo_scan if f.rule == "pytree-schema-drift"] \
+        == []
+    store = json.loads((REPO_ROOT / ".lint-schemas.json").read_text())
+    assert store["version"] == 1
+    assert len(store["schemas"]) == len(devprog.SCHEMA_TABLE)
+    # the alert snapshot's 8-leaf bus layout is under the gate
+    assert len(store["schemas"]["alert-snapshot"]["leaves"]) == 8
+
+
+def test_repo_device_plane_rules_are_clean(repo_scan):
+    """Every real donation/u32/host-sync finding was fixed or carries
+    a justified pragma — the triage bar ISSUE 18 sets."""
+    assert [f for f in repo_scan
+            if f.rule in ("donation-use-after-donate",
+                          "u32-overflow")] == []

@@ -114,7 +114,15 @@ def test_supervisor_deadman_detects_wedged_thread():
 
 
 def test_supervisor_beat_clears_deadman():
-    sup = Supervisor(deadman_s=10.0)
+    # Staleness is judged on an injected clock the test advances; the
+    # beater still runs on wall time. The old wall-clock version
+    # (sleep 0.5 with deadman_s=0.2, beat every 0.01) flaked under
+    # load: a starved beater missing one 0.2s window flipped the
+    # check. Here the check only happens after a beat PROVABLY landed
+    # at the advanced clock value, so scheduling delay can't fail it —
+    # it just makes the _wait longer (bounded).
+    clock = [1000.0]
+    sup = Supervisor(deadman_s=10.0, clock=lambda: clock[0])
     stop = threading.Event()
 
     def beating():
@@ -122,7 +130,8 @@ def test_supervisor_beat_clears_deadman():
             sup.beat()
 
     h = sup.spawn("alive", beating, deadman_s=0.2)
-    time.sleep(0.5)               # well past deadman_s without beats -> stale
+    clock[0] += 0.5               # well past deadman_s without beats -> stale
+    assert _wait(lambda: h.last_beat >= 1000.5, timeout=5)
     assert sup.check_deadman() == []
     stop.set()
     h.join(2)
