@@ -184,7 +184,17 @@ _DEVICE_PATH_SUFFIXES = ("runtime/tpu_sketch.py", "runtime/app_red.py",
                          # on the sampler tick would serialize dispatch
                          # once per second forever; both must stay
                          # host-pure (zero sanctioned syncs)
-                         "runtime/timeline.py", "runtime/incident.py")
+                         "runtime/timeline.py", "runtime/incident.py",
+                         # ISSUE 20: the feed autotuner ticks beside
+                         # the device pipeline for the life of the
+                         # exporter — a device sync on the control
+                         # tick would serialize dispatch once per
+                         # interval, which is exactly the stall the
+                         # controller exists to remove. It reads only
+                         # the exporter's host-side counters; zero
+                         # sanctioned syncs, same stance as the
+                         # ISSUE 16 sampler
+                         "runtime/autotune.py")
 # the sampled-drain helpers where a blocking sync is the point: explicit
 # attribution drains on every Nth batch / cold compile (PR 1), the
 # degraded-mode device probe (PR 2), the overlapped feed's

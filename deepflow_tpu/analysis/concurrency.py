@@ -498,6 +498,11 @@ _BARRIER_OWNED_BY_FILE = {
         "_feed_process", "_feed_process_group", "_feed_process_staged",
         "_dispatch_begin", "_dispatch_group", "_dispatch_staged",
         "_dispatch_lanes_group", "_dispatch_dict_group",
+        # ISSUE 20 dict-wire twins of the staged pair above: the feed
+        # thread owns state/_dict_state/host ledgers between the same
+        # drain barriers; the dict path adds no new ownership rule
+        "_feed_process_dict_staged", "_dispatch_dict_staged",
+        "_absorb_dict_staged_host",
         "_absorb_tensorbatch", "_absorb_staged_host",
         "_staging_get", "_staging_release",
         "_feed_fence_error", "_feed_crash_restart",
@@ -505,6 +510,12 @@ _BARRIER_OWNED_BY_FILE = {
         # are mode-exclusive (prefetch on/off), never concurrent
         "_timed_update",
     ]),
+    # runtime/autotune.py (ISSUE 20) joins this rule with NO sanction
+    # entry on purpose: the controller's only cross-thread syncs are
+    # real locks — the module _REGISTRY_LOCK and the per-controller
+    # _lock funneling every transition through _tick_locked /
+    # _start_trial_locked / _fallback_locked — so it is held to the
+    # plain lock discipline, not a barrier-ownership protocol.
 }
 
 

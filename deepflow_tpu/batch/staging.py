@@ -47,9 +47,10 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from deepflow_tpu.models import flow_suite
+from deepflow_tpu.models import flow_dict, flow_suite
 
-__all__ = ["LaneStager", "PackPool", "StagedGroup", "StagingPackError"]
+__all__ = ["DictWireStager", "LaneStager", "PackPool", "StagedGroup",
+           "StagedWireGroup", "StagingPackError"]
 
 _PACK_COLS = ("ip_src", "ip_dst", "port_src", "port_dst", "proto",
               "packet_tx", "packet_rx")
@@ -133,6 +134,10 @@ class PackPool:
         from deepflow_tpu.runtime.supervisor import default_supervisor
 
         self.n_workers = max(1, int(n_workers))
+        # routing width: submit shards over the first `active` workers.
+        # The autotuner resizes this live — shrink just narrows routing
+        # (idle workers keep beating), grow spawns more workers.
+        self.active = self.n_workers
         self.name = name
         self._queues: List[_queue.Queue] = [
             _queue.Queue(maxsize=256) for _ in range(self.n_workers)]
@@ -183,7 +188,31 @@ class PackPool:
                state: _GroupState) -> None:
         state.add()
         self.tasks += 1
-        self._queues[shard_key % self.n_workers].put((fn, state))
+        self._queues[shard_key % self.active].put((fn, state))
+
+    def resize(self, n_workers: int) -> int:
+        """Retarget the routing width to `n_workers` (autotune's
+        pack_workers knob). Growing past the spawned count spawns new
+        supervised workers; shrinking only narrows `active` — routing
+        is a single GIL-atomic int read in submit(), already-queued
+        tasks finish on their original worker, and the same-shard FIFO
+        property holds for all tasks submitted after the change (what
+        correctness actually needs: destinations are pre-assigned, so
+        any routing is byte-identical). Returns the applied width."""
+        from deepflow_tpu.runtime.supervisor import default_supervisor
+
+        n = max(1, int(n_workers))
+        if self._closed:
+            return self.active
+        if n > self.n_workers:
+            sup = default_supervisor()
+            for i in range(self.n_workers, n):
+                self._queues.append(_queue.Queue(maxsize=256))
+                self._handles.append(
+                    sup.spawn(f"{self.name}-{i}", self._make_worker(i)))
+            self.n_workers = n
+        self.active = n
+        return n
 
     def close(self, timeout: float = 5.0) -> None:
         self._closed = True
@@ -194,7 +223,7 @@ class PackPool:
             h.join(timeout=timeout)
 
     def counters(self) -> dict:
-        return {"pack_workers": self.n_workers,
+        return {"pack_workers": self.active,
                 "pack_tasks": self.tasks,
                 "pack_task_errors": self.task_errors}
 
@@ -221,6 +250,7 @@ class LaneStager:
         self._pool_cap = max(1, int(pool_cap))
         self._words = flow_suite.coalesced_lanes_words(
             self.group_batches, self.capacity)
+        self._pending_group: Optional[int] = None
         self._free: list = []
         self._buf: Optional[np.ndarray] = None
         self._state: Optional[_GroupState] = None
@@ -280,10 +310,26 @@ class LaneStager:
         if len(self._free) < self._pool_cap:
             self._free.append(group.buffer)
 
+    def set_group_batches(self, n: int) -> None:
+        """Retarget the coalesce width (autotune's coalesce_batches
+        knob). Applied at the NEXT group boundary — the open buffer
+        keeps its layout, so in-flight groups and the feed's
+        per-signature jitted programs are untouched; the free list is
+        dropped (its buffers are sized for the old width; recycle()'s
+        size check would reject them anyway)."""
+        self._pending_group = max(1, int(n))
+
     # -- internals ---------------------------------------------------------
     def _ensure_buffer(self) -> None:
         if self._buf is not None:
             return
+        if self._pending_group is not None \
+                and self._pending_group != self.group_batches:
+            self.group_batches = self._pending_group
+            self._words = flow_suite.coalesced_lanes_words(
+                self.group_batches, self.capacity)
+            self._free.clear()
+        self._pending_group = None
         try:
             self._buf = self._free.pop()
             self.pool_hits += 1
@@ -340,6 +386,240 @@ class LaneStager:
              "staged_rows": self.total_rows,
              "staging_pool_hits": self.pool_hits,
              "staging_recycled": self.recycled}
+        if self._pack_pool is not None:
+            c.update(self._pack_pool.counters())
+        return c
+
+
+class StagedWireGroup(StagedGroup):
+    """A staged dict-wire group: one coalesced flat buffer holding an
+    emission-ordered news/hits word sequence (flow_dict.stage_wire
+    layout) plus the static signature that selects the fused
+    make_wire_update program. `epoch` stamps which packer generation
+    emitted it: after a device-state restore swaps the packer
+    (DictWireStager.reset_packer), in-flight groups from the old
+    generation reference dictionary indices the fresh device table
+    never scattered — the dispatcher drops them as counted loss
+    instead of applying garbage gathers."""
+
+    __slots__ = ("sig", "epoch", "_wire_src")
+
+    def __init__(self, flat: np.ndarray, sig, k: int, capacity: int,
+                 valid: int, epoch: int, state: _GroupState) -> None:
+        super().__init__(flat=flat, buffer=flat, k=k, capacity=capacity,
+                         valid=valid, state=state)
+        self.sig = sig
+        self.epoch = epoch
+
+
+class DictWireStager:
+    """Dict-wire twin of LaneStager: decoded chunks -> recycled
+    coalesced news/hits staging buffers.
+
+    The dict wire cannot pack chunk slices independently — the packer
+    is a stateful LRU whose news/hits split depends on every record
+    seen before — so the stager accumulates the 7 sketch columns into a
+    preallocated batch buffer, cut at exactly `capacity` rows, and runs
+    ONE pack()+flush() per cut. That reproduces the inline path's batch
+    partition bit-for-bit: same pack-call boundaries -> same news
+    bucket cuts -> same plane count -> same batches_seen -> identical
+    ring admission phase. What the staging plane adds is everything
+    AFTER the pack: emitted planes from `group_batches` consecutive
+    batches coalesce into one recycled flat buffer (flow_dict.stage_wire
+    layout, one device transfer per group), optionally copied by the
+    sharded PackPool (destinations pre-assigned per plane, disjoint
+    writes), riding the DeviceFeed prefetch window exactly like staged
+    lane groups.
+
+    Producer side (put/flush) runs on the exporter worker, serialized;
+    recycle()/reset_packer() run on the feed thread. `_lock` is a LEAF
+    lock (nothing else is acquired under it) guarding the packer and
+    the open group's emitted-wire accumulation — the only state both
+    threads touch."""
+
+    def __init__(self, capacity: int, packer_factory,
+                 group_batches: int = 1,
+                 pool: Optional[PackPool] = None,
+                 pool_cap: int = 4) -> None:
+        self.capacity = int(capacity)
+        self.group_batches = max(1, int(group_batches))
+        self._packer_factory = packer_factory
+        self._packer = packer_factory()
+        self.epoch = 0
+        self._lock = threading.Lock()
+        self._pack_pool = pool
+        self._pool_cap = max(1, int(pool_cap))
+        self._pending_group: Optional[int] = None
+        # host key mirror of the device table (lane-word layout), fed
+        # at stage time so degraded absorb can gather hit keys — see
+        # flow_dict.mirror_news_np for the eviction-reuse caveat
+        self.mirror = np.zeros((4, self._packer.capacity), np.uint32)
+        self._cols = {c: np.empty(self.capacity, np.uint32)
+                      for c in _PACK_COLS}
+        self._fill = 0           # rows in the open (unpacked) batch
+        self._wire: list = []    # emitted planes of the open group
+        self._batches = 0        # packed batches in the open group
+        self._rows = 0           # valid rows packed into the open group
+        # size-keyed free lists: signatures vary, but the packer's
+        # power-of-two width buckets keep the distinct sizes few
+        self._free: Dict[int, list] = {}
+        self.total_rows = 0
+        self.staged_groups = 0
+        self.staged_batches = 0
+        self.pool_hits = 0
+        self.recycled = 0
+        self.epoch_drops = 0
+
+    # -- producer side (the exporter worker, serialized) -------------------
+    def put(self, cols: Dict[str, np.ndarray]) -> List[StagedWireGroup]:
+        """Append one decoded chunk; returns zero or more complete
+        groups. Chunk columns are copied into the batch accumulation
+        buffer immediately, so the caller's views may be invalidated
+        as soon as put() returns."""
+        n = len(next(iter(cols.values())))
+        self.total_rows += n
+        out: List[StagedWireGroup] = []
+        off = 0
+        while n - off > 0:
+            take = min(self.capacity - self._fill, n - off)
+            for c in _PACK_COLS:
+                np.copyto(self._cols[c][self._fill:self._fill + take],
+                          cols[c][off:off + take], casting="unsafe")
+            self._fill += take
+            off += take
+            if self._fill == self.capacity:
+                g = self._cut_batch(self.capacity)
+                if g is not None:
+                    out.append(g)
+        return out
+
+    def flush(self) -> List[StagedWireGroup]:
+        """Pack the partial remainder batch and emit whatever the open
+        group holds — the window-boundary prefix emission."""
+        g = None
+        if self._fill > 0:
+            g = self._cut_batch(self._fill, force_emit=True)
+        elif self._batches > 0 or self._wire:
+            with self._lock:
+                g = self._emit_locked()
+        if g is None:
+            return []
+        self._stage(g)
+        return [g]
+
+    # -- consumer side (the feed thread) -----------------------------------
+    def recycle(self, group: StagedWireGroup) -> None:
+        """Return a group's flat buffer once its fence retired."""
+        self.recycled += 1
+        free = self._free.setdefault(group.flat.size, [])
+        if len(free) < self._pool_cap and len(self._free) <= 16:
+            free.append(group.flat)
+
+    def reset_packer(self) -> int:
+        """Device-state restore: swap in a fresh packer generation (the
+        fresh device table knows no index, so every flow must
+        re-announce as news). The open group's already-packed planes
+        belong to the dead generation and are dropped — returns their
+        row count so the caller adds it to the window's counted loss
+        (exactly the inline path's accounting: those rows died with
+        the device state). The open UNPACKED batch accumulation
+        survives: its rows pack under the new generation."""
+        with self._lock:
+            self._packer = self._packer_factory()
+            self.epoch += 1
+            self.mirror[:] = 0
+            dropped = self._rows
+            self._wire = []
+            self._batches = 0
+            self._rows = 0
+            return dropped
+
+    # -- knobs -------------------------------------------------------------
+    def set_group_batches(self, n: int) -> None:
+        """Retarget the coalesce width; applied at the next group
+        boundary, like LaneStager.set_group_batches. Free lists are
+        size-keyed so old buffers stay reusable whenever a signature
+        repeats."""
+        self._pending_group = max(1, int(n))
+
+    # -- internals ---------------------------------------------------------
+    def _cut_batch(self, n: int,
+                   force_emit: bool = False) -> Optional[StagedWireGroup]:
+        batch = {c: self._cols[c][:n] for c in _PACK_COLS}
+        g = None
+        with self._lock:
+            if self._batches == 0 and self._pending_group is not None:
+                self.group_batches = self._pending_group
+                self._pending_group = None
+            # the inline dispatch sequence, verbatim: one pack + one
+            # hit-drain per batch cut (the flush is what pins the batch
+            # partition — and therefore ring phase — to the inline path)
+            wire = self._packer.pack(batch)
+            wire += self._packer.flush()
+            self._fill = 0       # pack() consumed the accumulation
+            self._wire.extend(wire)
+            self._batches += 1
+            self._rows += n
+            self.staged_batches += 1
+            if force_emit or self._batches >= self.group_batches:
+                g = self._emit_locked()
+        if g is not None and not force_emit:
+            self._stage(g)
+        return g
+
+    def _emit_locked(self) -> Optional[StagedWireGroup]:
+        """Swap the open group out under the lock; staging the bytes
+        happens outside it (the wire list is local after the swap)."""
+        wire, self._wire = self._wire, []
+        k, self._batches = self._batches, 0
+        rows, self._rows = self._rows, 0
+        if not wire:
+            return None
+        sig = flow_dict.wire_signature(wire)
+        g = StagedWireGroup(
+            flat=np.empty(0, np.uint32), sig=sig, k=k,
+            capacity=self.capacity, valid=rows, epoch=self.epoch,
+            state=_GroupState())
+        g._wire_src = wire
+        return g
+
+    def _stage(self, g: StagedWireGroup) -> None:
+        wire = g._wire_src
+        del g._wire_src
+        words = flow_dict.wire_words(g.sig)
+        try:
+            flat = self._free[words].pop()
+            self.pool_hits += 1
+        except (KeyError, IndexError):
+            flat = np.empty(words, np.uint32)
+        g.flat = g.buffer = flat
+        flow_dict.mirror_news_np(wire, self.mirror)
+        if self._pack_pool is None:
+            flow_dict.stage_wire(wire, flat)
+            self.staged_groups += 1
+            return
+        # header words on the producer, plane copies sharded by plane
+        # index (disjoint destinations, pre-assigned — any worker
+        # interleaving lands the same bytes)
+        P = len(wire)
+        off = P
+        for i, (_, plane, nv) in enumerate(wire):
+            flat[i] = nv
+            dest = flat[off:off + plane.size]
+            self._pack_pool.submit(
+                i, lambda p=plane, d=dest: np.copyto(d, p.reshape(-1)),
+                g._state)
+            off += plane.size
+        self.staged_groups += 1
+
+    def counters(self) -> dict:
+        c = {"staged_groups": self.staged_groups,
+             "staged_batches": self.staged_batches,
+             "staged_rows": self.total_rows,
+             "staging_pool_hits": self.pool_hits,
+             "staging_recycled": self.recycled,
+             "dict_epoch": self.epoch,
+             "dict_epoch_drops": self.epoch_drops}
         if self._pack_pool is not None:
             c.update(self._pack_pool.counters())
         return c

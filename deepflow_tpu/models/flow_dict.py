@@ -43,11 +43,13 @@ from typing import Dict, List, NamedTuple, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from deepflow_tpu.models import flow_suite
 from deepflow_tpu.models.flow_suite import (FlowSuiteConfig,
                                             FlowSuiteState, unpack_lanes)
+from deepflow_tpu.utils.twinmark import host_twin_of
 
 # ONE saturation point for the whole dict wire (news and hits): u16,
 # the pairs-plane field width. The packed lane's 24-bit cap is wider,
@@ -100,9 +102,23 @@ def update_news(state: FlowSuiteState, dstate: FlowDictState,
         "ports": plane[3],
         "proto_pkts": proto_word | plane[5],
     }
+    hists = None
+    if count_mask is None and flow_suite.use_fused_hists(cfg):
+        # fused Pallas unpack+fold over the raw NEWS plane: the kernel's
+        # arange<n validity IS this path's count_mask, so the fused form
+        # only applies when no sharding override narrows the count (the
+        # sharded path keeps the unfused ops — its mask and the scatter
+        # mask genuinely differ)
+        from deepflow_tpu.ops import pallas_sketch
+        hists = pallas_sketch.fused_news_hists(
+            plane, n, state.sketch.seeds, state.ent.seeds,
+            cms_log2_width=cfg.cms_log2_width,
+            ent_log2_buckets=cfg.entropy_log2_buckets,
+            interpret=jax.default_backend() not in ("tpu", "axon"))
     if count_mask is None:
         count_mask = mask
-    state = flow_suite.update(state, unpack_lanes(lanes), count_mask, cfg)
+    state = flow_suite.update(state, unpack_lanes(lanes), count_mask, cfg,
+                              hists=hists)
     return state, FlowDictState(table=table)
 
 
@@ -131,6 +147,7 @@ def update_hits(state: FlowSuiteState, dstate: FlowDictState,
     when the plane is a shard of a larger batch and n indexes the
     GLOBAL row space."""
     idx, pkts = unpack_hits(plane)
+    fused = mask is None and flow_suite.use_fused_hists(cfg)
     if mask is None:
         mask = jnp.arange(2 * plane.shape[1]) < n
     rows = dstate.table[:, idx]                  # (4, 2H) gather
@@ -140,7 +157,23 @@ def update_hits(state: FlowSuiteState, dstate: FlowDictState,
         "ports": rows[2],
         "proto_pkts": rows[3] | pkts,
     }
-    return flow_suite.update(state, unpack_lanes(lanes), mask, cfg)
+    hists = None
+    if fused:
+        # hits need no kernel of their own: the table gather is an XLA
+        # op either way, and the gathered rows ARE a (4, 2H) lane plane
+        # — stack them and ride the lane kernel (table word rows[3] is
+        # proto<<24 with zero low bits, so | pkts rebuilds proto_pkts
+        # exactly as the packed-lane wire would carry it)
+        from deepflow_tpu.ops import pallas_sketch
+        lane_plane = jnp.stack([rows[0], rows[1], rows[2],
+                                rows[3] | pkts])
+        hists = pallas_sketch.fused_lane_hists(
+            lane_plane, n, state.sketch.seeds, state.ent.seeds,
+            cms_log2_width=cfg.cms_log2_width,
+            ent_log2_buckets=cfg.entropy_log2_buckets,
+            interpret=jax.default_backend() not in ("tpu", "axon"))
+    return flow_suite.update(state, unpack_lanes(lanes), mask, cfg,
+                             hists=hists)
 
 
 # plane rows per wire kind (the only two shapes the wire carries)
@@ -174,6 +207,78 @@ def stage_wire(wire, flat: np.ndarray) -> None:
         flat[i] = n
         flat[off:off + plane.size] = plane.ravel()
         off += plane.size
+
+
+def mirror_news_np(wire, table: np.ndarray) -> None:
+    """Scatter one wire emission's NEWS keys into a HOST mirror of the
+    device table ((4, capacity) uint32, same lane-word layout:
+    proto<<24 in row 3). The dict stager calls this at stage time for
+    EVERY emitted group — device-bound or not — so when degraded mode
+    must absorb staged hits on the host (`unpack_wire_np`), the mirror
+    holds every index announced so far. Eager stage-time scatter means
+    an index evicted and REUSED by a later already-staged group can
+    show its new tenant to an older in-flight hit absorbed after
+    degradation — a bounded approximation confined to the degraded
+    fallback plane, which is itself a 1/host_stride sample (the device
+    path is exact: its table applies strictly in emission order)."""
+    u = np.uint32
+    for kind, plane, n in wire:
+        if kind != "news":
+            continue
+        idx = plane[0, :n].astype(np.int64)
+        table[0, idx] = plane[1, :n]
+        table[1, idx] = plane[2, :n]
+        table[2, idx] = plane[3, :n]
+        table[3, idx] = plane[4, :n] << u(24)
+
+
+@host_twin_of("deepflow_tpu/models/flow_dict.py:make_wire_update")
+def unpack_wire_np(flat: np.ndarray, sig: Tuple[Tuple[str, int], ...],
+                   table: np.ndarray):
+    """Host twin of the staged wire program: decode one coalesced flat
+    buffer back into the per-plane column dicts `flow_suite.update`
+    consumes, trimmed to each plane's n valid records — what degraded
+    mode feeds the host-numpy fallback sketch when a staged dict group
+    must be absorbed after the device is lost. `table` is the host key
+    mirror `mirror_news_np` maintains; hits gather their 5-tuples from
+    it exactly as `update_hits` gathers from the device table. Returns
+    [(cols, n)] in emission order."""
+    u = np.uint32
+    out = []
+    off = len(sig)
+    for i, (kind, w) in enumerate(sig):
+        n = int(flat[i])
+        r = _KIND_ROWS[kind]
+        plane = flat[off:off + r * w].reshape(r, w)
+        off += r * w
+        if kind == "news":
+            cols = {
+                "ip_src": plane[1, :n],
+                "ip_dst": plane[2, :n],
+                "port_src": plane[3, :n] >> u(16),
+                "port_dst": plane[3, :n] & u(0xFFFF),
+                "proto": plane[4, :n] & u(0xFF),
+                "packet_tx": plane[5, :n],
+                "packet_rx": np.zeros(n, u),
+            }
+        else:
+            # a-lanes then b-lane spill: valid records contiguous at
+            # [0, n) after the concat, exactly like unpack_hits
+            idx = np.concatenate([plane[0], plane[1]])[:n].astype(np.int64)
+            pkts = np.concatenate([plane[2] & u(0xFFFF),
+                                   plane[2] >> u(16)])[:n]
+            rows = table[:, idx]
+            cols = {
+                "ip_src": rows[0],
+                "ip_dst": rows[1],
+                "port_src": rows[2] >> u(16),
+                "port_dst": rows[2] & u(0xFFFF),
+                "proto": rows[3] >> u(24),
+                "packet_tx": pkts,
+                "packet_rx": np.zeros(n, u),
+            }
+        out.append((cols, n))
+    return out
 
 
 def make_wire_update(cfg: FlowSuiteConfig,

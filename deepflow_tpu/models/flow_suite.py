@@ -392,9 +392,14 @@ def update_plane(state: FlowSuiteState, plane: jnp.ndarray,
 
 
 def update(state: FlowSuiteState, cols: Dict[str, jnp.ndarray],
-           mask: jnp.ndarray, cfg: FlowSuiteConfig) -> FlowSuiteState:
-    """Advance all sketches by one static-shape batch. Fully jittable."""
-    mid, fkey = _advance_sketches(state, cols, mask, cfg)
+           mask: jnp.ndarray, cfg: FlowSuiteConfig,
+           hists=None) -> FlowSuiteState:
+    """Advance all sketches by one static-shape batch. Fully jittable.
+    `hists` passes a fused Pallas kernel's precomputed (cms, entropy)
+    histogram deltas through to `_advance_sketches` — the dict wire's
+    fused news/hits path rides this hook (models/flow_dict.py) exactly
+    like `update_lanes_fused` rides `_advance_sketches` directly."""
+    mid, fkey = _advance_sketches(state, cols, mask, cfg, hists=hists)
     ring = topk.offer(state.ring, fkey, mid.sketch, mask=mask,
                       sample_log2=cfg.topk_sample_log2,
                       phase=state.batches_seen)

@@ -73,6 +73,22 @@ class IngesterConfig:
     # threads by flow hash, so host packing keeps prefetch_depth full
     # on multi-core hosts; 0 packs on the exporter worker thread
     pack_workers: int = 0
+    # -- self-tuning device feed (runtime/autotune.py, ISSUE 20) ------
+    # True spawns the feedback controller: a supervised thread that
+    # bounded-hill-climbs coalesce_batches / prefetch_depth /
+    # pack_workers live from tpu_device_busy_fraction,
+    # tpu_feed_stall_seconds and the feed's queue dwell — the static
+    # values above become the starting point (and the safe-fallback
+    # target on any device error). Bit-invisible to sketch state
+    # either way (ci.sh diffs an autotuned run against its
+    # controller-off twin). Requires prefetch_depth > 0.
+    autotune: bool = False
+    # seconds between control ticks; one knob trial spans two ticks
+    # (step, then judge against the occupancy deltas)
+    autotune_interval_s: float = 2.0
+    # hill-climb bounds: the controller never leaves [1, max]
+    autotune_max_coalesce: int = 8
+    autotune_max_depth: int = 8
     # -- pod fault domains (parallel/pod.py, ISSUE 10) ----------------
     # >= 2 runs the tpu_sketch lane as an epoch-merged pod of
     # single-device shard fault domains (one per jax device): each
@@ -293,6 +309,7 @@ class Ingester:
             self.platform.geo = load_geo_table(cfg.geo_db_path,
                                                self.tag_dicts)
         self.tpu_sketch = None
+        self.autotuner = None
         if cfg.tpu_sketch_window_s is not None:
             from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
             ckpt_dir = None if cfg.store_path is None else \
@@ -326,6 +343,18 @@ class Ingester:
                 audit_rate=cfg.audit_sample_rate,
                 anomaly=anomaly, anomaly_dir=anomaly_dir)
             self.exporters.register(self.tpu_sketch)
+            # self-tuning feed (runtime/autotune.py, ISSUE 20): the
+            # controller holds the decode-plane knobs from here on;
+            # cfg's values are its starting point and fallback target
+            if cfg.autotune and self.tpu_sketch._feed is not None:
+                from deepflow_tpu.runtime.autotune import FeedAutotuner
+                self.autotuner = FeedAutotuner(
+                    self.tpu_sketch,
+                    interval_s=cfg.autotune_interval_s,
+                    max_coalesce=cfg.autotune_max_coalesce,
+                    max_depth=cfg.autotune_max_depth)
+                self.stats.register("exporter.tpu_autotune",
+                                    self.autotuner.counters)
             if self.tpu_sketch.anomaly is not None:
                 # alerts ride the breaker-wrapped fan-out on stream
                 # "anomaly" (third-party exporters can subscribe; the
@@ -720,6 +749,8 @@ class Ingester:
             if self.incidents is not None:
                 self.incidents.register_datasource()
             self.timeline.start(self.supervisor)
+        if self.autotuner is not None:
+            self.autotuner.start()
         self.receiver.start()  # last, like the reference (ingester.go:220)
 
     def flush(self) -> None:
@@ -768,6 +799,10 @@ class Ingester:
             self.timeline.unregister_datasource()
             if self.incidents is not None:
                 self.incidents.unregister_datasource()
+        # controller before the drain: knob moves during teardown would
+        # race the drain ladder's own barriers for no benefit
+        if self.autotuner is not None:
+            self.autotuner.close()
         janitor_stop = getattr(self, "_janitor_stop", None)
         if janitor_stop is not None:
             janitor_stop.set()
@@ -808,6 +843,8 @@ class Ingester:
         self.tag_dicts.close()
         self.stats.deregister("tracer")
         self.stats.deregister("supervisor")
+        if self.autotuner is not None:
+            self.stats.deregister("exporter.tpu_autotune")
         if self.timeline is not None:
             self.stats.deregister("timeline")
         if self.incidents is not None:

@@ -365,3 +365,57 @@ def ddos_ramp(seed: int = 0xDD05,
     ramp -> sustained -> recovery), shared by tests, ci.sh and the
     bench anomaly phase."""
     return DDoSRamp(seed=seed, phases=phases, **kw)
+
+
+# -- bursty diurnal duty-cycle sweep (ISSUE 20) ------------------------------
+
+# the default day: quiet trough, morning rise, sustained peak, a short
+# 8x burst riding the peak, evening fall, night trough. rate_mult IS
+# the duty cycle under sweep — the feed autotuner must be within ~10%
+# of the best static config at EVERY phase, which only means something
+# if the phases actually disagree about the right knobs.
+DIURNAL_PHASES = (
+    RampPhase("trough", 4, 0.0, rate_mult=0.25),
+    RampPhase("rise", 3, 0.0, rate_mult=1.0),
+    RampPhase("peak", 6, 0.0, rate_mult=4.0),
+    RampPhase("burst", 2, 0.0, rate_mult=8.0),
+    RampPhase("fall", 3, 0.0, rate_mult=1.0),
+    RampPhase("night", 4, 0.0, rate_mult=0.25),
+)
+
+
+class BurstyDiurnal(DDoSRamp):
+    """Deterministic bursty-diurnal traffic: the DDoSRamp machinery
+    (per-(seed, window) RNG, stable benign flow pool, golden-signal
+    twins) with NO attack rows — the profile varies only the offered
+    rate, sweeping the duty cycle the feed autotuner tunes across.
+
+    The same ``window_cols(w)`` columns feed every wire: the dict wire
+    packs them through FlowDictPacker (the stable pool makes flows
+    genuinely repeat, so the news/hits split is exercised, not just
+    news), the lanes wire packs them into slot planes, and
+    ``l4_frames(w)`` serializes them as TaggedFlow wire frames for a
+    LIVE ingester replay (ci.sh's autotune smoke). Determinism is
+    per-(seed, window) exactly like the ramp: any consumer sees
+    identical bytes for window w."""
+
+    def l4_frames(self, w: int, per_frame: int = 64) -> List[bytes]:
+        """Window w as wire-exact TaggedFlow frames (sequence numbers
+        restart per window so two processes replaying different window
+        ranges stay deterministic)."""
+        _, cols = self.window_cols(w)
+        n = len(cols["ip_src"])
+        agent = SyntheticAgent(seed=(self.seed ^ 0x5EED) + w)
+        recs = [agent.l4_record(cols, i) for i in range(n)]
+        return list(agent.frames(recs, MessageType.TAGGEDFLOW,
+                                 per_frame=per_frame))
+
+
+def bursty_diurnal(seed: int = 0xD1A7,
+                   phases: Optional[Tuple[RampPhase, ...]] = None,
+                   **kw) -> BurstyDiurnal:
+    """The deterministic bursty-diurnal duty-cycle sweep (trough ->
+    rise -> peak -> 8x burst -> fall -> night), shared by
+    tests/test_autotune.py, ci.sh's autotune smoke and bench.py's
+    dict_zero_copy/autotune phases."""
+    return BurstyDiurnal(seed=seed, phases=phases or DIURNAL_PHASES, **kw)

@@ -173,6 +173,16 @@ def render_metrics(stats: Optional[StatsRegistry],
             float(profiler.spans_recorded), mtype="counter",
             help_="spans recorded into the occupancy ring")
 
+    # the feed autotuner's control-loop gauges (runtime/autotune.py):
+    # rendered from the module registry like the profiler's, fresh per
+    # scrape — a paused or fallen-back controller still reports its
+    # enabled=0 and final knob values instead of going silently absent
+    from deepflow_tpu.runtime.autotune import (AUTOTUNE_GAUGE_HELP,
+                                               autotune_gauges)
+    for name, value in sorted(autotune_gauges().items()):
+        _sample(_metric_name("deepflow", name), {}, value,
+                mtype="gauge", help_=AUTOTUNE_GAUGE_HELP.get(name, ""))
+
     if timeline is not None:
         for lbl, burn in sorted(timeline.slo_gauges(),
                                 key=lambda p: sorted(p[0].items())):

@@ -439,28 +439,49 @@ class TpuSketchExporter(QueueWorkerExporter):
             logging.getLogger(__name__).warning(
                 "staged=True has no coalesced feed; prefetch disabled")
             self.prefetch_depth = 0
-        # -- zero-copy decode->staging (batch/staging.py, ISSUE 9) ---------
-        # The packed-lane feed path skips the TensorBatch entirely:
-        # decoded chunk columns (frombuffer views of the frame payload)
-        # pack DIRECTLY into recycled coalesced staging buffers, whole
-        # pre-staged groups ride the feed, and pack_workers > 0 shards
-        # the pack across supervised worker threads by flow hash. The
-        # TensorBatch path (zero_copy=False) remains the bit-identity
-        # reference the equivalence tests diff against; dict/staged
-        # wires and the inline path are unaffected.
-        self.zero_copy = (bool(zero_copy) and self.wire == "lanes"
+        # -- zero-copy decode->staging (batch/staging.py, ISSUE 9/20) ------
+        # The feed path skips the TensorBatch entirely: decoded chunk
+        # columns (frombuffer views of the frame payload) pack DIRECTLY
+        # into recycled coalesced staging buffers, whole pre-staged
+        # groups ride the feed, and pack_workers > 0 shards the
+        # pack/stage work across supervised worker threads. The lanes
+        # wire stages slot-contiguous lane planes (LaneStager); the
+        # dict wire stages the packer's emitted news/hits word sequence
+        # (DictWireStager — ISSUE 20's parity: the DEFAULT wire rides
+        # the same prefetch window). The TensorBatch path
+        # (zero_copy=False) remains the bit-identity reference the
+        # equivalence tests diff against; the staged wire and the
+        # inline path are unaffected.
+        self.zero_copy = (bool(zero_copy)
+                          and self.wire in ("lanes", "dict")
                           and not self.staged and self.prefetch_depth > 0)
         self._stager = None
         self._pack_pool = None
         self.batcher = None
         if self.zero_copy:
-            from deepflow_tpu.batch.staging import LaneStager, PackPool
+            from deepflow_tpu.batch.staging import (DictWireStager,
+                                                    LaneStager, PackPool)
             if pack_workers > 0:
                 self._pack_pool = PackPool(pack_workers)
-            self._stager = LaneStager(
-                batch_rows, group_batches=self.coalesce_batches,
-                pool=self._pack_pool,
-                pool_cap=self.prefetch_depth + 2)
+            if self.wire == "dict":
+                # the stager owns the packer (it must pack at its own
+                # batch cuts to keep the inline partition); the inline
+                # packer object is retired so restore logic cannot
+                # confuse the two
+                self._stager = DictWireStager(
+                    batch_rows,
+                    packer_factory=lambda: self._flow_dict.FlowDictPacker(
+                        capacity=self._packer_capacity,
+                        hits_batch=self._packer_hits_batch),
+                    group_batches=self.coalesce_batches,
+                    pool=self._pack_pool,
+                    pool_cap=self.prefetch_depth + 2)
+                self._dict_packer = None
+            else:
+                self._stager = LaneStager(
+                    batch_rows, group_batches=self.coalesce_batches,
+                    pool=self._pack_pool,
+                    pool_cap=self.prefetch_depth + 2)
         else:
             # only the kernel-consumed subset is batched and transferred
             # to device — the wide store schema never crosses the
@@ -473,7 +494,9 @@ class TpuSketchExporter(QueueWorkerExporter):
             from deepflow_tpu.runtime.feed import DeviceFeed
             self._feed = DeviceFeed(
                 "tpu-sketch-feed",
-                self._feed_process_staged if self.zero_copy
+                self._feed_process_dict_staged
+                if (self.zero_copy and self.wire == "dict")
+                else self._feed_process_staged if self.zero_copy
                 else self._feed_process_group,
                 depth=self.prefetch_depth,
                 # zero-copy groups are coalesced AT THE STAGER (K slots
@@ -778,10 +801,20 @@ class TpuSketchExporter(QueueWorkerExporter):
                 "(current window %d)",
                 self.checkpointer.last_restored_step, self.windows)
         self.state = restored if restored is not None else fresh
-        if self._dict_packer is not None:
-            self._dict_packer = self._flow_dict.FlowDictPacker(
-                capacity=self._packer_capacity,
-                hits_batch=self._packer_hits_batch)
+        if self.wire == "dict":
+            if self.zero_copy and self._stager is not None:
+                # the stager owns the packer: swap a fresh one under its
+                # lock (bumping the wire epoch so in-flight groups whose
+                # slot indices reference the dead table are dropped as
+                # counted loss by the dispatcher) and zero the host
+                # mirror. The open group's already-packed words die with
+                # the old generation; its rows are counted lost here,
+                # matching the inline path's loss accounting.
+                self.lost_rows += self._stager.reset_packer()
+            else:
+                self._dict_packer = self._flow_dict.FlowDictPacker(
+                    capacity=self._packer_capacity,
+                    hits_batch=self._packer_hits_batch)
             self._dict_state = self._flow_dict.init_dict(
                 self._packer_capacity)
         self._warm = set()
@@ -1060,6 +1093,86 @@ class TpuSketchExporter(QueueWorkerExporter):
                     flow_suite.unpack_lanes_np(
                         flow_suite.slot_plane(sg.flat, k, sg.capacity),
                         n))
+        self._stager.recycle(sg)
+
+    def _feed_process_dict_staged(self, group) -> Optional["InFlight"]:
+        """Dict-wire zero-copy twin of _feed_process_staged: items are
+        pre-staged wire groups (batch/staging.py StagedWireGroup) —
+        the packer ran at put() time on the producer (pack + flush per
+        batch_rows cut, exactly the inline partition) and the emitted
+        word sequence was staged flat (possibly on the pack pool), so
+        this thread only waits for readiness, transfers and dispatches
+        the signature-keyed fused program. Degraded mode absorbs the
+        staged words host-side via the unpack twin against the
+        stager's host key mirror; a group staged before a device
+        restart (stale epoch) references a dead table generation and
+        is dropped as counted loss."""
+        return self._feed_process(group, self._absorb_dict_staged_host,
+                                  self._dispatch_dict_staged)
+
+    def _dispatch_dict_staged(self, group,
+                              rows: int) -> Optional["InFlight"]:
+        from deepflow_tpu.runtime.feed import InFlight
+
+        fd = self._flow_dict
+        before = self._dispatch_begin()
+        tr = self._tracer
+        fence = None
+        live = []
+        for sg, _ in group:        # coalesce=1: normally exactly one
+            sg.wait_ready(timeout=30.0)
+            if sg.epoch != self._stager.epoch:
+                # staged against a table generation that died in a
+                # device restart: its slot indices are meaningless now.
+                # Counted loss, exactly like the inline path dropping
+                # the packer's pending wire with the dead state.
+                self._stager.epoch_drops += 1
+                self.lost_rows += int(sg.valid)
+                self._stager.recycle(sg)
+                continue
+            prog = self._program(
+                ("dict", sg.sig),
+                lambda s=sg.sig: fd.make_wire_update(self.cfg, s))
+            flat_d = self._to_device(sg.flat, sg.valid)
+            key = "dict:" + "+".join(f"{k[0]}{w}" for k, w in sg.sig)
+            self.state, self._dict_state, fence = self._timed_update(
+                key, prog, self.state, self._dict_state, flat_d)
+            if self._anomaly is not None:
+                self._anomaly.feed_dict_flat(self._dict_state.table,
+                                             flat_d, sg.sig)
+            live.append(sg)
+        if tr.enabled and self._detailed:
+            tr.gauge("tpu_transfers_per_batch",
+                     (self.h2d_transfers - before)
+                     / max(1, sum(sg.k for sg, _ in group)))
+            tr.gauge("tpu_h2d_coalesced_bytes",
+                     float(sum(sg.flat.nbytes for sg, _ in group)))
+        if fence is None:
+            # every group was a stale-epoch drop (already counted) —
+            # nothing in flight
+            return None  # lint: disable=silent-drop
+        return InFlight(
+            fence, sum(int(sg.valid) for sg in live),
+            lambda: [self._stager.recycle(sg) for sg in live])
+
+    def _absorb_dict_staged_host(self, sg) -> None:
+        """Degraded mode reached a pre-staged wire group: the flat
+        word sequence IS the batch now, so the host fallback walks the
+        unpack twin (news planes carry their keys inline; hits gather
+        them from the stager's host mirror of the device table) at its
+        reduced rate."""
+        sg.wait_ready(timeout=30.0)
+        if sg.epoch != self._stager.epoch:
+            self._stager.epoch_drops += 1
+            self.lost_rows += int(sg.valid)
+            self._stager.recycle(sg)
+            return
+        if self._host is None:
+            self._host = _HostSketch(self.cfg, stride=self.host_stride)
+        for cols, n in self._flow_dict.unpack_wire_np(
+                sg.flat, sg.sig, self._stager.mirror):
+            if n:
+                self.host_rows += self._host.update(cols)
         self._stager.recycle(sg)
 
     _PROGRAM_CACHE_CAP = 128

@@ -1,0 +1,211 @@
+"""ISSUE 20: the feed autotuner's control law, observed synchronously.
+
+`FeedAutotuner.tick()` is the exact step the supervised thread runs, so
+every property — convergence, hysteresis damping, idle skips, safe
+fallback — is tested with fake metrics and explicit dt, no sleeps. The
+last test checks the real knob surface against a live dict-wire
+exporter and the PR 2 supervision of the control thread."""
+
+import pytest
+
+from deepflow_tpu.runtime.autotune import (AUTOTUNE_GAUGE_HELP,
+                                           FeedAutotuner, autotune_gauges)
+from deepflow_tpu.runtime.supervisor import default_supervisor
+
+
+class _FakeStager:
+    def __init__(self, group_batches=1):
+        self.group_batches = group_batches
+
+    def set_group_batches(self, n):
+        # the real stager defers to the next group boundary; the fake
+        # applies immediately — the controller under test is the same
+        self.group_batches = max(1, int(n))
+
+
+class _FakeFeed:
+    def __init__(self):
+        self.depth = 2
+        self.coalesce = 1
+
+
+class _FakePool:
+    def __init__(self, active=2):
+        self.active = active
+
+    def resize(self, n):
+        self.active = max(1, int(n))
+
+
+class _FakeExporter:
+    def __init__(self):
+        self._stager = _FakeStager()
+        self._feed = _FakeFeed()
+        self._pack_pool = _FakePool()
+
+
+class _Plant:
+    """Fake metrics: device busy peaks at (coalesce=4, depth=2,
+    workers=2) and every tick moves rows. The controller only ever
+    sees this dict — exactly what `metrics=` is for."""
+
+    def __init__(self, exp):
+        self.exp = exp
+        self.rows = 0
+        self.device_errors = 0
+        self.crash_recoveries = 0
+        self.degraded = 0.0
+
+    def __call__(self):
+        self.rows += 1000
+        busy = (1.0
+                - 0.10 * abs(self.exp._stager.group_batches - 4)
+                - 0.05 * abs(self.exp._feed.depth - 2)
+                - 0.05 * abs(self.exp._pack_pool.active - 2))
+        return {"busy": busy, "stall_s": 0.0, "dwell_s": 0.0,
+                "dwell_batches": 0, "rows_in": self.rows,
+                "device_errors": self.device_errors,
+                "crash_recoveries": self.crash_recoveries,
+                "degraded": self.degraded}
+
+
+def _tuner(exp, plant, **kw):
+    kw.setdefault("interval_s", 1.0)
+    return FeedAutotuner(exp, metrics=plant, **kw)
+
+
+def _knob(at, name):
+    return next(k for k in at.knobs if k.name == name)
+
+
+def test_converges_to_objective_optimum():
+    """Bounded hill-climbing finds the plant's optimum (coalesce 4)
+    from the static config (coalesce 1) and HOLDS the other knobs at
+    their already-optimal statics; trials past the peak revert and
+    geometrically damp (cooldown_base doubles per revert)."""
+    exp = _FakeExporter()
+    plant = _Plant(exp)
+    at = _tuner(exp, plant)
+    try:
+        for _ in range(60):
+            at.tick(dt=1.0)
+        while at._trial is not None:        # let an in-flight trial judge
+            at.tick(dt=1.0)
+        assert exp._stager.group_batches == 4
+        assert exp._feed.depth == 2
+        assert exp._pack_pool.active == 2
+        assert at.decisions >= 3            # 1 -> 2 -> 3 -> 4 committed
+        assert at.reverts >= 3              # overshoots + flat knobs
+        assert _knob(at, "coalesce_batches").cooldown_base > 1  # damped
+        # the last score may be a reverted probe's, one step off-peak
+        assert at.objective >= 0.89
+        assert at.enabled and at.fallbacks == 0
+    finally:
+        at.close()
+
+
+def test_idle_intervals_never_judge():
+    """A quiet pipe says nothing about a knob: with rows frozen the
+    controller neither starts nor judges trials, so the knobs hold."""
+    exp = _FakeExporter()
+    plant = _Plant(exp)
+    at = _tuner(exp, plant)
+    try:
+        at.tick(dt=1.0)                     # seed baselines
+        plant.rows -= 1000                  # freeze rows_in from here on
+
+        def frozen():
+            m = plant()
+            plant.rows -= 1000
+            return m
+
+        at._metrics = frozen
+        for _ in range(10):
+            at.tick(dt=1.0)
+        assert at.decisions == 0 and at.reverts == 0
+        assert exp._stager.group_batches == 1
+        assert exp._feed.depth == 2
+    finally:
+        at.close()
+
+
+@pytest.mark.parametrize("incident", ["device_errors",
+                                      "crash_recoveries", "degraded"])
+def test_fallback_restores_static_config(incident):
+    """Any device incident mid-tune restores every knob to its static
+    config value and disables the controller — an incident must meet
+    the exact pipeline the operator configured."""
+    exp = _FakeExporter()
+    plant = _Plant(exp)
+    at = _tuner(exp, plant)
+    try:
+        for _ in range(8):                  # move some knobs first
+            at.tick(dt=1.0)
+        assert exp._stager.group_batches > 1
+        setattr(plant, incident, 1 if incident != "degraded" else 1.0)
+        at.tick(dt=1.0)
+        assert not at.enabled and at.fallbacks == 1
+        assert exp._stager.group_batches == 1      # statics restored
+        assert exp._feed.depth == 2
+        assert exp._pack_pool.active == 2
+        g = at.gauges()
+        assert g["tpu_autotune_enabled"] == 0.0
+        assert g["tpu_autotune_fallbacks"] == 1.0
+        ticks = at.ticks
+        at.tick(dt=1.0)                     # disabled: a no-op forever
+        assert at.ticks == ticks
+    finally:
+        at.close()
+
+
+def test_gauges_help_registry_and_exposition():
+    """Every gauge carries HELP text, counters() is the same family
+    minus the prefix, and promexpo renders the live controller's
+    gauges as valid exposition — gone again after close()."""
+    from deepflow_tpu.runtime.promexpo import (render_metrics,
+                                               validate_exposition)
+
+    exp = _FakeExporter()
+    at = _tuner(exp, _Plant(exp))
+    try:
+        g = at.gauges()
+        assert set(g) == set(AUTOTUNE_GAUGE_HELP)
+        assert set(at.counters()) == {k[len("tpu_autotune_"):] for k in g}
+        assert autotune_gauges()["tpu_autotune_enabled"] == 1.0
+        text = render_metrics(None, None)
+        assert "# TYPE deepflow_tpu_autotune_enabled gauge" in text
+        assert "deepflow_tpu_autotune_coalesce_batches" in text
+        assert validate_exposition(text) == []
+    finally:
+        at.close()
+    assert "tpu_autotune_enabled" not in autotune_gauges()
+    assert "deepflow_tpu_autotune" not in render_metrics(None, None)
+
+
+def test_real_exporter_knob_surface_and_supervision():
+    """Against a live dict-wire exporter: the knob surface is exactly
+    (stager coalesce, feed depth, pool routing width), statics capture
+    the config, set routes through the boundary-deferred stager setter,
+    and the control thread rides the supervision tree."""
+    from deepflow_tpu.runtime.tpu_sketch import TpuSketchExporter
+
+    e = TpuSketchExporter(store=None, window_seconds=3600,
+                          batch_rows=1024, wire="dict",
+                          prefetch_depth=2, coalesce_batches=2,
+                          pack_workers=2)
+    at = FeedAutotuner(e, interval_s=0.1)
+    try:
+        assert [k.name for k in at.knobs] == [
+            "coalesce_batches", "prefetch_depth", "pack_workers"]
+        assert [k.static for k in at.knobs] == [2, 2, 2]
+        _knob(at, "coalesce_batches").set(3)
+        assert e._stager._pending_group == 3   # applied at next boundary
+        _knob(at, "pack_workers").set(3)
+        assert e._pack_pool.active == 3
+        at.start()
+        names = {t["name"] for t in default_supervisor().threads()}
+        assert "feed-autotune" in names
+    finally:
+        at.close()
+        e.close()
+    assert not at.enabled

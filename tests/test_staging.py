@@ -5,7 +5,9 @@ The contract under test everywhere: the zero-copy stager, the sharded
 pack pool and the fused kernel each produce sketch state BIT-IDENTICAL
 to the seed TensorBatch path; every row is delivered or counted
 (the PR 4 conservation invariant); and every new thread rides the PR 2
-supervision tree."""
+supervision tree. ISSUE 20 extends the same contract to the dict
+wire: staged news/hits word groups must be bit-identical to the
+inline dict path, LRU state included."""
 
 import os
 import tempfile
@@ -289,17 +291,27 @@ def test_zero_copy_window_output_identical_unaligned():
 
 
 def test_zero_copy_gating():
-    """zero_copy only arms on the lanes wire WITH a feed: the dict wire
-    and the inline path keep their seed shape."""
+    """zero_copy arms on the lanes AND dict wires WITH a feed; the
+    inline (no-feed) and explicitly-off paths keep their seed shape.
+    On the dict wire the stager owns the packer, so the inline packer
+    slot stays empty — there is exactly one LRU authority."""
+    from deepflow_tpu.batch.staging import DictWireStager
+
     e_dict = _exporter(wire="dict")
+    e_dict_inline = _exporter(wire="dict", prefetch_depth=0,
+                              coalesce_batches=1)
     e_inline = _exporter(prefetch_depth=0, coalesce_batches=1)
     e_off = _exporter(zero_copy=False)
     try:
-        assert e_dict._stager is None and not e_dict.zero_copy
+        assert e_dict.zero_copy
+        assert isinstance(e_dict._stager, DictWireStager)
+        assert e_dict._dict_packer is None
+        assert e_dict_inline._stager is None and not e_dict_inline.zero_copy
+        assert e_dict_inline._dict_packer is not None
         assert e_inline._stager is None and not e_inline.zero_copy
         assert e_off._stager is None and not e_off.zero_copy
     finally:
-        for e in (e_dict, e_inline, e_off):
+        for e in (e_dict, e_dict_inline, e_inline, e_off):
             e.close()
 
 
@@ -361,6 +373,107 @@ def test_pack_pool_threads_supervised():
         assert {"stage-pack-0", "stage-pack-1"} <= names
     finally:
         e.close()
+
+
+# -- dict-wire zero-copy parity (ISSUE 20) ----------------------------------
+
+def test_dict_staged_window_output_identical_unaligned():
+    """Dict-wire staged groups == the inline dict path, bit for bit:
+    the stager cuts batch_rows exactly where the inline partition
+    would, runs the SAME one-pack-per-cut LRU protocol, and the window
+    flush ships the open k<K prefix — so every window-output leaf AND
+    every dict-table word agree even when the stream never aligns with
+    group boundaries. Two consecutive windows cover LRU carry-over."""
+    import jax
+
+    rng, pool = _pool(seed=9, hi=1 << 12)
+    exps = [_exporter(wire="dict", zero_copy=False, coalesce_batches=2),
+            _exporter(wire="dict", coalesce_batches=2),
+            _exporter(wire="dict", coalesce_batches=2, pack_workers=2)]
+    assert not exps[0].zero_copy
+    assert exps[1].zero_copy and exps[2].zero_copy
+    try:
+        for _ in range(2):
+            # 6 x 3000 rows: 17 full batches + 592 remainder — never a
+            # whole number of 2-slot groups
+            for c in _chunks(rng, pool, n_chunks=6, rows=3000):
+                for e in exps:
+                    e.process([("l4_flow_log", 0, c)])
+            outs = [e.flush_window() for e in exps]
+            for o in outs[1:]:
+                for a, b in zip(jax.tree.leaves(outs[0]),
+                                jax.tree.leaves(o)):
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b))
+            ref = [np.asarray(x)
+                   for x in jax.tree.leaves(exps[0]._dict_state)]
+            for e in exps[1:]:
+                for a, b in zip(ref, jax.tree.leaves(e._dict_state)):
+                    np.testing.assert_array_equal(a, np.asarray(b))
+    finally:
+        for e in exps:
+            e.close()
+
+
+def test_dict_staged_drain_conservation():
+    """delivered + counted_loss == sent with staged dict groups in
+    flight through the close() drain ladder — the PR 4 invariant holds
+    on the wire-word path too."""
+    rng, pool = _pool(seed=3, n=256, hi=1 << 12)
+    e = _exporter(wire="dict", pack_workers=2)
+    sent = 0
+    for c in _chunks(rng, pool, n_chunks=7, rows=1300):
+        e.process([("l4_flow_log", 0, c)])
+        sent += 1300
+    assert e.pending_extra() >= 0
+    e.close()
+    assert e.rows_in == sent
+    delivered = int(np.asarray(e.last_output.rows))
+    assert delivered + e.lost_rows == sent
+    assert e._feed.pending() == 0
+    c = e.counters()
+    assert c["zero_copy"] == 1 and c["staged_rows"] == sent
+    assert c["pack_task_errors"] == 0
+    assert c["dict_epoch_drops"] == 0      # no rollback, no stale drops
+
+
+def test_zero_copy_degraded_absorbs_staged_dict():
+    """Device loss with staged dict groups in flight: rollback swaps
+    the packer (epoch bump), groups staged against the DEAD epoch are
+    counted loss — their wire indexes a table that no longer exists —
+    while live-epoch groups are absorbed on the host via the mirror
+    gather twin. Probe recovery works and rows_in stays accounted."""
+    rng, pool = _pool(seed=7, n=256, hi=1 << 12)
+    f = default_faults()
+    sites = f.arm_spec("tpu.device_error:count=3,match=dict;seed=5")
+    ck = tempfile.mkdtemp(prefix="stage_dict_ck_")
+    try:
+        e = _exporter(wire="dict", coalesce_batches=2, checkpoint_dir=ck)
+        assert e.zero_copy
+        sent = 0
+        for c in _chunks(rng, pool, n_chunks=8, rows=1024):
+            e.process([("l4_flow_log", 0, c)])
+            sent += 1024
+        assert e._feed.drain(30)
+        assert e.device_errors >= e.degrade_after and e.degraded
+        assert e.lost_rows > 0
+        assert e.counters()["dict_epoch_drops"] >= 1
+        # host absorb needs live-epoch traffic: only groups staged
+        # AFTER the last rollback gather against the rebuilt mirror
+        for c in _chunks(rng, pool, n_chunks=4, rows=1024):
+            e.process([("l4_flow_log", 0, c)])
+            sent += 1024
+        assert e._feed.drain(30)
+        assert e.host_rows > 0
+        assert e.rows_in == sent
+    finally:
+        for s in sites:
+            f.disarm(s)
+    e.flush_window()                 # probe runs with faults disarmed
+    assert e.recoveries == 1 and not e.degraded
+    e.process([("l4_flow_log", 0, _chunks(rng, pool, 1, 1024)[0])])
+    assert e._feed.drain(30)
+    e.close()
 
 
 # -- fused Pallas unpack+sketch kernel --------------------------------------
@@ -488,6 +601,41 @@ def test_fused_lane_hists_deltas_match_sketch_deltas():
     np.testing.assert_array_equal(
         np.asarray(ent_h).astype(np.int32),
         np.asarray(after.ent.hist) - np.asarray(state.ent.hist))
+
+
+def test_fused_dict_wire_state_bit_identical():
+    """The dict wire's news/hits updates with the fused kernel forced
+    (interpret mode off-TPU) == the unfused updates on the same packed
+    wire: every sketch leaf and every dict-table word. The stream sits
+    well inside the documented 2^24 per-cell exactness bound."""
+    import jax
+
+    from deepflow_tpu.models import flow_dict
+
+    rng, pool = _pool(seed=57, n=256, hi=1 << 12)
+    # row-coherent sampling (one index array for ALL columns) so the
+    # 256 pooled 5-tuples actually repeat — that is what fills the
+    # hits lane (_chunks resamples per column: fresh combos, all news)
+    chunks = []
+    for _ in range(3):
+        idx = rng.integers(0, 256, 1500)
+        chunks.append({k: v[idx] for k, v in pool.items()})
+    p = flow_dict.FlowDictPacker(capacity=1 << 13, hits_batch=512)
+    batches = []
+    for c in chunks:
+        batches += p.pack(c)
+    batches += p.flush()
+    assert {k for k, _, _ in batches} == {"news", "hits"}
+    cfg_f = _fused_cfg(fused_hists=True)
+    cfg_u = _fused_cfg()
+    sf, df = flow_dict.apply_batches(
+        flow_suite.init(cfg_f), flow_dict.init_dict(1 << 13),
+        batches, cfg_f)
+    su, du = flow_dict.apply_batches(
+        flow_suite.init(cfg_u), flow_dict.init_dict(1 << 13),
+        batches, cfg_u)
+    for a, b in zip(jax.tree.leaves((su, du)), jax.tree.leaves((sf, df))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 # -- satellite: decode string-hash LRU --------------------------------------
